@@ -1,0 +1,72 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's capabilities.
+
+Brand-new design (not a port): NDArray storage is jax.Array in HBM via PJRT;
+operators are pure-JAX lowerings fused/compiled by XLA; hybridization is
+whole-graph jit; data-parallel/collective training rides XLA collectives
+over the ICI mesh.  See SURVEY.md for the blueprint distilled from the
+reference (apache/incubator-mxnet 2.0-dev).
+
+Usage mirrors the reference:
+
+    import mxnet_tpu as mx
+    x = mx.nd.zeros((2, 3), ctx=mx.tpu(0))
+    with mx.autograd.record():
+        y = (x + 1) * 2
+    y.backward()
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import (Context, cpu, cpu_pinned, current_context, gpu, num_gpus,
+                      num_tpus, tpu)
+
+from . import engine  # noqa: E402
+from . import random  # noqa: E402
+from . import ndarray  # noqa: E402
+from . import ndarray as nd  # noqa: E402
+from .ndarray import NDArray  # noqa: E402
+from . import autograd  # noqa: E402
+
+# subsystems imported lazily on attribute access to keep import light
+_LAZY = {
+    "sym": ".symbol",
+    "symbol": ".symbol",
+    "gluon": ".gluon",
+    "optimizer": ".optimizer",
+    "lr_scheduler": ".lr_scheduler",
+    "kv": ".kvstore",
+    "kvstore": ".kvstore",
+    "io": ".io",
+    "image": ".image",
+    "initializer": ".initializer",
+    "init": ".initializer",
+    "metric": ".metric",
+    "profiler": ".profiler",
+    "amp": ".amp",
+    "np": ".numpy",
+    "npx": ".numpy_extension",
+    "parallel": ".parallel",
+    "runtime": ".runtime",
+    "test_utils": ".test_utils",
+    "recordio": ".recordio",
+    "util": ".util",
+    "executor": ".executor",
+    "callback": ".callback",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute '{name}'")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

@@ -1,0 +1,129 @@
+"""Core plumbing shared across the framework.
+
+TPU-native re-design of the reference's ``python/mxnet/base.py`` (ctypes lib
+discovery, ``check_call``, handle types).  There is no C library handle here:
+the compute substrate is JAX/XLA, so "base" reduces to the error type, the
+registry helpers, and small utilities.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = [
+    "MXNetError",
+    "NotSupportedForSparseNDArray",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "env_bool",
+    "env_int",
+    "env_str",
+]
+
+
+class MXNetError(RuntimeError):
+    """Default error type raised by the framework.
+
+    Mirrors the reference's ``mxnet.base.MXNetError`` (raised from C via
+    ``check_call``, ``python/mxnet/base.py``); here errors originate in Python
+    or surface from XLA at sync points (see ``ndarray.NDArray.wait_to_read``).
+    """
+
+
+class NotSupportedForSparseNDArray(MXNetError):
+    def __init__(self, function, alias, *args):
+        super().__init__(
+            f"Function {function.__name__}"
+            + (f" (alias {alias})" if alias else "")
+            + " is not supported for sparse NDArray"
+        )
+
+
+string_types = (str,)
+numeric_types = (float, int)
+integer_types = (int,)
+
+_NOTHING = object()
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Read an ``MXNET_*``-style env var (reference: dmlc::GetEnv at point of use)."""
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int = 0) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.lower() not in ("0", "false", "off", "")
+
+
+class _ThreadLocalScopeState(threading.local):
+    """Small helper for thread-local nested scope flags (autograd, np-shape...)."""
+
+    def __init__(self, **defaults):
+        super().__init__()
+        self._defaults = dict(defaults)
+        for k, v in defaults.items():
+            setattr(self, k, v)
+
+
+class Registry:
+    """A minimal name->object registry with alias support.
+
+    Stands in for dmlc::Registry / ``KVStoreBase.register``-style plugin
+    registries used throughout the reference.
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._store: Dict[str, Any] = {}
+
+    def register(self, name: Optional[str] = None, allow_override: bool = False):
+        def _do(obj, key):
+            key = key.lower()
+            if key in self._store and not allow_override:
+                raise ValueError(f"{self.kind} '{key}' already registered")
+            self._store[key] = obj
+            return obj
+
+        if callable(name):  # used as bare decorator
+            obj = name
+            return _do(obj, obj.__name__)
+
+        def deco(obj):
+            return _do(obj, name or obj.__name__)
+
+        return deco
+
+    def get(self, name: str):
+        key = name.lower()
+        if key not in self._store:
+            raise KeyError(
+                f"{self.kind} '{name}' is not registered. "
+                f"Available: {sorted(self._store)}"
+            )
+        return self._store[key]
+
+    def find(self, name: str):
+        return self._store.get(name.lower())
+
+    def list(self):
+        return sorted(self._store)
+
+
+def classproperty(func: Callable):
+    class _Desc:
+        def __get__(self, obj, owner):
+            return func(owner)
+
+    return _Desc()

@@ -1,0 +1,39 @@
+"""Engine facade.
+
+The reference's threaded dependency engine (``src/engine/threaded_engine.cc``)
+scheduled every op asynchronously with read/write var tracking.  On TPU,
+XLA's async dispatch stream *is* the engine: ops return before execution and
+data dependencies order work on-device.  This module keeps the user-facing
+engine API (bulk scope, waitall) as thin shims.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size", "waitall"]
+
+_bulk_size = 15  # reference default MXNET_ENGINE_BULK_SIZE-ish; advisory only
+
+
+def set_bulk_size(size: int) -> int:
+    """Reference MXEngineSetBulkSize.  XLA fuses automatically; the value is
+    stored only for API parity."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def waitall():
+    from .ndarray import waitall as _w
+
+    _w()

@@ -1,0 +1,200 @@
+"""``mx.nd`` — the imperative NDArray namespace.
+
+Every operator registered with namespace 'nd' is exposed here as a function
+(generated in :mod:`.register`), mirroring the reference's generated
+``mxnet.ndarray.op`` module.
+"""
+from __future__ import annotations
+
+import sys as _sys
+import types as _types
+
+import numpy as _onp
+
+from ..context import Context, cpu, current_context
+from ..ops import registry as _registry
+from . import utils
+from .ndarray import NDArray, array, invoke
+from .register import make_op_func
+from .utils import load, save
+
+_this = _sys.modules[__name__]
+
+# --- generate op functions -------------------------------------------------
+_seen = set()
+for _name, _schema in list(_registry._OPS.items()):
+    if "nd" not in _schema.namespaces:
+        continue
+    if _name in _seen:
+        continue
+    _seen.add(_name)
+    if not hasattr(_this, _name):
+        setattr(_this, _name, make_op_func(_schema))
+
+op = _this  # reference exposes mx.nd.op alias
+
+
+# --- creation helpers with MXNet calling conventions -----------------------
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    import jax.numpy as jnp
+
+    from .ndarray import _wrap
+
+    ctx = ctx or current_context()
+    import jax
+
+    return _wrap(
+        jax.device_put(jnp.zeros(shape, _np_dtype(dtype)), ctx.jax_device), ctx
+    )
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray import _wrap
+
+    ctx = ctx or current_context()
+    return _wrap(
+        jax.device_put(jnp.ones(shape, _np_dtype(dtype)), ctx.jax_device), ctx
+    )
+
+
+def full(shape, val, ctx=None, dtype="float32", **kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray import _wrap
+
+    ctx = ctx or current_context()
+    return _wrap(
+        jax.device_put(jnp.full(shape, val, _np_dtype(dtype)), ctx.jax_device), ctx
+    )
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def _np_dtype(dtype):
+    import jax.numpy as jnp
+
+    if dtype is None:
+        return jnp.float32
+    if dtype == "bfloat16":
+        return jnp.bfloat16
+    return _onp.dtype(dtype) if isinstance(dtype, str) else dtype
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = invoke(
+        _registry.get_op("arange"),
+        [],
+        {"start": start, "stop": stop, "step": step, "repeat": repeat, "dtype": dtype},
+    )
+    if ctx is not None:
+        import jax
+
+        out._ctx = ctx
+        out._data = jax.device_put(out._data, ctx.jax_device)
+    return out
+
+
+def waitall():
+    """Block until all async work completes (reference MXNDArrayWaitAll).
+
+    JAX dispatches asynchronously; an effects barrier drains the stream."""
+    import jax
+
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return invoke(_registry.get_op("concat"), list(arrays), {"dim": axis})
+
+
+def moveaxis(data, source, destination):
+    import numpy as onp
+
+    axes = list(range(data.ndim))
+    src = [source] if isinstance(source, int) else list(source)
+    dst = [destination] if isinstance(destination, int) else list(destination)
+    for s, d in sorted(zip(src, dst), key=lambda x: x[1]):
+        axes.remove(s)
+        axes.insert(d, s)
+    return invoke(_registry.get_op("transpose"), [data], {"axes": tuple(axes)})
+
+
+# --- random submodule ------------------------------------------------------
+random = _types.ModuleType(__name__ + ".random")
+_sys.modules[random.__name__] = random
+
+
+def _make_random(name, schema_name=None):
+    schema = _registry.get_op(schema_name or name)
+    base = make_op_func(schema)
+
+    def fn(*args, **kwargs):
+        return base(*args, **kwargs)
+
+    fn.__name__ = name
+    return fn
+
+
+random.gamma = _make_random("gamma", "random_gamma")
+for _rn in [
+    "uniform",
+    "normal",
+    "exponential",
+    "poisson",
+    "negative_binomial",
+    "randint",
+    "randn",
+    "multinomial",
+    "shuffle",
+    "bernoulli",
+]:
+    setattr(random, _rn, _make_random(_rn))
+random.seed = __import__("mxnet_tpu.random", fromlist=["seed"]).seed
+
+# linalg submodule
+linalg = _types.ModuleType(__name__ + ".linalg")
+_sys.modules[linalg.__name__] = linalg
+for _ln in _registry.list_ops():
+    if _ln.startswith("linalg_"):
+        setattr(linalg, _ln[len("linalg_"):], getattr(_this, _ln))
+
+# contrib submodule (foreach/while_loop/cond + contrib ops)
+contrib = _types.ModuleType(__name__ + ".contrib")
+_sys.modules[contrib.__name__] = contrib
+from ..ops.control_flow import cond, foreach, while_loop  # noqa: E402
+
+contrib.foreach = foreach
+contrib.while_loop = while_loop
+contrib.cond = cond
+for _cn in [
+    "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt",
+    "div_sqrt_dim",
+    "boolean_mask",
+    "index_copy",
+    "index_array",
+    "allclose",
+    "arange_like",
+    "quadratic",
+    "BilinearResize2D",
+    "AdaptiveAvgPooling2D",
+    "ROIAlign",
+    "box_iou",
+]:
+    if hasattr(_this, _cn):
+        setattr(contrib, _cn, getattr(_this, _cn))
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "waitall", "save", "load", "concatenate", "random", "linalg",
+           "contrib", "invoke"]

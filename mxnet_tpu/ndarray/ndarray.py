@@ -1,0 +1,656 @@
+"""NDArray: the imperative array type.
+
+TPU-native re-design of the reference NDArray (``include/mxnet/ndarray.h``,
+``python/mxnet/ndarray/ndarray.py``).  The reference pairs each array with a
+dependency-engine variable so mutation is ordered asynchronously; here the
+storage is an immutable ``jax.Array`` living in device memory (HBM via PJRT)
+and *mutation is modeled as replacement*: every write installs a fresh
+jax.Array and bumps ``version`` (the engine-var version analog).  JAX's async
+dispatch supplies the "ops return immediately / sync at asnumpy()" illusion
+that the reference built the threaded engine for:
+
+- ``wait_to_read``/``wait_to_write``  -> ``block_until_ready`` on the buffer
+- exceptions thrown by device code surface at sync points (MXNetError), the
+  reference's ``ExceptionRef`` story (src/engine/threaded_engine.h:64).
+
+Operator dispatch (``invoke``) is the analog of ``MXImperativeInvokeImpl``
+(src/c_api/c_api_ndarray.cc:91): unwrap arrays, run the registered pure-JAX
+fn (optionally under ``jax.vjp`` when autograd is recording), wrap outputs.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import autograd
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ops.registry import OpSchema, find_op, get_op
+
+__all__ = ["NDArray", "invoke", "array", "_wrap", "_on_tape"]
+
+_float_types = (onp.float16, onp.float32, onp.float64, jnp.bfloat16)
+
+
+def _dtype_np(dtype) -> onp.dtype:
+    if dtype is None:
+        return onp.dtype("float32")
+    if dtype == jnp.bfloat16 or (isinstance(dtype, str) and dtype == "bfloat16"):
+        return jnp.bfloat16  # type: ignore[return-value]
+    return onp.dtype(dtype)
+
+
+class NDArray:
+    """An n-dimensional array on a device context."""
+
+    __slots__ = (
+        "_data",
+        "_ctx",
+        "_version",
+        "_grad",
+        "_ag_grad_req",
+        "_ag_node",
+        "_ag_out_index",
+        "_deferred_init",
+        "__weakref__",
+    )
+
+    # numpy interop precedence (reference ndarray.py __array_priority__)
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(
+                data, dtype=_dtype_np(dtype) if dtype is not None else None
+            )
+            data = jax.device_put(data, ctx.jax_device)
+        elif dtype is not None and data.dtype != _dtype_np(dtype):
+            data = data.astype(_dtype_np(dtype))
+        self._data = data
+        self._ctx = ctx
+        self._version = 0
+        self._grad = None
+        self._ag_grad_req = "null"
+        self._ag_node = None
+        self._ag_out_index = 0
+        self._deferred_init = None
+
+    # ------------------------------------------------------------------
+    # core properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        dt = self._data.dtype
+        return dt if dt == jnp.bfloat16 else onp.dtype(dt)
+
+    @property
+    def size(self) -> int:
+        return int(onp.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def ctx(self) -> Context:
+        return self._ctx
+
+    context = ctx
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    @property
+    def T(self) -> "NDArray":
+        return invoke("transpose", [self], {})
+
+    @property
+    def version(self) -> int:
+        """Write-version of this array (engine var version analog)."""
+        return self._version
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    # ------------------------------------------------------------------
+    # mutation-as-replacement
+    # ------------------------------------------------------------------
+    def _set_data(self, new_data: jax.Array):
+        if tuple(new_data.shape) != self.shape:
+            raise MXNetError(
+                f"cannot write shape {tuple(new_data.shape)} into NDArray of "
+                f"shape {self.shape}"
+            )
+        self._data = new_data
+        self._version += 1
+
+    # ------------------------------------------------------------------
+    # sync / host transfer
+    # ------------------------------------------------------------------
+    def wait_to_read(self):
+        try:
+            self._data.block_until_ready()
+        except Exception as e:  # XLA runtime errors surface here
+            raise MXNetError(str(e)) from e
+
+    def wait_to_write(self):
+        self.wait_to_read()
+
+    def asnumpy(self) -> onp.ndarray:
+        self.wait_to_read()
+        return onp.asarray(self._data)
+
+    def item(self):
+        return self.asnumpy().item()
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError(
+            "The truth value of an NDArray with multiple elements is ambiguous."
+        )
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """Allocate a gradient buffer (reference ndarray.py attach_grad)."""
+        grad = _wrap(jnp.zeros(self.shape, self._data.dtype), self._ctx)
+        self._mark_variable(grad, grad_req)
+
+    def _mark_variable(self, grad: "NDArray", grad_req: str):
+        self._grad = grad
+        self._ag_grad_req = grad_req
+        self._ag_node = None
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad], retain_graph, train_mode)
+
+    def detach(self) -> "NDArray":
+        out = _wrap(self._data, self._ctx)
+        return out
+
+    # ------------------------------------------------------------------
+    # conversion / copies
+    # ------------------------------------------------------------------
+    def astype(self, dtype, copy=True) -> "NDArray":
+        dt = _dtype_np(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return invoke("cast", [self], {"dtype": dt})
+
+    def copy(self) -> "NDArray":
+        return invoke("_copy", [self], {})
+
+    def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        if isinstance(other, NDArray):
+            other._set_data(
+                jax.device_put(self._data, other._ctx.jax_device).astype(
+                    other._data.dtype
+                )
+            )
+            return other
+        out = NDArray(jax.device_put(self._data, other.jax_device), ctx=other)
+        return out
+
+    def as_in_context(self, context: Context) -> "NDArray":
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    as_in_ctx = as_in_context
+
+    def as_np_ndarray(self):
+        from ..numpy.multiarray import ndarray as np_ndarray
+
+        out = np_ndarray.__new__(np_ndarray)
+        NDArray.__init__(out, self._data, ctx=self._ctx)
+        out._ag_node = self._ag_node
+        out._ag_out_index = self._ag_out_index
+        out._grad = self._grad
+        out._ag_grad_req = self._ag_grad_req
+        return out
+
+    def as_nd_ndarray(self):
+        out = NDArray.__new__(NDArray)
+        NDArray.__init__(out, self._data, ctx=self._ctx)
+        out._ag_node = self._ag_node
+        out._ag_out_index = self._ag_out_index
+        out._grad = self._grad
+        out._ag_grad_req = self._ag_grad_req
+        return out
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise NotImplementedError("sparse storage is handled by mx.nd.sparse")
+        return self
+
+    # ------------------------------------------------------------------
+    # shape ops (methods mirror reference method surface)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs) -> "NDArray":
+        if "shape" in kwargs:
+            shape = kwargs["shape"]
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return invoke("reshape", [self], {"shape": tuple(shape)})
+
+    def reshape_like(self, other) -> "NDArray":
+        return invoke("reshape", [self], {"shape": other.shape})
+
+    def transpose(self, *axes) -> "NDArray":
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return invoke("transpose", [self], {"axes": axes or None})
+
+    def swapaxes(self, dim1, dim2) -> "NDArray":
+        return invoke("swapaxes", [self], {"dim1": dim1, "dim2": dim2})
+
+    def flatten(self) -> "NDArray":
+        return invoke("flatten", [self], {})
+
+    def expand_dims(self, axis) -> "NDArray":
+        return invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return invoke("squeeze", [self], {"axis": axis})
+
+    def broadcast_to(self, shape) -> "NDArray":
+        return invoke("broadcast_to", [self], {"shape": tuple(shape)})
+
+    def broadcast_like(self, other) -> "NDArray":
+        return invoke("broadcast_to", [self], {"shape": other.shape})
+
+    def tile(self, reps) -> "NDArray":
+        return invoke("tile", [self], {"reps": reps})
+
+    def repeat(self, repeats, axis=None) -> "NDArray":
+        return invoke("repeat", [self], {"repeats": repeats, "axis": axis})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke(
+            "split",
+            [self],
+            {"num_outputs": num_outputs, "axis": axis, "squeeze_axis": squeeze_axis},
+        )
+
+    def slice(self, begin, end, step=None) -> "NDArray":
+        return invoke("slice", [self], {"begin": begin, "end": end, "step": step})
+
+    def slice_axis(self, axis, begin, end) -> "NDArray":
+        return invoke("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip") -> "NDArray":
+        return invoke("take", [self, _as_nd(indices, self._ctx)], {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        return invoke("one_hot", [self], {"depth": depth, "on_value": on_value,
+                                          "off_value": off_value, "dtype": dtype})
+
+    # reductions
+    def sum(self, axis=None, keepdims=False, **kw) -> "NDArray":
+        return invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False, **kw) -> "NDArray":
+        return invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False, **kw) -> "NDArray":
+        return invoke("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False, **kw) -> "NDArray":
+        return invoke("min", [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False, **kw) -> "NDArray":
+        return invoke("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False) -> "NDArray":
+        return invoke("norm", [self], {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False) -> "NDArray":
+        return invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False) -> "NDArray":
+        return invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def clip(self, a_min=None, a_max=None) -> "NDArray":
+        return invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def abs(self) -> "NDArray":
+        return invoke("abs", [self], {})
+
+    def sqrt(self) -> "NDArray":
+        return invoke("sqrt", [self], {})
+
+    def square(self) -> "NDArray":
+        return invoke("square", [self], {})
+
+    def exp(self) -> "NDArray":
+        return invoke("exp", [self], {})
+
+    def log(self) -> "NDArray":
+        return invoke("log", [self], {})
+
+    def relu(self) -> "NDArray":
+        return invoke("relu", [self], {})
+
+    def sigmoid(self) -> "NDArray":
+        return invoke("sigmoid", [self], {})
+
+    def tanh(self) -> "NDArray":
+        return invoke("tanh", [self], {})
+
+    def softmax(self, axis=-1) -> "NDArray":
+        return invoke("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1) -> "NDArray":
+        return invoke("log_softmax", [self], {"axis": axis})
+
+    def dot(self, other) -> "NDArray":
+        return invoke("dot", [self, _as_nd(other, self._ctx)], {})
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def __getitem__(self, key) -> "NDArray":
+        key = _index_unwrap(key)
+        return invoke("_index", [self], {"key": key})
+
+    def __setitem__(self, key, value):
+        key = _index_unwrap(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, numbers.Number):
+            pass
+        else:
+            value = jnp.asarray(value)
+        if key is Ellipsis or key == slice(None):
+            if isinstance(value, numbers.Number):
+                self._set_data(jnp.full(self.shape, value, self._data.dtype))
+            else:
+                self._set_data(
+                    jnp.broadcast_to(jnp.asarray(value, self._data.dtype), self.shape)
+                )
+        else:
+            self._set_data(self._data.at[key].set(value))
+
+    # ------------------------------------------------------------------
+    # arithmetic operators
+    # ------------------------------------------------------------------
+    def _binary(self, op_name, other, reverse=False):
+        if isinstance(other, numbers.Number):
+            args = [self]
+            attrs = {"scalar": float(other), "reverse": reverse}
+            return invoke(f"{op_name}_scalar", args, attrs)
+        other = _as_nd(other, self._ctx)
+        a, b = (other, self) if reverse else (self, other)
+        return invoke(f"broadcast_{op_name}", [a, b], {})
+
+    def _inplace(self, op_name, other):
+        """In-place update.  While recording, the array takes over the
+        result's tape node so gradients stay correct (mutation-as-replacement
+        keeps the tape functional); in-place on a *leaf* variable during
+        recording is an error, as in the reference."""
+        if autograd.is_recording() and self._ag_grad_req != "null":
+            raise MXNetError(
+                "in-place operation on a variable with attached grad is not "
+                "allowed while autograd is recording"
+            )
+        # snapshot: the tape must reference the pre-mutation value, not self
+        # (otherwise the node's input aliases its own output -> cyclic tape)
+        src = _wrap(self._data, self._ctx)
+        src._ag_node = self._ag_node
+        src._ag_out_index = self._ag_out_index
+        out = src._binary(op_name, other)
+        self._set_data(out._data)
+        self._ag_node = out._ag_node
+        self._ag_out_index = out._ag_out_index
+        return self
+
+    def __add__(self, other):
+        return self._binary("add", other)
+
+    def __radd__(self, other):
+        return self._binary("add", other, reverse=True)
+
+    def __iadd__(self, other):
+        return self._inplace("add", other)
+
+    def __sub__(self, other):
+        return self._binary("sub", other)
+
+    def __rsub__(self, other):
+        return self._binary("sub", other, reverse=True)
+
+    def __isub__(self, other):
+        return self._inplace("sub", other)
+
+    def __mul__(self, other):
+        return self._binary("mul", other)
+
+    def __rmul__(self, other):
+        return self._binary("mul", other, reverse=True)
+
+    def __imul__(self, other):
+        return self._inplace("mul", other)
+
+    def __truediv__(self, other):
+        return self._binary("div", other)
+
+    def __rtruediv__(self, other):
+        return self._binary("div", other, reverse=True)
+
+    def __itruediv__(self, other):
+        return self._inplace("div", other)
+
+    def __mod__(self, other):
+        return self._binary("mod", other)
+
+    def __rmod__(self, other):
+        return self._binary("mod", other, reverse=True)
+
+    def __pow__(self, other):
+        return self._binary("power", other)
+
+    def __rpow__(self, other):
+        return self._binary("power", other, reverse=True)
+
+    def __matmul__(self, other):
+        return self.dot(other)
+
+    def __neg__(self):
+        return invoke("negative", [self], {})
+
+    def __abs__(self):
+        return invoke("abs", [self], {})
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return self._binary("equal", other)
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return self._binary("not_equal", other)
+
+    def __gt__(self, other):
+        return self._binary("greater", other)
+
+    def __ge__(self, other):
+        return self._binary("greater_equal", other)
+
+    def __lt__(self, other):
+        return self._binary("lesser", other)
+
+    def __le__(self, other):
+        return self._binary("lesser_equal", other)
+
+    __hash__ = None  # mutable container semantics, like the reference
+
+    def __repr__(self):
+        try:
+            arr = self.asnumpy()
+            body = str(arr)
+        except MXNetError as e:
+            body = f"<error: {e}>"
+        return f"{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def _on_tape(arr) -> bool:
+    return isinstance(arr, NDArray) and (
+        arr._ag_node is not None or arr._ag_grad_req != "null"
+    )
+
+
+def _wrap(data: jax.Array, ctx: Context) -> "NDArray":
+    out = NDArray.__new__(NDArray)
+    out._data = data
+    out._ctx = ctx
+    out._version = 0
+    out._grad = None
+    out._ag_grad_req = "null"
+    out._ag_node = None
+    out._ag_out_index = 0
+    out._deferred_init = None
+    return out
+
+
+def _as_nd(x, ctx: Context) -> "NDArray":
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(x, ctx=ctx)
+
+
+def _index_unwrap(key):
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+    return key
+
+
+def invoke(
+    op: Union[str, OpSchema],
+    inputs: Sequence[NDArray],
+    attrs: dict,
+    out: Optional[Union[NDArray, Sequence[NDArray]]] = None,
+):
+    """Imperative operator dispatch (MXImperativeInvokeImpl analog).
+
+    - Unwraps NDArray inputs to jax.Arrays.
+    - If autograd is recording and any input is tape-connected and the op is
+      differentiable, runs under ``jax.vjp`` and records a TapeNode.
+    - Wraps outputs; honours ``out=`` by writing into the destination
+      (reference's kWriteTo into provided output arrays).
+    """
+    schema = get_op(op) if isinstance(op, str) else op
+    ctx = inputs[0]._ctx if inputs else current_context()
+    arrays = [i._data for i in inputs]
+
+    # Record every differentiable op while the scope is active (the reference
+    # records all ops under record(), not just ones touching marked vars —
+    # autograd.grad() may later differentiate w.r.t. any graph input).
+    record = autograd.is_recording() and schema.differentiable and len(inputs) > 0
+
+    if schema.num_inputs == -1:
+        fn = lambda *arrs: schema.fn(list(arrs), **attrs)
+    else:
+        fn = lambda *arrs: schema.fn(*arrs, **attrs)
+
+    if record:
+        try:
+            raw_out, vjp_fn = jax.vjp(fn, *arrays)
+        except (TypeError, jax.errors.JaxRuntimeError):
+            # non-differentiable in practice (int dtypes etc.) — plain call
+            record = False
+            raw_out = fn(*arrays)
+    else:
+        raw_out = fn(*arrays)
+
+    multi = isinstance(raw_out, (tuple, list))
+    outs_raw = list(raw_out) if multi else [raw_out]
+    outputs = [_wrap(o, ctx) for o in outs_raw]
+
+    if record:
+        node = autograd.TapeNode(
+            vjp_fn,
+            list(inputs),
+            len(outputs),
+            [tuple(o.shape) for o in outs_raw],
+            [o.dtype for o in outs_raw],
+            name=schema.name,
+        )
+        for i, o in enumerate(outputs):
+            o._ag_node = node
+            o._ag_out_index = i
+
+    if out is not None:
+        dests = [out] if isinstance(out, NDArray) else list(out)
+        for d, o in zip(dests, outputs):
+            d._set_data(o._data.astype(d._data.dtype) if d._data.dtype != o._data.dtype else o._data)
+            d._ag_node = o._ag_node
+            d._ag_out_index = o._ag_out_index
+        return out
+
+    if not multi:
+        return outputs[0]
+    return outputs
+
+
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """Create an NDArray from any array-like (reference mx.nd.array)."""
+    if isinstance(source_array, NDArray):
+        return NDArray(source_array._data, ctx=ctx or source_array._ctx, dtype=dtype)
+    if dtype is None:
+        np_in = onp.asarray(source_array)
+        # MXNet's default dtype is float32: wide floats narrow, float16 and
+        # all integer dtypes pass through.
+        if np_in.dtype.kind == "f" and np_in.dtype != onp.float16:
+            dtype = "float32"
+        else:
+            dtype = np_in.dtype
+    return NDArray(onp.asarray(source_array), ctx=ctx, dtype=dtype)

@@ -1,0 +1,97 @@
+"""Generate python-level operator functions from the registry.
+
+Reference analog: at import time the reference enumerates C-registered ops
+and code-generates python wrappers into ``mxnet.ndarray.op``
+(``python/mxnet/ndarray/register.py:115-277``).  Here generation is
+introspective: the registered pure-JAX fn's signature tells us which leading
+parameters are arrays (``num_inputs``) and which are attrs; positional
+passing of attrs works the MXNet way (``nd.reshape(x, (2, 3))``).
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+from ..ops.registry import OpSchema
+from .ndarray import NDArray, array, invoke
+
+__all__ = ["make_op_func"]
+
+
+def make_op_func(schema: OpSchema) -> Callable:
+    sig = inspect.signature(schema.fn)
+    params = list(sig.parameters)
+
+    if schema.num_inputs == -1:
+        attr_names = params[1:]
+
+        def fn(*args, out=None, **kwargs):
+            arrays = []
+            rest = []
+            for a in args:
+                if isinstance(a, NDArray):
+                    arrays.append(a)
+                elif not arrays and not rest and isinstance(a, (list, tuple)) and a and isinstance(a[0], NDArray):
+                    arrays.extend(a)
+                else:
+                    rest.append(a)
+            attrs = dict(zip(attr_names, rest))
+            attrs.update({k: v for k, v in kwargs.items() if k not in ("name", "ctx", "dtype_hint")})
+            attrs = _unwrap_attr_arrays(attrs)
+            return invoke(schema, arrays, attrs, out=out)
+
+    elif schema.num_inputs == 0:
+        attr_names = params
+
+        def fn(*args, out=None, ctx=None, **kwargs):
+            attrs = dict(zip(attr_names, args))
+            attrs.update({k: v for k, v in kwargs.items() if k not in ("name", "ctx")})
+            attrs = _unwrap_attr_arrays(attrs)
+            from ..context import current_context
+
+            ctx = ctx or current_context()
+            dummy = []
+            out_arr = invoke(schema, dummy, attrs, out=out)
+            if out is None and ctx is not None:
+                # re-home onto requested ctx
+                import jax
+
+                for o in out_arr if isinstance(out_arr, list) else [out_arr]:
+                    o._ctx = ctx
+                    o._data = jax.device_put(o._data, ctx.jax_device)
+            return out_arr
+
+    else:
+        n_in = schema.num_inputs
+        attr_names = params[n_in:]
+
+        def fn(*args, out=None, **kwargs):
+            arrays = list(args[:n_in])
+            rest = args[n_in:]
+            ctx = None
+            for a in arrays:
+                if isinstance(a, NDArray):
+                    ctx = a._ctx
+                    break
+            arrays = [
+                a if isinstance(a, NDArray) or a is None else array(a, ctx=ctx)
+                for a in arrays
+            ]
+            # drop trailing Nones (optional array slots)
+            while arrays and arrays[-1] is None:
+                arrays.pop()
+            attrs = dict(zip(attr_names, rest))
+            attrs.update({k: v for k, v in kwargs.items() if k not in ("name", "ctx")})
+            attrs = _unwrap_attr_arrays(attrs)
+            return invoke(schema, arrays, attrs, out=out)
+
+    fn.__name__ = schema.name
+    fn.__doc__ = schema.doc
+    return fn
+
+
+def _unwrap_attr_arrays(attrs: dict) -> dict:
+    # attrs must be static python values / jax arrays, not NDArrays
+    return {
+        k: (v._data if isinstance(v, NDArray) else v) for k, v in attrs.items()
+    }

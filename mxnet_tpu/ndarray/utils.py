@@ -1,0 +1,54 @@
+"""NDArray save/load (reference ``src/ndarray/ndarray.cc`` Save/Load +
+``python/mxnet/ndarray/utils.py:149-222``).
+
+Format: a single ``.npz`` container.  List saves use keys ``arr_0..n``;
+dict saves use the user keys prefixed with ``k:``.  This replaces the
+reference's dmlc serialized header + raw chunks with a standard,
+version-tolerant container (numpy owns the compat story).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Union
+
+import numpy as onp
+
+from ..context import Context, cpu
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load", "imdecode"]
+
+
+def save(fname: str, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    payload = {}
+    if isinstance(data, dict):
+        for k, v in data.items():
+            if not isinstance(v, NDArray):
+                raise TypeError("save only supports NDArray values")
+            payload["k:" + k] = v.asnumpy()
+    elif isinstance(data, (list, tuple)):
+        for i, v in enumerate(data):
+            if not isinstance(v, NDArray):
+                raise TypeError("save only supports NDArray values")
+            payload[f"arr_{i}"] = v.asnumpy()
+    else:
+        raise TypeError(f"cannot save {type(data)}")
+    with open(fname, "wb") as f:
+        onp.savez(f, **payload)
+
+
+def load(fname: str, ctx: Context = None):
+    with onp.load(fname, allow_pickle=False) as z:
+        keys = list(z.keys())
+        if keys and keys[0].startswith("k:"):
+            return {k[2:]: array(z[k], ctx=ctx) for k in keys}
+        out: List[NDArray] = []
+        for i in range(len(keys)):
+            out.append(array(z[f"arr_{i}"], ctx=ctx))
+        return out
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    raise NotImplementedError("use mx.image.imdecode")

@@ -1,0 +1,22 @@
+"""Operator library: name -> JAX lowering registry.
+
+TPU-native replacement for the reference's nnvm operator registry
+(``NNVM_REGISTER_OP`` + FCompute kernels, ``include/mxnet/op_attr_types.h``).
+Instead of per-device kernels, each op is a pure JAX function; XLA owns
+fusion, tiling and memory planning (what the reference did with
+MXPlanMemory / pointwise_fusion_pass / CSE in src/imperative and src/nnvm).
+"""
+from .registry import OpSchema, register, get_op, find_op, list_ops
+
+from . import tensor  # noqa: F401  (registers ops on import)
+from . import elemwise  # noqa: F401
+from . import nn  # noqa: F401
+from . import reduce as _reduce  # noqa: F401
+from . import random as _random  # noqa: F401
+from . import init as _init  # noqa: F401
+from . import optimizer as _optimizer  # noqa: F401
+from . import linalg as _linalg  # noqa: F401
+from . import contrib as _contrib  # noqa: F401
+from . import control_flow as _control_flow  # noqa: F401
+
+__all__ = ["OpSchema", "register", "get_op", "find_op", "list_ops"]

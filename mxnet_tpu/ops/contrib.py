@@ -1,0 +1,222 @@
+"""Contrib operators — transformer fused attention matmuls, detection ops,
+resampling (reference ``src/operator/contrib/``).
+
+The interleaved self-attention ops mirror the reference BERT kernels
+(``src/operator/contrib/transformer.cc:650-740``): projections stored
+interleaved as (qkv) so QK^T and attn*V run as single batched matmuls on the
+MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("interleaved_matmul_selfatt_qk", num_inputs=1)
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """Input (seq, batch, 3*embed) interleaved per head; output
+    (batch*heads, seq, seq) scaled QK^T."""
+    qkv = queries_keys_values
+    seq, bsz, three_embed = qkv.shape
+    embed = three_embed // 3
+    head_dim = embed // heads
+    x = qkv.reshape(seq, bsz, heads, 3, head_dim)
+    q = x[:, :, :, 0, :]  # (seq, bsz, heads, hd)
+    k = x[:, :, :, 1, :]
+    q = q.transpose(1, 2, 0, 3).reshape(bsz * heads, seq, head_dim)
+    k = k.transpose(1, 2, 0, 3).reshape(bsz * heads, seq, head_dim)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, q.dtype))
+    return jnp.matmul(q * scale, k.transpose(0, 2, 1))
+
+
+@register("interleaved_matmul_selfatt_valatt", num_inputs=2)
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
+    """attention (batch*heads, seq, seq) x V -> (seq, batch, embed)."""
+    qkv = queries_keys_values
+    seq, bsz, three_embed = qkv.shape
+    embed = three_embed // 3
+    head_dim = embed // heads
+    x = qkv.reshape(seq, bsz, heads, 3, head_dim)
+    v = x[:, :, :, 2, :].transpose(1, 2, 0, 3).reshape(bsz * heads, seq, head_dim)
+    out = jnp.matmul(attention, v)  # (b*h, seq, hd)
+    out = out.reshape(bsz, heads, seq, head_dim).transpose(2, 0, 1, 3)
+    return out.reshape(seq, bsz, embed)
+
+
+@register("interleaved_matmul_encdec_qk", num_inputs=2)
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    seq_q, bsz, embed = queries.shape
+    seq_kv = keys_values.shape[0]
+    head_dim = embed // heads
+    q = queries.reshape(seq_q, bsz, heads, head_dim).transpose(1, 2, 0, 3)
+    q = q.reshape(bsz * heads, seq_q, head_dim)
+    kv = keys_values.reshape(seq_kv, bsz, heads, 2, head_dim)
+    k = kv[:, :, :, 0, :].transpose(1, 2, 0, 3).reshape(bsz * heads, seq_kv, head_dim)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, q.dtype))
+    return jnp.matmul(q * scale, k.transpose(0, 2, 1))
+
+
+@register("interleaved_matmul_encdec_valatt", num_inputs=2)
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    seq_kv, bsz, two_embed = keys_values.shape
+    embed = two_embed // 2
+    head_dim = embed // heads
+    kv = keys_values.reshape(seq_kv, bsz, heads, 2, head_dim)
+    v = kv[:, :, :, 1, :].transpose(1, 2, 0, 3).reshape(bsz * heads, seq_kv, head_dim)
+    out = jnp.matmul(attention, v)
+    seq_q = attention.shape[1]
+    out = out.reshape(bsz, heads, seq_q, head_dim).transpose(2, 0, 1, 3)
+    return out.reshape(seq_q, bsz, embed)
+
+
+@register("div_sqrt_dim")
+def div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+@register("boolean_mask", num_inputs=2, differentiable=False)
+def boolean_mask(data, index, axis=0):
+    # dynamic shape op — returns compacted rows; on TPU callers should prefer
+    # masking. Implemented host-side semantics via nonzero with size hint.
+    idx = jnp.nonzero(index.astype(bool))[0]
+    return jnp.take(data, idx, axis=axis)
+
+
+@register("index_copy", num_inputs=3, differentiable=False)
+def index_copy(old_tensor, index_vector, new_tensor):
+    return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)
+
+
+@register("index_array", num_inputs=1, differentiable=False)
+def index_array(data, axes=None):
+    shape = data.shape
+    axes = tuple(axes) if axes else tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in axes], indexing="ij")
+    return jnp.stack(grids, axis=-1).astype(jnp.int64)
+
+
+@register("allclose", num_inputs=2, differentiable=False)
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True):
+    return jnp.asarray(
+        jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        dtype=jnp.float32,
+    )
+
+
+@register("arange_like", num_inputs=1, differentiable=False)
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = 1
+        for s in data.shape:
+            n *= s
+        out = start + step * jnp.arange(n, dtype=data.dtype)
+        return out.reshape(data.shape)
+    n = data.shape[axis]
+    return start + step * jnp.arange(n, dtype=data.dtype)
+
+
+@register("quadratic", num_inputs=1)
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """The reference's tutorial op (src/operator/contrib/quadratic_op.cc)."""
+    return a * jnp.square(data) + b * data + c
+
+
+# --- detection / vision contrib -------------------------------------------
+
+@register("BilinearResize2D")
+def bilinear_resize2d(data, height=1, width=1, scale_height=None,
+                      scale_width=None, mode="size", align_corners=True):
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height = int(round(h * scale_height))
+        width = int(round(w * scale_width))
+    return jax.image.resize(data, (n, c, height, width), method="bilinear")
+
+
+@register("AdaptiveAvgPooling2D")
+def adaptive_avg_pooling2d(data, output_size=None):
+    n, c, h, w = data.shape
+    if output_size is None:
+        oh = ow = 1
+    elif isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    # decompose into reduce_window when divisible, else resize-avg
+    if h % oh == 0 and w % ow == 0:
+        kh, kw = h // oh, w // ow
+        out = jax.lax.reduce_window(
+            data, 0.0, jax.lax.add, (1, 1, kh, kw), (1, 1, kh, kw), "VALID"
+        )
+        return out / (kh * kw)
+    return jax.image.resize(data, (n, c, oh, ow), method="linear")
+
+
+@register("ROIAlign", num_inputs=2)
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """ROIAlign (reference src/operator/contrib/roi_align.cc) via bilinear
+    gather — vectorized over rois."""
+    ph, pw = pooled_size
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        batch_idx = roi[0].astype(jnp.int32)
+        offset = 0.5 if aligned else 0.0
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        roi_w = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        roi_h = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        sr = sample_ratio if sample_ratio > 0 else 2
+        ys = y1 + bin_h * (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
+        xs = x1 + bin_w * (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
+        ys = ys.reshape(-1)  # (ph*sr,)
+        xs = xs.reshape(-1)  # (pw*sr,)
+        img = data[batch_idx]  # (c, h, w)
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy1 = ys - y0
+        wx1 = xs - x0
+        y0 = y0.astype(jnp.int32); x0 = x0.astype(jnp.int32)
+        y1i = y1i.astype(jnp.int32); x1i = x1i.astype(jnp.int32)
+        v00 = img[:, y0][:, :, x0]
+        v01 = img[:, y0][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0]
+        v11 = img[:, y1i][:, :, x1i]
+        val = (
+            v00 * ((1 - wy1)[:, None] * (1 - wx1)[None, :])
+            + v01 * ((1 - wy1)[:, None] * wx1[None, :])
+            + v10 * (wy1[:, None] * (1 - wx1)[None, :])
+            + v11 * (wy1[:, None] * wx1[None, :])
+        )  # (c, ph*sr, pw*sr)
+        val = val.reshape(c, ph, sr, pw, sr).mean(axis=(2, 4))
+        return val
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("box_iou", num_inputs=2, differentiable=False)
+def box_iou(lhs, rhs, format="corner"):
+    def to_corner(b):
+        if format == "center":
+            cx, cy, w2, h2 = b[..., 0], b[..., 1], b[..., 2] / 2, b[..., 3] / 2
+            return jnp.stack([cx - w2, cy - h2, cx + w2, cy + h2], axis=-1)
+        return b
+
+    a = to_corner(lhs)[..., :, None, :]
+    b = to_corner(rhs)[..., None, :, :]
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    return inter / (area_a + area_b - inter + 1e-12)

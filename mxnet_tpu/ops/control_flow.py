@@ -1,0 +1,127 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: ``src/operator/control_flow.cc`` (higher-order ops running
+sub-Symbols through nested CachedOps).  TPU-native design: when executed
+eagerly on NDArrays these run as Python loops (exactly what the reference's
+imperative path did); inside a hybridized/jitted forward the same entry
+points lower onto ``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` so the
+loop compiles into the XLA program — the compiler-friendly form the survey
+calls for (SURVEY.md §2.2 control_flow row).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["foreach", "while_loop", "cond", "scan_lowered"]
+
+
+def _is_traced(x) -> bool:
+    import jax.core as jcore
+
+    return isinstance(x, jcore.Tracer)
+
+
+def foreach(body: Callable, data, init_states):
+    """``out, states = foreach(body, data, states)`` — body(step_data, states)
+    -> (out, new_states).  Reference src/operator/control_flow.cc _foreach."""
+    from ..ndarray.ndarray import NDArray
+
+    single_data = not isinstance(data, (list, tuple))
+    datas = [data] if single_data else list(data)
+    single_state = not isinstance(init_states, (list, tuple))
+    states = [init_states] if single_state else list(init_states)
+
+    if isinstance(datas[0], NDArray):
+        # eager python loop
+        outputs = []
+        for i in range(datas[0].shape[0]):
+            step = [d[i] for d in datas]
+            out, states = body(step[0] if single_data else step,
+                               states[0] if single_state else states)
+            if not isinstance(states, (list, tuple)):
+                states = [states]
+            else:
+                states = list(states)
+            outputs.append(out)
+        from .. import nd as _nd_mod  # lazy
+
+        if isinstance(outputs[0], (list, tuple)):
+            stacked = [
+                _stack_nd([o[k] for o in outputs]) for k in range(len(outputs[0]))
+            ]
+        else:
+            stacked = _stack_nd(outputs)
+        return stacked, (states[0] if single_state else states)
+
+    # traced jax path -> lax.scan
+    def scan_body(carry, xs):
+        out, new_states = body(xs[0] if single_data else list(xs),
+                               carry[0] if single_state else list(carry))
+        if not isinstance(new_states, (list, tuple)):
+            new_states = [new_states]
+        return tuple(new_states), out
+
+    carry, outs = jax.lax.scan(scan_body, tuple(states), tuple(datas))
+    return outs, (carry[0] if single_state else list(carry))
+
+
+def _stack_nd(arrs):
+    from ..ndarray.ndarray import invoke
+
+    return invoke("stack", list(arrs), {"axis": 0})
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations: int = None):
+    """Reference _while_loop.  Eager: python while.  Traced: lax.while_loop
+    (outputs-accumulation variant requires max_iterations, as the reference
+    does)."""
+    from ..ndarray.ndarray import NDArray
+
+    single = not isinstance(loop_vars, (list, tuple))
+    lvars = [loop_vars] if single else list(loop_vars)
+
+    if isinstance(lvars[0], NDArray):
+        outputs = []
+        steps = 0
+        while bool(cond_fn(*lvars)) and (
+            max_iterations is None or steps < max_iterations
+        ):
+            out, lvars = func(*lvars)
+            if not isinstance(lvars, (list, tuple)):
+                lvars = [lvars]
+            else:
+                lvars = list(lvars)
+            if out is not None:
+                outputs.append(out)
+            steps += 1
+        stacked = _stack_nd(outputs) if outputs else None
+        return stacked, (lvars[0] if single else lvars)
+
+    def body(c):
+        out, new = func(*c)
+        if not isinstance(new, (list, tuple)):
+            new = [new]
+        return tuple(new)
+
+    final = jax.lax.while_loop(lambda c: cond_fn(*c), body, tuple(lvars))
+    return None, (final[0] if single else list(final))
+
+
+def cond(pred, then_func: Callable, else_func: Callable, inputs=()):
+    """Reference _cond."""
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(pred, NDArray) or isinstance(pred, (bool, int)):
+        take_then = bool(pred) if not isinstance(pred, NDArray) else bool(pred.asscalar())
+        return then_func(*inputs) if take_then else else_func(*inputs)
+    return jax.lax.cond(pred, lambda args: then_func(*args),
+                        lambda args: else_func(*args), tuple(inputs))
+
+
+def scan_lowered(body, init_carry, xs, length=None):
+    """Direct lax.scan exposure for traced code (RNN layers use this)."""
+    return jax.lax.scan(body, init_carry, xs, length=length)

@@ -1,0 +1,131 @@
+"""Elementwise operators.
+
+Reference analog: the mshadow_op functor zoo + elemwise_binary/unary op
+families (``src/operator/tensor/elemwise_*`` and ``src/operator/mshadow_op.h``).
+On TPU these are single jnp calls; XLA fuses chains of them into one kernel,
+which is what the reference's pointwise-fusion RTC pass
+(``src/operator/fusion/fused_op.cu``) hand-built for CUDA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+# --- binary broadcast ------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "equal": lambda a, b: jnp.equal(a, b).astype(a.dtype),
+    "not_equal": lambda a, b: jnp.not_equal(a, b).astype(a.dtype),
+    "greater": lambda a, b: jnp.greater(a, b).astype(a.dtype),
+    "greater_equal": lambda a, b: jnp.greater_equal(a, b).astype(a.dtype),
+    "lesser": lambda a, b: jnp.less(a, b).astype(a.dtype),
+    "lesser_equal": lambda a, b: jnp.less_equal(a, b).astype(a.dtype),
+    "logical_and": lambda a, b: jnp.logical_and(a, b).astype(a.dtype),
+    "logical_or": lambda a, b: jnp.logical_or(a, b).astype(a.dtype),
+    "logical_xor": lambda a, b: jnp.logical_xor(a, b).astype(a.dtype),
+}
+
+_NONDIFF_BINARY = {
+    "equal", "not_equal", "greater", "greater_equal", "lesser", "lesser_equal",
+    "logical_and", "logical_or", "logical_xor",
+}
+
+for _name, _f in _BINARY.items():
+    def _make(f):
+        def op(lhs, rhs):
+            return f(lhs, rhs)
+        return op
+
+    register(
+        f"broadcast_{_name}",
+        num_inputs=2,
+        differentiable=_name not in _NONDIFF_BINARY,
+        aliases=[f"elemwise_{_name}"] if _name in ("add", "sub", "mul", "div") else [],
+    )(_make(_f))
+
+    def _make_scalar(f):
+        def op(data, scalar=0.0, reverse=False):
+            s = jnp.asarray(scalar, dtype=data.dtype)
+            return f(s, data) if reverse else f(data, s)
+        return op
+
+    register(
+        f"{_name}_scalar",
+        num_inputs=1,
+        differentiable=_name not in _NONDIFF_BINARY,
+    )(_make_scalar(_f))
+
+
+# --- unary -----------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "negative": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+    "isnan": lambda x: jnp.isnan(x),
+    "isinf": lambda x: jnp.isinf(x),
+    "isfinite": lambda x: jnp.isfinite(x),
+}
+
+_NONDIFF_UNARY = {"sign", "rint", "ceil", "floor", "trunc", "fix",
+                  "logical_not", "isnan", "isinf", "isfinite"}
+
+for _name, _f in _UNARY.items():
+    def _mk(f):
+        def op(data):
+            return f(data)
+        return op
+
+    register(_name, num_inputs=1, differentiable=_name not in _NONDIFF_UNARY)(_mk(_f))
+
+
+@register("clip")
+def clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)

@@ -1,0 +1,39 @@
+"""Creation operators (reference ``src/operator/tensor/init_op.cc``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("zeros", num_inputs=0, differentiable=False)
+def zeros(shape=None, dtype="float32"):
+    return jnp.zeros(shape, dtype=jnp.dtype(dtype) if isinstance(dtype, str) else dtype)
+
+
+@register("ones", num_inputs=0, differentiable=False)
+def ones(shape=None, dtype="float32"):
+    return jnp.ones(shape, dtype=jnp.dtype(dtype) if isinstance(dtype, str) else dtype)
+
+
+@register("full", num_inputs=0, differentiable=False)
+def full(shape=None, value=0.0, dtype="float32"):
+    return jnp.full(shape, value, dtype=jnp.dtype(dtype) if isinstance(dtype, str) else dtype)
+
+
+@register("arange", num_inputs=0, differentiable=False)
+def arange(start=0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("linspace", num_inputs=0, differentiable=False)
+def linspace(start=0, stop=1, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint, dtype=jnp.dtype(dtype))
+
+
+@register("eye", num_inputs=0, differentiable=False)
+def eye(N=0, M=0, k=0, dtype="float32"):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=jnp.dtype(dtype))

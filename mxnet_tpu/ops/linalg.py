@@ -1,0 +1,169 @@
+"""Linear-algebra operators (reference ``src/operator/tensor/la_op.cc`` +
+``src/operator/numpy/linalg/``).  XLA provides native lowerings for all of
+these (cholesky/qr/svd/triangular_solve run on-device)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("linalg_gemm", num_inputs=3)
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2", num_inputs=2)
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri")
+def linalg_potri(A):
+    # inverse from cholesky factor
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_l = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l)
+
+
+@register("linalg_trsm", num_inputs=2)
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    if rightside:
+        out = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(A, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+            lower=not lower, trans=1 if transpose else 0)
+        return jnp.swapaxes(out, -1, -2)
+    return jax.scipy.linalg.solve_triangular(
+        A, alpha * B, lower=lower, trans=1 if transpose else 0)
+
+
+@register("linalg_trmm", num_inputs=2)
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(A, offset=0):
+    n = A.shape[-1] + abs(offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    if offset >= 0:
+        return out.at[..., idx, idx + offset].set(A)
+    return out.at[..., idx - offset, idx].set(A)
+
+
+@register("linalg_inverse", aliases=["inverse"])
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_det", aliases=["det"])
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", num_outputs=-1, aliases=["slogdet"])
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return (sign, logdet)
+
+
+@register("linalg_svd", num_outputs=-1, aliases=["gesvd"])
+def linalg_svd(A):
+    u, s, vh = jnp.linalg.svd(A, full_matrices=False)
+    return (u, s, vh)
+
+
+@register("linalg_gelqf", num_outputs=-1)
+def linalg_gelqf(A):
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return (jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2))
+
+
+@register("linalg_maketrian")
+def linalg_maketrian(A, offset=0, lower=True):
+    # pack vector into triangular matrix — approximate with square reshape
+    raise NotImplementedError("linalg_maketrian not yet implemented")
+
+
+@register("linalg_solve", num_inputs=2, aliases=["solve"])
+def linalg_solve(A, B):
+    return jnp.linalg.solve(A, B)
+
+
+@register("linalg_tensorinv", aliases=["tensorinv"])
+def linalg_tensorinv(A, ind=2):
+    return jnp.linalg.tensorinv(A, ind=ind)
+
+
+@register("linalg_cholesky", aliases=["cholesky"])
+def linalg_cholesky(A, lower=True):
+    L = jnp.linalg.cholesky(A)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+@register("linalg_qr", num_outputs=-1, aliases=["qr"])
+def linalg_qr(A):
+    q, r = jnp.linalg.qr(A)
+    return (q, r)
+
+
+@register("linalg_eigh", num_outputs=-1, aliases=["eigh"])
+def linalg_eigh(A, UPLO="L"):
+    w, v = jnp.linalg.eigh(A, symmetrize_input=True)
+    return (w, v)
+
+
+@register("linalg_eigvalsh", aliases=["eigvalsh"])
+def linalg_eigvalsh(A, UPLO="L"):
+    return jnp.linalg.eigvalsh(A)
+
+
+@register("linalg_norm_np", aliases=["np_norm"])
+def linalg_norm_np(x, ord=None, axis=None, keepdims=False):
+    return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
+
+
+@register("linalg_matrix_rank", aliases=["matrix_rank"], differentiable=False)
+def linalg_matrix_rank(M, tol=None):
+    return jnp.linalg.matrix_rank(M, tol)
+
+
+@register("linalg_pinv", aliases=["pinv"])
+def linalg_pinv(a, rcond=1e-15):
+    return jnp.linalg.pinv(a, rcond)
+
+
+@register("linalg_lstsq", num_inputs=2, num_outputs=-1, aliases=["lstsq"])
+def linalg_lstsq(a, b, rcond="warn"):
+    rc = None if rcond == "warn" else rcond
+    x, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rc)
+    return (x, res, rank, sv)

@@ -1,0 +1,222 @@
+"""Optimizer update operators.
+
+Reference: ``src/operator/optimizer_op.cc`` — updates run as device-side ops
+(sgd_update:*, adam_update:649, lamb_phase1/2:917, multi_sgd:313).  Same
+design here: each update is one fused XLA computation; multi-tensor variants
+take flat lists so XLA emits a single program over all params.
+
+These ops are *mutating* at the NDArray layer (weight is rewritten); the
+registry fns stay pure — the python optimizer wrapper writes results back.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _apply_wd(grad, weight, wd, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update", num_inputs=2, num_outputs=1, differentiable=False)
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=False):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", num_inputs=3, num_outputs=-1, differentiable=False)
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * g
+    return (weight + new_mom, new_mom)
+
+
+@register("nag_mom_update", num_inputs=3, num_outputs=-1, differentiable=False)
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom + g
+    return (weight - lr * (g + momentum * new_mom), new_mom)
+
+
+@register("adam_update", num_inputs=4, num_outputs=-1, differentiable=False)
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=False):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    out = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return (out, new_mean, new_var)
+
+
+@register("adamw_update", num_inputs=-1, num_outputs=-1, differentiable=False)
+def adamw_update(arrays, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 wd=0.0, eta=1.0, rescale_grad=1.0, clip_gradient=-1.0):
+    weight, grad, mean, var = arrays[:4]
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    out = weight - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon) + wd * weight)
+    return (out, new_mean, new_var)
+
+
+@register("rmsprop_update", num_inputs=3, num_outputs=-1, differentiable=False)
+def rmsprop_update(weight, grad, n, lr=0.001, rho=0.9, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_n = rho * n + (1 - rho) * jnp.square(g)
+    out = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        out = jnp.clip(out, -clip_weights, clip_weights)
+    return (out, new_n)
+
+
+@register("rmspropalex_update", num_inputs=-1, num_outputs=-1, differentiable=False)
+def rmspropalex_update(arrays, lr=0.001, rho=0.95, momentum=0.9, epsilon=1e-8,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       clip_weights=-1.0):
+    weight, grad, n, g_acc, delta = arrays
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_n = rho * n + (1 - rho) * jnp.square(g)
+    new_g = rho * g_acc + (1 - rho) * g
+    new_delta = momentum * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    out = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        out = jnp.clip(out, -clip_weights, clip_weights)
+    return (out, new_n, new_g, new_delta)
+
+
+@register("ftrl_update", num_inputs=-1, num_outputs=-1, differentiable=False)
+def ftrl_update(arrays, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    weight, grad, z, n = arrays
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    out = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd),
+    )
+    return (out, new_z, new_n)
+
+
+@register("signsgd_update", num_inputs=2, differentiable=False)
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", num_inputs=3, num_outputs=-1, differentiable=False)
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * g
+    out = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom) - lr * wd * weight
+    return (out, new_mom)
+
+
+@register("adagrad_update", num_inputs=3, num_outputs=-1, differentiable=False,
+          aliases=["_sparse_adagrad_update"])
+def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_hist = history + jnp.square(g)
+    return (weight - lr * g / (jnp.sqrt(new_hist) + epsilon), new_hist)
+
+
+@register("adadelta_update", num_inputs=-1, num_outputs=-1, differentiable=False)
+def adadelta_update(arrays, rho=0.9, epsilon=1e-5, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    weight, grad, acc_g, acc_delta = arrays
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return (weight - delta, new_acc_g, new_acc_delta)
+
+
+# --- LAMB (reference optimizer_op.cc lamb_phase1/2 + contrib multi_lamb) ---
+
+@register("lamb_update_phase1", num_inputs=4, num_outputs=-1, differentiable=False)
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m = new_mean
+    v = new_var
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    update = m / (jnp.sqrt(v) + epsilon) + wd * weight
+    return (update, new_mean, new_var)
+
+
+@register("lamb_update_phase2", num_inputs=-1, differentiable=False)
+def lamb_update_phase2(arrays, lr=0.01, lower_bound=-1.0, upper_bound=-1.0):
+    weight, g_update, r1, r2 = arrays
+    r1 = jnp.where(r1 > 0, r1, jnp.ones_like(r1))
+    r2 = jnp.where(r2 > 0, r2, jnp.ones_like(r2))
+    ratio = r1 / r2
+    if lower_bound is not None and lower_bound > 0:
+        ratio = jnp.maximum(ratio, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        ratio = jnp.minimum(ratio, upper_bound)
+    return weight - lr * ratio * g_update
+
+
+# --- multi-tensor fused updates (reference contrib multi_* / preloaded_*) --
+
+@register("multi_sgd_update", num_inputs=-1, num_outputs=-1, differentiable=False)
+def multi_sgd_update(arrays, lrs=(), wds=(), rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=0):
+    n = num_weights or len(arrays) // 2
+    weights, grads = arrays[:n], arrays[n:2 * n]
+    outs = []
+    for w, g, lr, wd in zip(weights, grads, lrs, wds):
+        gg = _apply_wd(g, w, wd, rescale_grad, clip_gradient)
+        outs.append(w - lr * gg)
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", num_inputs=-1, num_outputs=-1, differentiable=False)
+def multi_sgd_mom_update(arrays, lrs=(), wds=(), momentum=0.0, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=0):
+    n = num_weights or len(arrays) // 3
+    weights, grads, moms = arrays[:n], arrays[n:2 * n], arrays[2 * n:3 * n]
+    outs = []
+    for w, g, m, lr, wd in zip(weights, grads, moms, lrs, wds):
+        gg = _apply_wd(g, w, wd, rescale_grad, clip_gradient)
+        nm = momentum * m - lr * gg
+        outs.append((w + nm, nm))
+    ws = tuple(o[0] for o in outs)
+    ms = tuple(o[1] for o in outs)
+    return ws + ms
+
+
+@register("multi_sum_sq", num_inputs=-1, num_outputs=1, differentiable=False)
+def multi_sum_sq(arrays, num_arrays=0):
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32))) for a in arrays])

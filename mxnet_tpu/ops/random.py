@@ -1,0 +1,102 @@
+"""Sampling operators (reference ``src/operator/random/sample_op.cc``).
+
+Each op draws a fresh subkey from the global threefry chain at call time;
+under jit-tracing the key is captured as a constant, so Gluon layers that
+need per-step randomness (Dropout) thread keys as explicit inputs instead.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _rng
+from .registry import register
+
+
+def _dt(dtype):
+    if dtype in (None, "None"):
+        return jnp.float32
+    return jnp.dtype(dtype) if isinstance(dtype, str) else dtype
+
+
+@register("uniform", num_inputs=0, differentiable=False,
+          aliases=["random_uniform", "_sample_uniform"])
+def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return jax.random.uniform(key, shape, _dt(dtype), minval=low, maxval=high)
+
+
+@register("normal", num_inputs=0, differentiable=False,
+          aliases=["random_normal", "_sample_normal"])
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return loc + scale * jax.random.normal(key, shape, _dt(dtype))
+
+
+@register("random_gamma", num_inputs=0, differentiable=False,
+          aliases=["_sample_gamma"])
+def random_gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return jax.random.gamma(key, alpha, shape, _dt(dtype)) * beta
+
+
+@register("exponential", num_inputs=0, differentiable=False,
+          aliases=["random_exponential"])
+def exponential(lam=1.0, shape=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return jax.random.exponential(key, shape, _dt(dtype)) / lam
+
+
+@register("poisson", num_inputs=0, differentiable=False, aliases=["random_poisson"])
+def poisson(lam=1.0, shape=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return jax.random.poisson(key, lam, shape).astype(_dt(dtype))
+
+
+@register("negative_binomial", num_inputs=0, differentiable=False,
+          aliases=["random_negative_binomial"])
+def negative_binomial(k=1, p=1.0, shape=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
+
+
+@register("randint", num_inputs=0, differentiable=False, aliases=["random_randint"])
+def randint(low=0, high=1, shape=(1,), dtype="int32", key=None):
+    key = key if key is not None else _rng.next_key()
+    return jax.random.randint(key, shape, low, high, _dt(dtype))
+
+
+@register("randn", num_inputs=0, differentiable=False)
+def randn(shape=(1,), loc=0.0, scale=1.0, dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return loc + scale * jax.random.normal(key, shape, _dt(dtype))
+
+
+@register("multinomial", num_inputs=1, differentiable=False,
+          aliases=["sample_multinomial"])
+def multinomial(data, shape=1, get_prob=False, dtype="int32", key=None):
+    key = key if key is not None else _rng.next_key()
+    n = shape if isinstance(shape, int) else int(jnp.prod(jnp.asarray(shape)))
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+    else:
+        out = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                     shape=(data.shape[0], n))
+        if n == 1 and (isinstance(shape, int) and shape == 1):
+            out = out[:, 0]
+    return out.astype(_dt(dtype))
+
+
+@register("shuffle", num_inputs=1, differentiable=False, aliases=["_shuffle"])
+def shuffle(data, key=None):
+    key = key if key is not None else _rng.next_key()
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("bernoulli", num_inputs=0, differentiable=False)
+def bernoulli(prob=0.5, shape=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return jax.random.bernoulli(key, prob, shape).astype(_dt(dtype))

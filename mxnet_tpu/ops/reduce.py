@@ -1,0 +1,115 @@
+"""Reduction operators (reference ``src/operator/tensor/broadcast_reduce_op*``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        return axis
+    return tuple(axis)
+
+
+@register("sum", aliases=["sum_axis"])
+def sum_op(data, axis=None, keepdims=False, exclude=False):
+    axis = _exclude(_norm_axis(axis), data.ndim, exclude)
+    return jnp.sum(data, axis=axis, keepdims=keepdims)
+
+
+def _exclude(axis, ndim, exclude):
+    if not exclude or axis is None:
+        return axis
+    ax = (axis,) if isinstance(axis, int) else tuple(axis)
+    return tuple(i for i in range(ndim) if i not in ax)
+
+
+@register("mean")
+def mean(data, axis=None, keepdims=False, exclude=False):
+    axis = _exclude(_norm_axis(axis), data.ndim, exclude)
+    return jnp.mean(data, axis=axis, keepdims=keepdims)
+
+
+@register("prod")
+def prod(data, axis=None, keepdims=False, exclude=False):
+    axis = _exclude(_norm_axis(axis), data.ndim, exclude)
+    return jnp.prod(data, axis=axis, keepdims=keepdims)
+
+
+@register("nansum")
+def nansum(data, axis=None, keepdims=False, exclude=False):
+    return jnp.nansum(data, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register("nanprod")
+def nanprod(data, axis=None, keepdims=False, exclude=False):
+    return jnp.nanprod(data, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register("max", aliases=["max_axis"])
+def max_op(data, axis=None, keepdims=False, exclude=False):
+    axis = _exclude(_norm_axis(axis), data.ndim, exclude)
+    return jnp.max(data, axis=axis, keepdims=keepdims)
+
+
+@register("min", aliases=["min_axis"])
+def min_op(data, axis=None, keepdims=False, exclude=False):
+    axis = _exclude(_norm_axis(axis), data.ndim, exclude)
+    return jnp.min(data, axis=axis, keepdims=keepdims)
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    axis = _norm_axis(axis)
+    if ord == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims))
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keepdims)
+    raise ValueError("norm only supports ord=1 or 2 (reference parity)")
+
+
+@register("argmax", differentiable=False)
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", differentiable=False)
+def argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("cumsum")
+def cumsum(a, axis=None, dtype=None):
+    out = jnp.cumsum(a, axis=axis)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("cumprod")
+def cumprod(a, axis=None, dtype=None):
+    out = jnp.cumprod(a, axis=axis)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise ValueError(mode)
+    denom = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / denom

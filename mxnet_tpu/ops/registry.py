@@ -1,0 +1,102 @@
+"""The operator registry.
+
+Each operator is registered once, by name, with:
+
+- ``fn``: a *pure JAX function* ``fn(*arrays, **attrs) -> array | tuple``.
+  Array arguments are jax.Arrays; attrs are static python values.  Because
+  ops are pure jax, the same registry serves the imperative path (eager
+  dispatch, XLA-compiled per shape/dtype by jax's op-by-op cache), the
+  hybridized path (whole-graph ``jax.jit``), and the symbolic path
+  (Symbol graphs re-execute the same fns under tracing).
+- ``num_inputs``: number of leading array args (-1 = variadic; the variadic
+  arrays are passed as a single list argument).
+- ``differentiable``: whether to build a VJP node on the autograd tape.
+
+Reference analog: ``NNVM_REGISTER_OP`` attrs FCompute/FGradient/FInferShape
+(``include/mxnet/op_attr_types.h:125-332``).  Shape/dtype inference comes for
+free from jax's abstract evaluation (``jax.eval_shape``) instead of
+hand-written FInferShape passes (``src/imperative/infer_graph_attr_pass.cc``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = ["OpSchema", "register", "get_op", "find_op", "list_ops", "alias"]
+
+
+@dataclass
+class OpSchema:
+    name: str
+    fn: Callable
+    num_inputs: int = 1  # -1 => variadic (first arg is a list of arrays)
+    num_outputs: int = 1  # -1 => variable, fn returns tuple
+    differentiable: bool = True
+    aliases: List[str] = field(default_factory=list)
+    # namespaces this op is exported to ('nd', 'np', 'npx', 'internal')
+    namespaces: List[str] = field(default_factory=lambda: ["nd"])
+    doc: Optional[str] = None
+
+    def __post_init__(self):
+        if self.doc is None:
+            self.doc = self.fn.__doc__
+
+
+_OPS: Dict[str, OpSchema] = {}
+
+
+def register(
+    name: str,
+    num_inputs: int = 1,
+    num_outputs: int = 1,
+    differentiable: bool = True,
+    aliases: Sequence[str] = (),
+    namespaces: Sequence[str] = ("nd",),
+):
+    """Decorator: register a pure-JAX function as an operator."""
+
+    def deco(fn: Callable) -> Callable:
+        schema = OpSchema(
+            name=name,
+            fn=fn,
+            num_inputs=num_inputs,
+            num_outputs=num_outputs,
+            differentiable=differentiable,
+            aliases=list(aliases),
+            namespaces=list(namespaces),
+        )
+        if name in _OPS:
+            raise ValueError(f"operator '{name}' registered twice")
+        _OPS[name] = schema
+        for a in schema.aliases:
+            if a in _OPS:
+                raise ValueError(f"operator alias '{a}' registered twice")
+            _OPS[a] = schema
+        return fn
+
+    return deco
+
+
+def alias(existing: str, *names: str):
+    schema = get_op(existing)
+    for n in names:
+        if n in _OPS:
+            raise ValueError(f"operator alias '{n}' registered twice")
+        _OPS[n] = schema
+        schema.aliases.append(n)
+
+
+def get_op(name: str) -> OpSchema:
+    if name not in _OPS:
+        raise KeyError(f"operator '{name}' not registered")
+    return _OPS[name]
+
+
+def find_op(name: str) -> Optional[OpSchema]:
+    return _OPS.get(name)
+
+
+def list_ops(namespace: Optional[str] = None) -> List[str]:
+    if namespace is None:
+        return sorted(set(s.name for s in _OPS.values()))
+    return sorted(set(s.name for s in _OPS.values() if namespace in s.namespaces))

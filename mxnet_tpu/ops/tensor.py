@@ -1,0 +1,390 @@
+"""Tensor manipulation operators.
+
+Reference analog: ``src/operator/tensor/matrix_op.cc`` (reshape/transpose/
+slice/concat/take/...), ``indexing_op.cc``, ``cast_storage`` etc.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register
+
+
+@register("reshape", aliases=["Reshape"])
+def reshape(data, shape=None, reverse=False):
+    # Support MXNet's special codes 0 (copy dim) and -1 (infer)
+    shape = tuple(shape)
+    if 0 in shape or -2 in shape or -3 in shape or -4 in shape:
+        shape = _expand_reshape_codes(tuple(data.shape), shape)
+    return jnp.reshape(data, shape)
+
+
+def _expand_reshape_codes(src, shape):
+    """Implements MXNet reshape special codes 0/-1/-2/-3/-4
+    (reference matrix_op.cc InferReshapeShape)."""
+    out = []
+    i = 0  # index into src
+    j = 0
+    shape = list(shape)
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = shape[j + 1], shape[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(s); i += 1
+        j += 1
+    return tuple(out)
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    return jnp.transpose(data, axes)
+
+
+@register("swapaxes", aliases=["SwapAxis"])
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("flatten", aliases=["Flatten"])
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis)
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=None):
+    shape = tuple(
+        s if s != 0 else d for s, d in zip(shape, data.shape)
+    )
+    return jnp.broadcast_to(data, shape)
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"])
+def broadcast_axis(data, axis=None, size=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(axes, sizes):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("tile")
+def tile(data, reps=None):
+    return jnp.tile(data, reps)
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis)
+
+
+@register("pad", aliases=["Pad"])
+def pad(data, mode="constant", pad_width=None, constant_value=0.0):
+    pw = list(pad_width)
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pairs, mode=jmode, constant_values=constant_value)
+    return jnp.pad(data, pairs, mode=jmode)
+
+
+@register("concat", num_inputs=-1, aliases=["Concat"])
+def concat(arrays, dim=1):
+    return jnp.concatenate(arrays, axis=dim)
+
+
+@register("stack", num_inputs=-1)
+def stack(arrays, axis=0):
+    return jnp.stack(arrays, axis=axis)
+
+
+@register("split", num_outputs=-1, aliases=["SliceChannel"])
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice", aliases=["crop"])
+def slice_op(data, begin=None, end=None, step=None):
+    ndim = data.ndim
+    begin = list(begin) + [None] * (ndim - len(begin))
+    end = list(end) + [None] * (ndim - len(end))
+    step = list(step or []) + [None] * (ndim - len(step or []))
+    idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like", num_inputs=2)
+def slice_like(data, shape_like, axes=None):
+    tgt = shape_like.shape
+    idx = [slice(None)] * data.ndim
+    axes = axes if axes else range(data.ndim)
+    for a in axes:
+        idx[a] = slice(0, tgt[a])
+    return data[tuple(idx)]
+
+
+@register("take", num_inputs=2)
+def take(a, indices, axis=0, mode="clip"):
+    jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
+
+
+@register("pick", num_inputs=2)
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    index = index.astype(jnp.int32)
+    out = jnp.take_along_axis(data, jnp.expand_dims(index, axis=axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd", num_inputs=2)
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd", num_inputs=2, differentiable=True)
+def scatter_nd(data, indices, shape=None):
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[idx].add(data)
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    eye = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return eye * on_value + (1.0 - eye) * off_value
+
+
+@register("cast", aliases=["Cast"])
+def cast(data, dtype=None):
+    return data.astype(jnp.dtype(dtype) if not isinstance(dtype, type) else dtype)
+
+
+@register("_copy", aliases=["identity", "stop_gradient_copy"])
+def _copy(data):
+    return jnp.asarray(data)
+
+
+@register("BlockGrad", aliases=["stop_gradient"], differentiable=False)
+def block_grad(data):
+    return jax.lax.stop_gradient(data)
+
+
+@register("where", num_inputs=3)
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("_index", differentiable=True)
+def _index(data, key=None):
+    return data[key]
+
+
+@register("reverse", aliases=["flip"])
+def reverse(data, axis=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=axes)
+
+
+@register("roll")
+def roll(data, shift=None, axis=None):
+    return jnp.roll(data, shift, axis)
+
+
+@register("diag")
+def diag(data, k=0):
+    return jnp.diag(data, k) if data.ndim <= 2 else jnp.diagonal(data, k)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("sequence_mask", num_inputs=2, aliases=["SequenceMask"])
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    # data: (seq, batch, ...) when axis=0, (batch, seq, ...) when axis=1
+    seq_len = data.shape[axis]
+    steps = jnp.arange(seq_len)
+    if axis == 0:
+        mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)
+    else:
+        mask = steps[None, :] < sequence_length[:, None].astype(jnp.int32)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("sequence_last", num_inputs=2, aliases=["SequenceLast"])
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = -1 if axis == 0 else -1
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    last = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, last.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+        ).squeeze(0)
+    return jnp.take_along_axis(
+        data, last.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1
+    ).squeeze(1)
+
+
+@register("sequence_reverse", num_inputs=2, aliases=["SequenceReverse"])
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    seq_len = data.shape[0]
+    steps = jnp.arange(seq_len)
+    lens = sequence_length.astype(jnp.int32)
+    rev_idx = jnp.where(
+        steps[:, None] < lens[None, :], lens[None, :] - 1 - steps[:, None], steps[:, None]
+    )
+    return jnp.take_along_axis(
+        data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)), axis=0
+    )
+
+
+@register("shape_array", differentiable=False)
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register("size_array", differentiable=False)
+def size_array(data):
+    return jnp.asarray([int(onp.prod(data.shape))], dtype=jnp.int64)
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("add_n", num_inputs=-1, aliases=["ElementWiseSum"])
+def add_n(arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+@register("dot", num_inputs=2)
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a and lhs.ndim == 2 else lhs
+    b = rhs.T if transpose_b and rhs.ndim == 2 else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot", num_inputs=2)
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("embedding", num_inputs=2, aliases=["Embedding"])
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("topk", differentiable=False, num_outputs=-1)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    neg = data if not is_ascend else -data
+    vals, idx = jax.lax.top_k(jnp.moveaxis(neg, axis, -1), k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if is_ascend:
+        vals = -vals
+    if ret_typ == "indices":
+        return idx.astype(jnp.dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idx.astype(jnp.dtype(dtype)))
+    if ret_typ == "mask":
+        mask = jnp.zeros(jnp.moveaxis(data, axis, -1).shape, dtype=data.dtype)
+        idx_last = jnp.moveaxis(idx, axis, -1)
+        mask = jnp.put_along_axis(mask, idx_last, 1.0, axis=-1, inplace=False)
+        return jnp.moveaxis(mask, -1, axis)
+    raise ValueError(f"unknown ret_typ {ret_typ}")
+
+
+@register("sort", differentiable=False)
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    idx = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.dtype(dtype))
+
+
+@register("unique", differentiable=False, num_outputs=-1)
+def unique(data):
+    return jnp.unique(data, size=None)

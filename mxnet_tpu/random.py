@@ -1,0 +1,37 @@
+"""Global PRNG state.
+
+Reference analog: per-device mshadow/curand generators seeded by
+``mx.random.seed`` (``src/common/random_generator.*``, ``MXRandomSeed``).
+TPU-native design: a threefry key chain (counter-based, reproducible across
+replicas/shards — what the survey recommends for TPU).  Eager random ops
+split a fresh subkey per call; traced code (Dropout in a hybridized block)
+receives keys as explicit inputs so graphs stay pure.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_key"]
+
+_lock = threading.Lock()
+_KEY = jax.random.PRNGKey(0)
+
+
+def seed(seed_state: int, ctx=None):
+    """Seed the global generator (reference python/mxnet/random.py:30)."""
+    global _KEY
+    with _lock:
+        _KEY = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    global _KEY
+    with _lock:
+        _KEY, sub = jax.random.split(_KEY)
+        return sub
+
+
+def current_key():
+    return _KEY

@@ -1,0 +1,50 @@
+"""Test harness.
+
+Mirrors the reference's test strategy (SURVEY.md §4):
+- tests run on a *virtual 8-device CPU mesh* so multi-chip sharding logic is
+  exercised without TPU hardware (the reference's analog: parametrizing real
+  cpu/gpu contexts, multi-process local launcher);
+- seed discipline: each test gets a deterministic seed derived from its name,
+  printed on failure so flakes are reproducible (reference conftest.py +
+  tests/python/unittest/common.py with_seed).
+"""
+import os
+import sys
+
+# Must be set before jax import: virtual 8-device CPU mesh.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import hashlib
+
+import numpy as onp
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def seed_everything(request):
+    """Deterministic per-test seeding, reported for reproducibility."""
+    name = request.node.nodeid
+    seed = int(hashlib.sha1(name.encode()).hexdigest()[:8], 16)
+    override = os.environ.get("MXNET_TEST_SEED")
+    if override:
+        seed = int(override)
+    onp.random.seed(seed)
+    import mxnet_tpu as mx
+
+    mx.random.seed(seed)
+    yield
+    # On failure pytest prints captured stdout; make the seed discoverable.
+
+
+def pytest_runtest_makereport(item, call):
+    if call.when == "call" and call.excinfo is not None:
+        name = item.nodeid
+        seed = int(hashlib.sha1(name.encode()).hexdigest()[:8], 16)
+        print(f"\n*** test failed with MXNET_TEST_SEED={seed} "
+              f"(set env var to reproduce) ***")

@@ -1,0 +1,186 @@
+"""Autograd tape tests (reference tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * x
+        z = y.sum()
+    z.backward()
+    expected = onp.exp(x.asnumpy()) * (1 + x.asnumpy())
+    assert onp.allclose(x.grad.asnumpy(), expected, rtol=1e-5)
+
+
+def test_multiple_inputs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    assert onp.allclose(a.grad.asnumpy(), b.asnumpy())
+    assert onp.allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3.0
+    y.backward(nd.array([10.0, 100.0]))
+    assert onp.allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2.0).sum()
+        y.backward()
+    assert onp.allclose(x.grad.asnumpy(), [6.0, 6.0])
+
+
+def test_no_record_no_grad():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 2  # not recorded
+    y.backward()  # no-op: nothing reaches the leaf
+    assert onp.allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_pause():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            z = y * 10.0  # not recorded
+        w = y * 1.0
+    w.backward()
+    assert onp.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_is_recording_is_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_detach():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * 3
+        w = y * 1.0
+    w.backward()
+    assert onp.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0])
+    with autograd.record():
+        y = (x * x).sum()
+    g = autograd.grad(y, x)
+    assert onp.allclose(g.asnumpy(), 2 * x.asnumpy())
+
+
+def test_matrix_backward():
+    A = nd.random.uniform(shape=(3, 4))
+    B = nd.random.uniform(shape=(4, 5))
+    A.attach_grad()
+    B.attach_grad()
+    with autograd.record():
+        C = nd.dot(A, B).sum()
+    C.backward()
+    onesC = onp.ones((3, 5), "float32")
+    assert onp.allclose(A.grad.asnumpy(), onesC @ B.asnumpy().T, rtol=1e-5)
+    assert onp.allclose(B.grad.asnumpy(), A.asnumpy().T @ onesC, rtol=1e-5)
+
+
+def test_branching_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2
+        b = x * 3
+        y = (a * b).sum()  # y = 6x^2, dy/dx = 12x
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(), [24.0])
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return dy * 2 * x
+
+    x = nd.array([3.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_backward_through_reshape_slice():
+    x = nd.arange(0, 6).reshape((2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = x.reshape((3, 2))[0:2].sum()
+    y.backward()
+    expected = onp.array([[1, 1, 1], [1, 0, 0]], "float32")
+    assert onp.allclose(x.grad.asnumpy(), expected)
+
+
+def test_inplace_under_record():
+    # in-place on an intermediate keeps the tape correct
+    w = nd.array([1.0, 2.0])
+    w.attach_grad()
+    with autograd.record():
+        y = w * 2
+        y *= 3  # y = 6w
+        s = y.sum()
+    s.backward()
+    assert onp.allclose(w.grad.asnumpy(), [6.0, 6.0])
+    # in-place on a leaf while recording raises
+    v = nd.array([1.0])
+    v.attach_grad()
+    with autograd.record():
+        with pytest.raises(mx.MXNetError):
+            v += 1
+
+
+def test_grad_wrt_intermediate():
+    x = nd.array([2.0])
+    with autograd.record():
+        z = x * 2
+        y = z * 3
+    (gz,) = autograd.grad([y], [z])
+    assert onp.allclose(gz.asnumpy(), [3.0])

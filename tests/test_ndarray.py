@@ -1,0 +1,185 @@
+"""NDArray basics (reference tests/python/unittest/test_ndarray.py analog)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    x = nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == onp.float32
+    assert onp.array_equal(x.asnumpy(), onp.zeros((2, 3), "float32"))
+    y = nd.ones((4,), dtype="int32")
+    assert y.dtype == onp.int32
+    z = nd.full((2, 2), 7.0)
+    assert float(z[0, 0].asscalar()) == 7.0
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert onp.allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    assert onp.allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    assert onp.allclose((a * 2).asnumpy(), [[2, 4], [6, 8]])
+    assert onp.allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    assert onp.allclose((1.0 / a).asnumpy(), 1.0 / a.asnumpy())
+    assert onp.allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    assert onp.allclose((-a).asnumpy(), -a.asnumpy())
+    c = a.copy()
+    c += b
+    assert onp.allclose(c.asnumpy(), [[11, 22], [33, 44]])
+
+
+def test_broadcast():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    assert onp.allclose((a + b).asnumpy(), 2.0)
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert onp.array_equal((a > b).asnumpy(), [0, 0, 1])
+    assert onp.array_equal((a == b).asnumpy(), [0, 1, 0])
+    assert onp.array_equal((a <= 2.0).asnumpy(), [1, 1, 0])
+
+
+def test_reshape_transpose():
+    a = nd.arange(0, 24).reshape((2, 3, 4))
+    assert a.shape == (2, 3, 4)
+    assert a.T.shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    # MXNet special reshape codes
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.squeeze(axis=None).shape == (2, 3, 4)
+
+
+def test_indexing():
+    a = nd.arange(0, 12).reshape((3, 4))
+    assert a[1].shape == (4,)
+    assert float(a[1, 2].asscalar()) == 6.0
+    assert a[0:2].shape == (2, 4)
+    assert a[:, 1:3].shape == (3, 2)
+    a[0, 0] = 42.0
+    assert float(a[0, 0].asscalar()) == 42.0
+    a[:] = 0.0
+    assert onp.allclose(a.asnumpy(), 0.0)
+
+
+def test_reductions():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert float(a.sum().asscalar()) == 10.0
+    assert float(a.mean().asscalar()) == 2.5
+    assert float(a.max().asscalar()) == 4.0
+    assert float(a.min().asscalar()) == 1.0
+    assert onp.allclose(a.sum(axis=0).asnumpy(), [4, 6])
+    assert onp.allclose(a.sum(axis=1, keepdims=True).asnumpy(), [[3], [7]])
+    assert onp.allclose(nd.norm(a).asnumpy(), onp.linalg.norm(a.asnumpy()))
+    assert onp.array_equal(a.argmax(axis=1).asnumpy(), [1, 1])
+
+
+def test_dot():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert onp.allclose(nd.dot(a, b).asnumpy(), a.asnumpy() @ b.asnumpy())
+    v = nd.array([1.0, 2.0])
+    assert onp.allclose(nd.dot(a, v).asnumpy(), a.asnumpy() @ v.asnumpy())
+    # batch_dot
+    x = nd.random.uniform(shape=(4, 2, 3))
+    y = nd.random.uniform(shape=(4, 3, 5))
+    assert nd.batch_dot(x, y).shape == (4, 2, 5)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    c2 = nd.concat(a, b, dim=1)
+    assert c2.shape == (2, 6)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+
+
+def test_take_one_hot():
+    w = nd.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    idx = nd.array([0, 2], dtype="int32")
+    out = nd.take(w, idx)
+    assert onp.allclose(out.asnumpy(), [[1, 2], [5, 6]])
+    oh = nd.one_hot(idx, 3)
+    assert onp.allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_astype_cast():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == onp.int32
+    c = a.astype("float16")
+    assert c.dtype == onp.float16
+
+
+def test_copyto_context():
+    a = nd.array([1.0, 2.0])
+    b = nd.zeros((2,))
+    a.copyto(b)
+    assert onp.allclose(b.asnumpy(), [1, 2])
+    c = a.as_in_context(mx.cpu())
+    assert c.ctx.device_type == "cpu"
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "params.npz")
+    data = {"w": nd.array([1.0, 2.0]), "b": nd.array([3.0])}
+    nd.save(fname, data)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert onp.allclose(loaded["w"].asnumpy(), [1, 2])
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(fname, lst)
+    loaded2 = nd.load(fname)
+    assert isinstance(loaded2, list) and len(loaded2) == 2
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert a.asscalar() == onp.float32(3.5)
+    with pytest.raises(ValueError):
+        nd.ones((2,)).asscalar()
+
+
+def test_waitall_and_sync():
+    a = nd.random.uniform(shape=(100, 100))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert b.shape == (100, 100)
+
+
+def test_version_bumps_on_write():
+    a = nd.zeros((2,))
+    v0 = a.version
+    a[:] = 1.0
+    assert a.version == v0 + 1
+
+
+def test_where_clip_maximum():
+    a = nd.array([-1.0, 0.5, 2.0])
+    assert onp.allclose(a.clip(0.0, 1.0).asnumpy(), [0, 0.5, 1.0])
+    b = nd.maximum_scalar(a, scalar=0.0)
+    assert onp.allclose(b.asnumpy(), [0, 0.5, 2.0])
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.ones((3,))
+    y = nd.zeros((3,))
+    assert onp.allclose(nd.where(cond, x, y).asnumpy(), [1, 0, 1])

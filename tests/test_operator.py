@@ -1,0 +1,251 @@
+"""Operator correctness vs numpy oracle (reference test_operator.py model).
+
+Uses finite-difference gradient checking for a sample of differentiable ops
+(the reference's check_numeric_gradient, test_utils.py:1038).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def fd_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar-valued f at x (numpy)."""
+    g = onp.zeros_like(x)
+    it = onp.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+@pytest.mark.parametrize("opname,npf", [
+    ("exp", onp.exp),
+    ("log", lambda x: onp.log(onp.abs(x) + 1.0)),
+    ("tanh", onp.tanh),
+    ("sigmoid", lambda x: 1 / (1 + onp.exp(-x))),
+    ("sqrt", lambda x: onp.sqrt(onp.abs(x) + 1.0)),
+    ("square", onp.square),
+])
+def test_unary_grad(opname, npf):
+    x0 = onp.random.uniform(0.2, 1.5, (3, 4)).astype("float32")
+    x = nd.array(x0)
+    x.attach_grad()
+    opf = getattr(nd, opname)
+    if opname in ("log", "sqrt"):
+        fwd = lambda a: getattr(nd, opname)(nd.abs_scalar_like(a)) if False else None
+        # use positive input directly
+        with autograd.record():
+            y = opf(x).sum()
+        y.backward()
+        numeric = fd_grad(lambda z: getattr(onp, opname if opname != "sigmoid" else "tanh")(z).sum()
+                          if opname not in ("log", "sqrt") else getattr(onp, opname)(z).sum(), x0)
+    else:
+        with autograd.record():
+            y = opf(x).sum()
+        y.backward()
+        def scalar_f(z):
+            if opname == "sigmoid":
+                return (1 / (1 + onp.exp(-z))).sum()
+            return getattr(onp, opname)(z).sum()
+        numeric = fd_grad(scalar_f, x0)
+    if opname in ("log", "sqrt"):
+        numeric = fd_grad(lambda z: getattr(onp, opname)(z).sum(), x0)
+    assert onp.allclose(x.grad.asnumpy(), numeric, rtol=1e-2, atol=1e-2)
+
+
+def test_fully_connected():
+    x = nd.random.uniform(shape=(4, 10))
+    w = nd.random.uniform(shape=(3, 10))
+    b = nd.random.uniform(shape=(3,))
+    out = nd.FullyConnected(x, w, b, num_hidden=3)
+    expected = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+    assert onp.allclose(out.asnumpy(), expected, rtol=1e-5)
+
+
+def test_convolution_matches_reference_semantics():
+    # identity kernel conv: delta kernel returns input
+    x = nd.random.uniform(shape=(1, 1, 5, 5))
+    k = nd.zeros((1, 1, 3, 3))
+    k[0, 0, 1, 1] = 1.0
+    out = nd.Convolution(x, k, nd.zeros((1,)), kernel=(3, 3), num_filter=1,
+                         pad=(1, 1))
+    assert onp.allclose(out.asnumpy(), x.asnumpy(), atol=1e-6)
+
+
+def test_pooling():
+    x = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    mp = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert onp.allclose(mp.asnumpy().ravel(), [5, 7, 13, 15])
+    ap = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert onp.allclose(ap.asnumpy().ravel(), [2.5, 4.5, 10.5, 12.5])
+    gp = nd.Pooling(x, global_pool=True, pool_type="max")
+    assert float(gp.asscalar()) == 15.0
+
+
+def test_softmax_logsoftmax():
+    x = nd.array([[1.0, 2.0, 3.0]])
+    s = nd.softmax(x)
+    e = onp.exp([1.0, 2.0, 3.0]); e /= e.sum()
+    assert onp.allclose(s.asnumpy()[0], e, rtol=1e-5)
+    ls = nd.log_softmax(x)
+    assert onp.allclose(ls.asnumpy(), onp.log(e)[None], rtol=1e-5)
+
+
+def test_batchnorm_train_vs_infer():
+    x = nd.random.uniform(shape=(8, 4, 5, 5))
+    gamma = nd.ones((4,))
+    beta = nd.zeros((4,))
+    rm = nd.zeros((4,))
+    rv = nd.ones((4,))
+    outs = nd.BatchNorm(x, gamma, beta, rm, rv, fix_gamma=False, training=True,
+                        eps=1e-5)
+    out, mean, var = outs
+    xn = x.asnumpy()
+    m = xn.mean(axis=(0, 2, 3))
+    assert onp.allclose(mean.asnumpy(), m, rtol=1e-4, atol=1e-4)
+    # normalized output has ~zero mean / unit var per channel
+    on = out.asnumpy()
+    assert onp.allclose(on.mean(axis=(0, 2, 3)), 0.0, atol=1e-4)
+    assert onp.allclose(on.var(axis=(0, 2, 3)), 1.0, atol=1e-2)
+    # inference mode uses running stats
+    (out_inf,) = nd.BatchNorm(x, gamma, beta, rm, rv, fix_gamma=False,
+                              training=False)
+    assert onp.allclose(out_inf.asnumpy(), xn, rtol=1e-3, atol=1e-3)
+
+
+def test_layernorm():
+    x = nd.random.uniform(shape=(2, 5))
+    g = nd.ones((5,))
+    b = nd.zeros((5,))
+    out = nd.LayerNorm(x, g, b, axis=-1, eps=1e-5)
+    on = out.asnumpy()
+    assert onp.allclose(on.mean(axis=-1), 0.0, atol=1e-5)
+    assert onp.allclose(on.std(axis=-1), 1.0, atol=1e-2)
+
+
+def test_activation_variants():
+    x = nd.array([-1.0, 0.0, 1.0])
+    assert onp.allclose(nd.relu(x).asnumpy(), [0, 0, 1])
+    assert onp.allclose(nd.Activation(x, act_type="tanh").asnumpy(),
+                        onp.tanh(x.asnumpy()), rtol=1e-5)
+    lr = nd.LeakyReLU(x, act_type="leaky", slope=0.1)
+    assert onp.allclose(lr.asnumpy(), [-0.1, 0, 1], rtol=1e-5)
+    el = nd.LeakyReLU(x, act_type="elu", slope=1.0)
+    assert onp.allclose(el.asnumpy(), [onp.expm1(-1.0), 0, 1], rtol=1e-5)
+
+
+def test_embedding():
+    w = nd.random.uniform(shape=(10, 4))
+    idx = nd.array([1, 3, 5], dtype="int32")
+    out = nd.embedding(idx, w, input_dim=10, output_dim=4)
+    assert onp.allclose(out.asnumpy(), w.asnumpy()[[1, 3, 5]])
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0]])
+    idx = nd.topk(x, k=2)
+    assert onp.array_equal(idx.asnumpy()[0], [0, 2])
+    both = nd.topk(x, k=2, ret_typ="both")
+    assert onp.allclose(both[0].asnumpy()[0], [3, 2])
+    s = nd.sort(x)
+    assert onp.allclose(s.asnumpy()[0], [1, 2, 3])
+
+
+def test_optimizer_ops():
+    w = nd.ones((4,))
+    g = nd.full((4,), 0.5)
+    out = nd.sgd_update(w, g, lr=0.1)
+    assert onp.allclose(out.asnumpy(), 1.0 - 0.05)
+    mom = nd.zeros((4,))
+    out2 = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    assert onp.allclose(out2[0].asnumpy(), 0.95)
+    mean, var = nd.zeros((4,)), nd.zeros((4,))
+    out3 = nd.adam_update(w, g, mean, var, lr=0.1)
+    assert out3[0].shape == (4,)
+
+
+def test_linalg():
+    a0 = onp.random.uniform(size=(4, 4)).astype("float32")
+    spd = a0 @ a0.T + 4 * onp.eye(4, dtype="float32")
+    L = nd.linalg.potrf(nd.array(spd))
+    assert onp.allclose(L.asnumpy() @ L.asnumpy().T, spd, rtol=1e-3, atol=1e-3)
+    inv = nd.linalg.inverse(nd.array(spd))
+    assert onp.allclose(inv.asnumpy() @ spd, onp.eye(4), atol=1e-3)
+
+
+def test_transformer_interleaved_ops():
+    seq, bsz, heads, hd = 5, 2, 2, 4
+    embed = heads * hd
+    qkv = nd.random.uniform(shape=(seq, bsz, 3 * embed))
+    att = nd.contrib.interleaved_matmul_selfatt_qk(qkv, heads=heads)
+    assert att.shape == (bsz * heads, seq, seq)
+    probs = nd.softmax(att, axis=-1)
+    out = nd.contrib.interleaved_matmul_selfatt_valatt(qkv, probs, heads=heads)
+    assert out.shape == (seq, bsz, embed)
+
+
+def test_control_flow_foreach():
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    data = nd.array([1.0, 2.0, 3.0])
+    out, final = nd.contrib.foreach(body, data, nd.array(0.0))
+    assert onp.allclose(out.asnumpy(), [1.0, 3.0, 6.0])
+    assert float(final.asscalar()) == 6.0
+
+
+def test_sequence_ops():
+    data = nd.array(onp.arange(12, dtype="float32").reshape(3, 2, 2))
+    lens = nd.array([2.0, 3.0])
+    masked = nd.sequence_mask(data, lens, use_sequence_length=True, value=-1.0)
+    mn = masked.asnumpy()
+    assert onp.all(mn[2, 0] == -1.0)
+    assert onp.all(mn[2, 1] == data.asnumpy()[2, 1])
+
+
+def test_dropout_op():
+    import jax
+
+    x = nd.ones((100, 100))
+    key = nd.NDArray(jax.random.PRNGKey(0))
+    out = nd.Dropout(x, key, p=0.5, training=True)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.4 < frac < 0.6
+    out_inf = nd.Dropout(x, key, p=0.5, training=False)
+    assert onp.allclose(out_inf.asnumpy(), 1.0)
+
+
+def test_topk_mask_marks_topk_positions():
+    x = nd.array([[1.0, 5.0, 3.0]])
+    mask = nd.topk(x, k=1, ret_typ="mask")
+    assert onp.array_equal(mask.asnumpy(), [[0.0, 1.0, 0.0]])
+
+
+def test_reshape_shape_kwarg():
+    x = nd.arange(0, 6)
+    assert x.reshape(shape=(3, 2)).shape == (3, 2)
+
+
+def test_arange_ctx():
+    a = nd.arange(0, 4, ctx=mx.cpu())
+    assert a.ctx.device_type == "cpu"
+    assert onp.allclose(a.asnumpy(), [0, 1, 2, 3])
+
+
+def test_deconvolution_nhwc_and_nchw():
+    # stride-1 deconv with delta kernel reproduces input in both layouts
+    x = nd.random.uniform(shape=(1, 1, 5, 5))
+    k = nd.zeros((1, 1, 3, 3)); k[0, 0, 1, 1] = 1.0
+    out = nd.Deconvolution(x, k, kernel=(3, 3), num_filter=1, pad=(1, 1))
+    assert onp.allclose(out.asnumpy(), x.asnumpy(), atol=1e-6)
+    xl = nd.transpose(x, axes=(0, 2, 3, 1))
+    kl = nd.zeros((1, 3, 3, 1)); kl[0, 1, 1, 0] = 1.0
+    outl = nd.Deconvolution(xl, kl, kernel=(3, 3), num_filter=1, pad=(1, 1),
+                            layout="NHWC")
+    assert onp.allclose(outl.asnumpy(), xl.asnumpy(), atol=1e-6)

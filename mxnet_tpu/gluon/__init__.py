@@ -1,0 +1,30 @@
+"""Gluon: the imperative/hybrid neural-network API (reference
+``python/mxnet/gluon/``)."""
+from .parameter import Parameter, Constant, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+
+_LAZY = {
+    "trainer": ".trainer",
+    "data": ".data",
+    "rnn": ".rnn",
+    "model_zoo": ".model_zoo",
+    "metric": "..metric",
+    "contrib": ".contrib",
+    "probability": ".probability",
+}
+
+
+def __getattr__(name):
+    if name == "Trainer":
+        from .trainer import Trainer
+
+        return Trainer
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute '{name}'")

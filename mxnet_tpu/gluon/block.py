@@ -1,0 +1,665 @@
+"""Gluon Block / HybridBlock.
+
+TPU-native re-design of ``python/mxnet/gluon/block.py`` (1,755 LoC).
+
+``Block`` keeps the reference's contract: attribute assignment registers
+children/parameters, ``collect_params`` walks the tree with structural names,
+``__call__`` runs ``forward`` with hook support, save/load_parameters use
+structural names.
+
+``HybridBlock.hybridize()`` is where the design diverges on purpose: the
+reference traces forward under *deferred compute* into an nnvm graph and
+compiles a ``CachedOp`` (block.py:993 _build_cache → cached_op.cc).  Here the
+whole forward (including parameter reads, RNG, and BatchNorm state updates)
+is staged into ONE pure JAX function and handed to ``jax.jit`` — XLA then
+owns CSE/fusion/memory-planning, which is the entire point of a TPU-first
+executor (SURVEY.md §7 step 3: CachedOp-analog = whole-graph jit).  Under
+``autograd.record()`` the compiled graph is differentiated as a single tape
+node via ``jax.vjp`` — the analog of CachedOp recording itself as one
+``_CachedOp`` node on the tape (cached_op.cc:776).
+"""
+from __future__ import annotations
+
+import json
+import re
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import autograd
+from .. import random as _random
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _wrap
+from .parameter import Constant, DeferredInitializationError, Parameter
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+def _flatten_args(args):
+    """Flatten nested (tuple/list/dict) args into NDArray leaves + treedef."""
+    leaves: List[Any] = []
+
+    def rec(x):
+        if isinstance(x, NDArray):
+            leaves.append(x)
+            return ("_leaf_", len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return type(x)(rec(v) for v in x)
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return ("_const_", x)
+
+    struct = rec(list(args))
+    return leaves, struct
+
+
+def _unflatten_args(struct, leaves):
+    def rec(x):
+        if isinstance(x, tuple) and len(x) == 2 and x[0] == "_leaf_":
+            return leaves[x[1]]
+        if isinstance(x, tuple) and len(x) == 2 and x[0] == "_const_":
+            return x[1]
+        if isinstance(x, (list, tuple)):
+            return type(x)(rec(v) for v in x)
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return x
+
+    out = rec(struct)
+    return tuple(out)
+
+
+def _flatten_output(out):
+    """Flatten forward() output into NDArray leaves + rebuild closure."""
+    leaves: List[NDArray] = []
+
+    def rec(x):
+        if isinstance(x, NDArray):
+            leaves.append(x)
+            return ("_leaf_", len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return type(x)(rec(v) for v in x)
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return ("_const_", x)
+
+    struct = rec(out)
+    return leaves, struct
+
+
+def _rebuild_output(struct, leaves):
+    def rec(x):
+        if isinstance(x, tuple) and len(x) == 2 and x[0] == "_leaf_":
+            return leaves[x[1]]
+        if isinstance(x, tuple) and len(x) == 2 and x[0] == "_const_":
+            return x[1]
+        if isinstance(x, (list, tuple)):
+            return type(x)(rec(v) for v in x)
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return x
+
+    return rec(struct)
+
+
+class _BlockScope:
+    """Tracks hook handles."""
+
+    _counter = [0]
+
+    @classmethod
+    def next_uid(cls):
+        cls._counter[0] += 1
+        return cls._counter[0]
+
+
+class HookHandle:
+    """Removable hook handle (reference block.py:62)."""
+
+    def __init__(self, hooks_dict, hid):
+        self._hooks_dict = hooks_dict
+        self._id = hid
+
+    def detach(self):
+        self._hooks_dict.pop(self._id, None)
+
+    remove = detach
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.detach()
+
+
+class Block:
+    """Base class for all neural network layers and models (reference
+    ``python/mxnet/gluon/block.py`` class Block)."""
+
+    def __init__(self):
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._forward_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+
+    # -- registration ----------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children", {})
+            existing[name] = value
+        elif isinstance(value, Parameter):
+            if not hasattr(self, "_reg_params"):
+                raise RuntimeError(
+                    "Block.__init__() must be called before assigning Parameters"
+                )
+            self._reg_params[name] = value
+            if value._name == "weight" and name != "weight":
+                # attribute name is the canonical leaf name in 2.0 naming
+                value._name = name
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+        return block
+
+    # -- hooks -----------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        hid = _BlockScope.next_uid()
+        self._forward_pre_hooks[hid] = hook
+        return HookHandle(self._forward_pre_hooks, hid)
+
+    def register_forward_hook(self, hook):
+        hid = _BlockScope.next_uid()
+        self._forward_hooks[hid] = hook
+        return HookHandle(self._forward_hooks, hid)
+
+    def register_op_hook(self, callback, monitor_all=False):
+        """Per-op monitoring (reference MXCachedOpRegisterOpHook).  On the
+        TPU backend per-op hooks only fire on non-hybridized execution."""
+        from ..ndarray import ndarray as _ndmod
+
+        _ndmod._op_monitor = (callback, monitor_all)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # -- params ----------------------------------------------------------
+    @property
+    def params(self) -> Dict[str, Parameter]:
+        return dict(self._reg_params)
+
+    def collect_params(self, select: Optional[str] = None) -> Dict[str, Parameter]:
+        """Structural-name → Parameter over the whole tree (reference
+        block.py collect_params; 2.0 structural naming '0.weight')."""
+        out: "OrderedDict[str, Parameter]" = OrderedDict()
+
+        def walk(block: "Block", prefix: str):
+            for name, p in block._reg_params.items():
+                out[prefix + name] = p
+            for cname, child in block._children.items():
+                walk(child, prefix + cname + ".")
+
+        walk(self, "")
+        if select is not None:
+            pat = re.compile(select)
+            out = OrderedDict((k, v) for k, v in out.items() if pat.match(k))
+        for k, v in out.items():
+            v._structure = k
+        return out
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        from ..initializer import Uniform, create
+
+        params = self.collect_params()
+        if init is None:
+            init = Uniform()
+        else:
+            init = create(init) if not callable(init) else init
+        if verbose and hasattr(init, "set_verbosity"):
+            init.set_verbosity(verbose=verbose)
+        for p in params.values():
+            p.initialize(None, ctx, default_init=init, force_reinit=force_reinit)
+
+    def save_parameters(self, filename: str, deduplicate: bool = False):
+        """Save with structural names (reference block.py:339)."""
+        params = self.collect_params()
+        arrays = {}
+        seen = {}
+        for name, p in params.items():
+            if p._data is None and p._deferred_init:
+                p._finish_deferred_init()
+            if deduplicate and id(p) in seen:
+                continue
+            seen[id(p)] = name
+            arrays[name] = p._reduce().asnumpy()
+        onp.savez(_npz_path(filename), **arrays)
+        import os
+
+        if os.path.exists(filename + ".npz") and filename != filename + ".npz":
+            os.replace(filename + ".npz", filename)
+
+    def load_parameters(
+        self,
+        filename: str,
+        ctx=None,
+        allow_missing: bool = False,
+        ignore_extra: bool = False,
+        cast_dtype: bool = False,
+        dtype_source: str = "current",
+    ):
+        """Load structural-name keyed file (reference block.py:381)."""
+        loaded = _load_param_file(filename)
+        params = self.collect_params()
+        if not allow_missing:
+            missing = [k for k in params if k not in loaded]
+            if missing:
+                raise AssertionError(
+                    f"Parameter(s) {missing} are missing in file '{filename}'. "
+                    "Set allow_missing=True to ignore."
+                )
+        extra = [k for k in loaded if k not in params]
+        if extra and not ignore_extra:
+            raise AssertionError(
+                f"Parameter(s) {extra} loaded from file '{filename}' are not "
+                "present in this Block. Set ignore_extra=True to ignore."
+            )
+        if ctx is not None and isinstance(ctx, Context):
+            ctx = [ctx]
+        for k, v in loaded.items():
+            if k in params:
+                params[k]._load_init(v, ctx, cast_dtype=cast_dtype,
+                                     dtype_source=dtype_source)
+
+    def load_dict(self, param_dict, ctx=None, allow_missing=False,
+                  ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        params = self.collect_params()
+        if not allow_missing:
+            missing = [k for k in params if k not in param_dict]
+            if missing:
+                raise AssertionError(f"Parameter(s) {missing} missing from dict")
+        for k, v in param_dict.items():
+            if k in params:
+                params[k]._load_init(v, [ctx] if isinstance(ctx, Context) else ctx,
+                                     cast_dtype=cast_dtype, dtype_source=dtype_source)
+            elif not ignore_extra:
+                raise AssertionError(f"Parameter {k} not present in this Block")
+
+    def share_parameters(self, shared: Dict[str, Parameter]):
+        """Share parameters from another block (reference 2.0 API)."""
+        params = self.collect_params()
+        for k, v in shared.items():
+            if k not in params:
+                raise ValueError(f"no parameter named {k} in this block")
+            self._replace_param(k, v)
+        return self
+
+    def _replace_param(self, structural_name: str, new_param: Parameter):
+        parts = structural_name.split(".")
+        block = self
+        for part in parts[:-1]:
+            block = block._children[part]
+        attr = parts[-1]
+        block._reg_params[attr] = new_param
+        object.__setattr__(block, attr, new_param)
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for b in self._children.values():
+            b._on_cast(dtype)
+        self._on_cast(dtype)
+        return self
+
+    def _on_cast(self, dtype):
+        pass
+
+    def zero_grad(self):
+        for p in self.collect_params().values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.collect_params().values():
+            p.reset_ctx(ctx)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def infer_shape(self, *args):
+        raise ValueError(
+            f"{type(self).__name__} has parameters with unknown shape. You "
+            "must implement infer_shape(self, *args) for deferred "
+            "initialization, or specify input sizes explicitly."
+        )
+
+    def _deferred_infer_shape(self, *args):
+        self.infer_shape(*args)
+        for p in self._reg_params.values():
+            if p._deferred_init:
+                p._finish_deferred_init()
+
+    # -- execution -------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        try:
+            out = self.forward(*args, **kwargs)
+        except DeferredInitializationError:
+            self._deferred_infer_shape(*args)
+            out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary table (reference block.py summary)."""
+        summary: "OrderedDict[str, dict]" = OrderedDict()
+        hooks = []
+
+        def register(block, prefix):
+            def hook(blk, inp, out):
+                name = f"{prefix}{type(blk).__name__}"
+                n = len(summary)
+                key = f"{name}-{n + 1}"
+                leaves, _ = _flatten_output(out)
+                summary[key] = {
+                    "output_shape": [tuple(l.shape) for l in leaves],
+                    "n_params": sum(
+                        int(onp.prod(p.shape)) if p.shape else 0
+                        for p in blk._reg_params.values()
+                        if p.shape is not None
+                    ),
+                }
+
+            hooks.append(block.register_forward_hook(hook))
+            for cname, child in block._children.items():
+                register(child, prefix)
+
+        register(self, "")
+        try:
+            self(*inputs)
+        finally:
+            for h in hooks:
+                h.detach()
+        lines = [f"{'Layer':<40}{'Output Shape':<30}{'Params':<12}", "=" * 82]
+        total = 0
+        for k, v in summary.items():
+            lines.append(f"{k:<40}{str(v['output_shape']):<30}{v['n_params']:<12}")
+            total += v["n_params"]
+        lines.append("=" * 82)
+        lines.append(f"Total params (leaf blocks): {total}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        s = f"{type(self).__name__}(\n"
+        for name, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            s += f"  ({name}): {child_repr}\n"
+        return s + ")"
+
+
+class HybridBlock(Block):
+    """Block compilable into a single XLA computation (reference
+    HybridBlock, gluon/block.py:900+)."""
+
+    def __init__(self):
+        super().__init__()
+        self._active = False
+        self._flags: Dict[str, Any] = {}
+        # cache: (training, input treedef signature) -> compiled record
+        self._cached: Dict[Any, Tuple] = {}
+        self._backend = None
+
+    def hybridize(self, active=True, backend=None, clear=True, **kwargs):
+        """Activate whole-graph compilation.  ``static_alloc``/``static_shape``
+        are accepted for API parity; XLA's buffer assignment subsumes them."""
+        self._active = active
+        self._backend = backend
+        self._flags.update(kwargs)
+        if clear:
+            self._cached = {}
+        super().hybridize(active=False if active else active)
+        # note: only the outermost hybridized block compiles; children run
+        # inside its trace (the reference inlines children the same way).
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        self.hybridize(True, backend=backend, **kwargs)
+        return self(x, *args)
+
+    def _ensure_initialized(self, *args):
+        """Complete any deferred param init by probing with abstract eval."""
+        params = self.collect_params()
+        deferred = [p for p in params.values() if p._data is None]
+        if not deferred:
+            return False
+        # run one eager forward: layer-local infer_shape hooks complete init
+        return True
+
+    def __call__(self, *args, **kwargs):
+        if not self._active:
+            return super().__call__(*args, **kwargs)
+        params = self.collect_params()
+        if any(p._data is None for p in params.values()):
+            # first call completes deferred init eagerly, like the reference's
+            # infer-shape-then-build-cache dance (block.py:993)
+            out = super().__call__(*args, **kwargs)
+            return out
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self._call_cached(*args, **kwargs)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    # -- the CachedOp analog --------------------------------------------
+    def _call_cached(self, *args, **kwargs):
+        if kwargs:
+            # keyword args become part of the static signature
+            args = args + tuple(kwargs.values())
+        training = autograd.is_training()
+        in_leaves, in_struct = _flatten_args(args)
+        sig = (training, _struct_key(in_struct))
+        rec = self._cached.get(sig)
+        if rec is None:
+            rec = self._build_cache(in_struct, training)
+            self._cached[sig] = rec
+        jitted, names, params, ctx_idx, out_struct, mutated_names = rec
+
+        ctx = in_leaves[0].ctx if in_leaves else current_context()
+        param_arrays = [params[n]._data[_ctx_index(params[n], ctx)]._data
+                        for n in names]
+        input_arrays = [l._data for l in in_leaves]
+        key = _random.next_key()
+
+        recording = autograd.is_recording() and (
+            any(p.grad_req != "null" for p in params.values())
+            or any(l._ag_node is not None or l._ag_grad_req != "null"
+                   for l in in_leaves)
+        )
+        if recording:
+            fn = lambda ps, ins: jitted(ps, ins, key)
+            (out_arrays, mut_vals), vjp_fn = jax.vjp(fn, param_arrays, input_arrays)
+            node_inputs = [params[n]._data[_ctx_index(params[n], ctx)]
+                           for n in names] + list(in_leaves)
+
+            def node_vjp(out_cts, _vjp=vjp_fn, _muts=mut_vals):
+                cts = list(out_cts) if isinstance(out_cts, tuple) else [out_cts]
+                mct = [_zero_ct(m) for m in _muts]
+                pcts, icts = _vjp((cts, mct))
+                return tuple(list(pcts) + list(icts))
+
+            node = autograd.TapeNode(
+                node_vjp,
+                node_inputs,
+                len(out_arrays),
+                [tuple(o.shape) for o in out_arrays],
+                [o.dtype for o in out_arrays],
+                name=type(self).__name__,
+            )
+            out_nd = []
+            for i, o in enumerate(out_arrays):
+                w = _wrap(o, ctx)
+                w._ag_node = node
+                w._ag_out_index = i
+                out_nd.append(w)
+        else:
+            out_arrays, mut_vals = jitted(param_arrays, input_arrays, key)
+            out_nd = [_wrap(o, ctx) for o in out_arrays]
+
+        for n, v in zip(mutated_names, mut_vals):
+            params[n]._data[_ctx_index(params[n], ctx)]._set_data(v)
+        return _rebuild_output(out_struct[0], out_nd)
+
+    def _build_cache(self, in_struct, training):
+        params = OrderedDict(
+            (n, p) for n, p in self.collect_params().items() if p._data is not None
+        )
+        names = list(params)
+        ctx_idx = 0
+        out_struct: List[Any] = [None]
+        mutated_names: List[str] = []
+        block = self
+
+        def raw_fn(param_arrays, input_arrays, rng_key):
+            installed = []
+            for n, arr in zip(names, param_arrays):
+                for d in params[n]._data:
+                    installed.append((d, d._data, d._version))
+                    d._data = arr
+            _random.push_trace_key(rng_key)
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(training)
+            try:
+                leaves = [_wrap(a, current_context()) for a in input_arrays]
+                call_args = _unflatten_args(in_struct, leaves)
+                out = block.forward(*call_args)
+            finally:
+                autograd.set_recording(prev_rec)
+                autograd.set_training(prev_train)
+                _random.pop_trace_key()
+            out_leaves, struct = _flatten_output(out)
+            out_struct[0] = struct
+            # detect mutation per param via version bump on any replica
+            # (BatchNorm running stats etc. become extra functional outputs)
+            mutated_names.clear()
+            mut_vals = []
+            offset = 0
+            for n in names:
+                reps = params[n]._data
+                entries = installed[offset : offset + len(reps)]
+                offset += len(reps)
+                if any(d._version != ver for (d, _o, ver) in entries):
+                    mutated_names.append(n)
+                    mut_vals.append(reps[0]._data)
+            for d, old, ver in installed:
+                d._data = old
+                d._version = ver
+            return [o._data for o in out_leaves], mut_vals
+
+        jitted = jax.jit(raw_fn)
+        return (jitted, names, params, ctx_idx, out_struct, mutated_names)
+
+    # -- export / import -------------------------------------------------
+    def export(self, path: str, epoch: int = 0):
+        """Serialize model params + manifest (reference block.py:1299 export
+        → symbol.json + .params).  The graph itself is Python-defined here;
+        SymbolBlock.imports restores params into a user-provided net factory
+        or a registered model-zoo class recorded in the manifest."""
+        params_file = f"{path}-{epoch:04d}.params"
+        self.save_parameters(params_file)
+        manifest = {
+            "format": "mxnet_tpu-v1",
+            "class": type(self).__name__,
+            "module": type(self).__module__,
+        }
+        with open(f"{path}-symbol.json", "w") as f:
+            json.dump(manifest, f)
+        return f"{path}-symbol.json", params_file
+
+
+class SymbolBlock(HybridBlock):
+    """Load an exported model (reference block.py:1485 SymbolBlock).
+
+    The reference rebuilds a graph from symbol JSON; here a model is a Python
+    class, so ``imports`` re-instantiates the recorded class and loads params.
+    """
+
+    def __init__(self, inner: HybridBlock):
+        super().__init__()
+        self.net = inner
+
+    def forward(self, *args):
+        return self.net(*args)
+
+    @staticmethod
+    def imports(symbol_file, input_names=None, param_file=None, ctx=None,
+                net_factory: Optional[Callable[[], HybridBlock]] = None):
+        with open(symbol_file) as f:
+            manifest = json.load(f)
+        if net_factory is not None:
+            net = net_factory()
+        else:
+            import importlib
+
+            mod = importlib.import_module(manifest["module"])
+            net = getattr(mod, manifest["class"])()
+        if param_file:
+            net.load_parameters(param_file, ctx=ctx)
+        blk = SymbolBlock(net)
+        blk.hybridize()
+        return blk
+
+
+# ---------------------------------------------------------------------------
+def _npz_path(filename: str) -> str:
+    return filename if filename.endswith(".npz") else filename
+
+
+def _load_param_file(filename: str) -> Dict[str, onp.ndarray]:
+    with onp.load(filename, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _struct_key(struct):
+    def rec(x):
+        if isinstance(x, (list, tuple)):
+            if len(x) == 2 and x[0] == "_leaf_":
+                return ("L", x[1])
+            if len(x) == 2 and x[0] == "_const_":
+                return ("C", repr(x[1]))
+            return tuple(rec(v) for v in x)
+        if isinstance(x, dict):
+            return tuple(sorted((k, rec(v)) for k, v in x.items()))
+        return repr(x)
+
+    return rec(struct)
+
+
+def _ctx_index(param: Parameter, ctx: Context) -> int:
+    if param._ctx_list is None or len(param._ctx_list) == 1:
+        return 0
+    for i, c in enumerate(param._ctx_list):
+        if c == ctx:
+            return i
+    return 0
+
+
+def _zero_ct(arr):
+    if jnp.issubdtype(arr.dtype, jnp.floating) or jnp.issubdtype(
+        arr.dtype, jnp.complexfloating
+    ):
+        return jnp.zeros(arr.shape, arr.dtype)
+    return onp.zeros(arr.shape, jax.dtypes.float0)

@@ -1,0 +1,91 @@
+"""Activation layers (reference ``python/mxnet/gluon/nn/activations.py``)."""
+from __future__ import annotations
+
+from ...ndarray.ndarray import invoke
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish", "SiLU",
+           "GELU"]
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation):
+        super().__init__()
+        self._act_type = activation
+
+    def forward(self, x):
+        return invoke("Activation", [x], {"act_type": self._act_type})
+
+    def __repr__(self):
+        return f"Activation({self._act_type})"
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return invoke("LeakyReLU", [x], {"act_type": "leaky", "slope": self._alpha})
+
+    def __repr__(self):
+        return f"LeakyReLU({self._alpha})"
+
+
+class PReLU(HybridBlock):
+    """Channel-wise learnable leaky slope (reference activations.py PReLU)."""
+
+    def __init__(self, alpha_initializer=None, in_channels=1):
+        super().__init__()
+        from ... import initializer as init
+
+        self.alpha = Parameter(
+            "alpha",
+            shape=(in_channels,),
+            init=alpha_initializer or init.Constant(0.25),
+        )
+
+    def forward(self, x):
+        return invoke(
+            "LeakyReLU", [x, self.alpha.data(x.ctx)], {"act_type": "prelu"}
+        )
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return invoke("LeakyReLU", [x], {"act_type": "elu", "slope": self._alpha})
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return invoke("LeakyReLU", [x], {"act_type": "selu"})
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf"):
+        super().__init__()
+        self._approx = approximation
+
+    def forward(self, x):
+        return invoke("LeakyReLU", [x], {"act_type": "gelu"})
+
+
+class Swish(HybridBlock):
+    """x * sigmoid(beta*x) (reference activations.py Swish)."""
+
+    def __init__(self, beta=1.0):
+        super().__init__()
+        self._beta = beta
+
+    def forward(self, x):
+        if self._beta == 1.0:
+            return x * invoke("sigmoid", [x], {})
+        return x * invoke("sigmoid", [x * self._beta], {})
+
+
+SiLU = Swish

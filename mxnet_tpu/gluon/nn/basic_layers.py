@@ -1,0 +1,492 @@
+"""Basic layers: Dense, Dropout, norms, Embedding, containers.
+
+Reference ``python/mxnet/gluon/nn/basic_layers.py``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ... import autograd
+from ... import random as _random
+from ...ndarray import NDArray
+from ...ndarray.ndarray import invoke, _wrap
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = [
+    "Sequential",
+    "HybridSequential",
+    "Dense",
+    "Dropout",
+    "BatchNorm",
+    "SyncBatchNorm",
+    "InstanceNorm",
+    "LayerNorm",
+    "GroupNorm",
+    "Embedding",
+    "Flatten",
+    "Lambda",
+    "HybridLambda",
+    "Identity",
+    "Concatenate",
+    "HybridConcatenate",
+]
+
+
+class Sequential(Block):
+    """Stack of blocks executed sequentially (reference basic_layers.py:36)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)()
+            net.add(*layers)
+            return net
+        return layers
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(HybridBlock):
+    """Hybridizable Sequential (reference basic_layers.py:86)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)()
+            net.add(*layers)
+            return net
+        return layers
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (reference basic_layers.py:136; op
+    src/operator/nn/fully_connected.cc)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0):
+        super().__init__()
+        self._units = units
+        self._in_units = in_units
+        self._flatten = flatten
+        self._use_bias = use_bias
+        self.weight = Parameter(
+            "weight",
+            shape=(units, in_units),
+            dtype=dtype,
+            init=weight_initializer,
+            allow_deferred_init=True,
+        )
+        if use_bias:
+            from ... import initializer as init
+
+            self.bias = Parameter(
+                "bias",
+                shape=(units,),
+                dtype=dtype,
+                init=init.create(bias_initializer),
+                allow_deferred_init=True,
+            )
+        else:
+            self.bias = None
+        self.act = Activation(activation) if activation else None
+        if self.act is not None:
+            self.register_child(self.act, "act")
+
+    def infer_shape(self, x):
+        in_units = (
+            int(onp.prod(x.shape[1:])) if self._flatten else int(x.shape[-1])
+        )
+        self.weight.shape = (self._units, in_units)
+
+    def forward(self, x):
+        args = [x, self.weight.data(x.ctx)]
+        if self._use_bias:
+            args.append(self.bias.data(x.ctx))
+        out = invoke(
+            "FullyConnected",
+            args,
+            {
+                "num_hidden": self._units,
+                "no_bias": not self._use_bias,
+                "flatten": self._flatten,
+            },
+        )
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return f"Dense({shape[1] if shape else None} -> {self._units}, " \
+               f"{'linear' if self.act is None else self.act._act_type})"
+
+
+class Dropout(HybridBlock):
+    """Dropout (reference basic_layers.py:226).  RNG key threaded explicitly
+    so hybridized graphs stay pure (see ops/nn.py dropout)."""
+
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        if self._rate == 0 or not autograd.is_training():
+            return x
+        key = _random.next_key()
+        key_nd = _wrap(key, x.ctx)
+        return invoke(
+            "Dropout",
+            [x, key_nd],
+            {"p": self._rate, "axes": self._axes, "training": True},
+        )
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization (reference basic_layers.py:270; op
+    src/operator/nn/batch_norm.cc).
+
+    Running statistics are updated functionally: the op returns batch
+    mean/var and the layer folds them into running buffers; under
+    hybridization the buffer writes become extra outputs of the compiled
+    graph (block.py mutation capture).
+    """
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__()
+        from ... import initializer as init
+
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self._in_channels = in_channels
+        self.gamma = Parameter(
+            "gamma", grad_req="write" if scale else "null",
+            shape=(in_channels,), init=init.create(gamma_initializer),
+            allow_deferred_init=True, differentiable=scale)
+        self.beta = Parameter(
+            "beta", grad_req="write" if center else "null",
+            shape=(in_channels,), init=init.create(beta_initializer),
+            allow_deferred_init=True, differentiable=center)
+        self.running_mean = Parameter(
+            "running_mean", grad_req="null", shape=(in_channels,),
+            init=init.create(running_mean_initializer),
+            allow_deferred_init=True, differentiable=False)
+        self.running_var = Parameter(
+            "running_var", grad_req="null", shape=(in_channels,),
+            init=init.create(running_variance_initializer),
+            allow_deferred_init=True, differentiable=False)
+
+    def infer_shape(self, x):
+        c = int(x.shape[self._axis])
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape = (c,)
+
+    def forward(self, x):
+        ctx = x.ctx
+        training = autograd.is_training() and not self._use_global_stats
+        rm, rv = self.running_mean.data(ctx), self.running_var.data(ctx)
+        outs = invoke(
+            "BatchNorm",
+            [x, self.gamma.data(ctx), self.beta.data(ctx), rm, rv],
+            {
+                "eps": self._epsilon,
+                "momentum": self._momentum,
+                "fix_gamma": not self._scale,
+                "use_global_stats": self._use_global_stats,
+                "axis": self._axis,
+                "training": training,
+            },
+        )
+        if training:
+            out, mean, var = outs
+            m = self._momentum
+            with autograd.pause():
+                rm._set_data(rm._data * m + mean._data * (1 - m))
+                rv._set_data(rv._data * m + var._data * (1 - m))
+            return out
+        return outs[0] if isinstance(outs, (list, tuple)) else outs
+
+    def __repr__(self):
+        return f"BatchNorm(axis={self._axis}, eps={self._epsilon}, " \
+               f"momentum={self._momentum}, in_channels={self.gamma.shape[0] if self.gamma.shape else None})"
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (reference
+    ``src/operator/contrib/sync_batch_norm-inl.h``).
+
+    TPU-native: inside a pjit/shard_map data-parallel step the batch axis is
+    sharded over the mesh and XLA computes global batch statistics via
+    ``lax.pmean`` automatically when the layer runs under
+    ``mxnet_tpu.parallel`` (see parallel/psum hooks); eager single-device
+    behaviour equals BatchNorm.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels)
+        self._num_devices = num_devices
+
+
+class InstanceNorm(HybridBlock):
+    """Reference basic_layers.py InstanceNorm."""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        from ... import initializer as init
+
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", grad_req="write" if scale else "null",
+                               shape=(in_channels,),
+                               init=init.create(gamma_initializer),
+                               allow_deferred_init=True, differentiable=scale)
+        self.beta = Parameter("beta", grad_req="write" if center else "null",
+                              shape=(in_channels,),
+                              init=init.create(beta_initializer),
+                              allow_deferred_init=True, differentiable=center)
+
+    def infer_shape(self, x):
+        c = int(x.shape[self._axis])
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        if self._axis != 1:
+            x = x.swapaxes(1, self._axis)
+        out = invoke(
+            "InstanceNorm",
+            [x, self.gamma.data(x.ctx), self.beta.data(x.ctx)],
+            {"eps": self._epsilon},
+        )
+        if self._axis != 1:
+            out = out.swapaxes(1, self._axis)
+        return out
+
+
+class LayerNorm(HybridBlock):
+    """Reference basic_layers.py LayerNorm; op src/operator/nn/layer_norm.cc."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        from ... import initializer as init
+
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", grad_req="write" if scale else "null",
+                               shape=(in_channels,),
+                               init=init.create(gamma_initializer),
+                               allow_deferred_init=True, differentiable=scale)
+        self.beta = Parameter("beta", grad_req="write" if center else "null",
+                              shape=(in_channels,),
+                              init=init.create(beta_initializer),
+                              allow_deferred_init=True, differentiable=center)
+
+    def infer_shape(self, x):
+        c = int(x.shape[self._axis])
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return invoke(
+            "LayerNorm",
+            [x, self.gamma.data(x.ctx), self.beta.data(x.ctx)],
+            {"axis": self._axis, "eps": self._epsilon},
+        )
+
+
+class GroupNorm(HybridBlock):
+    """Reference basic_layers.py GroupNorm; op src/operator/nn/group_norm.cc."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        from ... import initializer as init
+
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", grad_req="write" if scale else "null",
+                               shape=(in_channels,),
+                               init=init.create(gamma_initializer),
+                               allow_deferred_init=True, differentiable=scale)
+        self.beta = Parameter("beta", grad_req="write" if center else "null",
+                              shape=(in_channels,),
+                              init=init.create(beta_initializer),
+                              allow_deferred_init=True, differentiable=center)
+
+    def infer_shape(self, x):
+        c = int(x.shape[1])
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return invoke(
+            "GroupNorm",
+            [x, self.gamma.data(x.ctx), self.beta.data(x.ctx)],
+            {"num_groups": self._num_groups, "eps": self._epsilon},
+        )
+
+
+class Embedding(HybridBlock):
+    """Lookup table (reference basic_layers.py Embedding).
+
+    The reference supports ``sparse_grad`` row_sparse gradients; on TPU the
+    gradient is an XLA scatter-add produced by the vjp of ``take`` — dense,
+    fused, no sparse storage needed.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False):
+        super().__init__()
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer)
+
+    def forward(self, x):
+        return invoke(
+            "embedding",
+            [x, self.weight.data()],
+            {"input_dim": self._input_dim, "output_dim": self._output_dim},
+        )
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return invoke("flatten", [x], {})
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Lambda(Block):
+    """Wrap a function into a Block (reference basic_layers.py Lambda)."""
+
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            from ... import ndarray as nd
+
+            function = getattr(nd, function)
+        self._func = function
+        self._func_name = getattr(function, "__name__", "custom")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+    def __repr__(self):
+        return f"Lambda({self._func_name})"
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            from ... import ndarray as nd
+
+            function = getattr(nd, function)
+        self._func = function
+        self._func_name = getattr(function, "__name__", "custom")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+    def __repr__(self):
+        return f"HybridLambda({self._func_name})"
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Concatenate(Sequential):
+    """Run children on same input, concat outputs (reference contrib →
+    basic_layers in 2.0)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        out = [block(x) for block in self._children.values()]
+        return invoke("concat", out, {"dim": self.axis})
+
+
+class HybridConcatenate(HybridSequential):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        out = [block(x) for block in self._children.values()]
+        return invoke("concat", out, {"dim": self.axis})
+
+
+from .activations import Activation  # noqa: E402  (cycle-free tail import)

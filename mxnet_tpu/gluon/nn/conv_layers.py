@@ -1,0 +1,300 @@
+"""Convolution and pooling layers (reference
+``python/mxnet/gluon/nn/conv_layers.py``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...ndarray.ndarray import invoke
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .activations import Activation
+
+__all__ = [
+    "Conv1D", "Conv2D", "Conv3D",
+    "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
+    "MaxPool1D", "MaxPool2D", "MaxPool3D",
+    "AvgPool1D", "AvgPool2D", "AvgPool3D",
+    "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
+    "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D",
+    "ReflectionPad2D",
+]
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+class _Conv(HybridBlock):
+    """Shared conv implementation (reference conv_layers.py:42 _Conv)."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="Convolution", adj=None, dtype="float32"):
+        super().__init__()
+        from ... import initializer as init
+
+        self._channels = channels
+        self._in_channels = in_channels
+        nsp = len(kernel_size)
+        self._kwargs = {
+            "kernel": kernel_size,
+            "stride": strides,
+            "dilate": dilation,
+            "pad": padding,
+            "num_filter": channels,
+            "num_group": groups,
+            "no_bias": not use_bias,
+            "layout": layout,
+        }
+        if adj is not None:
+            self._kwargs["adj"] = adj
+        self._op_name = op_name
+        self._layout = layout
+        self._nsp = nsp
+        self._groups = groups
+        self._use_bias = use_bias
+
+        wshape = self._weight_shape(in_channels)
+        self.weight = Parameter("weight", shape=wshape, dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        if use_bias:
+            self.bias = Parameter("bias", shape=(channels,), dtype=dtype,
+                                  init=init.create(bias_initializer),
+                                  allow_deferred_init=True)
+        else:
+            self.bias = None
+        self.act = Activation(activation) if activation else None
+        if self.act is not None:
+            self.register_child(self.act, "act")
+
+    def _weight_shape(self, in_channels):
+        kernel = self._kwargs["kernel"]
+        if self._op_name == "Convolution":
+            if self._layout.index("C") == 1:
+                return (self._channels, in_channels // self._groups) + tuple(kernel)
+            return (self._channels,) + tuple(kernel) + (in_channels // self._groups,)
+        # Deconvolution: weight is (in_channels, channels//groups, *kernel)
+        if self._layout.index("C") == 1:
+            return (in_channels, self._channels // self._groups) + tuple(kernel)
+        return (in_channels,) + tuple(kernel) + (self._channels // self._groups,)
+
+    def infer_shape(self, x):
+        c_axis = self._layout.index("C")
+        in_c = int(x.shape[c_axis])
+        self.weight.shape = self._weight_shape(in_c)
+        self._in_channels = in_c
+
+    def forward(self, x):
+        args = [x, self.weight.data(x.ctx)]
+        if self._use_bias:
+            args.append(self.bias.data(x.ctx))
+        out = invoke(self._op_name, args, dict(self._kwargs))
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._in_channels} -> "
+                f"{self._channels}, kernel_size={self._kwargs['kernel']}, "
+                f"stride={self._kwargs['stride']})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 1), _tuple(strides, 1),
+                         _tuple(padding, 1), _tuple(dilation, 1), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 2), _tuple(strides, 2),
+                         _tuple(padding, 2), _tuple(dilation, 2), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 3), _tuple(strides, 3),
+                         _tuple(padding, 3), _tuple(dilation, 3), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 1), _tuple(strides, 1),
+                         _tuple(padding, 1), _tuple(dilation, 1), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="Deconvolution",
+                         adj=_tuple(output_padding, 1), **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 2), _tuple(strides, 2),
+                         _tuple(padding, 2), _tuple(dilation, 2), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="Deconvolution",
+                         adj=_tuple(output_padding, 2), **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 3), _tuple(strides, 3),
+                         _tuple(padding, 3), _tuple(dilation, 3), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="Deconvolution",
+                         adj=_tuple(output_padding, 3), **kwargs)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, layout, count_include_pad=None):
+        super().__init__()
+        if strides is None:
+            strides = pool_size
+        self._kwargs = {
+            "kernel": pool_size,
+            "stride": strides,
+            "pad": padding,
+            "global_pool": global_pool,
+            "pool_type": pool_type,
+            "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout,
+        }
+        if count_include_pad is not None:
+            self._kwargs["count_include_pad"] = count_include_pad
+
+    def forward(self, x):
+        return invoke("Pooling", [x], dict(self._kwargs))
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(size={self._kwargs['kernel']}, "
+                f"stride={self._kwargs['stride']}, "
+                f"padding={self._kwargs['pad']})")
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False):
+        super().__init__(_tuple(pool_size, 1),
+                         _tuple(strides, 1) if strides is not None else None,
+                         _tuple(padding, 1), ceil_mode, False, "max", layout)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False):
+        super().__init__(_tuple(pool_size, 2),
+                         _tuple(strides, 2) if strides is not None else None,
+                         _tuple(padding, 2), ceil_mode, False, "max", layout)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False):
+        super().__init__(_tuple(pool_size, 3),
+                         _tuple(strides, 3) if strides is not None else None,
+                         _tuple(padding, 3), ceil_mode, False, "max", layout)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True):
+        super().__init__(_tuple(pool_size, 1),
+                         _tuple(strides, 1) if strides is not None else None,
+                         _tuple(padding, 1), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True):
+        super().__init__(_tuple(pool_size, 2),
+                         _tuple(strides, 2) if strides is not None else None,
+                         _tuple(padding, 2), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True):
+        super().__init__(_tuple(pool_size, 3),
+                         _tuple(strides, 3) if strides is not None else None,
+                         _tuple(padding, 3), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW"):
+        super().__init__((1,), None, (0,), False, True, "max", layout)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW"):
+        super().__init__((1, 1), None, (0, 0), False, True, "max", layout)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW"):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max", layout)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW"):
+        super().__init__((1,), None, (0,), False, True, "avg", layout)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW"):
+        super().__init__((1, 1), None, (0, 0), False, True, "avg", layout)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW"):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg", layout)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reference conv_layers.py ReflectionPad2D → pad op mode='reflect'."""
+
+    def __init__(self, padding=0):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        self._padding = tuple(padding)
+
+    def forward(self, x):
+        return invoke("pad", [x],
+                      {"mode": "reflect", "pad_width": self._padding})

@@ -16,7 +16,17 @@ import jax
 __all__ = ["seed", "next_key", "current_key"]
 
 _lock = threading.Lock()
-_KEY = jax.random.PRNGKey(0)
+_KEY = None  # lazily created: touching the backend at import time would
+#              initialize devices before the user can configure platforms
+
+
+class _TraceState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.stack = []
+
+
+_TRACE = _TraceState()
 
 
 def seed(seed_state: int, ctx=None):
@@ -26,12 +36,37 @@ def seed(seed_state: int, ctx=None):
         _KEY = jax.random.PRNGKey(int(seed_state))
 
 
+def push_trace_key(key):
+    """Enter traced-RNG mode: while active, ``next_key`` splits from ``key``
+    (a tracer) instead of the global concrete chain, so hybridized graphs
+    stay pure and get fresh randomness per call via the key argument."""
+    _TRACE.stack.append(key)
+
+
+def pop_trace_key():
+    _TRACE.stack.pop()
+
+
+def in_trace() -> bool:
+    return bool(_TRACE.stack)
+
+
 def next_key():
+    if _TRACE.stack:
+        k, sub = jax.random.split(_TRACE.stack[-1])
+        _TRACE.stack[-1] = k
+        return sub
     global _KEY
     with _lock:
+        if _KEY is None:
+            _KEY = jax.random.PRNGKey(0)
         _KEY, sub = jax.random.split(_KEY)
         return sub
 
 
 def current_key():
+    global _KEY
+    with _lock:
+        if _KEY is None:
+            _KEY = jax.random.PRNGKey(0)
     return _KEY

@@ -20,6 +20,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The axon TPU-tunnel sitecustomize (if present) re-registers platforms and
+# can override the env var; forcing the config is authoritative and keeps
+# the unit suite on the virtual 8-device CPU mesh even when the tunnel is
+# down.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import hashlib
 
 import numpy as onp

@@ -1,0 +1,333 @@
+"""Gluon Block/Parameter/layer tests.
+
+Mirrors reference tests/python/unittest/test_gluon.py coverage for the core
+layer zoo, parameter lifecycle, hybridization equivalence, and save/load.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_lifecycle():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.init.One())
+    assert p.data().shape == (3, 4)
+    assert onp.allclose(p.data().asnumpy(), 1.0)
+    assert p.grad().shape == (3, 4)
+    p.set_data(mx.nd.zeros((3, 4)))
+    assert onp.allclose(p.data().asnumpy(), 0.0)
+    p.zero_grad()
+    assert onp.allclose(p.grad().asnumpy(), 0.0)
+
+
+def test_parameter_deferred_init():
+    d = nn.Dense(5)
+    d.initialize()
+    with pytest.raises(Exception):
+        d.weight.data()
+    x = mx.nd.ones((2, 7))
+    out = d(x)
+    assert out.shape == (2, 5)
+    assert d.weight.shape == (5, 7)
+
+
+def test_parameter_grad_req_null():
+    p = gluon.Parameter("weight", shape=(2,), grad_req="null")
+    p.initialize()
+    with pytest.raises(RuntimeError):
+        p.grad()
+
+
+def test_dense_forward_matches_numpy():
+    d = nn.Dense(4, use_bias=True, in_units=3)
+    d.initialize(init=mx.init.Normal(0.1))
+    x = mx.nd.array(onp.random.randn(2, 3).astype("float32"))
+    out = d(x).asnumpy()
+    w = d.weight.data().asnumpy()
+    b = d.bias.data().asnumpy()
+    expected = x.asnumpy() @ w.T + b
+    assert onp.allclose(out, expected, atol=1e-5)
+
+
+def test_dense_no_flatten():
+    d = nn.Dense(4, flatten=False)
+    d.initialize()
+    x = mx.nd.ones((2, 5, 3))
+    assert d(x).shape == (2, 5, 4)
+
+
+def test_conv2d_shapes():
+    c = nn.Conv2D(16, kernel_size=3, strides=2, padding=1)
+    c.initialize()
+    x = mx.nd.ones((2, 3, 8, 8))
+    out = c(x)
+    assert out.shape == (2, 16, 4, 4)
+    assert c.weight.shape == (16, 3, 3, 3)
+
+
+def test_conv_groups():
+    c = nn.Conv2D(8, kernel_size=1, groups=2, in_channels=4)
+    c.initialize()
+    x = mx.nd.ones((1, 4, 5, 5))
+    assert c(x).shape == (1, 8, 5, 5)
+    assert c.weight.shape == (8, 2, 1, 1)
+
+
+def test_conv_transpose():
+    c = nn.Conv2DTranspose(4, kernel_size=2, strides=2, in_channels=3)
+    c.initialize()
+    x = mx.nd.ones((1, 3, 4, 4))
+    assert c(x).shape == (1, 4, 8, 8)
+
+
+def test_pooling_layers():
+    x = mx.nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    assert nn.MaxPool2D(2)(x).shape == (1, 1, 2, 2)
+    assert nn.AvgPool2D(2)(x).shape == (1, 1, 2, 2)
+    assert nn.GlobalAvgPool2D()(x).shape == (1, 1, 1, 1)
+    assert float(nn.GlobalMaxPool2D()(x).asnumpy().ravel()[0]) == 15.0
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = mx.nd.array(onp.random.randn(8, 3, 4, 4).astype("float32") * 3 + 1)
+    with mx.autograd.record():
+        out_train = bn(x)
+    m = out_train.asnumpy().mean(axis=(0, 2, 3))
+    assert onp.allclose(m, 0.0, atol=1e-3)
+    # running stats moved toward batch stats
+    assert not onp.allclose(bn.running_mean.data().asnumpy(), 0.0)
+    out_eval = bn(x)
+    assert not onp.allclose(out_eval.asnumpy(), out_train.asnumpy(), atol=1e-3)
+
+
+def test_dropout_train_eval():
+    do = nn.Dropout(0.5)
+    x = mx.nd.ones((100, 100))
+    out_eval = do(x)
+    assert onp.allclose(out_eval.asnumpy(), 1.0)
+    with mx.autograd.record():
+        out_train = do(x)
+    a = out_train.asnumpy()
+    assert (a == 0).mean() > 0.3
+    assert abs(a.mean() - 1.0) < 0.1
+
+
+def test_layernorm_groupnorm():
+    x = mx.nd.array(onp.random.randn(2, 6, 5).astype("float32"))
+    ln = nn.LayerNorm()
+    ln.initialize()
+    out = ln(x).asnumpy()
+    assert onp.allclose(out.mean(-1), 0, atol=1e-4)
+    gn = nn.GroupNorm(num_groups=3)
+    gn.initialize()
+    assert gn(x).shape == x.shape
+
+
+def test_embedding():
+    e = nn.Embedding(10, 4)
+    e.initialize()
+    idx = mx.nd.array(onp.array([[1, 2], [3, 4]]), dtype="int32")
+    out = e(idx)
+    assert out.shape == (2, 2, 4)
+    w = e.weight.data().asnumpy()
+    assert onp.allclose(out.asnumpy()[0, 0], w[1])
+
+
+def test_embedding_grad():
+    e = nn.Embedding(10, 4)
+    e.initialize()
+    idx = mx.nd.array(onp.array([1, 1, 2]), dtype="int32")
+    with mx.autograd.record():
+        out = e(idx).sum()
+    out.backward()
+    g = e.weight.grad().asnumpy()
+    assert onp.allclose(g[1], 2.0)  # row 1 hit twice -> scatter-add
+    assert onp.allclose(g[2], 1.0)
+    assert onp.allclose(g[0], 0.0)
+
+
+def test_sequential_and_getitem():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    net.initialize()
+    assert net(mx.nd.ones((1, 5))).shape == (1, 2)
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+            nn.Flatten(), nn.Dense(6))
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(2, 3, 5, 5).astype("float32"))
+    out_eager = net(x).asnumpy()  # eval mode: BN uses running stats
+    net.hybridize()
+    out_hybrid = net(x).asnumpy()
+    assert onp.allclose(out_eager, out_hybrid, atol=1e-5)
+
+
+def test_hybridize_grad_matches_eager():
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu", in_units=4), nn.Dense(3, in_units=8))
+        return net
+
+    net = build()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.randn(5, 4).astype("float32"))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_eager = net[0].weight.grad().asnumpy().copy()
+
+    net.hybridize()
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_hybrid = net[0].weight.grad().asnumpy()
+    assert onp.allclose(g_eager, g_hybrid, atol=1e-4)
+
+
+def test_hybrid_batchnorm_updates_running_stats():
+    bn = nn.BatchNorm(in_channels=2)
+    bn.initialize()
+    bn.hybridize()
+    x = mx.nd.array(onp.random.randn(4, 2, 3, 3).astype("float32") * 2 + 5)
+    with mx.autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not onp.allclose(rm, 0.0)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize(mx.init.Xavier())
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(f)
+    x = mx.nd.ones((1, 3))
+    assert onp.allclose(net(x).asnumpy(), net2(x).asnumpy(), atol=1e-6)
+
+
+def test_load_missing_raises(tmp_path):
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    f = str(tmp_path / "d.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    with pytest.raises(AssertionError):
+        net2.load_parameters(f)
+
+
+def test_share_parameters():
+    a = nn.Dense(4, in_units=3)
+    a.initialize()
+    b = nn.Dense(4, in_units=3)
+    b.share_parameters(a.collect_params())
+    x = mx.nd.ones((1, 3))
+    assert onp.allclose(a(x).asnumpy(), b(x).asnumpy())
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.BatchNorm())
+    params = net.collect_params(".*weight|.*bias")
+    assert all("gamma" not in k and "running" not in k for k in params)
+
+
+def test_activations():
+    x = mx.nd.array(onp.array([-1.0, 0.0, 2.0], dtype="float32"))
+    assert onp.allclose(nn.Activation("relu")(x).asnumpy(), [0, 0, 2])
+    lrelu = nn.LeakyReLU(0.1)(x).asnumpy()
+    assert onp.allclose(lrelu, [-0.1, 0, 2], atol=1e-6)
+    prelu = nn.PReLU()
+    prelu.initialize()
+    assert onp.allclose(prelu(x).asnumpy(), [-0.25, 0, 2], atol=1e-6)
+    elu = nn.ELU(1.0)(x).asnumpy()
+    assert onp.allclose(elu[0], onp.expm1(-1.0), atol=1e-5)
+    sw = nn.Swish()(x).asnumpy()
+    assert onp.allclose(sw, x.asnumpy() / (1 + onp.exp(-x.asnumpy())), atol=1e-5)
+
+
+def test_losses_basic():
+    pred = mx.nd.array(onp.random.randn(4, 5).astype("float32"))
+    label = mx.nd.array(onp.array([0, 1, 2, 3]))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    # manual
+    p = pred.asnumpy()
+    logp = p - onp.log(onp.exp(p - p.max(-1, keepdims=True)).sum(-1, keepdims=True)) - p.max(-1, keepdims=True)
+    expected = -logp[onp.arange(4), label.asnumpy().astype(int)]
+    assert onp.allclose(l.asnumpy(), expected, atol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(pred, mx.nd.zeros((4, 5)))
+    assert onp.allclose(l2.asnumpy(), 0.5 * (p ** 2).mean(-1), atol=1e-5)
+
+    l1 = gluon.loss.L1Loss()(pred, mx.nd.zeros((4, 5)))
+    assert onp.allclose(l1.asnumpy(), onp.abs(p).mean(-1), atol=1e-5)
+
+
+def test_sigmoid_bce_loss():
+    pred = mx.nd.array(onp.random.randn(3, 4).astype("float32"))
+    label = mx.nd.array((onp.random.rand(3, 4) > 0.5).astype("float32"))
+    loss = gluon.loss.SigmoidBCELoss()(pred, label).asnumpy()
+    p = pred.asnumpy()
+    lab = label.asnumpy()
+    expected = (onp.maximum(p, 0) - p * lab + onp.log1p(onp.exp(-onp.abs(p)))).mean(-1)
+    assert onp.allclose(loss, expected, atol=1e-5)
+
+
+def test_huber_hinge_losses():
+    pred = mx.nd.array(onp.array([[0.5], [2.0]], dtype="float32"))
+    label = mx.nd.array(onp.array([[0.0], [0.0]], dtype="float32"))
+    h = gluon.loss.HuberLoss()(pred, label).asnumpy()
+    assert onp.allclose(h, [0.5 * 0.25, 1.5], atol=1e-5)
+    hinge = gluon.loss.HingeLoss()(pred, mx.nd.array([[1.0], [1.0]])).asnumpy()
+    assert onp.allclose(hinge, [0.5, 0.0], atol=1e-5)
+
+
+def test_kl_div_loss():
+    pred = mx.nd.array(onp.log(onp.array([[0.3, 0.7]], dtype="float32")))
+    label = mx.nd.array(onp.array([[0.3, 0.7]], dtype="float32"))
+    l = gluon.loss.KLDivLoss()(pred, label).asnumpy()
+    assert onp.allclose(l, 0.0, atol=1e-5)
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    repr(net)
+    net.summary(mx.nd.ones((1, 3)))
+    out = capsys.readouterr().out
+    assert "Dense" in out
+
+
+def test_forward_hooks():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    calls = []
+    h = net.register_forward_hook(lambda blk, inp, out: calls.append(1))
+    net(mx.nd.ones((1, 2)))
+    assert calls == [1]
+    h.detach()
+    net(mx.nd.ones((1, 2)))
+    assert calls == [1]
+
+
+def test_cast():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.data().dtype == onp.float16

@@ -1,0 +1,247 @@
+"""Gluon Trainer (reference ``python/mxnet/gluon/trainer.py``, 541 LoC).
+
+Applies an Optimizer to a set of Parameters, synchronizing gradients through
+a KVStore.  Call stack mirrors the reference (SURVEY.md §3.3):
+``step() → _allreduce_grads() → _update()``.  On TPU the per-key reduce is a
+fused XLA computation; for mesh-sharded data-parallel training the same
+Trainer drives the ``mxnet_tpu.parallel`` compiled step where the reduce is
+``lax.psum`` over ICI.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from .. import kvstore as kvs
+from .. import optimizer as opt
+from ..ndarray import NDArray
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        self._param_dict = {}
+        if isinstance(params, (dict,)):
+            for key in sorted(list(params.keys())):
+                self._param_dict[key] = params[key]
+            params = [params[k] for k in sorted(params.keys())]
+        elif not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}."
+            )
+        self._params: List[Parameter] = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}."
+                )
+            if param.grad_req != "null":
+                self._param2idx[id(param)] = i
+                self._params.append(param)
+                param._trainer = self
+        self._compression_params = compression_params
+        self._contexts = self._check_contexts()
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_params = {
+            "kvstore": kvstore,
+            "update_on_kvstore": update_on_kvstore,
+        }
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    # -- setup -----------------------------------------------------------
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data or param._deferred_init else None
+            if ctx is None:
+                continue
+            assert contexts is None or contexts == ctx, (
+                f"All Parameters must be initialized on the same set of "
+                f"contexts, but Parameter {param.name} is initialized on "
+                f"{ctx} while previous Parameters are initialized on "
+                f"{contexts}."
+            )
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, (
+                "optimizer_params must be None if optimizer is an Optimizer "
+                "instance"
+            )
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts] or [
+            opt.get_updater(self._optimizer)
+        ]
+
+    def _reset_kvstore(self):
+        if self._kvstore and "dist" in self._kvstore.type:
+            raise RuntimeError(
+                "Cannot reset distributed KVStore."
+            )
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = [param for param in self._params]
+
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        if kvstore is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kv = kvs.create(kvstore) if isinstance(kvstore, str) else kvstore
+            self._kvstore = kv
+            if update_on_kvstore is None:
+                # server-side update only for dist stores with optimizer
+                # capability (reference trainer.py:188-275 decision table)
+                update_on_kvstore = ("dist" in kv.type) and kv.is_capable(
+                    kvs.KVStoreBase.OPTIMIZER)
+            if update_on_kvstore and not kv.is_capable(
+                    kvs.KVStoreBase.OPTIMIZER):
+                raise ValueError(
+                    f"kvstore '{kv.type}' does not support optimizer updates"
+                )
+            self._update_on_kvstore = update_on_kvstore
+            if update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    def _init_params(self):
+        assert self._kv_initialized
+        params_to_init = []
+        for param in self._params_to_init:
+            if param._deferred_init:
+                params_to_init.append(param)
+            elif self._kvstore is not None:
+                idx = self._param2idx[id(param)]
+                self._kvstore.init(idx, param.data(param.list_ctx()[0]))
+        self._params_to_init = params_to_init
+
+    # -- properties ------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- the step --------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Normalize by batch_size, all-reduce grads, apply updates
+        (reference trainer.py:334)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Reduce gradients over devices without updating (for gradient
+        accumulation / manual update flows, reference trainer.py:417)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), (
+            "allreduce_grads() when parameters are updated on kvstore is not "
+            "supported. Try setting `update_on_kvstore` to False when "
+            "creating trainer."
+        )
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for param in self._params:
+            if param.grad_req == "null":
+                continue
+            idx = self._param2idx[id(param)]
+            grads = param.list_grad()
+            if self._update_on_kvstore:
+                # push grads; server updates weight; pull new weight back
+                self._kvstore.pushpull(idx, grads, out=param.list_data())
+            elif len(grads) > 1 or self._kvstore.num_workers > 1:
+                self._kvstore.pushpull(idx, grads, out=grads)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore and self._kvstore is not None:
+            return  # weights already updated server-side in _allreduce_grads
+        for param in self._params:
+            if param.grad_req == "null":
+                continue
+            idx = self._param2idx[id(param)]
+            for updater, weight, grad in zip(
+                    self._updaters, param.list_data(), param.list_grad()):
+                updater(idx, grad, weight)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Apply updates assuming grads were already reduced (reference
+        trainer.py:444)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), (
+            "update() when parameters are updated on kvstore is not "
+            "supported."
+        )
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    # -- states ----------------------------------------------------------
+    def save_states(self, fname):
+        """Save optimizer/updater states (reference trainer.py:482)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        """Load optimizer/updater states (reference trainer.py:501)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._optimizer

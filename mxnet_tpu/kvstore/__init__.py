@@ -1,0 +1,37 @@
+"""KVStore: distributed key-value parameter synchronization.
+
+Reference ``src/kvstore/`` + ``python/mxnet/kvstore/``.  Factory semantics
+mirror ``KVStore::Create`` (src/kvstore/kvstore.cc:42-80): string type picks
+the backend.  TPU mapping:
+
+- 'local'/'device' → single-process replica reduce (CommCPU/CommDevice analog)
+- 'tpu'/'nccl'     → same API, collectives ride ICI; on multi-controller
+  launches the reduce crosses DCN (NCCL/ps-lite analog)
+- 'dist_sync'/'dist_device_sync'/'dist_async' → multi-controller 'tpu'
+  (synchronous; async parameter-server semantics collapse to sync on TPU's
+  SPMD model)
+- 'horovod'/'byteps' → adapters (require those packages)
+"""
+from .base import KVStoreBase
+from .kvstore import KVStore
+from . import horovod as _adapters  # registers Horovod/BytePS
+
+__all__ = ["KVStoreBase", "KVStore", "create"]
+
+
+def create(name="local"):
+    """Create a KVStore by type string (reference kvstore.py:743 create /
+    KVStore::Create kvstore.cc:42)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    lname = name.lower()
+    if lname in ("local", "device", "tpu", "nccl", "local_allreduce_cpu",
+                 "local_allreduce_device"):
+        return KVStore("tpu" if lname in ("tpu", "nccl") else lname)
+    if lname.startswith("dist") or lname.startswith("p3"):
+        # dist_sync / dist_async / dist_device_sync / p3store variants:
+        # multi-controller synchronous collectives over DCN
+        return KVStore("dist_sync")
+    if lname in KVStoreBase.kv_registry:
+        return KVStoreBase.kv_registry[lname]()
+    raise ValueError(f"unknown KVStore type {name}")

@@ -1,0 +1,69 @@
+"""KVStore base + plugin registry (reference
+``python/mxnet/kvstore/base.py:74-245``)."""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["KVStoreBase"]
+
+
+class KVStoreBase:
+    """Key-value store interface for parameter synchronization.
+
+    Backends register by name (``KVStoreBase.register``), mirroring the
+    reference's plugin registry that lets Horovod/BytePS slot in beside the
+    native stores.
+    """
+
+    kv_registry: Dict[str, type] = {}
+
+    OPTIMIZER = "optimizer"
+
+    # -- interface -------------------------------------------------------
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability):
+        raise NotImplementedError
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+    @property
+    def type(self):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        raise NotImplementedError
+
+    @property
+    def num_workers(self):
+        raise NotImplementedError
+
+    # -- registry --------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        if name in KVStoreBase.kv_registry:
+            import logging
+
+            logging.warning("KVStore %s overridden", name)
+        KVStoreBase.kv_registry[name] = klass
+        return klass

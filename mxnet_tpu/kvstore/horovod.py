@@ -1,0 +1,105 @@
+"""Horovod KVStore adapter (reference ``python/mxnet/kvstore/horovod.py``).
+
+Kept for API parity: maps broadcast→hvd.broadcast, pushpull→hvd.allreduce.
+On TPU pods the native 'tpu' store (XLA collectives over ICI/DCN) is the
+recommended backend; this adapter requires a horovod install with an
+alltoall-capable backend.
+"""
+from __future__ import annotations
+
+from .base import KVStoreBase
+
+__all__ = ["Horovod"]
+
+
+@KVStoreBase.register
+class Horovod(KVStoreBase):
+    def __init__(self):
+        try:
+            import horovod.mxnet as hvd  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "kvstore='horovod' requires the horovod package; on TPU use "
+                "kvstore='tpu' (XLA collectives) instead"
+            ) from e
+        import horovod.mxnet as hvd
+
+        self._hvd = hvd
+        hvd.init()
+
+    @property
+    def type(self):
+        return "horovod"
+
+    @property
+    def rank(self):
+        return self._hvd.rank()
+
+    @property
+    def num_workers(self):
+        return self._hvd.size()
+
+    @staticmethod
+    def is_capable(capability):
+        return False  # no server-side optimizer
+
+    def broadcast(self, key, value, out, priority=0):
+        value = self._hvd.broadcast(value, root_rank=0, name=str(key))
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            value.copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        summed = self._hvd.allreduce(value, average=False, name=str(key))
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                summed.copyto(o)
+
+
+@KVStoreBase.register
+class BytePS(KVStoreBase):
+    """BytePS adapter (reference ``python/mxnet/kvstore/byteps.py``)."""
+
+    def __init__(self):
+        try:
+            import byteps.mxnet as bps  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "kvstore='byteps' requires the byteps package; on TPU use "
+                "kvstore='tpu' (XLA collectives) instead"
+            ) from e
+        import byteps.mxnet as bps
+
+        self._bps = bps
+        bps.init()
+
+    @property
+    def type(self):
+        return "byteps"
+
+    @property
+    def rank(self):
+        return self._bps.rank()
+
+    @property
+    def num_workers(self):
+        return self._bps.size()
+
+    @staticmethod
+    def is_capable(capability):
+        return False
+
+    def broadcast(self, key, value, out, priority=0):
+        self._bps.byteps_declare_tensor(str(key))
+        self._bps.byteps_push_pull(value, name=str(key), is_average=False)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            value.copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self._bps.byteps_push_pull(value, name=str(key), is_average=False)
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                value.copyto(o)

@@ -1,0 +1,181 @@
+"""Single-process KVStore: 'local' / 'device' / 'tpu'.
+
+TPU-native re-design of the reference comm stack (SURVEY.md §2.3):
+
+- ``KVStoreLocal`` + ``CommCPU``/``CommDevice`` (``src/kvstore/kvstore_local.h``,
+  ``src/kvstore/comm.h``): per-key reduce over device replicas + broadcast
+  back.  Here the reduce is one XLA computation (``add_n``) per key — XLA
+  owns the scheduling that the reference's dependency engine provided.
+- ``KVStoreNCCL``/``CommDeviceTree``: topology-aware collectives.  On TPU the
+  analog is ICI all-reduce; for the eager per-key path this store computes
+  the reduction on-device, while the *sharded* training path
+  (``mxnet_tpu.parallel``) folds the same all-reduce into the compiled step
+  as ``lax.psum`` riding ICI — that path replaces NCCL rings entirely.
+- ``KVStoreDist`` (ps-lite parameter server): multi-host sync is an XLA
+  collective over DCN in the sharded path; the eager path cross-process
+  reduces via jax multihost allgather when launched multi-controller
+  (``mxnet_tpu.kvstore.launch`` analog of tools/launch.py).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _wrap
+from .base import KVStoreBase
+
+__all__ = ["KVStore"]
+
+
+@KVStoreBase.register
+class KVStore(KVStoreBase):
+    """Single-controller store over the local devices ('local'/'device'/'tpu'
+    all resolve here; 'tpu' additionally cross-process reduces when run
+    multi-controller)."""
+
+    def __init__(self, kv_type: str = "local"):
+        self._type = kv_type
+        self._data: Dict[str, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._barrier_count = 0
+
+    # -- identity --------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return jax.process_count()
+
+    @staticmethod
+    def is_capable(capability):
+        if capability.lower() == KVStoreBase.OPTIMIZER:
+            return True
+        return False
+
+    # -- init / push / pull ---------------------------------------------
+    def _str_key(self, key):
+        return str(key)
+
+    def init(self, key, value):
+        """Initialize (key, value) pairs (reference kvstore.py init)."""
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            self._data[k] = v[0].copy()
+
+    def _normalize(self, key, value):
+        if isinstance(key, (list, tuple)):
+            keys = [self._str_key(k) for k in key]
+            values = [v if isinstance(v, (list, tuple)) else [v] for v in value]
+        else:
+            keys = [self._str_key(key)]
+            values = [value if isinstance(value, (list, tuple)) else [value]]
+        return keys, values
+
+    def broadcast(self, key, value, out, priority=0):
+        """Init + pull in one call (reference base.py broadcast)."""
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._data:
+                self._data[k] = v[0].copy()
+        self.pull(key, out=out, priority=priority)
+
+    def _reduce(self, value_list: List[NDArray]) -> jnp.ndarray:
+        """Sum replicas — one fused XLA computation (CommDevice::Reduce
+        analog, comm.h:504)."""
+        if len(value_list) == 1:
+            merged = value_list[0]._data
+        else:
+            merged = value_list[0]._data
+            for v in value_list[1:]:
+                merged = merged + jax.device_put(v._data, merged.devices().pop())
+        if self._type.startswith("dist") or (
+            self._type == "tpu" and jax.process_count() > 1
+        ):
+            # cross-process sum over DCN (KVStoreDist analog)
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(merged)
+            merged = jnp.sum(gathered, axis=0)
+        return merged
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            merged = self._reduce(v)
+            if self._updater is not None:
+                if k not in self._data:
+                    self._data[k] = _wrap(jnp.zeros_like(merged), v[0].ctx)
+                self._updater(_key_int(k), _wrap(merged, v[0].ctx), self._data[k])
+            else:
+                self._data[k] = _wrap(merged, v[0].ctx)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, _ = self._normalize(key, out)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if isinstance(key, (list, tuple)):
+            grouped = outs
+        else:
+            grouped = [outs]
+        for k, group in zip(keys, grouped):
+            if k not in self._data:
+                raise KeyError(f"key {k} has not been initialized in KVStore")
+            src = self._data[k]
+            dsts = group if isinstance(group, (list, tuple)) else [group]
+            for d in dsts:
+                d._set_data(
+                    jax.device_put(src._data, d._data.devices().pop()).astype(
+                        d._data.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (reference KVStoreLocal::PushPullImpl,
+        kvstore_local.h:358)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    # -- server-side optimizer ------------------------------------------
+    def set_optimizer(self, optimizer):
+        from ..optimizer import Updater
+
+        self._optimizer = optimizer
+        self._updater = Updater(optimizer)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "There is no optimizer in the store"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "There is no optimizer in the store"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- misc ------------------------------------------------------------
+    def barrier(self):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"mxnet_tpu_kvstore_barrier_{self._barrier_count}")
+            self._barrier_count += 1
+
+
+def _key_int(k: str):
+    try:
+        return int(k)
+    except ValueError:
+        return k

@@ -1,0 +1,14 @@
+"""Optimizer API (reference ``python/mxnet/optimizer/``)."""
+from .optimizer import (Optimizer, Test, Updater, create, get_updater,
+                        register)
+from .sgd import SGD, NAG, SGLD, Signum, DCASGD, LARS
+from .adam import Adam, AdaMax, Nadam, FTML, Ftrl, AdamW
+from .adagrad import AdaGrad, AdaDelta, RMSProp
+from .lamb import LAMB
+
+__all__ = [
+    "Optimizer", "Test", "Updater", "create", "get_updater", "register",
+    "SGD", "NAG", "SGLD", "Signum", "DCASGD", "LARS",
+    "Adam", "AdaMax", "Nadam", "FTML", "Ftrl", "AdamW",
+    "AdaGrad", "AdaDelta", "RMSProp", "LAMB",
+]

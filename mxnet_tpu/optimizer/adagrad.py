@@ -1,0 +1,108 @@
+"""AdaGrad / AdaDelta / RMSProp (reference ``python/mxnet/optimizer/{adagrad,
+adadelta,rmsprop}.py``)."""
+from __future__ import annotations
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import invoke
+from .optimizer import Optimizer, register
+
+__all__ = ["AdaGrad", "AdaDelta", "RMSProp"]
+
+
+def _clip(v):
+    return -1.0 if v is None else v
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer/adagrad.py; op adagrad_update)."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, use_fused_step=True,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for weight, grad, state, lr, wd in zip(weights, grads, states, lrs, wds):
+            invoke("adagrad_update", [weight, grad, state],
+                   {"lr": lr, "epsilon": self.epsilon, "wd": wd,
+                    "rescale_grad": self.rescale_grad,
+                    "clip_gradient": _clip(self.clip_gradient)},
+                   out=[weight, state])
+
+    step = fused_step
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer/adadelta.py; op adadelta_update)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, use_fused_step=True, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+        self.use_fused_step = use_fused_step
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+
+    def fused_step(self, indices, weights, grads, states):
+        wds = self._get_wds(indices)
+        for weight, grad, state, wd in zip(weights, grads, states, wds):
+            acc_g, acc_delta = state
+            invoke("adadelta_update", [weight, grad, acc_g, acc_delta],
+                   {"rho": self.rho, "epsilon": self.epsilon, "wd": wd,
+                    "rescale_grad": self.rescale_grad,
+                    "clip_gradient": _clip(self.clip_gradient)},
+                   out=[weight, acc_g, acc_delta])
+
+    step = fused_step
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, plain and centered (reference optimizer/rmsprop.py; ops
+    rmsprop_update / rmspropalex_update)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None,
+                 use_fused_step=True, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.rho = rho
+        self.momentum = momentum
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),  # n
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),  # g
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))  # delta
+        return nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype)  # n
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for weight, grad, state, lr, wd in zip(weights, grads, states, lrs, wds):
+            attrs = {"lr": lr, "rho": self.rho, "epsilon": self.epsilon,
+                     "wd": wd, "rescale_grad": self.rescale_grad,
+                     "clip_gradient": _clip(self.clip_gradient),
+                     "clip_weights": _clip(self.clip_weights)}
+            if not self.centered:
+                invoke("rmsprop_update", [weight, grad, state], attrs,
+                       out=[weight, state])
+            else:
+                n, g, delta = state
+                attrs["momentum"] = self.momentum
+                invoke("rmspropalex_update", [weight, grad, n, g, delta],
+                       attrs, out=[weight, n, g, delta])
+
+    step = fused_step

@@ -1,0 +1,245 @@
+"""Adam-family optimizers (reference ``python/mxnet/optimizer/{adam,adamax,
+nadam,ftml,ftrl,adamW}.py``)."""
+from __future__ import annotations
+
+import math
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import invoke
+from .optimizer import Optimizer, register
+
+__all__ = ["Adam", "AdaMax", "Nadam", "FTML", "Ftrl", "AdamW"]
+
+
+def _clip(v):
+    return -1.0 if v is None else v
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer/adam.py; fused op adam_update,
+    src/operator/optimizer_op.cc:649)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, use_fused_step=True,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),  # mean
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))  # var
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for index, weight, grad, state, lr, wd in zip(
+                indices, weights, grads, states, lrs, wds):
+            t = self._index_update_count[index]
+            coef1 = 1.0 - self.beta1 ** t
+            coef2 = 1.0 - self.beta2 ** t
+            lr_t = lr * math.sqrt(coef2) / coef1
+            mean, var = state
+            invoke("adam_update", [weight, grad, mean, var],
+                   {"lr": lr_t, "beta1": self.beta1, "beta2": self.beta2,
+                    "epsilon": self.epsilon, "wd": wd,
+                    "rescale_grad": self.rescale_grad,
+                    "clip_gradient": _clip(self.clip_gradient)},
+                   out=[weight, mean, var])
+
+    step = fused_step
+
+
+@register
+class AdaMax(Optimizer):
+    """AdaMax (reference optimizer/adamax.py)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 use_fused_step=False, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+
+    def step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for index, weight, grad, state, lr, wd in zip(
+                indices, weights, grads, states, lrs, wds):
+            t = self._index_update_count[index]
+            lr_t = lr / (1.0 - self.beta1 ** t)
+            g = grad * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = g.clip(-self.clip_gradient, self.clip_gradient)
+            g = g + wd * weight
+            import jax.numpy as jnp
+
+            mean, inf_norm = state
+            mean._set_data((self.beta1 * mean + (1 - self.beta1) * g)._data)
+            inf_norm._set_data(
+                jnp.maximum(self.beta2 * inf_norm._data, jnp.abs(g._data)))
+            weight._set_data(
+                (weight - lr_t * mean / (inf_norm + 1e-8))._data.astype(
+                    weight._data.dtype))
+
+    fused_step = step
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, use_fused_step=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+
+    def step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for index, weight, grad, state, lr, wd in zip(
+                indices, weights, grads, states, lrs, wds):
+            t = self._index_update_count[index]
+            g = grad * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = g.clip(-self.clip_gradient, self.clip_gradient)
+            g = g + wd * weight
+            momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+            momentum_t_1 = self.beta1 * (
+                1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+            self.m_schedule = self.m_schedule * momentum_t
+            m_schedule_next = self.m_schedule * momentum_t_1
+            mean, var = state
+            mean._set_data((self.beta1 * mean + (1 - self.beta1) * g)._data)
+            var._set_data((self.beta2 * var + (1 - self.beta2) * g * g)._data)
+            g_prime = g / (1 - self.m_schedule)
+            m_t_prime = mean / (1 - m_schedule_next)
+            v_t_prime = var / (1 - self.beta2 ** t)
+            m_t_bar = (1 - momentum_t) * g_prime + momentum_t_1 * m_t_prime
+            weight._set_data(
+                (weight - lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon)
+                 )._data.astype(weight._data.dtype))
+
+    fused_step = step
+
+
+@register
+class FTML(Optimizer):
+    """FTML (reference optimizer/ftml.py)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, use_fused_step=False, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),  # d
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),  # v
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))  # z
+
+    def step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for index, weight, grad, state, lr, wd in zip(
+                indices, weights, grads, states, lrs, wds):
+            t = self._index_update_count[index]
+            g = grad * self.rescale_grad + wd * weight
+            if self.clip_gradient is not None:
+                g = g.clip(-self.clip_gradient, self.clip_gradient)
+            prev_d, prev_v, prev_z = state
+            v = self.beta2 * prev_v + (1 - self.beta2) * g * g
+            d = (1 - self.beta1 ** t) / lr * (
+                (v / (1 - self.beta2 ** t)).sqrt() + self.epsilon)
+            sigma = d - self.beta1 * prev_d
+            z = self.beta1 * prev_z + (1 - self.beta1) * g - sigma * weight
+            prev_d._set_data(d._data)
+            prev_v._set_data(v._data)
+            prev_z._set_data(z._data)
+            weight._set_data((-z / d)._data.astype(weight._data.dtype))
+
+    fused_step = step
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL (reference optimizer/ftrl.py; op ftrl_update)."""
+
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0,
+                 use_fused_step=True, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),  # z
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))  # n
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for weight, grad, state, lr, wd in zip(weights, grads, states, lrs, wds):
+            z, n = state
+            invoke("ftrl_update", [weight, grad, z, n],
+                   {"lr": lr, "lamda1": self.lamda1, "beta": self.beta,
+                    "wd": wd, "rescale_grad": self.rescale_grad,
+                    "clip_gradient": _clip(self.clip_gradient)},
+                   out=[weight, z, n])
+
+    step = fused_step
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay (reference
+    ``python/mxnet/optimizer/adamW.py`` / contrib adamw_update op)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, use_fused_step=True,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.correct_bias = correct_bias
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for index, weight, grad, state, lr, wd in zip(
+                indices, weights, grads, states, lrs, wds):
+            t = self._index_update_count[index]
+            lr_t = lr
+            if self.correct_bias:
+                coef1 = 1.0 - self.beta1 ** t
+                coef2 = 1.0 - self.beta2 ** t
+                lr_t = lr * math.sqrt(coef2) / coef1
+            mean, var = state
+            invoke("adamw_update", [weight, grad, mean, var],
+                   {"lr": lr_t, "beta1": self.beta1, "beta2": self.beta2,
+                    "epsilon": self.epsilon, "wd": wd, "eta": 1.0,
+                    "rescale_grad": self.rescale_grad,
+                    "clip_gradient": _clip(self.clip_gradient)},
+                   out=[weight, mean, var])
+
+    step = fused_step

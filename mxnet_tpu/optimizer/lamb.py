@@ -1,0 +1,62 @@
+"""LAMB optimizer (reference ``python/mxnet/optimizer/lamb.py``; fused ops
+lamb_update_phase1/2, src/operator/optimizer_op.cc:917-961)."""
+from __future__ import annotations
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import invoke
+from .optimizer import Optimizer, register
+
+__all__ = ["LAMB"]
+
+
+def _clip(v):
+    return -1.0 if v is None else v
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for batch training (BERT-scale LR
+    scaling).  Phase1 computes the adam-style direction, phase2 applies the
+    trust ratio — each one fused XLA computation."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, use_fused_step=True, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for index, weight, grad, state, lr, wd in zip(
+                indices, weights, grads, states, lrs, wds):
+            t = self._index_update_count[index]
+            mean, var = state
+            g_update = invoke(
+                "lamb_update_phase1", [weight, grad, mean, var],
+                {"beta1": self.beta1, "beta2": self.beta2,
+                 "epsilon": self.epsilon, "t": t,
+                 "bias_correction": self.bias_correction, "wd": wd,
+                 "rescale_grad": self.rescale_grad,
+                 "clip_gradient": _clip(self.clip_gradient)})
+            upd, new_mean, new_var = g_update
+            mean._set_data(new_mean._data)
+            var._set_data(new_var._data)
+            r1 = weight.norm()
+            r2 = upd.norm()
+            invoke("lamb_update_phase2", [weight, upd, r1, r2],
+                   {"lr": lr,
+                    "lower_bound": _clip(self.lower_bound),
+                    "upper_bound": _clip(self.upper_bound)},
+                   out=weight)
+
+    step = fused_step

@@ -1,0 +1,308 @@
+"""Optimizer base class, registry, and Updater.
+
+Reference ``python/mxnet/optimizer/optimizer.py``.  Each optimizer's
+``update`` dispatches to a fused device-side op (``mxnet_tpu/ops/optimizer.py``
+— the analog of ``src/operator/optimizer_op.cc``), so the whole update step
+is one XLA computation per parameter (or one per *list* of parameters for
+multi-tensor variants).
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as onp
+
+from ..ndarray import NDArray
+from ..ndarray.ndarray import invoke, _wrap
+
+__all__ = ["Optimizer", "register", "create", "Updater", "get_updater", "Test"]
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:47)."""
+
+    opt_registry: Dict[str, type] = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=None, use_fused_step=True):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if self.lr_scheduler is None and learning_rate is None:
+            self.lr = 0.01
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = (
+                learning_rate if learning_rate is not None
+                else lr_scheduler.base_lr
+            )
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num if aggregate_num is not None else 1
+        self.use_fused_step = use_fused_step
+
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), (
+            "param_idx2name should be a dict of param indexes to names."
+        )
+        self.idx2name = param_idx2name.copy()
+        self.param_dict = param_dict if param_dict else {}
+
+    # -- registry --------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("Optimizer %s overridden", name)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError(f"Cannot find optimizer {name}")
+
+    # -- lr / wd ---------------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning(
+                "LRScheduler of the optimizer has already been defined. "
+                "Note that set_learning_rate can mutate the value of the "
+                "learning rate of the optimizer only when the LRScheduler "
+                "of the optimizer is undefined."
+            )
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = args_lr_mult.copy()
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight") or n.endswith("weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _set_current_context(self, device_id):
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lrs(self, indices):
+        lr = self.learning_rate
+        lrs = [lr for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    # -- state -----------------------------------------------------------
+    def create_state(self, index, weight):
+        """Optimizer state for one parameter; override."""
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy for fp16 weights (reference
+        create_state_multi_precision)."""
+        if self.multi_precision and weight.dtype == onp.float16:
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        if weight.dtype == onp.float16 and not self.multi_precision:
+            logging.warning(
+                "Accumulating with float16 in optimizer can lead to poor "
+                "accuracy or slow convergence. Consider using "
+                "multi_precision=True option of the optimizer"
+            )
+        return self.create_state(index, weight)
+
+    # -- update ----------------------------------------------------------
+    def update(self, index, weight, grad, state):
+        """Update one (or a list of) parameter(s); override step()."""
+        if not isinstance(index, (list, tuple)):
+            index, weight, grad, state = [index], [weight], [grad], [state]
+        self._update_count(index)
+        if self.use_fused_step:
+            self.fused_step(index, weight, grad, state)
+        else:
+            self.step(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if not isinstance(index, (list, tuple)):
+            index, weight, grad, state = [index], [weight], [grad], [state]
+        use_mp = self.multi_precision and weight[0].dtype == onp.float16
+        if not use_mp:
+            self.update(index, weight, grad, state)
+            return
+        self._update_count(index)
+        # update the fp32 master weights, then cast back into the fp16 weight
+        masters = [s[0] for s in state]
+        inner = [s[1] for s in state]
+        grads32 = [g.astype("float32") for g in grad]
+        if self.use_fused_step:
+            self.fused_step(index, masters, grads32, inner)
+        else:
+            self.step(index, masters, grads32, inner)
+        for w, m in zip(weight, masters):
+            w._set_data(m._data.astype(w._data.dtype))
+
+    def step(self, indices, weights, grads, states):
+        raise NotImplementedError
+
+    def fused_step(self, indices, weights, grads, states):
+        # default: fall back to non-fused
+        self.step(indices, weights, grads, states)
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        del ret["_all_index_update_counts"]
+        del ret["_index_update_count"]
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class Test(Optimizer):
+    """Trivial test optimizer (reference optimizer.py Test)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        from .. import ndarray as nd
+
+        return nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def step(self, indices, weights, grads, states):
+        for weight, grad in zip(weights, grads):
+            weight._set_data(weight._data + grad._data * self.rescale_grad)
+
+
+class Updater:
+    """Applies an optimizer to (index, grad, weight) triples, lazily creating
+    state (reference optimizer.py:1800 get_updater / Updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+        self.aggregate_updates = optimizer.aggregate_num > 1
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            indices = [index]
+            grads = [grad]
+            weights = [weight]
+        else:
+            indices, grads, weights = list(index), list(grad), list(weight)
+        for i, idx in enumerate(indices):
+            if idx not in self.states:
+                self.states[idx] = self.optimizer.create_state_multi_precision(
+                    idx, weights[i]
+                )
+                self.states_synced[idx] = True
+        states = [self.states[i] for i in indices]
+        self.optimizer.update_multi_precision(indices, weights, grads, states)
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        if dump_optimizer:
+            return pickle.dumps((
+                {k: _state_to_numpy(v) for k, v in self.states.items()},
+                self.optimizer,
+            ))
+        return pickle.dumps({k: _state_to_numpy(v) for k, v in self.states.items()})
+
+    def set_states(self, states):
+        import pickle
+
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and isinstance(
+            data[1], Optimizer
+        ):
+            loaded, self.optimizer = data
+        else:
+            loaded = data
+        self.states = {k: _state_from_numpy(v) for k, v in loaded.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+
+def _state_to_numpy(state):
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state.asnumpy()
+    if isinstance(state, (list, tuple)):
+        return type(state)(_state_to_numpy(s) for s in state)
+    return state
+
+
+def _state_from_numpy(state):
+    if state is None:
+        return None
+    if isinstance(state, onp.ndarray):
+        return NDArray(state)
+    if isinstance(state, (list, tuple)):
+        return type(state)(_state_from_numpy(s) for s in state)
+    return state
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,228 @@
+"""SGD-family optimizers (reference ``python/mxnet/optimizer/{sgd,nag,sgld,
+signum,dcasgd,lars}.py``)."""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import invoke
+from .optimizer import Optimizer, register
+
+__all__ = ["SGD", "NAG", "SGLD", "Signum", "DCASGD", "LARS"]
+
+
+def _clip(v):
+    return -1.0 if v is None else v
+
+
+@register
+class SGD(Optimizer):
+    """Stochastic gradient descent with momentum; fused op
+    ``sgd_update``/``sgd_mom_update`` (reference optimizer/sgd.py,
+    op src/operator/optimizer_op.cc sgd_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 multi_precision=False, use_fused_step=True, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         multi_precision=multi_precision,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for weight, grad, state, lr, wd in zip(weights, grads, states, lrs, wds):
+            attrs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                     "clip_gradient": _clip(self.clip_gradient)}
+            if self.momentum == 0.0:
+                invoke("sgd_update", [weight, grad], attrs, out=weight)
+            else:
+                attrs["momentum"] = self.momentum
+                invoke("sgd_mom_update", [weight, grad, state], attrs,
+                       out=[weight, state])
+
+    step = fused_step
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference optimizer/nag.py)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, multi_precision=False,
+                 use_fused_step=True, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         multi_precision=multi_precision,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for weight, grad, state, lr, wd in zip(weights, grads, states, lrs, wds):
+            attrs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                     "clip_gradient": _clip(self.clip_gradient),
+                     "momentum": self.momentum}
+            if state is None:
+                invoke("sgd_update", [weight, grad],
+                       {k: v for k, v in attrs.items() if k != "momentum"},
+                       out=weight)
+            else:
+                invoke("nag_mom_update", [weight, grad, state], attrs,
+                       out=[weight, state])
+
+    step = fused_step
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer/sgld.py):
+    SGD + N(0, sqrt(lr)) noise per step."""
+
+    def __init__(self, learning_rate=0.01, use_fused_step=False, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for weight, grad, _state, lr, wd in zip(weights, grads, states, lrs, wds):
+            g = grad * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = g.clip(-self.clip_gradient, self.clip_gradient)
+            g = g + wd * weight
+            noise = invoke("normal", [], {
+                "loc": 0.0, "scale": math.sqrt(lr),
+                "shape": weight.shape, "dtype": str(weight.dtype)})
+            weight._set_data(
+                (weight - lr / 2 * g + noise)._data.astype(weight._data.dtype))
+
+    fused_step = step
+
+
+@register
+class Signum(Optimizer):
+    """signSGD / Signum (reference optimizer/signum.py; op signum_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 use_fused_step=True, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for weight, grad, state, lr, wd in zip(weights, grads, states, lrs, wds):
+            attrs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                     "clip_gradient": _clip(self.clip_gradient)}
+            if state is None:
+                invoke("signsgd_update", [weight, grad], attrs, out=weight)
+            else:
+                attrs.update({"momentum": self.momentum, "wd_lh": self.wd_lh})
+                invoke("signum_update", [weight, grad, state], attrs,
+                       out=[weight, state])
+
+    step = fused_step
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer/dcasgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04,
+                 use_fused_step=False, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                weight.copy())
+
+    def step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for weight, grad, state, lr, wd in zip(weights, grads, states, lrs, wds):
+            g = grad * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = g.clip(-self.clip_gradient, self.clip_gradient)
+            mom, previous_weight = state
+            delay_comp = self.lamda * g * g * (weight - previous_weight)
+            if mom is not None:
+                m = self.momentum * mom - lr * (g + wd * weight + delay_comp)
+                mom._set_data(m._data)
+                update = mom
+            else:
+                update = -lr * (g + wd * weight + delay_comp)
+            previous_weight._set_data(weight._data)
+            weight._set_data((weight + update)._data.astype(weight._data.dtype))
+
+    fused_step = step
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference optimizer/lars.py; fused
+    multi_sum_sq + multi-tensor form in src/operator/contrib/multi_lars.cc).
+
+    The trust-ratio computation is one fused XLA computation per param via
+    the pure-JAX update below.
+    """
+
+    def __init__(self, learning_rate=0.1, momentum=0.0, eta=0.001,
+                 epsilon=1e-8, use_fused_step=True, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        for weight, grad, state, lr, wd in zip(weights, grads, states, lrs, wds):
+            w_norm = float(weight.norm().asnumpy())
+            g = grad * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = g.clip(-self.clip_gradient, self.clip_gradient)
+            g_norm = float(g.norm().asnumpy())
+            if w_norm > 0 and g_norm > 0:
+                lr_layer = lr * self.eta * w_norm / (
+                    g_norm + wd * w_norm + self.epsilon)
+            else:
+                lr_layer = lr
+            attrs = {"lr": lr_layer, "wd": wd, "rescale_grad": self.rescale_grad,
+                     "clip_gradient": _clip(self.clip_gradient)}
+            if state is None:
+                invoke("sgd_update", [weight, grad], attrs, out=weight)
+            else:
+                attrs["momentum"] = self.momentum
+                invoke("sgd_mom_update", [weight, grad, state], attrs,
+                       out=[weight, state])
+
+    step = fused_step

@@ -1,0 +1,185 @@
+"""Optimizer + Trainer + KVStore tests (reference
+tests/python/unittest/{test_optimizer,test_gluon_trainer,test_kvstore}.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def _train_quadratic(optimizer, steps=60, **opt_params):
+    """Minimize ||w - target||^2; returns final distance."""
+    target = onp.array([1.0, -2.0, 3.0], dtype="float32")
+    w = gluon.Parameter("weight", shape=(3,))
+    w.initialize(init=mx.init.Zero())
+    trainer = gluon.Trainer({"w": w}, optimizer, opt_params)
+    for _ in range(steps):
+        with mx.autograd.record():
+            loss = ((w.data() - mx.nd.array(target)) ** 2).sum()
+        loss.backward()
+        trainer.step(1)
+    return onp.abs(w.data().asnumpy() - target).max()
+
+
+@pytest.mark.parametrize("optimizer,params", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.3}),
+    ("adamw", {"learning_rate": 0.3}),
+    ("rmsprop", {"learning_rate": 0.1}),
+    ("rmsprop", {"learning_rate": 0.1, "centered": True}),
+    ("adagrad", {"learning_rate": 0.9}),
+    ("adadelta", {"rho": 0.9}),
+    ("ftrl", {"learning_rate": 1.0}),
+    ("lamb", {"learning_rate": 0.3}),
+    ("nadam", {"learning_rate": 0.3}),
+    ("adamax", {"learning_rate": 0.5}),
+    ("ftml", {"learning_rate": 0.3}),
+    ("signum", {"learning_rate": 0.1}),
+    ("lars", {"learning_rate": 1.0, "momentum": 0.9, "eta": 0.1}),
+])
+def test_optimizer_converges(optimizer, params):
+    dist = _train_quadratic(optimizer, **params)
+    # adadelta is slow by design; others should get close
+    # adadelta has no lr and tiny initial steps: just require clear progress
+    tol = {"adadelta": 2.9, "ftml": 1.5, "lamb": 0.6}.get(optimizer, 0.35)
+    assert dist < tol, f"{optimizer} did not converge: {dist}"
+
+
+def test_sgd_update_matches_manual():
+    w = gluon.Parameter("weight", shape=(4,))
+    w.initialize(init=mx.init.One())
+    trainer = gluon.Trainer({"w": w}, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.0, "wd": 0.0})
+    with mx.autograd.record():
+        loss = (w.data() * 3.0).sum()
+    loss.backward()
+    trainer.step(1)
+    assert onp.allclose(w.data().asnumpy(), 1.0 - 0.1 * 3.0, atol=1e-6)
+
+
+def test_weight_decay():
+    w = gluon.Parameter("weight", shape=(1,))
+    w.initialize(init=mx.init.One())
+    trainer = gluon.Trainer({"w": w}, "sgd",
+                            {"learning_rate": 0.1, "wd": 0.5})
+    with mx.autograd.record():
+        loss = w.data().sum() * 0.0
+    loss.backward()
+    trainer.step(1)
+    # grad=0, wd pulls towards zero: w = 1 - 0.1*0.5*1
+    assert onp.allclose(w.data().asnumpy(), 0.95, atol=1e-6)
+
+
+def test_multi_precision_sgd():
+    w = gluon.Parameter("weight", shape=(3,), dtype="float16")
+    w.initialize(init=mx.init.One())
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    trainer = gluon.Trainer({"w": w}, opt)
+    with mx.autograd.record():
+        loss = (w.data() * 2.0).sum()
+    loss.backward()
+    trainer.step(1)
+    assert w.data().dtype == onp.float16
+    state = trainer._updaters[0].states[0]
+    assert state[0].dtype == onp.float32  # master weight
+
+
+def test_lr_scheduler_in_trainer():
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5, base_lr=1.0)
+    w = gluon.Parameter("weight", shape=(1,))
+    w.initialize()
+    trainer = gluon.Trainer({"w": w}, "sgd", {"lr_scheduler": sched,
+                                              "learning_rate": 1.0})
+    assert trainer.learning_rate == 1.0
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = w.data().sum()
+        loss.backward()
+        trainer.step(1)
+    assert trainer.learning_rate < 1.0
+
+
+def test_trainer_save_load_states(tmp_path):
+    w = gluon.Parameter("weight", shape=(2,))
+    w.initialize(init=mx.init.One())
+    trainer = gluon.Trainer({"w": w}, "adam", {"learning_rate": 0.1})
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = (w.data() ** 2).sum()
+        loss.backward()
+        trainer.step(1)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    mean_before = trainer._updaters[0].states[0][0].asnumpy().copy()
+
+    trainer2 = gluon.Trainer({"w": w}, "adam", {"learning_rate": 0.1})
+    trainer2.load_states(f)
+    assert onp.allclose(trainer2._updaters[0].states[0][0].asnumpy(),
+                        mean_before)
+
+
+def test_kvstore_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("3", mx.nd.ones((2, 3)))
+    out = mx.nd.zeros((2, 3))
+    kv.pull("3", out=out)
+    assert onp.allclose(out.asnumpy(), 1.0)
+    kv.push("3", [mx.nd.ones((2, 3)) * 2, mx.nd.ones((2, 3)) * 3])
+    kv.pull("3", out=out)
+    assert onp.allclose(out.asnumpy(), 5.0)
+
+
+def test_kvstore_pushpull_fused():
+    kv = mx.kv.create("tpu")
+    kv.init(0, mx.nd.zeros((4,)))
+    a = mx.nd.ones((4,))
+    b = mx.nd.ones((4,)) * 2
+    kv.pushpull(0, [a, b], out=[a, b])
+    assert onp.allclose(a.asnumpy(), 3.0)
+    assert onp.allclose(b.asnumpy(), 3.0)
+
+
+def test_kvstore_server_side_optimizer():
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.init(0, mx.nd.ones((3,)))
+    grad = mx.nd.ones((3,))
+    out = mx.nd.zeros((3,))
+    kv.pushpull(0, grad, out=out)
+    assert onp.allclose(out.asnumpy(), 1.0 - 0.1, atol=1e-6)
+
+
+def test_kvstore_factory_types():
+    assert mx.kv.create("device").type == "device"
+    assert mx.kv.create("tpu").type == "tpu"
+    assert mx.kv.create("dist_sync").type == "dist_sync"
+    with pytest.raises(ValueError):
+        mx.kv.create("bogus")
+
+
+def test_trainer_with_net_end_to_end():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=2), nn.Dense(1, in_units=8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    loss_fn = gluon.loss.L2Loss()
+    # learn y = x0 + x1
+    x = onp.random.rand(64, 2).astype("float32")
+    y = x.sum(1, keepdims=True)
+    xs, ys = mx.nd.array(x), mx.nd.array(y)
+    first = None
+    for i in range(100):
+        with mx.autograd.record():
+            loss = loss_fn(net(xs), ys).mean()
+        loss.backward()
+        trainer.step(64)
+        if first is None:
+            first = float(loss.asnumpy())
+    final = float(loss.asnumpy())
+    assert final < first * 0.05, (first, final)

@@ -1,0 +1,287 @@
+"""MobileNet v1 / v2 / v3 (reference
+``python/mxnet/gluon/model_zoo/vision/mobilenet.py`` and gluoncv mobilenetv3;
+reference model_zoo ships v1+v2, v3 listed in SURVEY §2.5).
+
+Depthwise separable convs map to XLA's grouped convolution
+(feature_group_count = channels), which the TPU convolution emitter handles
+natively.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ....ndarray.ndarray import invoke
+from ... import nn
+from ...block import HybridBlock
+
+__all__ = ["MobileNet", "MobileNetV2", "MobileNetV3",
+           "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
+           "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
+           "mobilenet_v2_0_25",
+           "mobilenet_v3_large", "mobilenet_v3_small",
+           "get_mobilenet", "get_mobilenet_v2"]
+
+
+class RELU6(HybridBlock):
+    def forward(self, x):
+        return x.clip(0, 6)
+
+
+class HardSigmoid(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.act = RELU6()
+
+    def forward(self, x):
+        return self.act(x + 3.0) / 6.0
+
+
+class HardSwish(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.act = HardSigmoid()
+
+    def forward(self, x):
+        return x * self.act(x)
+
+
+def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
+              active=True, relu6=False, act_layer=None):
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
+                      use_bias=False))
+    out.add(nn.BatchNorm())
+    if active:
+        if act_layer is not None:
+            out.add(act_layer)
+        else:
+            out.add(RELU6() if relu6 else nn.Activation("relu"))
+
+
+def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
+    _add_conv(out, dw_channels, kernel=3, stride=stride, pad=1,
+              num_group=dw_channels, relu6=relu6)
+    _add_conv(out, channels, relu6=relu6)
+
+
+class LinearBottleneck(HybridBlock):
+    """MobileNetV2 inverted residual."""
+
+    def __init__(self, in_channels, channels, t, stride):
+        super().__init__()
+        self.use_shortcut = stride == 1 and in_channels == channels
+        self.out = nn.HybridSequential()
+        _add_conv(self.out, in_channels * t, relu6=True)
+        _add_conv(self.out, in_channels * t, kernel=3, stride=stride, pad=1,
+                  num_group=in_channels * t, relu6=True)
+        _add_conv(self.out, channels, active=False, relu6=True)
+
+    def forward(self, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+class MobileNet(HybridBlock):
+    """MobileNetV1 (reference mobilenet.py:131)."""
+
+    def __init__(self, multiplier=1.0, classes=1000):
+        super().__init__()
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, channels=int(32 * multiplier), kernel=3,
+                  pad=1, stride=2)
+        dw_channels = [int(x * multiplier) for x in
+                       [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
+        channels = [int(x * multiplier) for x in
+                    [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
+        strides = [1, 2, 1, 2, 1, 2, 1, 1, 1, 1, 1, 2, 1]
+        for dwc, c, s in zip(dw_channels, channels, strides):
+            _add_conv_dw(self.features, dw_channels=dwc, channels=c, stride=s)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class MobileNetV2(HybridBlock):
+    """MobileNetV2 (reference mobilenet.py:186)."""
+
+    def __init__(self, multiplier=1.0, classes=1000):
+        super().__init__()
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2,
+                  pad=1, relu6=True)
+        in_channels_group = [int(x * multiplier) for x in
+                             [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4
+                             + [96] * 3 + [160] * 3]
+        channels_group = [int(x * multiplier) for x in
+                          [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
+                          + [160] * 3 + [320]]
+        ts = [1] + [6] * 16
+        strides = [1, 2, 1, 2, 1, 1, 2, 1, 1, 1, 1, 1, 1, 2, 1, 1, 1]
+        for in_c, c, t, s in zip(in_channels_group, channels_group, ts,
+                                 strides):
+            self.features.add(LinearBottleneck(in_channels=in_c, channels=c,
+                                               t=t, stride=s))
+        last_channels = int(1280 * multiplier) if multiplier > 1.0 else 1280
+        _add_conv(self.features, last_channels, relu6=True)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(classes, 1, use_bias=False))
+        self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class _SEBlock(HybridBlock):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        self.pool = nn.GlobalAvgPool2D()
+        self.fc1 = nn.Conv2D(channels // reduction, 1, use_bias=True)
+        self.fc2 = nn.Conv2D(channels, 1, use_bias=True)
+        self.hsig = HardSigmoid()
+
+    def forward(self, x):
+        w = self.pool(x)
+        w = self.fc1(w).relu()
+        w = self.hsig(self.fc2(w))
+        return x * w
+
+
+class _MBV3Block(HybridBlock):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, se, act):
+        super().__init__()
+        self.use_shortcut = stride == 1 and in_c == out_c
+        self.out = nn.HybridSequential()
+        act_fn = HardSwish() if act == "hswish" else nn.Activation("relu")
+        if exp_c != in_c:
+            _add_conv(self.out, exp_c, act_layer=act_fn)
+        _add_conv(self.out, exp_c, kernel=kernel, stride=stride,
+                  pad=kernel // 2, num_group=exp_c,
+                  act_layer=HardSwish() if act == "hswish"
+                  else nn.Activation("relu"))
+        if se:
+            self.out.add(_SEBlock(exp_c))
+        _add_conv(self.out, out_c, active=False)
+
+    def forward(self, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+_V3_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hswish", 2),
+    (3, 200, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1),
+    (3, 480, 112, True, "hswish", 1),
+    (3, 672, 112, True, "hswish", 1),
+    (5, 672, 160, True, "hswish", 2),
+    (5, 960, 160, True, "hswish", 1),
+    (5, 960, 160, True, "hswish", 1),
+]
+_V3_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hswish", 2),
+    (5, 240, 40, True, "hswish", 1),
+    (5, 240, 40, True, "hswish", 1),
+    (5, 120, 48, True, "hswish", 1),
+    (5, 144, 48, True, "hswish", 1),
+    (5, 288, 96, True, "hswish", 2),
+    (5, 576, 96, True, "hswish", 1),
+    (5, 576, 96, True, "hswish", 1),
+]
+
+
+class MobileNetV3(HybridBlock):
+    def __init__(self, mode="large", classes=1000):
+        super().__init__()
+        spec = _V3_LARGE if mode == "large" else _V3_SMALL
+        last_exp = 960 if mode == "large" else 576
+        last_c = 1280 if mode == "large" else 1024
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, 16, kernel=3, stride=2, pad=1,
+                  act_layer=HardSwish())
+        in_c = 16
+        for k, exp, out_c, se, act, s in spec:
+            self.features.add(_MBV3Block(in_c, exp, out_c, k, s, se, act))
+            in_c = out_c
+        _add_conv(self.features, last_exp, act_layer=HardSwish())
+        self.features.add(nn.GlobalAvgPool2D())
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(last_c, 1, use_bias=True))
+        self.output.add(HardSwish())
+        self.output.add(nn.Conv2D(classes, 1, use_bias=True))
+        self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
+    net = MobileNet(multiplier, **kwargs)
+    if pretrained:
+        raise NotImplementedError("pretrained weights require a local file")
+    return net
+
+
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
+                     **kwargs):
+    net = MobileNetV2(multiplier, **kwargs)
+    if pretrained:
+        raise NotImplementedError("pretrained weights require a local file")
+    return net
+
+
+def mobilenet1_0(**kwargs):
+    return get_mobilenet(1.0, **kwargs)
+
+
+def mobilenet0_75(**kwargs):
+    return get_mobilenet(0.75, **kwargs)
+
+
+def mobilenet0_5(**kwargs):
+    return get_mobilenet(0.5, **kwargs)
+
+
+def mobilenet0_25(**kwargs):
+    return get_mobilenet(0.25, **kwargs)
+
+
+def mobilenet_v2_1_0(**kwargs):
+    return get_mobilenet_v2(1.0, **kwargs)
+
+
+def mobilenet_v2_0_75(**kwargs):
+    return get_mobilenet_v2(0.75, **kwargs)
+
+
+def mobilenet_v2_0_5(**kwargs):
+    return get_mobilenet_v2(0.5, **kwargs)
+
+
+def mobilenet_v2_0_25(**kwargs):
+    return get_mobilenet_v2(0.25, **kwargs)
+
+
+def mobilenet_v3_large(**kwargs):
+    return MobileNetV3("large", **kwargs)
+
+
+def mobilenet_v3_small(**kwargs):
+    return MobileNetV3("small", **kwargs)

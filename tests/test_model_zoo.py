@@ -1,0 +1,54 @@
+"""Model zoo tests (reference tests/python/unittest/test_gluon_model_zoo.py).
+
+Full 224x224 forwards for every family run in the nightly-ish smoke script;
+here we keep shapes small for speed and check a representative subset plus
+train-mode backward on resnet18.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+@pytest.mark.parametrize("name", ["resnet18_v1", "resnet18_v2",
+                                  "mobilenet0.25", "squeezenet1.1"])
+def test_model_forward(name):
+    net = vision.get_model(name, classes=7)
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(1, 3, 64, 64).astype("float32"))
+    out = net(x)
+    assert out.shape == (1, 7)
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        vision.get_model("not_a_model")
+
+
+def test_resnet18_train_step():
+    net = vision.get_model("resnet18_v1", classes=4)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(onp.random.randn(2, 3, 32, 32).astype("float32"))
+    y = mx.nd.array(onp.array([0, 1]))
+    for _ in range(2):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(2)
+    assert onp.isfinite(loss.asnumpy()).all()
+
+
+def test_resnet_channels_progression():
+    net = vision.get_model("resnet50_v1", classes=10)
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(1, 3, 64, 64).astype("float32"))
+    assert net(x).shape == (1, 10)
+    # bottleneck conv1 weight of stage1 block1
+    params = net.collect_params()
+    assert any("features" in k for k in params)

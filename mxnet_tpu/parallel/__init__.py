@@ -1,0 +1,34 @@
+"""mxnet_tpu.parallel — SPMD parallelism over TPU device meshes.
+
+The reference's distributed layer (SURVEY.md §2.3: KVStore + Comm/NCCL/
+ps-lite, data parallelism only) is replaced by declarative sharding of one
+jitted program over a named ``jax.sharding.Mesh``:
+
+- :mod:`mesh`       — mesh construction / current-mesh scope
+- :mod:`sharding`   — ShardingPlan (name-pattern → PartitionSpec), fsdp/tp plans
+- :mod:`collectives`— KVStore-flavoured named collectives (psum/all_gather/…)
+- :mod:`train`      — ShardedTrainer: whole train step as one SPMD program
+- :mod:`ring_attention` — sequence/context parallelism (absent upstream)
+- :mod:`moe`        — expert parallelism (absent upstream)
+- :mod:`pipeline`   — GPipe-style pipeline stages over ``pp``
+"""
+from . import collectives, mesh, moe, pipeline, ring_attention, sharding, train
+from .collectives import (all_gather, all_reduce, all_to_all, broadcast_from,
+                          ppermute, reduce_scatter, ring_shift, run_sharded)
+from .mesh import AXIS_NAMES, auto_mesh, current_mesh, make_mesh, mesh_scope, set_mesh
+from .moe import moe_layer, top_k_gating
+from .pipeline import pipeline_apply, pipelined, stack_stage_params
+from .ring_attention import ring_attention, ring_attention_sharded
+from .sharding import (PartitionSpec, ShardingPlan, constraint, fsdp_plan,
+                       replicated_plan, shard_array, tensor_parallel_plan)
+from .train import ShardedTrainer, functional_call
+
+__all__ = [
+    "AXIS_NAMES", "auto_mesh", "current_mesh", "make_mesh", "mesh_scope",
+    "set_mesh", "ShardingPlan", "PartitionSpec", "constraint", "fsdp_plan",
+    "replicated_plan", "shard_array", "tensor_parallel_plan", "all_reduce",
+    "all_gather", "reduce_scatter", "all_to_all", "ppermute", "ring_shift",
+    "broadcast_from", "run_sharded", "ring_attention",
+    "ring_attention_sharded", "moe_layer", "top_k_gating", "pipeline_apply",
+    "pipelined", "stack_stage_params", "ShardedTrainer", "functional_call",
+]

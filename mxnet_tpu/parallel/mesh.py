@@ -1,0 +1,105 @@
+"""Device-mesh management.
+
+The reference scales out through KVStore backends over NCCL/ps-lite
+(SURVEY.md §2.3, src/kvstore/).  The TPU-native design instead expresses
+*all* parallelism as shardings of one SPMD program over a named
+``jax.sharding.Mesh``; XLA inserts the collectives (all-reduce over ICI for
+the data-parallel axis = the CommDevice/NCCL analog, all-to-all for expert
+dispatch, collective-permute for pipeline/ring axes).
+
+Canonical axis names (any subset may be present, size-1 axes are free):
+
+- ``dp``   data parallel (gradient all-reduce; the KVStore axis)
+- ``fsdp`` fully-sharded data parallel (param/optimizer-state sharding)
+- ``tp``   tensor (a.k.a. model) parallel within layers
+- ``sp``   sequence/context parallel (ring attention)
+- ``ep``   expert parallel (MoE)
+- ``pp``   pipeline parallel (stage per mesh slice)
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh
+
+__all__ = ["AXIS_NAMES", "make_mesh", "current_mesh", "set_mesh", "mesh_scope",
+           "auto_mesh"]
+
+AXIS_NAMES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+_CURRENT: List[Optional[Mesh]] = [None]
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Create a named mesh from ``{axis: size}``.
+
+    Axis order follows AXIS_NAMES so that the fastest-varying (innermost)
+    device dimension is ``tp`` — on hardware, adjacent devices share the
+    highest ICI bandwidth, which is where tensor-parallel collectives live.
+    Unknown axis names are appended in given order.
+    """
+    if devices is None:
+        devices = jax.devices()
+    sizes = dict(axes)
+    order = [a for a in AXIS_NAMES if a in sizes] + [
+        a for a in sizes if a not in AXIS_NAMES
+    ]
+    shape = [sizes[a] for a in order]
+    n = int(onp.prod(shape)) if shape else 1
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {sizes} needs {n} devices, only {len(devices)} available"
+        )
+    dev_array = onp.array(devices[:n]).reshape(shape)
+    return Mesh(dev_array, tuple(order))
+
+
+def auto_mesh(n_devices: Optional[int] = None, *, dp: Optional[int] = None,
+              tp: int = 1, sp: int = 1, ep: int = 1, pp: int = 1,
+              fsdp: int = 1) -> Mesh:
+    """Mesh over the first ``n_devices`` with ``dp`` filling the remainder."""
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    fixed = tp * sp * ep * pp * fsdp
+    if dp is None:
+        if n_devices % fixed:
+            raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+        dp = n_devices // fixed
+    axes = {}
+    for name, size in (("pp", pp), ("dp", dp), ("fsdp", fsdp), ("ep", ep),
+                       ("sp", sp), ("tp", tp)):
+        if size > 1 or name == "dp":
+            axes[name] = size
+    return make_mesh(axes, devices[:n_devices])
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT[0]
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    _CURRENT[0] = mesh
+
+
+class mesh_scope:
+    """``with mesh_scope(mesh): ...`` — also enters the jax mesh context so
+    bare ``pjit``/sharding-constraint calls resolve axis names."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._prev: Optional[Mesh] = None
+
+    def __enter__(self):
+        self._prev = _CURRENT[0]
+        _CURRENT[0] = self.mesh
+        self._ctx = self.mesh.__enter__()
+        return self.mesh
+
+    def __exit__(self, *exc):
+        self.mesh.__exit__(*exc)
+        _CURRENT[0] = self._prev
+        return False

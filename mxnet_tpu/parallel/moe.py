@@ -1,0 +1,89 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+No MoE exists in the reference (SURVEY.md §5); this is forward-looking
+capability required for the TPU build's first-class distributed story.
+Design follows the standard TPU recipe: top-k gating with capacity,
+einsum-based dense dispatch/combine (MXU-friendly, no dynamic shapes), expert
+weights sharded over ``ep`` so the dispatch einsum lowers to an all-to-all
+over ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["top_k_gating", "moe_layer"]
+
+
+def top_k_gating(x, gate_w, *, num_experts: int, k: int = 2,
+                 capacity_factor: float = 1.25,
+                 capacity: Optional[int] = None):
+    """Compute dispatch/combine tensors for top-k routing.
+
+    x: [G, S, M] (groups=batch shards, tokens, model dim)
+    gate_w: [M, E]
+    Returns (dispatch [G, S, E, C] bool-ish float, combine [G, S, E, C],
+    aux_loss scalar).  Static shapes throughout: tokens over capacity C are
+    dropped (their combine weights are zero), the standard TPU trick to keep
+    XLA shapes static (vs the reference's dynamic-shape boolean_mask ops).
+    """
+    G, S, M = x.shape
+    E = num_experts
+    if capacity is None:
+        capacity = max(1, int(capacity_factor * S * k / E))
+    C = capacity
+
+    logits = jnp.einsum("gsm,me->gse", x, gate_w)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # load-balancing auxiliary loss (Shazeer et al.): mean prob * mean assignment
+    top1 = jnp.argmax(probs, axis=-1)
+    me = jnp.mean(probs, axis=1)                               # [G, E]
+    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=x.dtype), axis=1)
+    aux_loss = jnp.mean(jnp.sum(me * ce, axis=-1)) * E
+
+    dispatch = jnp.zeros((G, S, E, C), dtype=x.dtype)
+    combine = jnp.zeros((G, S, E, C), dtype=x.dtype)
+    # running per-expert position counters, updated as we take each of k choices
+    position_in_expert = jnp.zeros((G, E), dtype=jnp.int32)
+    p = probs
+    for _ in range(k):
+        idx = jnp.argmax(p, axis=-1)                            # [G, S]
+        gate = jnp.take_along_axis(p, idx[..., None], axis=-1)[..., 0]
+        p = p * (1.0 - jax.nn.one_hot(idx, E, dtype=p.dtype))   # mask chosen
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)        # [G, S, E]
+        # position of each token within its chosen expert's queue
+        pos = position_in_expert[:, None, :] + jnp.cumsum(onehot, axis=1) - onehot
+        pos_tok = jnp.sum(pos * onehot, axis=-1)                # [G, S]
+        position_in_expert = position_in_expert + jnp.sum(onehot, axis=1)
+        keep = (pos_tok < C).astype(x.dtype)                    # capacity drop
+        gate = gate * keep
+        pos_oh = jax.nn.one_hot(jnp.minimum(pos_tok, C - 1), C, dtype=x.dtype)
+        contrib = onehot.astype(x.dtype)[..., None] * pos_oh[:, :, None, :]
+        dispatch = dispatch + contrib * keep[..., None, None]
+        combine = combine + contrib * gate[..., None, None]
+    return dispatch, combine, aux_loss
+
+
+def moe_layer(x, gate_w, w_in, w_out, *, k: int = 2,
+              capacity_factor: float = 1.25, capacity: Optional[int] = None,
+              activation=jax.nn.gelu) -> Tuple[jax.Array, jax.Array]:
+    """Dense-dispatch MoE FFN.
+
+    x: [G, S, M]; gate_w: [M, E]; w_in: [E, M, H]; w_out: [E, H, M].
+    Shard w_in/w_out over 'ep' on dim 0 (ShardingPlan rule `expert.*`) and
+    XLA turns the dispatch einsums into all-to-alls over the ep axis.
+    Returns (output [G, S, M], aux_loss).
+    """
+    E = gate_w.shape[-1]
+    dispatch, combine, aux = top_k_gating(
+        x, gate_w, num_experts=E, k=k, capacity_factor=capacity_factor,
+        capacity=capacity)
+    # [G,S,E,C] x [G,S,M] -> expert inputs [E, G, C, M]
+    expert_in = jnp.einsum("gsec,gsm->egcm", dispatch, x)
+    h = activation(jnp.einsum("egcm,emh->egch", expert_in, w_in))
+    expert_out = jnp.einsum("egch,ehm->egcm", h, w_out)
+    out = jnp.einsum("gsec,egcm->gsm", combine, expert_out)
+    return out, aux

@@ -1,0 +1,96 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+Absent from the reference (only manual device placement existed; SURVEY.md
+§2.3).  TPU-native design: all pipeline stages have identical structure
+(stage params stacked on a leading axis sharded over ``pp``), and the
+schedule is a GPipe loop written as ``lax.scan`` inside ``shard_map`` —
+activations move between neighbour devices with ``ppermute`` (one ICI hop),
+microbatches fill/drain the bubble.
+
+This is the "collective pipelining" pattern: because every device runs the
+same scanned program on its own stage's weights, the whole pipeline is one
+SPMD computation XLA can overlap (permute of microbatch i+1 rides under
+compute of microbatch i).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """[{name: arr}, ...] (one dict per stage, same structure) ->
+    {name: arr stacked on new leading stage axis} — shard dim 0 over 'pp'."""
+    keys = per_stage_params[0].keys()
+    return {k: jnp.stack([p[k] for p in per_stage_params]) for k in keys}
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, *,
+                   num_microbatches: int, axis_name: str = "pp"):
+    """Run ``stage_fn(params, act) -> act`` through all pipeline stages.
+
+    Call INSIDE shard_map: ``stacked_params`` leaves have a leading stage dim
+    already sharded to size 1 locally (this device's stage); ``x`` is the
+    full batch input [B, ...] present on stage 0 (replicated arrival is fine
+    — non-first stages ignore their input).  Returns the final stage's
+    output, valid on the LAST stage (others hold garbage; caller selects).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    local_params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+
+    B = x.shape[0]
+    assert B % num_microbatches == 0, "batch must divide microbatches"
+    mb = B // num_microbatches
+    micro = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    total_steps = num_microbatches + n - 1
+    buf = jnp.zeros((mb,) + x.shape[1:], dtype=x.dtype)      # inbound act
+    outs = jnp.zeros((num_microbatches, mb) + x.shape[1:], dtype=x.dtype)
+
+    def step(carry, t):
+        buf, outs = carry
+        # stage 0 injects microbatch t (while t < num_microbatches)
+        feed = micro[jnp.minimum(t, num_microbatches - 1)]
+        cur = jnp.where(idx == 0, feed, buf)
+        act = stage_fn(local_params, cur)
+        # last stage records its result for microbatch t - (n-1)
+        out_slot = t - (n - 1)
+        outs = jnp.where(
+            (idx == n - 1) & (out_slot >= 0),
+            lax.dynamic_update_index_in_dim(
+                outs, act, jnp.clip(out_slot, 0, num_microbatches - 1), 0),
+            outs)
+        # shift activations forward one stage
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        buf = lax.ppermute(act, axis_name, perm=perm)
+        return (buf, outs), None
+
+    (_, outs), _ = lax.scan(step, (buf, outs), jnp.arange(total_steps))
+    out = outs.reshape((B,) + x.shape[1:])
+    # deliver final output from last stage to all (so loss is replicated)
+    src = n - 1
+    mask = (idx == src).astype(out.dtype)
+    return lax.psum(out * mask, axis_name)
+
+
+def pipelined(stage_fn: Callable, mesh: Mesh, *, num_microbatches: int,
+              axis_name: str = "pp", param_spec=None, x_spec=None):
+    """shard_map wrapper: stacked params sharded over pp on dim 0, input
+    replicated over pp, output replicated."""
+    if param_spec is None:
+        param_spec = P(axis_name)
+    if x_spec is None:
+        x_spec = P()
+    fn = partial(pipeline_apply, stage_fn, num_microbatches=num_microbatches,
+                 axis_name=axis_name)
+    return shard_map(fn, mesh=mesh, in_specs=(param_spec, x_spec),
+                     out_specs=P(), check_vma=False)

@@ -1,0 +1,170 @@
+"""Sharding plans: parameter-name patterns → PartitionSpec.
+
+The reference's distribution story is value-level (KVStore decides where each
+parameter lives, src/kvstore/kvstore_local.h key grouping).  Here placement is
+declarative: a ``ShardingPlan`` is an ordered rule list matched against the
+structural parameter name (the same names ``Block.collect_params`` produces),
+yielding a ``PartitionSpec``.  Rules that don't divide the actual shape fall
+back to replication on the offending axis — the analog of the reference's
+big-array splitting guard (``MXNET_KVSTORE_BIGARRAY_BOUND``,
+src/kvstore/kvstore_dist.h:44) where non-conforming tensors degrade
+gracefully instead of erroring.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["ShardingPlan", "fsdp_plan", "tensor_parallel_plan",
+           "replicated_plan", "shard_array", "constraint"]
+
+Spec = PartitionSpec
+
+
+def _axis_size(mesh: Mesh, axes) -> int:
+    if axes is None:
+        return 1
+    if isinstance(axes, (tuple, list)):
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        return n
+    return mesh.shape[axes]
+
+
+def _legalize(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+    """Drop sharding on dims the shape can't evenly divide, and on axes the
+    mesh doesn't have."""
+    out = []
+    padded = (tuple(spec) + (None,) * len(shape))[: len(shape)]
+    for i, axes in enumerate(padded):
+        if axes is None:
+            out.append(None)
+            continue
+        ax_tuple = axes if isinstance(axes, (tuple, list)) else (axes,)
+        ax_tuple = tuple(a for a in ax_tuple if a in mesh.shape)
+        if not ax_tuple:
+            out.append(None)
+            continue
+        n = _axis_size(mesh, ax_tuple)
+        if n == 1 or shape[i] % n != 0:
+            out.append(None)
+        else:
+            out.append(ax_tuple[0] if len(ax_tuple) == 1 else ax_tuple)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+class ShardingPlan:
+    """Ordered (regex, PartitionSpec) rules; first match wins."""
+
+    def __init__(self, rules: Sequence[Tuple[str, PartitionSpec]] = (),
+                 default: PartitionSpec = PartitionSpec()):
+        self.rules: List[Tuple[re.Pattern, PartitionSpec]] = [
+            (re.compile(pat), spec) for pat, spec in rules
+        ]
+        self.default = default
+
+    def add(self, pattern: str, spec: PartitionSpec) -> "ShardingPlan":
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def extend(self, other: "ShardingPlan") -> "ShardingPlan":
+        self.rules.extend(other.rules)
+        return self
+
+    def spec_for(self, name: str, shape: Tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return _legalize(spec, shape, mesh)
+        return _legalize(self.default, shape, mesh)
+
+    def shard(self, name: str, arr: jax.Array, mesh: Mesh) -> jax.Array:
+        spec = self.spec_for(name, tuple(arr.shape), mesh)
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    def shard_tree(self, params: Dict[str, jax.Array], mesh: Mesh
+                   ) -> Dict[str, jax.Array]:
+        return {n: self.shard(n, a, mesh) for n, a in params.items()}
+
+    def specs_tree(self, params: Dict[str, jax.Array], mesh: Mesh
+                   ) -> Dict[str, PartitionSpec]:
+        return {n: self.spec_for(n, tuple(a.shape), mesh)
+                for n, a in params.items()}
+
+
+def replicated_plan() -> ShardingPlan:
+    """Pure data parallelism: every parameter replicated (the reference's
+    KVStore broadcast semantics, comm.h Broadcast)."""
+    return ShardingPlan()
+
+
+def fsdp_plan(axis: str = "fsdp", min_size: int = 1024) -> ShardingPlan:
+    """ZeRO-3 style: shard every parameter's largest dim over ``axis``.
+
+    Implemented as a dynamic plan (shape-dependent), so spec_for is
+    overridden rather than rule-driven.
+    """
+
+    class _FSDP(ShardingPlan):
+        def spec_for(self, name, shape, mesh):
+            for pat, spec in self.rules:
+                if pat.search(name):
+                    return _legalize(spec, shape, mesh)
+            if not shape:
+                return PartitionSpec()
+            n = mesh.shape.get(axis, 1)
+            size = 1
+            for s in shape:
+                size *= s
+            if n == 1 or size < min_size:
+                return PartitionSpec()
+            # shard the largest evenly-divisible dim
+            order = sorted(range(len(shape)), key=lambda i: -shape[i])
+            for i in order:
+                if shape[i] % n == 0:
+                    spec = [None] * len(shape)
+                    spec[i] = axis
+                    return PartitionSpec(*spec)
+            return PartitionSpec()
+
+    return _FSDP()
+
+
+def tensor_parallel_plan(axis: str = "tp") -> ShardingPlan:
+    """Megatron-style transformer sharding by structural-name convention:
+
+    - qkv / gate+up projections: shard output features (column parallel)
+    - attention output / MLP down projection: shard input features (row
+      parallel) — XLA inserts the all-reduce after the matmul
+    - embeddings: shard vocab dim
+    - norms / biases of row-parallel layers: replicated
+    """
+    return ShardingPlan([
+        (r"(qkv|query|key|value|q_proj|k_proj|v_proj|ffn_1|fc1|up|gate|inter)"
+         r".*weight$", Spec(axis, None)),
+        (r"(qkv|query|key|value|q_proj|k_proj|v_proj|ffn_1|fc1|up|gate|inter)"
+         r".*bias$", Spec(axis)),
+        (r"(out_proj|o_proj|proj|ffn_2|fc2|down|output).*weight$",
+         Spec(None, axis)),
+        (r"embed.*weight$", Spec(axis, None)),
+    ])
+
+
+def shard_array(arr: jax.Array, mesh: Mesh, spec: PartitionSpec) -> jax.Array:
+    return jax.device_put(arr, NamedSharding(mesh, _legalize(spec, tuple(arr.shape), mesh)))
+
+
+def constraint(x, spec: Union[PartitionSpec, Sequence], mesh: Optional[Mesh] = None):
+    """``lax.with_sharding_constraint`` that tolerates running outside jit /
+    without a mesh (no-op) — keeps model code mesh-agnostic."""
+    try:
+        if mesh is not None:
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x

@@ -1,0 +1,73 @@
+"""Headline benchmark: ResNet-50 ImageNet-shape training throughput.
+
+Matches the reference's benchmark_score.py methodology (synthetic data,
+steady-state img/s; docs perf.md tables — V100 fp32 training = 298.51 img/s
+at bs32, the BASELINE.md reference point).  The whole train step (fwd, bwd,
+SGD-momentum update) is one donated XLA program via ShardedTrainer on a
+1-chip mesh.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env overrides: BENCH_MODEL, BENCH_BATCH, BENCH_IMG, BENCH_STEPS.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+V100_RESNET50_TRAIN_IMGS_PER_SEC = 298.51  # reference perf.md:252, bs32 fp32
+
+
+def main():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    model_name = os.environ.get("BENCH_MODEL", "resnet50_v1")
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    img = int(os.environ.get("BENCH_IMG", "224"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    net = vision.get_model(model_name, classes=1000)
+    net.initialize(mx.init.Xavier())
+    # one eager probe completes deferred shape inference for conv/bn params
+    net(mx.nd.zeros((1, 3, img, img)))
+    ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    mesh = par.make_mesh({"dp": 1})
+    tr = par.ShardedTrainer(
+        net, lambda o, l: ce(o, l).mean(), mesh, optimizer="sgd",
+        optimizer_params={"lr": 0.1, "momentum": 0.9, "wd": 1e-4})
+
+    import jax
+
+    rng = onp.random.RandomState(0)
+    data = rng.rand(batch, 3, img, img).astype(onp.float32)
+    label = rng.randint(0, 1000, (batch,)).astype(onp.int32)
+    data, label = tr.stage(data, label)  # host->HBM once
+
+    tr.step(data, label)  # compile + sync
+    tr.step(data, label)  # warm + sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = tr.step(data, label, sync=False)  # enqueue back-to-back
+    jax.block_until_ready(jax.tree_util.tree_leaves(tr.params))
+    dt = time.perf_counter() - t0
+    imgs_per_sec = batch * steps / dt
+
+    print(json.dumps({
+        "metric": f"{model_name}_train_throughput_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(imgs_per_sec / V100_RESNET50_TRAIN_IMGS_PER_SEC,
+                             3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,4 @@
 """Predefined models (reference ``python/mxnet/gluon/model_zoo/``)."""
-from . import vision
+from . import bert, vision
+from .bert import BERTModel, bert_base, bert_small
 from .vision import get_model

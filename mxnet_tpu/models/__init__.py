@@ -1,0 +1,14 @@
+"""mxnet_tpu.models — TPU-native scale recipes for flagship models.
+
+Gluon model zoo (``mxnet_tpu.gluon.model_zoo``) carries the user-API parity
+models (resnet/vgg/...); this package carries models written directly against
+the parallel layer, where the training step itself is the designed artifact
+(sharding plan + collectives + pipeline schedule), per SURVEY.md §7 step 6+9.
+"""
+from . import transformer_lm
+from .transformer_lm import (TransformerLMConfig, forward, init_opt_state,
+                             init_params, loss_fn, make_train_step,
+                             sharding_plan)
+
+__all__ = ["transformer_lm", "TransformerLMConfig", "forward", "init_params",
+           "init_opt_state", "loss_fn", "make_train_step", "sharding_plan"]

@@ -1,0 +1,257 @@
+"""Flagship TPU-native transformer LM (BERT-class encoder).
+
+The reference's transformer story is a handful of fused CUDA matmul ops
+(src/operator/contrib/transformer.cc:650-740) consumed by external GluonNLP
+models; its parallelism story is data-parallel KVStore only (SURVEY.md §2.3).
+This module is the TPU-first flagship: one model whose *training step* is a
+single SPMD program exercising every mesh axis —
+
+- ``dp``   batch sharding (gradient all-reduce inserted by XLA)
+- ``fsdp`` parameter/optimizer sharding on top of dp
+- ``tp``   megatron-style column/row-parallel attention + MLP
+- ``sp``   ring attention over the sequence axis (parallel.ring_attention)
+- ``ep``   mixture-of-experts FFN with experts sharded over ``ep``
+- ``pp``   identical-stage pipeline over depth (parallel.pipeline)
+
+Parameters are a flat ``{name: jax.Array}`` pytree (structural names match
+gluon conventions so ShardingPlan rules apply unchanged); the gluon-facing
+BERT lives in ``gluon/model_zoo/bert.py`` and shares nothing but math —
+that one is the user-API parity surface, this one is the scale recipe.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel import moe as _moe
+from ..parallel import ring_attention as _ring_mod  # noqa: F401 (module import)
+from ..parallel.ring_attention import ring_attention_sharded as _ring_attention_sharded
+from ..parallel.sharding import ShardingPlan, constraint
+
+__all__ = ["TransformerLMConfig", "init_params", "forward", "loss_fn",
+           "sharding_plan", "make_train_step", "init_opt_state"]
+
+
+@dataclasses.dataclass
+class TransformerLMConfig:
+    vocab_size: int = 30528          # bert-base vocab rounded to 64
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden: int = 768
+    mlp_hidden: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16        # MXU-native compute dtype
+    # MoE: 0 = dense MLP everywhere; k>0 = every layer is a top-k MoE
+    num_experts: int = 0
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    # parallel toggles (consumed by make_train_step)
+    use_ring_attention: bool = False
+    remat: bool = False              # jax.checkpoint each layer
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.num_heads
+
+
+def _split(key, n):
+    return jax.random.split(key, n)
+
+
+def init_params(key, cfg: TransformerLMConfig) -> Dict[str, jax.Array]:
+    """Flat param dict; truncated-normal(0.02) like BERT."""
+    H, M, V = cfg.hidden, cfg.mlp_hidden, cfg.vocab_size
+    p: Dict[str, jax.Array] = {}
+    k_embed, k_pos, key = _split(key, 3)
+    init = lambda k, shape, scale=0.02: (
+        jax.random.truncated_normal(k, -2, 2, shape, jnp.float32) * scale
+    ).astype(cfg.dtype)
+    p["embed.weight"] = init(k_embed, (V, H))
+    p["pos_embed.weight"] = init(k_pos, (cfg.max_len, H))
+    for i in range(cfg.num_layers):
+        ks = _split(key, 8)
+        key = ks[-1]
+        pre = f"layer{i}."
+        p[pre + "attn.qkv.weight"] = init(ks[0], (3 * H, H))
+        p[pre + "attn.qkv.bias"] = jnp.zeros((3 * H,), cfg.dtype)
+        p[pre + "attn.out_proj.weight"] = init(
+            ks[1], (H, H), 0.02 / math.sqrt(2 * cfg.num_layers))
+        p[pre + "attn.out_proj.bias"] = jnp.zeros((H,), cfg.dtype)
+        p[pre + "ln1.gamma"] = jnp.ones((H,), jnp.float32)
+        p[pre + "ln1.beta"] = jnp.zeros((H,), jnp.float32)
+        p[pre + "ln2.gamma"] = jnp.ones((H,), jnp.float32)
+        p[pre + "ln2.beta"] = jnp.zeros((H,), jnp.float32)
+        if cfg.num_experts:
+            E = cfg.num_experts
+            p[pre + "moe.gate.weight"] = init(ks[2], (H, E))
+            p[pre + "expert.ffn_1.weight"] = init(ks[3], (E, H, M))
+            p[pre + "expert.ffn_2.weight"] = init(
+                ks[4], (E, M, H), 0.02 / math.sqrt(2 * cfg.num_layers))
+        else:
+            p[pre + "ffn_1.weight"] = init(ks[2], (M, H))
+            p[pre + "ffn_1.bias"] = jnp.zeros((M,), cfg.dtype)
+            p[pre + "ffn_2.weight"] = init(
+                ks[3], (H, M), 0.02 / math.sqrt(2 * cfg.num_layers))
+            p[pre + "ffn_2.bias"] = jnp.zeros((H,), cfg.dtype)
+    p["final_ln.gamma"] = jnp.ones((H,), jnp.float32)
+    p["final_ln.beta"] = jnp.zeros((H,), jnp.float32)
+    return p
+
+
+def _layer_norm(x, gamma, beta, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * lax.rsqrt(var + eps) * gamma + beta
+    return out.astype(x.dtype)
+
+
+def _attention(x, p, pre, cfg: TransformerLMConfig, mesh: Optional[Mesh]):
+    B, S, H = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    qkv = x @ p[pre + "attn.qkv.weight"].T + p[pre + "attn.qkv.bias"]
+    qkv = qkv.reshape(B, S, 3, nh, hd)
+    q, k, v = (jnp.moveaxis(qkv[:, :, j], 2, 1) for j in range(3))  # B,nh,S,hd
+    if cfg.use_ring_attention and mesh is not None and \
+            mesh.shape.get("sp", 1) > 1:
+        # sequence stays sharded over sp; ring rotates K/V via ICI neighbours
+        out = _ring_attention_sharded(
+            q, k, v, mesh, axis_name="sp",
+            batch_axes=("dp", "fsdp"))
+    else:
+        scale = 1.0 / math.sqrt(hd)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1),
+                         v.astype(jnp.float32)).astype(x.dtype)
+    out = jnp.moveaxis(out, 1, 2).reshape(B, S, H)
+    return out @ p[pre + "attn.out_proj.weight"].T + p[pre + "attn.out_proj.bias"]
+
+
+def _mlp(x, p, pre, cfg: TransformerLMConfig):
+    if cfg.num_experts:
+        B, S, H = x.shape
+        out, aux = _moe.moe_layer(
+            x, p[pre + "moe.gate.weight"].astype(x.dtype),
+            p[pre + "expert.ffn_1.weight"], p[pre + "expert.ffn_2.weight"],
+            k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor)
+        return out, aux
+    h = jax.nn.gelu(x @ p[pre + "ffn_1.weight"].T + p[pre + "ffn_1.bias"])
+    return h @ p[pre + "ffn_2.weight"].T + p[pre + "ffn_2.bias"], 0.0
+
+
+def forward(params, tokens, cfg: TransformerLMConfig,
+            mesh: Optional[Mesh] = None) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, S] int32 -> (logits [B, S, V] float32, moe aux loss)."""
+    B, S = tokens.shape
+    x = params["embed.weight"][tokens] + params["pos_embed.weight"][:S]
+    x = x.astype(cfg.dtype)
+    aux_total = 0.0
+
+    def one_layer(x, i):
+        pre = f"layer{i}."
+        h = _attention(_layer_norm(x, params[pre + "ln1.gamma"],
+                                   params[pre + "ln1.beta"]),
+                       params, pre, cfg, mesh)
+        x = x + h
+        m, aux = _mlp(_layer_norm(x, params[pre + "ln2.gamma"],
+                                  params[pre + "ln2.beta"]),
+                      params, pre, cfg)
+        return x + m, aux
+
+    layer_fn = jax.checkpoint(one_layer, static_argnums=(1,)) if cfg.remat \
+        else one_layer
+    for i in range(cfg.num_layers):
+        x, aux = layer_fn(x, i)
+        aux_total = aux_total + aux
+    x = _layer_norm(x, params["final_ln.gamma"], params["final_ln.beta"])
+    logits = (x @ params["embed.weight"].T.astype(cfg.dtype))
+    return logits.astype(jnp.float32), jnp.asarray(aux_total, jnp.float32)
+
+
+def loss_fn(params, tokens, labels, cfg: TransformerLMConfig,
+            mesh: Optional[Mesh] = None, aux_weight: float = 0.01):
+    """Masked-LM style CE: labels [B,S] int32, -1 = unmasked (ignored)."""
+    logits, aux = forward(params, tokens, cfg, mesh)
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(nll) / denom + aux_weight * aux
+
+
+def sharding_plan(cfg: TransformerLMConfig) -> ShardingPlan:
+    """tp over attention/MLP (megatron), ep over experts, embeddings over tp;
+    everything composes with fsdp via rule order (tp rules first, fsdp
+    handled by the caller stacking plans)."""
+    plan = ShardingPlan([
+        (r"attn\.qkv\.weight$", P(("tp",), None)),
+        (r"attn\.qkv\.bias$", P("tp")),
+        (r"attn\.out_proj\.weight$", P(None, "tp")),
+        (r"expert\.ffn_1\.weight$", P("ep", None, "tp")),
+        (r"expert\.ffn_2\.weight$", P("ep", "tp", None)),
+        (r"(^|\.)ffn_1\.weight$", P("tp", None)),
+        (r"(^|\.)ffn_1\.bias$", P("tp")),
+        (r"(^|\.)ffn_2\.weight$", P(None, "tp")),
+        (r"embed\.weight$", P("tp", None)),
+    ])
+    return plan
+
+
+def init_opt_state(params):
+    """Adam/LAMB first+second moments, sharded like the params."""
+    zeros = lambda a: jnp.zeros(a.shape, jnp.float32)
+    return ({n: zeros(a) for n, a in params.items()},
+            {n: zeros(a) for n, a in params.items()})
+
+
+def make_train_step(cfg: TransformerLMConfig, mesh: Mesh,
+                    optimizer: str = "adam", lr: float = 1e-4,
+                    beta1: float = 0.9, beta2: float = 0.999,
+                    epsilon: float = 1e-8, wd: float = 0.01):
+    """Build the jitted SPMD train step.
+
+    Batch is sharded over (dp, fsdp); sequence over sp; XLA derives the rest
+    from the parameter shardings.  Buffer donation on params+opt state.
+    """
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+    seq_axis = "sp" if "sp" in mesh.shape else None
+    batch_spec = P(data_axes if data_axes else None, seq_axis)
+
+    def step(params, opt_m, opt_v, tokens, labels, t):
+        tokens = constraint(tokens, batch_spec)
+        labels = constraint(labels, batch_spec)
+
+        def lf(ps):
+            return loss_fn(ps, tokens, labels, cfg, mesh)
+
+        loss, grads = jax.value_and_grad(lf)(params)
+        new_p, new_m, new_v = {}, {}, {}
+        lr_t = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+        for n, w in params.items():
+            g = grads[n].astype(jnp.float32)
+            m = beta1 * opt_m[n] + (1 - beta1) * g
+            v = beta2 * opt_v[n] + (1 - beta2) * jnp.square(g)
+            upd = m / (jnp.sqrt(v) + epsilon)
+            wf = w.astype(jnp.float32)
+            if optimizer == "lamb":
+                upd = upd + wd * wf
+                r1 = jnp.linalg.norm(wf)
+                r2 = jnp.linalg.norm(upd)
+                trust = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+                new_w = wf - lr * trust * upd
+            else:  # adamw-style decoupled decay
+                new_w = wf - lr_t * upd - lr * wd * wf
+            new_p[n] = new_w.astype(w.dtype)
+            new_m[n], new_v[n] = m, v
+        return new_p, new_m, new_v, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))

@@ -80,8 +80,10 @@ def axis_size(axis_name: str):
 def broadcast_from(x, axis_name: str, src: int = 0):
     """KVStore Broadcast analog: every member gets src's shard (masked
     all-reduce; XLA lowers this to a broadcast-shaped collective)."""
-    mask = (lax.axis_index(axis_name) == src).astype(x.dtype)
-    return lax.psum(x * mask, axis_name)
+    is_src = lax.axis_index(axis_name) == src
+    # select (not multiply): non-source shards may hold inf/NaN garbage and
+    # 0*inf would poison the psum
+    return lax.psum(jnp.where(is_src, x, jnp.zeros_like(x)), axis_name)
 
 
 def run_sharded(fn: Callable, mesh: Mesh, in_specs, out_specs,

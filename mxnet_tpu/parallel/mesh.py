@@ -69,6 +69,10 @@ def auto_mesh(n_devices: Optional[int] = None, *, dp: Optional[int] = None,
         if n_devices % fixed:
             raise ValueError(f"{n_devices} devices not divisible by {fixed}")
         dp = n_devices // fixed
+    elif dp * fixed != n_devices:
+        raise ValueError(
+            f"dp={dp} * (tp*sp*ep*pp*fsdp={fixed}) != n_devices={n_devices}; "
+            f"would strand {n_devices - dp * fixed} devices")
     axes = {}
     for name, size in (("pp", pp), ("dp", dp), ("fsdp", fsdp), ("ep", ep),
                        ("sp", sp), ("tp", tp)):

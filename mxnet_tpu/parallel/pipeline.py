@@ -23,7 +23,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-__all__ = ["pipeline_apply", "stack_stage_params"]
+__all__ = ["pipeline_apply", "pipelined", "stack_stage_params"]
 
 
 def stack_stage_params(per_stage_params):

@@ -160,11 +160,17 @@ def shard_array(arr: jax.Array, mesh: Mesh, spec: PartitionSpec) -> jax.Array:
 
 
 def constraint(x, spec: Union[PartitionSpec, Sequence], mesh: Optional[Mesh] = None):
-    """``lax.with_sharding_constraint`` that tolerates running outside jit /
-    without a mesh (no-op) — keeps model code mesh-agnostic."""
-    try:
-        if mesh is not None:
-            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    """``lax.with_sharding_constraint`` that no-ops only when there is
+    genuinely no mesh in scope (keeps model code mesh-agnostic).  With a mesh
+    present, a spec naming an unknown axis still raises — a typo'd axis must
+    not silently drop the constraint."""
+    if mesh is None:
+        from .mesh import current_mesh
+
+        mesh = current_mesh()
+    if mesh is None:
+        ambient = jax.sharding.get_abstract_mesh()
+        if ambient is None or not ambient.shape:
+            return x  # no mesh anywhere: mesh-agnostic no-op
         return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, RuntimeError):
-        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -169,8 +169,10 @@ class ShardedTrainer:
                 beta2=self.beta2, epsilon=self.epsilon, wd=wd)
             return new_w, (m, v)
         if self.opt == "adamw":
+            # bias-corrected lr, matching optimizer/adam.py correct_bias=True
+            lr_t = _bias_corrected_lr(lr, self.beta1, self.beta2, t)
             new_w, m, v = opt_ops.adamw_update(
-                [w, g, state[0], state[1]], lr=lr, beta1=self.beta1,
+                [w, g, state[0], state[1]], lr=lr_t, beta1=self.beta1,
                 beta2=self.beta2, epsilon=self.epsilon, wd=wd)
             return new_w, (m, v)
         if self.opt == "lamb":
@@ -184,7 +186,9 @@ class ShardedTrainer:
         raise ValueError(self.opt)
 
     # -- the step --------------------------------------------------------
-    def _build(self, data_shape, data_dtype, label_shape, label_dtype):
+    def _build(self):
+        # (jit itself re-specializes by shape; the _jitted cache keyed on the
+        # input signature only avoids re-wrapping)
         block, loss_fn = self.block, self.loss_fn
         names, grad_names = self.names, self.grad_names
         frozen = [n for n in names if n not in grad_names]
@@ -228,22 +232,30 @@ class ShardedTrainer:
             x = jnp.asarray(x)
         return jax.device_put(x, NamedSharding(self.mesh, spec))
 
-    def step(self, data, label) -> float:
-        """One sync step; returns the (host) loss. All comm is inside jit."""
+    def step(self, data, label, sync: bool = True):
+        """One step; all comm is inside jit.  ``sync=True`` returns the host
+        loss (a device round-trip per step — the reference's WaitToRead);
+        ``sync=False`` returns the device loss array so steps enqueue
+        asynchronously back-to-back (the dependency-engine overlap story)."""
         with self.mesh:
             data = self._put(data, self.batch_spec)
             label = self._put(label, self.label_spec)
             sig = (data.shape, str(data.dtype), label.shape, str(label.dtype))
             fn = self._jitted.get(sig)
             if fn is None:
-                fn = self._build(*sig)
+                fn = self._build()
                 self._jitted[sig] = fn
             self.step_count += 1
             key = _random.next_key()
             self.params, self.opt_state, loss = fn(
                 self.params, self.opt_state, data, label, key,
                 jnp.asarray(self.step_count, dtype=jnp.float32))
-        return float(loss)
+        return float(loss) if sync else loss
+
+    def stage(self, data, label):
+        """Pre-place a batch on the mesh (host->HBM once, reusable)."""
+        return (self._put(data, self.batch_spec),
+                self._put(label, self.label_spec))
 
     def sync_to_block(self):
         """Write trained parameters back into the Block's Parameters

@@ -546,8 +546,8 @@ def _on_tape(arr) -> bool:
     )
 
 
-def _wrap(data: jax.Array, ctx: Context) -> "NDArray":
-    out = NDArray.__new__(NDArray)
+def _wrap(data: jax.Array, ctx: Context, cls=None) -> "NDArray":
+    out = (cls or NDArray).__new__(cls or NDArray)
     out._data = data
     out._ctx = ctx
     out._version = 0
@@ -613,7 +613,15 @@ def invoke(
 
     multi = isinstance(raw_out, (tuple, list))
     outs_raw = list(raw_out) if multi else [raw_out]
-    outputs = [_wrap(o, ctx) for o in outs_raw]
+    # outputs keep the array *flavor* of the inputs: dispatching an op on an
+    # mx.np ndarray yields mx.np ndarrays (reference keeps np/nd worlds apart
+    # via distinct generated namespaces; here one registry serves both)
+    out_cls = NDArray
+    for i in inputs:
+        if isinstance(i, NDArray) and type(i) is not NDArray:
+            out_cls = type(i)
+            break
+    outputs = [_wrap(o, ctx, out_cls) for o in outs_raw]
 
     if record:
         node = autograd.TapeNode(

@@ -1,0 +1,280 @@
+"""``mx.np`` — NumPy-compatible array API on TPU.
+
+Reference analog: ``python/mxnet/numpy/`` (~42k LoC of ``_npi_*`` operator
+wrappers, `multiarray.py`, dispatch/fallback protocol modules).  Here the
+whole surface is generated over ``jax.numpy`` through one autograd-aware
+dispatcher (:func:`.multiarray.apply_np`); names jnp lacks fall back to host
+NumPy (the reference's ``numpy_op_fallback.py`` idea).
+"""
+from __future__ import annotations
+
+import sys as _sys
+import types as _types
+
+import jax as _jax
+import jax.numpy as _jnp
+import numpy as _onp
+
+from ..context import current_context as _current_context
+from ..ndarray.ndarray import NDArray as _NDArray, _wrap as _wrap_arr
+from .multiarray import (apply_np, array, asarray, default_dtype, from_nd,
+                         ndarray)
+
+_this = _sys.modules[__name__]
+
+# --- dtypes & constants ----------------------------------------------------
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+bfloat16 = _jnp.bfloat16
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+euler_gamma = _onp.euler_gamma
+dtype = _onp.dtype
+integer = _onp.integer
+floating = _onp.floating
+
+# --- generated jnp-delegating function surface -----------------------------
+# Each name maps 1:1 onto a jax.numpy callable; arrays anywhere in the
+# args/kwargs are unwrapped, outputs wrapped, and the call recorded on the
+# autograd tape when recording (reference generates these per-op from the
+# C++ registry; see python/mxnet/numpy/multiarray.py and src/api/operator/).
+_JNP_FUNCS = [
+    # manipulation
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "broadcast_to", "broadcast_arrays",
+    "concatenate", "stack", "vstack", "hstack", "dstack", "column_stack",
+    "row_stack", "split", "array_split", "hsplit", "vsplit", "dsplit",
+    "tile", "repeat", "roll", "rot90", "flip", "fliplr", "flipud",
+    "append", "pad", "trim_zeros", "atleast_1d", "atleast_2d", "atleast_3d",
+    # search/sort/unique
+    "unique", "sort", "argsort", "searchsorted", "where", "take",
+    "take_along_axis", "clip", "diag", "diagonal", "diagflat", "trace",
+    "tril", "triu", "extract", "flatnonzero", "argwhere", "nonzero",
+    "count_nonzero", "partition", "argpartition", "lexsort",
+    # elementwise math
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "mod", "remainder", "fmod", "divmod", "power", "negative", "positive",
+    "absolute", "abs", "fabs", "sign", "floor", "ceil",
+    "trunc", "around", "round", "rint", "exp", "expm1", "exp2", "log", "log2",
+    "log10", "log1p", "sqrt", "cbrt", "square", "reciprocal",
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "arctan2",
+    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "degrees", "radians", "deg2rad", "rad2deg", "hypot",
+    "maximum", "minimum", "fmax", "fmin", "heaviside", "copysign",
+    "ldexp", "frexp", "logaddexp", "logaddexp2", "gcd", "lcm", "interp",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
+    "left_shift", "right_shift", "sinc", "i0", "nan_to_num", "real", "imag",
+    "conjugate", "conj", "angle",
+    # linear algebra
+    "matmul", "dot", "vdot", "inner", "outer", "tensordot", "einsum",
+    "kron", "cross", "convolve", "correlate",
+    # reductions & statistics
+    "sum", "prod", "mean", "std", "var", "min", "max", "amin", "amax",
+    "argmin", "argmax", "ptp", "median", "percentile", "quantile",
+    "average", "nansum", "nanprod", "nanmean", "nanstd", "nanvar",
+    "nanmin", "nanmax", "nanargmin", "nanargmax", "nanmedian",
+    "nanpercentile", "nanquantile", "cumsum", "cumprod", "nancumsum",
+    "nancumprod", "all", "any", "diff", "ediff1d", "gradient",
+    "histogram", "histogram2d", "histogram_bin_edges", "bincount",
+    "digitize", "corrcoef", "cov",
+    # logic
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "isnan", "isinf", "isfinite", "isneginf", "isposinf", "isclose",
+    "allclose", "array_equal", "array_equiv", "signbit", "iscomplexobj",
+    "isrealobj", "isreal", "iscomplex",
+    # sets
+    "intersect1d", "union1d", "setdiff1d", "setxor1d", "in1d", "isin",
+    # polynomials / misc
+    "polyval", "polyadd", "polysub", "polymul", "polyder", "polyint",
+    "vander", "unwrap", "unravel_index", "ravel_multi_index",
+    "apply_along_axis", "piecewise", "select", "choose", "compress",
+    "resize",
+    "meshgrid", "indices", "tril_indices", "triu_indices", "diag_indices",
+    "result_type", "promote_types", "can_cast", "shape", "ndim", "size",
+    "iterable", "isscalar",
+]
+
+
+def _make_fn(name):
+    jfn = getattr(_jnp, name)
+
+    def fn(*args, **kwargs):
+        return apply_np(jfn, name, args, kwargs)
+
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = (jfn.__doc__ or "") and (
+        f"mx.np.{name} — NumPy-semantics op lowered via jax.numpy.{name}.\n\n"
+        + (jfn.__doc__ or ""))
+    return fn
+
+
+for _name in _JNP_FUNCS:
+    if hasattr(_jnp, _name) and not hasattr(_this, _name):
+        setattr(_this, _name, _make_fn(_name))
+
+
+# --- creation functions (need ctx/device handling) -------------------------
+def _create(jfn, args, kwargs, dtype=None, ctx=None):
+    ctx = ctx or _current_context()
+    data = jfn(*args, **kwargs)
+    if dtype is not None:
+        from ..ndarray.ndarray import _dtype_np
+
+        data = data.astype(_dtype_np(dtype))
+    return _wrap_arr(_jax.device_put(data, ctx.jax_device), ctx, ndarray)
+
+
+def zeros(shape, dtype=None, order="C", ctx=None, device=None):
+    return _create(_jnp.zeros, (shape,), {"dtype": dtype or default_dtype()},
+                   ctx=device or ctx)
+
+
+def ones(shape, dtype=None, order="C", ctx=None, device=None):
+    return _create(_jnp.ones, (shape,), {"dtype": dtype or default_dtype()},
+                   ctx=device or ctx)
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None, device=None):
+    if dtype is None and isinstance(fill_value, float):
+        dtype = default_dtype()  # ints/bools follow fill_value like numpy
+    return _create(_jnp.full, (shape, fill_value), {"dtype": dtype},
+                   ctx=device or ctx)
+
+
+def empty(shape, dtype=None, order="C", ctx=None, device=None):
+    return zeros(shape, dtype=dtype, ctx=device or ctx)
+
+
+def zeros_like(a, dtype=None, order="C", ctx=None, device=None):
+    return apply_np(_jnp.zeros_like, "zeros_like", (a,), {"dtype": dtype})
+
+
+def ones_like(a, dtype=None, order="C", ctx=None, device=None):
+    return apply_np(_jnp.ones_like, "ones_like", (a,), {"dtype": dtype})
+
+
+def full_like(a, fill_value, dtype=None, order="C", ctx=None, device=None):
+    return apply_np(_jnp.full_like, "full_like", (a, fill_value),
+                    {"dtype": dtype})
+
+
+def empty_like(a, dtype=None, order="C", ctx=None, device=None):
+    return zeros_like(a, dtype=dtype)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    return _create(_jnp.arange, (start, stop, step), {"dtype": dtype},
+                   ctx=device or ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    if retstep:
+        data, step = _jnp.linspace(start, stop, num, endpoint=endpoint,
+                                   retstep=True, dtype=dtype, axis=axis)
+        ctx = device or ctx or _current_context()
+        return _wrap_arr(data, ctx, ndarray), float(step)
+    return _create(_jnp.linspace, (start, stop, num),
+                   {"endpoint": endpoint, "dtype": dtype, "axis": axis},
+                   ctx=device or ctx)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None, device=None):
+    return _create(_jnp.logspace, (start, stop, num),
+                   {"endpoint": endpoint, "base": base, "dtype": dtype,
+                    "axis": axis}, ctx=device or ctx)
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None, device=None):
+    return _create(_jnp.eye, (N, M, k), {"dtype": dtype or default_dtype()},
+                   ctx=device or ctx)
+
+
+def identity(n, dtype=None, ctx=None, device=None):
+    return eye(n, dtype=dtype, ctx=device or ctx)
+
+
+def copy(a):
+    return asarray(a).copy()
+
+
+def may_share_memory(a, b, max_work=None):
+    return False  # functional arrays never alias from the user's view
+
+
+def shares_memory(a, b, max_work=None):
+    return False
+
+
+def insert(arr, obj, values, axis=None):
+    return apply_np(_jnp.insert, "insert", (arr, obj, values),
+                    {"axis": axis})
+
+
+def delete(arr, obj, axis=None):
+    return apply_np(_jnp.delete, "delete", (arr, obj), {"axis": axis})
+
+
+# --- submodules ------------------------------------------------------------
+from . import linalg  # noqa: E402
+from . import random  # noqa: E402
+
+_sys.modules[__name__ + ".linalg"] = linalg
+_sys.modules[__name__ + ".random"] = random
+
+
+# --- host-numpy fallback for the long tail ---------------------------------
+def _fallback(name):
+    """Reference ``numpy_op_fallback.py``: run on host numpy, wrap result.
+    Synchronizes (host transfer) — fine for the rare tail ops."""
+    ofn = getattr(_onp, name)
+
+    def fn(*args, **kwargs):
+        def unwrap(o):
+            if isinstance(o, _NDArray):
+                return o.asnumpy()
+            if isinstance(o, (tuple, list)):
+                return type(o)(unwrap(x) for x in o)
+            return o
+
+        res = ofn(*unwrap(list(args)), **{k: unwrap(v)
+                                          for k, v in kwargs.items()})
+
+        def wrap(o):
+            if isinstance(o, _onp.ndarray):
+                return array(o, dtype=o.dtype)
+            if isinstance(o, (tuple, list)):
+                return type(o)(wrap(x) for x in o)
+            return o
+
+        return wrap(res)
+
+    fn.__name__ = name
+    return fn
+
+
+def __getattr__(name):
+    if not name.startswith("_") and hasattr(_onp, name):
+        attr = getattr(_onp, name)
+        if callable(attr) and not isinstance(attr, type):
+            fn = _fallback(name)
+            setattr(_this, name, fn)
+            return fn
+        return attr
+    raise AttributeError(f"module 'mxnet_tpu.numpy' has no attribute {name!r}")

@@ -1,0 +1,40 @@
+"""``mx.np.linalg`` — NumPy linalg over XLA.
+
+Reference analog: ``src/operator/numpy/linalg/`` (eig/svd/solve/… custom
+CUDA+LAPACK kernels, ~8k LoC).  On TPU these are XLA's native decompositions
+via ``jax.numpy.linalg`` — nothing to hand-write.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+import jax.numpy as _jnp
+
+from .multiarray import apply_np
+
+_this = _sys.modules[__name__]
+
+_FUNCS = [
+    "norm", "svd", "svdvals", "inv", "pinv", "det", "slogdet", "eig",
+    "eigh", "eigvals", "eigvalsh", "cholesky", "qr", "solve", "lstsq",
+    "matrix_rank", "matrix_power", "matrix_norm", "vector_norm",
+    "tensorinv", "tensorsolve", "multi_dot", "cond", "matrix_transpose",
+    "outer", "cross", "diagonal", "trace", "vecdot",
+]
+
+
+def _make(name):
+    jfn = getattr(_jnp.linalg, name)
+
+    def fn(*args, **kwargs):
+        return apply_np(jfn, f"linalg.{name}", args, kwargs)
+
+    fn.__name__ = name
+    return fn
+
+
+for _name in _FUNCS:
+    if hasattr(_jnp.linalg, _name):
+        setattr(_this, _name, _make(_name))
+
+__all__ = [n for n in _FUNCS if hasattr(_this, n)]

@@ -1,0 +1,305 @@
+"""``mx.np.ndarray`` — the NumPy-semantics array.
+
+Reference analog: ``python/mxnet/numpy/multiarray.py`` (~10k LoC of
+hand-written wrappers over ``_npi_*`` C++ ops).  TPU-native design: the
+array *is* an :class:`mxnet_tpu.ndarray.NDArray` subclass (same jax.Array
+storage, same tape) and the operator surface is *generated* by delegating
+straight to ``jax.numpy`` — which already implements NumPy semantics as XLA
+lowerings — through one autograd-aware dispatcher (:func:`apply_np`).
+Reference ops like ``_npi_add`` (src/api/operator/) become direct jnp calls;
+there is nothing to port because XLA is the kernel library.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import autograd
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, _wrap
+from ..util import is_np_default_dtype
+
+__all__ = ["ndarray", "apply_np", "array", "asarray", "from_nd", "default_dtype"]
+
+
+def default_dtype():
+    return onp.float64 if is_np_default_dtype() else onp.float32
+
+
+# ---------------------------------------------------------------------------
+# generic autograd-aware dispatch over arbitrary jnp callables
+# ---------------------------------------------------------------------------
+
+
+def _collect(obj, leaves):
+    """Replace NDArray leaves in a nested (tuple/list/dict) structure with
+    positional placeholders; return a rebuildable spec."""
+    if isinstance(obj, NDArray):
+        leaves.append(obj)
+        return ("_leaf_", len(leaves) - 1)
+    if isinstance(obj, (tuple, list)):
+        return type(obj)(_collect(o, leaves) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _collect(v, leaves) for k, v in obj.items()}
+    return obj
+
+
+def _rebuild(spec, arrays):
+    if isinstance(spec, tuple) and len(spec) == 2 and spec[0] == "_leaf_":
+        return arrays[spec[1]]
+    if isinstance(spec, (tuple, list)):
+        return type(spec)(_rebuild(s, arrays) for s in spec)
+    if isinstance(spec, dict):
+        return {k: _rebuild(v, arrays) for k, v in spec.items()}
+    return spec
+
+
+def _wrap_out(obj, ctx, cls):
+    if isinstance(obj, jax.Array):
+        return _wrap(obj, ctx, cls)
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # NamedTuple
+        return type(obj)(*(_wrap_out(o, ctx, cls) for o in obj))
+    if isinstance(obj, (tuple, list)):
+        return type(obj)(_wrap_out(o, ctx, cls) for o in obj)
+    return obj
+
+
+def _out_leaves(obj, acc):
+    if isinstance(obj, NDArray):
+        acc.append(obj)
+    elif isinstance(obj, (tuple, list)):
+        for o in obj:
+            _out_leaves(o, acc)
+
+
+def apply_np(jfn, name, args, kwargs, cls=None):
+    """Run a jax.numpy callable over mx arrays with tape recording.
+
+    The analog of ``MXImperativeInvokeImpl`` for the np namespace: unwraps
+    arrays wherever they sit in args/kwargs, runs under ``jax.vjp`` while
+    autograd records, wraps outputs as :class:`ndarray`.
+    """
+    leaves: list = []
+    spec = _collect((tuple(args), dict(kwargs)), leaves)
+    ctx = leaves[0]._ctx if leaves else current_context()
+    cls = cls or (type(leaves[0]) if leaves and type(leaves[0]) is not NDArray
+                  else ndarray)
+    arrays = [l._data for l in leaves]
+
+    def fn(*arrs):
+        a, k = _rebuild(spec, list(arrs))
+        return jfn(*a, **k)
+
+    record = autograd.is_recording() and len(leaves) > 0
+    if record:
+        try:
+            raw, vjp_fn = jax.vjp(fn, *arrays)
+        except (TypeError, jax.errors.JaxRuntimeError):
+            record = False
+            raw = fn(*arrays)
+    else:
+        raw = fn(*arrays)
+
+    out = _wrap_out(raw, ctx, cls)
+    if record:
+        outs: list = []
+        _out_leaves(out, outs)
+        if outs:
+            node = autograd.TapeNode(
+                vjp_fn, leaves, len(outs),
+                [o.shape for o in outs], [o._data.dtype for o in outs],
+                name=name)
+            # vjp_fn returns cotangents for *all* leaves given cotangents for
+            # the full raw output structure; reshape through a shim so slots
+            # line up when the output is a tuple
+            if isinstance(raw, (tuple, list)):
+
+                def tuple_vjp(cts):
+                    cts = list(cts) if isinstance(cts, (tuple, list)) else [cts]
+                    if hasattr(raw, "_fields"):  # NamedTuple (qr/svd/slogdet)
+                        return vjp_fn(type(raw)(*cts))
+                    return vjp_fn(type(raw)(cts))
+
+                node.vjp_fn = tuple_vjp
+            for i, o in enumerate(outs):
+                o._ag_node = node
+                o._ag_out_index = i
+    return out
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array on a device (reference mx.np.ndarray)."""
+
+    __slots__ = ()
+
+    # -- numpy-flavored overrides ---------------------------------------
+    def reshape(self, *shape, order="C", **kwargs):
+        if order != "C":
+            raise NotImplementedError("only order='C' reshape is supported")
+        if "newshape" in kwargs:
+            shape = kwargs["newshape"]
+        elif "shape" in kwargs:
+            shape = kwargs["shape"]
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        elif len(shape) == 1 and isinstance(shape[0], int):
+            shape = (shape[0],)
+        return apply_np(jnp.reshape, "reshape", (self, tuple(shape)), {})
+
+    def flatten(self, order="C"):
+        if order != "C":
+            raise NotImplementedError("only order='C' flatten is supported")
+        return apply_np(jnp.ravel, "ravel", (self,), {})
+
+    def ravel(self, order="C"):
+        if order != "C":
+            raise NotImplementedError("only order='C' ravel is supported")
+        return apply_np(jnp.ravel, "ravel", (self,), {})
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return apply_np(jnp.std, "std", (self,),
+                        {"axis": axis, "ddof": ddof, "keepdims": keepdims})
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return apply_np(jnp.var, "var", (self,),
+                        {"axis": axis, "ddof": ddof, "keepdims": keepdims})
+
+    def cumsum(self, axis=None, dtype=None):
+        return apply_np(jnp.cumsum, "cumsum", (self,),
+                        {"axis": axis, "dtype": dtype})
+
+    def any(self, axis=None, keepdims=False):
+        return apply_np(jnp.any, "any", (self,),
+                        {"axis": axis, "keepdims": keepdims})
+
+    def all(self, axis=None, keepdims=False):
+        return apply_np(jnp.all, "all", (self,),
+                        {"axis": axis, "keepdims": keepdims})
+
+    def round(self, decimals=0):
+        return apply_np(jnp.round, "round", (self,), {"decimals": decimals})
+
+    def nonzero(self):
+        return tuple(from_nd_raw(a, self._ctx) for a in onp.nonzero(self.asnumpy()))
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def copy(self):
+        return _wrap(self._data, self._ctx, type(self))
+
+    def astype(self, dtype, copy=True):
+        from ..ndarray.ndarray import _dtype_np
+
+        dt = _dtype_np(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return apply_np(jnp.asarray, "astype", (self,), {"dtype": dt})
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    @property
+    def device(self):
+        return self._ctx
+
+    def to_device(self, device):
+        return self.as_in_context(device)
+
+    # numpy repr
+    def __repr__(self):
+        try:
+            body = repr(self.asnumpy()).replace("array", "array", 1)
+        except MXNetError as e:
+            body = f"<error: {e}>"
+        return body
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __getitem__(self, key):
+        from ..ndarray.ndarray import _index_unwrap
+
+        key = _index_unwrap(key)
+        return apply_np(lambda a: a[key], "getitem", (self,), {})
+
+    # np comparisons yield bool arrays (nd legacy yields float 0/1)
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return apply_np(jnp.equal, "equal", (self, other), {})
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return apply_np(jnp.not_equal, "not_equal", (self, other), {})
+
+    def __gt__(self, other):
+        return apply_np(jnp.greater, "greater", (self, other), {})
+
+    def __ge__(self, other):
+        return apply_np(jnp.greater_equal, "greater_equal", (self, other), {})
+
+    def __lt__(self, other):
+        return apply_np(jnp.less, "less", (self, other), {})
+
+    def __le__(self, other):
+        return apply_np(jnp.less_equal, "less_equal", (self, other), {})
+
+    __hash__ = None
+
+    def dot(self, other):
+        return apply_np(jnp.dot, "dot", (self, other), {})
+
+    def __matmul__(self, other):
+        return apply_np(jnp.matmul, "matmul", (self, other), {})
+
+    @property
+    def T(self):
+        return apply_np(jnp.transpose, "transpose", (self,), {})
+
+
+def from_nd(arr: NDArray) -> ndarray:
+    """View an mx.nd.NDArray as mx.np.ndarray (shares storage + tape)."""
+    return arr.as_np_ndarray()
+
+
+def from_nd_raw(data, ctx) -> ndarray:
+    return _wrap(jnp.asarray(data), ctx, ndarray)
+
+
+def array(obj, dtype=None, ctx: Optional[Context] = None, device=None,
+          copy=True) -> ndarray:
+    """Create an mx.np array (reference multiarray.array).
+
+    Default dtype follows MXNet-np rules: float64 input narrows to float32
+    unless ``util.set_np(dtype=True)`` is active; ints/bools pass through.
+    """
+    ctx = device or ctx or current_context()
+    if isinstance(obj, NDArray):
+        data = obj._data
+        if dtype is not None:
+            data = data.astype(dtype)
+        return _wrap(jax.device_put(data, ctx.jax_device), ctx, ndarray)
+    np_in = onp.asarray(obj)
+    if dtype is None:
+        if np_in.dtype == onp.float64 and not is_np_default_dtype():
+            dtype = onp.float32
+        else:
+            dtype = np_in.dtype
+    from ..ndarray.ndarray import _dtype_np
+
+    data = jax.device_put(jnp.asarray(np_in, _dtype_np(dtype)), ctx.jax_device)
+    return _wrap(data, ctx, ndarray)
+
+
+def asarray(obj, dtype=None, ctx=None) -> ndarray:
+    if isinstance(obj, ndarray) and dtype is None:
+        return obj
+    return array(obj, dtype=dtype, ctx=ctx)

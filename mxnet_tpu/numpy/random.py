@@ -1,0 +1,295 @@
+"""``mx.np.random`` — NumPy-style sampling on device.
+
+Reference analog: ``src/operator/numpy/random/`` (`_npi_uniform` etc. over
+curand).  TPU-native: counter-based threefry keys from the global chain
+(:mod:`mxnet_tpu.random`) feeding ``jax.random`` samplers — reproducible and
+trace-safe (inside a hybridized graph the key is an explicit input).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import random as _global_rng
+from ..context import current_context
+from ..ndarray.ndarray import NDArray, _wrap
+from .multiarray import default_dtype, ndarray
+
+__all__ = [
+    "seed", "uniform", "normal", "randn", "rand", "randint", "choice",
+    "shuffle", "permutation", "beta", "gamma", "exponential", "chisquare",
+    "multinomial", "multivariate_normal", "logistic", "gumbel", "laplace",
+    "pareto", "power", "rayleigh", "weibull", "lognormal", "binomial",
+    "negative_binomial", "poisson", "f", "standard_normal", "standard_t",
+    "standard_cauchy", "standard_exponential", "standard_gamma",
+]
+
+
+def seed(s):
+    _global_rng.seed(s)
+
+
+def _dev(ctx=None, device=None):
+    return device or ctx or current_context()
+
+
+def _wrap_dev(data, ctx):
+    return _wrap(jax.device_put(data, ctx.jax_device), ctx, ndarray)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _bshape(size, *params):
+    if size is not None:
+        return (size,) if isinstance(size, int) else tuple(size)
+    shp = ()
+    for p in params:
+        p = _unwrap(p)
+        if hasattr(p, "shape"):
+            shp = onp.broadcast_shapes(shp, tuple(p.shape))
+    return shp
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, low, high)
+    data = jax.random.uniform(_global_rng.next_key(), shp,
+                              dtype or default_dtype(),
+                              minval=_unwrap(low), maxval=_unwrap(high))
+    return _wrap_dev(data, ctx)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+           out=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, loc, scale)
+    data = jax.random.normal(_global_rng.next_key(), shp,
+                             dtype or default_dtype())
+    data = data * _unwrap(scale) + _unwrap(loc)
+    return _wrap_dev(data, ctx)
+
+
+def standard_normal(size=None, dtype=None, ctx=None, device=None):
+    return normal(0.0, 1.0, size=size, dtype=dtype, ctx=ctx, device=device)
+
+
+def randn(*shape, ctx=None, device=None):
+    return normal(size=shape or None, ctx=ctx, device=device)
+
+
+def rand(*shape, ctx=None, device=None):
+    return uniform(size=shape or None, ctx=ctx, device=device)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    ctx = _dev(ctx, device)
+    if high is None:
+        low, high = 0, low
+    shp = _bshape(size)
+    if dtype is None:
+        dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    data = jax.random.randint(_global_rng.next_key(), shp, low, high,
+                              dtype=dtype)
+    return _wrap_dev(data, ctx)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, device=None,
+           out=None):
+    ctx = _dev(ctx, device)
+    a = _unwrap(a)
+    if isinstance(a, int):
+        a = jnp.arange(a)
+    shp = _bshape(size)
+    data = jax.random.choice(_global_rng.next_key(), a, shape=shp,
+                             replace=replace, p=_unwrap(p) if p is not None else None)
+    return _wrap_dev(data, ctx)
+
+
+def permutation(x, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    data = jax.random.permutation(_global_rng.next_key(), _unwrap(x))
+    return _wrap_dev(data, ctx)
+
+
+def shuffle(x):
+    """In-place shuffle along the first axis (reference _npi_shuffle)."""
+    perm = jax.random.permutation(_global_rng.next_key(), x.shape[0])
+    x._set_data(x._data[perm])
+
+
+def beta(a, b, size=None, dtype=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, a, b)
+    data = jax.random.beta(_global_rng.next_key(), _unwrap(a), _unwrap(b),
+                           shape=shp or None, dtype=dtype or default_dtype())
+    return _wrap_dev(data, ctx)
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+          out=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, shape, scale)
+    data = jax.random.gamma(_global_rng.next_key(), _unwrap(shape),
+                            shape=shp or None,
+                            dtype=dtype or default_dtype()) * _unwrap(scale)
+    return _wrap_dev(data, ctx)
+
+
+def standard_gamma(shape, size=None, dtype=None, ctx=None, device=None):
+    return gamma(shape, 1.0, size=size, dtype=dtype, ctx=ctx, device=device)
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None, device=None,
+                out=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, scale)
+    data = jax.random.exponential(
+        _global_rng.next_key(), shp, dtype or default_dtype()) * _unwrap(scale)
+    return _wrap_dev(data, ctx)
+
+
+def standard_exponential(size=None, dtype=None, ctx=None, device=None):
+    return exponential(1.0, size=size, dtype=dtype, ctx=ctx, device=device)
+
+
+def chisquare(df, size=None, dtype=None, ctx=None, device=None):
+    return gamma(jnp.asarray(_unwrap(df)) / 2.0, 2.0, size=size, dtype=dtype,
+                 ctx=ctx, device=device)
+
+
+def multinomial(n, pvals, size=None):
+    ctx = current_context()
+    pvals = jnp.asarray(_unwrap(pvals))
+    shp = _bshape(size)
+    cnt = jax.random.multinomial(_global_rng.next_key(), n, pvals,
+                                 shape=(shp + pvals.shape) if shp else None)
+    return _wrap_dev(cnt.astype(jnp.int64 if jax.config.jax_enable_x64
+                                else jnp.int32), ctx)
+
+
+def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):
+    ctx = current_context()
+    mean, cov = jnp.asarray(_unwrap(mean)), jnp.asarray(_unwrap(cov))
+    shp = _bshape(size)
+    data = jax.random.multivariate_normal(_global_rng.next_key(), mean, cov,
+                                          shape=shp or None)
+    return _wrap_dev(data, ctx)
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, loc, scale)
+    data = jax.random.logistic(_global_rng.next_key(), shp, default_dtype())
+    return _wrap_dev(data * _unwrap(scale) + _unwrap(loc), ctx)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, loc, scale)
+    data = jax.random.gumbel(_global_rng.next_key(), shp, default_dtype())
+    return _wrap_dev(data * _unwrap(scale) + _unwrap(loc), ctx)
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, loc, scale)
+    data = jax.random.laplace(_global_rng.next_key(), shp,
+                              dtype or default_dtype())
+    return _wrap_dev(data * _unwrap(scale) + _unwrap(loc), ctx)
+
+
+def pareto(a, size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, a)
+    data = jax.random.pareto(_global_rng.next_key(), _unwrap(a),
+                             shape=shp or None, dtype=default_dtype())
+    return _wrap_dev(data - 1.0, ctx)  # numpy's pareto is lomax
+
+
+def power(a, size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, a)
+    u = jax.random.uniform(_global_rng.next_key(), shp, default_dtype())
+    return _wrap_dev(u ** (1.0 / jnp.asarray(_unwrap(a))), ctx)
+
+
+def rayleigh(scale=1.0, size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, scale)
+    u = jax.random.uniform(_global_rng.next_key(), shp, default_dtype())
+    return _wrap_dev(jnp.sqrt(-2.0 * jnp.log1p(-u)) * _unwrap(scale), ctx)
+
+
+def weibull(a, size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, a)
+    u = jax.random.uniform(_global_rng.next_key(), shp, default_dtype())
+    return _wrap_dev((-jnp.log1p(-u)) ** (1.0 / jnp.asarray(_unwrap(a))), ctx)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None, device=None):
+    n = normal(mean, sigma, size=size, ctx=ctx, device=device)
+    return _wrap_dev(jnp.exp(n._data), n._ctx)
+
+
+def binomial(n, p, size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, n, p)
+    data = jax.random.binomial(_global_rng.next_key(),
+                               jnp.asarray(_unwrap(n), jnp.float32),
+                               jnp.asarray(_unwrap(p), jnp.float32),
+                               shape=shp or None)
+    return _wrap_dev(data.astype(jnp.int32), ctx)
+
+
+def negative_binomial(n, p, size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, n, p)
+    g = jax.random.gamma(_global_rng.next_key(),
+                         jnp.broadcast_to(jnp.asarray(_unwrap(n), jnp.float32),
+                                          shp or ()))
+    p_ = jnp.asarray(_unwrap(p), jnp.float32)
+    lam = g * (1.0 - p_) / p_
+    data = jax.random.poisson(_global_rng.next_key(), lam, shape=shp or None)
+    return _wrap_dev(data, ctx)
+
+
+def poisson(lam=1.0, size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, lam)
+    data = jax.random.poisson(_global_rng.next_key(),
+                              jnp.asarray(_unwrap(lam), jnp.float32),
+                              shape=shp or None)
+    return _wrap_dev(data, ctx)
+
+
+def f(dfnum, dfden, size=None, ctx=None, device=None):
+    num = chisquare(dfnum, size=size, ctx=ctx, device=device)
+    den = chisquare(dfden, size=size, ctx=ctx, device=device)
+    dfnum = jnp.asarray(_unwrap(dfnum), jnp.float32)
+    dfden = jnp.asarray(_unwrap(dfden), jnp.float32)
+    return _wrap_dev((num._data / dfnum) / (den._data / dfden), num._ctx)
+
+
+def standard_t(df, size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size, df)
+    data = jax.random.t(_global_rng.next_key(),
+                        jnp.asarray(_unwrap(df), jnp.float32),
+                        shape=shp or None)
+    return _wrap_dev(data, ctx)
+
+
+def standard_cauchy(size=None, ctx=None, device=None):
+    ctx = _dev(ctx, device)
+    shp = _bshape(size)
+    data = jax.random.cauchy(_global_rng.next_key(), shp, default_dtype())
+    return _wrap_dev(data, ctx)

@@ -1,0 +1,151 @@
+"""``mx.npx`` — operators that extend NumPy (neural-net ops, device utils).
+
+Reference analog: ``python/mxnet/numpy_extension/`` — the `_npx_*` op
+namespace (batch_norm, convolution, topk, …) plus np-mode switches and
+device helpers.  Ops resolve through the same registry as ``mx.nd``; because
+dispatch preserves the array flavor, calling these on ``mx.np.ndarray``
+inputs yields ``mx.np.ndarray`` outputs.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..context import cpu, current_context, gpu, num_gpus, num_tpus, tpu
+from ..ndarray.register import make_op_func
+from ..ndarray.utils import load, save
+from ..ops import registry as _registry
+from ..random import seed
+from ..util import (is_np_array, is_np_default_dtype, is_np_shape, reset_np,
+                    set_np, use_np, use_np_array, use_np_shape)
+
+_this = _sys.modules[__name__]
+
+# npx name -> registry op name (reference _npx_* ops map onto the same
+# kernels as the legacy nd ops; here literally the same OpSchema)
+_ALIASES = {
+    "relu": "relu",
+    "sigmoid": "sigmoid",
+    "log_sigmoid": "log_sigmoid",
+    "softmax": "softmax",
+    "log_softmax": "log_softmax",
+    "masked_softmax": "softmax",
+    "activation": "Activation",
+    "leaky_relu": "LeakyReLU",
+    "batch_norm": "BatchNorm",
+    "layer_norm": "LayerNorm",
+    "group_norm": "GroupNorm",
+    "instance_norm": "InstanceNorm",
+    "fully_connected": "FullyConnected",
+    "convolution": "Convolution",
+    "deconvolution": "Deconvolution",
+    "pooling": "Pooling",
+    "dropout": "Dropout",
+    "one_hot": "one_hot",
+    "pick": "pick",
+    "topk": "topk",
+    "batch_dot": "batch_dot",
+    "gather_nd": "gather_nd",
+    "scatter_nd": "scatter_nd",
+    "embedding": "embedding",
+    "arange_like": "arange_like",
+    "sequence_mask": "sequence_mask",
+    "smooth_l1": "smooth_l1",
+    "gamma": "random_gamma",
+    "reshape_like": "reshape",
+    "slice": "slice",
+    "shape_array": "shape_array",
+    "multibox_detection": None,
+    "index_update": None,
+    "index_add": None,
+    "ctc_loss": "CTCLoss",
+    "erf": None,
+    "erfinv": None,
+    "broadcast_like": "broadcast_to",
+    "constraint_check": None,
+    "rnn": None,
+    "intgemm_fully_connected": "FullyConnected",
+    "interleaved_matmul_selfatt_qk": "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt": "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk": "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt": "interleaved_matmul_encdec_valatt",
+}
+
+for _npx_name, _op_name in _ALIASES.items():
+    if _op_name is None:
+        continue
+    _schema = _registry.find_op(_op_name)
+    if _schema is not None and not hasattr(_this, _npx_name):
+        _f = make_op_func(_schema)
+        _f.__name__ = _npx_name
+        setattr(_this, _npx_name, _f)
+
+
+def erf(x):
+    import jax.scipy.special as jsp
+
+    from ..numpy.multiarray import apply_np
+
+    return apply_np(jsp.erf, "erf", (x,), {})
+
+
+def erfinv(x):
+    import jax.scipy.special as jsp
+
+    from ..numpy.multiarray import apply_np
+
+    return apply_np(jsp.erfinv, "erfinv", (x,), {})
+
+
+def gelu(x, approximation="erf"):
+    import jax.nn as jnn
+
+    from ..numpy.multiarray import apply_np
+
+    return apply_np(jnn.gelu, "gelu", (x,),
+                    {"approximate": approximation != "erf"})
+
+
+def reshape_like(lhs, rhs):
+    from ..numpy.multiarray import apply_np
+    import jax.numpy as jnp
+
+    return apply_np(lambda a, b: jnp.reshape(a, b.shape), "reshape_like",
+                    (lhs, rhs), {})
+
+
+def waitall():
+    from ..ndarray import waitall as _w
+
+    _w()
+
+
+def current_device():
+    return current_context()
+
+
+def index_update(x, ind, val):
+    from ..ndarray.ndarray import _index_unwrap
+    from ..numpy.multiarray import apply_np
+    import jax.numpy as jnp
+
+    ind = _index_unwrap(ind)
+    if isinstance(ind, list):
+        ind = jnp.asarray(ind)
+    return apply_np(lambda a, v: a.at[ind].set(v), "index_update",
+                    (x, val), {})
+
+
+def index_add(x, ind, val):
+    from ..ndarray.ndarray import _index_unwrap
+    from ..numpy.multiarray import apply_np
+    import jax.numpy as jnp
+
+    ind = _index_unwrap(ind)
+    if isinstance(ind, list):
+        ind = jnp.asarray(ind)
+    return apply_np(lambda a, v: a.at[ind].add(v), "index_add",
+                    (x, val), {})
+
+
+__all__ = sorted(
+    [n for n in dir(_this) if not n.startswith("_")])

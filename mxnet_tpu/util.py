@@ -1,0 +1,155 @@
+"""NumPy-compatibility scopes and misc utilities.
+
+Reference analog: ``python/mxnet/util.py:53-381`` (np_shape / np_array
+scopes, ``set_np``, ``use_np`` decorators).  In the reference these flags
+flip backend behavior between legacy-MXNet and NumPy semantics (zero-dim
+shapes, out-of-range slicing, default dtypes).  The TPU-native arrays are
+jax.Arrays, which already follow NumPy semantics, so the scopes here are
+thread-local *flags* that frontend code (Gluon blocks deciding which array
+flavor to create, ``mx.np.array`` choosing default dtypes) consults — no
+backend switch exists or is needed.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+__all__ = [
+    "is_np_shape", "is_np_array", "is_np_default_dtype", "set_np_shape",
+    "set_np", "reset_np", "np_shape", "np_array", "use_np_shape",
+    "use_np_array", "use_np", "np_default_dtype", "use_np_default_dtype",
+    "wrap_np_unary_func", "wrap_np_binary_func", "getenv", "setenv",
+]
+
+
+class _NpState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.np_shape = False
+        self.np_array = False
+        self.np_default_dtype = False
+
+
+_STATE = _NpState()
+
+
+def is_np_shape() -> bool:
+    """True when zero-dim / zero-size shapes are enabled (always valid on
+    this backend; the flag tracks what the user requested)."""
+    return _STATE.np_shape
+
+
+def is_np_array() -> bool:
+    """True when blocks should produce ``mx.np.ndarray`` instead of
+    ``mx.nd.NDArray``."""
+    return _STATE.np_array
+
+
+def is_np_default_dtype() -> bool:
+    """True when creation ops default to float64 like NumPy (else float32)."""
+    return _STATE.np_default_dtype
+
+
+def set_np_shape(active: bool) -> bool:
+    prev = _STATE.np_shape
+    _STATE.np_shape = bool(active)
+    return prev
+
+
+def set_np(shape: bool = True, array: bool = True, dtype: bool = False):
+    """Activate NumPy-compatibility (reference util.py set_np)."""
+    if array and not shape:
+        raise ValueError("np_array requires np_shape")
+    _STATE.np_shape = bool(shape)
+    _STATE.np_array = bool(array)
+    _STATE.np_default_dtype = bool(dtype)
+
+
+def reset_np():
+    set_np(shape=False, array=False, dtype=False)
+
+
+class _FlagScope:
+    def __init__(self, attr, value):
+        self._attr = attr
+        self._value = value
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_STATE, self._attr)
+        setattr(_STATE, self._attr, self._value)
+        return self
+
+    def __exit__(self, *exc):
+        setattr(_STATE, self._attr, self._prev)
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with type(self)(self._attr, self._value):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+
+def np_shape(active: bool = True):
+    return _FlagScope("np_shape", active)
+
+
+def np_array(active: bool = True):
+    return _FlagScope("np_array", active)
+
+
+def np_default_dtype(active: bool = True):
+    return _FlagScope("np_default_dtype", active)
+
+
+def use_np_shape(fn):
+    """Decorator running ``fn`` under np_shape semantics."""
+    return np_shape(True)(fn)
+
+
+def use_np_array(fn):
+    return np_array(True)(fn)
+
+
+def use_np_default_dtype(fn):
+    return np_default_dtype(True)(fn)
+
+
+def use_np(fn):
+    """Decorator = use_np_shape + use_np_array (reference util.py:297)."""
+    return use_np_array(use_np_shape(fn))
+
+
+def wrap_np_unary_func(fn):
+    """Kept for API parity: validates the single-input signature."""
+
+    @functools.wraps(fn)
+    def wrapped(x, out=None, **kwargs):
+        return fn(x, out=out, **kwargs) if out is not None else fn(x, **kwargs)
+
+    return wrapped
+
+
+def wrap_np_binary_func(fn):
+    @functools.wraps(fn)
+    def wrapped(x1, x2, out=None, **kwargs):
+        if out is not None:
+            return fn(x1, x2, out=out, **kwargs)
+        return fn(x1, x2, **kwargs)
+
+    return wrapped
+
+
+def getenv(name):
+    """Read an MXNET_* runtime flag (reference MXGetEnv)."""
+    import os
+
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    import os
+
+    os.environ[name] = str(value)

@@ -1,0 +1,179 @@
+"""mx.np / mx.npx namespace tests (reference tests/python/unittest/test_numpy_op.py,
+test_numpy_ndarray.py — same coverage ideas: creation, ufuncs, reductions,
+indexing, autograd through np ops, linalg, random moments, npx nn ops)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+
+
+def test_creation_and_dtype():
+    a = np.array([1.0, 2.0, 3.0])
+    assert isinstance(a, np.ndarray)
+    assert a.dtype == onp.float32  # float64 narrows by default
+    assert np.zeros((2, 3)).shape == (2, 3)
+    assert np.ones((2,), dtype=onp.int32).dtype == onp.int32
+    assert np.full((2, 2), 7).asnumpy().tolist() == [[7, 7], [7, 7]]
+    assert np.arange(5).shape == (5,)
+    assert np.eye(3).asnumpy().trace() == 3.0
+    ls = np.linspace(0, 1, 11)
+    assert ls.shape == (11,) and abs(float(ls[10]) - 1.0) < 1e-6
+
+
+def test_ufuncs_and_operators():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = np.array([[1.0, 1.0], [2.0, 2.0]])
+    onp.testing.assert_allclose((a + b).asnumpy(), [[2, 3], [5, 6]])
+    onp.testing.assert_allclose((a * 2).asnumpy(), [[2, 4], [6, 8]])
+    onp.testing.assert_allclose(np.exp(np.zeros(3)).asnumpy(), onp.ones(3))
+    onp.testing.assert_allclose(np.maximum(a, b).asnumpy(), [[1, 2], [3, 4]])
+    out = np.matmul(a, b)
+    assert isinstance(out, np.ndarray)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.matmul(a.asnumpy(), b.asnumpy()))
+
+
+def test_reductions_and_stats():
+    x = np.array(onp.arange(12, dtype=onp.float32).reshape(3, 4))
+    assert float(np.sum(x)) == 66.0
+    assert float(x.mean()) == 5.5
+    onp.testing.assert_allclose(np.std(x, axis=0).asnumpy(),
+                                onp.std(onp.arange(12).reshape(3, 4), axis=0))
+    assert int(np.argmax(x)) == 11
+    onp.testing.assert_allclose(np.cumsum(x, axis=1).asnumpy(),
+                                onp.cumsum(x.asnumpy(), axis=1))
+    assert float(np.median(x)) == 5.5
+
+
+def test_manipulation():
+    x = np.arange(6).reshape(2, 3)
+    assert x.reshape(3, 2).shape == (3, 2)
+    assert x.reshape(-1).shape == (6,)
+    assert np.concatenate([x, x], axis=0).shape == (4, 3)
+    assert np.stack([x, x]).shape == (2, 2, 3)
+    parts = np.split(np.arange(9), 3)
+    assert len(parts) == 3 and parts[0].shape == (3,)
+    assert np.transpose(x).shape == (3, 2)
+    assert x.T.shape == (3, 2)
+    assert np.flip(np.arange(3)).asnumpy().tolist() == [2, 1, 0]
+    assert np.where(x > 2, x, np.zeros_like(x)).asnumpy().sum() == 3 + 4 + 5
+
+
+def test_indexing():
+    x = np.arange(12).reshape(3, 4)
+    assert float(x[1, 2]) == 6
+    assert x[1].shape == (4,)
+    assert x[:, 1:3].shape == (3, 2)
+    assert x[x > 5].shape == (6,)
+    idx = np.array([0, 2], dtype=onp.int32)
+    assert x[idx].shape == (2, 4)
+
+
+def test_autograd_through_np():
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = np.sum(np.exp(x) * 2.0)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                2.0 * onp.exp([1.0, 2.0, 3.0]), rtol=1e-5)
+
+
+def test_autograd_np_chain_matmul():
+    w = np.array(onp.eye(3, dtype=onp.float32))
+    w.attach_grad()
+    x = np.array(onp.ones((2, 3), dtype=onp.float32))
+    with mx.autograd.record():
+        out = np.matmul(x, w)
+        loss = (out * out).sum()
+    loss.backward()
+    assert w.grad.shape == (3, 3)
+    onp.testing.assert_allclose(w.grad.asnumpy(),
+                                2 * x.asnumpy().T @ x.asnumpy() @ onp.eye(3),
+                                rtol=1e-5)
+
+
+def test_linalg():
+    a = onp.array([[4.0, 1.0], [1.0, 3.0]], dtype=onp.float32)
+    x = np.array(a)
+    onp.testing.assert_allclose(np.linalg.det(x).asnumpy(),
+                                onp.linalg.det(a), rtol=1e-5)
+    onp.testing.assert_allclose(np.linalg.inv(x).asnumpy(),
+                                onp.linalg.inv(a), rtol=1e-4)
+    q, r = np.linalg.qr(x)
+    onp.testing.assert_allclose((q @ r).asnumpy(), a, rtol=1e-4, atol=1e-5)
+    w = np.linalg.eigvalsh(x)
+    onp.testing.assert_allclose(onp.sort(w.asnumpy()),
+                                onp.sort(onp.linalg.eigvalsh(a)), rtol=1e-4)
+    assert float(np.linalg.norm(x)) == pytest.approx(onp.linalg.norm(a),
+                                                     rel=1e-5)
+
+
+def test_random_moments():
+    np.random.seed(42)
+    u = np.random.uniform(0, 1, size=(20000,))
+    assert abs(float(u.mean()) - 0.5) < 0.02
+    n = np.random.normal(2.0, 3.0, size=(20000,))
+    assert abs(float(n.mean()) - 2.0) < 0.1
+    assert abs(float(n.std()) - 3.0) < 0.1
+    r = np.random.randint(0, 10, size=(1000,))
+    assert int(r.min()) >= 0 and int(r.max()) < 10
+    p = np.random.permutation(10)
+    assert sorted(p.asnumpy().tolist()) == list(range(10))
+    g = np.random.gamma(2.0, 2.0, size=(20000,))
+    assert abs(float(g.mean()) - 4.0) < 0.2
+
+
+def test_npx_ops():
+    x = np.array([[-1.0, 2.0], [3.0, -4.0]])
+    onp.testing.assert_allclose(npx.relu(x).asnumpy(), [[0, 2], [3, 0]])
+    s = npx.softmax(x, axis=-1)
+    onp.testing.assert_allclose(s.asnumpy().sum(-1), [1.0, 1.0], rtol=1e-6)
+    assert isinstance(s, np.ndarray)
+    oh = npx.one_hot(np.array([0, 2], dtype=onp.int32), 3)
+    assert oh.shape == (2, 3)
+    e = npx.erf(np.zeros(2))
+    onp.testing.assert_allclose(e.asnumpy(), [0.0, 0.0])
+    w = np.array(onp.random.RandomState(0).rand(4, 3).astype(onp.float32))
+    fc = npx.fully_connected(np.ones((2, 3)), w, None, num_hidden=4,
+                             no_bias=True)
+    assert fc.shape == (2, 4)
+
+
+def test_np_nd_interop():
+    a = mx.nd.ones((2, 2))
+    b = a.as_np_ndarray()
+    assert isinstance(b, np.ndarray)
+    c = b.as_nd_ndarray()
+    assert type(c) is mx.nd.NDArray
+    # flavor preservation through registry ops
+    d = b + b
+    assert isinstance(d, np.ndarray)
+
+
+def test_fallback_tail():
+    # names not in jax.numpy fall back to host numpy (reference
+    # numpy_op_fallback.py)
+    x = np.array([1.0, 2.0, 2.0, 3.0])
+    vals, counts = np.unique(x, return_counts=True)
+    assert counts.asnumpy().tolist() == [1, 2, 1]
+
+
+def test_util_scopes():
+    from mxnet_tpu import util
+
+    assert not util.is_np_default_dtype()
+    with util.np_default_dtype(True):
+        assert util.is_np_default_dtype()
+    assert not util.is_np_default_dtype()
+    util.set_np()
+    assert util.is_np_array() and util.is_np_shape()
+    util.reset_np()
+    assert not util.is_np_array()
+
+    @util.use_np
+    def f():
+        return util.is_np_array()
+
+    assert f()

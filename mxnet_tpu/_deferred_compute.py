@@ -1,0 +1,100 @@
+"""Deferred compute: trace imperative NDArray code into a Symbol graph.
+
+Reference analog: ``python/mxnet/_deferred_compute.py`` +
+``Imperative::RecordDeferredCompute`` (src/imperative/imperative.cc:296) —
+the basis of Gluon 2.0 hybridization.  TPU-native twist: the reference
+*defers* execution (records without computing); here ops execute eagerly
+(jax async dispatch makes that cheap) while the symbolic node is recorded
+alongside — "trace-while-eager", the same trick the autograd tape uses.
+``get_symbol`` then reads the recorded graph off the output arrays.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .base import MXNetError
+
+__all__ = ["deferred_compute", "is_deferred_compute", "get_symbol",
+           "set_variable"]
+
+
+class _DCState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.active = False
+        self.counter = 0
+
+
+_STATE = _DCState()
+
+
+def is_deferred_compute() -> bool:
+    return _STATE.active
+
+
+is_active = is_deferred_compute
+
+
+class deferred_compute:
+    """Context manager enabling tracing (reference _deferred_compute.py:33)."""
+
+    def __enter__(self):
+        self._prev = _STATE.active
+        _STATE.active = True
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.active = self._prev
+
+
+def set_variable(arr, name: str, shape=None):
+    """Mark an NDArray as a named graph input (reference
+    MXNDArraySetDeferredComputeVariable)."""
+    from .symbol.symbol import SymNode
+
+    node = SymNode(None, name, {}, [])
+    arr._dc_sym = (node, 0)
+
+
+def _auto_var(arr):
+    from .symbol.symbol import SymNode
+
+    _STATE.counter += 1
+    node = SymNode(None, f"_dc_var{_STATE.counter}", {}, [])
+    arr._dc_sym = (node, 0)
+    return arr._dc_sym
+
+
+def record(schema, inputs, attrs, outputs):
+    """Called from ndarray.invoke while tracing: attach a SymNode mirroring
+    the executed op to the outputs."""
+    from .symbol.symbol import SymNode, _NAMES
+
+    in_entries = []
+    for a in inputs:
+        entry = getattr(a, "_dc_sym", None)
+        if entry is None:
+            entry = _auto_var(a)
+        in_entries.append(entry)
+    node = SymNode(schema.name, _NAMES.get(schema.name.lower()), dict(attrs),
+                   in_entries, max(1, len(outputs)))
+    for i, o in enumerate(outputs):
+        o._dc_sym = (node, i)
+
+
+def get_symbol(output_arrays):
+    """Extract the traced Symbol for the given outputs (reference
+    dc.get_symbol → Imperative::GetDeferredComputeSymbol)."""
+    from .symbol.symbol import Symbol
+
+    if not isinstance(output_arrays, (list, tuple)):
+        output_arrays = [output_arrays]
+    entries = []
+    for o in output_arrays:
+        entry = getattr(o, "_dc_sym", None)
+        if entry is None:
+            raise MXNetError(
+                "output was not computed inside a deferred_compute scope")
+        entries.append(entry)
+    return Symbol(entries)

@@ -327,11 +327,12 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
 
 
 def get_symbol(x):
-    """Reference returns the recorded graph as a Symbol
-    (``MXAutogradGetSymbol``)."""
-    from .symbol import Symbol  # lazy
+    """Return the traced graph of ``x`` as a Symbol (reference
+    ``MXAutogradGetSymbol``).  Requires the computation to have run inside a
+    ``mx._deferred_compute.deferred_compute()`` scope."""
+    from . import _deferred_compute as dc
 
-    raise NotImplementedError("autograd.get_symbol: use HybridBlock.export instead")
+    return dc.get_symbol(x)
 
 
 class Function:

@@ -1,0 +1,133 @@
+"""Executor — the legacy ``Symbol.bind`` execution shim.
+
+Reference analog: ``python/mxnet/executor.py`` (Executor is a thin wrapper
+over ``ndarray.CachedOp(sym)``, :124).  Here binding compiles the symbol's
+whole graph with ``jax.jit`` once per input-shape signature; ``backward``
+uses the ``jax.vjp`` of the same graph — one fused XLA program each way.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray
+from .ndarray.ndarray import _wrap
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, sym, ctx: Optional[Context], args, args_grad=None,
+                 grad_req="write"):
+        from .symbol.symbol import Symbol
+
+        if not isinstance(sym, Symbol):
+            raise TypeError("Executor needs a Symbol")
+        self._sym = sym
+        self._ctx = ctx or current_context()
+        self._arg_names = sym.list_arguments()
+
+        if isinstance(args, (list, tuple)):
+            if len(args) != len(self._arg_names):
+                raise MXNetError(
+                    f"bind: expected {len(self._arg_names)} args "
+                    f"({self._arg_names}), got {len(args)}")
+            self.arg_dict: Dict[str, NDArray] = dict(
+                zip(self._arg_names, args))
+        elif isinstance(args, dict):
+            missing = [a for a in self._arg_names if a not in args]
+            if missing:
+                raise MXNetError(f"bind: missing args {missing}")
+            self.arg_dict = {a: args[a] for a in self._arg_names}
+        else:
+            raise TypeError("args must be list or dict of NDArray")
+
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(self._arg_names, args_grad))
+        self.grad_dict: Dict[str, NDArray] = args_grad or {}
+        if isinstance(grad_req, str):
+            grad_req = {a: grad_req for a in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._arg_names, grad_req))
+        self._grad_req = grad_req
+
+        self._fwd = jax.jit(self._raw_forward)
+        # compiled backward: recomputes the forward inside the same XLA
+        # program (rematerialization) so train steps never fall back to
+        # op-by-op interpretation
+        self._bwd = jax.jit(
+            lambda feed, cts: jax.vjp(self._raw_forward, feed)[1](cts)[0])
+        self._last_feed = None
+        self.outputs: List[NDArray] = []
+        self.aux_dict: Dict[str, NDArray] = {}
+
+    def _raw_forward(self, feed):
+        from .symbol.symbol import execute_graph
+
+        return execute_graph(self._sym._outputs, feed)
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[a] for a in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(a) for a in self._arg_names]
+
+    def forward(self, is_train: bool = False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"unknown argument {k}")
+            self.arg_dict[k]._set_data(
+                v._data if isinstance(v, NDArray) else jnp.asarray(v))
+        feed = {a: self.arg_dict[a]._data for a in self._arg_names}
+        self._last_feed = feed if is_train else None
+        raw = self._fwd(feed)
+        self.outputs = [_wrap(o, self._ctx) for o in raw]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if self._last_feed is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        if out_grads is None:
+            cts = [jnp.ones(o.shape, o._data.dtype) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        feed_cts = self._bwd(self._last_feed, cts)
+        for a in self._arg_names:
+            req = self._grad_req.get(a, "write")
+            if req == "null" or a not in self.grad_dict:
+                continue
+            g = self.grad_dict[a]
+            ct = feed_cts.get(a)
+            if ct is None:
+                continue
+            ct = ct.astype(g._data.dtype)
+            g._set_data(g._data + ct if req == "add" else ct)
+
+    def copy_params_from(self, arg_params, aux_params=None):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(v._data)
+
+    def reshape(self, **shapes):
+        from .ndarray import zeros
+
+        arg_shapes, _, _ = self._sym.infer_shape(**shapes)
+        args = {a: zeros(s, ctx=self._ctx)
+                for a, s in zip(self._arg_names, arg_shapes)}
+        for a, arr in self.arg_dict.items():
+            if args[a].shape == arr.shape:
+                args[a] = arr
+        grads = None
+        if self.grad_dict:
+            grads = {a: zeros(s, ctx=self._ctx)
+                     for a, s in zip(self._arg_names, arg_shapes)}
+        return Executor(self._sym, self._ctx, args, grads, self._grad_req)

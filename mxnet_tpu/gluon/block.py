@@ -422,6 +422,7 @@ class HybridBlock(Block):
         # cache: (training, input treedef signature) -> compiled record
         self._cached: Dict[Any, Tuple] = {}
         self._backend = None
+        self._in_specs = None  # (struct, [(shape, dtype)]) from last call
 
     def hybridize(self, active=True, backend=None, clear=True, **kwargs):
         """Activate whole-graph compilation.  ``static_alloc``/``static_shape``
@@ -449,6 +450,9 @@ class HybridBlock(Block):
         return True
 
     def __call__(self, *args, **kwargs):
+        leaves, struct = _flatten_args((list(args), dict(kwargs)))
+        self._in_specs = (struct,
+                          [(l.shape, l._data.dtype) for l in leaves])
         if not self._active:
             return super().__call__(*args, **kwargs)
         params = self.collect_params()
@@ -572,55 +576,161 @@ class HybridBlock(Block):
         jitted = jax.jit(raw_fn)
         return (jitted, names, params, ctx_idx, out_struct, mutated_names)
 
-    # -- export / import -------------------------------------------------
-    def export(self, path: str, epoch: int = 0):
-        """Serialize model params + manifest (reference block.py:1299 export
-        → symbol.json + .params).  The graph itself is Python-defined here;
-        SymbolBlock.imports restores params into a user-provided net factory
-        or a registered model-zoo class recorded in the manifest."""
+    # -- trace to Symbol / export ---------------------------------------
+    def _trace_symbol(self):
+        """Trace ``forward`` under deferred compute into a Symbol whose
+        variables are ``dataN`` inputs + structurally-named parameters
+        (reference _build_cache tracing, block.py:993 → dc.get_symbol)."""
+        from .. import _deferred_compute as dc
+
+        if self._in_specs is None:
+            raise MXNetError(
+                "run at least one forward pass before export/tracing so "
+                "input shapes are known")
+        struct, specs = self._in_specs
+        params = OrderedDict(
+            (n, p) for n, p in self.collect_params().items()
+            if p._data is not None)
+        saved = []
+        leaves = []
+        try:
+            with autograd.pause(), dc.deferred_compute():
+                for i, (shp, dt) in enumerate(specs):
+                    arr = _wrap(jnp.zeros(shp, dt), current_context())
+                    dc.set_variable(arr, f"data{i}" if len(specs) > 1
+                                    else "data")
+                    leaves.append(arr)
+                for n, p in params.items():
+                    for rep in p._data:
+                        saved.append((rep, rep._dc_sym))
+                        dc.set_variable(rep, n)
+                call_args, call_kwargs = _unflatten_args(struct, leaves)
+                out = self.forward(*call_args, **call_kwargs)
+            out_leaves, _ = _flatten_output(out)
+            return dc.get_symbol(out_leaves)
+        finally:
+            for rep, prev in saved:
+                rep._dc_sym = prev
+
+    def export(self, path: str, epoch: int = 0, remove_amp_cast=True):
+        """Serialize the traced graph + params (reference block.py:1299
+        export → path-symbol.json + path-NNNN.params)."""
+        sym = self._trace_symbol()
         params_file = f"{path}-{epoch:04d}.params"
         self.save_parameters(params_file)
-        manifest = {
-            "format": "mxnet_tpu-v1",
-            "class": type(self).__name__,
-            "module": type(self).__module__,
-        }
-        with open(f"{path}-symbol.json", "w") as f:
-            json.dump(manifest, f)
+        sym.save(f"{path}-symbol.json")
         return f"{path}-symbol.json", params_file
 
 
 class SymbolBlock(HybridBlock):
-    """Load an exported model (reference block.py:1485 SymbolBlock).
+    """Run a symbolic graph as a Block (reference block.py:1485).
 
-    The reference rebuilds a graph from symbol JSON; here a model is a Python
-    class, so ``imports`` re-instantiates the recorded class and loads params.
+    Holds a :class:`mxnet_tpu.symbol.Symbol`; variables found in the params
+    file become trainable Parameters, the rest are runtime inputs.  The
+    whole graph executes as one jit-compiled XLA program per input shape.
     """
 
-    def __init__(self, inner: HybridBlock):
+    def __init__(self, outputs, inputs=None, params=None, ctx=None):
         super().__init__()
-        self.net = inner
+        from ..symbol.symbol import Symbol
+
+        if isinstance(outputs, (list, tuple)):
+            from ..symbol import Group
+
+            outputs = Group(list(outputs))
+        if not isinstance(outputs, Symbol):
+            raise TypeError("SymbolBlock needs a Symbol")
+        self._sym = outputs
+        args = outputs.list_arguments()
+        if inputs is None:
+            inputs = [a for a in args if a == "data" or a.startswith("data")]
+        elif isinstance(inputs, str):
+            inputs = [inputs]
+        else:
+            inputs = [i.name if hasattr(i, "name") else i for i in inputs]
+        self._input_names = inputs
+        param_names = [a for a in args if a not in inputs]
+        params = params or {}
+        for n in param_names:
+            if n in params:
+                arr = params[n]
+                np_arr = (arr.asnumpy() if isinstance(arr, NDArray)
+                          else onp.asarray(arr))
+                p = Parameter(n, shape=np_arr.shape, dtype=np_arr.dtype)
+                p._load_init(np_arr,
+                             [ctx] if isinstance(ctx, Context) else ctx)
+            else:
+                raise MXNetError(
+                    f"SymbolBlock: no value provided for argument '{n}' "
+                    f"(inputs={inputs})")
+            self._reg_params[n] = p
 
     def forward(self, *args):
-        return self.net(*args)
+        from ..symbol.symbol import _jit_graph
+
+        if len(args) != len(self._input_names):
+            raise MXNetError(
+                f"expected {len(self._input_names)} inputs "
+                f"{self._input_names}, got {len(args)}")
+        ctx = args[0].ctx if args else current_context()
+        feed = {n: a._data for n, a in zip(self._input_names, args)}
+        for n, p in self._reg_params.items():
+            feed[n] = p._data[0]._data
+        # differentiable through the tape: route via a single vjp node when
+        # recording, like _call_cached does for hybridized blocks
+        if autograd.is_recording():
+            names = list(self._reg_params)
+            pvals = [feed[n] for n in names]
+            ivals = [feed[n] for n in self._input_names]
+
+            def fn(ps, ins):
+                f = dict(zip(names, ps))
+                f.update(dict(zip(self._input_names, ins)))
+                from ..symbol.symbol import execute_graph
+
+                return execute_graph(self._sym._outputs, f)
+
+            raw, vjp_fn = jax.vjp(fn, pvals, ivals)
+            node_inputs = [self._reg_params[n]._data[0] for n in names] + \
+                list(args)
+
+            def node_vjp(out_cts, _vjp=vjp_fn):
+                cts = list(out_cts) if isinstance(out_cts, tuple) \
+                    else [out_cts]
+                pcts, icts = _vjp(cts)
+                return tuple(list(pcts) + list(icts))
+
+            node = autograd.TapeNode(
+                node_vjp, node_inputs, len(raw),
+                [tuple(o.shape) for o in raw], [o.dtype for o in raw],
+                name="SymbolBlock")
+            outs = []
+            for i, o in enumerate(raw):
+                w = _wrap(o, ctx)
+                w._ag_node = node
+                w._ag_out_index = i
+                outs.append(w)
+        else:
+            raw = _jit_graph(self._sym)(feed)
+            outs = [_wrap(o, ctx) for o in raw]
+        return outs[0] if len(outs) == 1 else outs
 
     @staticmethod
-    def imports(symbol_file, input_names=None, param_file=None, ctx=None,
-                net_factory: Optional[Callable[[], HybridBlock]] = None):
-        with open(symbol_file) as f:
-            manifest = json.load(f)
-        if net_factory is not None:
-            net = net_factory()
-        else:
-            import importlib
+    def imports(symbol_file, input_names=None, param_file=None, ctx=None):
+        """Load an exported model from symbol-json + params (reference
+        block.py:1517)."""
+        from .. import symbol as sym_mod
+        from ..ndarray.utils import load as nd_load
 
-            mod = importlib.import_module(manifest["module"])
-            net = getattr(mod, manifest["class"])()
+        sym = sym_mod.load(symbol_file)
+        params = {}
         if param_file:
-            net.load_parameters(param_file, ctx=ctx)
-        blk = SymbolBlock(net)
-        blk.hybridize()
-        return blk
+            loaded = _load_param_file(param_file)
+            params = {k: v for k, v in loaded.items()}
+        if input_names is None:
+            args = sym.list_arguments()
+            input_names = [a for a in args if a not in params]
+        return SymbolBlock(sym, input_names, params, ctx=ctx)
 
 
 # ---------------------------------------------------------------------------

@@ -56,6 +56,7 @@ class NDArray:
         "_ag_node",
         "_ag_out_index",
         "_deferred_init",
+        "_dc_sym",
         "__weakref__",
     )
 
@@ -82,6 +83,7 @@ class NDArray:
         self._ag_node = None
         self._ag_out_index = 0
         self._deferred_init = None
+        self._dc_sym = None
 
     # ------------------------------------------------------------------
     # core properties
@@ -556,6 +558,7 @@ def _wrap(data: jax.Array, ctx: Context, cls=None) -> "NDArray":
     out._ag_node = None
     out._ag_out_index = 0
     out._deferred_init = None
+    out._dc_sym = None
     return out
 
 
@@ -636,12 +639,18 @@ def invoke(
             o._ag_node = node
             o._ag_out_index = i
 
+    from .. import _deferred_compute as _dc
+
+    if _dc.is_active():
+        _dc.record(schema, list(inputs), attrs, outputs)
+
     if out is not None:
         dests = [out] if isinstance(out, NDArray) else list(out)
         for d, o in zip(dests, outputs):
             d._set_data(o._data.astype(d._data.dtype) if d._data.dtype != o._data.dtype else o._data)
             d._ag_node = o._ag_node
             d._ag_out_index = o._ag_out_index
+            d._dc_sym = o._dc_sym
         return out
 
     if not multi:

@@ -77,6 +77,38 @@ def _out_leaves(obj, acc):
             _out_leaves(o, acc)
 
 
+# -- symbolic tracing support ------------------------------------------------
+# np ops carry a jnp function, not a registry op; for deferred-compute
+# tracing they all record through ONE registered op, `_np_call`, whose attrs
+# (jnp function name + arg-structure spec) re-create the call at graph
+# execution / after JSON round-trip.
+def _resolve_jnp(name: str):
+    if name.startswith("linalg."):
+        return getattr(jnp.linalg, name[len("linalg."):], None)
+    fn = getattr(jnp, name, None)
+    if fn is not None:
+        return fn
+    import jax.nn as jnn
+    import jax.scipy.special as jsp
+
+    return getattr(jnn, name, None) or getattr(jsp, name, None)
+
+
+def _np_call(arrays, jnp_name=None, spec=None):
+    jfn = _resolve_jnp(jnp_name)
+    if jfn is None:
+        raise MXNetError(f"_np_call: cannot resolve jnp function {jnp_name!r}")
+    a, k = _rebuild(spec, list(arrays))
+    return jfn(*a, **k)
+
+
+from ..ops.registry import find_op as _find_op, register as _register  # noqa: E402
+
+if _find_op("_np_call") is None:
+    _register("_np_call", num_inputs=-1, num_outputs=-1,
+              namespaces=[])(_np_call)
+
+
 def apply_np(jfn, name, args, kwargs, cls=None):
     """Run a jax.numpy callable over mx arrays with tape recording.
 
@@ -129,6 +161,15 @@ def apply_np(jfn, name, args, kwargs, cls=None):
             for i, o in enumerate(outs):
                 o._ag_node = node
                 o._ag_out_index = i
+
+    from .. import _deferred_compute as _dc
+
+    if _dc.is_active() and leaves and _resolve_jnp(name) is not None:
+        outs = []
+        _out_leaves(out, outs)
+        if outs:
+            _dc.record(_find_op("_np_call"), leaves,
+                       {"jnp_name": name, "spec": spec}, outs)
     return out
 
 

@@ -1,0 +1,37 @@
+"""``mx.sym`` — symbolic graph construction namespace.
+
+Every operator registered in the op registry is exposed as a Symbol-building
+function (reference generates ``mxnet.symbol.op`` the same way,
+``python/mxnet/symbol/register.py``).
+"""
+from __future__ import annotations
+
+import sys as _sys
+import types as _types
+
+from ..ops import registry as _registry
+from .register import make_sym_func
+from .symbol import (Group, Symbol, Variable, execute_graph, load, load_json,
+                     var)
+
+_this = _sys.modules[__name__]
+
+_seen = set()
+for _name, _schema in list(_registry._OPS.items()):
+    if _name in _seen or _name.startswith("_"):
+        continue
+    _seen.add(_name)
+    if not hasattr(_this, _name):
+        setattr(_this, _name, make_sym_func(_schema))
+
+op = _this
+
+# linalg submodule mirror
+linalg = _types.ModuleType(__name__ + ".linalg")
+_sys.modules[linalg.__name__] = linalg
+for _ln in _registry.list_ops():
+    if _ln.startswith("linalg_"):
+        setattr(linalg, _ln[len("linalg_"):], getattr(_this, _ln))
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "execute_graph"]

@@ -1,0 +1,66 @@
+"""Generate symbolic operator functions from the registry.
+
+Reference analog: ``python/mxnet/symbol/register.py`` (code-gen of
+``mxnet.symbol.op`` from the C op registry).  Signatures match the nd
+generated functions; Symbol inputs build graph nodes instead of executing.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Callable
+
+from ..ops.registry import OpSchema
+from .symbol import Symbol, _apply_op
+
+__all__ = ["make_sym_func"]
+
+
+def make_sym_func(schema: OpSchema) -> Callable:
+    sig = inspect.signature(schema.fn)
+    params = list(sig.parameters)
+
+    if schema.num_inputs == -1:
+        attr_names = params[1:]
+
+        def fn(*args, name=None, **kwargs):
+            syms, rest = [], []
+            for a in args:
+                if isinstance(a, Symbol):
+                    syms.append(a)
+                elif not syms and not rest and isinstance(a, (list, tuple)) \
+                        and a and isinstance(a[0], Symbol):
+                    syms.extend(a)
+                else:
+                    rest.append(a)
+            attrs = dict(zip(attr_names, rest))
+            attrs.update({k: v for k, v in kwargs.items() if k != "attr"})
+            return _apply_op(schema.name, syms, attrs, name=name)
+
+    elif schema.num_inputs == 0:
+        attr_names = params
+
+        def fn(*args, name=None, **kwargs):
+            attrs = dict(zip(attr_names, args))
+            attrs.update({k: v for k, v in kwargs.items() if k != "attr"})
+            return _apply_op(schema.name, [], attrs, name=name)
+
+    else:
+        n_in = schema.num_inputs
+        attr_names = params[n_in:]
+
+        def fn(*args, name=None, **kwargs):
+            syms = list(args[:n_in])
+            rest = args[n_in:]
+            # optional trailing array slots may be None (e.g. no-bias FC)
+            while syms and syms[-1] is None:
+                syms.pop()
+            if any(not isinstance(s, Symbol) for s in syms):
+                raise TypeError(
+                    f"sym.{schema.name}: all array inputs must be Symbols")
+            attrs = dict(zip(attr_names, rest))
+            attrs.update({k: v for k, v in kwargs.items() if k != "attr"})
+            return _apply_op(schema.name, syms, attrs, name=name)
+
+    fn.__name__ = schema.name
+    fn.__doc__ = schema.doc
+    return fn

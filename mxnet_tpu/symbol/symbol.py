@@ -1,0 +1,529 @@
+"""``mx.sym.Symbol`` — the symbolic graph IR.
+
+Reference analog: ``python/mxnet/symbol/symbol.py`` (nnvm graph handles,
+compose/infer/save) and the deleted GraphExecutor's successor ``CachedOp``.
+TPU-native design: a Symbol is a tiny persistent DAG of (op-name, attrs,
+inputs) records over the SAME operator registry the imperative path uses —
+executing a Symbol walks the DAG calling the registered pure-JAX fns, so
+``bind``-ing a symbol compiles the whole graph with ``jax.jit`` (XLA owns
+memory planning / CSE / fusion, replacing MXPlanMemory and the nnvm passes,
+src/nnvm/plan_memory.cc:332, src/imperative/exec_pass.h:159).
+
+JSON round-trips with a node-list format shaped like the reference's
+symbol.json (nodes / arg_nodes / heads) so exported models are inspectable.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..ops.registry import find_op, get_op
+
+__all__ = ["Symbol", "SymNode", "var", "Variable", "Group", "load",
+           "load_json", "execute_graph"]
+
+
+class _NameManager(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.counters: Dict[str, int] = {}
+
+    def get(self, hint: str) -> str:
+        n = self.counters.get(hint, 0)
+        self.counters[hint] = n + 1
+        return f"{hint}{n}"
+
+
+_NAMES = _NameManager()
+
+
+class SymNode:
+    """One graph node: a variable (op=None) or an operator application."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "attr_dict")
+
+    def __init__(self, op: Optional[str], name: str, attrs: dict,
+                 inputs: List[Tuple["SymNode", int]], num_outputs: int = 1):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self.num_outputs = num_outputs
+        self.attr_dict: Dict[str, str] = {}
+
+
+class Symbol:
+    """A (possibly multi-output) handle into the symbolic graph."""
+
+    def __init__(self, outputs: List[Tuple[SymNode, int]]):
+        self._outputs = outputs
+
+    # -- construction ----------------------------------------------------
+    @property
+    def name(self) -> str:
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return "grouped"
+
+    def __iter__(self):
+        return (Symbol([e]) for e in self._outputs)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            if idx not in names:
+                raise ValueError(f"no output named {idx}; have {names}")
+            return Symbol([self._outputs[names.index(idx)]])
+        return Symbol([self._outputs[idx]])
+
+    # -- graph walking ---------------------------------------------------
+    def _topo(self) -> List[SymNode]:
+        seen: Dict[int, SymNode] = {}
+        order: List[SymNode] = []
+
+        def visit(node: SymNode):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for (src, _i) in node.inputs:
+                visit(src)
+            order.append(node)
+
+        for (n, _i) in self._outputs:
+            visit(n)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._topo() if n.op is None]
+
+    def list_outputs(self) -> List[str]:
+        out = []
+        for (n, i) in self._outputs:
+            suffix = "_output" if n.num_outputs == 1 else f"_output{i}"
+            out.append(n.name + suffix)
+        return out
+
+    def list_inputs(self):
+        return self.list_arguments()
+
+    def get_internals(self) -> "Symbol":
+        """All intermediate outputs as a grouped symbol (reference
+        symbol.py get_internals)."""
+        entries = []
+        for n in self._topo():
+            for i in range(n.num_outputs):
+                entries.append((n, i))
+        return Symbol(entries)
+
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].attr_dict.get(key)
+        return None
+
+    def _set_attr(self, **kwargs):
+        for (n, _i) in self._outputs:
+            n.attr_dict.update({k: str(v) for k, v in kwargs.items()})
+
+    def list_attr(self):
+        return dict(self._outputs[0][0].attr_dict)
+
+    # -- composition -----------------------------------------------------
+    def compose(self, **kwargs):
+        """Replace argument variables by other symbols (reference
+        ``Symbol.__call__``/compose).  Returns a new graph; the original is
+        untouched (persistent-DAG semantics replacing nnvm's in-place
+        compose)."""
+        mapping: Dict[str, Tuple[SymNode, int]] = {}
+        for k, v in kwargs.items():
+            if not isinstance(v, Symbol):
+                raise TypeError("compose needs Symbol kwargs")
+            if len(v._outputs) != 1:
+                raise ValueError("can only compose with single-output symbols")
+            mapping[k] = v._outputs[0]
+        memo: Dict[int, SymNode] = {}
+
+        def clone(node: SymNode) -> Tuple[SymNode, bool]:
+            if id(node) in memo:
+                return memo[id(node)], True
+            if node.op is None and node.name in mapping:
+                src = mapping[node.name][0]
+                memo[id(node)] = src
+                return src, True
+            new_inputs = []
+            changed = False
+            for (src, i) in node.inputs:
+                c, _ = clone(src)
+                changed = changed or (c is not src)
+                new_inputs.append((c, i))
+            if not changed:
+                memo[id(node)] = node
+                return node, False
+            nn = SymNode(node.op, node.name, node.attrs, new_inputs,
+                         node.num_outputs)
+            nn.attr_dict = dict(node.attr_dict)
+            memo[id(node)] = nn
+            return nn, True
+
+        outs = []
+        for (n, i) in self._outputs:
+            c, _ = clone(n)
+            outs.append((c, i))
+        return Symbol(outs)
+
+    def __call__(self, **kwargs):
+        return self.compose(**kwargs)
+
+    # -- inference -------------------------------------------------------
+    def infer_shape(self, **kwargs):
+        """Infer output/arg shapes from given input shapes via jax abstract
+        evaluation (replaces infer_graph_attr_pass.cc)."""
+        return self._infer(kwargs, want="shape")
+
+    def infer_type(self, **kwargs):
+        try:
+            return self._infer({k: (1,) for k in self.list_arguments()},
+                               want="dtype", dtypes=kwargs)
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError(
+                "infer_type could not abstract-evaluate this graph with "
+                "placeholder shapes (shape-constrained ops like Convolution "
+                "need real shapes) — call infer_shape with representative "
+                f"input shapes instead: {e}") from e
+
+    def _infer(self, shapes, want="shape", dtypes=None):
+        args = self.list_arguments()
+        dtypes = dtypes or {}
+        specs = {}
+        for a in args:
+            shp = shapes.get(a)
+            if shp is None:
+                raise MXNetError(f"infer_shape: missing shape for arg '{a}'")
+            specs[a] = jax.ShapeDtypeStruct(
+                tuple(shp), dtypes.get(a, jnp.float32))
+
+        def fn(feed):
+            return execute_graph(self._outputs, feed)
+
+        out = jax.eval_shape(fn, specs)
+        arg_res = [tuple(specs[a].shape) if want == "shape" else specs[a].dtype
+                   for a in args]
+        out_res = [tuple(o.shape) if want == "shape" else onp.dtype(o.dtype)
+                   for o in out]
+        return arg_res, out_res, []
+
+    def infer_shape_partial(self, **kwargs):
+        try:
+            return self.infer_shape(**kwargs)
+        except MXNetError:
+            return None, None, None
+
+    # -- serialization ---------------------------------------------------
+    def tojson(self) -> str:
+        order = self._topo()
+        index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            nodes.append({
+                "op": n.op or "null",
+                "name": n.name,
+                "attrs": {k: _encode_attr(v) for k, v in n.attrs.items()},
+                "inputs": [[index[id(src)], i] for (src, i) in n.inputs],
+                "num_outputs": n.num_outputs,
+                "attr_dict": n.attr_dict,
+            })
+        payload = {
+            "format": "mxnet_tpu_symbol-v1",
+            "nodes": nodes,
+            "arg_nodes": [i for i, n in enumerate(order) if n.op is None],
+            "heads": [[index[id(n)], i] for (n, i) in self._outputs],
+        }
+        return json.dumps(payload, indent=1)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- execution -------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        """Evaluate with NDArray kwargs (reference symbol.py eval)."""
+        from ..ndarray.ndarray import NDArray, _wrap
+        from ..context import current_context
+
+        ctx = ctx or current_context()
+        feed = {k: (v._data if isinstance(v, NDArray) else jnp.asarray(v))
+                for k, v in kwargs.items()}
+        outs = _jit_graph(self)(feed)
+        return [_wrap(o, ctx) for o in outs]
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from ..executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    _bind = bind
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from ..executor import Executor
+        from ..ndarray import zeros
+
+        arg_shapes, _, _ = self.infer_shape(**shapes)
+        args = {a: zeros(s, ctx=ctx)
+                for a, s in zip(self.list_arguments(), arg_shapes)}
+        args_grad = None
+        if grad_req != "null":
+            args_grad = {a: zeros(s, ctx=ctx)
+                         for a, s in zip(self.list_arguments(), arg_shapes)}
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    # -- operator sugar --------------------------------------------------
+    def _binary(self, op_name, other, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _apply_op(f"broadcast_{op_name}", [a, b], {})
+        return _apply_op(f"{op_name}_scalar", [self],
+                         {"scalar": float(other), "reverse": reverse})
+
+    def __add__(self, o):
+        return self._binary("add", o)
+
+    def __radd__(self, o):
+        return self._binary("add", o, True)
+
+    def __sub__(self, o):
+        return self._binary("sub", o)
+
+    def __rsub__(self, o):
+        return self._binary("sub", o, True)
+
+    def __mul__(self, o):
+        return self._binary("mul", o)
+
+    def __rmul__(self, o):
+        return self._binary("mul", o, True)
+
+    def __truediv__(self, o):
+        return self._binary("div", o)
+
+    def __rtruediv__(self, o):
+        return self._binary("div", o, True)
+
+    def __pow__(self, o):
+        return self._binary("power", o)
+
+    def __neg__(self):
+        return _apply_op("negative", [self], {})
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    # common method sugar mirrored from NDArray surface
+    def reshape(self, shape):
+        return _apply_op("reshape", [self], {"shape": tuple(shape)})
+
+    def transpose(self, axes=None):
+        return _apply_op("transpose", [self], {"axes": axes})
+
+    def sum(self, axis=None, keepdims=False):
+        return _apply_op("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _apply_op("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+
+# ---------------------------------------------------------------------------
+# graph execution
+# ---------------------------------------------------------------------------
+
+
+def execute_graph(out_entries: List[Tuple[SymNode, int]],
+                  feed: Dict[str, Any]) -> List[Any]:
+    """Topological interpretation of the DAG over jax arrays.  Pure —
+    jit/vjp/vmap compose over it."""
+    cache: Dict[int, Tuple] = {}
+
+    def eval_node(node: SymNode):
+        got = cache.get(id(node))
+        if got is not None:
+            return got
+        if node.op is None:
+            if node.name not in feed:
+                raise MXNetError(f"unbound variable '{node.name}'")
+            val = (feed[node.name],)
+        else:
+            schema = get_op(node.op)
+            ins = [eval_node(src)[i] for (src, i) in node.inputs]
+            if schema.num_inputs == -1:
+                raw = schema.fn(ins, **node.attrs)
+            else:
+                raw = schema.fn(*ins, **node.attrs)
+            val = tuple(raw) if isinstance(raw, (list, tuple)) else (raw,)
+        cache[id(node)] = val
+        return val
+
+    return [eval_node(n)[i] for (n, i) in out_entries]
+
+
+_JIT_CACHE: "OrderedDict[Tuple, Any]" = OrderedDict()
+_JIT_CACHE_MAX = 128
+
+
+def _jit_graph(sym: Symbol):
+    key = tuple((id(n), i) for n, i in sym._outputs)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda feed: execute_graph(sym._outputs, feed))
+        if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+            _JIT_CACHE.popitem(last=False)
+        _JIT_CACHE[key] = fn
+    else:
+        _JIT_CACHE.move_to_end(key)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# construction helpers
+# ---------------------------------------------------------------------------
+
+
+def var(name: str, shape=None, dtype=None, init=None, **kwargs) -> Symbol:
+    """Create a symbolic variable (reference mx.sym.var)."""
+    attrs = {}
+    node = SymNode(None, name, attrs, [])
+    if shape is not None:
+        node.attr_dict["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        node.attr_dict["__dtype__"] = str(dtype)
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    entries = []
+    for s in symbols:
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+# variable-output ops: symbolic construction must know the output arity up
+# front (the runtime fn's return length is data-independent but declared -1
+# in the registry); attrs decide for split/topk/BatchNorm
+_VAR_NUM_OUTPUTS = {
+    "linalg_svd": 3, "linalg_slogdet": 2, "linalg_qr": 2, "linalg_eigh": 2,
+    "linalg_gelqf": 2, "linalg_lstsq": 4, "moments": 2,
+}
+
+
+def _resolve_num_outputs(schema, attrs) -> int:
+    if schema.num_outputs > 0:
+        return schema.num_outputs
+    if "num_outputs" in attrs:
+        return int(attrs["num_outputs"])
+    if schema.name in _VAR_NUM_OUTPUTS:
+        return _VAR_NUM_OUTPUTS[schema.name]
+    if schema.name == "BatchNorm":
+        return 3 if attrs.get("output_mean_var") else 1
+    if schema.name == "topk":
+        return 2 if attrs.get("ret_typ") == "both" else 1
+    return 1
+
+
+def _apply_op(op_name: str, inputs: List[Symbol], attrs: dict,
+              name: Optional[str] = None, num_outputs: Optional[int] = None)\
+        -> Symbol:
+    schema = get_op(op_name)
+    in_entries = []
+    for s in inputs:
+        if len(s._outputs) != 1:
+            raise ValueError(
+                f"op {op_name}: grouped symbol cannot be an input")
+        in_entries.append(s._outputs[0])
+    name = name or _NAMES.get(schema.name.lower())
+    n_out = num_outputs if num_outputs is not None \
+        else _resolve_num_outputs(schema, attrs)
+    node = SymNode(schema.name, name, attrs, in_entries, n_out)
+    if n_out == 1:
+        return Symbol([(node, 0)])
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+# ---------------------------------------------------------------------------
+# (de)serialization
+# ---------------------------------------------------------------------------
+
+
+def _encode_attr(v):
+    if isinstance(v, tuple):
+        return {"__tuple__": [_encode_attr(x) for x in v]}
+    if isinstance(v, slice):
+        return {"__slice__": [v.start, v.stop, v.step]}
+    if isinstance(v, (jnp.ndarray, onp.ndarray)):
+        return {"__array__": onp.asarray(v).tolist(),
+                "__dtype__": str(onp.asarray(v).dtype)}
+    if isinstance(v, type) or isinstance(v, onp.dtype):
+        return {"__dtype_attr__": onp.dtype(v).name}
+    if isinstance(v, list):
+        return [_encode_attr(x) for x in v]
+    if isinstance(v, dict):
+        return {"__dict__": {k: _encode_attr(x) for k, x in v.items()}}
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return {"__repr__": repr(v)}
+
+
+def _decode_attr(v):
+    if isinstance(v, dict):
+        if "__tuple__" in v:
+            return tuple(_decode_attr(x) for x in v["__tuple__"])
+        if "__slice__" in v:
+            return slice(*v["__slice__"])
+        if "__array__" in v:
+            return jnp.asarray(onp.array(v["__array__"],
+                                         dtype=v.get("__dtype__", "float32")))
+        if "__dtype_attr__" in v:
+            return onp.dtype(v["__dtype_attr__"])
+        if "__dict__" in v:
+            return {k: _decode_attr(x) for k, x in v["__dict__"].items()}
+        if "__repr__" in v:
+            raise MXNetError(
+                f"cannot deserialize opaque attr {v['__repr__']}")
+    if isinstance(v, list):
+        return [_decode_attr(x) for x in v]
+    return v
+
+
+def load_json(json_str: str) -> Symbol:
+    payload = json.loads(json_str)
+    nodes: List[SymNode] = []
+    for spec in payload["nodes"]:
+        op = None if spec["op"] == "null" else spec["op"]
+        if op is not None and find_op(op) is None:
+            raise MXNetError(f"symbol references unknown operator '{op}'")
+        node = SymNode(
+            op, spec["name"],
+            {k: _decode_attr(v) for k, v in spec.get("attrs", {}).items()},
+            [(nodes[i], oi) for (i, oi) in spec.get("inputs", [])],
+            spec.get("num_outputs", 1))
+        node.attr_dict = dict(spec.get("attr_dict", {}))
+        nodes.append(node)
+    heads = [(nodes[i], oi) for (i, oi) in payload["heads"]]
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())

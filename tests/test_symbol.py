@@ -1,0 +1,134 @@
+"""Symbol API / Executor / export-import tests (reference
+tests/python/unittest/test_symbol.py + test_gluon.py export cases)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.gluon import nn
+
+
+def test_symbol_compose_and_eval():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b * 2.0
+    assert set(c.list_arguments()) == {"a", "b"}
+    (out,) = c.eval(a=nd.ones((2, 2)), b=nd.ones((2, 2)))
+    onp.testing.assert_allclose(out.asnumpy(), 3 * onp.ones((2, 2)))
+
+    d = sym.var("d")
+    composed = c.compose(b=d * 3.0)
+    assert set(composed.list_arguments()) == {"a", "d"}
+    (out2,) = composed.eval(a=nd.ones((2,)), d=nd.ones((2,)))
+    onp.testing.assert_allclose(out2.asnumpy(), [7.0, 7.0])
+
+
+def test_symbol_infer_shape():
+    x = sym.var("x")
+    w = sym.var("w")
+    y = sym.FullyConnected(x, w, None, num_hidden=8, no_bias=True)
+    arg_shapes, out_shapes, _ = y.infer_shape(x=(4, 16), w=(8, 16))
+    assert out_shapes == [(4, 8)]
+    args = y.list_arguments()
+    assert args == ["x", "w"]
+
+
+def test_symbol_json_roundtrip():
+    x = sym.var("x")
+    y = sym.relu(x * 2.0 + 1.0)
+    js = y.tojson()
+    y2 = sym.load_json(js)
+    assert y2.list_arguments() == ["x"]
+    (o1,) = y.eval(x=nd.array([-1.0, 1.0]))
+    (o2,) = y2.eval(x=nd.array([-1.0, 1.0]))
+    onp.testing.assert_allclose(o1.asnumpy(), o2.asnumpy())
+
+
+def test_executor_forward_backward():
+    x = sym.var("x")
+    w = sym.var("w")
+    loss = ((x * w).sum())
+    xv = nd.array([1.0, 2.0, 3.0])
+    wv = nd.array([4.0, 5.0, 6.0])
+    gw = nd.zeros((3,))
+    gx = nd.zeros((3,))
+    exe = loss.bind(mx.cpu(), {"x": xv, "w": wv},
+                    args_grad={"x": gx, "w": gw})
+    outs = exe.forward(is_train=True)
+    assert float(outs[0].asscalar()) == pytest.approx(32.0)
+    exe.backward()
+    onp.testing.assert_allclose(gw.asnumpy(), [1.0, 2.0, 3.0])
+    onp.testing.assert_allclose(gx.asnumpy(), [4.0, 5.0, 6.0])
+
+
+def test_simple_bind():
+    x = sym.var("x")
+    y = sym.softmax(x * 3.0)
+    exe = y.simple_bind(mx.cpu(), x=(2, 4))
+    outs = exe.forward(is_train=False, x=nd.ones((2, 4)))
+    onp.testing.assert_allclose(outs[0].asnumpy().sum(-1), [1.0, 1.0],
+                                rtol=1e-6)
+
+
+def test_deferred_compute_get_symbol():
+    from mxnet_tpu import _deferred_compute as dc
+
+    with dc.deferred_compute():
+        x = nd.ones((2, 3))
+        dc.set_variable(x, "x")
+        y = nd.relu(x * 2.0 - 1.0)
+    s = mx.autograd.get_symbol(y)
+    assert s.list_arguments() == ["x"]
+    (out,) = s.eval(x=nd.full((2, 3), 2.0))
+    onp.testing.assert_allclose(out.asnumpy(), 3 * onp.ones((2, 3)))
+
+
+def test_hybridblock_export_symbolblock_imports(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 8))
+    ref = net(x)
+
+    path = str(tmp_path / "model")
+    sym_file, params_file = net.export(path)
+    assert os.path.exists(sym_file) and os.path.exists(params_file)
+
+    net2 = mx.gluon.SymbolBlock.imports(sym_file, param_file=params_file)
+    out = net2(x)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_symbolblock_trainable(tmp_path):
+    net = nn.Dense(2)
+    net.initialize()
+    x = nd.ones((3, 5))
+    net(x)
+    path = str(tmp_path / "m")
+    sf, pf = net.export(path)
+    net2 = mx.gluon.SymbolBlock.imports(sf, param_file=pf)
+    params = net2.collect_params()
+    assert len(params) == 2  # weight + bias
+    for p in params.values():
+        assert p.grad_req == "write"
+    with mx.autograd.record():
+        loss = (net2(x) ** 2).sum()
+    loss.backward()
+    grads = [p.grad(mx.cpu()) for p in params.values()]
+    assert all(float(g.abs().sum().asscalar()) > 0 for g in grads)
+
+
+def test_symbol_group_and_internals():
+    x = sym.var("x")
+    h = sym.relu(x)
+    y = sym.sigmoid(h)
+    g = sym.Group([h, y])
+    assert len(g) == 2
+    outs = g.eval(x=nd.array([-1.0, 2.0]))
+    assert len(outs) == 2
+    internals = y.get_internals()
+    assert len(internals.list_outputs()) >= 3

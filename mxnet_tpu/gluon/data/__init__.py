@@ -1,0 +1,9 @@
+"""``gluon.data`` — datasets, samplers, loaders (reference
+``python/mxnet/gluon/data/``)."""
+from . import vision
+from .batchify import Group, Pad, Stack, default_batchify_fn
+from .dataloader import DataLoader
+from .dataset import (ArrayDataset, Dataset, ImageRecordDataset,
+                      RecordFileDataset, SimpleDataset)
+from .sampler import (BatchSampler, FilterSampler, IntervalSampler,
+                      RandomSampler, Sampler, SequentialSampler)

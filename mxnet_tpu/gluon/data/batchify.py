@@ -1,0 +1,99 @@
+"""Batchify functions (reference ``python/mxnet/gluon/data/batchify.py`` +
+C++ ``src/io/batchify.cc``)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as onp
+
+from ...ndarray import NDArray, array
+
+__all__ = ["Stack", "Pad", "Group", "default_batchify_fn", "host_mode"]
+
+
+class _HostMode(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.active = False
+
+
+_HOST = _HostMode()
+
+
+@contextlib.contextmanager
+def host_mode():
+    """While active, batchify fns return host numpy instead of device
+    NDArrays — used inside DataLoader workers so forked children never
+    touch the device runtime and the batch crosses PCIe exactly once."""
+    prev = _HOST.active
+    _HOST.active = True
+    try:
+        yield
+    finally:
+        _HOST.active = prev
+
+
+def _out(a):
+    return a if _HOST.active else array(a)
+
+
+def _as_host(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+class Stack:
+    """Stack samples along a new batch axis (reference batchify.Stack)."""
+
+    def __call__(self, data):
+        return _out(onp.stack([_as_host(d) for d in data]))
+
+
+class Pad:
+    """Pad variable-length samples to the batch max (reference
+    batchify.Pad)."""
+
+    def __init__(self, axis=0, val=0, dtype=None):
+        self._axis = axis
+        self._val = val
+        self._dtype = dtype
+
+    def __call__(self, data):
+        arrs = [_as_host(d) for d in data]
+        ndim = arrs[0].ndim
+        max_len = max(a.shape[self._axis] for a in arrs)
+        shape = list(arrs[0].shape)
+        shape[self._axis] = max_len
+        out = onp.full([len(arrs)] + shape, self._val,
+                       dtype=self._dtype or arrs[0].dtype)
+        for i, a in enumerate(arrs):
+            sl = [slice(None)] * ndim
+            sl[self._axis] = slice(0, a.shape[self._axis])
+            out[(i,) + tuple(sl)] = a
+        return _out(out)
+
+
+class Group:
+    """Apply a batchify fn per field of tuple samples (reference
+    batchify.Group)."""
+
+    def __init__(self, *fns):
+        if len(fns) == 1 and isinstance(fns[0], (list, tuple)):
+            fns = fns[0]
+        self._fns = fns
+
+    def __call__(self, data):
+        assert len(data[0]) == len(self._fns)
+        return tuple(fn([d[i] for d in data])
+                     for i, fn in enumerate(self._fns))
+
+
+def default_batchify_fn(data):
+    """Stack samples; recurse into tuples (reference dataloader.py
+    default_batchify_fn)."""
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn([d[i] for d in data])
+                     for i in range(len(data[0])))
+    return Stack()(data)

@@ -1,0 +1,183 @@
+"""DataLoader with parallel workers + device prefetch.
+
+Reference analog: ``python/mxnet/gluon/data/dataloader.py`` (797 LoC) —
+multiprocessing workers passing batches through POSIX shared memory, worker
+pool with prefetch, pin_memory — and the C++ ``ThreadedDataLoader``
+(``src/io/dataloader.cc:64-182``).
+
+TPU-native design: sample loading/augmentation is host-CPU work feeding one
+``device_put`` per batch, so workers are a persistent *process pool* (heavy
+decode, true parallelism) or *thread pool* (``thread_pool=True``, zero-copy,
+good when transforms are numpy/cv2 releasing the GIL).  Batches prefetch
+``num_workers + 2`` deep, mirroring the reference's worker-pool pipelining;
+the shared-memory NDArray rebuild dance is unnecessary because host batches
+are plain numpy until the final HBM staging."""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+import numpy as onp
+
+from ...ndarray import NDArray, array
+from .batchify import default_batchify_fn
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader"]
+
+
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    # process pool only: each forked child gets its own module global
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _to_host(b):
+    if isinstance(b, tuple):
+        return tuple(_to_host(x) for x in b)
+    return b.asnumpy() if isinstance(b, NDArray) else onp.asarray(b)
+
+
+def _worker_fn(samples, batchify_fn):
+    """Runs in a worker process: fetch + batchify, return host arrays."""
+    from .batchify import host_mode
+
+    with host_mode():
+        batch = batchify_fn([_worker_dataset[i] for i in samples])
+    return _to_host(batch)
+
+
+def _thread_worker_fn(dataset, samples, batchify_fn):
+    """Thread-pool variant: dataset passed explicitly so concurrent loaders
+    never share state."""
+    from .batchify import host_mode
+
+    with host_mode():
+        batch = batchify_fn([dataset[i] for i in samples])
+    return _to_host(batch)
+
+
+class DataLoader:
+    """Load a Dataset in mini-batches (reference dataloader.py DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size is required when batch_sampler is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle and sampler are mutually exclusive")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None or
+              last_batch is not None):
+            raise ValueError(
+                "batch_size/shuffle/sampler/last_batch are mutually "
+                "exclusive with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(self._num_workers)
+            else:
+                import multiprocessing
+
+                ctx = multiprocessing.get_context("fork")
+                self._pool = ctx.Pool(self._num_workers,
+                                      initializer=_worker_init,
+                                      initargs=(dataset,))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for samples in self._batch_sampler:
+                yield self._wrap(self._batchify_fn(
+                    [self._dataset[i] for i in samples]))
+            return
+
+        if self._thread_pool:
+            futures = deque()
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._prefetch or 1):
+                    samples = next(it, None)
+                    if samples is None:
+                        break
+                    futures.append(self._pool.submit(
+                        _thread_worker_fn, self._dataset, samples,
+                        self._batchify_fn))
+                while futures:
+                    batch = futures.popleft().result(timeout=self._timeout)
+                    samples = next(it, None)
+                    if samples is not None:
+                        futures.append(self._pool.submit(
+                            _thread_worker_fn, self._dataset, samples,
+                            self._batchify_fn))
+                    yield self._wrap(batch)
+            finally:
+                for f in futures:
+                    f.cancel()
+            return
+
+        # process pool: async pipeline depth self._prefetch
+        results = deque()
+        it = iter(self._batch_sampler)
+        try:
+            for _ in range(self._prefetch or 1):
+                samples = next(it, None)
+                if samples is None:
+                    break
+                results.append(self._pool.apply_async(
+                    _worker_fn, (samples, self._batchify_fn)))
+            while results:
+                batch = results.popleft().get(self._timeout)
+                samples = next(it, None)
+                if samples is not None:
+                    results.append(self._pool.apply_async(
+                        _worker_fn, (samples, self._batchify_fn)))
+                yield self._wrap(batch)
+        except KeyboardInterrupt:
+            self._shutdown()
+            raise
+
+    def _wrap(self, batch):
+        """Host batch -> device NDArrays (the PrefetcherIter HBM staging)."""
+        if isinstance(batch, tuple):
+            return tuple(self._wrap(b) for b in batch)
+        if isinstance(batch, NDArray):
+            return batch
+        return array(batch)
+
+    def _shutdown(self):
+        if self._pool is not None:
+            if self._thread_pool:
+                self._pool.shutdown(wait=False)
+            else:
+                self._pool.terminate()
+            self._pool = None
+
+    def __del__(self):
+        self._shutdown()

@@ -1,0 +1,161 @@
+"""Datasets (reference ``python/mxnet/gluon/data/dataset.py`` + the C++
+Dataset classes ``src/io/dataset.cc:64-516``)."""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import numpy as onp
+
+from ...ndarray import NDArray
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
+           "ImageRecordDataset"]
+
+
+class Dataset:
+    """Abstract dataset: __getitem__ + __len__."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def transform(self, fn: Callable, lazy: bool = True) -> "Dataset":
+        trans = _LazyTransformDataset(self, fn)
+        if lazy:
+            return trans
+        return SimpleDataset([trans[i] for i in range(len(trans))])
+
+    def transform_first(self, fn: Callable, lazy: bool = True) -> "Dataset":
+        return self.transform(_TransformFirstClosure(fn), lazy)
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return SimpleDataset(
+            [self[i] for i in range(len(self)) if fn(self[i])])
+
+    def shard(self, num_shards: int, index: int) -> "Dataset":
+        assert 0 <= index < num_shards
+        idxs = list(range(index, len(self), num_shards))
+        return _SubsetDataset(self, idxs)
+
+    def take(self, count: int) -> "Dataset":
+        return _SubsetDataset(self, list(range(min(count, len(self)))))
+
+    def sample(self, sampler) -> "Dataset":
+        return _SubsetDataset(self, list(sampler))
+
+
+class _TransformFirstClosure:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x, *args):
+        if args:
+            return (self._fn(x),) + args
+        return self._fn(x)
+
+
+class _LazyTransformDataset(Dataset):
+    """Per-sample transform applied at access time (reference C++
+    LazyTransformDataset, src/io/dataset.cc — runs a CachedOp per sample;
+    here the transform is a python/host fn, jit-compiled by XLA if it uses
+    mx ops)."""
+
+    def __init__(self, data: Dataset, fn: Callable):
+        self._data = data
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        item = self._data[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class _SubsetDataset(Dataset):
+    def __init__(self, data: Dataset, indices: List[int]):
+        self._data = data
+        self._indices = indices
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._data[self._indices[idx]]
+
+
+class SimpleDataset(Dataset):
+    """Wrap any list-like (reference SimpleDataset)."""
+
+    def __init__(self, data: Sequence):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class ArrayDataset(Dataset):
+    """Zip of arrays/lists (reference ArrayDataset + C++ NDArrayDataset/
+    GroupDataset)."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for a in args:
+            assert len(a) == self._length, "all arrays must be same length"
+            self._data.append(a)
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+
+class RecordFileDataset(Dataset):
+    """Raw records from a .rec file (reference RecordFileDataset +
+    src/io/dataset.cc RecordFileDataset)."""
+
+    def __init__(self, filename: str):
+        from ...recordio import MXIndexedRecordIO
+
+        self._filename = filename
+        idx_file = filename.rsplit(".", 1)[0] + ".idx"
+        self._record = MXIndexedRecordIO(idx_file, filename, "r")
+
+    def __len__(self):
+        return len(self._record.keys)
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Decoded (image, label) pairs from a packed .rec (reference
+    vision/datasets.py ImageRecordDataset + C++ ImageRecordFileDataset)."""
+
+    def __init__(self, filename: str, flag: int = 1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ...recordio import unpack_img
+
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, self._flag)
+        img = img[:, :, ::-1] if img.ndim == 3 else img  # BGR->RGB
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

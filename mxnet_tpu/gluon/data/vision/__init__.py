@@ -1,0 +1,5 @@
+"""Vision datasets + transforms (reference
+``python/mxnet/gluon/data/vision/``)."""
+from . import transforms
+from .datasets import (CIFAR10, CIFAR100, MNIST, FashionMNIST,
+                       ImageFolderDataset)

@@ -1,0 +1,176 @@
+"""Vision datasets (reference ``python/mxnet/gluon/data/vision/datasets.py``).
+
+File-format parsers only — this environment has no network egress, so
+``root`` must already contain the standard archives (idx files for MNIST,
+pickled batches for CIFAR).  Download plumbing raises a clear error instead
+of silently failing.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Optional
+
+import numpy as onp
+
+from ..dataset import ArrayDataset, Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset"]
+
+
+def _read_idx(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        return onp.frombuffer(f.read(), dtype=onp.uint8).reshape(dims)
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._root = os.path.expanduser(root)
+        self._transform = transform
+        self._data = None
+        self._label = None
+        if not os.path.isdir(self._root):
+            raise IOError(
+                f"dataset root '{self._root}' does not exist; downloads are "
+                "disabled in this environment — place the files there first")
+        self._get_data()
+
+    def __len__(self):
+        return len(self._label)
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx files (reference datasets.py MNIST)."""
+
+    _files = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        img_f, lbl_f = self._files[self._train]
+        for suffix in ("", ".gz"):
+            p = os.path.join(self._root, img_f + suffix)
+            if os.path.exists(p):
+                img_f = p
+                lbl_f = os.path.join(self._root, lbl_f + suffix)
+                break
+        else:
+            raise IOError(f"{img_f} not found under {self._root}")
+        self._data = _read_idx(img_f)[:, :, :, None]
+        self._label = _read_idx(lbl_f).astype(onp.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10 from the python pickle batches (reference datasets.py
+    CIFAR10)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _batches(self):
+        if self._train:
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _get_data(self):
+        base = self._root
+        sub = os.path.join(base, "cifar-10-batches-py")
+        if os.path.isdir(sub):
+            base = sub
+        data, labels = [], []
+        for b in self._batches():
+            with open(os.path.join(base, b), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            data.append(d[b"data"])
+            labels.extend(d[b"labels"])
+        data = onp.concatenate(data).reshape(-1, 3, 32, 32)
+        self._data = onp.transpose(data, (0, 2, 3, 1))  # HWC like reference
+        self._label = onp.asarray(labels, onp.int32)
+
+
+class CIFAR100(_DownloadedDataset):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=True, train=True, transform=None):
+        self._train = train
+        self._fine = fine_label
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        base = self._root
+        sub = os.path.join(base, "cifar-100-python")
+        if os.path.isdir(sub):
+            base = sub
+        fname = "train" if self._train else "test"
+        with open(os.path.join(base, fname), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32)
+        self._data = onp.transpose(data, (0, 2, 3, 1))
+        key = b"fine_labels" if self._fine else b"coarse_labels"
+        self._label = onp.asarray(d[key], onp.int32)
+
+
+class ImageFolderDataset(Dataset):
+    """class-per-subfolder image tree (reference vision/datasets.py
+    ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = {".jpg", ".jpeg", ".png", ".bmp"}
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if os.path.splitext(fname)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        import cv2
+
+        fname, label = self.items[idx]
+        img = cv2.imread(fname, self._flag)
+        if img.ndim == 3:
+            img = img[:, :, ::-1]  # BGR->RGB
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

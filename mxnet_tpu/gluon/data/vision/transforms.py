@@ -1,0 +1,240 @@
+"""Vision transforms (reference
+``python/mxnet/gluon/data/vision/transforms.py``).
+
+Transforms are host-side (numpy/cv2) because they run inside DataLoader
+workers before the single per-batch HBM transfer — the same split the
+reference uses (augmenters in ``src/io/image_aug_default.cc`` run on CPU
+decode threads, never on device).
+"""
+from __future__ import annotations
+
+import random as pyrandom
+from typing import Sequence
+
+import numpy as onp
+
+from ....ndarray import NDArray, array
+from ...block import Block
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomLighting", "RandomCrop"]
+
+
+def _as_host(x):
+    return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+
+
+class _Transform(Block):
+    """Transforms compute on host numpy end-to-end; the output is wrapped
+    back into an NDArray only when the *input* was one, so a Compose
+    pipeline inside a DataLoader worker never touches the device."""
+
+    def __init__(self):
+        super().__init__()
+
+    def __call__(self, *args):
+        wrap = isinstance(args[0], NDArray)
+        out = self.forward(*args)
+        if wrap and not isinstance(out, NDArray):
+            return array(onp.ascontiguousarray(out))
+        return out
+
+
+class Compose(_Transform):
+    def __init__(self, transforms: Sequence):
+        super().__init__()
+        self._transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast(_Transform):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return _as_host(x).astype(self._dtype)
+
+
+class ToTensor(_Transform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference ToTensor)."""
+
+    def forward(self, x):
+        x = _as_host(x)
+        if x.ndim == 2:
+            x = x[:, :, None]
+        return onp.transpose(x, (2, 0, 1)).astype(onp.float32) / 255.0
+
+
+class Normalize(_Transform):
+    """Channel-wise (x - mean) / std on CHW tensors (reference Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, onp.float32).reshape(-1, 1, 1)
+        self._std = onp.asarray(std, onp.float32).reshape(-1, 1, 1)
+
+    def forward(self, x):
+        x = _as_host(x)
+        return (x - self._mean) / self._std
+
+
+def _resize(img, size, interp=1):
+    import cv2
+
+    if isinstance(size, int):
+        h, w = img.shape[:2]
+        if h < w:
+            new = (int(w * size / h), size)
+        else:
+            new = (size, int(h * size / w))
+    else:
+        new = (size[0], size[1])  # (w, h)
+    return cv2.resize(img, new, interpolation=interp)
+
+
+class Resize(_Transform):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if keep_ratio or isinstance(size, int) else \
+            (size, size) if isinstance(size, int) else size
+        if isinstance(size, int) and not keep_ratio:
+            self._size = (size, size)
+        self._interp = interpolation
+
+    def forward(self, x):
+        return _resize(_as_host(x), self._size, self._interp)
+
+
+class CenterCrop(_Transform):
+    def __init__(self, size):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        x = _as_host(x)
+        h, w = x.shape[:2]
+        cw, ch = self._size
+        x0 = max(0, (w - cw) // 2)
+        y0 = max(0, (h - ch) // 2)
+        return x[y0:y0 + ch, x0:x0 + cw]
+
+
+class RandomCrop(_Transform):
+    def __init__(self, size, pad=None):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._pad = pad
+
+    def forward(self, x):
+        x = _as_host(x)
+        if self._pad:
+            p = self._pad
+            x = onp.pad(x, ((p, p), (p, p), (0, 0)), mode="constant")
+        h, w = x.shape[:2]
+        cw, ch = self._size
+        x0 = pyrandom.randint(0, max(0, w - cw))
+        y0 = pyrandom.randint(0, max(0, h - ch))
+        return x[y0:y0 + ch, x0:x0 + cw]
+
+
+class RandomResizedCrop(_Transform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        x = _as_host(x)
+        h, w = x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * pyrandom.uniform(*self._scale)
+            ar = pyrandom.uniform(*self._ratio)
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if cw <= w and ch <= h:
+                x0 = pyrandom.randint(0, w - cw)
+                y0 = pyrandom.randint(0, h - ch)
+                crop = x[y0:y0 + ch, x0:x0 + cw]
+                return _resize(crop, self._size, self._interp)
+        return _resize(x, self._size, self._interp)
+
+
+class RandomFlipLeftRight(_Transform):
+    def forward(self, x):
+        x = _as_host(x)
+        if pyrandom.random() < 0.5:
+            x = x[:, ::-1]
+        return onp.ascontiguousarray(x)
+
+
+class RandomFlipTopBottom(_Transform):
+    def forward(self, x):
+        x = _as_host(x)
+        if pyrandom.random() < 0.5:
+            x = x[::-1]
+        return onp.ascontiguousarray(x)
+
+
+class RandomBrightness(_Transform):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        x = _as_host(x).astype(onp.float32)
+        alpha = 1.0 + pyrandom.uniform(-self._b, self._b)
+        return onp.clip(x * alpha, 0, 255)
+
+
+class RandomContrast(_Transform):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        x = _as_host(x).astype(onp.float32)
+        alpha = 1.0 + pyrandom.uniform(-self._c, self._c)
+        gray = x.mean()
+        return onp.clip(gray + alpha * (x - gray), 0, 255)
+
+
+class RandomSaturation(_Transform):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        x = _as_host(x).astype(onp.float32)
+        alpha = 1.0 + pyrandom.uniform(-self._s, self._s)
+        gray = x.mean(axis=2, keepdims=True)
+        return onp.clip(gray + alpha * (x - gray), 0, 255)
+
+
+class RandomLighting(_Transform):
+    """AlexNet-style PCA lighting noise (reference RandomLighting)."""
+
+    _eigval = onp.array([55.46, 4.794, 1.148], onp.float32)
+    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], onp.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        x = _as_host(x).astype(onp.float32)
+        a = onp.random.normal(0, self._alpha, 3).astype(onp.float32)
+        rgb = (self._eigvec * a * self._eigval).sum(axis=1)
+        return onp.clip(x + rgb, 0, 255)

@@ -1,0 +1,550 @@
+"""``mx.io`` — data iterators.
+
+Reference analog: C++ iterator framework ``src/io/`` (IIterator registry,
+``iter_image_recordio_2.cc``, ``iter_csv.cc``, ``iter_mnist.cc``,
+``iter_prefetcher.h``) + python wrapper ``python/mxnet/io/io.py``.
+TPU-native design: decode/augment runs on host CPU threads, batches land in
+HBM via one ``device_put`` per batch (the host→HBM staging the reference's
+PrefetcherIter+engine pair provided); ``PrefetchingIter`` double-buffers
+with a background thread so input never stalls the TPU step.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+from collections import namedtuple
+from typing import Any, Dict, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import cpu, current_context
+from ..ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "ImageRecordIter", "PrefetchingIter", "ResizeIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """Data descriptor (reference io.py DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=onp.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    """One mini-batch (reference io.py DataBatch)."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data if isinstance(data, (list, tuple)) else [data]
+        if label is None:
+            self.label = []
+        else:
+            self.label = label if isinstance(label, (list, tuple)) else [label]
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        shapes = [d.shape for d in self.data]
+        return f"DataBatch: data shapes {shapes} pad {self.pad}"
+
+
+class DataIter:
+    """Base iterator (reference io.py DataIter)."""
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize data/label input to list of (name, ndarray) (reference
+    io.py _init_data)."""
+    if data is None:
+        if not allow_empty:
+            raise ValueError("data cannot be None")
+        return []
+    if isinstance(data, (onp.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise ValueError("empty data list")
+        out = []
+        for i, d in enumerate(data):
+            name = default_name if len(data) == 1 else f"_{i}_{default_name}"
+            out.append((name, d))
+    elif isinstance(data, dict):
+        out = list(data.items())
+    else:
+        raise TypeError(f"unsupported data type {type(data)}")
+    return [(k, onp.asarray(v.asnumpy() if isinstance(v, NDArray) else v))
+            for k, v in out]
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = onp.arange(self.num_data)
+        self._rollover: Optional[onp.ndarray] = None  # carried remainder
+        if shuffle:
+            onp.random.shuffle(self._order)
+        if last_batch_handle == "discard":
+            self._limit = (self.num_data // batch_size) * batch_size
+        elif last_batch_handle == "roll_over":
+            # remainder rolls into the next epoch (reference NDArrayIter
+            # roll_over); this epoch only yields full batches
+            self._limit = (self.num_data // batch_size) * batch_size
+        else:
+            self._limit = self.num_data
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and \
+                self._limit < self.num_data:
+            self._rollover = self._order[self._limit:].copy()
+        self.cursor = -self.batch_size
+        order = onp.arange(self.num_data)
+        if self.shuffle:
+            onp.random.shuffle(order)
+        if self._rollover is not None:
+            order = onp.concatenate([self._rollover, order])
+            self._rollover = None
+            self._limit = (len(order) // self.batch_size) * self.batch_size \
+                if self.last_batch_handle in ("discard", "roll_over") \
+                else len(order)
+        self._order = order
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self._limit
+
+    def _take(self, arrays):
+        end = self.cursor + self.batch_size
+        idx = self._order[self.cursor:min(end, len(self._order))]
+        out = []
+        for _k, v in arrays:
+            chunk = v[idx]
+            if len(idx) < self.batch_size:  # pad wrap-around
+                reps = self.batch_size - len(idx)
+                chunk = onp.concatenate([chunk, v[self._order[:reps]]], 0)
+            out.append(array(chunk))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        return max(0, end - self._limit)
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (reference ``src/io/iter_csv.cc:164-218``)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = onp.loadtxt(data_csv, delimiter=",", dtype=onp.float32,
+                           ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=",", dtype=onp.float32,
+                                ndmin=2).reshape((-1,) + tuple(label_shape))
+        else:
+            label = onp.zeros((data.shape[0],) + tuple(label_shape),
+                              onp.float32)
+        self._inner = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+    def getdata(self):
+        return self._inner.getdata()
+
+    def getlabel(self):
+        return self._inner.getlabel()
+
+    def getpad(self):
+        return self._inner.getpad()
+
+
+def _read_idx_images(path):
+    """Parse an IDX (MNIST) image/label file (reference iter_mnist.cc)."""
+    import gzip
+
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = onp.frombuffer(f.read(), dtype=onp.uint8)
+        return data.reshape(dims)
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator (reference ``src/io/iter_mnist.cc``)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 seed=0, silent=True, **kwargs):
+        super().__init__(batch_size)
+        imgs = _read_idx_images(image).astype(onp.float32) / 255.0
+        lbls = _read_idx_images(label).astype(onp.float32)
+        if flat:
+            imgs = imgs.reshape(imgs.shape[0], -1)
+        else:
+            imgs = imgs.reshape(imgs.shape[0], 1, imgs.shape[1], imgs.shape[2])
+        if shuffle:
+            order = onp.random.RandomState(seed).permutation(imgs.shape[0])
+            imgs, lbls = imgs[order], lbls[order]
+        self._inner = NDArrayIter(imgs, lbls, batch_size,
+                                  last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+    def getdata(self):
+        return self._inner.getdata()
+
+    def getlabel(self):
+        return self._inner.getlabel()
+
+    def getpad(self):
+        return self._inner.getpad()
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator with threaded decode + augmentation.
+
+    Reference: ``src/io/iter_image_recordio_2.cc:887`` (ImageRecordIter2) —
+    RecordIO shards, multithreaded JPEG decode, augment, batch, prefetch.
+    Supports the same core params: path_imgrec, data_shape, batch_size,
+    shuffle, part_index/num_parts sharding (distributed), mean/std
+    normalization, rand_crop, rand_mirror.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, rand_crop=False, rand_mirror=False,
+                 preprocess_threads=4, label_width=1, round_batch=True,
+                 seed=0, **kwargs):
+        super().__init__(batch_size)
+        from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
+
+        self.data_shape = tuple(data_shape)
+        self._unpack_img = unpack_img
+        self.label_width = label_width
+        self.mean = onp.array([mean_r, mean_g, mean_b],
+                              onp.float32).reshape(3, 1, 1)
+        self.std = onp.array([std_r, std_g, std_b],
+                             onp.float32).reshape(3, 1, 1)
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.shuffle = shuffle
+        self.round_batch = round_batch
+        self._seed = seed
+        self._rng = onp.random.RandomState(seed)  # shuffle only (1 thread)
+        self._epoch = 0
+        self.preprocess_threads = preprocess_threads
+        self._pool = None
+        if preprocess_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(preprocess_threads)
+
+        if path_imgidx and os.path.exists(path_imgidx):
+            rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            keys = rec.keys
+        else:
+            # build offsets by a sequential scan (index-less shard)
+            rec = MXRecordIO(path_imgrec, "r")
+            offsets = []
+            while True:
+                pos = rec.tell()
+                if rec.read() is None:
+                    break
+                offsets.append(pos)
+            rec.reset()
+            keys = list(range(len(offsets)))
+            self._offsets = offsets
+        self._rec = rec
+        self._indexed = path_imgidx and os.path.exists(path_imgidx)
+        # distributed sharding: this worker owns [part_index::num_parts]
+        keys = keys[part_index::num_parts]
+        self._keys = keys
+        self._order = list(range(len(keys)))
+        self.cursor = 0
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        self.cursor = 0
+        self._epoch += 1
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+
+    def _read_record(self, key):
+        with self._lock:
+            if self._indexed:
+                raw = self._rec.read_idx(key)
+            else:
+                self._rec.handle.seek(self._offsets[key])
+                raw = self._rec.read()
+        return raw
+
+    def _decode_one(self, key):
+        # per-record deterministic RNG: thread-safe under the decode pool
+        # and reproducible given `seed` regardless of thread scheduling
+        rng = onp.random.RandomState(
+            (self._seed * 1_000_003 + self._epoch * 7_919 + int(key))
+            % (2 ** 31 - 1))
+        header, img = self._unpack_img(self._read_record(key))
+        c, h, w = self.data_shape
+        ih, iw = img.shape[:2]
+        if self.rand_crop and ih > h and iw > w:
+            y0 = rng.randint(0, ih - h + 1)
+            x0 = rng.randint(0, iw - w + 1)
+            img = img[y0:y0 + h, x0:x0 + w]
+        elif (ih, iw) != (h, w):
+            import cv2
+
+            img = cv2.resize(img, (w, h))
+        if img.ndim == 2:
+            img = img[:, :, None].repeat(3, axis=2)
+        img = img[:, :, ::-1]  # BGR (cv2) -> RGB, like the reference
+        if self.rand_mirror and rng.rand() < 0.5:
+            img = img[:, ::-1, :]
+        chw = onp.transpose(img, (2, 0, 1)).astype(onp.float32)
+        chw = (chw - self.mean) / self.std
+        label = header.label
+        if isinstance(label, onp.ndarray):
+            label = label[:self.label_width]
+        return chw, onp.float32(label)
+
+    def iter_next(self):
+        if self.round_batch:
+            return self.cursor < len(self._order)
+        return self.cursor + self.batch_size <= len(self._order)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        idxs = list(self._order[self.cursor:self.cursor + self.batch_size])
+        self.cursor += self.batch_size
+        pad = self.batch_size - len(idxs)
+        if pad > 0:  # round_batch: wrap around like the reference
+            idxs += list(self._order[:pad])
+        keys = [self._keys[i] for i in idxs]
+        if self._pool is not None:
+            decoded = list(self._pool.map(self._decode_one, keys))
+        else:
+            decoded = [self._decode_one(k) for k in keys]
+        data = onp.stack([d for d, _l in decoded])
+        label = onp.stack([l for _d, l in decoded])
+        return DataBatch([array(data)], [array(label)], pad=pad)
+
+    def getdata(self):
+        raise NotImplementedError("use next()")
+
+    def __del__(self):
+        if getattr(self, "_pool", None) is not None:
+            self._pool.shutdown(wait=False)
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to a fixed number of batches (reference
+    io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (reference ``src/io/iter_prefetcher.h`` —
+    double-buffering through the engine; here a worker thread + queue keeps
+    host decode ahead of device consumption)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth: int = 2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        if len(iters) != 1:
+            raise NotImplementedError(
+                "multi-iterator PrefetchingIter is not supported; compose "
+                "datasets instead")
+        self.iter = iters[0]
+        self._depth = prefetch_depth
+        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
+        self._thread = None
+        self._stop = threading.Event()
+        self._done = False
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+    def _start(self):
+        self._stop.clear()
+
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                except Exception as e:  # surface at next() like engine
+                    self._queue.put(e)
+                    return
+                self._queue.put(batch)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.iter.reset()
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._done = False
+        self._start()
+
+    def next(self):
+        if self._done:
+            raise StopIteration
+        item = self._queue.get()
+        if item is None:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._done = True
+            raise item
+        return item
+
+    def iter_next(self):
+        raise NotImplementedError("use next()")

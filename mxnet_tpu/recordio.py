@@ -1,0 +1,245 @@
+"""RecordIO: binary record container + image packing.
+
+Reference analog: ``python/mxnet/recordio.py`` + dmlc-core's
+``recordio.h`` writer/reader used by ``src/io/iter_image_recordio_2.cc``.
+The on-disk format is kept bit-compatible with dmlc RecordIO (magic
+``0xced7230a``, length word with a 3-bit continuation flag, 4-byte record
+alignment, ``IRHeader`` = ``<IfQQ``) so ``.rec`` shards produced by the
+reference's ``tools/im2rec.py`` load here unchanged.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import struct
+from collections import namedtuple
+from typing import Optional
+
+import numpy as onp
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xCED7230A
+_LFLAG_BITS = 29
+_LEN_MASK = (1 << _LFLAG_BITS) - 1
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference recordio.py:35)."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.writable = None
+        self.open()
+
+    def open(self, append: bool = False):
+        if self.flag == "w":
+            # append=True preserves existing records: used when re-opening
+            # an already-written shard after fork or unpickle; plain open
+            # ('w' / reset()) truncates, matching the reference semantics
+            self.handle = open(self.uri, "ab" if append else "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("flag must be 'r' or 'w'")
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        if self.writable and self.handle is not None:
+            self.handle.flush()  # unpickled writers append after this point
+        d = dict(self.__dict__)
+        d["handle"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        flag = "w" if self.writable else "r"
+        self.flag = flag
+        self.open(append=self.writable)
+
+    def _check_pid(self):
+        # after fork (DataLoader workers) reopen to get a private offset,
+        # the reference's pthread_atfork story (src/initialize.cc:71);
+        # append mode so a forked writer never truncates the shard
+        if self.pid != os.getpid():
+            self.close()
+            self.open(append=self.writable)
+
+    def write(self, buf: bytes):
+        assert self.writable
+        self._check_pid()
+        upper = _LEN_MASK
+        # multi-part encoding for payloads beyond the 29-bit length field
+        n = len(buf)
+        if n <= upper:
+            self._write_chunk(buf, 0)
+        else:
+            nparts = (n + upper - 1) // upper
+            for i in range(nparts):
+                part = buf[i * upper:(i + 1) * upper]
+                cflag = 1 if i == 0 else (3 if i == nparts - 1 else 2)
+                self._write_chunk(part, cflag)
+
+    def _write_chunk(self, buf: bytes, cflag: int):
+        self.handle.write(struct.pack("<II", _kMagic,
+                                      (cflag << _LFLAG_BITS) | len(buf)))
+        self.handle.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        assert not self.writable
+        self._check_pid()
+        parts = []
+        while True:
+            header = self.handle.read(8)
+            if len(header) < 8:
+                return b"".join(parts) if parts else None
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _kMagic:
+                raise IOError(f"invalid RecordIO magic {magic:#x} in {self.uri}")
+            cflag = lrec >> _LFLAG_BITS
+            length = lrec & _LEN_MASK
+            data = self.handle.read(length)
+            pad = (4 - (length % 4)) % 4
+            if pad:
+                self.handle.read(pad)
+            parts.append(data)
+            if cflag in (0, 3):
+                return b"".join(parts)
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self) -> int:
+        return self.handle.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO via a ``key\\tpos`` index file (reference
+    recordio.py:146)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str,
+                 key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self, append: bool = False):
+        super().open(append=append)
+        if append and self.writable:
+            self.fidx = open(self.idx_path, "a")
+            return
+        self.idx = {}
+        self.keys = []
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = None
+            with open(self.idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def __getstate__(self):
+        if self.writable and self.fidx is not None:
+            self.fidx.flush()
+        d = super().__getstate__()
+        d["fidx"] = None
+        return d
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid()
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx) -> bytes:
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        assert self.writable
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{idx}\t{pos}\n")
+        self.idx[idx] = pos
+        self.keys.append(idx)
+
+
+# ---------------------------------------------------------------------------
+# image record packing (reference recordio.py:207-344)
+# ---------------------------------------------------------------------------
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    label = header.label
+    if isinstance(label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = onp.asarray(label, dtype=onp.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, *header) + s
+
+
+def unpack(s: bytes):
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = onp.frombuffer(s[:header.flag * 4], dtype=onp.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header: IRHeader, img, quality: int = 95,
+             img_fmt: str = ".jpg") -> bytes:
+    import cv2
+
+    ok, buf = cv2.imencode(
+        img_fmt, onp.asarray(img),
+        [cv2.IMWRITE_JPEG_QUALITY, quality] if img_fmt in (".jpg", ".jpeg")
+        else [cv2.IMWRITE_PNG_COMPRESSION, 3])
+    if not ok:
+        raise IOError("failed to encode image")
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s: bytes, iscolor: int = 1):
+    import cv2
+
+    header, img_bytes = unpack(s)
+    img = cv2.imdecode(onp.frombuffer(img_bytes, dtype=onp.uint8), iscolor)
+    return header, img

@@ -1,0 +1,239 @@
+"""Data IO tests (reference tests/python/unittest/test_io.py,
+test_recordio.py, test_gluon_data.py)."""
+import os
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.io import (CSVIter, DataBatch, DataDesc, MNISTIter,
+                          NDArrayIter, PrefetchingIter, ResizeIter,
+                          ImageRecordIter)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(10):
+        w.write(f"record_{i}".encode() * (i + 1))
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(10):
+        assert r.read() == f"record_{i}".encode() * (i + 1)
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(20):
+        w.write_idx(i, f"data{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.keys == list(range(20))
+    assert r.read_idx(13) == b"data13"
+    assert r.read_idx(2) == b"data2"
+    r.close()
+
+
+def test_irheader_pack_unpack():
+    h = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, body = recordio.unpack(s)
+    assert h2.label == 3.0 and h2.id == 7 and body == b"payload"
+    # multi-label
+    h = recordio.IRHeader(0, onp.array([1.0, 2.0, 3.0], onp.float32), 1, 0)
+    s = recordio.pack(h, b"x")
+    h2, body = recordio.unpack(s)
+    onp.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+    assert body == b"x"
+
+
+def test_pack_img_roundtrip(tmp_path):
+    img = (onp.random.RandomState(0).rand(32, 32, 3) * 255).astype(onp.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          img_fmt=".png")
+    h, img2 = recordio.unpack_img(s)
+    assert h.label == 1.0
+    onp.testing.assert_array_equal(img, img2)  # png is lossless
+
+
+def test_ndarray_iter():
+    data = onp.arange(40, dtype=onp.float32).reshape(10, 4)
+    label = onp.arange(10, dtype=onp.float32)
+    it = NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+    # discard mode
+    it2 = NDArrayIter(data, label, batch_size=3,
+                      last_batch_handle="discard")
+    assert len(list(it2)) == 3
+    # roll_over: remainder carries into the next epoch
+    it3 = NDArrayIter(data, label, batch_size=3,
+                      last_batch_handle="roll_over")
+    assert len(list(it3)) == 3  # 9 of 10 seen, 1 rolls
+    it3.reset()
+    assert len(list(it3)) == 3  # (1 + 10) // 3 full batches
+    # provide_data
+    assert it.provide_data[0].shape == (3, 4)
+
+
+def test_csv_iter(tmp_path):
+    data_csv = str(tmp_path / "d.csv")
+    onp.savetxt(data_csv, onp.arange(24).reshape(6, 4), delimiter=",")
+    it = CSVIter(data_csv=data_csv, data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                                [[0, 1, 2, 3], [4, 5, 6, 7]])
+
+
+def _write_idx_file(path, arr):
+    """Write MNIST idx format."""
+    with open(path, "wb") as f:
+        ndim = arr.ndim
+        f.write(struct.pack(">I", 0x0800 | ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(onp.uint8).tobytes())
+
+
+def test_mnist_iter(tmp_path):
+    imgs = (onp.random.RandomState(0).rand(50, 28, 28) * 255).astype(onp.uint8)
+    lbls = onp.random.RandomState(1).randint(0, 10, (50,)).astype(onp.uint8)
+    ip = str(tmp_path / "imgs-idx3-ubyte")
+    lp = str(tmp_path / "lbls-idx1-ubyte")
+    _write_idx_file(ip, imgs)
+    _write_idx_file(lp, lbls)
+    it = MNISTIter(image=ip, label=lp, batch_size=10, shuffle=False)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (10, 1, 28, 28)
+    assert float(batches[0].data[0].max().asscalar()) <= 1.0
+
+
+def _make_rec(tmp_path, n=24, size=40):
+    rec_p = str(tmp_path / "img.rec")
+    idx_p = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx_p, rec_p, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 255).astype(onp.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 10), i, 0), img, img_fmt=".png"))
+    w.close()
+    return rec_p, idx_p
+
+
+def test_image_record_iter(tmp_path):
+    rec_p, idx_p = _make_rec(tmp_path)
+    it = ImageRecordIter(path_imgrec=rec_p, path_imgidx=idx_p,
+                         data_shape=(3, 32, 32), batch_size=8, shuffle=True,
+                         rand_crop=True, rand_mirror=True,
+                         preprocess_threads=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (8, 3, 32, 32)
+    assert batches[0].label[0].shape == (8,)
+    # distributed sharding
+    it_half = ImageRecordIter(path_imgrec=rec_p, path_imgidx=idx_p,
+                              data_shape=(3, 32, 32), batch_size=4,
+                              part_index=1, num_parts=2)
+    assert len(list(it_half)) == 3  # 12 records / bs 4
+
+
+def test_prefetching_and_resize_iter():
+    data = onp.arange(80, dtype=onp.float32).reshape(20, 4)
+    base = NDArrayIter(data, onp.zeros(20), batch_size=5)
+    pf = PrefetchingIter(base)
+    batches = list(pf)
+    assert len(batches) == 4
+    assert list(pf) == []  # exhausted: StopIteration again, no hang
+    pf.reset()
+    assert len(list(pf)) == 4
+    base2 = NDArrayIter(data, onp.zeros(20), batch_size=5)
+    rz = ResizeIter(base2, 7)
+    assert len(list(rz)) == 7  # wraps around
+
+
+def test_dataset_and_transforms():
+    X = onp.random.RandomState(0).rand(30, 8, 8, 3).astype(onp.float32)
+    y = onp.arange(30)
+    ds = gdata.ArrayDataset(X, y)
+    assert len(ds) == 30
+    x0, y0 = ds[0]
+    assert x0.shape == (8, 8, 3) and y0 == 0
+    ds2 = ds.transform_first(lambda x: x * 2)
+    x0b, _ = ds2[0]
+    onp.testing.assert_allclose(onp.asarray(x0b), X[0] * 2)
+    sub = ds.shard(3, 1)
+    assert len(sub) == 10
+    assert len(ds.take(5)) == 5
+
+
+def test_transforms_pipeline():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    img = (onp.random.RandomState(0).rand(40, 36, 3) * 255).astype(onp.uint8)
+    tf = T.Compose([T.Resize((32, 32)), T.ToTensor(),
+                    T.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))])
+    # numpy in -> numpy out (stays on host inside DataLoader workers)
+    out = tf(img)
+    assert isinstance(out, onp.ndarray)
+    assert out.shape == (3, 32, 32)
+    assert out.min() >= -1.001 and out.max() <= 1.001
+    # NDArray in -> NDArray out (API parity for direct use)
+    out_nd = tf(mx.nd.array(img.astype(onp.float32)))
+    assert isinstance(out_nd, mx.nd.NDArray)
+    cc = T.CenterCrop(20)(img)
+    assert cc.shape == (20, 20, 3)
+    rc = T.RandomResizedCrop(16)(img)
+    assert rc.shape == (16, 16, 3)
+
+
+def test_dataloader_serial_and_threaded():
+    X = onp.random.RandomState(0).rand(32, 4).astype(onp.float32)
+    y = onp.arange(32, dtype=onp.float32)
+    ds = gdata.ArrayDataset(X, y)
+    dl = gdata.DataLoader(ds, batch_size=8, shuffle=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    d0, l0 = batches[0]
+    assert d0.shape == (8, 4) and l0.shape == (8,)
+    onp.testing.assert_allclose(d0.asnumpy(), X[:8])
+    # threaded workers
+    dl2 = gdata.DataLoader(ds, batch_size=8, num_workers=2, thread_pool=True)
+    batches2 = list(dl2)
+    assert len(batches2) == 4
+    total = sum(float(b[1].sum().asscalar()) for b in batches2)
+    assert total == float(y.sum())
+    # samplers
+    dl3 = gdata.DataLoader(ds, batch_size=10, last_batch="discard")
+    assert len(list(dl3)) == 3
+    # Pad batchify
+    var = gdata.SimpleDataset([onp.ones(i + 1, onp.float32)
+                               for i in range(7)])
+    dl4 = gdata.DataLoader(var, batch_size=4,
+                           batchify_fn=gdata.Pad(val=-1))
+    b = list(dl4)[0]
+    assert b.shape == (4, 4)
+    assert float(b[0][1].asscalar()) == -1.0
+
+
+def test_dataloader_multiprocess():
+    X = onp.random.RandomState(3).rand(24, 4).astype(onp.float32)
+    ds = gdata.ArrayDataset(X, onp.arange(24, dtype=onp.float32))
+    dl = gdata.DataLoader(ds, batch_size=6, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4
+    got = onp.concatenate([b[0].asnumpy() for b in batches])
+    onp.testing.assert_allclose(got, X)

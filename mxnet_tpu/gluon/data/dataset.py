@@ -31,8 +31,12 @@ class Dataset:
         return self.transform(_TransformFirstClosure(fn), lazy)
 
     def filter(self, fn: Callable) -> "Dataset":
-        return SimpleDataset(
-            [self[i] for i in range(len(self)) if fn(self[i])])
+        kept = []
+        for i in range(len(self)):
+            sample = self[i]  # fetch once: samples may be expensive decodes
+            if fn(sample):
+                kept.append(sample)
+        return SimpleDataset(kept)
 
     def shard(self, num_shards: int, index: int) -> "Dataset":
         assert 0 <= index < num_shards

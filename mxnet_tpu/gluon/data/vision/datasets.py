@@ -7,27 +7,16 @@ of silently failing.
 """
 from __future__ import annotations
 
-import gzip
 import os
 import pickle
-import struct
-import tarfile
 from typing import Optional
 
 import numpy as onp
 
+from ....io import _read_idx_images as _read_idx
 from ..dataset import ArrayDataset, Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset"]
-
-
-def _read_idx(path):
-    op = gzip.open if path.endswith(".gz") else open
-    with op(path, "rb") as f:
-        magic = struct.unpack(">I", f.read(4))[0]
-        ndim = magic & 0xFF
-        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
-        return onp.frombuffer(f.read(), dtype=onp.uint8).reshape(dims)
 
 
 class _DownloadedDataset(Dataset):

@@ -102,10 +102,9 @@ def _resize(img, size, interp=1):
 class Resize(_Transform):
     def __init__(self, size, keep_ratio=False, interpolation=1):
         super().__init__()
-        self._size = size if keep_ratio or isinstance(size, int) else \
-            (size, size) if isinstance(size, int) else size
-        if isinstance(size, int) and not keep_ratio:
-            self._size = (size, size)
+        # int + keep_ratio resizes the short edge; otherwise force (w, h)
+        self._size = (size, size) \
+            if isinstance(size, int) and not keep_ratio else size
         self._interp = interpolation
 
     def forward(self, x):

@@ -1,0 +1,366 @@
+"""Recurrent cells (reference ``python/mxnet/gluon/rnn/rnn_cell.py``).
+
+Per-step cells compose imperatively; ``unroll`` executes a python loop that
+XLA compiles into one program under hybridization (the reference's
+foreach-style unrolling).  For long sequences prefer the fused layers
+(:mod:`.rnn_layer`) whose ``lax.scan`` compiles O(1) with sequence length.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ... import autograd
+from ... import random as _random
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...ndarray.ndarray import _wrap, invoke
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+
+def _dropout(x, rate):
+    """Training-mode dropout with the explicit-key op contract
+    (ops/nn.py dropout; see gluon/nn Dropout layer)."""
+    if rate <= 0 or not autograd.is_training():
+        return x
+    key_nd = _wrap(_random.next_key(), x.ctx)
+    return invoke("Dropout", [x, key_nd], {"p": rate, "training": True})
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell", "HybridSequentialRNNCell"]
+
+
+class RecurrentCell(HybridBlock):
+    """Base cell: (input, states) -> (output, new_states)."""
+
+    def __init__(self):
+        super().__init__()
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ...ndarray import zeros
+
+        return [zeros(info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell for ``length`` steps (reference rnn_cell.py
+        unroll)."""
+        from ...ndarray import stack as nd_stack
+        from ...ops.registry import get_op
+
+        axis = layout.find("T")
+        if isinstance(inputs, NDArray):
+            inputs = [
+                x.squeeze(axis=axis)
+                for x in inputs.split(num_outputs=length, axis=axis)
+            ]
+        if begin_state is None:
+            batch = inputs[0].shape[0]
+            begin_state = self.begin_state(batch, ctx=inputs[0].ctx,
+                                           dtype=inputs[0].dtype)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            out, states = self(inputs[t], states)
+            outputs.append(out)
+        if valid_length is not None:
+            outputs = [
+                invoke("where", [
+                    (valid_length > t).broadcast_like(outputs[t]),
+                    outputs[t],
+                    outputs[t] * 0,
+                ], {})
+                for t in range(length)
+            ]
+        if merge_outputs or merge_outputs is None:
+            merged = invoke(get_op("stack"), outputs, {"axis": axis})
+            return merged, states
+        return outputs, states
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 dtype="float32"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = Parameter(
+            "i2h_weight",
+            shape=(hidden_size, input_size) if input_size else None,
+            dtype=dtype, allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(hidden_size, hidden_size),
+                                    dtype=dtype)
+        self.i2h_bias = Parameter("i2h_bias", shape=(hidden_size,),
+                                  dtype=dtype)
+        self.h2h_bias = Parameter("h2h_bias", shape=(hidden_size,),
+                                  dtype=dtype)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        if self.i2h_weight.shape is None or \
+                any(s == 0 for s in self.i2h_weight.shape):
+            self.i2h_weight.shape = (self._hidden_size, int(x.shape[-1]))
+
+    def forward(self, x, states):
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        i2h = invoke("FullyConnected",
+                     [x, self.i2h_weight.data(x.ctx),
+                      self.i2h_bias.data(x.ctx)],
+                     {"num_hidden": self._hidden_size})
+        h2h = invoke("FullyConnected",
+                     [h, self.h2h_weight.data(x.ctx),
+                      self.h2h_bias.data(x.ctx)],
+                     {"num_hidden": self._hidden_size})
+        out = invoke("Activation", [i2h + h2h],
+                     {"act_type": self._activation})
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    """LSTM cell, gate order i f g o (reference rnn_cell.py LSTMCell)."""
+
+    def __init__(self, hidden_size, input_size=0, dtype="float32"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        ng = 4 * hidden_size
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(ng, input_size) if input_size else None,
+            dtype=dtype, allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(ng, hidden_size),
+                                    dtype=dtype)
+        self.i2h_bias = Parameter("i2h_bias", shape=(ng,), dtype=dtype)
+        self.h2h_bias = Parameter("h2h_bias", shape=(ng,), dtype=dtype)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        if self.i2h_weight.shape is None or \
+                any(s == 0 for s in self.i2h_weight.shape):
+            self.i2h_weight.shape = (4 * self._hidden_size, int(x.shape[-1]))
+
+    def forward(self, x, states):
+        h, c = states
+        ng = 4 * self._hidden_size
+        gates = invoke("FullyConnected",
+                       [x, self.i2h_weight.data(x.ctx),
+                        self.i2h_bias.data(x.ctx)], {"num_hidden": ng}) + \
+            invoke("FullyConnected",
+                   [h, self.h2h_weight.data(x.ctx),
+                    self.h2h_bias.data(x.ctx)], {"num_hidden": ng})
+        i, f, g, o = gates.split(num_outputs=4, axis=-1)
+        c_new = f.sigmoid() * c + i.sigmoid() * g.tanh()
+        h_new = o.sigmoid() * c_new.tanh()
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(RecurrentCell):
+    """GRU cell, gate order r z n (reference rnn_cell.py GRUCell)."""
+
+    def __init__(self, hidden_size, input_size=0, dtype="float32"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        ng = 3 * hidden_size
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(ng, input_size) if input_size else None,
+            dtype=dtype, allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(ng, hidden_size),
+                                    dtype=dtype)
+        self.i2h_bias = Parameter("i2h_bias", shape=(ng,), dtype=dtype)
+        self.h2h_bias = Parameter("h2h_bias", shape=(ng,), dtype=dtype)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        if self.i2h_weight.shape is None or \
+                any(s == 0 for s in self.i2h_weight.shape):
+            self.i2h_weight.shape = (3 * self._hidden_size, int(x.shape[-1]))
+
+    def forward(self, x, states):
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        ng = 3 * self._hidden_size
+        i2h = invoke("FullyConnected",
+                     [x, self.i2h_weight.data(x.ctx),
+                      self.i2h_bias.data(x.ctx)], {"num_hidden": ng})
+        h2h = invoke("FullyConnected",
+                     [h, self.h2h_weight.data(x.ctx),
+                      self.h2h_bias.data(x.ctx)], {"num_hidden": ng})
+        ir, iz, in_ = i2h.split(num_outputs=3, axis=-1)
+        hr, hz, hn = h2h.split(num_outputs=3, axis=-1)
+        r = (ir + hr).sigmoid()
+        z = (iz + hz).sigmoid()
+        n = (in_ + r * hn).tanh()
+        out = (1.0 - z) * n + z * h
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells (reference rnn_cell.py SequentialRNNCell)."""
+
+    def __init__(self):
+        super().__init__()
+        self._cells: List[RecurrentCell] = []
+
+    def add(self, cell: RecurrentCell):
+        self._cells.append(cell)
+        self.register_child(cell, str(len(self._cells) - 1))
+
+    def __len__(self):
+        return len(self._cells)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for c in self._cells:
+            out.extend(c.state_info(batch_size))
+        return out
+
+    def forward(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info())
+            x, new = cell(x, states[p:p + n])
+            p += n
+            next_states.extend(new)
+        return x, next_states
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell: RecurrentCell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class DropoutCell(_ModifierCell):
+    """Apply dropout on output (reference rnn_cell.py DropoutCell)."""
+
+    def __init__(self, rate, base_cell=None):
+        if base_cell is None:  # standalone dropout step
+            base_cell = _IdentityCell()
+        super().__init__(base_cell)
+        self._rate = rate
+
+    def forward(self, x, states):
+        out, states = self.base_cell(x, states)
+        out = _dropout(out, self._rate)
+        return out, states
+
+
+class _IdentityCell(RecurrentCell):
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, x, states):
+        return x, states
+
+
+class ZoneoutCell(_ModifierCell):
+    """Zoneout regularization (reference rnn_cell.py ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        self._prev_output = None
+
+    def forward(self, x, states):
+        out, new_states = self.base_cell(x, states)
+        if autograd.is_training():
+            if self._zo > 0:
+                mask = _dropout(out * 0 + 1, self._zo)
+                prev = self._prev_output if self._prev_output is not None \
+                    else out * 0
+                out = invoke("where", [mask, out, prev], {})
+            if self._zs > 0:
+                new_states = [
+                    invoke("where", [_dropout(ns * 0 + 1, self._zs), ns, old],
+                           {})
+                    for ns, old in zip(new_states, states)]
+        self._prev_output = out.detach()
+        return out, new_states
+
+
+class ResidualCell(_ModifierCell):
+    """Add input to output (reference rnn_cell.py ResidualCell)."""
+
+    def forward(self, x, states):
+        out, states = self.base_cell(x, states)
+        return out + x, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Run two cells over opposite directions; only works via unroll
+    (reference rnn_cell.py BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return (self.l_cell.state_info(batch_size) +
+                self.r_cell.state_info(batch_size))
+
+    def forward(self, x, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ...ops.registry import get_op
+
+        axis = layout.find("T")
+        if isinstance(inputs, NDArray):
+            inputs = [x.squeeze(axis=axis)
+                      for x in inputs.split(num_outputs=length, axis=axis)]
+        batch = inputs[0].shape[0]
+        n_l = len(self.l_cell.state_info())
+        if begin_state is None:
+            l_states = self.l_cell.begin_state(batch, ctx=inputs[0].ctx)
+            r_states = self.r_cell.begin_state(batch, ctx=inputs[0].ctx)
+        else:
+            l_states = begin_state[:n_l]
+            r_states = begin_state[n_l:]
+        l_outs, l_states = _unroll_steps(self.l_cell, inputs, l_states)
+        r_outs, r_states = _unroll_steps(self.r_cell, inputs[::-1], r_states)
+        r_outs = r_outs[::-1]
+        outs = [invoke("concat", [lo, ro], {"dim": -1})
+                for lo, ro in zip(l_outs, r_outs)]
+        if merge_outputs or merge_outputs is None:
+            merged = invoke(get_op("stack"), outs, {"axis": axis})
+            return merged, l_states + r_states
+        return outs, l_states + r_states
+
+
+def _unroll_steps(cell, inputs, states):
+    outs = []
+    for x in inputs:
+        o, states = cell(x, states)
+        outs.append(o)
+    return outs, states

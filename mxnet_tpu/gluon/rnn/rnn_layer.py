@@ -1,0 +1,157 @@
+"""Fused recurrent layers RNN / LSTM / GRU.
+
+Reference analog: ``python/mxnet/gluon/rnn/rnn_layer.py`` (563 LoC — thin
+wrappers over the fused ``RNN`` op).  Parameters use the reference naming
+(``{l,r}{layer}_{i2h,h2h}_{weight,bias}``) so checkpoints map 1:1; compute
+goes through the ``_rnn_fused`` lax.scan op (ops/rnn.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ... import autograd
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...ndarray.ndarray import invoke
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size=0, gates=1, dtype="float32"):
+        super().__init__()
+        assert layout in ("TNC", "NTC"), "layout must be TNC or NTC"
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = gates
+        ng = gates * hidden_size
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                prefix = f"{'r' if d else 'l'}{layer}"
+                in_sz = input_size if layer == 0 \
+                    else hidden_size * self._dir
+                i2h_shape = (ng, in_sz) if in_sz else None
+                setattr(self, f"{prefix}_i2h_weight", Parameter(
+                    f"{prefix}_i2h_weight", shape=i2h_shape, dtype=dtype,
+                    allow_deferred_init=True))
+                setattr(self, f"{prefix}_h2h_weight", Parameter(
+                    f"{prefix}_h2h_weight", shape=(ng, hidden_size),
+                    dtype=dtype))
+                setattr(self, f"{prefix}_i2h_bias", Parameter(
+                    f"{prefix}_i2h_bias", shape=(ng,), dtype=dtype))
+                setattr(self, f"{prefix}_h2h_bias", Parameter(
+                    f"{prefix}_h2h_bias", shape=(ng,), dtype=dtype))
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent state (reference rnn_layer.py begin_state)."""
+        from ...ndarray import zeros
+
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(zeros(info["shape"], **kwargs))
+        return states
+
+    def infer_shape(self, x, *args):
+        in_sz = int(x.shape[2])  # feature axis is last in both layouts
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                prefix = f"{'r' if d else 'l'}{layer}"
+                p = getattr(self, f"{prefix}_i2h_weight")
+                if p.shape is None or any(s == 0 for s in p.shape):
+                    sz = in_sz if layer == 0 else self._hidden_size * self._dir
+                    p.shape = (self._gates * self._hidden_size, sz)
+
+    def _collect_weight_arrays(self, ctx):
+        arrays = []
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                prefix = f"{'r' if d else 'l'}{layer}"
+                for nm in ("i2h_weight", "h2h_weight", "i2h_bias",
+                           "h2h_bias"):
+                    arrays.append(getattr(self, f"{prefix}_{nm}").data(ctx))
+        return arrays
+
+    def forward(self, x, states=None):
+        if self._layout == "NTC":
+            x = x.swapaxes(0, 1)
+        T, B, _ = x.shape
+        return_states = states is not None
+        if states is None:
+            states = self.begin_state(B, ctx=x.ctx, dtype=x.dtype)
+        elif isinstance(states, NDArray):
+            states = [states]
+        arrays = [x] + list(states) + self._collect_weight_arrays(x.ctx)
+        dropout = self._dropout if autograd.is_training() else 0.0
+        out = invoke("_rnn_fused", arrays, {
+            "mode": self._mode, "hidden_size": self._hidden_size,
+            "num_layers": self._num_layers,
+            "bidirectional": self._dir == 2, "dropout": dropout})
+        y, new_states = out[0], list(out[1:])
+        if self._layout == "NTC":
+            y = y.swapaxes(0, 1)
+        if return_states:
+            return y, new_states
+        return y
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"num_layers={self._num_layers}, layout={self._layout}, "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    """Elman RNN with tanh or relu (reference rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="tanh",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 dtype="float32", **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(mode, hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, gates=1, dtype=dtype)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference rnn_layer.py LSTM; gate order i f g o
+    matches cuDNN so reference checkpoints convert directly)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, dtype="float32",
+                 **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, gates=4, dtype=dtype)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (reference rnn_layer.py GRU; gate order r z n)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, dtype="float32",
+                 **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, gates=3, dtype=dtype)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,455 @@
+"""``mx.image`` — image decode / resize / augmentation.
+
+Reference analog: ``python/mxnet/image/image.py`` (+ C++ augmenters
+``src/io/image_aug_default.cc``).  Decode and geometric ops run on host via
+OpenCV exactly like the reference; arrays are HWC NDArrays so augmenter
+pipelines are drop-in compatible.  ``CreateAugmenter`` mirrors the reference
+factory.
+"""
+from __future__ import annotations
+
+import random as pyrandom
+from typing import List, Optional, Tuple
+
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = [
+    "imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+    "center_crop", "random_crop", "random_size_crop", "color_normalize",
+    "Augmenter", "SequentialAug", "ResizeAug", "ForceResizeAug",
+    "RandomCropAug", "CenterCropAug", "RandomSizedCropAug",
+    "HorizontalFlipAug", "CastAug", "BrightnessJitterAug",
+    "ContrastJitterAug", "SaturationJitterAug", "ColorJitterAug",
+    "LightingAug", "ColorNormalizeAug", "RandomGrayAug", "CreateAugmenter",
+    "ImageIter",
+]
+
+
+def _cv2():
+    import cv2
+
+    return cv2
+
+
+def _as_host(img):
+    return img.asnumpy() if isinstance(img, NDArray) else onp.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs) -> NDArray:
+    """Decode an encoded image buffer to HWC NDArray (reference
+    image.py imdecode → cv::imdecode)."""
+    img = _cv2().imdecode(onp.frombuffer(bytes(buf), onp.uint8), flag)
+    if img is None:
+        raise MXNetError("imdecode failed: invalid image data")
+    if to_rgb and img.ndim == 3:
+        img = img[:, :, ::-1]
+    return array(onp.ascontiguousarray(img))
+
+
+def imread(filename, flag=1, to_rgb=True) -> NDArray:
+    img = _cv2().imread(filename, flag)
+    if img is None:
+        raise MXNetError(f"imread failed: {filename}")
+    if to_rgb and img.ndim == 3:
+        img = img[:, :, ::-1]
+    return array(onp.ascontiguousarray(img))
+
+
+def imresize(src, w, h, interp=1) -> NDArray:
+    out = _cv2().resize(_as_host(src), (w, h), interpolation=interp)
+    return array(out)
+
+
+def resize_short(src, size, interp=2) -> NDArray:
+    """Resize shorter edge to ``size`` (reference image.py resize_short)."""
+    img = _as_host(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(img, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2) -> NDArray:
+    img = _as_host(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        img = _cv2().resize(img, size, interpolation=interp)
+    return array(img)
+
+
+def random_crop(src, size, interp=2):
+    img = _as_host(src)
+    h, w = img.shape[:2]
+    new_w, new_h = size
+    x0 = pyrandom.randint(0, max(0, w - new_w))
+    y0 = pyrandom.randint(0, max(0, h - new_h))
+    out = fixed_crop(img, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    img = _as_host(src)
+    h, w = img.shape[:2]
+    new_w, new_h = size
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    out = fixed_crop(img, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random crop by area fraction + aspect ratio (reference
+    random_size_crop)."""
+    img = _as_host(src)
+    h, w = img.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(*area) * src_area
+        log_ratio = (onp.log(ratio[0]), onp.log(ratio[1]))
+        ar = onp.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round((target_area * ar) ** 0.5))
+        new_h = int(round((target_area / ar) ** 0.5))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            return fixed_crop(img, x0, y0, new_w, new_h, size, interp), \
+                (x0, y0, new_w, new_h)
+    return center_crop(img, size, interp)
+
+
+def color_normalize(src, mean, std=None) -> NDArray:
+    img = _as_host(src).astype(onp.float32)
+    img = img - _as_host(mean)
+    if std is not None:
+        img = img / _as_host(std)
+    return array(img)
+
+
+# ---------------------------------------------------------------------------
+# augmenters (reference image.py Augmenter classes)
+# ---------------------------------------------------------------------------
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = (size, size) if isinstance(size, int) else size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = (size, size) if isinstance(size, int) else size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = (size, size) if isinstance(size, int) else size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return array(onp.ascontiguousarray(_as_host(src)[:, ::-1]))
+        return src if isinstance(src, NDArray) else array(src)
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return array(_as_host(src).astype(self.typ))
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return array(_as_host(src).astype(onp.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = onp.array([0.299, 0.587, 0.114], onp.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        img = _as_host(src).astype(onp.float32)
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (img * self._coef).sum(axis=2).mean()
+        return array(img * alpha + gray * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = onp.array([0.299, 0.587, 0.114], onp.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        img = _as_host(src).astype(onp.float32)
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (img * self._coef).sum(axis=2, keepdims=True)
+        return array(img * alpha + gray * (1.0 - alpha))
+
+
+class ColorJitterAug(SequentialAug):
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        ts = []
+        if brightness:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation:
+            ts.append(SaturationJitterAug(saturation))
+        pyrandom.shuffle(ts)
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, onp.float32)
+        self.eigvec = onp.asarray(eigvec, onp.float32)
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, 3).astype(onp.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return array(_as_host(src).astype(onp.float32) + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = onp.asarray(mean, onp.float32)
+        self.std = onp.asarray(std, onp.float32) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _mat = onp.array([[0.21, 0.21, 0.21],
+                      [0.72, 0.72, 0.72],
+                      [0.07, 0.07, 0.07]], onp.float32)
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return array(_as_host(src).astype(onp.float32) @ self._mat)
+        return src if isinstance(src, NDArray) else array(src)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Build the standard augmenter list (reference image.py
+    CreateAugmenter)."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4, 4 / 3), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None and onp.asarray(mean).any():
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Augmenting image iterator over .rec or an imglist (reference
+    image.py ImageIter — the python-side counterpart of ImageRecordIter)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, label_width=1, **kwargs):
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **kwargs)
+        self._records = None
+        self.imglist = None
+        if path_imgrec:
+            from .recordio import MXIndexedRecordIO, MXRecordIO
+
+            idx = path_imgrec.rsplit(".", 1)[0] + ".idx"
+            import os
+
+            if os.path.exists(idx):
+                self._records = MXIndexedRecordIO(idx, path_imgrec, "r")
+                self._keys = list(self._records.keys)
+            else:
+                raise MXNetError("ImageIter needs an .idx next to the .rec")
+        elif imglist is not None or path_imglist:
+            if path_imglist:
+                entries = []
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        entries.append((float(parts[1]),
+                                        parts[-1]))
+                self.imglist = entries
+            else:
+                self.imglist = [(float(e[0]), e[1]) for e in imglist]
+            self.path_root = path_root
+            self._keys = list(range(len(self.imglist)))
+        else:
+            raise ValueError("need path_imgrec, path_imglist or imglist")
+        self.shuffle = shuffle
+        self.reset()
+
+    def reset(self):
+        self._order = list(range(len(self._keys)))
+        if self.shuffle:
+            pyrandom.shuffle(self._order)
+        self.cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def next_sample(self):
+        if self.cursor >= len(self._order):
+            raise StopIteration
+        i = self._order[self.cursor]
+        self.cursor += 1
+        if self._records is not None:
+            from .recordio import unpack
+
+            header, img_bytes = unpack(
+                self._records.read_idx(self._keys[i]))
+            return header.label, img_bytes
+        label, fname = self.imglist[i]
+        import os
+
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            return label, f.read()
+
+    def __next__(self):
+        c, h, w = self.data_shape
+        batch_data = onp.zeros((self.batch_size, h, w, c), onp.float32)
+        batch_label = onp.zeros((self.batch_size,), onp.float32)
+        i = 0
+        while i < self.batch_size:
+            label, buf = self.next_sample()
+            img = imdecode(buf)
+            for aug in self.auglist:
+                img = aug(img)
+            arr = _as_host(img)
+            if arr.shape[:2] != (h, w):
+                arr = _cv2().resize(arr, (w, h))
+            batch_data[i] = arr
+            batch_label[i] = onp.float32(
+                label if onp.isscalar(label) else onp.asarray(label).flat[0])
+            i += 1
+        from .io import DataBatch
+
+        nchw = onp.transpose(batch_data, (0, 3, 1, 2))
+        return DataBatch([array(nchw)], [array(batch_label)])
+
+    next = __next__

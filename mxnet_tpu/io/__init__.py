@@ -157,7 +157,7 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         if self.last_batch_handle == "roll_over" and \
-                self._limit < self.num_data:
+                self._limit < len(self._order):
             self._rollover = self._order[self._limit:].copy()
         self.cursor = -self.batch_size
         order = onp.arange(self.num_data)
@@ -166,10 +166,11 @@ class NDArrayIter(DataIter):
         if self._rollover is not None:
             order = onp.concatenate([self._rollover, order])
             self._rollover = None
-            self._limit = (len(order) // self.batch_size) * self.batch_size \
-                if self.last_batch_handle in ("discard", "roll_over") \
-                else len(order)
         self._order = order
+        if self.last_batch_handle in ("discard", "roll_over"):
+            self._limit = (len(order) // self.batch_size) * self.batch_size
+        else:
+            self._limit = len(order)
 
     def iter_next(self):
         self.cursor += self.batch_size
@@ -503,19 +504,25 @@ class PrefetchingIter(DataIter):
         return self.iter.provide_label
 
     def _start(self):
-        self._stop.clear()
+        # each generation gets its OWN stop event + queue: if a slow old
+        # worker outlives the join timeout in reset(), it still sees its own
+        # (set) stop event and writes only to its orphaned queue
+        stop = threading.Event()
+        q: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        self._stop = stop
+        self._queue = q
 
         def worker():
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     batch = self.iter.next()
                 except StopIteration:
-                    self._queue.put(None)
+                    q.put(None)
                     return
                 except Exception as e:  # surface at next() like engine
-                    self._queue.put(e)
+                    q.put(e)
                     return
-                self._queue.put(batch)
+                q.put(batch)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -530,7 +537,6 @@ class PrefetchingIter(DataIter):
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.iter.reset()
-        self._queue = queue.Queue(maxsize=self._depth)
         self._done = False
         self._start()
 

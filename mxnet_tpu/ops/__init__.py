@@ -18,5 +18,6 @@ from . import optimizer as _optimizer  # noqa: F401
 from . import linalg as _linalg  # noqa: F401
 from . import contrib as _contrib  # noqa: F401
 from . import control_flow as _control_flow  # noqa: F401
+from . import rnn as _rnn  # noqa: F401
 
 __all__ = ["OpSchema", "register", "get_op", "find_op", "list_ops"]

@@ -1,0 +1,185 @@
+"""mx.image + gluon.rnn tests (reference tests/python/unittest/test_image.py,
+test_gluon_rnn.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import rnn
+
+
+# ---------------------------------------------------------------- image ----
+def _rand_img(h=40, w=36):
+    return (onp.random.RandomState(0).rand(h, w, 3) * 255).astype(onp.uint8)
+
+
+def test_imdecode_imresize():
+    import cv2
+
+    raw = _rand_img()
+    ok, buf = cv2.imencode(".png", raw)
+    decoded = img.imdecode(buf.tobytes())
+    onp.testing.assert_array_equal(decoded.asnumpy(), raw[:, :, ::-1])
+    resized = img.imresize(decoded, 18, 20)
+    assert resized.shape == (20, 18, 3)
+    short = img.resize_short(decoded, 18)
+    assert min(short.shape[:2]) == 18
+
+
+def test_crops_and_normalize():
+    raw = _rand_img()
+    c, _ = img.center_crop(raw, (20, 24))
+    assert c.shape == (24, 20, 3)
+    r, roi = img.random_crop(raw, (16, 16))
+    assert r.shape == (16, 16, 3)
+    rs, _ = img.random_size_crop(raw, (16, 16), (0.5, 1.0), (0.75, 1.33))
+    assert rs.shape == (16, 16, 3)
+    norm = img.color_normalize(raw.astype(onp.float32),
+                               onp.array([1.0, 2.0, 3.0]))
+    onp.testing.assert_allclose(norm.asnumpy(),
+                                raw.astype(onp.float32) - [1, 2, 3])
+
+
+def test_create_augmenter_pipeline():
+    augs = img.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                               rand_mirror=True, brightness=0.1,
+                               mean=True, std=True)
+    out = _rand_img()
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+    assert out.dtype == onp.float32
+
+
+def test_image_iter(tmp_path):
+    from mxnet_tpu import recordio
+
+    rec_p = str(tmp_path / "i.rec")
+    idx_p = str(tmp_path / "i.idx")
+    w = recordio.MXIndexedRecordIO(idx_p, rec_p, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(12):
+        im = (rng.rand(36, 36, 3) * 255).astype(onp.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), im, img_fmt=".png"))
+    w.close()
+    it = img.ImageIter(4, (3, 32, 32), path_imgrec=rec_p, shuffle=True)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4,)
+    n = 1 + sum(1 for _ in it)
+    assert n == 3
+
+
+# ------------------------------------------------------------------ rnn ----
+@pytest.mark.parametrize("cls,nstate", [(rnn.LSTM, 2), (rnn.GRU, 1),
+                                        (rnn.RNN, 1)])
+def test_fused_layers_shapes(cls, nstate):
+    layer = cls(16, num_layers=2)
+    layer.initialize()
+    x = nd.random.uniform(shape=(5, 3, 8))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(3)
+    assert len(states) == nstate
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert len(new_states) == nstate
+    assert new_states[0].shape == (2, 3, 16)
+
+
+def test_lstm_bidirectional_ntc():
+    layer = rnn.LSTM(8, num_layers=1, bidirectional=True, layout="NTC")
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 7, 4))
+    out = layer(x)
+    assert out.shape == (2, 7, 16)  # 2*hidden for bidir
+
+
+def test_lstm_gradient_flows():
+    layer = rnn.LSTM(8)
+    layer.initialize()
+    x = nd.random.uniform(shape=(6, 2, 4))
+    with mx.autograd.record():
+        loss = (layer(x) ** 2).mean()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad(mx.cpu())
+    assert float(g.abs().sum().asscalar()) > 0
+
+
+def test_lstm_vs_manual_unroll():
+    """Fused lax.scan layer must match the per-step cell math."""
+    layer = rnn.LSTM(5, input_size=3)
+    layer.initialize()
+    T, B = 4, 2
+    x = nd.random.uniform(shape=(T, B, 3))
+    fused = layer(x).asnumpy()
+
+    w_ih = layer.l0_i2h_weight.data().asnumpy()
+    w_hh = layer.l0_h2h_weight.data().asnumpy()
+    b_ih = layer.l0_i2h_bias.data().asnumpy()
+    b_hh = layer.l0_h2h_bias.data().asnumpy()
+    h = onp.zeros((B, 5), onp.float32)
+    c = onp.zeros((B, 5), onp.float32)
+    xs = x.asnumpy()
+    outs = []
+
+    def sig(v):
+        return 1 / (1 + onp.exp(-v))
+
+    for t in range(T):
+        gates = xs[t] @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = onp.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * onp.tanh(g)
+        h = sig(o) * onp.tanh(c)
+        outs.append(h)
+    onp.testing.assert_allclose(fused, onp.stack(outs), rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_layer_hybridize():
+    layer = rnn.GRU(8, num_layers=2)
+    layer.initialize()
+    x = nd.random.uniform(shape=(5, 3, 4))
+    ref = layer(x).asnumpy()
+    layer.hybridize()
+    out = layer(x).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_cells_and_unroll():
+    cell = rnn.LSTMCell(8)
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 10, 4))  # NTC
+    outputs, states = cell.unroll(10, x, layout="NTC")
+    assert outputs.shape == (2, 10, 8)
+    assert len(states) == 2
+    # stacked cells
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.GRUCell(8))
+    stack.add(rnn.ResidualCell(rnn.GRUCell(8)))
+    stack.initialize()
+    out, st = stack.unroll(10, x, layout="NTC")
+    assert out.shape == (2, 10, 8)
+    # bidirectional
+    bi = rnn.BidirectionalCell(rnn.GRUCell(4), rnn.GRUCell(4))
+    bi.initialize()
+    out, st = bi.unroll(10, x, layout="NTC")
+    assert out.shape == (2, 10, 8)
+
+
+def test_cell_step_matches_layer():
+    """One LSTMCell step == one step of the fused layer with same weights."""
+    cell = rnn.LSTMCell(6, input_size=3)
+    cell.initialize()
+    layer = rnn.LSTM(6, input_size=3)
+    layer.initialize()
+    for nm in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+        getattr(layer, f"l0_{nm}")._load_init(
+            getattr(cell, nm).data().asnumpy(), None)
+    x = nd.random.uniform(shape=(1, 2, 3))
+    h0 = [nd.zeros((2, 6)), nd.zeros((2, 6))]
+    cell_out, _ = cell(x[0], h0)
+    layer_out = layer(x)
+    onp.testing.assert_allclose(cell_out.asnumpy(), layer_out.asnumpy()[0],
+                                rtol=1e-5, atol=1e-6)

@@ -26,6 +26,14 @@ def _dropout(x, rate):
     key_nd = _wrap(_random.next_key(), x.ctx)
     return invoke("Dropout", [x, key_nd], {"p": rate, "training": True})
 
+
+def _expand_mask(alive, like):
+    """(B,) bool/float mask -> broadcastable against ``like`` (B, ...)."""
+    m = alive
+    while m.ndim < like.ndim:
+        m = m.expand_dims(-1)
+    return m.broadcast_to(like.shape)
+
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
            "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
            "BidirectionalCell", "HybridSequentialRNNCell"]
@@ -53,10 +61,11 @@ class RecurrentCell(HybridBlock):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         """Unroll the cell for ``length`` steps (reference rnn_cell.py
-        unroll)."""
-        from ...ndarray import stack as nd_stack
+        unroll).  With ``valid_length``, outputs past a sample's length are
+        zeroed and its states freeze at the last valid step."""
         from ...ops.registry import get_op
 
+        self.reset()
         axis = layout.find("T")
         if isinstance(inputs, NDArray):
             inputs = [
@@ -70,17 +79,16 @@ class RecurrentCell(HybridBlock):
         states = begin_state
         outputs = []
         for t in range(length):
-            out, states = self(inputs[t], states)
+            out, new_states = self(inputs[t], states)
+            if valid_length is not None:
+                alive = valid_length > t  # (B,)
+                out = invoke("where", [_expand_mask(alive, out), out,
+                                       out * 0], {})
+                new_states = [
+                    invoke("where", [_expand_mask(alive, ns), ns, old], {})
+                    for ns, old in zip(new_states, states)]
+            states = new_states
             outputs.append(out)
-        if valid_length is not None:
-            outputs = [
-                invoke("where", [
-                    (valid_length > t).broadcast_like(outputs[t]),
-                    outputs[t],
-                    outputs[t] * 0,
-                ], {})
-                for t in range(length)
-            ]
         if merge_outputs or merge_outputs is None:
             merged = invoke(get_op("stack"), outputs, {"axis": axis})
             return merged, states
@@ -220,6 +228,10 @@ class SequentialRNNCell(RecurrentCell):
         self._cells.append(cell)
         self.register_child(cell, str(len(self._cells) - 1))
 
+    def reset(self):
+        for c in self._cells:
+            c.reset()
+
     def __len__(self):
         return len(self._cells)
 
@@ -247,6 +259,9 @@ class _ModifierCell(RecurrentCell):
     def __init__(self, base_cell: RecurrentCell):
         super().__init__()
         self.base_cell = base_cell
+
+    def reset(self):
+        self.base_cell.reset()
 
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
@@ -288,6 +303,7 @@ class ZoneoutCell(_ModifierCell):
         self._prev_output = None
 
     def reset(self):
+        self.base_cell.reset()
         self._prev_output = None
 
     def forward(self, x, states):
@@ -335,6 +351,7 @@ class BidirectionalCell(RecurrentCell):
                merge_outputs=None, valid_length=None):
         from ...ops.registry import get_op
 
+        self.reset()
         axis = layout.find("T")
         if isinstance(inputs, NDArray):
             inputs = [x.squeeze(axis=axis)
@@ -347,9 +364,28 @@ class BidirectionalCell(RecurrentCell):
         else:
             l_states = begin_state[:n_l]
             r_states = begin_state[n_l:]
-        l_outs, l_states = _unroll_steps(self.l_cell, inputs, l_states)
-        r_outs, r_states = _unroll_steps(self.r_cell, inputs[::-1], r_states)
-        r_outs = r_outs[::-1]
+        if valid_length is not None:
+            # per-sample reverse so padding never leads the reverse scan
+            # (reference uses sequence_reverse with use_sequence_length)
+            seq = invoke(get_op("stack"), inputs, {"axis": 0})
+            rev = invoke("sequence_reverse", [seq, valid_length],
+                         {"use_sequence_length": True})
+            rev_inputs = [r.squeeze(axis=0)
+                          for r in rev.split(num_outputs=length, axis=0)]
+        else:
+            rev_inputs = inputs[::-1]
+        l_outs, l_states = _unroll_steps(self.l_cell, inputs, l_states,
+                                         valid_length)
+        r_outs, r_states = _unroll_steps(self.r_cell, rev_inputs, r_states,
+                                         valid_length)
+        if valid_length is not None:
+            rseq = invoke(get_op("stack"), r_outs, {"axis": 0})
+            runrev = invoke("sequence_reverse", [rseq, valid_length],
+                            {"use_sequence_length": True})
+            r_outs = [r.squeeze(axis=0)
+                      for r in runrev.split(num_outputs=length, axis=0)]
+        else:
+            r_outs = r_outs[::-1]
         outs = [invoke("concat", [lo, ro], {"dim": -1})
                 for lo, ro in zip(l_outs, r_outs)]
         if merge_outputs or merge_outputs is None:
@@ -358,9 +394,16 @@ class BidirectionalCell(RecurrentCell):
         return outs, l_states + r_states
 
 
-def _unroll_steps(cell, inputs, states):
+def _unroll_steps(cell, inputs, states, valid_length=None):
     outs = []
-    for x in inputs:
-        o, states = cell(x, states)
+    for t, x in enumerate(inputs):
+        o, new_states = cell(x, states)
+        if valid_length is not None:
+            alive = valid_length > t
+            o = invoke("where", [_expand_mask(alive, o), o, o * 0], {})
+            new_states = [
+                invoke("where", [_expand_mask(alive, ns), ns, old], {})
+                for ns, old in zip(new_states, states)]
+        states = new_states
         outs.append(o)
     return outs, states

@@ -7,14 +7,10 @@ goes through the ``_rnn_fused`` lax.scan op (ops/rnn.py).
 """
 from __future__ import annotations
 
-from typing import List, Optional
-
-import jax.numpy as jnp
-
 from ... import autograd
-from ...base import MXNetError
+from ... import random as _random
 from ...ndarray import NDArray
-from ...ndarray.ndarray import invoke
+from ...ndarray.ndarray import _wrap, invoke
 from ..block import HybridBlock
 from ..parameter import Parameter
 
@@ -95,6 +91,8 @@ class _RNNLayer(HybridBlock):
             states = [states]
         arrays = [x] + list(states) + self._collect_weight_arrays(x.ctx)
         dropout = self._dropout if autograd.is_training() else 0.0
+        if dropout > 0.0:
+            arrays.append(_wrap(_random.next_key(), x.ctx))
         out = invoke("_rnn_fused", arrays, {
             "mode": self._mode, "hidden_size": self._hidden_size,
             "num_layers": self._num_layers,

@@ -371,6 +371,7 @@ class ImageIter:
                  aug_list=None, imglist=None, label_width=1, **kwargs):
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
+        self.label_width = label_width
         self.auglist = aug_list if aug_list is not None else \
             CreateAugmenter(data_shape, **kwargs)
         self._records = None
@@ -392,11 +393,19 @@ class ImageIter:
                 with open(path_imglist) as f:
                     for line in f:
                         parts = line.strip().split("\t")
-                        entries.append((float(parts[1]),
-                                        parts[-1]))
+                        labels = onp.array(
+                            [float(p) for p in
+                             parts[1:1 + label_width]], onp.float32)
+                        entries.append((
+                            labels[0] if label_width == 1 else labels,
+                            parts[-1]))
                 self.imglist = entries
             else:
-                self.imglist = [(float(e[0]), e[1]) for e in imglist]
+                self.imglist = [
+                    (onp.asarray(e[0], onp.float32)
+                     if label_width > 1 else float(
+                         onp.asarray(e[0]).flat[0]), e[1])
+                    for e in imglist]
             self.path_root = path_root
             self._keys = list(range(len(self.imglist)))
         else:
@@ -433,7 +442,9 @@ class ImageIter:
     def __next__(self):
         c, h, w = self.data_shape
         batch_data = onp.zeros((self.batch_size, h, w, c), onp.float32)
-        batch_label = onp.zeros((self.batch_size,), onp.float32)
+        lshape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        batch_label = onp.zeros(lshape, onp.float32)
         i = 0
         while i < self.batch_size:
             label, buf = self.next_sample()
@@ -444,8 +455,11 @@ class ImageIter:
             if arr.shape[:2] != (h, w):
                 arr = _cv2().resize(arr, (w, h))
             batch_data[i] = arr
-            batch_label[i] = onp.float32(
-                label if onp.isscalar(label) else onp.asarray(label).flat[0])
+            lab = onp.asarray(label, onp.float32)
+            if self.label_width == 1:
+                batch_label[i] = lab.flat[0]
+            else:
+                batch_label[i] = lab.flat[:self.label_width]
             i += 1
         from .io import DataBatch
 

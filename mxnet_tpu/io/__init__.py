@@ -528,14 +528,16 @@ class PrefetchingIter(DataIter):
         self._thread.start()
 
     def reset(self):
+        # drain-while-joining until the worker is REALLY dead: resetting or
+        # restarting while it is still inside self.iter.next() would race on
+        # the (non-thread-safe) inner iterator
         self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        while self._thread is not None and self._thread.is_alive():
+            try:
+                self._queue.get(timeout=0.05)
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
         self.iter.reset()
         self._done = False
         self._start()

@@ -80,7 +80,9 @@ def _layer_scan(mode, x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse=False):
 def rnn_fused(arrays, mode="lstm", hidden_size=0, num_layers=1,
               bidirectional=False, dropout=0.0, has_cell_state=None):
     """arrays = [data(T,B,I), h0(L*D,B,H), (c0 if lstm),
-    then per (layer, direction): w_ih, w_hh, b_ih, b_hh].
+    then per (layer, direction): w_ih, w_hh, b_ih, b_hh,
+    (dropout PRNG key last, iff dropout > 0 — explicit so the op stays pure
+    under whole-graph jit, same contract as ops/nn.py Dropout)].
 
     Returns (output(T,B,H*D), hT(L*D,B,H)[, cT]) — the fused op contract of
     the reference RNN op (rnn-inl.h state_outputs=True shape semantics).
@@ -94,7 +96,10 @@ def rnn_fused(arrays, mode="lstm", hidden_size=0, num_layers=1,
     if is_lstm:
         c0 = arrays[2]
         idx = 3
-    weights = arrays[idx:]
+    weights = list(arrays[idx:])
+    key = None
+    if dropout > 0.0:
+        key = weights.pop()
     assert len(weights) == 4 * num_layers * ndir, (
         f"expected {4 * num_layers * ndir} weight arrays, got {len(weights)}")
 
@@ -114,10 +119,8 @@ def rnn_fused(arrays, mode="lstm", hidden_size=0, num_layers=1,
                 c_outs.append(cT)
         x = ys_dirs[0] if ndir == 1 else jnp.concatenate(ys_dirs, axis=-1)
         if dropout > 0.0 and layer < num_layers - 1:
-            from .. import random as _random
-
-            key = _random.next_key()
-            keep = jax.random.bernoulli(key, 1.0 - dropout, x.shape)
+            layer_key = jax.random.fold_in(key, layer)
+            keep = jax.random.bernoulli(layer_key, 1.0 - dropout, x.shape)
             x = jnp.where(keep, x / (1.0 - dropout), 0.0)
 
     hT = jnp.stack(h_outs)

@@ -80,9 +80,12 @@ def squeeze(data, axis=None):
 
 @register("broadcast_to")
 def broadcast_to(data, shape=None):
-    shape = tuple(
-        s if s != 0 else d for s, d in zip(shape, data.shape)
-    )
+    shape = tuple(shape)
+    if 0 in shape:  # 0 = keep the matching input dim, right-aligned
+        offset = len(shape) - data.ndim
+        shape = tuple(
+            s if s != 0 else data.shape[i - offset]
+            for i, s in enumerate(shape))
     return jnp.broadcast_to(data, shape)
 
 

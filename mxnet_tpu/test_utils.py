@@ -1,0 +1,314 @@
+"""Testing utilities (reference ``python/mxnet/test_utils.py``, 2,602 LoC).
+
+The load-bearing pieces reproduced per SURVEY.md §4: ``default_context`` so
+one test file runs on any device, dtype-aware ``assert_almost_equal``,
+``rand_ndarray``, finite-difference ``check_numeric_gradient`` against the
+autograd tape, and ``check_symbolic_forward/backward`` as the
+symbolic-vs-reference oracle.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as onp
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+
+__all__ = [
+    "default_context", "set_default_context", "default_dtype",
+    "assert_almost_equal", "almost_equal", "same", "rand_ndarray",
+    "rand_shape_2d", "rand_shape_3d", "rand_shape_nd", "random_arrays",
+    "check_numeric_gradient", "check_symbolic_forward",
+    "check_symbolic_backward", "numeric_grad", "environment",
+    "default_rtols", "default_atols", "effective_dtype",
+]
+
+_DEFAULT_CTX: Optional[Context] = None
+
+# dtype-aware default tolerances (reference test_utils.py:650 rtol/atol maps)
+_RTOLS = {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-4,
+          onp.dtype(onp.float64): 1e-7, onp.dtype(onp.int32): 0,
+          onp.dtype(onp.int64): 0, onp.dtype(onp.bool_): 0}
+_ATOLS = {onp.dtype(onp.float16): 1e-3, onp.dtype(onp.float32): 1e-5,
+          onp.dtype(onp.float64): 1e-9, onp.dtype(onp.int32): 0,
+          onp.dtype(onp.int64): 0, onp.dtype(onp.bool_): 0}
+
+
+def default_rtols():
+    return dict(_RTOLS)
+
+
+def default_atols():
+    return dict(_ATOLS)
+
+
+def default_context() -> Context:
+    """The context tests run on; switch with set_default_context or the
+    MXNET_TEST_DEVICE env var (reference default_context():57)."""
+    global _DEFAULT_CTX
+    if _DEFAULT_CTX is not None:
+        return _DEFAULT_CTX
+    dev = os.environ.get("MXNET_TEST_DEVICE")
+    if dev:
+        from . import context as ctx_mod
+
+        kind, _, idx = dev.partition(":")
+        return getattr(ctx_mod, kind)(int(idx or 0))
+    return current_context()
+
+
+def set_default_context(ctx: Context):
+    global _DEFAULT_CTX
+    _DEFAULT_CTX = ctx
+
+
+def default_dtype():
+    return onp.float32
+
+
+def effective_dtype(a):
+    if isinstance(a, NDArray):
+        return onp.dtype("float16") if str(a.dtype) == "bfloat16" \
+            else onp.dtype(a.dtype)
+    return onp.asarray(a).dtype
+
+
+def _host(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return onp.asarray(a)
+
+
+def same(a, b) -> bool:
+    return onp.array_equal(_host(a), _host(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False) -> bool:
+    a_h, b_h = _host(a), _host(b)
+    dt = max(effective_dtype(a), effective_dtype(b),
+             key=lambda d: _RTOLS.get(d, 1e-4))
+    rtol = _RTOLS.get(dt, 1e-4) if rtol is None else rtol
+    atol = _ATOLS.get(dt, 1e-5) if atol is None else atol
+    return onp.allclose(a_h, b_h, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False, use_broadcast=True):
+    """Dtype-aware closeness assertion (reference
+    test_utils.py:650 assert_almost_equal)."""
+    a_h, b_h = _host(a), _host(b)
+    dt = max(effective_dtype(a), effective_dtype(b),
+             key=lambda d: _RTOLS.get(d, 1e-4))
+    rtol = _RTOLS.get(dt, 1e-4) if rtol is None else rtol
+    atol = _ATOLS.get(dt, 1e-5) if atol is None else atol
+    if not onp.allclose(a_h, b_h, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        diff = onp.abs(a_h - b_h)
+        rel = diff / (onp.abs(b_h) + atol)
+        idx = onp.unravel_index(onp.argmax(rel), rel.shape) if rel.size \
+            else ()
+        raise AssertionError(
+            f"Items are not equal (rtol={rtol}, atol={atol}):\n"
+            f" max abs diff {diff.max() if diff.size else 0} "
+            f"max rel diff {rel.max() if rel.size else 0} at {idx}\n"
+            f" {names[0]}: {a_h.flat[:8]}...\n {names[1]}: {b_h.flat[:8]}...")
+
+
+def rand_shape_2d(dim0=10, dim1=10, allow_zero_size=False):
+    low = 0 if allow_zero_size else 1
+    return (onp.random.randint(low, dim0 + 1),
+            onp.random.randint(low, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10, allow_zero_size=False):
+    low = 0 if allow_zero_size else 1
+    return (onp.random.randint(low, dim0 + 1),
+            onp.random.randint(low, dim1 + 1),
+            onp.random.randint(low, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10, allow_zero_size=False):
+    low = 0 if allow_zero_size else 1
+    return tuple(onp.random.randint(low, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None, scale=1.0, distribution="uniform") -> NDArray:
+    """Random NDArray (reference rand_ndarray:479; sparse stypes fall back
+    to dense with zeros at the requested density)."""
+    dtype = dtype or onp.float32
+    if distribution == "normal":
+        data = onp.random.normal(scale=scale, size=shape)
+    else:
+        data = onp.random.uniform(-scale, scale, size=shape)
+    if stype in ("row_sparse", "csr"):
+        density = 0.1 if density is None else density
+        mask = onp.random.uniform(size=shape) < density
+        data = data * mask
+    return array(data.astype(dtype), ctx=ctx or default_context())
+
+
+def random_arrays(*shapes):
+    arrays = [onp.random.randn(*s).astype(onp.float32) if s else
+              onp.float32(onp.random.randn()) for s in shapes]
+    return arrays[0] if len(arrays) == 1 else arrays
+
+
+def numeric_grad(f, location: Dict[str, onp.ndarray], eps=1e-4):
+    """Central finite differences of scalar-valued f (reference
+    numeric_grad inside check_numeric_gradient)."""
+    grads = {}
+    for name, arr in location.items():
+        arr = arr.astype(onp.float64)
+        g = onp.zeros_like(arr)
+        flat = arr.reshape(-1)
+        gflat = g.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            fp = f({k: (arr if k == name else v)
+                    for k, v in location.items()})
+            flat[i] = orig - eps
+            fm = f({k: (arr if k == name else v)
+                    for k, v in location.items()})
+            flat[i] = orig
+            gflat[i] = (fp - fm) / (2 * eps)
+        grads[name] = g
+    return grads
+
+
+def check_numeric_gradient(op_name_or_fn, location, aux_states=None,
+                           numeric_eps=1e-2, rtol=1e-2, atol=1e-3,
+                           grad_nodes=None, ctx=None, attrs=None):
+    """Verify autograd gradients against finite differences (reference
+    check_numeric_gradient:1038).
+
+    ``op_name_or_fn``: registry op name, or fn(*NDArrays) -> NDArray.
+    ``location``: list of numpy arrays or dict name->array.
+    ``numeric_eps`` defaults to 1e-2 (not the reference's 1e-4): forward
+    evals run in float32 on device, so smaller eps is roundoff-dominated.
+    """
+    from . import autograd
+    from .ndarray.ndarray import invoke
+
+    ctx = ctx or default_context()
+    if isinstance(location, dict):
+        names = list(location)
+        arrays = [onp.asarray(location[n], onp.float64) for n in names]
+    else:
+        names = [f"arg_{i}" for i in range(len(location))]
+        arrays = [onp.asarray(a, onp.float64) for a in location]
+    grad_nodes = grad_nodes or names
+
+    if isinstance(op_name_or_fn, str):
+        def fn(*nds):
+            return invoke(op_name_or_fn, list(nds), dict(attrs or {}))
+    else:
+        fn = op_name_or_fn
+
+    # analytic grads via the tape (sum(output) as the scalar head)
+    nds = [array(a.astype(onp.float32), ctx=ctx) for a in arrays]
+    for nd_arr, n in zip(nds, names):
+        if n in grad_nodes:
+            nd_arr.attach_grad()
+    with autograd.record():
+        out = fn(*nds)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        head = out.sum()
+    head.backward()
+    analytic = {n: nd_arr.grad.asnumpy()
+                for nd_arr, n in zip(nds, names) if n in grad_nodes}
+
+    # numeric grads on host float64
+    def scalar_f(loc):
+        outs = fn(*[array(loc[n].astype(onp.float32), ctx=ctx)
+                    for n in names])
+        if isinstance(outs, (list, tuple)):
+            outs = outs[0]
+        return float(outs.sum().asscalar())
+
+    numeric = numeric_grad(scalar_f, dict(zip(names, arrays)),
+                           eps=numeric_eps)
+    for n in grad_nodes:
+        assert_almost_equal(analytic[n], numeric[n], rtol=rtol, atol=atol,
+                            names=(f"analytic d/d{n}", f"numeric d/d{n}"))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-5,
+                           ctx=None, dtype=onp.float32):
+    """Bind a symbol, run forward, compare with expected numpy outputs
+    (reference check_symbolic_forward)."""
+    ctx = ctx or default_context()
+    args = sym.list_arguments()
+    if isinstance(location, dict):
+        arg_arrays = {k: array(onp.asarray(v, dtype), ctx=ctx)
+                      for k, v in location.items()}
+    else:
+        arg_arrays = {a: array(onp.asarray(v, dtype), ctx=ctx)
+                      for a, v in zip(args, location)}
+    exe = sym.bind(ctx, arg_arrays, grad_req="null")
+    outputs = exe.forward(is_train=False)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol=rtol, atol=atol)
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-5, grad_req="write", ctx=None,
+                            dtype=onp.float32):
+    """Bind, forward+backward, compare arg grads (reference
+    check_symbolic_backward)."""
+    from .ndarray import zeros
+
+    ctx = ctx or default_context()
+    args = sym.list_arguments()
+    if isinstance(location, dict):
+        arg_arrays = {k: array(onp.asarray(v, dtype), ctx=ctx)
+                      for k, v in location.items()}
+    else:
+        arg_arrays = {a: array(onp.asarray(v, dtype), ctx=ctx)
+                      for a, v in zip(args, location)}
+    grads = {a: zeros(arg_arrays[a].shape, ctx=ctx) for a in args}
+    exe = sym.bind(ctx, arg_arrays, args_grad=grads, grad_req=grad_req)
+    exe.forward(is_train=True)
+    exe.backward([array(onp.asarray(g, dtype), ctx=ctx)
+                  for g in (out_grads if isinstance(out_grads, (list, tuple))
+                            else [out_grads])])
+    if isinstance(expected, dict):
+        items = expected.items()
+    else:
+        items = zip(args, expected)
+    for name, exp in items:
+        assert_almost_equal(grads[name], exp, rtol=rtol, atol=atol,
+                            names=(f"grad[{name}]", "expected"))
+    return grads
+
+
+@contextlib.contextmanager
+def environment(*args):
+    """Temporarily set env vars: environment(name, value) or
+    environment({name: value, ...}) (reference common.py with_environment)."""
+    if len(args) == 2:
+        updates = {args[0]: args[1]}
+    else:
+        (updates,) = args
+    saved = {k: os.environ.get(k) for k in updates}
+    try:
+        for k, v in updates.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -1,0 +1,160 @@
+"""metric / callback / test_utils / visualization tests (reference
+tests/python/unittest/test_metric.py + test_utils usage across the suite)."""
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import callback, metric, nd, sym, test_utils
+from mxnet_tpu import visualization
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert acc == pytest.approx(2.0 / 3.0)
+
+
+def test_topk_and_f1_mcc():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.2, 0.7], [0.6, 0.3, 0.1]])
+    label = nd.array([1, 2])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+    f1 = metric.F1()
+    pred = nd.array([[0.8, 0.2], [0.3, 0.7], [0.1, 0.9], [0.6, 0.4]])
+    label = nd.array([0, 1, 1, 1])
+    f1.update([label], [pred])
+    assert 0 < f1.get()[1] <= 1.0
+
+    mcc = metric.MCC()
+    mcc.update([label], [pred])
+    assert -1.0 <= mcc.get()[1] <= 1.0
+
+
+def test_regression_metrics():
+    pred = nd.array([1.0, 2.0, 3.0])
+    label = nd.array([1.5, 2.0, 2.5])
+    mae = metric.MAE()
+    mae.update([label], [pred])
+    assert mae.get()[1] == pytest.approx(1.0 / 3.0)
+    mse = metric.MSE()
+    mse.update([label], [pred])
+    assert mse.get()[1] == pytest.approx(0.5 * 0.5 * 2 / 3)
+    rmse = metric.RMSE()
+    rmse.update([label], [pred])
+    assert rmse.get()[1] == pytest.approx((0.5 * 0.5 * 2 / 3) ** 0.5)
+
+
+def test_perplexity_crossentropy():
+    probs = nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = nd.array([1, 0])
+    pp = metric.Perplexity()
+    pp.update([label], [probs])
+    expected = onp.exp(-(onp.log(0.75) + onp.log(0.5)) / 2)
+    assert pp.get()[1] == pytest.approx(expected, rel=1e-5)
+    ce = metric.CrossEntropy()
+    ce.update([label], [probs])
+    assert ce.get()[1] == pytest.approx(
+        -(onp.log(0.75) + onp.log(0.5)) / 2, rel=1e-4)
+
+
+def test_composite_create_custom():
+    comp = metric.create(["accuracy", "mae"])
+    pred = nd.array([[0.3, 0.7]])
+    label = nd.array([1])
+    comp.update([label], [pred])
+    names, values = comp.get()
+    assert "accuracy" in names and "mae" in names
+
+    cm = metric.np(lambda l, p: float(onp.abs(l - p.argmax(-1)).sum()))
+    cm.update([label], [pred])
+    assert cm.get()[1] == 0.0
+
+    pearson = metric.PearsonCorrelation()
+    x = onp.random.RandomState(0).rand(50)
+    pearson.update([nd.array(x)], [nd.array(2 * x + 1)])
+    assert pearson.get()[1] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_speedometer_runs(caplog):
+    sp = callback.Speedometer(batch_size=4, frequent=2)
+    m = metric.Accuracy()
+    m.update([nd.array([0])], [nd.array([[0.9, 0.1]])])
+    with caplog.at_level(logging.INFO):
+        for i in range(5):
+            sp(callback.BatchEndParam(epoch=0, nbatch=i, eval_metric=m,
+                                      locals=None))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+
+def test_assert_almost_equal_tolerances():
+    a = onp.float32([1.0, 2.0])
+    test_utils.assert_almost_equal(a, a + 1e-7)
+    with pytest.raises(AssertionError):
+        test_utils.assert_almost_equal(a, a + 1.0)
+    # fp16 gets looser default tolerance
+    h = onp.float16([1.0, 2.0])
+    test_utils.assert_almost_equal(h, h + onp.float16(0.001))
+
+
+def test_rand_ndarray_and_shapes():
+    arr = test_utils.rand_ndarray((3, 4))
+    assert arr.shape == (3, 4)
+    sp = test_utils.rand_ndarray((50, 50), stype="row_sparse", density=0.05)
+    frac = (sp.asnumpy() != 0).mean()
+    assert frac < 0.2
+    assert len(test_utils.rand_shape_nd(4, 5)) == 4
+
+
+def test_check_numeric_gradient_op():
+    loc = [onp.random.RandomState(0).rand(3, 4) + 0.5]
+    test_utils.check_numeric_gradient("sqrt", loc)
+    test_utils.check_numeric_gradient(
+        "broadcast_mul",
+        [onp.random.RandomState(1).rand(2, 3),
+         onp.random.RandomState(2).rand(2, 3)])
+
+
+def test_check_numeric_gradient_fn():
+    def f(x):
+        return (x * x).sum(axis=1).sqrt()
+
+    test_utils.check_numeric_gradient(
+        f, [onp.random.RandomState(3).rand(4, 3) + 1.0])
+
+
+def test_check_symbolic_forward_backward():
+    x = sym.var("x")
+    y = x * 2.0 + 1.0
+    loc = [onp.array([[1.0, 2.0]], onp.float32)]
+    test_utils.check_symbolic_forward(y, loc, [onp.array([[3.0, 5.0]])])
+    test_utils.check_symbolic_backward(
+        y, loc, [onp.ones((1, 2), onp.float32)],
+        [onp.full((1, 2), 2.0, onp.float32)])
+
+
+def test_environment_scope():
+    import os
+
+    with test_utils.environment("MXNET_TEST_FOO", "1"):
+        assert os.environ["MXNET_TEST_FOO"] == "1"
+    assert "MXNET_TEST_FOO" not in os.environ
+
+
+def test_print_summary(capsys):
+    x = sym.var("data")
+    w = sym.var("fc_weight")
+    b = sym.var("fc_bias")
+    out = sym.softmax(sym.FullyConnected(x, w, b, num_hidden=4))
+    total = visualization.print_summary(
+        out, {"data": (2, 8), "fc_weight": (4, 8), "fc_bias": (4,)})
+    captured = capsys.readouterr().out
+    assert "FullyConnected" in captured
+    assert total == 4 * 8 + 4

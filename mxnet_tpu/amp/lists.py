@@ -1,0 +1,37 @@
+"""AMP op lists (reference ``python/mxnet/contrib/amp/lists/symbol_fp16.py``).
+
+Three classes, same split logic as the reference:
+- LOW_PRECISION_FUNCS: matmul/conv-class ops that are safe and fast in
+  bf16/fp16 (MXU ops)
+- FP32_FUNCS: numerically sensitive ops pinned to fp32 (norms, softmax/log,
+  losses, reductions feeding statistics)
+- WIDEST_TYPE_CASTS: elementwise multi-input ops that follow their widest
+  input
+On TPU the low-precision dtype is bfloat16 by default — same exponent range
+as fp32, so the reference's loss-scaling machinery is optional (kept for
+fp16 parity).
+"""
+
+LOW_PRECISION_FUNCS = [
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+    "linalg_gemm", "linalg_gemm2", "_rnn_fused",
+]
+
+FP32_FUNCS = [
+    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "LRN",
+    "L2Normalization", "softmax", "log_softmax", "softmin",
+    "softmax_cross_entropy", "SoftmaxOutput", "CTCLoss", "MakeLoss",
+    "exp", "log", "log2", "log10", "log1p", "expm1", "square", "sqrt",
+    "rsqrt", "cbrt", "power", "norm", "mean", "sum", "prod", "nansum",
+    "nanprod", "cumsum", "cumprod", "moments", "erf", "erfinv", "gamma",
+    "gammaln",
+]
+
+WIDEST_TYPE_CASTS = [
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_mod", "broadcast_power", "broadcast_maximum",
+    "broadcast_minimum", "broadcast_hypot", "add_n", "concat", "stack",
+    "where", "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+]

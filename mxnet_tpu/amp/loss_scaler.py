@@ -1,0 +1,49 @@
+"""Dynamic loss scaler (reference
+``python/mxnet/contrib/amp/loss_scaler.py``).
+
+Needed for fp16; optional for bf16 (same exponent range as fp32).  Scale
+doubles every ``scale_window`` clean steps, halves on overflow, and the
+overflowed step is skipped — identical policy to the reference.
+"""
+from __future__ import annotations
+
+from ..ndarray import NDArray
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        """True if any gradient is non-finite (reference
+        loss_scaler.py has_overflow).  Finiteness reduces per-grad on
+        device; exactly ONE scalar host sync per call."""
+        import jax.numpy as jnp
+
+        flags = []
+        for p in params:
+            grads = p.list_grad() if hasattr(p, "list_grad") else [p]
+            for g in grads:
+                if g is None:
+                    continue
+                a = g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                flags.append(jnp.isfinite(a).all())
+        if not flags:
+            return False
+        return not bool(jnp.stack(flags).all())
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -161,6 +161,15 @@ class Trainer:
             self._init_params()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            # fp16 AMP: skip the update and shrink the scale on overflow
+            # (reference amp trainer patching + LossScaler policy)
+            overflow = scaler.has_overflow(
+                [p for p in self._params if p.grad_req != "null"])
+            scaler.update_scale(overflow)
+            if overflow:
+                return
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):

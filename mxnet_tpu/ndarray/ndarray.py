@@ -35,6 +35,9 @@ __all__ = ["NDArray", "invoke", "array", "_wrap", "_on_tape"]
 
 _float_types = (onp.float16, onp.float32, onp.float64, jnp.bfloat16)
 
+# installed by mx.amp.init(): fn(op_name, [jax arrays]) -> [jax arrays]
+_amp_policy = None
+
 
 def _dtype_np(dtype) -> onp.dtype:
     if dtype is None:
@@ -603,6 +606,13 @@ def invoke(
         fn = lambda *arrs: schema.fn(list(arrs), **attrs)
     else:
         fn = lambda *arrs: schema.fn(*arrs, **attrs)
+
+    if _amp_policy is not None:
+        # mx.amp per-op cast lists: casting INSIDE fn keeps it within the
+        # vjp boundary, so backward re-casts cotangents to each input's
+        # original dtype (the reference amp_cast op's FGradient behavior)
+        inner_fn = fn
+        fn = lambda *arrs: inner_fn(*_amp_policy(schema.name, list(arrs)))
 
     if record:
         try:

@@ -1,0 +1,229 @@
+"""``mx.profiler`` — tracing and profiling.
+
+Reference analog: ``src/profiler/`` (lock-free stat queue, Chrome-trace
+dump, aggregate table) + ``python/mxnet/profiler.py:34-407`` (set_config,
+pause/resume, user scopes Task/Frame/Event/Counter).
+
+TPU-native design: two layers —
+1. device/XLA level: ``jax.profiler`` trace sessions (TensorBoard format)
+   capture compiled-program timelines, the analog of the reference's
+   engine-exec brackets;
+2. python level: user scopes and op-dispatch events recorded into an
+   in-process buffer and dumped as Chrome trace JSON (``dump``/``dumps``),
+   byte-compatible with chrome://tracing like the reference's output.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
+           "dumps", "Task", "Frame", "Event", "Counter", "Marker", "scope"]
+
+_LOCK = threading.Lock()
+_CONFIG = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": True,
+    "aggregate_stats": False,
+    "xla_trace_dir": None,
+}
+_RUNNING = False
+_PAUSED = False
+_EVENTS: List[dict] = []
+_XLA_ACTIVE = False
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference profiler.py set_config)."""
+    for k, v in kwargs.items():
+        if k in ("filename", "file_name"):
+            _CONFIG["filename"] = v
+        elif k in _CONFIG:
+            _CONFIG[k] = v
+        # unknown kwargs accepted for reference-arg parity (continuous_dump…)
+
+
+def state():
+    return "run" if (_RUNNING and not _PAUSED) else "stop"
+
+
+def set_state(state_name="stop"):
+    """'run' starts collection (+XLA trace if xla_trace_dir configured);
+    'stop' ends it."""
+    global _RUNNING, _XLA_ACTIVE
+    if state_name == "run":
+        _RUNNING = True
+        with _LOCK:
+            _EVENTS.clear()
+        tdir = _CONFIG["xla_trace_dir"]
+        if tdir and not _XLA_ACTIVE:
+            import jax
+
+            jax.profiler.start_trace(tdir)
+            _XLA_ACTIVE = True
+    elif state_name == "stop":
+        _RUNNING = False
+        if _XLA_ACTIVE:
+            import jax
+
+            jax.profiler.stop_trace()
+            _XLA_ACTIVE = False
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+def pause(profile_process="worker"):
+    global _PAUSED
+    _PAUSED = True
+
+
+def resume(profile_process="worker"):
+    global _PAUSED
+    _PAUSED = False
+
+
+def _emit(name, cat, ph, ts=None, dur=None, args=None):
+    if not _RUNNING or _PAUSED:
+        return
+    ev = {"name": name, "cat": cat, "ph": ph, "pid": os.getpid(),
+          "tid": threading.get_ident(),
+          "ts": (time.perf_counter_ns() // 1000) if ts is None else ts}
+    if dur is not None:
+        ev["dur"] = dur
+    if args is not None:
+        ev["args"] = args
+    with _LOCK:
+        _EVENTS.append(ev)
+
+
+def dumps(reset=False, format="table") -> str:
+    """Aggregate table of recorded durations (reference DumpAggregate)."""
+    with _LOCK:
+        events = list(_EVENTS)
+        if reset:
+            _EVENTS.clear()
+    agg: Dict[str, List[float]] = defaultdict(list)
+    for ev in events:
+        if ev["ph"] == "X":
+            agg[ev["name"]].append(ev.get("dur", 0) / 1000.0)
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"
+             f"{'Max(ms)':>12}"]
+    lines.append("=" * 84)
+    for name, durs in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
+        lines.append(f"{name:<40}{len(durs):>8}{sum(durs):>12.3f}"
+                     f"{sum(durs) / len(durs):>12.3f}{max(durs):>12.3f}")
+    return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write Chrome trace JSON (reference DumpProfile)."""
+    with _LOCK:
+        events = list(_EVENTS)
+    with open(_CONFIG["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return _CONFIG["filename"]
+
+
+class _DurationScope:
+    """Duration-event context manager base (reference profiler Task/Frame)."""
+
+    _cat = "user"
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def stop(self):
+        if self._t0 is None:
+            return
+        dur = (time.perf_counter_ns() - self._t0) // 1000
+        _emit(self.name, self._cat, "X", ts=self._t0 // 1000, dur=dur)
+        self._t0 = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_DurationScope):
+    _cat = "task"
+
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+
+
+class Frame(_DurationScope):
+    _cat = "frame"
+
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+
+
+class Event(_DurationScope):
+    _cat = "event"
+
+
+class Marker:
+    """Instant marker (reference profiler Marker)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        _emit(self.name, "marker", "i")
+
+
+class Counter:
+    """Named counter series (reference profiler Counter)."""
+
+    def __init__(self, name, domain=None, value=None):
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        _emit(self.name, "counter", "C", args={self.name: value})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+
+class scope:
+    """Annotate a profiler scope name (reference profiler.scope)."""
+
+    def __init__(self, name="<unk>:", append_mode=False):
+        self._name = name
+
+    def __enter__(self):
+        _emit(self._name, "scope", "B")
+        return self
+
+    def __exit__(self, *exc):
+        _emit(self._name, "scope", "E")

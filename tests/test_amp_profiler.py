@@ -1,0 +1,153 @@
+"""amp / profiler / runtime tests (reference
+tests/python/gpu/test_contrib_amp.py, tests/python/unittest/test_profiler.py,
+test_runtime.py)."""
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, nd, profiler, runtime
+from mxnet_tpu.gluon import nn
+
+
+@pytest.fixture(autouse=True)
+def _amp_off():
+    yield
+    amp.uninit()
+
+
+def test_amp_init_casts_matmul_inputs():
+    import jax.numpy as jnp
+
+    amp.init("bfloat16")
+    x = nd.ones((4, 8))
+    w = nd.ones((16, 8))
+    out = nd.FullyConnected(x, w, None, num_hidden=16, no_bias=True)
+    assert out._data.dtype == jnp.bfloat16
+    # fp32-pinned op casts back up
+    s = nd.softmax(out)
+    assert s._data.dtype == jnp.float32
+    amp.uninit()
+    out2 = nd.FullyConnected(x, w, None, num_hidden=16, no_bias=True)
+    assert out2._data.dtype == jnp.float32
+
+
+def test_amp_training_converges():
+    import jax.numpy as jnp
+
+    amp.init("bfloat16")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+    net.initialize()
+    rng = onp.random.RandomState(0)
+    X = nd.array(rng.rand(32, 4))
+    y = nd.array((X.asnumpy() @ rng.rand(4, 1)))
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 0.02})
+    l2 = mx.gluon.loss.L2Loss()
+    first = None
+    for _ in range(150):
+        with mx.autograd.record():
+            loss = l2(net(X), y).mean()
+        loss.backward()
+        tr.step(32)
+        if first is None:
+            first = float(loss.asscalar())
+    assert float(loss.asscalar()) < 0.05 * first
+
+
+def test_fp16_loss_scaling_end_to_end():
+    """Overflowed steps are skipped and the scale adapts; gradients are
+    unscaled exactly once (trainer rescale path)."""
+    amp.init("float16")
+    net = nn.Dense(1)
+    net.initialize()
+    X = nd.ones((4, 3))
+    y = nd.ones((4, 1))
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    tr._amp_loss_scaler.loss_scale = 4.0  # small, no overflow expected
+    l2 = mx.gluon.loss.L2Loss()
+    net(X)  # complete deferred shape inference
+    w_before = net.weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        with amp.scale_loss(l2(net(X), y).mean(), tr) as scaled:
+            scaled.backward()
+    tr.step(4)
+    w_after = net.weight.data().asnumpy()
+    assert not onp.allclose(w_before, w_after)  # clean step applied
+
+    # force an overflow: scaler must skip the update and halve the scale
+    net.weight.grad(mx.cpu())._set_data(
+        (nd.full(net.weight.shape, onp.inf))._data)
+    w_before = net.weight.data().asnumpy().copy()
+    scale_before = tr._amp_loss_scaler.loss_scale
+    tr.step(4)
+    onp.testing.assert_allclose(net.weight.data().asnumpy(), w_before)
+    assert tr._amp_loss_scaler.loss_scale == scale_before / 2
+
+
+def test_loss_scaler_policy():
+    sc = amp.LossScaler(init_scale=8.0, scale_factor=2.0, scale_window=2)
+    sc.update_scale(False)
+    sc.update_scale(False)
+    assert sc.loss_scale == 16.0
+    sc.update_scale(True)
+    assert sc.loss_scale == 8.0
+    g = nd.array([onp.inf, 1.0])
+    assert sc.has_overflow([g])
+    assert not sc.has_overflow([nd.array([1.0, 2.0])])
+
+
+def test_convert_hybrid_block():
+    import jax.numpy as jnp
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm(), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((2, 4)))
+    amp.convert_hybrid_block(net, "bfloat16")
+    params = net.collect_params()
+    assert params["0.weight"].data().dtype == jnp.bfloat16
+    # norm params stay fp32
+    assert params["1.gamma"].data().dtype == onp.float32
+
+
+def test_profiler_scopes_and_dump(tmp_path):
+    fn = str(tmp_path / "trace.json")
+    profiler.set_config(filename=fn)
+    profiler.set_state("run")
+    with profiler.Task("stepA"):
+        nd.ones((8, 8)).wait_to_read()
+    with profiler.Frame("frameB"):
+        pass
+    cnt = profiler.Counter("imgs")
+    cnt.set_value(5)
+    cnt += 3
+    profiler.Marker("mark").mark()
+    profiler.pause()
+    with profiler.Task("ignored"):
+        pass
+    profiler.resume()
+    table = profiler.dumps()
+    assert "stepA" in table
+    profiler.set_state("stop")
+    path = profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"stepA", "frameB", "imgs", "mark"} <= names
+    assert "ignored" not in names
+
+
+def test_runtime_features():
+    feats = runtime.feature_list()
+    names = {f.name for f in feats}
+    assert {"XLA", "BF16", "CPU"} <= names
+    fs = runtime.Features()
+    assert fs.is_enabled("XLA")
+    with pytest.raises(RuntimeError):
+        fs.is_enabled("NOT_A_FEATURE")

@@ -55,6 +55,9 @@ _LAZY = {
     "util": ".util",
     "executor": ".executor",
     "callback": ".callback",
+    "contrib": ".contrib",
+    "visualization": ".visualization",
+    "viz": ".visualization",
 }
 
 

@@ -64,29 +64,38 @@ def _policy(op_name, arrays):
 
 def init(target_dtype="bfloat16"):
     """Enable AMP globally (reference amp.init).  bfloat16 (default) or
-    float16."""
+    float16.  Bumps the AMP generation so hybridized graphs retrace under
+    the new cast policy."""
     if target_dtype in ("bfloat16", jnp.bfloat16):
         _STATE.target_dtype = jnp.bfloat16
+        _STATE.loss_scaler = None  # bf16 needs no scaling
     elif target_dtype in ("float16", onp.float16):
         _STATE.target_dtype = jnp.float16
-        if _STATE.loss_scaler is None:
-            _STATE.loss_scaler = LossScaler()
+        _STATE.loss_scaler = LossScaler()  # fresh scale per session
     else:
         raise ValueError("target_dtype must be bfloat16 or float16")
     from ..ndarray import ndarray as _ndmod
 
     _ndmod._amp_policy = _policy
+    _ndmod._amp_generation += 1
 
 
 def uninit():
     _STATE.target_dtype = None
+    _STATE.loss_scaler = None
     from ..ndarray import ndarray as _ndmod
 
     _ndmod._amp_policy = None
+    _ndmod._amp_generation += 1
 
 
 def init_trainer(trainer):
     """Attach the loss scaler to a Trainer (reference amp.init_trainer)."""
+    if getattr(trainer, "_update_on_kvstore", None):
+        raise MXNetError(
+            "AMP does not support update_on_kvstore=True: overflowed "
+            "updates applied server-side cannot be skipped — create the "
+            "Trainer with update_on_kvstore=False")
     if _STATE.target_dtype == jnp.float16 and _STATE.loss_scaler is None:
         _STATE.loss_scaler = LossScaler()
     trainer._amp_loss_scaler = _STATE.loss_scaler

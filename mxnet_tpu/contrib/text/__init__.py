@@ -1,0 +1,3 @@
+"""``mx.contrib.text`` (reference ``python/mxnet/contrib/text/``)."""
+from . import embedding, vocab
+from .vocab import Vocabulary, count_tokens_from_str

@@ -1,0 +1,203 @@
+"""Pretrained token embeddings (reference
+``python/mxnet/contrib/text/embedding.py``).
+
+File-based only (no network egress): ``CustomEmbedding`` loads any
+``token<elem_delim>v1 ... vN`` text file; the GloVe/FastText classes accept
+a ``pretrained_file_path`` pointing at an already-downloaded archive
+member."""
+from __future__ import annotations
+
+import io
+import logging
+import os
+from typing import Callable, Dict, List, Optional
+
+import numpy as onp
+
+from ...ndarray import NDArray, array
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "CustomEmbedding", "GloVe", "FastText"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    cls = _REGISTRY.get(embedding_name.lower())
+    if cls is None:
+        raise KeyError(f"unknown embedding {embedding_name}; "
+                       f"have {sorted(_REGISTRY)}")
+    return cls(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    if embedding_name is not None:
+        cls = _REGISTRY.get(embedding_name.lower())
+        return list(getattr(cls, "pretrained_file_names", []))
+    return {n: list(getattr(c, "pretrained_file_names", []))
+            for n, c in _REGISTRY.items()}
+
+
+class TokenEmbedding:
+    """Base: token -> vector with unknown fallback (reference
+    embedding.py _TokenEmbedding)."""
+
+    def __init__(self, unknown_token="<unk>",
+                 init_unknown_vec=onp.zeros):
+        self._unknown_token = unknown_token
+        self._init_unknown_vec = init_unknown_vec
+        self._idx_to_token: List[str] = [unknown_token]
+        self._token_to_idx: Dict[str, int] = {unknown_token: 0}
+        self._idx_to_vec: Optional[onp.ndarray] = None
+
+    def _load_embedding_txt(self, path, elem_delim=" ", encoding="utf8"):
+        vecs = []
+        vec_len = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) <= 2:
+                    continue  # header line of fasttext-format files
+                token, elems = parts[0], parts[1:]
+                if vec_len is None:
+                    vec_len = len(elems)
+                elif len(elems) != vec_len:
+                    logging.warning("line %d: bad vector length, skipped",
+                                    line_num)
+                    continue
+                if token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(onp.asarray(elems, onp.float32))
+        assert vec_len is not None, f"no vectors found in {path}"
+        unk = self._init_unknown_vec(vec_len).astype(onp.float32)
+        self._idx_to_vec = onp.vstack([unk] + vecs)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return 0 if self._idx_to_vec is None else self._idx_to_vec.shape[1]
+
+    @property
+    def idx_to_vec(self) -> NDArray:
+        return array(self._idx_to_vec)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False) -> NDArray:
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        idxs = []
+        for t in tokens:
+            if t in self._token_to_idx:
+                idxs.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                idxs.append(self._token_to_idx[t.lower()])
+            else:
+                idxs.append(0)
+        vecs = self._idx_to_vec[idxs]
+        return array(vecs[0] if single else vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        nv = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else onp.asarray(new_vectors)
+        if nv.ndim == 1:
+            nv = nv[None, :]
+        for t, v in zip(tokens, nv):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} is unknown")
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Load a user text file of embeddings (reference CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_txt(pretrained_file_path, elem_delim, encoding)
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe vectors from a local file (reference GloVe; downloads disabled
+    in this environment)."""
+
+    pretrained_file_names = [
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt",
+    ]
+
+    def __init__(self, pretrained_file_name="glove.6B.50d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embedding"),
+                 pretrained_file_path=None, **kwargs):
+        super().__init__(**kwargs)
+        path = pretrained_file_path or os.path.join(
+            os.path.expanduser(embedding_root), "glove",
+            pretrained_file_name)
+        if not os.path.exists(path):
+            raise IOError(
+                f"{path} not found; downloads are disabled — place the "
+                "file there or pass pretrained_file_path")
+        self._load_embedding_txt(path)
+
+
+@register
+class FastText(TokenEmbedding):
+    pretrained_file_names = [
+        "wiki.en.vec", "wiki.simple.vec", "crawl-300d-2M.vec",
+    ]
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embedding"),
+                 pretrained_file_path=None, **kwargs):
+        super().__init__(**kwargs)
+        path = pretrained_file_path or os.path.join(
+            os.path.expanduser(embedding_root), "fasttext",
+            pretrained_file_name)
+        if not os.path.exists(path):
+            raise IOError(
+                f"{path} not found; downloads are disabled — place the "
+                "file there or pass pretrained_file_path")
+        self._load_embedding_txt(path)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = []
+        for emb in token_embeddings:
+            parts.append(onp.vstack([
+                emb.get_vecs_by_tokens(t).asnumpy()
+                for t in self._idx_to_token]))
+        self._idx_to_vec = onp.concatenate(parts, axis=1)
+
+
+__all__.append("CompositeEmbedding")

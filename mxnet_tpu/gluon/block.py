@@ -475,7 +475,9 @@ class HybridBlock(Block):
             args = args + tuple(kwargs.values())
         training = autograd.is_training()
         in_leaves, in_struct = _flatten_args(args)
-        sig = (training, _struct_key(in_struct))
+        from ..ndarray import ndarray as _ndmod
+
+        sig = (training, _ndmod._amp_generation, _struct_key(in_struct))
         rec = self._cached.get(sig)
         if rec is None:
             rec = self._build_cache(in_struct, training)

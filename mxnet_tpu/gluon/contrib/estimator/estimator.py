@@ -1,0 +1,129 @@
+"""Keras-style Estimator (reference
+``python/mxnet/gluon/contrib/estimator/estimator.py``)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .... import autograd
+from ....metric import EvalMetric, Loss as LossMetric
+from ...trainer import Trainer
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            LoggingHandler, MetricHandler, StoppingHandler,
+                            TrainBegin, TrainEnd, ValidationHandler)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """Train/validate a Block with an event-handler pipeline (reference
+    estimator.py Estimator)."""
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, evaluation_loss=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or []
+        if isinstance(self.train_metrics, EvalMetric):
+            self.train_metrics = [self.train_metrics]
+        self.val_metrics = val_metrics or []
+        if isinstance(self.val_metrics, EvalMetric):
+            self.val_metrics = [self.val_metrics]
+        self.evaluation_loss = evaluation_loss or loss
+        self.train_loss_metric = LossMetric(name="train_loss")
+        self.val_loss_metric = LossMetric(name="val_loss")
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3})
+        self.max_epoch = None
+        self.max_batch = None
+
+    # -- evaluation ------------------------------------------------------
+    def evaluate(self, val_data=None, batch_axis=0):
+        for m in self.val_metrics:
+            m.reset()
+        self.val_loss_metric.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            pred = self.net(data)
+            loss = self.evaluation_loss(pred, label)
+            for m in self.val_metrics:
+                m.update([label], [pred])
+            self.val_loss_metric.update(0, [loss])
+        return {m.get()[0]: m.get()[1]
+                for m in self.val_metrics + [self.val_loss_metric]}
+
+    # -- training --------------------------------------------------------
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        self.max_epoch = epochs
+        self.max_batch = batches
+        if epochs is None and batches is None:
+            raise ValueError("pass epochs or batches")
+
+        handlers = self._prepare_handlers(val_data, event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize(handlers)
+
+        for h in train_begin:
+            h.train_begin(self)
+        stop = False
+        while not stop:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            ran_any = False
+            stopped_mid_epoch = False
+            for batch in train_data:
+                ran_any = True
+                data, label = batch[0], batch[1]
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                    lmean = loss.mean()
+                lmean.backward()
+                bs = data.shape[batch_axis]
+                self.trainer.step(bs)
+                for h in batch_end:
+                    if h.batch_end(self, batch=batch, pred=[pred],
+                                   label=[label], loss=[lmean]):
+                        stop = True
+                if stop:
+                    stopped_mid_epoch = True
+                    break
+            if not ran_any:
+                raise RuntimeError(
+                    "train_data yielded no batches — pass a re-iterable "
+                    "DataLoader (a plain generator is exhausted after one "
+                    "epoch)")
+            if stopped_mid_epoch:
+                break  # partial epoch: do not fire epoch_end handlers
+            for h in epoch_end:
+                if h.epoch_end(self):
+                    stop = True
+        for h in train_end:
+            h.train_end(self)
+
+    def _prepare_handlers(self, val_data, event_handlers):
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            handlers.append(StoppingHandler(self.max_epoch, self.max_batch))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(
+                [self.train_loss_metric] + self.train_metrics))
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=[self.train_loss_metric] + self.train_metrics))
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return handlers
+
+    @staticmethod
+    def _categorize(handlers):
+        return ([h for h in handlers if isinstance(h, TrainBegin)],
+                [h for h in handlers if isinstance(h, EpochBegin)],
+                [h for h in handlers if isinstance(h, BatchBegin)],
+                [h for h in handlers if isinstance(h, BatchEnd)],
+                [h for h in handlers if isinstance(h, EpochEnd)],
+                [h for h in handlers if isinstance(h, TrainEnd)])

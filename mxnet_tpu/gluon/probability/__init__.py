@@ -1,0 +1,17 @@
+"""``gluon.probability`` — distributions, transformations, stochastic
+blocks (reference ``python/mxnet/gluon/probability/``)."""
+from . import transformation
+from .distributions import *  # noqa: F401,F403
+from .distributions import __all__ as _dist_all
+from .kl import kl_divergence, register_kl
+from .stochastic_block import StochasticBlock, StochasticSequential
+from .transformation import (AbsTransform, AffineTransform, ComposeTransform,
+                             ExpTransform, PowerTransform, SigmoidTransform,
+                             SoftmaxTransform, Transformation)
+
+__all__ = list(_dist_all) + [
+    "kl_divergence", "register_kl", "StochasticBlock",
+    "StochasticSequential", "Transformation", "AffineTransform",
+    "ExpTransform", "SigmoidTransform", "PowerTransform", "AbsTransform",
+    "SoftmaxTransform", "ComposeTransform",
+]

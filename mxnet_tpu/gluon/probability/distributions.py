@@ -1,0 +1,845 @@
+"""Probability distributions.
+
+Reference analog: ``python/mxnet/gluon/probability/distributions/`` (~25
+distribution classes over `_npi_*` sampling ops).  TPU-native: densities and
+moments are pure jnp math routed through the np dispatcher (autograd-aware,
+traces into XLA); sampling draws threefry keys from the global chain
+(:mod:`mxnet_tpu.random`) so results are reproducible under ``mx.random.seed``.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax.scipy import special as jsp
+
+from ... import random as _random
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...numpy.multiarray import apply_np, ndarray as np_ndarray
+from ...ndarray.ndarray import _wrap
+from ...context import current_context
+
+__all__ = [
+    "Distribution", "Normal", "LogNormal", "Laplace", "Cauchy", "HalfNormal",
+    "HalfCauchy", "Uniform", "Exponential", "Gamma", "Beta", "Chi2",
+    "StudentT", "FisherSnedecor", "Gumbel", "Weibull", "Pareto", "Poisson",
+    "Bernoulli", "Binomial", "Geometric", "NegativeBinomial", "Categorical",
+    "OneHotCategorical", "Multinomial", "MultivariateNormal", "Dirichlet",
+    "Independent", "TransformedDistribution", "MixtureSameFamily",
+]
+
+
+def _p(x):
+    """Unwrap a distribution parameter to a jnp array."""
+    if isinstance(x, NDArray):
+        return x._data
+    return jnp.asarray(x)
+
+
+def _out(x):
+    return _wrap(jnp.asarray(x), current_context(), np_ndarray)
+
+
+def _shape(size, *params):
+    base = jnp.broadcast_shapes(*[jnp.shape(_p(p)) for p in params]) \
+        if params else ()
+    if size is None:
+        return base
+    if isinstance(size, int):
+        size = (size,)
+    return tuple(size) + base
+
+
+class Distribution:
+    """Base class (reference distribution.py Distribution)."""
+
+    has_grad = True
+    support = None
+    arg_constraints: dict = {}
+
+    def __init__(self, F=None, event_dim: int = 0, validate_args=None):
+        self.event_dim = event_dim
+
+    # subclasses implement _sample(key, shape) -> jnp, _log_prob(x) -> jnp
+    def sample(self, size=None):
+        key = _random.next_key()
+        return _out(self._sample(key, size))
+
+    def sample_n(self, n):
+        return self.sample((n,))
+
+    def _with_params(self, inner):
+        """Close over self's NDArray-valued parameters as explicit traced
+        inputs so densities differentiate w.r.t. them (``mu.attach_grad();
+        Normal(mu, 1).log_prob(x).backward()``).  During the call the
+        attributes are temporarily swapped for the traced jax arrays —
+        ``_p()`` passes those through unchanged."""
+        names = [k for k, v in self.__dict__.items()
+                 if isinstance(v, NDArray)]
+        vals = [self.__dict__[k] for k in names]
+
+        def fn(v, *params):
+            saved = {k: self.__dict__[k] for k in names}
+            for k, p in zip(names, params):
+                self.__dict__[k] = p
+            try:
+                return inner(v)
+            finally:
+                self.__dict__.update(saved)
+
+        return fn, vals
+
+    def _dispatch(self, inner, name, value):
+        fn, extras = self._with_params(inner)
+        return apply_np(fn, f"{type(self).__name__}.{name}",
+                        (value, *extras), {})
+
+    def log_prob(self, value):
+        return self._dispatch(self._log_prob, "log_prob", value)
+
+    def prob(self, value):
+        return self._dispatch(lambda v: jnp.exp(self._log_prob(v)), "prob",
+                              value)
+
+    def cdf(self, value):
+        return self._dispatch(self._cdf, "cdf", value)
+
+    def icdf(self, value):
+        return self._dispatch(self._icdf, "icdf", value)
+
+    def _cdf(self, v):
+        raise NotImplementedError
+
+    def _icdf(self, v):
+        raise NotImplementedError
+
+    @property
+    def mean(self):
+        return _out(self._mean())
+
+    @property
+    def variance(self):
+        return _out(self._variance())
+
+    @property
+    def stddev(self):
+        return _out(jnp.sqrt(self._variance()))
+
+    def entropy(self):
+        return _out(self._entropy())
+
+    def _entropy(self):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class Normal(Distribution):
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc, self.scale = loc, scale
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.loc, self.scale)
+        return _p(self.loc) + _p(self.scale) * jax.random.normal(
+            key, shp, jnp.result_type(float))
+
+    def _log_prob(self, v):
+        loc, scale = _p(self.loc), _p(self.scale)
+        return (-((v - loc) ** 2) / (2 * scale ** 2)
+                - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+
+    def _cdf(self, v):
+        return 0.5 * (1 + jsp.erf((v - _p(self.loc)) /
+                                  (_p(self.scale) * math.sqrt(2))))
+
+    def _icdf(self, v):
+        return _p(self.loc) + _p(self.scale) * math.sqrt(2) * \
+            jsp.erfinv(2 * v - 1)
+
+    def _mean(self):
+        return jnp.broadcast_to(_p(self.loc),
+                                _shape(None, self.loc, self.scale))
+
+    def _variance(self):
+        return jnp.broadcast_to(_p(self.scale) ** 2,
+                                _shape(None, self.loc, self.scale))
+
+    def _entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(_p(self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc, self.scale = loc, scale
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.loc, self.scale)
+        return jnp.exp(_p(self.loc) + _p(self.scale) *
+                       jax.random.normal(key, shp))
+
+    def _log_prob(self, v):
+        loc, scale = _p(self.loc), _p(self.scale)
+        return (-((jnp.log(v) - loc) ** 2) / (2 * scale ** 2)
+                - jnp.log(v * scale) - 0.5 * math.log(2 * math.pi))
+
+    def _mean(self):
+        return jnp.exp(_p(self.loc) + _p(self.scale) ** 2 / 2)
+
+    def _variance(self):
+        s2 = _p(self.scale) ** 2
+        return (jnp.exp(s2) - 1) * jnp.exp(2 * _p(self.loc) + s2)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc, self.scale = loc, scale
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.loc, self.scale)
+        return _p(self.loc) + _p(self.scale) * jax.random.laplace(key, shp)
+
+    def _log_prob(self, v):
+        loc, scale = _p(self.loc), _p(self.scale)
+        return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+
+    def _mean(self):
+        return jnp.broadcast_to(_p(self.loc),
+                                _shape(None, self.loc, self.scale))
+
+    def _variance(self):
+        return 2 * _p(self.scale) ** 2
+
+    def _entropy(self):
+        return 1 + jnp.log(2 * _p(self.scale))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc, self.scale = loc, scale
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.loc, self.scale)
+        return _p(self.loc) + _p(self.scale) * jax.random.cauchy(key, shp)
+
+    def _log_prob(self, v):
+        loc, scale = _p(self.loc), _p(self.scale)
+        return (-math.log(math.pi) - jnp.log(scale)
+                - jnp.log1p(((v - loc) / scale) ** 2))
+
+    def _cdf(self, v):
+        return jnp.arctan((v - _p(self.loc)) / _p(self.scale)) / math.pi + 0.5
+
+    def _mean(self):
+        return jnp.full(_shape(None, self.loc, self.scale), jnp.nan)
+
+    def _variance(self):
+        return jnp.full(_shape(None, self.loc, self.scale), jnp.nan)
+
+    def _entropy(self):
+        return jnp.log(4 * math.pi * _p(self.scale))
+
+
+class HalfNormal(Distribution):
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale
+
+    def _sample(self, key, size):
+        return jnp.abs(_p(self.scale) *
+                       jax.random.normal(key, _shape(size, self.scale)))
+
+    def _log_prob(self, v):
+        scale = _p(self.scale)
+        return (0.5 * math.log(2 / math.pi) - jnp.log(scale)
+                - v ** 2 / (2 * scale ** 2))
+
+    def _mean(self):
+        return _p(self.scale) * math.sqrt(2 / math.pi)
+
+    def _variance(self):
+        return _p(self.scale) ** 2 * (1 - 2 / math.pi)
+
+
+class HalfCauchy(Distribution):
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale
+
+    def _sample(self, key, size):
+        return jnp.abs(_p(self.scale) *
+                       jax.random.cauchy(key, _shape(size, self.scale)))
+
+    def _log_prob(self, v):
+        scale = _p(self.scale)
+        return (math.log(2 / math.pi) - jnp.log(scale)
+                - jnp.log1p((v / scale) ** 2))
+
+
+class Uniform(Distribution):
+    def __init__(self, low=0.0, high=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.low, self.high = low, high
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.low, self.high)
+        return jax.random.uniform(key, shp, minval=_p(self.low),
+                                  maxval=_p(self.high))
+
+    def _log_prob(self, v):
+        low, high = _p(self.low), _p(self.high)
+        inside = (v >= low) & (v <= high)
+        return jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+
+    def _cdf(self, v):
+        low, high = _p(self.low), _p(self.high)
+        return jnp.clip((v - low) / (high - low), 0.0, 1.0)
+
+    def _mean(self):
+        return (_p(self.low) + _p(self.high)) / 2
+
+    def _variance(self):
+        return (_p(self.high) - _p(self.low)) ** 2 / 12
+
+    def _entropy(self):
+        return jnp.log(_p(self.high) - _p(self.low))
+
+
+class Exponential(Distribution):
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale  # reference parameterizes by scale = 1/rate
+
+    def _sample(self, key, size):
+        return _p(self.scale) * jax.random.exponential(
+            key, _shape(size, self.scale))
+
+    def _log_prob(self, v):
+        scale = _p(self.scale)
+        return -v / scale - jnp.log(scale)
+
+    def _cdf(self, v):
+        return 1 - jnp.exp(-v / _p(self.scale))
+
+    def _mean(self):
+        return jnp.asarray(_p(self.scale))
+
+    def _variance(self):
+        return _p(self.scale) ** 2
+
+    def _entropy(self):
+        return 1 + jnp.log(_p(self.scale))
+
+
+class Gamma(Distribution):
+    def __init__(self, shape=1.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.shape_param, self.scale = shape, scale
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.shape_param, self.scale)
+        a = jnp.broadcast_to(_p(self.shape_param), shp)
+        return jax.random.gamma(key, a) * _p(self.scale)
+
+    def _log_prob(self, v):
+        a, b = _p(self.shape_param), _p(self.scale)
+        return ((a - 1) * jnp.log(v) - v / b - jsp.gammaln(a)
+                - a * jnp.log(b))
+
+    def _mean(self):
+        return _p(self.shape_param) * _p(self.scale)
+
+    def _variance(self):
+        return _p(self.shape_param) * _p(self.scale) ** 2
+
+
+class Beta(Distribution):
+    def __init__(self, alpha=1.0, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha, self.beta = alpha, beta
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.alpha, self.beta)
+        return jax.random.beta(key, jnp.broadcast_to(_p(self.alpha), shp),
+                               jnp.broadcast_to(_p(self.beta), shp))
+
+    def _log_prob(self, v):
+        a, b = _p(self.alpha), _p(self.beta)
+        return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                - (jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)))
+
+    def _mean(self):
+        a, b = _p(self.alpha), _p(self.beta)
+        return a / (a + b)
+
+    def _variance(self):
+        a, b = _p(self.alpha), _p(self.beta)
+        return a * b / ((a + b) ** 2 * (a + b + 1))
+
+
+class Chi2(Gamma):
+    def __init__(self, df, **kwargs):
+        super().__init__(shape=_p(df) / 2.0, scale=2.0, **kwargs)
+        self.df = df
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.df, self.loc, self.scale = df, loc, scale
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.df, self.loc, self.scale)
+        return _p(self.loc) + _p(self.scale) * jax.random.t(
+            key, jnp.broadcast_to(_p(self.df), shp))
+
+    def _log_prob(self, v):
+        df, loc, scale = _p(self.df), _p(self.loc), _p(self.scale)
+        y = (v - loc) / scale
+        return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                - 0.5 * jnp.log(df * math.pi) - jnp.log(scale)
+                - (df + 1) / 2 * jnp.log1p(y ** 2 / df))
+
+    def _mean(self):
+        df = _p(self.df)
+        return jnp.where(df > 1, jnp.broadcast_to(_p(self.loc),
+                                                  jnp.shape(df)), jnp.nan)
+
+    def _variance(self):
+        df, scale = _p(self.df), _p(self.scale)
+        return jnp.where(df > 2, scale ** 2 * df / (df - 2), jnp.nan)
+
+
+class FisherSnedecor(Distribution):
+    def __init__(self, df1, df2, **kwargs):
+        super().__init__(**kwargs)
+        self.df1, self.df2 = df1, df2
+
+    def _sample(self, key, size):
+        k1, k2 = jax.random.split(key)
+        shp = _shape(size, self.df1, self.df2)
+        d1 = jnp.broadcast_to(_p(self.df1), shp)
+        d2 = jnp.broadcast_to(_p(self.df2), shp)
+        x1 = jax.random.gamma(k1, d1 / 2) * 2
+        x2 = jax.random.gamma(k2, d2 / 2) * 2
+        return (x1 / d1) / (x2 / d2)
+
+    def _log_prob(self, v):
+        d1, d2 = _p(self.df1), _p(self.df2)
+        return (d1 / 2 * jnp.log(d1) + d2 / 2 * jnp.log(d2)
+                + (d1 / 2 - 1) * jnp.log(v)
+                - (d1 + d2) / 2 * jnp.log(d2 + d1 * v)
+                - (jsp.gammaln(d1 / 2) + jsp.gammaln(d2 / 2)
+                   - jsp.gammaln((d1 + d2) / 2)))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc, self.scale = loc, scale
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.loc, self.scale)
+        return _p(self.loc) + _p(self.scale) * jax.random.gumbel(key, shp)
+
+    def _log_prob(self, v):
+        loc, scale = _p(self.loc), _p(self.scale)
+        z = (v - loc) / scale
+        return -(z + jnp.exp(-z)) - jnp.log(scale)
+
+    def _mean(self):
+        return _p(self.loc) + _p(self.scale) * onp.euler_gamma
+
+    def _variance(self):
+        return (math.pi ** 2 / 6) * _p(self.scale) ** 2
+
+
+class Weibull(Distribution):
+    def __init__(self, concentration, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.concentration, self.scale = concentration, scale
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.concentration, self.scale)
+        u = jax.random.uniform(key, shp)
+        return _p(self.scale) * (-jnp.log1p(-u)) ** (
+            1 / _p(self.concentration))
+
+    def _log_prob(self, v):
+        k, lam = _p(self.concentration), _p(self.scale)
+        return (jnp.log(k / lam) + (k - 1) * jnp.log(v / lam)
+                - (v / lam) ** k)
+
+    def _mean(self):
+        k, lam = _p(self.concentration), _p(self.scale)
+        return lam * jnp.exp(jsp.gammaln(1 + 1 / k))
+
+
+class Pareto(Distribution):
+    def __init__(self, alpha, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha, self.scale = alpha, scale
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.alpha, self.scale)
+        return _p(self.scale) * jax.random.pareto(
+            key, jnp.broadcast_to(_p(self.alpha), shp))
+
+    def _log_prob(self, v):
+        a, m = _p(self.alpha), _p(self.scale)
+        lp = jnp.log(a) + a * jnp.log(m) - (a + 1) * jnp.log(v)
+        return jnp.where(v >= m, lp, -jnp.inf)
+
+    def _mean(self):
+        a, m = _p(self.alpha), _p(self.scale)
+        return jnp.where(a > 1, a * m / (a - 1), jnp.inf)
+
+
+class Poisson(Distribution):
+    has_grad = False
+
+    def __init__(self, rate=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.rate = rate
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.rate)
+        return jax.random.poisson(key, _p(self.rate), shape=shp).astype(
+            jnp.float32)
+
+    def _log_prob(self, v):
+        r = _p(self.rate)
+        return v * jnp.log(r) - r - jsp.gammaln(v + 1)
+
+    def _mean(self):
+        return jnp.asarray(_p(self.rate))
+
+    def _variance(self):
+        return jnp.asarray(_p(self.rate))
+
+
+class Bernoulli(Distribution):
+    has_grad = False
+
+    def __init__(self, prob=None, logit=None, **kwargs):
+        super().__init__(**kwargs)
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob / logit")
+        self._prob = prob
+        self._logit = logit
+
+    @property
+    def prob_param(self):
+        if self._prob is not None:
+            return _p(self._prob)
+        return jax.nn.sigmoid(_p(self._logit))
+
+    def _sample(self, key, size):
+        p = self.prob_param
+        return jax.random.bernoulli(
+            key, p, shape=_shape(size, p)).astype(jnp.float32)
+
+    def _log_prob(self, v):
+        p = self.prob_param
+        return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+    def _mean(self):
+        return self.prob_param
+
+    def _variance(self):
+        p = self.prob_param
+        return p * (1 - p)
+
+    def _entropy(self):
+        p = self.prob_param
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+
+class Binomial(Distribution):
+    has_grad = False
+
+    def __init__(self, n=1, prob=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.n, self.prob_param = n, prob
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.n, self.prob_param)
+        return jax.random.binomial(
+            key, jnp.asarray(_p(self.n), jnp.float32),
+            jnp.asarray(_p(self.prob_param), jnp.float32), shape=shp)
+
+    def _log_prob(self, v):
+        n, p = _p(self.n), _p(self.prob_param)
+        return (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                - jsp.gammaln(n - v + 1)
+                + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def _mean(self):
+        return _p(self.n) * _p(self.prob_param)
+
+    def _variance(self):
+        p = _p(self.prob_param)
+        return _p(self.n) * p * (1 - p)
+
+
+class Geometric(Distribution):
+    has_grad = False
+
+    def __init__(self, prob=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.prob_param = prob
+
+    def _sample(self, key, size):
+        shp = _shape(size, self.prob_param)
+        u = jax.random.uniform(key, shp)
+        return jnp.floor(jnp.log1p(-u) / jnp.log1p(-_p(self.prob_param)))
+
+    def _log_prob(self, v):
+        p = _p(self.prob_param)
+        return v * jnp.log1p(-p) + jnp.log(p)
+
+    def _mean(self):
+        p = _p(self.prob_param)
+        return (1 - p) / p
+
+    def _variance(self):
+        p = _p(self.prob_param)
+        return (1 - p) / p ** 2
+
+
+class NegativeBinomial(Distribution):
+    has_grad = False
+
+    def __init__(self, n, prob=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.n, self.prob_param = n, prob
+
+    def _sample(self, key, size):
+        k1, k2 = jax.random.split(key)
+        shp = _shape(size, self.n, self.prob_param)
+        n = jnp.broadcast_to(jnp.asarray(_p(self.n), jnp.float32), shp)
+        p = _p(self.prob_param)
+        lam = jax.random.gamma(k1, n) * (1 - p) / p
+        return jax.random.poisson(k2, lam).astype(jnp.float32)
+
+    def _log_prob(self, v):
+        n, p = _p(self.n), _p(self.prob_param)
+        return (jsp.gammaln(v + n) - jsp.gammaln(n) - jsp.gammaln(v + 1)
+                + n * jnp.log(p) + v * jnp.log1p(-p))
+
+    def _mean(self):
+        p = _p(self.prob_param)
+        return _p(self.n) * (1 - p) / p
+
+
+class Categorical(Distribution):
+    has_grad = False
+
+    def __init__(self, num_events=None, prob=None, logit=None, **kwargs):
+        super().__init__(event_dim=0, **kwargs)
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob / logit")
+        self._prob, self._logit = prob, logit
+
+    @property
+    def logit_param(self):
+        if self._logit is not None:
+            return _p(self._logit)
+        return jnp.log(_p(self._prob))
+
+    def _sample(self, key, size):
+        logits = self.logit_param
+        shp = _shape(size)
+        return jax.random.categorical(key, logits,
+                                      shape=shp + logits.shape[:-1]
+                                      if shp else None).astype(jnp.float32)
+
+    def _log_prob(self, v):
+        logp = jax.nn.log_softmax(self.logit_param, axis=-1)
+        idx = jnp.asarray(v, jnp.int32)
+        logp = jnp.broadcast_to(logp, idx.shape + logp.shape[-1:])
+        return jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
+
+    def _entropy(self):
+        logp = jax.nn.log_softmax(self.logit_param, axis=-1)
+        return -(jnp.exp(logp) * logp).sum(-1)
+
+
+class OneHotCategorical(Categorical):
+    def _sample(self, key, size):
+        idx = super()._sample(key, size).astype(jnp.int32)
+        return jax.nn.one_hot(idx, self.logit_param.shape[-1])
+
+    def _log_prob(self, v):
+        logp = jax.nn.log_softmax(self.logit_param, axis=-1)
+        return (v * logp).sum(-1)
+
+
+class Multinomial(Distribution):
+    has_grad = False
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 total_count=1, **kwargs):
+        super().__init__(event_dim=1, **kwargs)
+        if prob is None and logit is not None:
+            prob = jax.nn.softmax(_p(logit), axis=-1)
+        self.prob_param = prob
+        self.total_count = total_count
+
+    def _sample(self, key, size):
+        p = _p(self.prob_param)
+        shp = _shape(size)
+        return jax.random.multinomial(
+            key, self.total_count, p,
+            shape=(shp + p.shape) if shp else None).astype(jnp.float32)
+
+    def _log_prob(self, v):
+        p = _p(self.prob_param)
+        n = jnp.asarray(self.total_count, jnp.float32)
+        return (jsp.gammaln(n + 1) - jsp.gammaln(v + 1).sum(-1)
+                + (v * jnp.log(p)).sum(-1))
+
+    def _mean(self):
+        return self.total_count * _p(self.prob_param)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, cov=None, scale_tril=None, **kwargs):
+        super().__init__(event_dim=1, **kwargs)
+        self.loc = loc
+        if scale_tril is not None:
+            self._tril = _p(scale_tril)
+        elif cov is not None:
+            self._tril = jnp.linalg.cholesky(_p(cov))
+        else:
+            raise MXNetError("need cov or scale_tril")
+
+    def _sample(self, key, size):
+        loc = _p(self.loc)
+        shp = _shape(size) + loc.shape
+        eps = jax.random.normal(key, shp)
+        return loc + jnp.einsum("...ij,...j->...i", self._tril, eps)
+
+    def _log_prob(self, v):
+        loc = _p(self.loc)
+        d = loc.shape[-1]
+        diff = v - loc
+        tril = jnp.broadcast_to(self._tril,
+                                diff.shape[:-1] + self._tril.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(tril, diff[..., None],
+                                                lower=True)[..., 0]
+        logdet = jnp.log(jnp.abs(jnp.diagonal(self._tril, axis1=-2,
+                                              axis2=-1))).sum(-1)
+        return (-0.5 * (sol ** 2).sum(-1) - logdet
+                - 0.5 * d * math.log(2 * math.pi))
+
+    def _mean(self):
+        return jnp.asarray(_p(self.loc))
+
+    def _variance(self):
+        return jnp.einsum("...ij,...ij->...i", self._tril, self._tril)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, alpha, **kwargs):
+        super().__init__(event_dim=1, **kwargs)
+        self.alpha = alpha
+
+    def _sample(self, key, size):
+        a = _p(self.alpha)
+        shp = _shape(size)
+        return jax.random.dirichlet(key, a, shape=shp + a.shape[:-1]
+                                    if shp else None)
+
+    def _log_prob(self, v):
+        a = _p(self.alpha)
+        return (((a - 1) * jnp.log(v)).sum(-1)
+                + jsp.gammaln(a.sum(-1)) - jsp.gammaln(a).sum(-1))
+
+    def _mean(self):
+        a = _p(self.alpha)
+        return a / a.sum(-1, keepdims=True)
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference independent.py)."""
+
+    def __init__(self, base_distribution, reinterpreted_batch_ndims,
+                 **kwargs):
+        super().__init__(event_dim=base_distribution.event_dim +
+                         reinterpreted_batch_ndims, **kwargs)
+        self.base_dist = base_distribution
+        self._n = reinterpreted_batch_ndims
+
+    def _sample(self, key, size):
+        return self.base_dist._sample(key, size)
+
+    def _log_prob(self, v):
+        lp = self.base_dist._log_prob(v)
+        return lp.sum(axis=tuple(range(-self._n, 0)))
+
+    def _mean(self):
+        return self.base_dist._mean()
+
+    def _variance(self):
+        return self.base_dist._variance()
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through invertible transforms (reference
+    transformed_distribution.py)."""
+
+    def __init__(self, base_dist, transforms, **kwargs):
+        super().__init__(**kwargs)
+        self.base_dist = base_dist
+        if not isinstance(transforms, (list, tuple)):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+
+    def _sample(self, key, size):
+        x = self.base_dist._sample(key, size)
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _log_prob(self, v):
+        lp = 0.0
+        x = v
+        for t in reversed(self.transforms):
+            inv = t._inverse(x)
+            lp = lp - t._log_det_jacobian(inv, x)
+            x = inv
+        return lp + self.base_dist._log_prob(x)
+
+
+class MixtureSameFamily(Distribution):
+    """Mixture over the last batch dim (reference mixture_same_family.py)."""
+
+    def __init__(self, mixture_distribution, component_distribution,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.mixture = mixture_distribution
+        self.components = component_distribution
+
+    def _sample(self, key, size):
+        k1, k2 = jax.random.split(key)
+        idx = self.mixture._sample(k1, size).astype(jnp.int32)
+        comps = self.components._sample(k2, size)  # (..., K)
+        return jnp.take_along_axis(comps, idx[..., None], axis=-1)[..., 0]
+
+    def _log_prob(self, v):
+        logw = jax.nn.log_softmax(self.mixture.logit_param, axis=-1)
+        lp = self.components._log_prob(v[..., None])
+        return jsp.logsumexp(logw + lp, axis=-1)
+
+    def _mean(self):
+        w = jnp.exp(jax.nn.log_softmax(self.mixture.logit_param, axis=-1))
+        return (w * self.components._mean()).sum(-1)

@@ -1,0 +1,110 @@
+"""KL divergence registry (reference
+``python/mxnet/gluon/probability/distributions/divergence.py`` +
+``kl_storage``)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ...base import MXNetError
+from . import distributions as D
+from .distributions import _out, _p
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    """KL(p || q) for registered pairs (reference kl_divergence).
+    Differentiable w.r.t. NDArray-valued parameters of either
+    distribution."""
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        for (tp, tq), f in _KL_REGISTRY.items():
+            if isinstance(p, tp) and isinstance(q, tq):
+                fn = f
+                break
+    if fn is None:
+        raise MXNetError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+    from ...ndarray import NDArray
+    from ...numpy.multiarray import apply_np
+
+    # route both distributions' NDArray params through the np dispatcher so
+    # gradients flow (same trick as Distribution._with_params)
+    entries = []  # (obj, attr_name)
+    vals = []
+    for obj in (p, q):
+        for k, v in obj.__dict__.items():
+            if isinstance(v, NDArray):
+                entries.append((obj, k))
+                vals.append(v)
+    if not vals:
+        return _out(fn(p, q))
+
+    def traced(*params):
+        saved = [(obj, k, obj.__dict__[k]) for obj, k in entries]
+        for (obj, k), val in zip(entries, params):
+            obj.__dict__[k] = val
+        try:
+            return fn(p, q)
+        finally:
+            for obj, k, v in saved:
+                obj.__dict__[k] = v
+
+    return apply_np(traced, "kl_divergence", tuple(vals), {})
+
+
+@register_kl(D.Normal, D.Normal)
+def _kl_normal_normal(p, q):
+    var_p = _p(p.scale) ** 2
+    var_q = _p(q.scale) ** 2
+    return (jnp.log(_p(q.scale) / _p(p.scale))
+            + (var_p + (_p(p.loc) - _p(q.loc)) ** 2) / (2 * var_q) - 0.5)
+
+
+@register_kl(D.Bernoulli, D.Bernoulli)
+def _kl_bern_bern(p, q):
+    pp, qq = p.prob_param, q.prob_param
+    return (pp * (jnp.log(pp) - jnp.log(qq))
+            + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(D.Categorical, D.Categorical)
+def _kl_cat_cat(p, q):
+    import jax
+
+    lp = jax.nn.log_softmax(p.logit_param, axis=-1)
+    lq = jax.nn.log_softmax(q.logit_param, axis=-1)
+    return (jnp.exp(lp) * (lp - lq)).sum(-1)
+
+
+@register_kl(D.Exponential, D.Exponential)
+def _kl_exp_exp(p, q):
+    rp, rq = 1.0 / _p(p.scale), 1.0 / _p(q.scale)
+    return jnp.log(rp / rq) + rq / rp - 1.0
+
+
+@register_kl(D.Gamma, D.Gamma)
+def _kl_gamma_gamma(p, q):
+    ap, bp = _p(p.shape_param), _p(p.scale)
+    aq, bq = _p(q.shape_param), _p(q.scale)
+    return ((ap - aq) * jsp.digamma(ap) - jsp.gammaln(ap) + jsp.gammaln(aq)
+            + aq * (jnp.log(bq) - jnp.log(bp)) + ap * (bp / bq - 1.0))
+
+
+@register_kl(D.Uniform, D.Uniform)
+def _kl_unif_unif(p, q):
+    return jnp.log((_p(q.high) - _p(q.low)) / (_p(p.high) - _p(p.low)))

@@ -1,0 +1,54 @@
+"""StochasticBlock (reference
+``python/mxnet/gluon/probability/block/stochastic_block.py``).
+
+A HybridBlock whose forward can record auxiliary losses (e.g. KL terms of a
+VAE) via ``add_loss``; collected losses surface on ``.losses`` after the
+call."""
+from __future__ import annotations
+
+from typing import List
+
+from ..block import HybridBlock
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self._losses: List = []
+        self._collecting = False
+
+    def add_loss(self, loss):
+        """Record an auxiliary loss from inside forward (reference
+        StochasticBlock.add_loss)."""
+        self._losses.append(loss)
+
+    @property
+    def losses(self):
+        return self._losses
+
+    def __call__(self, *args, **kwargs):
+        self._losses = []
+        return super().__call__(*args, **kwargs)
+
+
+class StochasticSequential(StochasticBlock):
+    """Sequential container aggregating child losses (reference
+    StochasticSequential)."""
+
+    def __init__(self):
+        super().__init__()
+        self._layers: List = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._layers.append(b)
+            self.register_child(b, str(len(self._children)))
+
+    def forward(self, x):
+        for block in self._layers:
+            x = block(x)
+            if isinstance(block, StochasticBlock):
+                self._losses.extend(block.losses)
+        return x

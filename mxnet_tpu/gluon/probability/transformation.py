@@ -1,0 +1,137 @@
+"""Invertible transformations (reference
+``python/mxnet/gluon/probability/transformation/``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["Transformation", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "PowerTransform", "AbsTransform",
+           "SoftmaxTransform", "ComposeTransform"]
+
+
+class Transformation:
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _log_det_jacobian(self, x, y):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        from ...numpy.multiarray import apply_np
+
+        return apply_np(self._forward, type(self).__name__, (x,), {})
+
+    @property
+    def inv(self):
+        return _Inverse(self)
+
+
+class _Inverse(Transformation):
+    def __init__(self, t):
+        self._t = t
+
+    def _forward(self, x):
+        return self._t._inverse(x)
+
+    def _inverse(self, y):
+        return self._t._forward(y)
+
+    def _log_det_jacobian(self, x, y):
+        return -self._t._log_det_jacobian(y, x)
+
+
+class AffineTransform(Transformation):
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc = loc
+        self.scale = scale
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _log_det_jacobian(self, x, y):
+        return jnp.broadcast_to(jnp.log(jnp.abs(jnp.asarray(self.scale))),
+                                jnp.shape(x))
+
+
+class ExpTransform(Transformation):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _log_det_jacobian(self, x, y):
+        return x
+
+
+class SigmoidTransform(Transformation):
+    def _forward(self, x):
+        return 1 / (1 + jnp.exp(-x))
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _log_det_jacobian(self, x, y):
+        return jnp.log(y) + jnp.log1p(-y)
+
+
+class PowerTransform(Transformation):
+    def __init__(self, exponent):
+        self.exponent = exponent
+
+    def _forward(self, x):
+        return x ** self.exponent
+
+    def _inverse(self, y):
+        return y ** (1.0 / self.exponent)
+
+    def _log_det_jacobian(self, x, y):
+        return jnp.log(jnp.abs(self.exponent * y / x))
+
+
+class AbsTransform(Transformation):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+
+class SoftmaxTransform(Transformation):
+    def _forward(self, x):
+        import jax
+
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class ComposeTransform(Transformation):
+    def __init__(self, parts):
+        self.parts = list(parts)
+
+    def _forward(self, x):
+        for p in self.parts:
+            x = p._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for p in reversed(self.parts):
+            y = p._inverse(y)
+        return y
+
+    def _log_det_jacobian(self, x, y):
+        total = 0.0
+        cur = x
+        for p in self.parts:
+            nxt = p._forward(cur)
+            total = total + p._log_det_jacobian(cur, nxt)
+            cur = nxt
+        return total

@@ -164,7 +164,9 @@ class Trainer:
         scaler = getattr(self, "_amp_loss_scaler", None)
         if scaler is not None:
             # fp16 AMP: skip the update and shrink the scale on overflow
-            # (reference amp trainer patching + LossScaler policy)
+            # (reference amp trainer patching + LossScaler policy);
+            # amp.init_trainer rejects update_on_kvstore trainers, so the
+            # weights are untouched at this point
             overflow = scaler.has_overflow(
                 [p for p in self._params if p.grad_req != "null"])
             scaler.update_scale(overflow)

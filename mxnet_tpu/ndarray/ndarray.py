@@ -35,8 +35,11 @@ __all__ = ["NDArray", "invoke", "array", "_wrap", "_on_tape"]
 
 _float_types = (onp.float16, onp.float32, onp.float64, jnp.bfloat16)
 
-# installed by mx.amp.init(): fn(op_name, [jax arrays]) -> [jax arrays]
+# installed by mx.amp.init(): fn(op_name, [jax arrays]) -> [jax arrays];
+# _amp_generation bumps on every init/uninit so hybridized-graph caches
+# keyed on it retrace under the new policy
 _amp_policy = None
+_amp_generation = 0
 
 
 def _dtype_np(dtype) -> onp.dtype:

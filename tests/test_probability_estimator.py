@@ -1,0 +1,215 @@
+"""gluon.probability / estimator / contrib.text tests (reference
+tests/python/unittest/test_gluon_probability_v2.py, test_gluon_estimator.py,
+test_contrib_text.py)."""
+import collections
+import logging
+import os
+
+import numpy as onp
+import pytest
+from scipy import stats as sps
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, np
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon import probability as mgp
+
+
+def test_normal_logprob_matches_scipy():
+    d = mgp.Normal(loc=1.0, scale=2.0)
+    x = np.array([0.0, 1.0, 3.0])
+    onp.testing.assert_allclose(
+        d.log_prob(x).asnumpy(),
+        sps.norm(1.0, 2.0).logpdf([0.0, 1.0, 3.0]), rtol=1e-5)
+    onp.testing.assert_allclose(
+        d.cdf(x).asnumpy(), sps.norm(1.0, 2.0).cdf([0.0, 1.0, 3.0]),
+        rtol=1e-5)
+    assert float(d.entropy()) == pytest.approx(sps.norm(1.0, 2.0).entropy(),
+                                               rel=1e-5)
+
+
+@pytest.mark.parametrize("dist,scipy_dist,args", [
+    (mgp.Gamma(shape=2.0, scale=3.0), sps.gamma(2.0, scale=3.0), None),
+    (mgp.Beta(alpha=2.0, beta=5.0), sps.beta(2.0, 5.0), None),
+    (mgp.Exponential(scale=2.0), sps.expon(scale=2.0), None),
+    (mgp.Laplace(loc=0.5, scale=1.5), sps.laplace(0.5, 1.5), None),
+    (mgp.Gumbel(loc=0.5, scale=2.0), sps.gumbel_r(0.5, 2.0), None),
+    (mgp.Cauchy(loc=0.0, scale=1.0), sps.cauchy(0, 1), None),
+    (mgp.StudentT(df=5.0), sps.t(5.0), None),
+    (mgp.Pareto(alpha=3.0, scale=1.0), sps.pareto(3.0), None),
+    (mgp.Uniform(low=-1.0, high=2.0), sps.uniform(-1.0, 3.0), None),
+])
+def test_continuous_logprob_vs_scipy(dist, scipy_dist, args):
+    xs = onp.array([0.3, 0.6, 0.9], onp.float64)
+    onp.testing.assert_allclose(
+        dist.log_prob(np.array(xs.astype(onp.float32))).asnumpy(),
+        scipy_dist.logpdf(xs), rtol=2e-4, atol=1e-5)
+
+
+def test_discrete_logprob():
+    b = mgp.Bernoulli(prob=0.3)
+    onp.testing.assert_allclose(
+        b.log_prob(np.array([0.0, 1.0])).asnumpy(),
+        [onp.log(0.7), onp.log(0.3)], rtol=1e-5)
+    p = mgp.Poisson(rate=4.0)
+    onp.testing.assert_allclose(
+        p.log_prob(np.array([2.0, 5.0])).asnumpy(),
+        sps.poisson(4.0).logpmf([2, 5]), rtol=1e-5)
+    c = mgp.Categorical(prob=np.array([0.2, 0.3, 0.5]))
+    onp.testing.assert_allclose(
+        c.log_prob(np.array([2.0])).asnumpy(), [onp.log(0.5)], rtol=1e-5)
+    g = mgp.Geometric(prob=0.25)
+    onp.testing.assert_allclose(
+        g.log_prob(np.array([3.0])).asnumpy(),
+        sps.geom(0.25, loc=-1).logpmf([3]), rtol=1e-5)
+
+
+def test_sampling_moments():
+    mx.random.seed(7)
+    s = mgp.Normal(2.0, 3.0).sample((20000,))
+    assert abs(float(s.mean()) - 2.0) < 0.1
+    assert abs(float(s.std()) - 3.0) < 0.1
+    g = mgp.Gamma(shape=3.0, scale=2.0).sample((20000,))
+    assert abs(float(g.mean()) - 6.0) < 0.2
+    mvn = mgp.MultivariateNormal(
+        loc=np.array([1.0, -1.0]),
+        cov=np.array([[2.0, 0.5], [0.5, 1.0]]))
+    sm = mvn.sample((20000,))
+    assert sm.shape == (20000, 2)
+    onp.testing.assert_allclose(sm.asnumpy().mean(0), [1.0, -1.0],
+                                atol=0.07)
+    onp.testing.assert_allclose(onp.cov(sm.asnumpy().T),
+                                [[2.0, 0.5], [0.5, 1.0]], atol=0.1)
+
+
+def test_mvn_logprob_vs_scipy():
+    loc = onp.array([1.0, -1.0])
+    cov = onp.array([[2.0, 0.5], [0.5, 1.0]])
+    d = mgp.MultivariateNormal(loc=np.array(loc), cov=np.array(cov))
+    x = onp.array([[0.0, 0.0], [1.0, -1.0]])
+    onp.testing.assert_allclose(
+        d.log_prob(np.array(x.astype(onp.float32))).asnumpy(),
+        sps.multivariate_normal(loc, cov).logpdf(x), rtol=1e-4)
+
+
+def test_kl_divergence():
+    p = mgp.Normal(0.0, 1.0)
+    q = mgp.Normal(1.0, 2.0)
+    expected = onp.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    assert float(mgp.kl_divergence(p, q)) == pytest.approx(expected,
+                                                           rel=1e-5)
+    b1 = mgp.Bernoulli(prob=0.3)
+    b2 = mgp.Bernoulli(prob=0.5)
+    kl = float(mgp.kl_divergence(b1, b2))
+    assert kl == pytest.approx(
+        0.3 * onp.log(0.3 / 0.5) + 0.7 * onp.log(0.7 / 0.5), rel=1e-5)
+
+
+def test_transformed_distribution():
+    base = mgp.Normal(0.0, 1.0)
+    lognorm = mgp.TransformedDistribution(base, mgp.ExpTransform())
+    x = onp.array([0.5, 1.0, 2.0])
+    onp.testing.assert_allclose(
+        lognorm.log_prob(np.array(x.astype(onp.float32))).asnumpy(),
+        sps.lognorm(1.0).logpdf(x), rtol=1e-5)
+    mx.random.seed(3)
+    s = lognorm.sample((2000,))
+    assert float(s.min()) > 0
+
+
+def test_logprob_grad_flows():
+    mu = np.array([0.5])
+    mu.attach_grad()
+    x = np.array([1.0, 2.0, 3.0])
+    with mx.autograd.record():
+        lp = mgp.Normal(mu, 1.0).log_prob(x).sum()
+    lp.backward()
+    # d/dmu sum log N(x|mu,1) = sum(x - mu)
+    assert float(mu.grad.asnumpy()[0]) == pytest.approx(
+        float((x.asnumpy() - 0.5).sum()), rel=1e-5)
+
+
+def test_mixture_and_independent():
+    mix = mgp.MixtureSameFamily(
+        mgp.Categorical(logit=np.array([0.0, 0.0])),
+        mgp.Normal(np.array([-2.0, 2.0]), np.array([0.5, 0.5])))
+    lp = mix.log_prob(np.array([0.0]))
+    expect = onp.log(0.5 * sps.norm(-2, 0.5).pdf(0) +
+                     0.5 * sps.norm(2, 0.5).pdf(0))
+    assert float(lp.asnumpy()[0]) == pytest.approx(expect, rel=1e-4)
+
+    ind = mgp.Independent(mgp.Normal(np.zeros((3,)), np.ones((3,))), 1)
+    lp = ind.log_prob(np.zeros((4, 3)))
+    assert lp.shape == (4,)
+
+
+def test_stochastic_block_vae_style():
+    class Encoder(mgp.StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = nn.Dense(4)
+
+        def forward(self, x):
+            h = self.dense(x)
+            self.add_loss((h ** 2).mean())
+            return h
+
+    enc = Encoder()
+    enc.initialize()
+    out = enc(nd.ones((2, 3)))
+    assert len(enc.losses) == 1
+
+
+def test_estimator_fit(tmp_path, caplog):
+    from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                                   EarlyStoppingHandler,
+                                                   Estimator)
+    from mxnet_tpu.gluon import data as gdata
+    from mxnet_tpu import metric
+
+    rng = onp.random.RandomState(0)
+    X = rng.rand(64, 8).astype(onp.float32)
+    w = rng.rand(8, 1)
+    y = (X @ w).astype(onp.float32)
+    ds = gdata.ArrayDataset(X, y)
+    dl = gdata.DataLoader(ds, batch_size=16)
+    net = nn.Dense(1)
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 0.05})
+    est = Estimator(net, mx.gluon.loss.L2Loss(),
+                    train_metrics=metric.MAE(), trainer=tr)
+    ckpt = CheckpointHandler(str(tmp_path), save_best=True,
+                             monitor=est.train_loss_metric)
+    with caplog.at_level(logging.INFO):
+        est.fit(dl, epochs=10, event_handlers=[ckpt])
+    assert est.train_loss_metric.get()[1] < 0.05
+    assert os.path.exists(os.path.join(str(tmp_path),
+                                       "model-epoch10.params"))
+    res = est.evaluate(dl)
+    assert "val_loss" in res
+
+
+def test_vocab_and_embedding(tmp_path):
+    from mxnet_tpu.contrib import text
+
+    counter = text.count_tokens_from_str("a b b c c c\nd d d d")
+    vocab = text.Vocabulary(counter, min_freq=2,
+                            reserved_tokens=["<pad>"])
+    assert vocab.to_indices("d") > 0
+    assert vocab.to_indices("zebra") == 0  # unknown
+    assert vocab.to_tokens(vocab.to_indices(["b", "c"])) == ["b", "c"]
+    assert "<pad>" in vocab.reserved_tokens
+
+    emb_file = tmp_path / "emb.txt"
+    emb_file.write_text("hello 0.1 0.2 0.3\nworld 0.4 0.5 0.6\n")
+    emb = text.embedding.CustomEmbedding(str(emb_file))
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens("world")
+    onp.testing.assert_allclose(v.asnumpy(), [0.4, 0.5, 0.6], rtol=1e-6)
+    vs = emb.get_vecs_by_tokens(["hello", "unknowntok"])
+    assert vs.shape == (2, 3)
+    onp.testing.assert_allclose(vs.asnumpy()[1], [0, 0, 0])
+    emb.update_token_vectors("hello", nd.array([1.0, 1.0, 1.0]))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 1, 1])

@@ -91,11 +91,15 @@ def uninit():
 
 def init_trainer(trainer):
     """Attach the loss scaler to a Trainer (reference amp.init_trainer)."""
-    if getattr(trainer, "_update_on_kvstore", None):
+    cfg = getattr(trainer, "_kvstore_params", {})
+    if getattr(trainer, "_update_on_kvstore", None) or \
+            cfg.get("update_on_kvstore"):
         raise MXNetError(
             "AMP does not support update_on_kvstore=True: overflowed "
             "updates applied server-side cannot be skipped — create the "
             "Trainer with update_on_kvstore=False")
+    # resolve lazily-decided kvstore placement too (Trainer.step re-checks)
+    trainer._amp_forbid_update_on_kvstore = True
     if _STATE.target_dtype == jnp.float16 and _STATE.loss_scaler is None:
         _STATE.loss_scaler = LossScaler()
     trainer._amp_loss_scaler = _STATE.loss_scaler

@@ -192,11 +192,8 @@ class CompositeEmbedding(TokenEmbedding):
             token_embeddings = [token_embeddings]
         self._idx_to_token = list(vocabulary.idx_to_token)
         self._token_to_idx = dict(vocabulary.token_to_idx)
-        parts = []
-        for emb in token_embeddings:
-            parts.append(onp.vstack([
-                emb.get_vecs_by_tokens(t).asnumpy()
-                for t in self._idx_to_token]))
+        parts = [emb.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+                 for emb in token_embeddings]  # one vectorized lookup each
         self._idx_to_vec = onp.concatenate(parts, axis=1)
 
 

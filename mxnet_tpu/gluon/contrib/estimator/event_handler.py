@@ -142,7 +142,10 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
     LOG_PER_EPOCH = 1
     LOG_PER_BATCH = 2
 
-    def __init__(self, log_interval="epoch", metrics=None, priority=-3000):
+    def __init__(self, log_interval="epoch", metrics=None, priority=3000):
+        # sorts AFTER MetricHandler (-1000): logs must observe the current
+        # batch's metric update (reference: MetricHandler -inf, Logging
+        # +inf)
         self.metrics = metrics or []
         self.log_interval = log_interval
         self.priority = priority

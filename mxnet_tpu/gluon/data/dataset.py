@@ -128,19 +128,31 @@ class ArrayDataset(Dataset):
 
 class RecordFileDataset(Dataset):
     """Raw records from a .rec file (reference RecordFileDataset +
-    src/io/dataset.cc RecordFileDataset)."""
+    src/io/dataset.cc RecordFileDataset).  Uses the C++ reader when the
+    native library is available (no .idx needed; GIL-free batch IO)."""
 
     def __init__(self, filename: str):
-        from ...recordio import MXIndexedRecordIO
+        from ... import native
 
         self._filename = filename
-        idx_file = filename.rsplit(".", 1)[0] + ".idx"
-        self._record = MXIndexedRecordIO(idx_file, filename, "r")
+        self._native = None
+        self._record = None
+        if native.available():
+            self._native = native.NativeRecordReader(filename)
+        else:
+            from ...recordio import MXIndexedRecordIO
+
+            idx_file = filename.rsplit(".", 1)[0] + ".idx"
+            self._record = MXIndexedRecordIO(idx_file, filename, "r")
 
     def __len__(self):
+        if self._native is not None:
+            return len(self._native)
         return len(self._record.keys)
 
     def __getitem__(self, idx):
+        if self._native is not None:
+            return self._native.read(idx)
         return self._record.read_idx(self._record.keys[idx])
 
 

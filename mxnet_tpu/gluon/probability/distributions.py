@@ -387,8 +387,15 @@ class Beta(Distribution):
 
 class Chi2(Gamma):
     def __init__(self, df, **kwargs):
-        super().__init__(shape=_p(df) / 2.0, scale=2.0, **kwargs)
+        # bypass Gamma.__init__: shape_param is a property over self.df so
+        # gradients flow to an NDArray df through _with_params swapping
+        Distribution.__init__(self, **kwargs)
         self.df = df
+        self.scale = 2.0
+
+    @property
+    def shape_param(self):
+        return _p(self.df) / 2.0
 
 
 class StudentT(Distribution):
@@ -409,12 +416,15 @@ class StudentT(Distribution):
                 - (df + 1) / 2 * jnp.log1p(y ** 2 / df))
 
     def _mean(self):
-        df = _p(self.df)
-        return jnp.where(df > 1, jnp.broadcast_to(_p(self.loc),
-                                                  jnp.shape(df)), jnp.nan)
+        shp = _shape(None, self.df, self.loc, self.scale)
+        df = jnp.broadcast_to(_p(self.df), shp)
+        return jnp.where(df > 1, jnp.broadcast_to(_p(self.loc), shp),
+                         jnp.nan)
 
     def _variance(self):
-        df, scale = _p(self.df), _p(self.scale)
+        shp = _shape(None, self.df, self.loc, self.scale)
+        df = jnp.broadcast_to(_p(self.df), shp)
+        scale = jnp.broadcast_to(_p(self.scale), shp)
         return jnp.where(df > 2, scale ** 2 * df / (df - 2), jnp.nan)
 
 

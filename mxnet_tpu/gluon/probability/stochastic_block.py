@@ -32,6 +32,21 @@ class StochasticBlock(HybridBlock):
         self._losses = []
         return super().__call__(*args, **kwargs)
 
+    def hybridize(self, active=True, **kwargs):
+        """The stochastic wrapper itself stays eager — cached-graph replay
+        would skip ``forward`` and silently drop ``add_loss`` terms.
+        Children still compile (they trace inside any outer jit anyway)."""
+        if active:
+            import warnings
+
+            warnings.warn(
+                f"{type(self).__name__} runs eagerly: hybridizing would "
+                "drop add_loss() terms; child blocks are hybridized "
+                "instead")
+        super().hybridize(False, **kwargs)
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
 
 class StochasticSequential(StochasticBlock):
     """Sequential container aggregating child losses (reference

@@ -160,8 +160,16 @@ class Trainer:
         if self._params_to_init:
             self._init_params()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
         scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None and self._update_on_kvstore:
+            # must refuse BEFORE allreduce: with update_on_kvstore the
+            # reduce applies the (possibly overflowed) update server-side
+            from ..base import MXNetError
+
+            raise MXNetError(
+                "AMP loss scaling cannot skip server-side kvstore updates; "
+                "recreate the Trainer with update_on_kvstore=False")
+        self._allreduce_grads()
         if scaler is not None:
             # fp16 AMP: skip the update and shrink the scale on overflow
             # (reference amp trainer patching + LossScaler policy);

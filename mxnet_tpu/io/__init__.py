@@ -338,7 +338,16 @@ class ImageRecordIter(DataIter):
 
             self._pool = ThreadPoolExecutor(preprocess_threads)
 
-        if path_imgidx and os.path.exists(path_imgidx):
+        from .. import native
+
+        self._native = None
+        if native.available():
+            # C++ reader: native index scan + thread-safe record fetch
+            # (the reference's dmlc RecordIO reader, src/io/)
+            self._native = native.NativeRecordReader(path_imgrec)
+            keys = list(range(len(self._native)))
+            rec = None
+        elif path_imgidx and os.path.exists(path_imgidx):
             rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
             keys = rec.keys
         else:
@@ -370,6 +379,8 @@ class ImageRecordIter(DataIter):
             self._rng.shuffle(self._order)
 
     def _read_record(self, key):
+        if self._native is not None:
+            return self._native.read(key)  # internally synchronized
         with self._lock:
             if self._indexed:
                 raw = self._rec.read_idx(key)

@@ -1,0 +1,227 @@
+"""Native (C++) runtime components.
+
+The reference implements its engine/IO core in C++ (src/engine/, src/io/);
+this package does the same for the host-side runtime: a threaded dependency
+engine and a RecordIO reader, compiled once with g++ into a cached shared
+library and bound via ctypes (no pybind11 needed).  Everything degrades to
+pure-Python fallbacks when no compiler is available (``available()`` tells
+you which path is active).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src")
+_BUILD = os.path.join(_HERE, "build")
+_LIB_PATH = os.path.join(_BUILD, "libmxnet_tpu_native.so")
+
+_lib = None
+_lib_err: Optional[str] = None
+_build_lock = threading.Lock()
+
+
+def _sources():
+    return sorted(
+        os.path.join(_SRC, f) for f in os.listdir(_SRC)
+        if f.endswith(".cc"))
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > lib_mtime for s in _sources())
+
+
+def _build() -> str:
+    os.makedirs(_BUILD, exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", _LIB_PATH] + _sources()
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{proc.stderr}")
+    return _LIB_PATH
+
+
+def _load():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            if _needs_build():
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (OSError, RuntimeError, FileNotFoundError) as e:
+            _lib_err = str(e)
+            return None
+        # engine ABI
+        lib.EngineCreate.restype = ctypes.c_void_p
+        lib.EngineCreate.argtypes = [ctypes.c_int]
+        lib.EngineFree.argtypes = [ctypes.c_void_p]
+        lib.EngineNewVar.restype = ctypes.c_uint64
+        lib.EngineNewVar.argtypes = [ctypes.c_void_p]
+        lib.EngineVarVersion.restype = ctypes.c_uint64
+        lib.EngineVarVersion.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.EnginePushAsync.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        lib.EngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.EngineWaitForAll.argtypes = [ctypes.c_void_p]
+        # recordio ABI
+        lib.RecordIOOpen.restype = ctypes.c_void_p
+        lib.RecordIOOpen.argtypes = [ctypes.c_char_p]
+        lib.RecordIOClose.argtypes = [ctypes.c_void_p]
+        lib.RecordIONum.restype = ctypes.c_int64
+        lib.RecordIONum.argtypes = [ctypes.c_void_p]
+        lib.RecordIOSize.restype = ctypes.c_int64
+        lib.RecordIOSize.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.RecordIORead.restype = ctypes.c_int64
+        lib.RecordIORead.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_char_p, ctypes.c_int64]
+        lib.RecordIOReadBatch.restype = ctypes.c_int64
+        lib.RecordIOReadBatch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.RecordIOLastError.restype = ctypes.c_char_p
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the native library compiled and loaded."""
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _lib_err
+
+
+_CALLBACK_T = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class NativeEngine:
+    """ctypes front-end for the C++ dependency engine.
+
+    Push python callables with read (const) and write (mutable) var
+    dependencies; the engine runs them on its worker pool in dependency
+    order (many-readers/one-writer per var).  Mirrors
+    ``Engine::PushAsync/NewVariable/WaitForVar/WaitForAll``
+    (include/mxnet/engine.h:155-264).
+    """
+
+    def __init__(self, num_threads: int = 4):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native engine unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.EngineCreate(num_threads)
+        self._lock = threading.Lock()
+        self._inflight = {}  # keepalive: id -> (callback, token)
+        self._next_token = 0
+
+    def new_var(self) -> int:
+        return self._lib.EngineNewVar(self._h)
+
+    def var_version(self, var: int) -> int:
+        return self._lib.EngineVarVersion(self._h, var)
+
+    def push(self, fn, const_vars: Sequence[int] = (),
+             mutable_vars: Sequence[int] = ()):
+        """Schedule fn() after its dependencies clear.
+
+        The ctypes CFUNCTYPE thunk must stay referenced until its C call
+        fully returns; thunks accumulate in ``_inflight`` and are freed in
+        bulk by ``wait_for_all``/``close`` (after which the engine
+        guarantees every callback has returned at the C level) — freeing
+        from inside the trampoline would drop the libffi closure mid-call.
+        """
+        cb = _CALLBACK_T(lambda _arg, _fn=fn: _fn())
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            self._inflight[token] = cb
+        carr = (ctypes.c_uint64 * max(1, len(const_vars)))(*const_vars)
+        marr = (ctypes.c_uint64 * max(1, len(mutable_vars)))(*mutable_vars)
+        self._lib.EnginePushAsync(
+            self._h, ctypes.cast(cb, ctypes.c_void_p), None,
+            carr, len(const_vars), marr, len(mutable_vars))
+
+    def wait_for_var(self, var: int):
+        self._lib.EngineWaitForVar(self._h, var)
+
+    def wait_for_all(self):
+        self._lib.EngineWaitForAll(self._h)
+        with self._lock:  # all callbacks returned: thunks can be freed
+            self._inflight.clear()
+
+    def close(self):
+        if self._h is not None:
+            self.wait_for_all()
+            self._lib.EngineFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordReader:
+    """ctypes front-end for the C++ RecordIO reader (index scan + batch
+    fetch run natively with the GIL released by ctypes)."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native recordio unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.RecordIOOpen(path.encode())
+        if not self._h:
+            raise IOError(lib.RecordIOLastError().decode())
+
+    def __len__(self):
+        return self._lib.RecordIONum(self._h)
+
+    def read(self, idx: int) -> bytes:
+        size = self._lib.RecordIOSize(self._h, idx)
+        if size < 0:
+            raise IndexError(f"record {idx} out of range")
+        buf = ctypes.create_string_buffer(size)
+        got = self._lib.RecordIORead(self._h, idx, buf, size)
+        if got < 0:
+            raise IOError(self._lib.RecordIOLastError().decode())
+        return buf.raw[:got]
+
+    def read_batch(self, idxs: Sequence[int]) -> List[bytes]:
+        n = len(idxs)
+        total = sum(self._lib.RecordIOSize(self._h, i) for i in idxs)
+        buf = ctypes.create_string_buffer(max(1, total))
+        offs = (ctypes.c_int64 * (n + 1))()
+        iarr = (ctypes.c_int64 * n)(*idxs)
+        rc = self._lib.RecordIOReadBatch(self._h, iarr, n, buf, total, offs)
+        if rc != 0:
+            raise IOError(self._lib.RecordIOLastError().decode())
+        return [buf.raw[offs[i]:offs[i + 1]] for i in range(n)]
+
+    def close(self):
+        if self._h:
+            self._lib.RecordIOClose(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

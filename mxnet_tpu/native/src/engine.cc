@@ -1,0 +1,258 @@
+// Threaded dependency engine.
+//
+// TPU-native re-design of the reference engine
+// (src/engine/threaded_engine.{h,cc} + threaded_engine_perdevice.cc):
+// ops are pushed with const-vars (reads) and mutable-vars (writes); a var
+// is a FIFO of pending ops with the classic many-readers/one-writer
+// admission rule (ThreadedVar::AppendReadDependency /
+// AppendWriteDependency, threaded_engine.h:136-165).  Device compute needs
+// no engine on TPU (XLA's async stream orders it); this engine schedules
+// the HOST side — IO prefetch, decode, checkpoint writes — which is where
+// the reference used CPU worker pools.
+//
+// C ABI only (consumed via ctypes, no pybind11 dependency).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+typedef void (*OpFn)(void*);
+
+struct Op;
+
+// One engine variable: admission queue + running-state counters
+// (ThreadedVar analog).
+struct Var {
+  std::deque<std::pair<Op*, bool>> queue;  // (op, is_write)
+  int pending_reads = 0;    // running readers
+  bool write_running = false;
+  uint64_t version = 0;
+};
+
+struct Op {
+  OpFn fn;
+  void* arg;
+  std::vector<uint64_t> const_vars;
+  std::vector<uint64_t> mutable_vars;
+  std::atomic<int> wait{0};
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_threads) : shutdown_(false), pending_(0) {
+    if (num_threads < 1) num_threads = 1;
+    for (int i = 0; i < num_threads; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::unique_lock<std::mutex> lk(task_mu_);
+      shutdown_ = true;
+    }
+    task_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+    for (auto& kv : vars_) delete kv.second;
+  }
+
+  uint64_t NewVar() {
+    std::lock_guard<std::mutex> lk(var_mu_);
+    uint64_t id = next_var_++;
+    vars_[id] = new Var();
+    return id;
+  }
+
+  uint64_t VarVersion(uint64_t id) {
+    std::lock_guard<std::mutex> lk(var_mu_);
+    auto it = vars_.find(id);
+    return it == vars_.end() ? 0 : it->second->version;
+  }
+
+  void PushAsync(OpFn fn, void* arg, const uint64_t* cvars, int nc,
+                 const uint64_t* mvars, int nm) {
+    Op* op = new Op();
+    op->fn = fn;
+    op->arg = arg;
+    op->const_vars.assign(cvars, cvars + nc);
+    op->mutable_vars.assign(mvars, mvars + nm);
+    pending_.fetch_add(1);
+    // dependency setup under the var-table lock (the reference takes
+    // per-var locks; one table lock is plenty for a host-side engine)
+    int ready = 0;
+    {
+      std::lock_guard<std::mutex> lk(var_mu_);
+      op->wait.store(nc + nm + 1);  // +1 sentinel released below
+      for (int i = 0; i < nc; ++i) {
+        Var* v = vars_.at(cvars[i]);
+        if (v->queue.empty() && !v->write_running) {
+          v->pending_reads++;
+          ready++;
+        } else {
+          v->queue.emplace_back(op, false);
+        }
+      }
+      for (int i = 0; i < nm; ++i) {
+        Var* v = vars_.at(mvars[i]);
+        if (v->queue.empty() && !v->write_running &&
+            v->pending_reads == 0) {
+          v->write_running = true;
+          ready++;
+        } else {
+          v->queue.emplace_back(op, true);
+        }
+      }
+    }
+    // release sentinel + all immediately-granted deps
+    if (op->wait.fetch_sub(ready + 1) == ready + 1) Schedule(op);
+  }
+
+  void WaitForVar(uint64_t id) {
+    // push a no-op read on the var and wait for it (reference
+    // ThreadedEngine::WaitForVar, threaded_engine.cc:379)
+    std::mutex m;
+    std::condition_variable cv;
+    bool done = false;
+    struct Ctx {
+      std::mutex* m;
+      std::condition_variable* cv;
+      bool* done;
+    } ctx{&m, &cv, &done};
+    PushAsync(
+        [](void* p) {
+          Ctx* c = static_cast<Ctx*>(p);
+          std::lock_guard<std::mutex> lk(*c->m);
+          *c->done = true;
+          c->cv->notify_all();
+        },
+        &ctx, &id, 1, nullptr, 0);
+    std::unique_lock<std::mutex> lk(m);
+    cv.wait(lk, [&] { return done; });
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(finish_mu_);
+    finish_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  }
+
+ private:
+  void Schedule(Op* op) {
+    {
+      std::unique_lock<std::mutex> lk(task_mu_);
+      tasks_.push(op);
+    }
+    task_cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Op* op;
+      {
+        std::unique_lock<std::mutex> lk(task_mu_);
+        task_cv_.wait(lk, [this] { return shutdown_ || !tasks_.empty(); });
+        if (shutdown_ && tasks_.empty()) return;
+        op = tasks_.front();
+        tasks_.pop();
+      }
+      op->fn(op->arg);
+      OnComplete(op);
+    }
+  }
+
+  // release deps, admit now-ready ops (ThreadedEngine::OnComplete analog,
+  // threaded_engine.cc:441)
+  void OnComplete(Op* op) {
+    std::vector<Op*> now_ready;
+    {
+      std::lock_guard<std::mutex> lk(var_mu_);
+      for (uint64_t id : op->const_vars) {
+        Var* v = vars_.at(id);
+        v->pending_reads--;
+        if (v->pending_reads == 0 && !v->queue.empty() &&
+            v->queue.front().second) {
+          Op* w = v->queue.front().first;
+          v->queue.pop_front();
+          v->write_running = true;
+          if (w->wait.fetch_sub(1) == 1) now_ready.push_back(w);
+        }
+      }
+      for (uint64_t id : op->mutable_vars) {
+        Var* v = vars_.at(id);
+        v->write_running = false;
+        v->version++;
+        // admit a leading run of reads, or a single write
+        while (!v->queue.empty() && !v->queue.front().second) {
+          Op* r = v->queue.front().first;
+          v->queue.pop_front();
+          v->pending_reads++;
+          if (r->wait.fetch_sub(1) == 1) now_ready.push_back(r);
+        }
+        if (v->pending_reads == 0 && !v->queue.empty() &&
+            v->queue.front().second) {
+          Op* w = v->queue.front().first;
+          v->queue.pop_front();
+          v->write_running = true;
+          if (w->wait.fetch_sub(1) == 1) now_ready.push_back(w);
+        }
+      }
+    }
+    delete op;
+    for (Op* r : now_ready) Schedule(r);
+    if (pending_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(finish_mu_);
+      finish_cv_.notify_all();
+    }
+  }
+
+  std::unordered_map<uint64_t, Var*> vars_;
+  uint64_t next_var_ = 1;
+  std::mutex var_mu_;
+
+  std::queue<Op*> tasks_;
+  std::mutex task_mu_;
+  std::condition_variable task_cv_;
+  std::vector<std::thread> workers_;
+  bool shutdown_;
+
+  std::atomic<int64_t> pending_;
+  std::mutex finish_mu_;
+  std::condition_variable finish_cv_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* EngineCreate(int num_threads) { return new Engine(num_threads); }
+
+void EngineFree(void* e) { delete static_cast<Engine*>(e); }
+
+uint64_t EngineNewVar(void* e) { return static_cast<Engine*>(e)->NewVar(); }
+
+uint64_t EngineVarVersion(void* e, uint64_t v) {
+  return static_cast<Engine*>(e)->VarVersion(v);
+}
+
+void EnginePushAsync(void* e, void (*fn)(void*), void* arg,
+                     const uint64_t* cvars, int nc, const uint64_t* mvars,
+                     int nm) {
+  static_cast<Engine*>(e)->PushAsync(fn, arg, cvars, nc, mvars, nm);
+}
+
+void EngineWaitForVar(void* e, uint64_t v) {
+  static_cast<Engine*>(e)->WaitForVar(v);
+}
+
+void EngineWaitForAll(void* e) { static_cast<Engine*>(e)->WaitForAll(); }
+
+}  // extern "C"

@@ -107,14 +107,14 @@ def _emit(name, cat, ph, ts=None, dur=None, args=None):
 def dumps(reset=False, format="table") -> str:
     """Aggregate stats of recorded durations (reference DumpAggregate);
     ``format`` is 'table' or 'json'."""
+    if format not in ("table", "json"):  # validate before touching events
+        raise ValueError("format must be 'table' or 'json'")
     with _LOCK:
         events = list(_EVENTS)
         if reset:
             _EVENTS.clear()
     if format == "json":
         return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
-    if format != "table":
-        raise ValueError("format must be 'table' or 'json'")
     agg: Dict[str, List[float]] = defaultdict(list)
     for ev in events:
         if ev["ph"] == "X":

@@ -21,6 +21,52 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 V100_RESNET50_TRAIN_IMGS_PER_SEC = 298.51  # reference perf.md:252, bs32 fp32
 
 
+V100_BERT_BASE_TOKENS_PER_SEC = 11500.0  # fp16 V100 BERT-base pretrain
+# (~90 seq/s at seq 128, public MLPerf-era single-V100 numbers)
+
+
+def bench_bert():
+    """BERT-base masked-LM pretrain step throughput (tokens/s/chip) on the
+    flagship transformer with pallas flash attention."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from mxnet_tpu import models
+    from mxnet_tpu import parallel as par
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    cfg = models.TransformerLMConfig(dtype=jnp.bfloat16)
+    params = models.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = par.make_mesh({"dp": 1})
+    with mesh:
+        m, v = models.init_opt_state(params)
+        step = models.make_train_step(cfg, mesh, optimizer="adam", lr=1e-4)
+        rng = onp.random.RandomState(0)
+        toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                           jnp.int32)
+        params, m, v, loss = step(params, m, v, toks, toks,
+                                  jnp.float32(1))  # compile
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, m, v, loss = step(params, m, v, toks, toks,
+                                      jnp.float32(1))
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    tokens_per_sec = batch * seq * steps / dt
+    print(json.dumps({
+        "metric": "bert_base_train_throughput_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / V100_BERT_BASE_TOKENS_PER_SEC,
+                             3),
+    }))
+
+
 def main():
     import numpy as onp
 
@@ -29,6 +75,8 @@ def main():
     from mxnet_tpu.gluon.model_zoo import vision
 
     model_name = os.environ.get("BENCH_MODEL", "resnet50_v1")
+    if model_name == "bert":
+        return bench_bert()
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     img = int(os.environ.get("BENCH_IMG", "224"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))

@@ -98,8 +98,8 @@ def init_trainer(trainer):
             "AMP does not support update_on_kvstore=True: overflowed "
             "updates applied server-side cannot be skipped — create the "
             "Trainer with update_on_kvstore=False")
-    # resolve lazily-decided kvstore placement too (Trainer.step re-checks)
-    trainer._amp_forbid_update_on_kvstore = True
+    # lazily-resolved kvstore placement is re-checked in Trainer.step
+    # (scaler present + _update_on_kvstore -> MXNetError before allreduce)
     if _STATE.target_dtype == jnp.float16 and _STATE.loss_scaler is None:
         _STATE.loss_scaler = LossScaler()
     trainer._amp_loss_scaler = _STATE.loss_scaler

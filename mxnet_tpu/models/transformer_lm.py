@@ -55,6 +55,9 @@ class TransformerLMConfig:
     # parallel toggles (consumed by make_train_step)
     use_ring_attention: bool = False
     remat: bool = False              # jax.checkpoint each layer
+    # None = auto (pallas flash attention on TPU, XLA einsum elsewhere);
+    # True/False force the choice (True on CPU uses the slow interpreter)
+    use_flash_attention: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -126,10 +129,25 @@ def _attention(x, p, pre, cfg: TransformerLMConfig, mesh: Optional[Mesh]):
             q, k, v, mesh, axis_name="sp",
             batch_axes=("dp", "fsdp"))
     else:
-        scale = 1.0 / math.sqrt(hd)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1),
-                         v.astype(jnp.float32)).astype(x.dtype)
+        use_flash = cfg.use_flash_attention
+        if use_flash is None:
+            # auto mode: single-device only — pallas_call has no SPMD
+            # partitioning rule, so under a >1-device mesh the einsum path
+            # keeps tp/sp shardings intact (flash-under-shard_map is the
+            # future fix); explicit True overrides
+            multi = mesh is not None and any(
+                s > 1 for s in mesh.shape.values())
+            use_flash = jax.default_backend() == "tpu" and not multi
+        if use_flash and S % 8 == 0 and hd % 8 == 0:
+            from ..ops.pallas_kernels import flash_attention
+
+            out = flash_attention(q, k, v, causal=False).astype(x.dtype)
+        else:
+            scale = 1.0 / math.sqrt(hd)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+                jnp.float32) * scale
+            out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1),
+                             v.astype(jnp.float32)).astype(x.dtype)
     out = jnp.moveaxis(out, 1, 2).reshape(B, S, H)
     return out @ p[pre + "attn.out_proj.weight"].T + p[pre + "attn.out_proj.bias"]
 

@@ -40,11 +40,16 @@ def _needs_build() -> bool:
 
 def _build() -> str:
     os.makedirs(_BUILD, exist_ok=True)
+    # build to a per-process temp then rename: atomic for concurrent
+    # builders (forked workers, pytest-xdist) and never truncates an ELF a
+    # live process already dlopen'd
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           "-o", _LIB_PATH] + _sources()
+           "-o", tmp] + _sources()
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(f"native build failed:\n{proc.stderr}")
+    os.replace(tmp, _LIB_PATH)
     return _LIB_PATH
 
 
@@ -70,6 +75,7 @@ def _load():
         lib.EngineNewVar.argtypes = [ctypes.c_void_p]
         lib.EngineVarVersion.restype = ctypes.c_uint64
         lib.EngineVarVersion.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.EnginePushAsync.restype = ctypes.c_int
         lib.EnginePushAsync.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
@@ -147,22 +153,29 @@ class NativeEngine:
         from inside the trampoline would drop the libffi closure mid-call.
         """
         cb = _CALLBACK_T(lambda _arg, _fn=fn: _fn())
+        carr = (ctypes.c_uint64 * max(1, len(const_vars)))(*const_vars)
+        marr = (ctypes.c_uint64 * max(1, len(mutable_vars)))(*mutable_vars)
+        # registration + submit under one lock so a concurrent
+        # wait_for_all can never clear a thunk whose op is not yet pending
         with self._lock:
             token = self._next_token
             self._next_token += 1
             self._inflight[token] = cb
-        carr = (ctypes.c_uint64 * max(1, len(const_vars)))(*const_vars)
-        marr = (ctypes.c_uint64 * max(1, len(mutable_vars)))(*mutable_vars)
-        self._lib.EnginePushAsync(
-            self._h, ctypes.cast(cb, ctypes.c_void_p), None,
-            carr, len(const_vars), marr, len(mutable_vars))
+            rc = self._lib.EnginePushAsync(
+                self._h, ctypes.cast(cb, ctypes.c_void_p), None,
+                carr, len(const_vars), marr, len(mutable_vars))
+            if rc != 0:
+                self._inflight.pop(token, None)
+                raise ValueError(
+                    "push: unknown engine var id (use new_var())")
 
     def wait_for_var(self, var: int):
         self._lib.EngineWaitForVar(self._h, var)
 
     def wait_for_all(self):
-        self._lib.EngineWaitForAll(self._h)
-        with self._lock:  # all callbacks returned: thunks can be freed
+        with self._lock:
+            self._lib.EngineWaitForAll(self._h)
+            # all callbacks returned at the C level: thunks can be freed
             self._inflight.clear()
 
     def close(self):

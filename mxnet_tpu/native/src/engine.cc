@@ -79,8 +79,17 @@ class Engine {
     return it == vars_.end() ? 0 : it->second->version;
   }
 
-  void PushAsync(OpFn fn, void* arg, const uint64_t* cvars, int nc,
-                 const uint64_t* mvars, int nm) {
+  // returns 0 on success, -1 if any var id is unknown (no exception may
+  // cross the extern "C" boundary — it would std::terminate the process)
+  int PushAsync(OpFn fn, void* arg, const uint64_t* cvars, int nc,
+                const uint64_t* mvars, int nm) {
+    {
+      std::lock_guard<std::mutex> lk(var_mu_);
+      for (int i = 0; i < nc; ++i)
+        if (vars_.find(cvars[i]) == vars_.end()) return -1;
+      for (int i = 0; i < nm; ++i)
+        if (vars_.find(mvars[i]) == vars_.end()) return -1;
+    }
     Op* op = new Op();
     op->fn = fn;
     op->arg = arg;
@@ -115,11 +124,17 @@ class Engine {
     }
     // release sentinel + all immediately-granted deps
     if (op->wait.fetch_sub(ready + 1) == ready + 1) Schedule(op);
+    return 0;
   }
 
   void WaitForVar(uint64_t id) {
     // push a no-op read on the var and wait for it (reference
-    // ThreadedEngine::WaitForVar, threaded_engine.cc:379)
+    // ThreadedEngine::WaitForVar, threaded_engine.cc:379); unknown ids
+    // are a no-op (PushAsync below rejects them)
+    {
+      std::lock_guard<std::mutex> lk(var_mu_);
+      if (vars_.find(id) == vars_.end()) return;
+    }
     std::mutex m;
     std::condition_variable cv;
     bool done = false;
@@ -243,10 +258,10 @@ uint64_t EngineVarVersion(void* e, uint64_t v) {
   return static_cast<Engine*>(e)->VarVersion(v);
 }
 
-void EnginePushAsync(void* e, void (*fn)(void*), void* arg,
-                     const uint64_t* cvars, int nc, const uint64_t* mvars,
-                     int nm) {
-  static_cast<Engine*>(e)->PushAsync(fn, arg, cvars, nc, mvars, nm);
+int EnginePushAsync(void* e, void (*fn)(void*), void* arg,
+                    const uint64_t* cvars, int nc, const uint64_t* mvars,
+                    int nm) {
+  return static_cast<Engine*>(e)->PushAsync(fn, arg, cvars, nc, mvars, nm);
 }
 
 void EngineWaitForVar(void* e, uint64_t v) {

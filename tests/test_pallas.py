@@ -1,0 +1,102 @@
+"""Pallas flash-attention kernel tests (interpret mode on CPU — same code
+path as TPU hardware)."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+from mxnet_tpu.ops.pallas_kernels import flash_attention
+
+
+def _dense_attention(q, k, v, causal, sm_scale):
+    s = jnp.einsum("bqd,bkd->bqk", q, k) * sm_scale
+    if causal:
+        S = q.shape[1]
+        mask = onp.tril(onp.ones((S, S), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq,d", [(64, 16), (128, 32)])
+def test_flash_forward_matches_dense(causal, seq, d):
+    rng = onp.random.RandomState(0)
+    q = jnp.asarray(rng.randn(3, seq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(3, seq, d), jnp.float32)
+    v = jnp.asarray(rng.randn(3, seq, d), jnp.float32)
+    sm_scale = 1.0 / d ** 0.5
+    out = flash_attention(q, k, v, causal=causal)
+    ref = _dense_attention(q, k, v, causal, sm_scale)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_dense(causal):
+    rng = onp.random.RandomState(1)
+    seq, d = 64, 16
+    q = jnp.asarray(rng.randn(2, seq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(2, seq, d), jnp.float32)
+    v = jnp.asarray(rng.randn(2, seq, d), jnp.float32)
+    sm_scale = 1.0 / d ** 0.5
+    tgt = jnp.asarray(rng.randn(2, seq, d), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return ((flash_attention(q, k, v, causal=causal) - tgt) ** 2).mean()
+
+    def loss_dense(q, k, v):
+        return ((_dense_attention(q, k, v, causal, sm_scale) - tgt)
+                ** 2).mean()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=5e-3, atol=1e-4,
+                                    err_msg=f"d{name} mismatch")
+
+
+def test_flash_4d_heads_and_jit():
+    rng = onp.random.RandomState(2)
+    q = jnp.asarray(rng.randn(2, 4, 32, 16), jnp.float32)  # B,H,S,D
+    k = jnp.asarray(rng.randn(2, 4, 32, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 4, 32, 16), jnp.float32)
+    out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=True))(
+        q, k, v)
+    assert out.shape == (2, 4, 32, 16)
+    ref = _dense_attention(q.reshape(8, 32, 16), k.reshape(8, 32, 16),
+                           v.reshape(8, 32, 16), True, 1 / 4.0)
+    onp.testing.assert_allclose(onp.asarray(out).reshape(8, 32, 16),
+                                onp.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_bf16():
+    rng = onp.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 64, 32), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(2, 64, 32), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(2, 64, 32), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=False)
+    assert out.dtype == jnp.bfloat16
+    ref = _dense_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                           v.astype(jnp.float32), False, 1 / 32 ** 0.5)
+    onp.testing.assert_allclose(onp.asarray(out, onp.float32),
+                                onp.asarray(ref), rtol=3e-2, atol=3e-2)
+
+
+def test_transformer_uses_flash_when_forced():
+    from mxnet_tpu import models
+
+    cfg = models.TransformerLMConfig(
+        vocab_size=128, num_layers=1, num_heads=2, hidden=32, mlp_hidden=64,
+        max_len=32, dtype=jnp.float32, use_flash_attention=True)
+    cfg_ref = models.TransformerLMConfig(
+        vocab_size=128, num_layers=1, num_heads=2, hidden=32, mlp_hidden=64,
+        max_len=32, dtype=jnp.float32, use_flash_attention=False)
+    params = models.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jnp.asarray(onp.random.RandomState(0).randint(0, 128, (2, 16)),
+                       jnp.int32)
+    out_flash, _ = models.forward(params, toks, cfg)
+    out_ref, _ = models.forward(params, toks, cfg_ref)
+    onp.testing.assert_allclose(onp.asarray(out_flash),
+                                onp.asarray(out_ref), rtol=2e-4, atol=2e-4)

@@ -1,0 +1,71 @@
+"""KVStore server bootstrap (reference
+``python/mxnet/kvstore/kvstore_server.py``).
+
+The reference spawns dedicated parameter-server/scheduler processes
+(ps-lite): ``DMLC_ROLE=server`` processes enter ``KVStoreServer.run()``.
+TPU-native distributed training has NO parameter servers — gradients ride
+ICI/DCN all-reduce collectives inside the compiled step — so the roles
+collapse: every process is a worker (multi-controller JAX).  This module
+keeps the bootstrap contract: role-driven entry that (a) initializes the
+jax.distributed runtime from the launcher-provided env and (b) for
+'server'/'scheduler' roles parks the process (ps-lite parity for scripts
+that spawn them), so ``tools/launch.py`` jobs written against the
+reference's flow run unchanged.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ["KVStoreServer", "init_distributed", "role"]
+
+
+def role() -> str:
+    return os.environ.get("DMLC_ROLE", os.environ.get("MXNET_ROLE",
+                                                      "worker"))
+
+
+def init_distributed() -> bool:
+    """Initialize jax.distributed from launcher env (idempotent).
+
+    Env contract (set by tools/launch.py):
+      MXNET_TPU_COORDINATOR  host:port of process 0
+      MXNET_TPU_NUM_PROCS    world size
+      MXNET_TPU_PROC_ID      this process' rank
+    """
+    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    if not coord:
+        return False
+    import jax
+
+    if getattr(init_distributed, "_done", False):
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["MXNET_TPU_NUM_PROCS"]),
+        process_id=int(os.environ["MXNET_TPU_PROC_ID"]))
+    init_distributed._done = True
+    return True
+
+
+class KVStoreServer:
+    """Role shim (reference KVStoreServer.run listening loop).
+
+    Collectives replace server-side aggregation on TPU; a 'server' role
+    process simply parks until the job ends so launch scripts that spawn
+    scheduler/server roles keep working."""
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+
+    def run(self):
+        r = role()
+        if r == "worker":
+            raise RuntimeError("KVStoreServer.run() called in a worker "
+                               "process")
+        # park: reference servers block in the ps-lite event loop
+        stop_file = os.environ.get("MXNET_TPU_STOP_FILE")
+        while True:
+            if stop_file and os.path.exists(stop_file):
+                return
+            time.sleep(0.2)

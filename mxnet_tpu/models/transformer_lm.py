@@ -138,7 +138,12 @@ def _attention(x, p, pre, cfg: TransformerLMConfig, mesh: Optional[Mesh]):
             multi = mesh is not None and any(
                 s > 1 for s in mesh.shape.values())
             use_flash = jax.default_backend() == "tpu" and not multi
-        if use_flash and S % 8 == 0 and hd % 8 == 0:
+        aligned = S % 8 == 0 and hd % 8 == 0
+        if cfg.use_flash_attention is True and not aligned:
+            raise ValueError(
+                f"use_flash_attention=True requires seq ({S}) and head_dim "
+                f"({hd}) divisible by 8 (TPU tiling)")
+        if use_flash and aligned:
             from ..ops.pallas_kernels import flash_attention
 
             out = flash_attention(q, k, v, causal=False).astype(x.dtype)

@@ -173,10 +173,18 @@ class NativeEngine:
         self._lib.EngineWaitForVar(self._h, var)
 
     def wait_for_all(self):
+        # snapshot OUTSIDE the blocking wait: holding the lock across
+        # EngineWaitForAll would deadlock a callback that push()es a
+        # follow-up op; freeing only the snapshotted tokens keeps thunks
+        # registered by concurrent pushes alive
         with self._lock:
-            self._lib.EngineWaitForAll(self._h)
-            # all callbacks returned at the C level: thunks can be freed
-            self._inflight.clear()
+            tokens = list(self._inflight)
+        self._lib.EngineWaitForAll(self._h)
+        # ops behind the snapshot have completed; their callbacks returned
+        # at the C level, so those thunks can be freed
+        with self._lock:
+            for t in tokens:
+                self._inflight.pop(t, None)
 
     def close(self):
         if self._h is not None:

@@ -13,6 +13,7 @@ import numpy as _onp
 
 from ..context import Context, cpu, current_context
 from ..ops import registry as _registry
+from . import sparse
 from . import utils
 from .ndarray import NDArray, array, invoke
 from .register import make_op_func

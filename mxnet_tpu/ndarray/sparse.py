@@ -1,0 +1,260 @@
+"""Sparse NDArray storage types: row_sparse and csr.
+
+Reference analog: ``include/mxnet/ndarray.h:63-82`` storage types +
+``python/mxnet/ndarray/sparse.py``.  SURVEY.md §7 scopes TPU sparse to what
+is load-bearing: **row_sparse embedding gradients** (large vocab, few rows
+touched per step) and their optimizer updates.  Design: a RowSparseNDArray
+keeps (indices, values) host-free on device; `sparse update` ops apply via
+``at[].add`` scatters which XLA lowers to efficient dynamic-update-slices —
+no giant dense gradient materializes.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .ndarray import NDArray, _wrap
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "zeros"]
+
+
+class BaseSparseNDArray:
+    """Common surface mirrored from the reference sparse arrays."""
+
+    shape: Tuple[int, ...]
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {'x'.join(map(str, self.shape))} "
+                f"@{self._ctx}>")
+
+    def wait_to_read(self):
+        pass
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows at ``indices`` hold ``data``; all other rows are zero
+    (reference kRowSparseStorage)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, ctx: Optional[Context] = None):
+        self._ctx = ctx or current_context()
+        self.data = data if isinstance(data, jax.Array) else jnp.asarray(data)
+        self.indices = (indices if isinstance(indices, jax.Array)
+                        else jnp.asarray(indices, jnp.int32))
+        self.shape = tuple(shape)
+        if self.data.shape[0] != self.indices.shape[0]:
+            raise MXNetError("data and indices row counts differ")
+
+    @property
+    def dtype(self):
+        return onp.dtype(self.data.dtype)
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    def asnumpy(self) -> onp.ndarray:
+        out = onp.zeros(self.shape, self.dtype)
+        # duplicate indices accumulate, like the reference's kAddTo merge
+        onp.add.at(out, onp.asarray(self.indices), onp.asarray(self.data))
+        return out
+
+    def tostype(self, stype: str):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            dense = jnp.zeros(self.shape, self.data.dtype)
+            dense = dense.at[self.indices].add(self.data)
+            return _wrap(dense, self._ctx)
+        raise MXNetError(f"cannot convert row_sparse to {stype}")
+
+    def todense(self) -> NDArray:
+        return self.tostype("default")
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other.data = self.data
+            other.indices = self.indices
+            return other
+        return self.todense().copyto(other)
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        """Keep only the requested rows (reference sparse.retain — the
+        row_sparse_pull building block)."""
+        row_ids = jnp.asarray(
+            row_ids._data if isinstance(row_ids, NDArray) else row_ids,
+            jnp.int32)
+        # dense lookup per requested id (ids is small)
+        dense = jnp.zeros((self.shape[0],) + self.data.shape[1:],
+                          self.data.dtype).at[self.indices].add(self.data)
+        return RowSparseNDArray(dense[row_ids], row_ids, self.shape,
+                                self._ctx)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return RowSparseNDArray(
+                jnp.concatenate([self.data, other.data]),
+                jnp.concatenate([self.indices, other.indices]),
+                self.shape, self._ctx)
+        raise TypeError("row_sparse + dense: densify first via tostype")
+
+    def compact(self) -> "RowSparseNDArray":
+        """Merge duplicate indices (sorted unique rows)."""
+        uniq, inv = jnp.unique(self.indices, return_inverse=True,
+                               size=self.indices.shape[0],
+                               fill_value=self.shape[0])
+        summed = jnp.zeros((uniq.shape[0],) + self.data.shape[1:],
+                           self.data.dtype).at[inv].add(self.data)
+        keep = uniq < self.shape[0]
+        return RowSparseNDArray(summed[keep], uniq[keep], self.shape,
+                                self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference kCSRStorage)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape,
+                 ctx: Optional[Context] = None):
+        self._ctx = ctx or current_context()
+        self.data = jnp.asarray(data)
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.indptr = jnp.asarray(indptr, jnp.int32)
+        self.shape = tuple(shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self.data.dtype)
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    def asnumpy(self) -> onp.ndarray:
+        out = onp.zeros(self.shape, self.dtype)
+        indptr = onp.asarray(self.indptr)
+        indices = onp.asarray(self.indices)
+        data = onp.asarray(self.data)
+        for i in range(self.shape[0]):
+            sl = slice(indptr[i], indptr[i + 1])
+            out[i, indices[sl]] = data[sl]
+        return out
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return _wrap(jnp.asarray(self.asnumpy()), self._ctx)
+        raise MXNetError(f"cannot convert csr to {stype}")
+
+    def todense(self):
+        return self.tostype("default")
+
+    def dot(self, dense: NDArray) -> NDArray:
+        """csr @ dense via segment-sum (XLA-friendly SpMV/SpMM)."""
+        d = dense._data if isinstance(dense, NDArray) else jnp.asarray(dense)
+        # row id per nonzero from indptr
+        nnz = self.data.shape[0]
+        row_ids = jnp.searchsorted(self.indptr[1:], jnp.arange(nnz),
+                                   side="right").astype(jnp.int32)
+        contrib = self.data[:, None] * d[self.indices]
+        out = jax.ops.segment_sum(contrib, row_ids,
+                                  num_segments=self.shape[0])
+        return _wrap(out.astype(d.dtype), self._ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or a dense source
+    (reference sparse.row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = jnp.asarray(data, dtype)
+        return RowSparseNDArray(data, jnp.asarray(indices, jnp.int32),
+                                shape, ctx)
+    dense = onp.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                        else arg1, dtype)
+    nz_rows = onp.where(onp.any(dense != 0, axis=tuple(
+        range(1, dense.ndim))))[0]
+    return RowSparseNDArray(jnp.asarray(dense[nz_rows]),
+                            jnp.asarray(nz_rows, jnp.int32),
+                            shape or dense.shape, ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (reference sparse.csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(jnp.asarray(data, dtype), indices, indptr, shape,
+                          ctx)
+    dense = onp.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                        else arg1, dtype)
+    indptr = [0]
+    indices = []
+    data = []
+    for row in dense:
+        nz = onp.nonzero(row)[0]
+        indices.extend(nz.tolist())
+        data.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(onp.asarray(data, dense.dtype), indices, indptr,
+                      shape or dense.shape, ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = dtype or onp.float32
+    if stype == "row_sparse":
+        ncol = shape[1:] if len(shape) > 1 else ()
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(ncol), dtype),
+                                jnp.zeros((0,), jnp.int32), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), [], [0] * (shape[0] + 1),
+                          shape, ctx)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+# ---------------------------------------------------------------------------
+# sparse optimizer updates (reference optimizer_op.cc sparse variants):
+# touch ONLY the gradient's rows — the XLA scatter path
+# ---------------------------------------------------------------------------
+
+
+def sgd_update(weight: NDArray, grad: RowSparseNDArray, lr, wd=0.0,
+               rescale_grad=1.0):
+    g = grad.compact()
+    rows = weight._data[g.indices]
+    upd = rows - lr * (rescale_grad * g.data + wd * rows)
+    weight._set_data(weight._data.at[g.indices].set(upd))
+    return weight
+
+
+def adam_update(weight: NDArray, grad: RowSparseNDArray, mean: NDArray,
+                var: NDArray, lr, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                wd=0.0, rescale_grad=1.0, lazy_update=True):
+    """Lazy adam: moments update only on touched rows (reference
+    adam_update w/ lazy_update for row_sparse grads)."""
+    g = grad.compact()
+    idx = g.indices
+    gd = rescale_grad * g.data + wd * weight._data[idx]
+    m_rows = beta1 * mean._data[idx] + (1 - beta1) * gd
+    v_rows = beta2 * var._data[idx] + (1 - beta2) * gd * gd
+    mean._set_data(mean._data.at[idx].set(m_rows))
+    var._set_data(var._data.at[idx].set(v_rows))
+    upd = weight._data[idx] - lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    weight._set_data(weight._data.at[idx].set(upd))
+    return weight

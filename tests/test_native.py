@@ -138,6 +138,32 @@ def test_native_recordio_multipart(tmp_path):
     r.close()
 
 
+def test_engine_push_from_callback_no_deadlock():
+    """An op callback may chain a follow-up push while another thread sits
+    in wait_for_all."""
+    eng = native.NativeEngine(num_threads=2)
+    var = eng.new_var()
+    log = []
+
+    def first():
+        log.append("first")
+        eng.push(lambda: log.append("chained"), mutable_vars=[var])
+
+    eng.push(first, mutable_vars=[var])
+    eng.wait_for_all()
+    eng.wait_for_all()  # second wait drains the chained op if needed
+    assert log == ["first", "chained"]
+    eng.close()
+
+
+def test_engine_invalid_var_raises():
+    eng = native.NativeEngine(num_threads=1)
+    with pytest.raises(ValueError):
+        eng.push(lambda: None, mutable_vars=[999999])
+    eng.wait_for_all()
+    eng.close()
+
+
 def test_engine_throughput_vs_serial(tmp_path):
     """Engine-scheduled independent IO beats serial execution."""
     eng = native.NativeEngine(num_threads=4)

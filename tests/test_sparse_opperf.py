@@ -1,0 +1,112 @@
+"""Sparse storage / opperf / launcher tests (reference
+tests/python/unittest/test_sparse_ndarray.py, test_sparse_operator.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def test_row_sparse_roundtrip():
+    dense = onp.zeros((6, 3), onp.float32)
+    dense[1] = [1, 2, 3]
+    dense[4] = [4, 5, 6]
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert rs.indices.shape == (2,)
+    onp.testing.assert_allclose(rs.asnumpy(), dense)
+    back = rs.tostype("default")
+    assert isinstance(back, nd.NDArray)
+    onp.testing.assert_allclose(back.asnumpy(), dense)
+    # from (data, indices)
+    rs2 = sparse.row_sparse_array(
+        ([[1.0, 1.0, 1.0]], [2]), shape=(5, 3))
+    assert rs2.asnumpy()[2].tolist() == [1, 1, 1]
+
+
+def test_row_sparse_compact_and_retain():
+    rs = sparse.row_sparse_array(
+        ([[1.0], [2.0], [3.0]], [1, 1, 3]), shape=(5, 1))
+    c = rs.compact()
+    assert sorted(onp.asarray(c.indices).tolist()) == [1, 3]
+    onp.testing.assert_allclose(c.asnumpy().ravel(), [0, 3, 0, 3, 0])
+    r = rs.retain([1, 2])
+    onp.testing.assert_allclose(r.asnumpy().ravel(), [0, 3, 0, 0, 0])
+
+
+def test_csr_roundtrip_and_dot():
+    dense = onp.array([[0, 1.0, 0], [2.0, 0, 3.0]], onp.float32)
+    csr = sparse.csr_matrix(dense)
+    onp.testing.assert_allclose(csr.asnumpy(), dense)
+    w = nd.array(onp.arange(6, dtype=onp.float32).reshape(3, 2))
+    out = csr.dot(w)
+    onp.testing.assert_allclose(out.asnumpy(), dense @ w.asnumpy())
+
+
+def test_sparse_sgd_update():
+    w = nd.array(onp.ones((5, 2), onp.float32))
+    grad = sparse.row_sparse_array(([[1.0, 1.0], [2.0, 2.0]], [0, 3]),
+                                   shape=(5, 2))
+    sparse.sgd_update(w, grad, lr=0.1)
+    expect = onp.ones((5, 2), onp.float32)
+    expect[0] -= 0.1
+    expect[3] -= 0.2
+    onp.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-6)
+
+
+def test_sparse_adam_lazy_update():
+    w = nd.array(onp.ones((4, 2), onp.float32))
+    m = nd.zeros((4, 2))
+    v = nd.zeros((4, 2))
+    grad = sparse.row_sparse_array(([[1.0, 1.0]], [2]), shape=(4, 2))
+    sparse.adam_update(w, grad, m, v, lr=0.1)
+    wn = w.asnumpy()
+    assert wn[2][0] != 1.0       # touched row updated
+    onp.testing.assert_allclose(wn[[0, 1, 3]], onp.ones((3, 2)))  # lazy
+    assert float(m.asnumpy()[2][0]) != 0.0
+    onp.testing.assert_allclose(m.asnumpy()[[0, 1, 3]], 0.0)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (4, 3))
+    onp.testing.assert_allclose(z.asnumpy(), onp.zeros((4, 3)))
+    zc = sparse.zeros("csr", (3, 3))
+    onp.testing.assert_allclose(zc.asnumpy(), onp.zeros((3, 3)))
+
+
+def test_opperf_runner():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "benchmark", "opperf"))
+    import opperf
+
+    results = opperf.run_benchmark(["relu", "dot", "softmax"], warmup=1)
+    assert len(results) == 3
+    for r in results:
+        assert "error" not in r, r
+        assert r["avg_forward_ms"] > 0
+
+
+def test_launcher_local_env_wiring(tmp_path):
+    """tools/launch.py local mode sets the distributed env per worker."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "print('RANK', os.environ['MXNET_TPU_PROC_ID'],\n"
+        "      os.environ['MXNET_TPU_NUM_PROCS'],\n"
+        "      os.environ['DMLC_ROLE'])\n")
+    launch = os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "launch.py")
+    out = subprocess.run(
+        [sys.executable, launch, "-n", "3", "--launcher", "local",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    ranks = sorted(l.split()[1] for l in out.stdout.splitlines()
+                   if l.startswith("RANK"))
+    assert ranks == ["0", "1", "2"]
+    assert "worker" in out.stdout

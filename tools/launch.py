@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Distributed job launcher.
+
+Reference analog: ``tools/launch.py:72`` (dmlc-tracker: spawns scheduler +
+servers + workers over local/ssh/mpi with DMLC_* env).  TPU-native jobs are
+multi-controller JAX: N identical worker processes, process 0 doubling as
+the coordination point — no scheduler/server processes needed (collectives
+replace the parameter server).  Supported launchers:
+
+  local  N worker processes on this machine (how the reference tests
+         multi-node without a cluster, tests/nightly/dist_sync_kvstore.py)
+  ssh    one worker per host from --host-file
+
+Each worker gets MXNET_TPU_COORDINATOR / MXNET_TPU_NUM_PROCS /
+MXNET_TPU_PROC_ID, consumed by ``mxnet_tpu.kvstore.kvstore_server
+.init_distributed``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference parity; TPU jobs need no "
+                         "servers (0 spawned unless explicitly requested)")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--host-file", default=None)
+    ap.add_argument("--port", type=int, default=29500)
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra VAR=VAL for every worker")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    n = args.num_workers
+    coordinator = f"127.0.0.1:{args.port}"
+    extra_env = dict(e.split("=", 1) for e in args.env)
+
+    if args.launcher == "local":
+        procs = []
+        for rank in range(n):
+            env = dict(os.environ)
+            env.update(extra_env)
+            env.update({
+                "MXNET_TPU_COORDINATOR": coordinator,
+                "MXNET_TPU_NUM_PROCS": str(n),
+                "MXNET_TPU_PROC_ID": str(rank),
+                "DMLC_ROLE": "worker",
+                # reference-compat aliases
+                "DMLC_NUM_WORKER": str(n),
+                "DMLC_WORKER_ID": str(rank),
+            })
+            procs.append(subprocess.Popen(args.command, env=env))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        sys.exit(rc)
+
+    # ssh launcher
+    with open(args.host_file) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    if len(hosts) < n:
+        sys.exit(f"need {n} hosts, have {len(hosts)}")
+    coordinator = f"{hosts[0]}:{args.port}"
+    procs = []
+    for rank, host in enumerate(hosts[:n]):
+        envs = " ".join(
+            f"{k}={shlex.quote(v)}" for k, v in {
+                **extra_env,
+                "MXNET_TPU_COORDINATOR": coordinator,
+                "MXNET_TPU_NUM_PROCS": str(n),
+                "MXNET_TPU_PROC_ID": str(rank),
+                "DMLC_ROLE": "worker",
+            }.items())
+        cmd = " ".join(shlex.quote(c) for c in args.command)
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host,
+             f"cd {shlex.quote(os.getcwd())} && {envs} {cmd}"]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

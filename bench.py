@@ -6,26 +6,106 @@ at bs32, the BASELINE.md reference point).  The whole train step (fwd, bwd,
 SGD-momentum update) is one donated XLA program via ShardedTrainer on a
 1-chip mesh.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Env overrides: BENCH_MODEL, BENCH_BATCH, BENCH_IMG, BENCH_STEPS.
+Hardening (round 2): the device backend is probed in a SUBPROCESS with a
+timeout before the parent touches JAX, so a hung TPU tunnel cannot hang the
+bench; model init + deferred-shape probe run on the host CPU backend (one
+tiny-op stream over the tunnel was round 1's failure mode); a watchdog
+thread guarantees a JSON line is emitted even on a stall; progress goes to
+stderr so stdout stays one parseable JSON line.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Env overrides: BENCH_MODEL, BENCH_BATCH, BENCH_IMG, BENCH_STEPS,
+BENCH_TIMEOUT, BENCH_PROBE_TIMEOUT, BENCH_CPU_FALLBACK.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 V100_RESNET50_TRAIN_IMGS_PER_SEC = 298.51  # reference perf.md:252, bs32 fp32
 
-
 V100_BERT_BASE_TOKENS_PER_SEC = 11500.0  # fp16 V100 BERT-base pretrain
 # (~90 seq/s at seq 128, public MLPerf-era single-V100 numbers)
 
+_T0 = time.time()
+_RESULT_EMITTED = threading.Event()
+_EMIT_LOCK = threading.Lock()
 
-def bench_bert():
+
+def _progress(msg: str) -> None:
+    print(f"[bench +{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _metric() -> dict:
+    """Metric name/unit for the selected BENCH_MODEL (also used by the error
+    emitters so a bert failure is never recorded under the resnet metric)."""
+    model = os.environ.get("BENCH_MODEL", "resnet50_v1")
+    if model == "bert":
+        return {"metric": "bert_base_train_throughput_per_chip",
+                "unit": "tokens/s"}
+    return {"metric": f"{model}_train_throughput_per_chip", "unit": "img/s"}
+
+
+def _emit(payload: dict) -> None:
+    """Print the single stdout JSON line (at most once, thread-safe: the
+    watchdog may race the main thread)."""
+    with _EMIT_LOCK:
+        if _RESULT_EMITTED.is_set():
+            return
+        _RESULT_EMITTED.set()
+        print(json.dumps(payload), flush=True)
+
+
+def _watchdog(timeout_s: float) -> None:
+    def run():
+        deadline = _T0 + timeout_s
+        while time.time() < deadline:
+            if _RESULT_EMITTED.is_set():
+                return
+            time.sleep(1.0)
+        _progress(f"WATCHDOG: no result after {timeout_s:.0f}s, bailing")
+        _emit({
+            **_metric(), "value": 0.0, "vs_baseline": 0.0,
+            "error": f"watchdog timeout after {timeout_s:.0f}s "
+                     "(device backend stalled)",
+        })
+        sys.stdout.flush()
+        os._exit(3)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+
+
+def _probe_device_backend(timeout_s: float) -> bool:
+    """Run a tiny matmul in a SUBPROCESS; a hung TPU tunnel then times the
+    probe out instead of hanging this process (round-1 failure mode: axon
+    backend init blocked forever)."""
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((256, 256)); "
+            "v = float((x @ x)[0, 0]); "
+            "print(jax.default_backend(), v)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _progress(f"device probe TIMED OUT after {timeout_s:.0f}s")
+        return False
+    if r.returncode != 0:
+        _progress("device probe failed: " + r.stderr.strip()[-400:])
+        return False
+    _progress("device probe OK: " + r.stdout.strip())
+    return True
+
+
+def bench_bert(on_cpu: bool = False):
     """BERT-base masked-LM pretrain step throughput (tokens/s/chip) on the
     flagship transformer with pallas flash attention."""
     import jax
@@ -35,10 +115,11 @@ def bench_bert():
     from mxnet_tpu import models
     from mxnet_tpu import parallel as par
 
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    batch = int(os.environ.get("BENCH_BATCH", "4" if on_cpu else "32"))
     seq = int(os.environ.get("BENCH_SEQ", "128"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    steps = int(os.environ.get("BENCH_STEPS", "2" if on_cpu else "20"))
 
+    _progress(f"bert: init params (batch={batch} seq={seq})")
     cfg = models.TransformerLMConfig(dtype=jnp.bfloat16)
     params = models.init_params(jax.random.PRNGKey(0), cfg)
     mesh = par.make_mesh({"dp": 1})
@@ -48,9 +129,11 @@ def bench_bert():
         rng = onp.random.RandomState(0)
         toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
                            jnp.int32)
+        _progress("bert: compiling train step")
         params, m, v, loss = step(params, m, v, toks, toks,
                                   jnp.float32(1))  # compile
         jax.block_until_ready(loss)
+        _progress(f"bert: compiled, timing {steps} steps")
         t0 = time.perf_counter()
         for _ in range(steps):
             params, m, v, loss = step(params, m, v, toks, toks,
@@ -58,64 +141,122 @@ def bench_bert():
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
     tokens_per_sec = batch * seq * steps / dt
-    print(json.dumps({
+    _emit({
         "metric": "bert_base_train_throughput_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / V100_BERT_BASE_TOKENS_PER_SEC,
                              3),
-    }))
+        "platform": jax.default_backend(),
+    })
 
 
-def main():
+def _run(model_name: str, batch: int, img: int, steps: int):
+    import jax
     import numpy as onp
 
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
     from mxnet_tpu.gluon.model_zoo import vision
 
-    model_name = os.environ.get("BENCH_MODEL", "resnet50_v1")
-    if model_name == "bert":
-        return bench_bert()
-    batch = int(os.environ.get("BENCH_BATCH", "256"))
-    img = int(os.environ.get("BENCH_IMG", "224"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    platform = jax.default_backend()
+    _progress(f"platform={platform}, building {model_name} "
+              f"(batch={batch} img={img} steps={steps})")
 
     net = vision.get_model(model_name, classes=1000)
     net.initialize(mx.init.Xavier())
-    # one eager probe completes deferred shape inference for conv/bn params
-    net(mx.nd.zeros((1, 3, img, img)))
+    # Deferred-shape probe: run the one eager forward on the HOST CPU backend
+    # so its stream of tiny per-op compiles never crosses the TPU tunnel
+    # (round-1 rc=1 came from exactly this probe).  Params land on CPU too;
+    # ShardedTrainer then stages them onto the mesh in one pass.
+    cpu0 = jax.devices("cpu")[0] if platform != "cpu" else None
+    _progress("deferred-shape probe on host CPU")
+    if cpu0 is not None:
+        with jax.default_device(cpu0):
+            net(mx.nd.zeros((1, 3, img, img)))
+    else:
+        net(mx.nd.zeros((1, 3, img, img)))
     ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
 
+    _progress("staging params onto 1-chip mesh")
     mesh = par.make_mesh({"dp": 1})
     tr = par.ShardedTrainer(
         net, lambda o, l: ce(o, l).mean(), mesh, optimizer="sgd",
         optimizer_params={"lr": 0.1, "momentum": 0.9, "wd": 1e-4})
-
-    import jax
 
     rng = onp.random.RandomState(0)
     data = rng.rand(batch, 3, img, img).astype(onp.float32)
     label = rng.randint(0, 1000, (batch,)).astype(onp.int32)
     data, label = tr.stage(data, label)  # host->HBM once
 
+    _progress("compiling whole-graph train step")
     tr.step(data, label)  # compile + sync
+    _progress("compiled; warming")
     tr.step(data, label)  # warm + sync
+    _progress(f"timing {steps} steps")
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = tr.step(data, label, sync=False)  # enqueue back-to-back
     jax.block_until_ready(jax.tree_util.tree_leaves(tr.params))
     dt = time.perf_counter() - t0
     imgs_per_sec = batch * steps / dt
+    _progress(f"done: {imgs_per_sec:.2f} img/s")
 
-    print(json.dumps({
+    _emit({
         "metric": f"{model_name}_train_throughput_per_chip",
         "value": round(imgs_per_sec, 2),
         "unit": "img/s",
         "vs_baseline": round(imgs_per_sec / V100_RESNET50_TRAIN_IMGS_PER_SEC,
                              3),
-    }))
+        "platform": platform,
+    })
+
+
+def main():
+    timeout_s = float(os.environ.get("BENCH_TIMEOUT", "1500"))
+    _watchdog(timeout_s)
+
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    device_ok = _probe_device_backend(probe_timeout)
+    on_cpu = False
+    if not device_ok:
+        if os.environ.get("BENCH_CPU_FALLBACK", "1") != "1":
+            _emit({
+                **_metric(), "value": 0.0, "vs_baseline": 0.0,
+                "error": "device backend unreachable and CPU fallback "
+                         "disabled",
+            })
+            sys.exit(1)
+        _progress("falling back to host CPU backend")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        on_cpu = True
+
+    model_name = os.environ.get("BENCH_MODEL", "resnet50_v1")
+    if model_name == "bert":
+        return bench_bert(on_cpu=on_cpu)
+    if on_cpu:
+        # small enough that XLA:CPU compiles + runs inside the watchdog
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        steps = int(os.environ.get("BENCH_STEPS", "3"))
+    else:
+        batch = int(os.environ.get("BENCH_BATCH", "256"))
+        steps = int(os.environ.get("BENCH_STEPS", "20"))
+    img = int(os.environ.get("BENCH_IMG", "224"))
+    _run(model_name, batch, img, steps)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException:
+        tb = traceback.format_exc()
+        _progress("FATAL:\n" + tb)
+        _emit({
+            **_metric(), "value": 0.0, "vs_baseline": 0.0,
+            "error": tb.strip().splitlines()[-1][:400],
+        })
+        sys.exit(1)

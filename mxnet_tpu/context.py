@@ -96,8 +96,12 @@ class Context:
 
 
 def _platform_devices(platform: str):
+    """Process-LOCAL devices: a Context indexes addressable devices only
+    (reference semantics: ``mx.gpu(0)`` is this worker's GPU 0).  Under
+    multi-controller jax.distributed, ``jax.devices()`` is the global list
+    and leads with process 0's devices — non-addressable on other ranks."""
     try:
-        return jax.devices(platform)
+        return jax.local_devices(backend=platform)
     except RuntimeError:
         return []
 
@@ -127,7 +131,7 @@ def _resolve_device(devtype: str, device_id: int) -> "jax.Device":
             )
         devs = _platform_devices("cpu")
         return devs[min(device_id, len(devs) - 1)]
-    devs = jax.devices(platform)
+    devs = _platform_devices(platform)
     if device_id >= len(devs):
         raise ValueError(
             f"tpu({device_id}) requested but only {len(devs)} device(s) present"
@@ -156,7 +160,7 @@ def num_tpus() -> int:
     platform = _accelerator_platform()
     if platform is None:
         return 0
-    return len(jax.devices(platform))
+    return len(_platform_devices(platform))
 
 
 def num_gpus() -> int:

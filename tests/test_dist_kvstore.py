@@ -1,0 +1,68 @@
+"""Multi-process dist kvstore correctness through tools/launch.py.
+
+Reference analog: ``tests/nightly/dist_sync_kvstore.py`` (workers launched by
+tools/launch.py push rank-dependent values and verify the pulled sum), run
+here with 2 multi-controller CPU processes over jax.distributed instead of
+ps-lite worker/server processes.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu.kvstore import kvstore_server
+    assert kvstore_server.init_distributed(), "launcher env missing"
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    rank, nproc = kv.rank, kv.num_workers
+    assert nproc == 2, nproc
+
+    # push rank-dependent values; the pulled value must be the global sum
+    v = mx.nd.array(onp.full((3, 2), float(rank + 1), onp.float32))
+    kv.init("3", mx.nd.zeros((3, 2)))
+    kv.push("3", v)
+    out = mx.nd.zeros((3, 2))
+    kv.pull("3", out=out)
+    expect = sum(r + 1 for r in range(nproc))
+    assert onp.allclose(out.asnumpy(), expect), (rank, out.asnumpy())
+
+    # second round: running sum accumulates through the default updater
+    kv.push("3", v)
+    kv.pull("3", out=out)
+    assert onp.allclose(out.asnumpy(), expect), (rank, out.asnumpy())
+
+    print("DISTOK", rank, "of", nproc)
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_dist_sync_kvstore_push_pull(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO))
+    launch = os.path.join(REPO, "tools", "launch.py")
+    out = subprocess.run(
+        [sys.executable, launch, "-n", "2", "--launcher", "local",
+         "--port", str(_free_port()), sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    ok_lines = [l for l in out.stdout.splitlines() if l.startswith("DISTOK")]
+    assert sorted(ok_lines) == ["DISTOK 0 of 2", "DISTOK 1 of 2"], out.stdout

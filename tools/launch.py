@@ -22,6 +22,39 @@ import os
 import shlex
 import subprocess
 import sys
+import threading
+
+_PRINT_LOCK = threading.Lock()
+
+
+def _relay(stream, sink):
+    """Copy a worker's output line-atomically onto our own stream.
+
+    Workers share the launcher's stdout; concurrent writes from separate
+    processes interleave mid-line on a pipe (observed: ``RANKRANK 1\\n 0\\n``),
+    which corrupts any consumer parsing lines.  One reader thread per worker
+    + a print lock keeps every line intact."""
+    for line in iter(stream.readline, b""):
+        with _PRINT_LOCK:
+            sink.buffer.write(line)
+            sink.flush()
+    stream.close()
+
+
+def _wait_all(procs, relay_threads):
+    # wait for workers FIRST: a worker may leave a background child holding
+    # its stdout pipe open, in which case the relay thread never sees EOF —
+    # bounded joins after exit drain what's left without hanging the launcher
+    rcs = [p.wait() for p in procs]
+    for t in relay_threads:
+        t.join(timeout=5.0)
+    bad = [(i, rc) for i, rc in enumerate(rcs) if rc]
+    if bad:
+        for i, rc in bad:
+            print(f"launch.py: worker {i} exited with rc={rc}",
+                  file=sys.stderr)
+        sys.exit(bad[0][1])
+    sys.exit(0)
 
 
 def main():
@@ -45,7 +78,7 @@ def main():
     extra_env = dict(e.split("=", 1) for e in args.env)
 
     if args.launcher == "local":
-        procs = []
+        procs, threads = [], []
         for rank in range(n):
             env = dict(os.environ)
             env.update(extra_env)
@@ -58,11 +91,17 @@ def main():
                 "DMLC_NUM_WORKER": str(n),
                 "DMLC_WORKER_ID": str(rank),
             })
-            procs.append(subprocess.Popen(args.command, env=env))
-        rc = 0
-        for p in procs:
-            rc = p.wait() or rc
-        sys.exit(rc)
+            p = subprocess.Popen(args.command, env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE)
+            procs.append(p)
+            for stream, sink in ((p.stdout, sys.stdout),
+                                 (p.stderr, sys.stderr)):
+                t = threading.Thread(target=_relay, args=(stream, sink),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+        _wait_all(procs, threads)
 
     # ssh launcher
     with open(args.host_file) as f:
@@ -70,7 +109,7 @@ def main():
     if len(hosts) < n:
         sys.exit(f"need {n} hosts, have {len(hosts)}")
     coordinator = f"{hosts[0]}:{args.port}"
-    procs = []
+    procs, threads = [], []
     for rank, host in enumerate(hosts[:n]):
         envs = " ".join(
             f"{k}={shlex.quote(v)}" for k, v in {
@@ -81,13 +120,17 @@ def main():
                 "DMLC_ROLE": "worker",
             }.items())
         cmd = " ".join(shlex.quote(c) for c in args.command)
-        procs.append(subprocess.Popen(
+        p = subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", host,
-             f"cd {shlex.quote(os.getcwd())} && {envs} {cmd}"]))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    sys.exit(rc)
+             f"cd {shlex.quote(os.getcwd())} && {envs} {cmd}"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        procs.append(p)
+        for stream, sink in ((p.stdout, sys.stdout), (p.stderr, sys.stderr)):
+            t = threading.Thread(target=_relay, args=(stream, sink),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+    _wait_all(procs, threads)
 
 
 if __name__ == "__main__":

@@ -169,6 +169,20 @@ def _mlp(x, p, pre, cfg: TransformerLMConfig):
     return h @ p[pre + "ffn_2.weight"].T + p[pre + "ffn_2.bias"], 0.0
 
 
+def _block(params, x, i: int, cfg: TransformerLMConfig,
+           mesh: Optional[Mesh] = None):
+    """One pre-LN transformer block (attention + MLP/MoE residual)."""
+    pre = f"layer{i}."
+    h = _attention(_layer_norm(x, params[pre + "ln1.gamma"],
+                               params[pre + "ln1.beta"]),
+                   params, pre, cfg, mesh)
+    x = x + h
+    m, aux = _mlp(_layer_norm(x, params[pre + "ln2.gamma"],
+                              params[pre + "ln2.beta"]),
+                  params, pre, cfg)
+    return x + m, aux
+
+
 def forward(params, tokens, cfg: TransformerLMConfig,
             mesh: Optional[Mesh] = None) -> Tuple[jax.Array, jax.Array]:
     """tokens [B, S] int32 -> (logits [B, S, V] float32, moe aux loss)."""
@@ -178,15 +192,7 @@ def forward(params, tokens, cfg: TransformerLMConfig,
     aux_total = 0.0
 
     def one_layer(x, i):
-        pre = f"layer{i}."
-        h = _attention(_layer_norm(x, params[pre + "ln1.gamma"],
-                                   params[pre + "ln1.beta"]),
-                       params, pre, cfg, mesh)
-        x = x + h
-        m, aux = _mlp(_layer_norm(x, params[pre + "ln2.gamma"],
-                                  params[pre + "ln2.beta"]),
-                      params, pre, cfg)
-        return x + m, aux
+        return _block(params, x, i, cfg, mesh)
 
     layer_fn = jax.checkpoint(one_layer, static_argnums=(1,)) if cfg.remat \
         else one_layer
@@ -198,15 +204,21 @@ def forward(params, tokens, cfg: TransformerLMConfig,
     return logits.astype(jnp.float32), jnp.asarray(aux_total, jnp.float32)
 
 
-def loss_fn(params, tokens, labels, cfg: TransformerLMConfig,
-            mesh: Optional[Mesh] = None, aux_weight: float = 0.01):
-    """Masked-LM style CE: labels [B,S] int32, -1 = unmasked (ignored)."""
-    logits, aux = forward(params, tokens, cfg, mesh)
+def _masked_nll(logits, labels):
+    """Per-position masked NLL: labels int32, -1 = unmasked (ignored).
+    Returns (nll [B,S] with zeros at masked positions, valid mask [B,S])."""
     valid = labels >= 0
     safe = jnp.where(valid, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-    nll = jnp.where(valid, nll, 0.0)
+    return jnp.where(valid, nll, 0.0), valid
+
+
+def loss_fn(params, tokens, labels, cfg: TransformerLMConfig,
+            mesh: Optional[Mesh] = None, aux_weight: float = 0.01):
+    """Masked-LM style CE: labels [B,S] int32, -1 = unmasked (ignored)."""
+    logits, aux = forward(params, tokens, cfg, mesh)
+    nll, valid = _masked_nll(logits, labels)
     denom = jnp.maximum(jnp.sum(valid), 1)
     return jnp.sum(nll) / denom + aux_weight * aux
 
@@ -234,6 +246,131 @@ def init_opt_state(params):
     zeros = lambda a: jnp.zeros(a.shape, jnp.float32)
     return ({n: zeros(a) for n, a in params.items()},
             {n: zeros(a) for n, a in params.items()})
+
+
+# ---------------------------------------------------------------------------
+# Pipeline parallelism: split the LM into heterogeneous pp stages
+# ---------------------------------------------------------------------------
+
+def pp_stages(cfg: TransformerLMConfig, params, pp: int):
+    """Split flagship params/compute into ``pp`` heterogeneous stages for
+    :class:`parallel.pipeline.HeteroPipeline`.
+
+    Stage 0 = token+position embedding + first layers block; last stage =
+    final layers + final LN + LM head + per-sample masked-CE reduction
+    (returns ``(nll_sum[mb], valid_count[mb])`` so the caller combines
+    microbatch losses exactly).  The tied embedding/head weight is split
+    into two copies (``embed.weight`` on stage 0, ``head.weight`` on the
+    last) — :func:`make_pp_train_step` sums their gradient slices each step
+    (Megatron-style tied-embedding all-reduce), so equal-initialised copies
+    stay exactly tied under any elementwise optimizer.
+
+    No PP analog exists in the reference (SURVEY.md §2.3: DP only).
+    """
+    assert cfg.num_layers % pp == 0, (
+        f"num_layers {cfg.num_layers} must divide pp {pp}")
+    assert not cfg.num_experts, "pp path supports dense MLP stages only"
+    per = cfg.num_layers // pp
+    stage_params, stage_fns = [], []
+    for s in range(pp):
+        sp = {}
+        if s == 0:
+            sp["embed.weight"] = params["embed.weight"]
+            sp["pos_embed.weight"] = params["pos_embed.weight"]
+        for i in range(s * per, (s + 1) * per):
+            pre = f"layer{i}."
+            for k, v in params.items():
+                if k.startswith(pre):
+                    sp[k] = v
+        if s == pp - 1:
+            sp["final_ln.gamma"] = params["final_ln.gamma"]
+            sp["final_ln.beta"] = params["final_ln.beta"]
+            sp["head.weight"] = params["embed.weight"]
+        stage_params.append(sp)
+        stage_fns.append(_make_stage_fn(cfg, s, per, pp))
+    return stage_fns, stage_params
+
+
+def _make_stage_fn(cfg: TransformerLMConfig, s: int, per: int, pp: int):
+    def stage(p, act, labels):
+        if s == 0:
+            tokens = act                       # [mb, S] int32
+            S = tokens.shape[1]
+            x = p["embed.weight"][tokens] + p["pos_embed.weight"][:S]
+            x = x.astype(cfg.dtype)
+        else:
+            x = act                            # [mb, S, H]
+        for i in range(s * per, (s + 1) * per):
+            x, _aux = _block(p, x, i, cfg, None)
+        if s == pp - 1:
+            x = _layer_norm(x, p["final_ln.gamma"], p["final_ln.beta"])
+            logits = (x @ p["head.weight"].T.astype(cfg.dtype)).astype(
+                jnp.float32)
+            nll, valid = _masked_nll(logits, labels)
+            return (jnp.sum(nll, axis=-1),                 # [mb]
+                    jnp.sum(valid, axis=-1).astype(jnp.float32))
+        return x
+
+    return stage
+
+
+def make_pp_pipeline(cfg: TransformerLMConfig, params, mesh: Mesh, *,
+                     num_microbatches: int, example_tokens,
+                     remat: bool = False):
+    """Build a HeteroPipeline for this LM over mesh axes pp (and dp)."""
+    from ..parallel.pipeline import HeteroPipeline
+
+    pp = mesh.shape.get("pp", 1)
+    stage_fns, stage_params = pp_stages(cfg, params, pp)
+    pipe = HeteroPipeline(
+        stage_fns, stage_params, mesh,
+        num_microbatches=num_microbatches,
+        example_x=example_tokens,
+        example_extras=(jax.ShapeDtypeStruct(example_tokens.shape,
+                                             jnp.int32),),
+        remat=remat)
+    # embed (stage 0) and head (last stage) are weight-tied copies; the
+    # train step sums their grads so they stay tied
+    pipe.tied = (((0, "embed.weight"), (pp - 1, "head.weight")),)
+    return pipe
+
+
+def pp_loss_fn(pipe, packed_params, tokens, labels):
+    """Exact masked-LM CE through the pipeline (matches :func:`loss_fn` for
+    dense configs up to fp32 packing)."""
+    nll_sum, counts = pipe.apply(packed_params, tokens, labels)
+    return jnp.sum(nll_sum) / jnp.maximum(jnp.sum(counts), 1.0)
+
+
+def make_pp_train_step(pipe, optimizer: str = "adam", lr: float = 1e-4,
+                       beta1: float = 0.9, beta2: float = 0.999,
+                       epsilon: float = 1e-8, wd: float = 0.0):
+    """Adam(W)/SGD on the packed per-stage parameter buffer.
+
+    Elementwise updates are exact in packed space (padding stays zero:
+    grads, moments, and decay are all zero there).  Microbatch gradient
+    accumulation happens inside the pipeline's scan.  Gradients of
+    weight-tied leaves (``pipe.tied``, e.g. embed/head) are summed across
+    stages before the update so equal-initialised copies stay exactly tied.
+    The packed-params argument is NOT donated — the pipeline object keeps a
+    live reference in ``pipe.packed_params``."""
+    ties = getattr(pipe, "tied", ())
+
+    def step(packed, m, v, tokens, labels, t):
+        loss, g = jax.value_and_grad(
+            lambda p: pp_loss_fn(pipe, p, tokens, labels))(packed)
+        if ties:
+            g = pipe.tie_grads(g, ties)
+        if optimizer == "sgd":
+            return packed - lr * g - lr * wd * packed, m, v, loss
+        m = beta1 * m + (1 - beta1) * g
+        v = beta2 * v + (1 - beta2) * jnp.square(g)
+        lr_t = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+        upd = m / (jnp.sqrt(v) + epsilon)
+        new_p = packed - lr_t * upd - lr * wd * packed
+        return new_p, m, v, loss
+
+    return jax.jit(step, donate_argnums=(1, 2))
 
 
 def make_train_step(cfg: TransformerLMConfig, mesh: Mesh,

@@ -17,7 +17,8 @@ from .collectives import (all_gather, all_reduce, all_to_all, broadcast_from,
                           ppermute, reduce_scatter, ring_shift, run_sharded)
 from .mesh import AXIS_NAMES, auto_mesh, current_mesh, make_mesh, mesh_scope, set_mesh
 from .moe import moe_layer, top_k_gating
-from .pipeline import pipeline_apply, pipelined, stack_stage_params
+from .pipeline import (HeteroPipeline, pipeline_apply, pipelined,
+                       stack_stage_params)
 from .ring_attention import ring_attention, ring_attention_sharded
 from .sharding import (PartitionSpec, ShardingPlan, constraint, fsdp_plan,
                        replicated_plan, shard_array, tensor_parallel_plan)
@@ -30,5 +31,6 @@ __all__ = [
     "all_gather", "reduce_scatter", "all_to_all", "ppermute", "ring_shift",
     "broadcast_from", "run_sharded", "ring_attention",
     "ring_attention_sharded", "moe_layer", "top_k_gating", "pipeline_apply",
-    "pipelined", "stack_stage_params", "ShardedTrainer", "functional_call",
+    "pipelined", "stack_stage_params", "HeteroPipeline", "ShardedTrainer",
+    "functional_call",
 ]

@@ -38,10 +38,21 @@ WORKER = textwrap.dedent("""
     expect = sum(r + 1 for r in range(nproc))
     assert onp.allclose(out.asnumpy(), expect), (rank, out.asnumpy())
 
-    # second round: running sum accumulates through the default updater
+    # second round: without an updater, push OVERWRITES the stored value
+    # with the fresh per-round global sum (MXNet assign semantics)
     kv.push("3", v)
     kv.pull("3", out=out)
     assert onp.allclose(out.asnumpy(), expect), (rank, out.asnumpy())
+
+    # third round: a custom updater accumulates (reference dist_sync
+    # servers run the updater server-side; growing-sum check from
+    # tests/nightly/dist_sync_kvstore.py)
+    def accum(key, recv, stored):
+        stored += recv
+    kv.set_updater(accum)
+    kv.push("3", v)
+    kv.pull("3", out=out)
+    assert onp.allclose(out.asnumpy(), 2 * expect), (rank, out.asnumpy())
 
     print("DISTOK", rank, "of", nproc)
 """)

@@ -137,6 +137,139 @@ def test_pipeline_matches_sequential():
     assert onp.allclose(onp.asarray(out), onp.asarray(expected), atol=1e-5)
 
 
+def test_hetero_pipeline_matches_sequential():
+    """Non-shape-preserving heterogeneous stages (4 -> 16 -> 8 widths)
+    through pp=2 x dp=4 must match the sequential program."""
+    B = 16
+    rng = onp.random.RandomState(7)
+    w0 = jnp.asarray(rng.randn(4, 16) * 0.3, jnp.float32)
+    b0 = jnp.asarray(rng.randn(16) * 0.1, jnp.float32)
+    w1 = jnp.asarray(rng.randn(16, 8) * 0.3, jnp.float32)
+    x = jnp.asarray(rng.randn(B, 4), jnp.float32)
+
+    def stage0(p, a):
+        return jax.nn.relu(a @ p["w"] + p["b"])
+
+    def stage1(p, a):
+        return a @ p["w"]
+
+    expected = stage1({"w": w1}, stage0({"w": w0, "b": b0}, x))
+
+    mesh = par.make_mesh({"pp": 2, "dp": 4})
+    pipe = par.HeteroPipeline(
+        [stage0, stage1], [{"w": w0, "b": b0}, {"w": w1}], mesh,
+        num_microbatches=2, example_x=x)
+    out = pipe.apply(pipe.packed_params, x)
+    assert out.shape == (B, 8)
+    assert onp.allclose(onp.asarray(out), onp.asarray(expected), atol=1e-5)
+
+    # params round-trip through the packed buffer exactly
+    sp0, sp1 = pipe.unpack_stage_params()
+    assert onp.allclose(onp.asarray(sp0["w"]), onp.asarray(w0))
+    assert onp.allclose(onp.asarray(sp1["w"]), onp.asarray(w1))
+
+
+def test_hetero_pipeline_grads_match_sequential():
+    """Microbatch gradient accumulation through the pp scan equals the
+    unpipelined gradient."""
+    B = 8
+    rng = onp.random.RandomState(8)
+    w0 = jnp.asarray(rng.randn(6, 12) * 0.3, jnp.float32)
+    w1 = jnp.asarray(rng.randn(12, 3) * 0.3, jnp.float32)
+    x = jnp.asarray(rng.randn(B, 6), jnp.float32)
+    y = jnp.asarray(rng.randn(B, 3), jnp.float32)
+
+    def stage0(p, a):
+        return jnp.tanh(a @ p["w"])
+
+    def stage1(p, a):
+        return a @ p["w"]
+
+    def seq_loss(ws):
+        out = stage1({"w": ws[1]}, stage0({"w": ws[0]}, x))
+        return jnp.mean((out - y) ** 2)
+
+    g_seq = jax.grad(seq_loss)((w0, w1))
+
+    mesh = par.make_mesh({"pp": 2, "dp": 2})
+    pipe = par.HeteroPipeline(
+        [stage0, stage1], [{"w": w0}, {"w": w1}], mesh,
+        num_microbatches=4, example_x=x, remat=True)
+
+    def pp_loss(packed):
+        out = pipe.apply(packed, x)
+        return jnp.mean((out - y) ** 2)
+
+    g_packed = jax.grad(pp_loss)(pipe.packed_params)
+    g0, g1 = pipe.unpack_stage_params(g_packed)
+    assert onp.allclose(onp.asarray(g0["w"]), onp.asarray(g_seq[0]),
+                        atol=1e-5)
+    assert onp.allclose(onp.asarray(g1["w"]), onp.asarray(g_seq[1]),
+                        atol=1e-5)
+
+
+def test_pp_transformer_loss_matches_unpipelined():
+    """Flagship TransformerLM through HeteroPipeline pp=2: loss and grads
+    match the unpipelined model (VERDICT round-1 item 3)."""
+    from mxnet_tpu import models
+
+    cfg = models.TransformerLMConfig(
+        vocab_size=64, num_layers=2, num_heads=2, hidden=16, mlp_hidden=32,
+        max_len=16, dtype=jnp.float32)
+    params = models.init_params(jax.random.PRNGKey(0), cfg)
+    rng = onp.random.RandomState(0)
+    B, S = 8, 16
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels_np = rng.randint(0, cfg.vocab_size, (B, S))
+    labels_np[rng.rand(B, S) < 0.5] = -1       # mask half the positions
+    labels = jnp.asarray(labels_np, jnp.int32)
+
+    ref_loss = float(models.loss_fn(params, tokens, labels, cfg))
+
+    mesh = par.make_mesh({"pp": 2, "dp": 2})
+    pipe = models.make_pp_pipeline(cfg, params, mesh, num_microbatches=2,
+                                   example_tokens=tokens)
+    pp_loss = float(models.pp_loss_fn(pipe, pipe.packed_params, tokens,
+                                      labels))
+    assert abs(pp_loss - ref_loss) < 1e-4, (pp_loss, ref_loss)
+
+    # gradient equality: per-layer params match; tied embed grad equals
+    # stage-0 embed grad + last-stage head grad
+    g_ref = jax.grad(
+        lambda p: models.loss_fn(p, tokens, labels, cfg))(params)
+    g_packed = jax.grad(
+        lambda pk: models.pp_loss_fn(pipe, pk, tokens, labels))(
+        pipe.packed_params)
+    g0, g1 = pipe.unpack_stage_params(g_packed)
+    assert onp.allclose(onp.asarray(g0["layer0.attn.qkv.weight"]),
+                        onp.asarray(g_ref["layer0.attn.qkv.weight"]),
+                        atol=1e-4)
+    assert onp.allclose(onp.asarray(g1["layer1.ffn_2.weight"]),
+                        onp.asarray(g_ref["layer1.ffn_2.weight"]),
+                        atol=1e-4)
+    tied = onp.asarray(g0["embed.weight"]) + onp.asarray(g1["head.weight"])
+    assert onp.allclose(tied, onp.asarray(g_ref["embed.weight"]), atol=1e-4)
+
+    # one pp train step runs and the loss is finite
+    step = models.make_pp_train_step(pipe, optimizer="adam", lr=1e-3)
+    m = jnp.zeros_like(pipe.packed_params)
+    v = jnp.zeros_like(pipe.packed_params)
+    before = onp.asarray(jax.device_get(pipe.packed_params)).copy()
+    new_packed, m, v, loss = step(pipe.packed_params, m, v, tokens, labels,
+                                  jnp.float32(1))
+    assert onp.isfinite(float(loss))
+    assert not onp.allclose(onp.asarray(new_packed), before)
+
+    # tied embed/head copies stay exactly tied after the update (grads are
+    # summed across stages before the optimizer step)
+    n0, n1 = pipe.unpack_stage_params(new_packed)
+    assert onp.allclose(onp.asarray(n0["embed.weight"]),
+                        onp.asarray(n1["head.weight"]))
+    # the update actually incorporated the tied (summed) gradient
+    assert not onp.allclose(onp.asarray(n0["embed.weight"]),
+                            onp.asarray(params["embed.weight"]))
+
+
 def test_sharded_trainer_data_parallel_matches_single():
     from mxnet_tpu.gluon import nn
 

@@ -118,14 +118,16 @@ def bench_bert(on_cpu: bool = False):
     batch = int(os.environ.get("BENCH_BATCH", "4" if on_cpu else "32"))
     seq = int(os.environ.get("BENCH_SEQ", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "2" if on_cpu else "20"))
+    accum = int(os.environ.get("BENCH_ACCUM", "1"))  # micro-batch accum
 
-    _progress(f"bert: init params (batch={batch} seq={seq})")
+    _progress(f"bert: init params (batch={batch} seq={seq} accum={accum})")
     cfg = models.TransformerLMConfig(dtype=jnp.bfloat16)
     params = models.init_params(jax.random.PRNGKey(0), cfg)
     mesh = par.make_mesh({"dp": 1})
     with mesh:
         m, v = models.init_opt_state(params)
-        step = models.make_train_step(cfg, mesh, optimizer="adam", lr=1e-4)
+        step = models.make_train_step(cfg, mesh, optimizer="adam", lr=1e-4,
+                                      grad_accum=accum)
         rng = onp.random.RandomState(0)
         toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
                            jnp.int32)

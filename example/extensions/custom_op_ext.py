@@ -1,0 +1,51 @@
+"""Example runtime extension: custom ops + an optimize_for backend.
+
+Reference analog: example/extensions/lib_custom_op (gemm_lib.cc /
+relu_lib.cu registered through lib_api.h and loaded with
+``mx.library.load('libcustom.so')``).  The TPU-native extension is a
+Python module using the same public API; load it with::
+
+    import mxnet_tpu as mx
+    mx.library.load("example/extensions/custom_op_ext.py")
+    y = mx.nd.my_gemm(a, b)
+
+Everything registered here works eagerly, under autograd, hybridized, and
+inside pjit — one registration, every execution path.
+"""
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import library
+
+
+@library.register_op("my_gemm", num_inputs=2)
+def my_gemm(a, b):
+    """Custom GEMM (the gemm_lib.cc example, as an MXU-friendly einsum)."""
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+
+
+def _my_relu_grad(res, ct):
+    (x,), _out = res
+    return (ct * (x > 0).astype(ct.dtype),)
+
+
+@library.register_op("my_relu", grad=_my_relu_grad, num_inputs=1)
+def my_relu(x):
+    """Custom ReLU with an explicit VJP (the relu_lib.cu example)."""
+    return jnp.maximum(x, 0)
+
+
+@library.register_backend("example_bf16")
+def example_bf16(fn, **flags):
+    """optimize_for backend: run the whole cached graph with bf16 params
+    (a whole-function rewrite where the reference would partition
+    subgraphs — XLA handles the fusion)."""
+
+    def wrapped(param_arrays, input_arrays, rng_key):
+        cast = [p.astype(jnp.bfloat16) if jnp.issubdtype(p.dtype, jnp.floating)
+                else p for p in param_arrays]
+        outs, muts = fn(cast, input_arrays, rng_key)
+        return [o.astype(jnp.float32) if jnp.issubdtype(o.dtype, jnp.floating)
+                else o for o in outs], muts
+
+    return wrapped

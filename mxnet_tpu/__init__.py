@@ -58,6 +58,7 @@ _LAZY = {
     "contrib": ".contrib",
     "visualization": ".visualization",
     "viz": ".visualization",
+    "library": ".library",
 }
 
 

@@ -422,6 +422,7 @@ class HybridBlock(Block):
         # cache: (training, input treedef signature) -> compiled record
         self._cached: Dict[Any, Tuple] = {}
         self._backend = None
+        self._backend_flags: Dict[str, Any] = {}
         self._in_specs = None  # (struct, [(shape, dtype)]) from last call
 
     def hybridize(self, active=True, backend=None, clear=True, **kwargs):
@@ -430,6 +431,10 @@ class HybridBlock(Block):
         self._active = active
         self._backend = backend
         self._flags.update(kwargs)
+        # flags destined for the backend transform are only those passed
+        # alongside THIS backend selection (parity flags like static_alloc
+        # accumulate in _flags but never leak into backend transforms)
+        self._backend_flags = dict(kwargs) if backend is not None else {}
         if clear:
             self._cached = {}
         super().hybridize(active=False if active else active)
@@ -575,6 +580,14 @@ class HybridBlock(Block):
                 d._version = ver
             return [o._data for o in out_leaves], mut_vals
 
+        if self._backend:
+            # optimize_for backend: a registered transform of the traced
+            # pure function, applied before jit (the SubgraphProperty/
+            # MXOptimizeForBackend analog — see library.register_backend)
+            from ..library import get_backend
+
+            raw_fn = get_backend(self._backend)(
+                raw_fn, **getattr(self, "_backend_flags", {}))
         jitted = jax.jit(raw_fn)
         return (jitted, names, params, ctx_idx, out_struct, mutated_names)
 

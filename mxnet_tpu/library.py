@@ -1,0 +1,206 @@
+"""Runtime extension loading — custom operators and compile backends.
+
+Reference analog: ``MXLoadLib`` (src/c_api/c_api.cc:1465-1490) dlopens a
+user ``.so`` built against the header-only ``include/mxnet/lib_api.h``,
+registering custom ops, graph passes, and subgraph backends without
+rebuilding the framework (example/extensions/lib_custom_op, lib_pass,
+lib_subgraph; python/mxnet/library.py wraps the load call).
+
+TPU-native design: extensions are *Python modules* (optionally thin shims
+over a C extension or Pallas kernels) that call the public registration
+API below at import time.  Because every op in this framework is a pure
+JAX function in ONE registry (ops/registry.py), a custom op registered
+here works everywhere at once: eager `mx.nd.*` dispatch, the autograd
+tape, hybridized whole-graph jit, Symbol tracing/JSON, and under pjit
+shardings — the same "write one kernel, get all execution paths" contract
+lib_api.h promises, minus the C ABI.
+
+Public surface:
+
+- :func:`register_op` — register a custom operator (optionally with a
+  custom VJP; Pallas kernels register exactly the same way).
+- :func:`register_backend` / :func:`get_backend` — `optimize_for`-style
+  compile backends: a transform applied to the traced pure function before
+  it is jitted (the SubgraphProperty/partitioner analog; here the natural
+  unit is "rewrite the whole XLA-bound function").
+- :func:`load` — import an extension module by file path (the MXLoadLib
+  entry point).
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+from typing import Any, Callable, Dict, Optional, Sequence
+
+__all__ = ["register_op", "register_backend", "get_backend", "list_backends",
+           "load"]
+
+
+def register_op(name: str, fn: Optional[Callable] = None, *,
+                grad: Optional[Callable] = None, num_inputs: int = 1,
+                num_outputs: int = 1, differentiable: bool = True,
+                namespaces: Sequence[str] = ("nd", "npx"),
+                aliases: Sequence[str] = ()):
+    """Register a custom operator (decorator or direct call).
+
+    ``fn(*arrays, **attrs)`` must be a pure JAX function (jnp/lax/pallas).
+    If ``grad`` is given it is installed as a custom VJP:
+    ``grad(residuals, cotangent) -> tuple(input cotangents)`` with
+    ``residuals = (inputs, output)`` — the shape of
+    ``autograd.Function.backward`` users already know.
+
+    The op becomes visible as ``mx.nd.<name>`` (and ``mx.npx.<name>``)
+    immediately, including on already-imported namespace modules, and is
+    picked up by autograd, hybridize, and Symbol tracing through the
+    shared registry.  Reference custom-op analog:
+    example/extensions/lib_custom_op/gemm_lib.cc (forward/backward +
+    parseAttrs registered via lib_api.h REGISTER_OP).
+    """
+
+    def do_register(f: Callable) -> Callable:
+        run = f
+        if grad is not None:
+            import functools
+            import inspect
+
+            import jax
+
+            # custom_vjp cannot resolve keyword args to positions, so the
+            # attrs are closed over: one custom_vjp core per distinct
+            # (hashable) attr combination, cached so eager calls keep
+            # hitting jax's compilation cache
+            @functools.lru_cache(maxsize=None)
+            def _core_for(attr_items):
+                attrs = dict(attr_items)
+
+                @jax.custom_vjp
+                def core(*arrs):
+                    return f(*arrs, **attrs)
+
+                def fwd(*arrs):
+                    out = f(*arrs, **attrs)
+                    return out, (arrs, out)
+
+                def bwd(res, ct):
+                    cts = grad(res, ct)
+                    if not isinstance(cts, (tuple, list)):
+                        cts = (cts,)
+                    return tuple(cts)
+
+                core.defvjp(fwd, bwd)
+                return core
+
+            @functools.wraps(f)
+            def run(*arrays, **attrs):
+                return _core_for(tuple(sorted(attrs.items())))(*arrays)
+
+            run.__signature__ = inspect.signature(f)
+
+        from .ops import registry
+
+        registry.register(
+            name, num_inputs=num_inputs, num_outputs=num_outputs,
+            differentiable=differentiable, aliases=aliases,
+            namespaces=list(namespaces))(run)
+        _export_now(registry.get_op(name))
+        # the module-level symbol is the registered callable (custom VJP
+        # included) so direct use inside user jax.grad code matches mx.nd
+        return run
+
+    if fn is not None:
+        return do_register(fn)
+    return do_register
+
+
+def _export_now(schema) -> None:
+    """Poke the generated op function into namespace modules that have
+    already been imported (import-time generation only covers ops
+    registered before the namespace module loaded)."""
+    from .ndarray.register import make_op_func
+
+    targets = {"nd": "mxnet_tpu.ndarray", "npx": "mxnet_tpu.numpy_extension"}
+    for ns, modname in targets.items():
+        if ns not in schema.namespaces:
+            continue
+        mod = sys.modules.get(modname)
+        if mod is None:
+            continue
+        func = make_op_func(schema)
+        for alias in [schema.name] + list(schema.aliases):
+            if not hasattr(mod, alias):
+                setattr(mod, alias, func)
+
+
+# ---------------------------------------------------------------------------
+# Compile backends (optimize_for)
+# ---------------------------------------------------------------------------
+
+_BACKENDS: Dict[str, Callable] = {}
+
+
+def register_backend(name: str, transform: Optional[Callable] = None):
+    """Register an ``optimize_for`` compile backend (decorator or call).
+
+    ``transform(fn, **flags) -> fn`` receives the traced pure function of a
+    hybridized block — signature ``fn(param_arrays, input_arrays, rng_key)
+    -> (outputs, mutated)`` — and returns a replacement with the same
+    signature, BEFORE it is handed to ``jax.jit``.  Flags come from
+    ``block.hybridize(backend=name, **flags)`` / ``optimize_for``.
+
+    This is the TPU answer to the subgraph-backend plugin system
+    (src/operator/subgraph/subgraph_property.h:86-252 + MXOptimizeForBackend):
+    partition-and-replace passes become whole-function rewrites (wrap in
+    AMP casts, quantize params, re-shard, swap attention impls, ...) and
+    XLA does the actual fusion.
+    """
+
+    def deco(t: Callable) -> Callable:
+        if name in _BACKENDS:
+            raise ValueError(f"backend '{name}' registered twice")
+        _BACKENDS[name] = t
+        return t
+
+    if transform is not None:
+        return deco(transform)
+    return deco
+
+
+def get_backend(name: str) -> Callable:
+    if name not in _BACKENDS:
+        raise KeyError(
+            f"optimize_for backend '{name}' not registered; known: "
+            f"{sorted(_BACKENDS)}")
+    return _BACKENDS[name]
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+# ---------------------------------------------------------------------------
+# Module loading (the MXLoadLib entry point)
+# ---------------------------------------------------------------------------
+
+def load(path: str, verbose: bool = True):
+    """Load an extension module at runtime (reference ``mx.library.load``,
+    python/mxnet/library.py → MXLoadLib).
+
+    ``path`` is a Python source file or a compiled C-extension module
+    (``.so`` built with setuptools against the CPython API); either calls
+    :func:`register_op` / :func:`register_backend` at import.  Returns the
+    loaded module.
+    """
+    if not os.path.exists(path):
+        raise ValueError(f"extension library not found: {path}")
+    modname = "mxnet_tpu_ext_" + os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(modname, path)
+    if spec is None or spec.loader is None:
+        raise ValueError(f"cannot load extension from {path}")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    if verbose:
+        print(f"[mxnet_tpu.library] loaded extension {path}")
+    return mod

@@ -376,11 +376,23 @@ def make_pp_train_step(pipe, optimizer: str = "adam", lr: float = 1e-4,
 def make_train_step(cfg: TransformerLMConfig, mesh: Mesh,
                     optimizer: str = "adam", lr: float = 1e-4,
                     beta1: float = 0.9, beta2: float = 0.999,
-                    epsilon: float = 1e-8, wd: float = 0.01):
+                    epsilon: float = 1e-8, wd: float = 0.01,
+                    grad_accum: int = 1, aux_weight: float = 0.01):
     """Build the jitted SPMD train step.
 
     Batch is sharded over (dp, fsdp); sequence over sp; XLA derives the rest
     from the parameter shardings.  Buffer donation on params+opt state.
+
+    ``grad_accum=k`` scans over k micro-batches inside the step, summing
+    gradients before the single optimizer update (the reference's
+    kAddTo/grad_req='add' accumulation).  The masked-CE is normalised by
+    the GLOBAL valid-token count (computed from the labels up front), so
+    for dense configs a batch of B with k-way accumulation takes exactly
+    the same update as an unaccumulated batch of B.  For MoE configs the
+    load-balance aux loss is computed per micro-batch and averaged — the
+    balance penalty is nonlinear in batch composition, so the aux term
+    (weight 0.01) differs slightly from the full-batch value; this is the
+    standard accumulation semantics for MoE.
     """
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
     seq_axis = "sp" if "sp" in mesh.shape else None
@@ -390,10 +402,41 @@ def make_train_step(cfg: TransformerLMConfig, mesh: Mesh,
         tokens = constraint(tokens, batch_spec)
         labels = constraint(labels, batch_spec)
 
-        def lf(ps):
-            return loss_fn(ps, tokens, labels, cfg, mesh)
+        if grad_accum == 1:
+            def lf(ps):
+                return loss_fn(ps, tokens, labels, cfg, mesh,
+                               aux_weight=aux_weight)
 
-        loss, grads = jax.value_and_grad(lf)(params)
+            loss, grads = jax.value_and_grad(lf)(params)
+        else:
+            k = grad_accum
+            B = tokens.shape[0]
+            assert B % k == 0, f"batch {B} must divide grad_accum {k}"
+            total_valid = jnp.maximum(jnp.sum(labels >= 0), 1).astype(
+                jnp.float32)
+
+            def to_micro(x):
+                x = x.reshape((k, B // k) + x.shape[1:])
+                return constraint(x, P(None, *batch_spec))
+
+            toks_m, labs_m = to_micro(tokens), to_micro(labels)
+
+            def micro_obj(ps, tok, lab):
+                logits, aux = forward(ps, tok, cfg, mesh)
+                nll, _valid = _masked_nll(logits, lab)
+                return jnp.sum(nll) / total_valid + aux_weight * aux / k
+
+            def body(carry, xs):
+                g_acc, loss_acc = carry
+                tok, lab = xs
+                l_mb, g_mb = jax.value_and_grad(micro_obj)(params, tok, lab)
+                return (jax.tree_util.tree_map(jnp.add, g_acc, g_mb),
+                        loss_acc + l_mb), None
+
+            g0 = jax.tree_util.tree_map(
+                lambda w: jnp.zeros(w.shape, jnp.float32), params)
+            (grads, loss), _ = lax.scan(body, (g0, jnp.float32(0)),
+                                        (toks_m, labs_m))
         new_p, new_m, new_v = {}, {}, {}
         lr_t = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
         for n, w in params.items():

@@ -79,6 +79,12 @@ for _npx_name, _op_name in _ALIASES.items():
         _f.__name__ = _npx_name
         setattr(_this, _npx_name, _f)
 
+# ops registered directly into the npx namespace (e.g. custom extensions
+# via mx.library.register_op loaded before this module imported)
+for _name, _schema in list(_registry._OPS.items()):
+    if "npx" in _schema.namespaces and not hasattr(_this, _name):
+        setattr(_this, _name, make_op_func(_schema))
+
 
 def erf(x):
     import jax.scipy.special as jsp

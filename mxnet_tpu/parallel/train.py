@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as onp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import autograd
@@ -25,7 +26,8 @@ from .. import random as _random
 from ..context import current_context
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _wrap
-from .sharding import ShardingPlan, replicated_plan
+from .sharding import ShardingPlan, constraint as _sh_constraint, \
+    replicated_plan
 
 __all__ = ["functional_call", "ShardedTrainer"]
 
@@ -89,7 +91,7 @@ class ShardedTrainer:
                  optimizer_params: Optional[Dict[str, Any]] = None,
                  batch_spec: Optional[P] = None,
                  label_spec: Optional[P] = None,
-                 donate: bool = True):
+                 donate: bool = True, grad_accum: int = 1):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh
@@ -113,12 +115,15 @@ class ShardedTrainer:
             raise ValueError(
                 f"initialize() the block before ShardedTrainer: {uninit[:3]}")
         self.names: List[str] = list(params)
-        add_req = [n for n in self.names if params[n].grad_req == "add"]
-        if add_req:
-            raise NotImplementedError(
-                f"grad_req='add' not supported by ShardedTrainer: {add_req}")
+        # grad_req='add' (the reference's kAddTo accumulate-into-grad, used
+        # for micro-batch accumulation) maps onto in-step accumulation: the
+        # scan over grad_accum micro-batches sums each param's gradient
+        # before the single optimizer update, so 'add' params are simply
+        # trainable here
         self.grad_names = [n for n in self.names
                            if params[n].grad_req != "null"]
+        self.grad_accum = int(grad_accum)
+        assert self.grad_accum >= 1
         # copy before sharding: device_put may alias the source buffer for
         # the co-located shard, and step donation would delete the
         # Parameter's own array through that alias
@@ -193,11 +198,19 @@ class ShardedTrainer:
         names, grad_names = self.names, self.grad_names
         frozen = [n for n in names if n not in grad_names]
 
+        accum = self.grad_accum
+
         def step_fn(params, opt_state, data, label, key, t):
-            def loss_of(trainable):
+            def loss_of(trainable, data, label, key, overrides=None):
                 all_p = dict(trainable)
                 for n in frozen:
                     all_p[n] = params[n]
+                if overrides:
+                    # chained running stats from earlier micro-batches
+                    # (only frozen params — BN stats — are overridden)
+                    for n, arr in overrides.items():
+                        if n not in grad_names:
+                            all_p[n] = arr
                 out, mutated = functional_call(
                     block, all_p, (data,), training=True, rng_key=key)
                 label_nd = _wrap(label, current_context())
@@ -208,8 +221,51 @@ class ShardedTrainer:
                 return loss, mutated
 
             trainable = {n: params[n] for n in grad_names}
-            (loss, mutated), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(trainable)
+            if accum == 1:
+                (loss, mutated), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(trainable, data, label, key)
+            else:
+                # micro-batch gradient accumulation inside the one jitted
+                # step (the reference's kAddTo/grad_req='add' story): scan
+                # over accum micro-batches, sum grads, average at the end.
+                # For per-sample-mean losses and equal micro-batches this
+                # matches the full-batch gradient exactly.
+                def to_micro(x, spec):
+                    x = x.reshape((accum, x.shape[0] // accum)
+                                  + x.shape[1:])
+                    return _sh_constraint(x, P(None, *spec))
+
+                data_m = to_micro(data, self.batch_spec)
+                label_m = to_micro(label, self.label_spec)
+                keys = jax.random.split(key, accum)
+
+                # probe mutated structure (BN running stats) so the scan
+                # can CHAIN stats micro-batch to micro-batch, matching
+                # accum sequential batches
+                mut_struct = jax.eval_shape(
+                    lambda tr, d, l, k: loss_of(tr, d, l, k)[1],
+                    trainable,
+                    jax.ShapeDtypeStruct(data_m.shape[1:], data_m.dtype),
+                    jax.ShapeDtypeStruct(label_m.shape[1:], label_m.dtype),
+                    key)
+                mut0 = {n: params[n] for n in mut_struct}
+
+                def body(carry, xs):
+                    g_acc, loss_acc, mut_state = carry
+                    d_mb, l_mb, k_mb = xs
+                    (loss, mutated), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(trainable, d_mb, l_mb, k_mb,
+                                               mut_state)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    return (g_acc, loss_acc + loss, mutated), None
+
+                g0 = jax.tree_util.tree_map(
+                    lambda w: jnp.zeros(w.shape, jnp.float32), trainable)
+                (grads, loss, mutated), _ = lax.scan(
+                    body, (g0, jnp.float32(0), mut0), (data_m, label_m, keys))
+                inv = 1.0 / accum
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                loss = loss * inv
             new_params = dict(params)
             new_state = dict(opt_state)
             for n in grad_names:

@@ -76,6 +76,112 @@ def test_transformer_lm_train_step_dense_dp_tp():
     assert losses[-1] < losses[0]
 
 
+def test_grad_accum_matches_full_batch():
+    """make_train_step(grad_accum=k) takes the same update as the
+    unaccumulated full batch (VERDICT round-1 item 7: kAddTo parity)."""
+    cfg = _tiny_cfg()
+    mesh = par.make_mesh({"dp": 2})
+    rng = onp.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32)
+    labels_np = rng.randint(0, 64, (8, 16))
+    labels_np[rng.rand(8, 16) < 0.4] = -1
+    labels = jnp.asarray(labels_np, jnp.int32)
+
+    results = {}
+    for accum in (1, 4):
+        params = models.init_params(jax.random.PRNGKey(0), cfg)
+        with mesh:
+            m, v = models.init_opt_state(params)
+            step = models.make_train_step(cfg, mesh, lr=1e-3,
+                                          grad_accum=accum)
+            params, m, v, loss = step(params, m, v, tokens, labels,
+                                      jnp.float32(1))
+        results[accum] = (jax.device_get(params), float(loss))
+
+    p1, l1 = results[1]
+    p4, l4 = results[4]
+    assert abs(l1 - l4) < 1e-5, (l1, l4)
+    for n in p1:
+        assert onp.allclose(onp.asarray(p1[n]), onp.asarray(p4[n]),
+                            atol=2e-5), n
+
+
+def test_sharded_trainer_grad_accum_and_add_req():
+    """ShardedTrainer grad_accum matches the full-batch step and
+    grad_req='add' parameters are accepted."""
+    from mxnet_tpu.gluon import nn
+
+    rng = onp.random.RandomState(1)
+    data = rng.rand(8, 6).astype(onp.float32)
+    label = rng.rand(8, 4).astype(onp.float32)
+
+    def build():
+        net = nn.Dense(4, in_units=6)
+        net.initialize(mx.init.Constant(0.05))
+        # accumulation semantics ride on the in-step micro-batch scan
+        for p in net.collect_params().values():
+            p.grad_req = "add"
+        return net
+
+    def loss_fn(out, lab):
+        d = out - lab
+        return (d * d).mean()
+
+    mesh = par.make_mesh({"dp": 2})
+    outs = {}
+    for accum in (1, 2):
+        tr = par.ShardedTrainer(build(), loss_fn, mesh, optimizer="sgd",
+                                optimizer_params={"lr": 0.1},
+                                grad_accum=accum)
+        tr.step(data, label)
+        outs[accum] = {n: onp.asarray(jax.device_get(a))
+                       for n, a in tr.params.items()}
+    for n in outs[1]:
+        assert onp.allclose(outs[1][n], outs[2][n], atol=1e-6), n
+
+
+def test_sharded_trainer_accum_chains_batchnorm_stats():
+    """grad_accum=k chains BN running stats across micro-batches (matches
+    running k sequential batches, not just the last one)."""
+    from mxnet_tpu.gluon import nn
+
+    rng = onp.random.RandomState(2)
+    data = (rng.rand(8, 6).astype(onp.float32) * 4.0) - 2.0
+    label = rng.rand(8, 3).astype(onp.float32)
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(3, in_units=6), nn.BatchNorm())
+        net.initialize(mx.init.Constant(0.2))
+        net(mx.nd.zeros((1, 6)))   # complete deferred BN init (no stats
+        return net                 # update outside training mode)
+
+    def loss_fn(out, lab):
+        d = out - lab
+        return (d * d).mean()
+
+    mesh = par.make_mesh({"dp": 1})
+    # accumulated: one step over the full batch split into 4 micro-batches
+    tr = par.ShardedTrainer(build(), loss_fn, mesh, optimizer="sgd",
+                            optimizer_params={"lr": 0.0}, grad_accum=4)
+    tr.step(data, label)
+    stats_accum = {n: onp.asarray(jax.device_get(a))
+                   for n, a in tr.params.items() if "running" in n}
+
+    # oracle: 4 sequential steps, one micro-batch each (lr=0 so weights
+    # are frozen and only the running stats evolve)
+    tr2 = par.ShardedTrainer(build(), loss_fn, mesh, optimizer="sgd",
+                             optimizer_params={"lr": 0.0})
+    for i in range(4):
+        tr2.step(data[i * 2:(i + 1) * 2], label[i * 2:(i + 1) * 2])
+    stats_seq = {n: onp.asarray(jax.device_get(a))
+                 for n, a in tr2.params.items() if "running" in n}
+
+    assert stats_accum, "no running stats found"
+    for n in stats_accum:
+        assert onp.allclose(stats_accum[n], stats_seq[n], atol=1e-5), n
+
+
 def test_transformer_lm_moe_ring_all_axes():
     cfg = _tiny_cfg(num_experts=4, use_ring_attention=True)
     mesh = par.make_mesh({"dp": 2, "ep": 2, "sp": 2})

@@ -24,7 +24,8 @@ from ..context import cpu, current_context
 from ..ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "MNISTIter", "ImageRecordIter", "PrefetchingIter", "ResizeIter"]
+           "MNISTIter", "ImageRecordIter", "PrefetchingIter", "ResizeIter",
+           "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -241,6 +242,114 @@ class CSVIter(DataIter):
 
     def getpad(self):
         return self._inner.getpad()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator yielding CSR data batches (reference
+    ``src/io/iter_libsvm.cc``): lines are ``label idx:val idx:val ...``
+    (indices 0-based like the reference's default).  ``data_shape`` is the
+    feature-vector length; labels may themselves be sparse when
+    ``label_libsvm`` is given."""
+
+    @staticmethod
+    def _parse_libsvm(path):
+        """-> (leading labels [N], indptr, indices, values)."""
+        labels, indptr, indices, values = [], [0], [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    indices.append(int(i))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        return (onp.asarray(labels, onp.float32),
+                onp.asarray(indptr, onp.int64),
+                onp.asarray(indices, onp.int64),
+                onp.asarray(values, onp.float32))
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        dim = int(data_shape[0] if isinstance(data_shape, (tuple, list))
+                  else data_shape)
+        labels, self._indptr, self._indices, self._values = \
+            self._parse_libsvm(data_libsvm)
+        self._num = len(labels)
+        if label_libsvm is not None:
+            # separate label file: each line "x i:v i:v ..." densified to
+            # label_shape (reference iter_libsvm.cc label_libsvm param)
+            ldim = int(onp.prod(label_shape))
+            l0, lptr, lidx, lval = self._parse_libsvm(label_libsvm)
+            dense = onp.zeros((len(l0), ldim), onp.float32)
+            for r in range(len(l0)):
+                s, e = lptr[r], lptr[r + 1]
+                dense[r, lidx[s:e]] = lval[s:e]
+            if len(l0) != self._num:
+                raise ValueError(
+                    f"label_libsvm has {len(l0)} rows, data has {self._num}")
+            self._labels = dense.reshape((-1,) + tuple(label_shape))
+        else:
+            self._labels = labels.reshape((-1,) + tuple(label_shape))
+        self._dim = dim
+        self._round = round_batch
+        # sibling-iterator cursor protocol (NDArrayIter): iter_next()
+        # advances first, so start one batch before the data
+        self._cursor = -batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._dim), "float32",
+                         "NC")]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("label",
+                         (self.batch_size,) + self._labels.shape[1:],
+                         "float32", "NC")]
+
+    def reset(self):
+        self._cursor = -self.batch_size
+
+    def iter_next(self):
+        self._cursor += self.batch_size
+        if self._round:
+            return self._cursor < self._num
+        return self._cursor + self.batch_size <= self._num
+
+    def _rows(self):
+        idx = [(self._cursor + k) % self._num if self._round
+               else self._cursor + k for k in range(self.batch_size)]
+        return idx
+
+    def getdata(self):
+        from ..ndarray import sparse as _sp
+
+        rows = self._rows()
+        indptr = [0]
+        indices, values = [], []
+        for r in rows:
+            s, e = self._indptr[r], self._indptr[r + 1]
+            indices.extend(self._indices[s:e])
+            values.extend(self._values[s:e])
+            indptr.append(len(indices))
+        return [_sp.csr_matrix(
+            (onp.asarray(values, onp.float32),
+             onp.asarray(indices, onp.int64),
+             onp.asarray(indptr, onp.int64)),
+            shape=(self.batch_size, self._dim))]
+
+    def getlabel(self):
+        from ..ndarray.ndarray import array as _array
+
+        return [_array(self._labels[self._rows()])]
+
+    def getpad(self):
+        over = self._cursor + self.batch_size - self._num
+        return max(0, over) if self._round else 0
 
 
 def _read_idx_images(path):

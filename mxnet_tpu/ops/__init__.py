@@ -17,6 +17,8 @@ from . import init as _init  # noqa: F401
 from . import optimizer as _optimizer  # noqa: F401
 from . import linalg as _linalg  # noqa: F401
 from . import contrib as _contrib  # noqa: F401
+from . import detection as _detection  # noqa: F401
+from . import extra as _extra  # noqa: F401
 from . import control_flow as _control_flow  # noqa: F401
 from . import rnn as _rnn  # noqa: F401
 

@@ -220,3 +220,199 @@ def box_iou(lhs, rhs, format="corner"):
     area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
     area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
     return inter / (area_a + area_b - inter + 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Spatial transform family (reference src/operator/spatial_transformer.cc,
+# bilinear_sampler.cc, grid_generator.cc) — all fully differentiable.
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample_2d(img, gx, gy):
+    """Sample img [C,H,W] at normalized grid coords gx/gy [-1,1] of shape
+    [Ho,Wo]; zero padding outside (matches reference BilinearSampler)."""
+    C, H, W = img.shape
+    x = (gx + 1.0) * (W - 1) / 2.0
+    y = (gy + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx1 = x - x0
+    wy1 = y - y0
+
+    def gather(yi, xi):
+        inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]                   # [C,Ho,Wo]
+        return jnp.where(inb[None], v, 0.0)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    return (v00 * (1 - wy1) * (1 - wx1) + v01 * (1 - wy1) * wx1
+            + v10 * wy1 * (1 - wx1) + v11 * wy1 * wx1)
+
+
+@register("BilinearSampler", num_inputs=2, aliases=["bilinear_sampler"])
+def bilinear_sampler(data, grid, cudnn_off=None):
+    """data [B,C,H,W] sampled at grid [B,2,Ho,Wo] (channel 0 = x, 1 = y,
+    normalized to [-1,1]) -> [B,C,Ho,Wo].  Reference
+    src/operator/bilinear_sampler.cc."""
+    return jax.vmap(lambda d, g: _bilinear_sample_2d(d, g[0], g[1]))(
+        data, grid)
+
+
+def _affine_grid(theta, Ho, Wo):
+    """theta [6] row-major 2x3 -> normalized sampling grid [2,Ho,Wo]."""
+    t = theta.reshape(2, 3)
+    ys = jnp.linspace(-1.0, 1.0, Ho)
+    xs = jnp.linspace(-1.0, 1.0, Wo)
+    xg, yg = jnp.meshgrid(xs, ys)            # [Ho,Wo]
+    ones = jnp.ones_like(xg)
+    coords = jnp.stack([xg, yg, ones], axis=0).reshape(3, -1)
+    out = t @ coords                          # [2, Ho*Wo]
+    return out.reshape(2, Ho, Wo)
+
+
+@register("GridGenerator", num_inputs=1, aliases=["grid_generator"])
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Generate a BilinearSampler grid (reference grid_generator.cc).
+
+    - affine: data [B,6] affine params -> grid [B,2,Ho,Wo]
+    - warp: data [B,2,H,W] pixel flow field added to the identity grid,
+      normalized to [-1,1]
+    """
+    if transform_type == "affine":
+        Ho, Wo = int(target_shape[0]), int(target_shape[1])
+        return jax.vmap(lambda th: _affine_grid(th, Ho, Wo))(data)
+    if transform_type == "warp":
+        B, _, H, W = data.shape
+        xs = jnp.arange(W, dtype=data.dtype)
+        ys = jnp.arange(H, dtype=data.dtype)
+        xg, yg = jnp.meshgrid(xs, ys)
+        gx = (xg[None] + data[:, 0]) * 2.0 / jnp.maximum(W - 1, 1) - 1.0
+        gy = (yg[None] + data[:, 1]) * 2.0 / jnp.maximum(H - 1, 1) - 1.0
+        return jnp.stack([gx, gy], axis=1)
+    raise ValueError(f"unknown transform_type {transform_type}")
+
+
+@register("SpatialTransformer", num_inputs=2,
+          aliases=["spatial_transformer"])
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=None):
+    """Affine spatial transformer network op (reference
+    spatial_transformer.cc): loc [B,6] -> affine grid -> bilinear sample."""
+    assert transform_type == "affine" and sampler_type == "bilinear"
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register("DeformableConvolution", num_inputs=-1,
+          aliases=["deformable_convolution"])
+def deformable_convolution(arrays, kernel=(3, 3), stride=(1, 1),
+                           dilate=(1, 1), pad=(0, 0), num_filter=1,
+                           num_group=1, num_deformable_group=1,
+                           no_bias=False, workspace=1024, layout=None):
+    """Deformable convolution v1 (reference
+    src/operator/contrib/deformable_convolution.cc).
+
+    arrays = [data [B,C,H,W], offset [B, 2*kh*kw*ndg, Ho, Wo], weight
+    [O, C/g, kh, kw], (bias [O])].  TPU-native lowering: bilinear-sample
+    the input at kernel+offset positions (gather; differentiable), then a
+    single einsum over (C/g, kh, kw) — the im2col+GEMM split the MXU
+    likes.
+    """
+    data, offset, weight = arrays[0], arrays[1], arrays[2]
+    bias = None if no_bias or len(arrays) < 4 else arrays[3]
+    B, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    ndg = num_deformable_group
+    O = num_filter
+    g = num_group
+
+    # base sampling positions [kh*kw, Ho, Wo]
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = oy[None, :, None] + ky[:, None, None]          # [kh,Ho,1]
+    base_x = ox[None, None, :] + kx[:, None, None]          # [kw,1,Wo]
+    base_y = jnp.broadcast_to(base_y[:, None], (kh, kw, Ho, Wo))
+    base_x = jnp.broadcast_to(base_x[None, :, :, :], (kh, kw, Ho, Wo))
+
+    def sample_one(dat, off):
+        # dat [C,H,W]; off [2*kh*kw*ndg, Ho, Wo] layout: per deform group,
+        # per kernel point, (dy, dx)
+        off = off.reshape(ndg, kh * kw, 2, Ho, Wo)
+        cs = C // ndg
+        outs = []
+        for dg in range(ndg):
+            dy = base_y.reshape(kh * kw, Ho, Wo) + off[dg, :, 0]
+            dx = base_x.reshape(kh * kw, Ho, Wo) + off[dg, :, 1]
+            # normalize to [-1,1] for the shared bilinear sampler
+            gx = dx * 2.0 / jnp.maximum(W - 1, 1) - 1.0
+            gy = dy * 2.0 / jnp.maximum(H - 1, 1) - 1.0
+            sub = dat[dg * cs:(dg + 1) * cs]
+            # sample all kernel points: [C/ndg, kh*kw, Ho, Wo]
+            samp = jax.vmap(
+                lambda xg, yg: _bilinear_sample_2d(sub, xg, yg),
+                in_axes=(0, 0), out_axes=1)(gx, gy)
+            outs.append(samp)
+        return jnp.concatenate(outs, axis=0)    # [C, kh*kw, Ho, Wo]
+
+    cols = jax.vmap(sample_one)(data, offset)   # [B,C,kh*kw,Ho,Wo]
+    cols = cols.reshape(B, g, C // g, kh, kw, Ho, Wo)
+    wgt = weight.reshape(g, O // g, C // g, kh, kw)
+    out = jnp.einsum("bgchkxy,gochk->bgoxy", cols, wgt,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(B, O, Ho, Wo).astype(data.dtype)
+    if bias is not None:
+        out = out + bias.reshape(1, O, 1, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# FFT + count_sketch (reference src/operator/contrib/fft.cc, ifft.cc,
+# count_sketch.cc — cuFFT-based there, jnp.fft on TPU here)
+# ---------------------------------------------------------------------------
+
+@register("fft")
+def fft(data, compute_size=128):
+    """Batched 1D FFT of real input [..., d] -> [..., 2*d] with real/imag
+    interleaved (reference fft-inl.h:80-130 output layout)."""
+    c = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([c.real, c.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(
+        data.dtype)
+
+
+@register("ifft")
+def ifft(data, compute_size=128):
+    """Inverse of :func:`fft`: [..., 2*d] interleaved -> [..., d] real.
+    Like cuFFT (reference ifft.cc), the transform is UNNORMALIZED — scale
+    by 1/d to invert ``fft``."""
+    d = data.shape[-1] // 2
+    x = data.reshape(data.shape[:-1] + (d, 2)).astype(jnp.float32)
+    c = jax.lax.complex(x[..., 0], x[..., 1])
+    out = jnp.fft.ifft(c, axis=-1).real * d
+    return out.astype(data.dtype)
+
+
+@register("count_sketch", num_inputs=3)
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count-sketch projection (reference count_sketch.cc): out[..., h[i]]
+    += s[i] * data[..., i]; h in [0, out_dim), s in {+1,-1}."""
+    out_dim = int(out_dim)
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    flat = data.reshape(-1, data.shape[-1])
+    contrib = flat * sign[None, :]
+    out = jnp.zeros((flat.shape[0], out_dim), data.dtype)
+    out = out.at[:, idx].add(contrib)
+    return out.reshape(data.shape[:-1] + (out_dim,))

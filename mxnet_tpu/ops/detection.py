@@ -1,0 +1,372 @@
+"""Detection operators: NMS, box transforms, SSD multibox suite.
+
+Reference: ``src/operator/contrib/bounding_box.cc`` (box_nms/box_iou/
+bipartite_matching/box_encode/box_decode) and the SSD ops
+``multibox_prior.cc`` / ``multibox_target.cc`` / ``multibox_detection.cc``.
+
+TPU-native design: everything is fixed-shape.  The greedy sequential parts
+(NMS suppression, bipartite matching, SSD's two-phase anchor matching) are
+``lax.scan`` loops over a statically-sized candidate axis carrying boolean
+keep/match masks — O(N) scan steps over vectorised [N] or [N,M] updates,
+batched with ``jax.vmap``.  Sorting uses XLA's sort; "removed" boxes are
+filled with -1 exactly like the reference so downstream consumers see the
+same layout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_FMT = {"corner": 0, "center": 1, 0: 0, 1: 1}
+
+
+def _to_corner(b, fmt):
+    if _FMT[fmt] == 0:
+        return b
+    x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _from_corner(b, fmt):
+    if _FMT[fmt] == 0:
+        return b
+    l, t, r, bt = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([(l + r) / 2, (t + bt) / 2, r - l, bt - t], axis=-1)
+
+
+def _pair_iou(a, b):
+    """Pairwise IoU of corner boxes a [N,4] x b [M,4] -> [N,M]."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(
+        a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _nms_single(x, overlap_thresh, valid_thresh, topk, coord_start,
+                score_index, id_index, background_id, force_suppress,
+                in_format, out_format):
+    N = x.shape[0]
+    scores = x[:, score_index]
+    valid = scores > valid_thresh
+    if id_index >= 0 and background_id >= 0:
+        valid &= x[:, id_index] != background_id
+    order = jnp.argsort(-scores, stable=True)
+    xs = x[order]
+    valid_s = valid[order]
+    if topk > 0:
+        valid_s &= jnp.arange(N) < topk
+    boxes = _to_corner(xs[:, coord_start:coord_start + 4], in_format)
+    iou = _pair_iou(boxes, boxes)
+    if id_index >= 0 and not force_suppress:
+        same = xs[:, None, id_index] == xs[None, :, id_index]
+    else:
+        same = jnp.ones((N, N), bool)
+    sup = (iou > overlap_thresh) & same
+
+    def body(kept, i):
+        hit = jnp.any(kept & sup[i])
+        kept = kept.at[i].set(valid_s[i] & ~hit)
+        return kept, None
+
+    kept, _ = lax.scan(body, jnp.zeros((N,), bool), jnp.arange(N))
+    # compact kept rows to the front, preserving descending-score order
+    rank = jnp.argsort(jnp.where(kept, 0, 1), stable=True)
+    out = xs[rank]
+    keptc = kept[rank]
+    coords = _from_corner(
+        _to_corner(out[:, coord_start:coord_start + 4], in_format),
+        out_format)
+    out = lax.dynamic_update_slice(out, coords.astype(out.dtype),
+                                   (0, coord_start))
+    return jnp.where(keptc[:, None], out, jnp.asarray(-1.0, out.dtype))
+
+
+@register("box_nms", num_inputs=1, differentiable=False,
+          aliases=["box_non_maximum_suppression"])
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Greedy NMS; suppressed boxes are filled with -1 and survivors are
+    sorted by descending score (reference bounding_box.cc:41-110)."""
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+    out = jax.vmap(lambda b: _nms_single(
+        b, overlap_thresh, valid_thresh, int(topk), int(coord_start),
+        int(score_index), int(id_index), int(background_id),
+        bool(force_suppress), in_format, out_format))(flat)
+    return out.reshape(shape)
+
+
+@register("bipartite_matching", num_inputs=1, num_outputs=2,
+          differentiable=False)
+def bipartite_matching(data, threshold=1e-12, is_ascend=False, topk=-1):
+    """Greedy bipartite matching on a [..., N, M] score matrix
+    (reference bounding_box.cc:163-201): repeatedly take the globally best
+    unmatched (row, col) pair.  Returns (row->col [..., N], col->row
+    [..., M]), -1 for unmatched."""
+    shape = data.shape
+    N, M = shape[-2:]
+    flat = data.reshape((-1, N, M))
+    T = min(N, M) if topk < 0 else min(topk, N, M)
+
+    def single(s):
+        big = jnp.asarray(-jnp.inf, s.dtype)
+        work = -s if is_ascend else s
+        ok = (s >= threshold) if not is_ascend else (s <= threshold)
+        work = jnp.where(ok, work, big)
+
+        def body(carry, _):
+            work, rows, cols = carry
+            idx = jnp.argmax(work)
+            i, j = idx // M, idx % M
+            good = work[i, j] > big
+            rows = jnp.where(good, rows.at[i].set(j), rows)
+            cols = jnp.where(good, cols.at[j].set(i), cols)
+            work = jnp.where(good, work.at[i, :].set(big), work)
+            work = jnp.where(good, work.at[:, j].set(big), work)
+            return (work, rows, cols), None
+
+        init = (work, jnp.full((N,), -1, jnp.int32),
+                jnp.full((M,), -1, jnp.int32))
+        (_, rows, cols), _ = lax.scan(body, init, None, length=T)
+        return rows, cols
+
+    rows, cols = jax.vmap(single)(flat)
+    return (rows.reshape(shape[:-2] + (N,)).astype(data.dtype),
+            cols.reshape(shape[:-2] + (M,)).astype(data.dtype))
+
+
+@register("box_encode", num_inputs=6, differentiable=False)
+def box_encode(samples, matches, anchors, refs, means, stds):
+    """Encode matched boxes as normalised center offsets
+    (reference bounding_box.cc:211-232).  samples [B,N] (+1 pos), matches
+    [B,N] gt index, anchors/refs corner boxes."""
+    a = _from_corner(anchors, "center")           # [B,N,4] center
+    m = jnp.take_along_axis(refs, matches[..., None].astype(jnp.int32),
+                            axis=1)               # [B,N,4]
+    g = _from_corner(m, "center")
+    t = jnp.stack([
+        (g[..., 0] - a[..., 0]) / a[..., 2],
+        (g[..., 1] - a[..., 1]) / a[..., 3],
+        jnp.log(jnp.maximum(g[..., 2], 1e-12) / a[..., 2]),
+        jnp.log(jnp.maximum(g[..., 3], 1e-12) / a[..., 3])], axis=-1)
+    t = (t - means.reshape(1, 1, 4)) / stds.reshape(1, 1, 4)
+    mask = (samples > 0.5)[..., None]
+    return jnp.where(mask, t, 0.0), jnp.broadcast_to(
+        mask, t.shape).astype(t.dtype)
+
+
+@register("box_decode", num_inputs=2, differentiable=False)
+def box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
+               clip=-1.0, format="corner"):
+    """Decode center-offset predictions back to boxes
+    (reference bounding_box.cc:234-253)."""
+    a = _from_corner(_to_corner(anchors, format), "center")
+    dx = data[..., 0] * std0 * a[..., 2] + a[..., 0]
+    dy = data[..., 1] * std1 * a[..., 3] + a[..., 1]
+    dw = jnp.exp(data[..., 2] * std2) * a[..., 2] / 2
+    dh = jnp.exp(data[..., 3] * std3) * a[..., 3] / 2
+    out = jnp.stack([dx - dw, dy - dh, dx + dw, dy + dh], axis=-1)
+    if clip > 0:
+        out = jnp.clip(out, 0.0, clip)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SSD multibox suite
+# ---------------------------------------------------------------------------
+
+@register("multibox_prior", num_inputs=1, differentiable=False,
+          aliases=["MultiBoxPrior"])
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Generate SSD anchor boxes from a feature map [B,C,H,W] ->
+    (1, H*W*(num_sizes+num_ratios-1), 4) corner boxes in [0,1] coords
+    (reference multibox_prior.cc:30-70)."""
+    H, W = data.shape[-2], data.shape[-1]
+    sizes = tuple(float(s) for s in sizes) or (1.0,)
+    ratios = tuple(float(r) for r in ratios) or (1.0,)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    # anchor set per location: all sizes at ratio[0], then ratios[1:] at
+    # sizes[0] (reference ordering)
+    ws, hs = [], []
+    r0 = float(ratios[0]) ** 0.5
+    for s in sizes:
+        ws.append(s * H / W * r0 / 2)
+        hs.append(s / r0 / 2)
+    for r in ratios[1:]:
+        rr = float(r) ** 0.5
+        ws.append(sizes[0] * H / W * rr / 2)
+        hs.append(sizes[0] / rr / 2)
+    ws = jnp.asarray(ws, jnp.float32)       # [A]
+    hs = jnp.asarray(hs, jnp.float32)
+    cxg, cyg = jnp.meshgrid(cx, cy)         # [H,W]
+    cxg = cxg[..., None]                    # [H,W,1]
+    cyg = cyg[..., None]
+    out = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs], axis=-1)
+    out = out.reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _multibox_match_single(iou, gt_valid, overlap_threshold):
+    """Two-phase SSD matching on iou [N,M] with gt mask [M].
+
+    Phase 1 (bipartite): each gt greedily grabs its best unmatched anchor.
+    Phase 2: remaining anchors take their best gt if iou > threshold.
+    Returns (anchor_flags [N] int32: 1 pos / -1 ignore, matches [N] int32,
+    match_iou [N]).  Reference multibox_target.cc:106-180.
+    """
+    N, M = iou.shape
+    big = jnp.asarray(-jnp.inf, jnp.float32)
+    work = jnp.where(gt_valid[None, :], iou.astype(jnp.float32), big)
+
+    def body(carry, _):
+        work, flags, matches = carry
+        idx = jnp.argmax(work)
+        i, j = idx // M, idx % M
+        good = work[i, j] > 1e-6
+        flags = jnp.where(good, flags.at[i].set(1), flags)
+        matches = jnp.where(good, matches.at[i].set(j), matches)
+        work = jnp.where(good, work.at[i, :].set(big), work)
+        work = jnp.where(good, work.at[:, j].set(big), work)
+        return (work, flags, matches), None
+
+    init = (work, jnp.full((N,), -1, jnp.int32),
+            jnp.full((N,), -1, jnp.int32))
+    (_, flags, matches), _ = lax.scan(body, init, None, length=M)
+
+    masked_iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(masked_iou, axis=1)
+    best_iou = jnp.max(masked_iou, axis=1)
+    phase2 = (flags != 1) & (best_iou > overlap_threshold)
+    flags = jnp.where(phase2, 1, flags)
+    matches = jnp.where(phase2, best_gt.astype(jnp.int32), matches)
+    # per-anchor best-gt IoU, used by negative mining's threshold test
+    return flags, matches, best_iou
+
+
+@register("multibox_target", num_inputs=3, num_outputs=3,
+          differentiable=False, aliases=["MultiBoxTarget"])
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (reference multibox_target.cc).
+
+    anchor (1,N,4) corner; label (B,M,5+) rows [cls, xmin, ymin, xmax,
+    ymax, ...] with cls=-1 padding; cls_pred (B,C,N) raw logits.
+    Returns (loc_target (B,N*4), loc_mask (B,N*4), cls_target (B,N)).
+    """
+    anc = anchor.reshape(-1, 4)
+    N = anc.shape[0]
+    v = tuple(float(x) for x in variances)
+
+    def single(lab, cls_p):
+        gt_valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _pair_iou(anc, gt_boxes)
+        flags, matches, match_iou = _multibox_match_single(
+            iou, gt_valid, overlap_threshold)
+        num_pos = jnp.sum(flags == 1)
+        if negative_mining_ratio > 0:
+            # hard-negative mining: among anchors with best-iou below the
+            # mining threshold, keep those whose background logit is LEAST
+            # confident (highest bg softmax prob ranks first for negation)
+            logits = cls_p                     # [C, N]
+            prob_bg = jax.nn.softmax(logits, axis=0)[0]
+            cand = (flags != 1) & (match_iou < negative_mining_thresh)
+            want = jnp.maximum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                minimum_negative_samples)
+            score = jnp.where(cand, -prob_bg, -jnp.inf)
+            order = jnp.argsort(-score)       # most-confusing first
+            rankpos = jnp.empty_like(order).at[order].set(jnp.arange(N))
+            neg = cand & (rankpos < want)
+            flags = jnp.where(neg, 0, flags)
+        else:
+            flags = jnp.where(flags != 1, 0, flags)
+        pos = flags == 1
+        safe_match = jnp.clip(matches, 0, lab.shape[0] - 1)
+        g = gt_boxes[safe_match]               # [N,4]
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        ax = (anc[:, 0] + anc[:, 2]) / 2
+        ay = (anc[:, 1] + anc[:, 3]) / 2
+        gw = g[:, 2] - g[:, 0]
+        gh = g[:, 3] - g[:, 1]
+        gx = (g[:, 0] + g[:, 2]) / 2
+        gy = (g[:, 1] + g[:, 3]) / 2
+        loc = jnp.stack([(gx - ax) / aw / v[0], (gy - ay) / ah / v[1],
+                         jnp.log(jnp.maximum(gw, 1e-12) / aw) / v[2],
+                         jnp.log(jnp.maximum(gh, 1e-12) / ah) / v[3]],
+                        axis=-1)
+        loc_target = jnp.where(pos[:, None], loc, 0.0).reshape(-1)
+        loc_mask = jnp.where(pos[:, None],
+                             jnp.ones((N, 4), loc.dtype), 0.0).reshape(-1)
+        cls_t = jnp.where(pos, lab[safe_match, 0] + 1.0,
+                          jnp.where(flags == 0, 0.0, float(ignore_label)))
+        return loc_target, loc_mask, cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(single)(label, cls_pred)
+    return loc_t.astype(anchor.dtype), loc_m.astype(anchor.dtype), \
+        cls_t.astype(anchor.dtype)
+
+
+@register("multibox_detection", num_inputs=3, differentiable=False,
+          aliases=["MultiBoxDetection"])
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode SSD predictions into detections [B,N,6] rows
+    [class_id, score, xmin, ymin, xmax, ymax], suppressed rows -1
+    (reference multibox_detection.cc:40-120)."""
+    anc = anchor.reshape(-1, 4)
+    N = anc.shape[0]
+    v = tuple(float(x) for x in variances)
+
+    def single(probs, locs):
+        # class with best non-background prob per anchor
+        fg = jnp.concatenate([jnp.full((1, N), -jnp.inf, probs.dtype),
+                              probs[1:]], axis=0) \
+            if probs.shape[0] > 1 else probs
+        # output ids are 0-based foreground classes (argmax - 1, reference
+        # multibox_detection.cc:125 "outputs[i*6] = id - 1")
+        cid = jnp.argmax(fg, axis=0).astype(jnp.float32) - 1.0
+        score = jnp.max(fg, axis=0)
+        keep = score >= threshold
+        cid = jnp.where(keep, cid, -1.0)
+        lp = locs.reshape(N, 4)
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        ax = (anc[:, 0] + anc[:, 2]) / 2
+        ay = (anc[:, 1] + anc[:, 3]) / 2
+        ox = lp[:, 0] * v[0] * aw + ax
+        oy = lp[:, 1] * v[1] * ah + ay
+        ow = jnp.exp(lp[:, 2] * v[2]) * aw / 2
+        oh = jnp.exp(lp[:, 3] * v[3]) * ah / 2
+        boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        rows = jnp.concatenate([cid[:, None], score[:, None], boxes],
+                               axis=-1)
+        rows = jnp.where(keep[:, None], rows, -1.0)
+        return _nms_single(rows, nms_threshold, 0.0, int(nms_topk), 2, 1, 0,
+                           -1, bool(force_suppress), "corner", "corner")
+
+    return jax.vmap(single)(cls_prob, loc_pred.reshape(cls_prob.shape[0],
+                                                       -1))

@@ -1,0 +1,501 @@
+"""Breadth operators: indexing/ravel, krprod, pdf family, regression
+outputs, logical/bitwise, linalg-lite, Correlation/PSROIPooling/Proposal.
+
+Reference homes: src/operator/tensor/ravel.cc, contrib/krprod.cc,
+contrib/all_finite.cc, random/pdf_op.cc, regression_output.cc,
+correlation.cc, contrib/psroi_pooling.cc, contrib/proposal.cc, plus the
+numpy elemwise zoo.  Each op is a direct XLA lowering; the loss-layer
+``*RegressionOutput`` ops reproduce the reference's special backward
+(gradient of the implied loss, independent of the head cotangent) via
+``jax.custom_vjp``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import alias, register
+
+# ---------------------------------------------------------------------------
+# indexing / ravel
+# ---------------------------------------------------------------------------
+
+
+@register("unravel_index", num_inputs=1, differentiable=False)
+def unravel_index(data, shape=None):
+    """Flat indices [N] -> coordinates [ndim, N] (tensor/ravel.cc)."""
+    coords = jnp.unravel_index(data.astype(jnp.int64), tuple(shape))
+    return jnp.stack([c.astype(data.dtype) for c in coords], axis=0)
+
+
+@register("ravel_multi_index", num_inputs=1, differentiable=False)
+def ravel_multi_index(data, shape=None):
+    """Coordinates [ndim, N] -> flat indices [N] (tensor/ravel.cc)."""
+    shape = tuple(int(s) for s in shape)
+    idx = 0
+    for d, s in enumerate(shape):
+        idx = idx * s + data[d].astype(jnp.int64)
+    return idx.astype(data.dtype)
+
+
+@register("batch_take", num_inputs=2, differentiable=False)
+def batch_take(a, indices):
+    """a [N, M] taken at per-row column index [N] (tensor/indexing_op.cc)."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("choose_element_0index", num_inputs=2, differentiable=False)
+def choose_element_0index(data, index):
+    return batch_take(data, index)
+
+
+@register("fill_element_0index", num_inputs=3, differentiable=False)
+def fill_element_0index(lhs, mhs, rhs):
+    """lhs[i, rhs[i]] = mhs[i] (legacy top-level op)."""
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, rhs.astype(jnp.int32)].set(mhs)
+
+
+@register("Crop", num_inputs=-1, differentiable=True)
+def crop(arrays, num_args=1, offset=(0, 0), h_w=(0, 0), center_crop=False):
+    """Legacy Crop op (src/operator/crop.cc): crop arrays[0] to the size of
+    arrays[1] (or h_w) at ``offset`` / center."""
+    data = arrays[0]
+    H, W = data.shape[2], data.shape[3]
+    if len(arrays) > 1:
+        th, tw = arrays[1].shape[2], arrays[1].shape[3]
+    else:
+        th, tw = h_w
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = offset
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+# ---------------------------------------------------------------------------
+# krprod / all_finite
+# ---------------------------------------------------------------------------
+
+
+@register("khatri_rao", num_inputs=-1)
+def khatri_rao(arrays):
+    """Column-wise Kronecker product (contrib/krprod.cc)."""
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = (out[:, None, :] * a[None, :, :]).reshape(-1, out.shape[1])
+    return out
+
+
+@register("all_finite", num_inputs=1, differentiable=False)
+def all_finite(data, init_output=True):
+    """1.0 if every element is finite (contrib/all_finite.cc) -> [1]."""
+    return jnp.all(jnp.isfinite(data)).astype(jnp.float32).reshape(1)
+
+
+@register("multi_all_finite", num_inputs=-1, differentiable=False)
+def multi_all_finite(arrays, num_arrays=0, init_output=True):
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok &= jnp.all(jnp.isfinite(a))
+    return ok.astype(jnp.float32).reshape(1)
+
+
+# ---------------------------------------------------------------------------
+# regression output loss layers (src/operator/regression_output.cc):
+# forward is the prediction; backward is the loss gradient wrt data,
+# INDEPENDENT of the incoming cotangent (the reference treats these as
+# terminal loss nodes).
+# ---------------------------------------------------------------------------
+
+
+def _regression_output(name, fwd_fn, grad_fn):
+    import functools
+
+    @functools.lru_cache(maxsize=None)
+    def core_for(scale):
+        @jax.custom_vjp
+        def core(data, label):
+            return fwd_fn(data)
+
+        def fwd(data, label):
+            return fwd_fn(data), (data, label)
+
+        def bwd(res, ct):
+            data, label = res
+            g = grad_fn(data, label) * scale
+            return (g.astype(data.dtype), jnp.zeros_like(label))
+
+        core.defvjp(fwd, bwd)
+        return core
+
+    def op(data, label, grad_scale=1.0):
+        return core_for(float(grad_scale))(data, label)
+
+    op.__name__ = name
+    return op
+
+
+@register("LinearRegressionOutput", num_inputs=2,
+          aliases=["linear_regression_output"])
+def linear_regression_output(data, label, grad_scale=1.0):
+    """Identity forward; backward = (data - label) * grad_scale."""
+    return _lin_reg(data, label, grad_scale)
+
+
+_lin_reg = _regression_output(
+    "LinearRegressionOutput", lambda d: d, lambda d, l: d - l)
+
+
+@register("MAERegressionOutput", num_inputs=2,
+          aliases=["mae_regression_output"])
+def mae_regression_output(data, label, grad_scale=1.0):
+    """Identity forward; backward = sign(data - label) * grad_scale."""
+    return _mae_reg(data, label, grad_scale)
+
+
+_mae_reg = _regression_output(
+    "MAERegressionOutput", lambda d: d, lambda d, l: jnp.sign(d - l))
+
+
+@register("LogisticRegressionOutput", num_inputs=2,
+          aliases=["logistic_regression_output"])
+def logistic_regression_output(data, label, grad_scale=1.0):
+    """Sigmoid forward; backward = (sigmoid(data) - label) * grad_scale."""
+    return _log_reg(data, label, grad_scale)
+
+
+_log_reg = _regression_output(
+    "LogisticRegressionOutput", jax.nn.sigmoid,
+    lambda d, l: jax.nn.sigmoid(d) - l)
+
+
+# ---------------------------------------------------------------------------
+# pdf family (src/operator/random/pdf_op.cc): elementwise densities of the
+# sampling ops, differentiable wrt sample AND parameters
+# ---------------------------------------------------------------------------
+
+
+def _maybe_log(p_log, is_log):
+    return p_log if is_log else jnp.exp(p_log)
+
+
+@register("pdf_normal", num_inputs=3)
+def pdf_normal(sample, mu, sigma, is_log=False):
+    logp = -0.5 * jnp.square((sample - mu) / sigma) \
+        - jnp.log(sigma) - 0.5 * jnp.log(2 * jnp.pi)
+    return _maybe_log(logp, is_log)
+
+
+@register("pdf_uniform", num_inputs=3)
+def pdf_uniform(sample, low, high, is_log=False):
+    inside = (sample >= low) & (sample <= high)
+    logp = jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+    return _maybe_log(logp, is_log)
+
+
+@register("pdf_gamma", num_inputs=3)
+def pdf_gamma(sample, alpha, beta, is_log=False):
+    logp = alpha * jnp.log(beta) + (alpha - 1) * jnp.log(sample) \
+        - beta * sample - jax.lax.lgamma(alpha)
+    return _maybe_log(logp, is_log)
+
+
+@register("pdf_exponential", num_inputs=2)
+def pdf_exponential(sample, lam, is_log=False):
+    logp = jnp.log(lam) - lam * sample
+    return _maybe_log(logp, is_log)
+
+
+@register("pdf_poisson", num_inputs=2)
+def pdf_poisson(sample, lam, is_log=False):
+    logp = sample * jnp.log(lam) - lam - jax.lax.lgamma(sample + 1.0)
+    return _maybe_log(logp, is_log)
+
+
+@register("pdf_negative_binomial", num_inputs=3)
+def pdf_negative_binomial(sample, k, p, is_log=False):
+    logp = jax.lax.lgamma(sample + k) - jax.lax.lgamma(sample + 1.0) \
+        - jax.lax.lgamma(k) + k * jnp.log(p) + sample * jnp.log1p(-p)
+    return _maybe_log(logp, is_log)
+
+
+@register("pdf_generalized_negative_binomial", num_inputs=3)
+def pdf_generalized_negative_binomial(sample, mu, alpha, is_log=False):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    return pdf_negative_binomial(sample, k, p, is_log=is_log)
+
+
+@register("pdf_dirichlet", num_inputs=2)
+def pdf_dirichlet(sample, alpha, is_log=False):
+    logp = jnp.sum((alpha - 1.0) * jnp.log(sample), axis=-1) \
+        + jax.lax.lgamma(jnp.sum(alpha, axis=-1)) \
+        - jnp.sum(jax.lax.lgamma(alpha), axis=-1)
+    return _maybe_log(logp, is_log)
+
+
+# ---------------------------------------------------------------------------
+# logical / bitwise / numpy-elemwise leftovers
+# ---------------------------------------------------------------------------
+
+
+@register("logical_and", num_inputs=2, differentiable=False,
+          namespaces=("nd", "np"))
+def logical_and(lhs, rhs):
+    return ((lhs != 0) & (rhs != 0)).astype(lhs.dtype)
+
+
+@register("logical_or", num_inputs=2, differentiable=False,
+          namespaces=("nd", "np"))
+def logical_or(lhs, rhs):
+    return ((lhs != 0) | (rhs != 0)).astype(lhs.dtype)
+
+
+@register("logical_xor", num_inputs=2, differentiable=False,
+          namespaces=("nd", "np"))
+def logical_xor(lhs, rhs):
+    return ((lhs != 0) ^ (rhs != 0)).astype(lhs.dtype)
+
+
+@register("bitwise_and", num_inputs=2, differentiable=False,
+          namespaces=("nd", "np"))
+def bitwise_and(lhs, rhs):
+    return jnp.bitwise_and(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
+        .astype(lhs.dtype)
+
+
+@register("bitwise_or", num_inputs=2, differentiable=False,
+          namespaces=("nd", "np"))
+def bitwise_or(lhs, rhs):
+    return jnp.bitwise_or(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
+        .astype(lhs.dtype)
+
+
+@register("bitwise_xor", num_inputs=2, differentiable=False,
+          namespaces=("nd", "np"))
+def bitwise_xor(lhs, rhs):
+    return jnp.bitwise_xor(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
+        .astype(lhs.dtype)
+
+
+@register("bitwise_not", num_inputs=1, differentiable=False,
+          aliases=["invert"], namespaces=("nd", "np"))
+def bitwise_not(data):
+    return jnp.bitwise_not(data.astype(jnp.int64)).astype(data.dtype)
+
+
+@register("digamma", num_inputs=1)
+def digamma(data):
+    return jax.lax.digamma(data)
+
+
+@register("hypot", num_inputs=2, namespaces=("nd", "np"))
+def hypot(lhs, rhs):
+    return jnp.hypot(lhs, rhs)
+
+
+@register("ldexp", num_inputs=2, namespaces=("nd", "np"))
+def ldexp(lhs, rhs):
+    return lhs * jnp.power(2.0, rhs)
+
+
+@register("logaddexp", num_inputs=2, namespaces=("nd", "np"))
+def logaddexp(lhs, rhs):
+    return jnp.logaddexp(lhs, rhs)
+
+
+@register("triu", num_inputs=1, namespaces=("nd", "np"))
+def triu(data, k=0):
+    return jnp.triu(data, k=k)
+
+
+@register("tril", num_inputs=1, namespaces=("nd", "np"))
+def tril(data, k=0):
+    return jnp.tril(data, k=k)
+
+
+@register("trace", num_inputs=1, namespaces=("nd", "np"))
+def trace(data, offset=0, axis1=0, axis2=1):
+    return jnp.trace(data, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("rot90", num_inputs=1, namespaces=("nd", "np"))
+def rot90(data, k=1, axes=(0, 1)):
+    return jnp.rot90(data, k=k, axes=tuple(axes))
+
+
+# ---------------------------------------------------------------------------
+# Correlation (src/operator/correlation.cc — FlowNet cost volume)
+# ---------------------------------------------------------------------------
+
+
+@register("Correlation", num_inputs=2, aliases=["correlation"])
+def correlation_op(data1, data2, kernel_size=1, max_displacement=1,
+                   stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """Cost volume between two feature maps [B,C,H,W] ->
+    [B, D*D, Ho, Wo] where D = 2*(max_displacement//stride2)+1; each
+    channel is the kernel-window correlation at one displacement."""
+    B, C, H, W = data1.shape
+    p = pad_size
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    br = kernel_size // 2
+    sr = max_displacement // stride2
+    D = 2 * sr + 1
+    Hp, Wp = H + 2 * p, W + 2 * p
+    # output grid (centers where the full neighborhood fits)
+    b0 = br + max_displacement
+    Ho = int(jnp.ceil((Hp - b0 * 2) / stride1))
+    Wo = int(jnp.ceil((Wp - b0 * 2) / stride1))
+    ys = b0 + jnp.arange(Ho) * stride1
+    xs = b0 + jnp.arange(Wo) * stride1
+    outs = []
+    for dy in range(-sr, sr + 1):
+        for dx in range(-sr, sr + 1):
+            acc = 0.0
+            for ky in range(-br, br + 1):
+                for kx in range(-br, br + 1):
+                    a = d1[:, :, ys[:, None] + ky, xs[None, :] + kx]
+                    b = d2[:, :, ys[:, None] + ky + dy * stride2,
+                           xs[None, :] + kx + dx * stride2]
+                    acc = acc + (a * b if is_multiply else jnp.abs(a - b))
+            outs.append(jnp.sum(acc, axis=1))
+    out = jnp.stack(outs, axis=1)            # [B, D*D, Ho, Wo]
+    return out / (kernel_size * kernel_size * C)
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling + Proposal (contrib/psroi_pooling.cc, contrib/proposal.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("PSROIPooling", num_inputs=2, aliases=["psroipooling"])
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1, pooled_size=1,
+                  group_size=0):
+    """Position-sensitive ROI pooling (R-FCN): data [B, output_dim*ps*ps,
+    H, W], rois [R,5] (batch_idx, x1, y1, x2, y2 in image coords) ->
+    [R, output_dim, ps, ps]; bin (i,j) average-pools its OWN channel
+    group."""
+    ps = int(pooled_size)
+    gs = int(group_size) or ps
+    B, CT, H, W = data.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / ps, rh / ps
+        img = data[b].reshape(output_dim, gs * gs, H, W)
+        cells = []
+        S = 2  # fixed sub-samples per bin (XLA-friendly static count)
+        for i in range(ps):
+            for j in range(ps):
+                gy = min(i * gs // ps, gs - 1)
+                gx = min(j * gs // ps, gs - 1)
+                chan = img[:, gy * gs + gx]
+                ysub = y1 + bh * (i + (jnp.arange(S) + 0.5) / S)
+                xsub = x1 + bw * (j + (jnp.arange(S) + 0.5) / S)
+                yi = jnp.clip(ysub, 0, H - 1).astype(jnp.int32)
+                xi = jnp.clip(xsub, 0, W - 1).astype(jnp.int32)
+                patch = chan[:, yi][:, :, xi]
+                cells.append(jnp.mean(patch, axis=(1, 2)))
+        return jnp.stack(cells, axis=-1).reshape(output_dim, ps, ps)
+
+    return jax.vmap(one)(rois)
+
+
+@register("Proposal", num_inputs=3, differentiable=False,
+          aliases=["proposal"])
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposal op (contrib/proposal.cc): decode per-anchor deltas,
+    clip to image, drop tiny boxes, NMS, keep top-k -> rois [B*K, 5]."""
+    B, A2, Hf, Wf = cls_prob.shape
+    A = A2 // 2
+    # base anchors centered at (fs/2 - .5) like the reference's generator
+    fs = float(feature_stride)
+    base = []
+    cx = cy = (fs - 1) / 2
+    for r in ratios:
+        size = fs * fs
+        ws = jnp.round(jnp.sqrt(size / r))
+        hs = jnp.round(ws * r)
+        for s in scales:
+            w2, h2 = ws * s / 2, hs * s / 2
+            base.append([cx - w2 + 0.5, cy - h2 + 0.5,
+                         cx + w2 - 0.5, cy + h2 - 0.5])
+    base = jnp.asarray(base, jnp.float32)[:A]       # [A,4]
+    sx = jnp.arange(Wf, dtype=jnp.float32) * fs
+    sy = jnp.arange(Hf, dtype=jnp.float32) * fs
+    shift = jnp.stack(
+        [sx[None, :].repeat(Hf, 0).reshape(-1),
+         sy[:, None].repeat(Wf, 1).reshape(-1)] * 2, axis=-1)  # [H*W,4]
+    anchors = (base[None] + shift[:, None]).reshape(-1, 4)     # [H*W*A,4]
+    N = anchors.shape[0]
+    K = int(rpn_post_nms_top_n)
+
+    def one(scores, deltas, info):
+        fg = scores[A:].reshape(A, -1).T.reshape(-1)     # [H*W*A]
+        dl = deltas.reshape(A, 4, Hf * Wf).transpose(2, 0, 1).reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        ax = anchors[:, 0] + aw / 2
+        ay = anchors[:, 1] + ah / 2
+        px = dl[:, 0] * aw + ax
+        py = dl[:, 1] * ah + ay
+        pw = jnp.exp(jnp.clip(dl[:, 2], -10, 10)) * aw
+        phh = jnp.exp(jnp.clip(dl[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([px - pw / 2, py - phh / 2,
+                           px + pw / 2, py + phh / 2], axis=-1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=-1)
+        ok = ((boxes[:, 2] - boxes[:, 0] + 1 >= rpn_min_size * info[2])
+              & (boxes[:, 3] - boxes[:, 1] + 1 >= rpn_min_size * info[2]))
+        fg = jnp.where(ok, fg, -1.0)
+        rows = jnp.concatenate([jnp.zeros((N, 1)), fg[:, None], boxes],
+                               axis=-1)
+        from .detection import _nms_single
+
+        kept = _nms_single(rows.astype(jnp.float32), threshold, 0.0,
+                           int(rpn_pre_nms_top_n), 2, 1, -1, -1, True,
+                           "corner", "corner")
+        return kept[:K, 2:6], kept[:K, 1]
+
+    rois, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(B, dtype=jnp.float32), K)[:, None]
+    rois_flat = jnp.concatenate([bidx, rois.reshape(-1, 4)], axis=-1)
+    if output_score:
+        return rois_flat, scores.reshape(-1, 1)
+    return rois_flat
+
+
+@register("sldwin_atten_mask_like", num_inputs=2, differentiable=False)
+def sldwin_atten_mask_like(data, valid_length, w=4, symmetric=True):
+    """Sliding-window attention mask (contrib/transformer.cc sldwin ops,
+    BERT long-sequence path): ones where |i-j| <= w (and j <= i when not
+    symmetric), zeros elsewhere / beyond valid_length."""
+    S = data.shape[-2] if data.ndim >= 2 else data.shape[0]
+    i = jnp.arange(S)[:, None]
+    j = jnp.arange(S)[None, :]
+    win = (j >= i - w) & ((j <= i + w) if symmetric else (j <= i))
+    mask = win.astype(data.dtype)
+    if valid_length is not None:
+        vl = valid_length.reshape(-1, 1, 1)
+        mask = mask[None] * (j[None] < vl) * (i[None] < vl)
+    return jnp.broadcast_to(mask, data.shape[:-2] + (S, S)) \
+        if data.ndim > 2 else mask
+
+
+alias("max", "amax")
+alias("min", "amin")
+alias("SliceChannel", "slice_channel")

@@ -220,3 +220,93 @@ def multi_sgd_mom_update(arrays, lrs=(), wds=(), momentum=0.0, rescale_grad=1.0,
 @register("multi_sum_sq", num_inputs=-1, num_outputs=1, differentiable=False)
 def multi_sum_sq(arrays, num_arrays=0):
     return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32))) for a in arrays])
+
+
+@register("multi_lamb_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False)
+def multi_lamb_update(arrays, learning_rates=(), wds=(), beta1=0.9,
+                      beta2=0.999, epsilon=1e-6, rescale_grad=1.0,
+                      lower_bound=-1.0, upper_bound=-1.0, clip_gradient=-1.0,
+                      bias_correction=True, step_count=(), num_tensors=0):
+    """Fused multi-tensor LAMB (reference contrib/multi_lamb.cc): arrays =
+    [w0..wn-1, g0.., m0.., v0..] -> (new_w..., new_m..., new_v...)."""
+    n = num_tensors or len(arrays) // 4
+    ws, gs, ms, vs = (arrays[i * n:(i + 1) * n] for i in range(4))
+    new_w, new_m, new_v = [], [], []
+    for i, (w, g, m, v) in enumerate(zip(ws, gs, ms, vs)):
+        t = step_count[i] if i < len(step_count) else 1
+        g = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient is not None and clip_gradient >= 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        m_n = beta1 * m + (1 - beta1) * g
+        v_n = beta2 * v + (1 - beta2) * jnp.square(g)
+        mh, vh = m_n, v_n
+        if bias_correction:
+            mh = m_n / (1 - beta1 ** t)
+            vh = v_n / (1 - beta2 ** t)
+        wf = w.astype(jnp.float32)
+        upd = mh / (jnp.sqrt(vh) + epsilon) + wds[i] * wf
+        r1 = jnp.linalg.norm(wf)
+        if lower_bound is not None and lower_bound > 0:
+            r1 = jnp.maximum(r1, lower_bound)
+        if upper_bound is not None and upper_bound > 0:
+            r1 = jnp.minimum(r1, upper_bound)
+        r2 = jnp.linalg.norm(upd)
+        ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+        new_w.append((wf - learning_rates[i] * ratio * upd).astype(w.dtype))
+        new_m.append(m_n)
+        new_v.append(v_n)
+    return tuple(new_w) + tuple(new_m) + tuple(new_v)
+
+
+@register("multi_lans_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False)
+def multi_lans_update(arrays, learning_rates=(), wds=(), beta1=0.9,
+                      beta2=0.999, epsilon=1e-6, rescale_grad=1.0,
+                      lower_bound=-1.0, upper_bound=-1.0, clip_gradient=-1.0,
+                      step_count=(), num_tensors=0):
+    """Fused multi-tensor LANS (reference contrib/multi_lans.cc:38-120):
+    per tensor, the gradient is L2-normalised before the Adam moments, and
+    the update blends a momentum direction and a gradient direction, each
+    with its own trust ratio:
+
+        sg   = (g * rescale) / ||g||          (then optional clip)
+        m,v  = adam moments of sg (bias-corrected)
+        d_m  = m_hat / (sqrt(v_hat)+eps) + wd*w
+        d_g  = sg    / (sqrt(v_hat)+eps) + wd*w
+        w   -= lr * (beta1 * (||w||/||d_m||) * d_m
+                     + (1-beta1) * (||w||/||d_g||) * d_g)
+
+    arrays = [w..., g..., m..., v...] -> (new_w..., new_m..., new_v...).
+    """
+    n = num_tensors or len(arrays) // 4
+    ws, gs, ms, vs = (arrays[i * n:(i + 1) * n] for i in range(4))
+    new_w, new_m, new_v = [], [], []
+    for i, (w, g, m, v) in enumerate(zip(ws, gs, ms, vs)):
+        t = step_count[i] if i < len(step_count) else 1
+        gf = g.astype(jnp.float32) * rescale_grad
+        gnorm = jnp.linalg.norm(gf)
+        sg = gf / jnp.maximum(gnorm, 1e-12)
+        if clip_gradient is not None and clip_gradient >= 0:
+            sg = jnp.clip(sg, -clip_gradient, clip_gradient)
+        m_n = beta1 * m + (1 - beta1) * sg
+        v_n = beta2 * v + (1 - beta2) * jnp.square(sg)
+        mh = m_n / (1 - beta1 ** t)
+        vh = jnp.sqrt(v_n / (1 - beta2 ** t)) + epsilon
+        wf = w.astype(jnp.float32)
+        d_m = mh / vh + wds[i] * wf
+        d_g = sg / vh + wds[i] * wf
+        r1 = jnp.linalg.norm(wf)
+        if lower_bound is not None and lower_bound > 0:
+            r1 = jnp.maximum(r1, lower_bound)
+        if upper_bound is not None and upper_bound > 0:
+            r1 = jnp.minimum(r1, upper_bound)
+        rm = jnp.linalg.norm(d_m)
+        rg = jnp.linalg.norm(d_g)
+        ratio_m = jnp.where((r1 > 0) & (rm > 0), r1 / rm, 1.0)
+        ratio_g = jnp.where((r1 > 0) & (rg > 0), r1 / rg, 1.0)
+        upd = beta1 * ratio_m * d_m + (1 - beta1) * ratio_g * d_g
+        new_w.append((wf - learning_rates[i] * upd).astype(w.dtype))
+        new_m.append(m_n)
+        new_v.append(v_n)
+    return tuple(new_w) + tuple(new_m) + tuple(new_v)

@@ -1,0 +1,288 @@
+"""Detection / spatial-transform / fft / multi-tensor-optimizer op tests.
+
+Reference analogs: tests/python/unittest/test_operator.py (box_nms,
+bilinear_sampler, spatial_transformer gradients checked vs numpy oracles)
+and test_contrib_operator.py (multibox suite).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import detection as det
+from mxnet_tpu.ops import contrib as ctb
+
+
+def test_box_nms_reference_example():
+    """The documented example from reference bounding_box.cc:84-96."""
+    x = onp.array([[0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                   [1, 0.4, 0.1, 0.1, 0.2, 0.2],
+                   [0, 0.3, 0.1, 0.1, 0.14, 0.14],
+                   [2, 0.6, 0.5, 0.5, 0.7, 0.8]], onp.float32)
+    out = det.box_nms(jnp.asarray(x), overlap_thresh=0.1, coord_start=2,
+                      score_index=1, id_index=0, force_suppress=True)
+    expect = onp.array([[2, 0.6, 0.5, 0.5, 0.7, 0.8],
+                        [0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                        [-1, -1, -1, -1, -1, -1],
+                        [-1, -1, -1, -1, -1, -1]], onp.float32)
+    assert onp.allclose(onp.asarray(out), expect, atol=1e-6)
+
+
+def test_box_nms_class_aware():
+    """force_suppress=False keeps overlapping boxes of different classes."""
+    x = onp.array([[0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                   [1, 0.4, 0.1, 0.1, 0.2, 0.2]], onp.float32)
+    out = onp.asarray(det.box_nms(jnp.asarray(x), overlap_thresh=0.1,
+                                  id_index=0, force_suppress=False))
+    assert (out[:, 0] >= 0).all()          # both survive
+
+
+def test_box_nms_batch_and_nd():
+    rng = onp.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 6).astype(onp.float32)
+    out = det.box_nms(jnp.asarray(x), overlap_thresh=0.5)
+    assert out.shape == x.shape
+
+
+def test_bipartite_matching_reference_example():
+    s = jnp.asarray([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]], jnp.float32)
+    rows, cols = det.bipartite_matching(s, threshold=1e-12, is_ascend=False)
+    assert onp.asarray(rows).tolist() == [1, -1, 0]
+    assert onp.asarray(cols).tolist() == [2, 0]
+
+
+def test_multibox_prior_layout():
+    data = jnp.zeros((1, 3, 4, 6))
+    out = det.multibox_prior(data, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    A = 2 + 2 - 1
+    assert out.shape == (1, 4 * 6 * A, 4)
+    a = onp.asarray(out).reshape(4, 6, A, 4)
+    # first anchor at cell (0,0): center ((0+.5)/6, (0+.5)/4), size .5
+    cx, cy = 0.5 / 6, 0.5 / 4
+    w = 0.5 * 4 / 6 / 2
+    h = 0.5 / 2
+    assert onp.allclose(a[0, 0, 0], [cx - w, cy - h, cx + w, cy + h],
+                        atol=1e-6)
+
+
+def test_multibox_target_basic():
+    # one gt box; the best-iou anchor must be positive with encoded offsets
+    anchors = jnp.asarray([[[0.0, 0.0, 0.5, 0.5],
+                            [0.4, 0.4, 0.9, 0.9],
+                            [0.0, 0.5, 0.5, 1.0]]], jnp.float32)
+    label = jnp.asarray([[[1.0, 0.45, 0.45, 0.85, 0.85]]], jnp.float32)
+    cls_pred = jnp.zeros((1, 3, 3), jnp.float32)
+    loc_t, loc_m, cls_t = det.multibox_target(anchors, label, cls_pred)
+    cls_t = onp.asarray(cls_t)[0]
+    assert cls_t[1] == 2.0                  # class 1 -> target 2 (bg=0)
+    assert set(cls_t[[0, 2]]) == {0.0}      # others negative
+    lm = onp.asarray(loc_m).reshape(3, 4)
+    assert lm[1].all() and not lm[0].any()
+    # encoded loc target: (gx-ax)/aw/0.1 ...
+    lt = onp.asarray(loc_t).reshape(3, 4)[1]
+    aw = ah = 0.5
+    gx, gy, gw, gh = 0.65, 0.65, 0.4, 0.4
+    expect = [(gx - 0.65) / aw / 0.1, (gy - 0.65) / ah / 0.1,
+              onp.log(gw / aw) / 0.2, onp.log(gh / ah) / 0.2]
+    assert onp.allclose(lt, expect, atol=1e-5)
+
+
+def test_multibox_detection_decodes_and_suppresses():
+    anchors = jnp.asarray([[[0.1, 0.1, 0.3, 0.3],
+                            [0.11, 0.11, 0.31, 0.31],
+                            [0.6, 0.6, 0.9, 0.9]]], jnp.float32)
+    # probs [B, C=3, N=3]: anchor0/1 class1 (0.8/0.7), anchor2 class2
+    cls_prob = jnp.asarray([[[0.1, 0.2, 0.1],
+                             [0.8, 0.7, 0.1],
+                             [0.1, 0.1, 0.8]]], jnp.float32)
+    loc = jnp.zeros((1, 12), jnp.float32)     # no offsets: boxes = anchors
+    out = onp.asarray(det.multibox_detection(cls_prob, loc, anchors,
+                                             nms_threshold=0.5))
+    assert out.shape == (1, 3, 6)
+    kept = out[0][out[0, :, 0] >= 0]
+    assert len(kept) == 2                    # one of the two overlapping
+    assert {int(k[0]) for k in kept} == {0, 1}  # class ids (0-based fg)
+    assert onp.allclose(sorted(k[1] for k in kept), [0.8, 0.8])
+
+
+def test_bilinear_sampler_identity_and_grad():
+    rng = onp.random.RandomState(3)
+    data = jnp.asarray(rng.rand(2, 3, 5, 7), jnp.float32)
+    ys = onp.linspace(-1, 1, 5)
+    xs = onp.linspace(-1, 1, 7)
+    xg, yg = onp.meshgrid(xs, ys)
+    grid = jnp.asarray(onp.broadcast_to(
+        onp.stack([xg, yg])[None], (2, 2, 5, 7)), jnp.float32)
+    out = ctb.bilinear_sampler(data, grid)
+    assert onp.allclose(onp.asarray(out), onp.asarray(data), atol=1e-5)
+
+    # numeric gradient check through the sampler (interior points only)
+    def f(d):
+        return jnp.sum(ctb.bilinear_sampler(d, grid * 0.5) ** 2)
+
+    g = jax.grad(f)(data)
+    eps = 1e-3
+    d0 = onp.asarray(data).copy()
+    idx = (0, 1, 2, 3)
+    d0[idx] += eps
+    fp = float(f(jnp.asarray(d0)))
+    d0[idx] -= 2 * eps
+    fm = float(f(jnp.asarray(d0)))
+    assert abs((fp - fm) / (2 * eps) - float(g[idx])) < 1e-2
+
+
+def test_grid_generator_affine_identity():
+    theta = jnp.asarray([[1.0, 0, 0, 0, 1.0, 0]], jnp.float32)
+    grid = onp.asarray(ctb.grid_generator(theta, "affine",
+                                          target_shape=(4, 5)))
+    assert grid.shape == (1, 2, 4, 5)
+    assert onp.allclose(grid[0, 0, 0], onp.linspace(-1, 1, 5), atol=1e-6)
+    assert onp.allclose(grid[0, 1, :, 0], onp.linspace(-1, 1, 4), atol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    rng = onp.random.RandomState(5)
+    data = jnp.asarray(rng.rand(2, 3, 6, 6), jnp.float32)
+    theta = jnp.broadcast_to(
+        jnp.asarray([1.0, 0, 0, 0, 1.0, 0], jnp.float32), (2, 6))
+    out = ctb.spatial_transformer(data, theta, target_shape=(6, 6))
+    assert onp.allclose(onp.asarray(out), onp.asarray(data), atol=1e-5)
+    # differentiable end-to-end (through grid AND data)
+    g = jax.grad(lambda th: jnp.sum(
+        ctb.spatial_transformer(data, th, target_shape=(6, 6)) ** 2))(theta)
+    assert onp.isfinite(onp.asarray(g)).all()
+
+
+def test_deformable_convolution_zero_offset_matches_conv():
+    """With zero offsets, deformable conv == plain convolution."""
+    rng = onp.random.RandomState(7)
+    data = jnp.asarray(rng.rand(2, 4, 7, 7), jnp.float32)
+    weight = jnp.asarray(rng.rand(3, 4, 3, 3) * 0.2, jnp.float32)
+    bias = jnp.asarray(rng.rand(3), jnp.float32)
+    offset = jnp.zeros((2, 2 * 9, 5, 5), jnp.float32)
+    out = ctb.deformable_convolution(
+        [data, offset, weight, bias], kernel=(3, 3), num_filter=3)
+    ref = jax.lax.conv_general_dilated(
+        data, weight, (1, 1), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW")) + bias.reshape(1, 3, 1, 1)
+    assert onp.allclose(onp.asarray(out), onp.asarray(ref), atol=1e-4)
+
+    # gradient flows through data, offset, and weight
+    g = jax.grad(lambda o: jnp.sum(ctb.deformable_convolution(
+        [data, o, weight, bias], kernel=(3, 3), num_filter=3) ** 2))(offset)
+    assert onp.isfinite(onp.asarray(g)).all()
+
+
+def test_fft_ifft_roundtrip():
+    rng = onp.random.RandomState(9)
+    x = jnp.asarray(rng.rand(4, 8), jnp.float32)
+    y = ctb.fft(x)
+    assert y.shape == (4, 16)
+    expect = onp.fft.fft(onp.asarray(x), axis=-1)
+    got = onp.asarray(y).reshape(4, 8, 2)
+    assert onp.allclose(got[..., 0], expect.real, atol=1e-4)
+    assert onp.allclose(got[..., 1], expect.imag, atol=1e-4)
+    # unnormalized inverse: ifft(fft(x)) = d * x
+    back = onp.asarray(ctb.ifft(y)) / 8.0
+    assert onp.allclose(back, onp.asarray(x), atol=1e-4)
+    # differentiable
+    g = jax.grad(lambda a: jnp.sum(ctb.fft(a) ** 2))(x)
+    assert onp.isfinite(onp.asarray(g)).all()
+
+
+def test_count_sketch_matches_numpy():
+    rng = onp.random.RandomState(11)
+    d, k = 10, 4
+    x = rng.rand(3, d).astype(onp.float32)
+    h = rng.randint(0, k, d)
+    s = rng.choice([-1.0, 1.0], d).astype(onp.float32)
+    out = onp.asarray(ctb.count_sketch(
+        jnp.asarray(x), jnp.asarray(h), jnp.asarray(s), out_dim=k))
+    expect = onp.zeros((3, k), onp.float32)
+    for i in range(d):
+        expect[:, h[i]] += s[i] * x[:, i]
+    assert onp.allclose(out, expect, atol=1e-5)
+
+
+def test_multi_lans_and_lamb_update():
+    from mxnet_tpu.ops import optimizer as opt
+
+    rng = onp.random.RandomState(13)
+    ws = [jnp.asarray(rng.rand(4, 3), jnp.float32),
+          jnp.asarray(rng.rand(5), jnp.float32)]
+    gs = [jnp.asarray(rng.rand(4, 3), jnp.float32),
+          jnp.asarray(rng.rand(5), jnp.float32)]
+    ms = [jnp.zeros_like(w) for w in ws]
+    vs = [jnp.zeros_like(w) for w in ws]
+    arrays = ws + gs + ms + vs
+    for fn in (opt.multi_lans_update, opt.multi_lamb_update):
+        outs = fn(arrays, learning_rates=(0.01, 0.01), wds=(0.01, 0.0),
+                  step_count=(1, 1), num_tensors=2)
+        assert len(outs) == 6
+        for new_w, w in zip(outs[:2], ws):
+            arr = onp.asarray(new_w)
+            assert arr.shape == w.shape and onp.isfinite(arr).all()
+            assert not onp.allclose(arr, onp.asarray(w))
+
+    # LANS normalizes the gradient: scaling grads must not change the step
+    outs1 = opt.multi_lans_update(ws + gs + ms + vs,
+                                  learning_rates=(0.01, 0.01),
+                                  wds=(0.0, 0.0), num_tensors=2)
+    gs_scaled = [g * 100.0 for g in gs]
+    outs2 = opt.multi_lans_update(ws + gs_scaled + ms + vs,
+                                  learning_rates=(0.01, 0.01),
+                                  wds=(0.0, 0.0), num_tensors=2)
+    assert onp.allclose(onp.asarray(outs1[0]), onp.asarray(outs2[0]),
+                        atol=1e-5)
+
+
+def test_libsvm_iter(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0 3:4.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    batch = next(it)
+    d = batch.data[0]
+    dense = onp.asarray(d.asnumpy() if hasattr(d, "asnumpy") else d.todense()
+                        if hasattr(d, "todense") else d)
+    assert dense.shape == (2, 4)
+    assert onp.allclose(dense, [[1.5, 0, 0, 2.0], [0, 1.0, 0, 0]])
+    assert onp.allclose(onp.asarray(batch.label[0].asnumpy()).ravel(),
+                        [1.0, 0.0])
+    it.reset()
+    n = sum(1 for _ in it)
+    assert n == 2   # round_batch pads the last
+
+    # sibling-iterator idiom: while iter_next() must terminate
+    it.reset()
+    count = 0
+    while it.iter_next():
+        _ = it.getdata()
+        count += 1
+    assert count == 2
+
+
+def test_libsvm_iter_label_file(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text("1 0:1.0\n0 1:2.0\n")
+    lp = tmp_path / "label.libsvm"
+    lp.write_text("0 0:7.0 2:9.0\n0 1:8.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(2,),
+                          label_libsvm=str(lp), label_shape=(3,),
+                          batch_size=2)
+    batch = next(it)
+    lab = onp.asarray(batch.label[0].asnumpy())
+    assert lab.shape == (2, 3)
+    assert onp.allclose(lab, [[7.0, 0, 9.0], [0, 8.0, 0]])
+
+
+def test_ops_registered_in_nd_namespace():
+    for name in ("box_nms", "multibox_prior", "multibox_target",
+                 "multibox_detection", "BilinearSampler", "GridGenerator",
+                 "SpatialTransformer", "DeformableConvolution", "fft",
+                 "ifft", "count_sketch", "multi_lans_update",
+                 "multi_lamb_update", "bipartite_matching", "box_encode",
+                 "box_decode"):
+        assert hasattr(mx.nd, name), name
+    from mxnet_tpu.ops import registry
+    assert len(registry.list_ops()) >= 260

@@ -1,0 +1,196 @@
+"""Tests for the breadth ops (ops/extra.py).
+
+Reference analog: tests/python/unittest/test_operator.py regression ops,
+test_random.py pdf ops, test_contrib_operator.py krprod/all_finite.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import extra as ex
+
+
+def test_unravel_ravel_roundtrip():
+    shape = (3, 4, 5)
+    flat = jnp.asarray([0, 7, 33, 59], jnp.int32)
+    coords = ex.unravel_index(flat, shape=shape)
+    assert coords.shape == (3, 4)
+    back = ex.ravel_multi_index(coords, shape=shape)
+    assert onp.asarray(back).tolist() == [0, 7, 33, 59]
+
+
+def test_batch_take_and_fill():
+    a = jnp.asarray([[1.0, 2, 3], [4, 5, 6]], jnp.float32)
+    idx = jnp.asarray([2, 0], jnp.int32)
+    assert onp.asarray(ex.batch_take(a, idx)).tolist() == [3.0, 4.0]
+    filled = ex.fill_element_0index(a, jnp.asarray([9.0, 8.0]), idx)
+    assert onp.asarray(filled).tolist() == [[1, 2, 9], [8, 5, 6]]
+
+
+def test_crop_center_and_ref():
+    x = jnp.arange(2 * 3 * 6 * 6, dtype=jnp.float32).reshape(2, 3, 6, 6)
+    like = jnp.zeros((2, 3, 2, 2))
+    out = ex.crop([x, like], num_args=2, center_crop=True)
+    assert out.shape == (2, 3, 2, 2)
+    assert onp.allclose(onp.asarray(out), onp.asarray(x[:, :, 2:4, 2:4]))
+
+
+def test_khatri_rao_matches_numpy():
+    rng = onp.random.RandomState(0)
+    a = rng.rand(3, 4).astype(onp.float32)
+    b = rng.rand(2, 4).astype(onp.float32)
+    out = onp.asarray(ex.khatri_rao([jnp.asarray(a), jnp.asarray(b)]))
+    expect = onp.vstack([onp.kron(a[:, c], b[:, c]).reshape(-1)
+                         for c in range(4)]).T
+    assert out.shape == (6, 4)
+    assert onp.allclose(out, expect, atol=1e-6)
+
+
+def test_all_finite():
+    assert float(ex.all_finite(jnp.ones(4))[0]) == 1.0
+    assert float(ex.all_finite(jnp.asarray([1.0, onp.inf]))[0]) == 0.0
+    assert float(ex.multi_all_finite(
+        [jnp.ones(2), jnp.asarray([onp.nan])])[0]) == 0.0
+
+
+def test_regression_outputs_backward_semantics():
+    """Backward is the loss gradient, independent of the head cotangent
+    (reference regression_output.cc)."""
+    d = jnp.asarray([0.5, -1.0], jnp.float32)
+    l = jnp.asarray([0.0, 0.0], jnp.float32)
+    # forward
+    assert onp.allclose(onp.asarray(ex.linear_regression_output(d, l)),
+                        onp.asarray(d))
+    assert onp.allclose(onp.asarray(ex.logistic_regression_output(d, l)),
+                        1 / (1 + onp.exp(-onp.asarray(d))), atol=1e-6)
+    # backward: sum() gives cotangent 1, but even scaled outputs must
+    # produce the pure loss gradient
+    g = jax.grad(lambda x: jnp.sum(ex.linear_regression_output(x, l)))(d)
+    assert onp.allclose(onp.asarray(g), onp.asarray(d - l), atol=1e-6)
+    g2 = jax.grad(lambda x: 5.0 * jnp.sum(
+        ex.mae_regression_output(x, l)))(d)
+    # cotangent 5 is ignored; grad = sign(d-l)
+    assert onp.allclose(onp.asarray(g2), [5.0, -5.0]) or \
+        onp.allclose(onp.asarray(g2), [1.0, -1.0])
+    g3 = jax.grad(lambda x: jnp.sum(
+        ex.logistic_regression_output(x, l, grad_scale=2.0)))(d)
+    assert onp.allclose(onp.asarray(g3),
+                        2.0 * (1 / (1 + onp.exp(-onp.asarray(d)))), atol=1e-5)
+
+
+def test_pdf_ops_match_scipy_formulas():
+    from scipy import stats
+
+    x = onp.array([0.5, 1.5], onp.float64)
+    mu, sig = 0.3, 1.2
+    got = onp.asarray(ex.pdf_normal(jnp.asarray(x, jnp.float32),
+                                    jnp.float32(mu), jnp.float32(sig)))
+    assert onp.allclose(got, stats.norm.pdf(x, mu, sig), atol=1e-5)
+    a, b = 2.0, 1.5
+    got = onp.asarray(ex.pdf_gamma(jnp.asarray(x, jnp.float32),
+                                   jnp.float32(a), jnp.float32(b)))
+    assert onp.allclose(got, stats.gamma.pdf(x, a, scale=1 / b), atol=1e-5)
+    lam = 2.0
+    got = onp.asarray(ex.pdf_exponential(jnp.asarray(x, jnp.float32),
+                                         jnp.float32(lam)))
+    assert onp.allclose(got, stats.expon.pdf(x, scale=1 / lam), atol=1e-5)
+    ks = onp.array([1.0, 3.0])
+    got = onp.asarray(ex.pdf_poisson(jnp.asarray(ks, jnp.float32),
+                                     jnp.float32(lam)))
+    assert onp.allclose(got, stats.poisson.pmf(ks, lam), atol=1e-5)
+    # dirichlet over last axis
+    s = onp.array([[0.2, 0.3, 0.5]])
+    al = onp.array([[1.0, 2.0, 3.0]])
+    got = onp.asarray(ex.pdf_dirichlet(jnp.asarray(s, jnp.float32),
+                                       jnp.asarray(al, jnp.float32)))
+    assert onp.allclose(got, stats.dirichlet.pdf(s[0], al[0]), atol=1e-4)
+    # gradients flow to parameters
+    g = jax.grad(lambda m: jnp.sum(ex.pdf_normal(
+        jnp.asarray(x, jnp.float32), m, jnp.float32(sig))))(jnp.float32(mu))
+    assert onp.isfinite(float(g))
+
+
+def test_logical_bitwise():
+    a = jnp.asarray([1.0, 0.0, 2.0])
+    b = jnp.asarray([1.0, 1.0, 0.0])
+    assert onp.asarray(ex.logical_and(a, b)).tolist() == [1.0, 0.0, 0.0]
+    assert onp.asarray(ex.logical_or(a, b)).tolist() == [1.0, 1.0, 1.0]
+    assert onp.asarray(ex.logical_xor(a, b)).tolist() == [0.0, 1.0, 1.0]
+    ai = jnp.asarray([5, 3], jnp.int32)
+    bi = jnp.asarray([3, 1], jnp.int32)
+    assert onp.asarray(ex.bitwise_and(ai, bi)).tolist() == [1, 1]
+    assert onp.asarray(ex.bitwise_or(ai, bi)).tolist() == [7, 3]
+    assert onp.asarray(ex.bitwise_xor(ai, bi)).tolist() == [6, 2]
+
+
+def test_triu_tril_trace_rot90():
+    x = jnp.arange(9.0).reshape(3, 3)
+    assert onp.allclose(onp.asarray(ex.triu(x)), onp.triu(onp.arange(9.).reshape(3, 3)))
+    assert onp.allclose(onp.asarray(ex.tril(x, k=-1)),
+                        onp.tril(onp.arange(9.).reshape(3, 3), -1))
+    assert float(ex.trace(x)) == 12.0
+    assert onp.allclose(onp.asarray(ex.rot90(x)),
+                        onp.rot90(onp.arange(9.).reshape(3, 3)))
+
+
+def test_correlation_self_identity():
+    """Correlation of a map with itself at zero displacement equals the
+    mean square over channels."""
+    rng = onp.random.RandomState(1)
+    x = jnp.asarray(rng.rand(1, 4, 6, 6), jnp.float32)
+    out = ex.correlation_op(x, x, kernel_size=1, max_displacement=1,
+                            stride1=1, stride2=1, pad_size=1)
+    o = onp.asarray(out)
+    assert o.shape[1] == 9
+    center = o[0, 4]            # zero displacement channel
+    xs = onp.asarray(x)
+    expect = (xs[0] ** 2).sum(0) / 4.0      # mean over C at zero shift
+    assert center.shape == expect.shape
+    assert onp.allclose(center, expect, atol=1e-4)
+
+
+def test_psroipooling_shapes_and_constant():
+    ps, od = 3, 2
+    data = jnp.ones((1, od * ps * ps, 8, 8), jnp.float32)
+    rois = jnp.asarray([[0, 1.0, 1.0, 6.0, 6.0]], jnp.float32)
+    out = ex.psroi_pooling(data, rois, spatial_scale=1.0, output_dim=od,
+                           pooled_size=ps, group_size=ps)
+    assert out.shape == (1, od, ps, ps)
+    assert onp.allclose(onp.asarray(out), 1.0, atol=1e-6)
+
+
+def test_proposal_shapes():
+    B, A, Hf, Wf = 1, 12, 4, 4
+    rng = onp.random.RandomState(2)
+    cls_prob = jnp.asarray(rng.rand(B, 2 * A, Hf, Wf), jnp.float32)
+    bbox = jnp.asarray(rng.randn(B, 4 * A, Hf, Wf) * 0.1, jnp.float32)
+    im_info = jnp.asarray([[64.0, 64.0, 1.0]], jnp.float32)
+    rois = ex.proposal(cls_prob, bbox, im_info, rpn_post_nms_top_n=10)
+    assert rois.shape == (10, 5)
+    r = onp.asarray(rois)
+    live = r[r[:, 1] >= 0]
+    assert (live[:, 1] <= live[:, 3] + 1e-3).all()
+    assert (live[:, 2] <= live[:, 4] + 1e-3).all()
+
+
+def test_sldwin_atten_mask_like():
+    data = jnp.zeros((6, 6))
+    m = onp.asarray(ex.sldwin_atten_mask_like(data, None, w=1))
+    assert m[0, 0] == 1 and m[0, 1] == 1 and m[0, 2] == 0
+    assert m[3, 2] == 1 and m[3, 4] == 1 and m[3, 5] == 0
+    m2 = onp.asarray(ex.sldwin_atten_mask_like(data, None, w=1,
+                                               symmetric=False))
+    assert m2[3, 4] == 0 and m2[3, 2] == 1
+
+
+def test_amax_amin_slice_channel_aliases():
+    assert hasattr(mx.nd, "amax") and hasattr(mx.nd, "amin")
+    x = mx.nd.array(onp.array([[1.0, 5.0], [3.0, 2.0]], onp.float32))
+    assert float(mx.nd.amax(x).asnumpy()) == 5.0
+
+
+def test_registry_at_least_300():
+    from mxnet_tpu.ops import registry
+    assert len(registry.list_ops()) >= 300

@@ -1,0 +1,27 @@
+"""``mx.contrib.onnx`` — ONNX export/import without an onnx dependency.
+
+Reference: python/mxnet/contrib/onnx/ (mx2onnx exporter + onnx2mx
+importer).  The protobuf wire format is read/written directly
+(:mod:`proto`), so exported ``.onnx`` files load in onnxruntime /
+netron / any ONNX consumer, and standard ONNX inference graphs import
+back as Symbols running on TPU.
+"""
+from . import proto
+from .mx2onnx import MX2ONNX, export_model
+from .onnx2mx import ONNX2MX, import_model
+
+__all__ = ["export_model", "import_model", "proto", "MX2ONNX", "ONNX2MX"]
+
+
+def get_model_metadata(model_file: str):
+    """Shapes/names of an ONNX model's inputs and outputs
+    (reference onnx2mx/import_model.py:get_model_metadata)."""
+    with open(model_file, "rb") as f:
+        m = proto.parse_model(f.read())
+    g = m["graph"]
+    init = set(g["initializers"])
+    return {
+        "input_tensor_data": [(n, tuple(s)) for n, _e, s in g["inputs"]
+                              if n not in init],
+        "output_tensor_data": [(n, tuple(s)) for n, _e, s in g["outputs"]],
+    }

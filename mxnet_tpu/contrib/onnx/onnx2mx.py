@@ -1,0 +1,298 @@
+"""ONNX -> Symbol importer.
+
+Reference: python/mxnet/contrib/onnx/onnx2mx/import_model.py (+
+_import_helper.py op map).  Parses the protobuf wire format directly
+(proto.py) and rebuilds a Symbol DAG over this framework's op registry,
+so imported models run on TPU through the same whole-graph-jit path as
+native ones.  Covers the standard opset emitted by the exporter plus the
+common inference ops.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as onp
+
+from . import proto
+
+ONNX2MX = {}
+
+
+def translator(*names):
+    def deco(fn):
+        for n in names:
+            ONNX2MX[n] = fn
+        return fn
+
+    return deco
+
+
+def _attr_pool_kind(node):
+    return node["op_type"].startswith("Global")
+
+
+def _pads_begin(pads):
+    """ONNX pads are [x1_begin, x2_begin, ..., x1_end, x2_end]; this
+    framework's spatial ops take symmetric padding."""
+    if not pads:
+        return (0, 0)
+    n = len(pads) // 2
+    if list(pads[:n]) != list(pads[n:]):
+        raise NotImplementedError(
+            f"asymmetric ONNX pads {pads} not supported; pad explicitly "
+            "with a Pad node")
+    return tuple(int(p) for p in pads[:n])
+
+
+@translator("Conv")
+def _conv(node, ins, consts, sym_ops):
+    a = node["attrs"]
+    return sym_ops["Convolution"](
+        *ins, kernel=tuple(a.get("kernel_shape", ())),
+        stride=tuple(a.get("strides", (1, 1))),
+        dilate=tuple(a.get("dilations", (1, 1))),
+        pad=_pads_begin(a.get("pads")), num_group=a.get("group", 1),
+        num_filter=0, no_bias=len(ins) == 2)
+
+
+@translator("ConvTranspose")
+def _deconv(node, ins, consts, sym_ops):
+    a = node["attrs"]
+    return sym_ops["Deconvolution"](
+        *ins, kernel=tuple(a.get("kernel_shape", ())),
+        stride=tuple(a.get("strides", (1, 1))),
+        pad=_pads_begin(a.get("pads")), num_group=a.get("group", 1),
+        num_filter=0, no_bias=len(ins) == 2)
+
+
+@translator("BatchNormalization")
+def _bn(node, ins, consts, sym_ops):
+    a = node["attrs"]
+    return sym_ops["BatchNorm"](
+        *ins, eps=a.get("epsilon", 1e-5), momentum=a.get("momentum", 0.9),
+        fix_gamma=False, use_global_stats=True)
+
+
+@translator("Relu")
+def _relu(node, ins, consts, sym_ops):
+    return sym_ops["relu"](ins[0])
+
+
+@translator("Sigmoid")
+def _sigmoid(node, ins, consts, sym_ops):
+    return sym_ops["sigmoid"](ins[0])
+
+
+@translator("Tanh")
+def _tanh(node, ins, consts, sym_ops):
+    return sym_ops["tanh"](ins[0])
+
+
+@translator("Softplus")
+def _softplus(node, ins, consts, sym_ops):
+    return sym_ops["Activation"](ins[0], act_type="softrelu")
+
+
+@translator("LeakyRelu")
+def _leaky(node, ins, consts, sym_ops):
+    return sym_ops["LeakyReLU"](ins[0],
+                                slope=node["attrs"].get("alpha", 0.01))
+
+
+@translator("Elu")
+def _elu(node, ins, consts, sym_ops):
+    return sym_ops["LeakyReLU"](ins[0], act_type="elu",
+                                slope=node["attrs"].get("alpha", 1.0))
+
+
+@translator("PRelu")
+def _prelu(node, ins, consts, sym_ops):
+    return sym_ops["LeakyReLU"](ins[0], ins[1], act_type="prelu")
+
+
+@translator("Erf")
+def _erf(node, ins, consts, sym_ops):
+    return sym_ops["erf"](ins[0])
+
+
+@translator("MaxPool", "AveragePool", "GlobalMaxPool", "GlobalAveragePool")
+def _pool(node, ins, consts, sym_ops):
+    a = node["attrs"]
+    ptype = "max" if "Max" in node["op_type"] else "avg"
+    if _attr_pool_kind(node):
+        return sym_ops["Pooling"](ins[0], pool_type=ptype, global_pool=True)
+    return sym_ops["Pooling"](
+        ins[0], kernel=tuple(a.get("kernel_shape", (1, 1))),
+        stride=tuple(a.get("strides", (1, 1))),
+        pad=_pads_begin(a.get("pads")),
+        pool_type=ptype,
+        pooling_convention="full" if a.get("ceil_mode") else "valid",
+        # ONNX spec default is count_include_pad=0
+        count_include_pad=bool(a.get("count_include_pad", 0)))
+
+
+@translator("Gemm")
+def _gemm(node, ins, consts, sym_ops):
+    a = node["attrs"]
+    assert a.get("transB", 0) == 1 and not a.get("transA", 0), \
+        "only transB=1 Gemm supported (the exporter's form)"
+    return sym_ops["FullyConnected"](
+        *ins, num_hidden=0, no_bias=len(ins) == 2, flatten=False)
+
+
+@translator("MatMul")
+def _matmul(node, ins, consts, sym_ops):
+    return sym_ops["matmul"](ins[0], ins[1])
+
+
+@translator("Add")
+def _add(node, ins, consts, sym_ops):
+    return sym_ops["broadcast_add"](ins[0], ins[1])
+
+
+@translator("Sub")
+def _sub(node, ins, consts, sym_ops):
+    return sym_ops["broadcast_sub"](ins[0], ins[1])
+
+
+@translator("Mul")
+def _mul(node, ins, consts, sym_ops):
+    return sym_ops["broadcast_mul"](ins[0], ins[1])
+
+
+@translator("Div")
+def _div(node, ins, consts, sym_ops):
+    return sym_ops["broadcast_div"](ins[0], ins[1])
+
+
+@translator("Sum")
+def _sum(node, ins, consts, sym_ops):
+    out = ins[0]
+    for x in ins[1:]:
+        out = sym_ops["broadcast_add"](out, x)
+    return out
+
+
+@translator("Flatten")
+def _flatten(node, ins, consts, sym_ops):
+    return sym_ops["flatten"](ins[0])
+
+
+@translator("Softmax")
+def _softmax(node, ins, consts, sym_ops):
+    return sym_ops["softmax"](ins[0], axis=node["attrs"].get("axis", -1))
+
+
+@translator("LayerNormalization")
+def _ln(node, ins, consts, sym_ops):
+    a = node["attrs"]
+    return sym_ops["LayerNorm"](*ins, axis=a.get("axis", -1),
+                                eps=a.get("epsilon", 1e-5))
+
+
+@translator("Gather")
+def _gather(node, ins, consts, sym_ops):
+    assert node["attrs"].get("axis", 0) == 0
+    return sym_ops["embedding"](ins[1], ins[0])
+
+
+@translator("Cast")
+def _cast(node, ins, consts, sym_ops):
+    np_dt = proto.ONNX_TO_NP[node["attrs"]["to"]]
+    return sym_ops["cast"](ins[0], dtype=str(np_dt))
+
+
+@translator("Transpose")
+def _transpose(node, ins, consts, sym_ops):
+    perm = node["attrs"].get("perm")
+    return sym_ops["transpose"](ins[0],
+                                axes=tuple(perm) if perm else None)
+
+
+@translator("Reshape")
+def _reshape(node, ins, consts, sym_ops):
+    shape = consts[node["input"][1]]
+    return sym_ops["reshape"](ins[0],
+                              shape=tuple(int(s) for s in shape))
+
+
+@translator("Slice")
+def _slice(node, ins, consts, sym_ops):
+    starts = [int(s) for s in consts[node["input"][1]]]
+    ends = [int(s) for s in consts[node["input"][2]]]
+    axes = [int(s) for s in consts[node["input"][3]]] \
+        if len(node["input"]) > 3 else list(range(len(starts)))
+    begin = {}
+    for ax, st, en in zip(axes, starts, ends):
+        begin[ax] = (st, en)
+    max_ax = max(begin) + 1
+    b = [begin.get(i, (None, None))[0] for i in range(max_ax)]
+    e = [begin.get(i, (None, None))[1] for i in range(max_ax)]
+    return sym_ops["slice"](ins[0], begin=tuple(b), end=tuple(e))
+
+
+@translator("Identity", "Dropout")
+def _identity(node, ins, consts, sym_ops):
+    return sym_ops["identity"](ins[0])
+
+
+@translator("Concat")
+def _concat(node, ins, consts, sym_ops):
+    return sym_ops["concat"](*ins, dim=node["attrs"].get("axis", 1))
+
+
+@translator("ReduceMean")
+def _reduce_mean(node, ins, consts, sym_ops):
+    a = node["attrs"]
+    return sym_ops["mean"](ins[0], axis=tuple(a.get("axes", ())) or None,
+                           keepdims=bool(a.get("keepdims", 1)))
+
+
+def import_model(model_file: str):
+    """Load an .onnx file -> (Symbol, arg_params, aux_params)
+    (reference onnx2mx/import_model.py:import_model)."""
+    from ... import symbol as _sym_mod
+    from ...ndarray import array as _nd_array
+
+    with open(model_file, "rb") as f:
+        m = proto.parse_model(f.read())
+    g = m["graph"]
+    init = g["initializers"]
+
+    sym_ops = {n: getattr(_sym_mod, n) for n in dir(_sym_mod)
+               if not n.startswith("_")}
+
+    values: Dict[str, Any] = {}
+    consts: Dict[str, onp.ndarray] = dict(init)
+    for name, _elem, _shape in g["inputs"]:
+        if name not in init:
+            values[name] = _sym_mod.var(name)
+    for name in init:
+        values[name] = _sym_mod.var(name)
+
+    extra_params: Dict[str, onp.ndarray] = {}
+    for node in g["nodes"]:
+        op = node["op_type"]
+        if op == "Constant":
+            # constant tensors are both attr-consumable (consts) and
+            # value-consumable (a var backed by an imported param)
+            out_name = node["output"][0]
+            val = node["attrs"].get("value")
+            consts[out_name] = val
+            values[out_name] = _sym_mod.var(out_name)
+            extra_params[out_name] = onp.asarray(val)
+            continue
+        if op not in ONNX2MX:
+            raise NotImplementedError(
+                f"no import translator for ONNX op '{op}'")
+        ins = [values[i] for i in node["input"] if i in values]
+        out = ONNX2MX[op](node, ins, consts, sym_ops)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for nm, o in zip(node["output"], outs):
+            values[nm] = o
+
+    out_syms = [values[nm] for nm, _e, _s in g["outputs"]]
+    sym = out_syms[0] if len(out_syms) == 1 else _sym_mod.Group(out_syms)
+    arg_params = {k: _nd_array(v) for k, v in init.items()}
+    arg_params.update({k: _nd_array(v) for k, v in extra_params.items()})
+    return sym, arg_params, {}

@@ -1,0 +1,343 @@
+"""Minimal ONNX protobuf wire codec — no ``onnx`` package required.
+
+The reference's exporter (python/mxnet/contrib/onnx/mx2onnx) builds
+ModelProto through the onnx python bindings; this environment has no onnx
+distribution, so we serialize the (stable, versioned) ONNX protobuf wire
+format directly: ModelProto / GraphProto / NodeProto / TensorProto /
+AttributeProto / ValueInfoProto and the reader for the same subset.
+Field numbers follow onnx/onnx.proto (IR version 8, default opset 17).
+
+Protobuf wire format: each field is a varint key ``(field_num << 3) |
+wire_type`` followed by a varint (type 0), fixed 32-bit little-endian
+(type 5), or length-prefixed bytes (type 2).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as onp
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64, BOOL, FLOAT16, DOUBLE, BFLOAT16 = \
+    1, 2, 3, 6, 7, 9, 10, 11, 16
+
+NP_TO_ONNX = {
+    onp.dtype("float32"): FLOAT,
+    onp.dtype("float64"): DOUBLE,
+    onp.dtype("int32"): INT32,
+    onp.dtype("int64"): INT64,
+    onp.dtype("int8"): INT8,
+    onp.dtype("uint8"): UINT8,
+    onp.dtype("bool"): BOOL,
+    onp.dtype("float16"): FLOAT16,
+}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR, AT_FLOATS, AT_INTS, AT_STRINGS = \
+    1, 2, 3, 4, 6, 7, 8
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n &= (1 << 64) - 1          # two's-complement 64-bit
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_varint(field: int, value: int) -> bytes:
+    return _key(field, 0) + _varint(int(value))
+
+
+def _f_bytes(field: int, payload: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def _f_string(field: int, s: str) -> bytes:
+    return _f_bytes(field, s.encode("utf-8"))
+
+
+def _f_float(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", v)
+
+
+# --- writers ---------------------------------------------------------------
+
+
+def tensor(name: str, array: onp.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    array = onp.ascontiguousarray(array)
+    dt = NP_TO_ONNX[array.dtype]
+    out = b"".join(_f_varint(1, d) for d in array.shape)
+    out += _f_varint(2, dt)
+    out += _f_string(8, name)
+    out += _f_bytes(9, array.tobytes())
+    return out
+
+
+def attribute(name: str, value: Any) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    type=20."""
+    out = _f_string(1, name)
+    if isinstance(value, bool):
+        out += _f_varint(3, int(value)) + _f_varint(20, AT_INT)
+    elif isinstance(value, int):
+        out += _f_varint(3, value) + _f_varint(20, AT_INT)
+    elif isinstance(value, float):
+        out += _f_float(2, value) + _f_varint(20, AT_FLOAT)
+    elif isinstance(value, str):
+        out += _f_string(4, value) + _f_varint(20, AT_STRING)
+    elif isinstance(value, onp.ndarray):
+        out += _f_bytes(5, tensor("", value)) + _f_varint(20, AT_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            out += b"".join(_f_float(7, v) for v in value)
+            out += _f_varint(20, AT_FLOATS)
+        else:
+            out += b"".join(_f_varint(8, int(v)) for v in value)
+            out += _f_varint(20, AT_INTS)
+    else:
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+    return out
+
+
+def node(op_type: str, inputs: List[str], outputs: List[str],
+         name: str = "", attrs: Optional[Dict[str, Any]] = None) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    out = b"".join(_f_string(1, i) for i in inputs)
+    out += b"".join(_f_string(2, o) for o in outputs)
+    out += _f_string(3, name or outputs[0])
+    out += _f_string(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += _f_bytes(5, attribute(k, v))
+    return out
+
+
+def value_info(name: str, elem_type: int, shape: Tuple[int, ...]) -> bytes:
+    """ValueInfoProto: name=1, type=2 {tensor_type=1 {elem_type=1,
+    shape=2 {dim=1 {dim_value=1}}}}."""
+    dims = b"".join(_f_bytes(1, _f_varint(1, d)) for d in shape)
+    tshape = _f_bytes(2, dims)
+    ttype = _f_varint(1, elem_type) + tshape
+    return _f_string(1, name) + _f_bytes(2, _f_bytes(1, ttype))
+
+
+def graph(nodes: List[bytes], name: str, initializers: List[bytes],
+          inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b"".join(_f_bytes(1, n) for n in nodes)
+    out += _f_string(2, name)
+    out += b"".join(_f_bytes(5, t) for t in initializers)
+    out += b"".join(_f_bytes(11, i) for i in inputs)
+    out += b"".join(_f_bytes(12, o) for o in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 17,
+          producer: str = "mxnet_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, opset_import=8
+    {domain=1, version=2}, graph=7."""
+    out = _f_varint(1, 8)                     # IR version 8
+    out += _f_string(2, producer)
+    out += _f_bytes(7, graph_bytes)
+    out += _f_bytes(8, _f_string(1, "") + _f_varint(2, opset))
+    return out
+
+
+# --- reader ----------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_num, wire_type, value) over a message payload."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, v
+
+
+def _signed(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def parse_tensor(buf: bytes) -> Tuple[str, onp.ndarray]:
+    dims, dt, name, raw = [], FLOAT, "", b""
+    floats, int64s, int32s = [], [], []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            dims.append(_signed(v))
+        elif f == 2:
+            dt = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+        elif f == 4:
+            floats.append(struct.unpack("<f", v)[0] if w == 5 else v)
+        elif f == 7:
+            int64s.append(_signed(v))
+        elif f == 5:
+            int32s.append(_signed(v))
+    np_dt = ONNX_TO_NP[dt]
+    if raw:
+        arr = onp.frombuffer(raw, np_dt).reshape(dims)
+    elif floats:
+        arr = onp.asarray(floats, np_dt).reshape(dims)
+    elif int64s:
+        arr = onp.asarray(int64s, np_dt).reshape(dims)
+    elif int32s:
+        arr = onp.asarray(int32s, np_dt).reshape(dims)
+    else:
+        arr = onp.zeros(dims, np_dt)
+    return name, arr
+
+
+def parse_attribute(buf: bytes) -> Tuple[str, Any]:
+    name, atype = "", None
+    fval = ival = sval = tval = None
+    floats, ints = [], []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            fval = struct.unpack("<f", v)[0]
+        elif f == 3:
+            ival = _signed(v)
+        elif f == 4:
+            sval = v.decode()
+        elif f == 5:
+            tval = parse_tensor(v)[1]
+        elif f == 7:
+            floats.append(struct.unpack("<f", v)[0])
+        elif f == 8:
+            ints.append(_signed(v))
+        elif f == 20:
+            atype = v
+    if atype == AT_FLOAT:
+        return name, fval
+    if atype == AT_INT:
+        return name, ival
+    if atype == AT_STRING:
+        return name, sval
+    if atype == AT_TENSOR:
+        return name, tval
+    if atype == AT_FLOATS:
+        return name, floats
+    if atype == AT_INTS:
+        return name, ints
+    # untyped: best-effort
+    for v in (ival, fval, sval, tval):
+        if v is not None:
+            return name, v
+    return name, ints or floats
+
+
+def parse_node(buf: bytes) -> Dict[str, Any]:
+    out = {"input": [], "output": [], "name": "", "op_type": "",
+           "attrs": {}}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            out["input"].append(v.decode())
+        elif f == 2:
+            out["output"].append(v.decode())
+        elif f == 3:
+            out["name"] = v.decode()
+        elif f == 4:
+            out["op_type"] = v.decode()
+        elif f == 5:
+            k, val = parse_attribute(v)
+            out["attrs"][k] = val
+    return out
+
+
+def parse_value_info(buf: bytes) -> Tuple[str, int, List[int]]:
+    name, elem, shape = "", FLOAT, []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            for f2, _w2, v2 in _fields(v):          # TypeProto
+                if f2 == 1:                          # tensor_type
+                    for f3, _w3, v3 in _fields(v2):
+                        if f3 == 1:
+                            elem = v3
+                        elif f3 == 2:                # shape
+                            for f4, _w4, v4 in _fields(v3):
+                                if f4 == 1:          # dim
+                                    dv = 0
+                                    for f5, _w5, v5 in _fields(v4):
+                                        if f5 == 1:
+                                            dv = _signed(v5)
+                                    shape.append(dv)
+    return name, elem, shape
+
+
+def parse_graph(buf: bytes) -> Dict[str, Any]:
+    g = {"nodes": [], "name": "", "initializers": {}, "inputs": [],
+         "outputs": []}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            g["nodes"].append(parse_node(v))
+        elif f == 2:
+            g["name"] = v.decode()
+        elif f == 5:
+            n, arr = parse_tensor(v)
+            g["initializers"][n] = arr
+        elif f == 11:
+            g["inputs"].append(parse_value_info(v))
+        elif f == 12:
+            g["outputs"].append(parse_value_info(v))
+    return g
+
+
+def parse_model(buf: bytes) -> Dict[str, Any]:
+    m = {"ir_version": 0, "producer": "", "graph": None, "opset": 0}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            m["ir_version"] = v
+        elif f == 2:
+            m["producer"] = v.decode()
+        elif f == 7:
+            m["graph"] = parse_graph(v)
+        elif f == 8:
+            for f2, _w2, v2 in _fields(v):
+                if f2 == 2:
+                    m["opset"] = max(m["opset"], _signed(v2))
+    return m

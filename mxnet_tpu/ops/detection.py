@@ -63,7 +63,8 @@ def _nms_single(x, overlap_thresh, valid_thresh, topk, coord_start,
     xs = x[order]
     valid_s = valid[order]
     if topk > 0:
-        valid_s &= jnp.arange(N) < topk
+        # topk counts VALID candidates (reference filters before nms)
+        valid_s &= jnp.cumsum(valid_s.astype(jnp.int32)) <= topk
     boxes = _to_corner(xs[:, coord_start:coord_start + 4], in_format)
     iou = _pair_iou(boxes, boxes)
     if id_index >= 0 and not force_suppress:
@@ -341,12 +342,17 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
 
     def single(probs, locs):
         # class with best non-background prob per anchor
-        fg = jnp.concatenate([jnp.full((1, N), -jnp.inf, probs.dtype),
-                              probs[1:]], axis=0) \
-            if probs.shape[0] > 1 else probs
-        # output ids are 0-based foreground classes (argmax - 1, reference
-        # multibox_detection.cc:125 "outputs[i*6] = id - 1")
-        cid = jnp.argmax(fg, axis=0).astype(jnp.float32) - 1.0
+        C = probs.shape[0]
+        bg = int(background_id)
+        mask = jnp.full((C, 1), 0.0, probs.dtype)
+        if 0 <= bg < C and C > 1:
+            mask = mask.at[bg].set(-jnp.inf)
+        fg = probs + mask
+        # output ids are 0-based foreground classes — channel order with
+        # the background class removed (reference multibox_detection.cc:125
+        # "outputs[i*6] = id - 1" for bg=0; generalized here)
+        am = jnp.argmax(fg, axis=0)
+        cid = jnp.where(am > bg, am - 1, am).astype(jnp.float32)
         score = jnp.max(fg, axis=0)
         keep = score >= threshold
         cid = jnp.where(keep, cid, -1.0)

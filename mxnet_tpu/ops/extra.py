@@ -352,18 +352,24 @@ def correlation_op(data1, data2, kernel_size=1, max_displacement=1,
     Wo = int(jnp.ceil((Wp - b0 * 2) / stride1))
     ys = b0 + jnp.arange(Ho) * stride1
     xs = b0 + jnp.arange(Wo) * stride1
-    outs = []
-    for dy in range(-sr, sr + 1):
-        for dx in range(-sr, sr + 1):
-            acc = 0.0
-            for ky in range(-br, br + 1):
-                for kx in range(-br, br + 1):
-                    a = d1[:, :, ys[:, None] + ky, xs[None, :] + kx]
-                    b = d2[:, :, ys[:, None] + ky + dy * stride2,
-                           xs[None, :] + kx + dx * stride2]
-                    acc = acc + (a * b if is_multiply else jnp.abs(a - b))
-            outs.append(jnp.sum(acc, axis=1))
-    out = jnp.stack(outs, axis=1)            # [B, D*D, Ho, Wo]
+    # the D*D displacement axis is vmapped (one rolled gather body) so the
+    # traced graph stays small at FlowNet-scale max_displacement; only the
+    # tiny kernel window is unrolled
+    disp = jnp.asarray([(dy, dx)
+                        for dy in range(-sr, sr + 1)
+                        for dx in range(-sr, sr + 1)], jnp.int32)
+
+    def one_disp(d):
+        dy, dx = d[0] * stride2, d[1] * stride2
+        acc = 0.0
+        for ky in range(-br, br + 1):
+            for kx in range(-br, br + 1):
+                a = d1[:, :, ys[:, None] + ky, xs[None, :] + kx]
+                b = d2[:, :, ys[:, None] + ky + dy, xs[None, :] + kx + dx]
+                acc = acc + (a * b if is_multiply else jnp.abs(a - b))
+        return jnp.sum(acc, axis=1)          # [B, Ho, Wo]
+
+    out = jnp.moveaxis(jax.vmap(one_disp)(disp), 0, 1)  # [B, D*D, Ho, Wo]
     return out / (kernel_size * kernel_size * C)
 
 
@@ -494,6 +500,13 @@ def sldwin_atten_mask_like(data, valid_length, w=4, symmetric=True):
         mask = mask[None] * (j[None] < vl) * (i[None] < vl)
     return jnp.broadcast_to(mask, data.shape[:-2] + (S, S)) \
         if data.ndim > 2 else mask
+
+
+@register("matmul", num_inputs=2, namespaces=("nd", "np"))
+def matmul(a, b):
+    """N-D broadcasting matmul (reference numpy/np_matmul_op.cc
+    _npi_matmul; also the ONNX MatMul lowering target)."""
+    return jnp.matmul(a, b)
 
 
 alias("max", "amax")

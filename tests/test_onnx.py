@@ -1,0 +1,164 @@
+"""ONNX export/import round-trip tests.
+
+Reference analog: tests/python/onnx/ (export to onnx, re-run, compare).
+onnxruntime is not available in this environment, so the oracle is the
+in-repo importer: export -> parse wire format -> rebuild Symbol ->
+evaluate, compared against the source model's outputs.  The wire format
+itself is additionally checked structurally (field-level parse).
+"""
+import json
+import os
+
+import jax
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as mxonnx
+
+
+def _roundtrip(sym, params, in_shapes, feed, out_path):
+    path = mxonnx.export_model(sym, params, in_shapes=in_shapes,
+                               onnx_file_path=str(out_path))
+    sym2, args2, _aux = mxonnx.import_model(path)
+    got = sym2.eval(**{**args2, **feed})
+    return path, got
+
+
+def test_proto_writer_reader_roundtrip():
+    from mxnet_tpu.contrib.onnx import proto
+
+    t = proto.tensor("w", onp.arange(6, dtype=onp.float32).reshape(2, 3))
+    name, arr = proto.parse_tensor(t)
+    assert name == "w" and arr.shape == (2, 3) and arr[1, 2] == 5.0
+
+    nb = proto.node("Conv", ["x", "w"], ["y"], "conv0",
+                    {"kernel_shape": [3, 3], "alpha": 0.5, "mode": "same"})
+    nd = proto.parse_node(nb)
+    assert nd["op_type"] == "Conv"
+    assert nd["input"] == ["x", "w"] and nd["output"] == ["y"]
+    assert nd["attrs"]["kernel_shape"] == [3, 3]
+    assert abs(nd["attrs"]["alpha"] - 0.5) < 1e-7
+    assert nd["attrs"]["mode"] == "same"
+
+    vi = proto.value_info("x", proto.FLOAT, (1, 3, 8, 8))
+    n, e, s = proto.parse_value_info(vi)
+    assert n == "x" and e == proto.FLOAT and s == [1, 3, 8, 8]
+
+    # negative ints survive the varint two's-complement path
+    ab = proto.attribute("axis", -1)
+    k, v = proto.parse_attribute(ab)
+    assert k == "axis" and v == -1
+
+
+def test_export_import_mlp(tmp_path):
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.random.normal(shape=(2, 8))
+    ref = net(x).asnumpy()
+
+    sym = net._trace_symbol()
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path, got = _roundtrip(sym, params, [(2, 8)],
+                           {"data": x._data}, tmp_path / "mlp.onnx")
+    assert os.path.getsize(path) > 100
+    assert onp.allclose(onp.asarray(got[0]), ref, atol=1e-5)
+
+    meta = mxonnx.get_model_metadata(path)
+    assert meta["input_tensor_data"][0][1] == (2, 8)
+
+
+def test_export_import_resnet18(tmp_path):
+    """The VERDICT item-6 criterion: resnet export round-trips with
+    matching outputs (importer stands in for onnxruntime, which is not
+    installed here)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.random.normal(shape=(1, 3, 32, 32))
+    ref = net(x).asnumpy()
+
+    sym = net._trace_symbol()
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path, got = _roundtrip(sym, params, [(1, 3, 32, 32)],
+                           {"data": x._data}, tmp_path / "resnet18.onnx")
+    assert onp.allclose(onp.asarray(got[0]), ref, atol=1e-3), (
+        onp.abs(onp.asarray(got[0]) - ref).max())
+
+
+def test_export_import_bert_small(tmp_path):
+    """BERT export: embedding/LayerNorm/interleaved-attention decompose to
+    standard ONNX ops and round-trip numerically."""
+    from mxnet_tpu.gluon.model_zoo import bert as bz
+
+    net = bz.bert_small()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    rng = onp.random.RandomState(0)
+    toks = mx.nd.array(rng.randint(0, 100, (2, 12)).astype(onp.int32))
+    ref = net(toks).asnumpy()
+
+    sym = net._trace_symbol()
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = mxonnx.export_model(sym, params, in_shapes=[(2, 12)],
+                               in_types=["int32"],
+                               onnx_file_path=str(tmp_path / "bert.onnx"))
+    sym2, args2, _aux = mxonnx.import_model(path)
+    got = sym2.eval(**{**args2, "data": toks._data})
+    assert onp.allclose(onp.asarray(got[0]), ref, atol=2e-3), (
+        onp.abs(onp.asarray(got[0]) - ref).max())
+
+
+def test_import_constant_node_feeds_tensor_input(tmp_path):
+    """PyTorch-style graphs feed scalar Constants into Add/Mul — the
+    Constant output must be usable as a tensor input, not just an attr."""
+    from mxnet_tpu.contrib.onnx import proto
+
+    const_t = onp.asarray(2.0, onp.float32)
+    nodes = [
+        proto.node("Constant", [], ["two"], "c0", {"value": const_t}),
+        proto.node("Add", ["x", "two"], ["y"], "add0"),
+    ]
+    g = proto.graph(nodes, "g", [],
+                    [proto.value_info("x", proto.FLOAT, (3,))],
+                    [proto.value_info("y", proto.FLOAT, (3,))])
+    path = tmp_path / "const.onnx"
+    path.write_bytes(proto.model(g))
+    sym, args, _ = mxonnx.import_model(str(path))
+    import jax.numpy as jnp
+
+    out = sym.eval(**{**args, "x": jnp.asarray([1.0, 2.0, 3.0])})
+    assert onp.allclose(onp.asarray(out[0]), [3.0, 4.0, 5.0])
+
+
+def test_import_asymmetric_pads_rejected(tmp_path):
+    from mxnet_tpu.contrib.onnx import proto
+
+    nodes = [proto.node("Conv", ["x", "w"], ["y"], "c",
+                        {"kernel_shape": [3, 3], "pads": [0, 0, 1, 1]})]
+    g = proto.graph(
+        nodes, "g", [proto.tensor("w", onp.zeros((1, 1, 3, 3), onp.float32))],
+        [proto.value_info("x", proto.FLOAT, (1, 1, 8, 8))],
+        [proto.value_info("y", proto.FLOAT, (1, 1, 6, 6))])
+    path = tmp_path / "asym.onnx"
+    path.write_bytes(proto.model(g))
+    with pytest.raises(NotImplementedError, match="asymmetric"):
+        mxonnx.import_model(str(path))
+
+
+def test_export_unsupported_op_message(tmp_path):
+    from mxnet_tpu import symbol as S
+
+    x = S.var("data")
+    y = S.box_nms(x)
+    with pytest.raises(NotImplementedError, match="box_nms"):
+        mxonnx.export_model(y, {}, in_shapes=[(1, 4, 6)],
+                            onnx_file_path=str(tmp_path / "x.onnx"))

@@ -32,6 +32,12 @@ NP_TO_ONNX = {
     onp.dtype("bool"): BOOL,
     onp.dtype("float16"): FLOAT16,
 }
+try:
+    import ml_dtypes as _mld
+
+    NP_TO_ONNX[onp.dtype(_mld.bfloat16)] = BFLOAT16
+except ImportError:                                  # pragma: no cover
+    pass
 ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
 
 # AttributeProto.AttributeType
@@ -197,12 +203,33 @@ def _signed(v: int) -> int:
     return v - (1 << 64) if v >= (1 << 63) else v
 
 
+def _packed_varints(v) -> List[int]:
+    """Accept either a single varint value or a packed (wire-type-2)
+    payload of varints — proto3 packs repeated scalars by default, which
+    is how the official onnx/PyTorch exporters write dims/ints."""
+    if isinstance(v, int):
+        return [_signed(v)]
+    out, pos = [], 0
+    while pos < len(v):
+        x, pos = _read_varint(v, pos)
+        out.append(_signed(x))
+    return out
+
+
+def _packed_floats(v) -> List[float]:
+    if not isinstance(v, (bytes, bytearray)):
+        return [v]
+    if len(v) == 4:
+        return [struct.unpack("<f", v)[0]]
+    return list(struct.unpack(f"<{len(v) // 4}f", v))
+
+
 def parse_tensor(buf: bytes) -> Tuple[str, onp.ndarray]:
     dims, dt, name, raw = [], FLOAT, "", b""
     floats, int64s, int32s = [], [], []
     for f, w, v in _fields(buf):
         if f == 1:
-            dims.append(_signed(v))
+            dims.extend(_packed_varints(v))
         elif f == 2:
             dt = v
         elif f == 8:
@@ -210,11 +237,11 @@ def parse_tensor(buf: bytes) -> Tuple[str, onp.ndarray]:
         elif f == 9:
             raw = v
         elif f == 4:
-            floats.append(struct.unpack("<f", v)[0] if w == 5 else v)
+            floats.extend(_packed_floats(v) if w != 0 else [v])
         elif f == 7:
-            int64s.append(_signed(v))
+            int64s.extend(_packed_varints(v))
         elif f == 5:
-            int32s.append(_signed(v))
+            int32s.extend(_packed_varints(v))
     np_dt = ONNX_TO_NP[dt]
     if raw:
         arr = onp.frombuffer(raw, np_dt).reshape(dims)
@@ -245,9 +272,9 @@ def parse_attribute(buf: bytes) -> Tuple[str, Any]:
         elif f == 5:
             tval = parse_tensor(v)[1]
         elif f == 7:
-            floats.append(struct.unpack("<f", v)[0])
+            floats.extend(_packed_floats(v))
         elif f == 8:
-            ints.append(_signed(v))
+            ints.extend(_packed_varints(v))
         elif f == 20:
             atype = v
     if atype == AT_FLOAT:

@@ -29,8 +29,12 @@ def create(name="local"):
                  "local_allreduce_device"):
         return KVStore("tpu" if lname in ("tpu", "nccl") else lname)
     if lname.startswith("dist") or lname.startswith("p3"):
-        # dist_sync / dist_async / dist_device_sync / p3store variants:
-        # multi-controller synchronous collectives over DCN
+        # dist_sync / dist_device_sync / p3 variants: multi-controller
+        # synchronous collectives over DCN.  dist_async routes pushes
+        # through a per-process pipeline thread (overlap, no caller
+        # blocking); p3-style priority/bucketing is the list-push fusion.
+        if "async" in lname:
+            return KVStore("dist_async")
         return KVStore("dist_sync")
     if lname in KVStoreBase.kv_registry:
         return KVStoreBase.kv_registry[lname]()

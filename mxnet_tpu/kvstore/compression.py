@@ -1,0 +1,91 @@
+"""2-bit gradient compression with error feedback.
+
+Reference: ``src/kvstore/gradient_compression.{h,cc,cu}`` (kTwoBit,
+gradient_compression.h:38; Quantize/Dequantize :111-121) — worker-side
+quantization applied before the dist push, with the quantization error
+kept as a residual added to the next gradient.
+
+Quantization rule (matches the reference's 2-bit kernel and the expected
+values computed by tests/nightly/dist_sync_kvstore.py):
+
+    x >  threshold  ->  +threshold   (code 01)
+    x < -threshold  ->  -threshold   (code 10)
+    else            ->   0           (code 00)
+
+On the wire, 16 two-bit codes pack into one uint32 — a 16x reduction of
+cross-host (DCN) bytes versus raw fp32 gradients.  TPU-native layout:
+pack/unpack are pure jnp bit ops, so they fuse into the surrounding
+XLA program on either side of the collective.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = ["GradientCompression"]
+
+
+class GradientCompression:
+    """Stateful per-key 2-bit compressor (error-feedback residuals live
+    here, one per key, matching the reference's per-key residual_ array)."""
+
+    def __init__(self, type: str = "2bit", threshold: float = 0.5):
+        if type != "2bit":
+            raise ValueError(f"unsupported compression type {type!r}")
+        self.type = type
+        self.threshold = float(threshold)
+        if self.threshold <= 0:
+            raise ValueError("threshold must be positive")
+        self._residuals: Dict[str, jnp.ndarray] = {}
+
+    # -- quantize / codes -------------------------------------------------
+    def quantize(self, x: jnp.ndarray) -> jnp.ndarray:
+        t = self.threshold
+        return jnp.where(x > t, t, jnp.where(x < -t, -t, 0.0)).astype(
+            jnp.float32)
+
+    def codes(self, x: jnp.ndarray) -> jnp.ndarray:
+        t = self.threshold
+        return jnp.where(x > t, 1, jnp.where(x < -t, 2, 0)).astype(
+            jnp.uint32)
+
+    def decode(self, codes: jnp.ndarray) -> jnp.ndarray:
+        t = self.threshold
+        return jnp.where(codes == 1, t,
+                         jnp.where(codes == 2, -t, 0.0)).astype(jnp.float32)
+
+    # -- wire packing ------------------------------------------------------
+    def pack(self, x_flat: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
+        """fp32 [n] -> (uint32 [ceil(n/16)], n).  16 codes per word."""
+        n = x_flat.shape[0]
+        codes = self.codes(x_flat)
+        pad = (-n) % 16
+        codes = jnp.pad(codes, (0, pad))
+        codes = codes.reshape(-1, 16)
+        shifts = (2 * jnp.arange(16, dtype=jnp.uint32))[None, :]
+        return jnp.sum(codes << shifts, axis=1, dtype=jnp.uint32), n
+
+    def unpack(self, packed: jnp.ndarray, n: int) -> jnp.ndarray:
+        shifts = (2 * jnp.arange(16, dtype=jnp.uint32))[None, :]
+        codes = (packed[:, None] >> shifts) & jnp.uint32(3)
+        return self.decode(codes.reshape(-1)[:n])
+
+    # -- error-feedback push path -----------------------------------------
+    def compress(self, key: str, grad: jnp.ndarray) -> Tuple[jnp.ndarray,
+                                                             int]:
+        """grad + residual -> quantized wire words; residual keeps the
+        quantization error for the next round."""
+        flat = grad.reshape(-1).astype(jnp.float32)
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros_like(flat)
+        acc = flat + res
+        q = self.quantize(acc)
+        self._residuals[key] = acc - q
+        return self.pack(acc)
+
+    def residual(self, key: str) -> Optional[jnp.ndarray]:
+        return self._residuals.get(key)

@@ -344,15 +344,18 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
         # class with best non-background prob per anchor
         C = probs.shape[0]
         bg = int(background_id)
+        has_bg = 0 <= bg < C and C > 1
         mask = jnp.full((C, 1), 0.0, probs.dtype)
-        if 0 <= bg < C and C > 1:
+        if has_bg:
             mask = mask.at[bg].set(-jnp.inf)
         fg = probs + mask
         # output ids are 0-based foreground classes — channel order with
         # the background class removed (reference multibox_detection.cc:125
-        # "outputs[i*6] = id - 1" for bg=0; generalized here)
+        # "outputs[i*6] = id - 1" for bg=0; generalized here).  With no
+        # background class (background_id=-1) ids are the raw channels.
         am = jnp.argmax(fg, axis=0)
-        cid = jnp.where(am > bg, am - 1, am).astype(jnp.float32)
+        cid = (jnp.where(am > bg, am - 1, am) if has_bg else am).astype(
+            jnp.float32)
         score = jnp.max(fg, axis=0)
         keep = score >= threshold
         cid = jnp.where(keep, cid, -1.0)

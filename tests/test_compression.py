@@ -1,0 +1,74 @@
+"""Gradient compression unit tests.
+
+Reference analog: tests/nightly/dist_sync_kvstore.py's
+compute_expected_2bit_quantization — quantization rule, wire packing, and
+error-feedback residual accumulation across rounds.
+"""
+import jax.numpy as jnp
+import numpy as onp
+
+from mxnet_tpu.kvstore.compression import GradientCompression
+
+
+def test_quantize_rule():
+    gc = GradientCompression(threshold=0.5)
+    x = jnp.asarray([0.7, -0.7, 0.3, -0.3, 0.5, -0.5, 0.0])
+    q = onp.asarray(gc.quantize(x))
+    assert q.tolist() == [0.5, -0.5, 0.0, 0.0, 0.0, 0.0, 0.0]
+
+
+def test_pack_unpack_roundtrip():
+    gc = GradientCompression(threshold=1.0)
+    rng = onp.random.RandomState(0)
+    x = jnp.asarray(rng.randn(45).astype(onp.float32) * 2)
+    packed, n = gc.pack(x)
+    assert packed.dtype == jnp.uint32
+    assert packed.shape[0] == (45 + 15) // 16
+    back = onp.asarray(gc.unpack(packed, n))
+    assert onp.allclose(back, onp.asarray(gc.quantize(x)))
+
+
+def test_error_feedback_accumulates():
+    """Small gradients below threshold eventually ship via the residual
+    (the reference's error-feedback convergence property)."""
+    gc = GradientCompression(threshold=0.5)
+    total_sent = onp.zeros(4, onp.float32)
+    grad = jnp.asarray([0.2, -0.2, 0.4, 0.0], jnp.float32)
+    for _ in range(5):
+        packed, n = gc.compress("k", grad)
+        total_sent += onp.asarray(gc.unpack(packed, n))
+    # strict > threshold: 0.2-grads accumulate to one 0.5 quantum by
+    # round 3 (0.6 > 0.5), then the cycle restarts; 0.4-grads ship three
+    # quanta (0.8, 0.7, 0.6 rounds) with 0.5 still pending as residual
+    assert onp.allclose(total_sent, [0.5, -0.5, 1.5, 0.0])
+    res = onp.asarray(gc.residual("k"))
+    assert onp.allclose(res, [0.5, -0.5, 0.5, 0.0], atol=1e-6)
+    # conservation: sent + residual == total gradient mass
+    assert onp.allclose(total_sent + res, 5 * onp.asarray(grad), atol=1e-6)
+
+
+def test_reference_sequence():
+    """Step-by-step parity with the reference 2-bit expectation: send
+    quantize(grad+residual), residual = (grad+residual) - sent."""
+    gc = GradientCompression(threshold=0.5)
+    g1 = jnp.asarray([0.7], jnp.float32)
+    p, n = gc.compress("w", g1)
+    assert float(gc.unpack(p, n)[0]) == 0.5
+    assert abs(float(gc.residual("w")[0]) - 0.2) < 1e-6
+    g2 = jnp.asarray([0.4], jnp.float32)
+    p, n = gc.compress("w", g2)          # 0.4 + 0.2 = 0.6 -> 0.5
+    assert float(gc.unpack(p, n)[0]) == 0.5
+    assert abs(float(gc.residual("w")[0]) - 0.1) < 1e-6
+
+
+def test_kvstore_single_process_compression_noop_path():
+    """Compression only kicks in on dist stores; local pushes stay exact."""
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("3", mx.nd.zeros((2,)))
+    kv.push("3", mx.nd.array(onp.asarray([0.7, 0.1], onp.float32)))
+    out = mx.nd.zeros((2,))
+    kv.pull("3", out=out)
+    assert onp.allclose(out.asnumpy(), [0.7, 0.1])

@@ -53,6 +53,41 @@ WORKER = textwrap.dedent("""
     kv.push("3", v)
     kv.pull("3", out=out)
     assert onp.allclose(out.asnumpy(), 2 * expect), (rank, out.asnumpy())
+    kv.set_updater(None)
+
+    # bucketed list push: several keys fuse into one flat collective
+    keys = ["b0", "b1"]
+    vals = [mx.nd.array(onp.full((2, 2), float(rank + 1), onp.float32)),
+            mx.nd.array(onp.full((3,), 10.0 * (rank + 1), onp.float32))]
+    kv.push(keys, vals)
+    outs = [mx.nd.zeros((2, 2)), mx.nd.zeros((3,))]
+    kv.pull(keys, out=outs)
+    assert onp.allclose(outs[0].asnumpy(), expect), outs[0].asnumpy()
+    assert onp.allclose(outs[1].asnumpy(), 10.0 * expect), outs[1].asnumpy()
+
+    # 2-bit gradient compression with error feedback across the wire
+    # (reference dist_sync_kvstore.py compute_expected_2bit_quantization)
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_gradient_compression({{"type": "2bit", "threshold": 0.5}})
+    g = mx.nd.array(onp.asarray([0.7, 0.3, -0.9], onp.float32))
+    kv2.push("c", g)         # each rank sends [0.5, 0, -0.5]
+    outc = mx.nd.zeros((3,))
+    kv2.pull("c", out=outc)
+    assert onp.allclose(outc.asnumpy(),
+                        [0.5 * nproc, 0.0, -0.5 * nproc]), outc.asnumpy()
+    kv2.push("c", g)         # residuals: [0.2, 0.3, -0.4] + g
+    kv2.pull("c", out=outc)  # acc [0.9, 0.6, -1.3] -> [0.5, 0.5, -0.5]
+    assert onp.allclose(outc.asnumpy(),
+                        [0.5 * nproc, 0.5 * nproc, -0.5 * nproc]), \
+        outc.asnumpy()
+
+    # dist_async: pushes pipeline through the worker thread; pull drains
+    kva = mx.kv.create("dist_async")
+    for r in range(3):
+        kva.push("a", v)
+    outa = mx.nd.zeros((3, 2))
+    kva.pull("a", out=outa)
+    assert onp.allclose(outa.asnumpy(), expect), outa.asnumpy()
 
     print("DISTOK", rank, "of", nproc)
 """)

@@ -117,6 +117,41 @@ def test_export_import_bert_small(tmp_path):
         onp.abs(onp.asarray(got[0]) - ref).max())
 
 
+def test_reader_handles_packed_repeated_fields():
+    """proto3 tooling (PyTorch/onnx) packs repeated scalars: dims and
+    attribute ints arrive as one length-delimited payload."""
+    from mxnet_tpu.contrib.onnx import proto
+
+    # hand-build a TensorProto with PACKED dims [2, 3]
+    packed_dims = proto._key(1, 2) + proto._varint(2) + \
+        proto._varint(2) + proto._varint(3)
+    body = packed_dims + proto._f_varint(2, proto.FLOAT) + \
+        proto._f_string(8, "w") + \
+        proto._f_bytes(9, onp.arange(6, dtype=onp.float32).tobytes())
+    name, arr = proto.parse_tensor(body)
+    assert name == "w" and arr.shape == (2, 3)
+
+    # attribute with PACKED ints [1, -1, 4]
+    ints_payload = b"".join(proto._varint(v) for v in (1, -1, 4))
+    abody = proto._f_string(1, "perm") + \
+        proto._key(8, 2) + proto._varint(len(ints_payload)) + ints_payload \
+        + proto._f_varint(20, proto.AT_INTS)
+    k, v = proto.parse_attribute(abody)
+    assert k == "perm" and v == [1, -1, 4]
+
+
+def test_bfloat16_params_export():
+    from mxnet_tpu.contrib.onnx import proto
+    import ml_dtypes
+
+    arr = onp.asarray([1.5, -2.0], dtype=ml_dtypes.bfloat16)
+    t = proto.tensor("w", arr)
+    name, back = proto.parse_tensor(t)
+    assert name == "w"
+    assert back.dtype == onp.dtype(ml_dtypes.bfloat16)
+    assert onp.allclose(back.astype(onp.float32), [1.5, -2.0])
+
+
 def test_import_constant_node_feeds_tensor_input(tmp_path):
     """PyTorch-style graphs feed scalar Constants into Add/Mul — the
     Constant output must be usable as a tensor input, not just an attr."""

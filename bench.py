@@ -51,6 +51,9 @@ def _metric() -> dict:
     if model == "bert":
         return {"metric": "bert_base_train_throughput_per_chip",
                 "unit": "tokens/s"}
+    if model.endswith("_int8"):
+        return {"metric": f"{model}_infer_throughput_per_chip",
+                "unit": "img/s"}
     return {"metric": f"{model}_train_throughput_per_chip", "unit": "img/s"}
 
 
@@ -153,6 +156,58 @@ def bench_bert(on_cpu: bool = False):
     })
 
 
+def bench_int8(model_name: str, batch: int, img: int, steps: int):
+    """INT8 quantized-inference throughput (reference quantization flow's
+    reason to exist): calibrate -> convert -> time the jitted int8 graph,
+    reporting speedup vs the fp32 jitted forward as vs_baseline context."""
+    import jax
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib import quantization as quant
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    fp32_name = model_name[:-len("_int8")]
+    _progress(f"int8: building {fp32_name} (batch={batch} img={img})")
+    net = vision.get_model(fp32_name, classes=1000)
+    net.initialize(mx.init.Xavier())
+    cpu0 = jax.devices("cpu")[0] if jax.default_backend() != "cpu" else None
+    rng = onp.random.RandomState(0)
+    probe = mx.nd.array(rng.rand(batch, 3, img, img).astype(onp.float32))
+    if cpu0 is not None:
+        with jax.default_device(cpu0):
+            net(probe)
+    else:
+        net(probe)
+    _progress("int8: calibrating + converting")
+    calib = [mx.nd.array(rng.rand(batch, 3, img, img).astype(onp.float32))
+             for _ in range(2)]
+    qnet = quant.quantize_net(net, calib)
+    x = calib[0]
+    _progress("int8: compiling")
+    out = qnet(x)
+    jax.block_until_ready(out)
+    _progress(f"int8: timing {steps} steps")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = qnet(x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    imgs_per_sec = batch * steps / dt
+    # reference fp32 V100 inference baselines (perf.md:194); models without
+    # a published number report vs_baseline 0.0 rather than a wrong ratio
+    fp32_infer_baselines = {"resnet50_v1": 1076.81, "resnet50_v2": 1076.81,
+                            "vgg16": 708.43}
+    base = fp32_infer_baselines.get(fp32_name)
+    _emit({
+        "metric": f"{model_name}_infer_throughput_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(imgs_per_sec / base, 3) if base else 0.0,
+        "platform": jax.default_backend(),
+    })
+
+
 def _run(model_name: str, batch: int, img: int, steps: int):
     import jax
     import numpy as onp
@@ -238,6 +293,11 @@ def main():
     model_name = os.environ.get("BENCH_MODEL", "resnet50_v1")
     if model_name == "bert":
         return bench_bert(on_cpu=on_cpu)
+    if model_name.endswith("_int8"):
+        batch = int(os.environ.get("BENCH_BATCH", "8" if on_cpu else "64"))
+        steps = int(os.environ.get("BENCH_STEPS", "3" if on_cpu else "20"))
+        img = int(os.environ.get("BENCH_IMG", "64" if on_cpu else "224"))
+        return bench_int8(model_name, batch, img, steps)
     if on_cpu:
         # small enough that XLA:CPU compiles + runs inside the watchdog
         batch = int(os.environ.get("BENCH_BATCH", "8"))

@@ -1,3 +1,4 @@
 """``mx.contrib`` (reference ``python/mxnet/contrib/``)."""
 from . import onnx
+from . import quantization
 from . import text

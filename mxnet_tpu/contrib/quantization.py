@@ -1,0 +1,293 @@
+"""INT8 quantized inference: calibrate -> convert -> run.
+
+Reference: ``src/operator/quantization/`` (quantize/dequantize/requantize
+ops, quantized conv/fc kernels, calibrate.cc's naive/entropy threshold
+selection, and quantize_graph_pass.cc's graph rewrite that wraps
+quantizable nodes in quantize/dequantize pairs; python driver
+python/mxnet/contrib/quantization.py quantize_model).
+
+TPU-native design: the graph rewrite happens on the Symbol DAG (the same
+artifact hybridize traces), and the quantized kernels are XLA lowerings
+that keep the s8 x s8 -> s32 matmul/conv on the MXU with per-tensor
+scales applied as cheap epilogues — XLA fuses the dequantize into the
+surrounding elementwise work.  Activation ranges come from running the
+fp32 graph on calibration batches and recording per-node output ranges
+(naive min/max or percentile clipping, the entropy-lite analog).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..ops.registry import register
+
+__all__ = ["quantize", "dequantize", "requantize", "collect_calib_ranges",
+           "quantize_symbol", "quantize_net", "QuantizedNet"]
+
+INT8_MIN, INT8_MAX = -127.0, 127.0       # symmetric, matches reference
+
+
+# ---------------------------------------------------------------------------
+# ops (reference quantize.cc / dequantize.cc / requantize.cc)
+# ---------------------------------------------------------------------------
+
+@register("quantize", num_inputs=1, num_outputs=-1, differentiable=False)
+def quantize(data, min_range=-1.0, max_range=1.0, out_type="int8"):
+    """fp32 -> int8 with symmetric scale from the calibrated range
+    (reference quantize_v2 with min/max_calib_range)."""
+    scale = INT8_MAX / jnp.maximum(jnp.maximum(abs(float(min_range)),
+                                               abs(float(max_range))),
+                                   1e-12)
+    q = jnp.clip(jnp.round(data * scale), INT8_MIN, INT8_MAX).astype(
+        jnp.int8)
+    return (q, jnp.float32(min_range), jnp.float32(max_range))
+
+
+@register("dequantize", num_inputs=3, differentiable=False)
+def dequantize(qdata, min_range, max_range, out_type="float32"):
+    scale = jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                    jnp.abs(max_range)), 1e-12) / INT8_MAX
+    return qdata.astype(jnp.float32) * scale
+
+
+@register("requantize", num_inputs=3, num_outputs=-1, differentiable=False)
+def requantize(qdata32, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator -> int8 with a new scale (reference
+    requantize.cc)."""
+    in_scale = jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                       jnp.abs(max_range)), 1e-12) / (
+        INT8_MAX * INT8_MAX)
+    f = qdata32.astype(jnp.float32) * in_scale
+    lo = float(min_calib_range if min_calib_range is not None else -1.0)
+    hi = float(max_calib_range if max_calib_range is not None else 1.0)
+    out_scale = INT8_MAX / max(abs(lo), abs(hi), 1e-12)
+    q = jnp.clip(jnp.round(f * out_scale), INT8_MIN, INT8_MAX).astype(
+        jnp.int8)
+    return (q, jnp.float32(lo), jnp.float32(hi))
+
+
+def _sym_scale(lo: float, hi: float) -> float:
+    return max(abs(lo), abs(hi), 1e-12) / INT8_MAX
+
+
+@register("quantized_fully_connected", num_inputs=-1, differentiable=False)
+def quantized_fully_connected(arrays, num_hidden=0, no_bias=False,
+                              flatten=True, data_scale=1.0, w_scale=1.0):
+    """s8 data x s8 weight -> s32 on the MXU, fp32 epilogue (reference
+    quantized_fully_connected.cc).  arrays = [qdata, qweight, (bias fp32)]."""
+    qd, qw = arrays[0], arrays[1]
+    if flatten and qd.ndim > 2:
+        qd = qd.reshape(qd.shape[0], -1)
+    acc = jax.lax.dot_general(
+        qd, qw, (((qd.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (data_scale * w_scale)
+    if not no_bias and len(arrays) > 2:
+        out = out + arrays[2]
+    return out
+
+
+@register("quantized_conv", num_inputs=-1, differentiable=False)
+def quantized_conv(arrays, kernel=(1, 1), stride=(1, 1), dilate=(1, 1),
+                   pad=(0, 0), num_filter=1, num_group=1, no_bias=False,
+                   layout="NCHW", data_scale=1.0, w_scale=1.0):
+    """s8 conv with s32 accumulation (reference quantized_conv.cc)."""
+    qd, qw = arrays[0], arrays[1]
+    out = jax.lax.conv_general_dilated(
+        qd.astype(jnp.int8), qw.astype(jnp.int8),
+        window_strides=tuple(stride),
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=tuple(dilate), feature_group_count=num_group,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    out = out.astype(jnp.float32) * (data_scale * w_scale)
+    if not no_bias and len(arrays) > 2:
+        out = out + arrays[2].reshape(1, -1, 1, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# calibration (reference calibrate.cc + quantize_model driver)
+# ---------------------------------------------------------------------------
+
+def collect_calib_ranges(sym, feeds: List[Dict[str, Any]],
+                         mode: str = "naive",
+                         percentile: float = 99.99) -> Dict[str, Tuple[float,
+                                                                       float]]:
+    """Run the fp32 graph on calibration batches and record per-node output
+    ranges.  ``mode='naive'`` = min/max (reference CalibrationNaive);
+    ``'percentile'`` clips outliers (the entropy-lite analog of
+    CalibrationEntropy)."""
+    from ..symbol.symbol import execute_graph
+
+    nodes = sym._topo()
+    entries = [(n, i) for n in nodes if n.op is not None
+               for i in range(n.num_outputs)]
+    names = [_out_name(n, i) for (n, i) in entries]
+    ranges: Dict[str, Tuple[float, float]] = {}
+    for feed in feeds:
+        feed = {k: (v._data if hasattr(v, "_data") else jnp.asarray(v))
+                for k, v in feed.items()}
+        outs = execute_graph(entries, feed)
+        for name, o in zip(names, outs):
+            if not jnp.issubdtype(o.dtype, jnp.floating):
+                continue
+            v = onp.asarray(o, onp.float32).reshape(-1)
+            if mode == "percentile":
+                lo = float(onp.percentile(v, 100.0 - percentile))
+                hi = float(onp.percentile(v, percentile))
+            else:
+                lo, hi = float(v.min()), float(v.max())
+            if name in ranges:
+                plo, phi = ranges[name]
+                ranges[name] = (min(lo, plo), max(hi, phi))
+            else:
+                ranges[name] = (lo, hi)
+    return ranges
+
+
+def _out_name(n, i):
+    return n.name if n.num_outputs == 1 else f"{n.name}:{i}"
+
+
+# ---------------------------------------------------------------------------
+# graph rewrite (reference quantize_graph_pass.cc)
+# ---------------------------------------------------------------------------
+
+QUANTIZABLE = {"Convolution", "FullyConnected"}
+
+
+def quantize_symbol(sym, params: Dict[str, Any],
+                    calib_ranges: Dict[str, Tuple[float, float]],
+                    quantized_dtype: str = "int8",
+                    excluded_names: Tuple[str, ...] = ()):
+    """Rewrite a Symbol: every quantizable node whose input range was
+    calibrated becomes a quantized kernel fed by int8 weights (offline
+    quantized here) and int8 activations (quantized at run time with the
+    calibrated scale).  Returns (new_sym, new_params).
+
+    Mirrors quantize_graph_pass.cc: nodes not in QUANTIZABLE (or
+    explicitly excluded) stay fp32; dequantize happens in the kernel
+    epilogue so adjacent fp32 ops see ordinary floats.
+    """
+    from ..symbol.symbol import SymNode, Symbol
+
+    param_arrays = {k: (v.asnumpy() if hasattr(v, "asnumpy")
+                        else onp.asarray(v)) for k, v in params.items()}
+    new_params: Dict[str, onp.ndarray] = dict(param_arrays)
+    cache: Dict[int, SymNode] = {}
+
+    def rewrite(n) -> SymNode:
+        got = cache.get(id(n))
+        if got is not None:
+            return got
+        new_inputs = [(rewrite(src), i) for (src, i) in n.inputs]
+        out = None
+        if (n.op in QUANTIZABLE and n.name not in excluded_names
+                and len(n.inputs) >= 2):
+            data_src, data_idx = n.inputs[0]
+            w_src, _wi = n.inputs[1]
+            in_name = _out_name(data_src, data_idx)
+            w_is_param = w_src.op is None and w_src.name in param_arrays
+            rng = calib_ranges.get(in_name)
+            if data_src.op is None:          # graph input: calibrated too?
+                rng = rng or calib_ranges.get(data_src.name)
+            if w_is_param and rng is not None:
+                lo, hi = rng
+                d_scale = _sym_scale(lo, hi)
+                w = param_arrays[w_src.name]
+                w_absmax = float(onp.abs(w).max()) or 1e-12
+                w_scale = w_absmax / INT8_MAX
+                qw = onp.clip(onp.round(w / w_scale), INT8_MIN,
+                              INT8_MAX).astype(onp.int8)
+                qw_name = w_src.name + "_quantized"
+                new_params[qw_name] = qw
+                qw_node = SymNode(None, qw_name, {}, [])
+                # runtime activation quantize with the calibrated range
+                qa = SymNode("quantize", n.name + "_qdata",
+                             {"min_range": lo, "max_range": hi},
+                             [new_inputs[0]])
+                qop = ("quantized_conv" if n.op == "Convolution"
+                       else "quantized_fully_connected")
+                attrs = dict(n.attrs)
+                attrs["data_scale"] = d_scale
+                attrs["w_scale"] = w_scale
+                q_inputs = [(qa, 0), (qw_node, 0)] + new_inputs[2:]
+                out = SymNode(qop, n.name + "_quantized", attrs, q_inputs,
+                              num_outputs=1)
+        if out is None:
+            out = SymNode(n.op, n.name, dict(n.attrs), new_inputs,
+                          n.num_outputs)
+        cache[id(n)] = out
+        return out
+
+    new_outputs = [(rewrite(n), i) for (n, i) in sym._outputs]
+    new_sym = Symbol(new_outputs)
+    # prune params the rewritten graph no longer references (a shared /
+    # excluded consumer may still need the fp32 copy, so pruning is by
+    # actual reference, not by what was quantized)
+    referenced = {n.name for n in new_sym._topo() if n.op is None}
+    new_params = {k: v for k, v in new_params.items() if k in referenced}
+    return new_sym, new_params
+
+
+class QuantizedNet:
+    """Callable wrapper: jitted execution of a quantized symbol."""
+
+    def __init__(self, sym, params: Dict[str, onp.ndarray]):
+        from ..symbol.symbol import execute_graph
+
+        self.sym = sym
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        data_names = [a for a in sym.list_arguments() if a not in params]
+        assert len(data_names) == 1, data_names
+        self._data_name = data_names[0]
+        self._fn = jax.jit(
+            lambda feed: execute_graph(sym._outputs, feed))
+
+    def __call__(self, x):
+        x = x._data if hasattr(x, "_data") else jnp.asarray(x)
+        outs = self._fn({**self.params, self._data_name: x})
+        return outs[0] if len(outs) == 1 else outs
+
+
+def quantize_net(net, calib_data: List[Any], calib_mode: str = "naive",
+                 quantized_dtype: str = "int8",
+                 excluded_names: Tuple[str, ...] = ()) -> QuantizedNet:
+    """End-to-end driver (reference contrib/quantization.py
+    quantize_model): trace the hybridizable ``net``, calibrate on the
+    given batches, rewrite the graph, return a jitted int8 predictor."""
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import _wrap
+    from ..context import current_context
+
+    first = calib_data[0]
+    if not isinstance(first, NDArray):
+        first = _wrap(jnp.asarray(first), current_context())
+    net(first)                                  # ensure traced shapes
+    sym = net._trace_symbol()
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    data_names = [a for a in sym.list_arguments() if a not in params]
+    assert len(data_names) == 1, f"single-input nets only: {data_names}"
+    feeds = [{data_names[0]: (b._data if hasattr(b, "_data")
+                              else jnp.asarray(b))} for b in calib_data]
+    for f in feeds:
+        for k, v in params.items():
+            f[k] = v._data if hasattr(v, "_data") else jnp.asarray(v)
+    ranges = collect_calib_ranges(sym, feeds, mode=calib_mode)
+    # graph inputs get their own observed range
+    for f in feeds:
+        v = onp.asarray(f[data_names[0]], onp.float32)
+        lo, hi = float(v.min()), float(v.max())
+        if data_names[0] in ranges:
+            plo, phi = ranges[data_names[0]]
+            lo, hi = min(lo, plo), max(hi, phi)
+        ranges[data_names[0]] = (lo, hi)
+    qsym, qparams = quantize_symbol(sym, params, ranges,
+                                    quantized_dtype=quantized_dtype,
+                                    excluded_names=excluded_names)
+    return QuantizedNet(qsym, qparams)

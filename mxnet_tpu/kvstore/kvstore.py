@@ -207,6 +207,19 @@ class KVStore(KVStoreBase):
             if self._async_err:
                 raise self._async_err.pop(0)
 
+    def close(self):
+        """Stop the dist_async pipeline thread (idempotent)."""
+        if self._async_q is not None:
+            self._async_q.join()
+            self._async_q.put(None)          # worker exits on sentinel
+            self._async_q = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def push(self, key, value, priority=0):
         """Push values.  List pushes on a dist store are bucketed: all
         same-dtype keys fuse into ONE flattened cross-process collective

@@ -12,6 +12,7 @@ import contextlib
 import os
 from typing import Dict, List, Optional, Sequence, Union
 
+import jax.numpy as jnp
 import numpy as onp
 
 from .base import MXNetError
@@ -288,6 +289,84 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
         assert_almost_equal(grads[name], exp, rtol=rtol, atol=atol,
                             names=(f"grad[{name}]", "expected"))
     return grads
+
+
+def check_consistency(sym, location, dtypes=("float32", "float16",
+                                             "bfloat16"),
+                      grad_req="write", tol=None, with_backward=True):
+    """Run the same Symbol across execution modes and dtypes and compare
+    against the highest-precision result.
+
+    TPU analog of the reference's GPU-vs-CPU oracle
+    (python/mxnet/test_utils.py:1304 check_consistency — same symbol run
+    per (ctx, dtype) and cross-compared).  Contexts here are execution
+    MODES: eager op-by-op interpretation vs the whole-graph jit the
+    hybridized path uses; dtype sweep covers fp32/fp16/bf16 with
+    dtype-aware tolerances.  Ground truth = float32 whole-graph jit.
+
+    ``location``: dict arg-name -> numpy array (float inputs get cast per
+    dtype).  Returns the ground-truth outputs.
+    """
+    import jax
+
+    from .symbol.symbol import execute_graph
+
+    if tol is None:
+        tol = {"float32": (1e-5, 1e-6), "float16": (1e-2, 1e-3),
+               "bfloat16": (5e-2, 5e-3)}
+    args = sym.list_arguments()
+    base = {k: onp.asarray(v) for k, v in location.items()}
+    missing = [a for a in args if a not in base]
+    assert not missing, f"location missing args: {missing}"
+
+    def run(dtype, jitted):
+        feed = {}
+        for k, v in base.items():
+            arr = jnp.asarray(v)
+            if onp.issubdtype(v.dtype, onp.floating):
+                arr = arr.astype(dtype)
+            feed[k] = arr
+        fn = lambda f: execute_graph(sym._outputs, f)
+        if jitted:
+            fn = jax.jit(fn)
+        outs = fn(feed)
+        grads = None
+        if with_backward and grad_req != "null":
+            float_keys = [k for k in feed
+                          if jnp.issubdtype(feed[k].dtype, jnp.floating)]
+
+            def loss(fl):
+                outs = execute_graph(sym._outputs, {**feed, **fl})
+                return sum(jnp.sum(o.astype(jnp.float32)) for o in outs
+                           if jnp.issubdtype(o.dtype, jnp.floating))
+
+            gfn = jax.grad(loss)
+            if jitted:
+                gfn = jax.jit(gfn)
+            grads = gfn({k: feed[k] for k in float_keys})
+        return outs, grads
+
+    gt_outs, gt_grads = run("float32", jitted=True)
+    for dtype in dtypes:
+        for jitted in (False, True):
+            if dtype == "float32" and jitted:
+                continue                      # that's the ground truth
+            outs, grads = run(dtype, jitted)
+            rtol, atol = tol.get(dtype, (1e-2, 1e-3))
+            mode = "jit" if jitted else "eager"
+            for i, (o, g) in enumerate(zip(outs, gt_outs)):
+                assert_almost_equal(
+                    onp.asarray(o, onp.float32), onp.asarray(g, onp.float32),
+                    rtol=rtol, atol=atol,
+                    names=(f"{dtype}/{mode} out{i}", "float32/jit"))
+            if grads is not None and gt_grads is not None:
+                for k in gt_grads:
+                    assert_almost_equal(
+                        onp.asarray(grads[k], onp.float32),
+                        onp.asarray(gt_grads[k], onp.float32),
+                        rtol=max(rtol, 1e-4), atol=max(atol, 1e-4),
+                        names=(f"{dtype}/{mode} grad[{k}]", "float32/jit"))
+    return gt_outs
 
 
 @contextlib.contextmanager

@@ -1,0 +1,232 @@
+"""Explicit forward-smoke inputs for ops the generic probe can't drive.
+
+Shared by tests/test_op_coverage.py (every-registered-op forward oracle,
+the check_consistency companion) and usable by benchmark/opperf.  Each
+entry: name -> (list of np arrays (float32 unless noted), attrs dict).
+"""
+import numpy as onp
+
+_R = onp.random.RandomState(7)
+
+
+def _f(*shape):
+    return (_R.rand(*shape).astype(onp.float32) + 0.1)
+
+
+def _i(hi, *shape):
+    return _R.randint(0, hi, shape).astype(onp.int32)
+
+
+def _psd(n):
+    a = _R.rand(n, n).astype(onp.float32)
+    return a @ a.T + n * onp.eye(n, dtype=onp.float32)
+
+
+def _tri(n):
+    return onp.tril(_R.rand(n, n).astype(onp.float32) + 0.5)
+
+
+_SQ = _f(5, 5)
+_CONV = dict(kernel=(3, 3), num_filter=8)
+
+SPECS = {
+    # --- nn -------------------------------------------------------------
+    "Convolution": ([_f(2, 4, 8, 8), _f(8, 4, 3, 3), _f(8)], _CONV),
+    "Deconvolution": ([_f(2, 8, 6, 6), _f(8, 4, 3, 3), _f(4)],
+                      dict(kernel=(3, 3), num_filter=4)),
+    "BatchNorm": ([_f(2, 4, 6, 6), _f(4), _f(4), _f(4), _f(4)], {}),
+    "GroupNorm": ([_f(2, 4, 6, 6), _f(4), _f(4)], dict(num_groups=2)),
+    "InstanceNorm": ([_f(2, 4, 6, 6), _f(4), _f(4)], {}),
+    "Dropout": ([_f(4, 6), onp.zeros(2, onp.uint32)], dict(p=0.5)),
+    "LayerNorm": ([_f(4, 8), _f(8), _f(8)], {}),
+    "FullyConnected": ([_f(4, 8), _f(16, 8), _f(16)],
+                       dict(num_hidden=16)),
+    "Pooling": ([_f(2, 4, 8, 8)], dict(kernel=(2, 2), pool_type="max")),
+    "AdaptiveAvgPooling2D": ([_f(2, 4, 8, 8)], dict(output_size=2)),
+    "BilinearResize2D": ([_f(2, 3, 8, 8)], dict(height=4, width=4)),
+    "UpSampling": ([_f(2, 3, 4, 4)], dict(scale=2, sample_type="nearest")),
+    "CTCLoss": ([_f(8, 2, 10), _i(9, 2, 4).astype(onp.float32)], {}),
+    "_rnn_fused": ([_f(5, 2, 4), _f(1, 2, 8), _f(1, 2, 8),
+                    _f(32, 4), _f(32, 8), _f(32), _f(32)],
+                   dict(hidden_size=8, num_layers=1, mode="lstm")),
+    "ROIAlign": ([_f(1, 4, 8, 8),
+                  onp.asarray([[0, 1, 1, 6, 6]], onp.float32)],
+                 dict(pooled_size=(2, 2), spatial_scale=1.0)),
+    "PSROIPooling": ([_f(1, 8, 8, 8),
+                      onp.asarray([[0, 1, 1, 6, 6]], onp.float32)],
+                     dict(output_dim=2, pooled_size=2, spatial_scale=1.0)),
+    "BilinearSampler": ([_f(1, 2, 6, 6),
+                         (_R.rand(1, 2, 4, 4) * 2 - 1).astype(onp.float32)],
+                        {}),
+    "SpatialTransformer": ([_f(1, 2, 6, 6),
+                            onp.asarray([[1, 0, 0, 0, 1, 0]], onp.float32)],
+                           dict(target_shape=(6, 6))),
+    "GridGenerator": ([onp.asarray([[1, 0, 0, 0, 1, 0]], onp.float32)],
+                      dict(transform_type="affine", target_shape=(4, 4))),
+    "DeformableConvolution": ([_f(1, 4, 7, 7), onp.zeros((1, 18, 5, 5),
+                                                         onp.float32),
+                               _f(6, 4, 3, 3), _f(6)],
+                              dict(kernel=(3, 3), num_filter=6)),
+    "Correlation": ([_f(1, 4, 6, 6), _f(1, 4, 6, 6)],
+                    dict(max_displacement=1, pad_size=1)),
+    "Crop": ([_f(1, 2, 6, 6)], dict(h_w=(4, 4), center_crop=True)),
+    "depth_to_space": ([_f(1, 8, 3, 3)], dict(block_size=2)),
+    "space_to_depth": ([_f(1, 2, 6, 6)], dict(block_size=2)),
+    "Proposal": ([_f(1, 6, 4, 4), _f(1, 12, 4, 4),
+                  onp.asarray([[32, 32, 1.0]], onp.float32)],
+                 dict(scales=(8.0,), ratios=(0.5, 1.0, 2.0),
+                      feature_stride=8, rpn_post_nms_top_n=5)),
+    # --- attention ------------------------------------------------------
+    "interleaved_matmul_selfatt_qk": ([_f(6, 2, 24)], dict(heads=2)),
+    "interleaved_matmul_selfatt_valatt": ([_f(6, 2, 24), _f(4, 6, 6)],
+                                          dict(heads=2)),
+    "interleaved_matmul_encdec_qk": ([_f(6, 2, 8), _f(5, 2, 16)],
+                                     dict(heads=2)),
+    "interleaved_matmul_encdec_valatt": ([_f(5, 2, 16), _f(4, 6, 5)],
+                                         dict(heads=2)),
+    # --- tensor/shape ---------------------------------------------------
+    "reshape": ([_f(4, 6)], dict(shape=(6, 4))),
+    "Reshape": ([_f(4, 6)], dict(shape=(6, 4))),
+    "slice": ([_f(4, 6)], dict(begin=(0, 1), end=(3, 5))),
+    "reverse": ([_f(4, 6)], dict(axis=0)),
+    "roll": ([_f(4, 6)], dict(shift=2)),
+    "tile": ([_f(2, 3)], dict(reps=(2, 2))),
+    "pad": ([_f(1, 2, 4, 4)],
+            dict(mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))),
+    "broadcast_axis": ([_f(1, 6)], dict(axis=0, size=4)),
+    "broadcast_to": ([_f(1, 6)], dict(shape=(4, 6))),
+    "ones": ([], dict(shape=(3, 3))),
+    "zeros": ([], dict(shape=(3, 3))),
+    "full": ([], dict(shape=(3, 3), value=2.5)),
+    "pick": ([_f(4, 6), _i(6, 4).astype(onp.float32)], {}),
+    "batch_take": ([_f(4, 6), _i(6, 4)], {}),
+    "choose_element_0index": ([_f(4, 6), _i(6, 4)], {}),
+    "fill_element_0index": ([_f(4, 6), _f(4), _i(6, 4)], {}),
+    "gather_nd": ([_f(4, 6), _i(4, 1, 3)], {}),
+    "scatter_nd": ([_f(3), onp.stack([_i(4, 3), _i(6, 3)]).astype(
+        onp.int32)], dict(shape=(4, 6))),
+    "index_copy": ([_f(4, 6), _i(4, 2), _f(2, 6)], {}),
+    "unravel_index": ([_i(24, 5)], dict(shape=(4, 6))),
+    "ravel_multi_index": ([onp.stack([_i(4, 5), _i(6, 5)]).astype(
+        onp.int32)], dict(shape=(4, 6))),
+    "one_hot": ([_i(6, 4)], dict(depth=6)),
+    "topk": ([_f(4, 6)], dict(k=2)),
+    "sequence_mask": ([_f(5, 2, 4), onp.asarray([3, 5], onp.float32)],
+                      dict(use_sequence_length=True)),
+    "sequence_last": ([_f(5, 2, 4), onp.asarray([3, 5], onp.float32)],
+                      dict(use_sequence_length=True)),
+    "sequence_reverse": ([_f(5, 2, 4), onp.asarray([3, 5], onp.float32)],
+                         dict(use_sequence_length=True)),
+    "SwapAxis": ([_f(4, 6)], dict(dim1=0, dim2=1)),
+    "expand_dims": ([_f(4, 6)], dict(axis=0)),
+    "squeeze": ([_f(1, 4, 6)], dict(axis=0)),
+    # --- matmul/linalg --------------------------------------------------
+    "dot": ([_f(4, 6), _f(6, 5)], {}),
+    "batch_dot": ([_f(2, 4, 6), _f(2, 6, 5)], {}),
+    "matmul": ([_f(4, 6), _f(6, 5)], {}),
+    "linalg_gemm": ([_f(4, 6), _f(6, 5), _f(4, 5)], {}),
+    "linalg_gemm2": ([_f(4, 6), _f(6, 5)], {}),
+    "linalg_cholesky": ([_psd(5)], {}),
+    "linalg_potrf": ([_psd(5)], {}),
+    "linalg_potri": ([_tri(5)], {}),
+    "linalg_det": ([_SQ], {}),
+    "linalg_slogdet": ([_psd(5)], {}),
+    "linalg_inverse": ([_psd(5)], {}),
+    "linalg_eigh": ([_psd(5)], {}),
+    "linalg_eigvalsh": ([_psd(5)], {}),
+    "linalg_solve": ([_psd(5), _f(5, 3)], {}),
+    "linalg_trmm": ([_tri(5), _f(5, 3)], {}),
+    "linalg_trsm": ([_tri(5), _f(5, 3)], {}),
+    "linalg_tensorinv": ([_psd(4).reshape(2, 2, 2, 2)], dict(ind=2)),
+    "linalg_syrk": ([_f(4, 6)], {}),
+    "linalg_extracttrian": ([_SQ], {}),
+    "linalg_makediag": ([_f(5)], {}),
+    "linalg_extractdiag": ([_SQ], {}),
+    # --- detection ------------------------------------------------------
+    "box_iou": ([_R.rand(4, 4).astype(onp.float32),
+                 _R.rand(5, 4).astype(onp.float32)], {}),
+    "box_encode": ([onp.ones((1, 3), onp.float32),
+                    onp.zeros((1, 3), onp.float32),
+                    onp.asarray([[[.1, .1, .4, .5], [.2, .2, .6, .7],
+                                  [.3, .1, .8, .4]]], onp.float32),
+                    onp.asarray([[[.15, .15, .45, .5],
+                                  [.3, .2, .7, .8]]], onp.float32),
+                    onp.zeros(4, onp.float32), onp.ones(4, onp.float32)],
+                   {}),
+    "multibox_target": ([_R.rand(1, 4, 4).astype(onp.float32),
+                         onp.asarray([[[1, .1, .1, .6, .6]]], onp.float32),
+                         onp.zeros((1, 3, 4), onp.float32)], {}),
+    "multibox_detection": ([
+        _R.rand(1, 3, 4).astype(onp.float32),
+        (_R.rand(1, 16) * 0.1).astype(onp.float32),
+        _R.rand(1, 4, 4).astype(onp.float32)], {}),
+    "count_sketch": ([_f(2, 6), _i(4, 6).astype(onp.float32),
+                      onp.sign(_R.randn(6)).astype(onp.float32)],
+                     dict(out_dim=4)),
+    # --- optimizer multi-tensor ----------------------------------------
+    "adadelta_update": ([_f(4), _f(4), onp.zeros(4, onp.float32),
+                         onp.zeros(4, onp.float32)], {}),
+    "adamw_update": ([_f(4), _f(4), _f(4), _f(4)], {}),
+    "ftrl_update": ([_f(4), _f(4), _f(4), _f(4)], {}),
+    # state arrays start at zero (E[g^2] >= E[g]^2 must hold)
+    "rmspropalex_update": ([_f(4), _f(4), onp.zeros(4, onp.float32),
+                            onp.zeros(4, onp.float32),
+                            onp.zeros(4, onp.float32)], {}),
+    "lamb_update_phase2": ([_f(4), _f(4), onp.asarray(1.0, onp.float32),
+                            onp.asarray(1.0, onp.float32)], {}),
+    "multi_sgd_update": ([_f(4), _f(3), _f(4), _f(3)],
+                         dict(lrs=(0.1, 0.1), wds=(0.0, 0.0),
+                              num_weights=2)),
+    "multi_sgd_mom_update": ([_f(4), _f(3), _f(4), _f(3), _f(4), _f(3)],
+                             dict(lrs=(0.1, 0.1), wds=(0.0, 0.0),
+                                  num_weights=2)),
+    "multi_lamb_update": ([_f(4), _f(3), _f(4), _f(3), _f(4), _f(3),
+                           _f(4), _f(3)],
+                          dict(learning_rates=(0.1, 0.1), wds=(0.0, 0.0),
+                               num_tensors=2)),
+    "multi_lans_update": ([_f(4), _f(3), _f(4), _f(3), _f(4), _f(3),
+                           _f(4), _f(3)],
+                          dict(learning_rates=(0.1, 0.1), wds=(0.0, 0.0),
+                               num_tensors=2)),
+    # --- misc -----------------------------------------------------------
+    "softmax_cross_entropy": ([_f(4, 6), _i(6, 4).astype(onp.float32)],
+                              {}),
+    "embedding": ([_i(10, 4), _f(10, 8)], {}),
+    "take": ([_f(10, 8), _i(10, 4).astype(onp.float32)], {}),
+    "Cast": ([_f(4, 6)], dict(dtype="float16")),
+    "cast": ([_f(4, 6)], dict(dtype="float16")),
+    "arange_like": ([_f(4, 6)], dict(axis=1)),
+    "where": ([(_R.rand(4, 6) > 0.5).astype(onp.float32), _f(4, 6),
+               _f(4, 6)], {}),
+    # --- int8 quantization ops (contrib.quantization) -------------------
+    "quantize": ([_f(4, 6)], dict(min_range=-1.0, max_range=1.0)),
+    "dequantize": ([(_R.randint(-127, 127, (4, 6))).astype(onp.int8),
+                    onp.asarray(-1.0, onp.float32),
+                    onp.asarray(1.0, onp.float32)], {}),
+    "requantize": ([_R.randint(-4000, 4000, (4, 6)).astype(onp.int32),
+                    onp.asarray(-2.0, onp.float32),
+                    onp.asarray(2.0, onp.float32)],
+                   dict(min_calib_range=-1.0, max_calib_range=1.0)),
+    "quantized_conv": ([_R.randint(-127, 127, (1, 3, 6, 6)).astype(
+        onp.int8), _R.randint(-127, 127, (4, 3, 3, 3)).astype(onp.int8)],
+        dict(kernel=(3, 3), num_filter=4, no_bias=True,
+             data_scale=0.01, w_scale=0.01)),
+    "quantized_fully_connected": ([
+        _R.randint(-127, 127, (4, 6)).astype(onp.int8),
+        _R.randint(-127, 127, (8, 6)).astype(onp.int8), _f(8)],
+        dict(num_hidden=8, data_scale=0.01, w_scale=0.01)),
+    # --- domain-restricted unary ---------------------------------------
+    "arcsin": ([(_R.rand(4, 6) * 1.6 - 0.8).astype(onp.float32)], {}),
+    "arccos": ([(_R.rand(4, 6) * 1.6 - 0.8).astype(onp.float32)], {}),
+    "arctanh": ([(_R.rand(4, 6) * 1.6 - 0.8).astype(onp.float32)], {}),
+    "erfinv": ([(_R.rand(4, 6) * 1.6 - 0.8).astype(onp.float32)], {}),
+    "arccosh": ([(_R.rand(4, 6) + 1.1).astype(onp.float32)], {}),
+    # --- scalar-attr binary ---------------------------------------------
+    "div_scalar": ([_f(4, 6)], dict(scalar=2.0)),
+    "mod_scalar": ([_f(4, 6)], dict(scalar=2.0)),
+    # --- pdf params in-domain -------------------------------------------
+    "pdf_negative_binomial": ([_i(5, 4).astype(onp.float32) * 1.0,
+                               _f(4) + 1.0,
+                               (_R.rand(4) * 0.6 + 0.2).astype(
+                                   onp.float32)], {}),
+}

@@ -1,0 +1,153 @@
+"""Every registered operator gets at least a forward test; differentiable
+float ops get a finite-gradient check (VERDICT round-1 item 9).
+
+Reference analog: the breadth of tests/python/unittest/test_operator.py —
+here data-driven: ops not coverable by a generic random input carry an
+explicit spec in tests/op_smoke_specs.py, and the suite FAILS if any
+registered op is neither runnable nor skip-listed, so new ops cannot land
+untested.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import get_op, list_ops
+
+from op_smoke_specs import SPECS
+
+# Ops whose forward needs external state or is covered by dedicated tests
+# elsewhere (reason documented) — keep this SHORT.
+SKIP = {
+    "linalg_maketrian": "registered as explicit not-implemented guard",
+}
+
+_GEN = onp.random.RandomState(0)
+
+
+def _generic_inputs(schema):
+    n = schema.num_inputs
+    if n == -1:
+        n = 2
+    return [_GEN.rand(4, 6).astype(onp.float32) + 0.1 for _ in range(n)], {}
+
+
+def _inputs_for(name):
+    schema = get_op(name)
+    if name in SPECS:
+        arrays, attrs = SPECS[name]
+        return list(arrays), dict(attrs), schema
+    arrays, attrs = _generic_inputs(schema)
+    return arrays, attrs, schema
+
+
+def _run_forward(name):
+    arrays, attrs, schema = _inputs_for(name)
+    nds = [mx.nd.array(a) for a in arrays]
+    out = mx.nd.invoke(schema, nds, dict(attrs))
+    outs = out if isinstance(out, list) else [out]
+    for o in outs:
+        v = o.asnumpy()
+        if onp.issubdtype(v.dtype, onp.floating):
+            assert onp.isfinite(v).all(), f"{name}: non-finite output"
+    return arrays, attrs, schema, outs
+
+
+# _np_call is the internal dispatch record for traced jnp calls (registered
+# lazily on mx.np import); it is not a user op and needs a jnp_name attr
+_OPS_AT_IMPORT = list(list_ops())
+ALL_OPS = [n for n in _OPS_AT_IMPORT if n not in SKIP and n != "_np_call"]
+
+
+@pytest.mark.parametrize("name", ALL_OPS)
+def test_forward_smoke(name):
+    _run_forward(name)
+
+
+DIFF_OPS = [n for n in ALL_OPS
+            if get_op(n).differentiable and n not in (
+                # forward covered above; grads covered by dedicated tests
+                "_rnn_fused", "CTCLoss", "Dropout", "BatchNorm",
+                "multi_all_finite",
+                # jax defines no VJP for complete QR on this path
+                "linalg_qr",
+            )]
+
+
+@pytest.mark.parametrize("name", DIFF_OPS)
+def test_gradients_finite(name):
+    """Differentiable ops: jax.grad of sum(outputs) w.r.t. every float
+    input exists and is finite."""
+    arrays, attrs, schema = _inputs_for(name)
+    float_idx = [i for i, a in enumerate(arrays)
+                 if onp.issubdtype(onp.asarray(a).dtype, onp.floating)]
+    if not float_idx:
+        pytest.skip("no float inputs")
+    jarrs = [jnp.asarray(a) for a in arrays]
+
+    def loss(fl):
+        full = list(jarrs)
+        for i, v in zip(float_idx, fl):
+            full[i] = v
+        out = schema.fn(full, **attrs) if schema.num_inputs == -1 \
+            else schema.fn(*full, **attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return sum(jnp.sum(o.astype(jnp.float32)) for o in outs
+                   if jnp.issubdtype(o.dtype, jnp.floating))
+
+    grads = jax.grad(loss)([jarrs[i] for i in float_idx])
+    for g in grads:
+        assert onp.isfinite(onp.asarray(g)).all(), f"{name}: NaN/inf grad"
+
+
+def test_check_consistency_oracle():
+    """check_consistency: eager-vs-jit and dtype sweep agree on a small
+    conv net symbol (the reference's cross-context oracle)."""
+    from mxnet_tpu import symbol as S
+    from mxnet_tpu.test_utils import check_consistency
+
+    x = S.var("data")
+    w = S.var("w")
+    b = S.var("b")
+    y = S.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    y = S.Activation(y, act_type="relu")
+    y = S.Pooling(y, kernel=(2, 2), pool_type="max", stride=(2, 2))
+    rng = onp.random.RandomState(0)
+    check_consistency(y, {
+        "data": rng.rand(2, 3, 8, 8).astype(onp.float32),
+        "w": (rng.rand(4, 3, 3, 3).astype(onp.float32) - 0.5) * 0.3,
+        "b": rng.rand(4).astype(onp.float32) * 0.1,
+    })
+
+
+def test_check_consistency_catches_divergence():
+    """The oracle actually fails when modes diverge (guard against a
+    vacuous checker): feed a symbol whose fp16 result differs wildly."""
+    from mxnet_tpu import symbol as S
+    from mxnet_tpu.test_utils import check_consistency
+
+    x = S.var("data")
+    # catastrophic cancellation amplifier: (x + 1e4) - 1e4 in fp16 is
+    # lossy at this magnitude
+    y = (x + 1e4) - 1e4
+    data = onp.full((4,), 0.123, onp.float32)
+    with pytest.raises(AssertionError):
+        check_consistency(y, {"data": data},
+                          dtypes=("float16",),
+                          tol={"float16": (1e-7, 1e-8)})
+
+
+def test_no_uncovered_ops():
+    """Registry and coverage stay in lockstep: a newly registered op must
+    either run under the generic probe, get a SPECS entry, or be
+    explicitly skip-listed with a reason."""
+    internal = {"_np_call"}           # lazily registered dispatch record
+    covered = set(ALL_OPS) | set(SKIP) | internal
+    # judge coverage against the framework surface seen at module import;
+    # ops registered DURING the session (mx.library extension tests) are
+    # user extensions, not framework surface
+    uncovered = set(_OPS_AT_IMPORT) - covered
+    assert not uncovered, f"ops with no forward coverage: {uncovered}"
+    unknown_skips = set(SKIP) - set(list_ops())
+    assert not unknown_skips, f"SKIP entries for unknown ops: {unknown_skips}"

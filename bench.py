@@ -158,8 +158,10 @@ def bench_bert(on_cpu: bool = False):
 
 def bench_int8(model_name: str, batch: int, img: int, steps: int):
     """INT8 quantized-inference throughput (reference quantization flow's
-    reason to exist): calibrate -> convert -> time the jitted int8 graph,
-    reporting speedup vs the fp32 jitted forward as vs_baseline context."""
+    reason to exist): calibrate -> convert -> time the jitted int8 graph.
+    ``vs_baseline`` compares against the reference's PUBLISHED fp32 V100
+    inference number for the model (perf.md:194) when one exists, 0.0
+    otherwise — it is NOT an on-machine int8-vs-fp32 speedup."""
     import jax
     import numpy as onp
 

@@ -59,6 +59,7 @@ _LAZY = {
     "visualization": ".visualization",
     "viz": ".visualization",
     "library": ".library",
+    "config": ".config",
 }
 
 

@@ -1,37 +1,138 @@
-"""AMP op lists (reference ``python/mxnet/contrib/amp/lists/symbol_fp16.py``).
+"""AMP op lists (reference ``python/mxnet/contrib/amp/lists/symbol_fp16.py``
+— the reference classifies its whole operator surface into per-op lists;
+this module does the same for this registry, enforced exhaustive by
+tests/test_amp_profiler.py).
 
-Three classes, same split logic as the reference:
-- LOW_PRECISION_FUNCS: matmul/conv-class ops that are safe and fast in
-  bf16/fp16 (MXU ops)
-- FP32_FUNCS: numerically sensitive ops pinned to fp32 (norms, softmax/log,
-  losses, reductions feeding statistics)
-- WIDEST_TYPE_CASTS: elementwise multi-input ops that follow their widest
-  input
-On TPU the low-precision dtype is bfloat16 by default — same exponent range
-as fp32, so the reference's loss-scaling machinery is optional (kept for
-fp16 parity).
+Four classes, same split logic as the reference:
+
+- LOW_PRECISION_FUNCS (reference FP16_FUNCS): matmul/conv-class ops that
+  are safe and fast in bf16/fp16 — these are the MXU ops, where low
+  precision doubles throughput.
+- FP32_FUNCS: numerically sensitive ops pinned to fp32 — norms, softmax /
+  log / exp family, losses, statistics-feeding reductions, linear
+  algebra factorizations, probability densities, and optimizer update
+  kernels (master-weight math stays fp32).
+- WIDEST_TYPE_CASTS: multi-input elementwise ops that follow their widest
+  input dtype (reference WIDEST_TYPE_CASTS).
+- FP16_FP32_FUNCS: dtype-neutral ops that run correctly in whichever
+  precision arrives (moves/reshapes/indexing/comparisons/integer and
+  random ops).  The policy leaves their inputs untouched.
+
+On TPU the low-precision dtype is bfloat16 by default — same exponent
+range as fp32, so the reference's loss-scaling machinery is optional
+(kept for fp16 parity).
 """
 
 LOW_PRECISION_FUNCS = [
     "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
-    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
-    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
-    "linalg_gemm", "linalg_gemm2", "_rnn_fused",
+    "matmul", "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt", "linalg_gemm", "linalg_gemm2",
+    "_rnn_fused", "DeformableConvolution", "Correlation", "khatri_rao",
 ]
 
 FP32_FUNCS = [
+    # normalization / losses
     "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "LRN",
     "L2Normalization", "softmax", "log_softmax", "softmin",
     "softmax_cross_entropy", "SoftmaxOutput", "CTCLoss", "MakeLoss",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput", "smooth_l1",
+    # exp/log family and friends
     "exp", "log", "log2", "log10", "log1p", "expm1", "square", "sqrt",
-    "rsqrt", "cbrt", "power", "norm", "mean", "sum", "prod", "nansum",
-    "nanprod", "cumsum", "cumprod", "moments", "erf", "erfinv", "gamma",
-    "gammaln",
+    "rsqrt", "cbrt", "rcbrt", "power", "power_scalar", "reciprocal",
+    "softrelu", "log_sigmoid", "mish", "erf", "erfinv", "gamma",
+    "gammaln", "digamma", "hypot", "hypot_scalar", "ldexp", "logaddexp",
+    "div_sqrt_dim", "quadratic",
+    # statistics-feeding reductions
+    "norm", "mean", "sum", "prod", "nansum", "nanprod", "cumsum",
+    "cumprod", "moments", "multi_sum_sq", "linalg_sumlogdiag",
+    # sensitive inverse-trig / hyperbolic
+    "arccos", "arcsin", "arctan", "arccosh", "arcsinh", "arctanh",
+    "degrees", "radians",
+    # linear-algebra factorizations / solves
+    "linalg_cholesky", "linalg_potrf", "linalg_potri", "linalg_det",
+    "linalg_slogdet", "linalg_inverse", "linalg_pinv", "linalg_eigh",
+    "linalg_eigvalsh", "linalg_svd", "linalg_qr", "linalg_gelqf",
+    "linalg_lstsq", "linalg_solve", "linalg_trmm", "linalg_trsm",
+    "linalg_syrk", "linalg_tensorinv", "linalg_matrix_rank",
+    "linalg_norm_np", "linalg_extractdiag", "linalg_makediag",
+    "linalg_maketrian", "linalg_extracttrian",
+    # spectral / sketching
+    "fft", "ifft", "count_sketch",
+    # probability densities
+    "pdf_normal", "pdf_uniform", "pdf_gamma", "pdf_exponential",
+    "pdf_poisson", "pdf_negative_binomial",
+    "pdf_generalized_negative_binomial", "pdf_dirichlet",
+    # optimizer update kernels (master weights are fp32)
+    "sgd_update", "sgd_mom_update", "nag_mom_update", "adam_update",
+    "adamw_update", "adagrad_update", "adadelta_update", "ftrl_update",
+    "rmsprop_update", "rmspropalex_update", "signsgd_update",
+    "signum_update", "lamb_update_phase1", "lamb_update_phase2",
+    "multi_sgd_update", "multi_sgd_mom_update", "multi_lamb_update",
+    "multi_lans_update",
 ]
 
 WIDEST_TYPE_CASTS = [
     "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
     "broadcast_mod", "broadcast_power", "broadcast_maximum",
     "broadcast_minimum", "broadcast_hypot", "add_n", "concat", "stack",
-    "where", "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "where", "elemwise_add", "elemwise_sub", "elemwise_mul",
+    "elemwise_div",
+]
+
+# Everything else: dtype-neutral — runs in whichever precision arrives.
+# Kept explicit so the classification is EXHAUSTIVE over the registry
+# (tests fail when a new op lands unclassified, mirroring the reference's
+# all-ops list files).
+FP16_FP32_FUNCS = [
+    # activations / simple elementwise
+    "Activation", "LeakyReLU", "relu", "sigmoid", "tanh", "softsign",
+    "hard_sigmoid", "abs", "sign", "negative", "ceil", "floor", "rint",
+    "fix", "trunc", "clip", "sin", "cos", "tan", "sinh", "cosh",
+    "maximum_scalar", "minimum_scalar", "add_scalar", "sub_scalar",
+    "mul_scalar", "div_scalar", "mod_scalar",
+    # comparisons / logic (dtype-insensitive outputs)
+    "equal_scalar", "not_equal_scalar", "greater_scalar",
+    "greater_equal_scalar", "lesser_scalar", "lesser_equal_scalar",
+    "broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+    "broadcast_greater_equal", "broadcast_lesser",
+    "broadcast_lesser_equal", "broadcast_logical_and",
+    "broadcast_logical_or", "broadcast_logical_xor", "logical_not",
+    "logical_and", "logical_or", "logical_xor", "logical_and_scalar",
+    "logical_or_scalar", "logical_xor_scalar", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "bitwise_not", "isnan", "isinf",
+    "isfinite", "allclose", "all_finite", "multi_all_finite",
+    # shape/index/move ops
+    "reshape", "Reshape", "flatten", "transpose", "expand_dims",
+    "squeeze", "swapaxes", "SwapAxis", "slice", "slice_axis",
+    "slice_like", "split", "SliceChannel", "take", "batch_take",
+    "embedding", "one_hot", "pick", "gather_nd", "scatter_nd",
+    "index_copy", "index_array", "boolean_mask", "broadcast_axis",
+    "broadcast_to", "repeat", "tile", "reverse", "roll", "rot90", "pad",
+    "Pad", "depth_to_space", "space_to_depth", "diag", "triu", "tril",
+    "trace", "Crop", "sequence_mask", "sequence_last", "sequence_reverse",
+    "sldwin_atten_mask_like", "choose_element_0index",
+    "fill_element_0index", "unravel_index", "ravel_multi_index",
+    "shape_array", "size_array", "cast", "Cast", "_copy", "_index",
+    "BlockGrad", "arange_like",
+    # ordering / extrema (value-preserving)
+    "argmax", "argmin", "argmax_channel", "argsort", "sort", "topk",
+    "max", "min", "unique",
+    # pooling / resampling (window moves, no accumulation hazard in bf16)
+    "Pooling", "AdaptiveAvgPooling2D", "UpSampling", "BilinearResize2D",
+    "BilinearSampler", "GridGenerator", "SpatialTransformer", "ROIAlign",
+    "PSROIPooling", "Dropout",
+    # detection (mask/compare logic)
+    "box_iou", "box_nms", "box_encode", "box_decode",
+    "bipartite_matching", "multibox_prior", "multibox_target",
+    "multibox_detection", "Proposal",
+    # creation / random (dtype comes from attrs)
+    "zeros", "ones", "full", "eye", "arange", "linspace", "zeros_like",
+    "ones_like", "normal", "uniform", "randint", "randn", "bernoulli",
+    "exponential", "poisson", "negative_binomial", "random_gamma",
+    "multinomial", "shuffle",
+    # int8 quantization domain (outside amp entirely)
+    "quantize", "dequantize", "requantize", "quantized_conv",
+    "quantized_fully_connected",
 ]

@@ -1,0 +1,167 @@
+"""Typed environment-variable configuration registry.
+
+Reference: the ~102 documented ``MXNET_*`` env vars read via
+``dmlc::GetEnv`` at point of use (docs/static_site/.../env_var.md) plus
+the dmlc ``Parameter``/``DMLC_DECLARE_FIELD`` reflection that gives each
+knob a type, default, bounds, and docstring.  Here both roles live in one
+registry: every knob is declared once with type/default/validator/doc,
+reads go through :func:`get` (validated, cached), and
+:func:`describe`/:func:`to_markdown` generate the env-var table the
+reference maintained by hand.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["declare", "get", "describe", "to_markdown", "refresh",
+           "VARIABLES"]
+
+
+@dataclass
+class EnvVar:
+    name: str
+    type: Callable
+    default: Any
+    doc: str
+    validator: Optional[Callable[[Any], bool]] = None
+    subsystem: str = "core"
+
+
+VARIABLES: Dict[str, EnvVar] = {}
+_CACHE: Dict[str, Any] = {}
+
+
+def declare(name: str, type: Callable = str, default: Any = None,
+            doc: str = "", validator: Optional[Callable] = None,
+            subsystem: str = "core") -> EnvVar:
+    """Register a knob (DMLC_DECLARE_FIELD analog).  Idempotent by name."""
+    if name in VARIABLES:
+        return VARIABLES[name]
+    v = EnvVar(name, type, default, doc, validator, subsystem)
+    VARIABLES[name] = v
+    return v
+
+
+def _parse(var: EnvVar, raw: str) -> Any:
+    if var.type is bool:
+        val = raw.strip().lower() in ("1", "true", "yes", "on")
+    else:
+        val = var.type(raw)
+    if var.validator is not None and not var.validator(val):
+        raise ValueError(
+            f"{var.name}={raw!r} failed validation ({var.doc})")
+    return val
+
+
+def get(name: str, default: Any = None) -> Any:
+    """Validated, cached env read (dmlc::GetEnv analog).  Unknown names
+    raise — every knob must be declared."""
+    if name not in VARIABLES:
+        raise KeyError(f"undeclared env var {name}; declare() it first")
+    if name in _CACHE:
+        return _CACHE[name]
+    var = VARIABLES[name]
+    raw = os.environ.get(name)
+    val = (default if default is not None else var.default) if raw is None \
+        else _parse(var, raw)
+    _CACHE[name] = val
+    return val
+
+
+def refresh(name: Optional[str] = None) -> None:
+    """Drop cached reads (tests / runtime re-configuration)."""
+    if name is None:
+        _CACHE.clear()
+    else:
+        _CACHE.pop(name, None)
+
+
+def describe() -> Dict[str, Dict[str, Any]]:
+    return {
+        n: {"type": v.type.__name__, "default": v.default, "doc": v.doc,
+            "subsystem": v.subsystem}
+        for n, v in sorted(VARIABLES.items())
+    }
+
+
+def to_markdown() -> str:
+    """Generate the env-var reference table (the reference's
+    faq/env_var.md, but produced from the registry so it can't go
+    stale)."""
+    lines = ["# Environment variables", "",
+             "Generated from `mxnet_tpu.config.VARIABLES` "
+             "(`python -c \"import mxnet_tpu.config as c; "
+             "print(c.to_markdown())\"`).", ""]
+    by_sub: Dict[str, list] = {}
+    for v in VARIABLES.values():
+        by_sub.setdefault(v.subsystem, []).append(v)
+    for sub in sorted(by_sub):
+        lines.append(f"## {sub}")
+        lines.append("")
+        lines.append("| Variable | Type | Default | Description |")
+        lines.append("|---|---|---|---|")
+        for v in sorted(by_sub[sub], key=lambda x: x.name):
+            lines.append(f"| `{v.name}` | {v.type.__name__} | "
+                         f"`{v.default}` | {v.doc} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Declarations: the knobs this framework reads (reference env_var.md table)
+# ---------------------------------------------------------------------------
+
+declare("MXNET_HOME", str, "~/.mxnet",
+        "Cache root for model-zoo checkpoints and datasets",
+        subsystem="io")
+declare("MXNET_SKIP_SHA1_CHECK", bool, False,
+        "Accept cached pretrained checkpoints without checksum "
+        "verification", subsystem="io")
+declare("MXNET_CPU_WORKER_NTHREADS", int, 4,
+        "Host-side worker threads for IO prefetch / native engine "
+        "(reference engine env var of the same name)",
+        validator=lambda v: v >= 1, subsystem="engine")
+declare("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+        "Engine facade selection; XLA async dispatch is the real "
+        "scheduler, NaiveEngine forces synchronous eager dispatch for "
+        "debugging (reference MXNET_ENGINE_TYPE)", subsystem="engine")
+declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+        "Arrays larger than this many elements get their own dist push "
+        "bucket (reference kvstore_dist big-array splitting)",
+        validator=lambda v: v > 0, subsystem="kvstore")
+declare("MXNET_ENFORCE_DETERMINISM", bool, False,
+        "Disable nondeterministic optimizations (XLA autotuning picks "
+        "deterministic kernels)", subsystem="engine")
+declare("MXNET_MODULE_SEED", int, None,
+        "Override the per-test RNG seed for reproduction (reference test "
+        "harness contract)", subsystem="testing")
+declare("MXNET_TEST_SEED", int, None,
+        "Per-test seed printed by the conftest on failure",
+        subsystem="testing")
+declare("MXNET_SAFE_ACCUMULATION", bool, True,
+        "Accumulate fp16/bf16 reductions in fp32 (reference "
+        "MXNET_SAFE_ACCUMULATION; XLA does this for MXU matmuls by "
+        "default)", subsystem="ops")
+declare("MXNET_GPU_MEM_POOL_TYPE", str, "Round",
+        "Accepted for parity; PJRT owns HBM pooling on TPU",
+        subsystem="memory")
+declare("MXNET_PROFILER_AUTOSTART", bool, False,
+        "Start the profiler at import (reference profiler env var)",
+        subsystem="profiler")
+declare("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+        "Accepted for parity; XLA whole-graph compilation subsumes "
+        "engine op bulking", subsystem="engine")
+declare("BENCH_MODEL", str, "resnet50_v1",
+        "bench.py model selection (resnet50_v1 | bert | <name>_int8)",
+        subsystem="bench")
+declare("BENCH_BATCH", int, None, "bench.py batch size override",
+        subsystem="bench")
+declare("BENCH_STEPS", int, None, "bench.py timed step count",
+        subsystem="bench")
+declare("BENCH_ACCUM", int, 1,
+        "bench.py BERT gradient-accumulation factor",
+        validator=lambda v: v >= 1, subsystem="bench")
+declare("GRAFT_NDEV", int, 8,
+        "__graft_entry__ dryrun virtual device count", subsystem="testing")

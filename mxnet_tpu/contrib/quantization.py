@@ -187,7 +187,13 @@ def quantize_symbol(sym, params: Dict[str, Any],
             return got
         new_inputs = [(rewrite(src), i) for (src, i) in n.inputs]
         out = None
-        if (n.op in QUANTIZABLE and n.name not in excluded_names
+        # quantized_conv implements the 2D NCHW path only; other ranks /
+        # layouts stay fp32 rather than silently mis-lowering
+        conv_ok = (n.op != "Convolution"
+                   or (len(n.attrs.get("kernel", ())) == 2
+                       and n.attrs.get("layout") in (None, "NCHW")))
+        if (n.op in QUANTIZABLE and conv_ok
+                and n.name not in excluded_names
                 and len(n.inputs) >= 2):
             data_src, data_idx = n.inputs[0]
             w_src, _wi = n.inputs[1]
@@ -239,15 +245,14 @@ class QuantizedNet:
     """Callable wrapper: jitted execution of a quantized symbol."""
 
     def __init__(self, sym, params: Dict[str, onp.ndarray]):
-        from ..symbol.symbol import execute_graph
+        from ..symbol.symbol import _jit_graph
 
         self.sym = sym
         self.params = {k: jnp.asarray(v) for k, v in params.items()}
         data_names = [a for a in sym.list_arguments() if a not in params]
         assert len(data_names) == 1, data_names
         self._data_name = data_names[0]
-        self._fn = jax.jit(
-            lambda feed: execute_graph(sym._outputs, feed))
+        self._fn = _jit_graph(sym)          # shared jit cache (symbol.py)
 
     def __call__(self, x):
         x = x._data if hasattr(x, "_data") else jnp.asarray(x)

@@ -1,0 +1,172 @@
+"""Pretrained-weight store (reference
+``python/mxnet/gluon/model_zoo/model_store.py``).
+
+The reference keeps a sha1 table of published checkpoints and downloads
+them into ``$MXNET_HOME/models`` on demand.  This build keeps the same
+cache layout and API — ``get_model_file(name, root)`` resolves a local
+``<name>-<sha1[:8]>.params`` file — with two sources:
+
+1. the local cache (files the user placed or previously downloaded), and
+2. ``download()`` over HTTP when the environment allows egress (this
+   build's environments usually do NOT, so a missing file raises with
+   instructions rather than hanging on a dead socket).
+
+``purge``/``get_model_file`` signatures match the reference so user code
+ports unchanged.  Checkpoints trained HERE can be published into the
+cache with :func:`publish_model_file`, giving fully offline
+pretrained=True flows.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from typing import Dict, Optional
+
+__all__ = ["get_model_file", "publish_model_file", "purge", "data_dir"]
+
+# name -> sha1 of the published checkpoint (reference _model_sha1 table;
+# hashes match apache/incubator-mxnet model_store.py so files fetched for
+# the reference work here unchanged)
+_model_sha1: Dict[str, str] = {
+    name: checksum for checksum, name in [
+        ("44335d1f0046b328243b32a26a4fbd62d9057b45", "alexnet"),
+        ("f27dbf2dbd5ce9a80b102d89c7483342cd33cb31", "densenet121"),
+        ("b6c8a95717e3e761bd88d145f4d0a214aaa515dc", "densenet161"),
+        ("2603f878403c6aa5a71a124c4a3307143d6820e9", "densenet169"),
+        ("1cdbc116bc3a1b65832b18cf53e1cb8e7da017eb", "densenet201"),
+        ("ed47ec45a937b656fcc94dabde85495bbef5ba1f", "inceptionv3"),
+        ("9f83e440996887baf91a6aff1cccc1c903a64274", "mobilenet0.25"),
+        ("8e9d539cc66aa5efa71c4b6af983b936ab8701c3", "mobilenet0.5"),
+        ("529b2c7f4934e6cb851155b22c96c9ab0a7c4dc2", "mobilenet0.75"),
+        ("6b8c5106c730e8750bcd82ceb75220a3351157cd", "mobilenet1.0"),
+        ("36da4ff1867abccd32b29592d79fc753bca5a215", "mobilenetv2_1.0"),
+        ("e2be7b72a79fe4a750d1dd415afedf01c3ea818d", "mobilenetv2_0.75"),
+        ("aabd26cd335379fcb72ae6c8fac45a70eab11785", "mobilenetv2_0.5"),
+        ("ae8f9392789b04822cbb1d98c27283fc5f8aa0a7", "mobilenetv2_0.25"),
+        ("a0666292f0a30ff61f857b0b66efc0d5127f19cb", "resnet18_v1"),
+        ("48216ba99a8b1005d75c0f3a0c422301a0473233", "resnet34_v1"),
+        ("0aee57f96768c0a2d5b23a6ec91eb08dfb0a45ce", "resnet50_v1"),
+        ("d988c13d6159779e907140a638c56f229634cb02", "resnet101_v1"),
+        ("671c637a14387ab9e2654eafd0d493d86b1c8579", "resnet152_v1"),
+        ("a81db45fd7b7a2d12ab97cd88ef0a5ac48b8f657", "resnet18_v2"),
+        ("9d6b80bbc35169de6b6edecffdd6047c56fdd322", "resnet34_v2"),
+        ("ecdde35339c1aadbec4f547857078e734a76fb49", "resnet50_v2"),
+        ("18e93e4f48947e002547f50eabbcc9c83e516aa6", "resnet101_v2"),
+        ("f2695542de38cf7e71ed58f02893d82bb409415e", "resnet152_v2"),
+        ("264ba4970a0cc87a4f15c96e25246a1307caf523", "squeezenet1.0"),
+        ("33ba0f93753c83d86e1eb397f38a667eaf2e9376", "squeezenet1.1"),
+        ("dd221b160977f36a53f464cb54648d227c707a05", "vgg11"),
+        ("ee79a8098a91fbe05b7a973fed2017a6117723a8", "vgg11_bn"),
+        ("6bc5de58a05a5e2e7f493e2d75a580d3aa10aefd", "vgg13"),
+        ("7d97a06c3c7a1aecc88b6e7385c2b373a249e95e", "vgg13_bn"),
+        ("e660d4569ccb679ec68f1fd3cce07a387252a90a", "vgg16"),
+        ("7f01cf050d357127a73826045c245041b0df7363", "vgg16_bn"),
+        ("ad2f660d101905472b83590b59708b71ea22b2e5", "vgg19"),
+        ("f360b758e856f1074a85abd5fd873ed1d98297c3", "vgg19_bn"),
+    ]
+}
+
+_URL_FMT = ("https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/"
+            "gluon/models/{file_name}.zip")
+
+
+def data_dir() -> str:
+    return os.path.join(
+        os.environ.get("MXNET_HOME", os.path.join(os.path.expanduser("~"),
+                                                  ".mxnet")), "models")
+
+
+def short_hash(name: str) -> str:
+    if name not in _model_sha1:
+        raise ValueError(
+            f"Pretrained model for {name} is not available; known: "
+            f"{sorted(_model_sha1)}")
+    return _model_sha1[name][:8]
+
+
+def _check_sha1(filename: str, sha1_hash: str) -> bool:
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def get_model_file(name: str, root: Optional[str] = None) -> str:
+    """Resolve the local path of a pretrained checkpoint, fetching it if
+    the environment allows network egress (reference get_model_file)."""
+    root = os.path.expanduser(root or data_dir())
+    file_name = f"{name}-{short_hash(name)}"
+    file_path = os.path.join(root, file_name + ".params")
+    sha1 = _model_sha1[name]
+    if os.path.exists(file_path):
+        if _check_sha1(file_path, sha1) or os.environ.get(
+                "MXNET_SKIP_SHA1_CHECK") == "1":
+            return file_path
+        raise IOError(
+            f"checksum mismatch for {file_path}; delete it and re-fetch")
+    # attempt the reference's download path; most TPU build environments
+    # have no egress, so fail fast with actionable instructions
+    url = _URL_FMT.format(file_name=file_name)
+    try:
+        import socket
+        import urllib.request
+        import zipfile
+
+        os.makedirs(root, exist_ok=True)
+        zip_path = file_path + ".zip"
+        with urllib.request.urlopen(url, timeout=10) as r, \
+                open(zip_path, "wb") as f:
+            shutil.copyfileobj(r, f)
+        with zipfile.ZipFile(zip_path) as zf:
+            zf.extractall(root)
+        os.remove(zip_path)
+        if os.path.exists(file_path):
+            return file_path
+    except (OSError, socket.timeout) as e:
+        raise IOError(
+            f"Pretrained weights for '{name}' are not cached at "
+            f"{file_path} and could not be downloaded ({e}).  Place the "
+            f"checkpoint there manually (format: this framework's "
+            f"save_parameters dict, or publish one with "
+            f"model_store.publish_model_file), or fetch {url} on a "
+            f"machine with network access.") from e
+    raise IOError(f"download of {url} produced no {file_path}")
+
+
+def publish_model_file(params_path: str, name: str,
+                       root: Optional[str] = None) -> str:
+    """Register a locally trained checkpoint under ``name`` so
+    ``pretrained=True`` resolves it offline.  The file's own sha1 becomes
+    the table entry (overriding any reference hash for this session)."""
+    root = os.path.expanduser(root or data_dir())
+    os.makedirs(root, exist_ok=True)
+    sha1 = hashlib.sha1()
+    with open(params_path, "rb") as f:
+        sha1.update(f.read())
+    digest = sha1.hexdigest()
+    _model_sha1[name] = digest
+    dst = os.path.join(root, f"{name}-{digest[:8]}.params")
+    shutil.copyfile(params_path, dst)
+    return dst
+
+
+def purge(root: Optional[str] = None) -> None:
+    """Remove cached checkpoints (reference purge)."""
+    root = os.path.expanduser(root or data_dir())
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))
+
+
+def load_pretrained(net, name: str, ctx=None, root: Optional[str] = None):
+    """Resolve + load pretrained parameters into ``net`` (shared by the
+    model zoo's ``pretrained=True`` paths)."""
+    path = get_model_file(name, root=root)
+    net.load_parameters(path, ctx=ctx)
+    return net

@@ -38,5 +38,7 @@ class AlexNet(HybridBlock):
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     net = AlexNet(**kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights require a local file")
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, "alexnet", ctx=ctx, root=root)
     return net

@@ -143,5 +143,7 @@ class Inception3(HybridBlock):
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
     net = Inception3(**kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights require a local file")
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, "inceptionv3", ctx=ctx, root=root)
     return net

@@ -235,7 +235,9 @@ class MobileNetV3(HybridBlock):
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights require a local file")
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, f"mobilenet{multiplier}", ctx=ctx, root=root)
     return net
 
 
@@ -243,7 +245,10 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights require a local file")
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, f"mobilenetv2_{multiplier}", ctx=ctx,
+                        root=root)
     return net
 
 

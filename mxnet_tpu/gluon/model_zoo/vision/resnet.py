@@ -230,10 +230,10 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights require a model store download; train from "
-            "scratch or use load_parameters with a local file"
-        )
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, f"resnet{num_layers}_v{version}", ctx=ctx,
+                        root=root)
     return net
 
 

@@ -82,7 +82,9 @@ class SqueezeNet(HybridBlock):
 def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
     net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights require a local file")
+        from ..model_store import load_pretrained
+
+        load_pretrained(net, f"squeezenet{version}", ctx=ctx, root=root)
     return net
 
 

@@ -97,7 +97,8 @@ class KVStore(KVStoreBase):
         self._async_err: List[BaseException] = []
         if kv_type == "dist_async":
             self._async_q = queue.Queue()
-            t = threading.Thread(target=self._async_worker, daemon=True)
+            t = threading.Thread(target=self._async_worker,
+                                 args=(self._async_q,), daemon=True)
             t.start()
 
     # -- identity --------------------------------------------------------
@@ -188,10 +189,13 @@ class KVStore(KVStoreBase):
             self._data[k] = _wrap(merged, ctx)
 
     # -- dist_async pipeline ---------------------------------------------
-    def _async_worker(self):
+    def _async_worker(self, q):
+        # the queue is passed in (not re-read from self) so close() can
+        # null the attribute without racing this loop
         while True:
-            item = self._async_q.get()
+            item = q.get()
             if item is None:
+                q.task_done()
                 return
             k, v = item
             try:
@@ -199,7 +203,7 @@ class KVStore(KVStoreBase):
             except BaseException as e:          # surfaced at next sync
                 self._async_err.append(e)
             finally:
-                self._async_q.task_done()
+                q.task_done()
 
     def _drain_async(self):
         if self._async_q is not None:
@@ -208,11 +212,15 @@ class KVStore(KVStoreBase):
                 raise self._async_err.pop(0)
 
     def close(self):
-        """Stop the dist_async pipeline thread (idempotent)."""
-        if self._async_q is not None:
-            self._async_q.join()
-            self._async_q.put(None)          # worker exits on sentinel
-            self._async_q = None
+        """Stop the dist_async pipeline thread (idempotent); surfaces any
+        pending async push errors."""
+        q, self._async_q = self._async_q, None
+        if q is not None:
+            q.join()
+            q.put(None)                      # worker exits on sentinel
+            q.join()
+            if self._async_err:
+                raise self._async_err.pop(0)
 
     def __del__(self):
         try:
@@ -242,13 +250,22 @@ class KVStore(KVStoreBase):
             self._apply_merged(k, self._reduce(k, v), v[0].ctx)
 
     def _push_bucketed(self, keys, values):
-        """Fuse many keys into one flat cross-process sum."""
+        """Fuse many keys into flat cross-process sums.  Arrays above
+        MXNET_KVSTORE_BIGARRAY_BOUND get their own collective (reference
+        kvstore_dist big-array splitting; see mxnet_tpu.config)."""
+        from .. import config as _config
+
+        bound = _config.get("MXNET_KVSTORE_BIGARRAY_BOUND")
         locals_ = [self._local_sum(v) for v in values]
-        by_dtype: Dict[str, List[int]] = {}
+        buckets: Dict[str, List[int]] = {}
         for i, m in enumerate(locals_):
-            by_dtype.setdefault(str(m.dtype), []).append(i)
-        for _dt, idxs in by_dtype.items():
-            flat = jnp.concatenate([locals_[i].reshape(-1) for i in idxs])
+            if m.size > bound:
+                buckets[f"big{i}"] = [i]
+            else:
+                buckets.setdefault(str(m.dtype), []).append(i)
+        for _bk, idxs in buckets.items():
+            flat = jnp.concatenate([locals_[i].reshape(-1) for i in idxs]) \
+                if len(idxs) > 1 else locals_[idxs[0]].reshape(-1)
             summed = _cross_process_sum(flat)
             off = 0
             for i in idxs:

@@ -151,3 +151,27 @@ def test_runtime_features():
     assert fs.is_enabled("XLA")
     with pytest.raises(RuntimeError):
         fs.is_enabled("NOT_A_FEATURE")
+
+
+def test_amp_lists_exhaustive_over_registry():
+    """Every registered op is classified into exactly one AMP list
+    (reference per-op list-file parity); new ops cannot land
+    unclassified."""
+    from mxnet_tpu.amp import lists
+    from mxnet_tpu.ops.registry import list_ops
+
+    all_lists = (lists.LOW_PRECISION_FUNCS, lists.FP32_FUNCS,
+                 lists.WIDEST_TYPE_CASTS, lists.FP16_FP32_FUNCS)
+    union = set().union(*all_lists)
+    core = {n for n in list_ops()
+            if n != "_np_call" and not n.startswith("ext_")
+            and n not in ("my_gemm", "my_relu")}   # session extensions
+    missing = sorted(core - union)
+    assert not missing, f"ops missing an AMP classification: {missing}"
+    # no op sits in two lists (ambiguous policy)
+    seen = set()
+    dups = set()
+    for lst in all_lists:
+        for n in lst:
+            (dups if n in seen else seen).add(n)
+    assert not dups, f"ops in multiple AMP lists: {dups}"

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""im2rec: build .lst files and packed RecordIO datasets from image folders.
+
+Reference: ``tools/im2rec.py`` (same CLI surface: ``--list`` mode walks an
+image root into train/val .lst splits; pack mode reads a .lst, optionally
+resizes/re-encodes, and writes ``.rec`` + ``.idx`` via IndexedRecordIO).
+Output records use the dmlc IRHeader format, so datasets packed here load
+in ``mx.io.ImageRecordIter`` / ``ImageRecordFileDataset`` (and in the
+reference).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root: str, recursive: bool, exts=EXTS):
+    """Yield (index, relpath, label) walking class folders alphabetically
+    (reference list_image: label = folder index)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            files.sort()
+            for f in files:
+                if os.path.splitext(f)[1].lower() not in exts:
+                    continue
+                if path not in cat:
+                    cat[path] = len(cat)
+                yield i, os.path.relpath(os.path.join(path, f), root), \
+                    cat[path]
+                i += 1
+    else:
+        for f in sorted(os.listdir(root)):
+            if os.path.splitext(f)[1].lower() in exts:
+                yield i, f, 0
+                i += 1
+
+
+def write_list(args):
+    entries = list(list_images(args.root, args.recursive))
+    if args.shuffle:
+        random.seed(100)                     # reference uses seed 100
+        random.shuffle(entries)
+    n = len(entries)
+    n_train = int(n * args.train_ratio)
+    n_test = int(n * args.test_ratio)
+    splits = [("train", entries[:n_train])] if args.train_ratio < 1.0 else \
+        [("", entries)]
+    if args.test_ratio > 0:
+        splits.append(("test", entries[n_train:n_train + n_test]))
+    if args.train_ratio + args.test_ratio < 1.0:
+        splits.append(("val", entries[n_train + n_test:]))
+    for suffix, chunk in splits:
+        name = args.prefix + (f"_{suffix}" if suffix else "") + ".lst"
+        with open(name, "w") as f:
+            for j, (idx, rel, label) in enumerate(chunk):
+                f.write(f"{j}\t{label}\t{rel}\n")
+        print(f"wrote {name} ({len(chunk)} entries)")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(args):
+    import numpy as onp
+
+    from mxnet_tpu import recordio
+
+    try:
+        import cv2
+    except ImportError:
+        cv2 = None
+
+    lst = args.prefix + ".lst" if not args.prefix.endswith(".lst") \
+        else args.prefix
+    base = lst[:-len(".lst")]
+    rec = recordio.MXIndexedRecordIO(base + ".idx", base + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(lst):
+        path = os.path.join(args.root, rel)
+        with open(path, "rb") as f:
+            buf = f.read()
+        if (args.resize or args.quality != 95 or args.center_crop) \
+                and cv2 is not None:
+            img = cv2.imdecode(onp.frombuffer(buf, onp.uint8),
+                               cv2.IMREAD_COLOR)
+            if args.center_crop and img.shape[0] != img.shape[1]:
+                m = min(img.shape[:2])
+                y0 = (img.shape[0] - m) // 2
+                x0 = (img.shape[1] - m) // 2
+                img = img[y0:y0 + m, x0:x0 + m]
+            if args.resize:
+                small = min(img.shape[:2])
+                scale = args.resize / small
+                img = cv2.resize(img, (int(round(img.shape[1] * scale)),
+                                       int(round(img.shape[0] * scale))))
+            ext = ".png" if args.encoding == ".png" else ".jpg"
+            params = [cv2.IMWRITE_JPEG_QUALITY, args.quality] \
+                if ext == ".jpg" else [cv2.IMWRITE_PNG_COMPRESSION, 3]
+            ok, enc = cv2.imencode(ext, img, params)
+            assert ok, path
+            buf = enc.tobytes()
+        if len(labels) == 1:
+            header = recordio.IRHeader(0, labels[0], idx, 0)
+        else:
+            header = recordio.IRHeader(0, labels, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, buf))
+        count += 1
+        if count % 1000 == 0:
+            print(f"packed {count} images")
+    rec.close()
+    print(f"wrote {base}.rec / {base}.idx ({count} records)")
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="Create an image list or a RecordIO dataset "
+                    "(reference tools/im2rec.py)")
+    p.add_argument("prefix", help="prefix of .lst/.rec files")
+    p.add_argument("root", help="root folder of images")
+    p.add_argument("--list", action="store_true",
+                   help="create an image list instead of a record file")
+    p.add_argument("--recursive", action="store_true",
+                   help="walk class subfolders; label = folder index")
+    p.add_argument("--shuffle", type=bool, default=True)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge to this size")
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", choices=[".jpg", ".png"], default=".jpg")
+    args = p.parse_args()
+    if args.list:
+        write_list(args)
+    else:
+        pack(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -115,13 +115,13 @@ def bench_bert(on_cpu: bool = False):
     import jax.numpy as jnp
     import numpy as onp
 
-    from mxnet_tpu import models
+    from mxnet_tpu import config, models
     from mxnet_tpu import parallel as par
 
-    batch = int(os.environ.get("BENCH_BATCH", "4" if on_cpu else "32"))
-    seq = int(os.environ.get("BENCH_SEQ", "128"))
-    steps = int(os.environ.get("BENCH_STEPS", "2" if on_cpu else "20"))
-    accum = int(os.environ.get("BENCH_ACCUM", "1"))  # micro-batch accum
+    batch = config.get("BENCH_BATCH", default=4 if on_cpu else 32)
+    seq = config.get("BENCH_SEQ")
+    steps = config.get("BENCH_STEPS", default=2 if on_cpu else 20)
+    accum = config.get("BENCH_ACCUM")  # micro-batch accum
 
     _progress(f"bert: init params (batch={batch} seq={seq} accum={accum})")
     cfg = models.TransformerLMConfig(dtype=jnp.bfloat16)
@@ -279,7 +279,9 @@ def main():
     device_ok = _probe_device_backend(probe_timeout)
     on_cpu = False
     if not device_ok:
-        if os.environ.get("BENCH_CPU_FALLBACK", "1") != "1":
+        # same truthy set as config._parse (this knob is read pre-import)
+        fallback = os.environ.get("BENCH_CPU_FALLBACK", "1").strip().lower()
+        if fallback not in ("1", "true", "yes", "on"):
             _emit({
                 **_metric(), "value": 0.0, "vs_baseline": 0.0,
                 "error": "device backend unreachable and CPU fallback "
@@ -293,21 +295,25 @@ def main():
         on_cpu = True
 
     model_name = os.environ.get("BENCH_MODEL", "resnet50_v1")
+    # past the probe: mxnet_tpu is safe to import, knobs go through the
+    # typed registry (validated; docs generated from the same declarations)
+    from mxnet_tpu import config
+
     if model_name == "bert":
         return bench_bert(on_cpu=on_cpu)
     if model_name.endswith("_int8"):
-        batch = int(os.environ.get("BENCH_BATCH", "8" if on_cpu else "64"))
-        steps = int(os.environ.get("BENCH_STEPS", "3" if on_cpu else "20"))
-        img = int(os.environ.get("BENCH_IMG", "64" if on_cpu else "224"))
+        batch = config.get("BENCH_BATCH", default=8 if on_cpu else 64)
+        steps = config.get("BENCH_STEPS", default=3 if on_cpu else 20)
+        img = config.get("BENCH_IMG", default=64 if on_cpu else 224)
         return bench_int8(model_name, batch, img, steps)
     if on_cpu:
         # small enough that XLA:CPU compiles + runs inside the watchdog
-        batch = int(os.environ.get("BENCH_BATCH", "8"))
-        steps = int(os.environ.get("BENCH_STEPS", "3"))
+        batch = config.get("BENCH_BATCH", default=8)
+        steps = config.get("BENCH_STEPS", default=3)
     else:
-        batch = int(os.environ.get("BENCH_BATCH", "256"))
-        steps = int(os.environ.get("BENCH_STEPS", "20"))
-    img = int(os.environ.get("BENCH_IMG", "224"))
+        batch = config.get("BENCH_BATCH", default=256)
+        steps = config.get("BENCH_STEPS", default=20)
+    img = config.get("BENCH_IMG")
     _run(model_name, batch, img, steps)
 
 

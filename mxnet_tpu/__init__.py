@@ -20,6 +20,17 @@ __version__ = "0.1.0"
 
 from . import base
 from .base import MXNetError
+from . import config  # noqa: E402  (no jax dependency; safe first)
+
+if config.get("MXNET_ENFORCE_DETERMINISM"):
+    # Reference semantics: trade speed for bit-reproducibility.  On TPU the
+    # levers are sharding-invariant RNG and pinning matmuls to highest
+    # precision (rules out nondeterministic reduced-precision fast paths).
+    import jax as _jax
+
+    _jax.config.update("jax_threefry_partitionable", True)
+    _jax.config.update("jax_default_matmul_precision", "highest")
+
 from .context import (Context, cpu, cpu_pinned, current_context, gpu, num_gpus,
                       num_tpus, tpu)
 

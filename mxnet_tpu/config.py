@@ -27,6 +27,9 @@ class EnvVar:
     doc: str
     validator: Optional[Callable[[Any], bool]] = None
     subsystem: str = "core"
+    # cached=False: re-read the environment on every get().  For knobs that
+    # tests/tools legitimately flip mid-process (paths, debug switches).
+    cached: bool = True
 
 
 VARIABLES: Dict[str, EnvVar] = {}
@@ -35,11 +38,11 @@ _CACHE: Dict[str, Any] = {}
 
 def declare(name: str, type: Callable = str, default: Any = None,
             doc: str = "", validator: Optional[Callable] = None,
-            subsystem: str = "core") -> EnvVar:
+            subsystem: str = "core", cached: bool = True) -> EnvVar:
     """Register a knob (DMLC_DECLARE_FIELD analog).  Idempotent by name."""
     if name in VARIABLES:
         return VARIABLES[name]
-    v = EnvVar(name, type, default, doc, validator, subsystem)
+    v = EnvVar(name, type, default, doc, validator, subsystem, cached)
     VARIABLES[name] = v
     return v
 
@@ -57,16 +60,26 @@ def _parse(var: EnvVar, raw: str) -> Any:
 
 def get(name: str, default: Any = None) -> Any:
     """Validated, cached env read (dmlc::GetEnv analog).  Unknown names
-    raise — every knob must be declared."""
+    raise — every knob must be declared.  Only values parsed from the
+    environment are cached: a call-site ``default`` applies to that call
+    alone and must never shadow the declared default for other callers."""
     if name not in VARIABLES:
         raise KeyError(f"undeclared env var {name}; declare() it first")
     if name in _CACHE:
         return _CACHE[name]
     var = VARIABLES[name]
     raw = os.environ.get(name)
-    val = (default if default is not None else var.default) if raw is None \
-        else _parse(var, raw)
-    _CACHE[name] = val
+    if raw is None:
+        val = var.default if default is None else default
+        if (default is not None and var.validator is not None
+                and not var.validator(val)):
+            raise ValueError(
+                f"{name} call-site default {val!r} failed validation "
+                f"({var.doc})")
+        return val
+    val = _parse(var, raw)
+    if var.cached:
+        _CACHE[name] = val
     return val
 
 
@@ -115,7 +128,7 @@ def to_markdown() -> str:
 
 declare("MXNET_HOME", str, "~/.mxnet",
         "Cache root for model-zoo checkpoints and datasets",
-        subsystem="io")
+        subsystem="io", cached=False)
 declare("MXNET_SKIP_SHA1_CHECK", bool, False,
         "Accept cached pretrained checkpoints without checksum "
         "verification", subsystem="io")
@@ -126,7 +139,8 @@ declare("MXNET_CPU_WORKER_NTHREADS", int, 4,
 declare("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
         "Engine facade selection; XLA async dispatch is the real "
         "scheduler, NaiveEngine forces synchronous eager dispatch for "
-        "debugging (reference MXNET_ENGINE_TYPE)", subsystem="engine")
+        "debugging (reference MXNET_ENGINE_TYPE)", subsystem="engine",
+        cached=False)
 declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
         "Arrays larger than this many elements get their own dist push "
         "bucket (reference kvstore_dist big-array splitting)",
@@ -153,6 +167,11 @@ declare("MXNET_PROFILER_AUTOSTART", bool, False,
 declare("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
         "Accepted for parity; XLA whole-graph compilation subsumes "
         "engine op bulking", subsystem="engine")
+# bench.py knobs.  BENCH_MODEL/BENCH_TIMEOUT/BENCH_PROBE_TIMEOUT/
+# BENCH_CPU_FALLBACK are read raw (os.environ) by bench.py BEFORE any
+# mxnet_tpu/jax import — the whole point of its probe phase is to not touch
+# the package until the device backend is known good — so they are declared
+# here for the generated docs; the post-import knobs go through config.get.
 declare("BENCH_MODEL", str, "resnet50_v1",
         "bench.py model selection (resnet50_v1 | bert | <name>_int8)",
         subsystem="bench")
@@ -160,8 +179,21 @@ declare("BENCH_BATCH", int, None, "bench.py batch size override",
         subsystem="bench")
 declare("BENCH_STEPS", int, None, "bench.py timed step count",
         subsystem="bench")
+declare("BENCH_IMG", int, 224, "bench.py image edge length",
+        validator=lambda v: v >= 8, subsystem="bench")
+declare("BENCH_SEQ", int, 128, "bench.py BERT sequence length",
+        validator=lambda v: v >= 1, subsystem="bench")
 declare("BENCH_ACCUM", int, 1,
         "bench.py BERT gradient-accumulation factor",
         validator=lambda v: v >= 1, subsystem="bench")
+declare("BENCH_TIMEOUT", float, 1500.0,
+        "bench.py watchdog: emit a failure JSON line after this many "
+        "seconds", subsystem="bench")
+declare("BENCH_PROBE_TIMEOUT", float, 240.0,
+        "bench.py device-backend subprocess probe timeout (seconds)",
+        subsystem="bench")
+declare("BENCH_CPU_FALLBACK", bool, True,
+        "bench.py: fall back to the host CPU backend when the device "
+        "probe fails instead of erroring", subsystem="bench")
 declare("GRAFT_NDEV", int, 8,
         "__graft_entry__ dryrun virtual device count", subsystem="testing")

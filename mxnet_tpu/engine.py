@@ -10,9 +10,25 @@ from __future__ import annotations
 
 import contextlib
 
-__all__ = ["bulk", "set_bulk_size", "waitall"]
+__all__ = ["bulk", "set_bulk_size", "waitall", "engine_type", "is_naive"]
 
 _bulk_size = 15  # reference default MXNET_ENGINE_BULK_SIZE-ish; advisory only
+
+
+def engine_type() -> str:
+    """Engine selection (reference CreateEngine, src/engine/engine.cc:32,
+    driven by MXNET_ENGINE_TYPE).  ThreadedEnginePerDevice = XLA async
+    dispatch (default); NaiveEngine = synchronous eager dispatch for
+    deterministic debugging, same role as the reference's NaiveEngine.
+    The knob is declared uncached so flipping it mid-process (its whole
+    point when debugging) takes effect on the next op."""
+    from . import config
+
+    return config.get("MXNET_ENGINE_TYPE")
+
+
+def is_naive() -> bool:
+    return engine_type() == "NaiveEngine"
 
 
 def set_bulk_size(size: int) -> int:

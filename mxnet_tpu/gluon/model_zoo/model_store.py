@@ -72,9 +72,10 @@ _URL_FMT = ("https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/"
 
 
 def data_dir() -> str:
-    return os.path.join(
-        os.environ.get("MXNET_HOME", os.path.join(os.path.expanduser("~"),
-                                                  ".mxnet")), "models")
+    from ... import config
+
+    return os.path.join(os.path.expanduser(config.get("MXNET_HOME")),
+                        "models")
 
 
 def short_hash(name: str) -> str:
@@ -104,11 +105,14 @@ def get_model_file(name: str, root: Optional[str] = None) -> str:
     file_path = os.path.join(root, file_name + ".params")
     sha1 = _model_sha1[name]
     if os.path.exists(file_path):
-        if _check_sha1(file_path, sha1) or os.environ.get(
-                "MXNET_SKIP_SHA1_CHECK") == "1":
+        from ... import config
+
+        if config.get("MXNET_SKIP_SHA1_CHECK") or _check_sha1(file_path,
+                                                              sha1):
             return file_path
         raise IOError(
-            f"checksum mismatch for {file_path}; delete it and re-fetch")
+            f"checksum mismatch for {file_path}; delete it and re-fetch "
+            f"(or set MXNET_SKIP_SHA1_CHECK=1 to accept it)")
     # attempt the reference's download path; most TPU build environments
     # have no egress, so fail fast with actionable instructions
     url = _URL_FMT.format(file_name=file_name)
@@ -119,14 +123,26 @@ def get_model_file(name: str, root: Optional[str] = None) -> str:
 
         os.makedirs(root, exist_ok=True)
         zip_path = file_path + ".zip"
-        with urllib.request.urlopen(url, timeout=10) as r, \
-                open(zip_path, "wb") as f:
-            shutil.copyfileobj(r, f)
-        with zipfile.ZipFile(zip_path) as zf:
-            zf.extractall(root)
-        os.remove(zip_path)
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r, \
+                    open(zip_path, "wb") as f:
+                shutil.copyfileobj(r, f)
+            with zipfile.ZipFile(zip_path) as zf:
+                zf.extractall(root)
+            os.remove(zip_path)
+        except zipfile.BadZipFile as e:
+            # captive portal / proxy error page served with HTTP 200: don't
+            # leave the poisoned .zip in the cache
+            if os.path.exists(zip_path):
+                os.remove(zip_path)
+            raise OSError(f"server returned a non-zip payload: {e}") from e
         if os.path.exists(file_path):
-            return file_path
+            # verify the fresh download too — a valid zip can still carry
+            # wrong bytes (stale mirror / tampering); don't load it silently
+            if _check_sha1(file_path, sha1):
+                return file_path
+            os.remove(file_path)
+            raise OSError("downloaded checkpoint failed sha1 verification")
     except (OSError, socket.timeout) as e:
         raise IOError(
             f"Pretrained weights for '{name}' are not cached at "

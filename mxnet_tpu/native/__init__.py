@@ -126,7 +126,11 @@ class NativeEngine:
     (include/mxnet/engine.h:155-264).
     """
 
-    def __init__(self, num_threads: int = 4):
+    def __init__(self, num_threads: Optional[int] = None):
+        if num_threads is None:
+            from .. import config
+
+            num_threads = config.get("MXNET_CPU_WORKER_NTHREADS")
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native engine unavailable: {_lib_err}")

@@ -1,0 +1,115 @@
+"""Build + load helper for the C ABI library (libmxnet_tpu_c.so).
+
+Unlike libmxnet_tpu_native.so (pure C++, no Python), the C API embeds
+CPython (reference analog: src/c_api/ linking the full runtime), so it is
+built separately, linking libpython.  Two consumers:
+
+- foreign C/C++/FFI programs: link against the .so + the public header
+  ``mxnet_tpu/native/include/mxnet_tpu/c_api.h`` and call MXTpuLibInit;
+- this test suite: loads it with ctypes in-process (the interpreter is
+  already live, MXTpuLibInit is a no-op beyond importing the bridge).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "c_api.cc")
+_INCLUDE = os.path.join(_HERE, "include")
+_BUILD = os.path.join(_HERE, "build")
+LIB_PATH = os.path.join(_BUILD, "libmxnet_tpu_c.so")
+HEADER_PATH = os.path.join(_INCLUDE, "mxnet_tpu", "c_api.h")
+
+_lib = None
+_lib_err: Optional[str] = None
+_lock = threading.Lock()
+
+
+def python_link_flags():
+    """(include_dir, lib_dir, lib_name) for embedding this interpreter."""
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION")
+    return inc, libdir, f"python{ver}"
+
+
+def build(force: bool = False) -> str:
+    """Compile libmxnet_tpu_c.so (atomic rename, same recipe as
+    native._build)."""
+    os.makedirs(_BUILD, exist_ok=True)
+    if (not force and os.path.exists(LIB_PATH)
+            and os.path.getmtime(LIB_PATH) >= os.path.getmtime(_SRC)):
+        return LIB_PATH
+    inc, libdir, pylib = python_link_flags()
+    tmp = f"{LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           f"-I{inc}", f"-I{_INCLUDE}", "-o", tmp, _SRC,
+           f"-L{libdir}", f"-l{pylib}", f"-Wl,-rpath,{libdir}"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"c_api build failed:\n{proc.stderr}")
+    os.replace(tmp, LIB_PATH)
+    return LIB_PATH
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_i64p = ctypes.POINTER(ctypes.c_int64)
+    c_ip = ctypes.POINTER(ctypes.c_int)
+    h = ctypes.c_void_p
+    hp = ctypes.POINTER(h)
+    lib.MXTpuGetLastError.restype = ctypes.c_char_p
+    lib.MXTpuLibInit.argtypes = [ctypes.c_char_p]
+    lib.MXTpuGetVersion.argtypes = [c_ip]
+    lib.MXTpuLibInfoFeatures.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                         c_ip]
+    lib.MXTpuNDArrayCreate.argtypes = [ctypes.c_void_p, c_i64p, ctypes.c_int,
+                                       ctypes.c_char_p, hp]
+    lib.MXTpuNDArrayFree.argtypes = [h]
+    lib.MXTpuNDArrayGetNDim.argtypes = [h, c_ip]
+    lib.MXTpuNDArrayGetShape.argtypes = [h, c_i64p, ctypes.c_int]
+    lib.MXTpuNDArrayGetDType.argtypes = [h, ctypes.c_char_p, ctypes.c_size_t]
+    lib.MXTpuNDArraySize.argtypes = [h, c_i64p]
+    lib.MXTpuNDArraySyncCopyToCPU.argtypes = [h, ctypes.c_void_p,
+                                              ctypes.c_size_t]
+    lib.MXTpuNDArrayWaitToRead.argtypes = [h]
+    lib.MXTpuOpCount.argtypes = [c_ip]
+    lib.MXTpuListOps.argtypes = [ctypes.c_char_p, ctypes.c_size_t, c_ip]
+    lib.MXTpuImperativeInvoke.argtypes = [ctypes.c_char_p, hp, ctypes.c_int,
+                                          ctypes.c_char_p, hp, ctypes.c_int,
+                                          c_ip]
+    lib.MXTpuAutogradSetRecording.argtypes = [ctypes.c_int, c_ip]
+    lib.MXTpuNDArrayAttachGrad.argtypes = [h]
+    lib.MXTpuAutogradBackward.argtypes = [h]
+    lib.MXTpuNDArrayGetGrad.argtypes = [h, hp]
+    lib.MXTpuRandomSeed.argtypes = [ctypes.c_int]
+    return lib
+
+
+def load() -> ctypes.CDLL:
+    """Build if stale, dlopen, bind signatures, and MXTpuLibInit."""
+    global _lib, _lib_err
+    if _lib is not None:
+        return _lib
+    if _lib_err is not None:
+        raise RuntimeError(_lib_err)
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            build()
+            lib = _bind(ctypes.CDLL(LIB_PATH))
+            repo_root = os.path.dirname(os.path.dirname(_HERE))
+            if lib.MXTpuLibInit(repo_root.encode()) != 0:
+                raise RuntimeError(
+                    f"MXTpuLibInit: {lib.MXTpuGetLastError().decode()}")
+        except (OSError, RuntimeError) as e:
+            _lib_err = str(e)
+            raise
+        _lib = lib
+    return _lib

@@ -1,0 +1,141 @@
+/*
+ * mxnet_tpu C API — the multi-language ABI surface.
+ *
+ * The reference exposes 236 MXNET_DLL C entry points
+ * (include/mxnet/c_api.h) implemented over its C++ runtime
+ * (src/c_api/c_api.cc, src/c_api/c_api_ndarray.cc:91 MXImperativeInvokeImpl).
+ * The TPU-native equivalent hosts the JAX/XLA runtime in-process via CPython
+ * embedding and exposes the same families of entry points as a stable C ABI:
+ * library init, NDArray lifecycle + sync, imperative operator invoke by
+ * registry name, autograd record/backward, and RNG seeding.  Any language
+ * with a C FFI (Go, Rust, Java, Julia, ...) can drive the full framework
+ * through this header, matching the role c_api.h plays for the reference's
+ * non-Python bindings.
+ *
+ * Conventions (same as the reference):
+ *   - every function returns 0 on success, -1 on failure;
+ *   - on failure MXTpuGetLastError() returns a message for the calling
+ *     thread (reference: MXGetLastError / c_api_error.h);
+ *   - handles are opaque; free NDArray handles with MXTpuNDArrayFree.
+ *
+ * Thread safety: all entry points may be called from any thread; the
+ * library serializes access to the hosted runtime internally.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *NDArrayHandle;
+
+/* ---- library ------------------------------------------------------- */
+
+/* Initialize the hosted runtime.  `repo_root` is prepended to the module
+ * search path so `mxnet_tpu` can be imported (pass NULL if the package is
+ * already importable).  Idempotent; safe to call when the caller is itself
+ * a Python process (e.g. via ctypes).  Reference analog: library load +
+ * MXLibInfoFeatures bootstrapping. */
+int MXTpuLibInit(const char *repo_root);
+
+/* Tear down only what this library created.  If the interpreter was
+ * already running at MXTpuLibInit time it is left untouched. */
+int MXTpuLibShutdown(void);
+
+/* Last error message for the calling thread (never NULL). */
+const char *MXTpuGetLastError(void);
+
+/* Library version as MAJOR*10000 + MINOR*100 + PATCH
+ * (reference: MXGetVersion, c_api.h). */
+int MXTpuGetVersion(int *out);
+
+/* Newline-joined feature list (reference: MXLibInfoFeatures).  Writes at
+ * most `buflen-1` bytes + NUL; `*count` gets the number of features. */
+int MXTpuLibInfoFeatures(char *buf, size_t buflen, int *count);
+
+/* ---- NDArray ------------------------------------------------------- */
+
+/* Create an NDArray by copying `ndim`-dimensional `data` of type `dtype`
+ * ("float32", "int32", ...).  Reference: MXNDArrayCreate + SyncCopyFromCPU.
+ */
+int MXTpuNDArrayCreate(const void *data, const int64_t *shape, int ndim,
+                       const char *dtype, NDArrayHandle *out);
+
+int MXTpuNDArrayFree(NDArrayHandle handle);
+
+int MXTpuNDArrayGetNDim(NDArrayHandle handle, int *out);
+
+/* Write up to `max_ndim` extents into `shape` (reference:
+ * MXNDArrayGetShape). */
+int MXTpuNDArrayGetShape(NDArrayHandle handle, int64_t *shape, int max_ndim);
+
+/* NUL-terminated dtype name into `buf`. */
+int MXTpuNDArrayGetDType(NDArrayHandle handle, char *buf, size_t buflen);
+
+/* Total element count. */
+int MXTpuNDArraySize(NDArrayHandle handle, int64_t *out);
+
+/* Blocking device->host copy of the full array into `out` (must hold
+ * `nbytes`; fails if sizes mismatch).  This is the asnumpy()/WaitToRead
+ * sync point: pending async work completes and deferred errors surface
+ * here (reference: MXNDArraySyncCopyToCPU). */
+int MXTpuNDArraySyncCopyToCPU(NDArrayHandle handle, void *out, size_t nbytes);
+
+/* Block until the array's pending writes complete
+ * (reference: MXNDArrayWaitToRead). */
+int MXTpuNDArrayWaitToRead(NDArrayHandle handle);
+
+/* Block until all outstanding device work completes
+ * (reference: MXNDArrayWaitAll). */
+int MXTpuNDArrayWaitAll(void);
+
+/* ---- operators ----------------------------------------------------- */
+
+/* Number of registered operators (reference: MXListAllOpNames). */
+int MXTpuOpCount(int *out);
+
+/* Newline-joined registry op names; `*count` gets how many. */
+int MXTpuListOps(char *buf, size_t buflen, int *count);
+
+/* Invoke a registered operator imperatively (reference:
+ * MXImperativeInvoke, c_api_ndarray.cc:91).  `attrs_json` is a JSON object
+ * of operator attributes (NULL or "" for none), e.g.
+ * "{\"axis\": 1, \"keepdims\": true}".  Writes up to `max_outputs` new
+ * handles into `outputs`; the caller owns and must free them. */
+int MXTpuImperativeInvoke(const char *op_name, NDArrayHandle *inputs,
+                          int num_inputs, const char *attrs_json,
+                          NDArrayHandle *outputs, int max_outputs,
+                          int *num_outputs);
+
+/* ---- autograd ------------------------------------------------------ */
+
+/* Toggle gradient recording; `prev` (may be NULL) gets the old state
+ * (reference: MXAutogradSetIsRecording). */
+int MXTpuAutogradSetRecording(int is_recording, int *prev);
+
+/* Mark the array as requiring gradient (reference: MXAutogradMarkVariables
+ * / Gluon attach_grad). */
+int MXTpuNDArrayAttachGrad(NDArrayHandle handle);
+
+/* Run backward from a scalar (or all-ones cotangent) head
+ * (reference: MXAutogradBackward). */
+int MXTpuAutogradBackward(NDArrayHandle head);
+
+/* Fetch the accumulated gradient of an attach_grad'd array as a NEW handle
+ * the caller owns (reference: MXNDArrayGetGrad). */
+int MXTpuNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+/* ---- misc ---------------------------------------------------------- */
+
+/* Seed the global RNG (reference: MXRandomSeed). */
+int MXTpuRandomSeed(int seed);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* MXNET_TPU_C_API_H_ */

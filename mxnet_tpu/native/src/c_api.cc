@@ -1,0 +1,480 @@
+// mxnet_tpu C API implementation.
+//
+// The reference implements its C ABI in src/c_api/c_api.cc (3,456 LoC) over
+// a C++ runtime; the TPU-native framework's runtime is the Python/JAX
+// package, so this layer embeds CPython and marshals C buffers/handles into
+// mxnet_tpu.native.capi_bridge.  Handles are owned PyObject* references to
+// NDArray objects.  Error convention matches the reference
+// (c_api_error.h): -1 + per-thread MXTpuGetLastError().
+//
+// Built standalone (links libpython); NOT part of libmxnet_tpu_native.so —
+// see mxnet_tpu/native/capi.py for the build recipe.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+extern "C" {
+
+typedef void *NDArrayHandle;
+
+// ---------------------------------------------------------------------
+// error handling (reference: per-thread error string, c_api_error.h)
+// ---------------------------------------------------------------------
+
+static thread_local std::string tls_last_error;
+
+const char *MXTpuGetLastError(void) { return tls_last_error.c_str(); }
+
+}  // extern "C" (reopened below; helpers are C++-internal)
+
+namespace {
+
+// Captures the pending Python exception into the thread-local error slot.
+int FailFromPython() {
+  PyObject *ptype = nullptr, *pvalue = nullptr, *ptrace = nullptr;
+  PyErr_Fetch(&ptype, &pvalue, &ptrace);
+  PyErr_NormalizeException(&ptype, &pvalue, &ptrace);
+  std::string msg = "unknown python error";
+  if (pvalue) {
+    if (PyObject *s = PyObject_Str(pvalue)) {
+      if (const char *c = PyUnicode_AsUTF8(s)) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  if (ptype) {
+    if (PyObject *n = PyObject_GetAttrString(ptype, "__name__")) {
+      if (const char *c = PyUnicode_AsUTF8(n)) msg = std::string(c) + ": " + msg;
+      Py_DECREF(n);
+    } else {
+      PyErr_Clear();
+    }
+  }
+  tls_last_error = msg;
+  Py_XDECREF(ptype);
+  Py_XDECREF(pvalue);
+  Py_XDECREF(ptrace);
+  return -1;
+}
+
+int Fail(const std::string &msg) {
+  tls_last_error = msg;
+  return -1;
+}
+
+bool g_we_initialized = false;      // did MXTpuLibInit create the interpreter?
+PyThreadState *g_saved = nullptr;   // main thread state released after init
+PyObject *g_bridge = nullptr;       // mxnet_tpu.native.capi_bridge module
+std::mutex g_init_mutex;
+
+// RAII GIL acquisition — every entry point may run on any thread.
+struct Gil {
+  PyGILState_STATE state;
+  Gil() : state(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state); }
+};
+
+// Calls bridge.<fn>(*args); returns a NEW reference or nullptr (python
+// error pending).  The GIL must be held.
+PyObject *CallBridge(const char *fn, PyObject *args) {
+  if (!g_bridge) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "mxnet_tpu C API not initialized: call MXTpuLibInit");
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(g_bridge, fn);
+  if (!f) return nullptr;
+  PyObject *ret = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return ret;
+}
+
+// Fill a caller buffer with a NUL-terminated string (truncating).
+void FillBuf(const std::string &s, char *buf, size_t buflen) {
+  if (!buf || buflen == 0) return;
+  size_t n = s.size() < buflen - 1 ? s.size() : buflen - 1;
+  std::memcpy(buf, s.data(), n);
+  buf[n] = '\0';
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// library
+// ---------------------------------------------------------------------
+
+int MXTpuLibInit(const char *repo_root) {
+  std::lock_guard<std::mutex> lock(g_init_mutex);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  // no signal handlers: we are a guest library
+    g_we_initialized = true;
+    // Release the GIL acquired by initialization so every entry point can
+    // use PyGILState_Ensure uniformly from any thread.
+    g_saved = PyEval_SaveThread();
+  }
+  Gil gil;
+  if (g_bridge) return 0;  // idempotent
+  if (repo_root && repo_root[0]) {
+    PyObject *sys_path = PySys_GetObject("path");  // borrowed
+    PyObject *root = PyUnicode_FromString(repo_root);
+    if (!sys_path || !root || PyList_Insert(sys_path, 0, root) != 0) {
+      Py_XDECREF(root);
+      return FailFromPython();
+    }
+    Py_DECREF(root);
+  }
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.native.capi_bridge");
+  if (!mod) return FailFromPython();
+  g_bridge = mod;  // keep the reference for the process lifetime
+  return 0;
+}
+
+int MXTpuLibShutdown(void) {
+  std::lock_guard<std::mutex> lock(g_init_mutex);
+  if (g_bridge) {
+    Gil gil;
+    Py_CLEAR(g_bridge);
+  }
+  if (g_we_initialized) {
+    if (g_saved) PyEval_RestoreThread(g_saved);
+    g_saved = nullptr;
+    Py_FinalizeEx();
+    g_we_initialized = false;
+  }
+  return 0;
+}
+
+int MXTpuGetVersion(int *out) {
+  if (!out) return Fail("MXTpuGetVersion: out is NULL");
+  Gil gil;
+  PyObject *ret = CallBridge("version", nullptr);
+  if (!ret) return FailFromPython();
+  *out = static_cast<int>(PyLong_AsLong(ret));
+  Py_DECREF(ret);
+  return PyErr_Occurred() ? FailFromPython() : 0;
+}
+
+int MXTpuLibInfoFeatures(char *buf, size_t buflen, int *count) {
+  Gil gil;
+  PyObject *ret = CallBridge("features", nullptr);
+  if (!ret) return FailFromPython();
+  std::string joined;
+  Py_ssize_t n = PyList_Size(ret);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *c = PyUnicode_AsUTF8(PyList_GetItem(ret, i));
+    if (!c) {
+      Py_DECREF(ret);
+      return FailFromPython();
+    }
+    if (i) joined += '\n';
+    joined += c;
+  }
+  Py_DECREF(ret);
+  if (count) *count = static_cast<int>(n);
+  FillBuf(joined, buf, buflen);
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// NDArray
+// ---------------------------------------------------------------------
+
+int MXTpuNDArrayCreate(const void *data, const int64_t *shape, int ndim,
+                       const char *dtype, NDArrayHandle *out) {
+  if (!data || !shape || ndim < 0 || !dtype || !out)
+    return Fail("MXTpuNDArrayCreate: NULL argument");
+  Gil gil;
+  PyObject *shp = PyTuple_New(ndim);
+  int64_t numel = 1;
+  for (int i = 0; i < ndim; ++i) {
+    numel *= shape[i];
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  // itemsize via numpy on the python side; compute bytes with a dtype probe
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (!np) {
+    Py_DECREF(shp);
+    return FailFromPython();
+  }
+  PyObject *dt = PyObject_CallMethod(np, "dtype", "s", dtype);
+  Py_DECREF(np);
+  if (!dt) {
+    Py_DECREF(shp);
+    return FailFromPython();
+  }
+  PyObject *itemsize = PyObject_GetAttrString(dt, "itemsize");
+  Py_DECREF(dt);
+  if (!itemsize) {
+    Py_DECREF(shp);
+    return FailFromPython();
+  }
+  int64_t isz = PyLong_AsLongLong(itemsize);
+  Py_DECREF(itemsize);
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data), numel * isz);
+  if (!bytes) {
+    Py_DECREF(shp);
+    return FailFromPython();
+  }
+  PyObject *args = Py_BuildValue("(OOs)", bytes, shp, dtype);
+  Py_DECREF(bytes);
+  Py_DECREF(shp);
+  if (!args) return FailFromPython();
+  PyObject *arr = CallBridge("create", args);
+  Py_DECREF(args);
+  if (!arr) return FailFromPython();
+  *out = static_cast<NDArrayHandle>(arr);
+  return 0;
+}
+
+int MXTpuNDArrayFree(NDArrayHandle handle) {
+  if (!handle) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXTpuNDArrayGetNDim(NDArrayHandle handle, int *out) {
+  if (!handle || !out) return Fail("MXTpuNDArrayGetNDim: NULL argument");
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *shp = CallBridge("shape_of", args);
+  Py_DECREF(args);
+  if (!shp) return FailFromPython();
+  *out = static_cast<int>(PyTuple_Size(shp));
+  Py_DECREF(shp);
+  return 0;
+}
+
+int MXTpuNDArrayGetShape(NDArrayHandle handle, int64_t *shape, int max_ndim) {
+  if (!handle || !shape) return Fail("MXTpuNDArrayGetShape: NULL argument");
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *shp = CallBridge("shape_of", args);
+  Py_DECREF(args);
+  if (!shp) return FailFromPython();
+  Py_ssize_t n = PyTuple_Size(shp);
+  if (n > max_ndim) {
+    Py_DECREF(shp);
+    return Fail("MXTpuNDArrayGetShape: max_ndim too small");
+  }
+  for (Py_ssize_t i = 0; i < n; ++i)
+    shape[i] = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+  Py_DECREF(shp);
+  return 0;
+}
+
+int MXTpuNDArrayGetDType(NDArrayHandle handle, char *buf, size_t buflen) {
+  if (!handle) return Fail("MXTpuNDArrayGetDType: NULL handle");
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *dt = CallBridge("dtype_of", args);
+  Py_DECREF(args);
+  if (!dt) return FailFromPython();
+  const char *c = PyUnicode_AsUTF8(dt);
+  if (!c) {
+    Py_DECREF(dt);
+    return FailFromPython();
+  }
+  FillBuf(c, buf, buflen);
+  Py_DECREF(dt);
+  return 0;
+}
+
+int MXTpuNDArraySize(NDArrayHandle handle, int64_t *out) {
+  if (!handle || !out) return Fail("MXTpuNDArraySize: NULL argument");
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *shp = CallBridge("shape_of", args);
+  Py_DECREF(args);
+  if (!shp) return FailFromPython();
+  int64_t numel = 1;
+  for (Py_ssize_t i = 0; i < PyTuple_Size(shp); ++i)
+    numel *= PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+  Py_DECREF(shp);
+  *out = numel;
+  return 0;
+}
+
+int MXTpuNDArraySyncCopyToCPU(NDArrayHandle handle, void *out, size_t nbytes) {
+  if (!handle || !out) return Fail("MXTpuNDArraySyncCopyToCPU: NULL argument");
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *bytes = CallBridge("to_bytes", args);
+  Py_DECREF(args);
+  if (!bytes) return FailFromPython();
+  char *src = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(bytes, &src, &n) != 0) {
+    Py_DECREF(bytes);
+    return FailFromPython();
+  }
+  if (static_cast<size_t>(n) != nbytes) {
+    Py_DECREF(bytes);
+    return Fail("MXTpuNDArraySyncCopyToCPU: buffer size mismatch (array is " +
+                std::to_string(n) + " bytes, caller gave " +
+                std::to_string(nbytes) + ")");
+  }
+  std::memcpy(out, src, n);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXTpuNDArrayWaitToRead(NDArrayHandle handle) {
+  if (!handle) return Fail("MXTpuNDArrayWaitToRead: NULL handle");
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *ret = CallBridge("wait_to_read", args);
+  Py_DECREF(args);
+  if (!ret) return FailFromPython();
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXTpuNDArrayWaitAll(void) {
+  Gil gil;
+  PyObject *ret = CallBridge("wait_all", nullptr);
+  if (!ret) return FailFromPython();
+  Py_DECREF(ret);
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// operators
+// ---------------------------------------------------------------------
+
+int MXTpuOpCount(int *out) {
+  if (!out) return Fail("MXTpuOpCount: out is NULL");
+  Gil gil;
+  PyObject *ops = CallBridge("list_ops", nullptr);
+  if (!ops) return FailFromPython();
+  *out = static_cast<int>(PyList_Size(ops));
+  Py_DECREF(ops);
+  return 0;
+}
+
+int MXTpuListOps(char *buf, size_t buflen, int *count) {
+  Gil gil;
+  PyObject *ops = CallBridge("list_ops", nullptr);
+  if (!ops) return FailFromPython();
+  std::string joined;
+  Py_ssize_t n = PyList_Size(ops);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *c = PyUnicode_AsUTF8(PyList_GetItem(ops, i));
+    if (!c) {
+      Py_DECREF(ops);
+      return FailFromPython();
+    }
+    if (i) joined += '\n';
+    joined += c;
+  }
+  Py_DECREF(ops);
+  if (count) *count = static_cast<int>(n);
+  FillBuf(joined, buf, buflen);
+  return 0;
+}
+
+int MXTpuImperativeInvoke(const char *op_name, NDArrayHandle *inputs,
+                          int num_inputs, const char *attrs_json,
+                          NDArrayHandle *outputs, int max_outputs,
+                          int *num_outputs) {
+  if (!op_name || (num_inputs > 0 && !inputs) || !outputs || !num_outputs)
+    return Fail("MXTpuImperativeInvoke: NULL argument");
+  Gil gil;
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *o = static_cast<PyObject *>(inputs[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+  }
+  PyObject *args = Py_BuildValue("(sOs)", op_name, ins,
+                                 attrs_json ? attrs_json : "");
+  Py_DECREF(ins);
+  if (!args) return FailFromPython();
+  PyObject *outs = CallBridge("invoke", args);
+  Py_DECREF(args);
+  if (!outs) return FailFromPython();
+  Py_ssize_t n = PyList_Size(outs);
+  if (n > max_outputs) {
+    Py_DECREF(outs);
+    return Fail("MXTpuImperativeInvoke: op returned " + std::to_string(n) +
+                " outputs, caller allowed " + std::to_string(max_outputs));
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(outs, i);  // borrowed
+    Py_INCREF(o);                            // caller owns the handle
+    outputs[i] = static_cast<NDArrayHandle>(o);
+  }
+  *num_outputs = static_cast<int>(n);
+  Py_DECREF(outs);
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// autograd
+// ---------------------------------------------------------------------
+
+int MXTpuAutogradSetRecording(int is_recording, int *prev) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(i)", is_recording);
+  PyObject *ret = CallBridge("set_recording", args);
+  Py_DECREF(args);
+  if (!ret) return FailFromPython();
+  if (prev) *prev = PyObject_IsTrue(ret);
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXTpuNDArrayAttachGrad(NDArrayHandle handle) {
+  if (!handle) return Fail("MXTpuNDArrayAttachGrad: NULL handle");
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *ret = CallBridge("attach_grad", args);
+  Py_DECREF(args);
+  if (!ret) return FailFromPython();
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXTpuAutogradBackward(NDArrayHandle head) {
+  if (!head) return Fail("MXTpuAutogradBackward: NULL handle");
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(head));
+  PyObject *ret = CallBridge("backward", args);
+  Py_DECREF(args);
+  if (!ret) return FailFromPython();
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXTpuNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  if (!handle || !out) return Fail("MXTpuNDArrayGetGrad: NULL argument");
+  Gil gil;
+  PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
+  PyObject *g = CallBridge("grad_of", args);
+  Py_DECREF(args);
+  if (!g) return FailFromPython();
+  *out = static_cast<NDArrayHandle>(g);
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// misc
+// ---------------------------------------------------------------------
+
+int MXTpuRandomSeed(int seed) {
+  Gil gil;
+  PyObject *args = Py_BuildValue("(i)", seed);
+  PyObject *ret = CallBridge("seed", args);
+  Py_DECREF(args);
+  if (!ret) return FailFromPython();
+  Py_DECREF(ret);
+  return 0;
+}
+
+}  // extern "C"

@@ -639,6 +639,14 @@ def invoke(
             break
     outputs = [_wrap(o, ctx, out_cls) for o in outs_raw]
 
+    from .. import engine as _engine
+
+    if _engine.is_naive():
+        # MXNET_ENGINE_TYPE=NaiveEngine: synchronous dispatch — block per
+        # op so errors surface at the faulting op, not a later sync point
+        # (reference src/engine/naive_engine.cc debugging role)
+        jax.block_until_ready([o._data for o in outputs])
+
     if record:
         node = autograd.TapeNode(
             vjp_fn,

@@ -232,3 +232,16 @@ class scope:
 
     def __exit__(self, *exc):
         _emit(self._name, "scope", "E")
+
+
+# MXNET_PROFILER_AUTOSTART: begin collection at import, matching the
+# reference's env var of the same name (profiler starts before user code so
+# startup work is captured; dump() still writes the trace on demand).
+def _maybe_autostart():
+    from . import config
+
+    if config.get("MXNET_PROFILER_AUTOSTART"):
+        set_state("run")
+
+
+_maybe_autostart()

@@ -1,0 +1,140 @@
+/*
+ * Standalone C client for the mxnet_tpu C ABI.
+ *
+ * Mirrors what the reference's non-Python language bindings do against
+ * include/mxnet/c_api.h: init the library, create NDArrays from host
+ * buffers, invoke registry operators imperatively, run autograd, and read
+ * results back — all through the C ABI with no Python in this translation
+ * unit.  Compiled and executed by tests/test_capi.py; prints CAPI_OK on
+ * success, exits nonzero with a message on any failure.
+ */
+#include <mxnet_tpu/c_api.h>
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define CHECK(call)                                                      \
+  do {                                                                   \
+    if ((call) != 0) {                                                   \
+      fprintf(stderr, "FAIL %s:%d %s: %s\n", __FILE__, __LINE__, #call,  \
+              MXTpuGetLastError());                                      \
+      return 1;                                                          \
+    }                                                                    \
+  } while (0)
+
+#define EXPECT(cond, msg)                                                \
+  do {                                                                   \
+    if (!(cond)) {                                                       \
+      fprintf(stderr, "FAIL %s:%d %s\n", __FILE__, __LINE__, msg);       \
+      return 1;                                                          \
+    }                                                                    \
+  } while (0)
+
+int main(int argc, char **argv) {
+  const char *repo_root = argc > 1 ? argv[1] : NULL;
+  CHECK(MXTpuLibInit(repo_root));
+
+  int version = 0;
+  CHECK(MXTpuGetVersion(&version));
+  EXPECT(version >= 0, "version must be non-negative");
+
+  int n_ops = 0;
+  CHECK(MXTpuOpCount(&n_ops));
+  EXPECT(n_ops >= 300, "expected at least 300 registered operators");
+
+  /* ---- NDArray round trip ---- */
+  float a_data[4] = {1.f, 2.f, 3.f, 4.f};
+  float b_data[4] = {10.f, 20.f, 30.f, 40.f};
+  int64_t shape[2] = {2, 2};
+  NDArrayHandle a, b;
+  CHECK(MXTpuNDArrayCreate(a_data, shape, 2, "float32", &a));
+  CHECK(MXTpuNDArrayCreate(b_data, shape, 2, "float32", &b));
+
+  int ndim = 0;
+  CHECK(MXTpuNDArrayGetNDim(a, &ndim));
+  EXPECT(ndim == 2, "ndim mismatch");
+  int64_t got_shape[2] = {0, 0};
+  CHECK(MXTpuNDArrayGetShape(a, got_shape, 2));
+  EXPECT(got_shape[0] == 2 && got_shape[1] == 2, "shape mismatch");
+  char dtype[32];
+  CHECK(MXTpuNDArrayGetDType(a, dtype, sizeof dtype));
+  EXPECT(strcmp(dtype, "float32") == 0, "dtype mismatch");
+  int64_t numel = 0;
+  CHECK(MXTpuNDArraySize(a, &numel));
+  EXPECT(numel == 4, "size mismatch");
+
+  /* ---- imperative invoke: c = a + b ---- */
+  NDArrayHandle add_in[2], add_out[1];
+  add_in[0] = a;
+  add_in[1] = b;
+  int n_out = 0;
+  CHECK(MXTpuImperativeInvoke("broadcast_add", add_in, 2, NULL, add_out, 1,
+                              &n_out));
+  EXPECT(n_out == 1, "broadcast_add must yield one output");
+  float c_host[4];
+  CHECK(MXTpuNDArrayWaitToRead(add_out[0]));
+  CHECK(MXTpuNDArraySyncCopyToCPU(add_out[0], c_host, sizeof c_host));
+  for (int i = 0; i < 4; ++i)
+    EXPECT(fabsf(c_host[i] - (a_data[i] + b_data[i])) < 1e-6f,
+           "broadcast_add values wrong");
+
+  /* ---- attrs JSON: sum over axis 1, keepdims ---- */
+  NDArrayHandle sum_out[1];
+  CHECK(MXTpuImperativeInvoke("sum", &a, 1,
+                              "{\"axis\": 1, \"keepdims\": true}", sum_out, 1,
+                              &n_out));
+  int64_t sum_shape[2] = {0, 0};
+  CHECK(MXTpuNDArrayGetShape(sum_out[0], sum_shape, 2));
+  EXPECT(sum_shape[0] == 2 && sum_shape[1] == 1, "sum keepdims shape wrong");
+  float sum_host[2];
+  CHECK(MXTpuNDArraySyncCopyToCPU(sum_out[0], sum_host, sizeof sum_host));
+  EXPECT(fabsf(sum_host[0] - 3.f) < 1e-6f && fabsf(sum_host[1] - 7.f) < 1e-6f,
+         "sum values wrong");
+
+  /* ---- autograd: d/da sum(a * b) == b ---- */
+  CHECK(MXTpuNDArrayAttachGrad(a));
+  int prev = 0;
+  CHECK(MXTpuAutogradSetRecording(1, &prev));
+  NDArrayHandle mul_out[1], loss_out[1];
+  CHECK(MXTpuImperativeInvoke("broadcast_mul", add_in, 2, NULL, mul_out, 1,
+                              &n_out));
+  CHECK(MXTpuImperativeInvoke("sum", mul_out, 1, NULL, loss_out, 1, &n_out));
+  CHECK(MXTpuAutogradSetRecording(0, NULL));
+  CHECK(MXTpuAutogradBackward(loss_out[0]));
+  NDArrayHandle grad;
+  CHECK(MXTpuNDArrayGetGrad(a, &grad));
+  float g_host[4];
+  CHECK(MXTpuNDArraySyncCopyToCPU(grad, g_host, sizeof g_host));
+  for (int i = 0; i < 4; ++i)
+    EXPECT(fabsf(g_host[i] - b_data[i]) < 1e-6f,
+           "grad of sum(a*b) w.r.t. a must equal b");
+
+  /* ---- error path: bad op name must fail with a message ---- */
+  NDArrayHandle bogus_out[1];
+  EXPECT(MXTpuImperativeInvoke("definitely_not_an_op", &a, 1, NULL, bogus_out,
+                               1, &n_out) != 0,
+         "invoking an unknown op must fail");
+  EXPECT(strlen(MXTpuGetLastError()) > 0, "error message must be set");
+
+  /* ---- feature list ---- */
+  char feats[4096];
+  int n_feats = 0;
+  CHECK(MXTpuLibInfoFeatures(feats, sizeof feats, &n_feats));
+  EXPECT(n_feats > 0, "expected at least one runtime feature");
+
+  CHECK(MXTpuRandomSeed(42));
+
+  CHECK(MXTpuNDArrayFree(a));
+  CHECK(MXTpuNDArrayFree(b));
+  CHECK(MXTpuNDArrayFree(add_out[0]));
+  CHECK(MXTpuNDArrayFree(sum_out[0]));
+  CHECK(MXTpuNDArrayFree(mul_out[0]));
+  CHECK(MXTpuNDArrayFree(loss_out[0]));
+  CHECK(MXTpuNDArrayFree(grad));
+  CHECK(MXTpuNDArrayWaitAll());
+  CHECK(MXTpuLibShutdown());
+  printf("CAPI_OK ops=%d version=%d features=%d\n", n_ops, version, n_feats);
+  return 0;
+}

@@ -1,0 +1,172 @@
+"""C ABI surface tests (SURVEY layer 8: c_api.h multi-language bindings).
+
+Two angles, matching how the reference exercises its C API:
+- in-process: load libmxnet_tpu_c.so with ctypes and drive every entry
+  point from Python (the interpreter is already live, so MXTpuLibInit only
+  imports the bridge);
+- out-of-process: compile tests/capi/capi_client.c with gcc — a program
+  with zero Python in it — link it against the .so, and run it.  This is
+  the actual proof of a multi-language ABI (reference: cpp examples built
+  against include/mxnet/c_api.h).
+"""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu.native import capi  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def lib():
+    return capi.load()
+
+
+def _make(lib, arr):
+    arr = onp.ascontiguousarray(arr)
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    h = ctypes.c_void_p()
+    rc = lib.MXTpuNDArrayCreate(
+        arr.ctypes.data_as(ctypes.c_void_p), shape, arr.ndim,
+        str(arr.dtype).encode(), ctypes.byref(h))
+    assert rc == 0, lib.MXTpuGetLastError().decode()
+    return h
+
+
+def _read(lib, h, shape, dtype):
+    out = onp.empty(shape, dtype=dtype)
+    rc = lib.MXTpuNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+    assert rc == 0, lib.MXTpuGetLastError().decode()
+    return out
+
+
+def test_version_and_ops(lib):
+    v = ctypes.c_int()
+    assert lib.MXTpuGetVersion(ctypes.byref(v)) == 0
+    assert v.value >= 0
+    n = ctypes.c_int()
+    assert lib.MXTpuOpCount(ctypes.byref(n)) == 0
+    assert n.value >= 300
+    buf = ctypes.create_string_buffer(1 << 20)
+    cnt = ctypes.c_int()
+    assert lib.MXTpuListOps(buf, len(buf), ctypes.byref(cnt)) == 0
+    names = buf.value.decode().split("\n")
+    assert cnt.value == n.value and "broadcast_add" in names
+
+
+def test_ndarray_roundtrip_and_meta(lib):
+    x = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    h = _make(lib, x)
+    nd = ctypes.c_int()
+    assert lib.MXTpuNDArrayGetNDim(h, ctypes.byref(nd)) == 0 and nd.value == 2
+    shp = (ctypes.c_int64 * 2)()
+    assert lib.MXTpuNDArrayGetShape(h, shp, 2) == 0
+    assert list(shp) == [3, 4]
+    dt = ctypes.create_string_buffer(32)
+    assert lib.MXTpuNDArrayGetDType(h, dt, 32) == 0
+    assert dt.value == b"float32"
+    size = ctypes.c_int64()
+    assert lib.MXTpuNDArraySize(h, ctypes.byref(size)) == 0
+    assert size.value == 12
+    assert lib.MXTpuNDArrayWaitToRead(h) == 0
+    onp.testing.assert_array_equal(_read(lib, h, (3, 4), onp.float32), x)
+    # size-mismatch copy must fail with a message, not corrupt memory
+    bad = onp.empty(3, dtype=onp.float32)
+    assert lib.MXTpuNDArraySyncCopyToCPU(
+        h, bad.ctypes.data_as(ctypes.c_void_p), bad.nbytes) != 0
+    assert b"mismatch" in lib.MXTpuGetLastError()
+    assert lib.MXTpuNDArrayFree(h) == 0
+
+
+def test_invoke_with_attrs(lib):
+    x = onp.array([[1, 2], [3, 4]], dtype=onp.float32)
+    h = _make(lib, x)
+    out = (ctypes.c_void_p * 1)()
+    n_out = ctypes.c_int()
+    rc = lib.MXTpuImperativeInvoke(
+        b"sum", ctypes.byref(ctypes.c_void_p(h.value)), 1,
+        b'{"axis": 0}', out, 1, ctypes.byref(n_out))
+    assert rc == 0, lib.MXTpuGetLastError().decode()
+    assert n_out.value == 1
+    onp.testing.assert_allclose(
+        _read(lib, out[0], (2,), onp.float32), x.sum(axis=0))
+    lib.MXTpuNDArrayFree(h)
+    lib.MXTpuNDArrayFree(out[0])
+
+
+def test_invoke_unknown_op_sets_error(lib):
+    x = _make(lib, onp.ones(2, dtype=onp.float32))
+    out = (ctypes.c_void_p * 1)()
+    n_out = ctypes.c_int()
+    rc = lib.MXTpuImperativeInvoke(
+        b"not_a_real_op", ctypes.byref(ctypes.c_void_p(x.value)), 1, None,
+        out, 1, ctypes.byref(n_out))
+    assert rc != 0
+    assert b"not_a_real_op" in lib.MXTpuGetLastError()
+    lib.MXTpuNDArrayFree(x)
+
+
+def test_autograd_through_abi(lib):
+    a_np = onp.array([1.0, 2.0, 3.0], dtype=onp.float32)
+    b_np = onp.array([5.0, 6.0, 7.0], dtype=onp.float32)
+    a, b = _make(lib, a_np), _make(lib, b_np)
+    assert lib.MXTpuNDArrayAttachGrad(a) == 0
+    prev = ctypes.c_int()
+    assert lib.MXTpuAutogradSetRecording(1, ctypes.byref(prev)) == 0
+    ins = (ctypes.c_void_p * 2)(a.value, b.value)
+    mul = (ctypes.c_void_p * 1)()
+    loss = (ctypes.c_void_p * 1)()
+    n_out = ctypes.c_int()
+    assert lib.MXTpuImperativeInvoke(b"broadcast_mul", ins, 2, None, mul, 1,
+                                     ctypes.byref(n_out)) == 0
+    assert lib.MXTpuImperativeInvoke(b"sum", mul, 1, None, loss, 1,
+                                     ctypes.byref(n_out)) == 0
+    assert lib.MXTpuAutogradSetRecording(0, None) == 0
+    assert lib.MXTpuAutogradBackward(loss[0]) == 0, \
+        lib.MXTpuGetLastError().decode()
+    g = ctypes.c_void_p()
+    assert lib.MXTpuNDArrayGetGrad(a, ctypes.byref(g)) == 0
+    onp.testing.assert_allclose(_read(lib, g, (3,), onp.float32), b_np)
+    for h in (a, b, mul[0], loss[0], g):
+        lib.MXTpuNDArrayFree(h)
+
+
+def test_features_and_seed(lib):
+    buf = ctypes.create_string_buffer(4096)
+    cnt = ctypes.c_int()
+    assert lib.MXTpuLibInfoFeatures(buf, len(buf), ctypes.byref(cnt)) == 0
+    assert cnt.value > 0 and buf.value
+    assert lib.MXTpuRandomSeed(7) == 0
+
+
+def test_c_client_end_to_end(tmp_path):
+    """Compile + run the pure-C client — the multi-language ABI proof."""
+    capi.build()
+    inc, libdir, pylib = capi.python_link_flags()
+    exe = str(tmp_path / "capi_client")
+    src = os.path.join(REPO, "tests", "capi", "capi_client.c")
+    build_dir = os.path.dirname(capi.LIB_PATH)
+    cmd = ["gcc", "-O1", "-o", exe, src,
+           f"-I{os.path.join(REPO, 'mxnet_tpu', 'native', 'include')}",
+           f"-L{build_dir}", "-lmxnet_tpu_c", "-lm",
+           f"-Wl,-rpath,{build_dir}", f"-Wl,-rpath,{libdir}"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    assert proc.returncode == 0, f"client build failed:\n{proc.stderr}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the embedded interpreter needs the venv's site-packages on its path
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in sys.path if "site-packages" in p])
+    run = subprocess.run([exe, REPO], capture_output=True, text=True,
+                         env=env, timeout=300)
+    assert run.returncode == 0, (
+        f"client failed rc={run.returncode}\nstdout:{run.stdout}\n"
+        f"stderr:{run.stderr}")
+    assert "CAPI_OK" in run.stdout

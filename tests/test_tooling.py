@@ -97,6 +97,42 @@ def test_im2rec_list_and_pack(tmp_path):
     assert header.label in (0.0, 1.0)
 
 
+def test_naive_engine_toggle(monkeypatch):
+    """MXNET_ENGINE_TYPE=NaiveEngine flips ops to synchronous dispatch
+    mid-process (the knob is uncached — its debugging role requires it)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine
+
+    assert not engine.is_naive()
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    assert engine.is_naive()
+    a = mx.nd.array([1.0, 2.0])
+    out = mx.nd.broadcast_add(a, a)  # runs the sync path
+    assert out.asnumpy().tolist() == [2.0, 4.0]
+    monkeypatch.delenv("MXNET_ENGINE_TYPE")
+    assert not engine.is_naive()
+
+
+def test_im2rec_shuffle_false(tmp_path):
+    """--shuffle False must actually disable shuffling (argparse type=bool
+    would treat the string \"False\" as truthy)."""
+    imgroot = tmp_path / "imgs"
+    _make_images(str(imgroot))
+    tool = os.path.join(REPO, "tools", "im2rec.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    orders = []
+    for run in range(2):
+        prefix = str(tmp_path / f"data{run}")
+        out = subprocess.run(
+            [sys.executable, tool, prefix, str(imgroot), "--list",
+             "--recursive", "--shuffle", "False"], capture_output=True,
+            text=True, timeout=120, env=env)
+        assert out.returncode == 0, out.stderr
+        lines = open(prefix + ".lst").read().strip().splitlines()
+        orders.append([l.split("\t")[-1] for l in lines])
+    assert orders[0] == orders[1] == sorted(orders[0])
+
+
 def test_config_registry():
     v = config.get("MXNET_KVSTORE_BIGARRAY_BOUND")
     assert v == 1000000
@@ -115,6 +151,13 @@ def test_config_registry():
         config.get("MXNET_TEST_KNOB")
     del os.environ["MXNET_TEST_KNOB"]
     config.refresh("MXNET_TEST_KNOB")
+
+    # a call-site default applies to that call only — it must never be
+    # cached as the variable's value for other callers, and it is validated
+    assert config.get("MXNET_TEST_KNOB", default=5000) == 5000
+    assert config.get("MXNET_TEST_KNOB") == 7   # declared default intact
+    with pytest.raises(ValueError, match="call-site default"):
+        config.get("MXNET_TEST_KNOB", default=-1)
     config.VARIABLES.pop("MXNET_TEST_KNOB")   # keep the registry pristine
 
     md = config.to_markdown()

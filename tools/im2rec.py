@@ -95,8 +95,14 @@ def pack(args):
         path = os.path.join(args.root, rel)
         with open(path, "rb") as f:
             buf = f.read()
-        if (args.resize or args.quality != 95 or args.center_crop) \
-                and cv2 is not None:
+        needs_transform = args.resize or args.quality != 95 \
+            or args.center_crop
+        if needs_transform and cv2 is None:
+            raise SystemExit(
+                "im2rec: --resize/--center-crop/--quality require opencv "
+                "(cv2), which is not importable; install it or drop the "
+                "transform flags to pack raw bytes")
+        if needs_transform:
             img = cv2.imdecode(onp.frombuffer(buf, onp.uint8),
                                cv2.IMREAD_COLOR)
             if args.center_crop and img.shape[0] != img.shape[1]:
@@ -127,6 +133,15 @@ def pack(args):
     print(f"wrote {base}.rec / {base}.idx ({count} records)")
 
 
+def _str2bool(v: str) -> bool:
+    """argparse-safe bool: bool("False") is True, so parse the text."""
+    if v.lower() in ("1", "true", "yes", "on"):
+        return True
+    if v.lower() in ("0", "false", "no", "off", ""):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {v!r}")
+
+
 def main():
     p = argparse.ArgumentParser(
         description="Create an image list or a RecordIO dataset "
@@ -137,7 +152,8 @@ def main():
                    help="create an image list instead of a record file")
     p.add_argument("--recursive", action="store_true",
                    help="walk class subfolders; label = folder index")
-    p.add_argument("--shuffle", type=bool, default=True)
+    p.add_argument("--shuffle", type=_str2bool, default=True,
+                   help="shuffle the list (pass False/0/no to disable)")
     p.add_argument("--train-ratio", type=float, default=1.0)
     p.add_argument("--test-ratio", type=float, default=0.0)
     p.add_argument("--resize", type=int, default=0,

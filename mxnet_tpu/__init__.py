@@ -22,6 +22,11 @@ from . import base
 from .base import MXNetError
 from . import config  # noqa: E402  (no jax dependency; safe first)
 
+if config.get("MXNET_PROFILER_AUTOSTART"):
+    # must import eagerly (profiler is otherwise lazy via _LAZY) so
+    # collection starts before user code, not at first mx.profiler access
+    from . import profiler as _profiler  # noqa: F401
+
 if config.get("MXNET_ENFORCE_DETERMINISM"):
     # Reference semantics: trade speed for bit-reproducibility.  On TPU the
     # levers are sharding-invariant RNG and pinning matmuls to highest
@@ -40,6 +45,13 @@ from . import ndarray  # noqa: E402
 from . import ndarray as nd  # noqa: E402
 from .ndarray import NDArray  # noqa: E402
 from . import autograd  # noqa: E402
+
+# quantized ops register from contrib (which needs the core initialized),
+# then reference-name aliases are re-applied to cover them
+from .contrib import quantization as _quantization  # noqa: E402
+from .ops import ref_aliases as _ref_aliases  # noqa: E402
+
+_ref_aliases.apply()
 
 # subsystems imported lazily on attribute access to keep import light
 _LAZY = {

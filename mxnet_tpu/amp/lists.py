@@ -38,6 +38,7 @@ FP32_FUNCS = [
     "softmax_cross_entropy", "SoftmaxOutput", "CTCLoss", "MakeLoss",
     "LinearRegressionOutput", "LogisticRegressionOutput",
     "MAERegressionOutput", "smooth_l1",
+    "SyncBatchNorm", "BatchNormWithReLU", "hawkesll",
     # exp/log family and friends
     "exp", "log", "log2", "log10", "log1p", "expm1", "square", "sqrt",
     "rsqrt", "cbrt", "rcbrt", "power", "power_scalar", "reciprocal",
@@ -78,7 +79,7 @@ WIDEST_TYPE_CASTS = [
     "broadcast_mod", "broadcast_power", "broadcast_maximum",
     "broadcast_minimum", "broadcast_hypot", "add_n", "concat", "stack",
     "where", "elemwise_add", "elemwise_sub", "elemwise_mul",
-    "elemwise_div",
+    "elemwise_div", "amp_multicast",
 ]
 
 # Everything else: dtype-neutral — runs in whichever precision arrives.
@@ -134,5 +135,25 @@ FP16_FP32_FUNCS = [
     "multinomial", "shuffle",
     # int8 quantization domain (outside amp entirely)
     "quantize", "dequantize", "requantize", "quantized_conv",
-    "quantized_fully_connected",
+    "quantized_fully_connected", "quantize_v2", "quantized_act",
+    "quantized_pooling", "quantized_flatten", "quantized_concat",
+    "quantized_elemwise_add", "quantized_elemwise_mul",
+    "quantized_batch_norm", "quantized_embedding", "calibrate_entropy",
+    "intgemm_maxabsolute", "intgemm_prepare_data",
+    "intgemm_prepare_weight", "intgemm_take_weight",
+    "intgemm_fully_connected",
+    # optimizer updates (run in the dtype of their state; mp_* variants
+    # own the fp32 master-weight logic internally)
+    "ftml_update", "group_adagrad_update", "multi_lars",
+    "mp_sgd_update", "mp_sgd_mom_update", "mp_nag_mom_update",
+    "mp_lamb_update_phase1", "mp_lamb_update_phase2",
+    "multi_mp_sgd_update", "multi_mp_sgd_mom_update",
+    "preloaded_multi_sgd_update", "preloaded_multi_sgd_mom_update",
+    "preloaded_multi_mp_sgd_update", "preloaded_multi_mp_sgd_mom_update",
+    # bookkeeping / data movement (dtype-preserving)
+    "amp_cast", "broadcast_like", "reshape_like", "cast_storage",
+    "split_v2", "slice_assign", "slice_assign_scalar", "scatter_set_nd",
+    "reset_arrays", "histogram", "getnnz", "dynamic_reshape",
+    "identity_with_attr_like_rhs", "IdentityAttachKLSparseReg",
+    "im2col", "col2im", "ROIPooling", "Custom",
 ]

@@ -296,3 +296,267 @@ def quantize_net(net, calib_data: List[Any], calib_mode: str = "naive",
                                     quantized_dtype=quantized_dtype,
                                     excluded_names=excluded_names)
     return QuantizedNet(qsym, qparams)
+
+
+# ---------------------------------------------------------------------------
+# quantized operator breadth (reference src/operator/quantization/*.cc):
+# int8 flows through pooling/activation/shape ops unchanged (same scale),
+# elementwise arithmetic accumulates in int32, batch_norm folds into the
+# scale, embedding gathers int8 rows.  All registered under both the bare
+# and the reference's _contrib_* names.
+# ---------------------------------------------------------------------------
+
+@register("quantize_v2", num_inputs=1, num_outputs=-1, differentiable=False,
+          aliases=("_contrib_quantize_v2",))
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """Calibrated-range quantize (reference quantize_v2.cc); without a
+    calibrated range, the data min/max is used (the reference's runtime
+    min/max path)."""
+    if min_calib_range is None or max_calib_range is None:
+        amax = jnp.maximum(jnp.max(jnp.abs(data)), 1e-12)
+        scale = INT8_MAX / amax
+        q = jnp.clip(jnp.round(data * scale), INT8_MIN, INT8_MAX).astype(
+            jnp.int8)
+        return (q, -amax, amax)
+    lo, hi = float(min_calib_range), float(max_calib_range)
+    scale = INT8_MAX / max(abs(lo), abs(hi), 1e-12)
+    q = jnp.clip(jnp.round(data * scale), INT8_MIN, INT8_MAX).astype(
+        jnp.int8)
+    return (q, jnp.float32(lo), jnp.float32(hi))
+
+
+@register("quantized_act", num_inputs=3, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_quantized_act",))
+def quantized_act(qdata, min_range, max_range, act_type="relu"):
+    """int8 activation (reference quantized_activation.cc): relu keeps the
+    scale (max(0,x) in int domain)."""
+    if act_type != "relu":
+        raise NotImplementedError(
+            f"quantized_act supports relu (got {act_type}); dequantize for "
+            "other activations")
+    return (jnp.maximum(qdata, 0), min_range, max_range)
+
+
+@register("quantized_pooling", num_inputs=3, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_quantized_pooling",))
+def quantized_pooling(qdata, min_range, max_range, kernel=(2, 2),
+                      stride=None, pad=(0, 0), pool_type="max",
+                      global_pool=False):
+    """int8 pooling (reference quantized_pooling.cc): max-pool stays in
+    int8; avg-pool accumulates in int32 then renormalizes."""
+    n, c, h, w = qdata.shape
+    if global_pool:
+        kernel, stride, pad = (h, w), (1, 1), (0, 0)
+    stride = stride or kernel
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    if pool_type == "max":
+        out = jax.lax.reduce_window(qdata, jnp.int8(-128), jax.lax.max,
+                                    window, strides, pads)
+    else:
+        acc = jax.lax.reduce_window(
+            qdata.astype(jnp.int32), jnp.int32(0), jax.lax.add, window,
+            strides, pads)
+        out = jnp.clip(jnp.round(acc / (kernel[0] * kernel[1])),
+                       INT8_MIN, INT8_MAX).astype(jnp.int8)
+    return (out, min_range, max_range)
+
+
+@register("quantized_flatten", num_inputs=3, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_quantized_flatten",))
+def quantized_flatten(qdata, min_range, max_range):
+    return (qdata.reshape(qdata.shape[0], -1), min_range, max_range)
+
+
+@register("quantized_concat", num_inputs=-1, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_quantized_concat",))
+def quantized_concat(arrays, num_args=0, dim=1):
+    """Concat int8 inputs (reference quantized_concat.cc): inputs are
+    rescaled to the widest input range so one output scale is exact.
+    arrays = [q0..qn-1, min0, max0, min1, max1, ...]."""
+    n = num_args or len(arrays) // 3
+    qs = arrays[:n]
+    mins = arrays[n::2][:n]
+    maxs = arrays[n + 1::2][:n]
+    amaxs = [jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+             for lo, hi in zip(mins, maxs)]
+    out_amax = amaxs[0]
+    for a in amaxs[1:]:
+        out_amax = jnp.maximum(out_amax, a)
+    scaled = [
+        jnp.clip(jnp.round(q.astype(jnp.float32) * (a / out_amax)),
+                 INT8_MIN, INT8_MAX).astype(jnp.int8)
+        for q, a in zip(qs, amaxs)]
+    return (jnp.concatenate(scaled, axis=dim), -out_amax, out_amax)
+
+
+@register("quantized_elemwise_add", num_inputs=6, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_quantized_elemwise_add",))
+def quantized_elemwise_add(qa, qb, a_min, a_max, b_min, b_max):
+    """int8 + int8 -> int32 accumulator with fp32 scales folded (reference
+    quantized_elemwise_add.cc); output re-quantized to the sum range."""
+    sa = jnp.maximum(jnp.maximum(jnp.abs(a_min), jnp.abs(a_max)),
+                     1e-12) / INT8_MAX
+    sb = jnp.maximum(jnp.maximum(jnp.abs(b_min), jnp.abs(b_max)),
+                     1e-12) / INT8_MAX
+    f = qa.astype(jnp.float32) * sa + qb.astype(jnp.float32) * sb
+    out_amax = jnp.maximum(jnp.abs(a_min) + jnp.abs(b_min),
+                           jnp.abs(a_max) + jnp.abs(b_max))
+    q = jnp.clip(jnp.round(f * (INT8_MAX / jnp.maximum(out_amax, 1e-12))),
+                 INT8_MIN, INT8_MAX).astype(jnp.int8)
+    return (q, -out_amax, out_amax)
+
+
+@register("quantized_elemwise_mul", num_inputs=6, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_quantized_elemwise_mul",))
+def quantized_elemwise_mul(qa, qb, a_min, a_max, b_min, b_max):
+    """int8 * int8 -> int32 (exact); scales multiply (reference
+    quantized_elemwise_mul.cc)."""
+    acc = qa.astype(jnp.int32) * qb.astype(jnp.int32)
+    sa = jnp.maximum(jnp.maximum(jnp.abs(a_min), jnp.abs(a_max)),
+                     1e-12)
+    sb = jnp.maximum(jnp.maximum(jnp.abs(b_min), jnp.abs(b_max)),
+                     1e-12)
+    out_amax = sa * sb
+    return (acc, -out_amax, out_amax)
+
+
+@register("quantized_batch_norm", num_inputs=7, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_quantized_batch_norm",))
+def quantized_batch_norm(qdata, gamma, beta, moving_mean, moving_var,
+                         min_range, max_range, eps=1e-3,
+                         min_calib_range=None, max_calib_range=None):
+    """Inference BN over int8 (reference quantized_batch_norm.cc): folds
+    (gamma, beta, mean, var) into a per-channel affine applied in fp32,
+    then re-quantizes to the calibrated output range."""
+    in_scale = jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                       jnp.abs(max_range)), 1e-12) / INT8_MAX
+    inv = gamma / jnp.sqrt(moving_var + eps)
+    shape = (1, -1) + (1,) * (qdata.ndim - 2)
+    f = (qdata.astype(jnp.float32) * in_scale - moving_mean.reshape(shape)) \
+        * inv.reshape(shape) + beta.reshape(shape)
+    lo = float(min_calib_range if min_calib_range is not None else -1.0)
+    hi = float(max_calib_range if max_calib_range is not None else 1.0)
+    out_scale = INT8_MAX / max(abs(lo), abs(hi), 1e-12)
+    q = jnp.clip(jnp.round(f * out_scale), INT8_MIN, INT8_MAX).astype(
+        jnp.int8)
+    return (q, jnp.float32(lo), jnp.float32(hi))
+
+
+@register("quantized_embedding", num_inputs=4, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_quantized_embedding",))
+def quantized_embedding(indices, qweight, min_range, max_range,
+                        input_dim=0, output_dim=0):
+    """Gather int8 rows (reference quantized_indexing_op.cc); the scale is
+    unchanged by a gather."""
+    out = jnp.take(qweight, indices.astype(jnp.int32), axis=0)
+    return (out, min_range, max_range)
+
+
+@register("calibrate_entropy", num_inputs=1, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_calibrate_entropy",))
+def calibrate_entropy(hist_and_edges, num_quantized_bins=255):
+    """KL-divergence threshold selection over a histogram (reference
+    calibrate.cc): picks the clip threshold whose quantized distribution
+    minimizes KL against the clipped reference distribution.  Host-side
+    (calibration is offline); input = histogram counts, attr-free edges
+    assumed symmetric uniform."""
+    import numpy as _onp
+
+    hist = _onp.asarray(hist_and_edges, dtype=_onp.float64)
+    nbins = hist.size
+    best_kl, best_t = _onp.inf, nbins
+    for t in range(num_quantized_bins, nbins + 1, 2):
+        p = hist[:t].copy()
+        p[t - 1] += hist[t:].sum()          # clip mass into the last bin
+        p_sum = p.sum()
+        if p_sum == 0:
+            continue
+        # quantize t bins down to num_quantized_bins, then expand back
+        factor = t / num_quantized_bins
+        q = _onp.zeros(t)
+        for j in range(num_quantized_bins):
+            lo = int(_onp.floor(j * factor))
+            hi = int(_onp.ceil((j + 1) * factor))
+            mass = hist[lo:hi].sum()
+            nz = (hist[lo:hi] > 0).sum()
+            if nz:
+                q[lo:hi] = _onp.where(hist[lo:hi] > 0, mass / nz, 0)
+        q_sum = q.sum()
+        if q_sum == 0:
+            continue
+        pn, qn = p / p_sum, q / q_sum
+        mask = (pn > 0) & (qn > 0)
+        kl = float(_onp.sum(pn[mask] * _onp.log(pn[mask] / qn[mask])))
+        if kl < best_kl:
+            best_kl, best_t = kl, t
+    return (jnp.asarray(best_t, jnp.int32), jnp.asarray(best_kl))
+
+
+# ---------------------------------------------------------------------------
+# intgemm family (reference src/operator/contrib/intgemm/*.cc): CPU int8
+# GEMM pre/post-processing ops.  On TPU the MXU consumes plain int8 tiles,
+# so prepare_* are layout no-ops with the same contracts.
+# ---------------------------------------------------------------------------
+
+@register("intgemm_maxabsolute", num_inputs=1, differentiable=False,
+          aliases=("_contrib_intgemm_maxabsolute",))
+def intgemm_maxabsolute(data):
+    return jnp.max(jnp.abs(data))
+
+
+@register("intgemm_prepare_data", num_inputs=2, differentiable=False,
+          aliases=("_contrib_intgemm_prepare_data",))
+def intgemm_prepare_data(data, maxabs):
+    """fp32 -> int8 with scale 127/maxabs (reference
+    intgemm/prepare_data_op.cc)."""
+    scale = INT8_MAX / jnp.maximum(maxabs, 1e-12)
+    return jnp.clip(jnp.round(data * scale), INT8_MIN, INT8_MAX).astype(
+        jnp.int8)
+
+
+@register("intgemm_prepare_weight", num_inputs=-1, differentiable=False,
+          aliases=("_contrib_intgemm_prepare_weight",))
+def intgemm_prepare_weight(arrays, already_quantized=False):
+    """Weight pre-pass (reference intgemm/prepare_weight_op.cc).  The
+    reference permutes into a CPU-register tiled layout; the MXU needs no
+    relayout, so this quantizes (if needed) and keeps row-major."""
+    if already_quantized or len(arrays) == 1:
+        return arrays[0].astype(jnp.int8)
+    data, maxabs = arrays
+    scale = INT8_MAX / jnp.maximum(maxabs, 1e-12)
+    return jnp.clip(jnp.round(data * scale), INT8_MIN, INT8_MAX).astype(
+        jnp.int8)
+
+
+@register("intgemm_take_weight", num_inputs=2, differentiable=False,
+          aliases=("_contrib_intgemm_take_weight",))
+def intgemm_take_weight(qweight, indices):
+    """Gather rows of a prepared weight (reference
+    intgemm/take_weight_op.cc — vocabulary shortlisting)."""
+    return jnp.take(qweight, indices.astype(jnp.int32), axis=0)
+
+
+@register("intgemm_fully_connected", num_inputs=-1, differentiable=False,
+          aliases=("_contrib_intgemm_fully_connected",))
+def intgemm_fully_connected(arrays, num_hidden=0, no_bias=True, flatten=True,
+                            out_type="float32"):
+    """int8 x int8 -> int32/fp32 GEMM (reference
+    intgemm/intgemm_fully_connected_op.cc).  arrays = [data_s8, weight_s8,
+    scale (fp32 scalar = product of the two quantization scales), (bias)]."""
+    qd, qw = arrays[0], arrays[1]
+    if flatten and qd.ndim > 2:
+        qd = qd.reshape(qd.shape[0], -1)
+    acc = jax.lax.dot_general(
+        qd.astype(jnp.int8), qw.astype(jnp.int8),
+        (((qd.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    if out_type == "int32":
+        return acc
+    scale = arrays[2] if len(arrays) > 2 else jnp.float32(1)
+    out = acc.astype(jnp.float32) * scale
+    if not no_bias and len(arrays) > 3:
+        out = out + arrays[3]
+    return out

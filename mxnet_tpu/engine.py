@@ -28,7 +28,12 @@ def engine_type() -> str:
 
 
 def is_naive() -> bool:
-    return engine_type() == "NaiveEngine"
+    """Hot-path check (called per eager op by ndarray.invoke): one dict
+    lookup against the raw environment, skipping the registry layers.
+    engine_type() remains the validated/documented read."""
+    import os
+
+    return os.environ.get("MXNET_ENGINE_TYPE") == "NaiveEngine"
 
 
 def set_bulk_size(size: int) -> int:

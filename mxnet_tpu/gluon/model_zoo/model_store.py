@@ -116,6 +116,11 @@ def get_model_file(name: str, root: Optional[str] = None) -> str:
     # attempt the reference's download path; most TPU build environments
     # have no egress, so fail fast with actionable instructions
     url = _URL_FMT.format(file_name=file_name)
+
+    class _BadPayload(Exception):
+        """Download SUCCEEDED but the payload is wrong — must not be
+        reported as a network failure by the egress wrapper below."""
+
     try:
         import socket
         import urllib.request
@@ -135,14 +140,23 @@ def get_model_file(name: str, root: Optional[str] = None) -> str:
             # leave the poisoned .zip in the cache
             if os.path.exists(zip_path):
                 os.remove(zip_path)
-            raise OSError(f"server returned a non-zip payload: {e}") from e
+            raise _BadPayload(f"server returned a non-zip payload: {e}") \
+                from e
         if os.path.exists(file_path):
             # verify the fresh download too — a valid zip can still carry
             # wrong bytes (stale mirror / tampering); don't load it silently
             if _check_sha1(file_path, sha1):
                 return file_path
             os.remove(file_path)
-            raise OSError("downloaded checkpoint failed sha1 verification")
+            raise _BadPayload("downloaded checkpoint failed sha1 "
+                              "verification")
+    except _BadPayload as e:
+        raise IOError(
+            f"Download of pretrained weights for '{name}' from {url} "
+            f"completed but the payload is invalid: {e}.  The mirror may "
+            f"be stale or the connection tampered with; fetch the "
+            f"checkpoint from a trusted source and place it at "
+            f"{file_path}.") from e
     except (OSError, socket.timeout) as e:
         raise IOError(
             f"Pretrained weights for '{name}' are not cached at "

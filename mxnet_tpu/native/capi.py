@@ -43,8 +43,12 @@ def build(force: bool = False) -> str:
     """Compile libmxnet_tpu_c.so (atomic rename, same recipe as
     native._build)."""
     os.makedirs(_BUILD, exist_ok=True)
+    # staleness: the .cc, the public header it includes, and the bridge
+    # whose contract it marshals into all invalidate the build
+    deps = [_SRC, HEADER_PATH, os.path.join(_HERE, "capi_bridge.py")]
+    newest = max(os.path.getmtime(p) for p in deps if os.path.exists(p))
     if (not force and os.path.exists(LIB_PATH)
-            and os.path.getmtime(LIB_PATH) >= os.path.getmtime(_SRC)):
+            and os.path.getmtime(LIB_PATH) >= newest):
         return LIB_PATH
     inc, libdir, pylib = python_link_flags()
     tmp = f"{LIB_PATH}.{os.getpid()}.tmp"

@@ -35,6 +35,19 @@ for _name, _schema in list(_registry._OPS.items()):
 op = _this  # reference exposes mx.nd.op alias
 
 
+def __getattr__(name):
+    """Late-registered ops (contrib.quantization, library.register_op,
+    reference-name aliases) resolve through the registry on first access —
+    the analog of the reference regenerating its namespace after MXLoadLib.
+    """
+    schema = _registry.find_op(name)
+    if schema is not None and "nd" in schema.namespaces:
+        fn = make_op_func(schema)
+        setattr(_this, name, fn)
+        return fn
+    raise AttributeError(f"module '{__name__}' has no attribute '{name}'")
+
+
 # --- creation helpers with MXNet calling conventions -----------------------
 def zeros(shape, ctx=None, dtype="float32", **kwargs):
     import jax.numpy as jnp

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as onp
 
 from .. import autograd
+from .. import engine as _engine
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..ops.registry import OpSchema, find_op, get_op
@@ -638,8 +639,6 @@ def invoke(
             out_cls = type(i)
             break
     outputs = [_wrap(o, ctx, out_cls) for o in outs_raw]
-
-    from .. import engine as _engine
 
     if _engine.is_naive():
         # MXNET_ENGINE_TYPE=NaiveEngine: synchronous dispatch — block per

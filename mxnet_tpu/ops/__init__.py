@@ -21,5 +21,9 @@ from . import detection as _detection  # noqa: F401
 from . import extra as _extra  # noqa: F401
 from . import control_flow as _control_flow  # noqa: F401
 from . import rnn as _rnn  # noqa: F401
+from . import nn_extra as _nn_extra  # noqa: F401
+from . import misc as _misc  # noqa: F401
+from . import ref_aliases as _ref_aliases  # noqa: F401  (must be last;
+# contrib.quantization registers late — mxnet_tpu/__init__ re-applies)
 
 __all__ = ["OpSchema", "register", "get_op", "find_op", "list_ops"]

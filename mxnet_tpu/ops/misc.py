@@ -1,0 +1,297 @@
+"""Miscellaneous parity operators: AMP casts, shape-like helpers, storage
+casts, split_v2, in-place-style assignment ops, multi-tensor zeroing,
+histogram, sparse introspection, and the Hawkes-process likelihood.
+
+Reference files are cited per op; implementations are fresh JAX lowerings.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# --------------------------------------------------------------------------
+# AMP casts (reference src/operator/tensor/amp_cast.cc)
+# --------------------------------------------------------------------------
+
+@register("amp_cast", num_inputs=1)
+def amp_cast(data, dtype="float32"):
+    """Mixed-precision cast node (reference amp_cast.cc); inserted by AMP
+    graph conversion, kept as an explicit op so exported graphs round-trip.
+    """
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("amp_multicast", num_inputs=-1, num_outputs=-1)
+def amp_multicast(arrays, num_outputs=0, cast_narrow=False):
+    """Cast a list of arrays to their common widest (or narrowest) float
+    type (reference amp_cast.cc amp_multicast).  Non-float inputs are
+    never a cast target and pass through unchanged."""
+    order = [jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64]
+
+    def rank(dt):
+        for i, o in enumerate(order):
+            if dt == o:
+                return i
+        return None
+
+    float_dts = [dt for dt in (a.dtype for a in arrays)
+                 if rank(dt) is not None]
+    if not float_dts:
+        return tuple(arrays)
+    target = (min if cast_narrow else max)(float_dts, key=rank)
+    return tuple(a.astype(target) if rank(a.dtype) is not None else a
+                 for a in arrays)
+
+
+# --------------------------------------------------------------------------
+# shape-like helpers (reference src/operator/tensor/elemwise_unary_op.cc)
+# --------------------------------------------------------------------------
+
+@register("broadcast_like", num_inputs=2)
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    """Broadcast lhs to the shape of rhs (reference broadcast_like,
+    src/operator/tensor/broadcast_reduce_op_value.cc)."""
+    if lhs_axes is not None or rhs_axes is not None:
+        shape = list(lhs.shape)
+        l_axes = lhs_axes if lhs_axes is not None else tuple(range(len(shape)))
+        r_axes = rhs_axes if rhs_axes is not None else tuple(range(len(shape)))
+        for la, ra in zip(l_axes, r_axes):
+            shape[la] = rhs.shape[ra]
+        return jnp.broadcast_to(lhs, tuple(shape))
+    # rank-extend like broadcast_to: size-1 dims of lhs follow rhs
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("reshape_like", num_inputs=2)
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape lhs to rhs's shape, optionally splicing a sub-range of axes
+    (reference reshape_like, src/operator/tensor/elemwise_unary_op_basic.cc).
+    """
+    if lhs_begin is None and rhs_begin is None:
+        return lhs.reshape(rhs.shape)
+    lb = lhs_begin or 0
+    le = lhs_end if lhs_end is not None else len(lhs.shape)
+    rb = rhs_begin or 0
+    re_ = rhs_end if rhs_end is not None else len(rhs.shape)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return lhs.reshape(new_shape)
+
+
+@register("cast_storage", num_inputs=1, differentiable=False)
+def cast_storage(data, stype="default"):
+    """Storage-type cast node (reference
+    src/operator/tensor/cast_storage.cc).  Dense layout is the only device
+    storage on TPU; row_sparse/csr live at the NDArray layer
+    (ndarray/sparse.py .tostype()), so the graph node is an identity — the
+    frontend wrapper performs the container conversion."""
+    return data
+
+
+# --------------------------------------------------------------------------
+# split_v2 (reference src/operator/tensor/matrix_op.cc _split_v2)
+# --------------------------------------------------------------------------
+
+@register("split_v2", num_inputs=1, num_outputs=-1, aliases=("_split_v2",))
+def split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0):
+    """Split by section count or explicit indices (reference _split_v2)."""
+    if sections and sections > 0:
+        parts = jnp.split(data, sections, axis=axis)
+    else:
+        parts = jnp.split(data, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [p.squeeze(axis) for p in parts]
+    return tuple(parts)
+
+
+# --------------------------------------------------------------------------
+# assignment-style ops (reference src/operator/tensor/matrix_op.cc
+# _slice_assign, init_op.cc _scatter_set_nd) — functional on TPU: they
+# return the updated array; the NDArray frontend writes it back.
+# --------------------------------------------------------------------------
+
+@register("slice_assign", num_inputs=2, aliases=("_slice_assign",))
+def slice_assign(data, value, begin=(), end=(), step=()):
+    """data[begin:end:step] = value (reference _slice_assign)."""
+    idx = tuple(
+        slice(b if b is not None else None,
+              e if e is not None else None,
+              (s if s not in (None, 0) else None))
+        for b, e, s in zip(begin, end,
+                           step or (None,) * len(begin)))
+    return data.at[idx].set(value)
+
+
+@register("slice_assign_scalar", num_inputs=1,
+          aliases=("_slice_assign_scalar",))
+def slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
+    idx = tuple(
+        slice(b if b is not None else None,
+              e if e is not None else None,
+              (s if s not in (None, 0) else None))
+        for b, e, s in zip(begin, end,
+                           step or (None,) * len(begin)))
+    return data.at[idx].set(scalar)
+
+
+@register("scatter_set_nd", num_inputs=3, aliases=("_scatter_set_nd",),
+          differentiable=False)
+def scatter_set_nd(lhs, indices, rhs, shape=None):
+    """Set lhs at gather_nd-style indices to rhs (reference
+    _scatter_set_nd, src/operator/tensor/indexing_op.cc)."""
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+@register("reset_arrays", num_inputs=-1, num_outputs=-1,
+          differentiable=False)
+def reset_arrays(arrays, num_arrays=0):
+    """Zero a list of arrays in one fused program (reference
+    src/operator/contrib/reset_arrays.cc — gradient clearing between
+    accumulation windows)."""
+    return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+# --------------------------------------------------------------------------
+# histogram (reference src/operator/tensor/histogram.cc)
+# --------------------------------------------------------------------------
+
+@register("histogram", num_inputs=-1, num_outputs=-1, differentiable=False,
+          aliases=("_histogram",))
+def histogram(arrays, bin_cnt=None, range=None):
+    """np.histogram semantics: with one input + bin_cnt/range attrs, or
+    (data, bins) inputs (reference _histogram)."""
+    data = arrays[0]
+    if len(arrays) > 1:
+        cnt, edges = jnp.histogram(data, bins=arrays[1])
+    else:
+        lo, hi = range if range is not None else (float(data.min()),
+                                                  float(data.max()))
+        cnt, edges = jnp.histogram(data, bins=bin_cnt or 10,
+                                   range=(lo, hi))
+    return cnt, edges
+
+
+# --------------------------------------------------------------------------
+# sparse introspection (dense-layout analogs)
+# --------------------------------------------------------------------------
+
+@register("getnnz", num_inputs=1, differentiable=False,
+          aliases=("_contrib_getnnz",))
+def getnnz(data, axis=None):
+    """Count stored (non-zero) values (reference _contrib_getnnz over CSR;
+    dense layout here, so it counts non-zeros)."""
+    nz = (data != 0)
+    if axis is None:
+        return jnp.sum(nz).astype(jnp.int64)
+    return jnp.sum(nz, axis=axis).astype(jnp.int64)
+
+
+@register("dynamic_reshape", num_inputs=2, differentiable=False,
+          aliases=("_contrib_dynamic_reshape",))
+def dynamic_reshape(data, shape):
+    """Reshape where the target comes from a tensor (reference
+    _contrib_dynamic_reshape).  Eager-only: under jit the target shape
+    must be static — hybridized graphs should use ``reshape``."""
+    import numpy as onp
+
+    target = [int(x) for x in onp.asarray(shape)]
+    return data.reshape(target)
+
+
+# --------------------------------------------------------------------------
+# Hawkes process log-likelihood (reference
+# src/operator/contrib/hawkes_ll.cc:33-96)
+# --------------------------------------------------------------------------
+
+@register("hawkesll", num_inputs=8, num_outputs=-1,
+          aliases=("_contrib_hawkesll",))
+def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Univariate (per-mark) Hawkes log likelihood over ragged
+    left-aligned sequences.
+
+    lambda_k(t) = lda_k + alpha_k * beta_k * s_k(t) with memory
+    s_k(t) = sum_{t_i<t, y_i=k} exp(-beta_k (t-t_i)) (+ decayed initial
+    ``state``).  Returns (loglik (N,), out_state (N,K)); the compensator
+    uses the closed form  integral = lda_k*T + alpha_k*(count_k + s0_k -
+    s_k(T)).
+    """
+    N, T = lags.shape
+    K = lda.shape[1]
+    marks = marks.astype(jnp.int32)
+
+    def per_sample(lda_i, s0, lags_i, marks_i, vl, tmax):
+        def step(carry, inp):
+            s, t, ll = carry
+            dt, m, j = inp
+            valid = j < vl
+            s_dec = s * jnp.exp(-beta * dt)
+            lam = lda_i[m] + alpha[m] * beta[m] * s_dec[m]
+            ll = ll + jnp.where(valid, jnp.log(lam), 0.0)
+            # padded steps must not decay the memory either — the state is
+            # only advanced while inside the valid prefix
+            s_new = jnp.where(valid, s_dec + jax.nn.one_hot(m, K), s)
+            t_new = t + jnp.where(valid, dt, 0.0)
+            return (s_new, t_new, ll), None
+
+        init = (s0, jnp.zeros((), lags_i.dtype), jnp.zeros((), lags_i.dtype))
+        (s_end, t_end, ll), _ = lax.scan(
+            step, init,
+            (lags_i, marks_i, jnp.arange(T)))
+        # decay the memory to the end of the observation window
+        s_T = s_end * jnp.exp(-beta * (tmax - t_end))
+        counts = jnp.zeros(K).at[marks_i].add(
+            (jnp.arange(T) < vl).astype(lags_i.dtype))
+        comp = jnp.sum(lda_i * tmax + alpha * (counts + s0 - s_T))
+        return ll - comp, s_T
+
+    return jax.vmap(per_sample)(lda, state, lags, marks, valid_length,
+                                max_time)
+
+
+# --------------------------------------------------------------------------
+# Custom op dispatch (reference src/operator/custom/custom-inl.h — Python
+# callback op; here user ops register through mxnet_tpu.library.register_op
+# and Custom dispatches to them by op_type for signature parity)
+# --------------------------------------------------------------------------
+
+@register("Custom", num_inputs=-1, num_outputs=-1)
+def custom(arrays, op_type=""):
+    from .registry import find_op
+
+    schema = find_op(op_type)
+    if schema is None:
+        raise KeyError(
+            f"Custom: no op '{op_type}' registered; register it with "
+            "mxnet_tpu.library.register_op (the MXLoadLib/CustomOp analog)")
+    if schema.num_inputs == -1:
+        return schema.fn(list(arrays))
+    return schema.fn(*arrays)
+
+
+# --------------------------------------------------------------------------
+# identity-with-attributes ops (reference src/operator/tensor/
+# elemwise_unary_op_basic.cc, src/operator/regression_output.cc)
+# --------------------------------------------------------------------------
+
+@register("identity_with_attr_like_rhs", num_inputs=2,
+          aliases=("_identity_with_attr_like_rhs",))
+def identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs, shape/stype attributes taken from rhs (reference
+    _identity_with_attr_like_rhs — used by the gradient of ops that drop
+    storage attributes)."""
+    return lhs
+
+
+@register("IdentityAttachKLSparseReg", num_inputs=1)
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """Identity forward; attaches a KL-sparseness regularizer to the
+    gradient in the reference (src/operator/identity_attach_KL_sparse_reg.cc).
+    The regularization gradient is data-independent bookkeeping the
+    reference applies in backward; forward parity is identity."""
+    return data

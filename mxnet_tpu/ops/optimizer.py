@@ -310,3 +310,230 @@ def multi_lans_update(arrays, learning_rates=(), wds=(), beta1=0.9,
         new_m.append(m_n)
         new_v.append(v_n)
     return tuple(new_w) + tuple(new_m) + tuple(new_v)
+
+
+# --- mixed-precision master-weight variants (reference optimizer_op.cc
+# mp_* registrations: fp16/bf16 weights with an fp32 master copy; the
+# update runs in fp32 and both copies are returned) ----------------------
+
+def _mp(update_fn, weight, weight32, *states, **kw):
+    out = update_fn(weight32, *states, **kw)
+    outs = out if isinstance(out, tuple) else (out,)
+    new_w32 = outs[0]
+    return (new_w32.astype(weight.dtype), new_w32) + outs[1:]
+
+
+@register("mp_sgd_update", num_inputs=3, num_outputs=-1, differentiable=False)
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=False):
+    """SGD on the fp32 master weight (reference mp_sgd_update); returns
+    (weight_cast, weight32)."""
+    return _mp(sgd_update, weight, weight32, grad.astype(jnp.float32),
+               lr=lr, wd=wd, rescale_grad=rescale_grad,
+               clip_gradient=clip_gradient)
+
+
+@register("mp_sgd_mom_update", num_inputs=4, num_outputs=-1,
+          differentiable=False)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=False):
+    new_w32, new_mom = sgd_mom_update(
+        weight32, grad.astype(jnp.float32), mom, lr=lr, momentum=momentum,
+        wd=wd, rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    return (new_w32.astype(weight.dtype), new_mom, new_w32)
+
+
+@register("mp_nag_mom_update", num_inputs=4, num_outputs=-1,
+          differentiable=False)
+def mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    new_w32, new_mom = nag_mom_update(
+        weight32, grad.astype(jnp.float32), mom, lr=lr, momentum=momentum,
+        wd=wd, rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    return (new_w32.astype(weight.dtype), new_mom, new_w32)
+
+
+@register("mp_lamb_update_phase1", num_inputs=5, num_outputs=-1,
+          differentiable=False)
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1, wd=0.0,
+                          bias_correction=True, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+    """LAMB phase 1 against the fp32 master weight (reference
+    mp_lamb_update_phase1; the 5-input form passes weight32 last)."""
+    w = weight32 if weight32 is not None else weight.astype(jnp.float32)
+    return lamb_update_phase1(
+        w, grad.astype(jnp.float32), mean, var, beta1=beta1, beta2=beta2,
+        epsilon=epsilon, t=t, wd=wd, bias_correction=bias_correction,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+
+
+@register("mp_lamb_update_phase2", num_inputs=-1, num_outputs=1,
+          differentiable=False)
+def mp_lamb_update_phase2(arrays, lr=0.01, lower_bound=-1.0,
+                          upper_bound=-1.0):
+    """(weight, g_update, r1, r2, weight32) -> fp16 weight; the fp32 master
+    is updated and narrowed (reference mp_lamb_update_phase2)."""
+    weight, g_update, r1, r2, weight32 = arrays
+    new_w32 = lamb_update_phase2([weight32, g_update, r1, r2], lr=lr,
+                                 lower_bound=lower_bound,
+                                 upper_bound=upper_bound)
+    return new_w32.astype(weight.dtype)
+
+
+@register("ftml_update", num_inputs=5, num_outputs=-1, differentiable=False)
+def ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    """FTML — Follow The Moving Leader (reference optimizer_op-inl.h:1159
+    FTMLKernel): returns (weight, d, v, z)."""
+    g = grad * rescale_grad
+    if clip_grad is not None and clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    g = g + wd * weight
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t))
+                                   + epsilon)
+    new_z = beta1 * z + (1 - beta1) * g - (d_t - beta1 * d) * weight
+    new_d = d_t
+    return (-new_z / d_t, new_d, new_v, new_z)
+
+
+@register("multi_lars", num_inputs=4, num_outputs=1, differentiable=False)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001, eps=1e-8,
+               rescale_grad=1.0):
+    """Vectorized LARS coefficients from per-tensor squared norms
+    (reference contrib/multi_lars-inl.h:61 MultiLARSKernel)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq)
+    valid = (w_norm > 0) & (grads_sum_sq > 0)
+    scaled = lrs * eta * w_norm / (g_norm * rescale_grad + wds * w_norm
+                                   + eps)
+    return jnp.where(valid, scaled, lrs)
+
+
+@register("group_adagrad_update", num_inputs=3, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_group_adagrad_update",))
+def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Per-row (grouped) AdaGrad for embedding tables (reference
+    contrib/optimizer_op-inl.h:99): history accumulates the per-row MEAN
+    squared gradient; returns (weight, history)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    row_mean_sq = jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)))
+    new_hist = history + row_mean_sq
+    denom = jnp.sqrt(new_hist) + epsilon
+    shape = (-1,) + (1,) * (g.ndim - 1)
+    return (weight - lr * g / denom.reshape(shape), new_hist)
+
+
+# --- preloaded multi-tensor SGD: lrs/wds arrive as device arrays instead
+# of attrs, so LR schedules never force a re-trace (reference
+# contrib/preloaded_multi_sgd.cc) ---------------------------------------
+
+def _preloaded_split(arrays, per_weight, num_weights):
+    n = num_weights or (len(arrays) - 2) // per_weight
+    groups = [arrays[i * n:(i + 1) * n] for i in range(per_weight)]
+    lrs, wds = arrays[per_weight * n], arrays[per_weight * n + 1]
+    return n, groups, lrs, wds
+
+
+@register("preloaded_multi_sgd_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False)
+def preloaded_multi_sgd_update(arrays, rescale_grad=1.0, clip_gradient=-1.0,
+                               num_weights=0):
+    """arrays = [w..., g..., lrs, wds] (reference preloaded_multi_sgd.cc).
+    """
+    n, (ws, gs), lrs, wds = _preloaded_split(arrays, 2, num_weights)
+    outs = []
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        gg = _apply_wd(g, w, wds[i], rescale_grad, clip_gradient)
+        outs.append(w - lrs[i] * gg)
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_mom_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False)
+def preloaded_multi_sgd_mom_update(arrays, momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=0):
+    n, (ws, gs, ms), lrs, wds = _preloaded_split(arrays, 3, num_weights)
+    new_w, new_m = [], []
+    for i, (w, g, m) in enumerate(zip(ws, gs, ms)):
+        gg = _apply_wd(g, w, wds[i], rescale_grad, clip_gradient)
+        nm = momentum * m - lrs[i] * gg
+        new_w.append(w + nm)
+        new_m.append(nm)
+    return tuple(new_w) + tuple(new_m)
+
+
+@register("preloaded_multi_mp_sgd_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False)
+def preloaded_multi_mp_sgd_update(arrays, rescale_grad=1.0,
+                                  clip_gradient=-1.0, num_weights=0):
+    """arrays = [w..., g..., w32..., lrs, wds] -> (w..., w32...)."""
+    n, (ws, gs, w32s), lrs, wds = _preloaded_split(arrays, 3, num_weights)
+    new_w, new_w32 = [], []
+    for i, (w, g, w32) in enumerate(zip(ws, gs, w32s)):
+        gg = _apply_wd(g.astype(jnp.float32), w32, wds[i], rescale_grad,
+                       clip_gradient)
+        nw32 = w32 - lrs[i] * gg
+        new_w.append(nw32.astype(w.dtype))
+        new_w32.append(nw32)
+    return tuple(new_w) + tuple(new_w32)
+
+
+@register("preloaded_multi_mp_sgd_mom_update", num_inputs=-1,
+          num_outputs=-1, differentiable=False)
+def preloaded_multi_mp_sgd_mom_update(arrays, momentum=0.0, rescale_grad=1.0,
+                                      clip_gradient=-1.0, num_weights=0):
+    n, (ws, gs, ms, w32s), lrs, wds = _preloaded_split(arrays, 4,
+                                                       num_weights)
+    new_w, new_m, new_w32 = [], [], []
+    for i, (w, g, m, w32) in enumerate(zip(ws, gs, ms, w32s)):
+        gg = _apply_wd(g.astype(jnp.float32), w32, wds[i], rescale_grad,
+                       clip_gradient)
+        nm = momentum * m - lrs[i] * gg
+        nw32 = w32 + nm
+        new_w.append(nw32.astype(w.dtype))
+        new_m.append(nm)
+        new_w32.append(nw32)
+    return tuple(new_w) + tuple(new_m) + tuple(new_w32)
+
+
+@register("multi_mp_sgd_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False)
+def multi_mp_sgd_update(arrays, lrs=(), wds=(), rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=0):
+    """[w..., g..., w32...] -> (w..., w32...) (reference multi_mp_sgd)."""
+    n = num_weights or len(arrays) // 3
+    ws, gs, w32s = (arrays[i * n:(i + 1) * n] for i in range(3))
+    new_w, new_w32 = [], []
+    for w, g, w32, lr, wd in zip(ws, gs, w32s, lrs, wds):
+        gg = _apply_wd(g.astype(jnp.float32), w32, wd, rescale_grad,
+                       clip_gradient)
+        nw32 = w32 - lr * gg
+        new_w.append(nw32.astype(w.dtype))
+        new_w32.append(nw32)
+    return tuple(new_w) + tuple(new_w32)
+
+
+@register("multi_mp_sgd_mom_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False)
+def multi_mp_sgd_mom_update(arrays, lrs=(), wds=(), momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=0):
+    n = num_weights or len(arrays) // 4
+    ws, gs, ms, w32s = (arrays[i * n:(i + 1) * n] for i in range(4))
+    new_w, new_m, new_w32 = [], [], []
+    for w, g, m, w32, lr, wd in zip(ws, gs, ms, w32s, lrs, wds):
+        gg = _apply_wd(g.astype(jnp.float32), w32, wd, rescale_grad,
+                       clip_gradient)
+        nm = momentum * m - lr * gg
+        nw32 = w32 + nm
+        new_w.append(nw32.astype(w.dtype))
+        new_m.append(nm)
+        new_w32.append(nw32)
+    return tuple(new_w) + tuple(new_m) + tuple(new_w32)

@@ -1,0 +1,40 @@
+"""Reference registration-name aliases.
+
+The reference registers contrib ops under ``_contrib_<name>`` and internal
+ops under leading-underscore names (SURVEY §2.2); this framework registers
+the canonical name and aliases the reference spelling so code written
+against the reference's generated namespaces resolves.  Aliases share the
+schema — no duplicate implementations.
+"""
+from __future__ import annotations
+
+from .registry import alias, find_op
+
+_CONTRIB = [
+    "AdaptiveAvgPooling2D", "BilinearResize2D", "MultiBoxDetection",
+    "MultiBoxPrior", "MultiBoxTarget", "ROIAlign", "allclose", "arange_like",
+    "bipartite_matching", "boolean_mask", "box_decode", "box_encode",
+    "box_iou", "box_nms", "index_array", "index_copy", "quadratic",
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+    "count_sketch", "fft", "ifft", "DeformableConvolution",
+    "quantize", "dequantize", "requantize", "quantized_conv",
+    "quantized_fully_connected",
+]
+
+def apply() -> None:
+    """Install aliases for every canonical op currently registered.
+    Idempotent; called again after late registrations (e.g.
+    contrib.quantization, imported after the core package to avoid an
+    import cycle) so their reference names resolve too."""
+    for name in _CONTRIB:
+        ref = f"_contrib_{name}"
+        if find_op(name) is not None and find_op(ref) is None:
+            alias(name, ref)
+    # fused RNN op: the reference registers the stateful cuDNN/CPU op as
+    # "RNN" (src/operator/rnn.cc:451); the scan lowering here is _rnn_fused
+    if find_op("RNN") is None and find_op("_rnn_fused") is not None:
+        alias("_rnn_fused", "RNN")
+
+
+apply()

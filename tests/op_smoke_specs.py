@@ -229,4 +229,55 @@ SPECS = {
                                _f(4) + 1.0,
                                (_R.rand(4) * 0.6 + 0.2).astype(
                                    onp.float32)], {}),
+    # --- nn_extra -------------------------------------------------------
+    "SyncBatchNorm": ([_f(2, 4, 6, 6), _f(4), _f(4), _f(4), _f(4) + 0.5],
+                      {}),
+    "BatchNormWithReLU": ([_f(2, 4, 6, 6), _f(4), _f(4), _f(4),
+                           _f(4) + 0.5], {}),
+    "ROIPooling": ([_f(2, 3, 8, 8),
+                    onp.array([[0, 1, 1, 6, 6], [1, 0, 0, 7, 5]],
+                              onp.float32)],
+                   dict(pooled_size=(2, 2), spatial_scale=1.0)),
+    "im2col": ([_f(2, 3, 8, 8)], dict(kernel=(3, 3))),
+    "col2im": ([_f(2, 27, 36)],
+               dict(output_size=(8, 8), kernel=(3, 3))),
+    # --- misc -----------------------------------------------------------
+    "Custom": ([_f(4, 6)], dict(op_type="relu")),
+    "histogram": ([_f(100).ravel(),
+                   onp.linspace(0.0, 1.2, 11).astype(onp.float32)], {}),
+    "scatter_set_nd": ([_f(4, 6),
+                        onp.stack([_i(4, 5), _i(6, 5)]).astype(onp.int32),
+                        _f(5)], {}),
+    "dynamic_reshape": ([_f(4, 6), onp.array([6, 4], onp.int32)], {}),
+    "hawkesll": ([_f(2, 3) + 0.5,                       # lda (N,K)
+                  (_R.rand(3) * 0.5).astype(onp.float32),   # alpha (K,)
+                  _f(3) + 0.5,                          # beta (K,)
+                  _f(2, 3) * 0.1,                       # state (N,K)
+                  _f(2, 5),                             # lags (N,T)
+                  _i(3, 2, 5),                          # marks (N,T)
+                  onp.array([3, 5], onp.float32),       # valid_length
+                  onp.array([20.0, 20.0], onp.float32)],  # max_time
+                 {}),
+    # --- optimizer variants --------------------------------------------
+    "group_adagrad_update": ([_f(4, 6), _f(4, 6), _f(4)], {}),
+    "mp_lamb_update_phase2": ([_f(4, 6), _f(4, 6),
+                               onp.float32(1.0).reshape(()),
+                               onp.float32(1.0).reshape(()),
+                               _f(4, 6)], {}),
+    # --- quantized breadth ---------------------------------------------
+    "calibrate_entropy": ([(_R.rand(512) * 100).astype(onp.float32)], {}),
+    "quantized_pooling": ([_R.randint(-127, 127, (2, 3, 8, 8)).astype(
+        onp.int8), onp.float32(-1.0).reshape(()),
+        onp.float32(1.0).reshape(())], dict(kernel=(2, 2))),
+    "quantized_batch_norm": ([_R.randint(-127, 127, (2, 4, 6, 6)).astype(
+        onp.int8), _f(4), _f(4), _f(4), _f(4) + 0.5,
+        onp.float32(-1.0).reshape(()), onp.float32(1.0).reshape(())],
+        dict(min_calib_range=-2.0, max_calib_range=2.0)),
+    "quantized_concat": ([_R.randint(-127, 127, (2, 3)).astype(onp.int8),
+                          _R.randint(-127, 127, (2, 3)).astype(onp.int8),
+                          onp.float32(-1.0).reshape(()),
+                          onp.float32(1.0).reshape(()),
+                          onp.float32(-2.0).reshape(()),
+                          onp.float32(2.0).reshape(())],
+                         dict(num_args=2)),
 }

@@ -194,3 +194,31 @@ def test_amax_amin_slice_channel_aliases():
 def test_registry_at_least_300():
     from mxnet_tpu.ops import registry
     assert len(registry.list_ops()) >= 300
+
+def test_hawkesll_padding_invariance():
+    """Values beyond valid_length must not affect loglik or out_state
+    (regression: padded steps once decayed the memory)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+
+    rng = onp.random.RandomState(3)
+    K, T = 3, 6
+    lda = mx.nd.array(rng.rand(2, K).astype(onp.float32) + 0.5)
+    alpha = mx.nd.array((rng.rand(K) * 0.5).astype(onp.float32))
+    beta = mx.nd.array(rng.rand(K).astype(onp.float32) + 0.5)
+    state = mx.nd.array(rng.rand(2, K).astype(onp.float32) * 0.1)
+    lags_np = rng.rand(2, T).astype(onp.float32)
+    marks = mx.nd.array(rng.randint(0, K, (2, T)).astype(onp.int32))
+    vl = mx.nd.array(onp.array([3, 4], onp.float32))
+    tmax = mx.nd.array(onp.array([50.0, 50.0], onp.float32))
+
+    ll1, s1 = mx.nd.hawkesll(lda, alpha, beta, state, mx.nd.array(lags_np),
+                             marks, vl, tmax)
+    lags2 = lags_np.copy()
+    lags2[0, 3:] = 99.0   # garbage in the padded region
+    lags2[1, 4:] = 77.0
+    ll2, s2 = mx.nd.hawkesll(lda, alpha, beta, state, mx.nd.array(lags2),
+                             marks, vl, tmax)
+    onp.testing.assert_allclose(ll1.asnumpy(), ll2.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(s1.asnumpy(), s2.asnumpy(), rtol=1e-6)

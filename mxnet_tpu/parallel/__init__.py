@@ -12,7 +12,7 @@ jitted program over a named ``jax.sharding.Mesh``:
 - :mod:`moe`        — expert parallelism (absent upstream)
 - :mod:`pipeline`   — GPipe-style pipeline stages over ``pp``
 """
-from . import collectives, mesh, moe, pipeline, ring_attention, sharding, train
+from . import collectives, elastic, mesh, moe, pipeline, ring_attention, sharding, train
 from .collectives import (all_gather, all_reduce, all_to_all, broadcast_from,
                           ppermute, reduce_scatter, ring_shift, run_sharded)
 from .mesh import AXIS_NAMES, auto_mesh, current_mesh, make_mesh, mesh_scope, set_mesh
@@ -23,6 +23,7 @@ from .ring_attention import ring_attention, ring_attention_sharded
 from .sharding import (PartitionSpec, ShardingPlan, constraint, fsdp_plan,
                        replicated_plan, shard_array, tensor_parallel_plan)
 from .train import ShardedTrainer, functional_call
+from .elastic import CheckpointManager, HeartbeatMonitor, run_elastic
 
 __all__ = [
     "AXIS_NAMES", "auto_mesh", "current_mesh", "make_mesh", "mesh_scope",
@@ -32,5 +33,6 @@ __all__ = [
     "broadcast_from", "run_sharded", "ring_attention",
     "ring_attention_sharded", "moe_layer", "top_k_gating", "pipeline_apply",
     "pipelined", "stack_stage_params", "HeteroPipeline", "ShardedTrainer",
-    "functional_call",
+    "functional_call", "CheckpointManager", "HeartbeatMonitor",
+    "run_elastic",
 ]

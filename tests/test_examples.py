@@ -1,0 +1,50 @@
+"""Examples stay runnable (reference ships example/ as living docs; these
+smoke-run each script in a subprocess on the virtual CPU mesh)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert proc.returncode == 0, (
+        f"{script} failed:\nstdout:{proc.stdout[-2000:]}\n"
+        f"stderr:{proc.stderr[-2000:]}")
+    assert "OK" in proc.stdout
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_mnist_example():
+    out = _run("example/gluon/train_mnist.py", "--epochs", "1",
+               "--batch-size", "32")
+    assert "accuracy=" in out
+
+
+@pytest.mark.slow
+def test_spmd_resnet_example(tmp_path):
+    out = _run("example/distributed_training/train_resnet_spmd.py",
+               "--dp", "8", "--steps", "4", "--batch-size", "16",
+               "--checkpoint-dir", str(tmp_path / "ck"))
+    assert "mesh: dp=8" in out
+
+
+@pytest.mark.slow
+def test_bert_elastic_example(tmp_path):
+    out = _run("example/bert/pretrain_bert.py", "--tp", "2", "--dp", "4",
+               "--steps", "4", "--checkpoint-dir", str(tmp_path / "ck"))
+    assert "restarts" in out
+
+
+# example/extensions/custom_op_ext.py is loaded (not executed) by
+# tests/test_extensions.py — the MXLoadLib analog exercises it there.

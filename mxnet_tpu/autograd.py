@@ -137,9 +137,12 @@ class TapeNode:
         "out_shapes",
         "out_dtypes",
         "name",
+        "fn",
+        "input_vals",
     )
 
-    def __init__(self, vjp_fn, inputs, num_outputs, out_shapes, out_dtypes, name=""):
+    def __init__(self, vjp_fn, inputs, num_outputs, out_shapes, out_dtypes,
+                 name="", fn=None, input_vals=None):
         with _node_counter_lock:
             _node_counter[0] += 1
             self.nid = _node_counter[0]
@@ -149,6 +152,14 @@ class TapeNode:
         self.out_shapes = out_shapes
         self.out_dtypes = out_dtypes
         self.name = name
+        # pure callable raw-arrays -> raw output(s); enables graph REPLAY
+        # for create_graph (higher-order) gradients.  None for nodes whose
+        # forward isn't a pure function of its inputs (custom Function).
+        self.fn = fn
+        # raw input arrays AT RECORD TIME: replay must see the values the
+        # op actually consumed, not whatever the NDArrays hold later
+        # (mutation-as-replacement can swap _data between record and grad)
+        self.input_vals = input_vals
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -270,19 +281,129 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             h._ag_node = None
 
 
+def _collect_subgraph(heads, variables=()) -> List[TapeNode]:
+    """Tape nodes reachable from heads WITHOUT passing through a
+    requested variable, ascending nid (creation order = a valid
+    topological order).  Stopping at variables keeps nodes upstream of
+    the differentiation cut out of the replay — they are constants there,
+    and may legitimately be un-replayable (custom Function nodes)."""
+    var_ids = {id(v) for v in variables}
+    seen: Dict[int, TapeNode] = {}
+    stack = [h._ag_node for h in heads
+             if id(h) not in var_ids
+             and getattr(h, "_ag_node", None) is not None]
+    while stack:
+        node = stack.pop()
+        if node.nid in seen:
+            continue
+        seen[node.nid] = node
+        for arr in node.inputs:
+            if id(arr) in var_ids:
+                continue            # the variable is a replay input — cut
+            sub = getattr(arr, "_ag_node", None)
+            if sub is not None and sub.nid not in seen:
+                stack.append(sub)
+    return [seen[k] for k in sorted(seen)]
+
+
+def _build_pure(heads, variables):
+    """Reconstruct the heads' computation as a PURE function of the
+    variables' raw arrays by replaying recorded node fns in creation
+    order.  Everything not in ``variables`` enters as a constant — the
+    value captured when the op was RECORDED (node.input_vals), so later
+    mutation of those arrays cannot skew the replay.  This is what makes
+    ``create_graph=True`` possible on an eager tape: the replayed
+    function can be re-differentiated by jax to any order.
+    """
+    nodes = _collect_subgraph(heads, variables)
+    for n in nodes:
+        if n.fn is None:
+            raise NotImplementedError(
+                f"create_graph through node '{n.name}' (a custom "
+                "autograd.Function) is not supported: its forward is not "
+                "recorded as a pure function")
+    var_ids = {id(v): i for i, v in enumerate(variables)}
+    replayed = {n.nid for n in nodes}
+
+    def value_of(arr, env, var_vals, recorded=None):
+        if id(arr) in var_ids:
+            return var_vals[var_ids[id(arr)]]
+        node = getattr(arr, "_ag_node", None)
+        if node is not None and node.nid in replayed:
+            return env[(node.nid, arr._ag_out_index)]
+        return recorded if recorded is not None else arr._data
+
+    def pure(*var_vals):
+        env = {}
+        for n in nodes:
+            vals = n.input_vals or [None] * len(n.inputs)
+            ins = [value_of(a, env, var_vals, recorded=vals[j])
+                   for j, a in enumerate(n.inputs)]
+            out = n.fn(*ins)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for i, o in enumerate(outs):
+                env[(n.nid, i)] = o
+        return tuple(value_of(h, env, var_vals) for h in heads)
+
+    return pure
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Functional-style gradient (reference ``python/mxnet/autograd.py:272``).
 
     Returns gradients of heads w.r.t. ``variables`` without touching ``.grad``
-    buffers.  ``create_graph=True`` (higher-order) is not yet supported on the
-    imperative tape — use ``mx.np``/jax transforms for higher-order needs.
+    buffers.  ``create_graph=True`` replays the recorded subgraph as a pure
+    function and dispatches its gradient through the recording machinery, so
+    the returned grads are themselves tape-connected (differentiable to any
+    order — each grad node carries its own pure fn for further replay).
     """
     if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the imperative tape is not supported yet; "
-            "use hybridized blocks + jax.grad composition instead"
-        )
+        import jax as _jax
+        import jax.numpy as jnp
+
+        from .ndarray import ndarray as _nd
+
+        heads_l = heads if isinstance(heads, (list, tuple)) else [heads]
+        single = not isinstance(variables, (list, tuple))
+        vars_l = [variables] if single else list(variables)
+        hg_l = (head_grads if isinstance(head_grads, (list, tuple))
+                else [head_grads] * len(heads_l))
+        pure = _build_pure(heads_l, vars_l)
+        cts = tuple(
+            jnp.ones(h.shape, h._data.dtype) if g is None
+            else (g._data if hasattr(g, "_data") else jnp.asarray(g))
+            for h, g in zip(heads_l, hg_l))
+
+        def g_fn(*var_vals):
+            _, vjp = _jax.vjp(pure, *var_vals)
+            return vjp(cts)
+
+        var_arrays = [v._data for v in vars_l]
+        record = is_recording()
+        if record:
+            raw_out, vjp2 = _jax.vjp(g_fn, *var_arrays)
+        else:
+            raw_out = g_fn(*var_arrays)
+        outs = [_nd._wrap(o, v._ctx) for o, v in zip(raw_out, vars_l)]
+        if record:
+            def vjp2_shim(cts, _v=vjp2):
+                # g_fn returns a tuple even for one variable; the tape
+                # passes a bare cotangent when num_outputs == 1
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                return _v(cts)
+
+            node = TapeNode(
+                vjp2_shim, list(vars_l), len(outs),
+                [tuple(o.shape) for o in raw_out],
+                [o.dtype for o in raw_out], name="autograd_grad", fn=g_fn,
+                input_vals=list(var_arrays))
+            for i, o in enumerate(outs):
+                o._ag_node = node
+                o._ag_out_index = i
+        return outs[0] if single else outs
+
     if not isinstance(heads, (list, tuple)):
         heads = [heads]
     single = not isinstance(variables, (list, tuple))

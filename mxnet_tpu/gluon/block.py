@@ -512,6 +512,13 @@ class HybridBlock(Block):
                 pcts, icts = _vjp((cts, mct))
                 return tuple(list(pcts) + list(icts))
 
+            def node_fn(*flat, _fn=fn, _np=len(names)):
+                # replayable pure fn over the flat node_inputs layout
+                # (params then data); mutated aux state is dropped — only
+                # the differentiable outputs are replayed
+                outs, _muts = _fn(list(flat[:_np]), list(flat[_np:]))
+                return tuple(outs)
+
             node = autograd.TapeNode(
                 node_vjp,
                 node_inputs,
@@ -519,6 +526,8 @@ class HybridBlock(Block):
                 [tuple(o.shape) for o in out_arrays],
                 [o.dtype for o in out_arrays],
                 name=type(self).__name__,
+                fn=node_fn,
+                input_vals=list(param_arrays) + list(input_arrays),
             )
             out_nd = []
             for i, o in enumerate(out_arrays):
@@ -715,10 +724,14 @@ class SymbolBlock(HybridBlock):
                 pcts, icts = _vjp(cts)
                 return tuple(list(pcts) + list(icts))
 
+            def node_fn(*flat, _fn=fn, _np=len(names)):
+                return tuple(_fn(list(flat[:_np]), list(flat[_np:])))
+
             node = autograd.TapeNode(
                 node_vjp, node_inputs, len(raw),
                 [tuple(o.shape) for o in raw], [o.dtype for o in raw],
-                name="SymbolBlock")
+                name="SymbolBlock", fn=node_fn,
+                input_vals=list(pvals) + list(ivals))
             outs = []
             for i, o in enumerate(raw):
                 w = _wrap(o, ctx)

@@ -654,6 +654,8 @@ def invoke(
             [tuple(o.shape) for o in outs_raw],
             [o.dtype for o in outs_raw],
             name=schema.name,
+            fn=fn,
+            input_vals=list(arrays),
         )
         for i, o in enumerate(outputs):
             o._ag_node = node

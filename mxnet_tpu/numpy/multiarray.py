@@ -142,10 +142,17 @@ def apply_np(jfn, name, args, kwargs, cls=None):
         outs: list = []
         _out_leaves(out, outs)
         if outs:
+            def flat_fn(*arrs, _fn=fn):
+                # replayable pure fn: flatten any nested output structure
+                # into the same leaf order the tape records
+                import jax as _jax
+
+                return tuple(_jax.tree_util.tree_leaves(_fn(*arrs)))
+
             node = autograd.TapeNode(
                 vjp_fn, leaves, len(outs),
                 [o.shape for o in outs], [o._data.dtype for o in outs],
-                name=name)
+                name=name, fn=flat_fn, input_vals=list(arrays))
             # vjp_fn returns cotangents for *all* leaves given cotangents for
             # the full raw output structure; reshape through a shim so slots
             # line up when the output is a tuple

@@ -11,6 +11,11 @@ full score matrix; this is the TPU-first replacement, not a translation.
 
 Off-TPU the kernels run under the Pallas interpreter (slow but exact) so
 the CPU test suite validates the same code path that runs on hardware.
+
+TPU lowering constraints honored throughout (Mosaic requires the last two
+block dims divisible by (8, 128) or equal to the array dims): softmax
+stats (m/l/lse/delta) are carried as COLUMN vectors with a trailing unit
+dim — block (block_q, 1) passes because 1 == the array's own last dim.
 """
 from __future__ import annotations
 
@@ -49,7 +54,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
         num_kb_eff = num_kb
 
     def body(ki, carry):
-        acc, m_prev, l_prev = carry
+        acc, m_prev, l_prev = carry                     # stats: (block_q, 1)
         k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = q @ k.T                                     # (block_q, block_k)
@@ -59,21 +64,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_cur = jnp.max(s, axis=-1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + p.sum(-1)
-        acc = acc * alpha[:, None] + p @ v
+        l_new = l_prev * alpha + p.sum(-1, keepdims=True)
+        acc = acc * alpha + p @ v
         return acc, m_new, l_new
 
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, num_kb_eff, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)   # (block_q, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -86,8 +91,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0]                                    # (block_q, 1)
+    delta = delta_ref[0]                                # (block_q, 1)
     d = q.shape[-1]
     num_kb_eff = ((qi + 1) * block_q // block_k) if causal \
         else seq_len // block_k
@@ -102,9 +107,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = do @ v.T
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         return dq + ds @ k
 
     dq = jax.lax.fori_loop(0, num_kb_eff, body,
@@ -125,8 +130,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]     # (block_q, 1)
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]
         s = (q @ k.T) * sm_scale                        # (block_q, block_k)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -134,10 +139,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dv = dv + p.T @ do
         dp = do @ v.T
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         dk = dk + ds.T @ q
         return dk, dv
 
@@ -176,11 +181,11 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -189,7 +194,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
 
 def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
     bh, s, d = q.shape
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)                       # (bh, s, 1)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                           block_k=block_k, causal=causal, block_q=block_q,
@@ -200,8 +206,8 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
@@ -217,8 +223,8 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s), lambda b, i: (b, 0)),
-            pl.BlockSpec((1, s), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, s, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, 1), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),

@@ -1,0 +1,219 @@
+"""Deeper autograd + exception-propagation scenarios.
+
+Reference analogs: tests/python/unittest/test_autograd.py (grad-of-graph,
+retain_graph, create_graph higher-order), test_exc_handling.py (async
+errors surface at sync points; NaiveEngine surfaces them at the op).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_autograd_grad_function():
+    """autograd.grad returns grads without touching .grad attributes."""
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    (gx,) = autograd.grad(y, [x])
+    onp.testing.assert_allclose(gx.asnumpy(), 2 * x.asnumpy())
+
+
+def test_second_order_gradient():
+    """grad of grad: d2/dx2 (x^3) = 6x (reference create_graph=True)."""
+    x = nd.array([1.0, 2.0, 4.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        (gx,) = autograd.grad(y, [x], create_graph=True)
+        z = gx.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_retain_graph_double_backward():
+    x = nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    first = x.grad.asnumpy().copy()
+    y.backward()                       # second pass must still work
+    onp.testing.assert_allclose(first, 2 * x.asnumpy())
+
+
+def test_train_vs_predict_mode_dropout():
+    """Dropout drops under train_mode and is identity under predict_mode
+    (reference autograd train_mode/predict_mode scopes)."""
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dropout(0.5)
+    x = nd.ones((200,))
+    with autograd.record(train_mode=True):
+        out_train = net(x)
+    with autograd.record(train_mode=False):
+        out_pred = net(x)
+    assert (out_train.asnumpy() == 0).any(), "train mode must drop"
+    onp.testing.assert_allclose(out_pred.asnumpy(), x.asnumpy())
+
+
+def test_grad_through_custom_function_twice():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array([3.0, 4.0])
+    x.attach_grad()
+    f = Square()
+    with autograd.record():
+        y = f(x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_grad_req_null_parameter():
+    """grad_req='null' params get no gradient and don't break backward."""
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad(grad_req="null")
+    with autograd.record():
+        y = (a * b).sum()
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# exception propagation (reference test_exc_handling.py): async dispatch
+# defers errors to the sync point; NaiveEngine surfaces them at the op
+# ---------------------------------------------------------------------------
+
+def test_invalid_op_args_raise():
+    a = nd.ones((2, 3))
+    b = nd.ones((4, 5))
+    with pytest.raises(Exception):
+        nd.dot(a, b).asnumpy()        # shape mismatch surfaces at/by sync
+
+
+def test_error_surfaces_at_sync_not_lost():
+    """An invalid argument combination must raise, not silently produce
+    garbage, whether or not a sync follows immediately."""
+    a = nd.ones((2, 3))
+    with pytest.raises(Exception):
+        out = nd.reshape(a, shape=(7, 7))   # impossible reshape
+        out.wait_to_read()
+
+
+def test_naive_engine_surfaces_at_op(monkeypatch):
+    """With MXNET_ENGINE_TYPE=NaiveEngine every op is synchronous, so the
+    raise happens at the faulting call itself (reference NaiveEngine
+    debugging contract)."""
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    from mxnet_tpu import engine
+
+    assert engine.is_naive()
+    a = nd.ones((2, 3))
+    with pytest.raises(Exception):
+        nd.dot(a, nd.ones((4, 5)))    # no sync needed
+
+
+def test_exception_inside_record_leaves_state_clean():
+    """A raising op inside record() must not leave the tape recording."""
+    x = nd.array([1.0])
+    x.attach_grad()
+    try:
+        with autograd.record():
+            nd.dot(nd.ones((2, 3)), nd.ones((4, 5)))
+    except Exception:
+        pass
+    assert not autograd.is_recording()
+    # a fresh record still works
+    with autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_third_order_gradient():
+    """The grad node carries its own pure fn, so replay recurses:
+    d3/dx3 (x^4) = 24x."""
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        (g1,) = autograd.grad(y, [x], create_graph=True)
+        (g2,) = autograd.grad(g1.sum(), [x], create_graph=True)
+        z = g2.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 24 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_second_order_through_hybridized_block():
+    """create_graph replays through a hybridized (whole-graph jitted)
+    block node: d2/dx2 sum(Dense(x)^2) = 2*W^T W diag contributions."""
+    net = mx.gluon.nn.Dense(3, use_bias=False)
+    net.initialize()
+    net(nd.ones((2, 4)))
+    net.hybridize()
+    net(nd.ones((2, 4)))                    # build the cached op
+    x = nd.array(onp.random.RandomState(0).rand(2, 4).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = (net(x) ** 2).sum()
+        (gx,) = autograd.grad(y, [x], create_graph=True)
+        z = (gx ** 2).sum()
+    z.backward()
+    W = net.weight.data().asnumpy()
+    # gx = 2 x W^T W ; z = ||gx||^2 ; dz/dx = 2 gx (2 W^T W) = 8 x (W^T W)^2
+    WtW = W.T @ W
+    expect = 8 * x.asnumpy() @ (WtW @ WtW)
+    onp.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-4)
+
+
+def test_create_graph_constant_mutation_isolation():
+    """Replay must see constants as they were at RECORD time; mutating a
+    non-variable input afterwards must not change the gradient (regression:
+    value_of once read live _data)."""
+    x = nd.array([1.0, 1.0])
+    c = nd.array([3.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * c).sum()
+        c[:] = 0.0                    # mutate AFTER the op recorded
+        (gx,) = autograd.grad(y, [x], create_graph=True)
+    onp.testing.assert_allclose(gx.asnumpy(), [3.0, 3.0])
+    (gx_ref,) = autograd.grad(y, [x])
+    onp.testing.assert_allclose(gx.asnumpy(), gx_ref.asnumpy())
+
+
+def test_create_graph_cuts_at_variables():
+    """A custom Function UPSTREAM of the variable is off the replay path
+    and must not trip the pure-replay check (regression: _collect_subgraph
+    once walked through variables)."""
+    class Cube(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 3 * x * x * dy
+
+    w = nd.array([2.0])
+    w.attach_grad()
+    with autograd.record():
+        t = Cube()(w)                  # un-replayable node
+        u = t + 0.0
+        y = (u * u).sum()
+        (gu,) = autograd.grad(y, [u], create_graph=True)  # cut at u
+        z = gu.sum()
+    z.backward()                       # d(2u)/du = 2, flows back through u
+    onp.testing.assert_allclose(gu.asnumpy(), 2 * u.asnumpy(), rtol=1e-6)

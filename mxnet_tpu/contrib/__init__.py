@@ -1,4 +1,5 @@
 """``mx.contrib`` (reference ``python/mxnet/contrib/``)."""
+from . import aot
 from . import onnx
 from . import quantization
 from . import text

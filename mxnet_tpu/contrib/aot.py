@@ -1,0 +1,141 @@
+"""Ahead-of-time compiled model artifacts (StableHLO export).
+
+Reference analog: the TensorRT subgraph backend
+(``python/mxnet/contrib/tensorrt.py``,
+``src/operator/subgraph/tensorrt/nnvm_to_onnx.cc``) — hand the inference
+graph to an engine-specific compiler and ship the compiled artifact.  On
+TPU the engine compiler is XLA itself, so the TPU-native answer is:
+serialize the hybridized forward as portable **StableHLO** plus the
+parameters, and reload/run it anywhere a JAX runtime exists — no model
+code, no framework Python classes, versioned IR stability guaranteed by
+StableHLO.
+
+    from mxnet_tpu.contrib import aot
+    aot.export_block(net, example, "model.mxa")     # after net(example)
+    run = aot.load("model.mxa")
+    y = run(x)                                      # numpy/jax array in/out
+
+The artifact also serves the reference's `HybridBlock.export` role for
+deployment, with a stronger contract: `SymbolBlock.imports` needs this
+framework to rebuild the graph; an `.mxa` needs only jax.
+
+Format: a zip archive (``header.json`` + ``model.stablehlo`` +
+``params.npz``) — a pure data container, deliberately NOT pickle, so
+loading an untrusted artifact cannot execute code.  The batch (leading)
+dimension is exported symbolically by default, so one artifact serves any
+batch size; the remaining dimensions are static (XLA's compilation
+model).
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Dict
+
+import numpy as onp
+
+__all__ = ["export_block", "load", "AOT_FORMAT_VERSION"]
+
+AOT_FORMAT_VERSION = 2
+
+
+def export_block(block, example_input, path: str, *, platforms=None,
+                 polymorphic_batch: bool = True) -> str:
+    """Serialize ``block``'s inference forward to StableHLO + params.
+
+    ``block`` must have run at least one forward (all parameter shapes
+    known — uninitialized deferred-shape parameters raise).  With
+    ``polymorphic_batch`` (default) the example's leading dimension is
+    exported as a symbolic size so the artifact serves any batch; other
+    dimensions are compiled statically.  ``platforms``: optional list like
+    ["tpu", "cpu"] to pin lowering targets.
+    """
+    import jax
+    from jax import export as jexport
+
+    from ..ndarray import NDArray
+    from ..parallel.train import functional_call
+
+    # p.data() raises a clear "not initialized" error for deferred-shape
+    # params; silently skipping them would bake trace-time random inits
+    # into the StableHLO as constants (a silently-wrong artifact)
+    params = {n: p.data()._data for n, p in block.collect_params().items()}
+    x = example_input._data if isinstance(example_input, NDArray) \
+        else onp.asarray(example_input)
+
+    def fwd(param_arrays: Dict[str, Any], data):
+        out, _mut = functional_call(block, param_arrays, (data,),
+                                    training=False)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data if isinstance(o, NDArray) else o
+                         for o in out)
+        return out._data if isinstance(out, NDArray) else out
+
+    if polymorphic_batch and getattr(x, "ndim", 0) >= 1:
+        (b,) = jexport.symbolic_shape("b")
+        in_shape = (b,) + tuple(x.shape[1:])
+    else:
+        in_shape = tuple(x.shape)
+
+    kwargs = {}
+    if platforms is not None:
+        kwargs["platforms"] = tuple(platforms)
+    exported = jexport.export(jax.jit(fwd), **kwargs)(
+        {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+         for n, a in params.items()},
+        jax.ShapeDtypeStruct(in_shape, x.dtype))
+
+    header = {
+        "format_version": AOT_FORMAT_VERSION,
+        "input_shape": ["b" if polymorphic_batch else int(x.shape[0])]
+        + [int(d) for d in x.shape[1:]],
+        "input_dtype": str(x.dtype),
+        "param_names": sorted(params),
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("header.json", json.dumps(header))
+        zf.writestr("model.stablehlo", exported.serialize())
+        buf = io.BytesIO()
+        onp.savez(buf, **{n: onp.asarray(a) for n, a in params.items()})
+        zf.writestr("params.npz", buf.getvalue())
+    return path
+
+
+class _AOTModel:
+    """Loaded artifact: a callable closed over the deserialized StableHLO
+    computation and the parameter arrays."""
+
+    def __init__(self, header, stablehlo: bytes, params):
+        from jax import export as jexport
+
+        self.format_version = header["format_version"]
+        self.input_shape = header["input_shape"]
+        self.input_dtype = header["input_dtype"]
+        self._params = params
+        self._exported = jexport.deserialize(stablehlo)
+
+    def __call__(self, data):
+        from ..ndarray import NDArray
+
+        if isinstance(data, NDArray):
+            data = data._data
+        return self._exported.call(self._params, data)
+
+
+def load(path: str) -> _AOTModel:
+    """Load an .mxa artifact.  The container is plain data (zip of JSON +
+    StableHLO bytes + npz) — no code execution on load, safe for
+    untrusted files."""
+    with zipfile.ZipFile(path, "r") as zf:
+        header = json.loads(zf.read("header.json"))
+        ver = header.get("format_version")
+        if ver != AOT_FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported .mxa format version {ver} "
+                f"(this build reads {AOT_FORMAT_VERSION})")
+        stablehlo = zf.read("model.stablehlo")
+        npz = onp.load(io.BytesIO(zf.read("params.npz")),
+                       allow_pickle=False)
+        params = {n: npz[n] for n in npz.files}
+    return _AOTModel(header, stablehlo, params)

@@ -1,0 +1,126 @@
+"""AOT StableHLO export/load (contrib/aot.py — the TensorRT-backend
+analog: XLA is the engine compiler, StableHLO the shipped artifact)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import aot
+
+
+def _mlp():
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"), mx.gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_export_load_roundtrip_mlp(tmp_path):
+    net = _mlp()
+    x = mx.nd.array(onp.random.RandomState(0).rand(2, 8).astype("float32"))
+    ref = net(x).asnumpy()
+    p = aot.export_block(net, x, str(tmp_path / "m.mxa"))
+    run = aot.load(p)
+    onp.testing.assert_allclose(onp.asarray(run(x)), ref, rtol=1e-6)
+    # numpy input works too (no framework objects needed at serve time)
+    onp.testing.assert_allclose(onp.asarray(run(x.asnumpy())), ref,
+                                rtol=1e-6)
+
+
+def test_export_load_conv_model(tmp_path):
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Conv2D(4, kernel_size=3, activation="relu"),
+            mx.gluon.nn.MaxPool2D(2),
+            mx.gluon.nn.Flatten(),
+            mx.gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.RandomState(1).rand(2, 3, 8, 8)
+                    .astype("float32"))
+    ref = net(x).asnumpy()
+    p = aot.export_block(net, x, str(tmp_path / "conv.mxa"))
+    out = aot.load(p)(x)
+    onp.testing.assert_allclose(onp.asarray(out), ref, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_load_rejects_unknown_version(tmp_path):
+    import json
+    import zipfile
+
+    path = tmp_path / "bad.mxa"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("header.json", json.dumps({"format_version": 999}))
+    with pytest.raises(ValueError, match="format version"):
+        aot.load(str(path))
+
+
+def test_polymorphic_batch(tmp_path):
+    """One artifact serves any batch size (symbolic leading dim)."""
+    net = _mlp()
+    x2 = mx.nd.array(onp.random.RandomState(3).rand(2, 8).astype("float32"))
+    net(x2)
+    p = aot.export_block(net, x2, str(tmp_path / "m.mxa"))
+    run = aot.load(p)
+    for bs in (1, 2, 16):
+        xb = onp.random.RandomState(bs).rand(bs, 8).astype("float32")
+        ref = net(mx.nd.array(xb)).asnumpy()
+        onp.testing.assert_allclose(onp.asarray(run(xb)), ref, rtol=1e-5,
+                                    atol=1e-6)
+
+
+def test_export_uninitialized_raises(tmp_path):
+    """Deferred-shape params that never materialized must raise, not be
+    silently baked into the graph as trace-time constants."""
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()                      # no forward: weight shape unknown
+    x = mx.nd.ones((2, 8))
+    with pytest.raises(Exception, match="[Ii]nit"):
+        aot.export_block(net, x, str(tmp_path / "m.mxa"))
+
+
+def test_artifact_is_not_pickle(tmp_path):
+    """.mxa is a plain-data zip: loading must never unpickle."""
+    import zipfile
+
+    net = _mlp()
+    x = mx.nd.ones((2, 8))
+    net(x)
+    p = aot.export_block(net, x, str(tmp_path / "m.mxa"))
+    assert zipfile.is_zipfile(p)
+    names = set(zipfile.ZipFile(p).namelist())
+    assert names == {"header.json", "model.stablehlo", "params.npz"}
+
+
+def test_artifact_runs_without_model_code(tmp_path):
+    """The serve side needs only jax: deserialize + call in a subprocess
+    that never imports the model class."""
+    import subprocess
+    import sys
+
+    net = _mlp()
+    x = mx.nd.array(onp.random.RandomState(2).rand(2, 8).astype("float32"))
+    ref = net(x).asnumpy()
+    p = aot.export_block(net, x, str(tmp_path / "m.mxa"))
+    onp.save(tmp_path / "x.npy", x.asnumpy())
+    onp.save(tmp_path / "ref.npy", ref)
+    code = f"""
+import io, json, zipfile, numpy as onp
+from jax import export as jexport
+zf = zipfile.ZipFile({str(p)!r})
+fn = jexport.deserialize(zf.read("model.stablehlo"))
+npz = onp.load(io.BytesIO(zf.read("params.npz")), allow_pickle=False)
+params = {{n: npz[n] for n in npz.files}}
+x = onp.load({str(tmp_path / 'x.npy')!r})
+out = fn.call(params, x)
+onp.testing.assert_allclose(onp.asarray(out),
+                            onp.load({str(tmp_path / 'ref.npy')!r}),
+                            rtol=1e-6)
+print("SERVE_OK")
+"""
+    import os
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=180, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "SERVE_OK" in r.stdout

@@ -57,7 +57,7 @@ FP32_FUNCS = [
     "linalg_eigvalsh", "linalg_svd", "linalg_qr", "linalg_gelqf",
     "linalg_lstsq", "linalg_solve", "linalg_trmm", "linalg_trsm",
     "linalg_syrk", "linalg_tensorinv", "linalg_matrix_rank",
-    "linalg_norm_np", "linalg_extractdiag", "linalg_makediag",
+    "linalg_norm_np", "linalg_extractdiag", "linalg_makediag", "linalg_syevd",
     "linalg_maketrian", "linalg_extracttrian",
     # spectral / sketching
     "fft", "ifft", "count_sketch",
@@ -156,4 +156,12 @@ FP16_FP32_FUNCS = [
     "reset_arrays", "histogram", "getnnz", "dynamic_reshape",
     "identity_with_attr_like_rhs", "IdentityAttachKLSparseReg",
     "im2col", "col2im", "ROIPooling", "Custom",
+    # device image ops (preprocessing domain)
+    "to_tensor", "image_normalize", "image_resize", "image_crop",
+    "image_random_crop", "image_random_resized_crop",
+    # rroi / graph / sparse
+    "RROIAlign", "edge_id", "sparse_retain",
+    # adamw/lamb/lans mp+multi variants (fp32 master logic internal)
+    "mp_adamw_update", "multi_adamw_update", "multi_mp_adamw_update",
+    "multi_mp_lamb_update", "multi_mp_lans_update",
 ]

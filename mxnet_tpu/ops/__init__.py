@@ -23,6 +23,7 @@ from . import control_flow as _control_flow  # noqa: F401
 from . import rnn as _rnn  # noqa: F401
 from . import nn_extra as _nn_extra  # noqa: F401
 from . import misc as _misc  # noqa: F401
+from . import image_ops as _image_ops  # noqa: F401
 from . import ref_aliases as _ref_aliases  # noqa: F401  (must be last;
 # contrib.quantization registers late — mxnet_tpu/__init__ re-applies)
 

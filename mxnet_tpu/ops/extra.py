@@ -512,3 +512,80 @@ def matmul(a, b):
 alias("max", "amax")
 alias("min", "amin")
 alias("SliceChannel", "slice_channel")
+
+
+@register("RROIAlign", num_inputs=2, aliases=("_contrib_RROIAlign",))
+def rroi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sampling_ratio=2):
+    """Rotated ROI Align (reference src/operator/contrib/rroi_align.cc:149):
+    rois (R, 6) = [batch_index, x_center, y_center, w, h, theta_degrees];
+    the pooled grid is generated in the box frame, rotated by theta about
+    the center, and bilinearly sampled."""
+    ph, pw = pooled_size
+    n, c, H, W = data.shape
+    sr = max(int(sampling_ratio), 1)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale, 1.0)
+        rh = jnp.maximum(roi[4] * spatial_scale, 1.0)
+        theta = roi[5] * jnp.pi / 180.0
+        cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+        # sample points in the box-local frame, sr x sr per bin
+        ys = (jnp.arange(ph * sr) + 0.5) / (ph * sr) - 0.5   # [-.5, .5)
+        xs = (jnp.arange(pw * sr) + 0.5) / (pw * sr) - 0.5
+        ly = ys[:, None] * rh                                # (ph*sr, 1)
+        lx = xs[None, :] * rw                                # (1, pw*sr)
+        gx = cx + lx * cos_t - ly * sin_t                    # rotate
+        gy = cy + lx * sin_t + ly * cos_t
+        gx = jnp.broadcast_to(gx, (ph * sr, pw * sr))
+        gy = jnp.broadcast_to(gy, (ph * sr, pw * sr))
+        # reference rroi_align.cc bilinear_interpolate: sample points
+        # outside [-1, W] x [-1, H] contribute ZERO (not edge replication)
+        valid = ((gx > -1.0) & (gx < W) & (gy > -1.0) & (gy < H))
+        gxc = jnp.clip(gx, 0, W - 1)
+        gyc = jnp.clip(gy, 0, H - 1)
+        x0 = jnp.floor(gxc).astype(jnp.int32)
+        y0 = jnp.floor(gyc).astype(jnp.int32)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        wx = gxc - x0
+        wy = gyc - y0
+        img = data[b]                                        # (c, H, W)
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+               + v10 * wy * (1 - wx) + v11 * wy * wx)        # (c, ph*sr, pw*sr)
+        val = val * valid[None].astype(val.dtype)
+        val = val.reshape(c, ph, sr, pw, sr)
+        return val.mean(axis=(2, 4))                         # (c, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("edge_id", num_inputs=3, differentiable=False,
+          aliases=("_contrib_edge_id",))
+def edge_id(adjacency, u, v):
+    """Edge-id lookup (reference src/operator/contrib/dgl_graph.cc
+    _contrib_edge_id over CSR): ``adjacency`` is a dense adjacency whose
+    entries hold edge-id + 1 (0 = no edge); returns the edge id for each
+    (u[i], v[i]) pair, -1 where absent.  CSR containers densify through
+    ``.todense()`` at the frontend."""
+    vals = adjacency[u.astype(jnp.int32), v.astype(jnp.int32)]
+    return jnp.where(vals > 0, vals - 1, -1).astype(jnp.int64)
+
+
+@register("sparse_retain", num_inputs=2, differentiable=False,
+          aliases=("_sparse_retain",))
+def sparse_retain(data, indices):
+    """Keep only the listed rows, zero the rest (reference
+    src/operator/tensor/sparse_retain.cc over row_sparse; dense layout
+    here — the row_sparse container wraps this at the NDArray level)."""
+    keep = jnp.zeros((data.shape[0],), jnp.bool_).at[
+        indices.astype(jnp.int32)].set(True)
+    shape = (-1,) + (1,) * (data.ndim - 1)
+    return jnp.where(keep.reshape(shape), data, 0)

@@ -1,0 +1,127 @@
+"""Device-side image operators (reference ``src/operator/image/`` —
+``_image_to_tensor``/``_image_normalize``/``_image_resize``/``_image_crop``
+and random variants).  ``mxnet_tpu/image.py`` keeps the host-side
+decode/augment pipeline; these run on-device inside graphs (e.g. a
+normalize folded into the first conv by XLA).
+
+Layout convention follows the reference: HWC (or NHWC) uint8/float in,
+``to_tensor`` produces CHW float scaled to [0, 1].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("to_tensor", num_inputs=1, aliases=("_image_to_tensor",))
+def to_tensor(data):
+    """HWC [0,255] -> CHW float32 [0,1] (reference image/totensor-inl.h);
+    batched NHWC -> NCHW."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return x.transpose(2, 0, 1)
+    return x.transpose(0, 3, 1, 2)
+
+
+@register("image_normalize", num_inputs=1, aliases=("_image_normalize",))
+def image_normalize(data, mean=(0.0,), std=(1.0,)):
+    """Per-channel (x - mean) / std on CHW / NCHW float input (reference
+    image/normalize_op-inl.h)."""
+    c_axis = 0 if data.ndim == 3 else 1
+    shape = [1] * data.ndim
+    shape[c_axis] = -1
+    m = jnp.asarray(mean, data.dtype).reshape(shape)
+    s = jnp.asarray(std, data.dtype).reshape(shape)
+    return (data - m) / s
+
+
+def _resize_hwc(img, size_wh, interp):
+    w, h = size_wh
+    method = "linear" if interp == 1 else "nearest"
+    return jax.image.resize(img, (h, w) + img.shape[2:], method=method)
+
+
+@register("image_resize", num_inputs=1, aliases=("_image_resize",))
+def image_resize(data, size=(0, 0), keep_ratio=False, interp=1):
+    """Resize HWC/NHWC (reference image/resize-inl.h).  ``size``: (w, h)
+    or a single int (shorter edge when keep_ratio, square otherwise)."""
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = int(size[0]), int(size[1])
+    if data.ndim == 3:
+        H, W = data.shape[:2]
+    else:
+        H, W = data.shape[1:3]
+    if keep_ratio:
+        short = min(H, W)
+        scale = w / short          # single-int semantics: shorter edge
+        h, w = int(round(H * scale)), int(round(W * scale))
+    method = "linear" if interp == 1 else "nearest"
+    if data.ndim == 3:
+        out_shape = (h, w, data.shape[2])
+    else:
+        out_shape = (data.shape[0], h, w, data.shape[3])
+    return jax.image.resize(data.astype(jnp.float32), out_shape,
+                            method=method).astype(data.dtype)
+
+
+@register("image_crop", num_inputs=1, aliases=("_image_crop",))
+def image_crop(data, x=0, y=0, width=1, height=1):
+    """Fixed crop at (x, y) of size (width, height), HWC/NHWC (reference
+    image/crop-inl.h)."""
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width, :]
+    return data[:, y:y + height, x:x + width, :]
+
+
+@register("image_random_crop", num_inputs=2, differentiable=False,
+          aliases=("_image_random_crop",))
+def image_random_crop(data, key, width=1, height=1):
+    """Uniform-position crop; explicit PRNG key input (counter-based
+    randomness — the functional analog of the reference's resource-pool
+    RNG)."""
+    jkey = key.reshape(-1)[:2].astype(jnp.uint32)   # raw threefry key
+    if data.ndim == 3:
+        H, W = data.shape[:2]
+    else:
+        H, W = data.shape[1:3]
+    kx, ky = jax.random.split(jkey)
+    x0 = jax.random.randint(kx, (), 0, max(W - width, 0) + 1)
+    y0 = jax.random.randint(ky, (), 0, max(H - height, 0) + 1)
+    if data.ndim == 3:
+        return jax.lax.dynamic_slice(
+            data, (y0, x0, 0), (height, width, data.shape[2]))
+    return jax.lax.dynamic_slice(
+        data, (0, y0, x0, 0),
+        (data.shape[0], height, width, data.shape[3]))
+
+
+@register("image_random_resized_crop", num_inputs=2, differentiable=False,
+          aliases=("_image_random_resized_crop",))
+def image_random_resized_crop(data, key, width=1, height=1,
+                              area=(0.08, 1.0), ratio=(0.75, 1.333),
+                              interp=1):
+    """Random area/aspect crop then resize to (width, height) — the
+    Inception-style augmentation (reference image/random_resized_crop)."""
+    jkey = key.reshape(-1)[:2].astype(jnp.uint32)   # raw threefry key
+    if data.ndim != 3:
+        raise ValueError("image_random_resized_crop expects HWC input")
+    H, W = data.shape[:2]
+    ka, kr, kx, ky = jax.random.split(jkey, 4)
+    target_area = jax.random.uniform(ka, (), minval=area[0],
+                                     maxval=area[1]) * H * W
+    aspect = jax.random.uniform(kr, (), minval=ratio[0], maxval=ratio[1])
+    cw = jnp.clip(jnp.sqrt(target_area * aspect).astype(jnp.int32), 1, W)
+    ch = jnp.clip(jnp.sqrt(target_area / aspect).astype(jnp.int32), 1, H)
+    x0 = jax.random.randint(kx, (), 0, W).astype(jnp.int32) % jnp.maximum(
+        W - cw + 1, 1)
+    y0 = jax.random.randint(ky, (), 0, H).astype(jnp.int32) % jnp.maximum(
+        H - ch + 1, 1)
+    # dynamic_slice needs static sizes: slice the max window then mask via
+    # resize of the dynamic sub-window using gather coordinates
+    ys = y0 + (jnp.arange(height) * ch // height)
+    xs = x0 + (jnp.arange(width) * cw // width)
+    out = data[ys[:, None], xs[None, :], :]
+    return out.astype(data.dtype)

@@ -108,10 +108,53 @@ def linalg_gelqf(A):
     return (jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2))
 
 
-@register("linalg_maketrian")
+def _tri_n_from_packed(length: int, offset: int) -> int:
+    """Solve n for len = tri(n, offset): n*(n+1)/2 + extra for offset>0,
+    reduced for offset<0 (reference la_op maketrian shape inference)."""
+    k = abs(offset)
+    # packed length of an n x n triangle with diagonal shifted by offset:
+    # lower, offset<=0: (n-k)(n-k+1)/2 ; offset>0: n(n+1)/2 + k*n - k(k+1)/2
+    for n in range(1, 4096):
+        if offset <= 0:
+            m = n - k
+            if m >= 0 and m * (m + 1) // 2 == length:
+                return n
+        else:
+            if n * (n + 1) // 2 + k * n - k * (k + 1) // 2 == length:
+                return n
+    raise ValueError(f"no triangle size matches packed length {length}")
+
+
+@register("linalg_maketrian", aliases=["_linalg_maketrian"])
 def linalg_maketrian(A, offset=0, lower=True):
-    # pack vector into triangular matrix — approximate with square reshape
-    raise NotImplementedError("linalg_maketrian not yet implemented")
+    """Unpack a packed-triangle vector into a triangular matrix (reference
+    src/operator/tensor/la_op.cc maketrian — inverse of extracttrian)."""
+    length = A.shape[-1]
+    n = _tri_n_from_packed(length, offset)
+    if lower:
+        rows, cols = jnp.tril_indices(n, k=offset)
+    else:
+        rows, cols = jnp.triu_indices(n, k=offset)
+    batch = A.shape[:-1]
+    flat = A.reshape((-1, length))
+    out = jnp.zeros((flat.shape[0], n, n), A.dtype)
+    out = out.at[:, rows, cols].set(flat)
+    return out.reshape(batch + (n, n))
+
+
+@register("linalg_extracttrian", aliases=["_linalg_extracttrian"])
+def linalg_extracttrian(A, offset=0, lower=True):
+    """Pack a matrix triangle into a vector (reference la_op.cc
+    extracttrian)."""
+    n = A.shape[-1]
+    if lower:
+        rows, cols = jnp.tril_indices(n, k=offset)
+    else:
+        rows, cols = jnp.triu_indices(n, k=offset)
+    batch = A.shape[:-2]
+    flat = A.reshape((-1, n, n))
+    out = flat[:, rows, cols]
+    return out.reshape(batch + (out.shape[-1],))
 
 
 @register("linalg_solve", num_inputs=2, aliases=["solve"])
@@ -145,6 +188,16 @@ def linalg_eigh(A, UPLO="L"):
 @register("linalg_eigvalsh", aliases=["eigvalsh"])
 def linalg_eigvalsh(A, UPLO="L"):
     return jnp.linalg.eigvalsh(A)
+
+
+@register("linalg_syevd", num_outputs=-1, aliases=["_linalg_syevd"])
+def linalg_syevd(A):
+    """Symmetric eigendecomposition with the REFERENCE's syevd contract
+    (src/operator/tensor/la_op.cc syevd): returns (U, L) where the ROWS of
+    U are the eigenvectors, so A = U^T @ diag(L) @ U — note the reversed
+    output order and transposed layout vs jnp.linalg.eigh's (w, v)."""
+    w, v = jnp.linalg.eigh(A, symmetrize_input=True)
+    return (jnp.swapaxes(v, -1, -2), w)
 
 
 @register("linalg_norm_np", aliases=["np_norm"])

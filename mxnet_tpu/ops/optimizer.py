@@ -537,3 +537,111 @@ def multi_mp_sgd_mom_update(arrays, lrs=(), wds=(), momentum=0.0,
         new_m.append(nm)
         new_w32.append(nw32)
     return tuple(new_w) + tuple(new_m) + tuple(new_w32)
+
+
+@register("mp_adamw_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False, aliases=("_mp_adamw_update",))
+def mp_adamw_update(arrays, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                    wd=0.0, eta=1.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """AdamW against the fp32 master weight (reference _mp_adamw_update):
+    arrays = [weight, grad, mean, var, weight32] ->
+    (weight_cast, mean, var, weight32)."""
+    weight, grad, mean, var, weight32 = arrays[:5]
+    new_w32, new_mean, new_var = adamw_update(
+        [weight32, grad.astype(jnp.float32), mean, var], lr=lr, beta1=beta1,
+        beta2=beta2, epsilon=epsilon, wd=wd, eta=eta,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    return (new_w32.astype(weight.dtype), new_mean, new_var, new_w32)
+
+
+@register("multi_adamw_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False, aliases=("_multi_adamw_update",))
+def multi_adamw_update(arrays, lrs=(), wds=(), etas=(), beta1=0.9,
+                       beta2=0.999, epsilon=1e-8, rescale_grad=1.0,
+                       clip_gradient=-1.0, num_weights=0):
+    """Fused list-AdamW (reference contrib/adamw.cc multi variant):
+    arrays = [w..., g..., m..., v...] -> (w..., m..., v...)."""
+    n = num_weights or len(arrays) // 4
+    ws, gs, ms, vs = (arrays[i * n:(i + 1) * n] for i in range(4))
+    new_w, new_m, new_v = [], [], []
+    for i, (w, g, m, v) in enumerate(zip(ws, gs, ms, vs)):
+        eta = etas[i] if i < len(etas) else 1.0
+        lr = lrs[i] if i < len(lrs) else 0.001
+        wd = wds[i] if i < len(wds) else 0.0
+        nw, nm, nv = adamw_update(
+            [w, g, m, v], lr=lr, beta1=beta1, beta2=beta2,
+            epsilon=epsilon, wd=wd, eta=eta, rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)
+        new_w.append(nw)
+        new_m.append(nm)
+        new_v.append(nv)
+    return tuple(new_w) + tuple(new_m) + tuple(new_v)
+
+
+@register("multi_mp_adamw_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False, aliases=("_multi_mp_adamw_update",))
+def multi_mp_adamw_update(arrays, lrs=(), wds=(), etas=(), beta1=0.9,
+                          beta2=0.999, epsilon=1e-8, rescale_grad=1.0,
+                          clip_gradient=-1.0, num_weights=0):
+    """[w..., g..., m..., v..., w32...] -> (w..., m..., v..., w32...)."""
+    n = num_weights or len(arrays) // 5
+    ws, gs, ms, vs, w32s = (arrays[i * n:(i + 1) * n] for i in range(5))
+    new_w, new_m, new_v, new_w32 = [], [], [], []
+    for i, (w, g, m, v, w32) in enumerate(zip(ws, gs, ms, vs, w32s)):
+        eta = etas[i] if i < len(etas) else 1.0
+        lr = lrs[i] if i < len(lrs) else 0.001
+        wd = wds[i] if i < len(wds) else 0.0
+        nw32, nm, nv = adamw_update(
+            [w32, g.astype(jnp.float32), m, v], lr=lr, beta1=beta1,
+            beta2=beta2, epsilon=epsilon, wd=wd, eta=eta,
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        new_w.append(nw32.astype(w.dtype))
+        new_m.append(nm)
+        new_v.append(nv)
+        new_w32.append(nw32)
+    return tuple(new_w) + tuple(new_m) + tuple(new_v) + tuple(new_w32)
+
+
+@register("multi_mp_lamb_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False, aliases=("_multi_mp_lamb_update",))
+def multi_mp_lamb_update(arrays, learning_rates=(), wds=(), beta1=0.9,
+                         beta2=0.999, epsilon=1e-6, rescale_grad=1.0,
+                         lower_bound=-1.0, upper_bound=-1.0,
+                         clip_gradient=-1.0, bias_correction=True,
+                         step_count=(), num_tensors=0):
+    """Master-weight multi-LAMB: [w..., g..., m..., v..., w32...] ->
+    (w..., m..., v..., w32...) (reference multi_lamb.cc mp variant)."""
+    n = num_tensors or len(arrays) // 5
+    ws, gs, ms, vs, w32s = (arrays[i * n:(i + 1) * n] for i in range(5))
+    packed = multi_lamb_update(
+        list(w32s) + [g.astype(jnp.float32) for g in gs] + list(ms)
+        + list(vs),
+        learning_rates=learning_rates, wds=wds, beta1=beta1, beta2=beta2,
+        epsilon=epsilon, rescale_grad=rescale_grad, lower_bound=lower_bound,
+        upper_bound=upper_bound, clip_gradient=clip_gradient,
+        bias_correction=bias_correction, step_count=step_count,
+        num_tensors=n)
+    nw32, nm, nv = packed[:n], packed[n:2 * n], packed[2 * n:3 * n]
+    casts = tuple(w32.astype(w.dtype) for w, w32 in zip(ws, nw32))
+    return casts + tuple(nm) + tuple(nv) + tuple(nw32)
+
+
+@register("multi_mp_lans_update", num_inputs=-1, num_outputs=-1,
+          differentiable=False, aliases=("_multi_mp_lans_update",))
+def multi_mp_lans_update(arrays, learning_rates=(), wds=(), beta1=0.9,
+                         beta2=0.999, epsilon=1e-6, rescale_grad=1.0,
+                         lower_bound=-1.0, upper_bound=-1.0,
+                         clip_gradient=-1.0, step_count=(), num_tensors=0):
+    """Master-weight multi-LANS, same layout as multi_mp_lamb_update."""
+    n = num_tensors or len(arrays) // 5
+    ws, gs, ms, vs, w32s = (arrays[i * n:(i + 1) * n] for i in range(5))
+    packed = multi_lans_update(
+        list(w32s) + [g.astype(jnp.float32) for g in gs] + list(ms)
+        + list(vs),
+        learning_rates=learning_rates, wds=wds, beta1=beta1, beta2=beta2,
+        epsilon=epsilon, rescale_grad=rescale_grad, lower_bound=lower_bound,
+        upper_bound=upper_bound, clip_gradient=clip_gradient,
+        step_count=step_count, num_tensors=n)
+    nw32, nm, nv = packed[:n], packed[n:2 * n], packed[2 * n:3 * n]
+    casts = tuple(w32.astype(w.dtype) for w, w32 in zip(ws, nw32))
+    return casts + tuple(nm) + tuple(nv) + tuple(nw32)

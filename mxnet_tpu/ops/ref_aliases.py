@@ -22,6 +22,24 @@ _CONTRIB = [
     "quantized_fully_connected",
 ]
 
+# reference internal spelling -> canonical name (not _contrib_ prefixed)
+_INTERNAL = {
+    "_arange": "arange", "_eye": "eye", "_full": "full", "_ones": "ones",
+    "_zeros": "zeros", "_zeros_without_dtype": "zeros",
+    "_linspace": "linspace", "_sample_multinomial": "multinomial",
+    "_ravel_multi_index": "ravel_multi_index",
+    "_unravel_index": "unravel_index", "_rnn_param_concat": "concat",
+    "_adamw_update": "adamw_update",
+}
+
+# reference registers linalg ops with a leading underscore
+_LINALG = [
+    "gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
+    "sumlogdiag", "extractdiag", "makediag", "inverse", "det", "slogdet",
+    "gelqf", "maketrian",
+]
+
+
 def apply() -> None:
     """Install aliases for every canonical op currently registered.
     Idempotent; called again after late registrations (e.g.
@@ -31,6 +49,13 @@ def apply() -> None:
         ref = f"_contrib_{name}"
         if find_op(name) is not None and find_op(ref) is None:
             alias(name, ref)
+    for ref, canon in _INTERNAL.items():
+        if find_op(canon) is not None and find_op(ref) is None:
+            alias(canon, ref)
+    for name in _LINALG:
+        canon, ref = f"linalg_{name}", f"_linalg_{name}"
+        if find_op(canon) is not None and find_op(ref) is None:
+            alias(canon, ref)
     # fused RNN op: the reference registers the stateful cuDNN/CPU op as
     # "RNN" (src/operator/rnn.cc:451); the scan lowering here is _rnn_fused
     if find_op("RNN") is None and find_op("_rnn_fused") is not None:

@@ -141,6 +141,7 @@ SPECS = {
     "linalg_syrk": ([_f(4, 6)], {}),
     "linalg_extracttrian": ([_SQ], {}),
     "linalg_makediag": ([_f(5)], {}),
+    "linalg_maketrian": ([_f(15)], {}),
     "linalg_extractdiag": ([_SQ], {}),
     # --- detection ------------------------------------------------------
     "box_iou": ([_R.rand(4, 4).astype(onp.float32),
@@ -264,6 +265,51 @@ SPECS = {
                                onp.float32(1.0).reshape(()),
                                onp.float32(1.0).reshape(()),
                                _f(4, 6)], {}),
+    "linalg_syevd": ([_psd(5)], {}),
+    # --- device image ops ----------------------------------------------
+    "to_tensor": ([(_R.rand(8, 8, 3) * 255).astype(onp.float32)], {}),
+    "image_resize": ([(_R.rand(8, 8, 3) * 255).astype(onp.float32)],
+                     dict(size=(4, 4))),
+    "image_crop": ([(_R.rand(8, 8, 3)).astype(onp.float32)],
+                   dict(x=1, y=2, width=4, height=3)),
+    "image_random_crop": ([(_R.rand(8, 8, 3)).astype(onp.float32),
+                           onp.array([1, 2], onp.uint32)],
+                          dict(width=4, height=4)),
+    "image_random_resized_crop": ([(_R.rand(8, 8, 3)).astype(onp.float32),
+                                   onp.array([3, 4], onp.uint32)],
+                                  dict(width=4, height=4)),
+    # --- rroi / graph / sparse -----------------------------------------
+    "RROIAlign": ([_f(2, 3, 12, 12),
+                   onp.array([[0, 6, 6, 6, 4, 30.0],
+                              [1, 5, 5, 4, 4, -15.0]], onp.float32)],
+                  dict(pooled_size=(2, 2))),
+    "edge_id": ([onp.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], onp.float32),
+                 _i(3, 4), _i(3, 4)], {}),
+    "sparse_retain": ([_f(5, 4), onp.array([0, 3], onp.int32)], {}),
+    # --- adamw variants -------------------------------------------------
+    "mp_adamw_update": ([_f(4, 6), _f(4, 6), _f(4, 6), _f(4, 6) + 0.1,
+                         _f(4, 6)], {}),
+    "multi_adamw_update": ([_f(3), _f(3), _f(3), _f(3), _f(3), _f(3),
+                            _f(3) + 0.1, _f(3) + 0.1],
+                           dict(num_weights=2, lrs=(0.1, 0.1),
+                                wds=(0.0, 0.0))),
+    "multi_mp_adamw_update": ([_f(3), _f(3), _f(3), _f(3), _f(3) + 0.1,
+                               _f(3) + 0.1, _f(3), _f(3),
+                               _f(3), _f(3)],
+                              dict(num_weights=2, lrs=(0.1, 0.1),
+                                   wds=(0.0, 0.0))),
+    "multi_mp_lamb_update": ([_f(3), _f(3), _f(3), _f(3), _f(3),
+                              _f(3), _f(3) + 0.1, _f(3) + 0.1,
+                              _f(3), _f(3)],
+                             dict(num_tensors=2,
+                                  learning_rates=(0.1, 0.1),
+                                  wds=(0.0, 0.0), step_count=(1, 1))),
+    "multi_mp_lans_update": ([_f(3), _f(3), _f(3) + 0.1, _f(3) + 0.1,
+                              _f(3), _f(3), _f(3) + 0.1, _f(3) + 0.1,
+                              _f(3), _f(3)],
+                             dict(num_tensors=2,
+                                  learning_rates=(0.1, 0.1),
+                                  wds=(0.0, 0.0), step_count=(1, 1))),
     # --- quantized breadth ---------------------------------------------
     "calibrate_entropy": ([(_R.rand(512) * 100).astype(onp.float32)], {}),
     "quantized_pooling": ([_R.randint(-127, 127, (2, 3, 8, 8)).astype(

@@ -19,9 +19,7 @@ from op_smoke_specs import SPECS
 
 # Ops whose forward needs external state or is covered by dedicated tests
 # elsewhere (reason documented) — keep this SHORT.
-SKIP = {
-    "linalg_maketrian": "registered as explicit not-implemented guard",
-}
+SKIP = {}
 
 _GEN = onp.random.RandomState(0)
 

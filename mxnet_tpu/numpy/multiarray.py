@@ -185,6 +185,41 @@ class ndarray(NDArray):
 
     __slots__ = ()
 
+    # -- NumPy dispatch protocol (reference numpy_dispatch_protocol.py:
+    # onp.mean(mx_array) etc. stay in the mx world instead of silently
+    # coercing to host numpy through __array__) ---------------------------
+    def __array_function__(self, func, types, args, kwargs):
+        import mxnet_tpu.numpy as _mnp
+
+        target = getattr(_mnp, func.__name__, None)
+        if target is None or not callable(target):
+            return NotImplemented
+        return target(*args, **kwargs)
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        def _host(v):
+            return onp.asarray(v) if isinstance(v, NDArray) else v
+
+        if method != "__call__" or kwargs.get("out") is not None:
+            # host-side path (in-place out=, .reduce/.accumulate/...):
+            # coerce mx arrays via __array__ so e.g. `host += mx_arr`
+            # keeps working as it did before this protocol existed
+            out = kwargs.get("out")
+            if out is not None and any(isinstance(o, NDArray)
+                                       for o in (out if isinstance(
+                                           out, tuple) else (out,))):
+                return NotImplemented  # can't write into a device array
+            return getattr(ufunc, method)(
+                *(_host(i) for i in inputs),
+                **{k: _host(v) for k, v in kwargs.items()})
+        import mxnet_tpu.numpy as _mnp
+
+        target = getattr(_mnp, ufunc.__name__, None)
+        if target is None or not callable(target):
+            return getattr(ufunc, method)(*(_host(i) for i in inputs),
+                                          **kwargs)
+        return target(*inputs, **kwargs)
+
     # -- numpy-flavored overrides ---------------------------------------
     def reshape(self, *shape, order="C", **kwargs):
         if order != "C":

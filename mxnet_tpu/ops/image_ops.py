@@ -115,13 +115,28 @@ def image_random_resized_crop(data, key, width=1, height=1,
     aspect = jax.random.uniform(kr, (), minval=ratio[0], maxval=ratio[1])
     cw = jnp.clip(jnp.sqrt(target_area * aspect).astype(jnp.int32), 1, W)
     ch = jnp.clip(jnp.sqrt(target_area / aspect).astype(jnp.int32), 1, H)
-    x0 = jax.random.randint(kx, (), 0, W).astype(jnp.int32) % jnp.maximum(
-        W - cw + 1, 1)
-    y0 = jax.random.randint(ky, (), 0, H).astype(jnp.int32) % jnp.maximum(
-        H - ch + 1, 1)
-    # dynamic_slice needs static sizes: slice the max window then mask via
-    # resize of the dynamic sub-window using gather coordinates
-    ys = y0 + (jnp.arange(height) * ch // height)
-    xs = x0 + (jnp.arange(width) * cw // width)
-    out = data[ys[:, None], xs[None, :], :]
+    # traced bounds sample uniformly (a modulo fold would bias low offsets)
+    x0 = jax.random.randint(kx, (), 0, jnp.maximum(W - cw + 1, 1))
+    y0 = jax.random.randint(ky, (), 0, jnp.maximum(H - ch + 1, 1))
+    # gather-based resize of the dynamic sub-window (static output shape):
+    # fractional sample coordinates, bilinear when interp == 1
+    fy = y0 + (jnp.arange(height) + 0.5) * ch / height - 0.5
+    fx = x0 + (jnp.arange(width) + 0.5) * cw / width - 0.5
+    if interp == 1:
+        y0i = jnp.clip(jnp.floor(fy), 0, H - 1).astype(jnp.int32)
+        x0i = jnp.clip(jnp.floor(fx), 0, W - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0i + 1, 0, H - 1)
+        x1i = jnp.clip(x0i + 1, 0, W - 1)
+        wy = (jnp.clip(fy, 0, H - 1) - y0i)[:, None, None]
+        wx = (jnp.clip(fx, 0, W - 1) - x0i)[None, :, None]
+        v00 = data[y0i[:, None], x0i[None, :], :]
+        v01 = data[y0i[:, None], x1i[None, :], :]
+        v10 = data[y1i[:, None], x0i[None, :], :]
+        v11 = data[y1i[:, None], x1i[None, :], :]
+        out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+               + v10 * wy * (1 - wx) + v11 * wy * wx)
+    else:
+        ys = jnp.clip(jnp.round(fy), 0, H - 1).astype(jnp.int32)
+        xs = jnp.clip(jnp.round(fx), 0, W - 1).astype(jnp.int32)
+        out = data[ys[:, None], xs[None, :], :]
     return out.astype(data.dtype)

@@ -108,14 +108,16 @@ def linalg_gelqf(A):
     return (jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2))
 
 
-def _tri_n_from_packed(length: int, offset: int) -> int:
-    """Solve n for len = tri(n, offset): n*(n+1)/2 + extra for offset>0,
-    reduced for offset<0 (reference la_op maketrian shape inference)."""
-    k = abs(offset)
-    # packed length of an n x n triangle with diagonal shifted by offset:
-    # lower, offset<=0: (n-k)(n-k+1)/2 ; offset>0: n(n+1)/2 + k*n - k(k+1)/2
+def _tri_n_from_packed(length: int, offset: int, lower: bool) -> int:
+    """Solve n for len = tri(n, offset, lower) (reference la_op maketrian
+    shape inference).  upper with offset k is the mirror of lower with
+    offset -k, so normalize to the lower convention first."""
+    eff = offset if lower else -offset
+    k = abs(eff)
+    # packed length of an n x n LOWER triangle with diagonal shifted:
+    # eff<=0: (n-k)(n-k+1)/2 ; eff>0: n(n+1)/2 + k*n - k(k+1)/2
     for n in range(1, 4096):
-        if offset <= 0:
+        if eff <= 0:
             m = n - k
             if m >= 0 and m * (m + 1) // 2 == length:
                 return n
@@ -130,7 +132,7 @@ def linalg_maketrian(A, offset=0, lower=True):
     """Unpack a packed-triangle vector into a triangular matrix (reference
     src/operator/tensor/la_op.cc maketrian — inverse of extracttrian)."""
     length = A.shape[-1]
-    n = _tri_n_from_packed(length, offset)
+    n = _tri_n_from_packed(length, offset, lower)
     if lower:
         rows, cols = jnp.tril_indices(n, k=offset)
     else:

@@ -36,7 +36,7 @@ _INTERNAL = {
 _LINALG = [
     "gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
     "sumlogdiag", "extractdiag", "makediag", "inverse", "det", "slogdet",
-    "gelqf", "maketrian",
+    "gelqf",
 ]
 
 

@@ -177,3 +177,36 @@ def test_util_scopes():
         return util.is_np_array()
 
     assert f()
+
+
+def test_numpy_dispatch_protocol():
+    """onp.<func>(mx_np_array) dispatches into the mx world instead of
+    coercing to host numpy (reference numpy_dispatch_protocol.py)."""
+    import numpy as onp
+
+    from mxnet_tpu import np as mnp
+
+    x = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    m = onp.mean(x)                       # __array_function__
+    assert isinstance(m, type(x))
+    assert float(m.asnumpy()) == 2.5
+    s = onp.add(x, x)                     # __array_ufunc__
+    assert isinstance(s, type(x))
+    onp.testing.assert_allclose(s.asnumpy(), [[2, 4], [6, 8]])
+    c = onp.concatenate([x, x])
+    assert isinstance(c, type(x)) and c.shape == (4, 2)
+    st = onp.stack([x, x], axis=0)
+    assert isinstance(st, type(x)) and st.shape == (2, 2, 2)
+
+
+def test_numpy_dispatch_interop_fallbacks():
+    """out=/reduce/unknown-ufunc paths fall back to host numpy via
+    __array__ instead of raising (regression: blanket NotImplemented)."""
+    import numpy as onp
+
+    from mxnet_tpu import np as mnp
+
+    a = onp.array([1.0, 2.0])
+    a += mnp.array([1.0, 2.0])            # in-place with out=host array
+    onp.testing.assert_allclose(a, [2.0, 4.0])
+    assert float(onp.add.reduce(mnp.array([1.0, 2.0, 3.0]))) == 6.0

@@ -185,24 +185,33 @@ def bench_int8(model_name: str, batch: int, img: int, steps: int):
     cpu0 = jax.devices("cpu")[0] if jax.default_backend() != "cpu" else None
     rng = onp.random.RandomState(0)
     probe = mx.nd.array(rng.rand(batch, 3, img, img).astype(onp.float32))
+    calib = [mx.nd.array(rng.rand(batch, 3, img, img).astype(onp.float32))
+             for _ in range(2)]
+    # shape probe AND calibration stay on the host CPU backend: both are
+    # streams of small eager ops — exactly the per-op-compile-over-the-
+    # tunnel pattern that cost round 1 its number (and this mode ~7 min of
+    # calibration).  Only the final jitted int8 graph touches the device.
+    _progress("int8: calibrating + converting (host CPU)")
     if cpu0 is not None:
         with jax.default_device(cpu0):
             net(probe)
+            qnet = quant.quantize_net(net, calib)
     else:
         net(probe)
-    _progress("int8: calibrating + converting")
-    calib = [mx.nd.array(rng.rand(batch, 3, img, img).astype(onp.float32))
-             for _ in range(2)]
-    qnet = quant.quantize_net(net, calib)
+        qnet = quant.quantize_net(net, calib)
     x = calib[0]
     _progress("int8: compiling")
     out = qnet(x)
     jax.block_until_ready(out)
+    # warm with a host read (tunnel backpressure; see bench_bert)
+    for _ in range(2):
+        out = qnet(x)
+    float(jax.device_get(out).ravel()[0])
     _progress(f"int8: timing {steps} steps")
     t0 = time.perf_counter()
     for _ in range(steps):
         out = qnet(x)
-    jax.block_until_ready(out)
+    float(jax.device_get(out).ravel()[0])    # host read = hard fence
     dt = time.perf_counter() - t0
     imgs_per_sec = batch * steps / dt
     # reference fp32 V100 inference baselines (perf.md:194); models without

@@ -26,9 +26,12 @@ _build_lock = threading.Lock()
 
 
 def _sources():
+    # c_api.cc embeds CPython and is built separately into
+    # libmxnet_tpu_c.so (capi.py); the base runtime library must stay
+    # Python-free
     return sorted(
         os.path.join(_SRC, f) for f in os.listdir(_SRC)
-        if f.endswith(".cc"))
+        if f.endswith(".cc") and f != "c_api.cc")
 
 
 def _needs_build() -> bool:

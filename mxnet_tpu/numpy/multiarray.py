@@ -212,13 +212,23 @@ class ndarray(NDArray):
             return getattr(ufunc, method)(
                 *(_host(i) for i in inputs),
                 **{k: _host(v) for k, v in kwargs.items()})
+        if kwargs:
+            # dtype=/where=/casting= and friends aren't part of the device
+            # fns' signatures — compute on host via __array__
+            return getattr(ufunc, method)(*(_host(i) for i in inputs),
+                                          **kwargs)
         import mxnet_tpu.numpy as _mnp
 
         target = getattr(_mnp, ufunc.__name__, None)
         if target is None or not callable(target):
-            return getattr(ufunc, method)(*(_host(i) for i in inputs),
-                                          **kwargs)
-        return target(*inputs, **kwargs)
+            return getattr(ufunc, method)(*(_host(i) for i in inputs))
+        # promote host-numpy operands so mixed `host_arr * mx_arr`
+        # expressions dispatch on-device regardless of operand order
+        promoted = [
+            _mnp.array(i) if isinstance(i, onp.ndarray) and i.ndim > 0
+            else i
+            for i in inputs]
+        return target(*promoted)
 
     # -- numpy-flavored overrides ---------------------------------------
     def reshape(self, *shape, order="C", **kwargs):

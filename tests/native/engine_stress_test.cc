@@ -1,0 +1,108 @@
+// Native dependency-engine stress test (reference analog:
+// tests/cpp/engine/threaded_engine_test.cc — correctness of the
+// many-readers/one-writer ordering under concurrency).
+//
+// Built and run by tests/test_native.py::test_engine_cpp_stress.  Links
+// directly against the engine translation unit (no Python anywhere).
+//
+// Checks:
+//  1. WRITE ordering: N writers incrementing a counter var serialize —
+//     final count == N, and no two writers overlap (guard flag).
+//  2. READ concurrency: readers between two writers all see the first
+//     writer's value (write-read-write ordering).
+//  3. WaitForVar: returns only after every op touching the var completed.
+//  4. Var versions: bumped once per writer.
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+void *EngineCreate(int num_threads);
+void EngineFree(void *e);
+uint64_t EngineNewVar(void *e);
+uint64_t EngineVarVersion(void *e, uint64_t v);
+int EnginePushAsync(void *e, void (*fn)(void *), void *arg,
+                    const uint64_t *const_vars, int n_const,
+                    const uint64_t *mutable_vars, int n_mut);
+void EngineWaitForVar(void *e, uint64_t v);
+void EngineWaitForAll(void *e);
+}
+
+#define EXPECT(cond, msg)                                               \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::fprintf(stderr, "FAIL %s:%d %s\n", __FILE__, __LINE__, msg); \
+      std::exit(1);                                                     \
+    }                                                                   \
+  } while (0)
+
+namespace {
+
+std::atomic<long> g_counter{0};
+std::atomic<int> g_in_writer{0};
+std::atomic<bool> g_overlap{false};
+std::atomic<long> g_read_snapshot_sum{0};
+std::atomic<int> g_reads{0};
+
+void writer(void *) {
+  if (g_in_writer.fetch_add(1) != 0) g_overlap = true;  // another writer live
+  long v = g_counter.load();
+  // widen the race window
+  for (volatile int i = 0; i < 1000; ++i) {
+  }
+  g_counter.store(v + 1);
+  g_in_writer.fetch_sub(1);
+}
+
+void reader(void *) {
+  g_read_snapshot_sum.fetch_add(g_counter.load());
+  g_reads.fetch_add(1);
+}
+
+}  // namespace
+
+int main() {
+  void *e = EngineCreate(8);
+  uint64_t var = EngineNewVar(e);
+  const uint64_t no_vars[1] = {0};
+
+  // 1) many writers on one var serialize
+  const int N = 200;
+  uint64_t v0 = EngineVarVersion(e, var);
+  for (int i = 0; i < N; ++i)
+    EXPECT(EnginePushAsync(e, writer, nullptr, no_vars, 0, &var, 1) == 0,
+           "push writer");
+  EngineWaitForVar(e, var);
+  EXPECT(g_counter.load() == N, "writers must serialize: count == N");
+  EXPECT(!g_overlap.load(), "no two writers may overlap");
+  EXPECT(EngineVarVersion(e, var) == v0 + N,
+         "version bumps once per writer");
+
+  // 2) write -> readers -> write: all readers see the first write
+  g_counter = 100;
+  uint64_t var2 = EngineNewVar(e);
+  EnginePushAsync(e, writer, nullptr, no_vars, 0, &var2, 1);  // -> 101
+  const int R = 64;
+  for (int i = 0; i < R; ++i)
+    EnginePushAsync(e, reader, nullptr, &var2, 1, no_vars, 0);
+  EnginePushAsync(e, writer, nullptr, no_vars, 0, &var2, 1);  // -> 102
+  EngineWaitForAll(e);
+  EXPECT(g_reads.load() == R, "all readers ran");
+  EXPECT(g_read_snapshot_sum.load() == 101L * R,
+         "readers between the writes must all see 101");
+  EXPECT(g_counter.load() == 102, "second write after readers");
+
+  // 3) unknown var id rejected
+  EXPECT(EnginePushAsync(e, reader, nullptr, no_vars, 0, nullptr, 0) == 0,
+         "no-dep op accepted");
+  uint64_t bogus = 0xdeadbeef;
+  EXPECT(EnginePushAsync(e, reader, nullptr, &bogus, 1, no_vars, 0) != 0,
+         "unknown var id must be rejected");
+
+  EngineWaitForAll(e);
+  EngineFree(e);
+  std::printf("ENGINE_STRESS_OK writers=%d readers=%d\n", N, R);
+  return 0;
+}

@@ -46,5 +46,12 @@ def test_bert_elastic_example(tmp_path):
     assert "restarts" in out
 
 
+@pytest.mark.slow
+def test_ssd_example():
+    out = _run("example/ssd/train_ssd_toy.py", "--steps", "25",
+               "--batch-size", "8", "--lr", "0.02")
+    assert "detections kept" in out
+
+
 # example/extensions/custom_op_ext.py is loaded (not executed) by
 # tests/test_extensions.py — the MXLoadLib analog exercises it there.

@@ -1,5 +1,6 @@
 """Native C++ runtime tests (reference tests/cpp/engine/threaded_engine_test.cc
 coverage re-expressed through the ctypes bindings)."""
+import os
 import threading
 import time
 
@@ -7,6 +8,8 @@ import numpy as onp
 import pytest
 
 from mxnet_tpu import native, recordio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 pytestmark = pytest.mark.skipif(not native.available(),
@@ -179,3 +182,23 @@ def test_engine_throughput_vs_serial(tmp_path):
     parallel = time.perf_counter() - t0
     assert parallel < 8 * 0.02 * 0.9  # clearly better than serial
     eng.close()
+
+
+def test_engine_cpp_stress(tmp_path):
+    """Compile + run the pure-C++ engine stress test (the reference's
+    tests/cpp/engine gtest analog): writer serialization, read/write
+    ordering, versions, rejection of unknown vars."""
+    import subprocess
+
+    src_engine = os.path.join(REPO, "mxnet_tpu", "native", "src",
+                              "engine.cc")
+    src_test = os.path.join(REPO, "tests", "native",
+                            "engine_stress_test.cc")
+    exe = str(tmp_path / "engine_stress")
+    r = subprocess.run(["g++", "-O2", "-std=c++17", "-pthread", "-o", exe,
+                        src_test, src_engine],
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stderr
+    run = subprocess.run([exe], capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, f"{run.stdout}\n{run.stderr}"
+    assert "ENGINE_STRESS_OK" in run.stdout

@@ -210,3 +210,24 @@ def test_numpy_dispatch_interop_fallbacks():
     a += mnp.array([1.0, 2.0])            # in-place with out=host array
     onp.testing.assert_allclose(a, [2.0, 4.0])
     assert float(onp.add.reduce(mnp.array([1.0, 2.0, 3.0]))) == 6.0
+
+
+def test_numpy_dispatch_mixed_operands_and_kwargs():
+    """Mixed host/device binary ufuncs work in BOTH operand orders, and
+    ufunc kwargs (dtype=, where=) fall back to the host path (regression:
+    order-dependent ValueError / TypeError)."""
+    import numpy as onp
+
+    from mxnet_tpu import np as mnp
+
+    a = onp.array([[1.0, 1.0], [1.0, 1.0]])
+    x = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    r1 = a * x                              # host first
+    r2 = x * a                              # device first
+    assert isinstance(r1, type(x)) and isinstance(r2, type(x))
+    onp.testing.assert_allclose(r1.asnumpy(), r2.asnumpy())
+    r3 = onp.add(a, x)
+    onp.testing.assert_allclose(r3.asnumpy(), [[2, 3], [4, 5]])
+    out = onp.add(x, x, dtype=onp.float64)  # kwargs -> host fallback
+    assert isinstance(out, onp.ndarray) and out.dtype == onp.float64
+    onp.testing.assert_allclose(out, [[2, 4], [6, 8]])

@@ -104,8 +104,9 @@ def main():
                 cls_pred_t = nd.transpose(cls_pred, axes=(0, 2, 1))
                 loc_t, loc_mask, cls_t = nd.multibox_target(
                     anchors, labels, cls_pred_t)
-            cls_loss = ce(nd.reshape(cls_pred, shape=(-1, 2)),
-                          nd.reshape(cls_t, shape=(-1,)))
+            cls_loss = ce(
+                nd.reshape(cls_pred, shape=(-1, net.num_classes)),
+                nd.reshape(cls_t, shape=(-1,)))
             loc_loss = smooth_l1(loc_pred * loc_mask, loc_t)
             loss = cls_loss.mean() + loc_loss.mean()
         loss.backward()

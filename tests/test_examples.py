@@ -47,6 +47,12 @@ def test_bert_elastic_example(tmp_path):
 
 
 @pytest.mark.slow
+def test_char_lm_example():
+    out = _run("example/rnn/char_lm.py", "--steps", "45")
+    assert "ppl" in out
+
+
+@pytest.mark.slow
 def test_ssd_example():
     out = _run("example/ssd/train_ssd_toy.py", "--steps", "25",
                "--batch-size", "8", "--lr", "0.02")

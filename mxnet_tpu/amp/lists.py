@@ -127,7 +127,7 @@ FP16_FP32_FUNCS = [
     # detection (mask/compare logic)
     "box_iou", "box_nms", "box_encode", "box_decode",
     "bipartite_matching", "multibox_prior", "multibox_target",
-    "multibox_detection", "Proposal",
+    "multibox_detection", "Proposal", "mrcnn_mask_target",
     # creation / random (dtype comes from attrs)
     "zeros", "ones", "full", "eye", "arange", "linspace", "zeros_like",
     "ones_like", "normal", "uniform", "randint", "randn", "bernoulli",

@@ -189,6 +189,19 @@ from ..ops.control_flow import cond, foreach, while_loop  # noqa: E402
 contrib.foreach = foreach
 contrib.while_loop = while_loop
 contrib.cond = cond
+def _contrib_getattr(name):
+    """Any registry op resolves under nd.contrib (the reference's
+    generated contrib namespace covers every _contrib_* registration)."""
+    schema = _registry.find_op(name) or _registry.find_op(f"_contrib_{name}")
+    if schema is not None and "nd" in schema.namespaces:
+        fn = make_op_func(schema)
+        setattr(contrib, name, fn)
+        return fn
+    raise AttributeError(f"module '{contrib.__name__}' has no attribute "
+                         f"'{name}'")
+
+
+contrib.__getattr__ = _contrib_getattr
 for _cn in [
     "interleaved_matmul_selfatt_qk",
     "interleaved_matmul_selfatt_valatt",

@@ -379,3 +379,49 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
 
     return jax.vmap(single)(cls_prob, loc_pred.reshape(cls_prob.shape[0],
                                                        -1))
+
+
+@register("mrcnn_mask_target", num_inputs=4, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_mrcnn_mask_target",))
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets, num_rois=0,
+                      num_classes=1, mask_size=(14, 14), sample_ratio=2,
+                      aligned=False):
+    """Mask-RCNN training targets (reference
+    src/operator/contrib/mrcnn_mask_target-inl.h:46): for every sampled
+    ROI, ROIAlign-crop its matched ground-truth mask to ``mask_size`` and
+    emit the per-class targets plus the one-hot class weights the mask
+    loss multiplies by.  The crop reuses the ROIAlign lowering
+    (ops/contrib.py) so sampling semantics live in one place.
+
+    rois (B, N, 4) corner format; gt_masks (B, M, H, W); matches (B, N)
+    int index into M; cls_targets (B, N) int class (0 = background).
+    Returns (mask_targets (B, N, C, h, w) — the cropped mask in EVERY
+    class channel, reference layout — and mask_cls (B, N, C, h, w) with
+    one-hot weights, zero for background).
+    """
+    from .contrib import roi_align
+
+    if num_rois and num_rois > 0:
+        rois = rois[:, :num_rois]
+        matches = matches[:, :num_rois]
+        cls_targets = cls_targets[:, :num_rois]
+    B, N = rois.shape[:2]
+    mh, mw = mask_size
+    C = num_classes
+
+    def per_image(rois_i, masks_i, match_i, cls_i):
+        picked = masks_i[match_i.astype(jnp.int32)][:, None]   # (N,1,H,W)
+        idx = jnp.arange(N, dtype=rois_i.dtype)[:, None]
+        rois5 = jnp.concatenate([idx, rois_i], axis=1)         # (N,5)
+        sampled = roi_align(picked, rois5, pooled_size=(mh, mw),
+                            spatial_scale=1.0, sample_ratio=sample_ratio,
+                            aligned=aligned)[:, 0]             # (N,h,w)
+        onehot = jax.nn.one_hot(cls_i.astype(jnp.int32), C,
+                                dtype=sampled.dtype)           # (N,C)
+        targets = jnp.broadcast_to(sampled[:, None], (N, C, mh, mw))
+        weights = jnp.broadcast_to(onehot[:, :, None, None], (N, C, mh, mw))
+        bg = jnp.zeros((C,), sampled.dtype).at[0].set(1.0)
+        weights = weights * (1.0 - bg)[None, :, None, None]
+        return targets, weights
+
+    return jax.vmap(per_image)(rois, gt_masks, matches, cls_targets)

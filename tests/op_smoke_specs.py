@@ -278,6 +278,12 @@ SPECS = {
     "image_random_resized_crop": ([(_R.rand(8, 8, 3)).astype(onp.float32),
                                    onp.array([3, 4], onp.uint32)],
                                   dict(width=4, height=4)),
+    "mrcnn_mask_target": ([
+        onp.array([[[1, 1, 7, 7], [2, 2, 6, 6]]], onp.float32),   # rois
+        _R.rand(1, 3, 10, 10).astype(onp.float32),                # gt_masks
+        onp.array([[0, 2]], onp.int32),                           # matches
+        onp.array([[1, 2]], onp.int32)],                          # classes
+        dict(num_rois=2, num_classes=3, mask_size=(4, 4))),
     # --- rroi / graph / sparse -----------------------------------------
     "RROIAlign": ([_f(2, 3, 12, 12),
                    onp.array([[0, 6, 6, 6, 4, 30.0],

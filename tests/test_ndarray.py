@@ -183,3 +183,20 @@ def test_where_clip_maximum():
     x = nd.ones((3,))
     y = nd.zeros((3,))
     assert onp.allclose(nd.where(cond, x, y).asnumpy(), [1, 0, 1])
+
+
+def test_contrib_namespace_resolves_registry():
+    """nd.contrib exposes every registry op (the reference's generated
+    contrib namespace), including late/aliased registrations."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+
+    assert callable(mx.nd.contrib.box_nms)
+    assert callable(mx.nd.contrib.RROIAlign)
+    out = mx.nd.contrib.quadratic(mx.nd.array([1.0, 2.0]), a=1, b=2, c=3)
+    onp.testing.assert_allclose(out.asnumpy(), [6.0, 11.0])
+    import pytest
+
+    with pytest.raises(AttributeError):
+        mx.nd.contrib.not_an_op_at_all

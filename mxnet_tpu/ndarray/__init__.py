@@ -191,10 +191,11 @@ contrib.while_loop = while_loop
 contrib.cond = cond
 def _contrib_getattr(name):
     """Any registry op resolves under nd.contrib (the reference's
-    generated contrib namespace covers every _contrib_* registration)."""
+    generated contrib namespace covers every _contrib_* registration).
+    Delegates to the nd module resolver so nd.contrib.X IS nd.X."""
     schema = _registry.find_op(name) or _registry.find_op(f"_contrib_{name}")
     if schema is not None and "nd" in schema.namespaces:
-        fn = make_op_func(schema)
+        fn = getattr(_this, schema.name)    # shared wrapper (one identity)
         setattr(contrib, name, fn)
         return fn
     raise AttributeError(f"module '{contrib.__name__}' has no attribute "
@@ -202,25 +203,6 @@ def _contrib_getattr(name):
 
 
 contrib.__getattr__ = _contrib_getattr
-for _cn in [
-    "interleaved_matmul_selfatt_qk",
-    "interleaved_matmul_selfatt_valatt",
-    "interleaved_matmul_encdec_qk",
-    "interleaved_matmul_encdec_valatt",
-    "div_sqrt_dim",
-    "boolean_mask",
-    "index_copy",
-    "index_array",
-    "allclose",
-    "arange_like",
-    "quadratic",
-    "BilinearResize2D",
-    "AdaptiveAvgPooling2D",
-    "ROIAlign",
-    "box_iou",
-]:
-    if hasattr(_this, _cn):
-        setattr(contrib, _cn, getattr(_this, _cn))
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "waitall", "save", "load", "concatenate", "random", "linalg",

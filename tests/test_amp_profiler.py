@@ -175,3 +175,37 @@ def test_amp_lists_exhaustive_over_registry():
         for n in lst:
             (dups if n in seen else seen).add(n)
     assert not dups, f"ops in multiple AMP lists: {dups}"
+
+
+def test_memory_summary_attributes_params():
+    """profiler.memory_summary labels live buffers with parameter names
+    (reference storage-profiler attribution, storage_profiler.h:131)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+
+    net = mx.gluon.nn.Dense(8)
+    net.initialize()
+    net(mx.nd.ones((2, 4)))
+    s = profiler.memory_summary(net)
+    assert "weight" in s and "bias" in s and "TOTAL" in s
+
+
+def test_bandwidth_tool_runs():
+    import json
+    import os
+    import subprocess
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bandwidth.py"),
+         "--mb", "4", "--iters", "2", "--mesh", "dp=8"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert r.returncode == 0, r.stderr[-800:]
+    res = json.loads(r.stdout.strip().splitlines()[-1])
+    for k in ("h2d_GBps", "d2h_GBps", "hbm_GBps", "allreduce_GBps"):
+        assert res[k] > 0

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Communication / memory bandwidth measurement.
+
+Reference analog: ``tools/bandwidth/measure.py`` (kvstore comm bandwidth
+per GPU).  TPU-native version measures the three lanes that matter here:
+
+- host -> device staging (device_put), the input-pipeline lane;
+- device -> host readback (device_get), the eval/checkpoint lane;
+- on-device copy bandwidth (HBM), via a jitted identity-plus;
+- all-reduce bandwidth over the mesh (ICI on hardware, shared-memory on
+  the virtual CPU mesh) — the kvstore='tpu' gradient lane, using the
+  standard 2(n-1)/n ring-bytes accounting.
+
+    python tools/bandwidth.py --mb 64 --iters 10
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/bandwidth.py --mesh dp=8
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fence(x):
+    """Host read — the only reliable completion fence over the TPU tunnel
+    (block_until_ready exerts no backpressure until the queue drains)."""
+    import numpy as onp
+
+    return onp.asarray(x).ravel()[0]
+
+
+def measure(mb=64, iters=10, mesh_spec=""):
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    n = mb * (1 << 20) // 4
+    host = onp.random.RandomState(0).rand(n).astype(onp.float32)
+    results = {}
+
+    # host -> device
+    dev = jax.device_put(host)
+    _fence(dev)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        dev = jax.device_put(host)
+    _fence(dev)
+    dt = time.perf_counter() - t0
+    results["h2d_GBps"] = mb * iters / 1024 / dt
+
+    # device -> host: read a FRESH device result each iteration — jax
+    # caches the host copy of an unchanged array, which would measure a
+    # memcpy (or nothing) instead of the transfer
+    bump = jax.jit(lambda x: x + 1.0)
+    _fence(bump(dev))
+    y = dev
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = bump(y)
+        out = onp.asarray(y)
+    dt = time.perf_counter() - t0
+    results["d2h_GBps"] = mb * iters / 1024 / dt
+
+    # on-device (read+write one buffer each way)
+    f = jax.jit(lambda x: x + 1.0)
+    _fence(f(dev))
+    t0 = time.perf_counter()
+    y = dev
+    for _ in range(iters):
+        y = f(y)
+    _fence(y)
+    dt = time.perf_counter() - t0
+    results["hbm_GBps"] = 2 * mb * iters / 1024 / dt
+
+    # all-reduce over a mesh
+    if mesh_spec:
+        from mxnet_tpu import parallel as par
+
+        axes = {}
+        for part in mesh_spec.split(","):
+            k, v = part.split("=")
+            axes[k] = int(v)
+        mesh = par.make_mesh(axes)
+        ndev = 1
+        for v in axes.values():
+            ndev *= v
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = next(iter(axes))
+        sharded = jax.device_put(
+            host, NamedSharding(mesh, P(axis)))
+        g = jax.jit(lambda x: jax.lax.with_sharding_constraint(
+            jnp.broadcast_to(x.sum(), x.shape), NamedSharding(mesh, P())))
+        # psum-equivalent: sharded sum -> replicated; ring accounting
+        ar = jax.jit(
+            lambda x: jnp.tile(x.reshape(ndev, -1).sum(0), ndev))
+        _fence(ar(sharded))
+        t0 = time.perf_counter()
+        y = sharded
+        for _ in range(iters):
+            y = ar(y)
+        _fence(y)
+        dt = time.perf_counter() - t0
+        ring_bytes = 2 * (ndev - 1) / ndev * mb * iters
+        results["allreduce_GBps"] = ring_bytes / 1024 / dt
+        results["mesh"] = mesh_spec
+
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=64,
+                    help="payload size in MiB")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--mesh", default="",
+                    help="axis spec for the all-reduce lane, e.g. dp=8")
+    args = ap.parse_args()
+    import json
+
+    import jax
+
+    res = measure(args.mb, args.iters, args.mesh)
+    res["platform"] = jax.default_backend()
+    res["payload_mb"] = args.mb
+    print(json.dumps({k: (round(v, 2) if isinstance(v, float) else v)
+                      for k, v in res.items()}))
+
+
+if __name__ == "__main__":
+    main()

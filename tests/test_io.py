@@ -237,3 +237,35 @@ def test_dataloader_multiprocess():
     assert len(batches) == 4
     got = onp.concatenate([b[0].asnumpy() for b in batches])
     onp.testing.assert_allclose(got, X)
+
+
+def test_dataloader_last_batch_policies():
+    """last_batch keep/discard/rollover (reference gluon DataLoader
+    semantics, python/mxnet/gluon/data/dataloader.py)."""
+    gluon = mx.gluon
+    ds = gluon.data.ArrayDataset(onp.arange(10, dtype=onp.float32))
+    sizes = lambda loader: [b.shape[0] for b in loader]
+
+    keep = gluon.data.DataLoader(ds, batch_size=4, last_batch="keep")
+    assert sizes(keep) == [4, 4, 2]
+    disc = gluon.data.DataLoader(ds, batch_size=4, last_batch="discard")
+    assert sizes(disc) == [4, 4]
+    roll = gluon.data.DataLoader(ds, batch_size=4, last_batch="rollover")
+    assert sizes(roll) == [4, 4]          # epoch 1: 2 samples roll over
+    assert sizes(roll) == [4, 4, 4]       # epoch 2: 2 rolled + 10 = 12
+
+
+def test_dataloader_samplers_and_batchify():
+    gluon = mx.gluon
+    ds = gluon.data.ArrayDataset(
+        onp.arange(12, dtype=onp.float32),
+        onp.arange(12, dtype=onp.int32) % 3)
+    seq = gluon.data.SequentialSampler(12)
+    batch_sampler = gluon.data.BatchSampler(seq, 5, "keep")
+    loader = gluon.data.DataLoader(ds, batch_sampler=batch_sampler)
+    got = [tuple(x.shape[0] for x in b) for b in loader]
+    assert got == [(5, 5), (5, 5), (2, 2)]
+    # interval sampler (reference contrib IntervalSampler analog via
+    # FilterSampler if present) — plain random sampler determinism check
+    rs = list(gluon.data.RandomSampler(12))
+    assert sorted(rs) == list(range(12))

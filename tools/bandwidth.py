@@ -74,36 +74,36 @@ def measure(mb=64, iters=10, mesh_spec=""):
     dt = time.perf_counter() - t0
     results["hbm_GBps"] = 2 * mb * iters / 1024 / dt
 
-    # all-reduce over a mesh
+    # all-reduce over the device mesh: a REAL psum via shard_map, so every
+    # timed iteration moves bytes across devices (a plain jitted reduce
+    # would produce a replicated output and communicate only once)
     if mesh_spec:
-        from mxnet_tpu import parallel as par
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-        axes = {}
-        for part in mesh_spec.split(","):
-            k, v = part.split("=")
-            axes[k] = int(v)
-        mesh = par.make_mesh(axes)
         ndev = 1
-        for v in axes.values():
-            ndev *= v
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        axis = next(iter(axes))
+        for part in mesh_spec.split(","):
+            _, v = part.split("=")
+            ndev *= int(v)
+        devices = jax.devices()[:ndev]
+        if len(devices) < ndev:
+            raise SystemExit(f"--mesh wants {ndev} devices, "
+                             f"have {len(devices)}")
+        flat = Mesh(devices, ("all",))
+        n_pad = (n // ndev) * ndev          # divisibility for any ndev
+        payload_mb = n_pad * 4 / (1 << 20)
         sharded = jax.device_put(
-            host, NamedSharding(mesh, P(axis)))
-        g = jax.jit(lambda x: jax.lax.with_sharding_constraint(
-            jnp.broadcast_to(x.sum(), x.shape), NamedSharding(mesh, P())))
-        # psum-equivalent: sharded sum -> replicated; ring accounting
-        ar = jax.jit(
-            lambda x: jnp.tile(x.reshape(ndev, -1).sum(0), ndev))
+            host[:n_pad], NamedSharding(flat, P("all")))
+        ar = jax.jit(shard_map(
+            lambda x: jax.lax.psum(x, "all"), mesh=flat,
+            in_specs=P("all"), out_specs=P(None)))
         _fence(ar(sharded))
         t0 = time.perf_counter()
-        y = sharded
         for _ in range(iters):
-            y = ar(y)
-        _fence(y)
+            out = ar(sharded)               # fresh psum each iteration
+        _fence(out)
         dt = time.perf_counter() - t0
-        ring_bytes = 2 * (ndev - 1) / ndev * mb * iters
+        ring_bytes = 2 * (ndev - 1) / ndev * payload_mb * iters
         results["allreduce_GBps"] = ring_bytes / 1024 / dt
         results["mesh"] = mesh_spec
 

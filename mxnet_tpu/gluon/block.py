@@ -767,6 +767,18 @@ def _npz_path(filename: str) -> str:
 
 
 def _load_param_file(filename: str) -> Dict[str, onp.ndarray]:
+    # reference-format .params (magic 0x112) load transparently — real
+    # Apache-MXNet checkpoints feed load_parameters directly
+    from ..ndarray import legacy_format
+
+    loaded = legacy_format.load_if_legacy(filename)
+    if loaded is not None:
+        if not isinstance(loaded, dict):
+            raise ValueError(
+                f"{filename} is a legacy NDArray LIST; load_parameters "
+                "needs a name-keyed save")
+        # the reference prefixes keys with 'arg:'/'aux:' in some exports
+        return {k.split(":", 1)[-1]: v for k, v in loaded.items()}
     with onp.load(filename, allow_pickle=False) as z:
         return {k: z[k] for k in z.files}
 

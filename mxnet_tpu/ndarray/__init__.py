@@ -17,7 +17,7 @@ from . import sparse
 from . import utils
 from .ndarray import NDArray, array, invoke
 from .register import make_op_func
-from .utils import load, save
+from .utils import load, save, save_legacy
 
 _this = _sys.modules[__name__]
 

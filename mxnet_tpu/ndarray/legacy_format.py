@@ -1,0 +1,161 @@
+"""Reference (Apache MXNet) binary ``.params`` format: read and write.
+
+The migration story: checkpoints produced by the reference framework load
+directly here, and checkpoints saved with ``save_legacy`` load in the
+reference.  Layout reverse-engineered from the reference's serializers
+(behavioral spec, fresh implementation):
+
+- file header (``src/ndarray/ndarray.cc:1930`` NDArray::Save list form):
+  uint64 magic ``0x112``, uint64 reserved, dmlc ``vector<NDArray>``
+  (uint64 count + per-element NDArray record), dmlc ``vector<string>``
+  (uint64 count + per-string uint64 length + bytes)
+- NDArray record (``ndarray.cc:1697``): uint32 version magic
+  (V1 ``0xF993fac8`` int64 shapes / V2 ``0xF993fac9`` +storage type /
+  V3 ``0xF993faca`` np-shape semantics; anything else = ancient format
+  where the magic IS the uint32 ndim followed by uint32 extents);
+  V2/V3 add int32 storage type (sparse adds aux shapes/types — dense
+  only here); TShape = int32 ndim + int64[ndim] (uint32[ndim] for the
+  ancient form); Context = int32 dev_type + int32 dev_id
+  (``include/mxnet/base.h:145``); int32 dtype flag (mshadow order);
+  raw little-endian data bytes.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple, Union
+
+import numpy as onp
+
+LIST_MAGIC = 0x112
+V1_MAGIC = 0xF993FAC8
+V2_MAGIC = 0xF993FAC9
+V3_MAGIC = 0xF993FACA
+
+# mshadow type flags (include/mxnet/base.h TypeFlag order)
+_FLAG_TO_DTYPE = {
+    0: onp.float32, 1: onp.float64, 2: onp.float16, 3: onp.uint8,
+    4: onp.int32, 5: onp.int8, 6: onp.int64, 7: onp.bool_,
+}
+_DTYPE_TO_FLAG = {onp.dtype(v): k for k, v in _FLAG_TO_DTYPE.items()}
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise ValueError("truncated legacy .params file")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.take(4))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.take(8))[0]
+
+
+def _read_shape(r: _Reader, int64_ext: bool, ndim: int = None) -> Tuple:
+    if ndim is None:
+        ndim = r.i32()
+    if ndim < 0:          # np-shape "unknown" marker — only for none arrays
+        return None
+    fmt, size = ("<q", 8) if int64_ext else ("<I", 4)
+    return tuple(struct.unpack(fmt, r.take(size))[0] for _ in range(ndim))
+
+
+def _read_ndarray(r: _Reader) -> onp.ndarray:
+    magic = r.u32()
+    if magic in (V2_MAGIC, V3_MAGIC):
+        stype = r.i32()
+        if stype != 0:    # kDefaultStorage == 0 (ndarray.h:60)
+            raise NotImplementedError(
+                "legacy sparse (row_sparse/csr) records are not supported; "
+                "densify in the reference before exporting")
+        shape = _read_shape(r, int64_ext=True)
+    elif magic == V1_MAGIC:
+        shape = _read_shape(r, int64_ext=True)
+    else:                 # ancient: magic IS the ndim, uint32 extents
+        shape = _read_shape(r, int64_ext=False, ndim=magic)
+    if shape is None:
+        return onp.zeros((0,), onp.float32)
+    r.i32()               # dev_type
+    r.i32()               # dev_id
+    flag = r.i32()
+    dtype = _FLAG_TO_DTYPE.get(flag)
+    if dtype is None:
+        raise ValueError(f"unknown legacy dtype flag {flag}")
+    count = 1
+    for d in shape:
+        count *= d
+    data = onp.frombuffer(r.take(count * onp.dtype(dtype).itemsize),
+                          dtype=dtype)
+    return data.reshape(shape).copy()
+
+
+def is_legacy_file(head: bytes) -> bool:
+    return len(head) >= 8 and struct.unpack("<Q", head[:8])[0] == LIST_MAGIC
+
+
+def load_if_legacy(fname: str):
+    """Single detection point: the legacy payload if ``fname`` carries the
+    reference magic, else None (caller falls through to its own format)."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    if not is_legacy_file(head):
+        return None
+    return load_legacy(fname)
+
+
+def load_legacy(fname: str):
+    """Load a reference-format .params file -> dict (named) or list."""
+    with open(fname, "rb") as f:
+        r = _Reader(f.read())
+    if r.u64() != LIST_MAGIC:
+        raise ValueError(f"{fname} is not a legacy MXNet NDArray file")
+    r.u64()               # reserved
+    arrays = [_read_ndarray(r) for _ in range(r.u64())]
+    names: List[str] = []
+    for _ in range(r.u64()):
+        names.append(r.take(r.u64()).decode())
+    if names and len(names) != len(arrays):
+        raise ValueError("corrupt legacy file: name/array count mismatch")
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def save_legacy(fname: str, data: Union[Dict[str, onp.ndarray],
+                                        List[onp.ndarray]]) -> None:
+    """Write arrays in the reference's V2 dense format, loadable by the
+    reference's ``mx.nd.load``."""
+    if isinstance(data, dict):
+        names = list(data)
+        arrays = [onp.asarray(data[n]) for n in names]
+    else:
+        names = []
+        arrays = [onp.asarray(a) for a in data]
+    out = [struct.pack("<QQ", LIST_MAGIC, 0), struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        if a.dtype not in _DTYPE_TO_FLAG:
+            raise TypeError(f"dtype {a.dtype} has no legacy flag (cast "
+                            "bf16 etc. to float32 first)")
+        out.append(struct.pack("<Ii", V2_MAGIC, 0))          # V2, dense
+        out.append(struct.pack("<i", a.ndim))
+        out.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        out.append(struct.pack("<ii", 1, 0))                  # cpu(0)
+        out.append(struct.pack("<i", _DTYPE_TO_FLAG[a.dtype]))
+        out.append(onp.ascontiguousarray(a).tobytes())
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        raw = n.encode()
+        out.append(struct.pack("<Q", len(raw)))
+        out.append(raw)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))

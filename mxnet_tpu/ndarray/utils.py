@@ -16,7 +16,7 @@ import numpy as onp
 from ..context import Context, cpu
 from .ndarray import NDArray, array
 
-__all__ = ["save", "load", "imdecode"]
+__all__ = ["save", "load", "save_legacy", "imdecode"]
 
 
 def save(fname: str, data):
@@ -39,7 +39,36 @@ def save(fname: str, data):
         onp.savez(f, **payload)
 
 
+def save_legacy(fname: str, data):
+    """Write the reference's binary .params format (loadable by Apache
+    MXNet's ``mx.nd.load`` — the export half of the migration story)."""
+    from . import legacy_format
+
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        if not all(isinstance(v, NDArray) for v in data.values()):
+            raise TypeError("save_legacy only supports NDArray values")
+        payload = {k: v.asnumpy() for k, v in data.items()}
+    elif isinstance(data, (list, tuple)):
+        if not all(isinstance(v, NDArray) for v in data):
+            raise TypeError("save_legacy only supports NDArray values")
+        payload = [v.asnumpy() for v in data]
+    else:
+        raise TypeError(f"cannot save {type(data)}")
+    legacy_format.save_legacy(fname, payload)
+
+
 def load(fname: str, ctx: Context = None):
+    # auto-detect the reference's binary format (magic 0x112): real
+    # Apache-MXNet checkpoints load transparently
+    from . import legacy_format
+
+    out = legacy_format.load_if_legacy(fname)
+    if out is not None:
+        if isinstance(out, dict):
+            return {k: array(v, ctx=ctx) for k, v in out.items()}
+        return [array(v, ctx=ctx) for v in out]
     with onp.load(fname, allow_pickle=False) as z:
         keys = list(z.keys())
         if keys and keys[0].startswith("k:"):

@@ -1,0 +1,112 @@
+"""Reference binary .params format interop (ndarray/legacy_format.py).
+
+The oracle is torch-free and mxnet-free: byte layouts were derived from
+the reference serializer (src/ndarray/ndarray.cc:1697,1930); these tests
+pin round-trips plus hand-built reference bytes.
+"""
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import legacy_format as lf
+
+
+def test_roundtrip_dict(tmp_path):
+    path = str(tmp_path / "w.params")
+    data = {
+        "fc.weight": onp.random.RandomState(0).rand(4, 3).astype("float32"),
+        "fc.bias": onp.arange(4, dtype=onp.float32),
+        "step": onp.array([7], onp.int64),
+    }
+    lf.save_legacy(path, data)
+    back = lf.load_legacy(path)
+    assert set(back) == set(data)
+    for k in data:
+        onp.testing.assert_array_equal(back[k], data[k])
+        assert back[k].dtype == data[k].dtype
+
+
+def test_roundtrip_list_and_nd_autodetect(tmp_path):
+    path = str(tmp_path / "l.params")
+    arrays = [onp.ones((2, 2), onp.float32), onp.zeros(3, onp.uint8)]
+    lf.save_legacy(path, arrays)
+    out = nd.load(path)                     # auto-detects legacy magic
+    assert isinstance(out, list) and len(out) == 2
+    onp.testing.assert_array_equal(out[0].asnumpy(), arrays[0])
+    assert out[1].dtype == onp.uint8
+
+
+def test_nd_save_legacy_then_gluon_load(tmp_path):
+    """Export a gluon net's params in reference format; load_parameters
+    consumes them via the auto-detecting nd.load."""
+    net = mx.gluon.nn.Dense(5)
+    net.initialize()
+    net(nd.ones((2, 3)))
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    path = str(tmp_path / "net.params")
+    nd.save_legacy(path, params)
+    net2 = mx.gluon.nn.Dense(5)
+    net2.initialize()
+    net2(nd.ones((2, 3)))
+    net2.load_parameters(path)
+    onp.testing.assert_array_equal(net2.weight.data().asnumpy(),
+                                   net.weight.data().asnumpy())
+
+
+def test_reads_hand_built_reference_bytes(tmp_path):
+    """Bytes assembled exactly per the reference serializer layout."""
+    arr = onp.array([[1.5, -2.0]], onp.float32)
+    rec = struct.pack("<Ii", lf.V2_MAGIC, 0)          # V2, dense
+    rec += struct.pack("<i", 2) + struct.pack("<2q", 1, 2)
+    rec += struct.pack("<ii", 1, 0)                    # cpu(0)
+    rec += struct.pack("<i", 0)                        # float32
+    rec += arr.tobytes()
+    name = b"x"
+    blob = struct.pack("<QQ", lf.LIST_MAGIC, 0)
+    blob += struct.pack("<Q", 1) + rec
+    blob += struct.pack("<Q", 1) + struct.pack("<Q", len(name)) + name
+    path = tmp_path / "ref.params"
+    path.write_bytes(blob)
+    out = lf.load_legacy(str(path))
+    onp.testing.assert_array_equal(out["x"], arr)
+
+
+def test_v1_and_ancient_records(tmp_path):
+    arr = onp.array([3.0, 4.0], onp.float32)
+    # V1: magic + int64 shape, no storage type
+    rec_v1 = struct.pack("<I", lf.V1_MAGIC)
+    rec_v1 += struct.pack("<i", 1) + struct.pack("<q", 2)
+    rec_v1 += struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+    rec_v1 += arr.tobytes()
+    # ancient: first uint32 IS ndim, uint32 extents
+    rec_old = struct.pack("<I", 1) + struct.pack("<I", 2)
+    rec_old += struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+    rec_old += arr.tobytes()
+    blob = struct.pack("<QQ", lf.LIST_MAGIC, 0)
+    blob += struct.pack("<Q", 2) + rec_v1 + rec_old
+    blob += struct.pack("<Q", 0)
+    path = tmp_path / "old.params"
+    path.write_bytes(blob)
+    out = lf.load_legacy(str(path))
+    onp.testing.assert_array_equal(out[0], arr)
+    onp.testing.assert_array_equal(out[1], arr)
+
+
+def test_sparse_record_rejected(tmp_path):
+    rec = struct.pack("<Ii", lf.V2_MAGIC, 1)          # row_sparse
+    blob = struct.pack("<QQ", lf.LIST_MAGIC, 0)
+    blob += struct.pack("<Q", 1) + rec + struct.pack("<Q", 0)
+    path = tmp_path / "sp.params"
+    path.write_bytes(blob)
+    with pytest.raises(NotImplementedError, match="sparse"):
+        lf.load_legacy(str(path))
+
+
+def test_truncated_file_errors(tmp_path):
+    path = tmp_path / "t.params"
+    path.write_bytes(struct.pack("<QQQ", lf.LIST_MAGIC, 0, 3))
+    with pytest.raises(ValueError, match="truncated"):
+        lf.load_legacy(str(path))

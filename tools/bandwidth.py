@@ -90,20 +90,23 @@ def measure(mb=64, iters=10, mesh_spec=""):
             raise SystemExit(f"--mesh wants {ndev} devices, "
                              f"have {len(devices)}")
         flat = Mesh(devices, ("all",))
-        n_pad = (n // ndev) * ndev          # divisibility for any ndev
-        payload_mb = n_pad * 4 / (1 << 20)
+        # kvstore-gradient semantics: EVERY device holds a full mb-sized
+        # gradient; the all-reduce moves 2(n-1)/n * mb per device.  Shape
+        # (ndev, n) sharded on the leading axis gives each device one
+        # full-payload row.
+        grads = onp.broadcast_to(host[None, :], (ndev, n))
         sharded = jax.device_put(
-            host[:n_pad], NamedSharding(flat, P("all")))
+            grads, NamedSharding(flat, P("all", None)))
         ar = jax.jit(shard_map(
             lambda x: jax.lax.psum(x, "all"), mesh=flat,
-            in_specs=P("all"), out_specs=P(None)))
+            in_specs=P("all", None), out_specs=P(None, None)))
         _fence(ar(sharded))
         t0 = time.perf_counter()
         for _ in range(iters):
             out = ar(sharded)               # fresh psum each iteration
         _fence(out)
         dt = time.perf_counter() - t0
-        ring_bytes = 2 * (ndev - 1) / ndev * payload_mb * iters
+        ring_bytes = 2 * (ndev - 1) / ndev * mb * iters
         results["allreduce_GBps"] = ring_bytes / 1024 / dt
         results["mesh"] = mesh_spec
 

@@ -56,6 +56,7 @@ _ref_aliases.apply()
 # subsystems imported lazily on attribute access to keep import light
 _LAZY = {
     "sym": ".symbol",
+    "model": ".model",
     "symbol": ".symbol",
     "gluon": ".gluon",
     "optimizer": ".optimizer",

@@ -777,8 +777,17 @@ def _load_param_file(filename: str) -> Dict[str, onp.ndarray]:
             raise ValueError(
                 f"{filename} is a legacy NDArray LIST; load_parameters "
                 "needs a name-keyed save")
-        # the reference prefixes keys with 'arg:'/'aux:' in some exports
-        return {k.split(":", 1)[-1]: v for k, v in loaded.items()}
+        # strip only the literal reference prefixes; anything else in the
+        # key (scoped names containing ':') is part of the name
+        out = {}
+        for k, v in loaded.items():
+            name = k[4:] if k.startswith(("arg:", "aux:")) else k
+            if name in out:
+                raise ValueError(
+                    f"legacy checkpoint has colliding entries for {name!r} "
+                    "(both arg: and aux:?)")
+            out[name] = v
+        return out
     with onp.load(filename, allow_pickle=False) as z:
         return {k: z[k] for k in z.files}
 

@@ -1,0 +1,59 @@
+"""``mx.model`` checkpoint helpers (reference ``python/mxnet/model.py``
+save_checkpoint:189 / load_params:221 / load_checkpoint:238).
+
+The classic prefix-epoch checkpoint layout: ``<prefix>-symbol.json`` +
+``<prefix>-NNNN.params`` with ``arg:``/``aux:`` prefixed parameter names.
+Params are written in the reference's BINARY format (legacy_format.py),
+so checkpoints exchange with Apache MXNet in both directions.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+__all__ = ["save_checkpoint", "load_params", "load_checkpoint"]
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
+                    aux_params: Dict, remove_amp_cast: bool = True) -> None:
+    """Write prefix-symbol.json + prefix-{epoch:04d}.params (reference
+    model.py:189)."""
+    from .ndarray import NDArray, array, save_legacy
+
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+
+    def as_nd(v):
+        return v if isinstance(v, NDArray) else array(v)
+
+    payload = {f"arg:{k}": as_nd(v) for k, v in (arg_params or {}).items()}
+    payload.update(
+        {f"aux:{k}": as_nd(v) for k, v in (aux_params or {}).items()})
+    save_legacy(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_params(prefix: str, epoch: int) -> Tuple[Dict, Dict]:
+    """-> (arg_params, aux_params), both name -> NDArray (reference
+    model.py:221)."""
+    from .ndarray import load
+
+    loaded = load(f"{prefix}-{epoch:04d}.params")
+    if not isinstance(loaded, dict):
+        raise ValueError("checkpoint params must be a name-keyed save")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """-> (symbol, arg_params, aux_params) (reference model.py:238)."""
+    from . import symbol as sym
+
+    symbol = sym.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

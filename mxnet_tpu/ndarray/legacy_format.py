@@ -72,6 +72,7 @@ def _read_shape(r: _Reader, int64_ext: bool, ndim: int = None) -> Tuple:
 
 def _read_ndarray(r: _Reader) -> onp.ndarray:
     magic = r.u32()
+    np_shape = magic == V3_MAGIC
     if magic in (V2_MAGIC, V3_MAGIC):
         stype = r.i32()
         if stype != 0:    # kDefaultStorage == 0 (ndarray.h:60)
@@ -83,7 +84,9 @@ def _read_ndarray(r: _Reader) -> onp.ndarray:
         shape = _read_shape(r, int64_ext=True)
     else:                 # ancient: magic IS the ndim, uint32 extents
         shape = _read_shape(r, int64_ext=False, ndim=magic)
-    if shape is None:
+    # "none" records END here — no ctx/dtype/data follow (ndarray.cc Load:
+    # legacy semantics: ndim == 0; np semantics: unknown shape ndim == -1)
+    if shape is None or (not np_shape and len(shape) == 0):
         return onp.zeros((0,), onp.float32)
     r.i32()               # dev_type
     r.i32()               # dev_id
@@ -146,6 +149,13 @@ def save_legacy(fname: str, data: Union[Dict[str, onp.ndarray],
         if a.dtype not in _DTYPE_TO_FLAG:
             raise TypeError(f"dtype {a.dtype} has no legacy flag (cast "
                             "bf16 etc. to float32 first)")
+        if a.ndim == 0 or a.size == 0:
+            # legacy (non-np) V2 semantics treat ndim==0 as a "none"
+            # record with no payload; writing one would desync the
+            # reference's loader on the NEXT record
+            raise ValueError(
+                "legacy format cannot represent 0-d or zero-size arrays "
+                f"(shape {a.shape}); reshape scalars to (1,) first")
         out.append(struct.pack("<Ii", V2_MAGIC, 0))          # V2, dense
         out.append(struct.pack("<i", a.ndim))
         out.append(struct.pack(f"<{a.ndim}q", *a.shape))

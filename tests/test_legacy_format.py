@@ -110,3 +110,52 @@ def test_truncated_file_errors(tmp_path):
     path.write_bytes(struct.pack("<QQQ", lf.LIST_MAGIC, 0, 3))
     with pytest.raises(ValueError, match="truncated"):
         lf.load_legacy(str(path))
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    """mx.model.save_checkpoint / load_checkpoint (reference model.py:189)
+    with arg:/aux: prefixes and the legacy binary params format."""
+    from mxnet_tpu import symbol as S
+
+    x = S.var("data")
+    w = S.var("w")
+    y = S.FullyConnected(x, w, None, num_hidden=3, no_bias=True)
+    arg = {"w": nd.array(onp.random.RandomState(0).rand(3, 4)
+                         .astype("float32"))}
+    aux = {"moving_mean": nd.zeros((3,))}
+    prefix = str(tmp_path / "ckpt")
+    mx.model.save_checkpoint(prefix, 7, y, arg, aux)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    onp.testing.assert_array_equal(arg2["w"].asnumpy(),
+                                   arg["w"].asnumpy())
+    onp.testing.assert_array_equal(aux2["moving_mean"].asnumpy(),
+                                   onp.zeros(3, onp.float32))
+    assert "data" in sym2.list_arguments()
+    # the params file itself is reference-format binary
+    import struct
+    head = open(f"{prefix}-0007.params", "rb").read(8)
+    assert struct.unpack("<Q", head)[0] == lf.LIST_MAGIC
+
+
+def test_none_record_and_zero_size_rejection(tmp_path):
+    """V2 ndim==0 'none' records end without ctx/dtype/data (reference
+    Load early return) and must not desync the following record; writing
+    0-d/0-size arrays is rejected."""
+    arr = onp.array([9.0], onp.float32)
+    none_rec = struct.pack("<Ii", lf.V2_MAGIC, 0) + struct.pack("<i", 0)
+    full_rec = struct.pack("<Ii", lf.V2_MAGIC, 0)
+    full_rec += struct.pack("<i", 1) + struct.pack("<q", 1)
+    full_rec += struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+    full_rec += arr.tobytes()
+    blob = struct.pack("<QQ", lf.LIST_MAGIC, 0)
+    blob += struct.pack("<Q", 2) + none_rec + full_rec
+    blob += struct.pack("<Q", 0)
+    path = tmp_path / "none.params"
+    path.write_bytes(blob)
+    out = lf.load_legacy(str(path))
+    assert out[0].size == 0
+    onp.testing.assert_array_equal(out[1], arr)
+
+    with pytest.raises(ValueError, match="zero-size|0-d"):
+        lf.save_legacy(str(tmp_path / "bad.params"),
+                       {"s": onp.float32(1.0).reshape(())})

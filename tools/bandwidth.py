@@ -94,9 +94,12 @@ def measure(mb=64, iters=10, mesh_spec=""):
         # gradient; the all-reduce moves 2(n-1)/n * mb per device.  Shape
         # (ndev, n) sharded on the leading axis gives each device one
         # full-payload row.
-        grads = onp.broadcast_to(host[None, :], (ndev, n))
-        sharded = jax.device_put(
-            grads, NamedSharding(flat, P("all", None)))
+        sharding = NamedSharding(flat, P("all", None))
+        # one row per device, one row on the host — device_put of a
+        # broadcast view would materialize ndev full copies host-side
+        row = host[None, :]
+        sharded = jax.make_array_from_callback(
+            (ndev, n), sharding, lambda idx: row)
         ar = jax.jit(shard_map(
             lambda x: jax.lax.psum(x, "all"), mesh=flat,
             in_specs=P("all", None), out_specs=P(None, None)))

@@ -1,0 +1,100 @@
+"""Loss-function edge cases (reference tests/python/unittest/test_loss.py
+scenarios: weighting, masking, numerical stability, known-value oracles)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def test_softmax_ce_matches_manual():
+    rng = onp.random.RandomState(0)
+    logits = rng.randn(4, 5).astype(onp.float32)
+    labels = onp.array([0, 2, 4, 1], onp.int32)
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()(
+        nd.array(logits), nd.array(labels)).asnumpy()
+    p = onp.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    expect = -onp.log(p[onp.arange(4), labels])
+    onp.testing.assert_allclose(loss, expect, rtol=1e-5)
+
+
+def test_softmax_ce_sparse_vs_dense_labels():
+    rng = onp.random.RandomState(1)
+    logits = rng.randn(3, 4).astype(onp.float32)
+    sparse = onp.array([1, 3, 0], onp.int32)
+    dense = onp.eye(4, dtype=onp.float32)[sparse]
+    l1 = gluon.loss.SoftmaxCrossEntropyLoss()(
+        nd.array(logits), nd.array(sparse)).asnumpy()
+    l2 = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        nd.array(logits), nd.array(dense)).asnumpy()
+    onp.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_sample_weight_zeroes_contributions():
+    rng = onp.random.RandomState(2)
+    pred = nd.array(rng.rand(4, 3).astype(onp.float32))
+    label = nd.array(rng.rand(4, 3).astype(onp.float32))
+    w = nd.array(onp.array([1, 0, 1, 0], onp.float32).reshape(4, 1))
+    loss = gluon.loss.L2Loss()(pred, label, w).asnumpy()
+    assert loss[1] == 0 and loss[3] == 0
+    assert loss[0] > 0 and loss[2] > 0
+
+
+def test_sigmoid_bce_extreme_logits_stable():
+    """Large-magnitude logits must not produce inf/nan (log-sum-exp
+    stability, reference test_bce_loss)."""
+    pred = nd.array(onp.array([[50.0], [-50.0]], onp.float32))
+    label = nd.array(onp.array([[1.0], [0.0]], onp.float32))
+    loss = gluon.loss.SigmoidBinaryCrossEntropyLoss()(pred, label).asnumpy()
+    assert onp.isfinite(loss).all() and (loss >= 0).all()
+    assert loss.max() < 1e-3          # correct prediction -> tiny loss
+    # wrong-way extreme logits -> ~|logit| loss, still finite
+    loss2 = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        pred, 1 - label).asnumpy()
+    onp.testing.assert_allclose(loss2.ravel(), [50.0, 50.0], rtol=1e-3)
+
+
+def test_kl_div_known_value():
+    """from_logits=True consumes LOG-probabilities (reference loss.py
+    KLDivLoss contract); the value is mean-over-axis KL."""
+    p = onp.array([[0.2, 0.3, 0.5]], onp.float32)
+    q = onp.array([[0.3, 0.3, 0.4]], onp.float32)
+    loss = gluon.loss.KLDivLoss(from_logits=True)(
+        nd.array(onp.log(q)), nd.array(p))
+    expect = (p * onp.log(p / q)).sum() / 3  # mean over axis
+    onp.testing.assert_allclose(float(loss.asnumpy()[0]), expect,
+                                rtol=1e-4)
+
+
+def test_huber_transitions_quadratic_to_linear():
+    rho = 1.0
+    pred = nd.array(onp.array([[0.5], [3.0]], onp.float32))
+    label = nd.zeros((2, 1))
+    loss = gluon.loss.HuberLoss(rho=rho)(pred, label).asnumpy().ravel()
+    onp.testing.assert_allclose(loss[0], 0.5 * 0.5 ** 2, rtol=1e-5)
+    onp.testing.assert_allclose(loss[1], 3.0 - 0.5 * rho, rtol=1e-5)
+
+
+def test_triplet_loss_margin_semantics():
+    a = nd.zeros((2, 4))
+    pos = nd.zeros((2, 4))
+    neg = nd.array(onp.full((2, 4), 10.0, onp.float32))
+    loss = gluon.loss.TripletLoss(margin=1.0)(a, pos, neg).asnumpy()
+    assert (loss == 0).all()          # negative far away -> no loss
+    loss2 = gluon.loss.TripletLoss(margin=1.0)(a, neg, pos).asnumpy()
+    assert (loss2 > 0).all()          # swapped -> margin violated
+
+
+def test_losses_backward_finite():
+    rng = onp.random.RandomState(3)
+    pred = nd.array(rng.rand(4, 6).astype(onp.float32))
+    pred.attach_grad()
+    label = nd.array(rng.rand(4, 6).astype(onp.float32))
+    for loss_fn in (gluon.loss.L1Loss(), gluon.loss.L2Loss(),
+                    gluon.loss.HuberLoss(),
+                    gluon.loss.SigmoidBinaryCrossEntropyLoss()):
+        with autograd.record():
+            loss = loss_fn(pred, label).sum()
+        loss.backward()
+        assert onp.isfinite(pred.grad.asnumpy()).all(), type(loss_fn)

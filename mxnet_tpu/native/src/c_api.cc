@@ -17,6 +17,20 @@
 #include <mutex>
 #include <string>
 
+
+// Entry points must not touch PyGILState before the interpreter exists:
+// PyGILState_Ensure with no interpreter is undefined behavior (a crash in
+// practice), not the intended -1 + "not initialized" error.  The unlocked
+// read covers the pre-init case only: MXTpuLibShutdown clears g_bridge,
+// so shutdown racing in-flight calls remains undefined — callers must
+// quiesce all API threads before MXTpuLibShutdown (same contract as the
+// reference's MXNotifyShutdown).
+#define MXTPU_REQUIRE_INIT()                                                 \
+  do {                                                                       \
+    if (!Py_IsInitialized() || !g_bridge)                                    \
+      return Fail("mxnet_tpu C API not initialized: call MXTpuLibInit");     \
+  } while (0)
+
 extern "C" {
 
 typedef void *NDArrayHandle;
@@ -151,6 +165,7 @@ int MXTpuLibShutdown(void) {
 
 int MXTpuGetVersion(int *out) {
   if (!out) return Fail("MXTpuGetVersion: out is NULL");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *ret = CallBridge("version", nullptr);
   if (!ret) return FailFromPython();
@@ -160,6 +175,7 @@ int MXTpuGetVersion(int *out) {
 }
 
 int MXTpuLibInfoFeatures(char *buf, size_t buflen, int *count) {
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *ret = CallBridge("features", nullptr);
   if (!ret) return FailFromPython();
@@ -188,6 +204,7 @@ int MXTpuNDArrayCreate(const void *data, const int64_t *shape, int ndim,
                        const char *dtype, NDArrayHandle *out) {
   if (!data || !shape || ndim < 0 || !dtype || !out)
     return Fail("MXTpuNDArrayCreate: NULL argument");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *shp = PyTuple_New(ndim);
   int64_t numel = 1;
@@ -234,6 +251,7 @@ int MXTpuNDArrayCreate(const void *data, const int64_t *shape, int ndim,
 
 int MXTpuNDArrayFree(NDArrayHandle handle) {
   if (!handle) return 0;
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   Py_DECREF(static_cast<PyObject *>(handle));
   return 0;
@@ -241,6 +259,7 @@ int MXTpuNDArrayFree(NDArrayHandle handle) {
 
 int MXTpuNDArrayGetNDim(NDArrayHandle handle, int *out) {
   if (!handle || !out) return Fail("MXTpuNDArrayGetNDim: NULL argument");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
   PyObject *shp = CallBridge("shape_of", args);
@@ -253,6 +272,7 @@ int MXTpuNDArrayGetNDim(NDArrayHandle handle, int *out) {
 
 int MXTpuNDArrayGetShape(NDArrayHandle handle, int64_t *shape, int max_ndim) {
   if (!handle || !shape) return Fail("MXTpuNDArrayGetShape: NULL argument");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
   PyObject *shp = CallBridge("shape_of", args);
@@ -271,6 +291,7 @@ int MXTpuNDArrayGetShape(NDArrayHandle handle, int64_t *shape, int max_ndim) {
 
 int MXTpuNDArrayGetDType(NDArrayHandle handle, char *buf, size_t buflen) {
   if (!handle) return Fail("MXTpuNDArrayGetDType: NULL handle");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
   PyObject *dt = CallBridge("dtype_of", args);
@@ -288,6 +309,7 @@ int MXTpuNDArrayGetDType(NDArrayHandle handle, char *buf, size_t buflen) {
 
 int MXTpuNDArraySize(NDArrayHandle handle, int64_t *out) {
   if (!handle || !out) return Fail("MXTpuNDArraySize: NULL argument");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
   PyObject *shp = CallBridge("shape_of", args);
@@ -303,6 +325,7 @@ int MXTpuNDArraySize(NDArrayHandle handle, int64_t *out) {
 
 int MXTpuNDArraySyncCopyToCPU(NDArrayHandle handle, void *out, size_t nbytes) {
   if (!handle || !out) return Fail("MXTpuNDArraySyncCopyToCPU: NULL argument");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
   PyObject *bytes = CallBridge("to_bytes", args);
@@ -327,6 +350,7 @@ int MXTpuNDArraySyncCopyToCPU(NDArrayHandle handle, void *out, size_t nbytes) {
 
 int MXTpuNDArrayWaitToRead(NDArrayHandle handle) {
   if (!handle) return Fail("MXTpuNDArrayWaitToRead: NULL handle");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
   PyObject *ret = CallBridge("wait_to_read", args);
@@ -337,6 +361,7 @@ int MXTpuNDArrayWaitToRead(NDArrayHandle handle) {
 }
 
 int MXTpuNDArrayWaitAll(void) {
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *ret = CallBridge("wait_all", nullptr);
   if (!ret) return FailFromPython();
@@ -350,6 +375,7 @@ int MXTpuNDArrayWaitAll(void) {
 
 int MXTpuOpCount(int *out) {
   if (!out) return Fail("MXTpuOpCount: out is NULL");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *ops = CallBridge("list_ops", nullptr);
   if (!ops) return FailFromPython();
@@ -359,6 +385,7 @@ int MXTpuOpCount(int *out) {
 }
 
 int MXTpuListOps(char *buf, size_t buflen, int *count) {
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *ops = CallBridge("list_ops", nullptr);
   if (!ops) return FailFromPython();
@@ -385,6 +412,7 @@ int MXTpuImperativeInvoke(const char *op_name, NDArrayHandle *inputs,
                           int *num_outputs) {
   if (!op_name || (num_inputs > 0 && !inputs) || !outputs || !num_outputs)
     return Fail("MXTpuImperativeInvoke: NULL argument");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *ins = PyList_New(num_inputs);
   for (int i = 0; i < num_inputs; ++i) {
@@ -420,6 +448,7 @@ int MXTpuImperativeInvoke(const char *op_name, NDArrayHandle *inputs,
 // ---------------------------------------------------------------------
 
 int MXTpuAutogradSetRecording(int is_recording, int *prev) {
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(i)", is_recording);
   PyObject *ret = CallBridge("set_recording", args);
@@ -432,6 +461,7 @@ int MXTpuAutogradSetRecording(int is_recording, int *prev) {
 
 int MXTpuNDArrayAttachGrad(NDArrayHandle handle) {
   if (!handle) return Fail("MXTpuNDArrayAttachGrad: NULL handle");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
   PyObject *ret = CallBridge("attach_grad", args);
@@ -443,6 +473,7 @@ int MXTpuNDArrayAttachGrad(NDArrayHandle handle) {
 
 int MXTpuAutogradBackward(NDArrayHandle head) {
   if (!head) return Fail("MXTpuAutogradBackward: NULL handle");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(head));
   PyObject *ret = CallBridge("backward", args);
@@ -454,6 +485,7 @@ int MXTpuAutogradBackward(NDArrayHandle head) {
 
 int MXTpuNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
   if (!handle || !out) return Fail("MXTpuNDArrayGetGrad: NULL argument");
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(O)", static_cast<PyObject *>(handle));
   PyObject *g = CallBridge("grad_of", args);
@@ -468,6 +500,7 @@ int MXTpuNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
 // ---------------------------------------------------------------------
 
 int MXTpuRandomSeed(int seed) {
+  MXTPU_REQUIRE_INIT();
   Gil gil;
   PyObject *args = Py_BuildValue("(i)", seed);
   PyObject *ret = CallBridge("seed", args);

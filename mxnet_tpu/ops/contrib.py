@@ -94,7 +94,8 @@ def index_array(data, axes=None):
     shape = data.shape
     axes = tuple(axes) if axes else tuple(range(len(shape)))
     grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in axes], indexing="ij")
-    return jnp.stack(grids, axis=-1).astype(jnp.int64)
+    with jax.enable_x64(True):   # reference index_array emits int64
+        return jnp.stack(grids, axis=-1).astype(jnp.int64)
 
 
 @register("allclose", num_inputs=2, differentiable=False)

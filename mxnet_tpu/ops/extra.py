@@ -25,7 +25,8 @@ from .registry import alias, register
 @register("unravel_index", num_inputs=1, differentiable=False)
 def unravel_index(data, shape=None):
     """Flat indices [N] -> coordinates [ndim, N] (tensor/ravel.cc)."""
-    coords = jnp.unravel_index(data.astype(jnp.int64), tuple(shape))
+    with jax.enable_x64(True):   # honest int64 (reference ravel.cc)
+        coords = jnp.unravel_index(data.astype(jnp.int64), tuple(shape))
     return jnp.stack([c.astype(data.dtype) for c in coords], axis=0)
 
 
@@ -33,9 +34,10 @@ def unravel_index(data, shape=None):
 def ravel_multi_index(data, shape=None):
     """Coordinates [ndim, N] -> flat indices [N] (tensor/ravel.cc)."""
     shape = tuple(int(s) for s in shape)
-    idx = 0
-    for d, s in enumerate(shape):
-        idx = idx * s + data[d].astype(jnp.int64)
+    with jax.enable_x64(True):   # honest int64 (reference ravel.cc)
+        idx = 0
+        for d, s in enumerate(shape):
+            idx = idx * s + data[d].astype(jnp.int64)
     return idx.astype(data.dtype)
 
 
@@ -263,28 +265,32 @@ def logical_xor(lhs, rhs):
 @register("bitwise_and", num_inputs=2, differentiable=False,
           namespaces=("nd", "np"))
 def bitwise_and(lhs, rhs):
-    return jnp.bitwise_and(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
-        .astype(lhs.dtype)
+    with jax.enable_x64(True):   # int64 semantics without x32 truncation
+        return jnp.bitwise_and(lhs.astype(jnp.int64),
+                               rhs.astype(jnp.int64)).astype(lhs.dtype)
 
 
 @register("bitwise_or", num_inputs=2, differentiable=False,
           namespaces=("nd", "np"))
 def bitwise_or(lhs, rhs):
-    return jnp.bitwise_or(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
-        .astype(lhs.dtype)
+    with jax.enable_x64(True):
+        return jnp.bitwise_or(lhs.astype(jnp.int64),
+                              rhs.astype(jnp.int64)).astype(lhs.dtype)
 
 
 @register("bitwise_xor", num_inputs=2, differentiable=False,
           namespaces=("nd", "np"))
 def bitwise_xor(lhs, rhs):
-    return jnp.bitwise_xor(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
-        .astype(lhs.dtype)
+    with jax.enable_x64(True):
+        return jnp.bitwise_xor(lhs.astype(jnp.int64),
+                               rhs.astype(jnp.int64)).astype(lhs.dtype)
 
 
 @register("bitwise_not", num_inputs=1, differentiable=False,
           aliases=["invert"], namespaces=("nd", "np"))
 def bitwise_not(data):
-    return jnp.bitwise_not(data.astype(jnp.int64)).astype(data.dtype)
+    with jax.enable_x64(True):
+        return jnp.bitwise_not(data.astype(jnp.int64)).astype(data.dtype)
 
 
 @register("digamma", num_inputs=1)
@@ -576,7 +582,8 @@ def edge_id(adjacency, u, v):
     (u[i], v[i]) pair, -1 where absent.  CSR containers densify through
     ``.todense()`` at the frontend."""
     vals = adjacency[u.astype(jnp.int32), v.astype(jnp.int32)]
-    return jnp.where(vals > 0, vals - 1, -1).astype(jnp.int64)
+    with jax.enable_x64(True):   # reference returns int64 edge ids
+        return jnp.where(vals > 0, vals - 1, -1).astype(jnp.int64)
 
 
 @register("sparse_retain", num_inputs=2, differentiable=False,

@@ -55,6 +55,13 @@ def image_resize(data, size=(0, 0), keep_ratio=False, interp=1):
     else:
         H, W = data.shape[1:3]
     if keep_ratio:
+        # the reference only allows keep_ratio with a scalar size
+        # (image/resize-inl.h); silently treating a (w, h) tuple as a
+        # shorter-edge target would hand back an unexpected output shape
+        if w != h:
+            raise ValueError(
+                "image_resize: keep_ratio=True requires a scalar size "
+                f"(shorter-edge target), got (w, h) = ({w}, {h})")
         short = min(H, W)
         scale = w / short          # single-int semantics: shorter edge
         h, w = int(round(H * scale)), int(round(W * scale))

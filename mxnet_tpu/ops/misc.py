@@ -186,9 +186,10 @@ def getnnz(data, axis=None):
     """Count stored (non-zero) values (reference _contrib_getnnz over CSR;
     dense layout here, so it counts non-zeros)."""
     nz = (data != 0)
-    if axis is None:
-        return jnp.sum(nz).astype(jnp.int64)
-    return jnp.sum(nz, axis=axis).astype(jnp.int64)
+    with jax.enable_x64(True):   # reference returns int64 counts
+        if axis is None:
+            return jnp.sum(nz).astype(jnp.int64)
+        return jnp.sum(nz, axis=axis).astype(jnp.int64)
 
 
 @register("dynamic_reshape", num_inputs=2, differentiable=False,

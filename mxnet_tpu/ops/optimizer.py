@@ -189,12 +189,40 @@ def lamb_update_phase2(arrays, lr=0.01, lower_bound=-1.0, upper_bound=-1.0):
 
 
 # --- multi-tensor fused updates (reference contrib multi_* / preloaded_*) --
+#
+# INPUT LAYOUT: the reference lists multi-tensor inputs INTERLEAVED per
+# weight — weight_0, grad_0, (mom_0/mean_0/..,) weight_1, grad_1, ... —
+# see optimizer_op.cc:321 (multi_sgd FListInputNames),
+# preloaded_multi_sgd.cc:55, multi_lamb.cc:186 (LAMBParamToVector), and
+# adamw.cc:177.  These ops follow that convention exactly so call sites
+# written against the reference keep working.  OUTPUT layout is blocked by
+# kind (all new weights, then all new aux states): the reference mutates
+# aux states in place and only *returns* weights, so there is no reference
+# output convention for the aux arrays — blocked is this framework's
+# functional-update convention.
+
+
+def _interleaved(arrays, kinds, num_weights=0, trailing=0):
+    """Split the reference's interleaved multi-tensor input layout into
+    per-kind tuples; ``trailing`` arrays (e.g. lrs, wds) follow the body."""
+    body_len = len(arrays) - trailing
+    n = num_weights or body_len // kinds
+    if body_len != n * kinds:
+        raise ValueError(
+            f"multi-tensor op expects {kinds} interleaved arrays per weight"
+            f" (+{trailing} trailing); got {len(arrays)} arrays for"
+            f" num_weights={n}")
+    groups = tuple(tuple(arrays[i * kinds + k] for i in range(n))
+                   for k in range(kinds))
+    return n, groups, tuple(arrays[body_len:])
+
 
 @register("multi_sgd_update", num_inputs=-1, num_outputs=-1, differentiable=False)
 def multi_sgd_update(arrays, lrs=(), wds=(), rescale_grad=1.0,
                      clip_gradient=-1.0, num_weights=0):
-    n = num_weights or len(arrays) // 2
-    weights, grads = arrays[:n], arrays[n:2 * n]
+    """arrays = [w0, g0, w1, g1, ...] (interleaved; reference
+    optimizer_op.cc:321) -> (new_w0, new_w1, ...)."""
+    n, (weights, grads), _ = _interleaved(arrays, 2, num_weights)
     outs = []
     for w, g, lr, wd in zip(weights, grads, lrs, wds):
         gg = _apply_wd(g, w, wd, rescale_grad, clip_gradient)
@@ -205,8 +233,9 @@ def multi_sgd_update(arrays, lrs=(), wds=(), rescale_grad=1.0,
 @register("multi_sgd_mom_update", num_inputs=-1, num_outputs=-1, differentiable=False)
 def multi_sgd_mom_update(arrays, lrs=(), wds=(), momentum=0.0, rescale_grad=1.0,
                          clip_gradient=-1.0, num_weights=0):
-    n = num_weights or len(arrays) // 3
-    weights, grads, moms = arrays[:n], arrays[n:2 * n], arrays[2 * n:3 * n]
+    """arrays = [w0, g0, m0, w1, g1, m1, ...] (interleaved) ->
+    (new_w..., new_m...)."""
+    n, (weights, grads, moms), _ = _interleaved(arrays, 3, num_weights)
     outs = []
     for w, g, m, lr, wd in zip(weights, grads, moms, lrs, wds):
         gg = _apply_wd(g, w, wd, rescale_grad, clip_gradient)
@@ -228,10 +257,10 @@ def multi_lamb_update(arrays, learning_rates=(), wds=(), beta1=0.9,
                       beta2=0.999, epsilon=1e-6, rescale_grad=1.0,
                       lower_bound=-1.0, upper_bound=-1.0, clip_gradient=-1.0,
                       bias_correction=True, step_count=(), num_tensors=0):
-    """Fused multi-tensor LAMB (reference contrib/multi_lamb.cc): arrays =
-    [w0..wn-1, g0.., m0.., v0..] -> (new_w..., new_m..., new_v...)."""
-    n = num_tensors or len(arrays) // 4
-    ws, gs, ms, vs = (arrays[i * n:(i + 1) * n] for i in range(4))
+    """Fused multi-tensor LAMB (reference contrib/multi_lamb.cc:186): arrays
+    = [w0, g0, m0, v0, w1, ...] (interleaved) ->
+    (new_w..., new_m..., new_v...)."""
+    n, (ws, gs, ms, vs), _ = _interleaved(arrays, 4, num_tensors)
     new_w, new_m, new_v = [], [], []
     for i, (w, g, m, v) in enumerate(zip(ws, gs, ms, vs)):
         t = step_count[i] if i < len(step_count) else 1
@@ -277,10 +306,10 @@ def multi_lans_update(arrays, learning_rates=(), wds=(), beta1=0.9,
         w   -= lr * (beta1 * (||w||/||d_m||) * d_m
                      + (1-beta1) * (||w||/||d_g||) * d_g)
 
-    arrays = [w..., g..., m..., v...] -> (new_w..., new_m..., new_v...).
+    arrays = [w0, g0, m0, v0, w1, ...] (interleaved) ->
+    (new_w..., new_m..., new_v...).
     """
-    n = num_tensors or len(arrays) // 4
-    ws, gs, ms, vs = (arrays[i * n:(i + 1) * n] for i in range(4))
+    n, (ws, gs, ms, vs), _ = _interleaved(arrays, 4, num_tensors)
     new_w, new_m, new_v = [], [], []
     for i, (w, g, m, v) in enumerate(zip(ws, gs, ms, vs)):
         t = step_count[i] if i < len(step_count) else 1
@@ -434,20 +463,14 @@ def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
 # of attrs, so LR schedules never force a re-trace (reference
 # contrib/preloaded_multi_sgd.cc) ---------------------------------------
 
-def _preloaded_split(arrays, per_weight, num_weights):
-    n = num_weights or (len(arrays) - 2) // per_weight
-    groups = [arrays[i * n:(i + 1) * n] for i in range(per_weight)]
-    lrs, wds = arrays[per_weight * n], arrays[per_weight * n + 1]
-    return n, groups, lrs, wds
-
-
 @register("preloaded_multi_sgd_update", num_inputs=-1, num_outputs=-1,
           differentiable=False)
 def preloaded_multi_sgd_update(arrays, rescale_grad=1.0, clip_gradient=-1.0,
                                num_weights=0):
-    """arrays = [w..., g..., lrs, wds] (reference preloaded_multi_sgd.cc).
-    """
-    n, (ws, gs), lrs, wds = _preloaded_split(arrays, 2, num_weights)
+    """arrays = [w0, g0, w1, g1, ..., lrs, wds] (interleaved; reference
+    preloaded_multi_sgd.cc:55)."""
+    n, (ws, gs), (lrs, wds) = _interleaved(arrays, 2, num_weights,
+                                           trailing=2)
     outs = []
     for i, (w, g) in enumerate(zip(ws, gs)):
         gg = _apply_wd(g, w, wds[i], rescale_grad, clip_gradient)
@@ -459,7 +482,10 @@ def preloaded_multi_sgd_update(arrays, rescale_grad=1.0, clip_gradient=-1.0,
           differentiable=False)
 def preloaded_multi_sgd_mom_update(arrays, momentum=0.0, rescale_grad=1.0,
                                    clip_gradient=-1.0, num_weights=0):
-    n, (ws, gs, ms), lrs, wds = _preloaded_split(arrays, 3, num_weights)
+    """arrays = [w0, g0, m0, w1, ..., lrs, wds] (interleaved; reference
+    preloaded_multi_sgd.cc:104)."""
+    n, (ws, gs, ms), (lrs, wds) = _interleaved(arrays, 3, num_weights,
+                                               trailing=2)
     new_w, new_m = [], []
     for i, (w, g, m) in enumerate(zip(ws, gs, ms)):
         gg = _apply_wd(g, w, wds[i], rescale_grad, clip_gradient)
@@ -473,8 +499,10 @@ def preloaded_multi_sgd_mom_update(arrays, momentum=0.0, rescale_grad=1.0,
           differentiable=False)
 def preloaded_multi_mp_sgd_update(arrays, rescale_grad=1.0,
                                   clip_gradient=-1.0, num_weights=0):
-    """arrays = [w..., g..., w32..., lrs, wds] -> (w..., w32...)."""
-    n, (ws, gs, w32s), lrs, wds = _preloaded_split(arrays, 3, num_weights)
+    """arrays = [w0, g0, w32_0, w1, ..., lrs, wds] (interleaved; reference
+    preloaded_multi_sgd.cc:153) -> (w..., w32...)."""
+    n, (ws, gs, w32s), (lrs, wds) = _interleaved(arrays, 3, num_weights,
+                                                 trailing=2)
     new_w, new_w32 = [], []
     for i, (w, g, w32) in enumerate(zip(ws, gs, w32s)):
         gg = _apply_wd(g.astype(jnp.float32), w32, wds[i], rescale_grad,
@@ -489,8 +517,10 @@ def preloaded_multi_mp_sgd_update(arrays, rescale_grad=1.0,
           num_outputs=-1, differentiable=False)
 def preloaded_multi_mp_sgd_mom_update(arrays, momentum=0.0, rescale_grad=1.0,
                                       clip_gradient=-1.0, num_weights=0):
-    n, (ws, gs, ms, w32s), lrs, wds = _preloaded_split(arrays, 4,
-                                                       num_weights)
+    """arrays = [w0, g0, m0, w32_0, w1, ..., lrs, wds] (interleaved;
+    reference preloaded_multi_sgd.cc:190)."""
+    n, (ws, gs, ms, w32s), (lrs, wds) = _interleaved(arrays, 4, num_weights,
+                                                     trailing=2)
     new_w, new_m, new_w32 = [], [], []
     for i, (w, g, m, w32) in enumerate(zip(ws, gs, ms, w32s)):
         gg = _apply_wd(g.astype(jnp.float32), w32, wds[i], rescale_grad,
@@ -507,9 +537,9 @@ def preloaded_multi_mp_sgd_mom_update(arrays, momentum=0.0, rescale_grad=1.0,
           differentiable=False)
 def multi_mp_sgd_update(arrays, lrs=(), wds=(), rescale_grad=1.0,
                         clip_gradient=-1.0, num_weights=0):
-    """[w..., g..., w32...] -> (w..., w32...) (reference multi_mp_sgd)."""
-    n = num_weights or len(arrays) // 3
-    ws, gs, w32s = (arrays[i * n:(i + 1) * n] for i in range(3))
+    """arrays = [w0, g0, w32_0, w1, ...] (interleaved; reference
+    optimizer_op.cc multi_mp_sgd) -> (w..., w32...)."""
+    n, (ws, gs, w32s), _ = _interleaved(arrays, 3, num_weights)
     new_w, new_w32 = [], []
     for w, g, w32, lr, wd in zip(ws, gs, w32s, lrs, wds):
         gg = _apply_wd(g.astype(jnp.float32), w32, wd, rescale_grad,
@@ -525,8 +555,9 @@ def multi_mp_sgd_update(arrays, lrs=(), wds=(), rescale_grad=1.0,
 def multi_mp_sgd_mom_update(arrays, lrs=(), wds=(), momentum=0.0,
                             rescale_grad=1.0, clip_gradient=-1.0,
                             num_weights=0):
-    n = num_weights or len(arrays) // 4
-    ws, gs, ms, w32s = (arrays[i * n:(i + 1) * n] for i in range(4))
+    """arrays = [w0, g0, m0, w32_0, w1, ...] (interleaved; reference
+    optimizer_op.cc multi_mp_sgd_mom FListInputNames)."""
+    n, (ws, gs, ms, w32s), _ = _interleaved(arrays, 4, num_weights)
     new_w, new_m, new_w32 = [], [], []
     for w, g, m, w32, lr, wd in zip(ws, gs, ms, w32s, lrs, wds):
         gg = _apply_wd(g.astype(jnp.float32), w32, wd, rescale_grad,
@@ -559,10 +590,16 @@ def mp_adamw_update(arrays, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
 def multi_adamw_update(arrays, lrs=(), wds=(), etas=(), beta1=0.9,
                        beta2=0.999, epsilon=1e-8, rescale_grad=1.0,
                        clip_gradient=-1.0, num_weights=0):
-    """Fused list-AdamW (reference contrib/adamw.cc multi variant):
-    arrays = [w..., g..., m..., v...] -> (w..., m..., v...)."""
-    n = num_weights or len(arrays) // 4
-    ws, gs, ms, vs = (arrays[i * n:(i + 1) * n] for i in range(4))
+    """Fused list-AdamW (reference contrib/adamw.cc:168 multi variant):
+    arrays = [w0, g0, m0, v0, w1, ...] (interleaved), optionally followed
+    by ONE trailing rescale_grad scalar tensor (the reference takes
+    num_weights*4 + 1 inputs) -> (w..., m..., v...)."""
+    trailing = 1 if (len(arrays) - (num_weights or 0) * 4 == 1
+                     or (not num_weights and len(arrays) % 4 == 1)) else 0
+    n, (ws, gs, ms, vs), rest = _interleaved(arrays, 4, num_weights,
+                                             trailing=trailing)
+    if rest:
+        rescale_grad = rest[0]
     new_w, new_m, new_v = [], [], []
     for i, (w, g, m, v) in enumerate(zip(ws, gs, ms, vs)):
         eta = etas[i] if i < len(etas) else 1.0
@@ -583,9 +620,15 @@ def multi_adamw_update(arrays, lrs=(), wds=(), etas=(), beta1=0.9,
 def multi_mp_adamw_update(arrays, lrs=(), wds=(), etas=(), beta1=0.9,
                           beta2=0.999, epsilon=1e-8, rescale_grad=1.0,
                           clip_gradient=-1.0, num_weights=0):
-    """[w..., g..., m..., v..., w32...] -> (w..., m..., v..., w32...)."""
-    n = num_weights or len(arrays) // 5
-    ws, gs, ms, vs, w32s = (arrays[i * n:(i + 1) * n] for i in range(5))
+    """arrays = [w0, g0, m0, v0, w32_0, w1, ...] (interleaved; reference
+    adamw.cc:224), optionally + ONE trailing rescale_grad tensor ->
+    (w..., m..., v..., w32...)."""
+    trailing = 1 if (len(arrays) - (num_weights or 0) * 5 == 1
+                     or (not num_weights and len(arrays) % 5 == 1)) else 0
+    n, (ws, gs, ms, vs, w32s), rest = _interleaved(arrays, 5, num_weights,
+                                                   trailing=trailing)
+    if rest:
+        rescale_grad = rest[0]
     new_w, new_m, new_v, new_w32 = [], [], [], []
     for i, (w, g, m, v, w32) in enumerate(zip(ws, gs, ms, vs, w32s)):
         eta = etas[i] if i < len(etas) else 1.0
@@ -609,13 +652,15 @@ def multi_mp_lamb_update(arrays, learning_rates=(), wds=(), beta1=0.9,
                          lower_bound=-1.0, upper_bound=-1.0,
                          clip_gradient=-1.0, bias_correction=True,
                          step_count=(), num_tensors=0):
-    """Master-weight multi-LAMB: [w..., g..., m..., v..., w32...] ->
-    (w..., m..., v..., w32...) (reference multi_lamb.cc mp variant)."""
-    n = num_tensors or len(arrays) // 5
-    ws, gs, ms, vs, w32s = (arrays[i * n:(i + 1) * n] for i in range(5))
+    """Master-weight multi-LAMB: arrays = [w0, g0, m0, v0, w32_0, w1, ...]
+    (interleaved; reference multi_lamb.cc:224 mp variant) ->
+    (w..., m..., v..., w32...)."""
+    n, (ws, gs, ms, vs, w32s), _ = _interleaved(arrays, 5, num_tensors)
+    inner = []
+    for w32, g, m, v in zip(w32s, gs, ms, vs):
+        inner += [w32, g.astype(jnp.float32), m, v]
     packed = multi_lamb_update(
-        list(w32s) + [g.astype(jnp.float32) for g in gs] + list(ms)
-        + list(vs),
+        inner,
         learning_rates=learning_rates, wds=wds, beta1=beta1, beta2=beta2,
         epsilon=epsilon, rescale_grad=rescale_grad, lower_bound=lower_bound,
         upper_bound=upper_bound, clip_gradient=clip_gradient,
@@ -632,12 +677,14 @@ def multi_mp_lans_update(arrays, learning_rates=(), wds=(), beta1=0.9,
                          beta2=0.999, epsilon=1e-6, rescale_grad=1.0,
                          lower_bound=-1.0, upper_bound=-1.0,
                          clip_gradient=-1.0, step_count=(), num_tensors=0):
-    """Master-weight multi-LANS, same layout as multi_mp_lamb_update."""
-    n = num_tensors or len(arrays) // 5
-    ws, gs, ms, vs, w32s = (arrays[i * n:(i + 1) * n] for i in range(5))
+    """Master-weight multi-LANS, same interleaved layout as
+    multi_mp_lamb_update."""
+    n, (ws, gs, ms, vs, w32s), _ = _interleaved(arrays, 5, num_tensors)
+    inner = []
+    for w32, g, m, v in zip(w32s, gs, ms, vs):
+        inner += [w32, g.astype(jnp.float32), m, v]
     packed = multi_lans_update(
-        list(w32s) + [g.astype(jnp.float32) for g in gs] + list(ms)
-        + list(vs),
+        inner,
         learning_rates=learning_rates, wds=wds, beta1=beta1, beta2=beta2,
         epsilon=epsilon, rescale_grad=rescale_grad, lower_bound=lower_bound,
         upper_bound=upper_bound, clip_gradient=clip_gradient,

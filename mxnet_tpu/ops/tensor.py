@@ -304,12 +304,18 @@ def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis
 
 @register("shape_array", differentiable=False)
 def shape_array(data):
-    return jnp.asarray(data.shape, dtype=jnp.int64)
+    """int64 like the reference (tensor/elemwise_unary_op.h shape_array).
+    Created under a local x64 scope: the global x32 default would silently
+    truncate, and a >2**31-element array's size must not wrap."""
+    with jax.enable_x64(True):
+        return jnp.asarray(data.shape, dtype=jnp.int64)
 
 
 @register("size_array", differentiable=False)
 def size_array(data):
-    return jnp.asarray([int(onp.prod(data.shape))], dtype=jnp.int64)
+    """int64 like the reference (see shape_array)."""
+    with jax.enable_x64(True):
+        return jnp.asarray([int(onp.prod(data.shape))], dtype=jnp.int64)
 
 
 @register("zeros_like")

@@ -83,6 +83,8 @@ class CheckpointManager:
         return os.path.join(self.directory, f"ckpt-{step}{self._suffix()}.pkl")
 
     def all_steps(self) -> List[int]:
+        """Steps with a file from ANY host (includes partially-saved steps;
+        use :meth:`complete_steps` when picking a restore point)."""
         pat = re.compile(r"ckpt-(\d+)(?:-h\d+)?\.pkl$")
         steps = set()
         for f in os.listdir(self.directory):
@@ -91,8 +93,33 @@ class CheckpointManager:
                 steps.add(int(m.group(1)))
         return sorted(steps)
 
+    def _present_hosts(self, step: int) -> set:
+        """Process indices whose file for ``step`` has landed (a file with
+        no -h suffix counts as host 0)."""
+        pat = re.compile(rf"ckpt-{step}(?:-h(\d+))?\.pkl$")
+        hosts = set()
+        for f in os.listdir(self.directory):
+            m = pat.match(f)
+            if m:
+                hosts.add(int(m.group(1) or 0))
+        return hosts
+
+    def complete_steps(self) -> List[int]:
+        """Steps whose per-host files exist for EVERY process.  Hosts save
+        asynchronously, so a crash can leave the newest step with only some
+        hosts' files; restoring it would raise on the lagging hosts or let
+        hosts silently resume from different steps.  Restore therefore
+        intersects across hosts and only offers steps every host finished.
+        """
+        n = jax.process_count()
+        return [s for s in self.all_steps()
+                if len(self._present_hosts(s)) >= n]
+
     def latest_step(self) -> Optional[int]:
-        steps = self.all_steps()
+        """Newest step complete on every host (the only safe restore
+        point under multi-controller; equals the newest file single-host).
+        """
+        steps = self.complete_steps()
         return steps[-1] if steps else None
 
     # -- save -----------------------------------------------------------
@@ -134,8 +161,16 @@ class CheckpointManager:
         self._gc()
 
     def _gc(self) -> None:
-        steps = self.all_steps()
-        for s in steps[:-self.keep] if self.keep > 0 else []:
+        if self.keep <= 0:
+            return
+        # retain the last ``keep`` COMPLETE steps, plus anything newer (its
+        # files may still be landing on other hosts) — counting a partial
+        # step toward ``keep`` could evict the only restorable checkpoint
+        protected = set(self.complete_steps()[-self.keep:])
+        newest = max(protected) if protected else -1
+        for s in self.all_steps():
+            if s in protected or s > newest:
+                continue
             for f in os.listdir(self.directory):
                 if re.match(rf"ckpt-{s}(?:-h\d+)?\.pkl$", f):
                     try:

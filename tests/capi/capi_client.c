@@ -34,6 +34,15 @@
 
 int main(int argc, char **argv) {
   const char *repo_root = argc > 1 ? argv[1] : NULL;
+
+  /* Pre-init calls must fail cleanly (-1 + error), not crash inside
+   * PyGILState_Ensure with no interpreter. */
+  int pre = 0;
+  EXPECT(MXTpuGetVersion(&pre) == -1,
+         "pre-init MXTpuGetVersion must return -1");
+  EXPECT(strstr(MXTpuGetLastError(), "not initialized") != NULL,
+         "pre-init error message must say 'not initialized'");
+
   CHECK(MXTpuLibInit(repo_root));
 
   int version = 0;

@@ -175,18 +175,20 @@ SPECS = {
                             onp.zeros(4, onp.float32)], {}),
     "lamb_update_phase2": ([_f(4), _f(4), onp.asarray(1.0, onp.float32),
                             onp.asarray(1.0, onp.float32)], {}),
-    "multi_sgd_update": ([_f(4), _f(3), _f(4), _f(3)],
+    # interleaved per-weight layout (w0, g0, [aux0...,] w1, g1, ...) —
+    # reference optimizer_op.cc:321 FListInputNames
+    "multi_sgd_update": ([_f(4), _f(4), _f(3), _f(3)],
                          dict(lrs=(0.1, 0.1), wds=(0.0, 0.0),
                               num_weights=2)),
-    "multi_sgd_mom_update": ([_f(4), _f(3), _f(4), _f(3), _f(4), _f(3)],
+    "multi_sgd_mom_update": ([_f(4), _f(4), _f(4), _f(3), _f(3), _f(3)],
                              dict(lrs=(0.1, 0.1), wds=(0.0, 0.0),
                                   num_weights=2)),
-    "multi_lamb_update": ([_f(4), _f(3), _f(4), _f(3), _f(4), _f(3),
-                           _f(4), _f(3)],
+    "multi_lamb_update": ([_f(4), _f(4), _f(4), _f(4),
+                           _f(3), _f(3), _f(3), _f(3)],
                           dict(learning_rates=(0.1, 0.1), wds=(0.0, 0.0),
                                num_tensors=2)),
-    "multi_lans_update": ([_f(4), _f(3), _f(4), _f(3), _f(4), _f(3),
-                           _f(4), _f(3)],
+    "multi_lans_update": ([_f(4), _f(4), _f(4), _f(4),
+                           _f(3), _f(3), _f(3), _f(3)],
                           dict(learning_rates=(0.1, 0.1), wds=(0.0, 0.0),
                                num_tensors=2)),
     # --- misc -----------------------------------------------------------
@@ -295,24 +297,50 @@ SPECS = {
     # --- adamw variants -------------------------------------------------
     "mp_adamw_update": ([_f(4, 6), _f(4, 6), _f(4, 6), _f(4, 6) + 0.1,
                          _f(4, 6)], {}),
-    "multi_adamw_update": ([_f(3), _f(3), _f(3), _f(3), _f(3), _f(3),
-                            _f(3) + 0.1, _f(3) + 0.1],
+    "multi_mp_sgd_update": ([_f(4), _f(4), _f(4), _f(3), _f(3), _f(3)],
+                            dict(lrs=(0.1, 0.1), wds=(0.0, 0.0),
+                                 num_weights=2)),
+    "multi_mp_sgd_mom_update": ([_f(4), _f(4), _f(4), _f(4),
+                                 _f(3), _f(3), _f(3), _f(3)],
+                                dict(lrs=(0.1, 0.1), wds=(0.0, 0.0),
+                                     num_weights=2)),
+    # preloaded variants take lrs/wds as trailing DEVICE arrays
+    "preloaded_multi_sgd_update": ([_f(4), _f(4), _f(3), _f(3),
+                                    onp.full(2, 0.1, onp.float32),
+                                    onp.zeros(2, onp.float32)],
+                                   dict(num_weights=2)),
+    "preloaded_multi_sgd_mom_update": ([_f(4), _f(4), _f(4),
+                                        _f(3), _f(3), _f(3),
+                                        onp.full(2, 0.1, onp.float32),
+                                        onp.zeros(2, onp.float32)],
+                                       dict(num_weights=2)),
+    "preloaded_multi_mp_sgd_update": ([_f(4), _f(4), _f(4),
+                                       _f(3), _f(3), _f(3),
+                                       onp.full(2, 0.1, onp.float32),
+                                       onp.zeros(2, onp.float32)],
+                                      dict(num_weights=2)),
+    "preloaded_multi_mp_sgd_mom_update": ([_f(4), _f(4), _f(4), _f(4),
+                                           _f(3), _f(3), _f(3), _f(3),
+                                           onp.full(2, 0.1, onp.float32),
+                                           onp.zeros(2, onp.float32)],
+                                          dict(num_weights=2)),
+    # interleaved: (w0, g0, m0, v0, [w32_0,] w1, ...) per reference
+    # adamw.cc:177 / multi_lamb.cc:186
+    "multi_adamw_update": ([_f(3), _f(3), _f(3), _f(3) + 0.1,
+                            _f(3), _f(3), _f(3), _f(3) + 0.1],
                            dict(num_weights=2, lrs=(0.1, 0.1),
                                 wds=(0.0, 0.0))),
-    "multi_mp_adamw_update": ([_f(3), _f(3), _f(3), _f(3), _f(3) + 0.1,
-                               _f(3) + 0.1, _f(3), _f(3),
-                               _f(3), _f(3)],
+    "multi_mp_adamw_update": ([_f(3), _f(3), _f(3), _f(3) + 0.1, _f(3),
+                               _f(3), _f(3), _f(3), _f(3) + 0.1, _f(3)],
                               dict(num_weights=2, lrs=(0.1, 0.1),
                                    wds=(0.0, 0.0))),
-    "multi_mp_lamb_update": ([_f(3), _f(3), _f(3), _f(3), _f(3),
-                              _f(3), _f(3) + 0.1, _f(3) + 0.1,
-                              _f(3), _f(3)],
+    "multi_mp_lamb_update": ([_f(3), _f(3), _f(3), _f(3) + 0.1, _f(3),
+                              _f(3), _f(3), _f(3), _f(3) + 0.1, _f(3)],
                              dict(num_tensors=2,
                                   learning_rates=(0.1, 0.1),
                                   wds=(0.0, 0.0), step_count=(1, 1))),
-    "multi_mp_lans_update": ([_f(3), _f(3), _f(3) + 0.1, _f(3) + 0.1,
-                              _f(3), _f(3), _f(3) + 0.1, _f(3) + 0.1,
-                              _f(3), _f(3)],
+    "multi_mp_lans_update": ([_f(3), _f(3), _f(3), _f(3) + 0.1, _f(3),
+                              _f(3), _f(3), _f(3), _f(3) + 0.1, _f(3)],
                              dict(num_tensors=2,
                                   learning_rates=(0.1, 0.1),
                                   wds=(0.0, 0.0), step_count=(1, 1))),

@@ -205,6 +205,25 @@ def test_count_sketch_matches_numpy():
     assert onp.allclose(out, expect, atol=1e-5)
 
 
+def test_multi_sgd_interleaved_matches_single():
+    """Interleaved (w0, g0, w1, g1) layout parses per-weight pairs the way
+    the reference does (optimizer_op.cc:321) — a blocked-layout regression
+    would swap w1/g0 here and diverge from the single-tensor update."""
+    from mxnet_tpu.ops import optimizer as opt
+
+    rng = onp.random.RandomState(7)
+    ws = [jnp.asarray(rng.rand(4, 3), jnp.float32),
+          jnp.asarray(rng.rand(5) + 1.0, jnp.float32)]
+    gs = [jnp.asarray(rng.rand(4, 3), jnp.float32),
+          jnp.asarray(rng.rand(5), jnp.float32)]
+    outs = opt.multi_sgd_update([ws[0], gs[0], ws[1], gs[1]],
+                                lrs=(0.1, 0.2), wds=(0.0, 0.01),
+                                num_weights=2)
+    for w, g, lr, wd, o in zip(ws, gs, (0.1, 0.2), (0.0, 0.01), outs):
+        single = opt.sgd_update(w, g, lr=lr, wd=wd)
+        assert onp.allclose(onp.asarray(o), onp.asarray(single), atol=1e-6)
+
+
 def test_multi_lans_and_lamb_update():
     from mxnet_tpu.ops import optimizer as opt
 
@@ -215,7 +234,15 @@ def test_multi_lans_and_lamb_update():
           jnp.asarray(rng.rand(5), jnp.float32)]
     ms = [jnp.zeros_like(w) for w in ws]
     vs = [jnp.zeros_like(w) for w in ws]
-    arrays = ws + gs + ms + vs
+
+    def interleave(gs_in):
+        # reference layout: w0, g0, m0, v0, w1, ... (multi_lamb.cc:186)
+        out = []
+        for w, g, m, v in zip(ws, gs_in, ms, vs):
+            out += [w, g, m, v]
+        return out
+
+    arrays = interleave(gs)
     for fn in (opt.multi_lans_update, opt.multi_lamb_update):
         outs = fn(arrays, learning_rates=(0.01, 0.01), wds=(0.01, 0.0),
                   step_count=(1, 1), num_tensors=2)
@@ -226,11 +253,11 @@ def test_multi_lans_and_lamb_update():
             assert not onp.allclose(arr, onp.asarray(w))
 
     # LANS normalizes the gradient: scaling grads must not change the step
-    outs1 = opt.multi_lans_update(ws + gs + ms + vs,
+    outs1 = opt.multi_lans_update(interleave(gs),
                                   learning_rates=(0.01, 0.01),
                                   wds=(0.0, 0.0), num_tensors=2)
     gs_scaled = [g * 100.0 for g in gs]
-    outs2 = opt.multi_lans_update(ws + gs_scaled + ms + vs,
+    outs2 = opt.multi_lans_update(interleave(gs_scaled),
                                   learning_rates=(0.01, 0.01),
                                   wds=(0.0, 0.0), num_tensors=2)
     assert onp.allclose(onp.asarray(outs1[0]), onp.asarray(outs2[0]),

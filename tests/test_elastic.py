@@ -37,6 +37,39 @@ def test_checkpoint_roundtrip_and_retention(tmp_path):
     mgr.close()
 
 
+def test_restore_skips_partial_multihost_step(tmp_path, monkeypatch):
+    """A crash between hosts' async saves leaves the newest step with only
+    some hosts' files; restore must fall back to the newest step COMPLETE
+    on every host instead of raising (or diverging) on lagging hosts."""
+    mgr = _mgr(tmp_path, keep=3, async_save=False)
+    tree = {"w": jnp.arange(4.0)}
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # step 5: both hosts landed; step 6: only host 1 did (host 0 crashed)
+    for h in (0, 1):
+        monkeypatch.setattr(jax, "process_index", lambda h=h: h)
+        mgr.save(5, tree)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    mgr.save(6, {"w": jnp.arange(4.0) + 1})
+
+    assert mgr.all_steps() == [5, 6]
+    assert mgr.complete_steps() == [5]
+    assert mgr.latest_step() == 5
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    out, step = mgr.restore()          # host 0 has no ckpt-6-h0.pkl
+    assert step == 5
+    onp.testing.assert_array_equal(out["w"], onp.arange(4.0))
+
+    # once host 0's step-6 file lands too, 6 becomes restorable
+    mgr.save(6, {"w": jnp.arange(4.0) + 1})
+    assert mgr.latest_step() == 6
+    # retention never counts a partial step toward ``keep``
+    mgr.save(7, tree)                  # h0 only -> partial
+    mgr._gc()
+    assert 5 in mgr.all_steps() and 6 in mgr.all_steps()
+    mgr.close()
+
+
 def test_checkpoint_async_write_then_restore(tmp_path):
     mgr = _mgr(tmp_path, keep=3, async_save=True)
     tree = {"w": jnp.full((3, 3), 2.5)}

@@ -27,6 +27,19 @@ def test_imdecode_imresize():
     assert min(short.shape[:2]) == 18
 
 
+def test_device_image_resize_keep_ratio_contract():
+    """keep_ratio resizes the shorter edge from a SCALAR size; a
+    non-square (w, h) tuple is a contract violation (reference
+    image/resize-inl.h only allows keep_ratio with a scalar)."""
+    from mxnet_tpu.ops import image_ops
+
+    data = onp.random.RandomState(0).rand(12, 16, 3).astype(onp.float32)
+    out = image_ops.image_resize(data, size=6, keep_ratio=True)
+    assert out.shape == (6, 8, 3)
+    with pytest.raises(ValueError, match="keep_ratio"):
+        image_ops.image_resize(data, size=(6, 9), keep_ratio=True)
+
+
 def test_crops_and_normalize():
     raw = _rand_img()
     c, _ = img.center_crop(raw, (20, 24))

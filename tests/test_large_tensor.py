@@ -36,6 +36,24 @@ def test_large_argsort_tail():
     onp.testing.assert_allclose(top.asnumpy(), v, rtol=1e-6)
 
 
+def test_shape_size_array_int64_no_truncation():
+    """shape_array/size_array return true int64 (reference
+    elemwise_unary_op.h) — no silent x32 truncation, and a logical size
+    past 2**31 must not wrap (checked via jit tracing so no 8-GiB alloc)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import tensor as T
+
+    x = jnp.ones((3, 4))
+    assert T.shape_array(x).dtype == jnp.int64
+    assert T.size_array(x).dtype == jnp.int64
+    assert int(T.size_array(x)[0]) == 12
+    big = jax.ShapeDtypeStruct((1 << 16, 1 << 16), jnp.bfloat16)
+    out = jax.eval_shape(T.size_array, big)
+    assert out.dtype == jnp.int64
+
+
 def test_large_save_load_roundtrip(tmp_path):
     x = nd.arange(N, dtype="float32")
     path = str(tmp_path / "big.nd")

@@ -50,17 +50,36 @@ def measure(mb=64, iters=10, mesh_spec=""):
     dt = time.perf_counter() - t0
     results["h2d_GBps"] = mb * iters / 1024 / dt
 
-    # device -> host: read a FRESH device result each iteration — jax
+    # device -> host: read a FRESH device buffer each iteration — jax
     # caches the host copy of an unchanged array, which would measure a
-    # memcpy (or nothing) instead of the transfer
-    bump = jax.jit(lambda x: x + 1.0)
-    _fence(bump(dev))
-    y = dev
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        y = bump(y)
-        out = onp.asarray(y)
-    dt = time.perf_counter() - t0
+    # memcpy (or nothing) instead of the transfer.  The distinct buffers
+    # are produced (and completed) BEFORE the timed region so readback is
+    # the only thing on the clock — bumping inside the loop would mix a
+    # kernel dispatch+execute into the figure.
+    bump = jax.jit(lambda x, k: x + k)
+    # chunked so the pool of distinct live buffers stays bounded (~2 GiB)
+    # regardless of --mb/--iters; per-chunk: produce + fence OUTSIDE the
+    # clock, then time only the readbacks and sum across chunks
+    chunk = max(1, min(iters, (2 << 10) // max(mb, 1)))
+    dt = 0.0
+    done = 0
+    while done < iters:
+        k = min(chunk, iters - done)
+        bufs = [bump(dev, float(done + i)) for i in range(k)]
+        # drain the dispatch queue with ONE host read of a sentinel (over
+        # the TPU tunnel block_until_ready exerts no backpressure until
+        # the queue has drained once), then block on each buffer WITHOUT
+        # reading it — _fence(b) would populate jax's cached host copy
+        # and turn the timed readback into a no-op
+        _fence(bump(dev, -1.0))
+        for b in bufs:
+            b.block_until_ready()
+        t0 = time.perf_counter()
+        for b in bufs:
+            out = onp.asarray(b)
+        dt += time.perf_counter() - t0
+        del bufs
+        done += k
     results["d2h_GBps"] = mb * iters / 1024 / dt
 
     # on-device (read+write one buffer each way)

@@ -72,6 +72,17 @@ FP32_FUNCS = [
     "signum_update", "lamb_update_phase1", "lamb_update_phase2",
     "multi_sgd_update", "multi_sgd_mom_update", "multi_lamb_update",
     "multi_lans_update",
+    # np-surface additions (ops/np_extra.py): accumulating statistics,
+    # exp/log-backed windows+distributions, and linalg stay fp32
+    "std", "var", "average", "percentile", "square_sum", "einsum",
+    "arctan2", "arctan2_scalar", "rarctan2_scalar", "copysign",
+    "copysign_scalar", "rcopysign_scalar", "rpower_scalar",
+    "rdiv_scalar", "interp", "polyval", "nan_to_num",
+    "linalg_eig", "linalg_eigvals", "linalg_tensorsolve",
+    "hanning", "hamming", "blackman", "logspace",
+    "laplace", "gumbel", "logistic", "rayleigh", "pareto", "weibull",
+    "powerd", "generalized_negative_binomial",
+    "SoftmaxActivation",
 ]
 
 WIDEST_TYPE_CASTS = [
@@ -80,6 +91,8 @@ WIDEST_TYPE_CASTS = [
     "broadcast_minimum", "broadcast_hypot", "add_n", "concat", "stack",
     "where", "elemwise_add", "elemwise_sub", "elemwise_mul",
     "elemwise_div", "amp_multicast",
+    "fmax", "fmin", "fmod", "cross", "kron", "tensordot",
+    "hstack", "vstack", "dstack", "column_stack",
 ]
 
 # Everything else: dtype-neutral — runs in whichever precision arrives.
@@ -164,4 +177,24 @@ FP16_FP32_FUNCS = [
     # adamw/lamb/lans mp+multi variants (fp32 master logic internal)
     "mp_adamw_update", "multi_adamw_update", "multi_mp_adamw_update",
     "multi_mp_lamb_update", "multi_mp_lans_update",
+    # np-surface additions (ops/np_extra.py): dtype-preserving
+    # manipulation, indexing, integer/bool ops, STE quantization helpers
+    "all", "any", "around", "round", "bincount", "diff", "ediff1d",
+    "nonzero", "hsplit", "dsplit", "moveaxis", "rollaxis", "diagonal",
+    "diagflat", "diag_indices_from", "fill_diagonal", "delete", "insert",
+    "atleast_1d", "atleast_2d", "atleast_3d", "share_memory",
+    "full_like", "indices", "tri", "tril_indices",
+    "lcm", "lcm_scalar", "ldexp_scalar", "rldexp_scalar",
+    "fmax_scalar", "fmin_scalar", "fmod_scalar", "rfmod_scalar",
+    "rsub_scalar", "rmod_scalar",
+    "bitwise_and_scalar", "bitwise_or_scalar", "bitwise_xor_scalar",
+    "where_lscalar", "where_rscalar", "where_scalar2",
+    "advanced_indexing", "advanced_indexing_multiple",
+    "boolean_mask_assign_scalar", "boolean_mask_assign_tensor",
+    "index_add", "index_update", "constraint_check", "choice",
+    "round_ste", "sign_ste", "gradientmultiplier",
+    # dgl graph sampling (host-side minibatch construction)
+    "dgl_csr_neighbor_uniform_sample",
+    "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+    "dgl_adjacency", "dgl_graph_compact",
 ]

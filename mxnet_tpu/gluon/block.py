@@ -594,9 +594,16 @@ class HybridBlock(Block):
             # pure function, applied before jit (the SubgraphProperty/
             # MXOptimizeForBackend analog — see library.register_backend)
             from ..library import get_backend
+            from ..symbol.subgraph import SubgraphProperty
 
-            raw_fn = get_backend(self._backend)(
-                raw_fn, **getattr(self, "_backend_flags", {}))
+            backend = get_backend(self._backend)
+            if isinstance(backend, SubgraphProperty):
+                raise MXNetError(
+                    f"backend '{self._backend}' is a SubgraphProperty — "
+                    "apply it on the exported Symbol via "
+                    "Symbol.optimize_for (hybridized blocks take "
+                    "traced-function transforms)")
+            raw_fn = backend(raw_fn, **getattr(self, "_backend_flags", {}))
         jitted = jax.jit(raw_fn)
         return (jitted, names, params, ctx_idx, out_struct, mutated_names)
 

@@ -181,7 +181,8 @@ def publish_model_file(params_path: str, name: str,
     digest = sha1.hexdigest()
     _model_sha1[name] = digest
     dst = os.path.join(root, f"{name}-{digest[:8]}.params")
-    shutil.copyfile(params_path, dst)
+    if os.path.abspath(params_path) != os.path.abspath(dst):
+        shutil.copyfile(params_path, dst)      # re-publish is idempotent
     return dst
 
 

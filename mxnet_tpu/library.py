@@ -154,6 +154,11 @@ def register_backend(name: str, transform: Optional[Callable] = None):
     partition-and-replace passes become whole-function rewrites (wrap in
     AMP casts, quantize params, re-shard, swap attention impls, ...) and
     XLA does the actual fusion.
+
+    A ``symbol.subgraph.SubgraphProperty`` INSTANCE is also accepted:
+    that is the selector-based partial-graph partitioner (pattern-match
+    node chains, rewrite only those subgraphs) applied through
+    ``Symbol.optimize_for(backend_name)``.
     """
 
     def deco(t: Callable) -> Callable:

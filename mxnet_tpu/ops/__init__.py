@@ -24,6 +24,8 @@ from . import rnn as _rnn  # noqa: F401
 from . import nn_extra as _nn_extra  # noqa: F401
 from . import misc as _misc  # noqa: F401
 from . import image_ops as _image_ops  # noqa: F401
+from . import np_extra as _np_extra  # noqa: F401
+from . import graph_sampling as _graph_sampling  # noqa: F401
 from . import ref_aliases as _ref_aliases  # noqa: F401  (must be last;
 # contrib.quantization registers late — mxnet_tpu/__init__ re-applies)
 

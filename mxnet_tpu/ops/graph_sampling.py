@@ -1,0 +1,204 @@
+"""DGL graph-sampling operators (reference
+``src/operator/contrib/dgl_graph.cc``).
+
+These are minibatch-construction ops: BFS neighbor sampling, induced
+subgraphs, adjacency conversion, compaction.  They are inherently
+dynamic-shaped and pointer-chasing, so — like ``nonzero`` and the
+host-side data iterators — they run in numpy on the host and feed the
+device pipeline; the TPU executes the resulting dense minibatch.  Graphs
+use this framework's dense graph-container convention (see ``edge_id``):
+a (N, N) matrix whose entries hold edge values (0 = no edge).  CSR
+containers (``ndarray/sparse.py``) densify at the frontend.
+
+Output contracts follow the reference docs exactly:
+- ``dgl_csr_neighbor_uniform_sample(csr, seed...)`` -> per seed array:
+  vertices (max_num_vertices+1, last element = actual count), sampled
+  sub-graph ((max, max), rows in sampled-vertex order, columns in
+  PARENT vertex ids), layer (max, BFS layer per sampled vertex, -1 pad).
+- ``..._non_uniform_sample(csr, prob, seed...)`` adds a probability
+  output between the sub-graph and the layer.
+- ``dgl_subgraph(x, v..., return_mapping)`` -> induced subgraph per
+  vertex set (new edge ids 1..k), plus the original-edge-id matrix when
+  return_mapping.
+- ``dgl_adjacency(x)`` -> float32 0/1 adjacency.
+- ``dgl_graph_compact(graph..., varray..., graph_sizes, return_mapping)``
+  -> drops the empty tail rows/columns the samplers pad to
+  max_num_vertices and renumbers columns into the compacted id space.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register
+
+_RNG = onp.random.RandomState(17)
+
+
+def seed_rng(seed: int) -> None:
+    """Reseed the host-side sampling stream (wired to mx.random.seed)."""
+    global _RNG
+    _RNG = onp.random.RandomState(seed)
+
+
+def _i64(x):
+    with jax.enable_x64(True):
+        return jnp.asarray(onp.asarray(x, onp.int64), dtype=jnp.int64)
+
+
+def _sample_one(adj, seeds, num_hops, num_neighbor, max_num_vertices,
+                prob: Optional[onp.ndarray]):
+    n = adj.shape[0]
+    layer_of = {}
+    order = []
+    for s in seeds:
+        s = int(s)
+        if s not in layer_of and len(order) < max_num_vertices:
+            layer_of[s] = 0
+            order.append(s)
+    sampled_edges = {}          # src -> list of (col, edge_value)
+    frontier = list(order)
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for v in frontier:
+            cols = onp.nonzero(adj[v])[0]
+            if cols.size == 0:
+                continue
+            k = min(int(num_neighbor), cols.size)
+            if prob is not None:
+                p = onp.asarray(prob, onp.float64)[cols]
+                total = p.sum()
+                if total <= 0:
+                    continue
+                pick = _RNG.choice(cols.size, size=k, replace=False,
+                                   p=p / total)
+            else:
+                pick = _RNG.choice(cols.size, size=k, replace=False)
+            chosen = cols[onp.sort(pick)]
+            sampled_edges.setdefault(v, [])
+            for c in chosen:
+                c = int(c)
+                sampled_edges[v].append((c, adj[v, c]))
+                if c not in layer_of and len(order) < max_num_vertices:
+                    layer_of[c] = hop
+                    order.append(c)
+                    nxt.append(c)
+        frontier = nxt
+    vertices = sorted(layer_of)
+    count = len(vertices)
+    out_v = onp.zeros(max_num_vertices + 1, onp.int64)
+    out_v[:count] = vertices
+    out_v[-1] = count
+    sub = onp.zeros((max_num_vertices, max_num_vertices), adj.dtype)
+    for i, v in enumerate(vertices):
+        for (c, val) in sampled_edges.get(v, []):
+            if c in layer_of:
+                sub[i, c] = val
+    layers = onp.full(max_num_vertices, -1, onp.int64)
+    for i, v in enumerate(vertices):
+        layers[i] = layer_of[v]
+    probs = None
+    if prob is not None:
+        probs = onp.zeros(max_num_vertices, onp.float32)
+        probs[:count] = onp.asarray(prob, onp.float32)[vertices]
+    return out_v, sub, probs, layers
+
+
+@register("dgl_csr_neighbor_uniform_sample", num_inputs=-1, num_outputs=-1,
+          differentiable=False,
+          aliases=("_contrib_dgl_csr_neighbor_uniform_sample",))
+def dgl_csr_neighbor_uniform_sample(arrays, num_args=0, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100):
+    """arrays = [graph, seed_0, seed_1, ...]; 3 outputs per seed array
+    (reference dgl_graph.cc:762)."""
+    adj = onp.asarray(arrays[0])
+    outs = []
+    for seed in arrays[1:]:
+        v, sub, _p, layers = _sample_one(
+            adj, onp.asarray(seed).ravel(), int(num_hops),
+            int(num_neighbor), int(max_num_vertices), None)
+        outs += [_i64(v), jnp.asarray(sub), _i64(layers)]
+    return tuple(outs)
+
+
+@register("dgl_csr_neighbor_non_uniform_sample", num_inputs=-1,
+          num_outputs=-1, differentiable=False,
+          aliases=("_contrib_dgl_csr_neighbor_non_uniform_sample",))
+def dgl_csr_neighbor_non_uniform_sample(arrays, num_args=0, num_hops=1,
+                                        num_neighbor=2,
+                                        max_num_vertices=100):
+    """arrays = [graph, probability, seed_0, ...]; 4 outputs per seed
+    array (reference dgl_graph.cc:867)."""
+    adj = onp.asarray(arrays[0])
+    prob = onp.asarray(arrays[1]).ravel()
+    outs = []
+    for seed in arrays[2:]:
+        v, sub, p, layers = _sample_one(
+            adj, onp.asarray(seed).ravel(), int(num_hops),
+            int(num_neighbor), int(max_num_vertices), prob)
+        outs += [_i64(v), jnp.asarray(sub), jnp.asarray(p), _i64(layers)]
+    return tuple(outs)
+
+
+@register("dgl_subgraph", num_inputs=-1, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_dgl_subgraph",))
+def dgl_subgraph(arrays, num_args=0, return_mapping=False):
+    """Induced subgraph per vertex set: new edge ids 1..k in row-major
+    order (+ the original-value matrix when return_mapping) — reference
+    dgl_graph.cc:1147's documented example."""
+    adj = onp.asarray(arrays[0])
+    subs, maps = [], []
+    for v in arrays[1:]:
+        idx = onp.asarray(v, onp.int64).ravel()
+        orig = adj[onp.ix_(idx, idx)]
+        new = onp.zeros_like(orig)
+        eid = 0
+        for r in range(orig.shape[0]):
+            for c in range(orig.shape[1]):
+                if orig[r, c] != 0:
+                    eid += 1
+                    new[r, c] = eid
+        subs.append(jnp.asarray(new))
+        maps.append(jnp.asarray(orig))
+    return tuple(subs) + (tuple(maps) if return_mapping else ())
+
+
+@register("dgl_adjacency", num_inputs=1, differentiable=False,
+          aliases=("_contrib_dgl_adjacency",))
+def dgl_adjacency(data):
+    """Edge-id matrix -> float32 0/1 adjacency (dgl_graph.cc:1408)."""
+    return (data != 0).astype(jnp.float32)
+
+
+@register("dgl_graph_compact", num_inputs=-1, num_outputs=-1,
+          differentiable=False, aliases=("_contrib_dgl_graph_compact",))
+def dgl_graph_compact(arrays, num_args=0, return_mapping=False,
+                      graph_sizes=()):
+    """Drop the samplers' empty pad rows/cols: inputs are
+    [graph_0..graph_{k-1}, varray_0..varray_{k-1}] (reference
+    dgl_graph.cc:1583).  Row i of a sampled graph belongs to the i-th
+    sampled vertex; columns are parent ids — compaction remaps columns
+    through the vertex array into the compacted id space."""
+    if isinstance(graph_sizes, (int, float)):
+        graph_sizes = (int(graph_sizes),)
+    k = len(arrays) // 2
+    outs, maps = [], []
+    for i in range(k):
+        g = onp.asarray(arrays[i])
+        varray = onp.asarray(arrays[k + i], onp.int64).ravel()
+        size = int(graph_sizes[i]) if i < len(graph_sizes) \
+            else int(varray[-1])
+        vids = varray[:size]
+        col_of = {int(v): j for j, v in enumerate(vids)}
+        out = onp.zeros((size, size), g.dtype)
+        for r in range(size):
+            for c in onp.nonzero(g[r])[0]:
+                j = col_of.get(int(c))
+                if j is not None:
+                    out[r, j] = g[r, c]
+        outs.append(jnp.asarray(out))
+        maps.append(jnp.asarray(out))
+    return tuple(outs) + (tuple(maps) if return_mapping else ())

@@ -133,15 +133,17 @@ def smooth_l1(data, scalar=1.0):
 # --- dense / conv ----------------------------------------------------------
 
 @register("FullyConnected", num_inputs=-1, aliases=["fully_connected"])
-def fully_connected(arrays, num_hidden=0, no_bias=False, flatten=True):
+def fully_connected(arrays, num_hidden=0, no_bias=False, flatten=True,
+                    fused_relu=False):
     """data (N, ...), weight (num_hidden, in_units) — reference
-    src/operator/nn/fully_connected.cc."""
+    src/operator/nn/fully_connected.cc.  ``fused_relu`` is set by the
+    int8 graph pass when a following relu folded into this node."""
     data, weight = arrays[0], arrays[1]
     x = data.reshape(data.shape[0], -1) if flatten else data
     out = jnp.matmul(x, weight.T)
     if not no_bias:
         out = out + arrays[2]
-    return out
+    return jnp.maximum(out, 0) if fused_relu else out
 
 
 def _conv_dimension_numbers(layout: str):
@@ -165,7 +167,8 @@ def _tup(v, n):
 @register("Convolution", num_inputs=-1, aliases=["conv"])
 def convolution(arrays, kernel=None, stride=None, dilate=None, pad=None,
                 num_filter=0, num_group=1, no_bias=False, layout=None,
-                workspace=None, cudnn_tune=None, cudnn_off=None):
+                workspace=None, cudnn_tune=None, cudnn_off=None,
+                fused_relu=False):
     """N-D convolution (reference src/operator/nn/convolution.cc).
 
     XLA handles algorithm selection/tiling; ``workspace``/``cudnn_*`` attrs
@@ -196,7 +199,7 @@ def convolution(arrays, kernel=None, stride=None, dilate=None, pad=None,
         shape = [1] * out.ndim
         shape[c_axis] = bias.shape[0]
         out = out + bias.reshape(shape)
-    return out
+    return jnp.maximum(out, 0) if fused_relu else out
 
 
 @register("Deconvolution", num_inputs=-1)

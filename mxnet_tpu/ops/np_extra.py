@@ -1,0 +1,740 @@
+"""Numpy-surface operators that close the reference *registration-name* gap.
+
+The np namespace surface (``mx.np``) has dispatched these through jnp since
+round 1, but graph paths — reference symbol-JSON import, by-name ``invoke``
+through the C ABI, AMP lists — resolve ops by their *registration* names
+(reference ``src/operator/numpy/*`` registers ``_npi_*`` / ``_np_*``
+spellings, SURVEY §2.2).  This module registers the canonical ops and
+aliases every reference spelling, so a reference-generated graph resolves
+node-for-node.
+
+Pure-alias mappings for ops that already exist live in ``ref_aliases.py``;
+here are only ops that needed a real (if small) implementation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import random as _rng
+from .registry import register
+
+
+def _dt(dtype, default=jnp.float32):
+    if dtype in (None, "None"):
+        return default
+    return jnp.dtype(dtype) if isinstance(dtype, str) else dtype
+
+
+# ---------------------------------------------------------------------------
+# reductions / statistics (reference np_broadcast_reduce_op_value.cc,
+# np_moments_op.cc, np_percentile_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("std", aliases=("_npi_std",))
+def std(data, axis=None, ddof=0, keepdims=False):
+    return jnp.std(data, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register("var", aliases=("_npi_var",))
+def var(data, axis=None, ddof=0, keepdims=False):
+    return jnp.var(data, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register("average", num_inputs=-1, aliases=("_npi_average",))
+def average(arrays, axis=None, returned=False, weighted=True):
+    """average(a[, weights]) (reference np_broadcast_reduce_op_value.cc
+    _npi_average)."""
+    a = arrays[0]
+    w = arrays[1] if len(arrays) > 1 and weighted else None
+    if returned:
+        avg, wsum = jnp.average(a, axis=axis, weights=w, returned=True)
+        return avg, wsum
+    return jnp.average(a, axis=axis, weights=w)
+
+
+@register("percentile", differentiable=False, aliases=("_npi_percentile",))
+def percentile(data, q=50.0, axis=None, interpolation="linear",
+               keepdims=False):
+    q = jnp.asarray(q)
+    return jnp.percentile(data, q, axis=axis, method=interpolation,
+                          keepdims=keepdims)
+
+
+@register("all", differentiable=False, aliases=("_npi_all",))
+def all_(data, axis=None, keepdims=False):
+    return jnp.all(data, axis=axis, keepdims=keepdims)
+
+
+@register("any", differentiable=False, aliases=("_npi_any",))
+def any_(data, axis=None, keepdims=False):
+    return jnp.any(data, axis=axis, keepdims=keepdims)
+
+
+@register("around", aliases=("_npi_around",))
+def around(data, decimals=0):
+    """np.around: round-half-to-EVEN (banker's rounding)."""
+    return jnp.round(data, decimals)
+
+
+@register("round", differentiable=False)
+def round_(data):
+    """Legacy nd round: half away from zero (reference mshadow_op.h round),
+    unlike np.around's half-to-even."""
+    return jnp.sign(data) * jnp.floor(jnp.abs(data) + 0.5)
+
+
+@register("bincount", differentiable=False, num_inputs=-1,
+          aliases=("_npi_bincount",))
+def bincount(arrays, minlength=0):
+    x = arrays[0].astype(jnp.int32)
+    weights = arrays[1] if len(arrays) > 1 else None
+    # static length: jnp.bincount needs a bound; use minlength or data max
+    length = max(int(minlength), int(jnp.max(x)) + 1 if x.size else 1)
+    return jnp.bincount(x, weights=weights, length=length)
+
+
+@register("diff", aliases=("_npi_diff",))
+def diff(data, n=1, axis=-1):
+    return jnp.diff(data, n=n, axis=axis)
+
+
+@register("ediff1d", num_inputs=-1, aliases=("_npi_ediff1d",))
+def ediff1d(arrays, to_end=None, to_begin=None):
+    out = jnp.ediff1d(arrays[0].ravel())
+    parts = []
+    if to_begin is not None:
+        parts.append(jnp.atleast_1d(jnp.asarray(to_begin, out.dtype)).ravel())
+    parts.append(out)
+    if to_end is not None:
+        parts.append(jnp.atleast_1d(jnp.asarray(to_end, out.dtype)).ravel())
+    return jnp.concatenate(parts) if len(parts) > 1 else out
+
+
+@register("interp", num_inputs=3, differentiable=False,
+          aliases=("_npi_interp",))
+def interp(x, xp, fp, left=None, right=None):
+    return jnp.interp(x, xp, fp, left=left, right=right)
+
+
+@register("polyval", num_inputs=2, aliases=("_npi_polyval",))
+def polyval(p, x):
+    return jnp.polyval(p, x)
+
+
+@register("nan_to_num", aliases=("_npi_nan_to_num",))
+def nan_to_num(data, copy=True, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(data, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register("nonzero", differentiable=False,
+          aliases=("_npx_nonzero", "_npi_nonzero"))
+def nonzero(data):
+    """Indices of non-zero elements as an (N, ndim) int64 tensor
+    (reference np_nonzero_op.cc; int64 per the npx contract)."""
+    idx = onp.argwhere(onp.asarray(data) != 0)
+    with jax.enable_x64(True):
+        return jnp.asarray(idx, dtype=jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# stacking / splitting (reference np_matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("hstack", num_inputs=-1, aliases=("_npi_hstack",))
+def hstack(arrays):
+    return jnp.hstack(arrays)
+
+
+@register("vstack", num_inputs=-1, aliases=("_npi_vstack", "_np_vstack"))
+def vstack(arrays):
+    return jnp.vstack(arrays)
+
+
+@register("dstack", num_inputs=-1, aliases=("_npi_dstack",))
+def dstack(arrays):
+    return jnp.dstack(arrays)
+
+
+@register("column_stack", num_inputs=-1, aliases=("_npi_column_stack",))
+def column_stack(arrays):
+    return jnp.column_stack(arrays)
+
+
+@register("hsplit", num_outputs=-1, aliases=("_npi_hsplit",))
+def hsplit(data, indices_or_sections=1):
+    return tuple(jnp.hsplit(data, indices_or_sections))
+
+
+@register("dsplit", num_outputs=-1, aliases=("_npi_dsplit",))
+def dsplit(data, indices_or_sections=1):
+    return tuple(jnp.dsplit(data, indices_or_sections))
+
+
+# ---------------------------------------------------------------------------
+# products / linalg (reference np_tensordot_op.cc, np_kron.cc, np_cross.cc,
+# np_einsum_op.cc, la_op.cc numpy lanes)
+# ---------------------------------------------------------------------------
+
+@register("tensordot", num_inputs=2,
+          aliases=("_npi_tensordot", "_npi_tensordot_int_axes"))
+def tensordot(a, b, axes=2, a_axes_summed=None, b_axes_summed=None):
+    if a_axes_summed is not None and b_axes_summed is not None:
+        axes = (tuple(a_axes_summed), tuple(b_axes_summed))
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@register("kron", num_inputs=2, aliases=("_npi_kron",))
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+@register("cross", num_inputs=2, aliases=("_npi_cross",))
+def cross(a, b, axisa=-1, axisb=-1, axisc=-1, axis=None):
+    if axis is not None:
+        axisa = axisb = axisc = axis
+    return jnp.cross(a, b, axisa=axisa, axisb=axisb, axisc=axisc)
+
+
+@register("einsum", num_inputs=-1, aliases=("_npi_einsum",))
+def einsum(arrays, subscripts="", optimize=0):
+    return jnp.einsum(subscripts, *arrays)
+
+
+@register("linalg_eig", num_outputs=2, differentiable=False,
+          aliases=("_npi_eig",))
+def linalg_eig(data):
+    """General eigendecomposition — CPU-only in XLA, so computed on host
+    (reference np_eig.cc; same complex-typed contract)."""
+    w, v = onp.linalg.eig(onp.asarray(data))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@register("linalg_eigvals", differentiable=False, aliases=("_npi_eigvals",))
+def linalg_eigvals(data):
+    return jnp.asarray(onp.linalg.eigvals(onp.asarray(data)))
+
+
+@register("linalg_tensorsolve", num_inputs=2, differentiable=False,
+          aliases=("_npi_tensorsolve",))
+def linalg_tensorsolve(a, b, a_axes=None):
+    return jnp.linalg.tensorsolve(a, b, axes=tuple(a_axes) if a_axes else None)
+
+
+# ---------------------------------------------------------------------------
+# creation (reference np_init_op.cc, np_window_op.cc, np_tri*_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("logspace", num_inputs=0, differentiable=False,
+          aliases=("_npi_logspace",))
+def logspace(start=0.0, stop=1.0, num=50, endpoint=True, base=10.0,
+             dtype=None):
+    return jnp.logspace(start, stop, int(num), endpoint=endpoint, base=base,
+                        dtype=_dt(dtype))
+
+
+@register("indices", num_inputs=0, differentiable=False,
+          aliases=("_npi_indices",))
+def indices(dimensions=(), dtype="int32"):
+    return jnp.indices(tuple(int(d) for d in dimensions), dtype=_dt(dtype))
+
+
+@register("tri", num_inputs=0, differentiable=False, aliases=("_npi_tri",))
+def tri(N=1, M=None, k=0, dtype=None):
+    return jnp.tri(int(N), None if M in (None, "None") else int(M), int(k),
+                   dtype=_dt(dtype))
+
+
+@register("tril_indices", num_inputs=0, num_outputs=2, differentiable=False,
+          aliases=("_npi_tril_indices",))
+def tril_indices(n=1, k=0, m=None):
+    m = None if m in (None, "None") else int(m)
+    r, c = jnp.tril_indices(int(n), int(k), m)
+    return r, c
+
+
+@register("full_like", differentiable=False, aliases=("_npi_full_like",))
+def full_like(data, fill_value=0.0, dtype=None):
+    return jnp.full_like(data, fill_value,
+                         dtype=_dt(dtype, default=data.dtype))
+
+
+@register("hanning", num_inputs=0, differentiable=False,
+          aliases=("_npi_hanning",))
+def hanning(M=1, dtype=None):
+    return jnp.hanning(int(M)).astype(_dt(dtype))
+
+
+@register("hamming", num_inputs=0, differentiable=False,
+          aliases=("_npi_hamming",))
+def hamming(M=1, dtype=None):
+    return jnp.hamming(int(M)).astype(_dt(dtype))
+
+
+@register("blackman", num_inputs=0, differentiable=False,
+          aliases=("_npi_blackman",))
+def blackman(M=1, dtype=None):
+    return jnp.blackman(int(M)).astype(_dt(dtype))
+
+
+# ---------------------------------------------------------------------------
+# manipulation (reference np_matrix_op.cc, np_delete_op.cc, np_insert_op*.cc)
+# ---------------------------------------------------------------------------
+
+@register("moveaxis", aliases=("_npi_moveaxis", "_np_moveaxis"))
+def moveaxis(data, source=0, destination=0):
+    src = (source,) if isinstance(source, int) else tuple(source)
+    dst = (destination,) if isinstance(destination, int) \
+        else tuple(destination)
+    return jnp.moveaxis(data, src, dst)
+
+
+@register("rollaxis", aliases=("_npi_rollaxis",))
+def rollaxis(data, axis=0, start=0):
+    return jnp.rollaxis(data, axis, start)
+
+
+@register("diagonal", aliases=("_npi_diagonal", "_np_diagonal"))
+def diagonal(data, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(data, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("diagflat", aliases=("_npi_diagflat",))
+def diagflat(data, k=0):
+    return jnp.diagflat(data, k=k)
+
+
+@register("diag_indices_from", differentiable=False, num_outputs=1,
+          aliases=("_npi_diag_indices_from",))
+def diag_indices_from(data):
+    """(ndim, n) index tensor (reference np_matrix_op.cc
+    _npi_diag_indices_from packs the tuple into one tensor)."""
+    idx = jnp.diag_indices_from(data)
+    return jnp.stack(idx, axis=0)
+
+
+@register("fill_diagonal", differentiable=False,
+          aliases=("_npi_fill_diagonal",))
+def fill_diagonal(data, val=0.0, wrap=False):
+    """Functional fill_diagonal (the reference mutates in place)."""
+    a = onp.array(onp.asarray(data), copy=True)
+    vals = val if isinstance(val, (list, tuple)) else (val,)
+    onp.fill_diagonal(a, vals if len(vals) > 1 else vals[0], wrap=wrap)
+    return jnp.asarray(a)
+
+
+@register("delete", num_inputs=-1, differentiable=False,
+          aliases=("_npi_delete",))
+def delete(arrays, obj=None, start=None, stop=None, step=None, axis=None):
+    """np.delete: ``obj`` int attr, slice attrs (start/stop/step), or a
+    second index-array input (reference np_delete_op.cc)."""
+    data = arrays[0]
+    if len(arrays) > 1:
+        obj = onp.asarray(arrays[1]).astype(onp.int64)
+    elif start is not None or stop is not None or step is not None:
+        obj = slice(start, stop, step)
+    return jnp.delete(data, obj, axis=axis,
+                      assume_unique_indices=False)
+
+
+@register("insert", num_inputs=-1, differentiable=False,
+          aliases=("_npi_insert_scalar", "_npi_insert_slice",
+                   "_npi_insert_tensor"))
+def insert(arrays, obj=None, val=None, start=None, stop=None, step=None,
+           axis=None):
+    """np.insert; values come as a second input tensor or a ``val``
+    scalar attr; position as an int attr, slice attrs, or index tensor
+    (reference np_insert_op_scalar/slice/tensor.cc)."""
+    data = arrays[0]
+    rest = list(arrays[1:])
+    if val is None and rest:
+        values = rest.pop()
+    else:
+        values = val
+    if rest:                       # leading index tensor variant
+        obj = onp.asarray(rest[0]).astype(onp.int64)
+    elif start is not None or stop is not None or step is not None:
+        obj = slice(start, stop, step)
+    return jnp.insert(data, obj, values, axis=axis)
+
+
+@register("atleast_1d", num_inputs=-1, num_outputs=-1,
+          aliases=("_npi_atleast_1d",))
+def atleast_1d(arrays):
+    out = jnp.atleast_1d(*arrays)
+    return out if isinstance(out, (list, tuple)) else (out,)
+
+
+@register("atleast_2d", num_inputs=-1, num_outputs=-1,
+          aliases=("_npi_atleast_2d",))
+def atleast_2d(arrays):
+    out = jnp.atleast_2d(*arrays)
+    return out if isinstance(out, (list, tuple)) else (out,)
+
+
+@register("atleast_3d", num_inputs=-1, num_outputs=-1,
+          aliases=("_npi_atleast_3d",))
+def atleast_3d(arrays):
+    out = jnp.atleast_3d(*arrays)
+    return out if isinstance(out, (list, tuple)) else (out,)
+
+
+@register("share_memory", num_inputs=2, differentiable=False,
+          aliases=("_npi_share_memory",))
+def share_memory(a, b):
+    """Always false: XLA buffers are immutable and never alias across
+    distinct arrays (reference np_memory_op.cc)."""
+    return jnp.zeros((), dtype=jnp.bool_)
+
+
+# ---------------------------------------------------------------------------
+# binary ufuncs missing as registered names
+# (reference np_elemwise_broadcast_op*.cc)
+# ---------------------------------------------------------------------------
+
+_NEW_BINARY = {
+    "copysign": jnp.copysign,
+    "lcm": lambda a, b: jnp.lcm(a.astype(jnp.int32), b.astype(jnp.int32)),
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "fmod": jnp.fmod,
+    "arctan2": jnp.arctan2,
+}
+_NEW_BINARY_NONDIFF = {"lcm"}
+
+for _name, _f in _NEW_BINARY.items():
+    def _mk2(f):
+        def op(lhs, rhs):
+            return f(lhs, rhs)
+        return op
+
+    def _mks(f):
+        def op(data, scalar=0.0, reverse=False):
+            s = jnp.asarray(scalar, dtype=data.dtype)
+            return f(s, data) if reverse else f(data, s)
+        return op
+
+    def _mkr(f):
+        def op(data, scalar=0.0):
+            return f(jnp.asarray(scalar, dtype=data.dtype), data)
+        return op
+
+    _d = _name not in _NEW_BINARY_NONDIFF
+    register(_name, num_inputs=2, differentiable=_d,
+             aliases=(f"_npi_{_name}",))(_mk2(_f))
+    register(f"{_name}_scalar", num_inputs=1, differentiable=_d,
+             aliases=(f"_npi_{_name}_scalar",))(_mks(_f))
+
+register("rfmod_scalar", num_inputs=1,
+         aliases=("_npi_rfmod_scalar",))(
+    lambda data, scalar=0.0: jnp.fmod(
+        jnp.asarray(scalar, dtype=data.dtype), data))
+register("rarctan2_scalar", num_inputs=1,
+         aliases=("_npi_rarctan2_scalar",))(
+    lambda data, scalar=0.0: jnp.arctan2(
+        jnp.asarray(scalar, dtype=data.dtype), data))
+register("rcopysign_scalar", num_inputs=1,
+         aliases=("_npi_rcopysign_scalar",))(
+    lambda data, scalar=0.0: jnp.copysign(
+        jnp.asarray(scalar, dtype=data.dtype), data))
+register("rldexp_scalar", num_inputs=1, aliases=("_npi_rldexp_scalar",))(
+    lambda data, scalar=0.0: jnp.ldexp(
+        jnp.asarray(scalar, dtype=data.dtype), data.astype(jnp.int32)))
+register("ldexp_scalar", num_inputs=1, aliases=("_npi_ldexp_scalar",))(
+    lambda data, scalar=0.0: jnp.ldexp(data, jnp.asarray(int(scalar),
+                                                         jnp.int32)))
+
+
+def _bitwise_scalar(f):
+    def op(data, scalar=0, reverse=False):
+        with jax.enable_x64(True):
+            s = jnp.asarray(int(scalar), dtype=jnp.int64)
+            d = data.astype(jnp.int64)
+            out = f(s, d) if reverse else f(d, s)
+            return out.astype(data.dtype)
+    return op
+
+
+register("bitwise_and_scalar", num_inputs=1, differentiable=False,
+         aliases=("_npi_bitwise_and_scalar",))(
+    _bitwise_scalar(jnp.bitwise_and))
+register("bitwise_or_scalar", num_inputs=1, differentiable=False,
+         aliases=("_npi_bitwise_or_scalar",))(
+    _bitwise_scalar(jnp.bitwise_or))
+register("bitwise_xor_scalar", num_inputs=1, differentiable=False,
+         aliases=("_npi_bitwise_xor_scalar",))(
+    _bitwise_scalar(jnp.bitwise_xor))
+
+
+# legacy reversed-scalar ops (reference elemwise_binary_scalar_op_basic.cc
+# _rminus_scalar / _rdiv_scalar / _rmod_scalar / _rpower_scalar)
+register("rsub_scalar", num_inputs=1,
+         aliases=("_rminus_scalar", "_npi_rsubtract_scalar"))(
+    lambda data, scalar=0.0: jnp.asarray(scalar, data.dtype) - data)
+register("rdiv_scalar", num_inputs=1,
+         aliases=("_rdiv_scalar", "_npi_rtrue_divide_scalar"))(
+    lambda data, scalar=0.0: jnp.asarray(scalar, data.dtype) / data)
+register("rmod_scalar", num_inputs=1,
+         aliases=("_rmod_scalar", "_npi_rmod_scalar"))(
+    lambda data, scalar=0.0: jnp.mod(jnp.asarray(scalar, data.dtype), data))
+register("rpower_scalar", num_inputs=1,
+         aliases=("_rpower_scalar", "_npi_rpower_scalar"))(
+    lambda data, scalar=0.0: jnp.power(jnp.asarray(scalar, data.dtype), data))
+
+
+# ---------------------------------------------------------------------------
+# where scalar variants (reference np_where_op.cc: scalar is x for lscalar,
+# y for rscalar; scalar2 carries both as attrs x/y)
+# ---------------------------------------------------------------------------
+
+@register("where_lscalar", num_inputs=2, aliases=("_npi_where_lscalar",))
+def where_lscalar(condition, y, scalar=0.0):
+    return jnp.where(condition != 0, jnp.asarray(scalar, y.dtype), y)
+
+
+@register("where_rscalar", num_inputs=2, aliases=("_npi_where_rscalar",))
+def where_rscalar(condition, x, scalar=0.0):
+    return jnp.where(condition != 0, x, jnp.asarray(scalar, x.dtype))
+
+
+@register("where_scalar2", num_inputs=1, differentiable=False,
+          aliases=("_npi_where_scalar2",))
+def where_scalar2(condition, x=0.0, y=0.0):
+    return jnp.where(condition != 0, jnp.float32(x), jnp.float32(y))
+
+
+# ---------------------------------------------------------------------------
+# indexing / assignment (reference np_indexing_op.cc, np_boolean_mask*.cc,
+# np_index_add/update via _npx_)
+# ---------------------------------------------------------------------------
+
+@register("advanced_indexing", num_inputs=2, differentiable=False,
+          aliases=("_npi_advanced_indexing",))
+def advanced_indexing(data, indices):
+    return data[jnp.asarray(indices).astype(jnp.int32)]
+
+
+@register("advanced_indexing_multiple", num_inputs=-1, differentiable=False,
+          aliases=("_npi_advanced_indexing_multiple",))
+def advanced_indexing_multiple(arrays):
+    data = arrays[0]
+    idx = tuple(jnp.asarray(i).astype(jnp.int32) for i in arrays[1:])
+    return data[idx]
+
+
+@register("boolean_mask_assign_scalar", num_inputs=2, differentiable=False,
+          aliases=("_npi_boolean_mask_assign_scalar",))
+def boolean_mask_assign_scalar(data, mask, value=0.0):
+    m = mask.astype(jnp.bool_)
+    m = m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
+    return jnp.where(m, jnp.asarray(value, data.dtype), data)
+
+
+@register("boolean_mask_assign_tensor", num_inputs=3, differentiable=False,
+          aliases=("_npi_boolean_mask_assign_tensor",))
+def boolean_mask_assign_tensor(data, mask, value):
+    """data[mask] = value for a value broadcastable against ``data``; the
+    reference's compressed (n_masked, ...) value layout is
+    dynamic-shaped and handled on the host by the frontend."""
+    m = mask.astype(jnp.bool_)
+    m = m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
+    return jnp.where(m, jnp.broadcast_to(value.astype(data.dtype),
+                                         data.shape), data)
+
+
+@register("index_add", num_inputs=3, differentiable=False,
+          aliases=("_npx_index_add",))
+def index_add(data, indices, val):
+    """data.at[ind].add(val) — ``indices`` is the reference's (k, n) stacked
+    coordinate layout (np_index_add/update share it)."""
+    idx = tuple(indices.astype(jnp.int32))
+    return data.at[idx].add(val.astype(data.dtype))
+
+
+@register("index_update", num_inputs=3, differentiable=False,
+          aliases=("_npx_index_update",))
+def index_update(data, indices, val):
+    idx = tuple(indices.astype(jnp.int32))
+    return data.at[idx].set(val.astype(data.dtype))
+
+
+@register("constraint_check", differentiable=False,
+          aliases=("_npx_constraint_check",))
+def constraint_check(data, msg="Constraint violated!"):
+    """All-true check gate (reference np_constraint_check.cc): returns a
+    bool scalar; eager callers raise on False at the sync point."""
+    return jnp.all(data != 0)
+
+
+# ---------------------------------------------------------------------------
+# straight-through / gradient-scaling contrib ops
+# (reference contrib/stes_op.cc, contrib/gradient_multiplier_op.cc)
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def _round_ste(x):
+    return jnp.round(x)
+
+
+def _round_ste_fwd(x):
+    return jnp.round(x), None
+
+
+def _round_ste_bwd(_, g):
+    return (g,)
+
+
+_round_ste.defvjp(_round_ste_fwd, _round_ste_bwd)
+
+
+@register("round_ste", aliases=("_contrib_round_ste",))
+def round_ste(data):
+    """Round with straight-through gradient (reference contrib/stes_op.cc)."""
+    return _round_ste(data)
+
+
+@jax.custom_vjp
+def _sign_ste(x):
+    return jnp.sign(x)
+
+
+def _sign_ste_fwd(x):
+    return jnp.sign(x), None
+
+
+def _sign_ste_bwd(_, g):
+    return (g,)
+
+
+_sign_ste.defvjp(_sign_ste_fwd, _sign_ste_bwd)
+
+
+@register("sign_ste", aliases=("_contrib_sign_ste",))
+def sign_ste(data):
+    return _sign_ste(data)
+
+
+@register("gradientmultiplier", aliases=("_contrib_gradientmultiplier",))
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward, gradient scaled by ``scalar`` (reference
+    contrib/gradient_multiplier_op.cc — gradient-reversal layers)."""
+
+    @jax.custom_vjp
+    def _f(x):
+        return x
+
+    def _fwd(x):
+        return x, None
+
+    def _bwd(_, g):
+        return (g * scalar,)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data)
+
+
+@register("square_sum", aliases=("_square_sum",))
+def square_sum(data, axis=None, keepdims=False):
+    """sum(x*x) fused (reference square_sum.cc, row-sparse-oriented)."""
+    return jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims)
+
+
+# ---------------------------------------------------------------------------
+# legacy activation (reference softmax_activation.cc)
+# ---------------------------------------------------------------------------
+
+@register("SoftmaxActivation", aliases=("softmax_activation",))
+def softmax_activation(data, mode="instance"):
+    """mode='instance': softmax over the trailing flattened axes per batch
+    row; mode='channel': softmax over axis 1."""
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    flat = data.reshape(data.shape[0], -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(data.shape)
+
+
+# ---------------------------------------------------------------------------
+# distributions missing registered spellings
+# (reference numpy/random/np_*_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("laplace", num_inputs=0, differentiable=False,
+          aliases=("_npi_laplace",))
+def laplace(loc=0.0, scale=1.0, size=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return loc + scale * jax.random.laplace(key, tuple(size), _dt(dtype))
+
+
+@register("gumbel", num_inputs=0, differentiable=False,
+          aliases=("_npi_gumbel",))
+def gumbel(loc=0.0, scale=1.0, size=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return loc + scale * jax.random.gumbel(key, tuple(size), _dt(dtype))
+
+
+@register("logistic", num_inputs=0, differentiable=False,
+          aliases=("_npi_logistic",))
+def logistic(loc=0.0, scale=1.0, size=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return loc + scale * jax.random.logistic(key, tuple(size), _dt(dtype))
+
+
+@register("rayleigh", num_inputs=0, differentiable=False,
+          aliases=("_npi_rayleigh",))
+def rayleigh(scale=1.0, size=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    u = jax.random.uniform(key, tuple(size), _dt(dtype), minval=1e-7,
+                           maxval=1.0)
+    return scale * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+@register("pareto", num_inputs=0, differentiable=False,
+          aliases=("_npi_pareto",))
+def pareto(a=1.0, size=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    return jax.random.pareto(key, a, tuple(size), _dt(dtype)) - 1.0
+
+
+@register("weibull", num_inputs=0, differentiable=False,
+          aliases=("_npi_weibull",))
+def weibull(a=1.0, size=(1,), dtype=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    u = jax.random.uniform(key, tuple(size), _dt(dtype), minval=1e-7,
+                           maxval=1.0)
+    return jnp.power(-jnp.log(u), 1.0 / a)
+
+
+@register("powerd", num_inputs=0, differentiable=False,
+          aliases=("_npi_powerd",))
+def powerd(a=1.0, size=(1,), dtype=None, key=None):
+    """np.random.power: density a*x^(a-1) on [0, 1] — inverse-CDF
+    transform u^(1/a)."""
+    key = key if key is not None else _rng.next_key()
+    u = jax.random.uniform(key, tuple(size), _dt(dtype), minval=1e-7,
+                           maxval=1.0)
+    return jnp.power(u, 1.0 / a)
+
+
+@register("choice", num_inputs=0, differentiable=False,
+          aliases=("_npi_choice",))
+def choice(a=1, size=(1,), replace=True, weights=None, key=None):
+    key = key if key is not None else _rng.next_key()
+    pool = jnp.arange(int(a)) if isinstance(a, (int, float)) else jnp.asarray(a)
+    p = None if weights is None else jnp.asarray(weights)
+    return jax.random.choice(key, pool, tuple(size), replace=replace, p=p)
+
+
+@register("generalized_negative_binomial", num_inputs=0,
+          differentiable=False,
+          aliases=("_sample_generalized_negative_binomial",
+                   "random_generalized_negative_binomial"))
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,), dtype=None,
+                                  key=None):
+    """Gamma-Poisson mixture with mean mu, dispersion alpha (reference
+    random/sample_op.cc GeneralizedNegativeBinomialSampler)."""
+    key = key if key is not None else _rng.next_key()
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(k1, r, tuple(shape)) * (alpha * mu)
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(_dt(dtype))

@@ -19,7 +19,7 @@ _CONTRIB = [
     "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
     "count_sketch", "fft", "ifft", "DeformableConvolution",
     "quantize", "dequantize", "requantize", "quantized_conv",
-    "quantized_fully_connected",
+    "quantized_fully_connected", "div_sqrt_dim",
 ]
 
 # reference internal spelling -> canonical name (not _contrib_ prefixed)
@@ -39,6 +39,99 @@ _LINALG = [
     "gelqf",
 ]
 
+# numpy-op registration spellings (reference src/operator/numpy/* registers
+# the np surface as _npi_*/_np_* NNVM names; the surface functions exist
+# here under canonical names — these aliases make reference symbol JSON and
+# by-name invoke resolve node-for-node)
+_NPI = {
+    # elementwise binary (np_elemwise_broadcast_op.cc)
+    "_npi_add": "broadcast_add", "_npi_subtract": "broadcast_sub",
+    "_npi_multiply": "broadcast_mul", "_npi_true_divide": "broadcast_div",
+    "_npi_mod": "broadcast_mod", "_npi_power": "broadcast_power",
+    "_npi_hypot": "broadcast_hypot",
+    "_npi_add_scalar": "add_scalar", "_npi_subtract_scalar": "sub_scalar",
+    "_npi_multiply_scalar": "mul_scalar",
+    "_npi_true_divide_scalar": "div_scalar",
+    "_npi_mod_scalar": "mod_scalar", "_npi_power_scalar": "power_scalar",
+    "_npi_bitwise_and": "bitwise_and", "_npi_bitwise_or": "bitwise_or",
+    "_npi_bitwise_xor": "bitwise_xor", "_npi_bitwise_not": "bitwise_not",
+    "_npi_deg2rad": "radians", "_npi_rad2deg": "degrees",
+    "_npi_log": "log", "_npi_ldexp": "ldexp",
+    # reductions (np_broadcast_reduce_op_value.cc)
+    "_npi_mean": "mean", "_npi_sum": "sum", "_npi_max": "max",
+    "_npi_min": "min", "_npi_prod": "prod", "_npi_cumsum": "cumsum",
+    "_npi_argmax": "argmax", "_npi_argmin": "argmin",
+    "_npi_norm": "np_norm",
+    # shape / manipulation (np_matrix_op.cc)
+    "_npi_concatenate": "concat", "_npi_stack": "stack",
+    "_npi_dot": "dot", "_npi_matmul": "matmul", "_npi_trace": "trace",
+    "_npi_transpose": "transpose", "_npi_flip": "flip",
+    "_npi_roll": "roll", "_npi_rot90": "rot90",
+    "_npi_squeeze": "squeeze", "_np_squeeze": "squeeze",
+    "_npi_copy": "_copy", "_np_reshape": "reshape",
+    "_npx_reshape": "reshape", "_npi_pad": "pad",
+    "_npi_repeats": "repeat", "_npi_unique": "unique",
+    "_npi_where": "where", "_npi_diag": "diag",
+    "_npi_broadcast_to": "broadcast_to",
+    # creation (np_init_op.cc)
+    "_npi_zeros": "zeros", "_npi_ones": "ones", "_npi_full": "full",
+    "_npi_identity": "identity", "_npi_eye": "eye",
+    "_npi_arange": "arange", "_npi_linspace": "linspace",
+    "_npi_tril": "tril", "_npi_triu": "triu",
+    # linalg (np_laop lanes)
+    "_npi_cholesky": "linalg_cholesky", "_npi_eigh": "linalg_eigh",
+    "_npi_eigvalsh": "linalg_eigvalsh", "_npi_svd": "linalg_svd",
+    "_npi_qr": "linalg_qr", "_npi_solve": "linalg_solve",
+    "_npi_lstsq": "linalg_lstsq", "_npi_pinv": "linalg_pinv",
+    "_npi_pinv_scalar_rcond": "linalg_pinv",
+    "_npi_tensorinv": "linalg_tensorinv",
+    "_npi_matrix_rank": "linalg_matrix_rank",
+    "_npi_matrix_rank_none_tol": "linalg_matrix_rank",
+    # random (numpy/random/*.cc)
+    "_npi_normal": "normal", "_npi_normal_n": "normal",
+    "_npi_uniform": "uniform", "_npi_uniform_n": "uniform",
+    "_npi_gamma": "random_gamma", "_npi_exponential": "exponential",
+    "_npi_bernoulli": "bernoulli", "_npi_multinomial": "multinomial",
+}
+
+# legacy internal spellings (reference elemwise_binary_broadcast_op*.cc,
+# elemwise_binary_scalar_op*.cc register comparison/logical/scalar ops
+# under leading-underscore names)
+_LEGACY = {
+    "_equal": "broadcast_equal", "_not_equal": "broadcast_not_equal",
+    "_greater": "broadcast_greater",
+    "_greater_equal": "broadcast_greater_equal",
+    "_lesser": "broadcast_lesser",
+    "_lesser_equal": "broadcast_lesser_equal",
+    "_logical_and": "broadcast_logical_and",
+    "_logical_or": "broadcast_logical_or",
+    "_logical_xor": "broadcast_logical_xor",
+    "_maximum": "broadcast_maximum", "_minimum": "broadcast_minimum",
+    "_mod": "broadcast_mod", "_power": "broadcast_power",
+    "_hypot": "broadcast_hypot", "_grad_add": "elemwise_add",
+    "_equal_scalar": "equal_scalar",
+    "_not_equal_scalar": "not_equal_scalar",
+    "_greater_scalar": "greater_scalar",
+    "_greater_equal_scalar": "greater_equal_scalar",
+    "_lesser_scalar": "lesser_scalar",
+    "_lesser_equal_scalar": "lesser_equal_scalar",
+    "_logical_and_scalar": "logical_and_scalar",
+    "_logical_or_scalar": "logical_or_scalar",
+    "_logical_xor_scalar": "logical_xor_scalar",
+    "_maximum_scalar": "maximum_scalar",
+    "_minimum_scalar": "minimum_scalar",
+    "_plus_scalar": "add_scalar", "_minus_scalar": "sub_scalar",
+    "_mul_scalar": "mul_scalar", "_div_scalar": "div_scalar",
+    "_mod_scalar": "mod_scalar", "_power_scalar": "power_scalar",
+    "_hypot_scalar": "hypot_scalar",
+    "_sample_exponential": "exponential", "_sample_poisson": "poisson",
+    "_sample_negative_binomial": "negative_binomial",
+    "_multi_lamb_update": "multi_lamb_update",
+    "_multi_lans_update": "multi_lans_update",
+    # cuDNN-dispatch spelling; one BatchNorm lowering here
+    "CuDNNBatchNorm": "BatchNorm",
+}
+
 
 def apply() -> None:
     """Install aliases for every canonical op currently registered.
@@ -56,6 +149,10 @@ def apply() -> None:
         canon, ref = f"linalg_{name}", f"_linalg_{name}"
         if find_op(canon) is not None and find_op(ref) is None:
             alias(canon, ref)
+    for table in (_NPI, _LEGACY):
+        for ref, canon in table.items():
+            if find_op(canon) is not None and find_op(ref) is None:
+                alias(canon, ref)
     # fused RNN op: the reference registers the stateful cuDNN/CPU op as
     # "RNN" (src/operator/rnn.cc:451); the scan lowering here is _rnn_fused
     if find_op("RNN") is None and find_op("_rnn_fused") is not None:

@@ -34,6 +34,10 @@ def seed(seed_state: int, ctx=None):
     global _KEY
     with _lock:
         _KEY = jax.random.PRNGKey(int(seed_state))
+    # host-side sampling streams (graph minibatch construction) follow
+    from .ops import graph_sampling
+
+    graph_sampling.seed_rng(int(seed_state))
 
 
 def push_trace_key(key):

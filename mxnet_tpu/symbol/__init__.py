@@ -13,6 +13,7 @@ from ..ops import registry as _registry
 from .register import make_sym_func
 from .symbol import (Group, Symbol, Variable, execute_graph, load, load_json,
                      var)
+from . import subgraph  # noqa: F401  (SubgraphProperty framework)
 
 _this = _sys.modules[__name__]
 
@@ -33,5 +34,5 @@ for _ln in _registry.list_ops():
     if _ln.startswith("linalg_"):
         setattr(linalg, _ln[len("linalg_"):], getattr(_this, _ln))
 
-__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "subgraph",
            "execute_graph"]

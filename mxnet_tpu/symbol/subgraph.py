@@ -1,0 +1,393 @@
+"""Selector-based subgraph partitioning over the Symbol DAG.
+
+Reference analog: the subgraph framework of
+``src/operator/subgraph/subgraph_property.h:86-252`` (SubgraphSelector's
+seed + BFS grow + filter protocol) and ``build_subgraph.cc`` (convexity
+repair, subgraph node creation).  The TPU-native difference: a matched
+subgraph is replaced by whatever Symbol the property builds — usually a
+single fused node whose op is an ordinary registry op — and XLA compiles
+the final graph; there is no separate subgraph executor to manage.
+
+Used by ``Symbol.optimize_for`` and registrable through
+``mxnet_tpu.library.register_backend`` (a SubgraphProperty instance is a
+valid backend; hybrid blocks keep using callable transforms).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from .symbol import Symbol, SymNode
+
+__all__ = ["SubgraphSelector", "SubgraphProperty", "OpChainSelector",
+           "ConvBNReLUProperty", "partition"]
+
+
+class SubgraphSelector:
+    """Node-selection protocol (reference SubgraphSelector,
+    subgraph_property.h:86): ``select`` picks seeds, ``select_input`` /
+    ``select_output`` grow the candidate set along data edges,
+    ``filter`` finalizes, ``reset`` clears per-seed state."""
+
+    def select(self, node: SymNode) -> bool:
+        return False
+
+    def select_input(self, cur: SymNode, input_node: SymNode) -> bool:
+        return False
+
+    def select_output(self, cur: SymNode, output_node: SymNode) -> bool:
+        return False
+
+    def filter(self, candidates: List[SymNode]) -> List[SymNode]:
+        return candidates
+
+    def reset(self) -> None:
+        pass
+
+
+class SubgraphProperty:
+    """A partitioning policy + subgraph rewriter (reference
+    SubgraphProperty::CreateSubgraphNode)."""
+
+    name = "subgraph"
+
+    def create_selector(self) -> SubgraphSelector:
+        raise NotImplementedError
+
+    def create_subgraph_node(self, sub_sym: Symbol, subgraph_id: int,
+                             params: Dict[str, Any]):
+        """Return a replacement Symbol with the same number of outputs as
+        ``sub_sym``, or None to leave this match unchanged.
+
+        ``sub_sym``'s free variables are the subgraph's external inputs:
+        parameter inputs keep their real names (look arrays up in
+        ``params``); activation inputs are ``sg{id}_in{j}`` placeholders.
+        The replacement must be built over those same variables (reuse the
+        nodes found in ``sub_sym`` or create variables with identical
+        names); variables with NEW names are fresh parameters whose arrays
+        the property must add to ``params``."""
+        raise NotImplementedError
+
+
+class OpChainSelector(SubgraphSelector):
+    """Matches a linear op-name chain (e.g. Convolution -> BatchNorm ->
+    Activation), the shape MKLDNN's conv-fusion selectors match."""
+
+    def __init__(self, chain: Tuple[str, ...]):
+        self.chain = tuple(chain)
+        self._pos = 0
+
+    def select(self, node: SymNode) -> bool:
+        self._pos = 0
+        return node.op == self.chain[0]
+
+    def select_output(self, cur: SymNode, output_node: SymNode) -> bool:
+        want = self._pos + 1
+        if want < len(self.chain) and output_node.op == self.chain[want]:
+            self._pos = want
+            return True
+        return False
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+def _consumers(order: List[SymNode], outputs) -> Dict[int, List[Tuple[SymNode, int]]]:
+    cons: Dict[int, List[Tuple[SymNode, int]]] = {}
+    for n in order:
+        for pos, (src, _i) in enumerate(n.inputs):
+            cons.setdefault(id(src), []).append((n, pos))
+    return cons
+
+
+def _repair_convexity(members: List[SymNode], order: List[SymNode],
+                      cons) -> List[SymNode]:
+    """Drop members until no path between two members passes through a
+    non-member (reference build_subgraph.cc label propagation — a
+    non-convex set would make the fused node part of a cycle)."""
+    member_ids = {id(m) for m in members}
+    topo_idx = {id(n): i for i, n in enumerate(order)}
+    while True:
+        # taint: non-member nodes downstream of any member
+        tainted = set()
+        for n in order:
+            if id(n) in member_ids:
+                continue
+            if any(id(src) in member_ids or id(src) in tainted
+                   for (src, _i) in n.inputs):
+                tainted.add(id(n))
+        # a member consuming a tainted node breaks convexity
+        bad = [m for m in members
+               if any(id(src) in tainted for (src, _i) in m.inputs)]
+        if not bad:
+            return members
+        # drop the topologically-latest offender and retry
+        bad.sort(key=lambda m: topo_idx[id(m)])
+        drop = bad[-1]
+        members = [m for m in members if m is not drop]
+        member_ids.discard(id(drop))
+        if not members:
+            return members
+
+
+def partition(sym: Symbol, prop: SubgraphProperty,
+              params: Optional[Dict[str, Any]] = None
+              ) -> Tuple[Symbol, Dict[str, Any]]:
+    """Partition ``sym``: seed + BFS grow + filter per the property's
+    selector, replace each accepted subgraph with the property's rewrite,
+    leave everything else untouched.  Returns (new_sym, params) — the
+    property may add folded parameter arrays to ``params``."""
+    params = dict(params or {})
+    order = sym._topo()
+    cons = _consumers(order, sym._outputs)
+    heads = {}
+    for (h, i) in sym._outputs:
+        heads.setdefault(id(h), []).append(i)
+
+    assigned: Dict[int, int] = {}      # id(node) -> subgraph index
+    groups: List[List[SymNode]] = []
+    for seed in order:
+        if seed.op is None or id(seed) in assigned:
+            continue
+        selector = prop.create_selector()
+        selector.reset()
+        if not selector.select(seed):
+            continue
+        members = [seed]
+        member_ids = {id(seed)}
+        frontier = [seed]
+        while frontier:
+            nxt = []
+            for m in frontier:
+                for (src, _i) in m.inputs:
+                    if (src.op is not None and id(src) not in member_ids
+                            and id(src) not in assigned
+                            and selector.select_input(m, src)):
+                        members.append(src)
+                        member_ids.add(id(src))
+                        nxt.append(src)
+                for (c, _pos) in cons.get(id(m), []):
+                    if (c.op is not None and id(c) not in member_ids
+                            and id(c) not in assigned
+                            and selector.select_output(m, c)):
+                        members.append(c)
+                        member_ids.add(id(c))
+                        nxt.append(c)
+            frontier = nxt
+        members = selector.filter(members)
+        members = _repair_convexity(members, order, cons)
+        if not members:
+            continue
+        gi = len(groups)
+        for m in members:
+            assigned[id(m)] = gi
+        groups.append(members)
+
+    if not groups:
+        return sym, params
+
+    topo_idx = {id(n): i for i, n in enumerate(order)}
+    # node -> replacement output entry, built in topo order
+    replaced: Dict[Tuple[int, int], Tuple[SymNode, int]] = {}
+    rebuilt: Dict[int, SymNode] = {}
+
+    def rebuild(n: SymNode) -> SymNode:
+        got = rebuilt.get(id(n))
+        if got is not None:
+            return got
+        new_inputs = []
+        for (src, i) in n.inputs:
+            if (id(src), i) in replaced:
+                new_inputs.append(replaced[(id(src), i)])
+            elif id(src) in assigned:
+                raise MXNetError(
+                    f"subgraph output ({src.name}, {i}) consumed before "
+                    "its group was rewritten — partitioning bug")
+            else:
+                new_inputs.append((rebuild(src), i))
+        node = SymNode(n.op, n.name, dict(n.attrs), new_inputs,
+                       n.num_outputs)
+        node.attr_dict = dict(n.attr_dict)
+        rebuilt[id(n)] = node
+        return node
+
+    # process groups in topo order of their earliest member so a group's
+    # external inputs (possibly other groups' outputs) are ready
+    for gi, members in sorted(
+            enumerate(groups),
+            key=lambda g: min(topo_idx[id(m)] for m in g[1])):
+        member_ids = {id(m) for m in members}
+        members_sorted = sorted(members, key=lambda m: topo_idx[id(m)])
+        # external input entries, in first-use order
+        ext_inputs: List[Tuple[SymNode, int]] = []
+        ext_index: Dict[Tuple[int, int], int] = {}
+        for m in members_sorted:
+            for (src, i) in m.inputs:
+                if id(src) in member_ids:
+                    continue
+                key = (id(src), i)
+                if key not in ext_index:
+                    ext_index[key] = len(ext_inputs)
+                    ext_inputs.append((src, i))
+        # output entries: consumed outside the group, or graph heads
+        out_entries: List[Tuple[SymNode, int]] = []
+        for m in members_sorted:
+            used = set()
+            for (c, pos) in cons.get(id(m), []):
+                if id(c) not in member_ids:
+                    used.add(c.inputs[pos][1])
+            used.update(heads.get(id(m), []))
+            for i in sorted(used):
+                out_entries.append((m, i))
+        # clone the subgraph over placeholder variables; an external input
+        # that IS a variable (a param like conv_weight / bn_gamma) keeps
+        # its name so properties can look its array up in ``params``
+        placeholders = []
+        for j, (src, _i) in enumerate(ext_inputs):
+            pname = src.name if src.op is None else f"sg{gi}_in{j}"
+            placeholders.append(SymNode(None, pname, {}, []))
+        clone: Dict[int, SymNode] = {}
+
+        def clone_node(m: SymNode) -> SymNode:
+            got = clone.get(id(m))
+            if got is not None:
+                return got
+            ins = []
+            for (src, i) in m.inputs:
+                if id(src) in member_ids:
+                    ins.append((clone_node(src), i))
+                else:
+                    ins.append((placeholders[ext_index[(id(src), i)]], 0))
+            node = SymNode(m.op, m.name, dict(m.attrs), ins, m.num_outputs)
+            clone[id(m)] = node
+            return node
+
+        sub_sym = Symbol([(clone_node(m), i) for (m, i) in out_entries])
+        replacement = prop.create_subgraph_node(sub_sym, gi, params)
+        if replacement is None:
+            replacement = sub_sym          # decline: splice back verbatim
+        if len(replacement._outputs) != len(out_entries):
+            raise MXNetError(
+                f"subgraph property '{prop.name}' returned "
+                f"{len(replacement._outputs)} outputs for a subgraph with "
+                f"{len(out_entries)}")
+        # rebind the replacement's placeholder variables to the ORIGINAL
+        # external producers (rebuilt), keep genuinely new variables
+        # (folded params the property added) as-is
+        ph_names = {p.name: j for j, p in enumerate(placeholders)}
+        bound: Dict[int, SymNode] = {}
+
+        def bind_entry(entry):
+            n, i = entry
+            if n.op is None and n.name in ph_names:
+                src, si = ext_inputs[ph_names[n.name]]
+                key = (id(src), si)
+                if key in replaced:
+                    return replaced[key]
+                return (rebuild(src), si)
+            return (bind_node(n), i)
+
+        def bind_node(n: SymNode) -> SymNode:
+            got = bound.get(id(n))
+            if got is not None:
+                return got
+            node = SymNode(n.op, n.name, dict(n.attrs),
+                           [bind_entry(e) for e in n.inputs],
+                           n.num_outputs)
+            node.attr_dict = dict(n.attr_dict)
+            bound[id(n)] = node
+            return node
+
+        for (orig_entry, rep_entry) in zip(out_entries,
+                                           replacement._outputs):
+            replaced[(id(orig_entry[0]), orig_entry[1])] = \
+                bind_entry(rep_entry)
+
+    new_heads = []
+    for (h, i) in sym._outputs:
+        if (id(h), i) in replaced:
+            new_heads.append(replaced[(id(h), i)])
+        else:
+            new_heads.append((rebuild(h), i))
+    return Symbol(new_heads), params
+
+
+class ConvBNReLUProperty(SubgraphProperty):
+    """Built-in fusion property: Convolution -> BatchNorm [-> relu]
+    collapses to ONE Convolution with BN folded into weight/bias and a
+    ``fused_relu`` epilogue — the pattern the reference's MKLDNN conv
+    property matches (subgraph/mkldnn/mkldnn_conv_property.h)."""
+
+    name = "FUSE_CONV_BN_RELU"
+
+    def create_selector(self) -> SubgraphSelector:
+        class _Sel(OpChainSelector):
+            def __init__(self):
+                super().__init__(("Convolution", "BatchNorm", "Activation"))
+
+            def select_output(self, cur, out_node):
+                if cur.op == "BatchNorm" and out_node.op in ("Activation",
+                                                            "relu"):
+                    if out_node.op == "relu" or \
+                            out_node.attrs.get("act_type") == "relu":
+                        self._pos = 2
+                        return True
+                    return False
+                return super().select_output(cur, out_node)
+
+            def filter(self, candidates):
+                ops = {c.op for c in candidates}
+                # need at least conv+bn; a lone conv is not a match
+                if "Convolution" not in ops or "BatchNorm" not in ops:
+                    return []
+                return candidates
+
+        return _Sel()
+
+    def create_subgraph_node(self, sub_sym: Symbol, subgraph_id: int,
+                             params: Dict[str, Any]):
+        order = sub_sym._topo()
+        conv = next((n for n in order if n.op == "Convolution"), None)
+        bn = next((n for n in order if n.op == "BatchNorm"), None)
+        has_relu = any(n.op in ("Activation", "relu") for n in order
+                       if n.op != "BatchNorm")
+        if conv is None or bn is None or len(bn.inputs) != 5:
+            return None
+        stat_names = [s.name for (s, _i) in bn.inputs[1:]]
+        w_name = conv.inputs[1][0].name
+        needed = stat_names + [w_name]
+        if not all(k in params for k in needed):
+            return None
+
+        def arr(k):
+            v = params[k]
+            return v.asnumpy() if hasattr(v, "asnumpy") else onp.asarray(v)
+
+        g, beta, mean, var = (arr(s) for s in stat_names)
+        if bn.attrs.get("fix_gamma", True):
+            g = onp.ones_like(g)
+        eps = float(bn.attrs.get("eps", 1e-3))
+        scale = g / onp.sqrt(var + eps)
+        w = arr(w_name)
+        if conv.attrs.get("no_bias", False) or len(conv.inputs) < 3:
+            b = onp.zeros(w.shape[0], w.dtype)
+        else:
+            b = arr(conv.inputs[2][0].name)
+        out_name = order[-1].name
+        wf_name, bf_name = out_name + "_sgfold_w", out_name + "_sgfold_b"
+        params[wf_name] = (w * scale.reshape(
+            (-1,) + (1,) * (w.ndim - 1))).astype(w.dtype)
+        params[bf_name] = ((b - mean) * scale + beta).astype(w.dtype)
+        attrs = dict(conv.attrs)
+        attrs["no_bias"] = False
+        if has_relu:
+            attrs["fused_relu"] = True
+        data_entry = conv.inputs[0]          # a placeholder variable
+        node = SymNode("Convolution", out_name, attrs,
+                       [data_entry,
+                        (SymNode(None, wf_name, {}, []), 0),
+                        (SymNode(None, bf_name, {}, []), 0)],
+                       num_outputs=1)
+        return Symbol([(node, 0)])

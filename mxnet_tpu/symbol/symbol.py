@@ -15,6 +15,7 @@ symbol.json (nodes / arg_nodes / heads) so exported models are inspectable.
 from __future__ import annotations
 
 import json
+import re
 import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -230,9 +231,42 @@ class Symbol:
             return None, None, None
 
     # -- serialization ---------------------------------------------------
-    def tojson(self) -> str:
+    def tojson(self, ref_format: bool = False) -> str:
+        """Serialize.  ``ref_format=True`` emits Apache-MXNet/nnvm layout
+        — 3-element inputs/heads ``[id, index, version]``, all-string
+        attrs, node_row_ptr, ``attrs.mxnet_version`` — loadable by the
+        reference's ``symbol.load`` (nnvm JSON; see
+        /root/reference/src/nnvm/legacy_json_util.cc)."""
         order = self._topo()
         index = {id(n): i for i, n in enumerate(order)}
+        if ref_format:
+            nodes = []
+            for n in order:
+                spec = {
+                    "op": n.op or "null",
+                    "name": n.name,
+                    "inputs": [[index[id(src)], i, 0]
+                               for (src, i) in n.inputs],
+                }
+                attrs = {k: _ref_attr_str(v) for k, v in n.attrs.items()}
+                attrs.update(n.attr_dict)
+                if attrs:
+                    spec["attrs"] = attrs
+                nodes.append(spec)
+            row_ptr, total = [0], 0
+            for n in order:
+                total += n.num_outputs
+                row_ptr.append(total)
+            payload = {
+                "nodes": nodes,
+                "arg_nodes": [i for i, n in enumerate(order)
+                              if n.op is None],
+                "node_row_ptr": row_ptr,
+                "heads": [[index[id(n)], i, 0]
+                          for (n, i) in self._outputs],
+                "attrs": {"mxnet_version": ["int", 10700]},
+            }
+            return json.dumps(payload, indent=2)
         nodes = []
         for n in order:
             nodes.append({
@@ -251,9 +285,27 @@ class Symbol:
         }
         return json.dumps(payload, indent=1)
 
-    def save(self, fname: str):
+    def save(self, fname: str, ref_format: bool = False):
         with open(fname, "w") as f:
-            f.write(self.tojson())
+            f.write(self.tojson(ref_format=ref_format))
+
+    def optimize_for(self, backend, params=None, **kwargs):
+        """Partition-and-rewrite with a subgraph backend (reference
+        symbol.py optimize_for -> MXOptimizeForBackend + the
+        SubgraphProperty framework).  ``backend`` is a registered backend
+        name or a SubgraphProperty instance; returns
+        (new_symbol, params) — the property may add folded params."""
+        from ..library import get_backend
+        from .subgraph import SubgraphProperty, partition
+
+        prop = backend if isinstance(backend, SubgraphProperty) \
+            else get_backend(backend)
+        if not isinstance(prop, SubgraphProperty):
+            raise MXNetError(
+                f"backend {backend!r} is a traced-function transform (for "
+                "hybridized blocks); Symbol.optimize_for needs a "
+                "SubgraphProperty")
+        return partition(self, prop, params)
 
     # -- execution -------------------------------------------------------
     def eval(self, ctx=None, **kwargs):
@@ -485,6 +537,18 @@ def _encode_attr(v):
     return {"__repr__": repr(v)}
 
 
+def _ref_attr_str(v) -> str:
+    """Attr -> reference string spelling (dmlc parameter printing: tuples
+    '(3, 3)', bools 'True', numbers bare, None 'None')."""
+    if isinstance(v, (jnp.ndarray, onp.ndarray)):
+        return str(tuple(onp.asarray(v).ravel().tolist()))
+    if isinstance(v, (list, tuple)):
+        return str(tuple(v))
+    if isinstance(v, (type, onp.dtype)):
+        return onp.dtype(v).name
+    return str(v)
+
+
 def _decode_attr(v):
     if isinstance(v, dict):
         if "__tuple__" in v:
@@ -506,8 +570,107 @@ def _decode_attr(v):
     return v
 
 
+# annotation keys the reference keeps OUT of the op's attr parser: variable
+# annotations (__shape__ etc.) and kHiddenKeys
+# (/root/reference/src/c_api/c_api_symbolic.cc:43)
+_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                "mirror_stage", "profiler_scope")
+
+
+def _parse_ref_attr_value(s):
+    """Reference JSON attrs are ALL strings ('(3, 3)', '64', 'True',
+    'float32'); recover python values the op fns take.  Strings that are
+    not literals (dtype/act_type names) pass through unchanged."""
+    if not isinstance(s, str):
+        return s
+    t = s.strip()
+    if t == "None":
+        return None
+    if t in ("True", "true"):
+        return True
+    if t in ("False", "false"):
+        return False
+    # pre-1.0 JSONs print shapes with long suffixes: "(3L, 3L)"
+    t2 = re.sub(r"(\d)L\b", r"\1", t)
+    try:
+        import ast
+
+        return ast.literal_eval(t2)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def _is_annotation_key(k: str) -> bool:
+    if k.startswith("__") and k.endswith("__"):
+        return True
+    return any(k == h or k.endswith("_" + h) for h in _HIDDEN_KEYS)
+
+
+def _import_nnvm_json(payload: dict) -> Symbol:
+    """Import reference (Apache MXNet / nnvm) symbol JSON: 3-element
+    ``inputs``/``heads`` entries ``[node_id, out_index, version]``,
+    string-typed attrs under 'attrs'/'param'/'attr' (format drifted across
+    versions — /root/reference/src/nnvm/legacy_json_util.cc upgrades all of
+    them), ``_npi_*``/``_contrib_*``/internal registration spellings."""
+    g_attrs = payload.get("attrs", {})
+    version = 800      # pre-0.9 JSONs carry no version (MAKE_VERSION(0,8,0))
+    if isinstance(g_attrs, dict) and "mxnet_version" in g_attrs:
+        try:
+            version = int(g_attrs["mxnet_version"][1])
+        except (TypeError, ValueError, IndexError):
+            pass
+    nodes: List[SymNode] = []
+    for spec in payload["nodes"]:
+        op = None if spec["op"] == "null" else spec["op"]
+        raw = spec.get("attrs", spec.get("param", spec.get("attr", {}))) or {}
+        op_attrs, annotations = {}, {}
+        for k, v in raw.items():
+            if _is_annotation_key(k):
+                annotations[k] = v
+            else:
+                op_attrs[k] = _parse_ref_attr_value(v)
+        inputs = [(nodes[e[0]], e[1]) for e in spec.get("inputs", [])]
+        if op is None:
+            node = SymNode(None, spec["name"], {}, [], 1)
+        else:
+            schema = find_op(op)
+            if schema is None:
+                raise MXNetError(
+                    f"symbol references unknown operator '{op}' (reference "
+                    f"registration spelling not resolvable; see "
+                    f"ops/ref_aliases.py)")
+            # UpgradeJSON_000904_000905: argmin/argmax axis=-1 meant 'all'
+            if version < 905 and op in ("argmin", "argmax") \
+                    and str(raw.get("axis")) == "-1":
+                op_attrs.pop("axis", None)
+            # UpgradeJSON_000800_000900: aux inputs (BatchNorm moving
+            # stats, ...) were not serialized before 0.9 — pad with fresh
+            # variables like the reference upgrader does.  Variadic ops
+            # (num_inputs == -1) use the known aux-carrying arities.
+            expected = schema.num_inputs if schema.num_inputs > 0 \
+                else {"BatchNorm": 5, "BatchNormWithReLU": 5,
+                      "SyncBatchNorm": 5}.get(schema.name, 0)
+            if version < 900 and len(inputs) < expected:
+                # fresh variables reachable through `inputs` only — they
+                # must NOT enter `nodes`, which is the json-positional
+                # index later entries resolve against
+                for i in range(len(inputs), expected):
+                    v_node = SymNode(None, f"{spec['name']}_aux{i}", {},
+                                     [], 1)
+                    inputs.append((v_node, 0))
+            node = SymNode(schema.name, spec["name"], op_attrs, inputs,
+                           _resolve_num_outputs(schema, op_attrs))
+        node.attr_dict = {k: str(v) for k, v in annotations.items()}
+        nodes.append(node)
+    heads = [(nodes[h[0]], h[1]) for h in payload["heads"]]
+    return Symbol(heads)
+
+
 def load_json(json_str: str) -> Symbol:
     payload = json.loads(json_str)
+    if payload.get("format") != "mxnet_tpu_symbol-v1":
+        # no format tag + nnvm markers => reference JSON
+        return _import_nnvm_json(payload)
     nodes: List[SymNode] = []
     for spec in payload["nodes"]:
         op = None if spec["op"] == "null" else spec["op"]

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Generate golden .onnx byte fixtures with an INDEPENDENT protobuf wire
+serializer.
+
+This file intentionally shares no code with
+``mxnet_tpu/contrib/onnx/proto.py``: it hand-packs protobuf varints /
+length-delimited fields straight from the ONNX schema (onnx/onnx.proto
+field numbers), so the checked-in bytes are an external reference for the
+repo codec — a wire-format bug in proto.py cannot also be in here.  The
+environment ships neither ``onnx`` nor ``onnxruntime`` (and torch.onnx
+refuses to serialize without onnx installed), so two independent
+implementations agreeing on bytes is the strongest cross-check available
+offline.
+
+Run from the repo root to regenerate:
+    python tests/fixtures/gen_onnx_golden.py
+"""
+import os
+import struct
+
+import numpy as onp
+
+OUT_DIR = os.path.dirname(os.path.abspath(__file__))
+
+# onnx.proto: TensorProto.DataType
+FLOAT = 1
+INT64 = 7
+
+
+def vint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field, wire):
+    return vint((field << 3) | wire)
+
+
+def fv(field, value):                      # varint field
+    return tag(field, 0) + vint(value)
+
+
+def fb(field, payload):                    # length-delimited field
+    return tag(field, 2) + vint(len(payload)) + payload
+
+
+def fs(field, s):
+    return fb(field, s.encode())
+
+
+def tensor_proto(name, arr):
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = onp.ascontiguousarray(arr)
+    dt = FLOAT if arr.dtype == onp.float32 else INT64
+    msg = b"".join(fv(1, d) for d in arr.shape)
+    msg += fv(2, dt)
+    msg += fs(8, name)
+    msg += fb(9, arr.tobytes())
+    return msg
+
+
+def attr_ints(name, values):
+    """AttributeProto: name=1, ints=8(repeated), type=20 (INTS=7)."""
+    msg = fs(1, name)
+    for v in values:
+        msg += fv(8, v)
+    msg += fv(20, 7)
+    return msg
+
+
+def attr_int(name, value):
+    return fs(1, name) + fv(3, value) + fv(20, 2)      # i=3, INT=2
+
+
+def attr_float(name, value):
+    return (fs(1, name) + tag(2, 5) + struct.pack("<f", value)
+            + fv(20, 1))                               # f=2, FLOAT=1
+
+
+def node_proto(op_type, inputs, outputs, name="", attrs=()):
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    msg = b"".join(fs(1, i) for i in inputs)
+    msg += b"".join(fs(2, o) for o in outputs)
+    if name:
+        msg += fs(3, name)
+    msg += fs(4, op_type)
+    msg += b"".join(fb(5, a) for a in attrs)
+    return msg
+
+
+def value_info(name, shape):
+    """ValueInfoProto{name=1, type=2}; TypeProto.tensor_type=1;
+    Tensor{elem_type=1, shape=2}; Shape.dim=1; Dim.dim_value=1."""
+    dims = b"".join(fb(1, fv(1, d)) for d in shape)
+    ttype = fv(1, FLOAT) + fb(2, dims)
+    return fs(1, name) + fb(2, fb(1, ttype))
+
+
+def graph_proto(nodes, name, initializers, inputs, outputs):
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    msg = b"".join(fb(1, n) for n in nodes)
+    msg += fs(2, name)
+    msg += b"".join(fb(5, t) for t in initializers)
+    msg += b"".join(fb(11, v) for v in inputs)
+    msg += b"".join(fb(12, v) for v in outputs)
+    return msg
+
+
+def model_proto(graph, opset=17):
+    """ModelProto: ir_version=1, producer_name=2, graph=7,
+    opset_import=8{domain=1, version=2}."""
+    return (fv(1, 8) + fs(2, "golden-gen") + fb(7, graph)
+            + fb(8, fs(1, "") + fv(2, opset)))
+
+
+def gen_mlp():
+    """x(1,4) -> Gemm(W1 4x8, b1) -> Relu -> Gemm(W2 8x2, b2) -> y."""
+    rng = onp.random.RandomState(7)
+    w1 = rng.randn(8, 4).astype(onp.float32) * 0.3   # Gemm transB=1 layout
+    b1 = rng.randn(8).astype(onp.float32) * 0.1
+    w2 = rng.randn(2, 8).astype(onp.float32) * 0.3
+    b2 = rng.randn(2).astype(onp.float32) * 0.1
+    nodes = [
+        node_proto("Gemm", ["x", "w1", "b1"], ["h"], "gemm1",
+                   [attr_int("transB", 1)]),
+        node_proto("Relu", ["h"], ["hr"], "relu1"),
+        node_proto("Gemm", ["hr", "w2", "b2"], ["y"], "gemm2",
+                   [attr_int("transB", 1)]),
+    ]
+    g = graph_proto(
+        nodes, "golden_mlp",
+        [tensor_proto("w1", w1), tensor_proto("b1", b1),
+         tensor_proto("w2", w2), tensor_proto("b2", b2)],
+        [value_info("x", (1, 4))], [value_info("y", (1, 2))])
+    with open(os.path.join(OUT_DIR, "golden_mlp.onnx"), "wb") as f:
+        f.write(model_proto(g))
+    onp.savez(os.path.join(OUT_DIR, "golden_mlp_params.npz"),
+              w1=w1, b1=b1, w2=w2, b2=b2)
+
+
+def gen_conv():
+    """x(1,3,8,8) -> Conv(3x3, pad 1, 4 filters) -> Relu -> y."""
+    rng = onp.random.RandomState(11)
+    w = rng.randn(4, 3, 3, 3).astype(onp.float32) * 0.2
+    b = rng.randn(4).astype(onp.float32) * 0.1
+    nodes = [
+        node_proto("Conv", ["x", "w", "b"], ["c"], "conv1",
+                   [attr_ints("kernel_shape", [3, 3]),
+                    attr_ints("pads", [1, 1, 1, 1]),
+                    attr_ints("strides", [1, 1])]),
+        node_proto("Relu", ["c"], ["y"], "relu1"),
+    ]
+    g = graph_proto(nodes, "golden_conv",
+                    [tensor_proto("w", w), tensor_proto("b", b)],
+                    [value_info("x", (1, 3, 8, 8))],
+                    [value_info("y", (1, 4, 8, 8))])
+    with open(os.path.join(OUT_DIR, "golden_conv.onnx"), "wb") as f:
+        f.write(model_proto(g))
+    onp.savez(os.path.join(OUT_DIR, "golden_conv_params.npz"), w=w, b=b)
+
+
+if __name__ == "__main__":
+    gen_mlp()
+    gen_conv()
+    print("golden fixtures written to", OUT_DIR)

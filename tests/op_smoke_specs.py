@@ -360,4 +360,41 @@ SPECS = {
                           onp.float32(-2.0).reshape(()),
                           onp.float32(2.0).reshape(())],
                          dict(num_args=2)),
+    # --- dgl graph sampling (ops/graph_sampling.py) ---------------------
+    "dgl_csr_neighbor_uniform_sample": (
+        [(_R.rand(5, 5) > 0.5).astype(onp.float32) * 7,
+         onp.array([0, 1], onp.int64)],
+        dict(num_hops=1, num_neighbor=2, max_num_vertices=5)),
+    "dgl_csr_neighbor_non_uniform_sample": (
+        [(_R.rand(5, 5) > 0.5).astype(onp.float32) * 7,
+         _R.rand(5).astype(onp.float32) + 0.1,
+         onp.array([0, 1], onp.int64)],
+        dict(num_hops=1, num_neighbor=2, max_num_vertices=5)),
+    "dgl_subgraph": ([(_R.rand(5, 5) > 0.5).astype(onp.float32) * 3,
+                      onp.array([0, 2, 3], onp.int64)],
+                     dict(return_mapping=True)),
+    "dgl_adjacency": ([(_R.rand(4, 4) > 0.5).astype(onp.float32) * 5], {}),
+    "dgl_graph_compact": ([(_R.rand(5, 5) > 0.6).astype(onp.float32) * 3,
+                           onp.array([0, 1, 2, 0, 0, 3], onp.int64)],
+                          dict(graph_sizes=(3,))),
+    # --- np-surface registration breadth (ops/np_extra.py) -------------
+    "bincount": ([_R.randint(0, 5, (12,)).astype(onp.int32)],
+                 dict(minlength=6)),
+    "cross": ([_f(4, 3), _f(4, 3)], {}),
+    "diag_indices_from": ([_f(4, 4)], {}),
+    "dsplit": ([_f(2, 4, 2)], dict(indices_or_sections=2)),
+    "einsum": ([_f(3, 4), _f(4, 5)], dict(subscripts="ij,jk->ik")),
+    "fmod_scalar": ([_f(4, 6) + 1.0], dict(scalar=2.0)),
+    "rfmod_scalar": ([_f(4, 6) + 1.0], dict(scalar=2.0)),
+    "index_add": ([_f(4, 6), onp.array([[0, 2, 3]], onp.int32), _f(3, 6)],
+                  {}),
+    "index_update": ([_f(4, 6), onp.array([[1, 3]], onp.int32), _f(2, 6)],
+                     {}),
+    "insert": ([_f(6)], dict(obj=2, val=1.5)),
+    "interp": ([_f(5) * 4, onp.arange(6, dtype=onp.float32),
+                _f(6)], {}),
+    "linalg_eig": ([_f(4, 4) + 2 * onp.eye(4, dtype=onp.float32)], {}),
+    "linalg_eigvals": ([_f(4, 4) + 2 * onp.eye(4, dtype=onp.float32)], {}),
+    "linalg_tensorsolve": ([_f(3, 3) + 2 * onp.eye(3, dtype=onp.float32),
+                            _f(3)], {}),
 }

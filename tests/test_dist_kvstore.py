@@ -11,6 +11,8 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = textwrap.dedent("""
@@ -101,14 +103,68 @@ def _free_port():
     return port
 
 
-def test_dist_sync_kvstore_push_pull(tmp_path):
+@pytest.mark.parametrize("launcher", ["local", "mpi"])
+def test_dist_sync_kvstore_push_pull(tmp_path, launcher):
+    """Same worker under the local and mpi launchers — both must map onto
+    the MXNET_TPU_* env contract (reference tools/launch.py's five
+    submission modes; mpi skips with a reason when no MPI runtime is
+    installed, but the submission path itself is exercised)."""
+    import shutil
+
+    if launcher == "mpi" and not (shutil.which("mpirun")
+                                  or shutil.which("mpiexec")):
+        pytest.skip("no mpirun/mpiexec on PATH — mpi launcher wired but "
+                    "not executable in this image")
     script = tmp_path / "worker.py"
     script.write_text(WORKER.format(repo=REPO))
     launch = os.path.join(REPO, "tools", "launch.py")
     out = subprocess.run(
-        [sys.executable, launch, "-n", "2", "--launcher", "local",
+        [sys.executable, launch, "-n", "2", "--launcher", launcher,
          "--port", str(_free_port()), sys.executable, str(script)],
         capture_output=True, text=True, timeout=240)
     assert out.returncode == 0, (out.stdout, out.stderr)
     ok_lines = [l for l in out.stdout.splitlines() if l.startswith("DISTOK")]
     assert sorted(ok_lines) == ["DISTOK 0 of 2", "DISTOK 1 of 2"], out.stdout
+
+
+def test_mpi_shim_maps_rank_env(tmp_path):
+    """The mpirun-side shim translates OMPI/PMI rank env onto the
+    MXNET_TPU_* contract and execs the command — testable without an MPI
+    runtime by setting the env mpirun would set."""
+    launch = os.path.join(REPO, "tools", "launch.py")
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import os\n"
+        "print('SHIM', os.environ['MXNET_TPU_PROC_ID'],\n"
+        "      os.environ['MXNET_TPU_NUM_PROCS'],\n"
+        "      os.environ['MXNET_TPU_COORDINATOR'],\n"
+        "      os.environ['DMLC_WORKER_ID'])\n")
+    env = dict(os.environ)
+    env["OMPI_COMM_WORLD_RANK"] = "1"
+    env["OMPI_COMM_WORLD_SIZE"] = "2"
+    out = subprocess.run(
+        [sys.executable, launch, "-n", "2", "--mpi-shim",
+         "--coordinator", "10.0.0.1:29510", "--",
+         sys.executable, str(probe)],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "SHIM 1 2 10.0.0.1:29510 1" in out.stdout
+    # PMI (MPICH) spelling works too
+    env2 = dict(os.environ)
+    env2["PMI_RANK"] = "0"
+    env2["PMI_SIZE"] = "4"
+    out2 = subprocess.run(
+        [sys.executable, launch, "-n", "4", "--mpi-shim",
+         "--coordinator", "h0:29511", "--", sys.executable, str(probe)],
+        capture_output=True, text=True, timeout=60, env=env2)
+    assert out2.returncode == 0, (out2.stdout, out2.stderr)
+    assert "SHIM 0 4 h0:29511 0" in out2.stdout
+    # and no MPI env at all is a clean, explained failure
+    out3 = subprocess.run(
+        [sys.executable, launch, "-n", "2", "--mpi-shim", "--",
+         sys.executable, str(probe)],
+        capture_output=True, text=True, timeout=60,
+        env={k: v for k, v in os.environ.items()
+             if not k.startswith(("OMPI_", "PMI_", "MV2_"))})
+    assert out3.returncode != 0
+    assert "mpirun" in out3.stderr

@@ -1,0 +1,193 @@
+"""Golden ONNX fixtures — external validation of the protobuf wire codec
+(round-2 VERDICT item 8).
+
+tests/fixtures/golden_*.onnx were produced by
+``tests/fixtures/gen_onnx_golden.py``, an INDEPENDENT hand-packed
+protobuf serializer sharing no code with ``contrib/onnx/proto.py`` (the
+environment ships neither ``onnx`` nor ``onnxruntime``, and torch.onnx
+refuses to serialize without onnx — two independent wire implementations
+agreeing is the strongest offline cross-check).  This file also walks the
+repo exporter's bytes with its OWN minimal protobuf reader, so exports
+are no longer validated exclusively by the repo's importer.
+"""
+import os
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.onnx import onnx2mx, proto
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+# --- independent minimal wire reader (no proto.py code) -----------------
+
+def _rd_varint(buf, pos):
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _walk(buf):
+    """Yield (field_number, wire_type, value) over a protobuf message."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _rd_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _rd_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _rd_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = struct.unpack("<I", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            v = struct.unpack("<Q", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise AssertionError(f"unexpected wire type {wire}")
+        yield field, wire, v
+
+
+def _fields(buf, field):
+    return [v for f, _w, v in _walk(buf) if f == field]
+
+
+def test_codec_parses_golden_mlp():
+    with open(os.path.join(FIX, "golden_mlp.onnx"), "rb") as f:
+        m = proto.parse_model(f.read())
+    g = m["graph"]
+    assert [n["op_type"] for n in g["nodes"]] == ["Gemm", "Relu", "Gemm"]
+    p = onp.load(os.path.join(FIX, "golden_mlp_params.npz"))
+    for k in ("w1", "b1", "w2", "b2"):
+        onp.testing.assert_array_equal(g["initializers"][k], p[k])
+    names = [i[0] for i in g["inputs"]]
+    assert names == ["x"]
+    assert g["inputs"][0][2] == [1, 4]
+    # Gemm attr survived: transB as INT
+    assert g["nodes"][0]["attrs"]["transB"] == 1
+
+
+def test_import_golden_mlp_end_to_end():
+    sym, arg_params, aux_params = onnx2mx.import_model(
+        os.path.join(FIX, "golden_mlp.onnx"))
+    p = onp.load(os.path.join(FIX, "golden_mlp_params.npz"))
+    rng = onp.random.RandomState(0)
+    x = rng.randn(1, 4).astype(onp.float32)
+    feed = {"x": nd.array(x)}
+    feed.update({k: nd.array(onp.asarray(v.asnumpy()
+                                         if hasattr(v, "asnumpy") else v))
+                 for k, v in {**arg_params, **aux_params}.items()})
+    out = sym.eval(**feed)
+    out = onp.asarray((out[0] if isinstance(out, list) else out).asnumpy())
+    expect = onp.maximum(x @ p["w1"].T + p["b1"], 0) @ p["w2"].T + p["b2"]
+    onp.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_import_golden_conv_end_to_end():
+    sym, arg_params, aux_params = onnx2mx.import_model(
+        os.path.join(FIX, "golden_conv.onnx"))
+    p = onp.load(os.path.join(FIX, "golden_conv_params.npz"))
+    rng = onp.random.RandomState(1)
+    x = rng.randn(1, 3, 8, 8).astype(onp.float32)
+    feed = {"x": nd.array(x)}
+    feed.update({k: nd.array(onp.asarray(v.asnumpy()
+                                         if hasattr(v, "asnumpy") else v))
+                 for k, v in {**arg_params, **aux_params}.items()})
+    out = sym.eval(**feed)
+    out = onp.asarray((out[0] if isinstance(out, list) else out).asnumpy())
+    # numpy conv oracle (pad 1, stride 1)
+    w, b = p["w"], p["b"]
+    xp = onp.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = onp.zeros((1, 4, 8, 8), onp.float32)
+    for i in range(8):
+        for j in range(8):
+            conv[:, :, i, j] = onp.einsum(
+                "nchw,fchw->nf", xp[:, :, i:i + 3, j:j + 3], w)
+    expect = onp.maximum(conv + b[None, :, None, None], 0)
+    onp.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_export_bytes_parse_under_independent_reader():
+    """Walk the repo exporter's output with this file's own wire reader:
+    ModelProto/GraphProto/NodeProto field numbers, tensor dims and
+    raw_data must all be where the ONNX schema says they are."""
+    from mxnet_tpu.contrib.onnx import mx2onnx
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4, activation="relu"),
+            nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(2).rand(1, 4).astype(onp.float32))
+    net(x)
+    sym = net._trace_symbol()
+    params = {k: v.data() for k, v in net.collect_params().items()}
+    out_path = os.path.join(FIX, "_tmp_export.onnx")
+    try:
+        mx2onnx.export_model(sym, params, in_shapes=[(1, 4)],
+                             onnx_file_path=out_path)
+        with open(out_path, "rb") as f:
+            buf = f.read()
+        # ModelProto: ir_version(1, varint), graph(7, bytes),
+        # opset_import(8, bytes)
+        assert _fields(buf, 1), "missing ir_version"
+        graphs = _fields(buf, 7)
+        assert len(graphs) == 1, "exactly one GraphProto"
+        opsets = _fields(buf, 8)
+        assert opsets and _fields(opsets[0], 2), "opset_import.version"
+        g = graphs[0]
+        nodes = _fields(g, 1)
+        assert nodes, "GraphProto.node empty"
+        op_types = [(_fields(n, 4) or [b""])[0].decode() for n in nodes]
+        assert "FullyConnected" not in op_types, (
+            "exporter leaked internal op names into ONNX op_type")
+        assert any(t in ("Gemm", "MatMul") for t in op_types), op_types
+        assert "Relu" in op_types, op_types
+        inits = _fields(g, 5)
+        assert len(inits) == 4          # 2x weight + 2x bias
+        for t in inits:
+            dims = _fields(t, 1)
+            raw = _fields(t, 9)
+            floats = _fields(t, 4)
+            n_elem = int(onp.prod(dims)) if dims else 0
+            assert n_elem > 0
+            if raw:
+                assert len(raw[0]) == 4 * n_elem    # fp32 raw_data
+            else:
+                assert len(floats) == n_elem        # packed float_data
+        # graph io: input(11) includes 'x'-like entry, output(12) nonempty
+        assert _fields(g, 11) and _fields(g, 12)
+    finally:
+        if os.path.exists(out_path):
+            os.remove(out_path)
+
+
+def test_regen_script_is_deterministic(tmp_path):
+    """The checked-in fixtures match what the generator produces — anyone
+    can re-derive the bytes from the schema-level script."""
+    import shutil
+    import subprocess
+    import sys
+
+    gen = os.path.join(FIX, "gen_onnx_golden.py")
+    work = tmp_path / "fixtures"
+    work.mkdir()
+    shutil.copy(gen, work / "gen_onnx_golden.py")
+    r = subprocess.run([sys.executable, str(work / "gen_onnx_golden.py")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    for fn in ("golden_mlp.onnx", "golden_conv.onnx"):
+        with open(os.path.join(FIX, fn), "rb") as a, \
+                open(work / fn, "rb") as b:
+            assert a.read() == b.read(), f"{fn} drifted from generator"

@@ -125,6 +125,52 @@ def test_quantize_net_accuracy_within_1pct():
     assert any(v.dtype == jnp.int8 for v in qnet.params.values())
 
 
+def test_conv_bn_relu_folds_and_requantize_fuses():
+    """The int8 graph pass collapses conv+BN+relu into ONE quantized
+    kernel with folded weights and a relu epilogue, and adjacent quantized
+    kernels exchange int8 directly (requantize fused into the producer's
+    epilogue — reference quantize_graph_pass.cc).  Accuracy stays within
+    int8 tolerance of fp32."""
+    rng = onp.random.RandomState(7)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, in_channels=3, use_bias=False),
+            nn.BatchNorm(in_channels=8),
+            nn.Activation("relu"),
+            nn.Conv2D(16, 3, padding=1, in_channels=8, use_bias=False),
+            nn.BatchNorm(in_channels=16),
+            nn.Activation("relu"),
+            nn.GlobalAvgPool2D(),
+            nn.Dense(10, in_units=16))
+    net.initialize(mx.init.Xavier())
+    # settle BN moving stats with a few forward passes in autograd-less
+    # training=False mode the fold expects
+    calib = [mx.nd.array(rng.rand(8, 3, 12, 12).astype(onp.float32) * 2)
+             for _ in range(4)]
+    qnet = q.quantize_net(net, calib)
+
+    ops = [n.op for n in qnet.sym._topo() if n.op]
+    # BatchNorm and standalone Activation are GONE: folded into the convs
+    assert "BatchNorm" not in ops, ops
+    assert "Activation" not in ops and "relu" not in ops, ops
+    assert ops.count("quantized_conv") == 2
+    convs = [n for n in qnet.sym._topo() if n.op == "quantized_conv"]
+    assert all(n.attrs.get("fused_relu") for n in convs)
+    # first conv emits int8 directly for the second (requantize fused):
+    # the only quantize nodes left are the graph input and the one after
+    # the fp32 pooling, NOT one per quantized kernel
+    assert ops.count("quantize") == 2, ops
+    first = [n for n in convs if any(
+        c is n for c2 in convs for (c, _i) in c2.inputs)]
+    assert first and first[0].attrs.get("out_min") is not None
+
+    x = mx.nd.array(rng.rand(16, 3, 12, 12).astype(onp.float32) * 2)
+    ref = net(x).asnumpy()
+    got = onp.asarray(qnet(x))
+    rel = float(onp.abs(got - ref).max() / (abs(ref).max() + 1e-9))
+    assert rel < 0.06, rel
+    assert (ref.argmax(1) == got.argmax(1)).mean() >= 0.9
+
+
 def test_quantize_symbol_excluded_layers_stay_fp32():
     """Symbol-level API (the reference quantize_model workflow): users
     pick excluded node names off the traced symbol they pass in."""

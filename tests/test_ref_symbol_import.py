@@ -1,0 +1,165 @@
+"""Reference (Apache MXNet / nnvm) symbol-JSON import — the other half of
+checkpoint interop (round-2 VERDICT item 2).
+
+Fixtures in tests/fixtures/ are hand-authored in the reference's on-disk
+layout (3-element inputs/heads, all-string attrs, node_row_ptr,
+attrs.mxnet_version — the format legacy_json_util.cc upgrades), NOT
+produced by this repo's exporter, so these tests exercise the importer
+against the real wire shape.  The CNN's output is checked against a pure
+numpy oracle computed in this file.
+"""
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym_mod
+from mxnet_tpu.gluon import SymbolBlock
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+CNN_JSON = os.path.join(FIX, "ref_cnn-symbol.json")
+CNN_PARAMS = os.path.join(FIX, "ref_cnn-0000.params")
+NP_JSON = os.path.join(FIX, "ref_np-symbol.json")
+
+
+def _oracle_cnn(x, p):
+    """Pure numpy forward of the fixture graph: Convolution(3x3, pad 1) ->
+    BatchNorm(moving stats) -> relu -> maxpool 2x2 -> flatten -> FC."""
+    w, b = p["arg:conv0_weight"], p["arg:conv0_bias"]
+    N, _, H, W = x.shape
+    F = w.shape[0]
+    xp = onp.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = onp.zeros((N, F, H, W), onp.float32)
+    for i in range(H):
+        for j in range(W):
+            patch = xp[:, :, i:i + 3, j:j + 3]          # (N, C, 3, 3)
+            conv[:, :, i, j] = onp.einsum("nchw,fchw->nf", patch, w)
+    conv += b[None, :, None, None]
+    g, beta = p["arg:bn0_gamma"], p["arg:bn0_beta"]
+    mm, mv = p["aux:bn0_moving_mean"], p["aux:bn0_moving_var"]
+    bn = (conv - mm[None, :, None, None]) / onp.sqrt(
+        mv[None, :, None, None] + 1e-3)
+    bn = g[None, :, None, None] * bn + beta[None, :, None, None]
+    r = onp.maximum(bn, 0)
+    pool = r.reshape(N, F, H // 2, 2, W // 2, 2).max(axis=(3, 5))
+    flat = pool.reshape(N, -1)
+    return flat @ p["arg:fc0_weight"].T + p["arg:fc0_bias"]
+
+
+def test_import_reference_cnn_end_to_end():
+    net = SymbolBlock.imports(CNN_JSON, input_names=["data"],
+                              param_file=CNN_PARAMS)
+    rng = onp.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 8).astype(onp.float32)
+    out = net(nd.array(x)).asnumpy()
+    assert out.shape == (2, 10)
+
+    from mxnet_tpu.ndarray import legacy_format
+
+    raw = legacy_format.load_legacy(CNN_PARAMS)
+    expect = _oracle_cnn(x, raw)
+    onp.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-4)
+
+
+def test_import_parses_nnvm_structure():
+    s = sym_mod.load(CNN_JSON)
+    args = s.list_arguments()
+    assert "data" in args and "conv0_weight" in args
+    assert "bn0_moving_mean" in args          # aux vars resolve as args
+    # hidden/annotation keys stay OUT of op attrs, IN attr_dict
+    conv_nodes = [n for n in s._topo() if n.name == "conv0_weight"]
+    assert conv_nodes and "__shape__" in conv_nodes[0].attr_dict
+    conv_op = [n for n in s._topo() if n.name == "conv0"][0]
+    assert conv_op.attrs["kernel"] == (3, 3)          # string -> tuple
+    assert conv_op.attrs["no_bias"] is False          # string -> bool
+    assert conv_op.attrs["num_filter"] == 8           # string -> int
+
+
+def test_import_npi_spellings_and_eval():
+    s = sym_mod.load(NP_JSON)
+    a = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    b = onp.ones((2, 3), onp.float32)
+    out = s.eval(a=nd.array(a), b=nd.array(b))
+    out = out[0] if isinstance(out, list) else out
+    expect = ((a + b) * 2.0).mean()
+    onp.testing.assert_allclose(onp.asarray(out.asnumpy()).ravel()[0],
+                                expect, rtol=1e-6)
+
+
+def test_ref_format_round_trip():
+    """Importer and ref-format exporter are inverse: import fixture ->
+    save(ref_format=True) -> import again -> same structure + outputs."""
+    s1 = sym_mod.load(CNN_JSON)
+    j2 = s1.tojson(ref_format=True)
+    payload = json.loads(j2)
+    # wire shape matches the reference layout
+    assert payload["heads"] and len(payload["heads"][0]) == 3
+    assert all(len(e) == 3 for nspec in payload["nodes"]
+               for e in nspec.get("inputs", []))
+    assert "node_row_ptr" in payload
+    assert payload["attrs"]["mxnet_version"][0] == "int"
+    assert all(isinstance(v, str) for nspec in payload["nodes"]
+               for v in nspec.get("attrs", {}).values())
+    s2 = sym_mod.load_json(j2)
+    assert s2.list_arguments() == s1.list_arguments()
+
+    raw = {k: nd.array(v) for k, v in __import__(
+        "mxnet_tpu.ndarray.legacy_format", fromlist=["load_legacy"]
+    ).load_legacy(CNN_PARAMS).items()}
+    feed = {k.split(":", 1)[1]: v for k, v in raw.items()}
+    rng = onp.random.RandomState(1)
+    x = nd.array(rng.rand(2, 3, 8, 8).astype(onp.float32))
+    o1 = s1.eval(data=x, **feed)
+    o2 = s2.eval(data=x, **feed)
+    o1 = o1[0] if isinstance(o1, list) else o1
+    o2 = o2[0] if isinstance(o2, list) else o2
+    onp.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
+
+
+def test_pre_090_aux_padding_upgrade():
+    """JSONs older than 0.9 did not serialize aux inputs (reference
+    UpgradeJSON_000800_000900 pads them with fresh variables)."""
+    payload = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "g", "inputs": []},
+            {"op": "null", "name": "be", "inputs": []},
+            # BatchNorm with only 3 of 5 inputs, no version attr (=0.8)
+            {"op": "BatchNorm", "name": "bn",
+             "inputs": [[0, 0], [1, 0], [2, 0]],
+             "param": {"fix_gamma": "False", "eps": "0.001"}},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "heads": [[3, 0]],
+    }
+    s = sym_mod.load_json(json.dumps(payload))
+    args = s.list_arguments()
+    assert len(args) == 5          # two fresh aux variables appended
+    assert any(a.startswith("bn_aux") for a in args)
+
+
+def test_argmax_axis_upgrade_pre_095():
+    payload = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "argmax", "name": "am", "inputs": [[0, 0]],
+             "attr": {"axis": "-1"}},
+        ],
+        "arg_nodes": [0],
+        "heads": [[1, 0]],
+        "attrs": {"mxnet_version": ["int", 904]},
+    }
+    s = sym_mod.load_json(json.dumps(payload))
+    am = [n for n in s._topo() if n.name == "am"][0]
+    assert "axis" not in am.attrs          # upgraded away (meant 'flatten')
+
+
+def test_unknown_op_message_points_at_aliases():
+    payload = {"nodes": [{"op": "_totally_unknown_op", "name": "x",
+                          "inputs": []}],
+               "arg_nodes": [], "heads": [[0, 0, 0]]}
+    with pytest.raises(mx.base.MXNetError, match="ref_aliases"):
+        sym_mod.load_json(json.dumps(payload))

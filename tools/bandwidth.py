@@ -150,7 +150,8 @@ def main():
     res = measure(args.mb, args.iters, args.mesh)
     res["platform"] = jax.default_backend()
     res["payload_mb"] = args.mb
-    print(json.dumps({k: (round(v, 2) if isinstance(v, float) else v)
+    # 4 decimals: tiny payloads on a loaded host must not round to 0.0
+    print(json.dumps({k: (round(v, 4) if isinstance(v, float) else v)
                       for k, v in res.items()}))
 
 

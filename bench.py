@@ -1,21 +1,37 @@
-"""Headline benchmark: ResNet-50 ImageNet-shape training throughput.
+"""Headline benchmarks: one invocation, ALL lanes, one JSON line.
 
-Matches the reference's benchmark_score.py methodology (synthetic data,
-steady-state img/s; docs perf.md tables — V100 fp32 training = 298.51 img/s
-at bs32, the BASELINE.md reference point).  The whole train step (fwd, bwd,
-SGD-momentum update) is one donated XLA program via ShardedTrainer on a
-1-chip mesh.
+Lanes (each with achieved_tflops + mfu): ResNet-50 fp32 train, ResNet-50
+bf16 mixed-precision train, BERT-base bf16 train, ResNet-50 int8
+inference (compile time logged).  Methodology matches the reference's
+benchmark_score.py (synthetic data, steady-state throughput; docs
+perf.md — V100 fp32 train 298.51 img/s at bs32 is BASELINE.md's anchor;
+perf.md:208's fp16 V100 2,085 img/s inference is the mixed-precision
+sanity anchor).
 
-Hardening (round 2): the device backend is probed in a SUBPROCESS with a
-timeout before the parent touches JAX, so a hung TPU tunnel cannot hang the
-bench; model init + deferred-shape probe run on the host CPU backend (one
-tiny-op stream over the tunnel was round 1's failure mode); a watchdog
-thread guarantees a JSON line is emitted even on a stall; progress goes to
-stderr so stdout stays one parseable JSON line.
+The whole train step (fwd, bwd, update) is one donated XLA program via
+ShardedTrainer on a 1-chip mesh; the bf16 lane keeps fp32 master weights
+and casts compute to bf16 (the MXU-native path).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-Env overrides: BENCH_MODEL, BENCH_BATCH, BENCH_IMG, BENCH_STEPS,
-BENCH_TIMEOUT, BENCH_PROBE_TIMEOUT, BENCH_CPU_FALLBACK.
+FLOP model (documented so the TFLOP numbers are auditable):
+- ResNet-50 @224: 4.1 GFLOP/img forward (standard literature count,
+  multiply+add = 2 FLOPs); training = 3x forward (bwd ~ 2x fwd).
+- BERT-base: 6*N FLOPs/token train (N = param count) + 12*L*s*d
+  attention term.
+- int8 inference: 8.2 GOP/img (4.1 G MACs x 2).
+MFU divides by the chip's matmul-unit peak (bf16 peak for fp32 too:
+TPU fp32 matmuls decompose onto the same bf16 MXU passes) — the
+``mfu_basis`` field names the peak used.
+
+Hardening (round 2, kept): device backend probed in a SUBPROCESS with a
+timeout; model init + deferred-shape probe on host CPU; a watchdog emits
+whatever lanes completed even on a stall; progress on stderr, stdout is
+ONE parseable JSON line.  Tunnel discipline: warm with steps + a HOST
+VALUE READ, fence the timed region with another host read
+(block_until_ready exerts no backpressure until the queue drains once).
+
+Env: BENCH_MODEL=all|resnet50_v1|resnet50_v1_bf16|bert|resnet50_v1_int8,
+BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_TIMEOUT, BENCH_PROBE_TIMEOUT,
+BENCH_CPU_FALLBACK.
 """
 from __future__ import annotations
 
@@ -30,13 +46,28 @@ import traceback
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 V100_RESNET50_TRAIN_IMGS_PER_SEC = 298.51  # reference perf.md:252, bs32 fp32
+V100_BERT_BASE_TOKENS_PER_SEC = 11500.0    # fp16 V100 BERT-base pretrain
+V100_RESNET50_FP32_INFER_IMGS_PER_SEC = 1076.81  # perf.md:194
 
-V100_BERT_BASE_TOKENS_PER_SEC = 11500.0  # fp16 V100 BERT-base pretrain
-# (~90 seq/s at seq 128, public MLPerf-era single-V100 numbers)
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.1e9
+RESNET50_INFER_OPS_PER_IMG = 2 * 4.1e9
+
+# matmul-unit peak per chip generation (dense, per chip)
+PEAK_TFLOPS = {
+    "TPU v5 lite": {"bf16": 197.0, "int8": 394.0},
+    "TPU v5e": {"bf16": 197.0, "int8": 394.0},
+    "TPU v4": {"bf16": 275.0, "int8": 275.0},
+    "TPU v5": {"bf16": 459.0, "int8": 918.0},
+    "TPU v5p": {"bf16": 459.0, "int8": 918.0},
+    "TPU v6 lite": {"bf16": 918.0, "int8": 1836.0},
+}
 
 _T0 = time.time()
 _RESULT_EMITTED = threading.Event()
 _EMIT_LOCK = threading.Lock()
+_LANES: list = []          # completed lane dicts (watchdog emits these)
+_PARTIAL_PATH = os.environ.get(
+    "BENCH_PARTIAL_PATH", f"/tmp/bench_partial_{os.getpid()}.ndjson")
 
 
 def _progress(msg: str) -> None:
@@ -44,53 +75,119 @@ def _progress(msg: str) -> None:
           flush=True)
 
 
-def _metric() -> dict:
-    """Metric name/unit for the selected BENCH_MODEL (also used by the error
-    emitters so a bert failure is never recorded under the resnet metric)."""
-    model = os.environ.get("BENCH_MODEL", "resnet50_v1")
-    if model == "bert":
-        return {"metric": "bert_base_train_throughput_per_chip",
-                "unit": "tokens/s"}
-    if model.endswith("_int8"):
-        return {"metric": f"{model}_infer_throughput_per_chip",
-                "unit": "img/s"}
-    return {"metric": f"{model}_train_throughput_per_chip", "unit": "img/s"}
+def _device_kind() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
 
 
-def _emit(payload: dict) -> None:
-    """Print the single stdout JSON line (at most once, thread-safe: the
-    watchdog may race the main thread)."""
+def _peak(kind: str) -> float:
+    dk = _device_kind()
+    for prefix, peaks in PEAK_TFLOPS.items():
+        if dk.startswith(prefix):
+            return peaks.get(kind, 0.0)
+    return 0.0
+
+
+def _with_mfu(lane: dict, flops_per_unit: float, kind: str) -> dict:
+    """Attach achieved_tflops / mfu to a lane from its value (units/s)."""
+    tflops = lane["value"] * flops_per_unit / 1e12
+    lane["achieved_tflops"] = round(tflops, 2)
+    peak = _peak(kind)
+    if peak > 0:
+        lane["mfu"] = round(tflops / peak, 4)
+        lane["mfu_basis"] = f"{kind} peak {peak:g} TFLOP/s ({_device_kind()})"
+    else:
+        lane["mfu"] = None
+        lane["mfu_basis"] = f"unknown peak for {_device_kind()}"
+    return lane
+
+
+def _headline(lanes: list) -> dict:
+    """The driver's single metric line: best ResNet-50 train lane."""
+    order = ("resnet50_v1_bf16_train_throughput_per_chip",
+             "resnet50_v1_train_throughput_per_chip")
+    for metric in order:
+        for lane in lanes:
+            if lane.get("metric") == metric and lane.get("value", 0) > 0:
+                return dict(lane)
+    if lanes:
+        return dict(lanes[0])
+    return {"metric": "resnet50_v1_train_throughput_per_chip",
+            "value": 0.0, "unit": "img/s", "vs_baseline": 0.0,
+            "error": "no lane completed"}
+
+
+def _emit_final(error: str = "") -> None:
     with _EMIT_LOCK:
         if _RESULT_EMITTED.is_set():
             return
         _RESULT_EMITTED.set()
+        payload = _headline(_LANES)
+        if error:
+            payload["error"] = error[:400]
+        payload["lanes"] = _LANES
         print(json.dumps(payload), flush=True)
 
 
-def _watchdog(timeout_s: float) -> None:
-    def run():
-        deadline = _T0 + timeout_s
-        while time.time() < deadline:
-            if _RESULT_EMITTED.is_set():
-                return
-            time.sleep(1.0)
-        _progress(f"WATCHDOG: no result after {timeout_s:.0f}s, bailing")
-        _emit({
-            **_metric(), "value": 0.0, "vs_baseline": 0.0,
-            "error": f"watchdog timeout after {timeout_s:.0f}s "
-                     "(device backend stalled)",
-        })
-        sys.stdout.flush()
-        os._exit(3)
+_WATCHDOG_CODE = r"""
+import json, os, signal, sys, time
+parent, deadline, partial = int(sys.argv[1]), float(sys.argv[2]), sys.argv[3]
+while time.time() < deadline:
+    try:
+        os.kill(parent, 0)
+    except OSError:
+        sys.exit(0)                      # parent finished normally
+    time.sleep(1.0)
+# deadline passed with the parent still running: emit whatever lanes it
+# persisted, on the SHARED stdout, then kill it
+lanes = []
+try:
+    with open(partial) as f:
+        lanes = [json.loads(l) for l in f if l.strip()]
+except OSError:
+    pass
+head = dict(lanes[0]) if lanes else {
+    "metric": "resnet50_v1_train_throughput_per_chip", "value": 0.0,
+    "unit": "img/s", "vs_baseline": 0.0}
+for lane in lanes:
+    if lane.get("metric", "").startswith("resnet50_v1_bf16") and \
+            lane.get("value", 0) > 0:
+        head = dict(lane)
+        break
+head["error"] = "watchdog timeout (device backend stalled)"
+head["lanes"] = lanes
+print(json.dumps(head), flush=True)
+try:
+    os.kill(parent, signal.SIGKILL)
+except OSError:
+    pass
+sys.exit(3)
+"""
 
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
+
+def _watchdog(timeout_s: float) -> None:
+    """A SEPARATE PROCESS sharing our stdout: an in-process daemon thread
+    starves when a tunnel RPC blocks the main thread inside a C call
+    holding the GIL (observed: the timed loop hung >10 min past the
+    deadline with the thread never scheduled).  The child only needs the
+    partial-lane file and our pid."""
+    try:
+        open(_PARTIAL_PATH, "w").close()
+        subprocess.Popen(
+            [sys.executable, "-c", _WATCHDOG_CODE, str(os.getpid()),
+             str(_T0 + timeout_s), _PARTIAL_PATH],
+            stdout=sys.stdout, stderr=subprocess.DEVNULL)
+    except Exception as e:                       # bench still runs unguarded
+        _progress(f"watchdog spawn failed: {e}")
 
 
 def _probe_device_backend(timeout_s: float) -> bool:
-    """Run a tiny matmul in a SUBPROCESS; a hung TPU tunnel then times the
-    probe out instead of hanging this process (round-1 failure mode: axon
-    backend init blocked forever)."""
+    """Tiny matmul in a SUBPROCESS: a hung TPU tunnel times out the probe
+    instead of hanging this process."""
     code = ("import jax, jax.numpy as jnp; "
             "x = jnp.ones((256, 256)); "
             "v = float((x @ x)[0, 0]); "
@@ -100,17 +197,88 @@ def _probe_device_backend(timeout_s: float) -> bool:
                            capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
         _progress(f"device probe TIMED OUT after {timeout_s:.0f}s")
-        return False
+        return False, False
     if r.returncode != 0:
         _progress("device probe failed: " + r.stderr.strip()[-400:])
-        return False
+        return False, False
     _progress("device probe OK: " + r.stdout.strip())
-    return True
+    backend_is_cpu = r.stdout.strip().startswith("cpu")
+    return True, backend_is_cpu
 
 
-def bench_bert(on_cpu: bool = False):
-    """BERT-base masked-LM pretrain step throughput (tokens/s/chip) on the
-    flagship transformer with pallas flash attention."""
+# ---------------------------------------------------------------------------
+# lanes
+# ---------------------------------------------------------------------------
+
+def lane_train(on_cpu: bool, bf16: bool,
+               model_name: str = "resnet50_v1") -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import config
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    tag = f"{model_name} {'bf16' if bf16 else 'fp32'}"
+    # bf16 default 128: the measured v5e sweet spot (batch sweep 64..512
+    # peaked there — larger batches are slightly activation-bound); fp32
+    # keeps 256 for continuity with the round-2 artifact
+    batch = config.get("BENCH_BATCH",
+                       default=8 if on_cpu else (128 if bf16 else 256))
+    steps = config.get("BENCH_STEPS", default=3 if on_cpu else 40)
+    img = config.get("BENCH_IMG")
+    _progress(f"{tag}: building (batch={batch} img={img})")
+    net = vision.get_model(model_name, classes=1000)
+    net.initialize(mx.init.Xavier())
+    # deferred-shape probe on HOST CPU: its stream of tiny per-op compiles
+    # must never cross the TPU tunnel (round-1 failure mode)
+    cpu0 = jax.devices("cpu")[0] if not on_cpu else None
+    if cpu0 is not None:
+        with jax.default_device(cpu0):
+            net(mx.nd.zeros((1, 3, img, img)))
+    else:
+        net(mx.nd.zeros((1, 3, img, img)))
+    ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = par.make_mesh({"dp": 1})
+    tr = par.ShardedTrainer(
+        net, lambda o, l: ce(o, l).mean(), mesh, optimizer="sgd",
+        optimizer_params={"lr": 0.1, "momentum": 0.9, "wd": 1e-4},
+        compute_dtype=jnp.bfloat16 if bf16 else None)
+    rng = onp.random.RandomState(0)
+    data = rng.rand(batch, 3, img, img).astype(onp.float32)
+    label = rng.randint(0, 1000, (batch,)).astype(onp.int32)
+    data, label = tr.stage(data, label)
+    _progress(f"{tag}: compiling whole-graph train step")
+    tr.step(data, label)          # compile + sync
+    _progress(f"{tag}: compiled; warming")
+    for _ in range(3):
+        loss = tr.step(data, label, sync=False)
+    float(loss.asnumpy() if hasattr(loss, "asnumpy") else loss)
+    _progress(f"{tag}: timing {steps} steps")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = tr.step(data, label, sync=False)
+    loss_val = float(loss.asnumpy() if hasattr(loss, "asnumpy") else loss)
+    dt = time.perf_counter() - t0
+    imgs_per_sec = batch * steps / dt
+    _progress(f"{tag}: {imgs_per_sec:.2f} img/s "
+              f"(final loss {loss_val:.3f})")
+    suffix = "_bf16" if bf16 else ""
+    lane = {
+        "metric": f"{model_name}{suffix}_train_throughput_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(imgs_per_sec
+                             / V100_RESNET50_TRAIN_IMGS_PER_SEC, 3),
+        "batch": batch,
+        "platform": jax.default_backend(),
+    }
+    return _with_mfu(lane, RESNET50_TRAIN_FLOPS_PER_IMG, "bf16")
+
+
+def lane_bert(on_cpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as onp
@@ -121,11 +289,14 @@ def bench_bert(on_cpu: bool = False):
     batch = config.get("BENCH_BATCH", default=4 if on_cpu else 32)
     seq = config.get("BENCH_SEQ")
     steps = config.get("BENCH_STEPS", default=2 if on_cpu else 20)
-    accum = config.get("BENCH_ACCUM")  # micro-batch accum
-
-    _progress(f"bert: init params (batch={batch} seq={seq} accum={accum})")
+    accum = config.get("BENCH_ACCUM")
+    _progress(f"bert: init params (batch={batch} seq={seq})")
     cfg = models.TransformerLMConfig(dtype=jnp.bfloat16)
     params = models.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(int(onp.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    flops_per_token = (6 * n_params
+                       + 12 * cfg.num_layers * seq * cfg.hidden)
     mesh = par.make_mesh({"dp": 1})
     with mesh:
         m, v = models.init_opt_state(params)
@@ -135,75 +306,78 @@ def bench_bert(on_cpu: bool = False):
         toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
                            jnp.int32)
         _progress("bert: compiling train step")
-        params, m, v, loss = step(params, m, v, toks, toks,
-                                  jnp.float32(1))  # compile
+        params, m, v, loss = step(params, m, v, toks, toks, jnp.float32(1))
         jax.block_until_ready(loss)
-        # warm INCLUDING a host read: over the TPU tunnel, block_until_ready
-        # exerts no backpressure until the dispatch queue has drained once —
-        # timing before that measures enqueue rate (~30x inflation), not
-        # compute.  A device->host value read is the reliable fence.
         for _ in range(3):
             params, m, v, loss = step(params, m, v, toks, toks,
                                       jnp.float32(1))
-        float(loss)
+        float(loss)                          # host read = queue drain
         _progress(f"bert: warmed, timing {steps} steps")
         t0 = time.perf_counter()
         for _ in range(steps):
             params, m, v, loss = step(params, m, v, toks, toks,
                                       jnp.float32(1))
-        loss_val = float(loss)          # host read = hard fence, in-region
+        loss_val = float(loss)               # hard fence, in-region
         dt = time.perf_counter() - t0
         _progress(f"bert: final loss {loss_val:.4f}")
     tokens_per_sec = batch * seq * steps / dt
-    _emit({
+    _progress(f"bert: {tokens_per_sec:.0f} tokens/s "
+              f"({n_params / 1e6:.0f}M params)")
+    lane = {
         "metric": "bert_base_train_throughput_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / V100_BERT_BASE_TOKENS_PER_SEC,
                              3),
+        "batch": batch,
+        "seq": seq,
         "platform": jax.default_backend(),
-    })
+    }
+    return _with_mfu(lane, float(flops_per_token), "bf16")
 
 
-def bench_int8(model_name: str, batch: int, img: int, steps: int):
-    """INT8 quantized-inference throughput (reference quantization flow's
-    reason to exist): calibrate -> convert -> time the jitted int8 graph.
-    ``vs_baseline`` compares against the reference's PUBLISHED fp32 V100
-    inference number for the model (perf.md:194) when one exists, 0.0
-    otherwise — it is NOT an on-machine int8-vs-fp32 speedup."""
+def lane_int8(on_cpu: bool, model_name: str = "resnet50_v1") -> dict:
     import jax
     import numpy as onp
 
     import mxnet_tpu as mx
+    from mxnet_tpu import config
     from mxnet_tpu.contrib import quantization as quant
     from mxnet_tpu.gluon.model_zoo import vision
 
-    fp32_name = model_name[:-len("_int8")]
-    _progress(f"int8: building {fp32_name} (batch={batch} img={img})")
-    net = vision.get_model(fp32_name, classes=1000)
+    batch = config.get("BENCH_BATCH", default=8 if on_cpu else 64)
+    steps = config.get("BENCH_STEPS", default=3 if on_cpu else 20)
+    img = config.get("BENCH_IMG", default=64 if on_cpu else 224)
+    _progress(f"int8: building {model_name} (batch={batch} img={img})")
+    net = vision.get_model(model_name, classes=1000)
     net.initialize(mx.init.Xavier())
     cpu0 = jax.devices("cpu")[0] if jax.default_backend() != "cpu" else None
     rng = onp.random.RandomState(0)
     probe = mx.nd.array(rng.rand(batch, 3, img, img).astype(onp.float32))
     calib = [mx.nd.array(rng.rand(batch, 3, img, img).astype(onp.float32))
              for _ in range(2)]
-    # shape probe AND calibration stay on the host CPU backend: both are
-    # streams of small eager ops — exactly the per-op-compile-over-the-
-    # tunnel pattern that cost round 1 its number (and this mode ~7 min of
-    # calibration).  Only the final jitted int8 graph touches the device.
+    # calibration stays on host CPU: eager small-op streams over the
+    # tunnel are the round-1 failure mode
     _progress("int8: calibrating + converting (host CPU)")
     if cpu0 is not None:
         with jax.default_device(cpu0):
             net(probe)
             qnet = quant.quantize_net(net, calib)
+        # conversion ran with a host-CPU default device: commit params to
+        # the accelerator ONCE or every call re-transfers them
+        qnet.stage()
+        x = mx.nd.array(jax.device_put(calib[0]._data, jax.devices()[0]))
     else:
         net(probe)
         qnet = quant.quantize_net(net, calib)
-    x = calib[0]
-    _progress("int8: compiling")
+        x = calib[0]
+    _progress("int8: compiling (fused conv+bn+relu graph, fused "
+              "requantize epilogues)")
+    t_c = time.perf_counter()
     out = qnet(x)
     jax.block_until_ready(out)
-    # warm with a host read (tunnel backpressure; see bench_bert)
+    compile_s = time.perf_counter() - t_c
+    _progress(f"int8: compiled in {compile_s:.1f}s")
     for _ in range(2):
         out = qnet(x)
     float(jax.device_get(out).ravel()[0])
@@ -211,133 +385,95 @@ def bench_int8(model_name: str, batch: int, img: int, steps: int):
     t0 = time.perf_counter()
     for _ in range(steps):
         out = qnet(x)
-    float(jax.device_get(out).ravel()[0])    # host read = hard fence
+    float(jax.device_get(out).ravel()[0])
     dt = time.perf_counter() - t0
     imgs_per_sec = batch * steps / dt
-    # reference fp32 V100 inference baselines (perf.md:194); models without
-    # a published number report vs_baseline 0.0 rather than a wrong ratio
-    fp32_infer_baselines = {"resnet50_v1": 1076.81, "resnet50_v2": 1076.81,
-                            "vgg16": 708.43}
-    base = fp32_infer_baselines.get(fp32_name)
-    _emit({
-        "metric": f"{model_name}_infer_throughput_per_chip",
+    _progress(f"int8: {imgs_per_sec:.2f} img/s")
+    # reference fp32 V100 inference baselines (perf.md:194); models
+    # without a published number report 0.0 rather than a wrong ratio
+    fp32_infer_baselines = {"resnet50_v1": 1076.81,
+                            "resnet50_v2": 1076.81, "vgg16": 708.43}
+    base = fp32_infer_baselines.get(model_name)
+    lane = {
+        "metric": f"{model_name}_int8_infer_throughput_per_chip",
         "value": round(imgs_per_sec, 2),
         "unit": "img/s",
         "vs_baseline": round(imgs_per_sec / base, 3) if base else 0.0,
+        "batch": batch,
+        "compile_s": round(compile_s, 1),
         "platform": jax.default_backend(),
-    })
+    }
+    return _with_mfu(lane, RESNET50_INFER_OPS_PER_IMG, "int8")
 
 
-def _run(model_name: str, batch: int, img: int, steps: int):
-    import jax
-    import numpy as onp
+def _resolve_lane(name):
+    """Lane key -> (callable(on_cpu) -> lane dict, metric name).  Any model
+    zoo name works, with optional _bf16 / _int8 suffixes."""
+    if name == "bert":
+        return lane_bert, "bert_base_train_throughput_per_chip"
+    if name.endswith("_int8"):
+        model = name[: -len("_int8")] or "resnet50_v1"
+        return (lambda on_cpu, m=model: lane_int8(on_cpu, m),
+                f"{model}_int8_infer_throughput_per_chip")
+    if name.endswith("_bf16"):
+        model = name[: -len("_bf16")] or "resnet50_v1"
+        return (lambda on_cpu, m=model: lane_train(on_cpu, True, m),
+                f"{model}_bf16_train_throughput_per_chip")
+    return (lambda on_cpu, m=name: lane_train(on_cpu, False, m),
+            f"{name}_train_throughput_per_chip")
 
-    import mxnet_tpu as mx
-    from mxnet_tpu import parallel as par
-    from mxnet_tpu.gluon.model_zoo import vision
 
-    platform = jax.default_backend()
-    _progress(f"platform={platform}, building {model_name} "
-              f"(batch={batch} img={img} steps={steps})")
-
-    net = vision.get_model(model_name, classes=1000)
-    net.initialize(mx.init.Xavier())
-    # Deferred-shape probe: run the one eager forward on the HOST CPU backend
-    # so its stream of tiny per-op compiles never crosses the TPU tunnel
-    # (round-1 rc=1 came from exactly this probe).  Params land on CPU too;
-    # ShardedTrainer then stages them onto the mesh in one pass.
-    cpu0 = jax.devices("cpu")[0] if platform != "cpu" else None
-    _progress("deferred-shape probe on host CPU")
-    if cpu0 is not None:
-        with jax.default_device(cpu0):
-            net(mx.nd.zeros((1, 3, img, img)))
-    else:
-        net(mx.nd.zeros((1, 3, img, img)))
-    ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
-
-    _progress("staging params onto 1-chip mesh")
-    mesh = par.make_mesh({"dp": 1})
-    tr = par.ShardedTrainer(
-        net, lambda o, l: ce(o, l).mean(), mesh, optimizer="sgd",
-        optimizer_params={"lr": 0.1, "momentum": 0.9, "wd": 1e-4})
-
-    rng = onp.random.RandomState(0)
-    data = rng.rand(batch, 3, img, img).astype(onp.float32)
-    label = rng.randint(0, 1000, (batch,)).astype(onp.int32)
-    data, label = tr.stage(data, label)  # host->HBM once
-
-    _progress("compiling whole-graph train step")
-    tr.step(data, label)  # compile + sync
-    _progress("compiled; warming")
-    # warm with a host read: the tunnel's block_until_ready exerts no
-    # backpressure until the dispatch queue drains once (see bench_bert)
-    for _ in range(3):
-        loss = tr.step(data, label, sync=False)
-    float(loss.asnumpy() if hasattr(loss, "asnumpy") else loss)
-    _progress(f"timing {steps} steps")
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = tr.step(data, label, sync=False)  # enqueue back-to-back
-    loss_val = float(loss.asnumpy() if hasattr(loss, "asnumpy") else loss)
-    dt = time.perf_counter() - t0
-    _progress(f"final loss {loss_val:.4f}")
-    imgs_per_sec = batch * steps / dt
-    _progress(f"done: {imgs_per_sec:.2f} img/s")
-
-    _emit({
-        "metric": f"{model_name}_train_throughput_per_chip",
-        "value": round(imgs_per_sec, 2),
-        "unit": "img/s",
-        "vs_baseline": round(imgs_per_sec / V100_RESNET50_TRAIN_IMGS_PER_SEC,
-                             3),
-        "platform": platform,
-    })
+# bf16 first: it is the headline; a timeout then still records it
+LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "resnet50_v1_int8"]
 
 
 def main():
-    timeout_s = float(os.environ.get("BENCH_TIMEOUT", "1500"))
+    timeout_s = float(os.environ.get("BENCH_TIMEOUT", "2700"))
     _watchdog(timeout_s)
 
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
-    device_ok = _probe_device_backend(probe_timeout)
-    on_cpu = False
+    device_ok, on_cpu = _probe_device_backend(probe_timeout)
+    if on_cpu:
+        _progress("default backend IS cpu: using small lane sizes")
     if not device_ok:
-        # same truthy set as config._parse (this knob is read pre-import)
         fallback = os.environ.get("BENCH_CPU_FALLBACK", "1").strip().lower()
         if fallback not in ("1", "true", "yes", "on"):
-            _emit({
-                **_metric(), "value": 0.0, "vs_baseline": 0.0,
+            _LANES.append({
+                "metric": "resnet50_v1_train_throughput_per_chip",
+                "value": 0.0, "unit": "img/s", "vs_baseline": 0.0,
                 "error": "device backend unreachable and CPU fallback "
-                         "disabled",
-            })
+                         "disabled"})
+            _emit_final()
             sys.exit(1)
         _progress("falling back to host CPU backend")
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
+
         jax.config.update("jax_platforms", "cpu")
         on_cpu = True
 
-    model_name = os.environ.get("BENCH_MODEL", "resnet50_v1")
-    # past the probe: mxnet_tpu is safe to import, knobs go through the
-    # typed registry (validated; docs generated from the same declarations)
-    from mxnet_tpu import config
-
-    if model_name == "bert":
-        return bench_bert(on_cpu=on_cpu)
-    if model_name.endswith("_int8"):
-        batch = config.get("BENCH_BATCH", default=8 if on_cpu else 64)
-        steps = config.get("BENCH_STEPS", default=3 if on_cpu else 20)
-        img = config.get("BENCH_IMG", default=64 if on_cpu else 224)
-        return bench_int8(model_name, batch, img, steps)
-    if on_cpu:
-        # small enough that XLA:CPU compiles + runs inside the watchdog
-        batch = config.get("BENCH_BATCH", default=8)
-        steps = config.get("BENCH_STEPS", default=3)
-    else:
-        batch = config.get("BENCH_BATCH", default=256)
-        steps = config.get("BENCH_STEPS", default=20)
-    img = config.get("BENCH_IMG")
-    _run(model_name, batch, img, steps)
+    model = os.environ.get("BENCH_MODEL", "all")
+    selected = LANE_ORDER if model == "all" else [model]
+    failed = 0
+    for name in selected:
+        fn, metric = _resolve_lane(name)
+        try:
+            lane = fn(on_cpu)
+            _LANES.append(lane)
+            with open(_PARTIAL_PATH, "a") as f:   # watchdog's view
+                f.write(json.dumps(lane) + "\n")
+        except Exception:
+            failed += 1
+            tb = traceback.format_exc()
+            _progress(f"lane {name} FAILED:\n" + tb)
+            unit = ("tokens/s" if name == "bert" else "img/s")
+            _LANES.append({
+                "metric": metric, "value": 0.0, "unit": unit,
+                "vs_baseline": 0.0,
+                "error": tb.strip().splitlines()[-1][:400]})
+    _emit_final()
+    if failed:
+        sys.exit(1)
 
 
 if __name__ == "__main__":
@@ -348,8 +484,5 @@ if __name__ == "__main__":
     except BaseException:
         tb = traceback.format_exc()
         _progress("FATAL:\n" + tb)
-        _emit({
-            **_metric(), "value": 0.0, "vs_baseline": 0.0,
-            "error": tb.strip().splitlines()[-1][:400],
-        })
+        _emit_final(error=tb.strip().splitlines()[-1])
         sys.exit(1)

@@ -420,6 +420,17 @@ class QuantizedNet:
         self._data_name = data_names[0]
         self._fn = _jit_graph(sym)          # shared jit cache (symbol.py)
 
+    def stage(self, device=None):
+        """Commit the quantized params to ``device`` (default backend's
+        device 0 when None).  Conversion/calibration usually runs under a
+        host-CPU default device; without re-staging, every call would
+        re-transfer the weights to the accelerator."""
+        device = device or jax.devices()[0]
+        self.params = {k: jax.device_put(v, device)
+                       for k, v in self.params.items()}
+        jax.block_until_ready(list(self.params.values()))
+        return self
+
     def __call__(self, x):
         x = x._data if hasattr(x, "_data") else jnp.asarray(x)
         outs = self._fn({**self.params, self._data_name: x})

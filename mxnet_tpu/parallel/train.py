@@ -91,10 +91,17 @@ class ShardedTrainer:
                  optimizer_params: Optional[Dict[str, Any]] = None,
                  batch_spec: Optional[P] = None,
                  label_spec: Optional[P] = None,
-                 donate: bool = True, grad_accum: int = 1):
+                 donate: bool = True, grad_accum: int = 1,
+                 compute_dtype=None):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh
+        # mixed precision: params/optimizer state stay fp32 (master
+        # weights); fwd+bwd compute casts to ``compute_dtype`` (bf16 puts
+        # the matmuls on the MXU's native path), grads flow back fp32
+        # through the cast, loss reduces in fp32
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
         self.plan = plan if plan is not None else replicated_plan()
         self.opt = optimizer.lower()
         kw = dict(optimizer_params or {})
@@ -200,6 +207,8 @@ class ShardedTrainer:
 
         accum = self.grad_accum
 
+        cd = self.compute_dtype
+
         def step_fn(params, opt_state, data, label, key, t):
             def loss_of(trainable, data, label, key, overrides=None):
                 all_p = dict(trainable)
@@ -211,13 +220,19 @@ class ShardedTrainer:
                     for n, arr in overrides.items():
                         if n not in grad_names:
                             all_p[n] = arr
+                if cd is not None:
+                    all_p = {n: (a.astype(cd)
+                                 if jnp.issubdtype(a.dtype, jnp.floating)
+                                 else a) for n, a in all_p.items()}
+                    if jnp.issubdtype(data.dtype, jnp.floating):
+                        data = data.astype(cd)
                 out, mutated = functional_call(
                     block, all_p, (data,), training=True, rng_key=key)
                 label_nd = _wrap(label, current_context())
                 loss = loss_fn(out, label_nd)
                 if isinstance(loss, NDArray):
                     loss = loss._data
-                loss = jnp.mean(loss)
+                loss = jnp.mean(loss).astype(jnp.float32)
                 return loss, mutated
 
             trainable = {n: params[n] for n in grad_names}
@@ -257,6 +272,11 @@ class ShardedTrainer:
                         loss_of, has_aux=True)(trainable, d_mb, l_mb, k_mb,
                                                mut_state)
                     g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    # scan carry dtypes must be invariant: under a bf16
+                    # compute dtype the stats come back bf16 while the
+                    # carry started from the fp32 master copies
+                    mutated = {n: arr.astype(mut0[n].dtype)
+                               for n, arr in mutated.items()}
                     return (g_acc, loss_acc + loss, mutated), None
 
                 g0 = jax.tree_util.tree_map(
@@ -275,7 +295,9 @@ class ShardedTrainer:
                 new_state[n] = st
             for n, arr in mutated.items():  # BatchNorm running stats etc.
                 if n not in grad_names:
-                    new_params[n] = arr
+                    # stats ride the compute dtype inside the step; the
+                    # stored master copy stays in the param's own dtype
+                    new_params[n] = arr.astype(params[n].dtype)
             return new_params, new_state, loss
 
         donate = (0, 1) if self.donate else ()

@@ -1,0 +1,601 @@
+"""Operator edge-case scenarios, reference test-suite depth
+(round-2 VERDICT item 5).
+
+Covers the scenario classes of the reference's
+``tests/python/unittest/test_operator.py`` (shape/broadcast/axis/dtype
+edge cases against numpy oracles), ``test_higher_order_grad.py`` (2nd
+derivatives of analytic functions), and ``test_exc_handling.py``
+(imperative error surfacing).  Scenarios are re-derived from numpy
+semantics — oracles here are numpy itself, not ported assertions.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops.registry import get_op
+
+_R = onp.random.RandomState(42)
+
+
+def _get(name):
+    return get_op(name).fn
+
+
+# ---------------------------------------------------------------------------
+# broadcast binary ops: shape-pair matrix vs numpy (reference
+# test_operator.py test_broadcast_binary_op)
+# ---------------------------------------------------------------------------
+
+_BCAST_SHAPES = [
+    ((1,), (5,)),
+    ((3, 1), (1, 4)),
+    ((2, 3, 4), (4,)),
+    ((2, 3, 4), (1, 1, 1)),
+    ((2, 1, 4), (2, 3, 1)),
+    ((1, 1), (3, 4)),
+    ((5, 1, 3), (1, 2, 1)),
+    ((2, 3), ()),
+]
+
+_BCAST_OPS = {
+    "broadcast_add": onp.add,
+    "broadcast_sub": onp.subtract,
+    "broadcast_mul": onp.multiply,
+    "broadcast_div": onp.divide,
+    "broadcast_maximum": onp.maximum,
+    "broadcast_minimum": onp.minimum,
+    "broadcast_power": onp.power,
+    "broadcast_hypot": onp.hypot,
+}
+
+
+@pytest.mark.parametrize("op", sorted(_BCAST_OPS))
+@pytest.mark.parametrize("sa,sb", _BCAST_SHAPES)
+def test_broadcast_binary(op, sa, sb):
+    a = onp.asarray(_R.rand(*sa) + 0.5, onp.float32)
+    b = onp.asarray(_R.rand(*sb) + 0.5, onp.float32)
+    got = onp.asarray(_get(op)(jnp.asarray(a), jnp.asarray(b)))
+    want = _BCAST_OPS[op](a, b).astype(onp.float32)
+    assert got.shape == want.shape
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("op,np_op", [
+    ("broadcast_equal", onp.equal),
+    ("broadcast_not_equal", onp.not_equal),
+    ("broadcast_greater", onp.greater),
+    ("broadcast_lesser", onp.less),
+    ("broadcast_greater_equal", onp.greater_equal),
+    ("broadcast_lesser_equal", onp.less_equal),
+])
+def test_broadcast_compare(op, np_op):
+    a = _R.randint(0, 3, (4, 1)).astype(onp.float32)
+    b = _R.randint(0, 3, (1, 5)).astype(onp.float32)
+    got = onp.asarray(_get(op)(jnp.asarray(a), jnp.asarray(b)))
+    onp.testing.assert_array_equal(got, np_op(a, b).astype(onp.float32))
+
+
+# ---------------------------------------------------------------------------
+# unary math vs numpy, incl. boundary values (reference
+# test_operator.py test_unary_math_operators)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": (onp.abs, (-3, 3)),
+    "ceil": (onp.ceil, (-3, 3)),
+    "floor": (onp.floor, (-3, 3)),
+    "trunc": (onp.trunc, (-3, 3)),
+    "rint": (onp.rint, (-3, 3)),
+    "sign": (onp.sign, (-3, 3)),
+    "square": (onp.square, (-3, 3)),
+    "sqrt": (onp.sqrt, (0.01, 4)),
+    "cbrt": (onp.cbrt, (0.01, 4)),
+    "exp": (onp.exp, (-2, 2)),
+    "expm1": (onp.expm1, (-2, 2)),
+    "log": (onp.log, (0.01, 4)),
+    "log2": (onp.log2, (0.01, 4)),
+    "log10": (onp.log10, (0.01, 4)),
+    "log1p": (onp.log1p, (-0.5, 4)),
+    "sin": (onp.sin, (-3, 3)),
+    "cos": (onp.cos, (-3, 3)),
+    "tan": (onp.tan, (-1, 1)),
+    "arcsin": (onp.arcsin, (-0.99, 0.99)),
+    "arccos": (onp.arccos, (-0.99, 0.99)),
+    "arctan": (onp.arctan, (-3, 3)),
+    "sinh": (onp.sinh, (-2, 2)),
+    "cosh": (onp.cosh, (-2, 2)),
+    "tanh": (onp.tanh, (-3, 3)),
+    "arcsinh": (onp.arcsinh, (-3, 3)),
+    "arccosh": (onp.arccosh, (1.01, 4)),
+    "arctanh": (onp.arctanh, (-0.9, 0.9)),
+    "degrees": (onp.degrees, (-3, 3)),
+    "radians": (onp.radians, (-180, 180)),
+    "reciprocal": (onp.reciprocal, (0.1, 4)),
+    "negative": (onp.negative, (-3, 3)),
+}
+
+
+@pytest.mark.parametrize("op", sorted(_UNARY))
+def test_unary_math(op):
+    fn, (lo, hi) = _UNARY[op]
+    x = (_R.rand(3, 7) * (hi - lo) + lo).astype(onp.float32)
+    got = onp.asarray(_get(op)(jnp.asarray(x)))
+    onp.testing.assert_allclose(got, fn(x).astype(onp.float32),
+                                rtol=2e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("op", ["sqrt", "log", "rsqrt"])
+def test_unary_nan_domains(op):
+    """Out-of-domain inputs produce nan (not crashes) like the reference's
+    CPU kernels."""
+    x = jnp.asarray([-1.0, 0.0, 1.0], jnp.float32)
+    out = onp.asarray(_get(op)(x))
+    assert onp.isnan(out[0]) or onp.isinf(out[0])
+
+
+# ---------------------------------------------------------------------------
+# reductions: axis x keepdims matrix (reference test_operator.py
+# test_reduce + NumpyReduceAxes scenarios)
+# ---------------------------------------------------------------------------
+
+_REDUCE = {
+    "sum": onp.sum, "mean": onp.mean, "prod": onp.prod,
+    "max": onp.max, "min": onp.min,
+    "nansum": onp.nansum, "nanprod": onp.nanprod,
+}
+_AXES = [None, 0, 1, 2, (0, 1), (1, 2), (0, 2), (0, 1, 2)]
+
+
+@pytest.mark.parametrize("op", sorted(_REDUCE))
+@pytest.mark.parametrize("axis", _AXES)
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_reduce_axis_matrix(op, axis, keepdims):
+    x = (_R.rand(2, 3, 4) + 0.5).astype(onp.float32)
+    if op.startswith("nan"):
+        x = x.copy()
+        x[0, 0, 0] = onp.nan
+    got = onp.asarray(_get(op)(jnp.asarray(x), axis=axis,
+                               keepdims=keepdims))
+    want = _REDUCE[op](x, axis=axis, keepdims=keepdims).astype(onp.float32)
+    assert got.shape == want.shape, (got.shape, want.shape)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("op,np_op", [("argmax", onp.argmax),
+                                      ("argmin", onp.argmin)])
+@pytest.mark.parametrize("axis", [0, 1, 2])
+def test_arg_reduce(op, np_op, axis):
+    x = _R.rand(3, 4, 5).astype(onp.float32)
+    got = onp.asarray(_get(op)(jnp.asarray(x), axis=axis))
+    onp.testing.assert_array_equal(got.astype(onp.int64), np_op(x, axis))
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation edges (reference test_operator.py test_reshape /
+# test_transpose / test_expand_dims / slice suite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape,new", [
+    ((2, 3, 4), (4, 6)),
+    ((2, 3, 4), (-1,)),
+    ((2, 3, 4), (2, -1)),
+    ((2, 3, 4), (0, -1)),          # 0 = copy input dim (mxnet semantics)
+    ((2, 3, 4), (-1, 4)),
+    ((6,), (2, 3)),
+    ((1,), (1, 1, 1)),
+])
+def test_reshape_specials(shape, new):
+    x = onp.arange(int(onp.prod(shape)), dtype=onp.float32).reshape(shape)
+    got = onp.asarray(nd.reshape(nd.array(x), shape=new).asnumpy())
+    # numpy oracle with mxnet's 0 extension
+    target = tuple(shape[i] if d == 0 else d for i, d in enumerate(new))
+    onp.testing.assert_array_equal(got, x.reshape(target))
+
+
+@pytest.mark.parametrize("axes", [None, (1, 0, 2), (2, 1, 0), (0, 2, 1)])
+def test_transpose_axes(axes):
+    x = _R.rand(2, 3, 4).astype(onp.float32)
+    got = onp.asarray(_get("transpose")(jnp.asarray(x), axes=axes))
+    onp.testing.assert_array_equal(got, onp.transpose(x, axes))
+
+
+@pytest.mark.parametrize("begin,end,step", [
+    ((0, 0), (2, 3), None),
+    ((1, None), (None, None), None),
+    ((0, 2), (2, None), None),
+    ((None, None), (None, None), (1, 2)),
+    ((1, 3), (3, 0), (1, -1)),
+])
+def test_slice_scenarios(begin, end, step):
+    x = _R.rand(4, 5).astype(onp.float32)
+    got = onp.asarray(_get("slice")(jnp.asarray(x), begin=begin, end=end,
+                                    **({"step": step} if step else {})))
+    idx = tuple(slice(b, e, s) for b, e, s in zip(
+        begin, end, step if step else (None,) * len(begin)))
+    onp.testing.assert_array_equal(got, x[idx])
+
+
+@pytest.mark.parametrize("axis", [0, 1, 2, -1, (0, 2)])
+def test_expand_squeeze_roundtrip(axis):
+    x = _R.rand(3, 4).astype(onp.float32)
+    if isinstance(axis, tuple):
+        e = x.reshape(1, 3, 1, 4)
+        got = onp.asarray(_get("squeeze")(jnp.asarray(e), axis=axis))
+        onp.testing.assert_array_equal(got, x)
+    else:
+        got = onp.asarray(_get("expand_dims")(jnp.asarray(x), axis=axis))
+        onp.testing.assert_array_equal(got, onp.expand_dims(x, axis))
+
+
+@pytest.mark.parametrize("reps", [(2,), (2, 1), (1, 3), (2, 2, 2)])
+def test_tile_scenarios(reps):
+    x = _R.rand(2, 3).astype(onp.float32)
+    got = onp.asarray(_get("tile")(jnp.asarray(x), reps=reps))
+    onp.testing.assert_array_equal(got, onp.tile(x, reps))
+
+
+@pytest.mark.parametrize("axis,rep", [(0, 2), (1, 3), (None, 2)])
+def test_repeat_scenarios(axis, rep):
+    x = _R.rand(2, 3).astype(onp.float32)
+    got = onp.asarray(_get("repeat")(jnp.asarray(x), repeats=rep,
+                                     axis=axis))
+    onp.testing.assert_array_equal(got, onp.repeat(x, rep, axis=axis))
+
+
+@pytest.mark.parametrize("k", [-2, -1, 0, 1, 2])
+def test_diag_k(k):
+    x = _R.rand(4, 4).astype(onp.float32)
+    got = onp.asarray(_get("diag")(jnp.asarray(x), k=k))
+    onp.testing.assert_array_equal(got, onp.diag(x, k=k))
+    v = _R.rand(3).astype(onp.float32)
+    got2 = onp.asarray(_get("diag")(jnp.asarray(v), k=k))
+    onp.testing.assert_array_equal(got2, onp.diag(v, k=k))
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+@pytest.mark.parametrize("num", [1, 2, 4])
+def test_stack_unstack(axis, num):
+    xs = [_R.rand(2, 4).astype(onp.float32) for _ in range(num)]
+    got = onp.asarray(_get("stack")([jnp.asarray(x) for x in xs],
+                                    axis=axis))
+    onp.testing.assert_array_equal(got, onp.stack(xs, axis=axis))
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_flip_reverse(axis):
+    x = _R.rand(3, 4).astype(onp.float32)
+    got = onp.asarray(_get("flip")(jnp.asarray(x), axis=axis))
+    onp.testing.assert_array_equal(got, onp.flip(x, axis=axis))
+
+
+# ---------------------------------------------------------------------------
+# scalar-op family incl. reverse variants (reference
+# elemwise_binary_scalar tests)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("op,np_fn", [
+    ("add_scalar", lambda x, s: x + s),
+    ("sub_scalar", lambda x, s: x - s),
+    ("mul_scalar", lambda x, s: x * s),
+    ("div_scalar", lambda x, s: x / s),
+    ("power_scalar", lambda x, s: x ** s),
+    ("maximum_scalar", lambda x, s: onp.maximum(x, s)),
+    ("minimum_scalar", lambda x, s: onp.minimum(x, s)),
+    ("mod_scalar", lambda x, s: onp.mod(x, s)),
+])
+@pytest.mark.parametrize("scalar", [0.5, 2.0, 3.0])
+def test_scalar_ops(op, np_fn, scalar):
+    x = (_R.rand(3, 4) + 0.5).astype(onp.float32)
+    got = onp.asarray(_get(op)(jnp.asarray(x), scalar=scalar))
+    onp.testing.assert_allclose(got, np_fn(x, scalar).astype(onp.float32),
+                                rtol=2e-5)
+
+
+@pytest.mark.parametrize("op,np_fn", [
+    ("rsub_scalar", lambda x, s: s - x),
+    ("rdiv_scalar", lambda x, s: s / x),
+    ("rmod_scalar", lambda x, s: onp.mod(s, x)),
+    ("rpower_scalar", lambda x, s: s ** x),
+])
+def test_reverse_scalar_ops(op, np_fn):
+    x = (_R.rand(3, 4) + 0.5).astype(onp.float32)
+    got = onp.asarray(_get(op)(jnp.asarray(x), scalar=2.0))
+    onp.testing.assert_allclose(got, np_fn(x, 2.0).astype(onp.float32),
+                                rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# dtype fidelity across ops (reference test_operator.py dtype sweeps)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "float16", "int32", "int8",
+                                   "uint8"])
+@pytest.mark.parametrize("op", ["broadcast_add", "broadcast_mul"])
+def test_binary_dtype_preserved(op, dtype):
+    a = onp.array([[1, 2], [3, 4]], dtype=dtype)
+    b = onp.array([[1], [2]], dtype=dtype)
+    got = onp.asarray(_get(op)(jnp.asarray(a), jnp.asarray(b)))
+    assert got.dtype == onp.dtype(dtype)
+
+
+@pytest.mark.parametrize("dtype", ["float16", "float32", "int32", "int8"])
+def test_cast_matrix(dtype):
+    x = onp.array([0, 1, 2, 120], onp.float32)
+    got = onp.asarray(_get("cast")(jnp.asarray(x), dtype=dtype))
+    assert got.dtype == onp.dtype(dtype)
+    onp.testing.assert_array_equal(got.astype(onp.float32),
+                                   x.astype(dtype).astype(onp.float32))
+
+
+@pytest.mark.parametrize("op", ["zeros_like", "ones_like"])
+@pytest.mark.parametrize("dtype", ["float32", "int32", "float16"])
+def test_like_ops_dtype(op, dtype):
+    x = onp.zeros((2, 3), dtype)
+    got = onp.asarray(_get(op)(jnp.asarray(x)))
+    assert got.dtype == onp.dtype(dtype) and got.shape == (2, 3)
+
+
+# ---------------------------------------------------------------------------
+# indexing ops (reference test_operator.py take/gather/one_hot/pick)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_take_axis(axis):
+    x = _R.rand(4, 5).astype(onp.float32)
+    idx = onp.array([0, 2, 3], onp.int32)
+    got = onp.asarray(_get("take")(jnp.asarray(x), jnp.asarray(idx),
+                                   axis=axis))
+    onp.testing.assert_array_equal(got, onp.take(x, idx, axis=axis))
+
+
+@pytest.mark.parametrize("depth", [3, 5])
+@pytest.mark.parametrize("on,off", [(1.0, 0.0), (2.0, -1.0)])
+def test_one_hot(depth, on, off):
+    idx = onp.array([0, 2, 1], onp.int32)
+    got = onp.asarray(_get("one_hot")(jnp.asarray(idx), depth=depth,
+                                      on_value=on, off_value=off))
+    want = onp.full((3, depth), off, onp.float32)
+    for i, j in enumerate(idx):
+        want[i, j] = on
+    onp.testing.assert_array_equal(got, want)
+
+
+def test_pick_modes():
+    x = _R.rand(3, 4).astype(onp.float32)
+    idx = onp.array([0, 3, 2], onp.float32)
+    got = onp.asarray(_get("pick")(jnp.asarray(x), jnp.asarray(idx),
+                                   axis=1))
+    want = x[onp.arange(3), idx.astype(int)]
+    onp.testing.assert_array_equal(got, want)
+
+
+def test_gather_scatter_nd_roundtrip():
+    x = _R.rand(4, 5).astype(onp.float32)
+    indices = onp.array([[0, 1, 3], [1, 4, 2]], onp.int32)
+    picked = onp.asarray(_get("gather_nd")(jnp.asarray(x),
+                                           jnp.asarray(indices)))
+    onp.testing.assert_array_equal(picked, x[indices[0], indices[1]])
+    scat = onp.asarray(_get("scatter_nd")(jnp.asarray(picked),
+                                          jnp.asarray(indices),
+                                          shape=(4, 5)))
+    want = onp.zeros((4, 5), onp.float32)
+    want[indices[0], indices[1]] = picked
+    onp.testing.assert_array_equal(scat, want)
+
+
+# ---------------------------------------------------------------------------
+# sorting / topk edges (reference test_operator.py test_order)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+@pytest.mark.parametrize("is_ascend", [True, False])
+def test_sort_axis(axis, is_ascend):
+    x = _R.rand(4, 5).astype(onp.float32)
+    got = onp.asarray(_get("sort")(jnp.asarray(x), axis=axis,
+                                   is_ascend=is_ascend))
+    want = onp.sort(x, axis=axis)
+    if not is_ascend:
+        want = onp.flip(want, axis=axis)
+    onp.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+@pytest.mark.parametrize("ret_typ", ["value", "indices"])
+def test_topk_scenarios(k, ret_typ):
+    x = _R.rand(2, 5).astype(onp.float32)
+    got = onp.asarray(_get("topk")(jnp.asarray(x), k=k, ret_typ=ret_typ,
+                                   axis=-1))
+    order = onp.argsort(-x, axis=-1)[:, :k]
+    if ret_typ == "value":
+        want = onp.take_along_axis(x, order, axis=-1)
+        onp.testing.assert_array_equal(got, want)
+    else:
+        onp.testing.assert_array_equal(got.astype(onp.int64), order)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_argsort_matches_numpy(axis):
+    x = _R.rand(4, 5).astype(onp.float32)
+    got = onp.asarray(_get("argsort")(jnp.asarray(x), axis=axis))
+    onp.testing.assert_array_equal(got.astype(onp.int64),
+                                   onp.argsort(x, axis=axis, kind="stable"))
+
+
+# ---------------------------------------------------------------------------
+# higher-order gradients (reference test_higher_order_grad.py): d2/dx2 of
+# analytic functions through the public autograd API
+# ---------------------------------------------------------------------------
+
+_HOG = [
+    ("sin", onp.sin, lambda x: -onp.sin(x)),
+    ("cos", onp.cos, lambda x: -onp.cos(x)),
+    ("exp", onp.exp, onp.exp),
+    ("log", onp.log, lambda x: -1.0 / x ** 2),
+    ("sqrt", onp.sqrt, lambda x: -0.25 * x ** -1.5),
+    ("sigmoid",
+     lambda x: 1 / (1 + onp.exp(-x)),
+     lambda x: (1 / (1 + onp.exp(-x))) * (1 - 1 / (1 + onp.exp(-x)))
+     * (1 - 2 / (1 + onp.exp(-x)))),
+    ("tanh", onp.tanh,
+     lambda x: -2 * onp.tanh(x) * (1 - onp.tanh(x) ** 2)),
+]
+
+
+@pytest.mark.parametrize("name,f,d2", _HOG, ids=[h[0] for h in _HOG])
+def test_second_order_grad(name, f, d2):
+    from mxnet_tpu import autograd
+
+    xv = (_R.rand(5) * 0.8 + 0.3).astype(onp.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = getattr(nd, name)(x).sum()
+        (dy,) = autograd.grad(y, [x], create_graph=True)
+        z = dy.sum()
+    z.backward()
+    onp.testing.assert_allclose(onp.asarray(x.grad.asnumpy()), d2(xv),
+                                rtol=2e-3, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# exception handling (reference test_exc_handling.py): errors surface at
+# the sync point with real messages, and the stream recovers
+# ---------------------------------------------------------------------------
+
+def test_exc_shape_mismatch_surfaces():
+    a = nd.ones((2, 3))
+    b = nd.ones((4, 5))
+    with pytest.raises(Exception):
+        (a + b).asnumpy()
+    # the imperative stream is NOT poisoned: next op works
+    onp.testing.assert_array_equal((a * 2).asnumpy(),
+                                   onp.full((2, 3), 2, onp.float32))
+
+
+def test_exc_unknown_op_and_bad_attr():
+    from mxnet_tpu.ops.registry import get_op as _g
+
+    with pytest.raises(KeyError):
+        _g("definitely_not_an_op")
+    with pytest.raises(Exception):
+        nd.reshape(nd.ones((2, 3)), shape=(7, 7)).asnumpy()
+
+
+def test_exc_dot_rank_mismatch():
+    with pytest.raises(Exception):
+        nd.dot(nd.ones((2, 3)), nd.ones((4, 5))).asnumpy()
+
+
+def test_exc_concat_dim_mismatch():
+    with pytest.raises(Exception):
+        nd.concat(nd.ones((2, 3)), nd.ones((3, 4)), dim=0).asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# numerics at boundaries (reference test_operator.py clip/where edge rows)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("lo,hi", [(0.0, 1.0), (-1.0, 0.5), (0.2, 0.2)])
+def test_clip_bounds(lo, hi):
+    x = onp.linspace(-2, 2, 11).astype(onp.float32)
+    got = onp.asarray(_get("clip")(jnp.asarray(x), a_min=lo, a_max=hi))
+    onp.testing.assert_array_equal(got, onp.clip(x, lo, hi))
+
+
+def test_where_broadcasting():
+    cond = onp.array([[1], [0]], onp.float32)
+    a = _R.rand(2, 3).astype(onp.float32)
+    b = _R.rand(2, 3).astype(onp.float32)
+    got = onp.asarray(_get("where")(jnp.asarray(cond), jnp.asarray(a),
+                                    jnp.asarray(b)))
+    onp.testing.assert_array_equal(got, onp.where(cond != 0, a, b))
+
+
+@pytest.mark.parametrize("shape", [(0,), (0, 3), (2, 0)])
+def test_zero_size_arrays(shape):
+    """Zero-element tensors flow through elementwise and reduce ops
+    (reference test_operator.py zero-size scenarios)."""
+    x = onp.zeros(shape, onp.float32)
+    out = onp.asarray(_get("broadcast_add")(jnp.asarray(x),
+                                            jnp.asarray(x)))
+    assert out.shape == shape
+    s = onp.asarray(_get("sum")(jnp.asarray(x)))
+    assert float(s) == 0.0
+
+
+@pytest.mark.parametrize("op,val", [("sum", 0.0), ("prod", 1.0)])
+def test_reduce_identities_on_empty(op, val):
+    x = onp.zeros((0,), onp.float32)
+    out = float(onp.asarray(_get(op)(jnp.asarray(x))))
+    assert out == val
+
+
+# ---------------------------------------------------------------------------
+# batched linalg (reference test_operator.py test_laop batch lanes)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("batch", [(), (3,), (2, 2)])
+def test_batched_matmul(batch):
+    a = _R.rand(*batch, 3, 4).astype(onp.float32)
+    b = _R.rand(*batch, 4, 5).astype(onp.float32)
+    got = onp.asarray(_get("matmul")(jnp.asarray(a), jnp.asarray(b)))
+    onp.testing.assert_allclose(got, a @ b, rtol=2e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_batched_inverse_solve(n):
+    a = _R.rand(n, 3, 3).astype(onp.float32) + 3 * onp.eye(
+        3, dtype=onp.float32)
+    inv = onp.asarray(_get("linalg_inverse")(jnp.asarray(a)))
+    onp.testing.assert_allclose(inv @ a, onp.tile(onp.eye(3), (n, 1, 1)),
+                                atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# gradient correctness spot checks vs analytic derivative (reference
+# check_numeric_gradient scenarios, re-derived analytically)
+# ---------------------------------------------------------------------------
+
+_GRAD_CASES = [
+    ("square", lambda x: 2 * x),
+    ("exp", onp.exp),
+    ("log", lambda x: 1 / x),
+    ("sqrt", lambda x: 0.5 / onp.sqrt(x)),
+    ("sin", onp.cos),
+    ("tanh", lambda x: 1 - onp.tanh(x) ** 2),
+    ("sigmoid", lambda x: (1 / (1 + onp.exp(-x)))
+     * (1 - 1 / (1 + onp.exp(-x)))),
+    ("relu", lambda x: (x > 0).astype(onp.float32)),
+    ("softsign", lambda x: 1 / (1 + onp.abs(x)) ** 2),
+]
+
+
+@pytest.mark.parametrize("op,dfn", _GRAD_CASES,
+                         ids=[c[0] for c in _GRAD_CASES])
+def test_unary_gradient_analytic(op, dfn):
+    xv = (_R.rand(6) * 1.5 + 0.25).astype(onp.float32)
+    g = jax.grad(lambda t: jnp.sum(_get(op)(t)))(jnp.asarray(xv))
+    onp.testing.assert_allclose(onp.asarray(g), dfn(xv), rtol=2e-4,
+                                atol=1e-5)
+
+
+@pytest.mark.parametrize("sa,sb", [((3, 1), (1, 4)), ((2, 3, 4), (4,)),
+                                   ((5,), (5,))])
+def test_broadcast_grad_reduces_correctly(sa, sb):
+    """d/da sum(a*b) = broadcast-sum of b back to a's shape — the
+    unbroadcast path the reference tests via backward_broadcast_*."""
+    a = _R.rand(*sa).astype(onp.float32)
+    b = _R.rand(*sb).astype(onp.float32)
+    g = jax.grad(lambda t: jnp.sum(_get("broadcast_mul")(
+        t, jnp.asarray(b))))(jnp.asarray(a))
+    # numpy oracle: sum b over the broadcast axes
+    want = onp.broadcast_to(b, onp.broadcast_shapes(sa, sb)).copy()
+    while want.ndim > len(sa):
+        want = want.sum(axis=0)
+    for i, d in enumerate(sa):
+        if d == 1 and want.shape[i] != 1:
+            want = want.sum(axis=i, keepdims=True)
+    onp.testing.assert_allclose(onp.asarray(g), want, rtol=2e-5)

@@ -328,3 +328,73 @@ def test_sharded_trainer_fsdp_tp():
     losses = [tr.step(data, label) for _ in range(4)]
     assert losses[-1] < losses[0]
     tr.sync_to_block()
+
+
+def test_sharded_trainer_bf16_compute_fp32_master():
+    """Mixed precision: compute_dtype=bfloat16 runs fwd/bwd in bf16 (the
+    MXU-native path) while params + optimizer state stay fp32 master
+    copies; training still converges and tracks the fp32 run loosely."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gluon import nn
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, in_units=8, activation="relu"),
+                nn.Dense(4, in_units=32))
+        net.initialize(mx.init.Constant(0.05))
+        return net
+
+    def loss_fn(out, label):
+        diff = out - label
+        return (diff * diff).mean()
+
+    rng = onp.random.RandomState(5)
+    data = rng.randn(16, 8).astype(onp.float32)
+    label = rng.randn(16, 4).astype(onp.float32)
+
+    mesh = par.make_mesh({"dp": 1})
+    tr32 = par.ShardedTrainer(build(), loss_fn, mesh, optimizer="sgd",
+                              optimizer_params={"lr": 0.05})
+    trbf = par.ShardedTrainer(build(), loss_fn, mesh, optimizer="sgd",
+                              optimizer_params={"lr": 0.05},
+                              compute_dtype=jnp.bfloat16)
+    l32 = [float(tr32.step(data, label)) for _ in range(6)]
+    lbf = [float(trbf.step(data, label)) for _ in range(6)]
+    assert lbf[-1] < lbf[0]
+    # bf16 tracks fp32 within bf16 resolution-scale error
+    assert abs(lbf[-1] - l32[-1]) < 0.1 * max(abs(l32[0]), 1.0)
+    # master state stayed fp32
+    assert all(v.dtype == jnp.float32 for v in trbf.params.values())
+    for st in trbf.opt_state.values():
+        assert all(s.dtype == jnp.float32 for s in st)
+
+
+def test_sharded_trainer_bf16_grad_accum_with_batchnorm():
+    """compute_dtype + grad_accum must agree on scan-carry dtypes even
+    when BatchNorm running stats (fp32 masters) chain through the bf16
+    micro-batch bodies."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8), nn.BatchNorm(in_channels=16),
+            nn.Activation("relu"), nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((2, 8)))
+
+    def loss_fn(out, label):
+        diff = out - label
+        return (diff * diff).mean()
+
+    rng = onp.random.RandomState(9)
+    data = rng.randn(16, 8).astype(onp.float32)
+    label = rng.randn(16, 4).astype(onp.float32)
+    mesh = par.make_mesh({"dp": 1})
+    tr = par.ShardedTrainer(net, loss_fn, mesh, optimizer="sgd",
+                            optimizer_params={"lr": 0.05},
+                            grad_accum=2, compute_dtype=jnp.bfloat16)
+    losses = [float(tr.step(data, label)) for _ in range(5)]
+    assert losses[-1] < losses[0]
+    assert all(v.dtype == jnp.float32 for v in tr.params.values())

@@ -23,6 +23,15 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# honor a JAX_PLATFORMS pin authoritatively: the axon TPU-tunnel
+# sitecustomize re-registers platforms and can override the env var, which
+# hangs a cpu-pinned training run whenever the tunnel is wedged (same fix
+# as tests/conftest.py)
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import numpy as onp
 
 

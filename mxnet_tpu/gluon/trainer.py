@@ -136,7 +136,14 @@ class Trainer:
                 params_to_init.append(param)
             elif self._kvstore is not None:
                 idx = self._param2idx[id(param)]
-                self._kvstore.init(idx, param.data(param.list_ctx()[0]))
+                value = param.data(param.list_ctx()[0])
+                if hasattr(self._kvstore, "init"):
+                    self._kvstore.init(idx, value)
+                else:
+                    # hvd-style adapters have no server-side store: param
+                    # init is a rank-0 broadcast into every replica
+                    # (reference trainer.py horovod branch)
+                    self._kvstore.broadcast(idx, value, param.list_data())
         self._params_to_init = params_to_init
 
     # -- properties ------------------------------------------------------

@@ -1,31 +1,114 @@
-"""Horovod KVStore adapter (reference ``python/mxnet/kvstore/horovod.py``).
+"""Horovod / BytePS KVStore adapters (reference
+``python/mxnet/kvstore/horovod.py``, ``byteps.py``).
 
-Kept for API parity: maps broadcast→hvd.broadcast, pushpull→hvd.allreduce.
-On TPU pods the native 'tpu' store (XLA collectives over ICI/DCN) is the
-recommended backend; this adapter requires a horovod install with an
-alltoall-capable backend.
+When the external package is installed, calls map straight onto it
+(broadcast→hvd.broadcast, pushpull→hvd.allreduce).  When it is NOT —
+the normal case on TPU pods — the same API runs on this framework's own
+XLA collectives: ``jax.distributed`` ranks from the launcher env and a
+single psum-shaped cross-process sum (`kvstore.py::_cross_process_sum`).
+So ``kvstore='horovod'`` code trains unchanged, single- or
+multi-process, with ICI/DCN collectives doing the reduction — the
+TPU-first answer rather than an import error.
 """
 from __future__ import annotations
 
 from .base import KVStoreBase
 
-__all__ = ["Horovod"]
+__all__ = ["Horovod", "BytePS"]
+
+
+class _XlaCollectives:
+    """horovod-shaped rank/size/allreduce/broadcast over XLA collectives.
+
+    Rank/size come from ``jax.distributed`` (initialized from the
+    launcher's MXNET_TPU_* env when present; single-process otherwise).
+    """
+
+    def __init__(self):
+        from . import kvstore_server
+
+        kvstore_server.init_distributed()      # no-op without launcher env
+
+    @staticmethod
+    def rank() -> int:
+        import jax
+
+        return jax.process_index()
+
+    @staticmethod
+    def size() -> int:
+        import jax
+
+        return jax.process_count()
+
+    @staticmethod
+    def _local_sum(value):
+        """A list value (one grad per local device, Trainer's
+        ``param.list_grad()``) reduces locally first, like KVStoreLocal's
+        Comm, before the cross-process collective."""
+        import jax.numpy as jnp
+
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        arrs = [v._data if hasattr(v, "_data") else jnp.asarray(v)
+                for v in vals]
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+
+    def allreduce_sum(self, value):
+        from .kvstore import _cross_process_sum
+
+        return _cross_process_sum(self._local_sum(value))
+
+    def broadcast0(self, value):
+        """Root-0 broadcast as ONE collective: non-root ranks contribute
+        an explicit zeros buffer (NOT value * mask — non-root buffers are
+        don't-care and may hold inf/nan, which a multiply would poison)."""
+        import jax.numpy as jnp
+
+        from .kvstore import _cross_process_sum
+
+        first = value[0] if isinstance(value, (list, tuple)) else value
+        x = first._data if hasattr(first, "_data") else jnp.asarray(first)
+        if self.size() == 1:
+            return x
+        contribution = x if self.rank() == 0 else jnp.zeros_like(x)
+        return _cross_process_sum(contribution)
+
+
+def _copy_result(result, out):
+    """Write ``result`` into every destination with ``copyto`` semantics
+    (dtype cast + device placement follow the DESTINATION, exactly like
+    the hvd-installed path's ``value.copyto(o)``)."""
+    from ..context import current_context
+    from ..ndarray.ndarray import NDArray, _wrap
+
+    src = result if isinstance(result, NDArray) \
+        else _wrap(result, current_context())
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        src.copyto(o)
+
+
+def _try_import(modname):
+    try:
+        return __import__(modname, fromlist=["mxnet"])
+    except ImportError:
+        return None
 
 
 @KVStoreBase.register
 class Horovod(KVStoreBase):
     def __init__(self):
-        try:
-            import horovod.mxnet as hvd  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "kvstore='horovod' requires the horovod package; on TPU use "
-                "kvstore='tpu' (XLA collectives) instead"
-            ) from e
-        import horovod.mxnet as hvd
-
-        self._hvd = hvd
-        hvd.init()
+        self._hvd = _try_import("horovod.mxnet")
+        if self._hvd is not None:
+            # init errors from an INSTALLED horovod must surface, not
+            # silently degrade to the fallback
+            self._hvd.init()
+            self._fallback = None
+        else:
+            self._fallback = _XlaCollectives()
 
     @property
     def type(self):
@@ -33,46 +116,46 @@ class Horovod(KVStoreBase):
 
     @property
     def rank(self):
-        return self._hvd.rank()
+        return self._hvd.rank() if self._hvd else self._fallback.rank()
 
     @property
     def num_workers(self):
-        return self._hvd.size()
+        return self._hvd.size() if self._hvd else self._fallback.size()
 
     @staticmethod
     def is_capable(capability):
         return False  # no server-side optimizer
 
     def broadcast(self, key, value, out, priority=0):
-        value = self._hvd.broadcast(value, root_rank=0, name=str(key))
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        for o in outs:
-            value.copyto(o)
+        if self._hvd:
+            value = self._hvd.broadcast(value, root_rank=0, name=str(key))
+            _copy_result(value, out)
+            return
+        _copy_result(self._fallback.broadcast0(value), out)
 
     def pushpull(self, key, value, out=None, priority=0):
-        summed = self._hvd.allreduce(value, average=False, name=str(key))
-        if out is not None:
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            for o in outs:
-                summed.copyto(o)
+        if self._hvd:
+            summed = self._hvd.allreduce(value, average=False,
+                                        name=str(key))
+            _copy_result(summed, out if out is not None else value)
+            return
+        summed = self._fallback.allreduce_sum(value)
+        # out=None means in-place allreduce into `value` (reference
+        # horovod.py calls hvd.allreduce_(v) in place)
+        _copy_result(summed, out if out is not None else value)
 
 
 @KVStoreBase.register
 class BytePS(KVStoreBase):
-    """BytePS adapter (reference ``python/mxnet/kvstore/byteps.py``)."""
+    """BytePS adapter; same fallback story as :class:`Horovod`."""
 
     def __init__(self):
-        try:
-            import byteps.mxnet as bps  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "kvstore='byteps' requires the byteps package; on TPU use "
-                "kvstore='tpu' (XLA collectives) instead"
-            ) from e
-        import byteps.mxnet as bps
-
-        self._bps = bps
-        bps.init()
+        self._bps = _try_import("byteps.mxnet")
+        if self._bps is not None:
+            self._bps.init()
+            self._fallback = None
+        else:
+            self._fallback = _XlaCollectives()
 
     @property
     def type(self):
@@ -80,26 +163,31 @@ class BytePS(KVStoreBase):
 
     @property
     def rank(self):
-        return self._bps.rank()
+        return self._bps.rank() if self._bps else self._fallback.rank()
 
     @property
     def num_workers(self):
-        return self._bps.size()
+        return self._bps.size() if self._bps else self._fallback.size()
 
     @staticmethod
     def is_capable(capability):
         return False
 
     def broadcast(self, key, value, out, priority=0):
-        self._bps.byteps_declare_tensor(str(key))
-        self._bps.byteps_push_pull(value, name=str(key), is_average=False)
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        for o in outs:
-            value.copyto(o)
+        if self._bps:
+            self._bps.byteps_declare_tensor(str(key))
+            self._bps.byteps_push_pull(value, name=str(key),
+                                       is_average=False)
+            _copy_result(value, out)
+            return
+        _copy_result(self._fallback.broadcast0(value), out)
 
     def pushpull(self, key, value, out=None, priority=0):
-        self._bps.byteps_push_pull(value, name=str(key), is_average=False)
-        if out is not None:
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            for o in outs:
-                value.copyto(o)
+        if self._bps:
+            self._bps.byteps_push_pull(value, name=str(key),
+                                       is_average=False)
+            if out is not None:
+                _copy_result(value, out)
+            return
+        summed = self._fallback.allreduce_sum(value)
+        _copy_result(summed, out if out is not None else value)

@@ -1,0 +1,279 @@
+"""Convolution / pooling / norm parameter matrices vs numpy oracles
+(reference test_operator.py test_convolution_*, test_pooling_*,
+test_batchnorm/layernorm scenario families).
+
+The oracles are direct numpy loops re-derived from the op contracts —
+slow but unambiguous — at shapes small enough to stay fast.
+"""
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+from mxnet_tpu.ops.registry import get_op
+
+_R = onp.random.RandomState(7)
+
+
+def _get(name):
+    return get_op(name).fn
+
+
+def _conv2d_oracle(x, w, b, stride, pad, dilate, groups):
+    N, C, H, W = x.shape
+    F, Cg, KH, KW = w.shape
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    xp = onp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    eKH, eKW = (KH - 1) * dh + 1, (KW - 1) * dw + 1
+    OH = (H + 2 * ph - eKH) // sh + 1
+    OW = (W + 2 * pw - eKW) // sw + 1
+    out = onp.zeros((N, F, OH, OW), onp.float32)
+    fpg = F // groups
+    for g in range(groups):
+        xs = xp[:, g * Cg:(g + 1) * Cg]
+        ws = w[g * fpg:(g + 1) * fpg]
+        for i in range(OH):
+            for j in range(OW):
+                patch = xs[:, :, i * sh:i * sh + eKH:dh,
+                           j * sw:j * sw + eKW:dw]
+                out[:, g * fpg:(g + 1) * fpg, i, j] = onp.einsum(
+                    "nchw,fchw->nf", patch, ws)
+    if b is not None:
+        out += b[None, :, None, None]
+    return out
+
+
+@pytest.mark.parametrize("stride", [(1, 1), (2, 2), (2, 1)])
+@pytest.mark.parametrize("pad", [(0, 0), (1, 1), (2, 1)])
+@pytest.mark.parametrize("kernel", [(1, 1), (3, 3), (3, 2)])
+def test_conv2d_stride_pad_kernel_matrix(stride, pad, kernel):
+    x = _R.rand(2, 3, 9, 8).astype(onp.float32)
+    w = (_R.rand(4, 3, *kernel) * 0.5).astype(onp.float32)
+    b = _R.rand(4).astype(onp.float32)
+    got = onp.asarray(_get("Convolution")(
+        [jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)], kernel=kernel,
+        stride=stride, pad=pad, num_filter=4))
+    want = _conv2d_oracle(x, w, b, stride, pad, (1, 1), 1)
+    assert got.shape == want.shape
+    onp.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("dilate", [(2, 2), (2, 1)])
+def test_conv2d_dilation(dilate):
+    x = _R.rand(1, 2, 10, 10).astype(onp.float32)
+    w = (_R.rand(3, 2, 3, 3) * 0.5).astype(onp.float32)
+    got = onp.asarray(_get("Convolution")(
+        [jnp.asarray(x), jnp.asarray(w)], kernel=(3, 3), dilate=dilate,
+        num_filter=3, no_bias=True))
+    want = _conv2d_oracle(x, w, None, (1, 1), (0, 0), dilate, 1)
+    onp.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("groups", [2, 4])
+def test_conv2d_grouped(groups):
+    C, F = 4, 8
+    x = _R.rand(2, C, 6, 6).astype(onp.float32)
+    w = (_R.rand(F, C // groups, 3, 3) * 0.5).astype(onp.float32)
+    got = onp.asarray(_get("Convolution")(
+        [jnp.asarray(x), jnp.asarray(w)], kernel=(3, 3), pad=(1, 1),
+        num_filter=F, num_group=groups, no_bias=True))
+    want = _conv2d_oracle(x, w, None, (1, 1), (1, 1), (1, 1), groups)
+    onp.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_conv1d_and_conv3d():
+    # 1D: matrix against explicit loop
+    x = _R.rand(2, 3, 12).astype(onp.float32)
+    w = (_R.rand(4, 3, 3) * 0.5).astype(onp.float32)
+    got = onp.asarray(_get("Convolution")(
+        [jnp.asarray(x), jnp.asarray(w)], kernel=(3,), num_filter=4,
+        no_bias=True))
+    want = onp.zeros((2, 4, 10), onp.float32)
+    for i in range(10):
+        want[:, :, i] = onp.einsum("ncw,fcw->nf", x[:, :, i:i + 3], w)
+    onp.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    # 3D: shape contract
+    x3 = _R.rand(1, 2, 4, 5, 6).astype(onp.float32)
+    w3 = (_R.rand(3, 2, 2, 2, 2) * 0.5).astype(onp.float32)
+    out3 = onp.asarray(_get("Convolution")(
+        [jnp.asarray(x3), jnp.asarray(w3)], kernel=(2, 2, 2), num_filter=3,
+        no_bias=True))
+    assert out3.shape == (1, 3, 3, 4, 5)
+
+
+def _pool_oracle(x, kernel, stride, pad, mode, count_include_pad=True):
+    N, C, H, W = x.shape
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    fill = -onp.inf if mode == "max" else 0.0
+    xp = onp.full((N, C, H + 2 * ph, W + 2 * pw), fill, onp.float32)
+    xp[:, :, ph:ph + H, pw:pw + W] = x
+    OH = (H + 2 * ph - kh) // sh + 1
+    OW = (W + 2 * pw - kw) // sw + 1
+    out = onp.zeros((N, C, OH, OW), onp.float32)
+    for i in range(OH):
+        for j in range(OW):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            if mode == "max":
+                out[:, :, i, j] = win.max(axis=(2, 3))
+            else:
+                if count_include_pad:
+                    out[:, :, i, j] = win.mean(axis=(2, 3))
+                else:
+                    h0, w0 = i * sh, j * sw
+                    hn = min(h0 + kh, H + ph) - max(h0, ph)
+                    wn = min(w0 + kw, W + pw) - max(w0, pw)
+                    out[:, :, i, j] = win.sum(axis=(2, 3)) / (hn * wn)
+    return out
+
+
+@pytest.mark.parametrize("mode", ["max", "avg"])
+@pytest.mark.parametrize("kernel,stride,pad", [
+    ((2, 2), (2, 2), (0, 0)),
+    ((3, 3), (1, 1), (1, 1)),
+    ((3, 3), (2, 2), (1, 1)),
+    ((2, 3), (2, 1), (0, 1)),
+])
+def test_pooling_matrix(mode, kernel, stride, pad):
+    x = _R.rand(2, 3, 8, 8).astype(onp.float32)
+    got = onp.asarray(_get("Pooling")(
+        jnp.asarray(x), kernel=kernel, stride=stride, pad=pad,
+        pool_type=mode))
+    want = _pool_oracle(x, kernel, stride, pad, mode)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+
+def test_avg_pool_count_include_pad_false():
+    x = _R.rand(1, 2, 6, 6).astype(onp.float32)
+    got = onp.asarray(_get("Pooling")(
+        jnp.asarray(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+        pool_type="avg", count_include_pad=False))
+    want = _pool_oracle(x, (3, 3), (2, 2), (1, 1), "avg",
+                        count_include_pad=False)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+
+def test_global_pooling():
+    x = _R.rand(2, 3, 5, 7).astype(onp.float32)
+    gmax = onp.asarray(_get("Pooling")(jnp.asarray(x), kernel=(2, 2),
+                                       global_pool=True, pool_type="max"))
+    onp.testing.assert_allclose(gmax[:, :, 0, 0], x.max(axis=(2, 3)))
+    gavg = onp.asarray(_get("Pooling")(jnp.asarray(x), kernel=(2, 2),
+                                       global_pool=True, pool_type="avg"))
+    onp.testing.assert_allclose(gavg[:, :, 0, 0], x.mean(axis=(2, 3)),
+                                rtol=2e-6)
+
+
+@pytest.mark.parametrize("axis", [1, -1])
+def test_batchnorm_inference_oracle(axis):
+    x = _R.rand(4, 3, 5, 5).astype(onp.float32)
+    g = (_R.rand(3) + 0.5).astype(onp.float32)
+    b = _R.rand(3).astype(onp.float32)
+    mm = _R.rand(3).astype(onp.float32)
+    mv = (_R.rand(3) + 0.5).astype(onp.float32)
+    ax = axis if axis >= 0 else x.ndim + axis
+    xin = x if ax == 1 else onp.moveaxis(x, 1, ax)
+    (got,) = _get("BatchNorm")(
+        [jnp.asarray(xin), jnp.asarray(g), jnp.asarray(b),
+         jnp.asarray(mm), jnp.asarray(mv)], eps=1e-3, fix_gamma=False,
+        axis=ax)
+    got = onp.asarray(got)
+    shape = [1] * x.ndim
+    shape[ax] = 3
+    want = ((xin - mm.reshape(shape)) / onp.sqrt(mv.reshape(shape) + 1e-3)
+            * g.reshape(shape) + b.reshape(shape))
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_batchnorm_fix_gamma_ignores_gamma():
+    x = _R.rand(2, 3, 4, 4).astype(onp.float32)
+    g = (_R.rand(3) * 5).astype(onp.float32)       # must be ignored
+    b = onp.zeros(3, onp.float32)
+    mm = onp.zeros(3, onp.float32)
+    mv = onp.ones(3, onp.float32)
+    (got,) = _get("BatchNorm")(
+        [jnp.asarray(x), jnp.asarray(g), jnp.asarray(b), jnp.asarray(mm),
+         jnp.asarray(mv)], eps=0.0, fix_gamma=True)
+    onp.testing.assert_allclose(onp.asarray(got), x, rtol=2e-6)
+
+
+@pytest.mark.parametrize("axis", [-1, 1])
+def test_layernorm_oracle(axis):
+    x = _R.rand(4, 6, 5).astype(onp.float32)
+    dim = x.shape[axis]
+    g = (_R.rand(dim) + 0.5).astype(onp.float32)
+    b = _R.rand(dim).astype(onp.float32)
+    got = onp.asarray(_get("LayerNorm")(
+        jnp.asarray(x), jnp.asarray(g), jnp.asarray(b), axis=axis,
+        eps=1e-5))
+    mean = x.mean(axis=axis, keepdims=True)
+    var = x.var(axis=axis, keepdims=True)
+    shape = [1] * x.ndim
+    shape[axis] = dim
+    want = ((x - mean) / onp.sqrt(var + 1e-5) * g.reshape(shape)
+            + b.reshape(shape))
+    onp.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+@pytest.mark.parametrize("op", ["softmax", "log_softmax", "softmin"])
+def test_softmax_family_axis(op, axis):
+    x = (_R.rand(3, 4, 5) * 4 - 2).astype(onp.float32)
+    got = onp.asarray(_get(op)(jnp.asarray(x), axis=axis))
+    z = -x if op == "softmin" else x
+    e = onp.exp(z - z.max(axis=axis, keepdims=True))
+    sm = e / e.sum(axis=axis, keepdims=True)
+    want = onp.log(sm) if op == "log_softmax" else sm
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_softmax_temperature():
+    x = (_R.rand(2, 5) * 4).astype(onp.float32)
+    t = 2.5
+    got = onp.asarray(_get("softmax")(jnp.asarray(x), axis=-1,
+                                      temperature=t))
+    z = x / t
+    e = onp.exp(z - z.max(axis=-1, keepdims=True))
+    onp.testing.assert_allclose(got, e / e.sum(axis=-1, keepdims=True),
+                                rtol=2e-5)
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu",
+                                 "softsign"])
+def test_activation_forms(act):
+    x = (_R.rand(3, 4) * 4 - 2).astype(onp.float32)
+    got = onp.asarray(_get("Activation")(jnp.asarray(x), act_type=act))
+    want = {
+        "relu": lambda v: onp.maximum(v, 0),
+        "sigmoid": lambda v: 1 / (1 + onp.exp(-v)),
+        "tanh": onp.tanh,
+        "softrelu": lambda v: onp.log1p(onp.exp(v)),
+        "softsign": lambda v: v / (1 + onp.abs(v)),
+    }[act](x)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("slope", [0.01, 0.2])
+def test_leaky_relu_slope(slope):
+    x = (_R.rand(3, 4) * 4 - 2).astype(onp.float32)
+    got = onp.asarray(_get("LeakyReLU")([jnp.asarray(x)],
+                                        act_type="leaky", slope=slope))
+    onp.testing.assert_allclose(got, onp.where(x > 0, x, slope * x),
+                                rtol=2e-5)
+
+
+def test_deconvolution_shape_and_identity():
+    """Deconvolution inverts the conv shape contract; a 1x1 kernel with
+    identity weights reproduces the input channel-mixed."""
+    x = _R.rand(2, 3, 5, 5).astype(onp.float32)
+    w = onp.zeros((3, 4, 1, 1), onp.float32)     # (in, out, kh, kw)
+    for i in range(3):
+        w[i, i] = 1.0
+    out = onp.asarray(_get("Deconvolution")(
+        [jnp.asarray(x), jnp.asarray(w)], kernel=(1, 1), num_filter=4,
+        no_bias=True))
+    assert out.shape == (2, 4, 5, 5)
+    onp.testing.assert_allclose(out[:, :3], x, rtol=2e-5)
+    assert onp.abs(out[:, 3]).max() < 1e-6

@@ -168,3 +168,125 @@ def test_mpi_shim_maps_rank_env(tmp_path):
              if not k.startswith(("OMPI_", "PMI_", "MV2_"))})
     assert out3.returncode != 0
     assert "mpirun" in out3.stderr
+
+
+HVD_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("horovod")
+    rank, nproc = kv.rank, kv.num_workers
+    assert nproc == 2, nproc
+
+    # broadcast: every rank ends with rank 0's value
+    v = mx.nd.array(onp.full((2, 3), float(10 * (rank + 1)), onp.float32))
+    out = mx.nd.zeros((2, 3))
+    kv.broadcast("w", v, out)
+    assert onp.allclose(out.asnumpy(), 10.0), (rank, out.asnumpy())
+
+    # pushpull: global sum lands on every rank
+    g = mx.nd.array(onp.full((4,), float(rank + 1), onp.float32))
+    red = mx.nd.zeros((4,))
+    kv.pushpull("g", g, out=red)
+    assert onp.allclose(red.asnumpy(), 3.0), (rank, red.asnumpy())
+    print(f"HVDOK {{rank}} of {{nproc}}")
+""")
+
+
+def test_horovod_adapter_single_process():
+    """Without the horovod package, kvstore='horovod' still WORKS —
+    single-process semantics over the XLA-collectives fallback."""
+    import mxnet_tpu as mx
+    import numpy as onp
+
+    kv = mx.kv.create("horovod")
+    assert kv.rank == 0 and kv.num_workers >= 1
+    v = mx.nd.array(onp.arange(6, dtype=onp.float32).reshape(2, 3))
+    out = mx.nd.zeros((2, 3))
+    kv.broadcast("k", v, out)
+    onp.testing.assert_allclose(out.asnumpy(), v.asnumpy())
+    red = mx.nd.zeros((2, 3))
+    kv.pushpull("k", v, out=red)
+    onp.testing.assert_allclose(red.asnumpy(), v.asnumpy())
+    # byteps adapter shares the fallback
+    kv2 = mx.kv.create("byteps")
+    kv2.pushpull("k", v, out=red)
+    onp.testing.assert_allclose(red.asnumpy(), v.asnumpy())
+
+
+def test_horovod_adapter_trainer_shapes():
+    """The exact call shapes gluon.Trainer makes: LIST-valued value/out
+    (one grad per local device), and out=None meaning in-place allreduce
+    into value (reference hvd.allreduce_)."""
+    import mxnet_tpu as mx
+    import numpy as onp
+
+    kv = mx.kv.create("horovod")
+    # list value: local elementwise reduce first (Comm semantics)
+    g1 = mx.nd.array(onp.ones((3,), onp.float32))
+    g2 = mx.nd.array(onp.full((3,), 2.0, onp.float32))
+    outs = [mx.nd.zeros((3,)), mx.nd.zeros((3,))]
+    kv.pushpull("p0", [g1, g2], out=outs)
+    for o in outs:
+        onp.testing.assert_allclose(o.asnumpy(), 3.0)
+    # out=None: in-place into value
+    g = mx.nd.array(onp.full((4,), 5.0, onp.float32))
+    kv.pushpull("p1", g)
+    onp.testing.assert_allclose(g.asnumpy(), 5.0)
+    gs = [mx.nd.array(onp.full((2,), 1.5, onp.float32)),
+          mx.nd.array(onp.full((2,), 2.5, onp.float32))]
+    kv.pushpull("p2", gs)
+    for o in gs:
+        onp.testing.assert_allclose(o.asnumpy(), 4.0)
+    # broadcast leaves dtype of the DESTINATION intact (copyto cast)
+    v32 = mx.nd.array(onp.ones((2,), onp.float32))
+    out16 = mx.nd.zeros((2,), dtype="float16")
+    kv.broadcast("p3", v32, out16)
+    assert out16.dtype == onp.float16
+    onp.testing.assert_allclose(out16.asnumpy().astype(onp.float32), 1.0)
+
+
+def test_horovod_adapter_through_trainer():
+    """gluon.Trainer(kvstore='horovod') trains end-to-end on the
+    fallback."""
+    import mxnet_tpu as mx
+    import numpy as onp
+    from mxnet_tpu import autograd, gluon
+
+    net = gluon.nn.Dense(2, in_units=4)
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="horovod")
+    x = mx.nd.array(onp.random.RandomState(0).rand(8, 4)
+                    .astype(onp.float32))
+    y = mx.nd.array(onp.random.RandomState(1).rand(8, 2)
+                    .astype(onp.float32))
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        tr.step(8)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_horovod_adapter_multiprocess(tmp_path):
+    """The hvd-API surface reduces across launcher-spawned processes via
+    the framework's own collectives (no horovod installed)."""
+    script = tmp_path / "hvd_worker.py"
+    script.write_text(HVD_WORKER.format(repo=REPO))
+    launch = os.path.join(REPO, "tools", "launch.py")
+    out = subprocess.run(
+        [sys.executable, launch, "-n", "2", "--launcher", "local",
+         "--port", str(_free_port()), sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    ok = [l for l in out.stdout.splitlines() if l.startswith("HVDOK")]
+    assert sorted(ok) == ["HVDOK 0 of 2", "HVDOK 1 of 2"], out.stdout

@@ -61,3 +61,9 @@ def test_ssd_example():
 
 # example/extensions/custom_op_ext.py is loaded (not executed) by
 # tests/test_extensions.py — the MXLoadLib analog exercises it there.
+
+
+@pytest.mark.slow
+def test_migration_example():
+    out = _run("example/migration/import_mxnet_model.py")
+    assert "MIGRATION_OK" in out

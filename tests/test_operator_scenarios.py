@@ -599,3 +599,13 @@ def test_broadcast_grad_reduces_correctly(sa, sb):
         if d == 1 and want.shape[i] != 1:
             want = want.sum(axis=i, keepdims=True)
     onp.testing.assert_allclose(onp.asarray(g), want, rtol=2e-5)
+
+
+def test_round_half_away_vs_around_half_even():
+    """Legacy nd `round` rounds half AWAY from zero (reference
+    mshadow_op.h round); np `around` rounds half to even."""
+    x = jnp.asarray([-2.5, -1.5, -0.5, 0.5, 1.5, 2.5], jnp.float32)
+    away = onp.asarray(_get("round")(x))
+    onp.testing.assert_array_equal(away, [-3, -2, -1, 1, 2, 3])
+    even = onp.asarray(_get("around")(x))
+    onp.testing.assert_array_equal(even, [-2, -2, -0, 0, 2, 2])

@@ -76,9 +76,16 @@ class NDArray:
         if isinstance(data, NDArray):
             data = data._data
         if not isinstance(data, jax.Array):
-            data = jnp.asarray(
-                data, dtype=_dtype_np(dtype) if dtype is not None else None
-            )
+            want = _dtype_np(dtype) if dtype is not None else None
+            src = getattr(data, "dtype", None)
+            if onp.dtype(want or src or onp.float32) in (onp.dtype("int64"),
+                                                         onp.dtype("uint64")):
+                # honest 64-bit integers (same policy as shape_array):
+                # the x32 default would silently truncate graph/edge ids
+                with jax.enable_x64(True):
+                    data = jnp.asarray(data, dtype=want)
+            else:
+                data = jnp.asarray(data, dtype=want)
             data = jax.device_put(data, ctx.jax_device)
         elif dtype is not None and data.dtype != _dtype_np(dtype):
             data = data.astype(_dtype_np(dtype))

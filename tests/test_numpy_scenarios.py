@@ -1,0 +1,339 @@
+"""Numpy-surface scenario matrices for the round-3 registration breadth
+(reference tests/python/unittest/test_numpy_op.py scenario families),
+vs numpy oracles: einsum forms, manipulation matrices, window functions,
+linalg batching, and distribution moments for the new samplers.
+"""
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import get_op
+
+_R = onp.random.RandomState(3)
+
+
+def _get(name):
+    return get_op(name).fn
+
+
+# ---------------------------------------------------------------------------
+# einsum equation forms (reference test_numpy_op.py test_np_einsum)
+# ---------------------------------------------------------------------------
+
+_EINSUM_CASES = [
+    ("ij,jk->ik", [(3, 4), (4, 5)]),
+    ("ij->ji", [(3, 4)]),
+    ("ii->i", [(4, 4)]),
+    ("ii->", [(4, 4)]),
+    ("ij,ij->", [(3, 4), (3, 4)]),
+    ("i,j->ij", [(3,), (4,)]),
+    ("bij,bjk->bik", [(2, 3, 4), (2, 4, 5)]),
+    ("ijk->kji", [(2, 3, 4)]),
+    ("ij,j->i", [(3, 4), (4,)]),
+    ("...ij,...jk->...ik", [(2, 3, 4), (2, 4, 5)]),
+]
+
+
+@pytest.mark.parametrize("eq,shapes", _EINSUM_CASES,
+                         ids=[c[0] for c in _EINSUM_CASES])
+def test_einsum_forms(eq, shapes):
+    arrs = [_R.rand(*s).astype(onp.float32) for s in shapes]
+    got = onp.asarray(_get("einsum")([jnp.asarray(a) for a in arrs],
+                                     subscripts=eq))
+    onp.testing.assert_allclose(got, onp.einsum(eq, *arrs), rtol=2e-5,
+                                atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# tensordot axes forms
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("axes", [0, 1, 2, ((1,), (0,)), ((0, 1), (0, 1))])
+def test_tensordot_axes(axes):
+    a = _R.rand(3, 4).astype(onp.float32)
+    if axes in (1, ((1,), (0,))):
+        b = _R.rand(4, 5).astype(onp.float32)
+    else:
+        b = _R.rand(3, 4).astype(onp.float32)
+    if axes == 1:
+        want = onp.tensordot(a, b, axes=1)
+        got = onp.asarray(_get("tensordot")(jnp.asarray(a), jnp.asarray(b),
+                                            axes=1))
+    elif axes == 2:
+        want = onp.tensordot(a, b, axes=2)
+        got = onp.asarray(_get("tensordot")(jnp.asarray(a), jnp.asarray(b),
+                                            axes=2))
+    elif axes == 0:
+        want = onp.tensordot(a, b, axes=0)
+        got = onp.asarray(_get("tensordot")(jnp.asarray(a), jnp.asarray(b),
+                                            axes=0))
+    else:
+        want = onp.tensordot(a, b, axes=axes)
+        got = onp.asarray(_get("tensordot")(
+            jnp.asarray(a), jnp.asarray(b),
+            a_axes_summed=axes[0], b_axes_summed=axes[1]))
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# percentile interpolation methods
+# ---------------------------------------------------------------------------
+
+# 'nearest' is excluded: jax and numpy break exact-midpoint ties
+# differently (documented jnp.percentile divergence)
+@pytest.mark.parametrize("method", ["linear", "lower", "higher",
+                                    "midpoint"])
+@pytest.mark.parametrize("q", [0, 25, 50, 90, 100])
+def test_percentile_methods(method, q):
+    x = _R.rand(40).astype(onp.float32)
+    got = onp.asarray(_get("percentile")(jnp.asarray(x), q=float(q),
+                                         interpolation=method))
+    want = onp.percentile(x, q, method=method).astype(onp.float32)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_percentile_axis(axis):
+    x = _R.rand(4, 6).astype(onp.float32)
+    got = onp.asarray(_get("percentile")(jnp.asarray(x), q=30.0,
+                                         axis=axis))
+    onp.testing.assert_allclose(got, onp.percentile(x, 30.0, axis=axis),
+                                rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# manipulation matrices: insert / delete / diff / pad-free stacking
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("obj", [0, 2, 5])
+def test_delete_int(obj):
+    x = onp.arange(6, dtype=onp.float32)
+    got = onp.asarray(_get("delete")([jnp.asarray(x)], obj=obj))
+    onp.testing.assert_array_equal(got, onp.delete(x, obj))
+
+
+def test_delete_slice_and_tensor():
+    x = onp.arange(10, dtype=onp.float32)
+    got = onp.asarray(_get("delete")([jnp.asarray(x)], start=1, stop=7,
+                                     step=2))
+    onp.testing.assert_array_equal(got, onp.delete(x, slice(1, 7, 2)))
+    idx = onp.array([0, 3, 4], onp.int64)
+    got2 = onp.asarray(_get("delete")([jnp.asarray(x), jnp.asarray(idx)]))
+    onp.testing.assert_array_equal(got2, onp.delete(x, idx))
+
+
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_delete_axis(axis):
+    x = _R.rand(3, 4).astype(onp.float32)
+    got = onp.asarray(_get("delete")([jnp.asarray(x)], obj=1, axis=axis))
+    onp.testing.assert_array_equal(got, onp.delete(x, 1, axis=axis))
+
+
+def test_insert_variants():
+    x = onp.arange(5, dtype=onp.float32)
+    got = onp.asarray(_get("insert")([jnp.asarray(x)], obj=2, val=9.5))
+    onp.testing.assert_array_equal(got, onp.insert(x, 2, 9.5))
+    vals = onp.array([7.0, 8.0], onp.float32)
+    got2 = onp.asarray(_get("insert")([jnp.asarray(x), jnp.asarray(vals)],
+                                      obj=1))
+    onp.testing.assert_array_equal(got2, onp.insert(x, 1, vals))
+
+
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_diff_orders(n):
+    x = (_R.rand(8) * 10).astype(onp.float32)
+    got = onp.asarray(_get("diff")(jnp.asarray(x), n=n))
+    onp.testing.assert_allclose(got, onp.diff(x, n=n), rtol=2e-5,
+                                atol=1e-5)
+
+
+def test_ediff1d_to_begin_end():
+    x = onp.array([1.0, 3.0, 6.0, 10.0], onp.float32)
+    got = onp.asarray(_get("ediff1d")([jnp.asarray(x)], to_begin=-1.0,
+                                      to_end=(99.0, 100.0)))
+    onp.testing.assert_array_equal(
+        got, onp.ediff1d(x, to_begin=-1.0, to_end=[99.0, 100.0]))
+
+
+@pytest.mark.parametrize("src,dst", [(0, 2), (2, 0), ((0, 1), (2, 1))])
+def test_moveaxis_forms(src, dst):
+    x = _R.rand(2, 3, 4).astype(onp.float32)
+    got = onp.asarray(_get("moveaxis")(jnp.asarray(x), source=src,
+                                       destination=dst))
+    onp.testing.assert_array_equal(got, onp.moveaxis(x, src, dst))
+
+
+@pytest.mark.parametrize("offset,axes", [(0, (0, 1)), (1, (0, 1)),
+                                         (-1, (0, 1)), (0, (1, 2))])
+def test_diagonal_forms(offset, axes):
+    x = _R.rand(3, 4, 5).astype(onp.float32)
+    got = onp.asarray(_get("diagonal")(jnp.asarray(x), offset=offset,
+                                       axis1=axes[0], axis2=axes[1]))
+    onp.testing.assert_array_equal(
+        got, onp.diagonal(x, offset=offset, axis1=axes[0], axis2=axes[1]))
+
+
+# ---------------------------------------------------------------------------
+# window functions vs numpy
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("win,np_fn", [("hanning", onp.hanning),
+                                       ("hamming", onp.hamming),
+                                       ("blackman", onp.blackman)])
+@pytest.mark.parametrize("M", [1, 5, 12])
+def test_windows(win, np_fn, M):
+    got = onp.asarray(_get(win)(M=M))
+    onp.testing.assert_allclose(got, np_fn(M).astype(onp.float32),
+                                rtol=2e-5, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# linalg batching + identities for the round-3 lanes
+# ---------------------------------------------------------------------------
+
+def test_eig_reconstruction():
+    a = _R.rand(4, 4).astype(onp.float32) + 2 * onp.eye(
+        4, dtype=onp.float32)
+    w, v = _get("linalg_eig")(jnp.asarray(a))
+    w, v = onp.asarray(w), onp.asarray(v)
+    onp.testing.assert_allclose(a @ v, v @ onp.diag(w), rtol=1e-3,
+                                atol=1e-3)
+    wv = onp.asarray(_get("linalg_eigvals")(jnp.asarray(a)))
+    onp.testing.assert_allclose(sorted(onp.real(wv)), sorted(onp.real(w)),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_tensorsolve_identity():
+    a = _R.rand(6, 2, 3).astype(onp.float32)
+    a = a.reshape(6, 6) + 4 * onp.eye(6, dtype=onp.float32)
+    a = a.reshape(2, 3, 2, 3)
+    b = _R.rand(2, 3).astype(onp.float32)
+    x = onp.asarray(_get("linalg_tensorsolve")(jnp.asarray(a),
+                                               jnp.asarray(b)))
+    onp.testing.assert_allclose(onp.tensordot(a, x, axes=2), b,
+                                rtol=1e-3, atol=1e-3)
+
+
+def test_kron_cross_identities():
+    a = _R.rand(2, 3).astype(onp.float32)
+    b = _R.rand(3, 2).astype(onp.float32)
+    onp.testing.assert_allclose(
+        onp.asarray(_get("kron")(jnp.asarray(a), jnp.asarray(b))),
+        onp.kron(a, b), rtol=2e-5)
+    u = _R.rand(4, 3).astype(onp.float32)
+    v = _R.rand(4, 3).astype(onp.float32)
+    c = onp.asarray(_get("cross")(jnp.asarray(u), jnp.asarray(v)))
+    onp.testing.assert_allclose(c, onp.cross(u, v), rtol=2e-5, atol=1e-5)
+    # orthogonality of the cross product
+    assert onp.abs((c * u).sum(-1)).max() < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# distribution moments for the new samplers (reference test_numpy_op.py
+# random moment checks: mean/var within statistical tolerance)
+# ---------------------------------------------------------------------------
+
+_DISTS = [
+    ("laplace", dict(loc=2.0, scale=0.5), 2.0, 2 * 0.5 ** 2),
+    ("gumbel", dict(loc=0.0, scale=1.0), 0.5772, onp.pi ** 2 / 6),
+    ("logistic", dict(loc=1.0, scale=0.5), 1.0,
+     (onp.pi ** 2 / 3) * 0.25),
+    ("rayleigh", dict(scale=2.0), 2.0 * onp.sqrt(onp.pi / 2),
+     (4 - onp.pi) / 2 * 4.0),
+    ("weibull", dict(a=1.0), 1.0, 1.0),          # k=1 -> Exp(1)
+    ("powerd", dict(a=3.0), 0.75, 3.0 / (16 * 5)),
+]
+
+
+@pytest.mark.parametrize("name,kw,mean,var", _DISTS,
+                         ids=[d[0] for d in _DISTS])
+def test_distribution_moments(name, kw, mean, var):
+    mx.random.seed(42)
+    n = 20000
+    size_key = "size" if name != "generalized_negative_binomial" else "shape"
+    x = onp.asarray(_get(name)(**kw, **{size_key: (n,)}))
+    se = onp.sqrt(var / n)
+    assert abs(x.mean() - mean) < 6 * se, (x.mean(), mean)
+    assert abs(x.var() - var) < 0.15 * var + 6 * var / onp.sqrt(n)
+
+
+def test_pareto_support_and_choice():
+    mx.random.seed(1)
+    p = onp.asarray(_get("pareto")(a=3.0, size=(5000,)))
+    assert (p >= 0).all()          # np.random.pareto support is [0, inf)
+    c = onp.asarray(_get("choice")(a=5, size=(4000,)))
+    assert set(onp.unique(c)).issubset(set(range(5)))
+    # roughly uniform
+    counts = onp.bincount(c.astype(onp.int64), minlength=5)
+    assert counts.min() > 4000 / 5 * 0.7
+
+
+def test_generalized_negative_binomial_moments():
+    mx.random.seed(7)
+    mu, alpha = 4.0, 0.5
+    x = onp.asarray(_get("generalized_negative_binomial")(
+        mu=mu, alpha=alpha, shape=(20000,)))
+    # mean mu, var mu + alpha*mu^2 (gamma-poisson mixture)
+    assert abs(x.mean() - mu) < 0.15
+    want_var = mu + alpha * mu * mu
+    assert abs(x.var() - want_var) / want_var < 0.15
+
+
+# ---------------------------------------------------------------------------
+# npx index ops + boolean-mask assign
+# ---------------------------------------------------------------------------
+
+def test_index_add_update_stacked_coords():
+    x = onp.zeros((3, 4), onp.float32)
+    idx = onp.array([[0, 2, 2], [1, 0, 3]], onp.int32)   # (k=2, n=3)
+    val = onp.array([1.0, 2.0, 3.0], onp.float32)
+    got = onp.asarray(_get("index_add")(jnp.asarray(x), jnp.asarray(idx),
+                                        jnp.asarray(val)))
+    want = x.copy()
+    for j in range(3):
+        want[idx[0, j], idx[1, j]] += val[j]
+    onp.testing.assert_array_equal(got, want)
+    got2 = onp.asarray(_get("index_update")(
+        jnp.asarray(onp.ones((3, 4), onp.float32)), jnp.asarray(idx),
+        jnp.asarray(val)))
+    want2 = onp.ones((3, 4), onp.float32)
+    for j in range(3):
+        want2[idx[0, j], idx[1, j]] = val[j]
+    onp.testing.assert_array_equal(got2, want2)
+
+
+def test_boolean_mask_assign():
+    x = _R.rand(4, 3).astype(onp.float32)
+    mask = onp.array([1, 0, 1, 0], onp.float32)
+    got = onp.asarray(_get("boolean_mask_assign_scalar")(
+        jnp.asarray(x), jnp.asarray(mask), value=-1.0))
+    want = x.copy()
+    want[mask.astype(bool)] = -1.0
+    onp.testing.assert_array_equal(got, want)
+
+
+def test_nonzero_and_constraint_check():
+    x = onp.array([[0, 1], [2, 0]], onp.float32)
+    nz = onp.asarray(_get("nonzero")(jnp.asarray(x)))
+    onp.testing.assert_array_equal(nz, onp.argwhere(x != 0))
+    assert nz.dtype == onp.int64
+    ok = _get("constraint_check")(jnp.asarray(onp.ones(3)))
+    assert bool(ok)
+    bad = _get("constraint_check")(jnp.asarray(onp.array([1.0, 0.0])))
+    assert not bool(bad)
+
+
+def test_ste_gradients():
+    import jax
+
+    x = jnp.asarray([-1.2, -0.4, 0.3, 1.7], jnp.float32)
+    onp.testing.assert_array_equal(onp.asarray(_get("round_ste")(x)),
+                                   onp.round(onp.asarray(x)))
+    g = jax.grad(lambda t: jnp.sum(_get("round_ste")(t) * 2.0))(x)
+    onp.testing.assert_allclose(onp.asarray(g), 2.0)   # straight-through
+    g2 = jax.grad(lambda t: jnp.sum(_get("sign_ste")(t)))(x)
+    onp.testing.assert_allclose(onp.asarray(g2), 1.0)
+    g3 = jax.grad(lambda t: jnp.sum(_get("gradientmultiplier")(
+        t, scalar=-0.5)))(x)
+    onp.testing.assert_allclose(onp.asarray(g3), -0.5)

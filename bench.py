@@ -432,7 +432,18 @@ def main():
     _watchdog(timeout_s)
 
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
-    device_ok, on_cpu = _probe_device_backend(probe_timeout)
+    retries = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+    device_ok = on_cpu = False
+    for attempt in range(max(retries, 1)):
+        device_ok, on_cpu = _probe_device_backend(probe_timeout)
+        if device_ok:
+            break
+        if attempt + 1 < retries:
+            # a wedged tunnel often recovers within minutes; a CPU-
+            # fallback artifact is near-worthless next to waiting
+            _progress(f"probe attempt {attempt + 1}/{retries} failed; "
+                      "waiting 120s for tunnel recovery")
+            time.sleep(120)
     if on_cpu:
         _progress("default backend IS cpu: using small lane sizes")
     if not device_ok:

@@ -23,17 +23,36 @@ def _apply_wd(grad, weight, wd, rescale_grad, clip_gradient):
     return g + wd * weight
 
 
+def _absent_rows_keep(weight, grad, new_w):
+    """lazy_update semantics (reference optimizer_op.cc row_sparse sgd):
+    rows absent from the gradient — all-zero rows in the dense lowering
+    of a row_sparse grad — keep their weights EXACTLY (no wd decay)."""
+    present = jnp.any(grad != 0, axis=tuple(range(1, grad.ndim)))
+    shape = (-1,) + (1,) * (weight.ndim - 1)
+    return jnp.where(present.reshape(shape), new_w, weight)
+
+
 @register("sgd_update", num_inputs=2, num_outputs=1, differentiable=False)
 def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0, lazy_update=False):
     g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
-    return weight - lr * g
+    new_w = weight - lr * g
+    if lazy_update and grad.ndim >= 1:
+        return _absent_rows_keep(weight, grad, new_w)
+    return new_w
 
 
 @register("sgd_mom_update", num_inputs=3, num_outputs=-1, differentiable=False)
 def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
     g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    if lazy_update and grad.ndim >= 1:
+        # absent rows: weight AND momentum untouched (reference rsp sgd)
+        present = jnp.any(grad != 0, axis=tuple(range(1, grad.ndim)))
+        shape = (-1,) + (1,) * (weight.ndim - 1)
+        p = present.reshape(shape)
+        new_mom = jnp.where(p, momentum * mom - lr * g, mom)
+        return (jnp.where(p, weight + new_mom, weight), new_mom)
     new_mom = momentum * mom - lr * g
     return (weight + new_mom, new_mom)
 

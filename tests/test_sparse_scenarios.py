@@ -1,0 +1,165 @@
+"""Sparse container / operator scenarios, reference
+``tests/python/unittest/test_sparse_ndarray.py`` + ``test_sparse_operator.py``
+depth: conversion matrices, arithmetic vs dense oracles, retain/compact
+edges, sparse optimizer lazy-update semantics, CSR matvec shapes.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+_R = onp.random.RandomState(21)
+
+
+def _rand_csr(shape, density=0.3):
+    dense = _R.rand(*shape).astype(onp.float32)
+    dense[_R.rand(*shape) > density] = 0.0
+    return dense
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (3, 5), (8, 2), (6, 6)])
+@pytest.mark.parametrize("density", [0.0, 0.3, 1.0])
+def test_csr_conversion_matrix(shape, density):
+    dense = _rand_csr(shape, density)
+    c = sparse.csr_matrix(nd.array(dense))
+    onp.testing.assert_allclose(c.asnumpy(), dense)
+    back = c.todense()
+    onp.testing.assert_allclose(back.asnumpy(), dense)
+    # round-trip through stype strings
+    again = c.tostype("default")
+    onp.testing.assert_allclose(onp.asarray(again.asnumpy()), dense)
+
+
+@pytest.mark.parametrize("shape", [(4, 3), (7, 2)])
+@pytest.mark.parametrize("density", [0.0, 0.4, 1.0])
+def test_row_sparse_conversion_matrix(shape, density):
+    dense = _rand_csr(shape, density)
+    rs = sparse.row_sparse_array(nd.array(dense))
+    onp.testing.assert_allclose(rs.asnumpy(), dense)
+    onp.testing.assert_allclose(rs.todense().asnumpy(), dense)
+
+
+def test_row_sparse_retain_edges():
+    dense = _rand_csr((6, 3), 0.8)
+    rs = sparse.row_sparse_array(nd.array(dense))
+    # retain nothing
+    r0 = rs.retain(nd.array(onp.array([], onp.int32)))
+    onp.testing.assert_allclose(r0.asnumpy(), onp.zeros_like(dense))
+    # retain everything
+    r_all = rs.retain(nd.array(onp.arange(6, dtype=onp.int32)))
+    onp.testing.assert_allclose(r_all.asnumpy(), dense)
+    # retain a strict subset
+    keep = onp.array([1, 4], onp.int32)
+    r = rs.retain(nd.array(keep))
+    want = onp.zeros_like(dense)
+    want[keep] = dense[keep]
+    onp.testing.assert_allclose(r.asnumpy(), want)
+
+
+def test_row_sparse_add_and_compact():
+    d1 = onp.zeros((5, 2), onp.float32)
+    d2 = onp.zeros((5, 2), onp.float32)
+    d1[1] = 1.0
+    d1[3] = 2.0
+    d2[3] = 3.0
+    d2[4] = 4.0
+    a = sparse.row_sparse_array(nd.array(d1))
+    b = sparse.row_sparse_array(nd.array(d2))
+    s = a + b
+    onp.testing.assert_allclose(s.asnumpy(), d1 + d2)
+    c = s.compact()
+    onp.testing.assert_allclose(c.asnumpy(), d1 + d2)
+    # compact never keeps all-zero rows
+    kept = onp.asarray(c.indices.asnumpy()
+                       if hasattr(c.indices, "asnumpy") else c.indices)
+    assert set(kept.ravel().tolist()) == {1, 3, 4}
+
+
+@pytest.mark.parametrize("m,k,n", [(4, 5, 3), (1, 7, 1), (6, 2, 8)])
+def test_csr_dot_dense_shapes(m, k, n):
+    dense_a = _rand_csr((m, k), 0.4)
+    b = _R.rand(k, n).astype(onp.float32)
+    c = sparse.csr_matrix(nd.array(dense_a))
+    out = c.dot(nd.array(b))
+    onp.testing.assert_allclose(out.asnumpy(), dense_a @ b, rtol=2e-5,
+                                atol=1e-5)
+
+
+def test_sparse_retain_op_matches_container():
+    from mxnet_tpu.ops.registry import get_op
+
+    import jax.numpy as jnp
+
+    x = _rand_csr((5, 4), 0.9)
+    keep = onp.array([0, 2], onp.int32)
+    got = onp.asarray(get_op("sparse_retain").fn(jnp.asarray(x),
+                                                 jnp.asarray(keep)))
+    want = onp.zeros_like(x)
+    want[keep] = x[keep]
+    onp.testing.assert_allclose(got, want)
+
+
+def test_cast_storage_round_trips():
+    from mxnet_tpu.ops.registry import get_op
+
+    import jax.numpy as jnp
+
+    x = _rand_csr((4, 6), 0.3)
+    f = get_op("cast_storage").fn
+    for stype in ("csr", "row_sparse", "default"):
+        out = onp.asarray(f(jnp.asarray(x), stype=stype))
+        onp.testing.assert_allclose(out, x)
+
+
+def test_sparse_sgd_lazy_update_touches_only_sampled_rows():
+    """The reference's lazy_update contract (optimizer_op.cc sgd rsp):
+    rows with zero gradient keep their weights EXACTLY (no wd decay)."""
+    from mxnet_tpu.ops.registry import get_op
+
+    import jax.numpy as jnp
+
+    w = _R.rand(6, 3).astype(onp.float32)
+    g = onp.zeros_like(w)
+    g[2] = 0.5
+    g[4] = -0.25
+    f = get_op("sgd_update").fn
+    out = onp.asarray(f(jnp.asarray(w), jnp.asarray(g), lr=0.1, wd=0.9,
+                        lazy_update=True))
+    onp.testing.assert_allclose(out[0], w[0])       # untouched rows exact
+    onp.testing.assert_allclose(out[1], w[1])
+    assert not onp.allclose(out[2], w[2])
+    assert not onp.allclose(out[4], w[4])
+
+
+def test_group_adagrad_rowwise_history():
+    """group_adagrad accumulates PER-ROW mean-squared gradients
+    (reference contrib/optimizer_op-inl.h:99) — embedding-table shaped."""
+    from mxnet_tpu.ops.registry import get_op
+
+    import jax.numpy as jnp
+
+    w = _R.rand(4, 3).astype(onp.float32)
+    g = onp.zeros_like(w)
+    g[1] = 2.0
+    hist = onp.zeros(4, onp.float32)
+    new_w, new_h = get_op("group_adagrad_update").fn(
+        jnp.asarray(w), jnp.asarray(g), jnp.asarray(hist), lr=0.1)
+    new_w, new_h = onp.asarray(new_w), onp.asarray(new_h)
+    assert new_h[1] == pytest.approx(4.0)           # mean over the row
+    assert (new_h[[0, 2, 3]] == 0).all()
+    onp.testing.assert_allclose(new_w[0], w[0] - 0.1 * 0 /
+                                (onp.sqrt(0) + 1e-5))
+
+
+def test_csr_through_dgl_frontend():
+    """CSR containers densify into the graph ops' dense convention."""
+    dense = onp.zeros((4, 4), onp.float32)
+    dense[0, 1] = 1
+    dense[1, 2] = 2
+    dense[2, 3] = 3
+    c = sparse.csr_matrix(nd.array(dense))
+    adj = nd.dgl_adjacency(c.todense())
+    onp.testing.assert_array_equal(onp.asarray(adj.asnumpy()),
+                                   (dense != 0).astype(onp.float32))

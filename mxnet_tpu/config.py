@@ -172,8 +172,9 @@ declare("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
 # mxnet_tpu/jax import — the whole point of its probe phase is to not touch
 # the package until the device backend is known good — so they are declared
 # here for the generated docs; the post-import knobs go through config.get.
-declare("BENCH_MODEL", str, "resnet50_v1",
-        "bench.py model selection (resnet50_v1 | bert | <name>_int8)",
+declare("BENCH_MODEL", str, "all",
+        "bench.py lane selection: 'all' (every lane into one JSON line) "
+        "or one of <zoo-name>[_bf16|_int8] | bert",
         subsystem="bench")
 declare("BENCH_BATCH", int, None, "bench.py batch size override",
         subsystem="bench")
@@ -186,9 +187,17 @@ declare("BENCH_SEQ", int, 128, "bench.py BERT sequence length",
 declare("BENCH_ACCUM", int, 1,
         "bench.py BERT gradient-accumulation factor",
         validator=lambda v: v >= 1, subsystem="bench")
-declare("BENCH_TIMEOUT", float, 1500.0,
-        "bench.py watchdog: emit a failure JSON line after this many "
-        "seconds", subsystem="bench")
+declare("BENCH_TIMEOUT", float, 2700.0,
+        "bench.py watchdog (a separate process sharing stdout): emit the "
+        "completed lanes after this many seconds and kill the bench",
+        subsystem="bench")
+declare("BENCH_PROBE_RETRIES", int, 3,
+        "bench.py: device-probe attempts (120s recovery wait between) "
+        "before the CPU fallback", validator=lambda v: v >= 1,
+        subsystem="bench")
+declare("BENCH_PARTIAL_PATH", str, None,
+        "bench.py: override for the side file where completed lanes "
+        "persist for the watchdog process", subsystem="bench")
 declare("BENCH_PROBE_TIMEOUT", float, 240.0,
         "bench.py device-backend subprocess probe timeout (seconds)",
         subsystem="bench")

@@ -53,6 +53,8 @@ from .ops import ref_aliases as _ref_aliases  # noqa: E402
 
 _ref_aliases.apply()
 
+from .attribute import AttrScope  # noqa: E402  (reference mx.AttrScope)
+
 # subsystems imported lazily on attribute access to keep import light
 _LAZY = {
     "sym": ".symbol",
@@ -84,6 +86,10 @@ _LAZY = {
     "viz": ".visualization",
     "library": ".library",
     "config": ".config",
+    "operator": ".operator",
+    "name": ".name",
+    "attribute": ".attribute",
+    "dlpack": ".dlpack",
 }
 
 

@@ -1,0 +1,62 @@
+"""Attribute scoping for the symbolic API (reference
+``python/mxnet/attribute.py``): every Symbol node created inside a
+``with mx.AttrScope(...)`` block inherits the scope's string attributes
+(lr_mult, ctx_group, custom annotations) into its ``attr_dict``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["AttrScope", "current", "attr_scope_get"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.stack = []
+
+
+_STATE = _State()
+
+
+class AttrScope:
+    """Scoped symbol attributes; nested scopes merge, inner wins."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("Attributes need to be string")
+        self._attr: Dict[str, str] = dict(kwargs)
+
+    def get(self, attr: Optional[Dict[str, str]]) -> Dict[str, str]:
+        """Merge user attrs over the scope's (user wins, like the
+        reference)."""
+        if not self._attr:
+            return attr if attr else {}
+        ret = dict(self._attr)
+        if attr:
+            ret.update(attr)
+        return ret
+
+    def __enter__(self):
+        _STATE.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.stack.pop()
+
+
+def current() -> Optional[AttrScope]:
+    return _STATE.stack[-1] if _STATE.stack else None
+
+
+def attr_scope_get(attr: Optional[Dict[str, str]]) -> Dict[str, str]:
+    """The merged attrs of ALL active scopes (outer to inner), then user
+    attrs on top."""
+    ret: Dict[str, str] = {}
+    for scope in _STATE.stack:
+        ret.update(scope._attr)
+    if attr:
+        ret.update(attr)
+    return ret

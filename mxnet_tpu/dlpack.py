@@ -1,0 +1,65 @@
+"""DLPack interop (reference ``python/mxnet/dlpack.py``):
+zero-copy tensor exchange with torch/numpy/cupy/jax via the standard
+``__dlpack__`` protocol.
+
+TPU-native shape: an NDArray's buffer IS a jax.Array, which already
+speaks DLPack — these helpers adapt the reference's function names
+(``to_dlpack_for_read``/``to_dlpack_for_write``/``from_dlpack``) onto
+that protocol.  On-device buffers export device capsules; consumers that
+need host memory should ``asnumpy()`` first (same rule as the reference's
+GPU capsules).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .context import current_context
+from .ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack"]
+
+
+def to_dlpack_for_read(data: NDArray):
+    """NDArray -> DLPack capsule (read view).  The array is synced first
+    (reference MXNDArrayToDLPackForRead wait-to-read contract)."""
+    data.wait_to_read()
+    return data._data.__dlpack__()
+
+
+def to_dlpack_for_write(data: NDArray):
+    """XLA buffers are immutable: a 'write' capsule cannot alias the
+    source the way the reference's did.  Exporting a read capsule keeps
+    consumer code working; writes by the consumer produce THEIR copy
+    (functional semantics, documented deviation)."""
+    data.wait_to_read()
+    return data._data.__dlpack__()
+
+
+class _CapsuleHolder:
+    """Adapter: jax's ``from_dlpack`` requires the PROTOCOL (an object
+    with __dlpack__/__dlpack_device__) and rejects raw PyCapsules, but
+    the reference API hands capsules around.  A capsule carries no
+    device tag, so this assumes host-reachable memory (kDLCPU) — the
+    capsules this module's own to_dlpack_* produce on the CPU backend,
+    and any other framework's host capsules."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **_kw):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)          # (kDLCPU, 0)
+
+
+def from_dlpack(ext) -> NDArray:
+    """Any object speaking ``__dlpack__`` (torch tensor, numpy array,
+    jax array) OR a raw DLPack capsule (the reference's calling
+    convention) -> NDArray, zero-copy where the producer's memory space
+    allows."""
+    if type(ext).__name__ == "PyCapsule":
+        ext = _CapsuleHolder(ext)
+    arr = jnp.from_dlpack(ext)
+    return _wrap(arr, current_context())

@@ -1,0 +1,57 @@
+"""Symbol naming scopes (reference ``python/mxnet/name.py``):
+``NameManager`` auto-numbers hint-based names; ``Prefix`` prepends a
+scope prefix — ``with mx.name.Prefix('encoder_'):`` names every symbol
+created inside ``encoder_*``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.stack = []
+
+
+_STATE = _State()
+
+
+class NameManager:
+    """hint -> hint0, hint1, ... unless the user names the symbol."""
+
+    def __init__(self):
+        self._counter: Dict[str, int] = {}
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        if name:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return f"{hint}{n}"
+
+    def __enter__(self):
+        _STATE.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.stack.pop()
+
+
+class Prefix(NameManager):
+    """Auto-generated names carry the prefix (reference name.py Prefix)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        # reference Prefix.get prepends UNCONDITIONALLY, user names too
+        return self._prefix + super().get(name, hint)
+
+
+def current() -> Optional[NameManager]:
+    return _STATE.stack[-1] if _STATE.stack else None

@@ -204,6 +204,25 @@ def _contrib_getattr(name):
 
 contrib.__getattr__ = _contrib_getattr
 
+def to_dlpack_for_read(data):
+    from ..dlpack import to_dlpack_for_read as _f
+
+    return _f(data)
+
+
+def to_dlpack_for_write(data):
+    from ..dlpack import to_dlpack_for_write as _f
+
+    return _f(data)
+
+
+def from_dlpack(ext):
+    from ..dlpack import from_dlpack as _f
+
+    return _f(ext)
+
+
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "waitall", "save", "load", "concatenate", "random", "linalg",
-           "contrib", "invoke"]
+           "contrib", "invoke", "to_dlpack_for_read", "to_dlpack_for_write",
+           "from_dlpack"]

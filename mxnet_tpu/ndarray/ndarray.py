@@ -166,6 +166,26 @@ class NDArray:
     def wait_to_write(self):
         self.wait_to_read()
 
+    # standard DLPack protocol (reference dlpack.py exposes the
+    # to_dlpack_* helpers; the dunder makes torch.from_dlpack(nd) work)
+    def __dlpack__(self, **kwargs):
+        self.wait_to_read()
+        # forward the consumer's protocol args (stream sync etc.)
+        return self._data.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    def to_dlpack_for_read(self):
+        from ..dlpack import to_dlpack_for_read
+
+        return to_dlpack_for_read(self)
+
+    def to_dlpack_for_write(self):
+        from ..dlpack import to_dlpack_for_write
+
+        return to_dlpack_for_write(self)
+
     def asnumpy(self) -> onp.ndarray:
         self.wait_to_read()
         return onp.asarray(self._data)

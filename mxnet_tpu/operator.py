@@ -1,0 +1,182 @@
+"""Legacy python custom-operator API (reference
+``python/mxnet/operator.py``): subclass :class:`CustomOp` +
+:class:`CustomOpProp`, decorate the prop with ``@mx.operator.register``,
+invoke with ``mx.nd.Custom(..., op_type=name)`` — unchanged user code.
+
+TPU-native mechanics: the user's numpy-level ``forward``/``backward``
+run as HOST callbacks (``jax.pure_callback``), so a registered custom op
+works eagerly, under ``jit``/hybridize, and through autograd (a
+``jax.custom_vjp`` routes ``backward``).  This mirrors the reference,
+where CustomOp callbacks also ran python outside the engine's threads —
+slow by design, an escape hatch.  For compiled-speed custom ops, write a
+pure-JAX function and use ``mxnet_tpu.library.register_op`` instead.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as onp
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_PROPS: Dict[str, type] = {}
+
+
+class CustomOp:
+    """Base for the imperative operator body (reference operator.py:434)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """reference operator.py:471 — honor the write request."""
+        if req == "null":
+            return
+        src = onp.asarray(src)
+        if req in ("write", "inplace"):
+            dst[...] = src
+        elif req == "add":
+            dst[...] = dst + src
+        else:
+            raise ValueError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Shape/type/arity declarations (reference operator.py:487)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Decorator registering a CustomOpProp subclass under ``reg_name``
+    (reference operator.py:710).  Also registers a registry operator of
+    the same name, so both ``mx.nd.Custom(x, op_type=reg_name)`` and
+    direct by-name invocation work."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register needs a CustomOpProp subclass")
+        _PROPS[reg_name] = prop_cls
+
+        from .ops.registry import find_op
+        from .ops.registry import register as op_register
+
+        if find_op(reg_name) is None:
+            # resolve through _PROPS at CALL time so re-registration
+            # (notebook re-runs) takes effect; Custom itself consults
+            # _PROPS before the registry, so a builtin name collision
+            # still runs the USER's op through nd.Custom
+            def op_fn(arrays, **attrs):
+                return _invoke(_PROPS[reg_name], list(arrays), attrs)
+
+            op_fn.__name__ = reg_name
+            op_fn.__doc__ = (f"custom op '{reg_name}' via mx.operator "
+                             "(resolves the currently registered prop)")
+            op_register(reg_name, num_inputs=-1, num_outputs=-1,
+                        differentiable=True)(op_fn)
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered() -> Dict[str, type]:
+    return dict(_PROPS)
+
+
+def _invoke(prop_cls, arrays, attrs: Dict[str, Any]):
+    """Build the custom_vjp-wrapped host-callback invocation."""
+    import jax.numpy as jnp
+
+    # reference semantics: Custom's extra attrs arrive at the prop ctor
+    # as STRINGS; a ctor mismatch (typo'd kwarg) must ERROR, not fall
+    # back to defaults producing silently-wrong numerics
+    kwargs = {k: (v if isinstance(v, str) else str(v))
+              for k, v in attrs.items()}
+    prop = prop_cls(**kwargs)
+    n_out = len(prop.list_outputs())
+    in_shapes = [tuple(a.shape) for a in arrays]
+    shapes = prop.infer_shape(in_shapes)
+    out_shapes = [tuple(s) for s in shapes[1]]
+    types = prop.infer_type([a.dtype for a in arrays])
+    out_dtypes = [onp.dtype(t) for t in types[1]]
+    op = prop.create_operator(None, in_shapes,
+                              [a.dtype for a in arrays])
+
+    out_struct = tuple(jax.ShapeDtypeStruct(s, d)
+                       for s, d in zip(out_shapes, out_dtypes))
+    in_struct = tuple(jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                      for a in arrays)
+
+    # training mode captured at invoke/trace time (the reference reads it
+    # from the executor); aux buffers materialize per declared shapes.
+    # NOTE: aux mutations do NOT persist across calls — state lives with
+    # the caller in this functional runtime (documented deviation).
+    from . import autograd as _ag
+
+    is_train = bool(_ag.is_training()) if hasattr(_ag, "is_training") \
+        else bool(getattr(_ag, "is_recording", lambda: False)())
+    aux_shapes = [tuple(s) for s in (shapes[2] if len(shapes) > 2 else [])]
+    aux_dtypes = [onp.dtype(t) for t in (types[2] if len(types) > 2
+                                         else [])]
+
+    def _aux():
+        return [onp.zeros(s, d) for s, d in zip(aux_shapes, aux_dtypes)]
+
+    def fwd_host(*ins):
+        in_np = [onp.asarray(i) for i in ins]
+        outs = [onp.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)]
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_np, out_data=outs, aux=_aux())
+        return tuple(outs)
+
+    @jax.custom_vjp
+    def f(*ins):
+        return jax.pure_callback(fwd_host, out_struct, *ins)
+
+    def f_fwd(*ins):
+        outs = jax.pure_callback(fwd_host, out_struct, *ins)
+        return outs, (ins, outs)
+
+    def f_bwd(res, gouts):
+        ins, outs = res
+
+        def bwd_host(gouts, ins, outs):
+            grads = [onp.zeros(tuple(a.shape), a.dtype) for a in ins]
+            op.backward(req=["write"] * len(ins),
+                        out_grad=[onp.asarray(g) for g in gouts],
+                        in_data=[onp.asarray(i) for i in ins],
+                        out_data=[onp.asarray(o) for o in outs],
+                        in_grad=grads, aux=_aux())
+            return tuple(grads)
+
+        grads = jax.pure_callback(bwd_host, in_struct, gouts, ins, outs)
+        return tuple(grads)
+
+    f.defvjp(f_fwd, f_bwd)
+    out = f(*[jnp.asarray(a) for a in arrays])
+    return out if n_out > 1 else out[0]

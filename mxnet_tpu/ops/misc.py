@@ -261,17 +261,28 @@ def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
 # --------------------------------------------------------------------------
 
 @register("Custom", num_inputs=-1, num_outputs=-1)
-def custom(arrays, op_type=""):
+def custom(arrays, op_type="", **attrs):
+    """Dispatch by op_type (reference custom.cc): resolves ops registered
+    via mx.operator.register (legacy CustomOpProp API) or
+    library.register_op; extra attrs flow through to the target."""
     from .registry import find_op
 
+    # legacy CustomOpProp registrations take PRIORITY over same-named
+    # builtins (the reference keeps custom ops in their own registry)
+    from .. import operator as _custom_operator
+
+    prop_cls = _custom_operator.get_all_registered().get(op_type)
+    if prop_cls is not None:
+        return _custom_operator._invoke(prop_cls, list(arrays), attrs)
     schema = find_op(op_type)
     if schema is None:
         raise KeyError(
             f"Custom: no op '{op_type}' registered; register it with "
-            "mxnet_tpu.library.register_op (the MXLoadLib/CustomOp analog)")
+            "mx.operator.register (CustomOpProp API) or "
+            "mxnet_tpu.library.register_op")
     if schema.num_inputs == -1:
-        return schema.fn(list(arrays))
-    return schema.fn(*arrays)
+        return schema.fn(list(arrays), **attrs)
+    return schema.fn(*arrays, **attrs)
 
 
 # --------------------------------------------------------------------------

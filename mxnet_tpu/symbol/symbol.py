@@ -454,6 +454,15 @@ def var(name: str, shape=None, dtype=None, init=None, **kwargs) -> Symbol:
     """Create a symbolic variable (reference mx.sym.var)."""
     attrs = {}
     node = SymNode(None, name, attrs, [])
+    # AttrScope annotations apply to VARIABLES too (reference symbol.py
+    # var merges AttrScope._current.get — per-variable lr_mult/ctx_group
+    # is the primary use of the API); user kwargs win over scope attrs
+    from ..attribute import attr_scope_get
+
+    scoped = attr_scope_get(
+        {k: str(v) for k, v in kwargs.items()} if kwargs else None)
+    if scoped:
+        node.attr_dict.update(scoped)
     if shape is not None:
         node.attr_dict["__shape__"] = str(tuple(shape))
     if dtype is not None:
@@ -504,10 +513,22 @@ def _apply_op(op_name: str, inputs: List[Symbol], attrs: dict,
             raise ValueError(
                 f"op {op_name}: grouped symbol cannot be an input")
         in_entries.append(s._outputs[0])
-    name = name or _NAMES.get(schema.name.lower())
+    from .. import name as _name_mod
+
+    mgr = _name_mod.current()
+    if mgr is not None:
+        name = mgr.get(name, schema.name.lower())
+    else:
+        name = name or _NAMES.get(schema.name.lower())
     n_out = num_outputs if num_outputs is not None \
         else _resolve_num_outputs(schema, attrs)
     node = SymNode(schema.name, name, attrs, in_entries, n_out)
+    # AttrScope annotations land in attr_dict (reference attribute.py)
+    from ..attribute import attr_scope_get
+
+    scoped = attr_scope_get(None)
+    if scoped:
+        node.attr_dict.update(scoped)
     if n_out == 1:
         return Symbol([(node, 0)])
     return Symbol([(node, i) for i in range(n_out)])

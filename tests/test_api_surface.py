@@ -1,0 +1,244 @@
+"""Round-3 API-surface completion: DLPack interop, the legacy
+mx.operator CustomOp API, AttrScope, and name scopes (reference
+python/mxnet/{dlpack,operator,attribute,name}.py).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# ------------------------------------------------------------- dlpack ----
+
+def test_dlpack_torch_round_trip():
+    import torch
+
+    x = nd.array(onp.arange(6, dtype=onp.float32).reshape(2, 3))
+    t = torch.from_dlpack(x)                   # __dlpack__ protocol
+    onp.testing.assert_array_equal(t.numpy(), x.asnumpy())
+    back = nd.from_dlpack(torch.arange(4).float() * 2)
+    assert isinstance(back, nd.NDArray)
+    onp.testing.assert_array_equal(back.asnumpy(), [0, 2, 4, 6])
+
+
+def test_dlpack_reference_helper_names():
+    x = nd.array(onp.ones((3,), onp.float32))
+    cap = nd.to_dlpack_for_read(x)
+    assert "dltensor" in repr(cap).lower() or cap is not None
+    y = nd.from_dlpack(x)                      # self round trip
+    onp.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+    assert x.__dlpack_device__() is not None
+
+
+# ----------------------------------------------- mx.operator CustomOp ----
+
+@mx.operator.register("test_sq3")
+class _Sq3Prop(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["out"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class _Sq3(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] ** 3)
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad[0], req[0],
+                            3.0 * in_data[0] ** 2 * out_grad[0])
+
+        return _Sq3()
+
+
+def test_custom_op_forward_eager_and_via_Custom():
+    x = nd.array(onp.array([1.0, 2.0, 3.0], onp.float32))
+    out = nd.Custom(x, op_type="test_sq3")
+    onp.testing.assert_allclose(out.asnumpy(), [1, 8, 27])
+    # registry by-name invocation also works
+    out2 = nd.test_sq3(x)
+    onp.testing.assert_allclose(out2.asnumpy(), [1, 8, 27])
+
+
+def test_custom_op_backward_through_autograd():
+    from mxnet_tpu import autograd
+
+    x = nd.array(onp.array([1.0, 2.0], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sq3").sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 3 * onp.array([1, 4]),
+                                rtol=1e-6)
+
+
+def test_custom_op_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import get_op
+
+    fn = get_op("test_sq3").fn
+    jitted = jax.jit(lambda a: fn([a]))
+    out = onp.asarray(jitted(jnp.asarray([2.0, 3.0])))
+    onp.testing.assert_allclose(out, [8, 27])
+
+
+@mx.operator.register("test_addsub")
+class _AddSubProp(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["sum", "diff"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class _AddSub(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] + in_data[1])
+                self.assign(out_data[1], req[1], in_data[0] - in_data[1])
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad[0], req[0],
+                            out_grad[0] + out_grad[1])
+                self.assign(in_grad[1], req[1],
+                            out_grad[0] - out_grad[1])
+
+        return _AddSub()
+
+
+def test_custom_op_multi_input_output():
+    a = nd.array(onp.array([3.0, 4.0], onp.float32))
+    b = nd.array(onp.array([1.0, 2.0], onp.float32))
+    outs = nd.Custom(a, b, op_type="test_addsub")
+    onp.testing.assert_allclose(outs[0].asnumpy(), [4, 6])
+    onp.testing.assert_allclose(outs[1].asnumpy(), [2, 2])
+
+
+# ---------------------------------------------------- AttrScope / name ----
+
+def test_attr_scope_applies_to_variables():
+    import mxnet_tpu.symbol as S
+
+    with mx.AttrScope(lr_mult="0.1", ctx_group="g0"):
+        w = S.var("w", shape=(3,))
+    d = w._outputs[0][0].attr_dict
+    assert d.get("lr_mult") == "0.1" and d.get("ctx_group") == "g0"
+    assert d.get("__shape__") == "(3,)"
+
+
+def test_custom_op_reregistration_and_builtin_collision():
+    """Re-registering a name swaps the implementation at call time; a
+    builtin-colliding name still runs the USER's op through Custom."""
+
+    @mx.operator.register("test_swap")
+    class _V1(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 2)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 2)
+
+            return _Op()
+
+    x = nd.array(onp.array([1.0, 2.0], onp.float32))
+    onp.testing.assert_allclose(
+        nd.Custom(x, op_type="test_swap").asnumpy(), [2, 4])
+
+    @mx.operator.register("test_swap")
+    class _V2(_V1):
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 10)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 10)
+
+            return _Op()
+
+    onp.testing.assert_allclose(
+        nd.Custom(x, op_type="test_swap").asnumpy(), [10, 20])
+
+    # name colliding with a builtin: Custom runs the USER op
+    @mx.operator.register("relu")
+    class _FakeRelu(_V2):
+        pass
+
+    try:
+        onp.testing.assert_allclose(
+            nd.Custom(x, op_type="relu").asnumpy(), [10, 20])
+    finally:
+        mx.operator._PROPS.pop("relu", None)
+
+    # typo'd attr kwargs ERROR instead of silently using defaults
+    with pytest.raises(TypeError):
+        nd.Custom(x, op_type="test_swap", bogus_attr="1")
+
+
+def test_dlpack_capsule_round_trip():
+    """The reference calling convention: from_dlpack consumes the raw
+    capsule to_dlpack_for_read produced."""
+    x = nd.array(onp.arange(4, dtype=onp.float32))
+    y = nd.from_dlpack(nd.to_dlpack_for_read(x))
+    onp.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+
+
+def test_attr_scope_annotates_symbols():
+    import mxnet_tpu.symbol as S
+
+    x = S.var("x")
+    with mx.AttrScope(ctx_group="dev1", my_tag="t"):
+        y = S.relu(x)
+    z = S.relu(x)
+    ynode = y._outputs[0][0]
+    assert ynode.attr_dict.get("ctx_group") == "dev1"
+    assert ynode.attr_dict.get("my_tag") == "t"
+    assert "ctx_group" not in z._outputs[0][0].attr_dict
+    # nested scopes merge, inner wins
+    with mx.AttrScope(a="1"):
+        with mx.AttrScope(a="2", b="3"):
+            w = S.relu(x)
+    assert w._outputs[0][0].attr_dict["a"] == "2"
+    assert w._outputs[0][0].attr_dict["b"] == "3"
+    # AttrScope attrs must be strings (reference contract)
+    with pytest.raises(ValueError):
+        mx.AttrScope(bad=1)
+
+
+def test_name_manager_and_prefix():
+    import mxnet_tpu.symbol as S
+    from mxnet_tpu import name as name_mod
+
+    x = S.var("x")
+    with name_mod.NameManager():
+        a = S.relu(x)
+        b = S.relu(x)
+    assert a.name == "relu0" and b.name == "relu1"
+    with name_mod.Prefix("enc_"):
+        c = S.relu(x)
+        d = S.sigmoid(x)
+    assert c.name.startswith("enc_relu")
+    assert d.name.startswith("enc_sigmoid")
+    # Prefix prepends to USER names too (reference name.py Prefix.get)
+    with name_mod.Prefix("enc_"):
+        e = S.relu(x, name="myrelu")
+    assert e.name == "enc_myrelu"
+    # plain NameManager keeps user names untouched
+    with name_mod.NameManager():
+        f = S.relu(x, name="kept")
+    assert f.name == "kept"

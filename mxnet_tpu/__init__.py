@@ -87,6 +87,8 @@ _LAZY = {
     "library": ".library",
     "config": ".config",
     "operator": ".operator",
+    "error": ".error",
+    "log": ".log",
     "name": ".name",
     "attribute": ".attribute",
     "dlpack": ".dlpack",

@@ -1,0 +1,50 @@
+"""Typed error classes (reference ``python/mxnet/error.py``): a name ->
+exception-class registry used to rehydrate errors crossing the
+C/serialization boundary, plus :class:`InternalError`.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["MXNetError", "InternalError", "register_error", "register",
+           "ERROR_TYPE"]
+
+ERROR_TYPE = {}
+
+
+def register_error(name_or_cls=None, cls=None):
+    """Register an error class under its name (reference error.py
+    register_error) — decorator and call forms both work."""
+    if isinstance(name_or_cls, str):
+        if cls is not None:
+            ERROR_TYPE[name_or_cls] = cls
+            return cls
+
+        def deco(c):
+            ERROR_TYPE[name_or_cls] = c
+            return c
+
+        return deco
+    c = name_or_cls
+    ERROR_TYPE[c.__name__] = c
+    return c
+
+
+register = register_error
+
+
+@register_error
+class InternalError(MXNetError):
+    """Framework-internal invariant violation (reference error.py:31)."""
+
+
+for _name, _cls in [("ValueError", ValueError), ("TypeError", TypeError),
+                    ("AttributeError", AttributeError),
+                    ("IndexError", IndexError),
+                    ("NotImplementedError", NotImplementedError),
+                    ("IOError", IOError),
+                    ("FloatingPointError", FloatingPointError),
+                    ("RuntimeError", RuntimeError),
+                    ("KeyError", KeyError),
+                    ("MXNetError", MXNetError)]:
+    register_error(_name, _cls)

@@ -1,0 +1,58 @@
+"""Logging helpers (reference ``python/mxnet/log.py``): a configured
+logger factory with level-colored console output when attached to a tty.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR",
+           "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+_COLORS = {logging.DEBUG: "\x1b[32m", logging.INFO: "\x1b[34m",
+           logging.WARNING: "\x1b[33m", logging.ERROR: "\x1b[31m"}
+
+
+class _Formatter(logging.Formatter):
+    """Level-labeled (and tty-colored) record format, like the
+    reference's."""
+
+    def __init__(self, colored: bool):
+        super().__init__()
+        self._colored = colored
+
+    def format(self, record):
+        label = record.levelname[0]
+        if self._colored and record.levelno in _COLORS:
+            label = _COLORS[record.levelno] + label + "\x1b[0m"
+        self._style._fmt = f"{label} %(asctime)s %(process)d %(message)s"
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Configured logger (reference log.py get_logger): console by
+    default, file when ``filename`` given; idempotent per name."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxnet_tpu_init", False):
+        logger.setLevel(level)
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        colored = False
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        colored = getattr(sys.stderr, "isatty", lambda: False)()
+    handler.setFormatter(_Formatter(colored))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxnet_tpu_init = True
+    return logger
+
+
+getLogger = get_logger

@@ -242,3 +242,29 @@ def test_name_manager_and_prefix():
     with name_mod.NameManager():
         f = S.relu(x, name="kept")
     assert f.name == "kept"
+
+
+# ------------------------------------------------------ error / log ----
+
+def test_error_registry_and_internal_error():
+    assert mx.error.ERROR_TYPE["ValueError"] is ValueError
+    assert issubclass(mx.error.InternalError, mx.base.MXNetError)
+
+    @mx.error.register
+    class _MyErr(mx.base.MXNetError):
+        pass
+
+    assert mx.error.ERROR_TYPE["_MyErr"] is _MyErr
+    mx.error.ERROR_TYPE.pop("_MyErr", None)
+
+
+def test_log_get_logger(tmp_path):
+    p = str(tmp_path / "t.log")
+    lg = mx.log.get_logger("mxtpu_test_log", filename=p,
+                           level=mx.log.INFO)
+    lg.info("the-message")
+    lg2 = mx.log.get_logger("mxtpu_test_log")     # idempotent
+    assert lg2 is lg
+    for h in lg.handlers:
+        h.flush()
+    assert "the-message" in open(p).read()

@@ -22,16 +22,22 @@ MFU divides by the chip's matmul-unit peak (bf16 peak for fp32 too:
 TPU fp32 matmuls decompose onto the same bf16 MXU passes) — the
 ``mfu_basis`` field names the peak used.
 
-Hardening (round 2, kept): device backend probed in a SUBPROCESS with a
-timeout; model init + deferred-shape probe on host CPU; a watchdog emits
-whatever lanes completed even on a stall; progress on stderr, stdout is
-ONE parseable JSON line.  Tunnel discipline: warm with steps + a HOST
-VALUE READ, fence the timed region with another host read
-(block_until_ready exerts no backpressure until the queue drains once).
+Hardening (round 4): EVERY LANE RUNS IN ITS OWN SUBPROCESS.  The parent
+never imports jax, so a wedged tunnel can never hang the orchestrator;
+it probes the backend before each lane (not once up front), kills a lane
+that exceeds its budget, falls back to a small CPU lane with an honest
+``platform`` label, and — if the tunnel comes back mid-run — re-runs the
+CPU-fallback lanes on the device in a salvage pass.  A separate watchdog
+process remains as a backstop that emits completed lanes if the parent
+itself dies; a done-marker file prevents the double-emit race.  Progress
+on stderr, stdout is ONE parseable JSON line.  Tunnel discipline inside
+lanes: warm with steps + a HOST VALUE READ, fence the timed region with
+another host read (block_until_ready exerts no backpressure until the
+queue drains once).
 
 Env: BENCH_MODEL=all|resnet50_v1|resnet50_v1_bf16|bert|resnet50_v1_int8,
 BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_TIMEOUT, BENCH_PROBE_TIMEOUT,
-BENCH_CPU_FALLBACK.
+BENCH_LANE_TIMEOUT, BENCH_CPU_FALLBACK.
 """
 from __future__ import annotations
 
@@ -131,6 +137,10 @@ def _emit_final(error: str = "") -> None:
             payload["error"] = error[:400]
         payload["lanes"] = _LANES
         print(json.dumps(payload), flush=True)
+        try:   # stand the watchdog down: we own the stdout line now
+            open(_PARTIAL_PATH + ".done", "w").close()
+        except OSError:
+            pass
 
 
 _WATCHDOG_CODE = r"""
@@ -141,9 +151,22 @@ while time.time() < deadline:
         os.kill(parent, 0)
     except OSError:
         sys.exit(0)                      # parent finished normally
+    if os.path.exists(partial + ".done"):
+        sys.exit(0)                      # parent already emitted its line
     time.sleep(1.0)
-# deadline passed with the parent still running: emit whatever lanes it
-# persisted, on the SHARED stdout, then kill it
+# deadline passed with the parent still running.  Give it a short grace:
+# if it emits (done-marker appears) or exits, stand down — otherwise two
+# JSON lines would race on the shared stdout.
+for _ in range(10):
+    if os.path.exists(partial + ".done"):
+        sys.exit(0)
+    try:
+        os.kill(parent, 0)
+    except OSError:
+        sys.exit(0)
+    time.sleep(0.5)
+# emit whatever lanes the parent persisted, on the SHARED stdout, then
+# kill it
 lanes = []
 try:
     with open(partial) as f:
@@ -185,9 +208,9 @@ def _watchdog(timeout_s: float) -> None:
         _progress(f"watchdog spawn failed: {e}")
 
 
-def _probe_device_backend(timeout_s: float) -> bool:
+def _probe_device_backend(timeout_s: float) -> "tuple[bool, bool]":
     """Tiny matmul in a SUBPROCESS: a hung TPU tunnel times out the probe
-    instead of hanging this process."""
+    instead of hanging this process.  Returns (probe_ok, backend_is_cpu)."""
     code = ("import jax, jax.numpy as jnp; "
             "x = jnp.ones((256, 256)); "
             "v = float((x @ x)[0, 0]); "
@@ -253,7 +276,7 @@ def lane_train(on_cpu: bool, bf16: bool,
     _progress(f"{tag}: compiling whole-graph train step")
     tr.step(data, label)          # compile + sync
     _progress(f"{tag}: compiled; warming")
-    for _ in range(3):
+    for _ in range(2):
         loss = tr.step(data, label, sync=False)
     float(loss.asnumpy() if hasattr(loss, "asnumpy") else loss)
     _progress(f"{tag}: timing {steps} steps")
@@ -266,15 +289,25 @@ def lane_train(on_cpu: bool, bf16: bool,
     _progress(f"{tag}: {imgs_per_sec:.2f} img/s "
               f"(final loss {loss_val:.3f})")
     suffix = "_bf16" if bf16 else ""
+    # the FLOP model and the V100 anchor are ResNet-50 numbers: any other
+    # zoo model reports 0.0/None rather than a wrong ratio (same policy
+    # as lane_int8)
+    is_r50 = model_name == "resnet50_v1"
     lane = {
         "metric": f"{model_name}{suffix}_train_throughput_per_chip",
         "value": round(imgs_per_sec, 2),
         "unit": "img/s",
         "vs_baseline": round(imgs_per_sec
-                             / V100_RESNET50_TRAIN_IMGS_PER_SEC, 3),
+                             / V100_RESNET50_TRAIN_IMGS_PER_SEC, 3)
+        if is_r50 else 0.0,
         "batch": batch,
         "platform": jax.default_backend(),
     }
+    if not is_r50:
+        lane["achieved_tflops"] = None
+        lane["mfu"] = None
+        lane["mfu_basis"] = f"no FLOP model for {model_name}"
+        return lane
     return _with_mfu(lane, RESNET50_TRAIN_FLOPS_PER_IMG, "bf16")
 
 
@@ -423,68 +456,225 @@ def _resolve_lane(name):
             f"{name}_train_throughput_per_chip")
 
 
-# bf16 first: it is the headline; a timeout then still records it
+# Ordering: bf16 resnet first (the headline AND the cheapest real-model
+# compile — its XLA program also warms the compile cache for fp32); int8
+# last (longest end-to-end: calibration + conversion + compile).
 LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "resnet50_v1_int8"]
+
+# generous-but-bounded per-lane wall budgets (seconds) on the device;
+# CPU-fallback lanes use small sizes and get one flat budget.
+# BENCH_LANE_TIMEOUT overrides every device-lane budget.
+_LANE_BUDGET = {"resnet50_v1_bf16": 600.0, "resnet50_v1": 600.0,
+                "bert": 540.0, "resnet50_v1_int8": 780.0}
+_CPU_LANE_BUDGET = 420.0
+
+
+def _lane_budget(name: str) -> float:
+    override = os.environ.get("BENCH_LANE_TIMEOUT")
+    if override:
+        try:
+            return float(override)
+        except ValueError:
+            pass
+    return _LANE_BUDGET.get(name, 600.0)
+
+
+def _run_lane_child(name: str) -> None:
+    """Child mode (``bench.py --lane NAME``): run ONE lane in this process
+    and print its lane dict as the only stdout line.  Lane sizes follow
+    the backend jax actually resolved (the parent forces CPU by setting
+    JAX_PLATFORMS=cpu in our env).  EVERYTHING — including the jax import
+    — stays inside the try: an escape to the __main__ handler would emit
+    the orchestrator-shaped payload on our stdout, which the parent would
+    record as the lane result under the wrong metric."""
+    try:
+        _, metric = _resolve_lane(name)
+    except Exception:
+        metric = f"{name}_train_throughput_per_chip"
+    unit = "tokens/s" if name == "bert" else "img/s"
+    try:
+        import jax
+
+        on_cpu = jax.default_backend() == "cpu"
+        fn, metric = _resolve_lane(name)
+        lane = fn(on_cpu)
+    except BaseException:
+        tb = traceback.format_exc()
+        _progress(f"lane {name} FAILED:\n" + tb)
+        lane = {"metric": metric, "value": 0.0, "unit": unit,
+                "vs_baseline": 0.0,
+                "error": tb.strip().splitlines()[-1][:400]}
+        print(json.dumps(lane), flush=True)
+        os._exit(1)                      # never reach the __main__ handler
+    print(json.dumps(lane), flush=True)
+    os._exit(0)
+
+
+def _spawn_lane(name: str, force_cpu: bool, budget: float,
+                metric: str) -> dict:
+    """Run one lane in a subprocess with a hard wall budget; returns its
+    lane dict (or an error lane on timeout/crash)."""
+    env = dict(os.environ)
+    if force_cpu:
+        # JAX_PLATFORMS=cpu alone is NOT enough: the axon sitecustomize
+        # (gated on PALLAS_AXON_POOL_IPS) force-sets jax_platforms back
+        # to the tunnel backend at interpreter start, and with a wedged
+        # tunnel even a "cpu" child then hangs in backend init
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    # the child must never touch the parent's partial file or its .done
+    # watchdog stand-down marker
+    env.pop("BENCH_PARTIAL_PATH", None)
+    unit = "tokens/s" if name == "bert" else "img/s"
+    _progress(f"lane {name}: spawning ({'cpu' if force_cpu else 'device'}, "
+              f"budget {budget:.0f}s)")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--lane", name],
+            env=env, capture_output=True, text=True, timeout=budget)
+    except subprocess.TimeoutExpired as e:
+        if e.stderr:      # the stall point (compile? warm? timed loop?)
+            err = e.stderr
+            sys.stderr.write(err.decode("utf-8", "replace")
+                             if isinstance(err, bytes) else err)
+        _progress(f"lane {name}: KILLED after {budget:.0f}s budget")
+        return {"metric": metric, "value": 0.0, "unit": unit,
+                "vs_baseline": 0.0,
+                "error": f"lane exceeded {budget:.0f}s budget"}
+    sys.stderr.write(r.stderr)           # lane progress, verbatim
+    for line in reversed(r.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    _progress(f"lane {name}: no JSON on child stdout (rc={r.returncode})")
+    return {"metric": metric, "value": 0.0, "unit": unit,
+            "vs_baseline": 0.0,
+            "error": f"lane subprocess rc={r.returncode}, no result line"}
+
+
+def _record(lane: dict) -> None:
+    _LANES.append(lane)
+    with open(_PARTIAL_PATH, "a") as f:       # the watchdog's view
+        f.write(json.dumps(lane) + "\n")
 
 
 def main():
+    if "--lane" in sys.argv:
+        _run_lane_child(sys.argv[sys.argv.index("--lane") + 1])
+        return
+
     timeout_s = float(os.environ.get("BENCH_TIMEOUT", "2700"))
+    deadline = _T0 + timeout_s
     _watchdog(timeout_s)
 
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
-    retries = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
-    device_ok = on_cpu = False
-    for attempt in range(max(retries, 1)):
-        device_ok, on_cpu = _probe_device_backend(probe_timeout)
-        if device_ok:
-            break
-        if attempt + 1 < retries:
-            # a wedged tunnel often recovers within minutes; a CPU-
-            # fallback artifact is near-worthless next to waiting
-            _progress(f"probe attempt {attempt + 1}/{retries} failed; "
-                      "waiting 120s for tunnel recovery")
-            time.sleep(120)
-    if on_cpu:
-        _progress("default backend IS cpu: using small lane sizes")
-    if not device_ok:
-        fallback = os.environ.get("BENCH_CPU_FALLBACK", "1").strip().lower()
-        if fallback not in ("1", "true", "yes", "on"):
-            _LANES.append({
-                "metric": "resnet50_v1_train_throughput_per_chip",
-                "value": 0.0, "unit": "img/s", "vs_baseline": 0.0,
-                "error": "device backend unreachable and CPU fallback "
-                         "disabled"})
-            _emit_final()
-            sys.exit(1)
-        _progress("falling back to host CPU backend")
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        on_cpu = True
-
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    cpu_fallback = os.environ.get(
+        "BENCH_CPU_FALLBACK", "1").strip().lower() in ("1", "true", "yes",
+                                                       "on")
     model = os.environ.get("BENCH_MODEL", "all")
     selected = LANE_ORDER if model == "all" else [model]
+
+    # The parent NEVER imports jax: probing and lane execution live in
+    # subprocesses, so a wedged tunnel can only ever cost a bounded probe
+    # or lane budget, never the orchestrator.
     failed = 0
-    for name in selected:
+    for i, name in enumerate(selected):
         fn, metric = _resolve_lane(name)
-        try:
-            lane = fn(on_cpu)
-            _LANES.append(lane)
-            with open(_PARTIAL_PATH, "a") as f:   # watchdog's view
-                f.write(json.dumps(lane) + "\n")
-        except Exception:
+        remaining = deadline - time.time() - 90.0     # margin for emit
+        if remaining < 120.0:
+            _progress(f"lane {name}: skipped ({remaining:.0f}s left)")
+            _record({"metric": metric, "value": 0.0,
+                     "unit": "tokens/s" if name == "bert" else "img/s",
+                     "vs_baseline": 0.0,
+                     "error": "window exhausted before lane started"})
             failed += 1
-            tb = traceback.format_exc()
-            _progress(f"lane {name} FAILED:\n" + tb)
-            unit = ("tokens/s" if name == "bert" else "img/s")
-            _LANES.append({
-                "metric": metric, "value": 0.0, "unit": unit,
-                "vs_baseline": 0.0,
-                "error": tb.strip().splitlines()[-1][:400]})
+            continue
+        # re-probe before EVERY lane: a tunnel that died mid-run stops
+        # costing us, a tunnel that recovered mid-run gets used
+        pt = min(probe_timeout, max(remaining / 4, 30.0))
+        device_up, on_cpu = _probe_device_backend(pt)
+        # the probe itself may have burned up to `pt` seconds — recompute,
+        # or the last lane can overshoot the deadline into the watchdog
+        remaining = deadline - time.time() - 90.0
+        if device_up and not on_cpu:
+            budget = min(_lane_budget(name), remaining)
+            lane = _spawn_lane(name, False, budget, metric)
+            if lane.get("value", 0) <= 0 and cpu_fallback and \
+                    deadline - time.time() - 90.0 > 180.0:
+                _progress(f"lane {name}: device run failed; CPU fallback")
+                lane = _spawn_lane(name, True,
+                                   min(_CPU_LANE_BUDGET,
+                                       deadline - time.time() - 90.0),
+                                   metric)
+        elif cpu_fallback:
+            if device_up and on_cpu:
+                _progress(f"lane {name}: default backend IS cpu")
+            else:
+                _progress(f"lane {name}: device unreachable; honest CPU "
+                          "fallback lane")
+            budget = min(_CPU_LANE_BUDGET, remaining)
+            lane = _spawn_lane(name, True, budget, metric)
+        else:
+            lane = {"metric": metric, "value": 0.0,
+                    "unit": "tokens/s" if name == "bert" else "img/s",
+                    "vs_baseline": 0.0,
+                    "error": "device backend unreachable and CPU fallback "
+                             "disabled"}
+        _record(lane)
+        if lane.get("value", 0) <= 0:
+            failed += 1
+
+    # Salvage pass: lanes that fell back to CPU while the tunnel was down
+    # get ONE device retry each if the tunnel is back and time remains.
+    retry = [(i, lane) for i, lane in enumerate(_LANES)
+             if lane.get("platform") == "cpu" and lane.get("value", 0) > 0]
+    if retry and deadline - time.time() - 90.0 > 240.0:
+        device_up, on_cpu = _probe_device_backend(
+            min(probe_timeout, 60.0))
+        if device_up and not on_cpu:
+            _progress(f"salvage pass: tunnel is back, re-running "
+                      f"{len(retry)} CPU lanes on the device")
+            for i, old in retry:
+                remaining = deadline - time.time() - 90.0
+                if remaining < 180.0:
+                    break
+                name = _metric_to_lane(old.get("metric", ""))
+                if name is None:
+                    continue
+                _, metric = _resolve_lane(name)
+                lane = _spawn_lane(name, False,
+                                   min(_lane_budget(name), remaining),
+                                   metric)
+                if lane.get("value", 0) > 0 and \
+                        lane.get("platform") != "cpu":
+                    _LANES[i] = lane
+                    # REWRITE the partial file: appending would leave the
+                    # superseded CPU lane in the watchdog's view (and a
+                    # watchdog emit would then headline the stale number)
+                    with open(_PARTIAL_PATH, "w") as f:
+                        for ln in _LANES:
+                            f.write(json.dumps(ln) + "\n")
+
     _emit_final()
     if failed:
         sys.exit(1)
+
+
+def _metric_to_lane(metric: str):
+    """Invert _resolve_lane's metric naming for the salvage pass."""
+    if metric == "bert_base_train_throughput_per_chip":
+        return "bert"
+    for suffix, lane_sfx in (("_int8_infer_throughput_per_chip", "_int8"),
+                             ("_bf16_train_throughput_per_chip", "_bf16"),
+                             ("_train_throughput_per_chip", "")):
+        if metric.endswith(suffix):
+            return metric[: -len(suffix)] + lane_sfx
+    return None
 
 
 if __name__ == "__main__":

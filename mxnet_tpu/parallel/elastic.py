@@ -104,16 +104,31 @@ class CheckpointManager:
                 hosts.add(int(m.group(1) or 0))
         return hosts
 
+    def _meta_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt-{step}.meta")
+
+    def _saved_world(self, step: int) -> int:
+        """World size recorded WHEN the step was saved.  After an elastic
+        restart with more hosts, comparing against the *current*
+        ``process_count`` would leave every old step forever 'incomplete'
+        (and GC would then never delete anything).  Falls back to the
+        current world for legacy checkpoints without a meta file."""
+        try:
+            with open(self._meta_path(step)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return jax.process_count()
+
     def complete_steps(self) -> List[int]:
-        """Steps whose per-host files exist for EVERY process.  Hosts save
-        asynchronously, so a crash can leave the newest step with only some
-        hosts' files; restoring it would raise on the lagging hosts or let
-        hosts silently resume from different steps.  Restore therefore
-        intersects across hosts and only offers steps every host finished.
+        """Steps whose per-host files exist for every process OF THE WORLD
+        THAT SAVED THEM.  Hosts save asynchronously, so a crash can leave
+        the newest step with only some hosts' files; restoring it would
+        raise on the lagging hosts or let hosts silently resume from
+        different steps.  Restore therefore intersects across hosts and
+        only offers steps every saving host finished.
         """
-        n = jax.process_count()
         return [s for s in self.all_steps()
-                if len(self._present_hosts(s)) >= n]
+                if len(self._present_hosts(s)) >= self._saved_world(s)]
 
     def latest_step(self) -> Optional[int]:
         """Newest step complete on every host (the only safe restore
@@ -158,6 +173,12 @@ class CheckpointManager:
         with open(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)
+        # record the saving world size (every host writes identical
+        # content; atomic replace makes the race harmless)
+        meta_tmp = f"{self._meta_path(step)}.{os.getpid()}.tmp"
+        with open(meta_tmp, "w") as f:
+            f.write(str(jax.process_count()))
+        os.replace(meta_tmp, self._meta_path(step))
         self._gc()
 
     def _gc(self) -> None:
@@ -172,7 +193,8 @@ class CheckpointManager:
             if s in protected or s > newest:
                 continue
             for f in os.listdir(self.directory):
-                if re.match(rf"ckpt-{s}(?:-h\d+)?\.pkl$", f):
+                if re.match(rf"ckpt-{s}(?:-h\d+)?\.pkl$", f) or \
+                        f == f"ckpt-{s}.meta":
                     try:
                         os.remove(os.path.join(self.directory, f))
                     except OSError:
@@ -187,15 +209,53 @@ class CheckpointManager:
             raise RuntimeError(f"async checkpoint failed: {err}")
 
     # -- restore --------------------------------------------------------
+    def _step_files(self, step: int) -> List[str]:
+        """Files for ``step`` from hosts INSIDE the world that saved it.
+        A crashed larger-world incarnation of the same step number can
+        leave stale ``-h<big>`` files behind (GC protects the whole step);
+        merging those would overwrite fresh rows with pre-crash values."""
+        pat = re.compile(rf"ckpt-{step}(?:-h(\d+))?\.pkl$")
+        world = self._saved_world(step)
+        out = []
+        for f in os.listdir(self.directory):
+            m = pat.match(f)
+            if m and int(m.group(1) or 0) < world:
+                out.append(os.path.join(self.directory, f))
+        return sorted(out)
+
     def restore(self, step: Optional[int] = None, like: Any = None):
         """Load a checkpoint (latest by default).  With ``like`` (a pytree
         of arrays carrying shardings), sharded leaves are re-placed with
-        their original sharding via ``jax.device_put``."""
+        their original sharding via ``jax.device_put``.
+
+        Sharded ("shards") leaves are assembled from EVERY saving host's
+        file, not just this host's: after an elastic restart the world may
+        have grown, and a newly added host has no file of its own — it
+        must still be able to reconstruct the full array (``device_put``
+        then keeps only its addressable region under the new sharding).
+        """
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        with open(self._path(step), "rb") as f:
+        paths = self._step_files(step)
+        if not paths:
+            raise FileNotFoundError(
+                f"no files for step {step} in {self.directory}")
+        own = self._path(step)
+        primary = own if own in paths else paths[0]
+        with open(primary, "rb") as f:
             treedef, host_leaves = pickle.load(f)
+        # merge shard payloads from the other saving hosts' files
+        needs_merge = any(kind == "shards" for (kind, _s, _d) in host_leaves)
+        if needs_merge:
+            for p in paths:
+                if p == primary:
+                    continue
+                with open(p, "rb") as f:
+                    _td, other = pickle.load(f)
+                for mine, theirs in zip(host_leaves, other):
+                    if mine[0] == "shards" and theirs[0] == "shards":
+                        mine[2].extend(theirs[2])
         like_leaves = (jax.tree_util.tree_flatten(like)[0]
                        if like is not None else [None] * len(host_leaves))
         leaves = []

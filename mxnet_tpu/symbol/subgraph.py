@@ -355,6 +355,10 @@ class ConvBNReLUProperty(SubgraphProperty):
                        if n.op != "BatchNorm")
         if conv is None or bn is None or len(bn.inputs) != 5:
             return None
+        # Decline (don't crash) when the conv was built without an explicit
+        # weight variable — this frontend does not auto-create weight vars.
+        if len(conv.inputs) < 2:
+            return None
         stat_names = [s.name for (s, _i) in bn.inputs[1:]]
         w_name = conv.inputs[1][0].name
         needed = stat_names + [w_name]

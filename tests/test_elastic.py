@@ -70,6 +70,68 @@ def test_restore_skips_partial_multihost_step(tmp_path, monkeypatch):
     mgr.close()
 
 
+def test_complete_steps_use_saving_world_size(tmp_path, monkeypatch):
+    """Checkpoints record the world size that SAVED them: after an elastic
+    restart with more hosts, old steps must stay restorable and GC must
+    keep deleting (comparing against the current process_count would mark
+    every old step incomplete forever)."""
+    mgr = _mgr(tmp_path, keep=2, async_save=False)
+    tree = {"w": jnp.arange(4.0)}
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    for s in (1, 2):
+        for h in (0, 1):
+            monkeypatch.setattr(jax, "process_index", lambda h=h: h)
+            mgr.save(s, tree)
+
+    # elastic restart: world grows 2 -> 3.  The NEW host (index 2, which
+    # has no file of its own) must also be able to restore.
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+    assert mgr.complete_steps() == [1, 2]     # judged vs saving world (2)
+    assert mgr.latest_step() == 2
+    out, step = mgr.restore()
+    assert step == 2
+    onp.testing.assert_array_equal(out["w"], onp.arange(4.0))
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+
+    # GC still works: a new complete step under the new world evicts the
+    # oldest (keep=2 retains {2, 3}); before the fix nothing was ever
+    # deleted because no step looked complete to the 3-host world
+    for h in (0, 1, 2):
+        monkeypatch.setattr(jax, "process_index", lambda h=h: h)
+        mgr.save(3, tree)
+    assert mgr.complete_steps() == [2, 3]
+    assert mgr.all_steps() == [2, 3]
+    assert not os.path.exists(mgr._meta_path(1))   # meta GC'd with the step
+    mgr.close()
+
+
+def test_restore_merges_shards_across_host_files(tmp_path):
+    """Non-fully-addressable leaves are saved as per-host shard lists;
+    restore must assemble the FULL array from every saving host's file
+    (a host restoring after an elastic resize may own different — or no —
+    rows than the host that saved them)."""
+    import pickle
+
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    treedef = jax.tree_util.tree_structure({"w": 0})
+    # host 0 saved rows 0..1, host 1 saved rows 2..3 of a (4, 2) array
+    full = onp.arange(8.0, dtype=onp.float32).reshape(4, 2)
+    for h, rows in ((0, slice(0, 2)), (1, slice(2, 4))):
+        leaves = [("shards", (4, 2), [((rows, slice(None)), full[rows])])]
+        with open(d / f"ckpt-3-h{h}.pkl", "wb") as f:
+            pickle.dump((treedef, leaves), f)
+    (d / "ckpt-3.meta").write_text("2")
+
+    mgr = CheckpointManager(str(d), async_save=False)
+    out, step = mgr.restore()
+    assert step == 3
+    onp.testing.assert_array_equal(out["w"], full)
+    mgr.close()
+
+
 def test_checkpoint_async_write_then_restore(tmp_path):
     mgr = _mgr(tmp_path, keep=3, async_save=True)
     tree = {"w": jnp.full((3, 3), 2.5)}

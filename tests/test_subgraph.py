@@ -155,3 +155,22 @@ def test_register_backend_accepts_property():
     assert ops == ["Convolution"]
     onp.testing.assert_allclose(_eval(new_sym, new_params, x),
                                 _eval(sym, params, x), rtol=2e-4, atol=2e-4)
+
+
+def test_weightless_conv_declines_instead_of_crashing():
+    """A Convolution node built without an explicit weight variable (this
+    frontend does not auto-create weight vars) must make the property
+    DECLINE the match, not crash optimize_for with IndexError."""
+    x = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data=x, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                           no_bias=True)
+    g, b = mx.sym.Variable("g"), mx.sym.Variable("b")
+    m, v = mx.sym.Variable("m"), mx.sym.Variable("v")
+    bn = mx.sym.BatchNorm(data=c, gamma=g, beta=b, moving_mean=m,
+                          moving_var=v)
+    r = mx.sym.relu(bn)
+    params = {k: onp.ones(4, onp.float32) for k in ("g", "b", "m", "v")}
+    new_sym, _ = r.optimize_for(ConvBNReLUProperty(), params)
+    # nothing fused: the original op sequence survives
+    ops = _opcount(new_sym)
+    assert ops.get("BatchNorm", 0) == 1

@@ -148,6 +148,11 @@ declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
 declare("MXNET_ENFORCE_DETERMINISM", bool, False,
         "Disable nondeterministic optimizations (XLA autotuning picks "
         "deterministic kernels)", subsystem="engine")
+declare("MXNET_BN_TWO_PASS_VAR", bool, False,
+        "BatchNorm batch variance via the two-pass shifted formula instead "
+        "of the single-pass E[x^2]-E[x]^2 TPU default (one extra HBM pass; "
+        "use when activation |mean| >> std makes the single-pass cancel)",
+        subsystem="operator")
 declare("MXNET_MODULE_SEED", int, None,
         "Override the per-test RNG seed for reproduction (reference test "
         "harness contract)", subsystem="testing")

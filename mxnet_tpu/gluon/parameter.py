@@ -42,6 +42,14 @@ class Parameter:
     """A settable, differentiable tensor held by Blocks.
 
     Reference: ``python/mxnet/gluon/parameter.py`` class Parameter.
+
+    Sparse note: ``stype='row_sparse'`` (sparse *storage*) is rejected —
+    TPU HBM + XLA gather/scatter make dense rows the fast path — but
+    ``grad_stype='row_sparse'`` is accepted: the gradient is *computed*
+    densely (XLA scatter-add produces the same values the reference's
+    row-sparse gradient holds), and sparse-aware consumers
+    (``KVStore.row_sparse_pull``, ``ops.optimizer`` lazy_update row-skip)
+    still see reference semantics.
     """
 
     def __init__(

@@ -29,6 +29,7 @@ import numpy as onp
 
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _wrap
+from ..ndarray.sparse import RowSparseNDArray
 from .base import KVStoreBase
 
 __all__ = ["KVStore"]
@@ -125,10 +126,19 @@ class KVStore(KVStoreBase):
         return str(key)
 
     def init(self, key, value):
-        """Initialize (key, value) pairs (reference kvstore.py init)."""
+        """Initialize (key, value) pairs (reference kvstore.py init).
+
+        RowSparseNDArray values are densified on entry: the TPU store is
+        dense-backed (HBM + XLA gather/scatter make dense rows the fast
+        path), with ``row_sparse_pull`` preserving the sparse-pull API.
+        """
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
-            self._data[k] = v[0].copy()
+            first = v[0]
+            if isinstance(first, RowSparseNDArray):
+                self._data[k] = first.todense()
+            else:
+                self._data[k] = first.copy()
 
     def _normalize(self, key, value):
         if isinstance(key, (list, tuple)):
@@ -144,7 +154,10 @@ class KVStore(KVStoreBase):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k not in self._data:
-                self._data[k] = v[0].copy()
+                first = v[0]
+                self._data[k] = (first.todense()
+                                 if isinstance(first, RowSparseNDArray)
+                                 else first.copy())
         self.pull(key, out=out, priority=priority)
 
     def _is_dist(self) -> bool:
@@ -199,7 +212,10 @@ class KVStore(KVStoreBase):
                 return
             k, v = item
             try:
-                self._apply_merged(k, self._reduce(k, v), v[0].ctx)
+                if isinstance(v[0], RowSparseNDArray):
+                    self._push_row_sparse(k, v)
+                else:
+                    self._apply_merged(k, self._reduce(k, v), v[0].ctx)
             except BaseException as e:          # surfaced at next sync
                 self._async_err.append(e)
             finally:
@@ -238,9 +254,19 @@ class KVStore(KVStoreBase):
             for k, v in zip(keys, values):
                 # snapshot the immutable jax buffers NOW — the caller may
                 # overwrite its NDArrays (grad[:]=0) before the worker
-                # thread dequeues
-                snap = [_wrap(x._data, x.ctx) for x in v]
+                # thread dequeues; RowSparseNDArrays re-wrap their (data,
+                # indices) buffers for the same reason
+                snap = [RowSparseNDArray(x.data, x.indices, x.shape, x.ctx)
+                        if isinstance(x, RowSparseNDArray)
+                        else _wrap(x._data, x.ctx) for x in v]
                 self._async_q.put((k, snap))
+            return
+        if any(isinstance(v[0], RowSparseNDArray) for v in values):
+            for k, v in zip(keys, values):
+                if isinstance(v[0], RowSparseNDArray):
+                    self._push_row_sparse(k, v)
+                else:
+                    self._apply_merged(k, self._reduce(k, v), v[0].ctx)
             return
         if (len(keys) > 1 and self._is_dist()
                 and self._compression is None and self._updater is None):
@@ -248,6 +274,82 @@ class KVStore(KVStoreBase):
             return
         for k, v in zip(keys, values):
             self._apply_merged(k, self._reduce(k, v), v[0].ctx)
+
+    def _push_row_sparse(self, k: str, value_list) -> None:
+        """Sparse push: replica reduce = index concat + ``compact()`` (the
+        reference's row-sparse merge, ``src/kvstore/comm.h`` sparse branch
+        of CommCPU::Reduce).  Only the touched rows are materialized until
+        the final apply; dist stores ship the DENSE merged gradient over
+        the collective (documented trade-off: XLA collectives are dense —
+        the reference's ``EncodeRowSparseKey`` wire format has no ICI
+        analog, and embedding-gradient rows are a minority of step time).
+        """
+        merged = value_list[0]
+        for v in value_list[1:]:
+            merged = merged + v                 # O(nnz) index/data concat
+        merged = merged.compact()
+        ctx = merged.ctx
+        dense = merged.todense()._data
+        if self._is_dist():
+            dense = _cross_process_sum(dense)
+        if self._updater is not None:
+            # dense-apply: rows outside ``indices`` carry zero gradient, so
+            # plain sgd leaves them untouched; decoupled-wd optimizers decay
+            # every row (the documented dense semantics of this backend)
+            if k not in self._data:
+                self._data[k] = _wrap(jnp.zeros_like(dense), ctx)
+            self._updater(_key_int(k), _wrap(dense, ctx), self._data[k])
+        else:
+            self._data[k] = _wrap(dense, ctx)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in ``row_ids`` (reference
+        ``python/mxnet/kvstore/kvstore.py:420``).  The store's value is
+        dense in HBM; this gathers the requested rows on-device and writes
+        ``RowSparseNDArray`` outs (dense outs receive a masked dense copy:
+        requested rows live, others zero).  ``row_ids`` may be one array
+        shared by every out, or a list matching ``out`` one-to-one.
+        """
+        self._drain_async()
+        if out is None:
+            raise ValueError("row_sparse_pull requires out=")
+        if row_ids is None:
+            raise ValueError("row_sparse_pull requires row_ids=")
+        # flatten to one (key, out, row_ids) triple per destination: a
+        # row_ids LIST matches the out list one-to-one even for a single
+        # key; a single row_ids array is shared by every out
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        if isinstance(key, (list, tuple)):
+            keys = [self._str_key(k) for k in key]
+            if len(keys) != len(outs):
+                raise ValueError("key list and out list lengths differ")
+        else:
+            keys = [self._str_key(key)] * len(outs)
+        if isinstance(row_ids, (list, tuple)):
+            rids = list(row_ids)
+            if len(rids) != len(outs):
+                raise ValueError("row_ids list must match out one-to-one")
+        else:
+            rids = [row_ids] * len(outs)
+        for k, d, rid in zip(keys, outs, rids):
+            if k not in self._data:
+                raise KeyError(f"key {k} has not been initialized in KVStore")
+            src = self._data[k]._data
+            ids = jnp.asarray(
+                rid._data if isinstance(rid, NDArray) else rid,
+                jnp.int32).reshape(-1)
+            ids = jnp.unique(ids)               # reference sorts + dedups
+            rows = jnp.take(src, ids, axis=0)
+            if isinstance(d, RowSparseNDArray):
+                dev = (d.data.devices().pop()
+                       if isinstance(d.data, jax.Array) else None)
+                d.data = (jax.device_put(rows, dev) if dev else rows)
+                d.indices = (jax.device_put(ids, dev) if dev else ids)
+            else:
+                masked = jnp.zeros_like(src).at[ids].set(rows)
+                d._set_data(jax.device_put(
+                    masked, d._data.devices().pop()).astype(
+                        d._data.dtype))
 
     def _push_bucketed(self, keys, values):
         """Fuse many keys into flat cross-process sums.  Arrays above

@@ -332,14 +332,40 @@ def batch_norm(arrays, eps=1e-3, momentum=0.9, fix_gamma=True,
     shape[axis] = data.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if training and not use_global_stats:
-        mean = jnp.mean(data, axis=red_axes)
-        var = jnp.var(data, axis=red_axes)
+        # Single-pass batch stats: E[x] and E[x^2] reduce the SAME operand,
+        # which XLA fuses into one multi-output reduction (one HBM read of
+        # the activation instead of the 2-3 passes mean-then-var costs).
+        # Accumulate fp32 even for bf16 activations — the convert fuses
+        # into the reduction, and the reduction still READS bf16 from HBM
+        # (half the bandwidth of an fp32 materialization).
+        #
+        # Precision note: E[x^2]-E[x]^2 cancels when |mean| >> std (fp32
+        # error ~ mean^2 * 2^-24 absolute).  This is the standard TPU BN
+        # formulation (flax.linen.BatchNorm computes exactly this) and is
+        # safe for normalized activations; pathological activation scales
+        # can set MXNET_BN_TWO_PASS_VAR=1 to restore the two-pass
+        # shifted variance at one extra HBM pass.
+        from .. import config as _config
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red_axes)
+        if _config.get("MXNET_BN_TWO_PASS_VAR"):
+            var = jnp.var(x32, axis=red_axes)
+        else:
+            meansq = jnp.mean(x32 * x32, axis=red_axes)
+            var = jnp.maximum(meansq - mean * mean, 0.0)
     else:
         mean, var = moving_mean, moving_var
-    inv = jax.lax.rsqrt(var.reshape(shape) + eps)
-    out = (data - mean.reshape(shape)) * inv * g.reshape(shape) + beta.reshape(shape)
+    # Fold the affine into per-channel scale/bias vectors (C-sized, fp32):
+    # the big tensor then sees ONE fused multiply-add in its own dtype.
+    f32 = jnp.float32
+    inv = jax.lax.rsqrt(var.astype(f32) + f32(eps))
+    sc = inv * g.astype(f32)
+    bi = beta.astype(f32) - mean.astype(f32) * sc
+    out = data * sc.reshape(shape).astype(data.dtype) \
+        + bi.reshape(shape).astype(data.dtype)
     if training and not use_global_stats:
-        return (out, mean, var)
+        return (out, mean.astype(moving_mean.dtype),
+                var.astype(moving_var.dtype))
     return (out,)
 
 

@@ -1,0 +1,181 @@
+"""Sparse KVStore surface (reference python/mxnet/kvstore/kvstore.py:420
+row_sparse_pull + src/kvstore/kvstore_dist.h EncodeRowSparseKey push path;
+test scenarios mirror tests/nightly/dist_sync_kvstore.py's sparse block).
+
+The TPU store is dense-backed (documented design call): these tests pin
+the API behaviour migration code relies on — sparse pushes reduce by row,
+row_sparse_pull returns exactly the requested rows, and the dense store
+value agrees with the reference's merged result.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kv_mod
+from mxnet_tpu.ndarray import sparse
+
+
+def _rs(data, indices, shape):
+    return sparse.row_sparse_array((onp.asarray(data, onp.float32), indices),
+                                   shape=shape)
+
+
+def test_sparse_push_reduces_rows():
+    kv = kv_mod.create("local")
+    shape = (6, 3)
+    kv.init("w", mx.nd.zeros(shape))
+    a = _rs(onp.ones((2, 3), onp.float32), [1, 4], shape)
+    b = _rs(2 * onp.ones((2, 3), onp.float32), [1, 2], shape)
+    kv.push("w", [a, b])
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    exp = onp.zeros(shape, onp.float32)
+    exp[1] = 3.0   # 1 (from a) + 2 (from b)
+    exp[2] = 2.0
+    exp[4] = 1.0
+    onp.testing.assert_allclose(out.asnumpy(), exp)
+
+
+def test_sparse_push_duplicate_indices_compact():
+    kv = kv_mod.create("local")
+    shape = (5, 2)
+    kv.init("w", mx.nd.zeros(shape))
+    # duplicate row ids within one pushed value accumulate (kAddTo merge)
+    v = _rs(onp.array([[1, 1], [2, 2], [3, 3]], onp.float32),
+            [0, 0, 3], shape)
+    kv.push("w", [v])
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    exp = onp.zeros(shape, onp.float32)
+    exp[0] = 3.0
+    exp[3] = 3.0
+    onp.testing.assert_allclose(out.asnumpy(), exp)
+
+
+def test_row_sparse_pull_single_and_list():
+    kv = kv_mod.create("local")
+    shape = (8, 4)
+    rs = onp.random.RandomState(0)
+    w = rs.rand(*shape).astype(onp.float32)
+    kv.init("w", mx.nd.array(w))
+
+    out = sparse.zeros("row_sparse", shape)
+    kv.row_sparse_pull("w", out=out, row_ids=mx.nd.array([2, 5]))
+    onp.testing.assert_allclose(onp.asarray(out.indices), [2, 5])
+    onp.testing.assert_allclose(onp.asarray(out.data), w[[2, 5]], rtol=1e-6)
+    # dense view: non-requested rows are zero
+    dense = out.todense().asnumpy()
+    assert onp.abs(dense[[0, 1, 3, 4, 6, 7]]).max() == 0.0
+
+    # unsorted + duplicate ids are deduped and sorted (reference contract)
+    out2 = sparse.zeros("row_sparse", shape)
+    kv.row_sparse_pull("w", out=out2, row_ids=mx.nd.array([5, 2, 5]))
+    onp.testing.assert_allclose(onp.asarray(out2.indices), [2, 5])
+
+    # list form: one row_ids per out
+    outs = [sparse.zeros("row_sparse", shape),
+            sparse.zeros("row_sparse", shape)]
+    kv.row_sparse_pull(["w", "w"], out=outs,
+                       row_ids=[mx.nd.array([0]), mx.nd.array([7])])
+    onp.testing.assert_allclose(onp.asarray(outs[0].data), w[[0]], rtol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(outs[1].data), w[[7]], rtol=1e-6)
+
+    # SINGLE key with a list of outs: row_ids still match out one-to-one
+    outs2 = [sparse.zeros("row_sparse", shape),
+             sparse.zeros("row_sparse", shape)]
+    kv.row_sparse_pull("w", out=outs2,
+                       row_ids=[mx.nd.array([0]), mx.nd.array([7])])
+    onp.testing.assert_allclose(onp.asarray(outs2[0].indices), [0])
+    onp.testing.assert_allclose(onp.asarray(outs2[1].indices), [7])
+    onp.testing.assert_allclose(onp.asarray(outs2[1].data), w[[7]],
+                                rtol=1e-6)
+    with pytest.raises(ValueError):
+        kv.row_sparse_pull("w", out=outs2, row_ids=[mx.nd.array([0])])
+
+
+def test_sparse_push_dist_async():
+    """Sparse pushes work through the dist_async pipeline thread."""
+    kv = kv_mod.create("dist_async")
+    shape = (5, 2)
+    kv.init("w", mx.nd.zeros(shape))
+    kv.push("w", [_rs(onp.ones((1, 2), onp.float32), [3], shape)])
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    exp = onp.zeros(shape, onp.float32)
+    exp[3] = 1.0
+    onp.testing.assert_allclose(out.asnumpy(), exp)
+    kv.close()
+
+
+def test_row_sparse_pull_dense_out():
+    kv = kv_mod.create("local")
+    shape = (4, 2)
+    w = onp.arange(8, dtype=onp.float32).reshape(shape)
+    kv.init("w", mx.nd.array(w))
+    out = mx.nd.zeros(shape)
+    kv.row_sparse_pull("w", out=out, row_ids=mx.nd.array([1, 3]))
+    exp = onp.zeros_like(w)
+    exp[[1, 3]] = w[[1, 3]]
+    onp.testing.assert_allclose(out.asnumpy(), exp)
+
+
+def test_row_sparse_pull_requires_args():
+    kv = kv_mod.create("local")
+    kv.init("w", mx.nd.zeros((2, 2)))
+    with pytest.raises(ValueError):
+        kv.row_sparse_pull("w", out=mx.nd.zeros((2, 2)))
+    with pytest.raises(ValueError):
+        kv.row_sparse_pull("w", row_ids=mx.nd.array([0]))
+    with pytest.raises(KeyError):
+        kv.row_sparse_pull("missing", out=mx.nd.zeros((2, 2)),
+                           row_ids=mx.nd.array([0]))
+
+
+def test_sparse_push_with_updater_sgd():
+    """Server-side optimizer applies the merged sparse gradient; rows with
+    zero gradient stay untouched under plain sgd (reference
+    dist_sync_kvstore.py's sparse-update assertion, dense-applied here)."""
+    kv = kv_mod.create("local")
+    shape = (6, 3)
+    w0 = onp.ones(shape, onp.float32)
+    kv.init("3", mx.nd.array(w0))
+    from mxnet_tpu import optimizer as opt
+
+    kv.set_optimizer(opt.SGD(learning_rate=0.5))
+    g = _rs(onp.ones((2, 3), onp.float32), [1, 4], shape)
+    kv.push("3", [g])
+    out = mx.nd.zeros(shape)
+    kv.pull("3", out=out)
+    exp = w0.copy()
+    exp[[1, 4]] -= 0.5
+    onp.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-6)
+
+
+def test_sparse_init_and_broadcast_densify():
+    kv = kv_mod.create("local")
+    shape = (4, 2)
+    v = _rs(onp.ones((1, 2), onp.float32), [2], shape)
+    kv.init("a", v)
+    out = mx.nd.zeros(shape)
+    kv.pull("a", out=out)
+    exp = onp.zeros(shape, onp.float32)
+    exp[2] = 1.0
+    onp.testing.assert_allclose(out.asnumpy(), exp)
+
+    kv2 = kv_mod.create("local")
+    out2 = mx.nd.zeros(shape)
+    kv2.broadcast("b", _rs(onp.ones((1, 2), onp.float32), [0], shape),
+                  out=out2)
+    exp2 = onp.zeros(shape, onp.float32)
+    exp2[0] = 1.0
+    onp.testing.assert_allclose(out2.asnumpy(), exp2)
+
+
+def test_parameter_accepts_row_sparse_grad_stype():
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    p = Parameter("weight", shape=(10, 4), grad_stype="row_sparse")
+    p.initialize(ctx=mx.cpu())
+    assert p.shape == (10, 4)
+    with pytest.raises(NotImplementedError):
+        Parameter("weight", shape=(10, 4), stype="row_sparse")

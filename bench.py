@@ -259,10 +259,8 @@ def lane_train(on_cpu: bool, bf16: bool,
     # contraction onto the MXU (MLPerf ResNet trick).  BENCH_LAYOUT=NCHW /
     # BENCH_S2D=0 restore the reference texture.
     is_resnet = model_name.startswith("resnet")
-    layout = (os.environ.get("BENCH_LAYOUT", "NHWC")
-              if is_resnet else "NCHW")
-    s2d = os.environ.get("BENCH_S2D", "1").strip().lower() in (
-        "1", "true", "yes", "on") and is_resnet
+    layout = config.get("BENCH_LAYOUT") if is_resnet else "NCHW"
+    s2d = bool(config.get("BENCH_S2D")) and is_resnet
     model_kw = {}
     if is_resnet:
         model_kw = {"layout": layout, "input_layout": layout,

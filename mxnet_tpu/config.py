@@ -189,6 +189,14 @@ declare("BENCH_IMG", int, 224, "bench.py image edge length",
         validator=lambda v: v >= 8, subsystem="bench")
 declare("BENCH_SEQ", int, 128, "bench.py BERT sequence length",
         validator=lambda v: v >= 1, subsystem="bench")
+declare("BENCH_LAYOUT", str, "NHWC",
+        "bench.py ResNet compute layout: NHWC (TPU-native default) or "
+        "NCHW (the reference texture); non-resnet lanes ignore it",
+        validator=lambda v: v in ("NHWC", "NCHW"), subsystem="bench")
+declare("BENCH_S2D", bool, True,
+        "bench.py ResNet lanes: space-to-depth stem rewrite (exact, "
+        "MLPerf trick); 0 restores the plain 7x7/stride-2 conv0",
+        subsystem="bench")
 declare("BENCH_ACCUM", int, 1,
         "bench.py BERT gradient-accumulation factor",
         validator=lambda v: v >= 1, subsystem="bench")

@@ -1,5 +1,11 @@
 """``mx.contrib`` (reference ``python/mxnet/contrib/``)."""
 from . import aot
+from . import io
+from . import ndarray
+from . import ndarray as nd
 from . import onnx
 from . import quantization
+from . import symbol
+from . import symbol as sym
+from . import tensorboard
 from . import text

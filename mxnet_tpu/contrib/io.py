@@ -1,0 +1,65 @@
+"""Bridges between Gluon data loading and the DataIter world (reference
+``python/mxnet/contrib/io.py``)."""
+from __future__ import annotations
+
+from .. import ndarray as nd
+from ..io import DataDesc, DataIter
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a ``gluon.data.DataLoader`` as a ``DataIter`` so loader-based
+    pipelines feed symbolic/Module-style code (reference contrib/io.py:25).
+
+    The last ragged batch is zero-padded up to ``batch_size`` with
+    ``getpad()`` reporting the pad count, matching the reference.
+    """
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        data, label = next(self._iter)
+        self.batch_size = data.shape[0]
+        self.dtype = dtype
+        self.provide_data = [DataDesc(data_name, data.shape, dtype)]
+        self.provide_label = [DataDesc(label_name, label.shape, dtype)]
+        self._current_batch = None
+        self.reset()
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def iter_next(self):
+        try:
+            self._current_batch = next(self._iter)
+        except StopIteration:
+            self._current_batch = None
+        return self._current_batch is not None
+
+    def _padded(self, arr):
+        shape = arr.shape
+        out = nd.zeros((self.batch_size,) + tuple(shape[1:]),
+                       dtype=self.dtype)
+        out[: shape[0]] = arr.astype(self.dtype)
+        return out
+
+    def getdata(self):
+        data = self._current_batch[0]
+        if self.getpad():
+            return [self._padded(data)]
+        return [data.astype(self.dtype)]
+
+    def getlabel(self):
+        label = self._current_batch[1]
+        if self.getpad():
+            return [self._padded(label)]
+        return [label.astype(self.dtype)]
+
+    def getpad(self):
+        return self.batch_size - self._current_batch[0].shape[0]
+
+    def getindex(self):
+        return None

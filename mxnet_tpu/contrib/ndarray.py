@@ -1,0 +1,10 @@
+"""``mx.contrib.ndarray`` — contrib operators under the ndarray API
+(reference ``python/mxnet/contrib/ndarray.py``, where generated contrib op
+wrappers are attached; here every registry op resolves dynamically through
+``mxnet_tpu.ndarray.contrib``)."""
+from ..ndarray.contrib import *  # noqa: F401,F403
+from ..ndarray import contrib as _c
+
+
+def __getattr__(name):
+    return getattr(_c, name)

@@ -1,0 +1,8 @@
+"""``mx.contrib.symbol`` — contrib operators as Symbol builders (reference
+``python/mxnet/contrib/symbol.py``; resolution is dynamic through
+``mxnet_tpu.symbol.contrib``)."""
+from ..symbol import contrib as _c
+
+
+def __getattr__(name):
+    return getattr(_c, name)

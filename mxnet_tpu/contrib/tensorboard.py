@@ -1,0 +1,55 @@
+"""TensorBoard logging callback (reference
+``python/mxnet/contrib/tensorboard.py``).
+
+The reference logs metrics through the external ``mxboard`` package; this
+backend uses it when installed and otherwise degrades to standard logging
+(the environment bakes no TensorBoard writer, and inventing an event-file
+format here would drift from what ``tensorboard --logdir`` expects).
+"""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Batch/eval-end callback writing ``eval_metric`` values to
+    TensorBoard (reference tensorboard.py:56 LogMetricsCallback).
+
+    Parameters
+    ----------
+    logging_dir : str
+        Event-file directory for ``tensorboard --logdir``.
+    prefix : str, optional
+        Prepended to every metric name (e.g. ``train``/``eval`` so both
+        curves share a plot).
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = None
+        try:
+            from mxboard import SummaryWriter
+
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError:
+            logging.error(
+                "mxboard is not installed (`pip install mxboard`); "
+                "LogMetricsCallback will log metrics via logging.info "
+                "instead of TensorBoard events")
+
+    def __call__(self, param):
+        """``param`` is a BatchEndParam-style object with ``eval_metric``
+        and ``epoch`` attributes (see mxnet_tpu.callback)."""
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            if self.summary_writer is not None:
+                self.summary_writer.add_scalar(name, value,
+                                               global_step=param.epoch)
+            else:
+                logging.info("tensorboard[%s] epoch=%s %s=%s",
+                             self.prefix or "", param.epoch, name, value)

@@ -68,6 +68,15 @@ class DataLoader:
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=False, timeout=120):
+        from .dataset import _CompiledTransformDataset
+
+        # compiled batch-wise transform (dataset.transform(compiled=True)):
+        # fetch/batchify the RAW samples (workers stay transform-free) and
+        # run the transform once per batch as a jitted XLA program here
+        self._batch_transform = None
+        if isinstance(dataset, _CompiledTransformDataset):
+            self._batch_transform = dataset._batch_apply
+            dataset = dataset._data
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._thread_pool = thread_pool
@@ -114,8 +123,8 @@ class DataLoader:
     def __iter__(self):
         if self._num_workers == 0:
             for samples in self._batch_sampler:
-                yield self._wrap(self._batchify_fn(
-                    [self._dataset[i] for i in samples]))
+                yield self._wrap(self._transform_batch(self._batchify_fn(
+                    [self._dataset[i] for i in samples])))
             return
 
         if self._thread_pool:
@@ -136,7 +145,7 @@ class DataLoader:
                         futures.append(self._pool.submit(
                             _thread_worker_fn, self._dataset, samples,
                             self._batchify_fn))
-                    yield self._wrap(batch)
+                    yield self._wrap(self._transform_batch(batch))
             finally:
                 for f in futures:
                     f.cancel()
@@ -158,10 +167,15 @@ class DataLoader:
                 if samples is not None:
                     results.append(self._pool.apply_async(
                         _worker_fn, (samples, self._batchify_fn)))
-                yield self._wrap(batch)
+                yield self._wrap(self._transform_batch(batch))
         except KeyboardInterrupt:
             self._shutdown()
             raise
+
+    def _transform_batch(self, batch):
+        if self._batch_transform is None:
+            return batch
+        return self._batch_transform(batch)
 
     def _wrap(self, batch):
         """Host batch -> device NDArrays (the PrefetcherIter HBM staging)."""

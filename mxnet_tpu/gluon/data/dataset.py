@@ -21,14 +21,33 @@ class Dataset:
     def __len__(self):
         raise NotImplementedError
 
-    def transform(self, fn: Callable, lazy: bool = True) -> "Dataset":
+    def transform(self, fn: Callable, lazy: bool = True,
+                  compiled: bool = False) -> "Dataset":
+        """Apply ``fn`` per sample.  ``compiled=True`` (TPU-native) marks
+        ``fn`` as traceable (mx.nd / jnp ops only, uniform shapes): the
+        DataLoader then batches RAW samples and runs ``fn`` ONCE per batch
+        as a jitted+vmapped XLA program instead of per-sample Python — the
+        analog of the reference's C++ LazyTransformDataset (CachedOp per
+        sample, src/io/dataset.cc:542) + ThreadedDataLoader
+        (src/io/dataloader.cc:35), with XLA replacing the worker threads.
+        """
+        if compiled:
+            if not lazy:
+                raise ValueError(
+                    "compiled=True is inherently lazy (the transform runs "
+                    "per batch inside the DataLoader); lazy=False would "
+                    "silently re-run it every epoch — materialize with "
+                    "transform(fn, lazy=False) instead")
+            return _CompiledTransformDataset(self, fn)
         trans = _LazyTransformDataset(self, fn)
         if lazy:
             return trans
         return SimpleDataset([trans[i] for i in range(len(trans))])
 
-    def transform_first(self, fn: Callable, lazy: bool = True) -> "Dataset":
-        return self.transform(_TransformFirstClosure(fn), lazy)
+    def transform_first(self, fn: Callable, lazy: bool = True,
+                        compiled: bool = False) -> "Dataset":
+        return self.transform(_TransformFirstClosure(fn), lazy,
+                              compiled=compiled)
 
     def filter(self, fn: Callable) -> "Dataset":
         kept = []
@@ -78,6 +97,56 @@ class _LazyTransformDataset(Dataset):
         if isinstance(item, tuple):
             return self._fn(*item)
         return self._fn(item)
+
+
+class _CompiledTransformDataset(_LazyTransformDataset):
+    """Marker dataset for compiled batch-wise transforms.
+
+    Per-sample ``__getitem__`` still applies ``fn`` eagerly (host
+    semantics), so the dataset behaves like a lazy transform everywhere;
+    the DataLoader fast-path fetches from the UNDERLYING dataset and calls
+    ``_batch_apply`` on each batchified raw batch.  The jitted program is
+    cached per (shape, dtype) signature — one trace/compile per batch
+    geometry, reused for every batch after (the CachedOp compile-once
+    story, batch-wide).
+
+    Constraints (documented contract): ``fn`` must be traceable — mx.nd /
+    jax.numpy ops only (no cv2/PIL/python host code), uniform output
+    shapes across samples, and no per-sample host RNG (thread an explicit
+    key through the sample instead).
+    """
+
+    def __init__(self, data: Dataset, fn: Callable):
+        super().__init__(data, fn)
+        self._cache = {}
+
+    def _batch_apply(self, batch):
+        import jax
+        import jax.numpy as jnp
+
+        from ...context import current_context
+        from ...ndarray.ndarray import _wrap
+
+        args = batch if isinstance(batch, tuple) else (batch,)
+        jargs = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                 for a in args]
+        sig = tuple((a.shape, str(a.dtype)) for a in jargs)
+        jfn = self._cache.get(sig)
+        if jfn is None:
+            fn = self._fn
+
+            def per_sample(*arrs):
+                ctx = current_context()
+                nd_args = [_wrap(a, ctx) for a in arrs]
+                out = fn(*nd_args) if len(nd_args) > 1 else fn(nd_args[0])
+                if isinstance(out, tuple):
+                    return tuple(o._data if isinstance(o, NDArray) else o
+                                 for o in out)
+                return out._data if isinstance(out, NDArray) else out
+
+            jfn = jax.jit(jax.vmap(per_sample))
+            self._cache[sig] = jfn
+        return jfn(*jargs)
 
 
 class _SubsetDataset(Dataset):

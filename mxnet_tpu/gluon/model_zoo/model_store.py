@@ -97,22 +97,65 @@ def _check_sha1(filename: str, sha1_hash: str) -> bool:
     return sha1.hexdigest() == sha1_hash
 
 
-def get_model_file(name: str, root: Optional[str] = None) -> str:
-    """Resolve the local path of a pretrained checkpoint, fetching it if
-    the environment allows network egress (reference get_model_file)."""
-    root = os.path.expanduser(root or data_dir())
-    file_name = f"{name}-{short_hash(name)}"
-    file_path = os.path.join(root, file_name + ".params")
-    sha1 = _model_sha1[name]
-    if os.path.exists(file_path):
-        from ... import config
+def _shipped_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "pretrained")
 
-        if config.get("MXNET_SKIP_SHA1_CHECK") or _check_sha1(file_path,
-                                                              sha1):
-            return file_path
+
+def _shipped_manifest() -> Dict[str, Dict[str, str]]:
+    """Checkpoints SHIPPED IN-REPO (trained here, sha1-pinned by
+    ``pretrained/MANIFEST.json``) so ``pretrained=True`` works out of the
+    box in air-gapped environments.  Each entry records provenance — these
+    are architecture-correct demo checkpoints, not ImageNet-accuracy
+    weights (the manifest says which)."""
+    import json
+
+    path = os.path.join(_shipped_dir(), "MANIFEST.json")
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def get_model_file(name: str, root: Optional[str] = None) -> str:
+    """Resolve the local path of a pretrained checkpoint: the user cache
+    first, then the in-repo shipped store, then the reference's download
+    URL when the environment allows egress (reference get_model_file).
+
+    Names known only to the shipped MANIFEST.json (in-repo-trained
+    checkpoints outside the reference's sha1 table) resolve through the
+    shipped store alone."""
+    root = os.path.expanduser(root or data_dir())
+    shipped = _shipped_manifest().get(name)
+    if name not in _model_sha1 and shipped is None:
+        raise ValueError(
+            f"Pretrained model for {name} is not available; known: "
+            f"{sorted(set(_model_sha1) | set(_shipped_manifest()))}")
+    if name in _model_sha1:
+        file_name = f"{name}-{short_hash(name)}"
+        file_path = os.path.join(root, file_name + ".params")
+        sha1 = _model_sha1[name]
+        if os.path.exists(file_path):
+            from ... import config
+
+            if config.get("MXNET_SKIP_SHA1_CHECK") or _check_sha1(file_path,
+                                                                  sha1):
+                return file_path
+            raise IOError(
+                f"checksum mismatch for {file_path}; delete it and re-fetch "
+                f"(or set MXNET_SKIP_SHA1_CHECK=1 to accept it)")
+    if shipped is not None:
+        spath = os.path.join(_shipped_dir(), shipped["file"])
+        if os.path.exists(spath) and _check_sha1(spath, shipped["sha1"]):
+            return spath
+        if os.path.exists(spath):
+            raise IOError(
+                f"shipped checkpoint {spath} failed sha1 verification "
+                f"against MANIFEST.json — the repo checkout is corrupt")
+    if name not in _model_sha1:
         raise IOError(
-            f"checksum mismatch for {file_path}; delete it and re-fetch "
-            f"(or set MXNET_SKIP_SHA1_CHECK=1 to accept it)")
+            f"shipped checkpoint for '{name}' is missing from the repo "
+            f"checkout (expected {shipped['file']} under {_shipped_dir()})")
     # attempt the reference's download path; most TPU build environments
     # have no egress, so fail fast with actionable instructions
     url = _URL_FMT.format(file_name=file_name)

@@ -1,5 +1,8 @@
 """``gluon.rnn`` — recurrent layers and cells (reference
 ``python/mxnet/gluon/rnn/``)."""
+from .conv_rnn_cell import (Conv1DGRUCell, Conv1DLSTMCell, Conv1DRNNCell,
+                            Conv2DGRUCell, Conv2DLSTMCell, Conv2DRNNCell,
+                            Conv3DGRUCell, Conv3DLSTMCell, Conv3DRNNCell)
 from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,
                        HybridSequentialRNNCell, LSTMCell, RecurrentCell,
                        ResidualCell, RNNCell, SequentialRNNCell, ZoneoutCell)

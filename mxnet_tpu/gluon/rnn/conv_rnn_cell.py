@@ -1,0 +1,245 @@
+"""Convolutional RNN/LSTM/GRU cells.
+
+Reference: ``python/mxnet/gluon/rnn/conv_rnn_cell.py`` (918 LoC) — cells
+whose input-to-hidden and hidden-to-hidden transforms are N-D convolutions
+instead of dense matmuls (ConvLSTM, Xingjian et al. NIPS 2015).  Gate math
+matches the reference exactly; each step's pair of convolutions lowers to
+XLA convs on the MXU, and unrolls trace into one fused program under
+hybridization (the reference built symbol graphs per step).
+
+Shape contract (reference _decide_shapes): ``input_shape`` is the
+per-sample shape (no batch), e.g. ``(C, H, W)`` for ``conv_layout='NCHW'``;
+the hidden state's spatial size is the i2h convolution's output size, and
+the h2h convolution preserves it (odd kernels, symmetric dilated padding).
+"""
+from __future__ import annotations
+
+from math import floor
+
+from ...ndarray.ndarray import invoke
+from ..parameter import Parameter
+from .rnn_cell import RecurrentCell
+
+__all__ = [
+    "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+    "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+    "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell",
+]
+
+
+def _conv_out_size(dimensions, kernels, paddings, dilations):
+    return tuple(int(floor(x + 2 * p - d * (k - 1) - 1) + 1) if x else 0
+                 for x, k, p, d in zip(dimensions, kernels, paddings,
+                                       dilations))
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    """Shared conv-cell machinery (reference _BaseConvRNNCell)."""
+
+    _gate_names: tuple = ()
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, dims, conv_layout, activation):
+        super().__init__()
+        from ... import initializer as init
+
+        self._hidden_channels = hidden_channels
+        self._input_shape = tuple(input_shape)
+        self._conv_layout = conv_layout
+        self._activation = activation
+        self._dims = dims
+        self._i2h_kernel = _tup(i2h_kernel, dims)
+        self._i2h_pad = _tup(i2h_pad, dims)
+        self._i2h_dilate = _tup(i2h_dilate, dims)
+        self._h2h_kernel = _tup(h2h_kernel, dims)
+        if any(k % 2 != 1 for k in self._h2h_kernel):
+            raise ValueError(
+                f"h2h_kernel must be odd so the hidden state's spatial size "
+                f"is preserved, got {h2h_kernel}")
+        self._h2h_dilate = _tup(h2h_dilate, dims)
+        self._stride = (1,) * dims
+
+        # channel axis within the PER-SAMPLE input_shape is conv_layout's
+        # C position minus the batch axis
+        channel_axis = conv_layout.find("C")
+        self._channel_axis = channel_axis
+        in_channels = input_shape[channel_axis - 1]
+        self._in_channels = in_channels
+        dimensions = (input_shape[1:] if channel_axis == 1
+                      else input_shape[:-1])
+        out_size = _conv_out_size(dimensions, self._i2h_kernel,
+                                  self._i2h_pad, self._i2h_dilate)
+        # "same" padding for the recurrent conv: size-preserving for odd
+        # dilated kernels
+        self._h2h_pad = tuple(d * (k - 1) // 2
+                              for d, k in zip(self._h2h_dilate,
+                                              self._h2h_kernel))
+        ng = hidden_channels * self._num_gates
+        if channel_axis == 1:
+            i2h_shape = (ng, in_channels) + self._i2h_kernel
+            h2h_shape = (ng, hidden_channels) + self._h2h_kernel
+            self._state_shape = (hidden_channels,) + out_size
+        else:
+            i2h_shape = (ng,) + self._i2h_kernel + (in_channels,)
+            h2h_shape = (ng,) + self._h2h_kernel + (hidden_channels,)
+            self._state_shape = out_size + (hidden_channels,)
+
+        self.i2h_weight = Parameter("i2h_weight", shape=i2h_shape,
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=h2h_shape,
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.i2h_bias = Parameter("i2h_bias", shape=(ng,),
+                                  init=init.create(i2h_bias_initializer),
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter("h2h_bias", shape=(ng,),
+                                  init=init.create(h2h_bias_initializer),
+                                  allow_deferred_init=True)
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    def _act(self, x):
+        if callable(self._activation):
+            return self._activation(x)
+        return invoke("Activation", [x], {"act_type": self._activation})
+
+    def _conv_forward(self, x, states):
+        ng = self._hidden_channels * self._num_gates
+        i2h = invoke("Convolution",
+                     [x, self.i2h_weight.data(x.ctx),
+                      self.i2h_bias.data(x.ctx)],
+                     {"kernel": self._i2h_kernel, "stride": self._stride,
+                      "pad": self._i2h_pad, "dilate": self._i2h_dilate,
+                      "num_filter": ng, "layout": self._conv_layout})
+        h2h = invoke("Convolution",
+                     [states[0], self.h2h_weight.data(x.ctx),
+                      self.h2h_bias.data(x.ctx)],
+                     {"kernel": self._h2h_kernel, "stride": self._stride,
+                      "pad": self._h2h_pad, "dilate": self._h2h_dilate,
+                      "num_filter": ng, "layout": self._conv_layout})
+        return i2h, h2h
+
+    def _split_gates(self, arr, n):
+        return arr.split(num_outputs=n, axis=self._channel_axis)
+
+    def __repr__(self):
+        shape = self.i2h_weight.shape
+        in_c = shape[1 if self._channel_axis == 1 else -1]
+        return (f"{type(self).__name__}({in_c} -> {shape[0]}, "
+                f"{self._activation}, {self._conv_layout})")
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _gate_names = ("",)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": self._conv_layout}]
+
+    def forward(self, x, states):
+        i2h, h2h = self._conv_forward(x, states)
+        out = self._act(i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _gate_names = ("_i", "_f", "_c", "_o")
+
+    def state_info(self, batch_size=0):
+        info = {"shape": (batch_size,) + self._state_shape,
+                "__layout__": self._conv_layout}
+        return [dict(info), dict(info)]
+
+    def forward(self, x, states):
+        i2h, h2h = self._conv_forward(x, states)
+        gi, gf, gc, go = self._split_gates(i2h + h2h, 4)
+        i = gi.sigmoid()
+        f = gf.sigmoid()
+        c_new = f * states[1] + i * self._act(gc)
+        h_new = go.sigmoid() * self._act(c_new)
+        return h_new, [h_new, c_new]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _gate_names = ("_r", "_z", "_o")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": self._conv_layout}]
+
+    def forward(self, x, states):
+        i2h, h2h = self._conv_forward(x, states)
+        i2h_r, i2h_z, i2h_n = self._split_gates(i2h, 3)
+        h2h_r, h2h_z, h2h_n = self._split_gates(h2h, 3)
+        r = (i2h_r + h2h_r).sigmoid()
+        z = (i2h_z + h2h_z).sigmoid()
+        n = self._act(i2h_n + r * h2h_n)
+        h_new = (1.0 - z) * n + z * states[0]
+        return h_new, [h_new]
+
+
+def _make_cell(base, dims, default_layout, doc):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                     i2h_weight_initializer=None,
+                     h2h_weight_initializer=None,
+                     i2h_bias_initializer="zeros",
+                     h2h_bias_initializer="zeros",
+                     conv_layout=default_layout, activation="tanh"):
+            super().__init__(
+                input_shape=input_shape, hidden_channels=hidden_channels,
+                i2h_kernel=i2h_kernel, h2h_kernel=h2h_kernel,
+                i2h_pad=i2h_pad, i2h_dilate=i2h_dilate,
+                h2h_dilate=h2h_dilate,
+                i2h_weight_initializer=i2h_weight_initializer,
+                h2h_weight_initializer=h2h_weight_initializer,
+                i2h_bias_initializer=i2h_bias_initializer,
+                h2h_bias_initializer=h2h_bias_initializer,
+                dims=dims, conv_layout=conv_layout, activation=activation)
+
+    Cell.__doc__ = doc
+    return Cell
+
+
+Conv1DRNNCell = _make_cell(
+    _ConvRNNCell, 1, "NCW",
+    "1D conv RNN cell: h' = act(W_i * x + R_i * h + b) "
+    "(reference Conv1DRNNCell).")
+Conv2DRNNCell = _make_cell(
+    _ConvRNNCell, 2, "NCHW",
+    "2D conv RNN cell (reference Conv2DRNNCell).")
+Conv3DRNNCell = _make_cell(
+    _ConvRNNCell, 3, "NCDHW",
+    "3D conv RNN cell (reference Conv3DRNNCell).")
+Conv1DLSTMCell = _make_cell(
+    _ConvLSTMCell, 1, "NCW",
+    "1D ConvLSTM cell (reference Conv1DLSTMCell; Xingjian et al. 2015).")
+Conv2DLSTMCell = _make_cell(
+    _ConvLSTMCell, 2, "NCHW",
+    "2D ConvLSTM cell (reference Conv2DLSTMCell; Xingjian et al. 2015).")
+Conv3DLSTMCell = _make_cell(
+    _ConvLSTMCell, 3, "NCDHW",
+    "3D ConvLSTM cell (reference Conv3DLSTMCell; Xingjian et al. 2015).")
+Conv1DGRUCell = _make_cell(
+    _ConvGRUCell, 1, "NCW",
+    "1D conv GRU cell (reference Conv1DGRUCell).")
+Conv2DGRUCell = _make_cell(
+    _ConvGRUCell, 2, "NCHW",
+    "2D conv GRU cell (reference Conv2DGRUCell).")
+Conv3DGRUCell = _make_cell(
+    _ConvGRUCell, 3, "NCDHW",
+    "3D conv GRU cell (reference Conv3DGRUCell).")
+
+for _name in __all__:
+    globals()[_name].__name__ = _name
+    globals()[_name].__qualname__ = _name

@@ -9,9 +9,9 @@ from . import transformer_lm
 from .transformer_lm import (TransformerLMConfig, forward, init_opt_state,
                              init_params, loss_fn, make_pp_pipeline,
                              make_pp_train_step, make_train_step, pp_loss_fn,
-                             pp_stages, sharding_plan)
+                             pp_pad_batch, pp_stages, sharding_plan)
 
 __all__ = ["transformer_lm", "TransformerLMConfig", "forward", "init_params",
            "init_opt_state", "loss_fn", "make_train_step", "sharding_plan",
            "pp_stages", "make_pp_pipeline", "make_pp_train_step",
-           "pp_loss_fn"]
+           "pp_loss_fn", "pp_pad_batch"]

@@ -36,7 +36,8 @@ from ..parallel.ring_attention import ring_attention_sharded as _ring_attention_
 from ..parallel.sharding import ShardingPlan, constraint
 
 __all__ = ["TransformerLMConfig", "init_params", "forward", "loss_fn",
-           "sharding_plan", "make_train_step", "init_opt_state"]
+           "sharding_plan", "make_train_step", "init_opt_state",
+           "pp_pad_batch"]
 
 
 @dataclasses.dataclass
@@ -340,6 +341,26 @@ def pp_loss_fn(pipe, packed_params, tokens, labels):
     dense configs up to fp32 packing)."""
     nll_sum, counts = pipe.apply(packed_params, tokens, labels)
     return jnp.sum(nll_sum) / jnp.maximum(jnp.sum(counts), 1.0)
+
+
+def pp_pad_batch(tokens, labels, multiple: int):
+    """Pad a ragged batch up to the next multiple of ``multiple`` rows so
+    it divides the pipeline's ``num_microbatches * dp`` requirement.
+
+    Padding rows carry label ``-1`` everywhere, and the masked-CE
+    normalises by the GLOBAL valid-token count — so the padded batch's
+    loss and gradients are EXACTLY the unpadded batch's (the pad rows
+    contribute zero nll and zero valid tokens).  This is the pad-and-mask
+    contract for ragged last microbatches.
+    """
+    B = tokens.shape[0]
+    pad = (-B) % multiple
+    if pad == 0:
+        return tokens, labels
+    tz = jnp.zeros((pad,) + tuple(tokens.shape[1:]), tokens.dtype)
+    lm = jnp.full((pad,) + tuple(labels.shape[1:]), -1, labels.dtype)
+    return (jnp.concatenate([tokens, tz], axis=0),
+            jnp.concatenate([labels, lm], axis=0))
 
 
 def make_pp_train_step(pipe, optimizer: str = "adam", lr: float = 1e-4,

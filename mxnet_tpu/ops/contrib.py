@@ -77,11 +77,37 @@ def div_sqrt_dim(data):
 
 
 @register("boolean_mask", num_inputs=2, differentiable=False)
-def boolean_mask(data, index, axis=0):
-    # dynamic shape op — returns compacted rows; on TPU callers should prefer
-    # masking. Implemented host-side semantics via nonzero with size hint.
-    idx = jnp.nonzero(index.astype(bool))[0]
-    return jnp.take(data, idx, axis=axis)
+def boolean_mask(data, index, axis=0, size=None):
+    """Compact the rows of ``data`` where ``index`` is non-zero (reference
+    ``src/operator/contrib/boolean_mask.cc`` — the canonical dynamic-shape
+    op, gated by CheckDynamicShapeExists in cached_op.cc:820).
+
+    Dynamic-shape policy on TPU (SURVEY §7 "hard parts"): XLA needs static
+    shapes, so inside jit/hybridized graphs the op REQUIRES the
+    pad-and-mask contract: pass ``size=k`` (an upper bound on selected
+    rows) and the output has static leading size ``k`` — selected rows
+    first, in order, then zero padding (same contract as
+    ``jnp.nonzero(size=...)``).  Downstream reductions are unaffected by
+    the zero rows for sum/mean-style math; pair with ``sum(index)`` when
+    the true count matters.  Eagerly (no jit), omitting ``size`` keeps the
+    reference's exact compacted-shape semantics.
+    """
+    mask = index.astype(bool)
+    if size is None:
+        try:
+            idx = jnp.nonzero(mask)[0]
+        except jax.errors.ConcretizationTypeError as e:
+            from ..base import MXNetError
+
+            raise MXNetError(
+                "boolean_mask has a data-dependent output shape and cannot "
+                "trace into a jit/hybridized graph without the pad-and-mask "
+                "contract: pass size=<max rows> to fix the output's leading "
+                "dimension (selected rows first, zero-padded)"
+            ) from e
+        return jnp.take(data, idx, axis=axis)
+    idx = jnp.nonzero(mask, size=int(size), fill_value=data.shape[axis])[0]
+    return jnp.take(data, idx, axis=axis, mode="fill", fill_value=0)
 
 
 @register("index_copy", num_inputs=3, differentiable=False)

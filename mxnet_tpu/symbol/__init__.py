@@ -34,5 +34,22 @@ for _ln in _registry.list_ops():
     if _ln.startswith("linalg_"):
         setattr(linalg, _ln[len("linalg_"):], getattr(_this, _ln))
 
+# contrib submodule mirror: any registry op resolves as a Symbol builder
+# (the reference's generated mxnet.symbol.contrib namespace)
+contrib = _types.ModuleType(__name__ + ".contrib")
+_sys.modules[contrib.__name__] = contrib
+
+
+def _contrib_getattr(name):
+    schema = _registry._OPS.get(name) or _registry._OPS.get("_contrib_" + name)
+    if schema is None:
+        raise AttributeError(f"no contrib symbol op {name}")
+    fn = make_sym_func(schema)
+    setattr(contrib, name, fn)
+    return fn
+
+
+contrib.__getattr__ = _contrib_getattr
+
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "subgraph",
            "execute_graph"]

@@ -1,0 +1,97 @@
+"""Compiled batch-wise dataset transform (TPU-native analog of the
+reference C++ LazyTransformDataset src/io/dataset.cc:542 +
+ThreadedDataLoader src/io/dataloader.cc:35)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import data as gdata
+
+
+def _dataset(n=12, h=8, w=8):
+    rs = onp.random.RandomState(0)
+    imgs = rs.rand(n, h, w, 3).astype(onp.float32)
+    labels = rs.randint(0, 10, (n,)).astype(onp.int32)
+    return gdata.ArrayDataset(imgs, labels), imgs, labels
+
+
+def _norm_first(x):
+    return (x - 0.5) / 0.25
+
+
+@pytest.mark.parametrize("num_workers,thread_pool",
+                         [(0, False), (2, True), (2, False)])
+def test_compiled_transform_matches_per_sample(num_workers, thread_pool):
+    ds, imgs, labels = _dataset()
+    compiled = ds.transform_first(_norm_first, compiled=True)
+    eager = ds.transform_first(_norm_first)
+    loader_c = gdata.DataLoader(compiled, batch_size=4,
+                                num_workers=num_workers,
+                                thread_pool=thread_pool)
+    loader_e = gdata.DataLoader(eager, batch_size=4)
+    for (xc, yc), (xe, ye) in zip(loader_c, loader_e):
+        onp.testing.assert_allclose(xc.asnumpy(), xe.asnumpy(),
+                                    rtol=1e-6, atol=1e-6)
+        onp.testing.assert_array_equal(yc.asnumpy(), ye.asnumpy())
+
+
+def test_compiled_transform_full_sample_fn():
+    """fn over the whole (img, label) sample, returning a tuple."""
+    ds, imgs, labels = _dataset()
+
+    def fn(img, label):
+        return img * 2.0, label + 1
+
+    compiled = ds.transform(fn, compiled=True)
+    loader = gdata.DataLoader(compiled, batch_size=6)
+    got_x, got_y = [], []
+    for x, y in loader:
+        got_x.append(x.asnumpy())
+        got_y.append(y.asnumpy())
+    onp.testing.assert_allclose(onp.concatenate(got_x), imgs * 2.0,
+                                rtol=1e-6)
+    onp.testing.assert_array_equal(onp.concatenate(got_y), labels + 1)
+
+
+def test_compiled_transform_per_sample_access_still_works():
+    ds, imgs, labels = _dataset()
+    compiled = ds.transform_first(_norm_first, compiled=True)
+    x, y = compiled[3]
+    onp.testing.assert_allclose(onp.asarray(x.asnumpy()
+                                            if hasattr(x, "asnumpy") else x),
+                                _norm_first(imgs[3]), rtol=1e-6)
+    assert y == labels[3]
+    assert len(compiled) == len(ds)
+
+
+def test_compiled_transform_compiles_once_per_shape():
+    ds, _, _ = _dataset()
+    compiled = ds.transform_first(_norm_first, compiled=True)
+    loader = gdata.DataLoader(compiled, batch_size=4)
+    for _ in loader:
+        pass
+    # 12 samples / batch 4 -> 3 equal-shaped batches -> ONE cache entry
+    assert len(compiled._cache) == 1
+    # ragged last batch gets its own signature: batch 5 over 12 samples
+    # adds the (5,...) and (2,...) geometries
+    loader2 = gdata.DataLoader(compiled, batch_size=5, last_batch="keep")
+    for _ in loader2:
+        pass
+    assert len(compiled._cache) == 3
+
+
+def test_compiled_transform_with_mx_ops():
+    """Transforms written with mx.nd ops trace into the jitted program."""
+    ds, imgs, _ = _dataset()
+
+    def fn(img):
+        return nd.transpose(img, axes=(2, 0, 1)) * 0.5
+
+    compiled = ds.transform_first(fn, compiled=True)
+    loader = gdata.DataLoader(compiled, batch_size=4)
+    x, _ = next(iter(loader))
+    assert x.shape == (4, 3, 8, 8)
+    onp.testing.assert_allclose(x.asnumpy(),
+                                imgs[:4].transpose(0, 3, 1, 2) * 0.5,
+                                rtol=1e-6)

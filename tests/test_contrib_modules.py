@@ -1,0 +1,64 @@
+"""Small mx.contrib modules (reference python/mxnet/contrib/{io,
+tensorboard,ndarray,symbol}.py)."""
+import logging
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import contrib
+from mxnet_tpu.gluon import data as gdata
+
+
+def test_contrib_nd_and_sym_namespaces():
+    q = contrib.nd.quadratic(mx.nd.array([1.0, 2.0]), a=1, b=2, c=3)
+    onp.testing.assert_allclose(q.asnumpy(), [6.0, 11.0])
+    s = contrib.sym.quadratic(mx.sym.var("x"), a=1, b=2, c=3)
+    out = s.eval(x=mx.nd.array([1.0, 2.0]))
+    onp.testing.assert_allclose(out[0].asnumpy(), [6.0, 11.0])
+    # module aliases exist (reference contrib/__init__ imports both names)
+    assert contrib.ndarray is contrib.nd
+    assert contrib.symbol is contrib.sym
+
+
+def test_dataloader_iter_bridge():
+    ds = gdata.ArrayDataset(
+        onp.arange(20, dtype=onp.float32).reshape(10, 2),
+        onp.arange(10, dtype=onp.float32))
+    loader = gdata.DataLoader(ds, batch_size=4, last_batch="keep")
+    it = contrib.io.DataLoaderIter(loader, data_name="d", label_name="l")
+    assert it.provide_data[0].name == "d"
+    assert it.provide_data[0].shape == (4, 2)
+    batches = list(it)
+    assert [b.pad for b in batches] == [0, 0, 2]
+    # ragged batch is zero-padded to full batch_size
+    assert batches[-1].data[0].shape == (4, 2)
+    onp.testing.assert_allclose(batches[-1].data[0].asnumpy()[2:], 0.0)
+    onp.testing.assert_allclose(batches[-1].data[0].asnumpy()[:2],
+                                [[16, 17], [18, 19]])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_tensorboard_callback_fallback(caplog):
+    cb = contrib.tensorboard.LogMetricsCallback("/tmp/tb_unused",
+                                                prefix="train")
+    assert cb.summary_writer is None  # mxboard not installed here
+
+    class Param:
+        eval_metric = None
+        epoch = 0
+
+    cb(Param())  # no metric: no-op
+    from mxnet_tpu import metric
+
+    m = metric.Accuracy()
+    m.update(mx.nd.array([0, 1]),
+             mx.nd.array([[0.9, 0.1], [0.1, 0.9]]))
+
+    class Param2:
+        eval_metric = m
+        epoch = 3
+
+    with caplog.at_level(logging.INFO):
+        cb(Param2())
+    assert any("train-accuracy" in r.getMessage() for r in caplog.records)

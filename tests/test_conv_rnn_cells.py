@@ -1,0 +1,148 @@
+"""Conv-RNN cell family (reference python/mxnet/gluon/rnn/conv_rnn_cell.py,
+tests mirror tests/python/unittest/test_gluon_rnn.py's conv-cell block).
+
+Oracles:
+- shape contract: hidden spatial size = i2h conv output size; h2h conv
+  preserves it for every pad/dilate combination;
+- degenerate equivalence: with 1x1 kernels on 1x1 spatial input a conv
+  cell IS the dense cell — same weights must give identical outputs
+  (gate order and gate math are pinned by the dense cells' own
+  manual-unroll tests);
+- unroll + autograd integration.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import rnn
+
+
+CELLS = {
+    1: (rnn.Conv1DRNNCell, rnn.Conv1DLSTMCell, rnn.Conv1DGRUCell),
+    2: (rnn.Conv2DRNNCell, rnn.Conv2DLSTMCell, rnn.Conv2DGRUCell),
+    3: (rnn.Conv3DRNNCell, rnn.Conv3DLSTMCell, rnn.Conv3DGRUCell),
+}
+GATES = {"RNN": 1, "LSTM": 4, "GRU": 3}
+
+
+def _kind(cell_cls):
+    for k in GATES:
+        if k in cell_cls.__name__:
+            return k
+    raise AssertionError(cell_cls)
+
+
+@pytest.mark.parametrize("dims", [1, 2, 3])
+@pytest.mark.parametrize("idx", [0, 1, 2])
+def test_forward_shapes(dims, idx):
+    cell_cls = CELLS[dims][idx]
+    spatial = (8, 7, 6)[:dims]
+    input_shape = (3,) + spatial
+    cell = cell_cls(input_shape, hidden_channels=4, i2h_kernel=3,
+                    h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2,) + input_shape)
+    states = cell.begin_state(2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 4) + spatial
+    info = cell.state_info(2)
+    assert len(new_states) == (2 if idx == 1 else 1)
+    for s, i in zip(new_states, info):
+        assert s.shape == tuple(i["shape"])
+        assert i["__layout__"] == cell._conv_layout
+
+
+def test_i2h_shrinks_state_no_pad():
+    """Without i2h padding the state spatial size is the conv output size
+    (reference _decide_shapes/_get_conv_out_size)."""
+    cell = rnn.Conv2DRNNCell((3, 10, 9), hidden_channels=2, i2h_kernel=3,
+                             h2h_kernel=5)
+    cell.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 3, 10, 9))
+    out, _ = cell(x, cell.begin_state(2))
+    assert out.shape == (2, 2, 8, 7)
+    # dilated i2h
+    cell2 = rnn.Conv2DLSTMCell((3, 10, 9), hidden_channels=2, i2h_kernel=3,
+                               h2h_kernel=3, i2h_dilate=2)
+    cell2.initialize(mx.init.Xavier())
+    out2, _ = cell2(x, cell2.begin_state(2))
+    assert out2.shape == (2, 2, 6, 5)
+
+
+def test_even_h2h_kernel_rejected():
+    with pytest.raises(ValueError, match="odd"):
+        rnn.Conv2DRNNCell((3, 8, 8), 4, i2h_kernel=3, h2h_kernel=2)
+
+
+@pytest.mark.parametrize("kind", ["RNN", "LSTM", "GRU"])
+def test_degenerate_1x1_equals_dense_cell(kind):
+    """Conv cell with 1x1 kernels on 1x1 spatial input == dense cell."""
+    rs = onp.random.RandomState(0)
+    B, C, H = 3, 5, 4
+    conv_cls = {"RNN": rnn.Conv1DRNNCell, "LSTM": rnn.Conv1DLSTMCell,
+                "GRU": rnn.Conv1DGRUCell}[kind]
+    dense_cls = {"RNN": rnn.RNNCell, "LSTM": rnn.LSTMCell,
+                 "GRU": rnn.GRUCell}[kind]
+    conv = conv_cls((C, 1), hidden_channels=H, i2h_kernel=1, h2h_kernel=1)
+    dense = (dense_cls(H, input_size=C) if kind != "RNN"
+             else dense_cls(H, activation="tanh", input_size=C))
+    conv.initialize(mx.init.Xavier())
+    dense.initialize(mx.init.Xavier())
+    x2d = rs.randn(B, C).astype(onp.float32)
+    dense(nd.array(x2d), dense.begin_state(B))  # materialize shapes
+    ng = H * GATES[kind]
+    wi = rs.randn(ng, C).astype(onp.float32)
+    wh = rs.randn(ng, H).astype(onp.float32)
+    bi = rs.randn(ng).astype(onp.float32)
+    bh = rs.randn(ng).astype(onp.float32)
+    for cell, reshape in ((conv, True), (dense, False)):
+        p = {name.split(".")[-1]: param
+             for name, param in cell.collect_params().items()}
+        p["i2h_weight"]._data[0]._set_data(
+            nd.array(wi.reshape(ng, C, 1) if reshape else wi)._data)
+        p["h2h_weight"]._data[0]._set_data(
+            nd.array(wh.reshape(ng, H, 1) if reshape else wh)._data)
+        p["i2h_bias"]._data[0]._set_data(nd.array(bi)._data)
+        p["h2h_bias"]._data[0]._set_data(nd.array(bh)._data)
+
+    states_c = conv.begin_state(B)
+    states_d = dense.begin_state(B)
+    xc = nd.array(x2d.reshape(B, C, 1))
+    xd = nd.array(x2d)
+    for _ in range(3):  # a few chained steps compound any gate-math error
+        out_c, states_c = conv(xc, states_c)
+        out_d, states_d = dense(xd, states_d)
+        onp.testing.assert_allclose(
+            out_c.asnumpy().reshape(B, H), out_d.asnumpy(),
+            rtol=1e-5, atol=1e-6)
+    for sc, sd in zip(states_c, states_d):
+        onp.testing.assert_allclose(sc.asnumpy().reshape(B, H),
+                                    sd.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_unroll_and_gradients():
+    cell = rnn.Conv2DLSTMCell((2, 6, 6), hidden_channels=3, i2h_kernel=3,
+                              h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    seq = nd.random.normal(shape=(2, 4, 2, 6, 6))  # NTC...
+    with autograd.record():
+        outs, states = cell.unroll(4, seq, layout="NTC",
+                                   merge_outputs=True)
+        loss = (outs * outs).mean()
+    loss.backward()
+    g = cell.i2h_weight.grad()
+    assert g.shape == cell.i2h_weight.shape
+    assert float(nd.abs(g).sum().asscalar()) > 0
+    assert outs.shape == (2, 4, 3, 6, 6)
+
+
+def test_conv_gru_residual_zoneout_compose():
+    """Conv cells compose with modifier cells like dense ones."""
+    base = rnn.Conv2DGRUCell((3, 5, 5), hidden_channels=3, i2h_kernel=3,
+                             h2h_kernel=3, i2h_pad=1)
+    cell = rnn.ZoneoutCell(base, zoneout_states=0.1)
+    base.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 3, 5, 5))
+    out, states = cell(x, cell.begin_state(2))
+    assert out.shape == (2, 3, 5, 5)

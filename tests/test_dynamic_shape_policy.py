@@ -1,0 +1,75 @@
+"""Dynamic-shape policy for boolean_mask-class ops (SURVEY §7 hard part;
+reference CheckDynamicShapeExists src/imperative/cached_op.cc:820).
+
+Contract: eager keeps the reference's compacted shape; inside jit /
+hybridize the op requires ``size=`` and pads with zeros to that static
+size; omitting ``size`` under trace raises a actionable error.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+
+
+def test_eager_exact_semantics():
+    data = nd.array(onp.arange(12, dtype=onp.float32).reshape(4, 3))
+    index = nd.array([1, 0, 1, 0])
+    out = nd.contrib.boolean_mask(data, index)
+    assert out.shape == (2, 3)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                [[0, 1, 2], [6, 7, 8]])
+
+
+def test_size_pads_with_zeros():
+    data = nd.array(onp.arange(12, dtype=onp.float32).reshape(4, 3))
+    index = nd.array([1, 0, 1, 0])
+    out = nd.contrib.boolean_mask(data, index, size=3)
+    assert out.shape == (3, 3)
+    onp.testing.assert_allclose(
+        out.asnumpy(), [[0, 1, 2], [6, 7, 8], [0, 0, 0]])
+    # size smaller than the true count truncates (documented: size is the
+    # caller's upper bound)
+    out2 = nd.contrib.boolean_mask(data, index, size=1)
+    onp.testing.assert_allclose(out2.asnumpy(), [[0, 1, 2]])
+
+
+def test_jit_requires_size_with_actionable_error():
+    import jax
+
+    from mxnet_tpu.context import current_context
+    from mxnet_tpu.ndarray.ndarray import _wrap
+
+    def f(d, i):
+        ctx = current_context()
+        out = nd.contrib.boolean_mask(_wrap(d, ctx), _wrap(i, ctx))
+        return out._data
+
+    with pytest.raises(MXNetError, match="size="):
+        jax.jit(f)(onp.ones((4, 3), onp.float32),
+                   onp.array([1, 0, 1, 0], onp.float32))
+
+
+def test_hybridized_graph_mask_then_reduce():
+    """The contract case from VERDICT: a hybridized block containing
+    boolean_mask feeding a reduction compiles and matches eager."""
+    from mxnet_tpu import gluon
+
+    class MaskSum(gluon.HybridBlock):
+        def forward(self, x, idx):
+            kept = nd.contrib.boolean_mask(x, idx, size=4)
+            return kept.sum(axis=0)
+
+    net = MaskSum()
+    x = nd.array(onp.arange(12, dtype=onp.float32).reshape(4, 3))
+    idx = nd.array([0, 1, 1, 0])
+    eager = net(x, idx).asnumpy()
+    net.hybridize()
+    hybrid = net(x, idx).asnumpy()
+    onp.testing.assert_allclose(hybrid, eager)
+    onp.testing.assert_allclose(hybrid, [[9, 11, 13]][0])
+    # second call with a different mask reuses the compiled graph
+    idx2 = nd.array([1, 1, 1, 1])
+    onp.testing.assert_allclose(net(x, idx2).asnumpy(),
+                                x.asnumpy().sum(axis=0))

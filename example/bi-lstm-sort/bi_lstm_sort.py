@@ -1,0 +1,82 @@
+"""Sorting with a bidirectional LSTM — TPU-native analog of the reference's
+``example/bi-lstm-sort/bi-lstm-sort.ipynb``.
+
+The network reads a sequence of random digits and must emit the same digits
+in sorted order: each output position is a classification over the
+vocabulary, supervised with the sorted sequence.  A bidirectional LSTM sees
+the whole sequence at every position, which is exactly what the task needs.
+On TPU the recurrence lowers to a single ``lax.scan`` per direction.
+
+    python example/bi-lstm-sort/bi_lstm_sort.py --steps 150
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+class SortNet(gluon.HybridBlock):
+    def __init__(self, vocab=10, hidden=64):
+        super().__init__()
+        self.embed = gluon.nn.Embedding(vocab, 32)
+        self.lstm = gluon.rnn.LSTM(hidden, num_layers=1,
+                                   bidirectional=True, layout="NTC")
+        self.out = gluon.nn.Dense(vocab, flatten=False)
+
+    def forward(self, x):
+        h = self.lstm(self.embed(x))
+        return self.out(h)          # (N, T, vocab) logits per position
+
+
+def batches(batch_size, seq_len, vocab, seed):
+    rng = onp.random.RandomState(seed)
+    while True:
+        seq = rng.randint(0, vocab, size=(batch_size, seq_len))
+        yield seq.astype("int32"), onp.sort(seq, axis=1).astype("int32")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=10)
+    args = p.parse_args()
+
+    mx.random.seed(42)              # deterministic init for the smoke run
+    net = SortNet(vocab=args.vocab)
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+
+    gen = batches(args.batch_size, args.seq_len, args.vocab, seed=0)
+    for step in range(args.steps):
+        seq, tgt = next(gen)
+        data, label = mx.nd.array(seq), mx.nd.array(tgt)
+        with autograd.record():
+            logits = net(data)
+            loss = loss_fn(logits.reshape(-1, args.vocab), label.reshape(-1))
+        loss.backward()
+        trainer.step(data.shape[0])
+        if step % 30 == 0:
+            print(f"step {step}: loss={loss.mean().asnumpy():.4f}")
+
+    # evaluate exact-position accuracy on held-out sequences
+    seq, tgt = next(batches(256, args.seq_len, args.vocab, seed=99))
+    pred = net(mx.nd.array(seq)).asnumpy().argmax(axis=-1)
+    acc = float((pred == tgt).mean())
+    print(f"sorted-position accuracy={acc:.3f}")
+    assert acc > 0.75, "bi-LSTM should learn to sort short digit sequences"
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Variational autoencoder — TPU-native analog of the reference's
+``example/probability/VAE`` demo.
+
+Dense encoder produces (mu, log-variance); the reparameterization trick
+``z = mu + exp(logvar/2) * eps`` keeps sampling differentiable; the loss is
+Bernoulli reconstruction NLL + the analytic diagonal-Gaussian KL to the
+standard-normal prior.  ``mxnet_tpu.gluon.probability.Normal`` +
+``kl_divergence`` verify the hand-written KL at the end.
+
+    python example/probability/vae.py --steps 120
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.probability import Normal, kl_divergence
+
+
+class VAE(gluon.HybridBlock):
+    def __init__(self, latent=8, hidden=128, n_out=28 * 28):
+        super().__init__()
+        self.latent = latent
+        self.encoder = gluon.nn.HybridSequential()
+        self.encoder.add(gluon.nn.Dense(hidden, activation="relu"),
+                         gluon.nn.Dense(2 * latent))
+        self.decoder = gluon.nn.HybridSequential()
+        self.decoder.add(gluon.nn.Dense(hidden, activation="relu"),
+                         gluon.nn.Dense(n_out))
+
+    def forward(self, x, eps):
+        stats = self.encoder(x)
+        mu = stats[:, :self.latent]
+        logvar = stats[:, self.latent:]
+        z = mu + mx.nd.exp(0.5 * logvar) * eps      # reparameterization
+        logits = self.decoder(z)
+        return logits, mu, logvar
+
+
+def elbo_loss(logits, x, mu, logvar):
+    # Bernoulli NLL via numerically-stable logits form
+    recon = mx.nd.relu(logits) - logits * x + \
+        mx.nd.log(1 + mx.nd.exp(-mx.nd.abs(logits)))
+    recon = recon.sum(axis=1)
+    # KL(N(mu, sigma^2) || N(0, 1)), analytic diagonal form
+    kl = 0.5 * (mx.nd.exp(logvar) + mu ** 2 - 1 - logvar).sum(axis=1)
+    return (recon + kl).mean(), kl.mean()
+
+
+def synthetic_binary_digits(n, seed=0):
+    rng = onp.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    x = onp.zeros((n, 28, 28), dtype="float32")
+    for i, k in enumerate(y):
+        r, c = divmod(int(k), 4)
+        x[i, 7 * r:7 * r + 7, 7 * c:7 * c + 7] = 1.0
+    return x.reshape(n, -1)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=120)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--latent", type=int, default=8)
+    args = p.parse_args()
+
+    x = synthetic_binary_digits(1024)
+    net = VAE(latent=args.latent)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+
+    first = last = None
+    for step in range(args.steps):
+        i = (step * args.batch_size) % (1024 - args.batch_size)
+        data = mx.nd.array(x[i:i + args.batch_size])
+        eps = mx.nd.random.normal(shape=(data.shape[0], args.latent))
+        with autograd.record():
+            logits, mu, logvar = net(data, eps)
+            loss, kl = elbo_loss(logits, data, mu, logvar)
+        loss.backward()
+        trainer.step(data.shape[0])
+        val = float(loss.asnumpy())
+        if first is None:
+            first = val
+        last = val
+        if step % 30 == 0:
+            print(f"step {step}: -elbo={val:.2f} kl={float(kl.asnumpy()):.3f}")
+
+    # cross-check the hand-written KL against gluon.probability on the last
+    # batch's posterior
+    post = Normal(loc=mu, scale=mx.nd.exp(0.5 * logvar))
+    prior = Normal(loc=mx.nd.zeros(mu.shape), scale=mx.nd.ones(mu.shape))
+    kl_lib = float(kl_divergence(post, prior).sum(axis=1).mean().asnumpy())
+    assert abs(kl_lib - float(kl.asnumpy())) < 1e-3 * max(1.0, kl_lib), \
+        (kl_lib, float(kl.asnumpy()))
+
+    print(f"-elbo first={first:.2f} last={last:.2f} (library KL={kl_lib:.3f})")
+    assert last < first, "ELBO should improve"
+
+    # generate: decode prior samples — just proves the decoder runs standalone
+    z = mx.nd.random.normal(shape=(16, args.latent))
+    samples = mx.nd.sigmoid(net.decoder(z))
+    assert samples.shape == (16, 28 * 28)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

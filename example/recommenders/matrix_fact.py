@@ -1,0 +1,90 @@
+"""Matrix factorization recommender — TPU-native analog of the reference's
+``example/recommenders/matrix_fact.py`` (MovieLens MF demo).
+
+Classic embedding-dot-product MF: rating(u, i) ≈ <p_u, q_i> + b_u + b_i,
+trained with L2 loss on observed entries.  Embedding lookups become XLA
+gathers; with a real dataset the user/item gradient rows are sparse — the
+framework's ``sgd(lazy_update=True)`` skips untouched rows the same way the
+reference's row_sparse path does.
+
+Uses a synthetic low-rank ratings matrix (zero-egress environment), so the
+model can drive train RMSE toward the noise floor — the assertion checks
+exactly that.
+
+    python example/recommenders/matrix_fact.py --steps 200
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+class MFNet(gluon.HybridBlock):
+    def __init__(self, n_users, n_items, rank=8):
+        super().__init__()
+        self.user = gluon.nn.Embedding(n_users, rank)
+        self.item = gluon.nn.Embedding(n_items, rank)
+        self.user_bias = gluon.nn.Embedding(n_users, 1)
+        self.item_bias = gluon.nn.Embedding(n_items, 1)
+
+    def forward(self, uid, iid):
+        dot = (self.user(uid) * self.item(iid)).sum(axis=-1)
+        return dot + self.user_bias(uid).reshape(-1) \
+                   + self.item_bias(iid).reshape(-1)
+
+
+def synthetic_ratings(n_users, n_items, n_obs, rank=4, seed=0):
+    rng = onp.random.RandomState(seed)
+    p = rng.normal(scale=0.8, size=(n_users, rank))
+    q = rng.normal(scale=0.8, size=(n_items, rank))
+    uid = rng.randint(0, n_users, size=n_obs)
+    iid = rng.randint(0, n_items, size=n_obs)
+    r = (p[uid] * q[iid]).sum(axis=1) + rng.normal(scale=0.1, size=n_obs)
+    return uid.astype("int32"), iid.astype("int32"), r.astype("float32")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--users", type=int, default=200)
+    p.add_argument("--items", type=int, default=300)
+    p.add_argument("--rank", type=int, default=8)
+    args = p.parse_args()
+
+    uid, iid, r = synthetic_ratings(args.users, args.items, n_obs=8192)
+    net = MFNet(args.users, args.items, rank=args.rank)
+    net.initialize(mx.init.Normal(0.05))
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-2})
+
+    n = len(r)
+    for step in range(args.steps):
+        i = (step * args.batch_size) % (n - args.batch_size)
+        bu = mx.nd.array(uid[i:i + args.batch_size])
+        bi = mx.nd.array(iid[i:i + args.batch_size])
+        br = mx.nd.array(r[i:i + args.batch_size])
+        with autograd.record():
+            loss = loss_fn(net(bu, bi), br)
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step % 40 == 0:
+            print(f"step {step}: loss={loss.mean().asnumpy():.4f}")
+
+    pred = net(mx.nd.array(uid), mx.nd.array(iid)).asnumpy()
+    rmse = float(onp.sqrt(onp.mean((pred - r) ** 2)))
+    print(f"train RMSE={rmse:.4f}")
+    assert rmse < 0.5, "MF should recover the low-rank structure"
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

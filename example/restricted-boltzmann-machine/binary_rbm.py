@@ -1,0 +1,103 @@
+"""Binary Restricted Boltzmann Machine trained with contrastive divergence —
+TPU-native analog of the reference's
+``example/restricted-boltzmann-machine/binary_rbm.py``.
+
+An RBM is an energy model, not a feed-forward net: the CD-k gradient comes
+from Gibbs-sampling statistics rather than backprop, so this example drives
+the NDArray API directly (dot, sigmoid, bernoulli sampling) with manual
+parameter updates — the same imperative style the reference example uses,
+but every step's math runs as fused XLA ops on device.
+
+    python example/restricted-boltzmann-machine/binary_rbm.py --epochs 3
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def synthetic_binary_digits(n, seed=0):
+    """Binarized patch-digits: same generator family as the other examples."""
+    rng = onp.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    x = onp.zeros((n, 28 * 28), dtype="float32")
+    img = x.reshape(n, 28, 28)
+    for i, k in enumerate(y):
+        r, c = divmod(int(k), 4)
+        img[i, 7 * r:7 * r + 7, 7 * c:7 * c + 7] = 1.0
+    return x
+
+
+class BinaryRBM:
+    def __init__(self, n_visible, n_hidden, seed=0):
+        rng = onp.random.RandomState(seed)
+        self.w = nd.array(rng.normal(scale=0.01,
+                                     size=(n_visible, n_hidden)))
+        self.bv = nd.zeros((n_visible,))
+        self.bh = nd.zeros((n_hidden,))
+
+    def hidden_prob(self, v):
+        return nd.sigmoid(nd.dot(v, self.w) + self.bh)
+
+    def visible_prob(self, h):
+        return nd.sigmoid(nd.dot(h, self.w, transpose_b=True) + self.bv)
+
+    def _sample(self, prob):
+        return (mx.nd.random.uniform(shape=prob.shape) < prob).astype(
+            "float32")
+
+    def cd1_update(self, v0, lr):
+        """One step of CD-1: positive phase on data, negative phase after a
+        single Gibbs round trip; update with the statistics difference."""
+        ph0 = self.hidden_prob(v0)
+        h0 = self._sample(ph0)
+        pv1 = self.visible_prob(h0)
+        v1 = self._sample(pv1)
+        ph1 = self.hidden_prob(v1)
+
+        batch = float(v0.shape[0])
+        self.w += lr / batch * (nd.dot(v0, ph0, transpose_a=True)
+                                - nd.dot(v1, ph1, transpose_a=True))
+        self.bv += lr * (v0 - v1).mean(axis=0)
+        self.bh += lr * (ph0 - ph1).mean(axis=0)
+        # reconstruction error is the standard RBM training monitor
+        return float(((v0 - pv1) ** 2).mean().asnumpy())
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args()
+
+    x = synthetic_binary_digits(1024)
+    rbm = BinaryRBM(n_visible=x.shape[1], n_hidden=args.hidden)
+
+    first = last = None
+    for epoch in range(args.epochs):
+        errs = []
+        for i in range(0, len(x), args.batch_size):
+            v0 = nd.array(x[i:i + args.batch_size])
+            errs.append(rbm.cd1_update(v0, args.lr))
+        err = sum(errs) / len(errs)
+        if first is None:
+            first = err
+        last = err
+        print(f"epoch {epoch}: recon_err={err:.5f}")
+
+    print(f"recon_err first={first:.5f} last={last:.5f}")
+    assert last < first, "CD-1 should reduce reconstruction error"
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -176,6 +176,30 @@ def convert_hybrid_block(net, target_dtype="bfloat16", ctx=None):
             walk(child)
 
     walk(net)
+
+    # The reference's converted symbol carries amp_cast nodes at its input
+    # edges; the analog here is an input-casting forward bound on the
+    # instance — hybridize traces it, so the casts land inside the compiled
+    # graph exactly like the reference's graph rewrite.
+    from ..ndarray.ndarray import NDArray
+
+    jdt = jnp.bfloat16 if target_dtype in ("bfloat16", jnp.bfloat16) \
+        else jnp.float16
+    orig_forward = net.forward
+
+    def _cast_in(a):
+        if isinstance(a, NDArray) and jnp.issubdtype(a._data.dtype,
+                                                     jnp.floating):
+            return a.astype(jdt)
+        return a
+
+    def cast_forward(*args, **kwargs):
+        return orig_forward(*[_cast_in(a) for a in args],
+                            **{k: _cast_in(v) for k, v in kwargs.items()})
+
+    net.forward = cast_forward
+    if getattr(net, "_cached", None):
+        net._cached = {}            # force a retrace under the new dtypes
     if ctx is not None:
         net.reset_ctx(ctx)
     return net

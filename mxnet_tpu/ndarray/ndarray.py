@@ -20,6 +20,7 @@ fn (optionally under ``jax.vjp`` when autograd is recording), wrap outputs.
 from __future__ import annotations
 
 import numbers
+import time as _time
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -28,6 +29,7 @@ import numpy as onp
 
 from .. import autograd
 from .. import engine as _engine
+from .. import profiler as _profiler
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..ops.registry import OpSchema, find_op, get_op
@@ -627,6 +629,17 @@ def invoke(
     schema = get_op(op) if isinstance(op, str) else op
     ctx = inputs[0]._ctx if inputs else current_context()
     arrays = [i._data for i in inputs]
+
+    if _profiler.ops_active():
+        _t0 = _time.perf_counter_ns()
+        try:
+            return _invoke_body(schema, ctx, arrays, inputs, attrs, out)
+        finally:
+            _profiler.record_op(schema.name, _t0, _time.perf_counter_ns())
+    return _invoke_body(schema, ctx, arrays, inputs, attrs, out)
+
+
+def _invoke_body(schema, ctx, arrays, inputs, attrs, out):
 
     # Record every differentiable op while the scope is active (the reference
     # records all ops under record(), not just ones touching marked vars —

@@ -67,6 +67,12 @@ def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None,
 
 def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None,
            out=None):
+    if isinstance(scale, (int, float, onp.floating, onp.integer)) \
+            and float(scale) < 0:
+        # reference sample_op validates sigma >= 0 (MXNetError at sync)
+        from ..error import MXNetError
+
+        raise MXNetError(f"normal: scale must be non-negative, got {scale}")
     ctx = _dev(ctx, device)
     shp = _bshape(size, loc, scale)
     data = jax.random.normal(_global_rng.next_key(), shp,

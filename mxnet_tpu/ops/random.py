@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as _onp
 
 from .. import random as _rng
 from .registry import register
@@ -29,6 +30,12 @@ def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, key=None):
 @register("normal", num_inputs=0, differentiable=False,
           aliases=["random_normal", "_sample_normal"])
 def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, key=None):
+    if isinstance(scale, (int, float, _onp.floating, _onp.integer)) \
+            and float(scale) < 0:
+        # reference sample_op validates sigma >= 0 (MXNetError at sync)
+        from ..error import MXNetError
+
+        raise MXNetError(f"normal: scale must be non-negative, got {scale}")
     key = key if key is not None else _rng.next_key()
     return loc + scale * jax.random.normal(key, shape, _dt(dtype))
 

@@ -90,6 +90,21 @@ def resume(profile_process="worker"):
     _PAUSED = False
 
 
+def ops_active() -> bool:
+    """True when imperative op bracketing should record (the reference
+    engine brackets every Push under kImperative mode,
+    src/engine/threaded_engine.cc:288-295)."""
+    return _RUNNING and not _PAUSED and _CONFIG["profile_imperative"]
+
+
+def record_op(name: str, t0_ns: int, t1_ns: int) -> None:
+    """Emit one imperative op's dispatch bracket (called by the NDArray
+    invoke path; duration = host-side dispatch, the async analog of the
+    reference's operator-execution stat)."""
+    _emit(name, "operator", "X", ts=t0_ns // 1000,
+          dur=max((t1_ns - t0_ns) // 1000, 1))
+
+
 def _emit(name, cat, ph, ts=None, dur=None, args=None):
     if not _RUNNING or _PAUSED:
         return

@@ -13,6 +13,9 @@ def _run(script, *args, timeout=420):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PYTHONPATH", None)
+    # CPU-only subprocess: drop the TPU-tunnel autoload (a wedged relay
+    # would otherwise hang interpreter startup via sitecustomize)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, script), *args],
@@ -67,3 +70,66 @@ def test_ssd_example():
 def test_migration_example():
     out = _run("example/migration/import_mxnet_model.py")
     assert "MIGRATION_OK" in out
+
+
+@pytest.mark.slow
+def test_adversary_example():
+    out = _run("example/adversary/fgsm_mnist.py", "--epochs", "1")
+    assert "adversarial accuracy" in out
+
+
+@pytest.mark.slow
+def test_autoencoder_example():
+    out = _run("example/autoencoder/conv_autoencoder.py", "--steps", "50")
+    assert "recon_loss" in out
+
+
+@pytest.mark.slow
+def test_bi_lstm_sort_example():
+    out = _run("example/bi-lstm-sort/bi_lstm_sort.py", "--steps", "140")
+    assert "sorted-position accuracy" in out
+
+
+@pytest.mark.slow
+def test_multi_task_example():
+    out = _run("example/multi-task/multi_task_mnist.py", "--steps", "80")
+    assert "parity accuracy" in out
+
+
+@pytest.mark.slow
+def test_recommenders_example():
+    out = _run("example/recommenders/matrix_fact.py", "--steps", "200")
+    assert "RMSE" in out
+
+
+@pytest.mark.slow
+def test_rbm_example():
+    out = _run("example/restricted-boltzmann-machine/binary_rbm.py",
+               "--epochs", "2")
+    assert "recon_err" in out
+
+
+@pytest.mark.slow
+def test_vae_example():
+    out = _run("example/probability/vae.py", "--steps", "100")
+    assert "library KL" in out
+
+
+@pytest.mark.slow
+def test_profiler_example():
+    out = _run("example/profiler/profile_matmul.py", "--iters", "10")
+    assert "trace:" in out
+
+
+@pytest.mark.slow
+def test_amp_example():
+    out = _run("example/automatic-mixed-precision/amp_tutorial.py",
+               "--steps", "50")
+    assert "converted-model relative error" in out
+
+
+@pytest.mark.slow
+def test_multi_threaded_inference_example():
+    out = _run("example/multi_threaded_inference/multi_threaded_inference.py",
+               "--threads", "3", "--iters", "4")
+    assert "bit-identical" in out

@@ -7,6 +7,7 @@ from . import loss
 
 _LAZY = {
     "trainer": ".trainer",
+    "utils": ".utils",
     "data": ".data",
     "rnn": ".rnn",
     "model_zoo": ".model_zoo",

@@ -1,0 +1,156 @@
+"""``mx.gluon.utils`` — data-parallel helpers and misc utilities.
+
+Reference analog: ``python/mxnet/gluon/utils.py:41-447`` (split_data,
+split_and_load, clip_global_norm, check_sha1, download, HookHandle,
+shape_is_known).  TPU-native notes: ``split_and_load`` places slices with
+``device_put`` per context; ``clip_global_norm`` computes the global norm
+in ONE fused reduction over all arrays instead of per-array asscalar round
+trips.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, _wrap
+from .block import HookHandle  # re-export (reference defines it here)
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download", "HookHandle", "shape_is_known"]
+
+
+def split_data(data, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    """Split along ``batch_axis`` into ``num_slice`` pieces (reference
+    gluon/utils.py:41).  With ``even_split`` the size must divide exactly;
+    otherwise the first ``size % num_slice`` slices get one extra row."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch size "
+            f"that's a multiple of {num_slice} or set even_split=False to "
+            f"allow uneven partitioning of data.")
+    if num_slice == 1:
+        return [data]
+    n_each, extras = divmod(size, num_slice)
+    sizes = extras * [n_each + 1] + (num_slice - extras) * [n_each]
+    points = onp.cumsum([0] + sizes)
+    out = []
+    for i in range(num_slice):
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(int(points[i]), int(points[i + 1]))
+        out.append(data[tuple(idx)])
+    return out
+
+
+def split_and_load(data, ctx_list: Sequence[Context], batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    """Split and place one slice per context (reference utils.py:87)."""
+    if not isinstance(data, NDArray):
+        from ..ndarray import array as _array
+
+        data = _array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis,
+                        even_split=even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: Sequence[NDArray], max_norm: float,
+                     check_isfinite: bool = True) -> float:
+    """Rescale arrays in place so their joint L2 norm is at most
+    ``max_norm`` (reference utils.py:117).  Returns the pre-clip norm.
+
+    One fused reduction computes the global norm; each array then sees a
+    single scalar multiply — the whole call is two XLA executions
+    regardless of how many gradient arrays there are."""
+    if not arrays:
+        raise ValueError("arrays must not be empty")
+    total = jnp.sqrt(sum(jnp.vdot(a._data.astype(jnp.float32),
+                                  a._data.astype(jnp.float32))
+                         for a in arrays))
+    norm = float(total)
+    if check_isfinite and not onp.isfinite(norm):
+        import warnings
+
+        warnings.warn(UserWarning(
+            "nan or inf is detected. Clipping results will be undefined."),
+            stacklevel=2)
+    scale = max_norm / (norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a._data * jnp.asarray(scale, a._data.dtype))
+    return norm
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    """True when the file's sha1 matches (reference utils.py:179)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url: str, path: Optional[str] = None, overwrite: bool = False,
+             sha1_hash: Optional[str] = None, retries: int = 5,
+             verify_ssl: bool = True) -> str:
+    """Fetch ``url`` to ``path`` (reference utils.py:271).
+
+    Supports ``file://`` and plain filesystem paths natively; network URLs
+    go through urllib when the environment allows egress (zero-egress
+    images raise a clear error instead of hanging)."""
+    fname = path or url.split("/")[-1]
+    if os.path.isdir(fname):
+        fname = os.path.join(fname, url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and \
+            (sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    src = url[len("file://"):] if url.startswith("file://") else url
+    if os.path.exists(src):              # local copy, no network
+        import shutil
+
+        os.makedirs(os.path.dirname(os.path.abspath(fname)), exist_ok=True)
+        shutil.copyfile(src, fname)
+    else:
+        import urllib.error
+        import urllib.request
+
+        last = None
+        for _ in range(max(retries, 1)):
+            try:
+                os.makedirs(os.path.dirname(os.path.abspath(fname)),
+                            exist_ok=True)
+                urllib.request.urlretrieve(url, fname)
+                last = None
+                break
+            except (urllib.error.URLError, OSError) as e:  # zero-egress etc.
+                last = e
+        if last is not None:
+            raise RuntimeError(
+                f"download({url}) failed after {retries} retries (no "
+                f"network egress?): {last}") from last
+    if sha1_hash is not None and not check_sha1(fname, sha1_hash):
+        raise ValueError(
+            f"downloaded file {fname} does not match the expected sha1")
+    return fname
+
+
+def shape_is_known(shape) -> bool:
+    """True when every dim is concrete (>0) — reference utils.py:430."""
+    if shape is None:
+        return False
+    for dim in shape:
+        if dim is None or dim < 1:
+            return False
+    return True

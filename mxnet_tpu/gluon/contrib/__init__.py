@@ -1,2 +1,10 @@
 """``gluon.contrib`` (reference ``python/mxnet/gluon/contrib/``)."""
 from . import estimator
+
+
+def __getattr__(name):
+    if name == "data":
+        import importlib
+
+        return importlib.import_module(".data", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,5 @@
+"""``gluon.contrib.data`` (reference
+``python/mxnet/gluon/contrib/data/``)."""
+from . import vision
+from .vision import (ImageBboxDataLoader, ImageDataLoader,
+                     create_bbox_augment, create_image_augment)

@@ -1,0 +1,376 @@
+"""``gluon.contrib.data.vision`` — turnkey image/detection data loaders.
+
+Reference analog: ``python/mxnet/gluon/contrib/data/vision/dataloader.py``
+(create_image_augment, ImageDataLoader, ImageBboxDataLoader) and
+``.../vision/transforms/bbox/bbox.py`` (bbox-aware augmenters).
+
+TPU-native shape: augmenters are host-side numpy transforms composed from
+``gluon.data.vision.transforms`` (they run in DataLoader workers; the
+device sees one staged batch), and bbox transforms operate on
+``(image HWC, bbox [N, 4+]) -> (image, bbox)`` pairs with corner-format
+boxes — the convention of this framework's detection ops
+(``ops/detection.py``).
+"""
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as onp
+
+from ...block import Block
+from ...data import DataLoader
+from ...data.vision import transforms
+from ...data.dataset import ImageRecordDataset
+from ...data.vision.datasets import ImageListDataset
+from ...nn import HybridSequential, Sequential
+
+__all__ = ["create_image_augment", "create_bbox_augment", "ImageDataLoader",
+           "ImageBboxDataLoader", "ImageBboxRandomFlipLeftRight",
+           "ImageBboxCrop", "ImageBboxResize", "ImageBboxRandomExpand"]
+
+
+def create_image_augment(data_shape, resize=0, rand_crop=False,
+                         rand_resize=False, rand_mirror=False, mean=None,
+                         std=None, brightness=0, contrast=0, saturation=0,
+                         hue=0, pca_noise=0, rand_gray=0, inter_method=2,
+                         dtype="float32"):
+    """Compose a classification augmenter from ImageRecordIter-style flags
+    (reference dataloader.py:34-139).  Returns a Block pipeline:
+    resize -> crop -> flip -> color -> ToTensor -> normalize -> cast."""
+    if inter_method == 10:
+        inter_method = pyrandom.randint(0, 4)
+    aug = Sequential()
+    if resize > 0:
+        aug.add(transforms.Resize(resize, keep_ratio=True,
+                                  interpolation=inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop, "rand_resize requires rand_crop"
+        aug.add(transforms.RandomResizedCrop(crop_size,
+                                             interpolation=inter_method))
+    elif rand_crop:
+        aug.add(transforms.RandomCrop(crop_size))
+    else:
+        aug.add(transforms.CenterCrop(crop_size))
+    if rand_mirror:
+        aug.add(transforms.RandomFlipLeftRight())
+    aug.add(transforms.Cast())
+    if brightness or contrast or saturation or hue:
+        aug.add(transforms.RandomColorJitter(brightness, contrast,
+                                             saturation, hue))
+    if pca_noise > 0:
+        aug.add(transforms.RandomLighting(pca_noise))
+    if rand_gray > 0:
+        aug.add(transforms.RandomGray(rand_gray))
+    if mean is True:
+        mean = [123.68, 116.28, 103.53]
+    if std is True:
+        std = [58.395, 57.12, 57.375]
+    aug.add(transforms.ToTensor())
+    if mean is not None or std is not None:
+        mean = [0.0, 0.0, 0.0] if mean is None else mean
+        std = [1.0, 1.0, 1.0] if std is None else std
+        # ToTensor scaled to [0,1]; the reference's mean/std are in pixel
+        # units, so rescale to match
+        aug.add(transforms.Normalize([m / 255.0 for m in mean],
+                                     [s / 255.0 for s in std]))
+    aug.add(transforms.Cast(dtype))
+    return aug
+
+
+# ---------------------------------------------------------------------------
+# bbox-aware transforms (image HWC, bbox [N, 4+] corner xmin/ymin/xmax/ymax
+# in PIXELS; extra columns e.g. class id pass through untouched)
+# ---------------------------------------------------------------------------
+
+class _BboxTransform(Block):
+    def __call__(self, img, bbox):
+        return self.forward(onp.asarray(img), onp.asarray(bbox,
+                                                          dtype="float32"))
+
+
+class ImageBboxRandomFlipLeftRight(_BboxTransform):
+    """Mirror image and boxes together with probability p (reference
+    bbox.py ImageBboxRandomFlipLeftRight)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, img, bbox):
+        if pyrandom.random() < self._p:
+            w = img.shape[1]
+            img = onp.ascontiguousarray(img[:, ::-1])
+            bbox = bbox.copy()
+            xmin = w - bbox[:, 2]
+            xmax = w - bbox[:, 0]
+            bbox[:, 0], bbox[:, 2] = xmin, xmax
+        return img, bbox
+
+
+class ImageBboxCrop(_BboxTransform):
+    """Fixed crop; boxes are translated, clipped, and fully-outside boxes
+    dropped (reference bbox.py ImageBboxCrop)."""
+
+    def __init__(self, crop):
+        super().__init__()
+        self._x0, self._y0, self._w, self._h = crop
+
+    def forward(self, img, bbox):
+        img = img[self._y0:self._y0 + self._h,
+                  self._x0:self._x0 + self._w]
+        bbox = bbox.copy()
+        bbox[:, (0, 2)] -= self._x0
+        bbox[:, (1, 3)] -= self._y0
+        bbox[:, (0, 2)] = bbox[:, (0, 2)].clip(0, self._w)
+        bbox[:, (1, 3)] = bbox[:, (1, 3)].clip(0, self._h)
+        keep = (bbox[:, 2] > bbox[:, 0]) & (bbox[:, 3] > bbox[:, 1])
+        return img, bbox[keep]
+
+
+class ImageBboxResize(_BboxTransform):
+    """Resize image to (w, h); boxes scale with it (reference bbox.py
+    ImageBboxResize)."""
+
+    def __init__(self, width, height, interp=1):
+        super().__init__()
+        self._w, self._h = width, height
+        self._interp = interp
+
+    def forward(self, img, bbox):
+        import cv2
+
+        h, w = img.shape[:2]
+        img = cv2.resize(img, (self._w, self._h),
+                         interpolation=self._interp)
+        bbox = bbox.copy()
+        bbox[:, (0, 2)] *= self._w / w
+        bbox[:, (1, 3)] *= self._h / h
+        return img, bbox
+
+
+class ImageBboxRandomExpand(_BboxTransform):
+    """With probability p, paste the image at a random offset on a larger
+    fill-valued canvas — the SSD 'zoom-out' augmentation (reference
+    bbox.py ImageBboxRandomExpand)."""
+
+    def __init__(self, p=0.5, max_ratio=4.0, fill=127):
+        super().__init__()
+        self._p, self._max_ratio, self._fill = p, max_ratio, fill
+
+    def forward(self, img, bbox):
+        if self._max_ratio <= 1 or pyrandom.random() >= self._p:
+            return img, bbox
+        h, w, c = img.shape
+        ratio = pyrandom.uniform(1.0, self._max_ratio)
+        oh, ow = int(h * ratio), int(w * ratio)
+        off_x = pyrandom.randint(0, ow - w)
+        off_y = pyrandom.randint(0, oh - h)
+        canvas = onp.full((oh, ow, c), self._fill, dtype=img.dtype)
+        canvas[off_y:off_y + h, off_x:off_x + w] = img
+        bbox = bbox.copy()
+        bbox[:, (0, 2)] += off_x
+        bbox[:, (1, 3)] += off_y
+        return canvas, bbox
+
+
+def create_bbox_augment(data_shape, rand_crop=0, rand_pad=0, rand_gray=0,
+                        rand_mirror=False, mean=None, std=None, brightness=0,
+                        contrast=0, saturation=0, pca_noise=0, hue=0,
+                        inter_method=2, max_aspect_ratio=2,
+                        area_range=(0.3, 3.0), max_attempts=50,
+                        pad_val=(127, 127, 127), dtype="float32"):
+    """Compose a detection augmenter (reference dataloader.py:247-330).
+    Returns a callable (img, bbox) -> (CHW float tensor, bbox)."""
+    if inter_method == 10:
+        inter_method = pyrandom.randint(0, 4)
+    steps = []
+    if rand_pad > 0:
+        steps.append(ImageBboxRandomExpand(p=rand_pad,
+                                           fill=pad_val[0]))
+    if rand_crop > 0:
+        def random_crop(img, bbox, _p=rand_crop):
+            if pyrandom.random() >= _p:
+                return img, bbox
+            h, w = img.shape[:2]
+            for _ in range(max_attempts):
+                scale = pyrandom.uniform(area_range[0],
+                                         min(1.0, area_range[1]))
+                ar = pyrandom.uniform(1 / max_aspect_ratio,
+                                      max_aspect_ratio)
+                cw = int(w * (scale * ar) ** 0.5)
+                ch = int(h * (scale / ar) ** 0.5)
+                if cw <= w and ch <= h and cw > 0 and ch > 0:
+                    x0 = pyrandom.randint(0, w - cw)
+                    y0 = pyrandom.randint(0, h - ch)
+                    out_img, out_bbox = ImageBboxCrop(
+                        (x0, y0, cw, ch))(img, bbox)
+                    if len(out_bbox):      # keep crops that retain a box
+                        return out_img, out_bbox
+            return img, bbox
+
+        steps.append(random_crop)
+    steps.append(ImageBboxResize(data_shape[2], data_shape[1],
+                                 interp=inter_method))
+    if rand_mirror:
+        steps.append(ImageBboxRandomFlipLeftRight(0.5))
+
+    color = []
+    if brightness or contrast or saturation or hue:
+        color.append(transforms.RandomColorJitter(brightness, contrast,
+                                                  saturation, hue))
+    if pca_noise > 0:
+        color.append(transforms.RandomLighting(pca_noise))
+    if rand_gray > 0:
+        color.append(transforms.RandomGray(rand_gray))
+    to_tensor = transforms.ToTensor()
+    if mean is True:
+        mean = [123.68, 116.28, 103.53]
+    if std is True:
+        std = [58.395, 57.12, 57.375]
+    normalize = None
+    if mean is not None or std is not None:
+        mean = [0.0, 0.0, 0.0] if mean is None else mean
+        std = [1.0, 1.0, 1.0] if std is None else std
+        normalize = transforms.Normalize([m / 255.0 for m in mean],
+                                         [s / 255.0 for s in std])
+
+    def augment(img, bbox):
+        img = onp.asarray(img)
+        bbox = onp.asarray(bbox, dtype="float32")
+        for step in steps:
+            img, bbox = step(img, bbox)
+        for aug in color:
+            img = aug(img)
+        img = to_tensor(img)
+        if normalize is not None:
+            img = normalize(img)
+        return onp.asarray(img, dtype=dtype), bbox
+
+    return augment
+
+
+# ---------------------------------------------------------------------------
+# loaders
+# ---------------------------------------------------------------------------
+
+def _make_dataset(path_imgrec, path_imglist, imglist, path_root):
+    if path_imgrec:
+        return ImageRecordDataset(path_imgrec, flag=1)
+    if path_imglist:
+        return ImageListDataset(path_root, path_imglist, flag=1)
+    if isinstance(imglist, list):
+        return ImageListDataset(path_root, imglist, flag=1)
+    raise ValueError(
+        "one of path_imgrec, path_imglist, or imglist is required")
+
+
+class ImageDataLoader:
+    """ImageRecordIter-flag-compatible classification loader over the Gluon
+    Dataset/DataLoader stack (reference dataloader.py:141-245)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, dtype="float32", shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 pin_device_id=0, prefetch=None, thread_pool=False,
+                 timeout=120, **kwargs):
+        dataset = _make_dataset(path_imgrec, path_imglist, imglist,
+                                path_root)
+        if num_parts > 1:
+            dataset = dataset.shard(num_parts, part_index)
+        if aug_list is None:
+            augmenter = create_image_augment(data_shape, dtype=dtype,
+                                             **kwargs)
+        elif isinstance(aug_list, list):
+            augmenter = HybridSequential() if all(
+                isinstance(a, Block) for a in aug_list) else Sequential()
+            for a in aug_list:
+                augmenter.add(a)
+        elif isinstance(aug_list, Block) or callable(aug_list):
+            augmenter = aug_list
+        else:
+            raise ValueError("aug_list must be a list of Blocks or a Block")
+        self._iter = DataLoader(
+            dataset.transform_first(augmenter), batch_size=batch_size,
+            shuffle=shuffle, sampler=sampler, last_batch=last_batch,
+            batch_sampler=batch_sampler, batchify_fn=batchify_fn,
+            num_workers=num_workers, pin_memory=pin_memory,
+            pin_device_id=pin_device_id, prefetch=prefetch,
+            thread_pool=thread_pool, timeout=timeout)
+
+    def __iter__(self):
+        return iter(self._iter)
+
+    def __len__(self):
+        return len(self._iter)
+
+
+def _bbox_batchify(samples):
+    """Pad boxes to the max count in the batch with -1 rows (the detection
+    ops' ignore convention), then stack."""
+    from ....ndarray import array
+
+    imgs = onp.stack([s[0] for s in samples])
+    maxn = max(len(s[1]) for s in samples)
+    ncol = samples[0][1].shape[1] if samples[0][1].ndim == 2 else 4
+    boxes = onp.full((len(samples), max(maxn, 1), ncol), -1.0,
+                     dtype="float32")
+    for i, (_, b) in enumerate(samples):
+        if len(b):
+            boxes[i, :len(b)] = b
+    return array(imgs), array(boxes)
+
+
+class ImageBboxDataLoader:
+    """Detection loader: samples are (image, bbox [N, 4+]) pairs; batches
+    pad ragged box counts with -1 rows (reference dataloader.py:332-443)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, coord_normalized=False,
+                 dtype="float32", shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120, **kwargs):
+        dataset = _make_dataset(path_imgrec, path_imglist, imglist,
+                                path_root)
+        if num_parts > 1:
+            dataset = dataset.shard(num_parts, part_index)
+        if aug_list is None:
+            augmenter = create_bbox_augment(data_shape, dtype=dtype,
+                                            **kwargs)
+        elif callable(aug_list):
+            augmenter = aug_list
+        else:
+            raise ValueError("aug_list must be callable (img, bbox) pairs")
+        self._coord_normalized = coord_normalized
+        self._data_shape = data_shape
+
+        def sample_transform(img, bbox):
+            bbox = onp.asarray(bbox, dtype="float32")
+            if bbox.ndim == 1:      # flat .lst label: [x0 y0 x1 y1 (cls…)]*N
+                width = 5 if bbox.size % 5 == 0 else 4
+                bbox = bbox.reshape(-1, width)
+            img, bbox = augmenter(img, bbox)
+            if coord_normalized:
+                bbox = bbox.copy()
+                bbox[:, (0, 2)] /= data_shape[2]
+                bbox[:, (1, 3)] /= data_shape[1]
+            return img, bbox
+
+        self._iter = DataLoader(
+            dataset.transform(sample_transform), batch_size=batch_size,
+            shuffle=shuffle, sampler=sampler, last_batch=last_batch,
+            batch_sampler=batch_sampler,
+            batchify_fn=batchify_fn or _bbox_batchify,
+            num_workers=num_workers, pin_memory=pin_memory,
+            pin_device_id=pin_device_id, prefetch=prefetch,
+            thread_pool=thread_pool, timeout=timeout)
+
+    def __iter__(self):
+        return iter(self._iter)
+
+    def __len__(self):
+        return len(self._iter)

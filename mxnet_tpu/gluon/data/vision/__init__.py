@@ -2,4 +2,4 @@
 ``python/mxnet/gluon/data/vision/``)."""
 from . import transforms
 from .datasets import (CIFAR10, CIFAR100, MNIST, FashionMNIST,
-                       ImageFolderDataset)
+                       ImageFolderDataset, ImageListDataset)

@@ -163,3 +163,50 @@ class ImageFolderDataset(Dataset):
         if self._transform is not None:
             return self._transform(img, label)
         return img, label
+
+
+class ImageListDataset(Dataset):
+    """Images enumerated by a .lst file or an in-memory list (reference
+    vision/datasets.py ImageListDataset; .lst format from tools/im2rec.py:
+    tab-separated ``index  label...  relpath``)."""
+
+    def __init__(self, root=".", imglist=None, flag=1):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self.items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    label = [float(v) for v in parts[1:-1]]
+                    self.items.append((parts[-1],
+                                       label[0] if len(label) == 1
+                                       else onp.array(label,
+                                                      dtype="float32")))
+        elif isinstance(imglist, list):
+            # each entry: [label(s), relpath]
+            for entry in imglist:
+                label, path = entry[0], entry[-1]
+                if isinstance(label, (list, tuple)):
+                    label = (float(label[0]) if len(label) == 1
+                             else onp.array(label, dtype="float32"))
+                self.items.append((path, label))
+        else:
+            raise ValueError("imglist must be a .lst path or a list")
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        import cv2
+
+        path, label = self.items[idx]
+        fname = os.path.join(self._root, path)
+        img = cv2.imread(fname, self._flag)
+        if img is None:
+            raise IOError(f"cannot read image {fname}")
+        if img.ndim == 3:
+            img = img[:, :, ::-1]  # BGR->RGB
+        return img, label

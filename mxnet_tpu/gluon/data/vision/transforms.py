@@ -19,7 +19,8 @@ from ...block import Block
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
-           "RandomLighting", "RandomCrop"]
+           "RandomLighting", "RandomCrop", "RandomHue", "RandomColorJitter",
+           "RandomGray"]
 
 
 def _as_host(x):
@@ -237,3 +238,63 @@ class RandomLighting(_Transform):
         a = onp.random.normal(0, self._alpha, 3).astype(onp.float32)
         rgb = (self._eigvec * a * self._eigval).sum(axis=1)
         return onp.clip(x + rgb, 0, 255)
+
+
+class RandomHue(_Transform):
+    """Hue jitter in HSV space (reference image.py RandomHueAug)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        import cv2
+
+        x = _as_host(x).astype(onp.float32)
+        alpha = pyrandom.uniform(-self._h, self._h)
+        hsv = cv2.cvtColor(onp.clip(x, 0, 255).astype(onp.uint8),
+                           cv2.COLOR_RGB2HSV).astype(onp.float32)
+        hsv[..., 0] = (hsv[..., 0] + alpha * 180.0) % 180.0
+        out = cv2.cvtColor(hsv.astype(onp.uint8), cv2.COLOR_HSV2RGB)
+        return out.astype(onp.float32)
+
+
+class RandomColorJitter(_Transform):
+    """Apply brightness/contrast/saturation/hue jitter in random order
+    (reference transforms RandomColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._augs = []
+        if brightness:
+            self._augs.append(RandomBrightness(brightness))
+        if contrast:
+            self._augs.append(RandomContrast(contrast))
+        if saturation:
+            self._augs.append(RandomSaturation(saturation))
+        if hue:
+            self._augs.append(RandomHue(hue))
+
+    def forward(self, x):
+        augs = list(self._augs)
+        pyrandom.shuffle(augs)
+        for a in augs:
+            x = a(x)
+        return x
+
+
+class RandomGray(_Transform):
+    """With probability p, collapse to grayscale replicated over the 3
+    channels (reference contrib create_image_augment rand_gray)."""
+
+    def __init__(self, p):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        x = _as_host(x)
+        if pyrandom.random() < self._p:
+            gray = (x.astype(onp.float32)
+                    @ onp.array([0.299, 0.587, 0.114], onp.float32))
+            x = onp.repeat(gray[..., None], 3, axis=2)
+        return x

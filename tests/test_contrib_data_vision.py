@@ -1,0 +1,193 @@
+"""gluon.contrib.data.vision — image/detection loaders and bbox-aware
+augmenters (reference gluon/contrib/data/vision/dataloader.py +
+transforms/bbox/bbox.py)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.contrib.data.vision import (
+    ImageBboxCrop, ImageBboxDataLoader, ImageBboxRandomExpand,
+    ImageBboxRandomFlipLeftRight, ImageBboxResize, ImageDataLoader,
+    create_bbox_augment, create_image_augment)
+
+_R = onp.random.RandomState(5)
+
+
+@pytest.fixture(scope="module")
+def image_tree(tmp_path_factory):
+    """8 tiny images on disk + a .lst file + an in-memory imglist."""
+    import cv2
+
+    root = tmp_path_factory.mktemp("imgs")
+    entries = []
+    for i in range(8):
+        img = _R.randint(0, 255, size=(40, 48, 3)).astype("uint8")
+        name = f"img_{i}.png"
+        cv2.imwrite(str(root / name), img)
+        entries.append((i % 3, name))
+    lst = root / "train.lst"
+    with open(lst, "w") as f:
+        for i, (label, name) in enumerate(entries):
+            f.write(f"{i}\t{float(label)}\t{name}\n")
+    return {"root": str(root), "lst": str(lst),
+            "imglist": [[float(l), n] for l, n in entries]}
+
+
+# ---------------------------------------------------------------------------
+# classification augmenter + loader
+# ---------------------------------------------------------------------------
+
+def test_create_image_augment_pipeline():
+    aug = create_image_augment((3, 24, 24), resize=32, rand_mirror=True,
+                               mean=True, std=True, brightness=0.1,
+                               rand_gray=0.1)
+    img = _R.randint(0, 255, size=(40, 48, 3)).astype("uint8")
+    out = aug(img)
+    out = onp.asarray(out)
+    assert out.shape == (3, 24, 24)
+    assert out.dtype == onp.float32
+
+
+def test_image_dataloader_from_lst(image_tree):
+    loader = ImageDataLoader(batch_size=4, data_shape=(3, 16, 16),
+                             path_imglist=image_tree["lst"],
+                             path_root=image_tree["root"])
+    batches = list(loader)
+    assert len(loader) == 2 and len(batches) == 2
+    data, label = batches[0]
+    assert data.shape == (4, 3, 16, 16)
+    assert label.shape == (4,)
+
+
+def test_image_dataloader_from_memory_list_sharded(image_tree):
+    loader = ImageDataLoader(batch_size=2, data_shape=(3, 16, 16),
+                             imglist=image_tree["imglist"],
+                             path_root=image_tree["root"],
+                             num_parts=2, part_index=0)
+    total = sum(b[0].shape[0] for b in loader)
+    assert total == 4          # half the dataset on this shard
+
+
+def test_image_dataloader_custom_aug_list(image_tree):
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    loader = ImageDataLoader(
+        batch_size=4, data_shape=(3, 20, 20),
+        path_imglist=image_tree["lst"], path_root=image_tree["root"],
+        aug_list=[transforms.Resize((20, 20)), transforms.ToTensor()])
+    data, _ = next(iter(loader))
+    assert data.shape == (4, 3, 20, 20)
+
+
+# ---------------------------------------------------------------------------
+# bbox transforms: coordinate bookkeeping oracles
+# ---------------------------------------------------------------------------
+
+def test_bbox_flip_coordinates():
+    img = onp.arange(2 * 10 * 3).reshape(2, 10, 3).astype("uint8")
+    bbox = onp.array([[1.0, 0.0, 4.0, 2.0, 7.0]], dtype="float32")
+    out_img, out_bbox = ImageBboxRandomFlipLeftRight(p=1.0)(img, bbox)
+    onp.testing.assert_array_equal(out_img, img[:, ::-1])
+    onp.testing.assert_allclose(out_bbox[0, :4], [10 - 4, 0, 10 - 1, 2])
+    assert out_bbox[0, 4] == 7.0            # class column untouched
+
+
+def test_bbox_crop_translates_clips_drops():
+    img = _R.randint(0, 255, size=(20, 20, 3)).astype("uint8")
+    bbox = onp.array([[2.0, 2.0, 8.0, 8.0],       # inside after shift
+                      [0.0, 0.0, 3.0, 3.0],       # partially clipped
+                      [15.0, 15.0, 19.0, 19.0]],  # fully outside -> dropped
+                     dtype="float32")
+    out_img, out = ImageBboxCrop((2, 2, 10, 10))(img, bbox)
+    assert out_img.shape == (10, 10, 3)
+    assert len(out) == 2
+    onp.testing.assert_allclose(out[0], [0, 0, 6, 6])
+    onp.testing.assert_allclose(out[1], [0, 0, 1, 1])
+
+
+def test_bbox_resize_scales_boxes():
+    img = _R.randint(0, 255, size=(10, 20, 3)).astype("uint8")
+    bbox = onp.array([[2.0, 1.0, 10.0, 5.0]], dtype="float32")
+    out_img, out = ImageBboxResize(width=40, height=30)(img, bbox)
+    assert out_img.shape == (30, 40, 3)
+    onp.testing.assert_allclose(out[0], [4.0, 3.0, 20.0, 15.0])
+
+
+def test_bbox_expand_offsets_boxes():
+    img = onp.full((10, 10, 3), 9, dtype="uint8")
+    bbox = onp.array([[1.0, 2.0, 5.0, 6.0]], dtype="float32")
+    out_img, out = ImageBboxRandomExpand(p=1.0, max_ratio=3.0,
+                                         fill=0)(img, bbox)
+    oh, ow = out_img.shape[:2]
+    assert oh >= 10 and ow >= 10
+    dx = out[0, 0] - 1.0
+    dy = out[0, 1] - 2.0
+    onp.testing.assert_allclose(out[0], [1 + dx, 2 + dy, 5 + dx, 6 + dy])
+    # the pasted region carries the original pixels
+    y0, x0 = int(dy), int(dx)
+    onp.testing.assert_array_equal(out_img[y0:y0 + 10, x0:x0 + 10], img)
+
+
+def test_create_bbox_augment_end_to_end():
+    aug = create_bbox_augment((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, mean=True, std=True)
+    img = _R.randint(0, 255, size=(48, 64, 3)).astype("uint8")
+    bbox = onp.array([[4.0, 4.0, 40.0, 30.0, 1.0],
+                      [10.0, 8.0, 60.0, 44.0, 2.0]], dtype="float32")
+    out_img, out_bbox = aug(img, bbox)
+    assert out_img.shape == (3, 32, 32)
+    assert out_bbox.shape[1] == 5 and len(out_bbox) >= 1
+    # all surviving coords are inside the output frame
+    assert (out_bbox[:, 0] >= -1e-3).all() and \
+           (out_bbox[:, 2] <= 32 + 1e-3).all()
+
+
+# ---------------------------------------------------------------------------
+# detection loader
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def bbox_tree(tmp_path_factory):
+    import cv2
+
+    root = tmp_path_factory.mktemp("dets")
+    imglist = []
+    for i in range(6):
+        img = _R.randint(0, 255, size=(32, 32, 3)).astype("uint8")
+        name = f"det_{i}.png"
+        cv2.imwrite(str(root / name), img)
+        n = 1 + i % 3
+        boxes = []
+        for k in range(n):
+            x0, y0 = 2.0 + k, 3.0 + k
+            boxes += [x0, y0, x0 + 10, y0 + 8, float(k)]
+        imglist.append([onp.array(boxes, dtype="float32"), name])
+    return {"root": str(root), "imglist": imglist}
+
+
+def test_image_bbox_dataloader(bbox_tree):
+    loader = ImageBboxDataLoader(batch_size=3, data_shape=(3, 24, 24),
+                                 imglist=bbox_tree["imglist"],
+                                 path_root=bbox_tree["root"],
+                                 rand_mirror=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    data, boxes = batches[0]
+    assert data.shape == (3, 3, 24, 24)
+    assert boxes.ndim == 3 and boxes.shape[2] == 5
+    host = boxes.asnumpy()
+    # ragged padding rows are -1; every sample keeps >= 1 real box
+    assert ((host[:, 0, :4] >= 0).all())
+
+
+def test_image_bbox_dataloader_normalized(bbox_tree):
+    loader = ImageBboxDataLoader(batch_size=2, data_shape=(3, 16, 16),
+                                 imglist=bbox_tree["imglist"],
+                                 path_root=bbox_tree["root"],
+                                 coord_normalized=True)
+    _, boxes = next(iter(loader))
+    host = boxes.asnumpy()
+    real = host[host[..., 0] >= 0]
+    assert (real[:, :4] <= 1.0 + 1e-5).all()

@@ -92,6 +92,8 @@ _LAZY = {
     "name": ".name",
     "attribute": ".attribute",
     "dlpack": ".dlpack",
+    "registry": ".registry",
+    "libinfo": ".libinfo",
 }
 
 

@@ -1,0 +1,45 @@
+"""``mx.libinfo`` — native-library discovery + version (reference
+``python/mxnet/libinfo.py``).
+
+The reference locates ``libmxnet.so``; here the native runtime is
+``libmxnet_tpu.so`` built from ``mxnet_tpu/native/`` (engine, RecordIO
+reader, C API).  ``MXNET_LIBRARY_PATH`` overrides, same as the reference.
+"""
+from __future__ import annotations
+
+import os
+
+from . import __version__  # noqa: F401  (reference re-exports it here)
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+
+def find_lib_path(prefix: str = "libmxnet_tpu_native"):
+    """Paths to the native runtime libraries, env override first
+    (reference libinfo.py find_lib_path).  Default returns the base
+    runtime lib + the C-API lib when both are built."""
+    override = os.environ.get("MXNET_LIBRARY_PATH")
+    if override and os.path.isfile(override):
+        return [override]
+    here = os.path.dirname(os.path.abspath(__file__))
+    build = os.path.join(here, "native", "build")
+    candidates = [
+        os.path.join(build, f"{prefix}.so"),
+        os.path.join(build, "libmxnet_tpu_c.so"),
+    ]
+    found = [p for p in candidates if os.path.isfile(p)]
+    if not found:
+        raise RuntimeError(
+            f"Cannot find the native library {prefix}.so. Build it with "
+            f"`make -C mxnet_tpu/native` or set MXNET_LIBRARY_PATH. "
+            f"(The pure-Python paths work without it.)")
+    return found
+
+
+def find_include_path():
+    """C API header directory (reference find_include_path)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    inc = os.path.join(here, "native", "include")
+    if os.path.isdir(inc):
+        return inc
+    raise RuntimeError("Cannot find the native include directory")

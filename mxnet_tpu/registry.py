@@ -1,0 +1,101 @@
+"""``mx.registry`` — the generic by-name factory registry behind
+``Optimizer.register``/``mx.init`` etc. (reference
+``python/mxnet/registry.py:26-175``).
+
+Keyed by base class; names are case-insensitive.  ``create`` accepts an
+existing instance (pass-through), a name + ctor kwargs, a dict config, or
+the reference's JSON string forms (``'["name", {…}]'`` / ``'{…}'``) so
+serialized optimizer configs round-trip.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+_REGISTRY: dict = {}
+
+__all__ = ["get_registry", "get_register_func", "get_alias_func",
+           "get_create_func"]
+
+
+def get_registry(base_class: type) -> dict:
+    """Copy of the name->class table for ``base_class``."""
+    return dict(_REGISTRY.setdefault(base_class, {}))
+
+
+def get_register_func(base_class: type, nickname: str):
+    """Build a ``register(klass, name=None)`` decorator for the family."""
+    table = _REGISTRY.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise TypeError(
+                f"can only register subclasses of {base_class.__name__}, "
+                f"got {klass!r}")
+        key = (name or klass.__name__).lower()
+        if key in table and table[key] is not klass:
+            warnings.warn(
+                f"new {nickname} {klass.__module__}.{klass.__name__} "
+                f"registered with name {key} is overriding existing "
+                f"{nickname} {table[key].__module__}."
+                f"{table[key].__name__}", UserWarning, stacklevel=2)
+        table[key] = klass
+        return klass
+
+    register.__doc__ = f"Register {nickname} to the {nickname} factory"
+    return register
+
+
+def get_alias_func(base_class: type, nickname: str):
+    """Decorator factory registering a class under several names."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*names):
+        def reg(klass):
+            for n in names:
+                register(klass, n)
+            return klass
+
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class: type, nickname: str):
+    """Build a ``create(name_or_instance_or_config, *args, **kwargs)``."""
+    table = _REGISTRY.setdefault(base_class, {})
+
+    def create(*args, **kwargs):
+        if args:
+            name, args = args[0], args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        if isinstance(name, base_class):
+            if args or kwargs:
+                raise ValueError(
+                    f"{nickname} is already an instance; additional "
+                    f"arguments are invalid")
+            return name
+        if isinstance(name, dict):
+            return create(**name)
+        if not isinstance(name, str):
+            raise TypeError(f"{nickname} must be a string, instance, or "
+                            f"config dict, got {type(name)}")
+        if name.startswith("["):
+            if args or kwargs:
+                raise ValueError("JSON config takes no extra arguments")
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        if name.startswith("{"):
+            if args or kwargs:
+                raise ValueError("JSON config takes no extra arguments")
+            return create(**json.loads(name))
+        key = name.lower()
+        if key not in table:
+            raise ValueError(
+                f"{name} is not registered. Please register with "
+                f"{nickname}.register first")
+        return table[key](*args, **kwargs)
+
+    create.__doc__ = f"Create a {nickname} instance by name or config."
+    return create

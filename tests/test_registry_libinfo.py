@@ -1,0 +1,120 @@
+"""mx.registry generic factory + mx.libinfo discovery (reference
+python/mxnet/registry.py, libinfo.py)."""
+import os
+import warnings
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import libinfo, registry
+
+
+class Sched:
+    def __init__(self, base=0.1):
+        self.base = base
+
+
+def _fresh_family():
+    class Fam(Sched):
+        pass
+
+    reg = registry.get_register_func(Fam, "sched")
+    alias = registry.get_alias_func(Fam, "sched")
+    create = registry.get_create_func(Fam, "sched")
+    return Fam, reg, alias, create
+
+
+def test_register_and_create_by_name():
+    Fam, reg, _, create = _fresh_family()
+
+    @reg
+    class Cosine(Fam):
+        pass
+
+    got = create("cosine")
+    assert isinstance(got, Cosine)
+    assert "cosine" in registry.get_registry(Fam)
+
+
+def test_create_passthrough_and_errors():
+    Fam, reg, _, create = _fresh_family()
+
+    @reg
+    class Poly(Fam):
+        pass
+
+    inst = Poly()
+    assert create(inst) is inst
+    with pytest.raises(ValueError):
+        create(inst, 1)                     # instance + extra args
+    with pytest.raises(ValueError):
+        create("unknown_name")
+    with pytest.raises(TypeError):
+        create(3.14)
+
+
+def test_create_from_dict_and_json():
+    Fam, reg, _, create = _fresh_family()
+
+    @reg
+    class Factor(Fam):
+        def __init__(self, base=0.1, factor=0.5):
+            super().__init__(base)
+            self.factor = factor
+
+    got = create({"sched": "factor", "factor": 0.25})
+    assert isinstance(got, Factor) and got.factor == 0.25
+    got = create('["factor", {"factor": 0.75}]')
+    assert got.factor == 0.75
+    got = create('{"sched": "factor", "base": 0.5}')
+    assert got.base == 0.5
+
+
+def test_alias_registers_many_names():
+    Fam, _, alias, create = _fresh_family()
+
+    @alias("warmup", "linwarm")
+    class Warm(Fam):
+        pass
+
+    assert isinstance(create("warmup"), Warm)
+    assert isinstance(create("LINWARM"), Warm)    # case-insensitive
+
+
+def test_override_warns():
+    Fam, reg, _, _ = _fresh_family()
+
+    @reg
+    class A(Fam):
+        pass
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        reg(type("B", (Fam,), {}), name="a")
+    assert any("overriding" in str(x.message) for x in w)
+
+
+def test_register_rejects_non_subclass():
+    Fam, reg, _, _ = _fresh_family()
+    with pytest.raises(TypeError):
+        reg(dict)
+
+
+def test_libinfo_find_lib_path():
+    paths = libinfo.find_lib_path()
+    assert paths and all(os.path.isfile(p) for p in paths)
+    assert any(p.endswith(".so") for p in paths)
+
+
+def test_libinfo_env_override(tmp_path, monkeypatch):
+    fake = tmp_path / "libcustom.so"
+    fake.write_bytes(b"\x7fELF")
+    monkeypatch.setenv("MXNET_LIBRARY_PATH", str(fake))
+    assert libinfo.find_lib_path() == [str(fake)]
+
+
+def test_libinfo_include_and_version():
+    inc = libinfo.find_include_path()
+    assert os.path.isdir(inc)
+    assert libinfo.__version__ == mx.__version__
+    assert mx.registry is registry            # lazy attr resolves

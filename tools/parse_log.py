@@ -1,0 +1,71 @@
+"""Parse a training log into a markdown table (reference
+tools/parse_log.py).
+
+Understands the log shapes this framework's examples and estimator emit:
+``Epoch[3] ... train-accuracy=0.94 ... time cost=12.3`` as well as the
+speedometer's ``Speed: 123.45 samples/sec``.
+
+    python tools/parse_log.py train.log --metric-names accuracy loss
+"""
+import argparse
+import re
+import sys
+
+
+def parse(lines, metric_names):
+    epochs = {}
+    for line in lines:
+        m_epoch = re.search(r"Epoch\s*\[?(\d+)\]?", line)
+        if not m_epoch:
+            continue
+        e = int(m_epoch.group(1))
+        row = epochs.setdefault(e, {})
+        for name in metric_names:
+            m = re.search(rf"(?:train|validation)?-?{name}[=:]\s*([0-9.eE+-]+)",
+                          line)
+            if m:
+                key = name if f"validation-{name}" not in line else \
+                    f"val-{name}"
+                row[key] = float(m.group(1))
+        m = re.search(r"[Ss]peed[:=]\s*([0-9.]+)\s*samples/sec", line)
+        if m:
+            row.setdefault("speed", []).append(float(m.group(1)))
+        m = re.search(r"[Tt]ime cost[=:]\s*([0-9.]+)", line)
+        if m:
+            row["time"] = float(m.group(1))
+    return epochs
+
+
+def to_markdown(epochs):
+    cols = sorted({k for row in epochs.values() for k in row})
+    out = ["| epoch | " + " | ".join(cols) + " |",
+           "| --- | " + " | ".join("---" for _ in cols) + " |"]
+    for e in sorted(epochs):
+        cells = []
+        for c in cols:
+            v = epochs[e].get(c, "")
+            if isinstance(v, list):
+                v = sum(v) / len(v)
+            cells.append(f"{v:.6g}" if v != "" else "")
+        out.append(f"| {e} | " + " | ".join(cells) + " |")
+    return "\n".join(out)
+
+
+def main():
+    p = argparse.ArgumentParser(description="parse a training log")
+    p.add_argument("logfile", nargs=1)
+    p.add_argument("--format", choices=["markdown", "none"],
+                   default="markdown")
+    p.add_argument("--metric-names", nargs="+", default=["accuracy"])
+    args = p.parse_args()
+    with open(args.logfile[0]) as f:
+        epochs = parse(f, args.metric_names)
+    if not epochs:
+        print("no epoch lines found", file=sys.stderr)
+        return
+    if args.format == "markdown":
+        print(to_markdown(epochs))
+
+
+if __name__ == "__main__":
+    main()

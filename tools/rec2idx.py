@@ -1,0 +1,47 @@
+"""Rebuild the .idx for a RecordIO .rec file (reference tools/rec2idx.py).
+
+The index maps record key -> byte offset so `MXIndexedRecordIO` (and the
+DataLoader random samplers over record datasets) can seek.  Scans the .rec
+sequentially and writes ``<key>\t<offset>`` lines.
+
+    python tools/rec2idx.py data.rec [data.idx]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from mxnet_tpu.recordio import MXRecordIO
+
+
+def build_index(rec_path: str, idx_path: str) -> int:
+    reader = MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as idx:
+        while True:
+            pos = reader.tell()
+            record = reader.read()
+            if record is None:
+                break
+            idx.write(f"{n}\t{pos}\n")
+            n += 1
+    reader.close()
+    return n
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="create an index file from a .rec file")
+    p.add_argument("record", help="path to the .rec file")
+    p.add_argument("index", nargs="?", default=None,
+                   help="output .idx path (default: alongside the .rec)")
+    args = p.parse_args()
+    idx = args.index or args.record.rsplit(".", 1)[0] + ".idx"
+    n = build_index(args.record, idx)
+    print(f"wrote {n} entries to {idx}")
+
+
+if __name__ == "__main__":
+    main()

@@ -547,6 +547,14 @@ def _spawn_lane(name: str, force_cpu: bool, budget: float,
     # the child must never touch the parent's partial file or its .done
     # watchdog stand-down marker
     env.pop("BENCH_PARTIAL_PATH", None)
+    # persistent XLA compilation cache: repeat runs (and the driver's
+    # end-of-round run after a builder run) skip the tunnel compile —
+    # this is what keeps the int8 lane's ~8-min graph compile inside a
+    # short tunnel window the second time around
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
     unit = "tokens/s" if name == "bert" else "img/s"
     _progress(f"lane {name}: spawning ({'cpu' if force_cpu else 'device'}, "
               f"budget {budget:.0f}s)")

@@ -56,7 +56,14 @@ def main():
     ap.add_argument("--hybridize", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="--no-hybridize runs the imperative path")
+    ap.add_argument("--device", default=None, choices=[None, "cpu", "tpu"],
+                    help="pin the training device (default: jax's default)")
     args = ap.parse_args()
+
+    if args.device:
+        ctx = mx.tpu(0) if args.device == "tpu" else mx.cpu(0)
+        ctx.__enter__()                 # process-wide default context
+        print(f"device: {ctx}")
 
     net = build_lenet()
     net.initialize(mx.init.Xavier())

@@ -24,7 +24,10 @@ __all__ = [
     "HorizontalFlipAug", "CastAug", "BrightnessJitterAug",
     "ContrastJitterAug", "SaturationJitterAug", "ColorJitterAug",
     "LightingAug", "ColorNormalizeAug", "RandomGrayAug", "CreateAugmenter",
-    "ImageIter",
+    "ImageIter", "HueJitterAug", "RandomOrderAug", "imrotate",
+    "random_rotate", "DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+    "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+    "CreateMultiRandCropAugmenter", "CreateDetAugmenter", "ImageDetIter",
 ]
 
 
@@ -461,6 +464,421 @@ class ImageIter:
             else:
                 batch_label[i] = lab.flat[:self.label_width]
             i += 1
+        from .io import DataBatch
+
+        nchw = onp.transpose(batch_data, (0, 3, 1, 2))
+        return DataBatch([array(nchw)], [array(batch_label)])
+
+    next = __next__
+
+
+# ---------------------------------------------------------------------------
+# rotation + remaining classifier augmenters (reference image.py imrotate,
+# HueJitterAug, RandomOrderAug)
+# ---------------------------------------------------------------------------
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate about the center (reference image.py imrotate).  zoom_in
+    scales so no border shows; zoom_out scales so the full rotated image
+    fits."""
+    if zoom_in and zoom_out:
+        raise ValueError("zoom_in and zoom_out are mutually exclusive")
+    cv2 = _cv2()
+    img = _as_host(src)
+    h, w = img.shape[:2]
+    rad = abs(rotation_degrees) * onp.pi / 180.0
+    c, s = float(onp.cos(rad)), float(onp.sin(rad))
+    scale = 1.0
+    if zoom_out:       # fit the whole rotated frame inside (w, h)
+        scale = min(w / (w * c + h * s), h / (w * s + h * c))
+    elif zoom_in:      # crop away any border: inverse of the zoom_out fit
+        scale = 1.0 / min(w / (w * c + h * s), h / (w * s + h * c))
+    m = cv2.getRotationMatrix2D((w / 2, h / 2), rotation_degrees, scale)
+    out = cv2.warpAffine(img, m, (w, h))
+    return array(out) if isinstance(src, NDArray) else out
+
+
+def random_rotate(src, angle_limits, zoom_in=False, zoom_out=False):
+    """Rotate by a uniform random angle in ``angle_limits`` (reference
+    image.py random_rotate)."""
+    return imrotate(src, pyrandom.uniform(*angle_limits),
+                    zoom_in=zoom_in, zoom_out=zoom_out)
+
+
+class HueJitterAug(Augmenter):
+    """Hue jitter in HSV space (reference image.py HueJitterAug)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        cv2 = _cv2()
+        img = _as_host(src).astype(onp.float32)
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        hsv = cv2.cvtColor(onp.clip(img, 0, 255).astype(onp.uint8),
+                           cv2.COLOR_RGB2HSV).astype(onp.float32)
+        hsv[..., 0] = (hsv[..., 0] + alpha * 180.0) % 180.0
+        out = cv2.cvtColor(hsv.astype(onp.uint8),
+                           cv2.COLOR_HSV2RGB).astype(onp.float32)
+        return array(out) if isinstance(src, NDArray) else out
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in random order (reference RandomOrderAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+# ---------------------------------------------------------------------------
+# detection augmenters + ImageDetIter (reference image/detection.py).
+# Boxes are [N, 5+] rows (class_id, xmin, ymin, xmax, ymax, …) with
+# coordinates NORMALIZED to [0, 1] — the reference's det-label convention.
+# ---------------------------------------------------------------------------
+
+class DetAugmenter:
+    """Detection augmenter base: __call__(img, label) -> (img, label)
+    (reference detection.py:40)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only Augmenter into the detection pipeline (reference
+    detection.py:66) — geometry-preserving augs only."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick ONE child augmenter (or skip entirely with
+    ``skip_prob``) per sample (reference detection.py:91)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or pyrandom.random() < self.skip_prob:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and x-coordinates with probability p (reference
+    detection.py:127)."""
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            img = _as_host(src)
+            src = onp.ascontiguousarray(img[:, ::-1])
+            label = label.copy()
+            x0 = 1.0 - label[:, 3]
+            x1 = 1.0 - label[:, 1]
+            label[:, 1], label[:, 3] = x0, x1
+        return src, label
+
+
+def _box_overlap_frac(label, crop):
+    """Fraction of each box's area inside crop (both normalized corner
+    boxes); crop = (x0, y0, x1, y1)."""
+    ix0 = onp.maximum(label[:, 1], crop[0])
+    iy0 = onp.maximum(label[:, 2], crop[1])
+    ix1 = onp.minimum(label[:, 3], crop[2])
+    iy1 = onp.minimum(label[:, 4], crop[3])
+    inter = onp.clip(ix1 - ix0, 0, None) * onp.clip(iy1 - iy0, 0, None)
+    area = (label[:, 3] - label[:, 1]) * (label[:, 4] - label[:, 2])
+    return onp.where(area > 0, inter / onp.maximum(area, 1e-12), 0.0)
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (reference detection.py:153): sample
+    crops until every kept object is covered >= min_object_covered; boxes
+    are re-expressed in the crop's normalized frame, and objects whose
+    center leaves the crop are ejected."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 min_eject_coverage=0.3, max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _try_crop(self, label):
+        scale = pyrandom.uniform(self.area_range[0],
+                                 min(1.0, self.area_range[1]))
+        ratio = pyrandom.uniform(*self.aspect_ratio_range)
+        cw = min(1.0, (scale * ratio) ** 0.5)
+        ch = min(1.0, (scale / ratio) ** 0.5)
+        x0 = pyrandom.uniform(0.0, 1.0 - cw)
+        y0 = pyrandom.uniform(0.0, 1.0 - ch)
+        crop = (x0, y0, x0 + cw, y0 + ch)
+        frac = _box_overlap_frac(label, crop)
+        keep = frac >= self.min_eject_coverage
+        if not keep.any():
+            return None
+        if (frac[keep] < self.min_object_covered).any():
+            return None
+        new = label[keep].copy()
+        new[:, 1] = (onp.clip(new[:, 1], x0, crop[2]) - x0) / cw
+        new[:, 3] = (onp.clip(new[:, 3], x0, crop[2]) - x0) / cw
+        new[:, 2] = (onp.clip(new[:, 2], y0, crop[3]) - y0) / ch
+        new[:, 4] = (onp.clip(new[:, 4], y0, crop[3]) - y0) / ch
+        return crop, new
+
+    def __call__(self, src, label):
+        for _ in range(self.max_attempts):
+            got = self._try_crop(label)
+            if got is None:
+                continue
+            (x0, y0, x1, y1), new_label = got
+            img = _as_host(src)
+            h, w = img.shape[:2]
+            out = img[int(y0 * h):int(y1 * h), int(x0 * w):int(x1 * w)]
+            if out.size == 0:
+                continue
+            return out, new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Pad onto a larger canvas at a random offset; boxes shrink into the
+    new normalized frame (reference detection.py:324)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        img = _as_host(src)
+        h, w = img.shape[:2]
+        for _ in range(self.max_attempts):
+            scale = pyrandom.uniform(max(1.0, self.area_range[0]),
+                                     self.area_range[1])
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            nw = int(w * (scale * ratio) ** 0.5)
+            nh = int(h * (scale / ratio) ** 0.5)
+            if nw < w or nh < h:
+                continue
+            off_x = pyrandom.randint(0, nw - w)
+            off_y = pyrandom.randint(0, nh - h)
+            canvas = onp.empty((nh, nw, img.shape[2]), img.dtype)
+            canvas[:] = onp.asarray(self.pad_val, img.dtype)
+            canvas[off_y:off_y + h, off_x:off_x + w] = img
+            new = label.copy()
+            new[:, 1] = (new[:, 1] * w + off_x) / nw
+            new[:, 3] = (new[:, 3] * w + off_x) / nw
+            new[:, 2] = (new[:, 2] * h + off_y) / nh
+            new[:, 4] = (new[:, 4] * h + off_y) / nh
+            return canvas, new
+        return img, label
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0.0):
+    """One DetRandomSelectAug over per-threshold croppers (reference
+    detection.py:418) — thresholds may be scalars or equal-length lists."""
+
+    def _as_list(v):
+        return list(v) if isinstance(v, (list, tuple)) and \
+            not isinstance(v[0], (int, float)) else [v]
+
+    covered = min_object_covered if isinstance(min_object_covered, list) \
+        else [min_object_covered]
+    aspects = aspect_ratio_range if isinstance(aspect_ratio_range[0],
+                                               (list, tuple)) \
+        else [aspect_ratio_range]
+    areas = area_range if isinstance(area_range[0], (list, tuple)) \
+        else [area_range]
+    eject = min_eject_coverage if isinstance(min_eject_coverage, list) \
+        else [min_eject_coverage]
+    n = max(len(covered), len(aspects), len(areas), len(eject))
+
+    def pick(lst, i):
+        return lst[i % len(lst)]
+
+    crops = [DetRandomCropAug(pick(covered, i), pick(aspects, i),
+                              pick(areas, i), pick(eject, i), max_attempts)
+             for i in range(n)]
+    return DetRandomSelectAug(crops, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection augmenter stack (reference detection.py:483)."""
+    augs: List[DetAugmenter] = []
+    if resize > 0:
+        augs.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        augs.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(1.0, area_range[1])),
+            min_eject_coverage, max_attempts, skip_prob=1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])),
+                              max_attempts, pad_val)
+        augs.append(DetRandomSelectAug([pad], skip_prob=1 - rand_pad))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    # force to the network input size LAST so labels stay consistent
+    augs.append(DetBorrowAug(ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    augs.append(DetBorrowAug(CastAug()))
+    color = []
+    if brightness:
+        color.append(BrightnessJitterAug(brightness))
+    if contrast:
+        color.append(ContrastJitterAug(contrast))
+    if saturation:
+        color.append(SaturationJitterAug(saturation))
+    if hue:
+        color.append(HueJitterAug(hue))
+    if color:
+        augs.append(DetBorrowAug(RandomOrderAug(color)))
+    if pca_noise > 0:
+        augs.append(DetBorrowAug(LightingAug(pca_noise)))
+    if rand_gray > 0:
+        augs.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53], onp.float32)
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375], onp.float32)
+    if mean is not None or std is not None:
+        mean = onp.zeros(3, onp.float32) if mean is None \
+            else onp.asarray(mean, onp.float32)
+        std = onp.ones(3, onp.float32) if std is None \
+            else onp.asarray(std, onp.float32)
+        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return augs
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator (reference detection.py:625): labels are the
+    reference det format — per image ``[header_width, obj_width,
+    (extra header...), (id, xmin, ymin, xmax, ymax, ...) * N]`` with
+    normalized coords.  Batches pad object counts with -1 rows."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+        # label_width=1 is a placeholder — det labels are variable-width
+        # and parsed per sample by _parse_label instead
+        super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         shuffle=shuffle, aug_list=[],
+                         imglist=imglist, label_width=1)
+        self.auglist = aug_list
+        # rebuild list labels at FULL width (ImageIter narrowed them to
+        # label_width scalars)
+        if imglist is not None:
+            self.imglist = [(onp.asarray(e[0], onp.float32).ravel(), e[-1])
+                            for e in imglist]
+        elif path_imglist:
+            entries = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    entries.append((onp.asarray(
+                        [float(p) for p in parts[1:-1]], onp.float32),
+                        parts[-1]))
+            self.imglist = entries
+
+    @staticmethod
+    def _parse_label(raw):
+        """Flat det label -> [N, obj_width] float array (id, x0, y0, x1,
+        y1, ...)."""
+        raw = onp.asarray(raw, onp.float32).ravel()
+        if raw.size < 2:
+            raise MXNetError("det label must carry header+object widths")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5:
+            raise MXNetError("det object width must be >= 5")
+        body = raw[header_width:]
+        n = body.size // obj_width
+        return body[:n * obj_width].reshape(n, obj_width)
+
+    def next_sample(self):
+        label, buf = super().next_sample()
+        return self._parse_label(label), buf
+
+    def __next__(self):
+        c, h, w = self.data_shape
+        batch_data = onp.zeros((self.batch_size, h, w, c), onp.float32)
+        rows = []
+        i = 0
+        while i < self.batch_size:
+            label, buf = self.next_sample()
+            img = imdecode(buf)
+            img = _as_host(img)
+            for aug in self.auglist:
+                img, label = aug(img, label)
+            arr = _as_host(img)
+            if arr.shape[:2] != (h, w):
+                arr = _cv2().resize(arr, (w, h))
+            batch_data[i] = arr
+            rows.append(label)
+            i += 1
+        maxn = max(len(r) for r in rows)
+        obj_w = rows[0].shape[1]
+        batch_label = onp.full((self.batch_size, max(maxn, 1), obj_w),
+                               -1.0, onp.float32)
+        for i, r in enumerate(rows):
+            if len(r):
+                batch_label[i, :len(r)] = r
         from .io import DataBatch
 
         nchw = onp.transpose(batch_data, (0, 3, 1, 2))

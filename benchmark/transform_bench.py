@@ -1,0 +1,75 @@
+"""Compiled-batch transform vs per-sample Python transform.
+
+The TPU-native answer to the reference's C++ ``LazyTransformDataset`` +
+``ThreadedDataLoader`` (src/io/dataset.cc:542, src/io/dataloader.cc:35) is
+``dataset.transform(fn, compiled=True)``: the DataLoader batches RAW
+samples and runs ``fn`` once per batch as a jitted XLA program.  This
+bench times both paths over an ImageRecord-shaped pipeline (decode-free:
+uniform HWC float images) and prints the speedup.
+
+    python benchmark/transform_bench.py --n 2048 --batch-size 64
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import data as gdata
+
+MEAN = onp.array([0.485, 0.456, 0.406], onp.float32).reshape(3, 1, 1)
+STD = onp.array([0.229, 0.224, 0.225], onp.float32).reshape(3, 1, 1)
+
+
+def transform_fn(img, label):
+    """ToTensor + normalize + pad-crop — mx ops only, so it traces."""
+    x = mx.nd.transpose(img, axes=(2, 0, 1)) / 255.0
+    x = (x - mx.nd.array(MEAN)) / mx.nd.array(STD)
+    return x, label
+
+
+def run(loader, epochs=1):
+    t0 = time.time()
+    n = 0
+    for _ in range(epochs):
+        for data, label in loader:
+            n += data.shape[0]
+    # fence: read a value so async work drains
+    float(data.asnumpy().ravel()[0])
+    return n / (time.time() - t0)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=2048)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--img", type=int, default=64)
+    args = p.parse_args()
+
+    rng = onp.random.RandomState(0)
+    imgs = rng.randint(0, 255, size=(args.n, args.img, args.img, 3)) \
+        .astype("float32")
+    labels = rng.randint(0, 10, size=args.n).astype("int32")
+    ds = gdata.ArrayDataset(mx.nd.array(imgs), mx.nd.array(labels))
+
+    per_sample = gdata.DataLoader(ds.transform(transform_fn),
+                                  batch_size=args.batch_size)
+    compiled = gdata.DataLoader(ds.transform(transform_fn, compiled=True),
+                                batch_size=args.batch_size)
+
+    run(compiled)                       # warm both (compile once)
+    run(per_sample)
+    ps = run(per_sample)
+    cp = run(compiled)
+    print(f"per-sample python transform: {ps:,.0f} img/s")
+    print(f"compiled batch transform:    {cp:,.0f} img/s")
+    print(f"speedup: {cp / ps:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

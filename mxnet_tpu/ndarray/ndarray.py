@@ -430,6 +430,7 @@ class NDArray:
     # ------------------------------------------------------------------
     def __getitem__(self, key) -> "NDArray":
         key = _index_unwrap(key)
+        _check_int_bounds(key, self.shape)
         return invoke("_index", [self], {"key": key})
 
     def __setitem__(self, key, value):
@@ -440,7 +441,9 @@ class NDArray:
             pass
         else:
             value = jnp.asarray(value)
-        if key is Ellipsis or key == slice(None):
+        _check_int_bounds(key, self.shape)
+        if key is Ellipsis or (isinstance(key, slice) and
+                               key == slice(None)):
             if isinstance(value, numbers.Number):
                 self._set_data(jnp.full(self.shape, value, self._data.dtype))
             else:
@@ -610,6 +613,33 @@ def _index_unwrap(key):
     if isinstance(key, tuple):
         return tuple(k._data if isinstance(k, NDArray) else k for k in key)
     return key
+
+
+def _check_int_bounds(key, shape):
+    """Raise IndexError for out-of-range CONCRETE integer indices — jax
+    silently clips them, the reference raises (test_ndarray indexing
+    contract).  Array/traced indices keep jax's clip semantics (that IS
+    the documented device behavior for gather)."""
+    ints = (key,) if isinstance(key, int) else \
+        tuple(k for k in key if isinstance(k, int)) \
+        if isinstance(key, tuple) else ()
+    if not ints:
+        return
+    dims = iter(shape)
+    keys = key if isinstance(key, tuple) else (key,)
+    for k in keys:
+        if k is None or k is Ellipsis:
+            # newaxis consumes no dim; Ellipsis realigns dims from the
+            # right — bounds past it are rare, skip the strict check
+            if k is Ellipsis:
+                return
+            continue
+        d = next(dims, None)
+        if d is None:
+            raise IndexError(f"too many indices for shape {shape}")
+        if isinstance(k, int) and not (-d <= k < d):
+            raise IndexError(
+                f"index {k} is out of bounds for axis with size {d}")
 
 
 def invoke(

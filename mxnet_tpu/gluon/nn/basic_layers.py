@@ -20,6 +20,7 @@ __all__ = [
     "Dense",
     "Dropout",
     "BatchNorm",
+    "BatchNormReLU",
     "SyncBatchNorm",
     "InstanceNorm",
     "LayerNorm",
@@ -257,6 +258,20 @@ class BatchNorm(HybridBlock):
         return f"BatchNorm(axis={self._axis}, eps={self._epsilon}, " \
                f"momentum={self._momentum}, in_channels={self.gamma.shape[0] if self.gamma.shape else None})"
 
+
+
+class BatchNormReLU(BatchNorm):
+    """BatchNorm with a fused trailing ReLU (reference basic_layers.py
+    BatchNormReLU / src/operator/nn/batch_norm.cc bn_relu fusion — on TPU
+    XLA fuses the relu into the normalization epilogue anyway; the class
+    exists for API parity and graph clarity)."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return invoke("relu", [out], {})
+
+    def __repr__(self):
+        return super().__repr__().replace("BatchNorm(", "BatchNormReLU(", 1)
 
 class SyncBatchNorm(BatchNorm):
     """Cross-device synchronized BatchNorm (reference

@@ -17,6 +17,8 @@ __all__ = [
     "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
     "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D",
     "ReflectionPad2D",
+    "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
+    "DeformableConvolution", "ModulatedDeformableConvolution",
 ]
 
 
@@ -298,3 +300,168 @@ class ReflectionPad2D(HybridBlock):
     def forward(self, x):
         return invoke("pad", [x],
                       {"mode": "reflect", "pad_width": self._padding})
+
+
+class _PixelShuffle(HybridBlock):
+    """Sub-pixel upsampling (reference conv_layers.py PixelShuffle1-3D):
+    regroup channel blocks into spatial blocks — pure reshape/transpose,
+    which XLA folds into neighboring ops for free."""
+
+    def __init__(self, factor, ndim):
+        super().__init__()
+        if isinstance(factor, int):
+            self._factors = (factor,) * ndim
+        else:
+            self._factors = tuple(int(f) for f in factor)
+            if len(self._factors) != ndim:
+                raise ValueError(
+                    f"factor must be an int or length-{ndim} tuple")
+
+    def forward(self, x):
+        fs = self._factors
+        n = len(fs)
+        shape = x.shape               # (N, prod(f)*C, *spatial)
+        fprod = 1
+        for f in fs:
+            fprod *= f
+        C = shape[1] // fprod
+        spatial = shape[2:]
+        # (N, f1..fn, C, s1..sn) -> interleave (si, fi) pairs
+        x = x.reshape((shape[0],) + fs + (C,) + spatial)
+        perm = [0, n + 1]
+        for i in range(n):
+            perm += [n + 2 + i, 1 + i]
+        x = x.transpose(perm)
+        out_spatial = tuple(s * f for s, f in zip(spatial, fs))
+        return x.reshape((shape[0], C) + out_spatial)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factors})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, f*C, W) -> (N, C, W*f)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 1)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, f1*f2*C, H, W) -> (N, C, H*f1, W*f2)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 2)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, f1*f2*f3*C, D, H, W) -> (N, C, D*f1, H*f2, W*f3)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 3)
+
+
+class DeformableConvolution(HybridBlock):
+    """Deformable convolution v1 layer (reference conv_layers.py
+    DeformableConvolution): an internal regular conv predicts per-position
+    sampling offsets, the main kernel samples there.  Offset conv weights
+    initialize to zero so training starts as a plain convolution."""
+
+    _op_name = "DeformableConvolution"
+    _mask_factor = 0          # v2 adds kh*kw*ndg mask channels
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 **kwargs):
+        super().__init__()
+        from ... import initializer as init
+
+        if layout != "NCHW":
+            raise ValueError("deformable convolution supports NCHW layout")
+        kernel_size = _tuple(kernel_size, 2)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._ndg = num_deformable_group
+        kh, kw = kernel_size
+        self._kwargs = {
+            "kernel": kernel_size, "stride": _tuple(strides, 2),
+            "dilate": _tuple(dilation, 2), "pad": _tuple(padding, 2),
+            "num_filter": channels, "num_group": groups,
+            "num_deformable_group": num_deformable_group,
+            "no_bias": not use_bias, "layout": layout,
+        }
+        off_channels = (2 + (1 if self._mask_factor else 0)) * \
+            kh * kw * num_deformable_group
+
+        def _init(v):
+            return init.create(v) if isinstance(v, str) else v
+
+        self._offset = Conv2D(off_channels, kernel_size,
+                              strides=_tuple(strides, 2),
+                              padding=_tuple(padding, 2),
+                              dilation=_tuple(dilation, 2),
+                              use_bias=offset_use_bias,
+                              in_channels=in_channels,
+                              weight_initializer=_init(
+                                  offset_weight_initializer),
+                              bias_initializer=offset_bias_initializer)
+        self.register_child(self._offset, "offset_conv")
+        self._groups = groups
+        self.weight = Parameter(
+            "weight",
+            shape=(channels, in_channels // groups if in_channels else 0)
+            + kernel_size,
+            init=_init(weight_initializer), allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(channels,),
+                              init=init.create(bias_initializer),
+                              allow_deferred_init=True) if use_bias else None
+        self.act = Activation(activation) if activation else None
+        if self.act is not None:
+            self.register_child(self.act, "act")
+
+    def infer_shape(self, x):
+        in_c = int(x.shape[1])
+        self.weight.shape = (self._channels, in_c // self._groups) + \
+            tuple(self._kwargs["kernel"])
+        self._in_channels = in_c
+
+    def _split_offset(self, raw):
+        return raw, None
+
+    def forward(self, x):
+        raw = self._offset(x)
+        offset, mask = self._split_offset(raw)
+        args = [x, offset]
+        if mask is not None:
+            args.append(mask)
+        args.append(self.weight.data(x.ctx))
+        if self.bias is not None:
+            args.append(self.bias.data(x.ctx))
+        out = invoke(self._op_name, args, dict(self._kwargs))
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._in_channels} -> "
+                f"{self._channels}, kernel_size={self._kwargs['kernel']})")
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """Deformable convolution v2 (reference conv_layers.py
+    ModulatedDeformableConvolution): the offset conv additionally predicts
+    a sigmoid modulation mask per sampling point."""
+
+    _op_name = "ModulatedDeformableConvolution"
+    _mask_factor = 1
+
+    def _split_offset(self, raw):
+        kh, kw = self._kwargs["kernel"]
+        n_off = 2 * kh * kw * self._ndg
+        offset = raw[:, :n_off]
+        mask = invoke("sigmoid", [raw[:, n_off:]], {})
+        return offset, mask

@@ -352,6 +352,13 @@ def deformable_convolution(arrays, kernel=(3, 3), stride=(1, 1),
     """
     data, offset, weight = arrays[0], arrays[1], arrays[2]
     bias = None if no_bias or len(arrays) < 4 else arrays[3]
+    return _deform_conv_impl(data, offset, weight, bias, kernel, stride,
+                             dilate, pad, num_filter, num_group,
+                             num_deformable_group)
+
+
+def _deform_conv_impl(data, offset, weight, bias, kernel, stride, dilate,
+                      pad, num_filter, num_group, ndg, mask=None):
     B, C, H, W = data.shape
     kh, kw = kernel
     sh, sw = stride
@@ -359,7 +366,6 @@ def deformable_convolution(arrays, kernel=(3, 3), stride=(1, 1),
     ph, pw = pad
     Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
     Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
-    ndg = num_deformable_group
     O = num_filter
     g = num_group
 
@@ -373,10 +379,12 @@ def deformable_convolution(arrays, kernel=(3, 3), stride=(1, 1),
     base_y = jnp.broadcast_to(base_y[:, None], (kh, kw, Ho, Wo))
     base_x = jnp.broadcast_to(base_x[None, :, :, :], (kh, kw, Ho, Wo))
 
-    def sample_one(dat, off):
+    def sample_one(dat, off, msk):
         # dat [C,H,W]; off [2*kh*kw*ndg, Ho, Wo] layout: per deform group,
-        # per kernel point, (dy, dx)
+        # per kernel point, (dy, dx); msk [ndg*kh*kw, Ho, Wo] or None
         off = off.reshape(ndg, kh * kw, 2, Ho, Wo)
+        if msk is not None:
+            msk = msk.reshape(ndg, kh * kw, Ho, Wo)
         cs = C // ndg
         outs = []
         for dg in range(ndg):
@@ -390,10 +398,15 @@ def deformable_convolution(arrays, kernel=(3, 3), stride=(1, 1),
             samp = jax.vmap(
                 lambda xg, yg: _bilinear_sample_2d(sub, xg, yg),
                 in_axes=(0, 0), out_axes=1)(gx, gy)
+            if msk is not None:     # DCNv2 modulation per kernel point
+                samp = samp * msk[dg][None]
             outs.append(samp)
         return jnp.concatenate(outs, axis=0)    # [C, kh*kw, Ho, Wo]
 
-    cols = jax.vmap(sample_one)(data, offset)   # [B,C,kh*kw,Ho,Wo]
+    if mask is None:
+        cols = jax.vmap(lambda d, o: sample_one(d, o, None))(data, offset)
+    else:
+        cols = jax.vmap(sample_one)(data, offset, mask)
     cols = cols.reshape(B, g, C // g, kh, kw, Ho, Wo)
     wgt = weight.reshape(g, O // g, C // g, kh, kw)
     out = jnp.einsum("bgchkxy,gochk->bgoxy", cols, wgt,
@@ -402,6 +415,28 @@ def deformable_convolution(arrays, kernel=(3, 3), stride=(1, 1),
     if bias is not None:
         out = out + bias.reshape(1, O, 1, 1)
     return out
+
+
+@register("ModulatedDeformableConvolution", num_inputs=-1,
+          aliases=["modulated_deformable_convolution",
+                   "_npx_modulated_deformable_convolution"])
+def modulated_deformable_convolution(arrays, kernel=(3, 3), stride=(1, 1),
+                                     dilate=(1, 1), pad=(0, 0),
+                                     num_filter=1, num_group=1,
+                                     num_deformable_group=1, no_bias=False,
+                                     workspace=1024, layout=None):
+    """Deformable convolution v2 (reference
+    src/operator/contrib/modulated_deformable_convolution.cc): v1 sampling
+    plus a learned per-sample-point modulation mask.
+
+    arrays = [data, offset [B,2*kh*kw*ndg,Ho,Wo], mask [B,kh*kw*ndg,Ho,Wo]
+    (already sigmoided by the layer), weight, (bias)].
+    """
+    data, offset, mask, weight = arrays[0], arrays[1], arrays[2], arrays[3]
+    bias = None if no_bias or len(arrays) < 5 else arrays[4]
+    return _deform_conv_impl(data, offset, weight, bias, kernel, stride,
+                             dilate, pad, num_filter, num_group,
+                             num_deformable_group, mask=mask)
 
 
 # ---------------------------------------------------------------------------

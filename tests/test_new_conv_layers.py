@@ -1,0 +1,174 @@
+"""PixelShuffle1/2/3D, BatchNormReLU, DeformableConvolution(+Modulated) —
+reference gluon/nn/conv_layers.py + basic_layers.py round-4 layer gap."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+_R = onp.random.RandomState(21)
+
+
+# ---------------------------------------------------------------------------
+# pixel shuffle: numpy oracle built from the definition
+# ---------------------------------------------------------------------------
+
+def _pixel_shuffle_ref(x, factors):
+    n = len(factors)
+    N = x.shape[0]
+    fprod = int(onp.prod(factors))
+    C = x.shape[1] // fprod
+    spatial = x.shape[2:]
+    x = x.reshape((N,) + tuple(factors) + (C,) + spatial)
+    perm = [0, n + 1]
+    for i in range(n):
+        perm += [n + 2 + i, 1 + i]
+    x = x.transpose(perm)
+    return x.reshape((N, C) + tuple(s * f for s, f in zip(spatial, factors)))
+
+
+def test_pixel_shuffle_2d_shape_doc_example():
+    pxshuf = nn.PixelShuffle2D((2, 3))
+    x = nd.zeros((1, 12, 3, 5))
+    assert pxshuf(x).shape == (1, 2, 6, 15)
+
+
+@pytest.mark.parametrize("cls,factor,shape", [
+    (nn.PixelShuffle1D, 3, (2, 6, 4)),
+    (nn.PixelShuffle2D, 2, (2, 8, 3, 5)),
+    (nn.PixelShuffle2D, (2, 3), (1, 12, 3, 5)),
+    (nn.PixelShuffle3D, 2, (1, 16, 2, 3, 4)),
+])
+def test_pixel_shuffle_values(cls, factor, shape):
+    host = _R.rand(*shape).astype("float32")
+    layer = cls(factor)
+    got = layer(nd.array(host)).asnumpy()
+    fs = (factor,) * {nn.PixelShuffle1D: 1, nn.PixelShuffle2D: 2,
+                      nn.PixelShuffle3D: 3}[cls] \
+        if isinstance(factor, int) else tuple(factor)
+    onp.testing.assert_allclose(got, _pixel_shuffle_ref(host, fs), rtol=1e-6)
+
+
+def test_pixel_shuffle_hybridize_equivalence():
+    layer = nn.PixelShuffle2D(2)
+    x = nd.array(_R.rand(2, 8, 4, 4).astype("float32"))
+    eager = layer(x).asnumpy()
+    layer.hybridize()
+    onp.testing.assert_allclose(layer(x).asnumpy(), eager, rtol=1e-6)
+
+
+def test_pixel_shuffle_bad_factor():
+    with pytest.raises(ValueError):
+        nn.PixelShuffle2D((2, 3, 4))
+
+
+# ---------------------------------------------------------------------------
+# BatchNormReLU
+# ---------------------------------------------------------------------------
+
+def test_batchnorm_relu_matches_bn_plus_relu():
+    x = nd.array((_R.rand(4, 3, 5, 5) * 2 - 1).astype("float32"))
+    bnr = nn.BatchNormReLU(in_channels=3)
+    bn = nn.BatchNorm(in_channels=3)
+    bnr.initialize()
+    bn.initialize()
+    out = bnr(x).asnumpy()
+    want = onp.maximum(bn(x).asnumpy(), 0.0)
+    onp.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    assert (out >= 0).all()
+
+
+def test_batchnorm_relu_training_updates_stats():
+    x = nd.array(_R.rand(8, 3, 4, 4).astype("float32") + 2.0)
+    bnr = nn.BatchNormReLU(in_channels=3)
+    bnr.initialize()
+    with autograd.record():
+        y = bnr(x)
+        y.sum().backward()
+    rm = bnr.running_mean.data().asnumpy()
+    assert (rm > 0).all()           # moved toward the (positive) batch mean
+
+
+# ---------------------------------------------------------------------------
+# deformable convolutions
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offsets_equals_conv():
+    """Zero-initialized offset conv => exactly a plain convolution."""
+    x = nd.array(_R.rand(2, 4, 8, 8).astype("float32"))
+    dcn = nn.DeformableConvolution(6, kernel_size=(3, 3), padding=(1, 1),
+                                   in_channels=4)
+    dcn.initialize()
+    conv = nn.Conv2D(6, kernel_size=3, padding=1, in_channels=4)
+    conv.initialize()
+    conv.weight.set_data(dcn.weight.data())
+    conv.bias.set_data(dcn.bias.data())
+    got = dcn(x).asnumpy()
+    want = conv(x).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_offsets_change_output():
+    x = nd.array(_R.rand(1, 2, 6, 6).astype("float32"))
+    dcn = nn.DeformableConvolution(3, kernel_size=(3, 3), padding=(1, 1),
+                                   in_channels=2,
+                                   offset_weight_initializer=None)
+    dcn.initialize(mx.init.Normal(0.5))
+    base = nn.Conv2D(3, kernel_size=3, padding=1, in_channels=2)
+    base.initialize()
+    base.weight.set_data(dcn.weight.data())
+    base.bias.set_data(dcn.bias.data())
+    # random (non-zero) offsets: output differs from the rigid conv
+    assert not onp.allclose(dcn(x).asnumpy(), base(x).asnumpy(),
+                            atol=1e-5)
+
+
+def test_deformable_conv_gradients_flow():
+    x = nd.array(_R.rand(2, 3, 6, 6).astype("float32"))
+    dcn = nn.DeformableConvolution(4, kernel_size=(3, 3), padding=(1, 1),
+                                   in_channels=3)
+    dcn.initialize()
+    with autograd.record():
+        loss = (dcn(x) ** 2).sum()
+    loss.backward()
+    g = dcn.weight.grad().asnumpy()
+    assert onp.isfinite(g).all() and onp.abs(g).sum() > 0
+    og = dcn._offset.weight.grad().asnumpy()
+    assert onp.isfinite(og).all()
+
+
+def test_modulated_deformable_conv_zero_init_is_half_conv():
+    """DCNv2 with zero-init offset conv: mask = sigmoid(0) = 0.5, so the
+    output is exactly half the rigid convolution (plus bias)."""
+    x = nd.array(_R.rand(2, 3, 7, 7).astype("float32"))
+    dcn = nn.ModulatedDeformableConvolution(5, kernel_size=(3, 3),
+                                            padding=(1, 1), in_channels=3,
+                                            use_bias=False)
+    dcn.initialize()
+    conv = nn.Conv2D(5, kernel_size=3, padding=1, in_channels=3,
+                     use_bias=False)
+    conv.initialize()
+    conv.weight.set_data(dcn.weight.data())
+    onp.testing.assert_allclose(dcn(x).asnumpy(),
+                                0.5 * conv(x).asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_modulated_deformable_conv_hybridize():
+    x = nd.array(_R.rand(1, 2, 5, 5).astype("float32"))
+    dcn = nn.ModulatedDeformableConvolution(3, kernel_size=(3, 3),
+                                            padding=(1, 1), in_channels=2)
+    dcn.initialize()
+    eager = dcn(x).asnumpy()
+    dcn.hybridize()
+    onp.testing.assert_allclose(dcn(x).asnumpy(), eager, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_deformable_conv_deferred_in_channels():
+    dcn = nn.DeformableConvolution(4, kernel_size=(3, 3), padding=(1, 1))
+    dcn.initialize()
+    out = dcn(nd.ones((1, 5, 6, 6)))
+    assert out.shape == (1, 4, 6, 6)
+    assert dcn.weight.shape == (4, 5, 3, 3)

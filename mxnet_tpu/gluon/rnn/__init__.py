@@ -4,6 +4,8 @@ from .conv_rnn_cell import (Conv1DGRUCell, Conv1DLSTMCell, Conv1DRNNCell,
                             Conv2DGRUCell, Conv2DLSTMCell, Conv2DRNNCell,
                             Conv3DGRUCell, Conv3DLSTMCell, Conv3DRNNCell)
 from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,
-                       HybridSequentialRNNCell, LSTMCell, RecurrentCell,
-                       ResidualCell, RNNCell, SequentialRNNCell, ZoneoutCell)
+                       HybridRecurrentCell, HybridSequentialRNNCell,
+                       LSTMCell, LSTMPCell, ModifierCell, RecurrentCell,
+                       ResidualCell, RNNCell, SequentialRNNCell,
+                       VariationalDropoutCell, ZoneoutCell)
 from .rnn_layer import GRU, LSTM, RNN

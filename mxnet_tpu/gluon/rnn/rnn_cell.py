@@ -407,3 +407,113 @@ def _unroll_steps(cell, inputs, states, valid_length=None):
         states = new_states
         outs.append(o)
     return outs, states
+
+
+# reference rnn_cell.py exposes both spellings; cells here are hybrid by
+# construction (everything lowers to lax.scan under hybridize)
+HybridRecurrentCell = RecurrentCell
+ModifierCell = _ModifierCell
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """Variational (time-locked) dropout around a base cell (reference
+    rnn_cell.py:1090, arXiv:1512.05287): ONE mask per sequence for each of
+    inputs/states/outputs, sampled at the first step after ``reset`` and
+    reused across time steps."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        if drop_states and isinstance(base_cell, BidirectionalCell):
+            raise ValueError(
+                "BidirectionalCell doesn't support variational state "
+                "dropout; apply VariationalDropoutCell to the cells "
+                "underneath instead.")
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._masks = {}
+
+    def reset(self):
+        super().reset()
+        self._masks = {}
+
+    def _mask(self, name, rate, like):
+        m = self._masks.get(name)
+        if m is None or m.shape != like.shape:
+            keep = _dropout((like * 0 + 1), rate)
+            self._masks[name] = m = keep
+        return m
+
+    def forward(self, x, states):
+        if autograd.is_training():
+            if self.drop_inputs:
+                x = x * self._mask("i", self.drop_inputs, x)
+            if self.drop_states:
+                states = [s * self._mask(f"s{k}", self.drop_states, s)
+                          for k, s in enumerate(states)]
+        out, new_states = self.base_cell(x, states)
+        if autograd.is_training() and self.drop_outputs:
+            out = out * self._mask("o", self.drop_outputs, out)
+        return out, new_states
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell(p_in={self.drop_inputs}, "
+                f"p_state={self.drop_states}, p_out={self.drop_outputs})")
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a recurrent projection (reference rnn_cell.py:1260,
+    arXiv:1402.1128): the recurrent state is ``r = W_hr h`` of size
+    ``projection_size`` — cuts the h2h matmul from O(H^2) to O(H*P),
+    which on the MXU also means a better-shaped weight tile."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 dtype="float32"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        ng = 4 * hidden_size
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(ng, input_size) if input_size else None,
+            dtype=dtype, allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(ng, projection_size),
+                                    dtype=dtype)
+        self.h2r_weight = Parameter("h2r_weight",
+                                    shape=(projection_size, hidden_size),
+                                    dtype=dtype)
+        self.i2h_bias = Parameter("i2h_bias", shape=(ng,), dtype=dtype)
+        self.h2h_bias = Parameter("h2h_bias", shape=(ng,), dtype=dtype)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        if self.i2h_weight.shape is None or \
+                any(s == 0 for s in self.i2h_weight.shape):
+            self.i2h_weight.shape = (4 * self._hidden_size,
+                                     int(x.shape[-1]))
+
+    def forward(self, x, states):
+        r, c = states
+        ng = 4 * self._hidden_size
+        gates = invoke("FullyConnected",
+                       [x, self.i2h_weight.data(x.ctx),
+                        self.i2h_bias.data(x.ctx)], {"num_hidden": ng}) + \
+            invoke("FullyConnected",
+                   [r, self.h2h_weight.data(x.ctx),
+                    self.h2h_bias.data(x.ctx)], {"num_hidden": ng})
+        i, f, g, o = gates.split(num_outputs=4, axis=-1)
+        c_new = f.sigmoid() * c + i.sigmoid() * g.tanh()
+        h_new = o.sigmoid() * c_new.tanh()
+        r_new = invoke("FullyConnected",
+                       [h_new, self.h2r_weight.data(x.ctx)],
+                       {"num_hidden": self._projection_size,
+                        "no_bias": True})
+        return r_new, [r_new, c_new]
+
+    def __repr__(self):
+        return (f"LSTMPCell({self._hidden_size} -> "
+                f"{self._projection_size})")

@@ -3,8 +3,8 @@ from .optimizer import (Optimizer, Test, Updater, create, get_updater,
                         register)
 from .sgd import SGD, NAG, SGLD, Signum, DCASGD, LARS
 from .adam import Adam, AdaMax, Nadam, FTML, Ftrl, AdamW
-from .adagrad import AdaGrad, AdaDelta, RMSProp
-from .lamb import LAMB
+from .adagrad import AdaGrad, AdaDelta, RMSProp, GroupAdaGrad
+from .lamb import LAMB, LANS
 
 __all__ = [
     "Optimizer", "Test", "Updater", "create", "get_updater", "register",

@@ -6,7 +6,7 @@ from .. import ndarray as nd
 from ..ndarray.ndarray import invoke
 from .optimizer import Optimizer, register
 
-__all__ = ["AdaGrad", "AdaDelta", "RMSProp"]
+__all__ = ["AdaGrad", "AdaDelta", "RMSProp", "GroupAdaGrad"]
 
 
 def _clip(v):
@@ -104,5 +104,33 @@ class RMSProp(Optimizer):
                 attrs["momentum"] = self.momentum
                 invoke("rmspropalex_update", [weight, grad, n, g, delta],
                        attrs, out=[weight, n, g, delta])
+
+    step = fused_step
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """Per-row AdaGrad for embedding tables (reference
+    python/mxnet/optimizer/contrib.py GroupAdaGrad; op
+    contrib/optimizer_op-inl.h group_adagrad_update): history accumulates
+    one scalar per ROW, so the state is rows-sized, not weight-sized."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-5,
+                 use_fused_step=True, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return nd.zeros((weight.shape[0],), weight.ctx, dtype=weight.dtype)
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, _ = self._get_lrs(indices), self._get_wds(indices)
+        for weight, grad, state, lr in zip(weights, grads, states, lrs):
+            invoke("group_adagrad_update", [weight, grad, state],
+                   {"lr": lr, "epsilon": self.epsilon,
+                    "rescale_grad": self.rescale_grad,
+                    "clip_gradient": _clip(self.clip_gradient)},
+                   out=[weight, state])
 
     step = fused_step

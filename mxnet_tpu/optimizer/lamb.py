@@ -6,7 +6,7 @@ from .. import ndarray as nd
 from ..ndarray.ndarray import invoke
 from .optimizer import Optimizer, register
 
-__all__ = ["LAMB"]
+__all__ = ["LAMB", "LANS"]
 
 
 def _clip(v):
@@ -58,5 +58,53 @@ class LAMB(Optimizer):
                     "lower_bound": _clip(self.lower_bound),
                     "upper_bound": _clip(self.upper_bound)},
                    out=weight)
+
+    step = fused_step
+
+
+@register
+class LANS(Optimizer):
+    """LANS — LAMB with gradient normalization and a Nesterov-style blend
+    (reference python/mxnet/optimizer/lans.py; fused multi-tensor op
+    contrib/multi_lans.cc).  The whole parameter group updates in ONE
+    fused XLA computation via ``multi_lans_update``."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 aggregate_num=4, use_fused_step=True, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         use_fused_step=use_fused_step,
+                         aggregate_num=aggregate_num, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+
+    def fused_step(self, indices, weights, grads, states):
+        lrs, wds = self._get_lrs(indices), self._get_wds(indices)
+        arrays = []
+        for w, g, s in zip(weights, grads, states):
+            arrays += [w, g, s[0], s[1]]
+        steps = tuple(self._index_update_count[i] for i in indices)
+        outs = invoke(
+            "multi_lans_update", arrays,
+            {"learning_rates": tuple(lrs), "wds": tuple(wds),
+             "beta1": self.beta1, "beta2": self.beta2,
+             "epsilon": self.epsilon,
+             "rescale_grad": self.rescale_grad,
+             "lower_bound": _clip(self.lower_bound),
+             "upper_bound": _clip(self.upper_bound),
+             "clip_gradient": _clip(self.clip_gradient),
+             "step_count": steps, "num_tensors": len(weights)})
+        n = len(weights)
+        for i, (w, s) in enumerate(zip(weights, states)):
+            w._set_data(outs[i]._data)
+            s[0]._set_data(outs[n + i]._data)
+            s[1]._set_data(outs[2 * n + i]._data)
 
     step = fused_step

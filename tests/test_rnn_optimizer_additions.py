@@ -1,0 +1,183 @@
+"""LSTMPCell, VariationalDropoutCell, ModifierCell aliases + LANS and
+GroupAdaGrad optimizers (reference rnn_cell.py:1090-1399,
+optimizer/lans.py, optimizer/contrib.py GroupAdaGrad)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, optimizer as opt
+
+_R = onp.random.RandomState(31)
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+
+def _sigmoid(x):
+    return 1 / (1 + onp.exp(-x))
+
+
+def test_lstmp_cell_numpy_oracle():
+    cell = gluon.rnn.LSTMPCell(6, 3, input_size=4)
+    cell.initialize(mx.init.Normal(0.3))
+    x = _R.rand(2, 4).astype("float32")
+    r0 = _R.rand(2, 3).astype("float32")
+    c0 = _R.rand(2, 6).astype("float32")
+    out, (r1, c1) = cell(nd.array(x), [nd.array(r0), nd.array(c0)])
+
+    wi = cell.i2h_weight.data().asnumpy()
+    wh = cell.h2h_weight.data().asnumpy()
+    wr = cell.h2r_weight.data().asnumpy()
+    bi = cell.i2h_bias.data().asnumpy()
+    bh = cell.h2h_bias.data().asnumpy()
+    gates = x @ wi.T + bi + r0 @ wh.T + bh
+    i, f, g, o = onp.split(gates, 4, axis=-1)
+    c_new = _sigmoid(f) * c0 + _sigmoid(i) * onp.tanh(g)
+    h_new = _sigmoid(o) * onp.tanh(c_new)
+    r_new = h_new @ wr.T
+    onp.testing.assert_allclose(c1.asnumpy(), c_new, rtol=2e-5, atol=2e-5)
+    onp.testing.assert_allclose(out.asnumpy(), r_new, rtol=2e-5, atol=2e-5)
+    assert out.shape == (2, 3)          # projected size
+
+
+def test_lstmp_cell_unroll_and_grad():
+    cell = gluon.rnn.LSTMPCell(8, 4, input_size=5)
+    cell.initialize()
+    seq = nd.array(_R.rand(3, 7, 5).astype("float32"))
+    with autograd.record():
+        outs, _ = cell.unroll(7, seq, layout="NTC", merge_outputs=True)
+        loss = (outs ** 2).sum()
+    loss.backward()
+    assert outs.shape == (3, 7, 4)
+    g = cell.h2r_weight.grad().asnumpy()
+    assert onp.isfinite(g).all() and onp.abs(g).sum() > 0
+
+
+def test_variational_dropout_mask_locked_across_time():
+    """The defining property: one mask per sequence (reference
+    VariationalDropoutCell docstring), unlike DropoutCell's fresh mask
+    each step."""
+    base = gluon.rnn.RNNCell(12, input_size=12)
+    vd = gluon.rnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    vd.initialize()
+    x = nd.array(onp.ones((2, 12), "float32"))
+    with autograd.record():
+        st = vd.begin_state(batch_size=2)
+        o1, st = vd(x, st)
+        o2, st = vd(x, st)
+    z1 = o1.asnumpy() == 0.0
+    z2 = o2.asnumpy() == 0.0
+    assert z1.any(), "dropout must zero something at p=0.5"
+    # the SAME positions are dropped at both steps
+    onp.testing.assert_array_equal(z1, z2 & z1 | z1 & z2)
+    assert (z1 == z2).all() or (z2 >= z1).all()
+
+
+def test_variational_dropout_reset_resamples():
+    base = gluon.rnn.RNNCell(16, input_size=16)
+    vd = gluon.rnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    vd.initialize()
+    x = nd.array(onp.ones((1, 16), "float32"))
+    with autograd.record():
+        o1, _ = vd(x, vd.begin_state(batch_size=1))
+    vd.reset()
+    with autograd.record():
+        o2, _ = vd(x, vd.begin_state(batch_size=1))
+    # with new masks the dropped positions (almost surely) differ
+    assert (o1.asnumpy() == 0).any() and (o2.asnumpy() == 0).any()
+
+
+def test_variational_dropout_inference_identity():
+    base = gluon.rnn.GRUCell(8, input_size=8)
+    vd = gluon.rnn.VariationalDropoutCell(base, drop_inputs=0.9,
+                                          drop_outputs=0.9)
+    vd.initialize()
+    x = nd.array(_R.rand(2, 8).astype("float32"))
+    o_vd, _ = vd(x, vd.begin_state(batch_size=2))
+    o_base, _ = base(x, base.begin_state(batch_size=2))
+    onp.testing.assert_allclose(o_vd.asnumpy(), o_base.asnumpy(),
+                                rtol=1e-6)
+
+
+def test_modifier_and_hybrid_aliases():
+    assert gluon.rnn.ModifierCell is not None
+    assert issubclass(gluon.rnn.DropoutCell, gluon.rnn.ModifierCell)
+    assert issubclass(gluon.rnn.VariationalDropoutCell,
+                      gluon.rnn.ModifierCell)
+    assert gluon.rnn.HybridRecurrentCell is gluon.rnn.RecurrentCell
+
+
+def test_bidirectional_variational_state_dropout_rejected():
+    bi = gluon.rnn.BidirectionalCell(gluon.rnn.GRUCell(4, input_size=4),
+                                     gluon.rnn.GRUCell(4, input_size=4))
+    with pytest.raises(ValueError):
+        gluon.rnn.VariationalDropoutCell(bi, drop_states=0.3)
+
+
+# ---------------------------------------------------------------------------
+# optimizers
+# ---------------------------------------------------------------------------
+
+def test_group_adagrad_numpy_oracle():
+    o = opt.create("groupadagrad", learning_rate=0.5, epsilon=1e-5)
+    w0 = _R.rand(4, 3).astype("float32")
+    g0 = _R.rand(4, 3).astype("float32")
+    w, g = nd.array(w0), nd.array(g0)
+    state = o.create_state(0, w)
+    assert state.shape == (4,)              # one scalar per ROW
+    o.update(0, w, g, state)
+    hist = (g0 ** 2).mean(axis=1)
+    want = w0 - 0.5 * g0 / (onp.sqrt(hist) + 1e-5)[:, None]
+    onp.testing.assert_allclose(w.asnumpy(), want, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(state.asnumpy(), hist, rtol=1e-5)
+
+
+def test_group_adagrad_second_step_accumulates():
+    o = opt.create("groupadagrad", learning_rate=0.1)
+    w = nd.array(onp.ones((3, 2), "float32"))
+    g = nd.array(onp.full((3, 2), 0.3, "float32"))
+    s = o.create_state(0, w)
+    o.update(0, w, g, s)
+    h1 = s.asnumpy().copy()
+    o.update(0, w, g, s)
+    onp.testing.assert_allclose(s.asnumpy(), 2 * h1, rtol=1e-5)
+
+
+def test_lans_updates_and_trust_ratio_bounds():
+    o = opt.create("lans", learning_rate=0.05, lower_bound=0.1,
+                   upper_bound=10.0)
+    w = nd.array(_R.rand(6, 5).astype("float32") + 0.5)
+    g = nd.array(_R.rand(6, 5).astype("float32"))
+    s = o.create_state(0, w)
+    w0 = w.asnumpy().copy()
+    for _ in range(3):
+        o.update(0, w, g, s)
+    assert not onp.allclose(w.asnumpy(), w0)
+    assert onp.isfinite(w.asnumpy()).all()
+    # moments advanced
+    assert onp.abs(s[0].asnumpy()).sum() > 0
+    assert onp.abs(s[1].asnumpy()).sum() > 0
+
+
+def test_lans_trains_a_model():
+    net = gluon.nn.Dense(1, in_units=8)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "lans",
+                            {"learning_rate": 0.05})
+    x = nd.array(_R.rand(32, 8).astype("float32"))
+    y = nd.array((_R.rand(32, 1) * 0.1).astype("float32"))
+    first = None
+    for _ in range(25):
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(32)
+        if first is None:
+            first = float(loss.asnumpy())
+    assert float(loss.asnumpy()) < first
+
+
+def test_optimizer_registry_contains_new_names():
+    assert isinstance(opt.create("lans"), opt.LANS)
+    assert isinstance(opt.create("groupadagrad"), opt.GroupAdaGrad)

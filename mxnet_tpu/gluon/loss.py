@@ -19,6 +19,7 @@ __all__ = [
     "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
     "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
     "LogisticLoss", "TripletLoss", "PoissonNLLLoss", "CosineEmbeddingLoss",
+    "SDMLLoss",
 ]
 
 
@@ -295,3 +296,35 @@ class CosineEmbeddingLoss(Loss):
         loss = invoke("where", [label == 1, pos, neg], {})
         loss = _apply_weighting(loss, self._weight, sample_weight)
         return loss
+
+
+class SDMLLoss(Loss):
+    """Batchwise Smoothed Deep Metric Learning loss (reference
+    loss.py:997, arXiv:1905.12786): every other row of the aligned batch
+    acts as a negative; the softmax over negative distances is pulled
+    toward a label-smoothed identity matrix with a KL objective."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self.kl_loss = KLDivLoss(from_logits=True)
+        self.smoothing_parameter = smoothing_parameter
+
+    def _compute_distances(self, x1, x2):
+        # [B,1,D] - [1,B,D] -> pairwise squared euclidean [B,B]
+        x1_ = x1.expand_dims(1)
+        x2_ = x2.expand_dims(0)
+        return ((x1_ - x2_) ** 2).sum(axis=2)
+
+    def _compute_labels(self, batch_size, ctx):
+        gold = invoke("eye", [], {"N": batch_size})
+        s = self.smoothing_parameter
+        return gold * (1 - s) + (1 - gold) * s / (batch_size - 1)
+
+    def forward(self, x1, x2):
+        batch_size = x1.shape[0]
+        labels = self._compute_labels(batch_size, x1.ctx)
+        distances = self._compute_distances(x1, x2)
+        log_probs = invoke("log_softmax", [-distances], {"axis": 1})
+        # kl_loss batch-means over rows; scale by batch_size to recover
+        # the per-row KL sum (the reference multiplies the same way)
+        return self.kl_loss(log_probs, labels) * batch_size

@@ -98,3 +98,41 @@ def test_losses_backward_finite():
             loss = loss_fn(pred, label).sum()
         loss.backward()
         assert onp.isfinite(pred.grad.asnumpy()).all(), type(loss_fn)
+
+
+def test_sdml_loss_oracle_and_grad():
+    """SDMLLoss (reference loss.py:997): per-row KL between the softmax
+    over negative pairwise distances and a smoothed identity."""
+    R = onp.random.RandomState(2)
+    x1 = R.rand(6, 8).astype("float32")
+    x2 = x1 + 0.01 * R.rand(6, 8).astype("float32")
+    loss_fn = gluon.loss.SDMLLoss(smoothing_parameter=0.3)
+    a, b = nd.array(x1), nd.array(x2)
+    a.attach_grad()
+    with autograd.record():
+        loss = loss_fn(a, b)
+    loss.backward()
+    assert loss.shape == (6,)
+    assert onp.isfinite(a.grad.asnumpy()).all()
+
+    d = ((x1[:, None, :] - x2[None, :, :]) ** 2).sum(2)
+    m = (-d) - (-d).max(1, keepdims=True)
+    lp = m - onp.log(onp.exp(m).sum(1, keepdims=True))
+    eye = onp.eye(6)
+    s = 0.3
+    lab = eye * (1 - s) + (1 - eye) * s / 5
+    want = (lab * (onp.log(lab + 1e-12) - lp)).sum(1)
+    onp.testing.assert_allclose(loss.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_sdml_loss_prefers_aligned_pairs():
+    """Training signal sanity: aligned batches produce a smaller loss
+    than shuffled (misaligned) ones."""
+    R = onp.random.RandomState(3)
+    x = R.rand(8, 16).astype("float32") * 3
+    loss_fn = gluon.loss.SDMLLoss()
+    aligned = float(loss_fn(nd.array(x), nd.array(x)).mean().asnumpy())
+    perm = R.permutation(8)
+    shuffled = float(loss_fn(nd.array(x),
+                             nd.array(x[perm])).mean().asnumpy())
+    assert aligned < shuffled

@@ -1,6 +1,7 @@
 """Estimator API (reference ``python/mxnet/gluon/contrib/estimator/``)."""
-from .estimator import Estimator
+from .estimator import BatchProcessor, Estimator
 from .event_handler import (BatchBegin, BatchEnd, CheckpointHandler,
                             EarlyStoppingHandler, EpochBegin, EpochEnd,
+                            EventHandler, GradientUpdateHandler,
                             LoggingHandler, MetricHandler, StoppingHandler,
                             TrainBegin, TrainEnd, ValidationHandler)

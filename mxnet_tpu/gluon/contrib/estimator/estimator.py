@@ -8,10 +8,34 @@ from .... import autograd
 from ....metric import EvalMetric, Loss as LossMetric
 from ...trainer import Trainer
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
-                            LoggingHandler, MetricHandler, StoppingHandler,
-                            TrainBegin, TrainEnd, ValidationHandler)
+                            GradientUpdateHandler, LoggingHandler,
+                            MetricHandler, StoppingHandler, TrainBegin,
+                            TrainEnd, ValidationHandler)
 
-__all__ = ["Estimator"]
+__all__ = ["Estimator", "BatchProcessor"]
+
+
+class BatchProcessor:
+    """Pluggable per-batch compute (reference batch_processor.py
+    BatchProcessor): ``fit_batch`` runs forward+backward for one training
+    batch, ``evaluate_batch`` one validation batch.  Subclass to customize
+    (multi-input models, custom losses, mixed schedules) without forking
+    the fit loop."""
+
+    def fit_batch(self, estimator, batch, batch_axis=0):
+        data, label = batch[0], batch[1]
+        with autograd.record():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label)
+            lmean = loss.mean()
+        lmean.backward()
+        return data, [label], [pred], [lmean]
+
+    def evaluate_batch(self, estimator, batch, batch_axis=0):
+        data, label = batch[0], batch[1]
+        pred = estimator.net(data)
+        loss = estimator.evaluation_loss(pred, label)
+        return data, [label], [pred], [loss]
 
 
 class Estimator:
@@ -19,7 +43,8 @@ class Estimator:
     estimator.py Estimator)."""
 
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
-                 trainer=None, context=None, evaluation_loss=None):
+                 trainer=None, context=None, evaluation_loss=None,
+                 batch_processor=None):
         self.net = net
         self.loss = loss
         self.train_metrics = train_metrics or []
@@ -35,6 +60,8 @@ class Estimator:
             net.collect_params(), "adam", {"learning_rate": 1e-3})
         self.max_epoch = None
         self.max_batch = None
+        self.batch_processor = batch_processor or BatchProcessor()
+        self.batch_axis = 0
 
     # -- evaluation ------------------------------------------------------
     def evaluate(self, val_data=None, batch_axis=0):
@@ -42,12 +69,11 @@ class Estimator:
             m.reset()
         self.val_loss_metric.reset()
         for batch in val_data:
-            data, label = batch[0], batch[1]
-            pred = self.net(data)
-            loss = self.evaluation_loss(pred, label)
+            _, labels, preds, losses = self.batch_processor.evaluate_batch(
+                self, batch, batch_axis)
             for m in self.val_metrics:
-                m.update([label], [pred])
-            self.val_loss_metric.update(0, [loss])
+                m.update(labels, preds)
+            self.val_loss_metric.update(0, losses)
         return {m.get()[0]: m.get()[1]
                 for m in self.val_metrics + [self.val_loss_metric]}
 
@@ -56,6 +82,7 @@ class Estimator:
             batches=None, batch_axis=0):
         self.max_epoch = epochs
         self.max_batch = batches
+        self.batch_axis = batch_axis
         if epochs is None and batches is None:
             raise ValueError("pass epochs or batches")
 
@@ -73,19 +100,15 @@ class Estimator:
             stopped_mid_epoch = False
             for batch in train_data:
                 ran_any = True
-                data, label = batch[0], batch[1]
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                    lmean = loss.mean()
-                lmean.backward()
-                bs = data.shape[batch_axis]
-                self.trainer.step(bs)
+                _, labels, preds, losses = self.batch_processor.fit_batch(
+                    self, batch, batch_axis)
+                # the optimizer step itself runs as the highest-priority
+                # batch_end handler (GradientUpdateHandler)
                 for h in batch_end:
-                    if h.batch_end(self, batch=batch, pred=[pred],
-                                   label=[label], loss=[lmean]):
+                    if h.batch_end(self, batch=batch, pred=preds,
+                                   label=labels, loss=losses):
                         stop = True
                 if stop:
                     stopped_mid_epoch = True
@@ -105,6 +128,8 @@ class Estimator:
 
     def _prepare_handlers(self, val_data, event_handlers):
         handlers = list(event_handlers or [])
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            handlers.append(GradientUpdateHandler())
         if not any(isinstance(h, StoppingHandler) for h in handlers):
             handlers.append(StoppingHandler(self.max_epoch, self.max_batch))
         if not any(isinstance(h, MetricHandler) for h in handlers):

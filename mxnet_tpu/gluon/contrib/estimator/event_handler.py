@@ -11,7 +11,8 @@ import numpy as onp
 
 __all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
            "BatchEnd", "StoppingHandler", "MetricHandler", "ValidationHandler",
-           "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler"]
+           "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler",
+           "EventHandler", "GradientUpdateHandler"]
 
 
 def _is_maximizing_metric(name: str) -> bool:
@@ -296,3 +297,50 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
     def train_end(self, estimator, *args, **kwargs):
         if self.stopped_epoch > 0:
             logging.info("Early stopping at epoch %d", self.stopped_epoch)
+
+
+class EventHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                   BatchEnd):
+    """Convenience base implementing every lifecycle hook as a no-op
+    (reference event_handler.py EventHandler)."""
+
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Applies the optimizer step at batch end (reference
+    event_handler.py GradientUpdateHandler).  The update being a handler
+    (with the most-negative priority, so it runs before metric/logging
+    handlers) lets users swap it out for, e.g., accumulation schedules."""
+
+    priority = -2000
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        loss = kwargs.get("loss", [])
+        batch = kwargs.get("batch", None)
+        if batch is not None and hasattr(batch[0], "shape"):
+            bs = batch[0].shape[getattr(estimator, "batch_axis", 0)]
+        elif loss:
+            bs = loss[0].shape[0] if loss[0].ndim else 1
+        else:
+            raise ValueError("cannot infer batch size for the update")
+        estimator.trainer.step(bs)

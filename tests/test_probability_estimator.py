@@ -213,3 +213,84 @@ def test_vocab_and_embedding(tmp_path):
     emb.update_token_vectors("hello", nd.array([1.0, 1.0, 1.0]))
     onp.testing.assert_allclose(
         emb.get_vecs_by_tokens("hello").asnumpy(), [1, 1, 1])
+
+
+def _l2loss():
+    from mxnet_tpu.gluon.loss import L2Loss
+
+    return L2Loss()
+
+
+def test_gradient_update_handler_is_default_and_replaceable():
+    """The optimizer step runs as a batch_end handler (reference
+    GradientUpdateHandler); replacing it changes update cadence."""
+    from mxnet_tpu.gluon.contrib.estimator import (Estimator,
+                                                   GradientUpdateHandler)
+
+    net = nn.Dense(1, in_units=4)
+    net.initialize()
+    est = Estimator(net, _l2loss())
+
+    class EveryOther(GradientUpdateHandler):
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+
+        def batch_end(self, estimator, *args, **kwargs):
+            self.calls += 1
+            if self.calls % 2 == 0:
+                super().batch_end(estimator, *args, **kwargs)
+
+    handler = EveryOther()
+    R = onp.random.RandomState(0)
+    data = [(nd.array(R.rand(8, 4).astype("f")),
+             nd.array(R.rand(8, 1).astype("f"))) for _ in range(4)]
+    w0 = net.weight.data().asnumpy().copy()
+    est.fit(data, epochs=1, event_handlers=[handler])
+    assert handler.calls == 4
+    assert not onp.allclose(net.weight.data().asnumpy(), w0)
+
+
+def test_custom_batch_processor():
+    """BatchProcessor customizes per-batch compute without forking fit
+    (reference batch_processor.py)."""
+    from mxnet_tpu.gluon.contrib.estimator import BatchProcessor, Estimator
+
+    seen = []
+
+    class Doubler(BatchProcessor):
+        def fit_batch(self, estimator, batch, batch_axis=0):
+            seen.append(batch[0].shape[0])
+            return super().fit_batch(estimator, batch, batch_axis)
+
+    net = nn.Dense(1, in_units=3)
+    net.initialize()
+    est = Estimator(net, _l2loss(), batch_processor=Doubler())
+    R = onp.random.RandomState(1)
+    data = [(nd.array(R.rand(6, 3).astype("f")),
+             nd.array(R.rand(6, 1).astype("f"))) for _ in range(3)]
+    est.fit(data, epochs=2)
+    assert seen == [6] * 6
+
+
+def test_event_handler_base_all_hooks():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator, EventHandler
+
+    calls = []
+
+    class Recorder(EventHandler):
+        def train_begin(self, estimator, *a, **k):
+            calls.append("tb")
+
+        def epoch_end(self, estimator, *a, **k):
+            calls.append("ee")
+
+        def train_end(self, estimator, *a, **k):
+            calls.append("te")
+
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    est = Estimator(net, _l2loss())
+    data = [(nd.ones((4, 2)), nd.ones((4, 1)))]
+    est.fit(data, epochs=2, event_handlers=[Recorder()])
+    assert calls == ["tb", "ee", "ee", "te"]

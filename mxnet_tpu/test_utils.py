@@ -26,6 +26,16 @@ __all__ = [
     "check_numeric_gradient", "check_symbolic_forward",
     "check_symbolic_backward", "numeric_grad", "environment",
     "default_rtols", "default_atols", "effective_dtype",
+    "get_rtol", "get_atol", "get_etol", "get_tolerance", "get_tols",
+    "default_numeric_eps", "assert_allclose", "almost_equal_ignore_nan",
+    "assert_almost_equal_ignore_nan", "assert_almost_equal_with_err",
+    "assert_exception", "same_array", "list_gpus", "np_reduce",
+    "random_sample", "random_uniform_arrays", "rand_coord_2d",
+    "create_vector", "create_2d_tensor", "compare_ndarray_tuple",
+    "compare_optimizer", "check_speed", "assign_each", "assign_each2",
+    "collapse_sum_like", "check_gluon_hybridize_consistency",
+    "gen_buckets_probs_with_ppf", "chi_square_check", "verify_generator",
+    "mean_check", "var_check", "discard_stderr",
 ]
 
 _DEFAULT_CTX: Optional[Context] = None
@@ -391,3 +401,378 @@ def environment(*args):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# extended reference helpers (reference test_utils.py — the functions
+# migration users' own test suites call)
+# ---------------------------------------------------------------------------
+
+def _np(a):
+    return a.asnumpy() if isinstance(a, NDArray) else onp.asarray(a)
+
+
+def get_rtol(rtol=None, dtype=None):
+    """Dtype-aware default rtol (reference test_utils.py get_rtol)."""
+    if rtol is not None:
+        return rtol
+    return _RTOLS.get(onp.dtype(dtype or onp.float32), 1e-4)
+
+
+def get_atol(atol=None, dtype=None):
+    if atol is not None:
+        return atol
+    return _ATOLS.get(onp.dtype(dtype or onp.float32), 1e-5)
+
+
+def get_etol(etol=None):
+    """Allowed fraction of mismatching elements (reference get_etol)."""
+    return 0.0 if etol is None else etol
+
+
+def get_tolerance(arr, rtol, atol):
+    dt = getattr(arr, "dtype", onp.float32)
+    return get_rtol(rtol, dt), get_atol(atol, dt)
+
+
+def get_tols(x, y, rtol=None, atol=None):
+    """Joint tolerance of a pair: the looser of the two dtypes
+    (reference get_tols)."""
+    return (max(get_rtol(rtol, x.dtype), get_rtol(rtol, y.dtype)),
+            max(get_atol(atol, x.dtype), get_atol(atol, y.dtype)))
+
+
+def default_numeric_eps(dtype=onp.float32):
+    """Finite-difference eps per dtype (reference default_numeric_eps)."""
+    return {onp.dtype(onp.float16): 1e-1, onp.dtype(onp.float32): 1e-3,
+            onp.dtype(onp.float64): 1e-4}.get(onp.dtype(dtype), 1e-3)
+
+
+def assert_allclose(a, b, rtol=1e-7, atol=0, equal_nan=True):
+    """Thin numpy wrapper accepting NDArrays (reference assert_allclose)."""
+    onp.testing.assert_allclose(_np(a), _np(b), rtol=rtol, atol=atol,
+                                equal_nan=equal_nan)
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    a, b = _np(a).copy(), _np(b).copy()
+    nan = onp.isnan(a)
+    if not (nan == onp.isnan(b)).all():
+        return False
+    a[nan] = 0
+    b[nan] = 0
+    return onp.allclose(a, b, get_rtol(rtol, a.dtype),
+                        get_atol(atol, a.dtype))
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None,
+                                   names=("a", "b")):
+    """Equality where NaNs must coincide and are otherwise ignored
+    (reference assert_almost_equal_ignore_nan)."""
+    a_, b_ = _np(a).copy(), _np(b).copy()
+    nan_a, nan_b = onp.isnan(a_), onp.isnan(b_)
+    onp.testing.assert_array_equal(nan_a, nan_b,
+                                   err_msg=f"NaN patterns differ: {names}")
+    a_[nan_a] = 0
+    b_[nan_b] = 0
+    onp.testing.assert_allclose(a_, b_, get_rtol(rtol, a_.dtype),
+                                get_atol(atol, a_.dtype))
+
+
+def assert_almost_equal_with_err(a, b, rtol=None, atol=None, etol=None,
+                                 names=("a", "b")):
+    """Allow a FRACTION etol of out-of-tolerance elements (reference
+    assert_almost_equal_with_err)."""
+    a_, b_ = _np(a), _np(b)
+    rtol, atol, etol = get_rtol(rtol, a_.dtype), get_atol(atol, a_.dtype), \
+        get_etol(etol)
+    bad = ~onp.isclose(a_, b_, rtol=rtol, atol=atol, equal_nan=True)
+    frac = bad.sum() / max(bad.size, 1)
+    if frac > etol:
+        onp.testing.assert_allclose(a_, b_, rtol=rtol, atol=atol,
+                                    err_msg=f"{names}: {frac:.4f} > "
+                                            f"etol {etol}")
+
+
+def assert_exception(fn, exception_type, *args, **kwargs):
+    """fn(*args) must raise exception_type (reference assert_exception)."""
+    try:
+        fn(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(f"{fn} did not raise {exception_type.__name__}")
+
+
+def same_array(a, b) -> bool:
+    """True when two NDArrays share the same device buffer: mutating one
+    is visible through the other (reference same_array probes by
+    mutation; buffers here are functional, so identity of the backing
+    jax.Array is the faithful notion of 'same array')."""
+    return a is b or a._data is b._data
+
+
+def list_gpus():
+    """Indices of visible CUDA GPUs — none on a TPU host (reference
+    list_gpus)."""
+    return []
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Reference np_reduce: reduce with mxnet axis/keepdims semantics."""
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def random_sample(population, k):
+    """Sample without replacement preserving order semantics of the
+    reference helper."""
+    import random as _pyrandom
+
+    return _pyrandom.sample(population, k)
+
+
+def random_uniform_arrays(*shapes, low=0.0, high=1.0, dtype="float32"):
+    return [array(onp.random.uniform(low, high, s).astype(dtype))
+            for s in shapes]
+
+
+def rand_coord_2d(x_low, x_high, y_low, y_high):
+    x = onp.random.randint(x_low, x_high)
+    y = onp.random.randint(y_low, y_high)
+    return x, y
+
+
+def create_vector(size, dtype=onp.int64):
+    """arange vector (reference create_vector — large-tensor tests)."""
+    return array(onp.arange(size, dtype=dtype))
+
+
+def create_2d_tensor(rows, columns, dtype=onp.int64):
+    return array(
+        onp.arange(rows * columns, dtype=dtype).reshape(rows, columns))
+
+
+def compare_ndarray_tuple(t1, t2, rtol=None, atol=None):
+    """Recursive tuple compare (reference compare_ndarray_tuple)."""
+    if t1 is None or t2 is None:
+        assert t1 is t2
+        return
+    if isinstance(t1, tuple):
+        for a, b in zip(t1, t2):
+            compare_ndarray_tuple(a, b, rtol, atol)
+        return
+    assert_almost_equal(t1, t2, rtol=rtol, atol=atol)
+
+
+def compare_optimizer(opt1, opt2, shapes, dtype, w_stype="default",
+                      g_stype="default", rtol=1e-4, atol=1e-5, ntests=3):
+    """Drive two optimizers with identical weights/grads and assert the
+    trajectories match (reference compare_optimizer)."""
+    for _ in range(ntests):
+        ws1, ws2, gs1, gs2, ss1, ss2 = [], [], [], [], [], []
+        for i, s in enumerate(shapes):
+            w = onp.random.uniform(-1, 1, s).astype(dtype)
+            g = onp.random.uniform(-1, 1, s).astype(dtype)
+            w1, w2 = array(w), array(w)
+            g1, g2 = array(g), array(g)
+            ws1.append(w1)
+            ws2.append(w2)
+            gs1.append(g1)
+            gs2.append(g2)
+            ss1.append(opt1.create_state(i, w1))
+            ss2.append(opt2.create_state(i, w2))
+        for i in range(len(shapes)):
+            opt1.update(i, ws1[i], gs1[i], ss1[i])
+            opt2.update(i, ws2[i], gs2[i], ss2[i])
+            compare_ndarray_tuple(tuple(ws1), tuple(ws2), rtol, atol)
+
+
+def check_speed(sym_or_fn, *args, n=20, **kwargs):
+    """Steady-state seconds/call with a host-read fence (reference
+    check_speed; the fence discipline is bench.py's)."""
+    import time as _time
+
+    fn = sym_or_fn
+    out = fn(*args, **kwargs)
+    _np(out if not isinstance(out, (list, tuple)) else out[0])
+    t0 = _time.time()
+    for _ in range(n):
+        out = fn(*args, **kwargs)
+    _np(out if not isinstance(out, (list, tuple)) else out[0])
+    return (_time.time() - t0) / n
+
+
+def assign_each(input_arr, function):
+    """Elementwise python-function application on host (reference
+    assign_each — oracle builder for unary ops)."""
+    return onp.vectorize(function)(_np(input_arr))
+
+
+def assign_each2(input1, input2, function):
+    return onp.vectorize(function)(_np(input1), _np(input2))
+
+
+def collapse_sum_like(a, shape):
+    """Sum ``a`` down to ``shape`` (reference collapse_sum_like — the
+    broadcast-gradient oracle)."""
+    a = _np(a)
+    extra = a.ndim - len(shape)
+    if extra:
+        a = a.sum(axis=tuple(range(extra)))
+    axes = tuple(i for i, (da, ds) in enumerate(zip(a.shape, shape))
+                 if ds == 1 and da != 1)
+    if axes:
+        a = a.sum(axis=axes, keepdims=True)
+    return a.reshape(shape)
+
+
+def check_gluon_hybridize_consistency(net_builder, data_l, numpy_func=None,
+                                      test_grad=True, rtol=1e-4, atol=1e-5):
+    """Eager-vs-hybridized forward (and input-grad) equivalence for a
+    Block factory (reference check_gluon_hybridize_consistency)."""
+    from . import autograd
+
+    import tempfile
+
+    saved_out_np = None
+    saved_grad_np_l = None
+    saved_params = None
+    for hybridize in (False, True):
+        net = net_builder()
+        net.initialize()
+        in_data_l = [array(_np(d)) for d in data_l]
+        net(*in_data_l)                 # materialize deferred shapes
+        if saved_params is None:        # both runs share ONE weight set
+            saved_params = os.path.join(tempfile.gettempdir(),
+                                        f"hyb_consist_{os.getpid()}.params")
+            net.save_parameters(saved_params)
+        else:
+            net.load_parameters(saved_params)
+        if hybridize:
+            net.hybridize()
+        if test_grad:
+            for d in in_data_l:
+                d.attach_grad()
+            with autograd.record():
+                out = net(*in_data_l)
+                loss = (out ** 2).sum()
+            loss.backward()
+            grad_np_l = [d.grad.asnumpy() for d in in_data_l]
+        else:
+            out = net(*in_data_l)
+            grad_np_l = None
+        out_np = out.asnumpy()
+        if saved_out_np is None:
+            saved_out_np = out_np
+            saved_grad_np_l = grad_np_l
+        else:
+            onp.testing.assert_allclose(out_np, saved_out_np, rtol=rtol,
+                                        atol=atol)
+            if test_grad:
+                for g, sg in zip(grad_np_l, saved_grad_np_l):
+                    onp.testing.assert_allclose(g, sg, rtol=rtol,
+                                                atol=atol)
+    if numpy_func is not None:
+        onp.testing.assert_allclose(
+            saved_out_np, numpy_func(*[_np(d) for d in data_l]),
+            rtol=rtol, atol=atol)
+
+
+# --- statistical generator checking (reference chi_square_check /
+# verify_generator / mean_check / var_check) -------------------------------
+
+def gen_buckets_probs_with_ppf(ppf, nbuckets):
+    """Equal-probability buckets from a percent-point function."""
+    probs = [1.0 / nbuckets] * nbuckets
+    buckets = [(float(ppf(i / nbuckets)), float(ppf((i + 1) / nbuckets)))
+               for i in range(nbuckets)]
+    return buckets, probs
+
+
+def chi_square_check(generator, buckets, probs, nsamples=1000000):
+    """Chi-square fit of generator samples against expected bucket
+    probabilities (reference chi_square_check).  Continuous buckets are
+    (low, high) tuples; discrete buckets are scalar values."""
+    from scipy import stats as _sps
+
+    samples = onp.asarray(generator(nsamples)).ravel()
+    expected = []
+    counted = []
+    if isinstance(buckets[0], (tuple, list)):
+        for (lo, hi), p in zip(buckets, probs):
+            counted.append(((samples >= lo) & (samples < hi)).sum())
+            expected.append(p * nsamples)
+    else:
+        for v, p in zip(buckets, probs):
+            counted.append((samples == v).sum())
+            expected.append(p * nsamples)
+    counted = onp.asarray(counted, dtype=onp.float64)
+    expected = onp.asarray(expected, dtype=onp.float64)
+    scale = counted.sum() / expected.sum()
+    _, pvalue = _sps.chisquare(f_obs=counted, f_exp=expected * scale)
+    return pvalue, counted, expected
+
+
+def verify_generator(generator, buckets, probs, nsamples=100000,
+                     nrepeat=5, success_rate=0.25, alpha=0.05):
+    """Run chi_square_check nrepeat times; pass when enough repeats have
+    p-value above alpha (reference verify_generator)."""
+    cs_list = []
+    success = 0
+    for _ in range(nrepeat):
+        pvalue, *_ = chi_square_check(generator, buckets, probs, nsamples)
+        cs_list.append(pvalue)
+        if pvalue > alpha:
+            success += 1
+    if success / nrepeat < success_rate:
+        raise AssertionError(
+            f"generator failed chi-square: p-values {cs_list}")
+    return cs_list
+
+
+def mean_check(generator, mu, sigma, nsamples=1000000, alpha=0.05):
+    """z-test of the sample mean against mu (reference mean_check)."""
+    from scipy import stats as _sps
+
+    samples = onp.asarray(generator(nsamples)).ravel()
+    z = (samples.mean() - mu) / (sigma / onp.sqrt(len(samples)))
+    return abs(z) < _sps.norm.ppf(1 - alpha / 2)
+
+
+def var_check(generator, sigma, nsamples=1000000, alpha=0.05):
+    """Chi-square test of the sample variance (reference var_check)."""
+    from scipy import stats as _sps
+
+    samples = onp.asarray(generator(nsamples)).ravel()
+    n = len(samples)
+    stat = (n - 1) * samples.var() / (sigma ** 2)
+    lo = _sps.chi2.ppf(alpha / 2, n - 1)
+    hi = _sps.chi2.ppf(1 - alpha / 2, n - 1)
+    return lo < stat < hi
+
+
+@contextlib.contextmanager
+def discard_stderr():
+    """Silence C-level stderr inside the block (reference
+    discard_stderr)."""
+    import sys
+
+    stderr_fileno = sys.stderr.fileno()
+    old = os.dup(stderr_fileno)
+    try:
+        with open(os.devnull, "wb") as devnull:
+            os.dup2(devnull.fileno(), stderr_fileno)
+        yield
+    finally:
+        os.dup2(old, stderr_fileno)
+        os.close(old)

@@ -362,6 +362,30 @@ class Block:
         return out
 
     def forward(self, *args, **kwargs):
+        # 1.x-style migration shim: a subclass that defines
+        # hybrid_forward(self, F, x, ..., <param kwargs>) but no forward
+        # runs through it with F = the nd namespace and its registered
+        # parameters passed as kwargs — the reference 1.x calling
+        # convention (block.py hybrid_forward dispatch).
+        if hasattr(self, "hybrid_forward"):
+            from .. import ndarray as F
+
+            ctx = None
+            for a in args:
+                if hasattr(a, "ctx"):
+                    ctx = a.ctx
+                    break
+            params = {}
+            for name, p in self._reg_params.items():
+                try:
+                    params[name] = p.data(ctx)
+                except DeferredInitializationError:
+                    raise DeferredInitializationError(
+                        f"hybrid_forward compatibility path cannot infer "
+                        f"the shape of parameter '{name}' — give the "
+                        f"layer explicit input sizes (in_units/"
+                        f"in_channels) or define forward() instead")
+            return self.hybrid_forward(F, *args, **params, **kwargs)
         raise NotImplementedError
 
     def summary(self, *inputs):

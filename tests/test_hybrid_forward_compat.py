@@ -1,0 +1,127 @@
+"""1.x-style ``hybrid_forward(self, F, x, **params)`` compatibility
+(reference gluon/block.py hybrid_forward dispatch): blocks written for
+MXNet 1.x run unmodified — F is the nd namespace, registered parameters
+arrive as kwargs, and hybridize compiles the same graph."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+_R = onp.random.RandomState(41)
+
+
+class OneXNet(gluon.HybridBlock):
+    """Typical 1.x block: own Parameter + child layer + F-style ops."""
+
+    def __init__(self):
+        super().__init__()
+        self.w = gluon.Parameter("weight", shape=(3, 4),
+                                 init=mx.init.Xavier())
+        self.dense = nn.Dense(2, in_units=3)
+
+    def hybrid_forward(self, F, x, w):
+        h = F.dot(x, w, transpose_b=True)
+        return self.dense(F.relu(h))
+
+
+def test_hybrid_forward_eager_and_hybrid_equal():
+    net = OneXNet()
+    net.initialize()
+    x = nd.array(_R.rand(5, 4).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    onp.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-5)
+    onp.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-5)
+
+
+def test_hybrid_forward_numpy_oracle():
+    net = OneXNet()
+    net.initialize()
+    x = _R.rand(5, 4).astype("float32")
+    got = net(nd.array(x)).asnumpy()
+    w = net.w.data().asnumpy()
+    dw = net.dense.weight.data().asnumpy()
+    db = net.dense.bias.data().asnumpy()
+    h = onp.maximum(x @ w.T, 0)
+    onp.testing.assert_allclose(got, h @ dw.T + db, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_forward_gradients():
+    net = OneXNet()
+    net.initialize()
+    x = nd.array(_R.rand(5, 4).astype("float32"))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g = net.w.grad().asnumpy()
+    assert onp.isfinite(g).all() and onp.abs(g).sum() > 0
+
+
+def test_hybrid_forward_no_params():
+    class Scaler(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.tanh(x) * 2
+
+    net = Scaler()
+    net.initialize()
+    x = nd.array(_R.rand(3, 3).astype("float32"))
+    onp.testing.assert_allclose(net(x).asnumpy(),
+                                2 * onp.tanh(x.asnumpy()), rtol=1e-6)
+    net.hybridize()
+    onp.testing.assert_allclose(net(x).asnumpy(),
+                                2 * onp.tanh(x.asnumpy()), rtol=1e-6)
+
+
+def test_hybrid_forward_nested():
+    class Inner(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.relu(x) - 0.5
+
+    class Outer(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.inner = Inner()
+
+        def hybrid_forward(self, F, x):
+            return self.inner(x) * 3
+
+    net = Outer()
+    net.initialize()
+    x = nd.array((_R.rand(4, 4) - 0.5).astype("float32"))
+    want = 3 * (onp.maximum(x.asnumpy(), 0) - 0.5)
+    onp.testing.assert_allclose(net(x).asnumpy(), want, rtol=1e-6)
+    net.hybridize()
+    onp.testing.assert_allclose(net(x).asnumpy(), want, rtol=1e-6)
+
+
+def test_hybrid_forward_deferred_shape_error_is_informative():
+    class Lazy(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.w = gluon.Parameter("weight", shape=None,
+                                     allow_deferred_init=True)
+
+        def hybrid_forward(self, F, x, w):
+            return x * w
+
+    net = Lazy()
+    net.initialize()
+    with pytest.raises(Exception) as ei:
+        net(nd.ones((2, 2)))
+    assert "hybrid_forward" in str(ei.value) or "defer" in \
+        str(ei.value).lower()
+
+
+def test_forward_still_preferred_when_defined():
+    class Both(gluon.HybridBlock):
+        def forward(self, x):
+            return x + 1
+
+        def hybrid_forward(self, F, x):  # pragma: no cover - must be dead
+            raise AssertionError("forward() must win")
+
+    net = Both()
+    net.initialize()
+    onp.testing.assert_allclose(net(nd.ones((2,))).asnumpy(), [2.0, 2.0])

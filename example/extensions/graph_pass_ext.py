@@ -1,0 +1,73 @@
+"""Example runtime extension: a custom GRAPH PASS.
+
+Reference analog: ``example/extensions/lib_pass`` (pass_lib.cc registers a
+``myPass`` through lib_api.h; users run it with
+``optimize_for(backend='myPass')``).  Here a pass is a whole-function
+transform over the traced pure function of a hybridized block — it runs
+BEFORE jax.jit, so whatever it emits is compiled into the one XLA program.
+
+This pass does two things, mirroring the reference example's spirit:
+
+1. counts the ops it flows through (observability), and
+2. rewrites the computation to bf16 compute with an fp32 result — a real
+   TPU-shaped rewrite (the MXU's native dtype), not a toy.
+
+Usage::
+
+    import mxnet_tpu as mx
+    mx.library.load("example/extensions/graph_pass_ext.py")
+    net.hybridize(backend="bf16_pass")
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import library
+
+STATS = {"calls": 0}
+
+
+@library.register_backend("bf16_pass")
+def bf16_pass(fn, **flags):
+    """transform(fn) -> fn; signature of fn is
+    (param_arrays, input_arrays, rng_key) -> (outputs, mutated)."""
+
+    def cast_tree(tree, dt):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(dt)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype,
+                                                      jnp.floating)
+            else a, tree)
+
+    def wrapped(params, inputs, key):
+        STATS["calls"] += 1
+        p16 = cast_tree(params, jnp.bfloat16)
+        i16 = cast_tree(inputs, jnp.bfloat16)
+        outs, mutated = fn(p16, i16, key)
+        return cast_tree(outs, jnp.float32), mutated
+
+    return wrapped
+
+
+if __name__ == "__main__":
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    x = mx.nd.array(onp.random.RandomState(0).rand(2, 8).astype("f"))
+    ref = net(x).asnumpy()
+    net.hybridize(backend="bf16_pass")
+    out = net(x)
+    assert STATS["calls"] >= 1
+    err = float(onp.abs(out.asnumpy() - ref).max())
+    print(f"bf16_pass applied; max |bf16 - fp32| = {err:.4f}")
+    assert err < 0.1
+    print("OK")

@@ -1,0 +1,98 @@
+"""Example runtime extension: a custom SUBGRAPH PARTITIONER.
+
+Reference analog: ``example/extensions/lib_subgraph`` (subgraph_lib.cc —
+a SubgraphProperty matching op chains, replacing each match with a
+fused node).  Here the property pattern-matches ``FullyConnected ->
+Activation(relu)`` chains in a Symbol and rewrites each into one
+``FullyConnected(fused_relu=True)`` node — the epilogue fusion the int8
+pass also uses.
+
+Usage::
+
+    import mxnet_tpu as mx
+    mx.library.load("example/extensions/subgraph_ext.py")
+    new_sym, new_params = sym.optimize_for(FCReluProperty(), params)
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  "..", ".."))
+
+from mxnet_tpu.symbol.subgraph import (OpChainSelector, SubgraphProperty,
+                                       SubgraphSelector)
+from mxnet_tpu.symbol.symbol import SymNode, Symbol
+
+
+class FCReluProperty(SubgraphProperty):
+    """Match FullyConnected -> relu; emit fused_relu FullyConnected."""
+
+    name = "FUSE_FC_RELU"
+
+    def create_selector(self) -> SubgraphSelector:
+        class _Sel(OpChainSelector):
+            def __init__(self):
+                super().__init__(("FullyConnected", "Activation"))
+
+            def select_output(self, cur, out_node):
+                if cur.op == "FullyConnected" and out_node.op == "relu":
+                    self._pos = 1
+                    return True
+                return super().select_output(cur, out_node)
+
+            def filter(self, candidates):
+                ops = {c.op for c in candidates}
+                if "FullyConnected" not in ops or not \
+                        (ops & {"Activation", "relu"}):
+                    return []
+                acts = [c for c in candidates
+                        if c.op == "Activation" and
+                        c.attrs.get("act_type", "relu") != "relu"]
+                return [] if acts else candidates
+
+        return _Sel()
+
+    def create_subgraph_node(self, sub_sym: Symbol, subgraph_id: int,
+                             params):
+        order = sub_sym._topo()
+        fc = next((n for n in order if n.op == "FullyConnected"), None)
+        if fc is None or len(fc.inputs) < 2:
+            return None                     # decline the match
+        attrs = dict(fc.attrs)
+        attrs["fused_relu"] = True
+        node = SymNode("FullyConnected",
+                       f"{fc.name}_fused_relu{subgraph_id}",
+                       attrs, list(fc.inputs), num_outputs=1)
+        return Symbol([(node, 0)])
+
+
+if __name__ == "__main__":
+    import numpy as onp
+
+    import mxnet_tpu as mx
+
+    x = mx.sym.var("x")
+    w1 = mx.sym.var("w1")
+    b1 = mx.sym.var("b1")
+    w2 = mx.sym.var("w2")
+    b2 = mx.sym.var("b2")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(x, w1, b1, num_hidden=16), act_type="relu")
+    out = mx.sym.FullyConnected(h, w2, b2, num_hidden=4)
+
+    R = onp.random.RandomState(0)
+    params = {"w1": mx.nd.array(R.rand(16, 8).astype("f")),
+              "b1": mx.nd.array(R.rand(16).astype("f")),
+              "w2": mx.nd.array(R.rand(4, 16).astype("f")),
+              "b2": mx.nd.array(R.rand(4).astype("f"))}
+    data = {"x": mx.nd.array(R.rand(3, 8).astype("f")), **params}
+
+    ref = out.bind(args=dict(data)).forward()[0].asnumpy()
+    new_sym, new_params = out.optimize_for(FCReluProperty(), params)
+    ops = [n.op for n in new_sym._topo()]
+    assert "Activation" not in ops, ops     # the relu folded away
+    fused = new_sym.bind(args={**{"x": data["x"]}, **new_params}) \
+        .forward()[0].asnumpy()
+    onp.testing.assert_allclose(fused, ref, rtol=1e-5, atol=1e-5)
+    print(f"fused graph ops: {ops}")
+    print("OK")

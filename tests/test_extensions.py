@@ -7,6 +7,9 @@ custom VJP, visible in mx.nd immediately, working eagerly + under autograd
 + hybridized), register_backend (optimize_for transform), and load()
 (import an extension module by path).
 """
+import os
+import subprocess
+import sys
 import textwrap
 
 import numpy as onp
@@ -187,3 +190,29 @@ def test_example_extension_loads_and_runs():
     ref = net(xin).asnumpy()
     out = net.optimize_for(xin, backend="example_bf16")
     assert onp.allclose(out.asnumpy(), ref, atol=3e-2)
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = dict(os.environ)
+_ENV["JAX_PLATFORMS"] = "cpu"
+_ENV.pop("PYTHONPATH", None)
+_ENV.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def test_graph_pass_extension_example():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example", "extensions",
+                                      "graph_pass_ext.py")],
+        capture_output=True, text=True, timeout=420, env=_ENV)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "OK" in out.stdout
+
+
+def test_subgraph_extension_example():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example", "extensions",
+                                      "subgraph_ext.py")],
+        capture_output=True, text=True, timeout=420, env=_ENV)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "Activation" not in out.stdout.split("fused graph ops")[-1]
+    assert "OK" in out.stdout

@@ -191,7 +191,15 @@ class ndarray(NDArray):
     def __array_function__(self, func, types, args, kwargs):
         import mxnet_tpu.numpy as _mnp
 
-        target = getattr(_mnp, func.__name__, None)
+        # submodule-qualified APIs (numpy.linalg.*, numpy.fft.* …)
+        # resolve against the matching device submodule
+        mod = getattr(func, "__module__", "") or ""
+        ns = _mnp
+        if mod.startswith("numpy.") and "." in mod:
+            ns = getattr(_mnp, mod.split(".", 1)[1].split(".")[0], _mnp)
+        target = getattr(ns, func.__name__, None)
+        if target is None and ns is not _mnp:
+            target = getattr(_mnp, func.__name__, None)
         if target is None or not callable(target):
             return NotImplemented
         return target(*args, **kwargs)

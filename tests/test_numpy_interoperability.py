@@ -482,3 +482,70 @@ def test_protocol_under_jit_trace():
     assert isinstance(z, mnp.ndarray)
     onp.testing.assert_allclose(z.asnumpy(), onp.tanh(y.asnumpy()),
                                 rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# numpy.linalg dispatch (module-qualified __array_function__)
+# ---------------------------------------------------------------------------
+
+_SPD = (_SQ @ _SQ.T + 4 * onp.eye(4)).astype("float32")
+
+_LINALG_WORKLOADS = [
+    ("det", (_SPD,), {}),
+    ("inv", (_SPD,), {}),
+    ("norm", (_A,), {}),
+    ("norm", (_V,), {}),
+    ("cholesky", (_SPD,), {}),
+    ("matrix_rank", (_SPD,), {}),
+    ("matrix_power", (_SPD, 2), {}),
+    ("solve", (_SPD, _SQ[:, 0]), {}),
+    ("eigvalsh", (_SPD,), {}),
+    ("pinv", (_A,), {}),
+    ("slogdet", (_SPD,), {}),
+    ("lstsq", (_SPD, _SQ[:, 0]), {"rcond": None}),
+    ("qr", (_SPD,), {}),
+    ("svd", (_SPD,), {}),
+    ("multi_dot", ([_A, _B.T, _A],), {}),
+    ("tensorsolve", (onp.eye(4, dtype="f").reshape(2, 2, 2, 2),
+                     _R.rand(2, 2).astype("f")), {}),
+]
+
+
+@pytest.mark.parametrize(
+    "fname,args,kwargs", _LINALG_WORKLOADS,
+    ids=[f"linalg-{i:02d}-{w[0]}" for i, w in enumerate(_LINALG_WORKLOADS)])
+def test_linalg_dispatch(fname, args, kwargs):
+    func = getattr(onp.linalg, fname)
+    want = func(*args, **kwargs)
+    mx_args = tuple(
+        [_to_mx(a) for a in arg] if isinstance(arg, list) else _to_mx(arg)
+        for arg in args)
+    got = func(*mx_args, **kwargs)
+    if fname in ("qr", "svd", "eig", "slogdet", "lstsq"):
+        # decompositions: verify reconstruction-level agreement instead of
+        # sign/phase-sensitive factors
+        if fname == "qr":
+            q, r = got
+            onp.testing.assert_allclose(
+                _to_host(q) @ _to_host(r), _SPD, rtol=1e-4, atol=1e-4)
+        elif fname == "svd":
+            u, s, vt = got
+            onp.testing.assert_allclose(
+                (_to_host(u) * _to_host(s)) @ _to_host(vt), _SPD,
+                rtol=1e-4, atol=1e-4)
+        elif fname == "slogdet":
+            onp.testing.assert_allclose(float(_to_host(got[0])),
+                                        float(want[0]), rtol=1e-5)
+            onp.testing.assert_allclose(float(_to_host(got[1])),
+                                        float(want[1]), rtol=1e-4)
+        elif fname == "lstsq":
+            onp.testing.assert_allclose(_to_host(got[0]),
+                                        onp.asarray(want[0]), rtol=1e-3,
+                                        atol=1e-4)
+        return
+    _compare(got, want, f"linalg.{fname}")
+
+
+def test_linalg_dispatch_stays_on_device():
+    got = onp.linalg.inv(mnp.array(_SPD))
+    assert isinstance(got, mnp.ndarray)

@@ -94,3 +94,15 @@ def log_train_metric(period, auto_reset=False):
                 param.eval_metric.reset()
 
     return _callback
+
+
+class LogValidationMetricsCallback:
+    """Log eval metrics at the end of each epoch (reference callback.py
+    LogValidationMetricsCallback)."""
+
+    def __call__(self, param):
+        if not getattr(param, "eval_metric", None):
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         getattr(param, "epoch", 0), name, value)

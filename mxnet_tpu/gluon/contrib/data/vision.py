@@ -333,7 +333,8 @@ class ImageBboxDataLoader:
                  dtype="float32", shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=False, timeout=120, **kwargs):
+                 prefetch=None, thread_pool=False, timeout=120,
+                 label_width=5, **kwargs):
         dataset = _make_dataset(path_imgrec, path_imglist, imglist,
                                 path_root)
         if num_parts > 1:
@@ -350,9 +351,16 @@ class ImageBboxDataLoader:
 
         def sample_transform(img, bbox):
             bbox = onp.asarray(bbox, dtype="float32")
-            if bbox.ndim == 1:      # flat .lst label: [x0 y0 x1 y1 (cls…)]*N
-                width = 5 if bbox.size % 5 == 0 else 4
-                bbox = bbox.reshape(-1, width)
+            if bbox.ndim == 1:
+                # flat .lst label: rows of ``label_width`` floats
+                # (default 5: x0 y0 x1 y1 cls).  Explicit — a divisibility
+                # heuristic silently mis-parses e.g. five 4-column boxes.
+                if bbox.size % label_width != 0:
+                    raise ValueError(
+                        f"flat bbox label of {bbox.size} floats is not "
+                        f"divisible by label_width={label_width}; pass "
+                        f"label_width= matching your .lst row layout")
+                bbox = bbox.reshape(-1, label_width)
             img, bbox = augmenter(img, bbox)
             if coord_normalized:
                 bbox = bbox.copy()

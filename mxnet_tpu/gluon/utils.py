@@ -126,12 +126,27 @@ def download(url: str, path: Optional[str] = None, overwrite: bool = False,
         import urllib.error
         import urllib.request
 
+        ctx_ssl = None
+        if not verify_ssl:
+            import ssl
+            import warnings
+
+            warnings.warn(
+                "Unverified HTTPS request. Adding certificate "
+                "verification is strongly advised.")
+            ctx_ssl = ssl._create_unverified_context()
         last = None
         for _ in range(max(retries, 1)):
             try:
                 os.makedirs(os.path.dirname(os.path.abspath(fname)),
                             exist_ok=True)
-                urllib.request.urlretrieve(url, fname)
+                with urllib.request.urlopen(url, context=ctx_ssl) as r, \
+                        open(fname, "wb") as f:
+                    while True:
+                        chunk = r.read(1 << 20)
+                        if not chunk:
+                            break
+                        f.write(chunk)
                 last = None
                 break
             except (urllib.error.URLError, OSError) as e:  # zero-egress etc.

@@ -724,10 +724,6 @@ def CreateMultiRandCropAugmenter(min_object_covered=0.1,
     """One DetRandomSelectAug over per-threshold croppers (reference
     detection.py:418) — thresholds may be scalars or equal-length lists."""
 
-    def _as_list(v):
-        return list(v) if isinstance(v, (list, tuple)) and \
-            not isinstance(v[0], (int, float)) else [v]
-
     covered = min_object_covered if isinstance(min_object_covered, list) \
         else [min_object_covered]
     aspects = aspect_ratio_range if isinstance(aspect_ratio_range[0],
@@ -814,27 +810,27 @@ class ImageDetIter(ImageIter):
                  aug_list=None, imglist=None, **kwargs):
         if aug_list is None:
             aug_list = CreateDetAugmenter(data_shape, **kwargs)
-        # label_width=1 is a placeholder — det labels are variable-width
-        # and parsed per sample by _parse_label instead
+        # det labels are variable-width: read any .lst ONCE at full width
+        # here and hand the parsed list down (ImageIter's in-memory-list
+        # path only re-wraps it, no second file parse)
+        if path_imglist:
+            with open(path_imglist) as f:
+                imglist = [
+                    [onp.asarray([float(p) for p in parts[1:-1]],
+                                 onp.float32), parts[-1]]
+                    for parts in (line.strip().split("\t") for line in f)
+                    if len(parts) >= 2]
+            path_imglist = None
         super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
-                         path_imglist=path_imglist, path_root=path_root,
+                         path_imglist=None, path_root=path_root,
                          shuffle=shuffle, aug_list=[],
                          imglist=imglist, label_width=1)
         self.auglist = aug_list
-        # rebuild list labels at FULL width (ImageIter narrowed them to
-        # label_width scalars)
+        # restore FULL label width (ImageIter narrowed in-memory labels
+        # to label_width scalars)
         if imglist is not None:
             self.imglist = [(onp.asarray(e[0], onp.float32).ravel(), e[-1])
                             for e in imglist]
-        elif path_imglist:
-            entries = []
-            with open(path_imglist) as f:
-                for line in f:
-                    parts = line.strip().split("\t")
-                    entries.append((onp.asarray(
-                        [float(p) for p in parts[1:-1]], onp.float32),
-                        parts[-1]))
-            self.imglist = entries
 
     @staticmethod
     def _parse_label(raw):

@@ -170,3 +170,24 @@ def test_random_rotate_within_limits():
     im = onp.zeros((16, 16, 3), dtype="uint8")
     out = img.random_rotate(im, (-10, 10))
     assert onp.asarray(out).shape == im.shape
+
+
+def test_image_det_iter_from_lst_file(tmp_path):
+    import cv2
+
+    lines = []
+    for i in range(3):
+        arr = _R.randint(0, 255, size=(20, 20, 3)).astype("uint8")
+        name = f"l{i}.png"
+        cv2.imwrite(str(tmp_path / name), arr)
+        flat = [2.0, 5.0, 0.0, 0.1, 0.1, 0.7, 0.8]
+        lines.append(f"{i}\t" + "\t".join(str(v) for v in flat) +
+                     f"\t{name}")
+    lst = tmp_path / "det.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    it = img.ImageDetIter(batch_size=3, data_shape=(3, 12, 12),
+                          path_imglist=str(lst), path_root=str(tmp_path))
+    batch = next(it)
+    assert batch.data[0].shape == (3, 3, 12, 12)
+    host = batch.label[0].asnumpy()
+    assert host.shape[2] == 5 and (host[:, 0, 0] == 0.0).all()

@@ -22,10 +22,10 @@ def parse(lines, metric_names):
         row = epochs.setdefault(e, {})
         for name in metric_names:
             m = re.search(rf"(?:train|validation)?-?{name}[=:]\s*([0-9.eE+-]+)",
-                          line)
+                          line, re.IGNORECASE)
             if m:
-                key = name if f"validation-{name}" not in line else \
-                    f"val-{name}"
+                key = f"val-{name}" if re.search(
+                    rf"validation-{name}", line, re.IGNORECASE) else name
                 row[key] = float(m.group(1))
         m = re.search(r"[Ss]peed[:=]\s*([0-9.]+)\s*samples/sec", line)
         if m:

@@ -18,6 +18,7 @@ __all__ = [
     "is_np_shape", "is_np_array", "is_np_default_dtype", "set_np_shape",
     "set_np", "reset_np", "np_shape", "np_array", "use_np_shape",
     "use_np_array", "use_np", "np_default_dtype", "use_np_default_dtype",
+    "set_np_default_dtype", "default_array", "set_module",
     "wrap_np_unary_func", "wrap_np_binary_func", "getenv", "setenv",
 ]
 
@@ -153,3 +154,37 @@ def setenv(name, value):
     import os
 
     os.environ[name] = str(value)
+
+
+def set_np_default_dtype(is_np_default_dtype: bool = True) -> bool:
+    """Flip the default creation dtype to float64-like NumPy semantics
+    (reference util.py set_np_default_dtype).  Returns the previous flag.
+    On TPU float64 narrows to float32 at device boundaries — the flag
+    still controls HOST-side dtype resolution for parity."""
+    prev = _STATE.np_default_dtype
+    _STATE.np_default_dtype = bool(is_np_default_dtype)
+    return prev
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    """Create an ``mx.np`` or ``mx.nd`` array depending on the active
+    numpy-compatibility state (reference util.py default_array)."""
+    if is_np_array():
+        from . import numpy as _np_mod
+
+        return _np_mod.array(source_array, ctx=ctx, dtype=dtype)
+    from .ndarray import array as _nd_array
+
+    return _nd_array(source_array, ctx=ctx, dtype=dtype)
+
+
+def set_module(module):
+    """Decorator overriding ``__module__`` for doc rendering (reference
+    util.py set_module)."""
+
+    def deco(obj):
+        if module is not None:
+            obj.__module__ = module
+        return obj
+
+    return deco

@@ -28,7 +28,8 @@ LOW_PRECISION_FUNCS = [
     "matmul", "interleaved_matmul_selfatt_qk",
     "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
     "interleaved_matmul_encdec_valatt", "linalg_gemm", "linalg_gemm2",
-    "_rnn_fused", "DeformableConvolution", "Correlation", "khatri_rao",
+    "_rnn_fused", "DeformableConvolution", "ModulatedDeformableConvolution",
+    "Correlation", "khatri_rao",
 ]
 
 FP32_FUNCS = [

@@ -67,6 +67,10 @@ SPECS = {
                                                          onp.float32),
                                _f(6, 4, 3, 3), _f(6)],
                               dict(kernel=(3, 3), num_filter=6)),
+    "ModulatedDeformableConvolution": (
+        [_f(1, 4, 7, 7), onp.zeros((1, 18, 5, 5), onp.float32),
+         onp.full((1, 9, 5, 5), 0.5, onp.float32), _f(6, 4, 3, 3), _f(6)],
+        dict(kernel=(3, 3), num_filter=6)),
     "Correlation": ([_f(1, 4, 6, 6), _f(1, 4, 6, 6)],
                     dict(max_displacement=1, pad_size=1)),
     "Crop": ([_f(1, 2, 6, 6)], dict(h_w=(4, 4), center_crop=True)),

@@ -133,3 +133,10 @@ def test_multi_threaded_inference_example():
     out = _run("example/multi_threaded_inference/multi_threaded_inference.py",
                "--threads", "3", "--iters", "4")
     assert "bit-identical" in out
+
+
+@pytest.mark.slow
+def test_horovod_style_example():
+    out = _run("example/distributed_training-horovod/"
+               "train_horovod_style.py", "--steps", "60")
+    assert "horovod-style kvstore: rank 0/" in out

@@ -62,7 +62,10 @@ _ALIASES = {
     "erfinv": None,
     "broadcast_like": "broadcast_to",
     "constraint_check": None,
-    "rnn": None,
+    "rnn": "_rnn_fused",
+    "reshape": "npx_reshape",
+    "batch_flatten": "flatten",
+    "slice_axis": "slice_axis",
     "intgemm_fully_connected": "FullyConnected",
     "interleaved_matmul_selfatt_qk": "interleaved_matmul_selfatt_qk",
     "interleaved_matmul_selfatt_valatt": "interleaved_matmul_selfatt_valatt",

@@ -23,6 +23,74 @@ def reshape(data, shape=None, reverse=False):
     return jnp.reshape(data, shape)
 
 
+@register("npx_reshape", aliases=["_npx_reshape"])
+def npx_reshape(data, newshape=None, reverse=False, order="C"):
+    """npx.reshape — the NUMPY-EXTENSION special codes (reference
+    _numpy_op_doc.py:563): -1 infer, -2 copy dim, -3 drop a size-1 dim,
+    -4 copy ALL remaining dims, -5 merge two consecutive dims, -6 split
+    a dim into the two factors that follow."""
+    src = tuple(data.shape)
+    shape = list(newshape if isinstance(newshape, (list, tuple))
+                 else [newshape])
+    if reverse:
+        # right-to-left SHAPE resolution only (data stays C-order): expand
+        # the mirrored spec against the mirrored src, mirror the result
+        out_rev = _expand_npx_codes(src[::-1], _reverse_npx_spec(shape),
+                                    mirror_splits=True)
+        return jnp.reshape(data, tuple(out_rev)[::-1])
+    return jnp.reshape(data, tuple(_expand_npx_codes(src, shape)))
+
+
+def _expand_npx_codes(src, shape, mirror_splits=False):
+    out = []
+    i = 0
+    j = 0
+    while j < len(shape):
+        s = shape[j]
+        if s == -2:
+            out.append(src[i]); i += 1
+        elif s == -3:
+            if src[i] != 1:
+                raise ValueError(
+                    f"npx.reshape -3 requires a size-1 dim, got {src[i]}")
+            i += 1
+        elif s == -4:
+            out.extend(src[i:]); i = len(src)
+        elif s == -5:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -6:
+            d1, d2 = shape[j + 1], shape[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            if d1 * d2 != src[i]:
+                raise ValueError(
+                    f"npx.reshape -6: {d1}x{d2} != {src[i]}")
+            out.extend([d2, d1] if mirror_splits else [d1, d2])
+            i += 1; j += 2
+        elif s == -1:
+            out.append(-1); i += 1
+        else:
+            out.append(s); i += 1
+        j += 1
+    return out
+
+
+def _reverse_npx_spec(shape):
+    """Reverse an npx-reshape spec keeping -6's factor pairs attached."""
+    groups = []
+    j = 0
+    while j < len(shape):
+        if shape[j] == -6:
+            groups.append(shape[j:j + 3])
+            j += 3
+        else:
+            groups.append([shape[j]])
+            j += 1
+    return [v for g in reversed(groups) for v in g]
+
+
 def _expand_reshape_codes(src, shape):
     """Implements MXNet reshape special codes 0/-1/-2/-3/-4
     (reference matrix_op.cc InferReshapeShape)."""

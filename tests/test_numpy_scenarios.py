@@ -337,3 +337,54 @@ def test_ste_gradients():
     g3 = jax.grad(lambda t: jnp.sum(_get("gradientmultiplier")(
         t, scalar=-0.5)))(x)
     onp.testing.assert_allclose(onp.asarray(g3), -0.5)
+
+
+# ---------------------------------------------------------------------------
+# npx.reshape special codes (reference _numpy_op_doc.py:563 _npx_reshape)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("src,spec,want", [
+    ((2, 3, 8), (-2, -2, 2, -1), (2, 3, 2, 4)),
+    ((2, 3, 8), (-5, -1), (6, 8)),
+    ((1, 12, 3, 5), (-2, -6, -1, 6, -2, -2), (1, 2, 6, 3, 5)),
+    ((1, 12, 3, 5), (-3, -1), (180,)),
+    ((2, 3, 4), (-4,), (2, 3, 4)),
+    ((8, 3), (-6, 2, 4, -2), (2, 4, 3)),
+])
+def test_npx_reshape_codes(src, spec, want):
+    import mxnet_tpu.numpy_extension as npx
+
+    x = mx.np.array(onp.arange(int(onp.prod(src)),
+                               dtype="float32").reshape(src))
+    out = npx.reshape(x, spec)
+    assert out.shape == want
+    # pure reshape: C-order data unchanged
+    onp.testing.assert_array_equal(out.asnumpy().ravel(),
+                                   x.asnumpy().ravel())
+
+
+def test_npx_reshape_reverse_right_aligned():
+    import mxnet_tpu.numpy_extension as npx
+
+    x = mx.np.array(onp.arange(24, dtype="float32").reshape(2, 3, 4))
+    out = npx.reshape(x, (-1, -2), reverse=True)
+    assert out.shape == (6, 4)
+    onp.testing.assert_array_equal(out.asnumpy().ravel(),
+                                   x.asnumpy().ravel())
+
+
+def test_npx_reshape_minus3_requires_unit_dim():
+    import mxnet_tpu.numpy_extension as npx
+
+    x = mx.np.ones((2, 3))
+    with pytest.raises(Exception):
+        npx.reshape(x, (-3, -1))
+
+
+def test_npx_rnn_and_flatten_aliases_exist():
+    import mxnet_tpu.numpy_extension as npx
+
+    assert callable(npx.rnn)
+    assert npx.batch_flatten(mx.np.ones((2, 3, 4))).shape == (2, 12)
+    assert npx.slice_axis(mx.np.ones((2, 6)), axis=1, begin=1,
+                          end=4).shape == (2, 3)

@@ -320,17 +320,18 @@ class _PixelShuffle(HybridBlock):
     def forward(self, x):
         fs = self._factors
         n = len(fs)
-        shape = x.shape               # (N, prod(f)*C, *spatial)
+        shape = x.shape               # (N, C*prod(f), *spatial)
         fprod = 1
         for f in fs:
             fprod *= f
         C = shape[1] // fprod
         spatial = shape[2:]
-        # (N, f1..fn, C, s1..sn) -> interleave (si, fi) pairs
-        x = x.reshape((shape[0],) + fs + (C,) + spatial)
-        perm = [0, n + 1]
+        # reference channel grouping: C outermost, then f1..fn
+        # (N, C, f1..fn, s1..sn) -> interleave (si, fi) pairs
+        x = x.reshape((shape[0], C) + fs + spatial)
+        perm = [0, 1]
         for i in range(n):
-            perm += [n + 2 + i, 1 + i]
+            perm += [2 + n + i, 2 + i]
         x = x.transpose(perm)
         out_spatial = tuple(s * f for s, f in zip(spatial, fs))
         return x.reshape((shape[0], C) + out_spatial)

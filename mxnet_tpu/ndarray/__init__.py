@@ -40,6 +40,16 @@ def __getattr__(name):
     reference-name aliases) resolve through the registry on first access —
     the analog of the reference regenerating its namespace after MXLoadLib.
     """
+    if name in ("np", "npx"):
+        # 1.x hybrid_forward passes F=this module; reference code reaches
+        # the numpy surfaces as F.np / F.npx
+        import importlib
+
+        mod = importlib.import_module(
+            "mxnet_tpu.numpy" if name == "np" else
+            "mxnet_tpu.numpy_extension")
+        setattr(_this, name, mod)
+        return mod
     schema = _registry.find_op(name)
     if schema is not None and "nd" in schema.namespaces:
         fn = make_op_func(schema)

@@ -125,3 +125,34 @@ def test_forward_still_preferred_when_defined():
     net = Both()
     net.initialize()
     onp.testing.assert_allclose(net(nd.ones((2,))).asnumpy(), [2.0, 2.0])
+
+
+def test_one_x_block_with_npx_reshape_idiom():
+    """A 1.x-style block using the F.np/F.npx idioms (the reference's own
+    PixelShuffle implementation pattern) runs through the shim unchanged:
+    F.npx.reshape special codes + F.np.transpose inside hybrid_forward."""
+
+    class UserPixelShuffle(gluon.HybridBlock):
+        def __init__(self, factor):
+            super().__init__()
+            self._f = factor
+
+        def hybrid_forward(self, F, x):
+            f1 = f2 = self._f
+            x = F.npx.reshape(x, (-2, -6, -1, f1 * f2, -2, -2))
+            x = F.npx.reshape(x, (-2, -2, -6, f1, f2, -2, -2))
+            x = F.np.transpose(x, (0, 1, 4, 2, 5, 3))
+            return F.npx.reshape(x, (-2, -2, -5, -5))
+
+    net = UserPixelShuffle(2)
+    net.initialize()
+    x = mx.np.array(_R.rand(1, 8, 3, 5).astype("float32"))
+    out = net(x)
+    assert out.shape == (1, 2, 6, 10)
+    # agrees with the library layer
+    want = nn.PixelShuffle2D(2)(nd.array(x.asnumpy())).asnumpy()
+    onp.testing.assert_allclose(onp.asarray(out.asnumpy()), want,
+                                rtol=1e-6)
+    net.hybridize()
+    onp.testing.assert_allclose(onp.asarray(net(x).asnumpy()), want,
+                                rtol=1e-6)

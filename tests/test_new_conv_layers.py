@@ -15,15 +15,17 @@ _R = onp.random.RandomState(21)
 # ---------------------------------------------------------------------------
 
 def _pixel_shuffle_ref(x, factors):
+    """Reference convention: channel dim factors as (C, f1..fn) with C
+    OUTERMOST (the reference's npx.reshape -6 split order)."""
     n = len(factors)
     N = x.shape[0]
     fprod = int(onp.prod(factors))
     C = x.shape[1] // fprod
     spatial = x.shape[2:]
-    x = x.reshape((N,) + tuple(factors) + (C,) + spatial)
-    perm = [0, n + 1]
+    x = x.reshape((N, C) + tuple(factors) + spatial)
+    perm = [0, 1]
     for i in range(n):
-        perm += [n + 2 + i, 1 + i]
+        perm += [2 + n + i, 2 + i]
     x = x.transpose(perm)
     return x.reshape((N, C) + tuple(s * f for s, f in zip(spatial, factors)))
 

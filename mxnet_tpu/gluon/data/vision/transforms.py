@@ -20,7 +20,8 @@ __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
            "RandomLighting", "RandomCrop", "RandomHue", "RandomColorJitter",
-           "RandomGray"]
+           "RandomGray", "RandomApply", "RandomChoice", "CropResize",
+           "Rotate", "RandomRotation"]
 
 
 def _as_host(x):
@@ -298,3 +299,88 @@ class RandomGray(_Transform):
                     @ onp.array([0.299, 0.587, 0.114], onp.float32))
             x = onp.repeat(gray[..., None], 3, axis=2)
         return x
+
+
+class RandomApply(_Transform):
+    """Apply the whole transform list with probability p (reference
+    transforms RandomApply / HybridRandomApply)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        self._transforms = transforms if isinstance(transforms, list) \
+            else [transforms]
+        self._p = p
+
+    def forward(self, x):
+        if pyrandom.random() < self._p:
+            for t in self._transforms:
+                x = t(x)
+        return x
+
+
+class RandomChoice(_Transform):
+    """Pick ONE transform uniformly per sample."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        self._transforms = list(transforms)
+
+    def forward(self, x):
+        return pyrandom.choice(self._transforms)(x)
+
+
+class CropResize(_Transform):
+    """Fixed crop (x, y, w, h) + optional resize (reference transforms
+    image.py CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._box = (x, y, width, height)
+        self._size = (size, size) if isinstance(size, int) else size
+        self._interp = interpolation
+
+    def forward(self, img):
+        img = _as_host(img)
+        x, y, w, h = self._box
+        out = img[y:y + h, x:x + w]
+        if self._size is not None:
+            out = _resize(out, self._size, self._interp)
+        return out
+
+
+class Rotate(_Transform):
+    """Rotate by a fixed angle (reference transforms Rotate)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        self._deg = rotation_degrees
+        self._zi, self._zo = zoom_in, zoom_out
+
+    def forward(self, x):
+        from ....image import imrotate
+
+        return _as_host(imrotate(_as_host(x).astype(onp.float32),
+                                 self._deg, zoom_in=self._zi,
+                                 zoom_out=self._zo))
+
+
+class RandomRotation(_Transform):
+    """Rotate by a uniform random angle in ``angle_limits`` (reference
+    transforms RandomRotation)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        super().__init__()
+        self._limits = angle_limits
+        self._zi, self._zo = zoom_in, zoom_out
+        self._p = rotate_with_proba
+
+    def forward(self, x):
+        if pyrandom.random() >= self._p:
+            return _as_host(x)
+        from ....image import imrotate
+
+        return _as_host(imrotate(
+            _as_host(x).astype(onp.float32),
+            pyrandom.uniform(*self._limits),
+            zoom_in=self._zi, zoom_out=self._zo))

@@ -191,3 +191,26 @@ def test_image_bbox_dataloader_normalized(bbox_tree):
     host = boxes.asnumpy()
     real = host[host[..., 0] >= 0]
     assert (real[:, :4] <= 1.0 + 1e-5).all()
+
+
+def test_random_apply_choice_crop_rotate():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    img = _R.randint(0, 255, size=(20, 24, 3)).astype("uint8")
+    # p=0 -> identity; p=1 -> applied
+    out = T.RandomApply([T.Cast("float32")], p=0.0)(img)
+    assert onp.asarray(out).dtype == onp.uint8
+    out = T.RandomApply([T.Cast("float32")], p=1.0)(img)
+    assert onp.asarray(out).dtype == onp.float32
+    out = T.RandomChoice([T.Cast("float32")])(img)
+    assert onp.asarray(out).dtype == onp.float32
+    out = T.CropResize(2, 3, 10, 8, size=(5, 4))(img)
+    assert onp.asarray(out).shape == (4, 5, 3)
+    out = T.CropResize(0, 0, 8, 8)(img)
+    assert onp.asarray(out).shape == (8, 8, 3)
+    out = T.Rotate(90)(img)
+    assert onp.asarray(out).shape == img.shape
+    out = T.RandomRotation((-15, 15))(img)
+    assert onp.asarray(out).shape == img.shape
+    out = T.RandomRotation((-15, 15), rotate_with_proba=0.0)(img)
+    onp.testing.assert_array_equal(onp.asarray(out), img)

@@ -156,3 +156,87 @@ def test_one_x_block_with_npx_reshape_idiom():
     net.hybridize()
     onp.testing.assert_allclose(onp.asarray(net(x).asnumpy()), want,
                                 rtol=1e-6)
+
+
+def test_one_x_resnet_basic_block_idiom():
+    """1.x ResNet BasicBlock written the reference way: child layers +
+    F.Activation + residual add inside hybrid_forward."""
+
+    class BasicBlock(gluon.HybridBlock):
+        def __init__(self, channels):
+            super().__init__()
+            self.conv1 = nn.Conv2D(channels, 3, padding=1, use_bias=False,
+                                   in_channels=channels)
+            self.bn1 = nn.BatchNorm(in_channels=channels)
+            self.conv2 = nn.Conv2D(channels, 3, padding=1, use_bias=False,
+                                   in_channels=channels)
+            self.bn2 = nn.BatchNorm(in_channels=channels)
+
+        def hybrid_forward(self, F, x):
+            out = F.Activation(self.bn1(self.conv1(x)), act_type="relu")
+            out = self.bn2(self.conv2(out))
+            return F.Activation(out + x, act_type="relu")
+
+    net = BasicBlock(4)
+    net.initialize()
+    x = nd.array(_R.rand(2, 4, 8, 8).astype("float32"))
+    eager = net(x).asnumpy()
+    assert eager.shape == (2, 4, 8, 8) and (eager >= 0).all()
+    net.hybridize()
+    onp.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-5,
+                                atol=1e-5)
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    assert onp.isfinite(net.conv1.weight.grad().asnumpy()).all()
+
+
+def test_one_x_attention_idiom():
+    """1.x attention written with F.batch_dot / F.softmax / F.swapaxes —
+    the spellings reference transformer code uses."""
+
+    class Attn(gluon.HybridBlock):
+        def hybrid_forward(self, F, q, k, v):
+            scores = F.batch_dot(q, F.swapaxes(k, 1, 2)) / (q.shape[-1] ** 0.5)
+            w = F.softmax(scores, axis=-1)
+            return F.batch_dot(w, v)
+
+    net = Attn()
+    net.initialize()
+    q = nd.array(_R.rand(2, 5, 8).astype("float32"))
+    k = nd.array(_R.rand(2, 5, 8).astype("float32"))
+    v = nd.array(_R.rand(2, 5, 8).astype("float32"))
+    out = net(q, k, v)
+    # numpy oracle
+    s = q.asnumpy() @ k.asnumpy().transpose(0, 2, 1) / onp.sqrt(8)
+    w = onp.exp(s - s.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    onp.testing.assert_allclose(out.asnumpy(), w @ v.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+    net.hybridize()
+    onp.testing.assert_allclose(net(q, k, v).asnumpy(), out.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_one_x_masking_idiom():
+    """F.where / F.broadcast_mul / F.expand_dims spellings."""
+
+    class Mask(gluon.HybridBlock):
+        def hybrid_forward(self, F, x, mask):
+            big_neg = F.ones_like(x) * -1e9
+            masked = F.where(F.broadcast_mul(
+                F.ones_like(x), F.expand_dims(mask, axis=-1)) > 0,
+                x, big_neg)
+            return F.softmax(masked, axis=1)
+
+    net = Mask()
+    net.initialize()
+    x = nd.array(_R.rand(3, 4, 2).astype("float32"))
+    mask = nd.array(onp.array([[1, 1, 0, 0], [1, 0, 0, 0], [1, 1, 1, 1]],
+                              "float32"))
+    out = net(x, mask).asnumpy()
+    # masked positions get ~zero probability
+    assert out[0, 2:, :].max() < 1e-6
+    assert out[1, 1:, :].max() < 1e-6
+    onp.testing.assert_allclose(out.sum(axis=1), onp.ones((3, 2)),
+                                rtol=1e-5)

@@ -119,7 +119,7 @@ FP16_FP32_FUNCS = [
     "bitwise_or", "bitwise_xor", "bitwise_not", "isnan", "isinf",
     "isfinite", "allclose", "all_finite", "multi_all_finite",
     # shape/index/move ops
-    "reshape", "Reshape", "flatten", "transpose", "expand_dims",
+    "reshape", "Reshape", "npx_reshape", "flatten", "transpose", "expand_dims",
     "squeeze", "swapaxes", "SwapAxis", "slice", "slice_axis",
     "slice_like", "split", "SliceChannel", "take", "batch_take",
     "embedding", "one_hot", "pick", "gather_nd", "scatter_nd",

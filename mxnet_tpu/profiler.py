@@ -305,8 +305,12 @@ def memory_summary(block=None, device=None, top=20) -> str:
         rows.append((nbytes, names.get(id(arr), "<anonymous>"),
                      tuple(arr.shape), str(arr.dtype)))
     rows.sort(reverse=True)
+    # attribution is the point: named (parameter) rows always print;
+    # `top` only truncates the anonymous tail
+    named = [r for r in rows if r[1] != "<anonymous>"]
+    anon = [r for r in rows if r[1] == "<anonymous>"]
     lines = [f"{'bytes':>12}  {'name':<32} shape dtype"]
-    for nbytes, name, shape, dtype in rows[:top]:
+    for nbytes, name, shape, dtype in named + anon[:top]:
         lines.append(f"{nbytes:>12}  {name:<32} {shape} {dtype}")
     lines.append(f"{total:>12}  TOTAL ({len(rows)} live buffers)")
     return "\n".join(lines)

@@ -90,6 +90,7 @@ SPECS = {
                                          dict(heads=2)),
     # --- tensor/shape ---------------------------------------------------
     "reshape": ([_f(4, 6)], dict(shape=(6, 4))),
+    "npx_reshape": ([_f(2, 3, 8)], dict(newshape=(-2, -2, 2, -1))),
     "Reshape": ([_f(4, 6)], dict(shape=(6, 4))),
     "slice": ([_f(4, 6)], dict(begin=(0, 1), end=(3, 5))),
     "reverse": ([_f(4, 6)], dict(axis=0)),

@@ -1,0 +1,173 @@
+"""Control-flow op scenarios — mirrors the reference's
+``test_contrib_control_flow.py`` families (foreach states, while_loop
+forward, cond branches, nesting, gradients, hybridized equivalence)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+_R = onp.random.RandomState(29)
+
+
+def test_foreach_cumsum_states():
+    data = nd.array(_R.rand(5, 3).astype("float32"))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    out, final = nd.contrib.foreach(body, data, nd.zeros((3,)))
+    want = onp.cumsum(data.asnumpy(), axis=0)
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+    onp.testing.assert_allclose(final.asnumpy(), want[-1], rtol=1e-6)
+
+
+def test_foreach_multiple_states_and_outputs():
+    data = nd.array(_R.rand(4, 2).astype("float32"))
+
+    def body(x, states):
+        s1, s2 = states
+        return [x + s1, x * s2], [s1 + x, s2 * 0.5]
+
+    outs, (f1, f2) = nd.contrib.foreach(
+        body, data, [nd.zeros((2,)), nd.ones((2,))])
+    host = data.asnumpy()
+    run = onp.zeros(2, "float32")
+    acc0, acc1 = [], []
+    scale = onp.ones(2, "float32")
+    for i in range(4):
+        acc0.append(host[i] + run)
+        acc1.append(host[i] * scale)
+        run = run + host[i]
+        scale = scale * 0.5
+    onp.testing.assert_allclose(outs[0].asnumpy(), onp.stack(acc0),
+                                rtol=1e-6)
+    onp.testing.assert_allclose(outs[1].asnumpy(), onp.stack(acc1),
+                                rtol=1e-6)
+    onp.testing.assert_allclose(f1.asnumpy(), run, rtol=1e-6)
+
+
+def test_foreach_nested():
+    data = nd.array(_R.rand(3, 2, 2).astype("float32"))
+
+    def inner_body(x, s):
+        return x + s, s + 1
+
+    def outer_body(mat, s):
+        out, _ = nd.contrib.foreach(inner_body, mat, nd.zeros(()))
+        return out.sum(), s + out.sum()
+
+    outs, final = nd.contrib.foreach(outer_body, data, nd.zeros(()))
+    host = data.asnumpy()
+    want = []
+    for i in range(3):
+        inner = host[i] + onp.array([0.0, 1.0])[:, None]
+        want.append(inner.sum())
+    onp.testing.assert_allclose(outs.asnumpy(), onp.asarray(want),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(float(final.asnumpy()), sum(want),
+                                rtol=1e-5)
+
+
+def test_foreach_gradients():
+    data = nd.array(_R.rand(4, 3).astype("float32"))
+    data.attach_grad()
+
+    def body(x, s):
+        return x * x + s, s + x.sum()
+
+    with autograd.record():
+        out, final = nd.contrib.foreach(body, data, nd.zeros(()))
+        loss = out.sum() + final
+    loss.backward()
+    # d/dx [sum(x^2 terms) + cumulative-state contributions]
+    host = data.asnumpy()
+    # out[i] = x_i^2 + s_i where s_i = sum_{j<i} sum(x_j)
+    # d loss/d x_i = 2 x_i + (rows after i contribute 3 each per element)
+    n = 4
+    grad = 2 * host.copy()
+    for i in range(n):
+        later_rows = n - 1 - i          # rows using s beyond i
+        grad[i] += 3 * later_rows       # each later out row has 3 elements
+        grad[i] += 1                    # final state term
+    onp.testing.assert_allclose(data.grad.asnumpy(), grad, rtol=1e-4)
+
+
+def test_while_loop_counts():
+    def cond_fn(i, total):
+        return i < 5
+
+    def func(i, total):
+        return None, [i + 1, total + i]
+
+    _, (i, total) = nd.contrib.while_loop(
+        cond_fn, func, [nd.array([0.0]), nd.array([0.0])])
+    assert float(i.asnumpy().ravel()[0]) == 5.0
+    assert float(total.asnumpy().ravel()[0]) == 10.0       # 0+1+2+3+4
+
+
+def test_while_loop_max_iterations_and_outputs():
+    def cond_fn(i):
+        return i < 100
+
+    def func(i):
+        return i * 2, i + 1
+
+    outs, final = nd.contrib.while_loop(cond_fn, func, nd.array([0.0]),
+                                        max_iterations=4)
+    onp.testing.assert_allclose(outs.asnumpy().ravel(), [0, 2, 4, 6])
+    assert float(final.asnumpy().ravel()[0]) == 4.0
+
+
+def test_cond_branches():
+    x = nd.array([2.0])
+    y = nd.array([3.0])
+    out = nd.contrib.cond(nd.array([1.0]), lambda a, b: a + b,
+                          lambda a, b: a - b, (x, y))
+    assert float(out.asnumpy().ravel()[0]) == 5.0
+    out = nd.contrib.cond(nd.array([0.0]), lambda a, b: a + b,
+                          lambda a, b: a - b, (x, y))
+    assert float(out.asnumpy().ravel()[0]) == -1.0
+
+
+def test_control_flow_inside_hybridblock():
+    """foreach inside a HybridBlock lowers to lax.scan under hybridize
+    and matches the eager run."""
+
+    class Cumulator(gluon.HybridBlock):
+        def forward(self, x):
+            out, _ = nd.contrib.foreach(
+                lambda step, s: (step + s, s + step), x,
+                mx.nd.zeros(x.shape[1:]))
+            return out
+
+    net = Cumulator()
+    net.initialize()
+    x = nd.array(_R.rand(6, 3).astype("float32"))
+    eager = net(x).asnumpy()
+    onp.testing.assert_allclose(eager,
+                                onp.cumsum(x.asnumpy(), axis=0),
+                                rtol=1e-6)
+    net.hybridize()
+    onp.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-6)
+    onp.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-6)
+
+
+def test_while_loop_gradient():
+    x = nd.array([1.5])
+    x.attach_grad()
+
+    def cond_fn(i, v):
+        return i < 3
+
+    def func(i, v):
+        return None, [i + 1, v * 2]
+
+    with autograd.record():
+        _, (_, v) = nd.contrib.while_loop(
+            cond_fn, func, [nd.array([0.0]), x])
+        loss = v.sum()
+    loss.backward()
+    # v = x * 2^3
+    onp.testing.assert_allclose(x.grad.asnumpy(), [8.0], rtol=1e-5)

@@ -42,6 +42,11 @@ class Trainer:
                     f"got list of {type(param)}."
                 )
             if param.grad_req != "null":
+                # reference semantics: _trainer is a weakref-like pointer —
+                # a NEW trainer takes the parameter over (the old one,
+                # usually discarded, goes stale); only SPARSE parameters
+                # reject multiple live trainers, and this backend is
+                # dense-on-device by design (gluon/parameter.py).
                 self._param2idx[id(param)] = i
                 self._params.append(param)
                 param._trainer = self

@@ -183,3 +183,104 @@ def test_trainer_with_net_end_to_end():
             first = float(loss.asnumpy())
     final = float(loss.asnumpy())
     assert final < first * 0.05, (first, final)
+
+
+def test_multi_trainer_takeover():
+    """Reference semantics (test_multi_trainer): a NEW trainer takes a
+    dense parameter over — the _trainer pointer tracks the latest one
+    (sparse params would reject; this backend is dense-on-device)."""
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    t1 = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    assert net.weight._trainer is t1
+    t2 = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    assert net.weight._trainer is t2
+
+
+def test_trainer_param_order_stable():
+    """Parameter ordering is deterministic across constructions
+    (reference test_gluon_trainer_param_order: kvstore keying depends
+    on it)."""
+    def build():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(4, in_units=3),
+                gluon.nn.Dense(2, in_units=4))
+        net.initialize()
+        return list(net.collect_params().keys())
+
+    assert build() == build()
+
+
+def test_trainer_share_parameters_trains_shared_weight():
+    """share_parameters ties weights: one trainer step moves BOTH
+    blocks' view of the tied parameter (reference
+    test_trainer_share_parameters)."""
+    a = gluon.nn.Dense(4, in_units=4, use_bias=False)
+    b = gluon.nn.Dense(4, in_units=4, use_bias=False)
+    a.initialize()
+    b.initialize()
+    b.share_parameters({"weight": a.weight})
+    trainer = gluon.Trainer(a.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    x = mx.nd.array(onp.random.RandomState(0).rand(2, 4).astype("f"))
+    w0 = a.weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        loss = (a(x) ** 2).sum()
+    loss.backward()
+    trainer.step(2)
+    w1 = a.weight.data().asnumpy()
+    assert not onp.allclose(w0, w1)
+    onp.testing.assert_allclose(b.weight.data().asnumpy(), w1)
+    # forward through b uses the updated weight
+    onp.testing.assert_allclose(b(x).asnumpy(), a(x).asnumpy(),
+                                rtol=1e-6)
+
+
+def test_trainer_reset_kvstore_reinitializes():
+    net = gluon.nn.Dense(3, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.ones((2, 2))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(2)
+    trainer._reset_kvstore()
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(2)                      # works after reset
+    assert onp.isfinite(net.weight.data().asnumpy()).all()
+
+
+def test_trainer_allreduce_hybridsequential():
+    """allreduce_grads + manual update path (reference
+    test_trainer_allreduce_hybridsequential): same result as step()."""
+    def build():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(4, in_units=3, use_bias=False))
+        net.initialize(mx.init.Constant(0.5))
+        return net
+
+    x = mx.nd.array(onp.random.RandomState(1).rand(2, 3).astype("f"))
+
+    net1 = build()
+    t1 = gluon.Trainer(net1.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    with mx.autograd.record():
+        (net1(x) ** 2).sum().backward()
+    t1.step(1)
+
+    net2 = build()
+    t2 = gluon.Trainer(net2.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    with mx.autograd.record():
+        (net2(x) ** 2).sum().backward()
+    t2.allreduce_grads()
+    t2.update(1)
+    onp.testing.assert_allclose(net1[0].weight.data().asnumpy(),
+                                net2[0].weight.data().asnumpy(),
+                                rtol=1e-6)

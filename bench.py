@@ -456,7 +456,44 @@ def lane_int8(on_cpu: bool, model_name: str = "resnet50_v1") -> dict:
         "compile_s": round(compile_s, 1),
         "platform": jax.default_backend(),
     }
-    return _with_mfu(lane, RESNET50_INFER_OPS_PER_IMG, "int8")
+    lane = _with_mfu(lane, RESNET50_INFER_OPS_PER_IMG, "int8")
+    # Protect the headline before attempting the bf16 reference below: a
+    # wall-budget overrun SIGKILLs this subprocess (no except path runs),
+    # and the parent salvages the LAST parseable stdout line on timeout.
+    print(json.dumps(lane), flush=True)
+    # bf16 inference at the SAME batch, same run: the claim that matters
+    # is int8 beating bf16 inference ON THIS CHIP, so the ratio must be
+    # a single-window artifact, not a cross-round comparison.
+    try:
+        from mxnet_tpu import amp
+        _progress("int8: bf16 inference reference (matched batch)")
+        bnet = amp.convert_hybrid_block(
+            net, "bfloat16", ctx=None if on_cpu else mx.tpu(0))
+        bnet.hybridize()
+
+        def _time_net(run):
+            run()                               # compile + fence
+            for _ in range(2):
+                run()
+            float(jax.device_get(run()).ravel()[0])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = run()
+            float(jax.device_get(out).ravel()[0])
+            return batch * steps / (time.perf_counter() - t0)
+
+        def _run_bf16():
+            out = bnet(x)
+            return out._data if hasattr(out, "_data") else out
+
+        bf16_ips = _time_net(_run_bf16)
+        _progress(f"int8: bf16 inference ref {bf16_ips:.2f} img/s "
+                  f"(int8 is {imgs_per_sec / bf16_ips:.2f}x)")
+        lane["bf16_infer_ref"] = round(bf16_ips, 2)
+        lane["vs_bf16_infer"] = round(imgs_per_sec / bf16_ips, 3)
+    except Exception as exc:                    # pragma: no cover
+        _progress(f"int8: bf16 inference reference skipped: {exc!r}")
+    return lane
 
 
 def _resolve_lane(name):
@@ -568,6 +605,22 @@ def _spawn_lane(name: str, force_cpu: bool, budget: float,
             sys.stderr.write(err.decode("utf-8", "replace")
                              if isinstance(err, bytes) else err)
         _progress(f"lane {name}: KILLED after {budget:.0f}s budget")
+        # salvage: a lane may print a preliminary result line before an
+        # optional enrichment phase (lane_int8 does, ahead of its bf16
+        # reference); the measurement that completed should survive the kill
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        for line in reversed((out or "").strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    lane = json.loads(line)
+                    lane["truncated"] = f"budget {budget:.0f}s"
+                    _progress(f"lane {name}: salvaged preliminary result")
+                    return lane
+                except ValueError:
+                    continue
         return {"metric": metric, "value": 0.0, "unit": unit,
                 "vs_baseline": 0.0,
                 "error": f"lane exceeded {budget:.0f}s budget"}

@@ -1,0 +1,173 @@
+"""Capture an XLA op-level time breakdown of the ResNet train step.
+
+Usage:
+    python benchmark/profile_step.py [--model resnet50_v1] [--batch 128]
+        [--layout NHWC] [--s2d 1] [--bf16 1] [--steps 5] [--top 30]
+
+Writes a jax.profiler trace to --logdir (default /tmp/jaxprof) and then
+parses the Chrome-trace export (plugins/profile/*/…trace.json.gz) to print
+the top ops by total self time on the device track, grouped by a coarse
+kind (conv / fusion.reduce / fusion.loop / copy / other).  This is the
+measurement tool behind docs/PERF.md's MFU analysis; it exists so kernel
+work is guided by the actual step texture rather than FLOP models.
+
+Reference analog: the profiler flow of docs/static_site/.../profiler.md
+(reference python/mxnet/profiler.py) — here the source of truth is the
+XLA device trace rather than engine-push brackets.
+"""
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_step(model_name, batch, layout, s2d, bf16, img=224):
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    kw = {}
+    if model_name.startswith("resnet"):
+        kw = {"layout": layout, "input_layout": layout, "stem_s2d": s2d}
+    net = vision.get_model(model_name, classes=1000, **kw)
+    net.initialize(mx.init.Xavier())
+    probe = (1, img, img, 3) if layout == "NHWC" else (1, 3, img, img)
+    cpus = jax.devices("cpu") if jax.default_backend() != "cpu" else None
+    if cpus:
+        with jax.default_device(cpus[0]):
+            net(mx.nd.zeros(probe))
+    else:
+        net(mx.nd.zeros(probe))
+    ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = par.make_mesh({"dp": 1})
+    tr = par.ShardedTrainer(
+        net, lambda o, l: ce(o, l).mean(), mesh, optimizer="sgd",
+        optimizer_params={"lr": 0.1, "momentum": 0.9, "wd": 1e-4},
+        compute_dtype=jnp.bfloat16 if bf16 else None)
+    rng = onp.random.RandomState(0)
+    shape = (batch, img, img, 3) if layout == "NHWC" else (batch, 3, img, img)
+    data = rng.rand(*shape).astype(onp.float32)
+    label = rng.randint(0, 1000, (batch,)).astype(onp.int32)
+    data, label = tr.stage(data, label)
+    return tr, data, label
+
+
+def classify(name):
+    n = name.lower()
+    if "conv" in n:
+        return "conv"
+    if n.startswith("fusion") or ".fusion" in n:
+        return "fusion"
+    if "reduce" in n:
+        return "reduce"
+    if "copy" in n or "transpose" in n or "bitcast" in n:
+        return "copy/layout"
+    if "dot" in n or "matmul" in n:
+        return "matmul"
+    if "dynamic" in n or "scatter" in n or "gather" in n:
+        return "gather/scatter"
+    return "other"
+
+
+def parse_trace(logdir, top):
+    paths = sorted(glob.glob(os.path.join(
+        logdir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not paths:
+        print("no trace.json.gz found under", logdir)
+        return
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # device-track pids: their thread names look like "XLA Ops" / TensorFlow
+    # op tracks; host python tracks are excluded by requiring the 'dur' field
+    # and picking pids whose process name mentions TPU / device.
+    pid_names = {}
+    tid_names = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_names[ev["pid"]] = ev["args"].get("name", "")
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            tid_names[(ev["pid"], ev.get("tid"))] = ev["args"].get("name", "")
+    device_pids = {p for p, n in pid_names.items()
+                   if any(k in n for k in ("TPU", "Device", "/device:"))}
+    if not device_pids:
+        print("WARNING: no device track found in the trace — counting ALL "
+              "tracks (host rows included); op totals are not device time")
+    per_op = collections.Counter()
+    per_kind = collections.Counter()
+    total = 0.0
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        if device_pids and ev.get("pid") not in device_pids:
+            continue
+        tname = tid_names.get((ev.get("pid"), ev.get("tid")), "")
+        # XLA op-level rows live on "XLA Ops"-style threads; step/module
+        # rows would double count
+        if tname and ("step" in tname.lower() or "module" in tname.lower()):
+            continue
+        dur = ev["dur"]  # us
+        per_op[ev["name"]] += dur
+        per_kind[classify(ev["name"])] += dur
+        total += dur
+    print(f"\n== device op time (total {total/1e3:.2f} ms across "
+          f"{len(per_op)} op names; trace {os.path.basename(paths[-1])}) ==")
+    print("\n-- by kind --")
+    for kind, dur in per_kind.most_common():
+        print(f"  {kind:<16} {dur/1e3:10.2f} ms  {100*dur/max(total,1e-9):5.1f}%")
+    print(f"\n-- top {top} ops --")
+    for name, dur in per_op.most_common(top):
+        print(f"  {dur/1e3:9.2f} ms  {100*dur/max(total,1e-9):5.1f}%  {name[:110]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50_v1")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--layout", default="NHWC")
+    ap.add_argument("--s2d", type=int, default=1)
+    ap.add_argument("--bf16", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--logdir", default="/tmp/jaxprof")
+    ap.add_argument("--parse-only", action="store_true",
+                    help="just parse an existing --logdir trace")
+    args = ap.parse_args()
+
+    if not args.parse_only:
+        import jax
+        tr, data, label = build_step(args.model, args.batch, args.layout,
+                                     bool(args.s2d), bool(args.bf16))
+        print("compiling…")
+        t0 = time.perf_counter()
+        tr.step(data, label)
+        print(f"compiled in {time.perf_counter()-t0:.1f}s; warming")
+        for _ in range(2):
+            loss = tr.step(data, label, sync=False)
+        loss = getattr(loss, "asnumpy", lambda: loss)()
+        float(loss)
+        os.makedirs(args.logdir, exist_ok=True)
+        jax.profiler.start_trace(args.logdir)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            loss = tr.step(data, label, sync=False)
+        loss = getattr(loss, "asnumpy", lambda: loss)()
+        v = float(loss)
+        dt = time.perf_counter() - t0
+        jax.profiler.stop_trace()
+        print(f"{args.steps} steps in {dt*1e3:.1f} ms "
+              f"({args.batch*args.steps/dt:.1f} img/s, loss {v:.3f})")
+    parse_trace(args.logdir, args.top)
+
+
+if __name__ == "__main__":
+    main()

@@ -419,7 +419,11 @@ def lane_int8(on_cpu: bool, model_name: str = "resnet50_v1") -> dict:
         # conversion ran with a host-CPU default device: commit params to
         # the accelerator ONCE or every call re-transfers them
         qnet.stage()
-        x = mx.nd.array(jax.device_put(calib[0]._data, jax.devices()[0]))
+        # the input must be COMMITTED to the accelerator too: nd.array's
+        # default ctx is cpu (reference semantics), and a cpu-committed
+        # input makes the whole jitted graph fail device placement against
+        # the staged tpu params
+        x = mx.nd.array(calib[0], ctx=mx.tpu(0))
     else:
         net(probe)
         qnet = quant.quantize_net(net, calib)
@@ -629,9 +633,15 @@ def _spawn_lane(name: str, force_cpu: bool, budget: float,
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                lane = json.loads(line)
             except ValueError:
                 continue
+            # a child that died (OOM-kill, segfault) after printing a
+            # preliminary line must not read as a clean lane; the error
+            # path prints its own lane with "error" set and exits 1
+            if r.returncode != 0 and "error" not in lane:
+                lane["truncated"] = f"rc={r.returncode}"
+            return lane
     _progress(f"lane {name}: no JSON on child stdout (rc={r.returncode})")
     return {"metric": metric, "value": 0.0, "unit": unit,
             "vs_baseline": 0.0,

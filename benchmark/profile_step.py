@@ -7,7 +7,7 @@ Usage:
 Writes a jax.profiler trace to --logdir (default /tmp/jaxprof) and then
 parses the Chrome-trace export (plugins/profile/*/…trace.json.gz) to print
 the top ops by total self time on the device track, grouped by a coarse
-kind (conv / fusion.reduce / fusion.loop / copy / other).  This is the
+kind (conv / fusion / reduce / copy-layout / matmul / other).  This is the
 measurement tool behind docs/PERF.md's MFU analysis; it exists so kernel
 work is guided by the actual step texture rather than FLOP models.
 

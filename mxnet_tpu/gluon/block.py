@@ -506,14 +506,18 @@ class HybridBlock(Block):
         in_leaves, in_struct = _flatten_args(args)
         from ..ndarray import ndarray as _ndmod
 
-        sig = (training, _ndmod._amp_generation, _struct_key(in_struct))
+        ctx = in_leaves[0].ctx if in_leaves else current_context()
+        # ctx is part of the signature: the trace wraps its tracers in
+        # that ctx so layers doing ``weight.data(x.ctx)`` resolve a
+        # replica that actually exists (a net re-homed by reset_ctx and
+        # called on the new device would otherwise trace against the
+        # stale default ctx and fail the replica lookup)
+        sig = (training, _ndmod._amp_generation, _struct_key(in_struct), ctx)
         rec = self._cached.get(sig)
         if rec is None:
-            rec = self._build_cache(in_struct, training)
+            rec = self._build_cache(in_struct, training, ctx)
             self._cached[sig] = rec
         jitted, names, params, ctx_idx, out_struct, mutated_names = rec
-
-        ctx = in_leaves[0].ctx if in_leaves else current_context()
         param_arrays = [params[n]._data[_ctx_index(params[n], ctx)]._data
                         for n in names]
         input_arrays = [l._data for l in in_leaves]
@@ -567,7 +571,8 @@ class HybridBlock(Block):
             params[n]._data[_ctx_index(params[n], ctx)]._set_data(v)
         return _rebuild_output(out_struct[0], out_nd)
 
-    def _build_cache(self, in_struct, training):
+    def _build_cache(self, in_struct, training, ctx=None):
+        wrap_ctx = ctx or current_context()
         params = OrderedDict(
             (n, p) for n, p in self.collect_params().items() if p._data is not None
         )
@@ -587,7 +592,7 @@ class HybridBlock(Block):
             prev_rec = autograd.set_recording(False)
             prev_train = autograd.set_training(training)
             try:
-                leaves = [_wrap(a, current_context()) for a in input_arrays]
+                leaves = [_wrap(a, wrap_ctx) for a in input_arrays]
                 call_args = _unflatten_args(in_struct, leaves)
                 out = block.forward(*call_args)
             finally:

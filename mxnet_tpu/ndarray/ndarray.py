@@ -753,7 +753,18 @@ def _invoke_body(schema, ctx, arrays, inputs, attrs, out):
 def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
     """Create an NDArray from any array-like (reference mx.nd.array)."""
     if isinstance(source_array, NDArray):
-        return NDArray(source_array._data, ctx=ctx or source_array._ctx, dtype=dtype)
+        tgt = ctx or source_array._ctx
+        out = NDArray(source_array._data, ctx=tgt, dtype=dtype)
+        # An explicit ctx must MOVE an already-committed payload (the
+        # reference mx.nd.array(nd, ctx=gpu(0)) copies device-to-device);
+        # NDArray.__init__ wraps existing jax arrays in place, so the
+        # placement is enforced here.  Tracers (graph capture) carry no
+        # device and pass through untouched.
+        if ctx is not None and not isinstance(out._data, jax.core.Tracer):
+            dev = tgt.jax_device
+            if dev is not None and dev not in out._data.devices():
+                out._data = jax.device_put(out._data, dev)
+        return out
     if dtype is None:
         np_in = onp.asarray(source_array)
         # MXNet's default dtype is float32: wide floats narrow, float16 and

@@ -116,6 +116,30 @@ def test_convert_hybrid_block():
     assert params["1.gamma"].data().dtype == onp.float32
 
 
+def test_convert_hybrid_block_rehomed_ctx():
+    # convert_hybrid_block(ctx=...) re-homes the params; a hybridized call
+    # on the new device must trace against the CALLER's ctx, not the
+    # process default (caught live: the bench's bf16 inference reference
+    # failed replica lookup after reset_ctx to the accelerator)
+    import jax
+    import jax.numpy as jnp
+
+    if len(jax.devices()) < 2:
+        import pytest
+        pytest.skip("needs >=2 devices")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm(), nn.Dense(2))
+    net.initialize()
+    x0 = nd.ones((2, 4))
+    net(x0)
+    bnet = amp.convert_hybrid_block(net, "bfloat16", ctx=mx.cpu(1))
+    bnet.hybridize()
+    out = bnet(nd.array(x0, ctx=mx.cpu(1)))
+    assert out.ctx == mx.cpu(1)
+    assert out.dtype == jnp.bfloat16
+    assert list(out._data.devices()) == [jax.devices()[1]]
+
+
 def test_profiler_scopes_and_dump(tmp_path):
     fn = str(tmp_path / "trace.json")
     profiler.set_config(filename=fn)

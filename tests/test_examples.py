@@ -86,7 +86,11 @@ def test_autoencoder_example():
 
 @pytest.mark.slow
 def test_bi_lstm_sort_example():
-    out = _run("example/bi-lstm-sort/bi_lstm_sort.py", "--steps", "140")
+    # 140 biLSTM steps need ~6 min on the 1-core CI host and can exceed the
+    # default budget when the host is also driving a bench lane; the wider
+    # timeout keeps this a completion test, not a speed test
+    out = _run("example/bi-lstm-sort/bi_lstm_sort.py", "--steps", "140",
+               timeout=900)
     assert "sorted-position accuracy" in out
 
 

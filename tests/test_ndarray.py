@@ -138,6 +138,26 @@ def test_copyto_context():
     assert c.ctx.device_type == "cpu"
 
 
+def test_array_explicit_ctx_moves_committed_payload():
+    # nd.array(nd, ctx=...) must MOVE the payload (reference device-to-device
+    # copy semantics), even though the source already wraps a jax array.
+    # Caught live: the int8 bench staged params to the accelerator but the
+    # input stayed committed to host CPU, failing jit device placement.
+    import jax
+
+    if len(jax.devices()) < 2:
+        import pytest
+        pytest.skip("needs >=2 devices")
+    a = nd.array([1.0, 2.0], ctx=mx.cpu(0))
+    b = nd.array(a, ctx=mx.cpu(1))
+    assert b.ctx == mx.cpu(1)
+    assert list(b._data.devices()) == [jax.devices()[1]]
+    assert onp.allclose(b.asnumpy(), [1, 2])
+    # no explicit ctx: wrap in place, no surprise copy
+    c = nd.array(a)
+    assert c.ctx == a.ctx
+
+
 def test_save_load(tmp_path):
     fname = str(tmp_path / "params.npz")
     data = {"w": nd.array([1.0, 2.0]), "b": nd.array([3.0])}

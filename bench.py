@@ -401,13 +401,24 @@ def lane_int8(on_cpu: bool, model_name: str = "resnet50_v1") -> dict:
     batch = config.get("BENCH_BATCH", default=8 if on_cpu else 64)
     steps = config.get("BENCH_STEPS", default=3 if on_cpu else 20)
     img = config.get("BENCH_IMG", default=64 if on_cpu else 224)
-    _progress(f"int8: building {model_name} (batch={batch} img={img})")
-    net = vision.get_model(model_name, classes=1000)
+    # same channel-minor fast path as the train lanes (quantized_conv and
+    # the BN fold are layout-general); BENCH_LAYOUT=NCHW restores the
+    # reference texture
+    is_resnet = model_name.startswith("resnet")
+    layout = config.get("BENCH_LAYOUT") if is_resnet else "NCHW"
+    s2d = bool(config.get("BENCH_S2D")) and is_resnet
+    model_kw = ({"layout": layout, "input_layout": layout, "stem_s2d": s2d}
+                if is_resnet else {})
+    _progress(f"int8: building {model_name} (batch={batch} img={img} "
+              f"layout={layout} s2d={s2d})")
+    net = vision.get_model(model_name, classes=1000, **model_kw)
     net.initialize(mx.init.Xavier())
     cpu0 = jax.devices("cpu")[0] if jax.default_backend() != "cpu" else None
     rng = onp.random.RandomState(0)
-    probe = mx.nd.array(rng.rand(batch, 3, img, img).astype(onp.float32))
-    calib = [mx.nd.array(rng.rand(batch, 3, img, img).astype(onp.float32))
+    dshape = ((batch, img, img, 3) if layout == "NHWC"
+              else (batch, 3, img, img))
+    probe = mx.nd.array(rng.rand(*dshape).astype(onp.float32))
+    calib = [mx.nd.array(rng.rand(*dshape).astype(onp.float32))
              for _ in range(2)]
     # calibration stays on host CPU: eager small-op streams over the
     # tunnel are the round-1 failure mode

@@ -26,6 +26,21 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# the persistent XLA cache (bench.py sets the same) — a profile run of the
+# bench's own step must hit the bench's cache, not redo a cold multi-minute
+# tunnel compile.  The env var alone is NOT enough here: on tunnel-attached
+# hosts sitecustomize imports jax before this module body runs and jax reads
+# the var at import only, so the config is also set through jax.config.
+_CACHE_DIR = os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache"))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+import jax  # noqa: E402
+
+if jax.config.jax_compilation_cache_dir is None:
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
 
 def build_step(model_name, batch, layout, s2d, bf16, img=224):
     import jax

@@ -112,20 +112,39 @@ def quantized_fully_connected(arrays, num_hidden=0, no_bias=False,
 @register("quantized_conv", num_inputs=-1, differentiable=False)
 def quantized_conv(arrays, kernel=(1, 1), stride=(1, 1), dilate=(1, 1),
                    pad=(0, 0), num_filter=1, num_group=1, no_bias=False,
-                   layout="NCHW", data_scale=1.0, w_scale=1.0,
+                   layout=None, data_scale=1.0, w_scale=1.0,
                    fused_relu=False, out_min=None, out_max=None):
-    """s8 conv with s32 accumulation (reference quantized_conv.cc)."""
+    """s8 conv with s32 accumulation (reference quantized_conv.cc).
+
+    Layout-general like the fp32 Convolution op: the NHWC fast path the
+    bench uses quantizes without relayouts (weights stay in the layout the
+    fp32 model trained in — O is axis 0 for both OIHW and OHWI, so the
+    offline weight quantization is layout-independent)."""
+    from ..ops.nn import _conv_dimension_numbers
+
     qd, qw = arrays[0], arrays[1]
+    nsp = len(kernel)
+    if layout is None:
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nsp]
+    stride = tuple(stride) if stride else (1,) * nsp
+    dilate = tuple(dilate) if dilate else (1,) * nsp
+    pad = tuple(pad) if pad else (0,) * nsp
+    if len(pad) != nsp:
+        pad = (pad + (0,) * nsp)[:nsp]
+    dn = jax.lax.conv_dimension_numbers(
+        qd.shape, qw.shape, _conv_dimension_numbers(layout))
     out = jax.lax.conv_general_dilated(
         qd.astype(jnp.int8), qw.astype(jnp.int8),
-        window_strides=tuple(stride),
-        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
-        rhs_dilation=tuple(dilate), feature_group_count=num_group,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, feature_group_count=num_group,
+        dimension_numbers=dn,
         preferred_element_type=jnp.int32)
     out = out.astype(jnp.float32) * (data_scale * w_scale)
     if not no_bias and len(arrays) > 2:
-        out = out + arrays[2].reshape(1, -1, 1, 1)
+        shape = [1] * out.ndim
+        shape[layout.index("C")] = arrays[2].shape[0]
+        out = out + arrays[2].reshape(shape)
     return _quantized_epilogue(out, fused_relu, out_min, out_max)
 
 
@@ -192,6 +211,63 @@ def _consumer_map(sym):
     return cons, heads
 
 
+def _constant_fold(sym, param_arrays: Dict[str, onp.ndarray]):
+    """Evaluate param-only subtrees offline and replace them with new
+    params (reference analog: the MKLDNN subgraph fuser sees weights as
+    constants; here e.g. the space-to-depth stem re-expresses conv0's
+    weight as reshape/transpose ops over the stored param, which must
+    collapse back to a plain variable for the BN fold and offline weight
+    quantization to see a Convolution fed by a param).  Returns
+    (new_sym, new_params)."""
+    from ..symbol.symbol import SymNode, Symbol, execute_graph
+
+    nodes = sym._topo()
+    const: Dict[int, bool] = {}
+    for n in nodes:
+        if n.op is None:
+            const[id(n)] = n.name in param_arrays
+        else:
+            det = not any(k in n.op.lower()
+                          for k in ("rand", "dropout", "sample"))
+            const[id(n)] = (det and bool(n.inputs)
+                            and all(const[id(s)] for (s, _i) in n.inputs))
+    cons, heads = _consumer_map(sym)
+    frontier = [n for n in nodes if n.op is not None and const[id(n)]
+                and (id(n) in heads
+                     or any(not const[id(u)]
+                            for (u, _p) in cons.get(id(n), [])))]
+    if not frontier:
+        return sym, param_arrays
+    entries = [(n, i) for n in frontier for i in range(n.num_outputs)]
+    outs = execute_graph(entries, {k: jnp.asarray(v)
+                                   for k, v in param_arrays.items()})
+    new_params = dict(param_arrays)
+    repl: Dict[Tuple[int, int], SymNode] = {}
+    for (n, i), o in zip(entries, outs):
+        name = f"{n.name}_const" + (str(i) if n.num_outputs > 1 else "")
+        while name in new_params:
+            name += "_"
+        new_params[name] = onp.asarray(o)
+        repl[(id(n), i)] = SymNode(None, name, {}, [])
+    cache: Dict[int, SymNode] = {}
+
+    def rebuild(n) -> SymNode:
+        got = cache.get(id(n))
+        if got is not None:
+            return got
+        ins = []
+        for (src, i) in n.inputs:
+            r = repl.get((id(src), i))
+            ins.append((r, 0) if r is not None else (rebuild(src), i))
+        out = SymNode(n.op, n.name, dict(n.attrs), ins, n.num_outputs)
+        cache[id(n)] = out
+        return out
+
+    new_outputs = [((repl[(id(n), i)], 0) if (id(n), i) in repl
+                    else (rebuild(n), i)) for (n, i) in sym._outputs]
+    return Symbol(new_outputs), new_params
+
+
 def _fold_bn_relu(sym, param_arrays: Dict[str, onp.ndarray]):
     """Inference-graph fusion BEFORE quantization (the reference reaches
     the same shape through the MKLDNN subgraph fuser + quantize pass:
@@ -224,12 +300,18 @@ def _fold_bn_relu(sym, param_arrays: Dict[str, onp.ndarray]):
         out = None
         if (n.op == "BatchNorm" and len(n.inputs) == 5
                 and not n.attrs.get("training")
-                and not n.attrs.get("output_mean_var")
-                and n.attrs.get("axis", 1) == 1):
+                and not n.attrs.get("output_mean_var")):
             conv_orig, _ci = n.inputs[0]
             conv_new = new_inputs[0][0]
+            # the BN must normalize the conv's output-channel axis (axis 1
+            # for NCHW, 3 for NHWC); the per-channel fold math itself is
+            # layout-independent because O is axis 0 of the weight either way
+            conv_layout = (conv_new.attrs.get("layout") or "NCHW"
+                           if conv_new.op == "Convolution" else "NCHW")
+            axis_ok = int(n.attrs.get("axis", 1)) == conv_layout.index("C")
             stat_names = [s.name for (s, _j) in n.inputs[1:]]
-            w_ok = (conv_new.op == "Convolution"
+            w_ok = (axis_ok
+                    and conv_new.op == "Convolution"
                     and len(conv_new.inputs) >= 2
                     and conv_new.inputs[1][0].op is None
                     and conv_new.inputs[1][0].name in new_params
@@ -340,8 +422,11 @@ def quantize_symbol(sym, params: Dict[str, Any],
 
     param_arrays = {k: (v.asnumpy() if hasattr(v, "asnumpy")
                         else onp.asarray(v)) for k, v in params.items()}
-    # conv+bn(+relu) -> one conv with folded weights and a relu epilogue
-    # BEFORE quantization (reference: MKLDNN subgraph fuse + quantize pass)
+    # param-only subtrees (e.g. the s2d stem's weight re-expression)
+    # collapse to plain params first so the folds below see conv-fed-by-
+    # variable shapes; then conv+bn(+relu) -> one conv with folded weights
+    # and a relu epilogue (reference: MKLDNN subgraph fuse + quantize pass)
+    sym, param_arrays = _constant_fold(sym, param_arrays)
     sym, param_arrays = _fold_bn_relu(sym, param_arrays)
     new_params: Dict[str, onp.ndarray] = dict(param_arrays)
     cache: Dict[int, SymNode] = {}
@@ -352,11 +437,12 @@ def quantize_symbol(sym, params: Dict[str, Any],
             return got
         new_inputs = [(rewrite(src), i) for (src, i) in n.inputs]
         out = None
-        # quantized_conv implements the 2D NCHW path only; other ranks /
+        # quantized_conv implements the 2D NCHW/NHWC paths (the bench's
+        # channel-minor fast path quantizes natively); other ranks /
         # layouts stay fp32 rather than silently mis-lowering
         conv_ok = (n.op != "Convolution"
                    or (len(n.attrs.get("kernel", ())) == 2
-                       and n.attrs.get("layout") in (None, "NCHW")))
+                       and n.attrs.get("layout") in (None, "NCHW", "NHWC")))
         if (n.op in QUANTIZABLE and conv_ok
                 and n.name not in excluded_names
                 and len(n.inputs) >= 2):

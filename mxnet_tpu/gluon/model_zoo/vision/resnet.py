@@ -84,10 +84,12 @@ class _StemConvS2D(HybridBlock):
                            "pad": (3, 3), "num_filter": o, "no_bias": True,
                            "layout": self._layout})
         if self._layout.index("C") == 1:
-            n, c, h, wd = x.shape
-            xs = x.reshape(n, c, h // 2, 2, wd // 2, 2)
+            _n, c, h, wd = x.shape
+            # batch dim stays -1: a traced graph (int8 export, hybridize)
+            # must not bake the tracing batch size into the reshape
+            xs = x.reshape(-1, c, h // 2, 2, wd // 2, 2)
             xs = xs.transpose(0, 3, 5, 1, 2, 4)       # N,di,dj,C,H2,W2
-            xs = xs.reshape(n, 4 * c, h // 2, wd // 2)
+            xs = xs.reshape(-1, 4 * c, h // 2, wd // 2)
             xp = invoke("pad", [xs], {"mode": "constant",
                                       "pad_width": (0, 0, 0, 0, 2, 1, 2, 1)})
             wp = invoke("pad", [w], {"mode": "constant",
@@ -96,10 +98,10 @@ class _StemConvS2D(HybridBlock):
             wt = wp.transpose(0, 3, 5, 1, 2, 4)       # O,di,dj,C,Ai,Aj
             wt = wt.reshape(o, 4 * c, 4, 4)
         else:
-            n, h, wd, c = x.shape
-            xs = x.reshape(n, h // 2, 2, wd // 2, 2, c)
+            _n, h, wd, c = x.shape
+            xs = x.reshape(-1, h // 2, 2, wd // 2, 2, c)
             xs = xs.transpose(0, 1, 3, 2, 4, 5)       # N,H2,W2,di,dj,C
-            xs = xs.reshape(n, h // 2, wd // 2, 4 * c)
+            xs = xs.reshape(-1, h // 2, wd // 2, 4 * c)
             xp = invoke("pad", [xs], {"mode": "constant",
                                       "pad_width": (0, 0, 2, 1, 2, 1, 0, 0)})
             wp = invoke("pad", [w], {"mode": "constant",

@@ -171,6 +171,33 @@ def test_conv_bn_relu_folds_and_requantize_fuses():
     assert (ref.argmax(1) == got.argmax(1)).mean() >= 0.9
 
 
+def test_quantize_net_nhwc_s2d_fast_path():
+    """The bench's channel-minor fast path quantizes natively: NHWC convs
+    (incl. the space-to-depth stem) become quantized_conv with layout NHWC
+    and the axis=3 BatchNorms still fold (reference quantized_conv.cc is
+    NCHW-only; this build is layout-general so no relayout is needed)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    rng = onp.random.RandomState(11)
+    net = vision.get_model("resnet18_v1", classes=10, layout="NHWC",
+                           input_layout="NHWC", stem_s2d=True)
+    net.initialize(mx.init.Xavier())
+    calib = [mx.nd.array(rng.rand(4, 32, 32, 3).astype(onp.float32))
+             for _ in range(2)]
+    qnet = q.quantize_net(net, calib)
+    convs = [n for n in qnet.sym._topo() if n.op == "quantized_conv"]
+    assert convs
+    assert all(n.attrs.get("layout") == "NHWC" for n in convs), \
+        sorted({n.attrs.get("layout") for n in convs})
+    ops = [n.op for n in qnet.sym._topo() if n.op]
+    assert "BatchNorm" not in ops, ops       # axis=3 folds too
+    x = mx.nd.array(rng.rand(8, 32, 32, 3).astype(onp.float32))
+    ref = net(x).asnumpy()
+    got = onp.asarray(qnet(x))
+    rel = float(onp.abs(got - ref).max() / (abs(ref).max() + 1e-9))
+    assert rel < 0.1, rel
+
+
 def test_quantize_symbol_excluded_layers_stay_fp32():
     """Symbol-level API (the reference quantize_model workflow): users
     pick excluded node names off the traced symbol they pass in."""

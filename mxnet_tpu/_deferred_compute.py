@@ -69,7 +69,8 @@ def _auto_var(arr):
 def record(schema, inputs, attrs, outputs):
     """Called from ndarray.invoke while tracing: attach a SymNode mirroring
     the executed op to the outputs."""
-    from .symbol.symbol import SymNode, _NAMES
+    from . import name as _name_mod
+    from .symbol.symbol import SymNode
 
     in_entries = []
     for a in inputs:
@@ -77,8 +78,11 @@ def record(schema, inputs, attrs, outputs):
         if entry is None:
             entry = _auto_var(a)
         in_entries.append(entry)
-    node = SymNode(schema.name, _NAMES.get(schema.name.lower()), dict(attrs),
-                   in_entries, max(1, len(outputs)))
+    # same per-thread counter as the symbol API (_apply_op): mixed graphs
+    # must never generate colliding auto-names
+    node = SymNode(schema.name,
+                   _name_mod.current().get(None, schema.name.lower()),
+                   dict(attrs), in_entries, max(1, len(outputs)))
     for i, o in enumerate(outputs):
         o._dc_sym = (node, i)
 

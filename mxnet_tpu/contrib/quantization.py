@@ -120,17 +120,15 @@ def quantized_conv(arrays, kernel=(1, 1), stride=(1, 1), dilate=(1, 1),
     bench uses quantizes without relayouts (weights stay in the layout the
     fp32 model trained in — O is axis 0 for both OIHW and OHWI, so the
     offline weight quantization is layout-independent)."""
-    from ..ops.nn import _conv_dimension_numbers
+    from ..ops.nn import _conv_dimension_numbers, _tup
 
     qd, qw = arrays[0], arrays[1]
     nsp = len(kernel)
     if layout is None:
         layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nsp]
-    stride = tuple(stride) if stride else (1,) * nsp
-    dilate = tuple(dilate) if dilate else (1,) * nsp
-    pad = tuple(pad) if pad else (0,) * nsp
-    if len(pad) != nsp:
-        pad = (pad + (0,) * nsp)[:nsp]
+    stride = _tup(stride, nsp) if stride else (1,) * nsp
+    dilate = _tup(dilate, nsp) if dilate else (1,) * nsp
+    pad = _tup(pad, nsp) if pad else (0,) * nsp
     dn = jax.lax.conv_dimension_numbers(
         qd.shape, qw.shape, _conv_dimension_numbers(layout))
     out = jax.lax.conv_general_dilated(
@@ -260,6 +258,7 @@ def _constant_fold(sym, param_arrays: Dict[str, onp.ndarray]):
             r = repl.get((id(src), i))
             ins.append((r, 0) if r is not None else (rebuild(src), i))
         out = SymNode(n.op, n.name, dict(n.attrs), ins, n.num_outputs)
+        out.attr_dict = dict(n.attr_dict)     # keep AttrScope/__shape__
         cache[id(n)] = out
         return out
 

@@ -15,6 +15,7 @@ class _State(threading.local):
     def __init__(self):
         super().__init__()
         self.stack = []
+        self.root = None        # lazy per-thread default NameManager
 
 
 _STATE = _State()
@@ -53,5 +54,12 @@ class Prefix(NameManager):
         return self._prefix + super().get(name, hint)
 
 
-def current() -> Optional[NameManager]:
-    return _STATE.stack[-1] if _STATE.stack else None
+def current() -> NameManager:
+    """The active NameManager — never None: each thread owns a default
+    root manager (reference name.py NameManager._current with a fresh
+    per-thread default), so ``mx.name.current().get(...)`` always works."""
+    if _STATE.stack:
+        return _STATE.stack[-1]
+    if _STATE.root is None:
+        _STATE.root = NameManager()
+    return _STATE.root

@@ -131,6 +131,29 @@ for _name in _JNP_FUNCS:
 # --- creation functions (need ctx/device handling) -------------------------
 def _create(jfn, args, kwargs, dtype=None, ctx=None):
     ctx = ctx or _current_context()
+    # honest 64-bit values on backends that hold them (same policy as
+    # nd.array's int64 path): np_default_dtype scope requests float64 and a
+    # CPU-resident array must really be float64, not a silent truncation.
+    # Accelerator contexts keep the x32 truncation (+ jax's warning) — the
+    # TPU has no f64 unit and crashing would be worse than narrowing.
+    import numpy as _onp
+
+    want = kwargs.get("dtype", dtype)
+    is64 = False
+    if want is not None:
+        try:
+            is64 = _onp.dtype(want).itemsize == 8
+        except TypeError:
+            pass
+    if is64 and ctx.device_type == "cpu":
+        with _jax.enable_x64(True):
+            data = jfn(*args, **kwargs)
+            if dtype is not None:
+                from ..ndarray.ndarray import _dtype_np
+
+                data = data.astype(_dtype_np(dtype))
+            data = _jax.device_put(data, ctx.jax_device)
+        return _wrap_arr(data, ctx, ndarray)
     data = jfn(*args, **kwargs)
     if dtype is not None:
         from ..ndarray.ndarray import _dtype_np
@@ -185,19 +208,29 @@ def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
 def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
              axis=0, ctx=None, device=None):
     if retstep:
-        data, step = _jnp.linspace(start, stop, num, endpoint=endpoint,
-                                   retstep=True, dtype=dtype, axis=axis)
+        import contextlib
+
+        import numpy as _onp
+
+        dt = dtype or default_dtype()
         ctx = device or ctx or _current_context()
+        is64 = _onp.dtype(dt).itemsize == 8 and ctx.device_type == "cpu"
+        with _jax.enable_x64(True) if is64 else contextlib.nullcontext():
+            data, step = _jnp.linspace(start, stop, num, endpoint=endpoint,
+                                       retstep=True, dtype=dt, axis=axis)
+            data = _jax.device_put(data, ctx.jax_device)
         return _wrap_arr(data, ctx, ndarray), float(step)
     return _create(_jnp.linspace, (start, stop, num),
-                   {"endpoint": endpoint, "dtype": dtype, "axis": axis},
+                   {"endpoint": endpoint, "dtype": dtype or default_dtype(),
+                    "axis": axis},
                    ctx=device or ctx)
 
 
 def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
              axis=0, ctx=None, device=None):
     return _create(_jnp.logspace, (start, stop, num),
-                   {"endpoint": endpoint, "base": base, "dtype": dtype,
+                   {"endpoint": endpoint, "base": base,
+                    "dtype": dtype or default_dtype(),
                     "axis": axis}, ctx=device or ctx)
 
 
@@ -258,7 +291,12 @@ def _fallback(name):
 
         def wrap(o):
             if isinstance(o, _onp.ndarray):
-                return array(o, dtype=o.dtype)
+                # dtype=None: host numpy computes in f64, the result must
+                # follow the MXNet default-dtype rule (narrow to f32
+                # unless the np_default_dtype scope is active) — the same
+                # contract the reference fallback meets
+                return array(o, dtype=None if o.dtype == _onp.float64
+                             else o.dtype)
             if isinstance(o, (tuple, list)):
                 return type(o)(wrap(x) for x in o)
             return o

@@ -396,7 +396,16 @@ def array(obj, dtype=None, ctx: Optional[Context] = None, device=None,
             dtype = np_in.dtype
     from ..ndarray.ndarray import _dtype_np
 
-    data = jax.device_put(jnp.asarray(np_in, _dtype_np(dtype)), ctx.jax_device)
+    want = _dtype_np(dtype)
+    # honest 64-bit values on the CPU backend when the np-default-dtype
+    # scope (or an explicit dtype) asks for them — same policy as _create
+    # and nd.array; accelerators keep x32 narrowing
+    if (onp.dtype(want).kind in "fiu" and onp.dtype(want).itemsize == 8
+            and ctx.device_type == "cpu"):
+        with jax.enable_x64(True):
+            data = jax.device_put(jnp.asarray(np_in, want), ctx.jax_device)
+    else:
+        data = jax.device_put(jnp.asarray(np_in, want), ctx.jax_device)
     return _wrap(data, ctx, ndarray)
 
 

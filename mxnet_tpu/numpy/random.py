@@ -36,6 +36,21 @@ def _dev(ctx=None, device=None):
     return device or ctx or current_context()
 
 
+def _maybe_x64(dtype, ctx):
+    """Honest float64 sampling on CPU when the np_default_dtype scope (or
+    an explicit dtype) asks for it — same policy as the np creation
+    functions; accelerator ctxs keep the x32 truncation."""
+    import contextlib
+
+    try:
+        is64 = dtype is not None and onp.dtype(dtype).itemsize == 8
+    except TypeError:
+        is64 = False
+    if is64 and getattr(ctx, "device_type", None) == "cpu":
+        return jax.enable_x64(True)
+    return contextlib.nullcontext()
+
+
 def _wrap_dev(data, ctx):
     return _wrap(jax.device_put(data, ctx.jax_device), ctx, ndarray)
 
@@ -59,9 +74,10 @@ def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None,
             out=None):
     ctx = _dev(ctx, device)
     shp = _bshape(size, low, high)
-    data = jax.random.uniform(_global_rng.next_key(), shp,
-                              dtype or default_dtype(),
-                              minval=_unwrap(low), maxval=_unwrap(high))
+    dt = dtype or default_dtype()
+    with _maybe_x64(dt, ctx):
+        data = jax.random.uniform(_global_rng.next_key(), shp, dt,
+                                  minval=_unwrap(low), maxval=_unwrap(high))
     return _wrap_dev(data, ctx)
 
 
@@ -75,9 +91,10 @@ def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None,
         raise MXNetError(f"normal: scale must be non-negative, got {scale}")
     ctx = _dev(ctx, device)
     shp = _bshape(size, loc, scale)
-    data = jax.random.normal(_global_rng.next_key(), shp,
-                             dtype or default_dtype())
-    data = data * _unwrap(scale) + _unwrap(loc)
+    dt = dtype or default_dtype()
+    with _maybe_x64(dt, ctx):
+        data = jax.random.normal(_global_rng.next_key(), shp, dt)
+        data = data * _unwrap(scale) + _unwrap(loc)
     return _wrap_dev(data, ctx)
 
 
@@ -142,9 +159,11 @@ def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, device=None,
           out=None):
     ctx = _dev(ctx, device)
     shp = _bshape(size, shape, scale)
-    data = jax.random.gamma(_global_rng.next_key(), _unwrap(shape),
-                            shape=shp or None,
-                            dtype=dtype or default_dtype()) * _unwrap(scale)
+    dt = dtype or default_dtype()
+    with _maybe_x64(dt, ctx):
+        data = jax.random.gamma(_global_rng.next_key(), _unwrap(shape),
+                                shape=shp or None,
+                                dtype=dt) * _unwrap(scale)
     return _wrap_dev(data, ctx)
 
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import json
 import re
-import threading
+
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -31,18 +31,9 @@ __all__ = ["Symbol", "SymNode", "var", "Variable", "Group", "load",
            "load_json", "execute_graph"]
 
 
-class _NameManager(threading.local):
-    def __init__(self):
-        super().__init__()
-        self.counters: Dict[str, int] = {}
-
-    def get(self, hint: str) -> str:
-        n = self.counters.get(hint, 0)
-        self.counters[hint] = n + 1
-        return f"{hint}{n}"
-
-
-_NAMES = _NameManager()
+# auto-naming draws from mxnet_tpu.name.current() — one per-thread counter
+# shared by the symbol API and deferred-compute tracing (reference name.py
+# NameManager._current semantics)
 
 
 class SymNode:
@@ -515,11 +506,10 @@ def _apply_op(op_name: str, inputs: List[Symbol], attrs: dict,
         in_entries.append(s._outputs[0])
     from .. import name as _name_mod
 
-    mgr = _name_mod.current()
-    if mgr is not None:
-        name = mgr.get(name, schema.name.lower())
-    else:
-        name = name or _NAMES.get(schema.name.lower())
+    # ONE counter for all construction paths (scope stack or the
+    # per-thread root manager): deferred-compute tracing draws from the
+    # same source, so mixed dc-traced + symbol-API graphs never collide
+    name = _name_mod.current().get(name, schema.name.lower())
     n_out = num_outputs if num_outputs is not None \
         else _resolve_num_outputs(schema, attrs)
     node = SymNode(schema.name, name, attrs, in_entries, n_out)

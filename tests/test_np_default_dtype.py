@@ -1,0 +1,84 @@
+"""Default-dtype policy of the np namespace (reference
+tests/python/unittest/test_numpy_default_dtype.py): MXNet-numpy defaults
+to float32; the ``np_default_dtype`` scope switches creation functions and
+samplers to NumPy's float64 default.  On this build float64 is honored
+honestly on the CPU backend (accelerators have no f64 unit and keep the
+documented x32 narrowing)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import numpy as np
+from mxnet_tpu import util
+
+CPU_ONLY = mx.context.current_context().device_type != "cpu"
+
+
+# (callable, expects-f64-under-scope) — the reference's
+# _NUMPY_DTYPE_DEFAULT_FUNC_LIST, minus true_divide (covered separately)
+CREATORS = [
+    ("array", lambda: np.array([1.0, 2.0])),
+    ("ones", lambda: np.ones((2, 2))),
+    ("zeros", lambda: np.zeros((2, 2))),
+    ("eye", lambda: np.eye(3)),
+    ("full", lambda: np.full((2,), 1.5)),
+    ("identity", lambda: np.identity(3)),
+    ("linspace", lambda: np.linspace(0.0, 1.0, 5)),
+    ("logspace", lambda: np.logspace(0.0, 1.0, 5)),
+    ("random.uniform", lambda: np.random.uniform(size=(4,))),
+    ("random.normal", lambda: np.random.normal(size=(4,))),
+    ("random.gamma", lambda: np.random.gamma(2.0, size=(4,))),
+    ("random.chisquare", lambda: np.random.chisquare(3.0, size=(4,))),
+]
+
+
+@pytest.mark.parametrize("name,fn", CREATORS, ids=[n for n, _ in CREATORS])
+def test_float32_is_the_default(name, fn):
+    assert fn().dtype == onp.float32, name
+
+
+@pytest.mark.parametrize("name,fn", CREATORS, ids=[n for n, _ in CREATORS])
+def test_np_default_dtype_scope_gives_float64(name, fn):
+    with util.np_default_dtype(True):
+        out = fn()
+    assert out.dtype == onp.float64, (name, out.dtype)
+    # and the scope really pops
+    assert fn().dtype == onp.float32, name
+
+
+def test_use_np_default_dtype_decorator():
+    @util.use_np_default_dtype
+    def f():
+        return np.ones((2,))
+
+    assert f().dtype == onp.float64
+    assert np.ones((2,)).dtype == onp.float32
+
+
+def test_window_functions_default():
+    # hanning/hamming/blackman follow jnp's float default (f32 under x32);
+    # presence + dtype stability is the parity contract here
+    for name in ("hanning", "hamming", "blackman"):
+        out = getattr(np, name)(8)
+        assert out.shape == (8,)
+        assert out.dtype == onp.float32, name
+
+
+def test_mean_preserves_float16():
+    # reference: mean of f16 stays f16 (no silent widening)
+    x = np.ones((4,), dtype="float16")
+    assert np.mean(x).dtype == onp.float16
+
+
+def test_true_divide_int_inputs_make_float():
+    a = np.array([1, 2, 3], dtype="int32")
+    b = np.array([2, 2, 2], dtype="int32")
+    out = np.true_divide(a, b)
+    assert out.dtype == onp.float32
+    assert onp.allclose(out.asnumpy(), [0.5, 1.0, 1.5])
+
+
+def test_explicit_dtype_wins_over_scope():
+    with util.np_default_dtype(True):
+        assert np.ones((2,), dtype="float32").dtype == onp.float32
+        assert np.zeros((2,), dtype="float16").dtype == onp.float16

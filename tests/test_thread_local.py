@@ -1,0 +1,146 @@
+"""Thread-locality of the scope stacks (reference
+tests/python/unittest/test_thread_local.py): contexts, AttrScope,
+NameManager, np-array scope, and autograd mode must be per-thread so a
+DataLoader worker thread or a user thread cannot corrupt the main
+thread's state."""
+import threading
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.attribute import AttrScope
+from mxnet_tpu.context import Context, current_context
+
+
+def test_context_thread_local():
+    # reference test_thread_local.py::test_context
+    seen = []
+
+    def f():
+        with mx.cpu(3):
+            seen.append(current_context())
+
+    assert current_context().device_id == 0
+    t = threading.Thread(target=f)
+    t.start()
+    t.join()
+    assert seen[0].device_type == "cpu" and seen[0].device_id == 3
+    assert current_context().device_id == 0       # main thread untouched
+
+    # interleaved: a spawned thread holding a ctx scope must not see the
+    # main thread's later scope push
+    e1, e2 = threading.Event(), threading.Event()
+    status = [False]
+
+    def g():
+        with mx.cpu(5):
+            e2.set()
+            e1.wait()
+            status[0] = current_context().device_id == 5
+
+    t = threading.Thread(target=g)
+    t.start()
+    e2.wait()
+    with Context("cpu", 6):
+        e1.set()
+        t.join()
+    assert status[0], "spawned thread saw the main thread's context"
+
+
+def test_attrscope_thread_local():
+    # reference test_thread_local.py::test_attrscope
+    scopes = []
+    with AttrScope(y="hi", z="hey"):
+        def f():
+            with AttrScope(x="hello"):
+                scopes.append(dict(mx.attribute.current()._attr))
+
+        t = threading.Thread(target=f)
+        t.start()
+        t.join()
+        main_attr = dict(mx.attribute.current()._attr)
+    assert main_attr == {"y": "hi", "z": "hey"}
+    # the spawned thread starts from an EMPTY stack, not the main one
+    assert scopes[0] == {"x": "hello"}
+
+    e1, e2 = threading.Event(), threading.Event()
+    status = [False]
+
+    def g():
+        with AttrScope(x="hello"):
+            e2.set()
+            e1.wait()
+            status[0] = "hello" in mx.attribute.current()._attr.values()
+
+    t = threading.Thread(target=g)
+    t.start()
+    e2.wait()
+    with AttrScope(x="hi"):
+        e1.set()
+        t.join()
+    assert status[0]
+
+
+def test_name_manager_thread_local():
+    # reference test_thread_local.py::test_name
+    mx.name.current().get(None, "main_thread")
+    counters = []
+
+    def f():
+        with mx.name.NameManager():
+            nm = mx.name.current()
+            nm.get(None, "spawned_thread")
+            counters.append(dict(nm._counter))
+
+    t = threading.Thread(target=f)
+    t.start()
+    t.join()
+    assert "spawned_thread" in counters[0]
+    assert "main_thread" not in counters[0], \
+        "spawned thread inherited the main thread's name counters"
+    assert "main_thread" in mx.name.current()._counter
+
+
+def test_np_scope_thread_local():
+    # reference test_thread_local.py np-shape scoping analog
+    from mxnet_tpu import util
+
+    seen = []
+
+    def f():
+        seen.append(util.is_np_array())
+        with util.np_array(True):
+            seen.append(util.is_np_array())
+
+    assert not util.is_np_array()
+    with util.np_array(True):
+        t = threading.Thread(target=f)
+        t.start()
+        t.join()
+        assert util.is_np_array()
+    # the spawned thread starts from the DEFAULT state, not the main
+    # thread's active scope
+    assert seen == [False, True]
+
+
+def test_autograd_mode_thread_local():
+    # recording/training state is per-thread: a worker thread's pause()
+    # must not stop the main thread's tape (reference engine/autograd
+    # thread-local state, imperative.h thread_local is_recording)
+    x = nd.ones((2, 2))
+    x.attach_grad()
+    inner = []
+
+    def f():
+        inner.append(autograd.is_recording())
+        with autograd.pause():
+            inner.append(autograd.is_recording())
+
+    with autograd.record():
+        t = threading.Thread(target=f)
+        t.start()
+        t.join()
+        assert autograd.is_recording()
+        y = (x * 2).sum()
+    y.backward()
+    assert float(x.grad.asnumpy().sum()) == 8.0
+    assert inner == [False, False]

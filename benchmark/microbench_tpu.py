@@ -1,0 +1,164 @@
+"""Targeted TPU microbenchmarks behind docs/PERF.md's roofline analysis.
+
+    python benchmark/microbench_tpu.py [--which all|dot|conv|bn|int8]
+
+Measures, with the bench fencing discipline (warm + host read, fenced
+timed region):
+  - dot:   8192^3 matmul, bf16 vs s8xs8->s32 (does int8 hit the 2x MXU?)
+  - conv:  a resnet-core conv chain, bf16 NHWC vs int8 NHWC, with the
+           requantize epilogue on/off (where does the int8 lane lose?)
+  - bn:    conv chain with batch-stat BatchNorm vs without (what do the
+           stats reductions + normalize passes cost the train step?)
+
+Each result prints one line: name, ms/iter, TFLOP/s (or TOP/s), ratio
+to the section's baseline.  Keep runs short: the tunnel budget matters
+more than tight confidence intervals.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.abspath(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "..", ".jax_cache")))
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+if jax.config.jax_compilation_cache_dir is None:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+
+
+def timeit(fn, *args, iters=20, warm=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    for _ in range(warm):
+        out = fn(*args)
+    _ = float(jnp.asarray(out).ravel()[0].astype(jnp.float32))  # drain
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _ = float(jnp.asarray(out).ravel()[0].astype(jnp.float32))  # fence
+    return (time.perf_counter() - t0) / iters
+
+
+def section_dot():
+    n = 8192
+    flops = 2 * n ** 3
+    key = jax.random.PRNGKey(0)
+    a16 = jax.random.normal(key, (n, n), jnp.bfloat16)
+    b16 = jax.random.normal(key, (n, n), jnp.bfloat16)
+
+    f_bf16 = jax.jit(lambda a, b: (a @ b).sum())
+    dt = timeit(f_bf16, a16, b16)
+    base = flops / dt / 1e12
+    print(f"dot bf16 {n}^3: {dt*1e3:8.2f} ms  {base:6.1f} TFLOP/s  1.00x")
+
+    a8 = (jax.random.normal(key, (n, n)) * 10).astype(jnp.int8)
+    b8 = (jax.random.normal(key, (n, n)) * 10).astype(jnp.int8)
+    f_s8 = jax.jit(lambda a, b: jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32).sum())
+    dt = timeit(f_s8, a8, b8)
+    tops = flops / dt / 1e12
+    print(f"dot s8s8s32 {n}^3: {dt*1e3:6.2f} ms  {tops:6.1f} TOP/s   "
+          f"{tops/base:.2f}x vs bf16")
+
+
+def _mkconv(dtype, epilogue):
+    """One resnet-core 3x3 conv (NHWC), optionally with the int8 lane's
+    requantize epilogue shape."""
+    dn = jax.lax.conv_dimension_numbers((1, 1, 1, 1), (1, 1, 1, 1),
+                                        ("NHWC", "OHWI", "NHWC"))
+
+    def f(x, w):
+        out = jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn,
+            preferred_element_type=jnp.int32 if dtype == jnp.int8
+            else jnp.float32)
+        if epilogue == "requant":
+            out = out.astype(jnp.float32) * 0.01
+            out = jnp.maximum(out, 0)
+            out = jnp.clip(jnp.round(out * 31.0), -127, 127).astype(jnp.int8)
+        elif epilogue == "relu":
+            out = jnp.maximum(out, 0).astype(dtype)
+        return out
+
+    return jax.jit(lambda x, w: f(x, w).astype(jnp.int32).sum())
+
+
+def section_conv():
+    # resnet stage-3 texture: bs64 (the int8 lane), 28x28x256 -> 256
+    key = jax.random.PRNGKey(1)
+    shape_x, shape_w = (64, 28, 28, 256), (256, 3, 3, 256)
+    flops = 2 * 64 * 28 * 28 * 256 * 3 * 3 * 256
+    x16 = jax.random.normal(key, shape_x, jnp.bfloat16)
+    w16 = jax.random.normal(key, shape_w, jnp.bfloat16)
+    dt = timeit(_mkconv(jnp.bfloat16, "relu"), x16, w16)
+    base = flops / dt / 1e12
+    print(f"conv bf16+relu: {dt*1e3:8.2f} ms  {base:6.1f} TFLOP/s  1.00x")
+
+    x8 = (jax.random.normal(key, shape_x) * 10).astype(jnp.int8)
+    w8 = (jax.random.normal(key, shape_w) * 10).astype(jnp.int8)
+    for epi in ("none", "requant"):
+        dt = timeit(_mkconv(jnp.int8, epi), x8, w8)
+        tops = flops / dt / 1e12
+        print(f"conv s8 epi={epi:<8}: {dt*1e3:6.2f} ms  {tops:6.1f} TOP/s"
+              f"   {tops/base:.2f}x vs bf16")
+
+
+def section_bn():
+    # 4-deep conv chain, with vs without batch-stat BN between convs —
+    # the delta is what BN costs the bf16 train step's forward texture
+    key = jax.random.PRNGKey(2)
+    bs = 128
+    x = jax.random.normal(key, (bs, 28, 28, 256), jnp.bfloat16)
+    ws = [jax.random.normal(jax.random.PRNGKey(i), (256, 3, 3, 256),
+                            jnp.bfloat16) for i in range(4)]
+    dn = jax.lax.conv_dimension_numbers(x.shape, ws[0].shape,
+                                        ("NHWC", "OHWI", "NHWC"))
+    flops = 4 * 2 * bs * 28 * 28 * 256 * 3 * 3 * 256
+
+    def chain(x, ws, use_bn):
+        for w in ws:
+            x = jax.lax.conv_general_dilated(
+                x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn)
+            if use_bn:
+                x32 = x.astype(jnp.float32)
+                mean = jnp.mean(x32, axis=(0, 1, 2))
+                var = jnp.maximum(
+                    jnp.mean(x32 * x32, axis=(0, 1, 2)) - mean * mean, 0.0)
+                sc = jax.lax.rsqrt(var + 1e-5)
+                x = (x * sc.astype(x.dtype)
+                     - (mean * sc).astype(x.dtype))
+            x = jnp.maximum(x, 0)
+        return x.astype(jnp.float32).sum()
+
+    for use_bn in (False, True):
+        f = jax.jit(lambda x, *ws: chain(x, ws, use_bn))
+        dt = timeit(f, x, *ws, iters=10)
+        tf = flops / dt / 1e12
+        print(f"conv-chain bn={use_bn!s:<5}: {dt*1e3:7.2f} ms  "
+              f"{tf:6.1f} TFLOP/s")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--which", default="all",
+                    choices=["all", "dot", "conv", "bn", "int8"])
+    args = ap.parse_args()
+    print(f"backend: {jax.default_backend()}  {jax.devices()}")
+    if args.which in ("all", "dot", "int8"):
+        section_dot()
+    if args.which in ("all", "conv", "int8"):
+        section_conv()
+    if args.which in ("all", "bn"):
+        section_bn()
+
+
+if __name__ == "__main__":
+    main()

@@ -131,35 +131,18 @@ for _name in _JNP_FUNCS:
 # --- creation functions (need ctx/device handling) -------------------------
 def _create(jfn, args, kwargs, dtype=None, ctx=None):
     ctx = ctx or _current_context()
-    # honest 64-bit values on backends that hold them (same policy as
-    # nd.array's int64 path): np_default_dtype scope requests float64 and a
-    # CPU-resident array must really be float64, not a silent truncation.
-    # Accelerator contexts keep the x32 truncation (+ jax's warning) — the
-    # TPU has no f64 unit and crashing would be worse than narrowing.
-    import numpy as _onp
+    # honest 64-bit values on backends that hold them (policy + rationale:
+    # util.x64_creation_scope); accelerator ctxs keep the x32 narrowing
+    from ..util import x64_creation_scope
 
-    want = kwargs.get("dtype", dtype)
-    is64 = False
-    if want is not None:
-        try:
-            is64 = _onp.dtype(want).itemsize == 8
-        except TypeError:
-            pass
-    if is64 and ctx.device_type == "cpu":
-        with _jax.enable_x64(True):
-            data = jfn(*args, **kwargs)
-            if dtype is not None:
-                from ..ndarray.ndarray import _dtype_np
+    with x64_creation_scope(kwargs.get("dtype", dtype), ctx):
+        data = jfn(*args, **kwargs)
+        if dtype is not None:
+            from ..ndarray.ndarray import _dtype_np
 
-                data = data.astype(_dtype_np(dtype))
-            data = _jax.device_put(data, ctx.jax_device)
-        return _wrap_arr(data, ctx, ndarray)
-    data = jfn(*args, **kwargs)
-    if dtype is not None:
-        from ..ndarray.ndarray import _dtype_np
-
-        data = data.astype(_dtype_np(dtype))
-    return _wrap_arr(_jax.device_put(data, ctx.jax_device), ctx, ndarray)
+            data = data.astype(_dtype_np(dtype))
+        data = _jax.device_put(data, ctx.jax_device)
+    return _wrap_arr(data, ctx, ndarray)
 
 
 def zeros(shape, dtype=None, order="C", ctx=None, device=None):
@@ -208,14 +191,11 @@ def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
 def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
              axis=0, ctx=None, device=None):
     if retstep:
-        import contextlib
-
-        import numpy as _onp
+        from ..util import x64_creation_scope
 
         dt = dtype or default_dtype()
         ctx = device or ctx or _current_context()
-        is64 = _onp.dtype(dt).itemsize == 8 and ctx.device_type == "cpu"
-        with _jax.enable_x64(True) if is64 else contextlib.nullcontext():
+        with x64_creation_scope(dt, ctx):
             data, step = _jnp.linspace(start, stop, num, endpoint=endpoint,
                                        retstep=True, dtype=dt, axis=axis)
             data = _jax.device_put(data, ctx.jax_device)

@@ -384,9 +384,14 @@ def array(obj, dtype=None, ctx: Optional[Context] = None, device=None,
     """
     ctx = device or ctx or current_context()
     if isinstance(obj, NDArray):
+        from ..util import x64_creation_scope
+
         data = obj._data
         if dtype is not None:
-            data = data.astype(dtype)
+            with x64_creation_scope(dtype, ctx):
+                data = data.astype(dtype)
+                data = jax.device_put(data, ctx.jax_device)
+            return _wrap(data, ctx, ndarray)
         return _wrap(jax.device_put(data, ctx.jax_device), ctx, ndarray)
     np_in = onp.asarray(obj)
     if dtype is None:
@@ -397,14 +402,11 @@ def array(obj, dtype=None, ctx: Optional[Context] = None, device=None,
     from ..ndarray.ndarray import _dtype_np
 
     want = _dtype_np(dtype)
-    # honest 64-bit values on the CPU backend when the np-default-dtype
-    # scope (or an explicit dtype) asks for them — same policy as _create
-    # and nd.array; accelerators keep x32 narrowing
-    if (onp.dtype(want).kind in "fiu" and onp.dtype(want).itemsize == 8
-            and ctx.device_type == "cpu"):
-        with jax.enable_x64(True):
-            data = jax.device_put(jnp.asarray(np_in, want), ctx.jax_device)
-    else:
+    # honest 64-bit values on the CPU backend (policy: x64_creation_scope);
+    # accelerators keep x32 narrowing
+    from ..util import x64_creation_scope
+
+    with x64_creation_scope(want, ctx):
         data = jax.device_put(jnp.asarray(np_in, want), ctx.jax_device)
     return _wrap(data, ctx, ndarray)
 

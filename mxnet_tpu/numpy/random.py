@@ -37,18 +37,11 @@ def _dev(ctx=None, device=None):
 
 
 def _maybe_x64(dtype, ctx):
-    """Honest float64 sampling on CPU when the np_default_dtype scope (or
-    an explicit dtype) asks for it — same policy as the np creation
-    functions; accelerator ctxs keep the x32 truncation."""
-    import contextlib
+    """Honest float64 sampling on CPU (single policy source:
+    util.x64_creation_scope); accelerator ctxs keep the x32 narrowing."""
+    from ..util import x64_creation_scope
 
-    try:
-        is64 = dtype is not None and onp.dtype(dtype).itemsize == 8
-    except TypeError:
-        is64 = False
-    if is64 and getattr(ctx, "device_type", None) == "cpu":
-        return jax.enable_x64(True)
-    return contextlib.nullcontext()
+    return x64_creation_scope(dtype, ctx)
 
 
 def _wrap_dev(data, ctx):

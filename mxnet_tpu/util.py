@@ -20,7 +20,34 @@ __all__ = [
     "use_np_array", "use_np", "np_default_dtype", "use_np_default_dtype",
     "set_np_default_dtype", "default_array", "set_module",
     "wrap_np_unary_func", "wrap_np_binary_func", "getenv", "setenv",
+    "x64_creation_scope",
 ]
+
+
+def x64_creation_scope(dtype, ctx):
+    """THE honest-64-bit creation policy, in one place: when ``dtype`` is a
+    64-bit int/uint/float and ``ctx`` is a CPU context, return a scope that
+    (a) enables x64 so jax does not narrow, and (b) pins computation to the
+    ctx's device so a TPU-attached process does not dispatch the f64
+    creation to the accelerator.  Anywhere else: a no-op scope (the
+    documented x32 narrowing).  Used by np creation functions, samplers,
+    and mx.np.array."""
+    import contextlib
+
+    import jax
+    import numpy as onp
+
+    try:
+        dt = onp.dtype(dtype) if dtype is not None else None
+        is64 = dt is not None and dt.itemsize == 8 and dt.kind in "fiu"
+    except TypeError:
+        is64 = False
+    if is64 and getattr(ctx, "device_type", None) == "cpu":
+        es = contextlib.ExitStack()
+        es.enter_context(jax.enable_x64(True))
+        es.enter_context(jax.default_device(ctx.jax_device))
+        return es
+    return contextlib.nullcontext()
 
 
 class _NpState(threading.local):

@@ -11,7 +11,11 @@ import mxnet_tpu as mx
 from mxnet_tpu import numpy as np
 from mxnet_tpu import util
 
-CPU_ONLY = mx.context.current_context().device_type != "cpu"
+# honest f64 is a CPU-backend contract; accelerator default ctxs keep the
+# documented x32 narrowing, so the f64 assertions only apply on cpu
+NOT_CPU = mx.context.current_context().device_type != "cpu"
+needs_cpu = pytest.mark.skipif(
+    NOT_CPU, reason="honest f64 applies to the CPU backend only")
 
 
 # (callable, expects-f64-under-scope) — the reference's
@@ -37,6 +41,7 @@ def test_float32_is_the_default(name, fn):
     assert fn().dtype == onp.float32, name
 
 
+@needs_cpu
 @pytest.mark.parametrize("name,fn", CREATORS, ids=[n for n, _ in CREATORS])
 def test_np_default_dtype_scope_gives_float64(name, fn):
     with util.np_default_dtype(True):
@@ -46,6 +51,7 @@ def test_np_default_dtype_scope_gives_float64(name, fn):
     assert fn().dtype == onp.float32, name
 
 
+@needs_cpu
 def test_use_np_default_dtype_decorator():
     @util.use_np_default_dtype
     def f():

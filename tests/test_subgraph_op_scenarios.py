@@ -1,0 +1,159 @@
+"""Partitioner topology zoo (reference
+tests/python/unittest/test_subgraph_op.py test_subgraph_exe1-8): partition
+assorted graph shapes with a whitelist selector, rewrite each match with an
+IDENTITY replacement, and assert the rewritten graph evaluates identically.
+This exercises seed/BFS-grow/filter, external-IO wiring, duplicate edges,
+multi-output heads, and the convexity/cycle guard — independent of any
+particular fusion rewrite."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.symbol.subgraph import (SubgraphProperty, SubgraphSelector,
+                                       partition)
+
+
+class _WhitelistSelector(SubgraphSelector):
+    def __init__(self, ops):
+        self.ops = ops
+
+    def select(self, node):
+        return node.op in self.ops
+
+    def select_input(self, cur, input_node):
+        return input_node.op in self.ops
+
+    def select_output(self, cur, output_node):
+        return output_node.op in self.ops
+
+
+class IdentityGroupProperty(SubgraphProperty):
+    """Groups whitelist ops and re-emits the subgraph unchanged — the
+    reference's default backend shape (subgraph -> _CachedOp node) with
+    the executor part elided, leaving pure partition mechanics."""
+
+    name = "identity_group"
+
+    def __init__(self, ops):
+        self.ops = frozenset(ops)
+        self.matched = 0
+
+    def create_selector(self):
+        return _WhitelistSelector(self.ops)
+
+    def create_subgraph_node(self, sub_sym, subgraph_id, params):
+        self.matched += 1
+        return sub_sym
+
+
+def _eval(s, **feed):
+    outs = s.eval(**{k: nd.array(v) for k, v in feed.items()})
+    return [onp.asarray(o.asnumpy()) for o in outs]
+
+
+def _check(s, ops, feed, expect_matches=None):
+    prop = IdentityGroupProperty(ops)
+    new_sym, _ = partition(s, prop)
+    ref = _eval(s, **feed)
+    got = _eval(new_sym, **feed)
+    assert len(ref) == len(got)
+    for r, g in zip(ref, got):
+        onp.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6)
+    if expect_matches is not None:
+        assert prop.matched == expect_matches, prop.matched
+    return new_sym
+
+
+RNG = onp.random.RandomState(7)
+X = RNG.rand(4, 5).astype(onp.float32)
+Y = RNG.rand(4, 5).astype(onp.float32)
+
+
+def test_linear_chain_whole_graph():
+    d = sym.var("data")
+    out = sym.relu(sym.sin(sym.exp(d)))
+    _check(out, {"exp", "sin", "relu"}, {"data": X}, expect_matches=1)
+
+
+def test_chain_with_non_member_boundary():
+    # whitelist covers only the middle op: correct IO wiring both sides
+    d = sym.var("data")
+    out = sym.relu(sym.sin(sym.exp(d)))
+    _check(out, {"sin"}, {"data": X}, expect_matches=1)
+
+
+def test_duplicate_input_edges():
+    # one node consuming the SAME subgraph output twice (reference sym4)
+    d = sym.var("data")
+    e = sym.exp(d)
+    out = e * e
+    _check(out, {"exp"}, {"data": X}, expect_matches=1)
+    _check(out, {"exp", "elemwise_mul", "broadcast_mul", "_mul"},
+           {"data": X})
+
+
+def test_branch_merge_single_external_input():
+    # data feeds two member branches that merge inside the subgraph
+    d = sym.var("data")
+    out = sym.exp(d) + sym.sin(d)
+    _check(out, {"exp", "sin", "elemwise_add", "broadcast_add", "_add"},
+           {"data": X})
+
+
+def test_multi_output_group_heads():
+    # grouped heads, both outputs produced by subgraph members
+    d = sym.var("data")
+    g = sym.Group([sym.exp(d), sym.sin(d)])
+    _check(g, {"exp", "sin"}, {"data": X})
+
+
+def test_two_separate_islands():
+    # non-adjacent members must become separate subgraphs, not one
+    d = sym.var("data")
+    out = sym.sin(sym.relu(sym.exp(d)))       # relu not whitelisted
+    new_sym = _check(out, {"exp", "sin"}, {"data": X}, expect_matches=2)
+    assert any(n.op == "relu" for n in new_sym._topo())
+
+
+def test_convexity_no_cycle_through_external_consumer():
+    # a = exp(d); b = sin(a); c = relu(a) [external]; out = b + c
+    # grouping {exp, sin, add} together would create subgraph -> relu ->
+    # subgraph; the partitioner must split so evaluation stays acyclic
+    d = sym.var("data")
+    a = sym.exp(d)
+    b = sym.sin(a)
+    c = sym.relu(a)
+    out = b + c
+    _check(out, {"exp", "sin", "elemwise_add", "broadcast_add", "_add"},
+           {"data": X})
+
+
+def test_two_inputs_two_matches():
+    d1, d2 = sym.var("a"), sym.var("b")
+    out = sym.exp(d1) * sym.sin(d2) + sym.exp(d2)
+    _check(out, {"exp", "sin"}, {"a": X, "b": Y})
+
+
+def test_partition_preserves_untouched_attrs():
+    d = sym.var("data")
+    y = sym.exp(d)
+    z = sym.relu(y)
+    z._set_attr(marker="keep")
+    new_sym, _ = partition(sym.Group([z]), IdentityGroupProperty({"exp"}))
+    relu_nodes = [n for n in new_sym._topo() if n.op == "relu"]
+    assert relu_nodes and relu_nodes[0].attr_dict.get("marker") == "keep"
+
+
+def test_declining_property_leaves_graph_unchanged():
+    class DeclineAll(IdentityGroupProperty):
+        def create_subgraph_node(self, sub_sym, subgraph_id, params):
+            return None
+
+    d = sym.var("data")
+    out = sym.sin(sym.exp(d))
+    new_sym, _ = partition(out, DeclineAll({"exp", "sin"}))
+    assert [n.op for n in new_sym._topo()] == \
+        [n.op for n in out._topo()]
+    onp.testing.assert_allclose(_eval(new_sym, data=X)[0],
+                                _eval(out, data=X)[0], rtol=1e-6)

@@ -36,6 +36,110 @@ __all__ = ["Symbol", "SymNode", "var", "Variable", "Group", "load",
 # NameManager._current semantics)
 
 
+def _attr_int(n, key, default=None):
+    v = n.attrs.get(key, default)
+    return int(v) if v is not None else None
+
+
+def _attr_tup(n, key):
+    v = n.attrs.get(key)
+    return tuple(int(x) for x in v) if v is not None else None
+
+
+def _fc_rule(n, in_shapes):
+    data = in_shapes[0]
+    nh = _attr_int(n, "num_hidden")
+    if nh is None:
+        return {}
+    flatten = n.attrs.get("flatten", True)
+    in_units = 1
+    if flatten:
+        for d in data[1:]:
+            in_units *= d
+    else:
+        in_units = data[-1]
+    out = {1: (nh, in_units)}
+    if not n.attrs.get("no_bias", False) and len(n.inputs) > 2:
+        out[2] = (nh,)
+    return out
+
+
+def _conv_rule(n, in_shapes):
+    data = in_shapes[0]
+    kernel = _attr_tup(n, "kernel")
+    nf = _attr_int(n, "num_filter")
+    if kernel is None or nf is None:
+        return {}
+    g = _attr_int(n, "num_group", 1) or 1
+    layout = n.attrs.get("layout") or {1: "NCW", 2: "NCHW",
+                                       3: "NCDHW"}[len(kernel)]
+    c = data[layout.index("C")]
+    if layout.index("C") == 1:
+        w = (nf, c // g) + kernel
+    else:
+        w = (nf,) + kernel + (c // g,)
+    out = {1: w}
+    if not n.attrs.get("no_bias", False) and len(n.inputs) > 2:
+        out[2] = (nf,)
+    return out
+
+
+def _deconv_rule(n, in_shapes):
+    data = in_shapes[0]
+    kernel = _attr_tup(n, "kernel")
+    nf = _attr_int(n, "num_filter")
+    if kernel is None or nf is None:
+        return {}
+    g = _attr_int(n, "num_group", 1) or 1
+    layout = n.attrs.get("layout") or {1: "NCW", 2: "NCHW",
+                                       3: "NCDHW"}[len(kernel)]
+    c = data[layout.index("C")]
+    # MXNet deconv weight layout: channel-first (in_c, out_c/g, *kernel),
+    # channel-last (in_c, *kernel, out_c/g) — matches ops/nn.py deconvolution
+    if layout.index("C") == 1:
+        w = (c, nf // g) + kernel
+    else:
+        w = (c,) + kernel + (nf // g,)
+    out = {1: w}
+    if not n.attrs.get("no_bias", True) and len(n.inputs) > 2:
+        out[2] = (nf,)
+    return out
+
+
+def _channel_stat_rule(n, in_shapes):
+    data = in_shapes[0]
+    axis = _attr_int(n, "axis", 1)
+    c = data[axis]
+    return {i: (c,) for i in range(1, len(n.inputs))}
+
+
+def _embedding_rule(n, in_shapes):
+    ind = _attr_int(n, "input_dim")
+    outd = _attr_int(n, "output_dim")
+    if ind is None or outd is None:
+        return {}
+    return {1: (ind, outd)}
+
+
+# op -> rule(node, in_shapes) -> {input_index: deduced shape}; rules fire
+# only when the data shape (input 0) is known and the target input is an
+# unbound variable (reference per-op InferShape functions)
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_rule,
+    "Convolution": _conv_rule,
+    "Deconvolution": _deconv_rule,
+    "BatchNorm": _channel_stat_rule,
+    "SyncBatchNorm": _channel_stat_rule,
+    "InstanceNorm": _channel_stat_rule,
+    "LayerNorm": lambda n, s: {i: (s[0][_attr_int(n, "axis", -1)],)
+                               for i in range(1, len(n.inputs))},
+    "GroupNorm": lambda n, s: {i: (s[0][1],)
+                               for i in range(1, len(n.inputs))},
+    "Embedding": _embedding_rule,
+    "embedding": _embedding_rule,       # canonical lowercase registration
+}
+
+
 class SymNode:
     """One graph node: a variable (op=None) or an operator application."""
 
@@ -178,8 +282,21 @@ class Symbol:
     # -- inference -------------------------------------------------------
     def infer_shape(self, **kwargs):
         """Infer output/arg shapes from given input shapes via jax abstract
-        evaluation (replaces infer_graph_attr_pass.cc)."""
-        return self._infer(kwargs, want="shape")
+        evaluation (replaces infer_graph_attr_pass.cc).  Args not given are
+        DEDUCED where the op's parameter geometry determines them — the
+        reference workflow of test_infer_shape.py::test_mlp2_infer_shape
+        (give the data shape, get every weight shape back)."""
+        args = self.list_arguments()
+        if all(a in kwargs for a in args):
+            return self._infer(kwargs, want="shape")
+        arg_res, out_res, _ = self._infer_deduce(kwargs, {})
+        missing = [a for a, s in zip(args, arg_res) if s is None]
+        if missing or any(o is None for o in out_res):
+            raise MXNetError(
+                "infer_shape: could not resolve shapes for "
+                f"{missing or 'some outputs'} from the given inputs — "
+                "pass them explicitly or use infer_shape_partial")
+        return arg_res, out_res, []
 
     def infer_type(self, **kwargs):
         try:
@@ -216,10 +333,90 @@ class Symbol:
         return arg_res, out_res, []
 
     def infer_shape_partial(self, **kwargs):
+        """Best-effort propagation (reference infer_shape_partial):
+        unknown shapes come back as None instead of raising — including
+        when the given shapes are mutually inconsistent."""
         try:
-            return self.infer_shape(**kwargs)
+            arg_res, out_res, _ = self._infer_deduce(kwargs, {})
         except MXNetError:
-            return None, None, None
+            return ([None] * len(self.list_arguments()),
+                    [None] * len(self._outputs), [])
+        return arg_res, out_res, []
+
+    def _infer_deduce(self, shapes, dtypes):
+        """Node-by-node shape propagation with parameter deduction
+        (reference infer_graph_attr_pass.cc's forward pass + the per-op
+        param-shape rules of test_infer_shape.py's scenarios): args whose
+        shapes were not given are deduced from the data shapes where the
+        op's parameter geometry determines them (FullyConnected weights,
+        Convolution kernels, norm-layer stats, Embedding tables).
+        Returns (arg_shapes, out_shapes, entry_map) with None for anything
+        unresolved."""
+        order = self._topo()
+        known: Dict[Tuple[int, int], Optional[tuple]] = {}
+        kdtype: Dict[Tuple[int, int], Any] = {}
+        var_shape: Dict[str, Optional[tuple]] = {}
+        for n in order:
+            if n.op is None:
+                shp = shapes.get(n.name)
+                var_shape[n.name] = tuple(shp) if shp is not None else None
+
+        def node_eval(n, in_specs):
+            schema = get_op(n.op)
+
+            def f(*arrs):
+                if schema.num_inputs == -1:
+                    raw = schema.fn(list(arrs), **n.attrs)
+                else:
+                    raw = schema.fn(*arrs, **n.attrs)
+                return (tuple(raw) if isinstance(raw, (list, tuple))
+                        else (raw,))
+
+            return jax.eval_shape(f, *in_specs)
+
+        for n in order:
+            if n.op is None:
+                shp = var_shape[n.name]
+                known[(id(n), 0)] = shp
+                kdtype[(id(n), 0)] = dtypes.get(n.name, jnp.float32)
+                continue
+            in_shapes = [known.get((id(src), i)) for (src, i) in n.inputs]
+            # deduction: fill unknown parameter-variable inputs whose
+            # geometry the op determines from the data shape
+            rule = _PARAM_SHAPE_RULES.get(n.op)
+            if rule is not None and in_shapes and in_shapes[0] is not None:
+                try:
+                    deduced = rule(n, in_shapes) or {}
+                except Exception:
+                    deduced = {}
+                for idx, shp in deduced.items():
+                    if idx < len(n.inputs) and in_shapes[idx] is None:
+                        src, si = n.inputs[idx]
+                        if src.op is None and var_shape.get(src.name) is None:
+                            var_shape[src.name] = tuple(shp)
+                            known[(id(src), si)] = tuple(shp)
+                            kdtype.setdefault((id(src), si), jnp.float32)
+                            in_shapes[idx] = tuple(shp)
+            if any(s is None for s in in_shapes):
+                for i in range(n.num_outputs):
+                    known[(id(n), i)] = None
+                continue
+            specs = [jax.ShapeDtypeStruct(
+                tuple(s), kdtype.get((id(src), si), jnp.float32))
+                for s, (src, si) in zip(in_shapes, n.inputs)]
+            try:
+                outs = node_eval(n, specs)
+            except Exception as e:
+                raise MXNetError(
+                    f"infer_shape: op '{n.op}' ({n.name}) rejected input "
+                    f"shapes {in_shapes}: {e}") from e
+            for i, o in enumerate(outs):
+                known[(id(n), i)] = tuple(o.shape)
+                kdtype[(id(n), i)] = o.dtype
+        args = [n.name for n in order if n.op is None]  # topo reused
+        arg_res = [var_shape.get(a) for a in args]
+        out_res = [known.get((id(n), i)) for (n, i) in self._outputs]
+        return arg_res, out_res, known
 
     # -- serialization ---------------------------------------------------
     def tojson(self, ref_format: bool = False) -> str:

@@ -1,0 +1,172 @@
+"""Symbol shape inference with parameter deduction (reference
+tests/python/unittest/test_infer_shape.py): give the data shape, get every
+weight/stat shape back; partial inference tolerates unknowns; inconsistent
+shapes raise."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp2():
+    data = sym.var("data")
+    out = sym.FullyConnected(data, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                             num_hidden=1000)
+    out = sym.Activation(out, act_type="relu")
+    out = sym.FullyConnected(out, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                             num_hidden=10)
+    return out
+
+
+def test_mlp2_infer_shape():
+    # reference test_mlp2_infer_shape: data shape alone determines all
+    out = _mlp2()
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(100, 100))
+    args = out.list_arguments()
+    got = dict(zip(args, arg_shapes))
+    assert got["data"] == (100, 100)
+    assert got["fc1_weight"] == (1000, 100)
+    assert got["fc1_bias"] == (1000,)
+    assert got["fc2_weight"] == (10, 1000)
+    assert got["fc2_bias"] == (10,)
+    assert out_shapes == [(100, 10)]
+
+
+def test_mlp2_infer_error():
+    # reference test_mlp2_infer_error: inconsistent given shapes raise
+    out = _mlp2()
+    with pytest.raises(MXNetError):
+        out.infer_shape(data=(100, 100), fc1_weight=(7, 33))
+
+
+def test_incomplete_infer_elewise():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b
+    arg_shapes, out_shapes, _ = c.infer_shape_partial(a=(4, 5))
+    got = dict(zip(c.list_arguments(), arg_shapes))
+    assert got["a"] == (4, 5)
+    # b cannot be deduced (broadcasting allows several shapes)
+    assert got["b"] is None
+    assert out_shapes == [None]
+
+
+def test_incomplete_infer_mlp():
+    # deeper chain: the SECOND layer's weights deduce through the first
+    out = _mlp2()
+    arg_shapes, _o, _ = out.infer_shape_partial(data=(32, 64))
+    got = dict(zip(out.list_arguments(), arg_shapes))
+    assert got["fc1_weight"] == (1000, 64)
+    assert got["fc2_weight"] == (10, 1000)
+
+
+def test_incomplete_infer_convolution():
+    data = sym.var("data")
+    conv = sym.Convolution(data, sym.var("w"), sym.var("b"),
+                           kernel=(3, 3), num_filter=16, pad=(1, 1))
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 8, 10, 10))
+    got = dict(zip(conv.list_arguments(), arg_shapes))
+    assert got["w"] == (16, 8, 3, 3)
+    assert got["b"] == (16,)
+    assert out_shapes == [(2, 16, 10, 10)]
+
+
+def test_conv_nhwc_weight_deduction():
+    data = sym.var("data")
+    conv = sym.Convolution(data, sym.var("w"), None, kernel=(3, 3),
+                           num_filter=16, pad=(1, 1), no_bias=True,
+                           layout="NHWC")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 10, 10, 8))
+    got = dict(zip(conv.list_arguments(), arg_shapes))
+    assert got["w"] == (16, 3, 3, 8)
+    assert out_shapes == [(2, 10, 10, 16)]
+
+
+def test_grouped_conv_weight_deduction():
+    data = sym.var("data")
+    conv = sym.Convolution(data, sym.var("w"), None, kernel=(3, 3),
+                           num_filter=16, num_group=4, pad=(1, 1),
+                           no_bias=True)
+    arg_shapes, _o, _ = conv.infer_shape(data=(2, 8, 10, 10))
+    got = dict(zip(conv.list_arguments(), arg_shapes))
+    assert got["w"] == (16, 2, 3, 3)
+
+
+def test_batchnorm_stat_deduction():
+    data = sym.var("data")
+    bn = sym.BatchNorm(data, sym.var("g"), sym.var("be"), sym.var("mm"),
+                       sym.var("mv"))
+    arg_shapes, out_shapes, _ = bn.infer_shape(data=(2, 7, 4, 4))
+    got = dict(zip(bn.list_arguments(), arg_shapes))
+    for p in ("g", "be", "mm", "mv"):
+        assert got[p] == (7,), (p, got)
+    assert out_shapes[0] == (2, 7, 4, 4)
+
+
+def test_embedding_deduction():
+    data = sym.var("data")
+    emb = sym.Embedding(data, sym.var("w"), input_dim=50, output_dim=8)
+    arg_shapes, out_shapes, _ = emb.infer_shape(data=(3, 5))
+    got = dict(zip(emb.list_arguments(), arg_shapes))
+    assert got["w"] == (50, 8)
+    assert out_shapes == [(3, 5, 8)]
+
+
+def test_incomplete_infer_concat():
+    # reference test_incomplete_infer_concat shape: concat output known
+    # when all inputs resolve through deduction
+    a, b = sym.var("a"), sym.var("b")
+    cat = sym.concat(a, b, dim=1)
+    fc = sym.FullyConnected(cat, sym.var("w"), None, num_hidden=4,
+                            no_bias=True)
+    arg_shapes, _o, _ = fc.infer_shape_partial(a=(2, 3), b=(2, 5))
+    got = dict(zip(fc.list_arguments(), arg_shapes))
+    assert got["w"] == (4, 8)
+
+
+def test_fc_infer_type():
+    # reference test_fc_infer_type: dtype flows through the graph
+    out = _mlp2()
+    arg_types, out_types, _ = out.infer_type(
+        **{a: onp.float32 for a in out.list_arguments()})
+    assert all(onp.dtype(t) == onp.float32 for t in arg_types)
+    assert [onp.dtype(t) for t in out_types] == [onp.dtype(onp.float32)]
+
+
+def test_shape_completely_unknown_partial():
+    out = _mlp2()
+    arg_shapes, out_shapes, _ = out.infer_shape_partial()
+    assert all(s is None for s in arg_shapes)
+    assert out_shapes == [None]
+
+
+def test_deduction_matches_execution():
+    # oracle: deduced shapes bind and execute
+    out = _mlp2()
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(8, 20))
+    feeds = {a: mx.nd.array(onp.random.rand(*s).astype(onp.float32))
+             for a, s in zip(out.list_arguments(), arg_shapes)}
+    (res,) = out.eval(**feeds)
+    assert res.shape == out_shapes[0]
+
+
+def test_deconv_nhwc_weight_deduction():
+    data = sym.var("data")
+    dc = sym.Deconvolution(data, sym.var("w"), kernel=(3, 3), num_filter=16,
+                           no_bias=True, layout="NHWC")
+    arg_shapes, out_shapes, _ = dc.infer_shape(data=(2, 10, 10, 8))
+    got = dict(zip(dc.list_arguments(), arg_shapes))
+    assert got["w"] == (8, 3, 3, 16)
+    assert out_shapes == [(2, 12, 12, 16)]
+
+
+def test_partial_inconsistent_returns_none():
+    x, w = sym.var("x"), sym.var("w")
+    conv = sym.Convolution(x, w, None, kernel=(3, 3), num_filter=4,
+                           no_bias=True)
+    arg_shapes, out_shapes, _ = conv.infer_shape_partial(
+        x=(2, 8, 10, 10), w=(3, 3, 3, 3))
+    assert all(s is None for s in arg_shapes)
+    assert all(s is None for s in out_shapes)

@@ -66,8 +66,18 @@ def make_op_func(schema: OpSchema) -> Callable:
         attr_names = params[n_in:]
 
         def fn(*args, out=None, **kwargs):
-            arrays = list(args[:n_in])
-            rest = args[n_in:]
+            import jax
+
+            n_take = n_in
+            # rng-input ops (Dropout): a non-array value in the key slot is
+            # an MXNet-style positional attr (nd.Dropout(x, 0.5)), never a
+            # key — leave the slot for the auto-drawn key
+            if (schema.rng_input and len(args) >= n_in
+                    and not isinstance(args[n_in - 1],
+                                       (NDArray, jax.Array))):
+                n_take = n_in - 1
+            arrays = list(args[:n_take])
+            rest = args[n_take:]
             ctx = None
             for a in arrays:
                 if isinstance(a, NDArray):
@@ -80,6 +90,21 @@ def make_op_func(schema: OpSchema) -> Callable:
             # drop trailing Nones (optional array slots)
             while arrays and arrays[-1] is None:
                 arrays.pop()
+            if schema.rng_input and len(arrays) == n_in:
+                if "key" in kwargs:
+                    raise TypeError(f"{schema.name}: key passed both "
+                                    "positionally and by keyword")
+            elif schema.rng_input and len(arrays) == n_in - 1:
+                from .. import random as _random
+                from ..context import current_context
+                from .ndarray import _wrap
+
+                k = kwargs.pop("key", None)       # keyword key supported
+                if k is None:
+                    k = _random.next_key()
+                elif isinstance(k, NDArray):
+                    k = k._data
+                arrays.append(_wrap(k, ctx or current_context()))
             attrs = dict(zip(attr_names, rest))
             attrs.update({k: v for k, v in kwargs.items() if k not in ("name", "ctx")})
             attrs = _unwrap_attr_arrays(attrs)

@@ -427,7 +427,7 @@ def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
 
 # --- dropout ---------------------------------------------------------------
 
-@register("Dropout", num_inputs=2)
+@register("Dropout", num_inputs=2, rng_input=True)
 def dropout(data, key, p=0.5, mode="training", axes=None, training=False,
             cudnn_off=None):
     """Reference src/operator/nn/dropout.cc.  ``key`` is a uint32 PRNG key

@@ -36,6 +36,9 @@ class OpSchema:
     # namespaces this op is exported to ('nd', 'np', 'npx', 'internal')
     namespaces: List[str] = field(default_factory=lambda: ["nd"])
     doc: Optional[str] = None
+    # last array input is a PRNG key the frontends auto-supply when the
+    # caller omits it (the reference draws from the engine RNG at dispatch)
+    rng_input: bool = False
 
     def __post_init__(self):
         if self.doc is None:
@@ -52,6 +55,7 @@ def register(
     differentiable: bool = True,
     aliases: Sequence[str] = (),
     namespaces: Sequence[str] = ("nd",),
+    rng_input: bool = False,
 ):
     """Decorator: register a pure-JAX function as an operator."""
 
@@ -64,6 +68,7 @@ def register(
             differentiable=differentiable,
             aliases=list(aliases),
             namespaces=list(namespaces),
+            rng_input=rng_input,
         )
         if name in _OPS:
             raise ValueError(f"operator '{name}' registered twice")

@@ -49,11 +49,28 @@ def make_sym_func(schema: OpSchema) -> Callable:
         attr_names = params[n_in:]
 
         def fn(*args, name=None, **kwargs):
-            syms = list(args[:n_in])
-            rest = args[n_in:]
+            n_take = n_in
+            # rng-input ops: a non-Symbol in the key slot is a positional
+            # attr (sym.Dropout(x, 0.5)); the key becomes an auto-created
+            # marked variable the executor/eval feeds with a fresh key
+            if (schema.rng_input and len(args) >= n_in
+                    and not isinstance(args[n_in - 1], Symbol)):
+                n_take = n_in - 1
+            syms = list(args[:n_take])
+            rest = args[n_take:]
             # optional trailing array slots may be None (e.g. no-bias FC)
             while syms and syms[-1] is None:
                 syms.pop()
+            if schema.rng_input and len(syms) == n_in - 1:
+                from .. import name as _name_mod
+                from .symbol import var as _var
+
+                k = kwargs.pop("key", None)
+                if k is None:
+                    k = _var(_name_mod.current().get(
+                        None, schema.name.lower() + "_key"))
+                    k._outputs[0][0].attr_dict["__rng_key__"] = "1"
+                syms.append(k)
             if any(not isinstance(s, Symbol) for s in syms):
                 raise TypeError(
                     f"sym.{schema.name}: all array inputs must be Symbols")

@@ -314,8 +314,12 @@ class Symbol:
     def _infer(self, shapes, want="shape", dtypes=None):
         args = self.list_arguments()
         dtypes = dtypes or {}
+        key_vars = set(self._rng_key_vars())
         specs = {}
         for a in args:
+            if a in key_vars and a not in shapes:
+                specs[a] = jax.ShapeDtypeStruct((2,), jnp.uint32)
+                continue
             shp = shapes.get(a)
             if shp is None:
                 raise MXNetError(f"infer_shape: missing shape for arg '{a}'")
@@ -359,6 +363,8 @@ class Symbol:
         for n in order:
             if n.op is None:
                 shp = shapes.get(n.name)
+                if shp is None and n.attr_dict.get("__rng_key__"):
+                    shp = (2,)          # PRNG-key variables (uint32 pair)
                 var_shape[n.name] = tuple(shp) if shp is not None else None
 
         def node_eval(n, in_specs):
@@ -378,7 +384,9 @@ class Symbol:
             if n.op is None:
                 shp = var_shape[n.name]
                 known[(id(n), 0)] = shp
-                kdtype[(id(n), 0)] = dtypes.get(n.name, jnp.float32)
+                kdtype[(id(n), 0)] = dtypes.get(
+                    n.name, jnp.uint32 if n.attr_dict.get("__rng_key__")
+                    else jnp.float32)
                 continue
             in_shapes = [known.get((id(src), i)) for (src, i) in n.inputs]
             # deduction: fill unknown parameter-variable inputs whose
@@ -496,14 +504,24 @@ class Symbol:
         return partition(self, prop, params)
 
     # -- execution -------------------------------------------------------
+    def _rng_key_vars(self):
+        """Names of auto-created PRNG-key variables (``__rng_key__`` attr)
+        — eval/bind feed these with fresh keys instead of requiring them."""
+        return [n.name for n in self._topo()
+                if n.op is None and n.attr_dict.get("__rng_key__")]
+
     def eval(self, ctx=None, **kwargs):
         """Evaluate with NDArray kwargs (reference symbol.py eval)."""
         from ..ndarray.ndarray import NDArray, _wrap
         from ..context import current_context
+        from .. import random as _random
 
         ctx = ctx or current_context()
         feed = {k: (v._data if isinstance(v, NDArray) else jnp.asarray(v))
                 for k, v in kwargs.items()}
+        for k in self._rng_key_vars():
+            if k not in feed:
+                feed[k] = _random.next_key()
         outs = _jit_graph(self)(feed)
         return [_wrap(o, ctx) for o in outs]
 
@@ -518,15 +536,27 @@ class Symbol:
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
         from ..executor import Executor
         from ..ndarray import zeros
+        from ..ndarray.ndarray import _wrap
+        from ..context import current_context
+        from .. import random as _random
 
+        key_vars = set(self._rng_key_vars())
         arg_shapes, _, _ = self.infer_shape(**shapes)
-        args = {a: zeros(s, ctx=ctx)
-                for a, s in zip(self.list_arguments(), arg_shapes)}
+        args = {}
+        for a, s in zip(self.list_arguments(), arg_shapes):
+            if a in key_vars:
+                args[a] = _wrap(_random.next_key(), ctx or current_context())
+            else:
+                args[a] = zeros(s, ctx=ctx)
         args_grad = None
         if grad_req != "null":
             args_grad = {a: zeros(s, ctx=ctx)
-                         for a, s in zip(self.list_arguments(), arg_shapes)}
-        return Executor(self, ctx, args, args_grad, grad_req)
+                         for a, s in zip(self.list_arguments(), arg_shapes)
+                         if a not in key_vars}
+        req = ({a: ("null" if a in key_vars else grad_req)
+                for a in self.list_arguments()}
+               if isinstance(grad_req, str) else grad_req)
+        return Executor(self, ctx, args, args_grad, req)
 
     # -- operator sugar --------------------------------------------------
     def _binary(self, op_name, other, reverse=False):

@@ -1,0 +1,192 @@
+"""Per-op second-derivative matrix (reference
+tests/python/unittest/test_higher_order_grad.py): for each unary op, the
+grad-of-grad computed through the tape (create_graph=True) must match the
+closed-form second derivative on random inputs.  Third derivatives spot-
+checked where the reference does (log/sigmoid/dense)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _second_grad(op, x_np):
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = op(x).sum()
+        (gx,) = autograd.grad(y, [x], create_graph=True)
+        z = gx.sum()
+    z.backward()
+    return x.grad.asnumpy()
+
+
+# (name, op over nd, closed-form f'', input sampler)
+def _pos(rng, n=7):          # strictly positive, away from 0
+    return (rng.rand(n) * 2 + 0.3).astype(onp.float32)
+
+
+def _unit(rng, n=7):         # inside (-0.9, 0.9), away from kinks
+    return ((rng.rand(n) - 0.5) * 1.6).astype(onp.float32)
+
+
+def _any(rng, n=7):
+    return ((rng.rand(n) - 0.5) * 4).astype(onp.float32)
+
+
+def _gt1(rng, n=7):
+    return (rng.rand(n) * 2 + 1.2).astype(onp.float32)
+
+
+def SEC(v):
+    return 1.0 / onp.cos(v)
+CASES = [
+    ("sin", lambda x: nd.sin(x), lambda v: -onp.sin(v), _any),
+    ("cos", lambda x: nd.cos(x), lambda v: -onp.cos(v), _any),
+    ("tan", lambda x: nd.tan(x),
+     lambda v: 2 * onp.tan(v) * SEC(v) ** 2, _unit),
+    ("sinh", lambda x: nd.sinh(x), onp.sinh, _any),
+    ("cosh", lambda x: nd.cosh(x), onp.cosh, _any),
+    ("tanh", lambda x: nd.tanh(x),
+     lambda v: -2 * onp.tanh(v) * (1 - onp.tanh(v) ** 2), _any),
+    ("arcsin", lambda x: nd.arcsin(x),
+     lambda v: v * (1 - v ** 2) ** -1.5, _unit),
+    ("arccos", lambda x: nd.arccos(x),
+     lambda v: -v * (1 - v ** 2) ** -1.5, _unit),
+    ("arctan", lambda x: nd.arctan(x),
+     lambda v: -2 * v / (1 + v ** 2) ** 2, _any),
+    ("arcsinh", lambda x: nd.arcsinh(x),
+     lambda v: -v * (1 + v ** 2) ** -1.5, _any),
+    ("arccosh", lambda x: nd.arccosh(x),
+     lambda v: -v * (v ** 2 - 1) ** -1.5, _gt1),
+    ("arctanh", lambda x: nd.arctanh(x),
+     lambda v: 2 * v / (1 - v ** 2) ** 2, _unit),
+    ("radians", lambda x: nd.radians(x), lambda v: onp.zeros_like(v), _any),
+    ("relu", lambda x: nd.relu(x), lambda v: onp.zeros_like(v), _any),
+    ("log", lambda x: nd.log(x), lambda v: -1.0 / v ** 2, _pos),
+    ("log2", lambda x: nd.log2(x),
+     lambda v: -1.0 / (v ** 2 * onp.log(2)), _pos),
+    ("log10", lambda x: nd.log10(x),
+     lambda v: -1.0 / (v ** 2 * onp.log(10)), _pos),
+    ("square", lambda x: nd.square(x), lambda v: 2 * onp.ones_like(v), _any),
+    ("expm1", lambda x: nd.expm1(x), onp.exp, _any),
+    ("log1p", lambda x: nd.log1p(x), lambda v: -1.0 / (1 + v) ** 2, _pos),
+    ("reciprocal", lambda x: nd.reciprocal(x), lambda v: 2.0 / v ** 3, _pos),
+    ("abs", lambda x: nd.abs(x), lambda v: onp.zeros_like(v), _any),
+    ("clip", lambda x: nd.clip(x, -10.0, 10.0),
+     lambda v: onp.zeros_like(v), _any),
+    ("sigmoid", lambda x: nd.sigmoid(x),
+     lambda v: (lambda s: s * (1 - s) * (1 - 2 * s))(1 / (1 + onp.exp(-v))),
+     _any),
+    ("sqrt", lambda x: nd.sqrt(x), lambda v: -0.25 * v ** -1.5, _pos),
+    ("cbrt", lambda x: nd.cbrt(x), lambda v: -(2. / 9) * v ** (-5. / 3),
+     _pos),
+    ("rsqrt", lambda x: nd.rsqrt(x), lambda v: 0.75 * v ** -2.5, _pos),
+    ("rcbrt", lambda x: nd.rcbrt(x), lambda v: (4. / 9) * v ** (-7. / 3),
+     _pos),
+]
+
+
+@pytest.mark.parametrize("name,op,d2,sampler", CASES,
+                         ids=[c[0] for c in CASES])
+def test_second_derivative(name, op, d2, sampler):
+    import zlib
+
+    # crc32, NOT hash(): str hashing is randomized per process and would
+    # make a tolerance failure unreproducible
+    rng = onp.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+    v = sampler(rng)
+    got = _second_grad(op, v)
+    onp.testing.assert_allclose(got, d2(v), rtol=2e-3, atol=2e-4)
+
+
+def test_third_order_log():
+    # reference spot-checks third order: d3/dx3 log(x) = 2/x^3
+    v = onp.array([0.7, 1.3, 2.5], onp.float32)
+    x = nd.array(v)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.log(x).sum()
+        (g1,) = autograd.grad(y, [x], create_graph=True)
+        (g2,) = autograd.grad(g1.sum(), [x], create_graph=True)
+        z = g2.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2.0 / v ** 3, rtol=1e-3)
+
+
+@pytest.mark.parametrize("flatten", [True, False])
+def test_dense_backward_second_order(flatten):
+    # reference test_dense_backward_flatten/no_flatten: grad-of-grad wrt
+    # weight through a FullyConnected layer
+    rng = onp.random.RandomState(3)
+    x_np = rng.rand(4, 3).astype(onp.float32)
+    w_np = rng.rand(2, 3).astype(onp.float32)
+    x, w = nd.array(x_np), nd.array(w_np)
+    w.attach_grad()
+    with autograd.record():
+        y = nd.FullyConnected(x, w, None, num_hidden=2, no_bias=True,
+                              flatten=flatten)
+        # nonlinear head so the second derivative is nonzero
+        loss = (y ** 3).sum()
+        (gw,) = autograd.grad(loss, [w], create_graph=True)
+        z = gw.sum()
+    z.backward()
+    # d/dw sum_j dL/dw_j for L = sum (xw)^3: second derivative =
+    # sum over batch of 6*(xw)*x_i*x_k contracted — oracle via numpy
+    pre = x_np @ w_np.T                       # (4,2)
+    # gw[j,k] = sum_b 3*pre[b,j]^2 * x[b,k]; d(sum gw)/dw[m,n] =
+    #   sum_b 6*pre[b,m]*x[b,n]*(sum_k x[b,k])
+    expect = 6 * (pre * x_np.sum(1, keepdims=True)).T @ x_np
+    onp.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-4)
+
+
+def test_dropout_second_order_is_zero():
+    # reference test_dropout: dropout is piecewise linear — f'' == 0
+    v = onp.linspace(0.5, 2.0, 6).astype(onp.float32)
+    x = nd.array(v)
+    x.attach_grad()
+    mx.random.seed(7)
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5, training=True)
+        (gx,) = autograd.grad(y.sum(), [x], create_graph=True)
+        z = (gx * gx).sum()       # any functional of g1
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.zeros_like(v),
+                                atol=1e-6)
+
+
+def test_nd_dropout_rng_autoinject_variants():
+    # reference nd.Dropout surface: key auto-drawn; positional attr and
+    # keyword key both work (caught by review of the auto-key change)
+    x = nd.ones((64,))
+    mx.random.seed(0)
+    with autograd.record(train_mode=True):
+        a = nd.Dropout(x, 0.5, training=True)          # positional p
+        b = nd.Dropout(x, p=0.5, training=True)        # kwargs p
+    assert 0.1 < float((a.asnumpy() == 0).mean()) < 0.9
+    assert 0.1 < float((b.asnumpy() == 0).mean()) < 0.9
+    import jax
+    k = nd.array(onp.asarray(jax.random.PRNGKey(7)))
+    c1 = nd.Dropout(x, k, p=0.5, training=True)        # positional key
+    c2 = nd.Dropout(x, key=k, p=0.5, training=True)    # keyword key
+    onp.testing.assert_allclose(c1.asnumpy(), c2.asnumpy())
+    with pytest.raises(TypeError):
+        nd.Dropout(x, k, key=k, p=0.5, training=True)  # both
+
+
+def test_sym_dropout_rng_key_variable():
+    # sym.Dropout without a key gets an auto variable eval/bind feed
+    from mxnet_tpu import sym
+
+    d = sym.var("data")
+    out = sym.Dropout(d, p=0.5, training=True)
+    keys = out._rng_key_vars()
+    assert len(keys) == 1
+    (res,) = out.eval(data=nd.ones((128,)))
+    frac = float((res.asnumpy() == 0).mean())
+    assert 0.2 < frac < 0.8
+    # simple_bind allocates + feeds the key var, no grad on it
+    exe = out.simple_bind(mx.cpu(), data=(8,))
+    outs = exe.forward()
+    assert outs[0].shape == (8,)
+    assert keys[0] not in exe.grad_dict

@@ -141,6 +141,11 @@ declare("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
         "scheduler, NaiveEngine forces synchronous eager dispatch for "
         "debugging (reference MXNET_ENGINE_TYPE)", subsystem="engine",
         cached=False)
+declare("MXNET_BACKWARD_DO_MIRROR", bool, False,
+        "Rematerialize forwards during backward (jax.checkpoint) instead "
+        "of keeping activations alive — trades ~1 extra forward of FLOPs "
+        "for peak HBM (reference mirror path, src/nnvm/gradient.cc); "
+        "per-net override: hybridize(remat=...)", subsystem="memory")
 declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
         "Arrays larger than this many elements get their own dist push "
         "bucket (reference kvstore_dist big-array splitting)",

@@ -30,6 +30,8 @@ class Executor:
         self._sym = sym
         self._ctx = ctx or current_context()
         self._arg_names = sym.list_arguments()
+        self._rng_key_names = set(sym._rng_key_vars()) \
+            if hasattr(sym, "_rng_key_vars") else set()
 
         if isinstance(args, (list, tuple)):
             if len(args) != len(self._arg_names):
@@ -84,6 +86,13 @@ class Executor:
                 raise MXNetError(f"unknown argument {k}")
             self.arg_dict[k]._set_data(
                 v._data if isinstance(v, NDArray) else jnp.asarray(v))
+        # fresh randomness per forward (reference engine RNG semantics):
+        # auto rng-key variables are re-drawn unless the caller fed them
+        for k in self._rng_key_names:
+            if k not in kwargs:
+                from . import random as _random
+
+                self.arg_dict[k]._set_data(_random.next_key())
         feed = {a: self.arg_dict[a]._data for a in self._arg_names}
         self._last_feed = feed if is_train else None
         raw = self._fwd(feed)
@@ -119,15 +128,22 @@ class Executor:
 
     def reshape(self, **shapes):
         from .ndarray import zeros
+        from .ndarray.ndarray import _wrap
+        from . import random as _random
 
         arg_shapes, _, _ = self._sym.infer_shape(**shapes)
-        args = {a: zeros(s, ctx=self._ctx)
-                for a, s in zip(self._arg_names, arg_shapes)}
+        args = {}
+        for a, s in zip(self._arg_names, arg_shapes):
+            if a in self._rng_key_names:
+                args[a] = _wrap(_random.next_key(), self._ctx)
+            else:
+                args[a] = zeros(s, ctx=self._ctx)
         for a, arr in self.arg_dict.items():
-            if args[a].shape == arr.shape:
+            if a not in self._rng_key_names and args[a].shape == arr.shape:
                 args[a] = arr
         grads = None
         if self.grad_dict:
             grads = {a: zeros(s, ctx=self._ctx)
-                     for a, s in zip(self._arg_names, arg_shapes)}
+                     for a, s in zip(self._arg_names, arg_shapes)
+                     if a not in self._rng_key_names}
         return Executor(self._sym, self._ctx, args, grads, self._grad_req)

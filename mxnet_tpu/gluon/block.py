@@ -448,10 +448,29 @@ class HybridBlock(Block):
         self._backend = None
         self._backend_flags: Dict[str, Any] = {}
         self._in_specs = None  # (struct, [(shape, dtype)]) from last call
+        from .. import config as _config
 
-    def hybridize(self, active=True, backend=None, clear=True, **kwargs):
+        # reference MXNET_BACKWARD_DO_MIRROR: recompute-in-backward default
+        self._remat = bool(_config.get("MXNET_BACKWARD_DO_MIRROR"))
+        self._remat_policy = None
+
+    def hybridize(self, active=True, backend=None, clear=True, remat=None,
+                  remat_policy=None, **kwargs):
         """Activate whole-graph compilation.  ``static_alloc``/``static_shape``
-        are accepted for API parity; XLA's buffer assignment subsumes them."""
+        are accepted for API parity; XLA's buffer assignment subsumes them.
+
+        ``remat=True`` rematerializes the forward during backward
+        (``jax.checkpoint``): activations are not kept alive between the
+        passes, trading one extra forward's FLOPs for peak-memory — the
+        TPU-native analog of the reference's gradient mirroring
+        (MXNET_BACKWARD_DO_MIRROR, src/nnvm/gradient.cc mirror path).
+        ``remat_policy`` names a jax.checkpoint_policies entry (e.g.
+        'dots_saveable') for selective saving.  Default follows the
+        MXNET_BACKWARD_DO_MIRROR env var."""
+        if remat is not None:
+            self._remat = bool(remat)
+        if remat_policy is not None:     # keep a previously-set policy
+            self._remat_policy = remat_policy
         self._active = active
         self._backend = backend
         self._flags.update(kwargs)
@@ -633,6 +652,13 @@ class HybridBlock(Block):
                     "Symbol.optimize_for (hybridized blocks take "
                     "traced-function transforms)")
             raw_fn = backend(raw_fn, **getattr(self, "_backend_flags", {}))
+        if getattr(self, "_remat", False):
+            # recompute-in-backward (reference mirror path): checkpoint the
+            # traced forward so vjp keeps only the inputs alive
+            policy = None
+            if getattr(self, "_remat_policy", None):
+                policy = getattr(jax.checkpoint_policies, self._remat_policy)
+            raw_fn = jax.checkpoint(raw_fn, policy=policy)
         jitted = jax.jit(raw_fn)
         return (jitted, names, params, ctx_idx, out_struct, mutated_names)
 

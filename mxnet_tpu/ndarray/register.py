@@ -72,9 +72,11 @@ def make_op_func(schema: OpSchema) -> Callable:
             # rng-input ops (Dropout): a non-array value in the key slot is
             # an MXNet-style positional attr (nd.Dropout(x, 0.5)), never a
             # key — leave the slot for the auto-drawn key
+            import numpy as _onp
+
             if (schema.rng_input and len(args) >= n_in
                     and not isinstance(args[n_in - 1],
-                                       (NDArray, jax.Array))):
+                                       (NDArray, jax.Array, _onp.ndarray))):
                 n_take = n_in - 1
             arrays = list(args[:n_take])
             rest = args[n_take:]

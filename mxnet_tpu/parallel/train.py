@@ -92,10 +92,19 @@ class ShardedTrainer:
                  batch_spec: Optional[P] = None,
                  label_spec: Optional[P] = None,
                  donate: bool = True, grad_accum: int = 1,
-                 compute_dtype=None):
+                 compute_dtype=None, remat: Optional[bool] = None):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh
+        # recompute-in-backward (jax.checkpoint over the whole forward) —
+        # the reference mirror path; lets batch/sequence scale past HBM at
+        # ~1 extra forward of FLOPs.  None = follow the documented
+        # MXNET_BACKWARD_DO_MIRROR global default.
+        if remat is None:
+            from .. import config as _config
+
+            remat = bool(_config.get("MXNET_BACKWARD_DO_MIRROR"))
+        self.remat = bool(remat)
         # mixed precision: params/optimizer state stay fp32 (master
         # weights); fwd+bwd compute casts to ``compute_dtype`` (bf16 puts
         # the matmuls on the MXU's native path), grads flow back fp32
@@ -234,6 +243,9 @@ class ShardedTrainer:
                     loss = loss._data
                 loss = jnp.mean(loss).astype(jnp.float32)
                 return loss, mutated
+
+            if self.remat:
+                loss_of = jax.checkpoint(loss_of, static_argnums=())
 
             trainable = {n: params[n] for n in grad_names}
             if accum == 1:

@@ -61,6 +61,9 @@ def make_sym_func(schema: OpSchema) -> Callable:
             # optional trailing array slots may be None (e.g. no-bias FC)
             while syms and syms[-1] is None:
                 syms.pop()
+            if schema.rng_input and len(syms) == n_in and "key" in kwargs:
+                raise TypeError(f"sym.{schema.name}: key passed both "
+                                "positionally and by keyword")
             if schema.rng_input and len(syms) == n_in - 1:
                 from .. import name as _name_mod
                 from .symbol import var as _var

@@ -1,0 +1,146 @@
+"""Rematerialization (recompute-in-backward) — the TPU-native analog of
+the reference's gradient mirroring (MXNET_BACKWARD_DO_MIRROR,
+src/nnvm/gradient.cc mirror path), implemented with jax.checkpoint.
+The testable contract on CPU is bit-level equivalence: remat changes the
+schedule, never the math."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn
+
+
+def _net(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(8, in_units=16, activation="tanh"),
+            nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _grads(net, x):
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    return (float(loss.asnumpy()),
+            {k: p.grad().asnumpy().copy()
+             for k, p in net.collect_params().items()})
+
+
+def test_hybridize_remat_matches_plain():
+    x = nd.array(onp.random.RandomState(0).rand(4, 8).astype(onp.float32))
+    net_a, net_b = _net(11), _net(11)
+    net_a.hybridize()
+    net_b.hybridize(remat=True)
+    la, ga = _grads(net_a, x)
+    lb, gb = _grads(net_b, x)
+    assert abs(la - lb) < 1e-6
+    for k in ga:
+        onp.testing.assert_allclose(gb[k], ga[k], rtol=1e-6, atol=1e-7)
+
+
+def test_remat_policy_accepted():
+    x = nd.ones((2, 8))
+    net = _net(3)
+    net.hybridize(remat=True, remat_policy="dots_saveable")
+    la, _ = _grads(net, x)
+    net2 = _net(3)
+    net2.hybridize()
+    lb, _ = _grads(net2, x)
+    assert abs(la - lb) < 1e-6
+
+
+def test_mirror_env_var_default(monkeypatch):
+    from mxnet_tpu import config
+
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    config._CACHE.pop("MXNET_BACKWARD_DO_MIRROR", None)
+    try:
+        net = _net(5)
+        # a net constructed under the env var remats by default…
+        assert net._remat is True
+        # …and still matches the plain math
+        net.hybridize()
+        x = nd.ones((2, 8))
+        la, ga = _grads(net, x)
+        net2 = _net(5)
+        net2.hybridize(remat=False)
+        lb, gb = _grads(net2, x)
+        assert abs(la - lb) < 1e-6
+    finally:
+        config._CACHE.pop("MXNET_BACKWARD_DO_MIRROR", None)
+
+
+def test_sharded_trainer_remat_equivalence():
+    import jax.numpy as jnp
+
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    rng = onp.random.RandomState(2)
+    data = rng.rand(8, 8).astype(onp.float32)
+    label = rng.randint(0, 2, (8,)).astype(onp.int32)
+    ce = SoftmaxCrossEntropyLoss()
+
+    losses = []
+    for remat in (False, True):
+        net = _net(21)
+        mesh = par.make_mesh({"dp": 1})
+        tr = par.ShardedTrainer(net, lambda o, l: ce(o, l).mean(), mesh,
+                                optimizer="sgd",
+                                optimizer_params={"lr": 0.1},
+                                remat=remat)
+        d, l = tr.stage(data, label)
+        run = []
+        for _ in range(3):
+            loss = tr.step(d, l)
+            run.append(float(loss.asnumpy() if hasattr(loss, "asnumpy")
+                             else loss))
+        losses.append(run)
+    onp.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+
+
+def test_sharded_trainer_remat_with_accum():
+    import jax.numpy as jnp
+
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    rng = onp.random.RandomState(4)
+    data = rng.rand(8, 8).astype(onp.float32)
+    label = rng.randint(0, 2, (8,)).astype(onp.int32)
+    ce = SoftmaxCrossEntropyLoss()
+
+    losses = []
+    for remat in (False, True):
+        net = _net(23)
+        mesh = par.make_mesh({"dp": 1})
+        tr = par.ShardedTrainer(net, lambda o, l: ce(o, l).mean(), mesh,
+                                optimizer="sgd",
+                                optimizer_params={"lr": 0.1},
+                                grad_accum=2, remat=remat)
+        d, l = tr.stage(data, label)
+        out = [float(tr.step(d, l)) for _ in range(2)]
+        losses.append(out)
+    onp.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+
+
+def test_executor_fresh_dropout_mask_per_forward():
+    # reference engine RNG: each forward draws fresh randomness; a bound
+    # executor must not freeze the bind-time key (review-caught)
+    from mxnet_tpu import sym
+
+    out = sym.Dropout(sym.var("data"), p=0.5, training=True)
+    exe = out.simple_bind(mx.cpu(), data=(256,))
+    a = exe.forward(data=nd.ones((256,)))[0].asnumpy()
+    b = exe.forward(data=nd.ones((256,)))[0].asnumpy()
+    assert (a != b).any(), "dropout mask frozen across forwards"
+    # reshape keeps the key machinery intact
+    exe2 = exe.reshape(data=(64,))
+    c = exe2.forward(data=nd.ones((64,)))[0].asnumpy()
+    d = exe2.forward(data=nd.ones((64,)))[0].asnumpy()
+    assert c.shape == (64,) and (c != d).any()
+    assert not (set(exe2.grad_dict) & set(out._rng_key_vars()))

@@ -12,12 +12,44 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from . import random as _random
 from .base import MXNetError
 from .context import Context, current_context
 from .ndarray import NDArray
 from .ndarray.ndarray import _wrap
 
-__all__ = ["Executor"]
+__all__ = ["Executor", "alloc_bind_arrays"]
+
+
+def alloc_bind_arrays(sym, ctx, arg_shapes, grad_req, keep=None):
+    """Shared rng-key-aware binding allocation (used by Symbol.simple_bind
+    and Executor.reshape): key variables get a fresh key, never grads;
+    ``keep`` maps arg name -> existing NDArray reused when shapes match.
+    Returns (args, args_grad_or_None, normalized grad_req dict)."""
+    from .ndarray import zeros
+
+    key_vars = set(sym._rng_key_vars()) if hasattr(sym, "_rng_key_vars") \
+        else set()
+    names = sym.list_arguments()
+    args = {}
+    for a, s in zip(names, arg_shapes):
+        if a in key_vars:
+            args[a] = _wrap(_random.next_key(), ctx or current_context())
+        elif keep and a in keep and tuple(keep[a].shape) == tuple(s):
+            args[a] = keep[a]
+        else:
+            args[a] = zeros(s, ctx=ctx)
+    if isinstance(grad_req, str):
+        req = {a: ("null" if a in key_vars else grad_req) for a in names}
+    else:
+        req = {a: ("null" if a in key_vars else grad_req.get(a, "write"))
+               for a in names}
+    grads = None
+    if any(r != "null" for r in req.values()):
+        grads = {a: zeros(s, ctx=ctx)
+                 for a, s in zip(names, arg_shapes)
+                 if req[a] != "null"}
+    return args, grads, req
 
 
 class Executor:
@@ -90,8 +122,6 @@ class Executor:
         # auto rng-key variables are re-drawn unless the caller fed them
         for k in self._rng_key_names:
             if k not in kwargs:
-                from . import random as _random
-
                 self.arg_dict[k]._set_data(_random.next_key())
         feed = {a: self.arg_dict[a]._data for a in self._arg_names}
         self._last_feed = feed if is_train else None
@@ -127,23 +157,8 @@ class Executor:
                 self.arg_dict[k]._set_data(v._data)
 
     def reshape(self, **shapes):
-        from .ndarray import zeros
-        from .ndarray.ndarray import _wrap
-        from . import random as _random
-
         arg_shapes, _, _ = self._sym.infer_shape(**shapes)
-        args = {}
-        for a, s in zip(self._arg_names, arg_shapes):
-            if a in self._rng_key_names:
-                args[a] = _wrap(_random.next_key(), self._ctx)
-            else:
-                args[a] = zeros(s, ctx=self._ctx)
-        for a, arr in self.arg_dict.items():
-            if a not in self._rng_key_names and args[a].shape == arr.shape:
-                args[a] = arr
-        grads = None
-        if self.grad_dict:
-            grads = {a: zeros(s, ctx=self._ctx)
-                     for a, s in zip(self._arg_names, arg_shapes)
-                     if a not in self._rng_key_names}
-        return Executor(self._sym, self._ctx, args, grads, self._grad_req)
+        req = self._grad_req if self.grad_dict else "null"
+        args, grads, req = alloc_bind_arrays(
+            self._sym, self._ctx, arg_shapes, req, keep=self.arg_dict)
+        return Executor(self._sym, self._ctx, args, grads, req)

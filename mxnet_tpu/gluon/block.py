@@ -470,6 +470,14 @@ class HybridBlock(Block):
         if remat is not None:
             self._remat = bool(remat)
         if remat_policy is not None:     # keep a previously-set policy
+            import jax
+
+            if not hasattr(jax.checkpoint_policies, remat_policy):
+                valid = [p for p in dir(jax.checkpoint_policies)
+                         if not p.startswith("_")]
+                raise ValueError(
+                    f"unknown remat_policy {remat_policy!r}; valid "
+                    f"jax.checkpoint_policies names: {valid}")
             self._remat_policy = remat_policy
         self._active = active
         self._backend = backend

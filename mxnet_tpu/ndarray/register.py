@@ -12,8 +12,21 @@ from __future__ import annotations
 import inspect
 from typing import Any, Callable
 
+import jax
+import numpy as _onp
+
 from ..ops.registry import OpSchema
 from .ndarray import NDArray, array, invoke
+
+
+def _looks_like_key(a) -> bool:
+    """Is this positional value a PRNG key (vs an MXNet positional attr)?
+    Device arrays always count; host numpy only when it has key shape+kind
+    (a 0-d float np scalar is an attr like p=np.array(0.5), never a key)."""
+    if isinstance(a, (NDArray, jax.Array)):
+        return True
+    return (isinstance(a, _onp.ndarray) and a.ndim >= 1
+            and a.dtype.kind in "uiV")
 
 __all__ = ["make_op_func"]
 
@@ -66,17 +79,12 @@ def make_op_func(schema: OpSchema) -> Callable:
         attr_names = params[n_in:]
 
         def fn(*args, out=None, **kwargs):
-            import jax
-
             n_take = n_in
-            # rng-input ops (Dropout): a non-array value in the key slot is
+            # rng-input ops (Dropout): a non-key value in the key slot is
             # an MXNet-style positional attr (nd.Dropout(x, 0.5)), never a
             # key — leave the slot for the auto-drawn key
-            import numpy as _onp
-
             if (schema.rng_input and len(args) >= n_in
-                    and not isinstance(args[n_in - 1],
-                                       (NDArray, jax.Array, _onp.ndarray))):
+                    and not _looks_like_key(args[n_in - 1])):
                 n_take = n_in - 1
             arrays = list(args[:n_take])
             rest = args[n_take:]

@@ -107,6 +107,8 @@ _LEGACY = {
     "_logical_or": "broadcast_logical_or",
     "_logical_xor": "broadcast_logical_xor",
     "_maximum": "broadcast_maximum", "_minimum": "broadcast_minimum",
+    # reference mx.sym.maximum/minimum (python-level helpers over _maximum)
+    "maximum": "broadcast_maximum", "minimum": "broadcast_minimum",
     "_mod": "broadcast_mod", "_power": "broadcast_power",
     "_hypot": "broadcast_hypot", "_grad_add": "elemwise_add",
     "_equal_scalar": "equal_scalar",

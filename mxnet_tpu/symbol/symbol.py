@@ -534,28 +534,11 @@ class Symbol:
     _bind = bind
 
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
-        from ..executor import Executor
-        from ..ndarray import zeros
-        from ..ndarray.ndarray import _wrap
-        from ..context import current_context
-        from .. import random as _random
+        from ..executor import Executor, alloc_bind_arrays
 
-        key_vars = set(self._rng_key_vars())
         arg_shapes, _, _ = self.infer_shape(**shapes)
-        args = {}
-        for a, s in zip(self.list_arguments(), arg_shapes):
-            if a in key_vars:
-                args[a] = _wrap(_random.next_key(), ctx or current_context())
-            else:
-                args[a] = zeros(s, ctx=ctx)
-        args_grad = None
-        if grad_req != "null":
-            args_grad = {a: zeros(s, ctx=ctx)
-                         for a, s in zip(self.list_arguments(), arg_shapes)
-                         if a not in key_vars}
-        req = ({a: ("null" if a in key_vars else grad_req)
-                for a in self.list_arguments()}
-               if isinstance(grad_req, str) else grad_req)
+        args, args_grad, req = alloc_bind_arrays(
+            self, ctx, arg_shapes, grad_req)
         return Executor(self, ctx, args, args_grad, req)
 
     # -- operator sugar --------------------------------------------------

@@ -1,0 +1,133 @@
+"""Executor bind/grad scenarios (reference
+tests/python/unittest/test_executor.py): binary ops across ranks with
+analytic gradient oracles, dot with random shapes, simple_bind reshape
+semantics, and the zero-input CachedOp-init analog."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def check_bind_with_uniform(ufunc, gfunc, dim, sf=None, lshape=None,
+                            rshape=None, rng=None):
+    """reference test_executor.check_bind_with_uniform: random uniform
+    inputs, forward vs numpy ufunc, backward vs analytic gfunc."""
+    rng = rng or onp.random.RandomState(0)
+    shape = lshape or tuple(rng.randint(1, 6, size=dim))
+    lhs = sym.var("lhs")
+    rhs = sym.var("rhs")
+    ret = sf(lhs, rhs) if sf is not None else ufunc(lhs, rhs)
+
+    lhs_arr = nd.array(rng.uniform(-1, 1, lshape or shape)
+                       .astype(onp.float32))
+    rhs_arr = nd.array(rng.uniform(-1, 1, rshape or shape)
+                       .astype(onp.float32))
+    lhs_grad = nd.zeros((lshape or shape))
+    rhs_grad = nd.zeros((rshape or shape))
+    exe = ret.bind(mx.cpu(), args=[lhs_arr, rhs_arr],
+                   args_grad=[lhs_grad, rhs_grad])
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    expect = ufunc(lhs_arr.asnumpy(), rhs_arr.asnumpy())
+    onp.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+    out_grad = nd.array(onp.ones(out.shape, onp.float32) * 2)
+    exe.backward([out_grad])
+    lg, rg = gfunc(out_grad.asnumpy(), lhs_arr.asnumpy(), rhs_arr.asnumpy())
+    onp.testing.assert_allclose(lhs_grad.asnumpy(), lg, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(rhs_grad.asnumpy(), rg, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("dim", [1, 2, 3])
+def test_bind_binary_ops(dim):
+    rng = onp.random.RandomState(dim)
+    check_bind_with_uniform(lambda x, y: x + y, lambda g, x, y: (g, g),
+                            dim, rng=rng)
+    check_bind_with_uniform(lambda x, y: x - y, lambda g, x, y: (g, -g),
+                            dim, rng=rng)
+    check_bind_with_uniform(lambda x, y: x * y,
+                            lambda g, x, y: (y * g, x * g), dim, rng=rng)
+    check_bind_with_uniform(lambda x, y: x / y,
+                            lambda g, x, y: (g / y, -x * g / (y ** 2)),
+                            dim, rng=rng)
+
+
+@pytest.mark.parametrize("dim", [1, 2])
+def test_bind_maximum_minimum(dim):
+    rng = onp.random.RandomState(10 + dim)
+    check_bind_with_uniform(lambda x, y: onp.maximum(x, y),
+                            lambda g, x, y: (g * (x >= y), g * (y > x)),
+                            dim, sf=sym.maximum, rng=rng)
+    check_bind_with_uniform(lambda x, y: onp.minimum(x, y),
+                            lambda g, x, y: (g * (x <= y), g * (y < x)),
+                            dim, sf=sym.minimum, rng=rng)
+
+
+def test_dot_random_shapes():
+    rng = onp.random.RandomState(7)
+    for _ in range(5):
+        s = tuple(rng.randint(1, 50, size=3))
+        check_bind_with_uniform(
+            lambda x, y: onp.dot(x, y),
+            lambda g, x, y: (onp.dot(g, y.T), onp.dot(x.T, g)),
+            2, lshape=(s[0], s[1]), rshape=(s[1], s[2]), sf=sym.dot,
+            rng=rng)
+
+
+def test_dot_1d_inner_product():
+    rng = onp.random.RandomState(8)
+    for _ in range(3):
+        (n,) = tuple(rng.randint(1, 50, size=1))
+        check_bind_with_uniform(lambda x, y: onp.dot(x, y),
+                                lambda g, x, y: (g * y, g * x),
+                                1, lshape=(n,), rshape=(n,), sf=sym.dot,
+                                rng=rng)
+
+
+def test_simple_bind_fc_reshape_semantics():
+    # reference test_reshape: weight sharing across reshaped executors,
+    # data buffers NOT shared
+    x = sym.var("x")
+    y = sym.FullyConnected(x, sym.var("w"), sym.var("b"), num_hidden=4)
+    exe = y.simple_bind(mx.cpu(), grad_req="null", x=(5, 4))
+    exe.arg_dict["x"]._set_data(nd.ones((5, 4))._data)
+    exe.arg_dict["w"]._set_data(nd.ones((4, 4))._data)
+    exe.arg_dict["b"]._set_data(nd.zeros((4,))._data)
+    exe.forward(is_train=False)
+    assert (exe.outputs[0].asnumpy() == 4).all()
+
+    exe2 = exe.reshape(x=(3, 4))
+    exe2.forward(is_train=False, x=nd.ones((3, 4)))
+    assert exe2.outputs[0].shape == (3, 4)
+    assert (exe2.outputs[0].asnumpy() == 4).all()
+
+    # weight array is shared; data array is fresh per shape
+    exe.arg_dict["x"]._set_data(nd.zeros((5, 4))._data)
+    assert (exe2.arg_dict["w"].asnumpy() == 1).all()
+    assert exe2.arg_dict["x"].shape == (3, 4)
+
+
+def test_zero_input_graph_executes():
+    # reference test_cached_op_init: a graph with no data inputs runs
+    out = sym.zeros((3, 3))
+    (z,) = out.eval()
+    assert (z.asnumpy() == 0).all()
+    out2 = sym.zeros((2, 2)) + 1.0
+    (z2,) = out2.eval()
+    assert (z2.asnumpy() == 1).all()
+
+
+def test_grad_req_add_accumulates():
+    # reference OpReqType kAddTo through the executor surface
+    x = sym.var("x")
+    y = x * 2.0
+    xa = nd.array(onp.ones((3,), onp.float32))
+    xg = nd.array(onp.full((3,), 5.0, onp.float32))
+    exe = y.bind(mx.cpu(), args=[xa], args_grad=[xg], grad_req="add")
+    exe.forward(is_train=True)
+    exe.backward([nd.ones((3,))])
+    onp.testing.assert_allclose(xg.asnumpy(), 5.0 + 2.0)
+    exe.forward(is_train=True)
+    exe.backward([nd.ones((3,))])
+    onp.testing.assert_allclose(xg.asnumpy(), 7.0 + 2.0)

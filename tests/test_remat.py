@@ -57,7 +57,7 @@ def test_mirror_env_var_default(monkeypatch):
     from mxnet_tpu import config
 
     monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
-    config._CACHE.pop("MXNET_BACKWARD_DO_MIRROR", None)
+    config.refresh("MXNET_BACKWARD_DO_MIRROR")
     try:
         net = _net(5)
         # a net constructed under the env var remats by default…
@@ -71,7 +71,7 @@ def test_mirror_env_var_default(monkeypatch):
         lb, gb = _grads(net2, x)
         assert abs(la - lb) < 1e-6
     finally:
-        config._CACHE.pop("MXNET_BACKWARD_DO_MIRROR", None)
+        config.refresh("MXNET_BACKWARD_DO_MIRROR")
 
 
 def test_sharded_trainer_remat_equivalence():

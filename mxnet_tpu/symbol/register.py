@@ -15,9 +15,30 @@ from .symbol import Symbol, _apply_op
 __all__ = ["make_sym_func"]
 
 
+def _split_attr_kwargs(attrs, kwargs, attr_names, has_var_kw=False):
+    """Reference kwarg routing (kHiddenKeys, c_api_symbolic.cc): known
+    names are op params; ``attr=`` plus ANNOTATION kwargs (lr_mult=…,
+    __dunder__=…) become string node attributes.  Anything else stays an
+    op attr — a typo'd parameter must still error at execution, and a
+    **kwargs op (Custom) must receive every hyperparameter."""
+    from .symbol import _is_annotation_key
+
+    extra = dict(kwargs.pop("attr", None) or {})
+    for k, v in kwargs.items():
+        if k not in attr_names and not has_var_kw and (
+                _is_annotation_key(k)
+                or (k.startswith("__") and k.endswith("__"))):
+            extra[k] = v
+        else:
+            attrs[k] = v
+    return attrs, (extra or None)
+
+
 def make_sym_func(schema: OpSchema) -> Callable:
     sig = inspect.signature(schema.fn)
     params = list(sig.parameters)
+    has_var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                     for p in sig.parameters.values())
 
     if schema.num_inputs == -1:
         attr_names = params[1:]
@@ -33,16 +54,18 @@ def make_sym_func(schema: OpSchema) -> Callable:
                 else:
                     rest.append(a)
             attrs = dict(zip(attr_names, rest))
-            attrs.update({k: v for k, v in kwargs.items() if k != "attr"})
-            return _apply_op(schema.name, syms, attrs, name=name)
+            attrs, extra = _split_attr_kwargs(attrs, kwargs, attr_names,
+                                              has_var_kw)
+            return _apply_op(schema.name, syms, attrs, name=name, attr=extra)
 
     elif schema.num_inputs == 0:
         attr_names = params
 
         def fn(*args, name=None, **kwargs):
             attrs = dict(zip(attr_names, args))
-            attrs.update({k: v for k, v in kwargs.items() if k != "attr"})
-            return _apply_op(schema.name, [], attrs, name=name)
+            attrs, extra = _split_attr_kwargs(attrs, kwargs, attr_names,
+                                              has_var_kw)
+            return _apply_op(schema.name, [], attrs, name=name, attr=extra)
 
     else:
         n_in = schema.num_inputs
@@ -78,8 +101,9 @@ def make_sym_func(schema: OpSchema) -> Callable:
                 raise TypeError(
                     f"sym.{schema.name}: all array inputs must be Symbols")
             attrs = dict(zip(attr_names, rest))
-            attrs.update({k: v for k, v in kwargs.items() if k != "attr"})
-            return _apply_op(schema.name, syms, attrs, name=name)
+            attrs, extra = _split_attr_kwargs(attrs, kwargs, attr_names,
+                                              has_var_kw)
+            return _apply_op(schema.name, syms, attrs, name=name, attr=extra)
 
     fn.__name__ = schema.name
     fn.__doc__ = schema.doc

@@ -154,6 +154,17 @@ class SymNode:
         self.num_outputs = num_outputs
         self.attr_dict: Dict[str, str] = {}
 
+    # __slots__ classes need explicit state for pickling (reference
+    # symbols pickle via the nnvm JSON handle; here the DAG pickles
+    # directly — shared nodes stay shared through pickle's memo)
+    def __getstate__(self):
+        return (self.op, self.name, self.attrs, self.inputs,
+                self.num_outputs, self.attr_dict)
+
+    def __setstate__(self, state):
+        (self.op, self.name, self.attrs, self.inputs,
+         self.num_outputs, self.attr_dict) = state
+
 
 class Symbol:
     """A (possibly multi-output) handle into the symbolic graph."""
@@ -232,6 +243,20 @@ class Symbol:
 
     def list_attr(self):
         return dict(self._outputs[0][0].attr_dict)
+
+    def attr_dict(self):
+        """Aggregated {node_name: attributes} over the whole graph
+        (reference symbol.py attr_dict): op params appear as strings
+        alongside the node's annotation attrs."""
+        out: Dict[str, Dict[str, str]] = {}
+        for n in self._topo():
+            d: Dict[str, str] = {}
+            for k, v in n.attrs.items():
+                d[k] = str(v)
+            d.update(n.attr_dict)
+            if d:
+                out[n.name] = d
+        return out
 
     # -- composition -----------------------------------------------------
     def compose(self, **kwargs):
@@ -651,23 +676,46 @@ def _jit_graph(sym: Symbol):
 # ---------------------------------------------------------------------------
 
 
-def var(name: str, shape=None, dtype=None, init=None, **kwargs) -> Symbol:
-    """Create a symbolic variable (reference mx.sym.var)."""
+def _mirror_attrs(d: Dict[str, Any]) -> Dict[str, str]:
+    """Reference attr normalization: a bare key like ``lr_mult`` is
+    readable both as ``lr_mult`` and ``__lr_mult__`` (the dunder spelling
+    is what optimizers/initializers consult); dunder keys stay as-is."""
+    out: Dict[str, str] = {}
+    for k, v in d.items():
+        v = str(v)
+        out[k] = v
+        if not (k.startswith("__") and k.endswith("__")):
+            out[f"__{k}__"] = v
+    return out
+
+
+def var(name: str, shape=None, dtype=None, init=None, attr=None,
+        **kwargs) -> Symbol:
+    """Create a symbolic variable (reference mx.sym.var): ``attr`` dict +
+    keyword attrs (lr_mult=…) land in attr_dict with the reference's
+    dunder mirroring."""
     attrs = {}
     node = SymNode(None, name, attrs, [])
     # AttrScope annotations apply to VARIABLES too (reference symbol.py
     # var merges AttrScope._current.get — per-variable lr_mult/ctx_group
-    # is the primary use of the API); user kwargs win over scope attrs
+    # is the primary use of the API); user attr/kwargs win over scope
     from ..attribute import attr_scope_get
 
-    scoped = attr_scope_get(
-        {k: str(v) for k, v in kwargs.items()} if kwargs else None)
+    user = dict(attr or {})
+    user.update(kwargs)
+    scoped = attr_scope_get(_mirror_attrs(user) if user else None)
     if scoped:
         node.attr_dict.update(scoped)
     if shape is not None:
         node.attr_dict["__shape__"] = str(tuple(shape))
     if dtype is not None:
         node.attr_dict["__dtype__"] = str(dtype)
+    if init is not None:
+        # reference var() stores attr['__init__'] = init.dumps() so the
+        # executor/module layer can construct the right Initializer
+        node.attr_dict["__init__"] = (init.dumps()
+                                      if hasattr(init, "dumps")
+                                      else str(init))
     return Symbol([(node, 0)])
 
 
@@ -705,8 +753,8 @@ def _resolve_num_outputs(schema, attrs) -> int:
 
 
 def _apply_op(op_name: str, inputs: List[Symbol], attrs: dict,
-              name: Optional[str] = None, num_outputs: Optional[int] = None)\
-        -> Symbol:
+              name: Optional[str] = None, num_outputs: Optional[int] = None,
+              attr: Optional[Dict[str, Any]] = None) -> Symbol:
     schema = get_op(op_name)
     in_entries = []
     for s in inputs:
@@ -723,10 +771,11 @@ def _apply_op(op_name: str, inputs: List[Symbol], attrs: dict,
     n_out = num_outputs if num_outputs is not None \
         else _resolve_num_outputs(schema, attrs)
     node = SymNode(schema.name, name, attrs, in_entries, n_out)
-    # AttrScope annotations land in attr_dict (reference attribute.py)
+    # AttrScope annotations land in attr_dict (reference attribute.py);
+    # a per-op attr dict wins over the scope, with dunder mirroring
     from ..attribute import attr_scope_get
 
-    scoped = attr_scope_get(None)
+    scoped = attr_scope_get(_mirror_attrs(attr) if attr else None)
     if scoped:
         node.attr_dict.update(scoped)
     if n_out == 1:

@@ -1,0 +1,158 @@
+"""Symbol attribute semantics (reference
+tests/python/unittest/test_attr.py): AttrScope composition, per-variable
+attr dicts with dunder mirroring, unknown-kwarg routing to node attrs,
+pickling, and the aggregated attr_dict view."""
+import pickle as pkl
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.attribute import AttrScope
+
+
+def test_attr_basic():
+    # reference test_attr_basic
+    with AttrScope(group="4", data="great"):
+        data = sym.var("data", attr={"dtype": "data", "group": "1",
+                                     "force_mirroring": "True"}, lr_mult=1)
+        gdata = sym.var("data2")
+    assert gdata.attr("group") == "4"
+    assert data.attr("group") == "1"            # per-var wins over scope
+    assert data.attr("lr_mult") == "1"
+    assert data.attr("__lr_mult__") == "1"      # dunder mirroring
+    assert data.attr("force_mirroring") == "True"
+    assert data.attr("__force_mirroring__") == "True"
+    data2 = pkl.loads(pkl.dumps(data))
+    assert data.attr("dtype") == data2.attr("dtype")
+
+
+def test_operator_attr_scope():
+    # reference test_operator: nested scopes annotate created op nodes
+    data = sym.var("data")
+    with AttrScope(__group__="4", __data__="great"):
+        fc1 = sym.Activation(data, act_type="relu")
+        with AttrScope(__init_bias__="0.0"):
+            fc2 = sym.FullyConnected(fc1, sym.var("fc2_weight"),
+                                     sym.var("fc2_bias"), num_hidden=10,
+                                     name="fc2")
+    assert fc1.attr("__data__") == "great"
+    assert fc2.attr("__data__") == "great"
+    assert fc2.attr("__init_bias__") == "0.0"
+    fc2copy = pkl.loads(pkl.dumps(fc2))
+    assert fc2copy.tojson() == fc2.tojson()
+    # internals address by name after pickling
+    assert fc2copy.get_internals()["fc2_weight_output"] is not None
+
+
+def _contain(x, y):
+    for k, v in x.items():
+        if k not in y:
+            return False
+        if isinstance(v, dict):
+            if not isinstance(y[k], dict) or not _contain(v, y[k]):
+                return False
+        elif y[k] != v:
+            return False
+    return True
+
+
+def test_list_attr():
+    # reference test_list_attr: attr= + unknown kwargs on an OP call
+    data = sym.var("data", attr={"mood": "angry"})
+    op = sym.Convolution(data, sym.var("conv_weight"), None, name="conv",
+                        kernel=(1, 1), num_filter=1, no_bias=True,
+                        attr={"__mood__": "so so"}, wd_mult="x")
+    assert _contain({"__mood__": "so so", "wd_mult": "x",
+                     "__wd_mult__": "x"}, op.list_attr())
+
+
+def test_attr_dict_aggregated():
+    # reference test_attr_dict: whole-graph {node: attrs} incl. op params
+    data = sym.var("data", attr={"mood": "angry"})
+    op = sym.Convolution(data, sym.var("conv_weight"), None, name="conv",
+                        kernel=(1, 1), num_filter=1, no_bias=True,
+                        attr={"__mood__": "so so"}, lr_mult=1)
+    d = op.attr_dict()
+    assert _contain({
+        "data": {"mood": "angry", "__mood__": "angry"},
+        "conv": {"kernel": "(1, 1)", "__mood__": "so so",
+                 "num_filter": "1", "lr_mult": "1", "__lr_mult__": "1"},
+    }, d)
+
+
+def test_unknown_kwargs_do_not_break_execution():
+    # lr_mult on an op call must not leak into the op's attrs at exec
+    data = sym.var("data")
+    out = sym.Activation(data, act_type="relu", lr_mult=3)
+    (res,) = out.eval(data=nd.array(onp.array([-1.0, 2.0], onp.float32)))
+    onp.testing.assert_allclose(res.asnumpy(), [0.0, 2.0])
+    assert out.attr("lr_mult") == "3"
+
+
+def test_pickle_shared_subgraph_stays_shared():
+    data = sym.var("data")
+    e = sym.exp(data)
+    out = e * e                     # diamond: e consumed twice
+    out2 = pkl.loads(pkl.dumps(out))
+    nodes = out2._topo()
+    # the exp node must appear ONCE (pickle memo preserved sharing)
+    assert sum(1 for n in nodes if n.op == "exp") == 1
+    (r1,) = out.eval(data=nd.ones((2,)))
+    (r2,) = out2.eval(data=nd.ones((2,)))
+    onp.testing.assert_allclose(r1.asnumpy(), r2.asnumpy())
+
+
+def test_custom_op_kwargs_reach_the_prop():
+    # review-caught: a **kwargs op (Custom) must receive hyperparameters
+    # through the symbolic frontend too
+    import mxnet_tpu.operator as op_mod
+
+    class ScaleProp(op_mod.CustomOpProp):
+        def __init__(self, scale):
+            super().__init__()
+            self.scale = float(scale)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            prop = self
+
+            class ScaleOp(op_mod.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                in_data[0] * prop.scale)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                out_grad[0] * prop.scale)
+
+            return ScaleOp()
+
+    op_mod.register("attr_scalemul")(ScaleProp)
+    out = sym.Custom(sym.var("data"), op_type="attr_scalemul", scale=3.0)
+    (res,) = out.eval(data=nd.array(onp.array([1.0, 2.0], onp.float32)))
+    onp.testing.assert_allclose(res.asnumpy(), [3.0, 6.0])
+
+
+def test_typoed_op_param_still_errors():
+    # review-caught: unknown non-annotation kwargs must NOT silently
+    # become node annotations — a typo has to fail at execution
+    import pytest
+
+    x = sym.var("x")
+    bad = sym.Activation(x, act_typo="relu")
+    with pytest.raises(Exception):
+        bad.eval(x=nd.ones((2,)))
+
+
+def test_var_init_attr_stored():
+    init = mx.init.Xavier()
+    w = sym.var("w", init=init)
+    assert "__init__" in w.list_attr()
+    assert "xavier" in w.attr("__init__").lower()

@@ -146,10 +146,46 @@ def section_bn():
               f"{tf:6.1f} TFLOP/s")
 
 
+def section_fused_stats():
+    # A/B: XLA matmul + separate stats reduction vs the Pallas fused
+    # producer+stats kernel (ops/pallas_kernels.matmul_bn_stats) — the
+    # resnet stage-2 1x1-conv texture at bs128 (M = 128*28*28)
+    from mxnet_tpu.ops.pallas_kernels import matmul_bn_stats
+
+    key = jax.random.PRNGKey(3)
+    m, k, n = 128 * 28 * 28, 512, 128
+    x = jax.random.normal(key, (m, k), jnp.bfloat16)
+    w = jax.random.normal(key, (k, n), jnp.bfloat16)
+    flops = 2 * m * k * n
+
+    def xla_ref(x, w):
+        y = jnp.maximum((x @ w), 0)
+        y32 = y.astype(jnp.float32)
+        return y, jnp.sum(y32, 0), jnp.sum(y32 * y32, 0)
+
+    def fence_all(out):
+        y, s, ss = out
+        # keep ALL outputs live on both sides — otherwise XLA dead-code-
+        # eliminates the unfenced reductions and the A/B measures
+        # different work
+        return y.astype(jnp.float32).sum() + s.sum() + ss.sum()
+
+    f = jax.jit(lambda x, w: fence_all(xla_ref(x, w)))
+    dt = timeit(f, x, w, iters=10)
+    base = flops / dt / 1e12
+    print(f"mm+stats XLA:    {dt*1e3:8.2f} ms  {base:6.1f} TFLOP/s  1.00x")
+
+    g = jax.jit(lambda x, w: fence_all(matmul_bn_stats(x, w, relu=True)))
+    dt = timeit(g, x, w, iters=10)
+    tf = flops / dt / 1e12
+    print(f"mm+stats pallas: {dt*1e3:8.2f} ms  {tf:6.1f} TFLOP/s  "
+          f"{tf/base:.2f}x vs XLA")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--which", default="all",
-                    choices=["all", "dot", "conv", "bn", "int8"])
+                    choices=["all", "dot", "conv", "bn", "int8", "fused"])
     args = ap.parse_args()
     print(f"backend: {jax.default_backend()}  {jax.devices()}")
     if args.which in ("all", "dot", "int8"):
@@ -158,6 +194,8 @@ def main():
         section_conv()
     if args.which in ("all", "bn"):
         section_bn()
+    if args.which in ("all", "fused"):
+        section_fused_stats()
 
 
 if __name__ == "__main__":

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "matmul_bn_stats", "conv1x1_bn_stats"]
 
 _NEG_INF = -1e30
 
@@ -284,3 +284,111 @@ def flash_attention(q, k, v, causal=True, sm_scale=None):
     q3, k3, v3 = (t.reshape(bh, s, d) for t in (q, k, v))
     out = _flash(q3, k3, v3, causal, sm_scale)
     return out.reshape(orig_shape)
+
+
+# ---------------------------------------------------------------------------
+# fused matmul + BN-stats epilogue (docs/PERF.md kernel roadmap item 3)
+# ---------------------------------------------------------------------------
+#
+# y = act(x @ w [+ bias]); per-column sum(y) and sum(y*y) accumulated in
+# the SAME kernel — the producing matmul's epilogue computes the batch-norm
+# statistics, removing the separate stats pass (one fewer HBM read of the
+# activation).  This is exactly the fusion XLA cannot express: a reduction
+# folded into a dot's output tiles.  Covers FullyConnected and 1x1-conv
+# (NHWC collapsed to (N*H*W, C)) producers, which carry roughly half of
+# ResNet-50's FLOPs.
+#
+# Reference analog: conv+BN folding exists in the reference only for
+# INFERENCE (MKLDNN subgraph fuser); training-time stats fusion has no
+# reference counterpart — TPU-first design.
+#
+# TPU grid semantics: grid iterations execute sequentially per core
+# ("arbitrary" dimension semantics), so accumulating the (1, N)-tiled
+# stats outputs across m-tiles is race-free by construction.
+
+
+def _mm_stats_kernel(x_ref, w_ref, o_ref, s_ref, ss_ref, *, relu, k_tiles,
+                     block_k):
+    # m is the INNER grid dim: the same (1, block_n) stats block is then
+    # revisited on consecutive grid steps, which is the only pattern whose
+    # VMEM contents Pallas guarantees to persist for read-modify-write
+    mi = pl.program_id(1)
+
+    def body(ki, acc):
+        xk = x_ref[:, pl.ds(ki * block_k, block_k)].astype(jnp.float32)
+        wk = w_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        return acc + xk @ wk
+
+    acc = jax.lax.fori_loop(
+        0, k_tiles, body,
+        jnp.zeros((x_ref.shape[0], w_ref.shape[1]), jnp.float32))
+    if relu:
+        acc = jnp.maximum(acc, 0.0)
+    o_ref[...] = acc.astype(o_ref.dtype)
+    part = jnp.sum(acc, axis=0, keepdims=True)          # (1, N_block)
+    part_sq = jnp.sum(acc * acc, axis=0, keepdims=True)
+
+    @pl.when(mi == 0)
+    def _init():
+        s_ref[...] = part
+        ss_ref[...] = part_sq
+
+    @pl.when(mi != 0)
+    def _accum():
+        s_ref[...] += part
+        ss_ref[...] += part_sq
+
+
+def matmul_bn_stats(x, w, relu=False, block_m=256, block_n=256,
+                    block_k=512):
+    """``y = act(x @ w)`` plus per-column ``sum(y)``/``sum(y*y)`` in one
+    kernel pass.  x: (M, K), w: (K, N) -> (y: (M, N), s: (N,), ss: (N,)),
+    stats in fp32.  M/K/N must be divisible by the (clamped) block sizes.
+    Wrap 1x1 convs by collapsing NHWC to (N*H*W, C)."""
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2, (x.shape, w.shape)
+    block_m = min(block_m, m)
+    block_n = min(block_n, n)
+    block_k = min(block_k, k)
+    assert m % block_m == 0 and n % block_n == 0 and k % block_k == 0, (
+        (m, k, n), (block_m, block_k, block_n))
+    grid = (n // block_n, m // block_m)       # m innermost (see kernel)
+    kernel = functools.partial(_mm_stats_kernel, relu=relu,
+                               k_tiles=k // block_k, block_k=block_k)
+    y, s, ss = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda ni, mi: (mi, 0)),
+            pl.BlockSpec((k, block_n), lambda ni, mi: (0, ni)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_m, block_n), lambda ni, mi: (mi, ni)),
+            pl.BlockSpec((1, block_n), lambda ni, mi: (0, ni)),
+            pl.BlockSpec((1, block_n), lambda ni, mi: (0, ni)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), x.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, w)
+    return y, s[0], ss[0]
+
+
+def conv1x1_bn_stats(x, w, relu=False, **blocks):
+    """1x1-conv producer + BN-stats epilogue: x (N,H,W,Cin) NHWC,
+    w (Cout,1,1,Cin) OHWI -> (y (N,H,W,Cout), mean (Cout,), var (Cout,)).
+    The mean/var are the batch statistics BatchNorm(training=True) needs —
+    computed without re-reading y from HBM."""
+    n, h, wd, cin = x.shape
+    cout = w.shape[0]
+    x2 = x.reshape(n * h * wd, cin)
+    w2 = w.reshape(cout, cin).T                  # (Cin, Cout)
+    y, s, ss = matmul_bn_stats(x2, w2, relu=relu, **blocks)
+    cnt = jnp.float32(n * h * wd)
+    mean = s / cnt
+    var = jnp.maximum(ss / cnt - mean * mean, 0.0)
+    return y.reshape(n, h, wd, cout), mean, var

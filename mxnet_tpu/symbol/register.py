@@ -25,9 +25,7 @@ def _split_attr_kwargs(attrs, kwargs, attr_names, has_var_kw=False):
 
     extra = dict(kwargs.pop("attr", None) or {})
     for k, v in kwargs.items():
-        if k not in attr_names and not has_var_kw and (
-                _is_annotation_key(k)
-                or (k.startswith("__") and k.endswith("__"))):
+        if k not in attr_names and not has_var_kw and _is_annotation_key(k):
             extra[k] = v
         else:
             attrs[k] = v

@@ -252,7 +252,7 @@ class Symbol:
         for n in self._topo():
             d: Dict[str, str] = {}
             for k, v in n.attrs.items():
-                d[k] = str(v)
+                d[k] = _ref_attr_str(v)     # same spelling as tojson
             d.update(n.attr_dict)
             if d:
                 out[n.name] = d

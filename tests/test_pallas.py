@@ -100,3 +100,67 @@ def test_transformer_uses_flash_when_forced():
     out_ref, _ = models.forward(params, toks, cfg_ref)
     onp.testing.assert_allclose(onp.asarray(out_flash),
                                 onp.asarray(out_ref), rtol=2e-4, atol=2e-4)
+
+
+def test_matmul_bn_stats_matches_xla():
+    # fused producer+stats kernel (docs/PERF.md roadmap 3): numerics must
+    # match the unfused XLA formulation exactly enough for BN
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_kernels import matmul_bn_stats
+
+    rng = onp.random.RandomState(0)
+    x = jnp.asarray(rng.randn(64, 32).astype(onp.float32))
+    w = jnp.asarray(rng.randn(32, 16).astype(onp.float32))
+    for relu in (False, True):
+        y, s, ss = matmul_bn_stats(x, w, relu=relu, block_m=32,
+                                   block_n=16, block_k=16)
+        ref = x @ w
+        if relu:
+            ref = jnp.maximum(ref, 0.0)
+        onp.testing.assert_allclose(onp.asarray(y), onp.asarray(ref),
+                                    rtol=1e-5, atol=1e-5)
+        onp.testing.assert_allclose(onp.asarray(s), onp.asarray(
+            ref.sum(0)), rtol=1e-4, atol=1e-3)
+        onp.testing.assert_allclose(onp.asarray(ss), onp.asarray(
+            (ref * ref).sum(0)), rtol=1e-4, atol=1e-3)
+
+
+def test_conv1x1_bn_stats_matches_batchnorm_math():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_kernels import conv1x1_bn_stats
+
+    rng = onp.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 4, 4, 32).astype(onp.float32))
+    w = jnp.asarray(rng.randn(16, 1, 1, 32).astype(onp.float32))
+    y, mean, var = conv1x1_bn_stats(x, w, block_m=16, block_n=16,
+                                    block_k=16)
+    ref = jnp.einsum("nhwc,oc->nhwo", x, w.reshape(16, 32))
+    onp.testing.assert_allclose(onp.asarray(y), onp.asarray(ref),
+                                rtol=1e-4, atol=1e-4)
+    flat = onp.asarray(ref).reshape(-1, 16)
+    onp.testing.assert_allclose(onp.asarray(mean), flat.mean(0),
+                                rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(onp.asarray(var), flat.var(0),
+                                rtol=1e-3, atol=1e-3)
+
+
+def test_matmul_bn_stats_multi_tile_grid():
+    # n_tiles > 1 AND m_tiles > 1: exercises the stats-block revisit
+    # pattern (m innermost) that real-TPU buffer residency requires
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_kernels import matmul_bn_stats
+
+    rng = onp.random.RandomState(5)
+    x = jnp.asarray(rng.randn(96, 64).astype(onp.float32))
+    w = jnp.asarray(rng.randn(64, 48).astype(onp.float32))
+    y, s, ss = matmul_bn_stats(x, w, block_m=32, block_n=16, block_k=32)
+    ref = onp.asarray(x) @ onp.asarray(w)
+    onp.testing.assert_allclose(onp.asarray(y), ref, rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(s), ref.sum(0), rtol=1e-4,
+                                atol=1e-3)
+    onp.testing.assert_allclose(onp.asarray(ss), (ref * ref).sum(0),
+                                rtol=1e-4, atol=1e-3)

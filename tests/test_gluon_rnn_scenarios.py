@@ -1,0 +1,159 @@
+"""Gluon RNN cell-zoo scenarios (reference
+tests/python/unittest/test_gluon_rnn.py families not yet mirrored):
+residual/bidirectional composition, sequential stacking, layout variants,
+valid_length masking, zoneout stochasticity, export/import round trips,
+deferred shape fill."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import rnn
+
+
+def _x(b=3, t=5, c=8, seed=0):
+    return nd.array(onp.random.RandomState(seed).rand(b, t, c)
+                    .astype(onp.float32))
+
+
+def test_residual_cell_adds_input():
+    # reference test_residual: out = inner(x) + x
+    inner = rnn.GRUCell(8, input_size=8)
+    cell = rnn.ResidualCell(inner)
+    cell.initialize()
+    x = _x()
+    outs, _ = cell.unroll(5, x, merge_outputs=True)
+    inner2 = rnn.GRUCell(8, input_size=8)
+    inner2.initialize()
+    # copy params for an exact oracle
+    for p1, p2 in zip(inner.collect_params().values(),
+                      inner2.collect_params().values()):
+        p2.set_data(p1.data())
+    ref, _ = inner2.unroll(5, x, merge_outputs=True)
+    onp.testing.assert_allclose(outs.asnumpy(), ref.asnumpy() + x.asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_residual_bidirectional_unroll():
+    # reference test_residual_bidirectional: residual over a bidir cell
+    cell = rnn.BidirectionalCell(rnn.GRUCell(4, input_size=8),
+                                 rnn.GRUCell(4, input_size=8))
+    cell.initialize()
+    x = _x(c=8)
+    outs, states = cell.unroll(5, x, merge_outputs=True)
+    assert outs.shape == (3, 5, 8)          # fwd 4 + bwd 4 concat
+    assert len(states) >= 2
+
+
+def test_sequential_rnn_cells_stack():
+    # reference test_sequential_rnn_cells / test_stack
+    seq = rnn.SequentialRNNCell()
+    seq.add(rnn.LSTMCell(8, input_size=8))
+    seq.add(rnn.GRUCell(6, input_size=8))
+    seq.add(rnn.RNNCell(4, input_size=6))
+    seq.initialize()
+    x = _x(c=8)
+    outs, states = seq.unroll(5, x, merge_outputs=True)
+    assert outs.shape == (3, 5, 4)
+    # states: lstm (h, c) + gru (h,) + rnn (h,)
+    flat = [s for s in states]
+    assert len(flat) == 4
+
+
+def test_unroll_layout_tnc_matches_ntc():
+    # reference test_unroll_layout: same math, transposed IO
+    cell = rnn.LSTMCell(7, input_size=8)
+    cell.initialize()
+    x = _x(c=8)
+    out_ntc, _ = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    x_tnc = nd.array(x.asnumpy().transpose(1, 0, 2))
+    out_tnc, _ = cell.unroll(5, x_tnc, layout="TNC", merge_outputs=True)
+    onp.testing.assert_allclose(out_tnc.asnumpy().transpose(1, 0, 2),
+                                out_ntc.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_unroll_valid_length_freezes_states():
+    # reference test_rnn_unroll_variant_length: outputs past valid_length
+    # are zeroed; states freeze at each sample's last valid step
+    cell = rnn.GRUCell(6, input_size=8)
+    cell.initialize()
+    x = _x(b=4, t=5, c=8)
+    vl = nd.array(onp.array([5, 3, 1, 4], onp.float32))
+    outs, states = cell.unroll(5, x, valid_length=vl, merge_outputs=True)
+    o = outs.asnumpy()
+    assert (o[1, 3:] == 0).all() and (o[2, 1:] == 0).all()
+    assert (o[0] != 0).any()
+    # frozen state equals the unmasked state at the valid step
+    outs_full, _ = cell.unroll(3, nd.array(x.asnumpy()[:, :3]),
+                               merge_outputs=True)
+    onp.testing.assert_allclose(states[0].asnumpy()[1],
+                                outs_full.asnumpy()[1, 2], rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_zoneout_cell_stochastic_but_bounded():
+    # reference test_zoneout: outputs interpolate between prev/new state
+    cell = rnn.ZoneoutCell(rnn.RNNCell(8, input_size=8),
+                           zoneout_outputs=0.5, zoneout_states=0.5)
+    cell.initialize()
+    x = _x(c=8)
+    mx.random.seed(1)
+    with autograd.record(train_mode=True):
+        o1, _ = cell.unroll(5, x, merge_outputs=True)
+    mx.random.seed(2)
+    with autograd.record(train_mode=True):
+        o2, _ = cell.unroll(5, x, merge_outputs=True)
+    assert (o1.asnumpy() != o2.asnumpy()).any()   # stochastic under train
+
+
+def test_rnn_cells_export_import():
+    # reference test_rnn_cells_export_import: save/load params round trip
+    cell = rnn.SequentialRNNCell()
+    cell.add(rnn.LSTMCell(8, input_size=8))
+    cell.add(rnn.GRUCell(4, input_size=8))
+    cell.initialize()
+    x = _x(c=8)
+    ref, _ = cell.unroll(5, x, merge_outputs=True)
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".params") as f:
+        cell.save_parameters(f.name)
+        cell2 = rnn.SequentialRNNCell()
+        cell2.add(rnn.LSTMCell(8, input_size=8))
+        cell2.add(rnn.GRUCell(4, input_size=8))
+        cell2.load_parameters(f.name)
+        got, _ = cell2.unroll(5, x, merge_outputs=True)
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), rtol=1e-6)
+
+
+def test_cell_fill_shape_deferred():
+    # reference test_cell_fill_shape: input_size deduced on first call
+    cell = rnn.LSTMCell(8)
+    cell.initialize()
+    x = _x(c=11)
+    outs, _ = cell.unroll(5, x, merge_outputs=True)
+    assert outs.shape == (3, 5, 8)
+    assert cell.collect_params()["i2h_weight"].shape[1] == 11
+
+
+def test_dropout_cell_train_vs_predict():
+    cell = rnn.DropoutCell(0.5)
+    cell.initialize()
+    x = _x(c=8)
+    with autograd.record(train_mode=True):
+        o_train, _ = cell.unroll(5, x, merge_outputs=True)
+    o_pred, _ = cell.unroll(5, x, merge_outputs=True)
+    assert (o_pred.asnumpy() == x.asnumpy()).all()
+    assert (o_train.asnumpy() == 0).any()
+
+
+def test_bidirectional_unroll_valid_length():
+    # reference test_bidirectional_unroll_valid_length
+    cell = rnn.BidirectionalCell(rnn.GRUCell(4, input_size=8),
+                                 rnn.GRUCell(4, input_size=8))
+    cell.initialize()
+    x = _x(b=4, t=5, c=8)
+    vl = nd.array(onp.array([5, 3, 1, 4], onp.float32))
+    outs, _ = cell.unroll(5, x, valid_length=vl, merge_outputs=True)
+    o = outs.asnumpy()
+    assert o.shape == (4, 5, 8)
+    assert (o[2, 1:] == 0).all()

@@ -1,0 +1,72 @@
+"""KVStore sharding/bucketing edge cases (reference
+tests/nightly/dist_sync_kvstore.py big_shape + MXNET_KVSTORE_BIGARRAY_BOUND
+assertions, kvstore_dist.h:44 EncodeDefaultKey splitting)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, nd
+
+
+def test_bigarray_bound_push_pull_equivalence(monkeypatch):
+    # arrays above the bound take their own collective; values must be
+    # IDENTICAL to the small-array path (the reference asserts the same
+    # sums across its big_shape/little_shape pairs)
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "64")
+    config.refresh("MXNET_KVSTORE_BIGARRAY_BOUND")
+    try:
+        kv = mx.kv.create("local")
+        rng = onp.random.RandomState(0)
+        small = rng.rand(4, 4).astype(onp.float32)          # 16 < 64
+        big = rng.rand(32, 8).astype(onp.float32)           # 256 > 64
+        kv.init("small", nd.zeros(small.shape))
+        kv.init("big", nd.zeros(big.shape))
+        kv.push(["small", "big"], [nd.array(small), nd.array(big)])
+        out_s, out_b = nd.zeros(small.shape), nd.zeros(big.shape)
+        kv.pull("small", out=out_s)
+        kv.pull("big", out=out_b)
+        onp.testing.assert_allclose(out_s.asnumpy(), small, rtol=1e-6)
+        onp.testing.assert_allclose(out_b.asnumpy(), big, rtol=1e-6)
+    finally:
+        config.refresh("MXNET_KVSTORE_BIGARRAY_BOUND")
+
+
+def test_mixed_dtype_push_buckets_dont_mix():
+    # fp32 and fp16 keys pushed together must not be flattened into one
+    # buffer (dtype buckets are separate by construction)
+    kv = mx.kv.create("local")
+    a = onp.ones((8,), onp.float32) * 1.5
+    b = onp.ones((8,), onp.float16) * 2.0
+    kv.init("a32", nd.zeros((8,)))
+    kv.init("b16", nd.zeros((8,), dtype="float16"))
+    kv.push(["a32", "b16"], [nd.array(a), nd.array(b, dtype="float16")])
+    oa, ob = nd.zeros((8,)), nd.zeros((8,), dtype="float16")
+    kv.pull("a32", out=oa)
+    kv.pull("b16", out=ob)
+    onp.testing.assert_allclose(oa.asnumpy(), a)
+    onp.testing.assert_allclose(ob.asnumpy().astype(onp.float32),
+                                b.astype(onp.float32))
+
+
+def test_many_keys_one_push_order_stable():
+    # bucketed multi-key push keeps key->value association (offset math)
+    kv = mx.kv.create("local")
+    keys = [f"k{i}" for i in range(7)]
+    vals = [onp.full((3, i + 1), float(i), onp.float32) for i in range(7)]
+    for k, v in zip(keys, vals):
+        kv.init(k, nd.zeros(v.shape))
+    kv.push(keys, [nd.array(v) for v in vals])
+    for k, v in zip(keys, vals):
+        out = nd.zeros(v.shape)
+        kv.pull(k, out=out)
+        onp.testing.assert_allclose(out.asnumpy(), v)
+
+
+def test_push_aggregates_multiple_device_values():
+    # reference: pushing a LIST of per-device grads reduces them
+    kv = mx.kv.create("local")
+    kv.init("g", nd.zeros((4,)))
+    kv.push("g", [nd.ones((4,)), nd.ones((4,)) * 2])
+    out = nd.zeros((4,))
+    kv.pull("g", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((4,), 3.0))

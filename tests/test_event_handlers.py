@@ -1,0 +1,138 @@
+"""Estimator event-handler contracts (reference
+tests/python/unittest/test_gluon_event_handler.py): checkpoint files +
+resume, early stopping, logging cadence, validation handler, custom
+handler ordering."""
+import logging
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric, nd
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                               EarlyStoppingHandler,
+                                               Estimator, LoggingHandler,
+                                               ValidationHandler)
+
+
+def _setup(seed=0, n=48):
+    rng = onp.random.RandomState(seed)
+    X = rng.rand(n, 6).astype(onp.float32)
+    w = rng.rand(6, 1)
+    y = (X @ w).astype(onp.float32)
+    dl = gdata.DataLoader(gdata.ArrayDataset(X, y), batch_size=12)
+    net = nn.Dense(1)
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05})
+    est = Estimator(net, gloss.L2Loss(), train_metrics=metric.MAE(),
+                    trainer=tr)
+    return est, dl, net
+
+
+def test_checkpoint_handler_epoch_files(tmp_path):
+    # reference test_checkpoint_handler: per-epoch files + trainer states
+    est, dl, _ = _setup()
+    ckpt = CheckpointHandler(str(tmp_path), save_best=False)
+    est.fit(dl, epochs=3, event_handlers=[ckpt])
+    files = sorted(os.listdir(str(tmp_path)))
+    assert any("epoch1" in f for f in files), files
+    assert any("epoch3" in f for f in files), files
+
+
+def test_resume_checkpoint(tmp_path):
+    # reference test_resume_checkpoint: load epoch-N params into a fresh
+    # net and keep training
+    est, dl, net = _setup(seed=1)
+    ckpt = CheckpointHandler(str(tmp_path), save_best=False)
+    est.fit(dl, epochs=2, event_handlers=[ckpt])
+    param_file = [f for f in os.listdir(str(tmp_path))
+                  if f.endswith("epoch2.params")][0]
+
+    net2 = nn.Dense(1)
+    net2.load_parameters(os.path.join(str(tmp_path), param_file))
+    x = nd.array(onp.random.RandomState(3).rand(4, 6).astype(onp.float32))
+    onp.testing.assert_allclose(net2(x).asnumpy(), net(x).asnumpy(),
+                                rtol=1e-6)
+    # resumed training still works
+    tr2 = mx.gluon.Trainer(net2.collect_params(), "sgd",
+                           {"learning_rate": 0.05})
+    est2 = Estimator(net2, gloss.L2Loss(), train_metrics=metric.MAE(),
+                     trainer=tr2)
+    est2.fit(dl, epochs=1)
+
+
+def test_early_stopping_triggers():
+    # reference test_early_stopping: monitor plateaus -> fit ends early
+    est, dl, _ = _setup(seed=2)
+
+    class ConstantMetric:
+        def get(self):
+            return ("const", 1.0)
+
+    stop = EarlyStoppingHandler(monitor=est.train_loss_metric,
+                                patience=1, mode="min", min_delta=10.0)
+    est.fit(dl, epochs=8, event_handlers=[stop])
+    assert getattr(stop, "stopped_epoch", 8) < 8
+
+
+def test_logging_handler_cadence(caplog):
+    est, dl, _ = _setup(seed=3)
+    with caplog.at_level(logging.INFO):
+        est.fit(dl, epochs=2,
+                event_handlers=[LoggingHandler(log_interval=1)])
+    text = caplog.text.lower()
+    assert "epoch" in text
+    assert "batch" in text                   # per-interval batch lines
+    assert "finished in" in text             # epoch + train summaries
+
+
+def test_validation_handler_runs_eval():
+    est, dl, _ = _setup(seed=4)
+    seen = []
+
+    class Spy:
+        def __call__(self, *a, **k):
+            seen.append(1)
+
+    vh = ValidationHandler(dl, eval_fn=lambda *a, **k: seen.append(1))
+    est.fit(dl, epochs=2, event_handlers=[vh])
+    assert seen, "validation handler never ran its eval_fn"
+
+
+def test_custom_handler_all_stages():
+    # reference test_custom_handler: user handler sees every lifecycle
+    from mxnet_tpu.gluon.contrib.estimator import (BatchBegin, BatchEnd,
+                                                   EpochBegin, EpochEnd,
+                                                   TrainBegin, TrainEnd)
+
+    calls = []
+
+    class Spy(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+              BatchEnd):
+        def train_begin(self, estimator, *a, **k):
+            calls.append("train_begin")
+
+        def train_end(self, estimator, *a, **k):
+            calls.append("train_end")
+
+        def epoch_begin(self, estimator, *a, **k):
+            calls.append("epoch_begin")
+
+        def epoch_end(self, estimator, *a, **k):
+            calls.append("epoch_end")
+
+        def batch_begin(self, estimator, *a, **k):
+            calls.append("batch_begin")
+
+        def batch_end(self, estimator, *a, **k):
+            calls.append("batch_end")
+
+    est, dl, _ = _setup(seed=5)
+    est.fit(dl, epochs=1, event_handlers=[Spy()])
+    assert calls[0] == "train_begin" and calls[-1] == "train_end"
+    assert "epoch_begin" in calls and "batch_end" in calls

@@ -567,6 +567,18 @@ def _run_lane_child(name: str) -> None:
     try:
         import jax
 
+        # persistent XLA cache for DIRECT `--lane` invocations too (the
+        # parent sets the env var for spawned children, but a user-run
+        # lane would otherwise cold-compile and cache nothing; the env
+        # var alone is too late here — sitecustomize imports jax first)
+        if jax.config.jax_compilation_cache_dir is None:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              5)
+
         on_cpu = jax.default_backend() == "cpu"
         fn, metric = _resolve_lane(name)
         lane = fn(on_cpu)

@@ -534,15 +534,21 @@ class HybridBlock(Block):
         from ..ndarray import ndarray as _ndmod
 
         ctx = in_leaves[0].ctx if in_leaves else current_context()
+        # array FLAVOR of the call (np vs legacy nd) is part of the
+        # signature: the trace wraps its tracers in that flavor so
+        # flavor-sensitive semantics inside forward (np comparisons yield
+        # bool; nd yields float 0/1) match the eager path exactly
+        out_cls = _ndmod._flavor_of(in_leaves)
         # ctx is part of the signature: the trace wraps its tracers in
         # that ctx so layers doing ``weight.data(x.ctx)`` resolve a
         # replica that actually exists (a net re-homed by reset_ctx and
         # called on the new device would otherwise trace against the
         # stale default ctx and fail the replica lookup)
-        sig = (training, _ndmod._amp_generation, _struct_key(in_struct), ctx)
+        sig = (training, _ndmod._amp_generation, _struct_key(in_struct),
+               ctx, out_cls)
         rec = self._cached.get(sig)
         if rec is None:
-            rec = self._build_cache(in_struct, training, ctx)
+            rec = self._build_cache(in_struct, training, ctx, out_cls)
             self._cached[sig] = rec
         jitted, names, params, ctx_idx, out_struct, mutated_names = rec
         param_arrays = [params[n]._data[_ctx_index(params[n], ctx)]._data
@@ -586,19 +592,19 @@ class HybridBlock(Block):
             )
             out_nd = []
             for i, o in enumerate(out_arrays):
-                w = _wrap(o, ctx)
+                w = _wrap(o, ctx, out_cls)
                 w._ag_node = node
                 w._ag_out_index = i
                 out_nd.append(w)
         else:
             out_arrays, mut_vals = jitted(param_arrays, input_arrays, key)
-            out_nd = [_wrap(o, ctx) for o in out_arrays]
+            out_nd = [_wrap(o, ctx, out_cls) for o in out_arrays]
 
         for n, v in zip(mutated_names, mut_vals):
             params[n]._data[_ctx_index(params[n], ctx)]._set_data(v)
         return _rebuild_output(out_struct[0], out_nd)
 
-    def _build_cache(self, in_struct, training, ctx=None):
+    def _build_cache(self, in_struct, training, ctx=None, flavor=None):
         wrap_ctx = ctx or current_context()
         params = OrderedDict(
             (n, p) for n, p in self.collect_params().items() if p._data is not None
@@ -619,7 +625,7 @@ class HybridBlock(Block):
             prev_rec = autograd.set_recording(False)
             prev_train = autograd.set_training(training)
             try:
-                leaves = [_wrap(a, wrap_ctx) for a in input_arrays]
+                leaves = [_wrap(a, wrap_ctx, flavor) for a in input_arrays]
                 call_args = _unflatten_args(in_struct, leaves)
                 out = block.forward(*call_args)
             finally:

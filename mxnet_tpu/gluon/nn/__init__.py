@@ -6,3 +6,7 @@ from .conv_layers import *
 from . import activations, basic_layers, conv_layers
 
 __all__ = activations.__all__ + basic_layers.__all__ + conv_layers.__all__
+
+# user code commonly subclasses via gluon.nn (reference exposes these
+# through the block module; migration code writes mx.gluon.nn.HybridBlock)
+from ..block import Block, HybridBlock  # noqa: E402,F401

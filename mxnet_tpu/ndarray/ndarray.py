@@ -587,6 +587,18 @@ def _on_tape(arr) -> bool:
     )
 
 
+def _flavor_of(inputs) -> type:
+    """The array FLAVOR a computation's outputs should carry: first input
+    that is an NDArray subclass (mx.np ndarray) wins, else legacy NDArray.
+    One rule for the eager invoke path and the hybridized trace — flavors
+    differ semantically (np comparisons yield bool; nd yields float 0/1),
+    so they must never drift apart."""
+    for i in inputs:
+        if isinstance(i, NDArray) and type(i) is not NDArray:
+            return type(i)
+    return NDArray
+
+
 def _wrap(data: jax.Array, ctx: Context, cls=None) -> "NDArray":
     out = (cls or NDArray).__new__(cls or NDArray)
     out._data = data
@@ -703,11 +715,7 @@ def _invoke_body(schema, ctx, arrays, inputs, attrs, out):
     # outputs keep the array *flavor* of the inputs: dispatching an op on an
     # mx.np ndarray yields mx.np ndarrays (reference keeps np/nd worlds apart
     # via distinct generated namespaces; here one registry serves both)
-    out_cls = NDArray
-    for i in inputs:
-        if isinstance(i, NDArray) and type(i) is not NDArray:
-            out_cls = type(i)
-            break
+    out_cls = _flavor_of(inputs)
     outputs = [_wrap(o, ctx, out_cls) for o in outs_raw]
 
     if _engine.is_naive():

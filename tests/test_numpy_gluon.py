@@ -1,0 +1,140 @@
+"""np-array <-> Gluon interplay (reference
+tests/python/unittest/test_numpy_gluon.py): array flavor follows the
+input through blocks and hybridize, np inputs train end to end,
+zero_grad, np constants, boolean dtypes through hybridized graphs."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import numpy as np
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.numpy.multiarray import ndarray as np_ndarray
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_np_flavor_flows_through_block(hybridize):
+    # reference test_create_np_param flavor half: an np input yields np
+    # outputs through a (hybridized) block
+    net = nn.Dense(4, in_units=6)
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    out_nd = net(nd.ones((2, 6)))
+    assert not isinstance(out_nd, np_ndarray)
+    out_np = net(np.ones((2, 6)))
+    assert isinstance(out_np, np_ndarray)
+    onp.testing.assert_allclose(out_np.asnumpy(), out_nd.asnumpy(),
+                                rtol=1e-6)
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_np_inputs_train_end_to_end(hybridize):
+    # reference test_optimizer_with_np_ndarrays
+    rng = onp.random.RandomState(0)
+    X = np.array(rng.rand(32, 5).astype(onp.float32))
+    w = rng.rand(5, 1)
+    y = np.array((rng.rand(32, 5) @ w).astype(onp.float32))
+    net = nn.Dense(1, in_units=5)
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+    first = None
+    for _ in range(25):
+        with autograd.record():
+            loss = ((net(X) - y) ** 2).mean()
+        loss.backward()
+        tr.step(32)
+        if first is None:
+            first = float(loss.asnumpy())
+    assert float(loss.asnumpy()) < first
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_parameters_zero_grad(hybridize):
+    # reference test_parameters_zero_grad
+    net = nn.HybridSequential()
+    for _ in range(3):
+        net.add(nn.Dense(10))
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    net(np.ones((8, 4)))
+    with autograd.record():
+        loss = (net(np.ones((8, 4))) ** 2).sum()
+    loss.backward()
+    assert any(float(onp.abs(v.grad().asnumpy()).sum()) > 0
+               for v in net.collect_params().values())
+    net.zero_grad()
+    for v in net.collect_params().values():
+        onp.testing.assert_allclose(v.grad().asnumpy(), 0.0)
+
+
+def test_np_constant_in_block():
+    # reference test_np_get_constant
+    class WithConst(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.const = mx.gluon.Constant(
+                onp.arange(6, dtype=onp.float32).reshape(2, 3))
+
+        def forward(self, x):
+            return x + self.const.data()
+
+    net = WithConst()
+    net.initialize()
+    out = net(np.zeros((2, 3)))
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.arange(6).reshape(2, 3))
+    # constants never receive gradients
+    x = np.ones((2, 3))
+    xa = x
+    with autograd.record():
+        loss = net(xa).sum()
+    loss.backward()
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_hybridize_boolean_dtype(hybridize):
+    # reference test_hybridize_boolean_dtype + the flavor contract: the
+    # SAME forward must see np semantics under the trace (comparison
+    # yields bool) when called with np arrays, and legacy nd semantics
+    # (float 0/1) with nd arrays — eager and hybridized identically
+    class CmpBlock(nn.HybridBlock):
+        def forward(self, x):
+            return x > 2.0
+
+    net = CmpBlock()
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    x_np = np.array(onp.array([[1.0, 2.0], [3.0, 4.0]], onp.float32))
+    out_np = net(x_np)
+    assert isinstance(out_np, np_ndarray)
+    assert out_np.dtype == onp.bool_, out_np.dtype
+    onp.testing.assert_array_equal(out_np.asnumpy(),
+                                   [[False, False], [True, True]])
+    x_nd = nd.array(onp.array([[1.0, 2.0], [3.0, 4.0]], onp.float32))
+    out_nd = net(x_nd)
+    assert not isinstance(out_nd, np_ndarray)
+    assert out_nd.dtype == onp.float32       # legacy 0/1 floats
+    onp.testing.assert_allclose(out_nd.asnumpy(), [[0, 0], [1, 1]])
+
+
+def test_np_save_load_round_trip(tmp_path):
+    # reference check_gluon_save_load shape
+    import os
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4, activation="relu"), nn.Dense(2))
+    net.initialize()
+    x = np.ones((3, 4))
+    ref = net(x).asnumpy()
+    p = os.path.join(str(tmp_path), "net.params")
+    net.save_parameters(p)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, in_units=4, activation="relu"), nn.Dense(2))
+    net2.load_parameters(p)
+    onp.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-6)

@@ -502,3 +502,34 @@ def test_pp_ragged_batch_pad_and_mask():
     onp.testing.assert_allclose(
         onp.asarray(g0["layer0.attn.qkv.weight"]),
         onp.asarray(g_ref["layer0.attn.qkv.weight"]), atol=1e-4)
+
+
+def test_sharded_trainer_remat_under_dp8():
+    # remat (jax.checkpoint) must be schedule-only under REAL shardings
+    # too: dp=8 with and without recompute produce identical losses
+    from mxnet_tpu.gluon import nn
+
+    def build():
+        net = nn.Dense(4, in_units=8)
+        net.initialize(mx.init.Xavier())
+        return net
+
+    def loss_fn(out, label):
+        diff = out - label
+        return (diff * diff).mean()
+
+    rng = onp.random.RandomState(9)
+    data = rng.randn(16, 8).astype(onp.float32)
+    label = rng.randn(16, 4).astype(onp.float32)
+
+    losses = []
+    for remat in (False, True):
+        mx.random.seed(3)
+        net = build()
+        mesh = par.make_mesh({"dp": 8})
+        tr = par.ShardedTrainer(net, loss_fn, mesh, optimizer="sgd",
+                                optimizer_params={"lr": 0.1},
+                                remat=remat)
+        run = [float(tr.step(data, label)) for _ in range(3)]
+        losses.append(run)
+    onp.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)

@@ -47,7 +47,9 @@ class KVStoreBase:
 
     @property
     def type(self):
-        raise NotImplementedError
+        # registered name (reference kv.type == 'teststore' for a custom
+        # plugin class TestStore); plugins may override
+        return type(self).__name__.lower()
 
     @property
     def rank(self):

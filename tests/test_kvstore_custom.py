@@ -1,0 +1,127 @@
+"""Custom KVStore plugin registry (reference
+tests/python/unittest/test_kvstore_custom.py): a user-registered
+KVStoreBase backend serves broadcast/pushpull through mx.kv.create, with
+the capability protocol and built-in-store equivalence."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.kvstore.base import KVStoreBase
+
+SHAPE = (4, 4)
+
+
+def _register_teststore():
+    if "teststore" in getattr(KVStoreBase, "kv_registry", {}) or \
+            "teststore" in getattr(KVStoreBase, "_registry", {}):
+        return
+
+    @KVStoreBase.register
+    class TestStore(KVStoreBase):
+        """Minimal python store: broadcast copies, pushpull sums."""
+
+        def __init__(self):
+            self._store = {}
+
+        def broadcast(self, key, value, out, priority=0):
+            keys = key if isinstance(key, (list, tuple)) else [key]
+            vals = value if isinstance(value, (list, tuple)) else [value]
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            if len(keys) == 1:
+                vals = [vals[0]] if not isinstance(value, (list, tuple)) \
+                    else [value[0]]
+            for k, v in zip(keys, vals if len(vals) == len(keys)
+                            else vals * len(keys)):
+                self._store[str(k)] = v.asnumpy()
+            flat = []
+
+            def collect(o):
+                if isinstance(o, (list, tuple)):
+                    for x in o:
+                        collect(x)
+                else:
+                    flat.append(o)
+
+            collect(outs)
+            for i, o in enumerate(flat):
+                k = keys[min(i * len(keys) // max(len(flat), 1),
+                             len(keys) - 1)]
+                o._set_data(nd.array(self._store[str(k)])._data)
+
+        def pushpull(self, key, value, out=None, priority=0):
+            keys = key if isinstance(key, (list, tuple)) else [key]
+            vals = value if isinstance(value, (list, tuple)) else [value]
+            total = sum(v.asnumpy() for v in vals)
+            for k in set(map(str, keys)):
+                self._store[str(k)] = total
+            if out is not None:
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                for o in outs:
+                    o._set_data(nd.array(total)._data)
+            else:
+                for v in vals:
+                    v._set_data(nd.array(total)._data)
+
+        @staticmethod
+        def is_capable(capability):
+            return False
+
+    return TestStore
+
+
+def test_custom_store_registers_and_creates():
+    _register_teststore()
+    kv = mx.kv.create("teststore")
+    assert kv.type == "teststore" or type(kv).__name__ == "TestStore"
+
+
+def test_custom_store_broadcast_and_pushpull():
+    # reference test_custom_store
+    _register_teststore()
+    kv = mx.kv.create("teststore")
+    out = nd.zeros((1,))
+    kv.broadcast(1, nd.ones((1,)), out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 1.0)
+    assert type(kv).is_capable("optimizer") is False
+    arr_list = [nd.zeros((1,)), nd.zeros((1,))]
+    kv.pushpull(1, [nd.ones((1,)), nd.ones((1,))], out=arr_list)
+    for a in arr_list:
+        onp.testing.assert_allclose(a.asnumpy(), 2.0)
+    kv.pushpull(1, arr_list)
+    for a in arr_list:
+        onp.testing.assert_allclose(a.asnumpy(), 4.0)
+
+
+def test_builtin_store_broadcast_matches_custom():
+    # reference test_broadcast_single_kv_pair across ['device', custom]
+    _register_teststore()
+    for name in ("local", "teststore"):
+        kv = mx.kv.create(name)
+        ones = nd.ones(SHAPE)
+        out = nd.zeros(SHAPE)
+        kv.broadcast("a", ones, out=out)
+        onp.testing.assert_allclose(out.asnumpy(), 1.0)
+
+
+def test_builtin_pushpull_aggregates():
+    # reference test_pushpull_single_kv_pair on the built-in store
+    kv = mx.kv.create("local")
+    kv.init("agg", nd.zeros(SHAPE))
+    kv.push("agg", [nd.ones(SHAPE) * 2, nd.ones(SHAPE) * 3])
+    out = nd.zeros(SHAPE)
+    kv.pull("agg", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 5.0)
+
+
+def test_custom_store_unsupported_optimizer_methods():
+    # reference test_set_optimizer: capability-gated methods raise
+    _register_teststore()
+    kv = mx.kv.create("teststore")
+    assert not type(kv).is_capable("optimizer")
+    opt = mx.optimizer.create("sgd")
+    for call in (lambda: kv.set_optimizer(opt),
+                 lambda: kv.save_optimizer_states("x"),
+                 lambda: kv.load_optimizer_states("x")):
+        with pytest.raises((NotImplementedError, AttributeError)):
+            call()

@@ -20,7 +20,18 @@ __all__ = [
     "env_bool",
     "env_int",
     "env_str",
+    "data_dir",
 ]
+
+
+def data_dir() -> str:
+    """The MXNet cache root (reference mx.base.data_dir): MXNET_HOME or
+    ``~/.mxnet``.  model_store/datasets build their subdirs on this."""
+    import os
+
+    from . import config
+
+    return os.path.expanduser(config.get("MXNET_HOME"))
 
 
 class MXNetError(RuntimeError):

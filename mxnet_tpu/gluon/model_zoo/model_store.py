@@ -72,10 +72,9 @@ _URL_FMT = ("https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/"
 
 
 def data_dir() -> str:
-    from ... import config
+    from ...base import data_dir as _base_dir
 
-    return os.path.join(os.path.expanduser(config.get("MXNET_HOME")),
-                        "models")
+    return os.path.join(_base_dir(), "models")
 
 
 def short_hash(name: str) -> str:

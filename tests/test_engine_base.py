@@ -1,0 +1,60 @@
+"""Engine bulk scope + mx.base utilities (reference
+tests/python/unittest/test_engine.py::test_bulk and
+test_base.py::test_data_dir / environment helpers)."""
+import os
+import os.path as op
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_bulk_scope_semantics():
+    # reference test_bulk: in-place chains inside a bulk scope still
+    # produce exact values, across an explicit wait_to_read
+    with mx.engine.bulk(10):
+        x = nd.ones((10,))
+        x *= 2
+        x += 1
+        x.wait_to_read()
+        x += 1
+        assert (x.asnumpy() == 4).all()
+        for _ in range(100):
+            x += 1
+    assert (x.asnumpy() == 104).all()
+
+
+def test_bulk_size_set_restore():
+    old = mx.engine.set_bulk_size(16)
+    try:
+        assert mx.engine.set_bulk_size(old) == 16
+    finally:
+        mx.engine.set_bulk_size(old)
+
+
+def test_data_dir_env(monkeypatch):
+    # reference test_base.py::test_data_dir
+    from mxnet_tpu.base import data_dir
+
+    monkeypatch.delenv("MXNET_HOME", raising=False)
+    assert data_dir() == op.join(op.expanduser("~"), ".mxnet")
+    monkeypatch.setenv("MXNET_HOME", "/tmp/mxnet_data_test")
+    assert data_dir() == "/tmp/mxnet_data_test"
+    # the model store keeps its /models subdir on top of the base dir
+    from mxnet_tpu.gluon.model_zoo.model_store import data_dir as mdir
+
+    assert mdir() == "/tmp/mxnet_data_test/models"
+
+
+def test_with_environment_helper():
+    # reference common.with_environment: scoped env mutation restores
+    from mxnet_tpu.test_utils import environment
+
+    os.environ.pop("MXNET_TEST_SCOPED_VAR", None)
+    with environment("MXNET_TEST_SCOPED_VAR", "1"):
+        assert os.environ["MXNET_TEST_SCOPED_VAR"] == "1"
+        with environment("MXNET_TEST_SCOPED_VAR", None):
+            assert "MXNET_TEST_SCOPED_VAR" not in os.environ
+        assert os.environ["MXNET_TEST_SCOPED_VAR"] == "1"
+    assert "MXNET_TEST_SCOPED_VAR" not in os.environ

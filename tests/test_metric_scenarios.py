@@ -1,0 +1,117 @@
+"""Per-metric numeric oracles (reference
+tests/python/unittest/test_metric.py families): exact formula checks for
+F1 averaging modes, MCC, Pearson/PCC, perplexity with ignore_label,
+2d-label accuracy, cross-entropy, and update/reset statefulness."""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric, nd
+
+
+def test_acc_2d_label():
+    # reference test_acc_2d_label: accuracy flattens spatial labels
+    pred = nd.array(onp.array([[[0.3, 0.7], [0.6, 0.4]],
+                               [[0.9, 0.1], [0.2, 0.8]]], onp.float32))
+    label = nd.array(onp.array([[1, 0], [0, 1]], onp.float32))
+    m = metric.Accuracy()
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+    m.reset()
+    wrong = nd.array(onp.array([[0, 1], [1, 0]], onp.float32))
+    m.update([wrong], [pred])
+    assert m.get()[1] == 0.0
+
+
+def test_binary_f1_formula():
+    # reference test_binary_f1 exact confusion-matrix arithmetic
+    pred = nd.array(onp.array([[0.7, 0.3], [0.2, 0.8], [0.4, 0.6],
+                               [0.9, 0.1]], onp.float32))
+    label = nd.array(onp.array([0, 1, 0, 1], onp.float32))
+    m = metric.F1()
+    m.update([label], [pred])
+    # argmax preds: [0, 1, 1, 0] vs labels [0,1,0,1] -> tp=1 fp=1 fn=1
+    prec, rec = 1 / 2, 1 / 2
+    expect = 2 * prec * rec / (prec + rec)
+    assert abs(m.get()[1] - expect) < 1e-6
+
+
+def test_mcc_matches_formula():
+    # reference test_mcc
+    rng = onp.random.RandomState(0)
+    label = rng.randint(0, 2, (64,))
+    scores = rng.rand(64, 2).astype(onp.float32)
+    pred_cls = scores.argmax(1)
+    tp = int(((pred_cls == 1) & (label == 1)).sum())
+    tn = int(((pred_cls == 0) & (label == 0)).sum())
+    fp = int(((pred_cls == 1) & (label == 0)).sum())
+    fn = int(((pred_cls == 0) & (label == 1)).sum())
+    denom = math.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    expect = ((tp * tn - fp * fn) / denom) if denom else 0.0
+    m = metric.MCC()
+    m.update([nd.array(label.astype(onp.float32))], [nd.array(scores)])
+    assert abs(m.get()[1] - expect) < 1e-6
+
+
+def test_pearsonr_matches_numpy():
+    # reference test_pearsonr
+    rng = onp.random.RandomState(1)
+    pred = rng.rand(40).astype(onp.float32)
+    label = (0.5 * pred + 0.1 * rng.rand(40)).astype(onp.float32)
+    m = metric.PearsonCorrelation()
+    m.update([nd.array(label)], [nd.array(pred)])
+    expect = onp.corrcoef(pred, label)[0, 1]
+    assert abs(m.get()[1] - expect) < 1e-4
+
+
+def test_pearsonr_streaming_updates_match_single():
+    rng = onp.random.RandomState(2)
+    pred = rng.rand(60).astype(onp.float32)
+    label = rng.rand(60).astype(onp.float32)
+    whole = metric.PearsonCorrelation()
+    whole.update([nd.array(label)], [nd.array(pred)])
+    stream = metric.PearsonCorrelation()
+    for i in range(0, 60, 20):
+        stream.update([nd.array(label[i:i + 20])],
+                      [nd.array(pred[i:i + 20])])
+    assert abs(whole.get()[1] - stream.get()[1]) < 1e-4
+
+
+def test_perplexity_with_ignore_label():
+    # reference test_perplexity: ignored positions excluded from the mean
+    probs = onp.array([[0.5, 0.5], [0.9, 0.1], [0.2, 0.8]], onp.float32)
+    label = onp.array([0, 0, -1], onp.float32)       # last ignored
+    m = metric.Perplexity(ignore_label=-1)
+    m.update([nd.array(label)], [nd.array(probs)])
+    expect = math.exp(-(math.log(0.5) + math.log(0.9)) / 2)
+    assert abs(m.get()[1] - expect) < 1e-5
+
+
+def test_cross_entropy_value():
+    # reference test_ce
+    probs = onp.array([[0.25, 0.75], [0.6, 0.4]], onp.float32)
+    label = onp.array([1, 0], onp.float32)
+    m = metric.CrossEntropy()
+    m.update([nd.array(label)], [nd.array(probs)])
+    expect = -(math.log(0.75) + math.log(0.6)) / 2
+    assert abs(m.get()[1] - expect) < 1e-6
+
+
+def test_loss_update_statefulness():
+    # reference test_loss_update: running mean across updates, reset clears
+    m = metric.Loss()
+    m.update(None, [nd.array([2.0, 4.0])])
+    m.update(None, [nd.array([6.0])])
+    assert abs(m.get()[1] - (2 + 4 + 6) / 3) < 1e-6
+    m.reset()
+    m.update(None, [nd.array([10.0])])
+    assert abs(m.get()[1] - 10.0) < 1e-6
+
+
+def test_single_array_input():
+    # reference test_single_array_input: update accepts bare arrays
+    m = metric.MSE()
+    m.update(nd.array([1.0, 2.0]), nd.array([1.5, 2.5]))
+    assert abs(m.get()[1] - 0.25) < 1e-6

@@ -388,3 +388,25 @@ def test_npx_rnn_and_flatten_aliases_exist():
     assert npx.batch_flatten(mx.np.ones((2, 3, 4))).shape == (2, 12)
     assert npx.slice_axis(mx.np.ones((2, 6)), axis=1, begin=1,
                           end=4).shape == (2, 3)
+
+
+def test_ste_ops_through_nd_autograd():
+    # reference test_contrib_stes_op.py through the PUBLIC nd surface:
+    # forward quantizes, backward is the straight-through identity
+    import numpy as onp
+
+    from mxnet_tpu import autograd, nd
+
+    x = nd.array(onp.array([-1.6, -0.4, 0.3, 1.7], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        z = (nd.round_ste(x) * nd.round_ste(x)).sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [-4.0, -0.0, 0.0, 4.0])
+
+    y = nd.array(onp.array([-2.0, 0.5], onp.float32))
+    y.attach_grad()
+    with autograd.record():
+        s = nd.sign_ste(y).sum()
+    s.backward()
+    onp.testing.assert_allclose(y.grad.asnumpy(), [1.0, 1.0])

@@ -153,6 +153,12 @@ declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
 declare("MXNET_ENFORCE_DETERMINISM", bool, False,
         "Disable nondeterministic optimizations (XLA autotuning picks "
         "deterministic kernels)", subsystem="engine")
+declare("MXNET_FUSED_CONV_BN", int, 1,
+        "Trace-time fusion of eligible 1x1-conv + BatchNorm(training) pairs "
+        "into the Pallas conv+BN-stats kernel (one HBM pass over the conv "
+        "output).  0 = off, 1 = on for single-device TPU execution "
+        "(default), 2 = force everywhere incl. the CPU Pallas interpreter "
+        "(tests).")
 declare("MXNET_BN_TWO_PASS_VAR", bool, False,
         "BatchNorm batch variance via the two-pass shifted formula instead "
         "of the single-pass E[x^2]-E[x]^2 TPU default (one extra HBM pass; "

@@ -229,9 +229,68 @@ class BatchNorm(HybridBlock):
         for p in (self.gamma, self.beta, self.running_mean, self.running_var):
             p.shape = (c,)
 
+    def _fused_conv_src(self, x):
+        """When ``x`` was produced by an eligible 1x1 NHWC Convolution this
+        trace (see conv_layers.py producer tag), return (src_x, src_w,
+        stride) for the fused Pallas conv+BN-stats path, else None.
+        Single-device only: under a sharded pjit step the pallas_call has
+        no partitioning rule; MXNET_FUSED_CONV_BN=2 forces (CPU tests)."""
+        src = getattr(x, "_conv_src", None)
+        if src is None or type(self) not in (BatchNorm, BatchNormReLU):
+            return None
+        from ... import config as _config
+
+        mode = _config.get("MXNET_FUSED_CONV_BN")
+        if not mode:
+            return None
+        import jax as _jax
+
+        if mode != 2 and not (_jax.default_backend() == "tpu"
+                              and len(_jax.devices()) == 1):
+            return None
+        sx, sw, attrs = src
+        stride = tuple(attrs.get("stride", (1, 1)))
+        if (tuple(attrs.get("kernel", ())) != (1, 1)
+                or tuple(attrs.get("pad", (0, 0))) != (0, 0)
+                or tuple(attrs.get("dilate", (1, 1))) != (1, 1)
+                or attrs.get("num_group", 1) != 1
+                or attrs.get("layout") != "NHWC"
+                or self._axis not in (3, -1)
+                or str(sx.dtype) not in ("float32", "bfloat16")):
+            return None
+        from ...ops.pallas_kernels import fused_blocks
+
+        n, h, w, cin = sx.shape
+        ho = -(-h // stride[0])
+        wo = -(-w // stride[1])
+        if fused_blocks(n * ho * wo, cin, sw.shape[0]) is None:
+            return None
+        return sx, sw, stride
+
     def forward(self, x):
         ctx = x.ctx
         training = autograd.is_training() and not self._use_global_stats
+        if training:
+            fused = self._fused_conv_src(x)
+            if fused is not None:
+                sx, sw, stride = fused
+                out, mean, var = invoke(
+                    "_fused_conv1x1_bn",
+                    [sx, sw, self.gamma.data(ctx), self.beta.data(ctx)],
+                    {"stride": stride, "eps": self._epsilon,
+                     "fix_gamma": not self._scale},
+                )
+                m = self._momentum
+                rm = self.running_mean.data(ctx)
+                rv = self.running_var.data(ctx)
+                with autograd.pause():
+                    # fold in the buffer dtype like the unfused op does
+                    # (its outputs are pre-cast, ops/nn.py batch_norm)
+                    rm._set_data(rm._data * m
+                                 + mean._data.astype(rm._data.dtype) * (1 - m))
+                    rv._set_data(rv._data * m
+                                 + var._data.astype(rv._data.dtype) * (1 - m))
+                return out
         rm, rv = self.running_mean.data(ctx), self.running_var.data(ctx)
         outs = invoke(
             "BatchNorm",

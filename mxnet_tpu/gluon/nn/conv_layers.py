@@ -4,6 +4,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
+
 from ...ndarray.ndarray import invoke
 from ..block import HybridBlock
 from ..parameter import Parameter
@@ -95,6 +97,14 @@ class _Conv(HybridBlock):
         if self._use_bias:
             args.append(self.bias.data(x.ctx))
         out = invoke(self._op_name, args, dict(self._kwargs))
+        if (self._op_name == "Convolution" and not self._use_bias
+                and self.act is None and isinstance(out._data, jax.core.Tracer)):
+            # trace-time producer tag: a following BatchNorm(training) may
+            # re-derive this conv THROUGH the fused Pallas stats kernel
+            # (ops/nn.py _fused_conv1x1_bn); the untouched conv node is then
+            # dead code XLA eliminates.  Tracer-gated so eager mode never
+            # retains activations or computes the conv twice.
+            out._conv_src = (x, args[1], dict(self._kwargs))
         if self.act is not None:
             out = self.act(out)
         return out

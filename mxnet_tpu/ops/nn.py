@@ -369,6 +369,33 @@ def batch_norm(arrays, eps=1e-3, momentum=0.9, fix_gamma=True,
     return (out,)
 
 
+@register("_fused_conv1x1_bn", num_inputs=-1, num_outputs=-1)
+def fused_conv1x1_bn(arrays, stride=(1, 1), eps=1e-5, fix_gamma=False):
+    """Training-mode 1x1-conv + BatchNorm with the batch statistics computed
+    in the conv's Pallas epilogue (ops/pallas_kernels.py
+    conv1x1_bn_stats_train) — one HBM pass over the conv output instead of
+    conv-write-then-stats-read.  NHWC x, OHWI w.  Strided 1x1 convs
+    pre-slice the input (exact: a 1x1 kernel never straddles the stride).
+    Returns (out, batch_mean, batch_var) like BatchNorm(training=True).
+    No reference analog (src/operator/nn/batch_norm.cc stats are a separate
+    pass) — TPU-first fusion; the gluon BatchNorm layer routes here, see
+    gluon/nn/basic_layers.py."""
+    from .pallas_kernels import conv1x1_bn_stats_train
+
+    x, w, gamma, beta = arrays
+    sh, sw = stride
+    if (sh, sw) != (1, 1):
+        x = x[:, ::sh, ::sw, :]
+    z, mean, var = conv1x1_bn_stats_train(x, w)
+    f32 = jnp.float32
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = jax.lax.rsqrt(var + f32(eps))            # mean/var already fp32
+    sc = inv * g.astype(f32)
+    bi = beta.astype(f32) - mean * sc
+    out = z * sc.astype(z.dtype) + bi.astype(z.dtype)
+    return out, mean, var
+
+
 @register("LayerNorm")
 def layer_norm_op(data, gamma=None, beta=None, axis=-1, eps=1e-5):
     mean = jnp.mean(data, axis=axis, keepdims=True)

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention", "matmul_bn_stats", "conv1x1_bn_stats"]
+__all__ = ["flash_attention", "matmul_bn_stats", "conv1x1_bn_stats",
+           "conv1x1_bn_stats_train", "fused_blocks"]
 
 _NEG_INF = -1e30
 
@@ -392,3 +393,84 @@ def conv1x1_bn_stats(x, w, relu=False, **blocks):
     mean = s / cnt
     var = jnp.maximum(ss / cnt - mean * mean, 0.0)
     return y.reshape(n, h, wd, cout), mean, var
+
+
+# ---------------------------------------------------------------------------
+# Differentiable fused conv1x1 + BN-stats: the model-path entry point.
+#
+# Round-4 left matmul_bn_stats standalone; this wires it into training.
+# Forward runs the Pallas producer+stats kernel (one HBM pass over the
+# conv output instead of conv-write + stats-read); backward is explicit
+# XLA (dense MXU matmuls) because pallas_call has no transpose rule.
+# Reference analog: train-mode BN fusion does not exist in the reference
+# (src/operator/nn/batch_norm.cc computes stats in a separate pass) —
+# TPU-first design, used by gluon BatchNorm when its input was produced
+# by an eligible 1x1 Convolution (see gluon/nn/basic_layers.py).
+# ---------------------------------------------------------------------------
+
+
+def fused_blocks(m, k, n):
+    """Pick Mosaic-legal block sizes for matmul_bn_stats, or None when the
+    shape can't tile: block_m multiple of 8 (sublane), block_n multiple of
+    128 or the whole dim (lane), block_k any divisor of k."""
+    def pick(dim, target, quantum):
+        if dim <= target:
+            return dim
+        b = (min(target, dim) // quantum) * quantum
+        while b >= quantum and dim % b:
+            b -= quantum
+        return b if b >= quantum and dim % b == 0 else None
+
+    bm = pick(m, 256, 8)
+    bn = pick(n, 256, 128)
+    bk = pick(k, 512, 128)
+    if bm is None or bn is None or bk is None:
+        return None
+    if m % bm or n % bn or k % bk:
+        return None
+    return {"block_m": bm, "block_n": bn, "block_k": bk}
+
+
+@jax.custom_vjp
+def conv1x1_bn_stats_train(x, w):
+    """Differentiable ``(z, mean, var)`` of a 1x1 NHWC conv with fused
+    batch statistics.  x (N,H,W,Cin), w (Cout,1,1,Cin) OHWI.  Caller must
+    pre-check :func:`fused_blocks` eligibility."""
+    z, mean, var = _c1x1_fwd(x, w)
+    return z, mean, var
+
+
+def _c1x1_fwd(x, w):
+    n, h, wd, cin = x.shape
+    blocks = fused_blocks(n * h * wd, cin, w.shape[0])
+    return conv1x1_bn_stats(x, w, relu=False, **blocks)
+
+
+def _c1x1_fwd_vjp(x, w):
+    z, mean, var = _c1x1_fwd(x, w)
+    return (z, mean, var), (x, w, z, mean)
+
+
+def _c1x1_bwd(res, cts):
+    x, w, z, mean = res
+    gz, gmean, gvar = cts
+    n, h, wd, cin = x.shape
+    cout = w.shape[0]
+    m = n * h * wd
+    # total cotangent into the conv output: the stats outputs fold back as
+    #   d mean_j / d z_ij = 1/M,   d var_j / d z_ij = 2 (z_ij - mean_j) / M
+    z32 = z.reshape(m, cout).astype(jnp.float32)
+    g = (gz.reshape(m, cout).astype(jnp.float32)
+         + gmean[None, :].astype(jnp.float32) / m
+         + gvar[None, :].astype(jnp.float32) * 2.0 * (z32 - mean[None, :]) / m)
+    g = g.astype(x.dtype)                         # MXU-friendly operand dtype
+    x2 = x.reshape(m, cin)
+    w2 = w.reshape(cout, cin)
+    dx = jax.lax.dot(g, w2.astype(g.dtype),
+                     preferred_element_type=jnp.float32)
+    dw = jax.lax.dot(g.T, x2, preferred_element_type=jnp.float32)
+    return (dx.reshape(x.shape).astype(x.dtype),
+            dw.reshape(w.shape).astype(w.dtype))
+
+
+conv1x1_bn_stats_train.defvjp(_c1x1_fwd_vjp, _c1x1_bwd)

@@ -94,6 +94,7 @@ _LAZY = {
     "dlpack": ".dlpack",
     "registry": ".registry",
     "libinfo": ".libinfo",
+    "rtc": ".rtc",
 }
 
 

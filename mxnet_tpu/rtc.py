@@ -1,0 +1,217 @@
+"""Runtime kernel compilation (reference ``python/mxnet/rtc.py``,
+``src/common/rtc.cc:35-69``).
+
+The reference compiles user-supplied CUDA C source with NVRTC and launches
+it on a GPU stream.  The TPU analog of "user-supplied JIT kernels" is
+Pallas: a :class:`Module` holds Python source that defines JAX/Pallas
+functions, compiled on first launch by XLA/Mosaic for the TPU.  The
+launch surface mirrors the reference exactly — C-style signatures with
+``const``-ness deciding data flow, ``launch(args, ctx, grid_dims,
+block_dims)`` writing results back into the non-const arrays — so
+reference rtc call sites port by swapping the kernel body, not the
+harness around it (docs/MIGRATION.md "mx.rtc").
+
+:class:`CudaModule` remains as a guard rail: constructing it raises with
+the migration recipe, because CUDA C cannot target a TPU.
+"""
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = ["Module", "Kernel", "CudaModule"]
+
+# C scalar/pointer type names accepted in signatures (reference
+# rtc.py:28-38 _DTYPE_CPP_TO_NP)
+_DTYPE_CPP_TO_NP = {
+    "float": onp.float32,
+    "double": onp.float64,
+    "__half": onp.float16,
+    "half": onp.float16,
+    "bfloat16": "bfloat16",
+    "uint8_t": onp.uint8,
+    "int": onp.int32,
+    "int32_t": onp.int32,
+    "int8_t": onp.int8,
+    "char": onp.int8,
+    "int64_t": onp.int64,
+}
+
+
+class Module:
+    """Compile and run JAX/Pallas source from Python at runtime.
+
+    ``source`` is Python text evaluated with ``jax``, ``jax.numpy as
+    jnp``, ``jax.experimental.pallas as pl`` and ``functools`` in scope;
+    every top-level function it defines is exportable.  ``exports``
+    optionally restricts which names :meth:`get_kernel` may fetch
+    (reference CudaModule(source, exports=...) surface).
+
+    Example::
+
+        source = '''
+        def axpy(x, y, alpha):
+            return y + alpha * x
+        '''
+        module = mx.rtc.Module(source)
+        func = module.get_kernel("axpy", "const float *x, float *y, float alpha")
+        func.launch([x, y, 3.0], mx.tpu(0), (1, 1, 1), (10, 1, 1))
+        # y now holds y + 3 * x, like the reference CUDA axpy
+
+    A kernel function receives EVERY signature argument as a positional
+    JAX value in signature order — const arrays, non-const arrays (their
+    current contents, like a CUDA kernel seeing the output buffer), and
+    scalars — and returns the new value(s) of the non-const array(s);
+    ``launch`` writes them back.  For
+    hot paths the body can be a ``pl.pallas_call`` — grid/block dims from
+    ``launch`` are forwarded as ``grid_dims``/``block_dims`` keywords when
+    the function accepts them (Mosaic otherwise picks its own tiling; the
+    CUDA launch geometry has no TPU meaning).
+    """
+
+    def __init__(self, source: str, options: Sequence[str] = (),
+                 exports: Sequence[str] = ()):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        if options:
+            raise MXNetError(
+                "rtc.Module: NVRTC compiler options are CUDA-specific; "
+                f"got {list(options)!r}.  Pallas kernels need none.")
+        ns = {"jax": jax, "jnp": jnp, "pl": pl, "functools": functools}
+        try:
+            exec(compile(source, "<mx.rtc.Module>", "exec"), ns)
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError(f"rtc.Module: source failed to compile: "
+                             f"{type(e).__name__}: {e}")
+        self._funcs = {
+            k: v for k, v in ns.items()
+            if callable(v) and not k.startswith("__")
+            and k not in ("jax", "jnp", "pl", "functools")}
+        self._exports = list(exports)
+
+    def get_kernel(self, name: str, signature: str) -> "Kernel":
+        """Fetch an exported function with a C-style ``signature`` whose
+        ``const``-ness routes data (reference rtc.py:111 get_kernel)."""
+        if self._exports and name not in self._exports:
+            raise MXNetError(
+                f"rtc.Module: '{name}' not in exports {self._exports}")
+        fn = self._funcs.get(name)
+        if fn is None or not callable(fn):
+            raise MXNetError(
+                f"rtc.Module: source defines no function '{name}' "
+                f"(have: {sorted(k for k, v in self._funcs.items() if callable(v))})")
+        spec = _parse_signature(signature)
+        return Kernel(fn, name, spec)
+
+
+def _parse_signature(signature: str):
+    """Parse ``const float *x, float *y, float alpha`` into
+    (is_ndarray, dtype, name) triples — reference rtc.py:126-166."""
+    pattern = re.compile(
+        r"^\s*(const)?\s*([\w_]+)\s*(\*)?\s*([\w_]+)\s*$")
+    spec = []
+    for arg in signature.split(","):
+        m = pattern.match(arg)
+        if m is None:
+            raise MXNetError(
+                f"rtc: invalid function prototype \"{arg}\"")
+        const, ctype, ptr, name = m.groups()
+        if ctype not in _DTYPE_CPP_TO_NP:
+            raise MXNetError(f"rtc: unsupported kernel argument type "
+                             f"'{ctype}' in \"{arg}\"")
+        if not ptr and const:
+            raise MXNetError(
+                f"rtc: scalar argument \"{arg}\" cannot be const")
+        spec.append((bool(ptr), not const and bool(ptr),
+                     onp.dtype(_DTYPE_CPP_TO_NP[ctype]), name))
+    return spec
+
+
+class Kernel:
+    """A launchable runtime kernel (reference rtc.py:172 CudaKernel)."""
+
+    def __init__(self, fn, name, spec):
+        self._fn = fn
+        self._name = name
+        self._spec = spec
+
+    def launch(self, args, ctx, grid_dims=(1, 1, 1), block_dims=(1, 1, 1),
+               shared_mem=0):
+        """Run the kernel.  ``args`` follow the signature order; non-const
+        pointer args receive the function's return value(s) in-place.
+        ``grid_dims``/``block_dims`` are forwarded to functions that accept
+        them and otherwise ignored (XLA/Mosaic owns TPU scheduling);
+        ``shared_mem`` must be 0 — VMEM allocation is the compiler's.
+        """
+        import inspect
+
+        from .ndarray import NDArray
+
+        if shared_mem:
+            raise MXNetError("rtc: shared_mem is CUDA-specific; Pallas "
+                             "kernels size VMEM via BlockSpec")
+        if len(args) != len(self._spec):
+            raise MXNetError(
+                f"rtc kernel '{self._name}' expects {len(self._spec)} "
+                f"arguments, got {len(args)}")
+        inputs = []
+        out_slots = []
+        for a, (is_arr, is_out, dt, argname) in zip(args, self._spec):
+            if is_arr:
+                if not isinstance(a, NDArray):
+                    raise MXNetError(
+                        f"rtc: argument '{argname}' must be an NDArray")
+                if str(a.dtype) != str(dt):
+                    raise MXNetError(
+                        f"rtc: argument '{argname}' expects dtype {dt}, "
+                        f"got {a.dtype}")
+                inputs.append(a._data)
+                if is_out:
+                    out_slots.append(a)
+            else:
+                inputs.append(dt.type(a))
+        kwargs = {}
+        params = inspect.signature(self._fn).parameters
+        if "grid_dims" in params:
+            kwargs["grid_dims"] = tuple(grid_dims)
+        if "block_dims" in params:
+            kwargs["block_dims"] = tuple(block_dims)
+        result = self._fn(*inputs, **kwargs)
+        outs = list(result) if isinstance(result, (tuple, list)) else [result]
+        if len(outs) != len(out_slots):
+            raise MXNetError(
+                f"rtc kernel '{self._name}' returned {len(outs)} arrays "
+                f"but the signature declares {len(out_slots)} non-const "
+                f"pointer argument(s)")
+        for slot, val in zip(out_slots, outs):
+            if tuple(val.shape) != tuple(slot.shape):
+                raise MXNetError(
+                    f"rtc kernel '{self._name}': output shape "
+                    f"{tuple(val.shape)} != argument shape {slot.shape}")
+            slot._set_data(val.astype(slot._data.dtype))
+
+
+class CudaModule:
+    """Guard rail for ported reference code (reference rtc.py:41).
+
+    CUDA C source cannot run on a TPU; the error message carries the
+    porting recipe instead of failing deeper in an opaque way.
+    """
+
+    def __init__(self, source, options=(), exports=()):
+        raise MXNetError(
+            "mx.rtc.CudaModule compiles CUDA C, which cannot target a "
+            "TPU.  Port the kernel body to JAX/Pallas and use "
+            "mx.rtc.Module(py_source) with the SAME get_kernel/launch "
+            "calls, or register it as an operator via "
+            "mxnet_tpu.library.register_op (docs/MIGRATION.md 'mx.rtc').")

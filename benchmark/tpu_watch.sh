@@ -1,0 +1,78 @@
+#!/bin/bash
+# Background TPU-window watcher (round 5).
+#
+# Probes the tunnel every PROBE_EVERY seconds with a tiny bounded matmul
+# subprocess (a wedged tunnel costs one timeout, never a hang).  On a
+# healthy window it runs the queued on-chip work in priority order
+# (benchmark/chip_session.md), full driver-style bench FIRST so even an
+# early re-wedge leaves the most valuable artifact.  Every run goes
+# through `timeout` so no item can wedge the watcher itself.
+#
+# State files (benchmark/.watch/): one marker per completed item.
+# Touch benchmark/.watch/rerun_bench to request a bench re-run after a
+# perf-relevant code change lands (refreshes .jax_cache for the driver).
+set -u
+cd /root/repo
+mkdir -p benchmark/.watch
+LOG=benchmark/tpu_watch.log
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+PROBE_EVERY=${PROBE_EVERY:-240}
+
+log() { echo "[watch $(date +%H:%M:%S)] $*" >> "$LOG"; }
+
+probe() {
+    timeout 75 python - <<'EOF' >> "$LOG" 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+v = float((x @ x)[0, 0])
+assert jax.default_backend() == "tpu", jax.default_backend()
+print("probe OK:", jax.default_backend(), v)
+EOF
+}
+
+run_item() {  # run_item <marker> <budget_s> <cmd...>
+    local marker=$1 budget=$2; shift 2
+    [ -e "benchmark/.watch/$marker" ] && return 0
+    log "running $marker: $*"
+    if timeout "$budget" "$@" >> "$LOG" 2>&1; then
+        touch "benchmark/.watch/$marker"
+        log "$marker DONE"
+    else
+        log "$marker FAILED/TIMED OUT (rc=$?)"
+        return 1
+    fi
+}
+
+log "watcher started (probe every ${PROBE_EVERY}s)"
+while true; do
+    if probe; then
+        log "tunnel healthy"
+        # 1. full driver-style bench — the round's defining artifact
+        if [ ! -e benchmark/.watch/bench_full ] || [ -e benchmark/.watch/rerun_bench ]; then
+            rm -f benchmark/.watch/rerun_bench benchmark/.watch/bench_full
+            log "running bench_full"
+            if timeout 2400 python bench.py > benchmark/.watch/bench_full.out 2>> "$LOG"; then
+                tail -1 benchmark/.watch/bench_full.out > BENCH_builder_r05.json
+                touch benchmark/.watch/bench_full
+                log "bench_full DONE: $(tail -c 300 BENCH_builder_r05.json)"
+            else
+                log "bench_full FAILED/TIMED OUT (rc=$?)"
+            fi
+        fi
+        probe || { log "tunnel lost after bench"; sleep "$PROBE_EVERY"; continue; }
+        # 2. microbench (s8-vs-bf16, epilogue, BN cost)
+        run_item microbench 900 python benchmark/microbench_tpu.py
+        # 3. bf16 ablation rows
+        run_item ablation_nchw 900 env BENCH_MODEL=resnet50_v1_bf16 BENCH_LAYOUT=NCHW BENCH_S2D=0 python bench.py
+        run_item ablation_nhwc 900 env BENCH_MODEL=resnet50_v1_bf16 BENCH_LAYOUT=NHWC BENCH_S2D=0 python bench.py
+        # 4. train-step profile
+        run_item profile 600 python benchmark/profile_step.py --steps 5 --top 30
+        # 5. remat headroom at bs256
+        run_item remat_bs256 1200 env BENCH_MODEL=resnet50_v1_bf16 BENCH_BATCH=256 MXNET_BACKWARD_DO_MIRROR=1 python bench.py
+        # 6. large-tensor on-chip test (>2^31 elements in HBM)
+        run_item large_tensor 900 python -m pytest tests/test_large_tensor.py -x -q -m tpu --no-header
+    else
+        log "tunnel down"
+    fi
+    sleep "$PROBE_EVERY"
+done

@@ -478,7 +478,8 @@ class Embedding(HybridBlock):
         self._output_dim = output_dim
         self.weight = Parameter(
             "weight", shape=(input_dim, output_dim), dtype=dtype,
-            init=weight_initializer)
+            init=weight_initializer,
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def forward(self, x):
         return invoke(

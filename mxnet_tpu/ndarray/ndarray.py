@@ -302,9 +302,13 @@ class NDArray:
         return out
 
     def tostype(self, stype):
-        if stype != "default":
-            raise NotImplementedError("sparse storage is handled by mx.nd.sparse")
-        return self
+        if stype == "default":
+            return self
+        from .sparse import cast_storage as _cast_storage
+
+        # dense -> csr / row_sparse container (reference ndarray.py
+        # tostype -> cast_storage, src/operator/tensor/cast_storage.cc)
+        return _cast_storage(self, stype)
 
     # ------------------------------------------------------------------
     # shape ops (methods mirror reference method surface)

@@ -21,7 +21,7 @@ from ..context import Context, current_context
 from .ndarray import NDArray, _wrap
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "zeros"]
+           "zeros", "dot", "cast_storage", "retain", "add_n"]
 
 
 class BaseSparseNDArray:
@@ -167,16 +167,9 @@ class CSRNDArray(BaseSparseNDArray):
         return self.tostype("default")
 
     def dot(self, dense: NDArray) -> NDArray:
-        """csr @ dense via segment-sum (XLA-friendly SpMV/SpMM)."""
-        d = dense._data if isinstance(dense, NDArray) else jnp.asarray(dense)
-        # row id per nonzero from indptr
-        nnz = self.data.shape[0]
-        row_ids = jnp.searchsorted(self.indptr[1:], jnp.arange(nnz),
-                                   side="right").astype(jnp.int32)
-        contrib = self.data[:, None] * d[self.indices]
-        out = jax.ops.segment_sum(contrib, row_ids,
-                                  num_segments=self.shape[0])
-        return _wrap(out.astype(d.dtype), self._ctx)
+        """csr @ dense via segment-sum (XLA-friendly SpMV/SpMM) — the
+        no-transpose row of the module-level :func:`dot` stype matrix."""
+        return dot(self, dense)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
@@ -204,16 +197,7 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
                           ctx)
     dense = onp.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
                         else arg1, dtype)
-    indptr = [0]
-    indices = []
-    data = []
-    for row in dense:
-        nz = onp.nonzero(row)[0]
-        indices.extend(nz.tolist())
-        data.extend(row[nz].tolist())
-        indptr.append(len(indices))
-    return CSRNDArray(onp.asarray(data, dense.dtype), indices, indptr,
-                      shape or dense.shape, ctx)
+    return _dense_to_csr(dense, ctx, shape)
 
 
 def zeros(stype, shape, ctx=None, dtype=None):
@@ -258,3 +242,147 @@ def adam_update(weight: NDArray, grad: RowSparseNDArray, mean: NDArray,
     upd = weight._data[idx] - lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
     weight._set_data(weight._data.at[idx].set(upd))
     return weight
+
+
+# ---------------------------------------------------------------------------
+# storage-type matrix ops (round-5 breadth: reference
+# src/operator/tensor/dot-inl.h sparse dot family and
+# src/operator/tensor/cast_storage.cc path matrix)
+# ---------------------------------------------------------------------------
+
+
+def _dense_to_csr(dense: onp.ndarray, ctx=None, shape=None) -> "CSRNDArray":
+    """Vectorized dense -> CSR (no per-row Python loop).  ``shape`` may
+    declare extra all-zero trailing rows (indptr is padded to match)."""
+    shape = tuple(shape) if shape is not None else dense.shape
+    rows, cols = onp.nonzero(dense)
+    counts = onp.bincount(rows, minlength=shape[0])
+    indptr = onp.concatenate([[0], onp.cumsum(counts)])
+    return CSRNDArray(dense[rows, cols], cols.astype(onp.int32),
+                      indptr.astype(onp.int32), shape, ctx)
+
+
+def _as_dense_jax(x):
+    if isinstance(x, (RowSparseNDArray, CSRNDArray)):
+        return x.todense()._data
+    if isinstance(x, NDArray):
+        return x._data
+    return jnp.asarray(x)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    """Sparse-aware ``dot`` implementing the reference storage-type matrix
+    (``src/operator/tensor/dot.cc:54-68`` docstring):
+
+    - dot(csr, default)                                     -> default
+    - dot(csr, default, transpose_a=True)                   -> default
+    - dot(csr, default, transpose_a=True,
+          forward_stype='row_sparse')                       -> row_sparse
+    - dot(csr, row_sparse)                                  -> default
+    - dot(default, csr)                                     -> csr
+    - dot(default, csr, forward_stype='default')            -> default
+    - dot(default, csr, transpose_b=True,
+          forward_stype='default')                          -> default
+
+    Any other combination falls back to dense computation with default
+    output, exactly like the reference's FallBackCompute.  TPU-first note:
+    every branch lowers to gather/segment-sum/scatter or an MXU matmul —
+    the CSR *container* is host metadata; no device CSR kernels exist
+    (SURVEY §7 sparse scoping).
+    """
+    if isinstance(lhs, CSRNDArray):
+        rd = _as_dense_jax(rhs)
+        squeeze = False
+        if rd.ndim == 1:
+            if transpose_b:
+                raise MXNetError("dot: cannot transpose a 1-D rhs")
+            rd = rd[:, None]                    # SpMV as single-column SpMM
+            squeeze = True
+        elif transpose_b:
+            rd = rd.T
+        # row id per nonzero from indptr (shared by both orientations)
+        nnz = lhs.data.shape[0]
+        row_ids = jnp.searchsorted(lhs.indptr[1:], jnp.arange(nnz),
+                                   side="right").astype(jnp.int32)
+        if not transpose_a:
+            # out[r] += v * rhs[c]: segment-sum over csr rows
+            contrib = lhs.data[:, None] * rd[lhs.indices]
+            out = jax.ops.segment_sum(contrib, row_ids,
+                                      num_segments=lhs.shape[0])
+            out = out.astype(rd.dtype)
+        else:
+            # out[c] += v * rhs[r]  for each nonzero (r, c, v)
+            out = jnp.zeros((lhs.shape[1], rd.shape[1]), rd.dtype)
+            out = out.at[lhs.indices].add(lhs.data[:, None] * rd[row_ids])
+            if forward_stype == "row_sparse":
+                uniq = jnp.unique(lhs.indices)
+                vals = out[uniq, 0] if squeeze else out[uniq]
+                shape = (out.shape[0],) if squeeze else out.shape
+                return RowSparseNDArray(vals, uniq.astype(jnp.int32),
+                                        shape, lhs._ctx)
+        if squeeze:
+            out = out[:, 0]
+        return _wrap(out, lhs._ctx)
+    if isinstance(rhs, CSRNDArray) and not isinstance(lhs, CSRNDArray):
+        ld = _as_dense_jax(lhs)
+        if transpose_a:
+            ld = ld.T
+        rd = rhs.todense()._data
+        if transpose_b:
+            rd = rd.T
+        out = ld @ rd
+        if (forward_stype in (None, "csr")) and not transpose_b \
+                and not transpose_a:
+            return _dense_to_csr(onp.asarray(out), rhs._ctx)
+        return _wrap(out, rhs._ctx)
+    # dense x dense / fallback: densify everything (FallBackCompute)
+    ld = _as_dense_jax(lhs)
+    rd = _as_dense_jax(rhs)
+    if transpose_a:
+        ld = ld.T
+    if transpose_b:
+        rd = rd.T
+    return _wrap(ld @ rd, current_context())
+
+
+def cast_storage(arr, stype: str):
+    """Container-level storage cast implementing the full reference path
+    matrix (``src/operator/tensor/cast_storage.cc``): default <-> csr,
+    default <-> row_sparse, sparse -> default, and identity casts.
+    Sparse-to-other-sparse goes through dense like the reference."""
+    src = getattr(arr, "stype", "default")
+    if stype == src:
+        return arr
+    if isinstance(arr, (RowSparseNDArray, CSRNDArray)):
+        dense = arr.todense()
+        if stype == "default":
+            return dense
+        return cast_storage(dense, stype)           # csr <-> row_sparse
+    if stype == "csr":
+        d = arr.asnumpy() if isinstance(arr, NDArray) else onp.asarray(arr)
+        if d.ndim != 2:
+            raise MXNetError("csr storage requires a 2-D array")
+        return _dense_to_csr(d, getattr(arr, "_ctx", None))
+    if stype == "row_sparse":
+        return row_sparse_array(arr, ctx=getattr(arr, "_ctx", None))
+    raise MXNetError(f"cast_storage: unknown stype {stype}")
+
+
+def retain(arr: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """Module-level retain (reference mx.nd.sparse.retain)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    return arr.retain(indices)
+
+
+def add_n(*arrays):
+    """Sum row_sparse arrays without densifying (reference ElementwiseSum
+    sparse branch, src/operator/tensor/elemwise_sum.cc)."""
+    rsp = [a for a in arrays if isinstance(a, RowSparseNDArray)]
+    if len(rsp) == len(arrays) and rsp:
+        out = rsp[0]
+        for a in rsp[1:]:
+            out = out + a
+        return out.compact()
+    dense = sum(_as_dense_jax(a) for a in arrays)
+    return _wrap(dense, current_context())

@@ -1,0 +1,254 @@
+"""Sparse breadth (round-5 VERDICT Missing #4): the CSR dot storage-type
+matrix, the cast_storage path matrix, and Embedding row_sparse gradients
+under hybridize.
+
+Scenario families mirror the reference
+``tests/python/unittest/test_sparse_ndarray.py`` (test_sparse_nd_dot /
+test_cast_storage_ex / test_sparse_embedding) with numpy as the numeric
+oracle.  Reference implementations:
+``src/operator/tensor/dot-inl.h`` (forward/transpose combinations),
+``src/operator/tensor/cast_storage.cc`` (path matrix),
+``src/operator/tensor/indexing_op.cc`` SparseEmbedding.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+
+def _rand_dense(m, n, density, seed):
+    rng = onp.random.RandomState(seed)
+    d = rng.randn(m, n).astype(onp.float32)
+    d[rng.rand(m, n) >= density] = 0.0
+    return d
+
+
+# ------------------------------------------------------------- dot ------
+
+def test_dot_csr_dense_default():
+    a = _rand_dense(8, 6, 0.4, 0)
+    b = onp.random.RandomState(1).randn(6, 5).astype(onp.float32)
+    out = sparse.dot(sparse.csr_matrix(a), nd.array(b))
+    assert isinstance(out, nd.NDArray) and out.stype == "default"
+    onp.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_dot_csr_T_dense_default():
+    a = _rand_dense(8, 6, 0.4, 2)
+    b = onp.random.RandomState(3).randn(8, 5).astype(onp.float32)
+    out = sparse.dot(sparse.csr_matrix(a), nd.array(b), transpose_a=True)
+    assert isinstance(out, nd.NDArray) and out.stype == "default"
+    onp.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_dot_csr_T_dense_row_sparse_out():
+    a = _rand_dense(8, 6, 0.3, 4)
+    b = onp.random.RandomState(5).randn(8, 5).astype(onp.float32)
+    out = sparse.dot(sparse.csr_matrix(a), nd.array(b), transpose_a=True,
+                     forward_stype="row_sparse")
+    assert isinstance(out, RowSparseNDArray)
+    onp.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-5, atol=1e-5)
+    # only columns with nonzeros appear as stored rows
+    nz_cols = set(onp.nonzero(onp.any(a != 0, axis=0))[0].tolist())
+    assert set(onp.asarray(out.indices).tolist()) <= nz_cols
+
+
+def test_dot_csr_row_sparse_rhs():
+    a = _rand_dense(8, 6, 0.4, 6)
+    bd = _rand_dense(6, 5, 0.5, 7)
+    out = sparse.dot(sparse.csr_matrix(a), sparse.row_sparse_array(bd))
+    assert isinstance(out, nd.NDArray) and out.stype == "default"
+    onp.testing.assert_allclose(out.asnumpy(), a @ bd, rtol=1e-5, atol=1e-5)
+
+
+def test_dot_dense_csr_csr_out():
+    a = onp.random.RandomState(8).randn(4, 6).astype(onp.float32)
+    bd = _rand_dense(6, 5, 0.4, 9)
+    out = sparse.dot(nd.array(a), sparse.csr_matrix(bd))
+    assert isinstance(out, CSRNDArray)
+    onp.testing.assert_allclose(out.asnumpy(), a @ bd, rtol=1e-5, atol=1e-5)
+
+
+def test_dot_dense_csr_default_out():
+    a = onp.random.RandomState(10).randn(4, 6).astype(onp.float32)
+    bd = _rand_dense(6, 5, 0.4, 11)
+    out = sparse.dot(nd.array(a), sparse.csr_matrix(bd),
+                     forward_stype="default")
+    assert isinstance(out, nd.NDArray) and out.stype == "default"
+    onp.testing.assert_allclose(out.asnumpy(), a @ bd, rtol=1e-5, atol=1e-5)
+
+
+def test_dot_dense_csr_T_default_out():
+    a = onp.random.RandomState(12).randn(4, 5).astype(onp.float32)
+    bd = _rand_dense(6, 5, 0.4, 13)
+    out = sparse.dot(nd.array(a), sparse.csr_matrix(bd), transpose_b=True,
+                     forward_stype="default")
+    assert isinstance(out, nd.NDArray)
+    onp.testing.assert_allclose(out.asnumpy(), a @ bd.T, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_dot_csr_vector_spmv():
+    """1-D rhs: SpMV in both orientations (review finding — previously
+    returned garbage shapes)."""
+    a = _rand_dense(8, 6, 0.4, 30)
+    v = onp.random.RandomState(31).randn(6).astype(onp.float32)
+    out = sparse.dot(sparse.csr_matrix(a), nd.array(v))
+    assert out.shape == (8,)
+    onp.testing.assert_allclose(out.asnumpy(), a @ v, rtol=1e-5, atol=1e-5)
+    v8 = onp.random.RandomState(32).randn(8).astype(onp.float32)
+    out_t = sparse.dot(sparse.csr_matrix(a), nd.array(v8), transpose_a=True)
+    assert out_t.shape == (6,)
+    onp.testing.assert_allclose(out_t.asnumpy(), a.T @ v8, rtol=1e-5,
+                                atol=1e-5)
+    rsp = sparse.dot(sparse.csr_matrix(a), nd.array(v8), transpose_a=True,
+                     forward_stype="row_sparse")
+    assert isinstance(rsp, RowSparseNDArray) and rsp.shape == (6,)
+    onp.testing.assert_allclose(rsp.asnumpy(), a.T @ v8, rtol=1e-5,
+                                atol=1e-5)
+    with pytest.raises(mx.MXNetError, match="transpose a 1-D"):
+        sparse.dot(sparse.csr_matrix(a), nd.array(v), transpose_b=True)
+
+
+def test_csr_matrix_with_padded_shape():
+    d = _rand_dense(3, 4, 0.6, 33)
+    c = sparse.csr_matrix(d, shape=(5, 4))
+    assert c.shape == (5, 4) and len(onp.asarray(c.indptr)) == 6
+    expect = onp.zeros((5, 4), onp.float32)
+    expect[:3] = d
+    onp.testing.assert_allclose(c.asnumpy(), expect)
+
+
+def test_dot_fallback_combinations_densify():
+    """Combinations outside the reference matrix fall back to dense output
+    (reference FallBackCompute)."""
+    ad = _rand_dense(6, 4, 0.5, 14)
+    bd = _rand_dense(6, 5, 0.5, 15)
+    out = sparse.dot(sparse.row_sparse_array(ad), sparse.row_sparse_array(bd),
+                     transpose_a=True)
+    assert isinstance(out, nd.NDArray) and out.stype == "default"
+    onp.testing.assert_allclose(out.asnumpy(), ad.T @ bd, rtol=1e-5,
+                                atol=1e-5)
+
+
+# ------------------------------------------------------ cast_storage ----
+
+@pytest.mark.parametrize("src,dst", [
+    ("default", "csr"), ("default", "row_sparse"),
+    ("csr", "default"), ("row_sparse", "default"),
+    ("csr", "row_sparse"), ("row_sparse", "csr"),
+])
+def test_cast_storage_path_matrix(src, dst):
+    d = _rand_dense(7, 5, 0.4, 16)
+    arr = nd.array(d) if src == "default" else sparse.cast_storage(
+        nd.array(d), src)
+    out = sparse.cast_storage(arr, dst)
+    expect_cls = {"default": nd.NDArray, "csr": CSRNDArray,
+                  "row_sparse": RowSparseNDArray}[dst]
+    assert isinstance(out, expect_cls)
+    onp.testing.assert_allclose(out.asnumpy(), d, rtol=0, atol=0)
+
+
+def test_cast_storage_identity_returns_same_object():
+    d = nd.array(_rand_dense(4, 4, 0.5, 17))
+    assert sparse.cast_storage(d, "default") is d
+    c = sparse.cast_storage(d, "csr")
+    assert sparse.cast_storage(c, "csr") is c
+
+
+def test_dense_tostype_wires_to_cast_storage():
+    d = _rand_dense(6, 4, 0.3, 18)
+    arr = nd.array(d)
+    assert isinstance(arr.tostype("csr"), CSRNDArray)
+    assert isinstance(arr.tostype("row_sparse"), RowSparseNDArray)
+    onp.testing.assert_allclose(arr.tostype("csr").asnumpy(), d)
+    onp.testing.assert_allclose(arr.tostype("row_sparse").asnumpy(), d)
+
+
+def test_cast_storage_csr_requires_2d():
+    with pytest.raises(mx.MXNetError, match="2-D"):
+        sparse.cast_storage(nd.ones((2, 3, 4)), "csr")
+
+
+def test_sparse_add_n():
+    a = _rand_dense(6, 3, 0.5, 19)
+    b = _rand_dense(6, 3, 0.5, 20)
+    out = sparse.add_n(sparse.row_sparse_array(a), sparse.row_sparse_array(b))
+    assert isinstance(out, RowSparseNDArray)
+    onp.testing.assert_allclose(out.asnumpy(), a + b, rtol=1e-6, atol=1e-6)
+
+
+# --------------------------------------- Embedding row_sparse grads ----
+
+def _embedding_grads(hybridize):
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Embedding(50, 8, sparse_grad=True)
+    net.initialize(mx.init.Normal(0.1))
+    x = nd.array(onp.array([[3, 7, 3], [11, 7, 49]], dtype=onp.int32))
+    net(x)
+    if hybridize:
+        net.hybridize()
+    with autograd.record():
+        out = net(x)
+        loss = (out * out).sum()
+    loss.backward()
+    return net, net.weight.grad(mx.current_context())
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_embedding_sparse_grad(hybridize):
+    net, grad = _embedding_grads(hybridize)
+    assert net.weight._grad_stype == "row_sparse"
+    rsp = grad.tostype("row_sparse")
+    assert isinstance(rsp, RowSparseNDArray)
+    touched = set(onp.asarray(rsp.indices).tolist())
+    assert touched <= {3, 7, 11, 49}
+    # untouched rows are exactly zero in the dense view
+    dense = grad.asnumpy()
+    untouched = [i for i in range(50) if i not in (3, 7, 11, 49)]
+    assert onp.all(dense[untouched] == 0)
+    assert onp.any(dense[3] != 0)
+
+
+def test_embedding_sparse_grad_hybrid_matches_eager():
+    net, eager_grad = _embedding_grads(False)
+    eager = eager_grad.asnumpy().copy()
+    x = nd.array(onp.array([[3, 7, 3], [11, 7, 49]], dtype=onp.int32))
+    net.hybridize()  # same weights, same input — now through the jit cache
+    with autograd.record():
+        out = net(x)
+        loss = (out * out).sum()
+    loss.backward()
+    hybrid = net.weight.grad(mx.current_context()).asnumpy()
+    onp.testing.assert_allclose(hybrid, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_adam_touches_only_sampled_rows():
+    """Row-sparse lazy adam after a hybridized Embedding backward: sampled
+    rows match a dense-adam oracle; unsampled rows (weight AND moments)
+    are bit-identical to their pre-step values (the lazy_update
+    contract, reference adam_update lazy branch)."""
+    net, grad = _embedding_grads(True)
+    ctx = mx.current_context()
+    w = net.weight.data(ctx)
+    w0 = w.asnumpy().copy()
+    mean = nd.zeros(w.shape)
+    var = nd.zeros(w.shape)
+    rsp = grad.tostype("row_sparse")
+    sparse.adam_update(w, rsp, mean, var, lr=0.01)
+    w1 = w.asnumpy()
+    touched = sorted(set(onp.asarray(rsp.indices).tolist()))
+    untouched = [i for i in range(50) if i not in touched]
+    assert onp.array_equal(w1[untouched], w0[untouched])
+    assert onp.array_equal(mean.asnumpy()[untouched],
+                           onp.zeros((len(untouched), 8), onp.float32))
+    # dense-adam oracle on the touched rows
+    g = grad.asnumpy()[touched]
+    m = 0.1 * g
+    v = 0.001 * g * g
+    expect = w0[touched] - 0.01 * m / (onp.sqrt(v) + 1e-8)
+    onp.testing.assert_allclose(w1[touched], expect, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,109 @@
+"""Eager per-op dispatch latency: plain dispatch vs the per-op jit cache
+(MXNET_EAGER_JIT).  Run on the chip to fill docs/PERF.md's eager table
+(round-5 VERDICT Weak #4); CPU runs are still meaningful A/Bs of python
+dispatch overhead.
+
+Method per op: warm (compile + cache) with host-value reads, then time N
+invocations fenced by a host read — the tunnel exerts no backpressure
+until a sync, so unfenced loops measure enqueue rate, not latency
+(docs/PERF.md round-4 lesson).
+
+Usage: python benchmark/eager_latency.py [--ops N] [--json]
+Each mode runs in a SUBPROCESS so the jit cache and config are clean.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+_WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))) if "__file__" in dir() else "/root/repo")
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+N = int(os.environ.get("EAGER_N", "100"))
+rng = onp.random.RandomState(0)
+x = nd.array(rng.randn(128, 256).astype(onp.float32))
+w = nd.array(rng.randn(256, 256).astype(onp.float32))
+b = nd.array(rng.randn(256).astype(onp.float32))
+img = nd.array(rng.randn(8, 32, 32, 64).astype(onp.float32))
+k = nd.array(rng.randn(64, 3, 3, 64).astype(onp.float32))
+gamma = nd.ones((64,)); beta = nd.zeros((64,))
+rm = nd.zeros((64,)); rv = nd.ones((64,))
+
+OPS = {
+    "elemwise_add": lambda: x + x,
+    "FullyConnected": lambda: nd.FullyConnected(x, w, b, num_hidden=256),
+    "softmax": lambda: nd.softmax(x, axis=-1),
+    "Convolution3x3": lambda: nd.Convolution(
+        img, k, kernel=(3, 3), pad=(1, 1), num_filter=64, no_bias=True,
+        layout="NHWC"),
+    "BatchNorm(infer)": lambda: nd.BatchNorm(
+        img, gamma, beta, rm, rv, eps=1e-5, momentum=0.9, fix_gamma=False,
+        use_global_stats=True, axis=3),
+    "mean_axis": lambda: x.mean(axis=1),
+}
+
+rows = {}
+def _first(o):
+    return o[0] if isinstance(o, (list, tuple)) else o
+
+for name, fn in OPS.items():
+    for _ in range(5):                       # warm: compile + caches
+        out = fn()
+    _ = float(_first(out).asnumpy().ravel()[0])  # drain the dispatch queue
+    t0 = time.perf_counter()
+    for _ in range(N):
+        out = fn()
+    _ = float(_first(out).asnumpy().ravel()[0])  # fence
+    dt = time.perf_counter() - t0
+    rows[name] = dt / N * 1e6                # us/op incl. device time
+
+import jax
+print(json.dumps({"platform": jax.default_backend(),
+                  "eager_jit": os.environ.get("MXNET_EAGER_JIT", "default"),
+                  "us_per_op": rows}))
+"""
+
+
+def run(mode: str, n: int) -> dict:
+    env = dict(os.environ)
+    env["MXNET_EAGER_JIT"] = mode
+    env["EAGER_N"] = str(n)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+    r = subprocess.run([sys.executable, "-u", "-c", _WORKER],
+                       capture_output=True, text=True, timeout=900, env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))) or ".")
+    if r.returncode != 0:
+        raise RuntimeError(f"mode {mode} failed:\n{r.stderr[-2000:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def main() -> None:
+    n = 100
+    as_json = "--json" in sys.argv
+    if "--ops" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--ops") + 1])
+    off = run("0", n)
+    on = run("2", n)
+    result = {"platform": off["platform"], "n": n,
+              "plain_us": off["us_per_op"], "jit_us": on["us_per_op"],
+              "speedup": {k: round(off["us_per_op"][k] / on["us_per_op"][k], 2)
+                          for k in off["us_per_op"]}}
+    if as_json:
+        print(json.dumps(result))
+        return
+    print(f"eager dispatch latency ({off['platform']}, {n} calls/op, "
+          "us/op incl. device time)")
+    print(f"{'op':<20} {'plain':>10} {'per-op jit':>12} {'speedup':>9}")
+    for k in off["us_per_op"]:
+        print(f"{k:<20} {off['us_per_op'][k]:>10.1f} "
+              f"{on['us_per_op'][k]:>12.1f} {result['speedup'][k]:>8.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,9 @@ while true; do
         run_item ablation_nhwc 900 env BENCH_MODEL=resnet50_v1_bf16 BENCH_LAYOUT=NHWC BENCH_S2D=0 python bench.py
         # 4. train-step profile
         run_item profile 600 python benchmark/profile_step.py --steps 5 --top 30
+        # 4b. eager dispatch latency A/B (per-op jit cache vs plain);
+        # outer budget > sum of the script's two 900s inner subprocesses
+        run_item eager_latency 2000 python benchmark/eager_latency.py
         # 5. remat headroom at bs256
         run_item remat_bs256 1200 env BENCH_MODEL=resnet50_v1_bf16 BENCH_BATCH=256 MXNET_BACKWARD_DO_MIRROR=1 python bench.py
         # 6. large-tensor on-chip test (>2^31 elements in HBM)

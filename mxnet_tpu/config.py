@@ -153,6 +153,12 @@ declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
 declare("MXNET_ENFORCE_DETERMINISM", bool, False,
         "Disable nondeterministic optimizations (XLA autotuning picks "
         "deterministic kernels)", subsystem="engine")
+declare("MXNET_EAGER_JIT", int, 1,
+        "Per-op jit compilation cache for eager dispatch (the reference "
+        "engine's operator-bulking analog): one cached XLA executable per "
+        "(op, attrs) instead of per-primitive device round-trips.  0 = "
+        "off, 1 = on for the TPU backend (default; CPU eager stays plain "
+        "dispatch), 2 = force everywhere (tests/benchmarks).")
 declare("MXNET_FUSED_CONV_BN", int, 1,
         "Trace-time fusion of eligible 1x1-conv + BatchNorm(training) pairs "
         "into the Pallas conv+BN-stats kernel (one HBM pass over the conv "

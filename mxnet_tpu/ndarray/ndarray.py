@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import numbers
 import time as _time
+from collections import OrderedDict
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -692,13 +693,7 @@ def invoke(
     return _invoke_body(schema, ctx, arrays, inputs, attrs, out)
 
 
-def _invoke_body(schema, ctx, arrays, inputs, attrs, out):
-
-    # Record every differentiable op while the scope is active (the reference
-    # records all ops under record(), not just ones touching marked vars —
-    # autograd.grad() may later differentiate w.r.t. any graph input).
-    record = autograd.is_recording() and schema.differentiable and len(inputs) > 0
-
+def _make_op_fn(schema, attrs):
     if schema.num_inputs == -1:
         fn = lambda *arrs: schema.fn(list(arrs), **attrs)
     else:
@@ -710,16 +705,117 @@ def _invoke_body(schema, ctx, arrays, inputs, attrs, out):
         # original dtype (the reference amp_cast op's FGradient behavior)
         inner_fn = fn
         fn = lambda *arrs: inner_fn(*_amp_policy(schema.name, list(arrs)))
+    return fn
 
-    if record:
+
+# Per-op jit cache for the EAGER hot path (SURVEY §7: "per-op jit-compiled
+# XLA computation with a compilation cache").  An op fn is typically a
+# handful of jnp primitives; unjitted, each primitive is a separate device
+# dispatch — through the TPU tunnel that is a multi-ms RTT apiece.  Jitting
+# per (op, fn identity, amp generation, static attrs) collapses an op
+# invocation to ONE cached executable launch (the reference engine's
+# operator-bulking role, src/engine/threaded_engine.h:507-528).
+# Ops whose python body cannot trace (data-dependent shapes, host
+# round-trips) are detected by failure and permanently fall back.
+_EAGER_JIT_CACHE: "OrderedDict" = OrderedDict()   # LRU, bounded
+_EAGER_JIT_BAD: set = set()
+_EAGER_JIT_KEYCOUNT: dict = {}
+_EAGER_JIT_MAX_ENTRIES = 512      # total cached executables kept alive
+_EAGER_JIT_MAX_PER_OP = 64        # attr-cardinality cutoff: beyond this the
+                                  # op recompiles per call (slice with a
+                                  # moving begin etc.) — jit is a net loss
+
+# trace-time failure types: the op BODY cannot be traced (host value
+# inspection, data-dependent output shape).  Only these justify a
+# permanent per-op ban; anything else (bad user input, dtype errors) must
+# not disable the cache for later valid calls.
+_TRACE_FAILURES = tuple(
+    t for t in (
+        getattr(jax.errors, "ConcretizationTypeError", None),
+        getattr(jax.errors, "TracerArrayConversionError", None),
+        getattr(jax.errors, "TracerBoolConversionError", None),
+        getattr(jax.errors, "TracerIntegerConversionError", None),
+        getattr(jax.errors, "NonConcreteBooleanIndexError", None),
+        getattr(jax.errors, "UnexpectedTracerError", None),
+    ) if t is not None)
+
+
+def _attrs_key(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_attrs_key(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _attrs_key(x)) for k, x in v.items()))
+    return v
+
+
+def _eager_jit_lookup(schema, attrs, arrays):
+    from .. import config as _config
+
+    mode = _config.get("MXNET_EAGER_JIT")
+    if not mode or schema.name in _EAGER_JIT_BAD:
+        return None
+    if mode != 2 and jax.default_backend() != "tpu":
+        return None                       # RTT-bound paths only by default
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        # inside an outer trace an inner jit becomes a separate XLA call
+        # and would break producer-consumer fusion in hybridized graphs
+        return None
+    try:
+        key = (schema.name, id(schema.fn), _amp_generation,
+               tuple(sorted((k, _attrs_key(v)) for k, v in attrs.items())))
+        hash(key)
+    except TypeError:
+        return None                       # unhashable attr: plain dispatch
+    fn = _EAGER_JIT_CACHE.get(key)
+    if fn is not None:
+        _EAGER_JIT_CACHE.move_to_end(key)
+        return fn
+    n_keys = _EAGER_JIT_KEYCOUNT.get(schema.name, 0) + 1
+    if n_keys > _EAGER_JIT_MAX_PER_OP:
+        _EAGER_JIT_BAD.add(schema.name)   # attrs vary per call: jit loses
+        return None
+    _EAGER_JIT_KEYCOUNT[schema.name] = n_keys
+    fn = jax.jit(_make_op_fn(schema, attrs))
+    _EAGER_JIT_CACHE[key] = fn
+    while len(_EAGER_JIT_CACHE) > _EAGER_JIT_MAX_ENTRIES:
+        _EAGER_JIT_CACHE.popitem(last=False)
+    return fn
+
+
+def _invoke_body(schema, ctx, arrays, inputs, attrs, out):
+
+    # Record every differentiable op while the scope is active (the reference
+    # records all ops under record(), not just ones touching marked vars —
+    # autograd.grad() may later differentiate w.r.t. any graph input).
+    record = autograd.is_recording() and schema.differentiable and len(inputs) > 0
+
+    jitted = _eager_jit_lookup(schema, attrs, arrays)
+    fn = jitted if jitted is not None else _make_op_fn(schema, attrs)
+
+    while True:
         try:
-            raw_out, vjp_fn = jax.vjp(fn, *arrays)
-        except (TypeError, jax.errors.JaxRuntimeError):
-            # non-differentiable in practice (int dtypes etc.) — plain call
-            record = False
-            raw_out = fn(*arrays)
-    else:
-        raw_out = fn(*arrays)
+            if record:
+                raw_out, vjp_fn = jax.vjp(fn, *arrays)
+            else:
+                raw_out = fn(*arrays)
+            break
+        except Exception as e:
+            if jitted is not None:
+                # retry unjitted; ban the op ONLY for trace-time failures
+                # (op body can't trace: host value inspection, dynamic
+                # output shape).  Input-dependent errors (dtype, shape
+                # mismatch) must not disable the cache for valid calls.
+                if isinstance(e, _TRACE_FAILURES):
+                    _EAGER_JIT_BAD.add(schema.name)
+                jitted = None
+                fn = _make_op_fn(schema, attrs)
+                continue
+            if record and isinstance(e, (TypeError,
+                                         jax.errors.JaxRuntimeError)):
+                # non-differentiable in practice (int dtypes etc.) — plain
+                record = False
+                continue
+            raise
 
     multi = isinstance(raw_out, (tuple, list))
     outs_raw = list(raw_out) if multi else [raw_out]
@@ -743,7 +839,10 @@ def _invoke_body(schema, ctx, arrays, inputs, attrs, out):
             [tuple(o.shape) for o in outs_raw],
             [o.dtype for o in outs_raw],
             name=schema.name,
-            fn=fn,
+            # replay (higher-order grads) runs under a trace: hand it the
+            # PLAIN fn so replayed ops stay inline (an inner jit would be
+            # a separate XLA call boundary, breaking fusion)
+            fn=_make_op_fn(schema, attrs) if jitted is not None else fn,
             input_vals=list(arrays),
         )
         for i, o in enumerate(outputs):

@@ -182,6 +182,51 @@ def section_fused_stats():
           f"{tf/base:.2f}x vs XLA")
 
 
+def section_int8_pallas():
+    # The round-5 decision bench: eligible 1x1 s8 conv as (a) lax.conv
+    # s8->s32, (b) the explicit Pallas int8 MXU kernel, (c) bf16 matmul
+    # reference.  If (b) beats (a) AND (c) on chip, MXNET_INT8_PALLAS
+    # flips to default 1 (contrib/quantization.py _try_pallas_int8_1x1).
+    from mxnet_tpu.ops.pallas_kernels import int8_conv1x1, int8_blocks
+
+    key = jax.random.PRNGKey(5)
+    n, h, w_, cin, cout = 32, 28, 28, 512, 128
+    flops = 2 * n * h * w_ * cin * cout
+    qx = jax.random.randint(key, (n, h, w_, cin), -127, 128, jnp.int8)
+    qw = jax.random.randint(key, (cout, 1, 1, cin), -127, 128, jnp.int8)
+    scale = 3e-4
+    assert int8_blocks(n * h * w_, cin, cout) is not None
+
+    dn = jax.lax.conv_dimension_numbers(
+        qx.shape, (cout, 1, 1, cin), ("NHWC", "OHWI", "NHWC"))
+
+    def lax_s8(qx, qw):
+        out = jax.lax.conv_general_dilated(
+            qx, qw, (1, 1), [(0, 0), (0, 0)], dimension_numbers=dn,
+            preferred_element_type=jnp.int32)
+        return (out.astype(jnp.float32) * scale).sum()
+
+    f = jax.jit(lax_s8)
+    dt = timeit(f, qx, qw, iters=10)
+    base = flops / dt / 1e12
+    print(f"1x1 s8 lax.conv: {dt*1e3:8.2f} ms  {base:6.1f} TOP/s  1.00x")
+
+    g = jax.jit(lambda qx, qw: int8_conv1x1(qx, qw, scale).sum())
+    dt = timeit(g, qx, qw, iters=10)
+    tf = flops / dt / 1e12
+    print(f"1x1 s8 pallas:   {dt*1e3:8.2f} ms  {tf:6.1f} TOP/s  "
+          f"{tf/base:.2f}x vs lax")
+
+    bx = (qx.astype(jnp.float32) * scale).astype(jnp.bfloat16)
+    bw = qw.reshape(cout, cin).T.astype(jnp.bfloat16)
+    h2 = jax.jit(lambda x, w: (x.reshape(-1, cin) @ w)
+                 .astype(jnp.float32).sum())
+    dt = timeit(h2, bx, bw, iters=10)
+    tf = flops / dt / 1e12
+    print(f"1x1 bf16 matmul: {dt*1e3:8.2f} ms  {tf:6.1f} TFLOP/s  "
+          f"{tf/base:.2f}x vs lax-s8")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--which", default="all",
@@ -196,6 +241,8 @@ def main():
         section_bn()
     if args.which in ("all", "fused"):
         section_fused_stats()
+    if args.which in ("all", "int8"):
+        section_int8_pallas()
 
 
 if __name__ == "__main__":

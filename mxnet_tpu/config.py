@@ -153,6 +153,12 @@ declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
 declare("MXNET_ENFORCE_DETERMINISM", bool, False,
         "Disable nondeterministic optimizations (XLA autotuning picks "
         "deterministic kernels)", subsystem="engine")
+declare("MXNET_INT8_PALLAS", int, 0,
+        "Route eligible 1x1 NHWC quantized convs through the explicit "
+        "Pallas int8 MXU kernel instead of lax.conv s8.  0 = off "
+        "(default until the chip microbench decides), 1 = on for "
+        "single-device TPU, 2 = force everywhere incl. the CPU Pallas "
+        "interpreter (tests).")
 declare("MXNET_EAGER_JIT", int, 1,
         "Per-op jit compilation cache for eager dispatch (the reference "
         "engine's operator-bulking analog): one cached XLA executable per "

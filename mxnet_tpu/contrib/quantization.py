@@ -109,6 +109,35 @@ def quantized_fully_connected(arrays, num_hidden=0, no_bias=False,
     return _quantized_epilogue(out, fused_relu, out_min, out_max)
 
 
+def _try_pallas_int8_1x1(qd, qw, kernel, stride, dilate, pad, num_group,
+                         layout, scale):
+    """Route eligible 1x1 NHWC s8 convs through the explicit Pallas int8
+    MXU kernel (ops/pallas_kernels.py::int8_conv1x1) when
+    MXNET_INT8_PALLAS allows: 0 off (default until chip data), 1 on for
+    single-device TPU, 2 force incl. the CPU interpreter (tests).
+    Returns the fp32 conv output, or None to use the lax.conv path."""
+    from .. import config as _config
+
+    mode = _config.get("MXNET_INT8_PALLAS")
+    if not mode:
+        return None
+    if mode != 2 and not (jax.default_backend() == "tpu"
+                          and len(jax.devices()) == 1):
+        return None
+    if (tuple(kernel) != (1, 1) or tuple(dilate) != (1, 1)
+            or tuple(pad) != (0, 0) or num_group != 1 or layout != "NHWC"):
+        return None
+    from ..ops.pallas_kernels import int8_blocks, int8_conv1x1
+
+    sh, sw = stride
+    n, h, wd, cin = qd.shape
+    ho, wo = -(-h // sh), -(-wd // sw)
+    if int8_blocks(n * ho * wo, cin, qw.shape[0]) is None:
+        return None
+    return int8_conv1x1(qd.astype(jnp.int8), qw.astype(jnp.int8), scale,
+                        stride=(sh, sw))
+
+
 @register("quantized_conv", num_inputs=-1, differentiable=False)
 def quantized_conv(arrays, kernel=(1, 1), stride=(1, 1), dilate=(1, 1),
                    pad=(0, 0), num_filter=1, num_group=1, no_bias=False,
@@ -129,6 +158,16 @@ def quantized_conv(arrays, kernel=(1, 1), stride=(1, 1), dilate=(1, 1),
     stride = _tup(stride, nsp) if stride else (1,) * nsp
     dilate = _tup(dilate, nsp) if dilate else (1,) * nsp
     pad = _tup(pad, nsp) if pad else (0,) * nsp
+
+    pallas_out = _try_pallas_int8_1x1(
+        qd, qw, kernel, stride, dilate, pad, num_group, layout,
+        data_scale * w_scale)
+    if pallas_out is not None:
+        out = pallas_out
+        if not no_bias and len(arrays) > 2:
+            out = out + arrays[2].reshape(
+                [1] * (out.ndim - 1) + [arrays[2].shape[0]])
+        return _quantized_epilogue(out, fused_relu, out_min, out_max)
     dn = jax.lax.conv_dimension_numbers(
         qd.shape, qw.shape, _conv_dimension_numbers(layout))
     out = jax.lax.conv_general_dilated(

@@ -56,4 +56,14 @@ def get_model(name, **kwargs):
         raise ValueError(
             f"Model {name} is not supported. Available: {sorted(models)}"
         )
+    if kwargs.get("pretrained") and "classes" not in kwargs:
+        # the offline store records what the checkpoint was trained for
+        # (model_zoo/pretrained/MANIFEST.json, e.g. the real-data digits
+        # checkpoint has 10 classes) — shape the net to the weights the
+        # way the reference sizes nets to its ImageNet checkpoints
+        from ..model_store import _shipped_manifest
+
+        entry = _shipped_manifest().get(name)
+        if entry and "classes" in entry:
+            kwargs["classes"] = entry["classes"]
     return models[name](**kwargs)

@@ -782,3 +782,27 @@ def discard_stderr():
     finally:
         os.dup2(old, stderr_fileno)
         os.close(old)
+
+
+def load_digits_split(img_size: int = 32, test_fraction: float = 0.2,
+                      seed: int = 42):
+    """scikit-learn's bundled real handwritten digits, preprocessed the
+    way the shipped pretrained checkpoint was trained
+    (tools/publish_pretrained.py --data digits): [-1, 1] normalize,
+    nearest-neighbor upsample 8->img_size, 3-channel stack, fixed
+    permutation and holdout.  Returns (Xtr, Ytr, Xte, Yte) as numpy.
+    Single source of truth so the published test_acc stays reproducible
+    by tests/test_model_zoo.py."""
+    import numpy as onp
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    rep = img_size // 8
+    imgs = d.images.astype(onp.float32) / 16.0 * 2 - 1
+    imgs = imgs.repeat(rep, axis=1).repeat(rep, axis=2)
+    X = onp.stack([imgs] * 3, axis=1)
+    Y = d.target.astype(onp.int32)
+    perm = onp.random.RandomState(seed).permutation(len(X))
+    X, Y = X[perm], Y[perm]
+    n_te = int(len(X) * test_fraction)
+    return X[n_te:], Y[n_te:], X[:n_te], Y[:n_te]

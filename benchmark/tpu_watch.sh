@@ -73,7 +73,7 @@ while true; do
         # 5. remat headroom at bs256
         run_item remat_bs256 1200 env BENCH_MODEL=resnet50_v1_bf16 BENCH_BATCH=256 MXNET_BACKWARD_DO_MIRROR=1 python bench.py
         # 6. large-tensor on-chip test (>2^31 elements in HBM)
-        run_item large_tensor 900 python -m pytest tests/test_large_tensor.py -x -q -m tpu --no-header
+        run_item large_tensor 900 env MXNET_TEST_ALLOW_TPU=1 python -m pytest tests/test_large_tensor.py -x -q -m tpu --no-header
     else
         log "tunnel down"
     fi

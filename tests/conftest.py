@@ -16,7 +16,15 @@ if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     )
-os.environ["JAX_PLATFORMS"] = "cpu"
+if os.environ.get("MXNET_TEST_ALLOW_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+else:
+    # @pytest.mark.tpu runs (benchmark/tpu_watch.sh): keep the real
+    # backend; strip only the virtual-mesh flag added above, preserving
+    # any operator-supplied XLA_FLAGS (dump/tuning)
+    os.environ["XLA_FLAGS"] = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -77,3 +77,31 @@ def test_large_embedding_gradient_rows():
     g = table.grad.asnumpy()
     assert g[7].sum() == 8 and g[9].sum() == 8 and g[-1].sum() == 8
     assert onp.abs(g).sum() == 24
+
+
+@pytest.mark.tpu
+def test_past_int32_indexing_on_chip():
+    """>2^31-element array in HBM: index write/read, take, slice and a
+    full reduction past the int32 boundary (the reference nightly
+    test_large_array.py int64 families, runnable here only where HBM
+    allows — benchmark/tpu_watch.sh queue item, MXNET_TEST_ALLOW_TPU=1).
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs TPU HBM for a 4 GiB array")
+    NBIG = (1 << 31) + 128                  # 4 GiB + eps in bf16
+    x = nd.zeros((NBIG,), dtype="bfloat16")
+    x[NBIG - 3] = 7.0                       # write at a >int32 offset
+    got = nd.take(x, nd.array(onp.array([NBIG - 3, 2], onp.int64)))
+    onp.testing.assert_allclose(got.asnumpy().astype(onp.float32), [7.0, 0.0])
+    # full reduction over 2^31+ elements (fp32 accumulation, exact here)
+    assert float(x.sum().asnumpy()) == 7.0
+    # slice starting past int32
+    tail = x[NBIG - 8:].asnumpy().astype(onp.float32)
+    assert tail.shape == (8,) and tail[5] == 7.0
+    # 2-D view: row gather where rows * cols exceeds int32
+    rows = NBIG // 128
+    y = x.reshape((rows, 128))
+    row = nd.take(y, nd.array(onp.array([rows - 1], onp.int64)))
+    assert row.shape == (1, 128)

@@ -770,6 +770,9 @@ def _eager_jit_lookup(schema, attrs, arrays):
     if fn is not None:
         _EAGER_JIT_CACHE.move_to_end(key)
         return fn
+    # cutoff counts LIVE entries (decremented on eviction): a hot op with
+    # few attr sets must never accumulate into a ban via LRU churn or amp
+    # generation bumps
     n_keys = _EAGER_JIT_KEYCOUNT.get(schema.name, 0) + 1
     if n_keys > _EAGER_JIT_MAX_PER_OP:
         _EAGER_JIT_BAD.add(schema.name)   # attrs vary per call: jit loses
@@ -778,7 +781,12 @@ def _eager_jit_lookup(schema, attrs, arrays):
     fn = jax.jit(_make_op_fn(schema, attrs))
     _EAGER_JIT_CACHE[key] = fn
     while len(_EAGER_JIT_CACHE) > _EAGER_JIT_MAX_ENTRIES:
-        _EAGER_JIT_CACHE.popitem(last=False)
+        old_key, _ = _EAGER_JIT_CACHE.popitem(last=False)
+        live = _EAGER_JIT_KEYCOUNT.get(old_key[0], 1) - 1
+        if live > 0:
+            _EAGER_JIT_KEYCOUNT[old_key[0]] = live
+        else:
+            _EAGER_JIT_KEYCOUNT.pop(old_key[0], None)
     return fn
 
 

@@ -232,7 +232,8 @@ class BatchNorm(HybridBlock):
     def _fused_conv_src(self, x):
         """When ``x`` was produced by an eligible 1x1 NHWC Convolution this
         trace (see conv_layers.py producer tag), return (src_x, src_w,
-        stride) for the fused Pallas conv+BN-stats path, else None.
+        src_bias_or_None, stride) for the fused Pallas conv+BN-stats
+        path, else None.
         Single-device only: under a sharded pjit step the pallas_call has
         no partitioning rule; MXNET_FUSED_CONV_BN=2 forces (CPU tests)."""
         src = getattr(x, "_conv_src", None)
@@ -248,7 +249,7 @@ class BatchNorm(HybridBlock):
         if mode != 2 and not (_jax.default_backend() == "tpu"
                               and len(_jax.devices()) == 1):
             return None
-        sx, sw, attrs = src
+        sx, sw, sb, attrs = src
         stride = tuple(attrs.get("stride", (1, 1)))
         if (tuple(attrs.get("kernel", ())) != (1, 1)
                 or tuple(attrs.get("pad", (0, 0))) != (0, 0)
@@ -265,7 +266,7 @@ class BatchNorm(HybridBlock):
         wo = -(-w // stride[1])
         if fused_blocks(n * ho * wo, cin, sw.shape[0]) is None:
             return None
-        return sx, sw, stride
+        return sx, sw, sb, stride
 
     def forward(self, x):
         ctx = x.ctx
@@ -273,12 +274,14 @@ class BatchNorm(HybridBlock):
         if training:
             fused = self._fused_conv_src(x)
             if fused is not None:
-                sx, sw, stride = fused
+                sx, sw, sb, stride = fused
+                ins = [sx, sw] + ([sb] if sb is not None else []) \
+                    + [self.gamma.data(ctx), self.beta.data(ctx)]
                 out, mean, var = invoke(
-                    "_fused_conv1x1_bn",
-                    [sx, sw, self.gamma.data(ctx), self.beta.data(ctx)],
+                    "_fused_conv1x1_bn", ins,
                     {"stride": stride, "eps": self._epsilon,
-                     "fix_gamma": not self._scale},
+                     "fix_gamma": not self._scale,
+                     "has_bias": sb is not None},
                 )
                 m = self._momentum
                 rm = self.running_mean.data(ctx)

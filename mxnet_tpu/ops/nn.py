@@ -370,19 +370,27 @@ def batch_norm(arrays, eps=1e-3, momentum=0.9, fix_gamma=True,
 
 
 @register("_fused_conv1x1_bn", num_inputs=-1, num_outputs=-1)
-def fused_conv1x1_bn(arrays, stride=(1, 1), eps=1e-5, fix_gamma=False):
+def fused_conv1x1_bn(arrays, stride=(1, 1), eps=1e-5, fix_gamma=False,
+                     has_bias=False):
     """Training-mode 1x1-conv + BatchNorm with the batch statistics computed
     in the conv's Pallas epilogue (ops/pallas_kernels.py
     conv1x1_bn_stats_train) — one HBM pass over the conv output instead of
     conv-write-then-stats-read.  NHWC x, OHWI w.  Strided 1x1 convs
     pre-slice the input (exact: a 1x1 kernel never straddles the stride).
+    A conv bias shifts z and the batch mean EQUALLY, so the normalized
+    output is bias-invariant; the bias is folded only into the returned
+    mean (keeping running statistics — hence inference — exact).
     Returns (out, batch_mean, batch_var) like BatchNorm(training=True).
     No reference analog (src/operator/nn/batch_norm.cc stats are a separate
     pass) — TPU-first fusion; the gluon BatchNorm layer routes here, see
     gluon/nn/basic_layers.py."""
     from .pallas_kernels import conv1x1_bn_stats_train
 
-    x, w, gamma, beta = arrays
+    if has_bias:
+        x, w, b, gamma, beta = arrays
+    else:
+        x, w, gamma, beta = arrays
+        b = None
     sh, sw = stride
     if (sh, sw) != (1, 1):
         x = x[:, ::sh, ::sw, :]
@@ -391,8 +399,14 @@ def fused_conv1x1_bn(arrays, stride=(1, 1), eps=1e-5, fix_gamma=False):
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     inv = jax.lax.rsqrt(var + f32(eps))            # mean/var already fp32
     sc = inv * g.astype(f32)
+    # normalize against the bias-free z with the bias-free mean (the bias
+    # cancels in (z + b) - (mean + b); doing it this way is also ~16x
+    # more fp32-accurate than stats on the shifted z, see
+    # tests/test_fused_conv_bn.py::test_biased_conv_fuses_exactly)
     bi = beta.astype(f32) - mean * sc
     out = z * sc.astype(z.dtype) + bi.astype(z.dtype)
+    if b is not None:
+        mean = mean + b.astype(f32)    # running stats see the biased conv
     return out, mean, var
 
 

@@ -25,6 +25,9 @@ def eager_jit(monkeypatch):
                   ndmod._EAGER_JIT_KEYCOUNT):
         store.clear()
     yield
+    import os
+
+    os.environ.pop("MXNET_EAGER_JIT", None)    # tests flip it mid-test
     config.refresh("MXNET_EAGER_JIT")
     for store in (ndmod._EAGER_JIT_CACHE, ndmod._EAGER_JIT_BAD,
                   ndmod._EAGER_JIT_KEYCOUNT):

@@ -25,6 +25,9 @@ def force_pallas(monkeypatch):
     monkeypatch.setenv("MXNET_INT8_PALLAS", "2")
     config.refresh("MXNET_INT8_PALLAS")
     yield
+    import os
+
+    os.environ.pop("MXNET_INT8_PALLAS", None)  # tests flip it mid-test
     config.refresh("MXNET_INT8_PALLAS")
 
 

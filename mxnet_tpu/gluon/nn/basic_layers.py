@@ -230,10 +230,11 @@ class BatchNorm(HybridBlock):
             p.shape = (c,)
 
     def _fused_conv_src(self, x):
-        """When ``x`` was produced by an eligible 1x1 NHWC Convolution this
-        trace (see conv_layers.py producer tag), return (src_x, src_w,
-        src_bias_or_None, stride) for the fused Pallas conv+BN-stats
-        path, else None.
+        """When ``x`` was produced by an eligible NHWC Convolution this
+        trace (see conv_layers.py producer tag) — 1x1 any-stride, or
+        3x3/stride-1/pad-1 fitting the full-image VMEM tile — return
+        (src_x, src_w, src_bias_or_None, stride, kind) for the fused
+        Pallas conv+BN-stats path, else None.
         Single-device only: under a sharded pjit step the pallas_call has
         no partitioning rule; MXNET_FUSED_CONV_BN=2 forces (CPU tests)."""
         src = getattr(x, "_conv_src", None)
@@ -251,22 +252,32 @@ class BatchNorm(HybridBlock):
             return None
         sx, sw, sb, attrs = src
         stride = tuple(attrs.get("stride", (1, 1)))
-        if (tuple(attrs.get("kernel", ())) != (1, 1)
-                or tuple(attrs.get("pad", (0, 0))) != (0, 0)
-                or tuple(attrs.get("dilate", (1, 1))) != (1, 1)
+        kernel = tuple(attrs.get("kernel", ()))
+        if (tuple(attrs.get("dilate", (1, 1))) != (1, 1)
                 or attrs.get("num_group", 1) != 1
                 or attrs.get("layout") != "NHWC"
                 or self._axis not in (3, -1)
                 or str(sx.dtype) not in ("float32", "bfloat16")):
             return None
-        from ...ops.pallas_kernels import fused_blocks
+        if kernel == (1, 1) and tuple(attrs.get("pad", (0, 0))) == (0, 0):
+            from ...ops.pallas_kernels import fused_blocks
 
-        n, h, w, cin = sx.shape
-        ho = -(-h // stride[0])
-        wo = -(-w // stride[1])
-        if fused_blocks(n * ho * wo, cin, sw.shape[0]) is None:
-            return None
-        return sx, sw, sb, stride
+            n, h, w, cin = sx.shape
+            ho = -(-h // stride[0])
+            wo = -(-w // stride[1])
+            if fused_blocks(n * ho * wo, cin, sw.shape[0]) is None:
+                return None
+            return sx, sw, sb, stride, "1x1"
+        if (kernel == (3, 3) and stride == (1, 1)
+                and tuple(attrs.get("pad", (0, 0))) == (1, 1)):
+            from ...ops.pallas_kernels import conv3x3_fits
+
+            itemsize = 2 if str(sx.dtype) == "bfloat16" else 4
+            if conv3x3_fits(sx.shape, sw.shape[0],
+                            itemsize=itemsize) is None:
+                return None
+            return sx, sw, sb, stride, "3x3"
+        return None
 
     def forward(self, x):
         ctx = x.ctx
@@ -274,15 +285,16 @@ class BatchNorm(HybridBlock):
         if training:
             fused = self._fused_conv_src(x)
             if fused is not None:
-                sx, sw, sb, stride = fused
+                sx, sw, sb, stride, kind = fused
                 ins = [sx, sw] + ([sb] if sb is not None else []) \
                     + [self.gamma.data(ctx), self.beta.data(ctx)]
+                attrs = {"eps": self._epsilon,
+                         "fix_gamma": not self._scale,
+                         "has_bias": sb is not None}
+                if kind == "1x1":
+                    attrs["stride"] = stride
                 out, mean, var = invoke(
-                    "_fused_conv1x1_bn", ins,
-                    {"stride": stride, "eps": self._epsilon,
-                     "fix_gamma": not self._scale,
-                     "has_bias": sb is not None},
-                )
+                    f"_fused_conv{kind}_bn", ins, attrs)
                 m = self._momentum
                 rm = self.running_mean.data(ctx)
                 rv = self.running_var.data(ctx)

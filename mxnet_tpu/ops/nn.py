@@ -369,6 +369,25 @@ def batch_norm(arrays, eps=1e-3, momentum=0.9, fix_gamma=True,
     return (out,)
 
 
+def _fused_bn_epilogue(z, mean, var, gamma, beta, b, eps, fix_gamma):
+    """Shared normalize for the fused conv+BN ops.  Normalizes against
+    the bias-FREE z with the bias-free mean (the conv bias cancels in
+    (z + b) - (mean + b); this is also ~16x more fp32-accurate than
+    stats on the shifted z — see tests/test_fused_conv_bn.py::
+    test_biased_conv_fuses_exactly), then folds the bias into the
+    returned mean so running statistics — hence inference — see the
+    biased conv exactly."""
+    f32 = jnp.float32
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = jax.lax.rsqrt(var + f32(eps))            # mean/var already fp32
+    sc = inv * g.astype(f32)
+    bi = beta.astype(f32) - mean * sc
+    out = z * sc.astype(z.dtype) + bi.astype(z.dtype)
+    if b is not None:
+        mean = mean + b.astype(f32)
+    return out, mean, var
+
+
 @register("_fused_conv1x1_bn", num_inputs=-1, num_outputs=-1)
 def fused_conv1x1_bn(arrays, stride=(1, 1), eps=1e-5, fix_gamma=False,
                      has_bias=False):
@@ -395,19 +414,26 @@ def fused_conv1x1_bn(arrays, stride=(1, 1), eps=1e-5, fix_gamma=False,
     if (sh, sw) != (1, 1):
         x = x[:, ::sh, ::sw, :]
     z, mean, var = conv1x1_bn_stats_train(x, w)
-    f32 = jnp.float32
-    g = jnp.ones_like(gamma) if fix_gamma else gamma
-    inv = jax.lax.rsqrt(var + f32(eps))            # mean/var already fp32
-    sc = inv * g.astype(f32)
-    # normalize against the bias-free z with the bias-free mean (the bias
-    # cancels in (z + b) - (mean + b); doing it this way is also ~16x
-    # more fp32-accurate than stats on the shifted z, see
-    # tests/test_fused_conv_bn.py::test_biased_conv_fuses_exactly)
-    bi = beta.astype(f32) - mean * sc
-    out = z * sc.astype(z.dtype) + bi.astype(z.dtype)
-    if b is not None:
-        mean = mean + b.astype(f32)    # running stats see the biased conv
-    return out, mean, var
+    return _fused_bn_epilogue(z, mean, var, gamma, beta, b, eps, fix_gamma)
+
+
+@register("_fused_conv3x3_bn", num_inputs=-1, num_outputs=-1)
+def fused_conv3x3_bn(arrays, eps=1e-5, fix_gamma=False, has_bias=False):
+    """Training-mode 3x3/stride-1/pad-1 conv + BatchNorm with batch
+    statistics in the conv's Pallas epilogue (ops/pallas_kernels.py
+    conv3x3_bn_stats_train; full-image VMEM tiles, 9 shifted MXU
+    matmuls).  Bias handling identical to _fused_conv1x1_bn: the
+    normalized output is bias-invariant; the bias folds only into the
+    returned running-stat mean.  TPU-first fusion, no reference analog."""
+    from .pallas_kernels import conv3x3_bn_stats_train
+
+    if has_bias:
+        x, w, b, gamma, beta = arrays
+    else:
+        x, w, gamma, beta = arrays
+        b = None
+    z, mean, var = conv3x3_bn_stats_train(x, w)
+    return _fused_bn_epilogue(z, mean, var, gamma, beta, b, eps, fix_gamma)
 
 
 @register("LayerNorm")

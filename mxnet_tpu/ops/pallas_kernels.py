@@ -27,7 +27,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 __all__ = ["flash_attention", "matmul_bn_stats", "conv1x1_bn_stats",
-           "conv1x1_bn_stats_train", "fused_blocks"]
+           "conv1x1_bn_stats_train", "fused_blocks",
+           "conv3x3_bn_stats", "conv3x3_bn_stats_train", "conv3x3_fits",
+           "int8_matmul", "int8_conv1x1", "int8_blocks"]
 
 _NEG_INF = -1e30
 
@@ -582,3 +584,139 @@ def int8_conv1x1(qx, qw, scale, stride=(1, 1), relu=False, out_scale=None):
     out = int8_matmul(x2, w2, scale, relu=relu, out_scale=out_scale,
                       **blocks)
     return out.reshape(n, h, wd, cout)
+
+
+# ---------------------------------------------------------------------------
+# 3x3 conv + BN-stats epilogue (round-5 VERDICT #2 second half).
+#
+# ResNet-50's 16 bottleneck 3x3 convs (stride 1, pad 1) are the BN sites
+# the 1x1 fusion can't reach.  Every ResNet geometry keeps a full padded
+# image tile resident in VMEM (56x56x64 -> 430 KB ... 7x7x2048 -> 230 KB),
+# so the kernel grids over (cout-tiles, batch), pads in VMEM, and
+# accumulates the conv as 9 statically-shifted matmuls on the MXU, with
+# the same race-free batch-accumulated sum/sumsq epilogue as
+# matmul_bn_stats (batch is the inner, sequential grid dim).
+# No reference analog (src/operator/nn/batch_norm.cc stats are a
+# separate pass) — TPU-first fusion.
+# ---------------------------------------------------------------------------
+
+
+def _c3x3_kernel(x_ref, w_ref, o_ref, s_ref, ss_ref, *, hh, ww):
+    bi = pl.program_id(1)
+    x = x_ref[0].astype(jnp.float32)                  # (H, W, Cin)
+    xp = jnp.pad(x, ((1, 1), (1, 1), (0, 0)))
+    cin = x.shape[-1]
+    bn = w_ref.shape[0]
+    acc = jnp.zeros((hh * ww, bn), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            xs = xp[dy:dy + hh, dx:dx + ww, :].reshape(hh * ww, cin)
+            wt = w_ref[:, dy, dx, :].astype(jnp.float32).T   # (Cin, bn)
+            acc = acc + xs @ wt
+    o_ref[0] = acc.reshape(hh, ww, bn).astype(o_ref.dtype)
+    part = jnp.sum(acc, axis=0, keepdims=True)        # (1, bn)
+    part_sq = jnp.sum(acc * acc, axis=0, keepdims=True)
+
+    @pl.when(bi == 0)
+    def _init():
+        s_ref[...] = part
+        ss_ref[...] = part_sq
+
+    @pl.when(bi != 0)
+    def _accum():
+        s_ref[...] += part
+        ss_ref[...] += part_sq
+
+
+def conv3x3_fits(xshape, cout, block_n=128, vmem_budget=10 * 2 ** 20,
+                 itemsize=2):
+    """Eligibility for the full-image-tile 3x3 kernel: stride-1/pad-1
+    NHWC geometry whose tiles stay inside the VMEM budget, with a
+    Mosaic-friendly cout tiling.  ``itemsize`` is the storage dtype's
+    byte width (2 for bf16, 4 for fp32)."""
+    n, h, w, cin = xshape
+    bn = min(block_n, cout)
+    if cout % bn or (bn % 128 and bn != cout):
+        return None
+    vmem = (h * w * cin * itemsize                 # input tile as loaded
+            + (h + 2) * (w + 2) * cin * 4          # padded fp32 image
+            + h * w * bn * 4                       # fp32 accumulator
+            + 9 * cin * bn * 4                     # weight taps (fp32)
+            + h * w * bn * itemsize)               # output tile
+    if vmem > vmem_budget:
+        return None
+    return {"block_n": bn}
+
+
+def conv3x3_bn_stats(x, w, block_n=128):
+    """x (N,H,W,Cin) NHWC, w (Cout,3,3,Cin) OHWI, stride 1, pad 1 ->
+    (z (N,H,W,Cout), mean (Cout,), var (Cout,)), stats fp32."""
+    n, h, wd, cin = x.shape
+    cout = w.shape[0]
+    fit = conv3x3_fits(x.shape, cout, block_n,
+                       itemsize=jnp.dtype(x.dtype).itemsize)
+    assert fit is not None, (x.shape, cout)
+    bn = fit["block_n"]
+    grid = (cout // bn, n)                        # batch innermost
+    kernel = functools.partial(_c3x3_kernel, hh=h, ww=wd)
+    z, s, ss = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h, wd, cin), lambda ci, b: (b, 0, 0, 0)),
+            pl.BlockSpec((bn, 3, 3, cin), lambda ci, b: (ci, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, wd, bn), lambda ci, b: (b, 0, 0, ci)),
+            pl.BlockSpec((1, bn), lambda ci, b: (0, ci)),
+            pl.BlockSpec((1, bn), lambda ci, b: (0, ci)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, wd, cout), x.dtype),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, w)
+    cnt = jnp.float32(n * h * wd)
+    mean = s[0] / cnt
+    var = jnp.maximum(ss[0] / cnt - mean * mean, 0.0)
+    return z, mean, var
+
+
+def _ref_conv3x3(x, w):
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NHWC", "OHWI", "NHWC"))
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn)
+
+
+@jax.custom_vjp
+def conv3x3_bn_stats_train(x, w):
+    """Differentiable (z, mean, var) of a stride-1/pad-1 3x3 NHWC conv
+    with fused batch statistics.  Caller pre-checks conv3x3_fits."""
+    return conv3x3_bn_stats(x, w)
+
+
+def _c3x3_fwd_vjp(x, w):
+    z, mean, var = conv3x3_bn_stats(x, w)
+    return (z, mean, var), (x, w, z, mean)
+
+
+def _c3x3_bwd(res, cts):
+    x, w, z, mean = res
+    gz, gmean, gvar = cts
+    n, h, wd, _ = x.shape
+    cout = w.shape[0]
+    m = n * h * wd
+    z32 = z.astype(jnp.float32)
+    g = (gz.astype(jnp.float32)
+         + gmean.astype(jnp.float32) / m
+         + gvar.astype(jnp.float32) * 2.0 * (z32 - mean) / m)
+    # conv input/weight grads through XLA's own transposed convs (MXU)
+    _, vjp = jax.vjp(_ref_conv3x3, x, w)
+    dx, dw = vjp(g.astype(z.dtype))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+conv3x3_bn_stats_train.defvjp(_c3x3_fwd_vjp, _c3x3_bwd)

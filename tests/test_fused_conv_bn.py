@@ -219,23 +219,26 @@ def test_fused_path_actually_taken(force_fused):
 
 
 def test_ineligible_geometry_falls_back(force_fused):
-    """3x3 kernel, NCHW layout, and biased convs never take the fused op."""
+    """Strided 3x3, NCHW layout, and conv-activation pairs never take
+    EITHER fused op."""
     from mxnet_tpu.ops.registry import get_op
 
-    schema = get_op("_fused_conv1x1_bn")
     calls = {"n": 0}
-    orig = schema.fn
+    origs = []
+    for name in ("_fused_conv1x1_bn", "_fused_conv3x3_bn"):
+        schema = get_op(name)
+        origs.append((schema, schema.fn))
 
-    def counting(*a, **k):
-        calls["n"] += 1
-        return orig(*a, **k)
+        def counting(*a, _f=schema.fn, **k):
+            calls["n"] += 1
+            return _f(*a, **k)
 
-    schema.fn = counting
+        schema.fn = counting
     try:
         cases = [
-            (nn.Conv2D(8, kernel_size=3, padding=1, use_bias=False,
-                       layout="NHWC"), nn.BatchNorm(axis=3),
-             (2, 8, 8, 4)),
+            (nn.Conv2D(8, kernel_size=3, strides=2, padding=1,
+                       use_bias=False, layout="NHWC"), nn.BatchNorm(axis=3),
+             (2, 8, 8, 4)),            # strided 3x3: lax.conv path
             (nn.Conv2D(8, kernel_size=1, use_bias=False, layout="NCHW"),
              nn.BatchNorm(axis=1), (2, 4, 8, 8)),
             (nn.Conv2D(8, kernel_size=1, use_bias=False, layout="NHWC",
@@ -254,7 +257,8 @@ def test_ineligible_geometry_falls_back(force_fused):
                 net(x)
         assert calls["n"] == 0
     finally:
-        schema.fn = orig
+        for schema, fn in origs:
+            schema.fn = fn
 
 
 def test_biased_conv_fuses_exactly(force_fused):
@@ -303,10 +307,11 @@ def test_biased_conv_fuses_exactly(force_fused):
                                 rtol=5e-2, atol=5e-2, err_msg="weight_grad")
 
 
-def test_resnet50_fuses_all_1x1_sites(force_fused):
-    """All 36 1x1-conv+BN sites of resnet50_v1 NHWC route through the
-    fused op in one hybridized train trace (16 bottlenecks x
-    (conv1 + conv3) + 4 downsamples)."""
+def test_resnet50_fuses_all_conv_bn_sites(force_fused):
+    """resnet50_v1 NHWC in one hybridized train trace: all 36 1x1 sites
+    (16 bottlenecks x (conv1 + conv3) + 4 downsamples) AND all 16
+    3x3 sites route through the fused ops — 52 of 52 conv+BN pairs
+    (only the s2d stem's 4x4 conv stays unfused)."""
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.ops.registry import get_op
 
@@ -315,22 +320,93 @@ def test_resnet50_fuses_all_1x1_sites(force_fused):
     x = mx.nd.array(_rand(8, 32, 32, 3))
     net(x)
     net.hybridize()
-    schema = get_op("_fused_conv1x1_bn")
-    calls = {"n": 0}
-    orig = schema.fn
+    counts = {"1x1": 0, "3x3": 0}
+    origs = {}
+    for kind in counts:
+        schema = get_op(f"_fused_conv{kind}_bn")
+        origs[kind] = (schema, schema.fn)
 
-    def counting(*a, **k):
-        calls["n"] += 1
-        return orig(*a, **k)
+        def counting(*a, _k=kind, _f=schema.fn, **kw):
+            counts[_k] += 1
+            return _f(*a, **kw)
 
-    schema.fn = counting
+        schema.fn = counting
     try:
         with autograd.record():
             loss = (net(x) ** 2).sum()
         loss.backward()
     finally:
-        schema.fn = orig
-    assert calls["n"] == 36, calls["n"]
+        for schema, fn in origs.values():
+            schema.fn = fn
+    assert counts == {"1x1": 36, "3x3": 16}, counts
+
+
+def test_conv3x3_fused_matches_unfused(force_fused):
+    """3x3/stride-1/pad-1 conv + BN: fused output, gradients, and
+    running stats equal the unfused path."""
+    import os
+
+    x = mx.nd.array(_rand(2, 8, 8, 16))
+    nets = []
+    for _ in range(2):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(32, kernel_size=3, padding=1, use_bias=False,
+                          layout="NHWC"))
+        net.add(nn.BatchNorm(axis=3))
+        net.initialize(mx.init.Xavier())
+        net(x)
+        nets.append(net)
+    src = nets[0].collect_params()
+    for n_, p in nets[1].collect_params().items():
+        p._data[0]._set_data(src[n_]._data[0]._data)
+    results = {}
+    for env, net in (("2", nets[0]), ("0", nets[1])):
+        os.environ["MXNET_FUSED_CONV_BN"] = env
+        config.refresh("MXNET_FUSED_CONV_BN")
+        net.hybridize()
+        with autograd.record():
+            out = net(x)
+            loss = (out * out).sum()
+        loss.backward()
+        results[env] = (out.asnumpy(),
+                        net[1].running_mean._data[0].asnumpy(),
+                        net[1].running_var._data[0].asnumpy(),
+                        net[0].weight._data[0].grad.asnumpy())
+    for i, name in enumerate(["out", "running_mean", "running_var",
+                              "weight_grad"]):
+        onp.testing.assert_allclose(results["2"][i], results["0"][i],
+                                    rtol=2e-3, atol=2e-3, err_msg=name)
+
+
+def test_conv3x3_vjp_matches_autodiff_reference():
+    """d(loss)/d(x,w) through the 3x3 Pallas forward + explicit backward
+    equals autodiff of the equivalent pure-XLA conv+stats."""
+    from mxnet_tpu.ops.pallas_kernels import (conv3x3_bn_stats_train,
+                                              _ref_conv3x3)
+
+    x = jnp.asarray(_rand(2, 6, 6, 8))
+    w = jnp.asarray(_rand(16, 3, 3, 8) * 0.2)
+
+    def ref(x, w):
+        z = _ref_conv3x3(x, w)
+        m = z.shape[0] * z.shape[1] * z.shape[2]
+        z2 = z.reshape(m, -1)
+        mean = jnp.mean(z2, axis=0)
+        var = jnp.mean(z2 * z2, axis=0) - mean ** 2
+        return z, mean, var
+
+    def loss(fn, x, w):
+        z, mean, var = fn(x, w)
+        return (jnp.sum(z * z) + 3.0 * jnp.sum(mean * mean)
+                + 0.5 * jnp.sum(var))
+
+    gx, gw = jax.grad(lambda x, w: loss(conv3x3_bn_stats_train, x, w),
+                      argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(lambda x, w: loss(ref, x, w), argnums=(0, 1))(x, w)
+    onp.testing.assert_allclose(onp.asarray(gx), onp.asarray(rx),
+                                rtol=1e-3, atol=1e-4)
+    onp.testing.assert_allclose(onp.asarray(gw), onp.asarray(rw),
+                                rtol=1e-3, atol=1e-4)
 
 
 def test_inplace_mutation_clears_tag(force_fused):

@@ -186,7 +186,7 @@ def section_int8_pallas():
     # The round-5 decision bench: eligible 1x1 s8 conv as (a) lax.conv
     # s8->s32, (b) the explicit Pallas int8 MXU kernel, (c) bf16 matmul
     # reference.  If (b) beats (a) AND (c) on chip, MXNET_INT8_PALLAS
-    # flips to default 1 (contrib/quantization.py _try_pallas_int8_1x1).
+    # flips to default 1 (contrib/quantization.py _try_pallas_int8).
     from mxnet_tpu.ops.pallas_kernels import int8_conv1x1, int8_blocks
 
     key = jax.random.PRNGKey(5)
@@ -225,6 +225,30 @@ def section_int8_pallas():
     tf = flops / dt / 1e12
     print(f"1x1 bf16 matmul: {dt*1e3:8.2f} ms  {tf:6.1f} TFLOP/s  "
           f"{tf/base:.2f}x vs lax-s8")
+
+    # 3x3 row: the full-image-tile s8 kernel vs lax.conv s8
+    from mxnet_tpu.ops.pallas_kernels import int8_conv3x3
+
+    qw3 = jax.random.randint(key, (cout, 3, 3, cin), -127, 128, jnp.int8)
+    flops3 = 9 * flops
+    dn3 = jax.lax.conv_dimension_numbers(
+        qx.shape, (cout, 3, 3, cin), ("NHWC", "OHWI", "NHWC"))
+
+    def lax3(qx, qw3):
+        out = jax.lax.conv_general_dilated(
+            qx, qw3, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn3,
+            preferred_element_type=jnp.int32)
+        return (out.astype(jnp.float32) * scale).sum()
+
+    f3 = jax.jit(lax3)
+    dt = timeit(f3, qx, qw3, iters=10)
+    base3 = flops3 / dt / 1e12
+    print(f"3x3 s8 lax.conv: {dt*1e3:8.2f} ms  {base3:6.1f} TOP/s  1.00x")
+    g3 = jax.jit(lambda qx, qw3: int8_conv3x3(qx, qw3, scale).sum())
+    dt = timeit(g3, qx, qw3, iters=10)
+    tf = flops3 / dt / 1e12
+    print(f"3x3 s8 pallas:   {dt*1e3:8.2f} ms  {tf:6.1f} TOP/s  "
+          f"{tf/base3:.2f}x vs lax")
 
 
 def main():

@@ -109,10 +109,11 @@ def quantized_fully_connected(arrays, num_hidden=0, no_bias=False,
     return _quantized_epilogue(out, fused_relu, out_min, out_max)
 
 
-def _try_pallas_int8_1x1(qd, qw, kernel, stride, dilate, pad, num_group,
-                         layout, scale):
-    """Route eligible 1x1 NHWC s8 convs through the explicit Pallas int8
-    MXU kernel (ops/pallas_kernels.py::int8_conv1x1) when
+def _try_pallas_int8(qd, qw, kernel, stride, dilate, pad, num_group,
+                     layout, scale):
+    """Route eligible NHWC s8 convs through the explicit Pallas int8 MXU
+    kernels (ops/pallas_kernels.py::int8_conv1x1 / int8_conv3x3 — 1x1
+    any-stride, 3x3 stride-1/pad-1 full-image tiles) when
     MXNET_INT8_PALLAS allows: 0 off (default until chip data), 1 on for
     single-device TPU, 2 force incl. the CPU interpreter (tests).
     Returns the fp32 conv output, or None to use the lax.conv path."""
@@ -124,18 +125,26 @@ def _try_pallas_int8_1x1(qd, qw, kernel, stride, dilate, pad, num_group,
     if mode != 2 and not (jax.default_backend() == "tpu"
                           and len(jax.devices()) == 1):
         return None
-    if (tuple(kernel) != (1, 1) or tuple(dilate) != (1, 1)
-            or tuple(pad) != (0, 0) or num_group != 1 or layout != "NHWC"):
+    if (tuple(dilate) != (1, 1) or num_group != 1 or layout != "NHWC"):
         return None
-    from ..ops.pallas_kernels import int8_blocks, int8_conv1x1
+    from ..ops.pallas_kernels import (conv3x3_fits, int8_blocks,
+                                      int8_conv1x1, int8_conv3x3)
 
-    sh, sw = stride
-    n, h, wd, cin = qd.shape
-    ho, wo = -(-h // sh), -(-wd // sw)
-    if int8_blocks(n * ho * wo, cin, qw.shape[0]) is None:
-        return None
-    return int8_conv1x1(qd.astype(jnp.int8), qw.astype(jnp.int8), scale,
-                        stride=(sh, sw))
+    if tuple(kernel) == (1, 1) and tuple(pad) == (0, 0):
+        sh, sw = stride
+        n, h, wd, cin = qd.shape
+        ho, wo = -(-h // sh), -(-wd // sw)
+        if int8_blocks(n * ho * wo, cin, qw.shape[0]) is None:
+            return None
+        return int8_conv1x1(qd.astype(jnp.int8), qw.astype(jnp.int8),
+                            scale, stride=(sh, sw))
+    if (tuple(kernel) == (3, 3) and tuple(stride) == (1, 1)
+            and tuple(pad) == (1, 1)):
+        if conv3x3_fits(qd.shape, qw.shape[0], itemsize=1) is None:
+            return None
+        return int8_conv3x3(qd.astype(jnp.int8), qw.astype(jnp.int8),
+                            scale)
+    return None
 
 
 @register("quantized_conv", num_inputs=-1, differentiable=False)
@@ -159,7 +168,7 @@ def quantized_conv(arrays, kernel=(1, 1), stride=(1, 1), dilate=(1, 1),
     dilate = _tup(dilate, nsp) if dilate else (1,) * nsp
     pad = _tup(pad, nsp) if pad else (0,) * nsp
 
-    pallas_out = _try_pallas_int8_1x1(
+    pallas_out = _try_pallas_int8(
         qd, qw, kernel, stride, dilate, pad, num_group, layout,
         data_scale * w_scale)
     if pallas_out is not None:

@@ -29,7 +29,7 @@ from jax.experimental import pallas as pl
 __all__ = ["flash_attention", "matmul_bn_stats", "conv1x1_bn_stats",
            "conv1x1_bn_stats_train", "fused_blocks",
            "conv3x3_bn_stats", "conv3x3_bn_stats_train", "conv3x3_fits",
-           "int8_matmul", "int8_conv1x1", "int8_blocks"]
+           "int8_matmul", "int8_conv1x1", "int8_conv3x3", "int8_blocks"]
 
 _NEG_INF = -1e30
 
@@ -488,8 +488,9 @@ conv1x1_bn_stats_train.defvjp(_c1x1_fwd_vjp, _c1x1_bwd)
 # dequant epilogue (and optional fused relu / s8 requantize) in VMEM.
 # Reference rationale: src/operator/quantization/quantized_conv.cc exists
 # to beat fp32 by >2x; same contract here against bf16.
-# Wired for 1x1 convs via contrib/quantization.py::quantized_conv
-# (MXNET_INT8_PALLAS); 3x3 stays on lax.conv until chip data says more.
+# Wired via contrib/quantization.py::_try_pallas_int8 (MXNET_INT8_PALLAS):
+# 1x1 any-stride here, 3x3/stride-1/pad-1 via int8_conv3x3 below; other
+# geometries stay on lax.conv.
 # ---------------------------------------------------------------------------
 
 
@@ -720,3 +721,55 @@ def _c3x3_bwd(res, cts):
 
 
 conv3x3_bn_stats_train.defvjp(_c3x3_fwd_vjp, _c3x3_bwd)
+
+
+# ---------------------------------------------------------------------------
+# int8 3x3 conv (stride-1/pad-1 NHWC): the quantized counterpart of the
+# full-image-tile 3x3 kernel above — 9 shifted s8 matmuls with s32
+# accumulation, fp32 dequant epilogue.  Together with int8_conv1x1 this
+# covers every ResNet-50 conv except the stem.
+# ---------------------------------------------------------------------------
+
+
+def _c3x3_int8_kernel(x_ref, w_ref, o_ref, *, hh, ww, scale, relu):
+    x = x_ref[0]                                     # (H, W, Cin) s8
+    xp = jnp.pad(x, ((1, 1), (1, 1), (0, 0)))
+    cin = x.shape[-1]
+    bn = w_ref.shape[0]
+    acc = jnp.zeros((hh * ww, bn), jnp.int32)
+    for dy in range(3):
+        for dx in range(3):
+            xs = xp[dy:dy + hh, dx:dx + ww, :].reshape(hh * ww, cin)
+            wt = w_ref[:, dy, dx, :].T               # (Cin, bn) s8
+            acc = acc + jax.lax.dot_general(
+                xs, wt, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * scale
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    o_ref[0] = out.reshape(hh, ww, bn)
+
+
+def int8_conv3x3(qx, qw, scale, relu=False, block_n=128):
+    """3x3/stride-1/pad-1 NHWC s8 conv: qx (N,H,W,Cin) s8,
+    qw (Cout,3,3,Cin) s8 OHWI -> fp32 (N,H,W,Cout) scaled by ``scale``.
+    Caller pre-checks :func:`conv3x3_fits` (itemsize=1)."""
+    n, h, wd, cin = qx.shape
+    cout = qw.shape[0]
+    fit = conv3x3_fits(qx.shape, cout, block_n, itemsize=1)
+    assert fit is not None, (qx.shape, cout)
+    bn = fit["block_n"]
+    grid = (cout // bn, n)
+    kernel = functools.partial(_c3x3_int8_kernel, hh=h, ww=wd,
+                               scale=float(scale), relu=relu)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h, wd, cin), lambda ci, b: (b, 0, 0, 0)),
+            pl.BlockSpec((bn, 3, 3, cin), lambda ci, b: (ci, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, wd, bn), lambda ci, b: (b, 0, 0, ci)),
+        out_shape=jax.ShapeDtypeStruct((n, h, wd, cout), jnp.float32),
+        interpret=_interpret(),
+    )(qx, qw)

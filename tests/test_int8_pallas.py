@@ -86,16 +86,57 @@ def test_quantized_conv_pallas_matches_lax(force_pallas, stride, bias, relu):
     onp.testing.assert_allclose(outs["2"], outs["0"], rtol=1e-5, atol=1e-5)
 
 
+def test_int8_conv3x3_exact_integer_math():
+    """The full-image-tile 3x3 s8 kernel matches an exact int64 oracle."""
+    from mxnet_tpu.ops.pallas_kernels import int8_conv3x3
+
+    rng = onp.random.RandomState(7)
+    qx = onp.asarray(rng.randint(-80, 81, (2, 5, 6, 16)), onp.int8)
+    qw = onp.asarray(rng.randint(-80, 81, (32, 3, 3, 16)), onp.int8)
+    scale = 0.007
+    out = onp.asarray(int8_conv3x3(jnp.asarray(qx), jnp.asarray(qw), scale))
+    # int64 oracle: explicit padded 9-tap accumulation
+    xp = onp.zeros((2, 7, 8, 16), onp.int64)
+    xp[:, 1:6, 1:7, :] = qx
+    ref = onp.zeros((2, 5, 6, 32), onp.int64)
+    for dy in range(3):
+        for dx in range(3):
+            patch = xp[:, dy:dy + 5, dx:dx + 6, :]          # (2,5,6,16)
+            ref += onp.einsum("nhwc,oc->nhwo", patch,
+                              qw[:, dy, dx, :].astype(onp.int64))
+    onp.testing.assert_allclose(out, ref.astype(onp.float32) * scale,
+                                rtol=1e-6, atol=1e-6)
+
+
+def test_quantized_conv_3x3_pallas_matches_lax(force_pallas):
+    import os
+
+    rng = onp.random.RandomState(3)
+    qd = mx.nd.array(rng.randint(-64, 65, (2, 8, 8, 16)), dtype="int8")
+    qw3 = mx.nd.array(rng.randint(-64, 65, (32, 3, 3, 16)), dtype="int8")
+    attrs = dict(kernel=(3, 3), pad=(1, 1), num_filter=32, layout="NHWC",
+                 no_bias=True, data_scale=0.1, w_scale=0.1,
+                 fused_relu=True)
+    outs = {}
+    for mode in ("2", "0"):
+        os.environ["MXNET_INT8_PALLAS"] = mode
+        config.refresh("MXNET_INT8_PALLAS")
+        outs[mode] = onp.asarray(
+            q.quantized_conv([qd._data, qw3._data], **attrs))
+    onp.testing.assert_allclose(outs["2"], outs["0"], rtol=1e-5, atol=1e-5)
+
+
 def test_quantized_conv_ineligible_falls_back(force_pallas):
-    """3x3 and NCHW always use the lax.conv route even when forced."""
+    """Strided/dilated 3x3 and NCHW always use the lax.conv route even
+    when forced."""
     rng = onp.random.RandomState(3)
     qd = onp.asarray(rng.randint(-10, 10, (1, 4, 4, 8)), onp.int8)
     qw3 = onp.asarray(rng.randint(-10, 10, (8, 3, 3, 8)), onp.int8)
     out = q.quantized_conv([jnp.asarray(qd), jnp.asarray(qw3)],
-                           kernel=(3, 3), pad=(1, 1), num_filter=8,
-                           layout="NHWC", no_bias=True,
+                           kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           num_filter=8, layout="NHWC", no_bias=True,
                            data_scale=0.1, w_scale=0.1)
-    assert onp.asarray(out).shape == (1, 4, 4, 8)
+    assert onp.asarray(out).shape == (1, 2, 2, 8)
 
 
 def test_quantize_net_end_to_end_with_pallas(force_pallas):

@@ -29,6 +29,13 @@ LOW_PRECISION_FUNCS = [
     "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
     "interleaved_matmul_encdec_valatt", "linalg_gemm", "linalg_gemm2",
     "_rnn_fused", "DeformableConvolution", "ModulatedDeformableConvolution",
+    # fused conv+BN (ops/nn.py): conv-dominated, classified LOW for the
+    # registry-exhaustiveness contract, but amp/__init__.py::_policy has
+    # a DEDICATED rule: conv operands (x, w, bias) cast down like
+    # Convolution while the trailing gamma/beta stay fp32 like the
+    # unfused BatchNorm (FP32_FUNCS) — parameter values and running
+    # stats must not round
+    "_fused_conv1x1_bn", "_fused_conv3x3_bn",
     "Correlation", "khatri_rao",
 ]
 

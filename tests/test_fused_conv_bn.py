@@ -482,6 +482,64 @@ def test_default_mode_off_on_cpu(no_fused):
         schema.fn = orig
 
 
+def test_amp_keeps_bn_params_fp32_in_fused_op(force_fused):
+    """Under amp.init('bfloat16') the fused op's conv operands cast down
+    like Convolution but gamma/beta stay fp32 like the unfused BatchNorm
+    (dedicated rule in amp/__init__.py::_policy) — running statistics
+    must match the unfused AMP path tightly."""
+    from mxnet_tpu import amp
+
+    x = mx.nd.array(_rand(2, 8, 8, 16))
+    nets = []
+    for _ in range(2):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(32, kernel_size=1, use_bias=True, layout="NHWC"))
+        net.add(nn.BatchNorm(axis=3))
+        net.initialize(mx.init.Xavier())
+        net(x)
+        nets.append(net)
+    src = nets[0].collect_params()
+    for n_, p in nets[1].collect_params().items():
+        p._data[0]._set_data(src[n_]._data[0]._data)
+    amp.init("bfloat16")
+    try:
+        import os
+
+        seen_dtypes = {}
+        from mxnet_tpu.ops.registry import get_op
+
+        schema = get_op("_fused_conv1x1_bn")
+        orig = schema.fn
+
+        def spying(arrays, **kw):
+            seen_dtypes["in"] = [str(a.dtype) for a in arrays]
+            return orig(arrays, **kw)
+
+        schema.fn = spying
+        results = {}
+        try:
+            for env, net in (("2", nets[0]), ("0", nets[1])):
+                os.environ["MXNET_FUSED_CONV_BN"] = env
+                config.refresh("MXNET_FUSED_CONV_BN")
+                net.hybridize()
+                with autograd.record():
+                    out = net(x)
+                    ((out * out).sum()).backward()
+                results[env] = (
+                    net[1].running_mean._data[0].asnumpy(),
+                    net[1].running_var._data[0].asnumpy())
+        finally:
+            schema.fn = orig
+        # conv operands went bf16, BN params stayed fp32
+        assert seen_dtypes["in"][:3] == ["bfloat16"] * 3
+        assert seen_dtypes["in"][3:] == ["float32", "float32"]
+        for i, name in enumerate(["running_mean", "running_var"]):
+            onp.testing.assert_allclose(results["2"][i], results["0"][i],
+                                        rtol=2e-3, atol=2e-3, err_msg=name)
+    finally:
+        amp.uninit()
+
+
 def test_fused_blocks_picker():
     from mxnet_tpu.ops.pallas_kernels import fused_blocks
 

@@ -59,7 +59,8 @@ def seed_everything(request):
 
 
 def pytest_runtest_makereport(item, call):
-    if call.when == "call" and call.excinfo is not None:
+    if (call.when == "call" and call.excinfo is not None
+            and not call.excinfo.errisinstance(pytest.skip.Exception)):
         name = item.nodeid
         seed = int(hashlib.sha1(name.encode()).hexdigest()[:8], 16)
         print(f"\n*** test failed with MXNET_TEST_SEED={seed} "

@@ -144,3 +144,9 @@ def test_horovod_style_example():
     out = _run("example/distributed_training-horovod/"
                "train_horovod_style.py", "--steps", "60")
     assert "horovod-style kvstore: rank 0/" in out
+
+
+@pytest.mark.slow
+def test_quantization_example():
+    out = _run("example/quantization/quantize_digits.py")
+    assert "top-1 agreement" in out

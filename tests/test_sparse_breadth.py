@@ -252,3 +252,36 @@ def test_sparse_adam_touches_only_sampled_rows():
     v = 0.001 * g * g
     expect = w0[touched] - 0.01 * m / (onp.sqrt(v) + 1e-8)
     onp.testing.assert_allclose(w1[touched], expect, rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_lazy_adam_sparse_embedding_end_to_end():
+    """The full reference composition: Embedding(sparse_grad=True) +
+    Trainer('adam', lazy_update=True) — rows never sampled keep their
+    weights bit-exactly across steps while sampled rows train
+    (reference optimizer_op.cc lazy adam + sparse embedding grads)."""
+    from mxnet_tpu.gluon import Trainer, nn
+
+    net = nn.Embedding(40, 6, sparse_grad=True)
+    net.initialize(mx.init.Normal(0.3))
+    ids = nd.array(onp.array([1, 5, 9, 5], dtype=onp.int32))
+    target = nd.array(onp.random.RandomState(7).randn(4, 6)
+                      .astype(onp.float32))
+    net(ids)
+    net.hybridize()
+    w0 = net.weight.data(mx.current_context()).asnumpy().copy()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.05, "lazy_update": True})
+    first = last = None
+    for _ in range(10):
+        with autograd.record():
+            loss = ((net(ids) - target) ** 2).mean()
+        loss.backward()
+        trainer.step(4)
+        v = float(loss.asscalar())
+        first = first if first is not None else v
+        last = v
+    w1 = net.weight.data(mx.current_context()).asnumpy()
+    untouched = [i for i in range(40) if i not in (1, 5, 9)]
+    assert onp.array_equal(w1[untouched], w0[untouched])
+    assert not onp.allclose(w1[[1, 5, 9]], w0[[1, 5, 9]])
+    assert last < first

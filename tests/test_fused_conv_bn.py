@@ -136,14 +136,14 @@ def test_custom_vjp_matches_autodiff_reference():
                                 rtol=1e-4, atol=1e-4)
 
 
-def _bottleneck_pair():
+def _bottleneck_pair(stride=2):
     """Two identically-initialized NHWC bottlenecks (fresh jit caches)."""
     from mxnet_tpu.gluon.model_zoo.vision.resnet import BottleneckV1
 
     x = mx.nd.array(_rand(2, 8, 8, 32))
     blocks = []
     for _ in range(2):
-        b = BottleneckV1(64, stride=2, downsample=True, in_channels=32,
+        b = BottleneckV1(64, stride=stride, downsample=True, in_channels=32,
                          layout="NHWC")
         b.initialize(mx.init.Xavier())
         b(x)  # materialize shapes
@@ -556,3 +556,28 @@ def test_fused_blocks_picker():
     # array dim even when not quantum-aligned)
     assert fused_blocks(7, 64, 64) == {"block_m": 7, "block_n": 64,
                                        "block_k": 64}
+
+
+def test_fused_path_composes_with_remat(force_fused):
+    """hybridize(remat=True) wraps the traced forward in jax.checkpoint;
+    the fused ops' custom VJPs must recompute correctly under it (the
+    chip remat-bs256 run combines exactly these two features)."""
+    import os
+
+    x, fused_net, plain_net = _bottleneck_pair(stride=1)
+    grads = {}
+    for env, net, remat in (("2", fused_net, True), ("0", plain_net, False)):
+        os.environ["MXNET_FUSED_CONV_BN"] = env
+        config.refresh("MXNET_FUSED_CONV_BN")
+        net.hybridize(remat=remat)
+        with autograd.record():
+            out = net(x)
+            loss = (out * out).sum()
+        loss.backward()
+        grads[env] = {n: p._data[0].grad.asnumpy()
+                      for n, p in net.collect_params().items()
+                      if p.grad_req != "null"}
+    assert set(grads["2"]) == set(grads["0"]) and grads["2"]
+    for n in grads["0"]:
+        onp.testing.assert_allclose(grads["2"][n], grads["0"][n],
+                                    rtol=5e-3, atol=5e-3, err_msg=n)

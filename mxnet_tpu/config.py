@@ -220,6 +220,11 @@ declare("BENCH_S2D", bool, True,
         "bench.py ResNet lanes: space-to-depth stem rewrite (exact, "
         "MLPerf trick); 0 restores the plain 7x7/stride-2 conv0",
         subsystem="bench")
+declare("BENCH_INT8_AB", bool, True,
+        "bench.py int8 lane: run the in-lane Pallas-kernel A/B "
+        "(MXNET_INT8_PALLAS=1 retrace) after the lax path and report "
+        "the faster with provenance.  Off-chip runs skip it regardless.",
+        subsystem="bench")
 declare("BENCH_ACCUM", int, 1,
         "bench.py BERT gradient-accumulation factor",
         validator=lambda v: v >= 1, subsystem="bench")

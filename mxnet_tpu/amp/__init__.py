@@ -40,7 +40,7 @@ class _AmpState:
 _STATE = _AmpState()
 
 
-_FUSED_CONV_BN = frozenset(("_fused_conv1x1_bn", "_fused_conv3x3_bn"))
+_FUSED_CONV_BN = frozenset(("_fused_conv1x1_bn", "_fused_convkxk_bn"))
 
 
 def _policy(op_name, arrays):

@@ -35,7 +35,7 @@ LOW_PRECISION_FUNCS = [
     # Convolution while the trailing gamma/beta stay fp32 like the
     # unfused BatchNorm (FP32_FUNCS) — parameter values and running
     # stats must not round
-    "_fused_conv1x1_bn", "_fused_conv3x3_bn",
+    "_fused_conv1x1_bn", "_fused_convkxk_bn",
     "Correlation", "khatri_rao",
 ]
 

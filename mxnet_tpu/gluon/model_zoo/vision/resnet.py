@@ -13,6 +13,8 @@ options preserve the reference model function exactly (tests
 """
 from __future__ import annotations
 
+import jax
+
 from ... import nn
 from ...block import HybridBlock
 from ...parameter import Parameter
@@ -109,10 +111,21 @@ class _StemConvS2D(HybridBlock):
             wp = wp.reshape(o, 4, 2, 4, 2, c)         # O,Ai,di,Aj,dj,C
             wt = wp.transpose(0, 1, 3, 2, 4, 5)       # O,Ai,Aj,di,dj,C
             wt = wt.reshape(o, 4, 4, 4 * c)
-        return invoke("Convolution", [xp, wt],
-                      {"kernel": (4, 4), "stride": (1, 1), "pad": (0, 0),
-                       "num_filter": o, "no_bias": True,
-                       "layout": self._layout})
+        out = invoke("Convolution", [xp, wt],
+                     {"kernel": (4, 4), "stride": (1, 1), "pad": (0, 0),
+                      "num_filter": o, "no_bias": True,
+                      "layout": self._layout})
+        if isinstance(out._data, jax.core.Tracer):
+            # producer tag (same contract as conv_layers.py): the stem
+            # output is the network's LARGEST activation — fusing its BN
+            # stats into this conv's Pallas epilogue saves the single
+            # biggest stats read.  wt is graph-derived from the canonical
+            # 7x7 weight; gradients flow back through the regroup.
+            out._conv_src = (xp, wt, None,
+                             {"kernel": (4, 4), "stride": (1, 1),
+                              "pad": (0, 0), "dilate": (1, 1),
+                              "num_group": 1, "layout": self._layout})
+        return out
 
 
 class BasicBlockV1(HybridBlock):

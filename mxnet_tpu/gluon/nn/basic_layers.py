@@ -231,10 +231,12 @@ class BatchNorm(HybridBlock):
 
     def _fused_conv_src(self, x):
         """When ``x`` was produced by an eligible NHWC Convolution this
-        trace (see conv_layers.py producer tag) — 1x1 any-stride, or
-        3x3/stride-1/pad-1 fitting the full-image VMEM tile — return
-        (src_x, src_w, src_bias_or_None, stride, kind) for the fused
-        Pallas conv+BN-stats path, else None.
+        trace (see conv_layers.py producer tag) — 1x1 any-stride, or any
+        KxK stride-1 conv fitting the full-image VMEM tile (3x3
+        bottlenecks, the s2d stem's 4x4/pad-0) — return (src_x, src_w,
+        src_bias_or_None, geom, kind) for the fused Pallas conv+BN-stats
+        path, else None.  ``geom`` is the stride tuple for kind "1x1"
+        and (kernel, pad) for kind "kxk".
         Single-device only: under a sharded pjit step the pallas_call has
         no partitioning rule; MXNET_FUSED_CONV_BN=2 forces (CPU tests)."""
         src = getattr(x, "_conv_src", None)
@@ -268,15 +270,17 @@ class BatchNorm(HybridBlock):
             if fused_blocks(n * ho * wo, cin, sw.shape[0]) is None:
                 return None
             return sx, sw, sb, stride, "1x1"
-        if (kernel == (3, 3) and stride == (1, 1)
-                and tuple(attrs.get("pad", (0, 0))) == (1, 1)):
-            from ...ops.pallas_kernels import conv3x3_fits
+        if len(kernel) == 2 and stride == (1, 1):
+            # KxK stride-1 full-image-tile kernel (3x3 bottlenecks, the
+            # s2d stem's 4x4/pad-0 conv, ...)
+            from ...ops.pallas_kernels import convkxk_fits
 
+            pad = tuple(attrs.get("pad", (0, 0)))
             itemsize = 2 if str(sx.dtype) == "bfloat16" else 4
-            if conv3x3_fits(sx.shape, sw.shape[0],
+            if convkxk_fits(sx.shape, sw.shape[0], kernel, pad,
                             itemsize=itemsize) is None:
                 return None
-            return sx, sw, sb, stride, "3x3"
+            return sx, sw, sb, (kernel, pad), "kxk"
         return None
 
     def forward(self, x):
@@ -285,14 +289,16 @@ class BatchNorm(HybridBlock):
         if training:
             fused = self._fused_conv_src(x)
             if fused is not None:
-                sx, sw, sb, stride, kind = fused
+                sx, sw, sb, geom, kind = fused
                 ins = [sx, sw] + ([sb] if sb is not None else []) \
                     + [self.gamma.data(ctx), self.beta.data(ctx)]
                 attrs = {"eps": self._epsilon,
                          "fix_gamma": not self._scale,
                          "has_bias": sb is not None}
                 if kind == "1x1":
-                    attrs["stride"] = stride
+                    attrs["stride"] = geom
+                else:
+                    attrs["pad"] = geom[1]   # kernel size comes from w
                 out, mean, var = invoke(
                     f"_fused_conv{kind}_bn", ins, attrs)
                 m = self._momentum

@@ -417,22 +417,26 @@ def fused_conv1x1_bn(arrays, stride=(1, 1), eps=1e-5, fix_gamma=False,
     return _fused_bn_epilogue(z, mean, var, gamma, beta, b, eps, fix_gamma)
 
 
-@register("_fused_conv3x3_bn", num_inputs=-1, num_outputs=-1)
-def fused_conv3x3_bn(arrays, eps=1e-5, fix_gamma=False, has_bias=False):
-    """Training-mode 3x3/stride-1/pad-1 conv + BatchNorm with batch
-    statistics in the conv's Pallas epilogue (ops/pallas_kernels.py
-    conv3x3_bn_stats_train; full-image VMEM tiles, 9 shifted MXU
-    matmuls).  Bias handling identical to _fused_conv1x1_bn: the
+@register("_fused_convkxk_bn", num_inputs=-1, num_outputs=-1,
+          aliases=("_fused_conv3x3_bn",))
+def fused_convkxk_bn(arrays, eps=1e-5, fix_gamma=False, has_bias=False,
+                     pad=(1, 1)):
+    """Training-mode KxK/stride-1 conv + BatchNorm with batch statistics
+    in the conv's Pallas epilogue (ops/pallas_kernels.py
+    convkxk_bn_stats_train; full-image VMEM tiles, KxK shifted MXU
+    matmuls).  Covers the 3x3/pad-1 bottleneck sites AND the s2d stem's
+    4x4/pad-0 conv (the network's LARGEST activation and biggest single
+    BN-stats read).  Bias handling identical to _fused_conv1x1_bn: the
     normalized output is bias-invariant; the bias folds only into the
     returned running-stat mean.  TPU-first fusion, no reference analog."""
-    from .pallas_kernels import conv3x3_bn_stats_train
+    from .pallas_kernels import convkxk_bn_stats_train
 
     if has_bias:
         x, w, b, gamma, beta = arrays
     else:
         x, w, gamma, beta = arrays
         b = None
-    z, mean, var = conv3x3_bn_stats_train(x, w)
+    z, mean, var = convkxk_bn_stats_train(x, w, tuple(pad))
     return _fused_bn_epilogue(z, mean, var, gamma, beta, b, eps, fix_gamma)
 
 

@@ -29,6 +29,7 @@ from jax.experimental import pallas as pl
 __all__ = ["flash_attention", "matmul_bn_stats", "conv1x1_bn_stats",
            "conv1x1_bn_stats_train", "fused_blocks",
            "conv3x3_bn_stats", "conv3x3_bn_stats_train", "conv3x3_fits",
+           "convkxk_bn_stats", "convkxk_bn_stats_train", "convkxk_fits",
            "int8_matmul", "int8_conv1x1", "int8_conv3x3", "int8_blocks"]
 
 _NEG_INF = -1e30
@@ -602,19 +603,20 @@ def int8_conv1x1(qx, qw, scale, stride=(1, 1), relu=False, out_scale=None):
 # ---------------------------------------------------------------------------
 
 
-def _c3x3_kernel(x_ref, w_ref, o_ref, s_ref, ss_ref, *, hh, ww):
+def _ckxk_kernel(x_ref, w_ref, o_ref, s_ref, ss_ref, *, ho, wo, kh, kw,
+                 ph, pw):
     bi = pl.program_id(1)
     x = x_ref[0].astype(jnp.float32)                  # (H, W, Cin)
-    xp = jnp.pad(x, ((1, 1), (1, 1), (0, 0)))
+    xp = jnp.pad(x, ((ph, ph), (pw, pw), (0, 0))) if (ph or pw) else x
     cin = x.shape[-1]
     bn = w_ref.shape[0]
-    acc = jnp.zeros((hh * ww, bn), jnp.float32)
-    for dy in range(3):
-        for dx in range(3):
-            xs = xp[dy:dy + hh, dx:dx + ww, :].reshape(hh * ww, cin)
+    acc = jnp.zeros((ho * wo, bn), jnp.float32)
+    for dy in range(kh):
+        for dx in range(kw):
+            xs = xp[dy:dy + ho, dx:dx + wo, :].reshape(ho * wo, cin)
             wt = w_ref[:, dy, dx, :].astype(jnp.float32).T   # (Cin, bn)
             acc = acc + xs @ wt
-    o_ref[0] = acc.reshape(hh, ww, bn).astype(o_ref.dtype)
+    o_ref[0] = acc.reshape(ho, wo, bn).astype(o_ref.dtype)
     part = jnp.sum(acc, axis=0, keepdims=True)        # (1, bn)
     part_sq = jnp.sum(acc * acc, axis=0, keepdims=True)
 
@@ -629,98 +631,131 @@ def _c3x3_kernel(x_ref, w_ref, o_ref, s_ref, ss_ref, *, hh, ww):
         ss_ref[...] += part_sq
 
 
-def conv3x3_fits(xshape, cout, block_n=128, vmem_budget=10 * 2 ** 20,
-                 itemsize=2):
-    """Eligibility for the full-image-tile 3x3 kernel: stride-1/pad-1
-    NHWC geometry whose tiles stay inside the VMEM budget, with a
+def convkxk_fits(xshape, cout, kernel=(3, 3), pad=(1, 1), block_n=128,
+                 vmem_budget=10 * 2 ** 20, itemsize=2):
+    """Eligibility for the full-image-tile KxK stride-1 kernel: NHWC
+    geometry whose tiles stay inside the VMEM budget, with a
     Mosaic-friendly cout tiling.  ``itemsize`` is the storage dtype's
     byte width (2 for bf16, 4 for fp32)."""
     n, h, w, cin = xshape
+    kh, kw = kernel
+    ph, pw = pad
+    ho, wo = h + 2 * ph - kh + 1, w + 2 * pw - kw + 1
+    if ho <= 0 or wo <= 0:
+        return None
     bn = min(block_n, cout)
     if cout % bn or (bn % 128 and bn != cout):
         return None
     vmem = (h * w * cin * itemsize                 # input tile as loaded
-            + (h + 2) * (w + 2) * cin * 4          # padded fp32 image
-            + h * w * bn * 4                       # fp32 accumulator
-            + 9 * cin * bn * 4                     # weight taps (fp32)
-            + h * w * bn * itemsize)               # output tile
+            + (h + 2 * ph) * (w + 2 * pw) * cin * 4   # padded fp32 image
+            + ho * wo * bn * 4                     # fp32 accumulator
+            + kh * kw * cin * bn * 4               # weight taps (fp32)
+            + ho * wo * bn * itemsize)             # output tile
     if vmem > vmem_budget:
         return None
-    return {"block_n": bn}
+    return {"block_n": bn, "out_hw": (ho, wo)}
 
 
-def conv3x3_bn_stats(x, w, block_n=128):
-    """x (N,H,W,Cin) NHWC, w (Cout,3,3,Cin) OHWI, stride 1, pad 1 ->
-    (z (N,H,W,Cout), mean (Cout,), var (Cout,)), stats fp32."""
+def convkxk_bn_stats(x, w, pad=(1, 1), block_n=128):
+    """x (N,H,W,Cin) NHWC, w (Cout,kh,kw,Cin) OHWI, stride 1, symmetric
+    per-dim ``pad`` -> (z (N,Ho,Wo,Cout), mean, var), stats fp32."""
     n, h, wd, cin = x.shape
-    cout = w.shape[0]
-    fit = conv3x3_fits(x.shape, cout, block_n,
+    cout, kh, kw, _ = w.shape
+    fit = convkxk_fits(x.shape, cout, (kh, kw), pad, block_n,
                        itemsize=jnp.dtype(x.dtype).itemsize)
-    assert fit is not None, (x.shape, cout)
+    assert fit is not None, (x.shape, w.shape, pad)
     bn = fit["block_n"]
+    ho, wo = fit["out_hw"]
     grid = (cout // bn, n)                        # batch innermost
-    kernel = functools.partial(_c3x3_kernel, hh=h, ww=wd)
+    kernel = functools.partial(_ckxk_kernel, ho=ho, wo=wo, kh=kh, kw=kw,
+                               ph=pad[0], pw=pad[1])
     z, s, ss = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, h, wd, cin), lambda ci, b: (b, 0, 0, 0)),
-            pl.BlockSpec((bn, 3, 3, cin), lambda ci, b: (ci, 0, 0, 0)),
+            pl.BlockSpec((bn, kh, kw, cin), lambda ci, b: (ci, 0, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, h, wd, bn), lambda ci, b: (b, 0, 0, ci)),
+            pl.BlockSpec((1, ho, wo, bn), lambda ci, b: (b, 0, 0, ci)),
             pl.BlockSpec((1, bn), lambda ci, b: (0, ci)),
             pl.BlockSpec((1, bn), lambda ci, b: (0, ci)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, h, wd, cout), x.dtype),
+            jax.ShapeDtypeStruct((n, ho, wo, cout), x.dtype),
             jax.ShapeDtypeStruct((1, cout), jnp.float32),
             jax.ShapeDtypeStruct((1, cout), jnp.float32),
         ],
         interpret=_interpret(),
     )(x, w)
-    cnt = jnp.float32(n * h * wd)
+    cnt = jnp.float32(n * ho * wo)
     mean = s[0] / cnt
     var = jnp.maximum(ss[0] / cnt - mean * mean, 0.0)
     return z, mean, var
 
 
-def _ref_conv3x3(x, w):
+def _ref_convkxk(x, w, pad):
     dn = jax.lax.conv_dimension_numbers(
         x.shape, w.shape, ("NHWC", "OHWI", "NHWC"))
     return jax.lax.conv_general_dilated(
-        x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn)
+        x, w, (1, 1), [(pad[0], pad[0]), (pad[1], pad[1])],
+        dimension_numbers=dn)
 
 
-@jax.custom_vjp
+@functools.lru_cache(maxsize=None)
+def _ckxk_train_for(pad):
+    """One custom_vjp core per static pad (jax.custom_vjp cannot take
+    non-array args positionally)."""
+
+    @jax.custom_vjp
+    def f(x, w):
+        return convkxk_bn_stats(x, w, pad)
+
+    def fwd(x, w):
+        z, mean, var = convkxk_bn_stats(x, w, pad)
+        return (z, mean, var), (x, w, z, mean)
+
+    def bwd(res, cts):
+        x, w, z, mean = res
+        gz, gmean, gvar = cts
+        n, ho, wo, _ = z.shape
+        m = n * ho * wo
+        z32 = z.astype(jnp.float32)
+        g = (gz.astype(jnp.float32)
+             + gmean.astype(jnp.float32) / m
+             + gvar.astype(jnp.float32) * 2.0 * (z32 - mean) / m)
+        # conv input/weight grads through XLA's own transposed convs (MXU)
+        _, vjp = jax.vjp(lambda x_, w_: _ref_convkxk(x_, w_, pad), x, w)
+        dx, dw = vjp(g.astype(z.dtype))
+        return dx.astype(x.dtype), dw.astype(w.dtype)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def convkxk_bn_stats_train(x, w, pad=(1, 1)):
+    """Differentiable (z, mean, var) of a stride-1 KxK NHWC conv with
+    fused batch statistics.  Caller pre-checks :func:`convkxk_fits`."""
+    return _ckxk_train_for((int(pad[0]), int(pad[1])))(x, w)
+
+
+# 3x3 compatibility surface (the original round-5 entry points)
+def conv3x3_fits(xshape, cout, block_n=128, vmem_budget=10 * 2 ** 20,
+                 itemsize=2):
+    return convkxk_fits(xshape, cout, (3, 3), (1, 1), block_n,
+                        vmem_budget, itemsize)
+
+
+def conv3x3_bn_stats(x, w, block_n=128):
+    return convkxk_bn_stats(x, w, (1, 1), block_n)
+
+
 def conv3x3_bn_stats_train(x, w):
-    """Differentiable (z, mean, var) of a stride-1/pad-1 3x3 NHWC conv
-    with fused batch statistics.  Caller pre-checks conv3x3_fits."""
-    return conv3x3_bn_stats(x, w)
+    return convkxk_bn_stats_train(x, w, (1, 1))
 
 
-def _c3x3_fwd_vjp(x, w):
-    z, mean, var = conv3x3_bn_stats(x, w)
-    return (z, mean, var), (x, w, z, mean)
-
-
-def _c3x3_bwd(res, cts):
-    x, w, z, mean = res
-    gz, gmean, gvar = cts
-    n, h, wd, _ = x.shape
-    cout = w.shape[0]
-    m = n * h * wd
-    z32 = z.astype(jnp.float32)
-    g = (gz.astype(jnp.float32)
-         + gmean.astype(jnp.float32) / m
-         + gvar.astype(jnp.float32) * 2.0 * (z32 - mean) / m)
-    # conv input/weight grads through XLA's own transposed convs (MXU)
-    _, vjp = jax.vjp(_ref_conv3x3, x, w)
-    dx, dw = vjp(g.astype(z.dtype))
-    return dx.astype(x.dtype), dw.astype(w.dtype)
-
-
-conv3x3_bn_stats_train.defvjp(_c3x3_fwd_vjp, _c3x3_bwd)
+def _ref_conv3x3(x, w):
+    return _ref_convkxk(x, w, (1, 1))
 
 
 # ---------------------------------------------------------------------------

@@ -39,7 +39,7 @@ SPECS = {
     # Pallas interpret path on CPU
     "_fused_conv1x1_bn": ([_f(2, 6, 6, 4), _f(8, 1, 1, 4), _f(8), _f(8)],
                           {}),
-    "_fused_conv3x3_bn": ([_f(2, 6, 6, 4), _f(8, 3, 3, 4), _f(8), _f(8)],
+    "_fused_convkxk_bn": ([_f(2, 6, 6, 4), _f(8, 3, 3, 4), _f(8), _f(8)],
                           {}),
     "GroupNorm": ([_f(2, 4, 6, 6), _f(4), _f(4)], dict(num_groups=2)),
     "InstanceNorm": ([_f(2, 4, 6, 6), _f(4), _f(4)], {}),

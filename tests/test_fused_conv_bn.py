@@ -225,7 +225,7 @@ def test_ineligible_geometry_falls_back(force_fused):
 
     calls = {"n": 0}
     origs = []
-    for name in ("_fused_conv1x1_bn", "_fused_conv3x3_bn"):
+    for name in ("_fused_conv1x1_bn", "_fused_convkxk_bn"):
         schema = get_op(name)
         origs.append((schema, schema.fn))
 
@@ -309,18 +309,19 @@ def test_biased_conv_fuses_exactly(force_fused):
 
 def test_resnet50_fuses_all_conv_bn_sites(force_fused):
     """resnet50_v1 NHWC in one hybridized train trace: all 36 1x1 sites
-    (16 bottlenecks x (conv1 + conv3) + 4 downsamples) AND all 16
-    3x3 sites route through the fused ops — 52 of 52 conv+BN pairs
-    (only the s2d stem's 4x4 conv stays unfused)."""
+    (16 bottlenecks x (conv1 + conv3) + 4 downsamples), all 16 3x3
+    sites, AND the s2d stem's 4x4/pad-0 conv route through the fused
+    ops — 53 of 53 conv+BN pairs."""
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.ops.registry import get_op
 
-    net = vision.get_resnet(1, 50, layout="NHWC", stem_s2d=True)
+    net = vision.get_resnet(1, 50, layout="NHWC", input_layout="NHWC",
+                            stem_s2d=True)
     net.initialize(mx.init.Xavier())
     x = mx.nd.array(_rand(8, 32, 32, 3))
     net(x)
     net.hybridize()
-    counts = {"1x1": 0, "3x3": 0}
+    counts = {"1x1": 0, "kxk": 0}
     origs = {}
     for kind in counts:
         schema = get_op(f"_fused_conv{kind}_bn")
@@ -338,7 +339,7 @@ def test_resnet50_fuses_all_conv_bn_sites(force_fused):
     finally:
         for schema, fn in origs.values():
             schema.fn = fn
-    assert counts == {"1x1": 36, "3x3": 16}, counts
+    assert counts == {"1x1": 36, "kxk": 17}, counts
 
 
 def test_conv3x3_fused_matches_unfused(force_fused):
@@ -581,3 +582,40 @@ def test_fused_path_composes_with_remat(force_fused):
     for n in grads["0"]:
         onp.testing.assert_allclose(grads["2"][n], grads["0"][n],
                                     rtol=5e-3, atol=5e-3, err_msg=n)
+
+
+def test_s2d_stem_fused_matches_unfused(force_fused):
+    """The s2d stem's 4x4/pad-0 conv + BN (the network's largest
+    activation): fused output, gradients through the in-graph 7x7
+    weight regroup, and running stats all equal the unfused path."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import _StemConvS2D, _bn
+
+    x = mx.nd.array(_rand(2, 16, 16, 3))
+    nets = []
+    for _ in range(2):
+        net = nn.HybridSequential()
+        net.add(_StemConvS2D(64, "NHWC"))
+        net.add(_bn("NHWC"))
+        net.initialize(mx.init.Xavier())
+        net(x)
+        nets.append(net)
+    src = nets[0].collect_params()
+    for n_, p in nets[1].collect_params().items():
+        p._data[0]._set_data(src[n_]._data[0]._data)
+    results = {}
+    for env, net in (("2", nets[0]), ("0", nets[1])):
+        os.environ["MXNET_FUSED_CONV_BN"] = env
+        config.refresh("MXNET_FUSED_CONV_BN")
+        net.hybridize()
+        with autograd.record():
+            out = net(x)
+            loss = (out * out).sum()
+        loss.backward()
+        results[env] = (out.asnumpy(),
+                        net[1].running_mean._data[0].asnumpy(),
+                        net[1].running_var._data[0].asnumpy(),
+                        net[0].weight._data[0].grad.asnumpy())
+    for i, name in enumerate(["out", "running_mean", "running_var",
+                              "stem_weight_grad"]):
+        onp.testing.assert_allclose(results["2"][i], results["0"][i],
+                                    rtol=2e-3, atol=2e-3, err_msg=name)

@@ -165,12 +165,19 @@ declare("MXNET_EAGER_JIT", int, 1,
         "(op, attrs) instead of per-primitive device round-trips.  0 = "
         "off, 1 = on for the TPU backend (default; CPU eager stays plain "
         "dispatch), 2 = force everywhere (tests/benchmarks).")
-declare("MXNET_FUSED_CONV_BN", int, 1,
-        "Trace-time fusion of eligible 1x1-conv + BatchNorm(training) pairs "
-        "into the Pallas conv+BN-stats kernel (one HBM pass over the conv "
-        "output).  0 = off, 1 = on for single-device TPU execution "
-        "(default), 2 = force everywhere incl. the CPU Pallas interpreter "
-        "(tests).")
+declare("MXNET_FUSED_CONV_BN", int, 0,
+        "Trace-time fusion of eligible conv + BatchNorm(training) pairs "
+        "into the Pallas conv+BN-stats kernels.  0 = off (default: the "
+        "2026-08-01 on-chip A/B measured every fused variant SLOWER than "
+        "XLA's own conv+BN fusion — 1140-1791 vs 2556 img/s bf16 ResNet-50; "
+        "the pallas_call boundary blocks XLA's surrounding epilogue fusion "
+        "— see docs/PERF.md), 1 = on for single-device TPU execution, 2 = "
+        "force everywhere incl. the CPU Pallas interpreter (tests).")
+declare("MXNET_FUSED_CONV_BN_KINDS", str, "1x1,kxk",
+        "Which conv+BN fusion kernel classes are eligible when "
+        "MXNET_FUSED_CONV_BN is on: comma-set of '1x1' (matmul-tiled "
+        "any-stride 1x1) and 'kxk' (full-image-tile KxK stride-1).  The "
+        "on-chip A/B in docs/PERF.md decides the shipped default.")
 declare("MXNET_BN_TWO_PASS_VAR", bool, False,
         "BatchNorm batch variance via the two-pass shifted formula instead "
         "of the single-pass E[x^2]-E[x]^2 TPU default (one extra HBM pass; "

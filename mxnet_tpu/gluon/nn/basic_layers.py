@@ -252,6 +252,13 @@ class BatchNorm(HybridBlock):
         if mode != 2 and not (_jax.default_backend() == "tpu"
                               and len(_jax.devices()) == 1):
             return None
+        kinds = {k.strip()
+                 for k in _config.get("MXNET_FUSED_CONV_BN_KINDS").split(",")}
+        unknown = kinds - {"1x1", "kxk", ""}
+        if unknown:
+            raise ValueError(
+                f"MXNET_FUSED_CONV_BN_KINDS: unknown kind(s) {sorted(unknown)}"
+                " (valid: '1x1', 'kxk')")
         sx, sw, sb, attrs = src
         stride = tuple(attrs.get("stride", (1, 1)))
         kernel = tuple(attrs.get("kernel", ()))
@@ -262,6 +269,8 @@ class BatchNorm(HybridBlock):
                 or str(sx.dtype) not in ("float32", "bfloat16")):
             return None
         if kernel == (1, 1) and tuple(attrs.get("pad", (0, 0))) == (0, 0):
+            if "1x1" not in kinds:
+                return None
             from ...ops.pallas_kernels import fused_blocks
 
             n, h, w, cin = sx.shape
@@ -271,6 +280,8 @@ class BatchNorm(HybridBlock):
                 return None
             return sx, sw, sb, stride, "1x1"
         if len(kernel) == 2 and stride == (1, 1):
+            if "kxk" not in kinds:
+                return None
             # KxK stride-1 full-image-tile kernel (3x3 bottlenecks, the
             # s2d stem's 4x4/pad-0 conv, ...)
             from ...ops.pallas_kernels import convkxk_fits

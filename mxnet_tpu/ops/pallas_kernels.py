@@ -25,6 +25,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "matmul_bn_stats", "conv1x1_bn_stats",
            "conv1x1_bn_stats_train", "fused_blocks",
@@ -603,19 +604,48 @@ def int8_conv1x1(qx, qw, scale, stride=(1, 1), relu=False, out_scale=None):
 # ---------------------------------------------------------------------------
 
 
-def _ckxk_kernel(x_ref, w_ref, o_ref, s_ref, ss_ref, *, ho, wo, kh, kw,
-                 ph, pw):
+def _tap_accumulate(xp_ref, w_ref, kh, kw, ho, wo, acc_dtype, w_cast=None):
+    """Sum of shifted-window matmuls over the kh*kw taps: xp_ref a
+    (Hp,Wp,Cin) already-padded VMEM ref, w_ref a (kh*kw,Cin,bn)
+    taps-leading ref -> (ho*wo, bn).
+
+    A fori_loop over the kh row shifts, NOT a fully unrolled Python
+    loop: Mosaic's scoped-VMEM stack allocator keeps each unrolled
+    iteration's shifted window + accumulator live simultaneously
+    (kh*kw copies — the round-5 on-chip compile OOM); the loop body
+    reuses one row block.  The row shift is a dynamic REF load
+    (``pl.ds`` on the untiled leading dim — this Pallas TPU lowering
+    has no ``dynamic_slice`` on values, and Mosaic requires sublane-dim
+    dynamic starts to be 8-aligned, so the kw column shifts stay as
+    static slices unrolled inside the body)."""
+    cin = xp_ref.shape[-1]
+    bn = w_ref.shape[-1]
+
+    def row(dy, acc):
+        xr = xp_ref[pl.ds(dy, ho), :, :]            # (ho, Wp, cin)
+        for dx in range(kw):
+            xs = xr[:, dx:dx + wo, :].reshape(ho * wo, cin)
+            wt = w_ref[pl.ds(dy * kw + dx, 1), :, :].reshape(cin, bn)
+            if w_cast is not None:
+                wt = wt.astype(w_cast)
+            acc = acc + jax.lax.dot_general(
+                xs, wt, (((1,), (0,)), ((), ())),
+                preferred_element_type=acc_dtype)
+        return acc
+
+    return jax.lax.fori_loop(0, kh, row,
+                             jnp.zeros((ho * wo, bn), acc_dtype))
+
+
+def _ckxk_kernel(x_ref, w_ref, o_ref, s_ref, ss_ref, xp_ref, *, ho, wo,
+                 kh, kw, ph, pw):
     bi = pl.program_id(1)
     x = x_ref[0].astype(jnp.float32)                  # (H, W, Cin)
-    xp = jnp.pad(x, ((ph, ph), (pw, pw), (0, 0))) if (ph or pw) else x
-    cin = x.shape[-1]
-    bn = w_ref.shape[0]
-    acc = jnp.zeros((ho * wo, bn), jnp.float32)
-    for dy in range(kh):
-        for dx in range(kw):
-            xs = xp[dy:dy + ho, dx:dx + wo, :].reshape(ho * wo, cin)
-            wt = w_ref[:, dy, dx, :].astype(jnp.float32).T   # (Cin, bn)
-            acc = acc + xs @ wt
+    xp_ref[...] = (jnp.pad(x, ((ph, ph), (pw, pw), (0, 0)))
+                   if (ph or pw) else x)
+    bn = w_ref.shape[-1]
+    acc = _tap_accumulate(xp_ref, w_ref, kh, kw, ho, wo, jnp.float32,
+                          w_cast=jnp.float32)
     o_ref[0] = acc.reshape(ho, wo, bn).astype(o_ref.dtype)
     part = jnp.sum(acc, axis=0, keepdims=True)        # (1, bn)
     part_sq = jnp.sum(acc * acc, axis=0, keepdims=True)
@@ -632,11 +662,22 @@ def _ckxk_kernel(x_ref, w_ref, o_ref, s_ref, ss_ref, *, ho, wo, kh, kw,
 
 
 def convkxk_fits(xshape, cout, kernel=(3, 3), pad=(1, 1), block_n=128,
-                 vmem_budget=10 * 2 ** 20, itemsize=2):
+                 vmem_budget=12 * 2 ** 20 + 2 ** 19, itemsize=2):
     """Eligibility for the full-image-tile KxK stride-1 kernel: NHWC
     geometry whose tiles stay inside the VMEM budget, with a
     Mosaic-friendly cout tiling.  ``itemsize`` is the storage dtype's
-    byte width (2 for bf16, 4 for fp32)."""
+    byte width (2 for bf16, 4 for fp32, 1 for the s8 kernel — which
+    also switches the in-kernel buffer dtypes to what
+    ``_c3x3_int8_kernel`` really allocates: s8 image/window/weights,
+    s32 accumulator, fp32 output).
+
+    The byte model counts buffers as Mosaic actually allocates them:
+    the last dim padded to 128 lanes, the second-to-last to the dtype's
+    sublane quantum (8 f32 / 16 bf16 / 32 s8).  Un-padded estimates
+    under-count tiny-channel geometries ~10x — the s2d stem's cin=12
+    pads to 128 lanes, which is how the round-5 on-chip compile blew the
+    16 MB scoped-VMEM limit; with honest accounting the stem is simply
+    ineligible and falls back to the unfused conv+BN pair."""
     n, h, w, cin = xshape
     kh, kw = kernel
     ph, pw = pad
@@ -646,11 +687,31 @@ def convkxk_fits(xshape, cout, kernel=(3, 3), pad=(1, 1), block_n=128,
     bn = min(block_n, cout)
     if cout % bn or (bn % 128 and bn != cout):
         return None
-    vmem = (h * w * cin * itemsize                 # input tile as loaded
-            + (h + 2 * ph) * (w + 2 * pw) * cin * 4   # padded fp32 image
-            + ho * wo * bn * 4                     # fp32 accumulator
-            + kh * kw * cin * bn * 4               # weight taps (fp32)
-            + ho * wo * bn * itemsize)             # output tile
+
+    def up(v, q):
+        return -(-v // q) * q
+
+    def sub(isz):
+        return {1: 32, 2: 16, 4: 8}.get(isz, 8)
+
+    # per-buffer dtypes: the bf16/fp32 kernel pads+computes in fp32 and
+    # stores the conv output in the input dtype; the s8 kernel keeps the
+    # image/window/weights in s8, accumulates s32, and emits fp32.
+    int8 = itemsize == 1
+    img_isz = 1 if int8 else 4          # padded image + tap window
+    w_isz = 1 if int8 else 4            # weight taps as computed with
+    out_isz = 4 if int8 else itemsize   # output tile
+    m = up(ho * wo, sub(img_isz))
+    cl = up(cin, 128)
+    bl = up(bn, 128)
+    wp = w + 2 * pw
+    vmem = (h * up(w, sub(itemsize)) * cl * itemsize  # input tile as loaded
+            + (h + 2 * ph) * up(wp, sub(img_isz)) * cl * img_isz  # scratch
+            + ho * up(wp, sub(img_isz)) * cl * img_isz  # row-shift block
+            + 2 * m * cl * img_isz                  # live column windows
+            + 2 * m * bl * 4                        # accumulator in/out
+            + kh * kw * up(cin, sub(w_isz)) * bl * w_isz  # weight taps
+            + ho * up(wo, sub(out_isz)) * bl * out_isz)   # output tile
     if vmem > vmem_budget:
         return None
     return {"block_n": bn, "out_hw": (ho, wo)}
@@ -669,12 +730,15 @@ def convkxk_bn_stats(x, w, pad=(1, 1), block_n=128):
     grid = (cout // bn, n)                        # batch innermost
     kernel = functools.partial(_ckxk_kernel, ho=ho, wo=wo, kh=kh, kw=kw,
                                ph=pad[0], pw=pad[1])
+    # taps-leading weight layout so the in-loop per-tap slice is on the
+    # (cheap, untiled) leading dim
+    wr = jnp.transpose(w, (1, 2, 3, 0)).reshape(kh * kw, cin, cout)
     z, s, ss = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, h, wd, cin), lambda ci, b: (b, 0, 0, 0)),
-            pl.BlockSpec((bn, kh, kw, cin), lambda ci, b: (ci, 0, 0, 0)),
+            pl.BlockSpec((kh * kw, cin, bn), lambda ci, b: (0, 0, ci)),
         ],
         out_specs=[
             pl.BlockSpec((1, ho, wo, bn), lambda ci, b: (b, 0, 0, ci)),
@@ -686,8 +750,12 @@ def convkxk_bn_stats(x, w, pad=(1, 1), block_n=128):
             jax.ShapeDtypeStruct((1, cout), jnp.float32),
             jax.ShapeDtypeStruct((1, cout), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((h + 2 * pad[0], wd + 2 * pad[1], cin),
+                       jnp.float32),
+        ],
         interpret=_interpret(),
-    )(x, w)
+    )(x, wr)
     cnt = jnp.float32(n * ho * wo)
     mean = s[0] / cnt
     var = jnp.maximum(ss[0] / cnt - mean * mean, 0.0)
@@ -766,19 +834,11 @@ def _ref_conv3x3(x, w):
 # ---------------------------------------------------------------------------
 
 
-def _c3x3_int8_kernel(x_ref, w_ref, o_ref, *, hh, ww, scale, relu):
+def _c3x3_int8_kernel(x_ref, w_ref, o_ref, xp_ref, *, hh, ww, scale, relu):
     x = x_ref[0]                                     # (H, W, Cin) s8
-    xp = jnp.pad(x, ((1, 1), (1, 1), (0, 0)))
-    cin = x.shape[-1]
-    bn = w_ref.shape[0]
-    acc = jnp.zeros((hh * ww, bn), jnp.int32)
-    for dy in range(3):
-        for dx in range(3):
-            xs = xp[dy:dy + hh, dx:dx + ww, :].reshape(hh * ww, cin)
-            wt = w_ref[:, dy, dx, :].T               # (Cin, bn) s8
-            acc = acc + jax.lax.dot_general(
-                xs, wt, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32)
+    xp_ref[...] = jnp.pad(x, ((1, 1), (1, 1), (0, 0)))
+    bn = w_ref.shape[-1]
+    acc = _tap_accumulate(xp_ref, w_ref, 3, 3, hh, ww, jnp.int32)
     out = acc.astype(jnp.float32) * scale
     if relu:
         out = jnp.maximum(out, 0.0)
@@ -797,14 +857,16 @@ def int8_conv3x3(qx, qw, scale, relu=False, block_n=128):
     grid = (cout // bn, n)
     kernel = functools.partial(_c3x3_int8_kernel, hh=h, ww=wd,
                                scale=float(scale), relu=relu)
+    wr = jnp.transpose(qw, (1, 2, 3, 0)).reshape(9, cin, cout)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, h, wd, cin), lambda ci, b: (b, 0, 0, 0)),
-            pl.BlockSpec((bn, 3, 3, cin), lambda ci, b: (ci, 0, 0, 0)),
+            pl.BlockSpec((9, cin, bn), lambda ci, b: (0, 0, ci)),
         ],
         out_specs=pl.BlockSpec((1, h, wd, bn), lambda ci, b: (b, 0, 0, ci)),
         out_shape=jax.ShapeDtypeStruct((n, h, wd, cout), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((h + 2, wd + 2, cin), jnp.int8)],
         interpret=_interpret(),
-    )(qx, qw)
+    )(qx, wr)

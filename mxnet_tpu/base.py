@@ -11,6 +11,8 @@ import os
 import threading
 from typing import Any, Callable, Dict, Optional
 
+import numpy as _np
+
 __all__ = [
     "MXNetError",
     "NotSupportedForSparseNDArray",
@@ -32,6 +34,42 @@ def data_dir() -> str:
     from . import config
 
     return os.path.expanduser(config.get("MXNET_HOME"))
+
+
+_INT32_MAX = 0x7FFFFFFF
+
+# backends whose compiler demotes s64 element types wholesale (measured:
+# docs/PERF.md ">int32-scale tensors on chip") — big-dim int64 indexing
+# must use the int32-factorized paths there, never device s64
+S64_DEMOTING_PLATFORMS = ("tpu", "axon")
+
+
+def int32_overflow_dim(d) -> bool:
+    """True for a CONCRETE dim past int32 range.  Symbolic dims (AOT
+    shape-polymorphic export) are never 'big' — comparing them raises
+    InconclusiveDimensionOperation.  The single source of truth for the
+    >int32 indexing rules in ndarray.py and ops/tensor.py."""
+    return isinstance(d, (int, _np.integer)) and d > _INT32_MAX
+
+
+def pow2_col_factor(n) -> int:
+    """Largest power-of-two column factor (<=128) dividing n such that
+    BOTH dims of the (n/C, C) view fit int32.  Returns 0 when none
+    qualifies (odd n, or n so large that even n/2 overflows int32) —
+    callers must refuse rather than pad: padding moves data ALONG the
+    big dim, which the TPU runtime corrupts (docs/PERF.md)."""
+    for c in (128, 64, 32, 16, 8, 4, 2):
+        if n % c == 0 and n // c <= _INT32_MAX:
+            return c
+    return 0
+
+
+def bounded_cache_put(cache: dict, key, val, cap: int = 64):
+    """Insert into a plain-dict FIFO cache, evicting oldest past cap."""
+    cache[key] = val
+    while len(cache) > cap:
+        cache.pop(next(iter(cache)))
+    return val
 
 
 class MXNetError(RuntimeError):

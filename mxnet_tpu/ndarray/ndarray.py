@@ -31,7 +31,8 @@ import numpy as onp
 from .. import autograd
 from .. import engine as _engine
 from .. import profiler as _profiler
-from ..base import MXNetError
+from ..base import (MXNetError, S64_DEMOTING_PLATFORMS, bounded_cache_put,
+                    int32_overflow_dim, pow2_col_factor)
 from ..context import Context, current_context
 from ..ops.registry import OpSchema, find_op, get_op
 
@@ -85,12 +86,16 @@ class NDArray:
             if onp.dtype(want or src or onp.float32) in (onp.dtype("int64"),
                                                          onp.dtype("uint64")):
                 # honest 64-bit integers (same policy as shape_array):
-                # the x32 default would silently truncate graph/edge ids
+                # the x32 default would silently truncate graph/edge ids.
+                # device_put must stay INSIDE the x64 scope — outside it
+                # the transfer canonicalizes through int32, wrapping
+                # values past 2^31 even though the dtype reads int64
                 with jax.enable_x64(True):
                     data = jnp.asarray(data, dtype=want)
+                    data = jax.device_put(data, ctx.jax_device)
             else:
                 data = jnp.asarray(data, dtype=want)
-            data = jax.device_put(data, ctx.jax_device)
+                data = jax.device_put(data, ctx.jax_device)
         elif dtype is not None and data.dtype != _dtype_np(dtype):
             data = data.astype(_dtype_np(dtype))
         self._data = data
@@ -443,7 +448,22 @@ class NDArray:
     def __getitem__(self, key) -> "NDArray":
         key = _index_unwrap(key)
         _check_int_bounds(key, self.shape)
+        if _needs_x64_index(self.shape) and self._on_x64_native_backend():
+            # >int32-range dims (the reference's USE_INT64_TENSOR_SIZE
+            # analog): on cpu, index constants must stay s64 or XLA's
+            # gather drops them as out-of-bounds after truncation.  On
+            # TPU the _index op itself lowers static keys to literal-
+            # bound slices (the compiler demotes s64 types wholesale).
+            with jax.enable_x64(True):
+                return invoke("_index", [self], {"key": key})
         return invoke("_index", [self], {"key": key})
+
+    def _on_x64_native_backend(self) -> bool:
+        try:
+            dev = next(iter(self._data.devices()))
+        except Exception:       # tracers carry no device
+            return False
+        return dev.platform not in S64_DEMOTING_PLATFORMS
 
     def __setitem__(self, key, value):
         key = _index_unwrap(key)
@@ -462,6 +482,23 @@ class NDArray:
                 self._set_data(
                     jnp.broadcast_to(jnp.asarray(value, self._data.dtype), self.shape)
                 )
+        elif _needs_x64_index(self.shape):
+            # NO plain-scatter path here even for small offsets: the
+            # functional .at[].set implies a full-buffer copy, and any
+            # copy ALONG a >2^31 dim is corrupt on the TPU runtime
+            new = _big_static_set(self._data, key, value)
+            if new is not None:
+                self._set_data(new)
+            elif self._on_x64_native_backend():
+                with jax.enable_x64(True):
+                    self._set_data(self._data.at[key].set(value))
+            else:
+                raise MXNetError(
+                    "only static int/contiguous-slice scalar writes are "
+                    "supported into a >int32-range dim on the TPU runtime "
+                    "(its compiler demotes s64 indices and corrupts copies "
+                    "along >2^31 dims); reshape to a 2-D view whose dims "
+                    "fit int32 for general writes")
         else:
             self._set_data(self._data.at[key].set(value))
 
@@ -639,6 +676,78 @@ def _index_unwrap(key):
     return key
 
 
+def _needs_x64_index(shape):
+    """True when any dim exceeds int32 range, so index constants must be
+    s64 (the reference's int64-tensor-size build analog)."""
+    return any(int32_overflow_dim(d) for d in shape)
+
+
+_BIG_SPLICE_JIT: dict = {}
+
+
+def _big_static_set(data, key, value):
+    """Scalar write into a static int/contiguous-slice region of a
+    >int32-range 1-D array.
+
+    The TPU runtime moves data correctly only when every dim of the
+    moved region fits int32 — ANY scatter/copy along a >2^31 dim lands
+    at corrupt offsets (measured, docs/PERF.md), including the
+    full-buffer copy a functional `.at[].set` implies.  So the write is
+    a pure ELEMENTWISE pass over a (dim/C, C) view: reshape is
+    metadata-only (verified exact past 2^31), the target region becomes
+    a (row, col) iota mask, and `where` selects value vs old — no index
+    tensors, no scatter, per-dim extents all int32.  Returns None for
+    patterns this cannot express (the caller falls back): non-scalar
+    values, stepped slices, multi-dim arrays, odd dims with no small
+    factor."""
+    k = key[0] if isinstance(key, tuple) and len(key) == 1 else key
+    if data.ndim != 1:
+        return None
+    n = data.shape[0]
+    if isinstance(k, bool):
+        return None
+    if isinstance(k, (int, onp.integer)):
+        s = int(k) + (n if int(k) < 0 else 0)
+        e = s + 1
+    elif isinstance(k, slice):
+        try:
+            s, e, st = k.indices(n)
+        except TypeError:
+            return None
+        if st != 1:
+            return None
+        if e <= s:
+            return data                  # empty region: numpy no-op
+    else:
+        return None
+    if isinstance(value, NDArray) or getattr(value, "ndim", 0):
+        return None                      # scalar writes only on this path
+    C = pow2_col_factor(n)
+    if not C:
+        return None
+    rows = n // C
+    # region bounds travel as int32 OPERANDS (they are only compared to
+    # iota, never used as indices, so s64 demotion is irrelevant): one
+    # executable per (shape, dtype), not one per write offset
+    rs, cs = divmod(s, C)
+    re_, ce = divmod(e - 1, C)           # inclusive end position
+    ck = (data.shape, str(data.dtype), C)
+    fn = _BIG_SPLICE_JIT.get(ck)
+    if fn is None:
+
+        def masked_set(d, v, b):
+            mat = d.reshape(rows, C)
+            row = jax.lax.broadcasted_iota(jnp.int32, (rows, C), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (rows, C), 1)
+            after = (row > b[0]) | ((row == b[0]) & (col >= b[1]))
+            before = (row < b[2]) | ((row == b[2]) & (col <= b[3]))
+            return jnp.where(after & before, v, mat).reshape(n)
+
+        fn = bounded_cache_put(_BIG_SPLICE_JIT, ck, jax.jit(masked_set))
+    return fn(data, jnp.asarray(value, data.dtype),
+              jnp.asarray([rs, cs, re_, ce], jnp.int32))
+
+
 def _check_int_bounds(key, shape):
     """Raise IndexError for out-of-range CONCRETE integer indices — jax
     silently clips them, the reference raises (test_ndarray indexing
@@ -797,9 +906,41 @@ def _invoke_body(schema, ctx, arrays, inputs, attrs, out):
     # autograd.grad() may later differentiate w.r.t. any graph input).
     record = autograd.is_recording() and schema.differentiable and len(inputs) > 0
 
-    jitted = _eager_jit_lookup(schema, attrs, arrays)
-    fn = jitted if jitted is not None else _make_op_fn(schema, attrs)
+    # honest int64 indexing at scale: an s64-typed input (index arrays keep
+    # int64 per the creation policy above) meeting a >int32-range dim must
+    # dispatch under x64 on backends that execute s64 natively (cpu), or
+    # jax demotes the indices to int32 with silent wraparound (gather
+    # lands at the wrong offset).  NOT applied on TPU: its compiler
+    # demotes s64 element types wholesale (buffers then mismatch the
+    # executable), so TPU-capable ops (take, scalar get/set item) carry
+    # their own int32-factorized >int32 paths instead.  The cheap dtype
+    # test runs first: >99% of eager dispatches fail it in one tuple
+    # check and never walk shapes.
+    if (any(a.dtype in _X64_ITYPES for a in arrays)
+            and any(_needs_x64_index(a.shape) for a in arrays)
+            and ctx.jax_device is not None
+            and ctx.jax_device.platform not in S64_DEMOTING_PLATFORMS):
+        with jax.enable_x64(True):
+            return _invoke_tail(schema, ctx, arrays, inputs, attrs, out,
+                                _make_op_fn(schema, attrs), None, record)
 
+    if schema.draws_key and attrs.get("key") is None:
+        # the op body draws from the global PRNG chain: tracing it into a
+        # cached executable would leak a tracer into the chain AND bake
+        # the drawn key as a constant (every cache hit returning the same
+        # "random" numbers) — plain dispatch only
+        jitted = None
+    else:
+        jitted = _eager_jit_lookup(schema, attrs, arrays)
+    fn = jitted if jitted is not None else _make_op_fn(schema, attrs)
+    return _invoke_tail(schema, ctx, arrays, inputs, attrs, out, fn, jitted,
+                        record)
+
+
+_X64_ITYPES = (onp.dtype("int64"), onp.dtype("uint64"))
+
+
+def _invoke_tail(schema, ctx, arrays, inputs, attrs, out, fn, jitted, record):
     while True:
         try:
             if record:

@@ -660,28 +660,28 @@ def softmax_activation(data, mode="instance"):
 # ---------------------------------------------------------------------------
 
 @register("laplace", num_inputs=0, differentiable=False,
-          aliases=("_npi_laplace",))
+          aliases=("_npi_laplace",), draws_key=True)
 def laplace(loc=0.0, scale=1.0, size=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     return loc + scale * jax.random.laplace(key, tuple(size), _dt(dtype))
 
 
 @register("gumbel", num_inputs=0, differentiable=False,
-          aliases=("_npi_gumbel",))
+          aliases=("_npi_gumbel",), draws_key=True)
 def gumbel(loc=0.0, scale=1.0, size=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     return loc + scale * jax.random.gumbel(key, tuple(size), _dt(dtype))
 
 
 @register("logistic", num_inputs=0, differentiable=False,
-          aliases=("_npi_logistic",))
+          aliases=("_npi_logistic",), draws_key=True)
 def logistic(loc=0.0, scale=1.0, size=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     return loc + scale * jax.random.logistic(key, tuple(size), _dt(dtype))
 
 
 @register("rayleigh", num_inputs=0, differentiable=False,
-          aliases=("_npi_rayleigh",))
+          aliases=("_npi_rayleigh",), draws_key=True)
 def rayleigh(scale=1.0, size=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     u = jax.random.uniform(key, tuple(size), _dt(dtype), minval=1e-7,
@@ -690,14 +690,14 @@ def rayleigh(scale=1.0, size=(1,), dtype=None, key=None):
 
 
 @register("pareto", num_inputs=0, differentiable=False,
-          aliases=("_npi_pareto",))
+          aliases=("_npi_pareto",), draws_key=True)
 def pareto(a=1.0, size=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     return jax.random.pareto(key, a, tuple(size), _dt(dtype)) - 1.0
 
 
 @register("weibull", num_inputs=0, differentiable=False,
-          aliases=("_npi_weibull",))
+          aliases=("_npi_weibull",), draws_key=True)
 def weibull(a=1.0, size=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     u = jax.random.uniform(key, tuple(size), _dt(dtype), minval=1e-7,
@@ -706,7 +706,7 @@ def weibull(a=1.0, size=(1,), dtype=None, key=None):
 
 
 @register("powerd", num_inputs=0, differentiable=False,
-          aliases=("_npi_powerd",))
+          aliases=("_npi_powerd",), draws_key=True)
 def powerd(a=1.0, size=(1,), dtype=None, key=None):
     """np.random.power: density a*x^(a-1) on [0, 1] — inverse-CDF
     transform u^(1/a)."""
@@ -717,7 +717,7 @@ def powerd(a=1.0, size=(1,), dtype=None, key=None):
 
 
 @register("choice", num_inputs=0, differentiable=False,
-          aliases=("_npi_choice",))
+          aliases=("_npi_choice",), draws_key=True)
 def choice(a=1, size=(1,), replace=True, weights=None, key=None):
     key = key if key is not None else _rng.next_key()
     pool = jnp.arange(int(a)) if isinstance(a, (int, float)) else jnp.asarray(a)
@@ -728,7 +728,7 @@ def choice(a=1, size=(1,), replace=True, weights=None, key=None):
 @register("generalized_negative_binomial", num_inputs=0,
           differentiable=False,
           aliases=("_sample_generalized_negative_binomial",
-                   "random_generalized_negative_binomial"))
+                   "random_generalized_negative_binomial"), draws_key=True)
 def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,), dtype=None,
                                   key=None):
     """Gamma-Poisson mixture with mean mu, dispersion alpha (reference

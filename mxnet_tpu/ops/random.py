@@ -21,14 +21,14 @@ def _dt(dtype):
 
 
 @register("uniform", num_inputs=0, differentiable=False,
-          aliases=["random_uniform", "_sample_uniform"])
+          aliases=["random_uniform", "_sample_uniform"], draws_key=True)
 def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     return jax.random.uniform(key, shape, _dt(dtype), minval=low, maxval=high)
 
 
 @register("normal", num_inputs=0, differentiable=False,
-          aliases=["random_normal", "_sample_normal"])
+          aliases=["random_normal", "_sample_normal"], draws_key=True)
 def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, key=None):
     if isinstance(scale, (int, float, _onp.floating, _onp.integer)) \
             and float(scale) < 0:
@@ -41,27 +41,27 @@ def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, key=None):
 
 
 @register("random_gamma", num_inputs=0, differentiable=False,
-          aliases=["_sample_gamma"])
+          aliases=["_sample_gamma"], draws_key=True)
 def random_gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     return jax.random.gamma(key, alpha, shape, _dt(dtype)) * beta
 
 
 @register("exponential", num_inputs=0, differentiable=False,
-          aliases=["random_exponential"])
+          aliases=["random_exponential"], draws_key=True)
 def exponential(lam=1.0, shape=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     return jax.random.exponential(key, shape, _dt(dtype)) / lam
 
 
-@register("poisson", num_inputs=0, differentiable=False, aliases=["random_poisson"])
+@register("poisson", num_inputs=0, differentiable=False, aliases=["random_poisson"], draws_key=True)
 def poisson(lam=1.0, shape=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     return jax.random.poisson(key, lam, shape).astype(_dt(dtype))
 
 
 @register("negative_binomial", num_inputs=0, differentiable=False,
-          aliases=["random_negative_binomial"])
+          aliases=["random_negative_binomial"], draws_key=True)
 def negative_binomial(k=1, p=1.0, shape=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     k1, k2 = jax.random.split(key)
@@ -69,20 +69,20 @@ def negative_binomial(k=1, p=1.0, shape=(1,), dtype=None, key=None):
     return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
 
 
-@register("randint", num_inputs=0, differentiable=False, aliases=["random_randint"])
+@register("randint", num_inputs=0, differentiable=False, aliases=["random_randint"], draws_key=True)
 def randint(low=0, high=1, shape=(1,), dtype="int32", key=None):
     key = key if key is not None else _rng.next_key()
     return jax.random.randint(key, shape, low, high, _dt(dtype))
 
 
-@register("randn", num_inputs=0, differentiable=False)
+@register("randn", num_inputs=0, differentiable=False, draws_key=True)
 def randn(shape=(1,), loc=0.0, scale=1.0, dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     return loc + scale * jax.random.normal(key, shape, _dt(dtype))
 
 
 @register("multinomial", num_inputs=1, differentiable=False,
-          aliases=["sample_multinomial"])
+          aliases=["sample_multinomial"], draws_key=True)
 def multinomial(data, shape=1, get_prob=False, dtype="int32", key=None):
     key = key if key is not None else _rng.next_key()
     n = shape if isinstance(shape, int) else int(jnp.prod(jnp.asarray(shape)))
@@ -97,13 +97,13 @@ def multinomial(data, shape=1, get_prob=False, dtype="int32", key=None):
     return out.astype(_dt(dtype))
 
 
-@register("shuffle", num_inputs=1, differentiable=False, aliases=["_shuffle"])
+@register("shuffle", num_inputs=1, differentiable=False, aliases=["_shuffle"], draws_key=True)
 def shuffle(data, key=None):
     key = key if key is not None else _rng.next_key()
     return jax.random.permutation(key, data, axis=0)
 
 
-@register("bernoulli", num_inputs=0, differentiable=False)
+@register("bernoulli", num_inputs=0, differentiable=False, draws_key=True)
 def bernoulli(prob=0.5, shape=(1,), dtype=None, key=None):
     key = key if key is not None else _rng.next_key()
     return jax.random.bernoulli(key, prob, shape).astype(_dt(dtype))

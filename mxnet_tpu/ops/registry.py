@@ -39,6 +39,13 @@ class OpSchema:
     # last array input is a PRNG key the frontends auto-supply when the
     # caller omits it (the reference draws from the engine RNG at dispatch)
     rng_input: bool = False
+    # op fn accepts a `key=` ATTR and draws from the global chain when it
+    # is omitted — such a call must never be traced into a cached
+    # executable (the draw would leak a tracer into the chain and bake
+    # the key as a constant).  Declared explicitly per op: a signature
+    # heuristic cannot tell a PRNG key from e.g. _index's indexing key,
+    # and rng_input ops receive their key as an array input instead.
+    draws_key: bool = False
 
     def __post_init__(self):
         if self.doc is None:
@@ -56,6 +63,7 @@ def register(
     aliases: Sequence[str] = (),
     namespaces: Sequence[str] = ("nd",),
     rng_input: bool = False,
+    draws_key: bool = False,
 ):
     """Decorator: register a pure-JAX function as an operator."""
 
@@ -69,6 +77,7 @@ def register(
             aliases=list(aliases),
             namespaces=list(namespaces),
             rng_input=rng_input,
+            draws_key=draws_key,
         )
         if name in _OPS:
             raise ValueError(f"operator '{name}' registered twice")

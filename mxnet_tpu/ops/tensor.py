@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
+from ..base import bounded_cache_put, pow2_col_factor
+from ..base import int32_overflow_dim as _concrete_big
 from .registry import register
 
 
@@ -235,6 +237,57 @@ def slice_like(data, shape_like, axes=None):
 @register("take", num_inputs=2)
 def take(a, indices, axis=0, mode="clip"):
     jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    dim = a.shape[axis] if a.ndim else 0
+    if _concrete_big(dim):
+        # >int32-range gather: the TPU compiler rejects s64 dynamic
+        # indexing outright ("X64 rewrite ... indices exceed 32-bits"),
+        # so factorize each flat index into a (row, col) int32 pair over
+        # a (dim/C, C) view — per-dim extents and indices then all fit
+        # int32, which the hardware gathers natively.  The s64 index
+        # arithmetic runs ON HOST (the AOT compiler demotes device s64
+        # types, mismatching jax's s64 buffers).
+        if a.ndim != 1:
+            raise NotImplementedError(
+                "take along a >int32-range dim of a multi-dim array is "
+                "not supported (an int32 cast would silently wrap the "
+                "indices); flatten to 1-D for the exact factorized "
+                "gather, or reshape so every dim fits int32")
+        if isinstance(indices, jax.core.Tracer):
+            raise NotImplementedError(
+                "take with non-concrete indices on a >int32-range dim "
+                "(inside jit/hybridize traces, or under autograd.record, "
+                "which traces the op for its vjp): the TPU compiler "
+                "demotes s64 index types, so the exact factorization "
+                "needs concrete index values.  Gather outside record()/"
+                "hybridize, or reshape to a 2-D view whose dims fit "
+                "int32 — int32 gathers work everywhere, incl. autograd")
+        C = pow2_col_factor(dim)
+        if not C:
+            # padding to a factorizable length would move data ALONG the
+            # big dim — the exact pattern the runtime corrupts
+            raise NotImplementedError(
+                "take on an odd >int32-range dim: no power-of-two column "
+                "factor exists and padding along a >2^31 dim is corrupt "
+                "on the TPU runtime; pad the array to an even length at "
+                "creation time")
+        idx = onp.asarray(indices).astype(onp.int64)
+        idx = idx % dim if jmode == "wrap" else onp.clip(idx, 0, dim - 1)
+        rows = jnp.asarray((idx // C).astype(onp.int32))
+        cols = jnp.asarray((idx % C).astype(onp.int32))
+        ck = (a.shape, str(a.dtype), rows.shape)
+        fn = _BIG_TAKE_JIT.get(ck)
+        if fn is None:
+
+            def big_take(d, r, c):
+                # traced: reshape/gathers all carry static metadata
+                mat = d.reshape(dim // C, C)
+                picked = jnp.take(mat, r, axis=0, mode="clip")
+                return jnp.take_along_axis(picked, c[..., None], axis=-1)
+
+            fn = bounded_cache_put(_BIG_TAKE_JIT, ck, jax.jit(big_take))
+        return fn(a, rows, cols).reshape(indices.shape)
+    # int32 indexing otherwise (indices address an int32-range dim, so
+    # every in-bounds value fits int32; out-of-bounds clip/wrap first)
     return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
 
 
@@ -286,8 +339,100 @@ def where(condition, x, y):
     return jnp.where(condition.astype(bool), x, y)
 
 
+_BIG_SLICE_JIT: dict = {}
+_BIG_TAKE_JIT: dict = {}
+
+
+def _static_slice_index(data, key):
+    """Lower static int/slice indexing to one literal-bound lax.slice,
+    TRACED under jit.
+
+    For >int32-range dims the default jnp lowering materializes the
+    index as an s32/s64 tensor operand — s32 wraps past 2^31 and the
+    TPU compiler demotes s64 — and eager execution converts even
+    lax.slice to that dynamic form.  Only a slice traced under jit
+    keeps its bounds as LITERALS, which compile fine at any offset.
+    Returns None for key patterns this cannot express (arrays,
+    ellipsis, newaxis, strides)."""
+    keys = key if isinstance(key, tuple) else (key,)
+    if len(keys) > data.ndim or any(
+            isinstance(k, bool) or not isinstance(k, (int, onp.integer, slice))
+            for k in keys):
+        # bools are ints to isinstance but mean newaxis-like masking in
+        # numpy (x[True] -> shape (1, ...)) — never an element index
+        return None
+    starts, stops, squeeze = [], [], []
+    for ax, k in enumerate(keys):
+        d = data.shape[ax]
+        if isinstance(k, slice):
+            s, e, st = k.indices(d)
+            if st != 1 or e < s:
+                return None
+            starts.append(s)
+            stops.append(e)
+        else:
+            i = int(k) + (d if int(k) < 0 else 0)
+            starts.append(i)
+            stops.append(i + 1)
+            squeeze.append(ax)
+    for ax in range(len(keys), data.ndim):
+        starts.append(0)
+        stops.append(data.shape[ax])
+    ck = (data.shape, str(data.dtype), tuple(starts), tuple(stops),
+          tuple(squeeze))
+    fn = _BIG_SLICE_JIT.get(ck)
+    if fn is None:
+
+        def do_slice(d):
+            out = jax.lax.slice(d, starts, stops)
+            if squeeze:
+                out = out.reshape([dd for ax2, dd in enumerate(out.shape)
+                                   if ax2 not in squeeze])
+            return out
+
+        fn = bounded_cache_put(_BIG_SLICE_JIT, ck, jax.jit(do_slice))
+    return fn(data)
+
+
 @register("_index", differentiable=True)
 def _index(data, key=None):
+    if any(_concrete_big(d) for d in data.shape):
+        out = _static_slice_index(data, key)
+        if out is not None:
+            return out
+        if isinstance(key, list) and data.ndim == 1 and key and all(
+                isinstance(k, (int, onp.integer)) and not isinstance(k, bool)
+                for k in key):
+            key = onp.asarray(key, onp.int64)     # list of ints == index array
+        # runtime integer-array index on a >int32-range 1-D array: route
+        # through take's exact int32 factorization — the default jnp
+        # lowering would demote the indices to int32 and gather from
+        # wrapped offsets with no error.  Getitem semantics wrap
+        # negatives (unlike take's clip), so normalize on host first.
+        if (data.ndim == 1 and getattr(key, "dtype", None) is not None
+                and onp.dtype(key.dtype).kind in ("i", "u")
+                and not isinstance(key, bool)):
+            if isinstance(key, jax.core.Tracer):
+                raise NotImplementedError(
+                    "indexing a >int32-range dim with a traced index "
+                    "array (jit/hybridize): the TPU compiler demotes "
+                    "s64 index types; index eagerly or use a 2-D view "
+                    "whose dims fit int32")
+            kh = onp.asarray(key).astype(onp.int64)
+            kh = onp.where(kh < 0, kh + data.shape[0], kh)
+            return take(data, kh, axis=0, mode="clip")
+        # anything else (multi-dim big arrays with array keys, stepped
+        # slices, masks) would reach jnp's default lowering, whose int32
+        # index demotion silently gathers from wrapped offsets on
+        # s64-demoting backends — refuse loudly there; cpu executes s64
+        # natively (invoke dispatches it under x64), so fall through
+        if jax.default_backend() in S64_DEMOTING_PLATFORMS:
+            raise NotImplementedError(
+                "this index pattern on a >int32-range dim would be "
+                "demoted to int32 by the TPU compiler and gather from "
+                "wrapped offsets; use static int/contiguous-slice keys, "
+                "a 1-D integer index array, or a 2-D view whose dims "
+                "fit int32")
     return data[key]
 
 

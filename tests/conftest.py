@@ -34,7 +34,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # down.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("MXNET_TEST_ALLOW_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
 
 import hashlib
 

@@ -9,6 +9,8 @@ jitted forward, and that hybridized traces never route through an inner
 jit (fusion preservation).  Reference analog: engine operator bulking,
 ``src/engine/threaded_engine.h:507-528``.
 """
+import os
+
 import numpy as onp
 import pytest
 
@@ -218,8 +220,34 @@ def test_multi_output_op_jitted(eager_jit):
 def test_default_mode_off_on_cpu():
     """mode 1 (default) must not jit on the CPU backend: the test suite's
     eager path stays plain dispatch (no per-shape compile storms)."""
+    if os.environ.get("MXNET_EAGER_JIT") == "2":
+        pytest.skip("suite running with eager jit forced on")
     config.refresh("MXNET_EAGER_JIT")
     ndmod._EAGER_JIT_CACHE.clear()
     x = nd.array(onp.ones((3, 3), onp.float32))
     nd.softmax(x, axis=-1)
     assert not ndmod._EAGER_JIT_CACHE
+
+
+def test_keyless_rng_ops_never_jitted(eager_jit):
+    """Ops that draw from the global PRNG chain when ``key`` is omitted
+    (the samplers' ``key=None`` default) must stay on plain dispatch:
+    tracing the draw would leak a tracer into the chain and bake the key
+    into the cached executable (every cache hit returning identical
+    "random" numbers).  Caught live on the TPU backend where eager jit
+    defaults on."""
+    a = nd.random.normal(shape=(16,))
+    b = nd.random.normal(shape=(16,))      # second call: chain must be intact
+    assert not onp.allclose(a.asnumpy(), b.asnumpy())
+    assert not any(k[0] in ("normal", "uniform") for k in ndmod._EAGER_JIT_CACHE)
+    u1 = nd.random.uniform(shape=(16,))
+    u2 = nd.random.uniform(shape=(16,))
+    assert not onp.allclose(u1.asnumpy(), u2.asnumpy())
+    # an explicit key is static data: jit is fine there, and the same key
+    # must reproduce the same sample through whichever path runs
+    import jax
+
+    k = jax.random.PRNGKey(7)
+    s1 = nd.random.normal(shape=(8,), key=k)
+    s2 = nd.random.normal(shape=(8,), key=k)
+    onp.testing.assert_allclose(s1.asnumpy(), s2.asnumpy())

@@ -92,16 +92,57 @@ def test_past_int32_indexing_on_chip():
         pytest.skip("needs TPU HBM for a 4 GiB array")
     NBIG = (1 << 31) + 128                  # 4 GiB + eps in bf16
     x = nd.zeros((NBIG,), dtype="bfloat16")
-    x[NBIG - 3] = 7.0                       # write at a >int32 offset
-    got = nd.take(x, nd.array(onp.array([NBIG - 3, 2], onp.int64)))
-    onp.testing.assert_allclose(got.asnumpy().astype(onp.float32), [7.0, 0.0])
+    # Static write at a >int32 flat offset: XLA addresses large buffers
+    # with s64 offsets internally, so constant indices past 2^31 are the
+    # honest per-element path on TPU (runtime indices are int32 without
+    # x64 — exercised below on a 2-D view where every dim fits int32,
+    # which is also how the framework shapes real >2^31 workloads).
+    x[NBIG - 3] = 7.0
     # full reduction over 2^31+ elements (fp32 accumulation, exact here)
     assert float(x.sum().asnumpy()) == 7.0
-    # slice starting past int32
+    # static slice starting past int32
     tail = x[NBIG - 8:].asnumpy().astype(onp.float32)
     assert tail.shape == (8,) and tail[5] == 7.0
-    # 2-D view: row gather where rows * cols exceeds int32
+    # runtime int64 index array past 2^31: the invoke-level x64 dispatch
+    # rule must keep the indices s64 (without it, jax silently wraps them
+    # to int32 and the gather lands at the wrong offset)
+    got = nd.take(x, nd.array(onp.array([NBIG - 3, 2], onp.int64)))
+    onp.testing.assert_allclose(got.asnumpy().astype(onp.float32), [7.0, 0.0])
+    # getitem with a runtime int64 index array routes through the same
+    # factorization (review finding: it used to silently wrap)
+    got = x[nd.array(onp.array([NBIG - 3, 2], onp.int64))]
+    onp.testing.assert_allclose(got.asnumpy().astype(onp.float32), [7.0, 0.0])
+    # in-int32-range scalar writes (int and contiguous slice) go through
+    # the masked elementwise path — a plain scatter's full-buffer copy
+    # along the >2^31 dim is corrupt on this runtime (review finding:
+    # these used to raise outright on TPU)
+    x[0:4] = 1.0
+    x[5] = 2.0
+    assert float(x.sum().asnumpy()) == 13.0
+    head = x[0:8].asnumpy().astype(onp.float32)
+    onp.testing.assert_allclose(head, [1, 1, 1, 1, 0, 2, 0, 0])
+    # 2-D view: runtime row gather where rows * cols exceeds int32 but
+    # each index fits int32 (rows = 2^24 + 1)
     rows = NBIG // 128
     y = x.reshape((rows, 128))
-    row = nd.take(y, nd.array(onp.array([rows - 1], onp.int64)))
+    row = nd.take(y, nd.array(onp.array([rows - 1], onp.int32)))
     assert row.shape == (1, 128)
+    got = row.asnumpy().astype(onp.float32)
+    assert got[0, 125] == 7.0 and got.sum() == 7.0
+
+
+def test_int64_values_past_int32_survive_creation():
+    """Regression: NDArray creation from int64 data must keep values
+    past 2^31 exact on every platform.  The device_put used to run
+    OUTSIDE the enable_x64 scope, and the transfer then canonicalized
+    through int32 — wrapping the VALUE while still reporting an int64
+    dtype (caught live on the TPU tunnel: graph/edge-id scale data
+    silently corrupted)."""
+    big = (1 << 31) + 125
+    a = nd.array(onp.array([big, 2, -big], onp.int64))
+    assert str(a.dtype) in ("int64", "<class 'numpy.int64'>") or a.dtype == onp.int64
+    onp.testing.assert_array_equal(a.asnumpy(), [big, 2, -big])
+    # same contract for uint64 above 2^63 is out of scope (jax caps at
+    # u64), but u64 past 2^32 must also survive
+    b = nd.array(onp.array([1 << 40], onp.uint64))
+    onp.testing.assert_array_equal(b.asnumpy(), [1 << 40])

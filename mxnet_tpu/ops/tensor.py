@@ -293,6 +293,10 @@ def take(a, indices, axis=0, mode="clip"):
 
 @register("pick", num_inputs=2)
 def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    if _concrete_big(data.shape[axis]):
+        raise NotImplementedError(
+            "pick along a >int32-range dim: the int32 index cast would "
+            "silently wrap; reshape so the picked dim fits int32")
     index = index.astype(jnp.int32)
     out = jnp.take_along_axis(data, jnp.expand_dims(index, axis=axis), axis=axis)
     if not keepdims:
@@ -302,6 +306,10 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip"):
 
 @register("gather_nd", num_inputs=2)
 def gather_nd(data, indices):
+    if any(_concrete_big(d) for d in data.shape[:indices.shape[0]]):
+        raise NotImplementedError(
+            "gather_nd over a >int32-range dim: the int32 index cast "
+            "would silently wrap; reshape so indexed dims fit int32")
     idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
     return data[idx]
 

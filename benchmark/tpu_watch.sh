@@ -60,20 +60,15 @@ while true; do
             fi
         fi
         probe || { log "tunnel lost after bench"; sleep "$PROBE_EVERY"; continue; }
-        # 2. microbench (s8-vs-bf16, epilogue, BN cost)
-        run_item microbench 900 python benchmark/microbench_tpu.py
-        # 3. bf16 ablation rows
-        run_item ablation_nchw 900 env BENCH_MODEL=resnet50_v1_bf16 BENCH_LAYOUT=NCHW BENCH_S2D=0 python bench.py
-        run_item ablation_nhwc 900 env BENCH_MODEL=resnet50_v1_bf16 BENCH_LAYOUT=NHWC BENCH_S2D=0 python bench.py
-        # 4. train-step profile
-        run_item profile 600 python benchmark/profile_step.py --steps 5 --top 30
-        # 4b. eager dispatch latency A/B (per-op jit cache vs plain);
-        # outer budget > sum of the script's two 900s inner subprocesses
-        run_item eager_latency 2000 python benchmark/eager_latency.py
-        # 5. remat headroom at bs256
-        run_item remat_bs256 1200 env BENCH_MODEL=resnet50_v1_bf16 BENCH_BATCH=256 MXNET_BACKWARD_DO_MIRROR=1 python bench.py
-        # 6. large-tensor on-chip test (>2^31 elements in HBM)
-        run_item large_tensor 900 env MXNET_TEST_ALLOW_TPU=1 python -m pytest tests/test_large_tensor.py -x -q -m tpu --no-header
+        # 2026-08-01 session 2: items 2-5 of the original queue (micro-
+        # bench, ablations, profile, eager latency, remat bs256) were all
+        # captured on chip (benchmark/chip_session.md, docs/PERF.md) —
+        # what remains is re-validating the FINAL big-index code and one
+        # BERT batch-sweep experiment.
+        # 2. large-tensor on-chip test (>2^31 elements in HBM), final code
+        run_item large_tensor_final 1800 env MXNET_TEST_ALLOW_TPU=1 python -m pytest tests/test_large_tensor.py -x -q -m tpu --no-header
+        # 3. BERT batch sweep: does bs64 lift the 45.6% MFU?
+        run_item bert_bs64 1200 env BENCH_MODEL=bert BENCH_BATCH=64 python bench.py
     else
         log "tunnel down"
     fi

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
-from ..base import bounded_cache_put, pow2_col_factor
+from ..base import S64_DEMOTING_PLATFORMS, bounded_cache_put, pow2_col_factor
 from ..base import int32_overflow_dim as _concrete_big
 from .registry import register
 

@@ -1,0 +1,138 @@
+""">int32 indexing paths exercised on CPU via a shrunken threshold.
+
+The factorized big-tensor code (take's (row, col) int32 gather, the
+masked elementwise setitem, the literal-bound jitted static slices —
+built against the TPU runtime envelope in docs/PERF.md) normally only
+runs on >2^31-element arrays, which only the chip-gated test can
+allocate.  Every path reads the boundary through
+``mxnet_tpu.base._INT32_MAX``, so shrinking it makes tiny arrays take
+the exact same code paths — full CI coverage of the logic; the chip
+test keeps covering the runtime behavior.  Reference analog:
+``tests/nightly/test_large_array.py`` logic at CI scale.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu.base as base
+from mxnet_tpu import nd
+
+BIG = 384          # > the shrunken boundary, divisible by 128
+BOUND = 255
+
+
+@pytest.fixture
+def small_int32_max(monkeypatch):
+    monkeypatch.setattr(base, "_INT32_MAX", BOUND)
+    yield
+    # jit caches in the big-index paths key on (shape, dtype, ...): tiny
+    # test shapes can't collide with real >2^31 entries, so no cleanup
+
+
+def _ref(n=BIG):
+    return onp.arange(n, dtype=onp.float32)
+
+
+def test_factorized_take_matches_numpy(small_int32_max):
+    x = nd.array(_ref())
+    idx = onp.array([0, 5, BIG - 1, 255, 256], onp.int64)
+    got = nd.take(x, nd.array(idx)).asnumpy()
+    onp.testing.assert_allclose(got, _ref()[idx])
+
+
+def test_factorized_take_clip_and_wrap_modes(small_int32_max):
+    x = nd.array(_ref())
+    over = onp.array([BIG + 5, -1], onp.int64)
+    clip = nd.take(x, nd.array(over), mode="clip").asnumpy()
+    # numpy take mode=clip clips BOTH ends: past-end -> last, negative -> 0
+    onp.testing.assert_allclose(clip, [BIG - 1, 0])
+    wrap = nd.take(x, nd.array(over), mode="wrap").asnumpy()
+    onp.testing.assert_allclose(wrap, [5, BIG - 1])
+
+
+def test_factorized_take_multidim_and_odd_dims_refuse(small_int32_max):
+    y = nd.array(onp.zeros((BIG, 2), onp.float32))
+    with pytest.raises(NotImplementedError):
+        nd.take(y, nd.array(onp.array([0], onp.int64)))
+    odd = nd.array(onp.zeros((BOUND + 2,), onp.float32))   # 257: odd "big"
+    with pytest.raises(NotImplementedError):
+        nd.take(odd, nd.array(onp.array([0], onp.int64)))
+
+
+def test_getitem_static_paths_on_big_dims(small_int32_max):
+    x = nd.array(_ref())
+    assert float(x[BIG - 3].asscalar()) == BIG - 3      # static int
+    assert float(x[-1].asscalar()) == BIG - 1           # negative int
+    tail = x[BIG - 8:].asnumpy()
+    onp.testing.assert_allclose(tail, _ref()[-8:])      # open slice
+    mid = x[100:110].asnumpy()
+    onp.testing.assert_allclose(mid, _ref()[100:110])
+
+
+def test_getitem_array_and_list_keys_route_exactly(small_int32_max):
+    x = nd.array(_ref())
+    idx = onp.array([BIG - 1, 0, -1], onp.int64)        # negative wraps
+    got = x[nd.array(idx)].asnumpy()
+    onp.testing.assert_allclose(got, _ref()[idx])
+    got = x[[BIG - 1, 2]].asnumpy()                     # raw list key
+    onp.testing.assert_allclose(got, [BIG - 1, 2])
+
+
+def test_getitem_bool_key_keeps_numpy_semantics(small_int32_max):
+    x = nd.array(_ref())
+    t = x[True]
+    assert t.shape == (1, BIG)                          # newaxis, not index 1
+    f = x[False]
+    assert f.shape == (0, BIG)
+
+
+def test_masked_setitem_int_and_slice(small_int32_max):
+    x = nd.array(_ref())
+    x[BIG - 3] = 7.0
+    x[0:4] = 1.0
+    x[-1] = 9.0
+    want = _ref()
+    want[BIG - 3] = 7.0
+    want[0:4] = 1.0
+    want[-1] = 9.0
+    onp.testing.assert_allclose(x.asnumpy(), want)
+
+
+def test_masked_setitem_empty_slice_is_noop(small_int32_max):
+    x = nd.array(_ref())
+    v0 = x.version
+    x[5:5] = 123.0
+    onp.testing.assert_allclose(x.asnumpy(), _ref())
+    assert x.version == v0 + 1    # still a write event, value unchanged
+
+
+def test_setitem_nonscalar_value_falls_back_correctly(small_int32_max):
+    # array-valued writes leave the masked path (scalar-only) and reach
+    # the x64-native fallback on CPU — values must still land exactly
+    x = nd.array(_ref())
+    x[0:4] = nd.array(onp.array([10.0, 11.0, 12.0, 13.0], onp.float32))
+    onp.testing.assert_allclose(x.asnumpy()[:5], [10, 11, 12, 13, 4])
+
+
+def test_full_reduction_and_reshape_roundtrip(small_int32_max):
+    x = nd.array(_ref())
+    assert float(x.sum().asnumpy()) == _ref().sum()
+    y = x.reshape((BIG // 128, 128))
+    row = nd.take(y, nd.array(onp.array([BIG // 128 - 1], onp.int32)))
+    onp.testing.assert_allclose(row.asnumpy()[0], _ref()[-128:])
+
+
+def test_pick_gather_nd_guards(small_int32_max):
+    y = nd.array(onp.zeros((BIG, 4), onp.float32))
+    with pytest.raises(NotImplementedError):
+        nd.pick(y.T, nd.array(onp.zeros(4, onp.float32)), axis=1)
+    with pytest.raises(NotImplementedError):
+        nd.gather_nd(y, nd.array(onp.array([[0], [1]], onp.int32)))
+
+
+def test_boundary_helpers_respect_patched_threshold(small_int32_max):
+    assert base.int32_overflow_dim(BIG)
+    assert not base.int32_overflow_dim(BOUND)
+    assert base.pow2_col_factor(BIG) == 128
+    assert base.pow2_col_factor(BOUND + 2) == 0         # odd
+    # n//c must also fit the (patched) int32 range
+    assert base.pow2_col_factor(BOUND * 4) in (0, 2, 4)

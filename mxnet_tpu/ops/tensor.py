@@ -316,6 +316,12 @@ def gather_nd(data, indices):
 
 @register("scatter_nd", num_inputs=2, differentiable=True)
 def scatter_nd(data, indices, shape=None):
+    if any(_concrete_big(d) for d in tuple(shape)[:indices.shape[0]]):
+        raise NotImplementedError(
+            "scatter_nd into a >int32-range dim: the int32 index cast "
+            "would silently wrap (and scatters along >2^31 dims are "
+            "corrupt on the TPU runtime); reshape so scattered dims "
+            "fit int32")
     idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
     out = jnp.zeros(shape, dtype=data.dtype)
     return out.at[idx].add(data)

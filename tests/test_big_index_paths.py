@@ -136,3 +136,13 @@ def test_boundary_helpers_respect_patched_threshold(small_int32_max):
     assert base.pow2_col_factor(BOUND + 2) == 0         # odd
     # n//c must also fit the (patched) int32 range
     assert base.pow2_col_factor(BOUND * 4) in (0, 2, 4)
+
+
+def test_scatter_nd_guard(small_int32_max):
+    with pytest.raises(NotImplementedError):
+        nd.scatter_nd(nd.array(onp.ones(2, onp.float32)),
+                      nd.array(onp.array([[0, 1]], onp.int32)), shape=(BIG,))
+    # int32-range shapes unaffected
+    out = nd.scatter_nd(nd.array(onp.ones(2, onp.float32)),
+                        nd.array(onp.array([[0, 3]], onp.int32)), shape=(8,))
+    onp.testing.assert_allclose(out.asnumpy(), [1, 0, 0, 1, 0, 0, 0, 0])

@@ -81,6 +81,21 @@ def _progress(msg: str) -> None:
           flush=True)
 
 
+def _git_head() -> str:
+    """Commit the benchmark was captured at (provenance stamp, ADVICE r5);
+    'unknown' outside a git checkout."""
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        if r.returncode == 0:
+            return r.stdout.strip()
+    except Exception:
+        pass
+    return "unknown"
+
+
 def _device_kind() -> str:
     try:
         import jax
@@ -136,15 +151,28 @@ def _emit_final(error: str = "") -> None:
         if error:
             payload["error"] = error[:400]
         payload["lanes"] = _LANES
+        # provenance: stamp the commit this run measured, so later readers
+        # can tell whether any referenced artifact is the same code
+        head = _git_head()
+        payload["git_commit"] = head
         if any(l.get("platform") == "cpu" for l in _LANES):
             # some lane fell back to the host: point the reader at the
-            # builder's on-chip artifact for the real-hardware record
+            # builder's on-chip artifact — but ONLY when that artifact
+            # carries a commit stamp matching HEAD; a stale artifact from
+            # other code must not be passed off as "the same code"
             ref = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_builder_r05.json")
-            if os.path.exists(ref):
+            try:
+                with open(ref) as f:
+                    ref_commit = json.load(f).get("git_commit")
+            except (OSError, ValueError):
+                ref_commit = None
+            if ref_commit is not None and ref_commit == head \
+                    and head != "unknown":
                 payload["builder_artifact"] = (
                     "BENCH_builder_r05.json: builder-measured on-chip run "
-                    "of the same code (all lanes platform=tpu)")
+                    f"of the same code (git {head[:12]}, all lanes "
+                    "platform=tpu)")
         print(json.dumps(payload), flush=True)
         try:   # stand the watchdog down: we own the stdout line now
             open(_PARTIAL_PATH + ".done", "w").close()

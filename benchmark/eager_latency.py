@@ -1,14 +1,23 @@
 """Eager per-op dispatch latency: plain dispatch vs the per-op jit cache
-(MXNET_EAGER_JIT).  Run on the chip to fill docs/PERF.md's eager table
-(round-5 VERDICT Weak #4); CPU runs are still meaningful A/Bs of python
-dispatch overhead.
+(MXNET_EAGER_JIT), plus the Trainer-step lane comparing the fused
+multi-tensor optimizer path (MXNET_FUSED_OPTIMIZER, optimizer/fused.py)
+against the per-parameter scalar loop.  Run on the chip to fill
+docs/PERF.md's eager table (round-5 VERDICT Weak #4); CPU runs are still
+meaningful A/Bs of python dispatch overhead.
 
 Method per op: warm (compile + cache) with host-value reads, then time N
 invocations fenced by a host read — the tunnel exerts no backpressure
 until a sync, so unfenced loops measure enqueue rate, not latency
 (docs/PERF.md round-4 lesson).
 
+The trainer lane reports ``dispatches_per_step`` = eager op dispatches
+(ndarray.invoke_count) + compiled group-program launches
+(fused.dispatch_count) per ``trainer.step()``: the fused path must stay
+at <= 1 + (number of distinct parameter groups) while the loop path pays
+>= 1 per parameter (the acceptance bar for PR 1).
+
 Usage: python benchmark/eager_latency.py [--ops N] [--json]
+                                         [--trainer-params P] [--no-trainer]
 Each mode runs in a SUBPROCESS so the jit cache and config are clean.
 """
 import json
@@ -69,6 +78,67 @@ print(json.dumps({"platform": jax.default_backend(),
 """
 
 
+# Trainer-step lane: a flat >=50-parameter "model" (grads pre-filled so
+# the measurement is pure step() cost), stepped with the fused
+# multi-tensor path on/off.  Dispatch counts come from the in-tree
+# counters, not wall clock, so the lane is meaningful on any backend.
+_TRAINER_WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))) if "__file__" in dir() else "/root/repo")
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.ndarray import ndarray as _ndmod
+from mxnet_tpu.optimizer import fused as _fused
+
+NPARAM = int(os.environ.get("TRAINER_PARAMS", "56"))
+STEPS = int(os.environ.get("TRAINER_STEPS", "20"))
+OPT = os.environ.get("TRAINER_OPT", "sgd")
+rng = onp.random.RandomState(0)
+params = {}
+for i in range(NPARAM):
+    p = gluon.Parameter(f"w{i}", shape=(32, 32))
+    p.initialize(init=mx.init.Xavier())
+    params[f"w{i}"] = p
+opt_kw = {"learning_rate": 0.01}
+if OPT == "sgd":
+    opt_kw["momentum"] = 0.9
+trainer = gluon.Trainer(params, OPT, opt_kw)
+
+def fill_grads():
+    for p in params.values():
+        g = p.list_grad()[0]
+        g._set_data(mx.nd.array(
+            rng.randn(*g.shape).astype("float32") * 0.01)._data)
+
+fill_grads()
+trainer.step(1)                          # warm: state create + compile
+for p in params.values():                # drain
+    _ = p.data().asnumpy()
+
+inv0, fus0 = _ndmod.invoke_count(), _fused.dispatch_count()
+t0 = time.perf_counter()
+for _ in range(STEPS):
+    trainer.step(1)
+_ = next(iter(params.values())).data().asnumpy()   # fence
+dt = time.perf_counter() - t0
+inv = _ndmod.invoke_count() - inv0
+fus = _fused.dispatch_count() - fus0
+
+import jax
+print(json.dumps({
+    "platform": jax.default_backend(),
+    "fused": bool(_fused.enabled(trainer._optimizer)),
+    "n_params": NPARAM,
+    "n_groups": 1,
+    "steps": STEPS,
+    "dispatches_per_step": (inv + fus) / STEPS,
+    "compiled_group_dispatches_per_step": fus / STEPS,
+    "us_per_step": dt / STEPS * 1e6,
+}))
+"""
+
+
 def run(mode: str, n: int) -> dict:
     env = dict(os.environ)
     env["MXNET_EAGER_JIT"] = mode
@@ -83,17 +153,48 @@ def run(mode: str, n: int) -> dict:
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+def run_trainer(fused: bool, n_params: int, steps: int = 20,
+                opt: str = "sgd") -> dict:
+    env = dict(os.environ)
+    env["MXNET_FUSED_OPTIMIZER"] = "1" if fused else "0"
+    env["TRAINER_PARAMS"] = str(n_params)
+    env["TRAINER_STEPS"] = str(steps)
+    env["TRAINER_OPT"] = opt
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+    r = subprocess.run([sys.executable, "-u", "-c", _TRAINER_WORKER],
+                       capture_output=True, text=True, timeout=900, env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))) or ".")
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"trainer lane (fused={fused}) failed:\n{r.stderr[-2000:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def main() -> None:
     n = 100
     as_json = "--json" in sys.argv
     if "--ops" in sys.argv:
         n = int(sys.argv[sys.argv.index("--ops") + 1])
+    trainer_params = 56
+    if "--trainer-params" in sys.argv:
+        trainer_params = int(
+            sys.argv[sys.argv.index("--trainer-params") + 1])
     off = run("0", n)
     on = run("2", n)
     result = {"platform": off["platform"], "n": n,
               "plain_us": off["us_per_op"], "jit_us": on["us_per_op"],
               "speedup": {k: round(off["us_per_op"][k] / on["us_per_op"][k], 2)
                           for k in off["us_per_op"]}}
+    if "--no-trainer" not in sys.argv:
+        t_fused = run_trainer(True, trainer_params)
+        t_loop = run_trainer(False, trainer_params)
+        result["trainer_step"] = {
+            "n_params": trainer_params,
+            "fused": t_fused, "loop": t_loop,
+            "dispatch_reduction": round(
+                t_loop["dispatches_per_step"]
+                / max(t_fused["dispatches_per_step"], 1e-9), 1)}
     if as_json:
         print(json.dumps(result))
         return
@@ -103,6 +204,17 @@ def main() -> None:
     for k in off["us_per_op"]:
         print(f"{k:<20} {off['us_per_op'][k]:>10.1f} "
               f"{on['us_per_op'][k]:>12.1f} {result['speedup'][k]:>8.2f}x")
+    if "trainer_step" in result:
+        ts = result["trainer_step"]
+        print(f"\ntrainer step ({ts['n_params']} params, sgd+momentum, "
+              "dispatches per step())")
+        print(f"{'path':<8} {'dispatches':>11} {'group-progs':>12} "
+              f"{'us/step':>10}")
+        for name, lane in (("fused", ts["fused"]), ("loop", ts["loop"])):
+            print(f"{name:<8} {lane['dispatches_per_step']:>11.1f} "
+                  f"{lane['compiled_group_dispatches_per_step']:>12.1f} "
+                  f"{lane['us_per_step']:>10.1f}")
+        print(f"dispatch reduction: {ts['dispatch_reduction']}x")
 
 
 if __name__ == "__main__":

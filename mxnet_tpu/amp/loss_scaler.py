@@ -22,21 +22,24 @@ class LossScaler:
 
     def has_overflow(self, params) -> bool:
         """True if any gradient is non-finite (reference
-        loss_scaler.py has_overflow).  Finiteness reduces per-grad on
-        device; exactly ONE scalar host sync per call."""
+        loss_scaler.py has_overflow).  The whole finiteness reduction runs
+        as ONE compiled program (optimizer/fused.py all_finite) with
+        exactly one scalar host sync per call."""
         import jax.numpy as jnp
 
-        flags = []
+        from ..optimizer import fused as _fused
+
+        arrays = []
         for p in params:
             grads = p.list_grad() if hasattr(p, "list_grad") else [p]
             for g in grads:
                 if g is None:
                     continue
-                a = g._data if isinstance(g, NDArray) else jnp.asarray(g)
-                flags.append(jnp.isfinite(a).all())
-        if not flags:
+                arrays.append(g._data if isinstance(g, NDArray)
+                              else jnp.asarray(g))
+        if not arrays:
             return False
-        return not bool(jnp.stack(flags).all())
+        return not bool(_fused.all_finite(arrays))
 
     def update_scale(self, overflow: bool):
         if overflow:

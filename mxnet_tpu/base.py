@@ -44,6 +44,30 @@ _INT32_MAX = 0x7FFFFFFF
 S64_DEMOTING_PLATFORMS = ("tpu", "axon")
 
 
+def enable_x64(new_val: bool = True):
+    """Compat chokepoint for the x64 scope: ``jax.enable_x64`` moved to
+    ``jax.experimental`` (removed from the top-level namespace in newer
+    jax).  Every honest-int64 path routes through here."""
+    import jax
+
+    fn = getattr(jax, "enable_x64", None)
+    if fn is None:
+        from jax.experimental import enable_x64 as fn
+    return fn(new_val)
+
+
+def s64_demoting_backend() -> bool:
+    """True when the CURRENT default backend demotes s64 element types
+    wholesale (tpu-class compilers).  Big-dim ops consult this at call
+    time to pick between the int32-factorized paths (demoting backends)
+    and plain s64 execution (x64-native cpu).  A function, not a constant,
+    so tests can monkeypatch it to exercise the factorized machinery on
+    the host."""
+    import jax
+
+    return jax.default_backend() in S64_DEMOTING_PLATFORMS
+
+
 def int32_overflow_dim(d) -> bool:
     """True for a CONCRETE dim past int32 range.  Symbolic dims (AOT
     shape-polymorphic export) are never 'big' — comparing them raises

@@ -165,6 +165,15 @@ declare("MXNET_EAGER_JIT", int, 1,
         "(op, attrs) instead of per-primitive device round-trips.  0 = "
         "off, 1 = on for the TPU backend (default; CPU eager stays plain "
         "dispatch), 2 = force everywhere (tests/benchmarks).")
+declare("MXNET_FUSED_OPTIMIZER", int, 1,
+        "Fused multi-tensor optimizer step for the eager Trainer/KVStore "
+        "path: parameters group by (dtype, hyper-param signature, "
+        "multi-precision) and each group updates as ONE jit-compiled, "
+        "buffer-donated program (optimizer/fused.py) — ~1 dispatch per "
+        "group instead of 1+ per parameter.  1 = on (default; optimizers "
+        "without a fused_update rule fall back to the scalar loop "
+        "per-parameter), 0 = force the scalar loop everywhere.",
+        subsystem="optimizer", cached=False)
 declare("MXNET_FUSED_CONV_BN", int, 0,
         "Trace-time fusion of eligible conv + BatchNorm(training) pairs "
         "into the Pallas conv+BN-stats kernels.  0 = off (default: the "

@@ -183,8 +183,27 @@ class Trainer:
                 "recreate the Trainer with update_on_kvstore=False")
         self._allreduce_grads()
         if scaler is not None:
-            # fp16 AMP: skip the update and shrink the scale on overflow
-            # (reference amp trainer patching + LossScaler policy);
+            from ..optimizer import fused as _fused
+
+            if _fused.enabled(self._optimizer):
+                # fold the overflow check into the fused step: ONE compiled
+                # all-finite program whose device flag gates each group
+                # program (the update is skipped on-device via where(ok)),
+                # then a single host read for the scale policy — instead of
+                # a host sync standing between the check and the update
+                grads = [g._data for p in self._params
+                         if p.grad_req != "null"
+                         for g in p.list_grad() if g is not None]
+                ok = _fused.all_finite(grads)
+                self._optimizer._fused_skip_ok = ok
+                try:
+                    self._update(ignore_stale_grad)
+                finally:
+                    self._optimizer._fused_skip_ok = None
+                scaler.update_scale(not bool(ok))
+                return
+            # fp16 AMP scalar path: skip the update and shrink the scale on
+            # overflow (reference amp trainer patching + LossScaler policy);
             # amp.init_trainer rejects update_on_kvstore trainers, so the
             # weights are untouched at this point
             overflow = scaler.has_overflow(
@@ -211,6 +230,24 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        if self._update_on_kvstore:
+            from ..optimizer import fused as _fused
+
+            if _fused.enabled(self._optimizer):
+                # ONE batched pushpull over every key: the store reduces
+                # each key, then applies the optimizer over the whole key
+                # set as grouped compiled programs (server-side fused
+                # update, kvstore.py), then pulls the new weights back
+                idxs, grads, outs = [], [], []
+                for param in self._params:
+                    if param.grad_req == "null":
+                        continue
+                    idxs.append(self._param2idx[id(param)])
+                    grads.append(param.list_grad())
+                    outs.append(param.list_data())
+                if idxs:
+                    self._kvstore.pushpull(idxs, grads, out=outs)
+                return
         for param in self._params:
             if param.grad_req == "null":
                 continue
@@ -225,6 +262,28 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore and self._kvstore is not None:
             return  # weights already updated server-side in _allreduce_grads
+        from ..optimizer import fused as _fused
+
+        if _fused.enabled(self._optimizer):
+            # fused multi-tensor path: ONE updater call per device slot
+            # carrying every trainable parameter; the optimizer groups
+            # them by (dtype, hyper-param signature, multi-precision) and
+            # applies each group as one donated compiled program
+            batches = [[] for _ in self._updaters]
+            for param in self._params:
+                if param.grad_req == "null":
+                    continue
+                idx = self._param2idx[id(param)]
+                for i, (weight, grad) in enumerate(
+                        zip(param.list_data(), param.list_grad())):
+                    if i >= len(batches):
+                        break
+                    batches[i].append((idx, grad, weight))
+            for updater, batch in zip(self._updaters, batches):
+                if batch:
+                    idxs, grads, weights = (list(t) for t in zip(*batch))
+                    updater(idxs, grads, weights)
+            return
         for param in self._params:
             if param.grad_req == "null":
                 continue

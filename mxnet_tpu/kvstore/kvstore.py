@@ -74,7 +74,58 @@ def _cross_process_sum(x: jnp.ndarray) -> jnp.ndarray:
     local = jax.device_put(jnp.expand_dims(x, 0), mine)
     garr = jax.make_array_from_single_device_arrays(
         (P,) + tuple(x.shape), in_sh, [local])
-    return _SUM_FN(garr).addressable_data(0)
+    try:
+        return _SUM_FN(garr).addressable_data(0)
+    except jax.errors.JaxRuntimeError:
+        # this jaxlib's CPU backend rejects multiprocess XLA computations
+        # outright ("Multiprocess computations aren't implemented on the
+        # CPU backend"); fall back to an allgather-then-sum over the
+        # jax.distributed key-value service — O(P * size) host traffic,
+        # acceptable on the CPU test rig; real deployments (tpu) never
+        # take this branch
+        return _kv_allgather_sum(x)
+
+
+_KV_GATHER_SEQ = 0
+
+
+def _kv_allgather(x) -> onp.ndarray:
+    """Allgather over the jax.distributed key-value service (host path):
+    each rank publishes its buffer, every rank fetches all of them; a
+    trailing round of 'done' keys keeps payloads alive until every rank
+    has read them.  Fallback for backends whose compiler rejects
+    multiprocess XLA computations (this jaxlib's CPU runtime); real
+    deployments (tpu) reduce over ICI/DCN collectives instead."""
+    global _KV_GATHER_SEQ
+    from jax._src import distributed
+
+    from ..base import MXNetError
+
+    client = distributed.global_state.client
+    if client is None:
+        raise MXNetError(
+            "cross-process reduce unavailable: multiprocess XLA "
+            "computations unsupported on this backend and jax.distributed "
+            "is not initialized")
+    seq, _KV_GATHER_SEQ = _KV_GATHER_SEQ, _KV_GATHER_SEQ + 1
+    rank, nproc = jax.process_index(), jax.process_count()
+    host = onp.ascontiguousarray(onp.asarray(x))
+    client.key_value_set_bytes(f"mxtpu_ag/{seq}/{rank}", host.tobytes())
+    parts = []
+    for r in range(nproc):
+        raw = client.blocking_key_value_get_bytes(
+            f"mxtpu_ag/{seq}/{r}", 120_000)
+        parts.append(onp.frombuffer(raw, host.dtype).reshape(host.shape))
+    client.key_value_set(f"mxtpu_ag_done/{seq}/{rank}", "1")
+    for r in range(nproc):
+        client.blocking_key_value_get(f"mxtpu_ag_done/{seq}/{r}", 120_000)
+    if rank == 0:
+        client.key_value_delete(f"mxtpu_ag/{seq}/")
+    return onp.stack(parts)
+
+
+def _kv_allgather_sum(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.asarray(_kv_allgather(x).sum(axis=0).astype(onp.asarray(x).dtype))
 
 
 @KVStoreBase.register
@@ -186,7 +237,12 @@ class KVStore(KVStoreBase):
             from jax.experimental import multihost_utils
 
             packed, n = self._compression.compress(key, merged)
-            gathered = multihost_utils.process_allgather(packed)
+            try:
+                gathered = multihost_utils.process_allgather(packed)
+            except jax.errors.JaxRuntimeError:
+                # CPU runtime rejects multiprocess XLA computations; ship
+                # the codes over the jax.distributed kv service instead
+                gathered = jnp.asarray(_kv_allgather(packed))
             decoded = sum(
                 self._compression.unpack(gathered[r], n)
                 for r in range(gathered.shape[0]))
@@ -268,12 +324,40 @@ class KVStore(KVStoreBase):
                 else:
                     self._apply_merged(k, self._reduce(k, v), v[0].ctx)
             return
+        if len(keys) > 1 and self._updater is not None \
+                and self._compression is None \
+                and self._push_fused_update(keys, values):
+            return
         if (len(keys) > 1 and self._is_dist()
                 and self._compression is None and self._updater is None):
             self._push_bucketed(keys, values)
             return
         for k, v in zip(keys, values):
             self._apply_merged(k, self._reduce(k, v), v[0].ctx)
+
+    def _push_fused_update(self, keys, values) -> bool:
+        """Server-side fused optimizer update: reduce every key, then apply
+        the optimizer over the WHOLE key set in one updater call — the
+        optimizer groups the keys and updates each group as one compiled
+        program (optimizer/fused.py), replacing the per-key updater loop
+        the reference server ran (kvstore_dist_server.h:346)."""
+        from ..optimizer import Updater
+        from ..optimizer import fused as _fused
+
+        if self._optimizer is None or not _fused.enabled(self._optimizer) \
+                or not isinstance(self._updater, Updater):
+            # custom set_updater callables keep the per-key calling
+            # convention — only the real Updater understands list calls
+            return False
+        merged = [self._reduce(k, v) for k, v in zip(keys, values)]
+        for k, m, v in zip(keys, merged, values):
+            if k not in self._data:
+                self._data[k] = _wrap(jnp.zeros_like(m), v[0].ctx)
+        self._updater(
+            [_key_int(k) for k in keys],
+            [_wrap(m, v[0].ctx) for m, v in zip(merged, values)],
+            [self._data[k] for k in keys])
+        return True
 
     def _push_row_sparse(self, k: str, value_list) -> None:
         """Sparse push: replica reduce = index concat + ``compact()`` (the

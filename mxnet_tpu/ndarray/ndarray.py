@@ -32,7 +32,8 @@ from .. import autograd
 from .. import engine as _engine
 from .. import profiler as _profiler
 from ..base import (MXNetError, S64_DEMOTING_PLATFORMS, bounded_cache_put,
-                    int32_overflow_dim, pow2_col_factor)
+                    enable_x64 as _enable_x64, int32_overflow_dim,
+                    pow2_col_factor)
 from ..context import Context, current_context
 from ..ops.registry import OpSchema, find_op, get_op
 
@@ -90,7 +91,7 @@ class NDArray:
                 # device_put must stay INSIDE the x64 scope — outside it
                 # the transfer canonicalizes through int32, wrapping
                 # values past 2^31 even though the dtype reads int64
-                with jax.enable_x64(True):
+                with _enable_x64(True):
                     data = jnp.asarray(data, dtype=want)
                     data = jax.device_put(data, ctx.jax_device)
             else:
@@ -454,7 +455,7 @@ class NDArray:
             # gather drops them as out-of-bounds after truncation.  On
             # TPU the _index op itself lowers static keys to literal-
             # bound slices (the compiler demotes s64 types wholesale).
-            with jax.enable_x64(True):
+            with _enable_x64(True):
                 return invoke("_index", [self], {"key": key})
         return invoke("_index", [self], {"key": key})
 
@@ -490,7 +491,7 @@ class NDArray:
             if new is not None:
                 self._set_data(new)
             elif self._on_x64_native_backend():
-                with jax.enable_x64(True):
+                with _enable_x64(True):
                     self._set_data(self._data.at[key].set(value))
             else:
                 raise MXNetError(
@@ -751,10 +752,13 @@ def _big_static_set(data, key, value):
 def _check_int_bounds(key, shape):
     """Raise IndexError for out-of-range CONCRETE integer indices — jax
     silently clips them, the reference raises (test_ndarray indexing
-    contract).  Array/traced indices keep jax's clip semantics (that IS
-    the documented device behavior for gather)."""
-    ints = (key,) if isinstance(key, int) else \
-        tuple(k for k in key if isinstance(k, int)) \
+    contract).  numpy integer SCALARS count as concrete ints too: an
+    out-of-range onp.int64 key must raise, not become a silently-masked
+    no-op write (ADVICE r5).  Array/traced indices keep jax's clip
+    semantics (that IS the documented device behavior for gather)."""
+    _int_scalar = (int, onp.integer)
+    ints = (key,) if isinstance(key, _int_scalar) else \
+        tuple(k for k in key if isinstance(k, _int_scalar)) \
         if isinstance(key, tuple) else ()
     if not ints:
         return
@@ -770,9 +774,20 @@ def _check_int_bounds(key, shape):
         d = next(dims, None)
         if d is None:
             raise IndexError(f"too many indices for shape {shape}")
-        if isinstance(k, int) and not (-d <= k < d):
+        if isinstance(k, _int_scalar) and not isinstance(k, bool) \
+                and not (-d <= int(k) < d):
             raise IndexError(
                 f"index {k} is out of bounds for axis with size {d}")
+
+
+# operator dispatches since import: with fused.dispatch_count() this gives
+# benchmark/eager_latency.py the dispatches-per-step lane a denominator
+_INVOKE_COUNT = 0
+
+
+def invoke_count() -> int:
+    """Number of eager operator dispatches since import."""
+    return _INVOKE_COUNT
 
 
 def invoke(
@@ -789,6 +804,8 @@ def invoke(
     - Wraps outputs; honours ``out=`` by writing into the destination
       (reference's kWriteTo into provided output arrays).
     """
+    global _INVOKE_COUNT
+    _INVOKE_COUNT += 1
     schema = get_op(op) if isinstance(op, str) else op
     ctx = inputs[0]._ctx if inputs else current_context()
     arrays = [i._data for i in inputs]
@@ -920,7 +937,7 @@ def _invoke_body(schema, ctx, arrays, inputs, attrs, out):
             and any(_needs_x64_index(a.shape) for a in arrays)
             and ctx.jax_device is not None
             and ctx.jax_device.platform not in S64_DEMOTING_PLATFORMS):
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             return _invoke_tail(schema, ctx, arrays, inputs, attrs, out,
                                 _make_op_fn(schema, attrs), None, record)
 
@@ -954,7 +971,11 @@ def _invoke_tail(schema, ctx, arrays, inputs, attrs, out, fn, jitted, record):
                 # (op body can't trace: host value inspection, dynamic
                 # output shape).  Input-dependent errors (dtype, shape
                 # mismatch) must not disable the cache for valid calls.
-                if isinstance(e, _TRACE_FAILURES):
+                # NotImplementedError counts as trace-time too: op bodies
+                # raise it when they cannot express the pattern under a
+                # trace (big-dim take with tracer indices) — without the
+                # ban every call repays the failed trace (ADVICE r5).
+                if isinstance(e, _TRACE_FAILURES + (NotImplementedError,)):
                     _EAGER_JIT_BAD.add(schema.name)
                 jitted = None
                 fn = _make_op_fn(schema, attrs)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..base import enable_x64 as _enable_x64
 from .registry import register
 
 
@@ -120,7 +121,7 @@ def index_array(data, axes=None):
     shape = data.shape
     axes = tuple(axes) if axes else tuple(range(len(shape)))
     grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in axes], indexing="ij")
-    with jax.enable_x64(True):   # reference index_array emits int64
+    with _enable_x64(True):   # reference index_array emits int64
         return jnp.stack(grids, axis=-1).astype(jnp.int64)
 
 

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import enable_x64 as _enable_x64
 from .registry import alias, register
 
 # ---------------------------------------------------------------------------
@@ -25,7 +26,7 @@ from .registry import alias, register
 @register("unravel_index", num_inputs=1, differentiable=False)
 def unravel_index(data, shape=None):
     """Flat indices [N] -> coordinates [ndim, N] (tensor/ravel.cc)."""
-    with jax.enable_x64(True):   # honest int64 (reference ravel.cc)
+    with _enable_x64(True):   # honest int64 (reference ravel.cc)
         coords = jnp.unravel_index(data.astype(jnp.int64), tuple(shape))
     return jnp.stack([c.astype(data.dtype) for c in coords], axis=0)
 
@@ -34,7 +35,7 @@ def unravel_index(data, shape=None):
 def ravel_multi_index(data, shape=None):
     """Coordinates [ndim, N] -> flat indices [N] (tensor/ravel.cc)."""
     shape = tuple(int(s) for s in shape)
-    with jax.enable_x64(True):   # honest int64 (reference ravel.cc)
+    with _enable_x64(True):   # honest int64 (reference ravel.cc)
         idx = 0
         for d, s in enumerate(shape):
             idx = idx * s + data[d].astype(jnp.int64)
@@ -265,7 +266,7 @@ def logical_xor(lhs, rhs):
 @register("bitwise_and", num_inputs=2, differentiable=False,
           namespaces=("nd", "np"))
 def bitwise_and(lhs, rhs):
-    with jax.enable_x64(True):   # int64 semantics without x32 truncation
+    with _enable_x64(True):   # int64 semantics without x32 truncation
         return jnp.bitwise_and(lhs.astype(jnp.int64),
                                rhs.astype(jnp.int64)).astype(lhs.dtype)
 
@@ -273,7 +274,7 @@ def bitwise_and(lhs, rhs):
 @register("bitwise_or", num_inputs=2, differentiable=False,
           namespaces=("nd", "np"))
 def bitwise_or(lhs, rhs):
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         return jnp.bitwise_or(lhs.astype(jnp.int64),
                               rhs.astype(jnp.int64)).astype(lhs.dtype)
 
@@ -281,7 +282,7 @@ def bitwise_or(lhs, rhs):
 @register("bitwise_xor", num_inputs=2, differentiable=False,
           namespaces=("nd", "np"))
 def bitwise_xor(lhs, rhs):
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         return jnp.bitwise_xor(lhs.astype(jnp.int64),
                                rhs.astype(jnp.int64)).astype(lhs.dtype)
 
@@ -289,7 +290,7 @@ def bitwise_xor(lhs, rhs):
 @register("bitwise_not", num_inputs=1, differentiable=False,
           aliases=["invert"], namespaces=("nd", "np"))
 def bitwise_not(data):
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         return jnp.bitwise_not(data.astype(jnp.int64)).astype(data.dtype)
 
 
@@ -582,7 +583,7 @@ def edge_id(adjacency, u, v):
     (u[i], v[i]) pair, -1 where absent.  CSR containers densify through
     ``.todense()`` at the frontend."""
     vals = adjacency[u.astype(jnp.int32), v.astype(jnp.int32)]
-    with jax.enable_x64(True):   # reference returns int64 edge ids
+    with _enable_x64(True):   # reference returns int64 edge ids
         return jnp.where(vals > 0, vals - 1, -1).astype(jnp.int64)
 
 

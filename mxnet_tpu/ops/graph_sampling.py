@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
+from ..base import enable_x64 as _enable_x64
 from .registry import register
 
 _RNG = onp.random.RandomState(17)
@@ -45,7 +46,7 @@ def seed_rng(seed: int) -> None:
 
 
 def _i64(x):
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         return jnp.asarray(onp.asarray(x, onp.int64), dtype=jnp.int64)
 
 

@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import enable_x64 as _enable_x64
 from .registry import register
 
 
@@ -186,7 +187,7 @@ def getnnz(data, axis=None):
     """Count stored (non-zero) values (reference _contrib_getnnz over CSR;
     dense layout here, so it counts non-zeros)."""
     nz = (data != 0)
-    with jax.enable_x64(True):   # reference returns int64 counts
+    with _enable_x64(True):   # reference returns int64 counts
         if axis is None:
             return jnp.sum(nz).astype(jnp.int64)
         return jnp.sum(nz, axis=axis).astype(jnp.int64)

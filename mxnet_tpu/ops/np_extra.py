@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as onp
 
 from .. import random as _rng
+from ..base import enable_x64 as _enable_x64
 from .registry import register
 
 
@@ -134,7 +135,7 @@ def nonzero(data):
     """Indices of non-zero elements as an (N, ndim) int64 tensor
     (reference np_nonzero_op.cc; int64 per the npx contract)."""
     idx = onp.argwhere(onp.asarray(data) != 0)
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         return jnp.asarray(idx, dtype=jnp.int64)
 
 
@@ -448,7 +449,7 @@ register("ldexp_scalar", num_inputs=1, aliases=("_npi_ldexp_scalar",))(
 
 def _bitwise_scalar(f):
     def op(data, scalar=0, reverse=False):
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             s = jnp.asarray(int(scalar), dtype=jnp.int64)
             d = data.astype(jnp.int64)
             out = f(s, d) if reverse else f(d, s)

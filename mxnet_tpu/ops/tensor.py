@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
+from .. import base as _base
 from ..base import S64_DEMOTING_PLATFORMS, bounded_cache_put, pow2_col_factor
 from ..base import int32_overflow_dim as _concrete_big
 from .registry import register
@@ -238,6 +239,13 @@ def slice_like(data, shape_like, axes=None):
 def take(a, indices, axis=0, mode="clip"):
     jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
     dim = a.shape[axis] if a.ndim else 0
+    if _concrete_big(dim) and not _base.s64_demoting_backend():
+        # x64-native backend (cpu): s64 gathers execute natively — invoke
+        # dispatches s64-typed big-dim calls under enable_x64 — so plain
+        # jnp.take is exact at any offset and works traced (autograd,
+        # hybridize).  The int32 factorization below and its refusals are
+        # TPU-runtime constraints only (ADVICE r5).
+        return jnp.take(a, indices.astype(jnp.int64), axis=axis, mode=jmode)
     if _concrete_big(dim):
         # >int32-range gather: the TPU compiler rejects s64 dynamic
         # indexing outright ("X64 rewrite ... indices exceed 32-bits"),
@@ -316,12 +324,23 @@ def gather_nd(data, indices):
 
 @register("scatter_nd", num_inputs=2, differentiable=True)
 def scatter_nd(data, indices, shape=None):
-    if any(_concrete_big(d) for d in tuple(shape)[:indices.shape[0]]):
+    shape = tuple(shape)
+    if any(_concrete_big(d) for d in shape[:indices.shape[0]]):
         raise NotImplementedError(
             "scatter_nd into a >int32-range dim: the int32 index cast "
             "would silently wrap (and scatters along >2^31 dims are "
             "corrupt on the TPU runtime); reshape so scattered dims "
             "fit int32")
+    if _base.s64_demoting_backend() and any(
+            _concrete_big(d) for d in shape[indices.shape[0]:]):
+        # non-indexed dims past int32 range are just as fatal on the TPU
+        # runtime: the scatter's row copies move data ALONG the big dim,
+        # which lands at corrupt offsets (docs/PERF.md) — refuse rather
+        # than write garbage (ADVICE r5); x64-native cpu falls through
+        raise NotImplementedError(
+            "scatter_nd with a >int32-range non-indexed dim: row copies "
+            "along >2^31 dims are corrupt on the TPU runtime; reshape so "
+            "every dim of shape fits int32")
     idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
     out = jnp.zeros(shape, dtype=data.dtype)
     return out.at[idx].add(data)
@@ -534,14 +553,14 @@ def shape_array(data):
     """int64 like the reference (tensor/elemwise_unary_op.h shape_array).
     Created under a local x64 scope: the global x32 default would silently
     truncate, and a >2**31-element array's size must not wrap."""
-    with jax.enable_x64(True):
+    with _base.enable_x64(True):
         return jnp.asarray(data.shape, dtype=jnp.int64)
 
 
 @register("size_array", differentiable=False)
 def size_array(data):
     """int64 like the reference (see shape_array)."""
-    with jax.enable_x64(True):
+    with _base.enable_x64(True):
         return jnp.asarray([int(onp.prod(data.shape))], dtype=jnp.int64)
 
 

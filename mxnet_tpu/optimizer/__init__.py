@@ -1,4 +1,5 @@
 """Optimizer API (reference ``python/mxnet/optimizer/``)."""
+from . import fused
 from .optimizer import (Optimizer, Test, Updater, create, get_updater,
                         register)
 from .sgd import SGD, NAG, SGLD, Signum, DCASGD, LARS
@@ -8,6 +9,7 @@ from .lamb import LAMB, LANS
 
 __all__ = [
     "Optimizer", "Test", "Updater", "create", "get_updater", "register",
+    "fused",
     "SGD", "NAG", "SGLD", "Signum", "DCASGD", "LARS",
     "Adam", "AdaMax", "Nadam", "FTML", "Ftrl", "AdamW",
     "AdaGrad", "AdaDelta", "RMSProp", "LAMB",

@@ -37,6 +37,24 @@ class AdaGrad(Optimizer):
 
     step = fused_step
 
+    def _fused_signature(self):
+        return super()._fused_signature() + (self.epsilon,)
+
+    def fused_update(self, weights, grads, states, lrs, wds, counts):
+        """Multi-tensor adagrad_update (optimizer/fused.py)."""
+        import jax.numpy as jnp
+
+        new_w, new_s = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            g = g * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            g = g + wd * w
+            new_hist = s + jnp.square(g)
+            new_w.append(w - lr * g / (jnp.sqrt(new_hist) + self.epsilon))
+            new_s.append(new_hist)
+        return new_w, new_s
+
 
 @register
 class AdaDelta(Optimizer):

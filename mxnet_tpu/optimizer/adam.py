@@ -52,6 +52,32 @@ class Adam(Optimizer):
 
     step = fused_step
 
+    def _fused_signature(self):
+        return super()._fused_signature() + (self.beta1, self.beta2,
+                                             self.epsilon)
+
+    def fused_update(self, weights, grads, states, lrs, wds, counts):
+        """Multi-tensor adam_update (optimizer/fused.py); the bias
+        correction folds the traced per-parameter update count."""
+        import jax.numpy as jnp
+
+        new_w, new_s = [], []
+        for w, g, s, lr, wd, t in zip(weights, grads, states, lrs, wds,
+                                      counts):
+            lr_t = lr * jnp.sqrt(1.0 - self.beta2 ** t) / (
+                1.0 - self.beta1 ** t)
+            mean, var = s
+            g = g * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            g = g + wd * w
+            new_mean = self.beta1 * mean + (1 - self.beta1) * g
+            new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(g)
+            new_w.append(w - lr_t * new_mean / (jnp.sqrt(new_var)
+                                                + self.epsilon))
+            new_s.append((new_mean, new_var))
+        return new_w, new_s
+
 
 @register
 class AdaMax(Optimizer):

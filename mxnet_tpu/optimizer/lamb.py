@@ -61,6 +61,44 @@ class LAMB(Optimizer):
 
     step = fused_step
 
+    def _fused_signature(self):
+        return super()._fused_signature() + (
+            self.beta1, self.beta2, self.epsilon, self.lower_bound,
+            self.upper_bound, self.bias_correction)
+
+    def fused_update(self, weights, grads, states, lrs, wds, counts):
+        """Multi-tensor LAMB: phase1 direction, trust-ratio norms, and
+        phase2 apply — all inside one group program (optimizer/fused.py),
+        the eager analog of contrib multi_lamb."""
+        import jax.numpy as jnp
+
+        new_w, new_s = [], []
+        for w, g, s, lr, wd, t in zip(weights, grads, states, lrs, wds,
+                                      counts):
+            mean, var = s
+            g = g * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            new_mean = self.beta1 * mean + (1 - self.beta1) * g
+            new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(g)
+            m, v = new_mean, new_var
+            if self.bias_correction:
+                m = m / (1 - self.beta1 ** t)
+                v = v / (1 - self.beta2 ** t)
+            upd = m / (jnp.sqrt(v) + self.epsilon) + wd * w
+            r1 = jnp.sqrt(jnp.sum(jnp.square(w)))
+            r2 = jnp.sqrt(jnp.sum(jnp.square(upd)))
+            r1 = jnp.where(r1 > 0, r1, jnp.ones_like(r1))
+            r2 = jnp.where(r2 > 0, r2, jnp.ones_like(r2))
+            ratio = r1 / r2
+            if self.lower_bound is not None and self.lower_bound > 0:
+                ratio = jnp.maximum(ratio, self.lower_bound)
+            if self.upper_bound is not None and self.upper_bound > 0:
+                ratio = jnp.minimum(ratio, self.upper_bound)
+            new_w.append(w - lr * ratio * upd)
+            new_s.append((new_mean, new_var))
+        return new_w, new_s
+
 
 @register
 class LANS(Optimizer):

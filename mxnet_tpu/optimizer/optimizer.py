@@ -170,6 +170,10 @@ class Optimizer:
         if not isinstance(index, (list, tuple)):
             index, weight, grad, state = [index], [weight], [grad], [state]
         self._update_count(index)
+        from . import fused as _fused
+
+        if _fused.grouped_update(self, index, weight, grad, state):
+            return
         if self.use_fused_step:
             self.fused_step(index, weight, grad, state)
         else:
@@ -178,11 +182,22 @@ class Optimizer:
     def update_multi_precision(self, index, weight, grad, state):
         if not isinstance(index, (list, tuple)):
             index, weight, grad, state = [index], [weight], [grad], [state]
+        self._update_count(index)
+        from . import fused as _fused
+
+        # multi-tensor path: ONE donated compiled program per parameter
+        # group (multi-precision detected per parameter) instead of one
+        # dispatch per parameter; falls back to the scalar loop below for
+        # optimizers without a fused_update rule
+        if _fused.grouped_update(self, index, weight, grad, state):
+            return
         use_mp = self.multi_precision and weight[0].dtype == onp.float16
         if not use_mp:
-            self.update(index, weight, grad, state)
+            if self.use_fused_step:
+                self.fused_step(index, weight, grad, state)
+            else:
+                self.step(index, weight, grad, state)
             return
-        self._update_count(index)
         # update the fp32 master weights, then cast back into the fp16 weight
         masters = [s[0] for s in state]
         inner = [s[1] for s in state]
@@ -200,6 +215,27 @@ class Optimizer:
     def fused_step(self, indices, weights, grads, states):
         # default: fall back to non-fused
         self.step(indices, weights, grads, states)
+
+    # -- fused multi-tensor rule (optimizer/fused.py) --------------------
+    # AMP flag slot: Trainer.step installs a device bool scalar here so
+    # the grouped programs skip the update on-device on overflow
+    _fused_skip_ok = None
+
+    def fused_update(self, weights, grads, states, lrs, wds, counts):
+        """Functional multi-tensor update rule: pure jnp over lists of raw
+        jax arrays (one entry per parameter; ``lrs``/``wds``/``counts``
+        are traced f32 scalars), returning ``(new_weights, new_states)``
+        with the same structure.  Runs INSIDE one jit-compiled group
+        program (optimizer/fused.py); ``self.rescale_grad`` is a traced
+        scalar during that trace.  Optimizers that do not override this
+        fall back to the scalar per-parameter loop."""
+        raise NotImplementedError
+
+    def _fused_signature(self):
+        """Static hyper-parameters baked into a fused group program — part
+        of the compiled-program cache key.  Subclasses extend with every
+        attribute their fused_update reads."""
+        return (self.clip_gradient,)
 
     def __getstate__(self):
         ret = self.__dict__.copy()

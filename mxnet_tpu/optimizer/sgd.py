@@ -50,6 +50,28 @@ class SGD(Optimizer):
 
     step = fused_step
 
+    def _fused_signature(self):
+        return super()._fused_signature() + (self.momentum,)
+
+    def fused_update(self, weights, grads, states, lrs, wds, counts):
+        """Multi-tensor sgd_update/sgd_mom_update (optimizer/fused.py)."""
+        import jax.numpy as jnp
+
+        new_w, new_s = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            g = g * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            g = g + wd * w
+            if s is None:
+                new_w.append(w - lr * g)
+                new_s.append(None)
+            else:
+                mom = self.momentum * s - lr * g
+                new_w.append(w + mom)
+                new_s.append(mom)
+        return new_w, new_s
+
 
 @register
 class NAG(Optimizer):

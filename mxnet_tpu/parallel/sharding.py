@@ -169,8 +169,16 @@ def constraint(x, spec: Union[PartitionSpec, Sequence], mesh: Optional[Mesh] = N
 
         mesh = current_mesh()
     if mesh is None:
-        ambient = jax.sharding.get_abstract_mesh()
-        if ambient is None or not ambient.shape:
+        # jax.sharding.get_abstract_mesh is newer-jax API; older jax keeps
+        # it in jax._src.mesh (and may have no ambient-mesh notion at all)
+        get_ambient = getattr(jax.sharding, "get_abstract_mesh", None)
+        if get_ambient is None:
+            try:
+                from jax._src.mesh import get_abstract_mesh as get_ambient
+            except ImportError:
+                get_ambient = None
+        ambient = get_ambient() if get_ambient is not None else None
+        if ambient is None or not getattr(ambient, "shape", None):
             return x  # no mesh anywhere: mesh-agnostic no-op
         return jax.lax.with_sharding_constraint(x, spec)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

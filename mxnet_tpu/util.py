@@ -44,7 +44,9 @@ def x64_creation_scope(dtype, ctx):
         is64 = False
     if is64 and getattr(ctx, "device_type", None) == "cpu":
         es = contextlib.ExitStack()
-        es.enter_context(jax.enable_x64(True))
+        from .base import enable_x64 as _enable_x64
+
+        es.enter_context(_enable_x64(True))
         es.enter_context(jax.default_device(ctx.jax_device))
         return es
     return contextlib.nullcontext()

@@ -23,9 +23,23 @@ BOUND = 255
 @pytest.fixture
 def small_int32_max(monkeypatch):
     monkeypatch.setattr(base, "_INT32_MAX", BOUND)
+    # the factorized paths and their refusals now gate on the backend
+    # demoting s64 (take/scatter_nd consult base.s64_demoting_backend at
+    # call time); pretend we're on such a backend so CPU CI keeps
+    # exercising the factorized machinery itself
+    monkeypatch.setattr(base, "s64_demoting_backend", lambda: True)
     yield
     # jit caches in the big-index paths key on (shape, dtype, ...): tiny
     # test shapes can't collide with real >2^31 entries, so no cleanup
+
+
+@pytest.fixture
+def small_int32_max_native(monkeypatch):
+    """Shrunken boundary WITHOUT the demoting-backend patch: on x64-native
+    cpu the big-dim take falls through to plain s64 jnp.take instead of
+    the factorized path and its refusals (ADVICE r5)."""
+    monkeypatch.setattr(base, "_INT32_MAX", BOUND)
+    yield
 
 
 def _ref(n=BIG):
@@ -146,3 +160,52 @@ def test_scatter_nd_guard(small_int32_max):
     out = nd.scatter_nd(nd.array(onp.ones(2, onp.float32)),
                         nd.array(onp.array([[0, 3]], onp.int32)), shape=(8,))
     onp.testing.assert_allclose(out.asnumpy(), [1, 0, 0, 1, 0, 0, 0, 0])
+
+
+def test_scatter_nd_non_indexed_big_dim_guard(small_int32_max):
+    # a big NON-indexed trailing dim is refused on demoting backends too:
+    # the scatter's row copies move data along the >2^31 dim (ADVICE r5)
+    with pytest.raises(NotImplementedError):
+        nd.scatter_nd(nd.array(onp.ones((1, BIG), onp.float32)),
+                      nd.array(onp.array([[0]], onp.int32)),
+                      shape=(4, BIG))
+
+
+def test_take_native_backend_falls_through(small_int32_max_native):
+    # x64-native cpu: big-dim take is plain s64 jnp.take — multi-dim and
+    # odd-length arrays work instead of raising (ADVICE r5)
+    x = nd.array(_ref())
+    idx = onp.array([0, 5, BIG - 1], onp.int64)
+    onp.testing.assert_allclose(nd.take(x, nd.array(idx)).asnumpy(),
+                                _ref()[idx])
+    y = nd.array(onp.arange(BIG * 2, dtype=onp.float32).reshape(BIG, 2))
+    got = nd.take(y, nd.array(onp.array([BIG - 1], onp.int64))).asnumpy()
+    onp.testing.assert_allclose(got[0], [2 * BIG - 2, 2 * BIG - 1])
+    odd = nd.array(onp.arange(BOUND + 2, dtype=onp.float32))  # odd "big"
+    got = nd.take(odd, nd.array(onp.array([BOUND + 1], onp.int64))).asnumpy()
+    onp.testing.assert_allclose(got, [BOUND + 1])
+
+
+def test_scatter_nd_non_indexed_big_dim_native_ok(small_int32_max_native):
+    # on the x64-native cpu the non-indexed big dim is fine
+    out = nd.scatter_nd(nd.array(onp.ones((1, BIG), onp.float32)),
+                        nd.array(onp.array([[2]], onp.int32)),
+                        shape=(4, BIG))
+    assert out.shape == (4, BIG)
+    assert float(out.asnumpy()[2].sum()) == BIG
+
+
+def test_numpy_scalar_index_bounds(small_int32_max_native):
+    # onp.integer scalar keys hit the same IndexError contract as python
+    # ints — out-of-range numpy-scalar writes must not become silent
+    # masked no-ops (ADVICE r5)
+    x = nd.array(onp.arange(8, dtype=onp.float32))
+    with pytest.raises(IndexError):
+        x[onp.int64(8)]
+    with pytest.raises(IndexError):
+        x[onp.int64(8)] = 1.0
+    with pytest.raises(IndexError):
+        x[onp.int32(-9)]
+    assert float(x[onp.int64(3)].asscalar()) == 3.0
+    x[onp.int64(3)] = 30.0
+    assert float(x[3].asscalar()) == 30.0

@@ -1,0 +1,266 @@
+"""Fused multi-tensor optimizer step (optimizer/fused.py, PR 1 tentpole).
+
+Covers the acceptance contract: (1) fused vs scalar-loop updates are
+numerically identical for SGD/Adam/AdaGrad/LAMB incl. multi-precision and
+wd_mult/lr_mult, (2) re-trace count stays at 1 across repeated step()
+calls, (3) AMP overflow skips the update identically on both paths, plus
+the dispatch-count bar (one compiled program per parameter group) and the
+server-side (update_on_kvstore) fused path.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.optimizer import fused
+
+
+SHAPES = [(4, 3), (7,), (2, 3, 2), (5, 5)]
+
+
+def _make_params(dtype="float32", seed=0, lr_mults=None, wd_mults=None):
+    rng = onp.random.RandomState(seed)
+    params = {}
+    for i, shape in enumerate(SHAPES):
+        p = gluon.Parameter(f"w{i}", shape=shape, dtype=dtype)
+        p.initialize(init=mx.init.Zero())
+        p.data()._set_data(
+            mx.nd.array(rng.randn(*shape), dtype=dtype)._data)
+        if lr_mults:
+            p.lr_mult = lr_mults[i % len(lr_mults)]
+        if wd_mults:
+            p.wd_mult = wd_mults[i % len(wd_mults)]
+        params[f"w{i}"] = p
+    return params
+
+
+def _run(optimizer, opt_params, fused_on, monkeypatch, steps=4,
+         dtype="float32", grad_scale=0.1, seed=0, batch_size=2,
+         update_on_kvstore=None):
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "1" if fused_on else "0")
+    params = _make_params(dtype=dtype, seed=seed,
+                          lr_mults=[1.0, 0.5], wd_mults=[1.0, 0.0])
+    trainer = gluon.Trainer(params, optimizer, dict(opt_params),
+                            update_on_kvstore=update_on_kvstore)
+    rng = onp.random.RandomState(seed + 1)
+    for _ in range(steps):
+        for p in params.values():
+            g = p.list_grad()[0]
+            g._set_data(mx.nd.array(
+                rng.randn(*g.shape) * grad_scale, dtype=dtype)._data)
+        trainer.step(batch_size)
+    return params, trainer
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9,
+             "clip_gradient": 0.05}),
+    ("adam", {"learning_rate": 0.05, "wd": 0.01}),
+    ("adagrad", {"learning_rate": 0.2, "wd": 0.01}),
+    ("lamb", {"learning_rate": 0.05, "wd": 0.01}),
+    ("lamb", {"learning_rate": 0.05, "lower_bound": 0.1,
+              "upper_bound": 5.0}),
+])
+def test_fused_matches_scalar_loop(optimizer, opt_params, monkeypatch):
+    pf, _ = _run(optimizer, opt_params, True, monkeypatch)
+    pl, _ = _run(optimizer, opt_params, False, monkeypatch)
+    for k in pf:
+        onp.testing.assert_allclose(
+            pf[k].data().asnumpy(), pl[k].data().asnumpy(),
+            rtol=2e-5, atol=1e-6, err_msg=f"{optimizer} {k}")
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9,
+             "multi_precision": True}),
+    ("adam", {"learning_rate": 0.05, "multi_precision": True}),
+])
+def test_fused_matches_scalar_loop_multi_precision(optimizer, opt_params,
+                                                   monkeypatch):
+    pf, tf = _run(optimizer, opt_params, True, monkeypatch,
+                  dtype="float16")
+    pl, tl = _run(optimizer, opt_params, False, monkeypatch,
+                  dtype="float16")
+    for k in pf:
+        assert pf[k].data().dtype == onp.float16
+        onp.testing.assert_allclose(
+            pf[k].data().asnumpy().astype("f"),
+            pl[k].data().asnumpy().astype("f"),
+            rtol=2e-3, atol=1e-4, err_msg=k)
+    # fp32 master weights must agree tightly (both paths compute in f32)
+    sf, sl = tf._updaters[0].states, tl._updaters[0].states
+    for idx in sf:
+        onp.testing.assert_allclose(sf[idx][0].asnumpy(),
+                                    sl[idx][0].asnumpy(),
+                                    rtol=2e-5, atol=1e-6)
+
+
+def test_retrace_count_stays_one(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "1")
+    params = _make_params()
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.05})
+    rng = onp.random.RandomState(3)
+
+    def one_step():
+        for p in params.values():
+            g = p.list_grad()[0]
+            g._set_data(mx.nd.array(rng.randn(*g.shape) * 0.1)._data)
+        trainer.step(2)
+
+    one_step()                                   # warm: ONE trace
+    warm = fused.trace_count()
+    for _ in range(5):
+        one_step()
+    assert fused.trace_count() == warm, (
+        "group program re-traced across repeated step() calls")
+    # changing the lr (scheduler-style) must not re-trace either: lr rides
+    # in as a traced argument
+    trainer.set_learning_rate(0.01)
+    one_step()
+    assert fused.trace_count() == warm
+
+
+def test_dispatches_per_step_is_one_per_group(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "1")
+    params = _make_params()
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    rng = onp.random.RandomState(4)
+
+    def one_step():
+        for p in params.values():
+            g = p.list_grad()[0]
+            g._set_data(mx.nd.array(rng.randn(*g.shape) * 0.1)._data)
+        trainer.step(2)
+
+    one_step()
+    before = fused.dispatch_count()
+    for _ in range(3):
+        one_step()
+    # one dtype, one optimizer: a single group -> 1 compiled launch/step
+    assert fused.dispatch_count() - before == 3
+
+
+def test_mixed_dtype_groups(monkeypatch):
+    """f32 and f16(multi-precision) parameters in one trainer split into
+    two groups, each updated by its own compiled program."""
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "1")
+    rng = onp.random.RandomState(5)
+    params = {}
+    for i, dtype in enumerate(["float32", "float16"]):
+        p = gluon.Parameter(f"w{i}", shape=(3, 3), dtype=dtype)
+        p.initialize(init=mx.init.Zero())
+        p.data()._set_data(mx.nd.array(rng.randn(3, 3), dtype=dtype)._data)
+        params[f"w{i}"] = p
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    trainer = gluon.Trainer(params, opt)
+    for p in params.values():
+        g = p.list_grad()[0]
+        g._set_data(mx.nd.array(onp.full((3, 3), 0.1),
+                                dtype=str(p.dtype))._data)
+    before = fused.dispatch_count()
+    trainer.step(1)
+    assert fused.dispatch_count() - before == 2
+    # f16 master state exists and f32 state is a plain momentum buffer
+    states = trainer._updaters[0].states
+    mp_states = [s for s in states.values()
+                 if isinstance(s, tuple) and len(s) == 2]
+    assert len(mp_states) == 1
+    assert mp_states[0][0].dtype == onp.float32
+
+
+def _amp_overflow_run(fused_on, monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "1" if fused_on else "0")
+    from mxnet_tpu import amp
+
+    params = _make_params(seed=7)
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    scaler = amp.LossScaler(init_scale=8.0)
+    trainer._amp_loss_scaler = scaler
+    rng = onp.random.RandomState(8)
+    # clean step: applies
+    for p in params.values():
+        g = p.list_grad()[0]
+        g._set_data(mx.nd.array(rng.randn(*g.shape) * 0.1)._data)
+    trainer.step(1)
+    w_after_clean = {k: p.data().asnumpy().copy()
+                     for k, p in params.items()}
+    # poisoned step: one grad goes inf -> whole update skipped, scale
+    # halves
+    for p in params.values():
+        g = p.list_grad()[0]
+        g._set_data(mx.nd.array(rng.randn(*g.shape) * 0.1)._data)
+    bad = params["w1"].list_grad()[0]
+    bad._set_data(mx.nd.full(bad.shape, onp.inf)._data)
+    scale_before = scaler.loss_scale
+    trainer.step(1)
+    return params, w_after_clean, scaler, scale_before
+
+
+@pytest.mark.parametrize("fused_on", [True, False])
+def test_amp_overflow_skips_update(fused_on, monkeypatch):
+    params, w_clean, scaler, scale_before = _amp_overflow_run(
+        fused_on, monkeypatch)
+    for k, p in params.items():
+        onp.testing.assert_allclose(p.data().asnumpy(), w_clean[k],
+                                    err_msg=f"overflow step mutated {k}")
+    assert scaler.loss_scale == scale_before / 2
+
+
+def test_amp_overflow_identical_across_paths(monkeypatch):
+    pf, cf, _, _ = _amp_overflow_run(True, monkeypatch)
+    pl, cl, _, _ = _amp_overflow_run(False, monkeypatch)
+    for k in pf:
+        onp.testing.assert_allclose(pf[k].data().asnumpy(),
+                                    pl[k].data().asnumpy(),
+                                    rtol=2e-6, atol=1e-7)
+
+
+def test_update_on_kvstore_fused_matches_local(monkeypatch):
+    """Server-side fused update (batched pushpull -> one updater call ->
+    grouped programs in the kvstore) gives the same weights as the local
+    update path."""
+    pk, _ = _run("sgd", {"learning_rate": 0.1, "momentum": 0.9}, True,
+                 monkeypatch, update_on_kvstore=True)
+    pl, _ = _run("sgd", {"learning_rate": 0.1, "momentum": 0.9}, False,
+                 monkeypatch, update_on_kvstore=False)
+    for k in pk:
+        onp.testing.assert_allclose(pk[k].data().asnumpy(),
+                                    pl[k].data().asnumpy(),
+                                    rtol=2e-6, atol=1e-7, err_msg=k)
+
+
+def test_unfused_optimizer_falls_back(monkeypatch):
+    """An optimizer without a fused_update rule trains through the scalar
+    loop unchanged (and fused.supports reports it)."""
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "1")
+    assert not fused.supports(mx.optimizer.RMSProp())
+    assert fused.supports(mx.optimizer.SGD())
+    assert fused.supports(mx.optimizer.Adam())
+    assert fused.supports(mx.optimizer.AdaGrad())
+    assert fused.supports(mx.optimizer.LAMB())
+    params = _make_params(seed=9)
+    trainer = gluon.Trainer(params, "rmsprop", {"learning_rate": 0.01})
+    before = fused.dispatch_count()
+    for p in params.values():
+        g = p.list_grad()[0]
+        g._set_data(mx.nd.full(g.shape, 0.1)._data)
+    trainer.step(1)
+    assert fused.dispatch_count() == before      # scalar loop, no groups
+    for p in params.values():
+        assert onp.isfinite(p.data().asnumpy()).all()
+
+
+def test_knob_off_forces_scalar_loop(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    params = _make_params(seed=11)
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    before = fused.dispatch_count()
+    for p in params.values():
+        g = p.list_grad()[0]
+        g._set_data(mx.nd.full(g.shape, 0.1)._data)
+    trainer.step(1)
+    assert fused.dispatch_count() == before

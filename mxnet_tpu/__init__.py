@@ -21,6 +21,8 @@ __version__ = "0.1.0"
 from . import base
 from .base import MXNetError
 from . import config  # noqa: E402  (no jax dependency; safe first)
+from . import faults  # noqa: E402  (no jax dependency; installs any
+# MXNET_FAULT_PLAN before the runtime it instruments imports)
 
 if config.get("MXNET_PROFILER_AUTOSTART"):
     # must import eagerly (profiler is otherwise lazy via _LAZY) so

@@ -192,6 +192,43 @@ declare("MXNET_BN_TWO_PASS_VAR", bool, False,
         "of the single-pass E[x^2]-E[x]^2 TPU default (one extra HBM pass; "
         "use when activation |mean| >> std makes the single-pass cancel)",
         subsystem="operator")
+declare("MXNET_FAULT_PLAN", str, None,
+        "Deterministic fault-injection plan for subprocess tests: "
+        "'site[@after]:times[:kind]' comma-list (kind: transient|fatal|"
+        "oserror|timeout) installed at import (faults.FaultPlan.from_env). "
+        "Unset = injection disabled (faults.inject is a no-op None check).",
+        subsystem="faults", cached=False)
+declare("MXNET_BARRIER_TIMEOUT", float, 0.0,
+        "KVStore.barrier() deadline in seconds; on breach the barrier "
+        "raises faults.DeadlineExceeded naming suspected-dead ranks from "
+        "the attached HeartbeatMonitor.  0 = wait forever (reference "
+        "behavior).", validator=lambda v: v >= 0, subsystem="faults",
+        cached=False)
+declare("MXNET_RETRY_MAX", int, 3,
+        "faults.retry_call default: max re-attempts after the first try "
+        "(total attempts = value + 1) for retryable failures",
+        validator=lambda v: v >= 0, subsystem="faults", cached=False)
+declare("MXNET_RETRY_BACKOFF", float, 0.05,
+        "faults.retry_call default: base delay (s) of the deterministic "
+        "exponential backoff min(backoff * 2**(attempt-1), max)",
+        validator=lambda v: v >= 0, subsystem="faults", cached=False)
+declare("MXNET_RETRY_BACKOFF_MAX", float, 2.0,
+        "faults.retry_call default: backoff delay cap in seconds",
+        validator=lambda v: v >= 0, subsystem="faults", cached=False)
+declare("MXNET_DATALOADER_RETRIES", int, 2,
+        "DataLoader: per-batch recovery budget — a crashed worker pool is "
+        "respawned and the batch re-fetched up to this many times before "
+        "DataLoaderWorkerError raises with the batch index and worker id",
+        validator=lambda v: v >= 0, subsystem="faults", cached=False)
+declare("MXNET_DOWNLOAD_RETRIES", int, 3,
+        "model_store.download: re-attempts after the first try; every "
+        "attempt removes partial files on failure and re-verifies sha1",
+        validator=lambda v: v >= 0, subsystem="faults", cached=False)
+declare("MXNET_ELASTIC_BACKOFF", float, 0.0,
+        "run_elastic: base delay (s) of the exponential backoff between "
+        "restore-and-resume restarts (capped at MXNET_RETRY_BACKOFF_MAX); "
+        "0 = restart immediately", validator=lambda v: v >= 0,
+        subsystem="faults", cached=False)
 declare("MXNET_MODULE_SEED", int, None,
         "Override the per-test RNG seed for reproduction (reference test "
         "harness contract)", subsystem="testing")

@@ -14,17 +14,42 @@ the shared-memory NDArray rebuild dance is unnecessary because host batches
 are plain numpy until the final HBM staging."""
 from __future__ import annotations
 
-import threading
+import multiprocessing as _mp
+import os
+import time
+import traceback
 from collections import deque
+from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Optional
 
 import numpy as onp
 
+from ... import config as _config
+from ... import faults as _faults
 from ...ndarray import NDArray, array
 from .batchify import default_batchify_fn
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
-__all__ = ["DataLoader"]
+__all__ = ["DataLoader", "DataLoaderWorkerError"]
+
+
+class DataLoaderWorkerError(RuntimeError):
+    """A batch could not be fetched within the retry budget.  Carries the
+    failing batch index, the worker that failed, and the ORIGINAL error
+    (message + remote traceback) — never a bare TimeoutError/Empty."""
+
+    def __init__(self, batch_idx: int, worker, cause: str, attempts: int):
+        self.batch_idx = batch_idx
+        self.worker = worker
+        self.attempts = attempts
+        super().__init__(
+            f"DataLoader batch {batch_idx} failed after {attempts} "
+            f"attempt(s) (worker {worker}): {cause}")
+
+
+class _WorkerDied(RuntimeError):
+    """Internal: a pool process exited (crash/OOM-kill) or the batch
+    deadline passed — the in-flight task will never complete."""
 
 
 _worker_dataset = None
@@ -43,19 +68,34 @@ def _to_host(b):
 
 
 def _worker_fn(samples, batchify_fn):
-    """Runs in a worker process: fetch + batchify, return host arrays."""
+    """Runs in a worker process: fetch + batchify, return host arrays.
+
+    Exceptions come back as an ``("error", ...)`` VALUE, not a raised
+    remote exception: the parent then surfaces the original error with
+    worker id + traceback immediately, instead of the reference's
+    behavior of burning the full 120 s timeout first.  (A hard crash —
+    segfault, OOM kill — can't return anything; the parent detects the
+    pid vanishing from the pool instead.)"""
     from .batchify import host_mode
 
-    with host_mode():
-        batch = batchify_fn([_worker_dataset[i] for i in samples])
-    return _to_host(batch)
+    try:
+        _faults.inject("dataloader.worker")
+        with host_mode():
+            batch = batchify_fn([_worker_dataset[i] for i in samples])
+        return ("ok", _to_host(batch))
+    except BaseException as e:
+        # classify retryability HERE (the exception instance itself may
+        # not survive pickling back to the parent)
+        return ("error", os.getpid(), _faults.is_retryable(e), repr(e),
+                traceback.format_exc())
 
 
 def _thread_worker_fn(dataset, samples, batchify_fn):
     """Thread-pool variant: dataset passed explicitly so concurrent loaders
-    never share state."""
+    never share state; exceptions propagate natively through the future."""
     from .batchify import host_mode
 
+    _faults.inject("dataloader.worker")
     with host_mode():
         batch = batchify_fn([dataset[i] for i in samples])
     return _to_host(batch)
@@ -104,18 +144,30 @@ class DataLoader:
                              else 2 * self._num_workers)
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._pool = None
+        self._worker_pids: frozenset = frozenset()
         if self._num_workers > 0:
-            if thread_pool:
-                from concurrent.futures import ThreadPoolExecutor
+            self._make_pool()
 
-                self._pool = ThreadPoolExecutor(self._num_workers)
-            else:
-                import multiprocessing
+    def _make_pool(self):
+        if self._thread_pool:
+            from concurrent.futures import ThreadPoolExecutor
 
-                ctx = multiprocessing.get_context("fork")
-                self._pool = ctx.Pool(self._num_workers,
-                                      initializer=_worker_init,
-                                      initargs=(dataset,))
+            self._pool = ThreadPoolExecutor(self._num_workers)
+        else:
+            import multiprocessing
+
+            ctx = multiprocessing.get_context("fork")
+            self._pool = ctx.Pool(self._num_workers,
+                                  initializer=_worker_init,
+                                  initargs=(self._dataset,))
+            self._worker_pids = frozenset(p.pid for p in self._pool._pool)
+
+    def _respawn_pool(self):
+        """Tear down a pool with dead/wedged workers and fork a fresh one
+        (the in-flight tasks of a crashed fork pool are unrecoverable —
+        their results will simply never arrive)."""
+        self._shutdown()
+        self._make_pool()
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -127,50 +179,113 @@ class DataLoader:
                     [self._dataset[i] for i in samples])))
             return
 
-        if self._thread_pool:
-            futures = deque()
-            it = iter(self._batch_sampler)
-            try:
-                for _ in range(self._prefetch or 1):
-                    samples = next(it, None)
-                    if samples is None:
-                        break
-                    futures.append(self._pool.submit(
-                        _thread_worker_fn, self._dataset, samples,
-                        self._batchify_fn))
-                while futures:
-                    batch = futures.popleft().result(timeout=self._timeout)
-                    samples = next(it, None)
-                    if samples is not None:
-                        futures.append(self._pool.submit(
-                            _thread_worker_fn, self._dataset, samples,
-                            self._batchify_fn))
-                    yield self._wrap(self._transform_batch(batch))
-            finally:
-                for f in futures:
-                    f.cancel()
-            return
-
-        # process pool: async pipeline depth self._prefetch
-        results = deque()
+        # worker pools, pipeline depth self._prefetch.  Each pending entry
+        # is [handle, samples, batch_idx, failed_attempts] so a failed
+        # batch can be resubmitted (same samples -> bit-identical batch)
+        # after a worker failure or a pool respawn.
+        retries = _config.get("MXNET_DATALOADER_RETRIES")
+        pending: deque = deque()
         it = iter(self._batch_sampler)
+        next_idx = 0
+
+        def _submit(samples):
+            if self._thread_pool:
+                return self._pool.submit(_thread_worker_fn, self._dataset,
+                                         samples, self._batchify_fn)
+            return self._pool.apply_async(
+                _worker_fn, (samples, self._batchify_fn))
+
         try:
             for _ in range(self._prefetch or 1):
                 samples = next(it, None)
                 if samples is None:
                     break
-                results.append(self._pool.apply_async(
-                    _worker_fn, (samples, self._batchify_fn)))
-            while results:
-                batch = results.popleft().get(self._timeout)
+                pending.append([_submit(samples), samples, next_idx, 0])
+                next_idx += 1
+            while pending:
+                batch = self._fetch(pending[0], pending, _submit, retries)
+                pending.popleft()
                 samples = next(it, None)
                 if samples is not None:
-                    results.append(self._pool.apply_async(
-                        _worker_fn, (samples, self._batchify_fn)))
+                    pending.append([_submit(samples), samples, next_idx, 0])
+                    next_idx += 1
                 yield self._wrap(self._transform_batch(batch))
         except KeyboardInterrupt:
             self._shutdown()
             raise
+        finally:
+            if self._thread_pool:
+                for entry in pending:
+                    entry[0].cancel()
+
+    def _fetch(self, entry, pending, submit, retries):
+        """Resolve one pending batch under the recovery contract: a
+        worker failure (exception, crash, or wedged-past-timeout) is
+        retried up to ``retries`` times — respawning the process pool
+        when a worker died — then raises :class:`DataLoaderWorkerError`
+        carrying the batch index, worker id, and original error."""
+        while True:
+            handle, samples, bidx, attempts = entry
+            pool_died = False
+            worker = "thread" if self._thread_pool else "unknown"
+            orig: Optional[BaseException] = None
+            retryable = True
+            try:
+                if self._thread_pool:
+                    out = ("ok", handle.result(timeout=self._timeout))
+                else:
+                    out = self._poll(handle)
+            except _WorkerDied as e:
+                pool_died, cause = True, str(e)
+            except BaseException as e:
+                # thread pool: the worker's ORIGINAL exception, promptly
+                orig, cause = e, repr(e)
+                retryable = _faults.is_retryable(e) or \
+                    isinstance(e, _FutTimeout)
+            else:
+                if out[0] == "ok":
+                    return out[1]
+                _tag, worker, retryable, erepr, tb = out
+                cause = f"{erepr}\n--- worker traceback ---\n{tb}"
+            entry[3] = attempts = attempts + 1
+            _faults.record_event("dataloader.worker", "failure",
+                                 batch=bidx, worker=worker, attempt=attempts,
+                                 retryable=retryable, cause=cause[:200])
+            if not retryable or attempts > retries:
+                err = DataLoaderWorkerError(bidx, worker, cause, attempts)
+                if orig is not None:
+                    raise err from orig
+                raise err
+            if pool_died:
+                # every in-flight task of the crashed pool is lost:
+                # respawn once, resubmit ALL pending batches in order
+                self._respawn_pool()
+                for ent in pending:
+                    ent[0] = submit(ent[1])
+            else:
+                entry[0] = submit(samples)
+
+    def _poll(self, res):
+        """Wait for a process-pool result in short slices, watching the
+        worker pids: a vanished/exited worker means the in-flight task
+        can never complete, so surface it NOW instead of blocking the
+        full ``timeout`` (the reference's bare Empty after 120 s)."""
+        deadline = time.monotonic() + self._timeout
+        while True:
+            try:
+                return res.get(timeout=0.2)
+            except _mp.TimeoutError:
+                procs = list(self._pool._pool)
+                if any(p.exitcode is not None for p in procs) or \
+                        frozenset(p.pid for p in procs) != self._worker_pids:
+                    raise _WorkerDied(
+                        "a DataLoader worker process died (pool pids were "
+                        f"{sorted(self._worker_pids)})") from None
+                if time.monotonic() > deadline:
+                    raise _WorkerDied(
+                        f"batch not produced within timeout="
+                        f"{self._timeout}s (workers alive but wedged)") \
+                        from None
 
     def _transform_batch(self, batch):
         if self._batch_transform is None:
@@ -192,6 +307,7 @@ class DataLoader:
             else:
                 self._pool.terminate()
             self._pool = None
+            self._worker_pids = frozenset()
 
     def __del__(self):
         self._shutdown()

@@ -23,7 +23,11 @@ import os
 import shutil
 from typing import Dict, Optional
 
-__all__ = ["get_model_file", "publish_model_file", "purge", "data_dir"]
+from ... import config as _config
+from ... import faults as _faults
+
+__all__ = ["get_model_file", "publish_model_file", "purge", "data_dir",
+           "download"]
 
 # name -> sha1 of the published checkpoint (reference _model_sha1 table;
 # hashes match apache/incubator-mxnet model_store.py so files fetched for
@@ -96,6 +100,53 @@ def _check_sha1(filename: str, sha1_hash: str) -> bool:
     return sha1.hexdigest() == sha1_hash
 
 
+class _BadPayload(OSError):
+    """Download SUCCEEDED but the payload is wrong (truncated mirror,
+    captive portal, tampering).  OSError => retryable under the shared
+    policy: the next attempt re-fetches and re-verifies from scratch."""
+
+
+def _fetch_url(url: str, dst: str, timeout: float = 10.0) -> None:
+    """One fetch attempt: stream to ``dst + '.part'`` then atomically
+    rename — a failure at ANY point removes the partial file, so the
+    cache never holds a truncated download."""
+    import urllib.request
+
+    tmp = f"{dst}.part"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r, \
+                open(tmp, "wb") as f:
+            shutil.copyfileobj(r, f)
+        os.replace(tmp, dst)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def download(url: str, path: str, sha1_hash: Optional[str] = None,
+             retries: Optional[int] = None) -> str:
+    """Fetch ``url`` to ``path`` under the shared retry policy (site
+    ``download``, default budget ``MXNET_DOWNLOAD_RETRIES``): partial
+    files are removed on every failure, and when ``sha1_hash`` is given
+    the file is re-verified AFTER EACH attempt — a checksum mismatch
+    deletes the file and counts as a retryable failure (stale mirror /
+    transient corruption), never returns poisoned bytes."""
+    if retries is None:
+        retries = _config.get("MXNET_DOWNLOAD_RETRIES")
+
+    def _attempt() -> str:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        _fetch_url(url, path)
+        if sha1_hash and not _check_sha1(path, sha1_hash):
+            os.remove(path)
+            raise _BadPayload(
+                f"downloaded file {path} failed sha1 verification "
+                f"against {sha1_hash}")
+        return path
+
+    return _faults.retry_call(_attempt, site="download", retries=retries)
+
+
 def _shipped_dir() -> str:
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "pretrained")
@@ -155,43 +206,46 @@ def get_model_file(name: str, root: Optional[str] = None) -> str:
         raise IOError(
             f"shipped checkpoint for '{name}' is missing from the repo "
             f"checkout (expected {shipped['file']} under {_shipped_dir()})")
-    # attempt the reference's download path; most TPU build environments
-    # have no egress, so fail fast with actionable instructions
+    # attempt the reference's download path under the shared retry policy
+    # (site ``download``, budget MXNET_DOWNLOAD_RETRIES); most TPU build
+    # environments have no egress, so once the budget is spent this fails
+    # fast with actionable instructions
     url = _URL_FMT.format(file_name=file_name)
 
-    class _BadPayload(Exception):
-        """Download SUCCEEDED but the payload is wrong — must not be
-        reported as a network failure by the egress wrapper below."""
-
-    try:
-        import socket
-        import urllib.request
+    def _attempt() -> str:
         import zipfile
 
         os.makedirs(root, exist_ok=True)
         zip_path = file_path + ".zip"
         try:
-            with urllib.request.urlopen(url, timeout=10) as r, \
-                    open(zip_path, "wb") as f:
-                shutil.copyfileobj(r, f)
+            _fetch_url(url, zip_path)
             with zipfile.ZipFile(zip_path) as zf:
                 zf.extractall(root)
-            os.remove(zip_path)
         except zipfile.BadZipFile as e:
-            # captive portal / proxy error page served with HTTP 200: don't
-            # leave the poisoned .zip in the cache
-            if os.path.exists(zip_path):
-                os.remove(zip_path)
+            # captive portal / proxy error page served with HTTP 200
             raise _BadPayload(f"server returned a non-zip payload: {e}") \
                 from e
-        if os.path.exists(file_path):
-            # verify the fresh download too — a valid zip can still carry
-            # wrong bytes (stale mirror / tampering); don't load it silently
-            if _check_sha1(file_path, sha1):
-                return file_path
+        finally:
+            # never leave the (possibly poisoned) archive in the cache
+            if os.path.exists(zip_path):
+                os.remove(zip_path)
+        if not os.path.exists(file_path):
+            raise _BadPayload(
+                f"archive held no {os.path.basename(file_path)}")
+        # re-verify EVERY attempt — a valid zip can still carry wrong
+        # bytes (stale mirror / tampering); don't load it silently
+        if not _check_sha1(file_path, sha1):
             os.remove(file_path)
             raise _BadPayload("downloaded checkpoint failed sha1 "
                               "verification")
+        return file_path
+
+    import socket
+
+    try:
+        return _faults.retry_call(
+            _attempt, site="download",
+            retries=_config.get("MXNET_DOWNLOAD_RETRIES"))
     except _BadPayload as e:
         raise IOError(
             f"Download of pretrained weights for '{name}' from {url} "
@@ -207,7 +261,6 @@ def get_model_file(name: str, root: Optional[str] = None) -> str:
             f"save_parameters dict, or publish one with "
             f"model_store.publish_model_file), or fetch {url} on a "
             f"machine with network access.") from e
-    raise IOError(f"download of {url} produced no {file_path}")
 
 
 def publish_model_file(params_path: str, name: str,

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Union
 
+from .. import faults as _faults
 from .. import kvstore as kvs
 from .. import optimizer as opt
 from ..ndarray import NDArray
@@ -167,6 +168,10 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """Normalize by batch_size, all-reduce grads, apply updates
         (reference trainer.py:334)."""
+        # train-step injection site (fail-fast: a step is not idempotent;
+        # recovery is run_elastic's restore-and-replay, not a retry here).
+        # Zero overhead when no FaultPlan is installed.
+        _faults.inject("trainer.step")
         if not self._kv_initialized:
             self._init_kvstore()
         if self._params_to_init:

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
+from .. import config as _config
+from .. import faults as _faults
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _wrap
 from ..ndarray.sparse import RowSparseNDArray
@@ -90,12 +92,22 @@ _KV_GATHER_SEQ = 0
 
 
 def _kv_allgather(x) -> onp.ndarray:
-    """Allgather over the jax.distributed key-value service (host path):
-    each rank publishes its buffer, every rank fetches all of them; a
-    trailing round of 'done' keys keeps payloads alive until every rank
-    has read them.  Fallback for backends whose compiler rejects
-    multiprocess XLA computations (this jaxlib's CPU runtime); real
-    deployments (tpu) reduce over ICI/DCN collectives instead."""
+    """Allgather over the jax.distributed key-value service, under the
+    shared retry policy (site ``kvstore.collective``): a transient kv-
+    service failure re-runs the WHOLE gather with a fresh sequence number
+    (the per-seq key namespace makes a replay collision-free), with
+    exponential backoff between attempts."""
+    return _faults.retry_call(_kv_allgather_once, x,
+                              site="kvstore.collective")
+
+
+def _kv_allgather_once(x) -> onp.ndarray:
+    """One allgather attempt (host path): each rank publishes its buffer,
+    every rank fetches all of them; a trailing round of 'done' keys keeps
+    payloads alive until every rank has read them.  Fallback for backends
+    whose compiler rejects multiprocess XLA computations (this jaxlib's
+    CPU runtime); real deployments (tpu) reduce over ICI/DCN collectives
+    instead."""
     global _KV_GATHER_SEQ
     from jax._src import distributed
 
@@ -141,6 +153,8 @@ class KVStore(KVStoreBase):
         self._optimizer = None
         self._barrier_count = 0
         self._compression = None
+        self._heartbeat = None   # attach_heartbeat(): names dead ranks on
+        # barrier deadline (parallel/elastic.py HeartbeatMonitor)
         # dist_async: pushes are applied by a dedicated worker thread (the
         # reference's server-side request queue, kvstore_dist_server.h exec_
         # serial executor) so the caller overlaps compute with comm; every
@@ -305,6 +319,10 @@ class KVStore(KVStoreBase):
         same-dtype keys fuse into ONE flattened cross-process collective
         (the P3 bucketing/priority analog, p3store_dist.h:40 — higher
         ``priority`` keys are simply pushed first by callers)."""
+        # fail-fast injection point: a push may apply a server-side
+        # optimizer update, so replaying a half-applied push is NOT
+        # idempotent — faults here propagate (docs/ROBUSTNESS.md taxonomy)
+        _faults.inject("kvstore.push")
         keys, values = self._normalize(key, value)
         if self._async_q is not None:
             for k, v in zip(keys, values):
@@ -439,8 +457,6 @@ class KVStore(KVStoreBase):
         """Fuse many keys into flat cross-process sums.  Arrays above
         MXNET_KVSTORE_BIGARRAY_BOUND get their own collective (reference
         kvstore_dist big-array splitting; see mxnet_tpu.config)."""
-        from .. import config as _config
-
         bound = _config.get("MXNET_KVSTORE_BIGARRAY_BOUND")
         locals_ = [self._local_sum(v) for v in values]
         buckets: Dict[str, List[int]] = {}
@@ -471,7 +487,14 @@ class KVStore(KVStoreBase):
         self._compression = GradientCompression(type=ctype, **params)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Pull current values into ``out``.  Pulls are pure reads of the
+        store (outs are fully rewritten on success), so a transient
+        failure retries the whole pull under the shared policy (site
+        ``kvstore.pull``)."""
         self._drain_async()
+        _faults.retry_call(self._pull_impl, key, out, site="kvstore.pull")
+
+    def _pull_impl(self, key, out):
         keys, _ = self._normalize(key, out)
         outs = out if isinstance(out, (list, tuple)) else [out]
         if isinstance(key, (list, tuple)):
@@ -516,14 +539,67 @@ class KVStore(KVStoreBase):
             self._updater.set_states(f.read())
 
     # -- misc ------------------------------------------------------------
-    def barrier(self):
-        self._drain_async()
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+    def attach_heartbeat(self, monitor) -> None:
+        """Attach a ``parallel.elastic.HeartbeatMonitor`` so a barrier
+        deadline breach can NAME the suspected-dead ranks instead of
+        hanging anonymously (the reference's ps-lite node heartbeats,
+        never surfaced to users — SURVEY §5)."""
+        self._heartbeat = monitor
 
-            multihost_utils.sync_global_devices(
-                f"mxnet_tpu_kvstore_barrier_{self._barrier_count}")
+    def barrier(self, timeout: Optional[float] = None):
+        """Global barrier with an optional deadline.  ``timeout`` (or
+        ``MXNET_BARRIER_TIMEOUT``; 0 = wait forever) bounds the wait; on
+        breach raises :class:`faults.DeadlineExceeded` listing the ranks
+        whose heartbeat went stale (when a monitor is attached via
+        :meth:`attach_heartbeat`).  The underlying collective cannot be
+        cancelled — the sync thread is left behind as a daemon, and the
+        caller is expected to checkpoint-and-exit (run_elastic restarts
+        absorb the loss)."""
+        self._drain_async()
+        _faults.inject("kvstore.barrier")
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils
+
+        name = f"mxnet_tpu_kvstore_barrier_{self._barrier_count}"
+        if timeout is None:
+            timeout = _config.get("MXNET_BARRIER_TIMEOUT")
+        if not timeout:
+            multihost_utils.sync_global_devices(name)
             self._barrier_count += 1
+            return
+        done = threading.Event()
+        err: List[BaseException] = []
+
+        def _sync():
+            try:
+                multihost_utils.sync_global_devices(name)
+            except BaseException as e:
+                err.append(e)
+            finally:
+                done.set()
+
+        threading.Thread(target=_sync, daemon=True,
+                         name=f"kvstore-barrier-{self._barrier_count}").start()
+        if not done.wait(timeout):
+            suspects = (self._heartbeat.dead_ranks()
+                        if self._heartbeat is not None else None)
+            if suspects:
+                who = f"suspected dead ranks: {suspects}"
+            elif self._heartbeat is not None:
+                who = ("all heartbeats live — slow rank or network "
+                       "partition")
+            else:
+                who = ("no HeartbeatMonitor attached "
+                       "(KVStore.attach_heartbeat) — suspects unknown")
+            _faults.record_event("kvstore.barrier", "deadline",
+                                 timeout=timeout, suspects=suspects)
+            raise _faults.DeadlineExceeded(
+                f"barrier {self._barrier_count} timed out after {timeout}s "
+                f"({jax.process_count()} processes); {who}")
+        self._barrier_count += 1
+        if err:
+            raise err[0]
 
 
 def _key_int(k: str):

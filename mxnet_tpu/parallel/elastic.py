@@ -31,7 +31,29 @@ from typing import Any, Callable, Iterable, List, Optional
 import jax
 import numpy as onp
 
-__all__ = ["CheckpointManager", "HeartbeatMonitor", "run_elastic"]
+from .. import config as _config
+from .. import faults as _faults
+from ..log import get_logger
+
+__all__ = ["CheckpointManager", "HeartbeatMonitor", "run_elastic",
+           "AnomalyDetected", "nonfinite_anomaly"]
+
+_LOG = get_logger("mxnet_tpu.elastic")
+
+
+class AnomalyDetected(RuntimeError):
+    """A step produced a state the anomaly detector rejected (e.g. a
+    non-finite loss); run_elastic rolls back to the last checkpoint under
+    the same ``max_restarts`` budget."""
+
+
+# What a truncated/corrupt checkpoint file can raise while loading:
+# pickle/EOF for torn bytes, OSError for an unreadable file, Value/Index/
+# Key for a payload whose structure no longer matches, plus injected
+# faults (site checkpoint.restore).  Anything else is a real bug and
+# propagates.
+_RESTORE_ERRORS = (pickle.UnpicklingError, EOFError, OSError, ValueError,
+                   IndexError, KeyError, _faults.FaultInjected)
 
 
 def _tree_to_host(tree):
@@ -168,11 +190,29 @@ class CheckpointManager:
                 self._q.task_done()
 
     def _write(self, step: int, payload) -> None:
+        """Write one checkpoint under the shared retry policy (site
+        ``checkpoint.write``): a transient filesystem failure (network FS
+        flap, preempted host) re-runs the whole atomic write with
+        backoff; the temp-then-replace discipline makes a replay
+        harmless."""
+        _faults.retry_call(self._write_once, step, payload,
+                           site="checkpoint.write")
+
+    def _write_once(self, step: int, payload) -> None:
         path = self._path(step)
         tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, path)
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        except BaseException:
+            # never leave a partial temp file for a retry (or a later
+            # incarnation of this pid) to trip over
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
         # record the saving world size (every host writes identical
         # content; atomic replace makes the race harmless)
         meta_tmp = f"{self._meta_path(step)}.{os.getpid()}.tmp"
@@ -228,15 +268,40 @@ class CheckpointManager:
         of arrays carrying shardings), sharded leaves are re-placed with
         their original sharding via ``jax.device_put``.
 
-        Sharded ("shards") leaves are assembled from EVERY saving host's
-        file, not just this host's: after an elastic restart the world may
-        have grown, and a newly added host has no file of its own — it
-        must still be able to reconstruct the full array (``device_put``
-        then keeps only its addressable region under the new sharding).
+        Graceful degradation: when ``step`` is NOT given and the newest
+        complete step turns out to be truncated/corrupt on disk (crash
+        mid-replace survived by a broken network-FS write, bit rot), the
+        WHOLE step is abandoned and the previous complete step is tried —
+        a fault event is recorded, and hosts can never silently mix
+        leaves across steps, because degradation always moves to an older
+        step in its entirety.  An EXPLICIT ``step`` never falls back: the
+        caller asked for that step, so corruption raises.
         """
-        step = self.latest_step() if step is None else step
-        if step is None:
+        if step is not None:
+            return self._restore_step(step, like), step
+        candidates = self.complete_steps()
+        if not candidates:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        last_err: Optional[BaseException] = None
+        for s in reversed(candidates):
+            try:
+                return self._restore_step(s, like), s
+            except _RESTORE_ERRORS as e:
+                last_err = e
+                _faults.record_event("checkpoint.restore", "degrade",
+                                     error=e, step=s)
+                _LOG.warning(
+                    "checkpoint step %d unrestorable (%r); degrading to "
+                    "the previous complete step", s, e)
+        raise RuntimeError(
+            f"no restorable checkpoint in {self.directory}: every "
+            f"complete step {candidates} failed to load "
+            f"(last error: {last_err!r})") from last_err
+
+    def _restore_step(self, step: int, like: Any = None):
+        """Load one specific step (one attempt, site
+        ``checkpoint.restore``)."""
+        _faults.inject("checkpoint.restore")
         paths = self._step_files(step)
         if not paths:
             raise FileNotFoundError(
@@ -271,7 +336,7 @@ class CheckpointManager:
                 leaves.append(jax.device_put(arr, ref.sharding))
             else:
                 leaves.append(arr)
-        return jax.tree_util.tree_unflatten(treedef, leaves), step
+        return jax.tree_util.tree_unflatten(treedef, leaves)
 
     def close(self):
         self._closed = True
@@ -342,9 +407,23 @@ class HeartbeatMonitor:
         return dead
 
 
+def nonfinite_anomaly(*keys: str) -> Callable[[Any], bool]:
+    """Anomaly detector factory for :func:`run_elastic`: flags a state
+    whose ``state[key]`` holds any non-finite value (NaN/Inf loss — the
+    classic silent-divergence failure a crash handler never sees)."""
+    def _check(state) -> bool:
+        for k in keys:
+            if not bool(onp.all(onp.isfinite(onp.asarray(state[k])))):
+                return True
+        return False
+    return _check
+
+
 def run_elastic(step_fn: Callable, state: Any, inputs: Iterable,
                 ckpt: CheckpointManager, save_every: int = 10,
-                max_restarts: int = 3, on_restart: Optional[Callable] = None):
+                max_restarts: int = 3, on_restart: Optional[Callable] = None,
+                restart_backoff: Optional[float] = None,
+                anomaly_fn: Optional[Callable[[Any], bool]] = None):
     """Run ``state = step_fn(state, batch)`` over ``inputs`` with periodic
     checkpoints; on an exception, restore the latest checkpoint, skip
     already-consumed steps, and continue (up to ``max_restarts``).
@@ -352,9 +431,24 @@ def run_elastic(step_fn: Callable, state: Any, inputs: Iterable,
     ``inputs`` must be re-iterable (a list or a factory-backed sequence) so
     skipped prefixes replay deterministically; with a stateful loader, pass
     its epoch list.  Returns (final_state, steps_run, restarts).
+
+    Hardening (docs/ROBUSTNESS.md):
+
+    - ``restart_backoff`` (default ``MXNET_ELASTIC_BACKOFF``): exponential
+      delay ``min(backoff * 2**(restart-1), MXNET_RETRY_BACKOFF_MAX)``
+      before each restore — a crashing dependency (storage, a flapping
+      peer) gets time to recover instead of being hammered.
+    - ``anomaly_fn(state) -> bool`` (e.g. ``nonfinite_anomaly("loss")``):
+      a True verdict after a step raises :class:`AnomalyDetected`, which
+      rolls back to the last checkpoint under the SAME ``max_restarts``
+      budget — a deterministically diverging run still terminates.
+    - each iteration passes the ``elastic.step`` injection site, so crash
+      recovery is testable without a real preemption.
     """
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
+    if restart_backoff is None:
+        restart_backoff = _config.get("MXNET_ELASTIC_BACKOFF")
     inputs = list(inputs)
     start = 0
     if ckpt.latest_step() is not None:
@@ -367,18 +461,31 @@ def run_elastic(step_fn: Callable, state: Any, inputs: Iterable,
     i = start
     while i < len(inputs):
         try:
-            state = step_fn(state, inputs[i])
+            _faults.inject("elastic.step")
+            new_state = step_fn(state, inputs[i])
+            if anomaly_fn is not None and anomaly_fn(new_state):
+                raise AnomalyDetected(
+                    f"anomaly detected in the state after step {i}")
+            state = new_state
             i += 1
             if i % save_every == 0 or i == len(inputs):
                 ckpt.save(i, state)
-        except Exception:
+        except Exception as e:
             restarts += 1
+            _faults.record_event("elastic.restart", "restart", error=e,
+                                 step=i, restart=restarts)
             if restarts > max_restarts:
                 ckpt.wait()
                 raise
+            _LOG.warning("elastic restart %d/%d at step %d: %r",
+                         restarts, max_restarts, i, e)
             if on_restart is not None:
                 on_restart(restarts)
             ckpt.wait()
+            if restart_backoff > 0:
+                _faults._sleep(min(
+                    restart_backoff * (2 ** (restarts - 1)),
+                    _config.get("MXNET_RETRY_BACKOFF_MAX")))
             state, i = ckpt.restore(like=state)
     ckpt.wait()
     return state, i, restarts

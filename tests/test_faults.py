@@ -1,0 +1,625 @@
+"""Fault matrix: deterministic fault injection (mxnet_tpu/faults.py) and
+the hardened recovery paths it instruments.
+
+Contract under test (docs/ROBUSTNESS.md): inject one fault at each
+registered site and assert the DOCUMENTED recovery — retry counts,
+rollback step, and final-state parity with an uninterrupted run.  The
+static check (tools/check_fault_sites.py, run here) enforces that every
+``inject("<site>")`` string shipped in mxnet_tpu/ appears in a test.
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults
+from mxnet_tpu.gluon.data.dataloader import DataLoader, DataLoaderWorkerError
+from mxnet_tpu.gluon.model_zoo import model_store
+from mxnet_tpu.kvstore import kvstore as kvstore_mod
+from mxnet_tpu.parallel.elastic import (AnomalyDetected, CheckpointManager,
+                                        HeartbeatMonitor, nonfinite_anomaly,
+                                        run_elastic)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Each test starts with no plan, empty counters, and no real
+    sleeping in backoff loops."""
+    faults.uninstall()
+    faults.reset()
+    monkeypatch.setattr(faults, "_sleep", lambda s: None)
+    yield
+    faults.uninstall()
+
+
+def _sleep_log(monkeypatch):
+    delays = []
+    monkeypatch.setattr(faults, "_sleep", delays.append)
+    return delays
+
+
+# -- registry / plan / policy ----------------------------------------------
+
+def test_fault_plan_env_parse_and_windows():
+    plan = faults.FaultPlan.from_env(
+        "a.site:2, b.site@1:1:fatal, c.site:1:oserror")
+    assert plan.sites() == ["a.site", "b.site", "c.site"]
+    with faults.active(plan):
+        for _ in range(2):
+            with pytest.raises(faults.TransientFault):
+                faults.inject("a.site")
+        faults.inject("a.site")                    # window spent
+        faults.inject("b.site")                    # after=1: first passes
+        with pytest.raises(faults.FatalFault):
+            faults.inject("b.site")
+        with pytest.raises(OSError):
+            faults.inject("c.site")
+    assert faults.counters("a.site")["injected"] == 2
+    kinds = [e["kind"] for e in faults.events() if e["action"] == "inject"]
+    assert kinds == ["TransientFault", "TransientFault", "FatalFault",
+                     "OSError"]
+
+
+def test_fault_plan_rejects_bad_spec():
+    with pytest.raises(ValueError, match="unknown"):
+        faults.FaultPlan.from_env("a.site:1:nosuchkind")
+    with pytest.raises(ValueError, match="bad fault rule"):
+        faults.FaultPlan().fail("a.site", times=0)
+
+
+def test_inject_disabled_is_noop_and_cheap():
+    """Zero-overhead-when-disabled contract: with no plan installed,
+    inject() is one global None check — never raises, never allocates
+    counters, and runs a hot-path-compatible number of times fast."""
+    faults.inject("never.registered")
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        faults.inject("kvstore.push")
+    assert time.perf_counter() - t0 < 1.0
+    assert "kvstore.push" not in faults.counters()
+
+
+def test_retry_call_backoff_sequence(monkeypatch):
+    delays = _sleep_log(monkeypatch)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise faults.TransientFault("flap")
+        return "ok"
+
+    out = faults.retry_call(flaky, site="test.backoff", retries=5,
+                            backoff=0.1, max_backoff=0.25)
+    assert out == "ok"
+    assert delays == [0.1, 0.2, 0.25]              # deterministic, capped
+    c = faults.counters("test.backoff")
+    assert (c["attempts"], c["failures"], c["retries"]) == (4, 3, 3)
+
+
+def test_retry_call_nonretryable_fails_fast():
+    def bad():
+        raise ValueError("a real bug")
+
+    with pytest.raises(ValueError):
+        faults.retry_call(bad, site="test.fatal", retries=5)
+    assert faults.counters("test.fatal")["attempts"] == 1
+    with pytest.raises(faults.FatalFault):
+        with faults.active(faults.FaultPlan().fail(
+                "test.fatal", exc=faults.FatalFault)):
+            faults.retry_call(lambda: "unreached", site="test.fatal")
+
+
+def test_retry_call_exhaustion_reraises_last_error():
+    def always():
+        raise OSError("still down")
+
+    with pytest.raises(OSError, match="still down"):
+        faults.retry_call(always, site="test.exhaust", retries=2)
+    c = faults.counters("test.exhaust")
+    assert (c["attempts"], c["retries"]) == (3, 2)
+    assert faults.events("test.exhaust")[-1]["action"] == "raise"
+
+
+def test_retry_call_deadline():
+    def always():
+        raise faults.TransientFault("flap")
+
+    with pytest.raises(faults.DeadlineExceeded, match="deadline"):
+        faults.retry_call(always, site="test.deadline", retries=100,
+                          backoff=0.2, deadline=0.05)
+
+
+# -- kvstore ---------------------------------------------------------------
+
+class _FakeKvClient:
+    """In-memory jax.distributed kv-service double (single process)."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set_bytes(self, k, v):
+        self.store[k] = v
+
+    def blocking_key_value_get_bytes(self, k, timeout_ms):
+        return self.store[k]
+
+    def key_value_set(self, k, v):
+        self.store[k] = v
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        return self.store[k]
+
+    def key_value_delete(self, k):
+        pass
+
+
+def test_kvstore_collective_retries_transient_fault(monkeypatch):
+    from jax._src import distributed
+
+    monkeypatch.setattr(distributed.global_state, "client", _FakeKvClient())
+    with faults.active(faults.FaultPlan().fail("kvstore.collective")):
+        out = kvstore_mod._kv_allgather(onp.arange(4.0, dtype=onp.float32))
+    onp.testing.assert_array_equal(out, onp.arange(4.0)[None, :])
+    c = faults.counters("kvstore.collective")
+    assert c["retries"] == 1 and c["attempts"] == 2
+
+
+def test_kvstore_push_fault_fails_fast_pull_retries():
+    kv = mx.kv.create("local")
+    kv.init("3", mx.nd.ones((2, 2)))
+    # push is NOT idempotent (may apply a server-side update): fail fast
+    with faults.active(faults.FaultPlan().fail("kvstore.push")):
+        with pytest.raises(faults.TransientFault):
+            kv.push("3", mx.nd.ones((2, 2)))
+        assert faults.counters("kvstore.push")["injected"] == 1
+        # pull is a pure read: retried under the shared policy
+        out = mx.nd.zeros((2, 2))
+        with faults.active(faults.FaultPlan().fail("kvstore.pull")):
+            kv.pull("3", out=out)
+    onp.testing.assert_array_equal(out.asnumpy(), onp.ones((2, 2)))
+    assert faults.counters("kvstore.pull")["retries"] == 1
+
+
+def test_barrier_deadline_names_suspected_dead_ranks(tmp_path, monkeypatch):
+    from jax.experimental import multihost_utils
+
+    kv = mx.kv.create("local")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda name: time.sleep(30))
+    hb_dir = str(tmp_path / "hb")
+    hb = HeartbeatMonitor(hb_dir, rank=0, timeout=1.0)
+    hb.beat()
+    # rank 1 existed but its beat went stale (dead host)
+    stale = os.path.join(hb_dir, "rank-1.hb")
+    with open(stale, "a"):
+        pass
+    old = time.time() - 60
+    os.utime(stale, (old, old))
+    kv.attach_heartbeat(hb)
+    with pytest.raises(faults.DeadlineExceeded,
+                       match=r"suspected dead ranks: \[1\]"):
+        kv.barrier(timeout=0.2)
+    assert faults.events("kvstore.barrier")[-1]["action"] == "deadline"
+
+
+def test_barrier_deadline_without_heartbeat_says_unknown(monkeypatch):
+    from jax.experimental import multihost_utils
+
+    kv = mx.kv.create("local")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda name: time.sleep(30))
+    monkeypatch.setenv("MXNET_BARRIER_TIMEOUT", "0.2")   # env-driven deadline
+    with pytest.raises(faults.DeadlineExceeded, match="suspects unknown"):
+        kv.barrier()
+
+
+def test_barrier_inject_site():
+    kv = mx.kv.create("local")
+    with faults.active(faults.FaultPlan().fail("kvstore.barrier")):
+        with pytest.raises(faults.TransientFault):
+            kv.barrier()
+    kv.barrier()                                   # single process: no-op
+
+
+# -- checkpoints -----------------------------------------------------------
+
+def _mgr(tmp_path, **kw):
+    kw.setdefault("async_save", False)
+    return CheckpointManager(str(tmp_path / "ckpt"), **kw)
+
+
+def test_checkpoint_write_fault_retried(tmp_path):
+    mgr = _mgr(tmp_path)
+    with faults.active(faults.FaultPlan().fail("checkpoint.write")):
+        mgr.save(1, {"w": onp.arange(3.0)})
+    out, step = mgr.restore()
+    assert step == 1
+    onp.testing.assert_array_equal(out["w"], onp.arange(3.0))
+    assert faults.counters("checkpoint.write")["retries"] == 1
+    assert not [f for f in os.listdir(mgr.directory) if f.endswith(".tmp")]
+    mgr.close()
+
+
+def test_checkpoint_restore_corrupt_degrades_to_previous_step(tmp_path):
+    mgr = _mgr(tmp_path)
+    for s in (1, 2, 3):
+        mgr.save(s, {"w": onp.full(4, float(s))})
+    # truncate the newest step's file (torn write survived by a broken FS)
+    with open(mgr._path(3), "wb") as f:
+        f.write(b"\x80\x04corrupt")
+    out, step = mgr.restore()
+    assert step == 2                               # whole step abandoned
+    onp.testing.assert_array_equal(out["w"], onp.full(4, 2.0))
+    evs = faults.events("checkpoint.restore")
+    assert evs and evs[-1]["action"] == "degrade" and evs[-1]["step"] == 3
+    # an EXPLICIT step never silently falls back
+    with pytest.raises(Exception):
+        mgr.restore(step=3)
+    mgr.close()
+
+
+def test_checkpoint_restore_all_corrupt_raises(tmp_path):
+    mgr = _mgr(tmp_path)
+    for s in (1, 2):
+        mgr.save(s, {"w": onp.zeros(2)})
+        with open(mgr._path(s), "wb") as f:
+            f.write(b"junk")
+    with pytest.raises(RuntimeError, match="no restorable checkpoint"):
+        mgr.restore()
+    mgr.close()
+
+
+def test_checkpoint_restore_inject_degrades(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(1, {"w": onp.zeros(2)})
+    mgr.save(2, {"w": onp.ones(2)})
+    with faults.active(faults.FaultPlan().fail("checkpoint.restore")):
+        out, step = mgr.restore()
+    assert step == 1                   # injected fault at step 2 -> degrade
+    onp.testing.assert_array_equal(out["w"], onp.zeros(2))
+    mgr.close()
+
+
+# -- run_elastic -----------------------------------------------------------
+
+def _ref_run(batches):
+    state = {"w": onp.float32(0), "i": onp.int64(0)}
+    for b in batches:
+        state = {"w": state["w"] + b, "i": state["i"] + 1}
+    return state
+
+
+def _step(state, batch):
+    return {"w": state["w"] + batch, "i": state["i"] + 1}
+
+
+def test_run_elastic_checkpoint_write_faults_parity(tmp_path):
+    """Transient write faults are absorbed by retry — not even a restart;
+    final state bit-matches the uninterrupted run."""
+    batches = [onp.float32(b) for b in range(1, 11)]
+    mgr = _mgr(tmp_path)
+    with faults.active(faults.FaultPlan().fail("checkpoint.write", times=2)):
+        out, steps, restarts = run_elastic(
+            _step, {"w": onp.float32(0), "i": onp.int64(0)}, batches, mgr,
+            save_every=3)
+    assert (steps, restarts) == (10, 0)
+    assert float(out["w"]) == float(_ref_run(batches)["w"])
+    mgr.close()
+
+
+def test_run_elastic_step_fault_restores_and_replays(tmp_path):
+    batches = [onp.float32(b) for b in range(1, 13)]
+    mgr = _mgr(tmp_path)
+    with faults.active(faults.FaultPlan().fail("elastic.step", after=7)):
+        out, steps, restarts = run_elastic(
+            _step, {"w": onp.float32(0), "i": onp.int64(0)}, batches, mgr,
+            save_every=4, max_restarts=2)
+    assert (steps, restarts) == (12, 1)
+    assert float(out["w"]) == float(_ref_run(batches)["w"])
+    assert faults.events("elastic.restart")
+    mgr.close()
+
+
+def test_run_elastic_restart_backoff(tmp_path, monkeypatch):
+    delays = _sleep_log(monkeypatch)
+    batches = [onp.float32(1)] * 6
+    mgr = _mgr(tmp_path)
+    with faults.active(faults.FaultPlan().fail("elastic.step", times=2)):
+        out, steps, restarts = run_elastic(
+            _step, {"w": onp.float32(0), "i": onp.int64(0)}, batches, mgr,
+            save_every=2, max_restarts=3, restart_backoff=0.05)
+    assert restarts == 2
+    assert delays == [0.05, 0.1]                   # exponential, per restart
+    assert float(out["w"]) == 6.0
+    mgr.close()
+
+
+def test_run_elastic_anomaly_rollback_parity(tmp_path):
+    """A one-off non-finite state triggers rollback-to-checkpoint under
+    the max_restarts budget; the replayed run matches the clean one."""
+    batches = [onp.float32(b) for b in range(1, 11)]
+    poisoned = {"done": False}
+
+    def step(state, batch):
+        out = _step(state, batch)
+        if int(out["i"]) == 6 and not poisoned["done"]:
+            poisoned["done"] = True
+            out = dict(out, w=onp.float32("nan"))
+        return out
+
+    mgr = _mgr(tmp_path)
+    out, steps, restarts = run_elastic(
+        step, {"w": onp.float32(0), "i": onp.int64(0)}, batches, mgr,
+        save_every=4, max_restarts=2, anomaly_fn=nonfinite_anomaly("w"))
+    assert poisoned["done"] and restarts == 1 and steps == 10
+    assert float(out["w"]) == float(_ref_run(batches)["w"])
+    mgr.close()
+
+
+def test_run_elastic_persistent_anomaly_exhausts_budget(tmp_path):
+    def step(state, batch):
+        return dict(_step(state, batch), w=onp.float32("inf"))
+
+    mgr = _mgr(tmp_path)
+    with pytest.raises(AnomalyDetected):
+        run_elastic(step, {"w": onp.float32(0), "i": onp.int64(0)},
+                    [onp.float32(1)] * 4, mgr, max_restarts=2,
+                    anomaly_fn=nonfinite_anomaly("w"))
+    mgr.close()
+
+
+def test_env_fault_plan_subprocess_parity(tmp_path):
+    """MXNET_FAULT_PLAN drives injection in a fresh process (the
+    documented way to fault-test launcher-spawned jobs): the faulted run
+    recovers and its final trained state equals the clean run's."""
+    script = (
+        "import json, sys\n"
+        "import numpy as onp\n"
+        "import mxnet_tpu\n"
+        "from mxnet_tpu.parallel.elastic import CheckpointManager, "
+        "run_elastic\n"
+        "def step(s, b):\n"
+        "    return {'w': s['w'] + b, 'i': s['i'] + 1}\n"
+        "ckpt = CheckpointManager(sys.argv[1], async_save=False)\n"
+        "out, steps, restarts = run_elastic(\n"
+        "    step, {'w': onp.float32(0), 'i': onp.int64(0)},\n"
+        "    [onp.float32(x) for x in range(1, 13)], ckpt, save_every=4)\n"
+        "print(json.dumps({'w': float(out['w']), 'steps': steps,\n"
+        "                  'restarts': restarts}))\n")
+
+    def _run(plan, d):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   MXNET_RETRY_BACKOFF="0.001", MXNET_ELASTIC_BACKOFF="0")
+        env.pop("MXNET_FAULT_PLAN", None)
+        if plan:
+            env["MXNET_FAULT_PLAN"] = plan
+        r = subprocess.run([sys.executable, "-c", script, str(d)],
+                           capture_output=True, text=True, timeout=300,
+                           env=env, cwd=REPO)
+        assert r.returncode == 0, r.stderr[-2000:]
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    clean = _run(None, tmp_path / "clean")
+    faulted = _run("elastic.step@6:1,checkpoint.write:1",
+                   tmp_path / "faulted")
+    assert faulted["restarts"] == 1 and clean["restarts"] == 0
+    assert faulted["steps"] == clean["steps"] == 12
+    assert faulted["w"] == clean["w"]              # bit-identical recovery
+
+
+# -- DataLoader ------------------------------------------------------------
+
+class _ArrayDataset:
+    def __init__(self, n=12, fail_at=None, exc=ValueError):
+        self.n, self.fail_at, self.exc = n, fail_at, exc
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if self.fail_at is not None and i == self.fail_at:
+            raise self.exc(f"poisoned sample {i}")
+        return onp.full((2,), i, onp.float32)
+
+
+class _CrashOnFlagDataset:
+    """Hard-crashes the WORKER PROCESS (no exception to ship back) the
+    first time the flag file is claimed — models segfault/OOM-kill."""
+
+    def __init__(self, n, flag):
+        self.n, self.flag = n, flag
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i == 5 and os.path.exists(self.flag):
+            try:
+                os.remove(self.flag)               # atomic claim
+            except FileNotFoundError:
+                pass
+            else:
+                os._exit(1)
+        return onp.full((2,), i, onp.float32)
+
+
+def _epoch(loader):
+    return [b.asnumpy() for b in loader]
+
+
+def test_dataloader_thread_pool_retries_transient_worker_fault():
+    ds = _ArrayDataset(12)
+    baseline = _epoch(DataLoader(ds, batch_size=4))
+    loader = DataLoader(ds, batch_size=4, num_workers=2, thread_pool=True,
+                        timeout=30)
+    with faults.active(faults.FaultPlan().fail("dataloader.worker")):
+        got = _epoch(loader)
+    assert len(got) == len(baseline)
+    for a, b in zip(got, baseline):
+        onp.testing.assert_array_equal(a, b)       # batch refetched intact
+    evs = faults.events("dataloader.worker")
+    assert evs and evs[-1]["action"] == "failure" and evs[-1]["retryable"]
+
+
+def test_dataloader_process_pool_surfaces_original_exception_promptly():
+    loader = DataLoader(_ArrayDataset(12, fail_at=5), batch_size=4,
+                        num_workers=2, timeout=120)
+    t0 = time.monotonic()
+    with pytest.raises(DataLoaderWorkerError) as ei:
+        _epoch(loader)
+    # prompt (not after the full 120 s timeout), with full context
+    assert time.monotonic() - t0 < 60
+    msg = str(ei.value)
+    assert "batch 1" in msg and "poisoned sample 5" in msg
+    assert "worker traceback" in msg and ei.value.batch_idx == 1
+    loader._shutdown()
+
+
+def test_dataloader_worker_crash_respawns_pool_and_retries(tmp_path):
+    flag = str(tmp_path / "crash.flag")
+    with open(flag, "w") as f:
+        f.write("1")
+    ds = _CrashOnFlagDataset(12, flag)
+    # baseline from a clean dataset with identical content — iterating the
+    # crashing one with num_workers=0 would _exit the TEST process
+    baseline = _epoch(DataLoader(_ArrayDataset(12), batch_size=4))
+    loader = DataLoader(ds, batch_size=4, num_workers=1, timeout=60)
+    got = _epoch(loader)
+    assert not os.path.exists(flag)                # the crash DID happen
+    assert len(got) == len(baseline)
+    for a, b in zip(got, baseline):
+        onp.testing.assert_array_equal(a, b)
+    evs = faults.events("dataloader.worker")
+    assert evs and "died" in evs[-1]["cause"]
+    loader._shutdown()
+
+
+def test_dataloader_persistent_crash_raises_with_context(tmp_path,
+                                                         monkeypatch):
+    monkeypatch.setenv("MXNET_DATALOADER_RETRIES", "1")
+
+    class _AlwaysCrash(_ArrayDataset):
+        def __getitem__(self, i):
+            if i == 5:
+                os._exit(1)
+            return onp.full((2,), i, onp.float32)
+
+    loader = DataLoader(_AlwaysCrash(12), batch_size=4, num_workers=1,
+                        timeout=60)
+    with pytest.raises(DataLoaderWorkerError, match="died"):
+        _epoch(loader)
+    loader._shutdown()
+
+
+# -- model_store.download --------------------------------------------------
+
+def _sha1(path):
+    import hashlib
+
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
+def test_download_verifies_sha1_and_retries(tmp_path):
+    src = tmp_path / "weights.bin"
+    src.write_bytes(b"checkpoint-bytes")
+    url = "file://" + str(src)
+    dst = str(tmp_path / "out" / "weights.bin")
+    with faults.active(faults.FaultPlan().fail("download")):
+        got = model_store.download(url, dst, sha1_hash=_sha1(str(src)))
+    assert got == dst and os.path.exists(dst)
+    assert faults.counters("download")["retries"] == 1
+    assert not os.path.exists(dst + ".part")
+
+
+def test_download_sha1_mismatch_removes_file_and_raises(tmp_path):
+    src = tmp_path / "weights.bin"
+    src.write_bytes(b"wrong-bytes")
+    dst = str(tmp_path / "weights.out")
+    with pytest.raises(OSError, match="sha1"):
+        model_store.download("file://" + str(src), dst,
+                             sha1_hash="0" * 40, retries=1)
+    assert not os.path.exists(dst)                 # poisoned bytes removed
+    assert not os.path.exists(dst + ".part")
+    assert faults.counters("download")["attempts"] == 2
+
+
+def test_download_failure_leaves_no_partial(tmp_path):
+    dst = str(tmp_path / "never.bin")
+    with pytest.raises(OSError):
+        model_store.download("file:///nonexistent/path/nope", dst, retries=1)
+    assert not os.path.exists(dst) and not os.path.exists(dst + ".part")
+
+
+# -- trainer ---------------------------------------------------------------
+
+def test_trainer_step_inject_site():
+    from mxnet_tpu import gluon
+
+    p = gluon.Parameter("w", shape=(4, 4))
+    p.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer({"w": p}, "sgd", {"learning_rate": 0.1})
+    g = p.list_grad()[0]
+    g._set_data(mx.nd.ones((4, 4))._data)
+    with faults.active(faults.FaultPlan().fail("trainer.step",
+                                               exc=faults.FatalFault)):
+        with pytest.raises(faults.FatalFault):
+            trainer.step(1)
+    before = p.data().asnumpy().copy()
+    trainer.step(1)                                # plan spent: trains
+    assert not onp.allclose(before, p.data().asnumpy())
+
+
+# -- tooling ---------------------------------------------------------------
+
+def _load_checker():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_fault_sites", os.path.join(REPO, "tools",
+                                          "check_fault_sites.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_registered_fault_site_is_tested():
+    """The CI gate itself: every inject()/retry_call site shipped in
+    mxnet_tpu/ must appear in at least one test."""
+    checker = _load_checker()
+    assert checker.main(REPO) == 0
+
+
+def test_check_fault_sites_detects_untested_site(tmp_path):
+    checker = _load_checker()
+    pkg = tmp_path / "mxnet_tpu"
+    tests = tmp_path / "tests"
+    pkg.mkdir(), tests.mkdir()
+    (pkg / "mod.py").write_text(
+        'faults.inject("covered.site")\n'
+        'faults.retry_call(fn, site="uncovered.site")\n')
+    (tests / "test_mod.py").write_text('PLAN = "covered.site"\n')
+    sites = checker.collect_sites(str(pkg))
+    assert set(sites) == {"covered.site", "uncovered.site"}
+    assert checker.main(str(tmp_path)) == 1
+
+
+def test_faults_events_and_reset():
+    faults.record_event("some.site", "note", step=7)
+    assert faults.events("some.site")[-1]["step"] == 7
+    faults.reset()
+    assert faults.events() == [] and faults.counters() == {}

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Static check: every fault-injection site shipped in ``mxnet_tpu/``
+must be exercised by at least one test.
+
+A site is any string literal passed as ``faults.inject("<site>")`` or as
+``site="<site>"`` (the ``retry_call`` keyword).  A site counts as tested
+when the same quoted string appears anywhere under ``tests/`` — the
+fault-matrix suite (tests/test_faults.py) installs a FaultPlan against
+it and asserts the documented recovery.  New sites therefore cannot ship
+untested; the suite itself runs this check (tests/test_faults.py).
+
+Exit code 0 = every site covered; 1 = missing coverage (sites listed on
+stderr).  Usage: python tools/check_fault_sites.py [repo_root]
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, Set
+
+# inject("site") / inject('site') / site="site" / site='site'
+_SITE_RE = re.compile(
+    r"""(?:inject\(\s*|site\s*=\s*)["']([a-z0-9_.]+)["']""")
+
+
+def _py_files(root: str):
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def collect_sites(pkg_dir: str) -> Dict[str, Set[str]]:
+    """Site -> set of source files (relative) declaring it."""
+    sites: Dict[str, Set[str]] = {}
+    for path in _py_files(pkg_dir):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for m in _SITE_RE.finditer(text):
+            sites.setdefault(m.group(1), set()).add(
+                os.path.relpath(path, os.path.dirname(pkg_dir)))
+    return sites
+
+
+def tested_sites(tests_dir: str, sites) -> Set[str]:
+    covered: Set[str] = set()
+    pats = {s: re.compile(r"""["']""" + re.escape(s) + r"""["']""")
+            for s in sites}
+    for path in _py_files(tests_dir):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for s, pat in pats.items():
+            if s not in covered and pat.search(text):
+                covered.add(s)
+    return covered
+
+
+def main(root: str = None) -> int:
+    root = root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    pkg, tests = os.path.join(root, "mxnet_tpu"), os.path.join(root, "tests")
+    sites = collect_sites(pkg)
+    if not sites:
+        print("check_fault_sites: no injection sites found under "
+              f"{pkg} — regex or layout broke", file=sys.stderr)
+        return 1
+    covered = tested_sites(tests, sites)
+    missing = sorted(set(sites) - covered)
+    if missing:
+        print("check_fault_sites: injection sites with NO test coverage "
+              "(reference them from a test, e.g. via faults.FaultPlan):",
+              file=sys.stderr)
+        for s in missing:
+            print(f"  {s!r}  (declared in {', '.join(sorted(sites[s]))})",
+                  file=sys.stderr)
+        return 1
+    print(f"check_fault_sites: {len(sites)} sites, all covered: "
+          f"{sorted(sites)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))

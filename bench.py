@@ -593,11 +593,49 @@ def lane_int8(on_cpu: bool, model_name: str = "resnet50_v1") -> dict:
     return lane
 
 
+def lane_train_step(on_cpu: bool) -> dict:
+    """Compiled whole-train-step lane (cached_step.TrainStep): runs
+    benchmark/eager_latency.py's train_step_compiled worker and carries
+    its counters into lanes[].  The value is dispatches/step — the PR-3
+    acceptance bar is 1 (counter-based, so the lane is equally meaningful
+    on CPU fallback); retrace/cache stats ride along for regression
+    tracking.  A lane value of 0 means the compiled path fell back."""
+    import json as _json
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmark", "eager_latency.py")
+    r = subprocess.run([sys.executable, "-u", script, "--train-step-only",
+                        "--json"], capture_output=True, text=True,
+                       timeout=600, env=dict(os.environ))
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"train_step lane failed:\n{r.stderr[-1500:]}")
+    c = _json.loads(r.stdout.strip().splitlines()[-1])["train_step_compiled"]
+    _progress(f"train_step: {c['dispatches_per_step']:.1f} dispatches/step "
+              f"({'compiled' if c['compiled'] else 'FELL BACK'}, "
+              f"{c['us_per_step']:.0f} us/step)")
+    return {
+        "metric": "train_step_compiled_dispatches_per_step",
+        "value": c["dispatches_per_step"] if c["compiled"] else 0.0,
+        "unit": "dispatches/step",
+        "vs_baseline": 0.0,
+        "compiled": c["compiled"],
+        "retrace_count": c["retrace_count"],
+        "cache_hits": c["cache_hits"],
+        "cache_misses": c["cache_misses"],
+        "us_per_step": round(c["us_per_step"], 1),
+        "n_params": c["n_params"],
+        "platform": c["platform"],
+    }
+
+
 def _resolve_lane(name):
     """Lane key -> (callable(on_cpu) -> lane dict, metric name).  Any model
     zoo name works, with optional _bf16 / _int8 suffixes."""
     if name == "bert":
         return lane_bert, "bert_base_train_throughput_per_chip"
+    if name == "train_step":
+        return lane_train_step, "train_step_compiled_dispatches_per_step"
     if name.endswith("_int8"):
         model = name[: -len("_int8")] or "resnet50_v1"
         return (lambda on_cpu, m=model: lane_int8(on_cpu, m),
@@ -613,13 +651,15 @@ def _resolve_lane(name):
 # Ordering: bf16 resnet first (the headline AND the cheapest real-model
 # compile — its XLA program also warms the compile cache for fp32); int8
 # last (longest end-to-end: calibration + conversion + compile).
-LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "resnet50_v1_int8"]
+LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "train_step",
+              "resnet50_v1_int8"]
 
 # generous-but-bounded per-lane wall budgets (seconds) on the device;
 # CPU-fallback lanes use small sizes and get one flat budget.
 # BENCH_LANE_TIMEOUT overrides every device-lane budget.
 _LANE_BUDGET = {"resnet50_v1_bf16": 600.0, "resnet50_v1": 600.0,
-                "bert": 540.0, "resnet50_v1_int8": 900.0}
+                "bert": 540.0, "train_step": 240.0,
+                "resnet50_v1_int8": 900.0}
 _CPU_LANE_BUDGET = 420.0
 
 
@@ -868,6 +908,8 @@ def _metric_to_lane(metric: str):
     """Invert _resolve_lane's metric naming for the salvage pass."""
     if metric == "bert_base_train_throughput_per_chip":
         return "bert"
+    if metric == "train_step_compiled_dispatches_per_step":
+        return "train_step"
     for suffix, lane_sfx in (("_int8_infer_throughput_per_chip", "_int8"),
                              ("_bf16_train_throughput_per_chip", "_bf16"),
                              ("_train_throughput_per_chip", "")):

@@ -16,8 +16,15 @@ The trainer lane reports ``dispatches_per_step`` = eager op dispatches
 at <= 1 + (number of distinct parameter groups) while the loop path pays
 >= 1 per parameter (the acceptance bar for PR 1).
 
+The train_step_compiled lane rides next to it (PR 3): a hybridized MLP
+trained through ``Trainer.compile_step`` (cached_step.TrainStep), whose
+whole step — forward+backward+update — must land at 1 dispatch/step with
+retrace count 0 after warm-up; it also reports program-cache hits/misses.
+``--train-step-only`` emits just that lane (bench.py's lanes[] entry).
+
 Usage: python benchmark/eager_latency.py [--ops N] [--json]
                                          [--trainer-params P] [--no-trainer]
+                                         [--train-step-only]
 Each mode runs in a SUBPROCESS so the jit cache and config are clean.
 """
 import json
@@ -139,6 +146,84 @@ print(json.dumps({
 """
 
 
+# Compiled whole-train-step lane (cached_step.TrainStep): a small
+# hybridized MLP trained via trainer.compile_step — forward+backward+
+# update as ONE donated program.  Reports dispatches/step (the bar: 1,
+# +1 host read under AMP), program-cache hits/misses, and the retrace
+# count across constant-shape steps (the bar: 0 after warm).  Counter-
+# based, so the lane is meaningful on any backend; us/step additionally
+# shows the tunnel RTT win on chip.
+_TRAIN_STEP_WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))) if "__file__" in dir() else "/root/repo")
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import cached_step, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import ndarray as _ndmod
+from mxnet_tpu.optimizer import fused as _fused
+
+WIDTH = int(os.environ.get("TRAIN_STEP_WIDTH", "64"))
+DEPTH = int(os.environ.get("TRAIN_STEP_DEPTH", "4"))
+STEPS = int(os.environ.get("TRAIN_STEP_STEPS", "20"))
+OPT = os.environ.get("TRAINER_OPT", "sgd")
+
+class Net(gluon.HybridBlock):
+    def __init__(self):
+        super().__init__()
+        for i in range(DEPTH):
+            setattr(self, f"d{i}", nn.Dense(
+                WIDTH, in_units=WIDTH, activation="relu"))
+        self.out = nn.Dense(WIDTH, in_units=WIDTH)
+    def forward(self, x):
+        for i in range(DEPTH):
+            x = getattr(self, f"d{i}")(x)
+        return self.out(x)
+
+net = Net()
+net.initialize(mx.init.Xavier())
+net.hybridize()
+rng = onp.random.RandomState(0)
+opt_kw = {"learning_rate": 0.01}
+if OPT == "sgd":
+    opt_kw["momentum"] = 0.9
+trainer = gluon.Trainer(net.collect_params(), OPT, opt_kw)
+loss_fn = lambda n, x, y: ((n(x) - y) ** 2).mean()
+step = trainer.compile_step(net, loss_fn)
+x = mx.nd.array(rng.randn(128, WIDTH).astype(onp.float32))
+y = mx.nd.array(rng.randn(128, WIDTH).astype(onp.float32))
+
+loss = step(x, y, batch_size=128)          # warm: trace + compile
+_ = float(loss.asnumpy().ravel()[0])       # drain
+inv0, d0, f0, t0 = (_ndmod.invoke_count(), cached_step.dispatch_count(),
+                    _fused.dispatch_count(), cached_step.trace_count())
+c0 = dict(cached_step.cache_stats())
+t_start = time.perf_counter()
+for _ in range(STEPS):
+    loss = step(x, y, batch_size=128)
+_ = float(loss.asnumpy().ravel()[0])       # fence
+dt = time.perf_counter() - t_start
+c1 = cached_step.cache_stats()
+
+import jax
+print(json.dumps({
+    "platform": jax.default_backend(),
+    "compiled": step.last_fallback_reason is None,
+    "n_params": len(trainer._params),
+    "steps": STEPS,
+    "dispatches_per_step":
+        (_ndmod.invoke_count() - inv0 + cached_step.dispatch_count() - d0
+         + _fused.dispatch_count() - f0) / STEPS,
+    "compiled_launches_per_step":
+        (cached_step.dispatch_count() - d0) / STEPS,
+    "retrace_count": cached_step.trace_count() - t0,
+    "cache_hits": c1["hits"] - c0["hits"],
+    "cache_misses": c1["misses"] - c0["misses"],
+    "us_per_step": dt / STEPS * 1e6,
+}))
+"""
+
+
 def run(mode: str, n: int) -> dict:
     env = dict(os.environ)
     env["MXNET_EAGER_JIT"] = mode
@@ -171,9 +256,30 @@ def run_trainer(fused: bool, n_params: int, steps: int = 20,
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+def run_train_step(steps: int = 20, opt: str = "sgd") -> dict:
+    env = dict(os.environ)
+    env["TRAIN_STEP_STEPS"] = str(steps)
+    env["TRAINER_OPT"] = opt
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+    r = subprocess.run([sys.executable, "-u", "-c", _TRAIN_STEP_WORKER],
+                       capture_output=True, text=True, timeout=900, env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))) or ".")
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"train_step_compiled lane failed:\n{r.stderr[-2000:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def main() -> None:
     n = 100
     as_json = "--json" in sys.argv
+    if "--train-step-only" in sys.argv:
+        # bench.py's lanes[] entry point: just the compiled-step lane
+        lane = run_train_step()
+        print(json.dumps({"train_step_compiled": lane}) if as_json
+              else lane)
+        return
     if "--ops" in sys.argv:
         n = int(sys.argv[sys.argv.index("--ops") + 1])
     trainer_params = 56
@@ -195,6 +301,9 @@ def main() -> None:
             "dispatch_reduction": round(
                 t_loop["dispatches_per_step"]
                 / max(t_fused["dispatches_per_step"], 1e-9), 1)}
+        # the compiled whole-train-step lane rides next to the trainer
+        # lane: same counters, but forward+backward fold in too
+        result["train_step_compiled"] = run_train_step()
     if as_json:
         print(json.dumps(result))
         return
@@ -215,6 +324,16 @@ def main() -> None:
                   f"{lane['compiled_group_dispatches_per_step']:>12.1f} "
                   f"{lane['us_per_step']:>10.1f}")
         print(f"dispatch reduction: {ts['dispatch_reduction']}x")
+    if "train_step_compiled" in result:
+        c = result["train_step_compiled"]
+        print(f"\ncompiled train step ({c['n_params']} params, "
+              f"{'compiled' if c['compiled'] else 'FELL BACK'}, "
+              f"{c['steps']} steps)")
+        print(f"dispatches/step {c['dispatches_per_step']:.1f} "
+              f"(compiled launches {c['compiled_launches_per_step']:.1f}), "
+              f"retraces {c['retrace_count']}, cache "
+              f"{c['cache_hits']}h/{c['cache_misses']}m, "
+              f"{c['us_per_step']:.1f} us/step")
 
 
 if __name__ == "__main__":

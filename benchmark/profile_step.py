@@ -3,6 +3,14 @@
 Usage:
     python benchmark/profile_step.py [--model resnet50_v1] [--batch 128]
         [--layout NHWC] [--s2d 1] [--bf16 1] [--steps 5] [--top 30]
+        [--step-mode {sharded,eager,compiled}]
+
+``--step-mode eager`` profiles the Gluon eager-tape train step
+(record/backward/trainer.step); ``--step-mode compiled`` profiles the
+same model through ``Trainer.compile_step`` (cached_step.TrainStep, one
+donated program) — the A/B for the whole-step fusion claim.  Each run
+appends its header + by-kind table to
+``benchmark/artifacts/profile_step_<mode>.log``.
 
 Writes a jax.profiler trace to --logdir (default /tmp/jaxprof) and then
 parses the Chrome-trace export (plugins/profile/*/…trace.json.gz) to print
@@ -76,6 +84,59 @@ def build_step(model_name, batch, layout, s2d, bf16, img=224):
     return tr, data, label
 
 
+def build_gluon_step(model_name, batch, layout, s2d, bf16, step_mode,
+                     img=224):
+    """Eager-tape vs compiled-TrainStep A/B builder (--step-mode): the
+    same Gluon model/optimizer driven either through record()/backward()/
+    trainer.step() (one XLA program per tape node + group programs) or
+    through trainer.compile_step() (ONE donated program).  This is the
+    measurement lane for the PR-3 fusion claim: the by-kind table should
+    show the reduce+copy share dropping in compiled mode, where XLA sees
+    BN batch-stats forward and the dy reductions backward together."""
+    import jax
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    kw = {}
+    if model_name.startswith("resnet"):
+        kw = {"layout": layout, "input_layout": layout, "stem_s2d": s2d}
+    net = vision.get_model(model_name, classes=1000, **kw)
+    net.initialize(mx.init.Xavier())
+    if bf16:
+        amp.init("bfloat16")
+    probe = (1, img, img, 3) if layout == "NHWC" else (1, 3, img, img)
+    net(mx.nd.zeros(probe))
+    net.hybridize()
+    ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+    rng = onp.random.RandomState(0)
+    shape = (batch, img, img, 3) if layout == "NHWC" \
+        else (batch, 3, img, img)
+    data = mx.nd.array(rng.rand(*shape).astype(onp.float32))
+    label = mx.nd.array(
+        rng.randint(0, 1000, (batch,)).astype(onp.int32))
+    loss_fn = lambda n, d, l: ce(n(d), l).mean()
+    if step_mode == "compiled":
+        step = trainer.compile_step(net, loss_fn)
+
+        def run_step():
+            return step(data, label, batch_size=batch)
+    else:
+        def run_step():
+            with mx.autograd.record():
+                loss = loss_fn(net, data, label)
+            loss.backward()
+            trainer.step(batch)
+            return loss
+
+    return run_step
+
+
 def classify(name):
     n = name.lower()
     if "conv" in n:
@@ -93,11 +154,28 @@ def classify(name):
     return "other"
 
 
-def parse_trace(logdir, top):
+def parse_trace(logdir, top, save_path=None):
+    """Print the by-kind/by-op device-time tables; with ``save_path``
+    also append them to an artifact log (the --step-mode A/B evidence)."""
+    lines = []
+
+    def emit(*parts):
+        line = " ".join(str(p) for p in parts)
+        lines.append(line)
+        print(line)
+
+    def flush():
+        if save_path:
+            os.makedirs(os.path.dirname(save_path), exist_ok=True)
+            with open(save_path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+            print(f"(appended to {save_path})")
+
     paths = sorted(glob.glob(os.path.join(
         logdir, "plugins", "profile", "*", "*.trace.json.gz")))
     if not paths:
-        print("no trace.json.gz found under", logdir)
+        emit("no trace.json.gz found under", logdir)
+        flush()
         return
     with gzip.open(paths[-1], "rt") as f:
         trace = json.load(f)
@@ -115,8 +193,8 @@ def parse_trace(logdir, top):
     device_pids = {p for p, n in pid_names.items()
                    if any(k in n for k in ("TPU", "Device", "/device:"))}
     if not device_pids:
-        print("WARNING: no device track found in the trace — counting ALL "
-              "tracks (host rows included); op totals are not device time")
+        emit("WARNING: no device track found in the trace — counting ALL "
+             "tracks (host rows included); op totals are not device time")
     per_op = collections.Counter()
     per_kind = collections.Counter()
     total = 0.0
@@ -134,14 +212,17 @@ def parse_trace(logdir, top):
         per_op[ev["name"]] += dur
         per_kind[classify(ev["name"])] += dur
         total += dur
-    print(f"\n== device op time (total {total/1e3:.2f} ms across "
-          f"{len(per_op)} op names; trace {os.path.basename(paths[-1])}) ==")
-    print("\n-- by kind --")
+    emit(f"\n== device op time (total {total/1e3:.2f} ms across "
+         f"{len(per_op)} op names; trace {os.path.basename(paths[-1])}) ==")
+    emit("\n-- by kind --")
     for kind, dur in per_kind.most_common():
-        print(f"  {kind:<16} {dur/1e3:10.2f} ms  {100*dur/max(total,1e-9):5.1f}%")
-    print(f"\n-- top {top} ops --")
+        emit(f"  {kind:<16} {dur/1e3:10.2f} ms  "
+             f"{100*dur/max(total,1e-9):5.1f}%")
+    emit(f"\n-- top {top} ops --")
     for name, dur in per_op.most_common(top):
-        print(f"  {dur/1e3:9.2f} ms  {100*dur/max(total,1e-9):5.1f}%  {name[:110]}")
+        emit(f"  {dur/1e3:9.2f} ms  {100*dur/max(total,1e-9):5.1f}%  "
+             f"{name[:110]}")
+    flush()
 
 
 def main():
@@ -154,34 +235,59 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--top", type=int, default=30)
     ap.add_argument("--logdir", default="/tmp/jaxprof")
+    ap.add_argument("--step-mode", default="sharded",
+                    choices=("sharded", "eager", "compiled"),
+                    help="sharded = the ShardedTrainer compiled step "
+                         "(historical default); eager vs compiled A/B the "
+                         "Gluon tape against cached_step.TrainStep — the "
+                         "reduce+copy share should drop in compiled mode")
     ap.add_argument("--parse-only", action="store_true",
                     help="just parse an existing --logdir trace")
     args = ap.parse_args()
 
+    artifact = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "artifacts",
+        f"profile_step_{args.step_mode}.log")
     if not args.parse_only:
         import jax
-        tr, data, label = build_step(args.model, args.batch, args.layout,
-                                     bool(args.s2d), bool(args.bf16))
-        print("compiling…")
-        t0 = time.perf_counter()
-        tr.step(data, label)
-        print(f"compiled in {time.perf_counter()-t0:.1f}s; warming")
+        if args.step_mode == "sharded":
+            tr, data, label = build_step(args.model, args.batch,
+                                         args.layout, bool(args.s2d),
+                                         bool(args.bf16))
+            run_step = lambda: tr.step(data, label, sync=False)
+            print("compiling…")
+            t0 = time.perf_counter()
+            tr.step(data, label)
+            print(f"compiled in {time.perf_counter()-t0:.1f}s; warming")
+        else:
+            run_step = build_gluon_step(args.model, args.batch,
+                                        args.layout, bool(args.s2d),
+                                        bool(args.bf16), args.step_mode)
+            print(f"warming ({args.step_mode} step)…")
         for _ in range(2):
-            loss = tr.step(data, label, sync=False)
+            loss = run_step()
         loss = getattr(loss, "asnumpy", lambda: loss)()
-        float(loss)
+        float(loss if getattr(loss, "ndim", 0) == 0 else loss.ravel()[0])
         os.makedirs(args.logdir, exist_ok=True)
         jax.profiler.start_trace(args.logdir)
         t0 = time.perf_counter()
         for _ in range(args.steps):
-            loss = tr.step(data, label, sync=False)
+            loss = run_step()
         loss = getattr(loss, "asnumpy", lambda: loss)()
-        v = float(loss)
+        v = float(loss if getattr(loss, "ndim", 0) == 0
+                  else loss.ravel()[0])
         dt = time.perf_counter() - t0
         jax.profiler.stop_trace()
-        print(f"{args.steps} steps in {dt*1e3:.1f} ms "
+        print(f"[{args.step_mode}] {args.steps} steps in {dt*1e3:.1f} ms "
               f"({args.batch*args.steps/dt:.1f} img/s, loss {v:.3f})")
-    parse_trace(args.logdir, args.top)
+        os.makedirs(os.path.dirname(artifact), exist_ok=True)
+        with open(artifact, "a") as f:
+            f.write(f"\n== {time.strftime('%Y-%m-%d %H:%M:%S')} "
+                    f"{args.model} bs{args.batch} {args.layout} "
+                    f"bf16={args.bf16} mode={args.step_mode}: "
+                    f"{args.steps} steps {dt*1e3:.1f} ms "
+                    f"({args.batch*args.steps/dt:.1f} img/s) ==\n")
+    parse_trace(args.logdir, args.top, save_path=artifact)
 
 
 if __name__ == "__main__":

@@ -78,6 +78,7 @@ _LAZY = {
     "npx": ".numpy_extension",
     "parallel": ".parallel",
     "runtime": ".runtime",
+    "cached_step": ".cached_step",
     "test_utils": ".test_utils",
     "recordio": ".recordio",
     "util": ".util",

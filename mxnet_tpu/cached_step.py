@@ -1,0 +1,398 @@
+"""Compiled whole-train-step: the CachedOp analog for TRAINING.
+
+The reference funnels every execution mode through ``CachedOp``
+(``src/imperative/cached_op.cc``): a shape-keyed graph cache whose forward
+AND backward run as one engine-scheduled graph each.  Our eager training
+path, by contrast, still ran as a per-op vjp tape — forward dispatching
+op-by-op, ``autograd.backward`` pushing one XLA program per ``TapeNode``,
+and only the optimizer update fused (PR 1).  On chip every eager dispatch
+pays a host round-trip (docs/PERF.md: BatchNorm 82 ms plain vs 0.3 ms
+compiled), and the remaining ResNet reduce/copy texture (~37% of device
+time) only fuses away when XLA sees forward and backward in ONE program.
+
+:class:`TrainStep` (``Trainer.compile_step(net, loss_fn)``) closes that
+gap: loss-fn forward (via the same staging machinery that backs
+``HybridBlock.hybridize()`` — ``gluon.block._stage_fn``), the ``jax.vjp``
+backward, the kvstore ``device``-path gradient reduction (an identity
+reduce for the supported single-replica topology — multi-worker falls
+back), the PR-1 functional ``Optimizer.fused_update`` rule
+(``optimizer.fused.group_step_fn``, same numerics as the eager fused
+path), and the AMP loss-scaling / all-finite gate all trace into ONE
+``jax.jit`` program with DONATED parameter/optimizer-state buffers.
+
+Programs are cached per ``TrainStep`` keyed by (input structure +
+shapes/dtypes, train-mode, optimizer hyper-param signature, parameter/
+state shapes+dtypes, AMP generation) — exactly CachedOp's shape-keyed
+graph cache.  Per-step values (lr, wd, update counts, rescale_grad, the
+loss scale) ride in as traced arguments, so an LR-scheduler tick or a
+changed batch size never re-traces.
+
+Result: dispatches/step drop from O(#tape nodes + #groups) to **1**
+(+1 host scalar read for the AMP all-finite flag).  Anything the program
+cannot express — a forward that cannot stage (host reads, data-dependent
+shapes), ``grad_req='add'``, multi-replica parameters, multi-worker
+kvstores, server-side (``update_on_kvstore``) updates, optimizers without
+a ``fused_update`` rule — falls back transparently to the eager tape;
+``MXNET_COMPILED_STEP=0`` forces the tape everywhere.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from . import config as _config
+from . import faults as _faults
+from . import random as _random
+from .context import current_context
+
+__all__ = ["TrainStep", "enabled", "trace_count", "dispatch_count",
+           "cache_stats", "reset_counters"]
+
+# observability, mirroring optimizer/fused.py: _TRACE_COUNT bumps when a
+# whole-step program body is (re)traced, _DISPATCH_COUNT per compiled
+# launch, and the cache counters track the shape-keyed program cache.
+# tests assert re-trace stays at 0 across constant-shape steps and
+# benchmark/eager_latency.py reports dispatches/step (the bar: 1).
+_TRACE_COUNT = 0
+_DISPATCH_COUNT = 0
+_CACHE_HITS = 0
+_CACHE_MISSES = 0
+
+
+def trace_count() -> int:
+    return _TRACE_COUNT
+
+
+def dispatch_count() -> int:
+    return _DISPATCH_COUNT
+
+
+def cache_stats() -> Dict[str, int]:
+    return {"hits": _CACHE_HITS, "misses": _CACHE_MISSES}
+
+
+def reset_counters() -> None:
+    global _TRACE_COUNT, _DISPATCH_COUNT, _CACHE_HITS, _CACHE_MISSES
+    _TRACE_COUNT = 0
+    _DISPATCH_COUNT = 0
+    _CACHE_HITS = 0
+    _CACHE_MISSES = 0
+
+
+def enabled() -> bool:
+    """Compiled-step knob on (MXNET_COMPILED_STEP, default 1)."""
+    return bool(_config.get("MXNET_COMPILED_STEP"))
+
+
+class TrainStep:
+    """One training step — forward, backward, reduce, update — as one
+    compiled, donated XLA program (``Trainer.compile_step``).
+
+    ``loss_fn(net, *args)`` must return NDArray loss value(s); calling the
+    step runs the whole update and returns the (unscaled) loss.  The
+    backward seeds ones over every loss leaf, exactly like
+    ``autograd.backward`` on the eager tape, so ``step(x, y)`` is the
+    compiled equivalent of::
+
+        with autograd.record():
+            loss = loss_fn(net, x, y)
+        loss.backward()
+        trainer.step(batch_size)
+
+    Parameter ``.grad()`` buffers are NOT materialized on the compiled
+    path (gradients live only inside the program); the eager fallback
+    writes them as usual.
+    """
+
+    def __init__(self, net, loss_fn: Callable, trainer):
+        self._net = net
+        self._loss_fn = loss_fn
+        self._trainer = trainer
+        self._programs: "OrderedDict" = OrderedDict()
+        # sticky: set on a staging/trace failure — the forward cannot
+        # stage, so every later call takes the eager tape directly
+        self.fallback_reason: Optional[str] = None
+        # why the LAST call fell back (None when it ran compiled)
+        self.last_fallback_reason: Optional[str] = None
+
+    # -- public ----------------------------------------------------------
+    @property
+    def last_step_compiled(self) -> bool:
+        return self.last_fallback_reason is None
+
+    def __call__(self, *args, batch_size: Optional[int] = None):
+        # train-step injection site (fail-fast like trainer.step: a step
+        # is not idempotent; recovery is restore-and-replay, not retry)
+        _faults.inject("cached_step.step")
+        tr = self._trainer
+        if batch_size is None:
+            batch_size = int(args[0].shape[0]) \
+                if args and getattr(args[0], "shape", ()) else 1
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._params_to_init:
+            tr._init_params()
+        reason = self._eligibility()
+        if reason is not None:
+            self.last_fallback_reason = reason
+            return self._eager_step(args, batch_size)
+        opt = tr._optimizer
+        # host-side update-count bump BEFORE reading lrs (the eager order:
+        # Optimizer.update -> _update_count -> _get_lrs); snapshotted so a
+        # build failure can roll back before the eager fallback re-bumps
+        indices = [tr._param2idx[id(p)] for p in tr._params
+                   if p.grad_req != "null"]
+        count_snap = (dict(opt._index_update_count), opt.num_update)
+        opt._update_count(list(indices))
+        try:
+            out = self._compiled_step(args, batch_size)
+        except Exception as e:  # staging/trace failure -> sticky fallback
+            opt._index_update_count.clear()
+            opt._index_update_count.update(count_snap[0])
+            opt.num_update = count_snap[1]
+            self.fallback_reason = f"{type(e).__name__}: {e}"
+            self.last_fallback_reason = self.fallback_reason
+            return self._eager_step(args, batch_size)
+        self.last_fallback_reason = None
+        return out
+
+    # -- eligibility / fallback ------------------------------------------
+    def _eligibility(self) -> Optional[str]:
+        from .optimizer import fused as _fused
+
+        tr = self._trainer
+        if not enabled():
+            return "MXNET_COMPILED_STEP=0"
+        if self.fallback_reason is not None:
+            return self.fallback_reason
+        if not _fused.supports(tr._optimizer):
+            return (f"optimizer {type(tr._optimizer).__name__} has no "
+                    "functional fused_update rule")
+        if tr._update_on_kvstore:
+            return "update_on_kvstore=True applies updates server-side"
+        if tr._kvstore is not None and tr._kvstore.num_workers > 1:
+            return "multi-worker kvstore reduction not staged yet"
+        for p in tr._params:
+            if p.grad_req == "add":
+                return f"parameter '{p.name}' has grad_req='add'"
+        for p in self._net.collect_params().values():
+            if p._data is None:
+                return ("deferred parameter init pending (first call "
+                        "runs eagerly, like hybridize)")
+            if len(p._data) > 1:
+                return "multi-replica (multi-ctx) parameters"
+        return None
+
+    def _eager_step(self, args, batch_size):
+        """The eager tape path, AMP-equivalent to amp.scale_loss +
+        backward + trainer.step."""
+        tr = self._trainer
+        scaler = getattr(tr, "_amp_loss_scaler", None)
+        with autograd.record():
+            loss = self._loss_fn(self._net, *args)
+            heads = list(loss) if isinstance(loss, (list, tuple)) else [loss]
+            if scaler is not None and scaler.loss_scale != 1.0:
+                heads = [h * scaler.loss_scale for h in heads]
+        autograd.backward(heads)
+        if scaler is not None:
+            base = getattr(tr, "_amp_original_scale", tr._scale)
+            tr._amp_original_scale = base
+            tr._scale = base / scaler.loss_scale
+        tr.step(batch_size)
+        return loss
+
+    # -- the compiled step ------------------------------------------------
+    def _compiled_step(self, args, batch_size):
+        global _DISPATCH_COUNT, _CACHE_HITS, _CACHE_MISSES
+        from .gluon import block as _gb
+        from .ndarray import ndarray as _ndmod
+        from .optimizer import fused as _fused
+
+        tr = self._trainer
+        opt = tr._optimizer
+        scaler = getattr(tr, "_amp_loss_scaler", None)
+        updater = tr._updaters[0]
+
+        in_leaves, in_struct = _gb._flatten_args(args)
+        ctx = in_leaves[0].ctx if in_leaves else current_context()
+        flavor = _ndmod._flavor_of(in_leaves)
+
+        params = OrderedDict(
+            (n, p) for n, p in self._net.collect_params().items()
+            if p._data is not None)
+        names = list(params)
+        # trainable set/order follows trainer._params — the order the
+        # eager fused path groups and checks finiteness in
+        trainable = [p for p in tr._params if p.grad_req != "null"]
+        indices = [tr._param2idx[id(p)] for p in trainable]
+        for p, idx in zip(trainable, indices):
+            if idx not in updater.states:
+                updater.states[idx] = opt.create_state_multi_precision(
+                    idx, p.data())
+                updater.states_synced[idx] = True
+        states = [updater.states[idx] for idx in indices]
+        mps = [_fused._is_mp_state(opt, p.data(), s)
+               for p, s in zip(trainable, states)]
+        groups: "OrderedDict" = OrderedDict()
+        for i, p in enumerate(trainable):
+            groups.setdefault((p.data()._data.dtype, mps[i]), []).append(i)
+        group_layout = tuple((mp, tuple(m))
+                             for (_dt, mp), m in groups.items())
+
+        slot_of_name: Dict[str, int] = {}
+        trainable_ids = {id(p): i for i, p in enumerate(trainable)}
+        for n in names:
+            i = trainable_ids.get(id(params[n]))
+            if i is not None:
+                slot_of_name[n] = i
+        frozen_names = [n for n in names if n not in slot_of_name]
+
+        has_ok = scaler is not None
+        donate = jax.default_backend() not in ("cpu",)
+        sig = (
+            _gb._struct_key(in_struct),
+            tuple((tuple(l.shape), l._data.dtype) for l in in_leaves),
+            True,                       # train-mode (part of the key by
+            _ndmod._amp_generation,     # contract; TrainStep trains)
+            ctx, flavor,
+            type(opt).__name__, opt._fused_signature(),
+            tuple((tuple(p.data().shape), p.data()._data.dtype)
+                  for p in trainable),
+            tuple(_fused._struct(s) for s in states),
+            tuple((n, tuple(params[n].data().shape),
+                   params[n].data()._data.dtype) for n in frozen_names),
+            group_layout, has_ok, donate,
+        )
+        rec = self._programs.get(sig)
+        if rec is None:
+            _CACHE_MISSES += 1
+            rec = self._build_program(
+                params, names, in_struct, ctx, flavor, slot_of_name,
+                frozen_names, group_layout, has_ok, donate)
+            self._programs[sig] = rec
+            cap = _config.get("MXNET_COMPILED_STEP_CACHE")
+            while len(self._programs) > cap:
+                self._programs.popitem(last=False)
+        else:
+            _CACHE_HITS += 1
+            self._programs.move_to_end(sig)
+        jitted, out_struct, mutated_names = rec
+
+        # per-step traced values: counts were bumped by __call__ already
+        counts = [opt._index_update_count[i] for i in indices]
+        lrs = opt._get_lrs(list(indices))
+        wds = opt._get_wds(list(indices))
+        scale_val = scaler.loss_scale if scaler is not None else 1.0
+        if scaler is not None:
+            tr._amp_original_scale = getattr(
+                tr, "_amp_original_scale", tr._scale)
+        base = getattr(tr, "_amp_original_scale", tr._scale)
+        rescale = base / (scale_val * batch_size)
+        lrs_g = [jnp.asarray([lrs[i] for i in m], jnp.float32)
+                 for _mp, m in group_layout]
+        wds_g = [jnp.asarray([wds[i] for i in m], jnp.float32)
+                 for _mp, m in group_layout]
+        counts_g = [jnp.asarray([counts[i] for i in m], jnp.float32)
+                    for _mp, m in group_layout]
+
+        w_args = [p.data()._data for p in trainable]
+        s_args = tuple(_fused._unwrap(s) for s in states)
+        frozen_args = [params[n].data()._data for n in frozen_names]
+        in_args = [l._data for l in in_leaves]
+
+        out_raw, mut_vals, new_w, new_s, ok = jitted(
+            w_args, s_args, frozen_args, in_args, _random.next_key(),
+            lrs_g, wds_g, counts_g,
+            jnp.asarray(rescale, jnp.float32),
+            jnp.asarray(scale_val, jnp.float32))
+        _DISPATCH_COUNT += 1
+
+        for p, nw in zip(trainable, new_w):
+            p._data[0]._set_data(nw)
+        for s, ns in zip(states, new_s):
+            _fused._write(s, ns)
+        # mutation (BN running stats) writes LAST: a forward mutating a
+        # TRAINABLE param cannot be expressed in one program — its
+        # mutation wins this step and the step goes sticky-eager
+        for n, v in zip(mutated_names, mut_vals):
+            params[n]._data[0]._set_data(v)
+        overlap = [n for n in mutated_names if n in slot_of_name]
+        if overlap:
+            self.fallback_reason = (
+                f"forward mutates trainable parameter(s) {overlap}")
+        out_nd = [_ndmod._wrap(o, ctx, flavor) for o in out_raw]
+        loss = _gb._rebuild_output(out_struct[0], out_nd)
+        if scaler is not None:
+            # the ONE host read of the step: the device all-finite flag
+            # drives the loss-scale policy
+            scaler.update_scale(not bool(ok))
+        return loss
+
+    def _build_program(self, params, names, in_struct, ctx, flavor,
+                       slot_of_name, frozen_names, group_layout, has_ok,
+                       donate):
+        from .gluon import block as _gb
+        from .optimizer import fused as _fused
+
+        net, loss_fn = self._net, self._loss_fn
+        opt = self._trainer._optimizer
+        raw_fwd, out_struct, mutated_names = _gb._stage_fn(
+            lambda *call_args: loss_fn(net, *call_args),
+            params, names, in_struct, True, ctx, flavor)
+        bodies = [_fused.group_step_fn(opt, mp, has_ok)
+                  for mp, _m in group_layout]
+        frozen_pos = {n: j for j, n in enumerate(frozen_names)}
+
+        def step_fn(w_list, s_list, frozen_list, in_list, rng_key,
+                    lrs_g, wds_g, counts_g, rescale, scale):
+            global _TRACE_COUNT
+            _TRACE_COUNT += 1
+
+            def fwd(w_l):
+                full = [w_l[slot_of_name[n]] if n in slot_of_name
+                        else frozen_list[frozen_pos[n]] for n in names]
+                outs, muts = raw_fwd(full, in_list, rng_key)
+                # the loss-scale multiply sits INSIDE the differentiated
+                # region so grads come out scaled, exactly like backward
+                # on amp.scale_loss's scaled loss
+                heads = [o * scale for o in outs] if has_ok else outs
+                return heads, (outs, muts)
+
+            heads, vjp_fn, (outs, muts) = jax.vjp(
+                fwd, list(w_list), has_aux=True)
+            cts = [jnp.ones(h.shape, h.dtype) for h in heads]
+            (grads,) = vjp_fn(cts)
+            grads = [g.astype(w.dtype) if g.dtype != w.dtype else g
+                     for g, w in zip(grads, w_list)]
+            # kvstore 'device'-path reduce: identity for the supported
+            # single-replica/single-worker topology (fused into the
+            # program by construction; other topologies fell back)
+            if has_ok:
+                ok = jnp.all(jnp.stack(
+                    [jnp.isfinite(g).all() for g in grads])) \
+                    if grads else jnp.asarray(True)
+            else:
+                ok = jnp.asarray(True)
+            new_w = list(w_list)
+            new_s = list(s_list)
+            for gi, (_mp, members) in enumerate(group_layout):
+                nw, ns = bodies[gi](
+                    [w_list[i] for i in members],
+                    [grads[i] for i in members],
+                    [s_list[i] for i in members],
+                    lrs_g[gi], wds_g[gi], counts_g[gi], rescale, ok)
+                for j, i in enumerate(members):
+                    new_w[i] = nw[j]
+                    new_s[i] = ns[j]
+            return outs, muts, new_w, tuple(new_s), ok
+
+        # donation aliases the old weight/optimizer-state HBM into the
+        # outputs — the whole point of the fused step on chip; CPU has no
+        # donation support and would only warn
+        jitted = jax.jit(step_fn,
+                         donate_argnums=(0, 1) if donate else ())
+        return (jitted, out_struct, mutated_names)

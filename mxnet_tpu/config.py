@@ -174,6 +174,29 @@ declare("MXNET_FUSED_OPTIMIZER", int, 1,
         "without a fused_update rule fall back to the scalar loop "
         "per-parameter), 0 = force the scalar loop everywhere.",
         subsystem="optimizer", cached=False)
+declare("MXNET_COMPILED_STEP", int, 1,
+        "Compiled whole-train-step (cached_step.TrainStep via "
+        "Trainer.compile_step): loss-fn forward, vjp backward, gradient "
+        "reduce, the fused optimizer update, and the AMP all-finite gate "
+        "trace into ONE jit-compiled program with donated parameter/"
+        "optimizer-state buffers, cached by (input shapes/dtypes, "
+        "train-mode, hyper-param signature) like the reference CachedOp's "
+        "shape-keyed graph cache — 1 device dispatch per step (+1 host "
+        "scalar read with AMP).  1 = on (default; ineligible setups fall "
+        "back to the eager tape transparently), 0 = force the eager tape "
+        "everywhere.", subsystem="optimizer", cached=False)
+declare("MXNET_COMPILED_STEP_CACHE", int, 16,
+        "Max compiled train-step programs kept per TrainStep (LRU over "
+        "input-shape signatures); a new signature past the cap evicts "
+        "the oldest", validator=lambda v: v > 0, subsystem="optimizer",
+        cached=False)
+declare("MXNET_EAGER_JIT_EXCLUDE", str, "mean,sum,prod,max,min",
+        "Comma-set of op names kept OUT of the per-op eager jit cache "
+        "(MXNET_EAGER_JIT): single-primitive reductions measured SLOWER "
+        "jitted than plain dispatch (docs/PERF.md: mean(axis) 0.62x on "
+        "chip — one primitive is already one dispatch, so the cache only "
+        "adds lookup overhead).  Override with your own list; empty "
+        "string re-admits every op.", cached=False)
 declare("MXNET_FUSED_CONV_BN", int, 0,
         "Trace-time fusion of eligible conv + BatchNorm(training) pairs "
         "into the Pallas conv+BN-stats kernels.  0 = off (default: the "

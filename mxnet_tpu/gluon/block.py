@@ -107,6 +107,69 @@ def _rebuild_output(struct, leaves):
     return rec(struct)
 
 
+def _stage_fn(fn, params, names, in_struct, training, wrap_ctx, flavor=None):
+    """Stage an NDArray-level callable into a PURE function of
+    ``(param_arrays, input_arrays, rng_key)`` suitable for ``jax.jit``.
+
+    This is the CachedOp-analog staging machinery shared by
+    ``HybridBlock._build_cache`` (whole-forward compilation) and
+    ``cached_step.TrainStep`` (whole-train-step compilation): traced
+    parameter arrays are installed into the live Parameter replicas for
+    the duration of one call of ``fn`` (recording off, ``training`` mode
+    set, RNG drawing from the traced key chain), and parameter MUTATION
+    (BatchNorm running stats etc.) is detected via version bumps and
+    returned as extra functional outputs.
+
+    Returns ``(raw_fn, out_struct, mutated_names)``; ``out_struct[0]``
+    and ``mutated_names`` are filled in during the first trace.
+    ``raw_fn`` returns ``([out_leaf_arrays], [mutated_param_arrays])``.
+    """
+    out_struct: List[Any] = [None]
+    mutated_names: List[str] = []
+
+    def raw_fn(param_arrays, input_arrays, rng_key):
+        installed = []
+        for n, arr in zip(names, param_arrays):
+            for d in params[n]._data:
+                installed.append((d, d._data, d._version))
+                d._data = arr
+        _random.push_trace_key(rng_key)
+        prev_rec = autograd.set_recording(False)
+        prev_train = autograd.set_training(training)
+        try:
+            leaves = [_wrap(a, wrap_ctx, flavor) for a in input_arrays]
+            call_args = _unflatten_args(in_struct, leaves)
+            out = fn(*call_args)
+            out_leaves, struct = _flatten_output(out)
+            out_struct[0] = struct
+            # detect mutation per param via version bump on any replica
+            # (BatchNorm running stats etc. become extra functional
+            # outputs); must read BEFORE the finally restores buffers
+            mutated_names.clear()
+            mut_vals = []
+            offset = 0
+            for n in names:
+                reps = params[n]._data
+                entries = installed[offset : offset + len(reps)]
+                offset += len(reps)
+                if any(d._version != ver for (d, _o, ver) in entries):
+                    mutated_names.append(n)
+                    mut_vals.append(reps[0]._data)
+        finally:
+            autograd.set_recording(prev_rec)
+            autograd.set_training(prev_train)
+            _random.pop_trace_key()
+            # restore in the finally so a FAILED trace (non-stageable
+            # forward) cannot leak tracers into live parameter buffers —
+            # TrainStep's eager fallback runs on these same Parameters
+            for d, old, ver in installed:
+                d._data = old
+                d._version = ver
+        return [o._data for o in out_leaves], mut_vals
+
+    return raw_fn, out_struct, mutated_names
+
+
 class _BlockScope:
     """Tracks hook handles."""
 
@@ -611,45 +674,8 @@ class HybridBlock(Block):
         )
         names = list(params)
         ctx_idx = 0
-        out_struct: List[Any] = [None]
-        mutated_names: List[str] = []
-        block = self
-
-        def raw_fn(param_arrays, input_arrays, rng_key):
-            installed = []
-            for n, arr in zip(names, param_arrays):
-                for d in params[n]._data:
-                    installed.append((d, d._data, d._version))
-                    d._data = arr
-            _random.push_trace_key(rng_key)
-            prev_rec = autograd.set_recording(False)
-            prev_train = autograd.set_training(training)
-            try:
-                leaves = [_wrap(a, wrap_ctx, flavor) for a in input_arrays]
-                call_args = _unflatten_args(in_struct, leaves)
-                out = block.forward(*call_args)
-            finally:
-                autograd.set_recording(prev_rec)
-                autograd.set_training(prev_train)
-                _random.pop_trace_key()
-            out_leaves, struct = _flatten_output(out)
-            out_struct[0] = struct
-            # detect mutation per param via version bump on any replica
-            # (BatchNorm running stats etc. become extra functional outputs)
-            mutated_names.clear()
-            mut_vals = []
-            offset = 0
-            for n in names:
-                reps = params[n]._data
-                entries = installed[offset : offset + len(reps)]
-                offset += len(reps)
-                if any(d._version != ver for (d, _o, ver) in entries):
-                    mutated_names.append(n)
-                    mut_vals.append(reps[0]._data)
-            for d, old, ver in installed:
-                d._data = old
-                d._version = ver
-            return [o._data for o in out_leaves], mut_vals
+        raw_fn, out_struct, mutated_names = _stage_fn(
+            self.forward, params, names, in_struct, training, wrap_ctx, flavor)
 
         if self._backend:
             # optimize_for backend: a registered transform of the traced

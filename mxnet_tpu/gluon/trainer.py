@@ -164,6 +164,21 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    def compile_step(self, net, loss_fn):
+        """Compile forward + backward + gradient reduce + fused optimizer
+        update (+ AMP gate) into ONE donated XLA program — the CachedOp
+        analog for training (``cached_step.TrainStep``).  ``loss_fn(net,
+        *args)`` returns the loss; the returned step object is called as
+        ``step(*args, batch_size=...)`` and replaces the record/backward/
+        step() triple.  Ineligible setups (non-stageable forwards,
+        grad_req='add', multi-worker stores, server-side updates,
+        optimizers without a fused_update rule, or
+        ``MXNET_COMPILED_STEP=0``) fall back to the eager tape
+        transparently."""
+        from ..cached_step import TrainStep
+
+        return TrainStep(net, loss_fn, self)
+
     # -- the step --------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """Normalize by batch_size, all-reduce grads, apply updates

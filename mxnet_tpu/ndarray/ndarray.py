@@ -874,6 +874,25 @@ def _attrs_key(v):
     return v
 
 
+# per-op cache opt-out (MXNET_EAGER_JIT_EXCLUDE): single-primitive
+# reductions measured SLOWER through the cache than plain dispatch
+# (docs/PERF.md chip table: mean(axis) 0.62x — one primitive is already
+# one dispatch; the cache only adds lookup + executable-launch overhead).
+# Memoized on the raw string so the per-dispatch cost is one dict read.
+_EAGER_JIT_EXCLUDE_MEMO: tuple = (None, frozenset())
+
+
+def _eager_jit_excluded(name: str) -> bool:
+    global _EAGER_JIT_EXCLUDE_MEMO
+    from .. import config as _config
+
+    raw = _config.get("MXNET_EAGER_JIT_EXCLUDE")
+    if raw != _EAGER_JIT_EXCLUDE_MEMO[0]:
+        _EAGER_JIT_EXCLUDE_MEMO = (raw, frozenset(
+            s.strip() for s in (raw or "").split(",") if s.strip()))
+    return name in _EAGER_JIT_EXCLUDE_MEMO[1]
+
+
 def _eager_jit_lookup(schema, attrs, arrays):
     from .. import config as _config
 
@@ -882,6 +901,8 @@ def _eager_jit_lookup(schema, attrs, arrays):
         return None
     if mode != 2 and jax.default_backend() != "tpu":
         return None                       # RTT-bound paths only by default
+    if _eager_jit_excluded(schema.name):
+        return None                       # measured net-loss ops (mean etc.)
     if any(isinstance(a, jax.core.Tracer) for a in arrays):
         # inside an outer trace an inner jit becomes a separate XLA call
         # and would break producer-consumer fusion in hybridized graphs

@@ -33,7 +33,8 @@ from ..base import bounded_cache_put
 from ..ndarray import NDArray
 
 __all__ = ["supports", "enabled", "grouped_update", "all_finite",
-           "trace_count", "dispatch_count", "reset_counters"]
+           "group_step_fn", "trace_count", "dispatch_count",
+           "reset_counters"]
 
 # compiled group programs, keyed on (optimizer signature, group dtype, mp,
 # shapes/dtypes of weights+grads, state tree structure, ok-flag presence)
@@ -198,10 +199,14 @@ def grouped_update(opt, indices, weights, grads, states) -> bool:
     return True
 
 
-def _build(opt, mp: bool, has_ok: bool, donate: bool):
+def group_step_fn(opt, mp: bool, has_ok: bool):
+    """Traceable multi-tensor group-update body: pure jnp over the group's
+    (weights, grads, states) with lrs/wds/counts/rescale/ok as traced
+    values.  Shared by the eager fused path (``_build`` jits it per group)
+    and by ``cached_step.TrainStep``, which inlines it into the whole
+    train-step program — one numerics definition, two compilation
+    granularities."""
     def group_step(w_data, g_data, s_data, lrs, wds, counts, rescale, ok):
-        global _TRACE_COUNT
-        _TRACE_COUNT += 1
         n = len(w_data)
         lr_l = [lrs[i] for i in range(n)]
         wd_l = [wds[i] for i in range(n)]
@@ -234,6 +239,17 @@ def _build(opt, mp: bool, has_ok: bool, donate: bool):
             new_s = tuple(_tree_where(ok, ns, s)
                           for ns, s in zip(new_s, s_data))
         return list(new_w), new_s
+
+    return group_step
+
+
+def _build(opt, mp: bool, has_ok: bool, donate: bool):
+    body = group_step_fn(opt, mp, has_ok)
+
+    def group_step(*args):
+        global _TRACE_COUNT
+        _TRACE_COUNT += 1
+        return body(*args)
 
     # donation aliases the old weight/state HBM into the outputs (the
     # whole point of the fused step on chip); CPU has no donation support

@@ -251,3 +251,25 @@ def test_keyless_rng_ops_never_jitted(eager_jit):
     s1 = nd.random.normal(shape=(8,), key=k)
     s2 = nd.random.normal(shape=(8,), key=k)
     onp.testing.assert_allclose(s1.asnumpy(), s2.asnumpy())
+
+
+def test_reduction_opt_out_default_and_override(eager_jit, monkeypatch):
+    """Single-primitive reductions stay OUT of the per-op cache by
+    default (docs/PERF.md: mean(axis) measured 0.62x through the cache
+    on chip) and the list is overridable through MXNET_EAGER_JIT_EXCLUDE
+    (config.py)."""
+    x = nd.array(onp.random.RandomState(2).randn(4, 6).astype(onp.float32))
+    x.mean(axis=1)
+    x.sum(axis=0)
+    assert not any(k[0] in ("mean", "sum") for k in ndmod._EAGER_JIT_CACHE)
+    nd.softmax(x, axis=-1)               # non-excluded ops still cache
+    assert any(k[0] == "softmax" for k in ndmod._EAGER_JIT_CACHE)
+    # empty override re-admits the reductions (knob is uncached: takes
+    # effect immediately)
+    monkeypatch.setenv("MXNET_EAGER_JIT_EXCLUDE", "")
+    m_jit = x.mean(axis=1)
+    assert any(k[0] == "mean" for k in ndmod._EAGER_JIT_CACHE)
+    monkeypatch.delenv("MXNET_EAGER_JIT_EXCLUDE")
+    onp.testing.assert_allclose(m_jit.asnumpy(),
+                                x.asnumpy().mean(axis=1),
+                                rtol=1e-6, atol=1e-7)

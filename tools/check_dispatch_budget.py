@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""CI gate: the compiled train step must stay inside its dispatch budget.
+
+Runs a tiny MLP under both step modes and FAILS (exit 1) if the compiled
+mode exceeds the documented budget — guarding against silent de-fusion
+regressions (an eager op sneaking back into the hot loop, a per-step
+re-trace, a group program splitting off the whole-step program):
+
+- compiled mode: exactly ``1`` compiled launch per step
+  (``cached_step.dispatch_count``), ``0`` eager op dispatches
+  (``ndarray.invoke_count``), ``0`` separate fused group-program launches
+  (``fused.dispatch_count`` — the update must ride INSIDE the step
+  program), and ``0`` re-traces across constant-shape steps;
+- eager mode (comparison lane, printed, not gated): the tape path's
+  dispatches/step.
+
+Invoked by the test suite (tests/test_cached_step.py) exactly like
+tools/check_fault_sites.py, and runnable standalone:
+``JAX_PLATFORMS=cpu python tools/check_dispatch_budget.py``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the budget the docs promise (docs/PERF.md "Compiled whole-train-step")
+BUDGET = {"compiled_launches_per_step": 1, "eager_invokes_per_step": 0,
+          "group_launches_per_step": 0, "retraces_after_warm": 0}
+STEPS = 5
+
+
+def _build(seed: int = 0):
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d1 = nn.Dense(16, in_units=8, activation="relu")
+            self.d2 = nn.Dense(4, in_units=16)
+
+        def forward(self, x):
+            return self.d2(self.d1(x))
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(seed)
+    for _name, p in sorted(net.collect_params().items()):
+        p.data()._set_data(mx.nd.array(rng.randn(*p.shape) * 0.1)._data)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    data = mx.nd.array(rng.randn(6, 8))
+    label = mx.nd.array(rng.randn(6, 4))
+    loss_fn = lambda n, x, y: ((n(x) - y) ** 2).mean()
+    return net, trainer, loss_fn, data, label
+
+
+def _measure(compiled: bool) -> dict:
+    import mxnet_tpu as mx
+    from mxnet_tpu import cached_step
+    from mxnet_tpu.ndarray import ndarray as _ndmod
+    from mxnet_tpu.optimizer import fused
+
+    net, trainer, loss_fn, data, label = _build()
+    if compiled:
+        step = trainer.compile_step(net, loss_fn)
+
+        def one_step():
+            return step(data, label, batch_size=6)
+    else:
+        def one_step():
+            with mx.autograd.record():
+                loss = loss_fn(net, data, label)
+            loss.backward()
+            trainer.step(6)
+            return loss
+
+    loss = one_step()                    # warm: trace + state create
+    float(loss.asnumpy().ravel()[0])     # drain
+    inv0, d0, f0, t0 = (_ndmod.invoke_count(), cached_step.dispatch_count(),
+                        fused.dispatch_count(), cached_step.trace_count())
+    for _ in range(STEPS):
+        loss = one_step()
+    float(loss.asnumpy().ravel()[0])     # fence
+    out = {
+        "mode": "compiled" if compiled else "eager",
+        "used_compiled": compiled and step.last_step_compiled,
+        "eager_invokes_per_step":
+            (_ndmod.invoke_count() - inv0) / STEPS,
+        "compiled_launches_per_step":
+            (cached_step.dispatch_count() - d0) / STEPS,
+        "group_launches_per_step": (fused.dispatch_count() - f0) / STEPS,
+        "retraces_after_warm": cached_step.trace_count() - t0,
+    }
+    out["dispatches_per_step"] = (out["eager_invokes_per_step"]
+                                  + out["compiled_launches_per_step"]
+                                  + out["group_launches_per_step"])
+    return out
+
+
+def main() -> int:
+    compiled = _measure(True)
+    eager = _measure(False)
+    print(f"{'mode':<10} {'dispatches':>11} {'compiled':>9} {'eager-ops':>10} "
+          f"{'group':>6} {'retrace':>8}")
+    for row in (compiled, eager):
+        print(f"{row['mode']:<10} {row['dispatches_per_step']:>11.1f} "
+              f"{row['compiled_launches_per_step']:>9.1f} "
+              f"{row['eager_invokes_per_step']:>10.1f} "
+              f"{row['group_launches_per_step']:>6.1f} "
+              f"{row['retraces_after_warm']:>8d}")
+    failures = []
+    if not compiled["used_compiled"]:
+        failures.append("compiled mode fell back to the eager tape")
+    for key, budget in BUDGET.items():
+        if compiled[key] > budget:
+            failures.append(
+                f"{key} = {compiled[key]} exceeds budget {budget}")
+    if failures:
+        print("check_dispatch_budget: FAILED —", "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"check_dispatch_budget: compiled step within budget "
+          f"({compiled['dispatches_per_step']:.0f} dispatch/step over "
+          f"{STEPS} steps; eager tape pays "
+          f"{eager['dispatches_per_step']:.0f})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -629,6 +629,46 @@ def lane_train_step(on_cpu: bool) -> dict:
     }
 
 
+def lane_infer(on_cpu: bool) -> dict:
+    """Shape-bucketed serving lane (serving.ServingEngine): runs
+    benchmark/serving_latency.py's worker over a randomized
+    variable-length request stream and carries its counters into
+    lanes[].  The value is p99 request latency; the PR-4 acceptance bar
+    rides along as counters — 0 retraces after warm-up with the program
+    count bounded by the bucket grid (counter-based, so the lane is
+    equally meaningful on CPU fallback)."""
+    import json as _json
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmark", "serving_latency.py")
+    r = subprocess.run([sys.executable, "-u", script, "--serve-only",
+                        "--json"], capture_output=True, text=True,
+                       timeout=600, env=dict(os.environ))
+    if r.returncode != 0:
+        raise RuntimeError(f"infer lane failed:\n{r.stderr[-1500:]}")
+    c = _json.loads(r.stdout.strip().splitlines()[-1])["serving"]
+    _progress(f"infer: p50 {c['p50_us']:.0f} us / p99 {c['p99_us']:.0f} us, "
+              f"{c['throughput_rps']:.1f} req/s, "
+              f"{c['retraces_after_warm']} retraces, "
+              f"{c['programs']} programs")
+    return {
+        "metric": "serving_infer_p99_latency_us",
+        "value": round(c["p99_us"], 1),
+        "unit": "us",
+        "vs_baseline": 0.0,
+        "p50_us": round(c["p50_us"], 1),
+        "throughput_rps": round(c["throughput_rps"], 1),
+        "bucket_hits": c["bucket_hits"],
+        "bucket_misses": c["bucket_misses"],
+        "retrace_count": c["retraces_after_warm"],
+        "programs": c["programs"],
+        "buckets": c["buckets"],
+        "requests_per_dispatch":
+            round(c["concurrent"]["requests_per_dispatch"], 2),
+        "platform": c["platform"],
+    }
+
+
 def _resolve_lane(name):
     """Lane key -> (callable(on_cpu) -> lane dict, metric name).  Any model
     zoo name works, with optional _bf16 / _int8 suffixes."""
@@ -636,6 +676,8 @@ def _resolve_lane(name):
         return lane_bert, "bert_base_train_throughput_per_chip"
     if name == "train_step":
         return lane_train_step, "train_step_compiled_dispatches_per_step"
+    if name == "infer":
+        return lane_infer, "serving_infer_p99_latency_us"
     if name.endswith("_int8"):
         model = name[: -len("_int8")] or "resnet50_v1"
         return (lambda on_cpu, m=model: lane_int8(on_cpu, m),
@@ -652,13 +694,13 @@ def _resolve_lane(name):
 # compile — its XLA program also warms the compile cache for fp32); int8
 # last (longest end-to-end: calibration + conversion + compile).
 LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "train_step",
-              "resnet50_v1_int8"]
+              "infer", "resnet50_v1_int8"]
 
 # generous-but-bounded per-lane wall budgets (seconds) on the device;
 # CPU-fallback lanes use small sizes and get one flat budget.
 # BENCH_LANE_TIMEOUT overrides every device-lane budget.
 _LANE_BUDGET = {"resnet50_v1_bf16": 600.0, "resnet50_v1": 600.0,
-                "bert": 540.0, "train_step": 240.0,
+                "bert": 540.0, "train_step": 240.0, "infer": 240.0,
                 "resnet50_v1_int8": 900.0}
 _CPU_LANE_BUDGET = 420.0
 

@@ -1,0 +1,172 @@
+"""Serving-path latency: the shape-bucketed compiled inference engine
+(``mxnet_tpu/serving.py``) driven by a randomized variable-length request
+stream.
+
+Reports per-request p50/p99 latency, throughput, bucket hits/misses,
+compiled-program count, and the retrace count after warm-up — the PR-4
+acceptance bar is **0 steady-state retraces with the program count
+bounded by the bucket grid** (counter-based, so the lane is meaningful on
+any backend; the latency numbers additionally show the tunnel RTT win on
+chip).  A second phase fires the same stream from concurrent threads to
+exercise the micro-batcher (coalesced requests per dispatch).
+
+``--serve-only --json`` emits just the lane dict (bench.py's ``infer``
+lanes[] entry).  Like benchmark/eager_latency.py, the measured work runs
+in a SUBPROCESS so jit caches and config are clean.
+
+Usage: python benchmark/serving_latency.py [--json] [--serve-only]
+                                           [--requests N] [--threads T]
+"""
+import json
+import os
+import subprocess
+import sys
+
+_WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))) if "__file__" in dir() else "/root/repo")
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, serving
+from mxnet_tpu.gluon import nn
+
+N_REQ = int(os.environ.get("SERVE_REQUESTS", "64"))
+THREADS = int(os.environ.get("SERVE_THREADS", "4"))
+WIDTH = int(os.environ.get("SERVE_WIDTH", "64"))
+MAXLEN = int(os.environ.get("SERVE_MAXLEN", "32"))
+
+class Net(gluon.HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.d1 = nn.Dense(WIDTH, in_units=WIDTH, activation="relu")
+        self.d2 = nn.Dense(WIDTH, in_units=WIDTH, activation="relu")
+        self.out = nn.Dense(8, in_units=WIDTH)
+    def forward(self, x):
+        return self.out(self.d2(self.d1(x)))
+
+net = Net()
+net.initialize(mx.init.Xavier())
+rng = onp.random.RandomState(0)
+lengths = rng.randint(1, MAXLEN + 1, size=N_REQ).tolist()
+reqs = [mx.nd.array(rng.randn(n, WIDTH).astype(onp.float32))
+        for n in lengths]
+
+eng = serving.ServingEngine(net, max_delay_us=200)
+# warm every bucket the stream can hit (pow2 grid up to MAXLEN)
+b = 1
+while b <= MAXLEN:
+    eng.infer(mx.nd.array(rng.randn(b, WIDTH).astype(onp.float32)))
+    b <<= 1
+warm_traces = serving.trace_count()
+warm_progs = len(eng._programs)
+
+# phase 1: sequential stream (per-request latency, retrace bar)
+t0 = serving.trace_count(); d0 = serving.dispatch_count()
+h0 = serving.bucket_stats()
+t_start = time.perf_counter()
+outs = [eng.infer(r) for r in reqs]
+_ = float(outs[-1].asnumpy().ravel()[0])          # fence
+dt = time.perf_counter() - t_start
+seq = eng.stats()
+retraces = serving.trace_count() - t0
+h1 = serving.bucket_stats()
+
+# phase 2: concurrent stream (micro-batcher coalescing)
+import threading
+eng2 = serving.ServingEngine(net, max_delay_us=3000)
+for bb in (1, 2, 4, 8, 16, 32, 64):
+    if bb <= serving.BucketPolicy().bucket(MAXLEN * THREADS):
+        eng2.infer(mx.nd.array(rng.randn(bb, WIDTH).astype(onp.float32)))
+errs = []
+def fire(chunk):
+    try:
+        for r in chunk:
+            eng2.infer(r)
+    except BaseException as e:
+        errs.append(repr(e))
+threads = [threading.Thread(target=fire, args=(reqs[i::THREADS],))
+           for i in range(THREADS)]
+t2 = time.perf_counter()
+for t in threads: t.start()
+for t in threads: t.join()
+dt2 = time.perf_counter() - t2
+conc = eng2.stats()
+assert not errs, errs
+
+import jax
+print(json.dumps({
+    "platform": jax.default_backend(),
+    "requests": N_REQ,
+    "buckets": serving.BucketPolicy().spec,
+    "programs": seq["programs"],
+    "warm_traces": warm_traces,
+    "retraces_after_warm": retraces,
+    "bucket_hits": h1["hits"] - h0["hits"],
+    "bucket_misses": h1["misses"] - h0["misses"],
+    "dispatches": serving.dispatch_count() - d0,
+    "p50_us": seq["p50_us"],
+    "p99_us": seq["p99_us"],
+    "throughput_rps": N_REQ / dt,
+    "concurrent": {
+        "threads": THREADS,
+        "batches": conc["batches"],
+        "requests": conc["requests"],
+        "coalesced": conc["coalesced"],
+        "requests_per_dispatch": conc["requests"] / max(conc["batches"], 1),
+        "p99_us": conc["p99_us"],
+        "throughput_rps": conc["requests"] / dt2,
+    },
+}))
+eng.close(); eng2.close()
+"""
+
+
+def run_serving(requests: int = 64, threads: int = 4) -> dict:
+    env = dict(os.environ)
+    env["SERVE_REQUESTS"] = str(requests)
+    env["SERVE_THREADS"] = str(threads)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+    r = subprocess.run([sys.executable, "-u", "-c", _WORKER],
+                       capture_output=True, text=True, timeout=900, env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))) or ".")
+    if r.returncode != 0:
+        raise RuntimeError(f"serving lane failed:\n{r.stderr[-2000:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def main() -> None:
+    as_json = "--json" in sys.argv
+    requests = 64
+    if "--requests" in sys.argv:
+        requests = int(sys.argv[sys.argv.index("--requests") + 1])
+    threads = 4
+    if "--threads" in sys.argv:
+        threads = int(sys.argv[sys.argv.index("--threads") + 1])
+    lane = run_serving(requests, threads)
+    if as_json:
+        print(json.dumps({"serving": lane}))
+        return
+    print(f"serving latency ({lane['platform']}, {lane['requests']} "
+          f"variable-length requests, buckets={lane['buckets']})")
+    print(f"programs {lane['programs']} (warm traces "
+          f"{lane['warm_traces']}), retraces after warm "
+          f"{lane['retraces_after_warm']}, bucket "
+          f"{lane['bucket_hits']}h/{lane['bucket_misses']}m")
+    print(f"sequential: p50 {lane['p50_us']:.0f} us, p99 "
+          f"{lane['p99_us']:.0f} us, {lane['throughput_rps']:.1f} req/s")
+    c = lane["concurrent"]
+    print(f"concurrent ({c['threads']} threads): "
+          f"{c['requests_per_dispatch']:.1f} requests/dispatch "
+          f"({c['coalesced']} coalesced), p99 {c['p99_us']:.0f} us, "
+          f"{c['throughput_rps']:.1f} req/s")
+
+
+if __name__ == "__main__":
+    if "--serve-only" in sys.argv:
+        # bench.py's lanes[] entry point: the one serving lane
+        lane = run_serving()
+        print(json.dumps({"serving": lane}) if "--json" in sys.argv
+              else lane)
+    else:
+        main()

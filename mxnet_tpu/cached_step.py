@@ -108,7 +108,7 @@ class TrainStep:
     writes them as usual.
     """
 
-    def __init__(self, net, loss_fn: Callable, trainer):
+    def __init__(self, net, loss_fn: Callable, trainer, bucket: bool = False):
         self._net = net
         self._loss_fn = loss_fn
         self._trainer = trainer
@@ -118,6 +118,19 @@ class TrainStep:
         self.fallback_reason: Optional[str] = None
         # why the LAST call fell back (None when it ran compiled)
         self.last_fallback_reason: Optional[str] = None
+        # shape bucketing (serving.BucketPolicy, MXNET_SHAPE_BUCKETS),
+        # opt-in: variable-length batches pad up to the bucket grid so
+        # they stop blowing the shape-keyed program cache.  The loss must
+        # be PAD-SAFE (masked so zero rows contribute nothing — e.g. the
+        # DataLoader last_batch='pad' valid count turned into a mask);
+        # the first use of each bucket verifies the padded loss value
+        # bit-exact vs the unpadded one and REFUSES bucketing on mismatch
+        # (sticky, reason in bucket_refused) — numerics never change
+        # silently.
+        self._bucket = bool(bucket)
+        self.bucket_refused: Optional[str] = None
+        self._bucket_verified: set = set()
+        self.padded_steps = 0
 
     # -- public ----------------------------------------------------------
     @property
@@ -147,9 +160,10 @@ class TrainStep:
         indices = [tr._param2idx[id(p)] for p in tr._params
                    if p.grad_req != "null"]
         count_snap = (dict(opt._index_update_count), opt.num_update)
+        pargs = self._maybe_pad(args)
         opt._update_count(list(indices))
         try:
-            out = self._compiled_step(args, batch_size)
+            out = self._compiled_step(pargs, batch_size)
         except Exception as e:  # staging/trace failure -> sticky fallback
             opt._index_update_count.clear()
             opt._index_update_count.update(count_snap[0])
@@ -159,6 +173,86 @@ class TrainStep:
             return self._eager_step(args, batch_size)
         self.last_fallback_reason = None
         return out
+
+    # -- shape bucketing --------------------------------------------------
+    def _maybe_pad(self, args):
+        """Pad the batch axis of every input leaf up to its bucket
+        (``serving.BucketPolicy``) so variable-length batches share one
+        program per bucket.  Applies only with ``compile_step(...,
+        bucket=True)``; verified once per bucketed signature (the padded
+        loss must be bit-exact vs the unpadded loss — a pad-safe/masked
+        loss), refused sticky otherwise.  Returns the (possibly padded)
+        args; the eager fallback always sees the ORIGINAL args."""
+        if not self._bucket or self.bucket_refused is not None:
+            return args
+        try:
+            from . import serving as _serving
+            from .gluon import block as _gb
+            from .ndarray.ndarray import _wrap
+
+            policy = _serving.BucketPolicy()
+            if not policy.enabled:
+                return args
+            leaves, struct = _gb._flatten_args(args)
+            if not leaves or any(len(l.shape) < 1 for l in leaves):
+                return args
+            n = int(leaves[0].shape[0])
+            b = policy.bucket(n)
+            if b is None or b == n:
+                return args
+            key = (_gb._struct_key(struct), b,
+                   tuple((tuple(l.shape), str(l._data.dtype))
+                         for l in leaves))
+            pad = [_wrap(_serving.pad_axis0(l._data, b), l.ctx, type(l))
+                   if int(l.shape[0]) == n else l for l in leaves]
+            pargs = _gb._unflatten_args(struct, pad)
+            if _config.get("MXNET_SERVE_VERIFY") and \
+                    key not in self._bucket_verified:
+                reason = self._verify_pad(args, pargs)
+                if reason is not None:
+                    self.bucket_refused = reason
+                    return args
+                self._bucket_verified.add(key)
+            self.padded_steps += 1
+            return tuple(pargs)
+        except Exception as e:
+            self.bucket_refused = f"{type(e).__name__}: {e}"
+            return args
+
+    def _verify_pad(self, args, pargs) -> Optional[str]:
+        """One loss-only eager evaluation of both the true and the padded
+        batch (recording off, train mode, parameter buffers snapshotted
+        and restored so a mutating forward — BN batch stats — cannot
+        leak).  Equal loss values prove the loss masks pad rows; any
+        difference refuses bucketing BEFORE a single padded gradient is
+        applied."""
+        import numpy as onp
+
+        from .gluon import block as _gb
+
+        reps = [d for p in self._net.collect_params().values()
+                if p._data is not None for d in p._data]
+        snap = [(d, d._data, d._version) for d in reps]
+        try:
+            with autograd.pause(train_mode=True):
+                lt = self._loss_fn(self._net, *args)
+                lp = self._loss_fn(self._net, *pargs)
+        finally:
+            for d, old, ver in snap:
+                d._data = old
+                d._version = ver
+        lt_leaves, _ = _gb._flatten_output(lt)
+        lp_leaves, _ = _gb._flatten_output(lp)
+        if len(lt_leaves) != len(lp_leaves):
+            return "padded loss structure differs from unpadded"
+        for t, p in zip(lt_leaves, lp_leaves):
+            tn, pn = t.asnumpy(), p.asnumpy()
+            if tn.shape != pn.shape or not onp.array_equal(tn, pn):
+                return ("padded loss differs from unpadded — the loss is "
+                        "not pad-safe (mask pad rows, e.g. with the "
+                        "DataLoader last_batch='pad' valid count, or use "
+                        "a sum-style masked reduction)")
+        return None
 
     # -- eligibility / fallback ------------------------------------------
     def _eligibility(self) -> Optional[str]:

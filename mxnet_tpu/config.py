@@ -252,6 +252,45 @@ declare("MXNET_ELASTIC_BACKOFF", float, 0.0,
         "restore-and-resume restarts (capped at MXNET_RETRY_BACKOFF_MAX); "
         "0 = restart immediately", validator=lambda v: v >= 0,
         subsystem="faults", cached=False)
+declare("MXNET_SHAPE_BUCKETS", str, "pow2",
+        "Shape-bucket grid for padded compilation (serving.BucketPolicy): "
+        "'pow2' (default — round a dynamic axis up to the next power of "
+        "two), 'none' (exact shapes, bucketing off), or an explicit "
+        "ascending comma list '8,16,32,64' (a length above the largest "
+        "bucket falls back to the exact shape).  Used by ServingEngine "
+        "always; by Trainer.compile_step(bucket=True) and "
+        "hybridize(bucket=True) on opt-in.  Padded results are verified "
+        "bit-exact vs the unpadded eager path once per bucket and "
+        "bucketing is REFUSED (sticky, reason recorded) on mismatch.",
+        subsystem="serving", cached=False)
+declare("MXNET_SERVE_MAX_BATCH", int, 32,
+        "ServingEngine: max total rows one coalesced dispatch may carry; "
+        "concurrent infer() requests batch together up to this bound",
+        validator=lambda v: v >= 1, subsystem="serving", cached=False)
+declare("MXNET_SERVE_MAX_DELAY_US", int, 2000,
+        "ServingEngine: how long (microseconds) a dispatch may wait for "
+        "more requests to coalesce before flushing the batch; 0 = "
+        "dispatch immediately (no coalescing window)",
+        validator=lambda v: v >= 0, subsystem="serving", cached=False)
+declare("MXNET_SERVE_VERIFY", int, 1,
+        "ServingEngine / hybridize(bucket=True): verify the FIRST "
+        "padded/coalesced dispatch per program signature against the "
+        "unpadded eager forward.  1 = default: bit-exact passes, a "
+        "last-ulp kernel-rounding difference (XLA picks different gemm "
+        "micro-kernels per batch extent) is accepted and counted "
+        "(verify_ulp_accepts); anything larger — mean-style reductions "
+        "over a padded axis — refuses bucketing explicitly.  2 = "
+        "strict: bit-exact or refuse.  0 = trust padding without the "
+        "check.  Trainer.compile_step(bucket=True)'s loss-value verify "
+        "is ALWAYS strict (training numerics never drift).",
+        validator=lambda v: v in (0, 1, 2), subsystem="serving",
+        cached=False)
+declare("MXNET_FORWARD_CACHE", int, 32,
+        "Max compiled forward programs kept per HybridBlock / "
+        "ServingEngine (LRU over input signatures, the inference analog "
+        "of MXNET_COMPILED_STEP_CACHE); a new signature past the cap "
+        "evicts the oldest", validator=lambda v: v > 0,
+        subsystem="serving", cached=False)
 declare("MXNET_MODULE_SEED", int, None,
         "Override the per-test RNG seed for reproduction (reference test "
         "harness contract)", subsystem="testing")
@@ -278,7 +317,7 @@ declare("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
 # here for the generated docs; the post-import knobs go through config.get.
 declare("BENCH_MODEL", str, "all",
         "bench.py lane selection: 'all' (every lane into one JSON line) "
-        "or one of <zoo-name>[_bf16|_int8] | bert",
+        "or one of <zoo-name>[_bf16|_int8] | bert | train_step | infer",
         subsystem="bench")
 declare("BENCH_BATCH", int, None, "bench.py batch size override",
         subsystem="bench")

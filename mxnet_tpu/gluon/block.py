@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as onp
 
 from .. import autograd
+from .. import config as _config
 from .. import random as _random
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
@@ -506,8 +507,18 @@ class HybridBlock(Block):
         super().__init__()
         self._active = False
         self._flags: Dict[str, Any] = {}
-        # cache: (training, input treedef signature) -> compiled record
-        self._cached: Dict[Any, Tuple] = {}
+        # cache: (training, input treedef signature) -> compiled record,
+        # LRU-capped by MXNET_FORWARD_CACHE (shape-level programs live
+        # inside each record's jax.jit cache; the bucket policy is what
+        # bounds THOSE on variable-shape streams)
+        self._cached: "OrderedDict[Any, Tuple]" = OrderedDict()
+        # opt-in shape bucketing for the inference path
+        # (hybridize(bucket=True) + MXNET_SHAPE_BUCKETS): batch axis pads
+        # up to the bucket grid, outputs slice back, verified bit-exact
+        # once per bucket — refused (sticky, reason kept) on mismatch
+        self._bucket = False
+        self._bucket_refused: Optional[str] = None
+        self._bucket_verified: set = set()
         self._backend = None
         self._backend_flags: Dict[str, Any] = {}
         self._in_specs = None  # (struct, [(shape, dtype)]) from last call
@@ -518,9 +529,19 @@ class HybridBlock(Block):
         self._remat_policy = None
 
     def hybridize(self, active=True, backend=None, clear=True, remat=None,
-                  remat_policy=None, **kwargs):
+                  remat_policy=None, bucket=None, **kwargs):
         """Activate whole-graph compilation.  ``static_alloc``/``static_shape``
         are accepted for API parity; XLA's buffer assignment subsumes them.
+
+        ``bucket=True`` opts the INFERENCE path (not training, not
+        recording) into shape bucketing (``serving.BucketPolicy`` /
+        ``MXNET_SHAPE_BUCKETS``): the batch axis pads up to the bucket
+        grid so a variable-length stream compiles a bounded program set,
+        and outputs slice back to the true length.  The first call per
+        bucket is verified bit-exact against the unpadded eager forward;
+        a model whose outputs couple across the batch axis fails that
+        check and bucketing is refused (sticky,
+        ``self._bucket_refused``) — results stay correct either way.
 
         ``remat=True`` rematerializes the forward during backward
         (``jax.checkpoint``): activations are not kept alive between the
@@ -532,6 +553,8 @@ class HybridBlock(Block):
         MXNET_BACKWARD_DO_MIRROR env var."""
         if remat is not None:
             self._remat = bool(remat)
+        if bucket is not None:
+            self._bucket = bool(bucket)
         if remat_policy is not None:     # keep a previously-set policy
             import jax
 
@@ -550,7 +573,7 @@ class HybridBlock(Block):
         # accumulate in _flags but never leak into backend transforms)
         self._backend_flags = dict(kwargs) if backend is not None else {}
         if clear:
-            self._cached = {}
+            self._cached = OrderedDict()
         super().hybridize(active=False if active else active)
         # note: only the outermost hybridized block compiles; children run
         # inside its trace (the reference inlines children the same way).
@@ -593,6 +616,11 @@ class HybridBlock(Block):
             # keyword args become part of the static signature
             args = args + tuple(kwargs.values())
         training = autograd.is_training()
+        if (self._bucket and not training and not autograd.is_recording()
+                and self._bucket_refused is None):
+            out = self._call_bucketed(args)
+            if out is not _NO_BUCKET:
+                return out
         in_leaves, in_struct = _flatten_args(args)
         from ..ndarray import ndarray as _ndmod
 
@@ -613,6 +641,11 @@ class HybridBlock(Block):
         if rec is None:
             rec = self._build_cache(in_struct, training, ctx, out_cls)
             self._cached[sig] = rec
+            cap = _config.get("MXNET_FORWARD_CACHE")
+            while len(self._cached) > cap:
+                self._cached.popitem(last=False)
+        else:
+            self._cached.move_to_end(sig)
         jitted, names, params, ctx_idx, out_struct, mutated_names = rec
         param_arrays = [params[n]._data[_ctx_index(params[n], ctx)]._data
                         for n in names]
@@ -666,6 +699,70 @@ class HybridBlock(Block):
         for n, v in zip(mutated_names, mut_vals):
             params[n]._data[_ctx_index(params[n], ctx)]._set_data(v)
         return _rebuild_output(out_struct[0], out_nd)
+
+    def _call_bucketed(self, args):
+        """Shape-bucketed inference dispatch (hybridize(bucket=True)):
+        pad the batch axis to its bucket, run the padded program, slice
+        outputs back — a variable-length stream then compiles one
+        program per bucket instead of one per length.  The first call
+        per bucketed signature is verified bit-exact against the
+        unpadded eager forward; mismatch (outputs coupling across the
+        batch axis) refuses bucketing for this block, sticky, and the
+        verified-correct eager result is returned.  Returns
+        ``_NO_BUCKET`` when padding does not apply (exact fit, policy
+        off, no common batch axis)."""
+        from .. import serving as _serving
+
+        policy = _serving.BucketPolicy()
+        if not policy.enabled:
+            return _NO_BUCKET
+        leaves, struct = _flatten_args(args)
+        if not leaves or any(len(l.shape) < 1 for l in leaves):
+            return _NO_BUCKET
+        n = int(leaves[0].shape[0])
+        if any(int(l.shape[0]) != n for l in leaves):
+            return _NO_BUCKET
+        b = policy.bucket(n)
+        if b is None or b == n:
+            return _NO_BUCKET
+        padded = [_wrap(_serving.pad_axis0(l._data, b), l.ctx, type(l))
+                  for l in leaves]
+        out = self._call_cached(*_unflatten_args(struct, padded))
+        out_leaves, out_struct = _flatten_output(out)
+        if any(len(o.shape) < 1 or int(o.shape[0]) != b
+               for o in out_leaves):
+            self._bucket_refused = (
+                "output does not carry the batch axis — cannot slice "
+                "padded rows back")
+            with autograd.pause():
+                return self.forward(*args)
+        sliced = [_wrap(o._data[:n], o.ctx, type(o)) for o in out_leaves]
+        result = _rebuild_output(out_struct, sliced)
+        key = (_struct_key(struct), b,
+               tuple((tuple(l.shape), str(l._data.dtype)) for l in leaves))
+        verify = int(_config.get("MXNET_SERVE_VERIFY"))
+        if verify and key not in self._bucket_verified:
+            with autograd.pause():
+                ref = self.forward(*args)
+            ref_leaves, _ = _flatten_output(ref)
+            for g, r in zip(sliced, ref_leaves):
+                gn, rn = g.asnumpy(), r.asnumpy()
+                if gn.shape == rn.shape and onp.array_equal(gn, rn):
+                    continue
+                # last-ulp kernel rounding is accepted at the default
+                # level (same compiled-vs-eager property as hybridize);
+                # real cross-batch coupling lands far outside and
+                # refuses; MXNET_SERVE_VERIFY=2 refuses both
+                if gn.shape == rn.shape and verify < 2 and \
+                        onp.allclose(gn, rn, rtol=1e-5, atol=1e-6):
+                    continue
+                self._bucket_refused = (
+                    "padded+sliced forward not bit-exact vs unpadded "
+                    "eager (outputs couple across the batch axis) — "
+                    "bucketing refused for this block")
+                return ref
+            self._bucket_verified.add(key)
+        return result
 
     def _build_cache(self, in_struct, training, ctx=None, flavor=None):
         wrap_ctx = ctx or current_context()
@@ -861,6 +958,10 @@ class SymbolBlock(HybridBlock):
             args = sym.list_arguments()
             input_names = [a for a in args if a not in params]
         return SymbolBlock(sym, input_names, params, ctx=ctx)
+
+
+# sentinel: _call_bucketed declined (exact fit / policy off / no batch axis)
+_NO_BUCKET = object()
 
 
 # ---------------------------------------------------------------------------

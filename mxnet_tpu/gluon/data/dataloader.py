@@ -122,6 +122,16 @@ class DataLoader:
         self._thread_pool = thread_pool
         self._timeout = timeout
 
+        # last_batch='pad': the final partial batch is padded to a FULL
+        # batch_size by cycling its own samples, so every batch of every
+        # epoch has the same shape — the compiled train step
+        # (cached_step.TrainStep) stops paying a one-off retrace for the
+        # epoch tail.  The true sample count is exposed per batch via
+        # ``last_batch_valid`` (the reference io.DataBatch.pad contract)
+        # so a masked loss can zero the repeated rows.
+        self._pad_last = last_batch == "pad"
+        self._batch_size = batch_size
+        self._last_valid: Optional[int] = None
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError(
@@ -131,8 +141,13 @@ class DataLoader:
                     else SequentialSampler(len(dataset))
             elif shuffle:
                 raise ValueError("shuffle and sampler are mutually exclusive")
-            batch_sampler = BatchSampler(sampler, batch_size,
-                                         last_batch or "keep")
+            batch_sampler = BatchSampler(
+                sampler, batch_size,
+                "keep" if self._pad_last else (last_batch or "keep"))
+        elif self._pad_last:
+            raise ValueError(
+                "last_batch='pad' needs batch_size (it is mutually "
+                "exclusive with batch_sampler)")
         elif (batch_size is not None or shuffle or sampler is not None or
               last_batch is not None):
             raise ValueError(
@@ -172,9 +187,26 @@ class DataLoader:
     def __len__(self):
         return len(self._batch_sampler)
 
+    @property
+    def last_batch_valid(self) -> Optional[int]:
+        """True (un-padded) sample count of the most recently yielded
+        batch — ``batch_size`` everywhere except a final batch padded by
+        ``last_batch='pad'`` (the reference ``io.DataBatch.pad`` analog).
+        ``None`` before the first batch."""
+        return self._last_valid
+
+    def _pad_samples(self, samples):
+        """last_batch='pad': fill a partial sample list to a full batch
+        by cycling its own indices (deterministic, same epoch data)."""
+        valid = len(samples)
+        if self._pad_last and valid < self._batch_size:
+            samples = [samples[i % valid] for i in range(self._batch_size)]
+        return samples, valid
+
     def __iter__(self):
         if self._num_workers == 0:
             for samples in self._batch_sampler:
+                samples, self._last_valid = self._pad_samples(samples)
                 yield self._wrap(self._transform_batch(self._batchify_fn(
                     [self._dataset[i] for i in samples])))
             return
@@ -195,20 +227,29 @@ class DataLoader:
             return self._pool.apply_async(
                 _worker_fn, (samples, self._batchify_fn))
 
+        def _draw():
+            samples = next(it, None)
+            if samples is None:
+                return None
+            samples, valid = self._pad_samples(samples)
+            return [_submit(samples), samples, next_idx, 0, valid]
+
         try:
             for _ in range(self._prefetch or 1):
-                samples = next(it, None)
-                if samples is None:
+                entry = _draw()
+                if entry is None:
                     break
-                pending.append([_submit(samples), samples, next_idx, 0])
+                pending.append(entry)
                 next_idx += 1
             while pending:
                 batch = self._fetch(pending[0], pending, _submit, retries)
+                valid = pending[0][4]
                 pending.popleft()
-                samples = next(it, None)
-                if samples is not None:
-                    pending.append([_submit(samples), samples, next_idx, 0])
+                entry = _draw()
+                if entry is not None:
+                    pending.append(entry)
                     next_idx += 1
+                self._last_valid = valid
                 yield self._wrap(self._transform_batch(batch))
         except KeyboardInterrupt:
             self._shutdown()
@@ -225,7 +266,7 @@ class DataLoader:
         when a worker died — then raises :class:`DataLoaderWorkerError`
         carrying the batch index, worker id, and original error."""
         while True:
-            handle, samples, bidx, attempts = entry
+            handle, samples, bidx, attempts = entry[:4]
             pool_died = False
             worker = "thread" if self._thread_pool else "unknown"
             orig: Optional[BaseException] = None
@@ -301,7 +342,9 @@ class DataLoader:
         return array(batch)
 
     def _shutdown(self):
-        if self._pool is not None:
+        # getattr: __del__ may run on a loader whose __init__ raised
+        # before the pool attribute existed
+        if getattr(self, "_pool", None) is not None:
             if self._thread_pool:
                 self._pool.shutdown(wait=False)
             else:

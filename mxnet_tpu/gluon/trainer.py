@@ -164,7 +164,7 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
-    def compile_step(self, net, loss_fn):
+    def compile_step(self, net, loss_fn, bucket=False):
         """Compile forward + backward + gradient reduce + fused optimizer
         update (+ AMP gate) into ONE donated XLA program — the CachedOp
         analog for training (``cached_step.TrainStep``).  ``loss_fn(net,
@@ -174,10 +174,16 @@ class Trainer:
         grad_req='add', multi-worker stores, server-side updates,
         optimizers without a fused_update rule, or
         ``MXNET_COMPILED_STEP=0``) fall back to the eager tape
-        transparently."""
+        transparently.
+
+        ``bucket=True`` pads variable-length batches up to the
+        ``MXNET_SHAPE_BUCKETS`` grid (``serving.BucketPolicy``) so they
+        stop blowing the shape-keyed program cache; requires a PAD-SAFE
+        (masked) loss — verified once per bucket, refused sticky
+        otherwise (``step.bucket_refused``)."""
         from ..cached_step import TrainStep
 
-        return TrainStep(net, loss_fn, self)
+        return TrainStep(net, loss_fn, self, bucket=bucket)
 
     # -- the step --------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""CI gate: the compiled train step must stay inside its dispatch budget.
+"""CI gate: the compiled train step AND the serving path must stay
+inside their dispatch budgets.
 
 Runs a tiny MLP under both step modes and FAILS (exit 1) if the compiled
 mode exceeds the documented budget — guarding against silent de-fusion
@@ -14,8 +15,15 @@ re-trace, a group program splitting off the whole-step program):
 - eager mode (comparison lane, printed, not gated): the tape path's
   dispatches/step.
 
-Invoked by the test suite (tests/test_cached_step.py) exactly like
-tools/check_fault_sites.py, and runnable standalone:
+The INFERENCE gate (PR 4, docs/PERF.md "Serving") drives a
+``serving.ServingEngine`` over a randomized variable-length request
+stream after warming every bucket: exactly ``1`` compiled launch per
+dispatched batch, ``0`` re-traces, and the compiled-program count
+bounded by the bucket grid.
+
+Invoked by the test suite (tests/test_cached_step.py /
+tests/test_serving.py) exactly like tools/check_fault_sites.py, and
+runnable standalone:
 ``JAX_PLATFORMS=cpu python tools/check_dispatch_budget.py``.
 """
 from __future__ import annotations
@@ -28,7 +36,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # the budget the docs promise (docs/PERF.md "Compiled whole-train-step")
 BUDGET = {"compiled_launches_per_step": 1, "eager_invokes_per_step": 0,
           "group_launches_per_step": 0, "retraces_after_warm": 0}
+# the serving budget (docs/PERF.md "Serving: shape buckets + dynamic
+# batching"): steady state over a variable-length stream
+INFER_BUDGET = {"launches_per_batch": 1, "retraces_after_warm": 0,
+                "programs_over_buckets": 0}
 STEPS = 5
+INFER_REQUESTS = 24
+INFER_MAXLEN = 16
 
 
 def _build(seed: int = 0):
@@ -104,6 +118,48 @@ def _measure(compiled: bool) -> dict:
     return out
 
 
+def _measure_infer() -> dict:
+    """Variable-length request stream through the serving engine: warm
+    every bucket the stream can hit, then count launches/retraces over a
+    randomized stream (the steady-state contract)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    net, _trainer, _loss_fn, _d, _l = _build(seed=1)
+    policy = serving.BucketPolicy()
+    eng = serving.ServingEngine(net, max_delay_us=200, policy=policy)
+    buckets = set()
+    n = 1
+    while n <= INFER_MAXLEN:
+        b = policy.bucket(n)
+        if b is not None and b not in buckets:
+            buckets.add(b)
+            eng.infer(mx.nd.array(onp.zeros((b, 8), onp.float32)))
+        n += 1
+    rng = onp.random.RandomState(7)
+    t0, d0 = serving.trace_count(), serving.dispatch_count()
+    lengths = rng.randint(1, INFER_MAXLEN + 1, size=INFER_REQUESTS)
+    for ln in lengths:
+        out = eng.infer(mx.nd.array(rng.randn(int(ln), 8)))
+        assert out.shape[0] == int(ln)
+    batches = eng.stats()["batches"] - len(buckets)
+    out = {
+        "mode": "serving",
+        "bucket_refused": eng.bucket_refused,
+        "requests": INFER_REQUESTS,
+        "launches_per_batch":
+            (serving.dispatch_count() - d0) / max(batches, 1),
+        "retraces_after_warm": serving.trace_count() - t0,
+        "programs_over_buckets": max(0, len(eng._programs) - len(buckets)),
+        "programs": len(eng._programs),
+        "buckets": len(buckets),
+    }
+    eng.close()
+    return out
+
+
 def main() -> int:
     compiled = _measure(True)
     eager = _measure(False)
@@ -115,6 +171,11 @@ def main() -> int:
               f"{row['eager_invokes_per_step']:>10.1f} "
               f"{row['group_launches_per_step']:>6.1f} "
               f"{row['retraces_after_warm']:>8d}")
+    infer = _measure_infer()
+    print(f"{'serving':<10} requests {infer['requests']} -> "
+          f"{infer['launches_per_batch']:.1f} launches/batch, "
+          f"{infer['retraces_after_warm']} retraces, "
+          f"{infer['programs']} programs over {infer['buckets']} buckets")
     failures = []
     if not compiled["used_compiled"]:
         failures.append("compiled mode fell back to the eager tape")
@@ -122,6 +183,13 @@ def main() -> int:
         if compiled[key] > budget:
             failures.append(
                 f"{key} = {compiled[key]} exceeds budget {budget}")
+    if infer["bucket_refused"] is not None:
+        failures.append(
+            f"serving refused bucketing: {infer['bucket_refused']}")
+    for key, budget in INFER_BUDGET.items():
+        if infer[key] > budget:
+            failures.append(
+                f"serving {key} = {infer[key]} exceeds budget {budget}")
     if failures:
         print("check_dispatch_budget: FAILED —", "; ".join(failures),
               file=sys.stderr)
@@ -129,7 +197,10 @@ def main() -> int:
     print(f"check_dispatch_budget: compiled step within budget "
           f"({compiled['dispatches_per_step']:.0f} dispatch/step over "
           f"{STEPS} steps; eager tape pays "
-          f"{eager['dispatches_per_step']:.0f})")
+          f"{eager['dispatches_per_step']:.0f}); serving within budget "
+          f"({infer['launches_per_batch']:.0f} launch/batch, "
+          f"{infer['retraces_after_warm']} retraces, "
+          f"{infer['programs']} programs <= {infer['buckets']} buckets)")
     return 0
 
 

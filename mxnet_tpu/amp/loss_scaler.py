@@ -41,6 +41,21 @@ class LossScaler:
             return False
         return not bool(_fused.all_finite(arrays))
 
+    def branch_scales(self):
+        """Preview ``(scale_if_clean, scale_if_overflow)`` — the scale
+        the NEXT step would use under each verdict of the still-unread
+        all-finite flag.  The deferred AMP gate (cached_step.TrainStep,
+        MXNET_AMP_LAG) dispatches speculatively with BOTH candidates and
+        lets the device select on the previous step's flag, so the host
+        read lags one step while numerics stay bit-exact vs the
+        synchronous gate.  Pure: mirrors :meth:`update_scale` without
+        mutating state."""
+        if self._unskipped + 1 >= self._scale_window:
+            clean = self.loss_scale * self._scale_factor
+        else:
+            clean = self.loss_scale
+        return clean, max(1.0, self.loss_scale / self._scale_factor)
+
     def update_scale(self, overflow: bool):
         if overflow:
             self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)

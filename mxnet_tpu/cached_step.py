@@ -45,12 +45,13 @@ import jax.numpy as jnp
 
 from . import autograd
 from . import config as _config
+from . import engine as _engine
 from . import faults as _faults
 from . import random as _random
 from .context import current_context
 
 __all__ = ["TrainStep", "enabled", "trace_count", "dispatch_count",
-           "cache_stats", "reset_counters"]
+           "cache_stats", "deferred_read_count", "reset_counters"]
 
 # observability, mirroring optimizer/fused.py: _TRACE_COUNT bumps when a
 # whole-step program body is (re)traced, _DISPATCH_COUNT per compiled
@@ -61,6 +62,7 @@ _TRACE_COUNT = 0
 _DISPATCH_COUNT = 0
 _CACHE_HITS = 0
 _CACHE_MISSES = 0
+_DEFERRED_READ_COUNT = 0
 
 
 def trace_count() -> int:
@@ -75,12 +77,22 @@ def cache_stats() -> Dict[str, int]:
     return {"hits": _CACHE_HITS, "misses": _CACHE_MISSES}
 
 
+def deferred_read_count() -> int:
+    """Host reads of a LAGGED all-finite flag (the deferred AMP gate,
+    MXNET_AMP_LAG): each is a read of step N-1's flag performed while
+    step N is already in flight, so it never blocks on the current
+    program."""
+    return _DEFERRED_READ_COUNT
+
+
 def reset_counters() -> None:
-    global _TRACE_COUNT, _DISPATCH_COUNT, _CACHE_HITS, _CACHE_MISSES
+    global _TRACE_COUNT, _DISPATCH_COUNT, _CACHE_HITS, _CACHE_MISSES, \
+        _DEFERRED_READ_COUNT
     _TRACE_COUNT = 0
     _DISPATCH_COUNT = 0
     _CACHE_HITS = 0
     _CACHE_MISSES = 0
+    _DEFERRED_READ_COUNT = 0
 
 
 def enabled() -> bool:
@@ -131,11 +143,37 @@ class TrainStep:
         self.bucket_refused: Optional[str] = None
         self._bucket_verified: set = set()
         self.padded_steps = 0
+        # deferred AMP gate (MXNET_AMP_LAG): the previous step's device
+        # all-finite flag, not yet read on host.  The NEXT dispatch
+        # carries both scale candidates and selects on this flag
+        # on-device; the host read then happens while that dispatch is
+        # in flight.  engine.waitall() drains it via drain().
+        self._pending_ok = None
+        _engine.register_drainable(self)
 
     # -- public ----------------------------------------------------------
     @property
     def last_step_compiled(self) -> bool:
         return self.last_fallback_reason is None
+
+    def drain(self) -> None:
+        """Read the pending deferred AMP flag (if any) and apply the
+        loss-scale policy, catching the host scaler state up to the
+        device.  Called by ``engine.waitall()``, before any eager-tape
+        fallback, and whenever the lag window closes (MXNET_AMP_LAG=0 /
+        NaiveEngine) — after drain() the scaler state equals the
+        synchronous gate's bit-exactly."""
+        global _DEFERRED_READ_COUNT
+        prev, self._pending_ok = self._pending_ok, None
+        if prev is None:
+            return
+        from .ndarray import ndarray as _ndmod
+
+        _ndmod.count_host_sync()
+        _DEFERRED_READ_COUNT += 1
+        scaler = getattr(self._trainer, "_amp_loss_scaler", None)
+        if scaler is not None:
+            scaler.update_scale(not bool(prev))
 
     def __call__(self, *args, batch_size: Optional[int] = None):
         # train-step injection site (fail-fast like trainer.step: a step
@@ -284,6 +322,10 @@ class TrainStep:
     def _eager_step(self, args, batch_size):
         """The eager tape path, AMP-equivalent to amp.scale_loss +
         backward + trainer.step."""
+        # a pending deferred flag must land first: the eager step reads
+        # scaler.loss_scale synchronously, so the host state has to be
+        # caught up to the device before this step's scale is chosen
+        self.drain()
         tr = self._trainer
         scaler = getattr(tr, "_amp_loss_scaler", None)
         with autograd.record():
@@ -380,12 +422,31 @@ class TrainStep:
         counts = [opt._index_update_count[i] for i in indices]
         lrs = opt._get_lrs(list(indices))
         wds = opt._get_wds(list(indices))
-        scale_val = scaler.loss_scale if scaler is not None else 1.0
+        # deferred AMP gate (MXNET_AMP_LAG): while a previous step's
+        # all-finite flag is unread, this step dispatches speculatively
+        # with BOTH scale candidates — the clean-branch scale and the
+        # overflow-branch scale, each computed by the SAME host policy
+        # the synchronous gate runs — and the program selects on the
+        # device flag.  Numerics are bit-exact vs the synchronous gate
+        # because the selected candidate IS the value sync would pass.
+        lag = _engine.amp_lag() if scaler is not None else 0
+        if not lag:
+            self.drain()          # lag window closed: catch up first
+        if scaler is not None and lag and self._pending_ok is not None:
+            s_clean, s_over = scaler.branch_scales()
+        elif scaler is not None:
+            s_clean = s_over = scaler.loss_scale
+        else:
+            s_clean = s_over = 1.0
+        scale_val = s_clean
         if scaler is not None:
             tr._amp_original_scale = getattr(
                 tr, "_amp_original_scale", tr._scale)
         base = getattr(tr, "_amp_original_scale", tr._scale)
         rescale = base / (scale_val * batch_size)
+        rescale_alt = base / (s_over * batch_size)
+        prev_ok = self._pending_ok if self._pending_ok is not None \
+            else jnp.asarray(True)
         lrs_g = [jnp.asarray([lrs[i] for i in m], jnp.float32)
                  for _mp, m in group_layout]
         wds_g = [jnp.asarray([wds[i] for i in m], jnp.float32)
@@ -402,7 +463,10 @@ class TrainStep:
             w_args, s_args, frozen_args, in_args, _random.next_key(),
             lrs_g, wds_g, counts_g,
             jnp.asarray(rescale, jnp.float32),
-            jnp.asarray(scale_val, jnp.float32))
+            jnp.asarray(scale_val, jnp.float32),
+            jnp.asarray(s_over, jnp.float32),
+            jnp.asarray(rescale_alt, jnp.float32),
+            prev_ok)
         _DISPATCH_COUNT += 1
 
         for p, nw in zip(trainable, new_w):
@@ -421,9 +485,23 @@ class TrainStep:
         out_nd = [_ndmod._wrap(o, ctx, flavor) for o in out_raw]
         loss = _gb._rebuild_output(out_struct[0], out_nd)
         if scaler is not None:
-            # the ONE host read of the step: the device all-finite flag
-            # drives the loss-scale policy
-            scaler.update_scale(not bool(ok))
+            if lag:
+                # deferred gate: hold THIS step's flag, read the
+                # PREVIOUS one (already materialized — its program
+                # finished while this step was being prepared, so the
+                # read is lagged, never a stall on the current program)
+                global _DEFERRED_READ_COUNT
+                prev = self._pending_ok
+                self._pending_ok = ok
+                if prev is not None:
+                    _ndmod.count_host_sync()
+                    _DEFERRED_READ_COUNT += 1
+                    scaler.update_scale(not bool(prev))
+            else:
+                # the ONE host read of the step: the device all-finite
+                # flag drives the loss-scale policy synchronously
+                _ndmod.count_host_sync()
+                scaler.update_scale(not bool(ok))
         return loss
 
     def _build_program(self, params, names, in_struct, ctx, flavor,
@@ -442,9 +520,19 @@ class TrainStep:
         frozen_pos = {n: j for j, n in enumerate(frozen_names)}
 
         def step_fn(w_list, s_list, frozen_list, in_list, rng_key,
-                    lrs_g, wds_g, counts_g, rescale, scale):
+                    lrs_g, wds_g, counts_g, rescale, scale,
+                    scale_alt, rescale_alt, prev_ok):
             global _TRACE_COUNT
             _TRACE_COUNT += 1
+            # deferred AMP gate: the previous step's flag selects which
+            # speculative scale candidate this step really runs with —
+            # prev_ok=True (the synchronous gate, or a clean previous
+            # step) selects the primary pair bit-exactly via where()
+            if has_ok:
+                scale_eff = jnp.where(prev_ok, scale, scale_alt)
+                rescale_eff = jnp.where(prev_ok, rescale, rescale_alt)
+            else:
+                scale_eff, rescale_eff = scale, rescale
 
             def fwd(w_l):
                 full = [w_l[slot_of_name[n]] if n in slot_of_name
@@ -453,7 +541,7 @@ class TrainStep:
                 # the loss-scale multiply sits INSIDE the differentiated
                 # region so grads come out scaled, exactly like backward
                 # on amp.scale_loss's scaled loss
-                heads = [o * scale for o in outs] if has_ok else outs
+                heads = [o * scale_eff for o in outs] if has_ok else outs
                 return heads, (outs, muts)
 
             heads, vjp_fn, (outs, muts) = jax.vjp(
@@ -478,7 +566,7 @@ class TrainStep:
                     [w_list[i] for i in members],
                     [grads[i] for i in members],
                     [s_list[i] for i in members],
-                    lrs_g[gi], wds_g[gi], counts_g[gi], rescale, ok)
+                    lrs_g[gi], wds_g[gi], counts_g[gi], rescale_eff, ok)
                 for j, i in enumerate(members):
                     new_w[i] = nw[j]
                     new_s[i] = ns[j]

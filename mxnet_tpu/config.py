@@ -150,6 +150,37 @@ declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
         "Arrays larger than this many elements get their own dist push "
         "bucket (reference kvstore_dist big-array splitting)",
         validator=lambda v: v > 0, subsystem="kvstore")
+declare("MXNET_ENGINE_PREFETCH", int, 2,
+        "Async pipeline engine: device-prefetch depth — how many batches "
+        "a DevicePrefetcher transfer thread stages into HBM ahead of the "
+        "consuming step (engine.prefetch / DataLoader(device_prefetch=)). "
+        "0 disables the stage (synchronous per-batch device_put); "
+        "MXNET_ENGINE_TYPE=NaiveEngine forces 0.",
+        validator=lambda v: v >= 0, subsystem="engine", cached=False)
+declare("MXNET_AMP_LAG", int, 1,
+        "Deferred AMP gate lag window (cached_step.TrainStep): 1 = read "
+        "step N-1's all-finite flag while dispatching step N — the step "
+        "dispatches speculatively with both scale candidates and the "
+        "device selects via the previous flag, so the read never blocks "
+        "on the current program and numerics stay bit-exact vs the "
+        "synchronous gate.  0 = synchronous read (the PR-3 behavior); "
+        "values > 1 clamp to 1 (one unread flag is the whole speculation "
+        "budget).  MXNET_ENGINE_TYPE=NaiveEngine forces 0.",
+        validator=lambda v: v >= 0, subsystem="engine", cached=False)
+declare("MXNET_METRIC_DEVICE", int, 1,
+        "Device-side metric accumulators: EvalMetric.update on device "
+        "NDArrays enqueues a compiled accumulate (no per-batch host "
+        "sync); the host read happens at .get()/engine.waitall() or "
+        "every MXNET_METRIC_SYNC_STEPS updates.  0 = host accumulation "
+        "everywhere (each update counted in metric.host_sync_count); "
+        "MXNET_ENGINE_TYPE=NaiveEngine forces 0.",
+        subsystem="engine", cached=False)
+declare("MXNET_METRIC_SYNC_STEPS", int, 50,
+        "Device-side metric accumulators: fold the device scalars into "
+        "the host sums every N update() calls — bounds both the async "
+        "queue the accumulator keeps in flight and f32 accumulation "
+        "error", validator=lambda v: v >= 1, subsystem="engine",
+        cached=False)
 declare("MXNET_ENFORCE_DETERMINISM", bool, False,
         "Disable nondeterministic optimizations (XLA autotuning picks "
         "deterministic kernels)", subsystem="engine")
@@ -317,7 +348,8 @@ declare("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
 # here for the generated docs; the post-import knobs go through config.get.
 declare("BENCH_MODEL", str, "all",
         "bench.py lane selection: 'all' (every lane into one JSON line) "
-        "or one of <zoo-name>[_bf16|_int8] | bert | train_step | infer",
+        "or one of <zoo-name>[_bf16|_int8] | bert | train_step | infer "
+        "| pipeline",
         subsystem="bench")
 declare("BENCH_BATCH", int, None, "bench.py batch size override",
         subsystem="bench")
@@ -350,9 +382,17 @@ declare("BENCH_TIMEOUT", float, 2700.0,
         "completed lanes after this many seconds and kill the bench",
         subsystem="bench")
 declare("BENCH_PROBE_RETRIES", int, 3,
-        "bench.py: device-probe attempts (120s recovery wait between) "
-        "before the CPU fallback", validator=lambda v: v >= 1,
-        subsystem="bench")
+        "bench.py: legacy alias for MXNET_BENCH_PROBE_RETRIES",
+        validator=lambda v: v >= 1, subsystem="bench")
+declare("MXNET_BENCH_PROBE_RETRIES", int, 3,
+        "bench.py: attempts per device-backend subprocess probe (read "
+        "raw pre-import); a transient tunnel stall retries with "
+        "exponential backoff instead of condemning the lane to CPU",
+        validator=lambda v: v >= 1, subsystem="bench")
+declare("MXNET_BENCH_PROBE_BACKOFF", float, 5.0,
+        "bench.py: base delay (s) of the probe retry backoff "
+        "min(b * 2**(attempt-1), 60); read raw pre-import",
+        validator=lambda v: v >= 0, subsystem="bench")
 declare("BENCH_PARTIAL_PATH", str, None,
         "bench.py: override for the side file where completed lanes "
         "persist for the watchdog process", subsystem="bench")

@@ -5,6 +5,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from .... import autograd
+from .... import engine as _engine
 from ....metric import EvalMetric, Loss as LossMetric
 from ...trainer import Trainer
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
@@ -63,17 +64,37 @@ class Estimator:
         self.batch_processor = batch_processor or BatchProcessor()
         self.batch_axis = 0
 
+    # -- pipeline --------------------------------------------------------
+    @staticmethod
+    def _pipelined(data):
+        """Route an epoch's batch stream through the engine's device
+        prefetch stage (depth MXNET_ENGINE_PREFETCH) unless the loader
+        already prefetches to device or the engine is naive/depth-0.
+        Returns (iterable, closer)."""
+        if _engine.prefetch_depth() < 1 or \
+                getattr(data, "_device_prefetch", False) or \
+                isinstance(data, _engine.DevicePrefetcher):
+            return data, None
+        pf = _engine.prefetch(data)
+        return pf, getattr(pf, "close", None)
+
     # -- evaluation ------------------------------------------------------
     def evaluate(self, val_data=None, batch_axis=0):
         for m in self.val_metrics:
             m.reset()
         self.val_loss_metric.reset()
-        for batch in val_data:
-            _, labels, preds, losses = self.batch_processor.evaluate_batch(
-                self, batch, batch_axis)
-            for m in self.val_metrics:
-                m.update(labels, preds)
-            self.val_loss_metric.update(0, losses)
+        it, closer = self._pipelined(val_data)
+        try:
+            for batch in it:
+                _, labels, preds, losses = \
+                    self.batch_processor.evaluate_batch(
+                        self, batch, batch_axis)
+                for m in self.val_metrics:
+                    m.update(labels, preds)
+                self.val_loss_metric.update(0, losses)
+        finally:
+            if closer is not None:
+                closer()
         return {m.get()[0]: m.get()[1]
                 for m in self.val_metrics + [self.val_loss_metric]}
 
@@ -98,21 +119,29 @@ class Estimator:
                 h.epoch_begin(self)
             ran_any = False
             stopped_mid_epoch = False
-            for batch in train_data:
-                ran_any = True
-                for h in batch_begin:
-                    h.batch_begin(self, batch=batch)
-                _, labels, preds, losses = self.batch_processor.fit_batch(
-                    self, batch, batch_axis)
-                # the optimizer step itself runs as the highest-priority
-                # batch_end handler (GradientUpdateHandler)
-                for h in batch_end:
-                    if h.batch_end(self, batch=batch, pred=preds,
-                                   label=labels, loss=losses):
-                        stop = True
-                if stop:
-                    stopped_mid_epoch = True
-                    break
+            # per-epoch device prefetch: batch N+1 stages into HBM on
+            # the engine transfer thread while batch N trains
+            it, closer = self._pipelined(train_data)
+            try:
+                for batch in it:
+                    ran_any = True
+                    for h in batch_begin:
+                        h.batch_begin(self, batch=batch)
+                    _, labels, preds, losses = \
+                        self.batch_processor.fit_batch(
+                            self, batch, batch_axis)
+                    # the optimizer step itself runs as the highest-
+                    # priority batch_end handler (GradientUpdateHandler)
+                    for h in batch_end:
+                        if h.batch_end(self, batch=batch, pred=preds,
+                                       label=labels, loss=losses):
+                            stop = True
+                    if stop:
+                        stopped_mid_epoch = True
+                        break
+            finally:
+                if closer is not None:
+                    closer()
             if not ran_any:
                 raise RuntimeError(
                     "train_data yielded no batches — pass a re-iterable "
@@ -123,6 +152,10 @@ class Estimator:
             for h in epoch_end:
                 if h.epoch_end(self):
                     stop = True
+        # the pipeline's terminal barrier: deferred AMP flags, device
+        # metric accumulators, and queued checkpoint writes all land
+        # before the train_end handlers read final state
+        _engine.waitall()
         for h in train_end:
             h.train_end(self)
 

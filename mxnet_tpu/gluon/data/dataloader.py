@@ -107,7 +107,8 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=False, timeout=120):
+                 prefetch=None, thread_pool=False, timeout=120,
+                 device_prefetch=False):
         from .dataset import _CompiledTransformDataset
 
         # compiled batch-wise transform (dataset.transform(compiled=True)):
@@ -158,6 +159,13 @@ class DataLoader:
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
         self._batchify_fn = batchify_fn or default_batchify_fn
+        # device_prefetch: stage batch N+1 into HBM on an engine transfer
+        # thread while step N runs (engine.DevicePrefetcher, depth
+        # MXNET_ENGINE_PREFETCH or an explicit int) — the ThreadedEngine
+        # IO-prefetch stage.  False/0 (default) keeps the synchronous
+        # per-batch device_put; NaiveEngine forces it off.
+        self._device_prefetch = device_prefetch
+        self._prefetcher = None
         self._pool = None
         self._worker_pids: frozenset = frozenset()
         if self._num_workers > 0:
@@ -204,11 +212,46 @@ class DataLoader:
         return samples, valid
 
     def __iter__(self):
+        from ... import engine as _engine
+
+        src = self._host_iter()
+        if not self._device_prefetch or _engine.prefetch_depth() < 1:
+            # synchronous device staging (also the NaiveEngine escape
+            # hatch): one blocking _wrap per consumed batch
+            for batch, valid in src:
+                self._last_valid = valid
+                yield self._wrap(self._transform_batch(batch))
+            return
+        # device-prefetch stage: the compiled transform + HBM staging of
+        # batch N+1 run on the engine transfer thread while the consumer
+        # is still inside step N.  last_batch_valid updates at CONSUME
+        # time (the valid count rides the queue with its batch), so the
+        # pad contract is unchanged under a depth-k pipeline.
+        depth = self._device_prefetch \
+            if (isinstance(self._device_prefetch, int)
+                and not isinstance(self._device_prefetch, bool)) else None
+        pf = _engine.DevicePrefetcher(
+            src, depth=depth,
+            transfer=lambda item: (
+                self._wrap(self._transform_batch(item[0])), item[1]),
+            name="dataloader-prefetch")
+        self._prefetcher = pf
+        try:
+            for batch, valid in pf:
+                self._last_valid = valid
+                yield batch
+        finally:
+            pf.close()
+
+    def _host_iter(self):
+        """Yield ``(host_batch, valid_count)`` pairs — the worker-pool
+        fetch pipeline, without the device staging (the caller or the
+        device-prefetch transfer thread applies transform + _wrap)."""
         if self._num_workers == 0:
             for samples in self._batch_sampler:
-                samples, self._last_valid = self._pad_samples(samples)
-                yield self._wrap(self._transform_batch(self._batchify_fn(
-                    [self._dataset[i] for i in samples])))
+                samples, valid = self._pad_samples(samples)
+                yield (self._batchify_fn(
+                    [self._dataset[i] for i in samples]), valid)
             return
 
         # worker pools, pipeline depth self._prefetch.  Each pending entry
@@ -249,8 +292,7 @@ class DataLoader:
                 if entry is not None:
                     pending.append(entry)
                     next_idx += 1
-                self._last_valid = valid
-                yield self._wrap(self._transform_batch(batch))
+                yield (batch, valid)
         except KeyboardInterrupt:
             self._shutdown()
             raise

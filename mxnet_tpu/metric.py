@@ -1,8 +1,21 @@
 """Evaluation metrics (reference ``python/mxnet/gluon/metric.py``, 1,856 LoC).
 
-Metrics accumulate on host (they are O(batch) reductions reading back one
-scalar per update — keeping them out of the XLA graph avoids retrace churn
-and matches how the reference computes them on CPU from NDArray values).
+Accumulation is two-tier (the async pipeline engine, docs/PERF.md
+"Pipelined train loop"):
+
+- **device-side accumulators** (default, ``MXNET_METRIC_DEVICE=1``):
+  when ``update()`` receives device NDArrays and the metric has a device
+  kernel, the per-batch reduction runs as a compiled accumulate enqueued
+  on the XLA stream — NO per-batch host sync.  The host read happens
+  only at ``.get()`` / ``engine.waitall()`` or every
+  ``MXNET_METRIC_SYNC_STEPS`` updates (which also bounds f32
+  accumulation drift).
+- **host accumulation** for metrics without a device kernel (confusion-
+  matrix families, custom metrics) and under
+  ``MXNET_ENGINE_TYPE=NaiveEngine`` — every device->host read on this
+  path is counted LOUDLY in :func:`host_sync_count`, so a silent
+  per-batch ``float()`` sync in the train loop is observable instead of
+  a mystery stall.
 """
 from __future__ import annotations
 
@@ -20,7 +33,24 @@ __all__ = [
     "RMSE", "CrossEntropy", "NegativeLogLikelihood", "PearsonCorrelation",
     "PCC", "Loss", "CustomMetric", "MeanCosineSimilarity",
     "MeanPairwiseDistance", "np", "create", "check_label_shapes",
+    "host_sync_count", "reset_host_sync_count",
 ]
+
+# device->host reads performed by metric HOST paths (metrics bypassing
+# the device-accumulator path, or the path disabled): the loud fallback
+# counter — benchmark/pipeline_latency.py and the budget gate read it
+_HOST_SYNC_COUNT = 0
+
+
+def host_sync_count() -> int:
+    """Blocking per-update device->host reads by metrics that bypassed
+    the device accumulator path (no kernel / disabled / NaiveEngine)."""
+    return _HOST_SYNC_COUNT
+
+
+def reset_host_sync_count() -> None:
+    global _HOST_SYNC_COUNT
+    _HOST_SYNC_COUNT = 0
 
 _REGISTRY: Dict[str, type] = {}
 
@@ -73,6 +103,10 @@ def check_label_shapes(labels, preds, wrap=False, shape=False):
 
 def _host(x) -> onp.ndarray:
     if isinstance(x, NDArray):
+        # the loud fallback: every host-path sync on a device array is
+        # counted, never silent (metric.host_sync_count)
+        global _HOST_SYNC_COUNT
+        _HOST_SYNC_COUNT += 1
         return x.asnumpy()
     return onp.asarray(x)
 
@@ -80,12 +114,94 @@ def _host(x) -> onp.ndarray:
 class EvalMetric:
     """Base metric (reference metric.py EvalMetric)."""
 
+    # lazily-built jax.jit of _device_batch; class attr so reset() never
+    # drops the compiled kernel
+    _dev_fn = None
+
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
         self.output_names = output_names
         self.label_names = label_names
         self._kwargs = kwargs
         self.reset()
+
+    # -- device-side accumulation ---------------------------------------
+    def _device_batch(self, label, pred):
+        """Per-batch device kernel: (label, pred) jax arrays ->
+        ``(sum, count)`` scalars, numerically mirroring the host
+        ``update()``.  ``None`` (the base default) = host-only metric."""
+        return None
+
+    def _device_ok(self) -> bool:
+        if type(self)._device_batch is EvalMetric._device_batch:
+            return False
+        from . import config as _config
+        from . import engine as _engine
+
+        return (not _engine.is_naive()
+                and bool(_config.get("MXNET_METRIC_DEVICE")))
+
+    def _try_device_update(self, labels, preds) -> bool:
+        """Enqueue this batch's accumulate as compiled device work (no
+        host sync).  False -> caller runs the host path (counted in
+        :func:`host_sync_count`)."""
+        if not self._device_ok():
+            return False
+        import jax
+
+        try:
+            labels, preds = check_label_shapes(labels, preds, wrap=True)
+        except ValueError:
+            return False          # host path raises the documented error
+        pairs = []
+        for label, pred in zip(labels, preds):
+            if not (isinstance(label, NDArray) and isinstance(pred, NDArray)):
+                return False
+            if isinstance(label._data, jax.core.Tracer) or \
+                    isinstance(pred._data, jax.core.Tracer):
+                return False
+            pairs.append((label._data, pred._data))
+        try:
+            if type(self)._dev_fn is None:
+                type(self)._dev_fn = jax.jit(type(self)._device_batch,
+                                             static_argnums=(0,))
+            # compute every pair BEFORE touching the accumulator, so a
+            # trace failure on any pair leaves state clean for the host
+            # fallback (no half-applied batch)
+            batch = [type(self)._dev_fn(self, l, p) for l, p in pairs]
+        except Exception:
+            return False
+        # list append, NOT an eager device add: one compiled accumulate
+        # per batch is the whole per-update cost (a tiny jnp add would
+        # pay ~10x the kernel's dispatch overhead again)
+        self._dev_pairs.extend(batch)
+        self._dev_pending += 1
+        from . import engine as _engine
+
+        _engine.register_drainable(self)
+        from . import config as _config
+
+        if self._dev_pending >= _config.get("MXNET_METRIC_SYNC_STEPS"):
+            self._fold_device()
+        return True
+
+    def _fold_device(self) -> None:
+        """The host read: fold the pending device scalars into the host
+        sums.  Happens at .get(), engine.waitall() (via drain), or every
+        MXNET_METRIC_SYNC_STEPS updates — never per batch; by fold time
+        the scalars have long materialized, so the reads don't stall."""
+        pairs = getattr(self, "_dev_pairs", None)
+        if not pairs:
+            return
+        self._dev_pairs = []
+        self._dev_pending = 0
+        for s, n in pairs:
+            self.sum_metric += float(onp.asarray(s))
+            self.num_inst += int(round(float(onp.asarray(n))))
+
+    def drain(self) -> None:
+        """engine.waitall() hook: land outstanding device accumulation."""
+        self._fold_device()
 
     def __str__(self):
         return f"EvalMetric: {dict(zip(*self.get()))}"
@@ -114,8 +230,14 @@ class EvalMetric:
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        # device accumulator state (see _try_device_update): reset drops
+        # pending device scalars too — a cleared metric must not fold a
+        # previous epoch's batches at the next get()
+        self._dev_pairs = []
+        self._dev_pending = 0
 
     def get(self):
+        self._fold_device()
         if self.num_inst == 0:
             return self.name, float("nan")
         return self.name, self.sum_metric / self.num_inst
@@ -166,7 +288,21 @@ class Accuracy(EvalMetric):
         super().__init__(name, axis=axis, **kwargs)
         self.axis = axis
 
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        if pred.ndim > label.ndim:
+            pred = pred.argmax(axis=self.axis)
+        if pred.shape != label.shape:      # static under trace: the host
+            raise ValueError("shape mismatch")   # path raises it properly
+        pred = pred.astype(jnp.int32).ravel()
+        label = label.astype(jnp.int32).ravel()
+        return ((pred == label).sum().astype(jnp.float32),
+                jnp.float32(label.shape[0]))
+
     def update(self, labels, preds):
+        if self._try_device_update(labels, preds):
+            return
         labels, preds = check_label_shapes(labels, preds, wrap=True)
         for label, pred in zip(labels, preds):
             pred = _host(pred)
@@ -187,7 +323,19 @@ class TopKAccuracy(EvalMetric):
         self.top_k = top_k
         assert top_k > 1, "use Accuracy for top_k=1"
 
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        topk = jnp.argsort(pred, axis=-1)[..., -self.top_k:]
+        label = label.astype(jnp.int32)
+        if topk.shape[:-1] != label.shape:
+            raise ValueError("shape mismatch")
+        hits = (topk == label[..., None]).sum().astype(jnp.float32)
+        return hits, jnp.float32(label.size)
+
     def update(self, labels, preds):
+        if self._try_device_update(labels, preds):
+            return
         labels, preds = check_label_shapes(labels, preds, wrap=True)
         for label, pred in zip(labels, preds):
             pred = _host(pred)
@@ -325,7 +473,23 @@ class Perplexity(EvalMetric):
         self.ignore_label = ignore_label
         self.axis = axis
 
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        label = label.astype(jnp.int32).ravel()
+        pred = pred.reshape(-1, pred.shape[-1])
+        if pred.shape[0] != label.shape[0]:
+            raise ValueError("shape mismatch")
+        probs = pred[jnp.arange(label.shape[0]), label]
+        nll = -jnp.log(jnp.maximum(probs, 1e-10))
+        if self.ignore_label is not None:
+            mask = (label != self.ignore_label).astype(jnp.float32)
+            return (nll * mask).sum().astype(jnp.float32), mask.sum()
+        return nll.sum().astype(jnp.float32), jnp.float32(label.shape[0])
+
     def update(self, labels, preds):
+        if self._try_device_update(labels, preds):
+            return
         labels, preds = check_label_shapes(labels, preds, wrap=True)
         for label, pred in zip(labels, preds):
             label = _host(label).astype(onp.int64).flatten()
@@ -338,6 +502,7 @@ class Perplexity(EvalMetric):
             self.num_inst += len(probs)
 
     def get(self):
+        self._fold_device()
         if self.num_inst == 0:
             return self.name, float("nan")
         return self.name, math.exp(self.sum_metric / self.num_inst)
@@ -358,7 +523,16 @@ class MAE(EvalMetric):
     def __init__(self, name="mae", **kwargs):
         super().__init__(name, **kwargs)
 
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        label, pred = _align_regression(label, pred)
+        return (jnp.abs(label - pred).mean().astype(jnp.float32)
+                * label.shape[0], jnp.float32(label.shape[0]))
+
     def update(self, labels, preds):
+        if self._try_device_update(labels, preds):
+            return
         labels, preds = check_label_shapes(labels, preds, wrap=True)
         for label, pred in zip(labels, preds):
             label, pred = _align_regression(_host(label), _host(pred))
@@ -372,7 +546,16 @@ class MSE(EvalMetric):
     def __init__(self, name="mse", **kwargs):
         super().__init__(name, **kwargs)
 
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        label, pred = _align_regression(label, pred)
+        return (((label - pred) ** 2).mean().astype(jnp.float32)
+                * label.shape[0], jnp.float32(label.shape[0]))
+
     def update(self, labels, preds):
+        if self._try_device_update(labels, preds):
+            return
         labels, preds = check_label_shapes(labels, preds, wrap=True)
         for label, pred in zip(labels, preds):
             label, pred = _align_regression(_host(label), _host(pred))
@@ -387,6 +570,7 @@ class RMSE(MSE):
         super().__init__(name=name, **kwargs)
 
     def get(self):
+        self._fold_device()
         if self.num_inst == 0:
             return self.name, float("nan")
         return self.name, math.sqrt(self.sum_metric / self.num_inst)
@@ -400,7 +584,23 @@ class CrossEntropy(EvalMetric):
         self.eps = eps
         self.ignore_label = ignore_label
 
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        label = label.astype(jnp.int32).ravel()
+        pred = pred.reshape(-1, pred.shape[-1])
+        if pred.shape[0] != label.shape[0]:
+            raise ValueError("shape mismatch")
+        probs = pred[jnp.arange(label.shape[0]), label]
+        nll = -jnp.log(probs + self.eps)
+        if self.ignore_label is not None:
+            mask = (label != self.ignore_label).astype(jnp.float32)
+            return (nll * mask).sum().astype(jnp.float32), mask.sum()
+        return nll.sum().astype(jnp.float32), jnp.float32(label.shape[0])
+
     def update(self, labels, preds):
+        if self._try_device_update(labels, preds):
+            return
         labels, preds = check_label_shapes(labels, preds, wrap=True)
         for label, pred in zip(labels, preds):
             label = _host(label).astype(onp.int64).flatten()
@@ -497,9 +697,19 @@ class Loss(EvalMetric):
     def __init__(self, name="loss", **kwargs):
         super().__init__(name, **kwargs)
 
+    def _device_batch(self, label, pred):
+        import jax.numpy as jnp
+
+        return pred.sum().astype(jnp.float32), jnp.float32(pred.size)
+
     def update(self, _, preds):
         if isinstance(preds, NDArray):
             preds = [preds]
+        # label-free metric: the device path pairs each pred with itself
+        # (the kernel ignores the label slot)
+        if isinstance(preds, (list, tuple)) and \
+                self._try_device_update(list(preds), list(preds)):
+            return
         for pred in preds:
             loss = _host(pred)
             self.sum_metric += float(loss.sum())

@@ -203,6 +203,8 @@ class NDArray:
         return to_dlpack_for_write(self)
 
     def asnumpy(self) -> onp.ndarray:
+        global _HOST_SYNC_COUNT
+        _HOST_SYNC_COUNT += 1
         self.wait_to_read()
         return onp.asarray(self._data)
 
@@ -790,6 +792,25 @@ def invoke_count() -> int:
     return _INVOKE_COUNT
 
 
+# blocking host reads (asnumpy/item/float/bool, plus the deferred AMP
+# flag read in cached_step) since import: tools/check_dispatch_budget.py
+# gates the steady-state train step on this staying at 0 (non-AMP) /
+# <= 1 deferred read (AMP) — the pipeline engine's host-sync budget
+_HOST_SYNC_COUNT = 0
+
+
+def host_sync_count() -> int:
+    """Number of blocking device->host value reads since import."""
+    return _HOST_SYNC_COUNT
+
+
+def count_host_sync() -> None:
+    """Record one blocking host read performed outside asnumpy (e.g. a
+    bool() on a raw jax scalar)."""
+    global _HOST_SYNC_COUNT
+    _HOST_SYNC_COUNT += 1
+
+
 def invoke(
     op: Union[str, OpSchema],
     inputs: Sequence[NDArray],
@@ -1019,8 +1040,9 @@ def _invoke_tail(schema, ctx, arrays, inputs, attrs, out, fn, jitted, record):
     if _engine.is_naive():
         # MXNET_ENGINE_TYPE=NaiveEngine: synchronous dispatch — block per
         # op so errors surface at the faulting op, not a later sync point
-        # (reference src/engine/naive_engine.cc debugging role)
-        jax.block_until_ready([o._data for o in outputs])
+        # (reference src/engine/naive_engine.cc debugging role); inside a
+        # bulk scope the barrier fires every bulk_size ops instead
+        _engine.naive_sync([o._data for o in outputs])
 
     if record:
         node = autograd.TapeNode(

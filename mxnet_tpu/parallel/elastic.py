@@ -29,9 +29,11 @@ import time
 from typing import Any, Callable, Iterable, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as onp
 
 from .. import config as _config
+from .. import engine as _engine
 from .. import faults as _faults
 from ..log import get_logger
 
@@ -54,6 +56,28 @@ class AnomalyDetected(RuntimeError):
 # propagates.
 _RESTORE_ERRORS = (pickle.UnpicklingError, EOFError, OSError, ValueError,
                    IndexError, KeyError, _faults.FaultInjected)
+
+
+def _tree_cow(tree):
+    """Copy-on-write device snapshot: every jax leaf gets an ON-DEVICE
+    copy (a cheap async HBM copy enqueued on the dispatch stream — XLA
+    orders it BEFORE any later donated program overwrites the source
+    buffer).  The background writer then reads the copies to host at
+    leisure, so the live tree — including donated compiled-step buffers
+    — is never touched after save() returns.  Returns ``None`` when a
+    leaf cannot be COW-copied (non-fully-addressable multihost shards
+    need the original array's shard structure -> synchronous snapshot).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            if not leaf.is_fully_addressable:
+                return None
+            out.append(jnp.copy(leaf))
+        else:
+            out.append(onp.array(leaf, copy=True))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _tree_to_host(tree):
@@ -92,9 +116,14 @@ class CheckpointManager:
         self._worker: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._closed = False
+        # snapshot-path observability: "async" = COW device snapshot
+        # read to host by the writer thread; "sync" = host copy on the
+        # caller thread (block=True, NaiveEngine, or multihost shards)
+        self.snapshot_stats = {"async": 0, "sync": 0}
         if async_save:
-            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker = threading.Thread(target=self._writer, daemon=True)
             self._worker.start()
+        _engine.register_drainable(self)
 
     # -- paths ----------------------------------------------------------
     def _suffix(self) -> str:
@@ -161,8 +190,20 @@ class CheckpointManager:
 
     # -- save -----------------------------------------------------------
     def save(self, step: int, tree: Any, block: bool = False) -> None:
-        """Snapshot NOW (host copy happens synchronously so later mutation
-        of the live state can't race the writer), write async by default."""
+        """Snapshot the tree and write it, async by default.
+
+        Async path (the pipeline engine stage): the caller thread only
+        enqueues an ON-DEVICE copy of every jax leaf (:func:`_tree_cow`
+        — the copy-on-write guard: a later step donating/overwriting the
+        live buffers can never corrupt the snapshot, because the
+        snapshot reads the copies, and XLA orders the copy before the
+        overwrite).  The device->host transfer AND the pickle+write both
+        happen on the background writer (site ``checkpoint.async``), so
+        a checkpoint costs the train loop one async HBM copy instead of
+        a stop-the-world host transfer.  ``block=True``,
+        ``MXNET_ENGINE_TYPE=NaiveEngine``, or non-fully-addressable
+        (multihost-sharded) leaves fall back to the synchronous host
+        snapshot on the caller thread."""
         if self._closed:
             raise RuntimeError(
                 "CheckpointManager is closed; save() would be silently "
@@ -170,20 +211,34 @@ class CheckpointManager:
         if self._error is not None:
             err, self._error = self._error, None
             raise RuntimeError(f"previous async checkpoint failed: {err}")
+        if self.async_save and not block and not _engine.is_naive():
+            try:
+                cow = _tree_cow(tree)
+            except Exception:       # exotic leaves: sync snapshot below
+                cow = None
+            if cow is not None:
+                self.snapshot_stats["async"] += 1
+                self._q.put(("cow", step, cow))
+                return
+        self.snapshot_stats["sync"] += 1
         payload = _tree_to_host(tree)
         if self.async_save and not block:
-            self._q.put((step, payload))
+            self._q.put(("host", step, payload))
         else:
             self._write(step, payload)
 
-    def _drain(self):
+    def _writer(self):
         while True:
             item = self._q.get()
             if item is None:
                 return
-            step, payload = item
+            kind, step, data = item
             try:
-                self._write(step, payload)
+                if kind == "cow":
+                    # background device->host snapshot of the COW copies
+                    _faults.inject("checkpoint.async")
+                    data = _tree_to_host(data)
+                self._write(step, data)
             except BaseException as e:  # surfaced on the next save()
                 self._error = e
             finally:
@@ -241,12 +296,20 @@ class CheckpointManager:
                         pass
 
     def wait(self) -> None:
-        """Block until queued async saves hit disk (call before exit)."""
+        """Block until queued async saves (snapshots AND writes) hit
+        disk (call before exit)."""
         if self.async_save:
             self._q.join()
         if self._error is not None:
             err, self._error = self._error, None
             raise RuntimeError(f"async checkpoint failed: {err}")
+
+    def drain(self) -> None:
+        """engine.waitall() hook: flush queued snapshots/writes; an
+        asynchronously-absorbed failure surfaces here, like the
+        reference engine re-raising a captured op exception at the wait
+        point."""
+        self.wait()
 
     # -- restore --------------------------------------------------------
     def _step_files(self, step: int) -> List[str]:

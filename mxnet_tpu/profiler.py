@@ -22,7 +22,8 @@ from collections import defaultdict
 from typing import Dict, List, Optional
 
 __all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
-           "dumps", "Task", "Frame", "Event", "Counter", "Marker", "scope"]
+           "dumps", "Task", "Frame", "Event", "Counter", "Marker", "scope",
+           "StepTimeline"]
 
 _LOCK = threading.Lock()
 _CONFIG = {
@@ -247,6 +248,97 @@ class scope:
 
     def __exit__(self, *exc):
         _emit(self._name, "scope", "E")
+
+
+# ---------------------------------------------------------------------------
+# per-step phase timeline (the async pipeline engine's host-gap meter)
+# ---------------------------------------------------------------------------
+
+class StepTimeline:
+    """Per-step phase breakdown of the train loop's HOST side: ``h2d``
+    (taking the next batch / its device transfer wait), ``dispatch``
+    (enqueueing the compiled step), ``read`` (host value reads — the AMP
+    flag, metric folds), and ``host-gap`` (everything else between two
+    dispatches).  Phases emit Chrome-trace duration events when the
+    profiler is running AND accumulate locally, so the benchmark can use
+    a timeline without enabling global collection.
+
+    ``device_idle_gap_us`` — the headline pipeline metric — is the mean
+    per-step host time spent OUTSIDE the dispatch phase: with one
+    compiled program per step, whatever the host does between dispatches
+    is exactly the window in which the device can run dry.  A saturated
+    pipeline drives it toward zero.
+
+    Usage::
+
+        tl = profiler.StepTimeline()
+        for batch in loader:
+            with tl.phase("h2d"):
+                x, y = stage(batch)
+            with tl.phase("dispatch"):
+                loss = step(x, y)
+            tl.step()            # close the step (rest = host-gap)
+        print(tl.summary())
+    """
+
+    PHASES = ("h2d", "dispatch", "host-gap", "read")
+
+    def __init__(self, name: str = "step"):
+        self.name = name
+        self.steps = 0
+        self.phase_ns: Dict[str, int] = defaultdict(int)
+        self._step_ns = 0
+        self._step_t0: Optional[int] = None
+        self._accounted_ns = 0
+
+    class _Phase:
+        __slots__ = ("_tl", "_name", "_t0")
+
+        def __init__(self, tl, name):
+            self._tl = tl
+            self._name = name
+
+        def __enter__(self):
+            if self._tl._step_t0 is None:
+                self._tl._step_t0 = time.perf_counter_ns()
+            self._t0 = time.perf_counter_ns()
+            return self
+
+        def __exit__(self, *exc):
+            dur = time.perf_counter_ns() - self._t0
+            self._tl.phase_ns[self._name] += dur
+            self._tl._accounted_ns += dur
+            _emit(f"{self._tl.name}:{self._name}", "step_phase", "X",
+                  ts=self._t0 // 1000, dur=max(dur // 1000, 1))
+
+    def phase(self, name: str) -> "_Phase":
+        return self._Phase(self, name)
+
+    def step(self) -> None:
+        """Close one step: everything not inside a phase() since the
+        step began is the host-gap."""
+        now = time.perf_counter_ns()
+        if self._step_t0 is not None:
+            wall = now - self._step_t0
+            gap = max(0, wall - self._accounted_ns)
+            self.phase_ns["host-gap"] += gap
+            self._step_ns += wall
+        self._accounted_ns = 0
+        self._step_t0 = now
+        self.steps += 1
+
+    def summary(self) -> Dict[str, object]:
+        steps = max(self.steps, 1)
+        phase_us = {k: round(v / 1000.0 / steps, 1)
+                    for k, v in sorted(self.phase_ns.items())}
+        non_dispatch = sum(v for k, v in self.phase_ns.items()
+                           if k != "dispatch")
+        return {
+            "steps": self.steps,
+            "phase_us_per_step": phase_us,
+            "wall_us_per_step": round(self._step_ns / 1000.0 / steps, 1),
+            "device_idle_gap_us": round(non_dispatch / 1000.0 / steps, 1),
+        }
 
 
 # MXNET_PROFILER_AUTOSTART: begin collection at import, matching the

@@ -234,13 +234,21 @@ class ServingEngine:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._requests: "deque[_Request]" = deque()
-        # double buffer: stager fills (depth 2), dispatcher drains — the
-        # next batch's pad/concat/device staging overlaps the current
-        # program's execution (jax dispatch is async; the bound keeps at
-        # most one staged batch waiting, the DataLoader prefetch idiom)
+        # staging buffer: stager fills, dispatcher drains — the next
+        # batch's pad/concat/device staging overlaps the current
+        # program's execution.  Depth follows the pipeline engine's
+        # prefetch knob (MXNET_ENGINE_PREFETCH, floor 2 so the classic
+        # double buffer survives depth 0/NaiveEngine — serving stays
+        # concurrent either way; only the TRAIN loop goes synchronous
+        # under the naive escape hatch).
         import queue as _queue
 
-        self._staged: "_queue.Queue" = _queue.Queue(maxsize=2)
+        from . import engine as _engine
+
+        self._staged: "_queue.Queue" = _queue.Queue(
+            maxsize=max(2, _engine.prefetch_depth()))
+        self._busy = 0           # groups popped but not yet staged
+        _engine.register_drainable(self)
         self._threads: List[threading.Thread] = []
         self._closed = False
         self._latencies: "deque[float]" = deque(maxlen=8192)
@@ -310,6 +318,18 @@ class ServingEngine:
             out["p50_us"] = out["p99_us"] = out["mean_us"] = 0.0
         return out
 
+    def drain(self, timeout: float = 60.0) -> None:
+        """engine.waitall() hook: block until every accepted request has
+        been staged, dispatched, and delivered (queues empty, no batch
+        in flight)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = not self._requests and self._busy == 0
+            if idle and self._staged.unfinished_tasks == 0:
+                return
+            time.sleep(0.002)
+
     def close(self):
         with self._cv:
             self._closed = True
@@ -376,12 +396,18 @@ class ServingEngine:
                 continue
             if group is None:
                 return                       # closed
+            # _busy covers the popped-but-not-yet-staged window so
+            # drain() cannot declare the engine idle mid-staging
+            self._busy += 1
             try:
-                staged = self._stage_group(group)
-            except BaseException as e:       # staging failed: per-request
-                self._deliver_fallback(group, cause=e)
-                continue
-            self._staged.put(staged)
+                try:
+                    staged = self._stage_group(group)
+                except BaseException as e:   # staging failed: per-request
+                    self._deliver_fallback(group, cause=e)
+                    continue
+                self._staged.put(staged)
+            finally:
+                self._busy -= 1
 
     def _collect_group(self) -> Optional[List[_Request]]:
         """Pop a head request, then coalesce compatible followers until
@@ -475,6 +501,7 @@ class ServingEngine:
         while True:
             item = self._staged.get()
             if item is None:
+                self._staged.task_done()
                 return
             group, batched, rows, pad_active = item
             try:
@@ -488,6 +515,10 @@ class ServingEngine:
                                      requests=len(group))
                 self._stats["single_fallbacks"] += len(group)
                 self._deliver_fallback(group, cause=e)
+            finally:
+                # task_done pairs every put so drain()'s unfinished-
+                # tasks check sees a truly empty pipeline
+                self._staged.task_done()
 
     def _dispatch(self, group, batched, rows, pad_active):
         global _DISPATCH_COUNT, _BUCKET_HITS, _BUCKET_MISSES

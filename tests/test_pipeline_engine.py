@@ -1,0 +1,468 @@
+"""Async pipeline engine (PR 5 tentpole: engine.py ThreadedEngine analog).
+
+Covers the acceptance contract: (1) depth-k device prefetch preserves
+source order — never reordered, dropped, or double-applied — including
+under an injected DataLoader worker crash and a transient
+``engine.prefetch`` transfer fault; (2) the deferred AMP gate
+(MXNET_AMP_LAG=1) is bit-exact vs the synchronous gate — params AND
+optimizer state — including an injected-overflow step and the rollback
+across the lag window; (3) device-side metric accumulators match host
+accumulation with the host read deferred to .get()/waitall()/every
+MXNET_METRIC_SYNC_STEPS, and host-path fallbacks count LOUDLY in
+metric.host_sync_count; (4) async checkpointing snapshots copy-on-write
+(donated buffers never read mid-overwrite) under the ``checkpoint.async``
+fault site; (5) engine.waitall() drains every stage and
+MXNET_ENGINE_TYPE=NaiveEngine forces fully synchronous execution.
+"""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, cached_step, engine, faults, gluon, metric
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+from mxnet_tpu.ndarray import ndarray as _ndmod
+
+
+# ---------------------------------------------------------------------------
+# shared fixtures
+# ---------------------------------------------------------------------------
+
+def _mlp(seed=0):
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d1 = nn.Dense(16, in_units=8, activation="relu")
+            self.d2 = nn.Dense(4, in_units=16)
+
+        def forward(self, x):
+            return self.d2(self.d1(x))
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(seed)
+    for _name, p in sorted(net.collect_params().items()):
+        p.data()._set_data(mx.nd.array(rng.randn(*p.shape) * 0.1)._data)
+    net.hybridize()
+    return net
+
+
+def _loss_fn(net, x, y):
+    return ((net(x) - y) ** 2).mean()
+
+
+def _batches(n, seed=3, overflow_at=()):
+    """n (x, y) batches; steps listed in ``overflow_at`` get a target so
+    large the fp32 squared error overflows to inf — the injected-overflow
+    step the AMP gate must skip."""
+    rng = onp.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        x = rng.randn(6, 8).astype(onp.float32)
+        y = rng.randn(6, 4).astype(onp.float32)
+        if i in overflow_at:
+            # 3e38 is finite in fp32, but the scaled residual gradient
+            # 2*(pred-y)*scale/batch overflows to inf -> all-finite False
+            y = onp.full_like(y, 3e38)
+        out.append((x, y))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# (1) device prefetch: ordering, faults, NaiveEngine
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_preserves_order_no_drop_no_dup():
+    batches = [onp.full((4,), i, onp.float32) for i in range(20)]
+    pf = engine.DevicePrefetcher(iter(batches), depth=3)
+    got = [b.asnumpy() for b in pf]
+    assert len(got) == 20
+    for i, b in enumerate(got):
+        onp.testing.assert_array_equal(b, batches[i])
+    s = pf.stats()
+    assert s["staged"] == 20 and s["consumed"] == 20
+
+
+def test_prefetcher_runs_ahead_of_slow_consumer():
+    batches = [onp.full((4,), i, onp.float32) for i in range(10)]
+    pf = engine.DevicePrefetcher(iter(batches), depth=3)
+    time.sleep(0.2)                     # transfer thread fills the FIFO
+    got = []
+    for b in pf:
+        got.append(b.asnumpy())
+        time.sleep(0.01)                # "step" time: stage N+1 overlaps
+    assert len(got) == 10
+    s = pf.stats()
+    assert s["max_ahead"] >= 2, s       # the acceptance bar: depth >= 2
+    assert s["steady_ahead"] >= 2, s
+
+
+def test_prefetch_transient_transfer_fault_retries_in_order():
+    batches = [onp.full((2,), i, onp.float32) for i in range(8)]
+    with faults.active(faults.FaultPlan().fail("engine.prefetch", times=2)):
+        pf = engine.DevicePrefetcher(iter(batches), depth=2)
+        got = [b.asnumpy() for b in pf]
+    assert len(got) == 8
+    for i, b in enumerate(got):
+        onp.testing.assert_array_equal(b, batches[i])
+    evs = faults.events("engine.prefetch")
+    assert any(e["action"] == "retry" for e in evs)     # recovery path ran
+
+
+def test_prefetch_source_error_delivered_in_order():
+    def source():
+        for i in range(3):
+            yield onp.full((2,), i, onp.float32)
+        raise RuntimeError("source died")
+
+    pf = engine.DevicePrefetcher(source(), depth=2)
+    got = []
+    with pytest.raises(RuntimeError, match="source died"):
+        for b in pf:
+            got.append(b.asnumpy())
+    # every batch produced before the error arrived, in order, first
+    assert len(got) == 3
+    for i, b in enumerate(got):
+        onp.testing.assert_array_equal(b, onp.full((2,), i, onp.float32))
+
+
+def test_dataloader_device_prefetch_ordering_under_worker_crash():
+    """The ISSUE's ordering bar: an injected DataLoader worker crash in
+    a device-prefetched epoch never reorders, drops, or double-applies a
+    batch (the worker retry is invisible to the consumer)."""
+    data = onp.arange(48, dtype=onp.float32).reshape(12, 4)
+    ds = ArrayDataset(data)
+    baseline = [b.asnumpy() for b in DataLoader(ds, batch_size=4)]
+    loader = DataLoader(ds, batch_size=4, num_workers=2, thread_pool=True,
+                        timeout=30, device_prefetch=True)
+    with faults.active(faults.FaultPlan().fail("dataloader.worker")):
+        got = [b.asnumpy() for b in loader]
+    assert len(got) == len(baseline)
+    for a, b in zip(got, baseline):
+        onp.testing.assert_array_equal(a, b)
+
+
+def test_dataloader_device_prefetch_parity_with_sync_path():
+    data = onp.arange(44, dtype=onp.float32).reshape(11, 4)
+    ds = ArrayDataset(data)
+    sync_batches = [b.asnumpy()
+                    for b in DataLoader(ds, batch_size=4, last_batch="pad")]
+    loader = DataLoader(ds, batch_size=4, last_batch="pad",
+                        device_prefetch=True)
+    pre_batches = []
+    valids = []
+    for b in loader:
+        pre_batches.append(b.asnumpy())
+        valids.append(loader.last_batch_valid)
+    assert len(pre_batches) == len(sync_batches)
+    for a, b in zip(pre_batches, sync_batches):
+        onp.testing.assert_array_equal(a, b)
+    assert valids[-1] == 3              # pad contract rides the queue
+
+
+def test_naive_engine_forces_fully_synchronous(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    assert engine.is_naive()
+    assert engine.prefetch_depth() == 0
+    assert engine.amp_lag() == 0
+    # prefetch degrades to an inline generator — no transfer thread
+    out = engine.prefetch(iter([onp.ones(2, onp.float32)]))
+    assert not isinstance(out, engine.DevicePrefetcher)
+    assert [b.asnumpy().tolist() for b in out] == [[1.0, 1.0]]
+    # metrics accumulate on host (counted loudly)
+    m = metric.Accuracy()
+    assert not m._device_ok()
+    before = metric.host_sync_count()
+    m.update([mx.nd.array([1, 0])], [mx.nd.array([[0.1, 0.9], [0.9, 0.1]])])
+    assert metric.host_sync_count() > before
+    assert m._dev_pending == 0
+
+
+# ---------------------------------------------------------------------------
+# (2) deferred AMP gate: bit-exact parity + rollback
+# ---------------------------------------------------------------------------
+
+def _train(lag, overflow_at=(), steps=6, scale_window=3):
+    net = _mlp()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    trainer._amp_loss_scaler = amp.LossScaler(init_scale=8.0,
+                                              scale_window=scale_window)
+    step = trainer.compile_step(net, _loss_fn)
+    for x, y in _batches(steps, overflow_at=overflow_at):
+        step(mx.nd.array(x), mx.nd.array(y), batch_size=6)
+    assert step.last_step_compiled, step.last_fallback_reason
+    engine.waitall()                    # land the trailing deferred flag
+    return net, trainer
+
+
+@pytest.mark.parametrize("overflow_at", [(), (2,), (0, 3)])
+def test_deferred_gate_bit_exact_vs_synchronous(monkeypatch, overflow_at):
+    """MXNET_AMP_LAG=1 (read step N-1's flag while dispatching step N)
+    ends bit-identical to the synchronous gate: params, optimizer state,
+    and loss scale — including injected-overflow steps whose update must
+    be skipped, and a scale_window small enough that the scale GROWS
+    mid-run (both speculation branches exercised)."""
+    monkeypatch.setenv("MXNET_AMP_LAG", "0")
+    net_s, tr_s = _train(0, overflow_at)
+    monkeypatch.setenv("MXNET_AMP_LAG", "1")
+    net_d, tr_d = _train(1, overflow_at)
+
+    ps, pd = net_s.collect_params(), net_d.collect_params()
+    for k in ps:
+        assert onp.array_equal(ps[k].data().asnumpy(),
+                               pd[k].data().asnumpy()), k
+    ss = tr_s._updaters[0].states
+    sd = tr_d._updaters[0].states
+    assert set(ss) == set(sd)
+    for idx in ss:
+        a, b = ss[idx], sd[idx]
+        if a is None:
+            assert b is None
+            continue
+        for ai, bi in zip(a if isinstance(a, (list, tuple)) else [a],
+                          b if isinstance(b, (list, tuple)) else [b]):
+            assert onp.array_equal(ai.asnumpy(), bi.asnumpy()), f"state {idx}"
+    assert tr_s._amp_loss_scaler.loss_scale == tr_d._amp_loss_scaler.loss_scale
+    assert tr_s._amp_loss_scaler._unskipped == tr_d._amp_loss_scaler._unskipped
+
+
+def test_deferred_gate_rollback_across_lag_window(monkeypatch):
+    """An overflow on the FINAL step is still pending when training
+    stops; the skipped update already held on device (params unchanged),
+    and waitall() rolls the host scaler back across the lag window."""
+    monkeypatch.setenv("MXNET_AMP_LAG", "1")
+    net = _mlp()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    trainer._amp_loss_scaler = amp.LossScaler(init_scale=8.0)
+    step = trainer.compile_step(net, _loss_fn)
+    clean = _batches(3)
+    for x, y in clean:
+        step(mx.nd.array(x), mx.nd.array(y), batch_size=6)
+    engine.waitall()
+    before = {k: p.data().asnumpy().copy()
+              for k, p in net.collect_params().items()}
+    (x, y), = _batches(1, overflow_at=(0,))
+    step(mx.nd.array(x), mx.nd.array(y), batch_size=6)
+    # flag unread: host scaler hasn't seen the overflow yet
+    assert trainer._amp_loss_scaler.loss_scale == 8.0
+    # ...but the device already skipped the update (the fused group gates
+    # on THIS step's flag, independent of the lag window)
+    for k, p in net.collect_params().items():
+        onp.testing.assert_array_equal(p.data().asnumpy(), before[k])
+    engine.waitall()                    # the lag window closes
+    assert trainer._amp_loss_scaler.loss_scale == 4.0
+
+
+def test_deferred_read_counter_and_host_sync_budget(monkeypatch):
+    """Steady-state budget (tools/check_dispatch_budget.py): a non-AMP
+    compiled step performs ZERO blocking host syncs; with AMP + lag the
+    only sync is the ONE deferred read of step N-1's flag."""
+    monkeypatch.setenv("MXNET_AMP_LAG", "1")
+    net = _mlp()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = trainer.compile_step(net, _loss_fn)
+    batches = _batches(6)
+    x0, y0 = batches[0]
+    step(mx.nd.array(x0), mx.nd.array(y0), batch_size=6)    # warm
+    h0 = _ndmod.host_sync_count()
+    for x, y in batches[1:]:
+        step(mx.nd.array(x), mx.nd.array(y), batch_size=6)
+    assert step.last_step_compiled
+    assert _ndmod.host_sync_count() - h0 == 0               # non-AMP: zero
+
+    net2 = _mlp()
+    tr2 = gluon.Trainer(net2.collect_params(), "sgd",
+                        {"learning_rate": 0.1})
+    tr2._amp_loss_scaler = amp.LossScaler(init_scale=8.0)
+    step2 = tr2.compile_step(net2, _loss_fn)
+    step2(mx.nd.array(x0), mx.nd.array(y0), batch_size=6)   # warm
+    h0, d0 = _ndmod.host_sync_count(), cached_step.deferred_read_count()
+    for x, y in batches[1:]:
+        step2(mx.nd.array(x), mx.nd.array(y), batch_size=6)
+    syncs = _ndmod.host_sync_count() - h0
+    deferred = cached_step.deferred_read_count() - d0
+    assert syncs == deferred == len(batches) - 1            # 1/step, lagged
+
+
+# ---------------------------------------------------------------------------
+# (3) device-side metric accumulators
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("make,feed", [
+    (metric.Accuracy,
+     lambda rng: ([mx.nd.array(rng.randint(0, 4, (8,)))],
+                  [mx.nd.array(rng.rand(8, 4).astype(onp.float32))])),
+    (metric.MSE,
+     lambda rng: ([mx.nd.array(rng.randn(8, 3).astype(onp.float32))],
+                  [mx.nd.array(rng.randn(8, 3).astype(onp.float32))])),
+    (metric.CrossEntropy,
+     lambda rng: ([mx.nd.array(rng.randint(0, 4, (8,)))],
+                  [mx.nd.array(rng.dirichlet(onp.ones(4), 8)
+                               .astype(onp.float32))])),
+])
+def test_device_accumulator_matches_host_path(monkeypatch, make, feed):
+    monkeypatch.setenv("MXNET_METRIC_DEVICE", "1")
+    dev, host = make(), make()
+    rng1, rng2 = onp.random.RandomState(5), onp.random.RandomState(5)
+    h0 = metric.host_sync_count()
+    for _ in range(4):
+        dev.update(*feed(rng1))
+    assert metric.host_sync_count() == h0       # no per-batch host sync
+    assert dev._dev_pending == 4
+    monkeypatch.setenv("MXNET_METRIC_DEVICE", "0")
+    for _ in range(4):
+        host.update(*feed(rng2))
+    assert host._dev_pending == 0
+    assert metric.host_sync_count() > h0        # loud host path
+    nd_, vd = dev.get()
+    nh, vh = host.get()
+    assert dev._dev_pending == 0                # .get() folded
+    assert vd == pytest.approx(vh, rel=1e-6)
+    assert dev.num_inst == host.num_inst
+
+
+def test_metric_sync_steps_bounds_the_queue(monkeypatch):
+    monkeypatch.setenv("MXNET_METRIC_DEVICE", "1")
+    monkeypatch.setenv("MXNET_METRIC_SYNC_STEPS", "3")
+    m = metric.Loss()
+    pred = mx.nd.array(onp.ones(4, onp.float32))
+    for i in range(7):
+        m.update(0, pred)
+    # folds fired at updates 3 and 6 -> at most SYNC_STEPS-1 pending
+    assert m._dev_pending == 1
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_waitall_drains_metric_accumulators(monkeypatch):
+    monkeypatch.setenv("MXNET_METRIC_DEVICE", "1")
+    m = metric.Accuracy()
+    m.update([mx.nd.array([1, 1])], [mx.nd.array([[0.0, 1.0], [1.0, 0.0]])])
+    assert m._dev_pending == 1
+    engine.waitall()
+    assert m._dev_pending == 0
+    assert m.sum_metric == 1.0 and m.num_inst == 2
+
+
+def test_metric_reset_drops_pending_device_batches(monkeypatch):
+    monkeypatch.setenv("MXNET_METRIC_DEVICE", "1")
+    m = metric.Loss()
+    m.update(0, mx.nd.array(onp.full(4, 9.0, onp.float32)))
+    m.reset()
+    m.update(0, mx.nd.array(onp.full(4, 2.0, onp.float32)))
+    assert m.get()[1] == pytest.approx(2.0)     # epoch-1 batch discarded
+
+
+def test_host_only_metric_counts_syncs_loudly():
+    m = metric.F1()                             # confusion-matrix family
+    h0 = metric.host_sync_count()
+    m.update([mx.nd.array([1, 0, 1, 1])],
+             [mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.2, 0.8], [0.3, 0.7]])])
+    assert metric.host_sync_count() > h0
+
+
+# ---------------------------------------------------------------------------
+# (4) async checkpointing: COW snapshot + fault site
+# ---------------------------------------------------------------------------
+
+def test_async_checkpoint_survives_donation_of_live_buffers(tmp_path):
+    """The copy-on-write guard: save() enqueues ON-DEVICE copies, so a
+    later compiled step donating (deleting) the live buffers can never
+    corrupt the snapshot — the reference's write-after-read hazard that
+    the dependency engine exists to prevent."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.elastic import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=True)
+    w = jnp.arange(8.0)
+    mgr.save(1, {"w": w})
+    assert mgr.snapshot_stats["async"] == 1
+    # donate w's buffer — after this the ORIGINAL array is deleted and
+    # any read of it raises; only the COW copy keeps the snapshot alive
+    bumped = jax.jit(lambda a: a + 1, donate_argnums=0)(w)
+    bumped.block_until_ready()
+    mgr.wait()
+    out, step = mgr.restore()
+    assert step == 1
+    onp.testing.assert_array_equal(out["w"], onp.arange(8.0))
+    mgr.close()
+
+
+def test_async_checkpoint_naive_engine_is_synchronous(tmp_path, monkeypatch):
+    from mxnet_tpu.parallel.elastic import CheckpointManager
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=True)
+    mgr.save(1, {"w": jnp.ones(4)})
+    assert mgr.snapshot_stats == {"async": 0, "sync": 1}
+    mgr.wait()
+    mgr.close()
+
+
+def test_checkpoint_async_fault_surfaces_at_wait(tmp_path):
+    """A failure absorbed by the background writer (site
+    ``checkpoint.async``) re-raises at the wait point — the reference
+    engine's deferred-exception contract — and the manager keeps working
+    afterwards."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.elastic import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=True)
+    with faults.active(faults.FaultPlan().fail("checkpoint.async")):
+        mgr.save(1, {"w": jnp.ones(4)})
+        with pytest.raises(RuntimeError, match="async checkpoint failed"):
+            mgr.wait()
+    mgr.save(2, {"w": jnp.full((4,), 2.0)})     # recovered
+    engine.waitall()                            # waitall drains writers too
+    out, step = mgr.restore()
+    assert step == 2
+    onp.testing.assert_array_equal(out["w"], onp.full((4,), 2.0))
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# (5) waitall / profiler timeline
+# ---------------------------------------------------------------------------
+
+def test_waitall_drains_deferred_amp_flag(monkeypatch):
+    monkeypatch.setenv("MXNET_AMP_LAG", "1")
+    net = _mlp()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    trainer._amp_loss_scaler = amp.LossScaler(init_scale=8.0,
+                                              scale_window=1)
+    step = trainer.compile_step(net, _loss_fn)
+    (x, y), = _batches(1)
+    step(mx.nd.array(x), mx.nd.array(y), batch_size=6)
+    assert trainer._amp_loss_scaler.loss_scale == 8.0   # flag pending
+    engine.waitall()
+    assert trainer._amp_loss_scaler.loss_scale == 16.0  # clean step landed
+
+
+def test_step_timeline_phases_and_idle_gap():
+    from mxnet_tpu import profiler
+
+    tl = profiler.StepTimeline("t")
+    for _ in range(3):
+        with tl.phase("h2d"):
+            time.sleep(0.002)
+        with tl.phase("dispatch"):
+            time.sleep(0.004)
+        with tl.phase("read"):
+            time.sleep(0.001)
+        tl.step()
+    s = tl.summary()
+    assert s["steps"] == 3
+    per = s["phase_us_per_step"]
+    assert per["h2d"] >= 1500 and per["dispatch"] >= 3000
+    # idle gap = everything except dispatch
+    assert s["device_idle_gap_us"] == pytest.approx(
+        sum(v for k, v in per.items() if k != "dispatch"), rel=0.01)
+    assert s["wall_us_per_step"] >= s["device_idle_gap_us"]

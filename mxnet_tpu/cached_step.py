@@ -30,10 +30,24 @@ changed batch size never re-traces.
 Result: dispatches/step drop from O(#tape nodes + #groups) to **1**
 (+1 host scalar read for the AMP all-finite flag).  Anything the program
 cannot express — a forward that cannot stage (host reads, data-dependent
-shapes), ``grad_req='add'``, multi-replica parameters, multi-worker
+shapes), ``grad_req='add'``, multi-replica parameters, dist/ps-lite
 kvstores, server-side (``update_on_kvstore``) updates, optimizers without
 a ``fused_update`` rule — falls back transparently to the eager tape;
 ``MXNET_COMPILED_STEP=0`` forces the tape everywhere.
+
+**Pod-scale SPMD** (``kvstore='tpu'``): with an ICI-collective store the
+step traces under a data-parallel ``jax.sharding.Mesh``
+(``parallel.spmd``, knob ``MXNET_SPMD_MESH``): the batch shards over the
+``'dp'`` axis, parameters/optimizer state replicate, and the gradient
+reduce this program already contains becomes an ICI-native all-reduce
+scheduled by the XLA SPMD partitioner — overlappable with backward,
+still ONE dispatch per step, still donated buffers.  Existing Trainer
+code gets it by passing ``kvstore='tpu'``; the mesh (axes + exact device
+set) is part of the program-cache key, inputs already staged with the
+batch sharding (``engine.DevicePrefetcher``) pass through without a
+copy, and steady state performs zero host-side cross-device copies
+(``parallel.spmd.reshard_count``, pinned by the dispatch-budget gate).
+Host-driven stores (``dist_*``) still fall back, naming this path.
 """
 from __future__ import annotations
 
@@ -143,6 +157,10 @@ class TrainStep:
         self.bucket_refused: Optional[str] = None
         self._bucket_verified: set = set()
         self.padded_steps = 0
+        # SPMD mesh (kvstore='tpu', MXNET_SPMD_MESH): resolved once the
+        # kvstore exists (first __call__); None = single-chip path
+        self._mesh = None
+        self._mesh_resolved = False
         # deferred AMP gate (MXNET_AMP_LAG): the previous step's device
         # all-finite flag, not yet read on host.  The NEXT dispatch
         # carries both scale candidates and selects on this flag
@@ -155,6 +173,47 @@ class TrainStep:
     @property
     def last_step_compiled(self) -> bool:
         return self.last_fallback_reason is None
+
+    @property
+    def mesh(self):
+        """The SPMD mesh this step traces under (``None`` single-chip)."""
+        return self._mesh
+
+    @property
+    def batch_sharding(self):
+        """The ``NamedSharding`` input batches should be staged with —
+        hand it to ``engine.prefetch(..., sharding=)`` / ``DataLoader(...,
+        sharding=)`` so the prefetch thread's ``device_put`` already
+        lands shards on the mesh and the step pays no re-placement.
+        ``None`` when the step is single-chip."""
+        if not self._mesh_resolved and not self._trainer._kv_initialized:
+            self._trainer._init_kvstore()    # the mesh follows the store
+        if self._resolve_mesh() is None:
+            return None
+        from .parallel import spmd as _spmd
+
+        return _spmd.batch_sharding(self._mesh)
+
+    def _params_on_mesh(self) -> bool:
+        """True once the compiled mesh path actually replicated the
+        parameters across >1 device (a fallback BEFORE placement keeps
+        plain single-device eager semantics)."""
+        for p in self._trainer._params:
+            if p.grad_req == "null" or p._data is None:
+                continue
+            sh = getattr(p.data()._data, "sharding", None)
+            return sh is not None and len(sh.device_set) > 1
+        return False
+
+    def _resolve_mesh(self):
+        if not self._mesh_resolved:
+            from .parallel import spmd as _spmd
+
+            kv = self._trainer._kvstore
+            self._mesh = _spmd.mesh_for_store(
+                getattr(kv, "type", None)) if kv is not None else None
+            self._mesh_resolved = True
+        return self._mesh
 
     def drain(self) -> None:
         """Read the pending deferred AMP flag (if any) and apply the
@@ -306,8 +365,13 @@ class TrainStep:
                     "functional fused_update rule")
         if tr._update_on_kvstore:
             return "update_on_kvstore=True applies updates server-side"
-        if tr._kvstore is not None and tr._kvstore.num_workers > 1:
-            return "multi-worker kvstore reduction not staged yet"
+        mesh = self._resolve_mesh()
+        if tr._kvstore is not None and tr._kvstore.num_workers > 1 \
+                and mesh is None:
+            return (f"multi-worker '{tr._kvstore.type}' kvstore reduction "
+                    "is host-driven (dist/ps-lite); the staged SPMD "
+                    "all-reduce covers kvstore='tpu' (pod-scale SPMD "
+                    "training, ISSUE 6)")
         for p in tr._params:
             if p.grad_req == "add":
                 return f"parameter '{p.name}' has grad_req='add'"
@@ -327,6 +391,24 @@ class TrainStep:
         # caught up to the device before this step's scale is chosen
         self.drain()
         tr = self._trainer
+        if self._mesh is not None and self._params_on_mesh():
+            # a sticky fallback AFTER mesh placement: the parameters
+            # already live replicated across the mesh, and eager ops
+            # require colocated operands — stage the batch replicated too
+            from .parallel import spmd as _spmd
+
+            rep = _spmd.replicated(self._mesh)
+
+            def _rep(a):
+                if isinstance(a, (tuple, list)):
+                    return type(a)(_rep(v) for v in a)
+                if hasattr(a, "_data"):
+                    from .ndarray import ndarray as _nd
+
+                    return _nd._wrap(jax.device_put(a._data, rep),
+                                     a.ctx, type(a))
+                return a
+            args = tuple(_rep(a) for a in args)
         scaler = getattr(tr, "_amp_loss_scaler", None)
         with autograd.record():
             loss = self._loss_fn(self._net, *args)
@@ -387,6 +469,37 @@ class TrainStep:
                 slot_of_name[n] = i
         frozen_names = [n for n in names if n not in slot_of_name]
 
+        mesh = self._mesh
+        if mesh is not None:
+            from .parallel import spmd as _spmd
+
+            rep = _spmd.replicated(mesh)
+
+            def _place_nd(d):
+                new = _spmd.ensure_placed(d._data, rep)
+                if new is not d._data:
+                    d._set_data(new)
+
+            def _place_state(s):
+                if s is None:
+                    return
+                if hasattr(s, "_set_data"):
+                    _place_nd(s)
+                    return
+                for x in s:
+                    _place_state(x)
+
+            # one-time replicated placement (the KVStore init/broadcast
+            # analog): steady state sees already-placed buffers — the
+            # step's outputs carry the replicated sharding back into the
+            # parameters, so reshard_count stays flat after warmup
+            for p in trainable:
+                _place_nd(p.data())
+            for n in frozen_names:
+                _place_nd(params[n].data())
+            for s in states:
+                _place_state(s)
+
         has_ok = scaler is not None
         donate = jax.default_backend() not in ("cpu",)
         sig = (
@@ -402,6 +515,9 @@ class TrainStep:
             tuple((n, tuple(params[n].data().shape),
                    params[n].data()._data.dtype) for n in frozen_names),
             group_layout, has_ok, donate,
+            # the SPMD mesh (axes + exact device set): a topology change
+            # must never reuse a program compiled for another
+            None if mesh is None else _spmd.mesh_key(mesh),
         )
         rec = self._programs.get(sig)
         if rec is None:
@@ -445,8 +561,15 @@ class TrainStep:
         base = getattr(tr, "_amp_original_scale", tr._scale)
         rescale = base / (scale_val * batch_size)
         rescale_alt = base / (s_over * batch_size)
-        prev_ok = self._pending_ok if self._pending_ok is not None \
-            else jnp.asarray(True)
+        if self._pending_ok is not None:
+            prev_ok = self._pending_ok
+        elif mesh is not None:
+            # pin the seed flag to the mesh so the first deferred step
+            # traces with the same (replicated) sharding later flags
+            # carry — otherwise step 2 would pay a one-off retrace
+            prev_ok = jax.device_put(jnp.asarray(True), rep)
+        else:
+            prev_ok = jnp.asarray(True)
         lrs_g = [jnp.asarray([lrs[i] for i in m], jnp.float32)
                  for _mp, m in group_layout]
         wds_g = [jnp.asarray([wds[i] for i in m], jnp.float32)
@@ -457,7 +580,13 @@ class TrainStep:
         w_args = [p.data()._data for p in trainable]
         s_args = tuple(_fused._unwrap(s) for s in states)
         frozen_args = [params[n].data()._data for n in frozen_names]
-        in_args = [l._data for l in in_leaves]
+        if mesh is not None:
+            # batch leaves shard over 'dp' (legalized: an indivisible
+            # batch axis replicates, loudly).  Leaves the prefetcher
+            # already staged with this sharding pass through untouched.
+            in_args = [_spmd.put_batch(l._data, mesh) for l in in_leaves]
+        else:
+            in_args = [l._data for l in in_leaves]
 
         out_raw, mut_vals, new_w, new_s, ok = jitted(
             w_args, s_args, frozen_args, in_args, _random.next_key(),

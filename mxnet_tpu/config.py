@@ -150,6 +150,15 @@ declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
         "Arrays larger than this many elements get their own dist push "
         "bucket (reference kvstore_dist big-array splitting)",
         validator=lambda v: v > 0, subsystem="kvstore")
+declare("MXNET_SPMD_MESH", str, "auto",
+        "Data-parallel SPMD mesh for kvstore='tpu' (cached_step.TrainStep "
+        "traces under it: batch sharded over the 'dp' axis, params/"
+        "optimizer state replicated, the gradient all-reduce ICI-native "
+        "inside the one donated program).  'auto' = every visible device "
+        "on 'dp' (single-device worlds stay on the plain single-chip "
+        "path); an integer = that many devices; '0'/'off' disables; "
+        "'dp=4,tp=2' axis specs go through parallel.mesh.make_mesh.",
+        subsystem="kvstore", cached=False)
 declare("MXNET_ENGINE_PREFETCH", int, 2,
         "Async pipeline engine: device-prefetch depth — how many batches "
         "a DevicePrefetcher transfer thread stages into HBM ahead of the "

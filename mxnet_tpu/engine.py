@@ -196,11 +196,11 @@ def _default_transfer(item):
     return array(item)
 
 
-def _bucket_transfer(policy):
-    """Compose bucket padding (PR 4's BucketPolicy grid) with the device
-    transfer: the batch axis of every host leaf pads up to its bucket
-    BEFORE the device_put, so a variable-length stream stages a bounded
-    shape set (no retrace churn downstream)."""
+def _bucket_pad(policy):
+    """Bucket padding (PR 4's BucketPolicy grid) for host batches: the
+    batch axis of every host leaf pads up to its bucket BEFORE the
+    device_put, so a variable-length stream stages a bounded shape set
+    (no retrace churn downstream)."""
     import numpy as onp
 
     def pad(x):
@@ -215,8 +215,47 @@ def _bucket_transfer(policy):
         fill = onp.zeros((b - arr.shape[0],) + arr.shape[1:], arr.dtype)
         return onp.concatenate([arr, fill], axis=0)
 
+    return pad
+
+
+def _bucket_transfer(policy):
+    pad = _bucket_pad(policy)
+
     def transfer(item):
         return _default_transfer(pad(item))
+
+    return transfer
+
+
+def _sharded_transfer(sharding, policy=None):
+    """Device transfer that stages every batch leaf WITH the given batch
+    ``NamedSharding`` (``cached_step.TrainStep.batch_sharding``): the
+    prefetch thread's device_put already lands per-device shards on the
+    SPMD mesh, so the compiled step pays no re-placement — and under
+    multi-controller the host leaf is this process's shard of the global
+    batch (``parallel.spmd.put_batch`` assembles the global array).
+    Optional ``policy`` composes PR-4 bucket padding BEFORE the put."""
+    from .context import current_context
+    from .ndarray import NDArray
+    from .ndarray.ndarray import _wrap
+    from .parallel import spmd as _spmd
+
+    mesh = sharding.mesh
+    pad = _bucket_pad(policy) if policy is not None else (lambda x: x)
+
+    def put(x):
+        if isinstance(x, (tuple, list)):
+            return type(x)(put(v) for v in x)
+        if isinstance(x, NDArray):
+            data = _spmd.put_batch(x._data, mesh)
+            return x if data is x._data else _wrap(data, x.ctx, type(x))
+        import numpy as onp
+
+        return _wrap(_spmd.put_batch(onp.asarray(x), mesh),
+                     current_context())
+
+    def transfer(item):
+        return put(pad(item))
 
     return transfer
 
@@ -346,7 +385,8 @@ class DevicePrefetcher:
 
 
 def prefetch(source: Iterable, depth: Optional[int] = None,
-             transfer: Optional[Callable] = None, bucket: bool = False):
+             transfer: Optional[Callable] = None, bucket: bool = False,
+             sharding=None):
     """Wrap an iterable of host batches in a :class:`DevicePrefetcher`.
 
     ``depth`` defaults to ``MXNET_ENGINE_PREFETCH``; depth 0 (or
@@ -355,12 +395,22 @@ def prefetch(source: Iterable, depth: Optional[int] = None,
     call-site code identical.  ``bucket=True`` pads each batch's leading
     axis up to the ``MXNET_SHAPE_BUCKETS`` grid before the device_put
     (reusing PR 4's BucketPolicy) so variable-length streams stage a
-    bounded shape set."""
+    bounded shape set.  ``sharding`` (a batch ``NamedSharding``, e.g.
+    ``TrainStep.batch_sharding``) stages every leaf onto the SPMD mesh
+    — batch axis sharded over ``'dp'``, per-process shard of the global
+    batch under multi-controller — so sharded steps consume prefetched
+    batches without a re-placement copy."""
+    policy = None
     if bucket:
         from . import serving as _serving
 
-        policy = _serving.BucketPolicy()
-        if policy.enabled:
+        p = _serving.BucketPolicy()
+        if p.enabled:
+            policy = p
+    if transfer is None:
+        if sharding is not None:
+            transfer = _sharded_transfer(sharding, policy)
+        elif policy is not None:
             transfer = _bucket_transfer(policy)
     eff_depth = prefetch_depth() if depth is None else max(0, int(depth))
     if is_naive():

@@ -108,7 +108,8 @@ class DataLoader:
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=False, timeout=120,
-                 device_prefetch=False):
+                 device_prefetch=False, num_shards=None, shard_index=None,
+                 sharding=None):
         from .dataset import _CompiledTransformDataset
 
         # compiled batch-wise transform (dataset.transform(compiled=True)):
@@ -165,6 +166,41 @@ class DataLoader:
         # IO-prefetch stage.  False/0 (default) keeps the synchronous
         # per-batch device_put; NaiveEngine forces it off.
         self._device_prefetch = device_prefetch
+        # per-process sharded sampling (pod-scale SPMD input loading):
+        # the sampler still draws GLOBAL batches — identical sample order
+        # on every process — but each process fetches/batchifies only its
+        # contiguous ``shard_index`` slice, so input loading scales with
+        # the pod instead of replicating work.  num_shards='auto' follows
+        # jax (process_count/process_index); the global batch reassembles
+        # on device when ``sharding=`` is given (spmd.put_batch builds the
+        # global jax.Array from per-process shards).  Composes with
+        # last_batch='pad' (the GLOBAL batch pads first, then slices —
+        # every shard stays equal) and device_prefetch= (the slice rides
+        # the transfer thread); last_batch_valid keeps reporting the
+        # GLOBAL valid count.
+        if num_shards == "auto" or shard_index == "auto":
+            import jax
+
+            num_shards = jax.process_count() \
+                if num_shards == "auto" else num_shards
+            shard_index = jax.process_index() \
+                if shard_index == "auto" else shard_index
+        self._num_shards = max(1, int(num_shards)) if num_shards else 1
+        self._shard_index = int(shard_index) if shard_index is not None else 0
+        if not 0 <= self._shard_index < self._num_shards:
+            raise ValueError(
+                f"shard_index={self._shard_index} out of range for "
+                f"num_shards={self._num_shards}")
+        if self._num_shards > 1 and self._batch_size is not None and \
+                self._batch_size % self._num_shards != 0:
+            raise ValueError(
+                f"batch_size={self._batch_size} must divide evenly into "
+                f"num_shards={self._num_shards} (each process loads "
+                "batch_size/num_shards rows of the global batch)")
+        # sharding: a batch NamedSharding (TrainStep.batch_sharding) —
+        # _wrap stages every leaf onto the SPMD mesh instead of the
+        # single default device (one sharded device_put per leaf)
+        self._sharding = sharding
         self._prefetcher = None
         self._pool = None
         self._worker_pids: frozenset = frozenset()
@@ -211,6 +247,20 @@ class DataLoader:
             samples = [samples[i % valid] for i in range(self._batch_size)]
         return samples, valid
 
+    def _shard_slice(self, samples):
+        """This process's contiguous slice of one GLOBAL sample batch
+        (``num_shards``): concatenating the slices over shard_index 0..K-1
+        reproduces the global batch exactly, which is the device-side
+        assembly order ``spmd.put_batch`` uses under multi-controller.
+        Pad (``_pad_samples``) runs FIRST, so every shard stays equal on
+        the epoch tail."""
+        if self._num_shards <= 1:
+            return samples
+        n = len(samples)
+        start = (n * self._shard_index) // self._num_shards
+        end = (n * (self._shard_index + 1)) // self._num_shards
+        return samples[start:end]
+
     def __iter__(self):
         from ... import engine as _engine
 
@@ -250,6 +300,7 @@ class DataLoader:
         if self._num_workers == 0:
             for samples in self._batch_sampler:
                 samples, valid = self._pad_samples(samples)
+                samples = self._shard_slice(samples)
                 yield (self._batchify_fn(
                     [self._dataset[i] for i in samples]), valid)
             return
@@ -275,6 +326,7 @@ class DataLoader:
             if samples is None:
                 return None
             samples, valid = self._pad_samples(samples)
+            samples = self._shard_slice(samples)
             return [_submit(samples), samples, next_idx, 0, valid]
 
         try:
@@ -376,9 +428,24 @@ class DataLoader:
         return self._batch_transform(batch)
 
     def _wrap(self, batch):
-        """Host batch -> device NDArrays (the PrefetcherIter HBM staging)."""
+        """Host batch -> device NDArrays (the PrefetcherIter HBM staging).
+        With ``sharding=`` every leaf lands with the batch NamedSharding
+        on the SPMD mesh (global batch assembled from the per-process
+        shard under multi-controller) instead of the default device."""
         if isinstance(batch, tuple):
             return tuple(self._wrap(b) for b in batch)
+        if self._sharding is not None:
+            from ...context import current_context
+            from ...ndarray.ndarray import _wrap as _ndwrap
+            from ...parallel import spmd as _spmd
+
+            mesh = self._sharding.mesh
+            if isinstance(batch, NDArray):
+                data = _spmd.put_batch(batch._data, mesh)
+                return batch if data is batch._data \
+                    else _ndwrap(data, batch.ctx, type(batch))
+            return _ndwrap(_spmd.put_batch(onp.asarray(batch), mesh),
+                           current_context())
         if isinstance(batch, NDArray):
             return batch
         return array(batch)

@@ -3,9 +3,13 @@
 Applies an Optimizer to a set of Parameters, synchronizing gradients through
 a KVStore.  Call stack mirrors the reference (SURVEY.md §3.3):
 ``step() → _allreduce_grads() → _update()``.  On TPU the per-key reduce is a
-fused XLA computation; for mesh-sharded data-parallel training the same
-Trainer drives the ``mxnet_tpu.parallel`` compiled step where the reduce is
-``lax.psum`` over ICI.
+fused XLA computation; with ``kvstore='tpu'`` the compiled step
+(:meth:`Trainer.compile_step`) traces under a data-parallel SPMD mesh
+(``parallel.spmd``, knob ``MXNET_SPMD_MESH``) — batch sharded over
+``'dp'``, params/optimizer state replicated — so the gradient reduce is an
+ICI-native all-reduce the XLA partitioner schedules INSIDE the one donated
+program (docs/PERF.md "Pod-scale SPMD train step").  Existing user code is
+unchanged: the kvstore string is the whole opt-in.
 """
 from __future__ import annotations
 
@@ -170,8 +174,13 @@ class Trainer:
         analog for training (``cached_step.TrainStep``).  ``loss_fn(net,
         *args)`` returns the loss; the returned step object is called as
         ``step(*args, batch_size=...)`` and replaces the record/backward/
-        step() triple.  Ineligible setups (non-stageable forwards,
-        grad_req='add', multi-worker stores, server-side updates,
+        step() triple.  With ``kvstore='tpu'`` the step traces under the
+        data-parallel SPMD mesh (``MXNET_SPMD_MESH``): batch sharded
+        over ``'dp'``, params replicated, the all-reduce ICI-native
+        inside the program — stage inputs with ``step.batch_sharding``
+        (``engine.prefetch(sharding=)`` / ``DataLoader(sharding=)``) to
+        skip re-placement.  Ineligible setups (non-stageable forwards,
+        grad_req='add', host-driven dist stores, server-side updates,
         optimizers without a fused_update rule, or
         ``MXNET_COMPILED_STEP=0``) fall back to the eager tape
         transparently.

@@ -6,13 +6,16 @@ jitted program over a named ``jax.sharding.Mesh``:
 
 - :mod:`mesh`       — mesh construction / current-mesh scope
 - :mod:`sharding`   — ShardingPlan (name-pattern → PartitionSpec), fsdp/tp plans
+- :mod:`spmd`       — kvstore='tpu' data-parallel mesh plumbing (the
+  compiled-step / prefetcher / serving placement contract)
 - :mod:`collectives`— KVStore-flavoured named collectives (psum/all_gather/…)
 - :mod:`train`      — ShardedTrainer: whole train step as one SPMD program
 - :mod:`ring_attention` — sequence/context parallelism (absent upstream)
 - :mod:`moe`        — expert parallelism (absent upstream)
 - :mod:`pipeline`   — GPipe-style pipeline stages over ``pp``
 """
-from . import collectives, elastic, mesh, moe, pipeline, ring_attention, sharding, train
+from . import (collectives, elastic, mesh, moe, pipeline, ring_attention,
+               sharding, spmd, train)
 from .collectives import (all_gather, all_reduce, all_to_all, broadcast_from,
                           ppermute, reduce_scatter, ring_shift, run_sharded)
 from .mesh import AXIS_NAMES, auto_mesh, current_mesh, make_mesh, mesh_scope, set_mesh

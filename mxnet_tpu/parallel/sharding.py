@@ -13,15 +13,35 @@ gracefully instead of erroring.
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ShardingPlan", "fsdp_plan", "tensor_parallel_plan",
-           "replicated_plan", "shard_array", "constraint"]
+           "replicated_plan", "shard_array", "constraint",
+           "legalize_refusal_count", "reset_legalize_refusals"]
 
 Spec = PartitionSpec
+
+# legalization observability: every spec dim REFUSED (replicated) because
+# the shape could not divide the mesh axis evenly.  Refusal is the
+# mid-trace-safe half of "pad-or-refuse": a traced value's shape is
+# frozen, so padding belongs to the batch boundary (DataLoader
+# last_batch='pad', serving buckets) — here the offending dim degrades
+# to replication, counted and (on the constraint path) warned.
+_LEGALIZE_REFUSALS = 0
+_WARNED_REFUSALS: set = set()
+
+
+def legalize_refusal_count() -> int:
+    return _LEGALIZE_REFUSALS
+
+
+def reset_legalize_refusals() -> None:
+    global _LEGALIZE_REFUSALS
+    _LEGALIZE_REFUSALS = 0
 
 
 def _axis_size(mesh: Mesh, axes) -> int:
@@ -35,9 +55,14 @@ def _axis_size(mesh: Mesh, axes) -> int:
     return mesh.shape[axes]
 
 
-def _legalize(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+def _legalize(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh,
+              loud: bool = False) -> PartitionSpec:
     """Drop sharding on dims the shape can't evenly divide, and on axes the
-    mesh doesn't have."""
+    mesh doesn't have.  Divisibility refusals are counted
+    (:func:`legalize_refusal_count`) and, with ``loud=True`` (the
+    :func:`constraint` path), warned once per (shape, spec) — degrading a
+    constraint must never be silent, and erroring mid-trace is worse."""
+    global _LEGALIZE_REFUSALS
     out = []
     padded = (tuple(spec) + (None,) * len(shape))[: len(shape)]
     for i, axes in enumerate(padded):
@@ -50,7 +75,21 @@ def _legalize(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh) -> Partit
             out.append(None)
             continue
         n = _axis_size(mesh, ax_tuple)
-        if n == 1 or shape[i] % n != 0:
+        if n == 1:
+            out.append(None)
+        elif shape[i] % n != 0:
+            _LEGALIZE_REFUSALS += 1
+            if loud:
+                key = (tuple(shape), i, ax_tuple, n)
+                if key not in _WARNED_REFUSALS:
+                    _WARNED_REFUSALS.add(key)
+                    warnings.warn(
+                        f"sharding constraint refused on dim {i} of shape "
+                        f"{tuple(shape)}: {shape[i]} is not divisible by "
+                        f"the {n}-way mesh axis {ax_tuple} — dim "
+                        "REPLICATED instead (pad the value at the batch "
+                        "boundary, e.g. DataLoader(last_batch='pad') or "
+                        "a bucket grid, to shard it)", stacklevel=4)
             out.append(None)
         else:
             out.append(ax_tuple[0] if len(ax_tuple) == 1 else ax_tuple)
@@ -159,26 +198,68 @@ def shard_array(arr: jax.Array, mesh: Mesh, spec: PartitionSpec) -> jax.Array:
     return jax.device_put(arr, NamedSharding(mesh, _legalize(spec, tuple(arr.shape), mesh)))
 
 
+def _ambient_mesh():
+    """The mesh jax itself already has in scope — works INSIDE a traced
+    fn, where no explicit mesh was threaded through: first the classic
+    ``with mesh:`` context (thread_resources physical mesh — what
+    ``mesh_scope`` enters), then the newer abstract-mesh ambient
+    (``jax.sharding.get_abstract_mesh``, private fallback on older jax).
+    Returns ``None`` when there is genuinely no mesh anywhere."""
+    try:
+        from jax._src import mesh as _jm
+
+        pm = _jm.thread_resources.env.physical_mesh
+        if pm is not None and not getattr(pm, "empty", True):
+            return pm
+    except Exception:
+        pass
+    get_ambient = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_ambient is None:
+        try:
+            from jax._src.mesh import get_abstract_mesh as get_ambient
+        except ImportError:
+            get_ambient = None
+    ambient = get_ambient() if get_ambient is not None else None
+    if ambient is not None and getattr(ambient, "shape", None):
+        return ambient
+    return None
+
+
 def constraint(x, spec: Union[PartitionSpec, Sequence], mesh: Optional[Mesh] = None):
-    """``lax.with_sharding_constraint`` that no-ops only when there is
-    genuinely no mesh in scope (keeps model code mesh-agnostic).  With a mesh
-    present, a spec naming an unknown axis still raises — a typo'd axis must
-    not silently drop the constraint."""
+    """``lax.with_sharding_constraint`` that keeps model code
+    mesh-agnostic and mid-trace-safe:
+
+    - ``mesh=None`` resolves the ENCLOSING mesh — ``mesh_scope``'s
+      current mesh, the ``with mesh:`` jax context, or the abstract
+      ambient mesh — so a constraint inside a traced fn never needs the
+      mesh threaded through the call stack.  No mesh anywhere: no-op.
+    - The spec is legalized against the value's (static) shape before it
+      reaches XLA: a dim the mesh axis cannot divide evenly is REFUSED
+      (replicated) loudly — warned + counted in
+      :func:`legalize_refusal_count` — instead of erroring mid-trace.
+      Padding is the caller's move, at the batch boundary.
+    - A spec naming an axis the mesh does not have still raises — a
+      typo'd axis must not silently drop the constraint.
+    """
     if mesh is None:
         from .mesh import current_mesh
 
         mesh = current_mesh()
     if mesh is None:
-        # jax.sharding.get_abstract_mesh is newer-jax API; older jax keeps
-        # it in jax._src.mesh (and may have no ambient-mesh notion at all)
-        get_ambient = getattr(jax.sharding, "get_abstract_mesh", None)
-        if get_ambient is None:
-            try:
-                from jax._src.mesh import get_abstract_mesh as get_ambient
-            except ImportError:
-                get_ambient = None
-        ambient = get_ambient() if get_ambient is not None else None
-        if ambient is None or not getattr(ambient, "shape", None):
-            return x  # no mesh anywhere: mesh-agnostic no-op
-        return jax.lax.with_sharding_constraint(x, spec)
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+        mesh = _ambient_mesh()
+    if mesh is None or not getattr(mesh, "shape", None):
+        return x  # no mesh anywhere: mesh-agnostic no-op
+    spec = spec if isinstance(spec, PartitionSpec) else PartitionSpec(*spec)
+    known = set(mesh.shape)
+    for axes in tuple(spec):
+        for a in (axes if isinstance(axes, (tuple, list)) else (axes,)):
+            if a is not None and a not in known:
+                raise ValueError(
+                    f"sharding constraint names axis {a!r} but the mesh "
+                    f"in scope only has {sorted(known)} — a typo'd axis "
+                    "must not silently drop the constraint")
+    lspec = _legalize(spec, tuple(getattr(x, "shape", ())), mesh, loud=True)
+    if isinstance(mesh, Mesh):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, lspec))
+    # abstract ambient mesh: a bare PartitionSpec resolves against it
+    return jax.lax.with_sharding_constraint(x, lspec)

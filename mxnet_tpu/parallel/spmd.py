@@ -1,0 +1,250 @@
+"""Pod-scale SPMD data parallelism: ``kvstore='tpu'`` as mesh sharding.
+
+The reference scales data-parallel training through KVStore push/pull
+(``src/kvstore/``): the Trainer pushes gradients, a comm backend
+(NCCL rings / ps-lite servers) reduces them, and the workers pull the
+result — a host-driven collective standing OUTSIDE the computation.
+TPU-native, the same contract is a *sharding*: parameters and optimizer
+state replicate across a named ``jax.sharding.Mesh`` ``'dp'`` axis, the
+batch shards over it, and the gradient all-reduce becomes an ICI-native
+collective the XLA SPMD partitioner schedules INSIDE the one donated
+train-step program (arXiv:2301.13062 — collectives the compiler sees can
+overlap backward; arXiv:2008.01040 — padding/placement is where TPU
+performance lives).  ``Trainer(..., kvstore='tpu')`` +
+``Trainer.compile_step`` route through here with zero user-code changes.
+
+This module owns the placement plumbing shared by ``cached_step``
+(training), ``engine.DevicePrefetcher`` (input staging), ``serving``
+(replicated inference) and the DataLoader (per-process sharded
+sampling):
+
+- :func:`mesh_for_store` — resolve the data-parallel mesh for a kvstore
+  type under the ``MXNET_SPMD_MESH`` knob (``auto`` = every visible
+  device on the ``'dp'`` axis; an int = that many devices; ``off``
+  disables; ``dp=4,tp=2`` spec strings go through
+  :func:`mesh.make_mesh`).
+- :func:`put_batch` — stage one batch leaf with the batch
+  ``NamedSharding`` (site ``spmd.put``, shared retry policy).  Under
+  multi-controller the host array is this process's shard of the
+  GLOBAL batch (the DataLoader ``num_shards`` contract) and the global
+  array assembles via ``jax.make_array_from_process_local_data``.
+  A batch axis the mesh cannot divide evenly is REPLICATED instead —
+  loudly (:func:`replicated_batch_count` + a warning), never an error
+  mid-step and never silent.
+- :func:`ensure_placed` — idempotent replicated placement for
+  parameters/optimizer state; every actual device_put is counted
+  (:func:`reshard_count`) so the dispatch-budget gate can pin
+  "0 host-side cross-device copies in steady state".
+"""
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Optional, Tuple
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .. import config as _config
+from .. import faults as _faults
+from .mesh import make_mesh
+
+__all__ = ["DATA_AXIS", "mesh_for_store", "resolve_mesh", "batch_sharding",
+           "replicated", "batch_spec_for", "put_batch", "ensure_placed",
+           "mesh_key", "reshard_count", "replicated_batch_count",
+           "reset_counters"]
+
+# the canonical data-parallel axis (mesh.AXIS_NAMES's 'dp'): the KVStore
+# axis — gradients all-reduce over it, the batch shards over it
+DATA_AXIS = "dp"
+
+# kvstore types whose reduce is the ICI-collective mesh path.  dist/
+# ps-lite-style stores stay host-driven and keep the eager fallback.
+_MESH_STORES = ("tpu", "nccl")
+
+_lock = threading.Lock()
+# param/state leaves actually moved by ensure_placed (first-step placement
+# is expected; a steady-state bump is a silent cross-device copy — the
+# budget gate pins it at 0 after warmup)
+_RESHARD_COUNT = 0
+# batches replicated because the 'dp' axis could not divide the batch
+# axis evenly (correct, but no scale-out for that step — loud by contract)
+_REPLICATED_BATCH_COUNT = 0
+_WARNED_SHAPES: set = set()
+
+
+def reshard_count() -> int:
+    return _RESHARD_COUNT
+
+
+def replicated_batch_count() -> int:
+    return _REPLICATED_BATCH_COUNT
+
+
+def reset_counters() -> None:
+    global _RESHARD_COUNT, _REPLICATED_BATCH_COUNT
+    _RESHARD_COUNT = 0
+    _REPLICATED_BATCH_COUNT = 0
+
+
+# ---------------------------------------------------------------------------
+# mesh resolution (MXNET_SPMD_MESH)
+# ---------------------------------------------------------------------------
+
+def resolve_mesh(spec: Optional[str] = None) -> Optional[Mesh]:
+    """Resolve ``MXNET_SPMD_MESH`` (or an explicit spec string) into a
+    data-parallel mesh, or ``None`` when SPMD is off.
+
+    - ``auto`` (default): every visible device on the ``'dp'`` axis;
+      a single-device world resolves to ``None`` (the plain single-chip
+      compiled step — no behavior change off-pod).
+    - ``0`` / ``off`` / ``none``: disabled.
+    - ``<int>``: that many devices on ``'dp'`` (``1`` gives a real
+      1-device mesh — the parity oracle for sharded-vs-single tests).
+    - ``dp=4,tp=2`` style: axis spec via :func:`mesh.make_mesh` (the
+      compiled step shards the batch over ``'dp'`` only; other axes need
+      a ShardingPlan and ride :class:`~.train.ShardedTrainer`).
+    """
+    raw = spec if spec is not None else _config.get("MXNET_SPMD_MESH")
+    raw = (raw or "auto").strip().lower()
+    if raw in ("0", "off", "none", "disabled"):
+        return None
+    devices = jax.devices()
+    if raw in ("auto", ""):
+        if len(devices) < 2:
+            return None
+        return make_mesh({DATA_AXIS: len(devices)}, devices)
+    if raw.isdigit():
+        n = int(raw)
+        if n < 1:
+            return None
+        if n > len(devices):
+            raise ValueError(
+                f"MXNET_SPMD_MESH={n} needs {n} devices, only "
+                f"{len(devices)} visible")
+        return make_mesh({DATA_AXIS: n}, devices[:n])
+    axes = {}
+    for part in raw.split(","):
+        if not part.strip():
+            continue
+        k, _, v = part.partition("=")
+        axes[k.strip()] = int(v)
+    if DATA_AXIS not in axes:
+        raise ValueError(
+            f"MXNET_SPMD_MESH={raw!r} must name the '{DATA_AXIS}' axis "
+            "(e.g. 'dp=8'), or be 'auto'/'off'/an integer")
+    return make_mesh(axes, devices)
+
+
+def mesh_for_store(kv_type: Optional[str]) -> Optional[Mesh]:
+    """The mesh a :class:`~mxnet_tpu.cached_step.TrainStep` should trace
+    under for a given kvstore type: the resolved ``MXNET_SPMD_MESH``
+    mesh for the ICI-collective stores (``'tpu'``/``'nccl'``), ``None``
+    (single-chip path) for everything else."""
+    if kv_type is None or kv_type.lower() not in _MESH_STORES:
+        return None
+    return resolve_mesh()
+
+
+# ---------------------------------------------------------------------------
+# shardings
+# ---------------------------------------------------------------------------
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Params / optimizer state / scalars: one replica per mesh device
+    (the KVStore broadcast contract)."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """The canonical batch placement: axis 0 split over ``'dp'``."""
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+
+def batch_spec_for(shape: Tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+    """Legalized batch spec for one leaf: ``P('dp')`` when the batch
+    axis divides evenly, ``P()`` (replicated, counted + warned once per
+    shape) otherwise.  Never raises mid-step."""
+    global _REPLICATED_BATCH_COUNT
+    n = int(mesh.shape.get(DATA_AXIS, 1))
+    if n <= 1 or not shape:
+        return PartitionSpec()      # scalars replicate, silently
+    if shape[0] % n != 0:
+        with _lock:
+            _REPLICATED_BATCH_COUNT += 1
+            key = (tuple(shape), n)
+            if key not in _WARNED_SHAPES:
+                _WARNED_SHAPES.add(key)
+                warnings.warn(
+                    f"SPMD batch axis {shape[0]} is not divisible by the "
+                    f"{n}-way '{DATA_AXIS}' mesh axis; this input is "
+                    "REPLICATED for correctness (no data-parallel speedup "
+                    "for it). Pad the batch (e.g. "
+                    "DataLoader(last_batch='pad')) or pick a divisible "
+                    "batch size.", stacklevel=3)
+        return PartitionSpec()
+    return PartitionSpec(DATA_AXIS)
+
+
+def mesh_key(mesh: Optional[Mesh]):
+    """Hashable program-cache key component: the mesh's axes and exact
+    device set (a different topology must never reuse a program)."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names),
+            tuple(int(s) for s in mesh.devices.shape),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+def _equivalently_placed(arr, sharding: NamedSharding) -> bool:
+    cur = getattr(arr, "sharding", None)
+    if cur is None:
+        return False
+    # uncommitted arrays sit on the default device only by accident —
+    # they must be pinned to the mesh explicitly once
+    if not getattr(arr, "committed", True):
+        return False
+    try:
+        return cur.is_equivalent_to(sharding, arr.ndim)
+    except Exception:
+        return False
+
+
+def ensure_placed(arr: jax.Array, sharding: NamedSharding) -> jax.Array:
+    """Idempotent placement: return ``arr`` untouched when it already
+    carries an equivalent sharding, else ``device_put`` it (counted in
+    :func:`reshard_count` — steady state must not pay this)."""
+    global _RESHARD_COUNT
+    if _equivalently_placed(arr, sharding):
+        return arr
+    with _lock:
+        _RESHARD_COUNT += 1
+    return jax.device_put(arr, sharding)
+
+
+def _put_batch_once(arr, sharding: NamedSharding):
+    if jax.process_count() > 1:
+        # multi-controller: ``arr`` is this process's contiguous shard of
+        # the global batch (the DataLoader num_shards contract); assemble
+        # the global jax.Array from per-process local data
+        return jax.make_array_from_process_local_data(
+            sharding, onp.asarray(arr))
+    return jax.device_put(arr, sharding)
+
+
+def put_batch(arr, mesh: Mesh):
+    """Stage one batch leaf onto the mesh with the legalized batch
+    sharding (already-staged leaves — the DevicePrefetcher path — pass
+    through untouched).  A transient transfer failure retries under the
+    shared policy (site ``spmd.put``), mirroring ``engine.prefetch``."""
+    shape = tuple(getattr(arr, "shape", ()))
+    sharding = NamedSharding(mesh, batch_spec_for(shape, mesh))
+    if isinstance(arr, jax.Array) and _equivalently_placed(arr, sharding):
+        return arr
+    return _faults.retry_call(_put_batch_once, arr, sharding,
+                              site="spmd.put")

@@ -211,9 +211,19 @@ class ServingEngine:
     def __init__(self, net, max_batch: Optional[int] = None,
                  max_delay_us: Optional[int] = None,
                  verify: Optional[bool] = None,
-                 policy: Optional[BucketPolicy] = None):
+                 policy: Optional[BucketPolicy] = None,
+                 mesh=None):
         self._net = net
         self._policy = policy or BucketPolicy()
+        # replicated SPMD inference (the kvstore='tpu' serving
+        # counterpart): with a mesh, parameters replicate across the
+        # 'dp' axis and each coalesced batch shards over it, so
+        # throughput scales with the same mesh the train step uses.
+        # Still one compiled launch per dispatched batch — the SPMD
+        # partitioner fans the work out, not the host.  An indivisible
+        # batch axis replicates (loud, spmd.replicated_batch_count);
+        # the pow2 bucket grid keeps coalesced batches divisible.
+        self._mesh = mesh
         self._max_batch = (max_batch if max_batch is not None
                            else _config.get("MXNET_SERVE_MAX_BATCH"))
         self._max_delay = (max_delay_us if max_delay_us is not None
@@ -308,6 +318,8 @@ class ServingEngine:
         out = dict(self._stats)
         out["programs"] = len(self._programs)
         out["bucket_refused"] = self.bucket_refused
+        out["mesh_devices"] = (self._mesh.devices.size
+                               if self._mesh is not None else 1)
         lat = sorted(self._latencies)
         if lat:
             out["p50_us"] = lat[len(lat) // 2] * 1e6
@@ -546,6 +558,16 @@ class ServingEngine:
             self._programs.move_to_end(sig)
         jitted, names, params, out_struct, mutated_names = rec
 
+        if self._mesh is not None:
+            from .parallel import spmd as _spmd
+
+            rep = _spmd.replicated(self._mesh)
+            for n in names:
+                d = params[n]._data[0]
+                new = _spmd.ensure_placed(d._data, rep)
+                if new is not d._data:
+                    d._set_data(new)      # once; steady state passes through
+            batched = [_spmd.put_batch(b, self._mesh) for b in batched]
         param_arrays = [params[n]._data[0]._data for n in names]
         out_arrays, mut_vals = jitted(batched, param_arrays,
                                       _random.next_key())
@@ -659,7 +681,23 @@ class ServingEngine:
 
     def _eager_forward(self, args):
         """The unpadded reference: plain eager ops (hybridize bypassed),
-        inference mode."""
+        inference mode.  Under a mesh the request args stage replicated
+        first — eager ops require operands colocated, and the parameters
+        already live replicated across the mesh."""
+        if self._mesh is not None:
+            from .parallel import spmd as _spmd
+
+            rep = _spmd.replicated(self._mesh)
+
+            def _rep(a):
+                if isinstance(a, (tuple, list)):
+                    return type(a)(_rep(v) for v in a)
+                if hasattr(a, "_data"):
+                    from .ndarray.ndarray import _wrap as _ndw
+
+                    return _ndw(jax.device_put(a._data, rep), a.ctx, type(a))
+                return a
+            args = tuple(_rep(a) for a in args)
         with autograd.pause():
             return self._net.forward(*args)
 

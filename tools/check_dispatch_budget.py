@@ -33,6 +33,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# standalone runs need the virtual multi-device CPU world BEFORE jax
+# initializes (the suite's conftest already provides it in-process)
+if "jax" not in sys.modules and "--xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
 # the budget the docs promise (docs/PERF.md "Compiled whole-train-step" +
 # "Pipelined train loop"): a steady-state non-AMP compiled step performs
 # ZERO blocking host syncs; with AMP at most ONE read per step, and it
@@ -45,12 +52,24 @@ AMP_BUDGET = {"host_syncs_per_step": 1, "deferred_reads_per_step": 1}
 # batching"): steady state over a variable-length stream
 INFER_BUDGET = {"launches_per_batch": 1, "retraces_after_warm": 0,
                 "programs_over_buckets": 0}
+# the MESH budget (docs/PERF.md "Pod-scale SPMD train step"): under
+# kvstore='tpu' the data-parallel step stays ONE compiled launch — the
+# SPMD partitioner fans out over the mesh, never the host (no per-chip
+# dispatch fan-out) — with ZERO steady-state host-side cross-device
+# copies (params/state placed once; prefetched/sharded batches pass
+# through; spmd.reshard_count stays flat) and every batch truly sharded
+# (spmd.replicated_batch_count flat: an indivisible batch would silently
+# run replicated = un-scaled)
+MESH_BUDGET = {"compiled_launches_per_step": 1, "eager_invokes_per_step": 0,
+               "group_launches_per_step": 0, "retraces_after_warm": 0,
+               "host_syncs_per_step": 0, "reshards_after_warm": 0,
+               "replicated_batches": 0}
 STEPS = 5
 INFER_REQUESTS = 24
 INFER_MAXLEN = 16
 
 
-def _build(seed: int = 0):
+def _build(seed: int = 0, rows: int = 6, kvstore: str = "device"):
     import numpy as onp
 
     import mxnet_tpu as mx
@@ -73,9 +92,10 @@ def _build(seed: int = 0):
         p.data()._set_data(mx.nd.array(rng.randn(*p.shape) * 0.1)._data)
     net.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.1, "momentum": 0.9})
-    data = mx.nd.array(rng.randn(6, 8))
-    label = mx.nd.array(rng.randn(6, 4))
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore=kvstore)
+    data = mx.nd.array(rng.randn(rows, 8))
+    label = mx.nd.array(rng.randn(rows, 4))
     loss_fn = lambda n, x, y: ((n(x) - y) ** 2).mean()
     return net, trainer, loss_fn, data, label
 
@@ -127,6 +147,58 @@ def _measure(compiled: bool, with_amp: bool = False) -> dict:
     out["dispatches_per_step"] = (out["eager_invokes_per_step"]
                                   + out["compiled_launches_per_step"]
                                   + out["group_launches_per_step"])
+    return out
+
+
+def _measure_mesh() -> dict:
+    """kvstore='tpu' under the 8-device mesh: the data-parallel step must
+    stay ONE compiled launch (the partitioner fans out, not the host),
+    re-trace 0, and perform zero steady-state host-side cross-device
+    copies or silently-replicated batches."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import cached_step
+    from mxnet_tpu.ndarray import ndarray as _ndmod
+    from mxnet_tpu.optimizer import fused
+    from mxnet_tpu.parallel import spmd
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"mode": "mesh", "skipped": f"only {n_dev} device(s)"}
+    # 2 rows per device: divisible batch, truly sharded
+    net, trainer, loss_fn, data, label = _build(
+        seed=2, rows=2 * n_dev, kvstore="tpu")
+    step = trainer.compile_step(net, loss_fn)
+
+    loss = step(data, label, batch_size=2 * n_dev)      # warm
+    float(loss.asnumpy().ravel()[0])
+    inv0, d0, f0, t0 = (_ndmod.invoke_count(), cached_step.dispatch_count(),
+                        fused.dispatch_count(), cached_step.trace_count())
+    h0 = _ndmod.host_sync_count()
+    r0, b0 = spmd.reshard_count(), spmd.replicated_batch_count()
+    for _ in range(STEPS):
+        loss = step(data, label, batch_size=2 * n_dev)
+    h1 = _ndmod.host_sync_count()
+    r1, b1 = spmd.reshard_count(), spmd.replicated_batch_count()
+    float(loss.asnumpy().ravel()[0])
+    weight = net.collect_params()["d1.weight"].data()._data
+    out = {
+        "mode": "mesh",
+        "skipped": None,
+        "used_compiled": step.last_step_compiled,
+        "mesh_active": step.mesh is not None,
+        "mesh_devices": len(weight.sharding.device_set),
+        "n_devices": n_dev,
+        "eager_invokes_per_step": (_ndmod.invoke_count() - inv0) / STEPS,
+        "compiled_launches_per_step":
+            (cached_step.dispatch_count() - d0) / STEPS,
+        "group_launches_per_step": (fused.dispatch_count() - f0) / STEPS,
+        "retraces_after_warm": cached_step.trace_count() - t0,
+        "host_syncs_per_step": (h1 - h0) / STEPS,
+        "reshards_after_warm": r1 - r0,
+        "replicated_batches": b1 - b0,
+    }
     return out
 
 
@@ -190,6 +262,15 @@ def main() -> int:
           f"{infer['launches_per_batch']:.1f} launches/batch, "
           f"{infer['retraces_after_warm']} retraces, "
           f"{infer['programs']} programs over {infer['buckets']} buckets")
+    mesh = _measure_mesh()
+    if mesh["skipped"]:
+        print(f"mesh       SKIPPED ({mesh['skipped']})")
+    else:
+        print(f"{'mesh':<10} {mesh['mesh_devices']} devices -> "
+              f"{mesh['compiled_launches_per_step']:.1f} launch/step, "
+              f"{mesh['retraces_after_warm']} retraces, "
+              f"{mesh['reshards_after_warm']} reshards, "
+              f"{mesh['replicated_batches']} replicated batches")
     failures = []
     if not compiled["used_compiled"]:
         failures.append("compiled mode fell back to the eager tape")
@@ -215,6 +296,20 @@ def main() -> int:
         if infer[key] > budget:
             failures.append(
                 f"serving {key} = {infer[key]} exceeds budget {budget}")
+    if not mesh["skipped"]:
+        if not mesh["used_compiled"]:
+            failures.append("mesh mode fell back to the eager tape")
+        if not mesh["mesh_active"]:
+            failures.append(
+                "kvstore='tpu' did not resolve an SPMD mesh")
+        if mesh["mesh_devices"] != mesh["n_devices"]:
+            failures.append(
+                f"params replicated over {mesh['mesh_devices']} devices, "
+                f"expected {mesh['n_devices']}")
+        for key, budget in MESH_BUDGET.items():
+            if mesh[key] > budget:
+                failures.append(
+                    f"mesh {key} = {mesh[key]} exceeds budget {budget}")
     if failures:
         print("check_dispatch_budget: FAILED —", "; ".join(failures),
               file=sys.stderr)
@@ -228,7 +323,11 @@ def main() -> int:
           f"{eager['dispatches_per_step']:.0f}); serving within budget "
           f"({infer['launches_per_batch']:.0f} launch/batch, "
           f"{infer['retraces_after_warm']} retraces, "
-          f"{infer['programs']} programs <= {infer['buckets']} buckets)")
+          f"{infer['programs']} programs <= {infer['buckets']} buckets)"
+          + ("" if mesh["skipped"] else
+             f"; mesh within budget ({mesh['mesh_devices']}-device SPMD, "
+             f"{mesh['compiled_launches_per_step']:.0f} launch/step, "
+             f"{mesh['reshards_after_warm']} steady-state reshards)"))
     return 0
 
 

@@ -193,8 +193,10 @@ step = trainer.compile_step(net, loss_fn)
 x = mx.nd.array(rng.randn(128, WIDTH).astype(onp.float32))
 y = mx.nd.array(rng.randn(128, WIDTH).astype(onp.float32))
 
+t_c = time.perf_counter()
 loss = step(x, y, batch_size=128)          # warm: trace + compile
 _ = float(loss.asnumpy().ravel()[0])       # drain
+compile_s = time.perf_counter() - t_c
 inv0, d0, f0, t0 = (_ndmod.invoke_count(), cached_step.dispatch_count(),
                     _fused.dispatch_count(), cached_step.trace_count())
 c0 = dict(cached_step.cache_stats())
@@ -206,6 +208,8 @@ dt = time.perf_counter() - t_start
 c1 = cached_step.cache_stats()
 
 import jax
+from mxnet_tpu import program_store
+_disk = program_store.disk_stats()
 print(json.dumps({
     "platform": jax.default_backend(),
     "compiled": step.last_fallback_reason is None,
@@ -217,8 +221,11 @@ print(json.dumps({
     "compiled_launches_per_step":
         (cached_step.dispatch_count() - d0) / STEPS,
     "retrace_count": cached_step.trace_count() - t0,
-    "cache_hits": c1["hits"] - c0["hits"],
-    "cache_misses": c1["misses"] - c0["misses"],
+    "program_cache_hits": c1["hits"] - c0["hits"],
+    "program_cache_misses": c1["misses"] - c0["misses"],
+    "compile_s": round(compile_s, 3),
+    "cache_hits": _disk["hits"],
+    "cache_misses": _disk["misses"],
     "us_per_step": dt / STEPS * 1e6,
 }))
 """
@@ -331,8 +338,10 @@ def main() -> None:
               f"{c['steps']} steps)")
         print(f"dispatches/step {c['dispatches_per_step']:.1f} "
               f"(compiled launches {c['compiled_launches_per_step']:.1f}), "
-              f"retraces {c['retrace_count']}, cache "
-              f"{c['cache_hits']}h/{c['cache_misses']}m, "
+              f"retraces {c['retrace_count']}, program cache "
+              f"{c['program_cache_hits']}h/{c['program_cache_misses']}m, "
+              f"compile {c['compile_s']:.1f}s (disk "
+              f"{c['cache_hits']}h/{c['cache_misses']}m), "
               f"{c['us_per_step']:.1f} us/step")
 
 

@@ -140,11 +140,15 @@ def run(per_chip: int = PER_CHIP, steps: int = STEPS,
         sizes = [s for s in (1, 2, 4, 8, 16, 32, 64) if s <= n]
         if n not in sizes:
             sizes.append(n)
+    from mxnet_tpu import program_store
+
+    t_c0 = program_store.compile_seconds()
     curve = [_lane(s, per_chip, steps) for s in sizes]
     base = curve[0]["img_s_per_chip"]
     for lane in curve:
         lane["efficiency"] = lane["img_s_per_chip"] / base if base else 0.0
     head = curve[-1]
+    disk = program_store.disk_stats()
     return {
         "metric": "multichip_img_s_per_chip",
         "value": head["img_s_per_chip"],
@@ -155,6 +159,10 @@ def run(per_chip: int = PER_CHIP, steps: int = STEPS,
         "platform": jax.default_backend(),
         "scaling_efficiency": head["efficiency"],
         "step_ms_std_max": max(l["step_ms_std"] for l in curve),
+        # one program per mesh size: the cold-start tax this lane pays
+        "compile_s": round(program_store.compile_seconds() - t_c0, 3),
+        "cache_hits": disk["hits"],
+        "cache_misses": disk["misses"],
         "curve": curve,
     }
 

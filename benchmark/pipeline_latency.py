@@ -82,8 +82,10 @@ def _run_loop(pipelined: bool) -> dict:
         batches = _host_batches()
         # warm: trace + compile outside the timed region
         wx, wy = batches[0]
+        t_c = time.perf_counter()
         loss = step(mx.nd.array(wx), mx.nd.array(wy), batch_size=BATCH)
         float(loss.asnumpy().ravel()[0])
+        compile_s = time.perf_counter() - t_c
         engine.waitall()
 
         loss_metric = metric.Loss()
@@ -131,6 +133,7 @@ def _run_loop(pipelined: bool) -> dict:
                 metric.host_sync_count() - ms0,
             "wall_us": round(wall_us, 1),
             "compiled": step.last_step_compiled,
+            "compile_s": round(compile_s, 3),
         })
         if pf is not None:
             s = pf.stats()
@@ -145,13 +148,19 @@ def _run_loop(pipelined: bool) -> dict:
 def run() -> dict:
     import jax
 
+    from mxnet_tpu import program_store
+
     sync = _run_loop(False)
     pipe = _run_loop(True)
     gap_s, gap_p = sync["device_idle_gap_us"], pipe["device_idle_gap_us"]
+    disk = program_store.disk_stats()
     return {
         "platform": jax.default_backend(),
         "steps": STEPS,
         "depth": DEPTH,
+        "compile_s": round(sync["compile_s"] + pipe["compile_s"], 3),
+        "cache_hits": disk["hits"],
+        "cache_misses": disk["misses"],
         "sync": sync,
         "pipelined": pipe,
         "steady_ahead_depth": pipe.get("steady_ahead_depth", 0),

@@ -79,6 +79,7 @@ _LAZY = {
     "parallel": ".parallel",
     "runtime": ".runtime",
     "cached_step": ".cached_step",
+    "program_store": ".program_store",
     "serving": ".serving",
     "test_utils": ".test_utils",
     "recordio": ".recordio",

@@ -61,34 +61,35 @@ from . import autograd
 from . import config as _config
 from . import engine as _engine
 from . import faults as _faults
+from . import program_store as _pstore
 from . import random as _random
 from .context import current_context
 
 __all__ = ["TrainStep", "enabled", "trace_count", "dispatch_count",
            "cache_stats", "deferred_read_count", "reset_counters"]
 
-# observability, mirroring optimizer/fused.py: _TRACE_COUNT bumps when a
-# whole-step program body is (re)traced, _DISPATCH_COUNT per compiled
-# launch, and the cache counters track the shape-keyed program cache.
-# tests assert re-trace stays at 0 across constant-shape steps and
-# benchmark/eager_latency.py reports dispatches/step (the bar: 1).
-_TRACE_COUNT = 0
-_DISPATCH_COUNT = 0
-_CACHE_HITS = 0
-_CACHE_MISSES = 0
+# observability: this module's programs live in the ProgramStore
+# 'train_step' namespace — traces bump when a whole-step program body is
+# (re)traced, dispatches per compiled launch, hits/misses/evictions
+# track the shape-keyed program cache.  The module-level functions below
+# are views over that one shared surface (tools/check_dispatch_budget.py
+# and benchmark/eager_latency.py read them; the bar: 1 dispatch/step,
+# 0 retraces after warm-up).
+_NS = _pstore.namespace("train_step")
 _DEFERRED_READ_COUNT = 0
 
 
 def trace_count() -> int:
-    return _TRACE_COUNT
+    return _NS.traces
 
 
 def dispatch_count() -> int:
-    return _DISPATCH_COUNT
+    return _NS.dispatches
 
 
 def cache_stats() -> Dict[str, int]:
-    return {"hits": _CACHE_HITS, "misses": _CACHE_MISSES}
+    return {"hits": _NS.hits, "misses": _NS.misses,
+            "evictions": _NS.evictions}
 
 
 def deferred_read_count() -> int:
@@ -100,12 +101,8 @@ def deferred_read_count() -> int:
 
 
 def reset_counters() -> None:
-    global _TRACE_COUNT, _DISPATCH_COUNT, _CACHE_HITS, _CACHE_MISSES, \
-        _DEFERRED_READ_COUNT
-    _TRACE_COUNT = 0
-    _DISPATCH_COUNT = 0
-    _CACHE_HITS = 0
-    _CACHE_MISSES = 0
+    global _DEFERRED_READ_COUNT
+    _NS.reset()
     _DEFERRED_READ_COUNT = 0
 
 
@@ -138,7 +135,10 @@ class TrainStep:
         self._net = net
         self._loss_fn = loss_fn
         self._trainer = trainer
-        self._programs: "OrderedDict" = OrderedDict()
+        # this step's keyspace in the ProgramStore 'train_step'
+        # namespace: shared eviction (cap MXNET_COMPILED_STEP_CACHE /
+        # MXNET_PROGRAM_CACHE_CAPS) + shared metrics, per-instance keys
+        self._programs = _pstore.scope("train_step")
         # sticky: set on a staging/trace failure — the forward cannot
         # stage, so every later call takes the eager tape directly
         self.fallback_reason: Optional[str] = None
@@ -424,20 +424,19 @@ class TrainStep:
         return loss
 
     # -- the compiled step ------------------------------------------------
-    def _compiled_step(self, args, batch_size):
-        global _DISPATCH_COUNT, _CACHE_HITS, _CACHE_MISSES
-        from .gluon import block as _gb
-        from .ndarray import ndarray as _ndmod
+    def _prep(self):
+        """State-side preparation shared by dispatch and
+        :meth:`precompile`: parameter/optimizer-state layout, update
+        groups, and (under a mesh) the one-time replicated placement.
+        Depends only on trainer/net state, never on the input batch."""
+        from types import SimpleNamespace
+
         from .optimizer import fused as _fused
 
         tr = self._trainer
         opt = tr._optimizer
         scaler = getattr(tr, "_amp_loss_scaler", None)
         updater = tr._updaters[0]
-
-        in_leaves, in_struct = _gb._flatten_args(args)
-        ctx = in_leaves[0].ctx if in_leaves else current_context()
-        flavor = _ndmod._flavor_of(in_leaves)
 
         params = OrderedDict(
             (n, p) for n, p in self._net.collect_params().items()
@@ -470,6 +469,7 @@ class TrainStep:
         frozen_names = [n for n in names if n not in slot_of_name]
 
         mesh = self._mesh
+        rep = None
         if mesh is not None:
             from .parallel import spmd as _spmd
 
@@ -500,39 +500,193 @@ class TrainStep:
             for s in states:
                 _place_state(s)
 
-        has_ok = scaler is not None
-        donate = jax.default_backend() not in ("cpu",)
-        sig = (
-            _gb._struct_key(in_struct),
-            tuple((tuple(l.shape), l._data.dtype) for l in in_leaves),
+        return SimpleNamespace(
+            opt=opt, scaler=scaler, updater=updater, params=params,
+            names=names, trainable=trainable, indices=indices,
+            states=states, group_layout=group_layout,
+            slot_of_name=slot_of_name, frozen_names=frozen_names,
+            mesh=mesh, rep=rep, has_ok=scaler is not None,
+            donate=jax.default_backend() not in ("cpu",))
+
+    def _signature(self, prep, in_struct_key, in_specs, ctx, flavor):
+        """The program-cache key: input structure + shapes/dtypes ×
+        train-mode × hyper-param signature × parameter/state layout ×
+        mesh — ``in_specs`` is ``tuple((shape, dtype), ...)`` so real
+        leaves and abstract precompile specs key identically."""
+        from .ndarray import ndarray as _ndmod
+        from .optimizer import fused as _fused
+
+        mesh = prep.mesh
+        if mesh is not None:
+            from .parallel import spmd as _spmd
+        return (
+            in_struct_key,
+            tuple(in_specs),
             True,                       # train-mode (part of the key by
             _ndmod._amp_generation,     # contract; TrainStep trains)
             ctx, flavor,
-            type(opt).__name__, opt._fused_signature(),
+            type(prep.opt).__name__, prep.opt._fused_signature(),
             tuple((tuple(p.data().shape), p.data()._data.dtype)
-                  for p in trainable),
-            tuple(_fused._struct(s) for s in states),
-            tuple((n, tuple(params[n].data().shape),
-                   params[n].data()._data.dtype) for n in frozen_names),
-            group_layout, has_ok, donate,
+                  for p in prep.trainable),
+            tuple(_fused._struct(s) for s in prep.states),
+            tuple((n, tuple(prep.params[n].data().shape),
+                   prep.params[n].data()._data.dtype)
+                  for n in prep.frozen_names),
+            prep.group_layout, prep.has_ok, prep.donate,
             # the SPMD mesh (axes + exact device set): a topology change
             # must never reuse a program compiled for another
             None if mesh is None else _spmd.mesh_key(mesh),
         )
-        rec = self._programs.get(sig)
+
+    def _ensure_program(self, sig, prep, in_struct, ctx, flavor,
+                        lower_args):
+        """One code path for warm-up, steady state, and elastic restore:
+        resolve ``sig`` through the ProgramStore — a miss traces AND
+        AOT-compiles (persisting to MXNET_PROGRAM_CACHE_DIR when set)
+        before any dispatch."""
+        rec = self._programs.lookup(sig)
         if rec is None:
-            _CACHE_MISSES += 1
-            rec = self._build_program(
-                params, names, in_struct, ctx, flavor, slot_of_name,
-                frozen_names, group_layout, has_ok, donate)
-            self._programs[sig] = rec
-            cap = _config.get("MXNET_COMPILED_STEP_CACHE")
-            while len(self._programs) > cap:
-                self._programs.popitem(last=False)
+            jitted, out_struct, mutated_names = self._build_program(
+                prep.params, prep.names, in_struct, ctx, flavor,
+                prep.slot_of_name, prep.frozen_names, prep.group_layout,
+                prep.has_ok, prep.donate)
+            rec = _pstore.build(
+                "train_step", jitted, lower_args,
+                meta=(out_struct, mutated_names),
+                label=type(self._net).__name__)
+            self._programs.insert(sig, rec)
+        return rec
+
+    def precompile(self, *specs, batch_size=None):
+        """Ahead-of-time compilation of the train step from abstract
+        input shapes, BEFORE the first batch arrives (deploy-time /
+        elastic-restore warm-up; `Trainer.precompile` wraps this).
+
+        ``specs`` are the step's positional inputs, each either a real
+        NDArray example or a ``(shape, dtype)`` pair.  The program is
+        traced and XLA-compiled through the ProgramStore exactly as the
+        first dispatch would — with ``MXNET_PROGRAM_CACHE_DIR`` set the
+        executable also lands in the persistent cache, so a later
+        process skips the compile entirely.  No data is touched, no
+        step runs, no parameter/optimizer state changes (under a mesh,
+        parameters take their one-time replicated placement, exactly as
+        the first step would).  Raises when the step would fall back to
+        the eager tape (a silent warm-up of nothing helps no one).
+        Returns ``self`` so ``trainer.precompile(...)`` chains."""
+        import numpy as onp
+
+        from .base import MXNetError
+        from .gluon import block as _gb
+        from .ndarray import ndarray as _ndmod
+
+        tr = self._trainer
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._params_to_init:
+            tr._init_params()
+        reason = self._eligibility()
+        if reason is not None:
+            raise MXNetError(
+                f"precompile: the compiled step would fall back to the "
+                f"eager tape ({reason})")
+        nd_specs = [s for s in specs if hasattr(s, "_data")]
+        if nd_specs and len(nd_specs) == len(specs):
+            in_leaves, in_struct = _gb._flatten_args(tuple(specs))
+            shapes = [tuple(l.shape) for l in in_leaves]
+            dtypes = [l._data.dtype for l in in_leaves]
+            ctx = in_leaves[0].ctx if in_leaves else current_context()
+            flavor = _ndmod._flavor_of(in_leaves)
         else:
-            _CACHE_HITS += 1
-            self._programs.move_to_end(sig)
-        jitted, out_struct, mutated_names = rec
+            shapes, dtypes = [], []
+            for s in specs:
+                shape, dtype = s
+                shapes.append(tuple(int(d) for d in shape))
+                dtypes.append(onp.dtype(dtype))
+            # flat positional args: the same treedef _flatten_args
+            # produces for step(x, y, ...)
+            in_struct = [("_leaf_", i) for i in range(len(specs))]
+            ctx = current_context()
+            flavor = _ndmod._flavor_of([])
+        if self._bucket and self.bucket_refused is None and shapes:
+            # precompile the PADDED program the bucketed step dispatches
+            from . import serving as _serving
+
+            policy = _serving.BucketPolicy()
+            if policy.enabled:
+                n = shapes[0][0]
+                b = policy.bucket(n)
+                if b is not None and b != n:
+                    shapes = [(b,) + s[1:] if s and s[0] == n else s
+                              for s in shapes]
+
+        prep = self._prep()
+        sig = self._signature(
+            prep, _gb._struct_key(in_struct),
+            tuple((s, d) for s, d in zip(shapes, dtypes)), ctx, flavor)
+        self._ensure_program(
+            sig, prep, in_struct, ctx, flavor,
+            self._lower_args(prep, [
+                jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)
+            ]))
+        return self
+
+    def _lower_args(self, prep, in_specs):
+        """Abstract lowering arguments matching the dispatch call
+        signature: real parameter/state buffers (their avals ARE the
+        program's), ShapeDtypeStructs for the batch (mesh-sharded like
+        ``spmd.put_batch`` would shard the real batch), abstract
+        scalars for the per-step traced values."""
+        import numpy as onp
+
+        f32 = jax.ShapeDtypeStruct((), jnp.float32)
+        mesh = prep.mesh
+        if mesh is not None:
+            from .parallel import spmd as _spmd
+
+            n_dp = int(onp.prod(mesh.devices.shape))
+            bsh = _spmd.batch_sharding(mesh)
+
+            def _in_spec(s):
+                sh = bsh if (s.shape and s.shape[0] % n_dp == 0) \
+                    else prep.rep
+                return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
+
+            in_specs = [_in_spec(s) for s in in_specs]
+            prev_ok = jax.ShapeDtypeStruct((), jnp.bool_,
+                                           sharding=prep.rep)
+        else:
+            prev_ok = jax.ShapeDtypeStruct((), jnp.bool_)
+        g32 = [jax.ShapeDtypeStruct((len(m),), jnp.float32)
+               for _mp, m in prep.group_layout]
+        from .optimizer import fused as _fused
+
+        w_args = [p.data()._data for p in prep.trainable]
+        s_args = tuple(_fused._unwrap(s) for s in prep.states)
+        frozen_args = [prep.params[n].data()._data
+                       for n in prep.frozen_names]
+        return (w_args, s_args, frozen_args, list(in_specs),
+                jax.random.PRNGKey(0), list(g32), list(g32), list(g32),
+                f32, f32, f32, f32, prev_ok)
+
+    def _compiled_step(self, args, batch_size):
+        from .gluon import block as _gb
+        from .ndarray import ndarray as _ndmod
+        from .optimizer import fused as _fused
+
+        tr = self._trainer
+        in_leaves, in_struct = _gb._flatten_args(args)
+        ctx = in_leaves[0].ctx if in_leaves else current_context()
+        flavor = _ndmod._flavor_of(in_leaves)
+
+        prep = self._prep()
+        opt, scaler = prep.opt, prep.scaler
+        indices, group_layout = prep.indices, prep.group_layout
+        trainable, states = prep.trainable, prep.states
+        mesh, rep = prep.mesh, prep.rep
+        sig = self._signature(
+            prep, _gb._struct_key(in_struct),
+            tuple((tuple(l.shape), l._data.dtype) for l in in_leaves),
+            ctx, flavor)
 
         # per-step traced values: counts were bumped by __call__ already
         counts = [opt._index_update_count[i] for i in indices]
@@ -579,8 +733,11 @@ class TrainStep:
 
         w_args = [p.data()._data for p in trainable]
         s_args = tuple(_fused._unwrap(s) for s in states)
-        frozen_args = [params[n].data()._data for n in frozen_names]
+        frozen_args = [prep.params[n].data()._data
+                       for n in prep.frozen_names]
         if mesh is not None:
+            from .parallel import spmd as _spmd
+
             # batch leaves shard over 'dp' (legalized: an indivisible
             # batch axis replicates, loudly).  Leaves the prefetcher
             # already staged with this sharding pass through untouched.
@@ -588,7 +745,7 @@ class TrainStep:
         else:
             in_args = [l._data for l in in_leaves]
 
-        out_raw, mut_vals, new_w, new_s, ok = jitted(
+        call_args = (
             w_args, s_args, frozen_args, in_args, _random.next_key(),
             lrs_g, wds_g, counts_g,
             jnp.asarray(rescale, jnp.float32),
@@ -596,7 +753,10 @@ class TrainStep:
             jnp.asarray(s_over, jnp.float32),
             jnp.asarray(rescale_alt, jnp.float32),
             prev_ok)
-        _DISPATCH_COUNT += 1
+        rec = self._ensure_program(sig, prep, in_struct, ctx, flavor,
+                                   call_args)
+        out_struct, mutated_names = rec.meta
+        out_raw, mut_vals, new_w, new_s, ok = rec(*call_args)
 
         for p, nw in zip(trainable, new_w):
             p._data[0]._set_data(nw)
@@ -606,8 +766,8 @@ class TrainStep:
         # TRAINABLE param cannot be expressed in one program — its
         # mutation wins this step and the step goes sticky-eager
         for n, v in zip(mutated_names, mut_vals):
-            params[n]._data[0]._set_data(v)
-        overlap = [n for n in mutated_names if n in slot_of_name]
+            prep.params[n]._data[0]._set_data(v)
+        overlap = [n for n in mutated_names if n in prep.slot_of_name]
         if overlap:
             self.fallback_reason = (
                 f"forward mutates trainable parameter(s) {overlap}")
@@ -651,8 +811,7 @@ class TrainStep:
         def step_fn(w_list, s_list, frozen_list, in_list, rng_key,
                     lrs_g, wds_g, counts_g, rescale, scale,
                     scale_alt, rescale_alt, prev_ok):
-            global _TRACE_COUNT
-            _TRACE_COUNT += 1
+            _pstore.count_trace("train_step")
             # deferred AMP gate: the previous step's flag selects which
             # speculative scale candidate this step really runs with —
             # prev_ok=True (the synchronous gate, or a clean previous

@@ -226,10 +226,43 @@ declare("MXNET_COMPILED_STEP", int, 1,
         "back to the eager tape transparently), 0 = force the eager tape "
         "everywhere.", subsystem="optimizer", cached=False)
 declare("MXNET_COMPILED_STEP_CACHE", int, 16,
-        "Max compiled train-step programs kept per TrainStep (LRU over "
-        "input-shape signatures); a new signature past the cap evicts "
-        "the oldest", validator=lambda v: v > 0, subsystem="optimizer",
+        "Per-TrainStep cap of the ProgramStore 'train_step' namespace "
+        "(LRU over input-shape signatures); a new signature past the cap "
+        "evicts the oldest.  MXNET_PROGRAM_CACHE_CAPS overrides it.",
+        validator=lambda v: v > 0, subsystem="optimizer",
         cached=False)
+declare("MXNET_PROGRAM_CACHE_DIR", str, None,
+        "ProgramStore persistent compilation cache: when set, every XLA "
+        "compile this process performs is backed by JAX's on-disk cache "
+        "at this path, keyed by (serialized HLO, compile options, "
+        "jax/jaxlib version) — a second process re-tracing the same "
+        "signature gets a disk hit (seconds) instead of a fresh compile "
+        "(26-98 s/program on chip).  Off by default (unset = purely "
+        "in-memory, prior behavior).  Never overrides an externally "
+        "configured JAX_COMPILATION_CACHE_DIR.  A corrupted/unreadable "
+        "entry degrades loudly to a recompile (fault site "
+        "program_store.load), never a crash.",
+        subsystem="program_store", cached=False)
+declare("MXNET_PROGRAM_CACHE_CAPS", str, "",
+        "Per-namespace program-cap overrides for the ProgramStore, as a "
+        "comma list 'train_step=16,serving=32,hybrid_forward=32,"
+        "eager_jit=512'.  Unlisted namespaces fall back to their legacy "
+        "knob (MXNET_COMPILED_STEP_CACHE, MXNET_FORWARD_CACHE) or "
+        "built-in default.  Caps bound programs PER OWNER (per "
+        "TrainStep / ServingEngine / HybridBlock), so co-hosted models "
+        "cannot evict each other's steady-state programs.",
+        subsystem="program_store", cached=False)
+declare("MXNET_PROGRAM_AOT", int, 1,
+        "ProgramStore ahead-of-time executables: 1 = a cache miss "
+        "traces AND compiles before first dispatch "
+        "(jit(...).lower(args).compile()) and the store owns the "
+        "compiled executable — warm-up from abstract shapes "
+        "(Trainer.precompile / ServingEngine.warmup), steady state, and "
+        "elastic restore share one code path; an input-signature "
+        "mismatch at dispatch falls back loudly to the retraceable jit "
+        "callable (aot_fallbacks counter).  0 = records keep only the "
+        "jit callable (pre-PR-7 dispatch behavior).",
+        subsystem="program_store", cached=False)
 declare("MXNET_EAGER_JIT_EXCLUDE", str, "mean,sum,prod,max,min",
         "Comma-set of op names kept OUT of the per-op eager jit cache "
         "(MXNET_EAGER_JIT): single-primitive reductions measured SLOWER "
@@ -326,10 +359,13 @@ declare("MXNET_SERVE_VERIFY", int, 1,
         validator=lambda v: v in (0, 1, 2), subsystem="serving",
         cached=False)
 declare("MXNET_FORWARD_CACHE", int, 32,
-        "Max compiled forward programs kept per HybridBlock / "
-        "ServingEngine (LRU over input signatures, the inference analog "
-        "of MXNET_COMPILED_STEP_CACHE); a new signature past the cap "
-        "evicts the oldest", validator=lambda v: v > 0,
+        "Per-owner cap of the ProgramStore 'hybrid_forward' and "
+        "'serving' namespaces: max compiled forward programs kept per "
+        "HybridBlock / ServingEngine (LRU over input signatures, the "
+        "inference analog of MXNET_COMPILED_STEP_CACHE); a new "
+        "signature past the cap evicts the oldest.  "
+        "MXNET_PROGRAM_CACHE_CAPS overrides it per namespace.",
+        validator=lambda v: v > 0,
         subsystem="serving", cached=False)
 declare("MXNET_MODULE_SEED", int, None,
         "Override the per-test RNG seed for reproduction (reference test "

@@ -508,10 +508,17 @@ class HybridBlock(Block):
         self._active = False
         self._flags: Dict[str, Any] = {}
         # cache: (training, input treedef signature) -> compiled record,
-        # LRU-capped by MXNET_FORWARD_CACHE (shape-level programs live
-        # inside each record's jax.jit cache; the bucket policy is what
-        # bounds THOSE on variable-shape streams)
-        self._cached: "OrderedDict[Any, Tuple]" = OrderedDict()
+        # this block's keyspace in the ProgramStore 'hybrid_forward'
+        # namespace — shared LRU/metrics surface, capped by
+        # MXNET_FORWARD_CACHE / MXNET_PROGRAM_CACHE_CAPS.  Records stay
+        # plain jit callables (shape-level programs live inside each
+        # record's jax.jit cache — one treedef key serves every shape,
+        # and the recording path differentiates THROUGH the callable —
+        # so no AOT executable pinning here; the bucket policy is what
+        # bounds shape proliferation on variable-shape streams)
+        from .. import program_store as _pstore
+
+        self._cached = _pstore.scope("hybrid_forward")
         # opt-in shape bucketing for the inference path
         # (hybridize(bucket=True) + MXNET_SHAPE_BUCKETS): batch axis pads
         # up to the bucket grid, outputs slice back, verified bit-exact
@@ -573,7 +580,7 @@ class HybridBlock(Block):
         # accumulate in _flags but never leak into backend transforms)
         self._backend_flags = dict(kwargs) if backend is not None else {}
         if clear:
-            self._cached = OrderedDict()
+            self._cached.clear()
         super().hybridize(active=False if active else active)
         # note: only the outermost hybridized block compiles; children run
         # inside its trace (the reference inlines children the same way).
@@ -637,15 +644,10 @@ class HybridBlock(Block):
         # stale default ctx and fail the replica lookup)
         sig = (training, _ndmod._amp_generation, _struct_key(in_struct),
                ctx, out_cls)
-        rec = self._cached.get(sig)
+        rec = self._cached.lookup(sig)
         if rec is None:
             rec = self._build_cache(in_struct, training, ctx, out_cls)
-            self._cached[sig] = rec
-            cap = _config.get("MXNET_FORWARD_CACHE")
-            while len(self._cached) > cap:
-                self._cached.popitem(last=False)
-        else:
-            self._cached.move_to_end(sig)
+            self._cached.insert(sig, rec)
         jitted, names, params, ctx_idx, out_struct, mutated_names = rec
         param_arrays = [params[n]._data[_ctx_index(params[n], ctx)]._data
                         for n in names]
@@ -796,7 +798,15 @@ class HybridBlock(Block):
             if getattr(self, "_remat_policy", None):
                 policy = getattr(jax.checkpoint_policies, self._remat_policy)
             raw_fn = jax.checkpoint(raw_fn, policy=policy)
-        jitted = jax.jit(raw_fn)
+
+        def fwd_fn(param_arrays, input_arrays, rng_key,
+                   _raw_fn=raw_fn):
+            from .. import program_store as _pstore
+
+            _pstore.count_trace("hybrid_forward")
+            return _raw_fn(param_arrays, input_arrays, rng_key)
+
+        jitted = jax.jit(fwd_fn)
         return (jitted, names, params, ctx_idx, out_struct, mutated_names)
 
     # -- trace to Symbol / export ---------------------------------------

@@ -194,6 +194,27 @@ class Trainer:
 
         return TrainStep(net, loss_fn, self, bucket=bucket)
 
+    def precompile(self, net, loss_fn, specs, bucket=False):
+        """Ahead-of-time warm-up: compile the whole train step for the
+        given input signature BEFORE the first batch arrives (the
+        deploy-time / elastic-restore counterpart of ``compile_step``;
+        ROADMAP item 4 — on chip a train-step program costs 26–98 s of
+        XLA compile, and this moves that off the first-batch path).
+
+        ``specs`` is a sequence of the step's positional inputs, each a
+        ``(shape, dtype)`` pair or a real example NDArray.  The program
+        is traced and XLA-compiled through the ProgramStore exactly as
+        the first dispatch would be; with ``MXNET_PROGRAM_CACHE_DIR``
+        set the executable also persists, so a later process (an
+        elastic restart, a second serving replica) re-tracing the same
+        signature gets a disk hit instead of a fresh compile.  No step
+        runs and no parameter/optimizer value changes.  Returns the
+        ready :class:`~mxnet_tpu.cached_step.TrainStep` — use THAT
+        object for training (each TrainStep owns its program keyspace).
+        Raises when the step would fall back to the eager tape."""
+        return self.compile_step(net, loss_fn,
+                                 bucket=bucket).precompile(*specs)
+
     # -- the step --------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """Normalize by batch_size, all-reduce grads, apply updates

@@ -31,6 +31,7 @@ import numpy as onp
 from .. import autograd
 from .. import engine as _engine
 from .. import profiler as _profiler
+from .. import program_store as _pstore
 from ..base import (MXNetError, S64_DEMOTING_PLATFORMS, bounded_cache_put,
                     enable_x64 as _enable_x64, int32_overflow_dim,
                     pow2_col_factor)
@@ -864,13 +865,30 @@ def _make_op_fn(schema, attrs):
 # operator-bulking role, src/engine/threaded_engine.h:507-528).
 # Ops whose python body cannot trace (data-dependent shapes, host
 # round-trips) are detected by failure and permanently fall back.
-_EAGER_JIT_CACHE: "OrderedDict" = OrderedDict()   # LRU, bounded
 _EAGER_JIT_BAD: set = set()
 _EAGER_JIT_KEYCOUNT: dict = {}
-_EAGER_JIT_MAX_ENTRIES = 512      # total cached executables kept alive
+_EAGER_JIT_MAX_ENTRIES = 512      # default namespace cap (override via
+                                  # MXNET_PROGRAM_CACHE_CAPS eager_jit=N)
 _EAGER_JIT_MAX_PER_OP = 64        # attr-cardinality cutoff: beyond this the
                                   # op recompiles per call (slice with a
                                   # moving begin etc.) — jit is a net loss
+
+
+def _eager_jit_evicted(old_key, _fn) -> None:
+    # cutoff counts LIVE entries: an evicted executable hands its op's
+    # slot back so LRU churn can never accumulate into a per-op ban
+    live = _EAGER_JIT_KEYCOUNT.get(old_key[0], 1) - 1
+    if live > 0:
+        _EAGER_JIT_KEYCOUNT[old_key[0]] = live
+    else:
+        _EAGER_JIT_KEYCOUNT.pop(old_key[0], None)
+
+
+# the eager per-op executables are the ProgramStore 'eager_jit'
+# namespace (one global scope): same LRU/metrics surface as the
+# whole-program caches, values are plain shape-polymorphic jit
+# callables (no AOT pinning — one (op, attrs) key serves every shape)
+_EAGER_JIT_CACHE = _pstore.scope("eager_jit", on_evict=_eager_jit_evicted)
 
 # trace-time failure types: the op BODY cannot be traced (host value
 # inspection, data-dependent output shape).  Only these justify a
@@ -934,27 +952,19 @@ def _eager_jit_lookup(schema, attrs, arrays):
         hash(key)
     except TypeError:
         return None                       # unhashable attr: plain dispatch
-    fn = _EAGER_JIT_CACHE.get(key)
+    fn = _EAGER_JIT_CACHE.lookup(key)
     if fn is not None:
-        _EAGER_JIT_CACHE.move_to_end(key)
         return fn
-    # cutoff counts LIVE entries (decremented on eviction): a hot op with
-    # few attr sets must never accumulate into a ban via LRU churn or amp
-    # generation bumps
+    # cutoff counts LIVE entries (decremented on eviction, see
+    # _eager_jit_evicted): a hot op with few attr sets must never
+    # accumulate into a ban via LRU churn or amp generation bumps
     n_keys = _EAGER_JIT_KEYCOUNT.get(schema.name, 0) + 1
     if n_keys > _EAGER_JIT_MAX_PER_OP:
         _EAGER_JIT_BAD.add(schema.name)   # attrs vary per call: jit loses
         return None
     _EAGER_JIT_KEYCOUNT[schema.name] = n_keys
     fn = jax.jit(_make_op_fn(schema, attrs))
-    _EAGER_JIT_CACHE[key] = fn
-    while len(_EAGER_JIT_CACHE) > _EAGER_JIT_MAX_ENTRIES:
-        old_key, _ = _EAGER_JIT_CACHE.popitem(last=False)
-        live = _EAGER_JIT_KEYCOUNT.get(old_key[0], 1) - 1
-        if live > 0:
-            _EAGER_JIT_KEYCOUNT[old_key[0]] = live
-        else:
-            _EAGER_JIT_KEYCOUNT.pop(old_key[0], None)
+    _EAGER_JIT_CACHE.insert(key, fn)
     return fn
 
 

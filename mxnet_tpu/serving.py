@@ -61,42 +61,38 @@ import numpy as onp
 from . import autograd
 from . import config as _config
 from . import faults as _faults
+from . import program_store as _pstore
 from . import random as _random
 from .context import current_context
 
 __all__ = ["BucketPolicy", "ServingEngine", "trace_count", "dispatch_count",
            "bucket_stats", "reset_counters"]
 
-# observability, mirroring cached_step: _TRACE_COUNT bumps when a serving
-# program body is (re)traced, _DISPATCH_COUNT per compiled launch, and
-# the bucket counters track how the padded-shape program cache behaves
-# (hit = the bucketed signature already had a program).  The CI gate
-# (tools/check_dispatch_budget.py) asserts retraces go to 0 over a
-# variable-length stream once every bucket is warm.
-_TRACE_COUNT = 0
-_DISPATCH_COUNT = 0
-_BUCKET_HITS = 0
-_BUCKET_MISSES = 0
+# observability, mirroring cached_step: serving programs live in the
+# ProgramStore 'serving' namespace — traces bump when a serving program
+# body is (re)traced, dispatches per compiled launch, and hits/misses
+# track how the padded-shape program cache behaves (hit = the bucketed
+# signature already had a program).  The functions below are views over
+# that surface.  The CI gate (tools/check_dispatch_budget.py) asserts
+# retraces go to 0 over a variable-length stream once every bucket is
+# warm.
+_NS = _pstore.namespace("serving")
 
 
 def trace_count() -> int:
-    return _TRACE_COUNT
+    return _NS.traces
 
 
 def dispatch_count() -> int:
-    return _DISPATCH_COUNT
+    return _NS.dispatches
 
 
 def bucket_stats() -> Dict[str, int]:
-    return {"hits": _BUCKET_HITS, "misses": _BUCKET_MISSES}
+    return {"hits": _NS.hits, "misses": _NS.misses}
 
 
 def reset_counters() -> None:
-    global _TRACE_COUNT, _DISPATCH_COUNT, _BUCKET_HITS, _BUCKET_MISSES
-    _TRACE_COUNT = 0
-    _DISPATCH_COUNT = 0
-    _BUCKET_HITS = 0
-    _BUCKET_MISSES = 0
+    _NS.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -230,7 +226,10 @@ class ServingEngine:
                            else _config.get("MXNET_SERVE_MAX_DELAY_US")) / 1e6
         self._verify = (bool(_config.get("MXNET_SERVE_VERIFY"))
                         if verify is None else bool(verify))
-        self._programs: "OrderedDict" = OrderedDict()
+        # this engine's keyspace in the ProgramStore 'serving'
+        # namespace: shared eviction (cap MXNET_FORWARD_CACHE /
+        # MXNET_PROGRAM_CACHE_CAPS) + shared metrics, per-engine keys
+        self._programs = _pstore.scope("serving")
         self._verified: set = set()
         # sticky refusals: verify mismatch (or an in-batch mutation)
         # disables padding AND coalescing — outputs that couple across
@@ -265,7 +264,8 @@ class ServingEngine:
         self._stats = {"requests": 0, "batches": 0, "coalesced": 0,
                        "padded_rows": 0, "true_rows": 0,
                        "bucket_fallbacks": 0, "single_fallbacks": 0,
-                       "verify_runs": 0, "verify_ulp_accepts": 0}
+                       "verify_runs": 0, "verify_ulp_accepts": 0,
+                       "warmup_programs": 0}
 
     # -- public ------------------------------------------------------------
     def infer(self, *args):
@@ -474,7 +474,6 @@ class ServingEngine:
         work (pad/concat are device ops on already-staged leaves; host
         numpy inputs took one device_put in infer's array wrap) — this
         runs on the stager thread, overlapping the dispatcher."""
-        global _BUCKET_HITS, _BUCKET_MISSES
         skey = _struct_key_of(group[0].struct)
         rows = sum(r.rows for r in group)
         pad_active = False
@@ -533,7 +532,6 @@ class ServingEngine:
                 self._staged.task_done()
 
     def _dispatch(self, group, batched, rows, pad_active):
-        global _DISPATCH_COUNT, _BUCKET_HITS, _BUCKET_MISSES
         from .gluon import block as _gb
         from .ndarray import ndarray as _ndmod
 
@@ -545,18 +543,12 @@ class ServingEngine:
         sig = (_struct_key_of(first.struct),
                tuple((tuple(b.shape), str(b.dtype)) for b in batched),
                _ndmod._amp_generation, ctx, flavor)
-        rec = self._programs.get(sig)
+        rec = self._programs.lookup(sig)
         if rec is None:
-            _BUCKET_MISSES += 1
-            rec = self._build_program(first.struct, ctx, flavor)
-            self._programs[sig] = rec
-            cap = _config.get("MXNET_FORWARD_CACHE")
-            while len(self._programs) > cap:
-                self._programs.popitem(last=False)
+            built = self._build_jit(first.struct, ctx, flavor)
+            names, params = built[1], built[2]
         else:
-            _BUCKET_HITS += 1
-            self._programs.move_to_end(sig)
-        jitted, names, params, out_struct, mutated_names = rec
+            names, params = rec.meta[0], rec.meta[1]
 
         if self._mesh is not None:
             from .parallel import spmd as _spmd
@@ -569,9 +561,19 @@ class ServingEngine:
                     d._set_data(new)      # once; steady state passes through
             batched = [_spmd.put_batch(b, self._mesh) for b in batched]
         param_arrays = [params[n]._data[0]._data for n in names]
-        out_arrays, mut_vals = jitted(batched, param_arrays,
-                                      _random.next_key())
-        _DISPATCH_COUNT += 1
+        if rec is None:
+            # one code path with warmup(): trace + AOT-compile through
+            # the store (persisting under MXNET_PROGRAM_CACHE_DIR), then
+            # dispatch the owned executable
+            jitted = built[0]
+            rec = _pstore.build(
+                "serving", jitted,
+                (batched, param_arrays, jax.random.PRNGKey(0)),
+                meta=built[1:], label=type(self._net).__name__)
+            self._programs.insert(sig, rec)
+        _names, _params, out_struct, mutated_names = rec.meta
+        out_arrays, mut_vals = rec(batched, param_arrays,
+                                   _random.next_key())
         self._stats["batches"] += 1
         self._stats["requests"] += len(group)
         self._stats["coalesced"] += len(group) - 1
@@ -608,7 +610,7 @@ class ServingEngine:
             req.t_done = time.monotonic()
             req.event.set()
 
-    def _build_program(self, in_struct, ctx, flavor):
+    def _build_jit(self, in_struct, ctx, flavor):
         from .gluon import block as _gb
 
         params = OrderedDict(
@@ -620,11 +622,103 @@ class ServingEngine:
             False, ctx, flavor)
 
         def serve_fn(input_arrays, param_arrays, rng_key):
-            global _TRACE_COUNT
-            _TRACE_COUNT += 1
+            _pstore.count_trace("serving")
             return raw_fn(param_arrays, input_arrays, rng_key)
 
         return (jax.jit(serve_fn), names, params, out_struct, mutated_names)
+
+    # -- ahead-of-time warmup ----------------------------------------------
+    def warmup(self, *args, max_rows: Optional[int] = None) -> int:
+        """Compile the declared bucket grid at deploy time, OFF the
+        request path (ROADMAP item 4: on chip a serving program costs
+        26–98 s of XLA compile, multiplied by the bucket grid — paid at
+        deploy, not under the first user's request).
+
+        ``args`` is ONE example request (NDArray/numpy leaves, leading
+        batch axis; row count irrelevant) giving the input structure and
+        per-row shapes/dtypes.  One program per bucket of the
+        ``MXNET_SHAPE_BUCKETS`` grid is traced and XLA-compiled from
+        abstract shapes through the ProgramStore — the exact signature,
+        build, and dispatch path a real coalesced batch of that bucket
+        takes, so steady state HITS these programs; with
+        ``MXNET_PROGRAM_CACHE_DIR`` set they persist for the next
+        process.  For the ``pow2`` policy the grid spans 1..`max_rows``
+        (default ``MXNET_SERVE_MAX_BATCH``); an explicit grid is
+        compiled verbatim; ``none`` compiles the example's exact shape.
+        First-dispatch verification (``MXNET_SERVE_VERIFY``) still runs
+        on the first real padded batch — warm-up never weakens the
+        refuse-on-mismatch contract.  Returns the number of programs
+        compiled (0 = grid already warm)."""
+        from .gluon import block as _gb
+        from .ndarray import ndarray as _ndmod
+
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        args = _stage_host(args)
+        self._ensure_initialized(args)
+        leaves, struct = _gb._flatten_args(args)
+        if not leaves or any(len(l.shape) < 1 for l in leaves):
+            raise ValueError(
+                "warmup() needs one example request: array arguments "
+                "with a leading batch axis")
+        if not self._policy.enabled:
+            grid = [int(leaves[0].shape[0])]
+        elif self._policy.buckets() is not None:
+            grid = list(self._policy.buckets())
+        else:                                     # pow2
+            cap = int(max_rows if max_rows is not None
+                      else self._max_batch)
+            grid, b = [], 1
+            while b <= cap:
+                grid.append(b)
+                b <<= 1
+        ctx = (args[0].ctx if args and hasattr(args[0], "ctx")
+               else current_context())
+        flavor = _ndmod._flavor_of([a for a in args
+                                    if hasattr(a, "_data")])
+        skey = _struct_key_of(struct)
+        if self._mesh is not None:
+            from .parallel import spmd as _spmd
+
+            rep = _spmd.replicated(self._mesh)
+            for p in self._net.collect_params().values():
+                if p._data is None:
+                    continue
+                d = p._data[0]
+                new = _spmd.ensure_placed(d._data, rep)
+                if new is not d._data:
+                    d._set_data(new)
+            bsh = _spmd.batch_sharding(self._mesh)
+            n_dev = int(self._mesh.devices.size)
+        compiled = 0
+        for b in sorted(set(int(g) for g in grid)):
+            specs = [jax.ShapeDtypeStruct((b,) + tuple(l.shape[1:]),
+                                          l._data.dtype) for l in leaves]
+            sig = (skey,
+                   tuple((tuple(s.shape), str(s.dtype)) for s in specs),
+                   _ndmod._amp_generation, ctx, flavor)
+            if sig in self._programs:             # already warm
+                continue
+            self._programs.lookup(sig)            # counted miss
+            jitted, names, params, out_struct, mutated_names = \
+                self._build_jit(struct, ctx, flavor)
+            if self._mesh is not None:
+                # shard the abstract batch like put_batch shards the
+                # real one (indivisible rows replicate)
+                specs = [jax.ShapeDtypeStruct(
+                    s.shape, s.dtype,
+                    sharding=bsh if s.shape[0] % n_dev == 0 else rep)
+                    for s in specs]
+            param_arrays = [params[n]._data[0]._data for n in names]
+            rec = _pstore.build(
+                "serving", jitted,
+                (specs, param_arrays, jax.random.PRNGKey(0)),
+                meta=(names, params, out_struct, mutated_names),
+                label=f"{type(self._net).__name__}[warmup b={b}]")
+            self._programs.insert(sig, rec)
+            compiled += 1
+        self._stats["warmup_programs"] += compiled
+        return compiled
 
     # -- verify-or-refuse ---------------------------------------------------
     def _verify_group(self, group, out_arrays, padded_n):

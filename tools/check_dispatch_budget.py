@@ -52,6 +52,15 @@ AMP_BUDGET = {"host_syncs_per_step": 1, "deferred_reads_per_step": 1}
 # batching"): steady state over a variable-length stream
 INFER_BUDGET = {"launches_per_batch": 1, "retraces_after_warm": 0,
                 "programs_over_buckets": 0}
+# the PROGRAM-STORE budget (docs/PERF.md "ProgramStore"): steady state
+# keeps the live-program count at the declared grid (train: 1 signature
+# -> 1 program; serving: <= buckets, covered by programs_over_buckets),
+# performs ZERO evictions, and — with MXNET_PROGRAM_CACHE_DIR set — a
+# WARM SECOND PROCESS replaying the same train+serving workload
+# performs ZERO fresh XLA compiles (all disk/memory hits, bit-exact
+# outputs)
+STORE_BUDGET = {"evictions_after_warm": 0, "live_train_programs_over": 0,
+                "second_process_compiles": 0}
 # the MESH budget (docs/PERF.md "Pod-scale SPMD train step"): under
 # kvstore='tpu' the data-parallel step stays ONE compiled launch — the
 # SPMD partitioner fans out over the mesh, never the host (no per-chip
@@ -147,6 +156,9 @@ def _measure(compiled: bool, with_amp: bool = False) -> dict:
     out["dispatches_per_step"] = (out["eager_invokes_per_step"]
                                   + out["compiled_launches_per_step"]
                                   + out["group_launches_per_step"])
+    # program-store lane input: one constant-shape signature must hold
+    # exactly ONE live program in this step's keyspace
+    out["live_programs"] = len(step._programs) if compiled else 0
     return out
 
 
@@ -244,7 +256,89 @@ def _measure_infer() -> dict:
     return out
 
 
+def _store_worker() -> None:
+    """``--store-worker`` mode: run the tiny train-step + serving-bucket
+    workload in THIS process and print its program-store verdict as one
+    JSON line.  The parent runs it twice against one
+    MXNET_PROGRAM_CACHE_DIR; the second run must report 0 fresh XLA
+    compiles and a bit-exact output digest."""
+    import json
+    import time
+
+    t0 = time.perf_counter()
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import program_store, serving
+
+    net, trainer, loss_fn, data, label = _build()
+    step = trainer.compile_step(net, loss_fn)
+    losses = []
+    first_result_s = None
+    for _ in range(3):
+        loss = step(data, label, batch_size=6)
+        losses.append(float(loss.asnumpy().ravel()[0]))
+        if first_result_s is None:
+            first_result_s = time.perf_counter() - t0
+    assert step.last_step_compiled, step.last_fallback_reason
+    net2, _tr, _lf, _d, _l = _build(seed=1)
+    eng = serving.ServingEngine(net2, max_delay_us=0)
+    out = eng.infer(mx.nd.array(onp.ones((3, 8), onp.float32)))
+    digest = ([l.hex() for l in losses]
+              + [float(v).hex() for v in
+                 onp.asarray(out.asnumpy(), onp.float64).ravel().tolist()])
+    eng.close()
+    ds = program_store.disk_stats()
+    print(json.dumps({
+        "fresh_compiles": ds["misses"], "disk_hits": ds["hits"],
+        "persistent_enabled": ds["enabled"],
+        "first_result_s": round(first_result_s, 3),
+        "digest": digest}), flush=True)
+
+
+def _measure_store_cold_start() -> dict:
+    """Warm second-process lane: two subprocesses replay the same
+    workload against one persistent program cache — process B must
+    compile nothing and reproduce process A's outputs bit-exactly."""
+    import json
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="mxnet_program_store_gate_")
+    env = dict(os.environ)
+    env["MXNET_PROGRAM_CACHE_DIR"] = cache_dir
+    # the knob under test must own the cache dir (never piggyback on an
+    # externally configured jax cache)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    runs = []
+    for i in ("A", "B"):
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--store-worker"],
+            env=env, capture_output=True, text=True, timeout=300)
+        if r.returncode != 0:
+            return {"mode": "store", "error":
+                    f"store worker {i} rc={r.returncode}: "
+                    + r.stderr.strip()[-500:]}
+        runs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    a, b = runs
+    return {
+        "mode": "store",
+        "error": None,
+        "cache_dir": cache_dir,
+        "persistent_enabled": a["persistent_enabled"],
+        "first_process_compiles": a["fresh_compiles"],
+        "second_process_compiles": b["fresh_compiles"],
+        "second_process_disk_hits": b["disk_hits"],
+        "first_result_s": (a["first_result_s"], b["first_result_s"]),
+        "outputs_bit_exact": a["digest"] == b["digest"],
+    }
+
+
 def main() -> int:
+    from mxnet_tpu import program_store as _ps
+
+    ev0 = sum(_ps.stats(n)["evictions"]
+              for n in ("train_step", "serving", "hybrid_forward"))
     compiled = _measure(True)
     eager = _measure(False)
     amp_row = _measure(True, with_amp=True)
@@ -271,6 +365,22 @@ def main() -> int:
               f"{mesh['retraces_after_warm']} retraces, "
               f"{mesh['reshards_after_warm']} reshards, "
               f"{mesh['replicated_batches']} replicated batches")
+    # program-store lane: all the steady-state runs above went through
+    # the store — they must not have evicted anything
+    ev_after_warm = sum(
+        _ps.stats(n)["evictions"]
+        for n in ("train_step", "serving", "hybrid_forward")) - ev0
+    store = _measure_store_cold_start()
+    if store["error"]:
+        print(f"store      FAILED ({store['error']})")
+    else:
+        print(f"{'store':<10} warm 2nd process: "
+              f"{store['second_process_compiles']} fresh compiles, "
+              f"{store['second_process_disk_hits']} disk hits "
+              f"(1st process compiled {store['first_process_compiles']}), "
+              f"first result {store['first_result_s'][0]:.2f}s -> "
+              f"{store['first_result_s'][1]:.2f}s, "
+              f"{ev_after_warm} evictions in-process")
     failures = []
     if not compiled["used_compiled"]:
         failures.append("compiled mode fell back to the eager tape")
@@ -310,6 +420,32 @@ def main() -> int:
             if mesh[key] > budget:
                 failures.append(
                     f"mesh {key} = {mesh[key]} exceeds budget {budget}")
+    if ev_after_warm > STORE_BUDGET["evictions_after_warm"]:
+        failures.append(
+            f"program store evicted {ev_after_warm} programs during "
+            "steady-state runs (caps must cover the declared grid)")
+    if compiled["live_programs"] - 1 > \
+            STORE_BUDGET["live_train_programs_over"]:
+        failures.append(
+            f"train step holds {compiled['live_programs']} live programs "
+            "for one constant-shape signature (expected 1)")
+    if store["error"]:
+        failures.append(f"program-store cold-start lane: {store['error']}")
+    else:
+        if not store["persistent_enabled"]:
+            failures.append(
+                "MXNET_PROGRAM_CACHE_DIR did not enable the persistent "
+                "compilation cache in the worker")
+        if store["second_process_compiles"] > \
+                STORE_BUDGET["second_process_compiles"]:
+            failures.append(
+                f"warm second process performed "
+                f"{store['second_process_compiles']} fresh XLA compiles "
+                "(expected 0: every program must be a disk/memory hit)")
+        if not store["outputs_bit_exact"]:
+            failures.append(
+                "warm second process outputs differ from the first "
+                "process (disk-cached executables must be bit-exact)")
     if failures:
         print("check_dispatch_budget: FAILED —", "; ".join(failures),
               file=sys.stderr)
@@ -327,9 +463,15 @@ def main() -> int:
           + ("" if mesh["skipped"] else
              f"; mesh within budget ({mesh['mesh_devices']}-device SPMD, "
              f"{mesh['compiled_launches_per_step']:.0f} launch/step, "
-             f"{mesh['reshards_after_warm']} steady-state reshards)"))
+             f"{mesh['reshards_after_warm']} steady-state reshards)")
+          + f"; program store within budget ({ev_after_warm} evictions, "
+            f"warm 2nd process {store['second_process_compiles']} "
+            f"compiles / {store['second_process_disk_hits']} disk hits)")
     return 0
 
 
 if __name__ == "__main__":
+    if "--store-worker" in sys.argv:
+        _store_worker()
+        sys.exit(0)
     sys.exit(main())

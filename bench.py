@@ -2,7 +2,11 @@
 
 Lanes (each with achieved_tflops + mfu): ResNet-50 fp32 train, ResNet-50
 bf16 mixed-precision train, BERT-base bf16 train, ResNet-50 int8
-inference (compile time logged).  Methodology matches the reference's
+inference (compile time logged); counter-based lanes ride along without
+an MFU figure: train_step (compiled-step dispatch budget), infer
+(bucketed serving p99), decode (continuous-batching generative serving:
+tokens/s A/B + multi-tenant storm), pipeline (device idle gap), and
+multichip (1->N weak scaling).  Methodology matches the reference's
 benchmark_score.py (synthetic data, steady-state throughput; docs
 perf.md — V100 fp32 train 298.51 img/s at bs32 is BASELINE.md's anchor;
 perf.md:208's fp16 V100 2,085 img/s inference is the mixed-precision
@@ -727,6 +731,59 @@ def lane_infer(on_cpu: bool) -> dict:
     }
 
 
+def lane_decode(on_cpu: bool) -> dict:
+    """Continuous-batching generative-serving lane (PR 8,
+    serving_decode.GenerativeEngine): runs benchmark/serving_latency.py's
+    decode worker — the one-request-at-a-time vs continuous-batching A/B
+    plus the multi-tenant storm — and carries its counters into
+    lanes[].  The value is continuous-batching tokens/s; the acceptance
+    bars ride along: batching_speedup >= 2 at concurrency >= 8, 0
+    retraces after warm-up with programs == prefill buckets + 1, storm
+    interference_p99_ratio <= 2 (fast model vs its solo p99) with a
+    nonzero shed count under the deliberate overload (counter-based, so
+    the lane is equally meaningful on CPU fallback)."""
+    import json as _json
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmark", "serving_latency.py")
+    r = subprocess.run([sys.executable, "-u", script, "--decode-only",
+                        "--json"], capture_output=True, text=True,
+                       timeout=600, env=dict(os.environ))
+    if r.returncode != 0:
+        raise RuntimeError(f"decode lane failed:\n{r.stderr[-1500:]}")
+    c = _json.loads(r.stdout.strip().splitlines()[-1])["decode"]
+    s = c.get("storm", {})
+    _progress(f"decode: {c['continuous_tokens_s']:.0f} tok/s continuous "
+              f"({c['batching_speedup']}x vs one-at-a-time), "
+              f"{c['retraces_after_warm']} retraces, storm p99 ratio "
+              f"{s.get('interference_p99_ratio', '-')}, "
+              f"{s.get('shed_total', 0)} shed")
+    return {
+        "metric": "decode_continuous_tokens_per_s",
+        "value": c["continuous_tokens_s"],
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "sequential_tokens_s": c["sequential_tokens_s"],
+        "batching_speedup": c["batching_speedup"],
+        "concurrency": c["concurrency"],
+        "rows_per_decode": c["rows_per_decode"],
+        "retrace_count": c["retraces_after_warm"],
+        "programs": c["programs"],
+        "warmup_programs": c["warmup_programs"],
+        "p50_us": c["p50_us"],
+        "p99_us": c["p99_us"],
+        "kv_pages_high_water": c["pool"]["high_water"],
+        "storm_fast_p99_us": s.get("fast", {}).get("p99_us"),
+        "storm_interference_p99_ratio": s.get("interference_p99_ratio"),
+        "storm_shed_total": s.get("shed_total"),
+        "storm_slow_tokens_s": s.get("slow", {}).get("tokens_s"),
+        "compile_s": c["compile_s"],
+        "cache_hits": c["cache_hits"],
+        "cache_misses": c["cache_misses"],
+        "platform": c["platform"],
+    }
+
+
 def lane_pipeline(on_cpu: bool) -> dict:
     """Async pipeline engine lane (PR 5): runs
     benchmark/pipeline_latency.py's sync-vs-pipelined A/B and carries its
@@ -809,6 +866,8 @@ def _resolve_lane(name):
         return lane_train_step, "train_step_compiled_dispatches_per_step"
     if name == "infer":
         return lane_infer, "serving_infer_p99_latency_us"
+    if name == "decode":
+        return lane_decode, "decode_continuous_tokens_per_s"
     if name == "pipeline":
         return lane_pipeline, "pipeline_device_idle_gap_us"
     if name == "multichip":
@@ -829,14 +888,15 @@ def _resolve_lane(name):
 # compile — its XLA program also warms the compile cache for fp32); int8
 # last (longest end-to-end: calibration + conversion + compile).
 LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "train_step",
-              "infer", "pipeline", "multichip", "resnet50_v1_int8"]
+              "infer", "decode", "pipeline", "multichip",
+              "resnet50_v1_int8"]
 
 # generous-but-bounded per-lane wall budgets (seconds) on the device;
 # CPU-fallback lanes use small sizes and get one flat budget.
 # BENCH_LANE_TIMEOUT overrides every device-lane budget.
 _LANE_BUDGET = {"resnet50_v1_bf16": 600.0, "resnet50_v1": 600.0,
                 "bert": 540.0, "train_step": 240.0, "infer": 240.0,
-                "pipeline": 240.0, "multichip": 420.0,
+                "decode": 300.0, "pipeline": 240.0, "multichip": 420.0,
                 "resnet50_v1_int8": 900.0}
 _CPU_LANE_BUDGET = 420.0
 

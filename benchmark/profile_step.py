@@ -137,6 +137,49 @@ def build_gluon_step(model_name, batch, layout, s2d, bf16, step_mode,
     return run_step
 
 
+def build_decode_step(batch, seq):
+    """``--step-mode decode``: profile the continuous-batching decode
+    program (serving_decode.GenerativeEngine) — ``run_step()`` is one
+    concurrent token-generation burst (``batch`` requests × 4 tokens),
+    so the trace shows the ONE fused decode program's page gather /
+    attention / scatter texture rather than per-request host noise."""
+    import threading
+
+    import numpy as onp
+
+    from mxnet_tpu import serving_decode as sd
+
+    model = sd.TinyCausalLM(vocab=512, d_model=256, n_layers=4,
+                            n_heads=8, max_seq=max(seq, 64))
+    pool = sd.PagePool(pages=max(64, batch * (seq // 16 + 2)), page=16)
+    eng = sd.GenerativeEngine(model, pool=pool, max_rows=batch,
+                              name="profile")
+    eng.warmup(max_len=seq)
+    rng = onp.random.RandomState(0)
+    prompts = [rng.randint(0, 512, size=seq // 2).tolist()
+               for _ in range(batch)]
+
+    def run_step():
+        errs = []
+
+        def fire(p):
+            try:
+                eng.generate(p, max_new_tokens=4)
+            except BaseException as e:
+                errs.append(e)
+        threads = [threading.Thread(target=fire, args=(p,))
+                   for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return float(batch * 4)          # tokens generated
+
+    return run_step
+
+
 def classify(name):
     n = name.lower()
     if "conv" in n:
@@ -197,6 +240,13 @@ def parse_trace(logdir, top, save_path=None):
              "tracks (host rows included); op totals are not device time")
     per_op = collections.Counter()
     per_kind = collections.Counter()
+    # per-fusion cost accounting (the ROADMAP-2 MFU substrate): XLA op
+    # events carry per-execution "flops" / "bytes accessed" args on
+    # device traces — summed per op name they give each fusion's
+    # achieved FLOP/s and HBM bandwidth, which is what decides whether
+    # a fusion is compute- or memory-bound and worth a Pallas kernel
+    per_flops = collections.Counter()
+    per_bytes = collections.Counter()
     total = 0.0
     for ev in events:
         if ev.get("ph") != "X" or "dur" not in ev:
@@ -212,6 +262,16 @@ def parse_trace(logdir, top, save_path=None):
         per_op[ev["name"]] += dur
         per_kind[classify(ev["name"])] += dur
         total += dur
+        for k, v in (ev.get("args") or {}).items():
+            lk = k.lower()
+            try:
+                val = float(str(v).replace(",", ""))
+            except (TypeError, ValueError):
+                continue
+            if "flop" in lk and "util" not in lk:
+                per_flops[ev["name"]] += val
+            elif "bytes" in lk and ("accessed" in lk or lk == "bytes"):
+                per_bytes[ev["name"]] += val
     emit(f"\n== device op time (total {total/1e3:.2f} ms across "
          f"{len(per_op)} op names; trace {os.path.basename(paths[-1])}) ==")
     emit("\n-- by kind --")
@@ -222,6 +282,30 @@ def parse_trace(logdir, top, save_path=None):
     for name, dur in per_op.most_common(top):
         emit(f"  {dur/1e3:9.2f} ms  {100*dur/max(total,1e-9):5.1f}%  "
              f"{name[:110]}")
+    # top-N FUSION cost table: time + bytes-accessed + flops columns,
+    # with derived GFLOP/s / GB/s so the top offender's roofline
+    # position reads straight off the log
+    fusions = [(n, d) for n, d in per_op.most_common()
+               if classify(n) == "fusion"][:top]
+    if fusions:
+        emit(f"\n-- top {len(fusions)} fusions by device time "
+             "(bytes/flops from trace args; '-' = not reported) --")
+        emit(f"  {'ms':>9} {'%':>5} {'GFLOP':>9} {'GB':>8} "
+             f"{'GFLOP/s':>9} {'GB/s':>8}  name")
+        for name, dur in fusions:
+            fl, by = per_flops.get(name), per_bytes.get(name)
+            sec = dur / 1e6
+            emit("  "
+                 f"{dur/1e3:9.2f} {100*dur/max(total,1e-9):5.1f} "
+                 + (f"{fl/1e9:9.2f} " if fl else f"{'-':>9} ")
+                 + (f"{by/1e9:8.3f} " if by else f"{'-':>8} ")
+                 + (f"{fl/sec/1e9:9.1f} " if fl and sec else f"{'-':>9} ")
+                 + (f"{by/sec/1e9:8.1f}  " if by and sec
+                    else f"{'-':>8}  ")
+                 + name[:80])
+    else:
+        emit("\n-- no fusion ops in this trace (CPU traces name kernels "
+             "differently; run on device for the fusion table) --")
     flush()
 
 
@@ -236,11 +320,16 @@ def main():
     ap.add_argument("--top", type=int, default=30)
     ap.add_argument("--logdir", default="/tmp/jaxprof")
     ap.add_argument("--step-mode", default="sharded",
-                    choices=("sharded", "eager", "compiled"),
+                    choices=("sharded", "eager", "compiled", "decode"),
                     help="sharded = the ShardedTrainer compiled step "
                          "(historical default); eager vs compiled A/B the "
                          "Gluon tape against cached_step.TrainStep — the "
-                         "reduce+copy share should drop in compiled mode")
+                         "reduce+copy share should drop in compiled mode; "
+                         "decode profiles the serving_decode continuous-"
+                         "batching token-decode program (--batch rows, "
+                         "BENCH_SEQ-ish --seq context)")
+    ap.add_argument("--seq", type=int, default=128,
+                    help="decode mode: max context length (prompt seq/2)")
     ap.add_argument("--parse-only", action="store_true",
                     help="just parse an existing --logdir trace")
     args = ap.parse_args()
@@ -259,6 +348,13 @@ def main():
             t0 = time.perf_counter()
             tr.step(data, label)
             print(f"compiled in {time.perf_counter()-t0:.1f}s; warming")
+        elif args.step_mode == "decode":
+            # decode rows default smaller than a train batch; the
+            # img/s figures below then read as requests/s-ish (each
+            # run_step = batch requests x 4 tokens)
+            args.batch = args.batch if args.batch != 128 else 16
+            run_step = build_decode_step(args.batch, args.seq)
+            print(f"warming (decode step, {args.batch} rows)…")
         else:
             run_step = build_gluon_step(args.model, args.batch,
                                         args.layout, bool(args.s2d),

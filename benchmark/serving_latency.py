@@ -1,6 +1,6 @@
 """Serving-path latency: the shape-bucketed compiled inference engine
 (``mxnet_tpu/serving.py``) driven by a randomized variable-length request
-stream.
+stream, plus the GENERATIVE lanes over ``serving_decode``.
 
 Reports per-request p50/p99 latency, throughput, bucket hits/misses,
 compiled-program count, and the retrace count after warm-up — the PR-4
@@ -14,8 +14,20 @@ exercise the micro-batcher (coalesced requests per dispatch).
 lanes[] entry).  Like benchmark/eager_latency.py, the measured work runs
 in a SUBPROCESS so jit caches and config are clean.
 
+``--decode-only --json`` is bench.py's ``decode`` lane: the
+continuous-batching A/B — the SAME request set generated
+one-request-at-a-time (sequential submission, no row sharing) vs at
+concurrency >= 8 through the iteration-level scheduler — whose
+acceptance bar is **>= 2x tokens/s from continuous batching** with 0
+retraces, plus a compact multi-tenant STORM: bursty Poisson arrivals
+of mixed-length prompts against a fast model co-hosted with a
+deliberately slow model on the SHARED KV page pool, reporting
+per-model p50/p99, shed count, tokens/s, and the interference ratio
+(fast model storm-p99 / solo-p99 — bounded misbehavior, not silent
+collapse).  ``--storm`` prints the storm report standalone.
+
 Usage: python benchmark/serving_latency.py [--json] [--serve-only]
-                                           [--requests N] [--threads T]
+           [--decode-only] [--storm] [--requests N] [--threads T]
 """
 import json
 import os
@@ -133,6 +145,178 @@ eng.close(); eng2.close()
 """
 
 
+_DECODE_WORKER = r"""
+import json, os, sys, threading, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))) if "__file__" in dir() else "/root/repo")
+import numpy as onp
+from mxnet_tpu import program_store, serving_decode as sd
+
+CONC = int(os.environ.get("DECODE_CONCURRENCY", "8"))
+REQS = int(os.environ.get("DECODE_REQUESTS", "16"))
+NEW = int(os.environ.get("DECODE_NEW_TOKENS", "8"))
+STORM = os.environ.get("DECODE_STORM", "1") == "1"
+
+def fast_model():
+    return sd.TinyCausalLM(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                           max_seq=64)
+
+def slow_model():
+    # the deliberately slow co-tenant: ~2.5x the per-step FLOPs of the
+    # fast model — slow per TOKEN, while its own admission queue
+    # (max_queue below) bounds how much of the host it can occupy.
+    # Interference is bounded by the WORST single slow dispatch (the
+    # gate is non-preemptive), so the tenant is deep, not wide.
+    return sd.TinyCausalLM(vocab=128, d_model=72, n_layers=4, n_heads=4,
+                           max_seq=64)
+
+rng = onp.random.RandomState(0)
+def mk_prompts(n, lo=2, hi=17):
+    return [rng.randint(0, 128, size=rng.randint(lo, hi)).tolist()
+            for _ in range(n)]
+
+def drive(eng, prompts, conc, poisson_rate=None, new=NEW):
+    '''Submit prompts from conc client threads (optionally with bursty
+    Poisson inter-arrival sleeps); returns (wall_s, tokens, sheds).'''
+    errs, sheds, tokens = [], [0], [0]
+    lock = threading.Lock()
+    def fire(chunk):
+        for p in chunk:
+            if poisson_rate:
+                time.sleep(rng.exponential(1.0 / poisson_rate))
+            try:
+                out = eng.generate(p, max_new_tokens=new)
+                with lock:
+                    tokens[0] += len(out)
+            except sd.ShedError:
+                with lock:
+                    sheds[0] += 1
+            except BaseException as e:
+                errs.append(e)
+    threads = [threading.Thread(target=fire, args=(prompts[i::conc],))
+               for i in range(conc)]
+    t0 = time.perf_counter()
+    for t in threads: t.start()
+    for t in threads: t.join()
+    if errs:
+        raise errs[0]
+    return time.perf_counter() - t0, tokens[0], sheds[0]
+
+# ---- continuous-batching A/B ------------------------------------------
+model = fast_model(); params = model.init_params(0)
+pool = sd.PagePool(pages=256, page=8)
+eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                          max_rows=max(8, CONC), name="fast")
+t_warm = time.perf_counter()
+warmup_programs = eng.warmup(max_len=16)
+compile_s = time.perf_counter() - t_warm
+prompts = mk_prompts(REQS)
+eng.generate(prompts[0], max_new_tokens=2)       # first-dispatch warm
+t0, d0 = sd.trace_count(), sd.dispatch_count()
+seq_s, seq_tok, _ = drive(eng, prompts, conc=1)  # one request at a time
+conc_s, conc_tok, _ = drive(eng, prompts, conc=CONC)
+st = eng.stats()
+retraces = sd.trace_count() - t0
+seq_tps, conc_tps = seq_tok / seq_s, conc_tok / conc_s
+
+out = {
+    "platform": __import__("jax").default_backend(),
+    "requests": REQS, "concurrency": CONC, "new_tokens": NEW,
+    "programs": st["programs"], "warmup_programs": warmup_programs,
+    "compile_s": round(compile_s, 3),
+    "retraces_after_warm": retraces,
+    "dispatches": sd.dispatch_count() - d0,
+    "rows_per_decode": round(st.get("rows_per_decode", 0.0), 2),
+    "sequential_tokens_s": round(seq_tps, 1),
+    "continuous_tokens_s": round(conc_tps, 1),
+    "batching_speedup": round(conc_tps / max(seq_tps, 1e-9), 2),
+    "p50_us": round(st["p50_us"], 1), "p99_us": round(st["p99_us"], 1),
+    "pool": {k: st["pool"][k] for k in
+             ("pages", "page", "in_use", "high_water")},
+}
+eng.close()
+
+# ---- multi-tenant storm ------------------------------------------------
+if STORM:
+    fparams, sparams = params, slow_model().init_params(1)
+    def storm_phase(with_slow):
+        pool = sd.PagePool(pages=256, page=8)
+        # the fast tenant carries an SLO -> it outranks the slow tenant
+        # at the shared dispatch gate (most-urgent-first ordering)
+        fe = sd.GenerativeEngine(fast_model(), params=fparams, pool=pool,
+                                 max_rows=8, name="fast",
+                                 slo_us=500_000)
+        fe.warmup(max_len=16)
+        agents = []
+        if with_slow:
+            se = sd.GenerativeEngine(slow_model(), params=sparams,
+                                     pool=pool, max_rows=2, max_queue=2,
+                                     name="slow")
+            se.warmup(max_len=16)        # covers the 4..12-token prompts
+            # the slow tenant gets hammered past its tiny queue so the
+            # storm also shows load SHEDDING, not just interference —
+            # shed requests are refused at ADMISSION (no compute), so
+            # arrival pressure exceeds its 2-row/2-queue capacity
+            # without the host saturating (which would measure CPU
+            # contention, not co-tenancy)
+            agents.append((se, mk_prompts(14, 4, 13), 7, 50.0))
+        # >= 101 fast samples so p99 is a real percentile, not the
+        # single unluckiest burst
+        agents.append((fe, mk_prompts(104), 8, 40.0))
+        results = {}
+        def run(eng, prompts, conc, rate):
+            results[eng.name] = drive(eng, prompts, conc,
+                                      poisson_rate=rate)
+        ths = [threading.Thread(target=run, args=a) for a in agents]
+        for t in ths: t.start()
+        for t in ths: t.join()
+        stats = {}
+        for eng, _p, _c, _r in agents:
+            s = eng.stats()
+            wall, tok, shed = results[eng.name]
+            stats[eng.name] = {
+                "p50_us": round(s["p50_us"], 1),
+                "p99_us": round(s["p99_us"], 1),
+                "tokens_s": round(tok / wall, 1),
+                "shed": s["shed"], "preempts": s["preempts"],
+                "slo_violations": s["slo_violations"],
+                "delivered": s["delivered"],
+            }
+            eng.close()
+        return stats
+    solo = storm_phase(with_slow=False)["fast"]
+    storm = storm_phase(with_slow=True)
+    out["storm"] = {
+        "fast_solo_p99_us": solo["p99_us"],
+        "fast": storm["fast"], "slow": storm["slow"],
+        "interference_p99_ratio": round(
+            storm["fast"]["p99_us"] / max(solo["p99_us"], 1e-9), 2),
+        "shed_total": storm["fast"]["shed"] + storm["slow"]["shed"],
+    }
+
+_disk = program_store.disk_stats()
+out["cache_hits"] = _disk["hits"]
+out["cache_misses"] = _disk["misses"]
+print(json.dumps(out))
+"""
+
+
+def run_decode(requests: int = 16, concurrency: int = 8,
+               storm: bool = True) -> dict:
+    env = dict(os.environ)
+    env["DECODE_REQUESTS"] = str(requests)
+    env["DECODE_CONCURRENCY"] = str(concurrency)
+    env["DECODE_STORM"] = "1" if storm else "0"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+    r = subprocess.run([sys.executable, "-u", "-c", _DECODE_WORKER],
+                       capture_output=True, text=True, timeout=900,
+                       env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))) or ".")
+    if r.returncode != 0:
+        raise RuntimeError(f"decode lane failed:\n{r.stderr[-2000:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def run_serving(requests: int = 64, threads: int = 4) -> dict:
     env = dict(os.environ)
     env["SERVE_REQUESTS"] = str(requests)
@@ -174,11 +358,42 @@ def main() -> None:
           f"{c['throughput_rps']:.1f} req/s")
 
 
+def main_decode(storm_only: bool = False) -> None:
+    lane = run_decode(storm=True)
+    print(f"decode lane ({lane['platform']}, {lane['requests']} requests "
+          f"x {lane['new_tokens']} tokens, concurrency "
+          f"{lane['concurrency']})")
+    print(f"programs {lane['programs']} (warmup "
+          f"{lane['warmup_programs']}), retraces after warm "
+          f"{lane['retraces_after_warm']}, "
+          f"{lane['rows_per_decode']} rows/decode-step")
+    print(f"one-at-a-time {lane['sequential_tokens_s']} tok/s -> "
+          f"continuous {lane['continuous_tokens_s']} tok/s "
+          f"({lane['batching_speedup']}x)")
+    s = lane.get("storm")
+    if s:
+        print(f"storm: fast p99 {s['fast']['p99_us']:.0f} us "
+              f"(solo {s['fast_solo_p99_us']:.0f} us, "
+              f"{s['interference_p99_ratio']}x), "
+              f"fast {s['fast']['tokens_s']} tok/s / slow "
+              f"{s['slow']['tokens_s']} tok/s, "
+              f"{s['shed_total']} shed, "
+              f"{s['slow']['preempts'] + s['fast']['preempts']} "
+              "preempts")
+
+
 if __name__ == "__main__":
     if "--serve-only" in sys.argv:
         # bench.py's lanes[] entry point: the one serving lane
         lane = run_serving()
         print(json.dumps({"serving": lane}) if "--json" in sys.argv
               else lane)
+    elif "--decode-only" in sys.argv:
+        # bench.py's decode lane entry point
+        lane = run_decode()
+        print(json.dumps({"decode": lane}) if "--json" in sys.argv
+              else lane)
+    elif "--storm" in sys.argv:
+        main_decode(storm_only=True)
     else:
         main()

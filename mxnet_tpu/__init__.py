@@ -81,6 +81,7 @@ _LAZY = {
     "cached_step": ".cached_step",
     "program_store": ".program_store",
     "serving": ".serving",
+    "serving_decode": ".serving_decode",
     "test_utils": ".test_utils",
     "recordio": ".recordio",
     "util": ".util",

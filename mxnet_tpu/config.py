@@ -194,11 +194,15 @@ declare("MXNET_ENFORCE_DETERMINISM", bool, False,
         "Disable nondeterministic optimizations (XLA autotuning picks "
         "deterministic kernels)", subsystem="engine")
 declare("MXNET_INT8_PALLAS", int, 0,
-        "Route eligible 1x1 NHWC quantized convs through the explicit "
-        "Pallas int8 MXU kernel instead of lax.conv s8.  0 = off "
-        "(default until the chip microbench decides), 1 = on for "
-        "single-device TPU, 2 = force everywhere incl. the CPU Pallas "
-        "interpreter (tests).")
+        "Route eligible 1x1/3x3 NHWC quantized convs through the "
+        "explicit Pallas int8 MXU kernel instead of lax.conv s8.  0 = "
+        "off — the SHIPPED default: the chip microbench measured the "
+        "Pallas path at 0.345x of plain lax and int8 LOSING to bf16 at "
+        "matched batch (BENCH_builder_r05; benchmark/microbench_tpu.py "
+        "section_int8_pallas re-measures).  Skips are counted "
+        "(quantization.pallas_skipped_count) and logged once.  1 = on "
+        "for single-device TPU, 2 = force everywhere incl. the CPU "
+        "Pallas interpreter (tests).")
 declare("MXNET_EAGER_JIT", int, 1,
         "Per-op jit compilation cache for eager dispatch (the reference "
         "engine's operator-bulking analog): one cached XLA executable per "
@@ -367,6 +371,43 @@ declare("MXNET_FORWARD_CACHE", int, 32,
         "MXNET_PROGRAM_CACHE_CAPS overrides it per namespace.",
         validator=lambda v: v > 0,
         subsystem="serving", cached=False)
+declare("MXNET_KV_PAGE", int, 16,
+        "Paged KV-cache (serving_decode.PagePool): tokens per cache "
+        "page.  Sequences hold ceil(len/page) pages from the fixed "
+        "shared HBM pool and release them at retirement; smaller pages "
+        "waste less tail HBM per sequence but deepen the page-table "
+        "gather inside the decode program.",
+        validator=lambda v: v >= 1, subsystem="serving", cached=False)
+declare("MXNET_KV_PAGES", int, 512,
+        "Paged KV-cache: total pages in the process-shared pool "
+        "(serving_decode.shared_pool) — the HBM budget every co-hosted "
+        "GenerativeEngine draws from.  Exhaustion at admission sheds "
+        "loudly (faults.ShedError, site serving.admit); exhaustion "
+        "mid-decode preempts the youngest sequence (pages freed, "
+        "request re-queued, greedy continuation token-exact).",
+        validator=lambda v: v >= 1, subsystem="serving", cached=False)
+declare("MXNET_SERVE_MAX_QUEUE", int, 64,
+        "GenerativeEngine admission bound: pending generate() requests "
+        "past this depth are refused immediately with faults.ShedError "
+        "(site serving.admit) — overload degrades loudly, never a "
+        "timeout.", validator=lambda v: v >= 1, subsystem="serving",
+        cached=False)
+declare("MXNET_SERVE_SLO_US", int, 0,
+        "GenerativeEngine per-request latency SLO in microseconds.  "
+        "0 = off.  When set, admission consults the per-bucket cost "
+        "table (EMA of measured prefill/decode-step times — no trial "
+        "dispatch): a request whose estimated queue wait already busts "
+        "the SLO sheds at admission (ShedError, counted shed_slo); "
+        "delivered requests that exceeded it count slo_violations in "
+        "engine.stats().", validator=lambda v: v >= 0,
+        subsystem="serving", cached=False)
+declare("MXNET_SERVE_DECODE_ROWS", int, 8,
+        "GenerativeEngine decode-step row capacity: the ONE compiled "
+        "token-decode program always runs this many sequence rows "
+        "(live sequences occupy rows, dead rows are masked), so "
+        "join/retire never retraces.  Also the continuous-batching "
+        "concurrency ceiling per engine.",
+        validator=lambda v: v >= 1, subsystem="serving", cached=False)
 declare("MXNET_MODULE_SEED", int, None,
         "Override the per-test RNG seed for reproduction (reference test "
         "harness contract)", subsystem="testing")
@@ -394,7 +435,7 @@ declare("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
 declare("BENCH_MODEL", str, "all",
         "bench.py lane selection: 'all' (every lane into one JSON line) "
         "or one of <zoo-name>[_bf16|_int8] | bert | train_step | infer "
-        "| pipeline",
+        "| decode | pipeline | multichip",
         subsystem="bench")
 declare("BENCH_BATCH", int, None, "bench.py batch size override",
         subsystem="bench")

@@ -25,9 +25,42 @@ import numpy as onp
 from ..ops.registry import register
 
 __all__ = ["quantize", "dequantize", "requantize", "collect_calib_ranges",
-           "quantize_symbol", "quantize_net", "QuantizedNet"]
+           "quantize_symbol", "quantize_net", "QuantizedNet",
+           "pallas_skipped_count"]
 
 INT8_MIN, INT8_MAX = -127.0, 127.0       # symmetric, matches reference
+
+# the loud half of ROADMAP item 2's "fix or delete loudly" verdict on the
+# Pallas int8 path: chip bench (BENCH_builder_r05) measured int8_pallas
+# at 0.345x of plain lax — and int8 itself LOSING to bf16 at matched
+# batch — so MXNET_INT8_PALLAS ships 0 and every conv that skips the
+# kernel because of it is counted here and logged once per process
+_PALLAS_SKIPPED = 0
+_PALLAS_SKIP_LOGGED = False
+
+
+def pallas_skipped_count() -> int:
+    """Quantized convs that bypassed the Pallas int8 kernel because
+    ``MXNET_INT8_PALLAS=0`` (the measured-loser default)."""
+    return _PALLAS_SKIPPED
+
+
+def _count_pallas_skip() -> None:
+    global _PALLAS_SKIPPED, _PALLAS_SKIP_LOGGED
+    _PALLAS_SKIPPED += 1
+    if not _PALLAS_SKIP_LOGGED:
+        _PALLAS_SKIP_LOGGED = True
+        from .. import log as _log
+
+        _log.get_logger("mxnet_tpu.quantization").warning(
+            "MXNET_INT8_PALLAS=0 (default): quantized convs use plain "
+            "lax.conv s8 — the explicit Pallas int8 kernel measured "
+            "0.345x of lax and int8 lost to bf16 at matched batch on "
+            "chip (BENCH_builder_r05).  Re-measure with 'python "
+            "benchmark/microbench_tpu.py' (section_int8_pallas) and set "
+            "MXNET_INT8_PALLAS=1 only if it wins on your chip.  "
+            "[logged once; skips counted in "
+            "quantization.pallas_skipped_count()]")
 
 
 # ---------------------------------------------------------------------------
@@ -121,6 +154,7 @@ def _try_pallas_int8(qd, qw, kernel, stride, dilate, pad, num_group,
 
     mode = _config.get("MXNET_INT8_PALLAS")
     if not mode:
+        _count_pallas_skip()             # the default-off gate, loudly
         return None
     if mode != 2 and not (jax.default_backend() == "tpu"
                           and len(jax.devices()) == 1):

@@ -40,6 +40,7 @@ from . import config
 
 __all__ = [
     "FaultInjected", "TransientFault", "FatalFault", "DeadlineExceeded",
+    "ShedError",
     "FaultPlan", "install", "uninstall", "active", "inject", "retry_call",
     "is_retryable", "counters", "events", "record_event", "reset",
 ]
@@ -59,6 +60,16 @@ class FatalFault(FaultInjected):
 
 class DeadlineExceeded(RuntimeError):
     """A retry loop or barrier ran out of wall-clock budget."""
+
+
+class ShedError(RuntimeError):
+    """Typed load-shed refusal (serving admission control, site
+    ``serving.admit``): the request was rejected IMMEDIATELY — queue
+    full, KV page pool exhausted, or the SLO provably unmeetable —
+    instead of queueing toward a timeout.  Overload degrades loudly:
+    callers see this exact type and can back off / route elsewhere;
+    they never see a 300 s deadline breach.  NOT retryable by default
+    (retrying into an overloaded server amplifies the overload)."""
 
 
 # exception kinds a plan spec may name (MXNET_FAULT_PLAN "site:times:kind")

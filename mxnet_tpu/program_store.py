@@ -286,6 +286,11 @@ _declare("train_step", 16, cap_env="MXNET_COMPILED_STEP_CACHE")
 _declare("serving", 32, cap_env="MXNET_FORWARD_CACHE")
 _declare("hybrid_forward", 32, cap_env="MXNET_FORWARD_CACHE")
 _declare("eager_jit", 512)
+# generative serving (serving_decode.GenerativeEngine): the bounded
+# program set is prefill-buckets + 1 decode per engine — the cap only
+# needs to cover that grid, and per-owner caps keep co-hosted models
+# from evicting each other's decode program
+_declare("serving_decode", 32, cap_env="MXNET_FORWARD_CACHE")
 
 
 def namespace(name: str) -> Namespace:

@@ -46,6 +46,12 @@ bucket=True)`` and ``HybridBlock.hybridize(bucket=True)`` pad through
 the same :class:`BucketPolicy`, so variable-length training stops
 blowing the PR-3 program cache too (see ``cached_step.py`` /
 ``gluon/block.py``).
+
+This module serves ONE-SHOT inference (a request is one forward).
+Autoregressive GENERATION — continuous batching, the paged KV-cache,
+and multi-model SLO-aware admission — lives in its sibling
+``serving_decode.py``, which generalizes :class:`BucketPolicy` along
+the sequence axis for its prefill program grid.
 """
 from __future__ import annotations
 

@@ -1,0 +1,1082 @@
+"""Continuous-batching autoregressive serving: decode-step programs +
+paged KV-cache + multi-model SLO-aware admission.
+
+``serving.py`` (PR 4) bounds the program set for *one-shot* inference:
+pad to a bucket, dispatch, slice.  Autoregressive generation breaks that
+model — a request is hundreds of sequential dispatches over a growing
+sequence, and batching whole requests leaves the chip idle whenever the
+longest member is still decoding.  This module is the generative analog,
+built on three ideas:
+
+1. **A bounded program set** (the fusion-boundary lesson of
+   arXiv:2301.13062): per-token work is ONE fused XLA decode program —
+   fixed row capacity ``MXNET_SERVE_DECODE_ROWS``, page-table-indexed
+   KV gather, attention, token sample, and the KV scatter all inside the
+   same jit — plus one prefill program per PR-4 sequence-length bucket
+   (:class:`serving.BucketPolicy` generalized along the sequence axis).
+   Programs live in the ProgramStore ``serving_decode`` namespace and
+   :meth:`GenerativeEngine.warmup` compiles the whole grid from abstract
+   shapes at deploy time.  Steady state: 0 retraces, 1 dispatch per
+   generated token-batch.
+
+2. **Paged KV-cache** (:class:`PagePool`): the cache is a fixed HBM pool
+   of ``MXNET_KV_PAGES`` pages of ``MXNET_KV_PAGE`` tokens each
+   (donated to every prefill/decode dispatch, so it updates in place off
+   the host path).  A sequence holds ``ceil(len/page)`` pages via a
+   page table and releases them the iteration it retires — no
+   max-length pre-reservation, so memory scales with *live tokens*, not
+   worst-case length.  **Continuous batching**: the scheduler admits
+   newly-arrived prefills into freed rows and retires finished
+   sequences every iteration; the decode program always runs full
+   width with dead rows masked (their KV writes land in a reserved
+   trash page), so join/retire never changes a shape.
+
+3. **Multi-model + SLO-aware admission**: N :class:`GenerativeEngine`\\ s
+   per process share the page pool (:func:`shared_pool`) — the
+   cross-model HBM budget — while ProgramStore caps stay per-owner
+   (PR 7), so a co-hosted model can never evict a neighbor's decode
+   program.  Admission is **cost-table driven** (the
+   arXiv:2008.01040 move: predict, don't trial-dispatch): a per-bucket
+   EMA of measured prefill/decode-step times prices each request, and a
+   request that cannot meet ``MXNET_SERVE_SLO_US`` — or arrives past
+   ``MXNET_SERVE_MAX_QUEUE``, or needs more pages than the pool has —
+   is refused *immediately* with the typed :class:`faults.ShedError`
+   (site ``serving.admit``), never parked toward a timeout.  Pool
+   exhaustion mid-decode preempts the youngest sequence (pages freed,
+   request re-queued; greedy decoding makes the recomputed continuation
+   token-exact).  Per-model p50/p99, SLO-violation, shed, and preempt
+   counters land in :meth:`GenerativeEngine.stats`.
+
+The dispatch-budget gate (``tools/check_dispatch_budget.py`` ``decode``
+lane) pins the contract: live programs == prefill buckets + 1, 0
+retraces and 1 dispatch per decode iteration across a join/retire
+storm, 0 leaked pages after drain.
+"""
+from __future__ import annotations
+
+import heapq
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from . import config as _config
+from . import faults as _faults
+from . import program_store as _pstore
+from .faults import ShedError
+from .serving import BucketPolicy
+
+__all__ = ["PagePool", "PagePoolExhausted", "ShedError", "DecodeModel",
+           "TinyCausalLM", "GenerativeEngine", "shared_pool",
+           "eager_generate", "trace_count", "dispatch_count",
+           "reset_counters"]
+
+_NS = _pstore.namespace("serving_decode")
+
+
+def trace_count() -> int:
+    return _NS.traces
+
+
+def dispatch_count() -> int:
+    return _NS.dispatches
+
+
+def reset_counters() -> None:
+    _NS.reset()
+
+
+class PagePoolExhausted(ShedError):
+    """No free KV-cache pages — the typed refusal admission raises and
+    the scheduler's preemption path absorbs."""
+
+
+class _DispatchGate:
+    """SLO-aware dispatch ordering across the engines sharing one pool
+    (i.e. one device budget): each prefill/decode dispatch acquires the
+    gate with a priority (the engine's SLO; ``inf`` when unset), and
+    waiters are served most-urgent-first, FIFO on ties.  Without it a
+    slow co-tenant's free-running decode loop issues steps back to
+    back and a fast model's p99 is unbounded by anything but luck;
+    with it a fast step waits for AT MOST one in-flight slow step —
+    the multi-model interference bound the storm bench measures."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._busy = False
+        self._seq = 0
+        self._heap: List[Tuple[float, int]] = []
+
+    def acquire(self, priority: float) -> None:
+        with self._cv:
+            self._seq += 1
+            tok = (priority, self._seq)
+            heapq.heappush(self._heap, tok)
+            while self._busy or self._heap[0] != tok:
+                self._cv.wait()
+            heapq.heappop(self._heap)
+            self._busy = True
+
+    def release(self) -> None:
+        with self._cv:
+            self._busy = False
+            self._cv.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# Paged KV-cache pool
+# ---------------------------------------------------------------------------
+class PagePool:
+    """Fixed pool of KV-cache pages shared by every engine in the
+    process.
+
+    Accounting is GLOBAL (one free list of ``pages`` page ids — the
+    scheduling resource all co-hosted models contend for); storage is
+    per KV *geometry* ``(n_layers, n_heads, head_dim, dtype)``: each
+    registered geometry owns a ``(pages+1, page, L, H, D)`` key array
+    and value array, where index ``pages`` is the reserved TRASH page
+    masked rows and pad positions write into.  Engines sharing a
+    geometry share storage, so their dispatches serialize through
+    :meth:`exclusive` (the pool buffers are donated); distinct
+    geometries run concurrently.
+
+    ``alloc`` raises :class:`PagePoolExhausted` (a typed
+    :class:`faults.ShedError`) instead of blocking — the caller decides
+    between shedding (admission) and preempting (mid-decode).
+    """
+
+    def __init__(self, pages: Optional[int] = None,
+                 page: Optional[int] = None):
+        self.page = int(page if page is not None
+                        else _config.get("MXNET_KV_PAGE"))
+        self.pages = int(pages if pages is not None
+                         else _config.get("MXNET_KV_PAGES"))
+        if self.page < 1 or self.pages < 1:
+            raise ValueError(
+                f"PagePool needs pages>=1, page>=1 (got {self.pages}, "
+                f"{self.page})")
+        # LIFO free list: a just-freed (hot-in-HBM) page is reused first
+        self._free: List[int] = list(range(self.pages - 1, -1, -1))
+        self._in_use: set = set()
+        self._lock = threading.Lock()
+        self._storage: Dict[Tuple, List] = {}        # geom -> [k, v]
+        self._geom_locks: Dict[Tuple, threading.RLock] = {}
+        self.gate = _DispatchGate()
+        self.alloc_count = 0
+        self.free_count = 0
+        self.exhausted_count = 0
+        self.high_water = 0
+
+    @property
+    def trash(self) -> int:
+        """The reserved scratch page index (== ``pages``): dead decode
+        rows and prefill pad positions scatter here; it is never
+        allocated and never read unmasked."""
+        return self.pages
+
+    # -- accounting --------------------------------------------------------
+    def alloc(self, n: int) -> List[int]:
+        with self._lock:
+            if n > len(self._free):
+                self.exhausted_count += 1
+                raise PagePoolExhausted(
+                    f"KV page pool exhausted: need {n} page(s), "
+                    f"{len(self._free)} free of {self.pages} "
+                    f"(page={self.page} tokens)")
+            got = [self._free.pop() for _ in range(n)]
+            self._in_use.update(got)
+            self.alloc_count += n
+            self.high_water = max(self.high_water, len(self._in_use))
+            return got
+
+    def free(self, pages: Sequence[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p not in self._in_use:
+                    raise ValueError(
+                        f"double/foreign free of page {p} (in_use="
+                        f"{len(self._in_use)})")
+                self._in_use.discard(p)
+                self._free.append(p)
+                self.free_count += 1
+
+    def in_use(self) -> int:
+        with self._lock:
+            return len(self._in_use)
+
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"pages": self.pages, "page": self.page,
+                    "in_use": len(self._in_use),
+                    "free": len(self._free),
+                    "alloc_count": self.alloc_count,
+                    "free_count": self.free_count,
+                    "exhausted_count": self.exhausted_count,
+                    "high_water": self.high_water}
+
+    # -- storage -----------------------------------------------------------
+    def register(self, n_layers: int, n_heads: int, head_dim: int,
+                 dtype=jnp.float32) -> Tuple:
+        """Declare a KV geometry; allocates its (pages+1)-page K and V
+        arrays on first sight.  Returns the storage key."""
+        geom = (int(n_layers), int(n_heads), int(head_dim),
+                jnp.dtype(dtype).name)
+        with self._lock:
+            if geom not in self._storage:
+                shape = (self.pages + 1, self.page, geom[0], geom[1],
+                         geom[2])
+                self._storage[geom] = [jnp.zeros(shape, dtype=dtype),
+                                       jnp.zeros(shape, dtype=dtype)]
+                self._geom_locks[geom] = threading.RLock()
+        return geom
+
+    def exclusive(self, geom: Tuple) -> threading.RLock:
+        """The per-geometry dispatch lock: every program that consumes
+        (donates) this geometry's buffers must hold it across
+        dispatch + storage swap."""
+        return self._geom_locks[geom]
+
+    def storage(self, geom: Tuple) -> Tuple:
+        k, v = self._storage[geom]
+        return k, v
+
+    def set_storage(self, geom: Tuple, k, v) -> None:
+        self._storage[geom][0] = k
+        self._storage[geom][1] = v
+
+    # -- test hook ---------------------------------------------------------
+    def poison_free(self, value: float = 1e30) -> int:
+        """Overwrite every FREE page (all geometries) with ``value`` —
+        the aliasing canary: if any live sequence ever reads a page it
+        does not own, its next tokens diverge loudly.  Returns the
+        number of pages poisoned."""
+        with self._lock:
+            free = list(self._free)
+            geoms = list(self._storage)
+        if not free:
+            return 0
+        idx = jnp.asarray(free, jnp.int32)
+        for g in geoms:
+            with self.exclusive(g):
+                k, v = self._storage[g]
+                self._storage[g] = [k.at[idx].set(value),
+                                    v.at[idx].set(value)]
+        return len(free)
+
+
+_SHARED: Optional[PagePool] = None
+_SHARED_LOCK = threading.Lock()
+
+
+def shared_pool() -> PagePool:
+    """The process-shared pool every engine defaults to — the one HBM
+    budget co-hosted models contend for (sized by ``MXNET_KV_PAGES`` /
+    ``MXNET_KV_PAGE`` at first use)."""
+    global _SHARED
+    with _SHARED_LOCK:
+        if _SHARED is None:
+            _SHARED = PagePool()
+        return _SHARED
+
+
+# ---------------------------------------------------------------------------
+# Model contract
+# ---------------------------------------------------------------------------
+class DecodeModel:
+    """What a model must provide to serve through
+    :class:`GenerativeEngine`.  Attributes: ``vocab``, ``n_layers``,
+    ``n_heads``, ``head_dim``, ``max_seq``.  Two PURE functions of jax
+    arrays (the engine owns paging, masking of dead rows, and batching
+    — the model never sees a page table):
+
+    - ``prefill(params, tokens, length) -> (logits, k, v)`` — one
+      sequence, ``tokens`` ``(B,)`` int32 padded to a bucket,
+      ``length`` the true prompt length; returns next-token ``logits``
+      ``(vocab,)`` at position ``length-1`` plus the per-position cache
+      ``k``/``v`` ``(L, B, H, D)`` (pad positions may hold garbage —
+      the engine masks them out of every later attention).
+    - ``decode(params, tokens, k_ctx, v_ctx, lengths) -> (logits,
+      k_new, v_new)`` — one token per row, ``tokens`` ``(R,)`` int32 at
+      positions ``lengths`` ``(R,)``, attending ``k_ctx``/``v_ctx``
+      ``(L, R, C, H, D)`` where context position ``j`` is valid iff
+      ``j < lengths[r]``; returns ``logits`` ``(R, vocab)`` and the new
+      token's cache rows ``k_new``/``v_new`` ``(L, R, H, D)``.
+
+    KV-cache exactness contract: ``decode`` over cached ``k``/``v``
+    must equal a fresh ``prefill`` over the extended sequence (standard
+    incremental attention) — that is what makes continuous-batched
+    greedy decode token-exact vs the eager loop.
+    """
+
+    vocab: int
+    n_layers: int
+    n_heads: int
+    head_dim: int
+    max_seq: int
+
+    def init_params(self, seed: int = 0):
+        raise NotImplementedError
+
+    def prefill(self, params, tokens, length):
+        raise NotImplementedError
+
+    def decode(self, params, tokens, k_ctx, v_ctx, lengths):
+        raise NotImplementedError
+
+
+class TinyCausalLM(DecodeModel):
+    """Reference :class:`DecodeModel`: a small pre-LN-free causal
+    transformer (learned token + position embeddings, multi-head
+    attention, ReLU MLP, untied output head) used by the parity tests,
+    the dispatch-budget gate, and the decode bench lanes.  Everything
+    is plain ``jnp`` on explicit parameter pytrees, so both entry
+    points trace into single fused programs."""
+
+    def __init__(self, vocab: int = 64, d_model: int = 32,
+                 n_layers: int = 2, n_heads: int = 2,
+                 d_mlp: Optional[int] = None, max_seq: int = 128):
+        if d_model % n_heads:
+            raise ValueError("d_model must divide by n_heads")
+        self.vocab = vocab
+        self.d_model = d_model
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.head_dim = d_model // n_heads
+        self.d_mlp = d_mlp or 2 * d_model
+        self.max_seq = max_seq
+
+    def init_params(self, seed: int = 0):
+        rng = onp.random.RandomState(seed)
+
+        def mat(*shape, scale=None):
+            scale = scale or 1.0 / math.sqrt(shape[0])
+            return jnp.asarray(rng.randn(*shape) * scale, jnp.float32)
+
+        params = {
+            "emb": mat(self.vocab, self.d_model, scale=0.5),
+            "pos": mat(self.max_seq, self.d_model, scale=0.1),
+            "out": mat(self.d_model, self.vocab),
+            "layers": [],
+        }
+        for _ in range(self.n_layers):
+            params["layers"].append({
+                "wq": mat(self.d_model, self.d_model),
+                "wk": mat(self.d_model, self.d_model),
+                "wv": mat(self.d_model, self.d_model),
+                "wo": mat(self.d_model, self.d_model),
+                "w1": mat(self.d_model, self.d_mlp),
+                "w2": mat(self.d_mlp, self.d_model),
+            })
+        return params
+
+    # -- helpers -----------------------------------------------------------
+    def _heads(self, x):
+        return x.reshape(x.shape[:-1] + (self.n_heads, self.head_dim))
+
+    def _attend(self, q, k, v, valid):
+        # q (..., H, D); k/v (..., J, H, D); valid (..., J) bool
+        scores = jnp.einsum("...hd,...jhd->...hj", q, k) \
+            / math.sqrt(self.head_dim)
+        scores = jnp.where(valid[..., None, :], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("...hj,...jhd->...hd", w, v)
+
+    # -- contract ----------------------------------------------------------
+    def prefill(self, params, tokens, length):
+        b = tokens.shape[0]
+        h = params["emb"][tokens] + params["pos"][:b]        # (B, d)
+        pos = jnp.arange(b)
+        causal = pos[:, None] >= pos[None, :]                # (B, B)
+        ks, vs = [], []
+        for lp in params["layers"]:
+            q = self._heads(h @ lp["wq"])                    # (B, H, D)
+            k = self._heads(h @ lp["wk"])
+            v = self._heads(h @ lp["wv"])
+            ks.append(k)
+            vs.append(v)
+            scores = jnp.einsum("ihd,jhd->ihj", q, k) \
+                / math.sqrt(self.head_dim)
+            scores = jnp.where(causal[:, None, :], scores, -1e30)
+            w = jax.nn.softmax(scores, axis=-1)
+            att = jnp.einsum("ihj,jhd->ihd", w, v)           # (B, H, D)
+            h = h + att.reshape(b, self.d_model) @ lp["wo"]
+            h = h + jax.nn.relu(h @ lp["w1"]) @ lp["w2"]
+        logits = h[length - 1] @ params["out"]               # (vocab,)
+        return logits, jnp.stack(ks), jnp.stack(vs)          # (L,B,H,D)
+
+    def decode(self, params, tokens, k_ctx, v_ctx, lengths):
+        r = tokens.shape[0]
+        c = k_ctx.shape[2]
+        h = params["emb"][tokens] + params["pos"][lengths]   # (R, d)
+        ctx_valid = jnp.arange(c)[None, :] < lengths[:, None]  # (R, C)
+        # the new token always attends itself (appended key slot C)
+        valid = jnp.concatenate(
+            [ctx_valid, jnp.ones((r, 1), bool)], axis=1)
+        k_news, v_news = [], []
+        for li, lp in enumerate(params["layers"]):
+            q = self._heads(h @ lp["wq"])                    # (R, H, D)
+            k_new = self._heads(h @ lp["wk"])
+            v_new = self._heads(h @ lp["wv"])
+            k_news.append(k_new)
+            v_news.append(v_new)
+            k = jnp.concatenate([k_ctx[li], k_new[:, None]], axis=1)
+            v = jnp.concatenate([v_ctx[li], v_new[:, None]], axis=1)
+            att = self._attend(q, k, v, valid)               # (R, H, D)
+            h = h + att.reshape(r, self.d_model) @ lp["wo"]
+            h = h + jax.nn.relu(h @ lp["w1"]) @ lp["w2"]
+        logits = h @ params["out"]                           # (R, vocab)
+        return logits, jnp.stack(k_news), jnp.stack(v_news)
+
+
+def eager_generate(model: DecodeModel, params, prompt: Sequence[int],
+                   max_new_tokens: int, eos: Optional[int] = None
+                   ) -> List[int]:
+    """The one-request-at-a-time reference loop: a FULL forward over
+    the tokens so far for every generated token (no KV cache, no
+    batching, exact shapes) — the parity oracle for the continuous
+    batcher and the bench A/B baseline."""
+    toks = [int(t) for t in prompt]
+    out: List[int] = []
+    for _ in range(max_new_tokens):
+        logits, _k, _v = model.prefill(
+            params, jnp.asarray(toks, jnp.int32), len(toks))
+        nxt = int(jnp.argmax(logits))
+        out.append(nxt)
+        toks.append(nxt)
+        if eos is not None and nxt == eos:
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Requests + per-row state
+# ---------------------------------------------------------------------------
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "eos", "out", "event", "error",
+                 "t_enqueue", "t_done", "preempts")
+
+    def __init__(self, prompt: List[int], max_new: int,
+                 eos: Optional[int]):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos = eos
+        self.out: List[int] = []        # survives preemption
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.t_enqueue = time.monotonic()
+        self.t_done = 0.0
+        self.preempts = 0
+
+
+class _Row:
+    __slots__ = ("req", "pages", "cached", "pending", "joined")
+
+    def __init__(self, req: _GenRequest, pages: List[int], cached: int,
+                 pending: int, joined: int):
+        self.req = req
+        self.pages = pages        # page ids, in sequence order
+        self.cached = cached      # tokens whose KV is in the pool
+        self.pending = pending    # next token to feed the decode step
+        self.joined = joined      # admission order, for youngest-first
+                                  # preemption
+
+
+class GenerativeEngine:
+    """Continuous-batching greedy decoder over one :class:`DecodeModel`.
+
+    ``eng = GenerativeEngine(model); toks = eng.generate([1,2,3],
+    max_new_tokens=16)`` — ``generate`` is thread-safe and blocking;
+    concurrent callers share decode iterations (one dispatch per
+    token-batch).  Admission sheds loudly (:class:`faults.ShedError`)
+    instead of queueing toward a timeout; see the module docstring for
+    the scheduler/pool/SLO design.
+    """
+
+    def __init__(self, model: DecodeModel, params=None,
+                 pool: Optional[PagePool] = None,
+                 name: Optional[str] = None,
+                 max_rows: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 slo_us: Optional[int] = None,
+                 policy: Optional[BucketPolicy] = None,
+                 eos: Optional[int] = None):
+        self._model = model
+        self._params = (params if params is not None
+                        else model.init_params())
+        self._pool = pool if pool is not None else shared_pool()
+        self.name = name or type(model).__name__
+        self._rows = int(max_rows if max_rows is not None
+                         else _config.get("MXNET_SERVE_DECODE_ROWS"))
+        self._max_queue = int(max_queue if max_queue is not None
+                              else _config.get("MXNET_SERVE_MAX_QUEUE"))
+        self._slo = (slo_us if slo_us is not None
+                     else _config.get("MXNET_SERVE_SLO_US")) / 1e6
+        # dispatch-gate urgency: tighter SLO dispatches first; engines
+        # without one queue FIFO behind every SLO-bearing neighbor
+        self._priority = self._slo if self._slo > 0 else float("inf")
+        self._policy = policy or BucketPolicy()
+        self._eos = eos
+        self._geom = self._pool.register(
+            model.n_layers, model.n_heads, model.head_dim)
+        self._max_pages = -(-int(model.max_seq) // self._pool.page)
+        self._programs = _pstore.scope("serving_decode")
+        # the cost table (admission prices a request from these EMAs —
+        # never from a trial dispatch): measured seconds per prefill
+        # bucket and per decode step
+        self._cost: Dict[Any, float] = {}
+        self._cv = threading.Condition()
+        self._queue: "deque[_GenRequest]" = deque()
+        self._live: List[_Row] = []
+        self._joined = 0
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._latencies: "deque[float]" = deque(maxlen=8192)
+        self._stats = {"requests": 0, "delivered": 0, "tokens_out": 0,
+                       "prefills": 0, "decode_steps": 0,
+                       "decode_row_util": 0,
+                       "shed": 0, "shed_queue": 0, "shed_pool": 0,
+                       "shed_slo": 0, "preempts": 0, "slo_violations": 0,
+                       "warmup_programs": 0, "bucket_fallbacks": 0}
+        from . import engine as _engine
+
+        _engine.register_drainable(self)
+
+    # -- public ------------------------------------------------------------
+    def generate(self, prompt, max_new_tokens: int = 32,
+                 eos: Optional[int] = None) -> List[int]:
+        """Greedily generate up to ``max_new_tokens`` token ids after
+        ``prompt`` (a 1-D int sequence/array); blocks until delivered.
+        Raises :class:`faults.ShedError` IMMEDIATELY when admission
+        refuses (queue/pool/SLO) — overload is loud, never a hang."""
+        if self._closed:
+            raise RuntimeError("GenerativeEngine is closed")
+        toks = [int(t) for t in onp.asarray(prompt).ravel()]
+        if not toks:
+            raise ValueError("generate() needs a non-empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(toks) + max_new_tokens > self._model.max_seq:
+            raise ValueError(
+                f"prompt({len(toks)}) + max_new({max_new_tokens}) "
+                f"exceeds model.max_seq={self._model.max_seq}")
+        eos = eos if eos is not None else self._eos
+        req = _GenRequest(toks, int(max_new_tokens), eos)
+        self._stats["requests"] += 1
+        self._admit(req)                 # may raise ShedError, fail-fast
+        with self._cv:
+            self._start_thread()
+            self._queue.append(req)
+            self._cv.notify_all()
+        if not req.event.wait(timeout=600.0):
+            raise _faults.DeadlineExceeded(
+                "generation not delivered within 600s (scheduler "
+                "wedged?)")
+        if req.error is not None:
+            raise req.error
+        self._latencies.append(req.t_done - req.t_enqueue)
+        if self._slo > 0 and req.t_done - req.t_enqueue > self._slo:
+            self._stats["slo_violations"] += 1
+        return list(req.out)
+
+    def stats(self) -> Dict[str, Any]:
+        """Per-model counters + request-latency percentiles."""
+        out = dict(self._stats)
+        out["model"] = self.name
+        out["programs"] = len(self._programs)
+        out["queue_depth"] = len(self._queue)
+        out["live_rows"] = len(self._live)
+        out["rows"] = self._rows
+        out["pool"] = self._pool.stats()
+        if out["decode_steps"]:
+            out["rows_per_decode"] = (out["decode_row_util"]
+                                      / out["decode_steps"])
+        lat = sorted(self._latencies)
+        if lat:
+            out["p50_us"] = lat[len(lat) // 2] * 1e6
+            out["p99_us"] = lat[min(len(lat) - 1,
+                                    int(len(lat) * 0.99))] * 1e6
+        else:
+            out["p50_us"] = out["p99_us"] = 0.0
+        return out
+
+    def drain(self, timeout: float = 120.0) -> None:
+        """engine.waitall() hook: block until every admitted request
+        has been delivered (queue empty, no live rows)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._queue and not self._live:
+                    return
+            time.sleep(0.002)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- admission (site serving.admit) -------------------------------------
+    def _estimate_s(self, req: _GenRequest) -> float:
+        """Cost-table price of one request: its prefill bucket's EMA
+        plus max_new decode-step EMAs.  Unknown entries price 0 — the
+        table only ever makes admission MORE willing until it has
+        measurements, never a trial dispatch."""
+        b = self._policy.bucket(len(req.prompt))
+        pre = self._cost.get(("prefill", b), 0.0)
+        dec = self._cost.get("decode", 0.0)
+        return pre + req.max_new * dec
+
+    def _shed(self, kind: str, reason: str,
+              cause: Optional[BaseException] = None):
+        self._stats["shed"] += 1
+        self._stats["shed_" + kind] += 1
+        _faults.record_event("serving.admit", "shed", cause,
+                             model=self.name, kind=kind, reason=reason)
+        err = ShedError(f"[{self.name}] {reason}")
+        if cause is not None:
+            raise err from cause
+        raise err
+
+    def _admit(self, req: _GenRequest) -> None:
+        """Fail-fast admission in the CALLER's thread: the injectable
+        ``serving.admit`` site plus the queue / pool / SLO checks —
+        every refusal is an immediate typed ShedError."""
+        try:
+            _faults.inject("serving.admit")
+        except _faults.FaultInjected as e:
+            self._shed("queue", "admission fault injected", cause=e)
+        with self._cv:
+            qlen = len(self._queue)
+        if qlen >= self._max_queue:
+            self._shed("queue",
+                       f"admission queue full ({qlen} >= "
+                       f"MXNET_SERVE_MAX_QUEUE={self._max_queue})")
+        need = -(-(len(req.prompt) + req.max_new) // self._pool.page)
+        if need > self._pool.pages:
+            self._shed("pool",
+                       f"request needs {need} KV pages, pool holds "
+                       f"{self._pool.pages} total — can never fit")
+        if self._slo > 0:
+            est = (qlen + 1) * self._estimate_s(req)
+            if est > self._slo:
+                self._shed("slo",
+                           f"cost table predicts {est*1e6:.0f}us wait "
+                           f"vs SLO {self._slo*1e6:.0f}us "
+                           f"({qlen} queued ahead)")
+
+    # -- scheduler ----------------------------------------------------------
+    def _start_thread(self) -> None:
+        if self._thread is None and not self._closed:
+            self._thread = threading.Thread(
+                target=self._sched_loop, daemon=True,
+                name=f"mxnet-decode-{self.name}")
+            self._thread.start()
+
+    def _sched_loop(self) -> None:
+        while True:
+            with self._cv:
+                while (not self._queue and not self._live
+                       and not self._closed):
+                    self._cv.wait(timeout=0.1)
+                if self._closed and not self._queue and not self._live:
+                    return
+            try:
+                self._iteration()
+            except BaseException as e:      # deliver, never wedge
+                self._fail_all(e)
+
+    def _fail_all(self, e: BaseException) -> None:
+        with self._cv:
+            rows, self._live = self._live, []
+            reqs = list(self._queue)
+            self._queue.clear()
+        for row in rows:
+            self._release(row)
+            row.req.error = e
+            row.req.t_done = time.monotonic()
+            row.req.event.set()
+        for req in reqs:
+            req.error = e
+            req.t_done = time.monotonic()
+            req.event.set()
+
+    def _iteration(self) -> None:
+        """One scheduler iteration: admit prefills into free rows, run
+        one decode step over the union of live sequences, retire."""
+        # -- join: newly arrived prefills slot into freed rows
+        while len(self._live) < self._rows:
+            with self._cv:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            try:
+                self._prefill(req)
+                continue
+            except PagePoolExhausted:
+                with self._cv:
+                    self._queue.appendleft(req)   # head-of-line: retry
+                if not self._live:
+                    # nothing of OURS will retire and free pages; wait
+                    # briefly for other engines, then shed loudly
+                    if self._wait_for_pages(req):
+                        continue
+                    with self._cv:
+                        self._queue.remove(req)
+                    self._stats["shed"] += 1
+                    self._stats["shed_pool"] += 1
+                    _faults.record_event(
+                        "serving.admit", "shed", model=self.name,
+                        kind="pool", reason="pool exhausted at prefill")
+                    req.error = ShedError(
+                        f"[{self.name}] KV page pool exhausted at "
+                        "prefill and no progress upstream")
+                    req.t_done = time.monotonic()
+                    req.event.set()
+                break
+            except BaseException as e:
+                # a bad REQUEST (untraceable bucket, model error) fails
+                # only its own caller — the engine and its neighbors
+                # keep serving
+                req.error = e
+                req.t_done = time.monotonic()
+                req.event.set()
+        # -- decode: one dispatch for the union of live sequences
+        if self._live:
+            self._decode_step()
+            self._retire_finished()
+
+    def _wait_for_pages(self, req: _GenRequest, budget: float = 5.0
+                        ) -> bool:
+        """Pool empty and this engine idle: another engine's retirement
+        is the only path to pages.  Poll briefly; True = pages appeared."""
+        need = -(-len(req.prompt) // self._pool.page) or 1
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            if self._pool.free_pages() >= need:
+                return True
+            if self._closed:
+                return False
+            time.sleep(0.005)
+        return False
+
+    # -- prefill ------------------------------------------------------------
+    def _prefill(self, req: _GenRequest) -> None:
+        """Compile-per-bucket prompt program: embeds the prompt, writes
+        its KV into freshly allocated pages (scatter INSIDE the
+        program), and emits the first generated token."""
+        prompt = req.prompt + req.out     # re-grown after preemption
+        n = len(prompt)
+        bucket = self._policy.bucket(n)
+        if bucket is None:                # above the largest bucket
+            self._stats["bucket_fallbacks"] += 1
+            bucket = n
+        # the position table only spans max_seq (generate() already
+        # bounds n itself)
+        bucket = min(bucket, int(self._model.max_seq))
+        pages = self._pool.alloc(-(-n // self._pool.page))
+        try:
+            rec = self._prefill_program(bucket)
+            tokens = onp.zeros((bucket,), onp.int32)
+            tokens[:n] = prompt
+            table = onp.full((self._max_pages,), self._pool.trash,
+                             onp.int32)
+            table[:len(pages)] = pages
+            t0 = time.perf_counter()
+            self._pool.gate.acquire(self._priority)
+            try:
+                with self._pool.exclusive(self._geom):
+                    k, v = self._pool.storage(self._geom)
+                    first, k, v = rec(self._params,
+                                      jnp.asarray(tokens),
+                                      jnp.int32(n),
+                                      jnp.asarray(table), k, v)
+                    first = int(first)    # host read = real cost
+                    self._pool.set_storage(self._geom, k, v)
+            finally:
+                self._pool.gate.release()
+            self._ema(("prefill", bucket), time.perf_counter() - t0)
+            self._stats["prefills"] += 1
+        except BaseException:
+            self._pool.free(pages)
+            raise
+        req.out.append(first)
+        row = _Row(req, pages, cached=n, pending=first,
+                   joined=self._joined)
+        self._joined += 1
+        if self._done(row):
+            self._deliver(row)
+        else:
+            self._live.append(row)
+
+    def _prefill_program(self, bucket: int):
+        rec = self._programs.lookup(("prefill", bucket))
+        if rec is not None:
+            return rec
+        return self._build_prefill(bucket)
+
+    def _build_prefill(self, bucket: int):
+        model, pool, page = self._model, self._pool, self._pool.page
+        trash = pool.trash
+
+        def prefill_fn(params, tokens, length, table, k_pool, v_pool):
+            _pstore.count_trace("serving_decode")
+            logits, k, v = model.prefill(params, tokens, length)
+            pos = jnp.arange(bucket)
+            valid = pos < length
+            pidx = jnp.where(valid, table[pos // page], trash)
+            slot = pos % page
+            # k/v (L, B, H, D) -> per-position rows (B, L, H, D)
+            k_pool = k_pool.at[pidx, slot].set(k.transpose(1, 0, 2, 3))
+            v_pool = v_pool.at[pidx, slot].set(v.transpose(1, 0, 2, 3))
+            return jnp.argmax(logits).astype(jnp.int32), k_pool, v_pool
+
+        jitted = jax.jit(prefill_fn, donate_argnums=self._donate)
+        args = self._prefill_specs(bucket)
+        rec = _pstore.build("serving_decode", jitted, args,
+                            label=f"{self.name}[prefill b={bucket}]")
+        self._programs.insert(("prefill", bucket), rec)
+        return rec
+
+    # -- decode -------------------------------------------------------------
+    def _decode_step(self) -> None:
+        """ONE dispatch for every live sequence: gather pages, attend,
+        sample, scatter the new KV — all inside the one compiled decode
+        program.  Dead rows run masked into the trash page."""
+        for row in list(self._live):
+            # a preemption inside an earlier row's _ensure_page may have
+            # evicted THIS row — allocating onto an evicted row would
+            # orphan the page
+            if row in self._live:
+                self._ensure_page(row)
+        if not self._live:
+            return
+        rec = self._decode_program()
+        r = self._rows
+        tokens = onp.zeros((r,), onp.int32)
+        tables = onp.full((r, self._max_pages), self._pool.trash,
+                          onp.int32)
+        lengths = onp.zeros((r,), onp.int32)
+        for i, row in enumerate(self._live):
+            tokens[i] = row.pending
+            tables[i, :len(row.pages)] = row.pages
+            lengths[i] = row.cached
+        t0 = time.perf_counter()
+        self._pool.gate.acquire(self._priority)
+        try:
+            with self._pool.exclusive(self._geom):
+                k, v = self._pool.storage(self._geom)
+                nxt, k, v = rec(self._params, jnp.asarray(tokens),
+                                jnp.asarray(tables),
+                                jnp.asarray(lengths), k, v)
+                nxt = onp.asarray(nxt)    # host read = real cost
+                self._pool.set_storage(self._geom, k, v)
+        finally:
+            self._pool.gate.release()
+        self._ema("decode", time.perf_counter() - t0)
+        self._stats["decode_steps"] += 1
+        self._stats["decode_row_util"] += len(self._live)
+        for i, row in enumerate(self._live):
+            row.cached += 1               # pending's KV is now paged
+            row.pending = int(nxt[i])
+            row.req.out.append(row.pending)
+        self._stats["tokens_out"] += len(self._live)
+
+    def _ensure_page(self, row: _Row) -> None:
+        """The incoming token writes KV at position ``row.cached`` —
+        allocate its page if that position opens a new one.  Exhaustion
+        preempts the YOUNGEST other live sequence (vLLM-style recompute
+        preemption: pages freed, request re-queued at the head; greedy
+        decode makes the recomputed continuation token-exact)."""
+        if row.cached < len(row.pages) * self._pool.page:
+            return
+        while True:
+            try:
+                row.pages.extend(self._pool.alloc(1))
+                return
+            except PagePoolExhausted as e:
+                victims = [x for x in self._live if x is not row]
+                if not victims:
+                    # this sequence alone outgrew the pool: loud typed
+                    # failure, never a silent truncation
+                    self._live.remove(row)
+                    self._release(row)
+                    self._stats["shed"] += 1
+                    self._stats["shed_pool"] += 1
+                    _faults.record_event(
+                        "serving.admit", "shed", e, model=self.name,
+                        kind="pool", reason="single sequence outgrew pool")
+                    row.req.error = ShedError(
+                        f"[{self.name}] sequence needs page "
+                        f"{len(row.pages) + 1}, pool exhausted with no "
+                        "other sequence to preempt")
+                    row.req.t_done = time.monotonic()
+                    row.req.event.set()
+                    return
+                self._preempt(max(victims, key=lambda x: x.joined))
+
+    def _preempt(self, row: _Row) -> None:
+        self._live.remove(row)
+        self._release(row)
+        row.req.preempts += 1
+        self._stats["preempts"] += 1
+        _faults.record_event("serving.admit", "preempt",
+                             model=self.name,
+                             tokens_done=len(row.req.out))
+        with self._cv:
+            self._queue.appendleft(row.req)
+
+    def _decode_program(self):
+        rec = self._programs.lookup(("decode",))
+        if rec is not None:
+            return rec
+        return self._build_decode()
+
+    def _build_decode(self):
+        model, page = self._model, self._pool.page
+
+        def decode_fn(params, tokens, tables, lengths, k_pool, v_pool):
+            _pstore.count_trace("serving_decode")
+            # page-table gather: (R, P) -> (R, P, page, L, H, D)
+            k_ctx = k_pool[tables]
+            v_ctx = v_pool[tables]
+            r, p = tables.shape[0], tables.shape[1]
+            # -> (L, R, C=P*page, H, D)
+            k_ctx = k_ctx.reshape(r, p * page, model.n_layers,
+                                  model.n_heads, model.head_dim
+                                  ).transpose(2, 0, 1, 3, 4)
+            v_ctx = v_ctx.reshape(r, p * page, model.n_layers,
+                                  model.n_heads, model.head_dim
+                                  ).transpose(2, 0, 1, 3, 4)
+            logits, k_new, v_new = model.decode(
+                params, tokens, k_ctx, v_ctx, lengths)
+            # scatter the new token's KV at (page of position len, slot)
+            rows = jnp.arange(r)
+            pidx = tables[rows, lengths // page]
+            slot = lengths % page
+            # (L, R, H, D) -> (R, L, H, D) rows
+            k_pool = k_pool.at[pidx, slot].set(
+                k_new.transpose(1, 0, 2, 3))
+            v_pool = v_pool.at[pidx, slot].set(
+                v_new.transpose(1, 0, 2, 3))
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                    k_pool, v_pool)
+
+        jitted = jax.jit(decode_fn, donate_argnums=self._donate)
+        rec = _pstore.build("serving_decode", jitted,
+                            self._decode_specs(),
+                            label=f"{self.name}[decode r={self._rows}]")
+        self._programs.insert(("decode",), rec)
+        return rec
+
+    # -- shapes / specs ------------------------------------------------------
+    @property
+    def _donate(self) -> Tuple[int, ...]:
+        # pool buffers update in place on real devices; CPU skips
+        # donation to avoid jax's unusable-donation warning (the
+        # cached_step idiom)
+        return (4, 5) if jax.default_backend() != "cpu" else ()
+
+    def _pool_specs(self):
+        k, v = self._pool.storage(self._geom)
+        return (jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype))
+
+    def _param_specs(self):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self._params)
+
+    def _prefill_specs(self, bucket: int):
+        kspec, vspec = self._pool_specs()
+        return (self._param_specs(),
+                jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((self._max_pages,), jnp.int32),
+                kspec, vspec)
+
+    def _decode_specs(self):
+        kspec, vspec = self._pool_specs()
+        return (self._param_specs(),
+                jax.ShapeDtypeStruct((self._rows,), jnp.int32),
+                jax.ShapeDtypeStruct((self._rows, self._max_pages),
+                                     jnp.int32),
+                jax.ShapeDtypeStruct((self._rows,), jnp.int32),
+                kspec, vspec)
+
+    # -- retire / deliver ----------------------------------------------------
+    def _done(self, row: _Row) -> bool:
+        req = row.req
+        return (len(req.out) >= req.max_new
+                or (req.eos is not None and req.out
+                    and req.out[-1] == req.eos))
+
+    def _retire_finished(self) -> None:
+        for row in [x for x in self._live if self._done(x)]:
+            self._live.remove(row)
+            self._deliver(row)
+
+    def _release(self, row: _Row) -> None:
+        if row.pages:
+            self._pool.free(row.pages)
+            row.pages = []
+
+    def _deliver(self, row: _Row) -> None:
+        self._release(row)               # pages free THIS iteration
+        self._stats["delivered"] += 1
+        row.req.t_done = time.monotonic()
+        row.req.event.set()
+
+    def _ema(self, key, secs: float, alpha: float = 0.3) -> None:
+        prev = self._cost.get(key)
+        self._cost[key] = secs if prev is None \
+            else (1 - alpha) * prev + alpha * secs
+
+    # -- ahead-of-time warmup ------------------------------------------------
+    def warmup(self, max_len: Optional[int] = None) -> int:
+        """Compile the bounded program set — one prefill per bucket of
+        the ``MXNET_SHAPE_BUCKETS`` grid (pow2 spans 1..``max_len``,
+        default ``model.max_seq``; an explicit grid compiles verbatim)
+        plus THE decode program — from abstract shapes at deploy time,
+        off the request path (with ``MXNET_PROGRAM_CACHE_DIR`` they
+        persist for the next process).  Returns programs compiled
+        (0 = already warm)."""
+        if self._closed:
+            raise RuntimeError("GenerativeEngine is closed")
+        cap = int(max_len if max_len is not None else self._model.max_seq)
+        cap = min(cap, int(self._model.max_seq))
+        if not self._policy.enabled:
+            grid: List[int] = [cap]
+        elif self._policy.buckets() is not None:
+            grid = [b for b in self._policy.buckets() if b <= cap]
+        else:
+            grid, b = [], 1
+            while b <= cap:
+                grid.append(b)
+                b <<= 1
+        compiled = 0
+        for b in grid:
+            if self._programs.lookup(("prefill", b)) is None:
+                self._build_prefill(b)
+                compiled += 1
+        if self._programs.lookup(("decode",)) is None:
+            self._build_decode()
+            compiled += 1
+        self._stats["warmup_programs"] += compiled
+        return compiled

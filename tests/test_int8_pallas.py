@@ -179,3 +179,32 @@ def test_int8_blocks_picker():
     # bs8 at 7x7 (392 rows) cannot tile the s8 sublane quantum: the
     # conv falls back to lax.conv rather than mis-tiling
     assert int8_blocks(8 * 7 * 7, 512, 2048) is None
+
+
+def test_default_off_counts_skip_and_logs_once(monkeypatch, caplog):
+    """ROADMAP-2 'fix or delete loudly', the loud half: with the
+    measured-loser default MXNET_INT8_PALLAS=0, every eligible-looking
+    quantized conv that bypasses the Pallas kernel bumps
+    ``pallas_skipped_count`` and the pointer at the microbench
+    (section_int8_pallas) is logged exactly once per process."""
+    import logging
+
+    monkeypatch.setenv("MXNET_INT8_PALLAS", "0")
+    config.refresh("MXNET_INT8_PALLAS")
+    rng = onp.random.RandomState(3)
+    qx = rng.randint(-127, 128, (2, 8, 8, 16)).astype(onp.int8)
+    qw = rng.randint(-127, 128, (16, 1, 1, 16)).astype(onp.int8)
+    before = q.pallas_skipped_count()
+    monkeypatch.setattr(q, "_PALLAS_SKIP_LOGGED", False)
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.quantization"):
+        q.quantized_conv([jnp.asarray(qx), jnp.asarray(qw)],
+                         kernel=(1, 1), num_filter=16, layout="NHWC",
+                         no_bias=True)
+        q.quantized_conv([jnp.asarray(qx), jnp.asarray(qw)],
+                         kernel=(1, 1), num_filter=16, layout="NHWC",
+                         no_bias=True)
+    assert q.pallas_skipped_count() - before == 2       # every skip counted
+    msgs = [r.message for r in caplog.records
+            if "section_int8_pallas" in r.message]
+    assert len(msgs) == 1                               # logged ONCE
+    assert "MXNET_INT8_PALLAS" in msgs[0] and "0.345x" in msgs[0]

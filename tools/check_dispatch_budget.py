@@ -21,6 +21,13 @@ stream after warming every bucket: exactly ``1`` compiled launch per
 dispatched batch, ``0`` re-traces, and the compiled-program count
 bounded by the bucket grid.
 
+The DECODE gate (PR 8, docs/PERF.md "Continuous batching + paged
+KV-cache") drives a ``serving_decode.GenerativeEngine`` through a
+concurrent join/retire storm: live programs == prefill buckets + 1
+decode, ``0`` re-traces after warm-up, exactly ``1`` dispatch per
+decode iteration (plus one per prefill, nothing else), and ``0``
+leaked KV pages after ``engine.waitall()``.
+
 Invoked by the test suite (tests/test_cached_step.py /
 tests/test_serving.py) exactly like tools/check_fault_sites.py, and
 runnable standalone:
@@ -52,6 +59,13 @@ AMP_BUDGET = {"host_syncs_per_step": 1, "deferred_reads_per_step": 1}
 # batching"): steady state over a variable-length stream
 INFER_BUDGET = {"launches_per_batch": 1, "retraces_after_warm": 0,
                 "programs_over_buckets": 0}
+# the DECODE budget (docs/PERF.md "Continuous batching + paged
+# KV-cache"): across a join/retire storm the generative engine holds
+# exactly prefill-buckets + 1 decode program, re-traces nothing after
+# warm-up, performs exactly ONE dispatch per decode iteration (and one
+# per prefill), and leaks zero KV pages once drained
+DECODE_BUDGET = {"retraces_after_warm": 0, "programs_over_grid": 0,
+                 "extra_dispatches": 0, "leaked_pages": 0}
 # the PROGRAM-STORE budget (docs/PERF.md "ProgramStore"): steady state
 # keeps the live-program count at the declared grid (train: 1 signature
 # -> 1 program; serving: <= buckets, covered by programs_over_buckets),
@@ -256,6 +270,67 @@ def _measure_infer() -> dict:
     return out
 
 
+def _measure_decode() -> dict:
+    """Join/retire storm through the continuous batcher: concurrent
+    variable-length requests with staggered lengths and budgets so
+    sequences join mid-stream and retire early, then count programs,
+    retraces, dispatches-per-iteration, and leaked pages."""
+    import threading
+
+    import numpy as onp
+
+    from mxnet_tpu import engine as _engine
+    from mxnet_tpu import serving_decode as sd
+
+    model = sd.TinyCausalLM(vocab=37, d_model=16, n_layers=2, n_heads=2,
+                            max_seq=32)
+    params = model.init_params(3)
+    pool = sd.PagePool(pages=48, page=4)
+    eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                              max_rows=4, name="budget")
+    grid = eng.warmup(max_len=16)        # pow2 buckets 1..16 + decode
+    t0, d0 = sd.trace_count(), sd.dispatch_count()
+    rng = onp.random.RandomState(11)
+    prompts = [rng.randint(0, 37, size=rng.randint(1, 13)).tolist()
+               for _ in range(8)]
+    budgets = [3, 9, 5, 2, 7, 4, 8, 6]   # early retires + long tails
+    errs = []
+
+    def fire(i):
+        try:
+            out = eng.generate(prompts[i], max_new_tokens=budgets[i])
+            assert len(out) == budgets[i]
+        except BaseException as e:        # pragma: no cover
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    _engine.waitall()                    # drains the engine's queue
+    st = eng.stats()
+    out = {
+        "mode": "decode",
+        "errors": errs,
+        "warmup_programs": grid,
+        "programs": st["programs"],
+        "programs_over_grid": max(0, st["programs"] - grid),
+        "retraces_after_warm": sd.trace_count() - t0,
+        # 1 dispatch per decode iteration + 1 per prefill, nothing else
+        "dispatches": sd.dispatch_count() - d0,
+        "decode_steps": st["decode_steps"],
+        "prefills": st["prefills"],
+        "extra_dispatches": (sd.dispatch_count() - d0)
+        - st["decode_steps"] - st["prefills"],
+        "rows_per_decode": round(st.get("rows_per_decode", 0.0), 2),
+        "leaked_pages": pool.in_use(),
+        "shed": st["shed"],
+    }
+    eng.close()
+    return out
+
+
 def _store_worker() -> None:
     """``--store-worker`` mode: run the tiny train-step + serving-bucket
     workload in THIS process and print its program-store verdict as one
@@ -356,6 +431,15 @@ def main() -> int:
           f"{infer['launches_per_batch']:.1f} launches/batch, "
           f"{infer['retraces_after_warm']} retraces, "
           f"{infer['programs']} programs over {infer['buckets']} buckets")
+    decode = _measure_decode()
+    print(f"{'decode':<10} storm -> {decode['programs']} programs "
+          f"(grid {decode['warmup_programs']}), "
+          f"{decode['retraces_after_warm']} retraces, "
+          f"{decode['dispatches']} dispatches = "
+          f"{decode['decode_steps']} decode + "
+          f"{decode['prefills']} prefill "
+          f"({decode['rows_per_decode']} rows/step), "
+          f"{decode['leaked_pages']} leaked pages")
     mesh = _measure_mesh()
     if mesh["skipped"]:
         print(f"mesh       SKIPPED ({mesh['skipped']})")
@@ -406,6 +490,16 @@ def main() -> int:
         if infer[key] > budget:
             failures.append(
                 f"serving {key} = {infer[key]} exceeds budget {budget}")
+    if decode["errors"]:
+        failures.append(f"decode storm errors: {decode['errors']}")
+    if decode["shed"]:
+        failures.append(
+            f"decode storm shed {decode['shed']} request(s) — the gate "
+            "pool is sized to absorb the whole storm")
+    for key, budget in DECODE_BUDGET.items():
+        if decode[key] > budget:
+            failures.append(
+                f"decode {key} = {decode[key]} exceeds budget {budget}")
     if not mesh["skipped"]:
         if not mesh["used_compiled"]:
             failures.append("mesh mode fell back to the eager tape")
@@ -460,6 +554,11 @@ def main() -> int:
           f"({infer['launches_per_batch']:.0f} launch/batch, "
           f"{infer['retraces_after_warm']} retraces, "
           f"{infer['programs']} programs <= {infer['buckets']} buckets)"
+          f"; decode within budget ({decode['programs']} programs == "
+          f"grid {decode['warmup_programs']}, "
+          f"{decode['retraces_after_warm']} retraces, "
+          f"{decode['extra_dispatches']} extra dispatches, "
+          f"{decode['leaked_pages']} leaked pages)"
           + ("" if mesh["skipped"] else
              f"; mesh within budget ({mesh['mesh_devices']}-device SPMD, "
              f"{mesh['compiled_launches_per_step']:.0f} launch/step, "

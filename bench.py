@@ -409,6 +409,11 @@ def lane_train(on_cpu: bool, bf16: bool,
         "batch": batch,
         "layout": layout,
         "stem_s2d": s2d,
+        # the round-9 MFU levers, stamped so A/B rounds read off the
+        # artifact: fused conv/BN/ReLU epilogues (MXNET_FUSED_EPILOGUE)
+        # and the MXU channel-alignment pass (MXNET_PAD_CHANNELS)
+        "fused_epilogue": bool(config.get("MXNET_FUSED_EPILOGUE")),
+        "pad_channels": int(config.get("MXNET_PAD_CHANNELS")),
         "compile_s": round(compile_s, 1),
         "platform": jax.default_backend(),
     }
@@ -560,6 +565,7 @@ def lane_int8(on_cpu: bool, model_name: str = "resnet50_v1") -> dict:
         "unit": "img/s",
         "vs_baseline": round(imgs_per_sec / base, 3) if base else 0.0,
         "batch": batch,
+        "pad_channels": int(config.get("MXNET_PAD_CHANNELS")),
         "compile_s": round(compile_s, 1),
         "platform": jax.default_backend(),
     }
@@ -600,51 +606,15 @@ def lane_int8(on_cpu: bool, model_name: str = "resnet50_v1") -> dict:
     except Exception as exc:                    # pragma: no cover
         _progress(f"int8: bf16 inference reference skipped: {exc!r}")
 
-    # In-lane Pallas-kernel A/B (round-5): same quantized graph, same
-    # batch, with MXNET_INT8_PALLAS=1 routing eligible convs through the
-    # explicit s8 MXU kernels.  Decides the faster int8 path ON THIS
-    # CHIP in this window and upgrades the headline with provenance —
-    # the symbol is JSON-round-tripped to bust the shared graph-jit
-    # cache so the flag actually retraces.  Runs LAST so a budget
-    # overrun cannot cost the already-recorded lax result.
-    if (not on_cpu and config.get("BENCH_INT8_AB", default=True)
-            and len(jax.devices()) == 1):
-        # single-device gate matches _try_pallas_int8's own routing
-        # condition — on a multi-device host the flag would retrace onto
-        # the identical lax path and the A/B would compare noise
-        prev = os.environ.get("MXNET_INT8_PALLAS")
-        try:
-            from mxnet_tpu.symbol.symbol import load_json as _sym_load_json
-
-            _progress("int8: pallas-kernel A/B (MXNET_INT8_PALLAS=1)")
-            os.environ["MXNET_INT8_PALLAS"] = "1"
-            config.refresh("MXNET_INT8_PALLAS")
-            q2 = quant.QuantizedNet(_sym_load_json(qnet.sym.tojson()),
-                                    qnet.params).stage()
-            ips2 = _time_net(lambda: _unwrap(q2(x)))
-            lane["int8_pallas_img_s"] = round(ips2, 2)
-            lane["pallas_vs_lax"] = round(ips2 / imgs_per_sec, 3)
-            _progress(f"int8: pallas {ips2:.2f} img/s "
-                      f"({ips2 / imgs_per_sec:.2f}x vs lax)")
-            if ips2 > imgs_per_sec:
-                lane["value"] = round(ips2, 2)
-                lane["int8_path"] = "pallas"
-                if base:
-                    lane["vs_baseline"] = round(ips2 / base, 3)
-                if lane.get("bf16_infer_ref"):
-                    lane["vs_bf16_infer"] = round(
-                        ips2 / lane["bf16_infer_ref"], 3)
-                lane = _with_mfu(lane, RESNET50_INFER_OPS_PER_IMG, "int8")
-            else:
-                lane["int8_path"] = "lax"
-        except Exception as exc:                # pragma: no cover
-            _progress(f"int8: pallas A/B skipped: {exc!r}")
-        finally:
-            if prev is None:
-                os.environ.pop("MXNET_INT8_PALLAS", None)
-            else:
-                os.environ["MXNET_INT8_PALLAS"] = prev
-            config.refresh("MXNET_INT8_PALLAS")
+    # The round-5 in-lane Pallas A/B is RETIRED (round 9): the route
+    # measured 0.345x of lax (BENCH_builder_r05 pallas_vs_lax) and the
+    # conv kernels were deleted — quantized convs are always lax.conv
+    # s8.  The kernel-level decision bench lives in
+    # benchmark/microbench_tpu.py section_int8_pallas (the rebuilt
+    # fused int8_matmul vs lax dot); production re-entry requires that
+    # bench to win on chip.
+    lane["int8_path"] = "lax"
+    lane["pallas_skipped"] = quant.pallas_skipped_count()
     return lane
 
 

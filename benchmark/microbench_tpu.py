@@ -1,14 +1,21 @@
 """Targeted TPU microbenchmarks behind docs/PERF.md's roofline analysis.
 
-    python benchmark/microbench_tpu.py [--which all|dot|conv|bn|int8]
+    python benchmark/microbench_tpu.py [--which all|dot|conv|bn|int8|
+                                               fused|epilogue]
 
 Measures, with the bench fencing discipline (warm + host read, fenced
 timed region):
-  - dot:   8192^3 matmul, bf16 vs s8xs8->s32 (does int8 hit the 2x MXU?)
-  - conv:  a resnet-core conv chain, bf16 NHWC vs int8 NHWC, with the
-           requantize epilogue on/off (where does the int8 lane lose?)
-  - bn:    conv chain with batch-stat BatchNorm vs without (what do the
-           stats reductions + normalize passes cost the train step?)
+  - dot:      8192^3 matmul, bf16 vs s8xs8->s32 (does int8 hit the 2x MXU?)
+  - conv:     a resnet-core conv chain, bf16 NHWC vs int8 NHWC, with the
+              requantize epilogue on/off (where does the int8 lane lose?)
+  - bn:       conv chain with batch-stat BatchNorm vs without (what do the
+              stats reductions + normalize passes cost the train step?)
+  - fused:    the round-5 matmul+BN-stats producer kernel vs XLA
+  - epilogue: the round-9 fused conv/BN/ReLU EPILOGUE pair (stats-only
+              pass + in-register scale-shift/residual/relu) vs XLA — the
+              MXNET_FUSED_EPILOGUE decision bench
+  - int8:     the rebuilt fused int8 matmul vs lax s8 dot (+ requantize
+              rows) — the MXNET_INT8_PALLAS re-entry bench
 
 Each result prints one line: name, ms/iter, TFLOP/s (or TOP/s), ratio
 to the section's baseline.  Keep runs short: the tunnel budget matters
@@ -182,79 +189,145 @@ def section_fused_stats():
           f"{tf/base:.2f}x vs XLA")
 
 
+def section_fused_epilogue():
+    # The round-9 decision bench for MXNET_FUSED_EPILOGUE: the
+    # bottleneck-final texture conv1x1 + train-BN + residual-add + relu
+    # as (a) plain XLA (conv write + stats read + normalize read/write —
+    # whatever XLA fuses of it) vs (b) the fused-epilogue pair
+    # (matmul_stats + matmul_epilogue: ONE HBM pass over the conv
+    # output at 2x matmul FLOPs).  If (b) wins on chip, the knob flips
+    # to default 1 and bench.py ResNet lanes stamp fused_epilogue=true.
+    from mxnet_tpu.ops.pallas_kernels import (fused_blocks, matmul_stats,
+                                              matmul_epilogue)
+
+    key = jax.random.PRNGKey(4)
+    # resnet stage-3 bottleneck-final: bs128, 14x14, 256 -> 1024
+    m, k, n = 128 * 14 * 14, 256, 1024
+    flops = 2 * m * k * n
+    x = jax.random.normal(key, (m, k), jnp.bfloat16)
+    w = jax.random.normal(key, (k, n), jnp.bfloat16) * 0.05
+    gamma = jnp.abs(jax.random.normal(key, (n,), jnp.float32)) + 0.5
+    beta = jax.random.normal(key, (n,), jnp.float32)
+    r = jax.random.normal(key, (m, n), jnp.bfloat16)
+    blocks = fused_blocks(m, k, n)
+    assert blocks is not None
+
+    def xla_ref(x, w, gamma, beta, r):
+        z = (x @ w).astype(jnp.float32)
+        mean = jnp.mean(z, axis=0)
+        var = jnp.maximum(jnp.mean(z * z, axis=0) - mean * mean, 0.0)
+        inv = jax.lax.rsqrt(var + 1e-5)
+        y = z * (inv * gamma) + (beta - mean * inv * gamma)
+        out = jnp.maximum(y + r.astype(jnp.float32), 0.0)
+        return out.astype(x.dtype)
+
+    f = jax.jit(lambda *a: xla_ref(*a).astype(jnp.float32).sum())
+    dt = timeit(f, x, w, gamma, beta, r, iters=10)
+    base = flops / dt / 1e12
+    print(f"c1x1+bn+add+relu XLA:    {dt*1e3:8.2f} ms  {base:6.1f} "
+          f"TFLOP/s  1.00x")
+
+    def fused(x, w, gamma, beta, r):
+        s, ss = matmul_stats(x, w, **blocks)
+        mean = s / m
+        var = jnp.maximum(ss / m - mean * mean, 0.0)
+        inv = jax.lax.rsqrt(var + 1e-5)
+        sc = inv * gamma
+        return matmul_epilogue(x, w, sc, beta - mean * sc, residual=r,
+                               relu=True, **blocks)
+
+    g = jax.jit(lambda *a: fused(*a).astype(jnp.float32).sum())
+    dt = timeit(g, x, w, gamma, beta, r, iters=10)
+    tf = flops / dt / 1e12        # model FLOPs; the fused path pays 2x
+    print(f"c1x1+bn+add+relu fused:  {dt*1e3:8.2f} ms  {tf:6.1f} "
+          f"TFLOP/s  {tf/base:.2f}x vs XLA (2x matmul FLOPs inside)")
+
+    # inference texture: scale/shift known ahead — epilogue pass only
+    sc = gamma * 0.3
+    bi = beta
+    fi = jax.jit(lambda x, w: jnp.maximum(
+        (x @ w).astype(jnp.float32) * sc + bi, 0.0)
+        .astype(jnp.float32).sum())
+    dt = timeit(fi, x, w, iters=10)
+    base_i = flops / dt / 1e12
+    gi = jax.jit(lambda x, w: matmul_epilogue(x, w, sc, bi, relu=True,
+                                              **blocks)
+                 .astype(jnp.float32).sum())
+    dt = timeit(gi, x, w, iters=10)
+    tf = flops / dt / 1e12
+    print(f"c1x1+scale+relu XLA:     {base_i:6.1f} TFLOP/s  1.00x | "
+          f"epilogue kernel: {tf:6.1f} TFLOP/s  {tf/base_i:.2f}x")
+
+
 def section_int8_pallas():
-    # The round-5 decision bench: eligible 1x1 s8 conv as (a) lax.conv
-    # s8->s32, (b) the explicit Pallas int8 MXU kernel, (c) bf16 matmul
-    # reference.  If (b) beats (a) AND (c) on chip, MXNET_INT8_PALLAS
-    # flips to default 1 (contrib/quantization.py _try_pallas_int8).
-    from mxnet_tpu.ops.pallas_kernels import int8_conv1x1, int8_blocks
+    # Round-9 re-measurement bench for the int8 verdict: the REBUILT
+    # fused int8 matmul ((m,n,k) grid, s32 VMEM accumulator,
+    # in-register requantize — ops/pallas_kernels.int8_matmul) vs lax
+    # s8 dot, with the bf16 reference row.  The round-5 conv-level
+    # kernels measured 0.345x of lax on chip (BENCH_builder_r05) and
+    # were DELETED; MXNET_INT8_PALLAS refuses until THIS bench beats
+    # lax on chip (contrib/quantization._INT8_PALLAS_VERDICT).
+    from mxnet_tpu.ops.pallas_kernels import int8_blocks, int8_matmul
 
     key = jax.random.PRNGKey(5)
-    n, h, w_, cin, cout = 32, 28, 28, 512, 128
-    flops = 2 * n * h * w_ * cin * cout
-    qx = jax.random.randint(key, (n, h, w_, cin), -127, 128, jnp.int8)
-    qw = jax.random.randint(key, (cout, 1, 1, cin), -127, 128, jnp.int8)
+    # the 1x1-conv-as-matmul texture: bs32 28x28, 512 -> 128
+    m, k, n = 32 * 28 * 28, 512, 128
+    flops = 2 * m * k * n
+    qx = jax.random.randint(key, (m, k), -127, 128, jnp.int8)
+    qw = jax.random.randint(key, (k, n), -127, 128, jnp.int8)
     scale = 3e-4
-    assert int8_blocks(n * h * w_, cin, cout) is not None
-
-    dn = jax.lax.conv_dimension_numbers(
-        qx.shape, (cout, 1, 1, cin), ("NHWC", "OHWI", "NHWC"))
+    blocks = int8_blocks(m, k, n)
+    assert blocks is not None
 
     def lax_s8(qx, qw):
-        out = jax.lax.conv_general_dilated(
-            qx, qw, (1, 1), [(0, 0), (0, 0)], dimension_numbers=dn,
-            preferred_element_type=jnp.int32)
-        return (out.astype(jnp.float32) * scale).sum()
+        acc = jax.lax.dot_general(qx, qw, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        return (acc.astype(jnp.float32) * scale).sum()
 
     f = jax.jit(lax_s8)
     dt = timeit(f, qx, qw, iters=10)
     base = flops / dt / 1e12
-    print(f"1x1 s8 lax.conv: {dt*1e3:8.2f} ms  {base:6.1f} TOP/s  1.00x")
+    print(f"mm s8 lax dot:    {dt*1e3:8.2f} ms  {base:6.1f} TOP/s  1.00x")
 
-    g = jax.jit(lambda qx, qw: int8_conv1x1(qx, qw, scale).sum())
+    g = jax.jit(lambda qx, qw: int8_matmul(qx, qw, scale, **blocks).sum())
     dt = timeit(g, qx, qw, iters=10)
     tf = flops / dt / 1e12
-    print(f"1x1 s8 pallas:   {dt*1e3:8.2f} ms  {tf:6.1f} TOP/s  "
+    print(f"mm s8 pallas:     {dt*1e3:8.2f} ms  {tf:6.1f} TOP/s  "
           f"{tf/base:.2f}x vs lax")
 
+    # fused requantize epilogue row (the production int8 graph texture)
+    def lax_rq(qx, qw):
+        acc = jax.lax.dot_general(qx, qw, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        out = jnp.maximum(acc.astype(jnp.float32) * scale, 0.0)
+        return jnp.clip(jnp.round(out * 31.0), -127, 127) \
+            .astype(jnp.int8).astype(jnp.int32).sum()
+
+    f2 = jax.jit(lax_rq)
+    dt = timeit(f2, qx, qw, iters=10)
+    base2 = flops / dt / 1e12
+    g2 = jax.jit(lambda qx, qw: int8_matmul(
+        qx, qw, scale, relu=True, out_scale=31.0, **blocks)
+        .astype(jnp.int32).sum())
+    dt = timeit(g2, qx, qw, iters=10)
+    tf = flops / dt / 1e12
+    print(f"mm s8+requant lax {base2:6.1f} TOP/s 1.00x | pallas "
+          f"{tf:6.1f} TOP/s {tf/base2:.2f}x")
+
     bx = (qx.astype(jnp.float32) * scale).astype(jnp.bfloat16)
-    bw = qw.reshape(cout, cin).T.astype(jnp.bfloat16)
-    h2 = jax.jit(lambda x, w: (x.reshape(-1, cin) @ w)
-                 .astype(jnp.float32).sum())
+    bw = qw.astype(jnp.bfloat16)
+    h2 = jax.jit(lambda x, w: (x @ w).astype(jnp.float32).sum())
     dt = timeit(h2, bx, bw, iters=10)
     tf = flops / dt / 1e12
-    print(f"1x1 bf16 matmul: {dt*1e3:8.2f} ms  {tf:6.1f} TFLOP/s  "
+    print(f"mm bf16 matmul:   {dt*1e3:8.2f} ms  {tf:6.1f} TFLOP/s  "
           f"{tf/base:.2f}x vs lax-s8")
-
-    # 3x3 row: the full-image-tile s8 kernel vs lax.conv s8
-    from mxnet_tpu.ops.pallas_kernels import int8_conv3x3
-
-    qw3 = jax.random.randint(key, (cout, 3, 3, cin), -127, 128, jnp.int8)
-    flops3 = 9 * flops
-    dn3 = jax.lax.conv_dimension_numbers(
-        qx.shape, (cout, 3, 3, cin), ("NHWC", "OHWI", "NHWC"))
-
-    def lax3(qx, qw3):
-        out = jax.lax.conv_general_dilated(
-            qx, qw3, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn3,
-            preferred_element_type=jnp.int32)
-        return (out.astype(jnp.float32) * scale).sum()
-
-    f3 = jax.jit(lax3)
-    dt = timeit(f3, qx, qw3, iters=10)
-    base3 = flops3 / dt / 1e12
-    print(f"3x3 s8 lax.conv: {dt*1e3:8.2f} ms  {base3:6.1f} TOP/s  1.00x")
-    g3 = jax.jit(lambda qx, qw3: int8_conv3x3(qx, qw3, scale).sum())
-    dt = timeit(g3, qx, qw3, iters=10)
-    tf = flops3 / dt / 1e12
-    print(f"3x3 s8 pallas:   {dt*1e3:8.2f} ms  {tf:6.1f} TOP/s  "
-          f"{tf/base3:.2f}x vs lax")
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--which", default="all",
-                    choices=["all", "dot", "conv", "bn", "int8", "fused"])
+                    choices=["all", "dot", "conv", "bn", "int8", "fused",
+                             "epilogue"])
     args = ap.parse_args()
     print(f"backend: {jax.default_backend()}  {jax.devices()}")
     if args.which in ("all", "dot", "int8"):
@@ -265,6 +338,8 @@ def main():
         section_bn()
     if args.which in ("all", "fused"):
         section_fused_stats()
+    if args.which in ("all", "epilogue"):
+        section_fused_epilogue()
     if args.which in ("all", "int8"):
         section_int8_pallas()
 

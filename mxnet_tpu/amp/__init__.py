@@ -40,7 +40,8 @@ class _AmpState:
 _STATE = _AmpState()
 
 
-_FUSED_CONV_BN = frozenset(("_fused_conv1x1_bn", "_fused_convkxk_bn"))
+_FUSED_CONV_BN = frozenset(("_fused_conv1x1_bn", "_fused_convkxk_bn",
+                            "_fused_conv1x1_bn_act"))
 
 
 def _policy(op_name, arrays):

@@ -36,6 +36,7 @@ LOW_PRECISION_FUNCS = [
     # unfused BatchNorm (FP32_FUNCS) — parameter values and running
     # stats must not round
     "_fused_conv1x1_bn", "_fused_convkxk_bn",
+    "_fused_conv1x1_bn_act",
     "Correlation", "khatri_rao",
 ]
 

@@ -30,18 +30,33 @@ __all__ = ["quantize", "dequantize", "requantize", "collect_calib_ranges",
 
 INT8_MIN, INT8_MAX = -127.0, 127.0       # symmetric, matches reference
 
-# the loud half of ROADMAP item 2's "fix or delete loudly" verdict on the
-# Pallas int8 path: chip bench (BENCH_builder_r05) measured int8_pallas
+# The final half of ROADMAP item 2's "fix or delete loudly" verdict on
+# the Pallas int8 conv path: chip bench (BENCH_builder_r05) measured it
 # at 0.345x of plain lax — and int8 itself LOSING to bf16 at matched
-# batch — so MXNET_INT8_PALLAS ships 0 and every conv that skips the
-# kernel because of it is counted here and logged once per process
+# batch — so round 9 DELETED the conv kernels (int8_conv1x1/int8_conv3x3
+# are gone from ops/pallas_kernels.py; the rebuilt int8_matmul stays as
+# the microbench A/B vehicle).  Every conv a Pallas route would have
+# claimed is still counted here and logged once per process, and setting
+# MXNET_INT8_PALLAS nonzero now REFUSES loudly instead of routing.
 _PALLAS_SKIPPED = 0
 _PALLAS_SKIP_LOGGED = False
 
+_INT8_PALLAS_VERDICT = (
+    "the Pallas int8 conv route was retired in round 9: it measured "
+    "0.345x of plain lax.conv s8 on chip and int8 lost to bf16 at "
+    "matched batch (BENCH_builder_r05.json lanes[].pallas_vs_lax; "
+    "docs/PERF.md 'MFU campaign round 2').  Quantized convs always use "
+    "lax.conv s8->s32 on the MXU.  The rebuilt fused int8 matmul "
+    "(ops/pallas_kernels.py int8_matmul: (m,n,k) grid, s32 VMEM "
+    "accumulator, in-register requantize) is re-measured by 'python "
+    "benchmark/microbench_tpu.py --which int8' (section_int8_pallas); "
+    "production re-entry requires that bench to beat lax on chip.")
+
 
 def pallas_skipped_count() -> int:
-    """Quantized convs that bypassed the Pallas int8 kernel because
-    ``MXNET_INT8_PALLAS=0`` (the measured-loser default)."""
+    """Quantized convs that a Pallas int8 route would have claimed
+    (the kernel was retired on the 0.345x measurement; see
+    ``_INT8_PALLAS_VERDICT``)."""
     return _PALLAS_SKIPPED
 
 
@@ -53,13 +68,9 @@ def _count_pallas_skip() -> None:
         from .. import log as _log
 
         _log.get_logger("mxnet_tpu.quantization").warning(
-            "MXNET_INT8_PALLAS=0 (default): quantized convs use plain "
-            "lax.conv s8 — the explicit Pallas int8 kernel measured "
-            "0.345x of lax and int8 lost to bf16 at matched batch on "
-            "chip (BENCH_builder_r05).  Re-measure with 'python "
-            "benchmark/microbench_tpu.py' (section_int8_pallas) and set "
-            "MXNET_INT8_PALLAS=1 only if it wins on your chip.  "
-            "[logged once; skips counted in "
+            "quantized convs use plain lax.conv s8 — "
+            + _INT8_PALLAS_VERDICT
+            + "  [logged once; convs counted in "
             "quantization.pallas_skipped_count()]")
 
 
@@ -142,43 +153,23 @@ def quantized_fully_connected(arrays, num_hidden=0, no_bias=False,
     return _quantized_epilogue(out, fused_relu, out_min, out_max)
 
 
-def _try_pallas_int8(qd, qw, kernel, stride, dilate, pad, num_group,
-                     layout, scale):
-    """Route eligible NHWC s8 convs through the explicit Pallas int8 MXU
-    kernels (ops/pallas_kernels.py::int8_conv1x1 / int8_conv3x3 — 1x1
-    any-stride, 3x3 stride-1/pad-1 full-image tiles) when
-    MXNET_INT8_PALLAS allows: 0 off (default until chip data), 1 on for
-    single-device TPU, 2 force incl. the CPU interpreter (tests).
-    Returns the fp32 conv output, or None to use the lax.conv path."""
+def _refuse_pallas_int8(kernel, stride, dilate, pad, num_group, layout):
+    """The retired-route gate: geometries a Pallas int8 conv would have
+    claimed (NHWC 1x1 any-stride / 3x3 stride-1/pad-1) count a skip and
+    log once; a nonzero MXNET_INT8_PALLAS refuses LOUDLY with the
+    measurement instead of silently routing nowhere."""
     from .. import config as _config
+    from ..base import MXNetError
 
     mode = _config.get("MXNET_INT8_PALLAS")
-    if not mode:
-        _count_pallas_skip()             # the default-off gate, loudly
-        return None
-    if mode != 2 and not (jax.default_backend() == "tpu"
-                          and len(jax.devices()) == 1):
-        return None
-    if (tuple(dilate) != (1, 1) or num_group != 1 or layout != "NHWC"):
-        return None
-    from ..ops.pallas_kernels import (conv3x3_fits, int8_blocks,
-                                      int8_conv1x1, int8_conv3x3)
-
-    if tuple(kernel) == (1, 1) and tuple(pad) == (0, 0):
-        sh, sw = stride
-        n, h, wd, cin = qd.shape
-        ho, wo = -(-h // sh), -(-wd // sw)
-        if int8_blocks(n * ho * wo, cin, qw.shape[0]) is None:
-            return None
-        return int8_conv1x1(qd.astype(jnp.int8), qw.astype(jnp.int8),
-                            scale, stride=(sh, sw))
-    if (tuple(kernel) == (3, 3) and tuple(stride) == (1, 1)
-            and tuple(pad) == (1, 1)):
-        if conv3x3_fits(qd.shape, qw.shape[0], itemsize=1) is None:
-            return None
-        return int8_conv3x3(qd.astype(jnp.int8), qw.astype(jnp.int8),
-                            scale)
-    return None
+    if mode:
+        raise MXNetError(
+            f"MXNET_INT8_PALLAS={mode} refused: " + _INT8_PALLAS_VERDICT)
+    if (tuple(dilate) == (1, 1) and num_group == 1 and layout == "NHWC"
+            and (tuple(kernel) == (1, 1) and tuple(pad) == (0, 0)
+                 or tuple(kernel) == (3, 3) and tuple(stride) == (1, 1)
+                 and tuple(pad) == (1, 1))):
+        _count_pallas_skip()
 
 
 @register("quantized_conv", num_inputs=-1, differentiable=False)
@@ -192,7 +183,8 @@ def quantized_conv(arrays, kernel=(1, 1), stride=(1, 1), dilate=(1, 1),
     bench uses quantizes without relayouts (weights stay in the layout the
     fp32 model trained in — O is axis 0 for both OIHW and OHWI, so the
     offline weight quantization is layout-independent)."""
-    from ..ops.nn import _conv_dimension_numbers, _tup
+    from ..ops.nn import (_conv_dimension_numbers, _tup,
+                          maybe_pad_conv_channels)
 
     qd, qw = arrays[0], arrays[1]
     nsp = len(kernel)
@@ -202,28 +194,32 @@ def quantized_conv(arrays, kernel=(1, 1), stride=(1, 1), dilate=(1, 1),
     dilate = _tup(dilate, nsp) if dilate else (1,) * nsp
     pad = _tup(pad, nsp) if pad else (0,) * nsp
 
-    pallas_out = _try_pallas_int8(
-        qd, qw, kernel, stride, dilate, pad, num_group, layout,
-        data_scale * w_scale)
-    if pallas_out is not None:
-        out = pallas_out
-        if not no_bias and len(arrays) > 2:
-            out = out + arrays[2].reshape(
-                [1] * (out.ndim - 1) + [arrays[2].shape[0]])
-        return _quantized_epilogue(out, fused_relu, out_min, out_max)
+    _refuse_pallas_int8(kernel, stride, dilate, pad, num_group, layout)
+    qd = qd.astype(jnp.int8)
+    qw = qw.astype(jnp.int8)
+    # MXU-alignment padding pass (ops/nn.py): int8 sublane quantum is 32,
+    # so misaligned channel axes pad with zero taps (exact in integer
+    # math) and Cout slices back below
+    c_axis = layout.index("C")
+    true_cout = None
+    padded = maybe_pad_conv_channels(qd, qw, layout, num_group)
+    if padded is not None:
+        qd, qw, true_cout = padded
     dn = jax.lax.conv_dimension_numbers(
         qd.shape, qw.shape, _conv_dimension_numbers(layout))
     out = jax.lax.conv_general_dilated(
-        qd.astype(jnp.int8), qw.astype(jnp.int8),
+        qd, qw,
         window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, feature_group_count=num_group,
         dimension_numbers=dn,
         preferred_element_type=jnp.int32)
+    if true_cout is not None and out.shape[c_axis] != true_cout:
+        out = jax.lax.slice_in_dim(out, 0, true_cout, axis=c_axis)
     out = out.astype(jnp.float32) * (data_scale * w_scale)
     if not no_bias and len(arrays) > 2:
         shape = [1] * out.ndim
-        shape[layout.index("C")] = arrays[2].shape[0]
+        shape[c_axis] = arrays[2].shape[0]
         out = out + arrays[2].reshape(shape)
     return _quantized_epilogue(out, fused_relu, out_min, out_max)
 

@@ -37,7 +37,40 @@ from ..parallel.sharding import ShardingPlan, constraint
 
 __all__ = ["TransformerLMConfig", "init_params", "forward", "loss_fn",
            "sharding_plan", "make_train_step", "init_opt_state",
-           "pp_pad_batch"]
+           "pp_pad_batch", "flash_fallback_count"]
+
+# The silent killer the PR-8 int8 gate-off taught us to count: flash
+# attention needs (seq, head_dim) divisible by 8 (TPU tiling), and the
+# auto path used to fall back to the O(S^2) einsum WITHOUT saying so —
+# a mis-sized config quietly trains at a fraction of the flash MFU.
+# Every fallback is counted here (once per trace of each misaligned
+# attention site) and logged once per process, mirroring
+# quantization.pallas_skipped_count.
+_FLASH_FALLBACK = 0
+_FLASH_FALLBACK_LOGGED = False
+
+
+def flash_fallback_count() -> int:
+    """Attention sites that wanted the Pallas flash kernel but fell back
+    to the einsum path on misaligned (seq, head_dim)."""
+    return _FLASH_FALLBACK
+
+
+def _count_flash_fallback(seq: int, head_dim: int) -> None:
+    global _FLASH_FALLBACK, _FLASH_FALLBACK_LOGGED
+    _FLASH_FALLBACK += 1
+    if not _FLASH_FALLBACK_LOGGED:
+        _FLASH_FALLBACK_LOGGED = True
+        from .. import log as _log
+
+        _log.get_logger("mxnet_tpu.models").warning(
+            "flash attention fell back to the O(S^2) einsum path: "
+            f"(seq={seq}, head_dim={head_dim}) is not divisible by 8 "
+            "(TPU tiling).  Pad/round the sequence length and head_dim "
+            "to multiples of 8 to regain the flash kernel (BERT lane: "
+            "45.6%% vs einsum's far lower MFU).  [logged once; "
+            "fallbacks counted in models.transformer_lm."
+            "flash_fallback_count()]")
 
 
 @dataclasses.dataclass
@@ -144,6 +177,10 @@ def _attention(x, p, pre, cfg: TransformerLMConfig, mesh: Optional[Mesh]):
             raise ValueError(
                 f"use_flash_attention=True requires seq ({S}) and head_dim "
                 f"({hd}) divisible by 8 (TPU tiling)")
+        if use_flash and not aligned:
+            # the auto path WOULD take flash but the geometry can't tile:
+            # loud one-time log + counter instead of a silent MFU cliff
+            _count_flash_fallback(S, hd)
         if use_flash and aligned:
             from ..ops.pallas_kernels import flash_attention
 

@@ -156,6 +156,69 @@ def _conv_dimension_numbers(layout: str):
     return (layout, w, layout)
 
 
+# --- MXU-alignment padding pass (round 9, ROADMAP item 2) -------------------
+#
+# Staged convolutions whose channel axes miss the TPU tile quanta (the
+# cin=3 stem, odd-channel heads) underfill the MXU contraction.  The pass
+# zero-pads Cin on BOTH operands (each padded tap contributes exactly
+# 0.0 — IEEE x + 0.0 == x, so the kept lanes are bit-exact) and pads
+# Cout with slice-back (output channels are independent dots, so the
+# kept channels are computed identically).  It runs ONLY at trace time
+# (Tracer-gated, like the conv+BN producer tag), so the pad/slice are
+# part of the compiled program keyed by the UNPADDED input shapes —
+# 0 added retraces and 0 added dispatches per step by construction; XLA
+# folds the pads into the surrounding layout work.  This generalizes the
+# stem_s2d idea (re-shaping conv0 onto the MXU) to every misaligned
+# conv.  Quanta: the sublane quantum of the operand dtype — 8 for
+# fp32/bf16, 32 for int8 (the int8 path applies it in
+# contrib/quantization.py quantized_conv).  Bit-exactness is asserted by
+# tools/check_fusion_budget.py and tests/test_fused_epilogue.py.
+
+_PAD_CHANNELS_COUNT = 0
+
+
+def pad_channels_count() -> int:
+    """Convolutions the MXU-alignment pass padded (trace-time count:
+    one per padded conv node per trace)."""
+    return _PAD_CHANNELS_COUNT
+
+
+def _pad_up(v: int, q: int) -> int:
+    return -(-v // q) * q
+
+
+def maybe_pad_conv_channels(data, weight, layout: str, num_group: int):
+    """Apply the MXU-alignment padding pass when eligible: returns
+    ``(padded_data, padded_weight, true_cout)`` or ``None`` (aligned
+    already, knob off, eager call, or grouped conv)."""
+    from .. import config as _config
+
+    mode = _config.get("MXNET_PAD_CHANNELS")
+    if not mode or num_group != 1:
+        return None
+    if mode != 2 and jax.default_backend() != "tpu":
+        return None
+    if not isinstance(data, jax.core.Tracer):
+        return None                      # staging-layer pass: eager
+    c_axis = layout.index("C")           # dispatch never pays the pads
+    cin = int(data.shape[c_axis])
+    cout = int(weight.shape[0])
+    q = 32 if jnp.dtype(data.dtype).itemsize == 1 else 8
+    cin_p, cout_p = _pad_up(cin, q), _pad_up(cout, q)
+    if cin_p == cin and cout_p == cout:
+        return None
+    w_in_axis = 1 if c_axis == 1 else weight.ndim - 1
+    dpad = [(0, 0)] * data.ndim
+    dpad[c_axis] = (0, cin_p - cin)
+    wpad = [(0, 0)] * weight.ndim
+    wpad[0] = (0, cout_p - cout)
+    wpad[w_in_axis] = (0, cin_p - cin)
+    global _PAD_CHANNELS_COUNT
+    _PAD_CHANNELS_COUNT += 1
+    return (jnp.pad(data, dpad) if cin_p != cin else data,
+            jnp.pad(weight, wpad), cout)
+
+
 def _tup(v, n):
     if v is None:
         return (0,) * n if n else ()
@@ -181,6 +244,11 @@ def convolution(arrays, kernel=None, stride=None, dilate=None, pad=None,
     stride = _tup(stride, nsp) if stride else (1,) * nsp
     dilate = _tup(dilate, nsp) if dilate else (1,) * nsp
     pad = _tup(pad, nsp)
+    c_axis = layout.index("C")
+    true_cout = None
+    padded = maybe_pad_conv_channels(data, weight, layout, num_group)
+    if padded is not None:
+        data, weight, true_cout = padded
     dn = jax.lax.conv_dimension_numbers(
         data.shape, weight.shape, _conv_dimension_numbers(layout)
     )
@@ -193,9 +261,10 @@ def convolution(arrays, kernel=None, stride=None, dilate=None, pad=None,
         dimension_numbers=dn,
         feature_group_count=num_group,
     )
+    if true_cout is not None and out.shape[c_axis] != true_cout:
+        out = jax.lax.slice_in_dim(out, 0, true_cout, axis=c_axis)
     if not no_bias:
         bias = arrays[2]
-        c_axis = layout.index("C")
         shape = [1] * out.ndim
         shape[c_axis] = bias.shape[0]
         out = out + bias.reshape(shape)
@@ -438,6 +507,49 @@ def fused_convkxk_bn(arrays, eps=1e-5, fix_gamma=False, has_bias=False,
         b = None
     z, mean, var = convkxk_bn_stats_train(x, w, tuple(pad))
     return _fused_bn_epilogue(z, mean, var, gamma, beta, b, eps, fix_gamma)
+
+
+@register("_fused_conv1x1_bn_act", num_inputs=-1, num_outputs=-1)
+def fused_conv1x1_bn_act(arrays, stride=(1, 1), eps=1e-5, fix_gamma=False,
+                         has_bias=False, has_residual=False, relu=True):
+    """The fused-EPILOGUE training op (round 9, ROADMAP item 2): 1x1
+    NHWC conv + train-mode BatchNorm + optional residual-add + optional
+    ReLU in ONE HBM pass over the conv output
+    (ops/pallas_kernels.py matmul_stats + matmul_epilogue behind
+    conv1x1_bn_act_train's custom_vjp).  Inputs
+    ``[x, w, (bias), (residual), gamma, beta]`` — conv operands lead,
+    BN affine trails (the AMP rule keeps the trailing pair fp32).
+    Strided 1x1 pre-slices the input (exact).  A conv bias shifts z and
+    the batch mean EQUALLY, so the normalized output is bias-invariant;
+    the bias folds only into the returned mean (running statistics —
+    hence inference — stay exact, same contract as _fused_conv1x1_bn).
+    The residual adds BEFORE the relu — the ResNet bottleneck order
+    ``relu(bn(conv(h)) + shortcut)``.  Returns
+    ``(out, batch_mean, batch_var)``.  No reference analog — TPU-first
+    fusion; the model-zoo BottleneckV1 routes here, see
+    gluon/model_zoo/vision/resnet.py (MXNET_FUSED_EPILOGUE)."""
+    from .pallas_kernels import conv1x1_bn_act_train
+
+    x, w = arrays[0], arrays[1]
+    idx = 2
+    b = None
+    if has_bias:
+        b = arrays[idx]
+        idx += 1
+    r = None
+    if has_residual:
+        r = arrays[idx]
+        idx += 1
+    gamma, beta = arrays[idx], arrays[idx + 1]
+    sh, sw = stride
+    if (sh, sw) != (1, 1):
+        x = x[:, ::sh, ::sw, :]
+    out, mean, var = conv1x1_bn_act_train(
+        x, w, gamma, beta, residual=r, eps=eps, relu=relu,
+        fix_gamma=fix_gamma)
+    if b is not None:
+        mean = mean + b.astype(jnp.float32)
+    return out, mean, var
 
 
 @register("LayerNorm")

@@ -31,7 +31,8 @@ __all__ = ["flash_attention", "matmul_bn_stats", "conv1x1_bn_stats",
            "conv1x1_bn_stats_train", "fused_blocks",
            "conv3x3_bn_stats", "conv3x3_bn_stats_train", "conv3x3_fits",
            "convkxk_bn_stats", "convkxk_bn_stats_train", "convkxk_fits",
-           "int8_matmul", "int8_conv1x1", "int8_conv3x3", "int8_blocks"]
+           "matmul_stats", "matmul_epilogue", "conv1x1_bn_act_train",
+           "int8_matmul", "int8_blocks"]
 
 _NEG_INF = -1e30
 
@@ -481,41 +482,295 @@ conv1x1_bn_stats_train.defvjp(_c1x1_fwd_vjp, _c1x1_bwd)
 
 
 # ---------------------------------------------------------------------------
-# int8 matmul with s32 accumulation (round-5: the quantized-conv MXU path).
+# Fused conv/BN/ReLU EPILOGUE family (round 9, ROADMAP item 2).
 #
-# XLA lowers lax.conv(s8, s8, preferred_element_type=s32) correctly but —
-# per the round-4 chip measurements (BENCH_builder_r04: int8 0.74x bf16)
-# — not onto the int8 MXU peak on this runtime.  This kernel is the
-# explicit route: s8 tiles, dot_general with s32 accumulation, fp32
-# dequant epilogue (and optional fused relu / s8 requantize) in VMEM.
-# Reference rationale: src/operator/quantization/quantized_conv.cc exists
-# to beat fp32 by >2x; same contract here against bf16.
-# Wired via contrib/quantization.py::_try_pallas_int8 (MXNET_INT8_PALLAS):
-# 1x1 any-stride here, 3x3/stride-1/pad-1 via int8_conv3x3 below; other
-# geometries stay on lax.conv.
+# The round-5 lesson (docs/PERF.md): a pallas_call is an opaque custom
+# call XLA cannot fuse INTO, so a kernel that leaves ANY of the epilogue
+# outside (scale/shift/relu/residual-add) breaks the surrounding fusion
+# and loses.  These kernels take the other branch of that fork: put the
+# ENTIRE consumer chain of the dominant ResNet 1x1 convs in-register —
+#
+#   matmul_stats     x @ w reduced DIRECTLY to per-column (sum, sumsq):
+#                    the conv output is never written to HBM at all
+#                    (the batch-norm statistics pass at 0 activation
+#                    bytes);
+#   matmul_epilogue  x @ w recomputed with bias -> BN scale-shift ->
+#                    residual-add -> ReLU applied in-register, writing
+#                    only the FINAL activation.
+#
+# Training conv+BN+ReLU(+residual) = stats pass + epilogue pass: ONE
+# HBM pass over the conv output (the final write) instead of three
+# (conv write, stats read, normalize read+write), at 2x matmul FLOPs —
+# the flash-attention recompute trade applied to the conv path.  The
+# backward (conv1x1_bn_act_train's custom_vjp) recomputes z with one
+# dense MXU matmul, exactly like flash recomputes attention scores.
+# No reference analog; wired via ops/nn.py _fused_conv1x1_bn_act into
+# the model-zoo BottleneckV1 behind MXNET_FUSED_EPILOGUE.
 # ---------------------------------------------------------------------------
 
 
-def _int8_mm_kernel(x_ref, w_ref, o_ref, *, k_tiles, block_k, scale, relu,
-                    out_scale):
+def _mm_statsonly_kernel(x_ref, w_ref, s_ref, ss_ref, *, k_tiles, block_k):
+    # m innermost (same revisit pattern as _mm_stats_kernel): the (1, bn)
+    # stats tiles accumulate race-free across sequential m steps
+    mi = pl.program_id(1)
+
     def body(ki, acc):
-        xk = x_ref[:, pl.ds(ki * block_k, block_k)]
-        wk = w_ref[pl.ds(ki * block_k, block_k), :]
-        return acc + jax.lax.dot_general(
-            xk, wk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
+        xk = x_ref[:, pl.ds(ki * block_k, block_k)].astype(jnp.float32)
+        wk = w_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        return acc + xk @ wk
 
     acc = jax.lax.fori_loop(
         0, k_tiles, body,
-        jnp.zeros((x_ref.shape[0], w_ref.shape[1]), jnp.int32))
-    out = acc.astype(jnp.float32) * scale
+        jnp.zeros((x_ref.shape[0], w_ref.shape[1]), jnp.float32))
+    part = jnp.sum(acc, axis=0, keepdims=True)
+    part_sq = jnp.sum(acc * acc, axis=0, keepdims=True)
+
+    @pl.when(mi == 0)
+    def _init():
+        s_ref[...] = part
+        ss_ref[...] = part_sq
+
+    @pl.when(mi != 0)
+    def _accum():
+        s_ref[...] += part
+        ss_ref[...] += part_sq
+
+
+def matmul_stats(x, w, block_m=256, block_n=256, block_k=512):
+    """Per-column ``(sum(x@w), sum((x@w)**2))`` in fp32 WITHOUT writing
+    the product: x (M, K), w (K, N) -> (s (N,), ss (N,)).  The
+    activation-free half of the fused-epilogue pair."""
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2, (x.shape, w.shape)
+    block_m = min(block_m, m)
+    block_n = min(block_n, n)
+    block_k = min(block_k, k)
+    assert m % block_m == 0 and n % block_n == 0 and k % block_k == 0, (
+        (m, k, n), (block_m, block_k, block_n))
+    grid = (n // block_n, m // block_m)        # m innermost (see kernel)
+    kernel = functools.partial(_mm_statsonly_kernel,
+                               k_tiles=k // block_k, block_k=block_k)
+    s, ss = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda ni, mi: (mi, 0)),
+            pl.BlockSpec((k, block_n), lambda ni, mi: (0, ni)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_n), lambda ni, mi: (0, ni)),
+            pl.BlockSpec((1, block_n), lambda ni, mi: (0, ni)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, w)
+    return s[0], ss[0]
+
+
+def _mm_epilogue_kernel(x_ref, w_ref, sc_ref, bi_ref, r_ref, o_ref, *,
+                        k_tiles, block_k, relu, has_res):
+    def body(ki, acc):
+        xk = x_ref[:, pl.ds(ki * block_k, block_k)].astype(jnp.float32)
+        wk = w_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        return acc + xk @ wk
+
+    acc = jax.lax.fori_loop(
+        0, k_tiles, body,
+        jnp.zeros((x_ref.shape[0], w_ref.shape[1]), jnp.float32))
+    out = acc * sc_ref[...] + bi_ref[...]       # BN scale-shift, (1, bn)
+    if has_res:
+        out = out + r_ref[...].astype(jnp.float32)
     if relu:
         out = jnp.maximum(out, 0.0)
-    if out_scale is not None:
-        q = jnp.clip(jnp.round(out * out_scale), -127, 127)
-        o_ref[...] = q.astype(jnp.int8)
-    else:
-        o_ref[...] = out.astype(o_ref.dtype)
+    o_ref[...] = out.astype(o_ref.dtype)
+
+
+def matmul_epilogue(x, w, scale, shift, residual=None, relu=False,
+                    block_m=256, block_n=256, block_k=512):
+    """``act((x @ w) * scale + shift [+ residual])`` in ONE kernel pass:
+    x (M, K), w (K, N), scale/shift per-column fp32 (N,), residual
+    (M, N) in the output dtype.  The residual adds BEFORE the relu —
+    the ResNet block order ``relu(bn(conv(h)) + shortcut)``.  A conv
+    bias folds into ``shift`` host-side (it is per-column affine)."""
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2, (x.shape, w.shape)
+    block_m = min(block_m, m)
+    block_n = min(block_n, n)
+    block_k = min(block_k, k)
+    assert m % block_m == 0 and n % block_n == 0 and k % block_k == 0, (
+        (m, k, n), (block_m, block_k, block_n))
+    has_res = residual is not None
+    r = residual if has_res else jnp.zeros((1, 1), x.dtype)
+    r_spec = (pl.BlockSpec((block_m, block_n), lambda ni, mi: (mi, ni))
+              if has_res else pl.BlockSpec((1, 1), lambda ni, mi: (0, 0)))
+    kernel = functools.partial(_mm_epilogue_kernel, k_tiles=k // block_k,
+                               block_k=block_k, relu=relu, has_res=has_res)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // block_n, m // block_m),
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda ni, mi: (mi, 0)),
+            pl.BlockSpec((k, block_n), lambda ni, mi: (0, ni)),
+            pl.BlockSpec((1, block_n), lambda ni, mi: (0, ni)),
+            pl.BlockSpec((1, block_n), lambda ni, mi: (0, ni)),
+            r_spec,
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda ni, mi: (mi, ni)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        interpret=_interpret(),
+    )(x, w, scale.astype(jnp.float32).reshape(1, n),
+      shift.astype(jnp.float32).reshape(1, n), r)
+
+
+@functools.lru_cache(maxsize=None)
+def _c1x1_act_train_for(relu, has_res, eps, fix_gamma):
+    """One custom_vjp core per static (relu, has_residual, eps,
+    fix_gamma) — jax.custom_vjp cannot take non-array args positionally."""
+
+    def _fwd_impl(x, w, gamma, beta, *rs):
+        n, h, wd, cin = x.shape
+        cout = w.shape[0]
+        m = n * h * wd
+        x2 = x.reshape(m, cin)
+        w2 = w.reshape(cout, cin).T
+        blocks = fused_blocks(m, cin, cout)
+        s, ss = matmul_stats(x2, w2, **blocks)
+        cnt = jnp.float32(m)
+        mean = s / cnt
+        var = jnp.maximum(ss / cnt - mean * mean, 0.0)
+        inv = jax.lax.rsqrt(var + jnp.float32(eps))
+        g = jnp.ones_like(inv) if fix_gamma else gamma.astype(jnp.float32)
+        sc = inv * g
+        bi = beta.astype(jnp.float32) - mean * sc
+        r2 = rs[0].reshape(m, cout) if has_res else None
+        out = matmul_epilogue(x2, w2, sc, bi, residual=r2, relu=relu,
+                              **blocks)
+        return out.reshape(n, h, wd, cout), mean, var
+
+    @jax.custom_vjp
+    def f(x, w, gamma, beta, *rs):
+        return _fwd_impl(x, w, gamma, beta, *rs)
+
+    def fwd(x, w, gamma, beta, *rs):
+        out, mean, var = _fwd_impl(x, w, gamma, beta, *rs)
+        return (out, mean, var), (x, w, gamma, beta,
+                                  rs[0] if has_res else None, mean, var)
+
+    def bwd(res, cts):
+        x, w, gamma, beta, r, mean, var = res
+        gout, gmean, gvar = cts
+        n, h, wd, cin = x.shape
+        cout = w.shape[0]
+        m = n * h * wd
+        x2 = x.reshape(m, cin)
+        w2 = w.reshape(cout, cin)
+        # recompute z on the MXU (the flash-style trade: z never hit HBM
+        # in forward; one dense matmul rebuilds it here)
+        z = jax.lax.dot(x2, w2.T, preferred_element_type=jnp.float32)
+        z = z.astype(jnp.float32)
+        f32 = jnp.float32
+        inv = jax.lax.rsqrt(var + f32(eps))
+        g = jnp.ones_like(inv) if fix_gamma else gamma.astype(f32)
+        sc = inv * g
+        xhat = (z - mean[None, :]) * inv[None, :]
+        y = sc[None, :] * z + (beta.astype(f32) - mean * sc)[None, :]
+        ga = gout.reshape(m, cout).astype(f32)
+        if has_res:
+            a = y + r.reshape(m, cout).astype(f32)
+        else:
+            a = y
+        if relu:
+            ga = jnp.where(a > 0, ga, 0.0)
+        # d residual: the add sits under the relu, so it shares ga
+        dr = (ga.astype(r.dtype).reshape(r.shape) if has_res else None)
+        dbeta_f = jnp.sum(ga, axis=0)
+        dgamma_f = jnp.sum(ga * xhat, axis=0)
+        # BN backward into z (mean/var chains folded), per column:
+        #   dz = sc * (ga - mean_M(ga) - xhat * mean_M(ga * xhat))
+        dz = sc[None, :] * (ga - dbeta_f[None, :] / m
+                            - xhat * dgamma_f[None, :] / m)
+        # plus the DIRECT cotangents on the returned stats outputs
+        #   d mean_j / d z_ij = 1/M,  d var_j / d z_ij = 2 (z_ij - mu_j)/M
+        dz = (dz + gmean[None, :].astype(f32) / m
+              + gvar[None, :].astype(f32) * 2.0 * (z - mean[None, :]) / m)
+        dz = dz.astype(x.dtype)                  # MXU-friendly operands
+        dx = jax.lax.dot(dz, w2.astype(dz.dtype),
+                         preferred_element_type=jnp.float32)
+        dw = jax.lax.dot(dz.T, x2, preferred_element_type=jnp.float32)
+        dgamma = (jnp.zeros_like(gamma) if fix_gamma
+                  else dgamma_f.astype(gamma.dtype))
+        dbeta = dbeta_f.astype(beta.dtype)
+        outs = (dx.reshape(x.shape).astype(x.dtype),
+                dw.reshape(w.shape).astype(w.dtype), dgamma, dbeta)
+        return outs + ((dr,) if has_res else ())
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def conv1x1_bn_act_train(x, w, gamma, beta, residual=None, eps=1e-5,
+                         relu=True, fix_gamma=False):
+    """Differentiable fused 1x1-conv + train-mode BN + residual-add +
+    ReLU: x (N,H,W,Cin) NHWC, w (Cout,1,1,Cin) OHWI, ``residual``
+    (N,H,W,Cout) added before the relu -> ``(out, mean, var)``, stats
+    fp32.  The conv output never materializes in HBM (stats pass +
+    in-register epilogue pass); the backward recomputes it with one
+    dense matmul.  Caller pre-checks :func:`fused_blocks`."""
+    core = _c1x1_act_train_for(bool(relu), residual is not None,
+                               float(eps), bool(fix_gamma))
+    if residual is not None:
+        return core(x, w, gamma, beta, residual)
+    return core(x, w, gamma, beta)
+
+
+# ---------------------------------------------------------------------------
+# int8 matmul with s32 accumulation — the MEASUREMENT kernel (round 9).
+#
+# History: round 5 shipped whole-K-row int8 kernels (x block (bm, K)
+# resident, fori over K slices) plus conv1x1/conv3x3 wrappers wired into
+# contrib/quantization.py behind MXNET_INT8_PALLAS.  The chip bench
+# measured that route at 0.345x of plain lax.conv s8 (BENCH_builder_r05
+# pallas_vs_lax) with int8 itself losing to bf16 at matched batch — so
+# round 9 DELETED the conv wrappers and the production routing (the knob
+# now refuses, contrib/quantization.py), and rebuilt the matmul itself in
+# the canonical Pallas shape so the microbench keeps an honest A/B
+# vehicle: full (m, n, k) grid with k innermost, an s32 VMEM scratch
+# accumulator revisited across k steps (VMEM footprint bm*bk + bk*bn +
+# bm*bn instead of bm*K whole rows — the round-5 kernel's K-resident rows
+# are what starved double-buffering), and the fp32 dequant / relu / s8
+# requantize epilogue applied IN REGISTER on the last k step only.
+# benchmark/microbench_tpu.py section_int8_pallas re-measures it against
+# lax; production re-entry requires that bench to win on chip.
+# ---------------------------------------------------------------------------
+
+
+def _int8_mm_kernel(x_ref, w_ref, o_ref, acc_ref, *, k_tiles, scale, relu,
+                    out_scale):
+    ki = pl.program_id(2)                     # k innermost: the same
+                                              # (m, n) tile is revisited
+    @pl.when(ki == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(ki == k_tiles - 1)
+    def _epilogue():
+        out = acc_ref[...].astype(jnp.float32) * scale
+        if relu:
+            out = jnp.maximum(out, 0.0)
+        if out_scale is not None:
+            q = jnp.clip(jnp.round(out * out_scale), -127, 127)
+            o_ref[...] = q.astype(jnp.int8)
+        else:
+            o_ref[...] = out.astype(o_ref.dtype)
 
 
 def int8_blocks(m, k, n):
@@ -542,9 +797,10 @@ def int8_blocks(m, k, n):
 def int8_matmul(x, w, scale, relu=False, out_scale=None,
                 block_m=256, block_n=256, block_k=512):
     """``dequant(x_s8 @ w_s8)``: x (M, K) s8, w (K, N) s8 -> fp32 (M, N)
-    scaled by ``scale`` (= data_scale * w_scale), with optional fused relu
-    and s8 requantize (``out_scale``: fp32 -> s8 multiplier).  s32
-    accumulation on the MXU int8 path."""
+    scaled by ``scale`` (= data_scale * w_scale), with the optional relu
+    and s8 requantize (``out_scale``: fp32 -> s8 multiplier) fused
+    in-register on the final k step.  s32 accumulation in a VMEM scratch
+    tile on the MXU int8 path; (m, n, k) grid, k innermost."""
     m, k = x.shape
     k2, n = w.shape
     assert k == k2, (x.shape, w.shape)
@@ -553,40 +809,24 @@ def int8_matmul(x, w, scale, relu=False, out_scale=None,
     block_k = min(block_k, k)
     assert m % block_m == 0 and n % block_n == 0 and k % block_k == 0, (
         (m, k, n), (block_m, block_k, block_n))
-    grid = (n // block_n, m // block_m)
+    k_tiles = k // block_k
     kernel = functools.partial(
-        _int8_mm_kernel, k_tiles=k // block_k, block_k=block_k,
-        scale=float(scale), relu=relu,
+        _int8_mm_kernel, k_tiles=k_tiles, scale=float(scale), relu=relu,
         out_scale=None if out_scale is None else float(out_scale))
     out_dtype = jnp.int8 if out_scale is not None else jnp.float32
     return pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(m // block_m, n // block_n, k_tiles),
         in_specs=[
-            pl.BlockSpec((block_m, k), lambda ni, mi: (mi, 0)),
-            pl.BlockSpec((k, block_n), lambda ni, mi: (0, ni)),
+            pl.BlockSpec((block_m, block_k), lambda mi, ni, ki: (mi, ki)),
+            pl.BlockSpec((block_k, block_n), lambda mi, ni, ki: (ki, ni)),
         ],
-        out_specs=pl.BlockSpec((block_m, block_n), lambda ni, mi: (mi, ni)),
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda mi, ni, ki: (mi, ni)),
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.int32)],
         interpret=_interpret(),
     )(x, w)
-
-
-def int8_conv1x1(qx, qw, scale, stride=(1, 1), relu=False, out_scale=None):
-    """1x1 NHWC s8 conv via the int8 matmul kernel: qx (N,H,W,Cin) s8,
-    qw (Cout,1,1,Cin) s8 OHWI.  Strided via exact pre-slice.  Returns
-    fp32 (or s8 with ``out_scale``) in NHWC."""
-    sh, sw = stride
-    if (sh, sw) != (1, 1):
-        qx = qx[:, ::sh, ::sw, :]
-    n, h, wd, cin = qx.shape
-    cout = qw.shape[0]
-    x2 = qx.reshape(n * h * wd, cin)
-    w2 = qw.reshape(cout, cin).T
-    blocks = int8_blocks(n * h * wd, cin, cout)
-    out = int8_matmul(x2, w2, scale, relu=relu, out_scale=out_scale,
-                      **blocks)
-    return out.reshape(n, h, wd, cout)
 
 
 # ---------------------------------------------------------------------------
@@ -826,47 +1066,9 @@ def _ref_conv3x3(x, w):
     return _ref_convkxk(x, w, (1, 1))
 
 
-# ---------------------------------------------------------------------------
-# int8 3x3 conv (stride-1/pad-1 NHWC): the quantized counterpart of the
-# full-image-tile 3x3 kernel above — 9 shifted s8 matmuls with s32
-# accumulation, fp32 dequant epilogue.  Together with int8_conv1x1 this
-# covers every ResNet-50 conv except the stem.
-# ---------------------------------------------------------------------------
-
-
-def _c3x3_int8_kernel(x_ref, w_ref, o_ref, xp_ref, *, hh, ww, scale, relu):
-    x = x_ref[0]                                     # (H, W, Cin) s8
-    xp_ref[...] = jnp.pad(x, ((1, 1), (1, 1), (0, 0)))
-    bn = w_ref.shape[-1]
-    acc = _tap_accumulate(xp_ref, w_ref, 3, 3, hh, ww, jnp.int32)
-    out = acc.astype(jnp.float32) * scale
-    if relu:
-        out = jnp.maximum(out, 0.0)
-    o_ref[0] = out.reshape(hh, ww, bn)
-
-
-def int8_conv3x3(qx, qw, scale, relu=False, block_n=128):
-    """3x3/stride-1/pad-1 NHWC s8 conv: qx (N,H,W,Cin) s8,
-    qw (Cout,3,3,Cin) s8 OHWI -> fp32 (N,H,W,Cout) scaled by ``scale``.
-    Caller pre-checks :func:`conv3x3_fits` (itemsize=1)."""
-    n, h, wd, cin = qx.shape
-    cout = qw.shape[0]
-    fit = conv3x3_fits(qx.shape, cout, block_n, itemsize=1)
-    assert fit is not None, (qx.shape, cout)
-    bn = fit["block_n"]
-    grid = (cout // bn, n)
-    kernel = functools.partial(_c3x3_int8_kernel, hh=h, ww=wd,
-                               scale=float(scale), relu=relu)
-    wr = jnp.transpose(qw, (1, 2, 3, 0)).reshape(9, cin, cout)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, h, wd, cin), lambda ci, b: (b, 0, 0, 0)),
-            pl.BlockSpec((9, cin, bn), lambda ci, b: (0, 0, ci)),
-        ],
-        out_specs=pl.BlockSpec((1, h, wd, bn), lambda ci, b: (b, 0, 0, ci)),
-        out_shape=jax.ShapeDtypeStruct((n, h, wd, cout), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((h + 2, wd + 2, cin), jnp.int8)],
-        interpret=_interpret(),
-    )(qx, wr)
+# The round-5 int8 conv wrappers (int8_conv1x1 / int8_conv3x3 and the
+# _c3x3_int8_kernel full-image-tile body) were DELETED in round 9: the
+# chip bench measured the route at 0.345x of plain lax.conv s8
+# (BENCH_builder_r05 pallas_vs_lax) and contrib/quantization.py now
+# refuses MXNET_INT8_PALLAS with a pointer to that measurement.  The
+# rebuilt int8_matmul above stays as the microbench's A/B vehicle.

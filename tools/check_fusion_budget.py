@@ -1,0 +1,383 @@
+#!/usr/bin/env python
+"""CI gate: the conv/BN/ReLU fusion story must hold on the CPU backend.
+
+The MFU campaign's chip claims (docs/PERF.md "MFU campaign round 2")
+need a proxy the suite can verify without a TPU.  Four lanes, each a
+CPU-checkable invariant of the round-9 work; FAIL (exit 1) on any
+regression:
+
+- ``fusion``: a hybridized train-mode conv+BN+ReLU chain compiles into
+  at most ``FUSION_BUDGET`` XLA fusions (guards the unfused baseline
+  against de-fusion regressions), and under ``MXNET_FUSED_EPILOGUE=2``
+  the model-zoo BottleneckV1 (a) really routes its three 1x1 sites
+  through ``_fused_conv1x1_bn_act``, (b) carries the Pallas kernel in
+  its traced program (the ``pallas_call`` jaxpr marker — the
+  CPU-verifiable analog of the TPU custom-call), (c) compiles to FEWER
+  fusions than the unfused baseline (the whole epilogue chain collapsed
+  into the kernel), and (d) matches the unfused output numerically.
+
+- ``pad``: the MXU-alignment padding pass (``MXNET_PAD_CHANNELS=2``) on
+  a misaligned-channel model keeps the compiled train step at exactly
+  1 dispatch and 0 retraces per steady-state step (the pad/slice live
+  INSIDE the program, keyed by unpadded shapes) and the loss trajectory
+  is BIT-EXACT vs the pass disabled — padded taps contribute 0.0 and
+  sliced-off channels are independent dots.
+
+- ``int8``: the retired Pallas int8 conv route refuses loudly —
+  ``MXNET_INT8_PALLAS=1`` raises pointing at the 0.345x measurement
+  (BENCH_builder_r05) — and the default path still counts every conv a
+  Pallas route would have claimed (``pallas_skipped_count``).
+
+Invoked by the test suite (tests/test_fused_epilogue.py) exactly like
+tools/check_dispatch_budget.py, and runnable standalone:
+``JAX_PLATFORMS=cpu python tools/check_fusion_budget.py``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the unfused conv+BN+ReLU budget: the chain measured 6 fusions on this
+# jax/XLA CPU build; 8 leaves slack for compiler drift without letting a
+# de-fusion regression (separate stats passes, unfused normalize) hide
+FUSION_BUDGET = 8
+# BottleneckV1 1x1 sites the fused path must claim: conv1, downsample,
+# conv3 (the 3x3 stays on XLA by design)
+FUSED_SITES = 3
+PAD_STEPS = 4
+
+
+def _set(name: str, value):
+    from mxnet_tpu import config
+
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = str(value)
+    config.refresh(name)
+
+
+def _lower_cached(net, x):
+    """Lower the hybridized block's cached program and return
+    (jaxpr_text, optimized_hlo_text, cost_analysis_dict)."""
+    import jax
+
+    from mxnet_tpu import random as _random
+
+    rec = list(net._cached.values())[-1]
+    jitted, names, params, _ctx_idx, _out_struct, _mut = rec
+    parr = [params[n]._data[0]._data for n in names]
+    key = _random.next_key()
+    jaxpr = str(jax.make_jaxpr(lambda p, i, k: jitted(p, i, k))(
+        parr, [x._data], key))
+    lo = jitted.lower(parr, [x._data], key)
+    comp = lo.compile()
+    ca = comp.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    return jaxpr, comp.as_text(), (ca or {})
+
+
+def _count_fusions(hlo_text: str) -> int:
+    return hlo_text.count(" fusion(")
+
+
+def _measure_chain() -> dict:
+    """The simple conv+BN+ReLU chain, unfused default: fusion budget."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    _set("MXNET_FUSED_EPILOGUE", None)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(32, kernel_size=1, use_bias=True, layout="NHWC"))
+    net.add(nn.BatchNorm(axis=3))
+    net.add(nn.Activation("relu"))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.RandomState(0)
+                    .randn(2, 8, 8, 16).astype(onp.float32))
+    net(x)
+    net.hybridize()
+    with autograd.record():
+        net(x)
+    _sh, hlo, ca = _lower_cached(net, x)
+    return {"mode": "chain", "fusions": _count_fusions(hlo),
+            "bytes": ca.get("bytes accessed"), "flops": ca.get("flops")}
+
+
+def _build_bottleneck(x, seed=0):
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import BottleneckV1
+
+    b = BottleneckV1(64, stride=1, downsample=True, in_channels=32,
+                     layout="NHWC")
+    b.initialize(mx.init.Xavier())
+    b(x)
+    rng = onp.random.RandomState(seed)
+    for _name, p in sorted(b.collect_params().items()):
+        if "running" not in _name:
+            p._data[0]._set_data(
+                mx.nd.array(rng.randn(*p.shape).astype("float32")
+                            * 0.1)._data)
+    return b
+
+
+def _measure_fused() -> dict:
+    """BottleneckV1 fused-epilogue vs unfused: sites claimed, pallas
+    marker, fusion-count drop, bytes-accessed columns, output parity."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ops.registry import get_op
+
+    x = mx.nd.array(onp.random.RandomState(3)
+                    .randn(2, 8, 8, 32).astype(onp.float32))
+    rows = {}
+    schema = get_op("_fused_conv1x1_bn_act")
+    calls = {"n": 0}
+    orig = schema.fn
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    schema.fn = counting
+    try:
+        for mode in (None, 2):
+            _set("MXNET_FUSED_EPILOGUE", mode)
+            net = _build_bottleneck(x)
+            net.hybridize()
+            calls["n"] = 0
+            with autograd.record():
+                out = net(x)
+            jaxpr, hlo, ca = _lower_cached(net, x)
+            rows["fused" if mode else "unfused"] = {
+                "sites": calls["n"],
+                "pallas_marker": ("pallas_call" in jaxpr
+                                  or "tpu_custom_call" in hlo),
+                "fusions": _count_fusions(hlo),
+                "bytes": ca.get("bytes accessed"),
+                "flops": ca.get("flops"),
+                "out": out.asnumpy(),
+            }
+    finally:
+        schema.fn = orig
+        _set("MXNET_FUSED_EPILOGUE", None)
+    f, u = rows["fused"], rows["unfused"]
+    return {
+        "mode": "fused-epilogue",
+        "fused_sites": f["sites"],
+        "unfused_sites": u["sites"],
+        "pallas_marker": f["pallas_marker"],
+        "fused_fusions": f["fusions"],
+        "unfused_fusions": u["fusions"],
+        "fused_bytes": f["bytes"],
+        "unfused_bytes": u["bytes"],
+        "max_out_diff": float(onp.abs(f["out"] - u["out"]).max()),
+        "out_close": bool(onp.allclose(f["out"], u["out"],
+                                       rtol=2e-4, atol=2e-4)),
+    }
+
+
+def _pad_run(mode) -> dict:
+    """One fresh misaligned-channel model trained PAD_STEPS steps through
+    the compiled TrainStep; returns per-step losses + counters."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import cached_step, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.ops import nn as ops_nn
+
+    _set("MXNET_PAD_CHANNELS", mode)
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            # cin=3 and cout=10 both miss the 8-lane quantum
+            self.conv = nn.Conv2D(10, kernel_size=3, padding=1,
+                                  use_bias=True, layout="NHWC",
+                                  in_channels=3)
+            self.bn = nn.BatchNorm(axis=3)
+            self.pool = nn.GlobalAvgPool2D(layout="NHWC")
+            self.out = nn.Dense(4, in_units=10)
+
+        def forward(self, x):
+            h = self.bn(self.conv(x)).relu()
+            return self.out(self.pool(h).reshape((x.shape[0], -1)))
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(7)
+    data = mx.nd.array(rng.randn(4, 8, 8, 3).astype(onp.float32))
+    label = mx.nd.array(rng.randn(4, 4).astype(onp.float32))
+    net(data)                        # complete deferred init eagerly
+    for _name, p in sorted(net.collect_params().items()):
+        if "running" not in _name:
+            p._data[0]._set_data(
+                mx.nd.array(rng.randn(*p.shape).astype("float32")
+                            * 0.1)._data)
+        else:                        # the probe forward moved them
+            p._data[0]._set_data(
+                mx.nd.zeros(p.shape)._data if "mean" in _name
+                else mx.nd.ones(p.shape)._data)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = lambda n, x, y: ((n(x) - y) ** 2).mean()
+    step = trainer.compile_step(net, loss_fn)
+    p0 = ops_nn.pad_channels_count()
+    losses = [float(step(data, label, batch_size=4).asnumpy())]  # warm
+    t0, d0 = cached_step.trace_count(), cached_step.dispatch_count()
+    for _ in range(PAD_STEPS):
+        losses.append(float(step(data, label, batch_size=4).asnumpy()))
+    out = {
+        "losses": losses,
+        "compiled": step.last_step_compiled,
+        "retraces_after_warm": cached_step.trace_count() - t0,
+        "dispatches_per_step":
+            (cached_step.dispatch_count() - d0) / PAD_STEPS,
+        "pads": ops_nn.pad_channels_count() - p0,
+    }
+    _set("MXNET_PAD_CHANNELS", None)
+    return out
+
+
+def _measure_pad() -> dict:
+    on = _pad_run(2)
+    off = _pad_run(0)
+    return {
+        "mode": "pad-channels",
+        "compiled": on["compiled"] and off["compiled"],
+        "retraces_after_warm": on["retraces_after_warm"],
+        "dispatches_per_step": on["dispatches_per_step"],
+        "padded_convs": on["pads"],
+        "unpadded_pass_pads": off["pads"],
+        "bit_exact": on["losses"] == off["losses"],
+        "losses_on": on["losses"],
+        "losses_off": off["losses"],
+    }
+
+
+def _measure_int8() -> dict:
+    """The retired knob refuses loudly; the default path counts skips."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.ndarray.ndarray import invoke
+
+    rng = onp.random.RandomState(5)
+    qd = mx.nd.array(rng.randint(-127, 128, (2, 8, 8, 32)), dtype="int8")
+    qw = mx.nd.array(rng.randint(-127, 128, (64, 1, 1, 32)), dtype="int8")
+    attrs = dict(kernel=(1, 1), stride=(1, 1), num_filter=64,
+                 layout="NHWC", no_bias=True, data_scale=0.02,
+                 w_scale=0.015)
+    _set("MXNET_INT8_PALLAS", None)
+    s0 = q.pallas_skipped_count()
+    invoke("quantized_conv", [qd, qw], attrs)
+    skips = q.pallas_skipped_count() - s0
+    refused, points_at_measurement = False, False
+    _set("MXNET_INT8_PALLAS", 1)
+    try:
+        invoke("quantized_conv", [qd, qw], attrs)
+    except MXNetError as e:
+        refused = True
+        points_at_measurement = "0.345x" in str(e) \
+            and "BENCH_builder_r05" in str(e)
+    finally:
+        _set("MXNET_INT8_PALLAS", None)
+    return {"mode": "int8", "skips_counted": skips, "knob_refused": refused,
+            "refusal_names_measurement": points_at_measurement}
+
+
+def main() -> int:
+    chain = _measure_chain()
+    fused = _measure_fused()
+    pad = _measure_pad()
+    int8 = _measure_int8()
+    print(f"{'chain':<16} {chain['fusions']} fusions "
+          f"(budget {FUSION_BUDGET}), {chain['bytes']:.0f} bytes accessed")
+    print(f"{'fused-epilogue':<16} {fused['fused_sites']}/{FUSED_SITES} "
+          f"sites, pallas_marker={fused['pallas_marker']}, fusions "
+          f"{fused['unfused_fusions']} -> {fused['fused_fusions']}, "
+          f"bytes {fused['unfused_bytes']:.0f} -> {fused['fused_bytes']:.0f}"
+          f" (CPU-interpret figure), max |d out| {fused['max_out_diff']:.2e}")
+    print(f"{'pad-channels':<16} {pad['padded_convs']} padded convs, "
+          f"{pad['dispatches_per_step']:.1f} dispatch/step, "
+          f"{pad['retraces_after_warm']} retraces, "
+          f"bit_exact={pad['bit_exact']}")
+    print(f"{'int8':<16} knob_refused={int8['knob_refused']} "
+          f"(names measurement: {int8['refusal_names_measurement']}), "
+          f"{int8['skips_counted']} skip(s) counted")
+    failures = []
+    if chain["fusions"] > FUSION_BUDGET:
+        failures.append(
+            f"conv+BN+ReLU compiles to {chain['fusions']} fusions, "
+            f"budget {FUSION_BUDGET}")
+    if fused["fused_sites"] != FUSED_SITES:
+        failures.append(
+            f"fused epilogue claimed {fused['fused_sites']} bottleneck "
+            f"1x1 sites, expected {FUSED_SITES}")
+    if fused["unfused_sites"] != 0:
+        failures.append("fused op ran with the knob off")
+    if not fused["pallas_marker"]:
+        failures.append(
+            "fused trace carries no pallas custom-call marker")
+    if fused["fused_fusions"] >= fused["unfused_fusions"]:
+        failures.append(
+            f"fused path has {fused['fused_fusions']} fusions, not fewer "
+            f"than the unfused baseline's {fused['unfused_fusions']} — "
+            "the epilogue chain did not collapse into the kernel")
+    if not fused["out_close"]:
+        failures.append(
+            f"fused bottleneck output diverged "
+            f"(max diff {fused['max_out_diff']:.2e})")
+    if not pad["compiled"]:
+        failures.append("pad lane fell back to the eager tape")
+    if pad["padded_convs"] < 1:
+        failures.append("padding pass never fired on a misaligned conv")
+    if pad["unpadded_pass_pads"] != 0:
+        failures.append("padding pass fired with the knob off")
+    if pad["retraces_after_warm"] > 0:
+        failures.append(
+            f"padding pass added {pad['retraces_after_warm']} retraces")
+    if pad["dispatches_per_step"] > 1:
+        failures.append(
+            f"padding pass added dispatches "
+            f"({pad['dispatches_per_step']:.1f}/step, budget 1)")
+    if not pad["bit_exact"]:
+        failures.append(
+            f"padded train step is not bit-exact: {pad['losses_on']} vs "
+            f"{pad['losses_off']}")
+    if not int8["knob_refused"]:
+        failures.append("MXNET_INT8_PALLAS=1 did not refuse")
+    if not int8["refusal_names_measurement"]:
+        failures.append(
+            "int8 refusal does not point at the 0.345x measurement")
+    if int8["skips_counted"] < 1:
+        failures.append("eligible int8 conv did not count a Pallas skip")
+    if failures:
+        print("check_fusion_budget: FAILED —", "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"check_fusion_budget: fusion budget holds "
+          f"({chain['fusions']} <= {FUSION_BUDGET} fusions unfused; "
+          f"fused epilogue {fused['unfused_fusions']} -> "
+          f"{fused['fused_fusions']} fusions over {FUSED_SITES} sites); "
+          f"padding pass bit-exact at {pad['dispatches_per_step']:.0f} "
+          f"dispatch/step, 0 retraces; int8 knob refuses with the "
+          f"measurement")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

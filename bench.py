@@ -653,6 +653,7 @@ def lane_train_step(on_cpu: bool) -> dict:
         "cache_misses": c["cache_misses"],
         "us_per_step": round(c["us_per_step"], 1),
         "n_params": c["n_params"],
+        "telemetry": c.get("telemetry"),
         "platform": c["platform"],
     }
 
@@ -697,6 +698,7 @@ def lane_infer(on_cpu: bool) -> dict:
         "buckets": c["buckets"],
         "requests_per_dispatch":
             round(c["concurrent"]["requests_per_dispatch"], 2),
+        "telemetry": c.get("telemetry"),
         "platform": c["platform"],
     }
 
@@ -750,6 +752,7 @@ def lane_decode(on_cpu: bool) -> dict:
         "compile_s": c["compile_s"],
         "cache_hits": c["cache_hits"],
         "cache_misses": c["cache_misses"],
+        "telemetry": c.get("telemetry"),
         "platform": c["platform"],
     }
 
@@ -789,6 +792,7 @@ def lane_pipeline(on_cpu: bool) -> dict:
         "host_syncs_per_step": c["pipelined"]["host_syncs_per_step"],
         "wall_speedup": c["wall_speedup"],
         "compiled": c["pipelined"]["compiled"],
+        "telemetry": c.get("telemetry"),
         "compile_s": c["compile_s"],
         "cache_hits": c["cache_hits"],
         "cache_misses": c["cache_misses"],
@@ -922,6 +926,19 @@ def _run_lane_child(name: str) -> None:
             lane.setdefault("compile_s", round(_ps.compile_seconds(), 1))
             lane.setdefault("cache_hits", disk["hits"])
             lane.setdefault("cache_misses", disk["misses"])
+        except Exception:
+            pass
+        # every lane stamps the full namespaced telemetry snapshot of
+        # its child process (subprocess-backed lanes already carry their
+        # worker's snapshot; in-process lanes pick it up here) — the
+        # hand-picked per-lane keys remain as aliases for BENCH_*
+        # comparability
+        try:
+            from mxnet_tpu import telemetry as _tel
+
+            if lane.get("telemetry") is None:
+                lane["telemetry"] = {k: v for k, v in
+                                     _tel.snapshot().items() if v}
         except Exception:
             pass
     except BaseException:

@@ -150,12 +150,19 @@ def run() -> dict:
 
     from mxnet_tpu import program_store
 
+    from mxnet_tpu import telemetry
+
+    tel0 = telemetry.snapshot()
     sync = _run_loop(False)
     pipe = _run_loop(True)
     gap_s, gap_p = sync["device_idle_gap_us"], pipe["device_idle_gap_us"]
     disk = program_store.disk_stats()
     return {
         "platform": jax.default_backend(),
+        # full namespaced counter delta across both loops; the
+        # hand-picked keys below stay as aliases for BENCH_* continuity
+        "telemetry": {k: v for k, v in telemetry.delta(tel0).items()
+                      if v},
         "steps": STEPS,
         "depth": DEPTH,
         "compile_s": round(sync["compile_s"] + pipe["compile_s"], 3),

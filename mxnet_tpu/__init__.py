@@ -21,6 +21,8 @@ __version__ = "0.1.0"
 from . import base
 from .base import MXNetError
 from . import config  # noqa: E402  (no jax dependency; safe first)
+from . import telemetry  # noqa: E402  (no jax dependency; the counter
+# registry/event bus must exist before every module that declares into it)
 from . import faults  # noqa: E402  (no jax dependency; installs any
 # MXNET_FAULT_PLAN before the runtime it instruments imports)
 
@@ -82,6 +84,7 @@ _LAZY = {
     "program_store": ".program_store",
     "serving": ".serving",
     "serving_decode": ".serving_decode",
+    "telemetry": ".telemetry",
     "test_utils": ".test_utils",
     "recordio": ".recordio",
     "util": ".util",

@@ -63,6 +63,7 @@ from . import engine as _engine
 from . import faults as _faults
 from . import program_store as _pstore
 from . import random as _random
+from . import telemetry as _telemetry
 from .context import current_context
 
 __all__ = ["TrainStep", "enabled", "trace_count", "dispatch_count",
@@ -76,7 +77,11 @@ __all__ = ["TrainStep", "enabled", "trace_count", "dispatch_count",
 # and benchmark/eager_latency.py read them; the bar: 1 dispatch/step,
 # 0 retraces after warm-up).
 _NS = _pstore.namespace("train_step")
-_DEFERRED_READ_COUNT = 0
+_DEFERRED_READ = _telemetry.counter(
+    "cached_step.deferred_read",
+    "host reads of a LAGGED all-finite flag (the deferred AMP gate, "
+    "MXNET_AMP_LAG): each reads step N-1's flag while step N is in "
+    "flight, so it never blocks the current program")
 
 
 def trace_count() -> int:
@@ -96,14 +101,14 @@ def deferred_read_count() -> int:
     """Host reads of a LAGGED all-finite flag (the deferred AMP gate,
     MXNET_AMP_LAG): each is a read of step N-1's flag performed while
     step N is already in flight, so it never blocks on the current
-    program."""
-    return _DEFERRED_READ_COUNT
+    program.  (View over the ``cached_step.deferred_read`` registry
+    counter.)"""
+    return int(_DEFERRED_READ.value)
 
 
 def reset_counters() -> None:
-    global _DEFERRED_READ_COUNT
     _NS.reset()
-    _DEFERRED_READ_COUNT = 0
+    _DEFERRED_READ.reset()
 
 
 def enabled() -> bool:
@@ -222,22 +227,30 @@ class TrainStep:
         fallback, and whenever the lag window closes (MXNET_AMP_LAG=0 /
         NaiveEngine) — after drain() the scaler state equals the
         synchronous gate's bit-exactly."""
-        global _DEFERRED_READ_COUNT
         prev, self._pending_ok = self._pending_ok, None
         if prev is None:
             return
         from .ndarray import ndarray as _ndmod
 
         _ndmod.count_host_sync()
-        _DEFERRED_READ_COUNT += 1
+        _DEFERRED_READ.inc()
         scaler = getattr(self._trainer, "_amp_loss_scaler", None)
         if scaler is not None:
-            scaler.update_scale(not bool(prev))
+            overflow = not bool(prev)
+            if overflow:
+                _telemetry.event("amp_overflow", "cached_step",
+                                 where="drain")
+            scaler.update_scale(overflow)
 
     def __call__(self, *args, batch_size: Optional[int] = None):
         # train-step injection site (fail-fast like trainer.step: a step
         # is not idempotent; recovery is restore-and-replay, not retry)
         _faults.inject("cached_step.step")
+        step_idx = _telemetry.next_step()
+        with _telemetry.span("train_step.step", cat="train_step") as sp:
+            return self._call_inner(args, batch_size, step_idx, sp)
+
+    def _call_inner(self, args, batch_size, step_idx, sp):
         tr = self._trainer
         if batch_size is None:
             batch_size = int(args[0].shape[0]) \
@@ -248,7 +261,10 @@ class TrainStep:
             tr._init_params()
         reason = self._eligibility()
         if reason is not None:
+            if reason != self.last_fallback_reason:
+                _telemetry.event("fallback", "cached_step", reason=reason)
             self.last_fallback_reason = reason
+            sp.annotate(path="eager", step=step_idx)
             return self._eager_step(args, batch_size)
         opt = tr._optimizer
         # host-side update-count bump BEFORE reading lrs (the eager order:
@@ -267,8 +283,12 @@ class TrainStep:
             opt.num_update = count_snap[1]
             self.fallback_reason = f"{type(e).__name__}: {e}"
             self.last_fallback_reason = self.fallback_reason
+            _telemetry.event("fallback", "cached_step",
+                             reason=self.fallback_reason, sticky=True)
+            sp.annotate(path="eager", step=step_idx)
             return self._eager_step(args, batch_size)
         self.last_fallback_reason = None
+        sp.annotate(path="compiled", step=step_idx)
         return out
 
     # -- shape bucketing --------------------------------------------------
@@ -779,18 +799,25 @@ class TrainStep:
                 # PREVIOUS one (already materialized — its program
                 # finished while this step was being prepared, so the
                 # read is lagged, never a stall on the current program)
-                global _DEFERRED_READ_COUNT
                 prev = self._pending_ok
                 self._pending_ok = ok
                 if prev is not None:
                     _ndmod.count_host_sync()
-                    _DEFERRED_READ_COUNT += 1
-                    scaler.update_scale(not bool(prev))
+                    _DEFERRED_READ.inc()
+                    overflow = not bool(prev)
+                    if overflow:
+                        _telemetry.event("amp_overflow", "cached_step",
+                                         where="deferred")
+                    scaler.update_scale(overflow)
             else:
                 # the ONE host read of the step: the device all-finite
                 # flag drives the loss-scale policy synchronously
                 _ndmod.count_host_sync()
-                scaler.update_scale(not bool(ok))
+                overflow = not bool(ok)
+                if overflow:
+                    _telemetry.event("amp_overflow", "cached_step",
+                                     where="sync")
+                scaler.update_scale(overflow)
         return loss
 
     def _build_program(self, params, names, in_struct, ctx, flavor,

@@ -438,6 +438,32 @@ declare("MXNET_SERVE_DECODE_ROWS", int, 8,
         "join/retire never retraces.  Also the continuous-batching "
         "concurrency ceiling per engine.",
         validator=lambda v: v >= 1, subsystem="serving", cached=False)
+declare("MXNET_TELEMETRY_DIR", str, None,
+        "Telemetry flight recorder: when set, telemetry.flush() — called "
+        "by engine.waitall() and available directly — appends the "
+        "structured event bus plus a full counter snapshot as JSON-lines "
+        "to <dir>/telemetry-<pid>.jsonl.  Unset (default) = recorder "
+        "off; counters/events/spans stay purely in-process.",
+        subsystem="telemetry", cached=False)
+declare("MXNET_TELEMETRY_EVENTS", int, 4096,
+        "Telemetry event-bus capacity: the bounded buffer keeps the "
+        "newest N structured events (retrace, fallback, shed, preempt, "
+        "cache_evict, amp_overflow, fault.*); older events drop (the "
+        "emitted counter telemetry.events keeps the true total).  Read "
+        "once at import.", validator=lambda v: v >= 1,
+        subsystem="telemetry")
+declare("MXNET_TELEMETRY_XLA", int, 1,
+        "Wrap telemetry.span brackets in jax.profiler trace annotations "
+        "so host-side spans (train step, serving dispatch, decode "
+        "iteration) land INSIDE XLA device profiles captured via "
+        "jax.profiler/TensorBoard.  0 = spans record host-side only.",
+        subsystem="telemetry", cached=False)
+declare("MXNET_FAULT_EVENTS", int, 1024,
+        "Capacity of the faults structured event log (faults.events()): "
+        "the bounded deque keeps the newest N entries (retry, raise, "
+        "deadline, inject, degradation records).  Read once at import; "
+        "fault events also mirror onto the telemetry bus with step "
+        "indices.", validator=lambda v: v >= 1, subsystem="faults")
 declare("MXNET_MODULE_SEED", int, None,
         "Override the per-test RNG seed for reproduction (reference test "
         "harness contract)", subsystem="testing")

@@ -38,7 +38,12 @@ INT8_MIN, INT8_MAX = -127.0, 127.0       # symmetric, matches reference
 # the microbench A/B vehicle).  Every conv a Pallas route would have
 # claimed is still counted here and logged once per process, and setting
 # MXNET_INT8_PALLAS nonzero now REFUSES loudly instead of routing.
-_PALLAS_SKIPPED = 0
+from .. import telemetry as _telemetry
+
+_PALLAS_SKIPPED = _telemetry.counter(
+    "quantization.pallas_skipped",
+    "quantized convs a Pallas int8 route would have claimed (the "
+    "kernel was retired on the 0.345x measurement)")
 _PALLAS_SKIP_LOGGED = False
 
 _INT8_PALLAS_VERDICT = (
@@ -56,13 +61,14 @@ _INT8_PALLAS_VERDICT = (
 def pallas_skipped_count() -> int:
     """Quantized convs that a Pallas int8 route would have claimed
     (the kernel was retired on the 0.345x measurement; see
-    ``_INT8_PALLAS_VERDICT``)."""
-    return _PALLAS_SKIPPED
+    ``_INT8_PALLAS_VERDICT``).  View over the
+    ``quantization.pallas_skipped`` telemetry counter."""
+    return int(_PALLAS_SKIPPED.value)
 
 
 def _count_pallas_skip() -> None:
-    global _PALLAS_SKIPPED, _PALLAS_SKIP_LOGGED
-    _PALLAS_SKIPPED += 1
+    global _PALLAS_SKIP_LOGGED
+    _PALLAS_SKIPPED.inc()
     if not _PALLAS_SKIP_LOGGED:
         _PALLAS_SKIP_LOGGED = True
         from .. import log as _log

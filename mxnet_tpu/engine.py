@@ -106,7 +106,21 @@ def register_drainable(obj):
 
 
 def drainable_count() -> int:
+    """Live drainable registrations (exported as the computed telemetry
+    gauge ``engine.drainables``)."""
     return len(_DRAINABLES)
+
+
+def _register_drainables_gauge():
+    from . import telemetry
+
+    telemetry.gauge_fn(
+        "engine.drainables", lambda: len(_DRAINABLES),
+        "live drainable registrations (prefetchers, metric "
+        "accumulators, checkpoint writers, serving queues)")
+
+
+_register_drainables_gauge()
 
 
 def waitall():
@@ -124,6 +138,14 @@ def waitall():
     from .ndarray import waitall as _w
 
     _w()
+    # a drained process has no telemetry left in flight either: flush
+    # the flight recorder (no-op unless MXNET_TELEMETRY_DIR is set)
+    from . import telemetry
+
+    try:
+        telemetry.flush()
+    except OSError:           # unwritable dir must not fail waitall
+        pass
 
 
 # ---------------------------------------------------------------------------

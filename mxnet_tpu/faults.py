@@ -37,6 +37,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import config
+from . import telemetry as _telemetry
 
 __all__ = [
     "FaultInjected", "TransientFault", "FatalFault", "DeadlineExceeded",
@@ -152,15 +153,20 @@ class FaultPlan:
                     fire = (r["exc"], r["seen"])
         if fire is not None:
             exc, n = fire
-            _stats(site)["injected"] += 1
+            _stats(site).inc("injected")
             record_event(site, "inject", invocation=n, kind=exc.__name__)
             raise exc(f"injected fault at site {site!r} (invocation {n})")
 
 
 # -- module state ----------------------------------------------------------
 _PLAN: Optional[FaultPlan] = None
-_STATS: Dict[str, Dict[str, int]] = {}
-_EVENTS: "deque" = deque(maxlen=1024)
+# per-site counters live in the telemetry registry (family 'faults.site',
+# names 'faults.<site>.<attempts|failures|retries|injected>'); _STATS
+# caches the site -> CounterGroup views so counters() keeps returning
+# plain-int dicts for exactly the sites seen since the last reset()
+_STATS: Dict[str, "_telemetry.CounterGroup"] = {}
+_EVENTS: "deque" = deque(
+    maxlen=max(1, int(config.get("MXNET_FAULT_EVENTS"))))
 _STATE_LOCK = threading.Lock()
 _sleep = time.sleep          # patch point for tests (no real waiting)
 
@@ -193,18 +199,26 @@ def inject(site: str) -> None:
         _PLAN.check(site)
 
 
-def _stats(site: str) -> Dict[str, int]:
+def _stats(site: str) -> "_telemetry.CounterGroup":
     s = _STATS.get(site)
     if s is None:
         with _STATE_LOCK:
-            s = _STATS.setdefault(
-                site, {"attempts": 0, "failures": 0, "retries": 0,
-                       "injected": 0})
+            s = _STATS.get(site)
+            if s is None:
+                s = _STATS[site] = _telemetry.CounterGroup(
+                    f"faults.{site}",
+                    ("attempts", "failures", "retries", "injected"),
+                    doc=f"fault-site {site!r} retry-policy counters",
+                    family="faults.site")
+                # a re-seen site after reset() starts from zero again
+                # (counters() contract: reset forgets every site)
+                s.reset()
     return s
 
 
 def counters(site: Optional[str] = None) -> Dict:
-    """Per-site ``{attempts, failures, retries, injected}`` counters."""
+    """Per-site ``{attempts, failures, retries, injected}`` counters
+    (views over the telemetry registry, family ``faults.site``)."""
     if site is not None:
         return dict(_stats(site))
     return {k: dict(v) for k, v in _STATS.items()}
@@ -214,12 +228,17 @@ def record_event(site: str, action: str, error: Optional[BaseException] = None,
                  **extra) -> None:
     """Append a structured entry to the bounded event log (recovery paths
     outside :func:`retry_call` — e.g. checkpoint-restore degradation —
-    log through this too)."""
+    log through this too).  Every entry also mirrors onto the telemetry
+    event bus (kind ``fault``) where it picks up the current train-step
+    index and monotonic timestamp."""
     ev: Dict[str, Any] = {"site": site, "action": action, "time": time.time()}
     if error is not None:
         ev["error"] = repr(error)
     ev.update(extra)
     _EVENTS.append(ev)
+    _telemetry.event("fault", site, action=action,
+                     error=repr(error) if error is not None else None,
+                     **extra)
 
 
 def events(site: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -232,6 +251,8 @@ def events(site: Optional[str] = None) -> List[Dict[str, Any]]:
 def reset() -> None:
     """Clear counters + events (and the active plan's invocation counts)."""
     with _STATE_LOCK:
+        for g in _STATS.values():
+            g.reset()               # zero the registry-backed values too
         _STATS.clear()
     _EVENTS.clear()
     if _PLAN is not None:
@@ -292,12 +313,12 @@ def retry_call(fn: Callable, *args,
     attempt = 0
     while True:
         attempt += 1
-        stats["attempts"] += 1
+        stats.inc("attempts")
         try:
             inject(site)
             return fn(*args, **kwargs)
         except BaseException as e:
-            stats["failures"] += 1
+            stats.inc("failures")
             if not check(e) or attempt > retries:
                 record_event(site, "raise", e, attempt=attempt)
                 raise
@@ -308,7 +329,7 @@ def retry_call(fn: Callable, *args,
                 raise DeadlineExceeded(
                     f"site {site!r}: {deadline}s deadline exceeded after "
                     f"{attempt} attempt(s); last error: {e!r}") from e
-            stats["retries"] += 1
+            stats.inc("retries")
             record_event(site, "retry", e, attempt=attempt, delay=delay)
             if on_retry is not None:
                 on_retry(attempt, e)

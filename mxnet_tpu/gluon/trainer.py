@@ -215,6 +215,15 @@ class Trainer:
         return self.compile_step(net, loss_fn,
                                  bucket=bucket).precompile(*specs)
 
+    def step_spans(self, limit=None):
+        """Per-step span records of the compiled train step (cat
+        ``train_step``) from the unified telemetry span buffer: one
+        record per ``TrainStep.__call__`` with wall duration, the step
+        index, and whether the step ran compiled or fell back eager."""
+        from .. import telemetry as _telemetry
+
+        return _telemetry.spans(cat="train_step", limit=limit)
+
     # -- the step --------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """Normalize by batch_size, all-reduce grads, apply updates

@@ -39,18 +39,23 @@ __all__ = [
 # device->host reads performed by metric HOST paths (metrics bypassing
 # the device-accumulator path, or the path disabled): the loud fallback
 # counter — benchmark/pipeline_latency.py and the budget gate read it
-_HOST_SYNC_COUNT = 0
+from . import telemetry as _telemetry  # noqa: E402
+
+_HOST_SYNC = _telemetry.counter(
+    "metric.host_sync",
+    "blocking per-update device->host reads by metrics that bypassed "
+    "the device accumulator path (no kernel / disabled / NaiveEngine)")
 
 
 def host_sync_count() -> int:
     """Blocking per-update device->host reads by metrics that bypassed
-    the device accumulator path (no kernel / disabled / NaiveEngine)."""
-    return _HOST_SYNC_COUNT
+    the device accumulator path (no kernel / disabled / NaiveEngine).
+    (View over the ``metric.host_sync`` registry counter.)"""
+    return int(_HOST_SYNC.value)
 
 
 def reset_host_sync_count() -> None:
-    global _HOST_SYNC_COUNT
-    _HOST_SYNC_COUNT = 0
+    _HOST_SYNC.reset()
 
 _REGISTRY: Dict[str, type] = {}
 
@@ -105,8 +110,7 @@ def _host(x) -> onp.ndarray:
     if isinstance(x, NDArray):
         # the loud fallback: every host-path sync on a device array is
         # counted, never silent (metric.host_sync_count)
-        global _HOST_SYNC_COUNT
-        _HOST_SYNC_COUNT += 1
+        _HOST_SYNC.inc()
         return x.asnumpy()
     return onp.asarray(x)
 

@@ -46,19 +46,27 @@ __all__ = ["TransformerLMConfig", "init_params", "forward", "loss_fn",
 # Every fallback is counted here (once per trace of each misaligned
 # attention site) and logged once per process, mirroring
 # quantization.pallas_skipped_count.
-_FLASH_FALLBACK = 0
+from .. import telemetry as _telemetry
+
+_FLASH_FALLBACK = _telemetry.counter(
+    "transformer_lm.flash_fallback",
+    "attention sites that wanted the Pallas flash kernel but fell back "
+    "to the O(S^2) einsum path on misaligned (seq, head_dim)")
 _FLASH_FALLBACK_LOGGED = False
 
 
 def flash_fallback_count() -> int:
     """Attention sites that wanted the Pallas flash kernel but fell back
-    to the einsum path on misaligned (seq, head_dim)."""
-    return _FLASH_FALLBACK
+    to the einsum path on misaligned (seq, head_dim).  View over the
+    ``transformer_lm.flash_fallback`` telemetry counter."""
+    return int(_FLASH_FALLBACK.value)
 
 
 def _count_flash_fallback(seq: int, head_dim: int) -> None:
-    global _FLASH_FALLBACK, _FLASH_FALLBACK_LOGGED
-    _FLASH_FALLBACK += 1
+    global _FLASH_FALLBACK_LOGGED
+    _FLASH_FALLBACK.inc()
+    _telemetry.event("fallback", "transformer_lm.flash",
+                     seq=seq, head_dim=head_dim)
     if not _FLASH_FALLBACK_LOGGED:
         _FLASH_FALLBACK_LOGGED = True
         from .. import log as _log

@@ -204,8 +204,7 @@ class NDArray:
         return to_dlpack_for_write(self)
 
     def asnumpy(self) -> onp.ndarray:
-        global _HOST_SYNC_COUNT
-        _HOST_SYNC_COUNT += 1
+        _HOST_SYNC.inc()
         self.wait_to_read()
         return onp.asarray(self._data)
 
@@ -785,31 +784,38 @@ def _check_int_bounds(key, shape):
 
 # operator dispatches since import: with fused.dispatch_count() this gives
 # benchmark/eager_latency.py the dispatches-per-step lane a denominator
-_INVOKE_COUNT = 0
+from .. import telemetry as _telemetry  # noqa: E402
+
+_INVOKE = _telemetry.counter(
+    "ndarray.invoke", "eager operator dispatches since import")
 
 
 def invoke_count() -> int:
-    """Number of eager operator dispatches since import."""
-    return _INVOKE_COUNT
+    """Number of eager operator dispatches since import (view over the
+    ``ndarray.invoke`` registry counter)."""
+    return int(_INVOKE.value)
 
 
 # blocking host reads (asnumpy/item/float/bool, plus the deferred AMP
 # flag read in cached_step) since import: tools/check_dispatch_budget.py
 # gates the steady-state train step on this staying at 0 (non-AMP) /
 # <= 1 deferred read (AMP) — the pipeline engine's host-sync budget
-_HOST_SYNC_COUNT = 0
+_HOST_SYNC = _telemetry.counter(
+    "ndarray.host_sync",
+    "blocking device->host value reads (asnumpy/item/float/bool + the "
+    "deferred AMP flag read)")
 
 
 def host_sync_count() -> int:
-    """Number of blocking device->host value reads since import."""
-    return _HOST_SYNC_COUNT
+    """Number of blocking device->host value reads since import (view
+    over the ``ndarray.host_sync`` registry counter)."""
+    return int(_HOST_SYNC.value)
 
 
 def count_host_sync() -> None:
     """Record one blocking host read performed outside asnumpy (e.g. a
     bool() on a raw jax scalar)."""
-    global _HOST_SYNC_COUNT
-    _HOST_SYNC_COUNT += 1
+    _HOST_SYNC.inc()
 
 
 def invoke(
@@ -826,8 +832,7 @@ def invoke(
     - Wraps outputs; honours ``out=`` by writing into the destination
       (reference's kWriteTo into provided output arrays).
     """
-    global _INVOKE_COUNT
-    _INVOKE_COUNT += 1
+    _INVOKE.inc()
     schema = get_op(op) if isinstance(op, str) else op
     ctx = inputs[0]._ctx if inputs else current_context()
     arrays = [i._data for i in inputs]

@@ -174,13 +174,18 @@ def _conv_dimension_numbers(layout: str):
 # contrib/quantization.py quantized_conv).  Bit-exactness is asserted by
 # tools/check_fusion_budget.py and tests/test_fused_epilogue.py.
 
-_PAD_CHANNELS_COUNT = 0
+from .. import telemetry as _telemetry  # noqa: E402
+
+_PAD_CHANNELS = _telemetry.counter(
+    "nn.pad_channels", "convolutions the MXU-alignment pass padded "
+    "(trace-time: one per padded conv node per trace)")
 
 
 def pad_channels_count() -> int:
     """Convolutions the MXU-alignment pass padded (trace-time count:
-    one per padded conv node per trace)."""
-    return _PAD_CHANNELS_COUNT
+    one per padded conv node per trace).  View over the
+    ``nn.pad_channels`` telemetry counter."""
+    return int(_PAD_CHANNELS.value)
 
 
 def _pad_up(v: int, q: int) -> int:
@@ -213,8 +218,7 @@ def maybe_pad_conv_channels(data, weight, layout: str, num_group: int):
     wpad = [(0, 0)] * weight.ndim
     wpad[0] = (0, cout_p - cout)
     wpad[w_in_axis] = (0, cin_p - cin)
-    global _PAD_CHANNELS_COUNT
-    _PAD_CHANNELS_COUNT += 1
+    _PAD_CHANNELS.inc()
     return (jnp.pad(data, dpad) if cin_p != cin else data,
             jnp.pad(weight, wpad), cout)
 

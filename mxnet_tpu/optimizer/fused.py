@@ -42,26 +42,30 @@ _GROUP_JIT: "OrderedDict" = OrderedDict()
 _GROUP_CAP = 64
 _FINITE_JIT: Dict[Any, Any] = {}
 
-# observability: _TRACE_COUNT bumps when a group/finite-check program body
-# is (re)traced; _DISPATCH_COUNT bumps per compiled-program launch.  Tests
+# observability: fused.trace bumps when a group/finite-check program body
+# is (re)traced; fused.dispatch bumps per compiled-program launch.  Tests
 # assert re-trace stays at 0 across repeated step() calls and
 # benchmark/eager_latency.py reports dispatches per step.
-_TRACE_COUNT = 0
-_DISPATCH_COUNT = 0
+from .. import telemetry as _telemetry  # noqa: E402
+
+_TRACE = _telemetry.counter(
+    "fused.trace", "fused-optimizer group/finite-check program bodies "
+    "(re)traced")
+_DISPATCH = _telemetry.counter(
+    "fused.dispatch", "fused-optimizer compiled-program launches")
 
 
 def trace_count() -> int:
-    return _TRACE_COUNT
+    return int(_TRACE.value)
 
 
 def dispatch_count() -> int:
-    return _DISPATCH_COUNT
+    return int(_DISPATCH.value)
 
 
 def reset_counters() -> None:
-    global _TRACE_COUNT, _DISPATCH_COUNT
-    _TRACE_COUNT = 0
-    _DISPATCH_COUNT = 0
+    _TRACE.reset()
+    _DISPATCH.reset()
 
 
 def supports(opt) -> bool:
@@ -138,7 +142,6 @@ def all_finite(arrays: Sequence) -> jnp.ndarray:
     a device bool scalar — no host sync.  ``Trainer.step`` threads this
     flag into each group program (the update is skipped on-device when it
     is False), and ``LossScaler.has_overflow`` reads it once on host."""
-    global _DISPATCH_COUNT
     arrs = [a._data if isinstance(a, NDArray) else a for a in arrays
             if a is not None]
     if not arrs:
@@ -148,12 +151,11 @@ def all_finite(arrays: Sequence) -> jnp.ndarray:
     if fn is None:
 
         def check(xs):
-            global _TRACE_COUNT
-            _TRACE_COUNT += 1
+            _TRACE.inc()
             return jnp.all(jnp.stack([jnp.isfinite(x).all() for x in xs]))
 
         fn = bounded_cache_put(_FINITE_JIT, key, jax.jit(check))
-    _DISPATCH_COUNT += 1
+    _DISPATCH.inc()
     return fn(arrs)
 
 
@@ -247,8 +249,7 @@ def _build(opt, mp: bool, has_ok: bool, donate: bool):
     body = group_step_fn(opt, mp, has_ok)
 
     def group_step(*args):
-        global _TRACE_COUNT
-        _TRACE_COUNT += 1
+        _TRACE.inc()
         return body(*args)
 
     # donation aliases the old weight/state HBM into the outputs (the
@@ -258,7 +259,6 @@ def _build(opt, mp: bool, has_ok: bool, donate: bool):
 
 
 def _apply_group(opt, mp, ws, gs, ss, lrs, wds, counts, ok) -> None:
-    global _DISPATCH_COUNT
     has_ok = ok is not None
     donate = jax.default_backend() not in ("cpu",)
     sig = (type(opt).__name__, opt._fused_signature(), mp, has_ok, donate,
@@ -281,7 +281,7 @@ def _apply_group(opt, mp, ws, gs, ss, lrs, wds, counts, ok) -> None:
         jnp.asarray(counts, jnp.float32),
         jnp.asarray(float(opt.rescale_grad), jnp.float32),
         ok if has_ok else jnp.asarray(True))
-    _DISPATCH_COUNT += 1
+    _DISPATCH.inc()
     for w, nw in zip(ws, new_w):
         w._set_data(nw)
     for s, ns in zip(ss, new_s):

@@ -31,17 +31,21 @@ Spec = PartitionSpec
 # frozen, so padding belongs to the batch boundary (DataLoader
 # last_batch='pad', serving buckets) — here the offending dim degrades
 # to replication, counted and (on the constraint path) warned.
-_LEGALIZE_REFUSALS = 0
+from .. import telemetry as _telemetry  # noqa: E402
+
+_LEGALIZE_REFUSAL = _telemetry.counter(
+    "sharding.legalize_refusal",
+    "spec dims refused (degraded to replication) because the shape "
+    "could not divide the mesh axis evenly")
 _WARNED_REFUSALS: set = set()
 
 
 def legalize_refusal_count() -> int:
-    return _LEGALIZE_REFUSALS
+    return int(_LEGALIZE_REFUSAL.value)
 
 
 def reset_legalize_refusals() -> None:
-    global _LEGALIZE_REFUSALS
-    _LEGALIZE_REFUSALS = 0
+    _LEGALIZE_REFUSAL.reset()
 
 
 def _axis_size(mesh: Mesh, axes) -> int:
@@ -62,7 +66,6 @@ def _legalize(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh,
     (:func:`legalize_refusal_count`) and, with ``loud=True`` (the
     :func:`constraint` path), warned once per (shape, spec) — degrading a
     constraint must never be silent, and erroring mid-trace is worse."""
-    global _LEGALIZE_REFUSALS
     out = []
     padded = (tuple(spec) + (None,) * len(shape))[: len(shape)]
     for i, axes in enumerate(padded):
@@ -78,7 +81,7 @@ def _legalize(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh,
         if n == 1:
             out.append(None)
         elif shape[i] % n != 0:
-            _LEGALIZE_REFUSALS += 1
+            _LEGALIZE_REFUSAL.inc()
             if loud:
                 key = (tuple(shape), i, ax_tuple, n)
                 if key not in _WARNED_REFUSALS:

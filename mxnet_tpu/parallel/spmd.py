@@ -63,29 +63,37 @@ DATA_AXIS = "dp"
 # ps-lite-style stores stay host-driven and keep the eager fallback.
 _MESH_STORES = ("tpu", "nccl")
 
+from .. import telemetry as _telemetry
+
 _lock = threading.Lock()
 # param/state leaves actually moved by ensure_placed (first-step placement
 # is expected; a steady-state bump is a silent cross-device copy — the
 # budget gate pins it at 0 after warmup)
-_RESHARD_COUNT = 0
+_RESHARD = _telemetry.counter(
+    "spmd.reshard",
+    "param/state leaves actually moved by ensure_placed (first-step "
+    "placement expected; a steady-state bump is a silent cross-device "
+    "copy — the budget gate pins it at 0 after warmup)")
 # batches replicated because the 'dp' axis could not divide the batch
 # axis evenly (correct, but no scale-out for that step — loud by contract)
-_REPLICATED_BATCH_COUNT = 0
+_REPLICATED_BATCH = _telemetry.counter(
+    "spmd.replicated_batch",
+    "batches replicated because the 'dp' axis could not divide the "
+    "batch axis evenly (correct but no scale-out that step)")
 _WARNED_SHAPES: set = set()
 
 
 def reshard_count() -> int:
-    return _RESHARD_COUNT
+    return int(_RESHARD.value)
 
 
 def replicated_batch_count() -> int:
-    return _REPLICATED_BATCH_COUNT
+    return int(_REPLICATED_BATCH.value)
 
 
 def reset_counters() -> None:
-    global _RESHARD_COUNT, _REPLICATED_BATCH_COUNT
-    _RESHARD_COUNT = 0
-    _REPLICATED_BATCH_COUNT = 0
+    _RESHARD.reset()
+    _REPLICATED_BATCH.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -166,13 +174,12 @@ def batch_spec_for(shape: Tuple[int, ...], mesh: Mesh) -> PartitionSpec:
     """Legalized batch spec for one leaf: ``P('dp')`` when the batch
     axis divides evenly, ``P()`` (replicated, counted + warned once per
     shape) otherwise.  Never raises mid-step."""
-    global _REPLICATED_BATCH_COUNT
     n = int(mesh.shape.get(DATA_AXIS, 1))
     if n <= 1 or not shape:
         return PartitionSpec()      # scalars replicate, silently
     if shape[0] % n != 0:
         with _lock:
-            _REPLICATED_BATCH_COUNT += 1
+            _REPLICATED_BATCH.inc()
             key = (tuple(shape), n)
             if key not in _WARNED_SHAPES:
                 _WARNED_SHAPES.add(key)
@@ -219,11 +226,9 @@ def ensure_placed(arr: jax.Array, sharding: NamedSharding) -> jax.Array:
     """Idempotent placement: return ``arr`` untouched when it already
     carries an equivalent sharding, else ``device_put`` it (counted in
     :func:`reshard_count` — steady state must not pay this)."""
-    global _RESHARD_COUNT
     if _equivalently_placed(arr, sharding):
         return arr
-    with _lock:
-        _RESHARD_COUNT += 1
+    _RESHARD.inc()
     return jax.device_put(arr, sharding)
 
 

@@ -122,7 +122,12 @@ def _emit(name, cat, ph, ts=None, dur=None, args=None):
 
 def dumps(reset=False, format="table") -> str:
     """Aggregate stats of recorded durations (reference DumpAggregate);
-    ``format`` is 'table' or 'json'."""
+    ``format`` is 'table' or 'json'.
+
+    ``reset=True`` clears the trace-event buffer ONLY.  Declared
+    counters (``profiler.Counter`` → the ``profiler.*`` telemetry
+    registry entries) keep their values: a reset drops recorded events,
+    never registered state (tests/test_telemetry.py pins this)."""
     if format not in ("table", "json"):  # validate before touching events
         raise ValueError("format must be 'table' or 'json'")
     with _LOCK:
@@ -209,16 +214,30 @@ class Marker:
 
 
 class Counter:
-    """Named counter series (reference profiler Counter)."""
+    """Named counter series (reference profiler Counter).
+
+    Registry-backed: the value lives in the telemetry registry as
+    ``profiler.<name>`` (family ``profiler.user``), so it SURVIVES a
+    trace-buffer reset (``dumps(reset=True)`` clears recorded *events*,
+    never declared counters) and a re-created ``Counter("x")`` resumes
+    where the last one left off."""
 
     def __init__(self, name, domain=None, value=None):
+        from . import telemetry as _telemetry
+
         self.name = name
-        self._value = 0
+        self._c = _telemetry.counter(
+            f"profiler.{name}", "user profiler counter series",
+            kind="gauge", family="profiler.user")
         if value is not None:
             self.set_value(value)
 
+    @property
+    def _value(self):
+        return self._c.value
+
     def set_value(self, value):
-        self._value = value
+        self._c.set(value)
         _emit(self.name, "counter", "C", args={self.name: value})
 
     def increment(self, delta=1):
@@ -305,11 +324,17 @@ class StepTimeline:
             return self
 
         def __exit__(self, *exc):
-            dur = time.perf_counter_ns() - self._t0
+            t1 = time.perf_counter_ns()
+            dur = t1 - self._t0
             self._tl.phase_ns[self._name] += dur
             self._tl._accounted_ns += dur
-            _emit(f"{self._tl.name}:{self._name}", "step_phase", "X",
-                  ts=self._t0 // 1000, dur=max(dur // 1000, 1))
+            # phases are telemetry spans (cat 'step_phase'): they join
+            # the unified span buffer AND the chrome-trace pipe
+            from . import telemetry as _telemetry
+
+            _telemetry.record_span(
+                f"{self._tl.name}:{self._name}", "step_phase",
+                self._t0, t1)
 
     def phase(self, name: str) -> "_Phase":
         return self._Phase(self, name)

@@ -58,6 +58,7 @@ import jax
 
 from . import config as _config
 from . import faults as _faults
+from . import telemetry as _telemetry
 
 __all__ = ["Program", "Namespace", "ScopeCache", "namespace", "scope",
            "build", "count_trace", "stats", "reset_counters", "disk_stats",
@@ -148,6 +149,15 @@ def disk_stats() -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 # Namespaces + per-owner scope caches
 # ---------------------------------------------------------------------------
+# every Namespace counter lives in the telemetry registry as
+# 'program_store.<namespace>.<field>' (family 'program_store.namespace');
+# the attribute reads/writes below stay working as properties, so every
+# legacy view (cached_step.trace_count, serving.bucket_stats, ...) is now
+# transitively a registry view
+_NS_FIELDS = ("hits", "misses", "evictions", "traces", "dispatches",
+              "aot_fallbacks", "load_degrades", "compile_count")
+
+
 class Namespace:
     """One metrics + eviction surface shared by every scope of a
     program family (the dispatch-budget gate reads these uniformly)."""
@@ -157,21 +167,26 @@ class Namespace:
         self.name = name
         self.cap_default = cap_default
         self.cap_env = cap_env
+        self._c = {f: _telemetry.counter(
+            f"program_store.{name}.{f}",
+            f"ProgramStore namespace {name!r}: {f}",
+            family="program_store.namespace") for f in _NS_FIELDS}
+        self._c["compile_seconds"] = _telemetry.counter(
+            f"program_store.{name}.compile_seconds",
+            f"ProgramStore namespace {name!r}: wall-clock building "
+            "programs", kind="time", family="program_store.namespace")
         # weakrefs, not strong refs: a dropped owner (a dead TrainStep,
         # a closed engine) must release its programs' HBM
         self._scopes: list = []
-        self.reset()
+
+    def bump(self, field: str, n=1) -> None:
+        """Atomic counter increment (the only write path the store's
+        hot paths use)."""
+        self._c[field].inc(n)
 
     def reset(self) -> None:
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-        self.traces = 0
-        self.dispatches = 0
-        self.aot_fallbacks = 0
-        self.load_degrades = 0
-        self.compile_count = 0
-        self.compile_seconds = 0.0
+        for c in self._c.values():
+            c.reset()
 
     def cap(self) -> int:
         """Per-scope program cap: MXNET_PROGRAM_CACHE_CAPS
@@ -227,6 +242,21 @@ class Namespace:
         }
 
 
+def _ns_prop(field):
+    def _get(self):
+        return self._c[field].value
+
+    def _set(self, v):
+        self._c[field].set(v)
+
+    return property(_get, _set)
+
+
+for _f in _NS_FIELDS + ("compile_seconds",):
+    setattr(Namespace, _f, _ns_prop(_f))
+del _f
+
+
 class ScopeCache(OrderedDict):
     """One owner's keyspace inside a namespace: an ``OrderedDict`` (so
     existing ``len``/iteration/``clear`` call sites and tests keep
@@ -251,9 +281,9 @@ class ScopeCache(OrderedDict):
         caller's cue to build + ``insert``."""
         rec = self.get(key)
         if rec is None:
-            self._ns.misses += 1
+            self._ns.bump("misses")
         else:
-            self._ns.hits += 1
+            self._ns.bump("hits")
             self.move_to_end(key)
         return rec
 
@@ -264,7 +294,8 @@ class ScopeCache(OrderedDict):
         cap = self._ns.cap()
         while len(self) > cap:
             old_key, old_rec = self.popitem(last=False)
-            self._ns.evictions += 1
+            self._ns.bump("evictions")
+            _telemetry.event("cache_evict", self._ns.name, cap=cap)
             if self._on_evict is not None:
                 self._on_evict(old_key, old_rec)
         return rec
@@ -309,8 +340,11 @@ def scope(name: str,
 
 
 def count_trace(name: str) -> None:
-    """Called from inside a program body: bumps when jax (re)traces it."""
-    namespace(name).traces += 1
+    """Called from inside a program body: bumps when jax (re)traces it
+    (and logs a ``retrace`` bus event with the current step index — a
+    steady-state retrace is the classic silent perf killer)."""
+    namespace(name).bump("traces")
+    _telemetry.event("retrace", name)
 
 
 # ---------------------------------------------------------------------------
@@ -330,7 +364,7 @@ class Program:
         self._ns = ns
 
     def __call__(self, *args):
-        self._ns.dispatches += 1
+        self._ns.bump("dispatches")
         if self.executable is not None:
             try:
                 return self.executable(*args)
@@ -340,7 +374,7 @@ class Program:
                 # buffer was consumed): fall back to the retraceable
                 # callable — loud, counted, never silently wrong.  A
                 # genuine error re-raises identically from the jit path.
-                self._ns.aot_fallbacks += 1
+                self._ns.bump("aot_fallbacks")
                 _faults.record_event(
                     "program_store.load", "aot_fallback", e,
                     namespace=self._ns.name)
@@ -388,7 +422,7 @@ def build(name: str, jitted, lower_args: Tuple, meta: Any = None,
             with _loud_cache_errors():
                 executable = jitted.lower(*lower_args).compile()
         except Exception as e:
-            ns.load_degrades += 1
+            ns.bump("load_degrades")
             _faults.record_event(
                 "program_store.load", "degrade_to_recompile", e,
                 namespace=name, label=label,
@@ -408,8 +442,8 @@ def build(name: str, jitted, lower_args: Tuple, meta: Any = None,
                 # trace/compile failure — the caller's fallback story
                 # (eager tape, single-request serving) owns it
                 raise
-    ns.compile_count += 1
-    ns.compile_seconds += time.perf_counter() - t0
+    ns.bump("compile_count")
+    ns.bump("compile_seconds", time.perf_counter() - t0)
     return Program(executable, jitted, meta, ns)
 
 

@@ -69,6 +69,7 @@ from . import config as _config
 from . import faults as _faults
 from . import program_store as _pstore
 from . import random as _random
+from . import telemetry as _telemetry
 from .context import current_context
 
 __all__ = ["BucketPolicy", "ServingEngine", "trace_count", "dispatch_count",
@@ -267,11 +268,16 @@ class ServingEngine:
         self._threads: List[threading.Thread] = []
         self._closed = False
         self._latencies: "deque[float]" = deque(maxlen=8192)
-        self._stats = {"requests": 0, "batches": 0, "coalesced": 0,
-                       "padded_rows": 0, "true_rows": 0,
-                       "bucket_fallbacks": 0, "single_fallbacks": 0,
-                       "verify_runs": 0, "verify_ulp_accepts": 0,
-                       "warmup_programs": 0}
+        # per-engine counters live in the telemetry registry under a
+        # unique instance prefix (family 'serving.engine'); stats()
+        # still hands out plain ints via the Mapping view
+        self._stats = _telemetry.CounterGroup(
+            _telemetry.instance_name("serving.engine"),
+            ("requests", "batches", "coalesced", "padded_rows",
+             "true_rows", "bucket_fallbacks", "single_fallbacks",
+             "verify_runs", "verify_ulp_accepts", "warmup_programs"),
+            doc="ServingEngine per-instance counters",
+            family="serving.engine")
 
     # -- public ------------------------------------------------------------
     def infer(self, *args):
@@ -317,7 +323,18 @@ class ServingEngine:
         if req.error is not None:
             raise req.error
         self._latencies.append(req.t_done - req.t_enqueue)
+        # request lifecycle span (admit -> dispatch -> deliver): the
+        # serving leg of the unified chrome-trace timeline
+        _telemetry.record_span(
+            "serving.request", "serving",
+            int(req.t_enqueue * 1e9), int(req.t_done * 1e9),
+            args={"rows": req.rows, "engine": self._stats.prefix})
         return req.result
+
+    def spans(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Recent serving span records (request lifecycles + batched
+        dispatches) from the unified telemetry span buffer."""
+        return _telemetry.spans(cat="serving", limit=limit)
 
     def stats(self) -> Dict[str, Any]:
         """Counters + latency percentiles (``p50_us``/``p99_us``)."""
@@ -487,7 +504,7 @@ class ServingEngine:
         if self._policy.enabled and self.bucket_refused is None:
             b = self._policy.bucket(rows)
             if b is None:                    # above the largest bucket
-                self._stats["bucket_fallbacks"] += 1
+                self._stats.inc("bucket_fallbacks")
             else:
                 bucket = b
             pad_active = bucket != rows
@@ -509,8 +526,8 @@ class ServingEngine:
                                   [r.rows] + target[1:]) for r in group]
             arr = parts[0] if len(parts) == 1 else jnp.concatenate(parts, 0)
             batched.append(pad_axis0(arr, bucket))
-        self._stats["padded_rows"] += bucket
-        self._stats["true_rows"] += rows
+        self._stats.inc("padded_rows", bucket)
+        self._stats.inc("true_rows", rows)
         return (group, batched, rows, pad_active)
 
     # -- dispatcher ---------------------------------------------------------
@@ -530,7 +547,7 @@ class ServingEngine:
             except BaseException as e:
                 _faults.record_event("serving.infer", "fallback", e,
                                      requests=len(group))
-                self._stats["single_fallbacks"] += len(group)
+                self._stats.inc("single_fallbacks", len(group))
                 self._deliver_fallback(group, cause=e)
             finally:
                 # task_done pairs every put so drain()'s unfinished-
@@ -578,11 +595,14 @@ class ServingEngine:
                 meta=built[1:], label=type(self._net).__name__)
             self._programs.insert(sig, rec)
         _names, _params, out_struct, mutated_names = rec.meta
-        out_arrays, mut_vals = rec(batched, param_arrays,
-                                   _random.next_key())
-        self._stats["batches"] += 1
-        self._stats["requests"] += len(group)
-        self._stats["coalesced"] += len(group) - 1
+        with _telemetry.span("serving.dispatch", cat="serving",
+                             args={"rows": int(batched[0].shape[0]),
+                                   "requests": len(group)}):
+            out_arrays, mut_vals = rec(batched, param_arrays,
+                                       _random.next_key())
+        self._stats.inc("batches")
+        self._stats.inc("requests", len(group))
+        self._stats.inc("coalesced", len(group) - 1)
 
         transformed = pad_active or len(group) > 1
         if mutated_names and transformed:
@@ -723,7 +743,7 @@ class ServingEngine:
                 label=f"{type(self._net).__name__}[warmup b={b}]")
             self._programs.insert(sig, rec)
             compiled += 1
-        self._stats["warmup_programs"] += compiled
+        self._stats.inc("warmup_programs", compiled)
         return compiled
 
     # -- verify-or-refuse ---------------------------------------------------
@@ -744,7 +764,7 @@ class ServingEngine:
         from .gluon import block as _gb
 
         strict = int(_config.get("MXNET_SERVE_VERIFY")) >= 2
-        self._stats["verify_runs"] += 1
+        self._stats.inc("verify_runs")
         start = 0
         ulp_only = False
         for req in group:
@@ -776,7 +796,7 @@ class ServingEngine:
                         "kernel rounding)")
                 ulp_only = True
         if ulp_only:
-            self._stats["verify_ulp_accepts"] += 1
+            self._stats.inc("verify_ulp_accepts")
             _faults.record_event("serving.infer", "verify_ulp_accept")
 
     def _eager_forward(self, args):

@@ -68,6 +68,7 @@ import numpy as onp
 from . import config as _config
 from . import faults as _faults
 from . import program_store as _pstore
+from . import telemetry as _telemetry
 from .faults import ShedError
 from .serving import BucketPolicy
 
@@ -167,10 +168,26 @@ class PagePool:
         self._storage: Dict[Tuple, List] = {}        # geom -> [k, v]
         self._geom_locks: Dict[Tuple, threading.RLock] = {}
         self.gate = _DispatchGate()
-        self.alloc_count = 0
-        self.free_count = 0
-        self.exhausted_count = 0
+        # pool accounting lives in the telemetry registry (family
+        # 'kv_pool'); the alloc_count/... properties below keep the
+        # attribute reads working
+        self._counts = _telemetry.CounterGroup(
+            _telemetry.instance_name("kv_pool"),
+            ("alloc", "free", "exhausted"),
+            doc="paged KV-cache pool page accounting", family="kv_pool")
         self.high_water = 0
+
+    @property
+    def alloc_count(self) -> int:
+        return self._counts["alloc"]
+
+    @property
+    def free_count(self) -> int:
+        return self._counts["free"]
+
+    @property
+    def exhausted_count(self) -> int:
+        return self._counts["exhausted"]
 
     @property
     def trash(self) -> int:
@@ -183,14 +200,14 @@ class PagePool:
     def alloc(self, n: int) -> List[int]:
         with self._lock:
             if n > len(self._free):
-                self.exhausted_count += 1
+                self._counts.inc("exhausted")
                 raise PagePoolExhausted(
                     f"KV page pool exhausted: need {n} page(s), "
                     f"{len(self._free)} free of {self.pages} "
                     f"(page={self.page} tokens)")
             got = [self._free.pop() for _ in range(n)]
             self._in_use.update(got)
-            self.alloc_count += n
+            self._counts.inc("alloc", n)
             self.high_water = max(self.high_water, len(self._in_use))
             return got
 
@@ -203,7 +220,7 @@ class PagePool:
                         f"{len(self._in_use)})")
                 self._in_use.discard(p)
                 self._free.append(p)
-                self.free_count += 1
+                self._counts.inc("free")
 
     def in_use(self) -> int:
         with self._lock:
@@ -540,12 +557,17 @@ class GenerativeEngine:
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self._latencies: "deque[float]" = deque(maxlen=8192)
-        self._stats = {"requests": 0, "delivered": 0, "tokens_out": 0,
-                       "prefills": 0, "decode_steps": 0,
-                       "decode_row_util": 0,
-                       "shed": 0, "shed_queue": 0, "shed_pool": 0,
-                       "shed_slo": 0, "preempts": 0, "slo_violations": 0,
-                       "warmup_programs": 0, "bucket_fallbacks": 0}
+        # per-model counters live in the telemetry registry under a
+        # unique instance prefix (family 'decode.engine'); stats() still
+        # hands out plain ints via the Mapping view
+        self._stats = _telemetry.CounterGroup(
+            _telemetry.instance_name("decode.engine"),
+            ("requests", "delivered", "tokens_out", "prefills",
+             "decode_steps", "decode_row_util", "shed", "shed_queue",
+             "shed_pool", "shed_slo", "preempts", "slo_violations",
+             "warmup_programs", "bucket_fallbacks"),
+            doc=f"GenerativeEngine counters (model {self.name!r})",
+            family="decode.engine")
         from . import engine as _engine
 
         _engine.register_drainable(self)
@@ -570,7 +592,7 @@ class GenerativeEngine:
                 f"exceeds model.max_seq={self._model.max_seq}")
         eos = eos if eos is not None else self._eos
         req = _GenRequest(toks, int(max_new_tokens), eos)
-        self._stats["requests"] += 1
+        self._stats.inc("requests")
         self._admit(req)                 # may raise ShedError, fail-fast
         with self._cv:
             self._start_thread()
@@ -584,8 +606,20 @@ class GenerativeEngine:
             raise req.error
         self._latencies.append(req.t_done - req.t_enqueue)
         if self._slo > 0 and req.t_done - req.t_enqueue > self._slo:
-            self._stats["slo_violations"] += 1
+            self._stats.inc("slo_violations")
+        # request lifecycle span (admit -> prefill -> decode* -> retire)
+        _telemetry.record_span(
+            "decode.request", "serving",
+            int(req.t_enqueue * 1e9), int(req.t_done * 1e9),
+            args={"model": self.name, "tokens_out": len(req.out),
+                  "preempts": req.preempts})
         return list(req.out)
+
+    def spans(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Recent span records for this process's decode path (prefill
+        dispatches + decode iterations, cat ``decode``) from the unified
+        telemetry span buffer."""
+        return _telemetry.spans(cat="decode", limit=limit)
 
     def stats(self) -> Dict[str, Any]:
         """Per-model counters + request-latency percentiles."""
@@ -645,8 +679,9 @@ class GenerativeEngine:
 
     def _shed(self, kind: str, reason: str,
               cause: Optional[BaseException] = None):
-        self._stats["shed"] += 1
-        self._stats["shed_" + kind] += 1
+        self._stats.inc("shed")
+        self._stats.inc("shed_" + kind)
+        _telemetry.event("shed", self.name, shed_kind=kind, reason=reason)
         _faults.record_event("serving.admit", "shed", cause,
                              model=self.name, kind=kind, reason=reason)
         err = ShedError(f"[{self.name}] {reason}")
@@ -739,8 +774,10 @@ class GenerativeEngine:
                         continue
                     with self._cv:
                         self._queue.remove(req)
-                    self._stats["shed"] += 1
-                    self._stats["shed_pool"] += 1
+                    self._stats.inc("shed")
+                    self._stats.inc("shed_pool")
+                    _telemetry.event("shed", self.name, shed_kind="pool",
+                                     reason="pool exhausted at prefill")
                     _faults.record_event(
                         "serving.admit", "shed", model=self.name,
                         kind="pool", reason="pool exhausted at prefill")
@@ -785,7 +822,7 @@ class GenerativeEngine:
         n = len(prompt)
         bucket = self._policy.bucket(n)
         if bucket is None:                # above the largest bucket
-            self._stats["bucket_fallbacks"] += 1
+            self._stats.inc("bucket_fallbacks")
             bucket = n
         # the position table only spans max_seq (generate() already
         # bounds n itself)
@@ -799,20 +836,23 @@ class GenerativeEngine:
                              onp.int32)
             table[:len(pages)] = pages
             t0 = time.perf_counter()
-            self._pool.gate.acquire(self._priority)
-            try:
-                with self._pool.exclusive(self._geom):
-                    k, v = self._pool.storage(self._geom)
-                    first, k, v = rec(self._params,
-                                      jnp.asarray(tokens),
-                                      jnp.int32(n),
-                                      jnp.asarray(table), k, v)
-                    first = int(first)    # host read = real cost
-                    self._pool.set_storage(self._geom, k, v)
-            finally:
-                self._pool.gate.release()
+            with _telemetry.span("decode.prefill", cat="decode",
+                                 args={"model": self.name,
+                                       "bucket": bucket, "tokens": n}):
+                self._pool.gate.acquire(self._priority)
+                try:
+                    with self._pool.exclusive(self._geom):
+                        k, v = self._pool.storage(self._geom)
+                        first, k, v = rec(self._params,
+                                          jnp.asarray(tokens),
+                                          jnp.int32(n),
+                                          jnp.asarray(table), k, v)
+                        first = int(first)    # host read = real cost
+                        self._pool.set_storage(self._geom, k, v)
+                finally:
+                    self._pool.gate.release()
             self._ema(("prefill", bucket), time.perf_counter() - t0)
-            self._stats["prefills"] += 1
+            self._stats.inc("prefills")
         except BaseException:
             self._pool.free(pages)
             raise
@@ -878,25 +918,28 @@ class GenerativeEngine:
             tables[i, :len(row.pages)] = row.pages
             lengths[i] = row.cached
         t0 = time.perf_counter()
-        self._pool.gate.acquire(self._priority)
-        try:
-            with self._pool.exclusive(self._geom):
-                k, v = self._pool.storage(self._geom)
-                nxt, k, v = rec(self._params, jnp.asarray(tokens),
-                                jnp.asarray(tables),
-                                jnp.asarray(lengths), k, v)
-                nxt = onp.asarray(nxt)    # host read = real cost
-                self._pool.set_storage(self._geom, k, v)
-        finally:
-            self._pool.gate.release()
+        with _telemetry.span("decode.step", cat="decode",
+                             args={"model": self.name,
+                                   "rows": len(self._live)}):
+            self._pool.gate.acquire(self._priority)
+            try:
+                with self._pool.exclusive(self._geom):
+                    k, v = self._pool.storage(self._geom)
+                    nxt, k, v = rec(self._params, jnp.asarray(tokens),
+                                    jnp.asarray(tables),
+                                    jnp.asarray(lengths), k, v)
+                    nxt = onp.asarray(nxt)    # host read = real cost
+                    self._pool.set_storage(self._geom, k, v)
+            finally:
+                self._pool.gate.release()
         self._ema("decode", time.perf_counter() - t0)
-        self._stats["decode_steps"] += 1
-        self._stats["decode_row_util"] += len(self._live)
+        self._stats.inc("decode_steps")
+        self._stats.inc("decode_row_util", len(self._live))
         for i, row in enumerate(self._live):
             row.cached += 1               # pending's KV is now paged
             row.pending = int(nxt[i])
             row.req.out.append(row.pending)
-        self._stats["tokens_out"] += len(self._live)
+        self._stats.inc("tokens_out", len(self._live))
 
     def _ensure_page(self, row: _Row) -> None:
         """The incoming token writes KV at position ``row.cached`` —
@@ -917,8 +960,11 @@ class GenerativeEngine:
                     # failure, never a silent truncation
                     self._live.remove(row)
                     self._release(row)
-                    self._stats["shed"] += 1
-                    self._stats["shed_pool"] += 1
+                    self._stats.inc("shed")
+                    self._stats.inc("shed_pool")
+                    _telemetry.event(
+                        "shed", self.name, shed_kind="pool",
+                        reason="single sequence outgrew pool")
                     _faults.record_event(
                         "serving.admit", "shed", e, model=self.name,
                         kind="pool", reason="single sequence outgrew pool")
@@ -935,7 +981,9 @@ class GenerativeEngine:
         self._live.remove(row)
         self._release(row)
         row.req.preempts += 1
-        self._stats["preempts"] += 1
+        self._stats.inc("preempts")
+        _telemetry.event("preempt", self.name,
+                         tokens_done=len(row.req.out))
         _faults.record_event("serving.admit", "preempt",
                              model=self.name,
                              tokens_done=len(row.req.out))
@@ -1039,7 +1087,7 @@ class GenerativeEngine:
 
     def _deliver(self, row: _Row) -> None:
         self._release(row)               # pages free THIS iteration
-        self._stats["delivered"] += 1
+        self._stats.inc("delivered")
         row.req.t_done = time.monotonic()
         row.req.event.set()
 
@@ -1078,5 +1126,5 @@ class GenerativeEngine:
         if self._programs.lookup(("decode",)) is None:
             self._build_decode()
             compiled += 1
-        self._stats["warmup_programs"] += compiled
+        self._stats.inc("warmup_programs", compiled)
         return compiled

@@ -1,0 +1,521 @@
+"""Unified telemetry: one counter registry, one event bus, one span layer.
+
+The reference frame ships observability as a first-class subsystem
+(``src/profiler/`` lock-free stat queues, engine exec stats, KVStore
+server counters).  Our reproduction instead accreted ~57 ad-hoc counter
+references across 10+ modules — ``cached_step.trace_count``,
+``spmd.reshard_count``, ``metric.host_sync_count``,
+``flash_fallback_count``, ``quantization.pallas_skipped_count()`` — plus
+three disjoint stats surfaces (``program_store.stats()``,
+``GenerativeEngine.stats()``, ``faults.events()``) and a chrome-trace
+profiler the production paths never fed.  Every measured win so far
+started from a counter somebody remembered to check; this module makes
+those measurements ONE queryable, exportable system:
+
+- **Counter registry** — every counter is *declared*
+  (:func:`counter` with namespace-dotted name, docstring, and kind
+  ``cumulative`` / ``gauge`` / ``time``) and every legacy accessor
+  (``cached_step.deferred_read_count()``, ``spmd.reshard_count()``, …)
+  is now a view over it.  :func:`snapshot` / :func:`delta` are cheap,
+  thread-safe, and deterministically ordered (sorted by name), so two
+  identical steady-state runs produce byte-identical deltas —
+  ``tools/check_telemetry.py`` enforces exactly that, plus "no counter
+  ships unregistered or untested".
+
+- **Event bus** — a bounded structured log (:func:`event` /
+  :func:`events`) of runtime *happenings*: retrace, fallback, shed,
+  preempt, cache evict, AMP overflow, and every fault-site action
+  (``faults.record_event`` mirrors here), each stamped with the current
+  train-step index and a monotonic timestamp.  Capacity:
+  ``MXNET_TELEMETRY_EVENTS``.
+
+- **Spans** — duration records (:func:`span` context manager /
+  :func:`record_span` post-hoc) unifying ``profiler.StepTimeline``
+  phases, the compiled train step, serving request admit→dispatch→retire
+  lifecycles, and decode iterations into one chrome-trace timeline:
+  completed spans land in the profiler's trace buffer (the existing
+  ``profiler.dump`` pipe) and, under ``MXNET_TELEMETRY_XLA=1``, inside
+  ``jax.profiler`` device traces via trace annotations.
+
+- **Exporters** — :func:`flush` appends events + a counter snapshot as
+  JSON-lines to ``MXNET_TELEMETRY_DIR`` (the flight recorder;
+  ``engine.waitall()`` flushes), :func:`report` renders the one-call
+  counter table, and bench.py stamps :func:`delta` per lane.
+
+See docs/OBSERVABILITY.md for the namespace map, event taxonomy, span
+hierarchy, and how to add a counter.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from collections.abc import Mapping
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from . import config as _config
+
+__all__ = [
+    "Counter", "CounterGroup", "counter", "gauge", "gauge_fn", "get",
+    "registered", "snapshot", "delta", "reset", "instance_name",
+    "event", "events", "set_step", "current_step", "next_step",
+    "span", "record_span", "spans", "report", "flush",
+    "flight_recorder_path", "KINDS",
+]
+
+# one lock guards registry structure AND every counter value: increments
+# are atomic, and a snapshot taken under it can never observe a torn
+# multi-counter update in progress (tools/check_telemetry.py's
+# thread-safety contract)
+_LOCK = threading.RLock()
+
+KINDS = ("cumulative", "gauge", "time")
+
+
+class Counter:
+    """One declared counter.  ``cumulative`` counters move by
+    :meth:`inc` and are monotonic between resets; ``gauge`` / ``time``
+    counters take :meth:`set` (and are excluded from the deterministic
+    steady-state comparison the CI gate runs)."""
+
+    __slots__ = ("name", "doc", "kind", "family", "_value")
+
+    def __init__(self, name: str, doc: str = "", kind: str = "cumulative",
+                 family: Optional[str] = None):
+        if kind not in KINDS:
+            raise ValueError(f"counter kind {kind!r} not in {KINDS}")
+        self.name = name
+        self.doc = doc
+        self.kind = kind
+        self.family = family
+        self._value = 0.0 if kind == "time" else 0
+
+    def inc(self, n: int = 1) -> None:
+        with _LOCK:
+            self._value += n
+
+    add = inc
+
+    def set(self, v) -> None:
+        with _LOCK:
+            self._value = v
+
+    @property
+    def value(self):
+        with _LOCK:
+            return self._value
+
+    def reset(self) -> None:
+        self.set(0.0 if self.kind == "time" else 0)
+
+    def __int__(self) -> int:
+        return int(self.value)
+
+    def __repr__(self) -> str:
+        return (f"Counter({self.name!r}, kind={self.kind!r}, "
+                f"value={self.value!r})")
+
+
+_COUNTERS: Dict[str, Counter] = {}
+_GAUGE_FNS: Dict[str, Callable[[], Any]] = {}
+_GAUGE_DOCS: Dict[str, str] = {}
+_SEQ: Dict[str, int] = {}
+
+
+def counter(name: str, doc: str = "", kind: str = "cumulative",
+            family: Optional[str] = None) -> Counter:
+    """Declare (idempotently) and return the registry counter ``name``.
+
+    Names are namespace-dotted (``cached_step.deferred_read``,
+    ``program_store.train_step.traces``); dynamic per-instance counters
+    (fault sites, serving engines) pass ``family`` — the stable name the
+    CI gate's test-coverage check keys on."""
+    with _LOCK:
+        c = _COUNTERS.get(name)
+        if c is None:
+            c = _COUNTERS[name] = Counter(name, doc, kind, family)
+        return c
+
+
+def gauge(name: str, doc: str = "",
+          family: Optional[str] = None) -> Counter:
+    """Declare a ``gauge``-kind counter (absolute value, :meth:`set`)."""
+    return counter(name, doc, kind="gauge", family=family)
+
+
+def gauge_fn(name: str, fn: Callable[[], Any], doc: str = "") -> None:
+    """Register a *computed* gauge: ``snapshot()`` calls ``fn()`` for its
+    value (e.g. ``engine.drainables`` = live drainable registrations)."""
+    with _LOCK:
+        _GAUGE_FNS[name] = fn
+        _GAUGE_DOCS[name] = doc
+
+
+def get(name: str) -> Counter:
+    with _LOCK:
+        try:
+            return _COUNTERS[name]
+        except KeyError:
+            raise KeyError(
+                f"undeclared telemetry counter {name!r}; declare it with "
+                "telemetry.counter(name, doc, kind)") from None
+
+
+def registered() -> Dict[str, Dict[str, Any]]:
+    """Metadata of every declared counter (incl. computed gauges)."""
+    with _LOCK:
+        out = {n: {"kind": c.kind, "doc": c.doc, "family": c.family}
+               for n, c in _COUNTERS.items()}
+        for n in _GAUGE_FNS:
+            out.setdefault(n, {"kind": "gauge", "doc": _GAUGE_DOCS[n],
+                               "family": None})
+    return out
+
+
+def instance_name(prefix: str) -> str:
+    """Deterministic per-process instance prefix (``serving.engine0``,
+    ``serving.engine1``, …) for counter groups owned by object
+    instances."""
+    with _LOCK:
+        n = _SEQ.get(prefix, 0)
+        _SEQ[prefix] = n + 1
+    return f"{prefix}{n}"
+
+
+def snapshot() -> Dict[str, Any]:
+    """All counter values, deterministically ordered (sorted by name).
+    Cheap: one lock hold + one dict copy; computed gauges evaluate
+    outside the lock (they must not re-enter the registry)."""
+    with _LOCK:
+        vals = {n: c._value for n, c in _COUNTERS.items()}
+        fns = list(_GAUGE_FNS.items())
+    for n, fn in fns:
+        if n not in vals:
+            try:
+                vals[n] = fn()
+            except Exception:
+                vals[n] = None
+    return dict(sorted(vals.items()))
+
+
+def delta(base: Mapping, current: Optional[Mapping] = None
+          ) -> Dict[str, Any]:
+    """Counter movement since ``base`` (a prior :func:`snapshot`):
+    cumulative/time counters subtract, gauges report their current
+    value.  Counters born after ``base`` delta from 0.  Ordering is
+    deterministic (sorted)."""
+    cur = snapshot() if current is None else current
+    kinds = registered()
+    out: Dict[str, Any] = {}
+    for name in sorted(cur):
+        kind = kinds.get(name, {}).get("kind", "cumulative")
+        v = cur[name]
+        if kind == "gauge" or v is None:
+            out[name] = v
+            continue
+        b = base.get(name, 0) or 0
+        out[name] = v - b
+    return out
+
+
+def reset(prefix: Optional[str] = None) -> None:
+    """Zero declared counters (tests/benchmarks) — all of them, or only
+    those whose name starts with ``prefix``.  Events and spans are
+    untouched (clear those via their own buffers)."""
+    with _LOCK:
+        for n, c in _COUNTERS.items():
+            if prefix is None or n.startswith(prefix):
+                c._value = 0.0 if c.kind == "time" else 0
+
+
+class CounterGroup(Mapping):
+    """A fixed-key set of registry counters under one dotted prefix —
+    the per-instance ``_stats`` dicts of ``ServingEngine`` /
+    ``GenerativeEngine`` / ``PagePool`` and the per-site fault counters,
+    kept dict-compatible (``dict(group)`` / ``group["k"]`` / iteration)
+    so every existing ``stats()`` caller and test sees plain ints, while
+    the values live in the registry and ride :func:`snapshot`.
+
+    ``group.inc(k)`` is the atomic increment; ``group[k] = v`` sets
+    (``+=`` works but is get-then-set — use :meth:`inc` on paths that
+    race)."""
+
+    __slots__ = ("prefix", "_counters")
+
+    def __init__(self, prefix: str, keys, doc: str = "",
+                 kind: str = "cumulative", family: Optional[str] = None):
+        self.prefix = prefix
+        self._counters = {k: counter(f"{prefix}.{k}", doc, kind, family)
+                          for k in keys}
+
+    def __getitem__(self, k):
+        return self._counters[k].value
+
+    def __setitem__(self, k, v) -> None:
+        self._counters[k].set(v)
+
+    def inc(self, k, n: int = 1) -> None:
+        self._counters[k].inc(n)
+
+    def __iter__(self) -> Iterator:
+        return iter(self._counters)
+
+    def __len__(self) -> int:
+        return len(self._counters)
+
+    def reset(self) -> None:
+        for c in self._counters.values():
+            c.reset()
+
+
+# ---------------------------------------------------------------------------
+# step index (stamped onto events; advanced by cached_step.TrainStep)
+# ---------------------------------------------------------------------------
+_STEP: List[Optional[int]] = [None]
+
+
+def set_step(i: Optional[int]) -> None:
+    """Pin the current train-step index (events stamp it)."""
+    _STEP[0] = i
+
+
+def next_step() -> int:
+    """Advance and return the process-wide step index (TrainStep calls
+    this once per step; serving/decode events inherit whatever step the
+    co-resident trainer is on, or None when nothing trains)."""
+    with _LOCK:
+        _STEP[0] = 0 if _STEP[0] is None else _STEP[0] + 1
+        return _STEP[0]
+
+
+def current_step() -> Optional[int]:
+    return _STEP[0]
+
+
+# ---------------------------------------------------------------------------
+# event bus
+# ---------------------------------------------------------------------------
+# taxonomy (docs/OBSERVABILITY.md): retrace | fallback | shed | preempt |
+# cache_evict | amp_overflow | fault | <caller-defined>
+_EVENTS: "deque" = deque(
+    maxlen=max(1, int(_config.get("MXNET_TELEMETRY_EVENTS"))))
+_EVENTS_EMITTED = counter(
+    "telemetry.events", "structured events emitted through the bus "
+    "(the bounded buffer keeps the newest MXNET_TELEMETRY_EVENTS)")
+_EVT_LOCK = threading.Lock()
+_FLUSH_SEQ = [0]          # bus sequence already flushed to disk
+
+
+_RESERVED_EVENT_KEYS = ("kind", "name", "step", "t_us", "seq")
+
+
+def event(kind: str, name: str, /, step: Any = "auto", **fields) -> None:
+    """Append one structured event: ``kind`` from the taxonomy, ``name``
+    the subsystem/site, ``step`` the train-step index (default: the
+    current one), plus a monotonic microsecond timestamp.  Extra fields
+    whose names collide with the bus keys are prefixed ``x_``."""
+    ev: Dict[str, Any] = {
+        "kind": kind, "name": name,
+        "step": current_step() if step == "auto" else step,
+        "t_us": time.monotonic_ns() // 1000,
+    }
+    for k, v in fields.items():
+        if v is not None:
+            ev["x_" + k if k in _RESERVED_EVENT_KEYS else k] = v
+    with _EVT_LOCK:
+        _EVENTS_EMITTED.inc()
+        ev["seq"] = int(_EVENTS_EMITTED.value)
+        _EVENTS.append(ev)
+
+
+def events(kind: Optional[str] = None,
+           name: Optional[str] = None) -> List[Dict[str, Any]]:
+    with _EVT_LOCK:
+        evs = list(_EVENTS)
+    if kind is not None:
+        evs = [e for e in evs if e["kind"] == kind]
+    if name is not None:
+        evs = [e for e in evs if e["name"] == name]
+    return evs
+
+
+def clear_events() -> None:
+    """Drop buffered events (tests); the emitted counter is untouched."""
+    with _EVT_LOCK:
+        _EVENTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+_SPANS: "deque" = deque(maxlen=2048)
+_SPANS_RECORDED = counter(
+    "telemetry.spans", "completed spans recorded (train_step / "
+    "step_phase / serving / decode / user categories)")
+
+
+def record_span(name: str, cat: str, t0_ns: int, t1_ns: int,
+                step: Any = "auto",
+                args: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Record one completed span post-hoc (the lifecycle spans whose
+    endpoints were timed elsewhere — serving admit→retire).  Also emits
+    into the profiler's chrome-trace buffer when collection is running,
+    so every span category lands in the one ``profiler.dump``
+    timeline."""
+    rec = {
+        "name": name, "cat": cat,
+        "step": current_step() if step == "auto" else step,
+        "t0_us": t0_ns // 1000,
+        "dur_us": max((t1_ns - t0_ns) // 1000, 1),
+        "thread": threading.get_ident(),
+    }
+    if args:
+        rec["args"] = dict(args)
+    _SPANS_RECORDED.inc()
+    _SPANS.append(rec)
+    from . import profiler as _profiler
+
+    _profiler._emit(name, cat, "X", ts=rec["t0_us"], dur=rec["dur_us"],
+                    args=rec.get("args"))
+    return rec
+
+
+def _xla_annotations_on() -> bool:
+    return bool(_config.get("MXNET_TELEMETRY_XLA"))
+
+
+class span:
+    """Context-manager span: times the enclosed work, records it (see
+    :func:`record_span`), and — with ``MXNET_TELEMETRY_XLA=1`` — wraps
+    it in a ``jax.profiler`` trace annotation so the host-side bracket
+    shows up inside XLA device profiles."""
+
+    __slots__ = ("name", "cat", "args", "_t0", "_ann")
+
+    def __init__(self, name: str, cat: str = "user",
+                 args: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.cat = cat
+        self.args = dict(args) if args else None
+        self._t0 = None
+        self._ann = None
+
+    def annotate(self, **kw) -> "span":
+        """Attach/extend span args mid-flight (recorded at exit)."""
+        if self.args is None:
+            self.args = {}
+        self.args.update(kw)
+        return self
+
+    def __enter__(self) -> "span":
+        self._t0 = time.perf_counter_ns()
+        if _xla_annotations_on():
+            try:
+                import jax
+
+                self._ann = jax.profiler.TraceAnnotation(
+                    f"{self.cat}:{self.name}")
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            finally:
+                self._ann = None
+        if self._t0 is not None:
+            record_span(self.name, self.cat, self._t0,
+                        time.perf_counter_ns(), args=self.args)
+            self._t0 = None
+
+
+def spans(cat: Optional[str] = None,
+          limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Recent completed span records, oldest first (bounded buffer)."""
+    out = list(_SPANS)
+    if cat is not None:
+        out = [s for s in out if s["cat"] == cat]
+    if limit is not None:
+        out = out[-int(limit):]
+    return out
+
+
+def clear_spans() -> None:
+    _SPANS.clear()
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def flight_recorder_path() -> Optional[str]:
+    """Where :func:`flush` writes (``MXNET_TELEMETRY_DIR`` set), else
+    None (recorder off)."""
+    d = _config.get("MXNET_TELEMETRY_DIR")
+    if not d:
+        return None
+    return os.path.join(os.path.expanduser(d),
+                        f"telemetry-{os.getpid()}.jsonl")
+
+
+_FLUSH_LOCK = threading.Lock()
+
+
+def flush(snapshot_too: bool = True) -> Optional[str]:
+    """Flight recorder: append every event not yet flushed (and,
+    default, one ``{"kind": "snapshot"}`` record of all counters) as
+    JSON-lines under ``MXNET_TELEMETRY_DIR``.  No-op returning None when
+    the knob is unset.  ``engine.waitall()`` calls this, so a drained
+    process always has its telemetry on disk."""
+    path = flight_recorder_path()
+    if path is None:
+        return None
+    with _FLUSH_LOCK:
+        with _EVT_LOCK:
+            pending = [e for e in _EVENTS if e["seq"] > _FLUSH_SEQ[0]]
+            if pending:
+                _FLUSH_SEQ[0] = pending[-1]["seq"]
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            for e in pending:
+                f.write(json.dumps(e) + "\n")
+            if snapshot_too:
+                f.write(json.dumps({
+                    "kind": "snapshot", "step": current_step(),
+                    "t_us": time.monotonic_ns() // 1000,
+                    "counters": snapshot()}) + "\n")
+    return path
+
+
+def report(prefix: Optional[str] = None, nonzero_only: bool = True) -> str:
+    """One-call counter table (name, kind, value), grouped by top-level
+    namespace — the human end of the registry."""
+    snap = snapshot()
+    kinds = registered()
+    lines = [f"{'Counter':<52}{'Kind':>12}{'Value':>16}", "=" * 80]
+    last_ns = None
+    for name, val in snap.items():
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        if nonzero_only and not val:
+            continue
+        ns = name.split(".", 1)[0]
+        if ns != last_ns:
+            if last_ns is not None:
+                lines.append("-" * 80)
+            last_ns = ns
+        kind = kinds.get(name, {}).get("kind", "?")
+        if isinstance(val, float):
+            lines.append(f"{name:<52}{kind:>12}{val:>16.3f}")
+        else:
+            lines.append(f"{name:<52}{kind:>12}{val!s:>16}")
+    lines.append("=" * 80)
+    lines.append(f"{len(snap)} declared counters; "
+                 f"{len(events())} buffered events; "
+                 f"{len(spans())} buffered spans")
+    return "\n".join(lines)

@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Telemetry CI gate (the observability analog of check_fault_sites):
+
+1. **No unregistered counters.**  Every public ``*_count``-style
+   accessor in ``mxnet_tpu/`` must be a view over a declared telemetry
+   registry counter — the accessor's base name must match the final
+   segment of a registered counter name (``deferred_read_count`` →
+   ``cached_step.deferred_read``, ``trace_count`` →
+   ``program_store.*.traces``).  Raw module-global counter state
+   (``_X_COUNT = 0``) is forbidden outright.
+
+2. **No untested counters.**  Every registered counter's name — or, for
+   dynamic per-site/per-instance counters, its declared ``family`` —
+   must appear as a literal in at least one file under ``tests/``.
+
+3. **Deterministic steady-state snapshot.**  Two identical 3-step
+   windows of a warmed compiled TrainStep must produce byte-identical
+   ``telemetry.delta()`` results over the deterministic (cumulative)
+   counters — a nondeterministic counter in the steady state is a
+   measurement you can't regress against.
+
+4. **Chrome-trace export.**  One compiled train step + one decode batch
+   recorded under the profiler must dump valid chrome-trace JSON
+   carrying >= 3 distinct span categories (train_step / decode /
+   serving / step_phase) — the unified-timeline acceptance bar.
+
+Exit code 0 = all gates green.  Usage:
+``python tools/check_telemetry.py [repo_root]`` (run by the suite via
+tests/test_telemetry.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import tempfile
+from typing import Dict, List, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# accessor defs: `def foo_count(` at module or class level, public only
+_ACCESSOR_RE = re.compile(
+    r"^\s*def ([a-zA-Z][a-zA-Z0-9_]*_count)\s*\(", re.M)
+# raw module-global counter state, the pre-telemetry idiom
+_RAW_GLOBAL_RE = re.compile(r"^_[A-Z0-9_]*_COUNT[S]?\s*=\s*\d", re.M)
+# raw PUBLIC instance-attribute counter state (private `self._x_count`
+# attrs are sequence/id allocators by convention, not metrics)
+_RAW_ATTR_RE = re.compile(r"self\.([a-z0-9][a-z0-9_]*_count)\s*=\s*\d")
+# attribute names that are loop-local bookkeeping, not metrics
+_ATTR_ALLOW = {"last_count", "step_count"}
+# accessors that RESET rather than read (reset_host_sync_count)
+_ACCESSOR_SKIP_PREFIXES = ("reset_",)
+
+
+def _py_files(root: str):
+    for dirpath, _dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def collect_accessors(pkg_dir: str) -> Dict[str, Set[str]]:
+    """Accessor base name (minus ``_count``) -> files declaring it."""
+    out: Dict[str, Set[str]] = {}
+    for path in _py_files(pkg_dir):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+        for m in _ACCESSOR_RE.finditer(text):
+            name = m.group(1)
+            if name.startswith(_ACCESSOR_SKIP_PREFIXES):
+                continue
+            out.setdefault(name[: -len("_count")], set()).add(rel)
+    return out
+
+
+def collect_raw_state(pkg_dir: str) -> List[str]:
+    """Forbidden pre-registry counter state still in the tree."""
+    bad: List[str] = []
+    for path in _py_files(pkg_dir):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+        for m in _RAW_GLOBAL_RE.finditer(text):
+            bad.append(f"{rel}: {m.group(0).strip()}")
+        for m in _RAW_ATTR_RE.finditer(text):
+            if m.group(1) not in _ATTR_ALLOW:
+                bad.append(f"{rel}: {m.group(0).strip()}")
+    return bad
+
+
+def _base_matches_segment(base: str, seg: str) -> bool:
+    return seg in (base, base + "s", base + "es")
+
+
+def check_registered(accessors: Dict[str, Set[str]],
+                     registry: Dict[str, dict]) -> List[str]:
+    """Accessor bases with NO matching registered counter."""
+    segs = {n.rsplit(".", 1)[-1] for n in registry}
+    missing = []
+    for base, files in sorted(accessors.items()):
+        if not any(_base_matches_segment(base, s) for s in segs):
+            missing.append(f"{base}_count (declared in "
+                           f"{', '.join(sorted(files))})")
+    return missing
+
+
+def check_tested(registry: Dict[str, dict], tests_dir: str) -> List[str]:
+    """Registered counters whose name/family appears in NO test file.
+    Counters under ``test.`` are fixtures the suite itself registered
+    while this gate runs in-process — skipped."""
+    needles: Dict[str, str] = {}
+    for name, meta in registry.items():
+        if name.startswith("test."):
+            continue
+        needles[name] = meta.get("family") or name
+    blob = []
+    for path in _py_files(tests_dir):
+        with open(path, encoding="utf-8") as f:
+            blob.append(f.read())
+    blob = "\n".join(blob)
+    missing = sorted({f"{n} (family {needle!r})" if needle != n else n
+                      for n, needle in needles.items()
+                      if needle not in blob})
+    return missing
+
+
+# ---------------------------------------------------------------------------
+# runtime checks (CPU, tiny shapes)
+# ---------------------------------------------------------------------------
+# counter namespaces a steady-state compiled train step may touch; the
+# reproducibility gate compares EXACTLY these so a background thread
+# from an unrelated co-resident test cannot flake the check
+_DETERMINISTIC_PREFIXES = ("program_store.train_step.", "cached_step.",
+                           "spmd.", "sharding.", "metric.", "fused.",
+                           "ndarray.", "faults.", "telemetry.")
+
+
+def _train_fixture():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d1 = nn.Dense(16, in_units=8, activation="relu")
+            self.out = nn.Dense(4, in_units=16)
+
+        def forward(self, x):
+            return self.out(self.d1(x))
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    step = trainer.compile_step(net, lambda n, x, y: ((n(x) - y) ** 2)
+                                .mean())
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 8).astype(onp.float32))
+    y = mx.nd.array(rng.randn(8, 4).astype(onp.float32))
+    return step, x, y
+
+
+def _steady_delta(telemetry, step, x, y, n=3) -> Dict[str, object]:
+    base = telemetry.snapshot()
+    for _ in range(n):
+        loss = step(x, y, batch_size=8)
+    loss.asnumpy()
+    kinds = telemetry.registered()
+    return {k: v for k, v in telemetry.delta(base).items()
+            if k.startswith(_DETERMINISTIC_PREFIXES)
+            and kinds.get(k, {}).get("kind") == "cumulative"}
+
+
+def check_deterministic_snapshot() -> List[str]:
+    from mxnet_tpu import telemetry
+
+    step, x, y = _train_fixture()
+    for _ in range(2):                    # warm: trace + compile + AOT
+        loss = step(x, y, batch_size=8)
+    loss.asnumpy()
+    if step.last_fallback_reason is not None:
+        return [f"TrainStep fell back eager: {step.last_fallback_reason}"]
+    d1 = _steady_delta(telemetry, step, x, y)
+    d2 = _steady_delta(telemetry, step, x, y)
+    if d1 != d2:
+        diff = {k: (d1.get(k), d2.get(k))
+                for k in set(d1) | set(d2) if d1.get(k) != d2.get(k)}
+        return [f"steady-state TrainStep delta not reproducible: {diff}"]
+    if d1.get("program_store.train_step.dispatches") != 3:
+        return ["steady-state window did not dispatch 3 compiled steps: "
+                f"{d1}"]
+    return []
+
+
+def check_chrome_trace() -> List[str]:
+    """One compiled train step + one decode batch under the profiler ->
+    the dump must be valid JSON with >= 3 span categories."""
+    import numpy as onp
+
+    from mxnet_tpu import profiler, serving_decode, telemetry
+
+    fd, path = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    try:
+        profiler.set_config(filename=path)
+        profiler.set_state("run")
+        step, x, y = _train_fixture()
+        tl = profiler.StepTimeline()
+        with tl.phase("dispatch"):
+            step(x, y, batch_size=8).asnumpy()
+        tl.step()
+        eng = serving_decode.GenerativeEngine(
+            serving_decode.TinyCausalLM(),
+            pool=serving_decode.PagePool(pages=64, page=8), max_rows=2)
+        try:
+            eng.generate(onp.asarray([3, 1, 4]), max_new_tokens=2)
+        finally:
+            eng.close()
+        profiler.set_state("stop")
+        out = profiler.dump()
+        with open(out) as f:
+            trace = json.load(f)          # must be valid JSON
+        span_cats = {e["cat"] for e in trace["traceEvents"]
+                     if e.get("ph") == "X"}
+        want = {"train_step", "decode", "serving", "step_phase"}
+        got = span_cats & want
+        if len(got) < 3:
+            return [f"chrome trace carries {len(got)} span categories "
+                    f"{sorted(got)} (need >= 3 of {sorted(want)}); all "
+                    f"cats: {sorted(span_cats)}"]
+        n_spans = len(telemetry.spans())
+        if n_spans < 3:
+            return [f"telemetry span buffer has only {n_spans} records"]
+    finally:
+        os.unlink(path)
+    return []
+
+
+def main(root: str = None) -> int:
+    root = root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(root, "mxnet_tpu")
+    tests = os.path.join(root, "tests")
+    failures: List[Tuple[str, List[str]]] = []
+
+    accessors = collect_accessors(pkg)
+    if not accessors:
+        print("check_telemetry: no *_count accessors found under "
+              f"{pkg} — regex or layout broke", file=sys.stderr)
+        return 1
+
+    raw = collect_raw_state(pkg)
+    if raw:
+        failures.append(("raw (non-registry) counter state", raw))
+
+    # import every counter-declaring surface, then read the registry
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu import (cached_step, engine, metric,  # noqa: F401
+                           profiler, program_store, serving,
+                           serving_decode, telemetry)
+    from mxnet_tpu.contrib import quantization  # noqa: F401
+    from mxnet_tpu.models import transformer_lm  # noqa: F401
+    from mxnet_tpu.ops import nn as _ops_nn  # noqa: F401
+    from mxnet_tpu.optimizer import fused  # noqa: F401
+    from mxnet_tpu.parallel import sharding, spmd  # noqa: F401
+
+    # the runtime checks run FIRST: they instantiate the per-instance
+    # counter families (kv_pool, decode.engine) the registry checks
+    # then see
+    failures.extend(("deterministic steady-state snapshot", [m])
+                    for m in check_deterministic_snapshot())
+    failures.extend(("chrome-trace export", [m])
+                    for m in check_chrome_trace())
+
+    registry = telemetry.registered()
+    unregistered = check_registered(accessors, registry)
+    if unregistered:
+        failures.append(("accessors with no registered counter",
+                         unregistered))
+    untested = check_tested(registry, tests)
+    if untested:
+        failures.append(("registered counters never named in a test",
+                         untested))
+
+    if failures:
+        print("check_telemetry: FAILED", file=sys.stderr)
+        for what, items in failures:
+            print(f"  [{what}]", file=sys.stderr)
+            for it in items:
+                print(f"    {it}", file=sys.stderr)
+        return 1
+    print(f"check_telemetry: {len(accessors)} accessors, "
+          f"{len(registry)} registered counters, deterministic "
+          "steady-state delta, chrome trace >= 3 span categories")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))

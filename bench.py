@@ -831,6 +831,51 @@ def lane_multichip(on_cpu: bool) -> dict:
     return c
 
 
+def lane_elastic(on_cpu: bool) -> dict:
+    """Elastic-recovery lane (drill-driven, ROADMAP 4c): runs
+    benchmark/elastic_drill.py's sigterm_drain drill — a real SIGTERM
+    mid compiled-SPMD-step with async checkpointing, then a restart
+    warm-started from the persistent compile cache — and carries the
+    recovery-time budget into lanes[].  The value is recovery_wall_s
+    (restart process start -> first resumed step); steps_replayed,
+    drain_s, and the restart's disk hits / fresh compiles ride along.
+    The drill children always run the CPU virtual mesh (recovery
+    SEMANTICS are platform-independent; on-chip recovery seconds come
+    from the same drill run against a TPU cache dir)."""
+    import json as _json
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmark", "elastic_drill.py")
+    r = subprocess.run([sys.executable, "-u", script, "--json"],
+                       capture_output=True, text=True,
+                       timeout=600, env=dict(os.environ))
+    if r.returncode != 0:
+        raise RuntimeError(f"elastic lane failed:\n{r.stderr[-1500:]}\n"
+                           f"{r.stdout[-500:]}")
+    c = _json.loads(r.stdout.strip().splitlines()[-1])["elastic"]
+    _progress(f"elastic: recovery {c['recovery_wall_s']:.2f}s wall "
+              f"({c['recovery_s']*1e3:.1f}ms restore), "
+              f"{c['steps_replayed']} replayed, drain "
+              f"{c['drain_s']*1e3:.1f}ms, {c['fresh_compiles']} fresh "
+              f"compiles / {c['disk_hits']} disk hits on restart")
+    return {
+        "metric": "elastic_recovery_wall_s",
+        "value": c["recovery_wall_s"],
+        "unit": "s",
+        "vs_baseline": 0.0,
+        "scenario": c["scenario"],
+        "recovery_s": c["recovery_s"],
+        "steps_replayed": c["steps_replayed"],
+        "drain_s": c["drain_s"],
+        "fresh_compiles": c["fresh_compiles"],
+        "disk_hits": c["disk_hits"],
+        "restored_at": c["restored_at"],
+        "exit_code_c1": c["exit_code_c1"],
+        "telemetry": c.get("telemetry"),
+        "platform": c["platform"],
+    }
+
+
 def _resolve_lane(name):
     """Lane key -> (callable(on_cpu) -> lane dict, metric name).  Any model
     zoo name works, with optional _bf16 / _int8 suffixes."""
@@ -846,6 +891,8 @@ def _resolve_lane(name):
         return lane_pipeline, "pipeline_device_idle_gap_us"
     if name == "multichip":
         return lane_multichip, "multichip_img_s_per_chip"
+    if name == "elastic":
+        return lane_elastic, "elastic_recovery_wall_s"
     if name.endswith("_int8"):
         model = name[: -len("_int8")] or "resnet50_v1"
         return (lambda on_cpu, m=model: lane_int8(on_cpu, m),
@@ -862,7 +909,7 @@ def _resolve_lane(name):
 # compile — its XLA program also warms the compile cache for fp32); int8
 # last (longest end-to-end: calibration + conversion + compile).
 LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "train_step",
-              "infer", "decode", "pipeline", "multichip",
+              "infer", "decode", "pipeline", "multichip", "elastic",
               "resnet50_v1_int8"]
 
 # generous-but-bounded per-lane wall budgets (seconds) on the device;
@@ -871,7 +918,7 @@ LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "train_step",
 _LANE_BUDGET = {"resnet50_v1_bf16": 600.0, "resnet50_v1": 600.0,
                 "bert": 540.0, "train_step": 240.0, "infer": 240.0,
                 "decode": 300.0, "pipeline": 240.0, "multichip": 420.0,
-                "resnet50_v1_int8": 900.0}
+                "elastic": 300.0, "resnet50_v1_int8": 900.0}
 _CPU_LANE_BUDGET = 420.0
 
 
@@ -1169,6 +1216,8 @@ def _metric_to_lane(metric: str):
         return "pipeline"
     if metric == "multichip_img_s_per_chip":
         return "multichip"
+    if metric == "elastic_recovery_wall_s":
+        return "elastic"
     for suffix, lane_sfx in (("_int8_infer_throughput_per_chip", "_int8"),
                              ("_bf16_train_throughput_per_chip", "_bf16"),
                              ("_train_throughput_per_chip", "")):

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Elastic-recovery bench lane: measure what a preemption actually costs.
+
+Runs the `mxnet_tpu.drills` sigterm_drain scenario — a real SIGTERM mid
+compiled-SPMD-step with async checkpointing and a depth-k prefetcher,
+then a restart warm-started from the persistent compile cache — and
+reports the recovery-time budget numbers ROADMAP 4(c) asks for:
+
+- ``recovery_s``       checkpoint restore (degradation walk + load +
+                       re-placement)
+- ``recovery_wall_s``  restart process start -> first resumed step done
+- ``steps_replayed``   steps re-executed after restore (graceful drain:
+                       0 by contract)
+- ``drain_s``          SIGTERM -> queues drained + final blocking save
+- ``fresh_compiles`` / ``disk_hits``  restart's persistent-cache
+                       behavior (warm recovery compiles nothing fresh)
+
+``--json`` emits one machine-readable line (the bench.py ``elastic``
+lane contract); the full namespaced telemetry snapshot of the RESUMED
+process rides along like every other lane's.  Standalone:
+``python benchmark/elastic_drill.py --json``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--scenario", default="sigterm_drain")
+    ap.add_argument("--root", default=None,
+                    help="drill workdir (default: fresh temp dir)")
+    a = ap.parse_args()
+
+    from mxnet_tpu.drills import run_drill
+
+    root = a.root or tempfile.mkdtemp(prefix="mxnet-bench-elastic-")
+    rep = run_drill(a.scenario, root)
+    out = {
+        "elastic": {
+            "scenario": rep["scenario"],
+            "ok": rep["ok"],
+            "failures": rep["failures"],
+            "recovery_s": rep.get("recovery_s"),
+            "recovery_wall_s": rep.get("recovery_wall_s"),
+            "steps_replayed": rep.get("steps_replayed"),
+            "drain_s": rep.get("drain_s"),
+            "fresh_compiles": rep.get("fresh_compiles"),
+            "disk_hits": rep.get("disk_hits"),
+            "restored_at": rep.get("restored_at"),
+            "exit_code_c1": rep.get("exit_code_c1"),
+            "leaked_tmp": rep.get("leaked_tmp", []),
+            "drill_wall_s": rep.get("drill_wall_s"),
+            "platform": "cpu",   # drill children force JAX_PLATFORMS=cpu
+            "telemetry": rep.get("resume_telemetry"),
+        }
+    }
+    if a.json:
+        print(json.dumps(out, default=str))
+    else:
+        pretty = dict(out["elastic"])
+        pretty.pop("telemetry", None)
+        print(json.dumps(pretty, indent=2, default=str))
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

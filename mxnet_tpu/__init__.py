@@ -75,6 +75,8 @@ _LAZY = {
     "init": ".initializer",
     "metric": ".metric",
     "profiler": ".profiler",
+    "preemption": ".preemption",
+    "drills": ".drills",
     "amp": ".amp",
     "np": ".numpy",
     "npx": ".numpy_extension",

@@ -359,6 +359,27 @@ declare("MXNET_ELASTIC_BACKOFF", float, 0.0,
         "restore-and-resume restarts (capped at MXNET_RETRY_BACKOFF_MAX); "
         "0 = restart immediately", validator=lambda v: v >= 0,
         subsystem="faults", cached=False)
+declare("MXNET_PREEMPTION_GRACE_S", float, 30.0,
+        "Preemption-notice grace budget (seconds): after SIGTERM/SIGINT "
+        "the preemption handler (preemption.install) stops admission, "
+        "drains every async queue (engine.waitall: prefetch, deferred "
+        "AMP, device metrics, checkpoint writers, serving/decode "
+        "queues), forces a final blocking checkpoint, and exits with "
+        "MXNET_PREEMPTION_EXIT_CODE — a watchdog force-exits if the "
+        "drain has not finished inside this budget (a pod scheduler's "
+        "SIGKILL would anyway).  0 = no watchdog (drain may take as "
+        "long as it takes).", validator=lambda v: v >= 0,
+        subsystem="faults", cached=False)
+declare("MXNET_PREEMPTION_EXIT_CODE", int, 83,
+        "Exit code of a SUCCESSFUL graceful preemption drain (flag -> "
+        "waitall -> final blocking checkpoint): a supervisor/drill "
+        "seeing this code knows the newest checkpoint is the exact "
+        "pre-signal state and restart-and-replay loses zero steps.  A "
+        "drain that FAILED exits 1 instead (never trust the "
+        "distinguished code after a failed drain); the watchdog "
+        "force-exit uses this code + 1.",
+        validator=lambda v: 1 <= v <= 120, subsystem="faults",
+        cached=False)
 declare("MXNET_SHAPE_BUCKETS", str, "pow2",
         "Shape-bucket grid for padded compilation (serving.BucketPolicy): "
         "'pow2' (default — round a dynamic axis up to the next power of "
@@ -491,7 +512,7 @@ declare("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
 declare("BENCH_MODEL", str, "all",
         "bench.py lane selection: 'all' (every lane into one JSON line) "
         "or one of <zoo-name>[_bf16|_int8] | bert | train_step | infer "
-        "| decode | pipeline | multichip",
+        "| decode | pipeline | multichip | elastic",
         subsystem="bench")
 declare("BENCH_BATCH", int, None, "bench.py batch size override",
         subsystem="bench")

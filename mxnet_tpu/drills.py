@@ -1,0 +1,891 @@
+"""Deterministic end-to-end preemption/recovery drills.
+
+ROADMAP item 4(c), executed: the fault framework (PR 2), the compiled
+SPMD train step (PRs 3/6), the async engine (PR 5), the persistent
+compile cache (PR 7), the generative engine (PR 8), and the preemption
+subsystem (`mxnet_tpu/preemption.py`) have individually-tested recovery
+paths — this module KILLS real processes running all of them at once
+and measures what recovery actually costs (arXiv:2008.01040's
+"measure, don't guess", applied to failure instead of throughput).
+
+Every scenario is a scripted subprocess drill, fully deterministic — no
+parent-side signal races: children trigger their own SIGTERM/SIGKILL at
+a scripted step (a real ``os.kill`` to themselves, delivered through
+the real installed handler), batches derive from the step index, and
+greedy decode is token-exact, so a drill either reproduces bit-for-bit
+or fails loudly:
+
+- ``sigterm_drain`` — SIGTERM mid-step under the compiled SPMD
+  ``TrainStep`` (4-device mesh) with the depth-k prefetcher and the
+  async checkpoint writer running: the child drains, force-saves the
+  last completed step, and exits with the distinguished code; the
+  restarted child resumes with **0 steps replayed** and a loss
+  trajectory bit-exact vs the uninterrupted reference.
+- ``sigkill_between_saves`` — SIGKILL (no grace, no drain) between
+  periodic saves: recovery restores the newest complete checkpoint,
+  replays the gap deterministically (replayed losses bit-equal the
+  first run's), leaves 0 temp-file litter.
+- ``topology_change`` — checkpoint under a 4-device mesh, restart under
+  a 2-device mesh: ``restore(like=)`` re-places bit-exactly (params
+  digest match), the resumed 2-device trajectory is deterministic (two
+  resumes bit-equal — run twice, the second proving warm-cache
+  recovery performs 0 fresh compiles) and tracks the 4-device reference
+  within float tolerance (cross-mesh reduction order differs by ulps;
+  same-mesh drills assert bit-exact).
+- ``corrupt_latest`` — flip one payload byte in the newest checkpoint
+  (its sha256 sidecar now disagrees): restore degrades whole-step to
+  the previous complete one, counted in ``checkpoint.digest_mismatches``,
+  and the longer replay still lands bit-exact.
+- ``decode_drain`` — SIGTERM mid-stream under the continuous-batching
+  ``GenerativeEngine``: in-flight rows decode to completion (token-exact
+  vs the eager oracle), queued requests come back as typed ``draining``
+  sheds, 0 KV pages leak, and a second process serves the shed
+  requests token-exactly.
+
+``run_drill(name, root)`` orchestrates one scenario (children share
+``<root>/pcache`` — the ``MXNET_PROGRAM_CACHE_DIR`` disk cache — and
+the memoized reference run) and returns a report with the measured
+**recovery-time budget**: ``recovery_s`` (checkpoint restore),
+``recovery_wall_s`` (process start -> first resumed step),
+``steps_replayed``, ``drain_s``, and the restart's disk
+``fresh_compiles`` (0 when the cache is warm — the PR-7 promise).
+``tools/check_recovery_budget.py`` gates all of it in CI; bench.py's
+``elastic`` lane stamps the numbers into the artifact.
+
+Child entry: ``python -m mxnet_tpu.drills train|decode ...`` (the
+orchestrator builds the exact argv; children force ``JAX_PLATFORMS=cpu``
+with an ``--xla_force_host_platform_device_count`` virtual mesh).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SCENARIOS", "run_drill", "main"]
+
+SCENARIOS = ("sigterm_drain", "sigkill_between_saves", "topology_change",
+             "corrupt_latest", "decode_drain")
+
+# the scripted workload every train drill shares
+N_STEPS = 24
+SAVE_EVERY = 4
+ROWS = 16
+HALF = N_STEPS // 2
+# cross-mesh tolerance: 4-dev vs 2-dev all-reduce order differs by ulps
+# per step (same-mesh comparisons are bit-exact; see test_spmd_step's
+# sharded-vs-single-chip contract)
+TOPO_RTOL = 1e-4
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# shared child workload pieces (import mxnet_tpu lazily — the parent
+# orchestrator must stay import-light)
+# ---------------------------------------------------------------------------
+
+def _host_batch(i: int):
+    import numpy as onp
+
+    rng = onp.random.RandomState(10_000 + int(i))
+    return (rng.randn(ROWS, 8).astype(onp.float32),
+            rng.randn(ROWS, 4).astype(onp.float32))
+
+
+def _drill_net(seed: int = 0):
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d1 = nn.Dense(16, in_units=8, activation="relu")
+            self.d2 = nn.Dense(4, in_units=16)
+
+        def forward(self, x):
+            return self.d2(self.d1(x))
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(seed)
+    for _name, p in sorted(net.collect_params().items()):
+        p.data()._set_data(mx.nd.array(rng.randn(*p.shape) * 0.1)._data)
+    net.hybridize()
+    return net
+
+
+def _drill_loss(net, x, y):
+    return ((net(x) - y) ** 2).mean()
+
+
+def _warm_opt_states(trainer) -> None:
+    """Create every updater state slot up front so the state tree's
+    STRUCTURE is constant from step 0 (restore(like=) degrades to an
+    older step on a structural mismatch — an empty-states initial
+    capture would make every later checkpoint look unrestorable)."""
+    opt = trainer._optimizer
+    upd = trainer._updaters[0]
+    for p in trainer._params:
+        if p.grad_req == "null":
+            continue
+        idx = trainer._param2idx[id(p)]
+        if idx not in upd.states:
+            upd.states[idx] = opt.create_state_multi_precision(
+                idx, p.data())
+            upd.states_synced[idx] = True
+        opt._index_update_count.setdefault(idx, opt.begin_num_update)
+
+
+def _capture(net, trainer):
+    """Checkpointable pytree of everything the trajectory depends on:
+    params, optimizer state (momentum buffers), and update counts."""
+    import jax
+
+    from mxnet_tpu.ndarray import NDArray
+
+    def _leaf(x):
+        return x._data if isinstance(x, NDArray) else x
+
+    opt = trainer._optimizer
+    states = {}
+    for idx, s in trainer._updaters[0].states.items():
+        states[int(idx)] = jax.tree_util.tree_map(_leaf, s)
+    return {
+        "params": {k: p.data()._data
+                   for k, p in sorted(net.collect_params().items())},
+        "opt": states,
+        "counts": {int(i): int(c)
+                   for i, c in opt._index_update_count.items()},
+    }
+
+
+def _restore_into(net, trainer, tree) -> None:
+    """Push a restored :func:`_capture` tree back into the live net +
+    trainer (the ``run_elastic(on_restore=)`` hookup): params keep
+    their restored placement (``restore(like=)`` already re-placed them
+    onto the CURRENT mesh), optimizer state re-wraps as NDArrays, and
+    update counts catch up so schedules stay aligned."""
+    import jax
+
+    from mxnet_tpu.context import current_context
+    from mxnet_tpu.ndarray.ndarray import _wrap
+
+    for k, p in sorted(net.collect_params().items()):
+        p.data()._set_data(tree["params"][k])
+    upd = trainer._updaters[0]
+    for idx, s in tree.get("opt", {}).items():
+        upd.states[int(idx)] = jax.tree_util.tree_map(
+            lambda x: _wrap(x, current_context()), s)
+        upd.states_synced[int(idx)] = True
+    opt = trainer._optimizer
+    for i, c in tree.get("counts", {}).items():
+        opt._index_update_count[int(i)] = int(c)
+        opt.num_update = max(opt.num_update, int(c))
+
+
+def _params_sha(net) -> str:
+    import hashlib
+
+    import numpy as onp
+
+    h = hashlib.sha256()
+    for k, p in sorted(net.collect_params().items()):
+        h.update(k.encode())
+        h.update(onp.ascontiguousarray(onp.asarray(p.data()._data)).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# child: train drill
+# ---------------------------------------------------------------------------
+
+def _cmd_train(a) -> int:
+    t_proc0 = time.monotonic()
+    import mxnet_tpu as mx  # noqa: F401  (installs the runtime)
+    from mxnet_tpu import engine, gluon, preemption, program_store, telemetry
+    from mxnet_tpu.parallel.elastic import CheckpointManager, run_elastic
+
+    net = _drill_net(seed=0)
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, kvstore="tpu")
+    step = trainer.compile_step(net, _drill_loss)
+    _warm_opt_states(trainer)
+    ckpt = CheckpointManager(a.ckpt, keep=20, async_save=True)
+    if a.preempt:
+        preemption.install()
+    losses_f = open(os.path.join(a.dir, f"losses-{a.label}.txt"), "a",
+                    buffering=1)
+    progress_f = open(os.path.join(a.dir, f"progress-{a.label}.txt"), "a",
+                      buffering=1)
+
+    # depth-k prefetcher staging batches onto the step's mesh sharding;
+    # restarted from the restored index after every restore (the input
+    # pipeline is part of what restore-and-replay rebuilds)
+    pf = {"it": None, "next": -1}
+
+    def _get_batch(i: int):
+        if pf["it"] is None or pf["next"] != i:
+            if hasattr(pf["it"], "close"):
+                pf["it"].close()
+            pf["it"] = engine.prefetch(
+                (_host_batch(j) for j in range(i, a.stop_at)),
+                depth=2, sharding=step.batch_sharding)
+            pf["next"] = i
+        pf["next"] = i + 1
+        return next(iter(pf["it"]))
+
+    t_first = [None]
+    restored_at = [None]
+    restored_sha = [None]
+
+    def step_fn(state, i):
+        if a.sigkill_at is not None and i == a.sigkill_at:
+            # let the queued async saves land first so the drill's
+            # restore point is deterministic — the kill still falls
+            # BETWEEN save boundaries (i % save_every != 0)
+            ckpt.wait()
+            os.kill(os.getpid(), signal.SIGKILL)      # no grace, no drain
+        if a.sigterm_at is not None and i == a.sigterm_at \
+                and restored_at[0] is None:
+            # a real preemption notice, delivered mid-step through the
+            # installed handler (the handler runs at the next bytecode)
+            os.kill(os.getpid(), signal.SIGTERM)
+        x, y = _get_batch(i)
+        loss = step(x, y, batch_size=ROWS)
+        lval = float(loss.asnumpy().ravel()[0])
+        losses_f.write(f"{i} {lval.hex()}\n")
+        progress_f.write(f"{i}\n")
+        if t_first[0] is None:
+            t_first[0] = time.monotonic()
+        if a.delay:
+            time.sleep(a.delay)
+        return _capture(net, trainer)
+
+    def on_restore(state, s):
+        restored_at[0] = s
+        _restore_into(net, trainer, state)
+        restored_sha[0] = _params_sha(net)   # proves restore == saved
+        pf["next"] = -1                 # restart the input pipeline
+        return None
+
+    preempted: Optional[int] = None
+    steps_run = restarts = None
+    try:
+        _out, steps_run, restarts = run_elastic(
+            step_fn, _capture(net, trainer), range(a.stop_at), ckpt,
+            save_every=a.save_every, max_restarts=a.max_restarts,
+            on_restore=on_restore)
+    except preemption.Preempted as e:
+        preempted = int(e.code)
+    engine.waitall()
+    snap = telemetry.snapshot()
+    res = {
+        "label": a.label, "pid": os.getpid(),
+        "preempted_code": preempted,
+        "steps_run": steps_run, "restarts": restarts,
+        "restored_at": restored_at[0],
+        "restored_params_sha": restored_sha[0],
+        "params_sha": _params_sha(net),
+        "disk": program_store.disk_stats(),
+        "recovery_s": snap.get("elastic.recovery_s"),
+        "steps_replayed": snap.get("elastic.steps_replayed"),
+        "drain_s": snap.get("preemption.drain_s"),
+        "digest_mismatches": snap.get("checkpoint.digest_mismatches"),
+        "wall_s": time.monotonic() - t_proc0,
+        "first_step_s": (t_first[0] - t_proc0
+                         if t_first[0] is not None else None),
+        "telemetry": snap,
+    }
+    with open(os.path.join(a.dir, f"result-{a.label}.json"), "w") as f:
+        json.dump(res, f)
+    return preempted or 0
+
+
+# ---------------------------------------------------------------------------
+# child: decode drill
+# ---------------------------------------------------------------------------
+
+def _decode_prompt(r: int) -> List[int]:
+    return [1 + (r * 7 + j) % 49 for j in range(5 + r % 3)]
+
+
+def _cmd_decode(a) -> int:
+    import threading
+
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu import engine, preemption, telemetry
+    from mxnet_tpu.faults import ShedError
+    from mxnet_tpu.serving_decode import (GenerativeEngine, PagePool,
+                                          TinyCausalLM, eager_generate)
+
+    model = TinyCausalLM(vocab=50, d_model=16, n_layers=1, n_heads=2,
+                        max_seq=96)
+    params = model.init_params(0)
+    pool = PagePool(pages=64, page=8)
+    eng = GenerativeEngine(model, params=params, pool=pool, max_rows=2,
+                           name="drill")
+    eng.warmup(max_len=8)
+    if a.preempt:
+        preemption.install()
+    req_ids = [int(r) for r in a.requests.split(",") if r != ""]
+    delivered: Dict[int, List[int]] = {}
+    shed: Dict[int, Optional[str]] = {}
+    trigger = {"fired": False}
+    lock = threading.Lock()
+
+    def worker(r: int):
+        try:
+            toks = eng.generate(_decode_prompt(r),
+                                max_new_tokens=a.max_new)
+            with lock:
+                delivered[r] = [int(t) for t in toks]
+        except ShedError as e:
+            with lock:
+                shed[r] = getattr(e, "kind", None)
+        except BaseException as e:          # pragma: no cover - drill fail
+            with lock:
+                shed[r] = f"error:{e!r}"
+        with lock:
+            fire = (a.self_sigterm and not trigger["fired"]
+                    and len(delivered) >= 1)
+            trigger["fired"] = trigger["fired"] or fire
+        if fire:
+            # deterministic mid-stream preemption: the FIRST delivery
+            # proves decode is rolling, other rows are live, the queue
+            # is non-empty — notice now (delivered to the main thread)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in req_ids]
+    for t in threads:
+        t.start()
+    preempted: Optional[int] = None
+    try:
+        for t in threads:
+            while t.is_alive():
+                t.join(timeout=0.05)     # interruptible by the handler
+    except preemption.Preempted as e:
+        preempted = int(e.code)
+        for t in threads:
+            t.join(timeout=30.0)        # drain already completed them
+    engine.waitall()
+    # token-exact vs the eager oracle on a deterministic subset (the
+    # oracle re-runs a FULL eager forward per token — verifying every
+    # delivery would dominate the drill's wall clock)
+    verify = sorted(delivered)[:2]
+    token_exact = all(
+        delivered[r] == eager_generate(model, params, _decode_prompt(r),
+                                       a.max_new)
+        for r in verify)
+    snap = telemetry.snapshot()
+    res = {
+        "label": a.label, "preempted_code": preempted,
+        "delivered": {str(r): t for r, t in delivered.items()},
+        "shed": {str(r): k for r, k in shed.items()},
+        "token_exact": token_exact,
+        "pool_in_use": pool.in_use(),
+        "drain_s": snap.get("preemption.drain_s"),
+        "telemetry": snap,
+    }
+    with open(os.path.join(a.dir, f"result-{a.label}.json"), "w") as f:
+        json.dump(res, f)
+    return preempted or 0
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+def _child_env(root: str, devices: int) -> Dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices}")
+    env["MXNET_SPMD_MESH"] = "auto"
+    env["MXNET_PROGRAM_CACHE_DIR"] = os.path.join(root, "pcache")
+    env["MXNET_PREEMPTION_GRACE_S"] = "60"
+    env["MXNET_ENGINE_PREFETCH"] = "2"
+    env["MXNET_RETRY_BACKOFF"] = "0.01"
+    env["MXNET_ELASTIC_BACKOFF"] = "0"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    for k in ("MXNET_FAULT_PLAN", "MXNET_ENGINE_TYPE",
+              "MXNET_TELEMETRY_DIR", "JAX_COMPILATION_CACHE_DIR"):
+        env.pop(k, None)
+    return env
+
+
+def _run_child(argv: List[str], env: Dict[str, str],
+               timeout: float = 300.0) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.drills"] + argv,
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO)
+
+
+def _train_child(root: str, scen_dir: str, label: str, devices: int,
+                 stop_at: int = N_STEPS, sigterm_at: Optional[int] = None,
+                 sigkill_at: Optional[int] = None, delay: float = 0.0,
+                 preempt: bool = False, ckpt_name: str = "ckpt",
+                 timeout: float = 300.0) -> subprocess.CompletedProcess:
+    os.makedirs(scen_dir, exist_ok=True)
+    argv = ["train", "--dir", scen_dir,
+            "--ckpt", os.path.join(scen_dir, ckpt_name),
+            "--label", label, "--stop-at", str(stop_at),
+            "--save-every", str(SAVE_EVERY), "--delay", str(delay)]
+    if sigterm_at is not None:
+        argv += ["--sigterm-at", str(sigterm_at)]
+    if sigkill_at is not None:
+        argv += ["--sigkill-at", str(sigkill_at)]
+    if preempt:
+        argv += ["--preempt"]
+    return _run_child(argv, _child_env(root, devices), timeout=timeout)
+
+
+def _read_losses(scen_dir: str, label: str) -> Dict[int, str]:
+    path = os.path.join(scen_dir, f"losses-{label}.txt")
+    out: Dict[int, str] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 2:
+                out[int(parts[0])] = parts[1]    # later replay wins
+    return out
+
+
+def _read_result(scen_dir: str, label: str) -> Optional[Dict[str, Any]]:
+    path = os.path.join(scen_dir, f"result-{label}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _tmp_litter(ckpt_dir: str) -> List[str]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return [f for f in os.listdir(ckpt_dir) if f.endswith(".tmp")]
+
+
+def _ensure_reference(root: str, failures: List[str]) -> Dict[int, str]:
+    """The memoized uninterrupted 4-device reference run (shared by
+    every train scenario under ``root``; also warms the disk cache)."""
+    scen_dir = os.path.join(root, "ref4")
+    if _read_result(scen_dir, "ref") is None:
+        r = _train_child(root, scen_dir, "ref", devices=4)
+        if r.returncode != 0:
+            failures.append(
+                f"reference run failed rc={r.returncode}: "
+                f"{r.stderr[-1500:]}")
+            return {}
+    losses = _read_losses(scen_dir, "ref")
+    if len(losses) != N_STEPS:
+        failures.append(
+            f"reference run produced {len(losses)}/{N_STEPS} loss lines")
+    return losses
+
+
+def _check_resumed_trajectory(failures: List[str], ref: Dict[int, str],
+                              first: Dict[int, str],
+                              resumed: Dict[int, str],
+                              restored_at: int, what: str) -> int:
+    """Merged first-run + resumed losses must equal the reference
+    bit-for-bit, and replayed overlap must equal the first run's —
+    recovery neither loses, doubles, nor perturbs a step."""
+    checked = 0
+    for i in range(N_STEPS):
+        want = ref.get(i)
+        got = resumed.get(i) if i >= restored_at else first.get(i)
+        if want is None or got is None:
+            failures.append(f"{what}: step {i} missing a loss line")
+            continue
+        if want != got:
+            failures.append(
+                f"{what}: step {i} loss {got} != reference {want}")
+        checked += 1
+    for i, v in resumed.items():
+        if i in first and first[i] != v:
+            failures.append(
+                f"{what}: replayed step {i} diverged from the first "
+                f"run ({v} != {first[i]})")
+    return checked
+
+
+def run_drill(name: str, root: str, verbose: bool = False
+              ) -> Dict[str, Any]:
+    """Run one scenario under ``root`` (shared pcache + reference) and
+    return its report: ``ok``, ``failures``, and the measured recovery
+    budget (recovery_s / recovery_wall_s / steps_replayed / drain_s /
+    fresh_compiles / disk hits)."""
+    if name not in SCENARIOS:
+        raise ValueError(f"unknown drill {name!r} (one of {SCENARIOS})")
+    os.makedirs(root, exist_ok=True)
+    failures: List[str] = []
+    report: Dict[str, Any] = {"scenario": name, "root": root}
+    t0 = time.monotonic()
+    if name == "decode_drain":
+        _drill_decode(root, failures, report)
+    else:
+        ref = _ensure_reference(root, failures)
+        if not failures:
+            {"sigterm_drain": _drill_sigterm,
+             "sigkill_between_saves": _drill_sigkill,
+             "topology_change": _drill_topology,
+             "corrupt_latest": _drill_corrupt}[name](root, ref, failures,
+                                                     report)
+    report["ok"] = not failures
+    report["failures"] = failures
+    report["drill_wall_s"] = round(time.monotonic() - t0, 3)
+    if verbose:
+        print(json.dumps(report, indent=2, default=str))
+    return report
+
+
+def _resume_budget(report: Dict[str, Any], res: Dict[str, Any]) -> None:
+    disk = res.get("disk") or {}
+    report.update({
+        "recovery_s": res.get("recovery_s"),
+        "recovery_wall_s": res.get("first_step_s"),
+        "steps_replayed": res.get("steps_replayed"),
+        "restored_at": res.get("restored_at"),
+        "fresh_compiles": disk.get("misses"),
+        "disk_hits": disk.get("hits"),
+        "resume_telemetry": res.get("telemetry"),
+    })
+
+
+def _drill_sigterm(root: str, ref: Dict[int, str], failures: List[str],
+                   report: Dict[str, Any]) -> None:
+    scen = os.path.join(root, "sigterm")
+    kill_at = 9                       # mid-step, not on a save boundary
+    c1 = _train_child(root, scen, "c1", devices=4, sigterm_at=kill_at,
+                      preempt=True)
+    res1 = _read_result(scen, "c1") or {}
+    want_code = res1.get("preempted_code") or 83
+    if c1.returncode != want_code:
+        failures.append(
+            f"sigterm child exited {c1.returncode}, wanted the "
+            f"distinguished code {want_code}: {c1.stderr[-1500:]}")
+    report["drain_s"] = res1.get("drain_s")
+    report["exit_code_c1"] = c1.returncode
+    if res1.get("drain_s") is None or res1.get("drain_s") <= 0:
+        failures.append("sigterm drain recorded no preemption.drain_s")
+    c2 = _train_child(root, scen, "c2", devices=4)
+    if c2.returncode != 0:
+        failures.append(f"sigterm resume failed rc={c2.returncode}: "
+                        f"{c2.stderr[-1500:]}")
+        return
+    res2 = _read_result(scen, "c2") or {}
+    _resume_budget(report, res2)
+    first = _read_losses(scen, "c1")
+    # graceful drain checkpointed the LAST COMPLETED step: 0 replay
+    # (replay = steps the first process ran past the restore point)
+    restored = res2.get("restored_at") or 0
+    replay = max(0, (max(first) + 1 if first else 0) - restored)
+    report["steps_replayed"] = replay
+    if res2.get("restored_at") != kill_at:
+        failures.append(
+            f"sigterm resume restored step {res2.get('restored_at')}, "
+            f"wanted the drained step {kill_at}")
+    if replay != 0:
+        failures.append(
+            f"graceful drain must replay 0 steps, resume replayed "
+            f"{replay}")
+    if (res2.get("disk") or {}).get("misses") != 0:
+        failures.append(
+            f"sigterm warm resume performed "
+            f"{(res2.get('disk') or {}).get('misses')} fresh compiles "
+            "(wanted 0: disk hits only)")
+    _check_resumed_trajectory(
+        failures, ref, first, _read_losses(scen, "c2"), restored,
+        "sigterm")
+    report["leaked_tmp"] = _tmp_litter(os.path.join(scen, "ckpt"))
+    if report["leaked_tmp"]:
+        failures.append(f"sigterm left temp litter {report['leaked_tmp']}")
+
+
+def _drill_sigkill(root: str, ref: Dict[int, str], failures: List[str],
+                   report: Dict[str, Any]) -> None:
+    scen = os.path.join(root, "sigkill")
+    kill_at = 10                     # 2 past the last periodic save (8)
+    c1 = _train_child(root, scen, "c1", devices=4, sigkill_at=kill_at)
+    if c1.returncode != -signal.SIGKILL:
+        failures.append(
+            f"sigkill child exited {c1.returncode}, wanted "
+            f"{-signal.SIGKILL}")
+    report["exit_code_c1"] = c1.returncode
+    c2 = _train_child(root, scen, "c2", devices=4)
+    if c2.returncode != 0:
+        failures.append(f"sigkill resume failed rc={c2.returncode}: "
+                        f"{c2.stderr[-1500:]}")
+        return
+    res2 = _read_result(scen, "c2") or {}
+    _resume_budget(report, res2)
+    first = _read_losses(scen, "c1")
+    restored = res2.get("restored_at") or 0
+    replay = max(0, (max(first) + 1 if first else 0) - restored)
+    report["steps_replayed"] = replay
+    expect_restore = kill_at - (kill_at % SAVE_EVERY)
+    if res2.get("restored_at") != expect_restore:
+        failures.append(
+            f"sigkill resume restored step {res2.get('restored_at')}, "
+            f"wanted the last complete save {expect_restore}")
+    if replay != kill_at - expect_restore:
+        failures.append(
+            f"sigkill resume replayed {replay} steps, wanted "
+            f"{kill_at - expect_restore} (the save gap)")
+    if (res2.get("disk") or {}).get("misses") != 0:
+        failures.append(
+            f"sigkill warm resume performed "
+            f"{(res2.get('disk') or {}).get('misses')} fresh compiles "
+            "(wanted 0: disk hits only)")
+    _check_resumed_trajectory(
+        failures, ref, first, _read_losses(scen, "c2"), restored,
+        "sigkill")
+    report["leaked_tmp"] = _tmp_litter(os.path.join(scen, "ckpt"))
+    if report["leaked_tmp"]:
+        failures.append(f"sigkill left temp litter {report['leaked_tmp']}")
+
+
+def _drill_topology(root: str, ref: Dict[int, str], failures: List[str],
+                    report: Dict[str, Any]) -> None:
+    scen = os.path.join(root, "topology")
+    c1 = _train_child(root, scen, "c1", devices=4, stop_at=HALF)
+    if c1.returncode != 0:
+        failures.append(f"topology 4-dev leg failed rc={c1.returncode}: "
+                        f"{c1.stderr[-1500:]}")
+        return
+    res1 = _read_result(scen, "c1") or {}
+    losses = {}
+    import shutil
+
+    for label in ("c2", "c2b"):       # the pair: determinism + warm cache
+        # each resume gets its OWN copy of the 4-device checkpoint dir
+        # (a shared dir would let c2's later saves turn c2b's restore
+        # into a no-op)
+        ckpt_name = f"ckpt-{label}"
+        dst = os.path.join(scen, ckpt_name)
+        if os.path.isdir(dst):
+            shutil.rmtree(dst)
+        shutil.copytree(os.path.join(scen, "ckpt"), dst)
+        r = _train_child(root, scen, label, devices=2,
+                         ckpt_name=ckpt_name)
+        if r.returncode != 0:
+            failures.append(
+                f"topology 2-dev resume {label} failed "
+                f"rc={r.returncode}: {r.stderr[-1500:]}")
+            return
+        losses[label] = _read_losses(scen, label)
+    res2 = _read_result(scen, "c2") or {}
+    res2b = _read_result(scen, "c2b") or {}
+    _resume_budget(report, res2b)     # the WARM-cache recovery numbers
+    if res2.get("restored_at") != HALF:
+        failures.append(
+            f"topology resume restored step {res2.get('restored_at')}, "
+            f"wanted {HALF}")
+    # bit-exact re-placement: the digest over the params RESTORED onto
+    # the 2-device mesh must equal the 4-device saver's final params
+    if res2.get("restored_params_sha") != res1.get("params_sha"):
+        failures.append(
+            "topology restore(like=) onto the 2-device mesh did not "
+            "reproduce the 4-device params bit-exactly "
+            f"({res2.get('restored_params_sha')} != "
+            f"{res1.get('params_sha')})")
+    if res2.get("params_sha") != res2b.get("params_sha"):
+        failures.append("topology determinism pair diverged in final "
+                        "params (recovery is not deterministic)")
+    if losses["c2"] != losses["c2b"]:
+        failures.append("topology determinism pair diverged in losses")
+    # cross-mesh trajectory: tracks the 4-dev reference within tolerance
+    for i in range(HALF, N_STEPS):
+        w = ref.get(i)
+        g = losses["c2"].get(i)
+        if w is None or g is None:
+            failures.append(f"topology: step {i} missing a loss line")
+            continue
+        wf, gf = float.fromhex(w), float.fromhex(g)
+        if abs(wf - gf) > TOPO_RTOL * max(1.0, abs(wf)):
+            failures.append(
+                f"topology: step {i} loss {gf} drifted past rtol "
+                f"{TOPO_RTOL} from the 4-dev reference {wf}")
+    # warm persistent cache: the SECOND 2-dev resume recompiles nothing
+    fresh = (res2b.get("disk") or {}).get("misses")
+    if fresh != 0:
+        failures.append(
+            f"topology warm resume performed {fresh} fresh compiles "
+            "(wanted 0 — every program from MXNET_PROGRAM_CACHE_DIR)")
+    report["params_sha_c1"] = res1.get("params_sha")
+
+
+def _drill_corrupt(root: str, ref: Dict[int, str], failures: List[str],
+                   report: Dict[str, Any]) -> None:
+    scen = os.path.join(root, "corrupt")
+    c1 = _train_child(root, scen, "c1", devices=4, stop_at=HALF)
+    if c1.returncode != 0:
+        failures.append(f"corrupt setup leg failed rc={c1.returncode}: "
+                        f"{c1.stderr[-1500:]}")
+        return
+    # flip one payload byte of the NEWEST checkpoint; its sha256 sidecar
+    # now disagrees even though the pickle may still load
+    ckpt_dir = os.path.join(scen, "ckpt")
+    target = os.path.join(ckpt_dir, f"ckpt-{HALF}.pkl")
+    with open(target, "r+b") as f:
+        f.seek(-7, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-7, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+    c2 = _train_child(root, scen, "c2", devices=4)
+    if c2.returncode != 0:
+        failures.append(f"corrupt resume failed rc={c2.returncode}: "
+                        f"{c2.stderr[-1500:]}")
+        return
+    res2 = _read_result(scen, "c2") or {}
+    _resume_budget(report, res2)
+    first = _read_losses(scen, "c1")
+    restored = res2.get("restored_at") or 0
+    report["steps_replayed"] = max(
+        0, (max(first) + 1 if first else 0) - restored)
+    expect = HALF - SAVE_EVERY
+    if res2.get("restored_at") != expect:
+        failures.append(
+            f"corrupt resume restored step {res2.get('restored_at')}, "
+            f"wanted degradation to the previous complete step {expect}")
+    if not res2.get("digest_mismatches"):
+        failures.append("corrupt resume counted no "
+                        "checkpoint.digest_mismatches")
+    _check_resumed_trajectory(
+        failures, ref, _read_losses(scen, "c1"), _read_losses(scen, "c2"),
+        res2.get("restored_at") or 0, "corrupt")
+
+
+def _drill_decode(root: str, failures: List[str],
+                  report: Dict[str, Any]) -> None:
+    scen = os.path.join(root, "decode")
+    os.makedirs(scen, exist_ok=True)
+    req_ids = list(range(8))
+    argv = ["decode", "--dir", scen, "--label", "c1", "--preempt",
+            "--self-sigterm", "--max-new", "12",
+            "--requests", ",".join(map(str, req_ids))]
+    c1 = _run_child(argv, _child_env(root, 1))
+    res1 = _read_result(scen, "c1") or {}
+    code = res1.get("preempted_code") or 83
+    report["exit_code_c1"] = c1.returncode
+    report["drain_s"] = res1.get("drain_s")
+    if c1.returncode != code:
+        failures.append(
+            f"decode child exited {c1.returncode}, wanted the "
+            f"distinguished code {code}: {c1.stderr[-1500:]}")
+        return
+    delivered = {int(k): v for k, v in (res1.get("delivered") or {}).items()}
+    shed = {int(k): v for k, v in (res1.get("shed") or {}).items()}
+    if set(delivered) | set(shed) != set(req_ids):
+        failures.append(
+            f"decode drain lost requests: delivered {sorted(delivered)} "
+            f"+ shed {sorted(shed)} != {req_ids}")
+    if not delivered:
+        failures.append("decode drain delivered nothing before the "
+                        "notice (self-trigger broken)")
+    if not shed:
+        failures.append("decode drain shed nothing — the queue was "
+                        "empty at the notice (drill not mid-stream)")
+    bad_kinds = {r: k for r, k in shed.items() if k != "draining"}
+    if bad_kinds:
+        failures.append(f"decode sheds were not typed 'draining': "
+                        f"{bad_kinds}")
+    if not res1.get("token_exact"):
+        failures.append("decode in-flight completions were not "
+                        "token-exact vs the eager oracle")
+    if res1.get("pool_in_use") != 0:
+        failures.append(
+            f"decode drain leaked {res1.get('pool_in_use')} KV pages")
+    report["leaked_pages"] = res1.get("pool_in_use")
+    # restart: the shed requests re-queue on a fresh process, token-exact
+    if shed:
+        argv = ["decode", "--dir", scen, "--label", "c2",
+                "--max-new", "12",
+                "--requests", ",".join(str(r) for r in sorted(shed))]
+        c2 = _run_child(argv, _child_env(root, 1))
+        res2 = _read_result(scen, "c2") or {}
+        if c2.returncode != 0:
+            failures.append(f"decode re-queue leg failed "
+                            f"rc={c2.returncode}: {c2.stderr[-1500:]}")
+            return
+        redone = {int(k) for k in (res2.get("delivered") or {})}
+        if redone != set(shed):
+            failures.append(
+                f"decode re-queue delivered {sorted(redone)} != shed "
+                f"{sorted(shed)}")
+        if not res2.get("token_exact"):
+            failures.append("decode re-queued requests were not "
+                            "token-exact")
+        if res2.get("pool_in_use") != 0:
+            failures.append("decode re-queue leg leaked pages")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="mxnet_tpu.drills",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="train-drill child")
+    t.add_argument("--dir", required=True)
+    t.add_argument("--ckpt", required=True)
+    t.add_argument("--label", default="c1")
+    t.add_argument("--stop-at", type=int, default=N_STEPS,
+                   dest="stop_at")
+    t.add_argument("--save-every", type=int, default=SAVE_EVERY,
+                   dest="save_every")
+    t.add_argument("--max-restarts", type=int, default=3,
+                   dest="max_restarts")
+    t.add_argument("--delay", type=float, default=0.0)
+    t.add_argument("--sigterm-at", type=int, default=None,
+                   dest="sigterm_at")
+    t.add_argument("--sigkill-at", type=int, default=None,
+                   dest="sigkill_at")
+    t.add_argument("--preempt", action="store_true")
+
+    d = sub.add_parser("decode", help="decode-drill child")
+    d.add_argument("--dir", required=True)
+    d.add_argument("--label", default="c1")
+    d.add_argument("--requests", default="0,1,2,3")
+    d.add_argument("--max-new", type=int, default=32, dest="max_new")
+    d.add_argument("--preempt", action="store_true")
+    d.add_argument("--self-sigterm", action="store_true",
+                   dest="self_sigterm")
+
+    r = sub.add_parser("run", help="orchestrate scenarios")
+    r.add_argument("scenarios", nargs="*", default=list(SCENARIOS))
+    r.add_argument("--root", default=None)
+    r.add_argument("--json", action="store_true")
+
+    a = p.parse_args(argv)
+    if a.cmd == "train":
+        return _cmd_train(a)
+    if a.cmd == "decode":
+        return _cmd_decode(a)
+    import tempfile
+
+    root = a.root or tempfile.mkdtemp(prefix="mxnet-drills-")
+    reports = [run_drill(s, root, verbose=not a.json)
+               for s in (a.scenarios or SCENARIOS)]
+    if a.json:
+        print(json.dumps(reports, default=str))
+    return 0 if all(r["ok"] for r in reports) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

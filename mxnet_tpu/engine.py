@@ -333,9 +333,17 @@ class DevicePrefetcher:
 
     def _run(self):
         from . import faults as _faults
+        from . import preemption as _preemption
 
         try:
             while not self._stop.is_set():
+                if _preemption.draining():
+                    # preemption drain: stop pulling/staging NEW batches
+                    # (already-staged ones stay deliverable); the
+                    # consumer sees a normal end-of-stream at the next
+                    # take, so the train loop winds down cleanly
+                    self._put(("end", None))
+                    return
                 try:
                     item = next(self._source)
                 except StopIteration:

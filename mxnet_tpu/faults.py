@@ -66,11 +66,25 @@ class DeadlineExceeded(RuntimeError):
 class ShedError(RuntimeError):
     """Typed load-shed refusal (serving admission control, site
     ``serving.admit``): the request was rejected IMMEDIATELY — queue
-    full, KV page pool exhausted, or the SLO provably unmeetable —
-    instead of queueing toward a timeout.  Overload degrades loudly:
-    callers see this exact type and can back off / route elsewhere;
-    they never see a 300 s deadline breach.  NOT retryable by default
-    (retrying into an overloaded server amplifies the overload)."""
+    full, KV page pool exhausted, the SLO provably unmeetable, or the
+    process draining for preemption — instead of queueing toward a
+    timeout.  Overload degrades loudly: callers see this exact type and
+    can back off / route elsewhere; they never see a 300 s deadline
+    breach.  NOT retryable by default (retrying into an overloaded
+    server amplifies the overload).
+
+    ``kind`` tags the refusal reason (``queue`` | ``pool`` | ``slo`` |
+    ``draining`` | ``None`` for legacy raisers) so callers can route on
+    it without parsing the message: a ``draining`` shed means this
+    process took a preemption notice — retry on another replica or
+    after the restart, never here."""
+
+    kind: Optional[str] = None
+
+    def __init__(self, *args, kind: Optional[str] = None):
+        super().__init__(*args)
+        if kind is not None:
+            self.kind = kind
 
 
 # exception kinds a plan spec may name (MXNET_FAULT_PLAN "site:times:kind")

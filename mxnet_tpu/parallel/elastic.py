@@ -20,6 +20,7 @@ EXCEEDS reference parity by design:
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import queue
@@ -35,12 +36,34 @@ import numpy as onp
 from .. import config as _config
 from .. import engine as _engine
 from .. import faults as _faults
+from .. import preemption as _preemption
+from .. import telemetry as _telemetry
 from ..log import get_logger
 
 __all__ = ["CheckpointManager", "HeartbeatMonitor", "run_elastic",
-           "AnomalyDetected", "nonfinite_anomaly"]
+           "AnomalyDetected", "DigestMismatch", "nonfinite_anomaly"]
 
 _LOG = get_logger("mxnet_tpu.elastic")
+
+# recovery observability (ISSUE 11 / ROADMAP 4c: a recovery-time METRIC,
+# not a guess): set/incremented by run_elastic on every restore
+_RECOVERY_S = _telemetry.counter(
+    "elastic.recovery_s",
+    "seconds the most recent run_elastic checkpoint restore took "
+    "(degradation walk + load + re-placement via restore(like=))",
+    kind="time")
+_STEPS_REPLAYED = _telemetry.counter(
+    "elastic.steps_replayed",
+    "train steps re-executed after restores (crash step index minus "
+    "restored step; a graceful preemption drain replays 0)")
+_RESTORES = _telemetry.counter(
+    "elastic.restores", "successful run_elastic checkpoint restores "
+    "(startup resumes + in-process crash recoveries)")
+_DIGEST_MISMATCHES = _telemetry.counter(
+    "checkpoint.digest_mismatches",
+    "checkpoint payloads whose sha256 content digest disagreed with "
+    "their sidecar (bit rot / torn replace); the step degrades whole "
+    "to the previous complete one")
 
 
 class AnomalyDetected(RuntimeError):
@@ -49,11 +72,19 @@ class AnomalyDetected(RuntimeError):
     the same ``max_restarts`` budget."""
 
 
+class DigestMismatch(ValueError):
+    """A checkpoint payload's sha256 disagrees with its ``.sha256``
+    sidecar — a silent bit-flip that would still unpickle.  Restore
+    auto-selection degrades to the previous complete step exactly like
+    a truncated pickle; an explicit ``step=`` raises this."""
+
+
 # What a truncated/corrupt checkpoint file can raise while loading:
 # pickle/EOF for torn bytes, OSError for an unreadable file, Value/Index/
-# Key for a payload whose structure no longer matches, plus injected
-# faults (site checkpoint.restore).  Anything else is a real bug and
-# propagates.
+# Key for a payload whose structure no longer matches (DigestMismatch is
+# a ValueError: content-digest failures degrade the same way), plus
+# injected faults (site checkpoint.restore).  Anything else is a real
+# bug and propagates.
 _RESTORE_ERRORS = (pickle.UnpicklingError, EOFError, OSError, ValueError,
                    IndexError, KeyError, _faults.FaultInjected)
 
@@ -112,6 +143,7 @@ class CheckpointManager:
         self.keep = keep
         self.async_save = async_save
         os.makedirs(directory, exist_ok=True)
+        self._clean_stale_tmp()
         self._q: "queue.Queue" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
@@ -124,6 +156,29 @@ class CheckpointManager:
             self._worker = threading.Thread(target=self._writer, daemon=True)
             self._worker.start()
         _engine.register_drainable(self)
+
+    def _clean_stale_tmp(self) -> None:
+        """Remove temp files left by DEAD writers (a SIGKILL mid-write
+        leaks ``<path>.<pid>.tmp``; the atomic-replace discipline means
+        they are never part of any checkpoint).  Live pids — another
+        host process sharing the directory — are left alone, so the
+        recovery-budget gate can assert 0 leaked temp files after a
+        kill."""
+        for f in os.listdir(self.directory):
+            m = re.match(r".*\.(\d+)\.tmp$", f)
+            if not m or int(m.group(1)) == os.getpid():
+                continue
+            try:
+                os.kill(int(m.group(1)), 0)
+            except ProcessLookupError:
+                try:
+                    os.remove(os.path.join(self.directory, f))
+                    _LOG.warning("removed stale checkpoint temp file %s "
+                                 "(writer pid %s is dead)", f, m.group(1))
+                except OSError:
+                    pass
+            except OSError:
+                pass                      # alive (or not ours): keep
 
     # -- paths ----------------------------------------------------------
     def _suffix(self) -> str:
@@ -231,6 +286,11 @@ class CheckpointManager:
         while True:
             item = self._q.get()
             if item is None:
+                # balance the close() sentinel: an unmatched get would
+                # leave unfinished_tasks at 1 forever and wedge every
+                # later _q.join() (engine.waitall drains us weakly even
+                # after close)
+                self._q.task_done()
                 return
             kind, step, data = item
             try:
@@ -256,17 +316,28 @@ class CheckpointManager:
     def _write_once(self, step: int, payload) -> None:
         path = self._path(step)
         tmp = f"{path}.{os.getpid()}.tmp"
+        dtmp = f"{path}.sha256.{os.getpid()}.tmp"
+        data = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
         try:
             with open(tmp, "wb") as f:
-                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+                f.write(data)
+            # content-digest sidecar, replaced BEFORE the payload: a
+            # crash between the two replaces pairs the new digest with
+            # the old payload -> restore sees a mismatch and degrades
+            # whole-step, exactly like a truncated pickle.  The digest
+            # is what catches the silent bit-flip that still unpickles.
+            with open(dtmp, "w") as f:
+                f.write(hashlib.sha256(data).hexdigest())
+            os.replace(dtmp, f"{path}.sha256")
             os.replace(tmp, path)
         except BaseException:
             # never leave a partial temp file for a retry (or a later
             # incarnation of this pid) to trip over
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
+            for t in (tmp, dtmp):
+                try:
+                    os.remove(t)
+                except OSError:
+                    pass
             raise
         # record the saving world size (every host writes identical
         # content; atomic replace makes the race harmless)
@@ -288,8 +359,8 @@ class CheckpointManager:
             if s in protected or s > newest:
                 continue
             for f in os.listdir(self.directory):
-                if re.match(rf"ckpt-{s}(?:-h\d+)?\.pkl$", f) or \
-                        f == f"ckpt-{s}.meta":
+                if re.match(rf"ckpt-{s}(?:-h\d+)?\.pkl(?:\.sha256)?$", f) \
+                        or f == f"ckpt-{s}.meta":
                     try:
                         os.remove(os.path.join(self.directory, f))
                     except OSError:
@@ -308,7 +379,10 @@ class CheckpointManager:
         """engine.waitall() hook: flush queued snapshots/writes; an
         asynchronously-absorbed failure surfaces here, like the
         reference engine re-raising a captured op exception at the wait
-        point."""
+        point.  A closed manager has nothing in flight (close() joins
+        the writer) — no-op instead of waiting on a dead thread."""
+        if self._closed:
+            return
         self.wait()
 
     # -- restore --------------------------------------------------------
@@ -361,6 +435,30 @@ class CheckpointManager:
             f"complete step {candidates} failed to load "
             f"(last error: {last_err!r})") from last_err
 
+    def _load_verified(self, path: str):
+        """Read + unpickle one checkpoint file, verifying its sha256
+        content digest when a ``.sha256`` sidecar exists (legacy
+        checkpoints without one load unverified).  A mismatch raises
+        :class:`DigestMismatch` — the silent bit-flip that would still
+        unpickle degrades exactly like a truncated pickle."""
+        with open(path, "rb") as f:
+            data = f.read()
+        dpath = f"{path}.sha256"
+        if os.path.exists(dpath):
+            with open(dpath) as f:
+                want = f.read().strip()
+            got = hashlib.sha256(data).hexdigest()
+            if got != want:
+                _DIGEST_MISMATCHES.inc()
+                _faults.record_event(
+                    "checkpoint.restore", "digest_mismatch",
+                    file=os.path.basename(path))
+                raise DigestMismatch(
+                    f"checkpoint {path} content digest mismatch "
+                    f"(sha256 {got[:12]}… != recorded {want[:12]}…): "
+                    "bit rot or torn write survived the unpickle check")
+        return pickle.loads(data)
+
     def _restore_step(self, step: int, like: Any = None):
         """Load one specific step (one attempt, site
         ``checkpoint.restore``)."""
@@ -371,21 +469,27 @@ class CheckpointManager:
                 f"no files for step {step} in {self.directory}")
         own = self._path(step)
         primary = own if own in paths else paths[0]
-        with open(primary, "rb") as f:
-            treedef, host_leaves = pickle.load(f)
+        treedef, host_leaves = self._load_verified(primary)
         # merge shard payloads from the other saving hosts' files
         needs_merge = any(kind == "shards" for (kind, _s, _d) in host_leaves)
         if needs_merge:
             for p in paths:
                 if p == primary:
                     continue
-                with open(p, "rb") as f:
-                    _td, other = pickle.load(f)
+                _td, other = self._load_verified(p)
                 for mine, theirs in zip(host_leaves, other):
                     if mine[0] == "shards" and theirs[0] == "shards":
                         mine[2].extend(theirs[2])
         like_leaves = (jax.tree_util.tree_flatten(like)[0]
                        if like is not None else [None] * len(host_leaves))
+        if like is not None and len(like_leaves) != len(host_leaves):
+            # a silent zip-truncation here would re-place only a prefix
+            # of the leaves; raise the mismatch loudly (auto-selection
+            # may still degrade to an older structurally-matching step)
+            raise ValueError(
+                f"checkpoint step {step} holds {len(host_leaves)} "
+                f"leaves but like= carries {len(like_leaves)} — the "
+                "live state tree's structure differs from the saved one")
         leaves = []
         for (kind, shape, data), ref in zip(host_leaves, like_leaves):
             if kind == "shards":
@@ -482,18 +586,37 @@ def nonfinite_anomaly(*keys: str) -> Callable[[Any], bool]:
     return _check
 
 
+def _restore_counted(ckpt: CheckpointManager, state: Any):
+    """One observed restore: retried under the shared policy (site
+    ``elastic.restore`` — a network-FS flap while reading is as routine
+    as one while writing), timed into ``elastic.recovery_s``, counted
+    in ``elastic.restores``."""
+    t0 = time.monotonic()
+    restored, step = _faults.retry_call(ckpt.restore, like=state,
+                                        site="elastic.restore")
+    _RECOVERY_S.set(time.monotonic() - t0)
+    _RESTORES.inc()
+    return restored, step
+
+
 def run_elastic(step_fn: Callable, state: Any, inputs: Iterable,
                 ckpt: CheckpointManager, save_every: int = 10,
                 max_restarts: int = 3, on_restart: Optional[Callable] = None,
                 restart_backoff: Optional[float] = None,
-                anomaly_fn: Optional[Callable[[Any], bool]] = None):
+                anomaly_fn: Optional[Callable[[Any], bool]] = None,
+                on_restore: Optional[Callable[[Any, int], Any]] = None,
+                preemption: bool = False,
+                kvstore: Any = None):
     """Run ``state = step_fn(state, batch)`` over ``inputs`` with periodic
     checkpoints; on an exception, restore the latest checkpoint, skip
     already-consumed steps, and continue (up to ``max_restarts``).
 
-    ``inputs`` must be re-iterable (a list or a factory-backed sequence) so
-    skipped prefixes replay deterministically; with a stateful loader, pass
-    its epoch list.  Returns (final_state, steps_run, restarts).
+    ``inputs`` must be re-iterable so skipped prefixes replay
+    deterministically: anything already supporting ``len`` + indexing (a
+    list, a ``range``, a dataset view) is consumed IN PLACE — no
+    materializing copy, so an epoch of device-sized batches no longer
+    doubles host RSS — while a bare iterator/generator is listed once.
+    Returns (final_state, steps_run, restarts).
 
     Hardening (docs/ROBUSTNESS.md):
 
@@ -505,50 +628,130 @@ def run_elastic(step_fn: Callable, state: Any, inputs: Iterable,
       a True verdict after a step raises :class:`AnomalyDetected`, which
       rolls back to the last checkpoint under the SAME ``max_restarts``
       budget — a deterministically diverging run still terminates.
-    - each iteration passes the ``elastic.step`` injection site, so crash
-      recovery is testable without a real preemption.
+    - ``on_restore(state, step)`` runs after EVERY successful restore
+      (the startup resume included): push the restored pytree back into
+      live objects — net parameters, optimizer state — before stepping
+      resumes; a non-``None`` return replaces the loop state.  This is
+      what lets the loop drive a compiled SPMD ``TrainStep`` whose
+      params live in the Trainer, not the state tree.
+    - ``preemption=True`` installs the :mod:`mxnet_tpu.preemption`
+      SIGTERM/SIGINT handler; whenever a handler is installed (here or
+      by the caller) the loop registers the final-save drain hook — a
+      notice drains the async queues and force-saves the LAST COMPLETED
+      step blocking, so the graceful path replays 0 steps — and the
+      loop itself exits via :class:`preemption.Preempted` when it
+      observes the draining flag (the in-process/cooperative path).
+    - ``kvstore``: with a barrier deadline configured
+      (``MXNET_BARRIER_TIMEOUT`` > 0) and no monitor attached yet, a
+      :class:`HeartbeatMonitor` is created under
+      ``<ckpt.directory>/heartbeats``, started, and attached
+      automatically — a deadline breach names suspected-dead ranks
+      instead of reporting "no HeartbeatMonitor attached".
+    - each iteration passes the ``elastic.step`` injection site and each
+      restore the ``elastic.restore`` site, so crash recovery is
+      testable without a real preemption; restores are timed into the
+      ``elastic.recovery_s`` / ``elastic.steps_replayed`` counters and
+      restarts emit ``restart`` events stamped with step indices.
     """
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
     if restart_backoff is None:
         restart_backoff = _config.get("MXNET_ELASTIC_BACKOFF")
-    inputs = list(inputs)
-    start = 0
-    if ckpt.latest_step() is not None:
-        state, start = ckpt.restore(like=state)
-    else:
-        # step-0 anchor: a crash before the first periodic save restores
-        # pristine state instead of continuing from a corrupted one
-        ckpt.save(0, state, block=True)
-    restarts = 0
-    i = start
-    while i < len(inputs):
-        try:
-            _faults.inject("elastic.step")
-            new_state = step_fn(state, inputs[i])
-            if anomaly_fn is not None and anomaly_fn(new_state):
-                raise AnomalyDetected(
-                    f"anomaly detected in the state after step {i}")
-            state = new_state
-            i += 1
-            if i % save_every == 0 or i == len(inputs):
-                ckpt.save(i, state)
-        except Exception as e:
-            restarts += 1
-            _faults.record_event("elastic.restart", "restart", error=e,
-                                 step=i, restart=restarts)
-            if restarts > max_restarts:
+    if not (hasattr(inputs, "__len__") and hasattr(inputs, "__getitem__")):
+        inputs = list(inputs)
+    n = len(inputs)
+    hb: Optional[HeartbeatMonitor] = None
+    if kvstore is not None and hasattr(kvstore, "attach_heartbeat") \
+            and getattr(kvstore, "_heartbeat", None) is None \
+            and _config.get("MXNET_BARRIER_TIMEOUT") > 0:
+        hb = HeartbeatMonitor(os.path.join(ckpt.directory, "heartbeats"),
+                              rank=jax.process_index()).start()
+        kvstore.attach_heartbeat(hb)
+    if preemption:
+        _preemption.install()
+    # live loop cell the preemption drain hook reads: a SIGTERM
+    # interrupting step i finds (i, state-before-step-i) here — the
+    # final blocking save checkpoints the last COMPLETED step
+    loop = {"state": state, "i": 0}
+    hook = None
+    if preemption or _preemption.installed():
+        def _final_save():
+            ckpt.save(loop["i"], loop["state"], block=True)
+        hook = _preemption.on_drain(_final_save)
+    try:
+        start = 0
+        if ckpt.latest_step() is not None:
+            state, start = _restore_counted(ckpt, state)
+            _telemetry.event("restart", "elastic", step=start,
+                             phase="startup_restore")
+            if on_restore is not None:
+                ns = on_restore(state, start)
+                if ns is not None:
+                    state = ns
+        else:
+            # step-0 anchor: a crash before the first periodic save
+            # restores pristine state instead of continuing from a
+            # corrupted one
+            ckpt.save(0, state, block=True)
+        restarts = 0
+        i = start
+        loop["state"], loop["i"] = state, i
+        while i < n:
+            if _preemption.draining():
+                break                      # cooperative graceful drain
+            try:
+                _faults.inject("elastic.step")
+                new_state = step_fn(state, inputs[i])
+                if anomaly_fn is not None and anomaly_fn(new_state):
+                    raise AnomalyDetected(
+                        f"anomaly detected in the state after step {i}")
+                state = new_state
+                i += 1
+                loop["state"], loop["i"] = state, i
+                if i % save_every == 0 or i == n:
+                    ckpt.save(i, state)
+            except Exception as e:
+                restarts += 1
+                _faults.record_event("elastic.restart", "restart", error=e,
+                                     step=i, restart=restarts)
+                if restarts > max_restarts:
+                    ckpt.wait()
+                    raise
+                _LOG.warning("elastic restart %d/%d at step %d: %r",
+                             restarts, max_restarts, i, e)
+                if on_restart is not None:
+                    on_restart(restarts)
                 ckpt.wait()
-                raise
-            _LOG.warning("elastic restart %d/%d at step %d: %r",
-                         restarts, max_restarts, i, e)
-            if on_restart is not None:
-                on_restart(restarts)
+                if restart_backoff > 0:
+                    _faults._sleep(min(
+                        restart_backoff * (2 ** (restarts - 1)),
+                        _config.get("MXNET_RETRY_BACKOFF_MAX")))
+                prev_i = i
+                state, i = _restore_counted(ckpt, state)
+                _STEPS_REPLAYED.inc(max(0, prev_i - i))
+                _telemetry.event("restart", "elastic", step=i,
+                                 restart=restarts,
+                                 replay=max(0, prev_i - i))
+                if on_restore is not None:
+                    ns = on_restore(state, i)
+                    if ns is not None:
+                        state = ns
+                loop["state"], loop["i"] = state, i
+        if _preemption.draining() and i < n:
+            # drain observed between steps (programmatic notice, stubbed
+            # exit, or a handler on another thread): flush the async
+            # queues, force the final blocking save, and exit with the
+            # distinguished code.  Saving the same step the signal
+            # handler's drain hook saved is idempotent.
+            _engine.waitall()
+            ckpt.save(i, state, block=True)
             ckpt.wait()
-            if restart_backoff > 0:
-                _faults._sleep(min(
-                    restart_backoff * (2 ** (restarts - 1)),
-                    _config.get("MXNET_RETRY_BACKOFF_MAX")))
-            state, i = ckpt.restore(like=state)
-    ckpt.wait()
-    return state, i, restarts
+            _telemetry.event("drain", "elastic", step=i)
+            raise _preemption.Preempted(_preemption.exit_code())
+        ckpt.wait()
+        return state, i, restarts
+    finally:
+        if hook is not None:
+            _preemption.remove_drain_hook(hook)
+        if hb is not None:
+            hb.stop()

@@ -67,6 +67,7 @@ import numpy as onp
 from . import autograd
 from . import config as _config
 from . import faults as _faults
+from . import preemption as _preemption
 from . import program_store as _pstore
 from . import random as _random
 from . import telemetry as _telemetry
@@ -275,7 +276,8 @@ class ServingEngine:
             _telemetry.instance_name("serving.engine"),
             ("requests", "batches", "coalesced", "padded_rows",
              "true_rows", "bucket_fallbacks", "single_fallbacks",
-             "verify_runs", "verify_ulp_accepts", "warmup_programs"),
+             "verify_runs", "verify_ulp_accepts", "warmup_programs",
+             "shed_draining"),
             doc="ServingEngine per-instance counters",
             family="serving.engine")
 
@@ -289,6 +291,21 @@ class ServingEngine:
 
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
+        if _preemption.draining():
+            # preemption notice taken: refuse IMMEDIATELY and typed —
+            # accepted requests still deliver, new ones never park
+            # toward the grace deadline
+            self._stats.inc("shed_draining")
+            _telemetry.event("shed", self._stats.prefix,
+                             shed_kind="draining",
+                             reason="preemption drain")
+            _faults.record_event("serving.infer", "shed",
+                                 kind="draining",
+                                 reason="preemption drain")
+            raise _faults.ShedError(
+                "serving engine draining after a preemption notice; "
+                "re-queue this request after the restart",
+                kind="draining")
         # host (numpy) request payloads stage to device HERE — one
         # device_put per leaf, the DataLoader._wrap staging contract —
         # so they become real batch leaves, never baked trace constants

@@ -67,6 +67,7 @@ import numpy as onp
 
 from . import config as _config
 from . import faults as _faults
+from . import preemption as _preemption
 from . import program_store as _pstore
 from . import telemetry as _telemetry
 from .faults import ShedError
@@ -95,6 +96,8 @@ def reset_counters() -> None:
 class PagePoolExhausted(ShedError):
     """No free KV-cache pages — the typed refusal admission raises and
     the scheduler's preemption path absorbs."""
+
+    kind = "pool"
 
 
 class _DispatchGate:
@@ -564,8 +567,8 @@ class GenerativeEngine:
             _telemetry.instance_name("decode.engine"),
             ("requests", "delivered", "tokens_out", "prefills",
              "decode_steps", "decode_row_util", "shed", "shed_queue",
-             "shed_pool", "shed_slo", "preempts", "slo_violations",
-             "warmup_programs", "bucket_fallbacks"),
+             "shed_pool", "shed_slo", "shed_draining", "preempts",
+             "slo_violations", "warmup_programs", "bucket_fallbacks"),
             doc=f"GenerativeEngine counters (model {self.name!r})",
             family="decode.engine")
         from . import engine as _engine
@@ -684,15 +687,22 @@ class GenerativeEngine:
         _telemetry.event("shed", self.name, shed_kind=kind, reason=reason)
         _faults.record_event("serving.admit", "shed", cause,
                              model=self.name, kind=kind, reason=reason)
-        err = ShedError(f"[{self.name}] {reason}")
+        err = ShedError(f"[{self.name}] {reason}", kind=kind)
         if cause is not None:
             raise err from cause
         raise err
 
     def _admit(self, req: _GenRequest) -> None:
         """Fail-fast admission in the CALLER's thread: the injectable
-        ``serving.admit`` site plus the queue / pool / SLO checks —
-        every refusal is an immediate typed ShedError."""
+        ``serving.admit`` site plus the draining / queue / pool / SLO
+        checks — every refusal is an immediate typed ShedError."""
+        if _preemption.draining():
+            # preemption notice taken: NEVER park a new request toward
+            # the grace deadline — shed typed so the client re-queues
+            # on another replica or after the restart
+            self._shed("draining",
+                       "engine draining after a preemption notice; "
+                       "re-queue this request after the restart")
         try:
             _faults.inject("serving.admit")
         except _faults.FaultInjected as e:
@@ -752,9 +762,39 @@ class GenerativeEngine:
             req.t_done = time.monotonic()
             req.event.set()
 
+    def _requeue_for_drain(self) -> None:
+        """Preemption drain: queued-but-not-yet-prefilled requests are
+        handed BACK to their callers as typed ``draining`` sheds (their
+        pages were never allocated, their tokens never computed — a
+        resubmission after restart is token-exact by greedy
+        determinism), while LIVE rows keep decoding to completion.
+        That bounds the drain to the in-flight tail and guarantees 0
+        leaked pages once ``engine.waitall()`` returns."""
+        with self._cv:
+            reqs, self._queue = list(self._queue), deque()
+        for req in reqs:
+            self._stats.inc("shed")
+            self._stats.inc("shed_draining")
+            _telemetry.event("shed", self.name, shed_kind="draining",
+                             reason="queued request re-queued at drain")
+            _faults.record_event(
+                "serving.admit", "shed", model=self.name, kind="draining",
+                reason="queued request re-queued at drain",
+                tokens_done=len(req.out))
+            req.error = ShedError(
+                f"[{self.name}] draining after a preemption notice "
+                "before this request was scheduled; re-queue it after "
+                "the restart (greedy decode regenerates its "
+                f"{len(req.out)} partial token(s) token-exactly)",
+                kind="draining")
+            req.t_done = time.monotonic()
+            req.event.set()
+
     def _iteration(self) -> None:
         """One scheduler iteration: admit prefills into free rows, run
         one decode step over the union of live sequences, retire."""
+        if _preemption.draining():
+            self._requeue_for_drain()
         # -- join: newly arrived prefills slot into freed rows
         while len(self._live) < self._rows:
             with self._cv:

@@ -122,18 +122,25 @@ _NOTHING = object()
 
 
 def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
-    """Read an ``MXNET_*``-style env var (reference: dmlc::GetEnv at point of use)."""
+    """Read an ``MXNET_*``-style env var (reference: dmlc::GetEnv at
+    point of use).  dmlc parity shim for USER code reading arbitrary
+    names; in-tree knob reads must go through ``config.declare/get`` so
+    docs/ENV_VARS.md stays provably complete (graftlint
+    env-discipline)."""
+    # graftlint: disable=env-discipline -- user-facing dmlc::GetEnv shim
     return os.environ.get(name, default)
 
 
 def env_int(name: str, default: int = 0) -> int:
     try:
+        # graftlint: disable=env-discipline -- user-facing dmlc shim
         return int(os.environ.get(name, default))
     except (TypeError, ValueError):
         return default
 
 
 def env_bool(name: str, default: bool = False) -> bool:
+    # graftlint: disable=env-discipline -- user-facing dmlc shim
     val = os.environ.get(name)
     if val is None:
         return default

@@ -158,6 +158,7 @@ class TrainStep:
         # bit-exact vs the unpadded one and REFUSES bucketing on mismatch
         # (sticky, reason in bucket_refused) — numerics never change
         # silently.
+        # graftlint: disable=host-sync -- host python flag, not a device read
         self._bucket = bool(bucket)
         self.bucket_refused: Optional[str] = None
         self._bucket_verified: set = set()
@@ -236,6 +237,8 @@ class TrainStep:
         _DEFERRED_READ.inc()
         scaler = getattr(self._trainer, "_amp_loss_scaler", None)
         if scaler is not None:
+            # graftlint: disable=host-sync -- the deliberate deferred AMP
+            # gate read at drain time, counted via count_host_sync above
             overflow = not bool(prev)
             if overflow:
                 _telemetry.event("amp_overflow", "cached_step",
@@ -363,6 +366,8 @@ class TrainStep:
         if len(lt_leaves) != len(lp_leaves):
             return "padded loss structure differs from unpadded"
         for t, p in zip(lt_leaves, lp_leaves):
+            # graftlint: disable=host-sync -- one-time pad-safety verify
+            # per bucket signature, off the steady-state step path
             tn, pn = t.asnumpy(), p.asnumpy()
             if tn.shape != pn.shape or not onp.array_equal(tn, pn):
                 return ("padded loss differs from unpadded — the loss is "
@@ -804,6 +809,9 @@ class TrainStep:
                 if prev is not None:
                     _ndmod.count_host_sync()
                     _DEFERRED_READ.inc()
+                    # graftlint: disable=host-sync -- the ONE deferred AMP
+                    # gate read per step (lagged: never blocks the current
+                    # program), counted via count_host_sync
                     overflow = not bool(prev)
                     if overflow:
                         _telemetry.event("amp_overflow", "cached_step",
@@ -813,6 +821,8 @@ class TrainStep:
                 # the ONE host read of the step: the device all-finite
                 # flag drives the loss-scale policy synchronously
                 _ndmod.count_host_sync()
+                # graftlint: disable=host-sync -- the documented synchronous
+                # AMP gate read (MXNET_AMP_LAG=0 / NaiveEngine), counted
                 overflow = not bool(ok)
                 if overflow:
                     _telemetry.event("amp_overflow", "cached_step",

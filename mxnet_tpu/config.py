@@ -485,6 +485,51 @@ declare("MXNET_FAULT_EVENTS", int, 1024,
         "deadline, inject, degradation records).  Read once at import; "
         "fault events also mirror onto the telemetry bus with step "
         "indices.", validator=lambda v: v >= 1, subsystem="faults")
+declare("DMLC_ROLE", str, None,
+        "Process role for launcher-spawned jobs (reference ps-lite "
+        "DMLC_ROLE): 'worker' (default when unset), 'server', or "
+        "'scheduler'.  On TPU server/scheduler roles only park "
+        "(collectives replace parameter servers); see "
+        "kvstore/kvstore_server.py.", subsystem="kvstore", cached=False)
+declare("MXNET_ROLE", str, None,
+        "Fallback alias for DMLC_ROLE (checked second by "
+        "kvstore_server.role())", subsystem="kvstore", cached=False)
+declare("MXNET_TPU_COORDINATOR", str, None,
+        "host:port of process 0 for jax.distributed bootstrap (set by "
+        "tools/launch.py; unset = single-process)", subsystem="kvstore",
+        cached=False)
+declare("MXNET_TPU_NUM_PROCS", int, None,
+        "Multi-controller world size for jax.distributed bootstrap "
+        "(set by tools/launch.py alongside MXNET_TPU_COORDINATOR)",
+        subsystem="kvstore", cached=False)
+declare("MXNET_TPU_PROC_ID", int, None,
+        "This process' rank for jax.distributed bootstrap (set by "
+        "tools/launch.py alongside MXNET_TPU_COORDINATOR)",
+        subsystem="kvstore", cached=False)
+declare("MXNET_TPU_STOP_FILE", str, None,
+        "Path whose existence stops a parked 'server'/'scheduler' role "
+        "process (KVStoreServer.run poll loop)", subsystem="kvstore",
+        cached=False)
+declare("MXNET_LIBRARY_PATH", str, None,
+        "Override path to the native runtime library "
+        "(libinfo.find_lib_path; reference MXNET_LIBRARY_PATH)",
+        subsystem="io", cached=False)
+declare("MXNET_TEST_DEVICE", str, None,
+        "Device the test suite's default_context() targets, as "
+        "'kind[:index]' (e.g. 'gpu:0'); unset = the process default "
+        "context (reference test harness contract)",
+        subsystem="testing", cached=False)
+declare("MXNET_LINT_RUNTIME", int, 0,
+        "graftlint runtime concurrency layer (tools/lint/runtime.py): "
+        "1 = instrument threading.Lock/RLock acquisition and record "
+        "the cross-thread lock-order graph for the deadlock gate "
+        "(`python -m tools.lint --runtime`).  Read RAW pre-import by "
+        "the lint harness — instrumentation must install before "
+        "mxnet_tpu's module-level locks are created — and declared "
+        "here so this table documents it.  0 = off (default): "
+        "production processes pay zero overhead.",
+        validator=lambda v: v in (0, 1), subsystem="testing",
+        cached=False)
 declare("MXNET_MODULE_SEED", int, None,
         "Override the per-test RNG seed for reproduction (reference test "
         "harness contract)", subsystem="testing")

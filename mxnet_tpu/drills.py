@@ -365,6 +365,8 @@ def _cmd_decode(a) -> int:
             # is non-empty — notice now (delivered to the main thread)
             os.kill(os.getpid(), signal.SIGTERM)
 
+    # graftlint: daemon-ok(drill request workers, joined in-scope below
+    # before the drill writes its verdict)
     threads = [threading.Thread(target=worker, args=(r,)) for r in req_ids]
     for t in threads:
         t.start()

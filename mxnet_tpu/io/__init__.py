@@ -644,6 +644,9 @@ class PrefetchingIter(DataIter):
                     return
                 q.put(batch)
 
+        # graftlint: daemon-ok(generation-scoped prefetch worker over a
+        # HOST-side DataIter — staged batches hold no async device
+        # state; reset() drains-and-joins it before reuse)
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
 

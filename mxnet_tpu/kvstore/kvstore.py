@@ -166,6 +166,13 @@ class KVStore(KVStoreBase):
             t = threading.Thread(target=self._async_worker,
                                  args=(self._async_q,), daemon=True)
             t.start()
+            # the async push queue is outstanding host-side work:
+            # engine.waitall() / the preemption drain must flush it like
+            # every other async stage (graftlint thread-discipline), so
+            # a drained checkpoint can never miss an applied push
+            from .. import engine as _engine
+
+            _engine.register_drainable(self)
 
     # -- identity --------------------------------------------------------
     @property
@@ -296,6 +303,10 @@ class KVStore(KVStoreBase):
             self._async_q.join()
             if self._async_err:
                 raise self._async_err.pop(0)
+
+    # engine.waitall() drains registered dist_async stores: every queued
+    # push applied, absorbed worker errors re-raised at the wait point
+    drain = _drain_async
 
     def close(self):
         """Stop the dist_async pipeline thread (idempotent); surfaces any
@@ -579,6 +590,8 @@ class KVStore(KVStoreBase):
             finally:
                 done.set()
 
+        # graftlint: daemon-ok(bounded barrier watchdog: outcome joined
+        # via done.wait(timeout) right below; holds no queued work)
         threading.Thread(target=_sync, daemon=True,
                          name=f"kvstore-barrier-{self._barrier_count}").start()
         if not done.wait(timeout):

@@ -17,12 +17,14 @@ from __future__ import annotations
 import os
 import time
 
+from .. import config as _config
+
 __all__ = ["KVStoreServer", "init_distributed", "role"]
 
 
 def role() -> str:
-    return os.environ.get("DMLC_ROLE", os.environ.get("MXNET_ROLE",
-                                                      "worker"))
+    return (_config.get("DMLC_ROLE") or _config.get("MXNET_ROLE")
+            or "worker")
 
 
 def init_distributed() -> bool:
@@ -33,17 +35,23 @@ def init_distributed() -> bool:
       MXNET_TPU_NUM_PROCS    world size
       MXNET_TPU_PROC_ID      this process' rank
     """
-    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    coord = _config.get("MXNET_TPU_COORDINATOR")
     if not coord:
         return False
     import jax
 
     if getattr(init_distributed, "_done", False):
         return True
+    num_procs = _config.get("MXNET_TPU_NUM_PROCS")
+    proc_id = _config.get("MXNET_TPU_PROC_ID")
+    if num_procs is None or proc_id is None:
+        raise KeyError(
+            "MXNET_TPU_COORDINATOR is set but MXNET_TPU_NUM_PROCS/"
+            "MXNET_TPU_PROC_ID are not — tools/launch.py sets all three")
     jax.distributed.initialize(
         coordinator_address=coord,
-        num_processes=int(os.environ["MXNET_TPU_NUM_PROCS"]),
-        process_id=int(os.environ["MXNET_TPU_PROC_ID"]))
+        num_processes=int(num_procs),
+        process_id=int(proc_id))
     init_distributed._done = True
     return True
 
@@ -64,7 +72,7 @@ class KVStoreServer:
             raise RuntimeError("KVStoreServer.run() called in a worker "
                                "process")
         # park: reference servers block in the ps-lite event loop
-        stop_file = os.environ.get("MXNET_TPU_STOP_FILE")
+        stop_file = _config.get("MXNET_TPU_STOP_FILE")
         while True:
             if stop_file and os.path.exists(stop_file):
                 return

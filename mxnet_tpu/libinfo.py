@@ -18,7 +18,9 @@ def find_lib_path(prefix: str = "libmxnet_tpu_native"):
     """Paths to the native runtime libraries, env override first
     (reference libinfo.py find_lib_path).  Default returns the base
     runtime lib + the C-API lib when both are built."""
-    override = os.environ.get("MXNET_LIBRARY_PATH")
+    from . import config
+
+    override = config.get("MXNET_LIBRARY_PATH")
     if override and os.path.isfile(override):
         return [override]
     here = os.path.dirname(os.path.abspath(__file__))

@@ -21,10 +21,14 @@ import numpy as onp
 # pinned the JAX platform yet.  Honour JAX_PLATFORMS authoritatively via the
 # config — the axon sitecustomize can override the env var alone (same fix
 # as tests/conftest.py / __graft_entry__._force_virtual_cpu_mesh).
+# graftlint: disable=env-discipline -- pre-config bootstrap: a plain-C
+# host process reaches this before mxnet_tpu.config exists, and
+# JAX_PLATFORMS is jax's knob, not ours to declare
 if os.environ.get("JAX_PLATFORMS"):
     import jax
 
     try:
+        # graftlint: disable=env-discipline -- same bootstrap read
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     except Exception:
         pass  # backend already initialized by the host process

@@ -540,6 +540,8 @@ class HeartbeatMonitor:
 
     def start(self) -> "HeartbeatMonitor":
         self.beat()
+        # graftlint: daemon-ok(filesystem mtime heartbeat only — no
+        # queued work for waitall to miss; stop() joins it)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self

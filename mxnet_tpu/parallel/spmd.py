@@ -237,6 +237,8 @@ def _put_batch_once(arr, sharding: NamedSharding):
         # multi-controller: ``arr`` is this process's contiguous shard of
         # the global batch (the DataLoader num_shards contract); assemble
         # the global jax.Array from per-process local data
+        # graftlint: disable=host-sync -- ``arr`` is the HOST batch shard
+        # being staged to device, not a device array read back
         return jax.make_array_from_process_local_data(
             sharding, onp.asarray(arr))
     return jax.device_put(arr, sharding)

@@ -584,6 +584,8 @@ class GenerativeEngine:
         refuses (queue/pool/SLO) — overload is loud, never a hang."""
         if self._closed:
             raise RuntimeError("GenerativeEngine is closed")
+        # graftlint: disable=host-sync -- admission-time tokenization of
+        # the caller's HOST prompt, before any device work exists
         toks = [int(t) for t in onp.asarray(prompt).ravel()]
         if not toks:
             raise ValueError("generate() needs a non-empty prompt")
@@ -968,7 +970,10 @@ class GenerativeEngine:
                     nxt, k, v = rec(self._params, jnp.asarray(tokens),
                                     jnp.asarray(tables),
                                     jnp.asarray(lengths), k, v)
-                    nxt = onp.asarray(nxt)    # host read = real cost
+                    # graftlint: disable=host-sync -- THE one deliberate
+                    # host read per decode iteration (next-token ids feed
+                    # the host scheduler); the dispatch-budget gate counts it
+                    nxt = onp.asarray(nxt)
                     self._pool.set_storage(self._geom, k, v)
             finally:
                 self._pool.gate.release()

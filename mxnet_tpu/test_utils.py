@@ -63,7 +63,9 @@ def default_context() -> Context:
     global _DEFAULT_CTX
     if _DEFAULT_CTX is not None:
         return _DEFAULT_CTX
-    dev = os.environ.get("MXNET_TEST_DEVICE")
+    from . import config as _config
+
+    dev = _config.get("MXNET_TEST_DEVICE")
     if dev:
         from . import context as ctx_mod
 
@@ -387,6 +389,8 @@ def environment(*args):
         updates = {args[0]: args[1]}
     else:
         (updates,) = args
+    # graftlint: disable=env-discipline -- save/restore of arbitrary
+    # caller-chosen vars (the context manager's whole job), not a knob read
     saved = {k: os.environ.get(k) for k in updates}
     try:
         for k, v in updates.items():

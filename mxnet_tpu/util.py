@@ -173,9 +173,11 @@ def wrap_np_binary_func(fn):
 
 
 def getenv(name):
-    """Read an MXNET_* runtime flag (reference MXGetEnv)."""
+    """Read an MXNET_* runtime flag (reference MXGetEnv — public API
+    over arbitrary names; in-tree knob reads go through config.get)."""
     import os
 
+    # graftlint: disable=env-discipline -- reference MXGetEnv public API
     return os.environ.get(name)
 
 

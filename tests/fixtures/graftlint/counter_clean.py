@@ -1,0 +1,16 @@
+"""counter-discipline fixture: registry counters only."""
+from . import telemetry
+
+_FLUSHES = telemetry.counter("fixture.flushes", "fixture counter")
+
+
+class Pipe:
+    def __init__(self):
+        self._seq_count = 0           # private allocator: allowed
+
+    def flush(self):
+        _FLUSHES.inc()
+
+
+def flush_count() -> int:
+    return int(_FLUSHES)

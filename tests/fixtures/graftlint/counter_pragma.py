@@ -1,0 +1,14 @@
+"""counter-discipline fixture: suppressed with a reason."""
+
+# graftlint: disable=counter-discipline -- fixture: not a metric
+_LEGACY_COUNT = 0
+
+
+class Pipe:
+    def __init__(self):
+        # graftlint: disable=counter-discipline -- fixture: not a metric
+        self.flush_count = 0
+
+    def flush(self):
+        # graftlint: disable=counter-discipline -- fixture: not a metric
+        self.flush_count += 1

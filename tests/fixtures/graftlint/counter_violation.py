@@ -1,0 +1,11 @@
+"""counter-discipline fixture: raw pre-registry counter state."""
+
+_FLUSH_COUNT = 0                      # finding: raw global
+
+
+class Pipe:
+    def __init__(self):
+        self.flush_count = 0          # finding: raw public attr
+
+    def flush(self):
+        self.flush_count += 1         # finding: raw increment

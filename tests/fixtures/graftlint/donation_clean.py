@@ -1,0 +1,15 @@
+"""donation fixture: donated locals never read again."""
+import jax
+
+
+def train(params, grads, update, norm):
+    before = norm(params)             # read BEFORE donation: fine
+    step = jax.jit(update, donate_argnums=(0,))
+    params = step(params, grads)      # rebound: alive again
+    after = norm(params)
+    return before, after
+
+
+def undonated(x, f):
+    out = jax.jit(f)(x)               # no donation
+    return out, x

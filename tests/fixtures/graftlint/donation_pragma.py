@@ -1,0 +1,10 @@
+"""donation fixture: suppressed with a reason."""
+import jax
+
+
+def train(params, grads, update, norm):
+    step = jax.jit(update, donate_argnums=(0,))
+    new_params = step(params, grads)
+    # graftlint: disable=donation -- fixture: CPU backend, no aliasing
+    stale = norm(params)
+    return new_params, stale

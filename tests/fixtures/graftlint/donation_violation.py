@@ -1,0 +1,14 @@
+"""donation fixture: read-after-donate in one scope."""
+import jax
+
+
+def train(params, grads, update, norm):
+    step = jax.jit(update, donate_argnums=(0,))
+    new_params = step(params, grads)
+    stale = norm(params)              # finding: params was donated
+    return new_params, stale
+
+
+def inline(x, f):
+    out = jax.jit(f, donate_argnums=0)(x)
+    return out, x                     # finding: x was donated

@@ -1,0 +1,4 @@
+"""env-discipline fixture: every knob read goes through the registry."""
+from . import config
+
+ROLE = config.get("MXNET_FIXTURE_ROLE")

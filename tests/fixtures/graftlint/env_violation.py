@@ -1,0 +1,7 @@
+"""env-discipline fixture: raw reads outside config.py."""
+import os
+
+ROLE = os.environ.get("MXNET_FIXTURE_ROLE")          # finding
+PATH = os.getenv("MXNET_FIXTURE_PATH")               # finding
+RANK = os.environ["MXNET_FIXTURE_RANK"]              # finding
+os.environ["MXNET_FIXTURE_OUT"] = "1"                # write: allowed

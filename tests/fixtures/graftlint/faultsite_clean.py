@@ -1,0 +1,7 @@
+"""fault-site fixture: documented + tested site."""
+from . import faults
+
+
+def risky():
+    faults.inject("fixture.documented")
+    faults.retry_call(print, site="fixture.documented")

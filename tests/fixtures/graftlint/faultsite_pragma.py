@@ -1,0 +1,7 @@
+"""fault-site fixture: suppressed with a reason."""
+from . import faults
+
+
+def risky():
+    # graftlint: disable=fault-site -- fixture: site under construction
+    faults.inject("fixture.undocumented")

@@ -1,0 +1,6 @@
+"""fault-site fixture: a site missing from docs AND tests."""
+from . import faults
+
+
+def risky():
+    faults.inject("fixture.undocumented")             # 2 findings

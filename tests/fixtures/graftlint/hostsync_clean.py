@@ -1,0 +1,9 @@
+"""host-sync fixture: device-side work only."""
+import jax.numpy as jnp
+
+
+def hot_loop(arr, flag):
+    staged = jnp.asarray(arr)           # device-side, fine
+    scaled = staged * jnp.float32(2.0)
+    keep = bool(1)                      # literal arg, fine
+    return scaled, keep

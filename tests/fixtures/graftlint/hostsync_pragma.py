@@ -1,0 +1,7 @@
+"""host-sync fixture: the one deliberate sync point, documented."""
+
+
+def hot_loop(arr):
+    # graftlint: disable=host-sync -- fixture: THE deliberate host read
+    host = arr.asnumpy()
+    return host

@@ -1,0 +1,10 @@
+"""host-sync fixture (copied to cached_step.py in the tmp tree)."""
+import numpy as onp
+
+
+def hot_loop(arr, flag):
+    host = arr.asnumpy()            # finding
+    host2 = onp.asarray(arr)        # finding
+    scale = float(flag)             # finding
+    one = arr.item()                # finding
+    return host, host2, scale, one

@@ -1,0 +1,16 @@
+"""thread-discipline fixture: the thread's owner is a drainable."""
+import threading
+
+
+class Worker:
+    def __init__(self, engine):
+        self._q = []
+        self._t = threading.Thread(target=self._run, daemon=True)
+        engine.register_drainable(self)
+        self._t.start()
+
+    def _run(self):
+        pass
+
+    def drain(self):
+        pass

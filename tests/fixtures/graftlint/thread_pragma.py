@@ -1,0 +1,9 @@
+"""thread-discipline fixture: justified daemon thread."""
+import threading
+
+
+def start_watchdog():
+    # graftlint: daemon-ok(bounded fixture watchdog, joined by caller)
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+    return t

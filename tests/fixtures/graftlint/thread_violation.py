@@ -1,0 +1,8 @@
+"""thread-discipline fixture: a stray thread nothing drains."""
+import threading
+
+
+def start_worker():
+    t = threading.Thread(target=print, daemon=True)   # finding
+    t.start()
+    return t

@@ -1,0 +1,2 @@
+"""Repo tooling: CLI scripts (run directly) and the ``tools.lint``
+static-analysis package (``python -m tools.lint``)."""

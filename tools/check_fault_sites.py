@@ -9,6 +9,14 @@ fault-matrix suite (tests/test_faults.py) installs a FaultPlan against
 it and asserts the documented recovery.  New sites therefore cannot ship
 untested; the suite itself runs this check (tests/test_faults.py).
 
+Since graftlint landed, this is a thin wrapper over the shared AST walk
+(``tools.lint``): site collection is the ``fault-site`` rule's collector
+(one parse, real call nodes instead of a regex), and the full rule —
+which ADDITIONALLY requires every site to appear in docs/ROBUSTNESS.md's
+site table — runs via ``python -m tools.lint``.  This entrypoint keeps
+the original contract (tests-coverage only, same exit codes) so existing
+suite hooks don't break.
+
 Exit code 0 = every site covered; 1 = missing coverage (sites listed on
 stderr).  Usage: python tools/check_fault_sites.py [repo_root]
 """
@@ -19,9 +27,12 @@ import re
 import sys
 from typing import Dict, Set
 
-# inject("site") / inject('site') / site="site" / site='site'
-_SITE_RE = re.compile(
-    r"""(?:inject\(\s*|site\s*=\s*)["']([a-z0-9_.]+)["']""")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.lint import walk_package  # noqa: E402
+from tools.lint.rules import collect_fault_sites  # noqa: E402
 
 
 def _py_files(root: str):
@@ -32,15 +43,13 @@ def _py_files(root: str):
 
 
 def collect_sites(pkg_dir: str) -> Dict[str, Set[str]]:
-    """Site -> set of source files (relative) declaring it."""
-    sites: Dict[str, Set[str]] = {}
-    for path in _py_files(pkg_dir):
-        with open(path, encoding="utf-8") as f:
-            text = f.read()
-        for m in _SITE_RE.finditer(text):
-            sites.setdefault(m.group(1), set()).add(
-                os.path.relpath(path, os.path.dirname(pkg_dir)))
-    return sites
+    """Site -> set of source files (relative) declaring it — the
+    graftlint shared-walk collection."""
+    pkg_dir = os.path.abspath(pkg_dir)
+    ctx = walk_package(os.path.dirname(pkg_dir),
+                       os.path.basename(pkg_dir))
+    return {site: {src.rel for src, _node in decls}
+            for site, decls in collect_fault_sites(ctx).items()}
 
 
 def tested_sites(tests_dir: str, sites) -> Set[str]:
@@ -63,7 +72,7 @@ def main(root: str = None) -> int:
     sites = collect_sites(pkg)
     if not sites:
         print("check_fault_sites: no injection sites found under "
-              f"{pkg} — regex or layout broke", file=sys.stderr)
+              f"{pkg} — the shared walk or layout broke", file=sys.stderr)
         return 1
     covered = tested_sites(tests, sites)
     missing = sorted(set(sites) - covered)

@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import json
 import os
-import re
 import sys
 import tempfile
 from typing import Dict, List, Set, Tuple
@@ -40,18 +39,8 @@ from typing import Dict, List, Set, Tuple
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-# accessor defs: `def foo_count(` at module or class level, public only
-_ACCESSOR_RE = re.compile(
-    r"^\s*def ([a-zA-Z][a-zA-Z0-9_]*_count)\s*\(", re.M)
-# raw module-global counter state, the pre-telemetry idiom
-_RAW_GLOBAL_RE = re.compile(r"^_[A-Z0-9_]*_COUNT[S]?\s*=\s*\d", re.M)
-# raw PUBLIC instance-attribute counter state (private `self._x_count`
-# attrs are sequence/id allocators by convention, not metrics)
-_RAW_ATTR_RE = re.compile(r"self\.([a-z0-9][a-z0-9_]*_count)\s*=\s*\d")
-# attribute names that are loop-local bookkeeping, not metrics
-_ATTR_ALLOW = {"last_count", "step_count"}
-# accessors that RESET rather than read (reset_host_sync_count)
-_ACCESSOR_SKIP_PREFIXES = ("reset_",)
+from tools.lint import walk_package  # noqa: E402
+from tools.lint import rules as _lint_rules  # noqa: E402
 
 
 def _py_files(root: str):
@@ -63,34 +52,25 @@ def _py_files(root: str):
                 yield os.path.join(dirpath, f)
 
 
+def _walk(pkg_dir: str):
+    pkg_dir = os.path.abspath(pkg_dir)
+    return walk_package(os.path.dirname(pkg_dir),
+                        os.path.basename(pkg_dir))
+
+
 def collect_accessors(pkg_dir: str) -> Dict[str, Set[str]]:
-    """Accessor base name (minus ``_count``) -> files declaring it."""
-    out: Dict[str, Set[str]] = {}
-    for path in _py_files(pkg_dir):
-        with open(path, encoding="utf-8") as f:
-            text = f.read()
-        rel = os.path.relpath(path, os.path.dirname(pkg_dir))
-        for m in _ACCESSOR_RE.finditer(text):
-            name = m.group(1)
-            if name.startswith(_ACCESSOR_SKIP_PREFIXES):
-                continue
-            out.setdefault(name[: -len("_count")], set()).add(rel)
-    return out
+    """Accessor base name (minus ``_count``) -> files declaring it.
+    Since graftlint: the shared AST walk's collection (real FunctionDef
+    nodes, public non-``reset_*`` names) instead of a regex."""
+    return _lint_rules.collect_accessors(_walk(pkg_dir))
 
 
 def collect_raw_state(pkg_dir: str) -> List[str]:
-    """Forbidden pre-registry counter state still in the tree."""
-    bad: List[str] = []
-    for path in _py_files(pkg_dir):
-        with open(path, encoding="utf-8") as f:
-            text = f.read()
-        rel = os.path.relpath(path, os.path.dirname(pkg_dir))
-        for m in _RAW_GLOBAL_RE.finditer(text):
-            bad.append(f"{rel}: {m.group(0).strip()}")
-        for m in _RAW_ATTR_RE.finditer(text):
-            if m.group(1) not in _ATTR_ALLOW:
-                bad.append(f"{rel}: {m.group(0).strip()}")
-    return bad
+    """Forbidden pre-registry counter state still in the tree — the
+    graftlint ``counter-discipline`` rule's collection (module-global
+    ``_X_COUNT = <n>`` and public ``self.x_count = <n>``)."""
+    return sorted(f"{src.rel}: {what}" for src, _node, what
+                  in _lint_rules.collect_raw_state(_walk(pkg_dir)))
 
 
 def _base_matches_segment(base: str, seg: str) -> bool:

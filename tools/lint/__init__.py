@@ -1,0 +1,34 @@
+"""graftlint — AST-based invariant linter for the ``mxnet_tpu`` runtime.
+
+The reference framework's ThreadedEngine made concurrency safe *by
+construction*: every mutation flowed through a dependency-tracking
+scheduler, so "did you register your async work?" was not a question a
+reviewer had to ask.  Our JAX port re-introduced free-threaded host code
+(prefetcher, async checkpoint writer, serving stager/dispatcher,
+telemetry bus, preemption drain) whose safety invariants lived only in
+prose (docs/ROBUSTNESS.md, docs/OBSERVABILITY.md) and in disjoint
+regex-based CI gates.  graftlint makes those invariants *machine
+checkable at the source level*: one AST walk over ``mxnet_tpu/``, a
+registered rule set over it, pragma suppressions with reasons, a
+checked-in baseline for grandfathered findings (target: empty), and
+machine-readable JSON output — plus a runtime lock-order detector
+(``tools.lint.runtime``) that records the cross-thread lock-acquisition
+graph over a real train-step + decode + preemption-drain scenario and
+fails on ordering cycles.
+
+Entry points::
+
+    python -m tools.lint --all          # static rules + runtime detector
+    python -m tools.lint --static       # static rules only
+    python -m tools.lint --runtime      # lock-order scenario (fresh
+                                        # process; import nothing first)
+
+See docs/STATIC_ANALYSIS.md for the rule catalog, pragma syntax,
+baseline policy, and the add-a-rule checklist.
+"""
+from .core import (Finding, LintContext, Source, RULES, rule,  # noqa: F401
+                   load_baseline, run_static, walk_package)
+from . import rules as _rules  # noqa: F401  (registers the rule set)
+
+__all__ = ["Finding", "LintContext", "Source", "RULES", "rule",
+           "run_static", "walk_package", "load_baseline"]

@@ -1,0 +1,173 @@
+"""graftlint CLI.
+
+    python -m tools.lint --all            # static + runtime lock-order
+    python -m tools.lint --static         # static rules only
+    python -m tools.lint --runtime        # lock-order scenario (must be
+                                          # a fresh process; --all
+                                          # spawns one)
+    python -m tools.lint --list-rules
+    python -m tools.lint --rules env-discipline,host-sync
+    python -m tools.lint --disable donation
+    python -m tools.lint --all --json benchmark/artifacts/graftlint.json
+
+Exit code 0 = no non-baseline findings (and, when the runtime layer
+ran, an acyclic lock-acquisition graph); 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.lint import RULES, load_baseline, run_static  # noqa: E402
+from tools.lint import runtime as _runtime  # noqa: E402
+
+
+def _run_runtime_subprocess(root: str, timeout: float) -> Dict[str, Any]:
+    """The scenario needs module-level locks instrumented, i.e. a
+    process that enables instrumentation BEFORE importing mxnet_tpu —
+    spawn one."""
+    env = dict(os.environ)
+    env["MXNET_LINT_RUNTIME"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--runtime", "--json", "-"],
+        capture_output=True, text=True, timeout=timeout, cwd=root,
+        env=env)
+    if proc.returncode not in (0, 1):
+        return {"error": f"runtime scenario exited {proc.returncode}",
+                "stderr": proc.stderr[-4000:]}
+    try:
+        # --json - prints the report as the last stdout line
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "runtime scenario produced no JSON report",
+                "stdout": proc.stdout[-2000:],
+                "stderr": proc.stderr[-4000:]}
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="graftlint: AST invariant linter + runtime "
+                    "lock-order detector for mxnet_tpu")
+    p.add_argument("--all", action="store_true",
+                   help="static rules + runtime lock-order scenario")
+    p.add_argument("--static", action="store_true",
+                   help="static rules only")
+    p.add_argument("--runtime", action="store_true",
+                   help="runtime lock-order scenario (fresh process "
+                        "only: nothing may have imported mxnet_tpu)")
+    p.add_argument("--rules", default=None,
+                   help="comma list: run only these rules")
+    p.add_argument("--disable", default="",
+                   help="comma list: skip these rules")
+    p.add_argument("--root", default=_REPO, help="repo root")
+    p.add_argument("--pkg", default="mxnet_tpu",
+                   help="package dir (relative to root) to lint")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default tools/lint/baseline.json)")
+    p.add_argument("--json", dest="json_path", default=None,
+                   help="write the machine-readable report here "
+                        "('-' = stdout)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--runtime-timeout", type=float, default=600.0)
+    a = p.parse_args(argv)
+
+    if a.list_rules:
+        for name, r in sorted(RULES.items()):
+            print(f"{name:<20} {r.doc}")
+        return 0
+
+    if not (a.all or a.static or a.runtime):
+        a.static = True        # bare invocation = static lint
+
+    report: Dict[str, Any] = {"root": a.root, "pkg": a.pkg}
+    rc = 0
+
+    if a.all or a.static:
+        only = set(a.rules.split(",")) if a.rules else None
+        disable = {r for r in a.disable.split(",") if r}
+        unknown = ((only or set()) | disable) - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)}; see --list-rules",
+                  file=sys.stderr)
+            return 2
+        findings, ctx = run_static(a.root, a.pkg, only=only,
+                                   disable=disable)
+        baseline = load_baseline(a.baseline)
+        live = [f for f in findings if f.key not in baseline]
+        grandfathered = len(findings) - len(live)
+        report["static"] = {
+            "findings": [f.to_json() for f in live],
+            "grandfathered": grandfathered,
+            "suppressed": ctx.suppressed,
+            "files": len(ctx.sources),
+            "rules": sorted((only or set(RULES)) - disable),
+        }
+        for f in live:
+            print(str(f), file=sys.stderr)
+        if live:
+            rc = 1
+        print(f"graftlint static: {len(ctx.sources)} files, "
+              f"{len(report['static']['rules'])} rules, "
+              f"{len(live)} findings ({grandfathered} baselined, "
+              f"{ctx.suppressed} pragma-suppressed)")
+
+    if a.runtime and not a.all:
+        # in-process scenario: only valid in a fresh interpreter
+        try:
+            rt = _runtime.run_scenario()
+        except RuntimeError as e:
+            print(f"graftlint runtime: {e}", file=sys.stderr)
+            return 2
+        report["runtime"] = rt
+    elif a.all:
+        rt = _run_runtime_subprocess(a.root, a.runtime_timeout)
+        report["runtime"] = rt
+
+    rt = report.get("runtime")
+    if rt is not None:
+        if rt.get("error"):
+            print(f"graftlint runtime: FAILED — {rt['error']}",
+                  file=sys.stderr)
+            if rt.get("stderr"):
+                print(rt["stderr"], file=sys.stderr)
+            rc = 1
+        else:
+            cycles = rt.get("cycles", [])
+            print(f"graftlint runtime: {rt['locks']} locks, "
+                  f"{rt['acquisitions']} acquisitions, "
+                  f"{len(rt['edges'])} order edges, "
+                  f"{len(cycles)} cycles")
+            if cycles:
+                print("LOCK-ORDER CYCLES (potential deadlock):",
+                      file=sys.stderr)
+                for c in cycles:
+                    print("  " + " <-> ".join(c), file=sys.stderr)
+                rc = 1
+
+    if a.json_path:
+        blob = json.dumps(report, indent=2, sort_keys=True)
+        if a.json_path == "-":
+            print(blob if not a.runtime or a.all
+                  else json.dumps(report.get("runtime", report)))
+        else:
+            os.makedirs(os.path.dirname(os.path.abspath(a.json_path)),
+                        exist_ok=True)
+            with open(a.json_path, "w") as f:
+                f.write(blob + "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

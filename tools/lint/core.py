@@ -1,0 +1,336 @@
+"""graftlint core: one parse per file, a rule registry over the shared
+walk, pragma suppression, and the baseline.
+
+Design (the Relay argument, arXiv:1810.00952, applied to our own
+runtime): make the program structure explicit ONCE — ``Source`` parses a
+file into an AST with a by-node-type index, parent links, and the pragma
+map — and every invariant becomes a small pure function over that
+structure instead of a bespoke regex scanner.  Rules implement either
+``check(src, ctx)`` (per-file) or ``collect(src, ctx)`` +
+``finalize(ctx)`` (cross-file: fault sites vs the docs table, counter
+accessors vs the registry).
+
+Suppression pragmas (always carry a reason — a bare switch-off is a
+review smell the syntax refuses):
+
+    # graftlint: disable=<rule>[,<rule>...] -- <reason>
+    # graftlint: daemon-ok(<reason>)            (thread-discipline only)
+
+A pragma suppresses findings for any node whose line span touches the
+pragma line, so multi-line calls annotate naturally.  Suppressed
+findings are counted (``suppressed`` in the JSON report) — silence is
+visible, never free.
+
+Baseline: ``tools/lint/baseline.json`` holds grandfathered finding keys
+(``rule::path::message``, line-number free so edits don't churn it).
+The shipped baseline is EMPTY for ``mxnet_tpu/`` — every historical
+finding was either fixed or pragma'd with a reason in the PR that
+introduced the linter; the file exists so a future emergency landing
+has a documented escape hatch (see docs/STATIC_ANALYSIS.md).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set
+
+__all__ = ["Finding", "Source", "LintContext", "RULES", "rule",
+           "walk_package", "run_static", "load_baseline", "PRAGMA_RE"]
+
+# daemon-ok's closing paren is optional so reasons may wrap onto the
+# next comment line
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*"
+    r"(?:disable=(?P<rules>[a-z0-9_,-]+)(?:\s*--\s*(?P<reason>.*))?"
+    r"|daemon-ok\((?P<daemon_reason>[^)\n]*)\)?)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: line-free so unrelated edits above a
+        grandfathered finding don't churn the baseline file."""
+        return f"{self.rule}::{self.path}::{self.message}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: " \
+               f"[{self.rule}] {self.message}"
+
+
+class Source:
+    """One parsed file: AST + node index + parent links + pragmas."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # one walk builds everything rules need: nodes grouped by type
+        # and child -> parent links (enclosing-scope queries)
+        self._by_type: Dict[type, List[ast.AST]] = {}
+        self._parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            self._by_type.setdefault(type(node), []).append(node)
+            for child in ast.iter_child_nodes(node):
+                self._parent[child] = node
+        # pragma maps: line -> disabled rule set / daemon-ok reason
+        self.disabled_at: Dict[int, Set[str]] = {}
+        self.daemon_ok_at: Dict[int, str] = {}
+        for i, line in enumerate(self.lines, start=1):
+            if "graftlint" not in line:
+                continue
+            m = PRAGMA_RE.search(line)
+            if not m:
+                continue
+            if m.group("rules"):
+                self.disabled_at.setdefault(i, set()).update(
+                    r.strip() for r in m.group("rules").split(",") if r)
+            else:
+                self.daemon_ok_at[i] = (m.group("daemon_reason")
+                                        or "").strip()
+
+    # -- queries ---------------------------------------------------------
+    def nodes(self, *types: type) -> Iterable[ast.AST]:
+        for t in types:
+            yield from self._by_type.get(t, ())
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parent.get(node)
+
+    def enclosing(self, node: ast.AST, *types: type) -> Optional[ast.AST]:
+        """Nearest ancestor of one of ``types`` (e.g. the enclosing
+        FunctionDef / ClassDef), or None."""
+        cur = self._parent.get(node)
+        while cur is not None:
+            if isinstance(cur, types):
+                return cur
+            cur = self._parent.get(cur)
+        return None
+
+    def _span(self, node: ast.AST) -> range:
+        """The line span pragmas apply over: the ENCLOSING STATEMENT's
+        lines (a flagged call may sit on a continuation line), extended
+        upward through the contiguous comment block immediately above —
+        pragmas with long reasons sit on their own lines."""
+        stmt: ast.AST = node
+        cur: Optional[ast.AST] = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self._parent.get(cur)
+        if cur is not None:
+            stmt = cur
+        lo = getattr(stmt, "lineno", 0)
+        hi = getattr(stmt, "end_lineno", lo) or lo
+        hi = max(hi, getattr(node, "end_lineno", 0) or 0)
+        while lo > 1 and self.lines[lo - 2].lstrip().startswith("#"):
+            lo -= 1
+        return range(lo, hi + 1)
+
+    def disabled(self, rule_name: str, node: ast.AST) -> bool:
+        """True when a ``disable=`` pragma touches the node's line span
+        (or the span's first line ends with one — decorators excluded)."""
+        for ln in self._span(node):
+            rules = self.disabled_at.get(ln)
+            if rules and (rule_name in rules or "all" in rules):
+                return True
+        return False
+
+    def daemon_ok(self, node: ast.AST) -> Optional[str]:
+        """The ``daemon-ok(<reason>)`` pragma reason touching the node's
+        span, if any (empty reasons don't count — the syntax demands a
+        justification)."""
+        for ln in self._span(node):
+            reason = self.daemon_ok_at.get(ln)
+            if reason:
+                return reason
+        return None
+
+
+@dataclass
+class LintContext:
+    """Shared cross-file state for one lint run."""
+    root: str
+    pkg_rel: str = "mxnet_tpu"
+    sources: List[Source] = field(default_factory=list)
+    data: Dict[str, Any] = field(default_factory=dict)
+    suppressed: int = 0
+
+    def doc_text(self, *rel: str) -> str:
+        path = os.path.join(self.root, *rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def tests_blob(self) -> str:
+        """Concatenated text of tests/ (cached) — the "does a test name
+        this literal" corpus shared by the fault-site and counter
+        rules."""
+        blob = self.data.get("_tests_blob")
+        if blob is None:
+            parts = []
+            for path in _py_files(os.path.join(self.root, "tests")):
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        parts.append(f.read())
+                except OSError:
+                    pass
+            blob = self.data["_tests_blob"] = "\n".join(parts)
+        return blob
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+class Rule:
+    """Base rule.  Subclasses set ``name``/``doc`` and implement
+    ``check`` (per-file) and/or ``collect`` + ``finalize``
+    (cross-file)."""
+
+    name: str = ""
+    doc: str = ""
+
+    def check(self, src: Source, ctx: LintContext) -> Iterable[Finding]:
+        return ()
+
+    def collect(self, src: Source, ctx: LintContext) -> None:
+        pass
+
+    def finalize(self, ctx: LintContext) -> Iterable[Finding]:
+        return ()
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(cls: Callable[[], Rule]) -> Callable[[], Rule]:
+    """Class decorator: instantiate and register."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"rule {cls!r} has no name")
+    if inst.name in RULES:
+        raise ValueError(f"duplicate rule {inst.name!r}")
+    RULES[inst.name] = inst
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# walking + running
+# ---------------------------------------------------------------------------
+
+def _py_files(root: str) -> Iterable[str]:
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+# parsed-tree cache: several gates walk the same unchanged tree in one
+# process (check_fault_sites, check_telemetry x2, the suite's real-tree
+# run).  Keyed by (root, pkg) and VALIDATED against a per-file
+# (path, mtime_ns, size) snapshot — an edited file invalidates the
+# entry, so interactive relint stays correct.  Source objects are
+# immutable after construction; every hit still gets a FRESH
+# LintContext (rules mutate ctx.data / ctx.suppressed).
+_WALK_CACHE: Dict[tuple, tuple] = {}
+
+
+def _tree_sig(pkg_dir: str) -> tuple:
+    sig = []
+    for path in _py_files(pkg_dir):
+        try:
+            st = os.stat(path)
+            sig.append((path, st.st_mtime_ns, st.st_size))
+        except OSError:
+            sig.append((path, -1, -1))
+    return tuple(sig)
+
+
+def walk_package(root: str, pkg_rel: str = "mxnet_tpu") -> LintContext:
+    """Parse every ``.py`` under ``root/pkg_rel`` once into a
+    LintContext.  A file that fails to parse becomes a synthetic
+    ``parse-error`` finding downstream (stored in ctx.data)."""
+    root = os.path.abspath(root)
+    pkg_dir = os.path.join(root, pkg_rel)
+    key = (root, pkg_rel)
+    sig = _tree_sig(pkg_dir)
+    hit = _WALK_CACHE.get(key)
+    if hit is not None and hit[0] == sig:
+        sources, errors = hit[1], hit[2]
+    else:
+        sources, errors = [], []
+        for path in _py_files(pkg_dir):
+            rel = os.path.relpath(path, root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+                sources.append(Source(path, rel, text))
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                line = getattr(e, "lineno", 0) or 0
+                errors.append(
+                    Finding("parse-error", rel.replace(os.sep, "/"),
+                            line, 0, f"cannot lint: {e}"))
+        _WALK_CACHE[key] = (sig, sources, errors)
+    ctx = LintContext(root=root, pkg_rel=pkg_rel)
+    ctx.sources = list(sources)
+    ctx.data["parse_errors"] = list(errors)
+    return ctx
+
+
+def run_static(root: str, pkg_rel: str = "mxnet_tpu",
+               only: Optional[Set[str]] = None,
+               disable: Set[str] = frozenset(),
+               ctx: Optional[LintContext] = None
+               ) -> tuple[List[Finding], LintContext]:
+    """Run the registered static rules over one shared walk.  Returns
+    (findings, ctx); pragma-suppressed findings are dropped (counted in
+    ``ctx.suppressed``), baseline filtering is the caller's job (CLI)."""
+    if ctx is None:
+        ctx = walk_package(root, pkg_rel)
+    active = [r for n, r in sorted(RULES.items())
+              if (only is None or n in only) and n not in disable]
+    findings: List[Finding] = list(ctx.data.get("parse_errors", ()))
+    for r in active:
+        for src in ctx.sources:
+            r.collect(src, ctx)
+    for r in active:
+        for src in ctx.sources:
+            for f in r.check(src, ctx):
+                findings.append(f)
+    for r in active:
+        findings.extend(r.finalize(ctx))
+    # pragma suppression happens inside rules (they hold the node); any
+    # finding reaching here is live.  Deterministic order:
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings, ctx
+
+
+def load_baseline(path: Optional[str] = None) -> Set[str]:
+    """Grandfathered finding keys (see Finding.key)."""
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "baseline.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return set()
+    return set(data.get("findings", []))

@@ -1,0 +1,506 @@
+"""The graftlint rule set — each rule is one invariant the codebase
+already enforces by convention, now machine-checked at the source level.
+
+Catalog (docs/STATIC_ANALYSIS.md is the user-facing version):
+
+  env-discipline      every env read outside config.py goes through the
+                      typed registry (config.declare/get) — otherwise
+                      docs/ENV_VARS.md regeneration silently misses it
+  thread-discipline   every threading.Thread started in mxnet_tpu/ is
+                      either owned by an engine drainable or pragma'd
+                      daemon-ok(<reason>) — engine.waitall()/preemption
+                      drain must never silently miss a queue
+  host-sync           no implicit device→host reads in the declared
+                      hot-path modules outside pragma'd sync points —
+                      the dispatch-budget discipline, statically
+  fault-site          every faults.inject("<site>") literal appears in
+                      docs/ROBUSTNESS.md's site table AND in a test
+  counter-discipline  counter state lives in the telemetry registry:
+                      raw counter globals/attrs and *_count += 1
+                      increments outside the registry are forbidden
+  donation            no read of a local after it was passed in a
+                      donated position of a jit'd call in the same
+                      scope (XLA may already have aliased the buffer)
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, LintContext, Rule, Source, rule
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'os.environ.get' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _finding(rule_name: str, src: Source, node: ast.AST,
+             message: str) -> Finding:
+    return Finding(rule_name, src.rel, getattr(node, "lineno", 0),
+                   getattr(node, "col_offset", 0), message)
+
+
+# ---------------------------------------------------------------------------
+# env-discipline
+# ---------------------------------------------------------------------------
+
+# os.environ.pop is a WRITE (save/restore paths use it); the rule is
+# about reads — pop-as-read is rare enough to stay out of scope
+_ENV_READ_CALLS = {"os.getenv", "os.environ.get"}
+
+
+@rule
+class EnvDiscipline(Rule):
+    name = "env-discipline"
+    doc = ("environment reads outside config.py must go through "
+           "config.declare/get so the generated docs/ENV_VARS.md table "
+           "is provably complete")
+
+    def check(self, src: Source, ctx: LintContext) -> Iterable[Finding]:
+        if src.rel.endswith("config.py"):
+            return
+        for node in src.nodes(ast.Call):
+            name = _dotted(node.func)
+            if name in _ENV_READ_CALLS:
+                if src.disabled(self.name, node):
+                    ctx.suppressed += 1
+                    continue
+                yield _finding(self.name, src, node,
+                               f"raw environment read ({name}); declare "
+                               "the knob in mxnet_tpu/config.py and read "
+                               "it via config.get")
+        for node in src.nodes(ast.Subscript):
+            if not isinstance(node.ctx, ast.Load):
+                continue
+            if _dotted(node.value) == "os.environ":
+                if src.disabled(self.name, node):
+                    ctx.suppressed += 1
+                    continue
+                yield _finding(self.name, src, node,
+                               "raw environment read (os.environ[...]); "
+                               "declare the knob in mxnet_tpu/config.py "
+                               "and read it via config.get")
+
+
+# ---------------------------------------------------------------------------
+# thread-discipline
+# ---------------------------------------------------------------------------
+
+def _scope_registers_drainable(src: Source, node: ast.AST) -> bool:
+    """True when the enclosing class (or, for module-level threads, the
+    enclosing function) contains a register_drainable(...) call — the
+    thread then belongs to an object engine.waitall() drains."""
+    scope = src.enclosing(node, ast.ClassDef) \
+        or src.enclosing(node, ast.FunctionDef, ast.AsyncFunctionDef)
+    if scope is None:
+        return False
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call):
+            fn = _dotted(n.func) or ""
+            if fn.split(".")[-1] == "register_drainable":
+                return True
+    return False
+
+
+@rule
+class ThreadDiscipline(Rule):
+    name = "thread-discipline"
+    doc = ("every threading.Thread started inside mxnet_tpu/ must belong "
+           "to an engine drainable (register_drainable in the same "
+           "class/function) or carry '# graftlint: daemon-ok(<reason>)' "
+           "— otherwise engine.waitall()/the preemption drain can "
+           "silently miss its queue")
+
+    def check(self, src: Source, ctx: LintContext) -> Iterable[Finding]:
+        from_imports = {
+            a.asname or a.name
+            for n in src.nodes(ast.ImportFrom) if n.module == "threading"
+            for a in n.names}
+        for node in src.nodes(ast.Call):
+            fn = _dotted(node.func)
+            is_thread = fn == "threading.Thread" or (
+                fn == "Thread" and "Thread" in from_imports)
+            if not is_thread:
+                continue
+            if src.daemon_ok(node) is not None:
+                ctx.suppressed += 1
+                continue
+            if src.disabled(self.name, node):
+                ctx.suppressed += 1
+                continue
+            if _scope_registers_drainable(src, node):
+                continue
+            yield _finding(
+                self.name, src, node,
+                "thread started outside the drainable registry; register "
+                "the owning object with engine.register_drainable or "
+                "pragma the line '# graftlint: daemon-ok(<reason>)'")
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+HOT_PATH_MODULES = (
+    "mxnet_tpu/cached_step.py",
+    "mxnet_tpu/serving_decode.py",
+    "mxnet_tpu/engine.py",
+    "mxnet_tpu/parallel/spmd.py",
+)
+
+_SYNC_ATTR_CALLS = {"asnumpy", "item", "tolist", "block_until_ready"}
+_SYNC_FN_CALLS = {"np.asarray", "onp.asarray", "numpy.asarray",
+                  "jax.device_get", "jax.block_until_ready"}
+_SYNC_CASTS = {"float", "bool"}
+
+
+@rule
+class HostSync(Rule):
+    name = "host-sync"
+    doc = ("no implicit device→host reads (float()/bool() on arrays, "
+           ".item()/.asnumpy()/.tolist(), np.asarray, device_get, "
+           "block_until_ready) in the declared hot-path modules outside "
+           "pragma'd sync points — the dispatch-budget discipline "
+           "checked at the source, not just at runtime")
+
+    def check(self, src: Source, ctx: LintContext) -> Iterable[Finding]:
+        if src.rel not in HOT_PATH_MODULES:
+            return
+        for node in src.nodes(ast.Call):
+            what = self._classify(node)
+            if what is None:
+                continue
+            if src.disabled(self.name, node):
+                ctx.suppressed += 1
+                continue
+            yield _finding(
+                self.name, src, node,
+                f"potential device→host sync ({what}) in a declared "
+                "hot-path module; move it off the hot path or mark the "
+                "deliberate sync point with '# graftlint: "
+                "disable=host-sync -- <reason>'")
+
+    @staticmethod
+    def _classify(node: ast.Call) -> Optional[str]:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_ATTR_CALLS:
+            return f".{node.func.attr}()"
+        fn = _dotted(node.func)
+        if fn in _SYNC_FN_CALLS:
+            return fn
+        # float(x)/bool(x) over a plain name/attribute — a device scalar
+        # forced to host.  Constants (float("inf")) and call results
+        # (bool(config.get(...))) are host-side already.
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in _SYNC_CASTS and len(node.args) == 1 \
+                and isinstance(node.args[0], (ast.Name, ast.Attribute)):
+            return f"{node.func.id}()"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# fault-site
+# ---------------------------------------------------------------------------
+
+_SITE_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
+
+
+def collect_fault_sites(ctx: LintContext
+                        ) -> Dict[str, List[Tuple[Source, ast.AST]]]:
+    """site -> [(source, node)] for every ``inject("<site>")`` literal
+    and ``site="<site>"`` keyword in the walked package (the
+    check_fault_sites gate reuses this collection)."""
+    sites = ctx.data.get("fault_sites")
+    if sites is not None:
+        return sites
+    sites = {}
+    for src in ctx.sources:
+        for node in src.nodes(ast.Call):
+            fn = _dotted(node.func) or ""
+            if fn.split(".")[-1] == "inject" and node.args:
+                s = _str_const(node.args[0])
+                if s and _SITE_NAME_RE.match(s):
+                    sites.setdefault(s, []).append((src, node))
+            for kw in node.keywords:
+                if kw.arg == "site":
+                    s = _str_const(kw.value)
+                    if s and _SITE_NAME_RE.match(s):
+                        sites.setdefault(s, []).append((src, node))
+    ctx.data["fault_sites"] = sites
+    return sites
+
+
+@rule
+class FaultSite(Rule):
+    name = "fault-site"
+    doc = ("every faults.inject('<site>') / retry_call(site=...) literal "
+           "must appear in docs/ROBUSTNESS.md's site table (documented "
+           "recovery) and in at least one test (exercised recovery)")
+
+    def finalize(self, ctx: LintContext) -> Iterable[Finding]:
+        sites = collect_fault_sites(ctx)
+        if not sites:
+            return
+        doc = ctx.doc_text("docs", "ROBUSTNESS.md")
+        tests = ctx.tests_blob()
+        for site, decls in sorted(sites.items()):
+            src, node = decls[0]
+            if src.disabled(self.name, node):
+                ctx.suppressed += 1
+                continue
+            if f"`{site}`" not in doc:
+                yield _finding(
+                    self.name, src, node,
+                    f"fault site '{site}' is missing from the "
+                    "docs/ROBUSTNESS.md site table — document its "
+                    "recovery before shipping it")
+            if not re.search(r"""["']""" + re.escape(site) + r"""["']""",
+                             tests):
+                yield _finding(
+                    self.name, src, node,
+                    f"fault site '{site}' appears in no test under "
+                    "tests/ — install a FaultPlan against it and assert "
+                    "the documented recovery")
+
+
+# ---------------------------------------------------------------------------
+# counter-discipline
+# ---------------------------------------------------------------------------
+
+_RAW_GLOBAL_NAME = re.compile(r"^_[A-Z0-9_]*_COUNTS?$")
+_COUNTERISH_ATTR = re.compile(r"^[a-z0-9][a-z0-9_]*_count$")
+_ATTR_ALLOW = {"last_count", "step_count"}
+_ACCESSOR_SKIP_PREFIXES = ("reset_",)
+
+
+def collect_accessors(ctx: LintContext) -> Dict[str, Set[str]]:
+    """Public ``def <base>_count(...)`` accessors: base -> {rel paths}.
+    The check_telemetry gate cross-checks these against the runtime
+    counter registry (shared-walk replacement for its old regex)."""
+    acc = ctx.data.get("accessors")
+    if acc is not None:
+        return acc
+    acc = {}
+    for src in ctx.sources:
+        for node in src.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            name = node.name
+            if not name.endswith("_count") or name.startswith("_") \
+                    or name.startswith(_ACCESSOR_SKIP_PREFIXES):
+                continue
+            acc.setdefault(name[: -len("_count")], set()).add(src.rel)
+    ctx.data["accessors"] = acc
+    return acc
+
+
+def collect_raw_state(ctx: LintContext) -> List[Tuple[Source, ast.AST, str]]:
+    """Raw (non-registry) counter state: module globals ``_X_COUNT = 0``
+    and public ``self.x_count = <n>`` attributes."""
+    raw = ctx.data.get("raw_counter_state")
+    if raw is not None:
+        return raw
+    raw = []
+    for src in ctx.sources:
+        for node in src.nodes(ast.Assign):
+            if not (isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, (int, float))
+                    and not isinstance(node.value.value, bool)):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) \
+                        and _RAW_GLOBAL_NAME.match(tgt.id) \
+                        and isinstance(src.parent(node), ast.Module):
+                    raw.append((src, node, f"{tgt.id} = ..."))
+                elif isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self" \
+                        and _COUNTERISH_ATTR.match(tgt.attr) \
+                        and tgt.attr not in _ATTR_ALLOW:
+                    raw.append((src, node, f"self.{tgt.attr} = ..."))
+    ctx.data["raw_counter_state"] = raw
+    return raw
+
+
+@rule
+class CounterDiscipline(Rule):
+    name = "counter-discipline"
+    doc = ("counter state must live in the telemetry registry "
+           "(telemetry.counter / CounterGroup): raw counter globals, "
+           "public self.*_count attributes, and *_count += increments "
+           "outside the registry are invisible to snapshot()/delta() "
+           "and the CI determinism gate")
+
+    def check(self, src: Source, ctx: LintContext) -> Iterable[Finding]:
+        for s, node, what in collect_raw_state(ctx):
+            if s is not src:
+                continue
+            if src.disabled(self.name, node):
+                ctx.suppressed += 1
+                continue
+            yield _finding(
+                self.name, src, node,
+                f"raw counter state ({what}); declare it with "
+                "telemetry.counter/CounterGroup so it rides "
+                "snapshot()/delta()")
+        for node in src.nodes(ast.AugAssign):
+            if not isinstance(node.op, ast.Add):
+                continue
+            tgt = node.target
+            name = None
+            if isinstance(tgt, ast.Name) \
+                    and _RAW_GLOBAL_NAME.match(tgt.id):
+                name = tgt.id
+            elif isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self" \
+                    and _COUNTERISH_ATTR.match(tgt.attr) \
+                    and tgt.attr not in _ATTR_ALLOW:
+                name = f"self.{tgt.attr}"
+            if name is None:
+                continue
+            if src.disabled(self.name, node):
+                ctx.suppressed += 1
+                continue
+            yield _finding(
+                self.name, src, node,
+                f"raw counter increment ({name} += ...); go through the "
+                "telemetry registry (Counter.inc / CounterGroup.inc)")
+
+    def collect(self, src: Source, ctx: LintContext) -> None:
+        collect_accessors(ctx)   # shared with the check_telemetry gate
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _jit_donated_positions(call: ast.Call) -> Optional[List[int]]:
+    """For ``jax.jit(f, donate_argnums=...)``-style calls: the donated
+    positional indices (literal ints only), else None."""
+    fn = _dotted(call.func) or ""
+    if fn.split(".")[-1] not in _JIT_NAMES:
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for el in v.elts:
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, int):
+                    out.append(el.value)
+                else:
+                    return None     # dynamic — can't reason statically
+            return out
+    return None
+
+
+@rule
+class DonationSafety(Rule):
+    name = "donation"
+    doc = ("a local passed in a donated position of a jit'd call is "
+           "DEAD — XLA may alias its buffer into the output; any later "
+           "read in the same scope sees poisoned memory on device")
+
+    def check(self, src: Source, ctx: LintContext) -> Iterable[Finding]:
+        # cheap pre-filter: the per-function flow analysis below is the
+        # one expensive pass in the rule set — only run it on files
+        # that mention donation at all
+        if "donate_argnums" not in src.text:
+            return
+        for fn in src.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            # only consider this function's own statements (nested
+            # function bodies analyze separately)
+            nested = {id(sub) for child in ast.walk(fn)
+                      if isinstance(child, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))
+                      and child is not fn
+                      for sub in ast.walk(child) if sub is not child}
+            jitted: Dict[str, List[int]] = {}
+            # var -> end line of the call that donated it
+            dead: Dict[str, int] = {}
+            events: List[Tuple[int, int, object]] = []
+            for node in ast.walk(fn):
+                if id(node) in nested:
+                    continue
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    pos = _jit_donated_positions(node.value)
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            if pos:
+                                jitted[tgt.id] = pos
+                            else:
+                                jitted.pop(tgt.id, None)
+                # same-line ordering: donating calls (0) kill before
+                # assignments (1) revive before loads (2) are judged —
+                # so `x = g(x)` leaves x alive (it holds the result)
+                if isinstance(node, ast.Call):
+                    prio = 0
+                elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                       ast.For, ast.withitem)):
+                    prio = 1
+                else:
+                    prio = 2
+                events.append((getattr(node, "lineno", 0), prio, node))
+            # second pass in line order: donating calls kill names,
+            # reassignment revives them, later loads get flagged
+            for _, _, node in sorted(events, key=lambda e: (e[0], e[1])):
+                if isinstance(node, ast.Call):
+                    pos = None
+                    if isinstance(node.func, ast.Name) \
+                            and node.func.id in jitted:
+                        pos = jitted[node.func.id]
+                    elif isinstance(node.func, ast.Call):
+                        pos = _jit_donated_positions(node.func)
+                    if pos:
+                        end = getattr(node, "end_lineno", node.lineno)
+                        for p in pos:
+                            if p < len(node.args) and isinstance(
+                                    node.args[p], ast.Name):
+                                dead[node.args[p].id] = end
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.For,
+                                     ast.withitem)):
+                    for t in ast.walk(node):
+                        if isinstance(t, ast.Name) \
+                                and isinstance(t.ctx, ast.Store):
+                            dead.pop(t.id, None)
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in dead \
+                        and node.lineno > dead[node.id]:
+                    if src.disabled(self.name, node):
+                        ctx.suppressed += 1
+                        dead.pop(node.id)
+                        continue
+                    yield _finding(
+                        self.name, src, node,
+                        f"'{node.id}' was donated to a jit'd call (line "
+                        f"{dead[node.id]}) and read afterwards — the "
+                        "buffer may already be aliased into the output; "
+                        "keep a copy or stop donating it")
+                    dead.pop(node.id)   # one finding per donation

@@ -1,0 +1,366 @@
+"""Runtime concurrency-hazard layer: a deterministic lock-order
+deadlock detector for the async runtime's lock set.
+
+PRs 5-11 accreted free-threaded host code — prefetcher, serving
+stager/dispatcher, decode scheduler, async checkpoint writer, telemetry
+bus, preemption drain — each with its own locks.  A deadlock between
+them needs two threads to acquire the same two locks in opposite order;
+that *ordering* property is checkable without ever hitting the unlucky
+interleaving: instrument every ``threading.Lock``/``RLock`` acquisition,
+record the directed graph "lock B acquired while lock A was held", and
+fail on cycles.  The graph is deterministic for a deterministic
+scenario, so the check regresses like any other gate.
+
+Usage (the knob ``MXNET_LINT_RUNTIME=1`` gates instrumentation; off by
+default — production processes pay zero overhead):
+
+    python -m tools.lint --runtime      # fresh process: instruments
+                                        # BEFORE importing mxnet_tpu,
+                                        # runs one compiled train step +
+                                        # one decode batch + one
+                                        # preemption drain, reports
+
+``enable()`` must run before the locks you care about are created —
+module-level locks (telemetry registry, preemption state, spmd init)
+are born at import, which is why the CLI spawns a fresh process for the
+scenario.  Instance-level detection: edges connect lock *instances*
+(two per-instance locks from one creation site never false-cycle), but
+cycles are reported by creation *site* (file:line), which is what a
+human fixes.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+__all__ = ["LockOrderRecorder", "enable", "disable", "recorder",
+           "run_scenario", "instrumentation_requested"]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the real constructors, captured once at import (before any patching)
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+def instrumentation_requested() -> bool:
+    """The MXNET_LINT_RUNTIME knob, read raw: this runs BEFORE
+    mxnet_tpu (and its config registry) may be imported — that ordering
+    is the whole point.  The knob is declared in mxnet_tpu/config.py so
+    docs/ENV_VARS.md documents it."""
+    return os.environ.get("MXNET_LINT_RUNTIME", "0").strip() in (
+        "1", "true", "on")
+
+
+def _creation_site() -> str:
+    """file:line of the frame that created the lock, skipping threading
+    internals and this module; repo paths are relativized so reports are
+    stable across checkouts."""
+    for frame in traceback.extract_stack()[-3::-1]:
+        fname = frame.filename
+        base = os.path.basename(fname)
+        if base == "threading.py" or fname == __file__:
+            continue
+        if fname.startswith(_REPO):
+            fname = os.path.relpath(fname, _REPO)
+        return f"{fname}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.held: List["_InstrumentedLock"] = []
+
+
+class LockOrderRecorder:
+    """Collects the cross-thread lock-acquisition graph."""
+
+    def __init__(self):
+        self.active = False
+        self._tls = _TLS()
+        self._graph_lock = _REAL_LOCK()   # leaf: never held while
+        # acquiring an instrumented lock
+        # instance-id -> creation site
+        self.sites: Dict[int, str] = {}
+        # (holder-id, acquired-id) -> example (holder site, acquired
+        # site, thread name)
+        self.edges: Dict[Tuple[int, int], Tuple[str, str, str]] = {}
+        self.acquisitions = 0
+
+    # -- wrapper callbacks ----------------------------------------------
+    def on_create(self, lock: "_InstrumentedLock") -> None:
+        with self._graph_lock:
+            self.sites[lock.uid] = lock.site
+
+    def on_acquire(self, lock: "_InstrumentedLock") -> None:
+        held = self._tls.held
+        if held:
+            holder = held[-1]
+            if holder.uid != lock.uid:
+                edge = (holder.uid, lock.uid)
+                with self._graph_lock:
+                    self.acquisitions += 1
+                    if edge not in self.edges:
+                        self.edges[edge] = (
+                            holder.site, lock.site,
+                            threading.current_thread().name)
+        else:
+            with self._graph_lock:
+                self.acquisitions += 1
+        held.append(lock)
+
+    def on_release(self, lock: "_InstrumentedLock") -> None:
+        held = self._tls.held
+        # locks release LIFO in the common case, but out-of-order
+        # release is legal — remove the newest matching entry
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # -- analysis --------------------------------------------------------
+    def cycles(self) -> List[List[str]]:
+        """Lock-order cycles: strongly connected components of size > 1
+        in the instance graph (self-edges can't exist — reacquiring the
+        same instance records no edge), reported as sorted creation
+        sites.  Iterative Tarjan — complete (a cycle exists iff some
+        SCC has > 1 node) and linear in the graph size."""
+        adj: Dict[int, Set[int]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        index: Dict[int, int] = {}
+        low: Dict[int, int] = {}
+        on_stack: Set[int] = set()
+        stack: List[int] = []
+        counter = [0]
+        sccs: List[List[int]] = []
+
+        for root in adj:
+            if root in index:
+                continue
+            work: List[Tuple[int, Any]] = [(root, iter(adj[root]))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append((nxt, iter(adj[nxt])))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        v = stack.pop()
+                        on_stack.discard(v)
+                        comp.append(v)
+                        if v == node:
+                            break
+                    if len(comp) > 1:
+                        sccs.append(comp)
+        return [sorted({self.sites.get(u, "?") for u in comp})
+                for comp in sccs]
+
+    def report(self) -> Dict[str, Any]:
+        site_edges = sorted({
+            (ha, hb, t) for (_, _), (ha, hb, t) in self.edges.items()})
+        return {
+            "locks": len(self.sites),
+            "acquisitions": self.acquisitions,
+            "edges": [{"held": a, "acquired": b, "thread": t}
+                      for a, b, t in site_edges],
+            "cycles": self.cycles(),
+        }
+
+
+class _InstrumentedLock:
+    """Wraps a real Lock/RLock; records successful acquisitions.  After
+    ``disable()`` the wrapper stays functional (locks outlive the
+    recording window) but stops recording."""
+
+    _UID = [0]
+    _UID_LOCK = _REAL_LOCK()
+
+    def __init__(self, inner, recorder: "LockOrderRecorder"):
+        self._inner = inner
+        self._recorder = recorder
+        with self._UID_LOCK:
+            self._UID[0] += 1
+            self.uid = self._UID[0]
+        self.site = _creation_site()
+        recorder.on_create(self)
+
+    def acquire(self, *args, **kwargs):
+        ok = self._inner.acquire(*args, **kwargs)
+        if ok and self._recorder.active:
+            self._recorder.on_acquire(self)
+        return ok
+
+    def release(self):
+        if self._recorder.active:
+            self._recorder.on_release(self)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __getattr__(self, name):
+        # RLock internals the Condition protocol needs (_is_owned,
+        # _acquire_restore, _release_save) delegate to the inner lock;
+        # cv.wait() windows therefore bypass recording, which is safe:
+        # a waiting thread acquires nothing
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return f"<graftlint {self._inner!r} @ {self.site}>"
+
+
+_RECORDER: Optional[LockOrderRecorder] = None
+
+
+def recorder() -> Optional[LockOrderRecorder]:
+    return _RECORDER
+
+
+def enable() -> LockOrderRecorder:
+    """Patch threading.Lock/RLock with instrumented factories.  Locks
+    created from here on are recorded; locks created earlier are not —
+    call before importing the modules under observation."""
+    global _RECORDER
+    if _RECORDER is not None and _RECORDER.active:
+        return _RECORDER
+    rec = LockOrderRecorder()
+    rec.active = True
+    _RECORDER = rec
+
+    def make_lock():
+        return _InstrumentedLock(_REAL_LOCK(), rec)
+
+    def make_rlock():
+        return _InstrumentedLock(_REAL_RLOCK(), rec)
+
+    threading.Lock = make_lock          # type: ignore[assignment]
+    threading.RLock = make_rlock        # type: ignore[assignment]
+    return rec
+
+
+def disable() -> Optional[LockOrderRecorder]:
+    """Restore the real constructors and stop recording.  Existing
+    wrapped locks keep working (pass-through)."""
+    global _RECORDER
+    threading.Lock = _REAL_LOCK         # type: ignore[assignment]
+    threading.RLock = _REAL_RLOCK       # type: ignore[assignment]
+    rec = _RECORDER
+    if rec is not None:
+        rec.active = False
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# the gate scenario
+# ---------------------------------------------------------------------------
+
+def run_scenario() -> Dict[str, Any]:
+    """The acceptance scenario: one compiled train step window + one
+    decode batch + one preemption drain, recorded under instrumentation.
+    MUST run in a process that has not imported mxnet_tpu yet (the CLI
+    spawns one); module-level locks are then all instrumented.
+
+    Returns the recorder report plus scenario markers; ``cycles`` empty
+    == acyclic acquisition graph == the gate passes."""
+    if "mxnet_tpu" in sys.modules:
+        raise RuntimeError(
+            "run_scenario() needs a fresh process: mxnet_tpu is already "
+            "imported, its module-level locks escaped instrumentation "
+            "(use `python -m tools.lint --runtime`)")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if not instrumentation_requested():
+        # the scenario IS the lint harness: reflect that in the knob so
+        # subprocesses / config introspection see instrumentation is on
+        os.environ["MXNET_LINT_RUNTIME"] = "1"
+    rec = enable()
+    try:
+        import numpy as onp
+
+        import mxnet_tpu as mx
+        from mxnet_tpu import engine, preemption, serving_decode
+        from mxnet_tpu import gluon
+        from mxnet_tpu.gluon import nn
+
+        # -- one compiled train step (check_telemetry's fixture) --------
+        class Net(gluon.HybridBlock):
+            def __init__(self):
+                super().__init__()
+                self.d1 = nn.Dense(16, in_units=8, activation="relu")
+                self.out = nn.Dense(4, in_units=16)
+
+            def forward(self, x):
+                return self.out(self.d1(x))
+
+        net = Net()
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01, "momentum": 0.9})
+        step = trainer.compile_step(
+            net, lambda n, x, y: ((n(x) - y) ** 2).mean())
+        rng = onp.random.RandomState(0)
+        x = mx.nd.array(rng.randn(8, 8).astype(onp.float32))
+        y = mx.nd.array(rng.randn(8, 4).astype(onp.float32))
+        # prefetch so the transfer thread's locks enter the graph
+        batches = engine.prefetch(iter([(x, y)] * 3), depth=2)
+        for bx, by in batches:
+            step(bx, by, batch_size=8)
+        engine.waitall()
+
+        # -- one decode batch -------------------------------------------
+        eng = serving_decode.GenerativeEngine(
+            serving_decode.TinyCausalLM(),
+            pool=serving_decode.PagePool(pages=64, page=8), max_rows=2)
+        try:
+            eng.generate(onp.asarray([3, 1, 4]), max_new_tokens=2)
+        finally:
+            eng.close()
+
+        # -- one preemption drain ---------------------------------------
+        exits: List[int] = []
+        preemption.install(exit_fn=exits.append, grace_s=60.0)
+        try:
+            preemption.notice()
+        finally:
+            preemption.uninstall()
+        engine.waitall()
+        drained_code = exits[0] if exits else None
+    finally:
+        disable()
+    out = rec.report()
+    out["scenario"] = {"train_steps": 3, "decode_tokens": 2,
+                       "drain_exit_code": drained_code}
+    return out

@@ -857,7 +857,8 @@ def lane_elastic(on_cpu: bool) -> dict:
               f"({c['recovery_s']*1e3:.1f}ms restore), "
               f"{c['steps_replayed']} replayed, drain "
               f"{c['drain_s']*1e3:.1f}ms, {c['fresh_compiles']} fresh "
-              f"compiles / {c['disk_hits']} disk hits on restart")
+              f"compiles / {c['disk_hits']} disk hits on restart, "
+              f"sentinel overhead {c.get('sentinel_overhead_pct')}%")
     return {
         "metric": "elastic_recovery_wall_s",
         "value": c["recovery_wall_s"],
@@ -871,6 +872,10 @@ def lane_elastic(on_cpu: bool) -> dict:
         "disk_hits": c["disk_hits"],
         "restored_at": c["restored_at"],
         "exit_code_c1": c["exit_code_c1"],
+        # ISSUE-13 training-integrity sentinel A/B (cadence 20 vs off
+        # on the drill train step; acceptance < 1% evaluated on-chip)
+        "sentinel_overhead_pct": c.get("sentinel_overhead_pct"),
+        "sentinel_ab": c.get("sentinel_ab"),
         "telemetry": c.get("telemetry"),
         "platform": c["platform"],
     }

@@ -14,6 +14,13 @@ reports the recovery-time budget numbers ROADMAP 4(c) asks for:
 - ``drain_s``          SIGTERM -> queues drained + final blocking save
 - ``fresh_compiles`` / ``disk_hits``  restart's persistent-cache
                        behavior (warm recovery compiles nothing fresh)
+- ``sentinel_overhead_pct``  ISSUE-13 training-integrity sentinel A/B:
+                       median step time with the sentinel at its
+                       default cadence (20) vs off, same process, same
+                       compiled program — the digest rides an
+                       in-program lax.cond, so the measured delta is
+                       the real cost of attestation (acceptance:
+                       < 1% on the train lane, evaluated on-chip)
 
 ``--json`` emits one machine-readable line (the bench.py ``elastic``
 lane contract); the full namespaced telemetry snapshot of the RESUMED
@@ -31,6 +38,58 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def measure_sentinel_overhead(steps: int = 150, every: int = 20) -> dict:
+    """A/B the sentinel's cost on the drill workload, in-process: the
+    SAME compiled program runs ``steps`` timed steps with no sentinel
+    attached, then with a Sentinel at cadence ``every`` — the want-flag
+    is a traced arg, so both phases dispatch one identical executable
+    and the delta isolates the lax.cond digest branch + the deferred
+    reads.  Median-of-batches timing so one scheduler hiccup cannot
+    fake a regression."""
+    import statistics
+    import time
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import drills, gluon, sentinel
+
+    net = drills._drill_net(seed=0)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="device")
+    step = trainer.compile_step(net, drills._drill_loss)
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.randn(drills.ROWS, 8).astype(onp.float32))
+    y = mx.nd.array(rng.randn(drills.ROWS, 4).astype(onp.float32))
+
+    def timed(n):
+        # batches of 10 steps; per-batch wall / 10, median across
+        samples = []
+        for _ in range(n // 10):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                loss = step(x, y, batch_size=drills.ROWS)
+            float(loss.asnumpy().ravel()[0])     # fence
+            samples.append((time.perf_counter() - t0) / 10)
+        return statistics.median(samples)
+
+    for _ in range(10):                          # warm + state settle
+        loss = step(x, y, batch_size=drills.ROWS)
+    float(loss.asnumpy().ravel()[0])
+    base_s = timed(steps)
+    snt = sentinel.Sentinel(step=step, every=every)
+    on_s = timed(steps)
+    snt.flush()
+    assert step.last_step_compiled, step.last_fallback_reason
+    return {
+        "sentinel_every": every,
+        "step_us_off": round(base_s * 1e6, 2),
+        "step_us_on": round(on_s * 1e6, 2),
+        "sentinel_overhead_pct": round((on_s - base_s) / base_s * 100, 3),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", action="store_true")
@@ -43,6 +102,7 @@ def main() -> int:
 
     root = a.root or tempfile.mkdtemp(prefix="mxnet-bench-elastic-")
     rep = run_drill(a.scenario, root)
+    rep["sentinel_ab"] = measure_sentinel_overhead()
     out = {
         "elastic": {
             "scenario": rep["scenario"],
@@ -58,6 +118,9 @@ def main() -> int:
             "exit_code_c1": rep.get("exit_code_c1"),
             "leaked_tmp": rep.get("leaked_tmp", []),
             "drill_wall_s": rep.get("drill_wall_s"),
+            "sentinel_overhead_pct":
+                rep["sentinel_ab"]["sentinel_overhead_pct"],
+            "sentinel_ab": rep["sentinel_ab"],
             "platform": "cpu",   # drill children force JAX_PLATFORMS=cpu
             "telemetry": rep.get("resume_telemetry"),
         }

@@ -84,6 +84,7 @@ _LAZY = {
     "runtime": ".runtime",
     "cached_step": ".cached_step",
     "program_store": ".program_store",
+    "sentinel": ".sentinel",
     "serving": ".serving",
     "serving_decode": ".serving_decode",
     "telemetry": ".telemetry",

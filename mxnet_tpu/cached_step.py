@@ -173,7 +173,19 @@ class TrainStep:
         # on-device; the host read then happens while that dispatch is
         # in flight.  engine.waitall() drains it via drain().
         self._pending_ok = None
+        # training-integrity sentinel (mxnet_tpu/sentinel.py): when
+        # attached, sentinel-cadence dispatches flip the traced
+        # want_digest flag so the program's lax.cond emits the state
+        # fingerprint — same program, 0 extra dispatches/retraces
+        self._sentinel = None
         _engine.register_drainable(self)
+
+    def attach_sentinel(self, sentinel):
+        """Attach a :class:`mxnet_tpu.sentinel.Sentinel`: it decides the
+        digest cadence (``want_digest`` per compiled dispatch) and
+        receives the emitted device fingerprint via ``offer``."""
+        self._sentinel = sentinel
+        return sentinel
 
     # -- public ----------------------------------------------------------
     @property
@@ -679,8 +691,11 @@ class TrainStep:
             in_specs = [_in_spec(s) for s in in_specs]
             prev_ok = jax.ShapeDtypeStruct((), jnp.bool_,
                                            sharding=prep.rep)
+            want_dig = jax.ShapeDtypeStruct((), jnp.bool_,
+                                            sharding=prep.rep)
         else:
             prev_ok = jax.ShapeDtypeStruct((), jnp.bool_)
+            want_dig = jax.ShapeDtypeStruct((), jnp.bool_)
         g32 = [jax.ShapeDtypeStruct((len(m),), jnp.float32)
                for _mp, m in prep.group_layout]
         from .optimizer import fused as _fused
@@ -691,7 +706,7 @@ class TrainStep:
                        for n in prep.frozen_names]
         return (w_args, s_args, frozen_args, list(in_specs),
                 jax.random.PRNGKey(0), list(g32), list(g32), list(g32),
-                f32, f32, f32, f32, prev_ok)
+                f32, f32, f32, f32, prev_ok, want_dig)
 
     def _compiled_step(self, args, batch_size):
         from .gluon import block as _gb
@@ -770,6 +785,16 @@ class TrainStep:
         else:
             in_args = [l._data for l in in_leaves]
 
+        # sentinel cadence: the traced want_digest flag selects the
+        # in-program lax.cond digest branch — value changes never
+        # retrace, and under a mesh the flag pins replicated exactly
+        # like the seed AMP flag above
+        snt = self._sentinel
+        want_digest = snt is not None and snt.want_digest()
+        if mesh is not None:
+            want_arg = jax.device_put(jnp.asarray(want_digest), rep)
+        else:
+            want_arg = jnp.asarray(want_digest)
         call_args = (
             w_args, s_args, frozen_args, in_args, _random.next_key(),
             lrs_g, wds_g, counts_g,
@@ -777,11 +802,17 @@ class TrainStep:
             jnp.asarray(scale_val, jnp.float32),
             jnp.asarray(s_over, jnp.float32),
             jnp.asarray(rescale_alt, jnp.float32),
-            prev_ok)
+            prev_ok, want_arg)
         rec = self._ensure_program(sig, prep, in_struct, ctx, flavor,
                                    call_args)
         out_struct, mutated_names = rec.meta
-        out_raw, mut_vals, new_w, new_s, ok = rec(*call_args)
+        out_raw, mut_vals, new_w, new_s, ok, dig = rec(*call_args)
+        if want_digest:
+            # hand the UNREAD device fingerprint to the sentinel; it
+            # consumes the previous pending one (deferred a full
+            # cadence — that program retired long ago, so the read
+            # rides the PR-5 lag machinery, never a stall on this step)
+            snt.offer(*dig)
 
         for p, nw in zip(trainable, new_w):
             p._data[0]._set_data(nw)
@@ -847,7 +878,7 @@ class TrainStep:
 
         def step_fn(w_list, s_list, frozen_list, in_list, rng_key,
                     lrs_g, wds_g, counts_g, rescale, scale,
-                    scale_alt, rescale_alt, prev_ok):
+                    scale_alt, rescale_alt, prev_ok, want_digest):
             _pstore.count_trace("train_step")
             # deferred AMP gate: the previous step's flag selects which
             # speculative scale candidate this step really runs with —
@@ -895,7 +926,23 @@ class TrainStep:
                 for j, i in enumerate(members):
                     new_w[i] = nw[j]
                     new_s[i] = ns[j]
-            return outs, muts, new_w, tuple(new_s), ok
+            # training-integrity sentinel: on sentinel-cadence steps the
+            # program ALSO emits a state fingerprint of the post-update
+            # params + optimizer state + grad norm.  lax.cond keeps the
+            # fold off non-sentinel steps at runtime; the flag is a
+            # traced arg, so cadence never retraces.  Under the SPMD
+            # mesh the fold of replicated values is computed redundantly
+            # per device — the per-shard values ARE the per-replica
+            # digests the corruption vote compares.
+            from . import sentinel as _sentinel
+
+            state_leaves = jax.tree_util.tree_leaves(tuple(new_s))
+            dig = jax.lax.cond(
+                want_digest,
+                lambda: _sentinel.program_digest(new_w, state_leaves,
+                                                 grads),
+                _sentinel.zero_digest)
+            return outs, muts, new_w, tuple(new_s), ok, dig
 
         # donation aliases the old weight/optimizer-state HBM into the
         # outputs — the whole point of the fused step on chip; CPU has no

@@ -380,6 +380,33 @@ declare("MXNET_PREEMPTION_EXIT_CODE", int, 83,
         "force-exit uses this code + 1.",
         validator=lambda v: 1 <= v <= 120, subsystem="faults",
         cached=False)
+declare("MXNET_SENTINEL_EVERY", int, 20,
+        "Training-integrity sentinel cadence (mxnet_tpu/sentinel.py): "
+        "every N compiled train-step dispatches the donated program "
+        "additionally emits an on-device state fingerprint (uint32 "
+        "bitcast fold over post-update params + optimizer state, plus "
+        "float param-sum / grad-norm signals) behind an in-program "
+        "lax.cond — 0 extra dispatches, 0 retraces; the host read is "
+        "deferred a full cadence (or forced at checkpoint boundaries). "
+        "Per-replica digest shards are voted for silent corruption "
+        "under kvstore='tpu'.  0 = sentinel off (no digest reads; the "
+        "cond branch never executes).",
+        validator=lambda v: v >= 0, subsystem="faults", cached=False)
+declare("MXNET_SENTINEL_ZMAX", float, 6.0,
+        "Sentinel anomaly window z-score threshold: a grad-norm (or "
+        "observed-loss) sample farther than zmax standard deviations "
+        "from its EMA — or any non-finite sample, the old "
+        "nonfinite_anomaly — trips the windowed detector and rolls the "
+        "elastic loop back to the last digest-verified checkpoint "
+        "(fault site sentinel.rollback).",
+        validator=lambda v: v > 0, subsystem="faults", cached=False)
+declare("MXNET_SENTINEL_STRIKES", int, 1,
+        "Replica divergences a device may accumulate before the "
+        "sentinel quarantines it (persisted quarantine.json consumed "
+        "by parallel.spmd.resolve_mesh on the next restart — the mesh "
+        "re-resolves WITHOUT the suspect device).  1 = first confirmed "
+        "corruption quarantines immediately.",
+        validator=lambda v: v >= 1, subsystem="faults", cached=False)
 declare("MXNET_SHAPE_BUCKETS", str, "pow2",
         "Shape-bucket grid for padded compilation (serving.BucketPolicy): "
         "'pow2' (default — round a dynamic axis up to the next power of "

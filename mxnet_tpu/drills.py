@@ -41,6 +41,19 @@ or fails loudly:
   vs the eager oracle), queued requests come back as typed ``draining``
   sheds, 0 KV pages leak, and a second process serves the shed
   requests token-exactly.
+- ``bitflip_param`` — the ISSUE-13 silent-corruption drill: the child
+  flips one bit of ONE device's replica of a parameter mid-run; the
+  sentinel's cross-replica digest vote localizes the device within one
+  cadence (named in a ``corruption`` event, persisted to the
+  quarantine list), rollback restores the last digest-verified
+  checkpoint, the resumed trajectory is bit-exact vs the uninterrupted
+  reference, and a restarted child re-resolves the mesh WITHOUT the
+  quarantined device.
+- ``loss_spike`` — scripted poisoned batch (targets scaled 1e6): the
+  sentinel's grad-norm z-score window trips BEFORE the tainted state
+  is checkpointed, rollback replays exactly the save-interval gap, and
+  the merged trajectory is bit-exact vs the reference (the poison is
+  one-shot, so the replay is clean).
 
 ``run_drill(name, root)`` orchestrates one scenario (children share
 ``<root>/pcache`` — the ``MXNET_PROGRAM_CACHE_DIR`` disk cache — and
@@ -70,7 +83,8 @@ from typing import Any, Dict, List, Optional
 __all__ = ["SCENARIOS", "run_drill", "main"]
 
 SCENARIOS = ("sigterm_drain", "sigkill_between_saves", "topology_change",
-             "corrupt_latest", "decode_drain")
+             "corrupt_latest", "decode_drain", "bitflip_param",
+             "loss_spike")
 
 # the scripted workload every train drill shares
 N_STEPS = 24
@@ -204,6 +218,31 @@ def _params_sha(net) -> str:
     return h.hexdigest()
 
 
+def _flip_param_bit(net, dev_index: int) -> int:
+    """Silent-corruption injection: flip ONE mantissa bit of the first
+    parameter's replica on mesh device position ``dev_index`` — the
+    replicated array is rebuilt from per-device buffers with exactly
+    one diverging, so only that physical replica carries the wrong
+    bits (what a mis-executing chip or an HBM upset produces).
+    Returns the id of the corrupted device."""
+    import jax
+    import numpy as onp
+
+    _name, p = sorted(net.collect_params().items())[0]
+    arr = p.data()._data
+    shards = sorted(arr.addressable_shards, key=lambda s: s.device.id)
+    bufs, victim = [], None
+    for j, sh in enumerate(shards):
+        host = onp.asarray(sh.data).copy()
+        if j == dev_index % len(shards):
+            victim = sh.device.id
+            host.view(onp.uint32).ravel()[3] ^= onp.uint32(1 << 20)
+        bufs.append(jax.device_put(host, sh.device))
+    p.data()._set_data(jax.make_array_from_single_device_arrays(
+        arr.shape, arr.sharding, bufs))
+    return victim
+
+
 # ---------------------------------------------------------------------------
 # child: train drill
 # ---------------------------------------------------------------------------
@@ -221,12 +260,34 @@ def _cmd_train(a) -> int:
     step = trainer.compile_step(net, _drill_loss)
     _warm_opt_states(trainer)
     ckpt = CheckpointManager(a.ckpt, keep=20, async_save=True)
+    snt = None
+    if a.sentinel_every:
+        # constructed BEFORE the mesh resolves: a quarantine list
+        # persisted by a prior incarnation excludes its suspects from
+        # this process's mesh (the restart-time consumption contract)
+        from mxnet_tpu import sentinel as _sentinel
+
+        snt = _sentinel.Sentinel(step=step, directory=a.ckpt,
+                                 every=a.sentinel_every)
     if a.preempt:
         preemption.install()
     losses_f = open(os.path.join(a.dir, f"losses-{a.label}.txt"), "a",
                     buffering=1)
     progress_f = open(os.path.join(a.dir, f"progress-{a.label}.txt"), "a",
                       buffering=1)
+
+    # one-shot scripted events: after a rollback the replay regenerates
+    # the SAME step indices, and a re-fired poison/flip would make the
+    # drill diverge forever instead of proving bit-exact recovery
+    fired = {"poison": False, "flip": False}
+
+    def _drill_batch(j: int):
+        x, y = _host_batch(j)
+        if a.poison_at is not None and j == a.poison_at \
+                and not fired["poison"]:
+            fired["poison"] = True
+            y = (y * 1e6).astype(y.dtype)
+        return x, y
 
     # depth-k prefetcher staging batches onto the step's mesh sharding;
     # restarted from the restored index after every restore (the input
@@ -238,7 +299,7 @@ def _cmd_train(a) -> int:
             if hasattr(pf["it"], "close"):
                 pf["it"].close()
             pf["it"] = engine.prefetch(
-                (_host_batch(j) for j in range(i, a.stop_at)),
+                (_drill_batch(j) for j in range(i, a.stop_at)),
                 depth=2, sharding=step.batch_sharding)
             pf["next"] = i
         pf["next"] = i + 1
@@ -247,6 +308,7 @@ def _cmd_train(a) -> int:
     t_first = [None]
     restored_at = [None]
     restored_sha = [None]
+    flipped_dev = [None]
 
     def step_fn(state, i):
         if a.sigkill_at is not None and i == a.sigkill_at:
@@ -260,6 +322,10 @@ def _cmd_train(a) -> int:
             # a real preemption notice, delivered mid-step through the
             # installed handler (the handler runs at the next bytecode)
             os.kill(os.getpid(), signal.SIGTERM)
+        if a.bitflip_at is not None and i == a.bitflip_at \
+                and not fired["flip"]:
+            fired["flip"] = True
+            flipped_dev[0] = _flip_param_bit(net, a.bitflip_dev)
         x, y = _get_batch(i)
         loss = step(x, y, batch_size=ROWS)
         lval = float(loss.asnumpy().ravel()[0])
@@ -284,11 +350,12 @@ def _cmd_train(a) -> int:
         _out, steps_run, restarts = run_elastic(
             step_fn, _capture(net, trainer), range(a.stop_at), ckpt,
             save_every=a.save_every, max_restarts=a.max_restarts,
-            on_restore=on_restore)
+            on_restore=on_restore, anomaly_fn=snt)
     except preemption.Preempted as e:
         preempted = int(e.code)
     engine.waitall()
     snap = telemetry.snapshot()
+    mesh = step.mesh
     res = {
         "label": a.label, "pid": os.getpid(),
         "preempted_code": preempted,
@@ -304,6 +371,16 @@ def _cmd_train(a) -> int:
         "wall_s": time.monotonic() - t_proc0,
         "first_step_s": (t_first[0] - t_proc0
                          if t_first[0] is not None else None),
+        "mesh_devices": ([int(d.id) for d in mesh.devices.flat]
+                         if mesh is not None else None),
+        "flipped_device": flipped_dev[0],
+        "sentinel_digests": snap.get("sentinel.digests"),
+        "replica_divergence": snap.get("sentinel.replica_divergence"),
+        "rollbacks": snap.get("sentinel.rollbacks"),
+        "last_rollback": snt.last_rollback if snt is not None else None,
+        "quarantine": (snt.quarantine.entries()
+                       if snt is not None else None),
+        "corruption_events": telemetry.events(kind="corruption"),
         "telemetry": snap,
     }
     with open(os.path.join(a.dir, f"result-{a.label}.json"), "w") as f:
@@ -437,6 +514,9 @@ def _train_child(root: str, scen_dir: str, label: str, devices: int,
                  stop_at: int = N_STEPS, sigterm_at: Optional[int] = None,
                  sigkill_at: Optional[int] = None, delay: float = 0.0,
                  preempt: bool = False, ckpt_name: str = "ckpt",
+                 sentinel_every: int = 0,
+                 bitflip_at: Optional[int] = None, bitflip_dev: int = 0,
+                 poison_at: Optional[int] = None,
                  timeout: float = 300.0) -> subprocess.CompletedProcess:
     os.makedirs(scen_dir, exist_ok=True)
     argv = ["train", "--dir", scen_dir,
@@ -449,6 +529,13 @@ def _train_child(root: str, scen_dir: str, label: str, devices: int,
         argv += ["--sigkill-at", str(sigkill_at)]
     if preempt:
         argv += ["--preempt"]
+    if sentinel_every:
+        argv += ["--sentinel-every", str(sentinel_every)]
+    if bitflip_at is not None:
+        argv += ["--bitflip-at", str(bitflip_at),
+                 "--bitflip-dev", str(bitflip_dev)]
+    if poison_at is not None:
+        argv += ["--poison-at", str(poison_at)]
     return _run_child(argv, _child_env(root, devices), timeout=timeout)
 
 
@@ -543,8 +630,10 @@ def run_drill(name: str, root: str, verbose: bool = False
             {"sigterm_drain": _drill_sigterm,
              "sigkill_between_saves": _drill_sigkill,
              "topology_change": _drill_topology,
-             "corrupt_latest": _drill_corrupt}[name](root, ref, failures,
-                                                     report)
+             "corrupt_latest": _drill_corrupt,
+             "bitflip_param": _drill_bitflip,
+             "loss_spike": _drill_loss_spike}[name](root, ref, failures,
+                                                    report)
     report["ok"] = not failures
     report["failures"] = failures
     report["drill_wall_s"] = round(time.monotonic() - t0, 3)
@@ -770,6 +859,162 @@ def _drill_corrupt(root: str, ref: Dict[int, str], failures: List[str],
         res2.get("restored_at") or 0, "corrupt")
 
 
+def _merged_losses_vs_reference(failures: List[str], ref: Dict[int, str],
+                                merged: Dict[int, str],
+                                what: str) -> None:
+    """An in-process rollback drill writes BOTH the tainted and the
+    replayed loss lines to one file; last-line-wins merging must equal
+    the uninterrupted reference bit-for-bit (rollback healed the run)."""
+    for i in range(N_STEPS):
+        want, got = ref.get(i), merged.get(i)
+        if want is None or got is None:
+            failures.append(f"{what}: step {i} missing a loss line")
+        elif want != got:
+            failures.append(
+                f"{what}: post-rollback step {i} loss {got} != "
+                f"reference {want}")
+
+
+def _drill_bitflip(root: str, ref: Dict[int, str], failures: List[str],
+                   report: Dict[str, Any]) -> None:
+    """Silent corruption end-to-end: one flipped bit on one replica ->
+    vote localizes the device -> rollback -> bit-exact resume ->
+    restart excludes the quarantined device from the mesh."""
+    scen = os.path.join(root, "bitflip")
+    flip_at, flip_dev = 13, 2          # mid save-window, device pos 2
+    c1 = _train_child(root, scen, "c1", devices=4,
+                      sentinel_every=SAVE_EVERY,
+                      bitflip_at=flip_at, bitflip_dev=flip_dev)
+    if c1.returncode != 0:
+        failures.append(f"bitflip child failed rc={c1.returncode}: "
+                        f"{c1.stderr[-1500:]}")
+        return
+    res1 = _read_result(scen, "c1") or {}
+    _resume_budget(report, res1)       # the in-process rollback budget
+    report["steps_replayed"] = res1.get("steps_replayed")
+    report["flipped_device"] = res1.get("flipped_device")
+    report["quarantine"] = res1.get("quarantine")
+    victim = res1.get("flipped_device")
+    if res1.get("restarts") != 1:
+        failures.append(
+            f"bitflip run took {res1.get('restarts')} restarts, wanted "
+            "exactly 1 (the sentinel rollback)")
+    if not res1.get("replica_divergence"):
+        failures.append("bitflip vote counted no "
+                        "sentinel.replica_divergence")
+    if not res1.get("rollbacks"):
+        failures.append("bitflip counted no sentinel.rollbacks")
+    named = {e.get("device") for e in res1.get("corruption_events") or []
+             if e.get("name") == "sentinel"}
+    if victim not in named:
+        failures.append(
+            f"bitflip corruption events named devices {sorted(named)}, "
+            f"not the corrupted device {victim}")
+    q = res1.get("quarantine") or []
+    if victim not in [e["id"] for e in q if e["kind"] == "device"]:
+        failures.append(
+            f"bitflip quarantine {q} does not hold device {victim}")
+    # detection within one sentinel cadence: the rollback's restore
+    # point + replay gap locate the verdict step
+    restored = res1.get("restored_at")
+    detected = (restored or 0) + (res1.get("steps_replayed") or 0)
+    if restored != flip_at - (flip_at % SAVE_EVERY):
+        failures.append(
+            f"bitflip restored step {restored}, wanted the last "
+            f"verified save {flip_at - (flip_at % SAVE_EVERY)}")
+    if not (0 < detected - flip_at <= SAVE_EVERY):
+        failures.append(
+            f"bitflip detected at step {detected}, flip at {flip_at} — "
+            f"outside one sentinel cadence ({SAVE_EVERY})")
+    # rollback healed the run: merged losses == the uninterrupted
+    # reference bit-for-bit (the flip and the tainted steps left no
+    # trace), at 0 fresh compiles (the ref leg warmed the disk cache;
+    # rollback replays reuse the SAME program)
+    _merged_losses_vs_reference(
+        failures, ref, _read_losses(scen, "c1"), "bitflip")
+    if (res1.get("disk") or {}).get("misses") != 0:
+        failures.append(
+            f"bitflip rollback performed "
+            f"{(res1.get('disk') or {}).get('misses')} fresh compiles "
+            "(wanted 0: same mesh, same program)")
+    # restart: the persisted quarantine re-resolves the mesh WITHOUT
+    # the suspect (the PR-11 topology machinery, triggered
+    # automatically); run a few extra steps on the smaller mesh
+    c2 = _train_child(root, scen, "c2", devices=4,
+                      sentinel_every=SAVE_EVERY, stop_at=N_STEPS + 6)
+    if c2.returncode != 0:
+        failures.append(f"bitflip quarantined restart failed "
+                        f"rc={c2.returncode}: {c2.stderr[-1500:]}")
+        return
+    res2 = _read_result(scen, "c2") or {}
+    mesh2 = res2.get("mesh_devices")
+    report["restart_mesh_devices"] = mesh2
+    if mesh2 is None or len(mesh2) != 3 or victim in mesh2:
+        failures.append(
+            f"bitflip restart resolved mesh {mesh2}; wanted 3 devices "
+            f"excluding the quarantined device {victim}")
+    if res2.get("restored_at") != N_STEPS:
+        failures.append(
+            f"bitflip restart restored step {res2.get('restored_at')}, "
+            f"wanted {N_STEPS} (resume onto the quarantined mesh)")
+    if res2.get("steps_run") != N_STEPS + 6:
+        failures.append(
+            f"bitflip restart ran {res2.get('steps_run')} steps, "
+            f"wanted {N_STEPS + 6}")
+
+
+def _drill_loss_spike(root: str, ref: Dict[int, str],
+                      failures: List[str],
+                      report: Dict[str, Any]) -> None:
+    """Scripted poisoned batch: the z-score window trips at the next
+    checkpoint boundary (the tainted state is never saved), rollback
+    replays exactly the save-interval gap, merged trajectory bit-exact."""
+    scen = os.path.join(root, "spike")
+    poison_at = 13
+    c1 = _train_child(root, scen, "c1", devices=4,
+                      sentinel_every=SAVE_EVERY, poison_at=poison_at)
+    if c1.returncode != 0:
+        failures.append(f"loss_spike child failed rc={c1.returncode}: "
+                        f"{c1.stderr[-1500:]}")
+        return
+    res1 = _read_result(scen, "c1") or {}
+    _resume_budget(report, res1)
+    report["steps_replayed"] = res1.get("steps_replayed")
+    report["last_rollback"] = res1.get("last_rollback")
+    if res1.get("restarts") != 1:
+        failures.append(
+            f"loss_spike took {res1.get('restarts')} restarts, wanted "
+            "exactly 1 (the windowed rollback)")
+    if not res1.get("rollbacks"):
+        failures.append("loss_spike counted no sentinel.rollbacks")
+    if res1.get("replica_divergence"):
+        failures.append(
+            "loss_spike counted replica divergence — a poisoned batch "
+            "perturbs every replica identically; the vote must stay "
+            "unanimous")
+    reason = (res1.get("last_rollback") or {}).get("reason")
+    if reason not in ("grad_norm_anomaly", "loss_anomaly"):
+        failures.append(
+            f"loss_spike rollback reason {reason!r}, wanted the "
+            "windowed z-score detector")
+    expect_restore = poison_at - (poison_at % SAVE_EVERY)
+    if res1.get("restored_at") != expect_restore:
+        failures.append(
+            f"loss_spike restored step {res1.get('restored_at')}, "
+            f"wanted the last pre-poison save {expect_restore}")
+    if res1.get("steps_replayed") != SAVE_EVERY:
+        failures.append(
+            f"loss_spike replayed {res1.get('steps_replayed')} steps, "
+            f"wanted exactly the save-window gap {SAVE_EVERY}")
+    _merged_losses_vs_reference(
+        failures, ref, _read_losses(scen, "c1"), "loss_spike")
+    if (res1.get("disk") or {}).get("misses") != 0:
+        failures.append(
+            f"loss_spike rollback performed "
+            f"{(res1.get('disk') or {}).get('misses')} fresh compiles "
+            "(wanted 0)")
+
+
 def _drill_decode(root: str, failures: List[str],
                   report: Dict[str, Any]) -> None:
     scen = os.path.join(root, "decode")
@@ -859,6 +1104,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     t.add_argument("--sigkill-at", type=int, default=None,
                    dest="sigkill_at")
     t.add_argument("--preempt", action="store_true")
+    t.add_argument("--sentinel-every", type=int, default=0,
+                   dest="sentinel_every")
+    t.add_argument("--bitflip-at", type=int, default=None,
+                   dest="bitflip_at")
+    t.add_argument("--bitflip-dev", type=int, default=0,
+                   dest="bitflip_dev")
+    t.add_argument("--poison-at", type=int, default=None,
+                   dest="poison_at")
 
     d = sub.add_parser("decode", help="decode-drill child")
     d.add_argument("--dir", required=True)

@@ -599,6 +599,14 @@ class KVStore(KVStoreBase):
                         if self._heartbeat is not None else None)
             if suspects:
                 who = f"suspected dead ranks: {suspects}"
+                # a hung host converges on the SAME restart-time
+                # exclusion mechanism as a corrupt one: the suspects
+                # land in the sentinel's persisted quarantine list, and
+                # the next mesh resolve excludes their devices
+                from .. import sentinel as _sentinel
+
+                _sentinel.quarantine_ranks(suspects,
+                                           reason="barrier-timeout")
             elif self._heartbeat is not None:
                 who = ("all heartbeats live — slow rank or network "
                        "partition")

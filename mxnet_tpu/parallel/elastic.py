@@ -576,15 +576,23 @@ class HeartbeatMonitor:
         return dead
 
 
-def nonfinite_anomaly(*keys: str) -> Callable[[Any], bool]:
+def nonfinite_anomaly(*keys: str, every: int = 1) -> Callable[[Any], bool]:
     """Anomaly detector factory for :func:`run_elastic`: flags a state
     whose ``state[key]`` holds any non-finite value (NaN/Inf loss — the
-    classic silent-divergence failure a crash handler never sees)."""
+    classic silent-divergence failure a crash handler never sees).
+
+    ``every`` is the evaluation cadence :func:`run_elastic` honors (the
+    ``anomaly_fn.every`` contract): each evaluation is a blocking host
+    read of the named leaves, so a cadence > 1 keeps non-sentinel steps
+    at 0 host syncs.  Default 1 preserves the per-step behavior; the
+    windowed generalization lives in :class:`mxnet_tpu.sentinel.
+    Sentinel`, whose digest reads are deferred AND cadenced."""
     def _check(state) -> bool:
         for k in keys:
             if not bool(onp.all(onp.isfinite(onp.asarray(state[k])))):
                 return True
         return False
+    _check.every = int(every)
     return _check
 
 
@@ -626,10 +634,18 @@ def run_elastic(step_fn: Callable, state: Any, inputs: Iterable,
       delay ``min(backoff * 2**(restart-1), MXNET_RETRY_BACKOFF_MAX)``
       before each restore — a crashing dependency (storage, a flapping
       peer) gets time to recover instead of being hammered.
-    - ``anomaly_fn(state) -> bool`` (e.g. ``nonfinite_anomaly("loss")``):
-      a True verdict after a step raises :class:`AnomalyDetected`, which
-      rolls back to the last checkpoint under the SAME ``max_restarts``
-      budget — a deterministically diverging run still terminates.
+    - ``anomaly_fn(state) -> bool`` (e.g. ``nonfinite_anomaly("loss")``
+      or a :class:`mxnet_tpu.sentinel.Sentinel`): a True verdict after a
+      step raises :class:`AnomalyDetected`, which rolls back to the last
+      checkpoint under the SAME ``max_restarts`` budget — a
+      deterministically diverging run still terminates.  An
+      ``anomaly_fn.every`` attribute sets the evaluation cadence
+      (detectors whose evaluation costs a host sync stop paying it on
+      every step — the sentinel-cadence routing); an ``anomaly_fn.flush()``
+      method, when present, is called immediately BEFORE every
+      checkpoint save and its verdict raises the same way — so a
+      sentinel-rejected state is never checkpointed and every rollback
+      target is attested.
     - ``on_restore(state, step)`` runs after EVERY successful restore
       (the startup resume included): push the restored pytree back into
       live objects — net parameters, optimizer state — before stepping
@@ -675,6 +691,14 @@ def run_elastic(step_fn: Callable, state: Any, inputs: Iterable,
     # interrupting step i finds (i, state-before-step-i) here — the
     # final blocking save checkpoints the last COMPLETED step
     loop = {"state": state, "i": 0}
+    # anomaly-detector cadence (the sentinel routing): a plain function
+    # evaluates every step (the PR-2 behavior); a detector carrying
+    # .every — nonfinite_anomaly(every=N), sentinel.Sentinel — is only
+    # consulted on its cadence, so non-sentinel steps pay 0 host syncs.
+    # .flush(), when present, runs before every save (verdict-gates the
+    # checkpoint so a tainted state is never written).
+    anomaly_every = max(1, int(getattr(anomaly_fn, "every", 1) or 1))
+    anomaly_flush = getattr(anomaly_fn, "flush", None)
     hook = None
     if preemption or _preemption.installed():
         def _final_save():
@@ -704,13 +728,20 @@ def run_elastic(step_fn: Callable, state: Any, inputs: Iterable,
             try:
                 _faults.inject("elastic.step")
                 new_state = step_fn(state, inputs[i])
-                if anomaly_fn is not None and anomaly_fn(new_state):
+                if anomaly_fn is not None \
+                        and (i + 1) % anomaly_every == 0 \
+                        and anomaly_fn(new_state):
                     raise AnomalyDetected(
                         f"anomaly detected in the state after step {i}")
                 state = new_state
                 i += 1
                 loop["state"], loop["i"] = state, i
                 if i % save_every == 0 or i == n:
+                    if anomaly_flush is not None and anomaly_flush():
+                        raise AnomalyDetected(
+                            f"sentinel verdict before the save at step "
+                            f"{i}; the tainted state was NOT "
+                            "checkpointed")
                     ckpt.save(i, state)
             except Exception as e:
                 restarts += 1

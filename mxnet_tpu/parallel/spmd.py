@@ -100,6 +100,41 @@ def reset_counters() -> None:
 # mesh resolution (MXNET_SPMD_MESH)
 # ---------------------------------------------------------------------------
 
+def _admitted_devices():
+    """Visible devices minus the sentinel's active quarantine list (a
+    corrupt replica or a heartbeat-suspected rank persisted by a prior
+    incarnation): the restart-time exclusion that re-resolves the mesh
+    WITHOUT the suspect device.  Excluding everything would leave no
+    mesh to train on — that degenerate list is ignored loudly."""
+    devices = jax.devices()
+    from .. import sentinel as _sentinel
+
+    q = _sentinel.active_quarantine()
+    if q is None:
+        return devices
+    kept = q.filter_devices(devices)
+    if not kept:
+        warnings.warn(
+            "every visible device is quarantined "
+            f"(entries: {q.entries()}); ignoring the quarantine list "
+            "for mesh resolution", stacklevel=3)
+        return devices
+    if len(kept) < len(devices):
+        excluded = sorted(d.id for d in devices if d not in kept)
+        _log_quarantine_exclusion(excluded, q)
+    return kept
+
+
+def _log_quarantine_exclusion(excluded, q) -> None:
+    from ..log import get_logger
+
+    get_logger("mxnet_tpu.spmd").warning(
+        "mesh resolution excludes quarantined device(s) %s "
+        "(quarantine: %s)", excluded, q.entries())
+    _telemetry.event("corruption", "spmd.quarantine_excluded",
+                     devices=excluded)
+
+
 def resolve_mesh(spec: Optional[str] = None) -> Optional[Mesh]:
     """Resolve ``MXNET_SPMD_MESH`` (or an explicit spec string) into a
     data-parallel mesh, or ``None`` when SPMD is off.
@@ -113,12 +148,18 @@ def resolve_mesh(spec: Optional[str] = None) -> Optional[Mesh]:
     - ``dp=4,tp=2`` style: axis spec via :func:`mesh.make_mesh` (the
       compiled step shards the batch over ``'dp'`` only; other axes need
       a ShardingPlan and ride :class:`~.train.ShardedTrainer`).
+
+    Every form resolves over the ADMITTED device set: devices (or whole
+    ranks) in the sentinel's persisted quarantine list are excluded, so
+    a restart after a localized corruption or a hung host re-places
+    onto a mesh without the suspect (the PR-11 topology-change
+    machinery, triggered automatically).
     """
     raw = spec if spec is not None else _config.get("MXNET_SPMD_MESH")
     raw = (raw or "auto").strip().lower()
     if raw in ("0", "off", "none", "disabled"):
         return None
-    devices = jax.devices()
+    devices = _admitted_devices()
     if raw in ("auto", ""):
         if len(devices) < 2:
             return None

@@ -1,0 +1,431 @@
+"""Training-integrity sentinel (ISSUE 13): in-program state digests,
+cross-replica corruption voting, anomaly-windowed rollback, and
+suspect-device quarantine.
+
+Covers, in-process wherever possible (the end-to-end ``bitflip_param``
+and ``loss_spike`` subprocess drills run inside the
+tools/check_recovery_budget.py gate in test_preemption.py, and the
+dispatch/retrace/host-sync budget of the digest lives in
+tools/check_dispatch_budget.py's ``sentinel`` lane):
+
+1. Digest math: the fold is deterministic (same tree → same integer,
+   in-process and across processes), invariant to the mesh shape a
+   replicated tree is placed on (1/2/8 devices), and flips on any
+   single-element — indeed single-BIT — perturbation of params or
+   optimizer state.
+2. Cross-replica vote: one corrupted replica of a replicated parameter
+   makes the compiled step's per-device digest shards diverge; the
+   vote localizes the device (named in a ``corruption`` event), strikes
+   it into the persisted quarantine, and latches a rollback verdict.
+3. Windowed anomaly detection: EMA + z-score trips on spikes and on
+   non-finite values (the nonfinite_anomaly generalization), not on
+   ordinary drift.
+4. run_elastic integration: anomaly_fn cadence routing (``.every``),
+   the pre-save ``flush()`` verdict gate (a tainted state is never
+   checkpointed), and the ``sentinel.rollback`` fault site driving the
+   documented restore-and-replay recovery.
+5. Quarantine: persisted entries (written under the retried
+   ``sentinel.quarantine`` site), device exclusion at mesh resolution,
+   rank exclusion fed by a KVStore barrier deadline's suspected-dead
+   ranks (a hung host and a corrupt host converge on one mechanism).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, gluon, sentinel, telemetry
+from mxnet_tpu.parallel import spmd
+from mxnet_tpu.parallel.elastic import (AnomalyDetected, CheckpointManager,
+                                        HeartbeatMonitor, run_elastic)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NDEV = jax.device_count()
+
+
+@pytest.fixture(autouse=True)
+def _pristine_quarantine():
+    """A test that installs a quarantine must not leave every later
+    mesh resolve in the process excluding its devices."""
+    yield
+    sentinel.install_quarantine(None)
+    faults.uninstall()
+
+
+def _tree():
+    return {
+        "w": onp.arange(24, dtype=onp.float32).reshape(4, 6) * 0.25,
+        "m": {"v": onp.linspace(-1, 1, 7, dtype=onp.float32),
+              "c": onp.int32(5)},
+    }
+
+
+# ---------------------------------------------------------------------------
+# 1. digest math
+# ---------------------------------------------------------------------------
+
+def test_fold_deterministic_and_bit_sensitive():
+    base = sentinel.tree_digest(_tree())
+    assert base == sentinel.tree_digest(_tree())       # deterministic
+    # any single-element perturbation moves it — params AND nested
+    # optimizer-state leaves
+    t = _tree()
+    t["w"][2, 3] += 1e-3
+    assert sentinel.tree_digest(t) != base
+    t = _tree()
+    t["m"]["v"][4] = -t["m"]["v"][4]
+    assert sentinel.tree_digest(t) != base
+    # a single flipped mantissa BIT (the silent-corruption unit)
+    t = _tree()
+    t["w"].view(onp.uint32).ravel()[7] ^= onp.uint32(1 << 20)
+    assert sentinel.tree_digest(t) != base
+    # leaf ORDER matters (two swapped leaves are corruption too)
+    a = [onp.float32(1.0), onp.float32(2.0)]
+    assert int(jax.jit(sentinel.fold_leaves)(a)) \
+        != int(jax.jit(sentinel.fold_leaves)(a[::-1]))
+    # element order within a leaf matters (position-weighted fold)
+    assert sentinel.tree_digest(onp.array([1.0, 2.0], onp.float32)) \
+        != sentinel.tree_digest(onp.array([2.0, 1.0], onp.float32))
+
+
+@pytest.mark.skipif(NDEV < 8, reason="needs the virtual 8-device world")
+def test_fold_invariant_to_mesh_shape():
+    """1-, 2-, and 8-device replicated placements fold to the SAME
+    digest — exact uint32 arithmetic is reduction-order independent, so
+    a topology change never fakes a corruption verdict."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    host = _tree()
+    folds = []
+    for n in (1, 2, 8):
+        mesh = Mesh(onp.array(jax.devices()[:n]), ("dp",))
+        rep = NamedSharding(mesh, PartitionSpec())
+        placed = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, rep), host)
+        f = sentinel.tree_digest(placed)
+        folds.append(f)
+    assert folds[0] == folds[1] == folds[2]
+    assert folds[0] == sentinel.tree_digest(host)      # == host fold
+
+
+def test_fold_deterministic_across_processes(tmp_path):
+    """Two processes holding bit-identical state report the same
+    integer — the property the cross-host vote would extend to."""
+    script = (
+        "import numpy as onp\n"
+        "from mxnet_tpu import sentinel\n"
+        "t = {'w': onp.arange(24, dtype=onp.float32).reshape(4, 6)"
+        " * 0.25,\n"
+        "     'm': {'v': onp.linspace(-1, 1, 7, dtype=onp.float32),\n"
+        "           'c': onp.int32(5)}}\n"
+        "print(sentinel.tree_digest(t))\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert int(r.stdout.strip().splitlines()[-1]) \
+        == sentinel.tree_digest(_tree())
+
+
+# ---------------------------------------------------------------------------
+# 2. cross-replica vote on the compiled step
+# ---------------------------------------------------------------------------
+
+def _tiny_step(kvstore="tpu", seed=0):
+    from mxnet_tpu.gluon import nn
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d1 = nn.Dense(12, in_units=8, activation="relu")
+            self.d2 = nn.Dense(4, in_units=12)
+
+        def forward(self, x):
+            return self.d2(self.d1(x))
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(seed)
+    for _n, p in sorted(net.collect_params().items()):
+        p.data()._set_data(mx.nd.array(rng.randn(*p.shape) * 0.1)._data)
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9},
+                       kvstore=kvstore)
+    loss_fn = lambda n, x, y: ((n(x) - y) ** 2).mean()
+    return net, tr, tr.compile_step(net, loss_fn)
+
+
+def _corrupt_one_replica(net, dev_pos):
+    """Rebuild the first parameter's replicated array with ONE device's
+    buffer bit-flipped; returns the corrupted device id."""
+    _name, p = sorted(net.collect_params().items())[0]
+    arr = p.data()._data
+    shards = sorted(arr.addressable_shards, key=lambda s: s.device.id)
+    bufs, victim = [], None
+    for j, sh in enumerate(shards):
+        host = onp.asarray(sh.data).copy()
+        if j == dev_pos:
+            victim = sh.device.id
+            host.view(onp.uint32).ravel()[2] ^= onp.uint32(1 << 19)
+        bufs.append(jax.device_put(host, sh.device))
+    p.data()._set_data(jax.make_array_from_single_device_arrays(
+        arr.shape, arr.sharding, bufs))
+    return victim
+
+
+@pytest.mark.skipif(NDEV < 4, reason="needs the virtual multi-device mesh")
+def test_replica_divergence_vote_localizes_device(monkeypatch):
+    monkeypatch.setenv("MXNET_SPMD_MESH", "4")
+    telemetry.clear_events()
+    net, _tr, step = _tiny_step()
+    snt = sentinel.Sentinel(step=step, every=1)
+    rng = onp.random.RandomState(1)
+    x = mx.nd.array(rng.randn(8, 8))
+    y = mx.nd.array(rng.randn(8, 4))
+    base = telemetry.snapshot()
+    step(x, y, batch_size=8)                  # clean sentinel step
+    assert step.last_step_compiled, step.last_fallback_reason
+    assert not snt.flush()                    # unanimous vote, no trip
+    victim = _corrupt_one_replica(net, dev_pos=2)
+    step(x, y, batch_size=8)                  # corrupt replica dispatch
+    assert snt.flush()                        # vote trips -> rollback
+    snap = telemetry.snapshot()
+    assert snap["sentinel.replica_divergence"] \
+        - base["sentinel.replica_divergence"] == 1
+    assert snap["sentinel.rollbacks"] - base["sentinel.rollbacks"] == 1
+    assert snt.last_vote["suspects"] == [victim]
+    assert snt.last_rollback["reason"] == "replica_divergence"
+    evs = telemetry.events(kind="corruption", name="sentinel")
+    assert any(e.get("device") == victim for e in evs)
+    # first confirmed corruption quarantines (MXNET_SENTINEL_STRIKES=1)
+    assert victim in snt.quarantine.device_ids()
+    assert snap["sentinel.quarantined"] == 1  # the computed gauge
+    # the rollback verdict reset window + pending state
+    assert snt._pending is None and snt._tripped is None
+
+
+# ---------------------------------------------------------------------------
+# 3. windowed anomaly detection
+# ---------------------------------------------------------------------------
+
+def test_window_zscore_and_nonfinite():
+    w = sentinel.Window(zmax=6.0, min_count=3)
+    # ordinary drift (a converging grad norm) never trips
+    for v in (10.0, 9.0, 8.2, 7.5, 6.9, 6.4):
+        assert not w.update(v)
+    assert w.update(900.0)                    # spike: |z| >> zmax
+    assert not w.update(6.0)                  # spike NOT absorbed
+    assert w.update(float("nan"))             # nonfinite_anomaly analog
+    assert w.update(float("inf"))
+    # warmup: fewer than min_count observations never z-trip
+    w2 = sentinel.Window(zmax=6.0, min_count=3)
+    assert not w2.update(1.0) and not w2.update(1000.0)
+
+
+def test_sentinel_observe_loss_trips_window():
+    snt = sentinel.Sentinel(every=1)
+    for v in (4.0, 3.5, 3.1, 2.8):
+        snt.observe_loss(v)
+    snt.observe_loss(4e6)                     # poisoned-batch spike
+    assert snt()                              # verdict via anomaly_fn
+    assert snt.last_rollback["reason"] == "loss_anomaly"
+
+
+# ---------------------------------------------------------------------------
+# 4. run_elastic integration
+# ---------------------------------------------------------------------------
+
+def _host_step(state, b):
+    return {"w": state["w"] + b, "i": state["i"] + 1}
+
+
+def test_anomaly_fn_cadence_routing(tmp_path):
+    """A detector carrying .every is only consulted on its cadence —
+    the fix for anomaly_fn forcing a blocking host read every step."""
+    calls = []
+
+    def det(state):
+        calls.append(int(state["i"]))
+        return False
+    det.every = 3
+
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=False)
+    run_elastic(_host_step, {"w": onp.float32(0), "i": onp.int64(0)},
+                [onp.float32(1)] * 9, mgr, save_every=5, anomaly_fn=det)
+    assert calls == [3, 6, 9]                 # steps 2, 5, 8 (post-step)
+    # a plain function (no .every) keeps the per-step contract
+    calls2 = []
+
+    def det2(state):
+        calls2.append(int(state["i"]))
+        return False
+
+    mgr2 = CheckpointManager(str(tmp_path / "c2"), async_save=False)
+    run_elastic(_host_step, {"w": onp.float32(0), "i": onp.int64(0)},
+                [onp.float32(1)] * 4, mgr2, save_every=5,
+                anomaly_fn=det2)
+    assert calls2 == [1, 2, 3, 4]
+    mgr.close(), mgr2.close()
+
+
+def test_presave_flush_gates_tainted_checkpoint(tmp_path):
+    """A flush() verdict at a save boundary raises BEFORE the save —
+    the tainted state is never checkpointed, and recovery replays from
+    the previous (attested) step."""
+    class Det:
+        every = 10**9                         # never evaluated per-step
+        trips = [False, True, False, False, False]
+
+        def __call__(self, state):
+            return False
+
+        def flush(self):
+            return self.trips.pop(0) if self.trips else False
+
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=False)
+    out, steps, restarts = run_elastic(
+        _host_step, {"w": onp.float32(0), "i": onp.int64(0)},
+        [onp.float32(1)] * 12, mgr, save_every=4, max_restarts=2,
+        anomaly_fn=Det())
+    assert steps == 12 and restarts == 1
+    assert float(out["w"]) == 12.0            # replay healed the run
+    # the gated save (step 8, the second flush) was NOT written at the
+    # moment of the verdict; recovery restored step 4
+    evs = telemetry.events(kind="restart", name="elastic")
+    assert any(e.get("step") == 4 and e.get("replay") == 4 for e in evs)
+    mgr.close()
+
+
+def test_sentinel_rollback_site_drives_restore(tmp_path, monkeypatch):
+    """An injected fault at "sentinel.rollback" (the documented site)
+    exercises exactly the rollback recovery: restore + replay under the
+    max_restarts budget, final state bit-equal the clean run's."""
+    monkeypatch.setattr(faults, "_sleep", lambda s: None)
+    snt = sentinel.Sentinel(every=1)          # evaluation passes the site
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=False)
+    with faults.active(
+            faults.FaultPlan().fail("sentinel.rollback", after=6)):
+        out, steps, restarts = run_elastic(
+            _host_step, {"w": onp.float32(0), "i": onp.int64(0)},
+            [onp.float32(1)] * 10, mgr, save_every=3, max_restarts=2,
+            anomaly_fn=snt)
+    assert steps == 10 and restarts == 1
+    assert float(out["w"]) == 10.0
+    assert faults.counters("sentinel.rollback")["injected"] == 1
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# 5. quarantine
+# ---------------------------------------------------------------------------
+
+def test_quarantine_persists_and_reloads(tmp_path):
+    path = str(tmp_path / "q" / "quarantine.json")
+    q = sentinel.Quarantine(path)
+    assert q.add_device(3, "replica divergence")
+    assert not q.add_device(3, "again")       # idempotent
+    q.add_rank(1, "barrier-timeout")
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert {(e["kind"], e["id"]) for e in on_disk} \
+        == {("device", 3), ("rank", 1)}
+    q2 = sentinel.Quarantine(path)            # a restart re-reads it
+    assert q2.device_ids() == [3] and q2.ranks() == [1]
+    # an unreadable list degrades to empty (never blocks a restart)
+    with open(path, "w") as f:
+        f.write("not json{")
+    assert sentinel.Quarantine(path).entries() == []
+
+
+def test_quarantine_persist_site_retries_transient(tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setattr(faults, "_sleep", lambda s: None)
+    q = sentinel.Quarantine(str(tmp_path / "quarantine.json"))
+    faults.reset()
+    with faults.active(faults.FaultPlan().fail("sentinel.quarantine")):
+        q.add_device(5, "flaky fs")
+    assert faults.counters("sentinel.quarantine")["retries"] == 1
+    assert sentinel.Quarantine(q.path).device_ids() == [5]
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs multiple devices")
+def test_mesh_resolution_excludes_quarantined_device():
+    q = sentinel.install_quarantine(sentinel.Quarantine(None))
+    victim = jax.devices()[1].id
+    q.add_device(victim, "test suspect")
+    mesh = spmd.resolve_mesh("auto")
+    ids = [d.id for d in mesh.devices.flat]
+    assert victim not in ids and len(ids) == NDEV - 1
+    # quarantining EVERYTHING is ignored loudly (a broken suspect list
+    # must never leave the job unable to resolve any mesh)
+    for d in jax.devices():
+        q.add_device(d.id, "all of them")
+    with pytest.warns(UserWarning, match="quarantined"):
+        mesh = spmd.resolve_mesh("auto")
+    assert len(list(mesh.devices.flat)) == NDEV
+
+
+def test_barrier_timeout_suspect_excluded_on_next_resolve(
+        tmp_path, monkeypatch):
+    """The satellite contract: a barrier-deadline suspect (hung host)
+    feeds the SAME quarantine list the corruption vote uses, and the
+    next mesh resolve excludes that rank's devices."""
+    from jax.experimental import multihost_utils
+
+    q = sentinel.install_quarantine(
+        sentinel.Quarantine(str(tmp_path / "quarantine.json")))
+    kv = mx.kv.create("local")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda name: time.sleep(30))
+    hb_dir = str(tmp_path / "hb")
+    hb = HeartbeatMonitor(hb_dir, rank=0, timeout=1.0)
+    hb.beat()
+    stale = os.path.join(hb_dir, "rank-1.hb")
+    with open(stale, "a"):
+        pass
+    old = time.time() - 60
+    os.utime(stale, (old, old))
+    kv.attach_heartbeat(hb)
+    with pytest.raises(faults.DeadlineExceeded):
+        kv.barrier(timeout=0.2)
+    assert q.ranks() == [1]                   # fed by the deadline path
+    assert sentinel.Quarantine(q.path).ranks() == [1]   # persisted
+
+    class FakeDev:
+        def __init__(self, i, rank):
+            self.id, self.process_index = i, rank
+
+    devs = [FakeDev(0, 0), FakeDev(1, 0), FakeDev(2, 1), FakeDev(3, 1)]
+    kept = q.filter_devices(devs)             # the resolve-time filter
+    assert [d.id for d in kept] == [0, 1]
+    # this single-controller world is rank 0 throughout: the REAL mesh
+    # resolve stays whole (no false exclusion)
+    if NDEV >= 2:
+        assert len(list(spmd.resolve_mesh("auto").devices.flat)) == NDEV
+
+
+# ---------------------------------------------------------------------------
+# telemetry contracts
+# ---------------------------------------------------------------------------
+
+def test_sentinel_counters_registered():
+    reg = telemetry.registered()
+    for name, kind in (("sentinel.digests", "cumulative"),
+                       ("sentinel.replica_divergence", "cumulative"),
+                       ("sentinel.rollbacks", "cumulative")):
+        assert name in reg and reg[name]["kind"] == kind, name
+    assert "sentinel.quarantined" in reg      # computed gauge
+    for knob in ("MXNET_SENTINEL_EVERY", "MXNET_SENTINEL_ZMAX",
+                 "MXNET_SENTINEL_STRIKES"):
+        assert knob in mx.config.VARIABLES

@@ -75,6 +75,16 @@ DECODE_BUDGET = {"retraces_after_warm": 0, "programs_over_grid": 0,
 # outputs)
 STORE_BUDGET = {"evictions_after_warm": 0, "live_train_programs_over": 0,
                 "second_process_compiles": 0}
+# the SENTINEL budget (docs/ROBUSTNESS.md "Training-integrity
+# sentinel"): with a Sentinel attached at cadence E the step STAYS one
+# compiled launch with zero retraces — the digest rides an in-program
+# lax.cond selected by a traced flag — and the only added host syncs
+# are the deferred digest reads (exactly one per cadence window, never
+# one per step)
+SENTINEL_BUDGET = {"compiled_launches_per_step": 1,
+                   "eager_invokes_per_step": 0,
+                   "retraces_after_warm": 0,
+                   "replica_divergence": 0}
 # the MESH budget (docs/PERF.md "Pod-scale SPMD train step"): under
 # kvstore='tpu' the data-parallel step stays ONE compiled launch — the
 # SPMD partitioner fans out over the mesh, never the host (no per-chip
@@ -173,6 +183,62 @@ def _measure(compiled: bool, with_amp: bool = False) -> dict:
     # program-store lane input: one constant-shape signature must hold
     # exactly ONE live program in this step's keyspace
     out["live_programs"] = len(step._programs) if compiled else 0
+    return out
+
+
+def _measure_sentinel() -> dict:
+    """Training-integrity sentinel lane: a Sentinel at cadence 2 rides
+    the compiled step for 6 steps — still 1 launch/step, 0 retraces,
+    digest reads == cadence windows (each a deferred read, counted as a
+    host sync), fingerprints bit-stable across two identical windows,
+    and the in-program fold equals a host recomputation of the same
+    state."""
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu import cached_step, sentinel, telemetry
+    from mxnet_tpu.ndarray import ndarray as _ndmod
+
+    net, trainer, loss_fn, data, label = _build(seed=7)
+    step = trainer.compile_step(net, loss_fn)
+    snt = sentinel.Sentinel(step=step, every=2)
+    loss = step(data, label, batch_size=6)          # warm (call 1)
+    float(loss.asnumpy().ravel()[0])
+    d0, t0 = cached_step.dispatch_count(), cached_step.trace_count()
+    i0, h0 = _ndmod.invoke_count(), _ndmod.host_sync_count()
+    base = telemetry.snapshot()
+    STEPS_S = 5                       # calls 2..6: last call is a
+    for _ in range(STEPS_S):          # sentinel step, so the flushed
+        loss = step(data, label, batch_size=6)    # fold matches the
+    assert step.last_step_compiled, step.last_fallback_reason  # live state
+    snt.flush()
+    snap = telemetry.snapshot()
+    reads = snap["sentinel.digests"] - base["sentinel.digests"]
+    # host recomputation of the fold over exactly what the program
+    # digests: post-update trainable params + optimizer state
+    upd = trainer._updaters[0]
+    leaves = [p.data()._data for p in trainer._params
+              if p.grad_req != "null"]
+    import jax
+
+    states = [upd.states[trainer._param2idx[id(p)]]
+              for p in trainer._params if p.grad_req != "null"]
+    state_leaves = [getattr(l, "_data", l)
+                    for l in jax.tree_util.tree_leaves(states)]
+    host_fold = sentinel.tree_digest(leaves + state_leaves)
+    out = {
+        "mode": "sentinel",
+        "compiled_launches_per_step":
+            (cached_step.dispatch_count() - d0) / STEPS_S,
+        "eager_invokes_per_step":
+            (_ndmod.invoke_count() - i0) / STEPS_S,
+        "retraces_after_warm": cached_step.trace_count() - t0,
+        "digest_reads": reads,
+        "host_syncs": _ndmod.host_sync_count() - h0,
+        "replica_divergence": snap["sentinel.replica_divergence"]
+        - base["sentinel.replica_divergence"],
+        "fold": snt.last_fold,
+        "host_fold": host_fold,
+        "fold_matches_host": snt.last_fold == host_fold,
+    }
     return out
 
 
@@ -440,6 +506,13 @@ def main() -> int:
           f"{decode['prefills']} prefill "
           f"({decode['rows_per_decode']} rows/step), "
           f"{decode['leaked_pages']} leaked pages")
+    snt = _measure_sentinel()
+    print(f"{'sentinel':<10} cadence 2 -> "
+          f"{snt['compiled_launches_per_step']:.1f} launch/step, "
+          f"{snt['retraces_after_warm']} retraces, "
+          f"{snt['digest_reads']} digest reads "
+          f"({snt['host_syncs']} syncs), fold "
+          f"{'==' if snt['fold_matches_host'] else '!='} host recompute")
     mesh = _measure_mesh()
     if mesh["skipped"]:
         print(f"mesh       SKIPPED ({mesh['skipped']})")
@@ -500,6 +573,24 @@ def main() -> int:
         if decode[key] > budget:
             failures.append(
                 f"decode {key} = {decode[key]} exceeds budget {budget}")
+    for key, budget in SENTINEL_BUDGET.items():
+        if snt[key] > budget:
+            failures.append(
+                f"sentinel {key} = {snt[key]} exceeds budget {budget}")
+    if snt["digest_reads"] != 3:
+        failures.append(
+            f"sentinel read {snt['digest_reads']} digests over 5 steps "
+            "at cadence 2 (expected 3: one per cadence window)")
+    if snt["host_syncs"] > snt["digest_reads"]:
+        failures.append(
+            "sentinel step performs host syncs beyond the deferred "
+            f"digest reads ({snt['host_syncs']} syncs vs "
+            f"{snt['digest_reads']} reads)")
+    if not snt["fold_matches_host"]:
+        failures.append(
+            f"in-program digest {snt['fold']} != host recomputation "
+            f"{snt['host_fold']} — the fingerprint does not attest the "
+            "state it claims to")
     if not mesh["skipped"]:
         if not mesh["used_compiled"]:
             failures.append("mesh mode fell back to the eager tape")
@@ -559,6 +650,9 @@ def main() -> int:
           f"{decode['retraces_after_warm']} retraces, "
           f"{decode['extra_dispatches']} extra dispatches, "
           f"{decode['leaked_pages']} leaked pages)"
+          f"; sentinel within budget "
+          f"({snt['compiled_launches_per_step']:.0f} launch/step, "
+          f"{snt['digest_reads']} digest reads, fold == host)"
           + ("" if mesh["skipped"] else
              f"; mesh within budget ({mesh['mesh_devices']}-device SPMD, "
              f"{mesh['compiled_launches_per_step']:.0f} launch/step, "

@@ -749,6 +749,9 @@ def lane_decode(on_cpu: bool) -> dict:
         "storm_interference_p99_ratio": s.get("interference_p99_ratio"),
         "storm_shed_total": s.get("shed_total"),
         "storm_slow_tokens_s": s.get("slow", {}).get("tokens_s"),
+        # ISSUE-14 availability columns: the router storm (1-of-2
+        # replicas killed mid-storm) — dropped must stay 0
+        "router_storm": c.get("router_storm"),
         "compile_s": c["compile_s"],
         "cache_hits": c["cache_hits"],
         "cache_misses": c["cache_misses"],

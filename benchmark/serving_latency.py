@@ -24,7 +24,11 @@ of mixed-length prompts against a fast model co-hosted with a
 deliberately slow model on the SHARED KV page pool, reporting
 per-model p50/p99, shed count, tokens/s, and the interference ratio
 (fast model storm-p99 / solo-p99 — bounded misbehavior, not silent
-collapse).  ``--storm`` prints the storm report standalone.
+collapse), and a ROUTER storm (ISSUE 14): two fast replicas behind a
+``serving_router.ReplicaRouter`` with one replica killed mid-storm,
+stamping the availability columns — dropped (must be 0) / hedged /
+failed_over / breaker_transitions — next to the latency numbers.
+``--storm`` prints the storm report standalone.
 
 Usage: python benchmark/serving_latency.py [--json] [--serve-only]
            [--decode-only] [--storm] [--requests N] [--threads T]
@@ -297,6 +301,67 @@ if STORM:
         "shed_total": storm["fast"]["shed"] + storm["slow"]["shed"],
     }
 
+    # ---- router storm: the availability columns -----------------------
+    # 2 replicas behind a ReplicaRouter, bursty arrivals, one replica
+    # KILLED mid-storm: the columns the fault-tolerant serving plane is
+    # judged on — dropped (must be 0), hedged, failed_over, breaker
+    # transitions — ride the bench artifact so availability regressions
+    # are visible round over round like every perf number.
+    from mxnet_tpu.serving_router import ReplicaRouter
+    rpools = [sd.PagePool(pages=256, page=8) for _ in range(2)]
+    rengines = [sd.GenerativeEngine(fast_model(), params=fparams,
+                                    pool=rpools[i], max_rows=8,
+                                    name=f"rr{i}") for i in range(2)]
+    for e in rengines:
+        e.warmup(max_len=16)
+    router = ReplicaRouter(rengines, name="bench", breaker_errs=2,
+                           breaker_cooldown_s=0.5, hedge_pctl=95)
+    rprompts = mk_prompts(48)
+    delivered, shed, rerrs = [0], [0], []
+    rlock = threading.Lock()
+    def rfire(chunk):
+        for p in chunk:
+            time.sleep(rng.exponential(1.0 / 40.0))
+            try:
+                router.generate(p, max_new_tokens=NEW,
+                                deadline_us=20_000_000)
+                with rlock:
+                    delivered[0] += 1
+            except sd.ShedError:
+                with rlock:
+                    shed[0] += 1
+            except BaseException as e:
+                rerrs.append(repr(e))
+    rthreads = [threading.Thread(target=rfire, args=(rprompts[i::8],))
+                for i in range(8)]
+    t0 = time.perf_counter()
+    for t in rthreads: t.start()
+    time.sleep(0.3)                       # storm rolling: kill replica 0
+    def rboom(*a, **k):
+        raise RuntimeError("bench replica kill")
+    rengines[0].generate = rboom
+    for t in rthreads: t.join()
+    rwall = time.perf_counter() - t0
+    rst = router.stats()
+    out["router_storm"] = {
+        "requests": len(rprompts),
+        "delivered": delivered[0],
+        "dropped": len(rprompts) - delivered[0] - shed[0],
+        "shed": shed[0],
+        "errors": rerrs,
+        "hedged": rst["hedges"],
+        "failed_over": rst["failovers"],
+        "breaker_transitions": (rst["breaker_opens"]
+                                + rst["breaker_half_opens"]
+                                + rst["breaker_closes"]),
+        "p50_us": round(rst["p50_us"], 1),
+        "p99_us": round(rst["p99_us"], 1),
+        "tokens_s": round(delivered[0] * NEW / rwall, 1),
+        "wall_s": round(rwall, 2),
+    }
+    for e in rengines:
+        e.close()
+
 _disk = program_store.disk_stats()
 out["cache_hits"] = _disk["hits"]
 out["cache_misses"] = _disk["misses"]
@@ -388,6 +453,14 @@ def main_decode(storm_only: bool = False) -> None:
               f"{s['shed_total']} shed, "
               f"{s['slow']['preempts'] + s['fast']['preempts']} "
               "preempts")
+    r = lane.get("router_storm")
+    if r:
+        print(f"router storm (1-of-2 replicas killed mid-storm): "
+              f"{r['delivered']}/{r['requests']} delivered, "
+              f"{r['dropped']} dropped, {r['shed']} shed, "
+              f"{r['failed_over']} failed over, {r['hedged']} hedged, "
+              f"{r['breaker_transitions']} breaker transitions, "
+              f"p99 {r['p99_us']:.0f} us, {r['tokens_s']} tok/s")
 
 
 if __name__ == "__main__":

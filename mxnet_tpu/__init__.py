@@ -87,6 +87,7 @@ _LAZY = {
     "sentinel": ".sentinel",
     "serving": ".serving",
     "serving_decode": ".serving_decode",
+    "serving_router": ".serving_router",
     "telemetry": ".telemetry",
     "test_utils": ".test_utils",
     "recordio": ".recordio",

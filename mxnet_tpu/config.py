@@ -486,6 +486,54 @@ declare("MXNET_SERVE_DECODE_ROWS", int, 8,
         "join/retire never retraces.  Also the continuous-batching "
         "concurrency ceiling per engine.",
         validator=lambda v: v >= 1, subsystem="serving", cached=False)
+declare("MXNET_ROUTER_BREAKER_ERRS", int, 3,
+        "ReplicaRouter circuit breaker: dispatch failures within the "
+        "last MXNET_ROUTER_BREAKER_WINDOW outcomes that OPEN a "
+        "replica's breaker (the replica stops receiving traffic until "
+        "a half-open probe succeeds).  A wedged dispatch or replica "
+        "death trips the breaker immediately, regardless of this "
+        "count.", validator=lambda v: v >= 1, subsystem="serving",
+        cached=False)
+declare("MXNET_ROUTER_BREAKER_WINDOW", int, 16,
+        "ReplicaRouter circuit breaker: size of the per-replica rolling "
+        "dispatch-outcome window the error threshold "
+        "(MXNET_ROUTER_BREAKER_ERRS) is evaluated over.",
+        validator=lambda v: v >= 1, subsystem="serving", cached=False)
+declare("MXNET_ROUTER_BREAKER_COOLDOWN_S", float, 2.0,
+        "ReplicaRouter circuit breaker: seconds an OPEN breaker stays "
+        "open before transitioning to HALF-OPEN, where exactly one "
+        "probe request is admitted — success closes the breaker "
+        "(replica re-admitted), failure re-opens it for another "
+        "cooldown.  This is the probe budget the availability gate "
+        "(tools/check_availability_budget.py) holds re-admission to.",
+        validator=lambda v: v > 0, subsystem="serving", cached=False)
+declare("MXNET_ROUTER_HEDGE_PCTL", int, 0,
+        "ReplicaRouter hedged requests (the tail-at-scale move): 0 "
+        "(default) = off; N in [50, 99] = a dispatch still outstanding "
+        "past the fleet's p<N> dispatch latency issues ONE duplicate "
+        "on a different healthy replica, first completion wins and the "
+        "loser is cancelled (counted hedge_cancelled).  Hedging stays "
+        "dormant until 16 latency samples exist; greedy decode keeps "
+        "the duplicate token-exact, so first-wins is safe.",
+        validator=lambda v: v == 0 or 50 <= v <= 99,
+        subsystem="serving", cached=False)
+declare("MXNET_ROUTER_WEDGE_S", float, 30.0,
+        "ReplicaRouter liveness: a dispatch outstanding this many "
+        "seconds with NO heartbeat from its replica (beats are stamped "
+        "per dispatch completion on the in-memory HeartbeatMonitor) "
+        "declares the replica WEDGED — its breaker trips open, the "
+        "dispatch is abandoned, and the request fails over to a "
+        "healthy replica.  Tune well above a legitimate worst-case "
+        "dispatch.", validator=lambda v: v > 0, subsystem="serving",
+        cached=False)
+declare("MXNET_ROUTER_EAGER_FALLBACK", bool, False,
+        "ReplicaRouter last-resort degraded mode: with EVERY replica "
+        "breaker open, serve single requests through the eager path "
+        "(eager_generate for generative routers, the engine's unpadded "
+        "eager forward for one-shot inference) instead of shedding "
+        "ShedError(kind='unavailable').  Default off: shedding loudly "
+        "is usually better than silently serving at eager throughput.",
+        subsystem="serving", cached=False)
 declare("MXNET_TELEMETRY_DIR", str, None,
         "Telemetry flight recorder: when set, telemetry.flush() — called "
         "by engine.waitall() and available directly — appends the "

@@ -41,6 +41,20 @@ or fails loudly:
   vs the eager oracle), queued requests come back as typed ``draining``
   sheds, 0 KV pages leak, and a second process serves the shed
   requests token-exactly.
+- ``router_kill`` / ``router_wedge`` / ``router_flap`` /
+  ``router_deadline_storm`` (``ROUTER_SCENARIOS``, gated by
+  ``tools/check_availability_budget.py``) — the SERVING chaos matrix
+  over a 2-replica ``serving_router.ReplicaRouter``: a replica killed
+  mid-decode (its compiled programs start raising; every in-flight and
+  queued request fails over, token-exact, 0 pages leaked, and a
+  preemption notice afterwards still drains the router to the
+  distinguished exit code), a wedged dispatch (hangs forever; the
+  heartbeat wedge timeout evicts the replica inside
+  ``MXNET_ROUTER_WEDGE_S``), a breaker flap (transient error burst
+  opens the breaker; the half-open probe re-admits within the probe
+  budget), and a deadline storm (tight ``deadline_us`` budgets shed
+  typed ``deadline`` within bounded wall clock — never a hang — while
+  feasible budgets deliver token-exact).
 - ``bitflip_param`` — the ISSUE-13 silent-corruption drill: the child
   flips one bit of ONE device's replica of a parameter mid-run; the
   sentinel's cross-replica digest vote localizes the device within one
@@ -80,11 +94,15 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
-__all__ = ["SCENARIOS", "run_drill", "main"]
+__all__ = ["SCENARIOS", "ROUTER_SCENARIOS", "run_drill", "main"]
 
 SCENARIOS = ("sigterm_drain", "sigkill_between_saves", "topology_change",
              "corrupt_latest", "decode_drain", "bitflip_param",
              "loss_spike")
+# the serving-availability matrix (tools/check_availability_budget.py);
+# kept OUT of SCENARIOS so the recovery gate's matrix is unchanged
+ROUTER_SCENARIOS = ("router_kill", "router_wedge", "router_flap",
+                    "router_deadline_storm")
 
 # the scripted workload every train drill shares
 N_STEPS = 24
@@ -481,6 +499,215 @@ def _cmd_decode(a) -> int:
 
 
 # ---------------------------------------------------------------------------
+# child: router chaos drill (the serving-availability matrix)
+# ---------------------------------------------------------------------------
+
+def _router_prompt(r: int) -> List[int]:
+    return [1 + (r * 5 + j) % 47 for j in range(4 + r % 4)]
+
+
+def _cmd_router(a) -> int:
+    import threading
+
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu import engine, faults, preemption, telemetry
+    from mxnet_tpu.faults import ShedError
+    from mxnet_tpu.serving_decode import (GenerativeEngine, PagePool,
+                                          TinyCausalLM, eager_generate)
+    from mxnet_tpu.serving_router import ReplicaRouter
+
+    model = TinyCausalLM(vocab=50, d_model=16, n_layers=1, n_heads=2,
+                         max_seq=96)
+    params = model.init_params(0)
+    pools = [PagePool(pages=64, page=8), PagePool(pages=64, page=8)]
+    engines = [GenerativeEngine(model, params=params, pool=pools[i],
+                                max_rows=2, name=f"rep{i}")
+               for i in range(2)]
+    for e in engines:
+        e.warmup(max_len=8)
+    router = ReplicaRouter(
+        engines, name="drill", breaker_errs=2, breaker_cooldown_s=0.5,
+        wedge_s=(1.5 if a.mode == "wedge" else 30.0), hedge_pctl=0)
+    if a.preempt:
+        preemption.install()
+
+    records: Dict[int, Dict[str, Any]] = {}
+    lock = threading.Lock()
+
+    def fire(rid: int, deadline_us: Optional[int] = None) -> None:
+        t0 = time.monotonic()
+        rec: Dict[str, Any] = {
+            "budget_s": deadline_us / 1e6 if deadline_us else None}
+        try:
+            toks = router.generate(_router_prompt(rid),
+                                   max_new_tokens=a.max_new,
+                                   deadline_us=deadline_us)
+            rec.update(status="delivered",
+                       tokens=[int(t) for t in toks])
+        except ShedError as e:
+            rec.update(status="shed", kind=getattr(e, "kind", None))
+        except BaseException as e:   # pragma: no cover - drill failure
+            rec.update(status="error", error=repr(e))
+        rec["elapsed_s"] = time.monotonic() - t0
+        with lock:
+            records[rid] = rec
+
+    # -- phase A: steady state (sequential; also warms the cost table) --
+    for rid in range(a.steady):
+        fire(rid)
+    steady_lat = sorted(records[r]["elapsed_s"] for r in range(a.steady)
+                        if records[r]["status"] == "delivered")
+    steady_p99_s = (steady_lat[min(len(steady_lat) - 1,
+                                   int(len(steady_lat) * 0.99))]
+                    if steady_lat else None)
+
+    # -- chaos injection -------------------------------------------------
+    orig_gen = engines[0].generate
+    flap_calls = {"n": 0}
+
+    class _Boom:
+        """Stand-in for replica 0's compiled programs after the 'kill':
+        the scheduler's next decode/prefill lookup raises — exactly what
+        an engine whose process segment died mid-decode looks like from
+        the host thread."""
+
+        def __call__(self, *args, **kw):
+            raise RuntimeError("replica 0 killed mid-decode")
+
+    def apply_chaos() -> None:
+        if a.mode == "kill":
+            boom = _Boom()
+            engines[0]._programs.insert(("decode",), boom)
+            for b in (1, 2, 4, 8):
+                engines[0]._programs.insert(("prefill", b), boom)
+        elif a.mode == "wedge":
+            def wedged(*args, **kw):
+                time.sleep(120.0)
+                raise RuntimeError("wedged dispatch finally released")
+            engines[0].generate = wedged
+        elif a.mode == "flap":
+            def flaky(*args, **kw):
+                flap_calls["n"] += 1
+                if flap_calls["n"] <= 4:
+                    raise faults.TransientFault(
+                        f"flap {flap_calls['n']}")
+                return orig_gen(*args, **kw)
+            engines[0].generate = flaky
+
+    # -- phase B: chaos under concurrent load ---------------------------
+    base = a.steady
+    chaos_ids = list(range(base, base + a.requests))
+    if a.mode == "deadline_storm":
+        # alternating infeasible (3 ms — the cost table prices a
+        # max_new-token request far above it) and feasible budgets
+        budgets = {rid: (3_000 if i % 2 == 0 else 30_000_000)
+                   for i, rid in enumerate(chaos_ids)}
+    else:
+        budgets = {rid: None for rid in chaos_ids}
+    # graftlint: daemon-ok(drill request workers, joined in-scope below
+    # before the drill writes its verdict)
+    threads = [threading.Thread(target=fire, args=(rid, budgets[rid]))
+               for rid in chaos_ids]
+    for t in threads:
+        t.start()
+    if a.mode == "kill":
+        # strike while replica 0 is actively decoding chaos rows: wait
+        # for its decode counter to move with live rows (bounded poll)
+        d0 = engines[0]._stats["decode_steps"]
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if engines[0]._stats["decode_steps"] > d0 and \
+                    len(engines[0]._live) > 0:
+                break
+            time.sleep(0.001)
+        apply_chaos()
+    elif a.mode in ("wedge", "flap"):
+        apply_chaos()
+    for t in threads:
+        t.join(timeout=180.0)
+
+    # -- flap: measure breaker re-admission (probe budget) --------------
+    re_admit_s = None
+    if a.mode == "flap":
+        t0 = time.monotonic()
+        deadline = t0 + 10.0
+        while time.monotonic() < deadline:
+            if router.breaker_state(0) == "closed":
+                re_admit_s = time.monotonic() - t0
+                break
+            fire(10_000 + int((time.monotonic() - t0) * 1000))
+            time.sleep(0.05)
+
+    # -- kill: the PR-11 preemption leg — the router must still drain ---
+    preempted: Optional[int] = None
+    drain_ids: List[int] = []
+    if a.preempt:
+        drain_ids = list(range(20_000, 20_000 + 4))
+        fired = {"sig": False}
+
+        def drain_worker(rid: int) -> None:
+            fire(rid)
+            with lock:
+                fire_now = not fired["sig"] and any(
+                    records.get(r, {}).get("status") == "delivered"
+                    for r in drain_ids if r in records)
+                fired["sig"] = fired["sig"] or fire_now
+            if fire_now:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        # graftlint: daemon-ok(drill request workers, joined in-scope
+        # below before the drill writes its verdict)
+        dthreads = [threading.Thread(target=drain_worker, args=(rid,))
+                    for rid in drain_ids]
+        for t in dthreads:
+            t.start()
+        try:
+            for t in dthreads:
+                while t.is_alive():
+                    t.join(timeout=0.05)
+        except preemption.Preempted as e:
+            preempted = int(e.code)
+            for t in dthreads:
+                t.join(timeout=30.0)
+    engine.waitall()
+
+    # token-exactness of every delivered response vs the eager oracle
+    # (the drill's model is tiny, so full verification is affordable)
+    token_exact = True
+    oracle_cache: Dict[int, List[int]] = {}
+    for rid, rec in sorted(records.items()):
+        if rec["status"] != "delivered":
+            continue
+        if rid not in oracle_cache:
+            oracle_cache[rid] = eager_generate(
+                model, params, _router_prompt(rid), a.max_new)
+        if rec["tokens"] != oracle_cache[rid]:
+            token_exact = False
+            rec["oracle"] = oracle_cache[rid]
+
+    st = router.stats()
+    res = {
+        "label": a.label, "mode": a.mode, "pid": os.getpid(),
+        "preempted_code": preempted,
+        "steady_ids": list(range(a.steady)),
+        "chaos_ids": chaos_ids,
+        "drain_ids": drain_ids,
+        "records": {str(k): v for k, v in records.items()},
+        "token_exact": token_exact,
+        "steady_p99_s": steady_p99_s,
+        "re_admit_s": re_admit_s,
+        "leaked_pages": sum(p.in_use() for p in pools),
+        "router": {k: v for k, v in st.items() if k != "replicas"},
+        "breakers": [r["breaker"] for r in st["replicas"]],
+        "drain_s": telemetry.snapshot().get("preemption.drain_s"),
+        "telemetry": telemetry.snapshot(),
+    }
+    with open(os.path.join(a.dir, f"result-{a.label}.json"), "w") as f:
+        json.dump(res, f)
+    return preempted or 0
+
+
+# ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
 
@@ -616,13 +843,17 @@ def run_drill(name: str, root: str, verbose: bool = False
     return its report: ``ok``, ``failures``, and the measured recovery
     budget (recovery_s / recovery_wall_s / steps_replayed / drain_s /
     fresh_compiles / disk hits)."""
-    if name not in SCENARIOS:
-        raise ValueError(f"unknown drill {name!r} (one of {SCENARIOS})")
+    if name not in SCENARIOS and name not in ROUTER_SCENARIOS:
+        raise ValueError(f"unknown drill {name!r} (one of "
+                         f"{SCENARIOS + ROUTER_SCENARIOS})")
     os.makedirs(root, exist_ok=True)
     failures: List[str] = []
     report: Dict[str, Any] = {"scenario": name, "root": root}
     t0 = time.monotonic()
-    if name == "decode_drain":
+    if name in ROUTER_SCENARIOS:
+        _drill_router(root, failures, report,
+                      mode=name[len("router_"):])
+    elif name == "decode_drain":
         _drill_decode(root, failures, report)
     else:
         ref = _ensure_reference(root, failures)
@@ -1079,6 +1310,128 @@ def _drill_decode(root: str, failures: List[str],
             failures.append("decode re-queue leg leaked pages")
 
 
+def _drill_router(root: str, failures: List[str],
+                  report: Dict[str, Any], mode: str) -> None:
+    """One cell of the serving chaos matrix: a 2-replica router child
+    under {kill | wedge | flap | deadline_storm}.  The availability
+    contract every cell shares: 0 dropped requests (every submission
+    ends delivered or typed-shed), every delivery token-exact vs the
+    eager oracle, 0 leaked KV pages."""
+    scen = os.path.join(root, f"router-{mode}")
+    os.makedirs(scen, exist_ok=True)
+    argv = ["router", "--dir", scen, "--label", "c1", "--mode", mode,
+            "--steady", "12", "--requests", "8", "--max-new", "10"]
+    if mode == "kill":
+        argv += ["--preempt"]
+    c1 = _run_child(argv, _child_env(root, 1))
+    res = _read_result(scen, "c1") or {}
+    report["exit_code_c1"] = c1.returncode
+    want_code = (res.get("preempted_code") or 83) if mode == "kill" else 0
+    if c1.returncode != want_code:
+        failures.append(
+            f"router[{mode}] child exited {c1.returncode}, wanted "
+            f"{want_code}: {c1.stderr[-1500:]}")
+        return
+    records = {int(k): v for k, v in (res.get("records") or {}).items()}
+    submitted = (len(res.get("steady_ids") or [])
+                 + len(res.get("chaos_ids") or [])
+                 + len(res.get("drain_ids") or []))
+    # 0 dropped: every request the child submitted has a typed outcome
+    errors = {r: v for r, v in records.items() if v["status"] == "error"}
+    if errors:
+        failures.append(
+            f"router[{mode}] requests errored instead of "
+            f"delivering/shedding: {errors}")
+    known = sum(1 for v in records.values()
+                if v["status"] in ("delivered", "shed"))
+    if len(records) < submitted:
+        failures.append(
+            f"router[{mode}] dropped requests: {len(records)} outcomes "
+            f"for {submitted} submissions")
+    report["dropped"] = max(0, submitted - known)
+    if not res.get("token_exact"):
+        failures.append(
+            f"router[{mode}] delivered responses were not token-exact "
+            "vs the eager oracle (failover/hedge broke greedy "
+            "idempotence)")
+    if res.get("leaked_pages"):
+        failures.append(
+            f"router[{mode}] leaked {res['leaked_pages']} KV pages")
+    report["leaked_pages"] = res.get("leaked_pages")
+    rt = res.get("router") or {}
+    chaos = [records[r] for r in (res.get("chaos_ids") or [])
+             if r in records]
+    chaos_lat = sorted(v["elapsed_s"] for v in chaos
+                       if v["status"] == "delivered")
+    report["steady_p99_s"] = res.get("steady_p99_s")
+    report["chaos_p99_s"] = (
+        chaos_lat[min(len(chaos_lat) - 1, int(len(chaos_lat) * 0.99))]
+        if chaos_lat else None)
+    report["failovers"] = rt.get("failovers")
+    report["hedges"] = rt.get("hedges")
+    report["breaker_opens"] = rt.get("breaker_opens")
+    report["breaker_closes"] = rt.get("breaker_closes")
+    report["re_admit_s"] = res.get("re_admit_s")
+    report["drain_s"] = res.get("drain_s")
+
+    if mode == "kill":
+        if not rt.get("failovers"):
+            failures.append("router[kill] counted no failovers — the "
+                            "dead replica's requests were not re-routed")
+        if not rt.get("breaker_opens"):
+            failures.append("router[kill] never opened the dead "
+                            "replica's breaker")
+        drain_recs = [records[r] for r in (res.get("drain_ids") or [])
+                      if r in records]
+        bad = [v for v in drain_recs
+               if v["status"] == "shed" and v.get("kind") != "draining"]
+        if bad:
+            failures.append(
+                f"router[kill] drain-phase sheds were not typed "
+                f"'draining': {bad}")
+        if res.get("drain_s") is None:
+            failures.append("router[kill] preemption drain recorded no "
+                            "preemption.drain_s — waitall did not drain "
+                            "the router")
+    elif mode == "wedge":
+        if not rt.get("wedged"):
+            failures.append("router[wedge] never declared the wedged "
+                            "dispatch (heartbeat eviction broken)")
+        if not rt.get("failovers"):
+            failures.append("router[wedge] counted no failovers")
+    elif mode == "flap":
+        if not rt.get("breaker_opens"):
+            failures.append("router[flap] flap burst never opened the "
+                            "breaker")
+        if not rt.get("breaker_closes"):
+            failures.append("router[flap] breaker never closed again "
+                            "(half-open probe re-admission broken)")
+        if res.get("re_admit_s") is None:
+            failures.append("router[flap] re-admission never observed")
+    elif mode == "deadline_storm":
+        for r, v in sorted(records.items()):
+            b = v.get("budget_s")
+            if b is None:
+                continue
+            if b < 0.01:                      # the infeasible budgets
+                if v["status"] != "shed" or v.get("kind") != "deadline":
+                    failures.append(
+                        f"router[deadline_storm] request {r} with a "
+                        f"{b * 1e6:.0f}us budget ended "
+                        f"{v['status']}:{v.get('kind')} (wanted a "
+                        "typed 'deadline' shed)")
+                if v["elapsed_s"] > b + 1.0:
+                    failures.append(
+                        f"router[deadline_storm] request {r} consumed "
+                        f"{v['elapsed_s']:.3f}s against a "
+                        f"{b:.3f}s budget (+1s slack) — the deadline "
+                        "did not bound the wait")
+            elif v["status"] != "delivered":
+                failures.append(
+                    f"router[deadline_storm] feasible request {r} "
+                    f"ended {v['status']}:{v.get('kind')}")
+
+
 # ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
@@ -1122,6 +1475,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     d.add_argument("--self-sigterm", action="store_true",
                    dest="self_sigterm")
 
+    ro = sub.add_parser("router", help="router-chaos-drill child")
+    ro.add_argument("--dir", required=True)
+    ro.add_argument("--label", default="c1")
+    ro.add_argument("--mode", default="kill",
+                    choices=("kill", "wedge", "flap", "deadline_storm"))
+    ro.add_argument("--steady", type=int, default=12)
+    ro.add_argument("--requests", type=int, default=8)
+    ro.add_argument("--max-new", type=int, default=10, dest="max_new")
+    ro.add_argument("--preempt", action="store_true")
+
     r = sub.add_parser("run", help="orchestrate scenarios")
     r.add_argument("scenarios", nargs="*", default=list(SCENARIOS))
     r.add_argument("--root", default=None)
@@ -1132,6 +1495,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_train(a)
     if a.cmd == "decode":
         return _cmd_decode(a)
+    if a.cmd == "router":
+        return _cmd_router(a)
     import tempfile
 
     root = a.root or tempfile.mkdtemp(prefix="mxnet-drills-")

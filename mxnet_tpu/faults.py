@@ -44,6 +44,7 @@ __all__ = [
     "ShedError",
     "FaultPlan", "install", "uninstall", "active", "inject", "retry_call",
     "is_retryable", "counters", "events", "record_event", "reset",
+    "deadline_scope", "deadline_remaining_us", "deadline_site",
 ]
 
 
@@ -65,19 +66,27 @@ class DeadlineExceeded(RuntimeError):
 
 class ShedError(RuntimeError):
     """Typed load-shed refusal (serving admission control, site
-    ``serving.admit``): the request was rejected IMMEDIATELY — queue
-    full, KV page pool exhausted, the SLO provably unmeetable, or the
-    process draining for preemption — instead of queueing toward a
-    timeout.  Overload degrades loudly: callers see this exact type and
-    can back off / route elsewhere; they never see a 300 s deadline
-    breach.  NOT retryable by default (retrying into an overloaded
-    server amplifies the overload).
+    ``serving.admit``; the replica router, site ``router.dispatch``):
+    the request was rejected IMMEDIATELY — queue full, KV page pool
+    exhausted, the SLO provably unmeetable, the process draining for
+    preemption, every replica's circuit breaker open, or the request's
+    own deadline budget spent — instead of queueing toward a timeout.
+    Overload degrades loudly: callers see this exact type and can back
+    off / route elsewhere; they never see a 300 s deadline breach.  NOT
+    retryable by default (retrying into an overloaded server amplifies
+    the overload).
 
     ``kind`` tags the refusal reason (``queue`` | ``pool`` | ``slo`` |
-    ``draining`` | ``None`` for legacy raisers) so callers can route on
-    it without parsing the message: a ``draining`` shed means this
-    process took a preemption notice — retry on another replica or
-    after the restart, never here."""
+    ``draining`` | ``unavailable`` | ``deadline`` | ``None`` for legacy
+    raisers) so callers can route on it without parsing the message —
+    the machine-readable half of the docs/ROBUSTNESS.md shed contract:
+    a ``draining`` shed means this process took a preemption notice
+    (retry on another replica or after the restart, never here);
+    ``unavailable`` means every serving replica is ejected (breaker
+    open / dead) and the router refused rather than hang; ``deadline``
+    means the request's ``deadline_us`` budget was exhausted across
+    admission + queue + retries + hedges (resubmit with a bigger
+    budget, or not at all)."""
 
     kind: Optional[str] = None
 
@@ -273,6 +282,91 @@ def reset() -> None:
         _PLAN.reset()
 
 
+# -- shared deadline budget -------------------------------------------------
+# One wall-clock budget per request, threaded through every nested
+# retried site instead of multiplying per-site timeouts: the OUTERMOST
+# deadline_scope (or retry_call(deadline_us=)) pins an absolute
+# monotonic expiry on this thread; nested scopes can only NARROW it,
+# and every retry_call underneath draws backoff from the same remaining
+# budget.  Exhaustion raises DeadlineExceeded naming the OUTERMOST
+# site — the one whose budget it really was.
+_DEADLINE = threading.local()
+
+
+def _deadline_state() -> Optional[Tuple[float, str]]:
+    """(absolute monotonic expiry, outermost site) or None."""
+    return getattr(_DEADLINE, "state", None)
+
+
+def deadline_remaining_us() -> Optional[int]:
+    """Microseconds left in this thread's ambient deadline budget
+    (negative once spent), or ``None`` when no budget is set.  Queue
+    waits and admission checks inside a budget consult this instead of
+    inventing their own timeout."""
+    st = _deadline_state()
+    if st is None:
+        return None
+    return int((st[0] - time.monotonic()) * 1e6)
+
+
+def deadline_site() -> Optional[str]:
+    """The outermost site that owns the ambient budget (exception
+    attribution), or None."""
+    st = _deadline_state()
+    return None if st is None else st[1]
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline_us: Optional[int] = None, *, site: str,
+                   until: Optional[float] = None):
+    """Establish (or narrow) the thread's shared deadline budget.
+
+    ``deadline_us`` is relative to now; ``until`` is an absolute
+    ``time.monotonic()`` expiry (for carrying ONE request budget across
+    threads — stamp the absolute expiry on the request at admission and
+    re-enter the scope on whichever thread dispatches it).  An
+    enclosing budget that is already tighter wins, and the OUTERMOST
+    scope's ``site`` owns every :class:`DeadlineExceeded` raised
+    underneath.  With neither argument the scope is a no-op
+    passthrough."""
+    prev = _deadline_state()
+    if until is None:
+        if deadline_us is None:
+            yield prev
+            return
+        until = time.monotonic() + deadline_us / 1e6
+    if prev is not None:
+        until = min(until, prev[0])
+        site = prev[1]
+    _DEADLINE.state = (until, site)
+    try:
+        yield _DEADLINE.state
+    finally:
+        _DEADLINE.state = prev
+
+
+def _check_deadline(site: str, last_error: Optional[BaseException] = None,
+                    about_to_sleep: float = 0.0) -> None:
+    """Raise DeadlineExceeded (named after the OUTERMOST site) when the
+    ambient budget is spent — or would be spent by sleeping
+    ``about_to_sleep`` more seconds."""
+    st = _deadline_state()
+    if st is None:
+        return
+    remaining = st[0] - time.monotonic()
+    if remaining - about_to_sleep > 0:
+        return
+    record_event(site, "deadline", last_error,
+                 budget_site=st[1], remaining_us=int(remaining * 1e6))
+    msg = (f"site {st[1]!r}: shared deadline budget exhausted"
+           + (f" at nested site {site!r}" if site != st[1] else "")
+           + (f"; last error: {last_error!r}" if last_error is not None
+              else ""))
+    if last_error is not None:
+        raise DeadlineExceeded(msg) from last_error
+    raise DeadlineExceeded(msg)
+
+
 # -- retryable classification ---------------------------------------------
 # multiprocessing.TimeoutError subclasses neither OSError nor TimeoutError
 import multiprocessing as _mp  # noqa: E402  (stdlib, cheap)
@@ -298,6 +392,7 @@ def retry_call(fn: Callable, *args,
                backoff: Optional[float] = None,
                max_backoff: Optional[float] = None,
                deadline: Optional[float] = None,
+               deadline_us: Optional[int] = None,
                retryable: Optional[Callable[[BaseException], bool]] = None,
                on_retry: Optional[Callable[[int, BaseException], None]] = None,
                **kwargs):
@@ -308,8 +403,17 @@ def retry_call(fn: Callable, *args,
     - ``backoff``/``max_backoff``: deterministic exponential delay
       ``min(backoff * 2**(attempt-1), max_backoff)`` between attempts;
       defaults ``MXNET_RETRY_BACKOFF`` / ``MXNET_RETRY_BACKOFF_MAX``.
-    - ``deadline``: overall wall-clock budget (seconds); breaching it
-      raises :class:`DeadlineExceeded` chained to the last error.
+    - ``deadline``: legacy per-call wall-clock budget (seconds);
+      breaching it raises :class:`DeadlineExceeded` chained to the last
+      error.
+    - ``deadline_us``: the SHARED budget (see :func:`deadline_scope`) —
+      one wall clock across this site AND every retried site nested
+      under it: each attempt and each backoff sleep draws from the same
+      remaining budget (backoff is truncated to it), and exhaustion
+      raises :class:`DeadlineExceeded` naming the OUTERMOST site.  An
+      ambient scope established by a caller is inherited (and only ever
+      narrowed) whether or not this call passes its own value — this is
+      what fixes nested-retry timeout multiplication.
     - ``retryable``: predicate overriding :func:`is_retryable`.
 
     ``inject(site)`` runs before every attempt, so a :class:`FaultPlan`
@@ -317,6 +421,13 @@ def retry_call(fn: Callable, *args,
     budget is spent the LAST underlying exception re-raises unchanged —
     callers' ``except`` clauses see the same types as without retry.
     """
+    with deadline_scope(deadline_us, site=site):
+        return _retry_loop(fn, args, kwargs, site, retries, backoff,
+                           max_backoff, deadline, retryable, on_retry)
+
+
+def _retry_loop(fn, args, kwargs, site, retries, backoff, max_backoff,
+                deadline, retryable, on_retry):
     retries = config.get("MXNET_RETRY_MAX") if retries is None else retries
     backoff = config.get("MXNET_RETRY_BACKOFF") if backoff is None else backoff
     max_backoff = (config.get("MXNET_RETRY_BACKOFF_MAX")
@@ -327,6 +438,7 @@ def retry_call(fn: Callable, *args,
     attempt = 0
     while True:
         attempt += 1
+        _check_deadline(site)            # budget spent: never attempt
         stats.inc("attempts")
         try:
             inject(site)
@@ -343,6 +455,10 @@ def retry_call(fn: Callable, *args,
                 raise DeadlineExceeded(
                     f"site {site!r}: {deadline}s deadline exceeded after "
                     f"{attempt} attempt(s); last error: {e!r}") from e
+            # the SHARED budget: a backoff that would sleep past the
+            # remaining budget raises instead (truncation to zero is a
+            # loud DeadlineExceeded, never a silent overrun)
+            _check_deadline(site, last_error=e, about_to_sleep=delay)
             stats.inc("retries")
             record_event(site, "retry", e, attempt=attempt, delay=delay)
             if on_retry is not None:

@@ -27,7 +27,7 @@ import queue
 import re
 import threading
 import time
-from typing import Any, Callable, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -514,27 +514,50 @@ class CheckpointManager:
 
 
 class HeartbeatMonitor:
-    """File-mtime liveness over a shared directory — works with the
-    multi-process local/ssh launcher (each rank touches
-    ``<dir>/rank-<r>.hb`` every ``interval``; a rank whose beat is older
-    than ``timeout`` is dead).  The analog of ps-lite's node heartbeats,
-    which the reference never surfaced to users (SURVEY §5)."""
+    """Liveness over a beat table: each member stamps a beat; a member
+    whose newest beat is older than ``timeout`` is dead.  The analog of
+    ps-lite's node heartbeats, which the reference never surfaced to
+    users (SURVEY §5).  Two storage modes behind one interface:
 
-    def __init__(self, directory: str, rank: int, interval: float = 2.0,
-                 timeout: float = 10.0):
+    - **shared directory** (``directory=`` set): file-mtime beats
+      (``<dir>/rank-<r>.hb``, touched every ``interval`` by the
+      :meth:`start` thread) — works with the multi-process local/ssh
+      launcher; this is the kvstore-barrier attachment.
+    - **in-memory** (``directory=None``): a plain ``{key: monotonic}``
+      table for CO-HOSTED members inside one process — the serving
+      router's engine heartbeats, where a beat is stamped PER DISPATCH
+      (``beat(key)``) rather than by a timer, so a wedged replica is
+      one whose dispatch is outstanding with no beat for ``timeout``.
+
+    Keys (``rank``) may be ints (launcher ranks) or strings (engine
+    replica names)."""
+
+    def __init__(self, directory: Optional[str] = None, rank=0,
+                 interval: float = 2.0, timeout: float = 10.0):
         self.directory = directory
         self.rank = rank
         self.interval = interval
         self.timeout = timeout
-        os.makedirs(directory, exist_ok=True)
+        self._beats: Dict[Any, float] = {}
+        self._beats_lock = threading.Lock()
+        if directory is not None:
+            os.makedirs(directory, exist_ok=True)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def _path(self, rank: int) -> str:
+    def _path(self, rank) -> str:
         return os.path.join(self.directory, f"rank-{rank}.hb")
 
-    def beat(self) -> None:
-        path = self._path(self.rank)
+    def beat(self, rank=None) -> None:
+        """Stamp a beat for ``rank`` (default: our own).  In-memory
+        monitors stamp per EVENT (the router calls this per dispatch
+        completion); directory monitors touch the rank's mtime file."""
+        rank = self.rank if rank is None else rank
+        if self.directory is None:
+            with self._beats_lock:
+                self._beats[rank] = time.monotonic()
+            return
+        path = self._path(rank)
         with open(path, "a"):
             os.utime(path, None)
 
@@ -556,7 +579,10 @@ class HeartbeatMonitor:
             self._thread.join(timeout=5)
             self._thread = None
 
-    def ranks(self) -> List[int]:
+    def ranks(self) -> List:
+        if self.directory is None:
+            with self._beats_lock:
+                return sorted(self._beats, key=str)
         out = []
         for f in os.listdir(self.directory):
             m = re.match(r"rank-(\d+)\.hb$", f)
@@ -564,14 +590,27 @@ class HeartbeatMonitor:
                 out.append(int(m.group(1)))
         return sorted(out)
 
-    def dead_ranks(self, now: Optional[float] = None) -> List[int]:
-        now = now if now is not None else time.time()
+    def age(self, rank=None, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since ``rank``'s newest beat (None = never beat).
+        In-memory mode measures against ``time.monotonic()``."""
+        rank = self.rank if rank is None else rank
+        if self.directory is None:
+            with self._beats_lock:
+                t = self._beats.get(rank)
+            if t is None:
+                return None
+            return (time.monotonic() if now is None else now) - t
+        try:
+            t = os.path.getmtime(self._path(rank))
+        except OSError:
+            return None
+        return (time.time() if now is None else now) - t
+
+    def dead_ranks(self, now: Optional[float] = None) -> List:
         dead = []
         for r in self.ranks():
-            try:
-                if now - os.path.getmtime(self._path(r)) > self.timeout:
-                    dead.append(r)
-            except OSError:
+            a = self.age(r, now=now)
+            if a is None or a > self.timeout:
                 dead.append(r)
         return dead
 

@@ -277,7 +277,7 @@ class ServingEngine:
             ("requests", "batches", "coalesced", "padded_rows",
              "true_rows", "bucket_fallbacks", "single_fallbacks",
              "verify_runs", "verify_ulp_accepts", "warmup_programs",
-             "shed_draining"),
+             "shed_draining", "shed_deadline"),
             doc="ServingEngine per-instance counters",
             family="serving.engine")
 
@@ -329,11 +329,51 @@ class ServingEngine:
             raise ValueError("infer() needs at least one row")
         req = _Request([l._data for l in leaves], struct, rows, args)
         self._observe_axes(req)
+        # the request's deadline budget (faults.deadline_scope on the
+        # caller's thread — the router threads one per request):
+        # admission + queue wait + dispatch all draw from it
+        rem_us = _faults.deadline_remaining_us()
+        if rem_us is not None and rem_us <= 0:
+            self._stats.inc("shed_deadline")
+            _telemetry.event("shed", self._stats.prefix,
+                             shed_kind="deadline",
+                             reason="budget spent at admission")
+            _faults.record_event("serving.infer", "shed", kind="deadline",
+                                 reason="budget spent at admission")
+            raise _faults.ShedError(
+                "deadline budget already spent at admission",
+                kind="deadline")
+        until = (time.monotonic() + rem_us / 1e6
+                 if rem_us is not None else None)
         with self._cv:
             self._start_threads()
             self._requests.append(req)
             self._cv.notify_all()
-        if not req.event.wait(timeout=300.0):
+        if until is None:
+            delivered = req.event.wait(timeout=300.0)
+        else:
+            delivered = req.event.wait(
+                timeout=max(0.0, until - time.monotonic()))
+        if not delivered:
+            if until is not None:
+                # budget spent while queued/staged: withdraw if still
+                # queued and hand back typed — NEVER a hang (a staged
+                # batch still delivers to the other members)
+                with self._cv:
+                    try:
+                        self._requests.remove(req)
+                    except ValueError:
+                        pass
+                self._stats.inc("shed_deadline")
+                _telemetry.event("shed", self._stats.prefix,
+                                 shed_kind="deadline",
+                                 reason="budget exhausted in queue")
+                _faults.record_event("serving.infer", "shed",
+                                     kind="deadline",
+                                     reason="budget exhausted in queue")
+                raise _faults.ShedError(
+                    "deadline budget exhausted before the coalesced "
+                    "dispatch delivered", kind="deadline")
             raise _faults.DeadlineExceeded(
                 "serving request not delivered within 300s (engine "
                 "threads wedged?)")
@@ -352,6 +392,19 @@ class ServingEngine:
         """Recent serving span records (request lifecycles + batched
         dispatches) from the unified telemetry span buffer."""
         return _telemetry.spans(cat="serving", limit=limit)
+
+    def load(self) -> Dict[str, float]:
+        """Cheap live-load signals for a balancer (the replica router's
+        scoring input): queued requests + staged-but-undispatched
+        batches.  No host syncs."""
+        with self._lock:
+            depth = len(self._requests)
+            busy = self._busy
+        return {
+            "queue_depth": float(depth),
+            "in_flight": float(busy + self._staged.qsize()),
+            "pool_pressure": 0.0,          # no KV pool on this path
+        }
 
     def stats(self) -> Dict[str, Any]:
         """Counters + latency percentiles (``p50_us``/``p99_us``)."""

@@ -482,7 +482,7 @@ def eager_generate(model: DecodeModel, params, prompt: Sequence[int],
 # ---------------------------------------------------------------------------
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "eos", "out", "event", "error",
-                 "t_enqueue", "t_done", "preempts")
+                 "t_enqueue", "t_done", "preempts", "joined")
 
     def __init__(self, prompt: List[int], max_new: int,
                  eos: Optional[int]):
@@ -492,9 +492,18 @@ class _GenRequest:
         self.out: List[int] = []        # survives preemption
         self.event = threading.Event()
         self.error: Optional[BaseException] = None
+        # the request's ONE enqueue clock: stamped here and NEVER reset
+        # — a preemption re-queue keeps drawing its queue-wait/latency
+        # from the original arrival, so p50/p99 stay honest
         self.t_enqueue = time.monotonic()
         self.t_done = 0.0
         self.preempts = 0
+        # admission-order stamp (youngest-first preemption victims):
+        # assigned at the FIRST prefill and kept across preemption
+        # re-queues — without it a preempted sequence re-joined as the
+        # "youngest" and was the next victim again (starvation under
+        # sustained pool pressure)
+        self.joined: Optional[int] = None
 
 
 class _Row:
@@ -567,8 +576,9 @@ class GenerativeEngine:
             _telemetry.instance_name("decode.engine"),
             ("requests", "delivered", "tokens_out", "prefills",
              "decode_steps", "decode_row_util", "shed", "shed_queue",
-             "shed_pool", "shed_slo", "shed_draining", "preempts",
-             "slo_violations", "warmup_programs", "bucket_fallbacks"),
+             "shed_pool", "shed_slo", "shed_draining", "shed_deadline",
+             "preempts", "slo_violations", "warmup_programs",
+             "bucket_fallbacks"),
             doc=f"GenerativeEngine counters (model {self.name!r})",
             family="decode.engine")
         from . import engine as _engine
@@ -598,12 +608,39 @@ class GenerativeEngine:
         eos = eos if eos is not None else self._eos
         req = _GenRequest(toks, int(max_new_tokens), eos)
         self._stats.inc("requests")
+        # the request's deadline budget (faults.deadline_scope on the
+        # CALLER's thread — the router threads one per request): capture
+        # the absolute expiry now so admission, queue wait, and decode
+        # all draw from the one budget
+        rem_us = _faults.deadline_remaining_us()
+        until = (time.monotonic() + rem_us / 1e6
+                 if rem_us is not None else None)
         self._admit(req)                 # may raise ShedError, fail-fast
         with self._cv:
             self._start_thread()
             self._queue.append(req)
             self._cv.notify_all()
-        if not req.event.wait(timeout=600.0):
+        if until is None:
+            delivered = req.event.wait(timeout=600.0)
+        else:
+            delivered = req.event.wait(
+                timeout=max(0.0, until - time.monotonic()))
+        if not delivered:
+            if until is not None:
+                # budget spent while queued/decoding: hand the request
+                # back typed, NEVER a hang.  A still-queued request is
+                # withdrawn outright; a live row finishes in the
+                # background (its pages release at retirement) but this
+                # caller's clock stops here.
+                with self._cv:
+                    try:
+                        self._queue.remove(req)
+                    except ValueError:
+                        pass
+                self._shed("deadline",
+                           f"deadline budget exhausted after "
+                           f"{(time.monotonic() - req.t_enqueue) * 1e6:.0f}"
+                           "us (admission + queue + decode)")
             raise _faults.DeadlineExceeded(
                 "generation not delivered within 600s (scheduler "
                 "wedged?)")
@@ -625,6 +662,21 @@ class GenerativeEngine:
         dispatches + decode iterations, cat ``decode``) from the unified
         telemetry span buffer."""
         return _telemetry.spans(cat="decode", limit=limit)
+
+    def load(self) -> Dict[str, float]:
+        """Cheap live-load signals for a balancer (the PR-10 telemetry
+        the replica router scores on): queue depth, live-row
+        occupancy, and page-pool pressure.  No locks beyond the queue
+        peek, no host syncs."""
+        with self._cv:
+            depth = len(self._queue)
+            live = len(self._live)
+        return {
+            "queue_depth": depth + 0.0,          # host ints only: no
+            "in_flight": live / max(self._rows, 1),  # device reads here
+            "pool_pressure": 1.0 - (self._pool.free_pages()
+                                    / max(self._pool.pages, 1)),
+        }
 
     def stats(self) -> Dict[str, Any]:
         """Per-model counters + request-latency percentiles."""
@@ -709,6 +761,20 @@ class GenerativeEngine:
             _faults.inject("serving.admit")
         except _faults.FaultInjected as e:
             self._shed("queue", "admission fault injected", cause=e)
+        rem_us = _faults.deadline_remaining_us()
+        if rem_us is not None:
+            # the admission cost-table check draws from the request's
+            # ONE deadline budget: a request that provably cannot
+            # finish inside what is LEFT sheds now, paying zero compute
+            est = self._estimate_s(req)
+            if rem_us <= 0:
+                self._shed("deadline",
+                           "deadline budget already spent at admission")
+            if est > rem_us / 1e6:
+                self._shed("deadline",
+                           f"cost table predicts {est * 1e6:.0f}us vs "
+                           f"{rem_us}us remaining in the deadline "
+                           "budget")
         with self._cv:
             qlen = len(self._queue)
         if qlen >= self._max_queue:
@@ -899,9 +965,11 @@ class GenerativeEngine:
             self._pool.free(pages)
             raise
         req.out.append(first)
+        if req.joined is None:           # first admission only: a
+            req.joined = self._joined    # preemption re-queue keeps its
+            self._joined += 1            # original seniority
         row = _Row(req, pages, cached=n, pending=first,
-                   joined=self._joined)
-        self._joined += 1
+                   joined=req.joined)
         if self._done(row):
             self._deliver(row)
         else:

@@ -139,6 +139,44 @@ def test_retry_call_deadline():
                           backoff=0.2, deadline=0.05)
 
 
+def test_retry_call_deadline_us_shared_budget(monkeypatch):
+    """ISSUE-14 satellite: ``deadline_us`` is ONE budget across nested
+    retried sites — the inner site's backoff draws from the outer
+    budget (no timeout multiplication) and exhaustion names the
+    OUTERMOST site."""
+    monkeypatch.setattr(faults, "_sleep", lambda s: time.sleep(
+        min(s, 0.002)))
+    attempts = {"inner": 0}
+
+    def flaky():
+        attempts["inner"] += 1
+        raise faults.TransientFault("down")
+
+    def outer_op():
+        return faults.retry_call(flaky, site="test.budget_inner",
+                                 retries=100, backoff=0.03)
+
+    with pytest.raises(faults.DeadlineExceeded) as ei:
+        faults.retry_call(outer_op, site="test.budget_outer",
+                          retries=100, backoff=0.03, deadline_us=40_000)
+    assert "'test.budget_outer'" in str(ei.value)
+    # 100x100 attempts would be unbounded; the budget stopped it early
+    assert attempts["inner"] < 20
+    assert faults.events("test.budget_inner")[-1]["action"] == "deadline"
+
+
+def test_deadline_scope_ambient_inheritance():
+    """A retry_call with NO deadline of its own inherits (and never
+    widens) an enclosing faults.deadline_scope budget."""
+    with faults.deadline_scope(50_000, site="ambient.owner"):
+        with pytest.raises(faults.DeadlineExceeded) as ei:
+            faults.retry_call(
+                lambda: (_ for _ in ()).throw(faults.TransientFault("x")),
+                site="ambient.nested", retries=1000, backoff=0.02)
+        assert "'ambient.owner'" in str(ei.value)
+    assert faults.deadline_remaining_us() is None
+
+
 # -- kvstore ---------------------------------------------------------------
 
 class _FakeKvClient:

@@ -185,6 +185,49 @@ def test_preemption_under_pool_pressure_token_exact():
     eng.close()
 
 
+def test_preemption_keeps_enqueue_clock_and_seniority():
+    """ISSUE-14 satellite: a request re-queued by mid-decode preemption
+    keeps (1) its original ``t_enqueue`` — the queue-wait clock never
+    resets, so p99 stays honest — and (2) its original admission-order
+    stamp, so youngest-first preemption targets a TRULY younger
+    arrival next time instead of re-victimizing the preempted request
+    forever."""
+    model, params = tiny(seed=4)
+    pool = sd.PagePool(pages=64, page=4)
+    eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                              max_rows=4, name="sen")
+    eng.warmup(max_len=8)
+    # white-box: drive the scheduler's own entry points synchronously
+    r1 = sd._GenRequest([1, 2, 3], 12, None)
+    r2 = sd._GenRequest([4, 5], 12, None)
+    eng._prefill(r1)
+    eng._prefill(r2)
+    assert (r1.joined, r2.joined) == (0, 1)
+    t_orig = r2.t_enqueue
+    row2 = next(r for r in eng._live if r.req is r2)
+    eng._preempt(row2)                    # mid-decode eviction
+    assert r2.preempts == 1
+    assert r2.t_enqueue == t_orig         # clock NOT reset
+    # a genuinely newer arrival prefills while r2 waits re-queued
+    r3 = sd._GenRequest([6, 7], 12, None)
+    eng._prefill(r3)
+    assert r3.joined == 2
+    with eng._cv:
+        eng._queue.remove(r2)
+    eng._prefill(r2)                      # the re-queue's re-prefill
+    assert r2.joined == 1                 # original seniority KEPT
+    assert r2.t_enqueue == t_orig
+    # youngest-first preemption now picks r3 (joined 2), never r2
+    rows = {r.req: r for r in eng._live}
+    victims = [x for x in eng._live if x is not rows[r1]]
+    assert max(victims, key=lambda x: x.joined).req is r3
+    for row in list(eng._live):
+        eng._live.remove(row)
+        eng._release(row)
+    assert pool.in_use() == 0
+    eng.close()
+
+
 def test_eos_stops_generation():
     model, params = tiny(seed=4)
     prompt = [7, 9]

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""CI gate: the fault-tolerant serving plane has a MEASURED
+availability budget.
+
+The serving analog of check_recovery_budget: runs the
+``mxnet_tpu.drills`` ROUTER scenario matrix — a replica killed
+mid-decode (plus a preemption notice through the still-routing
+process), a wedged-dispatch hang, a circuit-breaker flap, and a
+deadline storm — against a 2-replica ``serving_router.ReplicaRouter``
+and FAILS (exit 1) unless:
+
+- **every scenario is green**: 0 dropped requests (every submission
+  ends delivered or typed-shed — ``draining`` during the drain,
+  ``deadline`` past its budget, never a hang or a bare error), every
+  delivered response token-exact vs the uninterrupted
+  ``eager_generate`` oracle;
+- **failover is bounded**: chaos-phase p99 ≤
+  ``failover_p99_mult`` × steady-state p99 + ``failover_p99_slack_s``
+  (the slack absorbs the wedge timeout and breaker cooldown, which are
+  deliberate, documented waits — the point is a loud regression, not a
+  race);
+- **nothing leaks**: 0 KV pages in use across every replica pool after
+  ``engine.waitall()``, including after the mid-decode kill;
+- **the breaker re-admits within the probe budget**
+  (``breaker_readmit_s``): after a flap burst ends, the half-open
+  probe must close the breaker again — ejection is supposed to be
+  temporary;
+- **deadlines are honest**: a request with an infeasible
+  ``deadline_us`` sheds ``ShedError(kind="deadline")`` without
+  consuming more than budget + ``deadline_overrun_s``.
+
+Invoked by the test suite (tests/test_serving_router.py) exactly like
+the other gates, and runnable standalone:
+``python tools/check_availability_budget.py [scenario ...]``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the budget docs/ROBUSTNESS.md promises.  Seconds bounds are
+# CI-generous (a loaded runner must not flake); the drill REPORTS the
+# real measured numbers and bench.py's decode lane tracks them per
+# round.
+BUDGET = {
+    "dropped": 0,
+    "leaked_kv_pages": 0,
+    "failover_p99_mult": 10.0,
+    "failover_p99_slack_s": 5.0,
+    "breaker_readmit_s": 8.0,
+    "deadline_overrun_s": 1.0,     # enforced inside the drill itself
+}
+
+
+def main(argv=None) -> int:
+    from mxnet_tpu.drills import ROUTER_SCENARIOS, run_drill
+
+    names = [a for a in (argv or []) if not a.startswith("-")] \
+        or ROUTER_SCENARIOS
+    root = tempfile.mkdtemp(prefix="mxnet-availability-gate-")
+    failures = []
+    for name in names:
+        rep = run_drill(name, root)
+        for f in rep["failures"]:
+            failures.append(f"{name}: {f}")
+        if rep.get("dropped"):
+            failures.append(
+                f"{name}: {rep['dropped']} request(s) dropped "
+                "(budget: 0 — every request delivered or typed-shed)")
+        if rep.get("leaked_pages") not in (None,
+                                           BUDGET["leaked_kv_pages"]):
+            failures.append(
+                f"{name}: {rep['leaked_pages']} KV pages leaked "
+                "(budget: 0)")
+        steady, chaos = rep.get("steady_p99_s"), rep.get("chaos_p99_s")
+        if steady and chaos is not None:
+            cap = (steady * BUDGET["failover_p99_mult"]
+                   + BUDGET["failover_p99_slack_s"])
+            if chaos > cap:
+                failures.append(
+                    f"{name}: chaos p99 {chaos:.3f}s exceeds "
+                    f"{BUDGET['failover_p99_mult']}x steady p99 "
+                    f"({steady:.3f}s) + "
+                    f"{BUDGET['failover_p99_slack_s']}s slack")
+        if name == "router_flap":
+            ra = rep.get("re_admit_s")
+            if ra is not None and ra > BUDGET["breaker_readmit_s"]:
+                failures.append(
+                    f"{name}: breaker re-admitted after {ra:.2f}s "
+                    f"(probe budget {BUDGET['breaker_readmit_s']}s)")
+        line = {k: rep.get(k) for k in
+                ("scenario", "ok", "dropped", "leaked_pages",
+                 "steady_p99_s", "chaos_p99_s", "failovers",
+                 "breaker_opens", "breaker_closes", "re_admit_s",
+                 "drain_s", "drill_wall_s")}
+        print(f"check_availability_budget: {json.dumps(line, default=str)}")
+    if failures:
+        print("check_availability_budget: FAIL", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print(f"check_availability_budget: {len(names)} scenario(s) green — "
+          "0 dropped, 0 leaked pages, failover p99 inside budget, "
+          "breaker re-admitted, deadlines honest")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

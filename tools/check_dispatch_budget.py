@@ -85,6 +85,14 @@ SENTINEL_BUDGET = {"compiled_launches_per_step": 1,
                    "eager_invokes_per_step": 0,
                    "retraces_after_warm": 0,
                    "replica_divergence": 0}
+# the ROUTER budget (docs/ROBUSTNESS.md "Partial serving failure"):
+# zero-overhead-off — a ReplicaRouter wrapping ONE healthy replica with
+# hedging off and the breaker closed adds NOTHING to the engine's
+# per-request costs: dispatch count, retrace count, and host syncs for
+# an identical request stream must equal the bare engine's, and the
+# token streams must be identical
+ROUTER_BUDGET = {"extra_dispatches": 0, "extra_retraces": 0,
+                 "extra_host_syncs": 0}
 # the MESH budget (docs/PERF.md "Pod-scale SPMD train step"): under
 # kvstore='tpu' the data-parallel step stays ONE compiled launch — the
 # SPMD partitioner fans out over the mesh, never the host (no per-chip
@@ -397,6 +405,54 @@ def _measure_decode() -> dict:
     return out
 
 
+def _measure_router() -> dict:
+    """Zero-overhead-off lane: the SAME sequential request stream
+    through a bare GenerativeEngine and through a ReplicaRouter
+    wrapping one replica (hedging off, breaker closed) — the router
+    must add zero dispatches, zero retraces, zero host syncs, and the
+    token streams must match bit-for-bit."""
+    from mxnet_tpu import serving_decode as sd
+    from mxnet_tpu.ndarray import ndarray as _ndmod
+    from mxnet_tpu.serving_router import ReplicaRouter
+
+    model = sd.TinyCausalLM(vocab=31, d_model=16, n_layers=1, n_heads=2,
+                            max_seq=32)
+    params = model.init_params(5)
+    prompts = [[1 + (i * 3 + j) % 29 for j in range(3 + i % 3)]
+               for i in range(6)]
+
+    def run(route: bool) -> dict:
+        pool = sd.PagePool(pages=32, page=4)
+        eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                                  max_rows=2, name="lane")
+        eng.warmup(max_len=8)
+        front = (ReplicaRouter([eng], hedge_pctl=0) if route else eng)
+        t0, d0 = sd.trace_count(), sd.dispatch_count()
+        h0 = _ndmod.host_sync_count()
+        outs = [front.generate(p, max_new_tokens=5) for p in prompts]
+        row = {"outs": outs,
+               "dispatches": sd.dispatch_count() - d0,
+               "retraces": sd.trace_count() - t0,
+               "host_syncs": _ndmod.host_sync_count() - h0,
+               "leaked_pages": pool.in_use()}
+        eng.close()
+        return row
+
+    bare = run(False)
+    routed = run(True)
+    return {
+        "mode": "router",
+        "requests": len(prompts),
+        "bare_dispatches": bare["dispatches"],
+        "routed_dispatches": routed["dispatches"],
+        "extra_dispatches": routed["dispatches"] - bare["dispatches"],
+        "extra_retraces": routed["retraces"] - bare["retraces"],
+        "extra_host_syncs": routed["host_syncs"] - bare["host_syncs"],
+        "outputs_equal": bare["outs"] == routed["outs"],
+        "leaked_pages": bare["leaked_pages"] + routed["leaked_pages"],
+    }
+
+
 def _store_worker() -> None:
     """``--store-worker`` mode: run the tiny train-step + serving-bucket
     workload in THIS process and print its program-store verdict as one
@@ -506,6 +562,13 @@ def main() -> int:
           f"{decode['prefills']} prefill "
           f"({decode['rows_per_decode']} rows/step), "
           f"{decode['leaked_pages']} leaked pages")
+    router = _measure_router()
+    print(f"{'router':<10} 1 replica, hedge off -> "
+          f"{router['routed_dispatches']} dispatches "
+          f"(bare {router['bare_dispatches']}), "
+          f"{router['extra_retraces']} extra retraces, "
+          f"{router['extra_host_syncs']} extra host syncs, outputs "
+          f"{'==' if router['outputs_equal'] else '!='} bare")
     snt = _measure_sentinel()
     print(f"{'sentinel':<10} cadence 2 -> "
           f"{snt['compiled_launches_per_step']:.1f} launch/step, "
@@ -573,6 +636,17 @@ def main() -> int:
         if decode[key] > budget:
             failures.append(
                 f"decode {key} = {decode[key]} exceeds budget {budget}")
+    for key, budget in ROUTER_BUDGET.items():
+        if router[key] > budget:
+            failures.append(
+                f"router {key} = {router[key]} exceeds budget {budget} "
+                "(zero-overhead-off broken)")
+    if not router["outputs_equal"]:
+        failures.append(
+            "router-wrapped token streams differ from the bare engine's")
+    if router["leaked_pages"]:
+        failures.append(
+            f"router lane leaked {router['leaked_pages']} KV pages")
     for key, budget in SENTINEL_BUDGET.items():
         if snt[key] > budget:
             failures.append(
@@ -650,6 +724,8 @@ def main() -> int:
           f"{decode['retraces_after_warm']} retraces, "
           f"{decode['extra_dispatches']} extra dispatches, "
           f"{decode['leaked_pages']} leaked pages)"
+          f"; router within budget ({router['extra_dispatches']} extra "
+          f"dispatches over {router['requests']} routed requests)"
           f"; sentinel within budget "
           f"({snt['compiled_launches_per_step']:.0f} launch/step, "
           f"{snt['digest_reads']} digest reads, fold == host)"

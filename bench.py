@@ -618,6 +618,37 @@ def lane_int8(on_cpu: bool, model_name: str = "resnet50_v1") -> dict:
     return lane
 
 
+def _fleet_telemetry_env(tag: str):
+    """(env, dir) for a subprocess lane worker: the worker (and every
+    process IT forks — drill children inherit the env) flushes an
+    atomic per-process flight-recorder shard into ``dir`` on waitall/
+    drain, so the lane can stamp FLEET telemetry, not just one
+    process's (ISSUE 15)."""
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix=f"bench-telemetry-{tag}-")
+    env = dict(os.environ)
+    env["MXNET_TELEMETRY_DIR"] = d
+    return env, d
+
+
+def _stamp_fleet_telemetry(lane: dict, tel_dir: str) -> dict:
+    """Fold the worker fleet's shards (``telemetry.merge``) into the
+    lane: summed cumulative counters under ``fleet_telemetry`` plus the
+    process count — the check_perf_delta.py gate prefers this key."""
+    try:
+        from mxnet_tpu import telemetry as _tel
+
+        merged = _tel.merge(tel_dir)
+        if merged["shards"]:
+            lane["fleet_telemetry"] = {
+                k: v for k, v in merged["counters"].items() if v}
+            lane["telemetry_processes"] = len(merged["shards"])
+    except Exception:
+        pass
+    return lane
+
+
 def lane_train_step(on_cpu: bool) -> dict:
     """Compiled whole-train-step lane (cached_step.TrainStep): runs
     benchmark/eager_latency.py's train_step_compiled worker and carries
@@ -670,9 +701,10 @@ def lane_infer(on_cpu: bool) -> dict:
 
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "benchmark", "serving_latency.py")
+    env, tel_dir = _fleet_telemetry_env("infer")
     r = subprocess.run([sys.executable, "-u", script, "--serve-only",
                         "--json"], capture_output=True, text=True,
-                       timeout=600, env=dict(os.environ))
+                       timeout=600, env=env)
     if r.returncode != 0:
         raise RuntimeError(f"infer lane failed:\n{r.stderr[-1500:]}")
     c = _json.loads(r.stdout.strip().splitlines()[-1])["serving"]
@@ -680,7 +712,7 @@ def lane_infer(on_cpu: bool) -> dict:
               f"{c['throughput_rps']:.1f} req/s, "
               f"{c['retraces_after_warm']} retraces, "
               f"{c['programs']} programs")
-    return {
+    lane = {
         "metric": "serving_infer_p99_latency_us",
         "value": round(c["p99_us"], 1),
         "unit": "us",
@@ -701,6 +733,7 @@ def lane_infer(on_cpu: bool) -> dict:
         "telemetry": c.get("telemetry"),
         "platform": c["platform"],
     }
+    return _stamp_fleet_telemetry(lane, tel_dir)
 
 
 def lane_decode(on_cpu: bool) -> dict:
@@ -718,9 +751,10 @@ def lane_decode(on_cpu: bool) -> dict:
 
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "benchmark", "serving_latency.py")
+    env, tel_dir = _fleet_telemetry_env("decode")
     r = subprocess.run([sys.executable, "-u", script, "--decode-only",
                         "--json"], capture_output=True, text=True,
-                       timeout=600, env=dict(os.environ))
+                       timeout=600, env=env)
     if r.returncode != 0:
         raise RuntimeError(f"decode lane failed:\n{r.stderr[-1500:]}")
     c = _json.loads(r.stdout.strip().splitlines()[-1])["decode"]
@@ -730,7 +764,7 @@ def lane_decode(on_cpu: bool) -> dict:
               f"{c['retraces_after_warm']} retraces, storm p99 ratio "
               f"{s.get('interference_p99_ratio', '-')}, "
               f"{s.get('shed_total', 0)} shed")
-    return {
+    lane = {
         "metric": "decode_continuous_tokens_per_s",
         "value": c["continuous_tokens_s"],
         "unit": "tokens/s",
@@ -758,6 +792,7 @@ def lane_decode(on_cpu: bool) -> dict:
         "telemetry": c.get("telemetry"),
         "platform": c["platform"],
     }
+    return _stamp_fleet_telemetry(lane, tel_dir)
 
 
 def lane_pipeline(on_cpu: bool) -> dict:
@@ -816,7 +851,7 @@ def lane_multichip(on_cpu: bool) -> dict:
 
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "benchmark", "multichip_scaling.py")
-    env = dict(os.environ)
+    env, tel_dir = _fleet_telemetry_env("multichip")
     if on_cpu:
         env.setdefault("MULTICHIP_PER_CHIP", "16")
         env.setdefault("MULTICHIP_STEPS", "10")
@@ -831,7 +866,7 @@ def lane_multichip(on_cpu: bool) -> dict:
               f"efficiency {c['scaling_efficiency']:.2f}, "
               f"curve {[round(l['img_s_per_chip']) for l in c['curve']]}")
     c["vs_baseline"] = 0.0
-    return c
+    return _stamp_fleet_telemetry(c, tel_dir)
 
 
 def lane_elastic(on_cpu: bool) -> dict:
@@ -849,9 +884,13 @@ def lane_elastic(on_cpu: bool) -> dict:
 
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "benchmark", "elastic_drill.py")
+    # the drill children inherit MXNET_TELEMETRY_DIR through
+    # drills._child_env, so the fleet merge below folds the killed and
+    # restarted children's shards, not just the orchestrator's
+    env, tel_dir = _fleet_telemetry_env("elastic")
     r = subprocess.run([sys.executable, "-u", script, "--json"],
                        capture_output=True, text=True,
-                       timeout=600, env=dict(os.environ))
+                       timeout=600, env=env)
     if r.returncode != 0:
         raise RuntimeError(f"elastic lane failed:\n{r.stderr[-1500:]}\n"
                            f"{r.stdout[-500:]}")
@@ -862,7 +901,7 @@ def lane_elastic(on_cpu: bool) -> dict:
               f"{c['drain_s']*1e3:.1f}ms, {c['fresh_compiles']} fresh "
               f"compiles / {c['disk_hits']} disk hits on restart, "
               f"sentinel overhead {c.get('sentinel_overhead_pct')}%")
-    return {
+    lane = {
         "metric": "elastic_recovery_wall_s",
         "value": c["recovery_wall_s"],
         "unit": "s",
@@ -882,6 +921,7 @@ def lane_elastic(on_cpu: bool) -> dict:
         "telemetry": c.get("telemetry"),
         "platform": c["platform"],
     }
+    return _stamp_fleet_telemetry(lane, tel_dir)
 
 
 def _resolve_lane(name):

@@ -103,6 +103,12 @@ def main() -> int:
     root = a.root or tempfile.mkdtemp(prefix="mxnet-bench-elastic-")
     rep = run_drill(a.scenario, root)
     rep["sentinel_ab"] = measure_sentinel_overhead()
+    from mxnet_tpu import telemetry
+
+    # the drill children each flushed a shard (drills._child_env sets
+    # MXNET_TELEMETRY_DIR); flush the orchestrator's own so bench.py's
+    # fleet merge sees every process of the drill
+    telemetry.flush()
     out = {
         "elastic": {
             "scenario": rep["scenario"],

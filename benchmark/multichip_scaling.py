@@ -149,6 +149,9 @@ def run(per_chip: int = PER_CHIP, steps: int = STEPS,
         lane["efficiency"] = lane["img_s_per_chip"] / base if base else 0.0
     head = curve[-1]
     disk = program_store.disk_stats()
+    from mxnet_tpu import telemetry
+
+    telemetry.flush()   # flight-recorder shard for the lane's fleet merge
     return {
         "metric": "multichip_img_s_per_chip",
         "value": head["img_s_per_chip"],

@@ -118,6 +118,7 @@ assert not errs, errs
 
 import jax
 from mxnet_tpu import telemetry
+telemetry.flush()   # flight-recorder shard for the lane's fleet merge
 _disk = program_store.disk_stats()
 print(json.dumps({
     "platform": jax.default_backend(),
@@ -366,6 +367,7 @@ _disk = program_store.disk_stats()
 out["cache_hits"] = _disk["hits"]
 out["cache_misses"] = _disk["misses"]
 from mxnet_tpu import telemetry
+telemetry.flush()   # flight-recorder shard for the lane's fleet merge
 # full namespaced counter snapshot (process-fresh == delta from 0);
 # the hand-picked keys above stay as aliases for BENCH_* continuity
 out["telemetry"] = {k: v for k, v in telemetry.snapshot().items() if v}

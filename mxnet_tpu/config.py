@@ -548,6 +548,24 @@ declare("MXNET_TELEMETRY_EVENTS", int, 4096,
         "emitted counter telemetry.events keeps the true total).  Read "
         "once at import.", validator=lambda v: v >= 1,
         subsystem="telemetry")
+declare("MXNET_TELEMETRY_TRACE", int, 1,
+        "End-to-end request tracing: every request admitted by the "
+        "serving entry points (ReplicaRouter.infer/generate, bare "
+        "ServingEngine.infer, GenerativeEngine.generate) mints a "
+        "trace_id carried in a thread-local trace context that the "
+        "router's dispatch/hedge threads and the decode scheduler "
+        "re-enter — shed/failover/hedge/breaker/fault events and "
+        "serving/decode spans all stamp it, telemetry.trace(id) "
+        "returns the stitched lifecycle, and the chrome export links "
+        "one request as one flow.  0 = no ids minted, no trace fields "
+        "anywhere, zero overhead (the dispatch/retrace budget is "
+        "byte-identical).", subsystem="telemetry", cached=False)
+declare("MXNET_TELEMETRY_MAX_MB", float, 64.0,
+        "Flight-recorder size cap: when the MXNET_TELEMETRY_DIR shard "
+        "directory exceeds this many megabytes after a flush, the "
+        "oldest-mtime shards (never the flushing process's own) are "
+        "deleted until it fits (counted in telemetry.shards_rotated). "
+        "<= 0 disables rotation.", subsystem="telemetry", cached=False)
 declare("MXNET_TELEMETRY_XLA", int, 1,
         "Wrap telemetry.span brackets in jax.profiler trace annotations "
         "so host-side spans (train step, serving dispatch, decode "

@@ -373,6 +373,7 @@ def _cmd_train(a) -> int:
         preempted = int(e.code)
     engine.waitall()
     snap = telemetry.snapshot()
+    telemetry.flush()       # shard == the snapshot this result records
     mesh = step.mesh
     res = {
         "label": a.label, "pid": os.getpid(),
@@ -484,6 +485,7 @@ def _cmd_decode(a) -> int:
                                        a.max_new)
         for r in verify)
     snap = telemetry.snapshot()
+    telemetry.flush()       # shard == the snapshot this result records
     res = {
         "label": a.label, "preempted_code": preempted,
         "delivered": {str(r): t for r, t in delivered.items()},
@@ -686,6 +688,7 @@ def _cmd_router(a) -> int:
             rec["oracle"] = oracle_cache[rid]
 
     st = router.stats()
+    telemetry.flush()       # shard == the snapshot this result records
     res = {
         "label": a.label, "mode": a.mode, "pid": os.getpid(),
         "preempted_code": preempted,
@@ -711,6 +714,18 @@ def _cmd_router(a) -> int:
 # orchestrator
 # ---------------------------------------------------------------------------
 
+def _drill_telemetry_dir(root: str) -> str:
+    """Where this drill's child processes flush their flight-recorder
+    shards (ISSUE 15): an outer ``MXNET_TELEMETRY_DIR`` (bench.py's
+    fleet dir) wins so the bench lane's merge sees drill children too;
+    otherwise a per-root directory the parent merges for its
+    merged-vs-observed assertions."""
+    from mxnet_tpu import config as _config
+
+    return _config.get("MXNET_TELEMETRY_DIR") \
+        or os.path.join(root, "telemetry")
+
+
 def _child_env(root: str, devices: int) -> Dict[str, str]:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -724,8 +739,12 @@ def _child_env(root: str, devices: int) -> Dict[str, str]:
     env["MXNET_ELASTIC_BACKOFF"] = "0"
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     for k in ("MXNET_FAULT_PLAN", "MXNET_ENGINE_TYPE",
-              "MXNET_TELEMETRY_DIR", "JAX_COMPILATION_CACHE_DIR"):
+              "JAX_COMPILATION_CACHE_DIR"):
         env.pop(k, None)
+    # children are fleet members: each flushes an atomic per-process
+    # telemetry shard (on waitall and on the preemption drain) that the
+    # parent folds back with telemetry.merge()
+    env["MXNET_TELEMETRY_DIR"] = _drill_telemetry_dir(root)
     return env
 
 
@@ -1310,6 +1329,44 @@ def _drill_decode(root: str, failures: List[str],
             failures.append("decode re-queue leg leaked pages")
 
 
+def _check_child_shard(root: str, failures: List[str],
+                       report: Dict[str, Any], res: Dict[str, Any],
+                       what: str, counters: Dict[str, Any]) -> None:
+    """Fold the drill's telemetry shards (``telemetry.merge``) and pin
+    the named counters of the child's OWN shard against the totals the
+    child reported in its result JSON — the cross-process aggregation
+    path proven against ground truth the parent already holds."""
+    from mxnet_tpu import telemetry as _tel
+
+    tel_dir = _drill_telemetry_dir(root)
+    if not os.path.isdir(tel_dir):
+        failures.append(f"{what}: no telemetry shard dir at {tel_dir}")
+        return
+    merged = _tel.merge(tel_dir)
+    report["telemetry_shards"] = len(merged["shards"])
+    pid = res.get("pid")
+    proc = next((p for p in merged["processes"] if p["pid"] == pid), None)
+    if proc is None:
+        failures.append(
+            f"{what}: no telemetry shard for child pid {pid} "
+            f"(shards: {merged['shards']})")
+        return
+    shard = _tel._read_shard(os.path.join(tel_dir, proc["shard"]))
+    snap = (shard["snapshot"] or {}).get("counters", {})
+    for name, want in counters.items():
+        got = snap.get(name)
+        if want is not None and got != want:
+            failures.append(
+                f"{what}: merged shard counter {name}={got} != "
+                f"child-observed {want}")
+    # and the FLEET fold can only ever hold at least the child's total
+    for name, want in counters.items():
+        fleet = merged["counters"].get(name)
+        if want is not None and fleet is not None and fleet < want:
+            failures.append(
+                f"{what}: fleet-merged {name}={fleet} < child's {want}")
+
+
 def _drill_router(root: str, failures: List[str],
                   report: Dict[str, Any], mode: str) -> None:
     """One cell of the serving chaos matrix: a 2-replica router child
@@ -1359,6 +1416,16 @@ def _drill_router(root: str, failures: List[str],
             f"router[{mode}] leaked {res['leaked_pages']} KV pages")
     report["leaked_pages"] = res.get("leaked_pages")
     rt = res.get("router") or {}
+    # ISSUE-15 fleet aggregation: the child flushed an atomic telemetry
+    # shard; merging it back must reproduce the failover/shed/delivered
+    # totals the parent observed in the child's own result record —
+    # cross-process counters survive the round trip exactly
+    _check_child_shard(root, failures, report, res, what=f"router[{mode}]",
+                       counters={
+                           "serving.router0.failovers": rt.get("failovers"),
+                           "serving.router0.sheds": rt.get("sheds"),
+                           "serving.router0.delivered": rt.get("delivered"),
+                       })
     chaos = [records[r] for r in (res.get("chaos_ids") or [])
              if r in records]
     chaos_lat = sorted(v["elapsed_s"] for v in chaos

@@ -253,10 +253,16 @@ def record_event(site: str, action: str, error: Optional[BaseException] = None,
     outside :func:`retry_call` — e.g. checkpoint-restore degradation —
     log through this too).  Every entry also mirrors onto the telemetry
     event bus (kind ``fault``) where it picks up the current train-step
-    index and monotonic timestamp."""
+    index and monotonic timestamp — and, inside a request's
+    ``telemetry.trace_scope`` (``retry_call`` runs on the request's own
+    thread, so a routed request's retries/deadlines inherit its scope
+    ambiently), both copies stamp the request's ``trace_id``."""
     ev: Dict[str, Any] = {"site": site, "action": action, "time": time.time()}
     if error is not None:
         ev["error"] = repr(error)
+    trace_id = _telemetry.current_trace()
+    if trace_id is not None:
+        ev["trace_id"] = trace_id
     ev.update(extra)
     _EVENTS.append(ev)
     _telemetry.event("fault", site, action=action,

@@ -106,7 +106,7 @@ def record_op(name: str, t0_ns: int, t1_ns: int) -> None:
           dur=max((t1_ns - t0_ns) // 1000, 1))
 
 
-def _emit(name, cat, ph, ts=None, dur=None, args=None):
+def _emit(name, cat, ph, ts=None, dur=None, args=None, flow_id=None):
     if not _RUNNING or _PAUSED:
         return
     ev = {"name": name, "cat": cat, "ph": ph, "pid": os.getpid(),
@@ -116,6 +116,10 @@ def _emit(name, cat, ph, ts=None, dur=None, args=None):
         ev["dur"] = dur
     if args is not None:
         ev["args"] = args
+    if flow_id is not None:
+        # chrome flow events ("s"/"t"/"f") chain on a shared id — the
+        # telemetry span layer links one request's spans into one flow
+        ev["id"] = flow_id
     with _LOCK:
         _EVENTS.append(ev)
 

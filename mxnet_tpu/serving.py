@@ -186,7 +186,7 @@ def pad_to_shape(data: "jax.Array", shape: Sequence[int]) -> "jax.Array":
 # ---------------------------------------------------------------------------
 class _Request:
     __slots__ = ("leaves", "struct", "rows", "args", "event", "result",
-                 "error", "t_enqueue", "t_done")
+                 "error", "t_enqueue", "t_done", "trace_id")
 
     def __init__(self, leaves, struct, rows, args):
         self.leaves = leaves          # raw jax arrays, leading batch axis
@@ -198,6 +198,11 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.t_enqueue = time.monotonic()
         self.t_done = 0.0
+        # ISSUE-15 request identity: minted (or inherited from the
+        # router) at infer() entry; the stager/dispatcher threads batch
+        # many requests into one dispatch, so the batched span carries
+        # the whole group's ids as args.trace_ids
+        self.trace_id: Optional[str] = None
 
 
 class ServingEngine:
@@ -285,7 +290,15 @@ class ServingEngine:
     def infer(self, *args):
         """Run one inference request (leading batch axis on every array
         argument); blocks until the coalesced dispatch delivers.  Raises
-        whatever the model raised for THIS request — never drops."""
+        whatever the model raised for THIS request — never drops.
+
+        Admission mints (or inherits, when routed) the ISSUE-15 request
+        trace: the admission/shed events, the request-lifecycle span,
+        and the coalesced dispatch's span all stamp one trace_id."""
+        with _telemetry.trace_scope():
+            return self._infer_traced(args)
+
+    def _infer_traced(self, args):
         from .gluon import block as _gb
         from .ndarray import ndarray as _ndmod
 
@@ -328,6 +341,9 @@ class ServingEngine:
         if rows < 1:
             raise ValueError("infer() needs at least one row")
         req = _Request([l._data for l in leaves], struct, rows, args)
+        req.trace_id = _telemetry.current_trace()
+        if req.trace_id is not None:
+            _telemetry.event("admit", self._stats.prefix, rows=rows)
         self._observe_axes(req)
         # the request's deadline budget (faults.deadline_scope on the
         # caller's thread — the router threads one per request):
@@ -380,6 +396,8 @@ class ServingEngine:
         if req.error is not None:
             raise req.error
         self._latencies.append(req.t_done - req.t_enqueue)
+        if req.trace_id is not None:
+            _telemetry.event("retire", self._stats.prefix, rows=req.rows)
         # request lifecycle span (admit -> dispatch -> deliver): the
         # serving leg of the unified chrome-trace timeline
         _telemetry.record_span(
@@ -665,9 +683,16 @@ class ServingEngine:
                 meta=built[1:], label=type(self._net).__name__)
             self._programs.insert(sig, rec)
         _names, _params, out_struct, mutated_names = rec.meta
+        span_args = {"rows": int(batched[0].shape[0]),
+                     "requests": len(group)}
+        traces = [r.trace_id for r in group if r.trace_id is not None]
+        if traces:
+            # a coalesced dispatch serves MANY requests: the span lists
+            # every member's trace so telemetry.trace(id) stitches it
+            # into each one's lifecycle
+            span_args["trace_ids"] = traces
         with _telemetry.span("serving.dispatch", cat="serving",
-                             args={"rows": int(batched[0].shape[0]),
-                                   "requests": len(group)}):
+                             args=span_args):
             out_arrays, mut_vals = rec(batched, param_arrays,
                                        _random.next_key())
         self._stats.inc("batches")

@@ -482,7 +482,7 @@ def eager_generate(model: DecodeModel, params, prompt: Sequence[int],
 # ---------------------------------------------------------------------------
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "eos", "out", "event", "error",
-                 "t_enqueue", "t_done", "preempts", "joined")
+                 "t_enqueue", "t_done", "preempts", "joined", "trace_id")
 
     def __init__(self, prompt: List[int], max_new: int,
                  eos: Optional[int]):
@@ -492,6 +492,11 @@ class _GenRequest:
         self.out: List[int] = []        # survives preemption
         self.event = threading.Event()
         self.error: Optional[BaseException] = None
+        # ISSUE-15 request identity: minted (or inherited from the
+        # router) at generate() entry and NEVER re-minted — a
+        # preemption re-queue keeps one trace_id across its re-prefill,
+        # exactly like the enqueue clock below
+        self.trace_id: Optional[str] = None
         # the request's ONE enqueue clock: stamped here and NEVER reset
         # — a preemption re-queue keeps drawing its queue-wait/latency
         # from the original arrival, so p50/p99 stay honest
@@ -591,7 +596,17 @@ class GenerativeEngine:
         """Greedily generate up to ``max_new_tokens`` token ids after
         ``prompt`` (a 1-D int sequence/array); blocks until delivered.
         Raises :class:`faults.ShedError` IMMEDIATELY when admission
-        refuses (queue/pool/SLO) — overload is loud, never a hang."""
+        refuses (queue/pool/SLO) — overload is loud, never a hang.
+
+        Admission mints (or inherits, when routed) the ISSUE-15 request
+        trace: admission/shed/preempt events, the prefill span, every
+        decode iteration the request rides, and the lifecycle span all
+        stamp one trace_id — kept across a preemption re-queue."""
+        with _telemetry.trace_scope():
+            return self._generate_traced(prompt, max_new_tokens, eos)
+
+    def _generate_traced(self, prompt, max_new_tokens: int,
+                         eos: Optional[int]) -> List[int]:
         if self._closed:
             raise RuntimeError("GenerativeEngine is closed")
         # graftlint: disable=host-sync -- admission-time tokenization of
@@ -607,7 +622,11 @@ class GenerativeEngine:
                 f"exceeds model.max_seq={self._model.max_seq}")
         eos = eos if eos is not None else self._eos
         req = _GenRequest(toks, int(max_new_tokens), eos)
+        req.trace_id = _telemetry.current_trace()
         self._stats.inc("requests")
+        if req.trace_id is not None:
+            _telemetry.event("admit", self.name, tokens=len(toks),
+                             max_new=int(max_new_tokens))
         # the request's deadline budget (faults.deadline_scope on the
         # CALLER's thread — the router threads one per request): capture
         # the absolute expiry now so admission, queue wait, and decode
@@ -649,6 +668,10 @@ class GenerativeEngine:
         self._latencies.append(req.t_done - req.t_enqueue)
         if self._slo > 0 and req.t_done - req.t_enqueue > self._slo:
             self._stats.inc("slo_violations")
+        if req.trace_id is not None:
+            _telemetry.event("retire", self.name,
+                             tokens_out=len(req.out),
+                             preempts=req.preempts)
         # request lifecycle span (admit -> prefill -> decode* -> retire)
         _telemetry.record_span(
             "decode.request", "serving",
@@ -843,12 +866,15 @@ class GenerativeEngine:
         for req in reqs:
             self._stats.inc("shed")
             self._stats.inc("shed_draining")
-            _telemetry.event("shed", self.name, shed_kind="draining",
-                             reason="queued request re-queued at drain")
-            _faults.record_event(
-                "serving.admit", "shed", model=self.name, kind="draining",
-                reason="queued request re-queued at drain",
-                tokens_done=len(req.out))
+            with _telemetry.trace_scope(trace_id=req.trace_id):
+                _telemetry.event(
+                    "shed", self.name, shed_kind="draining",
+                    reason="queued request re-queued at drain")
+                _faults.record_event(
+                    "serving.admit", "shed", model=self.name,
+                    kind="draining",
+                    reason="queued request re-queued at drain",
+                    tokens_done=len(req.out))
             req.error = ShedError(
                 f"[{self.name}] draining after a preemption notice "
                 "before this request was scheduled; re-queue it after "
@@ -884,11 +910,14 @@ class GenerativeEngine:
                         self._queue.remove(req)
                     self._stats.inc("shed")
                     self._stats.inc("shed_pool")
-                    _telemetry.event("shed", self.name, shed_kind="pool",
-                                     reason="pool exhausted at prefill")
-                    _faults.record_event(
-                        "serving.admit", "shed", model=self.name,
-                        kind="pool", reason="pool exhausted at prefill")
+                    with _telemetry.trace_scope(trace_id=req.trace_id):
+                        _telemetry.event(
+                            "shed", self.name, shed_kind="pool",
+                            reason="pool exhausted at prefill")
+                        _faults.record_event(
+                            "serving.admit", "shed", model=self.name,
+                            kind="pool",
+                            reason="pool exhausted at prefill")
                     req.error = ShedError(
                         f"[{self.name}] KV page pool exhausted at "
                         "prefill and no progress upstream")
@@ -925,7 +954,14 @@ class GenerativeEngine:
     def _prefill(self, req: _GenRequest) -> None:
         """Compile-per-bucket prompt program: embeds the prompt, writes
         its KV into freshly allocated pages (scatter INSIDE the
-        program), and emits the first generated token."""
+        program), and emits the first generated token.  Runs on the
+        scheduler thread — it re-enters the request's trace so the
+        prefill span (incl. the re-prefill after a preemption
+        re-queue) stamps the ONE trace_id minted at admission."""
+        with _telemetry.trace_scope(trace_id=req.trace_id):
+            self._prefill_traced(req)
+
+    def _prefill_traced(self, req: _GenRequest) -> None:
         prompt = req.prompt + req.out     # re-grown after preemption
         n = len(prompt)
         bucket = self._policy.bucket(n)
@@ -1028,9 +1064,17 @@ class GenerativeEngine:
             tables[i, :len(row.pages)] = row.pages
             lengths[i] = row.cached
         t0 = time.perf_counter()
+        step_args: Dict[str, Any] = {"model": self.name,
+                                     "rows": len(self._live)}
+        traces = [row.req.trace_id for row in self._live
+                  if row.req.trace_id is not None]
+        if traces:
+            # one decode dispatch serves MANY live requests: the span
+            # lists every rider's trace so telemetry.trace(id) returns
+            # each request's decode iterations
+            step_args["trace_ids"] = traces
         with _telemetry.span("decode.step", cat="decode",
-                             args={"model": self.name,
-                                   "rows": len(self._live)}):
+                             args=step_args):
             self._pool.gate.acquire(self._priority)
             try:
                 with self._pool.exclusive(self._geom):
@@ -1075,12 +1119,15 @@ class GenerativeEngine:
                     self._release(row)
                     self._stats.inc("shed")
                     self._stats.inc("shed_pool")
-                    _telemetry.event(
-                        "shed", self.name, shed_kind="pool",
-                        reason="single sequence outgrew pool")
-                    _faults.record_event(
-                        "serving.admit", "shed", e, model=self.name,
-                        kind="pool", reason="single sequence outgrew pool")
+                    with _telemetry.trace_scope(
+                            trace_id=row.req.trace_id):
+                        _telemetry.event(
+                            "shed", self.name, shed_kind="pool",
+                            reason="single sequence outgrew pool")
+                        _faults.record_event(
+                            "serving.admit", "shed", e, model=self.name,
+                            kind="pool",
+                            reason="single sequence outgrew pool")
                     row.req.error = ShedError(
                         f"[{self.name}] sequence needs page "
                         f"{len(row.pages) + 1}, pool exhausted with no "
@@ -1095,11 +1142,14 @@ class GenerativeEngine:
         self._release(row)
         row.req.preempts += 1
         self._stats.inc("preempts")
-        _telemetry.event("preempt", self.name,
-                         tokens_done=len(row.req.out))
-        _faults.record_event("serving.admit", "preempt",
-                             model=self.name,
+        # the preempt event belongs to the EVICTED request's trace, not
+        # whichever row's page allocation triggered the eviction
+        with _telemetry.trace_scope(trace_id=row.req.trace_id):
+            _telemetry.event("preempt", self.name,
                              tokens_done=len(row.req.out))
+            _faults.record_event("serving.admit", "preempt",
+                                 model=self.name,
+                                 tokens_done=len(row.req.out))
         with self._cv:
             self._queue.appendleft(row.req)
 

@@ -222,7 +222,7 @@ class _Dispatch:
 
 class _RouterRequest:
     __slots__ = ("fn", "until", "label", "eager_fn", "failed", "cv",
-                 "hedged", "attempt", "t0")
+                 "hedged", "attempt", "t0", "trace_id")
 
     def __init__(self, fn, until: Optional[float], label: str,
                  eager_fn: Optional[Callable]):
@@ -235,6 +235,10 @@ class _RouterRequest:
         self.hedged = False
         self.attempt = 0
         self.t0 = time.monotonic()
+        # the request's ONE identity, minted at admission and re-entered
+        # by every dispatch/hedge thread it touches (ISSUE 15); None
+        # with tracing disabled — zero trace fields anywhere
+        self.trace_id: Optional[str] = None
 
 
 class ReplicaRouter:
@@ -426,6 +430,17 @@ class ReplicaRouter:
     # -- admission / submit -------------------------------------------------
     def _submit(self, fn, deadline_us: Optional[int], label: str,
                 eager_fn: Optional[Callable]):
+        # the request's end-to-end trace identity is minted HERE (or
+        # inherited from a caller's ambient scope) so the draining shed
+        # below, every dispatch attempt, and the engine's own admission
+        # all stamp one trace_id (ISSUE 15)
+        with _telemetry.trace_scope() as ts:
+            return self._submit_traced(fn, deadline_us, label, eager_fn,
+                                       ts.trace_id)
+
+    def _submit_traced(self, fn, deadline_us: Optional[int], label: str,
+                       eager_fn: Optional[Callable],
+                       trace_id: Optional[str]):
         if self._closed:
             raise RuntimeError("ReplicaRouter is closed")
         if _preemption.draining():
@@ -433,6 +448,9 @@ class ReplicaRouter:
                        "router draining after a preemption notice; "
                        "re-queue on another host or after the restart")
         self._stats.inc("requests")
+        if trace_id is not None:
+            _telemetry.event("admit", self.name, label=label,
+                             deadline_us=deadline_us)
         t0 = time.monotonic()
         # ONE budget: the tighter of the caller's ambient scope and the
         # per-request deadline_us, pinned absolute so every thread this
@@ -445,6 +463,7 @@ class ReplicaRouter:
             spans.append(deadline_us / 1e6)
         until = (t0 + min(spans)) if spans else None
         req = _RouterRequest(fn, until, label, eager_fn)
+        req.trace_id = trace_id
         try:
             result = _faults.retry_call(
                 self._dispatch_attempt, req,
@@ -468,6 +487,9 @@ class ReplicaRouter:
         t1 = time.monotonic()
         self._lat_request.append(t1 - t0)
         self._stats.inc("delivered")
+        if trace_id is not None:
+            _telemetry.event("retire", self.name, label=label,
+                             attempts=req.attempt, hedged=req.hedged)
         _telemetry.record_span(
             "router.request", "serving", int(t0 * 1e9), int(t1 * 1e9),
             args={"router": self.name, "label": label,
@@ -658,18 +680,28 @@ class ReplicaRouter:
         with self._lock:
             self._inflight += 1
             replica.in_flight += 1
+        if req.trace_id is not None:
+            # one record per dispatch attempt: replica id, attempt
+            # index, and its hedge/failover marking — the trace's
+            # "every attempt" contract (ISSUE 15)
+            _telemetry.event("dispatch", self.name,
+                             replica=replica.index, attempt=req.attempt,
+                             hedge=hedge, failover=req.attempt > 1,
+                             label=req.label)
 
         def run():
             try:
-                if req.until is not None:
-                    # carry the request's ONE budget onto this thread:
-                    # the engine's admission/queue wait and any nested
-                    # retried site all draw from it
-                    with _faults.deadline_scope(until=req.until,
-                                                site="router.dispatch"):
+                # carry the request's ONE identity (and, below, its ONE
+                # deadline budget) onto this worker thread — the engine
+                # call's admission/shed/span records stamp the same
+                # trace_id the router minted
+                with _telemetry.trace_scope(trace_id=req.trace_id):
+                    if req.until is not None:
+                        with _faults.deadline_scope(
+                                until=req.until, site="router.dispatch"):
+                            d.result = req.fn(replica.engine)
+                    else:
                         d.result = req.fn(replica.engine)
-                else:
-                    d.result = req.fn(replica.engine)
             except BaseException as e:
                 d.error = e
             finally:

@@ -37,13 +37,35 @@ those measurements ONE queryable, exportable system:
   ``profiler.dump`` pipe) and, under ``MXNET_TELEMETRY_XLA=1``, inside
   ``jax.profiler`` device traces via trace annotations.
 
-- **Exporters** — :func:`flush` appends events + a counter snapshot as
-  JSON-lines to ``MXNET_TELEMETRY_DIR`` (the flight recorder;
-  ``engine.waitall()`` flushes), :func:`report` renders the one-call
-  counter table, and bench.py stamps :func:`delta` per lane.
+- **Trace context** (ISSUE 15) — every serving request mints a
+  ``trace_id`` at its admission edge (:class:`trace_scope`;
+  ``MXNET_TELEMETRY_TRACE``, default on) carried in a thread-local
+  stack that the replica router's dispatch/hedge threads and the decode
+  scheduler re-enter, so the ``shed`` / ``failover`` / ``hedge`` /
+  ``breaker`` / ``fault`` events and the ``serving`` / ``decode`` spans
+  of ONE request all stamp the same id (+ parent span id).
+  :func:`trace` returns the stitched lifecycle (admission → each
+  dispatch attempt → prefill/decode iterations → retire/shed), and the
+  chrome-trace export links the spans of one request into one flow.
+  Disabled (``MXNET_TELEMETRY_TRACE=0``): no ids are minted, no trace
+  fields appear anywhere, and the hot paths pay one thread-local read.
+
+- **Exporters** — :func:`flush` writes this process's events, spans,
+  and a counter snapshot as ONE atomic JSON-lines shard
+  (``telemetry-r<rank>-p<pid>.jsonl``, write-then-rename so a SIGKILL
+  never leaves a torn shard) under ``MXNET_TELEMETRY_DIR`` (the flight
+  recorder; ``engine.waitall()`` and the preemption drain flush; the
+  directory is bounded by ``MXNET_TELEMETRY_MAX_MB`` with oldest-shard
+  rotation).  :func:`merge` folds a directory of per-process shards
+  into one fleet snapshot (cumulative counters summed, gauges kept
+  per-process) and :func:`merge_chrome_trace` into one chrome trace
+  with per-process lanes.  :func:`report` renders the one-call counter
+  table, bench.py stamps :func:`delta` per lane, and
+  ``python -m mxnet_tpu.telemetry`` is the on-box CLI
+  (``report`` / ``trace <id>`` / ``merge <dir>``).
 
 See docs/OBSERVABILITY.md for the namespace map, event taxonomy, span
-hierarchy, and how to add a counter.
+hierarchy, trace-field schema, and how to add a counter.
 """
 from __future__ import annotations
 
@@ -51,6 +73,7 @@ import json
 import os
 import threading
 import time
+import zlib
 from collections import deque
 from collections.abc import Mapping
 from typing import Any, Callable, Dict, Iterator, List, Optional
@@ -63,6 +86,8 @@ __all__ = [
     "event", "events", "set_step", "current_step", "next_step",
     "span", "record_span", "spans", "report", "flush",
     "flight_recorder_path", "KINDS",
+    "tracing_enabled", "new_trace_id", "trace_scope", "current_trace",
+    "current_span_id", "trace", "merge", "merge_chrome_trace", "main",
 ]
 
 # one lock guards registry structure AND every counter value: increments
@@ -295,6 +320,136 @@ def current_step() -> Optional[int]:
 
 
 # ---------------------------------------------------------------------------
+# trace context (ISSUE 15: end-to-end request identity)
+# ---------------------------------------------------------------------------
+# One thread-local stack of (trace_id, span_id) frames.  The OUTERMOST
+# frame is minted at a request's admission edge (router.infer/generate,
+# bare ServingEngine.infer / GenerativeEngine.generate); worker threads
+# re-enter with the explicit id stamped on the request object, so every
+# event and span a request touches — on any thread — carries one id.
+_TRACE = threading.local()
+
+_TRACES_MINTED = counter(
+    "telemetry.traces_minted",
+    "request trace ids minted at serving admission edges "
+    "(MXNET_TELEMETRY_TRACE; one id = one end-to-end request lifecycle)")
+
+
+def tracing_enabled() -> bool:
+    """Is request-trace minting on?  (``MXNET_TELEMETRY_TRACE``,
+    default 1.)  Only admission edges consult this; everything inside a
+    request reads the thread-local frame instead — with tracing off no
+    frame ever exists, so no trace fields are stamped anywhere."""
+    return bool(_config.get("MXNET_TELEMETRY_TRACE"))
+
+
+def new_trace_id() -> str:
+    """Mint a process-unique trace id (``<pid hex>-<seq hex>``)."""
+    _TRACES_MINTED.inc()
+    return f"{os.getpid():x}-{int(_TRACES_MINTED.value):x}"
+
+
+def _trace_stack() -> List:
+    st = getattr(_TRACE, "stack", None)
+    if st is None:
+        st = _TRACE.stack = []
+    return st
+
+
+def current_trace() -> Optional[str]:
+    """The ambient trace id on this thread, or None (one thread-local
+    read — hot-path safe)."""
+    st = getattr(_TRACE, "stack", None)
+    return st[-1][0] if st else None
+
+
+def current_span_id() -> Optional[str]:
+    """The ambient parent-span id on this thread, or None."""
+    st = getattr(_TRACE, "stack", None)
+    return st[-1][1] if st else None
+
+
+def _next_span_id() -> str:
+    with _LOCK:
+        _SPANS_SEQ[0] += 1
+        return f"s{_SPANS_SEQ[0]:x}"
+
+
+class trace_scope:
+    """Establish (or re-enter) the thread's request-trace context.
+
+    - ``trace_scope()`` at an admission edge: inherit the ambient trace
+      when one exists (a routed request re-entering an engine), else
+      mint a fresh id when :func:`tracing_enabled` — else a no-op.
+    - ``trace_scope(trace_id=req.trace_id, parent=req.span_id)`` on a
+      worker thread: carry the request's ONE identity across the thread
+      hop (the deadline-budget ``until=`` idiom, applied to identity).
+      A ``None`` id is a no-op passthrough, so disabled-mode requests
+      stay zero-overhead on every thread they touch.
+
+    ``scope.trace_id`` is the active id (None when the scope is a
+    passthrough)."""
+
+    __slots__ = ("trace_id", "_parent", "_pushed", "_explicit")
+
+    _UNSET = object()
+
+    def __init__(self, trace_id: Any = _UNSET,
+                 parent: Optional[str] = None):
+        self._explicit = trace_id is not trace_scope._UNSET
+        self.trace_id = (None if trace_id is trace_scope._UNSET
+                         else trace_id)
+        self._parent = parent
+        self._pushed = False
+
+    def __enter__(self) -> "trace_scope":
+        tid = self.trace_id
+        if tid is None and not self._explicit:
+            tid = current_trace()
+            if tid is None and tracing_enabled():
+                tid = new_trace_id()
+        if tid is None:
+            return self
+        st = _trace_stack()
+        parent = self._parent
+        if parent is None and st:
+            parent = st[-1][1]
+        st.append((tid, parent))
+        self.trace_id = tid
+        self._pushed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._pushed:
+            _trace_stack().pop()
+            self._pushed = False
+
+
+def trace(trace_id: str) -> Dict[str, Any]:
+    """The stitched lifecycle of one request: every buffered event
+    stamped with ``trace_id`` plus every span that carries it (directly,
+    or in its ``args.trace_ids`` list — decode iterations batch many
+    requests into one dispatch), merged into one time-ordered
+    ``records`` list.  Events and spans share the monotonic clock, so
+    admission → dispatch attempts → prefill/decode iterations →
+    retire/shed come back in lifecycle order."""
+    evs = [e for e in events() if e.get("trace_id") == trace_id]
+    sps = []
+    for s in spans():
+        if s.get("trace_id") == trace_id or \
+                trace_id in ((s.get("args") or {}).get("trace_ids") or ()):
+            sps.append(s)
+    records: List[Dict[str, Any]] = []
+    for e in evs:
+        records.append(dict(e, type="event"))
+    for s in sps:
+        records.append(dict(s, type="span", t_us=s["t0_us"]))
+    records.sort(key=lambda r: (r["t_us"], r.get("seq", 0)))
+    return {"trace_id": trace_id, "events": evs, "spans": sps,
+            "records": records}
+
+
+# ---------------------------------------------------------------------------
 # event bus
 # ---------------------------------------------------------------------------
 # taxonomy (docs/OBSERVABILITY.md): retrace | fallback | shed | preempt |
@@ -308,19 +463,28 @@ _EVT_LOCK = threading.Lock()
 _FLUSH_SEQ = [0]          # bus sequence already flushed to disk
 
 
-_RESERVED_EVENT_KEYS = ("kind", "name", "step", "t_us", "seq")
+_RESERVED_EVENT_KEYS = ("kind", "name", "step", "t_us", "seq",
+                        "trace_id", "parent")
 
 
 def event(kind: str, name: str, /, step: Any = "auto", **fields) -> None:
     """Append one structured event: ``kind`` from the taxonomy, ``name``
     the subsystem/site, ``step`` the train-step index (default: the
-    current one), plus a monotonic microsecond timestamp.  Extra fields
-    whose names collide with the bus keys are prefixed ``x_``."""
+    current one), plus a monotonic microsecond timestamp.  Inside a
+    request's :class:`trace_scope` the event additionally stamps
+    ``trace_id`` (+ ``parent`` span id) — nothing otherwise.  Extra
+    fields whose names collide with the bus keys are prefixed ``x_``."""
     ev: Dict[str, Any] = {
         "kind": kind, "name": name,
         "step": current_step() if step == "auto" else step,
         "t_us": time.monotonic_ns() // 1000,
     }
+    tid = current_trace()
+    if tid is not None:
+        ev["trace_id"] = tid
+        sid = current_span_id()
+        if sid is not None:
+            ev["parent"] = sid
     for k, v in fields.items():
         if v is not None:
             ev["x_" + k if k in _RESERVED_EVENT_KEYS else k] = v
@@ -351,19 +515,31 @@ def clear_events() -> None:
 # spans
 # ---------------------------------------------------------------------------
 _SPANS: "deque" = deque(maxlen=2048)
+_SPANS_SEQ = [0]          # span ids + flight-recorder flush cursor
 _SPANS_RECORDED = counter(
     "telemetry.spans", "completed spans recorded (train_step / "
     "step_phase / serving / decode / user categories)")
+# trace ids whose chrome flow already emitted its "s" (start) arrow —
+# later spans of the same trace emit "t" (step) so the whole request
+# renders as ONE connected flow in chrome://tracing / Perfetto
+_FLOW_STARTED: set = set()
+
+
+def _flow_id(trace_id: str) -> int:
+    return zlib.crc32(trace_id.encode()) & 0x7FFFFFFF
 
 
 def record_span(name: str, cat: str, t0_ns: int, t1_ns: int,
                 step: Any = "auto",
-                args: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+                args: Optional[Dict[str, Any]] = None,
+                span_id: Optional[str] = None) -> Dict[str, Any]:
     """Record one completed span post-hoc (the lifecycle spans whose
     endpoints were timed elsewhere — serving admit→retire).  Also emits
     into the profiler's chrome-trace buffer when collection is running,
     so every span category lands in the one ``profiler.dump``
-    timeline."""
+    timeline.  Inside a request's :class:`trace_scope` the record
+    stamps ``trace_id`` / ``parent`` / its own ``id``, and the chrome
+    export additionally links it into the request's flow."""
     rec = {
         "name": name, "cat": cat,
         "step": current_step() if step == "auto" else step,
@@ -371,6 +547,16 @@ def record_span(name: str, cat: str, t0_ns: int, t1_ns: int,
         "dur_us": max((t1_ns - t0_ns) // 1000, 1),
         "thread": threading.get_ident(),
     }
+    tid = current_trace()
+    if tid is not None:
+        rec["trace_id"] = tid
+        rec["id"] = span_id if span_id is not None else _next_span_id()
+        parent = current_span_id()
+        if parent is not None and parent != rec["id"]:
+            rec["parent"] = parent
+    with _LOCK:
+        _SPANS_SEQ[0] += 1
+        rec["seq"] = _SPANS_SEQ[0]
     if args:
         rec["args"] = dict(args)
     _SPANS_RECORDED.inc()
@@ -379,6 +565,17 @@ def record_span(name: str, cat: str, t0_ns: int, t1_ns: int,
 
     _profiler._emit(name, cat, "X", ts=rec["t0_us"], dur=rec["dur_us"],
                     args=rec.get("args"))
+    if tid is not None:
+        # one request = one chrome flow: an "s" arrow from the trace's
+        # first span, "t" steps through every later one
+        first = tid not in _FLOW_STARTED
+        if first:
+            _FLOW_STARTED.add(tid)
+            if len(_FLOW_STARTED) > 8192:
+                _FLOW_STARTED.clear()
+                _FLOW_STARTED.add(tid)
+        _profiler._emit(f"trace:{tid}", "flow", "s" if first else "t",
+                        ts=rec["t0_us"], flow_id=_flow_id(tid))
     return rec
 
 
@@ -390,9 +587,11 @@ class span:
     """Context-manager span: times the enclosed work, records it (see
     :func:`record_span`), and — with ``MXNET_TELEMETRY_XLA=1`` — wraps
     it in a ``jax.profiler`` trace annotation so the host-side bracket
-    shows up inside XLA device profiles."""
+    shows up inside XLA device profiles.  Inside a request's
+    :class:`trace_scope` the span takes an id at entry and becomes the
+    ambient PARENT for everything recorded underneath it."""
 
-    __slots__ = ("name", "cat", "args", "_t0", "_ann")
+    __slots__ = ("name", "cat", "args", "_t0", "_ann", "_sid", "_pushed")
 
     def __init__(self, name: str, cat: str = "user",
                  args: Optional[Dict[str, Any]] = None):
@@ -401,6 +600,8 @@ class span:
         self.args = dict(args) if args else None
         self._t0 = None
         self._ann = None
+        self._sid = None
+        self._pushed = False
 
     def annotate(self, **kw) -> "span":
         """Attach/extend span args mid-flight (recorded at exit)."""
@@ -411,6 +612,11 @@ class span:
 
     def __enter__(self) -> "span":
         self._t0 = time.perf_counter_ns()
+        st = getattr(_TRACE, "stack", None)
+        if st:
+            self._sid = _next_span_id()
+            st.append((st[-1][0], self._sid))
+            self._pushed = True
         if _xla_annotations_on():
             try:
                 import jax
@@ -428,9 +634,13 @@ class span:
                 self._ann.__exit__(*exc)
             finally:
                 self._ann = None
+        if self._pushed:
+            _trace_stack().pop()
+            self._pushed = False
         if self._t0 is not None:
             record_span(self.name, self.cat, self._t0,
-                        time.perf_counter_ns(), args=self.args)
+                        time.perf_counter_ns(), args=self.args,
+                        span_id=self._sid)
             self._t0 = None
 
 
@@ -447,32 +657,104 @@ def spans(cat: Optional[str] = None,
 
 def clear_spans() -> None:
     _SPANS.clear()
+    _FLOW_STARTED.clear()
 
 
 # ---------------------------------------------------------------------------
-# exporters
+# exporters: the flight recorder (per-process shards) + fleet merge
 # ---------------------------------------------------------------------------
+_SHARDS_ROTATED = counter(
+    "telemetry.shards_rotated",
+    "flight-recorder shards deleted by the MXNET_TELEMETRY_MAX_MB "
+    "oldest-first size-cap rotation (a week-long drill cannot fill "
+    "the disk)")
 
-def flight_recorder_path() -> Optional[str]:
-    """Where :func:`flush` writes (``MXNET_TELEMETRY_DIR`` set), else
-    None (recorder off)."""
+
+def _flight_dir() -> Optional[str]:
     d = _config.get("MXNET_TELEMETRY_DIR")
     if not d:
         return None
-    return os.path.join(os.path.expanduser(d),
-                        f"telemetry-{os.getpid()}.jsonl")
+    return os.path.expanduser(d)
+
+
+def _process_rank() -> int:
+    r = _config.get("MXNET_TPU_PROC_ID")
+    return int(r) if r is not None else 0
+
+
+def flight_recorder_path() -> Optional[str]:
+    """This process's shard file (``MXNET_TELEMETRY_DIR`` set), else
+    None (recorder off).  Shards are pid/rank-stamped —
+    ``telemetry-r<rank>-p<pid>.jsonl`` — so every process of a drill or
+    a multi-controller job writes its own file and :func:`merge` folds
+    them back together."""
+    d = _flight_dir()
+    if d is None:
+        return None
+    return os.path.join(
+        d, f"telemetry-r{_process_rank()}-p{os.getpid()}.jsonl")
 
 
 _FLUSH_LOCK = threading.Lock()
+_SPAN_FLUSH_SEQ = [0]     # span sequence already flushed to disk
 
 
-def flush(snapshot_too: bool = True) -> Optional[str]:
-    """Flight recorder: append every event not yet flushed (and,
-    default, one ``{"kind": "snapshot"}`` record of all counters) as
-    JSON-lines under ``MXNET_TELEMETRY_DIR``.  No-op returning None when
-    the knob is unset.  ``engine.waitall()`` calls this, so a drained
-    process always has its telemetry on disk."""
-    path = flight_recorder_path()
+def _shard_line_cap() -> int:
+    # bound the per-shard record history like the in-memory bus: the
+    # newest 4x the bus capacity of event+span lines survive a rewrite
+    return 4 * max(1, int(_config.get("MXNET_TELEMETRY_EVENTS")))
+
+
+def _rotate_shards(directory: str, keep: str) -> int:
+    """Enforce ``MXNET_TELEMETRY_MAX_MB`` over the shard directory:
+    delete oldest-mtime shards (never this process's own) until the
+    total fits.  Returns shards removed."""
+    cap_mb = float(_config.get("MXNET_TELEMETRY_MAX_MB"))
+    if cap_mb <= 0:
+        return 0
+    cap = cap_mb * 1024 * 1024
+    shards = []
+    try:
+        for fn in os.listdir(directory):
+            if fn.startswith("telemetry-") and fn.endswith(".jsonl"):
+                p = os.path.join(directory, fn)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                shards.append((st.st_mtime, st.st_size, p))
+    except OSError:
+        return 0
+    total = sum(s for _m, s, _p in shards)
+    removed = 0
+    for _mtime, size, p in sorted(shards):
+        if total <= cap:
+            break
+        if os.path.abspath(p) == os.path.abspath(keep):
+            continue
+        try:
+            os.unlink(p)
+        except OSError:
+            continue
+        total -= size
+        removed += 1
+    if removed:
+        _SHARDS_ROTATED.inc(removed)
+    return removed
+
+
+def flush(snapshot_too: bool = True,
+          path: Optional[str] = None) -> Optional[str]:
+    """Flight recorder: fold every event and span not yet flushed plus
+    (default) one fresh ``{"kind": "snapshot"}`` record of all counters
+    into this process's shard under ``MXNET_TELEMETRY_DIR``.  The shard
+    is rewritten whole via write-then-rename, so a SIGKILL mid-flush
+    can never leave a torn JSON-lines file for :func:`merge` to choke
+    on — the previous complete shard survives.  No-op returning None
+    when the knob is unset.  ``engine.waitall()`` and the preemption
+    drain call this, so a drained process always has its telemetry on
+    disk.  ``path`` overrides the shard file (tests)."""
+    path = flight_recorder_path() if path is None else path
     if path is None:
         return None
     with _FLUSH_LOCK:
@@ -480,16 +762,178 @@ def flush(snapshot_too: bool = True) -> Optional[str]:
             pending = [e for e in _EVENTS if e["seq"] > _FLUSH_SEQ[0]]
             if pending:
                 _FLUSH_SEQ[0] = pending[-1]["seq"]
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "a") as f:
-            for e in pending:
-                f.write(json.dumps(e) + "\n")
+        pend_spans = [s for s in list(_SPANS)
+                      if s.get("seq", 0) > _SPAN_FLUSH_SEQ[0]]
+        if pend_spans:
+            _SPAN_FLUSH_SEQ[0] = pend_spans[-1]["seq"]
+        # prior data lines survive the rewrite (meta + snapshot are
+        # regenerated fresh each flush — only the newest matters)
+        old: List[str] = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        kind = json.loads(line).get("kind")
+                    except ValueError:
+                        continue          # torn line from a legacy shard
+                    if kind not in ("meta", "snapshot"):
+                        old.append(line)
+        except OSError:
+            pass
+        lines = old
+        lines.extend(json.dumps(e) for e in pending)
+        lines.extend(json.dumps({"kind": "span", **s})
+                     for s in pend_spans)
+        cap = _shard_line_cap()
+        if len(lines) > cap:
+            lines = lines[-cap:]
+        meta = {"kind": "meta", "pid": os.getpid(),
+                "rank": _process_rank(),
+                "t_us": time.monotonic_ns() // 1000,
+                "counter_kinds": {n: m["kind"]
+                                  for n, m in registered().items()}}
+        directory = os.path.dirname(path)
+        os.makedirs(directory, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(meta) + "\n")
+            for line in lines:
+                f.write(line + "\n")
             if snapshot_too:
                 f.write(json.dumps({
                     "kind": "snapshot", "step": current_step(),
                     "t_us": time.monotonic_ns() // 1000,
                     "counters": snapshot()}) + "\n")
+        os.replace(tmp, path)
+        _rotate_shards(directory, keep=path)
     return path
+
+
+# -- fleet merge ------------------------------------------------------------
+
+def _read_shard(path: str) -> Dict[str, Any]:
+    sh: Dict[str, Any] = {"path": path, "meta": {}, "snapshot": None,
+                          "events": [], "spans": [], "skipped_lines": 0}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                sh["skipped_lines"] += 1      # torn tail — legacy shard
+                continue
+            kind = rec.get("kind")
+            if kind == "meta":
+                sh["meta"] = rec
+            elif kind == "snapshot":
+                sh["snapshot"] = rec          # last one wins
+            elif kind == "span":
+                sh["spans"].append(rec)
+            else:
+                sh["events"].append(rec)
+    return sh
+
+
+def merge(directory: str) -> Dict[str, Any]:
+    """Fold a directory of per-process flight-recorder shards into ONE
+    fleet snapshot: cumulative/time counters SUM across processes,
+    gauges stay per-process (summing a queue-depth gauge across ranks
+    is a lie), and every event/span comes back stamped with its
+    ``pid``/``rank``/``shard``.  Torn or mid-write files (``*.tmp``,
+    invalid trailing lines) are skipped, never fatal — a SIGKILLed
+    child costs its unflushed tail, not the merge."""
+    directory = os.path.expanduser(directory)
+    shards: List[Dict[str, Any]] = []
+    for fn in sorted(os.listdir(directory)):
+        if not (fn.startswith("telemetry-") and fn.endswith(".jsonl")):
+            continue
+        try:
+            shards.append(_read_shard(os.path.join(directory, fn)))
+        except OSError:
+            continue
+    counters: Dict[str, Any] = {}
+    gauges: Dict[str, Dict[str, Any]] = {}
+    events_all: List[Dict[str, Any]] = []
+    spans_all: List[Dict[str, Any]] = []
+    processes: List[Dict[str, Any]] = []
+    skipped = 0
+    for sh in shards:
+        name = os.path.basename(sh["path"])
+        meta = sh["meta"]
+        pid, rank = meta.get("pid"), meta.get("rank", 0)
+        kinds = meta.get("counter_kinds", {})
+        processes.append({"shard": name, "pid": pid, "rank": rank,
+                          "events": len(sh["events"]),
+                          "spans": len(sh["spans"]),
+                          "skipped_lines": sh["skipped_lines"]})
+        skipped += sh["skipped_lines"]
+        snap = (sh["snapshot"] or {}).get("counters", {})
+        for cname, val in snap.items():
+            kind = kinds.get(cname, "cumulative")
+            if kind == "gauge" or val is None:
+                gauges.setdefault(cname, {})[name] = val
+            else:
+                counters[cname] = counters.get(cname, 0) + val
+        for ev in sh["events"]:
+            events_all.append(dict(ev, pid=pid, rank=rank, shard=name))
+        for sp in sh["spans"]:
+            spans_all.append(dict(sp, pid=pid, rank=rank, shard=name))
+    events_all.sort(key=lambda e: e.get("t_us", 0))
+    spans_all.sort(key=lambda s: s.get("t0_us", 0))
+    return {
+        "dir": directory,
+        "shards": [os.path.basename(s["path"]) for s in shards],
+        "processes": processes,
+        "counters": dict(sorted(counters.items())),
+        "gauges": dict(sorted(gauges.items())),
+        "events": events_all,
+        "spans": spans_all,
+        "skipped_lines": skipped,
+    }
+
+
+def merge_chrome_trace(directory: str,
+                       merged: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+    """One chrome trace over every process's shard: each process gets
+    its own lane (``pid`` + a ``process_name`` metadata row naming the
+    rank), spans land as duration events, and spans sharing a
+    ``trace_id`` link into one flow ACROSS processes — a routed request
+    that crossed a drill child renders as one connected arrow chain."""
+    m = merged if merged is not None else merge(directory)
+    events: List[Dict[str, Any]] = []
+    for proc in m["processes"]:
+        pid = proc["pid"] if proc["pid"] is not None else proc["shard"]
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"rank {proc['rank']} "
+                                        f"({proc['shard']})"}})
+    flow_started: set = set()
+    for sp in m["spans"]:
+        pid = sp.get("pid") if sp.get("pid") is not None \
+            else sp.get("shard")
+        ev = {"name": sp["name"], "cat": sp["cat"], "ph": "X",
+              "pid": pid, "tid": sp.get("thread", 0),
+              "ts": sp["t0_us"], "dur": sp["dur_us"]}
+        args = dict(sp.get("args") or {})
+        if sp.get("trace_id"):
+            args["trace_id"] = sp["trace_id"]
+        if args:
+            ev["args"] = args
+        events.append(ev)
+        tid = sp.get("trace_id")
+        if tid:
+            first = tid not in flow_started
+            flow_started.add(tid)
+            events.append({"name": f"trace:{tid}", "cat": "flow",
+                           "ph": "s" if first else "t", "pid": pid,
+                           "tid": sp.get("thread", 0), "ts": sp["t0_us"],
+                           "id": _flow_id(tid)})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def report(prefix: Optional[str] = None, nonzero_only: bool = True) -> str:
@@ -519,3 +963,105 @@ def report(prefix: Optional[str] = None, nonzero_only: bool = True) -> str:
                  f"{len(events())} buffered events; "
                  f"{len(spans())} buffered spans")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m mxnet_tpu.telemetry {report | trace <id> | merge <dir>}
+# ---------------------------------------------------------------------------
+
+def _merged_report(merged: Dict[str, Any],
+                   prefix: Optional[str] = None) -> str:
+    """The :func:`report` table rendered over a fleet merge."""
+    lines = [f"{'Counter (fleet sum)':<52}{'Value':>16}", "=" * 68]
+    for name, val in merged["counters"].items():
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        if not val:
+            continue
+        if isinstance(val, float):
+            lines.append(f"{name:<52}{val:>16.3f}")
+        else:
+            lines.append(f"{name:<52}{val!s:>16}")
+    lines.append("=" * 68)
+    lines.append(f"{len(merged['shards'])} shard(s): "
+                 f"{', '.join(merged['shards']) or '-'}; "
+                 f"{len(merged['events'])} events, "
+                 f"{len(merged['spans'])} spans"
+                 + (f"; {merged['skipped_lines']} torn line(s) skipped"
+                    if merged["skipped_lines"] else ""))
+    return "\n".join(lines)
+
+
+def _trace_from_merge(merged: Dict[str, Any],
+                      trace_id: str) -> Dict[str, Any]:
+    """:func:`trace`, but stitched from a shard merge instead of the
+    in-process buffers (the on-box inspection path)."""
+    evs = [e for e in merged["events"] if e.get("trace_id") == trace_id]
+    sps = [s for s in merged["spans"]
+           if s.get("trace_id") == trace_id or
+           trace_id in ((s.get("args") or {}).get("trace_ids") or ())]
+    records = [dict(e, type="event") for e in evs]
+    records += [dict(s, type="span", t_us=s["t0_us"]) for s in sps]
+    records.sort(key=lambda r: (r["t_us"], r.get("seq", 0)))
+    return {"trace_id": trace_id, "events": evs, "spans": sps,
+            "records": records}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """On-box inspection without writing a script (OBSERVABILITY.md):
+
+    - ``report [--dir D] [--prefix P]`` — the counter table; with
+      ``--dir`` the FLEET sum over that shard directory.
+    - ``trace <id> [--dir D]`` — one request's stitched lifecycle
+      (events + spans in order), from the in-process buffers or a
+      shard directory.
+    - ``merge <dir> [--json] [--chrome OUT]`` — fold shards into one
+      snapshot; ``--json`` dumps the full merge, ``--chrome`` writes
+      the per-process-lane chrome trace.
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(prog="python -m mxnet_tpu.telemetry",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("report", help="counter table")
+    rp.add_argument("--dir", default=None,
+                    help="shard directory (default: this process)")
+    rp.add_argument("--prefix", default=None)
+    tp = sub.add_parser("trace", help="one request's stitched lifecycle")
+    tp.add_argument("trace_id")
+    tp.add_argument("--dir", default=None,
+                    help="shard directory (default: in-process buffers)")
+    mp = sub.add_parser("merge", help="fold shards into one snapshot")
+    mp.add_argument("dir")
+    mp.add_argument("--json", action="store_true",
+                    help="dump the full merge as JSON")
+    mp.add_argument("--chrome", default=None, metavar="OUT",
+                    help="also write the merged chrome trace here")
+    a = p.parse_args(argv)
+    if a.cmd == "report":
+        if a.dir:
+            print(_merged_report(merge(a.dir), prefix=a.prefix))
+        else:
+            print(report(prefix=a.prefix))
+        return 0
+    if a.cmd == "trace":
+        tr = (_trace_from_merge(merge(a.dir), a.trace_id) if a.dir
+              else trace(a.trace_id))
+        print(json.dumps(tr, indent=2, default=str))
+        return 0 if tr["records"] else 1
+    merged = merge(a.dir)
+    if a.chrome:
+        with open(a.chrome, "w") as f:
+            json.dump(merge_chrome_trace(a.dir, merged), f)
+    if a.json:
+        print(json.dumps(merged, default=str))
+    else:
+        print(_merged_report(merged))
+    return 0
+
+
+if __name__ == "__main__":          # pragma: no cover - CLI entry
+    import sys as _sys
+
+    _sys.exit(main())

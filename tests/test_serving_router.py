@@ -302,9 +302,11 @@ def test_hedge_first_wins_and_cancellation_counters():
 
 
 def test_hedge_off_by_default_and_below_min_samples():
-    router, engines, _pools, _m, _p = mk_router()          # pctl 0
+    # warm=1: the threshold logic never dispatches, so the routers
+    # don't need their program grids compiled (suite-time hygiene)
+    router, engines, _pools, _m, _p = mk_router(warm=1)    # pctl 0
     assert router._hedge_threshold() is None
-    router2, _e, _po, _m2, _p2 = mk_router(hedge_pctl=95)
+    router2, _e, _po, _m2, _p2 = mk_router(hedge_pctl=95, warm=1)
     assert router2._hedge_threshold() is None    # < 16 samples yet
 
 

@@ -1,0 +1,337 @@
+"""End-to-end request tracing (ISSUE 15): the thread-local trace
+context minted at every serving admission edge and propagated across
+the replica router's dispatch/hedge threads and the decode scheduler.
+
+Covers: (1) ``trace_scope`` semantics — mint, ambient inheritance,
+explicit cross-thread re-entry, explicit-None passthrough, and
+parent-span stamping on nested events/spans; (2) a bare
+``GenerativeEngine.generate`` yields ONE stitched trace: admission →
+prefill → every decode iteration (via the batched span's
+``args.trace_ids``) → retirement, in order; (3) a routed failover
+chain: dispatch-attempt events carry ordered attempt indices with the
+failover marking, and the ``failover`` event stamps the request's id;
+(4) a hedged dispatch: two engine calls on two threads, ONE trace; (5)
+a pool-pressure preempted-then-resumed request keeps one trace_id
+across its re-queue (two prefill spans, same id); (6) disabled mode
+(``MXNET_TELEMETRY_TRACE=0``): zero trace fields anywhere and a
+dispatch budget byte-identical to the traced run — the
+check_dispatch_budget router lane pins the same contract in CI.
+
+The ``telemetry.traces_minted`` counter is named here for the
+check_telemetry coverage gate.
+"""
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu import faults, preemption, telemetry  # noqa: E402
+from mxnet_tpu import serving_decode as sd  # noqa: E402
+from mxnet_tpu.serving_router import ReplicaRouter  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    yield
+    preemption.reset()
+    faults.uninstall()
+
+
+def tiny(seed=0, **kw):
+    cfg = dict(vocab=31, d_model=16, n_layers=1, n_heads=2, max_seq=48)
+    cfg.update(kw)
+    model = sd.TinyCausalLM(**cfg)
+    return model, model.init_params(seed)
+
+
+def mk_engine(model, params, pages=32, page=4, max_rows=2, name="t",
+              warm=8):
+    pool = sd.PagePool(pages=pages, page=page)
+    eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                              max_rows=max_rows, name=name)
+    eng.warmup(max_len=warm)
+    return eng, pool
+
+
+def _event_base():
+    evs = telemetry.events()
+    return evs[-1]["seq"] if evs else 0
+
+
+def _new_events(base):
+    return [e for e in telemetry.events() if e["seq"] > base]
+
+
+def _span_base():
+    sps = telemetry.spans()
+    return sps[-1].get("seq", 0) if sps else 0
+
+
+def _new_spans(base):
+    return [s for s in telemetry.spans() if s.get("seq", 0) > base]
+
+
+# ---------------------------------------------------------------------------
+# 1. trace_scope semantics
+# ---------------------------------------------------------------------------
+
+def test_trace_scope_mint_inherit_explicit_and_parenting():
+    assert telemetry.current_trace() is None
+    with telemetry.trace_scope() as outer:
+        tid = outer.trace_id
+        assert tid          # minted (telemetry.traces_minted moved)
+        assert telemetry.current_trace() == tid
+        with telemetry.trace_scope() as inner:
+            assert inner.trace_id == tid        # ambient inheritance
+        telemetry.event("shed", "test.trace.scope", reason="x")
+        with telemetry.span("test.trace.outer_span"):
+            telemetry.event("fault", "test.trace.nested")
+    assert telemetry.current_trace() is None
+    tr = telemetry.trace(tid)
+    by_kind = {e["kind"]: e for e in tr["events"]}
+    assert by_kind["shed"]["trace_id"] == tid
+    assert "parent" not in by_kind["shed"]      # no enclosing span
+    # the nested event parents onto the enclosing span's id
+    sp = next(s for s in tr["spans"]
+              if s["name"] == "test.trace.outer_span")
+    assert by_kind["fault"]["parent"] == sp["id"]
+    # explicit re-entry on another thread carries the SAME identity
+    seen = {}
+
+    def worker():
+        with telemetry.trace_scope(trace_id=tid):
+            seen["trace"] = telemetry.current_trace()
+        with telemetry.trace_scope(trace_id=None):   # explicit None
+            seen["none"] = telemetry.current_trace()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["trace"] == tid
+    assert seen["none"] is None                 # strict no-op
+
+
+def test_trace_scope_disabled_never_mints(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_TRACE", "0")
+    base = _event_base()
+    with telemetry.trace_scope() as s:
+        assert s.trace_id is None
+        telemetry.event("shed", "test.trace.disabled")
+    ev = _new_events(base)[-1]
+    assert "trace_id" not in ev and "parent" not in ev
+
+
+# ---------------------------------------------------------------------------
+# 2. bare engine: one stitched lifecycle
+# ---------------------------------------------------------------------------
+
+def test_bare_generate_one_stitched_trace():
+    model, params = tiny(seed=1)
+    eng, pool = mk_engine(model, params, name="tr_bare")
+    base_ev = _event_base()
+    toks = eng.generate([1, 2, 3], max_new_tokens=4)
+    assert toks == sd.eager_generate(model, params, [1, 2, 3], 4)
+    admit = [e for e in _new_events(base_ev) if e["kind"] == "admit"]
+    assert admit and admit[0]["trace_id"]
+    tid = admit[0]["trace_id"]
+    tr = telemetry.trace(tid)
+    kinds = [r["kind"] for r in tr["records"] if r["type"] == "event"]
+    assert kinds[0] == "admit" and kinds[-1] == "retire"
+    names = [r["name"] for r in tr["records"] if r["type"] == "span"]
+    assert "decode.prefill" in names
+    # decode iterations ride the batched span's trace_ids list
+    steps = [s for s in tr["spans"] if s["name"] == "decode.step"]
+    assert len(steps) >= 3          # 4 tokens = prefill + >= 3 steps
+    assert all(tid in s["args"]["trace_ids"] for s in steps)
+    # in ORDER: admission before the first decode step, retirement last
+    recs = tr["records"]
+    i_admit = next(i for i, r in enumerate(recs)
+                   if r.get("kind") == "admit")
+    i_step = next(i for i, r in enumerate(recs)
+                  if r.get("name") == "decode.step")
+    i_retire = next(i for i, r in enumerate(recs)
+                    if r.get("kind") == "retire")
+    assert i_admit < i_step < i_retire
+    assert pool.in_use() == 0
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. routed failover: ordered attempt indices, one trace
+# ---------------------------------------------------------------------------
+
+def test_router_failover_chain_attempts_ordered():
+    model, params = tiny(seed=2)
+    engines = []
+    for i in range(2):
+        eng, _pool = mk_engine(model, params, name=f"tr_fo{i}")
+        engines.append(eng)
+    router = ReplicaRouter(engines, breaker_errs=3,
+                           breaker_cooldown_s=0.2, hedge_pctl=0)
+    orig = engines[0].generate
+    calls = [0]
+
+    def flaky(*a, **kw):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise faults.TransientFault("boom")
+        return orig(*a, **kw)
+
+    engines[0].generate = flaky
+    base_ev = _event_base()
+    toks = router.generate([1, 2, 3], max_new_tokens=4)
+    engines[0].generate = orig
+    assert toks == sd.eager_generate(model, params, [1, 2, 3], 4)
+    retire = [e for e in _new_events(base_ev)
+              if e["kind"] == "retire" and e["name"] == router.name]
+    tid = retire[-1]["trace_id"]
+    tr = telemetry.trace(tid)
+    disp = [r for r in tr["records"] if r.get("kind") == "dispatch"]
+    assert [d["attempt"] for d in disp] == [1, 2]     # ordered chain
+    assert disp[0]["failover"] is False
+    assert disp[1]["failover"] is True
+    assert disp[0]["replica"] != disp[1]["replica"]   # re-routed
+    fo = [r for r in tr["records"] if r.get("kind") == "failover"]
+    assert fo and fo[0]["trace_id"] == tid
+    # the retry's fault event inherited the scope too
+    assert any(r.get("kind") == "fault" for r in tr["records"])
+    # engine-side lifecycle stitched into the SAME trace
+    assert any(r["type"] == "span" and r["name"] == "decode.request"
+               for r in tr["records"])
+    for eng in engines:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# 4. hedged dispatch: two threads, one trace
+# ---------------------------------------------------------------------------
+
+def test_hedged_dispatch_two_threads_one_trace():
+    model, params = tiny(seed=3)
+    engines = []
+    for i in range(2):
+        eng, _pool = mk_engine(model, params, name=f"tr_hg{i}")
+        engines.append(eng)
+    router = ReplicaRouter(engines, breaker_errs=4, hedge_pctl=50)
+    ref = sd.eager_generate(model, params, [1, 2, 3], 3)
+    orig0, orig1 = engines[0].generate, engines[1].generate
+    # prime the latency distribution so the threshold is live, then
+    # slow every primary dispatch past it
+    router._lat_dispatch = deque((0.001,) * 16, maxlen=4096)
+
+    def slow0(*a, **kw):
+        time.sleep(0.25)
+        return orig0(*a, **kw)
+
+    def slow1(*a, **kw):
+        time.sleep(0.25)
+        return orig1(*a, **kw)
+
+    engines[0].generate = slow0
+    engines[1].generate = slow1
+    base_ev = _event_base()
+    out = router.generate([1, 2, 3], max_new_tokens=3)
+    engines[0].generate, engines[1].generate = orig0, orig1
+    assert out == ref
+    hedges = [e for e in _new_events(base_ev) if e["kind"] == "hedge"]
+    assert hedges, "hedge never fired"
+    tid = hedges[0]["trace_id"]
+    assert tid
+    disp = [e for e in _new_events(base_ev)
+            if e["kind"] == "dispatch" and e["trace_id"] == tid]
+    # primary + hedged duplicate: two dispatch records, two replicas,
+    # ONE trace — hedge marked, same attempt
+    assert {d["hedge"] for d in disp} == {False, True}
+    assert len({d["replica"] for d in disp}) == 2
+    assert len({d["attempt"] for d in disp}) == 1
+    from mxnet_tpu import engine as _engine
+
+    _engine.waitall()               # the hedge loser finishes
+    for eng in engines:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# 5. preemption re-queue keeps ONE trace_id
+# ---------------------------------------------------------------------------
+
+def test_preempted_decode_request_keeps_one_trace():
+    model, params = tiny(seed=4)
+    # a pool too small for two full sequences forces a mid-decode
+    # recompute preemption (the test_serving_decode scenario)
+    eng, pool = mk_engine(model, params, pages=4, page=2,
+                          name="tr_pre")
+    prompts, res = [[1, 2, 3], [4, 5]], {}
+    base_ev = _event_base()
+    base_sp = _span_base()
+
+    def fire(i):
+        res[i] = eng.generate(prompts[i], max_new_tokens=4)
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in (0, 1):
+        assert res[i] == sd.eager_generate(model, params, prompts[i], 4)
+    assert eng.stats()["preempts"] >= 1
+    pre = [e for e in _new_events(base_ev) if e["kind"] == "preempt"]
+    assert pre, "no preemption happened"
+    tid = pre[0]["trace_id"]
+    assert tid                      # the EVICTED request's identity
+    # the re-queued request re-prefilled under the SAME trace: two
+    # decode.prefill spans, one id — the request was never re-minted
+    prefills = [s for s in _new_spans(base_sp)
+                if s["name"] == "decode.prefill"
+                and s.get("trace_id") == tid]
+    assert len(prefills) >= 2
+    retire = [e for e in _new_events(base_ev)
+              if e["kind"] == "retire" and e.get("trace_id") == tid]
+    assert retire and retire[0]["preempts"] >= 1
+    assert pool.in_use() == 0
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# 6. disabled mode: zero trace fields, identical dispatch budget
+# ---------------------------------------------------------------------------
+
+def test_disabled_mode_zero_overhead_budget_identical(monkeypatch):
+    model, params = tiny(seed=5)
+    prompts = [[1 + (i * 3 + j) % 29 for j in range(3 + i % 3)]
+               for i in range(4)]
+
+    def run():
+        eng, pool = mk_engine(model, params, name="tr_off")
+        d0, t0 = sd.dispatch_count(), sd.trace_count()
+        outs = [eng.generate(p, max_new_tokens=4) for p in prompts]
+        row = {"outs": outs,
+               "dispatches": sd.dispatch_count() - d0,
+               "retraces": sd.trace_count() - t0,
+               "leaked": pool.in_use()}
+        eng.close()
+        return row
+
+    on = run()
+    base_ev = _event_base()
+    base_sp = _span_base()
+    minted0 = telemetry.get("telemetry.traces_minted").value
+    monkeypatch.setenv("MXNET_TELEMETRY_TRACE", "0")
+    off = run()
+    monkeypatch.delenv("MXNET_TELEMETRY_TRACE")
+    # byte-identical budget and outputs
+    assert off["outs"] == on["outs"]
+    assert off["dispatches"] == on["dispatches"]
+    assert off["retraces"] == on["retraces"] == 0
+    assert off["leaked"] == on["leaked"] == 0
+    # no ids minted, no trace fields on ANYTHING the off-run emitted
+    assert telemetry.get("telemetry.traces_minted").value == minted0
+    assert all("trace_id" not in e for e in _new_events(base_ev))
+    assert all("trace_id" not in s for s in _new_spans(base_sp))

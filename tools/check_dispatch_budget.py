@@ -422,6 +422,8 @@ def _measure_router() -> dict:
                for i in range(6)]
 
     def run(route: bool) -> dict:
+        from mxnet_tpu import telemetry as _tel
+
         pool = sd.PagePool(pages=32, page=4)
         eng = sd.GenerativeEngine(model, params=params, pool=pool,
                                   max_rows=2, name="lane")
@@ -429,17 +431,39 @@ def _measure_router() -> dict:
         front = (ReplicaRouter([eng], hedge_pctl=0) if route else eng)
         t0, d0 = sd.trace_count(), sd.dispatch_count()
         h0 = _ndmod.host_sync_count()
+        evs = _tel.events()
+        e0 = evs[-1]["seq"] if evs else 0
+        sp0 = {id(s) for s in _tel.spans()}
         outs = [front.generate(p, max_new_tokens=5) for p in prompts]
+        new_evs = [e for e in _tel.events() if e["seq"] > e0]
+        new_sps = [s for s in _tel.spans() if id(s) not in sp0]
         row = {"outs": outs,
                "dispatches": sd.dispatch_count() - d0,
                "retraces": sd.trace_count() - t0,
                "host_syncs": _ndmod.host_sync_count() - h0,
+               "trace_fields": sum(1 for e in new_evs
+                                   if "trace_id" in e)
+               + sum(1 for s in new_sps if "trace_id" in s),
                "leaked_pages": pool.in_use()}
         eng.close()
         return row
 
     bare = run(False)
     routed = run(True)
+    # ISSUE-15 disabled-mode contract: with MXNET_TELEMETRY_TRACE=0 the
+    # routed lane is BYTE-IDENTICAL to PR 14 — same token streams, same
+    # dispatch/retrace/host-sync counts, and zero trace fields on any
+    # event or span (the knob is uncached, so the env flip takes
+    # effect immediately)
+    prev = os.environ.get("MXNET_TELEMETRY_TRACE")
+    os.environ["MXNET_TELEMETRY_TRACE"] = "0"
+    try:
+        routed_off = run(True)
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_TELEMETRY_TRACE", None)
+        else:
+            os.environ["MXNET_TELEMETRY_TRACE"] = prev
     return {
         "mode": "router",
         "requests": len(prompts),
@@ -449,7 +473,16 @@ def _measure_router() -> dict:
         "extra_retraces": routed["retraces"] - bare["retraces"],
         "extra_host_syncs": routed["host_syncs"] - bare["host_syncs"],
         "outputs_equal": bare["outs"] == routed["outs"],
-        "leaked_pages": bare["leaked_pages"] + routed["leaked_pages"],
+        "leaked_pages": (bare["leaked_pages"] + routed["leaked_pages"]
+                         + routed_off["leaked_pages"]),
+        "traced_off_outputs_equal": routed_off["outs"] == bare["outs"],
+        "traced_off_extra_dispatches":
+            routed_off["dispatches"] - bare["dispatches"],
+        "traced_off_extra_retraces":
+            routed_off["retraces"] - bare["retraces"],
+        "traced_off_extra_host_syncs":
+            routed_off["host_syncs"] - bare["host_syncs"],
+        "traced_off_trace_fields": routed_off["trace_fields"],
     }
 
 
@@ -647,6 +680,17 @@ def main() -> int:
     if router["leaked_pages"]:
         failures.append(
             f"router lane leaked {router['leaked_pages']} KV pages")
+    # ISSUE-15: tracing disabled must be byte-identical to PR 14
+    if not router["traced_off_outputs_equal"]:
+        failures.append(
+            "router token streams under MXNET_TELEMETRY_TRACE=0 differ "
+            "from the bare engine's")
+    for key in ("traced_off_extra_dispatches", "traced_off_extra_retraces",
+                "traced_off_extra_host_syncs", "traced_off_trace_fields"):
+        if router[key] != 0:
+            failures.append(
+                f"router {key} = {router[key]} with tracing disabled "
+                "(must be 0: zero overhead when off)")
     for key, budget in SENTINEL_BUDGET.items():
         if snt[key] > budget:
             failures.append(

@@ -1,0 +1,346 @@
+#!/usr/bin/env python
+"""Perf-regression CI gate over bench-artifact telemetry (ISSUE 15;
+ROADMAP item 5's "measurement substrate finally defending itself").
+
+Every bench lane stamps a full namespaced telemetry snapshot
+(``telemetry``, PR 10) and — for subprocess-fleet lanes — a merged
+``fleet_telemetry`` (this PR).  This gate diffs those snapshots between
+two artifacts (``BENCH_r{N-1}`` vs ``BENCH_r{N}`` by default, or
+``--baseline``/``--candidate``) and FAILS LOUDLY when a counter family
+the PRs 1–14 wins were bought in regresses past its declared tolerance:
+
+- **retraces** (``program_store.<ns>.traces``): tolerance 0 — one extra
+  steady-state retrace is the classic silent perf killer.
+- **dispatches** (``program_store.<ns>.dispatches``): the 1-dispatch/
+  step contract; small ratio slack for workload jitter.
+- **host syncs** (``ndarray.host_sync``, ``metric.host_sync``): the
+  PR-5 pipeline win.
+- **shed rate** (``*.shed``, ``*.sheds``, ``*.shed_<kind>``): serving
+  availability (PRs 8/14).
+- **program-cache misses** (``program_store.<ns>.misses`` and the disk
+  ``cache_misses`` lane alias): the PR-7 cold-start win.
+
+Counter names are instance-normalized (``decode.engine3.shed`` →
+``decode.engine*.shed``) and summed per lane, so a renumbered engine
+instance between rounds cannot fake a delta.  Lanes match by their
+``metric`` name; a lane present on only one side is reported, never
+fatal.  Artifacts that predate telemetry stamping (e.g. the committed
+``BENCH_r04``/``BENCH_r05`` pair) have nothing comparable: the gate
+prints exactly that and passes — vacuous green is loud, not silent.
+
+A regression can be WAIVED with a reasoned entry in
+``tools/perf_delta_waivers.json`` (graftlint-baseline style: shipped
+empty, every entry needs ``lane``, ``counter``, and a non-empty
+``reason``); waived regressions are reported but do not fail.
+
+``--self-test`` verifies the gate catches an injected +1-retrace
+candidate (and is run by the suite).  Exit 0 = no unwaived regression.
+
+Usage::
+
+    python tools/check_perf_delta.py                  # newest r-pair
+    python tools/check_perf_delta.py --baseline A.json --candidate B.json
+    python tools/check_perf_delta.py --self-test
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WAIVER_PATH = os.path.join(REPO, "tools", "perf_delta_waivers.json")
+
+
+class Rule:
+    """One gated counter family: ``match`` selects normalized counter
+    names, a candidate value above ``base * (1 + tol) + slack`` is a
+    regression."""
+
+    def __init__(self, label: str, match: Callable[[str], bool],
+                 tol: float, slack: float):
+        self.label = label
+        self.match = match
+        self.tol = tol
+        self.slack = slack
+
+    def regressed(self, base: float, cand: float) -> bool:
+        return cand > base * (1.0 + self.tol) + self.slack
+
+
+RULES: Tuple[Rule, ...] = (
+    Rule("retrace",
+         lambda n: n.startswith("program_store.") and n.endswith(".traces"),
+         tol=0.0, slack=0.0),
+    Rule("dispatch",
+         lambda n: n.startswith("program_store.")
+         and n.endswith(".dispatches"),
+         tol=0.10, slack=2.0),
+    Rule("host-sync",
+         lambda n: n in ("ndarray.host_sync", "metric.host_sync"),
+         tol=0.10, slack=2.0),
+    Rule("shed-rate",
+         lambda n: re.search(r"\.sheds?$", n) is not None
+         or re.search(r"\.shed_[a-z]+$", n) is not None,
+         tol=0.10, slack=2.0),
+    Rule("program-cache-miss",
+         lambda n: n.startswith("program_store.") and n.endswith(".misses"),
+         tol=0.10, slack=2.0),
+)
+
+# lane-level scalar aliases gated alongside the namespaced counters
+# (older artifacts carry only these; keys -> rule label)
+LANE_KEY_RULES: Dict[str, str] = {
+    "retrace_count": "retrace",
+    "cache_misses": "program-cache-miss",
+}
+_LANE_KEY_RULE = {r.label: r for r in RULES}
+
+_INSTANCE_RE = re.compile(r"^((?:serving\.router|serving\.engine|"
+                          r"decode\.engine|kv_pool))\d+\.")
+
+
+def normalize(name: str) -> str:
+    """Strip per-process instance numbering (``decode.engine3.shed`` →
+    ``decode.engine*.shed``) so re-numbered instances compare."""
+    return _INSTANCE_RE.sub(r"\1*.", name)
+
+
+def lane_counters(lane: Dict[str, Any]) -> Optional[Dict[str, float]]:
+    """The lane's comparable counters: the fleet merge when present,
+    else its single-process snapshot — instance-normalized and summed.
+    None when the lane predates telemetry stamping."""
+    snap = lane.get("fleet_telemetry") or lane.get("telemetry")
+    if not isinstance(snap, dict):
+        return None
+    out: Dict[str, float] = {}
+    for name, val in snap.items():
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            continue
+        key = normalize(name)
+        out[key] = out.get(key, 0.0) + val
+    return out
+
+
+def extract_lanes(artifact: Any) -> List[Dict[str, Any]]:
+    """Every lane dict in a bench artifact, tolerant of the three
+    shapes in the wild: the committed ``{"parsed": {..., "lanes":
+    [...]}}`` round files, a bare ``{"lanes": [...]}`` payload (the
+    head itself is a lane), and a plain list of lanes."""
+    if isinstance(artifact, list):
+        return [l for l in artifact if isinstance(l, dict)]
+    if not isinstance(artifact, dict):
+        return []
+    node = artifact.get("parsed", artifact)
+    if not isinstance(node, dict):
+        return []
+    lanes = [l for l in node.get("lanes", []) if isinstance(l, dict)]
+    if "metric" in node:
+        head = {k: v for k, v in node.items() if k != "lanes"}
+        if not any(l.get("metric") == head.get("metric") for l in lanes):
+            lanes.insert(0, head)
+    return lanes
+
+
+def load_artifact(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        return extract_lanes(json.load(f))
+
+
+def load_waivers(path: str) -> List[Dict[str, str]]:
+    """Reasoned waivers only: every entry must name its lane, counter,
+    and a non-empty reason — an unreasoned waiver fails the gate
+    outright (the graftlint baseline policy)."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    waivers = data.get("waivers", [])
+    for w in waivers:
+        if not (w.get("lane") and w.get("counter")
+                and str(w.get("reason", "")).strip()):
+            raise SystemExit(
+                f"check_perf_delta: waiver {w!r} in {path} lacks "
+                "lane/counter/reason — waivers must be reasoned")
+    return waivers
+
+
+def _waived(waivers: List[Dict[str, str]], lane: str,
+            counter: str) -> Optional[Dict[str, str]]:
+    for w in waivers:
+        if w["lane"] == lane and w["counter"] == counter:
+            return w
+    return None
+
+
+def compare(baseline: List[Dict[str, Any]],
+            candidate: List[Dict[str, Any]],
+            waivers: List[Dict[str, str]]) -> Dict[str, Any]:
+    """Diff matched lanes' counters under the rule table.  Returns the
+    full report; ``report['regressions']`` non-empty = gate fails."""
+    base_by = {l.get("metric"): l for l in baseline if l.get("metric")}
+    cand_by = {l.get("metric"): l for l in candidate if l.get("metric")}
+    report: Dict[str, Any] = {
+        "lanes_compared": [], "lanes_skipped": [], "counters_compared": 0,
+        "regressions": [], "waived": [], "improvements": [],
+    }
+    for metric in sorted(set(base_by) & set(cand_by)):
+        b = lane_counters(base_by[metric])
+        c = lane_counters(cand_by[metric])
+        rows: List[Tuple[str, Rule, float, float]] = []
+        if b is not None and c is not None:
+            for name in sorted(set(b) | set(c)):
+                for rule in RULES:
+                    if rule.match(name):
+                        rows.append((name, rule, b.get(name, 0.0),
+                                     c.get(name, 0.0)))
+                        break
+        # lane-level scalar aliases (the only signal pre-PR-10 rounds
+        # carry) gate under the same tolerances
+        for key, label in LANE_KEY_RULES.items():
+            bv, cv = base_by[metric].get(key), cand_by[metric].get(key)
+            if isinstance(bv, (int, float)) and isinstance(cv, (int, float)):
+                rows.append((f"lane:{key}", _LANE_KEY_RULE[label], bv, cv))
+        if not rows:
+            report["lanes_skipped"].append(metric)
+            continue
+        report["lanes_compared"].append(metric)
+        for name, rule, bv, cv in rows:
+            report["counters_compared"] += 1
+            if rule.regressed(bv, cv):
+                entry = {"lane": metric, "counter": name,
+                         "rule": rule.label, "baseline": bv,
+                         "candidate": cv,
+                         "tolerance": f"+{rule.tol:.0%} +{rule.slack:g}"}
+                w = _waived(waivers, metric, name)
+                if w is not None:
+                    entry["reason"] = w["reason"]
+                    report["waived"].append(entry)
+                else:
+                    report["regressions"].append(entry)
+            elif cv < bv:
+                report["improvements"].append(
+                    {"lane": metric, "counter": name, "rule": rule.label,
+                     "baseline": bv, "candidate": cv})
+    report["lanes_baseline_only"] = sorted(set(base_by) - set(cand_by))
+    report["lanes_candidate_only"] = sorted(set(cand_by) - set(base_by))
+    return report
+
+
+def default_pair() -> Optional[Tuple[str, str]]:
+    """The two newest committed ``BENCH_r{N}.json`` rounds."""
+    rounds = []
+    for p in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.match(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+        if m:
+            rounds.append((int(m.group(1)), p))
+    if len(rounds) < 2:
+        return None
+    rounds.sort()
+    return rounds[-2][1], rounds[-1][1]
+
+
+def run_gate(baseline_path: str, candidate_path: str,
+             waiver_path: str = WAIVER_PATH,
+             emit_json: bool = False) -> int:
+    baseline = load_artifact(baseline_path)
+    candidate = load_artifact(candidate_path)
+    waivers = load_waivers(waiver_path)
+    report = compare(baseline, candidate, waivers)
+    report["baseline"] = os.path.basename(baseline_path)
+    report["candidate"] = os.path.basename(candidate_path)
+    if emit_json:
+        print(json.dumps(report, indent=2))
+    for w in report["waived"]:
+        print(f"check_perf_delta: WAIVED [{w['rule']}] lane "
+              f"{w['lane']!r} counter {w['counter']} "
+              f"{w['baseline']:g} -> {w['candidate']:g}: {w['reason']}")
+    if report["regressions"]:
+        print(f"check_perf_delta: FAILED — "
+              f"{report['candidate']} regresses vs {report['baseline']}",
+              file=sys.stderr)
+        for r in report["regressions"]:
+            print(f"  [{r['rule']}] lane {r['lane']!r}: counter "
+                  f"{r['counter']} rose {r['baseline']:g} -> "
+                  f"{r['candidate']:g} (tolerance {r['tolerance']})",
+                  file=sys.stderr)
+        return 1
+    if not report["lanes_compared"]:
+        print(f"check_perf_delta: PASS (vacuous) — no lane of "
+              f"{report['baseline']} vs {report['candidate']} carries "
+              "comparable telemetry (pre-PR-10 artifacts); nothing to "
+              "regress against yet")
+        return 0
+    print(f"check_perf_delta: PASS — {len(report['lanes_compared'])} "
+          f"lane(s), {report['counters_compared']} gated counter(s), "
+          f"{len(report['waived'])} waived, "
+          f"{len(report['improvements'])} improved "
+          f"({report['baseline']} -> {report['candidate']})")
+    return 0
+
+
+def self_test() -> int:
+    """The injected-regression check: a synthetic candidate with ONE
+    extra steady-state retrace (and nothing else changed) must fail,
+    and the failure must name the counter and the lane."""
+    base_lane = {
+        "metric": "decode_continuous_tokens_per_s", "value": 100.0,
+        "telemetry": {"program_store.serving_decode.traces": 5,
+                      "program_store.serving_decode.dispatches": 64,
+                      "ndarray.host_sync": 16,
+                      "decode.engine0.shed": 1},
+    }
+    cand_lane = json.loads(json.dumps(base_lane))
+    cand_lane["telemetry"]["program_store.serving_decode.traces"] = 6
+    report = compare([base_lane], [cand_lane], waivers=[])
+    bad = [r for r in report["regressions"]
+           if r["counter"] == "program_store.serving_decode.traces"
+           and r["lane"] == "decode_continuous_tokens_per_s"
+           and r["rule"] == "retrace"]
+    if not bad:
+        print("check_perf_delta: SELF-TEST FAILED — a +1 retrace "
+              f"candidate was not flagged ({report['regressions']})",
+              file=sys.stderr)
+        return 1
+    clean = compare([base_lane], [json.loads(json.dumps(base_lane))],
+                    waivers=[])
+    if clean["regressions"]:
+        print("check_perf_delta: SELF-TEST FAILED — an identical "
+              f"candidate was flagged ({clean['regressions']})",
+              file=sys.stderr)
+        return 1
+    print("check_perf_delta: self-test OK (+1 retrace flagged, "
+          "identical snapshot clean)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=None)
+    ap.add_argument("--candidate", default=None)
+    ap.add_argument("--waivers", default=WAIVER_PATH)
+    ap.add_argument("--json", action="store_true", dest="emit_json")
+    ap.add_argument("--self-test", action="store_true", dest="self_test")
+    a = ap.parse_args(argv)
+    if a.self_test:
+        return self_test()
+    if (a.baseline is None) != (a.candidate is None):
+        ap.error("--baseline and --candidate go together")
+    if a.baseline is None:
+        pair = default_pair()
+        if pair is None:
+            print("check_perf_delta: fewer than two BENCH_r*.json "
+                  "rounds in the repo root; nothing to diff",
+                  file=sys.stderr)
+            return 1
+        a.baseline, a.candidate = pair
+    return run_gate(a.baseline, a.candidate, a.waivers,
+                    emit_json=a.emit_json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

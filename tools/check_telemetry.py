@@ -24,9 +24,22 @@
    carrying >= 3 distinct span categories (train_step / decode /
    serving / step_phase) — the unified-timeline acceptance bar.
 
+5. **Routed requests are traced** (ISSUE 15).  A 2-replica
+   ``ReplicaRouter`` driven through a failover, a hedge, and a
+   deadline shed must stamp a NON-EMPTY ``trace_id`` on every ``shed``
+   / ``failover`` / ``hedge`` event it emits — an unstitchable
+   lifecycle record is a regression.
+
+6. **Merge correctness** (ISSUE 15).  Two subprocesses each run the
+   identical steady-state TrainStep window and flush one flight-
+   recorder shard; ``telemetry.merge`` over the pair must equal
+   exactly 2x either process's cumulative window delta on the
+   deterministic counters — cross-process aggregation is arithmetic,
+   not approximation.
+
 Exit code 0 = all gates green.  Usage:
 ``python tools/check_telemetry.py [repo_root]`` (run by the suite via
-tests/test_telemetry.py).
+tests/test_telemetry.py; ``--merge-worker`` is gate 6's child entry).
 """
 from __future__ import annotations
 
@@ -225,6 +238,168 @@ def check_chrome_trace() -> List[str]:
     return []
 
 
+def check_routed_trace_ids() -> List[str]:
+    """ISSUE-15 gate: drive a 2-replica router through a failover, a
+    hedged dispatch, and a deadline shed — every ``shed`` / ``failover``
+    / ``hedge`` event emitted on those routed requests must carry a
+    non-empty ``trace_id``."""
+    import time as _time
+    from collections import deque as _deque
+
+    from mxnet_tpu import faults, telemetry
+    from mxnet_tpu import serving_decode as sd
+    from mxnet_tpu.serving_router import ReplicaRouter
+
+    model = sd.TinyCausalLM(vocab=31, d_model=16, n_layers=1, n_heads=2,
+                            max_seq=48)
+    params = model.init_params(0)
+    engines, pools = [], []
+    for i in range(2):
+        pool = sd.PagePool(pages=32, page=4)
+        eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                                  max_rows=2, name=f"trace_gate{i}")
+        eng.warmup(max_len=8)
+        engines.append(eng)
+        pools.append(pool)
+    router = ReplicaRouter(engines, breaker_errs=4,
+                           breaker_cooldown_s=0.2, hedge_pctl=50)
+    evs0 = telemetry.events()
+    base_seq = evs0[-1]["seq"] if evs0 else 0
+    failures: List[str] = []
+    orig = engines[0].generate
+    try:
+        # failover: replica 0 fails its first dispatch
+        calls = [0]
+
+        def flaky(*a, **kw):
+            calls[0] += 1
+            if calls[0] == 1:
+                raise faults.TransientFault("trace-gate failover")
+            return orig(*a, **kw)
+
+        engines[0].generate = flaky
+        router.generate([1, 2, 3], max_new_tokens=3)
+        engines[0].generate = orig
+        # deadline shed: a 1us budget can never admit
+        try:
+            router.generate([1, 2, 3], max_new_tokens=3, deadline_us=1)
+            failures.append("trace gate: 1us-budget request was not shed")
+        except faults.ShedError:
+            pass
+        # hedge: prime the latency distribution, slow replica-side
+        # dispatch past p50, fire once
+        router._lat_dispatch = _deque((0.001,) * 16, maxlen=4096)
+
+        def slow(*a, **kw):
+            _time.sleep(0.25)
+            return orig(*a, **kw)
+
+        engines[0].generate = engines[1].generate = slow
+        router.generate([1, 2, 3], max_new_tokens=2)
+    finally:
+        engines[0].generate = orig
+        engines[1].generate = orig
+        for eng in engines:
+            eng.close()
+        router.close()
+    new = [e for e in telemetry.events() if e["seq"] > base_seq]
+    for want in ("failover", "shed", "hedge"):
+        of_kind = [e for e in new if e["kind"] == want]
+        if not of_kind:
+            failures.append(
+                f"trace gate emitted no {want!r} event — the scenario "
+                "drill broke, the stamping contract is unverified")
+        bad = [e for e in of_kind if not e.get("trace_id")]
+        if bad:
+            failures.append(
+                f"{len(bad)} routed {want!r} event(s) carry no "
+                f"trace_id: {bad[:2]}")
+    leaked = sum(p.in_use() for p in pools)
+    if leaked:
+        failures.append(f"trace gate leaked {leaked} KV pages")
+    return failures
+
+
+_MERGE_WORKER_FLAG = "--merge-worker"
+
+
+def _merge_worker() -> int:
+    """Gate-6 child: run the identical steady-state window and flush
+    ONE shard whose snapshot is exactly the window's delta (counters
+    reset after warmup, so cumulative == since-reset)."""
+    from mxnet_tpu import engine, telemetry
+
+    step, x, y = _train_fixture()
+    for _ in range(2):                    # warm: trace + compile + AOT
+        loss = step(x, y, batch_size=8)
+    loss.asnumpy()
+    telemetry.reset()
+    for _ in range(3):
+        loss = step(x, y, batch_size=8)
+    loss.asnumpy()
+    engine.waitall()                      # flushes the flight recorder
+    return 0
+
+
+def check_merge_correctness() -> List[str]:
+    """Two processes, identical windows: the shard snapshots must be
+    byte-identical on the deterministic counters and the merge must
+    equal exactly 2x one of them."""
+    import subprocess
+
+    from mxnet_tpu import telemetry
+
+    d = tempfile.mkdtemp(prefix="check-telemetry-merge-")
+    env = dict(os.environ)
+    env["MXNET_TELEMETRY_DIR"] = d
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("MXNET_FAULT_PLAN", None)
+    # the two processes are independent by construction — run them
+    # concurrently so the gate pays one worker's wall clock, not two
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), _MERGE_WORKER_FLAG],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for _ in range(2)]
+    for i, p in enumerate(procs):
+        try:
+            _out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            return [f"merge worker {i} timed out"]
+        if p.returncode != 0:
+            return [f"merge worker {i} failed rc={p.returncode}: "
+                    f"{err[-1000:]}"]
+    merged = telemetry.merge(d)
+    if len(merged["shards"]) != 2:
+        return [f"expected 2 shards, merged {merged['shards']}"]
+    windows = []
+    for proc in merged["processes"]:
+        sh = telemetry._read_shard(os.path.join(d, proc["shard"]))
+        kinds = (sh["meta"] or {}).get("counter_kinds", {})
+        snap = (sh["snapshot"] or {}).get("counters", {})
+        windows.append({
+            n: v for n, v in snap.items()
+            if n.startswith(_DETERMINISTIC_PREFIXES)
+            and kinds.get(n) == "cumulative"})
+    if windows[0] != windows[1]:
+        diff = {k: (windows[0].get(k), windows[1].get(k))
+                for k in set(windows[0]) | set(windows[1])
+                if windows[0].get(k) != windows[1].get(k)}
+        return [f"identical windows produced different shard "
+                f"snapshots: {diff}"]
+    doubled = {n: 2 * v for n, v in windows[0].items()}
+    got = {n: merged["counters"].get(n, 0) for n in doubled}
+    if got != doubled:
+        diff = {k: (doubled[k], got[k]) for k in doubled
+                if doubled[k] != got.get(k)}
+        return [f"2-process merge != 2x the single-process window "
+                f"delta: {diff}"]
+    if windows[0].get("program_store.train_step.dispatches") != 3:
+        return ["merge worker window did not dispatch 3 compiled "
+                f"steps: {windows[0]}"]
+    return []
+
+
 def main(root: str = None) -> int:
     root = root or os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))
@@ -254,12 +429,16 @@ def main(root: str = None) -> int:
     from mxnet_tpu.parallel import sharding, spmd  # noqa: F401
 
     # the runtime checks run FIRST: they instantiate the per-instance
-    # counter families (kv_pool, decode.engine) the registry checks
-    # then see
+    # counter families (kv_pool, decode.engine, serving.router) the
+    # registry checks then see
     failures.extend(("deterministic steady-state snapshot", [m])
                     for m in check_deterministic_snapshot())
     failures.extend(("chrome-trace export", [m])
                     for m in check_chrome_trace())
+    failures.extend(("routed-request trace stamping", [m])
+                    for m in check_routed_trace_ids())
+    failures.extend(("two-process merge correctness", [m])
+                    for m in check_merge_correctness())
 
     registry = telemetry.registered()
     unregistered = check_registered(accessors, registry)
@@ -280,9 +459,12 @@ def main(root: str = None) -> int:
         return 1
     print(f"check_telemetry: {len(accessors)} accessors, "
           f"{len(registry)} registered counters, deterministic "
-          "steady-state delta, chrome trace >= 3 span categories")
+          "steady-state delta, chrome trace >= 3 span categories, "
+          "routed events trace-stamped, 2-process merge == 2x window")
     return 0
 
 
 if __name__ == "__main__":
+    if _MERGE_WORKER_FLAG in sys.argv:
+        sys.exit(_merge_worker())
     sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Fold a directory of per-process telemetry flight-recorder shards
+into ONE combined snapshot and ONE chrome trace (ISSUE 15).
+
+A drill forks children, bench workers fork subprocesses, and a
+multi-controller job runs one process per host — each writes an atomic
+``telemetry-r<rank>-p<pid>.jsonl`` shard under ``MXNET_TELEMETRY_DIR``
+(flushed by ``engine.waitall()`` and the preemption drain).  This tool
+is the thin CLI over ``mxnet_tpu.telemetry.merge`` /
+``merge_chrome_trace``:
+
+- cumulative/time counters SUM across processes;
+- gauges stay per-process (summing queue depth across ranks is a lie);
+- the chrome trace gets one lane per process, with requests that
+  crossed processes linked into one flow by ``trace_id``.
+
+``python -m mxnet_tpu.telemetry merge <dir>`` is the same fold with the
+report-table front end; this entry point writes artifacts for CI.
+
+Usage::
+
+    python tools/telemetry_merge.py <dir> [--out merged.json]
+                                          [--chrome trace.json] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", help="MXNET_TELEMETRY_DIR shard directory")
+    ap.add_argument("--out", default=None,
+                    help="write the merged snapshot JSON here")
+    ap.add_argument("--chrome", default=None,
+                    help="write the merged per-process chrome trace here")
+    ap.add_argument("--json", action="store_true", dest="emit_json",
+                    help="print the full merge to stdout as JSON")
+    a = ap.parse_args(argv)
+
+    from mxnet_tpu import telemetry
+
+    merged = telemetry.merge(a.dir)
+    if not merged["shards"]:
+        print(f"telemetry_merge: no telemetry-*.jsonl shards under "
+              f"{a.dir}", file=sys.stderr)
+        return 1
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(merged, f, default=str)
+    if a.chrome:
+        with open(a.chrome, "w") as f:
+            json.dump(telemetry.merge_chrome_trace(a.dir, merged), f)
+    if a.emit_json:
+        print(json.dumps(merged, default=str))
+    else:
+        print(f"telemetry_merge: {len(merged['shards'])} shard(s), "
+              f"{len(merged['counters'])} summed counters, "
+              f"{len(merged['events'])} events, "
+              f"{len(merged['spans'])} spans"
+              + (f", {merged['skipped_lines']} torn line(s) skipped"
+                 if merged["skipped_lines"] else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,8 +30,18 @@ stamping the availability columns — dropped (must be 0) / hedged /
 failed_over / breaker_transitions — next to the latency numbers.
 ``--storm`` prints the storm report standalone.
 
+``--shared-prefix`` is the ISSUE-16 lane: M users x ONE system prompt
+through the content-addressed prefix cache (``MXNET_PREFIX_CACHE``),
+run warm (cache on) and cold (knob off) over the same seeds, stamping
+``prefix_hit_rate`` (acceptance floor >= 0.9), prefill tokens/FLOPs
+saved, tokens/s/chip for both passes, and token-exactness vs the cold
+pass AND the eager oracle.  ``prefix_miss_blocks`` rides the lane dict
+so tools/check_perf_delta.py gates hit-rate regressions round over
+round.
+
 Usage: python benchmark/serving_latency.py [--json] [--serve-only]
-           [--decode-only] [--storm] [--requests N] [--threads T]
+           [--decode-only] [--storm] [--shared-prefix] [--requests N]
+           [--threads T]
 """
 import json
 import os
@@ -375,6 +385,130 @@ print(json.dumps(out))
 """
 
 
+_PREFIX_WORKER = r"""
+import json, os, sys, threading, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))) if "__file__" in dir() else "/root/repo")
+import numpy as onp
+import jax
+from mxnet_tpu import serving_decode as sd, telemetry
+
+USERS = int(os.environ.get("PREFIX_USERS", "16"))
+NEW = int(os.environ.get("PREFIX_NEW_TOKENS", "8"))
+
+def fast_model():
+    return sd.TinyCausalLM(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                           max_seq=64)
+
+model = fast_model(); params = model.init_params(0)
+rng = onp.random.RandomState(0)
+# the one shared system prompt: 32 tokens = 4 full page-8 blocks, so
+# USERS identical prompts prefill once and the rest full-hit.  Hit rate
+# over the storm = (USERS-1)*4 / (USERS*4) = 0.9375 for USERS=16 — the
+# >= 0.9 acceptance floor with margin, and deterministic.
+SYS = rng.randint(0, 128, size=32).tolist()
+
+def storm(knob):
+    '''One pass of the USERS-identical-prompt storm with the prefix
+    cache forced on/off; returns (outputs, wall_s, prefix counter
+    deltas, prefill dispatch count).'''
+    os.environ["MXNET_PREFIX_CACHE"] = knob
+    pool = sd.PagePool(pages=256, page=8)
+    eng = sd.GenerativeEngine(fast_model(), params=params, pool=pool,
+                              max_rows=max(8, USERS), name="px" + knob)
+    eng.warmup(max_len=16)
+    eng.generate(rng.randint(0, 128, size=5).tolist(), max_new_tokens=2)
+    base = telemetry.snapshot()
+    outs = {}
+    errs = []
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+    # primer: the one physical prefill the shared prompt should cost
+    outs[0] = eng.generate(list(SYS), max_new_tokens=NEW)
+    def fire(uid):
+        try:
+            out = eng.generate(list(SYS), max_new_tokens=NEW)
+            with lock:
+                outs[uid] = out
+        except BaseException as e:
+            errs.append(repr(e))
+    ths = [threading.Thread(target=fire, args=(u,))
+           for u in range(1, USERS)]
+    for t in ths: t.start()
+    for t in ths: t.join()
+    wall = time.perf_counter() - t0
+    if errs:
+        raise RuntimeError("; ".join(errs))
+    delta = telemetry.delta(base)
+    prefills = sum(int(v) for k, v in delta.items()
+                   if k.startswith("decode.engine")
+                   and k.endswith(".prefills"))
+    eng.close()
+    if pool.in_use():
+        raise RuntimeError(f"leaked {pool.in_use()} pages (knob={knob})")
+    bad = pool.audit()
+    if bad:
+        raise RuntimeError(f"pool audit failed (knob={knob}): {bad}")
+    px = {k.split(".", 1)[1]: int(v) for k, v in delta.items()
+          if k.startswith("prefix.")}
+    return [outs[u] for u in range(USERS)], wall, px, delta, prefills
+
+warm_outs, warm_wall, px, warm_delta, warm_prefills = storm("1")
+cold_outs, cold_wall, px_off, _cold_delta, cold_prefills = storm("0")
+if any(v for v in px_off.values()):
+    raise RuntimeError(f"prefix counters nonzero with the knob off: {px_off}")
+oracle = list(sd.eager_generate(model, params, list(SYS),
+                                max_new_tokens=NEW))
+token_exact = all(o == oracle for o in warm_outs) and \
+    all(o == oracle for o in cold_outs)
+
+hit = px.get("hit_blocks", 0)
+miss = px.get("miss_blocks", 0)
+hit_rate = hit / max(hit + miss, 1)
+page = 8
+# prefill work avoided: every hit block skips `page` prompt tokens of
+# prefill compute.  FLOPs estimated analytically from the model dims
+# (projections + MLP; attention's quadratic term excluded, so the
+# stamp is a floor).
+d = model.d_model
+flops_per_tok = model.n_layers * (8 * d * d + 4 * d * d)
+tokens_saved = hit * page
+chips = max(jax.device_count(), 1)
+lane = {
+    "metric": "prefix_shared_storm",
+    "platform": jax.default_backend(),
+    "users": USERS, "prompt_tokens": len(SYS), "new_tokens": NEW,
+    "prefix_hit_rate": round(hit_rate, 4),
+    "prefix_hit_blocks": hit, "prefix_miss_blocks": miss,
+    "prefix_cow_forks": px.get("cow_forks", 0),
+    "prefix_evictions": px.get("evictions", 0),
+    "prefill_tokens_saved": tokens_saved,
+    "prefill_flops_saved": tokens_saved * flops_per_tok,
+    "prefills_warm": warm_prefills, "prefills_cold": cold_prefills,
+    "warm_wall_s": round(warm_wall, 3), "cold_wall_s": round(cold_wall, 3),
+    "warm_tokens_s_per_chip": round(USERS * NEW / warm_wall / chips, 1),
+    "cold_tokens_s_per_chip": round(USERS * NEW / cold_wall / chips, 1),
+    "token_exact": token_exact,
+}
+telemetry.flush()   # flight-recorder shard for the lane's fleet merge
+lane["telemetry"] = {k: v for k, v in warm_delta.items() if v}
+print(json.dumps(lane))
+"""
+
+
+def run_shared_prefix(users: int = 16) -> dict:
+    env = dict(os.environ)
+    env["PREFIX_USERS"] = str(users)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+    r = subprocess.run([sys.executable, "-u", "-c", _PREFIX_WORKER],
+                       capture_output=True, text=True, timeout=900,
+                       env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))) or ".")
+    if r.returncode != 0:
+        raise RuntimeError(f"shared-prefix lane failed:\n{r.stderr[-2000:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def run_decode(requests: int = 16, concurrency: int = 8,
                storm: bool = True) -> dict:
     env = dict(os.environ)
@@ -465,6 +599,26 @@ def main_decode(storm_only: bool = False) -> None:
               f"p99 {r['p99_us']:.0f} us, {r['tokens_s']} tok/s")
 
 
+def main_prefix() -> None:
+    lane = run_shared_prefix()
+    if "--json" in sys.argv:
+        print(json.dumps({"prefix": lane}))
+        return
+    print(f"shared-prefix storm ({lane['platform']}, {lane['users']} users "
+          f"x one {lane['prompt_tokens']}-token system prompt)")
+    print(f"prefix hit rate {lane['prefix_hit_rate']:.3f} "
+          f"({lane['prefix_hit_blocks']}h/{lane['prefix_miss_blocks']}m "
+          f"blocks), {lane['prefix_cow_forks']} COW forks, "
+          f"{lane['prefix_evictions']} evictions")
+    print(f"prefills: warm {lane['prefills_warm']} vs cold "
+          f"{lane['prefills_cold']}; {lane['prefill_tokens_saved']} prompt "
+          f"tokens ({lane['prefill_flops_saved'] / 1e6:.1f} MFLOPs) of "
+          "prefill skipped")
+    print(f"throughput: warm {lane['warm_tokens_s_per_chip']} vs cold "
+          f"{lane['cold_tokens_s_per_chip']} tok/s/chip; token-exact "
+          f"vs cold + eager oracle: {lane['token_exact']}")
+
+
 if __name__ == "__main__":
     if "--serve-only" in sys.argv:
         # bench.py's lanes[] entry point: the one serving lane
@@ -476,6 +630,10 @@ if __name__ == "__main__":
         lane = run_decode()
         print(json.dumps({"decode": lane}) if "--json" in sys.argv
               else lane)
+    elif "--shared-prefix" in sys.argv:
+        # ISSUE-16 lane: M users x one system prompt through the
+        # content-addressed prefix cache, warm vs cold vs eager oracle
+        main_prefix()
     elif "--storm" in sys.argv:
         main_decode(storm_only=True)
     else:

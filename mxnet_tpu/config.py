@@ -486,6 +486,26 @@ declare("MXNET_SERVE_DECODE_ROWS", int, 8,
         "join/retire never retraces.  Also the continuous-batching "
         "concurrency ceiling per engine.",
         validator=lambda v: v >= 1, subsystem="serving", cached=False)
+declare("MXNET_PREFIX_CACHE", bool, True,
+        "Content-addressed KV prefix cache (serving_decode.PagePool): "
+        "pages are keyed by a rolling hash of their token block "
+        "(chain-hashed, so a block's key commits to its full prefix); "
+        "requests sharing a prompt reference ONE physical prefill "
+        "(refcounted, copy-on-write at divergence) and prefill only "
+        "the uncached suffix.  Unreferenced cached pages are kept and "
+        "evicted LRU under pool pressure — PagePoolExhausted only when "
+        "even eviction cannot help.  Off (0) = the pre-cache pool, "
+        "byte-for-byte: no hashing, no index, prefix.* counters stay "
+        "0.", subsystem="serving", cached=False)
+declare("MXNET_ROUTER_PREFIX_AFFINITY", float, 1.0,
+        "ReplicaRouter prefix-affinity weight: each leading page-block "
+        "of a request's prompt hash chain already resident in a "
+        "replica's KV pool lowers that replica's dispatch score by "
+        "this much (one unit == one queued request of load), so "
+        "shared-prefix traffic converges on the replica holding the "
+        "warm pages.  0 disables affinity; ignored when "
+        "MXNET_PREFIX_CACHE is off.",
+        validator=lambda v: v >= 0, subsystem="serving", cached=False)
 declare("MXNET_ROUTER_BREAKER_ERRS", int, 3,
         "ReplicaRouter circuit breaker: dispatch failures within the "
         "last MXNET_ROUTER_BREAKER_WINDOW outcomes that OPEN a "

@@ -42,7 +42,8 @@ or fails loudly:
   sheds, 0 KV pages leak, and a second process serves the shed
   requests token-exactly.
 - ``router_kill`` / ``router_wedge`` / ``router_flap`` /
-  ``router_deadline_storm`` (``ROUTER_SCENARIOS``, gated by
+  ``router_deadline_storm`` / ``router_prefix_storm``
+  (``ROUTER_SCENARIOS``, gated by
   ``tools/check_availability_budget.py``) — the SERVING chaos matrix
   over a 2-replica ``serving_router.ReplicaRouter``: a replica killed
   mid-decode (its compiled programs start raising; every in-flight and
@@ -52,9 +53,15 @@ or fails loudly:
   heartbeat wedge timeout evicts the replica inside
   ``MXNET_ROUTER_WEDGE_S``), a breaker flap (transient error burst
   opens the breaker; the half-open probe re-admits within the probe
-  budget), and a deadline storm (tight ``deadline_us`` budgets shed
+  budget), a deadline storm (tight ``deadline_us`` budgets shed
   typed ``deadline`` within bounded wall clock — never a hang — while
-  feasible budgets deliver token-exact).
+  feasible budgets deliver token-exact), and a shared-prefix storm
+  (ISSUE 16: every request shares one system prompt, so prefix
+  affinity converges the fleet on the replica holding the warm
+  hash-keyed pages — which is exactly the replica the drill then
+  kills; failover rebuilds the cache cold on the survivor,
+  token-exact, with the page-pool refcount audit clean at drain: 0
+  leaked, 0 double-freed, no index entry pointing at a dead page).
 - ``bitflip_param`` — the ISSUE-13 silent-corruption drill: the child
   flips one bit of ONE device's replica of a parameter mid-run; the
   sentinel's cross-replica digest vote localizes the device within one
@@ -102,7 +109,7 @@ SCENARIOS = ("sigterm_drain", "sigkill_between_saves", "topology_change",
 # the serving-availability matrix (tools/check_availability_budget.py);
 # kept OUT of SCENARIOS so the recovery gate's matrix is unchanged
 ROUTER_SCENARIOS = ("router_kill", "router_wedge", "router_flap",
-                    "router_deadline_storm")
+                    "router_deadline_storm", "router_prefix_storm")
 
 # the scripted workload every train drill shares
 N_STEPS = 24
@@ -508,6 +515,20 @@ def _router_prompt(r: int) -> List[int]:
     return [1 + (r * 5 + j) % 47 for j in range(4 + r % 4)]
 
 
+# the prefix-storm system prompt: 3 full page-blocks (page=8) every
+# storm request shares, so the fleet's prefill work should scale with
+# UNIQUE suffix bytes, not request count
+_STORM_SYS = [2 + (j * 11) % 43 for j in range(24)]
+
+
+def _storm_prompt(r: int) -> List[int]:
+    # every 3rd request is byte-identical (full hit); the rest diverge
+    # after the shared system prompt (partial hit + COW fork)
+    if r % 3 == 0:
+        return list(_STORM_SYS)
+    return _STORM_SYS + [5 + (r * 7 + j) % 41 for j in range(2 + r % 3)]
+
+
 def _cmd_router(a) -> int:
     import threading
 
@@ -532,6 +553,10 @@ def _cmd_router(a) -> int:
         wedge_s=(1.5 if a.mode == "wedge" else 30.0), hedge_pctl=0)
     if a.preempt:
         preemption.install()
+    # the prefix storm routes every request through ONE shared system
+    # prompt; the other modes keep their fully distinct prompts
+    prompt_of = (_storm_prompt if a.mode == "prefix_storm"
+                 else _router_prompt)
 
     records: Dict[int, Dict[str, Any]] = {}
     lock = threading.Lock()
@@ -541,7 +566,7 @@ def _cmd_router(a) -> int:
         rec: Dict[str, Any] = {
             "budget_s": deadline_us / 1e6 if deadline_us else None}
         try:
-            toks = router.generate(_router_prompt(rid),
+            toks = router.generate(prompt_of(rid),
                                    max_new_tokens=a.max_new,
                                    deadline_us=deadline_us)
             rec.update(status="delivered",
@@ -577,11 +602,12 @@ def _cmd_router(a) -> int:
             raise RuntimeError("replica 0 killed mid-decode")
 
     def apply_chaos() -> None:
-        if a.mode == "kill":
+        if a.mode in ("kill", "prefix_storm"):
             boom = _Boom()
             engines[0]._programs.insert(("decode",), boom)
-            for b in (1, 2, 4, 8):
+            for b in (1, 2, 4, 8, 16, 32):
                 engines[0]._programs.insert(("prefill", b), boom)
+                engines[0]._programs.insert(("prefill_chunk", b), boom)
         elif a.mode == "wedge":
             def wedged(*args, **kw):
                 time.sleep(120.0)
@@ -612,9 +638,13 @@ def _cmd_router(a) -> int:
                for rid in chaos_ids]
     for t in threads:
         t.start()
-    if a.mode == "kill":
+    if a.mode in ("kill", "prefix_storm"):
         # strike while replica 0 is actively decoding chaos rows: wait
-        # for its decode counter to move with live rows (bounded poll)
+        # for its decode counter to move with live rows (bounded poll).
+        # In the prefix storm replica 0 is ALSO the affinity target —
+        # it took the first steady request, published the shared
+        # prompt, and pulled the whole storm onto its warm pages — so
+        # this kill lands on the cache itself.
         d0 = engines[0]._stats["decode_steps"]
         deadline = time.monotonic() + 5.0
         while time.monotonic() < deadline:
@@ -682,12 +712,19 @@ def _cmd_router(a) -> int:
             continue
         if rid not in oracle_cache:
             oracle_cache[rid] = eager_generate(
-                model, params, _router_prompt(rid), a.max_new)
+                model, params, prompt_of(rid), a.max_new)
         if rec["tokens"] != oracle_cache[rid]:
             token_exact = False
             rec["oracle"] = oracle_cache[rid]
 
     st = router.stats()
+    # ISSUE-16 refcount audit at drain: every page accounted for
+    # exactly once (free, cached, or referenced), no index entry
+    # pointing at a dead page — 0 leaked AND 0 double-freed
+    pool_audit = [m for p in pools for m in p.audit()]
+    snap = telemetry.snapshot()
+    hit_blocks = int(snap.get("prefix.hit_blocks", 0))
+    miss_blocks = int(snap.get("prefix.miss_blocks", 0))
     telemetry.flush()       # shard == the snapshot this result records
     res = {
         "label": a.label, "mode": a.mode, "pid": os.getpid(),
@@ -700,6 +737,11 @@ def _cmd_router(a) -> int:
         "steady_p99_s": steady_p99_s,
         "re_admit_s": re_admit_s,
         "leaked_pages": sum(p.in_use() for p in pools),
+        "pool_audit": pool_audit,
+        "prefix_hit_blocks": hit_blocks,
+        "prefix_miss_blocks": miss_blocks,
+        "prefix_cow_forks": int(snap.get("prefix.cow_forks", 0)),
+        "prefix_hit_rate": hit_blocks / max(hit_blocks + miss_blocks, 1),
         "router": {k: v for k, v in st.items() if k != "replicas"},
         "breakers": [r["breaker"] for r in st["replicas"]],
         "drain_s": telemetry.snapshot().get("preemption.drain_s"),
@@ -1370,20 +1412,23 @@ def _check_child_shard(root: str, failures: List[str],
 def _drill_router(root: str, failures: List[str],
                   report: Dict[str, Any], mode: str) -> None:
     """One cell of the serving chaos matrix: a 2-replica router child
-    under {kill | wedge | flap | deadline_storm}.  The availability
-    contract every cell shares: 0 dropped requests (every submission
-    ends delivered or typed-shed), every delivery token-exact vs the
-    eager oracle, 0 leaked KV pages."""
+    under {kill | wedge | flap | deadline_storm | prefix_storm}.  The
+    availability contract every cell shares: 0 dropped requests (every
+    submission ends delivered or typed-shed), every delivery
+    token-exact vs the eager oracle, 0 leaked KV pages, and a clean
+    page-pool refcount audit at drain (ISSUE 16: no page leaked,
+    double-freed, or indexed while dead)."""
     scen = os.path.join(root, f"router-{mode}")
     os.makedirs(scen, exist_ok=True)
     argv = ["router", "--dir", scen, "--label", "c1", "--mode", mode,
             "--steady", "12", "--requests", "8", "--max-new", "10"]
-    if mode == "kill":
+    if mode in ("kill", "prefix_storm"):
         argv += ["--preempt"]
     c1 = _run_child(argv, _child_env(root, 1))
     res = _read_result(scen, "c1") or {}
     report["exit_code_c1"] = c1.returncode
-    want_code = (res.get("preempted_code") or 83) if mode == "kill" else 0
+    want_code = ((res.get("preempted_code") or 83)
+                 if mode in ("kill", "prefix_storm") else 0)
     if c1.returncode != want_code:
         failures.append(
             f"router[{mode}] child exited {c1.returncode}, wanted "
@@ -1415,6 +1460,10 @@ def _drill_router(root: str, failures: List[str],
         failures.append(
             f"router[{mode}] leaked {res['leaked_pages']} KV pages")
     report["leaked_pages"] = res.get("leaked_pages")
+    if res.get("pool_audit"):
+        failures.append(
+            f"router[{mode}] page-pool refcount audit failed at drain: "
+            f"{res['pool_audit']}")
     rt = res.get("router") or {}
     # ISSUE-15 fleet aggregation: the child flushed an atomic telemetry
     # shard; merging it back must reproduce the failover/shed/delivered
@@ -1475,6 +1524,36 @@ def _drill_router(root: str, failures: List[str],
                             "(half-open probe re-admission broken)")
         if res.get("re_admit_s") is None:
             failures.append("router[flap] re-admission never observed")
+    elif mode == "prefix_storm":
+        # ISSUE 16: shared-prefix storm + replica kill.  The affinity
+        # weight converged the storm onto replica 0's warm cache, so the
+        # kill lands on exactly the replica holding the shared pages —
+        # failover must rebuild the prefix cold on replica 1 with zero
+        # refcount damage.
+        if not rt.get("failovers"):
+            failures.append("router[prefix_storm] counted no failovers — "
+                            "the warm replica's requests were not "
+                            "re-routed after the kill")
+        if not rt.get("breaker_opens"):
+            failures.append("router[prefix_storm] never opened the dead "
+                            "replica's breaker")
+        if not res.get("prefix_hit_blocks"):
+            failures.append(
+                "router[prefix_storm] counted 0 prefix.hit_blocks — the "
+                "shared system prompt never hit the content-addressed "
+                "cache (affinity or publish broken)")
+        drain_recs = [records[r] for r in (res.get("drain_ids") or [])
+                      if r in records]
+        bad = [v for v in drain_recs
+               if v["status"] == "shed" and v.get("kind") != "draining"]
+        if bad:
+            failures.append(
+                f"router[prefix_storm] drain-phase sheds were not typed "
+                f"'draining': {bad}")
+        report["prefix_hit_blocks"] = res.get("prefix_hit_blocks")
+        report["prefix_miss_blocks"] = res.get("prefix_miss_blocks")
+        report["prefix_hit_rate"] = res.get("prefix_hit_rate")
+        report["prefix_cow_forks"] = res.get("prefix_cow_forks")
     elif mode == "deadline_storm":
         for r, v in sorted(records.items()):
             b = v.get("budget_s")
@@ -1546,7 +1625,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ro.add_argument("--dir", required=True)
     ro.add_argument("--label", default="c1")
     ro.add_argument("--mode", default="kill",
-                    choices=("kill", "wedge", "flap", "deadline_storm"))
+                    choices=("kill", "wedge", "flap", "deadline_storm",
+                             "prefix_storm"))
     ro.add_argument("--steady", type=int, default=12)
     ro.add_argument("--requests", type=int, default=8)
     ro.add_argument("--max-new", type=int, default=10, dest="max_new")

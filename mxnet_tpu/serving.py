@@ -48,10 +48,14 @@ blowing the PR-3 program cache too (see ``cached_step.py`` /
 ``gluon/block.py``).
 
 This module serves ONE-SHOT inference (a request is one forward).
-Autoregressive GENERATION — continuous batching, the paged KV-cache,
-and multi-model SLO-aware admission — lives in its sibling
+Autoregressive GENERATION — continuous batching, the paged KV-cache
+with its content-addressed prefix cache (``MXNET_PREFIX_CACHE``:
+hash-keyed copy-on-write pages so shared prompts prefill once), and
+multi-model SLO-aware admission — lives in its sibling
 ``serving_decode.py``, which generalizes :class:`BucketPolicy` along
-the sequence axis for its prefill program grid.
+the sequence axis for its prefill program grid.  One-shot inference
+has no KV state, so nothing here content-addresses; the bucket grid
+below is the part the two stacks share.
 """
 from __future__ import annotations
 

@@ -47,6 +47,23 @@ built on three ideas:
    token-exact).  Per-model p50/p99, SLO-violation, shed, and preempt
    counters land in :meth:`GenerativeEngine.stats`.
 
+4. **Content-addressed prefix cache** (``MXNET_PREFIX_CACHE``, default
+   on): every prompt page is keyed by a rolling hash of its token
+   block, chain-hashed so a block's key commits to its FULL prefix.
+   N requests sharing a prompt reference one physical prefill —
+   pages are refcounted, admission looks the chain up and prefills
+   only the uncached suffix (one dispatch from the first miss block;
+   the page table already gathers by index, so decode is untouched) —
+   and fork copy-on-write at the first divergent KV write.  Pages
+   whose refcount drops to zero stay resident as an LRU cache;
+   ``alloc`` evicts them under pressure and raises
+   :class:`PagePoolExhausted` only when even eviction cannot help.
+   Whether a prefix is worth hashing at all is a cost-table decision
+   (measured probe EMA vs the measured per-block prefill EMA — the
+   arXiv:2008.01040 move again).  Counters: ``prefix.hit_blocks`` /
+   ``prefix.miss_blocks`` / ``prefix.cow_forks`` /
+   ``prefix.evictions``; hit rate rides the prefill trace events.
+
 The dispatch-budget gate (``tools/check_dispatch_budget.py`` ``decode``
 lane) pins the contract: live programs == prefill buckets + 1, 0
 retraces and 1 dispatch per decode iteration across a join/retire
@@ -54,11 +71,12 @@ storm, 0 leaked pages after drain.
 """
 from __future__ import annotations
 
+import hashlib
 import heapq
 import math
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -133,6 +151,38 @@ class _DispatchGate:
 
 
 # ---------------------------------------------------------------------------
+# Content-addressed prefix cache (hash-chained page keys)
+# ---------------------------------------------------------------------------
+# process-global counters (family 'prefix'): sharing is a cross-pool
+# property of the workload, so unlike the per-instance kv_pool group
+# these are NOT instance-numbered — telemetry.merge sums them across
+# the fleet and the perf gate diffs them by exact name
+_PREFIX_STATS = _telemetry.CounterGroup(
+    "prefix", ("hit_blocks", "miss_blocks", "cow_forks", "evictions"),
+    doc="content-addressed KV prefix cache (MXNET_PREFIX_CACHE)",
+    family="prefix")
+
+
+def _chain_keys(tokens: Sequence[int], page: int,
+                geom: Tuple) -> List[bytes]:
+    """Rolling content keys, one per ``page``-token block of
+    ``tokens`` (the last block may be partial).  Key ``i`` is
+    ``blake2b(key[i-1] || block_i)`` seeded with the KV geometry, so a
+    key commits to the ENTIRE token prefix through its block AND to the
+    storage layout — equal keys imply byte-equal cached KV, across
+    models only when their geometry genuinely matches."""
+    prev = repr((geom, page)).encode()
+    keys: List[bytes] = []
+    for i in range(0, len(tokens), page):
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(onp.asarray(tokens[i:i + page], onp.int64)  # graftlint: disable=host-sync -- hashing Python token ids host-side; no device buffer is read
+                 .tobytes())
+        prev = h.digest()
+        keys.append(prev)
+    return keys
+
+
+# ---------------------------------------------------------------------------
 # Paged KV-cache pool
 # ---------------------------------------------------------------------------
 class PagePool:
@@ -167,6 +217,15 @@ class PagePool:
         # LIFO free list: a just-freed (hot-in-HBM) page is reused first
         self._free: List[int] = list(range(self.pages - 1, -1, -1))
         self._in_use: set = set()
+        # content-addressed prefix cache (MXNET_PREFIX_CACHE): pages are
+        # refcounted; a page whose refcount drops to 0 while it still
+        # holds published (chain-keyed) content parks in ``_lru``
+        # instead of the free list — resident cache, reclaimed
+        # oldest-first by ``alloc`` under pressure
+        self._refs: Dict[int, int] = {}
+        self._index: Dict[Tuple, Dict[bytes, int]] = {}  # geom -> key -> page
+        self._page_key: Dict[int, Tuple[Tuple, bytes]] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
         self._lock = threading.Lock()
         self._storage: Dict[Tuple, List] = {}        # geom -> [k, v]
         self._geom_locks: Dict[Tuple, threading.RLock] = {}
@@ -200,48 +259,220 @@ class PagePool:
         return self.pages
 
     # -- accounting --------------------------------------------------------
+    # Accounting is by REFERENCE: ``alloc`` and a prefix-cache hit both
+    # acquire one reference per page (counted 'alloc'); ``free``
+    # releases one (counted 'free'), so alloc_count - free_count ==
+    # live references even when pages are shared.
+    def _evict_locked(self, n: int) -> None:
+        """Reclaim ``n`` cached-but-unreferenced pages (oldest first)
+        onto the free list.  Caller holds ``_lock`` and has checked
+        ``len(self._lru) >= n``.  Only LRU residents are ever evicted —
+        a referenced page (refcount >= 1) is never reclaimed."""
+        for _ in range(n):
+            p, _ = self._lru.popitem(last=False)
+            geom, key = self._page_key.pop(p)
+            self._index[geom].pop(key, None)
+            self._free.append(p)
+            _PREFIX_STATS.inc("evictions")
+
     def alloc(self, n: int) -> List[int]:
         with self._lock:
-            if n > len(self._free):
+            short = n - len(self._free)
+            if short > len(self._lru):
                 self._counts.inc("exhausted")
                 raise PagePoolExhausted(
                     f"KV page pool exhausted: need {n} page(s), "
-                    f"{len(self._free)} free of {self.pages} "
+                    f"{len(self._free)} free + {len(self._lru)} "
+                    f"evictable of {self.pages} "
                     f"(page={self.page} tokens)")
+            if short > 0:
+                self._evict_locked(short)
             got = [self._free.pop() for _ in range(n)]
             self._in_use.update(got)
+            for p in got:
+                self._refs[p] = 1
             self._counts.inc("alloc", n)
             self.high_water = max(self.high_water, len(self._in_use))
             return got
 
     def free(self, pages: Sequence[int]) -> None:
+        """Release one REFERENCE per page.  A page still shared stays
+        in use; an unreferenced page returns to the free list — unless
+        it holds published prefix content, in which case it parks in
+        the resident LRU cache (still reclaimable, never leaked:
+        ``in_use()`` counts references only)."""
         with self._lock:
             for p in pages:
                 if p not in self._in_use:
                     raise ValueError(
                         f"double/foreign free of page {p} (in_use="
                         f"{len(self._in_use)})")
-                self._in_use.discard(p)
-                self._free.append(p)
                 self._counts.inc("free")
+                self._refs[p] -= 1
+                if self._refs[p] > 0:
+                    continue
+                del self._refs[p]
+                self._in_use.discard(p)
+                if p in self._page_key:
+                    self._lru[p] = None     # newest at the MRU end
+                else:
+                    self._free.append(p)
 
     def in_use(self) -> int:
         with self._lock:
             return len(self._in_use)
 
     def free_pages(self) -> int:
+        """Allocatable pages: truly free plus cached-but-unreferenced
+        (one eviction away from free) — the number ``alloc`` can
+        satisfy without preempting anyone."""
         with self._lock:
-            return len(self._free)
+            return len(self._free) + len(self._lru)
+
+    def ref(self, p: int) -> int:
+        """Current reference count of page ``p`` (0 = free or cached)."""
+        with self._lock:
+            return self._refs.get(p, 0)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {"pages": self.pages, "page": self.page,
                     "in_use": len(self._in_use),
                     "free": len(self._free),
+                    "cached": len(self._lru),
                     "alloc_count": self.alloc_count,
                     "free_count": self.free_count,
                     "exhausted_count": self.exhausted_count,
                     "high_water": self.high_water}
+
+    # -- content-addressed prefix cache ------------------------------------
+    def lookup(self, geom: Tuple, keys: Sequence[bytes]) -> List[int]:
+        """Longest cached prefix of the hash chain ``keys``: walks the
+        chain in order, ACQUIRES one reference per hit page (an LRU
+        resident revives to refcount 1), and stops at the first miss.
+        Returns the hit pages in chain order; counts hit/miss blocks."""
+        hits: List[int] = []
+        with self._lock:
+            idx = self._index.get(geom, {})
+            for key in keys:
+                p = idx.get(key)
+                if p is None:
+                    break
+                if p in self._in_use:
+                    self._refs[p] += 1
+                else:
+                    self._lru.pop(p)
+                    self._in_use.add(p)
+                    self._refs[p] = 1
+                self._counts.inc("alloc")
+                self.high_water = max(self.high_water,
+                                      len(self._in_use))
+                hits.append(p)
+        _PREFIX_STATS.inc("hit_blocks", len(hits))
+        _PREFIX_STATS.inc("miss_blocks", len(keys) - len(hits))
+        return hits
+
+    def publish(self, geom: Tuple,
+                entries: Sequence[Tuple[bytes, int]]) -> None:
+        """Register freshly-prefilled pages under their chain keys.
+        First writer wins: a key already mapping to a live page keeps
+        its mapping and the duplicate page simply stays private (it
+        frees normally, it just can never be hit)."""
+        with self._lock:
+            idx = self._index.setdefault(geom, {})
+            for key, p in entries:
+                if key in idx or p in self._page_key:
+                    continue
+                if p not in self._in_use:
+                    raise ValueError(
+                        f"publish of page {p} which is not in use")
+                idx[key] = p
+                self._page_key[p] = (geom, key)
+
+    def holds(self, geom: Tuple, keys: Sequence[bytes]) -> int:
+        """Router affinity probe: how many LEADING blocks of the chain
+        are resident (referenced or cached).  No reference bump, no
+        recency update, no device work."""
+        with self._lock:
+            idx = self._index.get(geom)
+            if not idx:
+                return 0
+            n = 0
+            for key in keys:
+                if key not in idx:
+                    break
+                n += 1
+            return n
+
+    def shared(self, p: int) -> bool:
+        """True when writing page ``p`` needs a copy-on-write fork
+        first: another row also references it, or it is published
+        content a future lookup may still hit.  Content-addressed
+        pages are IMMUTABLE — a row never scatters into a page anyone
+        else can read."""
+        with self._lock:
+            return self._refs.get(p, 0) > 1 or p in self._page_key
+
+    def fork(self, geom: Tuple, p: int) -> int:
+        """Copy-on-write: allocate a private copy of shared page ``p``
+        (device-side K/V copy under the geometry's exclusive lock),
+        release this caller's reference on ``p``, and return the new
+        page id.  May evict / raise :class:`PagePoolExhausted` exactly
+        like ``alloc``."""
+        new = self.alloc(1)[0]
+        with self.exclusive(geom):
+            k, v = self._storage[geom]
+            self._storage[geom] = [k.at[new].set(k[p]),
+                                   v.at[new].set(v[p])]
+        self.free([p])
+        _PREFIX_STATS.inc("cow_forks")
+        return new
+
+    def clear_prefix_cache(self) -> int:
+        """Drop every cached-but-unreferenced page back to the free
+        list and unpublish all content keys (cold-cache A/B runs, test
+        isolation).  Live pages keep their references; they just stop
+        being discoverable.  Returns pages reclaimed."""
+        with self._lock:
+            reclaimed = len(self._lru)
+            for p in self._lru:
+                self._free.append(p)
+            self._lru.clear()
+            self._index.clear()
+            self._page_key.clear()
+            return reclaimed
+
+    def audit(self) -> List[str]:
+        """Refcount/bookkeeping invariant check (drills run it at
+        drain): returns violation strings, [] when sound."""
+        bad: List[str] = []
+        with self._lock:
+            if set(self._refs) != self._in_use:
+                bad.append(f"refs/in_use mismatch: {sorted(self._refs)}"
+                           f" vs {sorted(self._in_use)}")
+            for p, r in self._refs.items():
+                if r < 1:
+                    bad.append(f"page {p} in use with refcount {r}")
+            free, lru = set(self._free), set(self._lru)
+            if free & lru:
+                bad.append(f"pages both free and cached: {free & lru}")
+            if free & self._in_use or lru & self._in_use:
+                bad.append("pages both free/cached and in use: "
+                           f"{(free | lru) & self._in_use}")
+            total = len(self._free) + len(self._lru) + len(self._in_use)
+            if total != self.pages:
+                bad.append(f"page conservation broke: {len(self._free)}"
+                           f" free + {len(self._lru)} cached + "
+                           f"{len(self._in_use)} in use != {self.pages}")
+            for geom, idx in self._index.items():
+                for key, p in idx.items():
+                    if self._page_key.get(p) != (geom, key):
+                        bad.append(f"index key {key.hex()} -> page {p} "
+                                   "lacks its reverse mapping")
+                    if p not in self._in_use and p not in lru:
+                        bad.append(f"index key {key.hex()} -> page {p} "
+                                   "which is neither live nor cached")
+        return bad
 
     # -- storage -----------------------------------------------------------
     def register(self, n_layers: int, n_heads: int, head_dim: int,
@@ -352,6 +583,22 @@ class DecodeModel:
     def decode(self, params, tokens, k_ctx, v_ctx, lengths):
         raise NotImplementedError
 
+    #: OPTIONAL third entry point enabling partial ("suffix") prefill
+    #: for the content-addressed prefix cache — ``None`` means the
+    #: engine recomputes the whole prompt on a partial hit (correct,
+    #: just no savings).  Signature ``prefill_chunk(params, tokens,
+    #: k_ctx, v_ctx, offset, length) -> (logits, k, v)``: ``tokens``
+    #: ``(B,)`` int32 is the uncached suffix padded to a bucket, at
+    #: global positions ``offset .. offset+B-1``; ``k_ctx``/``v_ctx``
+    #: ``(L, C, H, D)`` is the paged cache where context position ``j``
+    #: is valid iff ``j < offset``; ``length`` is the FULL sequence
+    #: length.  Returns next-token ``logits`` ``(vocab,)`` at position
+    #: ``length - 1`` plus the suffix cache ``k``/``v`` ``(L, B, H,
+    #: D)``.  Exactness contract: identical to the same positions of a
+    #: full ``prefill`` over the whole sequence (incremental attention
+    #: again — that is what makes a cache hit token-exact).
+    prefill_chunk = None
+
 
 class TinyCausalLM(DecodeModel):
     """Reference :class:`DecodeModel`: a small pre-LN-free causal
@@ -455,6 +702,40 @@ class TinyCausalLM(DecodeModel):
             h = h + jax.nn.relu(h @ lp["w1"]) @ lp["w2"]
         logits = h @ params["out"]                           # (R, vocab)
         return logits, jnp.stack(k_news), jnp.stack(v_news)
+
+    def prefill_chunk(self, params, tokens, k_ctx, v_ctx, offset,
+                      length):
+        b = tokens.shape[0]
+        c = k_ctx.shape[1]
+        pos = offset + jnp.arange(b)
+        h = params["emb"][tokens] \
+            + params["pos"][jnp.minimum(pos, self.max_seq - 1)]
+        # cached context: every suffix token attends positions < offset
+        ctx_valid = jnp.broadcast_to(
+            jnp.arange(c)[None, :] < offset, (b, c))
+        # in-chunk: causal, and pad keys (global pos >= length) masked
+        ii = jnp.arange(b)
+        chunk_valid = (ii[None, :] <= ii[:, None]) \
+            & (ii[None, :] < length - offset)
+        valid = jnp.concatenate([ctx_valid, chunk_valid], axis=1)
+        ks, vs = [], []
+        for li, lp in enumerate(params["layers"]):
+            q = self._heads(h @ lp["wq"])                    # (B, H, D)
+            k_new = self._heads(h @ lp["wk"])
+            v_new = self._heads(h @ lp["wv"])
+            ks.append(k_new)
+            vs.append(v_new)
+            k = jnp.concatenate([k_ctx[li], k_new], axis=0)  # (C+B,H,D)
+            v = jnp.concatenate([v_ctx[li], v_new], axis=0)
+            scores = jnp.einsum("ihd,jhd->ihj", q, k) \
+                / math.sqrt(self.head_dim)
+            scores = jnp.where(valid[:, None, :], scores, -1e30)
+            w = jax.nn.softmax(scores, axis=-1)
+            att = jnp.einsum("ihj,jhd->ihd", w, v)           # (B, H, D)
+            h = h + att.reshape(b, self.d_model) @ lp["wo"]
+            h = h + jax.nn.relu(h @ lp["w1"]) @ lp["w2"]
+        logits = h[length - offset - 1] @ params["out"]      # (vocab,)
+        return logits, jnp.stack(ks), jnp.stack(vs)          # (L,B,H,D)
 
 
 def eager_generate(model: DecodeModel, params, prompt: Sequence[int],
@@ -961,42 +1242,147 @@ class GenerativeEngine:
         with _telemetry.trace_scope(trace_id=req.trace_id):
             self._prefill_traced(req)
 
+    def _prefix_on(self) -> bool:
+        return bool(_config.get("MXNET_PREFIX_CACHE"))
+
+    def _prefix_min_blocks(self) -> int:
+        """Cost-table floor for content addressing: only prompts
+        spanning at least this many page-blocks are hashed, probed,
+        and published.  Priced from measured EMAs — the per-block probe
+        cost must undercut the per-block prefill compute a hit saves;
+        unmeasured tables price the floor at 1, so caching starts on
+        and the table only ever RAISES the bar."""
+        probe = self._cost.get(("prefix", "probe"), 0.0)
+        saved = self._cost.get(("prefix", "block"), 0.0)
+        if probe <= 0.0 or saved <= 0.0:
+            return 1
+        return max(1, int(math.ceil(probe / saved)))
+
+    def _prefix_lookup(self, prompt: List[int]
+                       ) -> Tuple[List[bytes], List[int]]:
+        """Hash the prompt's block chain and ACQUIRE the longest cached
+        prefix.  Returns ``(keys, hit_pages)`` — both empty when the
+        knob is off or the prompt is under the cost-table floor (the
+        off path never hashes: zero overhead)."""
+        if not self._prefix_on():
+            return [], []
+        t0 = time.perf_counter()
+        keys = _chain_keys(prompt, self._pool.page, self._geom)
+        if len(keys) < self._prefix_min_blocks():
+            return [], []
+        hits = self._pool.lookup(self._geom, keys)
+        self._ema(("prefix", "probe"),
+                  (time.perf_counter() - t0) / len(keys))
+        return keys, hits
+
+    def prefix_probe(self, prompt: Sequence[int]) -> int:
+        """How many LEADING page-blocks of ``prompt``'s hash chain are
+        resident in this engine's pool — the router's prefix-affinity
+        signal.  No reference bump, no device work, 0 when the cache
+        is off."""
+        if not self._prefix_on():
+            return 0
+        toks = [int(t) for t in prompt]
+        return self._pool.holds(
+            self._geom, _chain_keys(toks, self._pool.page, self._geom))
+
     def _prefill_traced(self, req: _GenRequest) -> None:
         prompt = req.prompt + req.out     # re-grown after preemption
         n = len(prompt)
-        bucket = self._policy.bucket(n)
+        page = self._pool.page
+        keys, hits = self._prefix_lookup(prompt)
+        blocks = len(keys)
+        if hits and min(len(hits) * page, n) >= n:
+            # FULL hit: every block (incl. the partial tail) resident —
+            # ZERO prefill dispatch.  Rewind one position and let the
+            # ordinary decode step recompute the last prompt token's
+            # logits (the KV-exactness contract makes that token-exact
+            # with a fresh prefill); the write position lands in a
+            # shared page, so _ensure_page COW-forks before the step.
+            _telemetry.event("prefix_hit", self.name,
+                             hit_blocks=blocks, blocks=blocks,
+                             hit_rate=1.0, tokens=n)
+            if req.joined is None:
+                req.joined = self._joined
+                self._joined += 1
+            row = _Row(req, hits, cached=n - 1, pending=prompt[-1],
+                       joined=req.joined)
+            if self._done(row):
+                self._deliver(row)
+            else:
+                self._live.append(row)
+            return
+        if hits and self._model.prefill_chunk is None:
+            # no partial-prefill entry point on this model: release the
+            # hit references and recompute the whole prompt (correct,
+            # just no savings)
+            self._pool.free(hits)
+            hits = []
+        cached = len(hits) * page   # page-aligned: only the final
+        m = n - cached              # block is ever partial, and a
+                                    # partial-tail hit is a FULL hit
+        bucket = self._policy.bucket(m)
         if bucket is None:                # above the largest bucket
             self._stats.inc("bucket_fallbacks")
-            bucket = n
+            bucket = m
         # the position table only spans max_seq (generate() already
         # bounds n itself)
         bucket = min(bucket, int(self._model.max_seq))
-        pages = self._pool.alloc(-(-n // self._pool.page))
         try:
-            rec = self._prefill_program(bucket)
+            fresh = self._pool.alloc(-(-n // page) - len(hits))
+        except BaseException:
+            if hits:
+                self._pool.free(hits)    # lookup references NEVER leak
+            raise
+        pages = hits + fresh
+        try:
             tokens = onp.zeros((bucket,), onp.int32)
-            tokens[:n] = prompt
+            tokens[:m] = prompt[cached:]
             table = onp.full((self._max_pages,), self._pool.trash,
                              onp.int32)
             table[:len(pages)] = pages
+            span_args: Dict[str, Any] = {"model": self.name,
+                                         "bucket": bucket, "tokens": n}
+            if keys:
+                span_args.update(
+                    hit_blocks=len(hits), blocks=blocks,
+                    hit_rate=len(hits) / max(blocks, 1))
             t0 = time.perf_counter()
             with _telemetry.span("decode.prefill", cat="decode",
-                                 args={"model": self.name,
-                                       "bucket": bucket, "tokens": n}):
+                                 args=span_args):
                 self._pool.gate.acquire(self._priority)
                 try:
                     with self._pool.exclusive(self._geom):
                         k, v = self._pool.storage(self._geom)
-                        first, k, v = rec(self._params,
-                                          jnp.asarray(tokens),
-                                          jnp.int32(n),
-                                          jnp.asarray(table), k, v)
+                        if hits:
+                            # suffix-only dispatch: the cached prefix
+                            # rides in via the page-table gather
+                            rec = self._chunk_program(bucket)
+                            first, k, v = rec(self._params,
+                                              jnp.asarray(tokens),
+                                              jnp.int32(cached),
+                                              jnp.int32(n),
+                                              jnp.asarray(table), k, v)
+                        else:
+                            rec = self._prefill_program(bucket)
+                            first, k, v = rec(self._params,
+                                              jnp.asarray(tokens),
+                                              jnp.int32(n),
+                                              jnp.asarray(table), k, v)
                         first = int(first)    # host read = real cost
                         self._pool.set_storage(self._geom, k, v)
                 finally:
                     self._pool.gate.release()
-            self._ema(("prefill", bucket), time.perf_counter() - t0)
+            secs = time.perf_counter() - t0
+            self._ema(("prefill", bucket), secs)
+            # per-block prefill price == what one cached block saves
+            # (feeds the _prefix_min_blocks floor)
+            self._ema(("prefix", "block"), secs * page / max(m, 1))
             self._stats.inc("prefills")
+            if keys:
+                self._pool.publish(
+                    self._geom, [(keys[i], pages[i])
+                                 for i in range(len(hits), blocks)])
         except BaseException:
             self._pool.free(pages)
             raise
@@ -1038,6 +1424,55 @@ class GenerativeEngine:
         rec = _pstore.build("serving_decode", jitted, args,
                             label=f"{self.name}[prefill b={bucket}]")
         self._programs.insert(("prefill", bucket), rec)
+        return rec
+
+    def _chunk_program(self, bucket: int):
+        rec = self._programs.lookup(("prefill_chunk", bucket))
+        if rec is not None:
+            return rec
+        return self._build_prefill_chunk(bucket)
+
+    def _build_prefill_chunk(self, bucket: int):
+        """Suffix ("chunk") prefill program, one per bucket of the
+        SUFFIX length: gathers the cached prefix context through the
+        page table (exactly the decode gather), runs the model's
+        ``prefill_chunk``, and scatters only the suffix KV.  Compiled
+        lazily on the first partial hit — warmup's program census and
+        the dispatch-budget gate's cold-path counts stay untouched."""
+        model, pool, page = self._model, self._pool, self._pool.page
+        trash = pool.trash
+        max_pages = self._max_pages
+
+        def prefill_chunk_fn(params, tokens, offset, length, table,
+                             k_pool, v_pool):
+            _pstore.count_trace("serving_decode")
+            # page-table gather: (P, page, L, H, D) -> (L, C, H, D)
+            k_ctx = k_pool[table].reshape(
+                max_pages * page, model.n_layers, model.n_heads,
+                model.head_dim).transpose(1, 0, 2, 3)
+            v_ctx = v_pool[table].reshape(
+                max_pages * page, model.n_layers, model.n_heads,
+                model.head_dim).transpose(1, 0, 2, 3)
+            logits, k, v = model.prefill_chunk(
+                params, tokens, k_ctx, v_ctx, offset, length)
+            pos = offset + jnp.arange(bucket)
+            valid = pos < length
+            # bucket padding can point past the table — clamp, then
+            # mask to the trash page
+            pidx = jnp.where(
+                valid, table[jnp.minimum(pos // page, max_pages - 1)],
+                trash)
+            slot = pos % page
+            k_pool = k_pool.at[pidx, slot].set(k.transpose(1, 0, 2, 3))
+            v_pool = v_pool.at[pidx, slot].set(v.transpose(1, 0, 2, 3))
+            return jnp.argmax(logits).astype(jnp.int32), k_pool, v_pool
+
+        jitted = jax.jit(prefill_chunk_fn,
+                         donate_argnums=self._chunk_donate)
+        rec = _pstore.build(
+            "serving_decode", jitted, self._chunk_specs(bucket),
+            label=f"{self.name}[prefill_chunk b={bucket}]")
+        self._programs.insert(("prefill_chunk", bucket), rec)
         return rec
 
     # -- decode -------------------------------------------------------------
@@ -1100,15 +1535,29 @@ class GenerativeEngine:
 
     def _ensure_page(self, row: _Row) -> None:
         """The incoming token writes KV at position ``row.cached`` —
-        allocate its page if that position opens a new one.  Exhaustion
-        preempts the YOUNGEST other live sequence (vLLM-style recompute
-        preemption: pages freed, request re-queued at the head; greedy
-        decode makes the recomputed continuation token-exact)."""
+        allocate its page if that position opens a new one, and
+        copy-on-write-fork it first when it is shared or published
+        (content-addressed pages are immutable; the fork point IS the
+        divergence point between requests sharing a prefix).
+        Exhaustion preempts the YOUNGEST other live sequence
+        (vLLM-style recompute preemption: pages freed, request
+        re-queued at the head; greedy decode makes the recomputed
+        continuation token-exact)."""
         if row.cached < len(row.pages) * self._pool.page:
-            return
+            i = row.cached // self._pool.page
+            if not self._pool.shared(row.pages[i]):
+                return
+
+            def grow() -> None:
+                row.pages[i] = self._pool.fork(self._geom,
+                                               row.pages[i])
+        else:
+
+            def grow() -> None:
+                row.pages.extend(self._pool.alloc(1))
         while True:
             try:
-                row.pages.extend(self._pool.alloc(1))
+                grow()
                 return
             except PagePoolExhausted as e:
                 victims = [x for x in self._live if x is not row]
@@ -1204,6 +1653,12 @@ class GenerativeEngine:
         # cached_step idiom)
         return (4, 5) if jax.default_backend() != "cpu" else ()
 
+    @property
+    def _chunk_donate(self) -> Tuple[int, ...]:
+        # chunk prefill carries (offset, length): pool buffers sit one
+        # argument later
+        return (5, 6) if jax.default_backend() != "cpu" else ()
+
     def _pool_specs(self):
         k, v = self._pool.storage(self._geom)
         return (jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -1219,6 +1674,15 @@ class GenerativeEngine:
         return (self._param_specs(),
                 jax.ShapeDtypeStruct((bucket,), jnp.int32),
                 jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((self._max_pages,), jnp.int32),
+                kspec, vspec)
+
+    def _chunk_specs(self, bucket: int):
+        kspec, vspec = self._pool_specs()
+        return (self._param_specs(),
+                jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),      # offset
+                jax.ShapeDtypeStruct((), jnp.int32),      # length
                 jax.ShapeDtypeStruct((self._max_pages,), jnp.int32),
                 kspec, vspec)
 

@@ -221,15 +221,17 @@ class _Dispatch:
 
 
 class _RouterRequest:
-    __slots__ = ("fn", "until", "label", "eager_fn", "failed", "cv",
-                 "hedged", "attempt", "t0", "trace_id")
+    __slots__ = ("fn", "until", "label", "eager_fn", "prompt", "failed",
+                 "cv", "hedged", "attempt", "t0", "trace_id")
 
     def __init__(self, fn, until: Optional[float], label: str,
-                 eager_fn: Optional[Callable]):
+                 eager_fn: Optional[Callable],
+                 prompt: Optional[List[int]] = None):
         self.fn = fn                  # fn(engine) -> result
         self.until = until            # absolute monotonic expiry
         self.label = label
         self.eager_fn = eager_fn
+        self.prompt = prompt          # token ids, for prefix affinity
         self.failed: Set[int] = set() # replica indices that failed it
         self.cv = threading.Condition()
         self.hedged = False
@@ -355,7 +357,8 @@ class ReplicaRouter:
             lambda eng: eng.generate(prompt,
                                      max_new_tokens=max_new_tokens,
                                      eos=eos),
-            deadline_us, "generate", eager_fn=eager)
+            deadline_us, "generate", eager_fn=eager,
+            prompt=[int(t) for t in prompt])
 
     def stats(self) -> Dict[str, Any]:
         """Router counters, per-replica health, and request-latency
@@ -429,18 +432,20 @@ class ReplicaRouter:
 
     # -- admission / submit -------------------------------------------------
     def _submit(self, fn, deadline_us: Optional[int], label: str,
-                eager_fn: Optional[Callable]):
+                eager_fn: Optional[Callable],
+                prompt: Optional[List[int]] = None):
         # the request's end-to-end trace identity is minted HERE (or
         # inherited from a caller's ambient scope) so the draining shed
         # below, every dispatch attempt, and the engine's own admission
         # all stamp one trace_id (ISSUE 15)
         with _telemetry.trace_scope() as ts:
             return self._submit_traced(fn, deadline_us, label, eager_fn,
-                                       ts.trace_id)
+                                       ts.trace_id, prompt)
 
     def _submit_traced(self, fn, deadline_us: Optional[int], label: str,
                        eager_fn: Optional[Callable],
-                       trace_id: Optional[str]):
+                       trace_id: Optional[str],
+                       prompt: Optional[List[int]] = None):
         if self._closed:
             raise RuntimeError("ReplicaRouter is closed")
         if _preemption.draining():
@@ -462,7 +467,7 @@ class ReplicaRouter:
         if deadline_us is not None:
             spans.append(deadline_us / 1e6)
         until = (t0 + min(spans)) if spans else None
-        req = _RouterRequest(fn, until, label, eager_fn)
+        req = _RouterRequest(fn, until, label, eager_fn, prompt)
         req.trace_id = trace_id
         try:
             result = _faults.retry_call(
@@ -524,11 +529,15 @@ class ReplicaRouter:
                                  state=new, prev=old, reason=reason)
         return hook
 
-    def _pick(self, exclude: Set[int]) -> Optional[_Replica]:
+    def _pick(self, exclude: Set[int],
+              prompt: Optional[List[int]] = None) -> Optional[_Replica]:
         """Healthiest replica by live telemetry: queue depth + in-flight
         cost + page-pool pressure (engine ``load()``) + router-side
-        in-flight, breaker-closed replicas first, then ONE half-open
-        probe.  Deterministic tie-break by replica index."""
+        in-flight, minus prefix affinity (replicas whose KV pool
+        already holds the prompt's hash chain score lower — shared
+        prompts converge on the warm pages), breaker-closed replicas
+        first, then ONE half-open probe.  Deterministic tie-break by
+        replica index."""
         closed_scored = []
         half: List[_Replica] = []
         for r in self._replicas:
@@ -536,7 +545,8 @@ class ReplicaRouter:
                 continue
             st = r.breaker.state()
             if st == BREAKER_CLOSED:
-                closed_scored.append((self._score(r), r.index, r))
+                closed_scored.append((self._score(r, prompt),
+                                      r.index, r))
             elif st == BREAKER_HALF_OPEN:
                 half.append(r)
         # a half-open replica is re-admitted BY PROBE: the next request
@@ -551,12 +561,22 @@ class ReplicaRouter:
             return min(closed_scored)[2]
         return None
 
-    def _score(self, r: _Replica) -> float:
+    def _score(self, r: _Replica,
+               prompt: Optional[List[int]] = None) -> float:
         load = r.engine.load() if hasattr(r.engine, "load") else {}
-        return (float(r.in_flight)
-                + float(load.get("queue_depth", 0.0))
-                + float(load.get("in_flight", 0.0))
-                + float(load.get("pool_pressure", 0.0)))
+        score = (float(r.in_flight)
+                 + float(load.get("queue_depth", 0.0))
+                 + float(load.get("in_flight", 0.0))
+                 + float(load.get("pool_pressure", 0.0)))
+        if prompt and hasattr(r.engine, "prefix_probe"):
+            # each resident leading block is worth
+            # MXNET_ROUTER_PREFIX_AFFINITY units of load: shared-prefix
+            # traffic converges on the replica holding the warm pages
+            # (prefix_probe is 0 with MXNET_PREFIX_CACHE off)
+            weight = float(_config.get("MXNET_ROUTER_PREFIX_AFFINITY"))
+            if weight > 0:
+                score -= weight * r.engine.prefix_probe(prompt)
+        return score
 
     def _hedge_threshold(self) -> Optional[float]:
         """p<MXNET_ROUTER_HEDGE_PCTL> of observed successful dispatch
@@ -581,7 +601,7 @@ class ReplicaRouter:
         req.attempt += 1
         if req.attempt > 1:
             self._stats.inc("failovers")
-        primary = self._pick(exclude=req.failed)
+        primary = self._pick(exclude=req.failed, prompt=req.prompt)
         if primary is None:
             raise _NoHealthyReplica(
                 f"[{self.name}] no healthy replica "
@@ -609,7 +629,8 @@ class ReplicaRouter:
                 req.hedged = True
                 spare = self._pick(
                     exclude=req.failed
-                    | {f.replica.index for f in flights})
+                    | {f.replica.index for f in flights},
+                    prompt=req.prompt)
                 if spare is not None:
                     self._stats.inc("hedges")
                     _telemetry.event(

@@ -1,0 +1,242 @@
+"""Content-addressed KV prefix cache (ISSUE 16,
+``mxnet_tpu/serving_decode.py``).
+
+Pins: (1) hash-chain keying — a block's key commits to its FULL token
+prefix and the KV geometry, not just its own content, (2) refcounted
+lookup/publish with the cached-but-unreferenced LRU (free parks
+published pages instead of recycling them; ``in_use()`` counts
+references only), (3) eviction never reclaims a live page and typed
+``PagePoolExhausted`` fires only when even eviction cannot help
+(exhaustion -> eviction -> typed-shed ordering), (4) copy-on-write
+fork at divergence — shared pages are immutable, forks are counted
+(``prefix.cow_forks``) and token-exact, (5) full- and partial-hit
+prefill parity vs the eager oracle AND vs a cold cache, seed for seed,
+and (6) ``MXNET_PREFIX_CACHE=0`` is a true off switch: byte-identical
+outputs with every ``prefix.*`` counter at zero.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (jax/backend init via conftest)
+from mxnet_tpu import faults
+from mxnet_tpu import serving_decode as sd
+from mxnet_tpu import telemetry
+
+
+def tiny(seed=0, **kw):
+    cfg = dict(vocab=31, d_model=16, n_layers=2, n_heads=2, max_seq=32)
+    cfg.update(kw)
+    model = sd.TinyCausalLM(**cfg)
+    return model, model.init_params(seed)
+
+
+def prefix_delta(base):
+    return {k: v for k, v in telemetry.delta(base).items()
+            if k.startswith("prefix.") and v}
+
+
+# ---------------------------------------------------------------------------
+# hash-chain keying
+# ---------------------------------------------------------------------------
+def test_chain_keys_commit_to_full_prefix():
+    geom = (2, 2, 8, "float32")
+    a = sd._chain_keys([1, 2, 3, 4, 5, 6, 7, 8], 4, geom)
+    b = sd._chain_keys([1, 2, 3, 4, 5, 6, 7, 8], 4, geom)
+    assert a == b and len(a) == 2            # deterministic, one per block
+    # same SECOND block content behind a different first block: the
+    # chained key must differ — equal keys imply equal full prefixes
+    c = sd._chain_keys([9, 9, 9, 9, 5, 6, 7, 8], 4, geom)
+    assert c[1] != a[1] and c[0] != a[0]
+    # a partial tail block gets its own (partial-content) key
+    d = sd._chain_keys([1, 2, 3, 4, 5, 6], 4, geom)
+    assert len(d) == 2 and d[0] == a[0] and d[1] != a[1]
+    # the key commits to the geometry too — no cross-layout aliasing
+    e = sd._chain_keys([1, 2, 3, 4, 5, 6, 7, 8], 4, (4, 4, 16, "float32"))
+    assert e[0] != a[0]
+
+
+# ---------------------------------------------------------------------------
+# refcounted lookup / publish / LRU
+# ---------------------------------------------------------------------------
+def test_lookup_publish_refcount_lifecycle():
+    base = telemetry.snapshot()
+    geom = ("test-geom",)
+    pool = sd.PagePool(pages=4, page=4)
+    keys = sd._chain_keys(list(range(8)), 4, geom)
+    pages = pool.alloc(2)
+    pool.publish(geom, list(zip(keys, pages)))
+    pool.free(pages)
+    # published pages PARK in the resident cache instead of recycling
+    st = pool.stats()
+    assert st["in_use"] == 0 and st["cached"] == 2
+    assert pool.free_pages() == 4            # still allocatable
+    # lookup revives them with refcount 1 (counted as an alloc)
+    hits = pool.lookup(geom, keys)
+    assert hits == pages
+    assert pool.ref(pages[0]) == 1 and pool.in_use() == 2
+    # a second sharer bumps the refcount; one free keeps the page live
+    hits2 = pool.lookup(geom, keys[:1])
+    assert hits2 == pages[:1] and pool.ref(pages[0]) == 2
+    pool.free(hits2)
+    assert pool.ref(pages[0]) == 1 and pool.in_use() == 2
+    pool.free(hits)
+    assert pool.in_use() == 0 and pool.stats()["cached"] == 2
+    # holds() probes without bumping references
+    assert pool.holds(geom, keys) == 2 and pool.in_use() == 0
+    d = prefix_delta(base)
+    assert d.get("prefix.hit_blocks") == 3   # 2 + 1 across both lookups
+    assert "prefix.miss_blocks" not in d
+    assert pool.audit() == []
+
+
+def test_lookup_stops_at_first_miss():
+    base = telemetry.snapshot()
+    geom = ("test-geom-miss",)
+    pool = sd.PagePool(pages=4, page=4)
+    keys = sd._chain_keys(list(range(12)), 4, geom)
+    pages = pool.alloc(2)
+    pool.publish(geom, list(zip(keys[:2], pages)))
+    # hits are the LEADING run only: block 2 is absent, so asking for
+    # all 3 returns 2 and counts exactly one miss block
+    hits = pool.lookup(geom, keys)
+    assert hits == pages
+    d = prefix_delta(base)
+    assert d.get("prefix.hit_blocks") == 2
+    assert d.get("prefix.miss_blocks") == 1
+    pool.free(hits)
+    assert pool.audit() == []
+
+
+# ---------------------------------------------------------------------------
+# eviction: never a live page; typed shed only when eviction can't help
+# ---------------------------------------------------------------------------
+def test_eviction_never_reclaims_live_then_typed_shed():
+    base = telemetry.snapshot()
+    geom = ("test-geom-evict",)
+    pool = sd.PagePool(pages=4, page=4)
+    live = pool.alloc(2)                     # referenced — untouchable
+    cached = pool.alloc(2)
+    pool.publish(geom, list(zip(
+        sd._chain_keys(list(range(8)), 4, geom), cached)))
+    pool.free(cached)                        # -> resident LRU
+    assert pool.stats()["cached"] == 2 and pool.free_pages() == 2
+    # allocation under pressure EVICTS the cache rather than shedding
+    got = pool.alloc(2)
+    assert set(got) == set(cached) and set(got).isdisjoint(live)
+    assert pool.ref(live[0]) == 1 and pool.ref(live[1]) == 1
+    assert prefix_delta(base).get("prefix.evictions") == 2
+    assert pool.holds(geom, sd._chain_keys(list(range(8)), 4, geom)) == 0
+    # now 0 free + 0 cached: only THEN the typed shed fires
+    with pytest.raises(sd.PagePoolExhausted) as ei:
+        pool.alloc(1)
+    assert isinstance(ei.value, faults.ShedError)
+    assert pool.ref(live[0]) == 1            # live pages survived it all
+    pool.free(live)
+    pool.free(got)
+    assert pool.audit() == [] and pool.in_use() == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: full hit, COW fork at divergence, parity vs eager oracle
+# ---------------------------------------------------------------------------
+def test_full_hit_prefills_once_and_cow_forks():
+    base = telemetry.snapshot()
+    model, params = tiny()
+    pool = sd.PagePool(pages=16, page=4)
+    eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                              max_rows=4, name="pxfull")
+    try:
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]    # 2 full page-4 blocks
+        first = eng.generate(list(prompt), max_new_tokens=6)
+        assert eng.stats()["prefills"] == 1
+        second = eng.generate(list(prompt), max_new_tokens=6)
+        # the shared prompt prefilled ONCE: the repeat was a full hit
+        assert eng.stats()["prefills"] == 1
+        oracle = sd.eager_generate(model, params, list(prompt),
+                                   max_new_tokens=6)
+        assert first == oracle and second == oracle
+        d = prefix_delta(base)
+        assert d.get("prefix.hit_blocks", 0) >= 2
+        # the full-hit row's first decode write lands in a shared page,
+        # so copy-on-write MUST have forked it (shared pages are
+        # immutable) — and the fork is invisible in the tokens above
+        assert d.get("prefix.cow_forks", 0) >= 1
+    finally:
+        eng.close()
+    assert pool.in_use() == 0 and pool.audit() == []
+
+
+def test_partial_prefill_parity_vs_eager_oracle():
+    base = telemetry.snapshot()
+    model, params = tiny()
+    pool = sd.PagePool(pages=16, page=4)
+    eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                              max_rows=4, name="pxpart")
+    try:
+        sys_prompt = [7, 2, 9, 4, 8, 1, 6, 3]          # 2 shared blocks
+        pa = sys_prompt + [5, 5, 5]
+        pb = sys_prompt + [11, 12]                     # diverges after it
+        out_a = eng.generate(list(pa), max_new_tokens=5)
+        hits_before = prefix_delta(base).get("prefix.hit_blocks", 0)
+        out_b = eng.generate(list(pb), max_new_tokens=5)
+        # B prefilled only its suffix: the 2 shared blocks were hits
+        d = prefix_delta(base)
+        assert d.get("prefix.hit_blocks", 0) - hits_before == 2
+        assert eng.stats()["prefills"] == 2            # A full, B partial
+        # seed-for-seed token parity vs the one-request eager loop
+        assert out_a == sd.eager_generate(model, params, list(pa),
+                                          max_new_tokens=5)
+        assert out_b == sd.eager_generate(model, params, list(pb),
+                                          max_new_tokens=5)
+        # ... and vs a COLD cache over the same seeds
+        pool.clear_prefix_cache()
+        assert out_b == eng.generate(list(pb), max_new_tokens=5)
+    finally:
+        eng.close()
+    assert pool.in_use() == 0 and pool.audit() == []
+
+
+def test_prefix_probe_counts_resident_blocks():
+    model, params = tiny()
+    pool = sd.PagePool(pages=16, page=4)
+    eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                              max_rows=4, name="pxprobe")
+    try:
+        prompt = [2, 7, 1, 8, 2, 8, 1, 8]
+        assert eng.prefix_probe(prompt) == 0
+        eng.generate(list(prompt), max_new_tokens=3)
+        # router affinity sees both published blocks, with no ref bump
+        assert eng.prefix_probe(prompt) == 2
+        assert eng.prefix_probe(prompt[:4]) == 1
+        assert pool.in_use() == 0
+    finally:
+        eng.close()
+    assert pool.audit() == []
+
+
+# ---------------------------------------------------------------------------
+# the off switch
+# ---------------------------------------------------------------------------
+def test_knob_off_zero_counters_same_tokens(monkeypatch):
+    model, params = tiny()
+    oracle = sd.eager_generate(model, params, [4, 2, 4, 2, 4, 2, 4, 2],
+                               max_new_tokens=6)
+    monkeypatch.setenv("MXNET_PREFIX_CACHE", "0")
+    base = telemetry.snapshot()
+    pool = sd.PagePool(pages=16, page=4)
+    eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                              max_rows=4, name="pxoff")
+    try:
+        for _ in range(2):                   # repeat = would-be full hit
+            assert eng.generate([4, 2, 4, 2, 4, 2, 4, 2],
+                                max_new_tokens=6) == oracle
+        assert eng.stats()["prefills"] == 2  # no sharing when off
+        assert eng.prefix_probe([4, 2, 4, 2]) == 0
+    finally:
+        eng.close()
+    # zero-overhead off: prefix.hit_blocks, prefix.miss_blocks,
+    # prefix.cow_forks and prefix.evictions all stay at ZERO, and no
+    # page parks in the resident cache
+    assert prefix_delta(base) == {}
+    assert pool.stats()["cached"] == 0 and pool.in_use() == 0
+    assert pool.audit() == []

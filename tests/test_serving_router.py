@@ -246,7 +246,14 @@ def test_wedged_dispatch_evicted_and_failed_over():
     assert router.stats()["delivered"] == 1      # does not wedge drain
 
 
-def test_breaker_flap_reopens_then_probe_readmits():
+def test_breaker_flap_reopens_then_probe_readmits(monkeypatch):
+    # affinity off: this test repeats ONE prompt, and prefix affinity
+    # (ISSUE 16) would legitimately steer the repeats onto the healthy
+    # warm replica after the first failover — starving the flaky
+    # replica of the errors whose breaker mechanics are pinned here
+    # (placement-vs-affinity behavior is covered in test_prefix_cache
+    # and the router_prefix_storm drill)
+    monkeypatch.setenv("MXNET_ROUTER_PREFIX_AFFINITY", "0")
     router, engines, pools, model, params = mk_router(
         breaker_cooldown_s=0.15)
     orig = engines[0].generate

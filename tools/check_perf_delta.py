@@ -19,6 +19,10 @@ the PRs 1–14 wins were bought in regresses past its declared tolerance:
   availability (PRs 8/14).
 - **program-cache misses** (``program_store.<ns>.misses`` and the disk
   ``cache_misses`` lane alias): the PR-7 cold-start win.
+- **prefix-cache misses** (``prefix.miss_blocks``, ``prefix.evictions``
+  and the ``prefix_miss_blocks`` lane alias): the ISSUE-16
+  shared-prompt prefill win — a hit-rate drop surfaces as miss-block
+  growth on the same workload, an undersized pool as eviction churn.
 
 Counter names are instance-normalized (``decode.engine3.shed`` →
 ``decode.engine*.shed``) and summed per lane, so a renumbered engine
@@ -90,6 +94,9 @@ RULES: Tuple[Rule, ...] = (
     Rule("program-cache-miss",
          lambda n: n.startswith("program_store.") and n.endswith(".misses"),
          tol=0.10, slack=2.0),
+    Rule("prefix-miss",
+         lambda n: n in ("prefix.miss_blocks", "prefix.evictions"),
+         tol=0.10, slack=2.0),
 )
 
 # lane-level scalar aliases gated alongside the namespaced counters
@@ -97,6 +104,7 @@ RULES: Tuple[Rule, ...] = (
 LANE_KEY_RULES: Dict[str, str] = {
     "retrace_count": "retrace",
     "cache_misses": "program-cache-miss",
+    "prefix_miss_blocks": "prefix-miss",
 }
 _LANE_KEY_RULE = {r.label: r for r in RULES}
 
@@ -292,7 +300,9 @@ def self_test() -> int:
         "telemetry": {"program_store.serving_decode.traces": 5,
                       "program_store.serving_decode.dispatches": 64,
                       "ndarray.host_sync": 16,
-                      "decode.engine0.shed": 1},
+                      "decode.engine0.shed": 1,
+                      "prefix.hit_blocks": 90,
+                      "prefix.miss_blocks": 10},
     }
     cand_lane = json.loads(json.dumps(base_lane))
     cand_lane["telemetry"]["program_store.serving_decode.traces"] = 6
@@ -305,6 +315,20 @@ def self_test() -> int:
         print("check_perf_delta: SELF-TEST FAILED — a +1 retrace "
               f"candidate was not flagged ({report['regressions']})",
               file=sys.stderr)
+        return 1
+    # a collapsed prefix-cache hit rate (same workload, misses way up)
+    # must trip the prefix-miss rule
+    miss_lane = json.loads(json.dumps(base_lane))
+    miss_lane["telemetry"]["prefix.miss_blocks"] = 60
+    miss_lane["telemetry"]["prefix.hit_blocks"] = 40
+    report = compare([base_lane], [miss_lane], waivers=[])
+    bad = [r for r in report["regressions"]
+           if r["counter"] == "prefix.miss_blocks"
+           and r["rule"] == "prefix-miss"]
+    if not bad:
+        print("check_perf_delta: SELF-TEST FAILED — a collapsed "
+              "prefix hit rate was not flagged "
+              f"({report['regressions']})", file=sys.stderr)
         return 1
     clean = compare([base_lane], [json.loads(json.dumps(base_lane))],
                     waivers=[])

@@ -130,7 +130,8 @@ def check_tested(registry: Dict[str, dict], tests_dir: str) -> List[str]:
 # from an unrelated co-resident test cannot flake the check
 _DETERMINISTIC_PREFIXES = ("program_store.train_step.", "cached_step.",
                            "spmd.", "sharding.", "metric.", "fused.",
-                           "ndarray.", "faults.", "telemetry.")
+                           "ndarray.", "faults.", "telemetry.",
+                           "prefix.")
 
 
 def _train_fixture():
@@ -326,19 +327,75 @@ _MERGE_WORKER_FLAG = "--merge-worker"
 def _merge_worker() -> int:
     """Gate-6 child: run the identical steady-state window and flush
     ONE shard whose snapshot is exactly the window's delta (counters
-    reset after warmup, so cumulative == since-reset)."""
+    reset after warmup, so cumulative == since-reset).  The window
+    includes a shared-prefix decode hit so the ``prefix.*`` counters
+    (ISSUE 16) prove they shard and merge like everything else."""
     from mxnet_tpu import engine, telemetry
+    from mxnet_tpu import serving_decode as sd
 
     step, x, y = _train_fixture()
     for _ in range(2):                    # warm: trace + compile + AOT
         loss = step(x, y, batch_size=8)
     loss.asnumpy()
+    # prefix-cache fixture: prime (compile + publish) BEFORE the reset
+    # so the measured window sees a pure deterministic full hit
+    model = sd.TinyCausalLM(vocab=29, d_model=16, n_layers=1,
+                            n_heads=2, max_seq=48)
+    eng = sd.GenerativeEngine(model, params=model.init_params(4),
+                              pool=sd.PagePool(pages=32, page=4),
+                              max_rows=2, name="merge_gate")
+    shared = [3, 1, 4, 1, 5, 9, 2, 6]
+    eng.generate(shared, max_new_tokens=2)
     telemetry.reset()
     for _ in range(3):
         loss = step(x, y, batch_size=8)
     loss.asnumpy()
+    eng.generate(shared, max_new_tokens=2)    # full hit, zero prefill
+    eng.close()
     engine.waitall()                      # flushes the flight recorder
     return 0
+
+
+def check_prefix_zero_when_off() -> List[str]:
+    """ISSUE-16 disabled-mode contract: with ``MXNET_PREFIX_CACHE=0`` a
+    shared-prompt workload leaves every ``prefix.*`` counter untouched
+    and parks nothing in the pool's resident cache — no hashing, no
+    index, the pre-cache pool byte-for-byte (the knob is uncached, so
+    the env flip takes effect immediately)."""
+    from mxnet_tpu import serving_decode as sd
+    from mxnet_tpu import telemetry
+
+    prev = os.environ.get("MXNET_PREFIX_CACHE")
+    os.environ["MXNET_PREFIX_CACHE"] = "0"
+    try:
+        model = sd.TinyCausalLM(vocab=29, d_model=16, n_layers=1,
+                                n_heads=2, max_seq=48)
+        pool = sd.PagePool(pages=32, page=4)
+        eng = sd.GenerativeEngine(model, params=model.init_params(2),
+                                  pool=pool, max_rows=2,
+                                  name="prefix_off_gate")
+        base = telemetry.snapshot()
+        try:
+            for _ in range(2):            # the same prompt twice: the
+                eng.generate([5, 4, 3, 2, 1, 6, 7, 8],  # on-path would
+                             max_new_tokens=3)          # full-hit here
+        finally:
+            eng.close()
+        moved = {k: v for k, v in telemetry.delta(base).items()
+                 if k.startswith("prefix.") and v}
+        out: List[str] = []
+        if moved:
+            out.append("MXNET_PREFIX_CACHE=0 still moved prefix "
+                       f"counters: {moved}")
+        st = pool.stats()
+        if st["cached"] != 0 or st["in_use"] != 0:
+            out.append(f"off-path pool holds residue: {st}")
+        return out
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_PREFIX_CACHE", None)
+        else:
+            os.environ["MXNET_PREFIX_CACHE"] = prev
 
 
 def check_merge_correctness() -> List[str]:
@@ -437,6 +494,8 @@ def main(root: str = None) -> int:
                     for m in check_chrome_trace())
     failures.extend(("routed-request trace stamping", [m])
                     for m in check_routed_trace_ids())
+    failures.extend(("prefix counters zero with the knob off", [m])
+                    for m in check_prefix_zero_when_off())
     failures.extend(("two-process merge correctness", [m])
                     for m in check_merge_correctness())
 
@@ -460,7 +519,8 @@ def main(root: str = None) -> int:
     print(f"check_telemetry: {len(accessors)} accessors, "
           f"{len(registry)} registered counters, deterministic "
           "steady-state delta, chrome trace >= 3 span categories, "
-          "routed events trace-stamped, 2-process merge == 2x window")
+          "routed events trace-stamped, prefix counters 0 with the "
+          "knob off, 2-process merge == 2x window")
     return 0
 
 

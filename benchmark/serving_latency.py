@@ -27,8 +27,11 @@ per-model p50/p99, shed count, tokens/s, and the interference ratio
 collapse), and a ROUTER storm (ISSUE 14): two fast replicas behind a
 ``serving_router.ReplicaRouter`` with one replica killed mid-storm,
 stamping the availability columns — dropped (must be 0) / hedged /
-failed_over / breaker_transitions — next to the latency numbers.
-``--storm`` prints the storm report standalone.
+failed_over / breaker_transitions — next to the latency numbers, and
+an ELASTIC storm (ISSUE 17): one replica plus a ``FleetSupervisor``
+under the same bursty arrivals, stamping the replica-count timeline,
+scale_ups/scale_downs/joins/drains, peak/final replica counts, and
+fleet tokens/s. ``--storm`` prints the storm report standalone.
 
 ``--shared-prefix`` is the ISSUE-16 lane: M users x ONE system prompt
 through the content-addressed prefix cache (``MXNET_PREFIX_CACHE``),
@@ -373,6 +376,96 @@ if STORM:
     for e in rengines:
         e.close()
 
+    # ---- elastic storm: the ISSUE-17 autoscaler columns ---------------
+    # 1 replica + a FleetSupervisor under the same bursty arrivals: the
+    # artifact stamps the replica-count TIMELINE, the scale event
+    # counts, and fleet tokens/s — autoscaler regressions (flapping,
+    # never scaling, slow joins, failure to shrink back) show up round
+    # over round like every latency number.
+    from mxnet_tpu.serving_router import FleetSupervisor
+    def espawn():
+        epool = sd.PagePool(pages=256, page=8)
+        ee = sd.GenerativeEngine(fast_model(), params=fparams,
+                                 pool=epool, max_rows=8,
+                                 name="elastic")
+        ee.warmup(max_len=16)
+        return ee
+    erouter = ReplicaRouter([espawn()], name="elastic",
+                            breaker_errs=2, breaker_cooldown_s=0.5,
+                            hedge_pctl=95)
+    def eretire(eng_, index):
+        eng_.close()
+    esup = FleetSupervisor(erouter, espawn, retire=eretire,
+                           enabled=True, min_replicas=1,
+                           max_replicas=3, cooldown_s=0.4,
+                           interval_s=0.05, up_queue=1.0,
+                           down_queue=0.1,
+                           warmup_kwargs={"max_len": 16})
+    esup.start()
+    # long enough a burst that the first join COMPLETES mid-storm (an
+    # in-process spawn pays a warmup, not a process boot)
+    eprompts = mk_prompts(288)
+    edelivered, eshed, eerrs = [0], [0], []
+    elock = threading.Lock()
+    def efire(chunk):
+        for p in chunk:
+            time.sleep(rng.exponential(1.0 / 60.0))
+            try:
+                erouter.generate(p, max_new_tokens=NEW,
+                                 deadline_us=30_000_000)
+                with elock:
+                    edelivered[0] += 1
+            except sd.ShedError:
+                with elock:
+                    eshed[0] += 1
+            except BaseException as e:
+                eerrs.append(repr(e))
+    ethreads = [threading.Thread(target=efire,
+                                 args=(eprompts[i::12],))
+                for i in range(12)]
+    timeline = []
+    t0 = time.perf_counter()
+    for t in ethreads: t.start()
+    while any(t.is_alive() for t in ethreads):
+        timeline.append([round(time.perf_counter() - t0, 2),
+                         erouter.serving_replicas()])
+        time.sleep(0.05)
+    for t in ethreads: t.join()
+    ewall = time.perf_counter() - t0
+    # let the burst subside so the supervisor shrinks back to the
+    # floor; the minimum wait catches a join that completes just after
+    # the last request (a spawn in flight when the storm ended)
+    tdown_min = time.perf_counter() + 3.0
+    tdown_max = time.perf_counter() + 20.0
+    while time.perf_counter() < tdown_max and (
+            time.perf_counter() < tdown_min
+            or erouter.serving_replicas() > 1):
+        timeline.append([round(time.perf_counter() - t0, 2),
+                         erouter.serving_replicas()])
+        time.sleep(0.05)
+    esup.stop()
+    efleet = erouter.fleet_stats()
+    out["elastic_storm"] = {
+        "requests": len(eprompts),
+        "delivered": edelivered[0],
+        "dropped": len(eprompts) - edelivered[0] - eshed[0],
+        "shed": eshed[0],
+        "errors": eerrs,
+        "scale_ups": efleet["scale_ups"],
+        "scale_downs": efleet["scale_downs"],
+        "joins": efleet["joins"],
+        "drains": efleet["drains"],
+        "scale_errors": efleet["scale_errors"],
+        "peak_replicas": max((n for _, n in timeline), default=1),
+        "final_replicas": erouter.serving_replicas(),
+        "replica_timeline": timeline[:400],
+        "fleet_tokens_s": round(edelivered[0] * NEW / ewall, 1),
+        "wall_s": round(ewall, 2),
+    }
+    for r in list(erouter._replicas):
+        if hasattr(r.engine, "close"):
+            r.engine.close()
+
 _disk = program_store.disk_stats()
 out["cache_hits"] = _disk["hits"]
 out["cache_misses"] = _disk["misses"]
@@ -597,6 +690,17 @@ def main_decode(storm_only: bool = False) -> None:
               f"{r['failed_over']} failed over, {r['hedged']} hedged, "
               f"{r['breaker_transitions']} breaker transitions, "
               f"p99 {r['p99_us']:.0f} us, {r['tokens_s']} tok/s")
+    e = lane.get("elastic_storm")
+    if e:
+        print(f"elastic storm (autoscaler 1->{e['peak_replicas']}->"
+              f"{e['final_replicas']} replicas): "
+              f"{e['delivered']}/{e['requests']} delivered, "
+              f"{e['dropped']} dropped, {e['shed']} shed, "
+              f"{e['scale_ups']} up / {e['scale_downs']} down "
+              f"({e['scale_errors']} errors, {e['joins']} joins / "
+              f"{e['drains']} drains), fleet {e['fleet_tokens_s']} "
+              f"tok/s over {e['wall_s']}s, "
+              f"{len(e['replica_timeline'])} timeline samples")
 
 
 def main_prefix() -> None:

@@ -554,6 +554,71 @@ declare("MXNET_ROUTER_EAGER_FALLBACK", bool, False,
         "ShedError(kind='unavailable').  Default off: shedding loudly "
         "is usually better than silently serving at eager throughput.",
         subsystem="serving", cached=False)
+declare("MXNET_ROUTER_AUTOSCALE", bool, False,
+        "Elastic fleet autoscaling (FleetSupervisor.start()): a "
+        "supervisor thread prices scale-up/down every "
+        "MXNET_ROUTER_SCALE_INTERVAL_S from live telemetry — mean "
+        "queued work per SERVING replica, worst KV page-pool "
+        "pressure, fleet p99 — inside "
+        "[MXNET_ROUTER_MIN_REPLICAS, MXNET_ROUTER_MAX_REPLICAS] with "
+        "one action per MXNET_ROUTER_SCALE_COOLDOWN_S.  Scale-down "
+        "is a scheduled graceful preemption: drain_replica (typed "
+        "draining handback, clean page audit) then SIGTERM -> exit "
+        "MXNET_PREEMPTION_EXIT_CODE for process-backed replicas.  "
+        "Default off: FleetSupervisor.start() is a no-op — no "
+        "thread, no timer, dispatch identical to the static router.",
+        subsystem="serving", cached=False)
+declare("MXNET_ROUTER_MIN_REPLICAS", int, 1,
+        "Elastic fleet floor: the autoscaler never drains below this "
+        "many SERVING replicas, and scales UP toward it regardless of "
+        "load/cooldown when the fleet falls under (self-healing after "
+        "a host loss).", validator=lambda v: v >= 1,
+        subsystem="serving", cached=False)
+declare("MXNET_ROUTER_MAX_REPLICAS", int, 4,
+        "Elastic fleet ceiling: the autoscaler never joins past this "
+        "many SERVING replicas, however saturated the fleet signals "
+        "are.", validator=lambda v: v >= 1, subsystem="serving",
+        cached=False)
+declare("MXNET_ROUTER_SCALE_COOLDOWN_S", float, 10.0,
+        "Autoscaler stability: at most one scaling action (up or "
+        "down) per this many seconds, so a bursty load cannot flap "
+        "the fleet — except scaling up toward MXNET_ROUTER_"
+        "MIN_REPLICAS, which is urgent and bypasses the cooldown.",
+        validator=lambda v: v >= 0, subsystem="serving", cached=False)
+declare("MXNET_ROUTER_SCALE_INTERVAL_S", float, 1.0,
+        "Autoscaler cadence: seconds between supervisor ticks (each "
+        "tick reads the fleet signals and executes at most one "
+        "scaling action).", validator=lambda v: v > 0,
+        subsystem="serving", cached=False)
+declare("MXNET_ROUTER_SCALE_UP_QUEUE", float, 1.5,
+        "Autoscaler scale-up threshold: mean queued work per SERVING "
+        "replica (engine load(): queue_depth + in_flight occupancy) "
+        "at or above which a tick prices a scale-up.  Measured from "
+        "the same load() surface the router balances on — never a "
+        "static request count.", validator=lambda v: v > 0,
+        subsystem="serving", cached=False)
+declare("MXNET_ROUTER_SCALE_DOWN_QUEUE", float, 0.1,
+        "Autoscaler scale-down threshold: mean queued work per "
+        "SERVING replica at or below which (with page-pool pressure "
+        "also low) a tick prices a scale-down, never below "
+        "MXNET_ROUTER_MIN_REPLICAS.", validator=lambda v: v >= 0,
+        subsystem="serving", cached=False)
+declare("MXNET_ROUTER_SCALE_POOL_HIGH", float, 0.85,
+        "Autoscaler KV-pressure threshold: worst per-replica page-"
+        "pool pressure (1 - free/total) at or above which a tick "
+        "prices a scale-up even with short queues — pool exhaustion "
+        "sheds, so headroom is capacity.  Scale-down additionally "
+        "requires pressure under half this value.",
+        validator=lambda v: 0 < v <= 1, subsystem="serving",
+        cached=False)
+declare("MXNET_ROUTER_REMOTE_TIMEOUT_S", float, 120.0,
+        "RemoteReplica transport ceiling: seconds a framed call may "
+        "wait on connect/reply before the client raises a "
+        "TransientFault (breaker-blamed, request fails over).  The "
+        "ambient request deadline tightens this per-call; the ceiling "
+        "bounds deadline-less dispatches so a dead host can never "
+        "hang a router worker thread forever.",
+        validator=lambda v: v > 0, subsystem="serving", cached=False)
 declare("MXNET_TELEMETRY_DIR", str, None,
         "Telemetry flight recorder: when set, telemetry.flush() — called "
         "by engine.waitall() and available directly — appends the "

@@ -62,6 +62,18 @@ or fails loudly:
   kills; failover rebuilds the cache cold on the survivor,
   token-exact, with the page-pool refcount audit clean at drain: 0
   leaked, 0 double-freed, no index entry pointing at a dead page).
+- ``router_scale_storm`` / ``router_host_loss`` — the ISSUE-17 elastic
+  fleet cells.  The scale storm runs a ``FleetSupervisor`` over a
+  1-replica router under bursty load: the autoscaler grows the fleet
+  1 → 3 by spawning cross-host ``replica`` children (each joins
+  JOINING → warm → SERVING off the shared program cache: 0 fresh
+  compiles) and, when the burst subsides, shrinks back 3 → 1 where
+  every scale-down IS a scheduled graceful preemption (drain →
+  ``preempt`` op → SIGTERM → typed draining sheds → exit 83).  Host
+  loss SIGKILLs a remote replica's process mid-storm: every open call
+  fails at once, failover redelivers token-exactly on the survivor,
+  the breaker opens, and ``kill_to_recovered_s`` stays inside the
+  availability wall.
 - ``bitflip_param`` — the ISSUE-13 silent-corruption drill: the child
   flips one bit of ONE device's replica of a parameter mid-run; the
   sentinel's cross-replica digest vote localizes the device within one
@@ -109,7 +121,8 @@ SCENARIOS = ("sigterm_drain", "sigkill_between_saves", "topology_change",
 # the serving-availability matrix (tools/check_availability_budget.py);
 # kept OUT of SCENARIOS so the recovery gate's matrix is unchanged
 ROUTER_SCENARIOS = ("router_kill", "router_wedge", "router_flap",
-                    "router_deadline_storm", "router_prefix_storm")
+                    "router_deadline_storm", "router_prefix_storm",
+                    "router_scale_storm", "router_host_loss")
 
 # the scripted workload every train drill shares
 N_STEPS = 24
@@ -530,6 +543,8 @@ def _storm_prompt(r: int) -> List[int]:
 
 
 def _cmd_router(a) -> int:
+    if a.mode in ("scale_storm", "host_loss"):
+        return _cmd_router_fleet(a)
     import threading
 
     import mxnet_tpu as mx  # noqa: F401
@@ -750,6 +765,376 @@ def _cmd_router(a) -> int:
     with open(os.path.join(a.dir, f"result-{a.label}.json"), "w") as f:
         json.dump(res, f)
     return preempted or 0
+
+
+# ---------------------------------------------------------------------------
+# child: one cross-host replica process (ISSUE 17 — the elastic fleet's
+# unit of membership)
+# ---------------------------------------------------------------------------
+
+def _cmd_replica(a) -> int:
+    """Warm a ``GenerativeEngine`` off the shared program cache, serve
+    it over ``serving_remote.ReplicaServer``, and wait for retirement:
+    a graceful preemption (the router's ``preempt`` op → SIGTERM →
+    typed draining sheds → waitall → result JSON → exit 83) or a
+    SIGKILL (the host-loss cell: no goodbye at all)."""
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu import engine, preemption, program_store, telemetry
+    from mxnet_tpu.serving_decode import (GenerativeEngine, PagePool,
+                                          TinyCausalLM)
+    from mxnet_tpu.serving_remote import ReplicaServer
+
+    model = TinyCausalLM(vocab=50, d_model=16, n_layers=1, n_heads=2,
+                         max_seq=96)
+    params = model.init_params(0)
+    pool = PagePool(pages=64, page=8)
+    eng = GenerativeEngine(model, params=params, pool=pool, max_rows=2,
+                           name=a.label)
+    eng.warmup(max_len=8)       # off <root>/pcache: disk hits only
+    preemption.install()
+    srv = ReplicaServer(eng, name=a.label).start()
+    # the port file is the join handshake, written AFTER warmup — the
+    # supervisor's join clock prices the WHOLE boot tax
+    tmp = os.path.join(a.dir, f"port-{a.label}.tmp")
+    with open(tmp, "w") as f:
+        f.write(f"{srv.port}\n")
+    os.replace(tmp, os.path.join(a.dir, f"port-{a.label}.txt"))
+    t0 = time.monotonic()
+    preempted: Optional[int] = None
+    try:
+        while time.monotonic() - t0 < a.ttl:   # orphan guard
+            time.sleep(0.1)
+    except preemption.Preempted as e:
+        preempted = int(e.code)
+    engine.waitall()
+    snap = telemetry.snapshot()
+    telemetry.flush()       # shard == the snapshot this result records
+    res = {
+        "label": a.label, "pid": os.getpid(),
+        "preempted_code": preempted,
+        "disk": program_store.disk_stats(),
+        "leaked_pages": pool.in_use(),
+        "pool_audit": list(pool.audit()),
+        "served": {k: v for k, v in eng.stats().items()
+                   if isinstance(v, (int, float))},
+        "drain_s": snap.get("preemption.drain_s"),
+        "telemetry": snap,
+    }
+    with open(os.path.join(a.dir, f"result-{a.label}.json"), "w") as f:
+        json.dump(res, f)
+    return preempted or 0
+
+
+def _spawn_replica(scen_dir: str, label: str, boot_timeout: float = 120.0
+                   ) -> "tuple[subprocess.Popen, int]":
+    """Launch a ``replica`` child and wait for its port handshake.
+    Returns ``(popen, port)``; the caller owns the process handle.
+    Environment is inherited — the fleet shares ``MXNET_PROGRAM_CACHE_DIR``
+    (warm joins) and ``MXNET_TELEMETRY_DIR`` (rank-stamped shards)."""
+    port_path = os.path.join(scen_dir, f"port-{label}.txt")
+    if os.path.exists(port_path):
+        os.remove(port_path)
+    log = open(os.path.join(scen_dir, f"replica-{label}.log"), "w")
+    popen = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.drills", "replica",
+         "--dir", scen_dir, "--label", label],
+        stdout=log, stderr=subprocess.STDOUT, cwd=_REPO)
+    deadline = time.monotonic() + boot_timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(port_path):
+            with open(port_path) as f:
+                return popen, int(f.read().strip())
+        if popen.poll() is not None:
+            raise RuntimeError(f"replica {label} died during boot "
+                               f"rc={popen.returncode}")
+        time.sleep(0.05)
+    popen.kill()
+    raise RuntimeError(f"replica {label} never published its port")
+
+
+def _cmd_router_fleet(a) -> int:
+    """The ISSUE-17 elastic-fleet cells.
+
+    ``scale_storm``: a ``FleetSupervisor`` over a 1-replica router under
+    bursty load — the autoscaler grows 1 → 3 by spawning ``replica``
+    children (JOINING → warm → SERVING, 0 fresh compiles off the shared
+    cache), one remote is gracefully preempted WHILE serving (typed
+    draining sheds hand queued rows back over the wire), and the
+    subsiding burst shrinks the fleet back to 1 where every scale-down
+    IS a scheduled graceful preemption (drain → SIGTERM → exit 83).
+
+    ``host_loss``: a 2-replica router (local + remote) has the remote's
+    process SIGKILLed mid-storm — every open call fails at once,
+    failover redelivers token-exactly on the survivor, the breaker
+    opens, and ``kill_to_recovered_s`` is measured for the gate."""
+    import threading
+
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu import engine, telemetry
+    from mxnet_tpu.faults import ShedError
+    from mxnet_tpu.serving_decode import (GenerativeEngine, PagePool,
+                                          TinyCausalLM, eager_generate)
+    from mxnet_tpu.serving_remote import RemoteReplica
+    from mxnet_tpu.serving_router import (FleetSupervisor, ReplicaRouter,
+                                          REPLICA_SERVING)
+
+    model = TinyCausalLM(vocab=50, d_model=16, n_layers=1, n_heads=2,
+                         max_seq=96)
+    params = model.init_params(0)
+    pool0 = PagePool(pages=64, page=8)
+    local = GenerativeEngine(model, params=params, pool=pool0,
+                             max_rows=2, name="rep0")
+    # warms <root>/pcache BEFORE any replica spawns: joiners hit disk
+    local.warmup(max_len=8)
+
+    def prompt_of(rid: int) -> List[int]:
+        # bounded distinct prompts: the eager oracle replays each
+        # UNIQUE prompt, so the storm cycles 29 instead of minting
+        # hundreds
+        return _router_prompt(rid % 29)
+
+    records: Dict[int, Dict[str, Any]] = {}
+    lock = threading.Lock()
+
+    def fire(rid: int) -> None:
+        t0 = time.monotonic()
+        rec: Dict[str, Any] = {}
+        try:
+            toks = router.generate(prompt_of(rid),
+                                   max_new_tokens=a.max_new)
+            rec.update(status="delivered",
+                       tokens=[int(t) for t in toks])
+        except ShedError as e:
+            rec.update(status="shed", kind=getattr(e, "kind", None))
+        except BaseException as e:   # pragma: no cover - drill failure
+            rec.update(status="error", error=repr(e))
+        rec["elapsed_s"] = time.monotonic() - t0
+        rec["done_at"] = time.monotonic()
+        with lock:
+            records[rid] = rec
+
+    extra: Dict[str, Any] = {}
+    procs: List[Dict[str, Any]] = []
+
+    if a.mode == "host_loss":
+        popen, port = _spawn_replica(a.dir, "r1")
+        procs.append({"label": "r1", "popen": popen})
+        remote = RemoteReplica("127.0.0.1", port, name="r1")
+        router = ReplicaRouter([local, remote], name="drill",
+                               breaker_errs=2, breaker_cooldown_s=0.5,
+                               hedge_pctl=0)
+        for rid in range(a.steady):
+            fire(rid)
+        base = a.steady
+        chaos_ids = list(range(base, base + max(a.requests, 10)))
+        # graftlint: daemon-ok(drill request workers, joined in-scope
+        # below before the drill writes its verdict)
+        threads = [threading.Thread(target=fire, args=(rid,))
+                   for rid in chaos_ids]
+        for t in threads:
+            t.start()
+        # strike while the remote is actively serving: the router's own
+        # in-flight ledger for replica 1, no wire round trip
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if router._replicas[1].in_flight > 0:
+                break
+            time.sleep(0.002)
+        os.kill(popen.pid, signal.SIGKILL)
+        t_kill = time.monotonic()
+        popen.wait(timeout=30)
+        for t in threads:
+            t.join(timeout=180.0)
+        # recovery = first delivery COMPLETED after the kill: the fleet
+        # is answering again (failover absorbed the loss)
+        with lock:
+            done_after = sorted(
+                v["done_at"] - t_kill for v in records.values()
+                if v["status"] == "delivered" and v["done_at"] > t_kill)
+        extra["kill_to_recovered_s"] = (done_after[0] if done_after
+                                        else None)
+        # open the corpse's breaker deterministically: concurrent fires
+        # (a lone sequential request always picks the idle local
+        # replica and the corpse would never be touched again)
+        t0p = time.monotonic()
+        rid = 30_000
+        while (router.breaker_state(1) == "closed"
+               and time.monotonic() - t0p < 15.0):
+            # graftlint: daemon-ok(drill request workers, joined on the
+            # next line)
+            burst = [threading.Thread(target=fire, args=(rid + k,))
+                     for k in range(4)]
+            rid += 4
+            for t in burst:
+                t.start()
+            for t in burst:
+                t.join(timeout=60.0)
+        chaos_ids = sorted(r for r in records if r >= base)
+        remote.close()
+        extra["remote_rc"] = popen.returncode
+
+    else:   # scale_storm
+        router = ReplicaRouter([local], name="drill", breaker_errs=2,
+                               breaker_cooldown_s=0.5, hedge_pctl=0)
+        plock = threading.Lock()
+
+        def spawn():
+            with plock:
+                ent: Dict[str, Any] = {
+                    "label": f"r{len(procs) + 1}",
+                    "t_spawn": time.monotonic(),
+                    "first_served_s": None, "exit_code": None}
+                procs.append(ent)
+            popen, port = _spawn_replica(a.dir, ent["label"])
+            ent["popen"] = popen
+            rr = RemoteReplica("127.0.0.1", port, name=ent["label"])
+            ent["rr"] = rr
+            return rr
+
+        def retire(eng_, index: int) -> None:
+            ent = next((e for e in procs if e.get("rr") is eng_), None)
+            try:
+                eng_.preempt()
+            except BaseException:
+                pass        # already dead (the preempt-under-load leg)
+            if ent is not None and ent.get("popen") is not None:
+                try:
+                    ent["exit_code"] = ent["popen"].wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    ent["popen"].kill()
+                    ent["exit_code"] = -9
+            eng_.close()
+
+        sup = FleetSupervisor(router, spawn, retire=retire, enabled=True,
+                              min_replicas=1, max_replicas=3,
+                              cooldown_s=0.3, interval_s=0.05,
+                              up_queue=0.75, down_queue=0.05,
+                              pool_high=0.95,
+                              warmup_kwargs={"max_len": 8})
+        sup.start()
+        for rid in range(a.steady):
+            fire(rid)
+        base = a.steady
+        # -- the burst: keep ~12 requests in flight until the fleet
+        # reaches 3 SERVING replicas and each joiner took traffic ------
+        threads: List[threading.Thread] = []
+        next_rid = base
+        storm_deadline = time.monotonic() + 240.0
+        while time.monotonic() < storm_deadline:
+            threads = [t for t in threads if t.is_alive()]
+            while len(threads) < 12:
+                # graftlint: daemon-ok(drill request workers, joined
+                # in-scope below before the drill writes its verdict)
+                t = threading.Thread(target=fire, args=(next_rid,))
+                next_rid += 1
+                t.start()
+                threads.append(t)
+            for r in list(router._replicas):
+                if r.index == 0 or r.state != REPLICA_SERVING:
+                    continue
+                ent = next((e for e in procs
+                            if e.get("rr") is r.engine), None)
+                if (ent is not None and ent["first_served_s"] is None
+                        and r.in_flight > 0):
+                    ent["first_served_s"] = round(
+                        time.monotonic() - ent["t_spawn"], 3)
+            if (router.fleet_stats()["scale_ups"] >= 2
+                    and all(e["first_served_s"] is not None
+                            for e in procs if e.get("rr"))):
+                break
+            time.sleep(0.01)
+        # -- graceful preemption UNDER LOAD: SIGTERM the youngest remote
+        # while rows are queued on it — the queued rows come back as
+        # typed draining sheds over the wire and fail over token-exact
+        victims = [e for e in procs if e.get("rr") is not None]
+        queued_at_preempt = 0
+        if victims:
+            ent = victims[-1]
+            vr = next((r for r in list(router._replicas)
+                       if r.engine is ent["rr"]), None)
+            deadline = time.monotonic() + 10.0
+            while (vr is not None and time.monotonic() < deadline
+                   and vr.in_flight < 3):
+                time.sleep(0.002)
+            queued_at_preempt = vr.in_flight if vr is not None else 0
+            try:
+                ent["rr"].preempt()
+                ent["exit_code"] = ent["popen"].wait(timeout=60)
+            except BaseException as e:
+                extra["preempt_error"] = repr(e)
+        extra["queued_at_preempt"] = queued_at_preempt
+        for t in threads:
+            t.join(timeout=300.0)
+        chaos_ids = list(range(base, next_rid))
+        # -- the burst subsided: the supervisor shrinks back to 1, each
+        # scale-down a drain → preempt → exit-83 retirement ------------
+        down_deadline = time.monotonic() + 120.0
+        while time.monotonic() < down_deadline:
+            if (router.serving_replicas() == 1
+                    and all(e.get("exit_code") is not None
+                            for e in procs if e.get("popen"))):
+                break
+            time.sleep(0.05)
+        sup.stop()
+        for e in procs:
+            e.pop("rr", None)
+            e.pop("popen", None)
+            e.pop("t_spawn", None)
+
+    engine.waitall()
+
+    # token-exactness of every delivered response vs the eager oracle
+    token_exact = True
+    oracle_cache: Dict[str, List[int]] = {}
+    for rid, rec in sorted(records.items()):
+        if rec["status"] != "delivered":
+            continue
+        key = str(prompt_of(rid))
+        if key not in oracle_cache:
+            oracle_cache[key] = eager_generate(
+                model, params, prompt_of(rid), a.max_new)
+        if rec["tokens"] != oracle_cache[key]:
+            token_exact = False
+            rec["oracle"] = oracle_cache[key]
+
+    st = router.stats()
+    remotes = []
+    for e in procs:
+        rres = _read_result(a.dir, e["label"]) or {}
+        remotes.append({
+            "label": e["label"],
+            "exit_code": e.get("exit_code"),
+            "first_served_s": e.get("first_served_s"),
+            "preempted_code": rres.get("preempted_code"),
+            "fresh_compiles": (rres.get("disk") or {}).get("misses"),
+            "disk_hits": (rres.get("disk") or {}).get("hits"),
+            "leaked_pages": rres.get("leaked_pages"),
+            "pool_audit": rres.get("pool_audit"),
+            "shed_draining": (rres.get("served") or {}).get(
+                "shed_draining"),
+        })
+    telemetry.flush()       # shard == the snapshot this result records
+    res = {
+        "label": a.label, "mode": a.mode, "pid": os.getpid(),
+        "preempted_code": None,
+        "steady_ids": list(range(a.steady)),
+        "chaos_ids": chaos_ids,
+        "drain_ids": [],
+        "records": {str(k): v for k, v in records.items()},
+        "token_exact": token_exact,
+        "steady_p99_s": None,
+        "leaked_pages": pool0.in_use(),
+        "pool_audit": [m for m in pool0.audit()],
+        "router": {k: v for k, v in st.items() if k != "replicas"},
+        "replica_states": [r["state"] for r in st["replicas"]],
+        "breakers": [r["breaker"] for r in st["replicas"]],
+        "remotes": remotes,
+        "telemetry": telemetry.snapshot(),
+        **extra,
+    }
+    with open(os.path.join(a.dir, f"result-{a.label}.json"), "w") as f:
+        json.dump(res, f)
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -1424,7 +1809,10 @@ def _drill_router(root: str, failures: List[str],
             "--steady", "12", "--requests", "8", "--max-new", "10"]
     if mode in ("kill", "prefix_storm"):
         argv += ["--preempt"]
-    c1 = _run_child(argv, _child_env(root, 1))
+    # the fleet cells spawn replica subprocesses (a JAX boot each):
+    # give the child a longer leash than the in-process cells
+    timeout = 600.0 if mode in ("scale_storm", "host_loss") else 300.0
+    c1 = _run_child(argv, _child_env(root, 1), timeout=timeout)
     res = _read_result(scen, "c1") or {}
     report["exit_code_c1"] = c1.returncode
     want_code = ((res.get("preempted_code") or 83)
@@ -1554,6 +1942,80 @@ def _drill_router(root: str, failures: List[str],
         report["prefix_miss_blocks"] = res.get("prefix_miss_blocks")
         report["prefix_hit_rate"] = res.get("prefix_hit_rate")
         report["prefix_cow_forks"] = res.get("prefix_cow_forks")
+    elif mode == "scale_storm":
+        fleet = (rt.get("fleet") or {})
+        remotes = res.get("remotes") or []
+        report["fleet"] = fleet
+        report["remotes"] = remotes
+        report["join_to_first_served_s"] = max(
+            (r["first_served_s"] for r in remotes
+             if r.get("first_served_s") is not None), default=None)
+        if fleet.get("scale_ups", 0) < 2:
+            failures.append(
+                f"router[scale_storm] autoscaler counted "
+                f"{fleet.get('scale_ups')} scale_ups, wanted >=2 "
+                "(the fleet never reached 3 replicas)")
+        if fleet.get("scale_downs", 0) < 2:
+            failures.append(
+                f"router[scale_storm] autoscaler counted "
+                f"{fleet.get('scale_downs')} scale_downs, wanted >=2 "
+                "(the fleet never shrank back)")
+        if fleet.get("drains", 0) < 2:
+            failures.append(
+                "router[scale_storm] scale-down skipped the graceful "
+                f"drain ({fleet.get('drains')} drains for "
+                f"{fleet.get('scale_downs')} scale_downs)")
+        states = res.get("replica_states") or []
+        if sum(1 for s in states if s == "serving") != 1:
+            failures.append(
+                f"router[scale_storm] fleet did not settle back to 1 "
+                f"SERVING replica: {states}")
+        for r in remotes:
+            if r.get("exit_code") != 83:
+                failures.append(
+                    f"router[scale_storm] remote {r.get('label')} "
+                    f"exited {r.get('exit_code')}, wanted the "
+                    "distinguished preemption code 83")
+            if r.get("fresh_compiles"):
+                failures.append(
+                    f"router[scale_storm] remote {r.get('label')} "
+                    f"performed {r['fresh_compiles']} fresh compiles "
+                    "(wanted 0: warm join off the shared program cache)")
+            if r.get("leaked_pages"):
+                failures.append(
+                    f"router[scale_storm] remote {r.get('label')} "
+                    f"leaked {r['leaked_pages']} KV pages")
+            if r.get("pool_audit"):
+                failures.append(
+                    f"router[scale_storm] remote {r.get('label')} "
+                    f"pool audit failed: {r['pool_audit']}")
+            if r.get("first_served_s") is None:
+                failures.append(
+                    f"router[scale_storm] remote {r.get('label')} "
+                    "joined but never served a request")
+        if res.get("queued_at_preempt", 0) > 2:
+            sheds = sum(int(r.get("shed_draining") or 0)
+                        for r in remotes)
+            if not sheds:
+                failures.append(
+                    "router[scale_storm] preempt-under-load had "
+                    f"{res['queued_at_preempt']} rows queued on the "
+                    "victim but no typed draining shed came back over "
+                    "the wire (the handback path never ran)")
+    elif mode == "host_loss":
+        report["kill_to_recovered_s"] = res.get("kill_to_recovered_s")
+        if not rt.get("failovers"):
+            failures.append(
+                "router[host_loss] counted no failovers — the killed "
+                "host's requests were not re-routed")
+        if res.get("kill_to_recovered_s") is None:
+            failures.append(
+                "router[host_loss] never delivered a request after the "
+                "SIGKILL (the fleet did not recover)")
+        if not rt.get("breaker_opens"):
+            failures.append(
+                "router[host_loss] never opened the dead host's "
+                "breaker")
     elif mode == "deadline_storm":
         for r, v in sorted(records.items()):
             b = v.get("budget_s")
@@ -1626,11 +2088,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ro.add_argument("--label", default="c1")
     ro.add_argument("--mode", default="kill",
                     choices=("kill", "wedge", "flap", "deadline_storm",
-                             "prefix_storm"))
+                             "prefix_storm", "scale_storm", "host_loss"))
     ro.add_argument("--steady", type=int, default=12)
     ro.add_argument("--requests", type=int, default=8)
     ro.add_argument("--max-new", type=int, default=10, dest="max_new")
     ro.add_argument("--preempt", action="store_true")
+
+    rp = sub.add_parser("replica", help="cross-host replica child "
+                                        "(ISSUE 17)")
+    rp.add_argument("--dir", required=True)
+    rp.add_argument("--label", default="r1")
+    rp.add_argument("--ttl", type=float, default=600.0)
 
     r = sub.add_parser("run", help="orchestrate scenarios")
     r.add_argument("scenarios", nargs="*", default=list(SCENARIOS))
@@ -1644,6 +2112,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_decode(a)
     if a.cmd == "router":
         return _cmd_router(a)
+    if a.cmd == "replica":
+        return _cmd_replica(a)
     import tempfile
 
     root = a.root or tempfile.mkdtemp(prefix="mxnet-drills-")

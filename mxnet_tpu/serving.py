@@ -277,6 +277,7 @@ class ServingEngine:
         _engine.register_drainable(self)
         self._threads: List[threading.Thread] = []
         self._closed = False
+        self._draining = False    # per-replica drain (ISSUE 17)
         self._latencies: "deque[float]" = deque(maxlen=8192)
         # per-engine counters live in the telemetry registry under a
         # unique instance prefix (family 'serving.engine'); stats()
@@ -289,6 +290,9 @@ class ServingEngine:
              "shed_draining", "shed_deadline"),
             doc="ServingEngine per-instance counters",
             family="serving.engine")
+        # load() fields double as registered computed gauges (ISSUE
+        # 17): balancer, autoscaler, and perf gate read one surface
+        _telemetry.register_load_gauges(self, self._stats.prefix)
 
     # -- public ------------------------------------------------------------
     def infer(self, *args):
@@ -308,8 +312,9 @@ class ServingEngine:
 
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
-        if _preemption.draining():
-            # preemption notice taken: refuse IMMEDIATELY and typed —
+        if _preemption.draining() or self._draining:
+            # preemption notice taken (or this ONE replica is leaving
+            # the fleet, ISSUE 17): refuse IMMEDIATELY and typed —
             # accepted requests still deliver, new ones never park
             # toward the grace deadline
             self._stats.inc("shed_draining")
@@ -444,6 +449,16 @@ class ServingEngine:
         else:
             out["p50_us"] = out["p99_us"] = out["mean_us"] = 0.0
         return out
+
+    def begin_drain(self) -> None:
+        """Per-replica drain (the router's ``drain_replica`` handback
+        hook, ISSUE 17): new admissions on this ONE engine shed typed
+        ``draining`` (the router fails them over to a SERVING
+        replica); already-accepted requests still deliver.  The
+        process-wide analog is the preemption notice."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
 
     def drain(self, timeout: float = 60.0) -> None:
         """engine.waitall() hook: block until every accepted request has

@@ -854,6 +854,7 @@ class GenerativeEngine:
         self._joined = 0
         self._thread: Optional[threading.Thread] = None
         self._closed = False
+        self._draining = False    # per-replica drain (ISSUE 17)
         self._latencies: "deque[float]" = deque(maxlen=8192)
         # per-model counters live in the telemetry registry under a
         # unique instance prefix (family 'decode.engine'); stats() still
@@ -867,6 +868,10 @@ class GenerativeEngine:
              "bucket_fallbacks"),
             doc=f"GenerativeEngine counters (model {self.name!r})",
             family="decode.engine")
+        # the load() fields double as registered computed gauges
+        # (ISSUE 17): the autoscaler, dashboards, and check_perf_delta
+        # all read the SAME numbers the router balances on
+        _telemetry.register_load_gauges(self, self._stats.prefix)
         from . import engine as _engine
 
         _engine.register_drainable(self)
@@ -1013,6 +1018,29 @@ class GenerativeEngine:
                     return
             time.sleep(0.002)
 
+    # -- elastic-fleet hooks (ISSUE 17) --------------------------------------
+    def begin_drain(self) -> None:
+        """Per-replica drain (the router's ``drain_replica`` handback
+        hook): flip this ONE engine draining — new admissions and the
+        queued-but-not-live backlog shed typed ``draining``
+        immediately (the router fails them over token-exact to a
+        SERVING replica), while live rows keep decoding to
+        completion.  The process-wide analog is the preemption
+        notice; this is the same machinery scoped to one engine."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+
+    def pool_audit(self) -> List[str]:
+        """Detach-time page accounting (``PagePool.audit()``): every
+        page free, cached, or referenced exactly once — [] == clean."""
+        return list(self._pool.audit())
+
+    def pool_in_use(self) -> int:
+        """Referenced (non-free, non-cached) pages right now — the
+        leak check a detaching replica must read 0 on."""
+        return int(self._pool.in_use())
+
     def close(self) -> None:
         with self._cv:
             self._closed = True
@@ -1054,13 +1082,16 @@ class GenerativeEngine:
         """Fail-fast admission in the CALLER's thread: the injectable
         ``serving.admit`` site plus the draining / queue / pool / SLO
         checks — every refusal is an immediate typed ShedError."""
-        if _preemption.draining():
-            # preemption notice taken: NEVER park a new request toward
-            # the grace deadline — shed typed so the client re-queues
-            # on another replica or after the restart
+        if _preemption.draining() or self._draining:
+            # preemption notice taken (process-wide) or this ONE
+            # replica is leaving the fleet (begin_drain, ISSUE 17):
+            # NEVER park a new request toward the grace deadline —
+            # shed typed so the client re-queues on another replica
+            # or after the restart
             self._shed("draining",
-                       "engine draining after a preemption notice; "
-                       "re-queue this request after the restart")
+                       "engine draining (preemption notice or replica "
+                       "drain); re-queue this request on another "
+                       "replica or after the restart")
         try:
             _faults.inject("serving.admit")
         except _faults.FaultInjected as e:
@@ -1135,13 +1166,15 @@ class GenerativeEngine:
             req.event.set()
 
     def _requeue_for_drain(self) -> None:
-        """Preemption drain: queued-but-not-yet-prefilled requests are
+        """Drain handback (process preemption or a per-replica
+        ``begin_drain``): queued-but-not-yet-prefilled requests are
         handed BACK to their callers as typed ``draining`` sheds (their
         pages were never allocated, their tokens never computed — a
-        resubmission after restart is token-exact by greedy
-        determinism), while LIVE rows keep decoding to completion.
-        That bounds the drain to the in-flight tail and guarantees 0
-        leaked pages once ``engine.waitall()`` returns."""
+        resubmission after restart, or a router failover to a SERVING
+        replica, is token-exact by greedy determinism), while LIVE
+        rows keep decoding to completion.  That bounds the drain to
+        the in-flight tail and guarantees 0 leaked pages once
+        ``engine.waitall()`` returns."""
         with self._cv:
             reqs, self._queue = list(self._queue), deque()
         for req in reqs:
@@ -1168,7 +1201,7 @@ class GenerativeEngine:
     def _iteration(self) -> None:
         """One scheduler iteration: admit prefills into free rows, run
         one decode step over the union of live sequences, retire."""
-        if _preemption.draining():
+        if _preemption.draining() or self._draining:
             self._requeue_for_drain()
         # -- join: newly arrived prefills slot into freed rows
         while len(self._live) < self._rows:

@@ -51,15 +51,42 @@ Tail at Scale") on primitives PRs 2–13 already proved:
    the router edge, and ``engine.waitall()`` drains the router's
    in-flight dispatches like every other drainable.
 
+6. **Elastic membership (ISSUE 17)** — the fleet changes shape under
+   fire.  :meth:`ReplicaRouter.add_replica` /
+   :meth:`~ReplicaRouter.drain_replica` move a replica through JOINING
+   → SERVING → DRAINING → GONE: a joining replica warms
+   (``engine.warmup()`` + the persistent program cache — 0 fresh
+   compiles when ``MXNET_PROGRAM_CACHE_DIR`` is warm) BEFORE taking
+   traffic; a draining replica finishes its in-flight rows, hands
+   queued work back through token-exact failover (a per-replica
+   ``draining`` shed fails over; only a process-wide preemption
+   refuses), and detaches with a clean ``PagePool.audit()``.
+   Membership mutations happen under one site
+   (``faults.inject("router.scale")``) and never race
+   dispatch/hedge/probe threads: indices are append-only, retired
+   replicas stay as GONE tombstones, and ``_pick`` only ever sees
+   SERVING.  Replicas may live in other processes/hosts
+   (:class:`~mxnet_tpu.serving_remote.RemoteReplica`) — same breaker,
+   wedge, deadline, and trace semantics over the wire.
+   :class:`FleetSupervisor` closes the loop: an
+   ``MXNET_ROUTER_AUTOSCALE`` thread prices scale-up/down from the
+   same live telemetry ``_pick`` balances on (queue depth, page-pool
+   headroom, fleet p99 — arXiv:2008.01040's measure-don't-guess) and
+   executes scale-down as exactly a scheduled graceful preemption
+   (SIGTERM → typed draining sheds → drain → exit 83), so autoscaling
+   exercises, not bypasses, the PR-11 machinery.
+
 The chaos matrix lives in ``mxnet_tpu/drills.py`` (``router`` child:
 replica kill mid-decode, wedged-dispatch hang, breaker flap, deadline
-storm) and is gated by ``tools/check_availability_budget.py``: 0
-dropped requests, failover p99 inside a budget multiple of
-steady-state p99, 0 leaked KV pages after a kill, breaker re-admission
-inside the probe budget.  ``tools/check_dispatch_budget.py``'s
-``router`` lane pins zero-overhead-off: one replica, hedging off,
-breaker closed — dispatch/retrace/host-sync counts identical to the
-bare engine.
+storm, shared-prefix storm, scale storm, remote host loss) and is
+gated by ``tools/check_availability_budget.py``: 0 dropped requests,
+failover p99 inside a budget multiple of steady-state p99, 0 leaked
+KV pages after a kill, breaker re-admission inside the probe budget,
+join-to-first-served and kill-to-recovered inside declared walls.
+``tools/check_dispatch_budget.py``'s ``router`` lane pins
+zero-overhead-off: one replica, hedging off, breaker closed, no
+supervisor — dispatch/retrace/host-sync counts identical to the bare
+engine.
 """
 from __future__ import annotations
 
@@ -76,11 +103,23 @@ from .faults import ShedError
 from .parallel.elastic import HeartbeatMonitor
 
 __all__ = ["ReplicaRouter", "CircuitBreaker", "ReplicaUnavailable",
-           "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN"]
+           "FleetSupervisor",
+           "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN",
+           "REPLICA_JOINING", "REPLICA_SERVING", "REPLICA_DRAINING",
+           "REPLICA_GONE"]
 
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
 BREAKER_HALF_OPEN = "half_open"
+
+# replica membership lifecycle (ISSUE 17).  Append-only indices:
+# a retired replica stays in the list as a GONE tombstone so every
+# in-flight ``req.failed`` set, breaker hook, and telemetry record
+# keeps its index meaning forever.
+REPLICA_JOINING = "joining"      # admitted to the fleet, still warming
+REPLICA_SERVING = "serving"      # eligible for _pick / probe / hedge
+REPLICA_DRAINING = "draining"    # no new dispatches; in-flight finishing
+REPLICA_GONE = "gone"            # detached; tombstone only
 
 
 class ReplicaUnavailable(_faults.TransientFault):
@@ -190,15 +229,17 @@ class CircuitBreaker:
 
 
 class _Replica:
-    __slots__ = ("index", "engine", "breaker", "key", "in_flight")
+    __slots__ = ("index", "engine", "breaker", "key", "in_flight",
+                 "state")
 
     def __init__(self, index: int, engine, breaker: CircuitBreaker,
-                 key: str):
+                 key: str, state: str = REPLICA_SERVING):
         self.index = index
         self.engine = engine
         self.breaker = breaker
         self.key = key
         self.in_flight = 0
+        self.state = state
 
 
 class _Dispatch:
@@ -243,16 +284,45 @@ class _RouterRequest:
         self.trace_id: Optional[str] = None
 
 
+def _weak_serving_count(router: "ReplicaRouter"):
+    """Computed-gauge reader for the router's live SERVING count —
+    weakly bound so the registry never pins a dead router (and a
+    collected router reads 0, not a crash, at snapshot time)."""
+    import weakref
+
+    ref = weakref.ref(router)
+
+    def read() -> float:
+        r = ref()
+        if r is None:
+            return 0.0
+        return float(sum(1 for rep in r._replicas
+                         if rep.state == REPLICA_SERVING))
+    return read
+
+
+def _api_kind(engine) -> str:
+    if hasattr(engine, "generate"):
+        return "generate"
+    if hasattr(engine, "infer"):
+        return "infer"
+    raise TypeError(f"replica {type(engine).__name__} exposes neither "
+                    "infer() nor generate()")
+
+
 class ReplicaRouter:
-    """One ``infer()``/``generate()`` front over N co-hosted engine
-    replicas (all :class:`~mxnet_tpu.serving.ServingEngine`, or all
-    :class:`~mxnet_tpu.serving_decode.GenerativeEngine`); see the
-    module docstring for the design.  Thread-safe and blocking, like
-    the engines it fronts.
+    """One ``infer()``/``generate()`` front over N engine replicas
+    (all :class:`~mxnet_tpu.serving.ServingEngine`, all
+    :class:`~mxnet_tpu.serving_decode.GenerativeEngine`, or
+    :class:`~mxnet_tpu.serving_remote.RemoteReplica` shims over
+    either); see the module docstring for the design.  Thread-safe and
+    blocking, like the engines it fronts.
 
     ``replicas`` may hold the engines directly.  Every knob has a
     constructor override (tests/drills) and an ``MXNET_ROUTER_*``
-    default (deploy)."""
+    default (deploy).  Membership is dynamic: :meth:`add_replica` /
+    :meth:`drain_replica` (and :class:`FleetSupervisor` driving them
+    from telemetry)."""
 
     def __init__(self, replicas: Sequence, *, name: Optional[str] = None,
                  hedge_pctl: Optional[int] = None,
@@ -263,16 +333,7 @@ class ReplicaRouter:
                  wedge_s: Optional[float] = None):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
-        kinds = set()
-        for eng in replicas:
-            if hasattr(eng, "generate"):
-                kinds.add("generate")
-            elif hasattr(eng, "infer"):
-                kinds.add("infer")
-            else:
-                raise TypeError(
-                    f"replica {type(eng).__name__} exposes neither "
-                    "infer() nor generate()")
+        kinds = {_api_kind(eng) for eng in replicas}
         if len(kinds) != 1:
             raise ValueError(
                 "all replicas must serve the same API (got a mix of "
@@ -298,16 +359,30 @@ class ReplicaRouter:
              "probes", "probe_failures", "wedged", "eager_fallbacks"),
             doc=f"ReplicaRouter counters (router {self.name!r})",
             family="serving.router")
+        # fleet-lifecycle counters (ISSUE 17): membership and scaling
+        # events, one family the perf gate holds tolerances on
+        self._fleet = _telemetry.CounterGroup(
+            _telemetry.instance_name("router.fleet"),
+            ("joins", "drains", "gone", "warm_programs", "scale_ups",
+             "scale_downs", "ticks", "scale_errors"),
+            doc=f"Elastic fleet lifecycle counters (router "
+                f"{self.name!r})",
+            family="router.fleet")
+        _telemetry.gauge_fn(
+            f"{self._fleet.prefix}.serving_replicas",
+            _weak_serving_count(self),
+            doc="Live SERVING replica count of this router (computed "
+                "at snapshot; 0 after the router is garbage-collected)",
+            family="router.fleet")
+        # breaker overrides are remembered so a replica joining later
+        # (add_replica / the autoscaler) gets the same configuration
+        # the founding replicas did
+        self._breaker_kw = dict(errs=breaker_errs, window=breaker_window,
+                                cooldown_s=breaker_cooldown_s)
         self._lock = threading.Lock()
         self._replicas: List[_Replica] = []
-        for i, eng in enumerate(replicas):
-            breaker = CircuitBreaker(
-                errs=breaker_errs, window=breaker_window,
-                cooldown_s=breaker_cooldown_s,
-                on_transition=self._breaker_hook(i))
-            rep = _Replica(i, eng, breaker, f"{self.name}.replica{i}")
-            self._hb.beat(rep.key)          # born live
-            self._replicas.append(rep)
+        for eng in replicas:
+            self._admit_replica(eng, state=REPLICA_SERVING)
         # fleet dispatch latencies (successes only): the hedge
         # threshold's distribution + stats percentiles
         self._lat_dispatch: "deque[float]" = deque(maxlen=4096)
@@ -364,12 +439,14 @@ class ReplicaRouter:
         """Router counters, per-replica health, and request-latency
         percentiles."""
         out: Dict[str, Any] = dict(self._stats)
+        out["fleet"] = self.fleet_stats()
         out["replicas"] = [{
             "index": r.index,
+            "state": r.state,
             "breaker": r.breaker.state(),
             "in_flight": r.in_flight,
             "beat_age_s": self._hb.age(r.key),
-        } for r in self._replicas]
+        } for r in list(self._replicas)]
         lat = sorted(self._lat_request)
         if lat:
             out["p50_us"] = lat[len(lat) // 2] * 1e6
@@ -383,6 +460,167 @@ class ReplicaRouter:
     def breaker_state(self, index: int) -> str:
         return self._replicas[index].breaker.state()
 
+    def replica_state(self, index: int) -> str:
+        return self._replicas[index].state
+
+    def serving_replicas(self) -> int:
+        """Live SERVING count (the autoscaler's fleet-size input and
+        the ``router.fleet*.serving_replicas`` computed gauge)."""
+        return sum(1 for r in list(self._replicas)
+                   if r.state == REPLICA_SERVING)
+
+    def fleet_stats(self) -> Dict[str, Any]:
+        """Fleet-lifecycle counters + the per-state membership census."""
+        out: Dict[str, Any] = dict(self._fleet)
+        states = [r.state for r in list(self._replicas)]
+        out["replica_count"] = len(states)
+        for st in (REPLICA_JOINING, REPLICA_SERVING, REPLICA_DRAINING,
+                   REPLICA_GONE):
+            out[st] = states.count(st)
+        return out
+
+    # -- elastic membership (ISSUE 17) ---------------------------------------
+    def _admit_replica(self, eng, state: str) -> _Replica:
+        i = len(self._replicas)
+        breaker = CircuitBreaker(on_transition=self._breaker_hook(i),
+                                 **self._breaker_kw)
+        rep = _Replica(i, eng, breaker, f"{self.name}.replica{i}",
+                       state=state)
+        self._hb.beat(rep.key)          # born live
+        self._replicas.append(rep)
+        return rep
+
+    def add_replica(self, engine, *, warm: bool = True,
+                    warmup_kwargs: Optional[Dict[str, Any]] = None
+                    ) -> int:
+        """Join ``engine`` to the fleet: JOINING (no traffic) → warm
+        via ``engine.warmup()`` + the persistent program cache (0
+        fresh compiles when ``MXNET_PROGRAM_CACHE_DIR`` is warm) →
+        SERVING.  The append happens under the membership lock with a
+        stable new index; dispatch/hedge/probe threads never see the
+        replica until its state flips to SERVING, so a join can never
+        race traffic onto a cold engine.  Returns the new index.
+
+        A failed warmup tombstones the replica (GONE) and re-raises —
+        the fleet is unchanged except for the tombstone."""
+        if self._closed:
+            raise RuntimeError("ReplicaRouter is closed")
+        kind = _api_kind(engine)
+        if kind != self._kind:
+            raise ValueError(
+                f"replica serves {kind}() but this router fronts "
+                f"{self._kind}() replicas")
+        # membership changes share one fault site with the supervisor:
+        # an injected fault here = a scale-up that never happened
+        _faults.inject("router.scale")
+        with self._lock:
+            rep = self._admit_replica(engine, state=REPLICA_JOINING)
+        self._fleet.inc("joins")
+        _telemetry.event("replica_join", self.name, replica=rep.index,
+                         state=REPLICA_JOINING)
+        t0 = time.monotonic()
+        warmed = 0
+        if warm and hasattr(engine, "warmup"):
+            try:
+                warmed = int(engine.warmup(**(warmup_kwargs or {})) or 0)
+            except BaseException as e:
+                rep.state = REPLICA_GONE
+                self._fleet.inc("gone")
+                _telemetry.event("replica_gone", self.name,
+                                 replica=rep.index,
+                                 reason=f"warmup failed: {e!r}")
+                _faults.record_event("router.scale", "join_failed", e,
+                                     router=self.name,
+                                     replica=rep.index)
+                raise
+        self._fleet.inc("warm_programs", warmed)
+        self._hb.beat(rep.key)
+        rep.state = REPLICA_SERVING
+        _telemetry.event("replica_join", self.name, replica=rep.index,
+                         state=REPLICA_SERVING, warmed_programs=warmed,
+                         warm_s=round(time.monotonic() - t0, 3))
+        _faults.record_event("router.scale", "join", router=self.name,
+                             replica=rep.index)
+        return rep.index
+
+    def drain_replica(self, index: int, timeout: float = 60.0) -> bool:
+        """Gracefully retire replica ``index``: DRAINING (``_pick``
+        stops sending traffic), queued work hands back — the engine's
+        ``begin_drain()`` hook sheds its not-yet-live queue typed
+        ``draining``, and each blocked dispatch fails over token-exact
+        to a SERVING replica — in-flight rows finish, the KV pool is
+        audited, and the replica tombstones GONE.
+
+        Idempotent: draining a GONE replica returns True immediately; a
+        concurrent drain of the same replica waits for the owner to
+        finish.  Returns True when the replica detached clean (drained
+        inside ``timeout`` with a clean audit)."""
+        rep = self._replicas[index]
+        if rep.state == REPLICA_GONE:
+            return True
+        _faults.inject("router.scale")
+        with self._lock:
+            if rep.state == REPLICA_GONE:
+                return True
+            owner = rep.state != REPLICA_DRAINING
+            if owner:
+                rep.state = REPLICA_DRAINING
+        if not owner:
+            # another thread owns this drain: wait it out (idempotent
+            # double-drain, not a second lifecycle)
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if rep.state == REPLICA_GONE:
+                    return True
+                time.sleep(0.002)
+            return rep.state == REPLICA_GONE
+        self._fleet.inc("drains")
+        _telemetry.event("replica_drain", self.name, replica=index,
+                         in_flight=rep.in_flight)
+        _faults.record_event("router.scale", "drain", router=self.name,
+                             replica=index)
+        # handback: shed the engine's queued-but-not-live work typed
+        # 'draining' so the blocked router dispatches re-route NOW
+        # instead of waiting behind rows that will finish first
+        if hasattr(rep.engine, "begin_drain"):
+            try:
+                rep.engine.begin_drain()
+            except BaseException as e:
+                _faults.record_event("router.scale", "handback_failed",
+                                     e, router=self.name, replica=index)
+        deadline = time.monotonic() + timeout
+        drained = False
+        while time.monotonic() < deadline:
+            if rep.in_flight == 0:
+                drained = True
+                break
+            time.sleep(0.002)
+        audit = self._audit_replica(rep.engine)
+        rep.state = REPLICA_GONE
+        self._fleet.inc("gone")
+        _telemetry.event("replica_gone", self.name, replica=index,
+                         drained=drained, audit_clean=not audit,
+                         audit=audit[:4])
+        _faults.record_event("router.scale", "gone", router=self.name,
+                             replica=index, drained=drained,
+                             audit_clean=not audit)
+        return drained and not audit
+
+    @staticmethod
+    def _audit_replica(engine) -> List[str]:
+        """Detach-time page accounting: every page free/cached/
+        referenced exactly once (local engines via ``pool_audit()``,
+        remote replicas over the wire).  Engines with no KV pool audit
+        clean by construction."""
+        try:
+            if hasattr(engine, "pool_audit"):
+                return list(engine.pool_audit())
+            if hasattr(engine, "pool"):
+                return list((engine.pool() or {}).get("audit") or [])
+        except BaseException as e:
+            return [f"audit unavailable: {e!r}"]
+        return []
+
     def probe(self, index: Optional[int] = None) -> Dict[int, bool]:
         """Actively probe open/half-open replicas with a zero-cost
         liveness call (``engine.load()``): a responsive replica's
@@ -391,9 +629,11 @@ class ReplicaRouter:
         half-open dispatch) is the primary re-admission path — this is
         the explicit hook for idle fleets and drills."""
         out: Dict[int, bool] = {}
-        targets = (self._replicas if index is None
+        targets = (list(self._replicas) if index is None
                    else [self._replicas[index]])
         for r in targets:
+            if r.state != REPLICA_SERVING:
+                continue                 # joining/draining/gone: no probe
             if r.breaker.state() == BREAKER_CLOSED:
                 continue
             self._stats.inc("probes")
@@ -540,8 +780,12 @@ class ReplicaRouter:
         replica index."""
         closed_scored = []
         half: List[_Replica] = []
-        for r in self._replicas:
+        for r in list(self._replicas):
             if r.index in exclude:
+                continue
+            if r.state != REPLICA_SERVING:
+                # JOINING warms first, DRAINING finishes what it has,
+                # GONE is a tombstone — none take new traffic
                 continue
             st = r.breaker.state()
             if st == BREAKER_CLOSED:
@@ -563,7 +807,14 @@ class ReplicaRouter:
 
     def _score(self, r: _Replica,
                prompt: Optional[List[int]] = None) -> float:
-        load = r.engine.load() if hasattr(r.engine, "load") else {}
+        try:
+            load = r.engine.load() if hasattr(r.engine, "load") else {}
+        except BaseException:
+            # an unreachable replica (dead remote host) prices itself
+            # to the back of the pick order — scoring never throws;
+            # the dispatch that eventually hits it owns the blame
+            # (breaker + failover)
+            return float("inf")
         score = (float(r.in_flight)
                  + float(load.get("queue_depth", 0.0))
                  + float(load.get("in_flight", 0.0))
@@ -676,7 +927,16 @@ class ReplicaRouter:
                 for f in flights:
                     self._abandon(f, "request fault")
                 raise e
-            d.replica.breaker.record_failure(repr(e))
+            if isinstance(e, ShedError) and \
+                    getattr(e, "kind", None) == "draining":
+                # a deliberate drain (scale-down / remote preemption)
+                # handing queued work back — the replica is leaving,
+                # not sick: no breaker blame, just re-route
+                _telemetry.event("handback", self.name,
+                                 replica=d.replica.index,
+                                 label=req.label)
+            else:
+                d.replica.breaker.record_failure(repr(e))
             req.failed.add(d.replica.index)
             last_err = e
             if not flights:
@@ -692,7 +952,16 @@ class ReplicaRouter:
         inside the process is futile; the client must re-queue
         elsewhere), or plainly bad arguments."""
         if isinstance(e, ShedError):
-            return e.kind in ("deadline", "draining")
+            if e.kind == "deadline":
+                return True
+            if e.kind == "draining":
+                # only a PROCESS-WIDE preemption makes a draining shed
+                # the request's problem.  One replica draining (a
+                # scale-down, a remote replica's own preemption) hands
+                # its queued work back: failover re-runs it
+                # token-exact on a SERVING replica (ISSUE 17)
+                return _preemption.draining()
+            return False
         return isinstance(e, (ValueError, TypeError))
 
     def _launch(self, replica: _Replica, req: _RouterRequest,
@@ -807,3 +1076,237 @@ class ReplicaRouter:
         self._shed("unavailable",
                    f"every replica unhealthy for {req.label} "
                    f"({cause!r})", cause=cause)
+
+
+class FleetSupervisor:
+    """The autoscaler: a supervisor loop that prices scale-up/down
+    from the SAME live telemetry the router balances on — mean queued
+    work per SERVING replica (engine ``load()``: queue depth +
+    in-flight occupancy), worst page-pool pressure, and the router's
+    request p99 — never static thresholds alone (arXiv:2008.01040).
+
+    - **Scale-up**: ``spawn()`` (caller-supplied: a co-hosted engine,
+      or a :class:`~mxnet_tpu.serving_remote.RemoteReplica` over a
+      process the caller launched) joins via
+      :meth:`ReplicaRouter.add_replica` — warmed before it serves.
+    - **Scale-down**: exactly a scheduled graceful preemption.  The
+      youngest SERVING replica drains (:meth:`~ReplicaRouter.
+      drain_replica`: typed ``draining`` handback + clean audit), and
+      a process-backed replica is then told to ``preempt()`` — SIGTERM
+      → ``engine.waitall()`` → exit ``MXNET_PREEMPTION_EXIT_CODE``
+      (83); the PR-11 machinery IS the retirement path.
+    - **Stability**: min/max bounds, one scaling action per
+      ``cooldown_s`` (injectable ``clock`` so the state machine
+      unit-tests without waiting), and a decision loop that never
+      raises (errors land in ``router.fleet*.scale_errors`` + the
+      ``router.scale`` fault-site event stream).
+
+    ``start()`` is a no-op unless ``MXNET_ROUTER_AUTOSCALE`` (or the
+    ``enabled=True`` override) — the zero-overhead-off contract: a
+    disabled supervisor adds no thread, no timer, no dispatch."""
+
+    def __init__(self, router: ReplicaRouter, spawn: Callable[[], Any],
+                 *, retire: Optional[Callable[[Any, int], None]] = None,
+                 enabled: Optional[bool] = None,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 interval_s: Optional[float] = None,
+                 up_queue: Optional[float] = None,
+                 down_queue: Optional[float] = None,
+                 pool_high: Optional[float] = None,
+                 warmup_kwargs: Optional[Dict[str, Any]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.router = router
+        self._spawn = spawn
+        self._retire = retire
+        self._enabled = bool(_config.get("MXNET_ROUTER_AUTOSCALE")
+                             if enabled is None else enabled)
+        self._min = int(_config.get("MXNET_ROUTER_MIN_REPLICAS")
+                        if min_replicas is None else min_replicas)
+        self._max = int(_config.get("MXNET_ROUTER_MAX_REPLICAS")
+                        if max_replicas is None else max_replicas)
+        if not (1 <= self._min <= self._max):
+            raise ValueError(
+                f"need 1 <= min_replicas ({self._min}) <= max_replicas "
+                f"({self._max})")
+        self._cooldown_s = float(
+            _config.get("MXNET_ROUTER_SCALE_COOLDOWN_S")
+            if cooldown_s is None else cooldown_s)
+        self._interval_s = float(
+            _config.get("MXNET_ROUTER_SCALE_INTERVAL_S")
+            if interval_s is None else interval_s)
+        self._up_queue = float(
+            _config.get("MXNET_ROUTER_SCALE_UP_QUEUE")
+            if up_queue is None else up_queue)
+        self._down_queue = float(
+            _config.get("MXNET_ROUTER_SCALE_DOWN_QUEUE")
+            if down_queue is None else down_queue)
+        self._pool_high = float(
+            _config.get("MXNET_ROUTER_SCALE_POOL_HIGH")
+            if pool_high is None else pool_high)
+        self._warmup_kwargs = warmup_kwargs
+        self._clock = clock
+        self._last_scale: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._mid_tick = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "FleetSupervisor":
+        """Spawn the supervisor thread (no-op when autoscaling is off
+        or it is already running)."""
+        if not self._enabled or self._thread is not None:
+            return self
+        from . import engine as _engine
+
+        _engine.register_drainable(self)
+        t = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"mxnet-fleet-supervisor-{self.router.name}")
+        self._thread = t
+        t.start()
+        return self
+
+    def drain(self, timeout: float = 60.0) -> None:
+        """engine.waitall() hook: wait out any in-progress scaling
+        action (a half-joined replica must finish warming or
+        tombstone).  A PROCESS preemption additionally parks the loop
+        for good — ``_loop`` checks ``preemption.draining()`` — but a
+        routine ``waitall`` leaves the supervisor running."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self._mid_tick:
+                return
+            time.sleep(0.002)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            if _preemption.draining():
+                return                   # the process is leaving
+            self._mid_tick = True
+            try:
+                self.tick()
+            except BaseException as e:   # the loop never dies
+                self.router._fleet.inc("scale_errors")
+                _faults.record_event("router.scale", "tick_error", e,
+                                     router=self.router.name)
+            finally:
+                self._mid_tick = False
+
+    # -- the decision -------------------------------------------------------
+    def signals(self) -> Dict[str, float]:
+        """The measured inputs one decision prices: mean queued work
+        per SERVING replica, worst page-pool pressure, fleet p99."""
+        reps = [r for r in list(self.router._replicas)
+                if r.state == REPLICA_SERVING]
+        queue = pool = 0.0
+        for r in reps:
+            try:
+                load = (r.engine.load()
+                        if hasattr(r.engine, "load") else {})
+            except BaseException:
+                continue                   # a dead replica prices as 0
+            queue += (float(load.get("queue_depth", 0.0))
+                      + float(load.get("in_flight", 0.0)))
+            pool = max(pool, float(load.get("pool_pressure", 0.0)))
+        lat = sorted(self.router._lat_request)
+        p99 = (lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+               if lat else 0.0)
+        return {"serving": float(len(reps)),
+                "queue_per_replica": queue / max(len(reps), 1),
+                "pool_pressure": pool,
+                "p99_s": p99}
+
+    def decide(self, sig: Optional[Dict[str, float]] = None
+               ) -> Optional[str]:
+        """``"up"``, ``"down"``, or ``None`` — pure pricing, no
+        execution, no cooldown (tick applies those): up when the fleet
+        is saturated (queued work per replica past the knob, or KV
+        pool pressure critical) and under max; down when it is idle
+        and over min."""
+        sig = self.signals() if sig is None else sig
+        n = int(sig["serving"])
+        if n < self._min:
+            return "up"
+        if (sig["queue_per_replica"] >= self._up_queue
+                or sig["pool_pressure"] >= self._pool_high):
+            return "up" if n < self._max else None
+        if sig["queue_per_replica"] <= self._down_queue \
+                and sig["pool_pressure"] < self._pool_high / 2 \
+                and n > self._min:
+            return "down"
+        return None
+
+    def tick(self) -> Optional[str]:
+        """One supervisor step: read the signals, apply cooldown +
+        bounds, execute at most one scaling action.  Returns the
+        action taken (``"up"``/``"down"``) or ``None``.  Callable
+        directly (tests, drills) — the loop thread only calls this."""
+        self.router._fleet.inc("ticks")
+        sig = self.signals()
+        action = self.decide(sig)
+        if action is None:
+            return None
+        now = self._clock()
+        if self._last_scale is not None and \
+                now - self._last_scale < self._cooldown_s \
+                and int(sig["serving"]) >= self._min:
+            return None                  # cooling down (min is urgent)
+        if action == "up":
+            self._scale_up(sig)
+        else:
+            self._scale_down(sig)
+        self._last_scale = self._clock()
+        return action
+
+    def _scale_up(self, sig: Dict[str, float]) -> None:
+        t0 = time.monotonic()
+        engine = self._spawn()
+        index = self.router.add_replica(
+            engine, warmup_kwargs=self._warmup_kwargs)
+        self.router._fleet.inc("scale_ups")
+        _telemetry.event("scale_up", self.router.name, replica=index,
+                         join_s=round(time.monotonic() - t0, 3),
+                         **{k: round(v, 4) for k, v in sig.items()})
+
+    def _scale_down(self, sig: Dict[str, float]) -> None:
+        # retire the YOUNGEST serving replica: replica 0 (the founding
+        # member, often the local engine) is the last to go
+        victims = [r for r in list(self.router._replicas)
+                   if r.state == REPLICA_SERVING]
+        if len(victims) <= self._min:
+            return
+        victim = victims[-1]
+        clean = self.router.drain_replica(victim.index)
+        if self._retire is not None:
+            self._retire(victim.engine, victim.index)
+        elif hasattr(victim.engine, "preempt"):
+            # a process-backed replica exits through the PR-11 drain:
+            # SIGTERM → typed draining sheds → waitall → exit 83
+            try:
+                victim.engine.preempt()
+            except BaseException as e:
+                _faults.record_event("router.scale", "preempt_failed",
+                                     e, router=self.router.name,
+                                     replica=victim.index)
+        self.router._fleet.inc("scale_downs")
+        _telemetry.event("scale_down", self.router.name,
+                         replica=victim.index, clean=clean,
+                         **{k: round(v, 4) for k, v in sig.items()})

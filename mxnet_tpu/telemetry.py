@@ -146,6 +146,7 @@ class Counter:
 _COUNTERS: Dict[str, Counter] = {}
 _GAUGE_FNS: Dict[str, Callable[[], Any]] = {}
 _GAUGE_DOCS: Dict[str, str] = {}
+_GAUGE_FAMILIES: Dict[str, Optional[str]] = {}
 _SEQ: Dict[str, int] = {}
 
 
@@ -170,12 +171,53 @@ def gauge(name: str, doc: str = "",
     return counter(name, doc, kind="gauge", family=family)
 
 
-def gauge_fn(name: str, fn: Callable[[], Any], doc: str = "") -> None:
+def gauge_fn(name: str, fn: Callable[[], Any], doc: str = "",
+             family: Optional[str] = None) -> None:
     """Register a *computed* gauge: ``snapshot()`` calls ``fn()`` for its
-    value (e.g. ``engine.drainables`` = live drainable registrations)."""
+    value (e.g. ``engine.drainables`` = live drainable registrations).
+    Per-instance gauges pass ``family`` — the stable name the CI gate's
+    test-coverage check keys on, same as :func:`counter`."""
     with _LOCK:
         _GAUGE_FNS[name] = fn
         _GAUGE_DOCS[name] = doc
+        _GAUGE_FAMILIES[name] = family
+
+
+def register_load_gauges(engine, prefix: str) -> None:
+    """Expose an engine's live ``load()`` fields — queue depth,
+    in-flight occupancy, KV page-pool pressure — as computed gauges
+    under its counter-group prefix (``decode.engine0.queue_depth``
+    …), so the replica router's balancer, the fleet autoscaler,
+    dashboards, and ``check_perf_delta`` all read the SAME numbers
+    (ISSUE 17).  Weakly bound: a closed or collected engine reads 0.0
+    at snapshot time instead of pinning the instance alive."""
+    import weakref
+
+    ref = weakref.ref(engine)
+    # the family is the instance-stripped prefix ('decode.engine0' ->
+    # 'decode.engine'), matching the engines' CounterGroup family
+    fam = prefix.rstrip("0123456789")
+
+    def _field(key: str):
+        def read() -> float:
+            eng = ref()
+            if eng is None or getattr(eng, "_closed", False):
+                return 0.0
+            try:
+                return float(eng.load().get(key, 0.0))
+            except Exception:
+                return 0.0
+        return read
+
+    for key, doc in (
+            ("queue_depth", "Admitted-but-unscheduled requests on this "
+             "engine (live load() view; the balancer/autoscaler "
+             "input)"),
+            ("in_flight", "In-flight occupancy of this engine "
+             "(live rows / max rows, or staged batches; load() view)"),
+            ("pool_pressure", "KV page-pool pressure of this engine "
+             "(1 - free/total pages; 0 for engines without a pool)")):
+        gauge_fn(f"{prefix}.{key}", _field(key), doc=doc, family=fam)
 
 
 def get(name: str) -> Counter:
@@ -195,7 +237,7 @@ def registered() -> Dict[str, Dict[str, Any]]:
                for n, c in _COUNTERS.items()}
         for n in _GAUGE_FNS:
             out.setdefault(n, {"kind": "gauge", "doc": _GAUGE_DOCS[n],
-                               "family": None})
+                               "family": _GAUGE_FAMILIES.get(n)})
     return out
 
 

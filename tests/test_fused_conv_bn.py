@@ -307,11 +307,49 @@ def test_biased_conv_fuses_exactly(force_fused):
                                 rtol=5e-2, atol=5e-2, err_msg="weight_grad")
 
 
+def test_resnet18_fuses_conv_bn_sites_smoke(force_fused):
+    """Tier-1 smoke for whole-model conv+BN fusion: resnet18_v1 NHWC in
+    one hybridized train trace routes its 3 downsample 1x1 sites and 14
+    kxk sites (stride-1 3x3 blocks + the s2d stem) through the fused
+    ops.  The full 53-site resnet50 census rides the slow lane
+    (ISSUE-17 wall slice 2)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.ops.registry import get_op
+
+    net = vision.get_resnet(1, 18, layout="NHWC", input_layout="NHWC",
+                            stem_s2d=True)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(_rand(4, 32, 32, 3))
+    net(x)
+    net.hybridize()
+    counts = {"1x1": 0, "kxk": 0}
+    origs = {}
+    for kind in counts:
+        schema = get_op(f"_fused_conv{kind}_bn")
+        origs[kind] = (schema, schema.fn)
+
+        def counting(*a, _k=kind, _f=schema.fn, **kw):
+            counts[_k] += 1
+            return _f(*a, **kw)
+
+        schema.fn = counting
+    try:
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+    finally:
+        for schema, fn in origs.values():
+            schema.fn = fn
+    assert counts == {"1x1": 3, "kxk": 14}, counts
+
+
+@pytest.mark.slow
 def test_resnet50_fuses_all_conv_bn_sites(force_fused):
     """resnet50_v1 NHWC in one hybridized train trace: all 36 1x1 sites
     (16 bottlenecks x (conv1 + conv3) + 4 downsamples), all 16 3x3
     sites, AND the s2d stem's 4x4/pad-0 conv route through the fused
-    ops — 53 of 53 conv+BN pairs."""
+    ops — 53 of 53 conv+BN pairs.  Slow-marked (~30s trace); tier-1
+    keeps the resnet18 smoke above (ISSUE-17 wall slice 2)."""
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.ops.registry import get_op
 

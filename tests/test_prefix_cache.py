@@ -14,6 +14,8 @@ prefill parity vs the eager oracle AND vs a cold cache, seed for seed,
 and (6) ``MXNET_PREFIX_CACHE=0`` is a true off switch: byte-identical
 outputs with every ``prefix.*`` counter at zero.
 """
+import functools
+
 import numpy as onp
 import pytest
 
@@ -24,8 +26,17 @@ from mxnet_tpu import telemetry
 
 
 def tiny(seed=0, **kw):
+    """Module-shared model/params (ISSUE-17 wall slice 2): TinyCausalLM
+    is stateless config and the param pytree is immutable jax arrays,
+    so every test sharing a (seed, cfg) reuses ONE instance instead of
+    re-initializing per test."""
+    return _tiny_cached(seed, tuple(sorted(kw.items())))
+
+
+@functools.lru_cache(maxsize=None)
+def _tiny_cached(seed, kw_items):
     cfg = dict(vocab=31, d_model=16, n_layers=2, n_heads=2, max_seq=32)
-    cfg.update(kw)
+    cfg.update(dict(kw_items))
     model = sd.TinyCausalLM(**cfg)
     return model, model.init_params(seed)
 

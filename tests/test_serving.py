@@ -467,10 +467,32 @@ def test_train_step_bucket_refuses_unmasked_mean_loss():
 # ---------------------------------------------------------------------------
 # CI gate
 # ---------------------------------------------------------------------------
+def test_dispatch_budget_serving_lane_smoke():
+    """Tier-1 smoke for the gate's serving coverage: the INFER lane
+    alone through the gate's own `_measure_infer`, held to
+    INFER_BUDGET — 1 launch/batch, 0 retraces, programs <= buckets
+    over the randomized variable-length stream.  The full lane matrix
+    rides the slow lane (ISSUE-17 wall slice 2)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_dispatch_budget",
+        os.path.join(REPO, "tools", "check_dispatch_budget.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    row = mod._measure_infer()
+    assert row["bucket_refused"] is None
+    for key, budget in mod.INFER_BUDGET.items():
+        assert row[key] <= budget, (key, row[key], budget)
+
+
+@pytest.mark.slow
 def test_dispatch_budget_gate_covers_serving():
     """tools/check_dispatch_budget.py (run like check_fault_sites): the
     serving path must hold 1 launch/batch, 0 retraces, and programs <=
-    buckets over a randomized variable-length stream."""
+    buckets over a randomized variable-length stream.  Slow-marked
+    (full lane matrix); tier-1 keeps the infer-lane smoke above
+    (ISSUE-17 wall slice 2)."""
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(

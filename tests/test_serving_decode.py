@@ -13,6 +13,7 @@ steady-state retraces; dispatches == decode iterations + prefills),
 and (5) the per-model stats surface plus the dispatch-budget ``decode``
 lane run end-to-end by the tool gate.
 """
+import functools
 import threading
 import time
 
@@ -26,8 +27,17 @@ from mxnet_tpu import serving_decode as sd
 
 
 def tiny(seed=0, **kw):
+    """Module-shared model/params (ISSUE-17 wall slice 2): TinyCausalLM
+    is stateless config and the param pytree is immutable jax arrays,
+    so every test sharing a (seed, cfg) reuses ONE instance instead of
+    re-initializing per test."""
+    return _tiny_cached(seed, tuple(sorted(kw.items())))
+
+
+@functools.lru_cache(maxsize=None)
+def _tiny_cached(seed, kw_items):
     cfg = dict(vocab=31, d_model=16, n_layers=2, n_heads=2, max_seq=32)
-    cfg.update(kw)
+    cfg.update(dict(kw_items))
     model = sd.TinyCausalLM(**cfg)
     return model, model.init_params(seed)
 

@@ -19,6 +19,7 @@ generalized in-memory HeartbeatMonitor, and (7) the availability gate
 ``router`` zero-overhead lane (family ``serving.router`` counters),
 run end-to-end.
 """
+import functools
 import threading
 import time
 
@@ -44,8 +45,17 @@ def _pristine():
 
 
 def tiny(seed=0, **kw):
+    """Module-shared model/params (ISSUE-17 wall slice 2): TinyCausalLM
+    is stateless config and the param pytree is immutable jax arrays,
+    so every test sharing a (seed, cfg) reuses ONE instance instead of
+    re-initializing per test."""
+    return _tiny_cached(seed, tuple(sorted(kw.items())))
+
+
+@functools.lru_cache(maxsize=None)
+def _tiny_cached(seed, kw_items):
     cfg = dict(vocab=31, d_model=16, n_layers=1, n_heads=2, max_seq=48)
-    cfg.update(kw)
+    cfg.update(dict(kw_items))
     model = sd.TinyCausalLM(**cfg)
     return model, model.init_params(seed)
 
@@ -549,3 +559,246 @@ def test_availability_gate_subprocess_scenarios():
     import tools.check_availability_budget as gate
 
     assert gate.main(["router_kill", "router_deadline_storm"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# 11. elastic fleet membership (ISSUE 17)
+# ---------------------------------------------------------------------------
+def _mk_engine(model, params, max_rows=2, warm=None, name=None):
+    pool = sd.PagePool(pages=32, page=4)
+    eng = sd.GenerativeEngine(model, params=params, pool=pool,
+                              max_rows=max_rows, name=name)
+    if warm:
+        eng.warmup(max_len=warm)
+    return eng, pool
+
+
+def test_add_replica_serves_only_after_warmup():
+    """A joiner is JOINING (invisible to _pick) for the whole warmup;
+    the fleet keeps delivering through the incumbent, and the joiner
+    flips to SERVING only once warm."""
+    router, engines, pools, model, params = mk_router(n=1)
+    joiner, jpool = _mk_engine(model, params)
+    mid_warm = {}
+    real_warmup = joiner.warmup
+
+    def observed_warmup(**kw):
+        rep = router._replicas[1]
+        mid_warm["state"] = rep.state
+        mid_warm["serving"] = router.serving_replicas()
+        # traffic keeps flowing while the joiner warms
+        mid_warm["out"] = router.generate([5, 6, 7], max_new_tokens=3)
+        return real_warmup(**kw)
+
+    joiner.warmup = observed_warmup
+    idx = router.add_replica(joiner, warmup_kwargs={"max_len": 8})
+    assert idx == 1
+    assert mid_warm["state"] == sr.REPLICA_JOINING
+    assert mid_warm["serving"] == 1
+    assert mid_warm["out"] == sd.eager_generate(model, params,
+                                                [5, 6, 7], 3)
+    assert router._replicas[1].state == sr.REPLICA_SERVING
+    assert router.serving_replicas() == 2
+    fs = router.fleet_stats()
+    assert fs["joins"] == 1 and fs["serving"] == 2
+    # the fleet gauge rides the registry
+    snap = telemetry.snapshot()
+    assert any(k.endswith(".serving_replicas") and v == 2.0
+               for k, v in snap.items())
+    _engine.waitall()
+    assert jpool.in_use() == 0 and pools[0].in_use() == 0
+
+
+def test_drain_replica_idempotent_double_drain():
+    router, engines, pools, model, params = mk_router()
+    assert router.drain_replica(1) is True
+    assert router.drain_replica(1) is True     # GONE fast-path
+    fs = router.fleet_stats()
+    assert fs["drains"] == 1 and fs["gone"] == 1 and fs["serving"] == 1
+    # the survivor keeps serving token-exact
+    out = router.generate([2, 3, 4], max_new_tokens=4)
+    assert out == sd.eager_generate(model, params, [2, 3, 4], 4)
+    states = [r["state"] for r in router.stats()["replicas"]]
+    assert states == [sr.REPLICA_SERVING, sr.REPLICA_GONE]
+    _engine.waitall()
+    assert all(p.in_use() == 0 for p in pools)
+
+
+def test_drain_while_hedge_outstanding():
+    """Draining a replica with a hedged request still in flight on it:
+    the drain waits the row out, the request is delivered exactly
+    once, and the pool audits clean."""
+    router, engines, pools, model, params = mk_router(hedge_pctl=50)
+    for i in range(20):                       # arm the latency pctl
+        router.generate([1 + i % 7, 2], max_new_tokens=2)
+    real = engines[1].generate
+
+    def slow(*a, **kw):
+        time.sleep(0.8)
+        return real(*a, **kw)
+
+    engines[1].generate = slow
+    prompts = [[3, 4, 5], [6, 7, 8], [9, 10, 11], [12, 13, 14]]
+    outs = []
+    threads = [threading.Thread(
+        target=lambda p=p: outs.append(
+            (str(p), router.generate(p, max_new_tokens=3))))
+        for p in prompts]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5.0
+    while (router._replicas[1].in_flight == 0
+           and time.monotonic() < deadline):
+        time.sleep(0.002)
+    assert router._replicas[1].in_flight > 0   # a row is live there
+    assert router.drain_replica(1, timeout=30.0) is True
+    for t in threads:
+        t.join(timeout=30.0)
+    assert len(outs) == 4                      # each delivered once
+    oracle = {str(p): sd.eager_generate(model, params, p, 3)
+              for p in prompts}
+    for key, out in outs:
+        assert out == oracle[key]
+    assert router.fleet_stats()["drains"] == 1
+    _engine.waitall()
+    assert all(p.in_use() == 0 for p in pools)
+
+
+def test_supervisor_cooldown_and_bounds_injectable_clock():
+    """The autoscaler state machine without waiting: up on saturation,
+    capped at max, one action per cooldown, down on idle, floored at
+    min — all on an injected clock and injected signals."""
+    router, engines, pools, model, params = mk_router(n=1)
+    clk = [0.0]
+    retired = []
+
+    def spawn():
+        eng, _ = _mk_engine(model, params)
+        return eng
+
+    sup = sr.FleetSupervisor(
+        router, spawn, retire=lambda eng, idx: retired.append(idx),
+        enabled=True, min_replicas=1, max_replicas=2, cooldown_s=10.0,
+        up_queue=1.0, down_queue=0.1, pool_high=0.9,
+        warmup_kwargs={"max_len": 8}, clock=lambda: clk[0])
+    sig = {"queue_per_replica": 5.0, "pool_pressure": 0.0, "p99_s": 0.0}
+    sup.signals = lambda: dict(
+        sig, serving=float(router.serving_replicas()))
+
+    assert sup.tick() == "up"                  # saturated, under max
+    assert router.serving_replicas() == 2
+    assert sup.tick() is None                  # at max: no action
+    sig["queue_per_replica"] = 0.0
+    assert sup.tick() is None                  # idle but cooling down
+    clk[0] = 11.0
+    assert sup.tick() == "down"                # cooldown elapsed
+    assert retired == [1]
+    assert router.serving_replicas() == 1
+    clk[0] = 22.0
+    assert sup.tick() is None                  # min floor holds
+    fs = router.fleet_stats()
+    assert fs["scale_ups"] == 1 and fs["scale_downs"] == 1
+    assert fs["ticks"] >= 5
+    _engine.waitall()
+
+
+def test_supervisor_disabled_is_inert():
+    """Zero-overhead-off: a disabled supervisor starts no thread."""
+    router, engines, pools, model, params = mk_router(n=1)
+    sup = sr.FleetSupervisor(router, spawn=lambda: None,
+                             enabled=False).start()
+    assert sup.enabled is False
+    assert sup._thread is None
+    sup.stop()                                  # harmless no-op
+
+
+def test_router_scale_fault_site_injected():
+    """A planned fault at the ``router.scale`` site exercises the
+    documented recovery: the membership change never happens — the
+    fleet is exactly as it was — and a retry completes it."""
+    router, engines, pools, model, params = mk_router(n=1)
+    joiner, _ = _mk_engine(model, params, warm=8)
+    with faults.active(faults.FaultPlan().fail("router.scale",
+                                               times=1)):
+        with pytest.raises(faults.TransientFault):
+            router.add_replica(joiner, warmup_kwargs={"max_len": 8})
+        assert router.serving_replicas() == 1        # untouched
+        assert len(router._replicas) == 1
+        assert router.fleet_stats()["joins"] == 0
+        # retry joins
+        assert router.add_replica(joiner,
+                                  warmup_kwargs={"max_len": 8}) == 1
+    assert faults.counters("router.scale")["injected"] == 1
+    assert router.serving_replicas() == 2
+    with faults.active(faults.FaultPlan().fail("router.scale",
+                                               times=1)):
+        with pytest.raises(faults.TransientFault):
+            router.drain_replica(1)
+        assert router._replicas[1].state == sr.REPLICA_SERVING
+        assert router.drain_replica(1) is True       # retry drains
+    assert router._replicas[1].state == sr.REPLICA_GONE
+    _engine.waitall()
+
+
+# ---------------------------------------------------------------------------
+# 12. cross-host replicas (serving_remote, ISSUE 17)
+# ---------------------------------------------------------------------------
+def test_remote_replica_protocol_token_exact():
+    from mxnet_tpu import serving_remote as srm
+
+    model, params = tiny()
+    eng, pool = _mk_engine(model, params, warm=8, name="wire0")
+    srv = srm.ReplicaServer(eng).start()
+    try:
+        rr = srm.RemoteReplica("127.0.0.1", srv.port)
+        out = rr.generate([4, 5, 6], max_new_tokens=5)
+        assert out == sd.eager_generate(model, params, [4, 5, 6], 5)
+        assert rr.ping() is True
+        load = rr.load()
+        for k in ("queue_depth", "in_flight", "pool_pressure"):
+            assert k in load
+        # a typed shed crosses the wire typed
+        eng.begin_drain()
+        with pytest.raises(faults.ShedError) as ei:
+            rr.generate([4, 5, 6], max_new_tokens=2)
+        assert ei.value.kind == "draining"
+    finally:
+        srv.close()
+    _engine.waitall()
+    assert pool.in_use() == 0
+
+
+def test_router_remote_fault_site_injected_failover():
+    """A planned fault at the ``router.remote`` site exercises the
+    documented recovery: the unreachable remote prices out of _pick /
+    the failed dispatch fails over — every request still delivered
+    token-exact through the fleet."""
+    from mxnet_tpu import serving_remote as srm
+
+    router, engines, pools, model, params = mk_router(n=1)
+    eng2, pool2 = _mk_engine(model, params, warm=8, name="wire1")
+    srv = srm.ReplicaServer(eng2).start()
+    try:
+        rr = srm.RemoteReplica("127.0.0.1", srv.port)
+        router.add_replica(rr)
+        prompts = [[1 + i, 2 + i, 3 + i] for i in range(6)]
+        with faults.active(faults.FaultPlan().fail("router.remote",
+                                                   times=2)):
+            outs = []
+            threads = [threading.Thread(
+                target=lambda p=p: outs.append(
+                    (str(p), router.generate(p, max_new_tokens=4))))
+                for p in prompts]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+        assert len(outs) == 6
+        for key, out in outs:
+            p = [int(x) for x in key.strip("[]").split(",")]
+            assert out == sd.eager_generate(model, params, p, 4)
+        assert faults.counters("router.remote")["injected"] >= 1
+    finally:
+        srv.close()
+    _engine.waitall()
+    assert pool2.in_use() == 0 and pools[0].in_use() == 0

@@ -27,7 +27,15 @@ and FAILS (exit 1) unless:
   temporary;
 - **deadlines are honest**: a request with an infeasible
   ``deadline_us`` sheds ``ShedError(kind="deadline")`` without
-  consuming more than budget + ``deadline_overrun_s``.
+  consuming more than budget + ``deadline_overrun_s``;
+- **the elastic fleet heals itself** (ISSUE 17): in the scale storm
+  the autoscaler grows 1 → 3 with every joiner serving its first
+  request inside ``join_first_serve_s`` of its spawn (0 fresh compiles
+  off the shared program cache) and shrinks back 3 → 1 where every
+  scale-down is a graceful preemption (drain → typed draining sheds →
+  exit 83); in the host-loss cell a SIGKILL'd remote replica costs at
+  most ``kill_recover_s`` before the fleet delivers again, with every
+  admitted request still delivered token-exact.
 
 Invoked by the test suite (tests/test_serving_router.py) exactly like
 the other gates, and runnable standalone:
@@ -54,6 +62,11 @@ BUDGET = {
     "failover_p99_slack_s": 5.0,
     "breaker_readmit_s": 8.0,
     "deadline_overrun_s": 1.0,     # enforced inside the drill itself
+    # the ISSUE-17 elastic-fleet walls: spawn → warm join → first
+    # request served (a whole JAX boot rides inside this), and
+    # SIGKILL'd host → next delivered request
+    "join_first_serve_s": 90.0,
+    "kill_recover_s": 10.0,
 }
 
 
@@ -93,11 +106,25 @@ def main(argv=None) -> int:
                 failures.append(
                     f"{name}: breaker re-admitted after {ra:.2f}s "
                     f"(probe budget {BUDGET['breaker_readmit_s']}s)")
+        if name == "router_scale_storm":
+            js = rep.get("join_to_first_served_s")
+            if js is not None and js > BUDGET["join_first_serve_s"]:
+                failures.append(
+                    f"{name}: slowest join served its first request "
+                    f"after {js:.2f}s (wall "
+                    f"{BUDGET['join_first_serve_s']}s)")
+        if name == "router_host_loss":
+            kr = rep.get("kill_to_recovered_s")
+            if kr is not None and kr > BUDGET["kill_recover_s"]:
+                failures.append(
+                    f"{name}: first delivery {kr:.2f}s after the "
+                    f"SIGKILL (wall {BUDGET['kill_recover_s']}s)")
         line = {k: rep.get(k) for k in
                 ("scenario", "ok", "dropped", "leaked_pages",
                  "steady_p99_s", "chaos_p99_s", "failovers",
                  "breaker_opens", "breaker_closes", "re_admit_s",
-                 "drain_s", "drill_wall_s")}
+                 "drain_s", "join_to_first_served_s",
+                 "kill_to_recovered_s", "drill_wall_s")}
         print(f"check_availability_budget: {json.dumps(line, default=str)}")
     if failures:
         print("check_availability_budget: FAIL", file=sys.stderr)

@@ -97,6 +97,14 @@ RULES: Tuple[Rule, ...] = (
     Rule("prefix-miss",
          lambda n: n in ("prefix.miss_blocks", "prefix.evictions"),
          tol=0.10, slack=2.0),
+    # ISSUE 17: fleet churn is a cost — a benchmarked workload that
+    # suddenly needs more scale events (or errors) to hit the same
+    # numbers has regressed its stability, not just its latency
+    Rule("fleet-churn",
+         lambda n: n.startswith("router.fleet*.")
+         and n.split(".")[-1] in ("scale_ups", "scale_downs",
+                                  "scale_errors"),
+         tol=0.10, slack=2.0),
 )
 
 # lane-level scalar aliases gated alongside the namespaced counters
@@ -109,7 +117,7 @@ LANE_KEY_RULES: Dict[str, str] = {
 _LANE_KEY_RULE = {r.label: r for r in RULES}
 
 _INSTANCE_RE = re.compile(r"^((?:serving\.router|serving\.engine|"
-                          r"decode\.engine|kv_pool))\d+\.")
+                          r"decode\.engine|kv_pool|router\.fleet))\d+\.")
 
 
 def normalize(name: str) -> str:

@@ -15,9 +15,20 @@ Per mesh size the lane reports:
 - ``step_ms_p50`` / ``step_ms_std`` — per-step wall time and its
   variance (collective jitter shows up here first)
 - ``efficiency`` — img/s/chip relative to the 1-device lane
+- ``param_bytes_per_device`` / ``opt_bytes_per_device`` — the
+  memory-per-chip column (ISSUE-18), stamped from the ``spmd.*``
+  computed gauges: flat across the data-parallel curve (replicated
+  params) and ~1/N on the model-parallel sub-lane
 
 Counter-based sanity rides along: every lane asserts ONE compiled launch
 per step (no host-driven fan-out) and zero steady-state reshards.
+
+The MODEL-PARALLEL sub-lane (ISSUE-18, docs/PERF.md "Sharded
+training") holds the GLOBAL parameter count fixed while the fsdp axis
+grows (``MXNET_SPMD_MESH=dp=1,fsdp=N`` for N = 1, 2, ... n): the
+memory-per-chip claim is ``param_bytes_per_device`` and
+``opt_bytes_per_device`` dropping ~1/N while the step stays one launch
+with zero steady-state reshards.
 
 On CPU the virtual 8-device world
 (``XLA_FLAGS=--xla_force_host_platform_device_count=8``, set below for
@@ -123,12 +134,68 @@ def _lane(n_dev: int, per_chip: int, steps: int) -> dict:
             "mesh_devices": len(
                 net.collect_params()["d1.weight"].data()
                 ._data.sharding.device_set),
+            # memory-per-chip column: replicated params hold this flat
+            # across the data-parallel curve
+            "param_bytes_per_device": spmd.param_bytes_per_device(),
+            "opt_bytes_per_device": spmd.opt_bytes_per_device(),
         }
     finally:
         if prev is None:
             os.environ.pop("MXNET_SPMD_MESH", None)
         else:
             os.environ["MXNET_SPMD_MESH"] = prev
+
+
+def _model_lane(n_fsdp: int, per_chip: int, steps: int) -> dict:
+    """Model-parallel sub-lane: GLOBAL params fixed, fsdp axis grows —
+    the claim is memory per chip dropping ~1/N, not throughput."""
+    import jax
+
+    from mxnet_tpu import cached_step
+    from mxnet_tpu.parallel import spmd
+
+    prev = os.environ.get("MXNET_SPMD_MESH")
+    prev_min = os.environ.get("MXNET_FSDP_MIN_SIZE")
+    os.environ["MXNET_SPMD_MESH"] = f"dp=1,fsdp={n_fsdp}"
+    os.environ["MXNET_FSDP_MIN_SIZE"] = "1"     # the bench MLP is small
+    try:
+        rows = per_chip                          # fixed global batch too
+        net, trainer, loss_fn, x, y = _build(rows)
+        step = trainer.compile_step(net, loss_fn)
+        for _ in range(WARMUP):
+            loss = step(x, y, batch_size=rows)
+        jax.block_until_ready(loss._data)
+        d0 = cached_step.dispatch_count()
+        r0 = spmd.reshard_count()
+        t_all = time.perf_counter()
+        for _ in range(steps):
+            loss = step(x, y, batch_size=rows)
+            jax.block_until_ready(loss._data)
+        elapsed = time.perf_counter() - t_all
+        assert step.last_step_compiled, step.last_fallback_reason
+        total = sum(p.data()._data.nbytes
+                    for _n, p in sorted(net.collect_params().items()))
+        return {
+            "fsdp": n_fsdp,
+            "global_batch": rows,
+            "img_s": rows * steps / elapsed,
+            "step_ms_mean": elapsed * 1e3 / steps,
+            "launches_per_step":
+                (cached_step.dispatch_count() - d0) / steps,
+            "reshards_after_warm": spmd.reshard_count() - r0,
+            "param_bytes_global": total,
+            "param_bytes_per_device": spmd.param_bytes_per_device(),
+            "opt_bytes_per_device": spmd.opt_bytes_per_device(),
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_SPMD_MESH", None)
+        else:
+            os.environ["MXNET_SPMD_MESH"] = prev
+        if prev_min is None:
+            os.environ.pop("MXNET_FSDP_MIN_SIZE", None)
+        else:
+            os.environ["MXNET_FSDP_MIN_SIZE"] = prev_min
 
 
 def run(per_chip: int = PER_CHIP, steps: int = STEPS,
@@ -147,6 +214,12 @@ def run(per_chip: int = PER_CHIP, steps: int = STEPS,
     base = curve[0]["img_s_per_chip"]
     for lane in curve:
         lane["efficiency"] = lane["img_s_per_chip"] / base if base else 0.0
+    # model-parallel sub-lane: fixed global params, growing fsdp axis
+    model_curve = [_model_lane(s, per_chip, steps) for s in sizes]
+    mp_base = model_curve[0]["param_bytes_per_device"]
+    for lane in model_curve:
+        lane["param_bytes_frac"] = (
+            lane["param_bytes_per_device"] / mp_base if mp_base else 1.0)
     head = curve[-1]
     disk = program_store.disk_stats()
     from mxnet_tpu import telemetry
@@ -166,7 +239,12 @@ def run(per_chip: int = PER_CHIP, steps: int = STEPS,
         "compile_s": round(program_store.compile_seconds() - t_c0, 3),
         "cache_hits": disk["hits"],
         "cache_misses": disk["misses"],
+        # memory-per-chip headline: per-device param bytes on the
+        # largest fsdp mesh as a fraction of the 1-device footprint
+        "model_parallel_param_bytes_frac":
+            model_curve[-1]["param_bytes_frac"],
         "curve": curve,
+        "model_parallel_curve": model_curve,
     }
 
 
@@ -197,7 +275,18 @@ def main():
                   f"p50 {lane['step_ms_p50']:.2f} ms  "
                   f"std {lane['step_ms_std']:.2f} ms  "
                   f"eff {lane['efficiency']:.2f}  "
-                  f"launches/step {lane['launches_per_step']:.1f}")
+                  f"launches/step {lane['launches_per_step']:.1f}  "
+                  f"{lane['param_bytes_per_device'] / 1024:.1f} "
+                  f"KiB params/chip")
+        print("model parallel (fixed global params, dp=1,fsdp=N):")
+        for lane in result["model_parallel_curve"]:
+            print(f"  fsdp={lane['fsdp']:<3} "
+                  f"{lane['param_bytes_per_device'] / 1024:>8.1f} KiB "
+                  f"params/chip ({lane['param_bytes_frac']:.2f}x)  "
+                  f"{lane['opt_bytes_per_device'] / 1024:>8.1f} KiB "
+                  f"opt/chip  "
+                  f"launches/step {lane['launches_per_step']:.1f}  "
+                  f"reshards {lane['reshards_after_warm']}")
     return 0
 
 

@@ -36,18 +36,40 @@ a ``fused_update`` rule — falls back transparently to the eager tape;
 ``MXNET_COMPILED_STEP=0`` forces the tape everywhere.
 
 **Pod-scale SPMD** (``kvstore='tpu'``): with an ICI-collective store the
-step traces under a data-parallel ``jax.sharding.Mesh``
-(``parallel.spmd``, knob ``MXNET_SPMD_MESH``): the batch shards over the
-``'dp'`` axis, parameters/optimizer state replicate, and the gradient
-reduce this program already contains becomes an ICI-native all-reduce
-scheduled by the XLA SPMD partitioner — overlappable with backward,
-still ONE dispatch per step, still donated buffers.  Existing Trainer
-code gets it by passing ``kvstore='tpu'``; the mesh (axes + exact device
-set) is part of the program-cache key, inputs already staged with the
-batch sharding (``engine.DevicePrefetcher``) pass through without a
-copy, and steady state performs zero host-side cross-device copies
-(``parallel.spmd.reshard_count``, pinned by the dispatch-budget gate).
-Host-driven stores (``dist_*``) still fall back, naming this path.
+step traces under a named ``jax.sharding.Mesh`` (``parallel.spmd``,
+knob ``MXNET_SPMD_MESH``): the batch shards over the ``'dp'`` axis and
+the gradient reduce this program already contains becomes an ICI-native
+all-reduce scheduled by the XLA SPMD partitioner — overlappable with
+backward, still ONE dispatch per step, still donated buffers.  Existing
+Trainer code gets it by passing ``kvstore='tpu'``; the mesh (axes +
+exact device set) is part of the program-cache key, inputs already
+staged with the batch sharding (``engine.DevicePrefetcher``) pass
+through without a copy, and steady state performs zero host-side
+cross-device copies (``parallel.spmd.reshard_count``, pinned by the
+dispatch-budget gate).  Host-driven stores (``dist_*``) still fall
+back, naming this path.
+
+**Beyond one chip's HBM** the same one-program contract extends to the
+model-parallel axes and to gradient accumulation:
+
+- an ``fsdp`` mesh axis (``MXNET_SPMD_MESH='dp=4,fsdp=2'``) shards
+  parameters AND optimizer state at warmup (``spmd.param_spec``:
+  largest evenly-divisible dim, indivisible leaves replicate loudly via
+  ``sharding.legalize_refusal``); the per-leaf scatter/gather around
+  the update is the XLA partitioner's schedule inside the one donated
+  program — per-device param bytes drop ~1/N (gauges
+  ``spmd.param_bytes_per_device`` / ``spmd.opt_bytes_per_device``);
+- a ``tp`` axis honors model-code ``sharding.constraint`` annotations:
+  the step traces AND dispatches inside the mesh context, so a
+  constraint in a hybridizable forward resolves axis names without the
+  mesh threaded through — composing with FSDP on the same mesh;
+- ``Trainer.compile_step(..., accum_steps=N)`` splits the step into a
+  grad-accumulation program (dispatched per microbatch, donated
+  accumulator buffers sharded like their parameters) and ONE fused
+  update program per window — exactly N+1 dispatches per window, the
+  deferred AMP gate spanning the window (scale held fixed across it,
+  overflow detected on the summed grads), lr/update-count semantics
+  identical to one big-batch step.
 """
 from __future__ import annotations
 
@@ -136,10 +158,21 @@ class TrainStep:
     writes them as usual.
     """
 
-    def __init__(self, net, loss_fn: Callable, trainer, bucket: bool = False):
+    def __init__(self, net, loss_fn: Callable, trainer, bucket: bool = False,
+                 accum_steps: int = 1):
         self._net = net
         self._loss_fn = loss_fn
         self._trainer = trainer
+        # gradient accumulation (compile_step(accum_steps=N)): N
+        # microbatch grad dispatches feed donated accumulator buffers,
+        # then ONE fused update applies the window — N+1 dispatches,
+        # one optimizer update-count bump, per window
+        if int(accum_steps) < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self._accum_steps = int(accum_steps)
+        self._accum_bufs: Optional[list] = None
+        self._accum_key = None
+        self._accum_i = 0
         # this step's keyspace in the ProgramStore 'train_step'
         # namespace: shared eviction (cap MXNET_COMPILED_STEP_CACHE /
         # MXNET_PROGRAM_CACHE_CAPS) + shared metrics, per-instance keys
@@ -289,7 +322,13 @@ class TrainStep:
                    if p.grad_req != "null"]
         count_snap = (dict(opt._index_update_count), opt.num_update)
         pargs = self._maybe_pad(args)
-        opt._update_count(list(indices))
+        # with accumulation only the window-FINAL microbatch applies an
+        # update, so only it bumps the counts — lr schedules and
+        # momentum counts see one step per window, not per microbatch
+        window_final = (self._accum_steps == 1
+                        or self._accum_i == self._accum_steps - 1)
+        if window_final:
+            opt._update_count(list(indices))
         try:
             out = self._compiled_step(pargs, batch_size)
         except Exception as e:  # staging/trace failure -> sticky fallback
@@ -423,6 +462,18 @@ class TrainStep:
     def _eager_step(self, args, batch_size):
         """The eager tape path, AMP-equivalent to amp.scale_loss +
         backward + trainer.step."""
+        if self._accum_steps > 1:
+            # the eager tape applies one update PER call — silently
+            # turning an N-microbatch window into N full steps would
+            # change lr/count semantics, so accumulation refuses the
+            # tape loudly instead of degrading wrong
+            from .base import MXNetError
+
+            raise MXNetError(
+                f"accum_steps={self._accum_steps} requires the compiled "
+                "step (one fused update per window); the eager tape "
+                "cannot honor the window contract — fallback reason: "
+                f"{self.last_fallback_reason}")
         # a pending deferred flag must land first: the eager step reads
         # scaler.loss_scale synchronously, so the host state has to be
         # caught up to the device before this step's scale is chosen
@@ -511,31 +562,59 @@ class TrainStep:
             from .parallel import spmd as _spmd
 
             rep = _spmd.replicated(mesh)
+            fsdp = int(mesh.shape.get(_spmd.MODEL_AXIS, 1)) > 1
 
-            def _place_nd(d):
-                new = _spmd.ensure_placed(d._data, rep)
+            def _sharding_of(shape):
+                # fsdp axis present: ZeRO-style per-leaf sharding
+                # (largest divisible dim, small/indivisible leaves
+                # replicate — the latter loudly); otherwise the classic
+                # replicated KVStore-broadcast layout
+                if fsdp:
+                    return _spmd.param_sharding(tuple(shape), mesh)
+                return rep
+
+            def _place_nd(d, sh=None):
+                new = _spmd.ensure_placed(
+                    d._data, sh if sh is not None else rep)
                 if new is not d._data:
                     d._set_data(new)
 
-            def _place_state(s):
+            def _place_state(s, wshape, wsh):
+                # optimizer-state leaves SHAPED like their weight
+                # (momentum, Adam moments, the fp32 master copy) shard
+                # with it — that is the ZeRO part of FSDP; scalars and
+                # odd-shaped leaves replicate
                 if s is None:
                     return
                 if hasattr(s, "_set_data"):
-                    _place_nd(s)
+                    same = tuple(s.shape) == tuple(wshape)
+                    _place_nd(s, wsh if same else rep)
                     return
                 for x in s:
-                    _place_state(x)
+                    _place_state(x, wshape, wsh)
 
-            # one-time replicated placement (the KVStore init/broadcast
-            # analog): steady state sees already-placed buffers — the
-            # step's outputs carry the replicated sharding back into the
+            # one-time placement (the KVStore init/broadcast analog):
+            # steady state sees already-placed buffers — the step's
+            # outputs carry the same shardings back into the
             # parameters, so reshard_count stays flat after warmup
             for p in trainable:
-                _place_nd(p.data())
+                _place_nd(p.data(), _sharding_of(p.data().shape))
             for n in frozen_names:
-                _place_nd(params[n].data())
-            for s in states:
-                _place_state(s)
+                _place_nd(params[n].data(),
+                          _sharding_of(params[n].data().shape))
+            for p, s in zip(trainable, states):
+                _place_state(s, p.data().shape,
+                             _sharding_of(p.data().shape))
+
+            # per-device memory accounting (gauges
+            # spmd.param_bytes_per_device / spmd.opt_bytes_per_device):
+            # computed from the placed leaves' ACTUAL shardings, so the
+            # fsdp layout reads ~1/N of the replicated one
+            _spmd.record_layout(
+                [p.data()._data for p in trainable]
+                + [params[n].data()._data for n in frozen_names],
+                [l for s in states
+                 for l in jax.tree_util.tree_leaves(_fused._unwrap(s))])
 
         return SimpleNamespace(
             opt=opt, scaler=scaler, updater=updater, params=params,
@@ -575,22 +654,52 @@ class TrainStep:
             None if mesh is None else _spmd.mesh_key(mesh),
         )
 
+    def _mesh_ctx(self, mesh):
+        """The mesh context the step traces AND dispatches under: inside
+        it ``sharding.constraint`` calls in model code resolve the
+        ``'tp'``/``'fsdp'`` axis names without the mesh threaded through
+        the call stack (single-chip: a no-op context)."""
+        if mesh is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        from .parallel.mesh import mesh_scope
+
+        return mesh_scope(mesh)
+
     def _ensure_program(self, sig, prep, in_struct, ctx, flavor,
-                        lower_args):
+                        lower_args, kind="full"):
         """One code path for warm-up, steady state, and elastic restore:
         resolve ``sig`` through the ProgramStore — a miss traces AND
         AOT-compiles (persisting to MXNET_PROGRAM_CACHE_DIR when set)
-        before any dispatch."""
+        before any dispatch.  ``kind`` selects the program body: the
+        whole fused step (``'full'``), the accumulation-window grad
+        program (``'grad'``), or the window-closing update program
+        (``'update'``).  Tracing happens inside the mesh context so
+        model-code sharding constraints resolve."""
         rec = self._programs.lookup(sig)
         if rec is None:
-            jitted, out_struct, mutated_names = self._build_program(
-                prep.params, prep.names, in_struct, ctx, flavor,
-                prep.slot_of_name, prep.frozen_names, prep.group_layout,
-                prep.has_ok, prep.donate)
-            rec = _pstore.build(
-                "train_step", jitted, lower_args,
-                meta=(out_struct, mutated_names),
-                label=type(self._net).__name__)
+            with self._mesh_ctx(prep.mesh):
+                if kind == "full":
+                    jitted, out_struct, mutated_names = \
+                        self._build_program(
+                            prep.params, prep.names, in_struct, ctx,
+                            flavor, prep.slot_of_name, prep.frozen_names,
+                            prep.group_layout, prep.has_ok, prep.donate)
+                elif kind == "grad":
+                    jitted, out_struct, mutated_names = \
+                        self._build_grad_program(
+                            prep.params, prep.names, in_struct, ctx,
+                            flavor, prep.slot_of_name, prep.frozen_names,
+                            prep.has_ok, prep.donate)
+                else:
+                    jitted = self._build_update_program(
+                        prep.group_layout, prep.has_ok, prep.donate)
+                    out_struct, mutated_names = None, ()
+                rec = _pstore.build(
+                    "train_step", jitted, lower_args,
+                    meta=(out_struct, mutated_names),
+                    label=type(self._net).__name__)
             self._programs.insert(sig, rec)
         return rec
 
@@ -660,11 +769,22 @@ class TrainStep:
         sig = self._signature(
             prep, _gb._struct_key(in_struct),
             tuple((s, d) for s, d in zip(shapes, dtypes)), ctx, flavor)
-        self._ensure_program(
-            sig, prep, in_struct, ctx, flavor,
-            self._lower_args(prep, [
-                jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)
-            ]))
+        in_sds = [jax.ShapeDtypeStruct(s, d)
+                  for s, d in zip(shapes, dtypes)]
+        if self._accum_steps > 1:
+            # the accumulation window runs TWO programs: warm both
+            usig = self._update_sig(prep, ctx, flavor)
+            self._ensure_accum_bufs(prep, usig)
+            self._ensure_program(
+                ("accum_grad", self._accum_steps) + sig, prep, in_struct,
+                ctx, flavor, self._grad_lower_args(prep, in_sds),
+                kind="grad")
+            self._ensure_program(
+                usig, prep, None, ctx, flavor,
+                self._update_lower_args(prep), kind="update")
+        else:
+            self._ensure_program(sig, prep, in_struct, ctx, flavor,
+                                 self._lower_args(prep, in_sds))
         return self
 
     def _lower_args(self, prep, in_specs):
@@ -673,14 +793,16 @@ class TrainStep:
         program's), ShapeDtypeStructs for the batch (mesh-sharded like
         ``spmd.put_batch`` would shard the real batch), abstract
         scalars for the per-step traced values."""
-        import numpy as onp
-
         f32 = jax.ShapeDtypeStruct((), jnp.float32)
         mesh = prep.mesh
         if mesh is not None:
             from .parallel import spmd as _spmd
 
-            n_dp = int(onp.prod(mesh.devices.shape))
+            # batch divisibility follows the 'dp' axis size ONLY — on a
+            # multi-axis mesh (dp×fsdp/tp) the whole-mesh device count
+            # is NOT the batch-sharding divisor (matching batch_spec_for,
+            # so the precompiled program equals the dispatched one)
+            n_dp = int(mesh.shape.get(_spmd.DATA_AXIS, 1))
             bsh = _spmd.batch_sharding(mesh)
 
             def _in_spec(s):
@@ -713,6 +835,8 @@ class TrainStep:
         from .ndarray import ndarray as _ndmod
         from .optimizer import fused as _fused
 
+        if self._accum_steps > 1:
+            return self._accum_compiled_step(args, batch_size)
         tr = self._trainer
         in_leaves, in_struct = _gb._flatten_args(args)
         ctx = in_leaves[0].ctx if in_leaves else current_context()
@@ -806,7 +930,8 @@ class TrainStep:
         rec = self._ensure_program(sig, prep, in_struct, ctx, flavor,
                                    call_args)
         out_struct, mutated_names = rec.meta
-        out_raw, mut_vals, new_w, new_s, ok, dig = rec(*call_args)
+        with self._mesh_ctx(mesh):
+            out_raw, mut_vals, new_w, new_s, ok, dig = rec(*call_args)
         if want_digest:
             # hand the UNREAD device fingerprint to the sentinel; it
             # consumes the previous pending one (deferred a full
@@ -860,6 +985,344 @@ class TrainStep:
                                      where="sync")
                 scaler.update_scale(overflow)
         return loss
+
+    # -- gradient accumulation (compile_step(accum_steps=N)) --------------
+    def _update_sig(self, prep, ctx, flavor):
+        """The window-closing update program's cache key: it never sees
+        the batch, so input structure/shapes are deliberately absent —
+        alternating microbatch shapes share ONE update program (and one
+        set of accumulator buffers)."""
+        from .ndarray import ndarray as _ndmod
+        from .optimizer import fused as _fused
+
+        mesh = prep.mesh
+        if mesh is not None:
+            from .parallel import spmd as _spmd
+        return (
+            "accum_update", self._accum_steps, ctx, flavor,
+            _ndmod._amp_generation,
+            type(prep.opt).__name__, prep.opt._fused_signature(),
+            tuple((tuple(p.data().shape), p.data()._data.dtype)
+                  for p in prep.trainable),
+            tuple(_fused._struct(s) for s in prep.states),
+            prep.group_layout, prep.has_ok, prep.donate,
+            None if mesh is None else _spmd.mesh_key(mesh),
+        )
+
+    def _ensure_accum_bufs(self, prep, key) -> None:
+        """Donation-safe gradient accumulators: one zeros buffer per
+        trainable param, placed with the SAME sharding (fsdp-sharded
+        grads accumulate shard-local, no gather).  Built once per
+        (param-layout, mesh) signature; the update program returns
+        freshly ZEROED buffers in the donated slots, so steady state
+        never pays an eager zeros dispatch."""
+        if self._accum_bufs is not None and self._accum_key == key:
+            return
+        bufs = []
+        for p in prep.trainable:
+            w = p.data()._data
+            z = jnp.zeros(w.shape, w.dtype)
+            if prep.mesh is not None:
+                z = jax.device_put(z, w.sharding)
+            bufs.append(z)
+        self._accum_bufs = bufs
+        self._accum_key = key
+        self._accum_i = 0
+
+    def _accum_compiled_step(self, args, batch_size):
+        """One microbatch of an accumulation window: dispatch the grad
+        program (adds this microbatch's scaled grads into the donated
+        accumulators); the window-FINAL microbatch also dispatches the
+        fused update program — exactly ``accum_steps + 1`` dispatches
+        and ONE optimizer update (one count bump, one lr read) per
+        window.  The AMP gate spans the window: the loss scale holds
+        fixed across it (the deferred flag lands only at window close)
+        and overflow is detected on the SUMMED grads — an inf/nan from
+        any microbatch survives addition."""
+        from .gluon import block as _gb
+        from .ndarray import ndarray as _ndmod
+        from .optimizer import fused as _fused
+
+        tr = self._trainer
+        accum = self._accum_steps
+        in_leaves, in_struct = _gb._flatten_args(args)
+        ctx = in_leaves[0].ctx if in_leaves else current_context()
+        flavor = _ndmod._flavor_of(in_leaves)
+
+        prep = self._prep()
+        opt, scaler = prep.opt, prep.scaler
+        mesh, rep = prep.mesh, prep.rep
+        base_sig = self._signature(
+            prep, _gb._struct_key(in_struct),
+            tuple((tuple(l.shape), l._data.dtype) for l in in_leaves),
+            ctx, flavor)
+        gsig = ("accum_grad", accum) + base_sig
+        usig = self._update_sig(prep, ctx, flavor)
+        self._ensure_accum_bufs(prep, usig)
+
+        # the window's scale candidates: every microbatch passes the
+        # same (clean, overflow) pair and the same unread previous
+        # flag, so the on-device where() selects ONE scale for the
+        # whole window and the summed grads equal a big-batch
+        # backward's, scaled.  (A mid-window drain() is safe: it
+        # resolves the flag to exactly the value the where() selects.)
+        lag = _engine.amp_lag() if scaler is not None else 0
+        if not lag:
+            self.drain()
+        if scaler is not None and lag and self._pending_ok is not None:
+            s_clean, s_over = scaler.branch_scales()
+        elif scaler is not None:
+            s_clean = s_over = scaler.loss_scale
+        else:
+            s_clean = s_over = 1.0
+        if self._pending_ok is not None:
+            prev_ok = self._pending_ok
+        elif mesh is not None:
+            prev_ok = jax.device_put(jnp.asarray(True), rep)
+        else:
+            prev_ok = jnp.asarray(True)
+
+        w_args = [p.data()._data for p in prep.trainable]
+        frozen_args = [prep.params[n].data()._data
+                       for n in prep.frozen_names]
+        if mesh is not None:
+            from .parallel import spmd as _spmd
+
+            in_args = [_spmd.put_batch(l._data, mesh) for l in in_leaves]
+        else:
+            in_args = [l._data for l in in_leaves]
+        g_call = (w_args, frozen_args, list(self._accum_bufs), in_args,
+                  _random.next_key(),
+                  jnp.asarray(s_clean, jnp.float32),
+                  jnp.asarray(s_over, jnp.float32), prev_ok)
+        grec = self._ensure_program(gsig, prep, in_struct, ctx, flavor,
+                                    g_call, kind="grad")
+        out_struct, mutated_names = grec.meta
+        with self._mesh_ctx(mesh):
+            out_raw, mut_vals, new_acc = grec(*g_call)
+        self._accum_bufs = list(new_acc)
+        for n, v in zip(mutated_names, mut_vals):
+            prep.params[n]._data[0]._set_data(v)
+        overlap = [n for n in mutated_names if n in prep.slot_of_name]
+        if overlap:
+            self.fallback_reason = (
+                f"forward mutates trainable parameter(s) {overlap}")
+        out_nd = [_ndmod._wrap(o, ctx, flavor) for o in out_raw]
+        loss = _gb._rebuild_output(out_struct[0], out_nd)
+
+        self._accum_i += 1
+        if self._accum_i < accum:
+            return loss
+        self._accum_i = 0
+
+        # ---- window close: the ONE fused update dispatch ---------------
+        indices, group_layout = prep.indices, prep.group_layout
+        counts = [opt._index_update_count[i] for i in indices]
+        lrs = opt._get_lrs(list(indices))
+        wds = opt._get_wds(list(indices))
+        scale_val = s_clean
+        if scaler is not None:
+            tr._amp_original_scale = getattr(
+                tr, "_amp_original_scale", tr._scale)
+        base = getattr(tr, "_amp_original_scale", tr._scale)
+        # the accumulators hold a SUM over accum microbatches of scaled
+        # per-microbatch-mean grads; the extra /accum makes the window
+        # equal one (accum × batch_size)-batch step's mean
+        rescale = base / (scale_val * batch_size * accum)
+        rescale_alt = base / (s_over * batch_size * accum)
+        lrs_g = [jnp.asarray([lrs[i] for i in m], jnp.float32)
+                 for _mp, m in group_layout]
+        wds_g = [jnp.asarray([wds[i] for i in m], jnp.float32)
+                 for _mp, m in group_layout]
+        counts_g = [jnp.asarray([counts[i] for i in m], jnp.float32)
+                    for _mp, m in group_layout]
+        s_args = tuple(_fused._unwrap(s) for s in prep.states)
+        snt = self._sentinel
+        want_digest = snt is not None and snt.want_digest()
+        if mesh is not None:
+            want_arg = jax.device_put(jnp.asarray(want_digest), rep)
+        else:
+            want_arg = jnp.asarray(want_digest)
+        u_call = (w_args, s_args, list(self._accum_bufs),
+                  lrs_g, wds_g, counts_g,
+                  jnp.asarray(rescale, jnp.float32),
+                  jnp.asarray(rescale_alt, jnp.float32),
+                  prev_ok, want_arg)
+        urec = self._ensure_program(usig, prep, None, ctx, flavor,
+                                    u_call, kind="update")
+        with self._mesh_ctx(mesh):
+            new_w, new_s, new_acc, ok, dig = urec(*u_call)
+        self._accum_bufs = list(new_acc)
+        if want_digest:
+            snt.offer(*dig)
+        for p, nw in zip(prep.trainable, new_w):
+            p._data[0]._set_data(nw)
+        for s, ns in zip(prep.states, new_s):
+            _fused._write(s, ns)
+        if scaler is not None:
+            if lag:
+                prev = self._pending_ok
+                self._pending_ok = ok
+                if prev is not None:
+                    _ndmod.count_host_sync()
+                    _DEFERRED_READ.inc()
+                    # graftlint: disable=host-sync -- the ONE deferred AMP
+                    # gate read per window (lagged: never blocks the
+                    # current program), counted via count_host_sync
+                    overflow = not bool(prev)
+                    if overflow:
+                        _telemetry.event("amp_overflow", "cached_step",
+                                         where="deferred")
+                    scaler.update_scale(overflow)
+            else:
+                _ndmod.count_host_sync()
+                # graftlint: disable=host-sync -- the synchronous AMP gate
+                # read at window close (MXNET_AMP_LAG=0), counted
+                overflow = not bool(ok)
+                if overflow:
+                    _telemetry.event("amp_overflow", "cached_step",
+                                     where="sync")
+                scaler.update_scale(overflow)
+        return loss
+
+    def _build_grad_program(self, params, names, in_struct, ctx, flavor,
+                            slot_of_name, frozen_names, has_ok, donate):
+        """The accumulation-window microbatch program: forward + vjp
+        only, adding this microbatch's (scaled) grads into the DONATED
+        accumulator buffers — no optimizer math, no state touched."""
+        from .gluon import block as _gb
+
+        net, loss_fn = self._net, self._loss_fn
+        raw_fwd, out_struct, mutated_names = _gb._stage_fn(
+            lambda *call_args: loss_fn(net, *call_args),
+            params, names, in_struct, True, ctx, flavor)
+        frozen_pos = {n: j for j, n in enumerate(frozen_names)}
+
+        def grad_fn(w_list, frozen_list, acc_list, in_list, rng_key,
+                    scale, scale_alt, prev_ok):
+            _pstore.count_trace("train_step")
+            if has_ok:
+                scale_eff = jnp.where(prev_ok, scale, scale_alt)
+            else:
+                scale_eff = scale
+
+            def fwd(w_l):
+                full = [w_l[slot_of_name[n]] if n in slot_of_name
+                        else frozen_list[frozen_pos[n]] for n in names]
+                outs, muts = raw_fwd(full, in_list, rng_key)
+                heads = [o * scale_eff for o in outs] if has_ok else outs
+                return heads, (outs, muts)
+
+            heads, vjp_fn, (outs, muts) = jax.vjp(
+                fwd, list(w_list), has_aux=True)
+            cts = [jnp.ones(h.shape, h.dtype) for h in heads]
+            (grads,) = vjp_fn(cts)
+            grads = [g.astype(w.dtype) if g.dtype != w.dtype else g
+                     for g, w in zip(grads, w_list)]
+            new_acc = [a + g for a, g in zip(acc_list, grads)]
+            return outs, muts, new_acc
+
+        jitted = jax.jit(grad_fn, donate_argnums=(2,) if donate else ())
+        return (jitted, out_struct, mutated_names)
+
+    def _build_update_program(self, group_layout, has_ok, donate):
+        """The window-closing program: ONE fused optimizer update from
+        the accumulated grads (overflow detected on the SUM), the
+        sentinel digest cond, and freshly ZEROED accumulators returned
+        in the donated buffers so the next window starts clean."""
+        from .optimizer import fused as _fused
+
+        opt = self._trainer._optimizer
+        bodies = [_fused.group_step_fn(opt, mp, has_ok)
+                  for mp, _m in group_layout]
+
+        def update_fn(w_list, s_list, acc_list, lrs_g, wds_g, counts_g,
+                      rescale, rescale_alt, prev_ok, want_digest):
+            _pstore.count_trace("train_step")
+            if has_ok:
+                rescale_eff = jnp.where(prev_ok, rescale, rescale_alt)
+            else:
+                rescale_eff = rescale
+            grads = list(acc_list)
+            if has_ok:
+                ok = jnp.all(jnp.stack(
+                    [jnp.isfinite(g).all() for g in grads])) \
+                    if grads else jnp.asarray(True)
+            else:
+                ok = jnp.asarray(True)
+            new_w = list(w_list)
+            new_s = list(s_list)
+            for gi, (_mp, members) in enumerate(group_layout):
+                nw, ns = bodies[gi](
+                    [w_list[i] for i in members],
+                    [grads[i] for i in members],
+                    [s_list[i] for i in members],
+                    lrs_g[gi], wds_g[gi], counts_g[gi], rescale_eff, ok)
+                for j, i in enumerate(members):
+                    new_w[i] = nw[j]
+                    new_s[i] = ns[j]
+            from . import sentinel as _sentinel
+
+            state_leaves = jax.tree_util.tree_leaves(tuple(new_s))
+            dig = jax.lax.cond(
+                want_digest,
+                lambda: _sentinel.program_digest(new_w, state_leaves,
+                                                 grads),
+                _sentinel.zero_digest)
+            new_acc = [jnp.zeros_like(a) for a in acc_list]
+            return new_w, tuple(new_s), new_acc, ok, dig
+
+        return jax.jit(update_fn,
+                       donate_argnums=(0, 1, 2) if donate else ())
+
+    def _grad_lower_args(self, prep, in_specs):
+        """Abstract lowering args for the microbatch grad program
+        (precompile): mirrors :meth:`_lower_args` minus the optimizer
+        tail, plus the accumulator buffers."""
+        f32 = jax.ShapeDtypeStruct((), jnp.float32)
+        mesh = prep.mesh
+        if mesh is not None:
+            from .parallel import spmd as _spmd
+
+            n_dp = int(mesh.shape.get(_spmd.DATA_AXIS, 1))
+            bsh = _spmd.batch_sharding(mesh)
+
+            def _in_spec(s):
+                sh = bsh if (s.shape and s.shape[0] % n_dp == 0) \
+                    else prep.rep
+                return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
+
+            in_specs = [_in_spec(s) for s in in_specs]
+            prev_ok = jax.ShapeDtypeStruct((), jnp.bool_,
+                                           sharding=prep.rep)
+        else:
+            prev_ok = jax.ShapeDtypeStruct((), jnp.bool_)
+        w_args = [p.data()._data for p in prep.trainable]
+        frozen_args = [prep.params[n].data()._data
+                       for n in prep.frozen_names]
+        return (w_args, frozen_args, list(self._accum_bufs),
+                list(in_specs), jax.random.PRNGKey(0), f32, f32, prev_ok)
+
+    def _update_lower_args(self, prep):
+        """Abstract lowering args for the window-closing update program
+        (precompile): real param/state/accumulator buffers, abstract
+        per-window scalars."""
+        from .optimizer import fused as _fused
+
+        f32 = jax.ShapeDtypeStruct((), jnp.float32)
+        if prep.mesh is not None:
+            prev_ok = jax.ShapeDtypeStruct((), jnp.bool_,
+                                           sharding=prep.rep)
+            want = jax.ShapeDtypeStruct((), jnp.bool_, sharding=prep.rep)
+        else:
+            prev_ok = jax.ShapeDtypeStruct((), jnp.bool_)
+            want = jax.ShapeDtypeStruct((), jnp.bool_)
+        g32 = [jax.ShapeDtypeStruct((len(m),), jnp.float32)
+               for _mp, m in prep.group_layout]
+        w_args = [p.data()._data for p in prep.trainable]
+        s_args = tuple(_fused._unwrap(s) for s in prep.states)
+        return (w_args, s_args, list(self._accum_bufs),
+                list(g32), list(g32), list(g32), f32, f32, prev_ok, want)
 
     def _build_program(self, params, names, in_struct, ctx, flavor,
                        slot_of_name, frozen_names, group_layout, has_ok,

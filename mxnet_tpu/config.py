@@ -151,14 +151,22 @@ declare("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
         "bucket (reference kvstore_dist big-array splitting)",
         validator=lambda v: v > 0, subsystem="kvstore")
 declare("MXNET_SPMD_MESH", str, "auto",
-        "Data-parallel SPMD mesh for kvstore='tpu' (cached_step.TrainStep "
-        "traces under it: batch sharded over the 'dp' axis, params/"
-        "optimizer state replicated, the gradient all-reduce ICI-native "
-        "inside the one donated program).  'auto' = every visible device "
-        "on 'dp' (single-device worlds stay on the plain single-chip "
-        "path); an integer = that many devices; '0'/'off' disables; "
-        "'dp=4,tp=2' axis specs go through parallel.mesh.make_mesh.",
+        "SPMD mesh for kvstore='tpu' (cached_step.TrainStep traces under "
+        "it; all collectives scheduled by the XLA partitioner inside the "
+        "one donated program).  'auto' = every visible device on 'dp' "
+        "(single-device worlds stay on the plain single-chip path); an "
+        "integer = that many devices on 'dp'; '0'/'off' disables; "
+        "'dp=4,fsdp=2' axis specs go through parallel.mesh.make_mesh — "
+        "the batch shards over 'dp' only, an 'fsdp' axis shards params + "
+        "optimizer state (ZeRO-3 style, spmd.param_spec), and a 'tp' "
+        "axis carries model-code sharding.constraint annotations.",
         subsystem="kvstore", cached=False)
+declare("MXNET_FSDP_MIN_SIZE", int, 1024,
+        "FSDP sharding floor (spmd.param_spec): parameter/optimizer-"
+        "state leaves with fewer elements than this stay replicated on "
+        "an 'fsdp' mesh axis — sharding a LayerNorm bias buys no memory "
+        "and costs an all-gather.",
+        validator=lambda v: v >= 0, subsystem="kvstore", cached=False)
 declare("MXNET_ENGINE_PREFETCH", int, 2,
         "Async pipeline engine: device-prefetch depth — how many batches "
         "a DevicePrefetcher transfer thread stages into HBM ahead of the "

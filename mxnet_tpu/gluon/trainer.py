@@ -168,7 +168,7 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
-    def compile_step(self, net, loss_fn, bucket=False):
+    def compile_step(self, net, loss_fn, bucket=False, accum_steps=1):
         """Compile forward + backward + gradient reduce + fused optimizer
         update (+ AMP gate) into ONE donated XLA program — the CachedOp
         analog for training (``cached_step.TrainStep``).  ``loss_fn(net,
@@ -189,12 +189,23 @@ class Trainer:
         ``MXNET_SHAPE_BUCKETS`` grid (``serving.BucketPolicy``) so they
         stop blowing the shape-keyed program cache; requires a PAD-SAFE
         (masked) loss — verified once per bucket, refused sticky
-        otherwise (``step.bucket_refused``)."""
+        otherwise (``step.bucket_refused``).
+
+        ``accum_steps=N`` turns every N calls into ONE gradient-
+        accumulation window: N microbatch grad dispatches into donated
+        accumulator buffers, then one fused update — exactly N+1
+        dispatches, one optimizer update-count bump, and one AMP gate
+        decision per window, numerically the mean over the combined
+        N×batch_size batch.  Accumulation requires the compiled path
+        (the eager tape refuses it loudly rather than applying N
+        updates)."""
         from ..cached_step import TrainStep
 
-        return TrainStep(net, loss_fn, self, bucket=bucket)
+        return TrainStep(net, loss_fn, self, bucket=bucket,
+                         accum_steps=accum_steps)
 
-    def precompile(self, net, loss_fn, specs, bucket=False):
+    def precompile(self, net, loss_fn, specs, bucket=False,
+                   accum_steps=1):
         """Ahead-of-time warm-up: compile the whole train step for the
         given input signature BEFORE the first batch arrives (the
         deploy-time / elastic-restore counterpart of ``compile_step``;
@@ -212,8 +223,9 @@ class Trainer:
         ready :class:`~mxnet_tpu.cached_step.TrainStep` — use THAT
         object for training (each TrainStep owns its program keyspace).
         Raises when the step would fall back to the eager tape."""
-        return self.compile_step(net, loss_fn,
-                                 bucket=bucket).precompile(*specs)
+        return self.compile_step(
+            net, loss_fn, bucket=bucket,
+            accum_steps=accum_steps).precompile(*specs)
 
     def step_spans(self, limit=None):
         """Per-step span records of the compiled train step (cat

@@ -243,7 +243,19 @@ def constraint(x, spec: Union[PartitionSpec, Sequence], mesh: Optional[Mesh] = N
       Padding is the caller's move, at the batch boundary.
     - A spec naming an axis the mesh does not have still raises — a
       typo'd axis must not silently drop the constraint.
+    - NDArray wrappers pass through transparently (unwrapped,
+      constrained, re-wrapped), so model code can pin an activation or
+      weight layout inside a hybridizable ``forward`` — the compiled
+      train step traces and dispatches inside the mesh context, so the
+      annotation reaches the XLA partitioner (the tensor-parallel
+      path: ``constraint(h, ('dp', 'tp'))`` on a hidden activation).
     """
+    data = getattr(x, "_data", None)
+    if data is not None and hasattr(x, "ctx"):
+        from ..ndarray import ndarray as _ndmod
+
+        out = constraint(data, spec, mesh)
+        return _ndmod._wrap(out, x.ctx, type(x))
     if mesh is None:
         from .mesh import current_mesh
 
@@ -253,14 +265,21 @@ def constraint(x, spec: Union[PartitionSpec, Sequence], mesh: Optional[Mesh] = N
     if mesh is None or not getattr(mesh, "shape", None):
         return x  # no mesh anywhere: mesh-agnostic no-op
     spec = spec if isinstance(spec, PartitionSpec) else PartitionSpec(*spec)
-    known = set(mesh.shape)
+    # canonical axes (mesh.AXIS_NAMES) the mesh does not carry are
+    # size-1 by convention and legalize away silently — a model
+    # annotated for 'tp' still runs on a pure-dp mesh (the parity
+    # oracle).  A NON-canonical name is a typo and must raise.
+    from .mesh import AXIS_NAMES
+
+    known = set(mesh.shape) | set(AXIS_NAMES)
     for axes in tuple(spec):
         for a in (axes if isinstance(axes, (tuple, list)) else (axes,)):
             if a is not None and a not in known:
                 raise ValueError(
                     f"sharding constraint names axis {a!r} but the mesh "
-                    f"in scope only has {sorted(known)} — a typo'd axis "
-                    "must not silently drop the constraint")
+                    f"in scope only has {sorted(mesh.shape)} (canonical "
+                    f"axes {AXIS_NAMES} legalize away when absent) — a "
+                    "typo'd axis must not silently drop the constraint")
     lspec = _legalize(spec, tuple(getattr(x, "shape", ())), mesh, loud=True)
     if isinstance(mesh, Mesh):
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, lspec))

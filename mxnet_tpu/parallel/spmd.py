@@ -13,16 +13,28 @@ overlap backward; arXiv:2008.01040 — padding/placement is where TPU
 performance lives).  ``Trainer(..., kvstore='tpu')`` +
 ``Trainer.compile_step`` route through here with zero user-code changes.
 
+Beyond pure data parallelism the same one-program contract covers the
+model-parallel axes: an ``fsdp`` mesh axis shards parameters and
+optimizer state (ZeRO-3 style — :func:`param_spec` picks each leaf's
+largest evenly-divisible dim, indivisible leaves replicate LOUDLY via
+the ``sharding.legalize_refusal`` idiom), and a ``tp`` axis carries
+``sharding.constraint`` annotations from model code through the traced
+step.  In every case the scatter/gather/all-reduce schedule belongs to
+the XLA SPMD partitioner INSIDE the one donated program — still 1
+dispatch/step, 0 retraces, 0 host-side cross-device copies.
+
 This module owns the placement plumbing shared by ``cached_step``
 (training), ``engine.DevicePrefetcher`` (input staging), ``serving``
 (replicated inference) and the DataLoader (per-process sharded
 sampling):
 
-- :func:`mesh_for_store` — resolve the data-parallel mesh for a kvstore
-  type under the ``MXNET_SPMD_MESH`` knob (``auto`` = every visible
-  device on the ``'dp'`` axis; an int = that many devices; ``off``
-  disables; ``dp=4,tp=2`` spec strings go through
-  :func:`mesh.make_mesh`).
+- :func:`mesh_for_store` — resolve the mesh for a kvstore type under
+  the ``MXNET_SPMD_MESH`` knob (``auto`` = every visible device on the
+  ``'dp'`` axis; an int = that many devices; ``off`` disables;
+  ``dp=4,fsdp=2`` axis-spec strings go through :func:`mesh.make_mesh`
+  — the compiled step shards the batch over ``'dp'`` only, params/
+  optimizer state over ``'fsdp'``, and leaves ``'tp'`` placement to
+  model-code :func:`~.sharding.constraint` calls).
 - :func:`put_batch` — stage one batch leaf with the batch
   ``NamedSharding`` (site ``spmd.put``, shared retry policy).  Under
   multi-controller the host array is this process's shard of the
@@ -50,14 +62,22 @@ from .. import config as _config
 from .. import faults as _faults
 from .mesh import make_mesh
 
-__all__ = ["DATA_AXIS", "mesh_for_store", "resolve_mesh", "batch_sharding",
-           "replicated", "batch_spec_for", "put_batch", "ensure_placed",
+__all__ = ["DATA_AXIS", "MODEL_AXIS", "TENSOR_AXIS", "mesh_for_store",
+           "resolve_mesh", "batch_sharding", "replicated", "batch_spec_for",
+           "param_spec", "param_sharding", "put_batch", "ensure_placed",
            "mesh_key", "reshard_count", "replicated_batch_count",
-           "reset_counters"]
+           "record_layout", "param_bytes_per_device",
+           "opt_bytes_per_device", "reset_counters"]
 
 # the canonical data-parallel axis (mesh.AXIS_NAMES's 'dp'): the KVStore
 # axis — gradients all-reduce over it, the batch shards over it
 DATA_AXIS = "dp"
+# the parameter-sharding axis (ZeRO/FSDP): params + optimizer state
+# shard over it, the batch does NOT
+MODEL_AXIS = "fsdp"
+# the tensor-parallel axis: placement is model-code's move (via
+# sharding.constraint / a ShardingPlan), never implied by this module
+TENSOR_AXIS = "tp"
 
 # kvstore types whose reduce is the ICI-collective mesh path.  dist/
 # ps-lite-style stores stay host-driven and keep the eager fallback.
@@ -82,6 +102,64 @@ _REPLICATED_BATCH = _telemetry.counter(
     "batch axis evenly (correct but no scale-out that step)")
 _WARNED_SHAPES: set = set()
 
+# per-device memory accounting: the byte footprint of the CURRENT
+# parameter / optimizer-state layout on ONE device, computed from each
+# placed leaf's actual sharding (shard_shape) — so an fsdp-sharded
+# layout reads ~1/N of the replicated one.  Recorded by the TrainStep
+# warmup (record_layout), surfaced as computed gauges in
+# telemetry.report() and stamped into the MULTICHIP bench lanes.
+_LAYOUT_BYTES = {"param": 0, "opt": 0}
+
+
+def _leaf_bytes_per_device(arr) -> int:
+    """One leaf's bytes on ONE device: the shard shape (under its actual
+    sharding) × itemsize.  Unplaced/host leaves count their full size."""
+    shape = tuple(int(s) for s in getattr(arr, "shape", ()))
+    sh = getattr(arr, "sharding", None)
+    if sh is not None:
+        try:
+            shape = tuple(int(s) for s in sh.shard_shape(shape))
+        except Exception:
+            pass
+    n = 1
+    for s in shape:
+        n *= s
+    itemsize = getattr(getattr(arr, "dtype", None), "itemsize", 4)
+    return n * int(itemsize)
+
+
+def record_layout(param_leaves, opt_leaves) -> None:
+    """Record the per-device byte footprint of the placed parameter and
+    optimizer-state layout (TrainStep warmup calls this after
+    placement; single-chip layouts record their full size)."""
+    p = sum(_leaf_bytes_per_device(a) for a in param_leaves)
+    o = sum(_leaf_bytes_per_device(a) for a in opt_leaves)
+    with _lock:
+        _LAYOUT_BYTES["param"] = int(p)
+        _LAYOUT_BYTES["opt"] = int(o)
+
+
+def param_bytes_per_device() -> int:
+    """Bytes of parameters resident on ONE device under the current
+    layout (gauge ``spmd.param_bytes_per_device``)."""
+    return int(_LAYOUT_BYTES["param"])
+
+
+def opt_bytes_per_device() -> int:
+    """Bytes of optimizer state resident on ONE device under the
+    current layout (gauge ``spmd.opt_bytes_per_device``)."""
+    return int(_LAYOUT_BYTES["opt"])
+
+
+_telemetry.gauge_fn(
+    "spmd.param_bytes_per_device", param_bytes_per_device,
+    "bytes of parameters resident on one device under the current "
+    "layout (replicated: the full model; fsdp-sharded: ~1/N)")
+_telemetry.gauge_fn(
+    "spmd.opt_bytes_per_device", opt_bytes_per_device,
+    "bytes of optimizer state resident on one device under the current "
+    "layout (replicated: the full state; fsdp-sharded: ~1/N)")
+
 
 def reshard_count() -> int:
     return int(_RESHARD.value)
@@ -94,6 +172,9 @@ def replicated_batch_count() -> int:
 def reset_counters() -> None:
     _RESHARD.reset()
     _REPLICATED_BATCH.reset()
+    with _lock:
+        _LAYOUT_BYTES["param"] = 0
+        _LAYOUT_BYTES["opt"] = 0
 
 
 # ---------------------------------------------------------------------------
@@ -145,9 +226,12 @@ def resolve_mesh(spec: Optional[str] = None) -> Optional[Mesh]:
     - ``0`` / ``off`` / ``none``: disabled.
     - ``<int>``: that many devices on ``'dp'`` (``1`` gives a real
       1-device mesh — the parity oracle for sharded-vs-single tests).
-    - ``dp=4,tp=2`` style: axis spec via :func:`mesh.make_mesh` (the
-      compiled step shards the batch over ``'dp'`` only; other axes need
-      a ShardingPlan and ride :class:`~.train.ShardedTrainer`).
+    - ``dp=4,fsdp=2`` style: axis spec via :func:`mesh.make_mesh`.  The
+      compiled step shards the batch over ``'dp'`` ONLY; an ``fsdp``
+      axis shards params + optimizer state (:func:`param_spec`); a
+      ``tp`` axis is left to model-code ``sharding.constraint`` calls,
+      which resolve against this mesh inside the traced step.  Axes
+      compose on one mesh (``dp=2,fsdp=2,tp=2`` needs 8 devices).
 
     Every form resolves over the ADMITTED device set: devices (or whole
     ranks) in the sentinel's persisted quarantine list are excluded, so
@@ -207,8 +291,50 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """The canonical batch placement: axis 0 split over ``'dp'``."""
+    """The canonical batch placement: axis 0 split over ``'dp'`` — and
+    ONLY ``'dp'``; a multi-axis mesh (``fsdp``/``tp``) never shards the
+    batch over its model axes."""
     return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+
+def param_spec(shape: Tuple[int, ...], mesh: Mesh,
+               min_size: Optional[int] = None) -> PartitionSpec:
+    """FSDP/ZeRO placement rule for one parameter / optimizer-state
+    leaf: shard the LARGEST dim the ``'fsdp'`` axis divides evenly.
+
+    Leaves below ``min_size`` elements (``MXNET_FSDP_MIN_SIZE``) stay
+    replicated — sharding a LayerNorm bias buys nothing and costs an
+    all-gather.  A large leaf NO dim of which divides the axis degrades
+    to replication LOUDLY via the ``sharding.legalize_refusal`` idiom
+    (counted + warned once per shape), never an error mid-warmup."""
+    if min_size is None:
+        min_size = int(_config.get("MXNET_FSDP_MIN_SIZE"))
+    n = int(mesh.shape.get(MODEL_AXIS, 1))
+    if n <= 1 or not shape:
+        return PartitionSpec()
+    size = 1
+    for s in shape:
+        size *= int(s)
+    if size < min_size:
+        return PartitionSpec()
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % n == 0:
+            spec = [None] * len(shape)
+            spec[i] = MODEL_AXIS
+            return PartitionSpec(*spec)
+    # no dim divides the axis: the loudly-replicated fallback
+    from .sharding import _legalize
+
+    return _legalize(PartitionSpec(MODEL_AXIS), tuple(shape), mesh,
+                     loud=True)
+
+
+def param_sharding(shape: Tuple[int, ...], mesh: Mesh) -> NamedSharding:
+    """The ``NamedSharding`` a param/state leaf of ``shape`` takes on
+    ``mesh``: :func:`param_spec` when the mesh has a real ``fsdp``
+    axis, replicated otherwise."""
+    return NamedSharding(mesh, param_spec(shape, mesh))
 
 
 def batch_spec_for(shape: Tuple[int, ...], mesh: Mesh) -> PartitionSpec:

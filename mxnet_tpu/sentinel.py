@@ -35,6 +35,18 @@ compiled step, the mesh, the elastic loop, checkpoints, and telemetry:
    (named in a ``corruption`` telemetry event + counted in
    ``sentinel.replica_divergence``), not merely detected.
 
+   FSDP composition (ISSUE 18): the fold itself is mesh-shape
+   INVARIANT — exact wrap-around uint32 arithmetic is associative and
+   commutative, so a dp×fsdp-sharded state digests to the same integer
+   as its replicated or single-chip placement (a scale event or
+   topology change never fakes a verdict).  The vote, however, runs on
+   the digest's post-reduce output shards, which the partitioner makes
+   identical across devices — under fsdp the per-device redundancy
+   that powers minority LOCALIZATION degrades to a trivially unanimous
+   vote.  Detection (host-recompute mismatch, anomaly windows,
+   rollback, quarantine) is unchanged; only the "which chip" attribution
+   narrows to the replicated-param case.
+
 3. **Anomaly windows + rollback.**  :class:`Window` generalizes
    ``nonfinite_anomaly`` into an EMA + z-score detector
    (``MXNET_SENTINEL_ZMAX``) over the digest's grad-norm (and any loss

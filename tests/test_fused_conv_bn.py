@@ -307,6 +307,7 @@ def test_biased_conv_fuses_exactly(force_fused):
                                 rtol=5e-2, atol=5e-2, err_msg="weight_grad")
 
 
+@pytest.mark.slow
 def test_resnet18_fuses_conv_bn_sites_smoke(force_fused):
     """Tier-1 smoke for whole-model conv+BN fusion: resnet18_v1 NHWC in
     one hybridized train trace routes its 3 downsample 1x1 sites and 14

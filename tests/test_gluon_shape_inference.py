@@ -88,7 +88,10 @@ def test_infer_shape_explicit():
 # jax, so the cast matrix uses the dtypes the platform really serves)
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+@pytest.mark.parametrize("dtype", [
+    pytest.param("float16", marks=pytest.mark.slow),   # ISSUE-18 wall
+    "bfloat16",                     # the TPU-native dtype stays tier-1
+])
 def test_cast_then_forward_backward(dtype):
     net = gluon.model_zoo.vision.get_model("resnet18_v1", classes=10)
     net.initialize()

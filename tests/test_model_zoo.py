@@ -12,8 +12,11 @@ from mxnet_tpu import gluon
 from mxnet_tpu.gluon.model_zoo import vision
 
 
-@pytest.mark.parametrize("name", ["resnet18_v1", "resnet18_v2",
-                                  "mobilenet0.25", "squeezenet1.1"])
+@pytest.mark.parametrize("name", [
+    "resnet18_v1", "resnet18_v2",
+    pytest.param("mobilenet0.25", marks=pytest.mark.slow),  # ISSUE-18 wall
+    pytest.param("squeezenet1.1", marks=pytest.mark.slow),  # ISSUE-18 wall
+])
 def test_model_forward(name):
     net = vision.get_model(name, classes=7)
     net.initialize()
@@ -27,6 +30,7 @@ def test_get_model_unknown():
         vision.get_model("not_a_model")
 
 
+@pytest.mark.slow
 def test_resnet18_train_step():
     net = vision.get_model("resnet18_v1", classes=4)
     net.initialize(mx.init.Xavier())
@@ -44,6 +48,7 @@ def test_resnet18_train_step():
     assert onp.isfinite(loss.asnumpy()).all()
 
 
+@pytest.mark.slow
 def test_resnet_channels_progression():
     net = vision.get_model("resnet50_v1", classes=10)
     net.initialize()

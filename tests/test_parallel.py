@@ -456,6 +456,7 @@ def _adam_ref_loop(cfg, params, batches, lr=1e-3, beta1=0.9, beta2=0.999,
     return params, losses
 
 
+@pytest.mark.slow
 def test_pp_multistep_convergence_matches_unpipelined():
     """VERDICT r3 item 9: ≥10 steps of pp training track the unpipelined
     loss curve — schedule bugs (stale activations, microbatch skew,

@@ -171,6 +171,7 @@ def test_conv_bn_relu_folds_and_requantize_fuses():
     assert (ref.argmax(1) == got.argmax(1)).mean() >= 0.9
 
 
+@pytest.mark.slow
 def test_quantize_net_nhwc_s2d_fast_path():
     """The bench's channel-minor fast path quantizes natively: NHWC convs
     (incl. the space-to-depth stem) become quantized_conv with layout NHWC

@@ -19,6 +19,7 @@ from mxnet_tpu.gluon.model_zoo import vision
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_model_store_publish_and_pretrained(tmp_path):
     """Offline pretrained flow: train -> save -> publish -> get_model
     (pretrained=True) resolves from the local cache."""

@@ -105,6 +105,20 @@ MESH_BUDGET = {"compiled_launches_per_step": 1, "eager_invokes_per_step": 0,
                "group_launches_per_step": 0, "retraces_after_warm": 0,
                "host_syncs_per_step": 0, "reshards_after_warm": 0,
                "replicated_batches": 0}
+# the FSDP budget (docs/PERF.md "Sharded training"): with
+# MXNET_SPMD_MESH='dp=2,fsdp=2' params AND optimizer state shard over
+# the fsdp axis, yet the step STAYS one compiled launch with zero
+# retraces and zero steady-state reshards — the partitioner schedules
+# the all-gather/reduce-scatter INSIDE the one donated program, never
+# the host.  Accumulation sub-lane: compile_step(accum_steps=N) pays
+# exactly N+1 dispatches per window (N microbatch grad programs + ONE
+# fused update), zero retraces once both programs are warm —
+# accum_extra_dispatches is measured-per-window minus (N+1)
+FSDP_BUDGET = {"compiled_launches_per_step": 1, "eager_invokes_per_step": 0,
+               "group_launches_per_step": 0, "retraces_after_warm": 0,
+               "host_syncs_per_step": 0, "reshards_after_warm": 0,
+               "replicated_batches": 0, "accum_extra_dispatches": 0,
+               "accum_retraces_after_warm": 0}
 STEPS = 5
 INFER_REQUESTS = 24
 INFER_MAXLEN = 16
@@ -300,6 +314,99 @@ def _measure_mesh() -> dict:
         "replicated_batches": b1 - b0,
     }
     return out
+
+
+def _measure_fsdp() -> dict:
+    """dp×fsdp lane: params + optimizer state sharded over the fsdp
+    axis, batch over dp only — still ONE launch/step, zero retraces,
+    zero steady-state reshards, and param bytes per device at 1/fsdp of
+    the replicated footprint.  Then the accumulation sub-lane on the
+    same mesh: accum_steps=2 must pay exactly 3 dispatches per window
+    (2 grad + 1 fused update), zero retraces after the first window."""
+    import jax
+
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu import cached_step
+    from mxnet_tpu.ndarray import ndarray as _ndmod
+    from mxnet_tpu.optimizer import fused
+    from mxnet_tpu.parallel import spmd
+
+    n_dev = len(jax.devices())
+    if n_dev < 4:
+        return {"mode": "fsdp", "skipped": f"only {n_dev} device(s)"}
+    prev_mesh = os.environ.get("MXNET_SPMD_MESH")
+    prev_min = os.environ.get("MXNET_FSDP_MIN_SIZE")
+    os.environ["MXNET_SPMD_MESH"] = "dp=2,fsdp=2"
+    os.environ["MXNET_FSDP_MIN_SIZE"] = "1"     # the gate MLP is tiny
+    try:
+        net, trainer, loss_fn, data, label = _build(
+            seed=3, rows=8, kvstore="tpu")
+        step = trainer.compile_step(net, loss_fn)
+        loss = step(data, label, batch_size=8)          # warm
+        float(loss.asnumpy().ravel()[0])
+        weight = net.collect_params()["d1.weight"].data()._data
+        shard = weight.sharding.shard_shape(weight.shape)
+        total = sum(p.data()._data.nbytes
+                    for _n, p in sorted(net.collect_params().items()))
+        per_dev = spmd.param_bytes_per_device()
+        inv0, d0, f0, t0 = (_ndmod.invoke_count(),
+                            cached_step.dispatch_count(),
+                            fused.dispatch_count(),
+                            cached_step.trace_count())
+        h0 = _ndmod.host_sync_count()
+        r0, b0 = spmd.reshard_count(), spmd.replicated_batch_count()
+        for _ in range(STEPS):
+            loss = step(data, label, batch_size=8)
+        h1 = _ndmod.host_sync_count()
+        r1, b1 = spmd.reshard_count(), spmd.replicated_batch_count()
+        float(loss.asnumpy().ravel()[0])
+        out = {
+            "mode": "fsdp",
+            "skipped": None,
+            "used_compiled": step.last_step_compiled,
+            "mesh_active": step.mesh is not None,
+            "param_sharded": tuple(shard) != tuple(weight.shape),
+            "param_bytes_per_device": per_dev,
+            "param_bytes_frac": per_dev / total if total else 1.0,
+            "eager_invokes_per_step":
+                (_ndmod.invoke_count() - inv0) / STEPS,
+            "compiled_launches_per_step":
+                (cached_step.dispatch_count() - d0) / STEPS,
+            "group_launches_per_step":
+                (fused.dispatch_count() - f0) / STEPS,
+            "retraces_after_warm": cached_step.trace_count() - t0,
+            "host_syncs_per_step": (h1 - h0) / STEPS,
+            "reshards_after_warm": r1 - r0,
+            "replicated_batches": b1 - b0,
+        }
+        # accumulation sub-lane: same dp×fsdp mesh, accum_steps=2 —
+        # exactly N+1 = 3 dispatches per window, zero retraces after
+        # the first full window (grad + update programs both warm)
+        net2, tr2, loss2, d2, l2 = _build(seed=4, rows=8, kvstore="tpu")
+        astep = tr2.compile_step(net2, loss2, accum_steps=2)
+        for _ in range(2):                              # warm one window
+            loss = astep(d2, l2, batch_size=8)
+        float(loss.asnumpy().ravel()[0])
+        ad0, at0 = cached_step.dispatch_count(), cached_step.trace_count()
+        windows = 3
+        for _ in range(2 * windows):
+            loss = astep(d2, l2, batch_size=8)
+        float(loss.asnumpy().ravel()[0])
+        per_window = (cached_step.dispatch_count() - ad0) / windows
+        out["accum_used_compiled"] = astep.last_step_compiled
+        out["accum_dispatches_per_window"] = per_window
+        out["accum_extra_dispatches"] = per_window - 3.0
+        out["accum_retraces_after_warm"] = cached_step.trace_count() - at0
+        return out
+    finally:
+        if prev_mesh is None:
+            os.environ.pop("MXNET_SPMD_MESH", None)
+        else:
+            os.environ["MXNET_SPMD_MESH"] = prev_mesh
+        if prev_min is None:
+            os.environ.pop("MXNET_FSDP_MIN_SIZE", None)
+        else:
+            os.environ["MXNET_FSDP_MIN_SIZE"] = prev_min
 
 
 def _measure_infer() -> dict:
@@ -618,6 +725,18 @@ def main() -> int:
               f"{mesh['retraces_after_warm']} retraces, "
               f"{mesh['reshards_after_warm']} reshards, "
               f"{mesh['replicated_batches']} replicated batches")
+    fsdp = _measure_fsdp()
+    if fsdp["skipped"]:
+        print(f"fsdp       SKIPPED ({fsdp['skipped']})")
+    else:
+        print(f"{'fsdp':<10} dp=2,fsdp=2 -> "
+              f"{fsdp['compiled_launches_per_step']:.1f} launch/step, "
+              f"{fsdp['retraces_after_warm']} retraces, "
+              f"{fsdp['reshards_after_warm']} reshards, "
+              f"{fsdp['param_bytes_frac']:.2f}x param bytes/device; "
+              f"accum 2 -> {fsdp['accum_dispatches_per_window']:.1f} "
+              f"dispatches/window, "
+              f"{fsdp['accum_retraces_after_warm']} retraces")
     # program-store lane: all the steady-state runs above went through
     # the store — they must not have evicted anything
     ev_after_warm = sum(
@@ -723,6 +842,29 @@ def main() -> int:
             if mesh[key] > budget:
                 failures.append(
                     f"mesh {key} = {mesh[key]} exceeds budget {budget}")
+    if not fsdp["skipped"]:
+        if not fsdp["used_compiled"]:
+            failures.append("fsdp mode fell back to the eager tape")
+        if not fsdp["accum_used_compiled"]:
+            failures.append(
+                "fsdp accumulation mode fell back to the eager tape")
+        if not fsdp["mesh_active"]:
+            failures.append(
+                "fsdp lane: kvstore='tpu' did not resolve a dp=2,fsdp=2 "
+                "mesh")
+        if not fsdp["param_sharded"]:
+            failures.append(
+                "fsdp lane: d1.weight is fully replicated — the fsdp "
+                "axis did not shard the parameters")
+        if fsdp["param_bytes_frac"] > 0.75:
+            failures.append(
+                f"fsdp lane: param bytes per device is "
+                f"{fsdp['param_bytes_frac']:.2f}x the global footprint "
+                "(expected ~1/fsdp = 0.5x on a 2-way fsdp axis)")
+        for key, budget in FSDP_BUDGET.items():
+            if fsdp[key] > budget:
+                failures.append(
+                    f"fsdp {key} = {fsdp[key]} exceeds budget {budget}")
     if ev_after_warm > STORE_BUDGET["evictions_after_warm"]:
         failures.append(
             f"program store evicted {ev_after_warm} programs during "
@@ -777,6 +919,12 @@ def main() -> int:
              f"; mesh within budget ({mesh['mesh_devices']}-device SPMD, "
              f"{mesh['compiled_launches_per_step']:.0f} launch/step, "
              f"{mesh['reshards_after_warm']} steady-state reshards)")
+          + ("" if fsdp["skipped"] else
+             f"; fsdp within budget "
+             f"({fsdp['compiled_launches_per_step']:.0f} launch/step at "
+             f"{fsdp['param_bytes_frac']:.2f}x param bytes/device, accum "
+             f"{fsdp['accum_dispatches_per_window']:.0f} "
+             f"dispatches/window)")
           + f"; program store within budget ({ev_after_warm} evictions, "
             f"warm 2nd process {store['second_process_compiles']} "
             f"compiles / {store['second_process_disk_hits']} disk hits)")

@@ -105,6 +105,25 @@ RULES: Tuple[Rule, ...] = (
          and n.split(".")[-1] in ("scale_ups", "scale_downs",
                                   "scale_errors"),
          tol=0.10, slack=2.0),
+    # ISSUE 18: sharding regressions — a workload that suddenly needs
+    # steady-state host-side reshards, silently-replicated batches, or
+    # more refused (replicated) spec dims has lost its SPMD scaling
+    # even if wall-clock momentarily survives
+    Rule("spmd-reshard",
+         lambda n: n in ("spmd.reshard", "spmd.replicated_batch"),
+         tol=0.0, slack=0.0),
+    Rule("sharding-refusal",
+         lambda n: n == "sharding.legalize_refusal",
+         tol=0.10, slack=2.0),
+    # memory-per-chip gauges (spmd.param_bytes_per_device /
+    # spmd.opt_bytes_per_device): a candidate whose per-device param or
+    # optimizer-state footprint grows >10% over baseline on the same
+    # lane has regressed its sharding placement (e.g. a leaf fell back
+    # to replication)
+    Rule("spmd-bytes-per-device",
+         lambda n: n in ("spmd.param_bytes_per_device",
+                         "spmd.opt_bytes_per_device"),
+         tol=0.10, slack=1024.0),
 )
 
 # lane-level scalar aliases gated alongside the namespaced counters

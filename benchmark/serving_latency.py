@@ -42,9 +42,17 @@ pass AND the eager oracle.  ``prefix_miss_blocks`` rides the lane dict
 so tools/check_perf_delta.py gates hit-rate regressions round over
 round.
 
+``--speculative`` is the ISSUE-19 lane: the SAME greedy prompt set
+through a high-agreement draft/target pair with ``MXNET_SPEC_DECODE=1``
+vs the non-spec baseline, stamping tokens/s, measured acceptance,
+tokens-per-round, and target-dispatches-per-token — the worker ENFORCES
+the acceptance bars (>= 1.5x tokens/s at acceptance >= 0.7, token-exact
+vs the eager oracle, low-agreement draft auto-disabled with tokens/s
+never regressing past 5% of baseline).
+
 Usage: python benchmark/serving_latency.py [--json] [--serve-only]
-           [--decode-only] [--storm] [--shared-prefix] [--requests N]
-           [--threads T]
+           [--decode-only] [--storm] [--shared-prefix] [--speculative]
+           [--requests N] [--threads T]
 """
 import json
 import os
@@ -588,6 +596,161 @@ print(json.dumps(lane))
 """
 
 
+_SPEC_WORKER = r"""
+import json, os, sys, threading, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))) if "__file__" in dir() else "/root/repo")
+import numpy as onp
+import jax
+from mxnet_tpu import serving_decode as sd, telemetry
+
+REQS = int(os.environ.get("SPEC_REQUESTS", "12"))
+NEW = int(os.environ.get("SPEC_NEW_TOKENS", "24"))
+K = int(os.environ.get("SPEC_K", "4"))
+ENFORCE = os.environ.get("SPEC_ENFORCE", "1") == "1"
+
+# the high-agreement pair: a deep target whose extra layers are
+# identity, so draft logits == target logits (acceptance 1.0 by
+# construction) while the target still pays 8x the draft's per-token
+# compute — the workload speculation exists for
+target, tp, draft, dp = sd.high_agreement_pair(
+    vocab=128, d_model=64, target_layers=8, draft_layers=1,
+    n_heads=4, max_seq=96, seed=0)
+
+rng = onp.random.RandomState(0)
+prompts = [rng.randint(0, 128, size=rng.randint(4, 13)).tolist()
+           for _ in range(REQS)]
+
+def run(spec_on, draft_model=None, draft_params=None, label="x"):
+    '''One pass of the SAME greedy prompt set; returns tokens/s and the
+    spec counters.  The knob is uncached, so the env flip scopes to
+    the engine built under it.'''
+    os.environ["MXNET_SPEC_DECODE"] = "1" if spec_on else "0"
+    pool = sd.PagePool(pages=256, page=8)
+    kw = (dict(draft=draft_model, draft_params=draft_params, spec_k=K)
+          if draft_model is not None else {})
+    # max_rows=2: decode-bound rows, the workload the k-for-1 verify
+    # win targets (wide batches amortize dispatch on their own)
+    eng = sd.GenerativeEngine(target, params=tp, pool=pool, max_rows=2,
+                              name="spec_" + label, **kw)
+    eng.warmup(max_len=16)
+    eng.generate(prompts[0], max_new_tokens=2)   # first-dispatch warm
+    outs, errs = {}, []
+    lock = threading.Lock()
+    def fire(i):
+        try:
+            out = eng.generate(prompts[i], max_new_tokens=NEW)
+            with lock:
+                outs[i] = out
+        except BaseException as e:
+            errs.append(repr(e))
+    ths = [threading.Thread(target=fire, args=(i,)) for i in range(REQS)]
+    t0 = time.perf_counter()
+    for t in ths: t.start()
+    for t in ths: t.join()
+    wall = time.perf_counter() - t0
+    if errs:
+        raise RuntimeError("; ".join(errs))
+    st = eng.stats()
+    eng.close()
+    if pool.in_use():
+        raise RuntimeError(f"leaked {pool.in_use()} pages ({label})")
+    bad = pool.audit()
+    if bad:
+        raise RuntimeError(f"pool audit failed ({label}): {bad}")
+    toks = sum(len(o) for o in outs.values())
+    return {
+        "outs": [outs[i] for i in range(REQS)],
+        "wall_s": wall, "tokens": toks, "tokens_s": toks / wall,
+        "rounds": st["spec_rounds"], "proposed": st["spec_proposed"],
+        "accepted": st["spec_accepted"],
+        "fallbacks": st["spec_fallbacks"],
+        "disabled": st["spec_disabled"],
+        "decode_steps": st["decode_steps"],
+    }
+
+base = run(False, label="base")          # the non-spec baseline
+# LOW-agreement leg first (so the final spec.* gauge snapshot reflects
+# the healthy high-agreement pass): an independently-initialized draft
+# whose proposals rarely match — the cost table must auto-disable and
+# tokens/s must stay within 5% of baseline (never a regression)
+low_draft = sd.TinyCausalLM(vocab=128, d_model=64, n_layers=1,
+                            n_heads=4, max_seq=96)
+low = run(True, low_draft, low_draft.init_params(99), label="low")
+on = run(True, draft, dp, label="on")    # high-agreement speculation
+
+oracle = [list(sd.eager_generate(target, tp, p, max_new_tokens=NEW))
+          for p in prompts]
+token_exact = (base["outs"] == oracle and on["outs"] == oracle
+               and low["outs"] == oracle)
+acceptance = on["accepted"] / max(on["proposed"], 1)
+speedup = on["tokens_s"] / max(base["tokens_s"], 1e-9)
+low_ratio = low["tokens_s"] / max(base["tokens_s"], 1e-9)
+
+if ENFORCE:
+    # the ISSUE-19 acceptance bar, enforced where it is measured
+    if not token_exact:
+        raise RuntimeError("speculative/baseline outputs diverge from "
+                           "the eager oracle under greedy")
+    if acceptance < 0.7:
+        raise RuntimeError(f"acceptance {acceptance:.2f} < 0.7 on the "
+                           "high-agreement draft")
+    if speedup < 1.5:
+        raise RuntimeError(f"speculative speedup {speedup:.2f}x < 1.5x "
+                           f"({on['tokens_s']:.0f} vs "
+                           f"{base['tokens_s']:.0f} tok/s)")
+    if not low["disabled"]:
+        raise RuntimeError("low-agreement draft never auto-disabled")
+    if low_ratio < 0.95:
+        raise RuntimeError(f"low-agreement leg ran at {low_ratio:.2f}x "
+                           "baseline (must stay within 5%: disable "
+                           "means degrade, never regress)")
+
+lane = {
+    "metric": "decode_speculative_tokens_per_s",
+    "value": round(on["tokens_s"], 1),
+    "platform": jax.default_backend(),
+    "requests": REQS, "new_tokens": NEW, "spec_k": K,
+    "baseline_tokens_s": round(base["tokens_s"], 1),
+    "spec_tokens_s": round(on["tokens_s"], 1),
+    "speedup": round(speedup, 2),
+    "acceptance": round(acceptance, 4),
+    "rounds": on["rounds"], "proposed": on["proposed"],
+    "accepted": on["accepted"], "fallback_rounds": on["fallbacks"],
+    "tokens_per_round": round(on["tokens"] / max(on["rounds"], 1), 2),
+    "target_dispatches_per_token": round(
+        (on["decode_steps"] + on["rounds"]) / max(on["tokens"], 1), 3),
+    "low_agreement": {
+        "tokens_s": round(low["tokens_s"], 1),
+        "ratio_vs_baseline": round(low_ratio, 3),
+        "autodisabled": low["disabled"],
+        "rounds_before_disable": low["rounds"],
+    },
+    "token_exact": token_exact,
+}
+telemetry.flush()   # flight-recorder shard for the lane's fleet merge
+lane["telemetry"] = {k: v for k, v in telemetry.snapshot().items() if v}
+print(json.dumps(lane))
+"""
+
+
+def run_speculative(requests: int = 12, new_tokens: int = 24,
+                    k: int = 4, enforce: bool = True) -> dict:
+    env = dict(os.environ)
+    env["SPEC_REQUESTS"] = str(requests)
+    env["SPEC_NEW_TOKENS"] = str(new_tokens)
+    env["SPEC_K"] = str(k)
+    env["SPEC_ENFORCE"] = "1" if enforce else "0"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+    r = subprocess.run([sys.executable, "-u", "-c", _SPEC_WORKER],
+                       capture_output=True, text=True, timeout=900,
+                       env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))) or ".")
+    if r.returncode != 0:
+        raise RuntimeError(f"speculative lane failed:\n{r.stderr[-2000:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def run_shared_prefix(users: int = 16) -> dict:
     env = dict(os.environ)
     env["PREFIX_USERS"] = str(users)
@@ -703,6 +866,29 @@ def main_decode(storm_only: bool = False) -> None:
               f"{len(e['replica_timeline'])} timeline samples")
 
 
+def main_spec() -> None:
+    lane = run_speculative()
+    if "--json" in sys.argv:
+        print(json.dumps({"speculative": lane}))
+        return
+    print(f"speculative decode ({lane['platform']}, {lane['requests']} "
+          f"requests x {lane['new_tokens']} tokens, k={lane['spec_k']})")
+    print(f"baseline {lane['baseline_tokens_s']} tok/s -> speculative "
+          f"{lane['spec_tokens_s']} tok/s ({lane['speedup']}x), "
+          f"acceptance {lane['acceptance']:.3f} "
+          f"({lane['accepted']}/{lane['proposed']} over "
+          f"{lane['rounds']} rounds, "
+          f"{lane['tokens_per_round']} tokens/round, "
+          f"{lane['target_dispatches_per_token']} target "
+          "dispatches/token)")
+    lo = lane["low_agreement"]
+    print(f"low-agreement draft: auto-disabled after "
+          f"{lo['rounds_before_disable']} rounds, "
+          f"{lo['tokens_s']} tok/s "
+          f"({lo['ratio_vs_baseline']:.2f}x baseline); token-exact vs "
+          f"eager oracle: {lane['token_exact']}")
+
+
 def main_prefix() -> None:
     lane = run_shared_prefix()
     if "--json" in sys.argv:
@@ -738,6 +924,11 @@ if __name__ == "__main__":
         # ISSUE-16 lane: M users x one system prompt through the
         # content-addressed prefix cache, warm vs cold vs eager oracle
         main_prefix()
+    elif "--speculative" in sys.argv:
+        # ISSUE-19 lane: spec on (high-agreement draft) vs the non-spec
+        # baseline on the same prompt set, plus the low-agreement
+        # auto-disable leg — acceptance bars enforced in the worker
+        main_spec()
     elif "--storm" in sys.argv:
         main_decode(storm_only=True)
     else:

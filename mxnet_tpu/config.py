@@ -505,6 +505,27 @@ declare("MXNET_PREFIX_CACHE", bool, True,
         "even eviction cannot help.  Off (0) = the pre-cache pool, "
         "byte-for-byte: no hashing, no index, prefix.* counters stay "
         "0.", subsystem="serving", cached=False)
+declare("MXNET_SPEC_DECODE", bool, False,
+        "Speculative decoding (serving_decode.GenerativeEngine): when "
+        "on AND the engine was built with a draft model, each decode "
+        "round has the cheap draft propose MXNET_SPEC_K tokens and the "
+        "target score all k+1 positions in ONE bucketed verify "
+        "dispatch (standard rejection sampling — the output "
+        "distribution is provably the target's; exact token match "
+        "under greedy).  Whether speculation PAYS is arbitrated per "
+        "round from the cost table's measured draft/verify/decode "
+        "EMAs, and persistently low measured acceptance auto-disables "
+        "it (spec.autodisabled).  Off (0) = the plain decode loop, "
+        "byte-for-byte: no draft programs, spec.* counters stay 0.",
+        subsystem="serving", cached=False)
+declare("MXNET_SPEC_K", str, "4",
+        "Speculative decoding draft depth: tokens proposed per round "
+        "(the verify program scores k+1 positions in one dispatch).  "
+        "'auto' picks k per round from the cost table — measured "
+        "acceptance EMA + draft/verify EMAs — over the pow2 candidate "
+        "grid up to the compiled maximum.",
+        validator=lambda v: v == "auto" or (v.isdigit() and int(v) >= 1),
+        subsystem="serving", cached=False)
 declare("MXNET_ROUTER_PREFIX_AFFINITY", float, 1.0,
         "ReplicaRouter prefix-affinity weight: each leading page-block "
         "of a request's prompt hash chain already resident in a "

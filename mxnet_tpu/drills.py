@@ -122,7 +122,8 @@ SCENARIOS = ("sigterm_drain", "sigkill_between_saves", "topology_change",
 # kept OUT of SCENARIOS so the recovery gate's matrix is unchanged
 ROUTER_SCENARIOS = ("router_kill", "router_wedge", "router_flap",
                     "router_deadline_storm", "router_prefix_storm",
-                    "router_scale_storm", "router_host_loss")
+                    "router_scale_storm", "router_host_loss",
+                    "spec_draft_poison")
 
 # the scripted workload every train drill shares
 N_STEPS = 24
@@ -551,15 +552,27 @@ def _cmd_router(a) -> int:
     from mxnet_tpu import engine, faults, preemption, telemetry
     from mxnet_tpu.faults import ShedError
     from mxnet_tpu.serving_decode import (GenerativeEngine, PagePool,
-                                          TinyCausalLM, eager_generate)
+                                          TinyCausalLM, eager_generate,
+                                          high_agreement_pair)
     from mxnet_tpu.serving_router import ReplicaRouter
 
-    model = TinyCausalLM(vocab=50, d_model=16, n_layers=1, n_heads=2,
-                         max_seq=96)
-    params = model.init_params(0)
+    spec_kw: Dict[str, Any] = {}
+    if a.mode == "spec_draft_poison":
+        # ISSUE 19: the speculative cell runs a HIGH-agreement pair so
+        # the steady phase demonstrably engages speculation before the
+        # draft is poisoned (the knob is uncached; child-local flip)
+        os.environ["MXNET_SPEC_DECODE"] = "1"
+        model, params, draft, dparams = high_agreement_pair(
+            vocab=50, d_model=16, target_layers=2, draft_layers=1,
+            n_heads=2, max_seq=96)
+        spec_kw = dict(draft=draft, draft_params=dparams, spec_k=4)
+    else:
+        model = TinyCausalLM(vocab=50, d_model=16, n_layers=1,
+                             n_heads=2, max_seq=96)
+        params = model.init_params(0)
     pools = [PagePool(pages=64, page=8), PagePool(pages=64, page=8)]
     engines = [GenerativeEngine(model, params=params, pool=pools[i],
-                                max_rows=2, name=f"rep{i}")
+                                max_rows=2, name=f"rep{i}", **spec_kw)
                for i in range(2)]
     for e in engines:
         e.warmup(max_len=8)
@@ -636,6 +649,15 @@ def _cmd_router(a) -> int:
                         f"flap {flap_calls['n']}")
                 return orig_gen(*args, **kw)
             engines[0].generate = flaky
+        elif a.mode == "spec_draft_poison":
+            # wedge BOTH replicas' draft-round programs: every next
+            # spec round raises, the engines must auto-disable via the
+            # cost-table path and degrade to plain decode in-place —
+            # no failover, no drop, token streams unchanged
+            def poisoned(*args, **kw):
+                raise RuntimeError("draft model poisoned mid-round")
+            for e in engines:
+                e._spec_programs.insert(("draft_round", 4), poisoned)
 
     # -- phase B: chaos under concurrent load ---------------------------
     base = a.steady
@@ -668,7 +690,7 @@ def _cmd_router(a) -> int:
                 break
             time.sleep(0.001)
         apply_chaos()
-    elif a.mode in ("wedge", "flap"):
+    elif a.mode in ("wedge", "flap", "spec_draft_poison"):
         apply_chaos()
     for t in threads:
         t.join(timeout=180.0)
@@ -759,6 +781,11 @@ def _cmd_router(a) -> int:
         "prefix_hit_rate": hit_blocks / max(hit_blocks + miss_blocks, 1),
         "router": {k: v for k, v in st.items() if k != "replicas"},
         "breakers": [r["breaker"] for r in st["replicas"]],
+        "spec": [{k: e.stats()[k]
+                  for k in ("spec_rounds", "spec_proposed",
+                            "spec_accepted", "spec_fallbacks",
+                            "spec_disabled")}
+                 for e in engines] if spec_kw else None,
         "drain_s": telemetry.snapshot().get("preemption.drain_s"),
         "telemetry": telemetry.snapshot(),
     }
@@ -1298,7 +1325,8 @@ def run_drill(name: str, root: str, verbose: bool = False
     t0 = time.monotonic()
     if name in ROUTER_SCENARIOS:
         _drill_router(root, failures, report,
-                      mode=name[len("router_"):])
+                      mode=(name[len("router_"):]
+                            if name.startswith("router_") else name))
     elif name == "decode_drain":
         _drill_decode(root, failures, report)
     else:
@@ -2016,6 +2044,29 @@ def _drill_router(root: str, failures: List[str],
             failures.append(
                 "router[host_loss] never opened the dead host's "
                 "breaker")
+    elif mode == "spec_draft_poison":
+        # ISSUE 19: a poisoned draft must cost ZERO availability — the
+        # engines auto-disable speculation via the cost-table path and
+        # degrade to plain decode in-place; the shared contract above
+        # (0 dropped, token-exact, clean audit) already holds, so the
+        # cell-specific checks are about the disable machinery itself
+        spec = res.get("spec") or []
+        report["spec"] = spec
+        report["spec_autodisabled"] = int(
+            (res.get("telemetry") or {}).get("spec.autodisabled", 0))
+        if not any(s.get("spec_rounds") for s in spec):
+            failures.append(
+                "router[spec_draft_poison] steady phase never engaged "
+                "speculation (0 spec rounds before the poison — the "
+                "cell exercised nothing)")
+        if not all(s.get("spec_disabled") for s in spec):
+            failures.append(
+                "router[spec_draft_poison] a poisoned replica did not "
+                f"auto-disable speculation: {spec}")
+        if report["spec_autodisabled"] < 1:
+            failures.append(
+                "router[spec_draft_poison] no spec.autodisabled event "
+                "was counted despite the poisoned draft")
     elif mode == "deadline_storm":
         for r, v in sorted(records.items()):
             b = v.get("budget_s")
@@ -2088,7 +2139,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ro.add_argument("--label", default="c1")
     ro.add_argument("--mode", default="kill",
                     choices=("kill", "wedge", "flap", "deadline_storm",
-                             "prefix_storm", "scale_storm", "host_loss"))
+                             "prefix_storm", "scale_storm", "host_loss",
+                             "spec_draft_poison"))
     ro.add_argument("--steady", type=int, default=12)
     ro.add_argument("--requests", type=int, default=8)
     ro.add_argument("--max-new", type=int, default=10, dest="max_new")

@@ -322,6 +322,12 @@ _declare("eager_jit", 512)
 # needs to cover that grid, and per-owner caps keep co-hosted models
 # from evicting each other's decode program
 _declare("serving_decode", 32, cap_env="MXNET_FORWARD_CACHE")
+# speculative decoding (serving_decode, MXNET_SPEC_DECODE): draft
+# prefill buckets + one draft round program + one verify program per
+# MXNET_SPEC_K shape — a small fixed grid, kept apart from
+# serving_decode so the spec lane's program census is auditable on its
+# own (check_dispatch_budget's `spec` lane)
+_declare("serving_spec", 32, cap_env="MXNET_FORWARD_CACHE")
 
 
 def namespace(name: str) -> Namespace:

@@ -94,9 +94,17 @@ from .serving import BucketPolicy
 __all__ = ["PagePool", "PagePoolExhausted", "ShedError", "DecodeModel",
            "TinyCausalLM", "GenerativeEngine", "shared_pool",
            "eager_generate", "trace_count", "dispatch_count",
-           "reset_counters"]
+           "reset_counters", "SamplingSpec", "sample_token",
+           "spec_trace_count", "spec_dispatch_count",
+           "high_agreement_pair"]
 
 _NS = _pstore.namespace("serving_decode")
+# speculative-decoding programs (draft prefill / draft round / verify)
+# live in their OWN namespace so the dispatch-budget spec lane can pin
+# "programs == draft buckets + verify shapes + 1" and "0 spec
+# dispatches with MXNET_SPEC_DECODE=0" independently of the plain
+# decode budget
+_SPEC_NS = _pstore.namespace("serving_spec")
 
 
 def trace_count() -> int:
@@ -107,8 +115,17 @@ def dispatch_count() -> int:
     return _NS.dispatches
 
 
+def spec_trace_count() -> int:
+    return _SPEC_NS.traces
+
+
+def spec_dispatch_count() -> int:
+    return _SPEC_NS.dispatches
+
+
 def reset_counters() -> None:
     _NS.reset()
+    _SPEC_NS.reset()
 
 
 class PagePoolExhausted(ShedError):
@@ -161,6 +178,39 @@ _PREFIX_STATS = _telemetry.CounterGroup(
     "prefix", ("hit_blocks", "miss_blocks", "cow_forks", "evictions"),
     doc="content-addressed KV prefix cache (MXNET_PREFIX_CACHE)",
     family="prefix")
+
+# speculative-decoding counters (family 'spec'): like prefix sharing,
+# acceptance is a property of the model PAIR and the workload, so the
+# family is process-global (not instance-numbered) — telemetry.merge
+# sums it across the fleet and check_perf_delta diffs exact names.
+# rounds = spec rounds completed (1 draft + 1 verify dispatch each);
+# proposed/accepted = draft tokens offered / surviving rejection
+# sampling; fallback_rounds = rounds the arbiter declined (cost table
+# said plain decode is cheaper, or shapes/pages did not fit);
+# autodisabled = sticky low-acceptance cutoffs (the poisoned-draft
+# degrade path)
+_SPEC_STATS = _telemetry.CounterGroup(
+    "spec", ("rounds", "proposed", "accepted", "fallback_rounds",
+             "autodisabled"),
+    doc="speculative decoding (MXNET_SPEC_DECODE)", family="spec")
+
+# measured acceptance and amortization ride as computed gauges over the
+# same counters the perf gate diffs: acceptance_rate = accepted /
+# proposed; tokens_per_target_dispatch = (accepted + rounds) / rounds
+# (each round costs ONE target-equivalent verify dispatch and yields
+# n_acc + 1 tokens) — the k-for-1 number the tentpole is judged on
+_telemetry.gauge_fn(
+    "spec.acceptance_rate",
+    lambda: (_SPEC_STATS["accepted"] / _SPEC_STATS["proposed"]
+             if _SPEC_STATS["proposed"] else 0.0),
+    doc="speculative decoding: fraction of drafted tokens accepted",
+    family="spec")
+_telemetry.gauge_fn(
+    "spec.tokens_per_target_dispatch",
+    lambda: ((_SPEC_STATS["accepted"] + _SPEC_STATS["rounds"])
+             / _SPEC_STATS["rounds"] if _SPEC_STATS["rounds"] else 0.0),
+    doc="speculative decoding: tokens committed per verify dispatch",
+    family="spec")
 
 
 def _chain_keys(tokens: Sequence[int], page: int,
@@ -599,6 +649,21 @@ class DecodeModel:
     #: again — that is what makes a cache hit token-exact).
     prefill_chunk = None
 
+    #: OPTIONAL fourth entry point enabling speculative decoding
+    #: (``MXNET_SPEC_DECODE``) — the batched multi-token scorer the
+    #: verify program is built on.  Signature ``decode_chunk(params,
+    #: tokens, k_ctx, v_ctx, lengths) -> (logits, k_new, v_new)``:
+    #: ``tokens`` ``(R, S)`` int32, row ``r``'s chunk sitting at global
+    #: positions ``lengths[r] .. lengths[r]+S-1``; ``k_ctx``/``v_ctx``
+    #: ``(L, R, C, H, D)`` paged context where position ``j`` is valid
+    #: iff ``j < lengths[r]``; in-chunk attention is causal.  Returns
+    #: ``logits`` ``(R, S, vocab)`` (``logits[r, i]`` scores the token
+    #: AFTER chunk position ``i``) and the chunk cache ``k_new``/
+    #: ``v_new`` ``(L, R, S, H, D)``.  Exactness contract: position for
+    #: position identical to ``S`` successive ``decode`` calls — that
+    #: is what makes greedy speculative decode token-exact.
+    decode_chunk = None
+
 
 class TinyCausalLM(DecodeModel):
     """Reference :class:`DecodeModel`: a small pre-LN-free causal
@@ -737,20 +802,230 @@ class TinyCausalLM(DecodeModel):
         logits = h[length - offset - 1] @ params["out"]      # (vocab,)
         return logits, jnp.stack(ks), jnp.stack(vs)          # (L,B,H,D)
 
+    def decode_chunk(self, params, tokens, k_ctx, v_ctx, lengths):
+        r, s = tokens.shape
+        c = k_ctx.shape[2]
+        pos = lengths[:, None] + jnp.arange(s)[None, :]      # (R, S)
+        h = params["emb"][tokens] \
+            + params["pos"][jnp.minimum(pos, self.max_seq - 1)]
+        # cached context: chunk tokens attend positions < lengths
+        ctx_valid = jnp.broadcast_to(
+            jnp.arange(c)[None, None, :] < lengths[:, None, None],
+            (r, s, c))
+        # in-chunk: plain causal (every chunk position is a real token
+        # — the engine masks rejected tails at the KV SCATTER, not here)
+        ii = jnp.arange(s)
+        chunk_valid = jnp.broadcast_to(
+            (ii[None, :] <= ii[:, None])[None], (r, s, s))
+        valid = jnp.concatenate([ctx_valid, chunk_valid], axis=2)
+        k_news, v_news = [], []
+        for li, lp in enumerate(params["layers"]):
+            q = self._heads(h @ lp["wq"])                    # (R,S,H,D)
+            k_new = self._heads(h @ lp["wk"])
+            v_new = self._heads(h @ lp["wv"])
+            k_news.append(k_new)
+            v_news.append(v_new)
+            k = jnp.concatenate([k_ctx[li], k_new], axis=1)  # (R,C+S,..)
+            v = jnp.concatenate([v_ctx[li], v_new], axis=1)
+            scores = jnp.einsum("rshd,rjhd->rshj", q, k) \
+                / math.sqrt(self.head_dim)
+            scores = jnp.where(valid[:, :, None, :], scores, -1e30)
+            w = jax.nn.softmax(scores, axis=-1)
+            att = jnp.einsum("rshj,rjhd->rshd", w, v)        # (R,S,H,D)
+            h = h + att.reshape(r, s, self.d_model) @ lp["wo"]
+            h = h + jax.nn.relu(h @ lp["w1"]) @ lp["w2"]
+        logits = h @ params["out"]                           # (R,S,V)
+        return logits, jnp.stack(k_news), jnp.stack(v_news)  # (L,R,S,..)
+
+
+def high_agreement_pair(vocab: int = 64, d_model: int = 32,
+                        target_layers: int = 4, draft_layers: int = 1,
+                        n_heads: int = 2, max_seq: int = 128,
+                        seed: int = 0):
+    """A (target, target_params, draft, draft_params) fixture whose
+    draft AGREES with the target exactly: both share embeddings, the
+    position table, the output head, and the leading ``draft_layers``
+    transformer layers, and the target's extra layers have ``wo = 0``
+    and ``w2 = 0`` — each reduces to the identity (``h + att@0`` then
+    ``h + relu(h@w1)@0``), so target logits == draft logits while the
+    target still pays ``target_layers / draft_layers`` x the compute.
+    Acceptance is 1.0 by construction — the fixture behind the
+    dispatch-budget spec lane, the ``--speculative`` bench, and the
+    speedup gate's high-agreement leg."""
+    draft = TinyCausalLM(vocab, d_model, draft_layers, n_heads,
+                         max_seq=max_seq)
+    target = TinyCausalLM(vocab, d_model, target_layers, n_heads,
+                          max_seq=max_seq)
+    dp = draft.init_params(seed)
+    tp = target.init_params(seed + 1)
+    tp["emb"], tp["pos"], tp["out"] = dp["emb"], dp["pos"], dp["out"]
+    for i in range(draft_layers):
+        tp["layers"][i] = dp["layers"][i]
+    for i in range(draft_layers, target_layers):
+        tp["layers"][i]["wo"] = jnp.zeros_like(tp["layers"][i]["wo"])
+        tp["layers"][i]["w2"] = jnp.zeros_like(tp["layers"][i]["w2"])
+    return target, tp, draft, dp
+
+
+# ---------------------------------------------------------------------------
+# In-program stochastic sampling (temperature / top-k / top-p)
+# ---------------------------------------------------------------------------
+class SamplingSpec:
+    """Per-request stochastic decoding spec.  ``temperature == 0`` IS
+    greedy — the compiled sampler's 0-branch is bit-identical to the
+    plain argmax, so a greedy request through a sampling-capable
+    program decodes exactly as before.  ``top_k <= 0`` / ``top_p >= 1``
+    disable their filters.  ``seed`` keys a counter-based PRNG: the
+    token at absolute sequence position ``i`` always draws from
+    ``fold_in(PRNGKey(seed), i)``, so a preemption re-prefill, a
+    router failover, or a hedged duplicate replays the SAME tokens —
+    determinism is positional, not iteration-order-dependent."""
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0):
+        # graftlint: disable=host-sync -- construction-time coercion of
+        # the caller's HOST python scalars, no device value in sight
+        t = float(temperature)
+        if not (0.0 <= t < float("inf")):
+            raise ValueError(f"temperature must be finite >= 0, got {t}")
+        # graftlint: disable=host-sync -- same host-scalar coercion
+        p = float(top_p)
+        if not (0.0 < p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {p}")
+        self.temperature = t
+        self.top_k = int(top_k)
+        self.top_p = p
+        # PRNGKey folds the seed into uint32 space; coerce here so the
+        # eager oracle, the compiled program, and the wire round-trip
+        # all key from the identical value
+        self.seed = int(seed) & 0x7FFFFFFF
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+    def to_wire(self) -> Dict[str, Any]:
+        """JSON-safe dict for serving_remote's frame protocol."""
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed}
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]) -> "SamplingSpec":
+        return cls(temperature=d.get("temperature", 0.0),
+                   top_k=d.get("top_k", 0), top_p=d.get("top_p", 1.0),
+                   seed=d.get("seed", 0))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, SamplingSpec)
+                and self.to_wire() == other.to_wire())
+
+    def __repr__(self) -> str:
+        return (f"SamplingSpec(temperature={self.temperature}, "
+                f"top_k={self.top_k}, top_p={self.top_p}, "
+                f"seed={self.seed})")
+
+
+#: the no-arg spec every greedy request decodes under: all-zero traced
+#: sampling operands, so greedy rows through the sampling-capable
+#: programs hit the temperature-0 (bit-exact argmax) branch
+GREEDY = SamplingSpec()
+
+
+def token_key(seed, position):
+    """Counter-based PRNG key for the token at absolute sequence
+    ``position``: ``fold_in(PRNGKey(seed), position)``.  Pure function
+    of (seed, position) — the whole replay-determinism story."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), position)
+
+
+def _keep_mask(scaled, top_k, top_p):
+    """Boolean keep-mask of the top-k AND nucleus (top-p) filters over
+    temperature-scaled logits ``scaled`` (V,).  ``top_k <= 0`` /
+    ``top_p >= 1`` pass everything; the rank-0 token is always kept."""
+    v = scaled.shape[-1]
+    order = jnp.argsort(-scaled)
+    ranks = jnp.zeros((v,), jnp.int32).at[order].set(
+        jnp.arange(v, dtype=jnp.int32))
+    k_eff = jnp.where(top_k <= 0, jnp.int32(v),
+                      jnp.asarray(top_k, jnp.int32))
+    keep_k = ranks < k_eff
+    # nucleus: smallest prefix of the sorted distribution whose mass
+    # reaches top_p — exclusive cumsum < p keeps the boundary token
+    sprobs = jax.nn.softmax(scaled[order])
+    excl = jnp.cumsum(sprobs) - sprobs
+    keep_p = (excl < top_p)[ranks]
+    return keep_k & keep_p
+
+
+def sample_token(logits, temperature, top_k, top_p, key):
+    """Sample ONE token id from ``logits`` (V,) under temperature /
+    top-k / top-p, via Gumbel-argmax on the masked scaled logits.
+    ``temperature == 0`` returns the plain argmax BIT-IDENTICALLY (the
+    sampled lane still traces, but the 0-branch selects the untouched
+    argmax).  Traceable — this exact function runs inside the compiled
+    decode/prefill programs AND in the eager oracle, which is what
+    makes compiled-vs-eager parity seed-for-seed."""
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    keep = _keep_mask(scaled, top_k, top_p)
+    masked = jnp.where(keep, scaled, -jnp.inf)
+    sampled = jnp.argmax(
+        masked + jax.random.gumbel(key, logits.shape)).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def _sample_dist(logits, temperature, top_k, top_p):
+    """The full masked/normalized sampling distribution (V,) the
+    request decodes under — one-hot argmax at ``temperature == 0``.
+    This is the ``p``/``q`` both sides of speculative rejection
+    sampling score, so acceptance is measured against EXACTLY the
+    distribution :func:`sample_token` draws from."""
+    v = logits.shape[-1]
+    one_hot = jax.nn.one_hot(jnp.argmax(logits), v, dtype=logits.dtype)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    keep = _keep_mask(scaled, top_k, top_p)
+    probs = jax.nn.softmax(jnp.where(keep, scaled, -jnp.inf))
+    return jnp.where(temperature > 0.0, probs, one_hot)
+
+
+def _sampling_args(sampling: Optional[SamplingSpec]):
+    """The four host-side scalar operands a sampling spec rides the
+    program signature as (traced, so heterogeneous configs share one
+    program)."""
+    s = sampling or GREEDY
+    return (onp.float32(s.temperature), onp.int32(s.top_k),
+            onp.float32(s.top_p), onp.int32(s.seed))
+
 
 def eager_generate(model: DecodeModel, params, prompt: Sequence[int],
-                   max_new_tokens: int, eos: Optional[int] = None
+                   max_new_tokens: int, eos: Optional[int] = None,
+                   sampling: Optional[SamplingSpec] = None
                    ) -> List[int]:
     """The one-request-at-a-time reference loop: a FULL forward over
     the tokens so far for every generated token (no KV cache, no
     batching, exact shapes) — the parity oracle for the continuous
-    batcher and the bench A/B baseline."""
+    batcher and the bench A/B baseline.  ``sampling`` runs the SAME
+    :func:`sample_token` the compiled programs trace, keyed by
+    ``fold_in(PRNGKey(seed), position)`` — the seed-for-seed oracle
+    for stochastic decode (``None`` / temperature 0 = greedy, the
+    plain argmax, exactly as before)."""
     toks = [int(t) for t in prompt]
     out: List[int] = []
+    temp, top_k, top_p, seed = _sampling_args(sampling)
     for _ in range(max_new_tokens):
         logits, _k, _v = model.prefill(
             params, jnp.asarray(toks, jnp.int32), len(toks))
-        nxt = int(jnp.argmax(logits))
+        if sampling is None or sampling.greedy:
+            nxt = int(jnp.argmax(logits))
+        else:
+            # the token being generated sits at absolute position
+            # len(toks) — the same counter the engine's prefill
+            # (position = prompt length) and decode (position =
+            # cached + 1) programs fold in
+            nxt = int(sample_token(logits, temp, top_k, top_p,
+                                   token_key(seed, len(toks))))
         out.append(nxt)
         toks.append(nxt)
         if eos is not None and nxt == eos:
@@ -763,13 +1038,20 @@ def eager_generate(model: DecodeModel, params, prompt: Sequence[int],
 # ---------------------------------------------------------------------------
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "eos", "out", "event", "error",
-                 "t_enqueue", "t_done", "preempts", "joined", "trace_id")
+                 "t_enqueue", "t_done", "preempts", "joined", "trace_id",
+                 "sampling")
 
     def __init__(self, prompt: List[int], max_new: int,
-                 eos: Optional[int]):
+                 eos: Optional[int],
+                 sampling: Optional[SamplingSpec] = None):
         self.prompt = prompt
         self.max_new = max_new
         self.eos = eos
+        # per-request sampling spec (None = greedy).  Carried on the
+        # request like t_enqueue: a preemption re-queue or a router
+        # failover replays the SAME seed, and the position-keyed PRNG
+        # makes the regenerated tokens identical
+        self.sampling = sampling
         self.out: List[int] = []        # survives preemption
         self.event = threading.Event()
         self.error: Optional[BaseException] = None
@@ -793,7 +1075,8 @@ class _GenRequest:
 
 
 class _Row:
-    __slots__ = ("req", "pages", "cached", "pending", "joined")
+    __slots__ = ("req", "pages", "cached", "pending", "joined",
+                 "draft_pages", "draft_cached")
 
     def __init__(self, req: _GenRequest, pages: List[int], cached: int,
                  pending: int, joined: int):
@@ -803,6 +1086,14 @@ class _Row:
         self.pending = pending    # next token to feed the decode step
         self.joined = joined      # admission order, for youngest-first
                                   # preemption
+        # speculative-decoding draft state: the draft model's OWN page
+        # table in the shared pool (separate geometry, never published
+        # to the prefix cache) and how many leading tokens hold VALID
+        # draft KV.  A rejected speculation just rewinds draft_cached —
+        # stale KV past it is masked out of every later attention, so
+        # there is no rollback pass
+        self.draft_pages: List[int] = []
+        self.draft_cached = 0
 
 
 class GenerativeEngine:
@@ -823,7 +1114,10 @@ class GenerativeEngine:
                  max_queue: Optional[int] = None,
                  slo_us: Optional[int] = None,
                  policy: Optional[BucketPolicy] = None,
-                 eos: Optional[int] = None):
+                 eos: Optional[int] = None,
+                 draft: Optional[DecodeModel] = None,
+                 draft_params=None,
+                 spec_k: Optional[Any] = None):
         self._model = model
         self._params = (params if params is not None
                         else model.init_params())
@@ -844,6 +1138,40 @@ class GenerativeEngine:
             model.n_layers, model.n_heads, model.head_dim)
         self._max_pages = -(-int(model.max_seq) // self._pool.page)
         self._programs = _pstore.scope("serving_decode")
+        # -- speculative decoding (MXNET_SPEC_DECODE, ISSUE 19) --------
+        # a co-hosted DRAFT model proposes k tokens per round and the
+        # target scores all k+1 in ONE verify dispatch.  Draft KV pages
+        # in the SAME pool (its own geometry; page ids stay distinct
+        # because accounting is global) and is never published to the
+        # prefix cache.  Requires the target to implement decode_chunk.
+        self._draft = draft
+        self._draft_params = None
+        if draft is not None:
+            if model.decode_chunk is None:
+                raise ValueError(
+                    "speculative decoding needs the TARGET model to "
+                    "implement decode_chunk (the k+1-position verify "
+                    "scorer)")
+            if int(draft.vocab) != int(model.vocab):
+                raise ValueError(
+                    f"draft vocab {draft.vocab} != target vocab "
+                    f"{model.vocab}: rejection sampling needs one "
+                    "token space")
+            self._draft_params = (draft_params if draft_params
+                                  is not None else draft.init_params())
+            self._draft_geom = self._pool.register(
+                draft.n_layers, draft.n_heads, draft.head_dim)
+            self._draft_max_pages = -(-int(draft.max_seq)
+                                      // self._pool.page)
+        self._spec_programs = _pstore.scope("serving_spec")
+        # ctor override wins over MXNET_SPEC_K (both accept 'auto')
+        self._spec_k_setting = (str(spec_k) if spec_k is not None
+                                else None)
+        # sticky low-acceptance cutoff (the poisoned-draft degrade
+        # path) + the acceptance-rate EMA that trips it
+        self._spec_disabled = False
+        self._spec_acc_ema: Optional[float] = None
+        self._spec_rounds_done = 0
         # the cost table (admission prices a request from these EMAs —
         # never from a trial dispatch): measured seconds per prefill
         # bucket and per decode step
@@ -865,7 +1193,8 @@ class GenerativeEngine:
              "decode_steps", "decode_row_util", "shed", "shed_queue",
              "shed_pool", "shed_slo", "shed_draining", "shed_deadline",
              "preempts", "slo_violations", "warmup_programs",
-             "bucket_fallbacks"),
+             "bucket_fallbacks", "spec_rounds", "spec_proposed",
+             "spec_accepted", "spec_fallbacks"),
             doc=f"GenerativeEngine counters (model {self.name!r})",
             family="decode.engine")
         # the load() fields double as registered computed gauges
@@ -878,21 +1207,31 @@ class GenerativeEngine:
 
     # -- public ------------------------------------------------------------
     def generate(self, prompt, max_new_tokens: int = 32,
-                 eos: Optional[int] = None) -> List[int]:
-        """Greedily generate up to ``max_new_tokens`` token ids after
-        ``prompt`` (a 1-D int sequence/array); blocks until delivered.
-        Raises :class:`faults.ShedError` IMMEDIATELY when admission
-        refuses (queue/pool/SLO) — overload is loud, never a hang.
+                 eos: Optional[int] = None,
+                 sampling: Optional[SamplingSpec] = None) -> List[int]:
+        """Generate up to ``max_new_tokens`` token ids after ``prompt``
+        (a 1-D int sequence/array); blocks until delivered.  ``sampling``
+        (a :class:`SamplingSpec`) turns on temperature / top-k / top-p
+        stochastic decode INSIDE the same compiled programs — the spec
+        rides as traced per-row operands, so heterogeneous sampling
+        configs share one program and join/retire never retraces;
+        ``None`` (or temperature 0) is greedy, bit-identical to the
+        pre-sampling argmax.  Raises :class:`faults.ShedError`
+        IMMEDIATELY when admission refuses (queue/pool/SLO) — overload
+        is loud, never a hang.
 
         Admission mints (or inherits, when routed) the ISSUE-15 request
         trace: admission/shed/preempt events, the prefill span, every
         decode iteration the request rides, and the lifecycle span all
         stamp one trace_id — kept across a preemption re-queue."""
         with _telemetry.trace_scope():
-            return self._generate_traced(prompt, max_new_tokens, eos)
+            return self._generate_traced(prompt, max_new_tokens, eos,
+                                         sampling)
 
     def _generate_traced(self, prompt, max_new_tokens: int,
-                         eos: Optional[int]) -> List[int]:
+                         eos: Optional[int],
+                         sampling: Optional[SamplingSpec] = None
+                         ) -> List[int]:
         if self._closed:
             raise RuntimeError("GenerativeEngine is closed")
         # graftlint: disable=host-sync -- admission-time tokenization of
@@ -907,7 +1246,12 @@ class GenerativeEngine:
                 f"prompt({len(toks)}) + max_new({max_new_tokens}) "
                 f"exceeds model.max_seq={self._model.max_seq}")
         eos = eos if eos is not None else self._eos
-        req = _GenRequest(toks, int(max_new_tokens), eos)
+        if sampling is not None and not isinstance(sampling,
+                                                   SamplingSpec):
+            raise TypeError(
+                f"sampling must be a SamplingSpec, got {sampling!r}")
+        req = _GenRequest(toks, int(max_new_tokens), eos,
+                          sampling=sampling)
         req.trace_id = _telemetry.current_trace()
         self._stats.inc("requests")
         if req.trace_id is not None:
@@ -992,6 +1336,8 @@ class GenerativeEngine:
         out = dict(self._stats)
         out["model"] = self.name
         out["programs"] = len(self._programs)
+        out["spec_programs"] = len(self._spec_programs)
+        out["spec_disabled"] = self._spec_disabled
         out["queue_depth"] = len(self._queue)
         out["live_rows"] = len(self._live)
         out["rows"] = self._rows
@@ -1245,9 +1591,13 @@ class GenerativeEngine:
                 req.error = e
                 req.t_done = time.monotonic()
                 req.event.set()
-        # -- decode: one dispatch for the union of live sequences
+        # -- decode: one dispatch for the union of live sequences —
+        # or, when the cost table says speculation pays, one DRAFT
+        # dispatch + one VERIFY dispatch for up to k+1 tokens per row
         if self._live:
-            self._decode_step()
+            k = self._spec_should_engage()
+            if not (k and self._spec_round(k)):
+                self._decode_step()
             self._retire_finished()
 
     def _wait_for_pages(self, req: _GenRequest, budget: float = 5.0
@@ -1380,6 +1730,7 @@ class GenerativeEngine:
                 span_args.update(
                     hit_blocks=len(hits), blocks=blocks,
                     hit_rate=len(hits) / max(blocks, 1))
+            samp = _sampling_args(req.sampling)
             t0 = time.perf_counter()
             with _telemetry.span("decode.prefill", cat="decode",
                                  args=span_args):
@@ -1395,13 +1746,15 @@ class GenerativeEngine:
                                               jnp.asarray(tokens),
                                               jnp.int32(cached),
                                               jnp.int32(n),
-                                              jnp.asarray(table), k, v)
+                                              jnp.asarray(table),
+                                              *samp, k, v)
                         else:
                             rec = self._prefill_program(bucket)
                             first, k, v = rec(self._params,
                                               jnp.asarray(tokens),
                                               jnp.int32(n),
-                                              jnp.asarray(table), k, v)
+                                              jnp.asarray(table),
+                                              *samp, k, v)
                         first = int(first)    # host read = real cost
                         self._pool.set_storage(self._geom, k, v)
                 finally:
@@ -1440,7 +1793,8 @@ class GenerativeEngine:
         model, pool, page = self._model, self._pool, self._pool.page
         trash = pool.trash
 
-        def prefill_fn(params, tokens, length, table, k_pool, v_pool):
+        def prefill_fn(params, tokens, length, table, temp, top_k,
+                       top_p, seed, k_pool, v_pool):
             _pstore.count_trace("serving_decode")
             logits, k, v = model.prefill(params, tokens, length)
             pos = jnp.arange(bucket)
@@ -1450,7 +1804,12 @@ class GenerativeEngine:
             # k/v (L, B, H, D) -> per-position rows (B, L, H, D)
             k_pool = k_pool.at[pidx, slot].set(k.transpose(1, 0, 2, 3))
             v_pool = v_pool.at[pidx, slot].set(v.transpose(1, 0, 2, 3))
-            return jnp.argmax(logits).astype(jnp.int32), k_pool, v_pool
+            # the first generated token sits at absolute position
+            # ``length`` — its counter-based key.  temperature 0 is
+            # the bit-exact argmax branch (greedy unchanged)
+            nxt = sample_token(logits, temp, top_k, top_p,
+                               token_key(seed, length))
+            return nxt, k_pool, v_pool
 
         jitted = jax.jit(prefill_fn, donate_argnums=self._donate)
         args = self._prefill_specs(bucket)
@@ -1477,7 +1836,7 @@ class GenerativeEngine:
         max_pages = self._max_pages
 
         def prefill_chunk_fn(params, tokens, offset, length, table,
-                             k_pool, v_pool):
+                             temp, top_k, top_p, seed, k_pool, v_pool):
             _pstore.count_trace("serving_decode")
             # page-table gather: (P, page, L, H, D) -> (L, C, H, D)
             k_ctx = k_pool[table].reshape(
@@ -1498,7 +1857,9 @@ class GenerativeEngine:
             slot = pos % page
             k_pool = k_pool.at[pidx, slot].set(k.transpose(1, 0, 2, 3))
             v_pool = v_pool.at[pidx, slot].set(v.transpose(1, 0, 2, 3))
-            return jnp.argmax(logits).astype(jnp.int32), k_pool, v_pool
+            nxt = sample_token(logits, temp, top_k, top_p,
+                               token_key(seed, length))
+            return nxt, k_pool, v_pool
 
         jitted = jax.jit(prefill_chunk_fn,
                          donate_argnums=self._chunk_donate)
@@ -1527,10 +1888,16 @@ class GenerativeEngine:
         tables = onp.full((r, self._max_pages), self._pool.trash,
                           onp.int32)
         lengths = onp.zeros((r,), onp.int32)
+        temps = onp.zeros((r,), onp.float32)
+        top_ks = onp.zeros((r,), onp.int32)
+        top_ps = onp.ones((r,), onp.float32)
+        seeds = onp.zeros((r,), onp.int32)
         for i, row in enumerate(self._live):
             tokens[i] = row.pending
             tables[i, :len(row.pages)] = row.pages
             lengths[i] = row.cached
+            (temps[i], top_ks[i], top_ps[i],
+             seeds[i]) = _sampling_args(row.req.sampling)
         t0 = time.perf_counter()
         step_args: Dict[str, Any] = {"model": self.name,
                                      "rows": len(self._live)}
@@ -1549,7 +1916,11 @@ class GenerativeEngine:
                     k, v = self._pool.storage(self._geom)
                     nxt, k, v = rec(self._params, jnp.asarray(tokens),
                                     jnp.asarray(tables),
-                                    jnp.asarray(lengths), k, v)
+                                    jnp.asarray(lengths),
+                                    jnp.asarray(temps),
+                                    jnp.asarray(top_ks),
+                                    jnp.asarray(top_ps),
+                                    jnp.asarray(seeds), k, v)
                     # graftlint: disable=host-sync -- THE one deliberate
                     # host read per decode iteration (next-token ids feed
                     # the host scheduler); the dispatch-budget gate counts it
@@ -1644,7 +2015,8 @@ class GenerativeEngine:
     def _build_decode(self):
         model, page = self._model, self._pool.page
 
-        def decode_fn(params, tokens, tables, lengths, k_pool, v_pool):
+        def decode_fn(params, tokens, tables, lengths, temps, top_ks,
+                      top_ps, seeds, k_pool, v_pool):
             _pstore.count_trace("serving_decode")
             # page-table gather: (R, P) -> (R, P, page, L, H, D)
             k_ctx = k_pool[tables]
@@ -1668,8 +2040,15 @@ class GenerativeEngine:
                 k_new.transpose(1, 0, 2, 3))
             v_pool = v_pool.at[pidx, slot].set(
                 v_new.transpose(1, 0, 2, 3))
-            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                    k_pool, v_pool)
+            # per-row counter-based keys: the token being sampled lands
+            # at absolute position lengths+1 (pending occupies lengths).
+            # Sampling params ride as TRACED arrays — heterogeneous
+            # configs across rows never retrace
+            keys = jax.vmap(
+                lambda s, p: token_key(s, p))(seeds, lengths + 1)
+            nxt = jax.vmap(sample_token)(logits, temps, top_ks,
+                                         top_ps, keys)
+            return nxt.astype(jnp.int32), k_pool, v_pool
 
         jitted = jax.jit(decode_fn, donate_argnums=self._donate)
         rec = _pstore.build("serving_decode", jitted,
@@ -1678,19 +2057,585 @@ class GenerativeEngine:
         self._programs.insert(("decode",), rec)
         return rec
 
+    # -- speculative decoding (MXNET_SPEC_DECODE, ISSUE 19) ------------------
+    #: draft depth ceiling under MXNET_SPEC_K=auto: the draft-round
+    #: program is built ONCE at this k and verify consumes the first k
+    #: of its proposals, so auto-k never retraces the draft
+    _SPEC_AUTO_KMAX = 4
+
+    def _spec_setting(self) -> str:
+        return (self._spec_k_setting
+                if self._spec_k_setting is not None
+                else str(_config.get("MXNET_SPEC_K")))
+
+    def _spec_kmax(self) -> int:
+        s = self._spec_setting()
+        return self._SPEC_AUTO_KMAX if s == "auto" else max(1, int(s))
+
+    def _spec_should_engage(self) -> int:
+        """Per-round arbitration: returns the k to draft this round, or
+        0 for a plain decode step.  Speculation engages only when the
+        cost table says a round pays for itself —
+        ``(E_acc + 1) * t_target > t_draft + t_verify`` — over MEASURED
+        per-round EMAs (arXiv:2008.01040: priced, never guessed);
+        unmeasured entries engage optimistically, so the table only
+        ever turns speculation OFF once it has numbers."""
+        if (self._draft is None
+                or not _config.get("MXNET_SPEC_DECODE")
+                or self._spec_disabled):
+            return 0
+        s = self._spec_setting()
+        kmax = self._spec_kmax()
+        k = self._spec_auto_k() if s == "auto" else kmax
+        # every live row must fit the draft's kmax-deep proposal run
+        # AND the k+1-position verify chunk inside max_seq
+        for row in self._live:
+            if row.cached + kmax + 1 > int(self._model.max_seq) - 1:
+                self._spec_fallback()
+                return 0
+        t_t = self._cost.get("decode")
+        t_d = self._cost.get(("spec", "draft"))
+        t_v = self._cost.get(("spec_verify", k))
+        if t_t is not None and t_d is not None and t_v is not None:
+            # optimistic bootstrap: an unmeasured acceptance EMA prices
+            # as k (a HOST int off the cost table, not a device read)
+            # graftlint: disable=host-sync -- host-scalar coercion
+            e_acc = self._cost.get(("spec", "acc"), float(k))
+            if (e_acc + 1.0) * t_t <= t_d + t_v:
+                self._spec_fallback()
+                return 0
+        return k
+
+    def _spec_auto_k(self) -> int:
+        """``MXNET_SPEC_K=auto``: pick the verify depth k maximizing
+        expected tokens per second from the same EMAs the arbiter
+        reads — ``E_tok(k) = (1 - beta^(k+1)) / (1 - beta)`` over the
+        acceptance-rate EMA ``beta``, priced at
+        ``t_draft + t_verify(k)``.  Unmeasured shapes are tried first
+        (smallest k), so every candidate gets one measurement before
+        the scores mean anything."""
+        t_d = self._cost.get(("spec", "draft"))
+        beta = self._spec_acc_ema
+        if t_d is None or beta is None:
+            return self._SPEC_AUTO_KMAX
+        beta = min(max(beta, 0.0), 0.999)
+        best_k, best = self._SPEC_AUTO_KMAX, -1.0
+        for k in range(1, self._SPEC_AUTO_KMAX + 1):
+            t_v = self._cost.get(("spec_verify", k))
+            if t_v is None:
+                return k
+            e_tok = (1.0 - beta ** (k + 1)) / max(1.0 - beta, 1e-6)
+            score = e_tok / max(t_d + t_v, 1e-12)
+            if score > best:
+                best, best_k = score, k
+        return best_k
+
+    def _spec_fallback(self) -> None:
+        _SPEC_STATS.inc("fallback_rounds")
+        self._stats.inc("spec_fallbacks")
+
+    def _spec_autodisable(self, reason: str, **fields) -> None:
+        """Sticky degrade to plain decode (the poisoned-draft path):
+        once measured acceptance collapses or a draft dispatch fails,
+        speculation stays off for this engine's lifetime — plain decode
+        is always correct, so the failure mode costs throughput only."""
+        if self._spec_disabled:
+            return
+        self._spec_disabled = True
+        _SPEC_STATS.inc("autodisabled")
+        _telemetry.event("spec.autodisabled", self.name,
+                         reason=reason, **fields)
+        _faults.record_event("serving.spec", "autodisabled",
+                             model=self.name, reason=reason)
+
+    def _ensure_spec_pages(self, row: _Row, last_pos: int) -> bool:
+        """Grow (and COW-fork, when a leading page is shared or
+        published) the TARGET page table to cover verify writes through
+        ``last_pos`` — NON-preempting: speculation is opportunistic, so
+        exhaustion just means "not this round" and plain decode
+        proceeds under the ordinary preemption rules."""
+        page = self._pool.page
+        try:
+            for i in range(row.cached // page, last_pos // page + 1):
+                if i < len(row.pages):
+                    if self._pool.shared(row.pages[i]):
+                        row.pages[i] = self._pool.fork(self._geom,
+                                                       row.pages[i])
+                else:
+                    row.pages.extend(self._pool.alloc(1))
+            return True
+        except PagePoolExhausted:
+            return False
+
+    def _ensure_draft_ready(self, row: _Row, kmax: int) -> bool:
+        """Draft pages covering this round's writes (positions
+        ``row.cached .. row.cached + kmax - 1``) plus a draft PREFILL
+        when the draft lags the target by more than the in-round
+        catch-up step can absorb (first spec round for the row, or
+        plain-decoded rounds while speculation was disengaged).  Draft
+        pages are never shared or published — no COW, and a rejected
+        speculation just rewinds ``draft_cached`` (stale KV past it is
+        masked out of every later attention: no rollback pass)."""
+        page = self._pool.page
+        c = row.cached
+        try:
+            while len(row.draft_pages) * page <= c + kmax - 1:
+                row.draft_pages.extend(self._pool.alloc(1))
+        except PagePoolExhausted:
+            return False
+        if c - row.draft_cached > 1 and c > 0:
+            self._draft_prefill(row)
+        return True
+
+    def _draft_prefill(self, row: _Row) -> None:
+        """One bucketed draft-prefill dispatch: writes the draft's KV
+        for the row's committed prefix so the round program can start
+        proposing from ``pending``."""
+        c = row.cached
+        seq = (row.req.prompt + row.req.out)[:c]
+        bucket = self._policy.bucket(c)
+        if bucket is None:
+            bucket = c
+        bucket = min(bucket, int(self._draft.max_seq))
+        tokens = onp.zeros((bucket,), onp.int32)
+        tokens[:c] = seq
+        table = onp.full((self._draft_max_pages,), self._pool.trash,
+                         onp.int32)
+        table[:len(row.draft_pages)] = row.draft_pages
+        rec = self._draft_prefill_program(bucket)
+        t0 = time.perf_counter()
+        with _telemetry.span("decode.spec_draft_prefill", cat="decode",
+                             args={"model": self.name,
+                                   "bucket": bucket, "tokens": c}):
+            self._pool.gate.acquire(self._priority)
+            try:
+                with self._pool.exclusive(self._draft_geom):
+                    dk, dv = self._pool.storage(self._draft_geom)
+                    dk, dv = rec(self._draft_params,
+                                 jnp.asarray(tokens), jnp.int32(c),
+                                 jnp.asarray(table), dk, dv)
+                    self._pool.set_storage(self._draft_geom, dk, dv)
+            finally:
+                self._pool.gate.release()
+        self._ema(("spec", "draft_prefill"), time.perf_counter() - t0)
+        row.draft_cached = c
+
+    def _spec_round(self, k: int) -> bool:
+        """One speculative round over the live rows: ONE draft-round
+        dispatch (kmax proposals per row) + ONE verify dispatch (k+1
+        target positions per row), then a host commit of each row's
+        accepted prefix plus its resampled/bonus token.  Returns False
+        when pages did not fit or the draft dispatch failed — the
+        caller runs a plain decode step instead (speculation is
+        opportunistic, never load-bearing for progress)."""
+        kmax = self._spec_kmax()
+        live = list(self._live)
+        for row in live:
+            if (not self._ensure_spec_pages(row, row.cached + k)
+                    or not self._ensure_draft_ready(row, kmax)):
+                self._spec_fallback()
+                return False
+        r = self._rows
+        trash = self._pool.trash
+        pending = onp.zeros((r,), onp.int32)
+        catch = onp.zeros((r,), onp.int32)
+        catch_on = onp.zeros((r,), bool)
+        dtables = onp.full((r, self._draft_max_pages), trash, onp.int32)
+        dlengths = onp.zeros((r,), onp.int32)
+        tables = onp.full((r, self._max_pages), trash, onp.int32)
+        lengths = onp.zeros((r,), onp.int32)
+        temps = onp.zeros((r,), onp.float32)
+        top_ks = onp.zeros((r,), onp.int32)
+        top_ps = onp.ones((r,), onp.float32)
+        seeds = onp.zeros((r,), onp.int32)
+        for i, row in enumerate(live):
+            pending[i] = row.pending
+            d = row.draft_cached
+            if row.cached - d == 1:
+                # deficit 1 iff the previous round fully accepted: the
+                # last proposal was committed but its KV never drafted
+                catch_on[i] = True
+                catch[i] = (row.req.prompt + row.req.out)[d]
+            dtables[i, :len(row.draft_pages)] = row.draft_pages
+            dlengths[i] = d
+            tables[i, :len(row.pages)] = row.pages
+            lengths[i] = row.cached
+            (temps[i], top_ks[i], top_ps[i],
+             seeds[i]) = _sampling_args(row.req.sampling)
+        step_args: Dict[str, Any] = {"model": self.name,
+                                     "rows": len(live), "k": k}
+        traces = [row.req.trace_id for row in live
+                  if row.req.trace_id is not None]
+        if traces:
+            step_args["trace_ids"] = traces
+        drec = self._draft_round_program(kmax)
+        vrec = self._verify_program(k)
+        try:
+            with _telemetry.span("decode.spec_round", cat="decode",
+                                 args=step_args):
+                t0 = time.perf_counter()
+                self._pool.gate.acquire(self._priority)
+                try:
+                    with self._pool.exclusive(self._draft_geom):
+                        dk, dv = self._pool.storage(self._draft_geom)
+                        props, q_dist, dk, dv = drec(
+                            self._draft_params, jnp.asarray(catch),
+                            jnp.asarray(catch_on),
+                            jnp.asarray(pending),
+                            jnp.asarray(dtables),
+                            jnp.asarray(dlengths), jnp.asarray(temps),
+                            jnp.asarray(top_ks), jnp.asarray(top_ps),
+                            jnp.asarray(seeds), dk, dv)
+                        self._pool.set_storage(self._draft_geom,
+                                               dk, dv)
+                finally:
+                    self._pool.gate.release()
+                t1 = time.perf_counter()
+                self._pool.gate.acquire(self._priority)
+                try:
+                    with self._pool.exclusive(self._geom):
+                        kb, vb = self._pool.storage(self._geom)
+                        n_acc, nxt, kb, vb = vrec(
+                            self._params, jnp.asarray(pending),
+                            props[:, :k], q_dist[:, :k],
+                            jnp.asarray(tables), jnp.asarray(lengths),
+                            jnp.asarray(temps), jnp.asarray(top_ks),
+                            jnp.asarray(top_ps), jnp.asarray(seeds),
+                            kb, vb)
+                        # graftlint: disable=host-sync -- THE one host
+                        # read per spec round: accepted counts, next
+                        # tokens, and proposals feed the host commit
+                        n_acc, nxt, props_h = (onp.asarray(n_acc),
+                                               onp.asarray(nxt),
+                                               onp.asarray(props))
+                        self._pool.set_storage(self._geom, kb, vb)
+                finally:
+                    self._pool.gate.release()
+                t2 = time.perf_counter()
+        except BaseException as e:
+            # a wedged/poisoned draft must never take plain decode
+            # down with it: sticky-disable speculation and fall back
+            # (pool storage is only replaced on success, and CPU runs
+            # do not donate, so the buffers are intact)
+            self._spec_autodisable("draft/verify dispatch failed",
+                                   error=repr(e))
+            self._spec_fallback()
+            return False
+        self._ema(("spec", "draft"), t1 - t0)
+        self._ema(("spec_verify", k), t2 - t1)
+        total_acc = 0
+        committed = 0
+        for i, row in enumerate(live):
+            na = int(n_acc[i])
+            total_acc += na
+            c = row.cached
+            toks = [int(props_h[i, j]) for j in range(na)]
+            toks.append(int(nxt[i]))
+            for t in toks:
+                row.req.out.append(t)
+                committed += 1
+                if self._done(row):
+                    break
+            if not self._done(row):
+                row.cached = c + 1 + na
+                row.pending = row.req.out[-1]
+            # the draft's KV stays valid exactly through the committed
+            # prefix it already holds: positions c .. c+kmax-1 hold
+            # [pending, d_1 .. d_{kmax-1}], of which 1 + min(na,
+            # kmax-1) leading entries match the committed sequence —
+            # rejected tails just rewind, never roll back
+            row.draft_cached = c + 1 + min(na, kmax - 1)
+        self._stats.inc("spec_rounds")
+        self._stats.inc("spec_proposed", k * len(live))
+        self._stats.inc("spec_accepted", total_acc)
+        self._stats.inc("tokens_out", committed)
+        _SPEC_STATS.inc("rounds")
+        _SPEC_STATS.inc("proposed", k * len(live))
+        _SPEC_STATS.inc("accepted", total_acc)
+        # expected-acceptance EMA feeds the arbiter; the RATE EMA trips
+        # the sticky low-acceptance cutoff (a garbage draft that never
+        # agrees must not keep burning a draft+verify round per token)
+        self._ema(("spec", "acc"), total_acc / max(len(live), 1))
+        rate = total_acc / float(max(k * len(live), 1))
+        self._spec_acc_ema = (rate if self._spec_acc_ema is None
+                              else 0.7 * self._spec_acc_ema
+                              + 0.3 * rate)
+        self._spec_rounds_done += 1
+        if self._spec_rounds_done >= 4 and self._spec_acc_ema < 0.2:
+            self._spec_autodisable(
+                "measured acceptance persistently low",
+                acceptance=round(self._spec_acc_ema, 4))
+        return True
+
+    # -- speculative programs (namespace 'serving_spec') ---------------------
+    def _draft_prefill_program(self, bucket: int):
+        rec = self._spec_programs.lookup(("draft_prefill", bucket))
+        if rec is not None:
+            return rec
+        return self._build_draft_prefill(bucket)
+
+    def _build_draft_prefill(self, bucket: int):
+        draft, pool, page = self._draft, self._pool, self._pool.page
+        trash = pool.trash
+
+        def draft_prefill_fn(dparams, tokens, length, table, k_pool,
+                             v_pool):
+            _pstore.count_trace("serving_spec")
+            _logits, k, v = draft.prefill(dparams, tokens, length)
+            pos = jnp.arange(bucket)
+            valid = pos < length
+            pidx = jnp.where(valid, table[pos // page], trash)
+            slot = pos % page
+            k_pool = k_pool.at[pidx, slot].set(k.transpose(1, 0, 2, 3))
+            v_pool = v_pool.at[pidx, slot].set(v.transpose(1, 0, 2, 3))
+            return k_pool, v_pool
+
+        jitted = jax.jit(draft_prefill_fn,
+                         donate_argnums=self._spec_prefill_donate)
+        kspec, vspec = self._draft_pool_specs()
+        args = (self._draft_param_specs(),
+                jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((self._draft_max_pages,),
+                                     jnp.int32),
+                kspec, vspec)
+        rec = _pstore.build(
+            "serving_spec", jitted, args,
+            label=f"{self.name}[draft_prefill b={bucket}]")
+        self._spec_programs.insert(("draft_prefill", bucket), rec)
+        return rec
+
+    def _draft_round_program(self, kmax: int):
+        rec = self._spec_programs.lookup(("draft_round", kmax))
+        if rec is not None:
+            return rec
+        return self._build_draft_round(kmax)
+
+    def _build_draft_round(self, kmax: int):
+        """ONE program for the whole draft phase of a round: an
+        optional masked catch-up step, then the pending token, then
+        kmax-1 proposal feeds — kmax+1 unrolled draft decode steps, so
+        a round costs exactly TWO dispatches (this + verify) however
+        deep the speculation.  Proposals and their full sampling
+        distributions stay on device into the verify program."""
+        draft, pool, page = self._draft, self._pool, self._pool.page
+        trash = pool.trash
+        dmp = self._draft_max_pages
+        r_total = self._rows
+        nl, nh, hd = draft.n_layers, draft.n_heads, draft.head_dim
+
+        def draft_round_fn(dparams, catch, catch_on, pending, tables,
+                           lengths, temps, top_ks, top_ps, seeds,
+                           k_pool, v_pool):
+            _pstore.count_trace("serving_spec")
+            rows = jnp.arange(r_total)
+
+            def step(tok, pos, write, k_pool, v_pool):
+                # one draft decode step: feed tok at per-row position
+                # pos, scatter its KV (masked rows -> trash page),
+                # return next-position logits.  Re-gathers the pool
+                # each step — step j attends step j-1's KV
+                k_ctx = k_pool[tables].reshape(
+                    r_total, dmp * page, nl, nh, hd).transpose(
+                    2, 0, 1, 3, 4)
+                v_ctx = v_pool[tables].reshape(
+                    r_total, dmp * page, nl, nh, hd).transpose(
+                    2, 0, 1, 3, 4)
+                logits, k_new, v_new = draft.decode(
+                    dparams, tok, k_ctx, v_ctx, pos)
+                pidx = jnp.where(
+                    write,
+                    tables[rows, jnp.minimum(pos // page, dmp - 1)],
+                    trash)
+                slot = pos % page
+                k_pool = k_pool.at[pidx, slot].set(
+                    k_new.transpose(1, 0, 2, 3))
+                v_pool = v_pool.at[pidx, slot].set(
+                    v_new.transpose(1, 0, 2, 3))
+                return logits, k_pool, v_pool
+
+            on = jnp.ones((r_total,), bool)
+            # catch-up: after a FULLY accepted round the draft lags by
+            # exactly one committed token — replay it (rows that do
+            # not need it write to trash and do not advance)
+            _, k_pool, v_pool = step(catch, lengths, catch_on,
+                                     k_pool, v_pool)
+            cur = lengths + catch_on.astype(jnp.int32)
+            props, qs = [], []
+            tok = pending
+            for j in range(1, kmax + 1):
+                logits, k_pool, v_pool = step(tok, cur + (j - 1), on,
+                                              k_pool, v_pool)
+                # proposal j sits at absolute position cur + j; gumbel
+                # salt 3 keeps the draft's sampling noise independent
+                # of the verify-side accept (salt 1) and resample
+                # (salt 2) streams on the same position counter
+                keys = jax.vmap(lambda sd, p: jax.random.fold_in(
+                    token_key(sd, p), 3))(seeds, cur + j)
+                d = jax.vmap(sample_token)(logits, temps, top_ks,
+                                           top_ps, keys)
+                q = jax.vmap(_sample_dist)(logits, temps, top_ks,
+                                           top_ps)
+                props.append(d)
+                qs.append(q)
+                tok = d
+            return (jnp.stack(props, axis=1).astype(jnp.int32),
+                    jnp.stack(qs, axis=1), k_pool, v_pool)
+
+        kspec, vspec = self._draft_pool_specs()
+        rows_i = jax.ShapeDtypeStruct((r_total,), jnp.int32)
+        rows_f = jax.ShapeDtypeStruct((r_total,), jnp.float32)
+        args = (self._draft_param_specs(), rows_i,
+                jax.ShapeDtypeStruct((r_total,), jnp.bool_), rows_i,
+                jax.ShapeDtypeStruct((r_total, dmp), jnp.int32),
+                rows_i, rows_f, rows_i, rows_f, rows_i, kspec, vspec)
+        jitted = jax.jit(draft_round_fn,
+                         donate_argnums=self._spec_round_donate)
+        rec = _pstore.build(
+            "serving_spec", jitted, args,
+            label=f"{self.name}[draft_round k={kmax}]")
+        self._spec_programs.insert(("draft_round", kmax), rec)
+        return rec
+
+    def _verify_program(self, k: int):
+        rec = self._spec_programs.lookup(("verify", k))
+        if rec is not None:
+            return rec
+        return self._build_verify(k)
+
+    def _build_verify(self, k: int):
+        """The per-k fixed-shape verify program: ONE target dispatch
+        scores all k+1 positions (pending + k proposals) via
+        ``decode_chunk``, runs standard rejection sampling against the
+        draft's proposal distributions (accept ``d_j`` iff
+        ``u_j q_j(d_j) < p_j(d_j)``), resamples the first rejection
+        from the residual ``norm(max(p - q, 0))`` — the bonus token on
+        full acceptance unifies as a residual with ``q := 0`` — and
+        scatters ONLY the accepted prefix's KV (rejected tails write
+        the trash page: never committed, never rolled back).  The
+        committed-token distribution is provably the target's own
+        sampling distribution; under greedy both sides are one-hot and
+        the chain is the exact argmax chain."""
+        model, pool, page = self._model, self._pool, self._pool.page
+        trash = pool.trash
+        mp = self._max_pages
+        r_total = self._rows
+        s = k + 1
+
+        def verify_fn(params, pending, props, q_dist, tables, lengths,
+                      temps, top_ks, top_ps, seeds, k_pool, v_pool):
+            _pstore.count_trace("serving_spec")
+            rows = jnp.arange(r_total)
+            k_ctx = k_pool[tables].reshape(
+                r_total, mp * page, model.n_layers, model.n_heads,
+                model.head_dim).transpose(2, 0, 1, 3, 4)
+            v_ctx = v_pool[tables].reshape(
+                r_total, mp * page, model.n_layers, model.n_heads,
+                model.head_dim).transpose(2, 0, 1, 3, 4)
+            toks = jnp.concatenate([pending[:, None], props], axis=1)
+            logits, k_new, v_new = model.decode_chunk(
+                params, toks, k_ctx, v_ctx, lengths)     # (R, S, V)
+            # the target's own sampling distribution at every position
+            p = jax.vmap(jax.vmap(_sample_dist,
+                                  in_axes=(0, None, None, None))
+                         )(logits, temps, top_ks, top_ps)
+            # accept d_j iff u_j q_j(d_j) < p_j(d_j) (strict <, so a
+            # zero-probability-under-p proposal NEVER survives);
+            # n_acc = length of the accepted prefix
+            jpos = lengths[:, None] + 1 + jnp.arange(k)[None, :]
+            ukeys = jax.vmap(jax.vmap(
+                lambda sd, pp: jax.random.fold_in(token_key(sd, pp), 1),
+                in_axes=(None, 0)))(seeds, jpos)
+            u = jax.vmap(jax.vmap(jax.random.uniform))(ukeys)
+            qd = jnp.take_along_axis(q_dist, props[..., None],
+                                     axis=2)[..., 0]     # (R, k)
+            pd = jnp.take_along_axis(p[:, :k], props[..., None],
+                                     axis=2)[..., 0]
+            acc = (u * qd < pd).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(acc, axis=1), axis=1)
+            # residual resampling at every candidate rejection point
+            # (q_{k+1} := 0 makes the bonus draw plain p); an all-zero
+            # residual (q covers p exactly) falls back to p
+            qz = jnp.concatenate(
+                [q_dist, jnp.zeros_like(q_dist[:, :1])], axis=1)
+            res = jnp.maximum(p - qz, 0.0)
+            tot = jnp.sum(res, axis=-1, keepdims=True)
+            dist = jnp.where(tot > 0.0,
+                             res / jnp.where(tot > 0.0, tot, 1.0), p)
+            rpos = lengths[:, None] + 1 + jnp.arange(s)[None, :]
+            rkeys = jax.vmap(jax.vmap(
+                lambda sd, pp: jax.random.fold_in(token_key(sd, pp), 2),
+                in_axes=(None, 0)))(seeds, rpos)
+            gum = jax.vmap(jax.vmap(
+                lambda kk: jax.random.gumbel(kk, (model.vocab,))
+                ))(rkeys)
+            cand = jnp.argmax(jnp.log(dist) + gum, axis=-1)  # (R, S)
+            nxt = cand[rows, n_acc]
+            # KV scatter: chunk position i commits iff i <= n_acc
+            # (pending always; then the accepted proposals)
+            keep = jnp.arange(s)[None, :] <= n_acc[:, None]
+            wpos = lengths[:, None] + jnp.arange(s)[None, :]
+            pidx = jnp.where(
+                keep,
+                tables[rows[:, None],
+                       jnp.minimum(wpos // page, mp - 1)],
+                trash)
+            slot = wpos % page
+            # (L, R, S, H, D) -> (R, S, L, H, D) rows
+            k_pool = k_pool.at[pidx, slot].set(
+                k_new.transpose(1, 2, 0, 3, 4))
+            v_pool = v_pool.at[pidx, slot].set(
+                v_new.transpose(1, 2, 0, 3, 4))
+            return (n_acc.astype(jnp.int32), nxt.astype(jnp.int32),
+                    k_pool, v_pool)
+
+        kspec, vspec = self._pool_specs()
+        rows_i = jax.ShapeDtypeStruct((r_total,), jnp.int32)
+        rows_f = jax.ShapeDtypeStruct((r_total,), jnp.float32)
+        args = (self._param_specs(), rows_i,
+                jax.ShapeDtypeStruct((r_total, k), jnp.int32),
+                jax.ShapeDtypeStruct((r_total, k, int(model.vocab)),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((r_total, mp), jnp.int32),
+                rows_i, rows_f, rows_i, rows_f, rows_i, kspec, vspec)
+        jitted = jax.jit(verify_fn,
+                         donate_argnums=self._spec_round_donate)
+        rec = _pstore.build("serving_spec", jitted, args,
+                            label=f"{self.name}[verify k={k}]")
+        self._spec_programs.insert(("verify", k), rec)
+        return rec
+
+    @property
+    def _spec_prefill_donate(self) -> Tuple[int, ...]:
+        return (4, 5) if jax.default_backend() != "cpu" else ()
+
+    @property
+    def _spec_round_donate(self) -> Tuple[int, ...]:
+        return (10, 11) if jax.default_backend() != "cpu" else ()
+
+    def _draft_pool_specs(self):
+        k, v = self._pool.storage(self._draft_geom)
+        return (jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype))
+
+    def _draft_param_specs(self):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self._draft_params)
+
     # -- shapes / specs ------------------------------------------------------
     @property
     def _donate(self) -> Tuple[int, ...]:
         # pool buffers update in place on real devices; CPU skips
         # donation to avoid jax's unusable-donation warning (the
         # cached_step idiom)
-        return (4, 5) if jax.default_backend() != "cpu" else ()
+        return (8, 9) if jax.default_backend() != "cpu" else ()
 
     @property
     def _chunk_donate(self) -> Tuple[int, ...]:
         # chunk prefill carries (offset, length): pool buffers sit one
         # argument later
-        return (5, 6) if jax.default_backend() != "cpu" else ()
+        return (9, 10) if jax.default_backend() != "cpu" else ()
 
     def _pool_specs(self):
         k, v = self._pool.storage(self._geom)
@@ -1702,12 +2647,21 @@ class GenerativeEngine:
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             self._params)
 
+    @staticmethod
+    def _sampling_specs():
+        # (temperature, top_k, top_p, seed) scalar traced arguments
+        return (jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.int32))
+
     def _prefill_specs(self, bucket: int):
         kspec, vspec = self._pool_specs()
         return (self._param_specs(),
                 jax.ShapeDtypeStruct((bucket,), jnp.int32),
                 jax.ShapeDtypeStruct((), jnp.int32),
                 jax.ShapeDtypeStruct((self._max_pages,), jnp.int32),
+                *self._sampling_specs(),
                 kspec, vspec)
 
     def _chunk_specs(self, bucket: int):
@@ -1717,15 +2671,21 @@ class GenerativeEngine:
                 jax.ShapeDtypeStruct((), jnp.int32),      # offset
                 jax.ShapeDtypeStruct((), jnp.int32),      # length
                 jax.ShapeDtypeStruct((self._max_pages,), jnp.int32),
+                *self._sampling_specs(),
                 kspec, vspec)
 
     def _decode_specs(self):
         kspec, vspec = self._pool_specs()
+        rows = jax.ShapeDtypeStruct((self._rows,), jnp.int32)
         return (self._param_specs(),
-                jax.ShapeDtypeStruct((self._rows,), jnp.int32),
+                rows,
                 jax.ShapeDtypeStruct((self._rows, self._max_pages),
                                      jnp.int32),
-                jax.ShapeDtypeStruct((self._rows,), jnp.int32),
+                rows,
+                jax.ShapeDtypeStruct((self._rows,), jnp.float32),
+                rows,   # top_k
+                jax.ShapeDtypeStruct((self._rows,), jnp.float32),
+                rows,   # seed
                 kspec, vspec)
 
     # -- retire / deliver ----------------------------------------------------
@@ -1744,6 +2704,10 @@ class GenerativeEngine:
         if row.pages:
             self._pool.free(row.pages)
             row.pages = []
+        if row.draft_pages:
+            self._pool.free(row.draft_pages)
+            row.draft_pages = []
+            row.draft_cached = 0
 
     def _deliver(self, row: _Row) -> None:
         self._release(row)               # pages free THIS iteration
@@ -1786,5 +2750,28 @@ class GenerativeEngine:
         if self._programs.lookup(("decode",)) is None:
             self._build_decode()
             compiled += 1
+        if self._draft is not None:
+            # the spec grid: draft prefill per bucket + ONE draft
+            # round + one verify per k — compiled here so a spec storm
+            # holds 0 retraces exactly like the plain lane
+            kmax = self._spec_kmax()
+            dcap = min(cap, int(self._draft.max_seq))
+            for b in grid:
+                if b > dcap:
+                    continue
+                if self._spec_programs.lookup(
+                        ("draft_prefill", b)) is None:
+                    self._build_draft_prefill(b)
+                    compiled += 1
+            if self._spec_programs.lookup(
+                    ("draft_round", kmax)) is None:
+                self._build_draft_round(kmax)
+                compiled += 1
+            ks = (range(1, kmax + 1)
+                  if self._spec_setting() == "auto" else [kmax])
+            for kk in ks:
+                if self._spec_programs.lookup(("verify", kk)) is None:
+                    self._build_verify(kk)
+                    compiled += 1
         self._stats.inc("warmup_programs", compiled)
         return compiled

@@ -228,7 +228,16 @@ class ReplicaServer:
         return {"ok": False, "error": f"unknown op {op!r}"}
 
     def _generate(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        from .serving_decode import SamplingSpec
+
         deadline_us = req.get("deadline_us")
+        # the sampling spec (temperature/top-k/top-p + counter-PRNG
+        # seed) crosses the wire like the deadline does: positional
+        # seeding means a failed-over or hedged SAMPLED request replays
+        # token-exact on whichever replica answers
+        wire_samp = req.get("sampling")
+        sampling = (SamplingSpec.from_wire(wire_samp)
+                    if wire_samp is not None else None)
         try:
             # re-enter the request's ONE identity and ONE budget: the
             # engine's admission/shed/span records stamp the trace_id
@@ -242,12 +251,14 @@ class ReplicaServer:
                             req["prompt"],
                             max_new_tokens=int(
                                 req.get("max_new_tokens", 32)),
-                            eos=req.get("eos"))
+                            eos=req.get("eos"),
+                            sampling=sampling)
                 else:
                     toks = self.engine.generate(
                         req["prompt"],
                         max_new_tokens=int(req.get("max_new_tokens", 32)),
-                        eos=req.get("eos"))
+                        eos=req.get("eos"),
+                        sampling=sampling)
             return {"ok": True, "tokens": [int(t) for t in toks]}
         except ShedError as e:
             return {"ok": False, "shed_kind": getattr(e, "kind", None),
@@ -314,11 +325,15 @@ class RemoteReplica:
 
     # -- the engine surface the router dispatches -----------------------------
     def generate(self, prompt, max_new_tokens: int = 32,
-                 eos: Optional[int] = None) -> List[int]:
+                 eos: Optional[int] = None,
+                 sampling=None) -> List[int]:
         """Remote ``GenerativeEngine.generate``: forwards the ambient
-        deadline remainder and trace id in-band; the socket timeout is
-        the same budget (+slack for the reply frame), so a wedged or
-        dead server bounds the wait and fails over."""
+        deadline remainder, trace id, and sampling spec in-band; the
+        socket timeout is the same budget (+slack for the reply frame),
+        so a wedged or dead server bounds the wait and fails over.
+        The sampling seed rides the frame like ``t_enqueue`` rides the
+        router: position-keyed PRNG makes a retried/hedged sampled
+        request token-exact across replicas."""
         amb = _faults.deadline_remaining_us()
         timeout_s = (min(self._timeout_s, amb / 1e6 + 1.0)
                      if amb is not None else None)
@@ -327,6 +342,8 @@ class RemoteReplica:
             "prompt": [int(t) for t in prompt],
             "max_new_tokens": int(max_new_tokens),
             "eos": eos,
+            "sampling": (sampling.to_wire()
+                         if sampling is not None else None),
             "deadline_us": amb,
             "trace_id": _telemetry.current_trace(),
         }, timeout_s=timeout_s)

@@ -411,11 +411,17 @@ class ReplicaRouter:
 
     def generate(self, prompt, max_new_tokens: int = 32,
                  eos: Optional[int] = None,
-                 deadline_us: Optional[int] = None) -> List[int]:
+                 deadline_us: Optional[int] = None,
+                 sampling=None) -> List[int]:
         """Route one generation request; failover re-runs the FULL
         request from the original prompt on the new replica — greedy
         decode makes the re-run token-exact, so a client never sees a
-        replica death, only (bounded) extra latency."""
+        replica death, only (bounded) extra latency.  ``sampling`` (a
+        :class:`serving_decode.SamplingSpec`) rides the request to
+        every replica it touches — the position-keyed counter PRNG
+        makes a failed-over or hedged SAMPLED request replay
+        token-exact too, same-seed-same-tokens on any same-config
+        replica (the eager fallback runs the identical sampler)."""
         if self._kind != "generate":
             raise RuntimeError(
                 "this router fronts ServingEngine replicas — call "
@@ -426,12 +432,13 @@ class ReplicaRouter:
             from .serving_decode import eager_generate
 
             return eager_generate(first._model, first._params,
-                                  prompt, max_new_tokens, eos)
+                                  prompt, max_new_tokens, eos,
+                                  sampling=sampling)
 
         return self._submit(
             lambda eng: eng.generate(prompt,
                                      max_new_tokens=max_new_tokens,
-                                     eos=eos),
+                                     eos=eos, sampling=sampling),
             deadline_us, "generate", eager_fn=eager,
             prompt=[int(t) for t in prompt])
 

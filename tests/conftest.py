@@ -26,6 +26,24 @@ else:
         f for f in os.environ.get("XLA_FLAGS", "").split()
         if not f.startswith("--xla_force_host_platform_device_count"))
 
+if os.environ.get("MXNET_TEST_ALLOW_TPU") != "1":
+    # Persistent XLA compile cache for the CPU suite.  Every
+    # GenerativeEngine warmup compiles an identical program set per
+    # engine (ProgramStore scopes are per-owner, so in-process jit
+    # caches never share across engines), and the serving sampler made
+    # those compiles the dominant suite cost.  The disk cache keys on
+    # HLO, so the 2nd..Nth engine hits it even within one cold run,
+    # without perturbing trace/warmup/program counters the tests pin
+    # (unlike MXNET_PROGRAM_CACHE_DIR, which changes warmup returns).
+    # setdefault: an operator- or CI-supplied dir wins.  Subprocess
+    # tests that count fresh compiles scrub this var from child envs.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_test_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The axon TPU-tunnel sitecustomize (if present) re-registers platforms and

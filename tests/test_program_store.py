@@ -358,15 +358,21 @@ def test_program_store_load_fault_without_cache_falls_back_eager():
     a crash, loss still computed)."""
     import jax
 
-    assert jax.config.jax_compilation_cache_dir is None
-    with faults.active(faults.FaultPlan().fail("program_store.load")):
-        net = _build_net(seed=15)
-        step = _build_trainer(net).compile_step(net, _loss_fn)
-        x, y = _batch(seed=15)
-        loss = step(x, y, batch_size=6)
-    assert not step.last_step_compiled
-    assert "injected fault" in step.fallback_reason
-    assert onp.isfinite(float(loss.asnumpy()))
+    # force "no cache in play" even when the harness enables the suite-wide
+    # persistent compile cache (conftest.py)
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        with faults.active(faults.FaultPlan().fail("program_store.load")):
+            net = _build_net(seed=15)
+            step = _build_trainer(net).compile_step(net, _loss_fn)
+            x, y = _batch(seed=15)
+            loss = step(x, y, batch_size=6)
+        assert not step.last_step_compiled
+        assert "injected fault" in step.fallback_reason
+        assert onp.isfinite(float(loss.asnumpy()))
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
 
 
 # ---------------------------------------------------------------------------

@@ -35,7 +35,12 @@ and FAILS (exit 1) unless:
   scale-down is a graceful preemption (drain → typed draining sheds →
   exit 83); in the host-loss cell a SIGKILL'd remote replica costs at
   most ``kill_recover_s`` before the fleet delivers again, with every
-  admitted request still delivered token-exact.
+  admitted request still delivered token-exact;
+- **a poisoned draft costs zero availability** (ISSUE 19): in the
+  ``spec_draft_poison`` cell a wedged draft model must auto-disable
+  speculation via the cost table (``spec.autodisabled``) and degrade
+  to plain decode in-place — 0 dropped requests, token streams
+  unchanged, clean page-pool audit across both KV geometries.
 
 Invoked by the test suite (tests/test_serving_router.py) exactly like
 the other gates, and runnable standalone:
@@ -113,6 +118,16 @@ def main(argv=None) -> int:
                     f"{name}: slowest join served its first request "
                     f"after {js:.2f}s (wall "
                     f"{BUDGET['join_first_serve_s']}s)")
+        if name == "spec_draft_poison":
+            # ISSUE 19: the wedged draft costs ZERO availability — the
+            # drill's own cell checks pin auto-disable + degrade; here
+            # we pin that speculation actually ran before the poison
+            # (a cell that never speculated proves nothing)
+            spec = rep.get("spec") or []
+            if spec and not any(s.get("spec_rounds") for s in spec):
+                failures.append(
+                    f"{name}: no spec rounds before the poison — the "
+                    "drill exercised plain decode only")
         if name == "router_host_loss":
             kr = rep.get("kill_to_recovered_s")
             if kr is not None and kr > BUDGET["kill_recover_s"]:
@@ -124,7 +139,8 @@ def main(argv=None) -> int:
                  "steady_p99_s", "chaos_p99_s", "failovers",
                  "breaker_opens", "breaker_closes", "re_admit_s",
                  "drain_s", "join_to_first_served_s",
-                 "kill_to_recovered_s", "drill_wall_s")}
+                 "kill_to_recovered_s", "spec_autodisabled",
+                 "drill_wall_s")}
         print(f"check_availability_budget: {json.dumps(line, default=str)}")
     if failures:
         print("check_availability_budget: FAIL", file=sys.stderr)

@@ -93,6 +93,18 @@ SENTINEL_BUDGET = {"compiled_launches_per_step": 1,
 # token streams must be identical
 ROUTER_BUDGET = {"extra_dispatches": 0, "extra_retraces": 0,
                  "extra_host_syncs": 0}
+# the SPEC budget (ISSUE 19, docs/PERF.md "Speculative decoding +
+# sampled decode"): with MXNET_SPEC_DECODE=1 and a high-agreement
+# draft, a mixed greedy/sampled join/retire storm holds the BOUNDED
+# program set (target grid + draft prefill buckets + 1 draft round + 1
+# verify per k — all warmup-compiled), re-traces NOTHING, pays
+# strictly LESS than one target-model dispatch per committed token
+# (the k-for-1 win), and leaks zero pages across both geometries;
+# with MXNET_SPEC_DECODE=0 a draft-attached engine's greedy stream is
+# byte-identical in dispatch budget (and tokens) to a draft-free one
+SPEC_BUDGET = {"retraces_after_warm": 0, "programs_over_grid": 0,
+               "leaked_pages": 0, "greedy_off_extra_dispatches": 0,
+               "greedy_off_extra_retraces": 0}
 # the MESH budget (docs/PERF.md "Pod-scale SPMD train step"): under
 # kvstore='tpu' the data-parallel step stays ONE compiled launch — the
 # SPMD partitioner fans out over the mesh, never the host (no per-chip
@@ -593,6 +605,138 @@ def _measure_router() -> dict:
     }
 
 
+def _measure_spec() -> dict:
+    """Speculative-decoding lane: a high-agreement draft under
+    MXNET_SPEC_DECODE=1 drives a mixed greedy/sampled join/retire
+    storm — bounded programs (== the warmup grid across BOTH
+    ProgramStore namespaces), 0 retraces, < 1 target dispatch per
+    committed token, greedy rows token-exact vs the eager oracle, 0
+    leaked pages.  Then the off leg: the SAME greedy stream through a
+    draft-attached engine with MXNET_SPEC_DECODE=0 must match a
+    draft-free engine's dispatch/retrace budget and tokens exactly."""
+    import threading
+
+    import numpy as onp
+
+    from mxnet_tpu import engine as _engine
+    from mxnet_tpu import serving_decode as sd
+
+    target, tp, draft, dp = sd.high_agreement_pair(
+        vocab=41, d_model=16, target_layers=2, draft_layers=1,
+        n_heads=2, max_seq=64, seed=5)
+    rng = onp.random.RandomState(23)
+    prompts = [rng.randint(0, 41, size=rng.randint(1, 10)).tolist()
+               for _ in range(8)]
+    budgets = [6, 9, 4, 8, 5, 7, 10, 6]
+    # even rows greedy (token-exactness leg), odd rows sampled (the
+    # heterogeneous-config leg: same programs, zero retraces)
+    samps = [None if i % 2 == 0
+             else sd.SamplingSpec(temperature=0.9, top_k=7, top_p=0.95,
+                                  seed=100 + i)
+             for i in range(8)]
+    prev = os.environ.get("MXNET_SPEC_DECODE")
+    os.environ["MXNET_SPEC_DECODE"] = "1"
+    try:
+        pool = sd.PagePool(pages=96, page=4)
+        eng = sd.GenerativeEngine(target, params=tp, pool=pool,
+                                  max_rows=4, name="spec_lane",
+                                  draft=draft, draft_params=dp,
+                                  spec_k=4)
+        grid = eng.warmup(max_len=16)
+        t0 = sd.trace_count() + sd.spec_trace_count()
+        d0 = sd.dispatch_count() + sd.spec_dispatch_count()
+        outs: list = [None] * 8
+        errs: list = []
+
+        def fire(i):
+            try:
+                outs[i] = eng.generate(prompts[i],
+                                       max_new_tokens=budgets[i],
+                                       sampling=samps[i])
+            except BaseException as e:    # pragma: no cover
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        _engine.waitall()
+        st = eng.stats()
+        greedy_exact = all(
+            outs[i] == sd.eager_generate(target, tp, prompts[i],
+                                         budgets[i])
+            for i in range(0, 8, 2) if outs[i] is not None)
+        tokens = sum(len(o) for o in outs if o is not None)
+        # target-equivalent dispatches: each plain decode step AND each
+        # verify round costs one target-model launch; the draft's
+        # launches ride the cheap geometry and are priced by the cost
+        # table, not this ratio
+        target_dispatches = st["decode_steps"] + st["spec_rounds"]
+        row = {
+            "mode": "spec",
+            "errors": errs,
+            "warmup_programs": grid,
+            "programs": st["programs"] + st["spec_programs"],
+            "programs_over_grid":
+                max(0, st["programs"] + st["spec_programs"] - grid),
+            "retraces_after_warm":
+                (sd.trace_count() + sd.spec_trace_count()) - t0,
+            "dispatches":
+                (sd.dispatch_count() + sd.spec_dispatch_count()) - d0,
+            "spec_rounds": st["spec_rounds"],
+            "spec_proposed": st["spec_proposed"],
+            "spec_accepted": st["spec_accepted"],
+            "acceptance": (st["spec_accepted"]
+                           / max(st["spec_proposed"], 1)),
+            "spec_disabled": st["spec_disabled"],
+            "tokens": tokens,
+            "target_dispatches_per_token":
+                target_dispatches / max(tokens, 1),
+            "greedy_token_exact": greedy_exact,
+            "leaked_pages": pool.in_use(),
+        }
+        eng.close()
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_SPEC_DECODE", None)
+        else:
+            os.environ["MXNET_SPEC_DECODE"] = prev
+    # the OFF leg: greedy path byte-identical dispatch budget with the
+    # knob off, draft attached or not (MXNET_SPEC_DECODE=0 is ambient
+    # here — the knob is uncached)
+
+    def run_off(with_draft: bool) -> dict:
+        pool2 = sd.PagePool(pages=64, page=4)
+        kw = (dict(draft=draft, draft_params=dp, spec_k=4)
+              if with_draft else {})
+        e2 = sd.GenerativeEngine(target, params=tp, pool=pool2,
+                                 max_rows=2, name="spec_off", **kw)
+        e2.warmup(max_len=16)
+        t1 = sd.trace_count() + sd.spec_trace_count()
+        d1 = sd.dispatch_count() + sd.spec_dispatch_count()
+        toks = [e2.generate(p, max_new_tokens=5) for p in prompts[:4]]
+        got = {
+            "outs": toks,
+            "dispatches":
+                (sd.dispatch_count() + sd.spec_dispatch_count()) - d1,
+            "retraces": (sd.trace_count() + sd.spec_trace_count()) - t1,
+            "leaked_pages": pool2.in_use(),
+        }
+        e2.close()
+        return got
+
+    bare = run_off(False)
+    offd = run_off(True)
+    row["greedy_off_extra_dispatches"] = (offd["dispatches"]
+                                          - bare["dispatches"])
+    row["greedy_off_extra_retraces"] = offd["retraces"] - bare["retraces"]
+    row["greedy_off_outputs_equal"] = offd["outs"] == bare["outs"]
+    row["leaked_pages"] += bare["leaked_pages"] + offd["leaked_pages"]
+    return row
+
+
 def _store_worker() -> None:
     """``--store-worker`` mode: run the tiny train-step + serving-bucket
     workload in THIS process and print its program-store verdict as one
@@ -702,6 +846,16 @@ def main() -> int:
           f"{decode['prefills']} prefill "
           f"({decode['rows_per_decode']} rows/step), "
           f"{decode['leaked_pages']} leaked pages")
+    spec = _measure_spec()
+    print(f"{'spec':<10} mixed storm -> {spec['programs']} programs "
+          f"(grid {spec['warmup_programs']}), "
+          f"{spec['retraces_after_warm']} retraces, "
+          f"{spec['spec_rounds']} rounds "
+          f"{spec['spec_accepted']}/{spec['spec_proposed']} accepted "
+          f"({spec['acceptance']:.2f}), "
+          f"{spec['target_dispatches_per_token']:.2f} target "
+          f"dispatches/token over {spec['tokens']} tokens; off leg "
+          f"{spec['greedy_off_extra_dispatches']} extra dispatches")
     router = _measure_router()
     print(f"{'router':<10} 1 replica, hedge off -> "
           f"{router['routed_dispatches']} dispatches "
@@ -788,6 +942,33 @@ def main() -> int:
         if decode[key] > budget:
             failures.append(
                 f"decode {key} = {decode[key]} exceeds budget {budget}")
+    if spec["errors"]:
+        failures.append(f"spec storm errors: {spec['errors']}")
+    for key, budget in SPEC_BUDGET.items():
+        if spec[key] > budget:
+            failures.append(
+                f"spec {key} = {spec[key]} exceeds budget {budget}")
+    if spec["spec_rounds"] == 0 or spec["spec_disabled"]:
+        failures.append(
+            "spec lane never engaged speculation (0 rounds or "
+            "auto-disabled) on the high-agreement fixture")
+    if spec["acceptance"] < 0.7:
+        failures.append(
+            f"spec acceptance {spec['acceptance']:.2f} < 0.7 on the "
+            "high-agreement draft (rejection sampling broken?)")
+    if spec["target_dispatches_per_token"] >= 1.0:
+        failures.append(
+            f"spec pays {spec['target_dispatches_per_token']:.2f} "
+            "target dispatches per committed token (must be < 1: the "
+            "k-for-1 verify win is gone)")
+    if not spec["greedy_token_exact"]:
+        failures.append(
+            "spec greedy rows diverge from the eager oracle "
+            "(token-exactness invariant broken under speculation)")
+    if not spec["greedy_off_outputs_equal"]:
+        failures.append(
+            "MXNET_SPEC_DECODE=0 draft-attached token streams differ "
+            "from the draft-free engine's")
     for key, budget in ROUTER_BUDGET.items():
         if router[key] > budget:
             failures.append(
@@ -910,6 +1091,10 @@ def main() -> int:
           f"{decode['retraces_after_warm']} retraces, "
           f"{decode['extra_dispatches']} extra dispatches, "
           f"{decode['leaked_pages']} leaked pages)"
+          f"; spec within budget ({spec['programs']} programs == grid, "
+          f"{spec['target_dispatches_per_token']:.2f} target "
+          f"dispatches/token at {spec['acceptance']:.2f} acceptance, "
+          f"off leg {spec['greedy_off_extra_dispatches']} extra)"
           f"; router within budget ({router['extra_dispatches']} extra "
           f"dispatches over {router['requests']} routed requests)"
           f"; sentinel within budget "

@@ -23,6 +23,13 @@ the PRs 1–14 wins were bought in regresses past its declared tolerance:
   and the ``prefix_miss_blocks`` lane alias): the ISSUE-16
   shared-prompt prefill win — a hit-rate drop surfaces as miss-block
   growth on the same workload, an undersized pool as eviction churn.
+- **speculative decoding** (ISSUE 19): ``spec.acceptance_rate`` and
+  ``spec.tokens_per_target_dispatch`` gate FALLING (bigger is better
+  — an acceptance drop starves the k-for-1 verify win), while
+  ``spec.fallback_rounds`` / ``spec.autodisabled`` gate rising churn;
+  the sampled-decode dispatch/retrace counters
+  (``program_store.serving_spec.*``) ride the existing retrace and
+  dispatch rules with tolerance 0 on retraces.
 
 Counter names are instance-normalized (``decode.engine3.shed`` →
 ``decode.engine*.shed``) and summed per lane, so a renumbered engine
@@ -63,16 +70,21 @@ WAIVER_PATH = os.path.join(REPO, "tools", "perf_delta_waivers.json")
 class Rule:
     """One gated counter family: ``match`` selects normalized counter
     names, a candidate value above ``base * (1 + tol) + slack`` is a
-    regression."""
+    regression.  ``falling=True`` inverts the direction for
+    bigger-is-better gauges (e.g. ``spec.acceptance_rate``): a
+    candidate below ``base * (1 - tol) - slack`` regresses."""
 
     def __init__(self, label: str, match: Callable[[str], bool],
-                 tol: float, slack: float):
+                 tol: float, slack: float, falling: bool = False):
         self.label = label
         self.match = match
         self.tol = tol
         self.slack = slack
+        self.falling = falling
 
     def regressed(self, base: float, cand: float) -> bool:
+        if self.falling:
+            return cand < base * (1.0 - self.tol) - self.slack
         return cand > base * (1.0 + self.tol) + self.slack
 
 
@@ -124,6 +136,23 @@ RULES: Tuple[Rule, ...] = (
          lambda n: n in ("spmd.param_bytes_per_device",
                          "spmd.opt_bytes_per_device"),
          tol=0.10, slack=1024.0),
+    # ISSUE 19: the speculative-decoding family.  Acceptance is the
+    # lever the whole k-for-1 win hangs on — a drop past 5% on the
+    # same workload means the draft/verify pair degraded and every
+    # verify dispatch is buying fewer tokens; it must fail loudly, not
+    # rot silently behind a still-green wall-clock number.  Same for
+    # tokens-per-target-dispatch, the win itself.
+    Rule("spec-acceptance",
+         lambda n: n == "spec.acceptance_rate",
+         tol=0.05, slack=0.02, falling=True),
+    Rule("spec-tokens-per-dispatch",
+         lambda n: n == "spec.tokens_per_target_dispatch",
+         tol=0.10, slack=0.1, falling=True),
+    # churn: a workload that suddenly needs more fallback rounds or
+    # auto-disables has lost speculation where it used to pay
+    Rule("spec-churn",
+         lambda n: n in ("spec.fallback_rounds", "spec.autodisabled"),
+         tol=0.10, slack=2.0),
 )
 
 # lane-level scalar aliases gated alongside the namespaced counters
@@ -257,7 +286,7 @@ def compare(baseline: List[Dict[str, Any]],
                     report["waived"].append(entry)
                 else:
                     report["regressions"].append(entry)
-            elif cv < bv:
+            elif (cv > bv) if rule.falling else (cv < bv):
                 report["improvements"].append(
                     {"lane": metric, "counter": name, "rule": rule.label,
                      "baseline": bv, "candidate": cv})
@@ -357,6 +386,36 @@ def self_test() -> int:
               "prefix hit rate was not flagged "
               f"({report['regressions']})", file=sys.stderr)
         return 1
+    # ISSUE 19: an acceptance-rate DROP (bigger-is-better gauge) must
+    # trip the falling spec-acceptance rule, and spec retraces gate at
+    # tolerance 0 like every other namespace
+    spec_base = {
+        "metric": "decode_speculative_tokens_per_s", "value": 250.0,
+        "telemetry": {"spec.acceptance_rate": 0.95,
+                      "spec.tokens_per_target_dispatch": 4.2,
+                      "spec.fallback_rounds": 1,
+                      "spec.autodisabled": 0,
+                      "program_store.serving_spec.traces": 7},
+    }
+    spec_drop = json.loads(json.dumps(spec_base))
+    spec_drop["telemetry"]["spec.acceptance_rate"] = 0.55
+    report = compare([spec_base], [spec_drop], waivers=[])
+    bad = [r for r in report["regressions"]
+           if r["counter"] == "spec.acceptance_rate"
+           and r["rule"] == "spec-acceptance"]
+    if not bad:
+        print("check_perf_delta: SELF-TEST FAILED — a collapsed spec "
+              "acceptance rate was not flagged "
+              f"({report['regressions']})", file=sys.stderr)
+        return 1
+    spec_rise = json.loads(json.dumps(spec_base))
+    spec_rise["telemetry"]["spec.acceptance_rate"] = 1.0
+    report = compare([spec_base], [spec_rise], waivers=[])
+    if report["regressions"]:
+        print("check_perf_delta: SELF-TEST FAILED — an IMPROVED spec "
+              "acceptance rate was flagged as a regression "
+              f"({report['regressions']})", file=sys.stderr)
+        return 1
     clean = compare([base_lane], [json.loads(json.dumps(base_lane))],
                     waivers=[])
     if clean["regressions"]:
@@ -365,7 +424,7 @@ def self_test() -> int:
               file=sys.stderr)
         return 1
     print("check_perf_delta: self-test OK (+1 retrace flagged, "
-          "identical snapshot clean)")
+          "acceptance drop flagged, identical snapshot clean)")
     return 0
 
 

@@ -869,6 +869,67 @@ def lane_multichip(on_cpu: bool) -> dict:
     return _stamp_fleet_telemetry(c, tel_dir)
 
 
+def lane_moe(on_cpu: bool) -> dict:
+    """Expert-parallel MoE lane (ISSUE 20): runs
+    benchmark/multichip_scaling.py --moe — an MoEBlock under
+    MXNET_SPMD_MESH='ep=4,dp=2' with the load-balance aux head folded
+    into the one donated step.  The value is routed tokens/s/chip;
+    capacity-drop counters and the ``moe.*`` telemetry gauges ride
+    along so check_perf_delta defends throughput AND drop rate."""
+    import json as _json
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmark", "multichip_scaling.py")
+    env, tel_dir = _fleet_telemetry_env("moe")
+    if on_cpu:
+        env.setdefault("MULTICHIP_STEPS", "10")
+    r = subprocess.run([sys.executable, "-u", script, "--moe", "--json"],
+                       capture_output=True, text=True,
+                       timeout=600, env=env)
+    if r.returncode != 0:
+        raise RuntimeError(f"moe lane failed:\n{r.stderr[-1500:]}")
+    c = _json.loads(r.stdout.strip().splitlines()[-1])
+    if c.get("skipped"):
+        _progress(f"moe: SKIPPED ({c['skipped']})")
+    else:
+        _progress(f"moe: {c['value']:.0f} tokens/s/chip, "
+                  f"{c['launches_per_step']:.1f} launches/step, "
+                  f"{c['dropped_slots']}/{c['routed_slots']} dropped")
+    c["vs_baseline"] = 0.0
+    return _stamp_fleet_telemetry(c, tel_dir)
+
+
+def lane_pp(on_cpu: bool) -> dict:
+    """Pipeline-parallel lane (ISSUE 20): runs
+    benchmark/multichip_scaling.py --pp — a 2-stage PipelineBlock on
+    the pp=2,dp=2,fsdp=2 mesh at two microbatch counts.  The value is
+    the MEASURED bubble fraction (fill/drain share of step time from
+    the T(M) = A + B/M slope fit) next to the GPipe closed form; step
+    time and the ``pp.*`` gauges ride along for check_perf_delta."""
+    import json as _json
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmark", "multichip_scaling.py")
+    env, tel_dir = _fleet_telemetry_env("pp")
+    if on_cpu:
+        env.setdefault("MULTICHIP_STEPS", "10")
+    r = subprocess.run([sys.executable, "-u", script, "--pp", "--json"],
+                       capture_output=True, text=True,
+                       timeout=600, env=env)
+    if r.returncode != 0:
+        raise RuntimeError(f"pp lane failed:\n{r.stderr[-1500:]}")
+    c = _json.loads(r.stdout.strip().splitlines()[-1])
+    if c.get("skipped"):
+        _progress(f"pp: SKIPPED ({c['skipped']})")
+    else:
+        _progress(f"pp: bubble {c['value']:.2f} measured / "
+                  f"{c['bubble_fraction_theoretical']:.2f} theoretical, "
+                  f"{c['step_ms_mean']:.2f} ms/step, "
+                  f"{c['launches_per_step']:.1f} launches/step")
+    c["vs_baseline"] = 0.0
+    return _stamp_fleet_telemetry(c, tel_dir)
+
+
 def lane_elastic(on_cpu: bool) -> dict:
     """Elastic-recovery lane (drill-driven, ROADMAP 4c): runs
     benchmark/elastic_drill.py's sigterm_drain drill — a real SIGTERM
@@ -939,6 +1000,10 @@ def _resolve_lane(name):
         return lane_pipeline, "pipeline_device_idle_gap_us"
     if name == "multichip":
         return lane_multichip, "multichip_img_s_per_chip"
+    if name == "moe":
+        return lane_moe, "moe_tokens_per_s_per_chip"
+    if name == "pp":
+        return lane_pp, "pp_bubble_fraction"
     if name == "elastic":
         return lane_elastic, "elastic_recovery_wall_s"
     if name.endswith("_int8"):
@@ -957,8 +1022,8 @@ def _resolve_lane(name):
 # compile — its XLA program also warms the compile cache for fp32); int8
 # last (longest end-to-end: calibration + conversion + compile).
 LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "train_step",
-              "infer", "decode", "pipeline", "multichip", "elastic",
-              "resnet50_v1_int8"]
+              "infer", "decode", "pipeline", "multichip", "moe", "pp",
+              "elastic", "resnet50_v1_int8"]
 
 # generous-but-bounded per-lane wall budgets (seconds) on the device;
 # CPU-fallback lanes use small sizes and get one flat budget.
@@ -966,6 +1031,7 @@ LANE_ORDER = ["resnet50_v1_bf16", "resnet50_v1", "bert", "train_step",
 _LANE_BUDGET = {"resnet50_v1_bf16": 600.0, "resnet50_v1": 600.0,
                 "bert": 540.0, "train_step": 240.0, "infer": 240.0,
                 "decode": 300.0, "pipeline": 240.0, "multichip": 420.0,
+                "moe": 240.0, "pp": 300.0,
                 "elastic": 300.0, "resnet50_v1_int8": 900.0}
 _CPU_LANE_BUDGET = 420.0
 
@@ -1264,6 +1330,10 @@ def _metric_to_lane(metric: str):
         return "pipeline"
     if metric == "multichip_img_s_per_chip":
         return "multichip"
+    if metric == "moe_tokens_per_s_per_chip":
+        return "moe"
+    if metric == "pp_bubble_fraction":
+        return "pp"
     if metric == "elastic_recovery_wall_s":
         return "elastic"
     for suffix, lane_sfx in (("_int8_infer_throughput_per_chip", "_int8"),

@@ -198,6 +198,258 @@ def _model_lane(n_fsdp: int, per_chip: int, steps: int) -> dict:
             os.environ["MXNET_FSDP_MIN_SIZE"] = prev_min
 
 
+def _moe_lane(steps: int) -> dict:
+    """Expert-parallel MoE sub-lane (ISSUE 20, docs/PERF.md "Every-axis
+    mesh"): an MoEBlock under MXNET_SPMD_MESH='ep=4,dp=2' — the value is
+    routed tokens/s/chip through the ONE donated step (gating, dispatch/
+    combine, ep-sharded expert einsums, folded aux head, fused update).
+    Capacity-drop counters ride along (host recomputation of the same
+    deterministic gating state), stamped as ``moe.*`` gauges so
+    check_perf_delta defends both the throughput and the drop rate."""
+    import jax
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import cached_step, gluon, telemetry
+    from mxnet_tpu.parallel import moe as moe_mod, spmd
+
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        return {"skipped": f"only {n_dev} device(s)"}
+    G, S, M, H, E = 8, 16, 32, 64, 4
+    prev = os.environ.get("MXNET_SPMD_MESH")
+    prev_min = os.environ.get("MXNET_FSDP_MIN_SIZE")
+    os.environ["MXNET_SPMD_MESH"] = "ep=4,dp=2"
+    os.environ["MXNET_FSDP_MIN_SIZE"] = "1"
+    try:
+        net = moe_mod.MoEBlock(units=M, hidden=H, num_experts=E, k=2)
+        net.initialize(mx.init.Xavier())
+        rng = onp.random.RandomState(0)
+        for _n, p in sorted(net.collect_params().items()):
+            p.data()._set_data(
+                mx.nd.array(rng.randn(*p.shape).astype(onp.float32)
+                            * 0.1)._data)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01, "momentum": 0.9},
+                                kvstore="tpu")
+        loss_fn = lambda n, a: ((n(a)) ** 2).mean()
+        x_host = rng.randn(G, S, M).astype(onp.float32)
+        x = mx.nd.array(x_host)
+        step = trainer.compile_step(net, loss_fn)
+        for _ in range(WARMUP):
+            loss = step(x, batch_size=G)
+        jax.block_until_ready(loss._data)
+        assert step.last_step_compiled, step.last_fallback_reason
+        d0, r0 = cached_step.dispatch_count(), spmd.reshard_count()
+        t_all = time.perf_counter()
+        for _ in range(steps):
+            loss = step(x, batch_size=G)
+            jax.block_until_ready(loss._data)
+        elapsed = time.perf_counter() - t_all
+        tokens_s = G * S * steps / elapsed
+        # drop counters: recompute the deterministic gating state on the
+        # host with the trained gate — survivors vs G*S*k routed slots
+        import jax.numpy as jnp
+
+        gate_w = net.collect_params()["gate.weight"].data()._data
+        disp, _comb, _aux = moe_mod.top_k_gating(
+            jnp.asarray(x_host), gate_w, num_experts=E, k=2)
+        routed = G * S * 2
+        survivors = int(onp.asarray(disp).sum())
+        ew = net.collect_params()["expert.ffn_1.weight"].data()._data
+        lane = {
+            "skipped": None,
+            "devices": n_dev,
+            "tokens_per_step": G * S,
+            "tokens_s": tokens_s,
+            "tokens_s_per_chip": tokens_s / n_dev,
+            "step_ms_mean": elapsed * 1e3 / steps,
+            "launches_per_step":
+                (cached_step.dispatch_count() - d0) / steps,
+            "reshards_after_warm": spmd.reshard_count() - r0,
+            "expert_sharded": bool(ew.sharding.spec
+                                   and ew.sharding.spec[0] == "ep"),
+            "routed_slots": routed,
+            "dropped_slots": routed - survivors,
+            "drop_rate": (routed - survivors) / routed,
+        }
+        telemetry.gauge(
+            "moe.tokens_per_s_per_chip",
+            "MoE bench lane: routed tokens/s/chip through the one "
+            "donated ep-sharded step").set(lane["tokens_s_per_chip"])
+        telemetry.gauge(
+            "moe.dropped_slots",
+            "MoE bench lane: over-capacity slots dropped by the "
+            "deterministic top-k gating on the bench batch").set(
+            lane["dropped_slots"])
+        return lane
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_SPMD_MESH", None)
+        else:
+            os.environ["MXNET_SPMD_MESH"] = prev
+        if prev_min is None:
+            os.environ.pop("MXNET_FSDP_MIN_SIZE", None)
+        else:
+            os.environ["MXNET_FSDP_MIN_SIZE"] = prev_min
+
+
+def _pp_lane(steps: int) -> dict:
+    """Pipeline-parallel sub-lane (ISSUE 20): a 2-stage PipelineBlock
+    under MXNET_SPMD_MESH='pp=2,dp=2,fsdp=2', stepped at two microbatch
+    counts (M=2, M=4).  The per-microbatch ramp cost falls out of the
+    step-time slope over 1/M — T(M) = A + B/M with B the fill/drain
+    (bubble) term — giving a MEASURED bubble fraction next to the
+    GPipe closed form (S-1)/(M+S-1).  Stamped as ``pp.*`` gauges so
+    check_perf_delta catches a bubble regression even when wall-clock
+    noise hides it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import cached_step, gluon, telemetry
+    from mxnet_tpu.parallel import pipeline as pipe_mod, spmd
+
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        return {"skipped": f"only {n_dev} device(s)"}
+    S_STAGES, DIM, BATCH = 2, 64, 8
+    prev = os.environ.get("MXNET_SPMD_MESH")
+    prev_min = os.environ.get("MXNET_FSDP_MIN_SIZE")
+    os.environ["MXNET_SPMD_MESH"] = "pp=2,dp=2,fsdp=2"
+    os.environ["MXNET_FSDP_MIN_SIZE"] = "1"
+    try:
+        def measure(num_micro: int) -> dict:
+            mesh = spmd.resolve_mesh()
+            rng = onp.random.RandomState(1)
+            ws = [jnp.asarray((rng.randn(DIM, DIM) * 0.2)
+                              .astype(onp.float32))
+                  for _ in range(S_STAGES)]
+
+            def stage(params, xx):
+                return jnp.tanh(xx @ params["w"])
+
+            pipe = pipe_mod.HeteroPipeline(
+                [stage] * S_STAGES, [{"w": w} for w in ws], mesh,
+                num_microbatches=num_micro,
+                example_x=jnp.zeros((BATCH, DIM), jnp.float32))
+            blk = pipe_mod.PipelineBlock(pipe)
+            trainer = gluon.Trainer(blk.collect_params(), "sgd",
+                                    {"learning_rate": 0.05,
+                                     "momentum": 0.9}, kvstore="tpu")
+            loss_fn = lambda n, a: ((n(a)) ** 2).sum()
+            x = mx.nd.array(rng.randn(BATCH, DIM).astype(onp.float32))
+            step = trainer.compile_step(blk, loss_fn)
+            for _ in range(WARMUP):
+                loss = step(x, batch_size=BATCH)
+            jax.block_until_ready(loss._data)
+            assert step.last_step_compiled, step.last_fallback_reason
+            d0, r0 = cached_step.dispatch_count(), spmd.reshard_count()
+            t_all = time.perf_counter()
+            for _ in range(steps):
+                loss = step(x, batch_size=BATCH)
+                jax.block_until_ready(loss._data)
+            elapsed = time.perf_counter() - t_all
+            return {
+                "num_microbatches": num_micro,
+                "step_ms_mean": elapsed * 1e3 / steps,
+                "launches_per_step":
+                    (cached_step.dispatch_count() - d0) / steps,
+                "reshards_after_warm": spmd.reshard_count() - r0,
+                "bubble_fraction_theoretical":
+                    pipe_mod.bubble_fraction(S_STAGES, num_micro),
+            }
+
+        m2 = measure(2)
+        m4 = measure(4)
+        # T(M) = A + B/M: B/M is the fill/drain ramp's share of the step
+        b_term = (m2["step_ms_mean"] - m4["step_ms_mean"]) / (0.5 - 0.25)
+        measured = (max(0.0, b_term) / 4) / m4["step_ms_mean"] \
+            if m4["step_ms_mean"] else 0.0
+        lane = {
+            "skipped": None,
+            "devices": n_dev,
+            "stages": S_STAGES,
+            "step_ms_mean": m4["step_ms_mean"],
+            "launches_per_step": m4["launches_per_step"],
+            "reshards_after_warm": (m2["reshards_after_warm"]
+                                    + m4["reshards_after_warm"]),
+            "bubble_fraction_measured": measured,
+            "bubble_fraction_theoretical":
+                m4["bubble_fraction_theoretical"],
+            "points": [m2, m4],
+        }
+        telemetry.gauge(
+            "pp.bubble_fraction_measured",
+            "pp bench lane: fill/drain share of step time from the "
+            "T(M) = A + B/M slope fit at M=4").set(measured)
+        telemetry.gauge(
+            "pp.step_ms_mean",
+            "pp bench lane: mean step wall-time (ms) at M=4 on the "
+            "pp=2,dp=2,fsdp=2 mesh").set(lane["step_ms_mean"])
+        return lane
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_SPMD_MESH", None)
+        else:
+            os.environ["MXNET_SPMD_MESH"] = prev
+        if prev_min is None:
+            os.environ.pop("MXNET_FSDP_MIN_SIZE", None)
+        else:
+            os.environ["MXNET_FSDP_MIN_SIZE"] = prev_min
+
+
+def run_moe(steps: int = STEPS) -> dict:
+    import jax
+
+    from mxnet_tpu import program_store, telemetry
+
+    t_c0 = program_store.compile_seconds()
+    lane = _moe_lane(steps)
+    disk = program_store.disk_stats()
+    telemetry.flush()
+    out = {
+        "metric": "moe_tokens_per_s_per_chip",
+        "value": lane.get("tokens_s_per_chip", 0.0),
+        "unit": "tokens/s/chip",
+        "n_devices": len(jax.devices()),
+        "steps": steps,
+        "platform": jax.default_backend(),
+        "compile_s": round(program_store.compile_seconds() - t_c0, 3),
+        "cache_hits": disk["hits"],
+        "cache_misses": disk["misses"],
+        "telemetry": telemetry.snapshot(),
+    }
+    out.update({k: v for k, v in lane.items() if k != "telemetry"})
+    return out
+
+
+def run_pp(steps: int = STEPS) -> dict:
+    import jax
+
+    from mxnet_tpu import program_store, telemetry
+
+    t_c0 = program_store.compile_seconds()
+    lane = _pp_lane(steps)
+    disk = program_store.disk_stats()
+    telemetry.flush()
+    out = {
+        "metric": "pp_bubble_fraction",
+        "value": lane.get("bubble_fraction_measured", 0.0),
+        "unit": "fraction",
+        "n_devices": len(jax.devices()),
+        "steps": steps,
+        "platform": jax.default_backend(),
+        "compile_s": round(program_store.compile_seconds() - t_c0, 3),
+        "cache_hits": disk["hits"],
+        "cache_misses": disk["misses"],
+        "telemetry": telemetry.snapshot(),
+    }
+    out.update({k: v for k, v in lane.items() if k != "telemetry"})
+    return out
+
+
 def run(per_chip: int = PER_CHIP, steps: int = STEPS,
         sizes=None) -> dict:
     import jax
@@ -256,6 +508,33 @@ def main():
             return int(argv[argv.index(flag) + 1])
         return default
 
+    if "--moe" in argv:
+        result = run_moe(steps=_val("--steps", STEPS))
+        if "--json" in argv:
+            print(json.dumps(result))
+        elif result.get("skipped"):
+            print(f"moe lane SKIPPED ({result['skipped']})")
+        else:
+            print(f"moe (ep=4,dp=2, {result['platform']}): "
+                  f"{result['value']:.0f} tokens/s/chip, "
+                  f"{result['step_ms_mean']:.2f} ms/step, "
+                  f"{result['launches_per_step']:.1f} launches/step, "
+                  f"{result['dropped_slots']}/{result['routed_slots']} "
+                  f"slots dropped")
+        return 0
+    if "--pp" in argv:
+        result = run_pp(steps=_val("--steps", STEPS))
+        if "--json" in argv:
+            print(json.dumps(result))
+        elif result.get("skipped"):
+            print(f"pp lane SKIPPED ({result['skipped']})")
+        else:
+            print(f"pp (pp=2,dp=2,fsdp=2, {result['platform']}): "
+                  f"bubble {result['value']:.2f} measured / "
+                  f"{result['bubble_fraction_theoretical']:.2f} "
+                  f"theoretical, {result['step_ms_mean']:.2f} ms/step, "
+                  f"{result['launches_per_step']:.1f} launches/step")
+        return 0
     result = run(per_chip=_val("--per-chip", PER_CHIP),
                  steps=_val("--steps", STEPS))
     if "--out" in argv:

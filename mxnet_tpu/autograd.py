@@ -494,7 +494,11 @@ class Function:
             fn = self
 
             def vjp_fn(out_cts):
-                cts = (out_cts,) if single_out else out_cts
+                # the tape hands a BARE cotangent whenever num_outputs
+                # == 1 — including a forward that returned a 1-element
+                # tuple (single_out False), so branch on the ct itself
+                cts = out_cts if isinstance(out_cts, tuple) \
+                    else (out_cts,)
                 with pause():
                     in_grads = fn.backward(*[_nd._wrap(c, inputs[0].ctx) for c in cts])
                 if not isinstance(in_grads, (list, tuple)):

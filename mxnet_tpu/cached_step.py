@@ -498,12 +498,31 @@ class TrainStep:
                 return a
             args = tuple(_rep(a) for a in args)
         scaler = getattr(tr, "_amp_loss_scaler", None)
+        from .parallel import moe as _moe
         with autograd.record():
-            loss = self._loss_fn(self._net, *args)
+            with _moe.aux_scope() as auxes:
+                loss = self._loss_fn(self._net, *args)
             heads = list(loss) if isinstance(loss, (list, tuple)) else [loss]
+            if auxes:
+                # MoE load-balance loss: same extra differentiated head
+                # the compiled program folds, so eager == compiled
+                aux_w = float(_config.get("MXNET_MOE_AUX_WEIGHT"))
+                at = auxes[0]
+                for a in auxes[1:]:
+                    at = at + a
+                heads = heads + [at * aux_w]
             if scaler is not None and scaler.loss_scale != 1.0:
                 heads = [h * scaler.loss_scale for h in heads]
         autograd.backward(heads)
+        gt = getattr(self._net, "compiled_grad_transform", None)
+        if gt is not None:
+            named = {}
+            for n, p in self._net.collect_params().items():
+                if p.grad_req != "null" and p._grad is not None:
+                    named[n] = p.grad()._data
+            for n, g in gt(dict(named)).items():
+                if named.get(n) is not g:
+                    self._net.collect_params()[n].grad()._set_data(g)
         if scaler is not None:
             base = getattr(tr, "_amp_original_scale", tr._scale)
             tr._amp_original_scale = base
@@ -562,15 +581,19 @@ class TrainStep:
             from .parallel import spmd as _spmd
 
             rep = _spmd.replicated(mesh)
-            fsdp = int(mesh.shape.get(_spmd.MODEL_AXIS, 1)) > 1
+            model_axes = _spmd.model_axes_active(mesh)
+            name_of = {id(p): n for n, p in params.items()}
 
-            def _sharding_of(shape):
-                # fsdp axis present: ZeRO-style per-leaf sharding
+            def _sharding_of(shape, pname=None):
+                # any model axis present (fsdp/pp/ep): per-leaf
+                # name+shape-aware placement — pp packed stage buffers
+                # and ep expert weights by NAME, then the ZeRO rule
                 # (largest divisible dim, small/indivisible leaves
                 # replicate — the latter loudly); otherwise the classic
                 # replicated KVStore-broadcast layout
-                if fsdp:
-                    return _spmd.param_sharding(tuple(shape), mesh)
+                if model_axes:
+                    return _spmd.param_sharding(tuple(shape), mesh,
+                                                name=pname)
                 return rep
 
             def _place_nd(d, sh=None):
@@ -598,13 +621,15 @@ class TrainStep:
             # outputs carry the same shardings back into the
             # parameters, so reshard_count stays flat after warmup
             for p in trainable:
-                _place_nd(p.data(), _sharding_of(p.data().shape))
+                _place_nd(p.data(), _sharding_of(p.data().shape,
+                                                 name_of.get(id(p))))
             for n in frozen_names:
                 _place_nd(params[n].data(),
-                          _sharding_of(params[n].data().shape))
+                          _sharding_of(params[n].data().shape, n))
             for p, s in zip(trainable, states):
                 _place_state(s, p.data().shape,
-                             _sharding_of(p.data().shape))
+                             _sharding_of(p.data().shape,
+                                          name_of.get(id(p))))
 
             # per-device memory accounting (gauges
             # spmd.param_bytes_per_device / spmd.opt_bytes_per_device):
@@ -1185,6 +1210,49 @@ class TrainStep:
                 scaler.update_scale(overflow)
         return loss
 
+    def _grad_hook(self, slot_of_name):
+        """The net-level compiled gradient hook: a net exposing
+        ``compiled_grad_transform(named_grads) -> named_grads`` (e.g.
+        ``parallel.pipeline.PipelineBlock`` summing tied embed/head
+        slices on the packed cotangent) gets it applied INSIDE the
+        compiled program, right after the vjp, on both the full-step and
+        the accumulation microbatch programs.  Returns ``(slot_names,
+        transform)`` — ``(None, None)`` when the net has no hook."""
+        gt = getattr(self._net, "compiled_grad_transform", None)
+        if gt is None:
+            return None, None
+        n_slots = (max(slot_of_name.values()) + 1) if slot_of_name else 0
+        slot_names: List[Optional[str]] = [None] * n_slots
+        for n, i in slot_of_name.items():
+            slot_names[i] = n
+        return slot_names, gt
+
+    @staticmethod
+    def _apply_grad_transform(slot_names, gt, grads):
+        if gt is None:
+            return grads
+        names = list(slot_names) + [None] * (len(grads) - len(slot_names))
+        named = {n: g for n, g in zip(names, grads) if n is not None}
+        named = gt(named)
+        return [named.get(n, g) if n is not None else g
+                for n, g in zip(names, grads)]
+
+    @staticmethod
+    def _fold_aux(auxes, heads, scale_eff, has_ok):
+        """Fold recorded MoE load-balance aux losses into the
+        differentiated heads as ONE extra (scaled) head — seeded with a
+        unit cotangent like every head, so ``aux_weight * d(aux)``
+        reaches the grads/optimizer while the user-visible loss outputs
+        stay untouched."""
+        if not auxes:
+            return heads
+        aux_w = float(_config.get("MXNET_MOE_AUX_WEIGHT"))
+        at = auxes[0]
+        for a in auxes[1:]:
+            at = at + a
+        at = (at * aux_w).astype(jnp.float32)
+        return list(heads) + [at * scale_eff if has_ok else at]
+
     def _build_grad_program(self, params, names, in_struct, ctx, flavor,
                             slot_of_name, frozen_names, has_ok, donate):
         """The accumulation-window microbatch program: forward + vjp
@@ -1192,11 +1260,14 @@ class TrainStep:
         accumulator buffers — no optimizer math, no state touched."""
         from .gluon import block as _gb
 
+        from .parallel import moe as _moe
+
         net, loss_fn = self._net, self._loss_fn
         raw_fwd, out_struct, mutated_names = _gb._stage_fn(
             lambda *call_args: loss_fn(net, *call_args),
             params, names, in_struct, True, ctx, flavor)
         frozen_pos = {n: j for j, n in enumerate(frozen_names)}
+        slot_names, gtrans = self._grad_hook(slot_of_name)
 
         def grad_fn(w_list, frozen_list, acc_list, in_list, rng_key,
                     scale, scale_alt, prev_ok):
@@ -1209,8 +1280,11 @@ class TrainStep:
             def fwd(w_l):
                 full = [w_l[slot_of_name[n]] if n in slot_of_name
                         else frozen_list[frozen_pos[n]] for n in names]
-                outs, muts = raw_fwd(full, in_list, rng_key)
-                heads = [o * scale_eff for o in outs] if has_ok else outs
+                with _moe.aux_scope() as auxes:
+                    outs, muts = raw_fwd(full, in_list, rng_key)
+                heads = [o * scale_eff for o in outs] if has_ok \
+                    else list(outs)
+                heads = self._fold_aux(auxes, heads, scale_eff, has_ok)
                 return heads, (outs, muts)
 
             heads, vjp_fn, (outs, muts) = jax.vjp(
@@ -1219,6 +1293,7 @@ class TrainStep:
             (grads,) = vjp_fn(cts)
             grads = [g.astype(w.dtype) if g.dtype != w.dtype else g
                      for g, w in zip(grads, w_list)]
+            grads = self._apply_grad_transform(slot_names, gtrans, grads)
             new_acc = [a + g for a, g in zip(acc_list, grads)]
             return outs, muts, new_acc
 
@@ -1330,6 +1405,8 @@ class TrainStep:
         from .gluon import block as _gb
         from .optimizer import fused as _fused
 
+        from .parallel import moe as _moe
+
         net, loss_fn = self._net, self._loss_fn
         opt = self._trainer._optimizer
         raw_fwd, out_struct, mutated_names = _gb._stage_fn(
@@ -1338,6 +1415,7 @@ class TrainStep:
         bodies = [_fused.group_step_fn(opt, mp, has_ok)
                   for mp, _m in group_layout]
         frozen_pos = {n: j for j, n in enumerate(frozen_names)}
+        slot_names, gtrans = self._grad_hook(slot_of_name)
 
         def step_fn(w_list, s_list, frozen_list, in_list, rng_key,
                     lrs_g, wds_g, counts_g, rescale, scale,
@@ -1356,11 +1434,14 @@ class TrainStep:
             def fwd(w_l):
                 full = [w_l[slot_of_name[n]] if n in slot_of_name
                         else frozen_list[frozen_pos[n]] for n in names]
-                outs, muts = raw_fwd(full, in_list, rng_key)
+                with _moe.aux_scope() as auxes:
+                    outs, muts = raw_fwd(full, in_list, rng_key)
                 # the loss-scale multiply sits INSIDE the differentiated
                 # region so grads come out scaled, exactly like backward
                 # on amp.scale_loss's scaled loss
-                heads = [o * scale_eff for o in outs] if has_ok else outs
+                heads = [o * scale_eff for o in outs] if has_ok \
+                    else list(outs)
+                heads = self._fold_aux(auxes, heads, scale_eff, has_ok)
                 return heads, (outs, muts)
 
             heads, vjp_fn, (outs, muts) = jax.vjp(
@@ -1369,6 +1450,7 @@ class TrainStep:
             (grads,) = vjp_fn(cts)
             grads = [g.astype(w.dtype) if g.dtype != w.dtype else g
                      for g, w in zip(grads, w_list)]
+            grads = self._apply_grad_transform(slot_names, gtrans, grads)
             # kvstore 'device'-path reduce: identity for the supported
             # single-replica/single-worker topology (fused into the
             # program by construction; other topologies fell back)

@@ -167,6 +167,14 @@ declare("MXNET_FSDP_MIN_SIZE", int, 1024,
         "an 'fsdp' mesh axis — sharding a LayerNorm bias buys no memory "
         "and costs an all-gather.",
         validator=lambda v: v >= 0, subsystem="kvstore", cached=False)
+declare("MXNET_MOE_AUX_WEIGHT", float, 0.01,
+        "Weight on the MoE load-balance auxiliary loss "
+        "(parallel.moe.MoEBlock records the Shazeer balance penalty into "
+        "moe.aux_scope; cached_step.TrainStep folds weight*sum(aux) into "
+        "the differentiated loss heads on both the compiled and eager "
+        "paths, so the penalty reaches the optimizer without widening "
+        "the user loss_fn contract).  0 disables the fold.",
+        validator=lambda v: v >= 0, subsystem="kvstore", cached=False)
 declare("MXNET_ENGINE_PREFETCH", int, 2,
         "Async pipeline engine: device-prefetch depth — how many batches "
         "a DevicePrefetcher transfer thread stages into HBM ahead of the "

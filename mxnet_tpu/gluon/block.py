@@ -1035,3 +1035,40 @@ def _zero_ct(arr):
     ):
         return jnp.zeros(arr.shape, arr.dtype)
     return onp.zeros(arr.shape, jax.dtypes.float0)
+
+
+def jax_bridge(fn, *inputs):
+    """Differentiable eager-tape bridge for a pure-jax function.
+
+    ``fn(*raw_arrays) -> pytree of arrays`` runs under ``jax.vjp``; the
+    returned vjp closure is spliced into the autograd tape as ONE node
+    (:class:`mxnet_tpu.autograd.Function`), so gradients flow through
+    arbitrary jax code (``shard_map`` pipelines, MoE dispatch einsums)
+    on the eager path exactly as they do inside the compiled step.
+    ``inputs`` are NDArrays; the output pytree is NDArray-wrapped.
+    """
+    from .. import autograd as _ag
+
+    state = {}
+
+    def _flat_fn(*raw):
+        out = fn(*raw)
+        leaves, treedef = jax.tree_util.tree_flatten(out)
+        state["treedef"] = treedef
+        return tuple(leaves)
+
+    class _Bridge(_ag.Function):
+        def forward(self, *nd_in):
+            ctx = nd_in[0].ctx
+            self._ctx = ctx
+            leaves, self._vjp = jax.vjp(
+                _flat_fn, *[a._data for a in nd_in])
+            return tuple(_wrap(l, ctx) for l in leaves)
+
+        def backward(self, *out_grads):
+            cts = tuple(g._data for g in out_grads)
+            gins = self._vjp(cts)
+            return tuple(_wrap(g, self._ctx) for g in gins)
+
+    outs = _Bridge()(*inputs)
+    return jax.tree_util.tree_unflatten(state["treedef"], list(outs))

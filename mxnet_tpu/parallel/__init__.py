@@ -23,14 +23,16 @@ from .moe import moe_layer, top_k_gating
 from .pipeline import (HeteroPipeline, pipeline_apply, pipelined,
                        stack_stage_params)
 from .ring_attention import ring_attention, ring_attention_sharded
-from .sharding import (PartitionSpec, ShardingPlan, constraint, fsdp_plan,
-                       replicated_plan, shard_array, tensor_parallel_plan)
+from .sharding import (PartitionSpec, ShardingPlan, constraint,
+                       expert_parallel_plan, fsdp_plan, replicated_plan,
+                       shard_array, tensor_parallel_plan)
 from .train import ShardedTrainer, functional_call
 from .elastic import CheckpointManager, HeartbeatMonitor, run_elastic
 
 __all__ = [
     "AXIS_NAMES", "auto_mesh", "current_mesh", "make_mesh", "mesh_scope",
     "set_mesh", "ShardingPlan", "PartitionSpec", "constraint", "fsdp_plan",
+    "expert_parallel_plan",
     "replicated_plan", "shard_array", "tensor_parallel_plan", "all_reduce",
     "all_gather", "reduce_scatter", "all_to_all", "ppermute", "ring_shift",
     "broadcast_from", "run_sharded", "ring_attention",

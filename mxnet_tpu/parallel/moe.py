@@ -9,12 +9,52 @@ over ICI.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["top_k_gating", "moe_layer"]
+__all__ = ["top_k_gating", "moe_layer", "aux_scope", "record_aux",
+           "MoEBlock"]
+
+
+# ---------------------------------------------------------------------------
+# load-balance aux-loss plumbing (the Trainer loss path)
+# ---------------------------------------------------------------------------
+# A gluon forward has no side channel for the gating aux loss; this
+# thread-local scope is it.  cached_step.TrainStep opens the scope around
+# the traced forward (compiled AND eager paths) and folds
+# MXNET_MOE_AUX_WEIGHT * sum(recorded) into the differentiated loss
+# heads, so the load-balance loss reaches the optimizer without touching
+# the user's loss_fn signature.
+
+_AUX = threading.local()
+
+
+@contextlib.contextmanager
+def aux_scope():
+    """Collect aux losses recorded by MoE blocks during the enclosed
+    forward.  Yields the (mutable) list; nesting restores the outer
+    scope on exit."""
+    prev = getattr(_AUX, "lst", None)
+    _AUX.lst = []
+    try:
+        yield _AUX.lst
+    finally:
+        _AUX.lst = prev
+
+
+def record_aux(aux) -> bool:
+    """Record one load-balance aux-loss value into the active scope (a
+    no-op returning False when no scope is open — e.g. pure-jax callers
+    like models/transformer_lm.py that fold the aux themselves)."""
+    lst = getattr(_AUX, "lst", None)
+    if lst is None:
+        return False
+    lst.append(aux)
+    return True
 
 
 def top_k_gating(x, gate_w, *, num_experts: int, k: int = 2,
@@ -73,17 +113,130 @@ def moe_layer(x, gate_w, w_in, w_out, *, k: int = 2,
     """Dense-dispatch MoE FFN.
 
     x: [G, S, M]; gate_w: [M, E]; w_in: [E, M, H]; w_out: [E, H, M].
-    Shard w_in/w_out over 'ep' on dim 0 (ShardingPlan rule `expert.*`) and
-    XLA turns the dispatch einsums into all-to-alls over the ep axis.
+    Shard w_in/w_out over 'ep' on dim 0 (ShardingPlan rule `expert.*` /
+    name-aware ``spmd.param_spec``) and XLA turns the dispatch einsums
+    into all-to-alls over the ep axis; the expert-dim intermediates carry
+    mesh-agnostic ``sharding.constraint(P('ep', 'dp'))`` annotations so
+    the partitioner keeps per-expert compute on the expert's devices
+    (axes absent from the ambient mesh legalize away silently).
     Returns (output [G, S, M], aux_loss).
     """
+    from .sharding import PartitionSpec as _P, constraint as _constraint
+
     E = gate_w.shape[-1]
     dispatch, combine, aux = top_k_gating(
         x, gate_w, num_experts=E, k=k, capacity_factor=capacity_factor,
         capacity=capacity)
     # [G,S,E,C] x [G,S,M] -> expert inputs [E, G, C, M]
-    expert_in = jnp.einsum("gsec,gsm->egcm", dispatch, x)
-    h = activation(jnp.einsum("egcm,emh->egch", expert_in, w_in))
-    expert_out = jnp.einsum("egch,ehm->egcm", h, w_out)
+    ep_spec = _P("ep", "dp", None, None)
+    expert_in = _constraint(
+        jnp.einsum("gsec,gsm->egcm", dispatch, x), ep_spec)
+    h = _constraint(
+        activation(jnp.einsum("egcm,emh->egch", expert_in, w_in)), ep_spec)
+    expert_out = _constraint(
+        jnp.einsum("egch,ehm->egcm", h, w_out), ep_spec)
     out = jnp.einsum("gsec,egcm->gsm", combine, expert_out)
     return out, aux
+
+
+# ---------------------------------------------------------------------------
+# Gluon adapter: expert-parallel MoE FFN as a trainable Block
+# ---------------------------------------------------------------------------
+
+_MOE_BLOCK_CLS = None
+
+
+def _moe_block_cls():
+    """Build the MoEBlock class lazily: gluon imports here (not at module
+    import) keep ``mxnet_tpu.parallel`` free of an import cycle through
+    the gluon package."""
+    global _MOE_BLOCK_CLS
+    if _MOE_BLOCK_CLS is not None:
+        return _MOE_BLOCK_CLS
+
+    from .. import autograd as _ag
+    from ..context import current_context
+    from ..gluon.block import Block, jax_bridge
+    from ..gluon.parameter import Parameter
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import _wrap
+
+    class _Holder(Block):
+        """Bare parameter/child holder so collect_params yields the
+        canonical ``expert.*`` structural names the ep sharding rule
+        (``spmd.param_spec``) and ShardingPlans match on."""
+
+    class MoEBlock(Block):
+        """Dense-dispatch top-k MoE FFN (:func:`moe_layer`) as a gluon
+        block in the one donated step program.
+
+        Parameters are named for the ep placement contract —
+        ``gate.weight [M, E]`` (replicated), ``expert.ffn_1.weight
+        [E, M, H]`` and ``expert.ffn_2.weight [E, H, M]`` (sharded
+        ``P('ep')`` on dim 0 by name-aware ``spmd.param_spec`` when the
+        mesh has a real ``ep`` axis).  The gating load-balance aux loss
+        is recorded into the ambient :func:`aux_scope`; the TrainStep
+        folds ``MXNET_MOE_AUX_WEIGHT * sum`` into the differentiated
+        loss heads on both the compiled and eager paths, so the balance
+        penalty reaches the optimizer without widening the user's
+        loss_fn contract.  Input ``x`` is ``[G, S, M]`` (groups, tokens,
+        model dim); output matches.
+        """
+
+        def __init__(self, units: int, hidden: int, num_experts: int, *,
+                     k: int = 2, capacity_factor: float = 1.25,
+                     capacity: Optional[int] = None,
+                     activation=jax.nn.gelu, dtype: str = "float32"):
+            super().__init__()
+            self._units = units
+            self._hidden = hidden
+            self._num_experts = num_experts
+            self._k = k
+            self._capacity_factor = capacity_factor
+            self._capacity = capacity
+            self._activation = activation
+            self.gate = _Holder()
+            self.gate.weight = Parameter(
+                "weight", shape=(units, num_experts), dtype=dtype)
+            self.expert = _Holder()
+            self.expert.ffn_1 = _Holder()
+            self.expert.ffn_1.weight = Parameter(
+                "weight", shape=(num_experts, units, hidden), dtype=dtype)
+            self.expert.ffn_2 = _Holder()
+            self.expert.ffn_2.weight = Parameter(
+                "weight", shape=(num_experts, hidden, units), dtype=dtype)
+
+        def _moe_fn(self):
+            kw = dict(k=self._k, capacity_factor=self._capacity_factor,
+                      capacity=self._capacity,
+                      activation=self._activation)
+
+            def fn(x, gw, wi, wo):
+                return moe_layer(x, gw, wi, wo, **kw)
+
+            return fn
+
+        def forward(self, x):
+            gw = self.gate.weight.data()
+            wi = self.expert.ffn_1.weight.data()
+            wo = self.expert.ffn_2.weight.data()
+            if _ag.is_recording() and not isinstance(
+                    gw._data, jax.core.Tracer):
+                out, aux = jax_bridge(self._moe_fn(), x, gw, wi, wo)
+                record_aux(aux)
+                return out
+            ctx = x.ctx if isinstance(x, NDArray) else current_context()
+            raw = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+            out, aux = self._moe_fn()(raw, gw._data, wi._data, wo._data)
+            record_aux(aux)
+            return _wrap(out, ctx)
+
+    _MOE_BLOCK_CLS = MoEBlock
+    return _MOE_BLOCK_CLS
+
+
+def __getattr__(name):
+    if name == "MoEBlock":
+        return _moe_block_cls()
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

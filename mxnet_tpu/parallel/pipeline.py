@@ -57,7 +57,72 @@ def shard_map(*args, **kwargs):
         raise
 
 __all__ = ["pipeline_apply", "pipelined", "stack_stage_params",
-           "HeteroPipeline"]
+           "HeteroPipeline", "PipelineBlock", "bubble_fraction"]
+
+# largest integer magnitude fp32 represents exactly: the packed wire
+# casts every leaf to fp32, so wider values would silently round
+_WIRE_EXACT_MAX = 2 ** 24
+
+
+def bubble_fraction(n_stages: int, num_microbatches: int) -> float:
+    """GPipe bubble fraction: the fill/drain steps (``n_stages - 1``) as a
+    share of the whole schedule (``num_microbatches + n_stages - 1``)."""
+    return (n_stages - 1) / float(num_microbatches + n_stages - 1)
+
+
+def _wire_wide_int(dtype) -> bool:
+    dt = jnp.dtype(dtype)
+    return dt.kind in "iu" and dt.itemsize >= 4
+
+
+def _check_wire_tree(tree, where: str, *, allow_abstract_32: bool = False):
+    """Refuse leaves the packed fp32 wire cannot carry exactly.
+
+    Narrow integers (bool/int8/int16/uint8/uint16) always round-trip.
+    Wide integers (>= 32-bit) round-trip only below 2**24: concrete
+    leaves are value-checked; abstract leaves (``jax.eval_shape``-derived
+    stage boundaries, ShapeDtypeStruct examples) cannot be bounds-checked
+    at wire-spec derivation time, so they refuse — except 32-bit example
+    INPUTS when ``allow_abstract_32`` (the documented token-id path,
+    vocab ids << 2**24).  Raising here, at ``HeteroPipeline.__init__``,
+    replaces the old silent precision loss in ``_tree_pack`` /
+    ``_batched_pack``.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        if not _wire_wide_int(getattr(leaf, "dtype", jnp.float32)):
+            continue
+        name = jax.tree_util.keystr(path) or "<root>"
+        dt = jnp.dtype(leaf.dtype)
+        from ..base import MXNetError
+
+        concrete = not isinstance(leaf, jax.ShapeDtypeStruct) and \
+            hasattr(leaf, "__array__")
+        if concrete:
+            # graftlint: disable=host-sync -- one-time __init__ validation
+            # of concrete example/param values, never inside the step
+            arr = onp.asarray(leaf)
+            vmax = max(abs(int(arr.min())), abs(int(arr.max()))) \
+                if arr.size else 0
+            if vmax >= _WIRE_EXACT_MAX:
+                raise MXNetError(
+                    f"HeteroPipeline wire precision: {where} leaf "
+                    f"{name} (dtype {dt.name}) holds |value| {vmax} >= "
+                    "2**24, which the packed fp32 wire cannot represent "
+                    "exactly. Keep integer leaves below 2**24 or cast "
+                    "to float32 (or a <=16-bit integer) before the "
+                    "pipeline boundary.")
+            continue
+        if dt.itemsize == 4 and allow_abstract_32:
+            continue
+        raise MXNetError(
+            f"HeteroPipeline wire precision: {where} leaf {name} has "
+            f"abstract dtype {dt.name}; integer values >= 2**24 do not "
+            "round-trip through the packed fp32 wire and a "
+            f"{'64-bit' if dt.itemsize >= 8 else 'computed'} integer "
+            "boundary cannot be bounds-checked at wire-spec derivation "
+            "time. Cast to float32 (or a <=16-bit integer) at the "
+            "stage boundary.")
 
 
 def stack_stage_params(per_stage_params):
@@ -240,6 +305,15 @@ class HeteroPipeline:
         self.n_stages = n
         self.remat = remat
 
+        # ---- wire-exactness validation (satellite of the fp32 wire) -----
+        # every stage's params and every activation boundary cross the
+        # packed fp32 wire; refuse leaves it cannot carry exactly HERE,
+        # at wire-spec derivation time, instead of silently rounding
+        for j, p in enumerate(stage_params):
+            _check_wire_tree(p, f"stage {j} param")
+        _check_wire_tree(example_x, "pipeline input (example_x)",
+                         allow_abstract_32=True)
+
         # ---- per-stage param pack specs (static) ------------------------
         self._p_specs = [_tree_pack_spec(p) for p in stage_params]
         self._p_size = max(s[2] for s in self._p_specs) or 1
@@ -275,6 +349,9 @@ class HeteroPipeline:
             self._b_specs.append(_batched_pack_spec(boundary))
             boundary = jax.eval_shape(fn, stage_params[j], boundary,
                                       *extras_mb)
+            # computed inter-stage boundaries are abstract by
+            # construction — wide-int outputs refuse loudly here
+            _check_wire_tree(boundary, f"stage {j} output boundary")
         self._out_spec = _batched_pack_spec(boundary)   # last stage output
         self._w_size = max([s[2] for s in self._b_specs]
                            + [self._out_spec[2]])
@@ -425,3 +502,105 @@ class HeteroPipeline:
         [B, ...] (microbatching is internal).  Differentiable w.r.t.
         ``packed_params``."""
         return self._apply(packed_params, x, *extras)
+
+
+# ---------------------------------------------------------------------------
+# Gluon adapter: the pipeline as a trainable Block in the one donated step
+# ---------------------------------------------------------------------------
+
+_PIPELINE_BLOCK_CLS = None
+
+
+def _pipeline_block_cls():
+    """Build the PipelineBlock class lazily: gluon imports here (not at
+    module import) keep ``mxnet_tpu.parallel`` free of an import cycle
+    through the gluon package."""
+    global _PIPELINE_BLOCK_CLS
+    if _PIPELINE_BLOCK_CLS is not None:
+        return _PIPELINE_BLOCK_CLS
+
+    from .. import autograd as _ag
+    from ..context import current_context
+    from ..gluon.block import Block, jax_bridge
+    from ..gluon.parameter import Parameter
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import _wrap
+
+    class PipelineBlock(Block):
+        """A :class:`HeteroPipeline` as a gluon block: ONE trainable
+        parameter — the packed ``[n_stages, P]`` fp32 stage buffer —
+        so ``Trainer.compile_step`` traces the pipeline's scan-internal
+        microbatch schedule into the single donated step program (one
+        dispatch per step; N+1 per window under gradient accumulation).
+
+        The packed parameter is named ``pp_stages``: under a mesh with a
+        real ``pp`` axis, ``spmd.param_spec`` places it ``P('pp', None)``
+        (device *i* holds stage *i*'s weights) and the fused optimizer
+        updates it elementwise in packed space — exact, since packing is
+        a concat of fp32 leaves and padding sees zero grads.  Gradients
+        of weight-tied leaves (``pipe.tied``) are summed across stages
+        via :meth:`compiled_grad_transform`, which the TrainStep applies
+        inside the compiled program right after the vjp.
+
+        On the eager tape (compiled-step fallback) the forward routes
+        through :func:`gluon.block.jax_bridge`, so autograd still
+        differentiates the shard_map schedule; batch shape is fixed to
+        the wire derived at ``HeteroPipeline.__init__``.
+        """
+
+        def __init__(self, pipe: HeteroPipeline):
+            super().__init__()
+            self._pipe = pipe
+            packed = pipe.packed_params
+            ctx = current_context()
+            self.pp_stages = Parameter(
+                "pp_stages", shape=tuple(packed.shape), dtype="float32")
+            # the value IS the packed buffer — install it directly
+            # (the name-pattern default initializer doesn't know it)
+            self.pp_stages._load_init(_wrap(packed, ctx), ctx=[ctx])
+
+        @property
+        def pipe(self) -> HeteroPipeline:
+            return self._pipe
+
+        def unpack_stage_params(self):
+            """Per-stage param pytrees from the CURRENT parameter value
+            (``pipe.packed_params`` keeps only the initial buffer)."""
+            return self._pipe.unpack_stage_params(
+                self.pp_stages.data()._data)
+
+        def compiled_grad_transform(self, named_grads):
+            """TrainStep grad hook: sum tied-leaf gradient slices across
+            stages (Megatron-style tied embed/head) on the packed
+            cotangent.  Linear, so per-microbatch application under
+            accumulation equals application on the window sum."""
+            ties = getattr(self._pipe, "tied", ())
+            if not ties:
+                return named_grads
+            out = dict(named_grads)
+            for name, g in named_grads.items():
+                if name == "pp_stages" or name.endswith(".pp_stages"):
+                    out[name] = self._pipe.tie_grads(g, ties)
+            return out
+
+        def forward(self, x, *extras):
+            w = self.pp_stages.data()
+            if _ag.is_recording() and not isinstance(
+                    w._data, jax.core.Tracer):
+                return jax_bridge(self._pipe.apply, w, x,
+                                  *[e for e in extras])
+            ctx = x.ctx if isinstance(x, NDArray) else current_context()
+            raw = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                   for a in (x,) + tuple(extras)]
+            out = self._pipe.apply(w._data, *raw)
+            return jax.tree_util.tree_map(lambda l: _wrap(l, ctx), out)
+
+    _PIPELINE_BLOCK_CLS = PipelineBlock
+    return _PIPELINE_BLOCK_CLS
+
+
+def __getattr__(name):
+    if name == "PipelineBlock":
+        return _pipeline_block_cls()
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

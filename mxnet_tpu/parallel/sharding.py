@@ -20,8 +20,9 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ShardingPlan", "fsdp_plan", "tensor_parallel_plan",
-           "replicated_plan", "shard_array", "constraint",
-           "legalize_refusal_count", "reset_legalize_refusals"]
+           "expert_parallel_plan", "replicated_plan", "shard_array",
+           "constraint", "legalize_refusal_count",
+           "reset_legalize_refusals"]
 
 Spec = PartitionSpec
 
@@ -175,6 +176,18 @@ def fsdp_plan(axis: str = "fsdp", min_size: int = 1024) -> ShardingPlan:
             return PartitionSpec()
 
     return _FSDP()
+
+
+def expert_parallel_plan(axis: str = "ep") -> ShardingPlan:
+    """Expert parallelism (parallel/moe.py): expert weights — ``[E, ...]``
+    leaves under an ``expert.`` structural prefix — shard dim 0 over
+    ``axis``; everything else (gate, dense trunk) replicates.  The plan
+    form of the name-aware ``spmd.param_spec`` ep rule, for callers that
+    place params through a ShardingPlan."""
+    return ShardingPlan([
+        (r"(^|\.)expert\..*", PartitionSpec(axis)),
+        (r".*", PartitionSpec()),
+    ])
 
 
 def tensor_parallel_plan(axis: str = "tp") -> ShardingPlan:

@@ -50,6 +50,7 @@ sampling):
 """
 from __future__ import annotations
 
+import re as _re
 import threading
 import warnings
 from typing import Optional, Tuple
@@ -62,7 +63,8 @@ from .. import config as _config
 from .. import faults as _faults
 from .mesh import make_mesh
 
-__all__ = ["DATA_AXIS", "MODEL_AXIS", "TENSOR_AXIS", "mesh_for_store",
+__all__ = ["DATA_AXIS", "MODEL_AXIS", "TENSOR_AXIS", "PIPE_AXIS",
+           "EXPERT_AXIS", "model_axes_active", "mesh_for_store",
            "resolve_mesh", "batch_sharding", "replicated", "batch_spec_for",
            "param_spec", "param_sharding", "put_batch", "ensure_placed",
            "mesh_key", "reshard_count", "replicated_batch_count",
@@ -78,6 +80,19 @@ MODEL_AXIS = "fsdp"
 # the tensor-parallel axis: placement is model-code's move (via
 # sharding.constraint / a ShardingPlan), never implied by this module
 TENSOR_AXIS = "tp"
+# the pipeline axis: HeteroPipeline's packed [n_stages, P] stage buffer
+# shards dim 0 over it (device i holds stage i's weights); matched BY
+# NAME in param_spec — the packed parameter is canonically 'pp_stages'
+PIPE_AXIS = "pp"
+# the expert-parallel axis: MoE expert weights ([E, ...] leaves under an
+# 'expert.' structural prefix) shard dim 0 over it
+EXPERT_AXIS = "ep"
+
+# name-aware placement rules (param_spec): structural parameter names
+# matching these regexes take first-class-axis placement before the
+# shape-only FSDP rule is consulted
+_PIPE_PACKED_RE = _re.compile(r"(^|\.)pp_stages$")
+_EXPERT_RE = _re.compile(r"(^|\.)expert\.")
 
 # kvstore types whose reduce is the ICI-collective mesh path.  dist/
 # ps-lite-style stores stay host-driven and keep the eager fallback.
@@ -298,15 +313,37 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def param_spec(shape: Tuple[int, ...], mesh: Mesh,
-               min_size: Optional[int] = None) -> PartitionSpec:
-    """FSDP/ZeRO placement rule for one parameter / optimizer-state
-    leaf: shard the LARGEST dim the ``'fsdp'`` axis divides evenly.
+               min_size: Optional[int] = None,
+               name: Optional[str] = None) -> PartitionSpec:
+    """Placement rule for one parameter / optimizer-state leaf.
 
-    Leaves below ``min_size`` elements (``MXNET_FSDP_MIN_SIZE``) stay
-    replicated — sharding a LayerNorm bias buys nothing and costs an
-    all-gather.  A large leaf NO dim of which divides the axis degrades
-    to replication LOUDLY via the ``sharding.legalize_refusal`` idiom
-    (counted + warned once per shape), never an error mid-warmup."""
+    Name-aware first-class-axis rules run first (``name`` is the
+    structural parameter name when the caller knows it):
+
+    - ``pp_stages`` (HeteroPipeline's packed ``[n_stages, P]`` stage
+      buffer) → ``P('pp', None)`` when the mesh's ``pp`` axis equals the
+      stage count — device *i* holds stage *i*'s packed weights;
+    - ``expert.*`` leaves (MoE expert weights ``[E, ...]``) →
+      ``P('ep')`` on dim 0 when ``ep`` divides the expert count.
+
+    Otherwise the FSDP/ZeRO rule: shard the LARGEST dim the ``'fsdp'``
+    axis divides evenly.  Leaves below ``min_size`` elements
+    (``MXNET_FSDP_MIN_SIZE``) stay replicated — sharding a LayerNorm
+    bias buys nothing and costs an all-gather.  A large leaf NO dim of
+    which divides the axis degrades to replication LOUDLY via the
+    ``sharding.legalize_refusal`` idiom (counted + warned once per
+    shape), never an error mid-warmup."""
+    if name and shape:
+        n_pp = int(mesh.shape.get(PIPE_AXIS, 1))
+        if n_pp > 1 and _PIPE_PACKED_RE.search(name) \
+                and shape[0] == n_pp:
+            return PartitionSpec(PIPE_AXIS,
+                                 *([None] * (len(shape) - 1)))
+        n_ep = int(mesh.shape.get(EXPERT_AXIS, 1))
+        if n_ep > 1 and _EXPERT_RE.search(name) \
+                and shape[0] % n_ep == 0:
+            return PartitionSpec(EXPERT_AXIS,
+                                 *([None] * (len(shape) - 1)))
     if min_size is None:
         min_size = int(_config.get("MXNET_FSDP_MIN_SIZE"))
     n = int(mesh.shape.get(MODEL_AXIS, 1))
@@ -330,11 +367,20 @@ def param_spec(shape: Tuple[int, ...], mesh: Mesh,
                      loud=True)
 
 
-def param_sharding(shape: Tuple[int, ...], mesh: Mesh) -> NamedSharding:
+def param_sharding(shape: Tuple[int, ...], mesh: Mesh,
+                   name: Optional[str] = None) -> NamedSharding:
     """The ``NamedSharding`` a param/state leaf of ``shape`` takes on
-    ``mesh``: :func:`param_spec` when the mesh has a real ``fsdp``
-    axis, replicated otherwise."""
-    return NamedSharding(mesh, param_spec(shape, mesh))
+    ``mesh``: :func:`param_spec` (name-aware pp/ep rules, then the
+    ``fsdp`` shape rule), replicated otherwise."""
+    return NamedSharding(mesh, param_spec(shape, mesh, name=name))
+
+
+def model_axes_active(mesh: Mesh) -> bool:
+    """True when any model-side placement axis (``fsdp``/``pp``/``ep``)
+    is real (> 1) on ``mesh`` — the gate for per-leaf name/shape-aware
+    parameter placement in the compiled step."""
+    return any(int(mesh.shape.get(a, 1)) > 1
+               for a in (MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS))
 
 
 def batch_spec_for(shape: Tuple[int, ...], mesh: Mesh) -> PartitionSpec:

@@ -182,6 +182,7 @@ def test_shipped_pretrained_checkpoint_out_of_the_box(tmp_path):
                                        root=str(tmp_path / "empty"))
 
 
+@pytest.mark.slow   # ISSUE-20 wall: full-split exact reproduction
 def test_pretrained_real_data_accuracy_reproduces(tmp_path):
     """The shipped checkpoint carries MEASURED real-data accuracy (round-5
     VERDICT Missing #2 closure for an air-gapped environment: trained on
@@ -207,3 +208,22 @@ def test_pretrained_real_data_accuracy_reproduces(tmp_path):
     acc = correct / len(Xte)
     assert abs(acc - entry["test_acc"]) < 5e-3, (acc, entry["test_acc"])
     assert acc >= 0.9, f"real-data accuracy regressed: {acc}"
+
+
+def test_pretrained_real_data_accuracy_smoke(tmp_path):
+    """Tier-1 smoke for the slow full-split test above: same manifest,
+    same pretrained load, same hybridized forward — scored on the first
+    128 held-out images only."""
+    from mxnet_tpu.gluon.model_zoo import model_store
+    from mxnet_tpu.test_utils import load_digits_split
+
+    entry = model_store._shipped_manifest()["mobilenet0.25"]
+    assert entry.get("test_acc"), "manifest lacks measured accuracy"
+    net = vision.get_model("mobilenet0.25", pretrained=True,
+                           root=str(tmp_path))
+    net.hybridize()
+    _, _, Xte, Yte = load_digits_split()
+    Xte, Yte = Xte[:128], Yte[:128]
+    out = net(mx.nd.array(Xte)).asnumpy()
+    acc = float((out.argmax(axis=1) == Yte).mean())
+    assert acc >= 0.85, f"pretrained smoke accuracy regressed: {acc}"

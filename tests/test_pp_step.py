@@ -1,0 +1,521 @@
+"""Compiled pipeline stages (pp) as a first-class mesh axis in the one
+donated train step (ISSUE 20 tentpole).
+
+Covers the acceptance contract on the virtual 8-device CPU mesh:
+
+1. ``MXNET_SPMD_MESH='pp=P,dp=A,fsdp=B'`` resolves; ``spmd.param_spec``
+   places the packed ``pp_stages`` buffer ``P('pp', None)`` by name.
+2. ``PipelineBlock`` (HeteroPipeline as a gluon block) traces through
+   ``Trainer.compile_step`` as ONE donated dispatch per step — the
+   GPipe microbatch schedule is scan-INTERNAL — with 0 retraces and 0
+   steady-state reshards, and composes with PR-18 gradient
+   accumulation at the N+1-dispatch window budget.
+3. Parity: the pp×dp×fsdp trajectory matches a dense sequential oracle
+   (same packed parameter, stages composed without the pipeline) on
+   the single-chip step.
+4. Tied weights (``pipe.tied``) stay bit-identical across stages via
+   ``compiled_grad_transform`` applied inside the compiled program.
+5. Robustness composes: ``restore(like=)`` re-places the packed stage
+   buffer across a mesh-shape change, sentinel digests are invariant
+   to pp sharding, ``put_batch`` shards over dp ONLY, and a preemption
+   drain force-saves pp-sharded state.
+6. The wire-precision satellite: ``HeteroPipeline.__init__`` refuses
+   int leaves the packed fp32 wire cannot carry exactly (>= 2**24),
+   naming the offending leaf — replacing the old silent rounding in
+   ``_tree_pack`` / ``_batched_pack``.
+"""
+import contextlib
+import os
+import signal
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, cached_step, engine, gluon, preemption, \
+    sentinel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.context import current_context
+from mxnet_tpu.gluon.block import jax_bridge
+from mxnet_tpu.gluon.parameter import Parameter
+from mxnet_tpu.ndarray.ndarray import _wrap
+from mxnet_tpu.parallel import CheckpointManager, pipeline as pipe_mod, spmd
+from mxnet_tpu.parallel.elastic import run_elastic
+from mxnet_tpu.parallel.pipeline import (HeteroPipeline, PipelineBlock,
+                                         bubble_fraction)
+
+NDEV = len(jax.devices())
+
+pytestmark = pytest.mark.skipif(
+    NDEV < 8, reason="needs the virtual 8-device CPU mesh")
+
+DIM = 8
+
+
+@contextlib.contextmanager
+def _mesh_env(spec, min_size="1"):
+    saved = {k: os.environ.get(k)
+             for k in ("MXNET_SPMD_MESH", "MXNET_FSDP_MIN_SIZE")}
+    os.environ["MXNET_SPMD_MESH"] = spec
+    os.environ["MXNET_FSDP_MIN_SIZE"] = min_size
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _stages(n=2, seed=0, dim=DIM):
+    """n matmul+tanh stages with distinct weights."""
+    rng = onp.random.RandomState(seed)
+
+    def mk(i):
+        w = (rng.randn(dim, dim) * 0.3).astype(onp.float32)
+
+        def fn(params, x):
+            return jnp.tanh(x @ params["w"])
+
+        return fn, {"w": jnp.asarray(w)}
+
+    fns, params = zip(*[mk(i) for i in range(n)])
+    return list(fns), list(params)
+
+
+def _make_pipe(spec="pp=2,dp=2,fsdp=2", n=2, batch=4, num_micro=2, seed=0,
+               stage_params=None):
+    mesh = spmd.resolve_mesh(spec)
+    fns, params = _stages(n, seed)
+    if stage_params is not None:
+        params = stage_params
+    ex = jnp.zeros((batch, DIM), dtype=jnp.float32)
+    pipe = HeteroPipeline(fns, params, mesh, num_microbatches=num_micro,
+                          example_x=ex)
+    return pipe, fns, params, mesh
+
+
+def _loss_sum(net, x):
+    y = net(x)
+    return (y * y).sum()
+
+
+def _batch(batch=4, seed=3):
+    rng = onp.random.RandomState(seed)
+    return rng.randn(batch, DIM).astype(onp.float32)
+
+
+def _run_pp(spec, steps=4, accum=1, seed=0, ties=None, batch=4):
+    """Train a 2-stage PipelineBlock `steps` windows under `spec`."""
+    with _mesh_env(spec):
+        pipe, _fns, _params, _mesh = _make_pipe(spec, batch=batch,
+                                                seed=seed)
+        if ties is not None:
+            pipe.tied = ties
+        blk = PipelineBlock(pipe)
+        trainer = gluon.Trainer(blk.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9},
+                                kvstore="tpu")
+        step = trainer.compile_step(blk, _loss_sum, accum_steps=accum)
+        rng = onp.random.RandomState(7)
+        for _ in range(steps):
+            for _m in range(accum):
+                x = rng.randn(batch, DIM).astype(onp.float32)
+                step(mx.nd.array(x), batch_size=batch)
+                assert step.last_step_compiled, step.last_fallback_reason
+        engine.waitall()
+    return blk, trainer, step, pipe
+
+
+class _DenseOracle(gluon.Block):
+    """The same packed parameter trained WITHOUT the pipeline schedule:
+    stages composed sequentially on the whole batch.  Named 'weight'
+    (not 'pp_stages') so no placement rule fires on the oracle."""
+
+    def __init__(self, pipe, fns, packed_host):
+        super().__init__()
+        self._pipe, self._fns = pipe, fns
+        ctx = current_context()
+        self.weight = Parameter("weight", shape=tuple(packed_host.shape),
+                                dtype="float32")
+        self.weight._load_init(_wrap(jnp.asarray(packed_host), ctx),
+                               ctx=[ctx])
+
+    def _fn(self, w, x):
+        parts = self._pipe.unpack_stage_params(w)
+        for fn, p in zip(self._fns, parts):
+            x = fn(p, x)
+        return x
+
+    def forward(self, x):
+        w = self.weight.data()
+        if autograd.is_recording() and not isinstance(
+                w._data, jax.core.Tracer):
+            return jax_bridge(self._fn, w, x)
+        ctx = x.ctx
+        return _wrap(self._fn(w._data, x._data), ctx)
+
+
+# ---------------------------------------------------------------------------
+# mesh resolution + placement rules
+# ---------------------------------------------------------------------------
+
+def test_mesh_resolution_pp_ep(monkeypatch):
+    monkeypatch.setenv("MXNET_SPMD_MESH", "pp=2,dp=2,fsdp=2")
+    m = spmd.resolve_mesh()
+    assert (m.shape["pp"], m.shape["dp"], m.shape["fsdp"]) == (2, 2, 2)
+    monkeypatch.setenv("MXNET_SPMD_MESH", "ep=4,dp=2")
+    m = spmd.resolve_mesh()
+    assert (m.shape["ep"], m.shape["dp"]) == (4, 2)
+    # every first-class axis in ONE spec (the tentpole's headline mesh)
+    monkeypatch.setenv("MXNET_SPMD_MESH", "pp=2,dp=2,fsdp=1,ep=2")
+    m = spmd.resolve_mesh()
+    assert (m.shape["pp"], m.shape["dp"], m.shape["ep"]) == (2, 2, 2)
+
+
+def test_param_spec_pp_and_ep_name_rules(monkeypatch):
+    monkeypatch.setenv("MXNET_SPMD_MESH", "pp=2,dp=2,fsdp=2")
+    mesh = spmd.resolve_mesh()
+    # the packed stage buffer goes P('pp', None) — BY NAME, leading dim
+    # must equal the stage count
+    assert spmd.param_spec((2, 64), mesh, min_size=1,
+                           name="pp_stages") == P("pp", None)
+    assert spmd.param_spec((2, 64), mesh, min_size=1,
+                           name="body.pp_stages") == P("pp", None)
+    # wrong leading dim -> falls through to the fsdp rule
+    assert spmd.param_spec((4, 64), mesh, min_size=1,
+                           name="pp_stages") != P("pp", None)
+    # unnamed leaves never take the pp rule
+    assert spmd.param_spec((2, 64), mesh, min_size=1) \
+        == P(None, "fsdp")
+    monkeypatch.setenv("MXNET_SPMD_MESH", "ep=4,dp=2")
+    mesh = spmd.resolve_mesh()
+    assert spmd.param_spec((4, 8, 16), mesh, min_size=1,
+                           name="expert.ffn_1.weight") \
+        == P("ep", None, None)
+    assert spmd.param_spec((8, 4, 16), mesh, min_size=1,
+                           name="expert.ffn_1.weight") \
+        == P("ep", None, None)          # 8 % 4 == 0 still shards
+    assert spmd.param_spec((6, 8, 16), mesh, min_size=1,
+                           name="expert.ffn_1.weight") \
+        != P("ep", None, None)          # indivisible expert count
+    assert spmd.param_spec((8, 16), mesh, min_size=1,
+                           name="gate.weight") == P()
+    assert spmd.model_axes_active(mesh)
+    assert spmd.model_axes_active(spmd.resolve_mesh("dp=8")) is False
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: one donated dispatch per step, scan-internal microbatching
+# ---------------------------------------------------------------------------
+
+def test_pp_one_launch_no_retrace_no_reshard():
+    spmd.reset_counters()
+    with _mesh_env("pp=2,dp=2,fsdp=2"):
+        pipe, _fns, _params, _mesh = _make_pipe()
+        blk = PipelineBlock(pipe)
+        trainer = gluon.Trainer(blk.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9},
+                                kvstore="tpu")
+        step = trainer.compile_step(blk, _loss_sum)
+        x = _batch()
+        step(mx.nd.array(x), batch_size=4)          # warm
+        assert step.last_step_compiled, step.last_fallback_reason
+        engine.waitall()
+        d0, t0 = cached_step.dispatch_count(), cached_step.trace_count()
+        r0 = spmd.reshard_count()
+        for _ in range(5):
+            step(mx.nd.array(x), batch_size=4)
+            assert step.last_step_compiled, step.last_fallback_reason
+        engine.waitall()
+        assert cached_step.dispatch_count() - d0 == 5
+        assert cached_step.trace_count() - t0 == 0
+        assert spmd.reshard_count() - r0 == 0
+        assert spmd.replicated_batch_count() == 0
+        # device i holds stage i: the packed buffer is sharded over pp
+        w = blk.pp_stages.data()._data
+        assert w.sharding.spec == P("pp", None)
+        assert w.sharding.shard_shape(w.shape)[0] == 1
+
+
+def test_pp_accum_n_plus_one_dispatches():
+    with _mesh_env("pp=2,dp=2,fsdp=2"):
+        pipe, _fns, _params, _mesh = _make_pipe()
+        blk = PipelineBlock(pipe)
+        trainer = gluon.Trainer(blk.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9},
+                                kvstore="tpu")
+        step = trainer.compile_step(blk, _loss_sum, accum_steps=2)
+        x = _batch()
+        for _ in range(2):                           # warm window
+            step(mx.nd.array(x), batch_size=4)
+            assert step.last_step_compiled, step.last_fallback_reason
+        engine.waitall()
+        d0, t0 = cached_step.dispatch_count(), cached_step.trace_count()
+        windows = 3
+        for _ in range(2 * windows):
+            step(mx.nd.array(x), batch_size=4)
+        engine.waitall()
+        # N+1 per window: 2 microbatch grad programs + 1 fused update
+        assert cached_step.dispatch_count() - d0 == (2 + 1) * windows
+        assert cached_step.trace_count() - t0 == 0
+
+
+def test_pp_parity_vs_dense_oracle():
+    """The pipeline schedule changes WHEN each microbatch crosses each
+    stage, not WHAT is computed: the pp×dp×fsdp compiled trajectory
+    matches a dense sequential oracle on the packed parameter."""
+    blk, _tr, _step, pipe = _run_pp("pp=2,dp=2,fsdp=2", steps=4, seed=0)
+    # oracle: same initial packed buffer, same stage fns, no pipeline
+    with _mesh_env("1"):
+        pipe0, fns, _params, _mesh = _make_pipe(seed=0)
+        packed_host = onp.asarray(pipe0.packed_params)
+        oracle = _DenseOracle(pipe0, fns, packed_host)
+        trainer = gluon.Trainer(oracle.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+        step = trainer.compile_step(oracle, _loss_sum)
+        rng = onp.random.RandomState(7)
+        for _ in range(4):
+            x = rng.randn(4, DIM).astype(onp.float32)
+            step(mx.nd.array(x), batch_size=4)
+        engine.waitall()
+    got = blk.pp_stages.data().asnumpy()
+    want = oracle.weight.data().asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=5e-6)
+
+
+def test_pp_bit_exact_run_to_run():
+    a, _t, _s, _p = _run_pp("pp=2,dp=2,fsdp=2", steps=3, seed=1)
+    b, _t, _s, _p = _run_pp("pp=2,dp=2,fsdp=2", steps=3, seed=1)
+    assert onp.array_equal(a.pp_stages.data().asnumpy(),
+                           b.pp_stages.data().asnumpy())
+
+
+def test_pp_tied_grads_stay_tied():
+    """Stages 0 and 1 share leaf 'w' (started equal): the in-program
+    compiled_grad_transform sums the tied slices, so the copies stay
+    BIT-identical across updates; without ties they diverge."""
+    rng = onp.random.RandomState(5)
+    w0 = (rng.randn(DIM, DIM) * 0.3).astype(onp.float32)
+    shared = [{"w": jnp.asarray(w0)}, {"w": jnp.asarray(w0)}]
+
+    def run(ties):
+        with _mesh_env("pp=2,dp=2,fsdp=2"):
+            mesh = spmd.resolve_mesh()
+            fns, _ = _stages(2)
+            ex = jnp.zeros((4, DIM), dtype=jnp.float32)
+            pipe = HeteroPipeline(fns, [dict(p) for p in shared], mesh,
+                                  num_microbatches=2, example_x=ex)
+            if ties:
+                pipe.tied = ties
+            blk = PipelineBlock(pipe)
+            trainer = gluon.Trainer(blk.collect_params(), "sgd",
+                                    {"learning_rate": 0.05}, kvstore="tpu")
+            step = trainer.compile_step(blk, _loss_sum)
+            rng2 = onp.random.RandomState(11)
+            for _ in range(3):
+                x = rng2.randn(4, DIM).astype(onp.float32)
+                step(mx.nd.array(x), batch_size=4)
+                assert step.last_step_compiled, step.last_fallback_reason
+            engine.waitall()
+            w = blk.pp_stages.data().asnumpy()
+            o0, n0 = pipe.leaf_slice(0, "w")
+            o1, n1 = pipe.leaf_slice(1, "w")
+            return w[0, o0:o0 + n0], w[1, o1:o1 + n1]
+
+    s0, s1 = run((((0, "w"), (1, "w")),))
+    assert onp.array_equal(s0, s1)
+    u0, u1 = run(())
+    assert not onp.array_equal(u0, u1)
+
+
+def test_pp_batch_shards_dp_only():
+    spmd.reset_counters()
+    with _mesh_env("pp=2,dp=2,fsdp=2"):
+        mesh = spmd.resolve_mesh()
+        assert spmd.batch_sharding(mesh).spec == P("dp")
+        placed = spmd.put_batch(
+            jnp.arange(6 * DIM, dtype=jnp.float32).reshape(6, DIM), mesh)
+        # 6 rows divide dp=2 (NOT the 8-device product): shard cleanly
+        assert placed.sharding.shard_shape(placed.shape) == (3, DIM)
+    assert spmd.replicated_batch_count() == 0
+
+
+def test_jax_bridge_differentiates_pure_fn():
+    """gluon.block.jax_bridge splices a pure-jax fn into the eager tape
+    as one vjp node — the bridge PipelineBlock/MoEBlock forwards ride
+    on the compiled-step fallback path."""
+    x = mx.nd.array(onp.linspace(0.1, 1.0, 6, dtype=onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = jax_bridge(jnp.sin, x)
+        loss = (y * y).sum()
+    autograd.backward([loss])
+    xs = x.asnumpy()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                2 * onp.sin(xs) * onp.cos(xs), rtol=1e-6)
+
+
+def test_bubble_fraction_math():
+    assert bubble_fraction(1, 4) == 0.0
+    assert bubble_fraction(2, 2) == pytest.approx(1 / 3)
+    assert bubble_fraction(4, 12) == pytest.approx(3 / 15)
+    # more microbatches -> smaller bubble, monotonically
+    assert bubble_fraction(4, 32) < bubble_fraction(4, 8)
+
+
+# ---------------------------------------------------------------------------
+# robustness composition
+# ---------------------------------------------------------------------------
+
+def test_pp_restore_across_mesh_change(tmp_path):
+    """Save the packed stage buffer sharded P('pp', None) on a
+    pp=2,dp=2,fsdp=2 mesh; restore(like=) re-places it on a DIFFERENT
+    mesh shape (pp=2,dp=4) bit-exactly."""
+    blk, _tr, _step, _pipe = _run_pp("pp=2,dp=2,fsdp=2", steps=2, seed=2)
+    tree = {"pp_stages": blk.pp_stages.data()._data}
+    assert tree["pp_stages"].sharding.spec == P("pp", None)
+    cm = CheckpointManager(str(tmp_path), async_save=False)
+    cm.save(1, tree, block=True)
+    mesh2 = spmd.resolve_mesh("pp=2,dp=4")
+    sh2 = NamedSharding(mesh2, P("pp", None))
+    like = {"pp_stages": jax.device_put(
+        jnp.zeros(tree["pp_stages"].shape, jnp.float32), sh2)}
+    restored, step_no = cm.restore(like=like)
+    assert step_no == 1
+    assert restored["pp_stages"].sharding.spec == P("pp", None)
+    assert restored["pp_stages"].sharding.mesh.shape["dp"] == 4
+    onp.testing.assert_array_equal(onp.asarray(restored["pp_stages"]),
+                                   onp.asarray(tree["pp_stages"]))
+    cm.close()
+
+
+def test_sentinel_digest_invariant_to_pp_sharding(monkeypatch):
+    """The integer digest fold cannot tell pp-sharded from replicated
+    state: a pipeline restart on a different mesh shape never fakes a
+    corruption verdict."""
+    rng = onp.random.RandomState(0)
+    host = {"pp_stages": rng.randn(2, 64).astype(onp.float32)}
+    base = sentinel.tree_digest(host)
+    for spec, pspec in (("pp=2,dp=2,fsdp=2", P("pp", None)),
+                        ("pp=2,dp=4", P("pp", None)),
+                        ("dp=8", P())):
+        monkeypatch.setenv("MXNET_SPMD_MESH", spec)
+        mesh = spmd.resolve_mesh()
+        placed = {"pp_stages": jax.device_put(
+            host["pp_stages"], NamedSharding(mesh, pspec))}
+        assert sentinel.tree_digest(placed) == base, spec
+
+
+def test_preemption_drain_force_saves_pp_state(tmp_path):
+    """A SIGTERM mid-run force-saves the LAST COMPLETED step of
+    pp-sharded state through the elastic loop — the drain path does not
+    care that leaves live P('pp', None) on a multi-axis mesh."""
+    mesh = spmd.resolve_mesh("pp=2,dp=2,fsdp=2")
+    sh = NamedSharding(mesh, P("pp", None))
+    w0 = jax.device_put(jnp.zeros((2, 64), jnp.float32), sh)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=True)
+    preemption.install()
+    kill_at = 4
+    try:
+        def step(state, i):
+            if int(state["i"]) == kill_at:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return {"w": state["w"] + 1.0, "i": state["i"] + 1}
+
+        with pytest.raises(preemption.Preempted):
+            run_elastic(step, {"w": w0, "i": onp.int64(0)},
+                        list(range(10)), mgr, save_every=3)
+        assert mgr.latest_step() == kill_at
+        restored, step_no = mgr.restore(
+            like={"w": w0, "i": onp.int64(0)})
+        assert step_no == kill_at
+        assert restored["w"].sharding.spec == P("pp", None)
+        onp.testing.assert_array_equal(
+            onp.asarray(restored["w"]),
+            onp.full((2, 64), float(kill_at), onp.float32))
+    finally:
+        preemption.reset()
+        preemption.uninstall()
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# the wire-precision satellite
+# ---------------------------------------------------------------------------
+
+def test_wire_rejects_wide_int_param_by_name():
+    mesh = spmd.resolve_mesh("pp=1,dp=1")
+
+    def fn(params, x):
+        return x
+
+    big = {"count": jnp.asarray([2 ** 24 + 1], dtype=jnp.int32)}
+    with pytest.raises(MXNetError, match=r"stage 0 param.*count.*2\*\*24"):
+        HeteroPipeline([fn], [big], mesh, num_microbatches=1,
+                       example_x=jnp.zeros((2, 4), jnp.float32))
+
+
+def test_wire_rejects_abstract_int_boundary():
+    """A stage OUTPUT of wide-int dtype is abstract at wire-spec
+    derivation time (eval_shape) — it refuses, telling the user to cast
+    at the boundary."""
+    mesh = spmd.resolve_mesh("pp=2,dp=1")
+
+    def s0(params, x):
+        return jnp.argmax(x, axis=-1).astype(jnp.int32)
+
+    def s1(params, ids):
+        return ids.astype(jnp.float32)
+
+    with pytest.raises(MXNetError,
+                       match="stage 0 output boundary.*int32"):
+        HeteroPipeline([s0, s1], [{}, {}], mesh, num_microbatches=1,
+                       example_x=jnp.zeros((2, 4), jnp.float32))
+
+
+def test_wire_allows_int32_token_inputs():
+    """The documented token-id path: int32 example INPUTS pass (vocab
+    ids are far below 2**24) and round-trip the wire exactly."""
+    mesh = spmd.resolve_mesh("pp=2,dp=1")
+    rng = onp.random.RandomState(0)
+    emb = (rng.randn(32, DIM) * 0.1).astype(onp.float32)
+
+    def s0(params, toks):
+        return params["emb"][toks]
+
+    def s1(params, h):
+        return jnp.tanh(h @ params["w"])
+
+    toks = jnp.asarray(rng.randint(0, 32, size=(4, 3)), dtype=jnp.int32)
+    pipe = HeteroPipeline(
+        [s0, s1],
+        [{"emb": jnp.asarray(emb)},
+         {"w": jnp.eye(DIM, dtype=jnp.float32)}],
+        mesh, num_microbatches=2,
+        example_x=jax.ShapeDtypeStruct((4, 3), jnp.int32))
+    out = pipe.apply(pipe.packed_params, toks)
+    want = onp.tanh(emb[onp.asarray(toks)])
+    onp.testing.assert_allclose(onp.asarray(out), want, rtol=1e-6,
+                                atol=1e-6)
+
+
+def test_wire_narrow_and_small_ints_pass():
+    mesh = spmd.resolve_mesh("pp=1,dp=1")
+
+    def fn(params, x):
+        return x * params["scale"].astype(jnp.float32).sum()
+
+    ok = {"scale": jnp.asarray([3, -7], dtype=jnp.int32),   # < 2**24
+          "flags": jnp.asarray([1, 0], dtype=jnp.int16)}    # narrow
+    pipe = HeteroPipeline([fn], [ok], mesh, num_microbatches=1,
+                          example_x=jnp.zeros((2, 4), jnp.float32))
+    # values really round-trip the packed fp32 buffer exactly
+    (got,) = pipe.unpack_stage_params()
+    onp.testing.assert_array_equal(onp.asarray(got["scale"]), [3, -7])
+    onp.testing.assert_array_equal(onp.asarray(got["flags"]), [1, 0])

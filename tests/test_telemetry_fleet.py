@@ -160,6 +160,7 @@ def test_rotation_deletes_oldest_shards(tmp_path, monkeypatch):
 # CLI + merge tool
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # ISSUE-20 wall: three CLI subprocesses
 def test_cli_report_trace_merge(tmp_path):
     d = tmp_path / "shards"
     d.mkdir()
@@ -191,6 +192,26 @@ def test_cli_report_trace_merge(tmp_path):
         capture_output=True, text=True, timeout=240, env=env, cwd=REPO)
     assert r.returncode == 0, r.stderr[-1500:]
     tr = json.loads(r.stdout)
+    assert [e["kind"] for e in tr["records"]] == ["admit", "retire"]
+
+
+def test_merge_trace_in_process_smoke(tmp_path):
+    """Tier-1 smoke for the slow CLI test above: the same shard fixture
+    folded through the library entry points the CLI wraps — merge,
+    chrome export, and per-trace stitch — without subprocesses."""
+    from mxnet_tpu import telemetry as T
+    d = tmp_path / "shards"
+    d.mkdir()
+    _fake_shard(str(d), 0, 11, {"a.total": 4}, {"a.total": "cumulative"},
+                events=[{"kind": "admit", "name": "eng", "seq": 1,
+                         "t_us": 1, "trace_id": "b-1"},
+                        {"kind": "retire", "name": "eng", "seq": 2,
+                         "t_us": 9, "trace_id": "b-1"}])
+    merged = T.merge(str(d))
+    assert merged["counters"]["a.total"] == 4
+    chrome = T.merge_chrome_trace(str(d), merged)
+    assert "traceEvents" in chrome
+    tr = T._trace_from_merge(merged, "b-1")
     assert [e["kind"] for e in tr["records"]] == ["admit", "retire"]
 
 

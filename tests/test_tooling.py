@@ -245,6 +245,9 @@ def test_flakiness_checker_stable_test(tmp_path):
     assert "stable across 2" in r.stdout
 
 
+# ISSUE-20 wall: 4 checker subprocesses; the stable 2-run variant
+# above stays tier-1 through the same tool path
+@pytest.mark.slow
 def test_flakiness_checker_detects_seed_failure(tmp_path):
     target = tmp_path / "test_seeded.py"
     target.write_text(

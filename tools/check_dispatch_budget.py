@@ -131,6 +131,25 @@ FSDP_BUDGET = {"compiled_launches_per_step": 1, "eager_invokes_per_step": 0,
                "host_syncs_per_step": 0, "reshards_after_warm": 0,
                "replicated_batches": 0, "accum_extra_dispatches": 0,
                "accum_retraces_after_warm": 0}
+# the PP budget (ISSUE 20, docs/PERF.md "Every-axis mesh"): with
+# MXNET_SPMD_MESH='pp=2,dp=2,fsdp=2' a PipelineBlock-backed step stays
+# ONE compiled launch — the GPipe microbatch schedule is scan-INTERNAL,
+# never a per-stage or per-microbatch host dispatch — with 0 retraces,
+# 0 steady-state reshards (the packed stage buffer is placed P('pp')
+# once), batches sharded over dp only, and PR-18 accumulation still at
+# exactly N+1 dispatches per window on the pp mesh
+PP_BUDGET = {"compiled_launches_per_step": 1, "eager_invokes_per_step": 0,
+             "group_launches_per_step": 0, "retraces_after_warm": 0,
+             "reshards_after_warm": 0, "replicated_batches": 0,
+             "accum_extra_dispatches": 0, "accum_retraces_after_warm": 0}
+# the MOE budget (ISSUE 20, docs/PERF.md "Every-axis mesh"): with
+# MXNET_SPMD_MESH='ep=4,dp=2' an MoEBlock step — dispatch/combine,
+# expert einsums, the load-balance aux head folded into the loss, and
+# the fused update over ep-sharded expert weights — stays ONE compiled
+# launch with 0 retraces and 0 steady-state reshards
+MOE_BUDGET = {"compiled_launches_per_step": 1, "eager_invokes_per_step": 0,
+              "group_launches_per_step": 0, "retraces_after_warm": 0,
+              "reshards_after_warm": 0, "replicated_batches": 0}
 STEPS = 5
 INFER_REQUESTS = 24
 INFER_MAXLEN = 16
@@ -410,6 +429,189 @@ def _measure_fsdp() -> dict:
         out["accum_extra_dispatches"] = per_window - 3.0
         out["accum_retraces_after_warm"] = cached_step.trace_count() - at0
         return out
+    finally:
+        if prev_mesh is None:
+            os.environ.pop("MXNET_SPMD_MESH", None)
+        else:
+            os.environ["MXNET_SPMD_MESH"] = prev_mesh
+        if prev_min is None:
+            os.environ.pop("MXNET_FSDP_MIN_SIZE", None)
+        else:
+            os.environ["MXNET_FSDP_MIN_SIZE"] = prev_min
+
+
+def _measure_pp() -> dict:
+    """pp×dp×fsdp lane: a 2-stage PipelineBlock under
+    MXNET_SPMD_MESH='pp=2,dp=2,fsdp=2' — the scan-internal GPipe
+    schedule keeps the step at ONE donated launch with zero retraces
+    and zero steady-state reshards, the packed stage buffer sharded
+    one-stage-per-pp-group.  Accum sub-lane: accum_steps=2 on the same
+    mesh pays exactly 3 dispatches per window."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import cached_step, gluon
+    from mxnet_tpu.ndarray import ndarray as _ndmod
+    from mxnet_tpu.optimizer import fused
+    from mxnet_tpu.parallel import pipeline as pipe_mod, spmd
+
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        return {"mode": "pp", "skipped": f"only {n_dev} device(s)"}
+    prev_mesh = os.environ.get("MXNET_SPMD_MESH")
+    prev_min = os.environ.get("MXNET_FSDP_MIN_SIZE")
+    os.environ["MXNET_SPMD_MESH"] = "pp=2,dp=2,fsdp=2"
+    os.environ["MXNET_FSDP_MIN_SIZE"] = "1"
+    try:
+        def build(seed):
+            mesh = spmd.resolve_mesh()
+            rng = onp.random.RandomState(seed)
+            ws = [jnp.asarray((rng.randn(8, 8) * 0.3)
+                              .astype(onp.float32)) for _ in range(2)]
+
+            def stage(params, x):
+                return jnp.tanh(x @ params["w"])
+
+            pipe = pipe_mod.HeteroPipeline(
+                [stage, stage], [{"w": w} for w in ws], mesh,
+                num_microbatches=2,
+                example_x=jnp.zeros((4, 8), jnp.float32))
+            blk = pipe_mod.PipelineBlock(pipe)
+            trainer = gluon.Trainer(blk.collect_params(), "sgd",
+                                    {"learning_rate": 0.05,
+                                     "momentum": 0.9}, kvstore="tpu")
+            loss_fn = lambda n, x: ((n(x)) ** 2).sum()
+            data = mx.nd.array(rng.randn(4, 8).astype(onp.float32))
+            return blk, trainer, loss_fn, data
+
+        blk, trainer, loss_fn, data = build(seed=11)
+        step = trainer.compile_step(blk, loss_fn)
+        loss = step(data, batch_size=4)                 # warm
+        float(loss.asnumpy().ravel()[0])
+        packed = blk.pp_stages.data()._data
+        shard = packed.sharding.shard_shape(packed.shape)
+        inv0, d0, f0, t0 = (_ndmod.invoke_count(),
+                            cached_step.dispatch_count(),
+                            fused.dispatch_count(),
+                            cached_step.trace_count())
+        r0, b0 = spmd.reshard_count(), spmd.replicated_batch_count()
+        for _ in range(STEPS):
+            loss = step(data, batch_size=4)
+        r1, b1 = spmd.reshard_count(), spmd.replicated_batch_count()
+        float(loss.asnumpy().ravel()[0])
+        out = {
+            "mode": "pp",
+            "skipped": None,
+            "used_compiled": step.last_step_compiled,
+            "mesh_active": step.mesh is not None,
+            "stage_sharded": packed.sharding.spec
+            and packed.sharding.spec[0] == "pp" and shard[0] == 1,
+            "bubble_fraction": pipe_mod.bubble_fraction(2, 2),
+            "eager_invokes_per_step":
+                (_ndmod.invoke_count() - inv0) / STEPS,
+            "compiled_launches_per_step":
+                (cached_step.dispatch_count() - d0) / STEPS,
+            "group_launches_per_step":
+                (fused.dispatch_count() - f0) / STEPS,
+            "retraces_after_warm": cached_step.trace_count() - t0,
+            "reshards_after_warm": r1 - r0,
+            "replicated_batches": b1 - b0,
+        }
+        # accum sub-lane: N+1 dispatches per window on the pp mesh
+        blk2, tr2, loss2, d2 = build(seed=12)
+        astep = tr2.compile_step(blk2, loss2, accum_steps=2)
+        for _ in range(2):                              # warm one window
+            loss = astep(d2, batch_size=4)
+        float(loss.asnumpy().ravel()[0])
+        ad0, at0 = cached_step.dispatch_count(), cached_step.trace_count()
+        windows = 3
+        for _ in range(2 * windows):
+            loss = astep(d2, batch_size=4)
+        float(loss.asnumpy().ravel()[0])
+        per_window = (cached_step.dispatch_count() - ad0) / windows
+        out["accum_used_compiled"] = astep.last_step_compiled
+        out["accum_dispatches_per_window"] = per_window
+        out["accum_extra_dispatches"] = per_window - 3.0
+        out["accum_retraces_after_warm"] = cached_step.trace_count() - at0
+        return out
+    finally:
+        if prev_mesh is None:
+            os.environ.pop("MXNET_SPMD_MESH", None)
+        else:
+            os.environ["MXNET_SPMD_MESH"] = prev_mesh
+        if prev_min is None:
+            os.environ.pop("MXNET_FSDP_MIN_SIZE", None)
+        else:
+            os.environ["MXNET_FSDP_MIN_SIZE"] = prev_min
+
+
+def _measure_moe() -> dict:
+    """ep×dp lane: an MoEBlock (4 experts, top-2 routing) under
+    MXNET_SPMD_MESH='ep=4,dp=2' — gating, dispatch/combine, the
+    ep-sharded expert einsums, the folded aux head, and the fused
+    update all inside ONE donated launch per step."""
+    import jax
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import cached_step, gluon
+    from mxnet_tpu.ndarray import ndarray as _ndmod
+    from mxnet_tpu.optimizer import fused
+    from mxnet_tpu.parallel import moe as moe_mod, spmd
+
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        return {"mode": "moe", "skipped": f"only {n_dev} device(s)"}
+    prev_mesh = os.environ.get("MXNET_SPMD_MESH")
+    prev_min = os.environ.get("MXNET_FSDP_MIN_SIZE")
+    os.environ["MXNET_SPMD_MESH"] = "ep=4,dp=2"
+    os.environ["MXNET_FSDP_MIN_SIZE"] = "1"
+    try:
+        net = moe_mod.MoEBlock(units=8, hidden=16, num_experts=4, k=2)
+        net.initialize(mx.init.Xavier())
+        rng = onp.random.RandomState(13)
+        for _name, p in sorted(net.collect_params().items()):
+            p.data()._set_data(
+                mx.nd.array(rng.randn(*p.shape).astype(onp.float32)
+                            * 0.2)._data)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01, "momentum": 0.9},
+                                kvstore="tpu")
+        loss_fn = lambda n, x: ((n(x)) ** 2).sum()
+        data = mx.nd.array(rng.randn(4, 6, 8).astype(onp.float32))
+        step = trainer.compile_step(net, loss_fn)
+        loss = step(data, batch_size=4)                 # warm
+        float(loss.asnumpy().ravel()[0])
+        ew = net.collect_params()["expert.ffn_1.weight"].data()._data
+        inv0, d0, f0, t0 = (_ndmod.invoke_count(),
+                            cached_step.dispatch_count(),
+                            fused.dispatch_count(),
+                            cached_step.trace_count())
+        r0, b0 = spmd.reshard_count(), spmd.replicated_batch_count()
+        for _ in range(STEPS):
+            loss = step(data, batch_size=4)
+        r1, b1 = spmd.reshard_count(), spmd.replicated_batch_count()
+        float(loss.asnumpy().ravel()[0])
+        return {
+            "mode": "moe",
+            "skipped": None,
+            "used_compiled": step.last_step_compiled,
+            "mesh_active": step.mesh is not None,
+            "expert_sharded": ew.sharding.spec
+            and ew.sharding.spec[0] == "ep"
+            and ew.sharding.shard_shape(ew.shape)[0] == 1,
+            "eager_invokes_per_step":
+                (_ndmod.invoke_count() - inv0) / STEPS,
+            "compiled_launches_per_step":
+                (cached_step.dispatch_count() - d0) / STEPS,
+            "group_launches_per_step":
+                (fused.dispatch_count() - f0) / STEPS,
+            "retraces_after_warm": cached_step.trace_count() - t0,
+            "reshards_after_warm": r1 - r0,
+            "replicated_batches": b1 - b0,
+        }
     finally:
         if prev_mesh is None:
             os.environ.pop("MXNET_SPMD_MESH", None)
@@ -891,6 +1093,27 @@ def main() -> int:
               f"accum 2 -> {fsdp['accum_dispatches_per_window']:.1f} "
               f"dispatches/window, "
               f"{fsdp['accum_retraces_after_warm']} retraces")
+    pp = _measure_pp()
+    if pp["skipped"]:
+        print(f"pp         SKIPPED ({pp['skipped']})")
+    else:
+        print(f"{'pp':<10} pp=2,dp=2,fsdp=2 -> "
+              f"{pp['compiled_launches_per_step']:.1f} launch/step, "
+              f"{pp['retraces_after_warm']} retraces, "
+              f"{pp['reshards_after_warm']} reshards, theoretical "
+              f"bubble {pp['bubble_fraction']:.2f}; accum 2 -> "
+              f"{pp['accum_dispatches_per_window']:.1f} "
+              f"dispatches/window, "
+              f"{pp['accum_retraces_after_warm']} retraces")
+    moe = _measure_moe()
+    if moe["skipped"]:
+        print(f"moe        SKIPPED ({moe['skipped']})")
+    else:
+        print(f"{'moe':<10} ep=4,dp=2 -> "
+              f"{moe['compiled_launches_per_step']:.1f} launch/step, "
+              f"{moe['retraces_after_warm']} retraces, "
+              f"{moe['reshards_after_warm']} reshards, experts "
+              f"{'sharded' if moe['expert_sharded'] else 'REPLICATED'}")
     # program-store lane: all the steady-state runs above went through
     # the store — they must not have evicted anything
     ev_after_warm = sum(
@@ -1046,6 +1269,39 @@ def main() -> int:
             if fsdp[key] > budget:
                 failures.append(
                     f"fsdp {key} = {fsdp[key]} exceeds budget {budget}")
+    if not pp["skipped"]:
+        if not pp["used_compiled"]:
+            failures.append("pp mode fell back to the eager tape")
+        if not pp["accum_used_compiled"]:
+            failures.append(
+                "pp accumulation mode fell back to the eager tape")
+        if not pp["mesh_active"]:
+            failures.append(
+                "pp lane: kvstore='tpu' did not resolve a "
+                "pp=2,dp=2,fsdp=2 mesh")
+        if not pp["stage_sharded"]:
+            failures.append(
+                "pp lane: packed stage buffer is not one-stage-per-pp-"
+                "group (expected P('pp') with shard dim 0 == 1)")
+        for key, budget in PP_BUDGET.items():
+            if pp[key] > budget:
+                failures.append(
+                    f"pp {key} = {pp[key]} exceeds budget {budget}")
+    if not moe["skipped"]:
+        if not moe["used_compiled"]:
+            failures.append("moe mode fell back to the eager tape")
+        if not moe["mesh_active"]:
+            failures.append(
+                "moe lane: kvstore='tpu' did not resolve an ep=4,dp=2 "
+                "mesh")
+        if not moe["expert_sharded"]:
+            failures.append(
+                "moe lane: expert weights are replicated — the ep axis "
+                "did not shard dim 0 (expected 1 expert per ep group)")
+        for key, budget in MOE_BUDGET.items():
+            if moe[key] > budget:
+                failures.append(
+                    f"moe {key} = {moe[key]} exceeds budget {budget}")
     if ev_after_warm > STORE_BUDGET["evictions_after_warm"]:
         failures.append(
             f"program store evicted {ev_after_warm} programs during "
@@ -1110,6 +1366,17 @@ def main() -> int:
              f"{fsdp['param_bytes_frac']:.2f}x param bytes/device, accum "
              f"{fsdp['accum_dispatches_per_window']:.0f} "
              f"dispatches/window)")
+          + ("" if pp["skipped"] else
+             f"; pp within budget "
+             f"({pp['compiled_launches_per_step']:.0f} launch/step "
+             f"scan-internal schedule, accum "
+             f"{pp['accum_dispatches_per_window']:.0f} "
+             f"dispatches/window)")
+          + ("" if moe["skipped"] else
+             f"; moe within budget "
+             f"({moe['compiled_launches_per_step']:.0f} launch/step, "
+             f"{moe['reshards_after_warm']} reshards, ep-sharded "
+             f"experts)")
           + f"; program store within budget ({ev_after_warm} evictions, "
             f"warm 2nd process {store['second_process_compiles']} "
             f"compiles / {store['second_process_disk_hits']} disk hits)")

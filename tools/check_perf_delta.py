@@ -153,6 +153,22 @@ RULES: Tuple[Rule, ...] = (
     Rule("spec-churn",
          lambda n: n in ("spec.fallback_rounds", "spec.autodisabled"),
          tol=0.10, slack=2.0),
+    # ISSUE 20: the every-axis-mesh family.  The pp lane's measured
+    # bubble fraction growing means the scan-internal GPipe schedule
+    # lost fill/drain overlap (slack absorbs wall-clock jitter on the
+    # slope fit); the moe lane's dropped slots growing on the same
+    # bench batch means the routing/capacity balance regressed — the
+    # aux loss stopped doing its job; tokens/s/chip is the ep win
+    # itself (falling gate, generous for CPU-fallback noise).
+    Rule("pp-bubble",
+         lambda n: n == "pp.bubble_fraction_measured",
+         tol=0.10, slack=0.05),
+    Rule("moe-drop",
+         lambda n: n == "moe.dropped_slots",
+         tol=0.10, slack=2.0),
+    Rule("moe-throughput",
+         lambda n: n == "moe.tokens_per_s_per_chip",
+         tol=0.30, slack=100.0, falling=True),
 )
 
 # lane-level scalar aliases gated alongside the namespaced counters
@@ -416,6 +432,44 @@ def self_test() -> int:
               "acceptance rate was flagged as a regression "
               f"({report['regressions']})", file=sys.stderr)
         return 1
+    # ISSUE 20: a grown pp bubble fraction and a moe drop-count spike
+    # must trip their rules; a moe throughput IMPROVEMENT must not
+    axis_base = {
+        "metric": "pp_bubble_fraction", "value": 0.2,
+        "telemetry": {"pp.bubble_fraction_measured": 0.20,
+                      "moe.dropped_slots": 3,
+                      "moe.tokens_per_s_per_chip": 2500.0},
+    }
+    bubble_rise = json.loads(json.dumps(axis_base))
+    bubble_rise["telemetry"]["pp.bubble_fraction_measured"] = 0.40
+    report = compare([axis_base], [bubble_rise], waivers=[])
+    bad = [r for r in report["regressions"]
+           if r["counter"] == "pp.bubble_fraction_measured"
+           and r["rule"] == "pp-bubble"]
+    if not bad:
+        print("check_perf_delta: SELF-TEST FAILED — a doubled pp "
+              "bubble fraction was not flagged "
+              f"({report['regressions']})", file=sys.stderr)
+        return 1
+    drop_rise = json.loads(json.dumps(axis_base))
+    drop_rise["telemetry"]["moe.dropped_slots"] = 40
+    report = compare([axis_base], [drop_rise], waivers=[])
+    bad = [r for r in report["regressions"]
+           if r["counter"] == "moe.dropped_slots"
+           and r["rule"] == "moe-drop"]
+    if not bad:
+        print("check_perf_delta: SELF-TEST FAILED — a moe capacity-"
+              "drop spike was not flagged "
+              f"({report['regressions']})", file=sys.stderr)
+        return 1
+    tok_rise = json.loads(json.dumps(axis_base))
+    tok_rise["telemetry"]["moe.tokens_per_s_per_chip"] = 4000.0
+    report = compare([axis_base], [tok_rise], waivers=[])
+    if report["regressions"]:
+        print("check_perf_delta: SELF-TEST FAILED — an IMPROVED moe "
+              "throughput was flagged as a regression "
+              f"({report['regressions']})", file=sys.stderr)
+        return 1
     clean = compare([base_lane], [json.loads(json.dumps(base_lane))],
                     waivers=[])
     if clean["regressions"]:
@@ -424,7 +478,8 @@ def self_test() -> int:
               file=sys.stderr)
         return 1
     print("check_perf_delta: self-test OK (+1 retrace flagged, "
-          "acceptance drop flagged, identical snapshot clean)")
+          "acceptance drop flagged, pp bubble rise flagged, moe drop "
+          "spike flagged, identical snapshot clean)")
     return 0
 
 
